@@ -1,0 +1,105 @@
+import threading
+
+import pytest
+
+from yugabyte_db_tpu.utils import (
+    HybridTime, DocHybridTime, HybridClock, LogicalClock, Status, StatusError,
+    flags, metrics,
+)
+from yugabyte_db_tpu.utils.hybrid_time import MockPhysicalClock
+from yugabyte_db_tpu.utils import status as st
+
+
+class TestHybridTime:
+    def test_components(self):
+        ht = HybridTime.from_micros(123456, 7)
+        assert ht.physical_micros == 123456
+        assert ht.logical == 7
+
+    def test_ordering(self):
+        assert HybridTime.from_micros(1) < HybridTime.from_micros(2)
+        assert HybridTime.from_micros(1, 1) > HybridTime.from_micros(1, 0)
+        assert HybridTime.min() < HybridTime.max()
+
+    def test_clock_monotonic(self):
+        clock = HybridClock(MockPhysicalClock())
+        samples = [clock.now() for _ in range(100)]
+        assert samples == sorted(samples)
+        assert len(set(samples)) == 100  # strictly increasing (logical bumps)
+
+    def test_clock_update_ratchets(self):
+        clock = HybridClock(MockPhysicalClock(1000))
+        remote = HybridTime.from_micros(10_000_000)
+        clock.update(remote)
+        assert clock.now() > remote
+
+    def test_clock_threadsafe_strictly_increasing(self):
+        clock = HybridClock(MockPhysicalClock())
+        out = []
+        def worker():
+            for _ in range(200):
+                out.append(clock.now().value)
+        ts = [threading.Thread(target=worker) for _ in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert len(set(out)) == len(out)
+
+    def test_doc_ht_desc_encoding_orders(self):
+        a = DocHybridTime(HybridTime.from_micros(100), 0)
+        b = DocHybridTime(HybridTime.from_micros(200), 0)
+        assert b.encoded_desc() < a.encoded_desc()
+        assert DocHybridTime.decode_desc(a.encoded_desc()) == a
+
+    def test_logical_clock(self):
+        c = LogicalClock()
+        a, b = c.now(), c.now()
+        assert a < b
+
+
+class TestStatus:
+    def test_ok(self):
+        assert Status.OK().ok()
+        assert bool(Status.OK())
+
+    def test_error_raises(self):
+        s = st.not_found("missing tablet", tablet_id="t1")
+        assert not s.ok()
+        with pytest.raises(StatusError) as ei:
+            s.raise_if_error()
+        assert ei.value.code == st.Code.NOT_FOUND
+        assert ei.value.status.payload["tablet_id"] == "t1"
+
+
+class TestFlags:
+    def test_runtime_flag_set(self):
+        flags.set_flag("tpu_pushdown_enabled", False)
+        assert flags.get("tpu_pushdown_enabled") is False
+        flags.REGISTRY.reset("tpu_pushdown_enabled")
+        assert flags.get("tpu_pushdown_enabled") is True
+
+    def test_callback(self):
+        seen = []
+        f = flags.DEFINE_RUNTIME("test_cb_flag", 1)
+        flags.REGISTRY.on_change("test_cb_flag", seen.append)
+        flags.set_flag("test_cb_flag", 5)
+        assert seen == [5]
+
+    def test_auto_flag_promotion(self):
+        af = flags.DEFINE_AUTO("test_auto", initial=False, target=True)
+        assert af.value is False
+        flags.promote_auto_flags()
+        assert af.value is True
+
+
+class TestMetrics:
+    def test_counter_histogram_prometheus(self):
+        reg = metrics.MetricRegistry()
+        e = reg.entity("tablet", "tab-1", table_name="t")
+        e.counter("rows_scanned").increment(10)
+        h = e.histogram("read_latency_us")
+        for v in (10, 100, 1000):
+            h.increment(v)
+        assert h.count() == 3
+        assert h.percentile(50) >= 10
+        text = reg.to_prometheus()
+        assert "rows_scanned" in text and 'metric_id="tab-1"' in text

@@ -1,0 +1,38 @@
+"""yugabyte_db_tpu — a TPU-native distributed SQL database.
+
+A from-scratch implementation of YugabyteDB's capability surface
+(reference: /root/reference, see /root/repo/SURVEY.md), re-architected
+TPU-first:
+
+- Control plane (Raft consensus, WAL, tablet lifecycle, master/catalog,
+  RPC) is host-side code with the same seams as the reference
+  (`src/yb/consensus/`, `src/yb/master/`, `src/yb/rpc/`).
+- Data-plane hot loops — scan/filter/aggregate execution (reference:
+  `src/yb/docdb/pgsql_operation.cc:2790` ExecuteScalar) and LSM
+  compaction merge + MVCC GC (reference:
+  `src/yb/rocksdb/db/compaction_job.cc:665`,
+  `src/yb/docdb/docdb_compaction_context.cc:783`) — run as JAX/XLA
+  kernels on TPU, behind a runtime flag (`tpu_pushdown_enabled`).
+- Storage blocks are columnar from day one so device decode is a
+  reinterpret + reshape, not a row loop.
+
+Package layout:
+  utils/      Status/Result, hybrid time (HLC), flags, metrics, trace
+  dockv/      doc key / value encoding, packed rows, partitions
+  storage/    LSM: memtable, SSTables (columnar blocks), merge, compaction
+  docdb/      MVCC document store: read/write paths, intents, conflicts
+  ops/        JAX kernels: scan/filter/aggregate, compaction merge, vector
+  parallel/   device mesh, shard_map distributed scan, psum combine
+  consensus/  per-tablet Raft + replicated log (the WAL)
+  tablet/     tablet core, peers, operations, bootstrap, snapshots, txns
+  tserver/    data node: tablet service, read path driver, heartbeater
+  master/     control plane: sys catalog, catalog manager, load balancer
+  client/     cluster client: meta cache, batcher, transactions
+  rpc/        async RPC framework (asyncio reactors, binary framing)
+  ql/         query layers: YSQL-subset SQL, YCQL, Redis
+  models/     end-to-end engine pipelines (benchmark workloads, flagship
+              scan models used by __graft_entry__)
+  tools/      admin CLI, local cluster launcher
+"""
+
+__version__ = "0.1.0"

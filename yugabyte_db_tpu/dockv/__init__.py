@@ -1,0 +1,11 @@
+from .key_encoding import (  # noqa: F401
+    ValueType, KeyBytes, KeyEntryValue,
+    encode_key_entry, decode_key_entry,
+    DocKey, SubDocKey,
+)
+from .value import PrimitiveValue, ValueKind  # noqa: F401
+from .partition import PartitionSchema, Partition, hash_key_for  # noqa: F401
+from .packed_row import (  # noqa: F401
+    ColumnType, ColumnSchema, TableSchema, SchemaPacking,
+    RowPacker, unpack_row, SchemaPackingStorage,
+)

@@ -1,0 +1,116 @@
+"""Hash and range partitioning of tables into tablets.
+
+Reference: src/yb/dockv/partition.h — a PartitionSchema maps a row's key to
+a 16-bit hash; tablets own contiguous ranges of hash space (or ranges of
+encoded range keys for range-sharded tables). Docs:
+architecture/docdb-sharding/sharding.md.
+"""
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+from .key_encoding import DocKey, KeyEntryValue, encode_key_entry
+
+MAX_HASH = 0x10000  # 16-bit hash space, like the reference
+
+
+def hash_key_for(entries: Sequence[KeyEntryValue]) -> int:
+    """Deterministic 16-bit hash of the hashed key components.
+
+    The reference uses YBPartition::HashColumnCompoundValue (Jenkins);
+    we hash the order-preserving encoding with blake2b for determinism
+    across hosts and languages.
+    """
+    h = hashlib.blake2b(digest_size=2)
+    for e in entries:
+        h.update(encode_key_entry(e))
+    return int.from_bytes(h.digest(), "big")
+
+
+@dataclass(frozen=True)
+class Partition:
+    """One tablet's key-space slice: [start, end) over the partition key.
+
+    For hash-sharded tables the bounds are 2-byte big-endian hash values;
+    empty bytes mean -inf / +inf (reference: dockv/partition.h Partition).
+    """
+
+    start: bytes = b""
+    end: bytes = b""
+
+    def contains(self, partition_key: bytes) -> bool:
+        if self.start and partition_key < self.start:
+            return False
+        if self.end and partition_key >= self.end:
+            return False
+        return True
+
+    def __repr__(self):
+        s = self.start.hex() or "-inf"
+        e = self.end.hex() or "+inf"
+        return f"Partition[{s},{e})"
+
+
+@dataclass(frozen=True)
+class PartitionSchema:
+    """How a table splits into tablets.
+
+    kind: 'hash' (16-bit multi-column hash) or 'range' (encoded range key).
+    num_hash_columns tells how many leading PK columns are hashed; the rest
+    are range columns within the tablet.
+    """
+
+    kind: str = "hash"
+    num_hash_columns: int = 1
+
+    def partition_key_for_row(self, pk_entries: Sequence[KeyEntryValue]) -> bytes:
+        if self.kind == "hash":
+            h = hash_key_for(pk_entries[: self.num_hash_columns])
+            return h.to_bytes(2, "big")
+        out = bytearray()
+        for e in pk_entries:
+            out += encode_key_entry(e)
+        return bytes(out)
+
+    def doc_key_for_row(self, pk_entries: Sequence[KeyEntryValue]) -> DocKey:
+        if self.kind == "hash":
+            n = self.num_hash_columns
+            return DocKey.make(hash=hash_key_for(pk_entries[:n]),
+                               hashed=pk_entries[:n], range=pk_entries[n:])
+        return DocKey.make(range=pk_entries)
+
+    def create_partitions(self, num_tablets: int,
+                          split_points: Optional[List[bytes]] = None
+                          ) -> List[Partition]:
+        """Even hash-space split (reference:
+        PartitionSchema::CreateHashPartitions) or explicit range split
+        points."""
+        if self.kind == "range":
+            points = split_points or []
+            bounds = [b""] + list(points) + [b""]
+            return [Partition(bounds[i], bounds[i + 1])
+                    for i in range(len(bounds) - 1)]
+        step = MAX_HASH // num_tablets
+        parts = []
+        for i in range(num_tablets):
+            start = (i * step).to_bytes(2, "big") if i else b""
+            end = ((i + 1) * step).to_bytes(2, "big") if i + 1 < num_tablets else b""
+            parts.append(Partition(start, end))
+        return parts
+
+
+def split_partition(p: Partition, split_key: Optional[bytes] = None
+                    ) -> Tuple[Partition, Partition]:
+    """Split a partition at split_key (or the hash midpoint) — the core of
+    automatic tablet splitting (reference: tablet/operations/split_operation.cc,
+    master/tablet_split_manager.cc)."""
+    if split_key is None:
+        lo = int.from_bytes(p.start or b"\x00\x00", "big")
+        hi = int.from_bytes(p.end or b"\xff\xff", "big") if p.end else MAX_HASH
+        mid = (lo + hi) // 2
+        split_key = mid.to_bytes(2, "big")
+    if (p.start and split_key <= p.start) or (p.end and split_key >= p.end):
+        raise ValueError("split key outside partition")
+    return Partition(p.start, split_key), Partition(split_key, p.end)

@@ -1,0 +1,111 @@
+"""Primitive value encoding for the value side of KV pairs.
+
+Analog of the reference's PrimitiveValue (reference:
+src/yb/dockv/primitive_value.cc) minus the key-encoding half, which lives
+in key_encoding.py. Values don't need order preservation, so encodings are
+compact little-endian.
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+
+class ValueKind:
+    kNull = 0x00
+    kFalse = 0x01
+    kTrue = 0x02
+    kInt32 = 0x03
+    kInt64 = 0x04
+    kDouble = 0x05
+    kFloat = 0x06
+    kString = 0x07
+    kBytes = 0x08
+    kTimestamp = 0x09
+    kDecimal = 0x0A
+    kJson = 0x0B
+    kTombstone = 0x10        # row/cell deletion marker
+    kPackedRowV1 = 0x20      # row-as-single-KV, nested-values format
+    kPackedRowV2 = 0x21      # row-as-single-KV, columnar-friendly format
+    kMergeFlags = 0x30       # TTL etc. prefix
+    kRowLock = 0x31          # lock-only intent value
+
+
+@dataclass(frozen=True)
+class PrimitiveValue:
+    kind: int
+    value: object = None
+
+    @staticmethod
+    def null(): return PrimitiveValue(ValueKind.kNull)
+    @staticmethod
+    def tombstone(): return PrimitiveValue(ValueKind.kTombstone)
+    @staticmethod
+    def int32(v): return PrimitiveValue(ValueKind.kInt32, int(v))
+    @staticmethod
+    def int64(v): return PrimitiveValue(ValueKind.kInt64, int(v))
+    @staticmethod
+    def double(v): return PrimitiveValue(ValueKind.kDouble, float(v))
+    @staticmethod
+    def string(v): return PrimitiveValue(ValueKind.kString, str(v))
+    @staticmethod
+    def raw_bytes(v): return PrimitiveValue(ValueKind.kBytes, bytes(v))
+    @staticmethod
+    def bool_(v): return PrimitiveValue(ValueKind.kTrue if v else ValueKind.kFalse)
+    @staticmethod
+    def timestamp(us): return PrimitiveValue(ValueKind.kTimestamp, int(us))
+
+    def is_tombstone(self) -> bool:
+        return self.kind == ValueKind.kTombstone
+
+    def to_python(self):
+        if self.kind == ValueKind.kTrue:
+            return True
+        if self.kind == ValueKind.kFalse:
+            return False
+        if self.kind in (ValueKind.kNull, ValueKind.kTombstone):
+            return None
+        return self.value
+
+    def encode(self) -> bytes:
+        k = self.kind
+        if k in (ValueKind.kNull, ValueKind.kTombstone, ValueKind.kTrue,
+                 ValueKind.kFalse, ValueKind.kRowLock):
+            return bytes([k])
+        if k == ValueKind.kInt32:
+            return bytes([k]) + struct.pack("<i", self.value)
+        if k in (ValueKind.kInt64, ValueKind.kTimestamp):
+            return bytes([k]) + struct.pack("<q", self.value)
+        if k == ValueKind.kDouble:
+            return bytes([k]) + struct.pack("<d", self.value)
+        if k == ValueKind.kFloat:
+            return bytes([k]) + struct.pack("<f", self.value)
+        if k == ValueKind.kString:
+            return bytes([k]) + self.value.encode()
+        if k in (ValueKind.kBytes, ValueKind.kJson,
+                 ValueKind.kPackedRowV1, ValueKind.kPackedRowV2):
+            return bytes([k]) + self.value
+        raise ValueError(f"cannot encode value kind {k:#x}")
+
+    @classmethod
+    def decode(cls, data: bytes) -> "PrimitiveValue":
+        k = data[0]
+        body = data[1:]
+        if k in (ValueKind.kNull, ValueKind.kTombstone, ValueKind.kTrue,
+                 ValueKind.kFalse, ValueKind.kRowLock):
+            return cls(k)
+        if k == ValueKind.kInt32:
+            return cls(k, struct.unpack("<i", body[:4])[0])
+        if k in (ValueKind.kInt64, ValueKind.kTimestamp):
+            return cls(k, struct.unpack("<q", body[:8])[0])
+        if k == ValueKind.kDouble:
+            return cls(k, struct.unpack("<d", body[:8])[0])
+        if k == ValueKind.kFloat:
+            return cls(k, struct.unpack("<f", body[:4])[0])
+        if k == ValueKind.kString:
+            return cls(k, body.decode())
+        if k in (ValueKind.kBytes, ValueKind.kJson,
+                 ValueKind.kPackedRowV1, ValueKind.kPackedRowV2):
+            return cls(k, bytes(body))
+        raise ValueError(f"cannot decode value kind {k:#x}")

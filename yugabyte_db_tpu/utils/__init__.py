@@ -1,0 +1,4 @@
+from .status import Status, StatusError, Result  # noqa: F401
+from .hybrid_time import HybridTime, DocHybridTime, HybridClock, LogicalClock  # noqa: F401
+from . import flags  # noqa: F401
+from . import metrics  # noqa: F401

@@ -1,0 +1,148 @@
+"""Runtime flag registry.
+
+Mirrors the reference's gflags + YB wrappers: DEFINE_RUNTIME_* flags are
+hot-updatable at runtime (reference: src/yb/util/flags.h), flags carry tags
+(reference: src/yb/util/flags/flag_tags.h), and AutoFlags gate wire/disk
+format changes on universe-wide upgrade (reference:
+src/yb/util/flags/auto_flags.h, architecture/design/auto_flags.md).
+
+The TPU pushdown switch `tpu_pushdown_enabled` follows the reference's
+planned `yb_enable_tpu_pushdown` GUC pattern: a runtime flag consulted at
+the scan/compaction seams with zero SQL changes.
+"""
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+@dataclass
+class Flag:
+    name: str
+    default: Any
+    help: str
+    tags: tuple = ()
+    runtime: bool = False
+    value: Any = None
+    callbacks: list = field(default_factory=list)
+
+    def get(self):
+        return self.value
+
+
+class FlagRegistry:
+    def __init__(self):
+        self._flags: dict[str, Flag] = {}
+        self._lock = threading.Lock()
+
+    def define(self, name: str, default: Any, help: str = "",
+               tags: tuple = (), runtime: bool = False) -> Flag:
+        with self._lock:
+            if name in self._flags:
+                return self._flags[name]
+            f = Flag(name, default, help, tags, runtime, default)
+            self._flags[name] = f
+            return f
+
+    def get(self, name: str) -> Any:
+        return self._flags[name].value
+
+    def set(self, name: str, value: Any) -> None:
+        f = self._flags[name]
+        if not f.runtime:
+            raise ValueError(f"flag {name} is not runtime-settable")
+        f.value = value
+        for cb in f.callbacks:
+            cb(value)
+
+    def on_change(self, name: str, cb: Callable[[Any], None]) -> None:
+        self._flags[name].callbacks.append(cb)
+
+    def all(self) -> dict[str, Any]:
+        return {n: f.value for n, f in self._flags.items()}
+
+    def reset(self, name: str) -> None:
+        f = self._flags[name]
+        f.value = f.default
+
+
+REGISTRY = FlagRegistry()
+
+define_flag = REGISTRY.define
+
+
+def DEFINE_RUNTIME(name: str, default: Any, help: str = "", tags: tuple = ()):
+    return REGISTRY.define(name, default, help, tags, runtime=True)
+
+
+def DEFINE(name: str, default: Any, help: str = "", tags: tuple = ()):
+    return REGISTRY.define(name, default, help, tags, runtime=False)
+
+
+def get(name: str) -> Any:
+    return REGISTRY.get(name)
+
+
+def set_flag(name: str, value: Any) -> None:
+    REGISTRY.set(name, value)
+
+
+# --- AutoFlags ------------------------------------------------------------
+# A flag whose value auto-promotes from `initial` to `target` only once the
+# whole universe is upgraded (reference: util/flags/auto_flags.h). We track
+# promotion state in the registry; the master's auto-flags manager flips it.
+
+@dataclass
+class AutoFlag:
+    name: str
+    initial: Any
+    target: Any
+    flag_class: str  # kLocalVolatile/kLocalPersisted/kExternal
+    promoted: bool = False
+
+    @property
+    def value(self):
+        return self.target if self.promoted else self.initial
+
+
+_AUTO_FLAGS: dict[str, AutoFlag] = {}
+
+
+def DEFINE_AUTO(name: str, initial: Any, target: Any,
+                flag_class: str = "kLocalVolatile") -> AutoFlag:
+    f = AutoFlag(name, initial, target, flag_class)
+    _AUTO_FLAGS[name] = f
+    return f
+
+
+def promote_auto_flags() -> None:
+    for f in _AUTO_FLAGS.values():
+        f.promoted = True
+
+
+def auto_flags() -> dict[str, AutoFlag]:
+    return dict(_AUTO_FLAGS)
+
+
+# --- Core engine flags ----------------------------------------------------
+DEFINE_RUNTIME("tpu_pushdown_enabled", True,
+               "Route scan/filter/aggregate pushdown to the TPU execution "
+               "backend (the yb_enable_tpu_pushdown analog).")
+DEFINE_RUNTIME("tpu_compaction_enabled", True,
+               "Offload LSM compaction merge + MVCC GC to TPU kernels.")
+DEFINE_RUNTIME("tpu_min_rows_for_pushdown", 4096,
+               "Scans smaller than this stay on the CPU path: point reads "
+               "must never pay a device round-trip.")
+DEFINE_RUNTIME("raft_heartbeat_interval_ms", 50, "Raft leader heartbeat period.")
+DEFINE_RUNTIME("leader_lease_duration_ms", 2000, "Raft leader lease length.")
+DEFINE_RUNTIME("log_segment_size_bytes", 16 * 1024 * 1024, "WAL segment size.")
+DEFINE_RUNTIME("memstore_flush_threshold_bytes", 64 * 1024 * 1024,
+               "Memtable size that triggers a flush.")
+DEFINE_RUNTIME("history_retention_interval_sec", 900,
+               "MVCC history retention before compaction GC "
+               "(timestamp_history_retention_interval_sec analog).")
+
+# TEST_ flags (reference: DEFINE_test_flag, util/flags/flag_tags.h:311)
+DEFINE_RUNTIME("TEST_fault_crash_fraction", 0.0,
+               "Probabilistic fault injection fraction (MAYBE_FAULT analog).")

@@ -1,0 +1,191 @@
+"""Hybrid logical clock (HLC) time.
+
+Mirrors the reference's HybridTime (reference: src/yb/common/hybrid_time.h:63
+— 64-bit value, physical microseconds in the high 52 bits, 12-bit logical
+component) and DocHybridTime (reference: src/yb/common/doc_hybrid_time.h —
+HybridTime + intra-transaction write_id), plus the HybridClock
+(reference: src/yb/server/hybrid_clock.h:89).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from functools import total_ordering
+
+kBitsForLogicalComponent = 12
+kLogicalMask = (1 << kBitsForLogicalComponent) - 1
+_MAX_U64 = (1 << 64) - 1
+
+
+@total_ordering
+@dataclass(frozen=True)
+class HybridTime:
+    """64-bit hybrid time: (physical_micros << 12) | logical."""
+
+    value: int = 0
+
+    # --- constructors -----------------------------------------------------
+    @classmethod
+    def from_micros(cls, micros: int, logical: int = 0) -> "HybridTime":
+        return cls((micros << kBitsForLogicalComponent) | logical)
+
+    @classmethod
+    def min(cls) -> "HybridTime":
+        return _MIN
+
+    @classmethod
+    def max(cls) -> "HybridTime":
+        return _MAX
+
+    @classmethod
+    def invalid(cls) -> "HybridTime":
+        return _INVALID
+
+    # --- accessors --------------------------------------------------------
+    @property
+    def physical_micros(self) -> int:
+        return self.value >> kBitsForLogicalComponent
+
+    @property
+    def logical(self) -> int:
+        return self.value & kLogicalMask
+
+    def is_valid(self) -> bool:
+        return self.value != _MAX_U64
+
+    def incremented(self) -> "HybridTime":
+        return HybridTime(self.value + 1)
+
+    def decremented(self) -> "HybridTime":
+        return HybridTime(self.value - 1)
+
+    def add_micros(self, micros: int) -> "HybridTime":
+        return HybridTime(self.value + (micros << kBitsForLogicalComponent))
+
+    def __lt__(self, other: "HybridTime") -> bool:
+        return self.value < other.value
+
+    def __repr__(self) -> str:
+        if self.value == _MAX_U64:
+            return "HT<invalid>"
+        return f"HT{{p: {self.physical_micros} l: {self.logical}}}"
+
+
+_MIN = HybridTime(0)
+_MAX = HybridTime(_MAX_U64 - 1)
+_INVALID = HybridTime(_MAX_U64)
+
+
+kMaxWriteId = (1 << 32) - 1
+
+
+@total_ordering
+@dataclass(frozen=True)
+class DocHybridTime:
+    """HybridTime plus intra-transaction write index.
+
+    Reference: src/yb/common/doc_hybrid_time.h. Orders first by hybrid
+    time, then by write_id.
+    """
+
+    ht: HybridTime
+    write_id: int = 0
+
+    @classmethod
+    def min(cls) -> "DocHybridTime":
+        return cls(HybridTime.min(), 0)
+
+    @classmethod
+    def max(cls) -> "DocHybridTime":
+        return cls(HybridTime.max(), kMaxWriteId)
+
+    def __lt__(self, other: "DocHybridTime") -> bool:
+        return (self.ht.value, self.write_id) < (other.ht.value, other.write_id)
+
+    def __repr__(self) -> str:
+        return f"DocHT{{{self.ht!r} w: {self.write_id}}}"
+
+    # 96-bit packed form used in keys; encoded DESCENDING so that within one
+    # doc key the newest version sorts first (reference:
+    # src/yb/common/doc_hybrid_time.cc AppendEncodedInDocDbFormat).
+    def encoded_desc(self) -> bytes:
+        packed = (self.ht.value << 32) | self.write_id
+        return (packed ^ ((1 << 96) - 1)).to_bytes(12, "big")
+
+    @classmethod
+    def decode_desc(cls, data: bytes) -> "DocHybridTime":
+        packed = int.from_bytes(data[:12], "big") ^ ((1 << 96) - 1)
+        return cls(HybridTime(packed >> 32), packed & 0xFFFFFFFF)
+
+
+ENCODED_SIZE = 12  # bytes of encoded DocHybridTime
+
+
+class PhysicalClock:
+    """Pluggable physical clock (reference: src/yb/server/hybrid_clock.h)."""
+
+    def now_micros(self) -> int:
+        return time.time_ns() // 1000
+
+
+class MockPhysicalClock(PhysicalClock):
+    """Manually-advanced clock for tests (reference: server/skewed_clock.h,
+    MockHybridClock)."""
+
+    def __init__(self, start_micros: int = 1_000_000):
+        self._now = start_micros
+
+    def now_micros(self) -> int:
+        return self._now
+
+    def advance_micros(self, d: int) -> None:
+        self._now += d
+
+
+class HybridClock:
+    """HLC: monotonic hybrid time from a (possibly non-monotonic) physical
+    clock; `update` incorporates remote timestamps (messages carry HT and the
+    receiver ratchets, giving cross-node causality).
+    """
+
+    def __init__(self, physical: PhysicalClock | None = None):
+        self._physical = physical or PhysicalClock()
+        self._last = 0
+        self._lock = threading.Lock()
+
+    def now(self) -> HybridTime:
+        with self._lock:
+            phys = self._physical.now_micros() << kBitsForLogicalComponent
+            self._last = max(phys, self._last + 1)
+            return HybridTime(self._last)
+
+    def update(self, observed: HybridTime) -> None:
+        """Ratchet local clock past an observed remote hybrid time."""
+        with self._lock:
+            if observed.value > self._last:
+                self._last = observed.value
+
+    def max_global_now(self) -> HybridTime:
+        # Uncertainty window upper bound; with no NTP error tracking we use a
+        # fixed 500ms bound like the reference's default max clock skew.
+        return self.now().add_micros(500_000)
+
+
+class LogicalClock:
+    """Pure logical clock for deterministic unit tests
+    (reference: src/yb/server/logical_clock.h)."""
+
+    def __init__(self, start: int = 1):
+        self._value = start
+        self._lock = threading.Lock()
+
+    def now(self) -> HybridTime:
+        with self._lock:
+            self._value += 1
+            return HybridTime(self._value)
+
+    def update(self, observed: HybridTime) -> None:
+        with self._lock:
+            if observed.value > self._value:
+                self._value = observed.value

@@ -1,0 +1,171 @@
+"""Metrics registry: counters, gauges, histograms; Prometheus export.
+
+Mirrors the reference's macro-declared per-entity metric registry
+(reference: src/yb/util/metrics.h:278-325, util/metrics_writer.cc for the
+Prometheus endpoint, util/hdr_histogram.cc for percentile tracking).
+Entities: server / table / tablet, each with attributes.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+from dataclasses import dataclass, field
+
+
+class Counter:
+    __slots__ = ("name", "help", "_value", "_lock")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name, self.help = name, help
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def increment(self, by: int = 1):
+        with self._lock:
+            self._value += by
+
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    __slots__ = ("name", "help", "_value", "_lock")
+
+    def __init__(self, name: str, help: str = "", initial=0):
+        self.name, self.help = name, help
+        self._value = initial
+        self._lock = threading.Lock()
+
+    def set(self, v):
+        with self._lock:
+            self._value = v
+
+    def increment(self, by=1):
+        with self._lock:
+            self._value += by
+
+    def decrement(self, by=1):
+        with self._lock:
+            self._value -= by
+
+    def value(self):
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket latency histogram with percentile estimation
+    (HdrHistogram-lite; reference: util/hdr_histogram.cc)."""
+
+    # exponential bucket bounds in microseconds, 1us .. ~67s
+    _BOUNDS = [2 ** i for i in range(27)]
+
+    def __init__(self, name: str, help: str = ""):
+        self.name, self.help = name, help
+        self._counts = [0] * (len(self._BOUNDS) + 1)
+        self._total = 0
+        self._sum = 0
+        self._min = None
+        self._max = None
+        self._lock = threading.Lock()
+
+    def increment(self, value_us: float):
+        idx = bisect.bisect_left(self._BOUNDS, value_us)
+        with self._lock:
+            self._counts[idx] += 1
+            self._total += 1
+            self._sum += value_us
+            self._min = value_us if self._min is None else min(self._min, value_us)
+            self._max = value_us if self._max is None else max(self._max, value_us)
+
+    def percentile(self, p: float) -> float:
+        with self._lock:
+            if self._total == 0:
+                return 0.0
+            target = self._total * p / 100.0
+            acc = 0
+            for i, c in enumerate(self._counts):
+                acc += c
+                if acc >= target:
+                    return float(self._BOUNDS[i] if i < len(self._BOUNDS)
+                                 else self._BOUNDS[-1])
+            return float(self._BOUNDS[-1])
+
+    def mean(self) -> float:
+        return self._sum / self._total if self._total else 0.0
+
+    def count(self) -> int:
+        return self._total
+
+
+@dataclass
+class MetricEntity:
+    """A metric scope: server / table / tablet (reference: util/metrics.h)."""
+
+    type: str
+    id: str
+    attributes: dict = field(default_factory=dict)
+    metrics: dict = field(default_factory=dict)
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self.metrics.setdefault(name, Counter(name, help))
+
+    def gauge(self, name: str, help: str = "", initial=0) -> Gauge:
+        return self.metrics.setdefault(name, Gauge(name, help, initial))
+
+    def histogram(self, name: str, help: str = "") -> Histogram:
+        return self.metrics.setdefault(name, Histogram(name, help))
+
+
+class MetricRegistry:
+    def __init__(self):
+        self._entities: dict[tuple, MetricEntity] = {}
+        self._lock = threading.Lock()
+
+    def entity(self, type: str, id: str, **attributes) -> MetricEntity:
+        with self._lock:
+            key = (type, id)
+            if key not in self._entities:
+                self._entities[key] = MetricEntity(type, id, attributes)
+            return self._entities[key]
+
+    def entities(self):
+        return list(self._entities.values())
+
+    def to_prometheus(self) -> str:
+        """Render all metrics in Prometheus text exposition format
+        (reference: util/prometheus_metric_filter.cc)."""
+        out = []
+        for e in self.entities():
+            labels = ",".join(
+                [f'{k}="{v}"' for k, v in
+                 {"metric_type": e.type, "metric_id": e.id, **e.attributes}.items()])
+            for m in e.metrics.values():
+                if isinstance(m, Counter):
+                    out.append(f"{m.name}{{{labels}}} {m.value()}")
+                elif isinstance(m, Gauge):
+                    out.append(f"{m.name}{{{labels}}} {m.value()}")
+                elif isinstance(m, Histogram):
+                    out.append(f"{m.name}_count{{{labels}}} {m.count()}")
+                    out.append(f"{m.name}_sum{{{labels}}} {m._sum}")
+                    for p in (50, 95, 99):
+                        out.append(
+                            f"{m.name}{{{labels},quantile=\"0.{p}\"}} "
+                            f"{m.percentile(p)}")
+        return "\n".join(out) + "\n"
+
+    def to_json(self) -> list:
+        return [
+            {
+                "type": e.type, "id": e.id, "attributes": e.attributes,
+                "metrics": [
+                    {"name": m.name,
+                     "value": m.value() if hasattr(m, "value") else None,
+                     "count": m.count() if isinstance(m, Histogram) else None}
+                    for m in e.metrics.values()
+                ],
+            }
+            for e in self.entities()
+        ]
+
+
+REGISTRY = MetricRegistry()

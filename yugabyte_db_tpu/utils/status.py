@@ -1,0 +1,132 @@
+"""Status / Result error model.
+
+Mirrors the reference's `Status`/`Result<T>` (reference:
+src/yb/util/status.h, src/yb/util/result.h) with Python ergonomics:
+a `Status` value carries a code + message; `StatusError` is the
+exception wrapper used across async boundaries.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Generic, TypeVar, Union
+
+
+class Code(enum.Enum):
+    OK = 0
+    NOT_FOUND = 1
+    CORRUPTION = 2
+    NOT_SUPPORTED = 3
+    INVALID_ARGUMENT = 4
+    IO_ERROR = 5
+    ALREADY_PRESENT = 6
+    RUNTIME_ERROR = 7
+    NETWORK_ERROR = 8
+    ILLEGAL_STATE = 9
+    NOT_AUTHORIZED = 10
+    ABORTED = 11
+    REMOTE_ERROR = 12
+    SERVICE_UNAVAILABLE = 13
+    TIMED_OUT = 14
+    UNINITIALIZED = 15
+    CONFIGURATION_ERROR = 16
+    INCOMPLETE = 17
+    END_OF_FILE = 18
+    INTERNAL_ERROR = 19
+    TRY_AGAIN = 20
+    BUSY = 21
+    SHUTDOWN_IN_PROGRESS = 22
+    MERGE_IN_PROGRESS = 23
+    COMBINED = 24
+    LEADER_NOT_READY = 25
+    LEADER_HAS_NO_LEASE = 26
+    TABLET_SPLIT = 27
+    EXPIRED = 28
+    CACHE_MISS_ERROR = 29
+    SNAPSHOT_TOO_OLD = 30
+    DEADLOCK = 31
+
+
+@dataclass(frozen=True)
+class Status:
+    code: Code = Code.OK
+    message: str = ""
+    # Optional machine-readable payloads (e.g. conflicting txn id, tablet id).
+    payload: dict = field(default_factory=dict)
+
+    def ok(self) -> bool:
+        return self.code is Code.OK
+
+    def __bool__(self) -> bool:  # `if status:` reads as "is ok"
+        return self.ok()
+
+    def __str__(self) -> str:
+        return "OK" if self.ok() else f"{self.code.name}: {self.message}"
+
+    def raise_if_error(self) -> None:
+        if not self.ok():
+            raise StatusError(self)
+
+    # --- constructors -----------------------------------------------------
+    @staticmethod
+    def OK() -> "Status":
+        return _OK
+
+    @classmethod
+    def make(cls, code: Code, message: str = "", **payload) -> "Status":
+        return cls(code, message, payload)
+
+
+_OK = Status()
+
+
+def _mk(code: Code):
+    def ctor(message: str = "", **payload) -> Status:
+        return Status(code, message, payload)
+    ctor.__name__ = code.name.lower()
+    return ctor
+
+
+not_found = _mk(Code.NOT_FOUND)
+corruption = _mk(Code.CORRUPTION)
+not_supported = _mk(Code.NOT_SUPPORTED)
+invalid_argument = _mk(Code.INVALID_ARGUMENT)
+io_error = _mk(Code.IO_ERROR)
+already_present = _mk(Code.ALREADY_PRESENT)
+runtime_error = _mk(Code.RUNTIME_ERROR)
+network_error = _mk(Code.NETWORK_ERROR)
+illegal_state = _mk(Code.ILLEGAL_STATE)
+aborted = _mk(Code.ABORTED)
+service_unavailable = _mk(Code.SERVICE_UNAVAILABLE)
+timed_out = _mk(Code.TIMED_OUT)
+internal_error = _mk(Code.INTERNAL_ERROR)
+try_again = _mk(Code.TRY_AGAIN)
+expired = _mk(Code.EXPIRED)
+leader_not_ready = _mk(Code.LEADER_NOT_READY)
+leader_has_no_lease = _mk(Code.LEADER_HAS_NO_LEASE)
+tablet_split = _mk(Code.TABLET_SPLIT)
+deadlock = _mk(Code.DEADLOCK)
+
+
+class StatusError(Exception):
+    """Exception carrying a Status across call/async boundaries."""
+
+    def __init__(self, status: Status):
+        super().__init__(str(status))
+        self.status = status
+
+    @property
+    def code(self) -> Code:
+        return self.status.code
+
+
+T = TypeVar("T")
+
+# A Result<T> in the reference is either a value or a Status; in Python we
+# just raise StatusError, but typed signatures can use Result[T] for clarity.
+Result = Union[T, Status]
+
+
+def check(cond: bool, status: Status) -> None:
+    if not cond:
+        raise StatusError(status)
