@@ -7,7 +7,6 @@ architecture/docdb-sharding/sharding.md.
 """
 from __future__ import annotations
 
-import hashlib
 from dataclasses import dataclass, field
 from typing import List, Optional, Sequence, Tuple
 
@@ -15,18 +14,25 @@ from .key_encoding import DocKey, KeyEntryValue, encode_key_entry
 
 MAX_HASH = 0x10000  # 16-bit hash space, like the reference
 
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_M64 = (1 << 64) - 1
+
 
 def hash_key_for(entries: Sequence[KeyEntryValue]) -> int:
     """Deterministic 16-bit hash of the hashed key components.
 
-    The reference uses YBPartition::HashColumnCompoundValue (Jenkins);
-    we hash the order-preserving encoding with blake2b for determinism
-    across hosts and languages.
+    The reference uses YBPartition::HashColumnCompoundValue (Jenkins); we
+    use FNV-1a over the order-preserving encoding, folded to 16 bits —
+    chosen because it is equally computable per-row here and in bulk with
+    numpy (dockv/bulk.py fast_hash16_from_encoded must agree bit-for-bit).
     """
-    h = hashlib.blake2b(digest_size=2)
+    h = _FNV_OFFSET
     for e in entries:
-        h.update(encode_key_entry(e))
-    return int.from_bytes(h.digest(), "big")
+        for b in encode_key_entry(e):
+            h = ((h ^ b) * _FNV_PRIME) & _M64
+    h ^= h >> 32
+    return h & 0xFFFF
 
 
 @dataclass(frozen=True)

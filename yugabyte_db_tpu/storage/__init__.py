@@ -1,0 +1,5 @@
+from .memtable import MemTable  # noqa: F401
+from .columnar import ColumnarBlock  # noqa: F401
+from .sst import SstWriter, SstReader, BloomFilter  # noqa: F401
+from .merge import merging_iterator  # noqa: F401
+from .lsm import LsmStore, WriteBatch, CompactionFeed  # noqa: F401

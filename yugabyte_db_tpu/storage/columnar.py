@@ -1,0 +1,264 @@
+"""Columnar block representation — the TPU-facing face of the LSM.
+
+The reference materializes rows one at a time into PgTableRow
+(reference: src/yb/dockv/pg_row.h, filled by
+src/yb/docdb/doc_rowwise_iterator.cc). We instead keep each SST data
+block's rows in STRUCT-OF-ARRAYS form: per-column numpy arrays + null
+masks, plus per-row hybrid time / write id / tombstone / key-hash arrays
+for MVCC. Decoding a block to device is then a buffer reinterpret, and
+scan/filter/aggregate kernels consume it directly (ops/scan.py).
+
+Blocks are built either from packed-row KV entries (flush/compaction
+path) or straight from user arrays (bulk load path), and serialize into
+the SST's columnar section.
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import msgpack
+import numpy as np
+
+from ..dockv.key_encoding import _decode_varint_unsigned
+from ..dockv.packed_row import ColumnType, SchemaPacking
+from ..dockv.value import ValueKind
+
+_HASH_MULT = np.uint64(0x100000001B3)
+_HASH_OFF = np.uint64(0xCBF29CE484222325)
+
+
+def fnv64_rows(mat: np.ndarray) -> np.ndarray:
+    """Row-wise FNV-1a 64-bit over an [N, L] uint8 matrix (vectorized over
+    rows; loop over the short L axis)."""
+    h = np.full(mat.shape[0], _HASH_OFF)
+    for j in range(mat.shape[1]):
+        h = (h ^ mat[:, j].astype(np.uint64)) * _HASH_MULT
+    return h
+
+
+def fnv64_bytes(data: bytes) -> int:
+    h = 0xCBF29CE484222325
+    for b in data:
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def fnv64_keys(keys: Sequence[bytes]) -> np.ndarray:
+    """Vectorized fnv64_bytes over variable-length keys: column-wise masked
+    updates so the result is byte-exact with the scalar hash regardless of
+    block-local padding (required for cross-block/SST dedup joins)."""
+    if not keys:
+        return np.zeros(0, np.uint64)
+    lens = np.array([len(k) for k in keys], np.int64)
+    w = int(lens.max())
+    mat = np.zeros((len(keys), w), np.uint8)
+    if lens.min() == w:
+        mat[:] = np.frombuffer(b"".join(keys), np.uint8).reshape(-1, w)
+    else:
+        for i, k in enumerate(keys):
+            mat[i, :len(k)] = np.frombuffer(k, np.uint8)
+    h = np.full(len(keys), _HASH_OFF)
+    for j in range(w):
+        upd = (h ^ mat[:, j].astype(np.uint64)) * _HASH_MULT
+        h = np.where(j < lens, upd, h)
+    return h
+
+
+@dataclass
+class ColumnarBlock:
+    """Struct-of-arrays form of one sorted run of rows."""
+
+    n: int
+    schema_version: int
+    # MVCC per-row metadata
+    key_hash: np.ndarray            # uint64 — FNV of encoded DocKey (no HT)
+    ht: np.ndarray                  # uint64 — HybridTime.value
+    write_id: np.ndarray            # uint32
+    tombstone: np.ndarray           # bool
+    # primary key component values (fixed-width components only)
+    pk: Dict[int, np.ndarray] = field(default_factory=dict)
+    # fixed-width value columns: col id -> (values, null_mask)
+    fixed: Dict[int, Tuple[np.ndarray, np.ndarray]] = field(default_factory=dict)
+    # varlen value columns: col id -> (end_offsets uint32 [n], heap bytes,
+    # null_mask)
+    varlen: Dict[int, Tuple[np.ndarray, bytes, np.ndarray]] = field(
+        default_factory=dict)
+    # True when every doc key appears exactly once in this block (post-
+    # compaction / bulk-load blocks) — enables the no-dedup scan fast path.
+    unique_keys: bool = True
+    # Optional full encoded SubDocKeys (incl. HT suffix) as an [N, L] uint8
+    # matrix — present on columnar-only blocks (bulk loads), where the KV
+    # row region is omitted entirely and rows are reconstructed on demand.
+    keys: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_packed_entries(
+            cls, packing: SchemaPacking,
+            keys: Sequence[bytes],              # encoded DocKey (no HT suffix)
+            hts: np.ndarray, write_ids: np.ndarray,
+            values: Sequence[bytes],            # KV values (kPackedRowV2 or
+                                                # kTombstone)
+            pk_decoder=None) -> "ColumnarBlock":
+        """Build from packed-row KV entries (flush/compaction path).
+
+        The fixed-stride prefix of the packed format means we can stack
+        all rows' prefixes into one [N, stride] matrix and reinterpret —
+        no per-row decode loop (see dockv/packed_row.py docstring).
+        """
+        n = len(keys)
+        tomb = np.zeros(n, bool)
+        hdr_len = _varint_len(packing.schema_version)
+        plen = hdr_len + packing.prefix_size
+        prefix_parts = []
+        pad = b"\x00" * plen
+        for i, v in enumerate(values):
+            if v[0] == ValueKind.kTombstone:
+                tomb[i] = True
+                prefix_parts.append(pad)
+            elif v[0] == ValueKind.kPackedRowV2:
+                prefix_parts.append(v[1:1 + plen])
+            else:
+                raise ValueError("columnar block needs packed or tombstone values")
+        mat = np.frombuffer(b"".join(prefix_parts), np.uint8).reshape(n, plen)
+        body = mat[:, hdr_len:]
+        blk = cls(
+            n=n, schema_version=packing.schema_version,
+            key_hash=fnv64_keys(keys),
+            ht=np.asarray(hts, np.uint64),
+            write_id=np.asarray(write_ids, np.uint32),
+            tombstone=tomb,
+        )
+        # null bitmap -> per-column masks
+        bitmap = body[:, :packing.bitmap_size]
+        for i, c in enumerate(packing.all_columns):
+            byte, bit = i // 8, i % 8
+            mask = (bitmap[:, byte] >> bit) & 1
+            null = mask.astype(bool) | tomb
+            if ColumnType.is_fixed(c.type):
+                off = packing.bitmap_size + packing.fixed_offsets[c.id]
+                w = ColumnType.FIXED_WIDTHS[c.type]
+                dt = ColumnType.NUMPY_DTYPES[c.type]
+                vals = np.ascontiguousarray(
+                    body[:, off:off + w]).view(dt).reshape(n)
+                blk.fixed[c.id] = (vals.copy(), null)
+        # varlen columns: per-row heaps differ in length → per-column gather
+        if packing.varlen_columns:
+            voff0 = packing.bitmap_size + packing.fixed_size
+            ends_mat = np.ascontiguousarray(
+                body[:, voff0:voff0 + 4 * len(packing.varlen_columns)]
+            ).view("<u4").reshape(n, len(packing.varlen_columns))
+            heaps = [v[1 + plen:] if not tomb[i] else b""
+                     for i, v in enumerate(values)]
+            for vi, c in enumerate(packing.varlen_columns):
+                i_ = len(packing.fixed_columns) + vi
+                null = ((bitmap[:, i_ // 8] >> (i_ % 8)) & 1).astype(bool) | tomb
+                starts = ends_mat[:, vi - 1] if vi else np.zeros(n, np.uint32)
+                ends = ends_mat[:, vi]
+                heap = bytearray()
+                out_ends = np.zeros(n, np.uint32)
+                for i in range(n):
+                    if not null[i]:
+                        heap += heaps[i][starts[i]:ends[i]]
+                    out_ends[i] = len(heap)
+                blk.varlen[c.id] = (out_ends, bytes(heap), null)
+        return blk
+
+    @classmethod
+    def from_arrays(cls, schema_version: int,
+                    key_hash: np.ndarray, ht: np.ndarray,
+                    write_id: Optional[np.ndarray] = None,
+                    pk: Optional[Dict[int, np.ndarray]] = None,
+                    fixed: Optional[Dict[int, Tuple[np.ndarray, np.ndarray]]] = None,
+                    varlen: Optional[Dict[int, Tuple[np.ndarray, bytes, np.ndarray]]] = None,
+                    tombstone: Optional[np.ndarray] = None,
+                    unique_keys: bool = True,
+                    keys: Optional[np.ndarray] = None) -> "ColumnarBlock":
+        n = len(key_hash)
+        return cls(
+            n=n, schema_version=schema_version,
+            key_hash=np.asarray(key_hash, np.uint64),
+            ht=np.asarray(ht, np.uint64),
+            write_id=(np.asarray(write_id, np.uint32) if write_id is not None
+                      else np.zeros(n, np.uint32)),
+            tombstone=(np.asarray(tombstone, bool) if tombstone is not None
+                       else np.zeros(n, bool)),
+            pk=dict(pk or {}), fixed=dict(fixed or {}), varlen=dict(varlen or {}),
+            unique_keys=unique_keys, keys=keys)
+
+    # ------------------------------------------------------------------
+    def serialize(self) -> bytes:
+        bufs: List[bytes] = []
+        def ref(arr: np.ndarray) -> dict:
+            raw = np.ascontiguousarray(arr).tobytes()
+            bufs.append(raw)
+            return {"dtype": str(arr.dtype), "shape": list(arr.shape),
+                    "len": len(raw)}
+        meta = {
+            "n": self.n, "sv": self.schema_version, "uniq": self.unique_keys,
+            "keys": ref(self.keys) if self.keys is not None else None,
+            "key_hash": ref(self.key_hash), "ht": ref(self.ht),
+            "wid": ref(self.write_id), "tomb": ref(self.tombstone),
+            "pk": {str(k): ref(v) for k, v in self.pk.items()},
+            "fixed": {str(k): [ref(v), ref(m)] for k, (v, m) in self.fixed.items()},
+            "varlen": {},
+        }
+        for k, (ends, heap, null) in self.varlen.items():
+            bufs.append(heap)
+            meta["varlen"][str(k)] = [ref(ends), {"len": len(heap)}, ref(null)]
+        head = msgpack.packb(meta)
+        return struct.pack("<I", len(head)) + head + b"".join(bufs)
+
+    @classmethod
+    def deserialize(cls, data: bytes) -> "ColumnarBlock":
+        hlen = struct.unpack_from("<I", data)[0]
+        meta = msgpack.unpackb(data[4:4 + hlen], strict_map_key=False)
+        pos = 4 + hlen
+
+        def take(ref) -> np.ndarray:
+            nonlocal pos
+            raw = data[pos:pos + ref["len"]]
+            pos += ref["len"]
+            return np.frombuffer(raw, dtype=np.dtype(ref["dtype"])).reshape(
+                ref["shape"]).copy()
+
+        def take_raw(n) -> bytes:
+            nonlocal pos
+            raw = data[pos:pos + n]
+            pos += n
+            return raw
+
+        keys = take(meta["keys"]) if meta.get("keys") is not None else None
+        blk = cls(
+            n=meta["n"], schema_version=meta["sv"],
+            key_hash=take(meta["key_hash"]), ht=take(meta["ht"]),
+            write_id=take(meta["wid"]), tombstone=take(meta["tomb"]),
+            unique_keys=meta["uniq"], keys=keys)
+        for k, ref_ in meta["pk"].items():
+            blk.pk[int(k)] = take(ref_)
+        for k, (vref, mref) in meta["fixed"].items():
+            v = take(vref)
+            m = take(mref)
+            blk.fixed[int(k)] = (v, m)
+        for k, (eref, heapinfo, nref) in meta["varlen"].items():
+            heap = take_raw(heapinfo["len"])
+            ends = take(eref)
+            null = take(nref)
+            blk.varlen[int(k)] = (ends, heap, null)
+        return blk
+
+    def visible_mask(self, read_ht: int) -> np.ndarray:
+        """MVCC visibility: rows written at or before read_ht."""
+        return self.ht <= np.uint64(read_ht)
+
+
+def _varint_len(v: int) -> int:
+    n = 1
+    while v >= 0x80:
+        v >>= 7
+        n += 1
+    return n
+
+

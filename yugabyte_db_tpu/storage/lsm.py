@@ -1,0 +1,284 @@
+"""Per-tablet LSM store: memtable + SSTs + flush + compaction + checkpoint.
+
+Analog of the reference's forked RocksDB DB instance per tablet
+(reference: src/yb/rocksdb/db/db_impl.cc), with the YB-specific traits
+kept: NO WAL of its own (the Raft log is the WAL — reference:
+src/yb/consensus/README), consensus frontiers persisted in SST files and
+the manifest (flushed op id decides bootstrap replay start), a pluggable
+streaming CompactionFeed seam (reference:
+src/yb/rocksdb/compaction_filter.h CompactionFeed), and hard-link
+checkpoints (reference: rocksdb/utilities/checkpoint.cc).
+
+Compaction style is size-tiered/universal (reference default for YB).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+
+from ..utils import flags
+from .memtable import MemTable
+from .merge import merging_iterator
+from .sst import SstReader, SstWriter
+
+
+@dataclass
+class WriteBatch:
+    """Ordered KV puts applied atomically to the memtable. Deletes are
+    tombstone values written by the docdb layer; storage doesn't interpret
+    values."""
+    entries: List[Tuple[bytes, bytes]] = field(default_factory=list)
+    # Raft op id (term, index) that produced this batch; becomes the
+    # flushed frontier when the memtable holding it is flushed.
+    op_id: Optional[Tuple[int, int]] = None
+
+    def put(self, key: bytes, value: bytes) -> "WriteBatch":
+        self.entries.append((key, value))
+        return self
+
+    def __len__(self):
+        return len(self.entries)
+
+
+class CompactionFeed:
+    """Streaming compaction hook (reference: rocksdb/compaction_filter.h
+    CompactionFeed + docdb/docdb_compaction_context.cc DocDBCompactionFeed).
+
+    Subclasses see the merged, sorted entry stream and decide what
+    survives into the output SST. `feed` returns entries to emit now;
+    `flush` emits any held-back tail. `feed_block` lets a vectorized/TPU
+    implementation process whole sorted runs at once.
+    """
+
+    def feed(self, key: bytes, value: bytes) -> List[Tuple[bytes, bytes]]:
+        return [(key, value)]
+
+    def flush(self) -> List[Tuple[bytes, bytes]]:
+        return []
+
+
+class LsmStore:
+    def __init__(self, directory: str, name: str = "db",
+                 columnar_builder=None, row_decoder=None):
+        self.dir = directory
+        self.name = name
+        self.columnar_builder = columnar_builder
+        self.row_decoder = row_decoder
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.RLock()
+        self._mem = MemTable()
+        self._frozen: List[MemTable] = []
+        self._ssts: List[SstReader] = []       # newest first
+        self._next_file = 0
+        self._flushed_frontier: dict = {}
+        self._mem_frontier: dict = {}
+        self._load_manifest()
+
+    # --- manifest ---------------------------------------------------------
+    @property
+    def _manifest_path(self) -> str:
+        return os.path.join(self.dir, f"{self.name}.MANIFEST")
+
+    def _load_manifest(self) -> None:
+        if not os.path.exists(self._manifest_path):
+            return
+        with open(self._manifest_path) as f:
+            m = json.load(f)
+        self._next_file = m["next_file"]
+        self._flushed_frontier = m.get("flushed_frontier", {})
+        for fname in m["ssts"]:
+            self._ssts.append(SstReader(os.path.join(self.dir, fname),
+                                        row_decoder=self.row_decoder))
+
+    def _write_manifest(self) -> None:
+        m = {
+            "next_file": self._next_file,
+            "flushed_frontier": self._flushed_frontier,
+            "ssts": [os.path.basename(r.path) for r in self._ssts],
+        }
+        tmp = self._manifest_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(m, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._manifest_path)
+
+    # --- writes -----------------------------------------------------------
+    def apply(self, batch: WriteBatch) -> None:
+        with self._lock:
+            for k, v in batch.entries:
+                self._mem.put(k, v)
+            if batch.op_id is not None:
+                self._mem_frontier["op_id"] = list(batch.op_id)
+
+    def should_flush(self) -> bool:
+        return (self._mem.approximate_bytes()
+                >= flags.get("memstore_flush_threshold_bytes"))
+
+    def flush(self) -> Optional[str]:
+        """Freeze the memtable and write it as an SST. Returns new SST path
+        (None if nothing to flush)."""
+        with self._lock:
+            if self._mem.empty():
+                return None
+            mem = self._mem
+            mem.freeze()
+            frontier = dict(self._mem_frontier)
+            self._frozen.append(mem)
+            self._mem = MemTable()
+            self._mem_frontier = {}
+        path = self._new_sst_path()
+        w = SstWriter(path, columnar_builder=self.columnar_builder)
+        for k, v in mem.iterate():
+            w.add(k, v)
+        w.set_frontier(**frontier)
+        w.finish()
+        with self._lock:
+            self._ssts.insert(0, SstReader(path, row_decoder=self.row_decoder))
+            self._frozen.remove(mem)
+            if "op_id" in frontier:
+                self._flushed_frontier["op_id"] = frontier["op_id"]
+            self._write_manifest()
+        return path
+
+    def ingest_sst(self, build: Callable[[SstWriter], None],
+                   frontier: Optional[dict] = None) -> str:
+        """Bulk load: caller fills a writer (rows or columnar blocks)."""
+        path = self._new_sst_path()
+        w = SstWriter(path, columnar_builder=self.columnar_builder)
+        build(w)
+        if frontier:
+            w.set_frontier(**frontier)
+        w.finish()
+        with self._lock:
+            self._ssts.insert(0, SstReader(path, row_decoder=self.row_decoder))
+            self._write_manifest()
+        return path
+
+    def _new_sst_path(self) -> str:
+        with self._lock:
+            n = self._next_file
+            self._next_file += 1
+        return os.path.join(self.dir, f"{self.name}.{n:06d}.sst")
+
+    # --- reads ------------------------------------------------------------
+    def iterate(self, lower: Optional[bytes] = None,
+                upper: Optional[bytes] = None) -> Iterator[Tuple[bytes, bytes]]:
+        """Merged view, ascending; newest source wins on exact-key ties."""
+        with self._lock:
+            sources = [self._mem.iterate(lower, upper)]
+            sources += [m.iterate(lower, upper) for m in reversed(self._frozen)]
+            sources += [r.iterate(lower, upper) for r in self._ssts]
+        return merging_iterator(sources)
+
+    def seek(self, key: bytes) -> Iterator[Tuple[bytes, bytes]]:
+        return self.iterate(lower=key)
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        """Exact-key point get."""
+        for k, v in self.iterate(lower=key):
+            return v if k == key else None
+        return None
+
+    @property
+    def ssts(self) -> List[SstReader]:
+        with self._lock:
+            return list(self._ssts)
+
+    def memtable_empty(self) -> bool:
+        return self._mem.empty() and not self._frozen
+
+    def flushed_frontier(self) -> dict:
+        return dict(self._flushed_frontier)
+
+    def approximate_size(self) -> int:
+        with self._lock:
+            return (sum(r.file_size for r in self._ssts)
+                    + self._mem.approximate_bytes())
+
+    # --- compaction -------------------------------------------------------
+    def pick_compaction(self, max_files: int = 8) -> List[SstReader]:
+        """Size-tiered pick: compact when >= 4 SSTs; choose the smallest
+        run of similar-size files (universal compaction analog)."""
+        with self._lock:
+            if len(self._ssts) < 4:
+                return []
+            by_size = sorted(self._ssts, key=lambda r: r.file_size)
+            return by_size[:max_files]
+
+    def compact(self, inputs: Optional[Sequence[SstReader]] = None,
+                feed: Optional[CompactionFeed] = None,
+                is_major: bool = False) -> Optional[str]:
+        """Merge `inputs` (default: all SSTs = major compaction) through the
+        feed into one output SST. The TPU path replaces this loop via
+        docdb/compaction (ops/compaction.py) and calls replace_ssts."""
+        with self._lock:
+            if inputs is None:
+                inputs = list(self._ssts)
+                is_major = True
+            inputs = list(inputs)
+        if not inputs:
+            return None
+        feed = feed or CompactionFeed()
+        path = self._new_sst_path()
+        w = SstWriter(path, columnar_builder=self.columnar_builder)
+        # merge newest-first sources; exact dup keys keep newest
+        merged = merging_iterator([r.iterate() for r in inputs])
+        for k, v in merged:
+            for ok, ov in feed.feed(k, v):
+                w.add(ok, ov)
+        for ok, ov in feed.flush():
+            w.add(ok, ov)
+        frontier = {}
+        for r in inputs:
+            if "op_id" in r.frontier:
+                op = r.frontier["op_id"]
+                if "op_id" not in frontier or op > frontier["op_id"]:
+                    frontier["op_id"] = op
+        w.set_frontier(**frontier)
+        w.finish()
+        self.replace_ssts(inputs, path)
+        return path
+
+    def replace_ssts(self, old: Sequence[SstReader], new_path: str) -> None:
+        with self._lock:
+            new_reader = SstReader(new_path, row_decoder=self.row_decoder)
+            old_set = {id(r) for r in old}
+            kept = [r for r in self._ssts if id(r) not in old_set]
+            # output is older than anything not in the inputs → append last
+            self._ssts = kept + [new_reader]
+            self._write_manifest()
+        for r in old:
+            try:
+                os.remove(r.path)
+            except OSError:
+                pass
+
+    # --- checkpoint -------------------------------------------------------
+    def checkpoint(self, out_dir: str) -> None:
+        """Hard-link all live SSTs + copy manifest (reference:
+        rocksdb Checkpoint::CreateCheckpoint via
+        tablet/tablet_snapshots.cc:273). Memtable contents are NOT
+        included — callers flush first for a point-in-time image."""
+        os.makedirs(out_dir, exist_ok=True)
+        with self._lock:
+            ssts = list(self._ssts)
+            for r in ssts:
+                dst = os.path.join(out_dir, os.path.basename(r.path))
+                if not os.path.exists(dst):
+                    os.link(r.path, dst)
+            m = {
+                "next_file": self._next_file,
+                "flushed_frontier": self._flushed_frontier,
+                "ssts": [os.path.basename(r.path) for r in ssts],
+            }
+        with open(os.path.join(out_dir, f"{self.name}.MANIFEST"), "w") as f:
+            json.dump(m, f)
+
+    @classmethod
+    def open_checkpoint(cls, directory: str, name: str = "db",
+                        **kw) -> "LsmStore":
+        return cls(directory, name, **kw)

@@ -1,0 +1,49 @@
+"""In-memory sorted write buffer.
+
+Analog of the reference's RocksDB memtable (reference:
+src/yb/rocksdb/memtable/ — skiplist-based). Keys are full encoded
+SubDocKeys (doc key + HT suffix), so all versions of a row are adjacent
+and newest sorts first; duplicate exact keys keep the latest insert.
+"""
+from __future__ import annotations
+
+from typing import Iterator, Optional, Tuple
+
+from sortedcontainers import SortedDict
+
+
+class MemTable:
+    def __init__(self):
+        self._map: SortedDict = SortedDict()
+        self._bytes = 0
+        self.frozen = False
+
+    def put(self, key: bytes, value: bytes) -> None:
+        assert not self.frozen
+        old = self._map.get(key)
+        if old is not None:
+            self._bytes -= len(key) + len(old)
+        self._map[key] = value
+        self._bytes += len(key) + len(value)
+
+    def approximate_bytes(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def empty(self) -> bool:
+        return not self._map
+
+    def freeze(self) -> None:
+        self.frozen = True
+
+    def iterate(self, lower: Optional[bytes] = None,
+                upper: Optional[bytes] = None
+                ) -> Iterator[Tuple[bytes, bytes]]:
+        """Entries with lower <= key < upper, ascending."""
+        for k in self._map.irange(lower, upper, inclusive=(True, False)):
+            yield k, self._map[k]
+
+    def seek(self, key: bytes) -> Iterator[Tuple[bytes, bytes]]:
+        return self.iterate(lower=key)
