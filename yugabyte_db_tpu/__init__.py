@@ -36,3 +36,10 @@ Package layout:
 """
 
 __version__ = "0.1.0"
+
+# Hybrid times and key hashes are 64-bit; JAX must carry u64 end-to-end.
+# (TPU emulates 64-bit integer ops; the scan kernels only use them for
+# visibility compares, which are negligible next to the f32 aggregate work.)
+import jax as _jax  # noqa: E402
+
+_jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,3 @@
+from .expr import Expr, col, const, compile_expr  # noqa: F401
+from .device_batch import DeviceBatch, DeviceBlockCache  # noqa: F401
+from .scan import ScanKernel, AggSpec, scan_aggregate, scan_filter  # noqa: F401

@@ -1,0 +1,171 @@
+"""Host→device batch formation and the device-resident block cache.
+
+The TPU-native replacement for the reference's block cache (reference:
+src/yb/rocksdb/util/cache.cc + table block cache): hot tablet blocks
+live in HBM as decoded columnar arrays, so steady-state scans never
+touch the host. Batches are padded to power-of-two row buckets so the
+jitted scan kernels compile once per bucket instead of once per block
+size (recompilation churn — SURVEY.md hard part #7).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..storage.columnar import ColumnarBlock
+
+_BUCKETS = [1 << b for b in range(12, 24)]  # 4096 .. 8M rows
+
+
+def bucket_rows(n: int) -> int:
+    for b in _BUCKETS:
+        if n <= b:
+            return b
+    return _BUCKETS[-1]
+
+
+@dataclass
+class DeviceBatch:
+    """Padded columnar batch on device.
+
+    cols / nulls: col_id -> [N] arrays (nulls True where SQL NULL).
+    valid: [N] bool — False on padding rows and MVCC-invisible rows.
+    """
+
+    n_rows: int                      # true (unpadded) row count
+    cols: Dict[int, jnp.ndarray]
+    nulls: Dict[int, jnp.ndarray]
+    valid: jnp.ndarray
+    key_hash: Optional[jnp.ndarray] = None
+    ht: Optional[jnp.ndarray] = None
+    write_id: Optional[jnp.ndarray] = None
+    tombstone: Optional[jnp.ndarray] = None
+    unique_keys: bool = True
+
+    @property
+    def padded_rows(self) -> int:
+        return int(self.valid.shape[0])
+
+
+_F64_BLOCKLIST = ()
+
+
+def _to_device_dtype(arr: np.ndarray) -> np.ndarray:
+    # Scans compute in f32/bf16 on TPU (MXU-friendly); f64 columns are
+    # converted at batch-formation time. Aggregation error is controlled by
+    # pairwise/psum trees and (for SUM) a compensated two-pass option in
+    # the kernel, not by keeping f64 on device.
+    if arr.dtype == np.float64:
+        return arr.astype(np.float32)
+    if arr.dtype == np.int64:
+        # int64 is supported but slow on TPU; keep when values may exceed
+        # int32 (we can't know → keep int32 only when safe)
+        return arr
+    return arr
+
+
+def build_batch(blocks: Sequence[ColumnarBlock],
+                columns: Sequence[int],
+                with_mvcc: bool = True,
+                pad_to: Optional[int] = None) -> DeviceBatch:
+    """Concatenate columnar blocks and ship the requested columns to
+    device, padded to a row bucket."""
+    n = sum(b.n for b in blocks)
+    padded = pad_to or bucket_rows(max(n, 1))
+    cols: Dict[int, jnp.ndarray] = {}
+    nulls: Dict[int, jnp.ndarray] = {}
+    for cid in columns:
+        parts, nparts = [], []
+        for b in blocks:
+            if cid in b.fixed:
+                v, m = b.fixed[cid]
+                parts.append(v)
+                nparts.append(m)
+            elif cid in b.pk:
+                parts.append(b.pk[cid])
+                nparts.append(np.zeros(b.n, bool))
+            else:
+                raise KeyError(
+                    f"column {cid} not available in columnar form")
+        arr = _to_device_dtype(np.concatenate(parts))
+        null = np.concatenate(nparts)
+        cols[cid] = jnp.asarray(_pad(arr, padded))
+        nulls[cid] = jnp.asarray(_pad(null, padded))
+    valid = np.zeros(padded, bool)
+    valid[:n] = True
+    batch = DeviceBatch(
+        n_rows=n, cols=cols, nulls=nulls, valid=jnp.asarray(valid),
+        unique_keys=all(b.unique_keys for b in blocks))
+    if with_mvcc:
+        batch.key_hash = jnp.asarray(_pad(
+            np.concatenate([b.key_hash for b in blocks]), padded))
+        batch.ht = jnp.asarray(_pad(
+            np.concatenate([b.ht for b in blocks]), padded))
+        batch.write_id = jnp.asarray(_pad(
+            np.concatenate([b.write_id for b in blocks]), padded))
+        tomb = np.concatenate([b.tombstone for b in blocks])
+        batch.tombstone = jnp.asarray(_pad(tomb, padded))
+    return batch
+
+
+def _pad(arr: np.ndarray, n: int) -> np.ndarray:
+    if len(arr) == n:
+        return arr
+    out = np.zeros((n,) + arr.shape[1:], arr.dtype)
+    out[:len(arr)] = arr
+    return out
+
+
+class DeviceBlockCache:
+    """LRU cache of device-resident DeviceBatches keyed by
+    (sst_path, block_range, column-set). Eviction by padded byte size."""
+
+    def __init__(self, capacity_bytes: int = 2 << 30):
+        self.capacity = capacity_bytes
+        self._map: OrderedDict[tuple, Tuple[DeviceBatch, int]] = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    def get_or_build(self, key: tuple, builder) -> DeviceBatch:
+        if key in self._map:
+            self.hits += 1
+            self._map.move_to_end(key)
+            return self._map[key][0]
+        self.misses += 1
+        batch = builder()
+        size = _batch_bytes(batch)
+        self._map[key] = (batch, size)
+        self._bytes += size
+        while self._bytes > self.capacity and len(self._map) > 1:
+            _, (old, osize) = self._map.popitem(last=False)
+            self._bytes -= osize
+            del old
+        return batch
+
+    def invalidate_prefix(self, prefix: tuple) -> None:
+        """Drop entries whose key starts with prefix (e.g. an SST was
+        compacted away)."""
+        drop = [k for k in self._map if k[:len(prefix)] == prefix]
+        for k in drop:
+            _, size = self._map.pop(k)
+            self._bytes -= size
+
+    def clear(self):
+        self._map.clear()
+        self._bytes = 0
+
+
+def _batch_bytes(b: DeviceBatch) -> int:
+    total = b.valid.size * 1
+    for a in list(b.cols.values()) + list(b.nulls.values()):
+        total += a.size * a.dtype.itemsize
+    for a in (b.key_hash, b.ht, b.write_id, b.tombstone):
+        if a is not None:
+            total += a.size * a.dtype.itemsize
+    return total

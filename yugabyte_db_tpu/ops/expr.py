@@ -1,0 +1,247 @@
+"""Pushdown expression ASTs compiled to JAX.
+
+The reference evaluates pushed-down expressions row-at-a-time inside
+DocDB by calling into a stripped PostgreSQL executor ("ybgate",
+reference: src/yb/docdb/doc_pg_expr.cc, ybgate_api.h:178) or the QL
+builtin interpreter (src/yb/qlexpr/ql_expr.h). Here the expression tree
+crosses the wire as a small serializable AST and compiles ONCE per
+(schema, expr-shape) into a jitted columnar function — evaluation is
+whole-column, fused by XLA into the surrounding scan kernel.
+
+Null semantics are SQL three-valued logic: every node evaluates to
+(value, is_null); comparisons/arithmetic propagate null, and a WHERE
+clause keeps rows only when value AND NOT is_null.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+
+ExprNode = Union[tuple, list]
+
+
+# --- AST constructors (tuples so they're trivially wire-serializable) -----
+def col(col_id: int) -> tuple:
+    return ("col", col_id)
+
+
+def const(v) -> tuple:
+    return ("const", v)
+
+
+class Expr:
+    """Fluent wrapper for building AST tuples in Python code."""
+
+    def __init__(self, node: ExprNode):
+        self.node = node
+
+    @staticmethod
+    def col(cid: int) -> "Expr":
+        return Expr(col(cid))
+
+    @staticmethod
+    def const(v) -> "Expr":
+        return Expr(const(v))
+
+    def _wrap(self, other) -> ExprNode:
+        return other.node if isinstance(other, Expr) else const(other)
+
+    def __lt__(self, o): return Expr(("cmp", "lt", self.node, self._wrap(o)))
+    def __le__(self, o): return Expr(("cmp", "le", self.node, self._wrap(o)))
+    def __gt__(self, o): return Expr(("cmp", "gt", self.node, self._wrap(o)))
+    def __ge__(self, o): return Expr(("cmp", "ge", self.node, self._wrap(o)))
+    def eq(self, o): return Expr(("cmp", "eq", self.node, self._wrap(o)))
+    def ne(self, o): return Expr(("cmp", "ne", self.node, self._wrap(o)))
+    def __add__(self, o): return Expr(("arith", "add", self.node, self._wrap(o)))
+    def __sub__(self, o): return Expr(("arith", "sub", self.node, self._wrap(o)))
+    def __mul__(self, o): return Expr(("arith", "mul", self.node, self._wrap(o)))
+    def __truediv__(self, o): return Expr(("arith", "div", self.node, self._wrap(o)))
+    def __and__(self, o): return Expr(("and", self.node, self._wrap(o)))
+    def __or__(self, o): return Expr(("or", self.node, self._wrap(o)))
+    def __invert__(self): return Expr(("not", self.node))
+    def between(self, lo, hi):
+        return Expr(("between", self.node, self._wrap(lo), self._wrap(hi)))
+    def isin(self, vals: Sequence):
+        return Expr(("in", self.node, list(vals)))
+    def is_null(self): return Expr(("isnull", self.node))
+
+
+def expr_signature(node: ExprNode) -> tuple:
+    """Hashable structural signature: constants folded to their VALUES are
+    part of the signature only when they change kernel shape (IN-list
+    length); scalar constants are passed as traced args so changing a
+    literal does NOT recompile (reference analog: prepared statements
+    re-binding params)."""
+    kind = node[0]
+    if kind == "const":
+        return ("const",)
+    if kind == "col":
+        return ("col", node[1])
+    if kind == "in":
+        return ("in", expr_signature(node[1]), len(node[2]))
+    return (kind,) + tuple(
+        expr_signature(c) if isinstance(c, (tuple, list)) else c
+        for c in node[1:])
+
+
+def collect_constants(node: ExprNode, out: list) -> None:
+    kind = node[0]
+    if kind == "const":
+        out.append(node[1])
+        return
+    if kind == "in":
+        collect_constants(node[1], out)
+        out.extend(node[2])
+        return
+    for c in node[1:]:
+        if isinstance(c, (tuple, list)) and c and isinstance(c[0], str):
+            collect_constants(c, out)
+
+
+_CMP = {
+    "lt": jnp.less, "le": jnp.less_equal, "gt": jnp.greater,
+    "ge": jnp.greater_equal, "eq": jnp.equal, "ne": jnp.not_equal,
+}
+_ARITH = {
+    "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+    "div": jnp.divide,
+}
+
+
+def compile_expr(node: ExprNode) -> Callable:
+    """Compile an AST into fn(cols, nulls, consts) -> (values, is_null).
+
+    cols/nulls: dict col_id -> [N] arrays. consts: flat list of scalar
+    jnp values in collect_constants order (so literals are runtime args,
+    not baked into the compiled kernel).
+    """
+    counter = [0]
+
+    def build(n: ExprNode) -> Callable:
+        kind = n[0]
+        if kind == "col":
+            cid = n[1]
+            return lambda cols, nulls, consts: (cols[cid], nulls[cid])
+        if kind == "const":
+            idx = counter[0]
+            counter[0] += 1
+            return lambda cols, nulls, consts: (consts[idx], None)
+        if kind == "cmp":
+            op = _CMP[n[1]]
+            lf, rf = build(n[2]), build(n[3])
+            def f(cols, nulls, consts):
+                lv, ln = lf(cols, nulls, consts)
+                rv, rn = rf(cols, nulls, consts)
+                return op(lv, rv), _or_null(ln, rn)
+            return f
+        if kind == "arith":
+            op = _ARITH[n[1]]
+            lf, rf = build(n[2]), build(n[3])
+            def f(cols, nulls, consts):
+                lv, ln = lf(cols, nulls, consts)
+                rv, rn = rf(cols, nulls, consts)
+                return op(lv, rv), _or_null(ln, rn)
+            return f
+        if kind == "and":
+            lf, rf = build(n[1]), build(n[2])
+            def f(cols, nulls, consts):
+                lv, ln = lf(cols, nulls, consts)
+                rv, rn = rf(cols, nulls, consts)
+                # SQL: FALSE AND NULL = FALSE; TRUE AND NULL = NULL
+                val = jnp.logical_and(lv, rv)
+                null = _and3_null(lv, ln, rv, rn)
+                return val, null
+            return f
+        if kind == "or":
+            lf, rf = build(n[1]), build(n[2])
+            def f(cols, nulls, consts):
+                lv, ln = lf(cols, nulls, consts)
+                rv, rn = rf(cols, nulls, consts)
+                val = jnp.logical_or(lv, rv)
+                null = _or3_null(lv, ln, rv, rn)
+                return val, null
+            return f
+        if kind == "not":
+            xf = build(n[1])
+            def f(cols, nulls, consts):
+                v, nn = xf(cols, nulls, consts)
+                return jnp.logical_not(v), nn
+            return f
+        if kind == "between":
+            xf, lof, hif = build(n[1]), build(n[2]), build(n[3])
+            def f(cols, nulls, consts):
+                xv, xn = xf(cols, nulls, consts)
+                lov, lon = lof(cols, nulls, consts)
+                hiv, hin = hif(cols, nulls, consts)
+                v = jnp.logical_and(xv >= lov, xv <= hiv)
+                return v, _or_null(_or_null(xn, lon), hin)
+            return f
+        if kind == "in":
+            xf = build(n[1])
+            k = len(n[2])
+            idx0 = counter[0]
+            counter[0] += k
+            def f(cols, nulls, consts):
+                xv, xn = xf(cols, nulls, consts)
+                acc = jnp.zeros_like(xv, dtype=bool)
+                for i in range(k):
+                    acc = jnp.logical_or(acc, xv == consts[idx0 + i])
+                return acc, xn
+            return f
+        if kind == "isnull":
+            xf = build(n[1])
+            def f(cols, nulls, consts):
+                _, xn = xf(cols, nulls, consts)
+                n_ = xn if xn is not None else jnp.zeros((), bool)
+                return n_, None
+            return f
+        raise ValueError(f"unknown expr node {kind}")
+
+    return build(node)
+
+
+def _or_null(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return jnp.logical_or(a, b)
+
+
+def _and3_null(lv, ln, rv, rn):
+    # NULL unless one side is definitively FALSE
+    ln_ = ln if ln is not None else False
+    rn_ = rn if rn is not None else False
+    l_false = jnp.logical_and(jnp.logical_not(lv), jnp.logical_not(ln_) if ln is not None else True)
+    r_false = jnp.logical_and(jnp.logical_not(rv), jnp.logical_not(rn_) if rn is not None else True)
+    any_null = _or_null(ln, rn)
+    if any_null is None:
+        return None
+    return jnp.logical_and(any_null,
+                           jnp.logical_not(jnp.logical_or(l_false, r_false)))
+
+
+def _or3_null(lv, ln, rv, rn):
+    # NULL unless one side is definitively TRUE
+    l_true = jnp.logical_and(lv, jnp.logical_not(ln) if ln is not None else True)
+    r_true = jnp.logical_and(rv, jnp.logical_not(rn) if rn is not None else True)
+    any_null = _or_null(ln, rn)
+    if any_null is None:
+        return None
+    return jnp.logical_and(any_null,
+                           jnp.logical_not(jnp.logical_or(l_true, r_true)))
+
+
+def referenced_columns(node: ExprNode, out: set | None = None) -> set:
+    out = out if out is not None else set()
+    if node[0] == "col":
+        out.add(node[1])
+    elif node[0] == "in":
+        referenced_columns(node[1], out)
+    else:
+        for c in node[1:]:
+            if isinstance(c, (tuple, list)) and c and isinstance(c[0], str):
+                referenced_columns(c, out)
+    return out

@@ -1,0 +1,265 @@
+"""The TPU scan/filter/aggregate kernel — THE hot path.
+
+Replaces the reference's row-at-a-time scan loop
+(reference: src/yb/docdb/pgsql_operation.cc:2790-2877 ExecuteScalar,
+EvalAggregate :3153, PopulateAggregate :3163) with whole-batch columnar
+kernels:
+
+- WHERE predicates compile via ops/expr.py and fuse with the masked
+  aggregates into one XLA program (VPU elementwise + MXU matmul for
+  grouped aggregation via one-hot matrices).
+- MVCC visibility (hybrid-time <= read point, tombstones) is a vector
+  mask; when a batch may contain multiple versions of a key, the newest
+  visible version is selected with a device sort over (key_hash, ~ht) —
+  the same job IntentAwareIterator+DocRowwiseIterator do with seeks
+  (reference: src/yb/docdb/doc_rowwise_iterator.cc:687).
+- Kernels are cached by structural signature (expr shape, agg list,
+  group spec, padded size, dtypes) — literals are runtime arguments, so
+  re-running with different constants does NOT recompile (the
+  schema-version-keyed kernel cache SURVEY.md §7 calls for).
+
+Aggregate partials come back in combinable form (sum/count/min/max) so
+the parallel layer can `lax.psum` them across a tablet mesh axis.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .device_batch import DeviceBatch
+from .expr import collect_constants, compile_expr, expr_signature
+
+_UINT64_MAX = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    """One aggregate target: op in sum|count|min|max|avg; expr None means
+    COUNT(*)."""
+    op: str
+    expr: Optional[tuple] = None
+
+    def signature(self) -> tuple:
+        return (self.op, expr_signature(self.expr) if self.expr else None)
+
+
+@dataclass(frozen=True)
+class GroupSpec:
+    """GROUP BY over small-domain columns (dictionary/categorical encoded):
+    cols = ((col_id, domain_size, offset), ...). Group id =
+    sum((col - offset) * stride); total groups = prod(domains).
+    Large/unbounded domains go through the CPU fallback path."""
+    cols: Tuple[Tuple[int, int, int], ...]
+
+    @property
+    def num_groups(self) -> int:
+        g = 1
+        for _, d, _ in self.cols:
+            g *= d
+        return g
+
+
+def _mvcc_visible_latest(key_hash, ht, write_id, tombstone, valid, read_ht):
+    """Mask of rows that are the newest visible, non-tombstone version of
+    their key at read_ht. Device equivalent of the MVCC seek dance."""
+    n = key_hash.shape[0]
+    visible = jnp.logical_and(valid, ht <= read_ht)
+    # sort so that per key: visible-newest first
+    sort_kh = jnp.where(valid, key_hash, _UINT64_MAX)
+    inv_vis = jnp.logical_not(visible).astype(jnp.uint8)
+    inv_ht = _UINT64_MAX - ht
+    inv_wid = jnp.uint32(0xFFFFFFFF) - write_id
+    idx = jnp.arange(n, dtype=jnp.int32)
+    s_kh, _, s_ht, s_wid, s_idx = jax.lax.sort(
+        (sort_kh, inv_vis, inv_ht, inv_wid, idx), num_keys=4)
+    first = jnp.concatenate([jnp.array([True]), s_kh[1:] != s_kh[:-1]])
+    vis_sorted = visible[s_idx]
+    tomb_sorted = tombstone[s_idx]
+    sel_sorted = first & vis_sorted & jnp.logical_not(tomb_sorted)
+    out = jnp.zeros(n, bool).at[s_idx].set(sel_sorted)
+    return out
+
+
+def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
+                  group: Optional[GroupSpec], mvcc_mode: str):
+    """mvcc_mode: 'none' (valid only), 'visible' (ht filter, unique keys),
+    'dedup' (full newest-visible-version selection)."""
+    where_fn = compile_expr(where_node) if where_node is not None else None
+    agg_fns = [(a.op, compile_expr(a.expr) if a.expr is not None else None)
+               for a in agg_specs]
+
+    def fn(cols, nulls, consts, valid, key_hash, ht, write_id, tombstone,
+           read_ht):
+        if mvcc_mode == "none":
+            mask = valid
+        elif mvcc_mode == "visible":
+            mask = valid & (ht <= read_ht) & jnp.logical_not(tombstone)
+        else:
+            mask = _mvcc_visible_latest(key_hash, ht, write_id, tombstone,
+                                        valid, read_ht)
+        if where_fn is not None:
+            wv, wn = where_fn(cols, nulls, consts)
+            mask = mask & wv
+            if wn is not None:
+                mask = mask & jnp.logical_not(wn)
+
+        if group is None:
+            out = []
+            for op, f in agg_fns:
+                if f is None:
+                    out.append(jnp.sum(mask, dtype=jnp.int64))
+                    continue
+                v, vn = f(cols, nulls, consts)
+                m = mask if vn is None else mask & jnp.logical_not(vn)
+                if op == "count":
+                    out.append(jnp.sum(m, dtype=jnp.int64))
+                elif op == "sum":
+                    out.append(jnp.sum(jnp.where(m, v, 0)))
+                elif op == "min":
+                    out.append(jnp.min(jnp.where(m, v, _type_max(v))))
+                elif op == "max":
+                    out.append(jnp.max(jnp.where(m, v, _type_min(v))))
+                else:
+                    raise ValueError(op)
+            return tuple(out), jnp.sum(mask, dtype=jnp.int64), mask
+
+        # grouped: one-hot [N, G] matmul — rides the MXU
+        gid = None
+        stride = 1
+        for cid, domain, offset in group.cols:
+            c = cols[cid].astype(jnp.int32) - offset
+            c = jnp.clip(c, 0, domain - 1)
+            gid = c * stride if gid is None else gid + c * stride
+            stride *= domain
+        G = group.num_groups
+        onehot = jax.nn.one_hot(gid, G, dtype=jnp.float32)
+        onehot = onehot * mask.astype(jnp.float32)[:, None]
+        out = []
+        for op, f in agg_fns:
+            if f is None:
+                out.append(jnp.sum(onehot, axis=0).astype(jnp.int64))
+                continue
+            v, vn = f(cols, nulls, consts)
+            m = mask if vn is None else mask & jnp.logical_not(vn)
+            oh = (onehot if vn is None
+                  else onehot * jnp.logical_not(vn).astype(jnp.float32)[:, None])
+            if op == "count":
+                out.append(jnp.sum(oh, axis=0).astype(jnp.int64))
+            elif op == "sum":
+                out.append(v.astype(jnp.float32) @ oh)
+            elif op == "min":
+                gmask = (oh > 0)
+                big = _type_max(v)
+                out.append(jnp.min(
+                    jnp.where(gmask, v[:, None], big), axis=0))
+            elif op == "max":
+                small = _type_min(v)
+                gmask = (oh > 0)
+                out.append(jnp.max(
+                    jnp.where(gmask, v[:, None], small), axis=0))
+            else:
+                raise ValueError(op)
+        group_counts = jnp.sum(onehot, axis=0).astype(jnp.int64)
+        return tuple(out), group_counts, mask
+
+    return fn
+
+
+def _type_max(v):
+    if jnp.issubdtype(v.dtype, jnp.integer):
+        return jnp.iinfo(v.dtype).max
+    return jnp.inf
+
+
+def _type_min(v):
+    if jnp.issubdtype(v.dtype, jnp.integer):
+        return jnp.iinfo(v.dtype).min
+    return -jnp.inf
+
+
+class ScanKernel:
+    """Signature-keyed cache of jitted scan kernels."""
+
+    def __init__(self):
+        self._cache: Dict[tuple, object] = {}
+        self.compiles = 0
+
+    def _get(self, sig, where_node, aggs, group, mvcc_mode, donate=False):
+        fn = self._cache.get(sig)
+        if fn is None:
+            raw = _build_kernel(where_node, aggs, group, mvcc_mode)
+            fn = jax.jit(raw)
+            self._cache[sig] = fn
+            self.compiles += 1
+        return fn
+
+    def run(self, batch: DeviceBatch,
+            where: Optional[tuple] = None,
+            aggs: Sequence[AggSpec] = (),
+            group: Optional[GroupSpec] = None,
+            read_ht: Optional[int] = None):
+        """Returns (agg_results tuple, count_or_group_counts, mask)."""
+        aggs = tuple(_expand_avg(aggs))
+        if read_ht is None:
+            mvcc_mode = "none"
+        elif batch.unique_keys:
+            mvcc_mode = "visible"
+        else:
+            mvcc_mode = "dedup"
+        consts: List = []
+        if where is not None:
+            collect_constants(where, consts)
+        for a in aggs:
+            if a.expr is not None:
+                collect_constants(a.expr, consts)
+        col_sig = tuple(sorted(
+            (cid, str(v.dtype)) for cid, v in batch.cols.items()))
+        sig = (
+            expr_signature(where) if where is not None else None,
+            tuple(a.signature() for a in aggs),
+            group.cols if group else None,
+            mvcc_mode, batch.padded_rows, col_sig,
+        )
+        fn = self._get(sig, where, aggs, group, mvcc_mode)
+        zeros_u64 = jnp.zeros(batch.padded_rows, jnp.uint64)
+        zeros_u32 = jnp.zeros(batch.padded_rows, jnp.uint32)
+        zeros_b = jnp.zeros(batch.padded_rows, bool)
+        return fn(
+            batch.cols, batch.nulls,
+            [jnp.asarray(c) for c in consts], batch.valid,
+            batch.key_hash if batch.key_hash is not None else zeros_u64,
+            batch.ht if batch.ht is not None else zeros_u64,
+            batch.write_id if batch.write_id is not None else zeros_u32,
+            batch.tombstone if batch.tombstone is not None else zeros_b,
+            jnp.uint64(read_ht if read_ht is not None else 0xFFFFFFFFFFFFFFFF),
+        )
+
+
+def _expand_avg(aggs: Sequence[AggSpec]) -> List[AggSpec]:
+    """AVG(e) -> SUM(e), COUNT(e); recombined by the caller/result layer."""
+    out = []
+    for a in aggs:
+        if a.op == "avg":
+            out.append(AggSpec("sum", a.expr))
+            out.append(AggSpec("count", a.expr))
+        else:
+            out.append(a)
+    return out
+
+
+_DEFAULT_KERNEL = ScanKernel()
+
+
+def scan_aggregate(batch: DeviceBatch, where=None, aggs=(), group=None,
+                   read_ht=None):
+    return _DEFAULT_KERNEL.run(batch, where, aggs, group, read_ht)
+
+
+def scan_filter(batch: DeviceBatch, where=None, read_ht=None):
+    """Filter-only scan: returns (mask ndarray, match_count)."""
+    _, count, mask = _DEFAULT_KERNEL.run(batch, where, (), None, read_ht)
+    return mask, count
