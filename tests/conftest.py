@@ -1,11 +1,19 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh so
 multi-chip sharding paths are exercised without TPU hardware (the driver
-separately dry-runs them; see __graft_entry__.dryrun_multichip)."""
+separately dry-runs them; see __graft_entry__.dryrun_multichip).
+
+Note: the environment presets JAX_PLATFORMS to the TPU tunnel platform,
+so we must override via jax.config (env setdefault is not enough), and
+it must happen before any backend initialization.
+"""
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,110 @@
+"""Distributed scan / sharded vector search over the virtual 8-device CPU
+mesh (the MiniCluster analog for the TPU data plane — reference tests run
+real multi-node stacks in-process, src/yb/integration-tests/mini_cluster.h)."""
+import jax
+import numpy as np
+import pytest
+
+from yugabyte_db_tpu.ops import AggSpec, Expr
+from yugabyte_db_tpu.ops.scan import GroupSpec
+from yugabyte_db_tpu.parallel import tablet_mesh, sharded_exact_search
+from yugabyte_db_tpu.parallel.distributed_scan import (
+    build_sharded_batch, distributed_scan_aggregate, DistributedScanKernel,
+)
+from yugabyte_db_tpu.storage.columnar import ColumnarBlock
+
+C = Expr.col
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs 8 virtual devices")
+
+
+def shard_block(n, seed, uniq=True):
+    rng = np.random.default_rng(seed)
+    qty = rng.uniform(0, 50, n)
+    flag = rng.integers(0, 4, n).astype(np.int32)
+    return ColumnarBlock.from_arrays(
+        schema_version=1,
+        key_hash=rng.integers(0, 2**63, n).astype(np.uint64),
+        ht=np.full(n, 10, np.uint64),
+        fixed={1: (qty, np.zeros(n, bool)),
+               4: (flag, np.zeros(n, bool))},
+        unique_keys=uniq), qty, flag
+
+
+class TestDistributedScan:
+    def test_psum_sum_count_8_tablets(self):
+        tm = tablet_mesh(num_tablet_shards=8)
+        blocks, all_qty = [], []
+        for s in range(8):
+            blk, qty, _ = shard_block(500 + 13 * s, seed=s)
+            blocks.append([blk])
+            all_qty.append(qty)
+        batch = build_sharded_batch(tm, blocks, [1])
+        (s_, c_), cnt = distributed_scan_aggregate(
+            batch, (C(1) < 25.0).node,
+            (AggSpec("sum", C(1).node), AggSpec("count")))
+        cat = np.concatenate(all_qty)
+        m = cat < 25.0
+        np.testing.assert_allclose(float(s_), cat[m].sum(), rtol=1e-4)
+        assert int(c_) == m.sum() == int(cnt)
+
+    def test_min_max_combine(self):
+        tm = tablet_mesh(num_tablet_shards=8)
+        blocks, all_qty = [], []
+        for s in range(8):
+            blk, qty, _ = shard_block(100, seed=100 + s)
+            blocks.append([blk])
+            all_qty.append(qty)
+        batch = build_sharded_batch(tm, blocks, [1])
+        (mn, mx), _ = distributed_scan_aggregate(
+            batch, None, (AggSpec("min", C(1).node), AggSpec("max", C(1).node)))
+        cat = np.concatenate(all_qty)
+        np.testing.assert_allclose(float(mn), cat.min(), rtol=1e-6)
+        np.testing.assert_allclose(float(mx), cat.max(), rtol=1e-6)
+
+    def test_grouped_2d_mesh(self):
+        """4 tablet shards x 2 block shards (dp x sp) — Q1-style grouped
+        aggregate combined across both axes."""
+        tm = tablet_mesh(num_tablet_shards=4, num_block_shards=2)
+        blocks, qs, fs = [], [], []
+        for s in range(8):
+            blk, qty, flag = shard_block(300, seed=200 + s)
+            blocks.append([blk])
+            qs.append(qty)
+            fs.append(flag)
+        batch = build_sharded_batch(tm, blocks, [1, 4])
+        (sums, counts), _ = distributed_scan_aggregate(
+            batch, None,
+            (AggSpec("sum", C(1).node), AggSpec("count")),
+            group=GroupSpec(cols=((4, 4, 0),)))
+        qcat, fcat = np.concatenate(qs), np.concatenate(fs)
+        for g in range(4):
+            m = fcat == g
+            np.testing.assert_allclose(np.asarray(sums)[g], qcat[m].sum(),
+                                       rtol=1e-4)
+            assert int(np.asarray(counts)[g]) == m.sum()
+
+    def test_kernel_cached_across_runs(self):
+        tm = tablet_mesh(num_tablet_shards=8)
+        kern = DistributedScanKernel()
+        for trial in range(3):
+            blocks = [[shard_block(64, seed=300 + trial * 8 + s)[0]]
+                      for s in range(8)]
+            batch = build_sharded_batch(tm, blocks, [1])
+            kern.run(batch, (C(1) < float(trial)).node, (AggSpec("count"),))
+        assert kern.compiles == 1
+
+
+class TestShardedVector:
+    def test_global_topk_matches_local(self):
+        tm = tablet_mesh(num_tablet_shards=4, num_block_shards=2)
+        rng = np.random.default_rng(5)
+        base = rng.normal(size=(8 * 64, 16)).astype(np.float32)
+        q = base[[3, 200, 500]] + 0.001
+        d, idx = sharded_exact_search(
+            tm, q, np.asarray(base).reshape(8, 64, 16), k=4)
+        assert idx[0, 0] == 3 and idx[1, 0] == 200 and idx[2, 0] == 500
+        ref = ((q[:, None, :] - base[None, :, :]) ** 2).sum(-1)
+        np.testing.assert_allclose(np.sort(d, axis=1)[:, 0],
+                                   ref.min(axis=1), atol=1e-1)

@@ -1,0 +1,154 @@
+"""Vector index kernels: distance matmuls, top-k, k-means / IVF-flat.
+
+TPU-native replacement for the reference's ANN backends (reference:
+src/yb/vector_index/vector_lsm.cc, src/yb/hnsw/hnsw.cc, usearch/hnswlib
+wrappers in src/yb/ann_methods/). Graph-walk ANN (HNSW) is a poor fit
+for the MXU; the TPU-idiomatic method is IVF-flat: k-means clustering
+(pure matmuls) + probed exhaustive search (one [Q,D]x[D,N] matmul per
+probe set), in bf16 with f32 accumulation. Exact search over 1M x 768
+is a single big matmul — often faster end-to-end than HNSW on CPU.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def l2_distance2(queries: jnp.ndarray, base: jnp.ndarray) -> jnp.ndarray:
+    """Squared L2 distances [Q, N] = |q|^2 + |b|^2 - 2 q.b (MXU matmul)."""
+    q = queries.astype(jnp.bfloat16)
+    b = base.astype(jnp.bfloat16)
+    dots = jax.lax.dot_general(
+        q, b, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    qn = jnp.sum(queries.astype(jnp.float32) ** 2, axis=1, keepdims=True)
+    bn = jnp.sum(base.astype(jnp.float32) ** 2, axis=1)
+    # bf16 dot rounding can push tiny distances below zero; clamp
+    return jnp.maximum(qn + bn[None, :] - 2.0 * dots, 0.0)
+
+
+@jax.jit
+def inner_product(queries: jnp.ndarray, base: jnp.ndarray) -> jnp.ndarray:
+    return jax.lax.dot_general(
+        queries.astype(jnp.bfloat16), base.astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+
+
+@jax.jit
+def cosine_distance(queries: jnp.ndarray, base: jnp.ndarray) -> jnp.ndarray:
+    qn = queries / (jnp.linalg.norm(queries, axis=1, keepdims=True) + 1e-12)
+    bn = base / (jnp.linalg.norm(base, axis=1, keepdims=True) + 1e-12)
+    return 1.0 - inner_product(qn, bn)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def exact_search(queries: jnp.ndarray, base: jnp.ndarray, k: int
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Brute-force k-NN: (distances [Q,k], indices [Q,k])."""
+    d = l2_distance2(queries, base)
+    neg, idx = jax.lax.top_k(-d, k)
+    return -neg, idx
+
+
+@partial(jax.jit, static_argnames=("iters",))
+def _kmeans_iters(data: jnp.ndarray, centroids: jnp.ndarray, iters: int):
+    def body(_, cent):
+        d = l2_distance2(data, cent)              # [N, K]
+        assign = jnp.argmin(d, axis=1)
+        onehot = jax.nn.one_hot(assign, cent.shape[0], dtype=jnp.float32)
+        sums = onehot.T @ data.astype(jnp.float32)   # [K, D] — MXU
+        counts = jnp.sum(onehot, axis=0)[:, None]
+        new = jnp.where(counts > 0, sums / jnp.maximum(counts, 1), cent)
+        return new
+    return jax.lax.fori_loop(0, iters, body, centroids)
+
+
+def kmeans(data: np.ndarray, k: int, iters: int = 10,
+           seed: int = 0) -> np.ndarray:
+    """Lloyd's k-means on device; returns [k, D] centroids."""
+    rng = np.random.default_rng(seed)
+    init = data[rng.choice(len(data), size=k, replace=False)]
+    out = _kmeans_iters(jnp.asarray(data, jnp.float32),
+                        jnp.asarray(init, jnp.float32), iters)
+    return np.asarray(out)
+
+
+class IvfFlatIndex:
+    """IVF-flat ANN index (pgvector `ivfflat` analog).
+
+    Build: k-means over a sample -> assign every vector to its nearest
+    centroid -> per-list row-id buckets padded to a rectangle so the
+    whole index is three device arrays. Search: find `nprobe` nearest
+    centroids per query, gather those lists, one distance matmul + top_k.
+    """
+
+    def __init__(self, centroids: np.ndarray, lists: np.ndarray,
+                 list_lens: np.ndarray, vectors: jnp.ndarray):
+        self.centroids = jnp.asarray(centroids, jnp.float32)   # [K, D]
+        self.lists = jnp.asarray(lists)                        # [K, M] int32
+        self.list_lens = jnp.asarray(list_lens)                # [K] int32
+        # bf16 on device halves HBM footprint; distances accumulate in f32
+        self.vectors = jnp.asarray(vectors, jnp.bfloat16)      # [N, D]
+        self.norms = jnp.sum(jnp.asarray(vectors, jnp.float32) ** 2,
+                             axis=1)                           # [N] f32
+
+    @classmethod
+    def build(cls, data: np.ndarray, nlists: int = 100,
+              sample: int = 100_000, iters: int = 10,
+              seed: int = 0) -> "IvfFlatIndex":
+        n = len(data)
+        rng = np.random.default_rng(seed)
+        samp = data if n <= sample else data[rng.choice(n, sample, False)]
+        cent = kmeans(samp, nlists, iters, seed)
+        # assign in chunks (keeps peak memory bounded)
+        assign = np.empty(n, np.int32)
+        step = 1 << 18
+        centd = jnp.asarray(cent, jnp.float32)
+        for i in range(0, n, step):
+            d = l2_distance2(jnp.asarray(data[i:i + step], jnp.float32), centd)
+            assign[i:i + step] = np.asarray(jnp.argmin(d, axis=1))
+        order = np.argsort(assign, kind="stable")
+        sorted_assign = assign[order]
+        counts = np.bincount(sorted_assign, minlength=nlists)
+        maxlen = int(counts.max()) if n else 1
+        lists = np.zeros((nlists, maxlen), np.int32)
+        lens = counts.astype(np.int32)
+        starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+        for li in range(nlists):
+            seg = order[starts[li]:starts[li] + counts[li]]
+            lists[li, :len(seg)] = seg
+        return cls(cent, lists, lens, jnp.asarray(data, jnp.float32))
+
+    @partial(jax.jit, static_argnames=("self", "k", "nprobe"))
+    def _search(self, queries, k: int, nprobe: int):
+        dc = l2_distance2(queries, self.centroids)            # [Q, K]
+        _, probe = jax.lax.top_k(-dc, nprobe)                 # [Q, nprobe]
+        cand = self.lists[probe]                              # [Q, nprobe, M]
+        q_, p_, m_ = cand.shape
+        cand = cand.reshape(q_, p_ * m_)
+        cand_valid = (jnp.arange(m_)[None, None, :]
+                      < self.list_lens[probe][:, :, None]).reshape(q_, p_ * m_)
+        vecs = self.vectors[cand]                             # [Q, C, D] bf16
+        dots = jnp.einsum("qd,qcd->qc", queries.astype(jnp.bfloat16), vecs,
+                          preferred_element_type=jnp.float32)
+        d = (jnp.sum(queries.astype(jnp.float32) ** 2, axis=1, keepdims=True)
+             + self.norms[cand] - 2.0 * dots)
+        d = jnp.where(cand_valid, jnp.maximum(d, 0.0), jnp.inf)
+        neg, pos = jax.lax.top_k(-d, k)
+        return -neg, jnp.take_along_axis(cand, pos, axis=1)
+
+    def search(self, queries: np.ndarray, k: int = 10, nprobe: int = 8
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        d, i = self._search(jnp.asarray(queries, jnp.float32), k, nprobe)
+        return np.asarray(d), np.asarray(i)
+
+    def __hash__(self):   # jit static self: identity-hashable
+        return id(self)
+
+    def __eq__(self, other):
+        return self is other

@@ -1,0 +1,208 @@
+"""Distributed scan: per-shard kernels + ICI collectives for the combine.
+
+Each device holds its tablet shard's columnar batch; the jitted step runs
+the same scan kernel per shard under `shard_map` and combines partial
+aggregates with psum/pmin/pmax over the mesh axes — the TPU translation
+of pggate's per-tablet fan-out + client-side partial combine (reference:
+src/yb/yql/pggate/pg_doc_op.h:117-121, aggregate combination in
+src/postgres yb_scan paths).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..ops.device_batch import DeviceBatch, bucket_rows, _pad
+from ..ops.expr import collect_constants, expr_signature
+from ..ops.scan import AggSpec, GroupSpec, _build_kernel, _expand_avg
+from ..storage.columnar import ColumnarBlock
+from .mesh import BLOCKS_AXIS, TABLETS_AXIS, TabletMesh
+
+
+@dataclass
+class ShardedBatch:
+    """[S, N] columnar arrays sharded over the mesh (S = total shards =
+    tablets * blocks, N = per-shard padded rows)."""
+    n_rows_per_shard: List[int]
+    cols: Dict[int, jnp.ndarray]
+    nulls: Dict[int, jnp.ndarray]
+    valid: jnp.ndarray
+    key_hash: jnp.ndarray
+    ht: jnp.ndarray
+    write_id: jnp.ndarray
+    tombstone: jnp.ndarray
+    unique_keys: bool
+    mesh: TabletMesh
+
+    @property
+    def padded_rows(self) -> int:
+        return int(self.valid.shape[1])
+
+    @property
+    def num_shards(self) -> int:
+        return int(self.valid.shape[0])
+
+
+def build_sharded_batch(tm: TabletMesh,
+                        per_shard_blocks: Sequence[Sequence[ColumnarBlock]],
+                        columns: Sequence[int]) -> ShardedBatch:
+    """Stack per-shard block lists into mesh-sharded [S, N] arrays. The
+    number of shard slots must equal the mesh size; short shards pad."""
+    S = tm.num_tablet_shards * tm.num_block_shards
+    if len(per_shard_blocks) != S:
+        raise ValueError(f"need {S} shard block-lists, got "
+                         f"{len(per_shard_blocks)}")
+    ns = [sum(b.n for b in blocks) for blocks in per_shard_blocks]
+    pad = bucket_rows(max(max(ns), 1))
+
+    def stack(get, dtype=None):
+        rows = []
+        for blocks, n in zip(per_shard_blocks, ns):
+            parts = [get(b) for b in blocks]
+            arr = (np.concatenate(parts) if parts
+                   else np.zeros(0, dtype or np.float32))
+            rows.append(_pad(arr, pad))
+        return np.stack(rows)
+
+    cols: Dict[int, jnp.ndarray] = {}
+    nulls: Dict[int, jnp.ndarray] = {}
+
+    def put(tm, arr):
+        T, B = tm.num_tablet_shards, tm.num_block_shards
+        arr = arr.reshape(T, B, *arr.shape[1:])
+        return jax.device_put(arr, tm.tablet_block_sharding(
+            extra_dims=arr.ndim - 2))
+
+    for cid in columns:
+        def getv(b, cid=cid):
+            if cid in b.fixed:
+                v = b.fixed[cid][0]
+                return v.astype(np.float32) if v.dtype == np.float64 else v
+            return b.pk[cid]
+
+        def getn(b, cid=cid):
+            if cid in b.fixed:
+                return b.fixed[cid][1]
+            return np.zeros(b.n, bool)
+        cols[cid] = put(tm, stack(getv))
+        nulls[cid] = put(tm, stack(getn, bool))
+    valid_rows = []
+    for n in ns:
+        v = np.zeros(pad, bool)
+        v[:n] = True
+        valid_rows.append(v)
+    return ShardedBatch(
+        n_rows_per_shard=ns, cols=cols, nulls=nulls,
+        valid=put(tm, np.stack(valid_rows)),
+        key_hash=put(tm, stack(lambda b: b.key_hash, np.uint64)),
+        ht=put(tm, stack(lambda b: b.ht, np.uint64)),
+        write_id=put(tm, stack(lambda b: b.write_id, np.uint32)),
+        tombstone=put(tm, stack(lambda b: b.tombstone, bool)),
+        unique_keys=all(b.unique_keys
+                        for blocks in per_shard_blocks for b in blocks),
+        mesh=tm)
+
+
+_COMBINE = {"sum": "psum", "count": "psum", "min": "pmin", "max": "pmax"}
+
+
+class DistributedScanKernel:
+    def __init__(self):
+        self._cache: Dict[tuple, object] = {}
+        self.compiles = 0
+
+    def _get(self, sig, tm: TabletMesh, where, aggs, group, mvcc_mode):
+        fn = self._cache.get(sig)
+        if fn is not None:
+            return fn
+        local = _build_kernel(where, aggs, group, mvcc_mode)
+        axes = (TABLETS_AXIS, BLOCKS_AXIS)
+
+        def shard_fn(cols, nulls, consts, valid, key_hash, ht, wid, tomb,
+                     read_ht):
+            # local shard view: [1, 1, N] → [N]
+            sq = lambda a: a.reshape(a.shape[-1])
+            lcols = {k: sq(v) for k, v in cols.items()}
+            lnulls = {k: sq(v) for k, v in nulls.items()}
+            outs, counts, _ = local(
+                lcols, lnulls, consts, sq(valid), sq(key_hash), sq(ht),
+                sq(wid), sq(tomb), read_ht)
+            combined = []
+            for a, o in zip(aggs, outs):
+                kind = _COMBINE["count" if a.expr is None else a.op]
+                for ax in axes:
+                    if kind == "psum":
+                        o = jax.lax.psum(o, ax)
+                    elif kind == "pmin":
+                        o = jax.lax.pmin(o, ax)
+                    else:
+                        o = jax.lax.pmax(o, ax)
+                combined.append(o)
+            for ax in axes:
+                counts = jax.lax.psum(counts, ax)
+            return tuple(combined), counts
+
+        spec3 = P(TABLETS_AXIS, BLOCKS_AXIS, None)
+        in_specs = (
+            {k: spec3 for k in sig_cols(sig)}, {k: spec3 for k in sig_cols(sig)},
+            P(), spec3, spec3, spec3, spec3, spec3, P())
+        smapped = jax.shard_map(
+            shard_fn, mesh=tm.mesh, in_specs=in_specs,
+            out_specs=(tuple(P() for _ in aggs), P()),
+            check_vma=False)
+        fn = jax.jit(smapped)
+        self._cache[sig] = fn
+        self.compiles += 1
+        return fn
+
+    def run(self, batch: ShardedBatch,
+            where: Optional[tuple] = None,
+            aggs: Sequence[AggSpec] = (),
+            group: Optional[GroupSpec] = None,
+            read_ht: Optional[int] = None):
+        aggs = tuple(_expand_avg(aggs))
+        if read_ht is None:
+            mvcc_mode = "none"
+        elif batch.unique_keys:
+            mvcc_mode = "visible"
+        else:
+            mvcc_mode = "dedup"   # per-shard dedup: correct because one doc
+            # key lives in exactly one tablet shard and one block shard
+        consts: List = []
+        if where is not None:
+            collect_constants(where, consts)
+        for a in aggs:
+            if a.expr is not None:
+                collect_constants(a.expr, consts)
+        col_sig = tuple(sorted(
+            (cid, str(v.dtype)) for cid, v in batch.cols.items()))
+        tm = batch.mesh
+        sig = (
+            id(tm.mesh), expr_signature(where) if where is not None else None,
+            tuple(a.signature() for a in aggs),
+            group.cols if group else None, mvcc_mode,
+            batch.padded_rows, col_sig,
+        )
+        fn = self._get(sig, tm, where, aggs, group, mvcc_mode)
+        return fn(batch.cols, batch.nulls,
+                  [jnp.asarray(c) for c in consts], batch.valid,
+                  batch.key_hash, batch.ht, batch.write_id, batch.tombstone,
+                  jnp.uint64(read_ht if read_ht is not None
+                             else 0xFFFFFFFFFFFFFFFF))
+
+
+def sig_cols(sig) -> Tuple[int, ...]:
+    return tuple(cid for cid, _ in sig[-1])
+
+
+_DEFAULT = DistributedScanKernel()
+
+
+def distributed_scan_aggregate(batch: ShardedBatch, where=None, aggs=(),
+                               group=None, read_ht=None):
+    return _DEFAULT.run(batch, where, aggs, group, read_ht)
