@@ -1,0 +1,5 @@
+from .table_codec import TableInfo, TableCodec  # noqa: F401
+from .operations import (  # noqa: F401
+    ReadRequest, ReadResponse, WriteRequest, WriteResponse, RowOp,
+    DocReadOperation, DocWriteOperation,
+)

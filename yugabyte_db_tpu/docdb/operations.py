@@ -1,0 +1,404 @@
+"""DocDB read/write operations — the tablet-level request executors.
+
+Analogs of the reference's PgsqlReadOperation / PgsqlWriteOperation
+(reference: src/yb/docdb/pgsql_operation.cc:2225 Execute, :1633 write
+path, scan loop :2790-2877). Both the SQL and CQL front ends compile to
+these requests; they cross the wire in msgpack (the PgsqlReadRequestPB
+analog, reference: src/yb/common/pgsql_protocol.proto:430-565).
+
+The read executor is where the TPU pushdown boundary lives: aggregate /
+filter scans over enough rows route to the columnar scan kernels
+(ops/scan.py) when `tpu_pushdown_enabled` is set, with row-at-a-time CPU
+execution as both the small-scan path and the correctness reference —
+exactly the two-backend structure the reference's
+`yb_enable_tpu_pushdown` GUC plan describes (BASELINE.json north star).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..dockv.key_encoding import ValueType
+from ..dockv.value import PrimitiveValue, ValueKind
+from ..ops.device_batch import build_batch
+from ..ops.scan import AggSpec, GroupSpec, ScanKernel
+from ..storage.columnar import ColumnarBlock
+from ..storage.lsm import LsmStore, WriteBatch
+from ..utils import flags
+from ..utils.hybrid_time import ENCODED_SIZE, DocHybridTime, HybridTime
+from .table_codec import TableCodec
+
+_HT_SUFFIX = ENCODED_SIZE + 1
+
+
+# --------------------------------------------------------------------------
+# Requests (wire format objects)
+# --------------------------------------------------------------------------
+@dataclass
+class RowOp:
+    kind: str                      # 'upsert' | 'delete'
+    row: Dict[str, object]         # full row for upsert; PK columns for delete
+
+
+@dataclass
+class WriteRequest:
+    table_id: str
+    ops: List[RowOp] = field(default_factory=list)
+
+
+@dataclass
+class WriteResponse:
+    rows_affected: int = 0
+
+
+@dataclass
+class ReadRequest:
+    table_id: str
+    columns: Tuple[str, ...] = ()            # projection (empty = all)
+    where: Optional[tuple] = None            # expr AST over column IDS
+    aggregates: Tuple[AggSpec, ...] = ()     # aggregate pushdown
+    group_by: Optional[GroupSpec] = None
+    pk_eq: Optional[Dict[str, object]] = None  # full-PK point lookup
+    limit: Optional[int] = None
+    paging_state: Optional[bytes] = None      # resume key (exclusive)
+    read_ht: Optional[int] = None             # read point (HybridTime.value)
+
+
+@dataclass
+class ReadResponse:
+    rows: List[Dict[str, object]] = field(default_factory=list)
+    agg_values: Optional[tuple] = None        # scalars or per-group arrays
+    group_counts: Optional[object] = None
+    paging_state: Optional[bytes] = None
+    backend: str = "cpu"                      # which path executed
+
+
+# --------------------------------------------------------------------------
+# CPU expression interpreter (correctness reference / small scans)
+# --------------------------------------------------------------------------
+def eval_expr_py(node: tuple, row: Dict[int, object]):
+    """Evaluate the pushdown AST over one row ({col_id: value}); returns
+    value or None for SQL NULL."""
+    kind = node[0]
+    if kind == "col":
+        return row.get(node[1])
+    if kind == "const":
+        return node[1]
+    if kind == "cmp":
+        l = eval_expr_py(node[2], row)
+        r = eval_expr_py(node[3], row)
+        if l is None or r is None:
+            return None
+        return {"lt": l < r, "le": l <= r, "gt": l > r, "ge": l >= r,
+                "eq": l == r, "ne": l != r}[node[1]]
+    if kind == "arith":
+        l = eval_expr_py(node[2], row)
+        r = eval_expr_py(node[3], row)
+        if l is None or r is None:
+            return None
+        return {"add": l + r, "sub": l - r, "mul": l * r,
+                "div": l / r}[node[1]]
+    if kind == "and":
+        l = eval_expr_py(node[1], row)
+        r = eval_expr_py(node[2], row)
+        if l is False or r is False:
+            return False
+        if l is None or r is None:
+            return None
+        return l and r
+    if kind == "or":
+        l = eval_expr_py(node[1], row)
+        r = eval_expr_py(node[2], row)
+        if l is True or r is True:
+            return True
+        if l is None or r is None:
+            return None
+        return l or r
+    if kind == "not":
+        v = eval_expr_py(node[1], row)
+        return None if v is None else not v
+    if kind == "between":
+        x = eval_expr_py(node[1], row)
+        lo = eval_expr_py(node[2], row)
+        hi = eval_expr_py(node[3], row)
+        if x is None or lo is None or hi is None:
+            return None
+        return lo <= x <= hi
+    if kind == "in":
+        x = eval_expr_py(node[1], row)
+        if x is None:
+            return None
+        return x in node[2]
+    if kind == "isnull":
+        return eval_expr_py(node[1], row) is None
+    raise ValueError(f"unknown node {kind}")
+
+
+# --------------------------------------------------------------------------
+# Write operation
+# --------------------------------------------------------------------------
+class DocWriteOperation:
+    """Converts row ops into a KV WriteBatch at apply time (the hybrid
+    time is assigned when the Raft operation is applied — reference:
+    tablet/tablet.cc ApplyRowOperations)."""
+
+    def __init__(self, codec: TableCodec, request: WriteRequest):
+        self.codec = codec
+        self.request = request
+
+    def apply(self, ht: HybridTime, op_id=None) -> Tuple[WriteBatch, int]:
+        batch = WriteBatch(op_id=op_id)
+        wid = 0
+        for op in self.request.ops:
+            dht = DocHybridTime(ht, wid)
+            if op.kind == "upsert":
+                k, v = self.codec.encode_write(op.row, dht)
+            elif op.kind == "delete":
+                k, v = self.codec.encode_delete(op.row, dht)
+            else:
+                raise ValueError(op.kind)
+            batch.put(k, v)
+            wid += 1
+        return batch, len(self.request.ops)
+
+
+# --------------------------------------------------------------------------
+# Read operation
+# --------------------------------------------------------------------------
+class DocReadOperation:
+    """Executes a ReadRequest against one tablet's stores."""
+
+    def __init__(self, codec: TableCodec, store: LsmStore,
+                 scan_kernel: Optional[ScanKernel] = None,
+                 device_cache=None):
+        self.codec = codec
+        self.store = store
+        self.kernel = scan_kernel or _SHARED_KERNEL
+        self.device_cache = device_cache
+
+    # ---- point lookup ----------------------------------------------------
+    def get_row(self, pk_row: Dict[str, object], read_ht: int
+                ) -> Optional[Dict[str, object]]:
+        prefix = self.codec.doc_key_prefix(pk_row)
+        for k, v in self.store.seek(prefix):
+            if not k.startswith(prefix) or k[len(prefix)] != ValueType.kHybridTime:
+                return None
+            dht = DocHybridTime.decode_desc(k[-ENCODED_SIZE:])
+            if dht.ht.value > read_ht:
+                continue    # newer than read point; keep scanning versions
+            return self.codec.decode_row(k, v)
+        return None
+
+    # ---- scans -----------------------------------------------------------
+    def execute(self, req: ReadRequest) -> ReadResponse:
+        if req.pk_eq is not None:
+            read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
+            row = self.get_row(req.pk_eq, read_ht)
+            rows = [self._project(row, req.columns)] if row is not None else []
+            return ReadResponse(rows=rows, backend="cpu")
+        if req.aggregates and self._tpu_eligible(req):
+            resp = self._execute_tpu_aggregate(req)
+            if resp is not None:
+                return resp
+        return self._execute_cpu(req)
+
+    def _tpu_eligible(self, req: ReadRequest) -> bool:
+        if not flags.get("tpu_pushdown_enabled"):
+            return False
+        approx_rows = sum(r.num_entries for r in self.store.ssts)
+        return approx_rows >= flags.get("tpu_min_rows_for_pushdown")
+
+    def _collect_blocks(self) -> Optional[List[ColumnarBlock]]:
+        """All columnar blocks across SSTs + a block built from memtable
+        contents; None if any source can't provide columnar form."""
+        blocks: List[ColumnarBlock] = []
+        for r in self.store.ssts:
+            for i in range(r.num_blocks()):
+                cb = r.columnar_block(i)
+                if cb is None:
+                    return None
+                blocks.append(cb)
+        mem_entries = list(self.store._mem.iterate())
+        for m in self.store._frozen:
+            mem_entries += list(m.iterate())
+        if mem_entries:
+            mem_entries.sort()
+            cb = self.codec.columnar_builder(mem_entries)
+            if cb is None:
+                return None
+            cb.unique_keys = False  # overlaps SSTs in general
+            blocks.append(cb)
+        if len(self.store.ssts) > 1 or (mem_entries and self.store.ssts):
+            for b in blocks:
+                b.unique_keys = b.unique_keys and len(blocks) == 1
+        return blocks
+
+    def _execute_tpu_aggregate(self, req: ReadRequest) -> Optional[ReadResponse]:
+        blocks = self._collect_blocks()
+        if not blocks:
+            return None
+        needed = set()
+        from ..ops.expr import referenced_columns
+        if req.where is not None:
+            referenced_columns(req.where, needed)
+        for a in req.aggregates:
+            if a.expr is not None:
+                referenced_columns(a.expr, needed)
+        if req.group_by is not None:
+            needed.update(cid for cid, _, _ in req.group_by.cols)
+        try:
+            if self.device_cache is not None:
+                key = (id(self.store), tuple(sorted(needed)),
+                       tuple(r.path for r in self.store.ssts),
+                       self.store.memtable_empty())
+                batch = self.device_cache.get_or_build(
+                    key, lambda: build_batch(blocks, sorted(needed)))
+            else:
+                batch = build_batch(blocks, sorted(needed))
+        except KeyError:
+            return None   # some column lacks columnar form → CPU path
+        read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
+        # multiple overlapping sources → force dedup mode via unique_keys
+        if len(blocks) > 1:
+            batch.unique_keys = False
+        outs, counts, _ = self.kernel.run(
+            batch, req.where, req.aggregates, req.group_by, read_ht)
+        return ReadResponse(agg_values=tuple(np.asarray(o) for o in outs),
+                            group_counts=np.asarray(counts),
+                            backend="tpu")
+
+    def _execute_cpu(self, req: ReadRequest) -> ReadResponse:
+        read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
+        lower = req.paging_state or None
+        rows_out: List[Dict[str, object]] = []
+        aggs = list(_expand_avg_cpu(req.aggregates))
+        agg_state = [_agg_init(a) for a in aggs]
+        group_state: Dict[int, list] = {}
+        count = 0
+        last_key = None
+        cur_prefix = None
+        chosen = False
+        by_id = {c.id: c.name for c in self.codec.schema.columns}
+        name_to_id = {c.name: c.id for c in self.codec.schema.columns}
+        for k, v in self.store.iterate(lower=lower):
+            marker = len(k) - _HT_SUFFIX
+            prefix = k[:marker]
+            if prefix != cur_prefix:
+                cur_prefix = prefix
+                chosen = False
+            if chosen:
+                continue
+            dht = DocHybridTime.decode_desc(k[-ENCODED_SIZE:])
+            if dht.ht.value > read_ht:
+                continue
+            chosen = True   # newest visible version of this doc key
+            if v[0] == ValueKind.kTombstone:
+                continue
+            row = self.codec.decode_row(k, v)
+            if row is None:
+                continue
+            idrow = {name_to_id[n]: val for n, val in row.items()}
+            if req.where is not None:
+                if eval_expr_py(req.where, idrow) is not True:
+                    continue
+            if aggs:
+                _agg_accumulate(aggs, agg_state, group_state, req.group_by,
+                                idrow)
+            else:
+                rows_out.append(self._project(row, req.columns))
+                count += 1
+                last_key = k
+                if req.limit is not None and count >= req.limit:
+                    return ReadResponse(
+                        rows=rows_out, paging_state=prefix + b"\xff",
+                        backend="cpu")
+        if aggs:
+            if req.group_by is not None:
+                return _grouped_cpu_response(aggs, group_state, req.group_by)
+            vals = tuple(_agg_final(a, s) for a, s in zip(aggs, agg_state))
+            return ReadResponse(agg_values=vals, backend="cpu",
+                                group_counts=None)
+        return ReadResponse(rows=rows_out, backend="cpu")
+
+    def _project(self, row: Dict[str, object], columns: Tuple[str, ...]
+                 ) -> Dict[str, object]:
+        if not columns:
+            return row
+        return {c: row.get(c) for c in columns}
+
+
+_MAX_HT = 0xFFFFFFFFFFFFFFFF - 1
+_SHARED_KERNEL = ScanKernel()
+
+
+def _expand_avg_cpu(aggs):
+    for a in aggs:
+        if a.op == "avg":
+            yield AggSpec("sum", a.expr)
+            yield AggSpec("count", a.expr)
+        else:
+            yield a
+
+
+def _agg_init(a: AggSpec):
+    if a.op in ("sum", "count"):
+        return 0
+    return None
+
+
+def _agg_step(a: AggSpec, state, idrow):
+    if a.expr is None:
+        return (state or 0) + 1
+    v = eval_expr_py(a.expr, idrow)
+    if v is None:
+        return state
+    if a.op == "count":
+        return (state or 0) + 1
+    if a.op == "sum":
+        return (state or 0) + v
+    if a.op == "min":
+        return v if state is None else min(state, v)
+    if a.op == "max":
+        return v if state is None else max(state, v)
+    raise ValueError(a.op)
+
+
+def _agg_accumulate(aggs, agg_state, group_state, group, idrow):
+    if group is None:
+        for i, a in enumerate(aggs):
+            agg_state[i] = _agg_step(a, agg_state[i], idrow)
+        return
+    gid = 0
+    stride = 1
+    for cid, domain, offset in group.cols:
+        c = idrow.get(cid)
+        c = 0 if c is None else int(c) - offset
+        gid += max(0, min(c, domain - 1)) * stride
+        stride *= domain
+    st = group_state.setdefault(gid, [_agg_init(a) for a in aggs] + [0])
+    for i, a in enumerate(aggs):
+        st[i] = _agg_step(a, st[i], idrow)
+    st[-1] += 1
+
+
+def _agg_final(a: AggSpec, state):
+    if a.op in ("sum", "count"):
+        return state or 0
+    return state
+
+
+def _grouped_cpu_response(aggs, group_state, group) -> ReadResponse:
+    G = group.num_groups
+    outs = []
+    for i, a in enumerate(aggs):
+        arr = np.zeros(G, np.float64 if a.op != "count" else np.int64)
+        for gid, st in group_state.items():
+            arr[gid] = _agg_final(a, st[i]) or 0
+        outs.append(arr)
+    counts = np.zeros(G, np.int64)
+    for gid, st in group_state.items():
+        counts[gid] = st[-1]
+    return ReadResponse(agg_values=tuple(outs), group_counts=counts,
+                        backend="cpu")

@@ -1,0 +1,326 @@
+"""Per-table codec: rows <-> doc KV entries <-> columnar blocks.
+
+This is the layer the reference spreads across dockv's PgTableRow
+materialization (src/yb/dockv/pg_row.cc), DocRowwiseIterator decode
+(src/yb/docdb/doc_rowwise_iterator.cc) and packed-row build
+(src/yb/dockv/packed_row.h) — concentrated here because our SSTs are
+columnar-first: the codec owns (a) scalar row encode/decode, (b) the
+ColumnarBlock builder plugged into SST flush, (c) the row_decoder that
+reconstructs KV entries from columnar-only blocks, (d) the vectorized
+bulk-load that turns user column arrays straight into sorted
+columnar-only SSTs without a per-row Python loop.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..dockv import bulk
+from ..dockv.key_encoding import (
+    DocKey, KeyEntryValue, SubDocKey, ValueType, decode_key_entry,
+)
+from ..dockv.packed_row import (
+    ColumnSchema, ColumnType, RowPacker, SchemaPacking, SchemaPackingStorage,
+    TableSchema, unpack_row,
+)
+from ..dockv.partition import PartitionSchema
+from ..dockv.value import PrimitiveValue, ValueKind
+from ..storage.columnar import ColumnarBlock, fnv64_bytes, fnv64_keys
+from ..utils.hybrid_time import ENCODED_SIZE, DocHybridTime, HybridTime
+
+_HT_SUFFIX = ENCODED_SIZE + 1
+
+
+@dataclass
+class TableInfo:
+    """Table metadata as known by tablets (reference: the schema parts of
+    master/catalog_entity_info.proto + tablet metadata)."""
+
+    table_id: str
+    name: str
+    schema: TableSchema
+    partition_schema: PartitionSchema
+    packings: SchemaPackingStorage = field(default_factory=SchemaPackingStorage)
+
+    def __post_init__(self):
+        if self.schema.version not in getattr(self.packings, "_packings", {}):
+            self.packings.add_schema(self.schema)
+
+    @property
+    def packing(self) -> SchemaPacking:
+        return self.packings.get(self.schema.version)
+
+
+_KEV_MAKER = {
+    ColumnType.INT32: KeyEntryValue.int32,
+    ColumnType.INT64: KeyEntryValue.int64,
+    ColumnType.FLOAT64: KeyEntryValue.double,
+    ColumnType.STRING: KeyEntryValue.string,
+    ColumnType.TIMESTAMP: KeyEntryValue.timestamp,
+    ColumnType.BINARY: KeyEntryValue.raw_bytes,
+}
+
+_BULK_ENC = {
+    ColumnType.INT32: bulk.encode_int32_column,
+    ColumnType.INT64: bulk.encode_int64_column,
+    ColumnType.FLOAT64: bulk.encode_double_column,
+    ColumnType.TIMESTAMP: lambda v, desc=False: bulk._retype(
+        bulk.encode_int64_column(v, desc),
+        ValueType.kTimestampDesc if desc else ValueType.kTimestamp),
+}
+
+
+class TableCodec:
+    def __init__(self, info: TableInfo):
+        self.info = info
+        self.schema = info.schema
+        self.packer = RowPacker(info.packing)
+        self._pk_cols = self.schema.key_columns
+
+    # --- scalar paths -----------------------------------------------------
+    def pk_entries(self, row: Dict[str, object]) -> List[KeyEntryValue]:
+        out = []
+        for c in self._pk_cols:
+            v = row[c.name]
+            maker = _KEV_MAKER[c.type]
+            e = maker(v)
+            if c.sort_desc:
+                e = KeyEntryValue(e.kind, e.value, desc=True)
+            out.append(e)
+        return out
+
+    def doc_key(self, row: Dict[str, object]) -> DocKey:
+        return self.info.partition_schema.doc_key_for_row(self.pk_entries(row))
+
+    def encode_write(self, row: Dict[str, object], dht: DocHybridTime
+                     ) -> Tuple[bytes, bytes]:
+        """Full-row upsert as one packed KV (packed-row V2 path)."""
+        key = SubDocKey(self.doc_key(row), (), dht).encode()
+        values = {c.id: row.get(c.name) for c in self.schema.value_columns}
+        return key, self.packer.pack_value(values)
+
+    def encode_delete(self, pk_row: Dict[str, object], dht: DocHybridTime
+                      ) -> Tuple[bytes, bytes]:
+        key = SubDocKey(self.doc_key(pk_row), (), dht).encode()
+        return key, PrimitiveValue.tombstone().encode()
+
+    def doc_key_prefix(self, pk_row: Dict[str, object]) -> bytes:
+        return self.doc_key(pk_row).encode()
+
+    def decode_row(self, key: bytes, value: bytes) -> Optional[Dict[str, object]]:
+        """KV entry -> {col name: value} (None for a tombstone)."""
+        if value[0] == ValueKind.kTombstone:
+            return None
+        sdk = SubDocKey.decode(key)
+        out: Dict[str, object] = {}
+        entries = list(sdk.doc_key.hashed) + list(sdk.doc_key.range)
+        for c, e in zip(self._pk_cols, entries):
+            out[c.name] = e.value
+        if value[0] != ValueKind.kPackedRowV2:
+            raise ValueError("row values must be packed (V2) or tombstones")
+        ver = self.info.packings.version_of(value, 1)
+        packing = self.info.packings.get(ver)
+        unpacked = unpack_row(packing, value, 1)
+        for c in self.schema.value_columns:
+            if c.id in unpacked:
+                out[c.name] = unpacked[c.id]
+            else:
+                out[c.name] = None   # column added after this row's version
+        return out
+
+    # --- columnar builder / row decoder (plugged into LsmStore) -----------
+    def columnar_builder(self, entries: Sequence[Tuple[bytes, bytes]]
+                         ) -> Optional[ColumnarBlock]:
+        """Build a columnar sidecar from one SST block's KV entries; None
+        when the block isn't packable (mixed schema versions)."""
+        try:
+            n = len(entries)
+            keys_noht, hts, wids = [], np.empty(n, np.uint64), np.empty(n, np.uint32)
+            values = []
+            ver: Optional[int] = None
+            for i, (k, v) in enumerate(entries):
+                if k[-_HT_SUFFIX] != ValueType.kHybridTime:
+                    return None
+                dht = DocHybridTime.decode_desc(k[-ENCODED_SIZE:])
+                hts[i] = dht.ht.value
+                wids[i] = dht.write_id
+                keys_noht.append(k[:-_HT_SUFFIX])
+                if v[0] == ValueKind.kPackedRowV2:
+                    v_ver = self.info.packings.version_of(v, 1)
+                    if ver is None:
+                        ver = v_ver
+                    elif ver != v_ver:
+                        return None
+                elif v[0] != ValueKind.kTombstone:
+                    return None
+                values.append(v)
+            if ver is None:
+                ver = self.schema.version
+            packing = self.info.packings.get(ver)
+            blk = ColumnarBlock.from_packed_entries(
+                packing, keys_noht, hts, wids, values)
+            # decode fixed-width PK components for device-side key predicates
+            self._attach_pk_columns(blk, keys_noht)
+            # a block may contain several versions of a key
+            blk.unique_keys = len(set(keys_noht)) == n
+            # keep full keys for columnar-only reconstruction & merges
+            lens = {len(k) for k in keys_noht}
+            if len(lens) == 1:
+                w = lens.pop() + _HT_SUFFIX
+                km = np.frombuffer(
+                    b"".join(entries[i][0] for i in range(n)),
+                    np.uint8).reshape(n, w)
+                blk.keys = km.copy()
+            return blk
+        except Exception:
+            return None
+
+    def _attach_pk_columns(self, blk: ColumnarBlock,
+                           keys_noht: Sequence[bytes]) -> None:
+        cols: Dict[int, list] = {c.id: [] for c in self._pk_cols
+                                 if ColumnType.is_fixed(c.type)
+                                 or c.type in (ColumnType.INT32,
+                                               ColumnType.INT64,
+                                               ColumnType.FLOAT64)}
+        if not cols:
+            return
+        try:
+            for k in keys_noht:
+                dk, _ = DocKey.decode(k)
+                entries = list(dk.hashed) + list(dk.range)
+                for c, e in zip(self._pk_cols, entries):
+                    if c.id in cols:
+                        cols[c.id].append(e.value)
+            for c in self._pk_cols:
+                if c.id in cols:
+                    dt = ColumnType.NUMPY_DTYPES.get(c.type, np.float64)
+                    blk.pk[c.id] = np.asarray(cols[c.id], dt)
+        except Exception:
+            pass
+
+    def row_decoder(self, blk: ColumnarBlock) -> List[Tuple[bytes, bytes]]:
+        """Reconstruct KV entries from a columnar-only block (slow path,
+        used by CPU merges/point-reads over bulk-loaded SSTs)."""
+        assert blk.keys is not None
+        packing = self.info.packings.get(blk.schema_version)
+        out = []
+        for i in range(blk.n):
+            key = blk.keys[i].tobytes()
+            if blk.tombstone[i]:
+                out.append((key, PrimitiveValue.tombstone().encode()))
+                continue
+            values: Dict[int, object] = {}
+            for cid, (vals, nulls) in blk.fixed.items():
+                values[cid] = None if nulls[i] else vals[i].item()
+            for cid, (ends, heap, nulls) in blk.varlen.items():
+                if nulls[i]:
+                    values[cid] = None
+                else:
+                    lo = int(ends[i - 1]) if i else 0
+                    raw = heap[lo:int(ends[i])]
+                    c = self.schema.column_by_id(cid)
+                    values[cid] = (raw.decode()
+                                   if c.type in (ColumnType.STRING,
+                                                 ColumnType.JSON,
+                                                 ColumnType.DECIMAL)
+                                   else raw)
+            out.append((key, RowPacker(packing).pack_value(values)))
+        return out
+
+    # --- vectorized bulk load ---------------------------------------------
+    def bulk_blocks(self, columns: Dict[str, np.ndarray],
+                    ht: HybridTime, block_rows: int = 65536,
+                    partition=None) -> List[ColumnarBlock]:
+        """Turn user column arrays into sorted columnar-only blocks.
+
+        Requirements (bulk fast path): every PK component fixed-width
+        numeric. Varlen value columns are allowed.
+        partition: optional Partition — rows outside it are dropped
+        (used when loading a table across several tablets).
+        """
+        n = len(next(iter(columns.values())))
+        ps = self.info.partition_schema
+        pk_blocks = []
+        for c in self._pk_cols:
+            enc = _BULK_ENC[c.type](np.asarray(columns[c.name]), c.sort_desc)
+            pk_blocks.append(enc)
+        if ps.kind == "hash":
+            nh = ps.num_hash_columns
+            hash_input = (pk_blocks[0] if nh == 1
+                          else np.concatenate(pk_blocks[:nh], axis=1))
+            hashes = bulk.fast_hash16_from_encoded(hash_input)
+            doc_keys = bulk.encode_doc_keys(hashes, pk_blocks, nh)
+            part_keys = hashes.astype(">u2").view(np.uint8).reshape(-1, 2)
+        else:
+            doc_keys = bulk.encode_doc_keys(None, pk_blocks, 0)
+            part_keys = doc_keys
+        keep = np.ones(n, bool)
+        if partition is not None:
+            if partition.start:
+                lo = np.frombuffer(partition.start.ljust(part_keys.shape[1],
+                                                         b"\x00"), np.uint8)
+                keep &= _rows_ge(part_keys, lo)
+            if partition.end:
+                hi = np.frombuffer(partition.end.ljust(part_keys.shape[1],
+                                                       b"\x00"), np.uint8)
+                keep &= ~_rows_ge(part_keys, hi)
+        idx = np.nonzero(keep)[0]
+        doc_keys = doc_keys[idx]
+        full = bulk.append_hybrid_times(
+            doc_keys,
+            np.full(len(idx), ht.value, np.uint64),
+            np.arange(len(idx), dtype=np.uint32))
+        # sort rows by encoded doc key
+        order = np.argsort(
+            np.ascontiguousarray(doc_keys).view(
+                np.dtype((np.void, doc_keys.shape[1]))).reshape(-1),
+            kind="stable")
+        full = full[order]
+        sorted_idx = idx[order]
+        # all doc keys share one width here, so the matrix FNV is byte-
+        # exact with fnv64_bytes — consistent with flush-built blocks
+        key_hash = _fnv_rows(doc_keys[order])
+        blocks = []
+        for s in range(0, len(sorted_idx), block_rows):
+            sel = sorted_idx[s:s + block_rows]
+            bn = len(sel)
+            fixed, varlen, pk = {}, {}, {}
+            for c in self.schema.columns:
+                arr = np.asarray(columns[c.name])[sel]
+                if c.is_key:
+                    pk[c.id] = arr
+                elif ColumnType.is_fixed(c.type):
+                    fixed[c.id] = (arr, np.zeros(bn, bool))
+                else:
+                    raws = [x.encode() if isinstance(x, str) else bytes(x)
+                            for x in arr]
+                    ends = np.cumsum([len(r) for r in raws]).astype(np.uint32)
+                    varlen[c.id] = (ends, b"".join(raws), np.zeros(bn, bool))
+            blocks.append(ColumnarBlock.from_arrays(
+                schema_version=self.schema.version,
+                key_hash=key_hash[s:s + bn],
+                ht=np.full(bn, ht.value, np.uint64),
+                pk=pk, fixed=fixed, varlen=varlen,
+                keys=full[s:s + bn], unique_keys=True))
+        return blocks
+
+
+def _rows_ge(mat: np.ndarray, bound: np.ndarray) -> np.ndarray:
+    """Row-wise lexicographic mat[i] >= bound (vectorized byte-column
+    sweep; numpy void rows sort but don't support ordering ufuncs)."""
+    n, w = mat.shape
+    result = np.zeros(n, bool)
+    decided = np.zeros(n, bool)
+    for j in range(w):
+        gt = ~decided & (mat[:, j] > bound[j])
+        lt = ~decided & (mat[:, j] < bound[j])
+        result |= gt
+        decided |= gt | lt
+    return result | ~decided   # fully-equal rows are >=
+
+
+def _fnv_rows(mat: np.ndarray) -> np.ndarray:
+    from ..storage.columnar import fnv64_rows
+    return fnv64_rows(mat)
