@@ -1,0 +1,234 @@
+"""DocDB compaction: MVCC GC feed (CPU) + the TPU compaction driver.
+
+CPU side mirrors the reference's DocDBCompactionFeed (reference:
+src/yb/docdb/docdb_compaction_context.cc:783): as the merged stream goes
+by, drop overwritten versions at or below the history cutoff, collapse
+tombstones, drop exact duplicates.
+
+TPU side feeds whole SSTs through ops/compaction.py: one device sort
+replaces the k-way merge and the retention decision is a vector mask;
+when all inputs are columnar with uniform key width the output SST is
+rebuilt by pure array gathers (no per-row loop at all).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.compaction import merge_gc_split_kernel, keys_to_words, split_ht_suffix
+from ..storage.columnar import ColumnarBlock
+from ..storage.lsm import CompactionFeed, LsmStore
+from ..storage.sst import SstReader, SstWriter
+from ..utils.hybrid_time import ENCODED_SIZE, DocHybridTime
+from ..dockv.value import ValueKind
+from .table_codec import TableCodec
+
+import jax.numpy as jnp
+
+_HT_SUFFIX = ENCODED_SIZE + 1
+
+
+class DocDbCompactionFeed(CompactionFeed):
+    """Streaming MVCC GC for the CPU compaction path."""
+
+    def __init__(self, history_cutoff: int):
+        self.cutoff = history_cutoff
+        self._cur_prefix: Optional[bytes] = None
+        self._seen_leq = False
+        self._last_dht: Optional[tuple] = None
+
+    def feed(self, key: bytes, value: bytes):
+        prefix = key[:-_HT_SUFFIX]
+        dht = DocHybridTime.decode_desc(key[-ENCODED_SIZE:])
+        if prefix != self._cur_prefix:
+            self._cur_prefix = prefix
+            self._seen_leq = False
+            self._last_dht = None
+        ident = (dht.ht.value, dht.write_id)
+        if self._last_dht == ident:
+            return []                      # exact duplicate (replay)
+        self._last_dht = ident
+        if dht.ht.value > self.cutoff:
+            return [(key, value)]          # within retention window
+        if self._seen_leq:
+            return []                      # overwritten history
+        self._seen_leq = True
+        if value and value[0] == ValueKind.kTombstone:
+            return []                      # latest <= cutoff is a delete
+        return [(key, value)]
+
+
+def tpu_compact(store: LsmStore, codec: TableCodec, history_cutoff: int,
+                inputs: Optional[Sequence[SstReader]] = None,
+                block_rows: int = 65536) -> Optional[str]:
+    """Major (or selected-input) compaction through the device kernel.
+
+    Returns the new SST path, or None if there was nothing to do. Falls
+    back to materialized row gathering when inputs aren't uniformly
+    columnar."""
+    if inputs is None:
+        inputs = store.ssts
+    inputs = list(inputs)
+    if not inputs:
+        return None
+
+    col_sources: List[ColumnarBlock] = []
+    all_columnar = True
+    for r in inputs:
+        for i in range(r.num_blocks()):
+            cb = r.columnar_block(i)
+            if cb is None or cb.keys is None:
+                all_columnar = False
+                break
+            col_sources.append(cb)
+        if not all_columnar:
+            break
+
+    if all_columnar and col_sources:
+        widths = {cb.keys.shape[1] for cb in col_sources}
+        if len(widths) == 1:
+            return _compact_columnar(store, codec, col_sources, inputs,
+                                     history_cutoff, block_rows)
+    return _compact_rows(store, codec, inputs, history_cutoff)
+
+
+def _compact_columnar(store, codec, blocks: List[ColumnarBlock],
+                      inputs, cutoff: int, block_rows: int) -> str:
+    keys = np.concatenate([b.keys for b in blocks])
+    tomb = np.concatenate([b.tombstone for b in blocks])
+    dk, ht, wid = split_ht_suffix(keys)
+    dk_words = keys_to_words(dk)
+    order, keep = merge_gc_split_kernel(
+        jnp.asarray(dk_words), jnp.asarray(ht), jnp.asarray(wid),
+        jnp.asarray(tomb), jnp.ones(len(keys), bool),
+        jnp.uint64(cutoff), num_dk_words=dk_words.shape[1])
+    order = np.asarray(order)
+    keep = np.asarray(keep)
+    sel = order[keep]                       # kept rows, in sorted key order
+
+    # concatenate all columns once, then gather
+    def cat_fixed(cid):
+        vals = np.concatenate([b.fixed[cid][0] for b in blocks])
+        nulls = np.concatenate([b.fixed[cid][1] for b in blocks])
+        return vals, nulls
+
+    def cat_pk(cid):
+        return np.concatenate([b.pk[cid] for b in blocks])
+
+    fixed_ids = list(blocks[0].fixed.keys())
+    pk_ids = list(blocks[0].pk.keys())
+    varlen_ids = list(blocks[0].varlen.keys())
+    key_hash = np.concatenate([b.key_hash for b in blocks])
+    sv = blocks[0].schema_version
+
+    # varlen gather: per column, rebuild (ends, heap) for selected rows
+    def gather_varlen(cid, sel_idx):
+        parts_ends, parts_heap, parts_null = [], [], []
+        offset = 0
+        ends_all, heaps, null_all, starts_all = [], [], [], []
+        row_src = []
+        base = 0
+        for b in blocks:
+            ends, heap, null = b.varlen[cid]
+            starts = np.concatenate([[0], ends[:-1]]).astype(np.int64)
+            ends_all.append(ends.astype(np.int64))
+            starts_all.append(starts)
+            null_all.append(null)
+            heaps.append(heap)
+            row_src.append(np.full(b.n, len(heaps) - 1, np.int32))
+            base += b.n
+        ends_c = np.concatenate(ends_all)
+        starts_c = np.concatenate(starts_all)
+        null_c = np.concatenate(null_all)
+        src_c = np.concatenate(row_src)
+        out_heap = bytearray()
+        out_ends = np.zeros(len(sel_idx), np.uint32)
+        out_null = null_c[sel_idx]
+        for j, i in enumerate(sel_idx):
+            if not out_null[j]:
+                out_heap += heaps[src_c[i]][starts_c[i]:ends_c[i]]
+            out_ends[j] = len(out_heap)
+        return out_ends, bytes(out_heap), out_null
+
+    path = store._new_sst_path()
+    w = SstWriter(path)
+    for s in range(0, len(sel), block_rows):
+        chunk = sel[s:s + block_rows]
+        if not len(chunk):
+            continue
+        fixed = {cid: (cat_fixed(cid)[0][chunk], cat_fixed(cid)[1][chunk])
+                 for cid in fixed_ids}
+        pk = {cid: cat_pk(cid)[chunk] for cid in pk_ids}
+        varlen = {cid: gather_varlen(cid, chunk) for cid in varlen_ids}
+        out = ColumnarBlock.from_arrays(
+            schema_version=sv,
+            key_hash=key_hash[chunk],
+            ht=ht[chunk], write_id=wid[chunk],
+            pk=pk, fixed=fixed, varlen=varlen,
+            tombstone=tomb[chunk],
+            keys=keys[chunk], unique_keys=_unique(dk_words, sel, s, block_rows))
+        w.add_columnar_block(out)
+    frontier = _merge_frontier(inputs)
+    w.set_frontier(**frontier)
+    w.finish()
+    store.replace_ssts(inputs, path)
+    return path
+
+
+def _unique(dk_words, sel, s, block_rows) -> bool:
+    chunk = sel[s:s + block_rows]
+    if len(chunk) < 2:
+        return True
+    rows = dk_words[chunk]
+    return bool((rows[1:] != rows[:-1]).any(axis=1).all())
+
+
+def _compact_rows(store, codec, inputs, cutoff: int) -> str:
+    """Fallback: materialize entries, sort+GC on device, gather rows."""
+    entries: List[Tuple[bytes, bytes]] = []
+    for r in inputs:
+        entries.extend(r.iterate())
+    if not entries:
+        # nothing to write; just drop inputs
+        path = store._new_sst_path()
+        w = SstWriter(path, columnar_builder=codec.columnar_builder)
+        w.finish()
+        store.replace_ssts(inputs, path)
+        return path
+    lens = [len(k) for k, _ in entries]
+    wmax = max(lens)
+    tomb = np.fromiter((v[0] == ValueKind.kTombstone for _, v in entries),
+                       bool, len(entries))
+    # split suffix per-entry then pad doc keys
+    from ..ops.compaction import compact_runs
+    keys_mat = np.zeros((len(entries), wmax), np.uint8)
+    same_w = len(set(lens)) == 1
+    if same_w:
+        keys_mat = np.frombuffer(b"".join(k for k, _ in entries),
+                                 np.uint8).reshape(len(entries), wmax).copy()
+        order, keep = compact_runs([(keys_mat, tomb)], cutoff)
+    else:
+        runs = []
+        for i, (k, v) in enumerate(entries):
+            runs.append((np.frombuffer(k, np.uint8)[None, :],
+                         tomb[i:i + 1]))
+        order, keep = compact_runs(runs, cutoff)
+    path = store._new_sst_path()
+    w = SstWriter(path, columnar_builder=codec.columnar_builder)
+    for i in order[keep]:
+        w.add(*entries[int(i)])
+    w.set_frontier(**_merge_frontier(inputs))
+    w.finish()
+    store.replace_ssts(inputs, path)
+    return path
+
+
+def _merge_frontier(inputs) -> dict:
+    frontier = {}
+    for r in inputs:
+        op = r.frontier.get("op_id")
+        if op is not None and ("op_id" not in frontier
+                               or op > frontier["op_id"]):
+            frontier["op_id"] = op
+    return frontier

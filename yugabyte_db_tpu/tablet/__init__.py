@@ -1,0 +1,1 @@
+from .tablet import Tablet  # noqa: F401

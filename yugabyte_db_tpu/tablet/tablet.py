@@ -1,0 +1,158 @@
+"""Tablet: one shard of one table — storage + codec + read/write ops.
+
+Analog of the reference's Tablet (reference: src/yb/tablet/tablet.h:151,
+tablet.cc:2303 HandlePgsqlReadRequest, :1938 ApplyRowOperations). Holds
+the RegularDB LSM (and, once distributed transactions land, the
+IntentsDB — reference: tablet/tablet.h:1287-1288), the table codec, and
+serves DocDB read/write operations. Raft integration drives `apply_*`
+through replicated operations; single-node callers may use them
+directly.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..docdb.compaction import DocDbCompactionFeed, tpu_compact
+from ..docdb.operations import (
+    DocReadOperation, DocWriteOperation, ReadRequest, ReadResponse,
+    WriteRequest, WriteResponse,
+)
+from ..docdb.table_codec import TableCodec, TableInfo
+from ..ops.device_batch import DeviceBlockCache
+from ..storage.lsm import LsmStore
+from ..utils import flags, metrics
+from ..utils.hybrid_time import HybridClock, HybridTime
+
+# process-wide device block cache shared by all tablets (HBM is global)
+_DEVICE_CACHE = DeviceBlockCache()
+
+
+class Tablet:
+    def __init__(self, tablet_id: str, info: TableInfo, directory: str,
+                 clock: Optional[HybridClock] = None,
+                 partition=None):
+        self.tablet_id = tablet_id
+        self.info = info
+        self.partition = partition
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.codec = TableCodec(info)
+        self.clock = clock or HybridClock()
+        self.regular = LsmStore(
+            os.path.join(directory, "regular"), name="regular",
+            columnar_builder=self.codec.columnar_builder,
+            row_decoder=self.codec.row_decoder)
+        self.intents = LsmStore(
+            os.path.join(directory, "intents"), name="intents")
+        self._read_op = DocReadOperation(
+            self.codec, self.regular, device_cache=_DEVICE_CACHE)
+        self._lock = threading.Lock()
+        ent = metrics.REGISTRY.entity("tablet", tablet_id,
+                                      table=info.name)
+        self._m_rows_written = ent.counter("rows_inserted")
+        self._m_reads = ent.counter("read_ops")
+        self._m_read_lat = ent.histogram("read_latency_us")
+
+    # --- writes (called under Raft apply, or directly in single-node) -----
+    def apply_write(self, req: WriteRequest,
+                    ht: Optional[HybridTime] = None,
+                    op_id=None) -> WriteResponse:
+        ht = ht or self.clock.now()
+        batch, n = DocWriteOperation(self.codec, req).apply(ht, op_id=op_id)
+        self.regular.apply(batch)
+        self._m_rows_written.increment(n)
+        if self.regular.should_flush():
+            self.flush()
+        return WriteResponse(rows_affected=n)
+
+    # --- reads ------------------------------------------------------------
+    def read(self, req: ReadRequest) -> ReadResponse:
+        import time
+        t0 = time.perf_counter()
+        if req.read_ht is None:
+            req.read_ht = self.clock.now().value
+        resp = self._read_op.execute(req)
+        self._m_reads.increment()
+        self._m_read_lat.increment((time.perf_counter() - t0) * 1e6)
+        return resp
+
+    def safe_time(self) -> HybridTime:
+        return self.clock.now()
+
+    # --- maintenance ------------------------------------------------------
+    def flush(self) -> Optional[str]:
+        path = self.regular.flush()
+        if path:
+            _DEVICE_CACHE.invalidate_prefix((id(self.regular),))
+        return path
+
+    def history_cutoff(self) -> int:
+        retention_us = flags.get("history_retention_interval_sec") * 1_000_000
+        now = self.clock.now()
+        return max(0, now.value - (retention_us << 12))
+
+    def compact(self, major: bool = True) -> Optional[str]:
+        """Major compaction with MVCC GC; routes to the TPU merge kernel
+        when enabled (reference analog: full_compaction_manager.cc driving
+        CompactionJob with the DocDB feed)."""
+        self.flush()
+        inputs = self.regular.ssts if major else self.regular.pick_compaction()
+        if not inputs:
+            return None
+        cutoff = self.history_cutoff()
+        if flags.get("tpu_compaction_enabled"):
+            path = tpu_compact(self.regular, self.codec, cutoff,
+                               inputs=inputs)
+        else:
+            path = self.regular.compact(
+                inputs=inputs, feed=DocDbCompactionFeed(cutoff))
+        _DEVICE_CACHE.invalidate_prefix((id(self.regular),))
+        return path
+
+    def bulk_load(self, columns: Dict[str, np.ndarray],
+                  ht: Optional[HybridTime] = None,
+                  block_rows: int = 65536) -> int:
+        """Vectorized ingest of column arrays (rows outside this tablet's
+        partition are dropped, so the same arrays can be fed to every
+        tablet of a table)."""
+        ht = ht or self.clock.now()
+        blocks = self.codec.bulk_blocks(columns, ht, block_rows=block_rows,
+                                        partition=self.partition)
+        if not blocks:
+            return 0
+        def build(w):
+            for b in blocks:
+                w.add_columnar_block(b)
+        self.regular.ingest_sst(build)
+        n = sum(b.n for b in blocks)
+        self._m_rows_written.increment(n)
+        return n
+
+    # --- snapshots --------------------------------------------------------
+    def create_snapshot(self, out_dir: str) -> None:
+        """Consistent tablet snapshot: flush + hard-link checkpoint
+        (reference: tablet/tablet_snapshots.cc:186,273)."""
+        self.flush()
+        self.regular.checkpoint(os.path.join(out_dir, "regular"))
+
+    @classmethod
+    def restore_snapshot(cls, tablet_id: str, info: TableInfo,
+                         snapshot_dir: str, directory: str,
+                         clock=None) -> "Tablet":
+        import shutil
+        os.makedirs(directory, exist_ok=True)
+        dst = os.path.join(directory, "regular")
+        if os.path.exists(dst):
+            shutil.rmtree(dst)
+        shutil.copytree(os.path.join(snapshot_dir, "regular"), dst)
+        return cls(tablet_id, info, directory, clock=clock)
+
+    def approximate_size(self) -> int:
+        return self.regular.approximate_size()
+
+    def num_sst_files(self) -> int:
+        return len(self.regular.ssts)
