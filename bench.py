@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Benchmark driver: TPC-H-style scan pushdown on the TPU engine.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+Primary metric (BASELINE.json config 2/3): TPC-H Q6 rows/sec through the
+TPU scan path on one tablet, vs the vectorized-numpy CPU baseline over
+the identical columnar blocks (a fair stand-in for a good CPU engine —
+NOT the row-at-a-time interpreter). Extra fields report Q1 grouped
+aggregation and the device compaction merge.
+
+Env knobs: BENCH_SF (default 1.0), BENCH_REPEATS (default 5).
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def best_of(fn, n, *args):
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        ts.append(time.perf_counter() - t0)
+    return min(ts), out
+
+
+def main():
+    sf = float(os.environ.get("BENCH_SF", "1.0"))
+    repeats = int(os.environ.get("BENCH_REPEATS", "5"))
+
+    import jax
+    from yugabyte_db_tpu.models.tpch import (
+        LineitemTable, TPCH_Q1, TPCH_Q6, generate_lineitem, numpy_reference,
+    )
+    from yugabyte_db_tpu.ops.cpu_scan import cpu_scan_aggregate
+    from yugabyte_db_tpu.ops.device_batch import build_batch
+    from yugabyte_db_tpu.ops.scan import ScanKernel
+    from yugabyte_db_tpu.utils import flags
+
+    dev = jax.devices()[0]
+    data = generate_lineitem(sf)
+    n = len(data["rowid"])
+
+    tmp = tempfile.mkdtemp(prefix="ybtpu-bench-")
+    table = LineitemTable(tmp, num_tablets=1)
+    t0 = time.perf_counter()
+    loaded = table.load(data)
+    load_s = time.perf_counter() - t0
+    tablet = table.tablets[0]
+
+    blocks = []
+    for r in tablet.regular.ssts:
+        for i in range(r.num_blocks()):
+            blocks.append(r.columnar_block(i))
+
+    results = {}
+    kernel = ScanKernel()
+    for q in (TPCH_Q6, TPCH_Q1):
+        # CPU vectorized baseline over the same blocks
+        cpu_t, cpu_out = best_of(
+            lambda: cpu_scan_aggregate(blocks, q.columns, q.where, q.aggs,
+                                       q.group), max(2, repeats // 2))
+        # TPU path: device-resident batch (block cache steady state)
+        batch = build_batch(blocks, sorted(q.columns))
+        def tpu_run():
+            outs, counts, _ = kernel.run(batch, q.where, q.aggs, q.group)
+            jax.block_until_ready(outs)
+            return outs
+        tpu_run()  # compile + warm
+        tpu_t, tpu_out = best_of(tpu_run, repeats)
+        # correctness spot check vs direct numpy
+        ref = numpy_reference(q, data)
+        if q.name == "q6":
+            rel = abs(float(tpu_out[0]) - ref) / max(abs(ref), 1e-9)
+            assert rel < 1e-3, f"q6 mismatch: {float(tpu_out[0])} vs {ref}"
+        results[q.name] = {
+            "cpu_s": cpu_t, "tpu_s": tpu_t,
+            "cpu_rows_per_s": n / cpu_t, "tpu_rows_per_s": n / tpu_t,
+            "speedup": cpu_t / tpu_t,
+        }
+
+    # compaction merge micro-bench: device merge of the loaded SST against
+    # an overlapping second version of 10% of rows
+    from yugabyte_db_tpu.docdb.compaction import tpu_compact
+    upd = {k: v[: n // 10] for k, v in data.items()}
+    from yugabyte_db_tpu.utils.hybrid_time import HybridTime
+    tablet.bulk_load(upd, ht=HybridTime.from_micros(
+        int(time.time() * 1e6) + 10_000_000))
+    total_bytes = tablet.approximate_size()
+    t0 = time.perf_counter()
+    tablet.compact()
+    comp_s = time.perf_counter() - t0
+    results["compaction"] = {
+        "input_mb": total_bytes / 1e6,
+        "mb_per_s": total_bytes / 1e6 / comp_s,
+        "seconds": comp_s,
+    }
+
+    q6 = results["q6"]
+    line = {
+        "metric": "tpch_q6_sf%g_tpu_rows_per_sec" % sf,
+        "value": round(q6["tpu_rows_per_s"], 1),
+        "unit": "rows/s",
+        "vs_baseline": round(q6["speedup"], 3),
+        "device": str(dev),
+        "rows": n,
+        "load_rows_per_s": round(loaded / load_s, 1),
+        "q1": {"tpu_rows_per_s": round(results["q1"]["tpu_rows_per_s"], 1),
+               "speedup": round(results["q1"]["speedup"], 3)},
+        "compaction_mb_per_s": round(results["compaction"]["mb_per_s"], 2),
+    }
+    print(json.dumps(line))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+from .log import Log, LogEntry  # noqa: F401
+from .raft import RaftConsensus, RaftConfig, PeerSpec, Role  # noqa: F401

@@ -1,0 +1,185 @@
+"""Segmented replicated log — the WAL.
+
+Analog of the reference's consensus log (reference: src/yb/consensus/
+log.cc, log_cache.cc, log_index.cc; design consensus/README:26-118: the
+Raft log IS the tablet WAL — there is no separate rocksdb WAL). Entries
+are (term, index, type, payload) with CRC32 framing; group commit via a
+single fsync per append batch; segments rotate at a size threshold; an
+in-memory tail cache serves reads for replication.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import msgpack
+
+from ..utils import flags
+
+ENTRY_HDR = struct.Struct("<II")   # payload_len, crc32
+
+
+@dataclass(frozen=True)
+class LogEntry:
+    term: int
+    index: int
+    etype: str            # 'write' | 'noop' | 'config' | ...
+    payload: bytes
+
+    def pack(self) -> bytes:
+        raw = msgpack.packb([self.term, self.index, self.etype, self.payload])
+        return ENTRY_HDR.pack(len(raw), zlib.crc32(raw)) + raw
+
+    @classmethod
+    def unpack_from(cls, data: bytes, pos: int) -> Tuple["LogEntry", int]:
+        ln, crc = ENTRY_HDR.unpack_from(data, pos)
+        pos += ENTRY_HDR.size
+        raw = data[pos:pos + ln]
+        if len(raw) < ln or zlib.crc32(raw) != crc:
+            raise EOFError("torn or corrupt log entry")
+        term, index, etype, payload = msgpack.unpackb(raw, raw=False)
+        return cls(term, index, etype, payload), pos + ln
+
+
+class Log:
+    """Append-only segmented log with an in-memory tail."""
+
+    def __init__(self, directory: str, fsync: bool = True):
+        self.dir = directory
+        self.fsync = fsync
+        os.makedirs(directory, exist_ok=True)
+        self._entries: List[LogEntry] = []     # full in-memory tail cache
+        self._first_index = 1                  # index of _entries[0]
+        self._segments: List[str] = []
+        self._active: Optional[object] = None
+        self._active_path: Optional[str] = None
+        self._active_size = 0
+        self._recover()
+
+    # --- recovery ---------------------------------------------------------
+    def _seg_paths(self) -> List[str]:
+        return sorted(p for p in os.listdir(self.dir)
+                      if p.startswith("wal-"))
+
+    def _recover(self) -> None:
+        for name in self._seg_paths():
+            path = os.path.join(self.dir, name)
+            with open(path, "rb") as f:
+                data = f.read()
+            pos = 0
+            while pos < len(data):
+                try:
+                    e, pos = LogEntry.unpack_from(data, pos)
+                except EOFError:
+                    # torn tail from a crash: truncate the file here
+                    with open(path, "r+b") as f:
+                        f.truncate(pos)
+                    break
+                self._append_mem(e)
+            self._segments.append(path)
+        if self._segments:
+            self._active_path = self._segments[-1]
+            self._active = open(self._active_path, "ab")
+            self._active_size = os.path.getsize(self._active_path)
+
+    def _append_mem(self, e: LogEntry) -> None:
+        if self._entries and e.index <= self._entries[-1].index:
+            # replayed conflict truncation: drop stale suffix
+            self._truncate_mem(e.index - 1)
+        if not self._entries:
+            self._first_index = e.index
+        self._entries.append(e)
+
+    def _truncate_mem(self, last_keep: int) -> None:
+        keep = last_keep - self._first_index + 1
+        del self._entries[max(keep, 0):]
+
+    # --- append path ------------------------------------------------------
+    def _roll_segment(self) -> None:
+        if self._active is not None:
+            self._active.close()
+        n = len(self._segments) + 1
+        self._active_path = os.path.join(self.dir, f"wal-{n:06d}")
+        self._segments.append(self._active_path)
+        self._active = open(self._active_path, "ab")
+        self._active_size = 0
+
+    def append(self, entries: List[LogEntry], sync: bool = True) -> None:
+        """Group-commit append: one write + one fsync for the batch."""
+        if not entries:
+            return
+        if self._active is None or self._active_size >= flags.get(
+                "log_segment_size_bytes"):
+            self._roll_segment()
+        buf = bytearray()
+        for e in entries:
+            if self.last_index and e.index <= self.last_index:
+                self._rewrite_truncated(e.index - 1)
+            self._append_mem(e)
+            buf += e.pack()
+        self._active.write(buf)
+        self._active.flush()
+        if sync and self.fsync:
+            os.fsync(self._active.fileno())
+        self._active_size += len(buf)
+
+    def _rewrite_truncated(self, last_keep: int) -> None:
+        """Physical truncation on conflict: rewrite from scratch into a
+        fresh segment chain (rare — only on divergent-follower repair)."""
+        self._truncate_mem(last_keep)
+        for p in self._segments:
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+        self._segments = []
+        if self._active is not None:
+            self._active.close()
+            self._active = None
+        self._roll_segment()
+        buf = bytearray()
+        for e in self._entries:
+            buf += e.pack()
+        self._active.write(buf)
+        self._active.flush()
+        if self.fsync:
+            os.fsync(self._active.fileno())
+        self._active_size = len(buf)
+
+    # --- reads ------------------------------------------------------------
+    @property
+    def last_index(self) -> int:
+        return self._entries[-1].index if self._entries else 0
+
+    @property
+    def last_term(self) -> int:
+        return self._entries[-1].term if self._entries else 0
+
+    def entry(self, index: int) -> Optional[LogEntry]:
+        i = index - self._first_index
+        if 0 <= i < len(self._entries):
+            return self._entries[i]
+        return None
+
+    def term_at(self, index: int) -> Optional[int]:
+        if index == 0:
+            return 0
+        e = self.entry(index)
+        return e.term if e else None
+
+    def entries_from(self, start: int, max_count: int = 10000
+                     ) -> List[LogEntry]:
+        i = max(start - self._first_index, 0)
+        return self._entries[i:i + max_count]
+
+    def all_entries(self) -> List[LogEntry]:
+        return list(self._entries)
+
+    def close(self) -> None:
+        if self._active is not None:
+            self._active.close()
+            self._active = None

@@ -1,0 +1,391 @@
+"""Per-tablet Raft consensus.
+
+Analog of the reference's RaftConsensus (reference:
+src/yb/consensus/raft_consensus.cc — ReplicateBatch :1224, elections
+leader_election.cc, peer tracking consensus_queue.cc/consensus_peers.cc,
+leader leases consensus/README). asyncio implementation:
+
+- roles FOLLOWER/CANDIDATE/LEADER; randomized election timeouts
+- UpdateConsensus-style AppendEntries carrying (prev_index, prev_term,
+  entries, commit_index, leader hybrid time for clock ratcheting)
+- log-matching repair by walking match_index back + truncating the
+  follower's divergent suffix
+- leader leases: a lease extends while a MAJORITY acks within the lease
+  window; linearizable reads require an unexpired lease (reference:
+  leader leases design in consensus/README)
+- replicate() returns when the entry commits (majority replicated);
+  committed entries apply in order through apply_cb
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Dict, List, Optional, Tuple
+
+from ..rpc.messenger import Messenger, RpcError
+from ..utils import flags
+from ..utils.hybrid_time import HybridClock, HybridTime
+from .log import Log, LogEntry
+
+
+class Role:
+    FOLLOWER = "FOLLOWER"
+    CANDIDATE = "CANDIDATE"
+    LEADER = "LEADER"
+
+
+@dataclass(frozen=True)
+class PeerSpec:
+    uuid: str
+    addr: Tuple[str, int]
+
+
+@dataclass
+class RaftConfig:
+    peers: List[PeerSpec]
+
+    def others(self, uuid: str) -> List[PeerSpec]:
+        return [p for p in self.peers if p.uuid != uuid]
+
+    @property
+    def majority(self) -> int:
+        return len(self.peers) // 2 + 1
+
+
+class ConsensusMetadata:
+    """Durable (term, voted_for, config) — reference:
+    consensus/consensus_meta.cc."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.current_term = 0
+        self.voted_for: Optional[str] = None
+        self._load()
+
+    def _load(self):
+        if os.path.exists(self.path):
+            with open(self.path) as f:
+                d = json.load(f)
+            self.current_term = d["term"]
+            self.voted_for = d.get("voted_for")
+
+    def save(self):
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"term": self.current_term,
+                       "voted_for": self.voted_for}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+
+ApplyCb = Callable[[LogEntry], Awaitable[None]]
+
+
+class RaftConsensus:
+    def __init__(self, tablet_id: str, uuid: str, config: RaftConfig,
+                 log: Log, messenger: Messenger, meta_dir: str,
+                 apply_cb: ApplyCb,
+                 clock: Optional[HybridClock] = None):
+        self.tablet_id = tablet_id
+        self.uuid = uuid
+        self.config = config
+        self.log = log
+        self.messenger = messenger
+        self.apply_cb = apply_cb
+        self.clock = clock or HybridClock()
+        self.meta = ConsensusMetadata(
+            os.path.join(meta_dir, f"cmeta-{tablet_id}.json"))
+
+        self.role = Role.FOLLOWER
+        self.leader_uuid: Optional[str] = None
+        self.commit_index = 0
+        self.last_applied = 0
+        self.next_index: Dict[str, int] = {}
+        self.match_index: Dict[str, int] = {}
+        self._lease_expiry = 0.0
+        self._last_heartbeat = time.monotonic()
+        self._election_deadline = self._new_election_deadline()
+        self._commit_waiters: List[Tuple[int, asyncio.Future]] = []
+        self._apply_lock = asyncio.Lock()
+        self._replicate_lock = asyncio.Lock()
+        self._tasks: List[asyncio.Task] = []
+        self._running = False
+        # registered as a messenger service per tablet
+        messenger.register_service(f"consensus-{tablet_id}", self)
+
+    # ------------------------------------------------------------------
+    def _new_election_deadline(self) -> float:
+        base = flags.get("raft_heartbeat_interval_ms") / 1000.0
+        return time.monotonic() + base * random.uniform(4, 8)
+
+    async def start(self):
+        self._running = True
+        self._tasks.append(asyncio.create_task(self._election_loop()))
+        # single-peer groups elect themselves instantly
+        if len(self.config.peers) == 1:
+            await self._become_leader()
+
+    async def shutdown(self):
+        self._running = False
+        for t in self._tasks:
+            t.cancel()
+        for _, fut in self._commit_waiters:
+            if not fut.done():
+                fut.cancel()
+
+    # ------------------------------------------------------------------
+    # Election
+    # ------------------------------------------------------------------
+    async def _election_loop(self):
+        while self._running:
+            await asyncio.sleep(0.01)
+            if self.role == Role.LEADER:
+                continue
+            if time.monotonic() >= self._election_deadline:
+                await self._run_election()
+
+    async def _run_election(self):
+        self.role = Role.CANDIDATE
+        self.meta.current_term += 1
+        self.meta.voted_for = self.uuid
+        self.meta.save()
+        term = self.meta.current_term
+        self._election_deadline = self._new_election_deadline()
+        votes = 1
+        req = {
+            "term": term, "candidate": self.uuid,
+            "last_log_index": self.log.last_index,
+            "last_log_term": self.log.last_term,
+        }
+
+        async def ask(peer: PeerSpec):
+            try:
+                return await self.messenger.call(
+                    peer.addr, f"consensus-{self.tablet_id}",
+                    "request_vote", req, timeout=1.0)
+            except (RpcError, asyncio.TimeoutError, OSError):
+                return None
+
+        results = await asyncio.gather(
+            *[ask(p) for p in self.config.others(self.uuid)])
+        if self.meta.current_term != term or self.role != Role.CANDIDATE:
+            return
+        for r in results:
+            if r is None:
+                continue
+            if r["term"] > term:
+                await self._step_down(r["term"])
+                return
+            if r.get("granted"):
+                votes += 1
+        if votes >= self.config.majority:
+            await self._become_leader()
+        else:
+            self.role = Role.FOLLOWER
+
+    async def rpc_request_vote(self, req) -> dict:
+        term = req["term"]
+        if term < self.meta.current_term:
+            return {"term": self.meta.current_term, "granted": False}
+        if term > self.meta.current_term:
+            await self._step_down(term)
+        up_to_date = (
+            (req["last_log_term"], req["last_log_index"])
+            >= (self.log.last_term, self.log.last_index))
+        grant = up_to_date and self.meta.voted_for in (None, req["candidate"])
+        if grant:
+            self.meta.voted_for = req["candidate"]
+            self.meta.save()
+            self._election_deadline = self._new_election_deadline()
+        return {"term": self.meta.current_term, "granted": grant}
+
+    async def _step_down(self, term: int):
+        if term > self.meta.current_term:
+            self.meta.current_term = term
+            self.meta.voted_for = None
+            self.meta.save()
+        if self.role == Role.LEADER:
+            self._lease_expiry = 0.0
+        self.role = Role.FOLLOWER
+        self._election_deadline = self._new_election_deadline()
+
+    async def _become_leader(self):
+        self.role = Role.LEADER
+        self.leader_uuid = self.uuid
+        for p in self.config.others(self.uuid):
+            self.next_index[p.uuid] = self.log.last_index + 1
+            self.match_index[p.uuid] = 0
+        # leader NO-OP commits entries from prior terms (Raft §5.4.2;
+        # reference appends a NO_OP on leader start)
+        await self._append_local(LogEntry(
+            self.meta.current_term, self.log.last_index + 1, "noop", b""))
+        if len(self.config.peers) == 1:
+            await self._advance_commit(self.log.last_index)
+            self._lease_expiry = time.monotonic() + 3600.0
+        else:
+            self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
+        await self._broadcast()
+
+    # ------------------------------------------------------------------
+    # Replication
+    # ------------------------------------------------------------------
+    async def _append_local(self, *entries: LogEntry):
+        self.log.append(list(entries))
+
+    async def replicate(self, etype: str, payload: bytes,
+                        timeout: float = 30.0) -> int:
+        """Leader-only: append + replicate; resolves at commit with the
+        entry's index (reference: ReplicateBatch raft_consensus.cc:1224)."""
+        if self.role != Role.LEADER:
+            raise RpcError(f"not leader (leader={self.leader_uuid})",
+                           "LEADER_NOT_READY")
+        async with self._replicate_lock:
+            idx = self.log.last_index + 1
+            await self._append_local(LogEntry(
+                self.meta.current_term, idx, etype, payload))
+            if len(self.config.peers) == 1:
+                await self._advance_commit(idx)
+                return idx
+            fut = asyncio.get_running_loop().create_future()
+            self._commit_waiters.append((idx, fut))
+        await self._broadcast()
+        await asyncio.wait_for(fut, timeout)
+        return idx
+
+    async def _heartbeat_loop(self):
+        interval = flags.get("raft_heartbeat_interval_ms") / 1000.0
+        while self._running and self.role == Role.LEADER:
+            await self._broadcast()
+            await asyncio.sleep(interval)
+
+    async def _broadcast(self):
+        if self.role != Role.LEADER or len(self.config.peers) == 1:
+            return
+        await asyncio.gather(
+            *[self._replicate_to(p) for p in self.config.others(self.uuid)])
+
+    async def _replicate_to(self, peer: PeerSpec):
+        ni = self.next_index.get(peer.uuid, self.log.last_index + 1)
+        prev = ni - 1
+        prev_term = self.log.term_at(prev)
+        if prev_term is None:     # fell behind our cache — restart from 1
+            ni = 1
+            prev, prev_term = 0, 0
+        entries = self.log.entries_from(ni)
+        req = {
+            "term": self.meta.current_term, "leader": self.uuid,
+            "prev_index": prev, "prev_term": prev_term,
+            "entries": [[e.term, e.index, e.etype, e.payload]
+                        for e in entries],
+            "commit_index": self.commit_index,
+            "leader_ht": self.clock.now().value,
+        }
+        try:
+            resp = await self.messenger.call(
+                peer.addr, f"consensus-{self.tablet_id}",
+                "update_consensus", req, timeout=2.0)
+        except (RpcError, asyncio.TimeoutError, OSError):
+            return
+        if resp["term"] > self.meta.current_term:
+            await self._step_down(resp["term"])
+            return
+        if resp.get("success"):
+            match = resp["last_index"]
+            self.match_index[peer.uuid] = match
+            self.next_index[peer.uuid] = match + 1
+            self._note_ack()
+            await self._maybe_advance_commit()
+        else:
+            self.next_index[peer.uuid] = max(
+                1, min(ni - 1, resp.get("last_index", ni - 1) + 1))
+
+    def _note_ack(self):
+        """Majority acks within the window extend the leader lease."""
+        acked = 1 + sum(1 for p in self.config.others(self.uuid)
+                        if self.match_index.get(p.uuid, 0) > 0)
+        if acked >= self.config.majority:
+            self._lease_expiry = time.monotonic() + \
+                flags.get("leader_lease_duration_ms") / 1000.0
+
+    async def _maybe_advance_commit(self):
+        matches = sorted(
+            [self.log.last_index] +
+            [self.match_index.get(p.uuid, 0)
+             for p in self.config.others(self.uuid)],
+            reverse=True)
+        candidate = matches[self.config.majority - 1]
+        # only commit entries from the current term directly (Raft §5.4.2)
+        if candidate > self.commit_index and \
+                self.log.term_at(candidate) == self.meta.current_term:
+            await self._advance_commit(candidate)
+
+    async def _advance_commit(self, index: int):
+        if index <= self.commit_index:
+            return
+        self.commit_index = index
+        await self._apply_committed()
+        still = []
+        for idx, fut in self._commit_waiters:
+            if idx <= index:
+                if not fut.done():
+                    fut.set_result(idx)
+            else:
+                still.append((idx, fut))
+        self._commit_waiters = still
+
+    async def _apply_committed(self):
+        async with self._apply_lock:
+            while self.last_applied < self.commit_index:
+                nxt = self.last_applied + 1
+                e = self.log.entry(nxt)
+                if e is None:
+                    break
+                if e.etype != "noop":
+                    await self.apply_cb(e)
+                self.last_applied = nxt
+
+    # ------------------------------------------------------------------
+    # Follower side
+    # ------------------------------------------------------------------
+    async def rpc_update_consensus(self, req) -> dict:
+        term = req["term"]
+        if term < self.meta.current_term:
+            return {"term": self.meta.current_term, "success": False,
+                    "last_index": self.log.last_index}
+        if term > self.meta.current_term or self.role != Role.FOLLOWER:
+            await self._step_down(term)
+        self.leader_uuid = req["leader"]
+        self._election_deadline = self._new_election_deadline()
+        self.clock.update(HybridTime(req["leader_ht"]))
+        prev, prev_term = req["prev_index"], req["prev_term"]
+        my_term = self.log.term_at(prev)
+        if prev > 0 and my_term != prev_term:
+            return {"term": self.meta.current_term, "success": False,
+                    "last_index": min(self.log.last_index, prev - 1)}
+        new = [LogEntry(t, i, ty, pl) for t, i, ty, pl in req["entries"]]
+        to_append = []
+        for e in new:
+            mine = self.log.entry(e.index)
+            if mine is None or mine.term != e.term:
+                to_append.append(e)
+        if to_append:
+            self.log.append(to_append)
+        await self._advance_commit(
+            min(req["commit_index"], self.log.last_index))
+        return {"term": self.meta.current_term, "success": True,
+                "last_index": self.log.last_index}
+
+    # ------------------------------------------------------------------
+    def is_leader(self) -> bool:
+        return self.role == Role.LEADER
+
+    def has_leader_lease(self) -> bool:
+        return self.is_leader() and time.monotonic() < self._lease_expiry
+
+    def leader_hint(self) -> Optional[str]:
+        return self.leader_uuid

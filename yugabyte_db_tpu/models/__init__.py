@@ -1,0 +1,1 @@
+from .tpch import LineitemTable, TPCH_Q1, TPCH_Q6  # noqa: F401

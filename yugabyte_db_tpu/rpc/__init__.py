@@ -1,0 +1,1 @@
+from .messenger import Messenger, RpcError  # noqa: F401

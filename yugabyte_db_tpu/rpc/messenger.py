@@ -1,0 +1,219 @@
+"""Async RPC framework.
+
+Analog of yb::rpc (reference: src/yb/rpc/ — Messenger/Reactor/Proxy/
+ServicePool, diagram rpc/README:30-62), built on asyncio instead of
+libev+epoll reactors. Wire format: 4-byte length + msgpack envelope
+[call_id, kind, service, method, payload]; responses multiplex over the
+same connection by call id (like the reference's InboundCall tracking).
+Local calls short-circuit the socket entirely (reference:
+rpc/local_call.h). Binary payloads ride msgpack bytes (sidecar analog).
+
+Services register as objects: `async def rpc_<method>(self, payload)`.
+"""
+from __future__ import annotations
+
+import asyncio
+import itertools
+import struct
+from typing import Any, Dict, Optional, Tuple
+
+import msgpack
+
+_REQ = 0
+_RESP = 1
+_ERR = 2
+
+_MAX_FRAME = 256 * 1024 * 1024
+
+
+class RpcError(Exception):
+    def __init__(self, message: str, code: str = "REMOTE_ERROR"):
+        super().__init__(message)
+        self.code = code
+
+
+def _pack(obj) -> bytes:
+    raw = msgpack.packb(obj, use_bin_type=True, default=_default)
+    return struct.pack("<I", len(raw)) + raw
+
+
+def _default(o):
+    import numpy as np
+    if isinstance(o, np.integer):
+        return int(o)
+    if isinstance(o, np.floating):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if isinstance(o, tuple):
+        return list(o)
+    raise TypeError(f"unserializable {type(o)}")
+
+
+class Connection:
+    """One multiplexed client connection."""
+
+    def __init__(self, reader: asyncio.StreamReader,
+                 writer: asyncio.StreamWriter):
+        self.reader = reader
+        self.writer = writer
+        self.pending: Dict[int, asyncio.Future] = {}
+        self.ids = itertools.count(1)
+        self._reader_task = asyncio.create_task(self._read_loop())
+        self.closed = False
+
+    async def _read_loop(self):
+        try:
+            while True:
+                hdr = await self.reader.readexactly(4)
+                (n,) = struct.unpack("<I", hdr)
+                if n > _MAX_FRAME:
+                    raise RpcError("oversized frame")
+                raw = await self.reader.readexactly(n)
+                call_id, kind, _svc, _m, payload = msgpack.unpackb(
+                    raw, raw=False)
+                fut = self.pending.pop(call_id, None)
+                if fut is not None and not fut.done():
+                    if kind == _ERR:
+                        fut.set_exception(RpcError(payload.get("message", ""),
+                                                   payload.get("code", "")))
+                    else:
+                        fut.set_result(payload)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError,
+                asyncio.CancelledError):
+            pass
+        finally:
+            self.closed = True
+            for fut in self.pending.values():
+                if not fut.done():
+                    fut.set_exception(RpcError("connection closed",
+                                               "NETWORK_ERROR"))
+            self.pending.clear()
+
+    async def call(self, service: str, method: str, payload: Any,
+                   timeout: float) -> Any:
+        call_id = next(self.ids)
+        fut = asyncio.get_running_loop().create_future()
+        self.pending[call_id] = fut
+        self.writer.write(_pack([call_id, _REQ, service, method, payload]))
+        await self.writer.drain()
+        return await asyncio.wait_for(fut, timeout)
+
+    def close(self):
+        self.closed = True
+        self._reader_task.cancel()
+        try:
+            self.writer.close()
+        except Exception:
+            pass
+
+
+class Messenger:
+    """Server + client in one object, like the reference Messenger."""
+
+    def __init__(self, name: str = "messenger"):
+        self.name = name
+        self.services: Dict[str, object] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._conns: Dict[Tuple[str, int], Connection] = {}
+        self._conn_locks: Dict[Tuple[str, int], asyncio.Lock] = {}
+        self.addr: Optional[Tuple[str, int]] = None
+        self._incoming: set = set()
+        # metrics
+        self.calls_sent = 0
+        self.calls_handled = 0
+
+    def register_service(self, name: str, service: object) -> None:
+        self.services[name] = service
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
+        self._server = await asyncio.start_server(self._handle_conn, host, port)
+        sock = self._server.sockets[0]
+        self.addr = sock.getsockname()[:2]
+        return self.addr
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter):
+        self._incoming.add(writer)
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                (n,) = struct.unpack("<I", hdr)
+                if n > _MAX_FRAME:
+                    break
+                raw = await reader.readexactly(n)
+                msg = msgpack.unpackb(raw, raw=False)
+                asyncio.create_task(self._dispatch(msg, writer))
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            self._incoming.discard(writer)
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, msg, writer):
+        call_id, kind, service, method, payload = msg
+        try:
+            result = await self._invoke(service, method, payload)
+            out = _pack([call_id, _RESP, service, method, result])
+        except Exception as e:  # noqa: BLE001 — errors cross the wire
+            code = getattr(e, "code", "REMOTE_ERROR")
+            code = code.name if hasattr(code, "name") else str(code)
+            out = _pack([call_id, _ERR, service, method,
+                         {"message": str(e), "code": code}])
+        try:
+            writer.write(out)
+            await writer.drain()
+        except (ConnectionError, OSError):
+            pass
+
+    async def _invoke(self, service: str, method: str, payload):
+        svc = self.services.get(service)
+        if svc is None:
+            raise RpcError(f"unknown service {service}", "NOT_FOUND")
+        fn = getattr(svc, f"rpc_{method}", None)
+        if fn is None:
+            raise RpcError(f"unknown method {service}.{method}", "NOT_FOUND")
+        self.calls_handled += 1
+        return await fn(payload)
+
+    async def call(self, addr: Tuple[str, int], service: str, method: str,
+                   payload: Any = None, timeout: float = 10.0) -> Any:
+        """Client call; local short-circuit when addr is our own server."""
+        self.calls_sent += 1
+        if self.addr is not None and tuple(addr) == tuple(self.addr):
+            return await asyncio.wait_for(
+                self._invoke(service, method, payload), timeout)
+        key = tuple(addr)
+        lock = self._conn_locks.setdefault(key, asyncio.Lock())
+        async with lock:
+            conn = self._conns.get(key)
+            if conn is None or conn.closed:
+                reader, writer = await asyncio.open_connection(*addr)
+                conn = Connection(reader, writer)
+                self._conns[key] = conn
+        try:
+            return await conn.call(service, method, payload, timeout)
+        except RpcError as e:
+            if e.code == "NETWORK_ERROR":
+                self._conns.pop(key, None)
+            raise
+
+    async def shutdown(self):
+        for c in self._conns.values():
+            c.close()
+        self._conns.clear()
+        for w in list(self._incoming):
+            try:
+                w.close()
+            except Exception:
+                pass
+        self._incoming.clear()
+        if self._server is not None:
+            self._server.close()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), 2.0)
+            except asyncio.TimeoutError:
+                pass
