@@ -1,0 +1,232 @@
+"""Cluster client: DDL via master, DML routed to tablet leaders.
+
+Analog of the reference's YBClient + MetaCache + Batcher (reference:
+src/yb/client/client.h:331, meta_cache.h:593 LookupTabletByKey,
+batcher.h:166 per-tablet op grouping, async_rpc.cc retry-on-NOT_LEADER).
+Scans fan out per tablet and combine partial aggregates client-side —
+the same combine pggate does (reference: pg_doc_op.h:117).
+"""
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..docdb.operations import ReadRequest, ReadResponse, RowOp, WriteRequest
+from ..docdb.table_codec import TableCodec, TableInfo
+from ..docdb.wire import (
+    read_request_to_wire, read_response_from_wire, write_request_to_wire,
+)
+from ..dockv.partition import Partition
+from ..rpc.messenger import Messenger, RpcError
+
+
+@dataclass
+class TabletLocation:
+    tablet_id: str
+    partition: Partition
+    replicas: List[Tuple[str, Tuple[str, int]]]   # (ts_uuid, addr)
+    leader: Optional[str] = None
+
+    def leader_addr(self) -> Optional[Tuple[str, int]]:
+        for u, a in self.replicas:
+            if u == self.leader:
+                return a
+        return None
+
+
+@dataclass
+class CachedTable:
+    info: TableInfo
+    codec: TableCodec
+    locations: List[TabletLocation]
+
+
+class YBClient:
+    def __init__(self, master_addr: Tuple[str, int],
+                 messenger: Optional[Messenger] = None):
+        self.master_addr = tuple(master_addr)
+        self.messenger = messenger or Messenger("client")
+        self._tables: Dict[str, CachedTable] = {}     # name -> cache
+
+    # --- DDL --------------------------------------------------------------
+    async def create_table(self, info: TableInfo, num_tablets: int = 2,
+                           replication_factor: int = 1) -> str:
+        resp = await self.messenger.call(
+            self.master_addr, "master", "create_table",
+            {"name": info.name, "table": info.to_wire(),
+             "num_tablets": num_tablets,
+             "replication_factor": replication_factor},
+            timeout=30.0)
+        return resp["table_id"]
+
+    async def drop_table(self, name: str) -> None:
+        await self.messenger.call(self.master_addr, "master", "drop_table",
+                                  {"name": name}, timeout=30.0)
+        self._tables.pop(name, None)
+
+    async def list_tables(self) -> List[dict]:
+        resp = await self.messenger.call(self.master_addr, "master",
+                                         "list_tables", {})
+        return resp["tables"]
+
+    # --- MetaCache --------------------------------------------------------
+    async def _table(self, name: str, refresh: bool = False) -> CachedTable:
+        if not refresh and name in self._tables:
+            return self._tables[name]
+        resp = await self.messenger.call(
+            self.master_addr, "master", "get_table", {"name": name})
+        info = TableInfo.from_wire(resp["table"])
+        locs = []
+        for l in resp["locations"]:
+            locs.append(TabletLocation(
+                tablet_id=l["tablet_id"],
+                partition=Partition(bytes.fromhex(l["partition"][0]),
+                                    bytes.fromhex(l["partition"][1])),
+                replicas=[(r["ts_uuid"], tuple(r["addr"]))
+                          for r in l["replicas"] if r["addr"]],
+                leader=l.get("leader")))
+        cached = CachedTable(info, TableCodec(info), locs)
+        self._tables[name] = cached
+        return cached
+
+    def _tablet_for_key(self, ct: CachedTable, row: dict) -> TabletLocation:
+        pk = ct.codec.pk_entries(row)
+        part_key = ct.info.partition_schema.partition_key_for_row(pk)
+        for loc in ct.locations:
+            if loc.partition.contains(part_key):
+                return loc
+        raise RpcError("no tablet covers key", "NOT_FOUND")
+
+    # --- DML: writes ------------------------------------------------------
+    async def write(self, table: str, ops: Sequence[RowOp]) -> int:
+        """Batcher: group ops per tablet, send in parallel, retry on
+        leadership changes."""
+        ct = await self._table(table)
+        by_tablet: Dict[str, List[RowOp]] = {}
+        for op in ops:
+            loc = self._tablet_for_key(ct, op.row)
+            by_tablet.setdefault(loc.tablet_id, []).append(op)
+
+        async def send(tablet_id: str, tops: List[RowOp]) -> int:
+            req = WriteRequest(ct.info.table_id, tops)
+            payload = {"tablet_id": tablet_id,
+                       "req": write_request_to_wire(req)}
+            return (await self._call_leader(ct, tablet_id, "write", payload)
+                    )["rows_affected"]
+
+        results = await asyncio.gather(
+            *[send(tid, tops) for tid, tops in by_tablet.items()])
+        return sum(results)
+
+    async def insert(self, table: str, rows: Sequence[dict]) -> int:
+        return await self.write(table, [RowOp("upsert", r) for r in rows])
+
+    async def delete(self, table: str, pk_rows: Sequence[dict]) -> int:
+        return await self.write(table, [RowOp("delete", r) for r in pk_rows])
+
+    # --- DML: reads -------------------------------------------------------
+    async def get(self, table: str, pk_row: dict) -> Optional[dict]:
+        ct = await self._table(table)
+        loc = self._tablet_for_key(ct, pk_row)
+        req = ReadRequest(ct.info.table_id, pk_eq=pk_row)
+        payload = {"tablet_id": loc.tablet_id,
+                   "req": read_request_to_wire(req)}
+        resp = read_response_from_wire(
+            await self._call_leader(ct, loc.tablet_id, "read", payload))
+        return resp.rows[0] if resp.rows else None
+
+    async def scan(self, table: str, req: ReadRequest) -> ReadResponse:
+        """Fan out to every tablet; combine rows or partial aggregates."""
+        ct = await self._table(table)
+        req.table_id = ct.info.table_id
+
+        async def one(loc: TabletLocation) -> ReadResponse:
+            rows: List[dict] = []
+            paging = None
+            first: Optional[ReadResponse] = None
+            while True:
+                r = ReadRequest(
+                    req.table_id, req.columns, req.where, req.aggregates,
+                    req.group_by, None, req.limit, paging, req.read_ht)
+                payload = {"tablet_id": loc.tablet_id,
+                           "req": read_request_to_wire(r)}
+                resp = read_response_from_wire(await self._call_leader(
+                    ct, loc.tablet_id, "read", payload))
+                if first is None:
+                    first = resp
+                rows.extend(resp.rows)
+                if resp.paging_state is None or req.aggregates:
+                    break
+                if req.limit is not None and len(rows) >= req.limit:
+                    break
+                paging = resp.paging_state
+            first.rows = rows
+            return first
+
+        parts = await asyncio.gather(*[one(l) for l in ct.locations])
+        return self._combine(req, parts)
+
+    def _combine(self, req: ReadRequest, parts: List[ReadResponse]
+                 ) -> ReadResponse:
+        if not req.aggregates:
+            rows = [r for p in parts for r in p.rows]
+            if req.limit is not None:
+                rows = rows[:req.limit]
+            return ReadResponse(rows=rows,
+                                backend=parts[0].backend if parts else "cpu")
+        from ..ops.scan import _expand_avg
+        aggs = _expand_avg(req.aggregates)
+        total = None
+        counts = None
+        for p in parts:
+            vals = [np.asarray(v) for v in p.agg_values]
+            if total is None:
+                total = vals
+                counts = (np.asarray(p.group_counts)
+                          if p.group_counts is not None else None)
+                continue
+            for i, a in enumerate(aggs):
+                if a.op in ("sum", "count"):
+                    total[i] = total[i] + vals[i]
+                elif a.op == "min":
+                    total[i] = np.minimum(total[i], vals[i])
+                else:
+                    total[i] = np.maximum(total[i], vals[i])
+            if counts is not None:
+                counts = counts + np.asarray(p.group_counts)
+        return ReadResponse(agg_values=tuple(total), group_counts=counts,
+                            backend=parts[0].backend if parts else "cpu")
+
+    # --- leader routing with retry ---------------------------------------
+    async def _call_leader(self, ct: CachedTable, tablet_id: str,
+                           method: str, payload, max_tries: int = 8):
+        loc = next(l for l in ct.locations if l.tablet_id == tablet_id)
+        last_err: Optional[Exception] = None
+        for attempt in range(max_tries):
+            addrs = []
+            la = loc.leader_addr()
+            if la is not None:
+                addrs.append(la)
+            addrs += [a for _, a in loc.replicas if a not in addrs]
+            for addr in addrs:
+                try:
+                    return await self.messenger.call(
+                        addr, "tserver", method, payload, timeout=10.0)
+                except RpcError as e:
+                    last_err = e
+                    if e.code in ("LEADER_NOT_READY", "LEADER_HAS_NO_LEASE",
+                                  "NOT_FOUND", "NETWORK_ERROR",
+                                  "SERVICE_UNAVAILABLE"):
+                        continue
+                    raise
+                except (asyncio.TimeoutError, OSError) as e:
+                    last_err = e
+                    continue
+            # refresh locations (leadership moved / tablet moved)
+            await asyncio.sleep(0.1 * (attempt + 1))
+            ct2 = await self._table(ct.info.name, refresh=True)
+            loc = next(l for l in ct2.locations if l.tablet_id == tablet_id)
+        raise last_err or RpcError("exhausted retries", "TIMED_OUT")

@@ -52,6 +52,30 @@ class TableInfo:
     def packing(self) -> SchemaPacking:
         return self.packings.get(self.schema.version)
 
+    def to_wire(self) -> dict:
+        return {
+            "table_id": self.table_id, "name": self.name,
+            "schema": {
+                "version": self.schema.version,
+                "columns": [[c.id, c.name, c.type, c.nullable, c.is_hash_key,
+                             c.is_range_key, c.sort_desc]
+                            for c in self.schema.columns],
+            },
+            "partition": {"kind": self.partition_schema.kind,
+                          "num_hash_columns":
+                              self.partition_schema.num_hash_columns},
+        }
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "TableInfo":
+        schema = TableSchema(
+            columns=tuple(ColumnSchema(*row)
+                          for row in d["schema"]["columns"]),
+            version=d["schema"]["version"])
+        return cls(d["table_id"], d["name"], schema,
+                   PartitionSchema(d["partition"]["kind"],
+                                   d["partition"]["num_hash_columns"]))
+
 
 _KEV_MAKER = {
     ColumnType.INT32: KeyEntryValue.int32,

@@ -1,0 +1,94 @@
+"""Wire (msgpack-dict) codecs for DocDB requests/responses.
+
+The pgsql_protocol.proto analog (reference:
+src/yb/common/pgsql_protocol.proto:430-565) — requests carry projection,
+pushdown expression AST, aggregate specs, group spec, paging state.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..ops.scan import AggSpec, GroupSpec
+from .operations import ReadRequest, ReadResponse, RowOp, WriteRequest, \
+    WriteResponse
+
+
+def _expr_to_wire(node):
+    if node is None:
+        return None
+    return list(node) if not isinstance(node, list) else node
+
+
+def _expr_from_wire(node):
+    if node is None:
+        return None
+    out = []
+    for x in node:
+        out.append(_expr_from_wire(x) if isinstance(x, list) else x)
+    return tuple(out)
+
+
+def write_request_to_wire(req: WriteRequest) -> dict:
+    return {"table_id": req.table_id,
+            "ops": [[o.kind, o.row] for o in req.ops]}
+
+
+def write_request_from_wire(d: dict) -> WriteRequest:
+    return WriteRequest(d["table_id"],
+                        [RowOp(k, r) for k, r in d["ops"]])
+
+
+def read_request_to_wire(req: ReadRequest) -> dict:
+    return {
+        "table_id": req.table_id,
+        "columns": list(req.columns),
+        "where": _expr_to_wire(req.where),
+        "aggregates": [[a.op, _expr_to_wire(a.expr)] for a in req.aggregates],
+        "group_by": list(req.group_by.cols) if req.group_by else None,
+        "pk_eq": req.pk_eq,
+        "limit": req.limit,
+        "paging_state": req.paging_state,
+        "read_ht": req.read_ht,
+    }
+
+
+def read_request_from_wire(d: dict) -> ReadRequest:
+    return ReadRequest(
+        table_id=d["table_id"],
+        columns=tuple(d.get("columns") or ()),
+        where=_expr_from_wire(d.get("where")),
+        aggregates=tuple(AggSpec(op, _expr_from_wire(e))
+                         for op, e in (d.get("aggregates") or [])),
+        group_by=(GroupSpec(tuple(tuple(c) for c in d["group_by"]))
+                  if d.get("group_by") else None),
+        pk_eq=d.get("pk_eq"),
+        limit=d.get("limit"),
+        paging_state=d.get("paging_state"),
+        read_ht=d.get("read_ht"),
+    )
+
+
+def read_response_to_wire(resp: ReadResponse) -> dict:
+    return {
+        "rows": resp.rows,
+        "agg_values": ([np.asarray(v).tolist() for v in resp.agg_values]
+                       if resp.agg_values is not None else None),
+        "group_counts": (np.asarray(resp.group_counts).tolist()
+                         if resp.group_counts is not None else None),
+        "paging_state": resp.paging_state,
+        "backend": resp.backend,
+    }
+
+
+def read_response_from_wire(d: dict) -> ReadResponse:
+    return ReadResponse(
+        rows=d.get("rows") or [],
+        agg_values=(tuple(np.asarray(v) for v in d["agg_values"])
+                    if d.get("agg_values") is not None else None),
+        group_counts=(np.asarray(d["group_counts"])
+                      if d.get("group_counts") is not None else None),
+        paging_state=d.get("paging_state"),
+        backend=d.get("backend", "cpu"),
+    )

@@ -1,0 +1,211 @@
+"""Master: the control plane.
+
+Analog of the reference's yb-master (reference: src/yb/master/ —
+CatalogManager catalog_manager.cc:4444 CreateTable, TS registry
+ts_manager.cc, heartbeats master_heartbeat_service.cc:403, sys catalog
+sys_catalog.cc). This round persists the sys catalog as an atomically-
+replaced JSON snapshot journaled through the same Raft log type used by
+tablets (single-master group); multi-master Raft is a planned round-2
+step — the state machine boundary (`_apply_catalog_mutation`) is
+already shaped for it.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+import uuid as uuidlib
+from typing import Dict, List, Optional, Tuple
+
+from ..docdb.table_codec import TableInfo
+from ..dockv.packed_row import ColumnSchema, TableSchema
+from ..dockv.partition import PartitionSchema
+from ..rpc.messenger import Messenger, RpcError
+from ..utils import flags
+
+TS_LIVENESS_S = 3.0
+
+
+class Master:
+    def __init__(self, fs_root: str):
+        self.fs_root = fs_root
+        os.makedirs(fs_root, exist_ok=True)
+        self.messenger = Messenger("master")
+        # sys catalog state
+        self.tables: Dict[str, dict] = {}      # table_id -> entry
+        self.tablets: Dict[str, dict] = {}     # tablet_id -> entry
+        self.tservers: Dict[str, dict] = {}    # ts_uuid -> {addr, last_hb}
+        self._load()
+        self.messenger.register_service("master", self)
+        self.messenger.register_service("master-heartbeat", self)
+        self._lb_task: Optional[asyncio.Task] = None
+        self._running = False
+
+    # --- persistence (sys catalog snapshot) -------------------------------
+    @property
+    def _catalog_path(self) -> str:
+        return os.path.join(self.fs_root, "sys_catalog.json")
+
+    def _load(self):
+        if os.path.exists(self._catalog_path):
+            with open(self._catalog_path) as f:
+                d = json.load(f)
+            self.tables = d["tables"]
+            self.tablets = d["tablets"]
+
+    def _persist(self):
+        tmp = self._catalog_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"tables": self.tables, "tablets": self.tablets}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._catalog_path)
+
+    # --- lifecycle --------------------------------------------------------
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        await self.messenger.start(host, port)
+        self._running = True
+        return self.messenger.addr
+
+    async def shutdown(self):
+        self._running = False
+        if self._lb_task:
+            self._lb_task.cancel()
+        await self.messenger.shutdown()
+
+    # --- TS registry ------------------------------------------------------
+    async def rpc_ts_heartbeat(self, payload) -> dict:
+        uuid = payload["ts_uuid"]
+        self.tservers[uuid] = {
+            "addr": tuple(payload["addr"]),
+            "last_hb": time.monotonic(),
+            "tablets": payload.get("tablets", []),
+        }
+        # track leadership reports for client routing
+        for t in payload.get("tablets", []):
+            ent = self.tablets.get(t["tablet_id"])
+            if ent is not None and t["is_leader"]:
+                ent["leader"] = uuid
+        return {"ok": True, "leader_master": True}
+
+    def live_tservers(self) -> List[str]:
+        now = time.monotonic()
+        return [u for u, d in self.tservers.items()
+                if now - d["last_hb"] < TS_LIVENESS_S]
+
+    async def rpc_list_tservers(self, payload) -> dict:
+        return {"tservers": {
+            u: {"addr": list(d["addr"]),
+                "live": u in self.live_tservers(),
+                "num_tablets": len(d.get("tablets", []))}
+            for u, d in self.tservers.items()}}
+
+    # --- DDL --------------------------------------------------------------
+    async def rpc_create_table(self, payload) -> dict:
+        """CreateTable: compute partitions, pick replica sets, create
+        tablets on tservers, commit to the catalog (reference:
+        catalog_manager.cc:4444)."""
+        name = payload["name"]
+        if any(t["info"]["name"] == name for t in self.tables.values()):
+            raise RpcError(f"table {name} exists", "ALREADY_PRESENT")
+        num_tablets = payload.get("num_tablets", 2)
+        rf = payload.get("replication_factor", 1)
+        live = self.live_tservers()
+        if len(live) < rf:
+            raise RpcError(
+                f"need {rf} live tservers, have {len(live)}",
+                "SERVICE_UNAVAILABLE")
+        table_id = payload.get("table_id") or f"tbl-{uuidlib.uuid4().hex[:12]}"
+        info_wire = dict(payload["table"])
+        info_wire["table_id"] = table_id
+        info = TableInfo.from_wire(info_wire)
+        parts = info.partition_schema.create_partitions(num_tablets)
+        tablet_entries = {}
+        for i, p in enumerate(parts):
+            tablet_id = f"{table_id}-t{i}"
+            replicas = self._choose_replicas(live, rf, i)
+            tablet_entries[tablet_id] = {
+                "tablet_id": tablet_id, "table_id": table_id,
+                "partition": [p.start.hex(), p.end.hex()],
+                "replicas": replicas, "leader": None,
+            }
+        # create replicas on tservers
+        for tablet_id, ent in tablet_entries.items():
+            raft_peers = [[u, list(self.tservers[u]["addr"])]
+                          for u in ent["replicas"]]
+            for u in ent["replicas"]:
+                await self.messenger.call(
+                    self.tservers[u]["addr"], "tserver", "create_tablet",
+                    {"tablet_id": tablet_id, "table": info_wire,
+                     "partition": ent["partition"],
+                     "raft_peers": raft_peers},
+                    timeout=10.0)
+        self.tables[table_id] = {"info": info_wire,
+                                 "tablets": list(tablet_entries)}
+        self.tablets.update(tablet_entries)
+        self._persist()
+        return {"table_id": table_id, "tablets": list(tablet_entries)}
+
+    def _choose_replicas(self, live: List[str], rf: int, salt: int
+                         ) -> List[str]:
+        """Least-loaded placement (cluster_balance.cc analog, static)."""
+        by_load = sorted(
+            live, key=lambda u: (len(self.tservers[u].get("tablets", [])),
+                                 hash((u, salt)) & 0xFFFF))
+        return by_load[:rf]
+
+    async def rpc_drop_table(self, payload) -> dict:
+        name = payload["name"]
+        tid = next((t for t, e in self.tables.items()
+                    if e["info"]["name"] == name), None)
+        if tid is None:
+            raise RpcError(f"table {name} not found", "NOT_FOUND")
+        for tablet_id in self.tables[tid]["tablets"]:
+            ent = self.tablets.pop(tablet_id, None)
+            if not ent:
+                continue
+            for u in ent["replicas"]:
+                ts = self.tservers.get(u)
+                if ts:
+                    try:
+                        await self.messenger.call(
+                            ts["addr"], "tserver", "delete_tablet",
+                            {"tablet_id": tablet_id}, timeout=5.0)
+                    except (RpcError, asyncio.TimeoutError, OSError):
+                        pass
+        del self.tables[tid]
+        self._persist()
+        return {"ok": True}
+
+    # --- lookups ----------------------------------------------------------
+    async def rpc_get_table(self, payload) -> dict:
+        name = payload.get("name")
+        table_id = payload.get("table_id")
+        for tid, e in self.tables.items():
+            if tid == table_id or e["info"]["name"] == name:
+                return {"table": e["info"],
+                        "locations": self._locations(tid)}
+        raise RpcError(f"table {name or table_id} not found", "NOT_FOUND")
+
+    def _locations(self, table_id: str) -> List[dict]:
+        out = []
+        for tablet_id in self.tables[table_id]["tablets"]:
+            ent = self.tablets[tablet_id]
+            out.append({
+                "tablet_id": tablet_id,
+                "partition": ent["partition"],
+                "replicas": [
+                    {"ts_uuid": u,
+                     "addr": list(self.tservers[u]["addr"])
+                     if u in self.tservers else None}
+                    for u in ent["replicas"]],
+                "leader": ent.get("leader"),
+            })
+        return out
+
+    async def rpc_list_tables(self, payload) -> dict:
+        return {"tables": [
+            {"table_id": tid, "name": e["info"]["name"],
+             "num_tablets": len(e["tablets"])}
+            for tid, e in self.tables.items()]}

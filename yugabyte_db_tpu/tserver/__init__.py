@@ -1,0 +1,1 @@
+from .tablet_server import TabletServer  # noqa: F401

@@ -1,0 +1,180 @@
+"""TabletServer: the data node.
+
+Analog of the reference's yb-tserver (reference:
+src/yb/tserver/tablet_server.cc, tablet_service.cc — Read :2769, Write
+:2724; ts_tablet_manager.cc for tablet lifecycle; heartbeater.cc for
+master heartbeats). Hosts TabletPeers, serves the tablet service RPCs,
+persists per-tablet metadata for restart, and heartbeats tablet reports
+to the master.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from ..consensus import PeerSpec, RaftConfig
+from ..docdb.table_codec import TableInfo
+from ..docdb.wire import (
+    read_request_from_wire, read_response_to_wire, write_request_from_wire,
+)
+from ..dockv.partition import Partition
+from ..rpc.messenger import Messenger, RpcError
+from ..tablet.tablet import Tablet
+from ..tablet.tablet_peer import TabletPeer
+from ..utils import flags
+from ..utils.hybrid_time import HybridClock
+
+
+class TabletServer:
+    def __init__(self, uuid: str, fs_root: str,
+                 master_addrs: Optional[List[Tuple[str, int]]] = None):
+        self.uuid = uuid
+        self.fs_root = fs_root
+        self.master_addrs = master_addrs or []
+        os.makedirs(fs_root, exist_ok=True)
+        self.messenger = Messenger(f"ts-{uuid}")
+        self.clock = HybridClock()
+        self.peers: Dict[str, TabletPeer] = {}
+        self._hb_task: Optional[asyncio.Task] = None
+        self._running = False
+        self.messenger.register_service("tserver", self)
+
+    # --- lifecycle --------------------------------------------------------
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        await self.messenger.start(host, port)
+        await self._open_existing_tablets()
+        self._running = True
+        if self.master_addrs:
+            self._hb_task = asyncio.create_task(self._heartbeat_loop())
+        return self.messenger.addr
+
+    async def shutdown(self):
+        self._running = False
+        if self._hb_task:
+            self._hb_task.cancel()
+        for p in self.peers.values():
+            await p.shutdown()
+        await self.messenger.shutdown()
+
+    # --- tablet management (TSTabletManager analog) -----------------------
+    def _tablet_dir(self, tablet_id: str) -> str:
+        return os.path.join(self.fs_root, "tablets", tablet_id)
+
+    async def _open_existing_tablets(self):
+        root = os.path.join(self.fs_root, "tablets")
+        if not os.path.isdir(root):
+            return
+        for tablet_id in sorted(os.listdir(root)):
+            meta_path = os.path.join(root, tablet_id, "tablet-meta.json")
+            if not os.path.exists(meta_path):
+                continue
+            with open(meta_path) as f:
+                meta = json.load(f)
+            await self._open_tablet(meta)
+
+    async def _open_tablet(self, meta: dict) -> TabletPeer:
+        info = TableInfo.from_wire(meta["table"])
+        tablet_id = meta["tablet_id"]
+        part = Partition(bytes.fromhex(meta["partition"][0]),
+                         bytes.fromhex(meta["partition"][1]))
+        tablet = Tablet(tablet_id, info, self._tablet_dir(tablet_id),
+                        clock=self.clock, partition=part)
+        config = RaftConfig([PeerSpec(u, tuple(a))
+                             for u, a in meta["raft_peers"]])
+        peer = TabletPeer(tablet, self.uuid, config, self.messenger,
+                          clock=self.clock)
+        self.peers[tablet_id] = peer
+        await peer.start()
+        return peer
+
+    async def rpc_create_tablet(self, payload) -> dict:
+        tablet_id = payload["tablet_id"]
+        if tablet_id in self.peers:
+            return {"ok": True, "existing": True}
+        d = self._tablet_dir(tablet_id)
+        os.makedirs(d, exist_ok=True)
+        meta = {
+            "tablet_id": tablet_id,
+            "table": payload["table"],
+            "partition": payload["partition"],
+            "raft_peers": payload["raft_peers"],
+        }
+        with open(os.path.join(d, "tablet-meta.json"), "w") as f:
+            json.dump(meta, f)
+        await self._open_tablet(meta)
+        return {"ok": True}
+
+    async def rpc_delete_tablet(self, payload) -> dict:
+        tablet_id = payload["tablet_id"]
+        peer = self.peers.pop(tablet_id, None)
+        if peer:
+            await peer.shutdown()
+        import shutil
+        shutil.rmtree(self._tablet_dir(tablet_id), ignore_errors=True)
+        return {"ok": True}
+
+    # --- data-path RPCs ---------------------------------------------------
+    def _peer(self, tablet_id: str) -> TabletPeer:
+        peer = self.peers.get(tablet_id)
+        if peer is None:
+            raise RpcError(f"tablet {tablet_id} not found", "NOT_FOUND")
+        return peer
+
+    async def rpc_write(self, payload) -> dict:
+        peer = self._peer(payload["tablet_id"])
+        req = write_request_from_wire(payload["req"])
+        resp = await peer.write(req)
+        return {"rows_affected": resp.rows_affected}
+
+    async def rpc_read(self, payload) -> dict:
+        peer = self._peer(payload["tablet_id"])
+        req = read_request_from_wire(payload["req"])
+        resp = peer.read(req)
+        return read_response_to_wire(resp)
+
+    async def rpc_flush(self, payload) -> dict:
+        peer = self._peer(payload["tablet_id"])
+        return {"path": peer.tablet.flush()}
+
+    async def rpc_compact(self, payload) -> dict:
+        peer = self._peer(payload["tablet_id"])
+        return {"path": peer.tablet.compact()}
+
+    async def rpc_status(self, payload) -> dict:
+        return {
+            "uuid": self.uuid,
+            "tablets": {
+                tid: {"leader": p.is_leader(),
+                      "size": p.tablet.approximate_size(),
+                      "ssts": p.tablet.num_sst_files()}
+                for tid, p in self.peers.items()
+            },
+        }
+
+    # --- heartbeats -------------------------------------------------------
+    async def _heartbeat_loop(self):
+        while self._running:
+            await self._heartbeat_once()
+            await asyncio.sleep(0.2)
+
+    async def _heartbeat_once(self):
+        report = {
+            "ts_uuid": self.uuid,
+            "addr": list(self.messenger.addr),
+            "tablets": [
+                {"tablet_id": tid, "is_leader": p.is_leader(),
+                 "size_bytes": p.tablet.approximate_size(),
+                 "num_ssts": p.tablet.num_sst_files()}
+                for tid, p in self.peers.items()
+            ],
+        }
+        for addr in self.master_addrs:
+            try:
+                await self.messenger.call(tuple(addr), "master-heartbeat",
+                                          "ts_heartbeat", report,
+                                          timeout=2.0)
+                return
+            except (RpcError, asyncio.TimeoutError, OSError):
+                continue
