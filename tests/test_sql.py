@@ -1,0 +1,146 @@
+"""SQL layer tests: parser + end-to-end SQL over a MiniCluster
+(reference analog: PG regress-style coverage at mini scale,
+java/yb-pgsql BasePgSQLTest)."""
+import asyncio
+
+import pytest
+
+from yugabyte_db_tpu.ql import SqlSession, parse_statement
+from yugabyte_db_tpu.ql.parser import (
+    CreateTableStmt, InsertStmt, SelectStmt,
+)
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+
+class TestParser:
+    def test_create_table(self):
+        s = parse_statement(
+            "CREATE TABLE t (k bigint, v double, s text, "
+            "PRIMARY KEY (k)) WITH tablets = 4 WITH replication = 3")
+        assert isinstance(s, CreateTableStmt)
+        assert s.columns == [("k", "bigint"), ("v", "double"),
+                             ("s", "text")]
+        assert s.primary_key == ["k"]
+        assert s.num_tablets == 4 and s.replication_factor == 3
+
+    def test_insert_multirow(self):
+        s = parse_statement(
+            "INSERT INTO t (k, v) VALUES (1, 2.5), (2, -3.5), (3, NULL)")
+        assert isinstance(s, InsertStmt)
+        assert s.rows == [[1, 2.5], [2, -3.5], [3, None]]
+
+    def test_select_full(self):
+        s = parse_statement(
+            "SELECT sum(v * (1 - d)) AS rev, count(*), k FROM t "
+            "WHERE v < 10 AND d BETWEEN 0.05 AND 0.07 OR NOT k IN (1,2) "
+            "GROUP BY k ORDER BY k DESC LIMIT 5")
+        assert isinstance(s, SelectStmt)
+        assert s.items[0][0] == "agg" and s.items[0][1] == "sum"
+        assert s.items[1] == ("agg", "count", None)
+        assert s.group_by == ["k"]
+        assert s.order_by == [("k", True)]
+        assert s.limit == 5
+
+    def test_string_literals_and_escapes(self):
+        s = parse_statement("INSERT INTO t (s) VALUES ('it''s')")
+        assert s.rows == [["it's"]]
+
+    def test_errors(self):
+        with pytest.raises(ValueError):
+            parse_statement("CREATE TABLE t (k bigint)")  # no PK
+        with pytest.raises(ValueError):
+            parse_statement("SELEC * FROM t")
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    return str(tmp_path)
+
+
+async def _session(root, n=1):
+    mc = await MiniCluster(root, num_tservers=n).start()
+    return mc, SqlSession(mc.client())
+
+
+class TestSqlEndToEnd:
+    def test_ddl_dml_select(self, cluster):
+        async def go():
+            mc, s = await _session(cluster)
+            try:
+                await s.execute(
+                    "CREATE TABLE items (k bigint, qty double, price double,"
+                    " flag int, name text, PRIMARY KEY (k)) WITH tablets = 2")
+                await mc.wait_for_leaders("items")
+                await s.execute(
+                    "INSERT INTO items (k, qty, price, flag, name) VALUES "
+                    + ", ".join(f"({i}, {i * 0.5}, {100 - i}, {i % 3}, "
+                                f"'n{i}')" for i in range(30)))
+                r = await s.execute("SELECT * FROM items WHERE k = 7")
+                assert r.rows[0]["name"] == "n7"
+                r = await s.execute(
+                    "SELECT k, qty FROM items WHERE qty > 10 "
+                    "ORDER BY k LIMIT 4")
+                assert [row["k"] for row in r.rows] == [21, 22, 23, 24]
+                r = await s.execute(
+                    "SELECT sum(qty * price) AS x, count(*), avg(qty) "
+                    "FROM items WHERE flag = 1")
+                expect = sum(i * 0.5 * (100 - i) for i in range(30)
+                             if i % 3 == 1)
+                assert abs(r.rows[0]["sum"] - expect) < 1e-6
+                assert r.rows[0]["count"] == 10
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_group_by_clientside_and_pushdown(self, cluster):
+        async def go():
+            mc, s = await _session(cluster)
+            try:
+                await s.execute(
+                    "CREATE TABLE g (k bigint, v double, f int, "
+                    "PRIMARY KEY (k))")
+                await mc.wait_for_leaders("g")
+                await s.execute(
+                    "INSERT INTO g (k, v, f) VALUES "
+                    + ", ".join(f"({i}, {float(i)}, {i % 4})"
+                                for i in range(40)))
+                r1 = await s.execute(
+                    "SELECT f, sum(v), count(*) FROM g GROUP BY f "
+                    "ORDER BY f")
+                assert len(r1.rows) == 4
+                assert r1.rows[0]["sum_v"] == sum(range(0, 40, 4))
+                # now declare stats → device-eligible pushdown path
+                s.stats["g"] = {"f": (4, 0)}
+                r2 = await s.execute(
+                    "SELECT f, sum(v), count(*) FROM g GROUP BY f "
+                    "ORDER BY f")
+                for a, b in zip(r1.rows, r2.rows):
+                    assert a["f"] == b["f"]
+                    assert abs(a["sum_v"] - b["sum_v"]) < 1e-6
+                    assert a["count"] == b["count"]
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_update_delete(self, cluster):
+        async def go():
+            mc, s = await _session(cluster)
+            try:
+                await s.execute(
+                    "CREATE TABLE u (k bigint, v double, PRIMARY KEY (k))")
+                await mc.wait_for_leaders("u")
+                await s.execute("INSERT INTO u (k, v) VALUES (1, 1), (2, 2),"
+                                " (3, 3)")
+                await s.execute("UPDATE u SET v = 99 WHERE k = 2")
+                r = await s.execute("SELECT v FROM u WHERE k = 2")
+                assert r.rows[0]["v"] == 99.0
+                await s.execute("DELETE FROM u WHERE v < 2")
+                r = await s.execute("SELECT count(*) FROM u")
+                assert r.rows[0]["count"] == 2
+            finally:
+                await mc.shutdown()
+        run(go())

@@ -1,0 +1,2 @@
+from .parser import parse_statement  # noqa: F401
+from .executor import SqlSession  # noqa: F401

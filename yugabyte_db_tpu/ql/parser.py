@@ -1,0 +1,446 @@
+"""SQL-subset parser.
+
+The YSQL surface this round: CREATE TABLE / DROP TABLE / INSERT /
+SELECT (projection, aggregates, WHERE, GROUP BY, ORDER BY, LIMIT) /
+UPDATE / DELETE. The reference embeds a full PostgreSQL
+(src/postgres/); our round-1 front end is a hand-rolled
+recursive-descent parser producing the same statement objects the
+executor compiles to DocDB requests — the seam where a full PG wire
+layer can slot in later (SURVEY.md §7 step 7 explicitly defers the PG
+fork until the engine is proven).
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+_TOKEN = re.compile(r"""
+    \s*(?:
+      (?P<num>-?\d+\.\d+(?:[eE][-+]?\d+)?|-?\d+)
+    | (?P<str>'(?:[^']|'')*')
+    | (?P<op><=|>=|<>|!=|[=<>(),;*+\-/])
+    | (?P<word>[A-Za-z_][A-Za-z0-9_.]*)
+    )""", re.VERBOSE)
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "order", "limit", "and",
+    "or", "not", "between", "in", "is", "null", "insert", "into",
+    "values", "create", "table", "primary", "key", "drop", "delete",
+    "update", "set", "asc", "desc", "count", "sum", "min", "max", "avg",
+    "as", "hash", "with", "tablets", "replication", "if", "exists",
+}
+
+
+def tokenize(sql: str) -> List[Tuple[str, str]]:
+    out = []
+    pos = 0
+    while pos < len(sql):
+        m = _TOKEN.match(sql, pos)
+        if not m:
+            if sql[pos:].strip() == "":
+                break
+            raise ValueError(f"bad token at: {sql[pos:pos+20]!r}")
+        pos = m.end()
+        if m.group("num") is not None:
+            out.append(("num", m.group("num")))
+        elif m.group("str") is not None:
+            out.append(("str", m.group("str")[1:-1].replace("''", "'")))
+        elif m.group("op") is not None:
+            out.append(("op", m.group("op")))
+        else:
+            w = m.group("word")
+            out.append(("kw" if w.lower() in KEYWORDS else "id", w))
+    return out
+
+
+# --- statement objects ------------------------------------------------------
+@dataclass
+class CreateTableStmt:
+    name: str
+    columns: List[Tuple[str, str]]            # (name, type)
+    primary_key: List[str]
+    num_hash: int = 1
+    num_tablets: int = 2
+    replication_factor: int = 1
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropTableStmt:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class InsertStmt:
+    table: str
+    columns: List[str]
+    rows: List[List[object]]
+
+
+@dataclass
+class SelectStmt:
+    table: str
+    # each item: ('col', name) | ('agg', op, expr|None) | ('star',)
+    items: List[tuple]
+    where: Optional[tuple] = None             # AST over column NAMES
+    group_by: List[str] = field(default_factory=list)
+    order_by: List[Tuple[str, bool]] = field(default_factory=list)
+    limit: Optional[int] = None
+
+
+@dataclass
+class DeleteStmt:
+    table: str
+    where: Optional[tuple] = None
+
+
+@dataclass
+class UpdateStmt:
+    table: str
+    sets: Dict[str, object] = field(default_factory=dict)
+    where: Optional[tuple] = None
+
+
+class Parser:
+    def __init__(self, tokens: List[Tuple[str, str]]):
+        self.toks = tokens
+        self.pos = 0
+
+    # -- token helpers --
+    def peek(self) -> Optional[Tuple[str, str]]:
+        return self.toks[self.pos] if self.pos < len(self.toks) else None
+
+    def next(self) -> Tuple[str, str]:
+        t = self.peek()
+        if t is None:
+            raise ValueError("unexpected end of statement")
+        self.pos += 1
+        return t
+
+    def accept_kw(self, *words) -> bool:
+        t = self.peek()
+        if t and t[0] == "kw" and t[1].lower() in words:
+            self.pos += 1
+            return True
+        return False
+
+    def expect_kw(self, word):
+        if not self.accept_kw(word):
+            raise ValueError(f"expected {word.upper()} at {self.peek()}")
+
+    def accept_op(self, op) -> bool:
+        t = self.peek()
+        if t and t[0] == "op" and t[1] == op:
+            self.pos += 1
+            return True
+        return False
+
+    def expect_op(self, op):
+        if not self.accept_op(op):
+            raise ValueError(f"expected {op!r} at {self.peek()}")
+
+    def ident(self) -> str:
+        t = self.next()
+        if t[0] not in ("id", "kw"):
+            raise ValueError(f"expected identifier, got {t}")
+        return t[1]
+
+    # -- statements --
+    def parse(self):
+        t = self.peek()
+        if t is None:
+            raise ValueError("empty statement")
+        word = t[1].lower()
+        fn = {
+            "create": self.create_table, "drop": self.drop_table,
+            "insert": self.insert, "select": self.select,
+            "delete": self.delete, "update": self.update,
+        }.get(word)
+        if fn is None:
+            raise ValueError(f"unsupported statement {word!r}")
+        stmt = fn()
+        self.accept_op(";")
+        if self.peek() is not None:
+            raise ValueError(f"trailing tokens at {self.peek()}")
+        return stmt
+
+    def create_table(self):
+        self.expect_kw("create")
+        self.expect_kw("table")
+        ine = False
+        if self.accept_kw("if"):
+            self.expect_kw("not") if False else None
+            # IF NOT EXISTS: "not" is tokenized as kw
+            if not self.accept_kw("not"):
+                raise ValueError("expected NOT after IF")
+            self.expect_kw("exists")
+            ine = True
+        name = self.ident()
+        self.expect_op("(")
+        cols: List[Tuple[str, str]] = []
+        pk: List[str] = []
+        num_hash = 1
+        while True:
+            if self.accept_kw("primary"):
+                self.expect_kw("key")
+                self.expect_op("(")
+                # optional HASH (cols) syntax: first N cols are hash cols
+                pk_cols = []
+                while True:
+                    pk_cols.append(self.ident())
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+                pk = pk_cols
+            else:
+                cname = self.ident()
+                ctype = self.ident().lower()
+                cols.append((cname, ctype))
+                if self.accept_kw("primary"):
+                    self.expect_kw("key")
+                    pk = [cname]
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        num_tablets, rf = 2, 1
+        while self.accept_kw("with"):
+            k = self.ident().lower()
+            self.expect_op("=")
+            v = int(self.next()[1])
+            if k == "tablets":
+                num_tablets = v
+            elif k == "replication":
+                rf = v
+        if not pk:
+            raise ValueError("PRIMARY KEY required")
+        return CreateTableStmt(name, cols, pk, num_hash, num_tablets, rf,
+                               ine)
+
+    def drop_table(self):
+        self.expect_kw("drop")
+        self.expect_kw("table")
+        ie = False
+        if self.accept_kw("if"):
+            self.expect_kw("exists")
+            ie = True
+        return DropTableStmt(self.ident(), ie)
+
+    def insert(self):
+        self.expect_kw("insert")
+        self.expect_kw("into")
+        table = self.ident()
+        cols = []
+        if self.accept_op("("):
+            while True:
+                cols.append(self.ident())
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+        self.expect_kw("values")
+        rows = []
+        while True:
+            self.expect_op("(")
+            row = []
+            while True:
+                row.append(self.literal())
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            rows.append(row)
+            if not self.accept_op(","):
+                break
+        return InsertStmt(table, cols, rows)
+
+    def literal(self):
+        t = self.next()
+        if t[0] == "num":
+            return float(t[1]) if ("." in t[1] or "e" in t[1].lower()) \
+                else int(t[1])
+        if t[0] == "str":
+            return t[1]
+        if t[0] == "kw" and t[1].lower() == "null":
+            return None
+        if t[0] == "op" and t[1] == "-":
+            v = self.literal()
+            return -v
+        raise ValueError(f"expected literal, got {t}")
+
+    def select(self):
+        self.expect_kw("select")
+        items = []
+        while True:
+            if self.accept_op("*"):
+                items.append(("star",))
+            else:
+                t = self.peek()
+                if t[0] == "kw" and t[1].lower() in ("count", "sum", "min",
+                                                     "max", "avg"):
+                    op = self.next()[1].lower()
+                    self.expect_op("(")
+                    if self.accept_op("*"):
+                        expr = None
+                    else:
+                        expr = self.expr()
+                    self.expect_op(")")
+                    if self.accept_kw("as"):
+                        self.ident()
+                    items.append(("agg", op, expr))
+                else:
+                    expr = self.expr()
+                    if self.accept_kw("as"):
+                        self.ident()
+                    if expr[0] == "col":
+                        items.append(("col", expr[1]))
+                    else:
+                        items.append(("expr", expr))
+            if not self.accept_op(","):
+                break
+        self.expect_kw("from")
+        table = self.ident()
+        where = None
+        if self.accept_kw("where"):
+            where = self.expr()
+        group = []
+        if self.accept_kw("group"):
+            self.expect_kw("by")
+            while True:
+                group.append(self.ident())
+                if not self.accept_op(","):
+                    break
+        order = []
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            while True:
+                col = self.ident()
+                desc = False
+                if self.accept_kw("desc"):
+                    desc = True
+                else:
+                    self.accept_kw("asc")
+                order.append((col, desc))
+                if not self.accept_op(","):
+                    break
+        limit = None
+        if self.accept_kw("limit"):
+            limit = int(self.next()[1])
+        return SelectStmt(table, items, where, group, order, limit)
+
+    def delete(self):
+        self.expect_kw("delete")
+        self.expect_kw("from")
+        table = self.ident()
+        where = None
+        if self.accept_kw("where"):
+            where = self.expr()
+        return DeleteStmt(table, where)
+
+    def update(self):
+        self.expect_kw("update")
+        table = self.ident()
+        self.expect_kw("set")
+        sets = {}
+        while True:
+            col = self.ident()
+            self.expect_op("=")
+            sets[col] = self.literal()
+            if not self.accept_op(","):
+                break
+        where = None
+        if self.accept_kw("where"):
+            where = self.expr()
+        return UpdateStmt(table, sets, where)
+
+    # -- expressions over column NAMES (bound to ids later) --
+    def expr(self):
+        return self.or_expr()
+
+    def or_expr(self):
+        left = self.and_expr()
+        while self.accept_kw("or"):
+            left = ("or", left, self.and_expr())
+        return left
+
+    def and_expr(self):
+        left = self.not_expr()
+        while self.accept_kw("and"):
+            left = ("and", left, self.not_expr())
+        return left
+
+    def not_expr(self):
+        if self.accept_kw("not"):
+            return ("not", self.not_expr())
+        return self.cmp_expr()
+
+    def cmp_expr(self):
+        left = self.add_expr()
+        t = self.peek()
+        if t and t[0] == "op" and t[1] in ("=", "<>", "!=", "<", "<=", ">",
+                                           ">="):
+            op = self.next()[1]
+            right = self.add_expr()
+            opname = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt",
+                      "<=": "le", ">": "gt", ">=": "ge"}[op]
+            return ("cmp", opname, left, right)
+        if t and t[0] == "kw" and t[1].lower() == "between":
+            self.next()
+            lo = self.add_expr()
+            self.expect_kw("and")
+            hi = self.add_expr()
+            return ("between", left, lo, hi)
+        if t and t[0] == "kw" and t[1].lower() == "in":
+            self.next()
+            self.expect_op("(")
+            vals = []
+            while True:
+                vals.append(self.literal())
+                if not self.accept_op(","):
+                    break
+            self.expect_op(")")
+            return ("in", left, vals)
+        if t and t[0] == "kw" and t[1].lower() == "is":
+            self.next()
+            neg = self.accept_kw("not")
+            self.expect_kw("null")
+            node = ("isnull", left)
+            return ("not", node) if neg else node
+        return left
+
+    def add_expr(self):
+        left = self.mul_expr()
+        while True:
+            if self.accept_op("+"):
+                left = ("arith", "add", left, self.mul_expr())
+            elif self.accept_op("-"):
+                left = ("arith", "sub", left, self.mul_expr())
+            else:
+                return left
+
+    def mul_expr(self):
+        left = self.unary_expr()
+        while True:
+            if self.accept_op("*"):
+                left = ("arith", "mul", left, self.unary_expr())
+            elif self.accept_op("/"):
+                left = ("arith", "div", left, self.unary_expr())
+            else:
+                return left
+
+    def unary_expr(self):
+        if self.accept_op("("):
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        t = self.peek()
+        if t[0] in ("num", "str") or (t[0] == "kw"
+                                      and t[1].lower() == "null"):
+            return ("const", self.literal())
+        if t[0] == "op" and t[1] == "-":
+            return ("const", self.literal())
+        name = self.ident()
+        return ("col", name)
+
+
+def parse_statement(sql: str):
+    return Parser(tokenize(sql)).parse()
