@@ -1,0 +1,180 @@
+"""Distributed transaction tests: atomic cross-tablet commit, abort,
+read-your-writes, snapshot isolation, write-write conflicts
+(reference analog: transaction parts of
+src/yb/client/ql-transaction-test.cc at mini scale)."""
+import asyncio
+
+import pytest
+
+from yugabyte_db_tpu.client import YBTransaction
+from yugabyte_db_tpu.docdb import ReadRequest
+from yugabyte_db_tpu.docdb.table_codec import TableInfo
+from yugabyte_db_tpu.dockv.packed_row import (
+    ColumnSchema, ColumnType, TableSchema,
+)
+from yugabyte_db_tpu.dockv.partition import PartitionSchema
+from yugabyte_db_tpu.ops import AggSpec, Expr
+from yugabyte_db_tpu.rpc import RpcError
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+C = Expr.col
+
+
+def kv_info(name="acct"):
+    schema = TableSchema(columns=(
+        ColumnSchema(0, "k", ColumnType.INT64, is_hash_key=True),
+        ColumnSchema(1, "bal", ColumnType.FLOAT64),
+    ), version=1)
+    return TableInfo("", name, schema, PartitionSchema("hash", 1))
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def make_cluster(root, n=1, tablets=4):
+    mc = await MiniCluster(root, num_tservers=n).start()
+    c = mc.client()
+    await c.create_table(kv_info(), num_tablets=tablets,
+                         replication_factor=1)
+    await mc.wait_for_leaders("acct")
+    await c.insert("acct", [{"k": i, "bal": 100.0} for i in range(20)])
+    # ensure the status tablet exists and has a leader
+    await c.messenger.call(mc.master.messenger.addr, "master",
+                           "get_status_tablet", {})
+    await mc.wait_for_leaders("system.transactions")
+    return mc, c
+
+
+class TestTransactions:
+    def test_commit_across_tablets(self, tmp_path):
+        async def go():
+            mc, c = await make_cluster(str(tmp_path))
+            try:
+                txn = await c.transaction().begin()
+                # money transfer across (very likely) different tablets
+                await txn.insert("acct", [{"k": 1, "bal": 50.0},
+                                          {"k": 2, "bal": 150.0}])
+                # not visible before commit
+                assert (await c.get("acct", {"k": 1}))["bal"] == 100.0
+                await txn.commit()
+                await asyncio.sleep(0.3)   # async participant apply
+                assert (await c.get("acct", {"k": 1}))["bal"] == 50.0
+                assert (await c.get("acct", {"k": 2}))["bal"] == 150.0
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_abort_discards(self, tmp_path):
+        async def go():
+            mc, c = await make_cluster(str(tmp_path))
+            try:
+                txn = await c.transaction().begin()
+                await txn.insert("acct", [{"k": 3, "bal": 0.0}])
+                await txn.abort()
+                await asyncio.sleep(0.3)
+                assert (await c.get("acct", {"k": 3}))["bal"] == 100.0
+                # second txn can now lock the same key
+                txn2 = await c.transaction().begin()
+                await txn2.insert("acct", [{"k": 3, "bal": 7.0}])
+                await txn2.commit()
+                await asyncio.sleep(0.3)
+                assert (await c.get("acct", {"k": 3}))["bal"] == 7.0
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_read_your_own_writes(self, tmp_path):
+        async def go():
+            mc, c = await make_cluster(str(tmp_path))
+            try:
+                txn = await c.transaction().begin()
+                await txn.insert("acct", [{"k": 5, "bal": 1.0}])
+                row = await txn.get("acct", {"k": 5})
+                assert row["bal"] == 1.0
+                # snapshot read of an untouched key
+                row2 = await txn.get("acct", {"k": 6})
+                assert row2["bal"] == 100.0
+                await txn.abort()
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_snapshot_isolation_read_point(self, tmp_path):
+        async def go():
+            mc, c = await make_cluster(str(tmp_path))
+            try:
+                txn = await c.transaction().begin()
+                _ = await txn.get("acct", {"k": 7})
+                # concurrent committed write AFTER txn start
+                await c.insert("acct", [{"k": 7, "bal": 999.0}])
+                row = await txn.get("acct", {"k": 7})
+                assert row["bal"] == 100.0   # still the snapshot value
+                await txn.abort()
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_write_write_conflict_waits_then_succeeds(self, tmp_path):
+        async def go():
+            mc, c = await make_cluster(str(tmp_path))
+            try:
+                t1 = await c.transaction().begin()
+                t2 = await c.transaction().begin()
+                await t1.insert("acct", [{"k": 9, "bal": 1.0}])
+
+                async def t2_write():
+                    await t2.insert("acct", [{"k": 9, "bal": 2.0}])
+                    await t2.commit()
+
+                task = asyncio.create_task(t2_write())
+                await asyncio.sleep(0.3)
+                assert not task.done()       # t2 is waiting on t1's intent
+                await t1.commit()
+                await asyncio.wait_for(task, 10.0)
+                await asyncio.sleep(0.3)
+                assert (await c.get("acct", {"k": 9}))["bal"] == 2.0
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_conflict_timeout_aborts(self, tmp_path):
+        async def go():
+            mc, c = await make_cluster(str(tmp_path))
+            try:
+                # shrink wait timeout on every participant
+                for ts in mc.tservers:
+                    for p in ts.peers.values():
+                        p.participant.wait_timeout = 0.5
+                t1 = await c.transaction().begin()
+                t2 = await c.transaction().begin()
+                await t1.insert("acct", [{"k": 11, "bal": 1.0}])
+                with pytest.raises(RpcError):
+                    await t2.insert("acct", [{"k": 11, "bal": 2.0}])
+                await t1.commit()
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_coordinator_survives_in_raft_log(self, tmp_path):
+        async def go():
+            mc, c = await make_cluster(str(tmp_path))
+            try:
+                txn = await c.transaction().begin()
+                await txn.insert("acct", [{"k": 13, "bal": 55.0}])
+                await txn.commit()
+                await asyncio.sleep(0.3)
+                # restart the whole tserver: coordinator state must rebuild
+                # from the status tablet's Raft log
+                await mc.restart_tserver(0)
+                await mc.wait_for_leaders("acct")
+                await mc.wait_for_leaders("system.transactions")
+                c2 = mc.client()
+                assert (await c2.get("acct", {"k": 13}))["bal"] == 55.0
+                ts = mc.tservers[0]
+                coord = next(p.coordinator for p in ts.peers.values()
+                             if p.coordinator is not None)
+                assert coord.txns[txn.txn_id]["status"] == "COMMITTED"
+            finally:
+                await mc.shutdown()
+        run(go())

@@ -40,6 +40,20 @@ __version__ = "0.1.0"
 # Hybrid times and key hashes are 64-bit; JAX must carry u64 end-to-end.
 # (TPU emulates 64-bit integer ops; the scan kernels only use them for
 # visibility compares, which are negligible next to the f32 aggregate work.)
+import os as _os  # noqa: E402
+
 import jax as _jax  # noqa: E402
 
 _jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: TPU sort/scan kernels are expensive to
+# compile (tens of seconds over the tunnel); cache them across processes.
+_cache_dir = _os.environ.get(
+    "YBTPU_COMPILE_CACHE",
+    _os.path.join(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+                  ".jax_cache"))
+try:
+    _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:  # older jax without the knob — fine, just slower
+    pass

@@ -1,1 +1,2 @@
 from .client import YBClient  # noqa: F401
+from .transaction import YBTransaction  # noqa: F401

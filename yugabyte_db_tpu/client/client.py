@@ -200,6 +200,11 @@ class YBClient:
         return ReadResponse(agg_values=tuple(total), group_counts=counts,
                             backend=parts[0].backend if parts else "cpu")
 
+    # --- transactions ------------------------------------------------------
+    def transaction(self):
+        from .transaction import YBTransaction
+        return YBTransaction(self)
+
     # --- leader routing with retry ---------------------------------------
     async def _call_leader(self, ct: CachedTable, tablet_id: str,
                            method: str, payload, max_tries: int = 8):

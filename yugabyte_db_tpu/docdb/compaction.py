@@ -99,12 +99,8 @@ def _compact_columnar(store, codec, blocks: List[ColumnarBlock],
     tomb = np.concatenate([b.tombstone for b in blocks])
     dk, ht, wid = split_ht_suffix(keys)
     dk_words = keys_to_words(dk)
-    order, keep = merge_gc_split_kernel(
-        jnp.asarray(dk_words), jnp.asarray(ht), jnp.asarray(wid),
-        jnp.asarray(tomb), jnp.ones(len(keys), bool),
-        jnp.uint64(cutoff), num_dk_words=dk_words.shape[1])
-    order = np.asarray(order)
-    keep = np.asarray(keep)
+    from ..ops.compaction import run_merge_gc
+    order, keep = run_merge_gc(dk_words, ht, wid, tomb, cutoff)
     sel = order[keep]                       # kept rows, in sorted key order
 
     # concatenate all columns once, then gather

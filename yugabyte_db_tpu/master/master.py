@@ -131,6 +131,7 @@ class Master:
                 "replicas": replicas, "leader": None,
             }
         # create replicas on tservers
+        is_status = payload.get("is_status_tablet", False)
         for tablet_id, ent in tablet_entries.items():
             raft_peers = [[u, list(self.tservers[u]["addr"])]
                           for u in ent["replicas"]]
@@ -139,7 +140,8 @@ class Master:
                     self.tservers[u]["addr"], "tserver", "create_tablet",
                     {"tablet_id": tablet_id, "table": info_wire,
                      "partition": ent["partition"],
-                     "raft_peers": raft_peers},
+                     "raft_peers": raft_peers,
+                     "is_status_tablet": is_status},
                     timeout=10.0)
         self.tables[table_id] = {"info": info_wire,
                                  "tablets": list(tablet_entries)}
@@ -203,6 +205,27 @@ class Master:
                 "leader": ent.get("leader"),
             })
         return out
+
+    async def rpc_get_status_tablet(self, payload) -> dict:
+        """Return (creating on demand) the transaction status tablet
+        (reference: client-side status-tablet picking,
+        client/transaction_pool.cc; system `transactions` table)."""
+        name = "system.transactions"
+        for tid, e in self.tables.items():
+            if e["info"]["name"] == name:
+                return {"locations": self._locations(tid)}
+        live = self.live_tservers()
+        rf = min(3, len(live)) or 1
+        info = TableInfo(
+            "", name,
+            TableSchema(columns=(
+                ColumnSchema(0, "txn_id", "string", is_hash_key=True),),
+                version=1),
+            PartitionSchema("hash", 1))
+        resp = await self.rpc_create_table({
+            "name": name, "table": info.to_wire(), "num_tablets": 1,
+            "replication_factor": rf, "is_status_tablet": True})
+        return {"locations": self._locations(resp["table_id"])}
 
     async def rpc_list_tables(self, payload) -> dict:
         return {"tables": [

@@ -183,6 +183,42 @@ def merge_gc_split_kernel(dk_words: jnp.ndarray,   # [N, Wd]
     return order, keep
 
 
+def _pad_rows(n: int) -> int:
+    """Row-count bucket (pow2) so the jitted merge kernel compiles once
+    per bucket, not once per input size."""
+    b = 1 << 12
+    while b < n:
+        b <<= 1
+    return b
+
+
+def run_merge_gc(dk_words: np.ndarray, ht: np.ndarray, wid: np.ndarray,
+                 tomb: np.ndarray, history_cutoff: int
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+    """Bucket-padded driver for merge_gc_split_kernel. Padding rows carry
+    valid=False, sort last, and are never kept; the returned (order, keep)
+    are already stripped back to the true row count."""
+    n = dk_words.shape[0]
+    padded = _pad_rows(n)
+    if padded != n:
+        dk_words = np.concatenate(
+            [dk_words, np.zeros((padded - n, dk_words.shape[1]), np.uint64)])
+        ht = np.concatenate([ht, np.zeros(padded - n, np.uint64)])
+        wid = np.concatenate([wid, np.zeros(padded - n, np.uint32)])
+        tomb = np.concatenate([tomb, np.zeros(padded - n, bool)])
+    valid = np.zeros(padded, bool)
+    valid[:n] = True
+    order, keep = merge_gc_split_kernel(
+        jnp.asarray(dk_words), jnp.asarray(ht), jnp.asarray(wid),
+        jnp.asarray(tomb), jnp.asarray(valid), jnp.uint64(history_cutoff),
+        num_dk_words=dk_words.shape[1])
+    order = np.asarray(order)
+    keep = np.asarray(keep)
+    # all padding sorts to the tail with keep=False; stripping the tail
+    # keeps indices in range
+    return order[:n], keep[:n]
+
+
 def compact_runs(runs: Sequence[Tuple[np.ndarray, np.ndarray]],
                  history_cutoff: int) -> Tuple[np.ndarray, np.ndarray]:
     """Merge+GC across sorted runs of differing key widths.
@@ -191,9 +227,4 @@ def compact_runs(runs: Sequence[Tuple[np.ndarray, np.ndarray]],
     the runs in the given order."""
     dk_padded, ht, wid, tomb = concat_runs(runs)
     dk_words = keys_to_words(dk_padded)
-    valid = np.ones(dk_words.shape[0], bool)
-    order, keep = merge_gc_split_kernel(
-        jnp.asarray(dk_words), jnp.asarray(ht), jnp.asarray(wid),
-        jnp.asarray(tomb), jnp.asarray(valid), jnp.uint64(history_cutoff),
-        num_dk_words=dk_words.shape[1])
-    return np.asarray(order), np.asarray(keep)
+    return run_merge_gc(dk_words, ht, wid, tomb, history_cutoff)

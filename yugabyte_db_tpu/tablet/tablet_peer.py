@@ -27,7 +27,9 @@ from .tablet import Tablet
 
 class TabletPeer:
     def __init__(self, tablet: Tablet, uuid: str, config: RaftConfig,
-                 messenger: Messenger, clock: Optional[HybridClock] = None):
+                 messenger: Messenger, clock: Optional[HybridClock] = None,
+                 is_status_tablet: bool = False):
+        from .transactions import TransactionCoordinator, TransactionParticipant
         self.tablet = tablet
         self.uuid = uuid
         self.clock = clock or tablet.clock
@@ -36,6 +38,9 @@ class TabletPeer:
         self.consensus = RaftConsensus(
             tablet.tablet_id, uuid, config, self.log, messenger,
             tablet.dir, self._apply_entry, clock=self.clock)
+        self.participant = TransactionParticipant(self)
+        self.coordinator = (TransactionCoordinator(self, messenger)
+                            if is_status_tablet else None)
 
     # --- lifecycle --------------------------------------------------------
     async def start(self):
@@ -74,6 +79,14 @@ class TabletPeer:
     async def _apply_entry(self, entry: LogEntry):
         if entry.etype == "write":
             self._apply_payload(entry)
+        elif entry.etype == "txn_intents":
+            self.participant.apply_intent_entry(entry.payload)
+        elif entry.etype == "txn_apply":
+            self.participant.apply_commit_entry(entry.payload)
+        elif entry.etype == "txn_rollback":
+            self.participant.apply_rollback_entry(entry.payload)
+        elif entry.etype == "txn_status" and self.coordinator is not None:
+            self.coordinator.apply_entry(entry.payload)
 
     def _apply_payload(self, entry: LogEntry):
         d = msgpack.unpackb(entry.payload, raw=False)
@@ -96,3 +109,26 @@ class TabletPeer:
 
     def is_leader(self) -> bool:
         return self.consensus.is_leader()
+
+    # --- transactional write path ------------------------------------------
+    async def write_txn(self, req: WriteRequest, txn_id: str,
+                        start_ht: int) -> int:
+        if not self.consensus.is_leader():
+            raise RpcError(
+                f"not leader (hint={self.consensus.leader_hint()})",
+                "LEADER_NOT_READY")
+        return await self.participant.write_intents(req, txn_id, start_ht)
+
+    async def apply_txn(self, txn_id: str, commit_ht: int):
+        import msgpack as _mp
+        await self.consensus.replicate("txn_apply", _mp.packb(
+            {"txn_id": txn_id, "commit_ht": commit_ht}))
+
+    async def rollback_txn(self, txn_id: str):
+        import msgpack as _mp
+        await self.consensus.replicate("txn_rollback", _mp.packb(
+            {"txn_id": txn_id}))
+
+    def read_own_intent(self, txn_id: str, pk_row: dict):
+        doc_key = self.tablet.codec.doc_key_prefix(pk_row)
+        return self.participant.own_intent(txn_id, doc_key)

