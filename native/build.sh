@@ -1,0 +1,7 @@
+#!/bin/sh
+# Build the native storage library (see ybtpu_native.cpp).
+set -e
+cd "$(dirname "$0")"
+g++ -O3 -march=native -std=c++17 -shared -fPIC \
+    ybtpu_native.cpp -o libybtpu_native.so
+echo "built $(pwd)/libybtpu_native.so"

@@ -1,0 +1,257 @@
+// Native host-side hot paths for the storage engine.
+//
+// The reference implements its entire storage layer in C++ (reference:
+// src/yb/rocksdb/, src/yb/util/ — block building, bloom filters, hashing,
+// the merging iterator). Our TPU engine keeps bulk work vectorized in
+// numpy/XLA, but four host paths remain per-row and hot:
+//   - FNV-1a hashing of variable-length keys (bloom + device dedup ids)
+//   - KV block encode/decode (shared-prefix compression, varint framing)
+//   - bloom filter build/probe
+//   - k-way merge of sorted runs (CPU compaction fallback, point reads)
+// This library implements them in C++ with a C ABI consumed via ctypes
+// (no pybind11 in the image). Python fallbacks remain for portability;
+// tests exercise both.
+//
+// Build: see native/build.sh (g++ -O3 -shared -fPIC).
+
+#include <cstdint>
+#include <cstring>
+#include <queue>
+#include <vector>
+
+extern "C" {
+
+// --------------------------------------------------------------------------
+// FNV-1a 64-bit over variable-length rows.
+// keys: concatenated bytes; offsets: n+1 u64 boundaries; out: n u64 hashes.
+// --------------------------------------------------------------------------
+void fnv64_batch(const uint8_t* keys, const uint64_t* offsets, int64_t n,
+                 uint64_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        uint64_t h = 0xCBF29CE484222325ULL;
+        for (uint64_t p = offsets[i]; p < offsets[i + 1]; ++p) {
+            h = (h ^ keys[p]) * 0x100000001B3ULL;
+        }
+        out[i] = h;
+    }
+}
+
+// --------------------------------------------------------------------------
+// Varint helpers
+// --------------------------------------------------------------------------
+static inline size_t put_uvarint(uint8_t* dst, uint64_t v) {
+    size_t i = 0;
+    while (v >= 0x80) {
+        dst[i++] = (uint8_t)(v) | 0x80;
+        v >>= 7;
+    }
+    dst[i++] = (uint8_t)v;
+    return i;
+}
+
+static inline uint64_t get_uvarint(const uint8_t* src, size_t* pos) {
+    uint64_t v = 0;
+    int shift = 0;
+    for (;;) {
+        uint8_t b = src[(*pos)++];
+        v |= (uint64_t)(b & 0x7F) << shift;
+        if (!(b & 0x80)) return v;
+        shift += 7;
+    }
+}
+
+// --------------------------------------------------------------------------
+// KV block encode: shared-prefix compressed entries.
+// Inputs: concatenated keys/values + offsets (n+1 each).
+// Output buffer must be large enough (use block_encode_bound).
+// Returns encoded size.
+// Layout: u32 count, then per entry: uvarint shared, uvarint unshared,
+// uvarint vlen, key suffix, value. (Matches storage/sst.py::_encode_block.)
+// --------------------------------------------------------------------------
+int64_t block_encode_bound(const uint64_t* koff, const uint64_t* voff,
+                           int64_t n) {
+    return 4 + (int64_t)(koff[n] + voff[n]) + n * 30;
+}
+
+int64_t block_encode(const uint8_t* keys, const uint64_t* koff,
+                     const uint8_t* vals, const uint64_t* voff,
+                     int64_t n, uint8_t* out) {
+    size_t pos = 0;
+    out[pos++] = (uint8_t)(n & 0xFF);
+    out[pos++] = (uint8_t)((n >> 8) & 0xFF);
+    out[pos++] = (uint8_t)((n >> 16) & 0xFF);
+    out[pos++] = (uint8_t)((n >> 24) & 0xFF);
+    const uint8_t* prev = nullptr;
+    size_t prev_len = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        const uint8_t* k = keys + koff[i];
+        size_t klen = koff[i + 1] - koff[i];
+        size_t shared = 0;
+        size_t lim = prev_len < klen ? prev_len : klen;
+        while (shared < lim && prev[shared] == k[shared]) ++shared;
+        size_t vlen = voff[i + 1] - voff[i];
+        pos += put_uvarint(out + pos, shared);
+        pos += put_uvarint(out + pos, klen - shared);
+        pos += put_uvarint(out + pos, vlen);
+        memcpy(out + pos, k + shared, klen - shared);
+        pos += klen - shared;
+        memcpy(out + pos, vals + voff[i], vlen);
+        pos += vlen;
+        prev = k;
+        prev_len = klen;
+    }
+    return (int64_t)pos;
+}
+
+// --------------------------------------------------------------------------
+// KV block decode: emits concatenated keys/values + offsets.
+// Caller sizes outputs via block_decode_sizes (returns n, total key bytes,
+// total value bytes).
+// --------------------------------------------------------------------------
+void block_decode_sizes(const uint8_t* data, int64_t len, int64_t* out_n,
+                        int64_t* out_kbytes, int64_t* out_vbytes) {
+    size_t pos = 0;
+    uint32_t n = data[0] | (data[1] << 8) | (data[2] << 16) |
+                 ((uint32_t)data[3] << 24);
+    pos = 4;
+    size_t kb = 0, vb = 0;
+    size_t prev_klen = 0;
+    for (uint32_t i = 0; i < n; ++i) {
+        uint64_t shared = get_uvarint(data, &pos);
+        uint64_t unshared = get_uvarint(data, &pos);
+        uint64_t vlen = get_uvarint(data, &pos);
+        prev_klen = shared + unshared;
+        kb += prev_klen;
+        vb += vlen;
+        pos += unshared + vlen;
+    }
+    *out_n = n;
+    *out_kbytes = (int64_t)kb;
+    *out_vbytes = (int64_t)vb;
+    (void)len;
+}
+
+void block_decode(const uint8_t* data, int64_t len, uint8_t* keys,
+                  uint64_t* koff, uint8_t* vals, uint64_t* voff) {
+    size_t pos = 0;
+    uint32_t n = data[0] | (data[1] << 8) | (data[2] << 16) |
+                 ((uint32_t)data[3] << 24);
+    pos = 4;
+    size_t kpos = 0, vpos = 0;
+    koff[0] = 0;
+    voff[0] = 0;
+    const uint8_t* prev_key = nullptr;
+    for (uint32_t i = 0; i < n; ++i) {
+        uint64_t shared = get_uvarint(data, &pos);
+        uint64_t unshared = get_uvarint(data, &pos);
+        uint64_t vlen = get_uvarint(data, &pos);
+        if (shared) memcpy(keys + kpos, prev_key, shared);
+        memcpy(keys + kpos + shared, data + pos, unshared);
+        pos += unshared;
+        prev_key = keys + kpos;
+        kpos += shared + unshared;
+        koff[i + 1] = kpos;
+        memcpy(vals + vpos, data + pos, vlen);
+        pos += vlen;
+        vpos += vlen;
+        voff[i + 1] = vpos;
+    }
+    (void)len;
+}
+
+// --------------------------------------------------------------------------
+// Bloom filter (double hashing, matches storage/sst.py::BloomFilter)
+// --------------------------------------------------------------------------
+void bloom_build(const uint64_t* hashes, int64_t n, uint8_t* bits,
+                 int64_t nbits, int32_t k) {
+    for (int64_t i = 0; i < n; ++i) {
+        uint64_t h1 = hashes[i];
+        uint64_t h2 = (h1 >> 33) | 1ULL;
+        for (int32_t j = 0; j < k; ++j) {
+            uint64_t idx = (h1 + (uint64_t)j * h2) % (uint64_t)nbits;
+            bits[idx >> 3] |= (uint8_t)(1u << (idx & 7));
+        }
+    }
+}
+
+void bloom_probe(const uint64_t* hashes, int64_t n, const uint8_t* bits,
+                 int64_t nbits, int32_t k, uint8_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        uint64_t h1 = hashes[i];
+        uint64_t h2 = (h1 >> 33) | 1ULL;
+        uint8_t hit = 1;
+        for (int32_t j = 0; j < k && hit; ++j) {
+            uint64_t idx = (h1 + (uint64_t)j * h2) % (uint64_t)nbits;
+            hit = (bits[idx >> 3] >> (idx & 7)) & 1;
+        }
+        out[i] = hit;
+    }
+}
+
+// --------------------------------------------------------------------------
+// K-way merge of sorted runs of byte keys. Runs are concatenated:
+// run r covers rows [run_starts[r], run_starts[r+1]). Keys via
+// (keys, offsets) like fnv64_batch. Emits the global row indices in merged
+// order, skipping exact duplicates after the first (earlier run wins; pass
+// runs newest-first). Returns count emitted.
+// --------------------------------------------------------------------------
+struct HeapItem {
+    const uint8_t* key;
+    uint64_t klen;
+    int32_t run;
+    int64_t row;     // global row index
+};
+
+static int key_cmp(const uint8_t* a, uint64_t alen, const uint8_t* b,
+                   uint64_t blen) {
+    size_t lim = alen < blen ? alen : blen;
+    int c = memcmp(a, b, lim);
+    if (c) return c;
+    return alen < blen ? -1 : (alen > blen ? 1 : 0);
+}
+
+struct HeapCmp {
+    bool operator()(const HeapItem& a, const HeapItem& b) const {
+        int c = key_cmp(a.key, a.klen, b.key, b.klen);
+        if (c) return c > 0;          // min-heap by key
+        return a.run > b.run;         // tie: lower run index first (newest)
+    }
+};
+
+int64_t kway_merge(const uint8_t* keys, const uint64_t* offsets,
+                   const int64_t* run_starts, int32_t num_runs,
+                   int64_t* out_indices, uint8_t* out_dup) {
+    std::priority_queue<HeapItem, std::vector<HeapItem>, HeapCmp> heap;
+    std::vector<int64_t> cursor(num_runs);
+    for (int32_t r = 0; r < num_runs; ++r) {
+        cursor[r] = run_starts[r];
+        if (cursor[r] < run_starts[r + 1]) {
+            heap.push({keys + offsets[cursor[r]],
+                       offsets[cursor[r] + 1] - offsets[cursor[r]], r,
+                       cursor[r]});
+        }
+    }
+    int64_t emitted = 0;
+    const uint8_t* last_key = nullptr;
+    uint64_t last_len = 0;
+    while (!heap.empty()) {
+        HeapItem it = heap.top();
+        heap.pop();
+        bool dup = last_key &&
+                   key_cmp(it.key, it.klen, last_key, last_len) == 0;
+        out_indices[emitted] = it.row;
+        out_dup[emitted] = dup ? 1 : 0;
+        ++emitted;
+        last_key = it.key;
+        last_len = it.klen;
+        int32_t r = it.run;
+        if (++cursor[r] < run_starts[r + 1]) {
+            heap.push({keys + offsets[cursor[r]],
+                       offsets[cursor[r] + 1] - offsets[cursor[r]], r,
+                       cursor[r]});
+        }
+    }
+    return emitted;
+}
+
+}  // extern "C"

@@ -51,6 +51,10 @@ def fnv64_keys(keys: Sequence[bytes]) -> np.ndarray:
     block-local padding (required for cross-block/SST dedup joins)."""
     if not keys:
         return np.zeros(0, np.uint64)
+    from . import native_lib
+    nat = native_lib.fnv64_batch(keys)
+    if nat is not None:
+        return nat
     lens = np.array([len(k) for k in keys], np.int64)
     w = int(lens.max())
     mat = np.zeros((len(keys), w), np.uint8)

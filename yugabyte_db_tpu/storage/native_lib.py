@@ -1,0 +1,170 @@
+"""ctypes bindings for the native storage library (native/ybtpu_native.cpp).
+
+Auto-builds with g++ on first import when the .so is missing; every entry
+point has a pure-Python fallback in the storage layer, so environments
+without a toolchain still work. `available()` reports which path is live.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native")
+_SO = os.path.join(_NATIVE_DIR, "libybtpu_native.so")
+
+_u8p = ctypes.POINTER(ctypes.c_uint8)
+_u64p = ctypes.POINTER(ctypes.c_uint64)
+_i64p = ctypes.POINTER(ctypes.c_int64)
+
+
+def _build() -> bool:
+    src = os.path.join(_NATIVE_DIR, "ybtpu_native.cpp")
+    if not os.path.exists(src):
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", src,
+             "-o", _SO],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    if not os.path.exists(_SO) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    lib.fnv64_batch.argtypes = [_u8p, _u64p, ctypes.c_int64, _u64p]
+    lib.block_encode_bound.argtypes = [_u64p, _u64p, ctypes.c_int64]
+    lib.block_encode_bound.restype = ctypes.c_int64
+    lib.block_encode.argtypes = [_u8p, _u64p, _u8p, _u64p,
+                                 ctypes.c_int64, _u8p]
+    lib.block_encode.restype = ctypes.c_int64
+    lib.block_decode_sizes.argtypes = [_u8p, ctypes.c_int64, _i64p, _i64p,
+                                       _i64p]
+    lib.block_decode.argtypes = [_u8p, ctypes.c_int64, _u8p, _u64p, _u8p,
+                                 _u64p]
+    lib.bloom_build.argtypes = [_u64p, ctypes.c_int64, _u8p,
+                                ctypes.c_int64, ctypes.c_int32]
+    lib.bloom_probe.argtypes = [_u64p, ctypes.c_int64, _u8p,
+                                ctypes.c_int64, ctypes.c_int32, _u8p]
+    lib.kway_merge.argtypes = [_u8p, _u64p, _i64p, ctypes.c_int32, _i64p,
+                               _u8p]
+    lib.kway_merge.restype = ctypes.c_int64
+    _LIB = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(arr: np.ndarray, typ):
+    return arr.ctypes.data_as(typ)
+
+
+def _concat_with_offsets(items: Sequence[bytes]) -> Tuple[np.ndarray, np.ndarray]:
+    offsets = np.zeros(len(items) + 1, np.uint64)
+    np.cumsum([len(x) for x in items], out=offsets[1:])
+    buf = np.frombuffer(b"".join(items), np.uint8) if items else \
+        np.zeros(0, np.uint8)
+    return np.ascontiguousarray(buf), offsets
+
+
+def fnv64_batch(items: Sequence[bytes]) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    buf, off = _concat_with_offsets(items)
+    out = np.empty(len(items), np.uint64)
+    lib.fnv64_batch(_ptr(buf, _u8p), _ptr(off, _u64p), len(items),
+                    _ptr(out, _u64p))
+    return out
+
+
+def block_encode(entries: Sequence[Tuple[bytes, bytes]]) -> Optional[bytes]:
+    lib = _load()
+    if lib is None:
+        return None
+    kbuf, koff = _concat_with_offsets([k for k, _ in entries])
+    vbuf, voff = _concat_with_offsets([v for _, v in entries])
+    bound = lib.block_encode_bound(_ptr(koff, _u64p), _ptr(voff, _u64p),
+                                   len(entries))
+    out = np.empty(bound, np.uint8)
+    n = lib.block_encode(_ptr(kbuf, _u8p), _ptr(koff, _u64p),
+                         _ptr(vbuf, _u8p), _ptr(voff, _u64p),
+                         len(entries), _ptr(out, _u8p))
+    return out[:n].tobytes()
+
+
+def block_decode(data: bytes) -> Optional[List[Tuple[bytes, bytes]]]:
+    lib = _load()
+    if lib is None:
+        return None
+    buf = np.frombuffer(data, np.uint8)
+    n = ctypes.c_int64()
+    kb = ctypes.c_int64()
+    vb = ctypes.c_int64()
+    lib.block_decode_sizes(_ptr(buf, _u8p), len(data),
+                           ctypes.byref(n), ctypes.byref(kb),
+                           ctypes.byref(vb))
+    keys = np.empty(kb.value, np.uint8)
+    koff = np.empty(n.value + 1, np.uint64)
+    vals = np.empty(vb.value, np.uint8)
+    voff = np.empty(n.value + 1, np.uint64)
+    lib.block_decode(_ptr(buf, _u8p), len(data), _ptr(keys, _u8p),
+                     _ptr(koff, _u64p), _ptr(vals, _u8p), _ptr(voff, _u64p))
+    kraw = keys.tobytes()
+    vraw = vals.tobytes()
+    return [(kraw[int(koff[i]):int(koff[i + 1])],
+             vraw[int(voff[i]):int(voff[i + 1])]) for i in range(n.value)]
+
+
+def bloom_build(hashes: np.ndarray, nbits: int, k: int) -> Optional[np.ndarray]:
+    lib = _load()
+    if lib is None:
+        return None
+    hashes = np.ascontiguousarray(hashes, np.uint64)
+    bits = np.zeros(nbits // 8, np.uint8)
+    lib.bloom_build(_ptr(hashes, _u64p), len(hashes), _ptr(bits, _u8p),
+                    nbits, k)
+    return bits
+
+
+def kway_merge(runs: Sequence[Sequence[bytes]]
+               ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """runs: newest-first lists of sorted keys. Returns (global row order,
+    dup flags) across the concatenation of runs."""
+    lib = _load()
+    if lib is None:
+        return None
+    flat: List[bytes] = []
+    starts = [0]
+    for r in runs:
+        flat.extend(r)
+        starts.append(len(flat))
+    buf, off = _concat_with_offsets(flat)
+    run_starts = np.asarray(starts, np.int64)
+    out_idx = np.empty(len(flat), np.int64)
+    out_dup = np.empty(len(flat), np.uint8)
+    n = lib.kway_merge(_ptr(buf, _u8p), _ptr(off, _u64p),
+                       _ptr(run_starts, _i64p), len(runs),
+                       _ptr(out_idx, _i64p), _ptr(out_dup, _u8p))
+    return out_idx[:n], out_dup[:n].astype(bool)

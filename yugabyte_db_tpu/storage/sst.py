@@ -24,6 +24,7 @@ from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 import msgpack
 import numpy as np
 
+from . import native_lib
 from .columnar import ColumnarBlock, fnv64_bytes, fnv64_keys
 
 MAGIC = b"YBTPUSST"
@@ -73,7 +74,10 @@ class BloomFilter:
 
 
 def _encode_block(entries: Sequence[Tuple[bytes, bytes]]) -> bytes:
-    """Shared-prefix-compressed KV block."""
+    """Shared-prefix-compressed KV block (native fast path when built)."""
+    enc = native_lib.block_encode(entries)
+    if enc is not None:
+        return enc
     out = bytearray(struct.pack("<I", len(entries)))
     prev = b""
     for k, v in entries:
@@ -86,6 +90,9 @@ def _encode_block(entries: Sequence[Tuple[bytes, bytes]]) -> bytes:
 
 
 def _decode_block(data: bytes) -> List[Tuple[bytes, bytes]]:
+    dec = native_lib.block_decode(data)
+    if dec is not None:
+        return dec
     (n,) = struct.unpack_from("<I", data)
     pos = 4
     out: List[Tuple[bytes, bytes]] = []
