@@ -1,0 +1,154 @@
+"""CQL and Redis wire-protocol server tests using raw socket clients
+(reference analog: cql/redis server tests under
+src/yb/yql/cql/cqlserver and integration-tests)."""
+import asyncio
+import struct
+
+import pytest
+
+from yugabyte_db_tpu.ql.cql_server import CqlServer
+from yugabyte_db_tpu.ql.redis_server import RedisServer
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def cql_frame(writer, reader, opcode, body=b"", stream=1):
+    writer.write(struct.pack(">BBhBI", 0x04, 0, stream, opcode, len(body))
+                 + body)
+    await writer.drain()
+    hdr = await reader.readexactly(9)
+    _, _, rstream, ropcode = struct.unpack(">BBhB", hdr[:5])
+    (ln,) = struct.unpack(">I", hdr[5:9])
+    rbody = await reader.readexactly(ln) if ln else b""
+    return ropcode, rbody
+
+
+def longstr(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">i", len(b)) + b + struct.pack(">BH", 0, 0)
+
+
+class TestCqlServer:
+    def test_startup_query_rows(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            srv = CqlServer(mc.client())
+            addr = await srv.start()
+            try:
+                reader, writer = await asyncio.open_connection(*addr)
+                op, _ = await cql_frame(writer, reader, 0x01,
+                                        struct.pack(">H", 0))   # STARTUP
+                assert op == 0x02   # READY
+                op, _ = await cql_frame(
+                    writer, reader, 0x07,
+                    longstr("CREATE TABLE t (k bigint, v double, "
+                            "PRIMARY KEY (k))"))
+                assert op == 0x08
+                await mc.wait_for_leaders("t")
+                op, _ = await cql_frame(
+                    writer, reader, 0x07,
+                    longstr("INSERT INTO t (k, v) VALUES (1, 2.5), (2, 5.0)"))
+                assert op == 0x08
+                op, body = await cql_frame(
+                    writer, reader, 0x07,
+                    longstr("SELECT k, v FROM t WHERE k = 2"))
+                assert op == 0x08
+                (kind,) = struct.unpack(">i", body[:4])
+                assert kind == 2    # Rows
+                # decode: flags, colcount
+                flags, ncols = struct.unpack(">ii", body[4:12])
+                assert ncols == 2
+                writer.close()
+            finally:
+                await srv.shutdown()
+                await mc.shutdown()
+        run(go())
+
+    def test_error_frame_on_bad_sql(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            srv = CqlServer(mc.client())
+            addr = await srv.start()
+            try:
+                reader, writer = await asyncio.open_connection(*addr)
+                await cql_frame(writer, reader, 0x01, struct.pack(">H", 0))
+                op, body = await cql_frame(writer, reader, 0x07,
+                                           longstr("BOGUS STATEMENT"))
+                assert op == 0x00   # ERROR
+                writer.close()
+            finally:
+                await srv.shutdown()
+                await mc.shutdown()
+        run(go())
+
+
+class RedisClient:
+    def __init__(self, reader, writer):
+        self.reader, self.writer = reader, writer
+
+    async def cmd(self, *args):
+        out = b"*" + str(len(args)).encode() + b"\r\n"
+        for a in args:
+            b = a.encode() if isinstance(a, str) else a
+            out += b"$" + str(len(b)).encode() + b"\r\n" + b + b"\r\n"
+        self.writer.write(out)
+        await self.writer.drain()
+        return await self._read_reply()
+
+    async def _read_reply(self):
+        line = (await self.reader.readline()).strip()
+        t, rest = line[:1], line[1:]
+        if t == b"+":
+            return rest.decode()
+        if t == b"-":
+            raise RuntimeError(rest.decode())
+        if t == b":":
+            return int(rest)
+        if t == b"$":
+            n = int(rest)
+            if n < 0:
+                return None
+            data = await self.reader.readexactly(n)
+            await self.reader.readexactly(2)
+            return data.decode()
+        if t == b"*":
+            return [await self._read_reply() for _ in range(int(rest))]
+        raise RuntimeError(f"bad reply {line!r}")
+
+
+class TestRedisServer:
+    def test_string_and_hash_commands(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            srv = RedisServer(mc.client(), num_tablets=1)
+            addr = await srv.start()
+            try:
+                reader, writer = await asyncio.open_connection(*addr)
+                r = RedisClient(reader, writer)
+                assert await r.cmd("PING") == "PONG"
+                assert await r.cmd("SET", "a", "1") == "OK"
+                # redis table creation is lazy; wait for leaders
+                await mc.wait_for_leaders("system.redis_kv")
+                assert await r.cmd("GET", "a") == "1"
+                assert await r.cmd("GET", "missing") is None
+                assert await r.cmd("INCR", "a") == 2
+                assert await r.cmd("INCRBY", "a", "10") == 12
+                assert await r.cmd("MSET", "x", "xv", "y", "yv") == "OK"
+                assert await r.cmd("MGET", "x", "y", "zz") == \
+                    ["xv", "yv", None]
+                assert await r.cmd("DEL", "x") == 1
+                assert await r.cmd("EXISTS", "x") == 0
+                assert await r.cmd("HSET", "h", "f1", "v1", "f2", "v2") == 2
+                await mc.wait_for_leaders("system.redis_hash")
+                assert await r.cmd("HGET", "h", "f1") == "v1"
+                assert await r.cmd("HGETALL", "h") == \
+                    ["f1", "v1", "f2", "v2"]
+                assert await r.cmd("HDEL", "h", "f1") == 1
+                writer.close()
+            finally:
+                await srv.shutdown()
+                await mc.shutdown()
+        run(go())

@@ -1,0 +1,175 @@
+"""YCQL: CQL binary protocol (v4) server subset.
+
+Analog of the reference's CQL server (reference:
+src/yb/yql/cql/cqlserver/cql_server.cc, cql_processor.cc:244
+ProcessCall; frame handling in cqlserver/cql_message.cc). Implements the
+v4 wire framing and the STARTUP/OPTIONS/QUERY/PREPARE/EXECUTE opcodes,
+executing statements through the same SQL front end (the reference's
+QLProcessor parse/analyze/execute pipeline, ql/ql_processor.cc:449).
+Real Cassandra drivers can speak this subset (no auth, no compression,
+no paging frames yet).
+"""
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from ..client import YBClient
+from ..dockv.packed_row import ColumnType
+from .executor import SqlSession
+
+# opcodes
+OP_ERROR, OP_STARTUP, OP_READY, OP_AUTHENTICATE = 0x00, 0x01, 0x02, 0x03
+OP_OPTIONS, OP_SUPPORTED, OP_QUERY, OP_RESULT = 0x05, 0x06, 0x07, 0x08
+OP_PREPARE, OP_EXECUTE = 0x09, 0x0A
+
+# result kinds
+K_VOID, K_ROWS, K_SET_KS, K_PREPARED, K_SCHEMA = 1, 2, 3, 4, 5
+
+_CQL_TYPE = {
+    ColumnType.INT64: 0x02, ColumnType.BINARY: 0x03, ColumnType.BOOL: 0x04,
+    ColumnType.FLOAT64: 0x07, ColumnType.FLOAT32: 0x08,
+    ColumnType.INT32: 0x09, ColumnType.TIMESTAMP: 0x0B,
+    ColumnType.STRING: 0x0D, ColumnType.JSON: 0x0D,
+    ColumnType.DECIMAL: 0x0D,
+}
+
+
+def _string(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">H", len(b)) + b
+
+
+def _bytes_value(v, ctype: Optional[str]) -> bytes:
+    if v is None:
+        return struct.pack(">i", -1)
+    if isinstance(v, bool):
+        raw = b"\x01" if v else b"\x00"
+    elif isinstance(v, int):
+        raw = struct.pack(">q", v) if ctype in (None, ColumnType.INT64,
+                                                ColumnType.TIMESTAMP) \
+            else struct.pack(">i", v)
+    elif isinstance(v, float):
+        raw = struct.pack(">d", v)
+    elif isinstance(v, bytes):
+        raw = v
+    else:
+        raw = str(v).encode()
+    return struct.pack(">i", len(raw)) + raw
+
+
+class CqlServer:
+    def __init__(self, client: YBClient, host="127.0.0.1", port=0):
+        self.session = SqlSession(client)
+        self.host, self.port = host, port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._prepared: Dict[bytes, str] = {}
+        self._next_prep = 0
+        self.addr: Optional[Tuple[str, int]] = None
+
+    async def start(self):
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port)
+        self.addr = self._server.sockets[0].getsockname()[:2]
+        return self.addr
+
+    async def shutdown(self):
+        if self._server:
+            self._server.close()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter):
+        try:
+            while True:
+                hdr = await reader.readexactly(9)
+                version, flags, stream, opcode = struct.unpack(">BBhB",
+                                                               hdr[:5])
+                (length,) = struct.unpack(">I", hdr[5:9])
+                body = await reader.readexactly(length) if length else b""
+                resp = await self._process(opcode, body)
+                out_op, out_body = resp
+                writer.write(struct.pack(">BBhBI", 0x84, 0, stream, out_op,
+                                         len(out_body)) + out_body)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _process(self, opcode: int, body: bytes
+                       ) -> Tuple[int, bytes]:
+        try:
+            if opcode == OP_STARTUP:
+                return OP_READY, b""
+            if opcode == OP_OPTIONS:
+                # string multimap: CQL_VERSION -> 3.4.5
+                out = struct.pack(">H", 1) + _string("CQL_VERSION") + \
+                    struct.pack(">H", 1) + _string("3.4.5")
+                return OP_SUPPORTED, out
+            if opcode == OP_QUERY:
+                (qlen,) = struct.unpack(">i", body[:4])
+                sql = body[4:4 + qlen].decode()
+                return OP_RESULT, await self._run(sql)
+            if opcode == OP_PREPARE:
+                (qlen,) = struct.unpack(">i", body[:4])
+                sql = body[4:4 + qlen].decode()
+                pid = struct.pack(">I", self._next_prep)
+                self._next_prep += 1
+                self._prepared[pid] = sql
+                out = struct.pack(">i", K_PREPARED)
+                out += struct.pack(">H", len(pid)) + pid
+                # empty metadata + empty result metadata
+                out += struct.pack(">iii", 0, 0, 0)   # flags, cols, pk count
+                out += struct.pack(">ii", 0, 0)
+                return OP_RESULT, out
+            if opcode == OP_EXECUTE:
+                (plen,) = struct.unpack(">H", body[:2])
+                pid = body[2:2 + plen]
+                sql = self._prepared.get(pid)
+                if sql is None:
+                    return self._error(0x2500, "unprepared query")
+                return OP_RESULT, await self._run(sql)
+            return self._error(0x000A, f"unsupported opcode {opcode}")
+        except Exception as e:   # noqa: BLE001 — surface as CQL error frame
+            return self._error(0x2200, str(e))
+
+    def _error(self, code: int, msg: str) -> Tuple[int, bytes]:
+        return OP_ERROR, struct.pack(">i", code) + _string(msg)
+
+    async def _run(self, sql: str) -> bytes:
+        res = await self.session.execute(sql)
+        if not res.rows:
+            if res.status.startswith(("CREATE", "DROP")):
+                body = struct.pack(">i", K_SCHEMA)
+                body += _string("CREATED") + _string("TABLE") + \
+                    _string("ybtpu") + _string("t")
+                return body
+            return struct.pack(">i", K_VOID)
+        # rows result
+        cols = list(res.rows[0].keys())
+        body = struct.pack(">i", K_ROWS)
+        body += struct.pack(">i", 0x0001)          # global tables spec
+        body += struct.pack(">i", len(cols))
+        body += _string("ybtpu") + _string("t")
+        for c in cols:
+            body += _string(c)
+            v = res.rows[0][c]
+            tid = 0x0D
+            if isinstance(v, bool):
+                tid = 0x04
+            elif isinstance(v, int):
+                tid = 0x02
+            elif isinstance(v, float):
+                tid = 0x07
+            elif isinstance(v, bytes):
+                tid = 0x03
+            body += struct.pack(">H", tid)
+        body += struct.pack(">i", len(res.rows))
+        for r in res.rows:
+            for c in cols:
+                body += _bytes_value(r[c], None)
+        return body

@@ -1,0 +1,260 @@
+"""YEDIS: Redis (RESP2) wire protocol server.
+
+Analog of the reference's Redis server over DocDB (reference:
+src/yb/yql/redis/redisserver/redis_service.cc, command table
+redis_commands.cc, parser redis_parser.cc; storage ops
+src/yb/docdb/redis_operation.cc). String and hash commands map to two
+system tables — redis_kv(k PK, v) and redis_hash(k hash PK, f range PK,
+v) — written through the normal tablet write path, so Redis data gets
+the same replication/MVCC/compaction machinery as SQL rows.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..client import YBClient
+from ..docdb.operations import ReadRequest, RowOp
+from ..docdb.table_codec import TableInfo
+from ..dockv.packed_row import ColumnSchema, ColumnType, TableSchema
+from ..dockv.partition import PartitionSchema
+from ..ops import Expr
+from ..rpc.messenger import RpcError
+
+C = Expr.col
+
+
+def _kv_info():
+    return TableInfo("", "system.redis_kv", TableSchema(columns=(
+        ColumnSchema(0, "k", ColumnType.STRING, is_hash_key=True),
+        ColumnSchema(1, "v", ColumnType.STRING),
+        ColumnSchema(2, "expire_at", ColumnType.FLOAT64),
+    ), version=1), PartitionSchema("hash", 1))
+
+
+def _hash_info():
+    return TableInfo("", "system.redis_hash", TableSchema(columns=(
+        ColumnSchema(0, "k", ColumnType.STRING, is_hash_key=True),
+        ColumnSchema(1, "f", ColumnType.STRING, is_range_key=True),
+        ColumnSchema(2, "v", ColumnType.STRING),
+    ), version=1), PartitionSchema("hash", 1))
+
+
+class RedisServer:
+    def __init__(self, client: YBClient, host="127.0.0.1", port=0,
+                 num_tablets: int = 2):
+        self.client = client
+        self.host, self.port = host, port
+        self.num_tablets = num_tablets
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.addr: Optional[Tuple[str, int]] = None
+        self._ready = False
+
+    async def start(self):
+        self._server = await asyncio.start_server(self._handle, self.host,
+                                                  self.port)
+        self.addr = self._server.sockets[0].getsockname()[:2]
+        return self.addr
+
+    async def _ensure_tables(self):
+        if self._ready:
+            return
+        names = {t["name"] for t in await self.client.list_tables()}
+        for info in (_kv_info(), _hash_info()):
+            if info.name not in names:
+                await self.client.create_table(info,
+                                               num_tablets=self.num_tablets)
+        self._ready = True
+
+    async def shutdown(self):
+        if self._server:
+            self._server.close()
+
+    # --- RESP framing -------------------------------------------------------
+    async def _read_command(self, reader) -> Optional[List[bytes]]:
+        line = await reader.readline()
+        if not line:
+            return None
+        line = line.strip()
+        if not line.startswith(b"*"):
+            return line.split()        # inline command
+        n = int(line[1:])
+        out = []
+        for _ in range(n):
+            hdr = (await reader.readline()).strip()
+            assert hdr.startswith(b"$")
+            ln = int(hdr[1:])
+            data = await reader.readexactly(ln)
+            await reader.readexactly(2)   # \r\n
+            out.append(data)
+        return out
+
+    @staticmethod
+    def _simple(s: str) -> bytes:
+        return f"+{s}\r\n".encode()
+
+    @staticmethod
+    def _error(s: str) -> bytes:
+        return f"-ERR {s}\r\n".encode()
+
+    @staticmethod
+    def _int(v: int) -> bytes:
+        return f":{v}\r\n".encode()
+
+    @staticmethod
+    def _bulk(v: Optional[str]) -> bytes:
+        if v is None:
+            return b"$-1\r\n"
+        b = v.encode() if isinstance(v, str) else v
+        return b"$" + str(len(b)).encode() + b"\r\n" + b + b"\r\n"
+
+    @classmethod
+    def _array(cls, items: List[Optional[str]]) -> bytes:
+        out = b"*" + str(len(items)).encode() + b"\r\n"
+        for i in items:
+            out += cls._bulk(i)
+        return out
+
+    # --- dispatch ------------------------------------------------------------
+    async def _handle(self, reader, writer):
+        try:
+            while True:
+                cmd = await self._read_command(reader)
+                if cmd is None:
+                    break
+                try:
+                    await self._ensure_tables()
+                    resp = await self._dispatch(
+                        cmd[0].decode().upper(),
+                        [c.decode() for c in cmd[1:]])
+                except RpcError as e:
+                    resp = self._error(str(e))
+                except Exception as e:   # noqa: BLE001
+                    resp = self._error(str(e))
+                writer.write(resp)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _get_kv(self, key: str) -> Optional[dict]:
+        row = await self.client.get("system.redis_kv", {"k": key})
+        if row is None:
+            return None
+        exp = row.get("expire_at")
+        if exp is not None and exp > 0 and exp <= time.time():
+            await self.client.delete("system.redis_kv", [{"k": key}])
+            return None
+        return row
+
+    async def _dispatch(self, cmd: str, args: List[str]) -> bytes:
+        c = self.client
+        if cmd == "PING":
+            return self._simple(args[0] if args else "PONG")
+        if cmd == "ECHO":
+            return self._bulk(args[0])
+        if cmd == "SET":
+            expire = None
+            if len(args) >= 4 and args[2].upper() == "EX":
+                expire = time.time() + float(args[3])
+            await c.insert("system.redis_kv",
+                           [{"k": args[0], "v": args[1],
+                             "expire_at": expire}])
+            return self._simple("OK")
+        if cmd == "GET":
+            row = await self._get_kv(args[0])
+            return self._bulk(row["v"] if row else None)
+        if cmd == "MSET":
+            rows = [{"k": args[i], "v": args[i + 1], "expire_at": None}
+                    for i in range(0, len(args), 2)]
+            await c.insert("system.redis_kv", rows)
+            return self._simple("OK")
+        if cmd == "MGET":
+            out = []
+            for k in args:
+                row = await self._get_kv(k)
+                out.append(row["v"] if row else None)
+            return self._array(out)
+        if cmd in ("DEL", "UNLINK"):
+            n = 0
+            for k in args:
+                if await self._get_kv(k) is not None:
+                    await c.delete("system.redis_kv", [{"k": k}])
+                    n += 1
+            return self._int(n)
+        if cmd == "EXISTS":
+            n = 0
+            for k in args:
+                if await self._get_kv(k) is not None:
+                    n += 1
+            return self._int(n)
+        if cmd in ("INCR", "INCRBY", "DECR", "DECRBY"):
+            delta = 1 if cmd in ("INCR", "DECR") else int(args[1])
+            if cmd.startswith("DECR"):
+                delta = -delta
+            row = await self._get_kv(args[0])
+            cur = int(row["v"]) if row else 0
+            cur += delta
+            await c.insert("system.redis_kv",
+                           [{"k": args[0], "v": str(cur),
+                             "expire_at": None}])
+            return self._int(cur)
+        if cmd == "EXPIRE":
+            row = await self._get_kv(args[0])
+            if row is None:
+                return self._int(0)
+            await c.insert("system.redis_kv",
+                           [{"k": args[0], "v": row["v"],
+                             "expire_at": time.time() + float(args[1])}])
+            return self._int(1)
+        if cmd == "TTL":
+            row = await self._get_kv(args[0])
+            if row is None:
+                return self._int(-2)
+            exp = row.get("expire_at")
+            if not exp:
+                return self._int(-1)
+            return self._int(int(exp - time.time()))
+        if cmd == "HSET":
+            rows = [{"k": args[0], "f": args[i], "v": args[i + 1]}
+                    for i in range(1, len(args), 2)]
+            await c.insert("system.redis_hash", rows)
+            return self._int(len(rows))
+        if cmd == "HGET":
+            row = await c.get("system.redis_hash",
+                              {"k": args[0], "f": args[1]})
+            return self._bulk(row["v"] if row else None)
+        if cmd == "HDEL":
+            n = 0
+            for f in args[1:]:
+                if await c.get("system.redis_hash",
+                               {"k": args[0], "f": f}) is not None:
+                    await c.delete("system.redis_hash",
+                                   [{"k": args[0], "f": f}])
+                    n += 1
+            return self._int(n)
+        if cmd == "HGETALL":
+            resp = await c.scan("system.redis_hash", ReadRequest(
+                "", where=("cmp", "eq", ("col", 0), ("const", args[0]))))
+            out: List[Optional[str]] = []
+            for r in sorted(resp.rows, key=lambda r: r["f"]):
+                out.extend([r["f"], r["v"]])
+            return self._array(out)
+        if cmd == "COMMAND":
+            return self._array([])
+        if cmd == "SELECT":
+            return self._simple("OK")
+        if cmd == "FLUSHALL":
+            for t in ("system.redis_kv", "system.redis_hash"):
+                try:
+                    await c.drop_table(t)
+                except RpcError:
+                    pass
+            self._ready = False
+            return self._simple("OK")
+        return self._error(f"unknown command '{cmd}'")
