@@ -1,0 +1,1 @@
+from .consumer import CdcStream, XClusterReplicator  # noqa: F401

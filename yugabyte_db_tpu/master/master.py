@@ -206,6 +206,129 @@ class Master:
             })
         return out
 
+    # --- snapshots / PITR (reference: master/master_snapshot_coordinator.cc)
+    async def rpc_create_snapshot(self, payload) -> dict:
+        """Cluster-consistent table snapshot: checkpoint every tablet
+        (hybrid-time consistency comes from checkpoints capturing a flushed
+        image; cross-tablet cut at one HT lands with distributed txn
+        integration in a later round)."""
+        import uuid as _uuid
+        name = payload["table"]
+        tid = next((t for t, e in self.tables.items()
+                    if e["info"]["name"] == name), None)
+        if tid is None:
+            raise RpcError(f"table {name} not found", "NOT_FOUND")
+        snapshot_id = f"snap-{_uuid.uuid4().hex[:12]}"
+        manifest = []
+        for tablet_id in self.tables[tid]["tablets"]:
+            ent = self.tablets[tablet_id]
+            done = False
+            for u in ent["replicas"]:
+                ts = self.tservers.get(u)
+                if not ts:
+                    continue
+                try:
+                    r = await self.messenger.call(
+                        ts["addr"], "tserver", "create_snapshot",
+                        {"tablet_id": tablet_id,
+                         "snapshot_id": snapshot_id}, timeout=30.0)
+                    manifest.append({"tablet_id": tablet_id, "ts_uuid": u,
+                                     "dir": r["dir"],
+                                     "partition": ent["partition"]})
+                    done = True
+                    break
+                except (RpcError, asyncio.TimeoutError, OSError):
+                    continue
+            if not done:
+                raise RpcError(f"no leader for {tablet_id}",
+                               "SERVICE_UNAVAILABLE")
+        snaps = self.tables[tid].setdefault("snapshots", {})
+        snaps[snapshot_id] = {"manifest": manifest}
+        self._persist()
+        return {"snapshot_id": snapshot_id,
+                "tablets": len(manifest)}
+
+    async def rpc_restore_snapshot(self, payload) -> dict:
+        """Restore a snapshot as a NEW table (clone-from-snapshot flow)."""
+        snapshot_id = payload["snapshot_id"]
+        new_name = payload["new_name"]
+        src = None
+        for tid, e in self.tables.items():
+            if snapshot_id in e.get("snapshots", {}):
+                src = (tid, e)
+                break
+        if src is None:
+            raise RpcError(f"snapshot {snapshot_id} not found", "NOT_FOUND")
+        tid, e = src
+        import uuid as _uuid
+        new_tid = f"tbl-{_uuid.uuid4().hex[:12]}"
+        info_wire = dict(e["info"])
+        info_wire["table_id"] = new_tid
+        info_wire["name"] = new_name
+        manifest = e["snapshots"][snapshot_id]["manifest"]
+        tablet_entries = {}
+        for i, m in enumerate(manifest):
+            child = f"{new_tid}-t{i}"
+            u = m["ts_uuid"]
+            ts = self.tservers.get(u)
+            if ts is None:
+                raise RpcError(f"tserver {u} holding snapshot is gone",
+                               "SERVICE_UNAVAILABLE")
+            await self.messenger.call(
+                ts["addr"], "tserver", "create_tablet",
+                {"tablet_id": child, "table": info_wire,
+                 "partition": m["partition"],
+                 "raft_peers": [[u, list(ts["addr"])]],
+                 "seed_snapshot_dir": m["dir"]}, timeout=30.0)
+            tablet_entries[child] = {
+                "tablet_id": child, "table_id": new_tid,
+                "partition": m["partition"], "replicas": [u],
+                "leader": None}
+        self.tables[new_tid] = {"info": info_wire,
+                                "tablets": list(tablet_entries)}
+        self.tablets.update(tablet_entries)
+        self._persist()
+        return {"table_id": new_tid}
+
+    # --- tablet splitting (reference: master/tablet_split_manager.cc) ------
+    async def rpc_split_tablet(self, payload) -> dict:
+        tablet_id = payload["tablet_id"]
+        ent = self.tablets.get(tablet_id)
+        if ent is None:
+            raise RpcError(f"tablet {tablet_id} not found", "NOT_FOUND")
+        table_id = ent["table_id"]
+        info_wire = self.tables[table_id]["info"]
+        from ..dockv.partition import Partition, split_partition
+        p = Partition(bytes.fromhex(ent["partition"][0]),
+                      bytes.fromhex(ent["partition"][1]))
+        lo, hi = split_partition(p)
+        split_key = lo.end.hex()
+        left_id, right_id = f"{tablet_id}l", f"{tablet_id}r"
+        raft_peers = [[u, list(self.tservers[u]["addr"])]
+                      for u in ent["replicas"] if u in self.tservers]
+        for u in ent["replicas"]:
+            ts = self.tservers.get(u)
+            if ts is None:
+                continue
+            await self.messenger.call(
+                ts["addr"], "tserver", "split_tablet",
+                {"parent_id": tablet_id, "left_id": left_id,
+                 "right_id": right_id, "split_key": split_key,
+                 "partition": ent["partition"], "table": info_wire,
+                 "raft_peers": raft_peers}, timeout=60.0)
+        for child_id, part in ((left_id, [ent["partition"][0], split_key]),
+                               (right_id, [split_key, ent["partition"][1]])):
+            self.tablets[child_id] = {
+                "tablet_id": child_id, "table_id": table_id,
+                "partition": part, "replicas": list(ent["replicas"]),
+                "leader": None}
+        del self.tablets[tablet_id]
+        tl = self.tables[table_id]["tablets"]
+        tl.remove(tablet_id)
+        tl.extend([left_id, right_id])
+        self._persist()
+        return {"left": left_id, "right": right_id}
+
     async def rpc_get_status_tablet(self, payload) -> dict:
         """Return (creating on demand) the transaction status tablet
         (reference: client-side status-tablet picking,

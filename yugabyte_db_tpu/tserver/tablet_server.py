@@ -104,6 +104,13 @@ class TabletServer:
             "raft_peers": payload["raft_peers"],
             "is_status_tablet": payload.get("is_status_tablet", False),
         }
+        seed = payload.get("seed_snapshot_dir")
+        if seed:
+            # restore-as-clone: seed the regular store from a checkpoint
+            import shutil
+            dst = os.path.join(d, "regular")
+            if not os.path.exists(dst):
+                shutil.copytree(os.path.join(seed, "regular"), dst)
         with open(os.path.join(d, "tablet-meta.json"), "w") as f:
             json.dump(meta, f)
         await self._open_tablet(meta)
@@ -136,6 +143,60 @@ class TabletServer:
         req = read_request_from_wire(payload["req"])
         resp = peer.read(req)
         return read_response_to_wire(resp)
+
+    # --- snapshots ----------------------------------------------------------
+    async def rpc_create_snapshot(self, payload) -> dict:
+        """Checkpoint one tablet under snapshots/<id> (reference:
+        tablet/tablet_snapshots.cc:186 via hard links)."""
+        peer = self._peer(payload["tablet_id"])
+        if not peer.is_leader() and payload.get("leader_only", True):
+            raise RpcError("not leader", "LEADER_NOT_READY")
+        d = os.path.join(self._tablet_dir(payload["tablet_id"]),
+                         "snapshots", payload["snapshot_id"])
+        peer.tablet.create_snapshot(d)
+        return {"ok": True, "dir": d}
+
+    async def rpc_split_tablet(self, payload) -> dict:
+        """Split a local tablet replica into two children at split_key.
+        Deterministic local copy on every replica (reference:
+        tablet/operations/split_operation.cc routes this through Raft; we
+        quiesce via the master instead this round)."""
+        parent_id = payload["parent_id"]
+        parent = self._peer(parent_id)
+        from ..dockv.partition import Partition
+        split_key = bytes.fromhex(payload["split_key"])
+        children = []
+        for side, child_id in (("left", payload["left_id"]),
+                               ("right", payload["right_id"])):
+            part = payload["partition"]
+            cpart = ([part[0], payload["split_key"]] if side == "left"
+                     else [payload["split_key"], part[1]])
+            meta = {
+                "tablet_id": child_id, "table": payload["table"],
+                "partition": cpart, "raft_peers": payload["raft_peers"],
+                "is_status_tablet": False,
+            }
+            d = self._tablet_dir(child_id)
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "tablet-meta.json"), "w") as f:
+                json.dump(meta, f)
+            peer = await self._open_tablet(meta)
+            children.append(peer)
+        # deterministic local copy of parent rows into children
+        from ..storage.lsm import WriteBatch
+        left, right = children
+        lb, rb = WriteBatch(), WriteBatch()
+        for k, v in parent.tablet.regular.iterate():
+            # partition key = 2-byte hash prefix of the doc key
+            pk = k[1:3] if k and k[0] == 0x08 else k[:2]
+            (lb if pk < split_key else rb).put(k, v)
+        left.tablet.regular.apply(lb)
+        right.tablet.regular.apply(rb)
+        left.tablet.flush()
+        right.tablet.flush()
+        # drop the parent replica
+        await self.rpc_delete_tablet({"tablet_id": parent_id})
+        return {"ok": True, "left_rows": len(lb), "right_rows": len(rb)}
 
     async def rpc_flush(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
@@ -207,6 +268,43 @@ class TabletServer:
         if peer.coordinator is None:
             raise RpcError("not a status tablet", "INVALID_ARGUMENT")
         return await peer.coordinator.status(payload)
+
+    # --- CDC (reference: src/yb/cdc/cdc_service.cc GetChanges) --------------
+    async def rpc_get_changes(self, payload) -> dict:
+        """Change stream from the tablet's Raft log: plain writes as
+        committed changes; transactional intents as provisional records
+        with begin/commit/abort markers — the CDC-SDK shape (reference:
+        cdc/cdcsdk_producer.cc)."""
+        import msgpack as _mp
+        peer = self._peer(payload["tablet_id"])
+        from_index = payload.get("from_index", 0)
+        limit = payload.get("limit", 1000)
+        changes = []
+        last = from_index
+        for e in peer.log.entries_from(from_index + 1, limit):
+            if e.index > peer.consensus.commit_index:
+                break
+            last = e.index
+            if e.etype == "write":
+                d = _mp.unpackb(e.payload, raw=False)
+                for kind, row in d["req"]["ops"]:
+                    changes.append({"op": kind, "row": row,
+                                    "ht": d["ht"], "index": e.index})
+            elif e.etype == "txn_intents":
+                d = _mp.unpackb(e.payload, raw=False)
+                for kind, row in d["req"]["ops"]:
+                    changes.append({"op": kind, "row": row,
+                                    "txn_id": d["txn_id"],
+                                    "provisional": True, "index": e.index})
+            elif e.etype == "txn_apply":
+                d = _mp.unpackb(e.payload, raw=False)
+                changes.append({"op": "commit", "txn_id": d["txn_id"],
+                                "ht": d["commit_ht"], "index": e.index})
+            elif e.etype == "txn_rollback":
+                d = _mp.unpackb(e.payload, raw=False)
+                changes.append({"op": "abort", "txn_id": d["txn_id"],
+                                "index": e.index})
+        return {"changes": changes, "checkpoint": last}
 
     async def rpc_status(self, payload) -> dict:
         return {
