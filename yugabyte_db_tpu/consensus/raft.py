@@ -90,7 +90,8 @@ class RaftConsensus:
     def __init__(self, tablet_id: str, uuid: str, config: RaftConfig,
                  log: Log, messenger: Messenger, meta_dir: str,
                  apply_cb: ApplyCb,
-                 clock: Optional[HybridClock] = None):
+                 clock: Optional[HybridClock] = None,
+                 on_config_change=None):
         self.tablet_id = tablet_id
         self.uuid = uuid
         self.config = config
@@ -111,6 +112,11 @@ class RaftConsensus:
         self._last_heartbeat = time.monotonic()
         self._election_deadline = self._new_election_deadline()
         self._commit_waiters: List[Tuple[int, asyncio.Future]] = []
+        self.on_config_change = on_config_change
+        # adopt the newest config entry already in the log (restart path)
+        for e in log.all_entries():
+            if e.etype == "config":
+                self._adopt_config(e.payload, notify=False)
         self._apply_lock = asyncio.Lock()
         self._replicate_lock = asyncio.Lock()
         self._tasks: List[asyncio.Task] = []
@@ -224,7 +230,7 @@ class RaftConsensus:
         # reference appends a NO_OP on leader start)
         await self._append_local(LogEntry(
             self.meta.current_term, self.log.last_index + 1, "noop", b""))
-        if len(self.config.peers) == 1:
+        if not self.config.others(self.uuid):
             await self._advance_commit(self.log.last_index)
             self._lease_expiry = time.monotonic() + 3600.0
         else:
@@ -248,7 +254,7 @@ class RaftConsensus:
             idx = self.log.last_index + 1
             await self._append_local(LogEntry(
                 self.meta.current_term, idx, etype, payload))
-            if len(self.config.peers) == 1:
+            if not self.config.others(self.uuid):
                 await self._advance_commit(idx)
                 return idx
             fut = asyncio.get_running_loop().create_future()
@@ -257,6 +263,64 @@ class RaftConsensus:
         await asyncio.wait_for(fut, timeout)
         return idx
 
+    # ------------------------------------------------------------------
+    # Membership change (single-server at a time; config applies at
+    # APPEND time per standard Raft practice — reference: ChangeConfig in
+    # consensus/raft_consensus.cc, learner promotion in the queue)
+    # ------------------------------------------------------------------
+    def _adopt_config(self, payload: bytes, notify: bool = True):
+        import json as _json
+        peers = [PeerSpec(u, tuple(a))
+                 for u, a in _json.loads(payload.decode())]
+        self.config = RaftConfig(peers)
+        for p in self.config.others(self.uuid):
+            self.next_index.setdefault(p.uuid, self.log.last_index + 1)
+            self.match_index.setdefault(p.uuid, 0)
+        if notify and self.on_config_change is not None:
+            self.on_config_change(self.config)
+
+    async def change_config(self, new_peers: List[PeerSpec]) -> int:
+        """Leader-only one-at-a-time membership change."""
+        import json as _json
+        if not self.is_leader():
+            raise RpcError("not leader", "LEADER_NOT_READY")
+        cur = {p.uuid for p in self.config.peers}
+        new = {p.uuid for p in new_peers}
+        if len(cur.symmetric_difference(new)) > 1:
+            raise RpcError("only single-server membership changes",
+                           "INVALID_ARGUMENT")
+        payload = _json.dumps([[p.uuid, list(p.addr)]
+                               for p in new_peers]).encode()
+        async with self._replicate_lock:
+            idx = self.log.last_index + 1
+            await self._append_local(LogEntry(
+                self.meta.current_term, idx, "config", payload))
+            self._adopt_config(payload)   # applies at append on the leader
+            if len(self.config.peers) == 1 and new == {self.uuid}:
+                await self._advance_commit(idx)
+                return idx
+            fut = asyncio.get_running_loop().create_future()
+            self._commit_waiters.append((idx, fut))
+        await self._broadcast()
+        await asyncio.wait_for(fut, 30.0)
+        if self.uuid not in new:
+            # we just removed ourselves: hand off leadership
+            await self.step_down()
+        return idx
+
+    async def wait_for_catchup(self, peer_uuid: str,
+                               timeout: float = 30.0) -> None:
+        """Block until `peer_uuid` has replicated our whole log — the
+        barrier before removing another replica (remote-bootstrap-catchup
+        analog; reference gates removal on the new peer being VOTER-ready)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.match_index.get(peer_uuid, 0) >= self.log.last_index:
+                return
+            await self._broadcast()
+            await asyncio.sleep(0.05)
+        raise RpcError(f"peer {peer_uuid} did not catch up", "TIMED_OUT")
+
     async def _heartbeat_loop(self):
         interval = flags.get("raft_heartbeat_interval_ms") / 1000.0
         while self._running and self.role == Role.LEADER:
@@ -264,7 +328,7 @@ class RaftConsensus:
             await asyncio.sleep(interval)
 
     async def _broadcast(self):
-        if self.role != Role.LEADER or len(self.config.peers) == 1:
+        if self.role != Role.LEADER or not self.config.others(self.uuid):
             return
         await asyncio.gather(
             *[self._replicate_to(p) for p in self.config.others(self.uuid)])
@@ -345,7 +409,7 @@ class RaftConsensus:
                 e = self.log.entry(nxt)
                 if e is None:
                     break
-                if e.etype != "noop":
+                if e.etype not in ("noop", "config"):
                     await self.apply_cb(e)
                 self.last_applied = nxt
 
@@ -375,12 +439,27 @@ class RaftConsensus:
                 to_append.append(e)
         if to_append:
             self.log.append(to_append)
+            for e in to_append:
+                if e.etype == "config":
+                    self._adopt_config(e.payload)
         await self._advance_commit(
             min(req["commit_index"], self.log.last_index))
         return {"term": self.meta.current_term, "success": True,
                 "last_index": self.log.last_index}
 
     # ------------------------------------------------------------------
+    async def step_down(self):
+        """Graceful leadership handoff (reference: LeaderStepDown RPC):
+        push one final round of appends, then become a follower with a
+        long election deadline so a peer wins the next election."""
+        if self.role != Role.LEADER:
+            return
+        await self._broadcast()
+        self.role = Role.FOLLOWER
+        self._lease_expiry = 0.0
+        base = flags.get("raft_heartbeat_interval_ms") / 1000.0
+        self._election_deadline = time.monotonic() + base * 20
+
     def is_leader(self) -> bool:
         return self.role == Role.LEADER
 

@@ -1,0 +1,170 @@
+"""Cluster load balancer: replica + leader balancing.
+
+Analog of the reference's ClusterLoadBalancer (reference:
+src/yb/master/cluster_balance.cc — per-table replica move selection,
+blacklist draining, leader balancing). Each tick performs at most one
+replica move (add-then-remove through Raft membership change; the new
+replica catches up from the leader's log — remote bootstrap proper lands
+with log GC) and one leader step-down toward the least-leader-loaded
+tserver.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Tuple
+
+from ..rpc.messenger import RpcError
+
+
+class ClusterLoadBalancer:
+    def __init__(self, master):
+        self.master = master
+        self.moves_done = 0
+        self.leader_moves_done = 0
+        self.blacklist: set = set()          # ts uuids being drained
+
+    # --- state ------------------------------------------------------------
+    def _replica_counts(self) -> Dict[str, int]:
+        counts = {u: 0 for u in self.master.live_tservers()}
+        for ent in self.master.tablets.values():
+            for u in ent["replicas"]:
+                if u in counts:
+                    counts[u] += 1
+        return counts
+
+    def _leader_counts(self) -> Dict[str, int]:
+        counts = {u: 0 for u in self.master.live_tservers()}
+        for ent in self.master.tablets.values():
+            l = ent.get("leader")
+            if l in counts:
+                counts[l] += 1
+        return counts
+
+    # --- one balancing step -------------------------------------------------
+    async def tick(self) -> Optional[str]:
+        """Returns a description of the action taken, or None."""
+        action = await self._maybe_move_replica()
+        if action:
+            return action
+        return await self._maybe_move_leader()
+
+    async def _maybe_move_replica(self) -> Optional[str]:
+        counts = self._replica_counts()
+        if len(counts) < 2:
+            return None
+        # blacklisted tservers count as infinitely loaded (drain them)
+        eligible_dst = {u: c for u, c in counts.items()
+                        if u not in self.blacklist}
+        if not eligible_dst:
+            return None
+        src = max(counts, key=lambda u: (counts[u] + (10**6 if u in
+                                                      self.blacklist else 0)))
+        dst = min(eligible_dst, key=eligible_dst.get)
+        overloaded = src in self.blacklist and counts[src] > 0
+        if not overloaded and counts[src] - counts.get(dst, 0) < 2:
+            return None
+        # find a tablet on src not on dst
+        for tablet_id, ent in self.master.tablets.items():
+            if src in ent["replicas"] and dst not in ent["replicas"]:
+                ok = await self.move_replica(tablet_id, src, dst)
+                if ok:
+                    self.moves_done += 1
+                    return f"moved {tablet_id} {src}->{dst}"
+        return None
+
+    async def move_replica(self, tablet_id: str, from_uuid: str,
+                           to_uuid: str) -> bool:
+        m = self.master
+        ent = m.tablets.get(tablet_id)
+        if ent is None or to_uuid not in m.tservers:
+            return False
+        table = m.tables[ent["table_id"]]["info"]
+        new_replicas = [u for u in ent["replicas"] if u != from_uuid] \
+            + [to_uuid]
+        new_peers = [[u, list(m.tservers[u]["addr"])] for u in new_replicas
+                     if u in m.tservers]
+        add_peers = [[u, list(m.tservers[u]["addr"])]
+                     for u in ent["replicas"] if u in m.tservers] \
+            + [[to_uuid, list(m.tservers[to_uuid]["addr"])]]
+        try:
+            # 1. create the replica on the destination with the JOINT
+            #    (current + new) config so it joins as a follower
+            await m.messenger.call(
+                m.tservers[to_uuid]["addr"], "tserver", "create_tablet",
+                {"tablet_id": tablet_id,
+                 "table": dict(table, table_id=ent["table_id"]),
+                 "partition": ent["partition"], "raft_peers": add_peers},
+                timeout=30.0)
+            # 2. leader adds the new peer
+            await self._leader_change_config(ent, tablet_id, add_peers)
+            ent["replicas"] = list(dict.fromkeys(
+                ent["replicas"] + [to_uuid]))
+            # 3. wait until the new peer has the whole log
+            await self._leader_call(ent, tablet_id, "wait_catchup",
+                                    {"peer_uuid": to_uuid})
+            # 4. then remove the old peer
+            await self._leader_change_config(ent, tablet_id, new_peers)
+            # 5. drop the replica on the source
+            if from_uuid in m.tservers:
+                try:
+                    await m.messenger.call(
+                        m.tservers[from_uuid]["addr"], "tserver",
+                        "delete_tablet", {"tablet_id": tablet_id},
+                        timeout=10.0)
+                except (RpcError, asyncio.TimeoutError, OSError):
+                    pass
+            ent["replicas"] = new_replicas
+            m._persist()
+            return True
+        except (RpcError, asyncio.TimeoutError, OSError):
+            return False
+
+    async def _leader_change_config(self, ent, tablet_id, peers):
+        await self._leader_call(ent, tablet_id, "change_config",
+                                {"peers": peers})
+
+    async def _leader_call(self, ent, tablet_id, method, payload):
+        m = self.master
+        payload = dict(payload, tablet_id=tablet_id)
+        last = None
+        candidates = list(dict.fromkeys(
+            ([ent["leader"]] if ent.get("leader") else [])
+            + list(ent["replicas"])))
+        for u in candidates:
+            ts = m.tservers.get(u)
+            if not ts:
+                continue
+            try:
+                return await m.messenger.call(
+                    ts["addr"], "tserver", method, payload, timeout=30.0)
+            except RpcError as e:
+                last = e
+                if e.code in ("LEADER_NOT_READY", "NOT_FOUND"):
+                    continue
+                raise
+            except (asyncio.TimeoutError, OSError) as e:
+                last = e
+                continue
+        raise last or RpcError(f"no leader for {method}", "TIMED_OUT")
+
+    async def _maybe_move_leader(self) -> Optional[str]:
+        counts = self._leader_counts()
+        if len(counts) < 2:
+            return None
+        src = max(counts, key=counts.get)
+        dst = min(counts, key=counts.get)
+        if counts[src] - counts[dst] < 2:
+            return None
+        m = self.master
+        for tablet_id, ent in m.tablets.items():
+            if ent.get("leader") == src and dst in ent["replicas"]:
+                try:
+                    await m.messenger.call(
+                        m.tservers[src]["addr"], "tserver",
+                        "leader_stepdown", {"tablet_id": tablet_id},
+                        timeout=10.0)
+                    self.leader_moves_done += 1
+                    return f"stepdown {tablet_id} on {src}"
+                except (RpcError, asyncio.TimeoutError, OSError):
+                    continue
+        return None

@@ -39,8 +39,11 @@ class Master:
         self._load()
         self.messenger.register_service("master", self)
         self.messenger.register_service("master-heartbeat", self)
+        from .load_balancer import ClusterLoadBalancer
+        self.load_balancer = ClusterLoadBalancer(self)
         self._lb_task: Optional[asyncio.Task] = None
         self._running = False
+        self.auto_balance = False   # ticked explicitly or via enable
 
     # --- persistence (sys catalog snapshot) -------------------------------
     @property
@@ -63,10 +66,40 @@ class Master:
         os.replace(tmp, self._catalog_path)
 
     # --- lifecycle --------------------------------------------------------
-    async def start(self, host: str = "127.0.0.1", port: int = 0):
+    async def start(self, host: str = "127.0.0.1", port: int = 0,
+                    auto_balance: bool = False):
         await self.messenger.start(host, port)
         self._running = True
+        if auto_balance:
+            self.auto_balance = True
+            self._lb_task = asyncio.create_task(self._lb_loop())
         return self.messenger.addr
+
+    async def _lb_loop(self):
+        while self._running:
+            try:
+                await self.load_balancer.tick()
+            except Exception:   # noqa: BLE001 — LB must never die
+                pass
+            await asyncio.sleep(1.0)
+
+    # --- balancing / placement RPCs ----------------------------------------
+    async def rpc_move_replica(self, payload) -> dict:
+        ok = await self.load_balancer.move_replica(
+            payload["tablet_id"], payload["from"], payload["to"])
+        if not ok:
+            raise RpcError("move failed", "RUNTIME_ERROR")
+        return {"ok": True}
+
+    async def rpc_balance_tick(self, payload) -> dict:
+        action = await self.load_balancer.tick()
+        return {"action": action}
+
+    async def rpc_blacklist(self, payload) -> dict:
+        """Decommission draining (reference: blacklist handling in
+        cluster_balance.cc)."""
+        self.load_balancer.blacklist.add(payload["ts_uuid"])
+        return {"ok": True}
 
     async def shutdown(self):
         self._running = False

@@ -87,6 +87,15 @@ class TabletServer:
                           clock=self.clock,
                           is_status_tablet=meta.get("is_status_tablet",
                                                     False))
+
+        def persist_config(cfg, tablet_id=tablet_id, meta=meta):
+            meta["raft_peers"] = [[p.uuid, list(p.addr)] for p in cfg.peers]
+            path = os.path.join(self._tablet_dir(tablet_id),
+                                "tablet-meta.json")
+            with open(path, "w") as f:
+                json.dump(meta, f)
+
+        peer.consensus.on_config_change = persist_config
         self.peers[tablet_id] = peer
         await peer.start()
         return peer
@@ -143,6 +152,25 @@ class TabletServer:
         req = read_request_from_wire(payload["req"])
         resp = peer.read(req)
         return read_response_to_wire(resp)
+
+    # --- membership / leadership --------------------------------------------
+    async def rpc_change_config(self, payload) -> dict:
+        peer = self._peer(payload["tablet_id"])
+        new_peers = [PeerSpec(u, tuple(a)) for u, a in payload["peers"]]
+        idx = await peer.consensus.change_config(new_peers)
+        return {"index": idx}
+
+    async def rpc_wait_catchup(self, payload) -> dict:
+        peer = self._peer(payload["tablet_id"])
+        if not peer.is_leader():
+            raise RpcError("not leader", "LEADER_NOT_READY")
+        await peer.consensus.wait_for_catchup(payload["peer_uuid"])
+        return {"ok": True}
+
+    async def rpc_leader_stepdown(self, payload) -> dict:
+        peer = self._peer(payload["tablet_id"])
+        await peer.consensus.step_down()
+        return {"ok": True}
 
     # --- snapshots ----------------------------------------------------------
     async def rpc_create_snapshot(self, payload) -> dict:
