@@ -200,6 +200,35 @@ class YBClient:
         return ReadResponse(agg_values=tuple(total), group_counts=counts,
                             backend=parts[0].backend if parts else "cpu")
 
+    # --- vector search ------------------------------------------------------
+    async def build_vector_index(self, table: str, column: str,
+                                 lists: int = 100) -> int:
+        ct = await self._table(table)
+        total = 0
+        for loc in ct.locations:
+            r = await self._call_leader(ct, loc.tablet_id,
+                                        "build_vector_index",
+                                        {"tablet_id": loc.tablet_id,
+                                         "column": column, "lists": lists})
+            total += r["indexed"]
+        return total
+
+    async def vector_search(self, table: str, column: str, query,
+                            k: int = 10, nprobe: int = 8):
+        """Distributed kNN: per-tablet top-k, client-side re-rank
+        (the RPC twin of parallel/vector.py's all_gather path)."""
+        ct = await self._table(table)
+        hits = []
+        for loc in ct.locations:
+            r = await self._call_leader(
+                ct, loc.tablet_id, "vector_search",
+                {"tablet_id": loc.tablet_id, "column": column,
+                 "query": list(map(float, query)), "k": k,
+                 "nprobe": nprobe})
+            hits.extend((pk, d) for pk, d in r["hits"])
+        hits.sort(key=lambda h: h[1])
+        return hits[:k]
+
     # --- transactions ------------------------------------------------------
     def transaction(self):
         from .transaction import YBTransaction

@@ -23,8 +23,8 @@ from ..dockv.packed_row import ColumnSchema, ColumnType, TableSchema
 from ..dockv.partition import PartitionSchema
 from ..ops.scan import AggSpec, GroupSpec
 from .parser import (
-    CreateTableStmt, DeleteStmt, DropTableStmt, InsertStmt, SelectStmt,
-    UpdateStmt, parse_statement,
+    CreateIndexStmt, CreateTableStmt, DeleteStmt, DropTableStmt, InsertStmt,
+    SelectStmt, UpdateStmt, parse_statement,
 )
 
 _TYPE_MAP = {
@@ -41,7 +41,16 @@ _TYPE_MAP = {
     "bytea": ColumnType.BINARY, "blob": ColumnType.BINARY,
     "jsonb": ColumnType.JSON, "json": ColumnType.JSON,
     "decimal": ColumnType.DECIMAL, "numeric": ColumnType.DECIMAL,
+    "vector": ColumnType.VECTOR,
 }
+
+
+def parse_vector(text) -> "np.ndarray":
+    if isinstance(text, (list, tuple)):
+        return np.asarray(text, np.float32)
+    return np.asarray(
+        [float(x) for x in text.strip().strip("[]").split(",") if x.strip()],
+        np.float32)
 
 
 @dataclass
@@ -70,7 +79,13 @@ class SqlSession:
             return await self._drop(stmt)
         if isinstance(stmt, InsertStmt):
             return await self._insert(stmt)
+        if isinstance(stmt, CreateIndexStmt):
+            n = await self.client.build_vector_index(
+                stmt.table, stmt.column, stmt.lists)
+            return SqlResult([], f"CREATE INDEX ({n} rows)")
         if isinstance(stmt, SelectStmt):
+            if stmt.knn is not None:
+                return await self._knn_select(stmt)
             return await self._select(stmt)
         if isinstance(stmt, DeleteStmt):
             return await self._delete(stmt)
@@ -112,11 +127,17 @@ class SqlSession:
     async def _insert(self, stmt: InsertStmt) -> SqlResult:
         ct = await self.client._table(stmt.table)
         cols = stmt.columns or [c.name for c in ct.info.schema.columns]
+        vec_cols = {c.name for c in ct.info.schema.columns
+                    if c.type == ColumnType.VECTOR}
         rows = []
         for vals in stmt.rows:
             if len(vals) != len(cols):
                 raise ValueError("column/value count mismatch")
-            rows.append(dict(zip(cols, vals)))
+            row = dict(zip(cols, vals))
+            for vc in vec_cols & set(row):
+                if row[vc] is not None:
+                    row[vc] = parse_vector(row[vc]).tobytes()
+            rows.append(row)
         n = await self.client.insert(stmt.table, rows)
         return SqlResult([], f"INSERT {n}")
 
@@ -287,6 +308,26 @@ class SqlSession:
                 row[_agg_name(it)] = _final(bound[i][0], st[i])
             rows.append(row)
         return SqlResult(self._order_limit(stmt, rows))
+
+    async def _knn_select(self, stmt: SelectStmt) -> SqlResult:
+        """pgvector-style: SELECT ... ORDER BY vcol <-> '[..]' LIMIT k
+        (reference: PgsqlReadOperation::ExecuteVectorLSMSearch,
+        docdb/pgsql_operation.cc:2728)."""
+        col, lit = stmt.knn
+        k = stmt.limit or 10
+        q = parse_vector(lit)
+        hits = await self.client.vector_search(stmt.table, col, q, k=k)
+        rows = []
+        for pk, dist in hits:
+            row = await self.client.get(stmt.table, pk)
+            if row is None:
+                continue
+            out = self._project_row(stmt, row,
+                                    (await self.client._table(stmt.table)
+                                     ).info.schema)
+            out["distance"] = dist
+            rows.append(out)
+        return SqlResult(rows)
 
     # ------------------------------------------------------------------
     async def _delete(self, stmt: DeleteStmt) -> SqlResult:

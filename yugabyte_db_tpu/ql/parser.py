@@ -19,7 +19,7 @@ _TOKEN = re.compile(r"""
     \s*(?:
       (?P<num>-?\d+\.\d+(?:[eE][-+]?\d+)?|-?\d+)
     | (?P<str>'(?:[^']|'')*')
-    | (?P<op><=|>=|<>|!=|[=<>(),;*+\-/])
+    | (?P<op><->|<=|>=|<>|!=|[=<>(),;*+\-/])
     | (?P<word>[A-Za-z_][A-Za-z0-9_.]*)
     )""", re.VERBOSE)
 
@@ -29,6 +29,7 @@ KEYWORDS = {
     "values", "create", "table", "primary", "key", "drop", "delete",
     "update", "set", "asc", "desc", "count", "sum", "min", "max", "avg",
     "as", "hash", "with", "tablets", "replication", "if", "exists",
+    "index", "on", "using", "lists",
 }
 
 
@@ -67,6 +68,15 @@ class CreateTableStmt:
 
 
 @dataclass
+class CreateIndexStmt:
+    name: str
+    table: str
+    column: str
+    method: str = "ivfflat"
+    lists: int = 100
+
+
+@dataclass
 class DropTableStmt:
     name: str
     if_exists: bool = False
@@ -88,6 +98,8 @@ class SelectStmt:
     group_by: List[str] = field(default_factory=list)
     order_by: List[Tuple[str, bool]] = field(default_factory=list)
     limit: Optional[int] = None
+    # kNN: ORDER BY col <-> 'vector literal' LIMIT k
+    knn: Optional[Tuple[str, str]] = None
 
 
 @dataclass
@@ -168,6 +180,8 @@ class Parser:
 
     def create_table(self):
         self.expect_kw("create")
+        if self.accept_kw("index"):
+            return self._create_index()
         self.expect_kw("table")
         ine = False
         if self.accept_kw("if"):
@@ -197,6 +211,9 @@ class Parser:
             else:
                 cname = self.ident()
                 ctype = self.ident().lower()
+                if self.accept_op("("):      # e.g. vector(768), varchar(32)
+                    self.next()              # dims/length (advisory)
+                    self.expect_op(")")
                 cols.append((cname, ctype))
                 if self.accept_kw("primary"):
                     self.expect_kw("key")
@@ -217,6 +234,23 @@ class Parser:
             raise ValueError("PRIMARY KEY required")
         return CreateTableStmt(name, cols, pk, num_hash, num_tablets, rf,
                                ine)
+
+    def _create_index(self):
+        name = self.ident()
+        self.expect_kw("on")
+        table = self.ident()
+        method = "ivfflat"
+        if self.accept_kw("using"):
+            method = self.ident().lower()
+        self.expect_op("(")
+        column = self.ident()
+        self.expect_op(")")
+        lists = 100
+        while self.accept_kw("with"):
+            k = self.ident().lower()
+            self.expect_op("=")
+            lists = int(self.next()[1])
+        return CreateIndexStmt(name, table, column, method, lists)
 
     def drop_table(self):
         self.expect_kw("drop")
@@ -310,10 +344,17 @@ class Parser:
                 if not self.accept_op(","):
                     break
         order = []
+        knn = None
         if self.accept_kw("order"):
             self.expect_kw("by")
             while True:
                 col = self.ident()
+                if self.accept_op("<->"):
+                    t = self.next()
+                    if t[0] != "str":
+                        raise ValueError("vector literal must be a string")
+                    knn = (col, t[1])
+                    break
                 desc = False
                 if self.accept_kw("desc"):
                     desc = True
@@ -325,7 +366,7 @@ class Parser:
         limit = None
         if self.accept_kw("limit"):
             limit = int(self.next()[1])
-        return SelectStmt(table, items, where, group, order, limit)
+        return SelectStmt(table, items, where, group, order, limit, knn)
 
     def delete(self):
         self.expect_kw("delete")

@@ -50,6 +50,8 @@ class Tablet:
             os.path.join(directory, "intents"), name="intents")
         self._read_op = DocReadOperation(
             self.codec, self.regular, device_cache=_DEVICE_CACHE)
+        # vector ANN indexes: col_id -> (IvfFlatIndex, [pk rows])
+        self.vector_indexes: Dict[int, tuple] = {}
         self._lock = threading.Lock()
         ent = metrics.REGISTRY.entity("tablet", tablet_id,
                                       table=info.name)
@@ -131,6 +133,59 @@ class Tablet:
         n = sum(b.n for b in blocks)
         self._m_rows_written.increment(n)
         return n
+
+    # --- vector indexes (reference: vector_index/vector_lsm.cc,
+    # docdb/doc_vector_index.cc; TPU-native IVF instead of HNSW) ----------
+    def _scan_vectors(self, col_name: str):
+        import numpy as np
+        from ..docdb.operations import ReadRequest
+        pk_names = tuple(c.name for c in self.info.schema.key_columns)
+        resp = self._read_op.execute(ReadRequest(
+            self.info.table_id, columns=pk_names + (col_name,),
+            read_ht=self.clock.now().value))
+        pks, vecs = [], []
+        for r in resp.rows:
+            v = r.get(col_name)
+            if v is None:
+                continue
+            pks.append({n: r[n] for n in pk_names})
+            vecs.append(np.frombuffer(v, np.float32))
+        return pks, (np.stack(vecs) if vecs else np.zeros((0, 1), np.float32))
+
+    def build_vector_index(self, col_name: str, nlists: int = 100) -> int:
+        from ..ops.vector import IvfFlatIndex
+        pks, vecs = self._scan_vectors(col_name)
+        cid = self.info.schema.column_by_name(col_name).id
+        if len(vecs) == 0:
+            self.vector_indexes[cid] = (None, [])
+            return 0
+        nlists = max(1, min(nlists, len(vecs) // 2 or 1))
+        idx = IvfFlatIndex.build(vecs, nlists=nlists)
+        self.vector_indexes[cid] = (idx, pks)
+        return len(pks)
+
+    def vector_search(self, col_name: str, query, k: int = 10,
+                      nprobe: int = 8):
+        """Top-k (pk row, distance) for one tablet. Uses the IVF index if
+        built; exact device search otherwise."""
+        import numpy as np
+        from ..ops.vector import exact_search
+        cid = self.info.schema.column_by_name(col_name).id
+        q = np.asarray(query, np.float32)[None, :]
+        entry = self.vector_indexes.get(cid)
+        if entry and entry[0] is not None:
+            idx, pks = entry
+            k_ = min(k, len(pks))
+            d, ids = idx.search(q, k=k_, nprobe=min(nprobe,
+                                                    len(idx.list_lens)))
+            return [(pks[int(i)], float(dist))
+                    for dist, i in zip(d[0], ids[0])]
+        pks, vecs = self._scan_vectors(col_name)
+        if not pks:
+            return []
+        d, ids = exact_search(q, vecs, k=min(k, len(pks)))
+        return [(pks[int(i)], float(dist))
+                for dist, i in zip(np.asarray(d)[0], np.asarray(ids)[0])]
 
     # --- snapshots --------------------------------------------------------
     def create_snapshot(self, out_dir: str) -> None:

@@ -297,6 +297,20 @@ class TabletServer:
             raise RpcError("not a status tablet", "INVALID_ARGUMENT")
         return await peer.coordinator.status(payload)
 
+    # --- vector indexes ------------------------------------------------------
+    async def rpc_build_vector_index(self, payload) -> dict:
+        peer = self._peer(payload["tablet_id"])
+        n = peer.tablet.build_vector_index(payload["column"],
+                                           payload.get("lists", 100))
+        return {"indexed": n}
+
+    async def rpc_vector_search(self, payload) -> dict:
+        peer = self._peer(payload["tablet_id"])
+        hits = peer.tablet.vector_search(
+            payload["column"], payload["query"], payload.get("k", 10),
+            payload.get("nprobe", 8))
+        return {"hits": [[pk, d] for pk, d in hits]}
+
     # --- CDC (reference: src/yb/cdc/cdc_service.cc GetChanges) --------------
     async def rpc_get_changes(self, payload) -> dict:
         """Change stream from the tablet's Raft log: plain writes as
