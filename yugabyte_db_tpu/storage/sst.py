@@ -207,7 +207,8 @@ class SstWriter:
         index: List[BlockIndexEntry] = []
         tmp = self.path + ".tmp"
         row_hashes: List[bytes] = []
-        with open(tmp, "wb") as f:
+        import io
+        with io.BytesIO() as f:
             # data blocks (empty region for columnar-only blocks)
             for bi, blk in enumerate(self._blocks):
                 cb = self._col_only[bi]
@@ -267,6 +268,15 @@ class SstWriter:
             f.write(fraw)
             f.write(struct.pack("<I", len(fraw)))
             f.write(MAGIC)
+            raw = f.getvalue()
+        from ..utils import flags as _flags
+        if _flags.get("encrypt_data_at_rest"):
+            from ..utils.encryption import KEY_MANAGER
+            raw = KEY_MANAGER.encrypt_file_bytes(raw)
+        with open(tmp, "wb") as out:
+            out.write(raw)
+            out.flush()
+            os.fsync(out.fileno())
         os.replace(tmp, self.path)
         self._blocks = []
         return {"path": self.path, "num_entries": self._num_entries,
@@ -282,6 +292,9 @@ class SstReader:
         self.row_decoder = row_decoder
         with open(path, "rb") as f:
             self._data = f.read()
+        from ..utils.encryption import KEY_MANAGER, MAGIC as ENC_MAGIC
+        if self._data.startswith(ENC_MAGIC):
+            self._data = KEY_MANAGER.decrypt_file_bytes(self._data)
         d = self._data
         if d[-8:] != MAGIC:
             raise ValueError(f"{path}: bad SST magic")

@@ -1,0 +1,89 @@
+"""yb-admin-style cluster admin CLI.
+
+Reference: src/yb/tools/yb-admin_cli.cc — snapshot/restore, tablet moves,
+compactions, tserver listing. Usage:
+
+    python -m yugabyte_db_tpu.tools.ybtpu_admin --master HOST:PORT <cmd> ...
+
+Commands: list_tables, list_tservers, list_tablets TABLE,
+create_snapshot TABLE, restore_snapshot SNAPSHOT_ID NEW_TABLE,
+split_tablet TABLET_ID, move_replica TABLET_ID FROM TO, balance_tick,
+blacklist TS_UUID, compact_table TABLE, flush_table TABLE
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from ..client import YBClient
+from ..docdb.wire import read_request_to_wire
+
+
+async def run_command(args) -> int:
+    host, port = args.master.rsplit(":", 1)
+    client = YBClient((host, int(port)))
+    m = client.messenger
+    maddr = client.master_addr
+    cmd = args.command
+    a = args.args
+    if cmd == "list_tables":
+        print(json.dumps(await client.list_tables(), indent=1))
+    elif cmd == "list_tservers":
+        r = await m.call(maddr, "master", "list_tservers", {})
+        print(json.dumps(r, indent=1))
+    elif cmd == "list_tablets":
+        ct = await client._table(a[0])
+        for l in ct.locations:
+            print(l.tablet_id, l.partition, "leader:", l.leader,
+                  "replicas:", [u for u, _ in l.replicas])
+    elif cmd == "create_snapshot":
+        r = await m.call(maddr, "master", "create_snapshot",
+                         {"table": a[0]}, timeout=120.0)
+        print(json.dumps(r))
+    elif cmd == "restore_snapshot":
+        r = await m.call(maddr, "master", "restore_snapshot",
+                         {"snapshot_id": a[0], "new_name": a[1]},
+                         timeout=120.0)
+        print(json.dumps(r))
+    elif cmd == "split_tablet":
+        r = await m.call(maddr, "master", "split_tablet",
+                         {"tablet_id": a[0]}, timeout=120.0)
+        print(json.dumps(r))
+    elif cmd == "move_replica":
+        r = await m.call(maddr, "master", "move_replica",
+                         {"tablet_id": a[0], "from": a[1], "to": a[2]},
+                         timeout=120.0)
+        print(json.dumps(r))
+    elif cmd == "balance_tick":
+        r = await m.call(maddr, "master", "balance_tick", {}, timeout=120.0)
+        print(json.dumps(r))
+    elif cmd == "blacklist":
+        r = await m.call(maddr, "master", "blacklist", {"ts_uuid": a[0]})
+        print(json.dumps(r))
+    elif cmd in ("compact_table", "flush_table"):
+        method = "compact" if cmd == "compact_table" else "flush"
+        ct = await client._table(a[0])
+        for l in ct.locations:
+            r = await client._call_leader(ct, l.tablet_id, method,
+                                          {"tablet_id": l.tablet_id})
+            print(l.tablet_id, r)
+    else:
+        print(f"unknown command {cmd}", file=sys.stderr)
+        return 1
+    await m.shutdown()
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ybtpu-admin")
+    p.add_argument("--master", required=True, help="master host:port")
+    p.add_argument("command")
+    p.add_argument("args", nargs="*")
+    args = p.parse_args(argv)
+    return asyncio.run(run_command(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,117 @@
+"""yugabyted-style single-command cluster launcher + SQL shell.
+
+Reference: bin/yugabyted (start/stop node, join cluster, UI). Runs a
+master + N tservers + CQL/Redis wire servers in one process and drops
+into an interactive SQL shell (ysqlsh analog).
+
+    python -m yugabyte_db_tpu.tools.ybtpud --data-dir /tmp/yb --tservers 3
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from ..master import Master
+from ..ql import SqlSession
+from ..ql.cql_server import CqlServer
+from ..ql.redis_server import RedisServer
+from ..tserver import TabletServer
+from ..tserver.webserver import StatusWebServer
+
+
+async def serve(args):
+    master = Master(f"{args.data_dir}/master")
+    maddr = await master.start(port=args.master_port,
+                               auto_balance=args.auto_balance)
+    print(f"master        : {maddr[0]}:{maddr[1]}")
+    tservers = []
+    for i in range(args.tservers):
+        ts = TabletServer(f"ts-{i}", f"{args.data_dir}/ts-{i}",
+                          master_addrs=[maddr])
+        addr = await ts.start(port=args.tserver_port + i
+                              if args.tserver_port else 0)
+        tservers.append(ts)
+        print(f"tserver ts-{i}  : {addr[0]}:{addr[1]}")
+    web = StatusWebServer("ybtpu")
+    waddr = await web.start(port=args.web_port)
+    print(f"status ui     : http://{waddr[0]}:{waddr[1]}/metrics")
+
+    from ..client import YBClient
+    client = YBClient(maddr)
+    cql = CqlServer(client)
+    caddr = await cql.start()
+    print(f"ycql          : {caddr[0]}:{caddr[1]}")
+    redis = RedisServer(YBClient(maddr))
+    raddr = await redis.start()
+    print(f"yedis         : {raddr[0]}:{raddr[1]}")
+
+    # wait for tserver registration
+    for _ in range(100):
+        for ts in tservers:
+            await ts._heartbeat_once()
+        if len(master.live_tservers()) >= args.tservers:
+            break
+        await asyncio.sleep(0.05)
+
+    if args.shell:
+        await sql_shell(SqlSession(client))
+        for ts in tservers:
+            await ts.shutdown()
+        await master.shutdown()
+    else:
+        print("ready. Ctrl-C to stop.")
+        try:
+            while True:
+                await asyncio.sleep(3600)
+        except (KeyboardInterrupt, asyncio.CancelledError):
+            pass
+
+
+async def sql_shell(session: SqlSession):
+    print("ybtpu SQL shell — end statements with ';', \\q to quit")
+    loop = asyncio.get_running_loop()
+    buf = ""
+    while True:
+        prompt = "ybtpu=# " if not buf else "ybtpu-# "
+        try:
+            line = await loop.run_in_executor(None, input, prompt)
+        except (EOFError, KeyboardInterrupt):
+            break
+        if line.strip() in ("\\q", "exit", "quit"):
+            break
+        buf += " " + line
+        if ";" not in line:
+            continue
+        sql, buf = buf.strip(), ""
+        try:
+            res = await session.execute(sql.rstrip(";"))
+            if res.rows:
+                cols = list(res.rows[0].keys())
+                print(" | ".join(cols))
+                print("-+-".join("-" * len(c) for c in cols))
+                for r in res.rows:
+                    print(" | ".join(str(r.get(c)) for c in cols))
+                print(f"({len(res.rows)} rows)")
+            else:
+                print(res.status)
+        except Exception as e:   # noqa: BLE001 — REPL surfaces all errors
+            print(f"ERROR: {e}")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ybtpud")
+    p.add_argument("--data-dir", default="/tmp/ybtpu-data")
+    p.add_argument("--tservers", type=int, default=1)
+    p.add_argument("--master-port", type=int, default=0)
+    p.add_argument("--tserver-port", type=int, default=0)
+    p.add_argument("--web-port", type=int, default=0)
+    p.add_argument("--auto-balance", action="store_true")
+    p.add_argument("--shell", action="store_true", default=True)
+    p.add_argument("--no-shell", dest="shell", action="store_false")
+    args = p.parse_args(argv)
+    asyncio.run(serve(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,87 @@
+"""Built-in HTTP status/metrics server.
+
+Reference: the embedded webserver + path handlers (src/yb/server/
+webserver.cc, master/master-path-handlers.cc, /metrics via
+util/metrics_writer.cc, /rpcz via server/rpcz-path-handler.cc,
+/mem-trackers). Minimal asyncio HTTP/1.1 — enough for Prometheus
+scraping and human inspection; no external deps.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Callable, Dict, Optional, Tuple
+
+from ..utils import metrics
+from ..utils.trace import ASH, TRACES
+
+
+class StatusWebServer:
+    def __init__(self, owner_name: str, extra_handlers: Optional[Dict] = None):
+        self.owner_name = owner_name
+        self.handlers: Dict[str, Callable[[], Tuple[str, str]]] = {
+            "/metrics": self._metrics_prom,
+            "/metrics.json": self._metrics_json,
+            "/rpcz": self._rpcz,
+            "/ash": self._ash,
+            "/status": self._status,
+        }
+        if extra_handlers:
+            self.handlers.update(extra_handlers)
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.addr: Optional[Tuple[str, int]] = None
+
+    async def start(self, host="127.0.0.1", port=0):
+        self._server = await asyncio.start_server(self._handle, host, port)
+        self.addr = self._server.sockets[0].getsockname()[:2]
+        return self.addr
+
+    async def shutdown(self):
+        if self._server:
+            self._server.close()
+
+    async def _handle(self, reader, writer):
+        try:
+            req = await reader.readline()
+            parts = req.decode().split()
+            path = parts[1] if len(parts) > 1 else "/"
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            handler = self.handlers.get(path.split("?")[0])
+            if handler is None:
+                body, ctype, code = f"not found: {path}", "text/plain", 404
+            else:
+                body, ctype = handler()
+                code = 200
+            data = body.encode()
+            writer.write(
+                f"HTTP/1.1 {code} OK\r\nContent-Type: {ctype}\r\n"
+                f"Content-Length: {len(data)}\r\n"
+                f"Connection: close\r\n\r\n".encode() + data)
+            await writer.drain()
+        except (ConnectionError, OSError, IndexError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def _metrics_prom(self):
+        return metrics.REGISTRY.to_prometheus(), "text/plain"
+
+    def _metrics_json(self):
+        return json.dumps(metrics.REGISTRY.to_json()), "application/json"
+
+    def _rpcz(self):
+        return json.dumps(TRACES.rpcz(), indent=1), "application/json"
+
+    def _ash(self):
+        return json.dumps({"wait_states_last_60s": ASH.histogram()},
+                          indent=1), "application/json"
+
+    def _status(self):
+        return json.dumps({"name": self.owner_name, "ok": True}), \
+            "application/json"

@@ -1,0 +1,91 @@
+"""Encryption at rest: counter-mode keystream cipher over data files.
+
+Reference: BlockAccessCipherStream (src/yb/encryption/cipher_stream.h)
+wraps files in a CTR cipher; the master's UniverseKeyManager
+(src/yb/encryption/universe_key_manager.cc, master/encryption_manager.cc)
+distributes universe keys. This implementation keeps the same seams —
+a keystream cipher with random-access XOR semantics and a registry of
+versioned universe keys — with a BLAKE2b-based keystream (no external
+crypto dependency; the cipher interface is pluggable).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import secrets
+from typing import Dict, Optional, Tuple
+
+_BLOCK = 64  # keystream block size (blake2b digest size)
+
+MAGIC = b"YBTPUENC"
+
+
+class CipherStream:
+    """Random-access XOR keystream: byte i uses block i//64 of
+    blake2b(key, nonce || counter)."""
+
+    def __init__(self, key: bytes, nonce: bytes):
+        self.key = key
+        self.nonce = nonce
+
+    def _block(self, counter: int) -> bytes:
+        return hashlib.blake2b(
+            self.nonce + counter.to_bytes(8, "big"),
+            key=self.key, digest_size=_BLOCK).digest()
+
+    def xor(self, data: bytes, offset: int = 0) -> bytes:
+        import numpy as np
+        first = offset // _BLOCK
+        last = (offset + len(data) - 1) // _BLOCK if data else first
+        stream = b"".join(self._block(c) for c in range(first, last + 1))
+        start = offset % _BLOCK
+        ks = np.frombuffer(stream, np.uint8)[start:start + len(data)]
+        return (np.frombuffer(data, np.uint8) ^ ks).tobytes()
+
+
+class UniverseKeyManager:
+    """Versioned key registry (key rotation keeps old versions readable)."""
+
+    def __init__(self):
+        self.keys: Dict[str, bytes] = {}
+        self.active: Optional[str] = None
+
+    def generate_key(self, version: Optional[str] = None) -> str:
+        version = version or f"k{len(self.keys)}"
+        self.keys[version] = secrets.token_bytes(32)
+        self.active = version
+        return version
+
+    def add_key(self, version: str, key: bytes, activate: bool = True):
+        self.keys[version] = key
+        if activate:
+            self.active = version
+
+    def encrypt_file_bytes(self, data: bytes) -> bytes:
+        """Envelope: MAGIC + key version + nonce + ciphertext."""
+        if self.active is None:
+            return data
+        nonce = secrets.token_bytes(16)
+        ver = self.active.encode()
+        stream = CipherStream(self.keys[self.active], nonce)
+        return (MAGIC + bytes([len(ver)]) + ver + nonce
+                + stream.xor(data))
+
+    def decrypt_file_bytes(self, data: bytes) -> bytes:
+        if not data.startswith(MAGIC):
+            return data          # unencrypted file (mixed clusters)
+        vlen = data[len(MAGIC)]
+        pos = len(MAGIC) + 1
+        ver = data[pos:pos + vlen].decode()
+        pos += vlen
+        nonce = data[pos:pos + 16]
+        pos += 16
+        key = self.keys.get(ver)
+        if key is None:
+            raise ValueError(f"universe key {ver} not available")
+        return CipherStream(key, nonce).xor(data[pos:])
+
+
+# Process-wide manager; tablet servers receive keys from the master via
+# heartbeat responses (round-2 wiring) or local config.
+KEY_MANAGER = UniverseKeyManager()

@@ -143,6 +143,9 @@ DEFINE_RUNTIME("history_retention_interval_sec", 900,
                "MVCC history retention before compaction GC "
                "(timestamp_history_retention_interval_sec analog).")
 
+DEFINE_RUNTIME("encrypt_data_at_rest", False,
+               "Encrypt SST files with the active universe key.")
+
 # TEST_ flags (reference: DEFINE_test_flag, util/flags/flag_tags.h:311)
 DEFINE_RUNTIME("TEST_fault_crash_fraction", 0.0,
                "Probabilistic fault injection fraction (MAYBE_FAULT analog).")

@@ -1,0 +1,142 @@
+"""Request tracing + ASH (Active Session History) wait-state sampling.
+
+Reference: per-request Trace objects appended via TRACE() macros and
+dumped on slow requests or /rpcz (src/yb/util/trace.h:88-113); ASH
+cross-component wait-state annotation via SET_WAIT_STATUS /
+SCOPED_WAIT_STATUS (src/yb/ash/wait_state.h:35-66) with a background
+sampler feeding a history buffer.
+"""
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional
+
+_current_trace: contextvars.ContextVar = contextvars.ContextVar(
+    "ybtpu_trace", default=None)
+
+
+@dataclass
+class Trace:
+    name: str
+    start: float = field(default_factory=time.monotonic)
+    events: List[tuple] = field(default_factory=list)
+    done: Optional[float] = None
+
+    def add(self, message: str) -> None:
+        self.events.append((time.monotonic() - self.start, message))
+
+    def finish(self) -> float:
+        self.done = time.monotonic()
+        return self.done - self.start
+
+    def dump(self) -> str:
+        out = [f"trace {self.name} ({(self.done or time.monotonic()) - self.start:.6f}s)"]
+        for dt, msg in self.events:
+            out.append(f"  {dt*1000:8.3f}ms  {msg}")
+        return "\n".join(out)
+
+
+class TraceRegistry:
+    """Keeps recent finished traces for /rpcz-style introspection."""
+
+    def __init__(self, keep: int = 200, slow_threshold_s: float = 0.5):
+        self.recent: Deque[Trace] = deque(maxlen=keep)
+        self.active: Dict[int, Trace] = {}
+        self.slow_threshold_s = slow_threshold_s
+        self._lock = threading.Lock()
+        self._next = 0
+
+    @contextmanager
+    def trace(self, name: str):
+        t = Trace(name)
+        with self._lock:
+            tid = self._next
+            self._next += 1
+            self.active[tid] = t
+        token = _current_trace.set(t)
+        try:
+            yield t
+        finally:
+            t.finish()
+            _current_trace.reset(token)
+            with self._lock:
+                self.active.pop(tid, None)
+                self.recent.append(t)
+
+    def rpcz(self) -> dict:
+        with self._lock:
+            return {
+                "active": [t.dump() for t in self.active.values()],
+                "recent_slow": [
+                    t.dump() for t in self.recent
+                    if t.done and (t.done - t.start) > self.slow_threshold_s],
+            }
+
+
+TRACES = TraceRegistry()
+
+
+def TRACE(message: str) -> None:
+    t = _current_trace.get()
+    if t is not None:
+        t.add(message)
+
+
+# --- ASH ------------------------------------------------------------------
+_wait_state: contextvars.ContextVar = contextvars.ContextVar(
+    "ybtpu_wait_state", default="Idle")
+
+
+@contextmanager
+def wait_status(state: str):
+    """SCOPED_WAIT_STATUS analog."""
+    token = _wait_state.set(state)
+    try:
+        yield
+    finally:
+        _wait_state.reset(token)
+
+
+def current_wait_state() -> str:
+    return _wait_state.get()
+
+
+class AshSampler:
+    """Periodic sampler of wait states into a bounded history ring."""
+
+    def __init__(self, keep: int = 10_000):
+        self.samples: Deque[tuple] = deque(maxlen=keep)
+        self._registered: List = []   # callables returning (name, state)
+        self._lock = threading.Lock()
+
+    def register(self, provider) -> None:
+        with self._lock:
+            self._registered.append(provider)
+
+    def sample_once(self) -> None:
+        now = time.time()
+        with self._lock:
+            providers = list(self._registered)
+        for p in providers:
+            try:
+                name, state = p()
+            except Exception:
+                continue
+            if state != "Idle":
+                self.samples.append((now, name, state))
+
+    def histogram(self, last_s: float = 60.0) -> Dict[str, int]:
+        cutoff = time.time() - last_s
+        out: Dict[str, int] = {}
+        for ts, _name, state in self.samples:
+            if ts >= cutoff:
+                out[state] = out.get(state, 0) + 1
+        return out
+
+
+ASH = AshSampler()
