@@ -64,6 +64,10 @@ class ReadRequest:
     limit: Optional[int] = None
     paging_state: Optional[bytes] = None      # resume key (exclusive)
     read_ht: Optional[int] = None             # read point (HybridTime.value)
+    # 'strong' = leader + lease; 'follower' = consistent-prefix read from
+    # any replica (reference: follower reads / consistent prefix,
+    # tserver/read_query.cc consistency levels)
+    consistency: str = "strong"
 
 
 @dataclass

@@ -51,6 +51,7 @@ def read_request_to_wire(req: ReadRequest) -> dict:
         "limit": req.limit,
         "paging_state": req.paging_state,
         "read_ht": req.read_ht,
+        "consistency": req.consistency,
     }
 
 
@@ -67,6 +68,7 @@ def read_request_from_wire(d: dict) -> ReadRequest:
         limit=d.get("limit"),
         paging_state=d.get("paging_state"),
         read_ht=d.get("read_ht"),
+        consistency=d.get("consistency", "strong"),
     )
 
 

@@ -96,9 +96,13 @@ class TabletPeer:
 
     # --- read path --------------------------------------------------------
     def read(self, req: ReadRequest) -> ReadResponse:
-        """Linearizable read: leader with a valid lease picks the read
-        time (reference: tserver/read_query.cc PickReadTime + leader
-        lease checks)."""
+        """Strong reads: leader with a valid lease picks the read time
+        (reference: tserver/read_query.cc PickReadTime + leader lease
+        checks). Follower (consistent-prefix) reads serve from any
+        replica at its applied state — the clock is ratcheted by leader
+        heartbeats, so the prefix is consistent though possibly stale."""
+        if req.consistency == "follower":
+            return self.tablet.read(req)
         if not self.consensus.is_leader():
             raise RpcError(
                 f"not leader (hint={self.consensus.leader_hint()})",
