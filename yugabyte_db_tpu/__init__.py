@@ -46,6 +46,12 @@ import jax as _jax  # noqa: E402
 
 _jax.config.update("jax_enable_x64", True)
 
+# Operator platform override: the deployment environment may preset a
+# platform (e.g. a TPU tunnel) via JAX_PLATFORMS before process start;
+# YBTPU_PLATFORM lets servers/tools force e.g. cpu regardless.
+if _os.environ.get("YBTPU_PLATFORM"):
+    _jax.config.update("jax_platforms", _os.environ["YBTPU_PLATFORM"])
+
 # Persistent XLA compilation cache: TPU sort/scan kernels are expensive to
 # compile (tens of seconds over the tunnel); cache them across processes.
 _cache_dir = _os.environ.get(
