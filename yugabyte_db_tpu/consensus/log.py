@@ -19,6 +19,7 @@ from typing import Dict, List, Optional, Tuple
 import msgpack
 
 from ..utils import flags
+from ..utils.fault_injection import TEST_CRASH_POINT
 
 ENTRY_HDR = struct.Struct("<II")   # payload_len, crc32
 
@@ -126,6 +127,7 @@ class Log:
         if sync and self.fsync:
             os.fsync(self._active.fileno())
         self._active_size += len(buf)
+        TEST_CRASH_POINT("wal:after_append")
 
     def _rewrite_truncated(self, last_keep: int) -> None:
         """Physical truncation on conflict: rewrite from scratch into a

@@ -206,6 +206,11 @@ class DocReadOperation:
             resp = self._execute_tpu_aggregate(req)
             if resp is not None:
                 return resp
+        if (not req.aggregates and req.where is not None
+                and req.paging_state is None and self._tpu_eligible(req)):
+            resp = self._execute_tpu_filter(req)
+            if resp is not None:
+                return resp
         return self._execute_cpu(req)
 
     def _tpu_eligible(self, req: ReadRequest) -> bool:
@@ -272,6 +277,66 @@ class DocReadOperation:
         return ReadResponse(agg_values=tuple(np.asarray(o) for o in outs),
                             group_counts=np.asarray(counts),
                             backend="tpu")
+
+    def _execute_tpu_filter(self, req: ReadRequest) -> Optional[ReadResponse]:
+        """Filter-pushdown row scan: the WHERE mask computes on device,
+        matching rows gather host-side with vectorized numpy over the
+        columnar blocks (no per-row predicate evaluation). Falls back to
+        the CPU row loop when columns aren't columnar-capable."""
+        blocks = self._collect_blocks()
+        if not blocks:
+            return None
+        from ..ops.expr import referenced_columns
+        needed = set(referenced_columns(req.where))
+        schema = self.codec.schema
+        proj_cols = ([schema.column_by_name(n) for n in req.columns]
+                     if req.columns else list(schema.columns))
+        try:
+            batch = build_batch(blocks, sorted(needed))
+        except KeyError:
+            return None
+        read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
+        if len(blocks) > 1:
+            batch.unique_keys = False
+        _, _, mask = self.kernel.run(batch, req.where, (), None, read_ht)
+        sel = np.nonzero(np.asarray(mask))[0]
+        if req.limit is not None and len(sel) > req.limit:
+            sel = sel[:req.limit]
+        # gather projected columns across blocks (vectorized per column)
+        rows: List[Dict[str, object]] = [dict() for _ in range(len(sel))]
+        offsets = np.cumsum([0] + [b.n for b in blocks])
+        blk_of = np.searchsorted(offsets, sel, side="right") - 1
+        local = sel - offsets[blk_of]
+        for c in proj_cols:
+            for bi, b in enumerate(blocks):
+                which = np.nonzero(blk_of == bi)[0]
+                if not len(which):
+                    continue
+                li = local[which]
+                if c.id in b.fixed:
+                    vals, nulls = b.fixed[c.id]
+                    for j, i_ in zip(which, li):
+                        rows[j][c.name] = (None if nulls[i_]
+                                           else vals[i_].item())
+                elif c.id in b.pk:
+                    vals = b.pk[c.id]
+                    for j, i_ in zip(which, li):
+                        rows[j][c.name] = vals[i_].item()
+                elif c.id in b.varlen:
+                    ends, heap, nulls = b.varlen[c.id]
+                    from ..dockv.packed_row import ColumnType as _CT
+                    is_text = c.type in (_CT.STRING, _CT.JSON, _CT.DECIMAL)
+                    for j, i_ in zip(which, li):
+                        if nulls[i_]:
+                            rows[j][c.name] = None
+                        else:
+                            lo = int(ends[i_ - 1]) if i_ else 0
+                            raw = heap[lo:int(ends[i_])]
+                            rows[j][c.name] = (raw.decode() if is_text
+                                               else raw)
+                else:
+                    return None   # column unavailable in columnar form
+        return ReadResponse(rows=rows, backend="tpu")
 
     def _execute_cpu(self, req: ReadRequest) -> ReadResponse:
         read_ht = req.read_ht if req.read_ht is not None else _MAX_HT

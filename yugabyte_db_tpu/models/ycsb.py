@@ -1,0 +1,87 @@
+"""YCSB-style workload driver (BASELINE.json config 1; reference numbers:
+docs/content/stable/benchmark/ycsb-ysql.md).
+
+Workloads run against a Tablet directly (engine-level, like the
+reference's local benchmarks) or through a YBClient. Implemented mixes:
+  A: 50% read / 50% update      C: 100% point reads
+  B: 95% read / 5% update       E: short range scans
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..docdb.operations import ReadRequest, RowOp, WriteRequest
+from ..docdb.table_codec import TableInfo
+from ..dockv.packed_row import ColumnSchema, ColumnType, TableSchema
+from ..dockv.partition import PartitionSchema
+
+
+def usertable_info() -> TableInfo:
+    cols = [ColumnSchema(0, "ycsb_key", ColumnType.INT64, is_hash_key=True)]
+    cols += [ColumnSchema(i + 1, f"field{i}", ColumnType.STRING)
+             for i in range(10)]
+    return TableInfo("usertable", "usertable", TableSchema(tuple(cols), 1),
+                     PartitionSchema("hash", 1))
+
+
+def generate_rows(n: int, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    payload = "x" * 100
+    return {
+        "ycsb_key": np.arange(n, dtype=np.int64),
+        **{f"field{i}": np.array([payload] * n, object) for i in range(10)},
+    }
+
+
+@dataclass
+class WorkloadResult:
+    ops: int
+    seconds: float
+
+    @property
+    def ops_per_sec(self) -> float:
+        return self.ops / self.seconds if self.seconds else 0.0
+
+
+class YcsbTabletWorkload:
+    """Engine-level workload against one Tablet (no RPC)."""
+
+    def __init__(self, tablet, n_rows: int, seed: int = 1):
+        self.tablet = tablet
+        self.n = n_rows
+        self.rng = np.random.default_rng(seed)
+
+    def load(self) -> int:
+        return self.tablet.bulk_load(generate_rows(self.n))
+
+    def _read(self, key: int):
+        return self.tablet.read(ReadRequest(
+            "usertable", pk_eq={"ycsb_key": int(key)}))
+
+    def _update(self, key: int):
+        row = {"ycsb_key": int(key),
+               **{f"field{i}": "u" * 100 for i in range(10)}}
+        self.tablet.apply_write(WriteRequest(
+            "usertable", [RowOp("upsert", row)]))
+
+    def run(self, workload: str, ops: int = 1000) -> WorkloadResult:
+        read_frac = {"a": 0.5, "b": 0.95, "c": 1.0, "e": 0.95}[workload]
+        keys = self.rng.integers(0, self.n, ops)
+        coins = self.rng.random(ops)
+        t0 = time.perf_counter()
+        for k, c in zip(keys, coins):
+            if workload == "e" and c < read_frac:
+                # short range scan: 10 keys from k (CPU path)
+                self.tablet.read(ReadRequest(
+                    "usertable", columns=("ycsb_key",),
+                    where=("between", ("col", 0), ("const", int(k)),
+                           ("const", int(k) + 10)), limit=10))
+            elif c < read_frac:
+                self._read(k)
+            else:
+                self._update(k)
+        return WorkloadResult(ops, time.perf_counter() - t0)

@@ -20,6 +20,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 
 from ..utils import flags
+from ..utils.fault_injection import MAYBE_FAULT, TEST_CRASH_POINT
 from .memtable import MemTable
 from .merge import merging_iterator
 from .sst import SstReader, SstWriter
@@ -108,6 +109,7 @@ class LsmStore:
 
     # --- writes -----------------------------------------------------------
     def apply(self, batch: WriteBatch) -> None:
+        MAYBE_FAULT()
         with self._lock:
             for k, v in batch.entries:
                 self._mem.put(k, v)
@@ -136,6 +138,7 @@ class LsmStore:
             w.add(k, v)
         w.set_frontier(**frontier)
         w.finish()
+        TEST_CRASH_POINT("flush:before_manifest")
         with self._lock:
             self._ssts.insert(0, SstReader(path, row_decoder=self.row_decoder))
             self._frozen.remove(mem)

@@ -1,0 +1,74 @@
+"""Deterministic fault injection + sync/crash points for tests.
+
+Reference machinery (SURVEY.md §4): TEST_ gflags
+(util/flags/flag_tags.h:311), TEST_SYNC_POINT dependency injection
+(util/sync_point.h:34-120), TEST_CRASH_POINT process kill
+(util/crash_point.h:32), probabilistic MAYBE_FAULT
+(util/fault_injection.h:47). These hooks live in product code paths and
+activate only when tests arm them.
+"""
+from __future__ import annotations
+
+import random
+import threading
+from typing import Callable, Dict, Optional
+
+from . import flags
+from .status import StatusError, io_error
+
+
+class CrashPointHit(BaseException):
+    """Raised at an armed crash point; simulates process death in-process
+    tests (ExternalMiniCluster-style tests kill the real process)."""
+
+    def __init__(self, name: str):
+        super().__init__(f"crash point {name}")
+        self.name = name
+
+
+_crash_points: set = set()
+_sync_callbacks: Dict[str, Callable[[], None]] = {}
+_rng = random.Random(0)
+_lock = threading.Lock()
+
+
+def arm_crash_point(name: str) -> None:
+    with _lock:
+        _crash_points.add(name)
+
+
+def clear_crash_points() -> None:
+    with _lock:
+        _crash_points.clear()
+
+
+def TEST_CRASH_POINT(name: str) -> None:
+    if name in _crash_points:
+        raise CrashPointHit(name)
+
+
+def set_sync_point(name: str, cb: Callable[[], None]) -> None:
+    with _lock:
+        _sync_callbacks[name] = cb
+
+
+def clear_sync_points() -> None:
+    with _lock:
+        _sync_callbacks.clear()
+
+
+def TEST_SYNC_POINT(name: str) -> None:
+    cb = _sync_callbacks.get(name)
+    if cb is not None:
+        cb()
+
+
+def seed(n: int) -> None:
+    global _rng
+    _rng = random.Random(n)
+
+
+def MAYBE_FAULT(fraction_flag: str = "TEST_fault_crash_fraction") -> None:
+    frac = flags.get(fraction_flag)
+    if frac and _rng.random() < frac:
+        raise StatusError(io_error(f"injected fault ({fraction_flag})"))
