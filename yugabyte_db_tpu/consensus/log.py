@@ -181,6 +181,61 @@ class Log:
     def all_entries(self) -> List[LogEntry]:
         return list(self._entries)
 
+    @property
+    def first_index(self) -> int:
+        return self._first_index if self._entries else self._first_index
+
+    def gc(self, upto_index: int) -> int:
+        """Log retention: drop whole closed segments whose entries are all
+        <= upto_index (they are flushed+committed — reference: log GC
+        driven by retention + flushed opid, consensus/log.cc GC). Always
+        keeps the active segment. Returns entries dropped."""
+        dropped = 0
+        keep_segments = []
+        for path in self._segments[:-1]:      # never the active segment
+            # segment bounds from file scan (cheap: read headers only)
+            last = self._segment_last_index(path)
+            if last is not None and last <= upto_index:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                dropped += 1
+                continue
+            keep_segments.append(path)
+        if dropped:
+            self._segments = keep_segments + self._segments[-1:]
+            # trim the in-memory tail to the first retained segment's start
+            first_retained = self._segment_first_index(self._segments[0])
+            if first_retained is not None and \
+                    first_retained > self._first_index:
+                cut = first_retained - self._first_index
+                del self._entries[:cut]
+                self._first_index = first_retained
+        return dropped
+
+    def _segment_first_index(self, path: str) -> Optional[int]:
+        try:
+            with open(path, "rb") as f:
+                data = f.read(4 * 1024)
+            e, _ = LogEntry.unpack_from(data, 0)
+            return e.index
+        except Exception:
+            return None
+
+    def _segment_last_index(self, path: str) -> Optional[int]:
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+            pos = 0
+            last = None
+            while pos < len(data):
+                e, pos = LogEntry.unpack_from(data, pos)
+                last = e.index
+            return last
+        except Exception:
+            return None
+
     def close(self) -> None:
         if self._active is not None:
             self._active.close()

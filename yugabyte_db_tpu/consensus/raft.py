@@ -117,6 +117,10 @@ class RaftConsensus:
         for e in log.all_entries():
             if e.etype == "config":
                 self._adopt_config(e.payload, notify=False)
+        # after WAL GC the log starts past 1: everything before the first
+        # retained entry is flushed+committed by the GC invariant
+        if log._entries and log._first_index > 1:
+            self.commit_index = self.last_applied = log._first_index - 1
         self._apply_lock = asyncio.Lock()
         self._replicate_lock = asyncio.Lock()
         self._tasks: List[asyncio.Task] = []
@@ -442,6 +446,13 @@ class RaftConsensus:
             for e in to_append:
                 if e.etype == "config":
                     self._adopt_config(e.payload)
+            # remote-bootstrapped replica: the log starts past 1 because
+            # earlier effects arrived as snapshot files — don't wait for
+            # entries that will never exist
+            if self.last_applied < self.log._first_index - 1:
+                self.last_applied = self.log._first_index - 1
+                self.commit_index = max(self.commit_index,
+                                        self.last_applied)
         await self._advance_commit(
             min(req["commit_index"], self.log.last_index))
         return {"term": self.meta.current_term, "success": True,

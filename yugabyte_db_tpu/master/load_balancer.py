@@ -87,14 +87,34 @@ class ClusterLoadBalancer:
                      for u in ent["replicas"] if u in m.tservers] \
             + [[to_uuid, list(m.tservers[to_uuid]["addr"])]]
         try:
+            # 0. checkpoint the current leader so the new replica can
+            #    remote-bootstrap instead of replaying the whole log
+            #    (required once WAL GC has trimmed history)
+            rb = None
+            try:
+                import uuid as _uuid
+                snap_id = f"rb-{_uuid.uuid4().hex[:8]}"
+                r = await self._leader_call(ent, tablet_id,
+                                            "create_snapshot",
+                                            {"snapshot_id": snap_id})
+                leader_uuid = ent.get("leader") or ent["replicas"][0]
+                for u in [ent.get("leader")] + list(ent["replicas"]):
+                    if u and u in m.tservers:
+                        leader_uuid = u
+                        break
+                rb = {"addr": list(m.tservers[leader_uuid]["addr"]),
+                      "tablet_id": tablet_id, "snapshot_id": snap_id}
+            except (RpcError, asyncio.TimeoutError, OSError):
+                rb = None   # fall back to pure log catch-up
             # 1. create the replica on the destination with the JOINT
             #    (current + new) config so it joins as a follower
             await m.messenger.call(
                 m.tservers[to_uuid]["addr"], "tserver", "create_tablet",
                 {"tablet_id": tablet_id,
                  "table": dict(table, table_id=ent["table_id"]),
-                 "partition": ent["partition"], "raft_peers": add_peers},
-                timeout=30.0)
+                 "partition": ent["partition"], "raft_peers": add_peers,
+                 "remote_bootstrap": rb},
+                timeout=60.0)
             # 2. leader adds the new peer
             await self._leader_change_config(ent, tablet_id, add_peers)
             ent["replicas"] = list(dict.fromkeys(

@@ -136,3 +136,18 @@ class TabletPeer:
     def read_own_intent(self, txn_id: str, pk_row: dict):
         doc_key = self.tablet.codec.doc_key_prefix(pk_row)
         return self.participant.own_intent(txn_id, doc_key)
+
+    # --- log retention ------------------------------------------------------
+    def maybe_gc_log(self) -> int:
+        """Drop WAL segments whose entries are both flushed to SSTs and
+        committed (reference: log GC gated on the flushed op id +
+        retention). New replicas beyond the retained log catch up via
+        remote bootstrap (tserver snapshot fetch)."""
+        frontier = self.tablet.regular.flushed_frontier()
+        op = frontier.get("op_id")
+        if not op:
+            return 0
+        cutoff = min(int(op[1]), self.consensus.commit_index)
+        if cutoff <= 0:
+            return 0
+        return self.log.gc(cutoff)

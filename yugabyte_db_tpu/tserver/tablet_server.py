@@ -120,6 +120,14 @@ class TabletServer:
             dst = os.path.join(d, "regular")
             if not os.path.exists(dst):
                 shutil.copytree(os.path.join(seed, "regular"), dst)
+        rb = payload.get("remote_bootstrap")
+        if rb:
+            # Remote bootstrap (reference: tserver/remote_bootstrap_*.cc):
+            # stream the source replica's checkpoint files over RPC, then
+            # open the tablet from them; Raft log catch-up covers the tail.
+            await self._remote_bootstrap_fetch(
+                tuple(rb["addr"]), rb["tablet_id"], rb["snapshot_id"],
+                os.path.join(d, "regular"))
         with open(os.path.join(d, "tablet-meta.json"), "w") as f:
             json.dump(meta, f)
         await self._open_tablet(meta)
@@ -152,6 +160,52 @@ class TabletServer:
         req = read_request_from_wire(payload["req"])
         resp = peer.read(req)
         return read_response_to_wire(resp)
+
+    # --- remote bootstrap ----------------------------------------------------
+    async def _remote_bootstrap_fetch(self, src_addr, tablet_id: str,
+                                      snapshot_id: str, dst_dir: str):
+        os.makedirs(dst_dir, exist_ok=True)
+        listing = await self.messenger.call(
+            src_addr, "tserver", "list_snapshot_files",
+            {"tablet_id": tablet_id, "snapshot_id": snapshot_id},
+            timeout=30.0)
+        for name, size in listing["files"]:
+            out_path = os.path.join(dst_dir, name)
+            with open(out_path, "wb") as out:
+                offset = 0
+                while offset < size:
+                    chunk = await self.messenger.call(
+                        src_addr, "tserver", "fetch_snapshot_file",
+                        {"tablet_id": tablet_id, "snapshot_id": snapshot_id,
+                         "name": name, "offset": offset,
+                         "length": 4 * 1024 * 1024}, timeout=60.0)
+                    out.write(chunk["data"])
+                    offset += len(chunk["data"])
+                    if not chunk["data"]:
+                        break
+
+    def _snapshot_dir(self, tablet_id: str, snapshot_id: str) -> str:
+        return os.path.join(self._tablet_dir(tablet_id), "snapshots",
+                            snapshot_id, "regular")
+
+    async def rpc_list_snapshot_files(self, payload) -> dict:
+        d = self._snapshot_dir(payload["tablet_id"], payload["snapshot_id"])
+        if not os.path.isdir(d):
+            raise RpcError("snapshot not found", "NOT_FOUND")
+        files = [(n, os.path.getsize(os.path.join(d, n)))
+                 for n in sorted(os.listdir(d))]
+        return {"files": files}
+
+    async def rpc_fetch_snapshot_file(self, payload) -> dict:
+        d = self._snapshot_dir(payload["tablet_id"], payload["snapshot_id"])
+        name = os.path.basename(payload["name"])   # no path escapes
+        path = os.path.join(d, name)
+        if not os.path.isfile(path):
+            raise RpcError(f"no such snapshot file {name}", "NOT_FOUND")
+        with open(path, "rb") as f:
+            f.seek(payload.get("offset", 0))
+            data = f.read(payload.get("length", 4 * 1024 * 1024))
+        return {"data": data}
 
     # --- membership / leadership --------------------------------------------
     async def rpc_change_config(self, payload) -> dict:
@@ -361,8 +415,16 @@ class TabletServer:
 
     # --- heartbeats -------------------------------------------------------
     async def _heartbeat_loop(self):
+        ticks = 0
         while self._running:
             await self._heartbeat_once()
+            ticks += 1
+            if ticks % 25 == 0:      # ~every 5s: WAL retention pass
+                for p in list(self.peers.values()):
+                    try:
+                        p.maybe_gc_log()
+                    except Exception:
+                        pass
             await asyncio.sleep(0.2)
 
     async def _heartbeat_once(self):
