@@ -1,0 +1,96 @@
+"""Row TTL + SQL transaction statement tests."""
+import asyncio
+
+import pytest
+
+from yugabyte_db_tpu.docdb import ReadRequest, RowOp, WriteRequest
+from yugabyte_db_tpu.ql import SqlSession
+from yugabyte_db_tpu.tablet import Tablet
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+from yugabyte_db_tpu.utils.hybrid_time import HybridClock, MockPhysicalClock
+from tests.test_tablet import make_info
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestTtl:
+    def test_row_expires_at_read_time(self, tmp_path):
+        clock = HybridClock(MockPhysicalClock(1_000_000))
+        t = Tablet("ttl-1", make_info(), str(tmp_path), clock=clock)
+        t.apply_write(WriteRequest("t1", [
+            RowOp("upsert", {"k": 1, "v": 1.0, "s": "ttl"}, ttl_ms=1000),
+            RowOp("upsert", {"k": 2, "v": 2.0, "s": "forever"})]))
+        r = t.read(ReadRequest("t1", pk_eq={"k": 1}))
+        assert r.rows and r.rows[0]["s"] == "ttl"
+        clock._physical.advance_micros(2_000_000)   # 2s later
+        assert not t.read(ReadRequest("t1", pk_eq={"k": 1})).rows
+        assert t.read(ReadRequest("t1", pk_eq={"k": 2})).rows
+        # scans skip expired rows too
+        resp = t.read(ReadRequest("t1", columns=("k",)))
+        assert [row["k"] for row in resp.rows] == [2]
+
+    def test_compaction_gcs_expired(self, tmp_path):
+        clock = HybridClock(MockPhysicalClock(1_000_000))
+        t = Tablet("ttl-2", make_info(), str(tmp_path), clock=clock)
+        t.apply_write(WriteRequest("t1", [
+            RowOp("upsert", {"k": 1, "v": 1.0, "s": "x"}, ttl_ms=1000)]))
+        t.flush()
+        clock._physical.advance_micros(3_000_000_000)  # beyond retention
+        from yugabyte_db_tpu.utils import flags
+        flags.set_flag("tpu_compaction_enabled", False)  # CPU GC feed
+        try:
+            t.compact()
+        finally:
+            flags.REGISTRY.reset("tpu_compaction_enabled")
+        assert sum(1 for _ in t.regular.iterate()) == 0
+
+
+class TestSqlTxn:
+    def test_begin_commit_rollback(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute("CREATE TABLE a (k bigint, v double, "
+                                "PRIMARY KEY (k))")
+                await mc.wait_for_leaders("a")
+                await s.execute("INSERT INTO a (k, v) VALUES (1, 10), (2, 20)")
+                # trigger status tablet creation + leadership
+                await s.execute("BEGIN")
+                await s.execute("INSERT INTO a (k, v) VALUES (1, 99)")
+                await s.execute("COMMIT")
+                await mc.wait_for_leaders("system.transactions")
+                await asyncio.sleep(0.3)
+                r = await s.execute("SELECT v FROM a WHERE k = 1")
+                assert r.rows[0]["v"] == 99.0
+                # rollback leaves data untouched
+                await s.execute("BEGIN")
+                await s.execute("UPDATE a SET v = 0 WHERE k = 2")
+                await s.execute("ROLLBACK")
+                await asyncio.sleep(0.3)
+                r = await s.execute("SELECT v FROM a WHERE k = 2")
+                assert r.rows[0]["v"] == 20.0
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_insert_using_ttl(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute("CREATE TABLE e (k bigint, v double, "
+                                "PRIMARY KEY (k))")
+                await mc.wait_for_leaders("e")
+                await s.execute(
+                    "INSERT INTO e (k, v) VALUES (1, 1) USING TTL 0.2")
+                r = await s.execute("SELECT count(*) FROM e")
+                assert r.rows[0]["count"] == 1
+                await asyncio.sleep(0.5)
+                r = await s.execute("SELECT count(*) FROM e")
+                assert r.rows[0]["count"] == 0
+            finally:
+                await mc.shutdown()
+        run(go())

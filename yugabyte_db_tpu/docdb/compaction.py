@@ -56,6 +56,10 @@ class DocDbCompactionFeed(CompactionFeed):
         self._seen_leq = True
         if value and value[0] == ValueKind.kTombstone:
             return []                      # latest <= cutoff is a delete
+        from ..dockv.value import unwrap_ttl
+        _, expire = unwrap_ttl(value)
+        if expire is not None and expire <= self.cutoff:
+            return []                      # TTL-expired beyond retention
         return [(key, value)]
 
 

@@ -40,6 +40,7 @@ _HT_SUFFIX = ENCODED_SIZE + 1
 class RowOp:
     kind: str                      # 'upsert' | 'delete'
     row: Dict[str, object]         # full row for upsert; PK columns for delete
+    ttl_ms: Optional[int] = None   # row TTL (None = forever)
 
 
 @dataclass
@@ -155,10 +156,14 @@ class DocWriteOperation:
     def apply(self, ht: HybridTime, op_id=None) -> Tuple[WriteBatch, int]:
         batch = WriteBatch(op_id=op_id)
         wid = 0
+        from ..dockv.value import wrap_ttl
         for op in self.request.ops:
             dht = DocHybridTime(ht, wid)
             if op.kind == "upsert":
                 k, v = self.codec.encode_write(op.row, dht)
+                if op.ttl_ms:
+                    expire = ht.add_micros(op.ttl_ms * 1000).value
+                    v = wrap_ttl(v, expire)
             elif op.kind == "delete":
                 k, v = self.codec.encode_delete(op.row, dht)
             else:
@@ -192,6 +197,10 @@ class DocReadOperation:
             dht = DocHybridTime.decode_desc(k[-ENCODED_SIZE:])
             if dht.ht.value > read_ht:
                 continue    # newer than read point; keep scanning versions
+            from ..dockv.value import unwrap_ttl
+            v, expire = unwrap_ttl(v)
+            if expire is not None and expire <= read_ht:
+                return None          # expired row
             return self.codec.decode_row(k, v)
         return None
 
@@ -363,6 +372,10 @@ class DocReadOperation:
             if dht.ht.value > read_ht:
                 continue
             chosen = True   # newest visible version of this doc key
+            from ..dockv.value import unwrap_ttl
+            v, expire = unwrap_ttl(v)
+            if expire is not None and expire <= read_ht:
+                continue    # expired
             if v[0] == ValueKind.kTombstone:
                 continue
             row = self.codec.decode_row(k, v)

@@ -171,6 +171,10 @@ class TableCodec:
                 hts[i] = dht.ht.value
                 wids[i] = dht.write_id
                 keys_noht.append(k[:-_HT_SUFFIX])
+                if v[0] == ValueKind.kMergeFlags:
+                    # TTL'd rows stay on the row path (CPU TTL checks);
+                    # the block simply doesn't get a columnar sidecar
+                    return None
                 if v[0] == ValueKind.kPackedRowV2:
                     v_ver = self.info.packings.version_of(v, 1)
                     if ver is None:

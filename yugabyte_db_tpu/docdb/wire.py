@@ -32,12 +32,14 @@ def _expr_from_wire(node):
 
 def write_request_to_wire(req: WriteRequest) -> dict:
     return {"table_id": req.table_id,
-            "ops": [[o.kind, o.row] for o in req.ops]}
+            "ops": [[o.kind, o.row, o.ttl_ms] for o in req.ops]}
 
 
 def write_request_from_wire(d: dict) -> WriteRequest:
-    return WriteRequest(d["table_id"],
-                        [RowOp(k, r) for k, r in d["ops"]])
+    return WriteRequest(
+        d["table_id"],
+        [RowOp(op[0], op[1], op[2] if len(op) > 2 else None)
+         for op in d["ops"]])
 
 
 def read_request_to_wire(req: ReadRequest) -> dict:

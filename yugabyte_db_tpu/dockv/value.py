@@ -32,6 +32,23 @@ class ValueKind:
     kRowLock = 0x31          # lock-only intent value
 
 
+TTL_HDR_LEN = 9   # kMergeFlags marker + u64 expire hybrid time
+
+
+def wrap_ttl(value: bytes, expire_ht: int) -> bytes:
+    """Prefix a KV value with an expiration hybrid time (reference: TTL
+    merge flags in dockv value encoding)."""
+    return bytes([ValueKind.kMergeFlags]) + struct.pack("<Q", expire_ht) + value
+
+
+def unwrap_ttl(value: bytes):
+    """Returns (inner_value, expire_ht or None)."""
+    if value and value[0] == ValueKind.kMergeFlags:
+        (exp,) = struct.unpack_from("<Q", value, 1)
+        return value[TTL_HDR_LEN:], exp
+    return value, None
+
+
 @dataclass(frozen=True)
 class PrimitiveValue:
     kind: int

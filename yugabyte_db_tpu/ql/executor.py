@@ -24,7 +24,7 @@ from ..dockv.partition import PartitionSchema
 from ..ops.scan import AggSpec, GroupSpec
 from .parser import (
     CreateIndexStmt, CreateTableStmt, DeleteStmt, DropTableStmt, InsertStmt,
-    SelectStmt, UpdateStmt, parse_statement,
+    SelectStmt, TxnStmt, UpdateStmt, parse_statement,
 )
 
 _TYPE_MAP = {
@@ -70,6 +70,7 @@ class SqlSession:
         # optional per-table column stats enabling device GROUP BY:
         # {table: {column: (domain, offset)}}
         self.stats: Dict[str, Dict[str, Tuple[int, int]]] = {}
+        self._txn = None    # active YBTransaction (BEGIN..COMMIT)
 
     async def execute(self, sql: str) -> SqlResult:
         stmt = parse_statement(sql)
@@ -79,6 +80,8 @@ class SqlSession:
             return await self._drop(stmt)
         if isinstance(stmt, InsertStmt):
             return await self._insert(stmt)
+        if isinstance(stmt, TxnStmt):
+            return await self._txn_stmt(stmt)
         if isinstance(stmt, CreateIndexStmt):
             n = await self.client.build_vector_index(
                 stmt.table, stmt.column, stmt.lists)
@@ -94,6 +97,21 @@ class SqlSession:
         raise ValueError(f"unhandled statement {stmt}")
 
     # ------------------------------------------------------------------
+    async def _txn_stmt(self, stmt: TxnStmt) -> SqlResult:
+        if stmt.kind == "begin":
+            if self._txn is not None:
+                raise ValueError("transaction already in progress")
+            self._txn = await self.client.transaction().begin()
+            return SqlResult([], "BEGIN")
+        if self._txn is None:
+            raise ValueError("no transaction in progress")
+        txn, self._txn = self._txn, None
+        if stmt.kind == "commit":
+            await txn.commit()
+            return SqlResult([], "COMMIT")
+        await txn.abort()
+        return SqlResult([], "ROLLBACK")
+
     async def _create(self, stmt: CreateTableStmt) -> SqlResult:
         if stmt.if_not_exists:
             names = {t["name"] for t in await self.client.list_tables()}
@@ -138,7 +156,15 @@ class SqlSession:
                 if row[vc] is not None:
                     row[vc] = parse_vector(row[vc]).tobytes()
             rows.append(row)
-        n = await self.client.insert(stmt.table, rows)
+        if self._txn is not None:
+            n = await self._txn.insert(stmt.table, rows)
+        elif stmt.ttl_ms:
+            from ..docdb.operations import RowOp
+            n = await self.client.write(
+                stmt.table, [RowOp("upsert", r, ttl_ms=stmt.ttl_ms)
+                             for r in rows])
+        else:
+            n = await self.client.insert(stmt.table, rows)
         return SqlResult([], f"INSERT {n}")
 
     # ------------------------------------------------------------------
@@ -160,6 +186,7 @@ class SqlSession:
     async def _select(self, stmt: SelectStmt) -> SqlResult:
         ct = await self.client._table(stmt.table)
         schema = ct.info.schema
+        read_ht = self._txn.start_ht if self._txn is not None else None
         where = self._bind(stmt.where, schema)
         agg_items = [it for it in stmt.items if it[0] == "agg"]
 
@@ -167,7 +194,7 @@ class SqlSession:
             aggs = tuple(AggSpec(op, self._bind(e, schema))
                          for _, op, e in agg_items)
             resp = await self.client.scan(stmt.table, ReadRequest(
-                "", where=where, aggregates=aggs))
+                "", where=where, aggregates=aggs, read_ht=read_ht))
             row = self._agg_row(stmt, resp.agg_values)
             return SqlResult([row])
 
@@ -180,7 +207,7 @@ class SqlSession:
         # plain row scan
         columns = self._needed_columns(stmt, schema)
         resp = await self.client.scan(stmt.table, ReadRequest(
-            "", columns=tuple(columns), where=where,
+            "", columns=tuple(columns), where=where, read_ht=read_ht,
             limit=None if stmt.order_by else stmt.limit))
         rows = [self._project_row(stmt, r, schema) for r in resp.rows]
         rows = self._order_limit(stmt, rows)
@@ -339,7 +366,10 @@ class SqlSession:
             "", columns=tuple(pk_cols), where=where))
         if not resp.rows:
             return SqlResult([], "DELETE 0")
-        n = await self.client.delete(stmt.table, resp.rows)
+        if self._txn is not None:
+            n = await self._txn.delete(stmt.table, resp.rows)
+        else:
+            n = await self.client.delete(stmt.table, resp.rows)
         return SqlResult([], f"DELETE {n}")
 
     async def _update(self, stmt: UpdateStmt) -> SqlResult:
@@ -351,7 +381,10 @@ class SqlSession:
         if not resp.rows:
             return SqlResult([], "UPDATE 0")
         updated = [dict(r, **stmt.sets) for r in resp.rows]
-        n = await self.client.insert(stmt.table, updated)
+        if self._txn is not None:
+            n = await self._txn.insert(stmt.table, updated)
+        else:
+            n = await self.client.insert(stmt.table, updated)
         return SqlResult([], f"UPDATE {n}")
 
 

@@ -29,7 +29,8 @@ KEYWORDS = {
     "values", "create", "table", "primary", "key", "drop", "delete",
     "update", "set", "asc", "desc", "count", "sum", "min", "max", "avg",
     "as", "hash", "with", "tablets", "replication", "if", "exists",
-    "index", "on", "using", "lists",
+    "index", "on", "using", "lists", "ttl", "begin", "commit",
+    "rollback", "transaction",
 }
 
 
@@ -87,6 +88,12 @@ class InsertStmt:
     table: str
     columns: List[str]
     rows: List[List[object]]
+    ttl_ms: Optional[int] = None
+
+
+@dataclass
+class TxnStmt:
+    kind: str   # 'begin' | 'commit' | 'rollback'
 
 
 @dataclass
@@ -169,6 +176,8 @@ class Parser:
             "create": self.create_table, "drop": self.drop_table,
             "insert": self.insert, "select": self.select,
             "delete": self.delete, "update": self.update,
+            "begin": self.txn_stmt, "commit": self.txn_stmt,
+            "rollback": self.txn_stmt,
         }.get(word)
         if fn is None:
             raise ValueError(f"unsupported statement {word!r}")
@@ -285,7 +294,16 @@ class Parser:
             rows.append(row)
             if not self.accept_op(","):
                 break
-        return InsertStmt(table, cols, rows)
+        ttl_ms = None
+        if self.accept_kw("using"):
+            self.expect_kw("ttl")
+            ttl_ms = int(float(self.next()[1]) * 1000)   # seconds -> ms
+        return InsertStmt(table, cols, rows, ttl_ms)
+
+    def txn_stmt(self):
+        t = self.next()[1].lower()
+        self.accept_kw("transaction")
+        return TxnStmt(t)
 
     def literal(self):
         t = self.next()

@@ -265,7 +265,8 @@ class TransactionParticipant:
         txn_id = m["txn_id"]
         commit_ht = m["commit_ht"]
         per_txn = self._intents.pop(txn_id, None) or {}
-        ops = [RowOp(k, r) for k, r in per_txn.values()]
+        ops = [RowOp(op[0], op[1], op[2] if len(op) > 2 else None)
+               for op in per_txn.values()]
         if ops:
             req = WriteRequest("", ops)
             self.tablet.apply_write(req, ht=HybridTime(commit_ht))

@@ -316,7 +316,7 @@ class TabletServer:
         peer = self._peer(payload["tablet_id"])
         own = peer.read_own_intent(payload["txn_id"], payload["pk_row"])
         if own is not None:
-            kind, row = own
+            kind, row = own[0], own[1]
             if kind == "delete":
                 return {"row": None, "from_intent": True}
             return {"row": row, "from_intent": True}
