@@ -383,13 +383,13 @@ class TabletServer:
             last = e.index
             if e.etype == "write":
                 d = _mp.unpackb(e.payload, raw=False)
-                for kind, row in d["req"]["ops"]:
-                    changes.append({"op": kind, "row": row,
+                for op in d["req"]["ops"]:
+                    changes.append({"op": op[0], "row": op[1],
                                     "ht": d["ht"], "index": e.index})
             elif e.etype == "txn_intents":
                 d = _mp.unpackb(e.payload, raw=False)
-                for kind, row in d["req"]["ops"]:
-                    changes.append({"op": kind, "row": row,
+                for op in d["req"]["ops"]:
+                    changes.append({"op": op[0], "row": op[1],
                                     "txn_id": d["txn_id"],
                                     "provisional": True, "index": e.index})
             elif e.etype == "txn_apply":
