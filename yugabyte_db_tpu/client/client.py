@@ -42,6 +42,7 @@ class CachedTable:
     info: TableInfo
     codec: TableCodec
     locations: List[TabletLocation]
+    indexes: Dict[str, dict] = None
 
 
 class YBClient:
@@ -88,7 +89,8 @@ class YBClient:
                 replicas=[(r["ts_uuid"], tuple(r["addr"]))
                           for r in l["replicas"] if r["addr"]],
                 leader=l.get("leader")))
-        cached = CachedTable(info, TableCodec(info), locs)
+        cached = CachedTable(info, TableCodec(info), locs,
+                             resp.get("indexes") or {})
         self._tables[name] = cached
         return cached
 
@@ -100,11 +102,30 @@ class YBClient:
                 return loc
         raise RpcError("no tablet covers key", "NOT_FOUND")
 
+    def _tablet_for_hash_key(self, ct: CachedTable, row: dict
+                             ) -> TabletLocation:
+        """Route by hash columns only (prefix lookups: the range part of
+        the PK is unknown)."""
+        schema = ct.info.schema
+        nh = ct.info.partition_schema.num_hash_columns
+        hash_cols = schema.key_columns[:nh]
+        from ..docdb.table_codec import _KEV_MAKER
+        entries = [_KEV_MAKER[c.type](row[c.name]) for c in hash_cols]
+        part_key = ct.info.partition_schema.partition_key_for_row(entries)
+        for loc in ct.locations:
+            if loc.partition.contains(part_key):
+                return loc
+        raise RpcError("no tablet covers key", "NOT_FOUND")
+
     # --- DML: writes ------------------------------------------------------
     async def write(self, table: str, ops: Sequence[RowOp]) -> int:
         """Batcher: group ops per tablet, send in parallel, retry on
-        leadership changes."""
+        leadership changes. Maintains secondary-index tables
+        synchronously (reference: transactional index maintenance in
+        pggate; round-1 maintenance is non-transactional)."""
         ct = await self._table(table)
+        if ct.indexes:
+            await self._maintain_indexes(ct, table, ops)
         by_tablet: Dict[str, List[RowOp]] = {}
         for op in ops:
             loc = self._tablet_for_key(ct, op.row)
@@ -126,6 +147,63 @@ class YBClient:
 
     async def delete(self, table: str, pk_rows: Sequence[dict]) -> int:
         return await self.write(table, [RowOp("delete", r) for r in pk_rows])
+
+    async def _maintain_indexes(self, ct, table: str, ops):
+        pk_names = [c.name for c in ct.info.schema.key_columns]
+        for index_name, spec in ct.indexes.items():
+            col = spec["column"]
+            idx_ops: List[RowOp] = []
+            for op in ops:
+                pk_row = {n: op.row[n] for n in pk_names if n in op.row}
+                old = await self.get(table, pk_row) if pk_row else None
+                if old is not None and old.get(col) is not None:
+                    if op.kind == "delete" or old.get(col) != op.row.get(col):
+                        idx_ops.append(RowOp("delete", {
+                            col: old[col],
+                            **{f"base_{n}": old[n] for n in pk_names}}))
+                if op.kind == "upsert" and op.row.get(col) is not None:
+                    idx_ops.append(RowOp("upsert", {
+                        col: op.row[col],
+                        **{f"base_{n}": op.row[n] for n in pk_names}}))
+            if idx_ops:
+                await self.write(index_name, idx_ops)
+
+    async def index_lookup(self, table: str, index_name: str, value
+                           ) -> List[dict]:
+        """Indexed-equality lookup: prefix-scan the index tablet owning
+        `value`, return base-table PK rows."""
+        ct = await self._table(table)
+        spec = ct.indexes[index_name]
+        ict = await self._table(spec["index_table"])
+        col = spec["column"]
+        loc = self._tablet_for_hash_key(ict, {col: value})
+        req = ReadRequest(ict.info.table_id, pk_prefix={col: value})
+        payload = {"tablet_id": loc.tablet_id,
+                   "req": read_request_to_wire(req)}
+        resp = read_response_from_wire(
+            await self._call_leader(ict, loc.tablet_id, "read", payload))
+        return [{n: r[f"base_{n}"] for n in spec["base_pk"]}
+                for r in resp.rows]
+
+    async def create_secondary_index(self, table: str, index_name: str,
+                                     column: str) -> int:
+        """Create + backfill (reference: online backfill,
+        master/backfill_index.cc — ours quiesces via full scan)."""
+        await self.messenger.call(
+            self.master_addr, "master", "create_secondary_index",
+            {"table": table, "index_name": index_name, "column": column},
+            timeout=60.0)
+        self._tables.pop(table, None)
+        ct = await self._table(table)
+        pk_names = [c.name for c in ct.info.schema.key_columns]
+        resp = await self.scan(table, ReadRequest(
+            "", columns=tuple(pk_names + [column])))
+        rows = [r for r in resp.rows if r.get(column) is not None]
+        if rows:
+            await self.insert(index_name, [
+                {column: r[column],
+                 **{f"base_{n}": r[n] for n in pk_names}} for r in rows])
+        return len(rows)
 
     # --- DML: reads -------------------------------------------------------
     async def get(self, table: str, pk_row: dict) -> Optional[dict]:

@@ -62,6 +62,7 @@ class ReadRequest:
     aggregates: Tuple[AggSpec, ...] = ()     # aggregate pushdown
     group_by: Optional[GroupSpec] = None
     pk_eq: Optional[Dict[str, object]] = None  # full-PK point lookup
+    pk_prefix: Optional[Dict[str, object]] = None  # hash-cols prefix scan
     limit: Optional[int] = None
     paging_state: Optional[bytes] = None      # resume key (exclusive)
     read_ht: Optional[int] = None             # read point (HybridTime.value)
@@ -211,6 +212,8 @@ class DocReadOperation:
             row = self.get_row(req.pk_eq, read_ht)
             rows = [self._project(row, req.columns)] if row is not None else []
             return ReadResponse(rows=rows, backend="cpu")
+        if req.pk_prefix is not None:
+            return self._prefix_scan(req)
         if req.aggregates and self._tpu_eligible(req):
             resp = self._execute_tpu_aggregate(req)
             if resp is not None:
@@ -221,6 +224,41 @@ class DocReadOperation:
             if resp is not None:
                 return resp
         return self._execute_cpu(req)
+
+    def _prefix_scan(self, req: ReadRequest) -> ReadResponse:
+        """All visible rows whose doc key starts with the hash prefix
+        (secondary-index lookup path)."""
+        read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
+        prefix = self.codec.hash_prefix(req.pk_prefix)
+        rows_out: List[Dict[str, object]] = []
+        cur_prefix = None
+        chosen = False
+        from ..dockv.value import unwrap_ttl
+        for k, v in self.store.iterate(lower=prefix):
+            if not k.startswith(prefix):
+                break
+            marker = len(k) - _HT_SUFFIX
+            p = k[:marker]
+            if p != cur_prefix:
+                cur_prefix = p
+                chosen = False
+            if chosen:
+                continue
+            dht = DocHybridTime.decode_desc(k[-ENCODED_SIZE:])
+            if dht.ht.value > read_ht:
+                continue
+            chosen = True
+            v, expire = unwrap_ttl(v)
+            if expire is not None and expire <= read_ht:
+                continue
+            if v[0] == ValueKind.kTombstone:
+                continue
+            row = self.codec.decode_row(k, v)
+            if row is not None:
+                rows_out.append(self._project(row, req.columns))
+                if req.limit is not None and len(rows_out) >= req.limit:
+                    break
+        return ReadResponse(rows=rows_out, backend="cpu")
 
     def _tpu_eligible(self, req: ReadRequest) -> bool:
         if not flags.get("tpu_pushdown_enabled"):

@@ -133,6 +133,22 @@ class TableCodec:
     def doc_key_prefix(self, pk_row: Dict[str, object]) -> bytes:
         return self.doc_key(pk_row).encode()
 
+    def hash_prefix(self, row: Dict[str, object]) -> bytes:
+        """Encoded prefix covering just the hash components — used for
+        prefix scans (e.g. secondary-index lookups by indexed value)."""
+        from ..dockv.key_encoding import KeyBytes
+        ps = self.info.partition_schema
+        entries = []
+        for c in self._pk_cols[:ps.num_hash_columns]:
+            maker = _KEV_MAKER[c.type]
+            entries.append(maker(row[c.name]))
+        from ..dockv.partition import hash_key_for
+        kb = KeyBytes()
+        kb.append_hash(hash_key_for(entries))
+        for e in entries:
+            kb.append_entry(e)
+        return kb.data()
+
     def decode_row(self, key: bytes, value: bytes) -> Optional[Dict[str, object]]:
         """KV entry -> {col name: value} (None for a tombstone)."""
         if value[0] == ValueKind.kTombstone:

@@ -50,6 +50,7 @@ def read_request_to_wire(req: ReadRequest) -> dict:
         "aggregates": [[a.op, _expr_to_wire(a.expr)] for a in req.aggregates],
         "group_by": list(req.group_by.cols) if req.group_by else None,
         "pk_eq": req.pk_eq,
+        "pk_prefix": req.pk_prefix,
         "limit": req.limit,
         "paging_state": req.paging_state,
         "read_ht": req.read_ht,
@@ -67,6 +68,7 @@ def read_request_from_wire(d: dict) -> ReadRequest:
         group_by=(GroupSpec(tuple(tuple(c) for c in d["group_by"]))
                   if d.get("group_by") else None),
         pk_eq=d.get("pk_eq"),
+        pk_prefix=d.get("pk_prefix"),
         limit=d.get("limit"),
         paging_state=d.get("paging_state"),
         read_ht=d.get("read_ht"),

@@ -220,7 +220,8 @@ class Master:
         for tid, e in self.tables.items():
             if tid == table_id or e["info"]["name"] == name:
                 return {"table": e["info"],
-                        "locations": self._locations(tid)}
+                        "locations": self._locations(tid),
+                        "indexes": e.get("indexes", {})}
         raise RpcError(f"table {name or table_id} not found", "NOT_FOUND")
 
     def _locations(self, table_id: str) -> List[dict]:
@@ -361,6 +362,42 @@ class Master:
         tl.extend([left_id, right_id])
         self._persist()
         return {"left": left_id, "right": right_id}
+
+    # --- secondary indexes (reference: index tables in catalog_manager,
+    # online backfill master/backfill_index.cc) ---------------------------
+    async def rpc_create_secondary_index(self, payload) -> dict:
+        """Register an index table mapping indexed column -> base PK.
+
+        The index is itself a normal sharded table (the reference models
+        indexes exactly this way); the client maintains it on writes and
+        backfills existing rows at creation."""
+        base_name = payload["table"]
+        index_name = payload["index_name"]
+        column = payload["column"]
+        tid = next((t for t, e in self.tables.items()
+                    if e["info"]["name"] == base_name), None)
+        if tid is None:
+            raise RpcError(f"table {base_name} not found", "NOT_FOUND")
+        base = self.tables[tid]
+        base_info = TableInfo.from_wire(base["info"])
+        col = base_info.schema.column_by_name(column)
+        pk_cols = base_info.schema.key_columns
+        cols = [ColumnSchema(0, column, col.type, is_hash_key=True)]
+        for i, c in enumerate(pk_cols):
+            cols.append(ColumnSchema(i + 1, f"base_{c.name}", c.type,
+                                     is_range_key=True))
+        idx_info = TableInfo(
+            "", index_name, TableSchema(tuple(cols), 1),
+            PartitionSchema("hash", 1))
+        resp = await self.rpc_create_table({
+            "name": index_name, "table": idx_info.to_wire(),
+            "num_tablets": payload.get("num_tablets", 2),
+            "replication_factor": payload.get("replication_factor", 1)})
+        base.setdefault("indexes", {})[index_name] = {
+            "column": column, "index_table": index_name,
+            "base_pk": [c.name for c in pk_cols]}
+        self._persist()
+        return {"index_table_id": resp["table_id"]}
 
     async def rpc_get_status_tablet(self, payload) -> dict:
         """Return (creating on demand) the transaction status tablet

@@ -83,8 +83,14 @@ class SqlSession:
         if isinstance(stmt, TxnStmt):
             return await self._txn_stmt(stmt)
         if isinstance(stmt, CreateIndexStmt):
-            n = await self.client.build_vector_index(
-                stmt.table, stmt.column, stmt.lists)
+            ct = await self.client._table(stmt.table)
+            col = ct.info.schema.column_by_name(stmt.column)
+            if col.type == ColumnType.VECTOR or stmt.method == "ivfflat":
+                n = await self.client.build_vector_index(
+                    stmt.table, stmt.column, stmt.lists)
+            else:
+                n = await self.client.create_secondary_index(
+                    stmt.table, stmt.name, stmt.column)
             return SqlResult([], f"CREATE INDEX ({n} rows)")
         if isinstance(stmt, SelectStmt):
             if stmt.knn is not None:
@@ -204,6 +210,13 @@ class SqlSession:
                 return await self._grouped_pushdown(stmt, ct, where, gspec)
             return await self._grouped_clientside(stmt, ct, where)
 
+        # index-accelerated equality lookup (reference: index scans via
+        # yb_lsm.c index AM)
+        idx_rows = await self._try_index_path(stmt, ct, where)
+        if idx_rows is not None:
+            rows = [self._project_row(stmt, r, schema) for r in idx_rows]
+            return SqlResult(self._order_limit(stmt, rows))
+
         # plain row scan
         columns = self._needed_columns(stmt, schema)
         resp = await self.client.scan(stmt.table, ReadRequest(
@@ -212,6 +225,57 @@ class SqlSession:
         rows = [self._project_row(stmt, r, schema) for r in resp.rows]
         rows = self._order_limit(stmt, rows)
         return SqlResult(rows)
+
+    async def _try_index_path(self, stmt, ct, where_bound):
+        """WHERE col = const (optionally AND residual) with a secondary
+        index on col -> index lookup + point gets + residual filter."""
+        if not ct.indexes or stmt.where is None or self._txn is not None:
+            return None
+        eq = self._extract_index_eq(stmt.where, ct)
+        if eq is None:
+            return None
+        index_name, value, residual = eq
+        pks = await self.client.index_lookup(stmt.table, index_name, value)
+        rows = []
+        schema = ct.info.schema
+        for pk in pks:
+            row = await self.client.get(stmt.table, pk)
+            if row is None:
+                continue
+            if residual is not None:
+                idrow = {schema.column_by_name(k).id: v
+                         for k, v in row.items()}
+                from ..docdb.operations import eval_expr_py
+                if eval_expr_py(self._bind(residual, schema),
+                                idrow) is not True:
+                    continue
+            rows.append(row)
+        return rows
+
+    def _extract_index_eq(self, node, ct):
+        """Match `col = const` or `col = const AND residual`; returns
+        (index_name, value, residual_ast|None)."""
+        indexed = {spec["column"]: name
+                   for name, spec in (ct.indexes or {}).items()}
+
+        def match_eq(n):
+            if n[0] == "cmp" and n[1] == "eq":
+                l, r = n[2], n[3]
+                if l[0] == "col" and r[0] == "const" and l[1] in indexed:
+                    return indexed[l[1]], r[1]
+                if r[0] == "col" and l[0] == "const" and r[1] in indexed:
+                    return indexed[r[1]], l[1]
+            return None
+
+        m = match_eq(node)
+        if m:
+            return m[0], m[1], None
+        if node[0] == "and":
+            for i, j in ((1, 2), (2, 1)):
+                m = match_eq(node[i])
+                if m:
+                    return m[0], m[1], node[j]
+        return None
 
     def _needed_columns(self, stmt: SelectStmt, schema) -> List[str]:
         if any(it[0] == "star" for it in stmt.items):

@@ -73,7 +73,7 @@ class CreateIndexStmt:
     name: str
     table: str
     column: str
-    method: str = "ivfflat"
+    method: str = "lsm"     # 'lsm' secondary index | 'ivfflat' vector ANN
     lists: int = 100
 
 
@@ -248,7 +248,7 @@ class Parser:
         name = self.ident()
         self.expect_kw("on")
         table = self.ident()
-        method = "ivfflat"
+        method = "lsm"
         if self.accept_kw("using"):
             method = self.ident().lower()
         self.expect_op("(")
