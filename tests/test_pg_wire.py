@@ -1,0 +1,135 @@
+"""PostgreSQL v3 wire protocol tests with a minimal raw-socket client
+implementing the same framing a real driver uses."""
+import asyncio
+import struct
+
+import pytest
+
+from yugabyte_db_tpu.ql.pg_server import PgServer
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class MiniPgClient:
+    def __init__(self, reader, writer):
+        self.reader, self.writer = reader, writer
+
+    async def startup(self, ssl_probe=False):
+        if ssl_probe:
+            self.writer.write(struct.pack(">II", 8, 80877103))
+            await self.writer.drain()
+            assert await self.reader.readexactly(1) == b"N"
+        params = b"user\x00yb\x00database\x00yb\x00\x00"
+        body = struct.pack(">I", 196608) + params
+        self.writer.write(struct.pack(">I", len(body) + 4) + body)
+        await self.writer.drain()
+        msgs = await self.read_until(b"Z")
+        assert any(t == b"R" for t, _ in msgs)      # AuthenticationOk
+        assert any(t == b"S" for t, _ in msgs)      # ParameterStatus
+        return msgs
+
+    async def read_msg(self):
+        hdr = await self.reader.readexactly(5)
+        (ln,) = struct.unpack(">I", hdr[1:5])
+        body = await self.reader.readexactly(ln - 4) if ln > 4 else b""
+        return hdr[:1], body
+
+    async def read_until(self, tag):
+        out = []
+        while True:
+            t, b = await self.read_msg()
+            out.append((t, b))
+            if t == tag:
+                return out
+
+    async def query(self, sql):
+        body = sql.encode() + b"\x00"
+        self.writer.write(b"Q" + struct.pack(">I", len(body) + 4) + body)
+        await self.writer.drain()
+        return await self.read_until(b"Z")
+
+    @staticmethod
+    def rows(msgs):
+        out = []
+        for t, b in msgs:
+            if t != b"D":
+                continue
+            (n,) = struct.unpack_from(">H", b)
+            pos = 2
+            vals = []
+            for _ in range(n):
+                (ln,) = struct.unpack_from(">i", b, pos)
+                pos += 4
+                if ln < 0:
+                    vals.append(None)
+                else:
+                    vals.append(b[pos:pos + ln].decode())
+                    pos += ln
+            out.append(vals)
+        return out
+
+
+class TestPgWire:
+    def test_psql_style_session(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            srv = PgServer(mc.client())
+            addr = await srv.start()
+            try:
+                reader, writer = await asyncio.open_connection(*addr)
+                c = MiniPgClient(reader, writer)
+                await c.startup(ssl_probe=True)   # psql always probes SSL
+                msgs = await c.query(
+                    "CREATE TABLE pgt (k bigint, v double, s text, "
+                    "PRIMARY KEY (k))")
+                assert any(t == b"C" for t, _ in msgs)
+                await mc.wait_for_leaders("pgt")
+                await c.query("INSERT INTO pgt (k, v, s) VALUES "
+                              "(1, 1.5, 'one'), (2, 2.5, 'two')")
+                msgs = await c.query("SELECT k, v, s FROM pgt ORDER BY k")
+                assert any(t == b"T" for t, _ in msgs)   # RowDescription
+                rows = c.rows(msgs)
+                assert rows == [["1", "1.5", "one"], ["2", "2.5", "two"]]
+                # multi-statement + aggregate
+                msgs = await c.query(
+                    "INSERT INTO pgt (k, v, s) VALUES (3, 3.5, 'x'); "
+                    "SELECT count(*) FROM pgt")
+                assert c.rows(msgs)[-1] == ["3"]
+                # error surfaces as ErrorResponse then ReadyForQuery
+                msgs = await c.query("SELECT * FROM missing_table")
+                assert msgs[0][0] == b"E"
+                assert b"42601" in msgs[0][1] or b"missing" in msgs[0][1]
+                assert msgs[-1][0] == b"Z"
+                # session still usable after the error
+                msgs = await c.query("SELECT s FROM pgt WHERE k = 1")
+                assert c.rows(msgs) == [["one"]]
+                writer.close()
+            finally:
+                await srv.shutdown()
+                await mc.shutdown()
+        run(go())
+
+    def test_extended_protocol_declined_cleanly(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            srv = PgServer(mc.client())
+            addr = await srv.start()
+            try:
+                reader, writer = await asyncio.open_connection(*addr)
+                c = MiniPgClient(reader, writer)
+                await c.startup()
+                # send a Parse message ('P')
+                body = b"\x00stmt\x00\x00\x00"
+                writer.write(b"P" + struct.pack(">I", len(body) + 4) + body)
+                await writer.drain()
+                msgs = await c.read_until(b"Z")
+                assert msgs[0][0] == b"E"
+                assert b"0A000" in msgs[0][1]
+                writer.close()
+            finally:
+                await srv.shutdown()
+                await mc.shutdown()
+        run(go())

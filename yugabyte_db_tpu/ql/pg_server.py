@@ -1,0 +1,181 @@
+"""YSQL: PostgreSQL v3 wire protocol server (simple query flow).
+
+The reference ships a full forked PostgreSQL (src/postgres/) in front of
+pggate; our round-1 YSQL surface is the v3 wire protocol implemented
+directly over the SQL executor: standard PG clients (psql, psycopg,
+JDBC in simple-query mode) can connect, issue queries, and read typed
+results. Supported: StartupMessage (incl. SSLRequest refusal),
+password-free auth, Query with multi-statement strings, RowDescription/
+DataRow/CommandComplete/EmptyQueryResponse, ErrorResponse with
+SQLSTATE, Terminate. Extended query protocol (Parse/Bind/Execute) is
+declined with a clear error (round-2).
+"""
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import List, Optional, Tuple
+
+from ..client import YBClient
+from .executor import SqlSession
+
+_PROTO_V3 = 196608
+_SSL_REQUEST = 80877103
+_CANCEL_REQUEST = 80877102
+
+# type OIDs
+_OID_BOOL, _OID_INT8, _OID_TEXT, _OID_FLOAT8, _OID_BYTEA = 16, 20, 25, 701, 17
+
+
+def _msg(tag: bytes, body: bytes = b"") -> bytes:
+    return tag + struct.pack(">I", len(body) + 4) + body
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\x00"
+
+
+class PgServer:
+    def __init__(self, client: YBClient, host="127.0.0.1", port=0):
+        self.client = client
+        self.host, self.port = host, port
+        self._server: Optional[asyncio.AbstractServer] = None
+        self.addr: Optional[Tuple[str, int]] = None
+
+    async def start(self):
+        self._server = await asyncio.start_server(self._handle, self.host,
+                                                  self.port)
+        self.addr = self._server.sockets[0].getsockname()[:2]
+        return self.addr
+
+    async def shutdown(self):
+        if self._server:
+            self._server.close()
+
+    # ------------------------------------------------------------------
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter):
+        session = SqlSession(self.client)
+        try:
+            if not await self._startup(reader, writer):
+                return
+            while True:
+                hdr = await reader.readexactly(5)
+                tag = hdr[:1]
+                (ln,) = struct.unpack(">I", hdr[1:5])
+                body = await reader.readexactly(ln - 4) if ln > 4 else b""
+                if tag == b"X":
+                    break
+                if tag == b"Q":
+                    await self._query(session, body, writer)
+                elif tag in (b"P", b"B", b"E", b"D", b"S", b"C", b"H"):
+                    writer.write(self._error(
+                        "0A000", "extended query protocol not supported; "
+                        "use simple query mode"))
+                    writer.write(_msg(b"Z", b"I"))
+                    await writer.drain()
+                else:
+                    writer.write(self._error("08P01",
+                                             f"unknown message {tag!r}"))
+                    writer.write(_msg(b"Z", b"I"))
+                    await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _startup(self, reader, writer) -> bool:
+        while True:
+            (ln,) = struct.unpack(">I", await reader.readexactly(4))
+            body = await reader.readexactly(ln - 4)
+            (proto,) = struct.unpack(">I", body[:4])
+            if proto == _SSL_REQUEST:
+                writer.write(b"N")           # no TLS; client retries plain
+                await writer.drain()
+                continue
+            if proto == _CANCEL_REQUEST:
+                return False
+            if proto != _PROTO_V3:
+                writer.write(self._error("08P01",
+                                         f"unsupported protocol {proto}"))
+                await writer.drain()
+                return False
+            break
+        writer.write(_msg(b"R", struct.pack(">I", 0)))   # AuthenticationOk
+        for k, v in (("server_version", "15.0 (ybtpu 0.1)"),
+                     ("server_encoding", "UTF8"),
+                     ("client_encoding", "UTF8"),
+                     ("DateStyle", "ISO"),
+                     ("integer_datetimes", "on")):
+            writer.write(_msg(b"S", _cstr(k) + _cstr(v)))
+        writer.write(_msg(b"K", struct.pack(">II", 0, 0)))
+        writer.write(_msg(b"Z", b"I"))
+        await writer.drain()
+        return True
+
+    # ------------------------------------------------------------------
+    async def _query(self, session: SqlSession, body: bytes, writer):
+        sql = body.rstrip(b"\x00").decode()
+        statements = [s.strip() for s in sql.split(";") if s.strip()]
+        if not statements:
+            writer.write(_msg(b"I"))
+            writer.write(_msg(b"Z", b"I"))
+            await writer.drain()
+            return
+        for stmt in statements:
+            try:
+                res = await session.execute(stmt)
+            except Exception as e:   # noqa: BLE001 — wire error frame
+                writer.write(self._error("42601", str(e)))
+                break
+            if res.rows:
+                cols = list(res.rows[0].keys())
+                writer.write(self._row_description(cols, res.rows[0]))
+                for r in res.rows:
+                    writer.write(self._data_row([r.get(c) for c in cols]))
+                writer.write(_msg(b"C", _cstr(f"SELECT {len(res.rows)}")))
+            else:
+                tag = res.status if res.status != "OK" else "SELECT 0"
+                writer.write(_msg(b"C", _cstr(tag)))
+        writer.write(_msg(b"Z", b"I"))
+        await writer.drain()
+
+    def _row_description(self, cols: List[str], sample: dict) -> bytes:
+        body = struct.pack(">H", len(cols))
+        for c in cols:
+            v = sample.get(c)
+            if isinstance(v, bool):
+                oid, size = _OID_BOOL, 1
+            elif isinstance(v, int):
+                oid, size = _OID_INT8, 8
+            elif isinstance(v, float):
+                oid, size = _OID_FLOAT8, 8
+            elif isinstance(v, bytes):
+                oid, size = _OID_BYTEA, -1
+            else:
+                oid, size = _OID_TEXT, -1
+            body += _cstr(c) + struct.pack(">IHIhih", 0, 0, oid, size, -1, 0)
+        return _msg(b"T", body)
+
+    def _data_row(self, values: List) -> bytes:
+        body = struct.pack(">H", len(values))
+        for v in values:
+            if v is None:
+                body += struct.pack(">i", -1)
+                continue
+            if isinstance(v, bool):
+                raw = b"t" if v else b"f"
+            elif isinstance(v, bytes):
+                raw = b"\\x" + v.hex().encode()
+            else:
+                raw = str(v).encode()
+            body += struct.pack(">i", len(raw)) + raw
+        return _msg(b"D", body)
+
+    def _error(self, sqlstate: str, message: str) -> bytes:
+        body = (b"S" + _cstr("ERROR") + b"C" + _cstr(sqlstate)
+                + b"M" + _cstr(message) + b"\x00")
+        return _msg(b"E", body)

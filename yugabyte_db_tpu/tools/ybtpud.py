@@ -15,6 +15,7 @@ import sys
 from ..master import Master
 from ..ql import SqlSession
 from ..ql.cql_server import CqlServer
+from ..ql.pg_server import PgServer
 from ..ql.redis_server import RedisServer
 from ..tserver import TabletServer
 from ..tserver.webserver import StatusWebServer
@@ -39,6 +40,9 @@ async def serve(args):
 
     from ..client import YBClient
     client = YBClient(maddr)
+    pg = PgServer(YBClient(maddr))
+    paddr = await pg.start()
+    print(f"ysql (pg wire): {paddr[0]}:{paddr[1]}")
     cql = CqlServer(client)
     caddr = await cql.start()
     print(f"ycql          : {caddr[0]}:{caddr[1]}")
