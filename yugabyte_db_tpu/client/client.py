@@ -46,39 +46,61 @@ class CachedTable:
 
 
 class YBClient:
-    def __init__(self, master_addr: Tuple[str, int],
-                 messenger: Optional[Messenger] = None):
-        self.master_addr = tuple(master_addr)
+    def __init__(self, master_addr=None, messenger: Optional[Messenger] = None,
+                 master_addrs=None):
+        """master_addr: single (host, port), or master_addrs: list of
+        them (multi-master HA — calls fail over to the leader)."""
+        if master_addrs is None:
+            master_addrs = [master_addr]
+        self.master_addrs = [tuple(a) for a in master_addrs]
+        self.master_addr = self.master_addrs[0]
         self.messenger = messenger or Messenger("client")
         self._tables: Dict[str, CachedTable] = {}     # name -> cache
+
+    async def _master_call(self, method: str, payload, timeout: float = 30.0):
+        """Call the leader master, failing over across known masters
+        (reference: master leader lookup in client/master_rpc.cc)."""
+        last = None
+        for attempt in range(10):
+            for addr in self.master_addrs:
+                try:
+                    return await self.messenger.call(
+                        addr, "master", method, payload, timeout=timeout)
+                except RpcError as e:
+                    last = e
+                    if e.code in ("LEADER_NOT_READY", "NETWORK_ERROR",
+                                  "SERVICE_UNAVAILABLE"):
+                        continue
+                    raise
+                except (asyncio.TimeoutError, OSError) as e:
+                    last = e
+                    continue
+            await asyncio.sleep(0.1 * (attempt + 1))
+        raise last or RpcError("no master reachable", "TIMED_OUT")
 
     # --- DDL --------------------------------------------------------------
     async def create_table(self, info: TableInfo, num_tablets: int = 2,
                            replication_factor: int = 1) -> str:
-        resp = await self.messenger.call(
-            self.master_addr, "master", "create_table",
+        resp = await self._master_call(
+            "create_table",
             {"name": info.name, "table": info.to_wire(),
              "num_tablets": num_tablets,
-             "replication_factor": replication_factor},
-            timeout=30.0)
+             "replication_factor": replication_factor})
         return resp["table_id"]
 
     async def drop_table(self, name: str) -> None:
-        await self.messenger.call(self.master_addr, "master", "drop_table",
-                                  {"name": name}, timeout=30.0)
+        await self._master_call("drop_table", {"name": name})
         self._tables.pop(name, None)
 
     async def list_tables(self) -> List[dict]:
-        resp = await self.messenger.call(self.master_addr, "master",
-                                         "list_tables", {})
+        resp = await self._master_call("list_tables", {})
         return resp["tables"]
 
     # --- MetaCache --------------------------------------------------------
     async def _table(self, name: str, refresh: bool = False) -> CachedTable:
         if not refresh and name in self._tables:
             return self._tables[name]
-        resp = await self.messenger.call(
-            self.master_addr, "master", "get_table", {"name": name})
+        resp = await self._master_call("get_table", {"name": name})
         info = TableInfo.from_wire(resp["table"])
         locs = []
         for l in resp["locations"]:
@@ -189,8 +211,8 @@ class YBClient:
                                      column: str) -> int:
         """Create + backfill (reference: online backfill,
         master/backfill_index.cc — ours quiesces via full scan)."""
-        await self.messenger.call(
-            self.master_addr, "master", "create_secondary_index",
+        await self._master_call(
+            "create_secondary_index",
             {"table": table, "index_name": index_name, "column": column},
             timeout=60.0)
         self._tables.pop(table, None)

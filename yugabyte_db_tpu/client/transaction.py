@@ -32,8 +32,7 @@ class YBTransaction:
     # ------------------------------------------------------------------
     async def _status_tablet(self) -> TabletLocation:
         if self._status_loc is None:
-            resp = await self.client.messenger.call(
-                self.client.master_addr, "master", "get_status_tablet", {})
+            resp = await self.client._master_call("get_status_tablet", {})
             l = resp["locations"][0]
             from ..dockv.partition import Partition
             self._status_loc = TabletLocation(

@@ -28,11 +28,12 @@ TS_LIVENESS_S = 3.0
 
 
 class Master:
-    def __init__(self, fs_root: str):
+    def __init__(self, fs_root: str, uuid: str = "m0"):
         self.fs_root = fs_root
+        self.uuid = uuid
         os.makedirs(fs_root, exist_ok=True)
-        self.messenger = Messenger("master")
-        # sys catalog state
+        self.messenger = Messenger(f"master-{uuid}")
+        # sys catalog state (the Raft-replicated state machine)
         self.tables: Dict[str, dict] = {}      # table_id -> entry
         self.tablets: Dict[str, dict] = {}     # tablet_id -> entry
         self.tservers: Dict[str, dict] = {}    # ts_uuid -> {addr, last_hb}
@@ -44,6 +45,60 @@ class Master:
         self._lb_task: Optional[asyncio.Task] = None
         self._running = False
         self.auto_balance = False   # ticked explicitly or via enable
+        # sys-catalog Raft (None = standalone single master, still
+        # journals through a local single-peer group once started)
+        self.consensus = None
+
+    # --- sys catalog as a Raft group (reference: master/sys_catalog.cc —
+    # "master state is stored in a single-tablet Raft group") -------------
+    async def start_consensus(self, peers) -> None:
+        """peers: [(uuid, (host, port))] including self. Catalog
+        mutations replicate through this group; followers apply the same
+        deltas, so any elected master serves DDL."""
+        from ..consensus import Log, RaftConfig, PeerSpec, RaftConsensus
+        cfg = RaftConfig([PeerSpec(u, tuple(a)) for u, a in peers])
+        log = Log(os.path.join(self.fs_root, "syscatalog-wal"))
+        self.consensus = RaftConsensus(
+            "syscatalog", self.uuid, cfg, log, self.messenger,
+            self.fs_root, self._apply_catalog_entry)
+        # rebuild from scratch on restart: snapshot already loaded; the
+        # log re-applies deltas idempotently (puts are last-writer-wins)
+        await self.consensus.start()
+
+    async def _apply_catalog_entry(self, entry) -> None:
+        import msgpack as _mp
+        for op in _mp.unpackb(entry.payload, raw=False):
+            kind = op[0]
+            if kind == "put_table":
+                self.tables[op[1]] = op[2]
+            elif kind == "del_table":
+                self.tables.pop(op[1], None)
+            elif kind == "put_tablet":
+                self.tablets[op[1]] = op[2]
+            elif kind == "del_tablet":
+                self.tablets.pop(op[1], None)
+        self._persist()
+
+    async def _commit_catalog(self, ops) -> None:
+        """Apply catalog deltas through Raft when running replicated;
+        direct when standalone."""
+        if self.consensus is None:
+            import types
+            e = types.SimpleNamespace(payload=__import__("msgpack").packb(ops))
+            await self._apply_catalog_entry(e)
+            return
+        import msgpack as _mp
+        await self.consensus.replicate("write", _mp.packb(ops))
+
+    def _check_leader(self) -> None:
+        if self.consensus is not None and not self.consensus.is_leader():
+            raise RpcError(
+                f"not the leader master "
+                f"(hint={self.consensus.leader_hint()})",
+                "LEADER_NOT_READY")
+
+    def is_leader(self) -> bool:
+        return self.consensus is None or self.consensus.is_leader()
 
     # --- persistence (sys catalog snapshot) -------------------------------
     @property
@@ -139,6 +194,7 @@ class Master:
         """CreateTable: compute partitions, pick replica sets, create
         tablets on tservers, commit to the catalog (reference:
         catalog_manager.cc:4444)."""
+        self._check_leader()
         name = payload["name"]
         if any(t["info"]["name"] == name for t in self.tables.values()):
             raise RpcError(f"table {name} exists", "ALREADY_PRESENT")
@@ -176,10 +232,11 @@ class Master:
                      "raft_peers": raft_peers,
                      "is_status_tablet": is_status},
                     timeout=10.0)
-        self.tables[table_id] = {"info": info_wire,
-                                 "tablets": list(tablet_entries)}
-        self.tablets.update(tablet_entries)
-        self._persist()
+        ops = [["put_table", table_id,
+                {"info": info_wire, "tablets": list(tablet_entries)}]]
+        ops += [["put_tablet", tid_, ent]
+                for tid_, ent in tablet_entries.items()]
+        await self._commit_catalog(ops)
         return {"table_id": table_id, "tablets": list(tablet_entries)}
 
     def _choose_replicas(self, live: List[str], rf: int, salt: int
@@ -191,6 +248,7 @@ class Master:
         return by_load[:rf]
 
     async def rpc_drop_table(self, payload) -> dict:
+        self._check_leader()
         name = payload["name"]
         tid = next((t for t, e in self.tables.items()
                     if e["info"]["name"] == name), None)
@@ -209,8 +267,9 @@ class Master:
                             {"tablet_id": tablet_id}, timeout=5.0)
                     except (RpcError, asyncio.TimeoutError, OSError):
                         pass
-        del self.tables[tid]
-        self._persist()
+        await self._commit_catalog(
+            [["del_table", tid]]
+            + [["del_tablet", t] for t in self.tables[tid]["tablets"]])
         return {"ok": True}
 
     # --- lookups ----------------------------------------------------------
@@ -242,6 +301,7 @@ class Master:
 
     # --- snapshots / PITR (reference: master/master_snapshot_coordinator.cc)
     async def rpc_create_snapshot(self, payload) -> dict:
+        self._check_leader()
         """Cluster-consistent table snapshot: checkpoint every tablet
         (hybrid-time consistency comes from checkpoints capturing a flushed
         image; cross-tablet cut at one HT lands with distributed txn
@@ -276,9 +336,11 @@ class Master:
             if not done:
                 raise RpcError(f"no leader for {tablet_id}",
                                "SERVICE_UNAVAILABLE")
-        snaps = self.tables[tid].setdefault("snapshots", {})
+        ent = dict(self.tables[tid])
+        snaps = dict(ent.get("snapshots", {}))
         snaps[snapshot_id] = {"manifest": manifest}
-        self._persist()
+        ent["snapshots"] = snaps
+        await self._commit_catalog([["put_table", tid, ent]])
         return {"snapshot_id": snapshot_id,
                 "tablets": len(manifest)}
 
@@ -318,14 +380,15 @@ class Master:
                 "tablet_id": child, "table_id": new_tid,
                 "partition": m["partition"], "replicas": [u],
                 "leader": None}
-        self.tables[new_tid] = {"info": info_wire,
-                                "tablets": list(tablet_entries)}
-        self.tablets.update(tablet_entries)
-        self._persist()
+        ops = [["put_table", new_tid,
+                {"info": info_wire, "tablets": list(tablet_entries)}]]
+        ops += [["put_tablet", t, e] for t, e in tablet_entries.items()]
+        await self._commit_catalog(ops)
         return {"table_id": new_tid}
 
     # --- tablet splitting (reference: master/tablet_split_manager.cc) ------
     async def rpc_split_tablet(self, payload) -> dict:
+        self._check_leader()
         tablet_id = payload["tablet_id"]
         ent = self.tablets.get(tablet_id)
         if ent is None:
@@ -350,17 +413,19 @@ class Master:
                  "right_id": right_id, "split_key": split_key,
                  "partition": ent["partition"], "table": info_wire,
                  "raft_peers": raft_peers}, timeout=60.0)
+        ops = []
         for child_id, part in ((left_id, [ent["partition"][0], split_key]),
                                (right_id, [split_key, ent["partition"][1]])):
-            self.tablets[child_id] = {
+            ops.append(["put_tablet", child_id, {
                 "tablet_id": child_id, "table_id": table_id,
                 "partition": part, "replicas": list(ent["replicas"]),
-                "leader": None}
-        del self.tablets[tablet_id]
-        tl = self.tables[table_id]["tablets"]
-        tl.remove(tablet_id)
-        tl.extend([left_id, right_id])
-        self._persist()
+                "leader": None}])
+        ops.append(["del_tablet", tablet_id])
+        tent = dict(self.tables[table_id])
+        tl = [t for t in tent["tablets"] if t != tablet_id]
+        tent["tablets"] = tl + [left_id, right_id]
+        ops.append(["put_table", table_id, tent])
+        await self._commit_catalog(ops)
         return {"left": left_id, "right": right_id}
 
     # --- secondary indexes (reference: index tables in catalog_manager,
@@ -393,10 +458,13 @@ class Master:
             "name": index_name, "table": idx_info.to_wire(),
             "num_tablets": payload.get("num_tablets", 2),
             "replication_factor": payload.get("replication_factor", 1)})
-        base.setdefault("indexes", {})[index_name] = {
+        tent = dict(base)
+        idxs = dict(tent.get("indexes", {}))
+        idxs[index_name] = {
             "column": column, "index_table": index_name,
             "base_pk": [c.name for c in pk_cols]}
-        self._persist()
+        tent["indexes"] = idxs
+        await self._commit_catalog([["put_table", tid, tent]])
         return {"index_table_id": resp["table_id"]}
 
     async def rpc_get_status_tablet(self, payload) -> dict:

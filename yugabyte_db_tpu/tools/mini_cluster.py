@@ -17,22 +17,55 @@ from ..tserver import TabletServer
 
 
 class MiniCluster:
-    def __init__(self, root: str, num_tservers: int = 3):
+    def __init__(self, root: str, num_tservers: int = 3,
+                 num_masters: int = 1):
         self.root = root
         self.num_tservers = num_tservers
-        self.master: Optional[Master] = None
+        self.num_masters = num_masters
+        self.masters: List[Master] = []
         self.tservers: List[TabletServer] = []
 
+    @property
+    def master(self) -> Master:
+        """The leader master (falls back to the first)."""
+        for m in self.masters:
+            if m.is_leader():
+                return m
+        return self.masters[0]
+
+    def master_addrs(self):
+        return [m.messenger.addr for m in self.masters]
+
     async def start(self) -> "MiniCluster":
-        self.master = Master(os.path.join(self.root, "master"))
-        maddr = await self.master.start()
+        for i in range(self.num_masters):
+            m = Master(os.path.join(self.root, f"master-{i}"), uuid=f"m{i}")
+            await m.start()
+            self.masters.append(m)
+        if self.num_masters > 1:
+            peers = [(m.uuid, m.messenger.addr) for m in self.masters]
+            for m in self.masters:
+                await m.start_consensus(peers)
+            # wait for a leader master
+            t0 = asyncio.get_event_loop().time()
+            while asyncio.get_event_loop().time() - t0 < 10.0:
+                if any(m.is_leader() and m.consensus is not None
+                       and m.consensus.is_leader() for m in self.masters):
+                    break
+                await asyncio.sleep(0.05)
+        maddrs = self.master_addrs()
         for i in range(self.num_tservers):
             ts = TabletServer(f"ts-{i}", os.path.join(self.root, f"ts-{i}"),
-                              master_addrs=[maddr])
+                              master_addrs=maddrs)
             await ts.start()
             self.tservers.append(ts)
         await self.wait_for_tservers()
         return self
+
+    async def stop_master(self, idx: int):
+        m = self.masters[idx]
+        if m.consensus is not None:
+            await m.consensus.shutdown()
+        await m.shutdown()
 
     async def wait_for_tservers(self, timeout: float = 10.0):
         t0 = asyncio.get_event_loop().time()
@@ -45,13 +78,13 @@ class MiniCluster:
         raise TimeoutError("tservers did not register")
 
     def client(self) -> YBClient:
-        return YBClient(self.master.messenger.addr)
+        return YBClient(master_addrs=self.master_addrs())
 
     async def restart_tserver(self, idx: int):
         ts = self.tservers[idx]
         await ts.shutdown()
         new = TabletServer(ts.uuid, ts.fs_root,
-                           master_addrs=[self.master.messenger.addr])
+                           master_addrs=self.master_addrs())
         await new.start()
         self.tservers[idx] = new
         return new
@@ -85,5 +118,7 @@ class MiniCluster:
     async def shutdown(self):
         for ts in self.tservers:
             await ts.shutdown()
-        if self.master:
-            await self.master.shutdown()
+        for m in self.masters:
+            if m.consensus is not None:
+                await m.consensus.shutdown()
+            await m.shutdown()

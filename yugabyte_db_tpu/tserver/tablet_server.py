@@ -443,6 +443,5 @@ class TabletServer:
                 await self.messenger.call(tuple(addr), "master-heartbeat",
                                           "ts_heartbeat", report,
                                           timeout=2.0)
-                return
             except (RpcError, asyncio.TimeoutError, OSError):
                 continue
