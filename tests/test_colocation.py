@@ -1,0 +1,82 @@
+"""Colocated tables / tablegroups tests (reference analog:
+architecture/design/ysql-colocated-tables.md, ysql_tablegroup_manager)."""
+import asyncio
+
+import pytest
+
+from yugabyte_db_tpu.docdb import ReadRequest
+from yugabyte_db_tpu.docdb.table_codec import TableInfo
+from yugabyte_db_tpu.dockv.packed_row import (
+    ColumnSchema, ColumnType, TableSchema,
+)
+from yugabyte_db_tpu.dockv.partition import PartitionSchema
+from yugabyte_db_tpu.ops import AggSpec, Expr
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+C = Expr.col
+
+
+def small_table(name, cols=("v",)):
+    schema_cols = [ColumnSchema(0, "k", ColumnType.INT64, is_hash_key=True)]
+    for i, c in enumerate(cols):
+        schema_cols.append(ColumnSchema(i + 1, c, ColumnType.FLOAT64))
+    return TableInfo("", name, TableSchema(tuple(schema_cols), 1),
+                     PartitionSchema("hash", 1))
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestColocation:
+    def test_two_tables_one_tablet(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                c = mc.client()
+                await c.create_tablegroup("g1")
+                await c.create_table(small_table("t_a"), tablegroup="g1")
+                await c.create_table(small_table("t_b"), tablegroup="g1")
+                # both tables share ONE tablet on the tserver
+                ts = mc.tservers[0]
+                assert len(ts.peers) == 1
+                peer = next(iter(ts.peers.values()))
+                assert len(peer.tablet.tables()) == 3  # parent + 2
+                await mc.wait_for_leaders("t_a")
+                await c.insert("t_a", [{"k": i, "v": float(i)}
+                                       for i in range(10)])
+                await c.insert("t_b", [{"k": i, "v": float(i) * 100}
+                                       for i in range(5)])
+                # reads keep the tables separate (cotable key prefixes)
+                assert (await c.get("t_a", {"k": 3}))["v"] == 3.0
+                assert (await c.get("t_b", {"k": 3}))["v"] == 300.0
+                ra = await c.scan("t_a", ReadRequest(
+                    "", aggregates=(AggSpec("count"),)))
+                rb = await c.scan("t_b", ReadRequest(
+                    "", aggregates=(AggSpec("count"),)))
+                assert int(ra.agg_values[0]) == 10
+                assert int(rb.agg_values[0]) == 5
+                # filtered scan doesn't leak across cotables
+                rows = await c.scan("t_b", ReadRequest(
+                    "", columns=("k",), where=(C(1) > 0.0).node))
+                assert {r["k"] for r in rows.rows} == {1, 2, 3, 4}
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_colocated_survive_restart(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                c = mc.client()
+                await c.create_tablegroup("g2")
+                await c.create_table(small_table("ca"), tablegroup="g2")
+                await mc.wait_for_leaders("ca")
+                await c.insert("ca", [{"k": 1, "v": 7.0}])
+                await mc.restart_tserver(0)
+                await mc.wait_for_leaders("ca")
+                c2 = mc.client()
+                assert (await c2.get("ca", {"k": 1}))["v"] == 7.0
+            finally:
+                await mc.shutdown()
+        run(go())

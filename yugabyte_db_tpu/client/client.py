@@ -80,13 +80,22 @@ class YBClient:
 
     # --- DDL --------------------------------------------------------------
     async def create_table(self, info: TableInfo, num_tablets: int = 2,
-                           replication_factor: int = 1) -> str:
+                           replication_factor: int = 1,
+                           tablegroup: Optional[str] = None) -> str:
         resp = await self._master_call(
             "create_table",
             {"name": info.name, "table": info.to_wire(),
              "num_tablets": num_tablets,
-             "replication_factor": replication_factor})
+             "replication_factor": replication_factor,
+             "tablegroup": tablegroup})
         return resp["table_id"]
+
+    async def create_tablegroup(self, name: str,
+                                replication_factor: int = 1) -> str:
+        resp = await self._master_call(
+            "create_tablegroup",
+            {"name": name, "replication_factor": replication_factor})
+        return resp["tablegroup_id"]
 
     async def drop_table(self, name: str) -> None:
         await self._master_call("drop_table", {"name": name})

@@ -387,7 +387,8 @@ class DocReadOperation:
 
     def _execute_cpu(self, req: ReadRequest) -> ReadResponse:
         read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
-        lower = req.paging_state or None
+        table_prefix = self.codec.scan_prefix()
+        lower = req.paging_state or (table_prefix or None)
         rows_out: List[Dict[str, object]] = []
         aggs = list(_expand_avg_cpu(req.aggregates))
         agg_state = [_agg_init(a) for a in aggs]
@@ -399,6 +400,8 @@ class DocReadOperation:
         by_id = {c.id: c.name for c in self.codec.schema.columns}
         name_to_id = {c.name: c.id for c in self.codec.schema.columns}
         for k, v in self.store.iterate(lower=lower):
+            if table_prefix and not k.startswith(table_prefix):
+                break                      # left this cotable's key space
             marker = len(k) - _HT_SUFFIX
             prefix = k[:marker]
             if prefix != cur_prefix:

@@ -43,6 +43,7 @@ class TableInfo:
     schema: TableSchema
     partition_schema: PartitionSchema
     packings: SchemaPackingStorage = field(default_factory=SchemaPackingStorage)
+    cotable_id: Optional[int] = None    # set for colocated tables
 
     def __post_init__(self):
         if self.schema.version not in getattr(self.packings, "_packings", {}):
@@ -64,6 +65,7 @@ class TableInfo:
             "partition": {"kind": self.partition_schema.kind,
                           "num_hash_columns":
                               self.partition_schema.num_hash_columns},
+            "cotable_id": self.cotable_id,
         }
 
     @classmethod
@@ -74,7 +76,8 @@ class TableInfo:
             version=d["schema"]["version"])
         return cls(d["table_id"], d["name"], schema,
                    PartitionSchema(d["partition"]["kind"],
-                                   d["partition"]["num_hash_columns"]))
+                                   d["partition"]["num_hash_columns"]),
+                   cotable_id=d.get("cotable_id"))
 
 
 _KEV_MAKER = {
@@ -116,7 +119,10 @@ class TableCodec:
         return out
 
     def doc_key(self, row: Dict[str, object]) -> DocKey:
-        return self.info.partition_schema.doc_key_for_row(self.pk_entries(row))
+        dk = self.info.partition_schema.doc_key_for_row(self.pk_entries(row))
+        if self.info.cotable_id is not None:
+            dk = DocKey(dk.hash, dk.hashed, dk.range, self.info.cotable_id)
+        return dk
 
     def encode_write(self, row: Dict[str, object], dht: DocHybridTime
                      ) -> Tuple[bytes, bytes]:
@@ -133,6 +139,15 @@ class TableCodec:
     def doc_key_prefix(self, pk_row: Dict[str, object]) -> bytes:
         return self.doc_key(pk_row).encode()
 
+    def scan_prefix(self) -> bytes:
+        """Key-space prefix owned by this table within its tablet —
+        empty for dedicated tablets, the cotable prefix for colocated
+        tables (bounds every scan)."""
+        if self.info.cotable_id is None:
+            return b""
+        return bytes([ValueType.kCoTableId]) + \
+            self.info.cotable_id.to_bytes(4, "big")
+
     def hash_prefix(self, row: Dict[str, object]) -> bytes:
         """Encoded prefix covering just the hash components — used for
         prefix scans (e.g. secondary-index lookups by indexed value)."""
@@ -143,7 +158,7 @@ class TableCodec:
             maker = _KEV_MAKER[c.type]
             entries.append(maker(row[c.name]))
         from ..dockv.partition import hash_key_for
-        kb = KeyBytes()
+        kb = KeyBytes(self.scan_prefix())
         kb.append_hash(hash_key_for(entries))
         for e in entries:
             kb.append_entry(e)

@@ -38,6 +38,7 @@ class ValueType:
     kGroupEnd = 0x03
     kHybridTime = 0x05
     kUInt16Hash = 0x08   # 2-byte big-endian hash prefix (key start only)
+    kCoTableId = 0x0A    # 4-byte BE colocated-table id (key start only)
     # value types
     kNull = 0x20
     kFalse = 0x22
@@ -303,20 +304,26 @@ class KeyBytes:
 
 @dataclass(frozen=True)
 class DocKey:
-    """Primary-key portion of a row key (reference: dockv/doc_key.h:95)."""
+    """Primary-key portion of a row key (reference: dockv/doc_key.h:95;
+    colocated tables carry a cotable prefix, doc_key.h:40-60)."""
 
     hash: Optional[int] = None                 # 16-bit partition hash
     hashed: Tuple[KeyEntryValue, ...] = ()
     range: Tuple[KeyEntryValue, ...] = ()
+    cotable_id: Optional[int] = None           # colocated table id
 
     @classmethod
     def make(cls, hash: Optional[int] = None,
              hashed: Iterable[KeyEntryValue] = (),
-             range: Iterable[KeyEntryValue] = ()) -> "DocKey":
-        return cls(hash, tuple(hashed), tuple(range))
+             range: Iterable[KeyEntryValue] = (),
+             cotable_id: Optional[int] = None) -> "DocKey":
+        return cls(hash, tuple(hashed), tuple(range), cotable_id)
 
     def encode(self) -> bytes:
         kb = KeyBytes()
+        if self.cotable_id is not None:
+            kb.append_raw(bytes([ValueType.kCoTableId])
+                          + self.cotable_id.to_bytes(4, "big"))
         if self.hash is not None:
             kb.append_hash(self.hash)
             for e in self.hashed:
@@ -332,6 +339,10 @@ class DocKey:
         hash_ = None
         hashed: List[KeyEntryValue] = []
         range_: List[KeyEntryValue] = []
+        cotable = None
+        if pos < len(data) and data[pos] == ValueType.kCoTableId:
+            cotable = int.from_bytes(data[pos + 1:pos + 5], "big")
+            pos += 5
         if pos < len(data) and data[pos] == ValueType.kUInt16Hash:
             hash_ = int.from_bytes(data[pos + 1:pos + 3], "big")
             pos += 3
@@ -344,7 +355,7 @@ class DocKey:
             range_.append(e)
         if pos >= len(data) or data[pos] != ValueType.kGroupEnd:
             raise ValueError("doc key missing range group end")
-        return cls(hash_, tuple(hashed), tuple(range_)), pos + 1
+        return cls(hash_, tuple(hashed), tuple(range_), cotable), pos + 1
 
 
 @dataclass(frozen=True)

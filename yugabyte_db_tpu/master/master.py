@@ -208,6 +208,8 @@ class Master:
         table_id = payload.get("table_id") or f"tbl-{uuidlib.uuid4().hex[:12]}"
         info_wire = dict(payload["table"])
         info_wire["table_id"] = table_id
+        if payload.get("tablegroup"):
+            return await self._create_colocated(payload, table_id, info_wire)
         info = TableInfo.from_wire(info_wire)
         parts = info.partition_schema.create_partitions(num_tablets)
         tablet_entries = {}
@@ -238,6 +240,31 @@ class Master:
                 for tid_, ent in tablet_entries.items()]
         await self._commit_catalog(ops)
         return {"table_id": table_id, "tablets": list(tablet_entries)}
+
+    async def _create_colocated(self, payload, table_id, info_wire) -> dict:
+        gid, gent = self._find_tablegroup(payload["tablegroup"])
+        if gid is None:
+            raise RpcError(f"tablegroup {payload['tablegroup']} not found",
+                           "NOT_FOUND")
+        cotable = gent.get("next_cotable", 1)
+        info_wire["cotable_id"] = cotable
+        tablet_id = gent["tablets"][0]
+        tent = self.tablets[tablet_id]
+        for u in tent["replicas"]:
+            ts = self.tservers.get(u)
+            if ts:
+                await self.messenger.call(
+                    ts["addr"], "tserver", "add_table",
+                    {"tablet_id": tablet_id, "table": info_wire},
+                    timeout=30.0)
+        new_gent = dict(gent)
+        new_gent["next_cotable"] = cotable + 1
+        ops = [["put_table", gid, new_gent],
+               ["put_table", table_id,
+                {"info": info_wire, "tablets": [tablet_id],
+                 "colocated_in": gid}]]
+        await self._commit_catalog(ops)
+        return {"table_id": table_id, "tablets": [tablet_id]}
 
     def _choose_replicas(self, live: List[str], rf: int, salt: int
                          ) -> List[str]:
@@ -427,6 +454,46 @@ class Master:
         ops.append(["put_table", table_id, tent])
         await self._commit_catalog(ops)
         return {"left": left_id, "right": right_id}
+
+    # --- tablegroups / colocated tables -----------------------------------
+    async def rpc_create_tablegroup(self, payload) -> dict:
+        self._check_leader()
+        name = payload["name"]
+        rf = payload.get("replication_factor", 1)
+        gid = f"tg-{uuidlib.uuid4().hex[:10]}"
+        parent_wire = TableInfo(
+            gid + ".parent", f"{name}.parent",
+            TableSchema(columns=(
+                ColumnSchema(0, "k", "string", is_hash_key=True),),
+                version=1),
+            PartitionSchema("hash", 1)).to_wire()
+        live = self.live_tservers()
+        if len(live) < rf:
+            raise RpcError("not enough tservers", "SERVICE_UNAVAILABLE")
+        replicas = self._choose_replicas(live, rf, 0)
+        tablet_id = f"{gid}-t0"
+        raft_peers = [[u, list(self.tservers[u]["addr"])] for u in replicas]
+        for u in replicas:
+            await self.messenger.call(
+                self.tservers[u]["addr"], "tserver", "create_tablet",
+                {"tablet_id": tablet_id, "table": parent_wire,
+                 "partition": ["", ""], "raft_peers": raft_peers,
+                 "colocated": True}, timeout=30.0)
+        ent = {"tablet_id": tablet_id, "table_id": gid,
+               "partition": ["", ""], "replicas": replicas, "leader": None}
+        ops = [["put_table", gid, {"info": parent_wire,
+                                   "tablets": [tablet_id],
+                                   "tablegroup": name,
+                                   "next_cotable": 1}],
+               ["put_tablet", tablet_id, ent]]
+        await self._commit_catalog(ops)
+        return {"tablegroup_id": gid, "tablet_id": tablet_id}
+
+    def _find_tablegroup(self, name: str):
+        for tid, e in self.tables.items():
+            if e.get("tablegroup") == name:
+                return tid, e
+        return None, None
 
     # --- secondary indexes (reference: index tables in catalog_manager,
     # online backfill master/backfill_index.cc) ---------------------------

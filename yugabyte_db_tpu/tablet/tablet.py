@@ -34,22 +34,29 @@ _DEVICE_CACHE = DeviceBlockCache()
 class Tablet:
     def __init__(self, tablet_id: str, info: TableInfo, directory: str,
                  clock: Optional[HybridClock] = None,
-                 partition=None):
+                 partition=None, colocated: bool = False):
         self.tablet_id = tablet_id
         self.info = info
         self.partition = partition
         self.dir = directory
+        self.colocated = colocated
         os.makedirs(directory, exist_ok=True)
         self.codec = TableCodec(info)
+        # colocated tablets host several tables (reference:
+        # ysql-colocated-tables design; cotable-prefixed doc keys)
+        self.codecs: Dict[str, TableCodec] = {info.table_id: self.codec}
         self.clock = clock or HybridClock()
         self.regular = LsmStore(
             os.path.join(directory, "regular"), name="regular",
-            columnar_builder=self.codec.columnar_builder,
-            row_decoder=self.codec.row_decoder)
+            columnar_builder=(None if colocated
+                              else self.codec.columnar_builder),
+            row_decoder=(None if colocated else self.codec.row_decoder))
         self.intents = LsmStore(
             os.path.join(directory, "intents"), name="intents")
         self._read_op = DocReadOperation(
             self.codec, self.regular, device_cache=_DEVICE_CACHE)
+        self._read_ops: Dict[str, DocReadOperation] = {
+            info.table_id: self._read_op}
         # vector ANN indexes: col_id -> (IvfFlatIndex, [pk rows])
         self.vector_indexes: Dict[int, tuple] = {}
         self._lock = threading.Lock()
@@ -59,12 +66,26 @@ class Tablet:
         self._m_reads = ent.counter("read_ops")
         self._m_read_lat = ent.histogram("read_latency_us")
 
+    # --- colocation ---------------------------------------------------------
+    def add_table(self, info: TableInfo) -> None:
+        codec = TableCodec(info)
+        self.codecs[info.table_id] = codec
+        self._read_ops[info.table_id] = DocReadOperation(
+            codec, self.regular, device_cache=None)
+
+    def _codec_for(self, table_id: str) -> TableCodec:
+        return self.codecs.get(table_id, self.codec)
+
+    def tables(self):
+        return list(self.codecs)
+
     # --- writes (called under Raft apply, or directly in single-node) -----
     def apply_write(self, req: WriteRequest,
                     ht: Optional[HybridTime] = None,
                     op_id=None) -> WriteResponse:
         ht = ht or self.clock.now()
-        batch, n = DocWriteOperation(self.codec, req).apply(ht, op_id=op_id)
+        batch, n = DocWriteOperation(self._codec_for(req.table_id),
+                                     req).apply(ht, op_id=op_id)
         self.regular.apply(batch)
         self._m_rows_written.increment(n)
         if self.regular.should_flush():
@@ -77,7 +98,7 @@ class Tablet:
         t0 = time.perf_counter()
         if req.read_ht is None:
             req.read_ht = self.clock.now().value
-        resp = self._read_op.execute(req)
+        resp = self._read_ops.get(req.table_id, self._read_op).execute(req)
         self._m_reads.increment()
         self._m_read_lat.increment((time.perf_counter() - t0) * 1e6)
         return resp

@@ -80,7 +80,10 @@ class TabletServer:
         part = Partition(bytes.fromhex(meta["partition"][0]),
                          bytes.fromhex(meta["partition"][1]))
         tablet = Tablet(tablet_id, info, self._tablet_dir(tablet_id),
-                        clock=self.clock, partition=part)
+                        clock=self.clock, partition=part,
+                        colocated=meta.get("colocated", False))
+        for tw in meta.get("colocated_tables", []):
+            tablet.add_table(TableInfo.from_wire(tw))
         config = RaftConfig([PeerSpec(u, tuple(a))
                              for u, a in meta["raft_peers"]])
         peer = TabletPeer(tablet, self.uuid, config, self.messenger,
@@ -112,6 +115,8 @@ class TabletServer:
             "partition": payload["partition"],
             "raft_peers": payload["raft_peers"],
             "is_status_tablet": payload.get("is_status_tablet", False),
+            "colocated": payload.get("colocated", False),
+            "colocated_tables": [],
         }
         seed = payload.get("seed_snapshot_dir")
         if seed:
@@ -160,6 +165,21 @@ class TabletServer:
         req = read_request_from_wire(payload["req"])
         resp = peer.read(req)
         return read_response_to_wire(resp)
+
+    async def rpc_add_table(self, payload) -> dict:
+        """Add a colocated table to an existing tablet (reference:
+        tablegroups, master/ysql_tablegroup_manager.cc)."""
+        peer = self._peer(payload["tablet_id"])
+        info = TableInfo.from_wire(payload["table"])
+        peer.tablet.add_table(info)
+        meta_path = os.path.join(self._tablet_dir(payload["tablet_id"]),
+                                 "tablet-meta.json")
+        with open(meta_path) as f:
+            meta = json.load(f)
+        meta.setdefault("colocated_tables", []).append(payload["table"])
+        with open(meta_path, "w") as f:
+            json.dump(meta, f)
+        return {"ok": True}
 
     # --- remote bootstrap ----------------------------------------------------
     async def _remote_bootstrap_fetch(self, src_addr, tablet_id: str,
