@@ -1,0 +1,127 @@
+"""Randomized DocDB model checking.
+
+Reference strategy: an in-memory model double-checks DocDB under
+randomized operation sequences (src/yb/docdb/in_mem_docdb.cc,
+randomized_docdb-test.cc). Here: random upserts/deletes at increasing
+hybrid times with random flush/compaction interleavings; reads at random
+historical timestamps must match a versioned dict model; TPU aggregate
+results must match model-side aggregation too.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from yugabyte_db_tpu.docdb import ReadRequest, RowOp, WriteRequest
+from yugabyte_db_tpu.ops import AggSpec, Expr
+from yugabyte_db_tpu.tablet import Tablet
+from yugabyte_db_tpu.utils import flags
+from yugabyte_db_tpu.utils.hybrid_time import (
+    HybridClock, HybridTime, MockPhysicalClock,
+)
+from tests.test_tablet import make_info
+
+C = Expr.col
+
+
+class VersionedModel:
+    """The in-memory truth: key -> [(ht, row_or_None)] sorted by ht."""
+
+    def __init__(self):
+        self.hist = {}
+
+    def put(self, k, row, ht):
+        self.hist.setdefault(k, []).append((ht, row))
+
+    def delete(self, k, ht):
+        self.hist.setdefault(k, []).append((ht, None))
+
+    def get(self, k, read_ht):
+        best = None
+        for ht, row in self.hist.get(k, []):
+            if ht <= read_ht:
+                if best is None or ht > best[0]:
+                    best = (ht, row)
+        return best[1] if best else None
+
+    def visible_rows(self, read_ht):
+        out = {}
+        for k in self.hist:
+            r = self.get(k, read_ht)
+            if r is not None:
+                out[k] = r
+        return out
+
+
+@pytest.mark.parametrize("seed", [7, 23, 91])
+def test_randomized_ops_match_model(tmp_path, seed):
+    rng = random.Random(seed)
+    clock = HybridClock(MockPhysicalClock(1_000_000))
+    tablet = Tablet(f"rand-{seed}", make_info(), str(tmp_path),
+                    clock=clock)
+    model = VersionedModel()
+    checkpoints = []      # (read_ht, snapshot of model state at that point)
+
+    for step in range(300):
+        clock._physical.advance_micros(rng.randint(1, 50))
+        op = rng.random()
+        k = rng.randint(0, 30)
+        if op < 0.6:
+            row = {"k": k, "v": float(rng.randint(0, 1000)),
+                   "s": f"s{step}"}
+            resp = tablet.apply_write(WriteRequest(
+                "t1", [RowOp("upsert", row)]))
+            # the tablet assigned its own HT; read it back from the clock
+            ht = clock.now().value
+            model.put(k, row, ht - 1)   # write happened just before `now`
+        elif op < 0.75:
+            tablet.apply_write(WriteRequest("t1", [RowOp("delete",
+                                                         {"k": k})]))
+            ht = clock.now().value
+            model.delete(k, ht - 1)
+        elif op < 0.85:
+            tablet.flush()
+        elif op < 0.9 and tablet.num_sst_files() >= 2:
+            tablet.compact()
+        if rng.random() < 0.1:
+            checkpoints.append(clock.now().value)
+
+    # point reads at current time match the model
+    now = clock.now().value
+    for k in range(31):
+        got = tablet.read(ReadRequest("t1", pk_eq={"k": k},
+                                      read_ht=now))
+        expect = model.get(k, now)
+        if expect is None:
+            assert not got.rows, f"key {k}: expected absent"
+        else:
+            assert got.rows and got.rows[0]["v"] == expect["v"], \
+                f"key {k}: {got.rows} vs {expect}"
+
+    # historical reads at random checkpoints match (MVCC time travel)
+    for read_ht in checkpoints[:10]:
+        visible = model.visible_rows(read_ht)
+        resp = tablet.read(ReadRequest("t1", columns=("k", "v"),
+                                       read_ht=read_ht))
+        got = {r["k"]: r for r in resp.rows}
+        assert set(got) == set(visible), \
+            f"@{read_ht}: {sorted(got)} vs {sorted(visible)}"
+        for k, r in visible.items():
+            assert got[k]["v"] == r["v"]
+
+    # aggregate pushdown agrees with the model at a historical point
+    if checkpoints:
+        read_ht = checkpoints[-1]
+        visible = model.visible_rows(read_ht)
+        flags.set_flag("tpu_min_rows_for_pushdown", 1)
+        try:
+            resp = tablet.read(ReadRequest(
+                "t1", aggregates=(AggSpec("sum", C(1).node),
+                                  AggSpec("count")),
+                read_ht=read_ht))
+        finally:
+            flags.REGISTRY.reset("tpu_min_rows_for_pushdown")
+        expect_sum = sum(r["v"] for r in visible.values())
+        assert int(resp.agg_values[1]) == len(visible)
+        np.testing.assert_allclose(float(resp.agg_values[0]), expect_sum,
+                                   rtol=1e-5)
