@@ -144,3 +144,32 @@ class TestSqlEndToEnd:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestJson:
+    def test_json_operators(self, cluster):
+        async def go():
+            mc, s = await _session(cluster)
+            try:
+                await s.execute(
+                    "CREATE TABLE docs (k bigint, data jsonb, "
+                    "PRIMARY KEY (k))")
+                await mc.wait_for_leaders("docs")
+                await s.execute(
+                    "INSERT INTO docs (k, data) VALUES "
+                    "(1, '{\"name\": \"ada\", \"age\": 36}'), "
+                    "(2, '{\"name\": \"bob\", \"age\": 41}'), "
+                    "(3, '{\"name\": \"cyd\"}')")
+                r = await s.execute(
+                    "SELECT k FROM docs WHERE data ->> 'name' = 'bob'")
+                assert [row["k"] for row in r.rows] == [2]
+                # ->> on a missing key is NULL -> row filtered out
+                r = await s.execute(
+                    "SELECT k FROM docs WHERE data ->> 'age' = '36'")
+                assert [row["k"] for row in r.rows] == [1]
+                r = await s.execute(
+                    "SELECT data ->> 'name' FROM docs WHERE k = 3")
+                assert r.rows[0]["expr"] == "cyd"
+            finally:
+                await mc.shutdown()
+        run(go())

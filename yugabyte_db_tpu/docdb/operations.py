@@ -139,6 +139,28 @@ def eval_expr_py(node: tuple, row: Dict[int, object]):
         return x in node[2]
     if kind == "isnull":
         return eval_expr_py(node[1], row) is None
+    if kind == "json":
+        # ('json', 'text'|'value', expr, key) — PG ->> / -> semantics
+        import json as _json
+        v = eval_expr_py(node[2], row)
+        if v is None:
+            return None
+        try:
+            obj = _json.loads(v) if isinstance(v, (str, bytes)) else v
+        except (ValueError, TypeError):
+            return None
+        key = node[3]
+        if isinstance(obj, dict):
+            out = obj.get(key)
+        elif isinstance(obj, list) and isinstance(key, int):
+            out = obj[key] if -len(obj) <= key < len(obj) else None
+        else:
+            return None
+        if out is None:
+            return None
+        if node[1] == "text":
+            return out if isinstance(out, str) else _json.dumps(out)
+        return out if isinstance(out, (str, bytes)) else _json.dumps(out)
     raise ValueError(f"unknown node {kind}")
 
 
@@ -263,6 +285,12 @@ class DocReadOperation:
     def _tpu_eligible(self, req: ReadRequest) -> bool:
         if not flags.get("tpu_pushdown_enabled"):
             return False
+        from ..ops.expr import device_compatible
+        if req.where is not None and not device_compatible(req.where):
+            return False
+        for a in req.aggregates:
+            if a.expr is not None and not device_compatible(a.expr):
+                return False
         approx_rows = sum(r.num_entries for r in self.store.ssts)
         return approx_rows >= flags.get("tpu_min_rows_for_pushdown")
 

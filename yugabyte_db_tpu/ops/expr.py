@@ -68,6 +68,22 @@ class Expr:
     def is_null(self): return Expr(("isnull", self.node))
 
 
+_DEVICE_NODE_KINDS = {"col", "const", "cmp", "arith", "and", "or", "not",
+                      "between", "in", "isnull"}
+
+
+def device_compatible(node: ExprNode) -> bool:
+    """True when every node kind compiles to the device kernel (json
+    extraction etc. stay on the CPU row path)."""
+    if node[0] not in _DEVICE_NODE_KINDS:
+        return False
+    for c in node[1:]:
+        if isinstance(c, (tuple, list)) and c and isinstance(c[0], str):
+            if not device_compatible(c):
+                return False
+    return True
+
+
 def expr_signature(node: ExprNode) -> tuple:
     """Hashable structural signature: constants folded to their VALUES are
     part of the signature only when they change kernel shape (IN-list
@@ -238,8 +254,8 @@ def referenced_columns(node: ExprNode, out: set | None = None) -> set:
     out = out if out is not None else set()
     if node[0] == "col":
         out.add(node[1])
-    elif node[0] == "in":
-        referenced_columns(node[1], out)
+    elif node[0] in ("in", "json"):
+        referenced_columns(node[1] if node[0] == "in" else node[2], out)
     else:
         for c in node[1:]:
             if isinstance(c, (tuple, list)) and c and isinstance(c[0], str):

@@ -19,7 +19,7 @@ _TOKEN = re.compile(r"""
     \s*(?:
       (?P<num>-?\d+\.\d+(?:[eE][-+]?\d+)?|-?\d+)
     | (?P<str>'(?:[^']|'')*')
-    | (?P<op><->|<=|>=|<>|!=|[=<>(),;*+\-/])
+    | (?P<op><->|->>|->|<=|>=|<>|!=|[=<>(),;*+\-/])
     | (?P<word>[A-Za-z_][A-Za-z0-9_.]*)
     )""", re.VERBOSE)
 
@@ -487,6 +487,16 @@ class Parser:
                 return left
 
     def unary_expr(self):
+        node = self._primary_expr()
+        while True:
+            if self.accept_op("->>"):
+                node = ("json", "text", node, self.literal())
+            elif self.accept_op("->"):
+                node = ("json", "value", node, self.literal())
+            else:
+                return node
+
+    def _primary_expr(self):
         if self.accept_op("("):
             e = self.expr()
             self.expect_op(")")
