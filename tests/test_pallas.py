@@ -1,0 +1,30 @@
+"""Pallas fused-scan kernel vs numpy reference (interpret mode on the CPU
+mesh; the same kernel compiles for TPU)."""
+import numpy as np
+import pytest
+
+from yugabyte_db_tpu.ops.pallas_scan import BLOCK_ROWS, q6_scan
+
+
+class TestPallasScan:
+    def test_matches_numpy(self):
+        rng = np.random.default_rng(1)
+        n = 3 * BLOCK_ROWS + 777    # force padding
+        qty = rng.uniform(1, 50, n)
+        price = rng.uniform(900, 105000, n)
+        disc = rng.integers(0, 11, n) / 100.0
+        ship = rng.integers(8036, 10592, n).astype(float)
+        s, c = q6_scan(qty, price, disc, ship, 8766, 9131, 0.05, 0.07,
+                       24.0, interpret=True)
+        m = ((ship >= 8766) & (ship < 9131) & (disc >= 0.05)
+             & (disc <= 0.07) & (qty < 24))
+        assert c == int(m.sum())
+        expect = float((price * disc)[m].sum())
+        assert abs(s - expect) <= max(1e-6, 2e-4 * abs(expect))
+
+    def test_empty_match(self):
+        n = BLOCK_ROWS
+        z = np.zeros(n)
+        s, c = q6_scan(z, z, z, z, 10.0, 20.0, 0.5, 0.6, -1.0,
+                       interpret=True)
+        assert (s, c) == (0.0, 0)

@@ -1,0 +1,97 @@
+"""Pallas TPU kernel: fused predicate + masked-aggregate scan.
+
+The XLA path (ops/scan.py) already fuses well; this hand-written kernel
+is the Pallas counterpart for the hottest fixed shape — a Q6-style
+conjunctive range predicate with masked SUM/COUNT — streaming each row
+block HBM -> VMEM exactly once and emitting per-block partials (grid
+dim 0), which the host-side wrapper reduces. Serves as the template for
+further pallas offloads (compaction mask, grouped one-hot) and runs
+under interpret mode on CPU for tests.
+
+Layout notes (pallas_guide): blocks are (8, 128)-aligned f32 tiles; we
+use (BLOCK_ROWS,) = 8*128 multiples so each block is a whole tile row
+set; scalars ride in SMEM.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BLOCK_ROWS = 8 * 128 * 4          # 4096 rows per grid step
+
+
+def _q6_kernel(scalars_ref, qty_ref, price_ref, disc_ref, ship_ref,
+               valid_ref, sum_ref, cnt_ref):
+    ship_lo = scalars_ref[0]
+    ship_hi = scalars_ref[1]
+    disc_lo = scalars_ref[2]
+    disc_hi = scalars_ref[3]
+    qty_max = scalars_ref[4]
+    qty = qty_ref[:]
+    price = price_ref[:]
+    disc = disc_ref[:]
+    ship = ship_ref[:]
+    valid = valid_ref[:]
+    mask = ((ship >= ship_lo) & (ship < ship_hi)
+            & (disc >= disc_lo) & (disc <= disc_hi)
+            & (qty < qty_max) & (valid > 0))
+    maskf = mask.astype(jnp.float32)
+    sum_ref[0] = jnp.sum(price * disc * maskf)
+    cnt_ref[0] = jnp.sum(maskf)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def q6_scan_pallas(qty, price, disc, shipdate, valid, scalars,
+                   interpret: bool = False):
+    """scalars: [ship_lo, ship_hi, disc_lo, disc_hi, qty_max] f32.
+    Inputs must be f32 arrays padded to a BLOCK_ROWS multiple (valid=0 on
+    padding). Returns (revenue_sum, match_count)."""
+    from jax.experimental import pallas as pl
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+        smem = pltpu.SMEM
+    except ImportError:   # cpu-only install
+        smem = None
+    n = qty.shape[0]
+    grid = n // BLOCK_ROWS
+    blk = pl.BlockSpec((BLOCK_ROWS,), lambda i: (i,))
+    scalar_spec = (pl.BlockSpec(memory_space=smem) if smem is not None
+                   else pl.BlockSpec((5,), lambda i: (0,)))
+    sums, cnts = pl.pallas_call(
+        _q6_kernel,
+        grid=(grid,),
+        in_specs=[scalar_spec, blk, blk, blk, blk, blk],
+        out_specs=(pl.BlockSpec((1,), lambda i: (i,)),
+                   pl.BlockSpec((1,), lambda i: (i,))),
+        out_shape=(jax.ShapeDtypeStruct((grid,), jnp.float32),
+                   jax.ShapeDtypeStruct((grid,), jnp.float32)),
+        interpret=interpret,
+    )(scalars, qty, price, disc, shipdate, valid)
+    return jnp.sum(sums), jnp.sum(cnts)
+
+
+def q6_scan(qty: np.ndarray, price: np.ndarray, disc: np.ndarray,
+            shipdate: np.ndarray, ship_lo: float, ship_hi: float,
+            disc_lo: float, disc_hi: float, qty_max: float,
+            interpret: bool = False) -> Tuple[float, int]:
+    """Host wrapper: pads to the block grid and runs the kernel."""
+    n = len(qty)
+    padded = ((n + BLOCK_ROWS - 1) // BLOCK_ROWS) * BLOCK_ROWS
+
+    def pad(a):
+        out = np.zeros(padded, np.float32)
+        out[:n] = a
+        return jnp.asarray(out)
+
+    valid = np.zeros(padded, np.float32)
+    valid[:n] = 1.0
+    scalars = jnp.asarray(
+        np.array([ship_lo, ship_hi, disc_lo, disc_hi, qty_max], np.float32))
+    s, c = q6_scan_pallas(pad(qty), pad(price), pad(disc), pad(shipdate),
+                          jnp.asarray(valid), scalars,
+                          interpret=interpret)
+    return float(s), int(c)
