@@ -125,6 +125,36 @@ def main():
         "seconds": comp_s,
     }
 
+    # YCSB workload C (BASELINE config 1): engine-level point reads
+    from yugabyte_db_tpu.models.ycsb import YcsbTabletWorkload, usertable_info
+    from yugabyte_db_tpu.tablet import Tablet
+    yt = Tablet("ycsb", usertable_info(), tempfile.mkdtemp(prefix="ycsb-"))
+    w = YcsbTabletWorkload(yt, n_rows=100_000)
+    w.load()
+    rc = w.run("c", ops=int(os.environ.get("BENCH_YCSB_OPS", "2000")))
+    results["ycsb_c"] = {"ops_per_s": rc.ops_per_sec}
+
+    # Vector search micro (BASELINE config 5 at reduced scale by default;
+    # BENCH_FULL=1 runs 1M x 768)
+    from yugabyte_db_tpu.ops.vector import IvfFlatIndex
+    full = os.environ.get("BENCH_FULL") == "1"
+    vn, vd = (1_000_000, 768) if full else (200_000, 128)
+    rngv = np.random.default_rng(0)
+    base = rngv.normal(size=(vn, vd)).astype(np.float32)
+    t0 = time.perf_counter()
+    idx = IvfFlatIndex.build(base, nlists=200 if full else 64, iters=5)
+    build_s = time.perf_counter() - t0
+    q = base[:64] + 0.001
+    idx.search(q, k=10, nprobe=8)   # warm/compile
+    t0 = time.perf_counter()
+    for _ in range(5):
+        idx.search(q, k=10, nprobe=8)
+    search_s = (time.perf_counter() - t0) / 5
+    results["vector"] = {
+        "n": vn, "dim": vd, "build_s": build_s,
+        "qps": 64 / search_s,
+    }
+
     q6 = results["q6"]
     line = {
         "metric": "tpch_q6_sf%g_tpu_rows_per_sec" % sf,
@@ -138,6 +168,11 @@ def main():
         "q1": {"tpu_rows_per_s": round(results["q1"]["tpu_rows_per_s"], 1),
                "speedup": round(results["q1"]["speedup"], 3)},
         "compaction_mb_per_s": round(results["compaction"]["mb_per_s"], 2),
+        "ycsb_c_ops_per_s": round(results["ycsb_c"]["ops_per_s"], 1),
+        "vector": {"n": results["vector"]["n"],
+                   "dim": results["vector"]["dim"],
+                   "build_s": round(results["vector"]["build_s"], 2),
+                   "search_qps": round(results["vector"]["qps"], 1)},
     }
     print(json.dumps(line))
 

@@ -213,19 +213,48 @@ class DocReadOperation:
     # ---- point lookup ----------------------------------------------------
     def get_row(self, pk_row: Dict[str, object], read_ht: int
                 ) -> Optional[Dict[str, object]]:
+        """Newest visible version across memtable + SSTs, using per-SST
+        bloom filters and columnar binary search (reference:
+        DocDBTableReader point-get over BlockBasedTable::Get)."""
+        from ..dockv.value import unwrap_ttl
+        from ..storage.columnar import fnv64_bytes
         prefix = self.codec.doc_key_prefix(pk_row)
-        for k, v in self.store.seek(prefix):
-            if not k.startswith(prefix) or k[len(prefix)] != ValueType.kHybridTime:
-                return None
-            dht = DocHybridTime.decode_desc(k[-ENCODED_SIZE:])
-            if dht.ht.value > read_ht:
-                continue    # newer than read point; keep scanning versions
-            from ..dockv.value import unwrap_ttl
-            v, expire = unwrap_ttl(v)
-            if expire is not None and expire <= read_ht:
-                return None          # expired row
-            return self.codec.decode_row(k, v)
-        return None
+        h = fnv64_bytes(prefix)
+
+        def newest_visible(entries):
+            for k, v in entries:
+                if not k.startswith(prefix) or \
+                        k[len(prefix)] != ValueType.kHybridTime:
+                    return None
+                dht = DocHybridTime.decode_desc(k[-ENCODED_SIZE:])
+                if dht.ht.value > read_ht:
+                    continue
+                return (dht, k, v)
+            return None
+
+        best = None
+        with self.store._lock:
+            mems = [self.store._mem] + list(self.store._frozen)
+            ssts = list(self.store._ssts)
+        for m in mems:
+            c = newest_visible(m.seek(prefix))
+            if c and (best is None or (c[0].ht.value, c[0].write_id) >
+                      (best[0].ht.value, best[0].write_id)):
+                best = c
+        for r in ssts:
+            if not r.may_contain_hash(h):
+                continue
+            c = newest_visible(r.point_entries(prefix))
+            if c and (best is None or (c[0].ht.value, c[0].write_id) >
+                      (best[0].ht.value, best[0].write_id)):
+                best = c
+        if best is None:
+            return None
+        _, k, v = best
+        v, expire = unwrap_ttl(v)
+        if expire is not None and expire <= read_ht:
+            return None
+        return self.codec.decode_row(k, v)
 
     # ---- scans -----------------------------------------------------------
     def execute(self, req: ReadRequest) -> ReadResponse:

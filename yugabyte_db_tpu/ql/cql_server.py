@@ -140,7 +140,25 @@ class CqlServer:
     def _error(self, code: int, msg: str) -> Tuple[int, bytes]:
         return OP_ERROR, struct.pack(">i", code) + _string(msg)
 
+    def _system_rows(self, sql: str):
+        """Canned system.local/system.peers rows so Cassandra drivers can
+        hand-shake (reference: master YQL virtual system tables,
+        master/yql_*_vtable.cc)."""
+        low = sql.lower()
+        if "system.local" in low:
+            return [{"key": "local", "rpc_address": self.addr[0],
+                     "data_center": "dc1", "rack": "r1",
+                     "release_version": "3.4.5",
+                     "partitioner": "ybtpu-hash",
+                     "cluster_name": "ybtpu"}]
+        if "system.peers" in low:
+            return []
+        return None
+
     async def _run(self, sql: str) -> bytes:
+        sys_rows = self._system_rows(sql)
+        if sys_rows is not None:
+            return self._rows_result(sys_rows)
         res = await self.session.execute(sql)
         if not res.rows:
             if res.status.startswith(("CREATE", "DROP")):
@@ -149,15 +167,18 @@ class CqlServer:
                     _string("ybtpu") + _string("t")
                 return body
             return struct.pack(">i", K_VOID)
-        # rows result
-        cols = list(res.rows[0].keys())
+        return self._rows_result(res.rows)
+
+    def _rows_result(self, rows) -> bytes:
+        cols = list(rows[0].keys()) if rows else []
         body = struct.pack(">i", K_ROWS)
         body += struct.pack(">i", 0x0001)          # global tables spec
         body += struct.pack(">i", len(cols))
         body += _string("ybtpu") + _string("t")
+        sample = rows[0] if rows else {}
         for c in cols:
             body += _string(c)
-            v = res.rows[0][c]
+            v = sample.get(c)
             tid = 0x0D
             if isinstance(v, bool):
                 tid = 0x04
@@ -168,8 +189,8 @@ class CqlServer:
             elif isinstance(v, bytes):
                 tid = 0x03
             body += struct.pack(">H", tid)
-        body += struct.pack(">i", len(res.rows))
-        for r in res.rows:
+        body += struct.pack(">i", len(rows))
+        for r in rows:
             for c in cols:
                 body += _bytes_value(r[c], None)
         return body

@@ -257,6 +257,41 @@ class ColumnarBlock:
         """MVCC visibility: rows written at or before read_ht."""
         return self.ht <= np.uint64(read_ht)
 
+    def slice(self, lo: int, hi: int) -> "ColumnarBlock":
+        """Cheap row-range view [lo, hi) — used by point lookups so a
+        single row decodes without materializing the whole block."""
+        out = ColumnarBlock(
+            n=hi - lo, schema_version=self.schema_version,
+            key_hash=self.key_hash[lo:hi], ht=self.ht[lo:hi],
+            write_id=self.write_id[lo:hi], tombstone=self.tombstone[lo:hi],
+            unique_keys=self.unique_keys,
+            keys=self.keys[lo:hi] if self.keys is not None else None)
+        for cid, arr in self.pk.items():
+            out.pk[cid] = arr[lo:hi]
+        for cid, (v, m) in self.fixed.items():
+            out.fixed[cid] = (v[lo:hi], m[lo:hi])
+        for cid, (ends, heap, null) in self.varlen.items():
+            starts = int(ends[lo - 1]) if lo else 0
+            new_ends = (ends[lo:hi].astype(np.int64) - starts).astype(
+                np.uint32)
+            out.varlen[cid] = (new_ends,
+                               heap[starts:int(ends[hi - 1]) if hi else 0],
+                               null[lo:hi])
+        return out
+
+    def searchsorted_key(self, key: bytes) -> int:
+        """First row index with keys[i] >= key (requires the keys matrix).
+        Pads/truncates `key` to the matrix width; doc-key prefix freedom
+        makes zero padding order-correct."""
+        assert self.keys is not None
+        w = self.keys.shape[1]
+        probe = key[:w].ljust(w, b"\x00")
+        v = np.dtype((np.void, w))
+        rows = np.ascontiguousarray(self.keys).view(v).reshape(-1)
+        target = np.frombuffer(probe, np.uint8).reshape(1, w)
+        t = np.ascontiguousarray(target).view(v).reshape(-1)[0]
+        return int(np.searchsorted(rows, t, side="left"))
+
 
 def _varint_len(v: int) -> int:
     n = 1

@@ -24,8 +24,20 @@ from typing import Callable, Iterator, List, Optional, Sequence, Tuple
 import msgpack
 import numpy as np
 
+from ..utils.hybrid_time import ENCODED_SIZE as _HT_ENC
 from . import native_lib
 from .columnar import ColumnarBlock, fnv64_bytes, fnv64_keys
+
+_HT_MARKER = 0x05          # dockv ValueType.kHybridTime
+_HT_SUFFIX = _HT_ENC + 1
+
+
+def _doc_key_of(k: bytes) -> bytes:
+    """Strip the hybrid-time suffix when present (doc-key bloom/point
+    lookups are by key prefix)."""
+    if len(k) > _HT_SUFFIX and k[-_HT_SUFFIX] == _HT_MARKER:
+        return k[:-_HT_SUFFIX]
+    return k
 
 MAGIC = b"YBTPUSST"
 DEFAULT_BLOCK_ROWS = 4096
@@ -225,7 +237,7 @@ class SstWriter:
                         offset=f.tell(), length=len(enc), num_rows=len(blk)))
                     f.write(enc)
                     self._num_entries += len(blk)
-                    row_hashes.extend(k for k, _ in blk)
+                    row_hashes.extend(_doc_key_of(k) for k, _ in blk)
             if index:
                 self._min_key = index[0].first_key
                 self._max_key = index[-1].last_key
@@ -310,6 +322,7 @@ class SstReader:
             d[meta["index_offset"]:meta["index_offset"] + meta["index_length"]])
         self.index = [BlockIndexEntry(*row) for row in raw_index]
         self._first_keys = [e.first_key for e in self.index]
+        self._col_cache: dict = {}
 
     @property
     def file_size(self) -> int:
@@ -352,13 +365,53 @@ class SstReader:
     def may_contain_hash(self, key_hash: int) -> bool:
         return self.bloom.may_contain(key_hash)
 
+    def point_entries(self, prefix: bytes) -> Iterator[Tuple[bytes, bytes]]:
+        """Entries whose key starts with `prefix` (a doc key), without
+        decoding whole columnar-only blocks — binary search in the block
+        keys matrix + single-row slice decode (the point-read fast path;
+        reference analog: BlockBasedTable::Get)."""
+        import bisect
+        bi = max(bisect.bisect_right(self._first_keys, prefix) - 1, 0)
+        for i in range(bi, len(self.index)):
+            e = self.index[i]
+            if e.first_key > prefix and not e.first_key.startswith(prefix):
+                return
+            if e.last_key < prefix:
+                continue
+            if e.length == 0 and self.row_decoder is not None:
+                cb = self.columnar_block(i)
+                pos = cb.searchsorted_key(prefix)
+                advanced = False
+                while pos < cb.n and cb.keys[pos].tobytes().startswith(
+                        prefix):
+                    yield from self.row_decoder(cb.slice(pos, pos + 1))
+                    pos += 1
+                    advanced = True
+                if pos < cb.n:
+                    return       # walked past the prefix inside this block
+                if not advanced and pos == 0:
+                    return
+            else:
+                for k, v in self._read_block(i):
+                    if k >= prefix:
+                        if not k.startswith(prefix):
+                            return
+                        yield k, v
+
     # --- columnar access --------------------------------------------------
     def columnar_block(self, i: int) -> Optional[ColumnarBlock]:
         e = self.index[i]
         if e.col_offset < 0:
             return None
-        return ColumnarBlock.deserialize(
+        cached = self._col_cache.get(i)
+        if cached is not None:
+            return cached
+        cb = ColumnarBlock.deserialize(
             self._data[e.col_offset:e.col_offset + e.col_length])
+        if len(self._col_cache) > 32:
+            self._col_cache.clear()
+        self._col_cache[i] = cb
+        return cb
 
     def columnar_blocks(self, lower: Optional[bytes] = None,
                         upper: Optional[bytes] = None

@@ -25,6 +25,7 @@ from ..tablet.tablet import Tablet
 from ..tablet.tablet_peer import TabletPeer
 from ..utils import flags
 from ..utils.hybrid_time import HybridClock
+from ..utils.trace import ASH, TRACES, wait_status
 
 
 class TabletServer:
@@ -157,13 +158,17 @@ class TabletServer:
     async def rpc_write(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
         req = write_request_from_wire(payload["req"])
-        resp = await peer.write(req)
+        with TRACES.trace(f"write:{payload['tablet_id']}"):
+            with wait_status("OnCpu_WriteApply"):
+                resp = await peer.write(req)
         return {"rows_affected": resp.rows_affected}
 
     async def rpc_read(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
         req = read_request_from_wire(payload["req"])
-        resp = peer.read(req)
+        with TRACES.trace(f"read:{payload['tablet_id']}"):
+            with wait_status("OnCpu_Read"):
+                resp = peer.read(req)
         return read_response_to_wire(resp)
 
     async def rpc_add_table(self, payload) -> dict:
@@ -435,9 +440,12 @@ class TabletServer:
 
     # --- heartbeats -------------------------------------------------------
     async def _heartbeat_loop(self):
+        from ..utils.trace import current_wait_state
+        ASH.register(lambda: (f"ts-{self.uuid}", current_wait_state()))
         ticks = 0
         while self._running:
             await self._heartbeat_once()
+            ASH.sample_once()
             ticks += 1
             if ticks % 25 == 0:      # ~every 5s: WAL retention pass
                 for p in list(self.peers.values()):
