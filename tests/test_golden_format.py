@@ -1,0 +1,72 @@
+"""Golden byte-format tests: the on-disk/wire encodings must stay stable
+across rounds (a change here is a disk-format break and needs a version
+gate — the AutoFlags pattern; reference: auto_flags.md)."""
+import numpy as np
+
+from yugabyte_db_tpu.dockv import (
+    DocKey, KeyEntryValue, SubDocKey, PrimitiveValue,
+)
+from yugabyte_db_tpu.dockv.packed_row import (
+    ColumnSchema, ColumnType, RowPacker, SchemaPacking, TableSchema,
+)
+from yugabyte_db_tpu.utils.hybrid_time import DocHybridTime, HybridTime
+
+K = KeyEntryValue
+
+
+class TestGoldenKeys:
+    def test_doc_key_bytes(self):
+        dk = DocKey.make(hash=0x1234, hashed=(K.int64(42),),
+                         range=(K.string("ab"),))
+        assert dk.encode().hex() == (
+            "081234"                  # hash marker + 0x1234
+            "26800000000000002a"      # kInt64 + biased 42
+            "03"                      # group end
+            "2a61620000"              # kString 'ab' + terminator
+            "03")                     # group end
+
+    def test_cotable_prefix_bytes(self):
+        dk = DocKey.make(range=(K.int32(1),), cotable_id=7)
+        assert dk.encode().hex() == (
+            "0a00000007"              # cotable marker + id 7
+            "2480000001"              # kInt32 + biased 1
+            "03")
+
+    def test_subdockey_ht_suffix(self):
+        dk = DocKey.make(range=(K.int64(1),))
+        sdk = SubDocKey(dk, (), DocHybridTime(HybridTime(0x1000), 2))
+        enc = sdk.encode()
+        assert enc[-13] == 0x05                   # kHybridTime marker
+        assert DocHybridTime.decode_desc(enc[-12:]) == \
+            DocHybridTime(HybridTime(0x1000), 2)
+
+    def test_desc_complement(self):
+        asc = K.int64(5)
+        desc = K.int64(5, desc=True)
+        from yugabyte_db_tpu.dockv.key_encoding import encode_key_entry
+        a, d = encode_key_entry(asc), encode_key_entry(desc)
+        assert bytes(x ^ 0xFF for x in a[1:]) == d[1:]
+
+
+class TestGoldenValues:
+    def test_primitive_values(self):
+        assert PrimitiveValue.tombstone().encode() == b"\x10"
+        assert PrimitiveValue.int64(1).encode().hex() == \
+            "040100000000000000"
+        assert PrimitiveValue.string("hi").encode() == b"\x07hi"
+
+    def test_packed_row_bytes(self):
+        schema = TableSchema(columns=(
+            ColumnSchema(0, "k", ColumnType.INT64, is_hash_key=True),
+            ColumnSchema(1, "a", ColumnType.INT32),
+            ColumnSchema(2, "s", ColumnType.STRING),
+        ), version=3)
+        sp = SchemaPacking.from_schema(schema)
+        packed = RowPacker(sp).pack_value({1: 7, 2: "x"})
+        # marker, varint version 3, bitmap 00, int32 7 LE, end-offset 1, 'x'
+        assert packed.hex() == "21" "03" "00" "07000000" "01000000" "78"
+
+    def test_ttl_envelope(self):
+        from yugabyte_db_tpu.dockv.value import unwrap_ttl, wrap_ttl
+        v = wrap_ttl(b"\x21abc", 0x55)
+        assert v[0] == 0x30 and unwrap_ttl(v) == (b"\x21abc", 0x55)

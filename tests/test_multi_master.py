@@ -55,13 +55,14 @@ class TestMultiMaster:
                         break
                     await asyncio.sleep(0.05)
                 # heartbeats keep registering tservers on survivors
-                for ts in mc.tservers:
-                    await ts._heartbeat_once()
-                # data path unaffected; DDL works via the new leader
+                # (re-register right before DDL — the liveness window is
+                # short relative to a loaded test run)
                 c2 = mc.client()
                 assert (await c2.get("kv", {"k": 5}))["v"] == 5.0
                 from yugabyte_db_tpu.docdb.table_codec import TableInfo
                 info2 = kv_info("kv2")
+                for ts in mc.tservers:
+                    await ts._heartbeat_once()
                 await c2.create_table(info2, num_tablets=1)
                 await mc.wait_for_leaders("kv2")
                 await c2.insert("kv2", [{"k": 1, "v": 1.0}])
