@@ -141,3 +141,33 @@ class TestMiniCluster:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestWriteBatching:
+    def test_concurrent_writes_batch_into_fewer_raft_rounds(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                c = mc.client()
+                await c.create_table(kv_info(), num_tablets=1,
+                                     replication_factor=1)
+                await mc.wait_for_leaders("kv")
+                # fire 50 concurrent single-row inserts
+                await asyncio.gather(*[
+                    c.insert("kv", [{"k": i, "v": float(i), "s": "w"}])
+                    for i in range(50)])
+                peer = next(p for ts in mc.tservers
+                            for p in ts.peers.values())
+                write_entries = [e for e in peer.log.all_entries()
+                                 if e.etype == "write"]
+                # batching: far fewer Raft entries than writes
+                assert len(write_entries) < 50
+                # all rows present and correct
+                for i in (0, 25, 49):
+                    assert (await c.get("kv", {"k": i}))["v"] == float(i)
+                agg = await c.scan("kv", ReadRequest(
+                    "", aggregates=(AggSpec("count"),)))
+                assert int(agg.agg_values[0]) == 50
+            finally:
+                await mc.shutdown()
+        run(go())

@@ -41,6 +41,8 @@ class TabletPeer:
         self.participant = TransactionParticipant(self)
         self.coordinator = (TransactionCoordinator(self, messenger)
                             if is_status_tablet else None)
+        self._write_queue: list = []
+        self._batcher_task = None
 
     # --- lifecycle --------------------------------------------------------
     async def start(self):
@@ -66,15 +68,37 @@ class TabletPeer:
 
     # --- write path -------------------------------------------------------
     async def write(self, req: WriteRequest) -> WriteResponse:
+        """Group commit: concurrent writes queue and ride ONE Raft round
+        (reference: Log group commit + ReplicateBatch batching,
+        consensus/log.cc TaskStream)."""
         if not self.consensus.is_leader():
             raise RpcError(
                 f"not leader (hint={self.consensus.leader_hint()})",
                 "LEADER_NOT_READY")
         ht = self.clock.now()
-        payload = msgpack.packb({
-            "req": write_request_to_wire(req), "ht": ht.value})
-        await self.consensus.replicate("write", payload)
+        payload = {"req": write_request_to_wire(req), "ht": ht.value}
+        fut = asyncio.get_running_loop().create_future()
+        self._write_queue.append((payload, fut))
+        if self._batcher_task is None or self._batcher_task.done():
+            self._batcher_task = asyncio.create_task(self._drain_writes())
+        await fut
         return WriteResponse(rows_affected=len(req.ops))
+
+    async def _drain_writes(self):
+        while self._write_queue:
+            batch, self._write_queue = self._write_queue, []
+            payload = msgpack.packb({
+                "batch": [p for p, _ in batch]})
+            try:
+                await self.consensus.replicate("write", payload)
+            except Exception as e:   # noqa: BLE001 — propagate per-waiter
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+                continue
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_result(None)
 
     async def _apply_entry(self, entry: LogEntry):
         if entry.etype == "write":
@@ -90,9 +114,11 @@ class TabletPeer:
 
     def _apply_payload(self, entry: LogEntry):
         d = msgpack.unpackb(entry.payload, raw=False)
-        req = write_request_from_wire(d["req"])
-        self.tablet.apply_write(req, ht=HybridTime(d["ht"]),
-                                op_id=(entry.term, entry.index))
+        items = d["batch"] if "batch" in d else [d]
+        for item in items:
+            req = write_request_from_wire(item["req"])
+            self.tablet.apply_write(req, ht=HybridTime(item["ht"]),
+                                    op_id=(entry.term, entry.index))
 
     # --- read path --------------------------------------------------------
     def read(self, req: ReadRequest) -> ReadResponse:

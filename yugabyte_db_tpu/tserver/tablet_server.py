@@ -408,9 +408,11 @@ class TabletServer:
             last = e.index
             if e.etype == "write":
                 d = _mp.unpackb(e.payload, raw=False)
-                for op in d["req"]["ops"]:
-                    changes.append({"op": op[0], "row": op[1],
-                                    "ht": d["ht"], "index": e.index})
+                for item in (d["batch"] if "batch" in d else [d]):
+                    for op in item["req"]["ops"]:
+                        changes.append({"op": op[0], "row": op[1],
+                                        "ht": item["ht"],
+                                        "index": e.index})
             elif e.etype == "txn_intents":
                 d = _mp.unpackb(e.payload, raw=False)
                 for op in d["req"]["ops"]:
