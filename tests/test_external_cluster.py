@@ -1,0 +1,106 @@
+"""ExternalMiniCluster: forked real server processes, SIGKILL crash
+fidelity (reference: integration-tests/external_mini_cluster.h,
+ts_recovery-itest.cc)."""
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from yugabyte_db_tpu.client import YBClient
+from yugabyte_db_tpu.docdb import ReadRequest
+from yugabyte_db_tpu.ops import AggSpec
+from tests.test_load_balancer import kv_info
+
+ENV = dict(os.environ, YBTPU_PLATFORM="cpu",
+           PYTHONPATH=os.path.dirname(os.path.dirname(
+               os.path.abspath(__file__))))
+
+
+def spawn(role, fs_root, port=0, uuid="ts-0", masters=""):
+    args = [sys.executable, "-m", "yugabyte_db_tpu.tools.server_main",
+            role, "--fs-root", str(fs_root), "--port", str(port)]
+    if role == "tserver":
+        args += ["--uuid", uuid, "--masters", masters]
+    proc = subprocess.Popen(args, stdout=subprocess.PIPE, env=ENV, text=True)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("READY"):
+            host, p = line.split()[1].rsplit(":", 1)
+            return proc, (host, int(p))
+    raise TimeoutError(f"{role} did not become ready")
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.mark.slow
+class TestExternalCluster:
+    def test_sigkill_tserver_recovers_data(self, tmp_path):
+        procs = []
+        try:
+            mproc, maddr = spawn("master", tmp_path / "m")
+            procs.append(mproc)
+            tsproc, tsaddr = spawn("tserver", tmp_path / "ts", port=0,
+                                   masters=f"{maddr[0]}:{maddr[1]}")
+            procs.append(tsproc)
+
+            async def setup():
+                c = YBClient(maddr)
+                # wait for TS registration
+                for _ in range(100):
+                    r = await c.messenger.call(maddr, "master",
+                                               "list_tservers", {})
+                    if any(d["live"] for d in r["tservers"].values()):
+                        break
+                    await asyncio.sleep(0.1)
+                await c.create_table(kv_info(), num_tablets=1)
+                for _ in range(150):
+                    try:
+                        await c.insert("kv", [{"k": 0, "v": 0.0}])
+                        break
+                    except Exception:
+                        await asyncio.sleep(0.1)
+                        c._tables.clear()
+                await c.insert("kv", [{"k": i, "v": float(i)}
+                                      for i in range(1, 30)])
+                await c.messenger.shutdown()
+            run(setup())
+
+            # SIGKILL the tserver mid-flight (no clean shutdown at all)
+            tsproc.send_signal(signal.SIGKILL)
+            tsproc.wait(timeout=10)
+            procs.remove(tsproc)
+
+            # restart the same tserver process on the same port+data
+            tsproc2, tsaddr2 = spawn("tserver", tmp_path / "ts",
+                                     port=tsaddr[1],
+                                     masters=f"{maddr[0]}:{maddr[1]}")
+            procs.append(tsproc2)
+
+            async def verify():
+                c = YBClient(maddr)
+                row = None
+                for _ in range(150):
+                    try:
+                        row = await c.get("kv", {"k": 13})
+                        if row is not None:
+                            break
+                    except Exception:
+                        pass
+                    await asyncio.sleep(0.1)
+                    c._tables.clear()
+                assert row is not None and row["v"] == 13.0
+                agg = await c.scan("kv", ReadRequest(
+                    "", aggregates=(AggSpec("count"),)))
+                assert int(agg.agg_values[0]) == 30
+                await c.messenger.shutdown()
+            run(verify())
+        finally:
+            for p in procs:
+                p.kill()

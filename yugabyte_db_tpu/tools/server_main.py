@@ -1,0 +1,58 @@
+"""Standalone server entry points (real processes).
+
+    python -m yugabyte_db_tpu.tools.server_main master \
+        --fs-root DIR --port P
+    python -m yugabyte_db_tpu.tools.server_main tserver \
+        --uuid ts-0 --fs-root DIR --port P --masters host:port[,host:port]
+
+The process analog of yb-master/yb-tserver binaries (reference:
+src/yb/master/master_main.cc, tserver/tablet_server_main.cc); used by
+the ExternalMiniCluster test harness for crash/restart fidelity
+(reference: integration-tests/external_mini_cluster.h).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+
+async def run_master(args):
+    from ..master import Master
+    m = Master(args.fs_root)
+    addr = await m.start(port=args.port)
+    print(f"READY {addr[0]}:{addr[1]}", flush=True)
+    while True:
+        await asyncio.sleep(3600)
+
+
+async def run_tserver(args):
+    from ..tserver import TabletServer
+    masters = []
+    for hp in args.masters.split(","):
+        h, p = hp.rsplit(":", 1)
+        masters.append((h, int(p)))
+    ts = TabletServer(args.uuid, args.fs_root, master_addrs=masters)
+    addr = await ts.start(port=args.port)
+    print(f"READY {addr[0]}:{addr[1]}", flush=True)
+    while True:
+        await asyncio.sleep(3600)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ybtpu-server")
+    p.add_argument("role", choices=["master", "tserver"])
+    p.add_argument("--fs-root", required=True)
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--uuid", default="ts-0")
+    p.add_argument("--masters", default="")
+    args = p.parse_args(argv)
+    try:
+        asyncio.run(run_master(args) if args.role == "master"
+                    else run_tserver(args))
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())
