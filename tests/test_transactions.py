@@ -178,3 +178,36 @@ class TestTransactions:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestDeadlock:
+    def test_local_cycle_detected_immediately(self, tmp_path):
+        async def go():
+            mc, c = await make_cluster(str(tmp_path), tablets=1)
+            try:
+                t1 = await c.transaction().begin()
+                t2 = await c.transaction().begin()
+                await t1.insert("acct", [{"k": 100, "bal": 1.0}])
+                await t2.insert("acct", [{"k": 200, "bal": 2.0}])
+
+                async def t1_second():
+                    await t1.insert("acct", [{"k": 200, "bal": 3.0}])
+
+                task = asyncio.create_task(t1_second())
+                await asyncio.sleep(0.2)
+                assert not task.done()     # t1 waits on t2's intent
+                # t2 -> needs k=100 held by t1 -> cycle -> DEADLOCK fast
+                t0 = asyncio.get_event_loop().time()
+                with pytest.raises(RpcError) as ei:
+                    await t2.insert("acct", [{"k": 100, "bal": 4.0}])
+                elapsed = asyncio.get_event_loop().time() - t0
+                assert ei.value.code == "DEADLOCK"
+                assert elapsed < 2.0       # detected, not timed out
+                # t2 aborted -> t1's wait resolves and t1 can commit
+                await asyncio.wait_for(task, 10.0)
+                await t1.commit()
+                await asyncio.sleep(0.3)
+                assert (await c.get("acct", {"k": 200}))["bal"] == 3.0
+            finally:
+                await mc.shutdown()
+        run(go())

@@ -101,7 +101,7 @@ class YBTransaction:
             results = await asyncio.gather(
                 *[send(t, o) for t, o in by_tablet.items()])
         except RpcError as e:
-            if e.code == "ABORTED":
+            if e.code in ("ABORTED", "DEADLOCK"):
                 await self.abort()
             raise
         return sum(results)

@@ -214,13 +214,33 @@ class TransactionParticipant:
         await self.peer.consensus.replicate("txn_intents", payload)
         return len(req.ops)
 
+    def _would_deadlock(self, txn_id: str, blockers: Set[str]) -> bool:
+        """Local wait-for cycle check (reference: probe-based
+        DeadlockDetector, docdb/deadlock_detector.cc — ours walks the
+        tablet-local graph; cross-tablet cycles still fall to the wait
+        timeout)."""
+        edges: Dict[str, Set[str]] = {txn_id: set(blockers)}
+        for w in self._waiters:
+            edges.setdefault(w.txn_id, set()).update(w.blockers)
+        seen: Set[str] = set()
+        stack = list(blockers)
+        while stack:
+            t = stack.pop()
+            if t == txn_id:
+                return True
+            if t in seen:
+                continue
+            seen.add(t)
+            stack.extend(edges.get(t, ()))
+        return False
+
     async def _resolve_conflicts(self, txn_id: str, start_ht: int,
                                  keys: List[bytes]):
         """WAIT_ON_CONFLICT with wound-wait flavored priority (older txn
-        = lower start_ht = higher priority). A waiter whose blocker is
-        younger AND still pending after the timeout aborts itself
-        (deadlock breaker); reference policies:
-        tablet/write_query.cc:757-802."""
+        = lower start_ht = higher priority). Deadlocks: an immediate
+        local wait-for cycle aborts the waiter; otherwise a timeout
+        breaks cross-tablet cycles; reference policies:
+        tablet/write_query.cc:757-802, wait queue docdb/wait_queue.cc."""
         deadline = time.monotonic() + self.wait_timeout
         while True:
             blockers = {self._key_holder[k] for k in keys
@@ -228,6 +248,10 @@ class TransactionParticipant:
                         and self._key_holder[k] != txn_id}
             if not blockers:
                 return
+            if self._would_deadlock(txn_id, blockers):
+                raise RpcError(
+                    f"txn {txn_id} would deadlock (cycle via {blockers})",
+                    "DEADLOCK")
             if time.monotonic() >= deadline:
                 raise RpcError(
                     f"txn {txn_id} conflict timeout (blockers={blockers})",
