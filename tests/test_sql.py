@@ -173,3 +173,34 @@ class TestJson:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestSqlBreadth:
+    def test_like_distinct_offset(self, cluster):
+        async def go():
+            mc, s = await _session(cluster)
+            try:
+                await s.execute(
+                    "CREATE TABLE w (k bigint, name text, grp int, "
+                    "PRIMARY KEY (k))")
+                await mc.wait_for_leaders("w")
+                await s.execute(
+                    "INSERT INTO w (k, name, grp) VALUES "
+                    "(1, 'alpha', 1), (2, 'beta', 1), (3, 'alpine', 2), "
+                    "(4, 'gamma', 2), (5, 'beta', 1)")
+                r = await s.execute(
+                    "SELECT k FROM w WHERE name LIKE 'al%' ORDER BY k")
+                assert [x["k"] for x in r.rows] == [1, 3]
+                r = await s.execute(
+                    "SELECT k FROM w WHERE name LIKE '_eta' ORDER BY k")
+                assert [x["k"] for x in r.rows] == [2, 5]
+                r = await s.execute("SELECT DISTINCT name FROM w "
+                                    "ORDER BY name")
+                assert [x["name"] for x in r.rows] == \
+                    ["alpha", "alpine", "beta", "gamma"]
+                r = await s.execute(
+                    "SELECT k FROM w ORDER BY k LIMIT 2 OFFSET 2")
+                assert [x["k"] for x in r.rows] == [3, 4]
+            finally:
+                await mc.shutdown()
+        run(go())

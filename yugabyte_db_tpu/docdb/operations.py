@@ -139,6 +139,16 @@ def eval_expr_py(node: tuple, row: Dict[int, object]):
         return x in node[2]
     if kind == "isnull":
         return eval_expr_py(node[1], row) is None
+    if kind == "like":
+        import re as _re
+        v = eval_expr_py(node[1], row)
+        if v is None:
+            return None
+        pat = "^" + _re.escape(node[2]).replace("%", ".*").replace(
+            "_", ".") + "$"
+        # note: escape() escaped % and _ as literals? re.escape leaves %
+        # and _ unescaped in Python 3.7+, so the replace above is correct
+        return _re.match(pat, str(v)) is not None
     if kind == "json":
         # ('json', 'text'|'value', expr, key) — PG ->> / -> semantics
         import json as _json

@@ -254,8 +254,10 @@ def referenced_columns(node: ExprNode, out: set | None = None) -> set:
     out = out if out is not None else set()
     if node[0] == "col":
         out.add(node[1])
-    elif node[0] in ("in", "json"):
-        referenced_columns(node[1] if node[0] == "in" else node[2], out)
+    elif node[0] in ("in", "like"):
+        referenced_columns(node[1], out)
+    elif node[0] == "json":
+        referenced_columns(node[2], out)
     else:
         for c in node[1:]:
             if isinstance(c, (tuple, list)) and c and isinstance(c[0], str):

@@ -185,6 +185,10 @@ class SqlSession:
             return node
         if kind == "in":
             return ("in", self._bind(node[1], schema), node[2])
+        if kind == "like":
+            return ("like", self._bind(node[1], schema), node[2])
+        if kind == "json":
+            return ("json", node[1], self._bind(node[2], schema), node[3])
         return (kind,) + tuple(
             self._bind(c, schema) if isinstance(c, tuple) else c
             for c in node[1:])
@@ -313,9 +317,21 @@ class SqlSession:
         return out
 
     def _order_limit(self, stmt: SelectStmt, rows: List[dict]) -> List[dict]:
+        if getattr(stmt, "distinct", False):
+            seen = set()
+            out = []
+            for r in rows:
+                key = tuple(sorted((k, repr(v)) for k, v in r.items()))
+                if key not in seen:
+                    seen.add(key)
+                    out.append(r)
+            rows = out
         for col, desc in reversed(stmt.order_by):
             rows.sort(key=lambda r, c=col: (r.get(c) is None, r.get(c)),
                       reverse=desc)
+        off = getattr(stmt, "offset", 0)
+        if off:
+            rows = rows[off:]
         if stmt.limit is not None:
             rows = rows[:stmt.limit]
         return rows

@@ -30,7 +30,7 @@ KEYWORDS = {
     "update", "set", "asc", "desc", "count", "sum", "min", "max", "avg",
     "as", "hash", "with", "tablets", "replication", "if", "exists",
     "index", "on", "using", "lists", "ttl", "begin", "commit",
-    "rollback", "transaction",
+    "rollback", "transaction", "distinct", "offset", "like",
 }
 
 
@@ -107,6 +107,8 @@ class SelectStmt:
     limit: Optional[int] = None
     # kNN: ORDER BY col <-> 'vector literal' LIMIT k
     knn: Optional[Tuple[str, str]] = None
+    distinct: bool = False
+    offset: int = 0
 
 
 @dataclass
@@ -321,6 +323,7 @@ class Parser:
 
     def select(self):
         self.expect_kw("select")
+        distinct = self.accept_kw("distinct")
         items = []
         while True:
             if self.accept_op("*"):
@@ -384,7 +387,11 @@ class Parser:
         limit = None
         if self.accept_kw("limit"):
             limit = int(self.next()[1])
-        return SelectStmt(table, items, where, group, order, limit, knn)
+        offset = 0
+        if self.accept_kw("offset"):
+            offset = int(self.next()[1])
+        return SelectStmt(table, items, where, group, order, limit, knn,
+                          distinct, offset)
 
     def delete(self):
         self.expect_kw("delete")
@@ -442,6 +449,12 @@ class Parser:
             opname = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt",
                       "<=": "le", ">": "gt", ">=": "ge"}[op]
             return ("cmp", opname, left, right)
+        if t and t[0] == "kw" and t[1].lower() == "like":
+            self.next()
+            pat = self.next()
+            if pat[0] != "str":
+                raise ValueError("LIKE pattern must be a string")
+            return ("like", left, pat[1])
         if t and t[0] == "kw" and t[1].lower() == "between":
             self.next()
             lo = self.add_expr()
