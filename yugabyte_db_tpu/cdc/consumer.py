@@ -22,12 +22,30 @@ from ..rpc.messenger import RpcError
 
 
 class CdcStream:
-    def __init__(self, client: YBClient, table: str):
+    def __init__(self, client: YBClient, table: str,
+                 stream_id: Optional[str] = None):
         self.client = client
         self.table = table
+        self.stream_id = stream_id      # set -> checkpoints persist in the
         self.checkpoints: Dict[str, int] = {}
         # provisional buffers per txn until commit/abort arrives
         self._pending_txns: Dict[str, List[dict]] = {}
+
+    @classmethod
+    async def create(cls, client: YBClient, table: str) -> "CdcStream":
+        """Registered stream: checkpoints survive consumer restarts in
+        the master's catalog (cdc_state_table analog)."""
+        r = await client._master_call("create_cdc_stream",
+                                      {"table": table})
+        return cls(client, table, stream_id=r["stream_id"])
+
+    @classmethod
+    async def resume(cls, client: YBClient, stream_id: str) -> "CdcStream":
+        r = await client._master_call("get_cdc_stream",
+                                      {"stream_id": stream_id})
+        st = cls(client, r["table"], stream_id=stream_id)
+        st.checkpoints = dict(r.get("checkpoints", {}))
+        return st
 
     async def poll(self, limit_per_tablet: int = 1000) -> List[dict]:
         """One round of the virtual WAL: fetch + merge committed changes
@@ -43,7 +61,17 @@ class CdcStream:
                     ct, loc.tablet_id, "get_changes", payload)
             except RpcError:
                 continue
-            self.checkpoints[loc.tablet_id] = resp["checkpoint"]
+            if resp["checkpoint"] != self.checkpoints.get(loc.tablet_id):
+                self.checkpoints[loc.tablet_id] = resp["checkpoint"]
+                if self.stream_id is not None:
+                    try:
+                        await self.client._master_call(
+                            "set_cdc_checkpoint",
+                            {"stream_id": self.stream_id,
+                             "tablet_id": loc.tablet_id,
+                             "index": resp["checkpoint"]})
+                    except RpcError:
+                        pass
             for ch in resp["changes"]:
                 if ch.get("provisional"):
                     self._pending_txns.setdefault(

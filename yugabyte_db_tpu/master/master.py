@@ -455,6 +455,54 @@ class Master:
         await self._commit_catalog(ops)
         return {"left": left_id, "right": right_id}
 
+    # --- CDC stream registry (reference: master cdcsdk_manager.cc,
+    # cdc_state_table.cc for checkpoints) ----------------------------------
+    async def rpc_create_cdc_stream(self, payload) -> dict:
+        self._check_leader()
+        name = payload["table"]
+        tid = next((t for t, e in self.tables.items()
+                    if e["info"]["name"] == name), None)
+        if tid is None:
+            raise RpcError(f"table {name} not found", "NOT_FOUND")
+        stream_id = f"cdc-{uuidlib.uuid4().hex[:12]}"
+        ent = dict(self.tables[tid])
+        streams = dict(ent.get("cdc_streams", {}))
+        streams[stream_id] = {"checkpoints": {}}
+        ent["cdc_streams"] = streams
+        await self._commit_catalog([["put_table", tid, ent]])
+        return {"stream_id": stream_id}
+
+    async def rpc_set_cdc_checkpoint(self, payload) -> dict:
+        self._check_leader()
+        for tid, e in self.tables.items():
+            if payload["stream_id"] in e.get("cdc_streams", {}):
+                ent = dict(e)
+                streams = dict(ent["cdc_streams"])
+                st = dict(streams[payload["stream_id"]])
+                cps = dict(st.get("checkpoints", {}))
+                cps[payload["tablet_id"]] = payload["index"]
+                st["checkpoints"] = cps
+                streams[payload["stream_id"]] = st
+                ent["cdc_streams"] = streams
+                await self._commit_catalog([["put_table", tid, ent]])
+                return {"ok": True}
+        raise RpcError("stream not found", "NOT_FOUND")
+
+    async def rpc_get_cdc_stream(self, payload) -> dict:
+        for tid, e in self.tables.items():
+            if payload["stream_id"] in e.get("cdc_streams", {}):
+                return {"table": e["info"]["name"],
+                        **e["cdc_streams"][payload["stream_id"]]}
+        raise RpcError("stream not found", "NOT_FOUND")
+
+    # --- AutoFlags (reference: master_auto_flags_manager.cc,
+    # architecture/design/auto_flags.md) -----------------------------------
+    async def rpc_promote_auto_flags(self, payload) -> dict:
+        self._check_leader()
+        from ..utils import flags as _flags
+        _flags.promote_auto_flags()
+        return {"promoted": sorted(_flags.auto_flags())}
+
     # --- tablegroups / colocated tables -----------------------------------
     async def rpc_create_tablegroup(self, payload) -> dict:
         self._check_leader()

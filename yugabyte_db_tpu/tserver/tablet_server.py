@@ -429,6 +429,19 @@ class TabletServer:
                                 "index": e.index})
         return {"changes": changes, "checkpoint": last}
 
+    async def rpc_mem_trackers(self, payload) -> dict:
+        """Memory accounting rollup (reference: util/mem_tracker.h
+        hierarchy surfaced at /mem-trackers)."""
+        out = {}
+        for tid, p in self.peers.items():
+            out[tid] = {
+                "memtable_bytes": p.tablet.regular._mem.approximate_bytes(),
+                "sst_bytes": sum(r.file_size
+                                 for r in p.tablet.regular.ssts),
+                "wal_entries": len(p.log._entries),
+            }
+        return {"tablets": out}
+
     async def rpc_status(self, payload) -> dict:
         return {
             "uuid": self.uuid,
@@ -453,6 +466,17 @@ class TabletServer:
                 for p in list(self.peers.values()):
                     try:
                         p.maybe_gc_log()
+                    except Exception:
+                        pass
+            if ticks % 50 == 0:      # ~every 10s: background compaction
+                # (reference: full_compaction_manager.cc + the priority
+                # compaction pool; size-tiered trigger at >= 4 SSTs)
+                for p in list(self.peers.values()):
+                    try:
+                        if p.is_leader() and p.tablet.num_sst_files() >= 4:
+                            await asyncio.get_running_loop().run_in_executor(
+                                None, lambda p=p: p.tablet.compact(
+                                    major=False))
                     except Exception:
                         pass
             await asyncio.sleep(0.2)
