@@ -204,3 +204,37 @@ class TestSqlBreadth:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestAlterTable:
+    def test_add_column_online(self, cluster):
+        async def go():
+            mc, s = await _session(cluster)
+            try:
+                await s.execute("CREATE TABLE at (k bigint, v double, "
+                                "PRIMARY KEY (k)) WITH tablets = 2")
+                await mc.wait_for_leaders("at")
+                await s.execute("INSERT INTO at (k, v) VALUES (1, 1), (2, 2)")
+                r = await s.execute(
+                    "ALTER TABLE at ADD COLUMN note text, ADD COLUMN n int")
+                assert "v2" in r.status
+                # old rows read with NULL in the new column
+                s2 = SqlSession(mc.client())
+                r = await s2.execute("SELECT k, note FROM at ORDER BY k")
+                assert r.rows[0]["note"] is None
+                # new writes carry the new column; mixed versions coexist
+                await s2.execute(
+                    "INSERT INTO at (k, v, note, n) VALUES (3, 3, 'hi', 7)")
+                r = await s2.execute("SELECT note, n FROM at WHERE k = 3")
+                assert r.rows[0]["note"] == "hi" and r.rows[0]["n"] == 7
+                r = await s2.execute("SELECT count(*) FROM at")
+                assert r.rows[0]["count"] == 3
+                # survives restart (schema persisted in tablet meta)
+                await mc.restart_tserver(0)
+                await mc.wait_for_leaders("at")
+                s3 = SqlSession(mc.client())
+                r = await s3.execute("SELECT note FROM at WHERE k = 3")
+                assert r.rows[0]["note"] == "hi"
+            finally:
+                await mc.shutdown()
+        run(go())

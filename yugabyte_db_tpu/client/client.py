@@ -97,6 +97,14 @@ class YBClient:
             {"name": name, "replication_factor": replication_factor})
         return resp["tablegroup_id"]
 
+    async def alter_table_add_columns(self, name: str,
+                                      add_columns) -> int:
+        r = await self._master_call(
+            "alter_table", {"table": name,
+                            "add_columns": [list(c) for c in add_columns]})
+        self._tables.pop(name, None)
+        return r["schema_version"]
+
     async def drop_table(self, name: str) -> None:
         await self._master_call("drop_table", {"name": name})
         self._tables.pop(name, None)

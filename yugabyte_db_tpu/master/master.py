@@ -274,6 +274,59 @@ class Master:
                                  hash((u, salt)) & 0xFFFF))
         return by_load[:rf]
 
+    async def rpc_alter_table(self, payload) -> dict:
+        """ADD COLUMN: bump the schema version, replicate the new schema
+        to every tablet via their Raft groups, commit to the catalog
+        (reference: AlterTable in catalog_manager + ChangeMetadata ops;
+        old packed rows keep decoding via retained packings)."""
+        self._check_leader()
+        name = payload["table"]
+        tid = next((t for t, e in self.tables.items()
+                    if e["info"]["name"] == name), None)
+        if tid is None:
+            raise RpcError(f"table {name} not found", "NOT_FOUND")
+        ent = self.tables[tid]
+        info = TableInfo.from_wire(ent["info"])
+        cols = list(info.schema.columns)
+        next_id = max(c.id for c in cols) + 1
+        from ..dockv.packed_row import ColumnSchema as _CS
+        for cname, ctype in payload["add_columns"]:
+            if any(c.name == cname for c in cols):
+                raise RpcError(f"column {cname} exists", "ALREADY_PRESENT")
+            cols.append(_CS(next_id, cname, ctype))
+            next_id += 1
+        new_schema = TableSchema(columns=tuple(cols),
+                                 version=info.schema.version + 1)
+        new_info = TableInfo(tid, name, new_schema, info.partition_schema,
+                             cotable_id=info.cotable_id)
+        new_wire = new_info.to_wire()
+        for tablet_id in ent["tablets"]:
+            tent = self.tablets.get(tablet_id)
+            if tent is None:
+                continue
+            last = None
+            for u in ([tent.get("leader")] if tent.get("leader") else [])                     + list(tent["replicas"]):
+                ts = self.tservers.get(u)
+                if not ts:
+                    continue
+                try:
+                    await self.messenger.call(
+                        ts["addr"], "tserver", "alter_table",
+                        {"tablet_id": tablet_id, "table": new_wire},
+                        timeout=30.0)
+                    last = None
+                    break
+                except (RpcError, asyncio.TimeoutError, OSError) as e:
+                    last = e
+                    continue
+            if last is not None:
+                raise RpcError(f"alter failed on {tablet_id}: {last}",
+                               "RUNTIME_ERROR")
+        new_ent = dict(ent)
+        new_ent["info"] = new_wire
+        await self._commit_catalog([["put_table", tid, new_ent]])
+        return {"schema_version": new_schema.version}
+
     async def rpc_drop_table(self, payload) -> dict:
         self._check_leader()
         name = payload["name"]

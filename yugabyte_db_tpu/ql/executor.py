@@ -23,8 +23,9 @@ from ..dockv.packed_row import ColumnSchema, ColumnType, TableSchema
 from ..dockv.partition import PartitionSchema
 from ..ops.scan import AggSpec, GroupSpec
 from .parser import (
-    CreateIndexStmt, CreateTableStmt, DeleteStmt, DropTableStmt, InsertStmt,
-    SelectStmt, TxnStmt, UpdateStmt, parse_statement,
+    AlterTableStmt, CreateIndexStmt, CreateTableStmt, DeleteStmt,
+    DropTableStmt, InsertStmt, SelectStmt, TxnStmt, UpdateStmt,
+    parse_statement,
 )
 
 _TYPE_MAP = {
@@ -80,6 +81,15 @@ class SqlSession:
             return await self._drop(stmt)
         if isinstance(stmt, InsertStmt):
             return await self._insert(stmt)
+        if isinstance(stmt, AlterTableStmt):
+            adds = []
+            for cname, ctype in stmt.add_columns:
+                ct = _TYPE_MAP.get(ctype)
+                if ct is None:
+                    raise ValueError(f"unknown type {ctype}")
+                adds.append((cname, ct))
+            v = await self.client.alter_table_add_columns(stmt.table, adds)
+            return SqlResult([], f"ALTER TABLE (v{v})")
         if isinstance(stmt, TxnStmt):
             return await self._txn_stmt(stmt)
         if isinstance(stmt, CreateIndexStmt):

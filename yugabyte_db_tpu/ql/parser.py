@@ -31,6 +31,7 @@ KEYWORDS = {
     "as", "hash", "with", "tablets", "replication", "if", "exists",
     "index", "on", "using", "lists", "ttl", "begin", "commit",
     "rollback", "transaction", "distinct", "offset", "like",
+    "alter", "add", "column",
 }
 
 
@@ -75,6 +76,12 @@ class CreateIndexStmt:
     column: str
     method: str = "lsm"     # 'lsm' secondary index | 'ivfflat' vector ANN
     lists: int = 100
+
+
+@dataclass
+class AlterTableStmt:
+    table: str
+    add_columns: List[Tuple[str, str]]
 
 
 @dataclass
@@ -179,7 +186,7 @@ class Parser:
             "insert": self.insert, "select": self.select,
             "delete": self.delete, "update": self.update,
             "begin": self.txn_stmt, "commit": self.txn_stmt,
-            "rollback": self.txn_stmt,
+            "rollback": self.txn_stmt, "alter": self.alter_table,
         }.get(word)
         if fn is None:
             raise ValueError(f"unsupported statement {word!r}")
@@ -262,6 +269,25 @@ class Parser:
             self.expect_op("=")
             lists = int(self.next()[1])
         return CreateIndexStmt(name, table, column, method, lists)
+
+    def alter_table(self):
+        self.expect_kw("alter")
+        self.expect_kw("table")
+        table = self.ident()
+        adds = []
+        while self.accept_kw("add"):
+            self.accept_kw("column")
+            cname = self.ident()
+            ctype = self.ident().lower()
+            if self.accept_op("("):
+                self.next()
+                self.expect_op(")")
+            adds.append((cname, ctype))
+            if not self.accept_op(","):
+                break
+        if not adds:
+            raise ValueError("ALTER TABLE supports ADD COLUMN")
+        return AlterTableStmt(table, adds)
 
     def drop_table(self):
         self.expect_kw("drop")

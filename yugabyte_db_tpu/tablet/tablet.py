@@ -76,6 +76,34 @@ class Tablet:
     def _codec_for(self, table_id: str) -> TableCodec:
         return self.codecs.get(table_id, self.codec)
 
+    def alter_table(self, new_info: TableInfo) -> None:
+        """Online schema change (reference: ChangeMetadataOperation,
+        tablet/operations/change_metadata_operation.cc): adopt the new
+        schema version while RETAINING old packings so existing rows keep
+        decoding; compaction repacks over time."""
+        old = self.codecs.get(new_info.table_id, self.codec)
+        merged = TableCodec(new_info)
+        merged.info.packings._packings.update(
+            {v: p for v, p in old.info.packings._packings.items()
+             if v not in merged.info.packings._packings})
+        self.codecs[new_info.table_id] = merged
+        if new_info.table_id == self.info.table_id:
+            self.info = new_info
+            self.codec = merged
+            if not self.colocated:
+                self.regular.columnar_builder = merged.columnar_builder
+                self.regular.row_decoder = merged.row_decoder
+                for r in self.regular.ssts:
+                    r.row_decoder = merged.row_decoder
+            from ..docdb.operations import DocReadOperation
+            self._read_op = DocReadOperation(
+                merged, self.regular, device_cache=_DEVICE_CACHE)
+        from ..docdb.operations import DocReadOperation as _DRO
+        self._read_ops[new_info.table_id] = _DRO(
+            merged, self.regular,
+            device_cache=_DEVICE_CACHE
+            if new_info.table_id == self.info.table_id else None)
+
     def tables(self):
         return list(self.codecs)
 

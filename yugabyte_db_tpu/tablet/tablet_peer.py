@@ -43,6 +43,13 @@ class TabletPeer:
                             if is_status_tablet else None)
         self._write_queue: list = []
         self._batcher_task = None
+        self.on_alter = None      # tserver persists new schema to meta
+
+    async def alter(self, table_wire: dict):
+        if not self.consensus.is_leader():
+            raise RpcError("not leader", "LEADER_NOT_READY")
+        await self.consensus.replicate(
+            "alter", msgpack.packb({"table": table_wire}))
 
     # --- lifecycle --------------------------------------------------------
     async def start(self):
@@ -103,6 +110,12 @@ class TabletPeer:
     async def _apply_entry(self, entry: LogEntry):
         if entry.etype == "write":
             self._apply_payload(entry)
+        elif entry.etype == "alter":
+            from ..docdb.table_codec import TableInfo
+            d = msgpack.unpackb(entry.payload, raw=False)
+            self.tablet.alter_table(TableInfo.from_wire(d["table"]))
+            if self.on_alter is not None:
+                self.on_alter(d["table"])
         elif entry.etype == "txn_intents":
             self.participant.apply_intent_entry(entry.payload)
         elif entry.etype == "txn_apply":

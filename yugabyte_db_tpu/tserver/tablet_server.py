@@ -100,6 +100,21 @@ class TabletServer:
                 json.dump(meta, f)
 
         peer.consensus.on_config_change = persist_config
+
+        def persist_alter(table_wire, tablet_id=tablet_id, meta=meta):
+            if meta["table"].get("table_id") == table_wire.get("table_id"):
+                meta["table"] = table_wire
+            else:
+                meta["colocated_tables"] = [
+                    tw if tw.get("table_id") != table_wire.get("table_id")
+                    else table_wire
+                    for tw in meta.get("colocated_tables", [])]
+            path = os.path.join(self._tablet_dir(tablet_id),
+                                "tablet-meta.json")
+            with open(path, "w") as f:
+                json.dump(meta, f)
+
+        peer.on_alter = persist_alter
         self.peers[tablet_id] = peer
         await peer.start()
         return peer
@@ -170,6 +185,11 @@ class TabletServer:
             with wait_status("OnCpu_Read"):
                 resp = peer.read(req)
         return read_response_to_wire(resp)
+
+    async def rpc_alter_table(self, payload) -> dict:
+        peer = self._peer(payload["tablet_id"])
+        await peer.alter(payload["table"])
+        return {"ok": True}
 
     async def rpc_add_table(self, payload) -> dict:
         """Add a colocated table to an existing tablet (reference:
