@@ -119,3 +119,36 @@ class TestReplicaMove:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestZonePlacement:
+    def test_rf3_spreads_across_zones(self, tmp_path):
+        async def go():
+            from yugabyte_db_tpu.master import Master
+            from yugabyte_db_tpu.tserver import TabletServer
+            from yugabyte_db_tpu.client import YBClient
+            m = Master(str(tmp_path / "m"))
+            maddr = await m.start()
+            tss = []
+            # 2 tservers in zone-a, 2 in zone-b, 1 in zone-c
+            for i, z in enumerate(["a", "a", "b", "b", "c"]):
+                ts = TabletServer(f"ts-{i}", str(tmp_path / f"ts{i}"),
+                                  master_addrs=[maddr], zone=f"zone-{z}")
+                await ts.start()
+                tss.append(ts)
+            for _ in range(50):
+                for ts in tss:
+                    await ts._heartbeat_once()
+                if len(m.live_tservers()) == 5:
+                    break
+                await asyncio.sleep(0.05)
+            c = YBClient(maddr)
+            await c.create_table(kv_info(), num_tablets=2,
+                                 replication_factor=3)
+            for ent in m.tablets.values():
+                zones = {m.tservers[u]["zone"] for u in ent["replicas"]}
+                assert len(zones) == 3      # one replica per zone
+            for ts in tss:
+                await ts.shutdown()
+            await m.shutdown()
+        run(go())

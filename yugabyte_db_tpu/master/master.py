@@ -169,6 +169,7 @@ class Master:
             "addr": tuple(payload["addr"]),
             "last_hb": time.monotonic(),
             "tablets": payload.get("tablets", []),
+            "zone": payload.get("zone", "zone-default"),
         }
         # track leadership reports for client routing
         for t in payload.get("tablets", []):
@@ -268,11 +269,24 @@ class Master:
 
     def _choose_replicas(self, live: List[str], rf: int, salt: int
                          ) -> List[str]:
-        """Least-loaded placement (cluster_balance.cc analog, static)."""
-        by_load = sorted(
+        """Zone-spreading, least-loaded placement (reference: placement
+        policy handling in cluster_balance.cc/catalog_manager): pick one
+        replica per zone round-robin before doubling up."""
+        chosen: List[str] = []
+        used_zones: Dict[str, int] = {}
+        candidates = sorted(
             live, key=lambda u: (len(self.tservers[u].get("tablets", [])),
                                  hash((u, salt)) & 0xFFFF))
-        return by_load[:rf]
+        while len(chosen) < rf and candidates:
+            best = min(candidates, key=lambda u: (
+                used_zones.get(self.tservers[u].get("zone", "z"), 0),
+                len(self.tservers[u].get("tablets", [])),
+                hash((u, salt)) & 0xFFFF))
+            chosen.append(best)
+            z = self.tservers[best].get("zone", "z")
+            used_zones[z] = used_zones.get(z, 0) + 1
+            candidates.remove(best)
+        return chosen
 
     async def rpc_alter_table(self, payload) -> dict:
         """ADD COLUMN: bump the schema version, replicate the new schema

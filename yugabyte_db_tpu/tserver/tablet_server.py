@@ -30,9 +30,11 @@ from ..utils.trace import ASH, TRACES, wait_status
 
 class TabletServer:
     def __init__(self, uuid: str, fs_root: str,
-                 master_addrs: Optional[List[Tuple[str, int]]] = None):
+                 master_addrs: Optional[List[Tuple[str, int]]] = None,
+                 zone: str = "zone-default"):
         self.uuid = uuid
         self.fs_root = fs_root
+        self.zone = zone
         self.master_addrs = master_addrs or []
         os.makedirs(fs_root, exist_ok=True)
         self.messenger = Messenger(f"ts-{uuid}")
@@ -505,6 +507,7 @@ class TabletServer:
         report = {
             "ts_uuid": self.uuid,
             "addr": list(self.messenger.addr),
+            "zone": self.zone,
             "tablets": [
                 {"tablet_id": tid, "is_leader": p.is_leader(),
                  "size_bytes": p.tablet.approximate_size(),
