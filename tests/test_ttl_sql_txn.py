@@ -94,3 +94,39 @@ class TestSqlTxn:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestCompactionRepack:
+    def test_old_rows_repack_to_latest_schema(self, tmp_path):
+        async def go():
+            from yugabyte_db_tpu.ql import SqlSession
+            from yugabyte_db_tpu.dockv.value import ValueKind
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute("CREATE TABLE rp (k bigint, v double, "
+                                "PRIMARY KEY (k)) WITH tablets = 1")
+                await mc.wait_for_leaders("rp")
+                await s.execute("INSERT INTO rp (k, v) VALUES (1, 1), (2, 2)")
+                await s.execute("ALTER TABLE rp ADD COLUMN note text")
+                s2 = SqlSession(mc.client())
+                await s2.execute(
+                    "INSERT INTO rp (k, v, note) VALUES (3, 3, 'new')")
+                peer = next(p for ts in mc.tservers
+                            for p in ts.peers.values()
+                            if p.coordinator is None)
+                tablet = peer.tablet
+                tablet.compact()
+                latest = tablet.codec.info.schema.version
+                for k, v in tablet.regular.iterate():
+                    if v[0] == ValueKind.kPackedRowV2:
+                        assert tablet.codec.info.packings.version_of(
+                            v, 1) == latest
+                # rows still read correctly after repack
+                r = await s2.execute("SELECT k, v, note FROM rp ORDER BY k")
+                assert [x["v"] for x in r.rows] == [1.0, 2.0, 3.0]
+                assert r.rows[0]["note"] is None
+                assert r.rows[2]["note"] == "new"
+            finally:
+                await mc.shutdown()
+        run(go())

@@ -63,6 +63,39 @@ class DocDbCompactionFeed(CompactionFeed):
         return [(key, value)]
 
 
+class RepackingCompactionFeed(DocDbCompactionFeed):
+    """DocDbCompactionFeed + schema repacking: surviving packed rows in
+    old schema versions re-encode with the latest packing (reference:
+    PackedRowData repacking during compaction,
+    docdb_compaction_context.cc:142)."""
+
+    def __init__(self, history_cutoff: int, codec: TableCodec):
+        super().__init__(history_cutoff)
+        self.codec = codec
+        from ..dockv.packed_row import RowPacker, unpack_row
+        self._latest = codec.info.schema.version
+        self._packer = RowPacker(codec.info.packings.get(self._latest))
+        self._unpack = unpack_row
+
+    def feed(self, key: bytes, value: bytes):
+        out = super().feed(key, value)
+        if not out:
+            return out
+        from ..dockv.value import ValueKind, unwrap_ttl, wrap_ttl
+        k, v = out[0]
+        inner, expire = unwrap_ttl(v)
+        if inner and inner[0] == ValueKind.kPackedRowV2:
+            ver = self.codec.info.packings.version_of(inner, 1)
+            if ver != self._latest:
+                row = self._unpack(self.codec.info.packings.get(ver),
+                                   inner, 1)
+                repacked = self._packer.pack_value(row)
+                v = (wrap_ttl(repacked, expire) if expire is not None
+                     else repacked)
+                return [(k, v)]
+        return out
+
+
 def tpu_compact(store: LsmStore, codec: TableCodec, history_cutoff: int,
                 inputs: Optional[Sequence[SstReader]] = None,
                 block_rows: int = 65536) -> Optional[str]:

@@ -16,7 +16,9 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from ..docdb.compaction import DocDbCompactionFeed, tpu_compact
+from ..docdb.compaction import (
+    DocDbCompactionFeed, RepackingCompactionFeed, tpu_compact,
+)
 from ..docdb.operations import (
     DocReadOperation, DocWriteOperation, ReadRequest, ReadResponse,
     WriteRequest, WriteResponse,
@@ -155,12 +157,16 @@ class Tablet:
         if not inputs:
             return None
         cutoff = self.history_cutoff()
-        if flags.get("tpu_compaction_enabled"):
+        multi_version = len(self.codec.info.packings.versions()) > 1
+        if flags.get("tpu_compaction_enabled") and not multi_version:
             path = tpu_compact(self.regular, self.codec, cutoff,
                                inputs=inputs)
         else:
+            # mixed schema versions compact on the CPU feed, which also
+            # repacks surviving rows to the latest schema version
             path = self.regular.compact(
-                inputs=inputs, feed=DocDbCompactionFeed(cutoff))
+                inputs=inputs, feed=RepackingCompactionFeed(cutoff,
+                                                            self.codec))
         _DEVICE_CACHE.invalidate_prefix((id(self.regular),))
         return path
 
