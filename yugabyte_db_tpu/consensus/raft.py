@@ -26,8 +26,12 @@ import time
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 
+import logging
+
 from ..rpc.messenger import Messenger, RpcError
 from ..utils import flags
+
+log = logging.getLogger("ybtpu.consensus")
 from ..utils.hybrid_time import HybridClock, HybridTime
 from .log import Log, LogEntry
 
@@ -414,7 +418,13 @@ class RaftConsensus:
                 if e is None:
                     break
                 if e.etype not in ("noop", "config"):
-                    await self.apply_cb(e)
+                    try:
+                        await self.apply_cb(e)
+                    except Exception:
+                        log.exception(
+                            "%s: apply failed at index %d (%s)",
+                            self.tablet_id, nxt, e.etype)
+                        raise
                 self.last_applied = nxt
 
     # ------------------------------------------------------------------

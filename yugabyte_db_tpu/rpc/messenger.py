@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import logging
 import struct
 from typing import Any, Dict, Optional, Tuple
 
@@ -159,6 +160,9 @@ class Messenger:
             result = await self._invoke(service, method, payload)
             out = _pack([call_id, _RESP, service, method, result])
         except Exception as e:  # noqa: BLE001 — errors cross the wire
+            if not isinstance(e, RpcError):
+                logging.getLogger("ybtpu.rpc").exception(
+                    "unhandled error in %s.%s", service, method)
             code = getattr(e, "code", "REMOTE_ERROR")
             code = code.name if hasattr(code, "name") else str(code)
             out = _pack([call_id, _ERR, service, method,

@@ -23,9 +23,13 @@ from ..dockv.partition import Partition
 from ..rpc.messenger import Messenger, RpcError
 from ..tablet.tablet import Tablet
 from ..tablet.tablet_peer import TabletPeer
+import logging
+
 from ..utils import flags
 from ..utils.hybrid_time import HybridClock
 from ..utils.trace import ASH, TRACES, wait_status
+
+log = logging.getLogger("ybtpu.tserver")
 
 
 class TabletServer:
@@ -500,7 +504,8 @@ class TabletServer:
                                 None, lambda p=p: p.tablet.compact(
                                     major=False))
                     except Exception:
-                        pass
+                        log.exception("background compaction failed for %s",
+                                      p.tablet.tablet_id)
             await asyncio.sleep(0.2)
 
     async def _heartbeat_once(self):
