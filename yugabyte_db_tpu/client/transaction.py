@@ -86,13 +86,18 @@ class YBTransaction:
             loc = self.client._tablet_for_key(ct, op.row)
             by_tablet.setdefault(loc.tablet_id, []).append(op)
 
+        status_loc = await self._status_tablet()
+        status_info = {"tablet_id": status_loc.tablet_id,
+                       "addrs": [list(a) for _, a in status_loc.replicas]}
+
         async def send(tablet_id: str, tops: List[RowOp]) -> int:
             loc = next(l for l in ct.locations if l.tablet_id == tablet_id)
             self._participants[tablet_id] = [list(a) for _, a in loc.replicas]
             req = WriteRequest(ct.info.table_id, tops)
             payload = {"tablet_id": tablet_id,
                        "req": write_request_to_wire(req),
-                       "txn_id": self.txn_id, "start_ht": self.start_ht}
+                       "txn_id": self.txn_id, "start_ht": self.start_ht,
+                       "status_tablet": status_info}
             r = await self.client._call_leader(ct, tablet_id, "txn_write",
                                                payload)
             return r["rows_affected"]

@@ -113,6 +113,7 @@ class RaftConsensus:
         self.next_index: Dict[str, int] = {}
         self.match_index: Dict[str, int] = {}
         self._lease_expiry = 0.0
+        self._lease_blocked_until = 0.0
         self._last_heartbeat = time.monotonic()
         self._election_deadline = self._new_election_deadline()
         self._commit_waiters: List[Tuple[int, asyncio.Future]] = []
@@ -148,7 +149,7 @@ class RaftConsensus:
         self._running = False
         for t in self._tasks:
             t.cancel()
-        for _, fut in self._commit_waiters:
+        for _, _, fut in self._commit_waiters:
             if not fut.done():
                 fut.cancel()
 
@@ -234,13 +235,21 @@ class RaftConsensus:
         for p in self.config.others(self.uuid):
             self.next_index[p.uuid] = self.log.last_index + 1
             self.match_index[p.uuid] = 0
+        # A new leader must wait out the previous leader's maximum lease
+        # before serving reads (reference: leader leases, consensus/README)
+        # — except on a group's very first election (term 1, no possible
+        # prior leaseholder).
+        if self.config.others(self.uuid) and self.meta.current_term > 1:
+            self._lease_blocked_until = time.monotonic() + \
+                flags.get("leader_lease_duration_ms") / 1000.0
         # leader NO-OP commits entries from prior terms (Raft §5.4.2;
         # reference appends a NO_OP on leader start)
         await self._append_local(LogEntry(
             self.meta.current_term, self.log.last_index + 1, "noop", b""))
         if not self.config.others(self.uuid):
             await self._advance_commit(self.log.last_index)
-            self._lease_expiry = time.monotonic() + 3600.0
+            self._lease_expiry = max(time.monotonic(),
+                                     self._lease_blocked_until) + 3600.0
         else:
             self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         await self._broadcast()
@@ -266,7 +275,7 @@ class RaftConsensus:
                 await self._advance_commit(idx)
                 return idx
             fut = asyncio.get_running_loop().create_future()
-            self._commit_waiters.append((idx, fut))
+            self._commit_waiters.append((idx, self.meta.current_term, fut))
         await self._broadcast()
         await asyncio.wait_for(fut, timeout)
         return idx
@@ -308,7 +317,7 @@ class RaftConsensus:
                 await self._advance_commit(idx)
                 return idx
             fut = asyncio.get_running_loop().create_future()
-            self._commit_waiters.append((idx, fut))
+            self._commit_waiters.append((idx, self.meta.current_term, fut))
         await self._broadcast()
         await asyncio.wait_for(fut, 30.0)
         if self.uuid not in new:
@@ -338,10 +347,17 @@ class RaftConsensus:
     async def _broadcast(self):
         if self.role != Role.LEADER or not self.config.others(self.uuid):
             return
-        await asyncio.gather(
+        acks = await asyncio.gather(
             *[self._replicate_to(p) for p in self.config.others(self.uuid)])
+        # lease renews only on a FRESH majority ack in this round
+        # (cumulative match_index is not evidence of current reachability)
+        if 1 + sum(1 for a in acks if a) >= self.config.majority:
+            now = time.monotonic()
+            if now >= self._lease_blocked_until:
+                self._lease_expiry = now + \
+                    flags.get("leader_lease_duration_ms") / 1000.0
 
-    async def _replicate_to(self, peer: PeerSpec):
+    async def _replicate_to(self, peer: PeerSpec) -> bool:
         ni = self.next_index.get(peer.uuid, self.log.last_index + 1)
         prev = ni - 1
         prev_term = self.log.term_at(prev)
@@ -362,27 +378,19 @@ class RaftConsensus:
                 peer.addr, f"consensus-{self.tablet_id}",
                 "update_consensus", req, timeout=2.0)
         except (RpcError, asyncio.TimeoutError, OSError):
-            return
+            return False
         if resp["term"] > self.meta.current_term:
             await self._step_down(resp["term"])
-            return
+            return False
         if resp.get("success"):
             match = resp["last_index"]
             self.match_index[peer.uuid] = match
             self.next_index[peer.uuid] = match + 1
-            self._note_ack()
             await self._maybe_advance_commit()
-        else:
-            self.next_index[peer.uuid] = max(
-                1, min(ni - 1, resp.get("last_index", ni - 1) + 1))
-
-    def _note_ack(self):
-        """Majority acks within the window extend the leader lease."""
-        acked = 1 + sum(1 for p in self.config.others(self.uuid)
-                        if self.match_index.get(p.uuid, 0) > 0)
-        if acked >= self.config.majority:
-            self._lease_expiry = time.monotonic() + \
-                flags.get("leader_lease_duration_ms") / 1000.0
+            return True
+        self.next_index[peer.uuid] = max(
+            1, min(ni - 1, resp.get("last_index", ni - 1) + 1))
+        return False
 
     async def _maybe_advance_commit(self):
         matches = sorted(
@@ -402,12 +410,18 @@ class RaftConsensus:
         self.commit_index = index
         await self._apply_committed()
         still = []
-        for idx, fut in self._commit_waiters:
+        for idx, term, fut in self._commit_waiters:
             if idx <= index:
                 if not fut.done():
-                    fut.set_result(idx)
+                    # the entry only committed if OUR entry survived: a
+                    # truncated-and-replaced index must not ack the write
+                    if self.log.term_at(idx) == term:
+                        fut.set_result(idx)
+                    else:
+                        fut.set_exception(RpcError(
+                            "entry lost to leadership change", "ABORTED"))
             else:
-                still.append((idx, fut))
+                still.append((idx, term, fut))
         self._commit_waiters = still
 
     async def _apply_committed(self):
@@ -452,7 +466,18 @@ class RaftConsensus:
             if mine is None or mine.term != e.term:
                 to_append.append(e)
         if to_append:
+            first_new = to_append[0].index
             self.log.append(to_append)
+            # any pending waiter at a truncated index lost its entry
+            still = []
+            for idx, term, fut in self._commit_waiters:
+                if idx >= first_new and self.log.term_at(idx) != term:
+                    if not fut.done():
+                        fut.set_exception(RpcError(
+                            "entry lost to leadership change", "ABORTED"))
+                else:
+                    still.append((idx, term, fut))
+            self._commit_waiters = still
             for e in to_append:
                 if e.etype == "config":
                     self._adopt_config(e.payload)

@@ -204,13 +204,14 @@ class LsmStore:
 
     # --- compaction -------------------------------------------------------
     def pick_compaction(self, max_files: int = 8) -> List[SstReader]:
-        """Size-tiered pick: compact when >= 4 SSTs; choose the smallest
-        run of similar-size files (universal compaction analog)."""
+        """Pick the OLDEST contiguous run (universal compaction picks
+        age-adjacent runs). Contiguity in age is what lets the output be
+        placed after all kept (newer) SSTs without breaking the
+        newest-source-wins merge invariant."""
         with self._lock:
             if len(self._ssts) < 4:
                 return []
-            by_size = sorted(self._ssts, key=lambda r: r.file_size)
-            return by_size[:max_files]
+            return list(self._ssts[-max_files:])   # newest-first list tail
 
     def compact(self, inputs: Optional[Sequence[SstReader]] = None,
                 feed: Optional[CompactionFeed] = None,

@@ -155,12 +155,13 @@ class TabletPeer:
 
     # --- transactional write path ------------------------------------------
     async def write_txn(self, req: WriteRequest, txn_id: str,
-                        start_ht: int) -> int:
+                        start_ht: int, status_tablet=None) -> int:
         if not self.consensus.is_leader():
             raise RpcError(
                 f"not leader (hint={self.consensus.leader_hint()})",
                 "LEADER_NOT_READY")
-        return await self.participant.write_intents(req, txn_id, start_ht)
+        return await self.participant.write_intents(
+            req, txn_id, start_ht, status_tablet)
 
     async def apply_txn(self, txn_id: str, commit_ht: int):
         import msgpack as _mp

@@ -147,23 +147,53 @@ class TransactionCoordinator:
         t.add_done_callback(self._apply_tasks.discard)
 
     async def _notify_participants(self, txn_id: str, st: dict, method: str):
+        all_ok = True
         for p in st.get("participants", []):
             tablet_id, addrs = p["tablet_id"], p["addrs"]
             payload = {"tablet_id": tablet_id, "txn_id": txn_id,
                        "commit_ht": st.get("commit_ht")}
+            done = False
             for attempt in range(10):
                 for addr in addrs:
                     try:
                         await self.messenger.call(
                             tuple(addr), "tserver", method, payload,
                             timeout=5.0)
+                        done = True
                         break
                     except (RpcError, asyncio.TimeoutError, OSError):
                         continue
-                else:
-                    await asyncio.sleep(0.2 * (attempt + 1))
-                    continue
-                break
+                if done:
+                    break
+                await asyncio.sleep(0.2 * (attempt + 1))
+            all_ok = all_ok and done
+        if all_ok:
+            st["resolved"] = True
+
+    async def sweep(self):
+        """Leader-side periodic pass (reference: coordinator poll task):
+        re-drives participant apply/rollback for decided-but-unresolved
+        transactions (covers coordinator failover — decisions replayed
+        from the Raft log while not yet leader were never notified) and
+        aborts PENDING transactions past their deadline."""
+        if not self.peer.is_leader():
+            return
+        now = time.time()
+        for txn_id, st in list(self.txns.items()):
+            status = st.get("status")
+            if status == PENDING and st.get("deadline") and \
+                    now > st["deadline"]:
+                try:
+                    await self._replicate({"op": "abort", "txn_id": txn_id,
+                                           "participants":
+                                               st.get("participants", [])})
+                except Exception:
+                    pass
+            elif status in (COMMITTED, ABORTED) and \
+                    st.get("participants") and not st.get("resolved"):
+                await self._notify_participants(
+                    txn_id, st,
+                    "apply_txn" if status == COMMITTED else "rollback_txn")
 
 
 # ==========================================================================
@@ -201,17 +231,33 @@ class TransactionParticipant:
 
     # --- write path --------------------------------------------------------
     async def write_intents(self, req: WriteRequest, txn_id: str,
-                            start_ht: int) -> int:
-        """Resolve conflicts then Raft-replicate the intent batch."""
+                            start_ht: int, status_tablet=None) -> int:
+        """Resolve conflicts then Raft-replicate the intent batch.
+
+        The key claims happen SYNCHRONOUSLY (no await) the moment the
+        conflict check passes — otherwise two concurrent writers of the
+        same key would both pass the check before either intent
+        replicates (write-write race)."""
         codec = self.tablet.codec
         keys = [codec.doc_key_prefix(op.row) for op in req.ops]
         await self._resolve_conflicts(txn_id, start_ht, keys)
+        if status_tablet:
+            self._txn_meta.setdefault(txn_id, {})["status_tablet"] = \
+                status_tablet
+        # claimed inside _resolve_conflicts on success; replicate now
         payload = msgpack.packb({
             "txn_id": txn_id, "start_ht": start_ht,
             "req": write_request_to_wire(req),
-            "keys": keys,
+            "keys": keys, "status_tablet": status_tablet,
         })
-        await self.peer.consensus.replicate("txn_intents", payload)
+        try:
+            await self.peer.consensus.replicate("txn_intents", payload)
+        except Exception:
+            # undo claims that never got an applied intent
+            per_txn = self._intents.get(txn_id, {})
+            self._release(txn_id,
+                          [k for k in keys if per_txn.get(k) is None])
+            raise
         return len(req.ops)
 
     def _would_deadlock(self, txn_id: str, blockers: Set[str]) -> bool:
@@ -247,6 +293,13 @@ class TransactionParticipant:
                         if k in self._key_holder
                         and self._key_holder[k] != txn_id}
             if not blockers:
+                # claim NOW, before any await, so a concurrent writer of
+                # the same keys sees the conflict
+                per_txn = self._intents.setdefault(txn_id, {})
+                self._txn_meta.setdefault(txn_id, {"start_ht": start_ht})
+                for k in keys:
+                    self._key_holder[k] = txn_id
+                    per_txn.setdefault(k, None)   # placeholder until apply
                 return
             if self._would_deadlock(txn_id, blockers):
                 raise RpcError(
@@ -259,20 +312,56 @@ class TransactionParticipant:
             w = _Waiter(txn_id, start_ht, asyncio.Event(), blockers)
             self._waiters.append(w)
             try:
-                await asyncio.wait_for(w.event.wait(),
-                                       max(deadline - time.monotonic(), 0.01))
+                await asyncio.wait_for(
+                    w.event.wait(),
+                    min(0.5, max(deadline - time.monotonic(), 0.01)))
             except asyncio.TimeoutError:
                 pass
             finally:
                 if w in self._waiters:
                     self._waiters.remove(w)
+            # status resolution (reference: TransactionStatusResolver):
+            # a blocker may be decided at its coordinator without this
+            # participant ever being notified (e.g. expired txn)
+            for blocker in list(blockers):
+                await self._maybe_resolve_blocker(blocker)
+
+    async def _maybe_resolve_blocker(self, txn_id: str) -> None:
+        meta = self._txn_meta.get(txn_id) or {}
+        st_info = meta.get("status_tablet")
+        if not st_info or meta.get("probing"):
+            return
+        meta["probing"] = True
+        try:
+            status = None
+            for addr in st_info.get("addrs", []):
+                try:
+                    r = await self.peer.consensus.messenger.call(
+                        tuple(addr), "tserver", "txn_status",
+                        {"tablet_id": st_info["tablet_id"],
+                         "txn_id": txn_id}, timeout=2.0)
+                    status = r
+                    break
+                except (RpcError, asyncio.TimeoutError, OSError):
+                    continue
+            if status is None:
+                return
+            if status["status"] == ABORTED:
+                await self.peer.rollback_txn(txn_id)
+            elif status["status"] == COMMITTED:
+                await self.peer.apply_txn(txn_id, status["commit_ht"])
+        finally:
+            meta.pop("probing", None)
 
     def apply_intent_entry(self, payload: bytes):
         """Raft apply of an intent batch: record in IntentsDB + memory."""
         m = msgpack.unpackb(payload, raw=False)
         txn_id = m["txn_id"]
         per_txn = self._intents.setdefault(txn_id, {})
-        self._txn_meta.setdefault(txn_id, {"start_ht": m["start_ht"]})
+        meta = self._txn_meta.setdefault(txn_id,
+                                         {"start_ht": m["start_ht"]})
+        if m.get("status_tablet"):
+            meta["status_tablet"] = m["status_tablet"]
         from ..storage.lsm import WriteBatch
         batch = WriteBatch()
         for key, op in zip(m["keys"], m["req"]["ops"]):
@@ -290,7 +379,7 @@ class TransactionParticipant:
         commit_ht = m["commit_ht"]
         per_txn = self._intents.pop(txn_id, None) or {}
         ops = [RowOp(op[0], op[1], op[2] if len(op) > 2 else None)
-               for op in per_txn.values()]
+               for op in per_txn.values() if op is not None]
         if ops:
             req = WriteRequest("", ops)
             self.tablet.apply_write(req, ht=HybridTime(commit_ht))
@@ -304,12 +393,11 @@ class TransactionParticipant:
 
     def _release(self, txn_id: str, keys):
         from ..storage.lsm import WriteBatch
+        from ..dockv.value import PrimitiveValue
         batch = WriteBatch()
         for k in list(keys):
             if self._key_holder.get(k) == txn_id:
                 del self._key_holder[k]
-            # tombstone the intent record
-            from ..dockv.value import PrimitiveValue
             batch.put(intent_key(k, txn_id),
                       PrimitiveValue.tombstone().encode())
         if batch.entries:

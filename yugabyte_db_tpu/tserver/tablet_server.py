@@ -343,7 +343,8 @@ class TabletServer:
     async def rpc_txn_write(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
         req = write_request_from_wire(payload["req"])
-        n = await peer.write_txn(req, payload["txn_id"], payload["start_ht"])
+        n = await peer.write_txn(req, payload["txn_id"], payload["start_ht"],
+                                 payload.get("status_tablet"))
         return {"rows_affected": n}
 
     async def rpc_apply_txn(self, payload) -> dict:
@@ -488,6 +489,13 @@ class TabletServer:
             await self._heartbeat_once()
             ASH.sample_once()
             ticks += 1
+            if ticks % 10 == 0:      # ~every 2s: txn coordinator sweep
+                for p in list(self.peers.values()):
+                    if p.coordinator is not None and p.is_leader():
+                        try:
+                            await p.coordinator.sweep()
+                        except Exception:
+                            log.exception("coordinator sweep failed")
             if ticks % 25 == 0:      # ~every 5s: WAL retention pass
                 for p in list(self.peers.values()):
                     try:
