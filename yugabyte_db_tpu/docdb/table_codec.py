@@ -44,8 +44,14 @@ class TableInfo:
     partition_schema: PartitionSchema
     packings: SchemaPackingStorage = field(default_factory=SchemaPackingStorage)
     cotable_id: Optional[int] = None    # set for colocated tables
+    # prior schema versions (ALTER history) — required so rows packed
+    # under old versions keep decoding after restarts/clones/bootstraps
+    schema_history: Tuple[TableSchema, ...] = ()
 
     def __post_init__(self):
+        for old in self.schema_history:
+            if old.version not in getattr(self.packings, "_packings", {}):
+                self.packings.add_schema(old)
         if self.schema.version not in getattr(self.packings, "_packings", {}):
             self.packings.add_schema(self.schema)
 
@@ -53,15 +59,27 @@ class TableInfo:
     def packing(self) -> SchemaPacking:
         return self.packings.get(self.schema.version)
 
+    @staticmethod
+    def _schema_wire(schema: TableSchema) -> dict:
+        return {
+            "version": schema.version,
+            "columns": [[c.id, c.name, c.type, c.nullable, c.is_hash_key,
+                         c.is_range_key, c.sort_desc]
+                        for c in schema.columns],
+        }
+
+    @staticmethod
+    def _schema_from_wire(d: dict) -> TableSchema:
+        return TableSchema(
+            columns=tuple(ColumnSchema(*row) for row in d["columns"]),
+            version=d["version"])
+
     def to_wire(self) -> dict:
         return {
             "table_id": self.table_id, "name": self.name,
-            "schema": {
-                "version": self.schema.version,
-                "columns": [[c.id, c.name, c.type, c.nullable, c.is_hash_key,
-                             c.is_range_key, c.sort_desc]
-                            for c in self.schema.columns],
-            },
+            "schema": self._schema_wire(self.schema),
+            "schema_history": [self._schema_wire(h)
+                               for h in self.schema_history],
             "partition": {"kind": self.partition_schema.kind,
                           "num_hash_columns":
                               self.partition_schema.num_hash_columns},
@@ -70,14 +88,14 @@ class TableInfo:
 
     @classmethod
     def from_wire(cls, d: dict) -> "TableInfo":
-        schema = TableSchema(
-            columns=tuple(ColumnSchema(*row)
-                          for row in d["schema"]["columns"]),
-            version=d["schema"]["version"])
+        schema = cls._schema_from_wire(d["schema"])
+        history = tuple(cls._schema_from_wire(h)
+                        for h in d.get("schema_history", []))
         return cls(d["table_id"], d["name"], schema,
                    PartitionSchema(d["partition"]["kind"],
                                    d["partition"]["num_hash_columns"]),
-                   cotable_id=d.get("cotable_id"))
+                   cotable_id=d.get("cotable_id"),
+                   schema_history=history)
 
 
 _KEV_MAKER = {

@@ -312,7 +312,9 @@ class Master:
         new_schema = TableSchema(columns=tuple(cols),
                                  version=info.schema.version + 1)
         new_info = TableInfo(tid, name, new_schema, info.partition_schema,
-                             cotable_id=info.cotable_id)
+                             cotable_id=info.cotable_id,
+                             schema_history=info.schema_history
+                             + (info.schema,))
         new_wire = new_info.to_wire()
         for tablet_id in ent["tablets"]:
             tent = self.tablets.get(tablet_id)
@@ -497,6 +499,18 @@ class Master:
         left_id, right_id = f"{tablet_id}l", f"{tablet_id}r"
         raft_peers = [[u, list(self.tservers[u]["addr"])]
                       for u in ent["replicas"] if u in self.tservers]
+        # Catch-up barrier: every replica must hold the full log before
+        # the replica-local split copies data (otherwise a lagging
+        # follower's children miss recent writes and can win elections
+        # with stale data). The reference avoids this by Raft-replicating
+        # the SplitOperation itself — planned for round 2.
+        if len(ent["replicas"]) > 1:
+            for u in ent["replicas"]:
+                try:
+                    await self.load_balancer._leader_call(
+                        ent, tablet_id, "wait_catchup", {"peer_uuid": u})
+                except (RpcError, asyncio.TimeoutError, OSError):
+                    pass
         for u in ent["replicas"]:
             ts = self.tservers.get(u)
             if ts is None:

@@ -298,6 +298,15 @@ class TabletServer:
         parent = self._peer(parent_id)
         from ..dockv.partition import Partition
         split_key = bytes.fromhex(payload["split_key"])
+        # apply barrier: everything in the local log must be APPLIED to
+        # the store before we copy (log catch-up alone isn't enough — a
+        # replica applies committed entries asynchronously, and the
+        # parent is deleted right after the copy)
+        import time as _time
+        deadline = _time.monotonic() + 30.0
+        while (parent.consensus.last_applied < parent.log.last_index
+               and _time.monotonic() < deadline):
+            await asyncio.sleep(0.05)
         children = []
         for side, child_id in (("left", payload["left_id"]),
                                ("right", payload["right_id"])):
