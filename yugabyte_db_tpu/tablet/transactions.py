@@ -241,6 +241,20 @@ class TransactionParticipant:
         codec = self.tablet.codec
         keys = [codec.doc_key_prefix(op.row) for op in req.ops]
         await self._resolve_conflicts(txn_id, start_ht, keys)
+        # First-committer-wins (snapshot isolation): a committed write
+        # NEWER than our snapshot on any target key is a conflict — the
+        # reference checks regular-DB records against the read time in
+        # ResolveTransactionConflicts (docdb/conflict_resolution.cc).
+        for k in keys:
+            committed = self._newest_committed_ht(k)
+            if committed is not None and committed > start_ht:
+                per_txn = self._intents.get(txn_id, {})
+                self._release(txn_id,
+                              [kk for kk in keys
+                               if per_txn.get(kk) is None])
+                raise RpcError(
+                    f"txn {txn_id} write conflict: key modified at "
+                    f"{committed} after snapshot {start_ht}", "ABORTED")
         if status_tablet:
             self._txn_meta.setdefault(txn_id, {})["status_tablet"] = \
                 status_tablet
@@ -259,6 +273,18 @@ class TransactionParticipant:
                           [k for k in keys if per_txn.get(k) is None])
             raise
         return len(req.ops)
+
+    def _newest_committed_ht(self, doc_key: bytes):
+        """Hybrid time of the newest committed version of doc_key in the
+        regular store (None if absent)."""
+        from ..utils.hybrid_time import ENCODED_SIZE, DocHybridTime
+        marker = 0x05
+        for k, _v in self.tablet.regular.seek(doc_key):
+            if not k.startswith(doc_key) or \
+                    k[len(doc_key)] != marker:
+                return None
+            return DocHybridTime.decode_desc(k[-ENCODED_SIZE:]).ht.value
+        return None
 
     def _would_deadlock(self, txn_id: str, blockers: Set[str]) -> bool:
         """Local wait-for cycle check (reference: probe-based
