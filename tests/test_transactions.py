@@ -115,7 +115,11 @@ class TestTransactions:
                 await mc.shutdown()
         run(go())
 
-    def test_write_write_conflict_waits_then_succeeds(self, tmp_path):
+    def test_write_write_conflict_waits_then_aborts_then_retry(self, tmp_path):
+        """Snapshot isolation is first-committer-wins: the waiter must NOT
+        blindly overwrite the winner's commit (that's a lost update); it
+        aborts with a conflict, and a RETRY with a fresh snapshot
+        succeeds."""
         async def go():
             mc, c = await make_cluster(str(tmp_path))
             try:
@@ -123,15 +127,28 @@ class TestTransactions:
                 t2 = await c.transaction().begin()
                 await t1.insert("acct", [{"k": 9, "bal": 1.0}])
 
+                result = {}
+
                 async def t2_write():
-                    await t2.insert("acct", [{"k": 9, "bal": 2.0}])
-                    await t2.commit()
+                    try:
+                        await t2.insert("acct", [{"k": 9, "bal": 2.0}])
+                        await t2.commit()
+                        result["outcome"] = "committed"
+                    except RpcError as e:
+                        result["outcome"] = e.code
 
                 task = asyncio.create_task(t2_write())
                 await asyncio.sleep(0.3)
                 assert not task.done()       # t2 is waiting on t1's intent
                 await t1.commit()
                 await asyncio.wait_for(task, 10.0)
+                await asyncio.sleep(0.3)
+                assert result["outcome"] == "ABORTED"
+                assert (await c.get("acct", {"k": 9}))["bal"] == 1.0
+                # retry with a fresh snapshot wins
+                t3 = await c.transaction().begin()
+                await t3.insert("acct", [{"k": 9, "bal": 2.0}])
+                await t3.commit()
                 await asyncio.sleep(0.3)
                 assert (await c.get("acct", {"k": 9}))["bal"] == 2.0
             finally:
