@@ -266,8 +266,10 @@ class YBClient:
             first: Optional[ReadResponse] = None
             while True:
                 r = ReadRequest(
-                    req.table_id, req.columns, req.where, req.aggregates,
-                    req.group_by, None, req.limit, paging, req.read_ht)
+                    req.table_id, columns=req.columns, where=req.where,
+                    aggregates=req.aggregates, group_by=req.group_by,
+                    limit=req.limit, paging_state=paging,
+                    read_ht=req.read_ht, consistency=req.consistency)
                 payload = {"tablet_id": loc.tablet_id,
                            "req": read_request_to_wire(r)}
                 resp = read_response_from_wire(await self._call_leader(

@@ -564,7 +564,9 @@ def _agg_accumulate(aggs, agg_state, group_state, group, idrow):
     stride = 1
     for cid, domain, offset in group.cols:
         c = idrow.get(cid)
-        c = 0 if c is None else int(c) - offset
+        if c is None:
+            return       # NULL group values are excluded (matches device)
+        c = int(c) - offset
         gid += max(0, min(c, domain - 1)) * stride
         stride *= domain
     st = group_state.setdefault(gid, [_agg_init(a) for a in aggs] + [0])

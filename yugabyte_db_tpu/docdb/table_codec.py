@@ -282,6 +282,7 @@ class TableCodec:
         used by CPU merges/point-reads over bulk-loaded SSTs)."""
         assert blk.keys is not None
         packing = self.info.packings.get(blk.schema_version)
+        packer = RowPacker(packing)
         out = []
         for i in range(blk.n):
             key = blk.keys[i].tobytes()
@@ -303,7 +304,7 @@ class TableCodec:
                                                  ColumnType.JSON,
                                                  ColumnType.DECIMAL)
                                    else raw)
-            out.append((key, RowPacker(packing).pack_value(values)))
+            out.append((key, packer.pack_value(values)))
         return out
 
     # --- vectorized bulk load ---------------------------------------------
@@ -358,7 +359,14 @@ class TableCodec:
         sorted_idx = idx[order]
         # all doc keys share one width here, so the matrix FNV is byte-
         # exact with fnv64_bytes — consistent with flush-built blocks
-        key_hash = _fnv_rows(doc_keys[order])
+        sorted_keys = doc_keys[order]
+        key_hash = _fnv_rows(sorted_keys)
+        if len(sorted_keys) > 1:
+            uniq = bool((sorted_keys[1:] != sorted_keys[:-1])
+                        .any(axis=1).all())
+        else:
+            uniq = True
+        write_ids = np.arange(len(idx), dtype=np.uint32)[order]
         blocks = []
         for s in range(0, len(sorted_idx), block_rows):
             sel = sorted_idx[s:s + block_rows]
@@ -379,8 +387,9 @@ class TableCodec:
                 schema_version=self.schema.version,
                 key_hash=key_hash[s:s + bn],
                 ht=np.full(bn, ht.value, np.uint64),
+                write_id=write_ids[s:s + bn],
                 pk=pk, fixed=fixed, varlen=varlen,
-                keys=full[s:s + bn], unique_keys=True))
+                keys=full[s:s + bn], unique_keys=uniq))
         return blocks
 
 

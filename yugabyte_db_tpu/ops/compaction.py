@@ -55,64 +55,14 @@ def split_ht_suffix(keys: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarra
     return dk, ht, wid
 
 
-@partial(jax.jit, static_argnames=("num_key_words",))
-def merge_gc_kernel(full_words: jnp.ndarray,     # [N, W] sort key (full key)
-                    dockey_words: jnp.ndarray,   # [N, Wd]
-                    ht: jnp.ndarray,             # [N] u64
-                    tombstone: jnp.ndarray,      # [N] bool
-                    valid: jnp.ndarray,          # [N] bool (padding=False)
-                    history_cutoff,              # scalar u64
-                    num_key_words: int):
-    """Returns (order [N] int32, keep [N] bool in SORTED order).
-
-    Sorted ascending by full key; invalid (padding) rows sort last and are
-    never kept."""
-    n = full_words.shape[0]
-    # push padding rows to the end
-    first = jnp.where(valid, full_words[:, 0], jnp.uint64(0xFFFFFFFFFFFFFFFF))
-    operands = (first,) + tuple(full_words[:, i] for i in range(1, num_key_words)) \
-        + (jnp.arange(n, dtype=jnp.int32),)
-    sorted_ops = jax.lax.sort(operands, num_keys=num_key_words)
-    order = sorted_ops[-1]
-    dk_s = dockey_words[order]
-    ht_s = ht[order]
-    tomb_s = tombstone[order]
-    valid_s = valid[order]
-    full_s = full_words[order]
-
-    same_dockey = jnp.concatenate([
-        jnp.array([False]),
-        jnp.all(dk_s[1:] == dk_s[:-1], axis=1)])
-    exact_dup = jnp.concatenate([
-        jnp.array([False]),
-        jnp.all(full_s[1:] == full_s[:-1], axis=1)])
-    prev_ht = jnp.concatenate([ht_s[:1], ht_s[:-1]])
-    leq = ht_s <= history_cutoff
-    prev_leq = jnp.concatenate([jnp.array([False]), leq[:-1]])
-    # first version of this dockey at or below the cutoff
-    first_leq = leq & (~same_dockey | ~prev_leq)
-    keep = valid_s & ~exact_dup & (
-        (ht_s > history_cutoff) | (first_leq & ~tomb_s))
-    return order, keep
-
-
 def compact_entry_arrays(keys: np.ndarray, tombstone: np.ndarray,
                          history_cutoff: int,
                          valid: Optional[np.ndarray] = None
                          ) -> Tuple[np.ndarray, np.ndarray]:
-    """Host wrapper: full SubDocKey matrix [N, L] (zero-padded rows OK) →
-    (sorted_order, keep_mask_sorted) as numpy arrays."""
-    n = keys.shape[0]
-    dk, ht, _wid = split_ht_suffix(keys)
-    full_words = keys_to_words(keys)
-    dk_words = keys_to_words(dk)
-    if valid is None:
-        valid = np.ones(n, bool)
-    order, keep = merge_gc_kernel(
-        jnp.asarray(full_words), jnp.asarray(dk_words), jnp.asarray(ht),
-        jnp.asarray(tombstone), jnp.asarray(valid),
-        jnp.uint64(history_cutoff), num_key_words=full_words.shape[1])
-    return np.asarray(order), np.asarray(keep)
+    """Host wrapper: full SubDocKey matrix [N, L] → (sorted_order,
+    keep_mask_sorted). One retention-rule implementation: delegates to
+    the split kernel (sort by dockey, ~ht, ~wid == full-key sort)."""
+    return compact_runs([(keys, tombstone)], history_cutoff)
 
 
 def pad_key_matrices(mats: Sequence[np.ndarray]) -> np.ndarray:

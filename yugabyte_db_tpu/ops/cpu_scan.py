@@ -127,6 +127,9 @@ def cpu_scan_aggregate(blocks: Sequence[ColumnarBlock],
     gid = None
     stride = 1
     for cid, domain, offset in group.cols:
+        gn = nulls.get(cid)
+        if gn is not None:
+            mask &= ~gn
         c = np.clip(cols[cid].astype(np.int64) - offset, 0, domain - 1)
         gid = c * stride if gid is None else gid + c * stride
         stride *= domain

@@ -26,7 +26,10 @@ def bucket_rows(n: int) -> int:
     for b in _BUCKETS:
         if n <= b:
             return b
-    return _BUCKETS[-1]
+    b = _BUCKETS[-1]
+    while b < n:          # beyond the table: keep doubling
+        b <<= 1
+    return b
 
 
 @dataclass

@@ -127,10 +127,16 @@ def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
                     raise ValueError(op)
             return tuple(out), jnp.sum(mask, dtype=jnp.int64), mask
 
-        # grouped: one-hot [N, G] matmul — rides the MXU
+        # grouped: one-hot [N, G] matmul — rides the MXU.
+        # Rows with NULL in any group column are excluded (the device
+        # group-id encoding has no NULL slot; PG's NULL group stays on
+        # the CPU fallback path).
         gid = None
         stride = 1
         for cid, domain, offset in group.cols:
+            gn = nulls.get(cid)
+            if gn is not None:
+                mask = mask & jnp.logical_not(gn)
             c = cols[cid].astype(jnp.int32) - offset
             c = jnp.clip(c, 0, domain - 1)
             gid = c * stride if gid is None else gid + c * stride

@@ -231,11 +231,14 @@ class SqlSession:
             rows = [self._project_row(stmt, r, schema) for r in idx_rows]
             return SqlResult(self._order_limit(stmt, rows))
 
-        # plain row scan
+        # plain row scan; LIMIT pushes down only when no client-side
+        # reordering/dedup/offset must happen first
         columns = self._needed_columns(stmt, schema)
+        push_limit = (None if (stmt.order_by or stmt.distinct or stmt.offset)
+                      else stmt.limit)
         resp = await self.client.scan(stmt.table, ReadRequest(
             "", columns=tuple(columns), where=where, read_ht=read_ht,
-            limit=None if stmt.order_by else stmt.limit))
+            limit=push_limit))
         rows = [self._project_row(stmt, r, schema) for r in resp.rows]
         rows = self._order_limit(stmt, rows)
         return SqlResult(rows)
@@ -378,11 +381,13 @@ class SqlSession:
 
     async def _grouped_pushdown(self, stmt, ct, where, gspec) -> SqlResult:
         schema = ct.info.schema
+        read_ht = self._txn.start_ht if self._txn is not None else None
         agg_items = [it for it in stmt.items if it[0] == "agg"]
         aggs = tuple(AggSpec(op, self._bind(e, schema))
                      for _, op, e in agg_items)
         resp = await self.client.scan(stmt.table, ReadRequest(
-            "", where=where, aggregates=aggs, group_by=gspec))
+            "", where=where, aggregates=aggs, group_by=gspec,
+            read_ht=read_ht))
         counts = np.asarray(resp.group_counts)
         rows = []
         for gid in range(gspec.num_groups):
@@ -402,13 +407,15 @@ class SqlSession:
     async def _grouped_clientside(self, stmt, ct, where) -> SqlResult:
         """Hash grouping over projected rows (arbitrary-domain GROUP BY)."""
         schema = ct.info.schema
+        read_ht = self._txn.start_ht if self._txn is not None else None
         agg_items = [it for it in stmt.items if it[0] == "agg"]
         needed = set(stmt.group_by)
         for _, op, e in agg_items:
             if e is not None:
                 self._collect_names(e, needed)
         resp = await self.client.scan(stmt.table, ReadRequest(
-            "", columns=tuple(sorted(needed)), where=where))
+            "", columns=tuple(sorted(needed)), where=where,
+            read_ht=read_ht))
         groups: Dict[tuple, list] = {}
         bound = [(op, self._bind(e, schema) if e else None)
                  for _, op, e in agg_items]
@@ -451,9 +458,10 @@ class SqlSession:
         ct = await self.client._table(stmt.table)
         schema = ct.info.schema
         pk_cols = [c.name for c in schema.key_columns]
+        read_ht = self._txn.start_ht if self._txn is not None else None
         where = self._bind(stmt.where, schema)
         resp = await self.client.scan(stmt.table, ReadRequest(
-            "", columns=tuple(pk_cols), where=where))
+            "", columns=tuple(pk_cols), where=where, read_ht=read_ht))
         if not resp.rows:
             return SqlResult([], "DELETE 0")
         if self._txn is not None:
@@ -465,9 +473,10 @@ class SqlSession:
     async def _update(self, stmt: UpdateStmt) -> SqlResult:
         ct = await self.client._table(stmt.table)
         schema = ct.info.schema
+        read_ht = self._txn.start_ht if self._txn is not None else None
         where = self._bind(stmt.where, schema)
         resp = await self.client.scan(stmt.table, ReadRequest(
-            "", where=where))
+            "", where=where, read_ht=read_ht))
         if not resp.rows:
             return SqlResult([], "UPDATE 0")
         updated = [dict(r, **stmt.sets) for r in resp.rows]
