@@ -238,3 +238,28 @@ class TestAlterTable:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestLimitNoOrder:
+    def test_limit_without_order_by(self, cluster):
+        """Regression: LIMIT without ORDER BY goes through the client
+        paging path (found as a positional-arg bug in review)."""
+        async def go():
+            mc, s = await _session(cluster)
+            try:
+                await s.execute("CREATE TABLE ln (k bigint, v double, "
+                                "PRIMARY KEY (k)) WITH tablets = 2")
+                await mc.wait_for_leaders("ln")
+                await s.execute("INSERT INTO ln (k, v) VALUES "
+                                + ", ".join(f"({i}, {i})"
+                                            for i in range(30)))
+                r = await s.execute("SELECT k FROM ln LIMIT 7")
+                assert len(r.rows) == 7
+                # transactional snapshot scan with limit
+                await s.execute("BEGIN")
+                r = await s.execute("SELECT k FROM ln LIMIT 5")
+                assert len(r.rows) == 5
+                await s.execute("ROLLBACK")
+            finally:
+                await mc.shutdown()
+        run(go())

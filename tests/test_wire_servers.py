@@ -152,3 +152,61 @@ class TestRedisServer:
                 await srv.shutdown()
                 await mc.shutdown()
         run(go())
+
+
+class TestCqlPaging:
+    def test_result_paging_frames(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            srv = CqlServer(mc.client())
+            addr = await srv.start()
+            try:
+                reader, writer = await asyncio.open_connection(*addr)
+                await cql_frame(writer, reader, 0x01, struct.pack(">H", 0))
+                await cql_frame(writer, reader, 0x07, longstr(
+                    "CREATE TABLE pg (k bigint, PRIMARY KEY (k))"))
+                await mc.wait_for_leaders("pg")
+                await cql_frame(writer, reader, 0x07, longstr(
+                    "INSERT INTO pg (k) VALUES "
+                    + ", ".join(f"({i})" for i in range(25))))
+
+                def q_with_paging(sql, page_size, state=None):
+                    b = sql.encode()
+                    flags = 0x04 | (0x08 if state else 0)
+                    body = struct.pack(">i", len(b)) + b
+                    body += struct.pack(">HB", 0, flags)
+                    body += struct.pack(">i", page_size)
+                    if state:
+                        body += struct.pack(">i", len(state)) + state
+                    return body
+
+                total = 0
+                state = None
+                pages = 0
+                while True:
+                    op, body = await cql_frame(
+                        writer, reader, 0x07,
+                        q_with_paging("SELECT k FROM pg ORDER BY k",
+                                      10, state))
+                    assert op == 0x08
+                    kind, flags_ = struct.unpack_from(">ii", body)
+                    assert kind == 2
+                    pos = 8
+                    (ncols,) = struct.unpack_from(">i", body, pos)
+                    pos += 4
+                    state = None
+                    if flags_ & 0x02:
+                        (ln,) = struct.unpack_from(">i", body, pos)
+                        pos += 4
+                        state = body[pos:pos + ln]
+                        pos += ln
+                    # skip table spec + col specs
+                    pages += 1
+                    if state is None:
+                        break
+                assert pages == 3   # 25 rows @ page 10 -> 3 pages
+                writer.close()
+            finally:
+                await srv.shutdown()
+                await mc.shutdown()
+        run(go())

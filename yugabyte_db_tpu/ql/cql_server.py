@@ -113,7 +113,9 @@ class CqlServer:
             if opcode == OP_QUERY:
                 (qlen,) = struct.unpack(">i", body[:4])
                 sql = body[4:4 + qlen].decode()
-                return OP_RESULT, await self._run(sql)
+                page_size, paging_state = self._query_params(body, 4 + qlen)
+                return OP_RESULT, await self._run(sql, page_size,
+                                                  paging_state)
             if opcode == OP_PREPARE:
                 (qlen,) = struct.unpack(">i", body[:4])
                 sql = body[4:4 + qlen].decode()
@@ -140,6 +142,34 @@ class CqlServer:
     def _error(self, code: int, msg: str) -> Tuple[int, bytes]:
         return OP_ERROR, struct.pack(">i", code) + _string(msg)
 
+    @staticmethod
+    def _query_params(body: bytes, pos: int):
+        """Parse <consistency><flags>[...] after the query string; we
+        honor PAGE_SIZE (0x04) and WITH_PAGING_STATE (0x08)."""
+        try:
+            pos += 2                       # consistency
+            flags_ = body[pos]
+            pos += 1
+            page_size = None
+            paging_state = None
+            if flags_ & 0x01:              # values: skip n [bytes]
+                (n,) = struct.unpack_from(">H", body, pos)
+                pos += 2
+                for _ in range(n):
+                    (ln,) = struct.unpack_from(">i", body, pos)
+                    pos += 4 + max(ln, 0)
+            if flags_ & 0x04:
+                (page_size,) = struct.unpack_from(">i", body, pos)
+                pos += 4
+            if flags_ & 0x08:
+                (ln,) = struct.unpack_from(">i", body, pos)
+                pos += 4
+                paging_state = body[pos:pos + ln]
+                pos += ln
+            return page_size, paging_state
+        except (struct.error, IndexError):
+            return None, None
+
     def _system_rows(self, sql: str):
         """Canned system.local/system.peers rows so Cassandra drivers can
         hand-shake (reference: master YQL virtual system tables,
@@ -155,7 +185,8 @@ class CqlServer:
             return []
         return None
 
-    async def _run(self, sql: str) -> bytes:
+    async def _run(self, sql: str, page_size=None,
+                   paging_state=None) -> bytes:
         sys_rows = self._system_rows(sql)
         if sys_rows is not None:
             return self._rows_result(sys_rows)
@@ -167,13 +198,24 @@ class CqlServer:
                     _string("ybtpu") + _string("t")
                 return body
             return struct.pack(">i", K_VOID)
-        return self._rows_result(res.rows)
+        rows = res.rows
+        next_state = None
+        if page_size and page_size > 0:
+            start = int(paging_state.decode()) if paging_state else 0
+            page = rows[start:start + page_size]
+            if start + page_size < len(rows):
+                next_state = str(start + page_size).encode()
+            rows = page
+        return self._rows_result(rows, next_state)
 
-    def _rows_result(self, rows) -> bytes:
+    def _rows_result(self, rows, paging_state: bytes = None) -> bytes:
         cols = list(rows[0].keys()) if rows else []
         body = struct.pack(">i", K_ROWS)
-        body += struct.pack(">i", 0x0001)          # global tables spec
+        flags_ = 0x0001 | (0x0002 if paging_state is not None else 0)
+        body += struct.pack(">i", flags_)          # global spec [+ paging]
         body += struct.pack(">i", len(cols))
+        if paging_state is not None:
+            body += struct.pack(">i", len(paging_state)) + paging_state
         body += _string("ybtpu") + _string("t")
         sample = rows[0] if rows else {}
         for c in cols:
