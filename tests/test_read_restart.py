@@ -1,0 +1,62 @@
+"""Read-restart (clock uncertainty) tests: strong reads encountering a
+record inside (read_ht, read_ht + max_skew] restart at the record's HT;
+explicit snapshot reads never restart (reference: read restart handling
+around tserver/read_query.cc PickReadTime)."""
+import numpy as np
+import pytest
+
+from yugabyte_db_tpu.docdb import ReadRequest, RowOp, WriteRequest
+from yugabyte_db_tpu.ops import AggSpec, Expr
+from yugabyte_db_tpu.tablet import Tablet
+from yugabyte_db_tpu.utils import flags
+from yugabyte_db_tpu.utils.hybrid_time import (
+    HybridClock, HybridTime, MockPhysicalClock,
+)
+from tests.test_tablet import make_info
+
+C = Expr.col
+
+
+class TestReadRestart:
+    def test_strong_read_sees_ahead_of_clock_write(self, tmp_path):
+        """A write stamped by a FAST clock (ahead of the reader's) must be
+        visible to a subsequent strong read — via restart."""
+        clock = HybridClock(MockPhysicalClock(1_000_000))
+        t = Tablet("rr-1", make_info(), str(tmp_path), clock=clock)
+        # writer's clock runs 200ms ahead (within the 500ms skew bound)
+        ahead = HybridTime.from_micros(1_000_000 + 200_000)
+        t.apply_write(WriteRequest("t1", [
+            RowOp("upsert", {"k": 1, "v": 42.0, "s": "ahead"})]), ht=ahead)
+        # strong read picks read_ht from the local (slow) clock — without
+        # restarts it would miss the committed row
+        resp = t.read(ReadRequest("t1", pk_eq={"k": 1}))
+        assert resp.rows and resp.rows[0]["v"] == 42.0
+        # scans too
+        resp = t.read(ReadRequest("t1", columns=("k",)))
+        assert len(resp.rows) == 1
+
+    def test_snapshot_read_does_not_restart(self, tmp_path):
+        clock = HybridClock(MockPhysicalClock(1_000_000))
+        t = Tablet("rr-2", make_info(), str(tmp_path), clock=clock)
+        t.apply_write(WriteRequest("t1", [
+            RowOp("upsert", {"k": 1, "v": 1.0, "s": "old"})]),
+            ht=HybridTime.from_micros(1_000_100))
+        snapshot_ht = clock.now().value
+        # later write inside what WOULD be the uncertainty window
+        t.apply_write(WriteRequest("t1", [
+            RowOp("upsert", {"k": 1, "v": 2.0, "s": "new"})]),
+            ht=HybridTime.from_micros(1_100_000))
+        resp = t.read(ReadRequest("t1", pk_eq={"k": 1},
+                                  read_ht=snapshot_ht))
+        assert resp.rows[0]["v"] == 1.0   # explicit snapshot: no restart
+
+    def test_far_future_write_not_visible(self, tmp_path):
+        """Writes beyond the skew bound don't trigger restarts (they are
+        genuinely in the future)."""
+        clock = HybridClock(MockPhysicalClock(1_000_000))
+        t = Tablet("rr-3", make_info(), str(tmp_path), clock=clock)
+        far = HybridTime.from_micros(1_000_000 + 10_000_000)  # +10s
+        t.apply_write(WriteRequest("t1", [
+            RowOp("upsert", {"k": 1, "v": 9.0, "s": "future"})]), ht=far)
+        resp = t.read(ReadRequest("t1", pk_eq={"k": 1}))
+        assert not resp.rows

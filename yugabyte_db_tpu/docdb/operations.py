@@ -66,6 +66,10 @@ class ReadRequest:
     limit: Optional[int] = None
     paging_state: Optional[bytes] = None      # resume key (exclusive)
     read_ht: Optional[int] = None             # read point (HybridTime.value)
+    # True when the SERVER picked read_ht from its clock: only such reads
+    # are subject to uncertainty-window restarts (explicit snapshot /
+    # time-travel read points never restart)
+    server_assigned_read_ht: bool = False
     # 'strong' = leader + lease; 'follower' = consistent-prefix read from
     # any replica (reference: follower reads / consistent prefix,
     # tserver/read_query.cc consistency levels)
@@ -209,6 +213,20 @@ class DocWriteOperation:
 # --------------------------------------------------------------------------
 # Read operation
 # --------------------------------------------------------------------------
+def _skew_window_ht() -> int:
+    return flags.get("max_clock_skew_ms") * 1000 << 12
+
+
+class ReadRestartError(Exception):
+    """Internal: a record inside the clock-uncertainty window was seen;
+    the read must restart at restart_ht (reference: read restarts in
+    tserver/read_query.cc / transactional reads design)."""
+
+    def __init__(self, restart_ht: int):
+        super().__init__(f"read restart at {restart_ht}")
+        self.restart_ht = restart_ht
+
+
 class DocReadOperation:
     """Executes a ReadRequest against one tablet's stores."""
 
@@ -219,6 +237,8 @@ class DocReadOperation:
         self.store = store
         self.kernel = scan_kernel or _SHARED_KERNEL
         self.device_cache = device_cache
+        # restarts engage only via execute() on server-assigned read points
+        self._allow_restart = False
 
     # ---- point lookup ----------------------------------------------------
     def get_row(self, pk_row: Dict[str, object], read_ht: int
@@ -231,6 +251,8 @@ class DocReadOperation:
         prefix = self.codec.doc_key_prefix(pk_row)
         h = fnv64_bytes(prefix)
 
+        window_hi = read_ht + _skew_window_ht()
+
         def newest_visible(entries):
             for k, v in entries:
                 if not k.startswith(prefix) or \
@@ -238,6 +260,11 @@ class DocReadOperation:
                     return None
                 dht = DocHybridTime.decode_desc(k[-ENCODED_SIZE:])
                 if dht.ht.value > read_ht:
+                    if self._allow_restart and \
+                            dht.ht.value <= window_hi:
+                        # concurrent write inside the uncertainty window:
+                        # the writer's clock may be ahead — restart
+                        raise ReadRestartError(dht.ht.value)
                     continue
                 return (dht, k, v)
             return None
@@ -268,6 +295,19 @@ class DocReadOperation:
 
     # ---- scans -----------------------------------------------------------
     def execute(self, req: ReadRequest) -> ReadResponse:
+        if req.server_assigned_read_ht:
+            for _attempt in range(3):
+                try:
+                    return self._execute_once(req)
+                except ReadRestartError as e:
+                    req.read_ht = e.restart_ht
+        # explicit read points never restart; after 3 bumps serve at the
+        # last restart point without further bumps
+        return self._execute_once(req, allow_restart=False)
+
+    def _execute_once(self, req: ReadRequest,
+                      allow_restart: bool = True) -> ReadResponse:
+        self._allow_restart = allow_restart and req.server_assigned_read_ht
         if req.pk_eq is not None:
             read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
             row = self.get_row(req.pk_eq, read_ht)
@@ -383,6 +423,13 @@ class DocReadOperation:
         except KeyError:
             return None   # some column lacks columnar form → CPU path
         read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
+        if self._allow_restart and read_ht != _MAX_HT:
+            window_hi = read_ht + _skew_window_ht()
+            for b in blocks:
+                amb = b.ht[(b.ht > np.uint64(read_ht))
+                           & (b.ht <= np.uint64(window_hi))]
+                if len(amb):
+                    raise ReadRestartError(int(amb.max()))
         # multiple overlapping sources → force dedup mode via unique_keys
         if len(blocks) > 1:
             batch.unique_keys = False
@@ -478,6 +525,9 @@ class DocReadOperation:
                 continue
             dht = DocHybridTime.decode_desc(k[-ENCODED_SIZE:])
             if dht.ht.value > read_ht:
+                if self._allow_restart and \
+                        dht.ht.value <= read_ht + _skew_window_ht():
+                    raise ReadRestartError(dht.ht.value)
                 continue
             chosen = True   # newest visible version of this doc key
             from ..dockv.value import unwrap_ttl

@@ -128,6 +128,7 @@ class Tablet:
         t0 = time.perf_counter()
         if req.read_ht is None:
             req.read_ht = self.clock.now().value
+            req.server_assigned_read_ht = True
         resp = self._read_ops.get(req.table_id, self._read_op).execute(req)
         self._m_reads.increment()
         self._m_read_lat.increment((time.perf_counter() - t0) * 1e6)

@@ -139,6 +139,9 @@ DEFINE_RUNTIME("leader_lease_duration_ms", 2000, "Raft leader lease length.")
 DEFINE_RUNTIME("log_segment_size_bytes", 16 * 1024 * 1024, "WAL segment size.")
 DEFINE_RUNTIME("memstore_flush_threshold_bytes", 64 * 1024 * 1024,
                "Memtable size that triggers a flush.")
+DEFINE_RUNTIME("max_clock_skew_ms", 500,
+               "Clock uncertainty window: strong reads restart when they "
+               "encounter records within (read_ht, read_ht + skew].")
 DEFINE_RUNTIME("history_retention_interval_sec", 900,
                "MVCC history retention before compaction GC "
                "(timestamp_history_retention_interval_sec analog).")
