@@ -40,7 +40,7 @@ class TestReadRestart:
         t = Tablet("rr-2", make_info(), str(tmp_path), clock=clock)
         t.apply_write(WriteRequest("t1", [
             RowOp("upsert", {"k": 1, "v": 1.0, "s": "old"})]),
-            ht=HybridTime.from_micros(1_000_100))
+            ht=HybridTime.from_micros(999_000))
         snapshot_ht = clock.now().value
         # later write inside what WOULD be the uncertainty window
         t.apply_write(WriteRequest("t1", [
