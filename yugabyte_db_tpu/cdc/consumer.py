@@ -59,7 +59,11 @@ class CdcStream:
             try:
                 resp = await self.client._call_leader(
                     ct, loc.tablet_id, "get_changes", payload)
-            except RpcError:
+            except RpcError as e:
+                if e.code == "CACHE_MISS_ERROR":
+                    # WAL GC trimmed past our checkpoint: unrecoverable
+                    # from the log — the consumer must resync (full scan)
+                    raise
                 continue
             if resp["checkpoint"] != self.checkpoints.get(loc.tablet_id):
                 self.checkpoints[loc.tablet_id] = resp["checkpoint"]

@@ -100,10 +100,23 @@ class Log:
         del self._entries[max(keep, 0):]
 
     # --- append path ------------------------------------------------------
+    def _next_segment_number(self) -> int:
+        """Strictly increasing across GC: derive from the largest
+        existing segment number, NOT the list length (GC shrinks the
+        list; reusing a live segment's name would let a later GC delete
+        the active file — committed-entry loss)."""
+        mx = 0
+        for p in self._segments:
+            try:
+                mx = max(mx, int(os.path.basename(p).split("-")[1]))
+            except (IndexError, ValueError):
+                pass
+        return mx + 1
+
     def _roll_segment(self) -> None:
         if self._active is not None:
             self._active.close()
-        n = len(self._segments) + 1
+        n = self._next_segment_number()
         self._active_path = os.path.join(self.dir, f"wal-{n:06d}")
         self._segments.append(self._active_path)
         self._active = open(self._active_path, "ab")

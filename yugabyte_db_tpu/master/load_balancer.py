@@ -97,13 +97,10 @@ class ClusterLoadBalancer:
                 r = await self._leader_call(ent, tablet_id,
                                             "create_snapshot",
                                             {"snapshot_id": snap_id})
-                leader_uuid = ent.get("leader") or ent["replicas"][0]
-                for u in [ent.get("leader")] + list(ent["replicas"]):
-                    if u and u in m.tservers:
-                        leader_uuid = u
-                        break
-                rb = {"addr": list(m.tservers[leader_uuid]["addr"]),
-                      "tablet_id": tablet_id, "snapshot_id": snap_id}
+                src_uuid = r.get("ts_uuid")     # the node that HAS it
+                if src_uuid in m.tservers:
+                    rb = {"addr": list(m.tservers[src_uuid]["addr"]),
+                          "tablet_id": tablet_id, "snapshot_id": snap_id}
             except (RpcError, asyncio.TimeoutError, OSError):
                 rb = None   # fall back to pure log catch-up
             # 1. create the replica on the destination with the JOINT
@@ -119,6 +116,7 @@ class ClusterLoadBalancer:
             await self._leader_change_config(ent, tablet_id, add_peers)
             ent["replicas"] = list(dict.fromkeys(
                 ent["replicas"] + [to_uuid]))
+            await m._commit_catalog([["put_tablet", tablet_id, ent]])
             # 3. wait until the new peer has the whole log
             await self._leader_call(ent, tablet_id, "wait_catchup",
                                     {"peer_uuid": to_uuid})
@@ -133,8 +131,8 @@ class ClusterLoadBalancer:
                         timeout=10.0)
                 except (RpcError, asyncio.TimeoutError, OSError):
                     pass
-            ent["replicas"] = new_replicas
-            m._persist()
+            ent = dict(ent, replicas=new_replicas)
+            await m._commit_catalog([["put_tablet", tablet_id, ent]])
             return True
         except (RpcError, asyncio.TimeoutError, OSError):
             return False

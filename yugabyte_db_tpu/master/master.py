@@ -351,7 +351,7 @@ class Master:
         if tid is None:
             raise RpcError(f"table {name} not found", "NOT_FOUND")
         for tablet_id in self.tables[tid]["tablets"]:
-            ent = self.tablets.pop(tablet_id, None)
+            ent = self.tablets.get(tablet_id)
             if not ent:
                 continue
             for u in ent["replicas"]:

@@ -61,6 +61,15 @@ def build_sharded_batch(tm: TabletMesh,
     pad = bucket_rows(max(max(ns), 1))
 
     def stack(get, dtype=None):
+        if dtype is None:
+            # take the real dtype from any nonempty shard so empty shards
+            # don't promote int columns to float64 via np.stack
+            for blocks in per_shard_blocks:
+                for b in blocks:
+                    dtype = get(b).dtype
+                    break
+                if dtype is not None:
+                    break
         rows = []
         for blocks, n in zip(per_shard_blocks, ns):
             parts = [get(b) for b in blocks]

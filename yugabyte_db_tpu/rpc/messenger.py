@@ -98,7 +98,11 @@ class Connection:
         self.pending[call_id] = fut
         self.writer.write(_pack([call_id, _REQ, service, method, payload]))
         await self.writer.drain()
-        return await asyncio.wait_for(fut, timeout)
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            self.pending.pop(call_id, None)
+            raise
 
     def close(self):
         self.closed = True
@@ -203,6 +207,13 @@ class Messenger:
         except RpcError as e:
             if e.code == "NETWORK_ERROR":
                 self._conns.pop(key, None)
+            raise
+        except asyncio.TimeoutError:
+            # the connection may be wedged (half-open socket): evict so
+            # the next call reconnects
+            if self._conns.get(key) is conn:
+                self._conns.pop(key, None)
+                conn.close()
             raise
 
     async def shutdown(self):

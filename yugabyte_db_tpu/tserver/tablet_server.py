@@ -287,7 +287,7 @@ class TabletServer:
         d = os.path.join(self._tablet_dir(payload["tablet_id"]),
                          "snapshots", payload["snapshot_id"])
         peer.tablet.create_snapshot(d)
-        return {"ok": True, "dir": d}
+        return {"ok": True, "dir": d, "ts_uuid": self.uuid}
 
     async def rpc_split_tablet(self, payload) -> dict:
         """Split a local tablet replica into two children at split_key.
@@ -307,6 +307,13 @@ class TabletServer:
         while (parent.consensus.last_applied < parent.log.last_index
                and _time.monotonic() < deadline):
             await asyncio.sleep(0.05)
+        if parent.consensus.last_applied < parent.log.last_index:
+            raise RpcError("split apply barrier timed out", "TRY_AGAIN")
+        if parent.participant._key_holder:
+            # in-flight transactions hold intents on this tablet; their
+            # provisional writes would be dropped by the copy
+            raise RpcError("tablet has live transaction intents; retry "
+                           "after they resolve", "TRY_AGAIN")
         children = []
         for side, child_id in (("left", payload["left_id"]),
                                ("right", payload["right_id"])):
@@ -436,6 +443,13 @@ class TabletServer:
         peer = self._peer(payload["tablet_id"])
         from_index = payload.get("from_index", 0)
         limit = payload.get("limit", 1000)
+        if from_index + 1 < peer.log._first_index:
+            # WAL GC trimmed past this consumer's checkpoint — the gap is
+            # unrecoverable from the log; the consumer must resync
+            raise RpcError(
+                f"changes from {from_index} were garbage-collected "
+                f"(log starts at {peer.log._first_index})",
+                "CACHE_MISS_ERROR")
         changes = []
         last = from_index
         for e in peer.log.entries_from(from_index + 1, limit):
