@@ -263,3 +263,64 @@ class TestLimitNoOrder:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestRangeSharding:
+    def test_range_table_ordered_scans(self, cluster):
+        async def go():
+            mc, s = await _session(cluster)
+            try:
+                await s.execute(
+                    "CREATE TABLE events (ts bigint, name text, "
+                    "PRIMARY KEY (ts ASC)) WITH tablets = 1")
+                await mc.wait_for_leaders("events")
+                import random
+                ks = list(range(20))
+                random.Random(3).shuffle(ks)
+                for k in ks:
+                    await s.execute(
+                        f"INSERT INTO events (ts, name) VALUES ({k}, 'e{k}')")
+                # rows come back in range-key order without ORDER BY
+                r = await s.execute("SELECT ts FROM events")
+                assert [x["ts"] for x in r.rows] == sorted(ks)
+                r = await s.execute(
+                    "SELECT ts FROM events WHERE ts BETWEEN 5 AND 8")
+                assert [x["ts"] for x in r.rows] == [5, 6, 7, 8]
+                assert (await s.execute(
+                    "SELECT name FROM events WHERE ts = 7")
+                    ).rows[0]["name"] == "e7"
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_range_split_points_client(self, cluster):
+        async def go():
+            from yugabyte_db_tpu.docdb.table_codec import TableInfo
+            from yugabyte_db_tpu.dockv.packed_row import (
+                ColumnSchema, ColumnType, TableSchema)
+            from yugabyte_db_tpu.dockv.partition import PartitionSchema
+            mc, s = await _session(cluster)
+            try:
+                c = mc.client()
+                info = TableInfo("", "rt", TableSchema((
+                    ColumnSchema(0, "k", ColumnType.INT64,
+                                 is_range_key=True),
+                    ColumnSchema(1, "v", ColumnType.FLOAT64)), 1),
+                    PartitionSchema("range", 0))
+                await c.create_table(info, split_rows=[{"k": 100}])
+                await mc.wait_for_leaders("rt")
+                ct = await c._table("rt")
+                assert len(ct.locations) == 2
+                await c.insert("rt", [{"k": 5, "v": 1.0},
+                                      {"k": 200, "v": 2.0}])
+                assert (await c.get("rt", {"k": 5}))["v"] == 1.0
+                assert (await c.get("rt", {"k": 200}))["v"] == 2.0
+                # rows landed on different tablets
+                counts = [sum(1 for _ in p.tablet.regular.iterate())
+                          for ts in mc.tservers
+                          for p in ts.peers.values()
+                          if p.tablet.info.name == "rt"]
+                assert sorted(counts) == [1, 1]
+            finally:
+                await mc.shutdown()
+        run(go())

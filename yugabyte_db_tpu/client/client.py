@@ -81,13 +81,23 @@ class YBClient:
     # --- DDL --------------------------------------------------------------
     async def create_table(self, info: TableInfo, num_tablets: int = 2,
                            replication_factor: int = 1,
-                           tablegroup: Optional[str] = None) -> str:
+                           tablegroup: Optional[str] = None,
+                           split_rows=None) -> str:
+        """split_rows: for range-sharded tables, PK rows whose encoded
+        keys become the tablet split points."""
+        split_points = None
+        if split_rows:
+            from ..docdb.table_codec import TableCodec
+            codec = TableCodec(info)
+            split_points = [
+                info.partition_schema.partition_key_for_row(
+                    codec.pk_entries(r)).hex() for r in split_rows]
         resp = await self._master_call(
             "create_table",
             {"name": info.name, "table": info.to_wire(),
              "num_tablets": num_tablets,
              "replication_factor": replication_factor,
-             "tablegroup": tablegroup})
+             "tablegroup": tablegroup, "split_points": split_points})
         return resp["table_id"]
 
     async def create_tablegroup(self, name: str,

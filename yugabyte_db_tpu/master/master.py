@@ -212,7 +212,10 @@ class Master:
         if payload.get("tablegroup"):
             return await self._create_colocated(payload, table_id, info_wire)
         info = TableInfo.from_wire(info_wire)
-        parts = info.partition_schema.create_partitions(num_tablets)
+        split_points = [bytes.fromhex(h)
+                        for h in payload.get("split_points") or []]
+        parts = info.partition_schema.create_partitions(
+            num_tablets, split_points=split_points or None)
         tablet_entries = {}
         for i, p in enumerate(parts):
             tablet_id = f"{table_id}-t{i}"

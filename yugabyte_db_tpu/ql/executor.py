@@ -135,16 +135,22 @@ class SqlSession:
                 return SqlResult([], "OK")
         cols = []
         pk = stmt.primary_key
+        range_sharded = getattr(stmt, "range_sharded", False)
         for i, (name, typ) in enumerate(stmt.columns):
             ct = _TYPE_MAP.get(typ)
             if ct is None:
                 raise ValueError(f"unknown type {typ}")
             cols.append(ColumnSchema(
                 i, name, ct,
-                is_hash_key=(name == pk[0]),
-                is_range_key=(name in pk[1:])))
+                is_hash_key=(not range_sharded and name == pk[0]),
+                is_range_key=(name in pk if range_sharded
+                              else name in pk[1:]),
+                sort_desc=name in getattr(stmt, "pk_desc", [])))
         schema = TableSchema(columns=tuple(cols), version=1)
-        info = TableInfo("", stmt.name, schema, PartitionSchema("hash", 1))
+        info = TableInfo(
+            "", stmt.name, schema,
+            PartitionSchema("range", 0) if range_sharded
+            else PartitionSchema("hash", 1))
         await self.client.create_table(
             info, num_tablets=stmt.num_tablets,
             replication_factor=stmt.replication_factor)

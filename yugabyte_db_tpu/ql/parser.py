@@ -63,6 +63,8 @@ class CreateTableStmt:
     name: str
     columns: List[Tuple[str, str]]            # (name, type)
     primary_key: List[str]
+    range_sharded: bool = False               # PRIMARY KEY (k ASC|DESC)
+    pk_desc: List[str] = field(default_factory=list)
     num_hash: int = 1
     num_tablets: int = 2
     replication_factor: int = 1
@@ -214,14 +216,20 @@ class Parser:
         cols: List[Tuple[str, str]] = []
         pk: List[str] = []
         num_hash = 1
+        range_sharded = False
+        pk_desc: List[str] = []
         while True:
             if self.accept_kw("primary"):
                 self.expect_kw("key")
                 self.expect_op("(")
-                # optional HASH (cols) syntax: first N cols are hash cols
                 pk_cols = []
                 while True:
                     pk_cols.append(self.ident())
+                    if self.accept_kw("asc"):
+                        range_sharded = True
+                    elif self.accept_kw("desc"):
+                        range_sharded = True
+                        pk_desc.append(pk_cols[-1])
                     if not self.accept_op(","):
                         break
                 self.expect_op(")")
@@ -250,8 +258,8 @@ class Parser:
                 rf = v
         if not pk:
             raise ValueError("PRIMARY KEY required")
-        return CreateTableStmt(name, cols, pk, num_hash, num_tablets, rf,
-                               ine)
+        return CreateTableStmt(name, cols, pk, range_sharded, pk_desc,
+                               num_hash, num_tablets, rf, ine)
 
     def _create_index(self):
         name = self.ident()
