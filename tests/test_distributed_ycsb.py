@@ -1,0 +1,73 @@
+"""Distributed YCSB smoke: concurrent clients over real RPC against an
+RF1 multi-tablet cluster — throughput sanity + correctness under
+concurrency (reference analog: the yb-loadtester workloads)."""
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from yugabyte_db_tpu.docdb import ReadRequest
+from yugabyte_db_tpu.models.ycsb import usertable_info
+from yugabyte_db_tpu.ops import AggSpec
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.mark.slow
+class TestDistributedYcsb:
+    def test_concurrent_mixed_workload(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=2).start()
+            try:
+                c = mc.client()
+                info = usertable_info()
+                info.table_id = ""
+                await c.create_table(info, num_tablets=4)
+                await mc.wait_for_leaders("usertable")
+                n = 400
+                await c.insert("usertable", [
+                    {"ycsb_key": i,
+                     **{f"field{j}": "x" * 20 for j in range(10)}}
+                    for i in range(n)])
+
+                rng = np.random.default_rng(0)
+
+                async def client_task(tid: int, ops: int):
+                    cc = mc.client()
+                    done = 0
+                    for _ in range(ops):
+                        k = int(rng.integers(0, n))
+                        if rng.random() < 0.8:
+                            row = await cc.get("usertable", {"ycsb_key": k})
+                            assert row is not None
+                        else:
+                            await cc.insert("usertable", [
+                                {"ycsb_key": k,
+                                 **{f"field{j}": f"u{tid}" * 5
+                                    for j in range(10)}}])
+                        done += 1
+                    await cc.messenger.shutdown()
+                    return done
+
+                t0 = time.perf_counter()
+                results = await asyncio.gather(
+                    *[client_task(i, 40) for i in range(8)])
+                dt = time.perf_counter() - t0
+                total_ops = sum(results)
+                assert total_ops == 320
+                ops_s = total_ops / dt
+                # loose sanity bound; prints for the record
+                print(f"\ndistributed mixed 80/20: {ops_s:.0f} ops/s "
+                      f"(8 clients, RF1, 4 tablets, 2 tservers)")
+                assert ops_s > 100
+                # data still consistent
+                agg = await c.scan("usertable", ReadRequest(
+                    "", aggregates=(AggSpec("count"),)))
+                assert int(agg.agg_values[0]) == n
+            finally:
+                await mc.shutdown()
+        run(go())
