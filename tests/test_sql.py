@@ -324,3 +324,45 @@ class TestRangeSharding:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestJoins:
+    def test_inner_and_left_join(self, cluster):
+        async def go():
+            mc, s = await _session(cluster)
+            try:
+                await s.execute("CREATE TABLE customers (id bigint, "
+                                "name text, PRIMARY KEY (id))")
+                await s.execute("CREATE TABLE orders2 (oid bigint, cust "
+                                "bigint, total double, PRIMARY KEY (oid))")
+                await mc.wait_for_leaders("customers")
+                await mc.wait_for_leaders("orders2")
+                await s.execute("INSERT INTO customers (id, name) VALUES "
+                                "(1, 'ada'), (2, 'bob'), (3, 'cyd')")
+                await s.execute(
+                    "INSERT INTO orders2 (oid, cust, total) VALUES "
+                    "(10, 1, 5.0), (11, 1, 7.0), (12, 2, 3.0)")
+                r = await s.execute(
+                    "SELECT name, total FROM customers "
+                    "JOIN orders2 ON customers.id = orders2.cust "
+                    "ORDER BY total")
+                assert [(x["name"], x["total"]) for x in r.rows] == \
+                    [("bob", 3.0), ("ada", 5.0), ("ada", 7.0)]
+                # residual WHERE on the joined row
+                r = await s.execute(
+                    "SELECT name FROM customers "
+                    "JOIN orders2 ON customers.id = orders2.cust "
+                    "WHERE total > 4")
+                assert sorted(x["name"] for x in r.rows) == ["ada", "ada"]
+                # LEFT JOIN keeps unmatched customers
+                r = await s.execute(
+                    "SELECT name, total FROM customers "
+                    "LEFT JOIN orders2 ON customers.id = orders2.cust "
+                    "ORDER BY name")
+                names = [x["name"] for x in r.rows]
+                assert names.count("cyd") == 1
+                cyd = next(x for x in r.rows if x["name"] == "cyd")
+                assert cyd["total"] is None
+            finally:
+                await mc.shutdown()
+        run(go())

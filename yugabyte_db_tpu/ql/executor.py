@@ -210,6 +210,8 @@ class SqlSession:
             for c in node[1:])
 
     async def _select(self, stmt: SelectStmt) -> SqlResult:
+        if getattr(stmt, "joins", None):
+            return await self._select_join(stmt)
         ct = await self.client._table(stmt.table)
         schema = ct.info.schema
         read_ht = self._txn.start_ht if self._txn is not None else None
@@ -299,6 +301,72 @@ class SqlSession:
                 if m:
                     return m[0], m[1], node[j]
         return None
+
+    @staticmethod
+    def _split_qual(name: str):
+        return name.split(".", 1) if "." in name else (None, name)
+
+    async def _select_join(self, stmt: SelectStmt) -> SqlResult:
+        """Hash join executed client-side (reference picks between
+        YB batched nested loop / hash joins in the PG planner; round-1
+        planner always hash-joins on the equi-key)."""
+        from ..docdb.operations import eval_expr_py
+        # fetch whole tables (residual WHERE applies after the join)
+        async def fetch(table):
+            resp = await self.client.scan(table, ReadRequest(""))
+            return resp.rows
+
+        left_rows = await fetch(stmt.table)
+        # qualify row dicts: {"t.col": v, "col": v (unqualified wins last)}
+        def qualify(rows, tname):
+            out = []
+            for r in rows:
+                q = {f"{tname}.{k}": v for k, v in r.items()}
+                q.update(r)
+                out.append(q)
+            return out
+
+        rows = qualify(left_rows, stmt.table)
+        for jc in stmt.joins:
+            right_rows = qualify(await fetch(jc.table), jc.table)
+            # build hash table on the right join key
+            _, rcol = self._split_qual(jc.right_col)
+            index: Dict[object, list] = {}
+            for rr in right_rows:
+                index.setdefault(rr.get(jc.right_col, rr.get(rcol)),
+                                 []).append(rr)
+            joined = []
+            for lr in rows:
+                key = lr.get(jc.left_col,
+                             lr.get(self._split_qual(jc.left_col)[1]))
+                matches = index.get(key, [])
+                if matches:
+                    for rr in matches:
+                        merged = dict(lr)
+                        merged.update(rr)
+                        joined.append(merged)
+                elif jc.kind == "left":
+                    merged = dict(lr)
+                    for k in (right_rows[0] if right_rows else {}):
+                        merged.setdefault(k, None)
+                    joined.append(merged)
+            rows = joined
+        # residual WHERE over merged rows (by name, not ids)
+        if stmt.where is not None:
+            rows = [r for r in rows
+                    if _eval_by_name(stmt.where, r) is True]
+        out = []
+        for r in rows:
+            if any(it[0] == "star" for it in stmt.items):
+                out.append({k: v for k, v in r.items() if "." not in k})
+                continue
+            row = {}
+            for it in stmt.items:
+                if it[0] == "col":
+                    _, bare = self._split_qual(it[1])
+                    row[bare] = r.get(it[1], r.get(bare))
+            out.append(row)
+        return SqlResult(self._order_limit(stmt, out))
 
     def _needed_columns(self, stmt: SelectStmt, schema) -> List[str]:
         if any(it[0] == "star" for it in stmt.items):
@@ -491,6 +559,36 @@ class SqlSession:
         else:
             n = await self.client.insert(stmt.table, updated)
         return SqlResult([], f"UPDATE {n}")
+
+
+def _eval_by_name(node, row: dict):
+    """Evaluate the name-based AST over a merged join row."""
+    kind = node[0]
+    if kind == "col":
+        name = node[1]
+        bare = name.split(".", 1)[1] if "." in name else name
+        return row.get(name, row.get(bare))
+    if kind == "const":
+        return node[1]
+    rebuilt = tuple(
+        _eval_wrap(c, row) if isinstance(c, tuple) else c
+        for c in node[1:])
+    from ..docdb.operations import eval_expr_py
+    # translate to id-free eval: replace col nodes with consts
+    def subst(n):
+        if n[0] == "col":
+            return ("const", _eval_by_name(n, row))
+        if n[0] in ("in",):
+            return ("in", subst(n[1]), n[2])
+        if n[0] == "json":
+            return ("json", n[1], subst(n[2]), n[3])
+        return (n[0],) + tuple(subst(c) if isinstance(c, tuple) else c
+                               for c in n[1:])
+    return eval_expr_py(subst(node), {})
+
+
+def _eval_wrap(node, row):
+    return node
 
 
 def _expr_name(node) -> str:

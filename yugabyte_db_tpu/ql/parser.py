@@ -31,7 +31,7 @@ KEYWORDS = {
     "as", "hash", "with", "tablets", "replication", "if", "exists",
     "index", "on", "using", "lists", "ttl", "begin", "commit",
     "rollback", "transaction", "distinct", "offset", "like",
-    "alter", "add", "column",
+    "alter", "add", "column", "join", "inner", "left", "outer",
 }
 
 
@@ -106,6 +106,14 @@ class TxnStmt:
 
 
 @dataclass
+class JoinClause:
+    table: str                  # right table
+    kind: str                   # 'inner' | 'left'
+    left_col: str               # qualified or bare column of the LEFT side
+    right_col: str              # column of the right table
+
+
+@dataclass
 class SelectStmt:
     table: str
     # each item: ('col', name) | ('agg', op, expr|None) | ('star',)
@@ -118,6 +126,7 @@ class SelectStmt:
     knn: Optional[Tuple[str, str]] = None
     distinct: bool = False
     offset: int = 0
+    joins: List["JoinClause"] = field(default_factory=list)
 
 
 @dataclass
@@ -388,6 +397,24 @@ class Parser:
                 break
         self.expect_kw("from")
         table = self.ident()
+        joins = []
+        while True:
+            kind = None
+            if self.accept_kw("join") or (self.accept_kw("inner")
+                                          and self.accept_kw("join")):
+                kind = "inner"
+            elif self.accept_kw("left"):
+                self.accept_kw("outer")
+                self.expect_kw("join")
+                kind = "left"
+            else:
+                break
+            rtable = self.ident()
+            self.expect_kw("on")
+            lcol = self.ident()
+            self.expect_op("=")
+            rcol = self.ident()
+            joins.append(JoinClause(rtable, kind, lcol, rcol))
         where = None
         if self.accept_kw("where"):
             where = self.expr()
@@ -425,12 +452,30 @@ class Parser:
         if self.accept_kw("offset"):
             offset = int(self.next()[1])
         return SelectStmt(table, items, where, group, order, limit, knn,
-                          distinct, offset)
+                          distinct, offset, joins)
 
     def delete(self):
         self.expect_kw("delete")
         self.expect_kw("from")
         table = self.ident()
+        joins = []
+        while True:
+            kind = None
+            if self.accept_kw("join") or (self.accept_kw("inner")
+                                          and self.accept_kw("join")):
+                kind = "inner"
+            elif self.accept_kw("left"):
+                self.accept_kw("outer")
+                self.expect_kw("join")
+                kind = "left"
+            else:
+                break
+            rtable = self.ident()
+            self.expect_kw("on")
+            lcol = self.ident()
+            self.expect_op("=")
+            rcol = self.ident()
+            joins.append(JoinClause(rtable, kind, lcol, rcol))
         where = None
         if self.accept_kw("where"):
             where = self.expr()
