@@ -112,7 +112,7 @@ class TestPgWire:
                 await mc.shutdown()
         run(go())
 
-    def test_extended_protocol_declined_cleanly(self, tmp_path):
+    def test_extended_protocol_parse_bind_execute(self, tmp_path):
         async def go():
             mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
             srv = PgServer(mc.client())
@@ -121,13 +121,48 @@ class TestPgWire:
                 reader, writer = await asyncio.open_connection(*addr)
                 c = MiniPgClient(reader, writer)
                 await c.startup()
-                # send a Parse message ('P')
-                body = b"\x00stmt\x00\x00\x00"
-                writer.write(b"P" + struct.pack(">I", len(body) + 4) + body)
+                await c.query("CREATE TABLE ep (k bigint, v text, "
+                              "PRIMARY KEY (k))")
+                await mc.wait_for_leaders("ep")
+
+                def parse(name, sql):
+                    body = name.encode() + b"\x00" + sql.encode() + \
+                        b"\x00" + struct.pack(">H", 0)
+                    return b"P" + struct.pack(">I", len(body) + 4) + body
+
+                def bind(portal, stmt, params):
+                    body = portal.encode() + b"\x00" + stmt.encode() + b"\x00"
+                    body += struct.pack(">H", 0)           # fmt codes
+                    body += struct.pack(">H", len(params))
+                    for p in params:
+                        raw = p.encode()
+                        body += struct.pack(">i", len(raw)) + raw
+                    body += struct.pack(">H", 0)           # result fmts
+                    return b"B" + struct.pack(">I", len(body) + 4) + body
+
+                def execute(portal):
+                    body = portal.encode() + b"\x00" + struct.pack(">i", 0)
+                    return b"E" + struct.pack(">I", len(body) + 4) + body
+
+                sync = b"S" + struct.pack(">I", 4)
+                # INSERT via extended protocol with $1/$2
+                writer.write(parse("s1", "INSERT INTO ep (k, v) VALUES "
+                                         "($1, $2)"))
+                writer.write(bind("", "s1", ["7", "it's bound"]))
+                writer.write(execute(""))
+                writer.write(sync)
                 await writer.drain()
                 msgs = await c.read_until(b"Z")
-                assert msgs[0][0] == b"E"
-                assert b"0A000" in msgs[0][1]
+                tags = [t for t, _ in msgs]
+                assert b"1" in tags and b"2" in tags and b"C" in tags
+                # SELECT it back the same way
+                writer.write(parse("s2", "SELECT v FROM ep WHERE k = $1"))
+                writer.write(bind("", "s2", ["7"]))
+                writer.write(execute(""))
+                writer.write(sync)
+                await writer.drain()
+                msgs = await c.read_until(b"Z")
+                assert c.rows(msgs) == [["it's bound"]]
                 writer.close()
             finally:
                 await srv.shutdown()

@@ -7,8 +7,9 @@ JDBC in simple-query mode) can connect, issue queries, and read typed
 results. Supported: StartupMessage (incl. SSLRequest refusal),
 password-free auth, Query with multi-statement strings, RowDescription/
 DataRow/CommandComplete/EmptyQueryResponse, ErrorResponse with
-SQLSTATE, Terminate. Extended query protocol (Parse/Bind/Execute) is
-declined with a clear error (round-2).
+SQLSTATE, Terminate, and the extended query protocol (Parse/Bind/
+Describe/Execute/Sync/Close) with text-format $n parameter binding —
+enough for psycopg-style drivers in their default mode.
 """
 from __future__ import annotations
 
@@ -56,6 +57,8 @@ class PgServer:
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter):
         session = SqlSession(self.client)
+        prepared = {}       # name -> sql with $n placeholders
+        portals = {}        # name -> bound sql
         try:
             if not await self._startup(reader, writer):
                 return
@@ -68,11 +71,29 @@ class PgServer:
                     break
                 if tag == b"Q":
                     await self._query(session, body, writer)
-                elif tag in (b"P", b"B", b"E", b"D", b"S", b"C", b"H"):
-                    writer.write(self._error(
-                        "0A000", "extended query protocol not supported; "
-                        "use simple query mode"))
+                elif tag == b"P":           # Parse
+                    name, sql = self._parse_msg(body)
+                    prepared[name] = sql
+                    writer.write(_msg(b"1"))        # ParseComplete
+                elif tag == b"B":           # Bind
+                    portal, stmt_name, params = self._bind_msg(body)
+                    sql = prepared.get(stmt_name, "")
+                    portals[portal] = self._substitute(sql, params)
+                    writer.write(_msg(b"2"))        # BindComplete
+                elif tag == b"D":           # Describe — NoData for writes,
+                    writer.write(_msg(b"n"))        # rows described at Execute
+                elif tag == b"E":           # Execute
+                    portal = body.split(b"\x00")[0].decode()
+                    await self._query(session,
+                                      portals.get(portal, "").encode()
+                                      + b"\x00", writer,
+                                      suppress_ready=True)
+                elif tag == b"C":           # Close
+                    writer.write(_msg(b"3"))        # CloseComplete
+                elif tag == b"S":           # Sync
                     writer.write(_msg(b"Z", b"I"))
+                    await writer.drain()
+                elif tag == b"H":           # Flush
                     await writer.drain()
                 else:
                     writer.write(self._error("08P01",
@@ -86,6 +107,54 @@ class PgServer:
                 writer.close()
             except Exception:
                 pass
+
+    @staticmethod
+    def _parse_msg(body: bytes):
+        name_end = body.index(b"\x00")
+        name = body[:name_end].decode()
+        rest = body[name_end + 1:]
+        sql = rest[:rest.index(b"\x00")].decode()
+        return name, sql
+
+    @staticmethod
+    def _bind_msg(body: bytes):
+        pos = body.index(b"\x00")
+        portal = body[:pos].decode()
+        body2 = body[pos + 1:]
+        pos2 = body2.index(b"\x00")
+        stmt_name = body2[:pos2].decode()
+        rest = body2[pos2 + 1:]
+        off = 0
+        (nfmt,) = struct.unpack_from(">H", rest, off)
+        off += 2 + 2 * nfmt
+        (nparams,) = struct.unpack_from(">H", rest, off)
+        off += 2
+        params = []
+        for _ in range(nparams):
+            (plen,) = struct.unpack_from(">i", rest, off)
+            off += 4
+            if plen < 0:
+                params.append(None)
+            else:
+                params.append(rest[off:off + plen].decode())
+                off += plen
+        return portal, stmt_name, params
+
+    @staticmethod
+    def _substitute(sql: str, params):
+        """Text-format $n substitution with literal quoting."""
+        for i in range(len(params), 0, -1):
+            v = params[i - 1]
+            if v is None:
+                lit = "NULL"
+            else:
+                try:
+                    float(v)
+                    lit = v
+                except ValueError:
+                    lit = "'" + v.replace("'", "''") + "'"
+            sql = sql.replace(f"${i}", lit)
+        return sql
 
     async def _startup(self, reader, writer) -> bool:
         while True:
@@ -117,12 +186,14 @@ class PgServer:
         return True
 
     # ------------------------------------------------------------------
-    async def _query(self, session: SqlSession, body: bytes, writer):
+    async def _query(self, session: SqlSession, body: bytes, writer,
+                     suppress_ready: bool = False):
         sql = body.rstrip(b"\x00").decode()
         statements = [s.strip() for s in sql.split(";") if s.strip()]
         if not statements:
             writer.write(_msg(b"I"))
-            writer.write(_msg(b"Z", b"I"))
+            if not suppress_ready:
+                writer.write(_msg(b"Z", b"I"))
             await writer.drain()
             return
         for stmt in statements:
@@ -140,7 +211,8 @@ class PgServer:
             else:
                 tag = res.status if res.status != "OK" else "SELECT 0"
                 writer.write(_msg(b"C", _cstr(tag)))
-        writer.write(_msg(b"Z", b"I"))
+        if not suppress_ready:
+            writer.write(_msg(b"Z", b"I"))
         await writer.drain()
 
     def _row_description(self, cols: List[str], sample: dict) -> bytes:
