@@ -28,3 +28,17 @@ class TestPallasScan:
         s, c = q6_scan(z, z, z, z, 10.0, 20.0, 0.5, 0.6, -1.0,
                        interpret=True)
         assert (s, c) == (0.0, 0)
+
+
+class TestPallasGrouped:
+    def test_grouped_sums_match_numpy(self):
+        rng = np.random.default_rng(2)
+        n = 2 * BLOCK_ROWS + 123
+        gids = rng.integers(0, 6, n).astype(np.float64)
+        vals = rng.uniform(0, 10, n)
+        mask = rng.random(n) < 0.7
+        from yugabyte_db_tpu.ops.pallas_scan import grouped_sum
+        out = grouped_sum(gids, vals, mask, num_groups=6, interpret=True)
+        for g in range(6):
+            m = (gids == g) & mask
+            np.testing.assert_allclose(out[g], vals[m].sum(), rtol=2e-4)

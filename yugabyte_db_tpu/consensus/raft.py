@@ -79,12 +79,18 @@ class ConsensusMetadata:
 
     def save(self):
         tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump({"term": self.current_term,
-                       "voted_for": self.voted_for}, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"term": self.current_term,
+                           "voted_for": self.voted_for}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except FileNotFoundError:
+            # the tablet directory is being deleted under us (tablet drop
+            # or split cleanup racing a vote/step-down) — metadata of a
+            # deleted replica is irrelevant
+            pass
 
 
 ApplyCb = Callable[[LogEntry], Awaitable[None]]

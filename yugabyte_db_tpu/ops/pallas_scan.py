@@ -95,3 +95,53 @@ def q6_scan(qty: np.ndarray, price: np.ndarray, disc: np.ndarray,
                           jnp.asarray(valid), scalars,
                           interpret=interpret)
     return float(s), int(c)
+
+
+# --------------------------------------------------------------------------
+# Grouped masked sums: the Q1-style one-hot matmul, hand-fused in pallas.
+# Each grid step streams one row block and emits [G] partial sums computed
+# as  one_hot(gid)ᵀ · (value · mask)  — an MXU matmul per block.
+# --------------------------------------------------------------------------
+def _grouped_kernel(gid_ref, val_ref, mask_ref, out_ref, *, num_groups):
+    gid = gid_ref[:]
+    val = val_ref[:] * mask_ref[:]
+    # one_hot via broadcasted iota compare: [B, G]
+    groups = jax.lax.broadcasted_iota(jnp.float32, (gid.shape[0],
+                                                    num_groups), 1)
+    onehot = (gid[:, None] == groups).astype(jnp.float32)
+    out_ref[0, :] = val @ onehot            # [B] @ [B, G] -> [G]
+
+
+@partial(jax.jit, static_argnames=("num_groups", "interpret"))
+def grouped_sum_pallas(gids, values, mask, num_groups: int,
+                       interpret: bool = False):
+    """gids/values/mask: f32 arrays padded to BLOCK_ROWS multiples
+    (mask 0 on padding). Returns [num_groups] sums."""
+    from jax.experimental import pallas as pl
+    n = gids.shape[0]
+    grid = n // BLOCK_ROWS
+    blk = pl.BlockSpec((BLOCK_ROWS,), lambda i: (i,))
+    partials = pl.pallas_call(
+        partial(_grouped_kernel, num_groups=num_groups),
+        grid=(grid,),
+        in_specs=[blk, blk, blk],
+        out_specs=pl.BlockSpec((1, num_groups), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((grid, num_groups), jnp.float32),
+        interpret=interpret,
+    )(gids, values, mask)
+    return jnp.sum(partials, axis=0)
+
+
+def grouped_sum(gids: np.ndarray, values: np.ndarray, mask: np.ndarray,
+                num_groups: int, interpret: bool = False) -> np.ndarray:
+    n = len(gids)
+    padded = ((n + BLOCK_ROWS - 1) // BLOCK_ROWS) * BLOCK_ROWS
+
+    def pad(a):
+        out = np.zeros(padded, np.float32)
+        out[:n] = a
+        return jnp.asarray(out)
+
+    return np.asarray(grouped_sum_pallas(
+        pad(gids), pad(values), pad(mask.astype(np.float32)), num_groups,
+        interpret=interpret))
