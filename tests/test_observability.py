@@ -124,3 +124,25 @@ class TestAdminCli:
         run(go())
         out = capsys.readouterr().out
         assert "kv" in out
+
+
+class TestSstDump:
+    def test_dump_sst_and_wal(self, tmp_path, capsys):
+        from yugabyte_db_tpu.storage import SstWriter
+        from yugabyte_db_tpu.consensus import Log, LogEntry
+        from yugabyte_db_tpu.tools import sst_dump
+        p = str(tmp_path / "x.sst")
+        w = SstWriter(p)
+        for i in range(10):
+            w.add(b"key%03d" % i, b"v")
+        w.set_frontier(op_id=[1, 5])
+        w.finish()
+        assert sst_dump.main([p, "--blocks", "--entries", "3"]) == 0
+        out = capsys.readouterr().out
+        assert "entries:   10" in out and "op_id" in out
+        wal = Log(str(tmp_path / "wal"), fsync=False)
+        wal.append([LogEntry(1, 1, "write", b"abc")])
+        wal.close()
+        assert sst_dump.main(["--wal", str(tmp_path / "wal")]) == 0
+        out = capsys.readouterr().out
+        assert "[1:1] write" in out

@@ -1,0 +1,71 @@
+"""SST inspection tool (sst_dump/ldb analog; reference:
+src/yb/tools/sst_dump.cc, ldb.cc).
+
+    python -m yugabyte_db_tpu.tools.sst_dump FILE [--blocks] [--entries N]
+    python -m yugabyte_db_tpu.tools.sst_dump --wal DIR [--entries N]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def dump_sst(path: str, show_blocks: bool, n_entries: int):
+    from ..storage.sst import SstReader
+    from ..dockv.key_encoding import SubDocKey
+    r = SstReader(path)
+    print(f"{path}:")
+    print(f"  entries:   {r.num_entries}")
+    print(f"  blocks:    {r.num_blocks()}")
+    print(f"  file size: {r.file_size}")
+    print(f"  min key:   {r.min_key.hex()}")
+    print(f"  max key:   {r.max_key.hex()}")
+    print(f"  frontier:  {r.frontier}")
+    if show_blocks:
+        for i, e in enumerate(r.index):
+            kind = "columnar-only" if e.length == 0 else "row"
+            sidecar = "+sidecar" if e.col_offset >= 0 else ""
+            print(f"  block {i}: {e.num_rows} rows, {kind}{sidecar}, "
+                  f"[{e.first_key.hex()[:24]}.. {e.last_key.hex()[:24]}..]")
+    if n_entries:
+        shown = 0
+        for k, v in r.iterate():
+            try:
+                sdk = SubDocKey.decode(k)
+                desc = (f"pk={[e.value for e in sdk.doc_key.hashed + sdk.doc_key.range]} "
+                        f"ht={sdk.doc_ht}")
+            except Exception:
+                desc = k.hex()[:48]
+            print(f"    {desc}  value[{len(v)}B] kind={v[0]:#x}")
+            shown += 1
+            if shown >= n_entries:
+                break
+
+
+def dump_wal(directory: str, n_entries: int):
+    from ..consensus.log import Log
+    log = Log(directory, fsync=False)
+    print(f"{directory}: entries {log._first_index}..{log.last_index}")
+    for e in log.all_entries()[:n_entries or 20]:
+        print(f"  [{e.term}:{e.index}] {e.etype} payload[{len(e.payload)}B]")
+    log.close()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ybtpu-sst-dump")
+    p.add_argument("path", nargs="?")
+    p.add_argument("--wal", help="dump a WAL directory instead")
+    p.add_argument("--blocks", action="store_true")
+    p.add_argument("--entries", type=int, default=0)
+    args = p.parse_args(argv)
+    if args.wal:
+        dump_wal(args.wal, args.entries)
+    elif args.path:
+        dump_sst(args.path, args.blocks, args.entries)
+    else:
+        p.error("need an SST path or --wal DIR")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
