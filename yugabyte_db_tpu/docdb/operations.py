@@ -213,6 +213,54 @@ class DocWriteOperation:
 # --------------------------------------------------------------------------
 # Read operation
 # --------------------------------------------------------------------------
+def extract_pk_bounds(where, pk_col_id: int):
+    """(lower, upper_inclusive, residual) numeric bounds for the leading
+    range-PK column from a conjunctive WHERE (ScanChoices-lite;
+    reference: docdb/scan_choices.cc). Returns (None, None, where) when
+    no usable bound exists."""
+    lo = hi = None
+    residual = []
+
+    def visit(node):
+        nonlocal lo, hi
+        if node[0] == "and":
+            visit(node[1])
+            visit(node[2])
+            return
+        if node[0] == "cmp" and node[2][0] == "col" \
+                and node[2][1] == pk_col_id and node[3][0] == "const":
+            op, v = node[1], node[3][1]
+            if op in ("ge", "gt", "eq"):
+                b = v if op != "gt" else v + 1
+                lo = b if lo is None else max(lo, b)
+            if op in ("le", "lt", "eq"):
+                b = v if op != "lt" else v - 1
+                hi = b if hi is None else min(hi, b)
+            if op in ("ge", "gt", "le", "lt", "eq"):
+                return
+        if node[0] == "between" and node[1][0] == "col" \
+                and node[1][1] == pk_col_id \
+                and node[2][0] == "const" and node[3][0] == "const":
+            lo = node[2][1] if lo is None else max(lo, node[2][1])
+            hi = node[3][1] if hi is None else min(hi, node[3][1])
+            return
+        residual.append(node)
+
+    if where is not None:
+        visit(where)
+    if lo is None and hi is None:
+        return None, None, where
+    if not residual:
+        res = None
+    elif len(residual) == 1:
+        res = residual[0]
+    else:
+        res = residual[0]
+        for r in residual[1:]:
+            res = ("and", res, r)
+    return lo, hi, res
+
+
 def _skew_window_ht() -> int:
     return flags.get("max_clock_skew_ms") * 1000 << 12
 
@@ -499,10 +547,34 @@ class DocReadOperation:
                     return None   # column unavailable in columnar form
         return ReadResponse(rows=rows, backend="tpu")
 
+    def _scan_bounds(self, req: ReadRequest):
+        """Seek bounds for range-sharded single-range-PK tables: turn
+        leading-PK predicates into encoded key bounds."""
+        schema = self.codec.schema
+        ps = self.codec.info.partition_schema
+        if ps.kind != "range" or len(schema.key_columns) != 1 \
+                or req.where is None:
+            return None, None, req.where
+        pk = schema.key_columns[0]
+        if pk.sort_desc or pk.type not in ("int32", "int64", "timestamp"):
+            return None, None, req.where
+        lo, hi, residual = extract_pk_bounds(req.where, pk.id)
+        if lo is None and hi is None:
+            return None, None, req.where
+        from .table_codec import _KEV_MAKER
+        from ..dockv.key_encoding import DocKey
+        maker = _KEV_MAKER[pk.type]
+        enc = lambda v: DocKey.make(range=(maker(int(v)),)).encode()
+        lower = enc(lo) if lo is not None else None
+        # upper: inclusive bound -> everything below the NEXT key
+        upper = enc(hi + 1) if hi is not None else None
+        return lower, upper, residual
+
     def _execute_cpu(self, req: ReadRequest) -> ReadResponse:
         read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
         table_prefix = self.codec.scan_prefix()
-        lower = req.paging_state or (table_prefix or None)
+        bound_lo, bound_hi, bounded_where = self._scan_bounds(req)
+        lower = req.paging_state or bound_lo or (table_prefix or None)
         rows_out: List[Dict[str, object]] = []
         aggs = list(_expand_avg_cpu(req.aggregates))
         agg_state = [_agg_init(a) for a in aggs]
@@ -513,7 +585,9 @@ class DocReadOperation:
         chosen = False
         by_id = {c.id: c.name for c in self.codec.schema.columns}
         name_to_id = {c.name: c.id for c in self.codec.schema.columns}
-        for k, v in self.store.iterate(lower=lower):
+        scan_where = bounded_where if bound_lo is not None \
+            or bound_hi is not None else req.where
+        for k, v in self.store.iterate(lower=lower, upper=bound_hi):
             if table_prefix and not k.startswith(table_prefix):
                 break                      # left this cotable's key space
             marker = len(k) - _HT_SUFFIX
@@ -540,8 +614,8 @@ class DocReadOperation:
             if row is None:
                 continue
             idrow = {name_to_id[n]: val for n, val in row.items()}
-            if req.where is not None:
-                if eval_expr_py(req.where, idrow) is not True:
+            if scan_where is not None:
+                if eval_expr_py(scan_where, idrow) is not True:
                     continue
             if aggs:
                 _agg_accumulate(aggs, agg_state, group_state, req.group_by,
