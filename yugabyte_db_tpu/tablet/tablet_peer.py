@@ -173,8 +173,10 @@ class TabletPeer:
         await self.consensus.replicate("txn_rollback", _mp.packb(
             {"txn_id": txn_id}))
 
-    def read_own_intent(self, txn_id: str, pk_row: dict):
-        doc_key = self.tablet.codec.doc_key_prefix(pk_row)
+    def read_own_intent(self, txn_id: str, pk_row: dict,
+                        table_id: str = ""):
+        codec = self.tablet._codec_for(table_id)
+        doc_key = codec.doc_key_prefix(pk_row)
         return self.participant.own_intent(txn_id, doc_key)
 
     # --- log retention ------------------------------------------------------

@@ -238,7 +238,7 @@ class TransactionParticipant:
         conflict check passes — otherwise two concurrent writers of the
         same key would both pass the check before either intent
         replicates (write-write race)."""
-        codec = self.tablet.codec
+        codec = self.tablet._codec_for(req.table_id)
         keys = [codec.doc_key_prefix(op.row) for op in req.ops]
         await self._resolve_conflicts(txn_id, start_ht, keys)
         # First-committer-wins (snapshot isolation): a committed write
@@ -263,6 +263,7 @@ class TransactionParticipant:
             "txn_id": txn_id, "start_ht": start_ht,
             "req": write_request_to_wire(req),
             "keys": keys, "status_tablet": status_tablet,
+            "table_id": req.table_id,
         })
         try:
             await self.peer.consensus.replicate("txn_intents", payload)
@@ -390,8 +391,9 @@ class TransactionParticipant:
             meta["status_tablet"] = m["status_tablet"]
         from ..storage.lsm import WriteBatch
         batch = WriteBatch()
+        table_id = m.get("table_id", "")
         for key, op in zip(m["keys"], m["req"]["ops"]):
-            per_txn[key] = op
+            per_txn[key] = (table_id, op)
             self._key_holder[key] = txn_id
             batch.put(intent_key(key, txn_id), msgpack.packb(op))
         self.tablet.intents.apply(batch)
@@ -404,11 +406,16 @@ class TransactionParticipant:
         txn_id = m["txn_id"]
         commit_ht = m["commit_ht"]
         per_txn = self._intents.pop(txn_id, None) or {}
-        ops = [RowOp(op[0], op[1], op[2] if len(op) > 2 else None)
-               for op in per_txn.values() if op is not None]
-        if ops:
-            req = WriteRequest("", ops)
-            self.tablet.apply_write(req, ht=HybridTime(commit_ht))
+        by_table = {}
+        for ent in per_txn.values():
+            if ent is None:
+                continue
+            table_id, op = ent
+            by_table.setdefault(table_id, []).append(
+                RowOp(op[0], op[1], op[2] if len(op) > 2 else None))
+        for table_id, ops in by_table.items():
+            self.tablet.apply_write(WriteRequest(table_id, ops),
+                                    ht=HybridTime(commit_ht))
         self._release(txn_id, per_txn.keys())
 
     def apply_rollback_entry(self, payload: bytes):
@@ -437,7 +444,8 @@ class TransactionParticipant:
     def own_intent(self, txn_id: str, doc_key: bytes) -> Optional[list]:
         per_txn = self._intents.get(txn_id)
         if per_txn:
-            return per_txn.get(doc_key)
+            ent = per_txn.get(doc_key)
+            return ent[1] if ent is not None else None
         return None
 
     def has_foreign_intents(self, txn_id: Optional[str] = None) -> bool:

@@ -382,7 +382,8 @@ class TabletServer:
         at the txn start time."""
         from ..docdb.operations import ReadRequest
         peer = self._peer(payload["tablet_id"])
-        own = peer.read_own_intent(payload["txn_id"], payload["pk_row"])
+        own = peer.read_own_intent(payload["txn_id"], payload["pk_row"],
+                                   payload.get("table_id", ""))
         if own is not None:
             kind, row = own[0], own[1]
             if kind == "delete":
