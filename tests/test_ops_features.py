@@ -178,6 +178,8 @@ class TestCdcStreamRegistry:
                 await c.insert("kv", [{"k": 1, "v": 1.0}])
                 changes = await stream.poll()
                 assert changes
+                # at-least-once: checkpoints persist only on explicit ack
+                await stream.commit_checkpoints()
                 # resume from the registry: no replays
                 resumed = await CdcStream.resume(mc.client(),
                                                  stream.stream_id)

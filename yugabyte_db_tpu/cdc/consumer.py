@@ -65,18 +65,9 @@ class CdcStream:
                     # from the log — the consumer must resync (full scan)
                     raise
                 continue
-            if resp["checkpoint"] != self.checkpoints.get(loc.tablet_id):
-                self.checkpoints[loc.tablet_id] = resp["checkpoint"]
-                if self.stream_id is not None:
-                    try:
-                        await self.client._master_call(
-                            "set_cdc_checkpoint",
-                            {"stream_id": self.stream_id,
-                             "tablet_id": loc.tablet_id,
-                             "index": resp["checkpoint"]})
-                    except RpcError:
-                        pass
+            new_cp = resp["checkpoint"]
             for ch in resp["changes"]:
+                ch["tablet_id"] = loc.tablet_id
                 if ch.get("provisional"):
                     self._pending_txns.setdefault(
                         ch["txn_id"], []).append(ch)
@@ -89,8 +80,34 @@ class CdcStream:
                     self._pending_txns.pop(ch["txn_id"], None)
                 else:
                     out.append(ch)
+            # hold the checkpoint back to before the OLDEST still-pending
+            # provisional change from this tablet, so a restarted consumer
+            # re-reads it (re-buffering provisional records is idempotent)
+            pending_min = min(
+                (p["index"] for chs in self._pending_txns.values()
+                 for p in chs if p.get("tablet_id") == loc.tablet_id),
+                default=None)
+            if pending_min is not None:
+                new_cp = min(new_cp, pending_min - 1)
+            self.checkpoints[loc.tablet_id] = max(
+                self.checkpoints.get(loc.tablet_id, 0), new_cp)
         out.sort(key=lambda c: c.get("ht", 0))
         return out
+
+    async def commit_checkpoints(self) -> None:
+        """Persist checkpoints AFTER the consumer has durably handled the
+        delivered changes (at-least-once: call this once the batch is
+        applied downstream)."""
+        if self.stream_id is None:
+            return
+        for tablet_id, idx in self.checkpoints.items():
+            try:
+                await self.client._master_call(
+                    "set_cdc_checkpoint",
+                    {"stream_id": self.stream_id,
+                     "tablet_id": tablet_id, "index": idx})
+            except RpcError:
+                pass
 
 
 class XClusterReplicator:
@@ -116,10 +133,13 @@ class XClusterReplicator:
     async def step(self) -> int:
         changes = await self.stream.poll()
         if not changes:
+            await self.stream.commit_checkpoints()
             return 0
         ops = [RowOp("delete" if c["op"] == "delete" else "upsert",
                      c["row"]) for c in changes]
         await self.target.write(self.table, ops)
+        # checkpoint persists only after the target accepted the batch
+        await self.stream.commit_checkpoints()
         self.replicated += len(ops)
         return len(ops)
 

@@ -184,15 +184,18 @@ def _compact_columnar(store, codec, blocks: List[ColumnarBlock],
             out_ends[j] = len(out_heap)
         return out_ends, bytes(out_heap), out_null
 
+    # concatenate each column ONCE; chunks below only gather
+    fixed_cat = {cid: cat_fixed(cid) for cid in fixed_ids}
+    pk_cat = {cid: cat_pk(cid) for cid in pk_ids}
     path = store._new_sst_path()
     w = SstWriter(path)
     for s in range(0, len(sel), block_rows):
         chunk = sel[s:s + block_rows]
         if not len(chunk):
             continue
-        fixed = {cid: (cat_fixed(cid)[0][chunk], cat_fixed(cid)[1][chunk])
+        fixed = {cid: (fixed_cat[cid][0][chunk], fixed_cat[cid][1][chunk])
                  for cid in fixed_ids}
-        pk = {cid: cat_pk(cid)[chunk] for cid in pk_ids}
+        pk = {cid: pk_cat[cid][chunk] for cid in pk_ids}
         varlen = {cid: gather_varlen(cid, chunk) for cid in varlen_ids}
         out = ColumnarBlock.from_arrays(
             schema_version=sv,

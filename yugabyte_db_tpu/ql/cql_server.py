@@ -134,6 +134,9 @@ class CqlServer:
                 sql = self._prepared.get(pid)
                 if sql is None:
                     return self._error(0x2500, "unprepared query")
+                values = self._execute_values(body, 2 + plen)
+                if values:
+                    sql = self._bind_qmarks(sql, values)
                 return OP_RESULT, await self._run(sql)
             return self._error(0x000A, f"unsupported opcode {opcode}")
         except Exception as e:   # noqa: BLE001 — surface as CQL error frame
@@ -141,6 +144,68 @@ class CqlServer:
 
     def _error(self, code: int, msg: str) -> Tuple[int, bytes]:
         return OP_ERROR, struct.pack(">i", code) + _string(msg)
+
+    @staticmethod
+    def _execute_values(body: bytes, pos: int):
+        """Bound values from an EXECUTE body (consistency + flags +
+        values). Types are heuristic — we advertise no bind metadata, so
+        we decode 8 bytes as bigint, 4 as int, else utf8 text."""
+        try:
+            pos += 2                    # consistency
+            flags_ = body[pos]
+            pos += 1
+            if not flags_ & 0x01:
+                return []
+            (n,) = struct.unpack_from(">H", body, pos)
+            pos += 2
+            out = []
+            for _ in range(n):
+                (ln,) = struct.unpack_from(">i", body, pos)
+                pos += 4
+                if ln < 0:
+                    out.append(None)
+                    continue
+                raw = body[pos:pos + ln]
+                pos += ln
+                if ln == 8:
+                    out.append(struct.unpack(">q", raw)[0])
+                elif ln == 4:
+                    out.append(struct.unpack(">i", raw)[0])
+                else:
+                    try:
+                        out.append(raw.decode())
+                    except UnicodeDecodeError:
+                        out.append(raw.hex())
+            return out
+        except (struct.error, IndexError):
+            return []
+
+    @staticmethod
+    def _bind_qmarks(sql: str, values) -> str:
+        """Replace '?' markers (outside string literals) with literals."""
+        out = []
+        vi = 0
+        in_str = False
+        for ch in sql:
+            if in_str:
+                out.append(ch)
+                if ch == "'":
+                    in_str = False
+            elif ch == "'":
+                in_str = True
+                out.append(ch)
+            elif ch == "?" and vi < len(values):
+                v = values[vi]
+                vi += 1
+                if v is None:
+                    out.append("NULL")
+                elif isinstance(v, (int, float)):
+                    out.append(str(v))
+                else:
+                    out.append("'" + str(v).replace("'", "''") + "'")
+            else:
+                out.append(ch)
+        return "".join(out)
 
     @staticmethod
     def _query_params(body: bytes, pos: int):

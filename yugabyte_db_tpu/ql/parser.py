@@ -17,7 +17,7 @@ from typing import Dict, List, Optional, Tuple
 
 _TOKEN = re.compile(r"""
     \s*(?:
-      (?P<num>-?\d+\.\d+(?:[eE][-+]?\d+)?|-?\d+)
+      (?P<num>\d+\.\d+(?:[eE][-+]?\d+)?|\d+)
     | (?P<str>'(?:[^']|'')*')
     | (?P<op><->|->>|->|<=|>=|<>|!=|[=<>(),;*+\-/])
     | (?P<word>[A-Za-z_][A-Za-z0-9_.]*)

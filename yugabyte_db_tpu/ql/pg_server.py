@@ -142,19 +142,49 @@ class PgServer:
 
     @staticmethod
     def _substitute(sql: str, params):
-        """Text-format $n substitution with literal quoting."""
+        """Text-format $n substitution with literal quoting. Without
+        Parse-time type OIDs, strictly-numeric text inlines bare (the
+        common driver case for int/float params); anything else —
+        including 'nan'/'inf' strings — quotes as a string literal."""
+        import re as _re
+        num = _re.compile(r"^[+-]?(\d+(\.\d*)?|\.\d+)([eE][+-]?\d+)?$")
         for i in range(len(params), 0, -1):
             v = params[i - 1]
             if v is None:
                 lit = "NULL"
+            elif num.match(v):
+                lit = v
             else:
-                try:
-                    float(v)
-                    lit = v
-                except ValueError:
-                    lit = "'" + v.replace("'", "''") + "'"
+                lit = "'" + v.replace("'", "''") + "'"
             sql = sql.replace(f"${i}", lit)
         return sql
+
+    @staticmethod
+    def _split_statements(sql: str):
+        """Split on ';' OUTSIDE single-quoted literals."""
+        out, cur, in_str = [], [], False
+        i = 0
+        while i < len(sql):
+            ch = sql[i]
+            if in_str:
+                cur.append(ch)
+                if ch == "'":
+                    if i + 1 < len(sql) and sql[i + 1] == "'":
+                        cur.append("'")
+                        i += 1
+                    else:
+                        in_str = False
+            elif ch == "'":
+                in_str = True
+                cur.append(ch)
+            elif ch == ";":
+                out.append("".join(cur))
+                cur = []
+            else:
+                cur.append(ch)
+            i += 1
+        out.append("".join(cur))
+        return [s.strip() for s in out if s.strip()]
 
     async def _startup(self, reader, writer) -> bool:
         while True:
@@ -189,7 +219,7 @@ class PgServer:
     async def _query(self, session: SqlSession, body: bytes, writer,
                      suppress_ready: bool = False):
         sql = body.rstrip(b"\x00").decode()
-        statements = [s.strip() for s in sql.split(";") if s.strip()]
+        statements = self._split_statements(sql)
         if not statements:
             writer.write(_msg(b"I"))
             if not suppress_ready:

@@ -25,6 +25,10 @@ from ..rpc.messenger import RpcError
 C = Expr.col
 
 
+class _ProtocolError(Exception):
+    pass
+
+
 def _kv_info():
     return TableInfo("", "system.redis_kv", TableSchema(columns=(
         ColumnSchema(0, "k", ColumnType.STRING, is_hash_key=True),
@@ -79,12 +83,22 @@ class RedisServer:
         line = line.strip()
         if not line.startswith(b"*"):
             return line.split()        # inline command
-        n = int(line[1:])
+        try:
+            n = int(line[1:])
+        except ValueError as e:
+            raise _ProtocolError(f"bad array header {line!r}") from e
         out = []
         for _ in range(n):
             hdr = (await reader.readline()).strip()
-            assert hdr.startswith(b"$")
-            ln = int(hdr[1:])
+            if not hdr.startswith(b"$"):
+                raise _ProtocolError(
+                    f"expected bulk string, got {hdr!r}")
+            try:
+                ln = int(hdr[1:])
+            except ValueError as e:
+                raise _ProtocolError(f"bad bulk length {hdr!r}") from e
+            if ln < 0 or ln > 64 * 1024 * 1024:
+                raise _ProtocolError(f"bulk length out of range: {ln}")
             data = await reader.readexactly(ln)
             await reader.readexactly(2)   # \r\n
             out.append(data)
@@ -120,7 +134,12 @@ class RedisServer:
     async def _handle(self, reader, writer):
         try:
             while True:
-                cmd = await self._read_command(reader)
+                try:
+                    cmd = await self._read_command(reader)
+                except _ProtocolError as e:
+                    writer.write(self._error(str(e)))
+                    await writer.drain()
+                    continue
                 if cmd is None:
                     break
                 try:

@@ -159,7 +159,12 @@ class Tablet:
             return None
         cutoff = self.history_cutoff()
         multi_version = len(self.codec.info.packings.versions()) > 1
-        if flags.get("tpu_compaction_enabled") and not multi_version:
+        if self.colocated:
+            # colocated tablets mix schemas per cotable: GC without
+            # repacking (per-cotable repack dispatch is a round-2 item)
+            path = self.regular.compact(
+                inputs=inputs, feed=DocDbCompactionFeed(cutoff))
+        elif flags.get("tpu_compaction_enabled") and not multi_version:
             path = tpu_compact(self.regular, self.codec, cutoff,
                                inputs=inputs)
         else:
