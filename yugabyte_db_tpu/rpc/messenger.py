@@ -113,11 +113,44 @@ class Connection:
             pass
 
 
-class Messenger:
-    """Server + client in one object, like the reference Messenger."""
+def make_tls_contexts(cert_file: str, key_file: str, ca_file: str = None):
+    """(server_ctx, client_ctx) for mutual/one-way TLS (reference:
+    rpc/secure_stream.cc). ca_file verifies peers; without it the client
+    trusts the given cert directly (self-signed deployments)."""
+    import ssl
+    server = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    server.load_cert_chain(cert_file, key_file)
+    client = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    client.check_hostname = False
+    client.load_verify_locations(ca_file or cert_file)
+    return server, client
 
-    def __init__(self, name: str = "messenger"):
+
+def generate_self_signed_cert(directory: str, cn: str = "ybtpu"):
+    """Dev/test helper: self-signed cert via the openssl CLI."""
+    import os
+    import subprocess
+    cert = os.path.join(directory, "node.crt")
+    key = os.path.join(directory, "node.key")
+    if not (os.path.exists(cert) and os.path.exists(key)):
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048",
+             "-keyout", key, "-out", cert, "-days", "365", "-nodes",
+             "-subj", f"/CN={cn}",
+             "-addext", "subjectAltName=IP:127.0.0.1,DNS:localhost"],
+            check=True, capture_output=True)
+    return cert, key
+
+
+class Messenger:
+    """Server + client in one object, like the reference Messenger.
+
+    Pass tls=(server_ctx, client_ctx) (see make_tls_contexts) to encrypt
+    every connection — the secure-stream analog."""
+
+    def __init__(self, name: str = "messenger", tls=None):
         self.name = name
+        self.tls_server, self.tls_client = tls if tls else (None, None)
         self.services: Dict[str, object] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: Dict[Tuple[str, int], Connection] = {}
@@ -132,7 +165,8 @@ class Messenger:
         self.services[name] = service
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
-        self._server = await asyncio.start_server(self._handle_conn, host, port)
+        self._server = await asyncio.start_server(
+            self._handle_conn, host, port, ssl=self.tls_server)
         sock = self._server.sockets[0]
         self.addr = sock.getsockname()[:2]
         return self.addr
@@ -199,7 +233,8 @@ class Messenger:
         async with lock:
             conn = self._conns.get(key)
             if conn is None or conn.closed:
-                reader, writer = await asyncio.open_connection(*addr)
+                reader, writer = await asyncio.open_connection(
+                    *addr, ssl=self.tls_client)
                 conn = Connection(reader, writer)
                 self._conns[key] = conn
         try:
