@@ -80,3 +80,25 @@ class TestColocation:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestColocatedDrop:
+    def test_drop_one_table_keeps_the_group_tablet(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                c = mc.client()
+                await c.create_tablegroup("g3")
+                await c.create_table(small_table("d1"), tablegroup="g3")
+                await c.create_table(small_table("d2"), tablegroup="g3")
+                await mc.wait_for_leaders("d1")
+                await c.insert("d1", [{"k": 1, "v": 1.0}])
+                await c.insert("d2", [{"k": 1, "v": 2.0}])
+                await c.drop_table("d1")
+                # the shared tablet (and d2's data) survives
+                assert (await c.get("d2", {"k": 1}))["v"] == 2.0
+                names = {t["name"] for t in await c.list_tables()}
+                assert "d1" not in names and "d2" in names
+            finally:
+                await mc.shutdown()
+        run(go())

@@ -353,6 +353,12 @@ class Master:
                     if e["info"]["name"] == name), None)
         if tid is None:
             raise RpcError(f"table {name} not found", "NOT_FOUND")
+        if self.tables[tid].get("colocated_in"):
+            # colocated table: the tablet is SHARED with other tables —
+            # drop only the catalog entry (cotable-range GC is a round-2
+            # compaction job; reference deletes the cotable key range)
+            await self._commit_catalog([["del_table", tid]])
+            return {"ok": True}
         for tablet_id in self.tables[tid]["tablets"]:
             ent = self.tablets.get(tablet_id)
             if not ent:
