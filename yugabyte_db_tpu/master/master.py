@@ -125,16 +125,21 @@ class Master:
                     auto_balance: bool = False):
         await self.messenger.start(host, port)
         self._running = True
-        if auto_balance:
-            self.auto_balance = True
-            self._lb_task = asyncio.create_task(self._lb_loop())
+        self.auto_balance = auto_balance
+        self._lb_task = asyncio.create_task(self._lb_loop())
         return self.messenger.addr
 
     async def _lb_loop(self):
+        """Maintenance loop: LB (when enabled) + snapshot schedules."""
         while self._running:
+            if self.auto_balance:
+                try:
+                    await self.load_balancer.tick()
+                except Exception:   # noqa: BLE001 — LB must never die
+                    pass
             try:
-                await self.load_balancer.tick()
-            except Exception:   # noqa: BLE001 — LB must never die
+                await self.tick_snapshot_schedules()
+            except Exception:   # noqa: BLE001
                 pass
             await asyncio.sleep(1.0)
 
@@ -448,6 +453,137 @@ class Master:
         await self._commit_catalog([["put_table", tid, ent]])
         return {"snapshot_id": snapshot_id,
                 "tablets": len(manifest)}
+
+    async def rpc_delete_snapshot(self, payload) -> dict:
+        """Delete a snapshot: drop tserver checkpoint dirs (best effort,
+        tserver delete is idempotent) and remove the catalog entry
+        (reference: MasterSnapshotCoordinator::Delete)."""
+        self._check_leader()
+        snapshot_id = payload["snapshot_id"]
+        for tid, e in self.tables.items():
+            snap = e.get("snapshots", {}).get(snapshot_id)
+            if snap is None:
+                continue
+            for ent in snap.get("manifest", []):
+                ts = self.tservers.get(ent["ts_uuid"])
+                if not ts:
+                    continue
+                try:
+                    await self.messenger.call(
+                        ts["addr"], "tserver", "delete_snapshot",
+                        {"tablet_id": ent["tablet_id"],
+                         "snapshot_id": snapshot_id}, timeout=30.0)
+                except (RpcError, asyncio.TimeoutError, OSError):
+                    pass
+            tent = dict(self.tables[tid])
+            snaps = dict(tent.get("snapshots", {}))
+            snaps.pop(snapshot_id, None)
+            tent["snapshots"] = snaps
+            await self._commit_catalog([["put_table", tid, tent]])
+            return {"ok": True}
+        raise RpcError(f"snapshot {snapshot_id} not found", "NOT_FOUND")
+
+    async def rpc_create_snapshot_schedule(self, payload) -> dict:
+        """Periodic snapshots with retention (reference:
+        SnapshotScheduleState in master_snapshot_coordinator.cc). The
+        master loop ticks schedules; restore_snapshot_schedule picks the
+        newest snapshot at-or-before a target time (PITR-style)."""
+        self._check_leader()
+        name = payload["table"]
+        tid = next((t for t, e in self.tables.items()
+                    if e["info"]["name"] == name), None)
+        if tid is None:
+            raise RpcError(f"table {name} not found", "NOT_FOUND")
+        sched_id = f"sched-{uuidlib.uuid4().hex[:10]}"
+        ent = dict(self.tables[tid])
+        scheds = dict(ent.get("snapshot_schedules", {}))
+        scheds[sched_id] = {
+            "interval_s": payload.get("interval_s", 60.0),
+            "keep": max(1, int(payload.get("keep", 5))),
+            "last_run": 0.0, "snapshots": []}
+        ent["snapshot_schedules"] = scheds
+        await self._commit_catalog([["put_table", tid, ent]])
+        return {"schedule_id": sched_id}
+
+    async def tick_snapshot_schedules(self) -> int:
+        """Run due schedules (called from the maintenance loop or tests).
+        Returns snapshots taken."""
+        if not self.is_leader():
+            return 0
+        taken = 0
+        for tid, e in list(self.tables.items()):
+            for sid in list(e.get("snapshot_schedules", {})):
+                sc = e["snapshot_schedules"].get(sid, {})
+                if time.time() - sc.get("last_run", 0) < sc["interval_s"]:
+                    continue
+                try:
+                    r = await self.rpc_create_snapshot(
+                        {"table": e["info"]["name"]})
+                except (RpcError, asyncio.TimeoutError, OSError):
+                    continue
+                # re-fetch AFTER the await: concurrent RPCs (schedule
+                # create/delete, other ticks) may have replaced the
+                # catalog entry — merge into fresh state, touching only
+                # this schedule.
+                ent = dict(self.tables.get(tid) or {})
+                scheds = dict(ent.get("snapshot_schedules", {}))
+                cur = scheds.get(sid)
+                if not ent or cur is None:       # dropped concurrently
+                    continue
+                cur = dict(cur)
+                snaps = list(cur.get("snapshots", []))
+                snaps.append({"snapshot_id": r["snapshot_id"],
+                              "at": time.time()})
+                # retention: keep the newest N, delete the rest for real
+                cur["snapshots"] = snaps[-cur["keep"]:]
+                cur["last_run"] = time.time()
+                scheds[sid] = cur
+                ent["snapshot_schedules"] = scheds
+                await self._commit_catalog([["put_table", tid, ent]])
+                taken += 1
+                for old in snaps[:-cur["keep"]]:
+                    try:
+                        await self.rpc_delete_snapshot(
+                            {"snapshot_id": old["snapshot_id"]})
+                    except (RpcError, asyncio.TimeoutError, OSError):
+                        pass
+        return taken
+
+    async def rpc_list_snapshot_schedules(self, payload) -> dict:
+        """List schedules (optionally for one table) with their retained
+        snapshots (reference: yb-admin list_snapshot_schedules)."""
+        name = payload.get("table")
+        out = {}
+        for tid, e in self.tables.items():
+            if name and e["info"]["name"] != name:
+                continue
+            for sid, sc in e.get("snapshot_schedules", {}).items():
+                out[sid] = {"table": e["info"]["name"],
+                            "interval_s": sc["interval_s"],
+                            "keep": sc["keep"],
+                            "snapshots": sc.get("snapshots", [])}
+        return {"schedules": out}
+
+    async def rpc_restore_snapshot_schedule(self, payload) -> dict:
+        """PITR-style: restore the newest scheduled snapshot taken at or
+        before `at` (epoch seconds) as a new table."""
+        self._check_leader()
+        sched_id = payload["schedule_id"]
+        at = payload.get("at", time.time())
+        for tid, e in self.tables.items():
+            sc = e.get("snapshot_schedules", {}).get(sched_id)
+            if sc is None:
+                continue
+            candidates = [x for x in sc.get("snapshots", [])
+                          if x["at"] <= at]
+            if not candidates:
+                raise RpcError("no snapshot at or before the target time",
+                               "NOT_FOUND")
+            best = max(candidates, key=lambda x: x["at"])
+            return await self.rpc_restore_snapshot(
+                {"snapshot_id": best["snapshot_id"],
+                 "new_name": payload["new_name"]})
+        raise RpcError(f"schedule {sched_id} not found", "NOT_FOUND")
 
     async def rpc_restore_snapshot(self, payload) -> dict:
         """Restore a snapshot as a NEW table (clone-from-snapshot flow)."""

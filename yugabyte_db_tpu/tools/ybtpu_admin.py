@@ -7,6 +7,9 @@ compactions, tserver listing. Usage:
 
 Commands: list_tables, list_tservers, list_tablets TABLE,
 create_snapshot TABLE, restore_snapshot SNAPSHOT_ID NEW_TABLE,
+create_snapshot_schedule TABLE INTERVAL_S KEEP,
+list_snapshot_schedules TABLE,
+restore_snapshot_schedule SCHEDULE_ID AT_UNIX_TS NEW_TABLE,
 split_tablet TABLET_ID, move_replica TABLET_ID FROM TO, balance_tick,
 blacklist TS_UUID, compact_table TABLE, flush_table TABLE
 """
@@ -21,6 +24,15 @@ from ..client import YBClient
 from ..docdb.wire import read_request_to_wire
 
 
+# minimum positional args per command (commands absent here take 0)
+_MIN_ARGS = {
+    "list_tablets": 1, "create_snapshot": 1, "restore_snapshot": 2,
+    "create_snapshot_schedule": 3, "restore_snapshot_schedule": 3,
+    "split_tablet": 1, "move_replica": 3, "blacklist": 1,
+    "compact_table": 1, "flush_table": 1,
+}
+
+
 async def run_command(args) -> int:
     host, port = args.master.rsplit(":", 1)
     client = YBClient((host, int(port)))
@@ -28,6 +40,10 @@ async def run_command(args) -> int:
     maddr = client.master_addr
     cmd = args.command
     a = args.args
+    if len(a) < _MIN_ARGS.get(cmd, 0):
+        print(f"error: {cmd} takes at least {_MIN_ARGS[cmd]} argument(s) "
+              f"(see module docstring)", file=sys.stderr)
+        return 1
     if cmd == "list_tables":
         print(json.dumps(await client.list_tables(), indent=1))
     elif cmd == "list_tservers":
@@ -46,6 +62,20 @@ async def run_command(args) -> int:
         r = await m.call(maddr, "master", "restore_snapshot",
                          {"snapshot_id": a[0], "new_name": a[1]},
                          timeout=120.0)
+        print(json.dumps(r))
+    elif cmd == "create_snapshot_schedule":
+        r = await m.call(maddr, "master", "create_snapshot_schedule",
+                         {"table": a[0], "interval_s": float(a[1]),
+                          "keep": int(a[2])}, timeout=120.0)
+        print(json.dumps(r))
+    elif cmd == "list_snapshot_schedules":
+        r = await m.call(maddr, "master", "list_snapshot_schedules",
+                         {"table": a[0]} if a else {}, timeout=120.0)
+        print(json.dumps(r, indent=1))
+    elif cmd == "restore_snapshot_schedule":
+        r = await m.call(maddr, "master", "restore_snapshot_schedule",
+                         {"schedule_id": a[0], "at": float(a[1]),
+                          "new_name": a[2]}, timeout=120.0)
         print(json.dumps(r))
     elif cmd == "split_tablet":
         r = await m.call(maddr, "master", "split_tablet",
@@ -82,7 +112,12 @@ def main(argv=None):
     p.add_argument("command")
     p.add_argument("args", nargs="*")
     args = p.parse_args(argv)
-    return asyncio.run(run_command(args))
+    from ..rpc.messenger import RpcError
+    try:
+        return asyncio.run(run_command(args))
+    except RpcError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
 
 
 if __name__ == "__main__":

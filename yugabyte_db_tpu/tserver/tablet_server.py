@@ -289,6 +289,15 @@ class TabletServer:
         peer.tablet.create_snapshot(d)
         return {"ok": True, "dir": d, "ts_uuid": self.uuid}
 
+    async def rpc_delete_snapshot(self, payload) -> dict:
+        """Drop a tablet checkpoint dir (reference: DeleteTabletSnapshot
+        in tablet/tablet_snapshots.cc). Idempotent."""
+        import shutil
+        d = os.path.join(self._tablet_dir(payload["tablet_id"]),
+                         "snapshots", payload["snapshot_id"])
+        shutil.rmtree(d, ignore_errors=True)
+        return {"ok": True}
+
     async def rpc_split_tablet(self, payload) -> dict:
         """Split a local tablet replica into two children at split_key.
         Deterministic local copy on every replica (reference:
