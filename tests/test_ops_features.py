@@ -166,6 +166,55 @@ class TestXCluster:
         run(go())
 
 
+class TestXClusterSafeTime:
+    def test_safe_time_advances_and_reads_consistently(self, tmp_path):
+        async def go():
+            src = await MiniCluster(str(tmp_path / "src"),
+                                    num_tservers=1).start()
+            dst = await MiniCluster(str(tmp_path / "dst"),
+                                    num_tservers=1).start()
+            try:
+                cs, cd = src.client(), dst.client()
+                await cs.create_table(kv_info(), num_tablets=2)
+                await src.wait_for_leaders("kv")
+                repl = XClusterReplicator(cs, cd, "kv", poll_interval=0.05)
+                await repl.ensure_target_table()
+                await dst.wait_for_leaders("kv")
+                await cs.insert("kv", [{"k": i, "v": float(i)}
+                                       for i in range(10)])
+                n = 0
+                for _ in range(20):
+                    n += await repl.step()
+                    if n >= 10:
+                        break
+                    await asyncio.sleep(0.05)
+                r = await cd._master_call("get_xcluster_safe_time",
+                                          {"table": "kv"})
+                st1 = r["safe_ht"]
+                assert st1 > 0
+                # a read AT the safe time sees the full replicated cut
+                resp = await cd.scan("kv", ReadRequest(
+                    "", aggregates=(AggSpec("count"),), read_ht=st1))
+                assert int(resp.agg_values[0]) == 10
+                # more source writes -> safe time advances monotonically
+                await cs.insert("kv", [{"k": 100, "v": 1.0}])
+                for _ in range(20):
+                    await repl.step()
+                    r = await cd._master_call("get_xcluster_safe_time",
+                                              {"table": "kv"})
+                    if r["safe_ht"] > st1:
+                        break
+                    await asyncio.sleep(0.05)
+                assert r["safe_ht"] > st1
+                # cluster-wide min (no table arg) reports this table too
+                r = await cd._master_call("get_xcluster_safe_time", {})
+                assert r["safe_ht"] > 0 and "kv" in r["tables"]
+            finally:
+                await src.shutdown()
+                await dst.shutdown()
+        run(go())
+
+
 class TestCdcStreamRegistry:
     def test_durable_checkpoints_resume(self, tmp_path):
         async def go():

@@ -28,6 +28,10 @@ class CdcStream:
         self.table = table
         self.stream_id = stream_id      # set -> checkpoints persist in the
         self.checkpoints: Dict[str, int] = {}
+        self._num_tablets = 0
+        # per-tablet replicated-up-to hybrid time (xCluster safe time
+        # inputs; reference: xcluster_safe_time_service.cc)
+        self._tablet_safe_ht: Dict[str, int] = {}
         # provisional buffers per txn until commit/abort arrives
         self._pending_txns: Dict[str, List[dict]] = {}
 
@@ -51,6 +55,12 @@ class CdcStream:
         """One round of the virtual WAL: fetch + merge committed changes
         from every tablet."""
         ct = await self.client._table(self.table, refresh=True)
+        self._num_tablets = len(ct.locations)
+        live = {loc.tablet_id for loc in ct.locations}
+        # tablets split away no longer report; keeping their stale HT
+        # would freeze min() forever
+        self._tablet_safe_ht = {k: v for k, v in
+                                self._tablet_safe_ht.items() if k in live}
         out: List[dict] = []
         for loc in ct.locations:
             payload = {"tablet_id": loc.tablet_id,
@@ -91,8 +101,23 @@ class CdcStream:
                 new_cp = min(new_cp, pending_min - 1)
             self.checkpoints[loc.tablet_id] = max(
                 self.checkpoints.get(loc.tablet_id, 0), new_cp)
+            # safe time only advances while no provisional txn from this
+            # tablet is still buffered (its commit HT is unknown yet)
+            if pending_min is None and "safe_ht" in resp:
+                self._tablet_safe_ht[loc.tablet_id] = max(
+                    self._tablet_safe_ht.get(loc.tablet_id, 0),
+                    resp["safe_ht"])
         out.sort(key=lambda c: c.get("ht", 0))
         return out
+
+    def safe_time(self) -> int:
+        """Min replicated-up-to HT across tablets: a reader using this
+        as read_ht sees a consistent, fully-replicated cut. 0 until
+        every tablet has reported."""
+        live = set(self._tablet_safe_ht)
+        if not self._num_tablets or len(live) < self._num_tablets:
+            return 0
+        return min(self._tablet_safe_ht.values())
 
     async def commit_checkpoints(self) -> None:
         """Persist checkpoints AFTER the consumer has durably handled the
@@ -131,17 +156,58 @@ class XClusterReplicator:
                 ct.locations))
 
     async def step(self) -> int:
+        # poll() advances in-memory checkpoints optimistically; if the
+        # target rejects the batch, roll them (and the safe-ht inputs)
+        # back so the next step re-reads the same changes instead of
+        # silently dropping them under an advancing safe time
+        cps = dict(self.stream.checkpoints)
+        shts = dict(self.stream._tablet_safe_ht)
+        try:
+            return await self._step_inner()
+        except Exception:
+            self.stream.checkpoints = cps
+            self.stream._tablet_safe_ht = shts
+            raise
+
+    async def _step_inner(self) -> int:
         changes = await self.stream.poll()
-        if not changes:
-            await self.stream.commit_checkpoints()
-            return 0
-        ops = [RowOp("delete" if c["op"] == "delete" else "upsert",
-                     c["row"]) for c in changes]
-        await self.target.write(self.table, ops)
+        n = 0
+        if changes:
+            # one target write per source commit HT, applied AT that HT
+            # (external hybrid time) so target reads at xCluster safe
+            # time see exactly the source's consistent cut
+            groups: List[Tuple[int, List[RowOp]]] = []
+            for c in changes:
+                op = RowOp("delete" if c["op"] == "delete" else "upsert",
+                           c["row"])
+                ht = c.get("ht", 0)
+                if groups and groups[-1][0] == ht:
+                    groups[-1][1].append(op)
+                else:
+                    groups.append((ht, [op]))
+            for ht, ops in groups:
+                await self.target.write(self.table, ops,
+                                        external_ht=ht or None)
+                self.replicated += len(ops)
+                n += len(ops)
         # checkpoint persists only after the target accepted the batch
         await self.stream.commit_checkpoints()
-        self.replicated += len(ops)
-        return len(ops)
+        await self._publish_safe_time()
+        return n
+
+    async def _publish_safe_time(self) -> None:
+        """Advertise the replicated-up-to HT on the TARGET master so
+        target-universe readers can take a consistent read_ht
+        (reference: XClusterSafeTimeService publishing to the sys
+        catalog)."""
+        st = self.stream.safe_time()
+        if not st:
+            return
+        try:
+            await self.target._master_call(
+                "set_xcluster_safe_time", {"table": self.table, "safe_ht": st})
+        except (RpcError, asyncio.TimeoutError, OSError):
+            pass
 
     async def start(self):
         await self.ensure_target_table()

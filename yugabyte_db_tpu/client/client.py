@@ -167,7 +167,8 @@ class YBClient:
         raise RpcError("no tablet covers key", "NOT_FOUND")
 
     # --- DML: writes ------------------------------------------------------
-    async def write(self, table: str, ops: Sequence[RowOp]) -> int:
+    async def write(self, table: str, ops: Sequence[RowOp],
+                    external_ht: int | None = None) -> int:
         """Batcher: group ops per tablet, send in parallel, retry on
         leadership changes. Maintains secondary-index tables
         synchronously (reference: transactional index maintenance in
@@ -181,7 +182,8 @@ class YBClient:
             by_tablet.setdefault(loc.tablet_id, []).append(op)
 
         async def send(tablet_id: str, tops: List[RowOp]) -> int:
-            req = WriteRequest(ct.info.table_id, tops)
+            req = WriteRequest(ct.info.table_id, tops,
+                               external_ht=external_ht)
             payload = {"tablet_id": tablet_id,
                        "req": write_request_to_wire(req)}
             return (await self._call_leader(ct, tablet_id, "write", payload)

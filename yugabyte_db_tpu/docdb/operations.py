@@ -47,6 +47,10 @@ class RowOp:
 class WriteRequest:
     table_id: str
     ops: List[RowOp] = field(default_factory=list)
+    # xCluster: preserve the SOURCE universe's commit HT on target
+    # writes so safe-time reads see a consistent cut (reference:
+    # external hybrid time in docdb / xcluster_write_interface)
+    external_ht: int | None = None
 
 
 @dataclass

@@ -31,15 +31,19 @@ def _expr_from_wire(node):
 
 
 def write_request_to_wire(req: WriteRequest) -> dict:
-    return {"table_id": req.table_id,
-            "ops": [[o.kind, o.row, o.ttl_ms] for o in req.ops]}
+    out = {"table_id": req.table_id,
+           "ops": [[o.kind, o.row, o.ttl_ms] for o in req.ops]}
+    if req.external_ht is not None:
+        out["external_ht"] = req.external_ht
+    return out
 
 
 def write_request_from_wire(d: dict) -> WriteRequest:
     return WriteRequest(
         d["table_id"],
         [RowOp(op[0], op[1], op[2] if len(op) > 2 else None)
-         for op in d["ops"]])
+         for op in d["ops"]],
+        external_ht=d.get("external_ht"))
 
 
 def read_request_to_wire(req: ReadRequest) -> dict:

@@ -44,6 +44,8 @@ class Master:
         self.load_balancer = ClusterLoadBalancer(self)
         self._lb_task: Optional[asyncio.Task] = None
         self._running = False
+        # table -> replicated-up-to HT for inbound xCluster replication
+        self._xcluster_safe_time: Dict[str, int] = {}
         self.auto_balance = False   # ticked explicitly or via enable
         # sys-catalog Raft (None = standalone single master, still
         # journals through a local single-peer group once started)
@@ -683,6 +685,29 @@ class Master:
 
     # --- CDC stream registry (reference: master cdcsdk_manager.cc,
     # cdc_state_table.cc for checkpoints) ----------------------------------
+    async def rpc_set_xcluster_safe_time(self, payload) -> dict:
+        """Published by an inbound xCluster replicator: the HT up to
+        which this table is fully replicated from its source universe
+        (reference: xcluster_safe_time_service.cc). Kept in memory —
+        it's a high-frequency watermark, re-published continuously, so
+        losing it on failover only delays consistent reads briefly."""
+        self._check_leader()
+        self._xcluster_safe_time[payload["table"]] = max(
+            self._xcluster_safe_time.get(payload["table"], 0),
+            int(payload["safe_ht"]))
+        return {"ok": True}
+
+    async def rpc_get_xcluster_safe_time(self, payload) -> dict:
+        """Safe read time for one table, or the min across all inbound
+        xCluster tables when no table is given (cluster-consistent)."""
+        self._check_leader()
+        name = payload.get("table")
+        if name is not None:
+            return {"safe_ht": self._xcluster_safe_time.get(name, 0)}
+        vals = self._xcluster_safe_time
+        return {"safe_ht": min(vals.values()) if vals else 0,
+                "tables": dict(vals)}
+
     async def rpc_create_cdc_stream(self, payload) -> dict:
         self._check_leader()
         name = payload["table"]

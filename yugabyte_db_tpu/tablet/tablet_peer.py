@@ -82,14 +82,39 @@ class TabletPeer:
             raise RpcError(
                 f"not leader (hint={self.consensus.leader_hint()})",
                 "LEADER_NOT_READY")
-        ht = self.clock.now()
-        payload = {"req": write_request_to_wire(req), "ht": ht.value}
+        if req.external_ht is not None:
+            # HLC merge keeps local time ahead of the imported HT
+            self.clock.update(HybridTime(req.external_ht))
+            ht_value = req.external_ht
+        else:
+            ht_value = self.clock.now().value
+        payload = {"req": write_request_to_wire(req), "ht": ht_value}
         fut = asyncio.get_running_loop().create_future()
         self._write_queue.append((payload, fut))
         if self._batcher_task is None or self._batcher_task.done():
             self._batcher_task = asyncio.create_task(self._drain_writes())
         await fut
         return WriteResponse(rows_affected=len(req.ops))
+
+    def xcluster_safe_ht(self, now_value: int) -> int:
+        """Upper bound below which no NEW commit can land: current HT
+        clamped under every queued write and every uncommitted log
+        suffix entry that already carries an assigned HT (the MVCC
+        safe-time analog, reference: mvcc.cc SafeTime). Without this,
+        a write with ht=100 sitting in the queue would let get_changes
+        advertise now()=105 as safe, then commit below it."""
+        bound = now_value
+        for p, _ in self._write_queue:
+            bound = min(bound, p["ht"] - 1)
+        for e in self.log.entries_from(
+                self.consensus.commit_index + 1, 1000):
+            d = msgpack.unpackb(e.payload, raw=False)
+            if e.etype == "write":
+                for item in (d["batch"] if "batch" in d else [d]):
+                    bound = min(bound, item["ht"] - 1)
+            elif e.etype == "txn_apply":
+                bound = min(bound, d["commit_ht"] - 1)
+        return bound
 
     async def _drain_writes(self):
         while self._write_queue:

@@ -487,7 +487,17 @@ class TabletServer:
                 d = _mp.unpackb(e.payload, raw=False)
                 changes.append({"op": "abort", "txn_id": d["txn_id"],
                                 "index": e.index})
-        return {"changes": changes, "checkpoint": last}
+        # xCluster safe time (reference: GetChanges safe_hybrid_time,
+        # xcluster_safe_time_service.cc): when the consumer has drained
+        # to commit_index, every future commit on this leader gets
+        # HT > now, so "now" is safe; otherwise the last streamed HT is.
+        if last >= peer.consensus.commit_index and peer.is_leader():
+            safe_ht = peer.xcluster_safe_ht(self.clock.now().value)
+        else:
+            safe_ht = max((c["ht"] for c in changes if "ht" in c),
+                          default=0)
+        return {"changes": changes, "checkpoint": last,
+                "safe_ht": safe_ht}
 
     async def rpc_mem_trackers(self, payload) -> dict:
         """Memory accounting rollup (reference: util/mem_tracker.h
