@@ -99,6 +99,42 @@ class TestWebServer:
             await ws.shutdown()
         run(go())
 
+    def test_master_path_handlers(self, tmp_path):
+        """Master web UI (reference: master-path-handlers.cc): /tables,
+        /tablet-servers, /tablets serve live catalog state as JSON."""
+        async def go():
+            import json as _json
+            from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+            from tests.test_load_balancer import kv_info
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            ws = StatusWebServer("m", extra_handlers=mc.master.web_handlers())
+            addr = await ws.start()
+            try:
+                c = mc.client()
+                await c.create_table(kv_info(), num_tablets=2)
+                await mc.wait_for_leaders("kv")
+                loop = asyncio.get_running_loop()
+
+                def fetch(path):
+                    with urllib.request.urlopen(
+                            f"http://{addr[0]}:{addr[1]}{path}") as r:
+                        return r.read().decode()
+
+                tables = _json.loads(
+                    await loop.run_in_executor(None, fetch, "/tables"))
+                assert any(t["name"] == "kv" and t["tablets"] == 2
+                           for t in tables)
+                tss = _json.loads(await loop.run_in_executor(
+                    None, fetch, "/tablet-servers"))
+                assert len(tss) == 1 and tss[0]["alive"]
+                tablets = _json.loads(await loop.run_in_executor(
+                    None, fetch, "/tablets"))
+                assert sum(t["leader"] is not None for t in tablets) >= 2
+            finally:
+                await ws.shutdown()
+                await mc.shutdown()
+        run(go())
+
 
 class TestAdminCli:
     def test_list_tables_and_compact(self, tmp_path, capsys):

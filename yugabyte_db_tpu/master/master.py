@@ -169,6 +169,58 @@ class Master:
             self._lb_task.cancel()
         await self.messenger.shutdown()
 
+    # --- web UI path handlers (reference: master-path-handlers.cc) --------
+    def web_handlers(self) -> Dict[str, object]:
+        """Handlers for StatusWebServer: cluster state as JSON —
+        /tables, /tablet-servers, /tablets, /xcluster-safe-time."""
+        def tables():
+            out = []
+            for tid, e in self.tables.items():
+                info = e["info"]
+                out.append({
+                    "table_id": tid, "name": info["name"],
+                    "tablets": len(e.get("tablets", [])),
+                    "schema_version": info["schema"]["version"],
+                    "colocated": bool(e.get("colocated_in")
+                                      or e.get("tablegroup")),
+                    "indexes": list(e.get("indexes", {})),
+                    "snapshots": len(e.get("snapshots", {})),
+                    "cdc_streams": len(e.get("cdc_streams", {})),
+                })
+            return json.dumps(out, indent=1), "application/json"
+
+        def tablet_servers():
+            now = time.monotonic()
+            out = []
+            for u, ts in self.tservers.items():
+                out.append({
+                    "ts_uuid": u, "addr": list(ts["addr"]),
+                    "zone": ts.get("zone"),
+                    "alive": now - ts["last_hb"] < TS_LIVENESS_S,
+                    "tablets": len(ts.get("tablets", [])),
+                    "leaders": sum(1 for t in ts.get("tablets", [])
+                                   if t.get("is_leader")),
+                })
+            return json.dumps(out, indent=1), "application/json"
+
+        def tablets():
+            out = []
+            for tablet_id, ent in self.tablets.items():
+                out.append({
+                    "tablet_id": tablet_id, "table_id": ent.get("table_id"),
+                    "partition": ent.get("partition"),
+                    "leader": ent.get("leader"),
+                    "replicas": ent.get("replicas", []),
+                })
+            return json.dumps(out, indent=1, default=str), "application/json"
+
+        def xcluster():
+            return json.dumps(self._xcluster_safe_time,
+                              indent=1), "application/json"
+
+        return {"/tables": tables, "/tablet-servers": tablet_servers,
+                "/tablets": tablets, "/xcluster-safe-time": xcluster}
+
     # --- TS registry ------------------------------------------------------
     async def rpc_ts_heartbeat(self, payload) -> dict:
         uuid = payload["ts_uuid"]

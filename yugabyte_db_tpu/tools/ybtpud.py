@@ -34,9 +34,10 @@ async def serve(args):
                               if args.tserver_port else 0)
         tservers.append(ts)
         print(f"tserver ts-{i}  : {addr[0]}:{addr[1]}")
-    web = StatusWebServer("ybtpu")
+    web = StatusWebServer("ybtpu", extra_handlers=master.web_handlers())
     waddr = await web.start(port=args.web_port)
-    print(f"status ui     : http://{waddr[0]}:{waddr[1]}/metrics")
+    print(f"status ui     : http://{waddr[0]}:{waddr[1]}/metrics "
+          f"(/tables /tablet-servers /tablets /rpcz /ash)")
 
     from ..client import YBClient
     client = YBClient(maddr)
