@@ -61,6 +61,75 @@ class TestSnapshots:
         run(go())
 
 
+class TestSnapshotConsistentCut:
+    def test_trim_above_ht_drops_later_versions(self, tmp_path):
+        """Unit cut: versions written after the cut HT disappear; the
+        pre-cut image (including older versions of the same row) stays."""
+        from yugabyte_db_tpu.docdb import ReadRequest, RowOp, WriteRequest
+        from yugabyte_db_tpu.tablet import Tablet
+        from yugabyte_db_tpu.utils.hybrid_time import (
+            HybridClock, MockPhysicalClock,
+        )
+        from tests.test_tablet import make_info
+        clock = HybridClock(MockPhysicalClock(1_000_000))
+        t = Tablet("cut1", make_info(), str(tmp_path), clock=clock)
+        t.apply_write(WriteRequest("t1", [
+            RowOp("upsert", {"k": 1, "v": 1.0, "s": "a"}),
+            RowOp("upsert", {"k": 2, "v": 2.0, "s": "b"})]))
+        cutoff = clock.now().value
+        clock._physical.advance_micros(1000)
+        t.apply_write(WriteRequest("t1", [
+            RowOp("upsert", {"k": 1, "v": 100.0, "s": "a"}),   # overwrite
+            RowOp("upsert", {"k": 3, "v": 3.0, "s": "c"})]))   # new row
+        dropped = t.trim_above_ht(cutoff)
+        assert dropped == 2
+        now = clock.now().value
+        r1 = t.read(ReadRequest("t1", pk_eq={"k": 1}, read_ht=now))
+        assert r1.rows[0]["v"] == 1.0        # rolled back to the cut
+        assert not t.read(ReadRequest("t1", pk_eq={"k": 3},
+                                      read_ht=now)).rows
+        assert t.read(ReadRequest("t1", pk_eq={"k": 2},
+                                  read_ht=now)).rows[0]["v"] == 2.0
+        # idempotent: nothing else above the cut
+        assert t.trim_above_ht(cutoff) == 0
+
+    def test_snapshot_cut_never_loses_acked_writes(self, tmp_path):
+        """The cut HT samples every tserver clock, so a write acked
+        BEFORE create_snapshot — even one that merged the tablet HLC
+        far ahead via an external (xCluster) HT — is in the restore."""
+        async def go():
+            import time as _t
+            from yugabyte_db_tpu.utils.hybrid_time import HybridTime
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                c = mc.client()
+                await c.create_table(kv_info(), num_tablets=2)
+                await mc.wait_for_leaders("kv")
+                await c.insert("kv", [{"k": i, "v": float(i)}
+                                      for i in range(10)])
+                future_ht = HybridTime.from_micros(
+                    _t.time_ns() // 1000 + 10_000_000).value
+                from yugabyte_db_tpu.docdb import RowOp
+                await c.write("kv", [RowOp("upsert", {"k": 99, "v": 9.0})],
+                              external_ht=future_ht)
+                # acked AFTER the HLC jumped ahead: normal write whose HT
+                # is ~now+10s — the regression case for a wall-clock cut
+                await c.insert("kv", [{"k": 50, "v": 50.0}])
+                snap = await c._master_call("create_snapshot",
+                                            {"table": "kv"})
+                await c._master_call(
+                    "restore_snapshot",
+                    {"snapshot_id": snap["snapshot_id"],
+                     "new_name": "kv_cut"})
+                await mc.wait_for_leaders("kv_cut")
+                for k, v in [(0, 0.0), (9, 9.0), (99, 9.0), (50, 50.0)]:
+                    row = await c.get("kv_cut", {"k": k})
+                    assert row is not None and row["v"] == v, (k, row)
+            finally:
+                await mc.shutdown()
+        run(go())
+
+
 class TestTabletSplit:
     def test_split_preserves_data_and_routing(self, tmp_path):
         async def go():

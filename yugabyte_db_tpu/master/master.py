@@ -477,6 +477,28 @@ class Master:
         if tid is None:
             raise RpcError(f"table {name} not found", "NOT_FOUND")
         snapshot_id = f"snap-{_uuid.uuid4().hex[:12]}"
+        # single-HT cut: every tablet checkpoints AT this hybrid time —
+        # tservers merge it into their HLC, wait until all in-flight
+        # writes below it are applied, and restore trims anything above
+        # it (reference: SysSnapshotEntryPB snapshot_hybrid_time)
+        from ..utils.hybrid_time import HybridTime
+        snapshot_ht = HybridTime.from_micros(time.time_ns() // 1000).value
+        # the cut must dominate every write acked before this request:
+        # sample the HLC of every tserver hosting this table and take
+        # the max (clock skew / merged-ahead HLCs otherwise leave acked
+        # writes above the cut, and restore would trim them)
+        hosts = {u for tablet_id in self.tables[tid]["tablets"]
+                 for u in self.tablets[tablet_id]["replicas"]}
+        for u in hosts:
+            ts = self.tservers.get(u)
+            if not ts:
+                continue
+            try:
+                r = await self.messenger.call(
+                    ts["addr"], "tserver", "server_clock", {}, timeout=5.0)
+                snapshot_ht = max(snapshot_ht, r["ht"])
+            except (RpcError, asyncio.TimeoutError, OSError):
+                pass
         manifest = []
         for tablet_id in self.tables[tid]["tablets"]:
             ent = self.tablets[tablet_id]
@@ -489,20 +511,27 @@ class Master:
                     r = await self.messenger.call(
                         ts["addr"], "tserver", "create_snapshot",
                         {"tablet_id": tablet_id,
-                         "snapshot_id": snapshot_id}, timeout=30.0)
+                         "snapshot_id": snapshot_id,
+                         "snapshot_ht": snapshot_ht}, timeout=30.0)
                     manifest.append({"tablet_id": tablet_id, "ts_uuid": u,
                                      "dir": r["dir"],
                                      "partition": ent["partition"]})
                     done = True
                     break
-                except (RpcError, asyncio.TimeoutError, OSError):
+                except RpcError as ex:
+                    if ex.code not in ("LEADER_NOT_READY", "NOT_FOUND"):
+                        raise      # real failure (e.g. drain TIMED_OUT):
+                                   # followers can never succeed anyway
+                    continue
+                except (asyncio.TimeoutError, OSError):
                     continue
             if not done:
                 raise RpcError(f"no leader for {tablet_id}",
                                "SERVICE_UNAVAILABLE")
         ent = dict(self.tables[tid])
         snaps = dict(ent.get("snapshots", {}))
-        snaps[snapshot_id] = {"manifest": manifest}
+        snaps[snapshot_id] = {"manifest": manifest,
+                              "snapshot_ht": snapshot_ht}
         ent["snapshots"] = snaps
         await self._commit_catalog([["put_table", tid, ent]])
         return {"snapshot_id": snapshot_id,
@@ -670,7 +699,9 @@ class Master:
                 {"tablet_id": child, "table": info_wire,
                  "partition": m["partition"],
                  "raft_peers": [[u, list(ts["addr"])]],
-                 "seed_snapshot_dir": m["dir"]}, timeout=30.0)
+                 "seed_snapshot_dir": m["dir"],
+                 "trim_above_ht": e["snapshots"][snapshot_id].get(
+                     "snapshot_ht")}, timeout=30.0)
             tablet_entries[child] = {
                 "tablet_id": child, "table_id": new_tid,
                 "partition": m["partition"], "replicas": [u],

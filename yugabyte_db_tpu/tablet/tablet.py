@@ -255,6 +255,36 @@ class Tablet:
         self.flush()
         self.regular.checkpoint(os.path.join(out_dir, "regular"))
 
+    def trim_above_ht(self, cutoff: int) -> int:
+        """Enforce a single-HT consistent cut: drop every version whose
+        DocHybridTime exceeds `cutoff`. Run on a freshly-restored tablet
+        so a snapshot taken at one hybrid time reads identically across
+        tablets even when their clocks were skewed at checkpoint time
+        (reference: tablet_snapshots.cc restore with history cutoff).
+        Returns the number of dropped versions."""
+        from ..dockv.key_encoding import split_key_ht
+        from ..storage.lsm import CompactionFeed
+        self.flush()
+        inputs = self.regular.ssts
+        if not inputs:
+            return 0
+
+        class _TrimFeed(CompactionFeed):
+            dropped = 0
+
+            def feed(self, key, value):
+                try:
+                    if split_key_ht(key)[1].ht.value > cutoff:
+                        self.dropped += 1
+                        return []
+                except ValueError:
+                    pass              # no HT suffix (shouldn't happen)
+                return [(key, value)]
+
+        feed = _TrimFeed()
+        self.regular.compact(inputs, feed)
+        return feed.dropped
+
     @classmethod
     def restore_snapshot(cls, tablet_id: str, info: TableInfo,
                          snapshot_dir: str, directory: str,

@@ -157,7 +157,12 @@ class TabletServer:
                 os.path.join(d, "regular"))
         with open(os.path.join(d, "tablet-meta.json"), "w") as f:
             json.dump(meta, f)
-        await self._open_tablet(meta)
+        peer = await self._open_tablet(meta)
+        trim = payload.get("trim_above_ht")
+        if seed and trim:
+            # restore of a single-HT snapshot: clock-skewed versions
+            # above the cut are in the checkpoint; drop them
+            peer.tablet.trim_above_ht(trim)
         return {"ok": True}
 
     async def rpc_delete_tablet(self, payload) -> dict:
@@ -277,6 +282,13 @@ class TabletServer:
         await peer.consensus.step_down()
         return {"ok": True}
 
+    async def rpc_server_clock(self, payload) -> dict:
+        """Current hybrid time — the master samples every involved
+        tserver before picking a snapshot cut HT so the cut dominates
+        all previously-acked writes (reference: the hybrid-time
+        propagation that backs ReadHybridTime/snapshot selection)."""
+        return {"ht": self.clock.now().value}
+
     # --- snapshots ----------------------------------------------------------
     async def rpc_create_snapshot(self, payload) -> dict:
         """Checkpoint one tablet under snapshots/<id> (reference:
@@ -284,6 +296,21 @@ class TabletServer:
         peer = self._peer(payload["tablet_id"])
         if not peer.is_leader() and payload.get("leader_only", True):
             raise RpcError("not leader", "LEADER_NOT_READY")
+        snapshot_ht = payload.get("snapshot_ht")
+        if snapshot_ht:
+            # single-HT cut: push the local HLC past the cut (future
+            # writes land above it), then wait until every in-flight
+            # write at-or-below it has been applied so the checkpoint
+            # can't miss one
+            from ..utils.hybrid_time import HybridTime
+            self.clock.update(HybridTime(snapshot_ht))
+            deadline = asyncio.get_running_loop().time() + 10.0
+            while (peer.xcluster_safe_ht(self.clock.now().value)
+                   < snapshot_ht):
+                if asyncio.get_running_loop().time() > deadline:
+                    raise RpcError("in-flight writes below the snapshot "
+                                   "time did not drain", "TIMED_OUT")
+                await asyncio.sleep(0.005)
         d = os.path.join(self._tablet_dir(payload["tablet_id"]),
                          "snapshots", payload["snapshot_id"])
         peer.tablet.create_snapshot(d)
