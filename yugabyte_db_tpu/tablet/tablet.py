@@ -33,6 +33,21 @@ from ..utils.hybrid_time import HybridClock, HybridTime
 _DEVICE_CACHE = DeviceBlockCache()
 
 
+class _VectorIndexState:
+    """One ANN index: a frozen IVF chunk plus a mutable delta — the
+    vector-LSM shape (reference: vector_index/vector_lsm.cc)."""
+
+    def __init__(self, col_name: str, nlists: int):
+        self.col_name = col_name
+        self.nlists = nlists
+        self.idx = None               # frozen IvfFlatIndex (or None)
+        self.pks: list = []           # row ids aligned with idx vectors
+        self.frozen_keys: set = set()  # pk_keys present in the chunk
+        # pk_key -> (pk_row, vector_bytes, expire_at_wall or None)
+        self.delta: Dict[tuple, tuple] = {}
+        self.dead: set = set()        # frozen pk_keys hidden by del/upsert
+
+
 class Tablet:
     def __init__(self, tablet_id: str, info: TableInfo, directory: str,
                  clock: Optional[HybridClock] = None,
@@ -59,8 +74,8 @@ class Tablet:
             self.codec, self.regular, device_cache=_DEVICE_CACHE)
         self._read_ops: Dict[str, DocReadOperation] = {
             info.table_id: self._read_op}
-        # vector ANN indexes: col_id -> (IvfFlatIndex, [pk rows])
-        self.vector_indexes: Dict[int, tuple] = {}
+        # vector ANN indexes: col_id -> _VectorIndexState
+        self.vector_indexes: Dict[int, _VectorIndexState] = {}
         self._lock = threading.Lock()
         ent = metrics.REGISTRY.entity("tablet", tablet_id,
                                       table=info.name)
@@ -117,6 +132,7 @@ class Tablet:
         batch, n = DocWriteOperation(self._codec_for(req.table_id),
                                      req).apply(ht, op_id=op_id)
         self.regular.apply(batch)
+        self._maintain_vector_indexes(req)
         self._m_rows_written.increment(n)
         if self.regular.should_flush():
             self.flush()
@@ -214,39 +230,127 @@ class Tablet:
         return pks, (np.stack(vecs) if vecs else np.zeros((0, 1), np.float32))
 
     def build_vector_index(self, col_name: str, nlists: int = 100) -> int:
+        """(Re)build the frozen IVF chunk. Safe against writes racing a
+        background fold: overlay entries recorded before the scan fold
+        into the chunk and are dropped; entries that arrive during the
+        build are carried over into the new state."""
         from ..ops.vector import IvfFlatIndex
-        pks, vecs = self._scan_vectors(col_name)
         cid = self.info.schema.column_by_name(col_name).id
-        if len(vecs) == 0:
-            self.vector_indexes[cid] = (None, [])
-            return 0
-        nlists = max(1, min(nlists, len(vecs) // 2 or 1))
-        idx = IvfFlatIndex.build(vecs, nlists=nlists)
-        self.vector_indexes[cid] = (idx, pks)
+        old = self.vector_indexes.get(cid)
+        with self._lock:
+            pending = dict(old.delta) if old else {}
+            deadsnap = set(old.dead) if old else set()
+        pks, vecs = self._scan_vectors(col_name)
+        pk_names = tuple(c.name for c in self.info.schema.key_columns)
+        state = _VectorIndexState(col_name, nlists)
+        if len(vecs):
+            n = max(1, min(nlists, len(vecs) // 2 or 1))
+            state.idx = IvfFlatIndex.build(vecs, nlists=n)
+            state.pks = pks
+            state.frozen_keys = {tuple(p[n_] for n_ in pk_names)
+                                 for p in pks}
+        with self._lock:
+            if old is not None:
+                # identity check: keep only entries written AFTER the
+                # snapshot (same key re-written during the build stays)
+                state.delta = {kk: v for kk, v in old.delta.items()
+                               if pending.get(kk) is not v}
+                state.dead = (old.dead - deadsnap) & state.frozen_keys
+            self.vector_indexes[cid] = state
         return len(pks)
+
+    def _maintain_vector_indexes(self, req: WriteRequest) -> None:
+        """Incremental maintenance (reference: vector_lsm.cc mutable
+        chunk): writes land in a delta buffer merged at search time;
+        once the delta outgrows the frozen index, rebuild folds it in."""
+        if not self.vector_indexes or req.table_id != self.info.table_id:
+            return
+        import time as _time
+        pk_names = tuple(c.name for c in self.info.schema.key_columns)
+        with self._lock:
+            for state in self.vector_indexes.values():
+                for op in req.ops:
+                    try:
+                        pk_key = tuple(op.row[n] for n in pk_names)
+                    except KeyError:
+                        continue
+                    state.delta.pop(pk_key, None)
+                    # dead only hides FROZEN copies; fresh inserts never
+                    # grow it (it bounds the search over-fetch)
+                    if pk_key in state.frozen_keys:
+                        state.dead.add(pk_key)
+                    if op.kind != "delete":
+                        v = op.row.get(state.col_name)
+                        if v is None:
+                            continue
+                        expire = (None if op.ttl_ms is None else
+                                  _time.time() + op.ttl_ms / 1000.0)
+                        state.delta[pk_key] = (
+                            {n: op.row[n] for n in pk_names}, bytes(v),
+                            expire)
+
+    def maybe_rebuild_vector_indexes(self) -> int:
+        """Fold an outgrown delta back into the frozen IVF index
+        (background-compaction analog). Returns indexes rebuilt."""
+        n = 0
+        for cid, state in list(self.vector_indexes.items()):
+            churn = len(state.delta) + len(state.dead)
+            if churn and churn >= max(64, len(state.pks) // 5):
+                self.build_vector_index(state.col_name, state.nlists)
+                n += 1
+        return n
 
     def vector_search(self, col_name: str, query, k: int = 10,
                       nprobe: int = 8):
-        """Top-k (pk row, distance) for one tablet. Uses the IVF index if
-        built; exact device search otherwise."""
+        """Top-k (pk row, distance) for one tablet: IVF over the frozen
+        chunk + exact search over the live delta, merged; falls back to
+        full exact search when no index is built."""
+        import time as _time
         import numpy as np
         from ..ops.vector import exact_search
         cid = self.info.schema.column_by_name(col_name).id
+        pk_names = tuple(c.name for c in self.info.schema.key_columns)
         q = np.asarray(query, np.float32)[None, :]
-        entry = self.vector_indexes.get(cid)
-        if entry and entry[0] is not None:
-            idx, pks = entry
-            k_ = min(k, len(pks))
+        state = self.vector_indexes.get(cid)
+        if state is None:
+            pks, vecs = self._scan_vectors(col_name)
+            if not pks:
+                return []
+            d, ids = exact_search(q, vecs, k=min(k, len(pks)))
+            return [(pks[int(i)], float(dist))
+                    for dist, i in zip(np.asarray(d)[0],
+                                       np.asarray(ids)[0])]
+        with self._lock:
+            dead = set(state.dead)
+            now = _time.time()
+            expired = [kk for kk, (_, _, exp) in state.delta.items()
+                       if exp is not None and exp <= now]
+            for kk in expired:
+                del state.delta[kk]
+            delta = list(state.delta.values())
+        hits = []
+        if state.idx is not None and state.pks:
+            idx, pks = state.idx, state.pks
+            # over-fetch so post-filtering dead rows still fills k
+            k_ = min(k + len(dead), len(pks))
             d, ids = idx.search(q, k=k_, nprobe=min(nprobe,
                                                     len(idx.list_lens)))
-            return [(pks[int(i)], float(dist))
-                    for dist, i in zip(d[0], ids[0])]
-        pks, vecs = self._scan_vectors(col_name)
-        if not pks:
-            return []
-        d, ids = exact_search(q, vecs, k=min(k, len(pks)))
-        return [(pks[int(i)], float(dist))
-                for dist, i in zip(np.asarray(d)[0], np.asarray(ids)[0])]
+            for dist, i in zip(d[0], ids[0]):
+                if not np.isfinite(float(dist)):
+                    continue          # top_k padding, not a real hit
+                pk = pks[int(i)]
+                if tuple(pk[n] for n in pk_names) not in dead:
+                    hits.append((pk, float(dist)))
+        if delta:
+            dpks = [p for p, _, _ in delta]
+            dvecs = np.stack([np.frombuffer(v, np.float32)
+                              for _, v, _ in delta])
+            d, ids = exact_search(q, dvecs, k=min(k, len(dpks)))
+            hits += [(dpks[int(i)], float(dist))
+                     for dist, i in zip(np.asarray(d)[0],
+                                        np.asarray(ids)[0])]
+        hits.sort(key=lambda h: h[1])
+        return hits[:k]
 
     # --- snapshots --------------------------------------------------------
     def create_snapshot(self, out_dir: str) -> None:

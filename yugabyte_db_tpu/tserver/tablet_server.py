@@ -584,6 +584,16 @@ class TabletServer:
                     except Exception:
                         log.exception("background compaction failed for %s",
                                       p.tablet.tablet_id)
+                # fold outgrown vector-index deltas back into the
+                # frozen IVF chunks (vector-LSM background compaction)
+                for p in list(self.peers.values()):
+                    try:
+                        if p.tablet.vector_indexes:
+                            await asyncio.get_running_loop().run_in_executor(
+                                None, p.tablet.maybe_rebuild_vector_indexes)
+                    except Exception:
+                        log.exception("vector index rebuild failed for %s",
+                                      p.tablet.tablet_id)
             await asyncio.sleep(0.2)
 
     async def _heartbeat_once(self):
