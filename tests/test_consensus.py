@@ -190,6 +190,30 @@ class TestRaft:
             await h.shutdown()
         run(go())
 
+    def test_pre_vote_shields_healthy_leader(self, tmp_path):
+        """A node whose election timer fires while the leader is healthy
+        must NOT inflate the term or depose the leader (pre-vote:
+        reference raft pre-elections)."""
+        async def go():
+            h = RaftHarness(tmp_path, n=3)
+            await h.start()
+            leader = await h.leader()
+            await leader.replicate("write", b"stable")
+            term_before = leader.meta.current_term
+            follower = next(n for n in h.nodes.values()
+                            if n.role != Role.LEADER)
+            # force the follower's election timer to fire repeatedly
+            for _ in range(5):
+                follower._election_deadline = 0.0
+                await asyncio.sleep(0.1)
+            assert leader.role == Role.LEADER
+            assert leader.meta.current_term == term_before
+            assert follower.meta.current_term == term_before
+            # the cluster still works
+            await leader.replicate("write", b"after")
+            await h.shutdown()
+        run(go())
+
     def test_lease_expires_without_majority(self, tmp_path):
         async def go():
             h = RaftHarness(tmp_path, n=3)
@@ -204,6 +228,44 @@ class TestRaft:
             await asyncio.sleep(lease_s + 0.5)
             assert not leader.has_leader_lease()
             await h.shutdown()
+        run(go())
+
+
+class TestRpcCompression:
+    def test_large_frames_compress_and_roundtrip(self):
+        from yugabyte_db_tpu.rpc.messenger import (
+            _COMPRESS_BIT, _COMPRESS_MIN, _pack,
+        )
+        import struct as _struct
+        # compressible payload >= threshold gets the flag + shrinks
+        obj = [0, 0, "svc", "m", {"rows": ["abc" * 10] * 400}]
+        framed = _pack(obj)
+        (n,) = _struct.unpack("<I", framed[:4])
+        assert n & _COMPRESS_BIT
+        assert len(framed) < _COMPRESS_MIN
+        # incompressible stays raw (no flag)
+        import os as _os
+        framed = _pack([0, 0, "s", "m", {"b": _os.urandom(8192)}])
+        (n,) = _struct.unpack("<I", framed[:4])
+        assert not n & _COMPRESS_BIT
+
+    def test_roundtrip_over_socket(self):
+        async def go():
+            from yugabyte_db_tpu.rpc import Messenger
+
+            class Echo:
+                async def rpc_echo(self, payload):
+                    return {"echo": payload["msg"]}
+
+            server = Messenger("comp-server")
+            server.register_service("svc", Echo())
+            addr = await server.start()
+            client = Messenger("comp-client")
+            big = "x" * 100_000 + "".join(str(i) for i in range(5000))
+            r = await client.call(addr, "svc", "echo", {"msg": big})
+            assert r == {"echo": big}
+            await client.shutdown()
+            await server.shutdown()
         run(go())
 
 

@@ -122,6 +122,7 @@ class RaftConsensus:
         self._lease_blocked_until = 0.0
         self._last_heartbeat = time.monotonic()
         self._election_deadline = self._new_election_deadline()
+        self._last_leader_contact = 0.0    # for pre-vote freshness checks
         self._commit_waiters: List[Tuple[int, asyncio.Future]] = []
         self.on_config_change = on_config_change
         # adopt the newest config entry already in the log (restart path)
@@ -170,7 +171,19 @@ class RaftConsensus:
             if time.monotonic() >= self._election_deadline:
                 await self._run_election()
 
+    def _min_election_timeout(self) -> float:
+        return flags.get("raft_heartbeat_interval_ms") / 1000.0 * 4
+
     async def _run_election(self):
+        # pre-vote (reference: raft_consensus.cc pre-elections): probe a
+        # majority WITHOUT bumping our term, so a partitioned or flaky
+        # node can't inflate terms and depose a healthy leader on rejoin
+        if len(self.config.peers) > 1:
+            if not await self._run_pre_vote():
+                self._election_deadline = self._new_election_deadline()
+                return
+            if self.meta.current_term != self._pre_vote_term - 1 or                     self.role == Role.LEADER:
+                return       # the world moved on during the pre-vote
         self.role = Role.CANDIDATE
         self.meta.current_term += 1
         self.meta.voted_for = self.uuid
@@ -208,6 +221,42 @@ class RaftConsensus:
             await self._become_leader()
         else:
             self.role = Role.FOLLOWER
+
+    async def _run_pre_vote(self) -> bool:
+        self._pre_vote_term = self.meta.current_term + 1
+        req = {
+            "term": self._pre_vote_term, "candidate": self.uuid,
+            "last_log_index": self.log.last_index,
+            "last_log_term": self.log.last_term,
+        }
+
+        async def ask(peer: PeerSpec):
+            try:
+                return await self.messenger.call(
+                    peer.addr, f"consensus-{self.tablet_id}",
+                    "request_pre_vote", req, timeout=1.0)
+            except (RpcError, asyncio.TimeoutError, OSError):
+                return None
+
+        results = await asyncio.gather(
+            *[ask(p) for p in self.config.others(self.uuid)])
+        grants = 1 + sum(1 for r in results if r and r.get("granted"))
+        return grants >= self.config.majority
+
+    async def rpc_request_pre_vote(self, req) -> dict:
+        """Grant without any durable state change: the candidate's log
+        must be up to date AND we must not have heard from a live
+        leader within the minimum election timeout."""
+        up_to_date = (
+            (req["last_log_term"], req["last_log_index"])
+            >= (self.log.last_term, self.log.last_index))
+        leader_fresh = (
+            self.role == Role.LEADER or
+            (time.monotonic() - self._last_leader_contact
+             < self._min_election_timeout()))
+        grant = (req["term"] > self.meta.current_term and up_to_date
+                 and not leader_fresh)
+        return {"term": self.meta.current_term, "granted": grant}
 
     async def rpc_request_vote(self, req) -> dict:
         term = req["term"]
@@ -256,8 +305,7 @@ class RaftConsensus:
             await self._advance_commit(self.log.last_index)
             self._lease_expiry = max(time.monotonic(),
                                      self._lease_blocked_until) + 3600.0
-        else:
-            self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
+        self._tasks.append(asyncio.create_task(self._heartbeat_loop()))
         await self._broadcast()
 
     # ------------------------------------------------------------------
@@ -314,6 +362,13 @@ class RaftConsensus:
                            "INVALID_ARGUMENT")
         payload = _json.dumps([[p.uuid, list(p.addr)]
                                for p in new_peers]).encode()
+        # growing out of a single-peer group: the "infinite" solo lease
+        # must shrink to a normal majority-renewed one
+        if not self.config.others(self.uuid) and len(new_peers) > 1:
+            self._lease_expiry = min(
+                self._lease_expiry,
+                time.monotonic()
+                + flags.get("leader_lease_duration_ms") / 1000.0)
         async with self._replicate_lock:
             idx = self.log.last_index + 1
             await self._append_local(LogEntry(
@@ -461,6 +516,7 @@ class RaftConsensus:
             await self._step_down(term)
         self.leader_uuid = req["leader"]
         self._election_deadline = self._new_election_deadline()
+        self._last_leader_contact = time.monotonic()
         self.clock.update(HybridTime(req["leader_ht"]))
         prev, prev_term = req["prev_index"], req["prev_term"]
         my_term = self.log.term_at(prev)

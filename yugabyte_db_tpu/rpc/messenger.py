@@ -16,6 +16,7 @@ import asyncio
 import itertools
 import logging
 import struct
+import zlib
 from typing import Any, Dict, Optional, Tuple
 
 import msgpack
@@ -25,6 +26,11 @@ _RESP = 1
 _ERR = 2
 
 _MAX_FRAME = 256 * 1024 * 1024
+# frames at/above this compress with zlib; flagged via the top length
+# bit (reference: rpc compression negotiation in rpc/secure_stream +
+# CompressedStream — ours is per-frame, stateless)
+_COMPRESS_MIN = 4 * 1024
+_COMPRESS_BIT = 0x8000_0000
 
 
 class RpcError(Exception):
@@ -35,7 +41,28 @@ class RpcError(Exception):
 
 def _pack(obj) -> bytes:
     raw = msgpack.packb(obj, use_bin_type=True, default=_default)
+    if len(raw) >= _COMPRESS_MIN:
+        comp = zlib.compress(raw, 1)
+        if len(comp) < len(raw):
+            return struct.pack("<I", len(comp) | _COMPRESS_BIT) + comp
     return struct.pack("<I", len(raw)) + raw
+
+
+async def _read_frame(reader) -> bytes:
+    hdr = await reader.readexactly(4)
+    (n,) = struct.unpack("<I", hdr)
+    compressed = bool(n & _COMPRESS_BIT)
+    n &= ~_COMPRESS_BIT
+    if n > _MAX_FRAME:
+        raise RpcError("oversized frame")
+    raw = await reader.readexactly(n)
+    if not compressed:
+        return raw
+    d = zlib.decompressobj()
+    out = d.decompress(raw, _MAX_FRAME)
+    if d.unconsumed_tail:
+        raise RpcError("oversized frame")   # decompression bomb
+    return out
 
 
 def _default(o):
@@ -66,11 +93,7 @@ class Connection:
     async def _read_loop(self):
         try:
             while True:
-                hdr = await self.reader.readexactly(4)
-                (n,) = struct.unpack("<I", hdr)
-                if n > _MAX_FRAME:
-                    raise RpcError("oversized frame")
-                raw = await self.reader.readexactly(n)
+                raw = await _read_frame(self.reader)
                 call_id, kind, _svc, _m, payload = msgpack.unpackb(
                     raw, raw=False)
                 fut = self.pending.pop(call_id, None)
@@ -176,11 +199,10 @@ class Messenger:
         self._incoming.add(writer)
         try:
             while True:
-                hdr = await reader.readexactly(4)
-                (n,) = struct.unpack("<I", hdr)
-                if n > _MAX_FRAME:
-                    break
-                raw = await reader.readexactly(n)
+                try:
+                    raw = await _read_frame(reader)
+                except RpcError:
+                    break              # oversized frame: drop the conn
                 msg = msgpack.unpackb(raw, raw=False)
                 asyncio.create_task(self._dispatch(msg, writer))
         except (asyncio.IncompleteReadError, ConnectionError, OSError):

@@ -77,6 +77,7 @@ class Tablet:
         # vector ANN indexes: col_id -> _VectorIndexState
         self.vector_indexes: Dict[int, _VectorIndexState] = {}
         self._lock = threading.Lock()
+        self._vector_build_lock = threading.Lock()   # serializes rebuilds
         ent = metrics.REGISTRY.entity("tablet", tablet_id,
                                       table=info.name)
         self._m_rows_written = ent.counter("rows_inserted")
@@ -236,6 +237,12 @@ class Tablet:
         build are carried over into the new state."""
         from ..ops.vector import IvfFlatIndex
         cid = self.info.schema.column_by_name(col_name).id
+        with self._vector_build_lock:
+            return self._build_vector_index_locked(
+                IvfFlatIndex, cid, col_name, nlists)
+
+    def _build_vector_index_locked(self, IvfFlatIndex, cid,
+                                   col_name, nlists) -> int:
         old = self.vector_indexes.get(cid)
         with self._lock:
             pending = dict(old.delta) if old else {}
@@ -256,6 +263,9 @@ class Tablet:
                 state.delta = {kk: v for kk, v in old.delta.items()
                                if pending.get(kk) is not v}
                 state.dead = (old.dead - deadsnap) & state.frozen_keys
+                # rows rewritten DURING the build exist in both places;
+                # the delta copy is newer — hide the frozen one
+                state.dead |= set(state.delta) & state.frozen_keys
             self.vector_indexes[cid] = state
         return len(pks)
 

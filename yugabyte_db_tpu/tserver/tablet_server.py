@@ -459,8 +459,12 @@ class TabletServer:
     # --- vector indexes ------------------------------------------------------
     async def rpc_build_vector_index(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
-        n = peer.tablet.build_vector_index(payload["column"],
-                                           payload.get("lists", 100))
+        # executor: the build (scan + k-means) must not stall the event
+        # loop, and the per-index build lock serializes it against the
+        # background fold which also runs in an executor thread
+        n = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: peer.tablet.build_vector_index(
+                payload["column"], payload.get("lists", 100)))
         return {"indexed": n}
 
     async def rpc_vector_search(self, payload) -> dict:
