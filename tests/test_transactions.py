@@ -228,3 +228,96 @@ class TestDeadlock:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestSerializable:
+    def test_write_skew_blocked_under_serializable(self, tmp_path):
+        """Classic write-skew: t1 reads A+B then writes A; t2 reads A+B
+        then writes B. Under SI both commit (anomaly). Under
+        SERIALIZABLE the read locks make the two writes conflict, so
+        one txn aborts (or waits for the other and then conflicts)."""
+        async def go():
+            mc, c = await make_cluster(str(tmp_path))
+            try:
+                t1 = await c.transaction("serializable").begin()
+                t2 = await c.transaction("serializable").begin()
+                # both read both keys (on-call constraint: A + B >= 100)
+                for t in (t1, t2):
+                    assert (await t.get("acct", {"k": 1}))["bal"] == 100.0
+                    assert (await t.get("acct", {"k": 2}))["bal"] == 100.0
+                # each writes the OTHER key — classic skew
+                outcomes = []
+                try:
+                    await t1.insert("acct", [{"k": 1, "bal": 0.0}])
+                    outcomes.append("t1w")
+                except RpcError:
+                    outcomes.append("t1-aborted")
+                try:
+                    await t2.insert("acct", [{"k": 2, "bal": 0.0}])
+                    await t2.commit()
+                    outcomes.append("t2c")
+                except RpcError:
+                    outcomes.append("t2-aborted")
+                if "t1w" in outcomes and t1.state == "PENDING":
+                    try:
+                        await t1.commit()
+                        outcomes.append("t1c")
+                    except RpcError:
+                        outcomes.append("t1-aborted")
+                # serializability: at most ONE of the two committed
+                committed = sum(1 for o in outcomes if o in ("t1c", "t2c"))
+                assert committed <= 1, outcomes
+                assert any("aborted" in o for o in outcomes), outcomes
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_serializable_smoke_no_conflict(self, tmp_path):
+        """Disjoint serializable txns proceed; read locks release on
+        commit so later writers aren't blocked."""
+        async def go():
+            mc, c = await make_cluster(str(tmp_path))
+            try:
+                t1 = await c.transaction("serializable").begin()
+                assert (await t1.get("acct", {"k": 3}))["bal"] == 100.0
+                await t1.insert("acct", [{"k": 3, "bal": 50.0}])
+                await t1.commit()
+                await asyncio.sleep(0.3)
+                assert (await c.get("acct", {"k": 3}))["bal"] == 50.0
+                # read locks are gone: a plain write succeeds immediately
+                await c.insert("acct", [{"k": 3, "bal": 75.0}])
+                assert (await c.get("acct", {"k": 3}))["bal"] == 75.0
+                # read-only serializable txn releases on commit too
+                t2 = await c.transaction("serializable").begin()
+                assert (await t2.get("acct", {"k": 4}))["bal"] == 100.0
+                await t2.commit()
+                await c.insert("acct", [{"k": 4, "bal": 1.0}])
+                assert (await c.get("acct", {"k": 4}))["bal"] == 1.0
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_write_skew_blocked_when_one_commits_first(self, tmp_path):
+        """The other skew interleaving: t2 reads A+B, writes B, commits —
+        all BEFORE t1 reads. t1 (older snapshot) must then fail its
+        serializable read of B (version committed after its snapshot):
+        read validation, not locks, closes this path."""
+        async def go():
+            mc, c = await make_cluster(str(tmp_path))
+            try:
+                t1 = await c.transaction("serializable").begin()
+                t2 = await c.transaction("serializable").begin()
+                assert (await t2.get("acct", {"k": 1}))["bal"] == 100.0
+                assert (await t2.get("acct", {"k": 2}))["bal"] == 100.0
+                await t2.insert("acct", [{"k": 2, "bal": 0.0}])
+                await t2.commit()
+                await asyncio.sleep(0.3)    # apply intents
+                # t1 reads under its OLDER snapshot: k=1 ok (unchanged),
+                # k=2 must abort (modified after t1's snapshot)
+                assert (await t1.get("acct", {"k": 1}))["bal"] == 100.0
+                with pytest.raises(RpcError):
+                    await t1.get("acct", {"k": 2})
+                assert t1.state != "PENDING"   # aborted client-side
+            finally:
+                await mc.shutdown()
+        run(go())

@@ -361,9 +361,9 @@ class YBClient:
         return hits[:k]
 
     # --- transactions ------------------------------------------------------
-    def transaction(self):
+    def transaction(self, isolation: str = "snapshot"):
         from .transaction import YBTransaction
-        return YBTransaction(self)
+        return YBTransaction(self, isolation=isolation)
 
     # --- leader routing with retry ---------------------------------------
     async def _call_leader(self, ct: CachedTable, tablet_id: str,

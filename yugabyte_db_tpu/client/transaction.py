@@ -20,14 +20,22 @@ PENDING, COMMITTED, ABORTED = "PENDING", "COMMITTED", "ABORTED"
 
 
 class YBTransaction:
-    def __init__(self, client: YBClient):
+    def __init__(self, client: YBClient, isolation: str = "snapshot"):
+        """isolation: "snapshot" (SI, first-committer-wins) or
+        "serializable" (reads take shared locks; write-after-read
+        conflicts — reference: IsolationLevel in common.proto,
+        SERIALIZABLE via read intents)."""
+        assert isolation in ("snapshot", "serializable")
         self.client = client
+        self.isolation = isolation
         self.txn_id: Optional[str] = None
         self.start_ht: Optional[int] = None
         self.state = "NEW"
         self._status_loc: Optional[TabletLocation] = None
         # participants: tablet_id -> [addrs]
         self._participants: Dict[str, List[List]] = {}
+        # tablets holding only our READ locks (need explicit release)
+        self._read_participants: Dict[str, List[List]] = {}
 
     # ------------------------------------------------------------------
     async def _status_tablet(self) -> TabletLocation:
@@ -125,8 +133,21 @@ class YBTransaction:
         payload = {"tablet_id": loc.tablet_id, "txn_id": self.txn_id,
                    "pk_row": pk_row, "read_ht": self.start_ht,
                    "table_id": ct.info.table_id}
-        r = await self.client._call_leader(ct, loc.tablet_id, "txn_get",
-                                           payload)
+        if self.isolation == "serializable":
+            status_loc = await self._status_tablet()
+            payload["serializable"] = True
+            payload["status_tablet"] = {
+                "tablet_id": status_loc.tablet_id,
+                "addrs": [list(a) for _, a in status_loc.replicas]}
+            self._read_participants[loc.tablet_id] = [
+                list(a) for _, a in loc.replicas]
+        try:
+            r = await self.client._call_leader(ct, loc.tablet_id,
+                                               "txn_get", payload)
+        except RpcError as e:
+            if e.code in ("ABORTED", "DEADLOCK"):
+                await self.abort()
+            raise
         row = r.get("row")
         if row is not None and r.get("from_intent"):
             # intents store only written columns; merge over snapshot? For
@@ -143,6 +164,7 @@ class YBTransaction:
             "txn_commit", {"txn_id": self.txn_id,
                            "participants": participants})
         self.state = COMMITTED
+        await self._release_read_locks()
         return resp["commit_ht"]
 
     async def abort(self) -> None:
@@ -156,3 +178,22 @@ class YBTransaction:
                               "participants": participants})
         finally:
             self.state = ABORTED
+            await self._release_read_locks()
+
+    async def _release_read_locks(self) -> None:
+        """Read-only participants never see apply/rollback, so their
+        shared locks release here (best effort: a leaked lock resolves
+        via the blocker status probe once this txn is decided)."""
+        for tablet_id, addrs in self._read_participants.items():
+            if tablet_id in self._participants:
+                continue           # writer participant releases on apply
+            for addr in addrs:
+                try:
+                    await self.client.messenger.call(
+                        tuple(addr), "tserver", "txn_release_reads",
+                        {"tablet_id": tablet_id, "txn_id": self.txn_id},
+                        timeout=5.0)
+                    break
+                except (RpcError, OSError, asyncio.TimeoutError):
+                    continue
+        self._read_participants.clear()

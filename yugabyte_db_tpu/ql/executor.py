@@ -117,7 +117,8 @@ class SqlSession:
         if stmt.kind == "begin":
             if self._txn is not None:
                 raise ValueError("transaction already in progress")
-            self._txn = await self.client.transaction().begin()
+            self._txn = await self.client.transaction(
+                getattr(stmt, "isolation", "snapshot")).begin()
             return SqlResult([], "BEGIN")
         if self._txn is None:
             raise ValueError("no transaction in progress")

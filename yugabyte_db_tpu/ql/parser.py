@@ -103,6 +103,7 @@ class InsertStmt:
 @dataclass
 class TxnStmt:
     kind: str   # 'begin' | 'commit' | 'rollback'
+    isolation: str = "snapshot"
 
 
 @dataclass
@@ -348,7 +349,25 @@ class Parser:
     def txn_stmt(self):
         t = self.next()[1].lower()
         self.accept_kw("transaction")
-        return TxnStmt(t)
+        iso = "snapshot"
+        # BEGIN [TRANSACTION] [ISOLATION LEVEL] (SERIALIZABLE|SNAPSHOT)
+        if t == "begin" and self.peek() is not None                 and self.peek()[0] in ("kw", "id"):
+            words = []
+            while self.peek() is not None and self.peek()[0] in ("kw", "id"):
+                words.append(self.next()[1].lower())
+            forms = {
+                ("serializable",): "serializable",
+                ("isolation", "level", "serializable"): "serializable",
+                ("snapshot",): "snapshot",
+                ("isolation", "level", "snapshot"): "snapshot",
+            }
+            if tuple(words) not in forms:
+                raise ValueError(
+                    f"unsupported BEGIN options {' '.join(words)!r} "
+                    f"(try: BEGIN [TRANSACTION] [ISOLATION LEVEL] "
+                    f"SERIALIZABLE)")
+            iso = forms[tuple(words)]
+        return TxnStmt(t, isolation=iso)
 
     def literal(self):
         t = self.next()

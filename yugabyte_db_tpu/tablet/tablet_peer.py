@@ -188,6 +188,16 @@ class TabletPeer:
         return await self.participant.write_intents(
             req, txn_id, start_ht, status_tablet)
 
+    async def lock_reads(self, keys, txn_id: str, start_ht: int,
+                         status_tablet=None) -> None:
+        """SERIALIZABLE read locks on doc keys (leader only)."""
+        if not self.consensus.is_leader():
+            raise RpcError(
+                f"not leader (hint={self.consensus.leader_hint()})",
+                "LEADER_NOT_READY")
+        await self.participant.read_intents(keys, txn_id, start_ht,
+                                            status_tablet)
+
     async def apply_txn(self, txn_id: str, commit_ht: int):
         import msgpack as _mp
         await self.consensus.replicate("txn_apply", _mp.packb(

@@ -225,6 +225,13 @@ class TransactionParticipant:
         # txn_id -> {doc_key -> RowOp wire}
         self._intents: Dict[str, Dict[bytes, list]] = {}
         self._key_holder: Dict[bytes, str] = {}       # doc_key -> txn_id
+        # SERIALIZABLE read locks (reference: kStrongRead intents in
+        # docdb/intent.h; conflict matrix conflict_resolution.cc):
+        # shared among readers, conflicting with writers. Held in leader
+        # memory — a failover drops them, like the reference's wait
+        # queue state (intents themselves are the durable part).
+        self._read_holders: Dict[bytes, Set[str]] = {}
+        self._txn_reads: Dict[str, Set[bytes]] = {}
         self._txn_meta: Dict[str, dict] = {}          # txn_id -> {start_ht}
         self._waiters: List[_Waiter] = []
         self.wait_timeout = 5.0
@@ -307,6 +314,63 @@ class TransactionParticipant:
             stack.extend(edges.get(t, ()))
         return False
 
+    async def read_intents(self, keys: List[bytes], txn_id: str,
+                           start_ht: int, status_tablet=None) -> None:
+        """SERIALIZABLE read locks: wait until no OTHER txn holds a
+        write claim on `keys`, then register shared read holds (readers
+        never block readers). Write-after-read then conflicts in
+        _resolve_conflicts, closing write-skew (reference: SERIALIZABLE
+        via read intents, docdb/conflict_resolution.cc)."""
+        deadline = time.monotonic() + self.wait_timeout
+        if status_tablet:
+            self._txn_meta.setdefault(txn_id, {})["status_tablet"] =                 status_tablet
+        while True:
+            blockers = {self._key_holder[k] for k in keys
+                        if k in self._key_holder
+                        and self._key_holder[k] != txn_id}
+            if not blockers:
+                # read validation first: if the key has a version
+                # committed AFTER our snapshot, our read would return
+                # stale state that no write-side check would ever catch
+                # (the other txn is already gone) — abort instead
+                # (reference: read-time conflict in conflict_resolution)
+                for k in keys:
+                    committed = self._newest_committed_ht(k)
+                    if committed is not None and start_ht and                             committed > start_ht:
+                        raise RpcError(
+                            f"txn {txn_id} serializable read conflict: "
+                            f"key modified at {committed} after snapshot "
+                            f"{start_ht}", "ABORTED")
+                # register synchronously (no await) so a racing writer
+                # sees the read hold
+                reads = self._txn_reads.setdefault(txn_id, set())
+                self._txn_meta.setdefault(txn_id, {"start_ht": start_ht})
+                for k in keys:
+                    self._read_holders.setdefault(k, set()).add(txn_id)
+                    reads.add(k)
+                return
+            if self._would_deadlock(txn_id, blockers):
+                raise RpcError(
+                    f"txn {txn_id} would deadlock (cycle via {blockers})",
+                    "DEADLOCK")
+            if time.monotonic() >= deadline:
+                raise RpcError(
+                    f"txn {txn_id} read-lock timeout "
+                    f"(blockers={blockers})", "ABORTED")
+            w = _Waiter(txn_id, start_ht, asyncio.Event(), blockers)
+            self._waiters.append(w)
+            try:
+                await asyncio.wait_for(
+                    w.event.wait(),
+                    min(0.5, max(deadline - time.monotonic(), 0.01)))
+            except asyncio.TimeoutError:
+                pass
+            finally:
+                if w in self._waiters:
+                    self._waiters.remove(w)
+            for blocker in list(blockers):
+                await self._maybe_resolve_blocker(blocker)
+
     async def _resolve_conflicts(self, txn_id: str, start_ht: int,
                                  keys: List[bytes]):
         """WAIT_ON_CONFLICT with wound-wait flavored priority (older txn
@@ -319,6 +383,8 @@ class TransactionParticipant:
             blockers = {self._key_holder[k] for k in keys
                         if k in self._key_holder
                         and self._key_holder[k] != txn_id}
+            for k in keys:            # SERIALIZABLE read locks block writes
+                blockers |= self._read_holders.get(k, set()) - {txn_id}
             if not blockers:
                 # claim NOW, before any await, so a concurrent writer of
                 # the same keys sees the conflict
@@ -435,7 +501,22 @@ class TransactionParticipant:
                       PrimitiveValue.tombstone().encode())
         if batch.entries:
             self.tablet.intents.apply(batch)
+        self.release_reads(txn_id)
         self._txn_meta.pop(txn_id, None)
+        for w in self._waiters:
+            if txn_id in w.blockers:
+                w.event.set()
+
+    def release_reads(self, txn_id: str) -> None:
+        """Drop a txn's read locks (client-driven at commit/abort for
+        read-only participants; writer participants release via
+        apply/rollback)."""
+        for k in self._txn_reads.pop(txn_id, ()):
+            holders = self._read_holders.get(k)
+            if holders:
+                holders.discard(txn_id)
+                if not holders:
+                    del self._read_holders[k]
         for w in self._waiters:
             if txn_id in w.blockers:
                 w.event.set()

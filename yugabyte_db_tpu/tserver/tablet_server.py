@@ -406,6 +406,15 @@ class TabletServer:
         await peer.apply_txn(payload["txn_id"], payload["commit_ht"])
         return {"ok": True}
 
+    async def rpc_txn_release_reads(self, payload) -> dict:
+        peer = self._peer(payload["tablet_id"])
+        if not peer.is_leader():
+            # locks live only in leader memory; a follower "ok" would
+            # leave them held
+            raise RpcError("not leader", "LEADER_NOT_READY")
+        peer.participant.release_reads(payload["txn_id"])
+        return {"ok": True}
+
     async def rpc_rollback_txn(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
         if not peer.is_leader():
@@ -415,9 +424,17 @@ class TabletServer:
 
     async def rpc_txn_get(self, payload) -> dict:
         """Point get inside a txn: own-intent overlay, else snapshot read
-        at the txn start time."""
+        at the txn start time. Under SERIALIZABLE the read takes a
+        shared read lock first, so later writers conflict (write-skew
+        protection)."""
         from ..docdb.operations import ReadRequest
         peer = self._peer(payload["tablet_id"])
+        if payload.get("serializable"):
+            codec = peer.tablet._codec_for(payload.get("table_id", ""))
+            key = codec.doc_key_prefix(payload["pk_row"])
+            await peer.lock_reads([key], payload["txn_id"],
+                                  payload.get("read_ht") or 0,
+                                  payload.get("status_tablet"))
         own = peer.read_own_intent(payload["txn_id"], payload["pk_row"],
                                    payload.get("table_id", ""))
         if own is not None:
