@@ -299,3 +299,48 @@ class TestTlsRpc:
             await plain.shutdown()
             await server.shutdown()
         run(go())
+
+
+class TestObservers:
+    def test_observer_replicates_but_does_not_vote_or_commit(self, tmp_path):
+        """A 2-voter + 1-observer group: the observer applies the log,
+        but majority is over VOTERS (2), so losing one voter blocks
+        commits even with the observer alive — and the observer never
+        campaigns."""
+        async def go():
+            h = RaftHarness(tmp_path, n=3)
+            # build config manually: n2 is a non-voting observer
+            messengers = {}
+            addrs = {}
+            for i in range(3):
+                uuid = f"n{i}"
+                m = Messenger(uuid)
+                await m.start()
+                messengers[uuid] = m
+                addrs[uuid] = m.addr
+            config = RaftConfig(
+                [PeerSpec("n0", addrs["n0"]), PeerSpec("n1", addrs["n1"]),
+                 PeerSpec("n2", addrs["n2"], "observer")])
+            for uuid, m in messengers.items():
+                await h._start_node(uuid, m, config)
+            leader = await h.leader()
+            assert leader.uuid != "n2"
+            await leader.replicate("write", b"seen-by-all")
+            for _ in range(100):
+                if h.applied["n2"] == [b"seen-by-all"]:
+                    break
+                await asyncio.sleep(0.05)
+            assert h.applied["n2"] == [b"seen-by-all"]   # observer applies
+            assert leader.config.majority == 2           # voters only
+            # drop the voter follower: observer alone can't form majority
+            voter_follower = next(u for u in ("n0", "n1")
+                                  if u != leader.uuid)
+            await h.stop_node(voter_follower)
+            from yugabyte_db_tpu.rpc import RpcError
+            with pytest.raises((RpcError, asyncio.TimeoutError)):
+                await asyncio.wait_for(
+                    leader.replicate("write", b"blocked", timeout=2.0), 4.0)
+            # observer never became a candidate/leader
+            assert h.nodes["n2"].role == Role.FOLLOWER
+            await h.shutdown()
+        run(go())

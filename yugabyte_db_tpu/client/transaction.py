@@ -155,6 +155,42 @@ class YBTransaction:
             return row
         return row
 
+    async def lock_rows(self, table: str, pk_rows) -> int:
+        """Take SERIALIZABLE read locks on specific rows (the SQL layer
+        locks a SELECT's read set with this). No-op under snapshot."""
+        if self.isolation != "serializable" or not pk_rows:
+            return 0
+        assert self.state == PENDING
+        ct = await self.client._table(table)
+        status_loc = await self._status_tablet()
+        status_info = {"tablet_id": status_loc.tablet_id,
+                       "addrs": [list(a) for _, a in status_loc.replicas]}
+        by_tablet: Dict[str, list] = {}
+        for row in pk_rows:
+            loc = self.client._tablet_for_key(ct, row)
+            by_tablet.setdefault(loc.tablet_id, []).append(row)
+
+        async def send(tablet_id, rows):
+            loc = next(l for l in ct.locations if l.tablet_id == tablet_id)
+            self._read_participants[tablet_id] = [
+                list(a) for _, a in loc.replicas]
+            r = await self.client._call_leader(
+                ct, tablet_id, "txn_lock_rows",
+                {"tablet_id": tablet_id, "txn_id": self.txn_id,
+                 "read_ht": self.start_ht, "rows": rows,
+                 "table_id": ct.info.table_id,
+                 "status_tablet": status_info})
+            return r["locked"]
+
+        try:
+            results = await asyncio.gather(
+                *[send(t, rows) for t, rows in by_tablet.items()])
+        except RpcError as e:
+            if e.code in ("ABORTED", "DEADLOCK"):
+                await self.abort()
+            raise
+        return sum(results)
+
     # ------------------------------------------------------------------
     async def commit(self) -> int:
         assert self.state == PENDING
@@ -187,12 +223,12 @@ class YBTransaction:
         for tablet_id, addrs in self._read_participants.items():
             if tablet_id in self._participants:
                 continue           # writer participant releases on apply
-            for addr in addrs:
-                try:
-                    await self.client.messenger.call(
+            for addr in addrs:     # short timeout: best-effort cleanup —
+                try:               # a leaked lock resolves via the
+                    await self.client.messenger.call(   # status probe
                         tuple(addr), "tserver", "txn_release_reads",
                         {"tablet_id": tablet_id, "txn_id": self.txn_id},
-                        timeout=5.0)
+                        timeout=1.0)
                     break
                 except (RpcError, OSError, asyncio.TimeoutError):
                     continue

@@ -46,6 +46,10 @@ class Role:
 class PeerSpec:
     uuid: str
     addr: Tuple[str, int]
+    # "voter" | "observer" — observers replicate and apply but neither
+    # vote nor count toward commit (reference: PRE_OBSERVER/OBSERVER
+    # member types, consensus/metadata.proto; learner promotion flow)
+    role: str = "voter"
 
 
 @dataclass
@@ -56,8 +60,18 @@ class RaftConfig:
         return [p for p in self.peers if p.uuid != uuid]
 
     @property
+    def voters(self) -> List[PeerSpec]:
+        return [p for p in self.peers if p.role == "voter"]
+
+    def voter_others(self, uuid: str) -> List[PeerSpec]:
+        return [p for p in self.voters if p.uuid != uuid]
+
+    def is_voter(self, uuid: str) -> bool:
+        return any(p.uuid == uuid for p in self.voters)
+
+    @property
     def majority(self) -> int:
-        return len(self.peers) // 2 + 1
+        return len(self.voters) // 2 + 1
 
 
 class ConsensusMetadata:
@@ -148,8 +162,8 @@ class RaftConsensus:
     async def start(self):
         self._running = True
         self._tasks.append(asyncio.create_task(self._election_loop()))
-        # single-peer groups elect themselves instantly
-        if len(self.config.peers) == 1:
+        # single-VOTER groups (sole voter = us) elect themselves
+        if len(self.config.voters) == 1 and self.config.is_voter(self.uuid):
             await self._become_leader()
 
     async def shutdown(self):
@@ -168,6 +182,9 @@ class RaftConsensus:
             await asyncio.sleep(0.01)
             if self.role == Role.LEADER:
                 continue
+            if not self.config.is_voter(self.uuid):
+                self._election_deadline = self._new_election_deadline()
+                continue               # observers never campaign
             if time.monotonic() >= self._election_deadline:
                 await self._run_election()
 
@@ -206,7 +223,7 @@ class RaftConsensus:
                 return None
 
         results = await asyncio.gather(
-            *[ask(p) for p in self.config.others(self.uuid)])
+            *[ask(p) for p in self.config.voter_others(self.uuid)])
         if self.meta.current_term != term or self.role != Role.CANDIDATE:
             return
         for r in results:
@@ -239,7 +256,7 @@ class RaftConsensus:
                 return None
 
         results = await asyncio.gather(
-            *[ask(p) for p in self.config.others(self.uuid)])
+            *[ask(p) for p in self.config.voter_others(self.uuid)])
         grants = 1 + sum(1 for r in results if r and r.get("granted"))
         return grants >= self.config.majority
 
@@ -259,6 +276,8 @@ class RaftConsensus:
         return {"term": self.meta.current_term, "granted": grant}
 
     async def rpc_request_vote(self, req) -> dict:
+        if not self.config.is_voter(self.uuid):
+            return {"term": self.meta.current_term, "granted": False}
         term = req["term"]
         if term < self.meta.current_term:
             return {"term": self.meta.current_term, "granted": False}
@@ -341,8 +360,9 @@ class RaftConsensus:
     # ------------------------------------------------------------------
     def _adopt_config(self, payload: bytes, notify: bool = True):
         import json as _json
-        peers = [PeerSpec(u, tuple(a))
-                 for u, a in _json.loads(payload.decode())]
+        peers = [PeerSpec(e[0], tuple(e[1]),
+                          e[2] if len(e) > 2 else "voter")
+                 for e in _json.loads(payload.decode())]
         self.config = RaftConfig(peers)
         for p in self.config.others(self.uuid):
             self.next_index.setdefault(p.uuid, self.log.last_index + 1)
@@ -357,14 +377,23 @@ class RaftConsensus:
             raise RpcError("not leader", "LEADER_NOT_READY")
         cur = {p.uuid for p in self.config.peers}
         new = {p.uuid for p in new_peers}
-        if len(cur.symmetric_difference(new)) > 1:
-            raise RpcError("only single-server membership changes",
+        membership_changes = len(cur.symmetric_difference(new))
+        cur_roles = {p.uuid: p.role for p in self.config.peers}
+        role_changes = sum(1 for p in new_peers
+                           if p.uuid in cur_roles
+                           and cur_roles[p.uuid] != p.role)
+        # one server OR one role flip per config entry — a combined or
+        # multi-role change can create disjoint voter majorities against
+        # a stale-config peer mid-transition
+        if membership_changes + role_changes > 1:
+            raise RpcError("only single-server membership/role changes",
                            "INVALID_ARGUMENT")
-        payload = _json.dumps([[p.uuid, list(p.addr)]
+        payload = _json.dumps([[p.uuid, list(p.addr), p.role]
                                for p in new_peers]).encode()
         # growing out of a single-peer group: the "infinite" solo lease
         # must shrink to a normal majority-renewed one
-        if not self.config.others(self.uuid) and len(new_peers) > 1:
+        new_voters = [p for p in new_peers if p.role == "voter"]
+        if len(self.config.voters) == 1 and len(new_voters) > 1:
             self._lease_expiry = min(
                 self._lease_expiry,
                 time.monotonic()
@@ -410,11 +439,14 @@ class RaftConsensus:
     async def _broadcast(self):
         if self.role != Role.LEADER or not self.config.others(self.uuid):
             return
+        peers = self.config.others(self.uuid)
         acks = await asyncio.gather(
-            *[self._replicate_to(p) for p in self.config.others(self.uuid)])
-        # lease renews only on a FRESH majority ack in this round
+            *[self._replicate_to(p) for p in peers])
+        # lease renews only on a FRESH VOTER-majority ack in this round
         # (cumulative match_index is not evidence of current reachability)
-        if 1 + sum(1 for a in acks if a) >= self.config.majority:
+        voter_acks = sum(1 for p, a in zip(peers, acks)
+                         if a and p.role == "voter")
+        if 1 + voter_acks >= self.config.majority:
             now = time.monotonic()
             if now >= self._lease_blocked_until:
                 self._lease_expiry = now + \
@@ -459,7 +491,7 @@ class RaftConsensus:
         matches = sorted(
             [self.log.last_index] +
             [self.match_index.get(p.uuid, 0)
-             for p in self.config.others(self.uuid)],
+             for p in self.config.voter_others(self.uuid)],
             reverse=True)
         candidate = matches[self.config.majority - 1]
         # only commit entries from the current term directly (Raft §5.4.2)

@@ -81,10 +81,24 @@ class ClusterLoadBalancer:
         table = m.tables[ent["table_id"]]["info"]
         new_replicas = [u for u in ent["replicas"] if u != from_uuid] \
             + [to_uuid]
-        new_peers = [[u, list(m.tservers[u]["addr"])] for u in new_replicas
-                     if u in m.tservers]
-        add_peers = [[u, list(m.tservers[u]["addr"])]
-                     for u in ent["replicas"] if u in m.tservers] \
+        # preserve roles recorded in the catalog: an observer left by an
+        # interrupted earlier move must not be silently promoted here
+        observers = set(ent.get("observers", []))
+
+        def peer(u):
+            e = [u, list(m.tservers[u]["addr"])]
+            return e + ["observer"] if u in observers else e
+
+        new_peers = [peer(u) for u in new_replicas
+                     if u in m.tservers and u != to_uuid] \
+            + [[to_uuid, list(m.tservers[to_uuid]["addr"])]]
+        cur_peers = [peer(u) for u in ent["replicas"] if u in m.tservers]
+        # the destination joins as a non-voting OBSERVER first so a slow
+        # catch-up can never degrade commit availability (reference:
+        # PRE_OBSERVER add + promotion in the LB / raft_consensus)
+        learner_peers = cur_peers \
+            + [[to_uuid, list(m.tservers[to_uuid]["addr"]), "observer"]]
+        add_peers = cur_peers \
             + [[to_uuid, list(m.tservers[to_uuid]["addr"])]]
         try:
             # 0. checkpoint the current leader so the new replica can
@@ -109,17 +123,23 @@ class ClusterLoadBalancer:
                 m.tservers[to_uuid]["addr"], "tserver", "create_tablet",
                 {"tablet_id": tablet_id,
                  "table": dict(table, table_id=ent["table_id"]),
-                 "partition": ent["partition"], "raft_peers": add_peers,
+                 "partition": ent["partition"], "raft_peers": learner_peers,
                  "remote_bootstrap": rb},
                 timeout=60.0)
-            # 2. leader adds the new peer
-            await self._leader_change_config(ent, tablet_id, add_peers)
+            # 2. leader adds the new peer as a LEARNER (observer)
+            await self._leader_change_config(ent, tablet_id, learner_peers)
             ent["replicas"] = list(dict.fromkeys(
                 ent["replicas"] + [to_uuid]))
+            ent["observers"] = sorted(observers | {to_uuid})
             await m._commit_catalog([["put_tablet", tablet_id, ent]])
             # 3. wait until the new peer has the whole log
             await self._leader_call(ent, tablet_id, "wait_catchup",
                                     {"peer_uuid": to_uuid})
+            # 3b. promote learner -> voter (same peer set, role change)
+            await self._leader_change_config(ent, tablet_id, add_peers)
+            observers.discard(to_uuid)
+            ent["observers"] = sorted(observers)
+            await m._commit_catalog([["put_tablet", tablet_id, ent]])
             # 4. then remove the old peer
             await self._leader_change_config(ent, tablet_id, new_peers)
             # 5. drop the replica on the source

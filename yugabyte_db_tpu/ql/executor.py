@@ -243,11 +243,27 @@ class SqlSession:
         # plain row scan; LIMIT pushes down only when no client-side
         # reordering/dedup/offset must happen first
         columns = self._needed_columns(stmt, schema)
+        serializable = (self._txn is not None
+                        and self._txn.isolation == "serializable")
+        if serializable:
+            # pk columns must come back so the read set can be locked
+            columns = list(dict.fromkeys(
+                list(columns) + [c.name for c in schema.key_columns]))
         push_limit = (None if (stmt.order_by or stmt.distinct or stmt.offset)
                       else stmt.limit)
-        resp = await self.client.scan(stmt.table, ReadRequest(
-            "", columns=tuple(columns), where=where, read_ht=read_ht,
-            limit=push_limit))
+        req = ReadRequest("", columns=tuple(columns), where=where,
+                          read_ht=read_ht, limit=push_limit)
+        resp = await self.client.scan(stmt.table, req)
+        if serializable and resp.rows:
+            # lock the read set, then re-read under the locks so the
+            # returned rows are stable (row-level serializability;
+            # predicate/phantom locks are out of scope this round —
+            # same row-level granularity the reference takes intents at)
+            pk_names = [c.name for c in schema.key_columns]
+            await self._txn.lock_rows(
+                stmt.table,
+                [{n: r[n] for n in pk_names} for r in resp.rows])
+            resp = await self.client.scan(stmt.table, req)
         rows = [self._project_row(stmt, r, schema) for r in resp.rows]
         rows = self._order_limit(stmt, rows)
         return SqlResult(rows)

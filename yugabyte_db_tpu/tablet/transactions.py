@@ -321,55 +321,36 @@ class TransactionParticipant:
         never block readers). Write-after-read then conflicts in
         _resolve_conflicts, closing write-skew (reference: SERIALIZABLE
         via read intents, docdb/conflict_resolution.cc)."""
-        deadline = time.monotonic() + self.wait_timeout
         if status_tablet:
-            self._txn_meta.setdefault(txn_id, {})["status_tablet"] =                 status_tablet
-        while True:
-            blockers = {self._key_holder[k] for k in keys
-                        if k in self._key_holder
-                        and self._key_holder[k] != txn_id}
-            if not blockers:
-                # read validation first: if the key has a version
-                # committed AFTER our snapshot, our read would return
-                # stale state that no write-side check would ever catch
-                # (the other txn is already gone) — abort instead
-                # (reference: read-time conflict in conflict_resolution)
-                for k in keys:
-                    committed = self._newest_committed_ht(k)
-                    if committed is not None and start_ht and                             committed > start_ht:
-                        raise RpcError(
-                            f"txn {txn_id} serializable read conflict: "
-                            f"key modified at {committed} after snapshot "
-                            f"{start_ht}", "ABORTED")
-                # register synchronously (no await) so a racing writer
-                # sees the read hold
-                reads = self._txn_reads.setdefault(txn_id, set())
-                self._txn_meta.setdefault(txn_id, {"start_ht": start_ht})
-                for k in keys:
-                    self._read_holders.setdefault(k, set()).add(txn_id)
-                    reads.add(k)
-                return
-            if self._would_deadlock(txn_id, blockers):
-                raise RpcError(
-                    f"txn {txn_id} would deadlock (cycle via {blockers})",
-                    "DEADLOCK")
-            if time.monotonic() >= deadline:
-                raise RpcError(
-                    f"txn {txn_id} read-lock timeout "
-                    f"(blockers={blockers})", "ABORTED")
-            w = _Waiter(txn_id, start_ht, asyncio.Event(), blockers)
-            self._waiters.append(w)
-            try:
-                await asyncio.wait_for(
-                    w.event.wait(),
-                    min(0.5, max(deadline - time.monotonic(), 0.01)))
-            except asyncio.TimeoutError:
-                pass
-            finally:
-                if w in self._waiters:
-                    self._waiters.remove(w)
-            for blocker in list(blockers):
-                await self._maybe_resolve_blocker(blocker)
+            self._txn_meta.setdefault(txn_id, {})["status_tablet"] = \
+                status_tablet
+
+        def blockers_of():
+            return {self._key_holder[k] for k in keys
+                    if k in self._key_holder
+                    and self._key_holder[k] != txn_id}
+
+        def on_clear():
+            # read validation first: if the key has a version committed
+            # AFTER our snapshot, our read would return stale state that
+            # no write-side check would ever catch (the other txn is
+            # already gone) — abort instead
+            for k in keys:
+                committed = self._newest_committed_ht(k)
+                if committed is not None and start_ht and \
+                        committed > start_ht:
+                    raise RpcError(
+                        f"txn {txn_id} serializable read conflict: "
+                        f"key modified at {committed} after snapshot "
+                        f"{start_ht}", "ABORTED")
+            reads = self._txn_reads.setdefault(txn_id, set())
+            self._txn_meta.setdefault(txn_id, {"start_ht": start_ht})
+            for k in keys:
+                self._read_holders.setdefault(k, set()).add(txn_id)
+                reads.add(k)
+
+        await self._wait_for_unblock(txn_id, start_ht, blockers_of,
+                                     on_clear, "read-lock")
 
     async def _resolve_conflicts(self, txn_id: str, start_ht: int,
                                  keys: List[bytes]):
@@ -378,21 +359,35 @@ class TransactionParticipant:
         local wait-for cycle aborts the waiter; otherwise a timeout
         breaks cross-tablet cycles; reference policies:
         tablet/write_query.cc:757-802, wait queue docdb/wait_queue.cc."""
-        deadline = time.monotonic() + self.wait_timeout
-        while True:
+        def blockers_of():
             blockers = {self._key_holder[k] for k in keys
                         if k in self._key_holder
                         and self._key_holder[k] != txn_id}
-            for k in keys:            # SERIALIZABLE read locks block writes
+            for k in keys:        # SERIALIZABLE read locks block writes
                 blockers |= self._read_holders.get(k, set()) - {txn_id}
+            return blockers
+
+        def on_clear():
+            # claim NOW, before any await, so a concurrent writer of
+            # the same keys sees the conflict
+            per_txn = self._intents.setdefault(txn_id, {})
+            self._txn_meta.setdefault(txn_id, {"start_ht": start_ht})
+            for k in keys:
+                self._key_holder[k] = txn_id
+                per_txn.setdefault(k, None)   # placeholder until apply
+        await self._wait_for_unblock(txn_id, start_ht, blockers_of,
+                                     on_clear, "conflict")
+
+    async def _wait_for_unblock(self, txn_id: str, start_ht: int,
+                                blockers_of, on_clear, what: str):
+        """Shared blocking primitive: loop until `blockers_of()` is
+        empty, then run `on_clear` SYNCHRONOUSLY (registration must not
+        await, or racing claimants would both pass)."""
+        deadline = time.monotonic() + self.wait_timeout
+        while True:
+            blockers = blockers_of()
             if not blockers:
-                # claim NOW, before any await, so a concurrent writer of
-                # the same keys sees the conflict
-                per_txn = self._intents.setdefault(txn_id, {})
-                self._txn_meta.setdefault(txn_id, {"start_ht": start_ht})
-                for k in keys:
-                    self._key_holder[k] = txn_id
-                    per_txn.setdefault(k, None)   # placeholder until apply
+                on_clear()
                 return
             if self._would_deadlock(txn_id, blockers):
                 raise RpcError(
@@ -400,7 +395,7 @@ class TransactionParticipant:
                     "DEADLOCK")
             if time.monotonic() >= deadline:
                 raise RpcError(
-                    f"txn {txn_id} conflict timeout (blockers={blockers})",
+                    f"txn {txn_id} {what} timeout (blockers={blockers})",
                     "ABORTED")
             w = _Waiter(txn_id, start_ht, asyncio.Event(), blockers)
             self._waiters.append(w)

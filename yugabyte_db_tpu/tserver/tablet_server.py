@@ -91,15 +91,17 @@ class TabletServer:
                         colocated=meta.get("colocated", False))
         for tw in meta.get("colocated_tables", []):
             tablet.add_table(TableInfo.from_wire(tw))
-        config = RaftConfig([PeerSpec(u, tuple(a))
-                             for u, a in meta["raft_peers"]])
+        config = RaftConfig([PeerSpec(e[0], tuple(e[1]),
+                                      e[2] if len(e) > 2 else "voter")
+                             for e in meta["raft_peers"]])
         peer = TabletPeer(tablet, self.uuid, config, self.messenger,
                           clock=self.clock,
                           is_status_tablet=meta.get("is_status_tablet",
                                                     False))
 
         def persist_config(cfg, tablet_id=tablet_id, meta=meta):
-            meta["raft_peers"] = [[p.uuid, list(p.addr)] for p in cfg.peers]
+            meta["raft_peers"] = [[p.uuid, list(p.addr), p.role]
+                                  for p in cfg.peers]
             path = os.path.join(self._tablet_dir(tablet_id),
                                 "tablet-meta.json")
             with open(path, "w") as f:
@@ -266,7 +268,9 @@ class TabletServer:
     # --- membership / leadership --------------------------------------------
     async def rpc_change_config(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
-        new_peers = [PeerSpec(u, tuple(a)) for u, a in payload["peers"]]
+        new_peers = [PeerSpec(e[0], tuple(e[1]),
+                              e[2] if len(e) > 2 else "voter")
+                     for e in payload["peers"]]
         idx = await peer.consensus.change_config(new_peers)
         return {"index": idx}
 
@@ -405,6 +409,18 @@ class TabletServer:
             raise RpcError("not leader", "LEADER_NOT_READY")
         await peer.apply_txn(payload["txn_id"], payload["commit_ht"])
         return {"ok": True}
+
+    async def rpc_txn_lock_rows(self, payload) -> dict:
+        """Bulk SERIALIZABLE read locks for rows a txn scanned (the SQL
+        SELECT read-set; reference: row-level read intents taken by
+        serializable reads in docdb)."""
+        peer = self._peer(payload["tablet_id"])
+        codec = peer.tablet._codec_for(payload.get("table_id", ""))
+        keys = [codec.doc_key_prefix(r) for r in payload["rows"]]
+        await peer.lock_reads(keys, payload["txn_id"],
+                              payload.get("read_ht") or 0,
+                              payload.get("status_tablet"))
+        return {"locked": len(keys)}
 
     async def rpc_txn_release_reads(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
