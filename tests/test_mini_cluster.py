@@ -171,3 +171,33 @@ class TestWriteBatching:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestScanPages:
+    def test_double_buffered_paging_streams_all_rows(self, tmp_path):
+        """scan_pages yields every row exactly once across page
+        boundaries and tablets, with the next page prefetched while the
+        consumer holds the current one."""
+        async def go():
+            from yugabyte_db_tpu.docdb import ReadRequest
+            from tests.test_load_balancer import kv_info
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                c = mc.client()
+                await c.create_table(kv_info(), num_tablets=2)
+                await mc.wait_for_leaders("kv")
+                await c.insert("kv", [{"k": i, "v": float(i)}
+                                      for i in range(57)])
+                seen = []
+                pages = 0
+                async for page in c.scan_pages(
+                        "kv", ReadRequest("", columns=("k",)),
+                        page_size=10):
+                    pages += 1
+                    assert len(page) <= 10
+                    seen.extend(r["k"] for r in page)
+                assert sorted(seen) == list(range(57))
+                assert pages >= 6     # 57 rows / 10 per page, 2 tablets
+            finally:
+                await mc.shutdown()
+        run(go())

@@ -466,3 +466,53 @@ class TestSqlSerializable:
             finally:
                 await mc.shutdown()
         run(go())
+
+    def test_sql_write_skew_blocked_with_aggregate_read(self, tmp_path):
+        """Same skew but the read is SELECT sum(...) — the aggregate
+        branch must lock its read set too (it scans pk rows first)."""
+        async def go():
+            from yugabyte_db_tpu.ql import SqlSession
+            from yugabyte_db_tpu.rpc import RpcError
+            from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s0 = SqlSession(mc.client())
+                await s0.execute("CREATE TABLE oncall (k bigint, "
+                                 "on_duty bigint, PRIMARY KEY (k))")
+                await mc.wait_for_leaders("oncall")
+                await s0.execute(
+                    "INSERT INTO oncall (k, on_duty) VALUES (1, 1), (2, 1)")
+                await mc.master.rpc_get_status_tablet({})
+                await mc.wait_for_leaders("system.transactions")
+                a = SqlSession(mc.client())
+                b = SqlSession(mc.client())
+                for s in (a, b):
+                    await s.execute("BEGIN ISOLATION LEVEL SERIALIZABLE")
+                outcomes = []
+
+                async def step(sess, tag, me):
+                    try:
+                        r = await sess.execute(
+                            "SELECT sum(on_duty) AS total FROM oncall")
+                        assert list(r.rows[0].values())[0] == 2
+                        await sess.execute(
+                            f"UPDATE oncall SET on_duty = 0 WHERE "
+                            f"k = {me}")
+                        await sess.execute("COMMIT")
+                        outcomes.append(f"{tag}-committed")
+                    except Exception:   # noqa: BLE001
+                        outcomes.append(f"{tag}-failed")
+                        try:
+                            await sess.execute("ROLLBACK")
+                        except Exception:
+                            pass
+
+                await asyncio.gather(step(a, "a", 1), step(b, "b", 2))
+                committed = [o for o in outcomes if o.endswith("committed")]
+                assert len(committed) <= 1, outcomes
+                r = await s0.execute(
+                    "SELECT sum(on_duty) AS total FROM oncall")
+                assert list(r.rows[0].values())[0] >= 1, (outcomes, r.rows)
+            finally:
+                await mc.shutdown()
+        run(go())

@@ -85,6 +85,43 @@ class TestCqlServer:
         run(go())
 
 
+class TestCqlSystemSchema:
+    def test_driver_metadata_discovery(self, tmp_path):
+        """system_schema.keyspaces/tables/columns reflect the live
+        catalog (reference: yql_*_vtable.cc virtual tables)."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            srv = CqlServer(mc.client())
+            addr = await srv.start()
+            try:
+                reader, writer = await asyncio.open_connection(*addr)
+                await cql_frame(writer, reader, 0x01, struct.pack(">H", 0))
+                await cql_frame(
+                    writer, reader, 0x07,
+                    longstr("CREATE TABLE md (k bigint, v double, "
+                            "PRIMARY KEY (k))"))
+                await mc.wait_for_leaders("md")
+                op, body = await cql_frame(
+                    writer, reader, 0x07,
+                    longstr("SELECT * FROM system_schema.tables"))
+                assert op == 0x08 and b"md" in body
+                op, body = await cql_frame(
+                    writer, reader, 0x07,
+                    longstr("SELECT * FROM system_schema.columns"))
+                assert op == 0x08
+                assert b"partition_key" in body and b"bigint" in body \
+                    and b"double" in body
+                op, body = await cql_frame(
+                    writer, reader, 0x07,
+                    longstr("SELECT * FROM system_schema.keyspaces"))
+                assert op == 0x08 and b"ybtpu" in body
+                writer.close()
+            finally:
+                await srv.shutdown()
+                await mc.shutdown()
+        run(go())
+
+
 class RedisClient:
     def __init__(self, reader, writer):
         self.reader, self.writer = reader, writer

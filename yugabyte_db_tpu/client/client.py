@@ -300,6 +300,41 @@ class YBClient:
         parts = await asyncio.gather(*[one(l) for l in ct.locations])
         return self._combine(req, parts)
 
+    async def scan_pages(self, table: str, req: ReadRequest,
+                         page_size: int = 1000):
+        """Streaming scan with DOUBLE-BUFFERED paging: while the caller
+        consumes page N, page N+1's RPC is already in flight (reference:
+        the prefetching PgDocOp pipeline, pggate/pg_doc_op.cc). Yields
+        lists of rows; tablets stream in location order."""
+        ct = await self._table(table)
+        req.table_id = ct.info.table_id
+
+        async def fetch(loc, paging):
+            r = ReadRequest(
+                req.table_id, columns=req.columns, where=req.where,
+                limit=page_size, paging_state=paging,
+                read_ht=req.read_ht, consistency=req.consistency)
+            payload = {"tablet_id": loc.tablet_id,
+                       "req": read_request_to_wire(r)}
+            return read_response_from_wire(await self._call_leader(
+                ct, loc.tablet_id, "read", payload))
+
+        nxt = None
+        try:
+            for loc in ct.locations:
+                nxt = asyncio.ensure_future(fetch(loc, None))
+                while nxt is not None:
+                    resp = await nxt
+                    nxt = (asyncio.ensure_future(
+                               fetch(loc, resp.paging_state))
+                           if resp.paging_state is not None else None)
+                    if resp.rows:
+                        yield resp.rows
+        finally:
+            # consumer broke out early: reap the in-flight prefetch
+            if nxt is not None and not nxt.done():
+                nxt.cancel()
+
     def _combine(self, req: ReadRequest, parts: List[ReadResponse]
                  ) -> ReadResponse:
         if not req.aggregates:

@@ -727,8 +727,11 @@ class Master:
         lo, hi = split_partition(p)
         split_key = lo.end.hex()
         left_id, right_id = f"{tablet_id}l", f"{tablet_id}r"
-        raft_peers = [[u, list(self.tservers[u]["addr"])]
-                      for u in ent["replicas"] if u in self.tservers]
+        observers = set(ent.get("observers", []))
+        raft_peers = [
+            [u, list(self.tservers[u]["addr"])]
+            + (["observer"] if u in observers else [])
+            for u in ent["replicas"] if u in self.tservers]
         # Catch-up barrier: every replica must hold the full log before
         # the replica-local split copies data (otherwise a lagging
         # follower's children miss recent writes and can win elections
@@ -757,6 +760,7 @@ class Master:
             ops.append(["put_tablet", child_id, {
                 "tablet_id": child_id, "table_id": table_id,
                 "partition": part, "replicas": list(ent["replicas"]),
+                "observers": sorted(observers),
                 "leader": None}])
         ops.append(["del_tablet", tablet_id])
         tent = dict(self.tables[table_id])

@@ -250,9 +250,55 @@ class CqlServer:
             return []
         return None
 
+    _CQL_TYPES = {
+        "bool": "boolean", "int32": "int", "int64": "bigint",
+        "float32": "float", "float64": "double",
+        "timestamp": "timestamp", "string": "text", "binary": "blob",
+        "json": "text", "decimal": "decimal",
+    }
+
+    async def _system_schema_rows(self, sql: str):
+        """system_schema.* virtual tables from the live catalog so
+        Cassandra drivers can discover metadata (reference:
+        master/yql_keyspaces_vtable.cc, yql_tables_vtable.cc,
+        yql_columns_vtable.cc)."""
+        import re as _re
+        low = sql.lower()
+        # ONLY a SELECT whose FROM targets system_schema.<vtable> hits
+        # the virtual tables; anything else (DML mentioning the string,
+        # other statements) falls through to real execution
+        m = _re.search(r"\bfrom\s+system_schema\.(\w+)", low)
+        if not low.lstrip().startswith("select") or m is None:
+            return None
+        vtable = m.group(1)
+        client = self.session.client
+        if vtable == "keyspaces":
+            return [{"keyspace_name": "ybtpu", "durable_writes": True}]
+        tables = [t["name"] for t in await client.list_tables()
+                  if not t["name"].startswith("system.")]
+        if vtable == "tables":
+            return [{"keyspace_name": "ybtpu", "table_name": n}
+                    for n in sorted(tables)]
+        if vtable == "columns":
+            out = []
+            for name in sorted(tables):
+                ct = await client._table(name)
+                for c in ct.info.schema.columns:
+                    kind = ("partition_key" if c.is_hash_key else
+                            "clustering" if c.is_range_key else "regular")
+                    out.append({
+                        "keyspace_name": "ybtpu", "table_name": name,
+                        "column_name": c.name, "kind": kind,
+                        "position": c.id,
+                        "type": self._CQL_TYPES.get(c.type, "text")})
+            return out
+        return []   # unknown vtable (e.g. .types): empty result set
+
     async def _run(self, sql: str, page_size=None,
                    paging_state=None) -> bytes:
         sys_rows = self._system_rows(sql)
+        if sys_rows is None:
+            sys_rows = await self._system_schema_rows(sql)
         if sys_rows is not None:
             return self._rows_result(sys_rows)
         res = await self.session.execute(sql)

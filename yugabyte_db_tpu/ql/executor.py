@@ -210,6 +210,22 @@ class SqlSession:
             self._bind(c, schema) if isinstance(c, tuple) else c
             for c in node[1:])
 
+    def _is_serializable(self) -> bool:
+        return (self._txn is not None
+                and self._txn.isolation == "serializable")
+
+    async def _lock_read_set(self, table, schema, where, read_ht) -> None:
+        """Take SERIALIZABLE row locks on every row matching `where`
+        (the SELECT's read set): scan just the pk columns, lock them.
+        Row-level only — predicate/phantom locks are out of scope this
+        round, matching the row-intent granularity of the reference."""
+        pk_names = [c.name for c in schema.key_columns]
+        resp = await self.client.scan(table, ReadRequest(
+            "", columns=tuple(pk_names), where=where, read_ht=read_ht))
+        if resp.rows:
+            await self._txn.lock_rows(
+                table, [{n: r[n] for n in pk_names} for r in resp.rows])
+
     async def _select(self, stmt: SelectStmt) -> SqlResult:
         if getattr(stmt, "joins", None):
             return await self._select_join(stmt)
@@ -217,6 +233,11 @@ class SqlSession:
         schema = ct.info.schema
         read_ht = self._txn.start_ht if self._txn is not None else None
         where = self._bind(stmt.where, schema)
+        if self._is_serializable():
+            # EVERY select shape (agg, grouped, plain) locks its read
+            # set; reads at the pinned start_ht snapshot plus lock-time
+            # read validation make the subsequent scan stable
+            await self._lock_read_set(stmt.table, schema, where, read_ht)
         agg_items = [it for it in stmt.items if it[0] == "agg"]
 
         if agg_items and not stmt.group_by:
@@ -243,27 +264,11 @@ class SqlSession:
         # plain row scan; LIMIT pushes down only when no client-side
         # reordering/dedup/offset must happen first
         columns = self._needed_columns(stmt, schema)
-        serializable = (self._txn is not None
-                        and self._txn.isolation == "serializable")
-        if serializable:
-            # pk columns must come back so the read set can be locked
-            columns = list(dict.fromkeys(
-                list(columns) + [c.name for c in schema.key_columns]))
         push_limit = (None if (stmt.order_by or stmt.distinct or stmt.offset)
                       else stmt.limit)
         req = ReadRequest("", columns=tuple(columns), where=where,
                           read_ht=read_ht, limit=push_limit)
         resp = await self.client.scan(stmt.table, req)
-        if serializable and resp.rows:
-            # lock the read set, then re-read under the locks so the
-            # returned rows are stable (row-level serializability;
-            # predicate/phantom locks are out of scope this round —
-            # same row-level granularity the reference takes intents at)
-            pk_names = [c.name for c in schema.key_columns]
-            await self._txn.lock_rows(
-                stmt.table,
-                [{n: r[n] for n in pk_names} for r in resp.rows])
-            resp = await self.client.scan(stmt.table, req)
         rows = [self._project_row(stmt, r, schema) for r in resp.rows]
         rows = self._order_limit(stmt, rows)
         return SqlResult(rows)
@@ -328,6 +333,11 @@ class SqlSession:
         YB batched nested loop / hash joins in the PG planner; round-1
         planner always hash-joins on the equi-key)."""
         from ..docdb.operations import eval_expr_py
+        if self._is_serializable():
+            for tname in [stmt.table] + [j.table for j in stmt.joins]:
+                jct = await self.client._table(tname)
+                await self._lock_read_set(
+                    tname, jct.info.schema, None, self._txn.start_ht)
         # fetch whole tables (residual WHERE applies after the join)
         async def fetch(table):
             resp = await self.client.scan(table, ReadRequest(""))
