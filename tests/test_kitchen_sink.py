@@ -59,9 +59,14 @@ class TestKitchenSink:
                     "UPDATE orders SET status = 9 WHERE id = 2")
                 await s2.execute("COMMIT")
                 await mc.wait_for_leaders("system.transactions")
-                await asyncio.sleep(0.5)
-                r = await s2.execute(
-                    "SELECT count(*) FROM orders WHERE status = 9")
+                # intent application is async after commit: poll, don't
+                # trust a fixed sleep (flaky on slow machines)
+                for _ in range(100):
+                    r = await s2.execute(
+                        "SELECT count(*) FROM orders WHERE status = 9")
+                    if r.rows[0]["count"] == 2:
+                        break
+                    await asyncio.sleep(0.1)
                 assert r.rows[0]["count"] == 2
 
                 # ALTER + mixed-version rows

@@ -516,3 +516,79 @@ class TestSqlSerializable:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestHaving:
+    def test_having_filters_groups(self, tmp_path):
+        async def go():
+            from yugabyte_db_tpu.ql import SqlSession
+            from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute("CREATE TABLE sales (k bigint, region "
+                                "bigint, amt double, PRIMARY KEY (k))")
+                await mc.wait_for_leaders("sales")
+                rows = []
+                for i in range(30):
+                    rows.append(f"({i}, {i % 3}, {float(i)})")
+                await s.execute("INSERT INTO sales (k, region, amt) "
+                                f"VALUES {', '.join(rows)}")
+                r = await s.execute(
+                    "SELECT region, sum(amt) FROM sales GROUP BY region "
+                    "HAVING sum(amt) > 140 ORDER BY region")
+                # region sums: 0->135, 1->145, 2->155
+                assert [row["region"] for row in r.rows] == [1, 2]
+                r = await s.execute(
+                    "SELECT region, count(*) FROM sales GROUP BY region "
+                    "HAVING count(*) >= 10 AND region < 2")
+                assert sorted(row["region"] for row in r.rows) == [0, 1]
+                # HAVING without aggregates errors out cleanly
+                with pytest.raises(ValueError):
+                    await s.execute("SELECT k FROM sales HAVING k > 1")
+            finally:
+                await mc.shutdown()
+        run(go())
+
+
+class TestHavingEdgeCases:
+    def test_unprojected_and_ungrouped_having(self, tmp_path):
+        async def go():
+            from yugabyte_db_tpu.ql import SqlSession
+            from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute("CREATE TABLE hx (k bigint, region bigint, "
+                                "amt double, PRIMARY KEY (k))")
+                await mc.wait_for_leaders("hx")
+                await s.execute(
+                    "INSERT INTO hx (k, region, amt) VALUES "
+                    "(1, 0, 10), (2, 0, 20), (3, 1, 100), (4, 1, 200)")
+                # HAVING aggregate NOT in the projection
+                r = await s.execute(
+                    "SELECT region FROM hx GROUP BY region "
+                    "HAVING sum(amt) > 50")
+                assert [row["region"] for row in r.rows] == [1]
+                assert all("__h0" not in row for row in r.rows)
+                # HAVING avg (two-slot expansion) not projected
+                r = await s.execute(
+                    "SELECT region, count(*) FROM hx GROUP BY region "
+                    "HAVING avg(amt) >= 150")
+                assert [row["region"] for row in r.rows] == [1]
+                # ungrouped aggregate select with HAVING (implicit group)
+                r = await s.execute(
+                    "SELECT count(*) FROM hx HAVING count(*) > 10")
+                assert r.rows == []
+                r = await s.execute(
+                    "SELECT count(*) FROM hx HAVING sum(amt) > 100")
+                assert r.rows[0]["count"] == 4
+                # invalid: sum(*) / HAVING without aggregates
+                with pytest.raises(Exception):
+                    await s.execute("SELECT region, count(*) FROM hx "
+                                    "GROUP BY region HAVING sum(*) > 5")
+                with pytest.raises(Exception):
+                    await s.execute("SELECT k FROM hx HAVING k > 1")
+            finally:
+                await mc.shutdown()
+        run(go())

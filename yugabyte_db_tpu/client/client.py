@@ -354,9 +354,18 @@ class YBClient:
                 counts = (np.asarray(p.group_counts)
                           if p.group_counts is not None else None)
                 continue
+            def _none(x):
+                return x is None or (
+                    isinstance(x, np.ndarray) and x.dtype == object
+                    and x.shape == () and x.item() is None)
+
             for i, a in enumerate(aggs):
                 if a.op in ("sum", "count"):
                     total[i] = total[i] + vals[i]
+                elif _none(vals[i]):      # empty tablet: min/max identity
+                    pass
+                elif _none(total[i]):
+                    total[i] = vals[i]
                 elif a.op == "min":
                     total[i] = np.minimum(total[i], vals[i])
                 else:

@@ -240,16 +240,27 @@ class SqlSession:
             await self._lock_read_set(stmt.table, schema, where, read_ht)
         agg_items = [it for it in stmt.items if it[0] == "agg"]
 
-        if agg_items and not stmt.group_by:
+        if getattr(stmt, "having", None) is not None \
+                and not agg_items and not stmt.group_by:
+            raise ValueError("HAVING requires aggregates or GROUP BY")
+        if (agg_items or getattr(stmt, "having", None) is not None) \
+                and not stmt.group_by:
+            refs = self._having_refs(stmt)
             aggs = tuple(AggSpec(op, self._bind(e, schema))
-                         for _, op, e in agg_items)
+                         for _, op, e in agg_items) + \
+                tuple(AggSpec(op, self._bind(e, schema))
+                      for op, e in refs)
             resp = await self.client.scan(stmt.table, ReadRequest(
                 "", where=where, aggregates=aggs, read_ht=read_ht))
             row = self._agg_row(stmt, resp.agg_values)
-            return SqlResult([row])
+            row.update(self._hidden_agg_row(
+                refs, resp.agg_values, self._projected_slots(stmt)))
+            rows = self._having_filter(stmt, [row], refs)
+            return SqlResult(rows)
 
-        if agg_items and stmt.group_by:
-            gspec = self._group_spec(stmt, schema)
+        if stmt.group_by and (
+                agg_items or getattr(stmt, "having", None) is not None):
+            gspec = self._group_spec(stmt, schema) if agg_items else None
             if gspec is not None:
                 return await self._grouped_pushdown(stmt, ct, where, gspec)
             return await self._grouped_clientside(stmt, ct, where)
@@ -459,16 +470,87 @@ class SqlSession:
                 continue
             op = it[1]
             if op == "avg":
-                s = float(np.asarray(values[vi]))
-                c = float(np.asarray(values[vi + 1]))
-                out[_agg_name(it)] = s / c if c else None
+                s = _scalar(values[vi])
+                c = _scalar(values[vi + 1])
+                out[_agg_name(it)] = (s / c) if s is not None and c \
+                    else None
                 vi += 2
             else:
-                v = np.asarray(values[vi])
-                out[_agg_name(it)] = (int(v) if op == "count"
-                                      else float(v))
+                v = _scalar(values[vi])
+                out[_agg_name(it)] = (v if v is None else
+                                      int(v) if op == "count" else
+                                      float(v))
                 vi += 1
         return out
+
+    @staticmethod
+    def _having_refs(stmt: SelectStmt) -> list:
+        """Ordered unique (op, expr) aggregate references in HAVING.
+        Each is computed as a HIDDEN extra aggregate ("__h<i>") — never
+        resolved by name against the projection, so un-projected or
+        name-colliding aggregates still filter correctly."""
+        having = getattr(stmt, "having", None)
+        refs: list = []
+        if having is None:
+            return refs
+
+        def walk(n):
+            if not isinstance(n, tuple):
+                return
+            if n[0] == "aggref":
+                if (n[1], n[2]) not in refs:
+                    refs.append((n[1], n[2]))
+                return
+            for c in n[1:]:
+                walk(c)
+
+        walk(having)
+        return refs
+
+    @staticmethod
+    def _hidden_agg_row(refs: list, values, vi: int) -> dict:
+        """Decode the hidden aggregates' expanded output slots starting
+        at `vi` (avg occupies two: sum, count)."""
+        out = {}
+        for i, (op, _e) in enumerate(refs):
+            if op == "avg":
+                sv = _scalar(values[vi])
+                cv = _scalar(values[vi + 1])
+                out[f"__h{i}"] = (sv / cv) if sv is not None and cv \
+                    else None
+                vi += 2
+            else:
+                v = _scalar(values[vi])
+                out[f"__h{i}"] = (v if v is None else
+                                  int(v) if op == "count" else float(v))
+                vi += 1
+        return out
+
+    @staticmethod
+    def _projected_slots(stmt: SelectStmt) -> int:
+        return sum(2 if it[1] == "avg" else 1
+                   for it in stmt.items if it[0] == "agg")
+
+    @staticmethod
+    def _having_filter(stmt: SelectStmt, rows: list, refs: list) -> list:
+        having = getattr(stmt, "having", None)
+        if having is None:
+            return rows
+
+        def subst(n):
+            if not isinstance(n, tuple):
+                return n
+            if n[0] == "aggref":
+                return ("col", f"__h{refs.index((n[1], n[2]))}")
+            return tuple(subst(c) if isinstance(c, tuple) else c
+                         for c in n)
+
+        expr = subst(having)
+        kept = [r for r in rows if _eval_by_name(expr, r) is True]
+        for r in kept:                      # hidden keys never surface
+            for i in range(len(refs)):
+                r.pop(f"__h{i}", None)
+        return kept
 
     def _group_spec(self, stmt: SelectStmt, schema) -> Optional[GroupSpec]:
         st = self.stats.get(stmt.table, {})
@@ -484,8 +566,10 @@ class SqlSession:
         schema = ct.info.schema
         read_ht = self._txn.start_ht if self._txn is not None else None
         agg_items = [it for it in stmt.items if it[0] == "agg"]
+        refs = self._having_refs(stmt)
         aggs = tuple(AggSpec(op, self._bind(e, schema))
-                     for _, op, e in agg_items)
+                     for _, op, e in agg_items) + \
+            tuple(AggSpec(op, self._bind(e, schema)) for op, e in refs)
         resp = await self.client.scan(stmt.table, ReadRequest(
             "", where=where, aggregates=aggs, group_by=gspec,
             read_ht=read_ht))
@@ -500,9 +584,12 @@ class SqlSession:
                                                    stmt.group_by):
                 row[name] = rem % domain + offset
                 rem //= domain
-            row.update(self._agg_row(
-                stmt, [np.asarray(v)[gid] for v in resp.agg_values]))
+            gvals = [np.asarray(v)[gid] for v in resp.agg_values]
+            row.update(self._agg_row(stmt, gvals))
+            row.update(self._hidden_agg_row(
+                refs, gvals, self._projected_slots(stmt)))
             rows.append(row)
+        rows = self._having_filter(stmt, rows, refs)
         return SqlResult(self._order_limit(stmt, rows))
 
     async def _grouped_clientside(self, stmt, ct, where) -> SqlResult:
@@ -510,8 +597,12 @@ class SqlSession:
         schema = ct.info.schema
         read_ht = self._txn.start_ht if self._txn is not None else None
         agg_items = [it for it in stmt.items if it[0] == "agg"]
+        refs = self._having_refs(stmt)
         needed = set(stmt.group_by)
         for _, op, e in agg_items:
+            if e is not None:
+                self._collect_names(e, needed)
+        for _op, e in refs:
             if e is not None:
                 self._collect_names(e, needed)
         resp = await self.client.scan(stmt.table, ReadRequest(
@@ -519,7 +610,9 @@ class SqlSession:
             read_ht=read_ht))
         groups: Dict[tuple, list] = {}
         bound = [(op, self._bind(e, schema) if e else None)
-                 for _, op, e in agg_items]
+                 for _, op, e in agg_items] + \
+            [(op, self._bind(e, schema) if e else None)
+             for op, e in refs]
         for r in resp.rows:
             key = tuple(r.get(c) for c in stmt.group_by)
             st = groups.setdefault(key, [_init(op) for op, _ in bound])
@@ -531,7 +624,11 @@ class SqlSession:
             row = dict(zip(stmt.group_by, key))
             for i, it in enumerate(agg_items):
                 row[_agg_name(it)] = _final(bound[i][0], st[i])
+            for j in range(len(refs)):
+                i = len(agg_items) + j
+                row[f"__h{j}"] = _final(bound[i][0], st[i])
             rows.append(row)
+        rows = self._having_filter(stmt, rows, refs)
         return SqlResult(self._order_limit(stmt, rows))
 
     async def _knn_select(self, stmt: SelectStmt) -> SqlResult:
@@ -620,6 +717,15 @@ def _eval_wrap(node, row):
 
 def _expr_name(node) -> str:
     return "expr"
+
+
+def _scalar(v):
+    """Aggregate output -> python scalar; None passes through (min/max
+    over zero rows)."""
+    a = np.asarray(v)
+    if a.dtype == object and a.shape == ():
+        return a.item()
+    return float(a)
 
 
 def _agg_name(it) -> str:

@@ -30,7 +30,7 @@ KEYWORDS = {
     "update", "set", "asc", "desc", "count", "sum", "min", "max", "avg",
     "as", "hash", "with", "tablets", "replication", "if", "exists",
     "index", "on", "using", "lists", "ttl", "begin", "commit",
-    "rollback", "transaction", "distinct", "offset", "like",
+    "rollback", "transaction", "distinct", "offset", "like", "having",
     "alter", "add", "column", "join", "inner", "left", "outer",
 }
 
@@ -128,6 +128,7 @@ class SelectStmt:
     distinct: bool = False
     offset: int = 0
     joins: List["JoinClause"] = field(default_factory=list)
+    having: Optional[tuple] = None   # expr; ("aggref", op, expr) leaves
 
 
 @dataclass
@@ -444,6 +445,13 @@ class Parser:
                 group.append(self.ident())
                 if not self.accept_op(","):
                     break
+        having = None
+        if self.accept_kw("having"):   # executor validates agg context
+            self._in_having = True
+            try:
+                having = self.expr()
+            finally:
+                self._in_having = False
         order = []
         knn = None
         if self.accept_kw("order"):
@@ -471,7 +479,7 @@ class Parser:
         if self.accept_kw("offset"):
             offset = int(self.next()[1])
         return SelectStmt(table, items, where, group, order, limit, knn,
-                          distinct, offset, joins)
+                          distinct, offset, joins, having)
 
     def delete(self):
         self.expect_kw("delete")
@@ -607,12 +615,27 @@ class Parser:
             else:
                 return node
 
+    _in_having = False
+
     def _primary_expr(self):
         if self.accept_op("("):
             e = self.expr()
             self.expect_op(")")
             return e
         t = self.peek()
+        if self._in_having and t[0] == "kw" and \
+                t[1].lower() in ("count", "sum", "min", "max", "avg"):
+            op = self.next()[1].lower()
+            self.expect_op("(")
+            if self.accept_op("*"):
+                if op != "count":
+                    raise ValueError(f"{op}(*) is not valid (only "
+                                     f"count(*))")
+                inner = None
+            else:
+                inner = self.expr()
+            self.expect_op(")")
+            return ("aggref", op, inner)
         if t[0] in ("num", "str") or (t[0] == "kw"
                                       and t[1].lower() == "null"):
             return ("const", self.literal())
