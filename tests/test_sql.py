@@ -592,3 +592,55 @@ class TestHavingEdgeCases:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestExplain:
+    def test_explain_reports_routing(self, tmp_path):
+        async def go():
+            from yugabyte_db_tpu.ql import SqlSession
+            from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute("CREATE TABLE ex (k bigint, g bigint, "
+                                "v double, PRIMARY KEY (k))")
+                await mc.wait_for_leaders("ex")
+                r = await s.execute("EXPLAIN SELECT count(*) FROM ex "
+                                    "WHERE v > 1")
+                text = "\n".join(row["QUERY PLAN"] for row in r.rows)
+                assert "Aggregate" in text and "Filter" in text
+                assert r.status == "EXPLAIN"
+                # device group pushdown is reported when stats exist
+                s.stats["ex"] = {"g": (4, 0)}
+                r = await s.execute("EXPLAIN SELECT g, sum(v) FROM ex "
+                                    "GROUP BY g")
+                text = "\n".join(row["QUERY PLAN"] for row in r.rows)
+                assert "DEVICE pushdown" in text
+                # plain scan
+                r = await s.execute("EXPLAIN SELECT k FROM ex LIMIT 2")
+                text = "\n".join(row["QUERY PLAN"] for row in r.rows)
+                assert "Seq Scan" in text and "pushed down" in text
+                # aggregate with indexed predicate: EXPLAIN must NOT
+                # claim an index lookup (the executor aggregates)
+                await s.execute("CREATE INDEX exg ON ex (g)")
+                await mc.wait_for_leaders("exg")
+                r = await s.execute("EXPLAIN SELECT count(*) FROM ex "
+                                    "WHERE g = 1")
+                text = "\n".join(row["QUERY PLAN"] for row in r.rows)
+                assert "Aggregate" in text and "Index" not in text
+                # ...but a plain select DOES use the index
+                r = await s.execute("EXPLAIN SELECT k FROM ex "
+                                    "WHERE g = 1")
+                text = "\n".join(row["QUERY PLAN"] for row in r.rows)
+                assert "Index Lookup" in text
+                # GROUP BY with HAVING-only aggregates is a grouped plan
+                r = await s.execute("EXPLAIN SELECT g FROM ex GROUP BY g "
+                                    "HAVING count(*) > 1")
+                text = "\n".join(row["QUERY PLAN"] for row in r.rows)
+                assert "Grouped Aggregate" in text
+                # EXPLAIN does not execute: no rows were touched
+                r = await s.execute("SELECT count(*) FROM ex")
+                assert r.rows[0]["count"] == 0
+            finally:
+                await mc.shutdown()
+        run(go())

@@ -24,8 +24,8 @@ from ..dockv.partition import PartitionSchema
 from ..ops.scan import AggSpec, GroupSpec
 from .parser import (
     AlterTableStmt, CreateIndexStmt, CreateTableStmt, DeleteStmt,
-    DropTableStmt, InsertStmt, SelectStmt, TxnStmt, UpdateStmt,
-    parse_statement,
+    DropTableStmt, ExplainStmt, InsertStmt, SelectStmt, TxnStmt,
+    UpdateStmt, parse_statement,
 )
 
 _TYPE_MAP = {
@@ -102,6 +102,8 @@ class SqlSession:
                 n = await self.client.create_secondary_index(
                     stmt.table, stmt.name, stmt.column)
             return SqlResult([], f"CREATE INDEX ({n} rows)")
+        if isinstance(stmt, ExplainStmt):
+            return await self._explain(stmt.inner)
         if isinstance(stmt, SelectStmt):
             if stmt.knn is not None:
                 return await self._knn_select(stmt)
@@ -113,6 +115,97 @@ class SqlSession:
         raise ValueError(f"unhandled statement {stmt}")
 
     # ------------------------------------------------------------------
+    async def _explain(self, stmt) -> SqlResult:
+        """Plan description without executing (reference: EXPLAIN via
+        the PG planner + yb_lsm cost hooks; ours mirrors _select's
+        branch order exactly so the reported plan is the executed one)."""
+        lines: List[str] = []
+        if isinstance(stmt, SelectStmt):
+            ct = await self.client._table(stmt.table)
+            schema = ct.info.schema
+            agg_items = [it for it in stmt.items if it[0] == "agg"]
+            having = getattr(stmt, "having", None)
+            push_limit = (stmt.limit is not None
+                          and not (stmt.order_by or stmt.distinct
+                                   or stmt.offset))
+            if stmt.knn is not None:
+                lines.append(f"kNN Search on {stmt.table} "
+                             f"({stmt.knn[0]})")
+                lines.append("  -> per-tablet IVF-flat index + re-rank"
+                             " (exact device search if no index)")
+            elif getattr(stmt, "joins", None):
+                lines.append(f"Hash Join ({stmt.joins[0].kind}) "
+                             f"{stmt.table} ⋈ "
+                             f"{', '.join(j.table for j in stmt.joins)}")
+                lines.append("  -> full scans, client-side hash build")
+            elif agg_items and not stmt.group_by:
+                lines.append(f"Aggregate on {stmt.table} "
+                             f"(pushed to tablets; TPU scan kernel "
+                             f"when >= tpu_min_rows_for_pushdown)")
+                if stmt.where is not None:
+                    lines.append("  Filter: pushed to tablets "
+                                 "(device mask when columnar)")
+                if having is not None:
+                    lines.append("  Having: client-side over the "
+                                 "single group")
+            elif stmt.group_by and (agg_items or having is not None):
+                gspec = (self._group_spec(stmt, schema)
+                         if agg_items else None)
+                if gspec is not None:
+                    lines.append(
+                        f"Grouped Aggregate on {stmt.table} "
+                        f"(DEVICE pushdown: one-hot matmul over "
+                        f"{gspec.num_groups} groups)")
+                else:
+                    lines.append(
+                        f"Grouped Aggregate on {stmt.table} "
+                        f"(client hash grouping; declare stats "
+                        f"for device pushdown)")
+                if stmt.where is not None:
+                    lines.append("  Filter: pushed to tablets "
+                                 "(device mask when columnar)")
+                if having is not None:
+                    lines.append("  Having: client-side over group rows")
+                if stmt.order_by:
+                    lines.append("  Order By: client-side sort")
+                if stmt.limit is not None:
+                    lines.append(f"  Limit {stmt.limit}: client-side")
+            else:
+                idx = None
+                if ct.indexes and stmt.where is not None \
+                        and self._txn is None:
+                    idx = self._extract_index_eq(stmt.where, ct)
+                if idx is not None:
+                    lines.append(f"Index Lookup on {stmt.table} "
+                                 f"via {idx[0]}")
+                    lines.append("  Residual Filter: client-side")
+                    if stmt.order_by:
+                        lines.append("  Order By: client-side sort")
+                    if stmt.limit is not None:
+                        lines.append(f"  Limit {stmt.limit}: "
+                                     f"client-side")
+                else:
+                    lines.append(f"Seq Scan on {stmt.table}")
+                    if stmt.where is not None:
+                        lines.append("  Filter: pushed to tablets "
+                                     "(device mask when columnar)")
+                    if stmt.order_by:
+                        lines.append("  Order By: client-side sort")
+                    if stmt.limit is not None:
+                        lines.append(
+                            f"  Limit {stmt.limit}: "
+                            f"{'pushed down' if push_limit else 'client-side'}")
+            if self._is_serializable():
+                lines.append("  Locks: SERIALIZABLE row read locks "
+                             "on the read set")
+        elif isinstance(stmt, (UpdateStmt, DeleteStmt)):
+            op = "Update" if isinstance(stmt, UpdateStmt) else "Delete"
+            lines.append(f"{op} on {stmt.table}: pk scan + per-row "
+                         f"write (txn intents when in a transaction)")
+        else:
+            lines.append(f"{type(stmt).__name__}: no plan")
+        return SqlResult([{"QUERY PLAN": l} for l in lines], "EXPLAIN")
+
     async def _txn_stmt(self, stmt: TxnStmt) -> SqlResult:
         if stmt.kind == "begin":
             if self._txn is not None:

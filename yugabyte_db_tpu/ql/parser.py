@@ -31,6 +31,7 @@ KEYWORDS = {
     "as", "hash", "with", "tablets", "replication", "if", "exists",
     "index", "on", "using", "lists", "ttl", "begin", "commit",
     "rollback", "transaction", "distinct", "offset", "like", "having",
+    "explain",
     "alter", "add", "column", "join", "inner", "left", "outer",
 }
 
@@ -98,6 +99,11 @@ class InsertStmt:
     columns: List[str]
     rows: List[List[object]]
     ttl_ms: Optional[int] = None
+
+
+@dataclass
+class ExplainStmt:
+    inner: object
 
 
 @dataclass
@@ -194,6 +200,10 @@ class Parser:
         if t is None:
             raise ValueError("empty statement")
         word = t[1].lower()
+        if word == "explain":
+            self.next()
+            inner = self.parse()
+            return ExplainStmt(inner)
         fn = {
             "create": self.create_table, "drop": self.drop_table,
             "insert": self.insert, "select": self.select,
