@@ -13,6 +13,7 @@ from yugabyte_db_tpu.dockv.packed_row import (
 )
 from yugabyte_db_tpu.dockv.partition import PartitionSchema
 from yugabyte_db_tpu.ops import AggSpec, Expr
+from yugabyte_db_tpu.rpc import RpcError
 from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
 
 C = Expr.col
@@ -387,4 +388,56 @@ class TestSnapshotSchedules:
                 assert first_snap not in m.tables[tid]["snapshots"]
             finally:
                 await mc.shutdown()
+        run(go())
+
+
+class TestManagedXCluster:
+    def test_master_driven_replication_lifecycle(self, tmp_path):
+        """setup_xcluster_replication on the TARGET master spawns the
+        poller in its maintenance loop: rows flow without any client-
+        side replicator object, safe time publishes, drop stops it."""
+        async def go():
+            src = await MiniCluster(str(tmp_path / "src"),
+                                    num_tservers=1).start()
+            dst = await MiniCluster(str(tmp_path / "dst"),
+                                    num_tservers=1).start()
+            try:
+                cs, cd = src.client(), dst.client()
+                await cs.create_table(kv_info(), num_tablets=2)
+                await src.wait_for_leaders("kv")
+                await cs.insert("kv", [{"k": i, "v": float(i)}
+                                       for i in range(15)])
+                await cd._master_call(
+                    "setup_xcluster_replication",
+                    {"source_master": list(src.master.messenger.addr),
+                     "table": "kv"})
+                # rows appear on the target with no manual stepping
+                for _ in range(100):
+                    try:
+                        row = await cd.get("kv", {"k": 14})
+                        if row is not None:
+                            break
+                    except RpcError:
+                        pass
+                    await asyncio.sleep(0.1)
+                assert (await cd.get("kv", {"k": 14}))["v"] == 14.0
+                r = await cd._master_call("list_xcluster_replication", {})
+                assert "kv" in r["replication"] and "kv" in r["running"]
+                # safe time flows too
+                for _ in range(50):
+                    r2 = await cd._master_call("get_xcluster_safe_time",
+                                               {"table": "kv"})
+                    if r2["safe_ht"] > 0:
+                        break
+                    await asyncio.sleep(0.1)
+                assert r2["safe_ht"] > 0
+                # drop: poller stops; later source writes stay put
+                await cd._master_call("drop_xcluster_replication",
+                                      {"table": "kv"})
+                await cs.insert("kv", [{"k": 500, "v": 1.0}])
+                await asyncio.sleep(1.0)
+                assert await cd.get("kv", {"k": 500}) is None
+            finally:
+                await src.shutdown()
+                await dst.shutdown()
         run(go())

@@ -46,6 +46,12 @@ class Master:
         self._running = False
         # table -> replicated-up-to HT for inbound xCluster replication
         self._xcluster_safe_time: Dict[str, int] = {}
+        # table -> {source_master: [host, port]} inbound replication
+        # config (catalog-persisted); running replicator tasks live in
+        # _xcluster_tasks on the leader only
+        self.xcluster_replication: Dict[str, dict] = {}
+        self._xcluster_tasks: Dict[str, object] = {}
+        self._xcluster_reconcile_lock = asyncio.Lock()
         self.auto_balance = False   # ticked explicitly or via enable
         # sys-catalog Raft (None = standalone single master, still
         # journals through a local single-peer group once started)
@@ -79,6 +85,10 @@ class Master:
                 self.tablets[op[1]] = op[2]
             elif kind == "del_tablet":
                 self.tablets.pop(op[1], None)
+            elif kind == "put_xcluster":
+                self.xcluster_replication[op[1]] = op[2]
+            elif kind == "del_xcluster":
+                self.xcluster_replication.pop(op[1], None)
         self._persist()
 
     async def _commit_catalog(self, ops) -> None:
@@ -113,11 +123,13 @@ class Master:
                 d = json.load(f)
             self.tables = d["tables"]
             self.tablets = d["tablets"]
+            self.xcluster_replication = d.get("xcluster", {})
 
     def _persist(self):
         tmp = self._catalog_path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"tables": self.tables, "tablets": self.tablets}, f)
+            json.dump({"tables": self.tables, "tablets": self.tablets,
+                       "xcluster": self.xcluster_replication}, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._catalog_path)
@@ -141,6 +153,10 @@ class Master:
                     pass
             try:
                 await self.tick_snapshot_schedules()
+            except Exception:   # noqa: BLE001
+                pass
+            try:
+                await self._ensure_xcluster_replicators()
             except Exception:   # noqa: BLE001
                 pass
             await asyncio.sleep(1.0)
@@ -167,6 +183,9 @@ class Master:
         self._running = False
         if self._lb_task:
             self._lb_task.cancel()
+        for ent in self._xcluster_tasks.values():
+            await ent.stop()
+        self._xcluster_tasks.clear()
         await self.messenger.shutdown()
 
     # --- web UI path handlers (reference: master-path-handlers.cc) --------
@@ -772,6 +791,78 @@ class Master:
 
     # --- CDC stream registry (reference: master cdcsdk_manager.cc,
     # cdc_state_table.cc for checkpoints) ----------------------------------
+    async def rpc_setup_xcluster_replication(self, payload) -> dict:
+        """Start pulling a table from another universe into THIS one
+        (reference: SetupUniverseReplication in catalog_manager_ent /
+        xcluster; ours runs the poller inside the target master's
+        maintenance loop). Config is catalog-persisted; the leader
+        (re)spawns the replicator task."""
+        self._check_leader()
+        table = payload["table"]
+        src_addr = tuple(payload["source_master"])
+        # validate up front: unreachable source or missing table must
+        # fail the RPC, not retry silently forever
+        try:
+            r = await self.messenger.call(src_addr, "master",
+                                          "list_tables", {}, timeout=10.0)
+        except (RpcError, asyncio.TimeoutError, OSError) as e:
+            raise RpcError(f"source master {src_addr} unreachable: {e}",
+                           "SERVICE_UNAVAILABLE")
+        if table not in {t["name"] for t in r["tables"]}:
+            raise RpcError(f"table {table} not found on source universe",
+                           "NOT_FOUND")
+        cfg = {"source_master": list(payload["source_master"]),
+               "table": table}
+        await self._commit_catalog([["put_xcluster", table, cfg]])
+        await self._ensure_xcluster_replicators()
+        return {"ok": True}
+
+    async def rpc_drop_xcluster_replication(self, payload) -> dict:
+        self._check_leader()
+        table = payload["table"]
+        await self._commit_catalog([["del_xcluster", table]])
+        ent = self._xcluster_tasks.pop(table, None)
+        if ent is not None:
+            await ent.stop()
+        return {"ok": True}
+
+    async def rpc_list_xcluster_replication(self, payload) -> dict:
+        return {"replication": dict(self.xcluster_replication),
+                "running": sorted(self._xcluster_tasks),
+                "safe_time": dict(self._xcluster_safe_time)}
+
+    async def _ensure_xcluster_replicators(self) -> None:
+        """Leader-only: reconcile running replicator tasks with the
+        configured set (spawns after failover/restart too). Serialized:
+        the setup RPC and the maintenance tick both call this, and two
+        concurrent passes would double-start a poller."""
+        async with self._xcluster_reconcile_lock:
+            await self._reconcile_xcluster_locked()
+
+    async def _reconcile_xcluster_locked(self) -> None:
+        if not self.is_leader():
+            for t, ent in list(self._xcluster_tasks.items()):
+                await ent.stop()
+                del self._xcluster_tasks[t]
+            return
+        from ..cdc import XClusterReplicator
+        from ..client import YBClient
+        for table, cfg in list(self.xcluster_replication.items()):
+            if table in self._xcluster_tasks:
+                continue
+            src = YBClient(tuple(cfg["source_master"]),
+                           messenger=self.messenger)
+            dst = YBClient(self.messenger.addr, messenger=self.messenger)
+            repl = XClusterReplicator(src, dst, table, poll_interval=0.2)
+            try:
+                await repl.start()
+            except Exception:   # noqa: BLE001 — source may be down; retry
+                continue        # on the next maintenance tick
+            self._xcluster_tasks[table] = repl
+        for table in list(self._xcluster_tasks):
+            if table not in self.xcluster_replication:
+                await self._xcluster_tasks.pop(table).stop()
+
     async def rpc_set_xcluster_safe_time(self, payload) -> dict:
         """Published by an inbound xCluster replicator: the HT up to
         which this table is fully replicated from its source universe

@@ -125,6 +125,8 @@ class SqlSession:
             schema = ct.info.schema
             agg_items = [it for it in stmt.items if it[0] == "agg"]
             having = getattr(stmt, "having", None)
+            if having is not None and not agg_items and not stmt.group_by:
+                raise ValueError("HAVING requires aggregates or GROUP BY")
             push_limit = (stmt.limit is not None
                           and not (stmt.order_by or stmt.distinct
                                    or stmt.offset))

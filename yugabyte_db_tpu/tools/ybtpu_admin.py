@@ -10,6 +10,8 @@ create_snapshot TABLE, restore_snapshot SNAPSHOT_ID NEW_TABLE,
 create_snapshot_schedule TABLE INTERVAL_S KEEP,
 list_snapshot_schedules TABLE,
 restore_snapshot_schedule SCHEDULE_ID AT_UNIX_TS NEW_TABLE,
+setup_xcluster SOURCE_HOST:PORT TABLE, drop_xcluster TABLE,
+list_xcluster,
 split_tablet TABLET_ID, move_replica TABLET_ID FROM TO, balance_tick,
 blacklist TS_UUID, compact_table TABLE, flush_table TABLE
 """
@@ -29,6 +31,7 @@ _MIN_ARGS = {
     "list_tablets": 1, "create_snapshot": 1, "restore_snapshot": 2,
     "create_snapshot_schedule": 3, "restore_snapshot_schedule": 3,
     "split_tablet": 1, "move_replica": 3, "blacklist": 1,
+    "setup_xcluster": 2, "drop_xcluster": 1,
     "compact_table": 1, "flush_table": 1,
 }
 
@@ -77,6 +80,24 @@ async def run_command(args) -> int:
                          {"schedule_id": a[0], "at": float(a[1]),
                           "new_name": a[2]}, timeout=120.0)
         print(json.dumps(r))
+    elif cmd == "setup_xcluster":
+        if ":" not in a[0] or not a[0].rsplit(":", 1)[1].isdigit():
+            print(f"error: setup_xcluster needs SOURCE_HOST:PORT, "
+                  f"got {a[0]!r}", file=sys.stderr)
+            return 1
+        shost, sport = a[0].rsplit(":", 1)
+        r = await m.call(maddr, "master", "setup_xcluster_replication",
+                         {"source_master": [shost, int(sport)],
+                          "table": a[1]}, timeout=120.0)
+        print(json.dumps(r))
+    elif cmd == "drop_xcluster":
+        r = await m.call(maddr, "master", "drop_xcluster_replication",
+                         {"table": a[0]}, timeout=120.0)
+        print(json.dumps(r))
+    elif cmd == "list_xcluster":
+        r = await m.call(maddr, "master", "list_xcluster_replication",
+                         {}, timeout=120.0)
+        print(json.dumps(r, indent=1))
     elif cmd == "split_tablet":
         r = await m.call(maddr, "master", "split_tablet",
                          {"tablet_id": a[0]}, timeout=120.0)
@@ -118,6 +139,8 @@ def main(argv=None):
     except RpcError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+    except BrokenPipeError:
+        return 0   # output piped into a closed reader (e.g. | head)
 
 
 if __name__ == "__main__":
