@@ -248,3 +248,32 @@ class TestLsm:
         db.apply(WriteBatch([(kv(3)[0], b"changed")]))
         db.flush()
         assert snap.get(kv(3)[0]) == kv(3)[1]
+
+
+class TestPointEntriesVarlenPk:
+    def test_point_reads_with_string_pk_sidecars(self, tmp_path):
+        """Variable-length PKs produce sidecars WITHOUT a keys matrix;
+        point_entries must fall back to row decode, not assert."""
+        import asyncio
+        from yugabyte_db_tpu.docdb import ReadRequest, RowOp, WriteRequest
+        from yugabyte_db_tpu.docdb.table_codec import TableInfo
+        from yugabyte_db_tpu.dockv.packed_row import (
+            ColumnSchema, ColumnType, TableSchema,
+        )
+        from yugabyte_db_tpu.dockv.partition import PartitionSchema
+        from yugabyte_db_tpu.tablet import Tablet
+        info = TableInfo("", "sv", TableSchema(columns=(
+            ColumnSchema(0, "name", ColumnType.STRING, is_hash_key=True),
+            ColumnSchema(1, "v", ColumnType.FLOAT64)), version=1),
+            PartitionSchema("hash", 1))
+        t = Tablet("svt", info, str(tmp_path))
+        t.apply_write(WriteRequest("", [
+            RowOp("upsert", {"name": n, "v": float(i)})
+            for i, n in enumerate(
+                ["a", "bb", "ccc", "dddd", "x" * 40, "yy" * 7])]))
+        t.flush()
+        for i, n in enumerate(["a", "bb", "ccc", "dddd",
+                               "x" * 40, "yy" * 7]):
+            r = t.read(ReadRequest("", pk_eq={"name": n}))
+            assert r.rows and r.rows[0]["v"] == float(i), n
+        assert not t.read(ReadRequest("", pk_eq={"name": "zzz"})).rows

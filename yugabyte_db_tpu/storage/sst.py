@@ -323,13 +323,26 @@ class SstReader:
         self.index = [BlockIndexEntry(*row) for row in raw_index]
         self._first_keys = [e.first_key for e in self.index]
         self._col_cache: dict = {}
+        self._row_cache: dict = {}   # block idx -> decoded entries
 
     @property
     def file_size(self) -> int:
         return len(self._data)
 
     # --- row access -------------------------------------------------------
+    @staticmethod
+    def _cache_put(cache: dict, i: int, value, cap: int):
+        """Bounded block cache: point reads revisit hot blocks; full
+        scans touch each block once, so eviction-by-clear is fine."""
+        if len(cache) > cap:
+            cache.clear()
+        cache[i] = value
+        return value
+
     def _read_block(self, i: int) -> List[Tuple[bytes, bytes]]:
+        cached = self._row_cache.get(i)
+        if cached is not None:
+            return cached
         e = self.index[i]
         if e.length == 0:   # columnar-only block
             cb = self.columnar_block(i)
@@ -337,8 +350,10 @@ class SstReader:
                 raise ValueError(
                     f"{self.path}: block {i} is columnar-only and no "
                     "row_decoder is set")
-            return self.row_decoder(cb)
-        return _decode_block(self._data[e.offset:e.offset + e.length])
+            out = self.row_decoder(cb)
+        else:
+            out = _decode_block(self._data[e.offset:e.offset + e.length])
+        return self._cache_put(self._row_cache, i, out, 16)
 
     def seek(self, key: bytes) -> Iterator[Tuple[bytes, bytes]]:
         """Yield entries with entry_key >= key, ascending."""
@@ -378,8 +393,15 @@ class SstReader:
                 return
             if e.last_key < prefix:
                 continue
-            if e.length == 0 and self.row_decoder is not None:
-                cb = self.columnar_block(i)
+            cb = (self.columnar_block(i)
+                  if self.row_decoder is not None else None)
+            if cb is not None and cb.keys is None:
+                cb = None   # variable-length PKs: no keys matrix to
+                            # binary-search; fall back to row decode
+            if cb is not None:
+                # columnar fast path whenever a sidecar exists (also for
+                # blocks that carry row data): binary search + single-row
+                # slice beats decoding the whole block for one key
                 pos = cb.searchsorted_key(prefix)
                 advanced = False
                 while pos < cb.n and cb.keys[pos].tobytes().startswith(
@@ -408,10 +430,7 @@ class SstReader:
             return cached
         cb = ColumnarBlock.deserialize(
             self._data[e.col_offset:e.col_offset + e.col_length])
-        if len(self._col_cache) > 32:
-            self._col_cache.clear()
-        self._col_cache[i] = cb
-        return cb
+        return self._cache_put(self._col_cache, i, cb, 32)
 
     def columnar_blocks(self, lower: Optional[bytes] = None,
                         upper: Optional[bytes] = None
