@@ -644,3 +644,68 @@ class TestExplain:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestInSubquery:
+    def test_semi_join_in_select_update_delete(self, tmp_path):
+        async def go():
+            from yugabyte_db_tpu.ql import SqlSession
+            from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute("CREATE TABLE users (id bigint, region "
+                                "bigint, PRIMARY KEY (id))")
+                await s.execute("CREATE TABLE orders2 (oid bigint, uid "
+                                "bigint, amt double, PRIMARY KEY (oid))")
+                await mc.wait_for_leaders("users")
+                await mc.wait_for_leaders("orders2")
+                await s.execute("INSERT INTO users (id, region) VALUES "
+                                "(1, 0), (2, 1), (3, 0), (4, 1)")
+                await s.execute(
+                    "INSERT INTO orders2 (oid, uid, amt) VALUES "
+                    "(10, 1, 5.0), (11, 2, 6.0), (12, 2, 7.0), "
+                    "(13, 9, 8.0)")
+                r = await s.execute(
+                    "SELECT oid FROM orders2 WHERE uid IN "
+                    "(SELECT id FROM users WHERE region = 1) "
+                    "ORDER BY oid")
+                assert [x["oid"] for x in r.rows] == [11, 12]
+                # nested in a larger predicate
+                r = await s.execute(
+                    "SELECT oid FROM orders2 WHERE amt > 5.5 AND uid IN "
+                    "(SELECT id FROM users WHERE region = 1)")
+                assert sorted(x["oid"] for x in r.rows) == [11, 12]
+                # UPDATE/DELETE through the same resolution
+                await s.execute(
+                    "UPDATE orders2 SET amt = 0 WHERE uid IN "
+                    "(SELECT id FROM users WHERE region = 0)")
+                r = await s.execute("SELECT amt FROM orders2 WHERE oid = 10")
+                assert r.rows[0]["amt"] == 0.0
+                await s.execute(
+                    "DELETE FROM orders2 WHERE uid IN "
+                    "(SELECT id FROM users WHERE region = 1)")
+                r = await s.execute("SELECT count(*) FROM orders2")
+                assert r.rows[0]["count"] == 2
+                # empty subquery result matches nothing
+                r = await s.execute(
+                    "SELECT oid FROM orders2 WHERE uid IN "
+                    "(SELECT id FROM users WHERE region = 99)")
+                assert r.rows == []
+                # multi-column subquery rejected (even on empty tables)
+                with pytest.raises(Exception):
+                    await s.execute(
+                        "SELECT oid FROM orders2 WHERE uid IN "
+                        "(SELECT id, region FROM users WHERE region = 77)")
+                # SQL three-valued NOT IN: a NULL in the subquery result
+                # makes every NOT IN row UNKNOWN -> zero rows
+                await s.execute("ALTER TABLE users ADD COLUMN alt bigint")
+                await s.execute(
+                    "INSERT INTO users (id, region, alt) VALUES (9, 5, 2)")
+                r = await s.execute(
+                    "SELECT oid FROM orders2 WHERE NOT uid IN "
+                    "(SELECT alt FROM users)")   # alt NULL for old rows
+                assert r.rows == []
+            finally:
+                await mc.shutdown()
+        run(go())

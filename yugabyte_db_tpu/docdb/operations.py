@@ -92,6 +92,9 @@ class ReadResponse:
 # --------------------------------------------------------------------------
 # CPU expression interpreter (correctness reference / small scans)
 # --------------------------------------------------------------------------
+_IN_SET_CACHE: Dict[int, tuple] = {}
+
+
 def eval_expr_py(node: tuple, row: Dict[int, object]):
     """Evaluate the pushdown AST over one row ({col_id: value}); returns
     value or None for SQL NULL."""
@@ -144,7 +147,19 @@ def eval_expr_py(node: tuple, row: Dict[int, object]):
         x = eval_expr_py(node[1], row)
         if x is None:
             return None
-        return x in node[2]
+        vals = node[2]
+        if len(vals) > 32:
+            # large lists (IN-subquery results): one set build per node,
+            # O(1) membership per row; the entry keeps a strong ref to
+            # the node so its id stays valid for the cache's lifetime
+            ent = _IN_SET_CACHE.get(id(node))
+            if ent is None or ent[0] is not node:
+                if len(_IN_SET_CACHE) > 128:
+                    _IN_SET_CACHE.clear()
+                ent = (node, set(vals))
+                _IN_SET_CACHE[id(node)] = ent
+            return x in ent[1]
+        return x in vals
     if kind == "isnull":
         return eval_expr_py(node[1], row) is None
     if kind == "like":

@@ -77,6 +77,11 @@ def device_compatible(node: ExprNode) -> bool:
     extraction etc. stay on the CPU row path)."""
     if node[0] not in _DEVICE_NODE_KINDS:
         return False
+    if node[0] == "in" and len(node[2]) > 64:
+        # the kernel unrolls one compare per value (and the signature
+        # includes the length, so every size recompiles) — large lists
+        # (IN-subquery results) run on the CPU set path instead
+        return False
     for c in node[1:]:
         if isinstance(c, (tuple, list)) and c and isinstance(c[0], str):
             if not device_compatible(c):

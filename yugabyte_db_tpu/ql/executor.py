@@ -321,7 +321,40 @@ class SqlSession:
             await self._txn.lock_rows(
                 table, [{n: r[n] for n in pk_names} for r in resp.rows])
 
+    async def _resolve_subqueries(self, node):
+        """Replace ("in_subquery", expr, SelectStmt) with a plain
+        ("in", expr, values) by running the subquery (semi-join via
+        materialized value list — the reference plans these as hash
+        semi-joins; ours inlines, which also keeps pushdown working)."""
+        if not isinstance(node, tuple):
+            return node
+        if node[0] == "in_subquery":
+            sub = node[2]
+            # static shape check (deterministic even on empty results)
+            if len(sub.items) != 1 or sub.items[0][0] == "star":
+                raise ValueError(
+                    "IN (SELECT ...) must produce exactly one column")
+            res = await self._select(sub)
+            raw = [next(iter(r.values())) for r in res.rows]
+            vals = sorted({v for v in raw if v is not None})
+            inner = await self._resolve_subqueries(node[1])
+            in_node = ("in", inner, vals)
+            if len(raw) != len([v for v in raw if v is not None]):
+                # SQL three-valued IN: a NULL in the list makes a non-
+                # match UNKNOWN, not FALSE (matters under NOT IN) —
+                # OR with an unknown term models it exactly
+                return ("or", in_node,
+                        ("cmp", "eq", ("const", None), ("const", None)))
+            return in_node
+        out = []
+        for c in node:
+            out.append(await self._resolve_subqueries(c)
+                       if isinstance(c, tuple) else c)
+        return tuple(out)
+
     async def _select(self, stmt: SelectStmt) -> SqlResult:
+        if stmt.where is not None:
+            stmt.where = await self._resolve_subqueries(stmt.where)
         if getattr(stmt, "joins", None):
             return await self._select_join(stmt)
         ct = await self.client._table(stmt.table)
@@ -748,6 +781,8 @@ class SqlSession:
 
     # ------------------------------------------------------------------
     async def _delete(self, stmt: DeleteStmt) -> SqlResult:
+        if stmt.where is not None:
+            stmt.where = await self._resolve_subqueries(stmt.where)
         ct = await self.client._table(stmt.table)
         schema = ct.info.schema
         pk_cols = [c.name for c in schema.key_columns]
@@ -764,6 +799,8 @@ class SqlSession:
         return SqlResult([], f"DELETE {n}")
 
     async def _update(self, stmt: UpdateStmt) -> SqlResult:
+        if stmt.where is not None:
+            stmt.where = await self._resolve_subqueries(stmt.where)
         ct = await self.client._table(stmt.table)
         schema = ct.info.schema
         read_ht = self._txn.start_ht if self._txn is not None else None

@@ -580,6 +580,13 @@ class Parser:
         if t and t[0] == "kw" and t[1].lower() == "in":
             self.next()
             self.expect_op("(")
+            nt = self.peek()
+            if nt and nt[0] == "kw" and nt[1].lower() == "select":
+                sub = self.select()
+                self.expect_op(")")
+                # semi-join: executor runs the subquery first and
+                # inlines its single-column values
+                return ("in_subquery", left, sub)
             vals = []
             while True:
                 vals.append(self.literal())
