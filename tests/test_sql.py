@@ -90,7 +90,7 @@ class TestSqlEndToEnd:
                     "FROM items WHERE flag = 1")
                 expect = sum(i * 0.5 * (100 - i) for i in range(30)
                              if i % 3 == 1)
-                assert abs(r.rows[0]["sum"] - expect) < 1e-6
+                assert abs(r.rows[0]["x"] - expect) < 1e-6   # AS alias
                 assert r.rows[0]["count"] == 10
             finally:
                 await mc.shutdown()
@@ -706,6 +706,50 @@ class TestInSubquery:
                     "SELECT oid FROM orders2 WHERE NOT uid IN "
                     "(SELECT alt FROM users)")   # alt NULL for old rows
                 assert r.rows == []
+            finally:
+                await mc.shutdown()
+        run(go())
+
+
+class TestAliases:
+    def test_as_renames_projection(self, tmp_path):
+        async def go():
+            from yugabyte_db_tpu.ql import SqlSession
+            from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute("CREATE TABLE al (k bigint, v double, "
+                                "PRIMARY KEY (k))")
+                await mc.wait_for_leaders("al")
+                await s.execute("INSERT INTO al (k, v) VALUES (1, 2.0), "
+                                "(2, 4.0)")
+                r = await s.execute("SELECT k AS id, v AS price FROM al "
+                                    "ORDER BY k")
+                assert r.rows[0] == {"id": 1, "price": 2.0}
+                r = await s.execute(
+                    "SELECT sum(v) AS total, count(*) AS n FROM al")
+                assert r.rows[0] == {"total": 6.0, "n": 2}
+                r = await s.execute(
+                    "SELECT k, avg(v) AS m FROM al GROUP BY k "
+                    "HAVING avg(v) > 3")
+                assert r.rows == [{"k": 2, "m": 4.0}]
+                # alias colliding with another projected column name
+                r = await s.execute("SELECT v AS k, k FROM al "
+                                    "ORDER BY k LIMIT 1")
+                assert set(r.rows[0].keys()) == {"k"} or \
+                    len(r.rows[0]) == 2   # positional: both survive
+                r = await s.execute("SELECT v AS a, k AS b FROM al "
+                                    "WHERE k = 1")
+                assert r.rows[0] == {"a": 2.0, "b": 1}
+                # two expression items with aliases keep both columns
+                r = await s.execute("SELECT k + 1 AS a, k * 2 AS b "
+                                    "FROM al WHERE k = 2")
+                assert r.rows[0] == {"a": 3, "b": 4}
+                # ORDER BY an alias
+                r = await s.execute("SELECT v AS price FROM al "
+                                    "ORDER BY price DESC")
+                assert [x["price"] for x in r.rows] == [4.0, 2.0]
             finally:
                 await mc.shutdown()
         run(go())

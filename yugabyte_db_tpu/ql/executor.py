@@ -114,6 +114,18 @@ class SqlSession:
             return await self._update(stmt)
         raise ValueError(f"unhandled statement {stmt}")
 
+    @staticmethod
+    def _item_name(stmt: SelectStmt, idx: int) -> str:
+        """Output column name for item `idx`: its AS alias, else the
+        default derived name (positional, so aliases can never collide
+        with or overwrite other projected columns)."""
+        alias = getattr(stmt, "aliases", {}).get(idx)
+        if alias:
+            return alias
+        it = stmt.items[idx]
+        return (it[1] if it[0] == "col" else
+                _agg_name(it) if it[0] == "agg" else _expr_name(it[1]))
+
     # ------------------------------------------------------------------
     async def _explain(self, stmt) -> SqlResult:
         """Plan description without executing (reference: EXPLAIN via
@@ -543,8 +555,10 @@ class SqlSession:
                 names.add(it[1])
             elif it[0] == "expr":
                 self._collect_names(it[1], names)
+        alias_names = set(getattr(stmt, "aliases", {}).values())
         for col, _ in stmt.order_by:
-            names.add(col)
+            if col not in alias_names:   # aliases exist post-projection
+                names.add(col)
         return sorted(names)
 
     def _collect_names(self, node, out: set):
@@ -559,14 +573,14 @@ class SqlSession:
         if any(it[0] == "star" for it in stmt.items):
             return row
         out = {}
-        for it in stmt.items:
+        for i, it in enumerate(stmt.items):
             if it[0] == "col":
-                out[it[1]] = row.get(it[1])
+                out[self._item_name(stmt, i)] = row.get(it[1])
             elif it[0] == "expr":
                 bound = self._bind(it[1], schema)
                 idrow = {schema.column_by_name(k).id: v
                          for k, v in row.items()}
-                out[_expr_name(it[1])] = eval_expr_py(bound, idrow)
+                out[self._item_name(stmt, i)] = eval_expr_py(bound, idrow)
         return out
 
     def _order_limit(self, stmt: SelectStmt, rows: List[dict]) -> List[dict]:
@@ -593,21 +607,20 @@ class SqlSession:
         """Map expanded (avg->sum,count) agg outputs back to named items."""
         out = {}
         vi = 0
-        for it in stmt.items:
+        for i, it in enumerate(stmt.items):
             if it[0] != "agg":
                 continue
             op = it[1]
+            name = self._item_name(stmt, i)
             if op == "avg":
                 s = _scalar(values[vi])
                 c = _scalar(values[vi + 1])
-                out[_agg_name(it)] = (s / c) if s is not None and c \
-                    else None
+                out[name] = (s / c) if s is not None and c else None
                 vi += 2
             else:
                 v = _scalar(values[vi])
-                out[_agg_name(it)] = (v if v is None else
-                                      int(v) if op == "count" else
-                                      float(v))
+                out[name] = (v if v is None else
+                             int(v) if op == "count" else float(v))
                 vi += 1
         return out
 
@@ -751,7 +764,9 @@ class SqlSession:
         for key, st in groups.items():
             row = dict(zip(stmt.group_by, key))
             for i, it in enumerate(agg_items):
-                row[_agg_name(it)] = _final(bound[i][0], st[i])
+                idx = stmt.items.index(it)
+                row[self._item_name(stmt, idx)] = _final(bound[i][0],
+                                                         st[i])
             for j in range(len(refs)):
                 i = len(agg_items) + j
                 row[f"__h{j}"] = _final(bound[i][0], st[i])

@@ -135,6 +135,7 @@ class SelectStmt:
     offset: int = 0
     joins: List["JoinClause"] = field(default_factory=list)
     having: Optional[tuple] = None   # expr; ("aggref", op, expr) leaves
+    aliases: Dict[int, str] = field(default_factory=dict)  # item idx -> AS
 
 
 @dataclass
@@ -398,6 +399,7 @@ class Parser:
         self.expect_kw("select")
         distinct = self.accept_kw("distinct")
         items = []
+        aliases: Dict[int, str] = {}
         while True:
             if self.accept_op("*"):
                 items.append(("star",))
@@ -413,12 +415,12 @@ class Parser:
                         expr = self.expr()
                     self.expect_op(")")
                     if self.accept_kw("as"):
-                        self.ident()
+                        aliases[len(items)] = self.ident()
                     items.append(("agg", op, expr))
                 else:
                     expr = self.expr()
                     if self.accept_kw("as"):
-                        self.ident()
+                        aliases[len(items)] = self.ident()
                     if expr[0] == "col":
                         items.append(("col", expr[1]))
                     else:
@@ -489,7 +491,7 @@ class Parser:
         if self.accept_kw("offset"):
             offset = int(self.next()[1])
         return SelectStmt(table, items, where, group, order, limit, knn,
-                          distinct, offset, joins, having)
+                          distinct, offset, joins, having, aliases)
 
     def delete(self):
         self.expect_kw("delete")
