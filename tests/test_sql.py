@@ -750,6 +750,29 @@ class TestAliases:
                 r = await s.execute("SELECT v AS price FROM al "
                                     "ORDER BY price DESC")
                 assert [x["price"] for x in r.rows] == [4.0, 2.0]
+                # ORDER BY the SOURCE name of an aliased column
+                r = await s.execute("SELECT v AS price FROM al "
+                                    "ORDER BY v DESC")
+                assert [x["price"] for x in r.rows] == [4.0, 2.0]
+                assert set(r.rows[0]) == {"price"}   # sort col stripped
+                # ORDER BY a non-projected column
+                r = await s.execute("SELECT k FROM al ORDER BY v DESC")
+                assert [x["k"] for x in r.rows] == [2, 1]
+                assert set(r.rows[0]) == {"k"}
+                # duplicate aggregates with distinct aliases both survive
+                r = await s.execute(
+                    "SELECT k, sum(v) AS a, sum(v) AS b FROM al "
+                    "GROUP BY k ORDER BY k LIMIT 1")
+                assert r.rows[0] == {"k": 1, "a": 2.0, "b": 2.0}
+                # join projection honors aliases
+                await s.execute("CREATE TABLE al2 (k bigint, t double, "
+                                "PRIMARY KEY (k))")
+                await mc.wait_for_leaders("al2")
+                await s.execute("INSERT INTO al2 (k, t) VALUES (1, 7.0)")
+                r = await s.execute(
+                    "SELECT al.k AS id, t AS tax FROM al "
+                    "JOIN al2 ON k = k WHERE al.k = 1")
+                assert r.rows and r.rows[0] == {"id": 1, "tax": 7.0}
             finally:
                 await mc.shutdown()
         run(go())

@@ -81,19 +81,56 @@ class RepackingCompactionFeed(DocDbCompactionFeed):
         out = super().feed(key, value)
         if not out:
             return out
-        from ..dockv.value import ValueKind, unwrap_ttl, wrap_ttl
         k, v = out[0]
-        inner, expire = unwrap_ttl(v)
-        if inner and inner[0] == ValueKind.kPackedRowV2:
-            ver = self.codec.info.packings.version_of(inner, 1)
-            if ver != self._latest:
-                row = self._unpack(self.codec.info.packings.get(ver),
-                                   inner, 1)
-                repacked = self._packer.pack_value(row)
-                v = (wrap_ttl(repacked, expire) if expire is not None
-                     else repacked)
-                return [(k, v)]
-        return out
+        return [_repack_entry(self.codec, self._latest, self._packer,
+                              k, v)]
+
+
+def _repack_entry(codec, latest: int, packer, k: bytes, v: bytes):
+    """Re-encode a surviving packed row with the latest packing,
+    preserving any TTL envelope (shared by the single-table and
+    per-cotable repacking feeds)."""
+    from ..dockv.value import ValueKind, unwrap_ttl, wrap_ttl
+    from ..dockv.packed_row import unpack_row
+    inner, expire = unwrap_ttl(v)
+    if inner and inner[0] == ValueKind.kPackedRowV2:
+        ver = codec.info.packings.version_of(inner, 1)
+        if ver != latest:
+            row = unpack_row(codec.info.packings.get(ver), inner, 1)
+            repacked = packer.pack_value(row)
+            v = (wrap_ttl(repacked, expire) if expire is not None
+                 else repacked)
+    return (k, v)
+
+
+class ColocatedRepackingFeed(DocDbCompactionFeed):
+    """GC + PER-COTABLE schema repacking for colocated tablets: one GC
+    pass over the merged stream, with the repack packing chosen by the
+    key's cotable prefix (reference: cotable-aware SchemaPackingProvider
+    in docdb_compaction_context.cc)."""
+
+    def __init__(self, history_cutoff: int, codecs):
+        super().__init__(history_cutoff)
+        from ..dockv.packed_row import RowPacker
+        self._by_prefix = {}
+        for codec in codecs:
+            prefix = codec.scan_prefix()
+            if not prefix:
+                continue            # parent anchor has no cotable id
+            latest = codec.info.schema.version
+            self._by_prefix[prefix] = (
+                codec, latest,
+                RowPacker(codec.info.packings.get(latest)))
+
+    def feed(self, key: bytes, value: bytes):
+        out = super().feed(key, value)
+        if not out:
+            return out
+        k, v = out[0]
+        ent = self._by_prefix.get(k[:5])
+        if ent is None:
+            return out
+        return [_repack_entry(*ent, k, v)]
 
 
 def tpu_compact(store: LsmStore, codec: TableCodec, history_cutoff: int,

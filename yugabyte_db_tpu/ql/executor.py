@@ -539,10 +539,11 @@ class SqlSession:
                 out.append({k: v for k, v in r.items() if "." not in k})
                 continue
             row = {}
-            for it in stmt.items:
+            for i, it in enumerate(stmt.items):
                 if it[0] == "col":
                     _, bare = self._split_qual(it[1])
-                    row[bare] = r.get(it[1], r.get(bare))
+                    alias = getattr(stmt, "aliases", {}).get(i)
+                    row[alias or bare] = r.get(it[1], r.get(bare))
             out.append(row)
         return SqlResult(self._order_limit(stmt, out))
 
@@ -581,6 +582,12 @@ class SqlSession:
                 idrow = {schema.column_by_name(k).id: v
                          for k, v in row.items()}
                 out[self._item_name(stmt, i)] = eval_expr_py(bound, idrow)
+        # carry ORDER BY source columns through so post-projection sort
+        # works even when they're aliased or not projected; _order_limit
+        # strips them again
+        for col, _ in stmt.order_by:
+            if col not in out and col in row:
+                out[col] = row[col]
         return out
 
     def _order_limit(self, stmt: SelectStmt, rows: List[dict]) -> List[dict]:
@@ -601,6 +608,13 @@ class SqlSession:
             rows = rows[off:]
         if stmt.limit is not None:
             rows = rows[:stmt.limit]
+        # strip sort-only carried columns from the output
+        if stmt.order_by and not any(it[0] == "star"
+                                     for it in stmt.items):
+            projected = {self._item_name(stmt, i)
+                         for i in range(len(stmt.items))}
+            rows = [{k: v for k, v in r.items() if k in projected}
+                    for r in rows]
         return rows
 
     def _agg_row(self, stmt: SelectStmt, values) -> dict:
@@ -737,7 +751,9 @@ class SqlSession:
         """Hash grouping over projected rows (arbitrary-domain GROUP BY)."""
         schema = ct.info.schema
         read_ht = self._txn.start_ht if self._txn is not None else None
-        agg_items = [it for it in stmt.items if it[0] == "agg"]
+        agg_indexed = [(i, it) for i, it in enumerate(stmt.items)
+                       if it[0] == "agg"]
+        agg_items = [it for _, it in agg_indexed]
         refs = self._having_refs(stmt)
         needed = set(stmt.group_by)
         for _, op, e in agg_items:
@@ -763,10 +779,9 @@ class SqlSession:
         rows = []
         for key, st in groups.items():
             row = dict(zip(stmt.group_by, key))
-            for i, it in enumerate(agg_items):
-                idx = stmt.items.index(it)
-                row[self._item_name(stmt, idx)] = _final(bound[i][0],
-                                                         st[i])
+            for j, (idx, it) in enumerate(agg_indexed):
+                row[self._item_name(stmt, idx)] = _final(bound[j][0],
+                                                         st[j])
             for j in range(len(refs)):
                 i = len(agg_items) + j
                 row[f"__h{j}"] = _final(bound[i][0], st[i])

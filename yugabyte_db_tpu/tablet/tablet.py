@@ -177,10 +177,12 @@ class Tablet:
         cutoff = self.history_cutoff()
         multi_version = len(self.codec.info.packings.versions()) > 1
         if self.colocated:
-            # colocated tablets mix schemas per cotable: GC without
-            # repacking (per-cotable repack dispatch is a round-2 item)
+            # colocated tablets mix schemas per cotable: one GC pass
+            # with the repack packing dispatched by cotable prefix
+            from ..docdb.compaction import ColocatedRepackingFeed
             path = self.regular.compact(
-                inputs=inputs, feed=DocDbCompactionFeed(cutoff))
+                inputs=inputs,
+                feed=ColocatedRepackingFeed(cutoff, self.codecs.values()))
         elif flags.get("tpu_compaction_enabled") and not multi_version:
             path = tpu_compact(self.regular, self.codec, cutoff,
                                inputs=inputs)
