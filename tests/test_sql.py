@@ -776,3 +776,63 @@ class TestAliases:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestDropColumn:
+    def test_drop_column_lifecycle(self, tmp_path):
+        async def go():
+            from yugabyte_db_tpu.ql import SqlSession
+            from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute("CREATE TABLE dc (k bigint, v double, "
+                                "s text, PRIMARY KEY (k))")
+                await mc.wait_for_leaders("dc")
+                await s.execute("INSERT INTO dc (k, v, s) VALUES "
+                                "(1, 2.0, 'aa'), (2, 4.0, 'bb')")
+                await s.execute("ALTER TABLE dc DROP COLUMN s")
+                r = await s.execute("SELECT * FROM dc ORDER BY k")
+                assert all("s" not in row for row in r.rows)
+                assert r.rows[0] == {"k": 1, "v": 2.0}
+                # key columns protected; unknown rejected
+                with pytest.raises(Exception):
+                    await s.execute("ALTER TABLE dc DROP COLUMN k")
+                with pytest.raises(Exception):
+                    await s.execute("ALTER TABLE dc DROP COLUMN nope")
+                # indexed columns protected until the index is dropped
+                await s.execute("CREATE INDEX dcv ON dc (v)")
+                await mc.wait_for_leaders("dcv")
+                with pytest.raises(Exception):
+                    await s.execute("ALTER TABLE dc DROP COLUMN v")
+                # combined ADD+DROP with a failing half applies NOTHING
+                with pytest.raises(Exception):
+                    await s.execute("ALTER TABLE dc ADD COLUMN tmp "
+                                    "bigint, DROP COLUMN k")
+                r = await s.execute("SELECT * FROM dc WHERE k = 1")
+                assert "tmp" not in r.rows[0]
+                # compaction repacks without the dropped column
+                peer = next(p for ts in mc.tservers
+                            for p in ts.peers.values())
+                peer.tablet.flush()
+                peer.tablet.compact(major=True)
+                r = await s.execute("SELECT * FROM dc ORDER BY k")
+                assert r.rows[0] == {"k": 1, "v": 2.0}
+                # re-adding the NAME gets a fresh id: old data must NOT
+                # resurface
+                await s.execute("ALTER TABLE dc ADD COLUMN s text")
+                r = await s.execute("SELECT k, s FROM dc ORDER BY k")
+                assert [row["s"] for row in r.rows] == [None, None]
+                await s.execute("INSERT INTO dc (k, v, s) VALUES "
+                                "(3, 6.0, 'new')")
+                r = await s.execute("SELECT s FROM dc WHERE k = 3")
+                assert r.rows[0]["s"] == "new"
+                # survives restart (schema history persisted)
+                await mc.restart_tserver(0)
+                await mc.wait_for_leaders("dc")
+                s2 = SqlSession(mc.client())
+                r = await s2.execute("SELECT * FROM dc ORDER BY k")
+                assert r.rows[0] == {"k": 1, "v": 2.0, "s": None}
+            finally:
+                await mc.shutdown()
+        run(go())

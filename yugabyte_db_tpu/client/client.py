@@ -115,6 +115,26 @@ class YBClient:
         self._tables.pop(name, None)
         return r["schema_version"]
 
+    async def alter_table_drop_columns(self, name: str,
+                                       drop_columns) -> int:
+        r = await self._master_call(
+            "alter_table", {"table": name,
+                            "drop_columns": list(drop_columns)})
+        self._tables.pop(name, None)
+        return r["schema_version"]
+
+    async def alter_table(self, name: str, add_columns=(),
+                          drop_columns=()) -> int:
+        """Combined ADD/DROP in ONE schema change (atomic at the
+        master; a failed validation leaves nothing half-applied)."""
+        r = await self._master_call(
+            "alter_table",
+            {"table": name,
+             "add_columns": [list(c) for c in add_columns],
+             "drop_columns": list(drop_columns)})
+        self._tables.pop(name, None)
+        return r["schema_version"]
+
     async def drop_table(self, name: str) -> None:
         await self._master_call("drop_table", {"name": name})
         self._tables.pop(name, None)

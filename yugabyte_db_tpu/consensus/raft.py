@@ -168,6 +168,11 @@ class RaftConsensus:
 
     async def shutdown(self):
         self._running = False
+        # demote + deregister: a deleted replica must not keep answering
+        # consensus RPCs — a stale "LEADER" would reject pre-votes
+        # forever and log appends would hit its removed WAL directory
+        self.role = Role.FOLLOWER
+        self.messenger.unregister_service(f"consensus-{self.tablet_id}")
         for t in self._tasks:
             t.cancel()
         for _, _, fut in self._commit_waiters:

@@ -383,13 +383,33 @@ class Master:
         ent = self.tables[tid]
         info = TableInfo.from_wire(ent["info"])
         cols = list(info.schema.columns)
-        next_id = max(c.id for c in cols) + 1
+        # ids are never reused, even after DROP COLUMN: a recycled id
+        # would make old packed rows' values resurface under the new
+        # column (reference: ColumnId allocation in catalog_entity_info)
+        next_id = 1 + max(
+            (c.id for sch in (tuple(info.schema_history) + (info.schema,))
+             for c in sch.columns), default=0)
         from ..dockv.packed_row import ColumnSchema as _CS
-        for cname, ctype in payload["add_columns"]:
+        for cname, ctype in payload.get("add_columns", []):
             if any(c.name == cname for c in cols):
                 raise RpcError(f"column {cname} exists", "ALREADY_PRESENT")
             cols.append(_CS(next_id, cname, ctype))
             next_id += 1
+        indexed = {spec.get("column")
+                   for spec in ent.get("indexes", {}).values()}
+        for cname in payload.get("drop_columns", []):
+            target = next((c for c in cols if c.name == cname), None)
+            if target is None:
+                raise RpcError(f"column {cname} not found", "NOT_FOUND")
+            if target.is_hash_key or target.is_range_key:
+                raise RpcError(f"cannot drop key column {cname}",
+                               "INVALID_ARGUMENT")
+            if cname in indexed:
+                raise RpcError(
+                    f"cannot drop column {cname}: a secondary index "
+                    f"depends on it (drop the index first)",
+                    "INVALID_ARGUMENT")
+            cols.remove(target)
         new_schema = TableSchema(columns=tuple(cols),
                                  version=info.schema.version + 1)
         new_info = TableInfo(tid, name, new_schema, info.partition_schema,
@@ -763,6 +783,9 @@ class Master:
                         ent, tablet_id, "wait_catchup", {"peer_uuid": u})
                 except (RpcError, asyncio.TimeoutError, OSError):
                     pass
+        # phase 1: every replica copies (parent group stays at full
+        # strength so each replica's apply barrier can commit+apply its
+        # whole log); phase 2 deletes the parents
         for u in ent["replicas"]:
             ts = self.tservers.get(u)
             if ts is None:
@@ -772,7 +795,21 @@ class Master:
                 {"parent_id": tablet_id, "left_id": left_id,
                  "right_id": right_id, "split_key": split_key,
                  "partition": ent["partition"], "table": info_wire,
-                 "raft_peers": raft_peers}, timeout=60.0)
+                 "raft_peers": raft_peers,
+                 "delete_parent": False}, timeout=60.0)
+        for u in ent["replicas"]:
+            ts = self.tservers.get(u)
+            if ts is None:
+                continue
+            try:
+                await self.messenger.call(
+                    ts["addr"], "tserver", "delete_tablet",
+                    {"tablet_id": tablet_id}, timeout=30.0)
+            except (RpcError, asyncio.TimeoutError, OSError):
+                pass   # replica gone/lagging: catalog del_tablet below
+                       # stops routing; the replica's disk copy is
+                       # orphaned until operator cleanup (a catalog-
+                       # driven GC sweep is future work)
         ops = []
         for child_id, part in ((left_id, [ent["partition"][0], split_key]),
                                (right_id, [split_key, ent["partition"][1]])):

@@ -88,7 +88,8 @@ class SqlSession:
                 if ct is None:
                     raise ValueError(f"unknown type {ctype}")
                 adds.append((cname, ct))
-            v = await self.client.alter_table_add_columns(stmt.table, adds)
+            v = await self.client.alter_table(
+                stmt.table, adds, getattr(stmt, "drop_columns", ()))
             return SqlResult([], f"ALTER TABLE (v{v})")
         if isinstance(stmt, TxnStmt):
             return await self._txn_stmt(stmt)

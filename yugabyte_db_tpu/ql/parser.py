@@ -85,6 +85,7 @@ class CreateIndexStmt:
 class AlterTableStmt:
     table: str
     add_columns: List[Tuple[str, str]]
+    drop_columns: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -305,19 +306,27 @@ class Parser:
         self.expect_kw("table")
         table = self.ident()
         adds = []
-        while self.accept_kw("add"):
-            self.accept_kw("column")
-            cname = self.ident()
-            ctype = self.ident().lower()
-            if self.accept_op("("):
-                self.next()
-                self.expect_op(")")
-            adds.append((cname, ctype))
+        drops: List[str] = []
+        while True:
+            if self.accept_kw("add"):
+                self.accept_kw("column")
+                cname = self.ident()
+                ctype = self.ident().lower()
+                if self.accept_op("("):
+                    self.next()
+                    self.expect_op(")")
+                adds.append((cname, ctype))
+            elif self.accept_kw("drop"):
+                self.accept_kw("column")
+                drops.append(self.ident())
+            else:
+                break
             if not self.accept_op(","):
                 break
-        if not adds:
-            raise ValueError("ALTER TABLE supports ADD COLUMN")
-        return AlterTableStmt(table, adds)
+        if not adds and not drops:
+            raise ValueError(
+                "ALTER TABLE supports ADD COLUMN / DROP COLUMN")
+        return AlterTableStmt(table, adds, drops)
 
     def drop_table(self):
         self.expect_kw("drop")

@@ -187,6 +187,9 @@ class Messenger:
     def register_service(self, name: str, service: object) -> None:
         self.services[name] = service
 
+    def unregister_service(self, name: str) -> None:
+        self.services.pop(name, None)
+
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Tuple[str, int]:
         self._server = await asyncio.start_server(
             self._handle_conn, host, port, ssl=self.tls_server)

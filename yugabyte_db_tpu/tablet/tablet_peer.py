@@ -138,19 +138,38 @@ class TabletPeer:
         elif entry.etype == "alter":
             from ..docdb.table_codec import TableInfo
             d = msgpack.unpackb(entry.payload, raw=False)
+            # flush first: every pre-alter write must sit at-or-below
+            # the flushed frontier so a restart never replays it under
+            # the post-alter codec
+            self.tablet.flush()
             self.tablet.alter_table(TableInfo.from_wire(d["table"]))
             if self.on_alter is not None:
                 self.on_alter(d["table"])
         elif entry.etype == "txn_intents":
             self.participant.apply_intent_entry(entry.payload)
         elif entry.etype == "txn_apply":
-            self.participant.apply_commit_entry(entry.payload)
+            # frontier-covered applies replay as claim-release only; the
+            # regular-store image of the txn is already in the SSTs
+            fr = self.tablet.regular.flushed_frontier().get("op_id")
+            covered = bool(fr) and (entry.term, entry.index) <= (fr[0],
+                                                                 fr[1])
+            self.participant.apply_commit_entry(
+                entry.payload, op_id=(entry.term, entry.index),
+                skip_regular=covered)
         elif entry.etype == "txn_rollback":
             self.participant.apply_rollback_entry(entry.payload)
         elif entry.etype == "txn_status" and self.coordinator is not None:
             self.coordinator.apply_entry(entry.payload)
 
     def _apply_payload(self, entry: LogEntry):
+        # entries at-or-below the flushed frontier are already durable in
+        # SSTs — re-applying them is NOT merely redundant: after a schema
+        # change they would re-encode under the newer codec and resurrect
+        # dropped columns (reference: tablet_bootstrap.cc skips ops
+        # covered by the flushed frontier)
+        fr = self.tablet.regular.flushed_frontier().get("op_id")
+        if fr and (entry.term, entry.index) <= (fr[0], fr[1]):
+            return
         d = msgpack.unpackb(entry.payload, raw=False)
         items = d["batch"] if "batch" in d else [d]
         for item in items:

@@ -460,23 +460,30 @@ class TransactionParticipant:
         self.tablet.intents.apply(batch)
 
     # --- commit/abort ------------------------------------------------------
-    def apply_commit_entry(self, payload: bytes):
+    def apply_commit_entry(self, payload: bytes, op_id=None,
+                           skip_regular: bool = False):
         """Raft apply of 'apply this txn at commit_ht': intents -> regular
-        (reference: transactional-io-path.md:66-70)."""
+        (reference: transactional-io-path.md:66-70). `skip_regular` is
+        the replay path for applies already covered by the flushed
+        frontier: the claims/intents still release, but nothing re-
+        encodes into the regular store (a re-encode under a post-alter
+        codec would resurrect dropped columns)."""
         m = msgpack.unpackb(payload, raw=False)
         txn_id = m["txn_id"]
         commit_ht = m["commit_ht"]
         per_txn = self._intents.pop(txn_id, None) or {}
-        by_table = {}
-        for ent in per_txn.values():
-            if ent is None:
-                continue
-            table_id, op = ent
-            by_table.setdefault(table_id, []).append(
-                RowOp(op[0], op[1], op[2] if len(op) > 2 else None))
-        for table_id, ops in by_table.items():
-            self.tablet.apply_write(WriteRequest(table_id, ops),
-                                    ht=HybridTime(commit_ht))
+        if not skip_regular:
+            by_table = {}
+            for ent in per_txn.values():
+                if ent is None:
+                    continue
+                table_id, op = ent
+                by_table.setdefault(table_id, []).append(
+                    RowOp(op[0], op[1], op[2] if len(op) > 2 else None))
+            for table_id, ops in by_table.items():
+                self.tablet.apply_write(WriteRequest(table_id, ops),
+                                        ht=HybridTime(commit_ht),
+                                        op_id=op_id)
         self._release(txn_id, per_txn.keys())
 
     def apply_rollback_entry(self, payload: bytes):

@@ -348,7 +348,11 @@ class TabletServer:
                and _time.monotonic() < deadline):
             await asyncio.sleep(0.05)
         if parent.consensus.last_applied < parent.log.last_index:
-            raise RpcError("split apply barrier timed out", "TRY_AGAIN")
+            c = parent.consensus
+            raise RpcError(
+                f"split apply barrier timed out (applied="
+                f"{c.last_applied} last={parent.log.last_index})",
+                "TRY_AGAIN")
         if parent.participant._key_holder:
             # in-flight transactions hold intents on this tablet; their
             # provisional writes would be dropped by the copy
@@ -383,8 +387,13 @@ class TabletServer:
         right.tablet.regular.apply(rb)
         left.tablet.flush()
         right.tablet.flush()
-        # drop the parent replica
-        await self.rpc_delete_tablet({"tablet_id": parent_id})
+        # the parent replica is NOT deleted here: the master deletes all
+        # parents in a second phase once every replica has copied —
+        # deleting as-we-go would shrink the parent group under quorum
+        # and the last replica's apply barrier could never commit its
+        # log tail
+        if payload.get("delete_parent", True):
+            await self.rpc_delete_tablet({"tablet_id": parent_id})
         return {"ok": True, "left_rows": len(lb), "right_rows": len(rb)}
 
     async def rpc_flush(self, payload) -> dict:
