@@ -836,3 +836,58 @@ class TestDropColumn:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestAnalyze:
+    def test_analyze_enables_device_group_pushdown(self, tmp_path):
+        async def go():
+            from yugabyte_db_tpu.ql import SqlSession
+            from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute("CREATE TABLE an (k bigint, region bigint, "
+                                "big bigint, amt double, PRIMARY KEY (k))")
+                await mc.wait_for_leaders("an")
+                await s.execute(
+                    "INSERT INTO an (k, region, big, amt) VALUES " +
+                    ", ".join(f"({i}, {i % 4}, {i * 100000}, {float(i)})"
+                              for i in range(20)))
+                r = await s.execute("EXPLAIN SELECT region, sum(amt) "
+                                    "FROM an GROUP BY region")
+                assert "client hash" in r.rows[0]["QUERY PLAN"]
+                r = await s.execute("ANALYZE an")
+                cols = {row["column"]: (row["domain"], row["offset"])
+                        for row in r.rows}
+                assert cols["region"] == (4, 0)
+                assert "big" not in cols    # domain too wide
+                r = await s.execute("EXPLAIN SELECT region, sum(amt) "
+                                    "FROM an GROUP BY region")
+                assert "DEVICE pushdown" in r.rows[0]["QUERY PLAN"]
+                # results agree with the client-side path
+                r = await s.execute("SELECT region, sum(amt) AS t FROM an "
+                                    "GROUP BY region ORDER BY region")
+                assert [row["t"] for row in r.rows] == [
+                    sum(float(i) for i in range(20) if i % 4 == g)
+                    for g in range(4)]
+                # DML invalidates the correctness-bearing stats: a row
+                # outside the recorded domain must NOT clip into group 3
+                await s.execute("INSERT INTO an (k, region, big, amt) "
+                                "VALUES (100, 9, 0, 1000.0)")
+                r = await s.execute("EXPLAIN SELECT region, sum(amt) "
+                                    "FROM an GROUP BY region")
+                assert "client hash" in r.rows[0]["QUERY PLAN"]
+                r = await s.execute("SELECT region, sum(amt) AS t FROM an "
+                                    "GROUP BY region ORDER BY region")
+                assert r.rows[-1]["region"] == 9 and r.rows[-1]["t"] == 1000.0
+                # NULL-bearing columns are skipped by ANALYZE
+                await s.execute("ALTER TABLE an ADD COLUMN maybe bigint")
+                await s.execute("INSERT INTO an (k, region, big, amt, "
+                                "maybe) VALUES (101, 1, 0, 1.0, 2)")
+                r = await s.execute("ANALYZE an")
+                cols = {row["column"] for row in r.rows}
+                assert "maybe" not in cols   # old rows have NULL maybe
+                assert "region" in cols
+            finally:
+                await mc.shutdown()
+        run(go())

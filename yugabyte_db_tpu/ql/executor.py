@@ -23,9 +23,9 @@ from ..dockv.packed_row import ColumnSchema, ColumnType, TableSchema
 from ..dockv.partition import PartitionSchema
 from ..ops.scan import AggSpec, GroupSpec
 from .parser import (
-    AlterTableStmt, CreateIndexStmt, CreateTableStmt, DeleteStmt,
-    DropTableStmt, ExplainStmt, InsertStmt, SelectStmt, TxnStmt,
-    UpdateStmt, parse_statement,
+    AlterTableStmt, AnalyzeStmt, CreateIndexStmt, CreateTableStmt,
+    DeleteStmt, DropTableStmt, ExplainStmt, InsertStmt, SelectStmt,
+    TxnStmt, UpdateStmt, parse_statement,
 )
 
 _TYPE_MAP = {
@@ -105,6 +105,8 @@ class SqlSession:
             return SqlResult([], f"CREATE INDEX ({n} rows)")
         if isinstance(stmt, ExplainStmt):
             return await self._explain(stmt.inner)
+        if isinstance(stmt, AnalyzeStmt):
+            return await self._analyze(stmt)
         if isinstance(stmt, SelectStmt):
             if stmt.knn is not None:
                 return await self._knn_select(stmt)
@@ -126,6 +128,56 @@ class SqlSession:
         it = stmt.items[idx]
         return (it[1] if it[0] == "col" else
                 _agg_name(it) if it[0] == "agg" else _expr_name(it[1]))
+
+    # max distinct-domain width eligible for device GROUP BY (one-hot
+    # matmul columns scale with the domain product)
+    _ANALYZE_MAX_DOMAIN = 4096
+
+    async def _analyze(self, stmt: AnalyzeStmt) -> SqlResult:
+        """Collect small-domain integer column stats so grouped
+        aggregates route to the DEVICE one-hot kernel automatically
+        (reference: ANALYZE feeding the PG planner; ours feeds the
+        group-pushdown eligibility check). Unlike PG, these stats are
+        correctness-bearing for the device kernel (it clips values to
+        the recorded domain), so DML on the table invalidates them —
+        re-run ANALYZE after loading data. Columns are skipped when
+        NULLs exist (the device kernel has no NULL group slot) or when
+        values fall outside int32 (the kernel's group dtype)."""
+        ct = await self.client._table(stmt.table)
+        schema = ct.info.schema
+        int_cols = [c for c in schema.columns
+                    if c.type in (ColumnType.INT32, ColumnType.INT64)
+                    and not c.is_hash_key and not c.is_range_key]
+        # ONE scan carries every column's min/max/count + count(*)
+        aggs = [AggSpec("count")]
+        for c in int_cols:
+            aggs += [AggSpec("min", ("col", c.id)),
+                     AggSpec("max", ("col", c.id)),
+                     AggSpec("count", ("col", c.id))]
+        resp = await self.client.scan(stmt.table, ReadRequest(
+            "", aggregates=tuple(aggs)))
+        total = _scalar(resp.agg_values[0])
+        st = {}
+        i32 = 2 ** 31 - 1
+        for j, c in enumerate(int_cols):
+            lo = _scalar(resp.agg_values[1 + 3 * j])
+            hi = _scalar(resp.agg_values[2 + 3 * j])
+            nn = _scalar(resp.agg_values[3 + 3 * j])
+            if lo is None or hi is None:
+                continue
+            if nn != total:
+                continue        # NULLs present: no device NULL group
+            lo, hi = int(lo), int(hi)
+            if lo < -i32 or hi > i32:
+                continue        # outside the kernel's int32 group dtype
+            domain = hi - lo + 1
+            if 0 < domain <= self._ANALYZE_MAX_DOMAIN:
+                st[c.name] = (domain, lo)
+        self.stats[stmt.table] = st
+        return SqlResult(
+            [{"column": k, "domain": d, "offset": o}
+             for k, (d, o) in sorted(st.items())],
+            f"ANALYZE ({len(st)} columns)")
 
     # ------------------------------------------------------------------
     async def _explain(self, stmt) -> SqlResult:
@@ -265,7 +317,14 @@ class SqlSession:
             replication_factor=stmt.replication_factor)
         return SqlResult([], "CREATE TABLE")
 
+    def _invalidate_stats(self, table: str) -> None:
+        """Device-group stats are correctness-bearing (the kernel clips
+        to the recorded domain): any DML or DDL on the table voids
+        them until the next ANALYZE."""
+        self.stats.pop(table, None)
+
     async def _drop(self, stmt: DropTableStmt) -> SqlResult:
+        self._invalidate_stats(stmt.name)
         if stmt.if_exists:
             names = {t["name"] for t in await self.client.list_tables()}
             if stmt.name not in names:
@@ -274,6 +333,7 @@ class SqlSession:
         return SqlResult([], "DROP TABLE")
 
     async def _insert(self, stmt: InsertStmt) -> SqlResult:
+        self._invalidate_stats(stmt.table)
         ct = await self.client._table(stmt.table)
         cols = stmt.columns or [c.name for c in ct.info.schema.columns]
         vec_cols = {c.name for c in ct.info.schema.columns
@@ -812,6 +872,7 @@ class SqlSession:
 
     # ------------------------------------------------------------------
     async def _delete(self, stmt: DeleteStmt) -> SqlResult:
+        self._invalidate_stats(stmt.table)
         if stmt.where is not None:
             stmt.where = await self._resolve_subqueries(stmt.where)
         ct = await self.client._table(stmt.table)
@@ -830,6 +891,7 @@ class SqlSession:
         return SqlResult([], f"DELETE {n}")
 
     async def _update(self, stmt: UpdateStmt) -> SqlResult:
+        self._invalidate_stats(stmt.table)
         if stmt.where is not None:
             stmt.where = await self._resolve_subqueries(stmt.where)
         ct = await self.client._table(stmt.table)

@@ -31,7 +31,7 @@ KEYWORDS = {
     "as", "hash", "with", "tablets", "replication", "if", "exists",
     "index", "on", "using", "lists", "ttl", "begin", "commit",
     "rollback", "transaction", "distinct", "offset", "like", "having",
-    "explain",
+    "explain", "analyze",
     "alter", "add", "column", "join", "inner", "left", "outer",
 }
 
@@ -105,6 +105,11 @@ class InsertStmt:
 @dataclass
 class ExplainStmt:
     inner: object
+
+
+@dataclass
+class AnalyzeStmt:
+    table: str
 
 
 @dataclass
@@ -206,12 +211,14 @@ class Parser:
             self.next()
             inner = self.parse()
             return ExplainStmt(inner)
+
         fn = {
             "create": self.create_table, "drop": self.drop_table,
             "insert": self.insert, "select": self.select,
             "delete": self.delete, "update": self.update,
             "begin": self.txn_stmt, "commit": self.txn_stmt,
             "rollback": self.txn_stmt, "alter": self.alter_table,
+            "analyze": self.analyze,
         }.get(word)
         if fn is None:
             raise ValueError(f"unsupported statement {word!r}")
@@ -220,6 +227,10 @@ class Parser:
         if self.peek() is not None:
             raise ValueError(f"trailing tokens at {self.peek()}")
         return stmt
+
+    def analyze(self):
+        self.expect_kw("analyze")
+        return AnalyzeStmt(self.ident())
 
     def create_table(self):
         self.expect_kw("create")
