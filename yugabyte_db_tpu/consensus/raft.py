@@ -136,6 +136,7 @@ class RaftConsensus:
         self._lease_blocked_until = 0.0
         self._last_heartbeat = time.monotonic()
         self._election_deadline = self._new_election_deadline()
+        self.term_start_index = 0          # set at _become_leader
         self._last_leader_contact = 0.0    # for pre-vote freshness checks
         self._commit_waiters: List[Tuple[int, asyncio.Future]] = []
         self.on_config_change = on_config_change
@@ -311,6 +312,10 @@ class RaftConsensus:
     async def _become_leader(self):
         self.role = Role.LEADER
         self.leader_uuid = self.uuid
+        # state machines gate reads on this: everything up to (and
+        # incl.) our term-opening noop must be APPLIED before the new
+        # leader's view is current (reference: leader_ready gating)
+        self.term_start_index = self.log.last_index + 1
         for p in self.config.others(self.uuid):
             self.next_index[p.uuid] = self.log.last_index + 1
             self.match_index[p.uuid] = 0

@@ -103,11 +103,20 @@ class Master:
         await self.consensus.replicate("write", _mp.packb(ops))
 
     def _check_leader(self) -> None:
-        if self.consensus is not None and not self.consensus.is_leader():
+        if self.consensus is None:
+            return
+        if not self.consensus.is_leader():
             raise RpcError(
                 f"not the leader master "
                 f"(hint={self.consensus.leader_hint()})",
                 "LEADER_NOT_READY")
+        # a freshly-elected leader may not have APPLIED its whole
+        # catalog log yet; gate on the TERM-START index (not the live
+        # last_index — that would spuriously reject during any
+        # in-flight catalog write) (reference: leader_ready gating)
+        if self.consensus.last_applied < self.consensus.term_start_index:
+            raise RpcError("leader catalog still loading",
+                           "LEADER_NOT_READY")
 
     def is_leader(self) -> bool:
         return self.consensus is None or self.consensus.is_leader()
@@ -477,6 +486,7 @@ class Master:
 
     # --- lookups ----------------------------------------------------------
     async def rpc_get_table(self, payload) -> dict:
+        self._check_leader()
         name = payload.get("name")
         table_id = payload.get("table_id")
         for tid, e in self.tables.items():
@@ -674,6 +684,7 @@ class Master:
     async def rpc_list_snapshot_schedules(self, payload) -> dict:
         """List schedules (optionally for one table) with their retained
         snapshots (reference: yb-admin list_snapshot_schedules)."""
+        self._check_leader()
         name = payload.get("table")
         out = {}
         for tid, e in self.tables.items():
@@ -864,6 +875,7 @@ class Master:
         return {"ok": True}
 
     async def rpc_list_xcluster_replication(self, payload) -> dict:
+        self._check_leader()
         return {"replication": dict(self.xcluster_replication),
                 "running": sorted(self._xcluster_tasks),
                 "safe_time": dict(self._xcluster_safe_time)}
@@ -955,6 +967,7 @@ class Master:
         raise RpcError("stream not found", "NOT_FOUND")
 
     async def rpc_get_cdc_stream(self, payload) -> dict:
+        self._check_leader()
         for tid, e in self.tables.items():
             if payload["stream_id"] in e.get("cdc_streams", {}):
                 return {"table": e["info"]["name"],
@@ -1052,6 +1065,7 @@ class Master:
         """Return (creating on demand) the transaction status tablet
         (reference: client-side status-tablet picking,
         client/transaction_pool.cc; system `transactions` table)."""
+        self._check_leader()
         name = "system.transactions"
         for tid, e in self.tables.items():
             if e["info"]["name"] == name:
@@ -1070,6 +1084,7 @@ class Master:
         return {"locations": self._locations(resp["table_id"])}
 
     async def rpc_list_tables(self, payload) -> dict:
+        self._check_leader()
         return {"tables": [
             {"table_id": tid, "name": e["info"]["name"],
              "num_tablets": len(e["tablets"])}
