@@ -891,3 +891,47 @@ class TestAnalyze:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestInsertSelect:
+    def test_insert_from_select(self, tmp_path):
+        async def go():
+            from yugabyte_db_tpu.ql import SqlSession
+            from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute("CREATE TABLE src (k bigint, v double, "
+                                "PRIMARY KEY (k))")
+                await s.execute("CREATE TABLE dst (k bigint, v double, "
+                                "PRIMARY KEY (k))")
+                for t in ("src", "dst"):
+                    await mc.wait_for_leaders(t)
+                await s.execute("INSERT INTO src (k, v) VALUES "
+                                "(1, 5.0), (2, 6.0), (3, 7.0)")
+                r = await s.execute("INSERT INTO dst (k, v) "
+                                    "SELECT k, v FROM src WHERE v > 5.5")
+                assert "2" in r.status
+                r = await s.execute("SELECT count(*) AS n FROM dst")
+                assert r.rows[0]["n"] == 2
+                # expression projection + alias maps by position
+                await s.execute("INSERT INTO dst (k, v) "
+                                "SELECT k + 100 AS nk, v FROM src "
+                                "WHERE k = 1")
+                r = await s.execute("SELECT v FROM dst WHERE k = 101")
+                assert r.rows[0]["v"] == 5.0
+                # duplicate select columns map by position
+                await s.execute("INSERT INTO dst (k, v) "
+                                "SELECT k + 200, k FROM src WHERE k = 1")
+                r = await s.execute("SELECT v FROM dst WHERE k = 201")
+                assert r.rows[0]["v"] == 1.0
+                # column-count mismatch rejected; empty select inserts 0
+                with pytest.raises(Exception):
+                    await s.execute("INSERT INTO dst (k, v) "
+                                    "SELECT k FROM src")
+                r = await s.execute("INSERT INTO dst (k, v) "
+                                    "SELECT k, v FROM src WHERE v > 99")
+                assert r.status == "INSERT 0"
+            finally:
+                await mc.shutdown()
+        run(go())

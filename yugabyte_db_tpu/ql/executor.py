@@ -336,6 +336,22 @@ class SqlSession:
         self._invalidate_stats(stmt.table)
         ct = await self.client._table(stmt.table)
         cols = stmt.columns or [c.name for c in ct.info.schema.columns]
+        if getattr(stmt, "select", None) is not None:
+            # INSERT INTO ... SELECT: run the select, map by POSITION.
+            # Unaliased items get unique hidden aliases first so
+            # duplicate output names (SELECT k, k) can't collapse in
+            # the row dicts; user aliases are kept for ORDER BY refs.
+            sub = stmt.select
+            if not any(it[0] == "star" for it in sub.items):
+                sub.aliases = {
+                    i: sub.aliases.get(i, f"__c{i}")
+                    for i in range(len(sub.items))}
+            res = await self._select(sub)
+            stmt = InsertStmt(
+                stmt.table, stmt.columns,
+                [list(r.values()) for r in res.rows], stmt.ttl_ms)
+            if not stmt.rows:
+                return SqlResult([], "INSERT 0")
         vec_cols = {c.name for c in ct.info.schema.columns
                     if c.type == ColumnType.VECTOR}
         rows = []
@@ -344,7 +360,8 @@ class SqlSession:
                 raise ValueError("column/value count mismatch")
             row = dict(zip(cols, vals))
             for vc in vec_cols & set(row):
-                if row[vc] is not None:
+                if row[vc] is not None and not isinstance(
+                        row[vc], (bytes, bytearray)):
                     row[vc] = parse_vector(row[vc]).tobytes()
             rows.append(row)
         if self._txn is not None:

@@ -100,6 +100,7 @@ class InsertStmt:
     columns: List[str]
     rows: List[List[object]]
     ttl_ms: Optional[int] = None
+    select: Optional["SelectStmt"] = None   # INSERT INTO ... SELECT
 
 
 @dataclass
@@ -359,6 +360,14 @@ class Parser:
                 if not self.accept_op(","):
                     break
             self.expect_op(")")
+        t = self.peek()
+        if t and t[0] == "kw" and t[1].lower() == "select":
+            sub = self.select()
+            ttl_ms = None
+            if self.accept_kw("using"):
+                self.expect_kw("ttl")
+                ttl_ms = int(float(self.next()[1]) * 1000)
+            return InsertStmt(table, cols, [], ttl_ms, sub)
         self.expect_kw("values")
         rows = []
         while True:
