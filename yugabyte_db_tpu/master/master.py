@@ -3,11 +3,11 @@
 Analog of the reference's yb-master (reference: src/yb/master/ —
 CatalogManager catalog_manager.cc:4444 CreateTable, TS registry
 ts_manager.cc, heartbeats master_heartbeat_service.cc:403, sys catalog
-sys_catalog.cc). This round persists the sys catalog as an atomically-
-replaced JSON snapshot journaled through the same Raft log type used by
-tablets (single-master group); multi-master Raft is a planned round-2
-step — the state machine boundary (`_apply_catalog_mutation`) is
-already shaped for it.
+sys_catalog.cc). The sys catalog persists as an atomically-replaced
+JSON snapshot journaled through the same Raft log type used by
+tablets; multi-master groups replicate catalog deltas through
+`start_consensus` (leader serves DDL, reads gate on term-start
+catch-up).
 """
 from __future__ import annotations
 
