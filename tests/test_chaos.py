@@ -1,0 +1,156 @@
+"""Mixed-workload chaos: concurrent writes + txn transfers while the
+cluster splits, moves replicas, snapshots, compacts, and restarts a
+tserver (reference analog: tablet-split-itest.cc with workload +
+ts-itest restarts). Invariants: no acked write lost, bank total
+conserved."""
+import asyncio
+import random
+
+import pytest
+
+from yugabyte_db_tpu.docdb import ReadRequest
+from yugabyte_db_tpu.docdb.table_codec import TableInfo
+from yugabyte_db_tpu.dockv.packed_row import (
+    ColumnSchema, ColumnType, TableSchema,
+)
+from yugabyte_db_tpu.dockv.partition import PartitionSchema
+from yugabyte_db_tpu.ops import AggSpec
+from yugabyte_db_tpu.rpc import RpcError
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+from tests.test_ops_features import kv_info, run
+
+# transient faults the workload must RIDE THROUGH (client retry
+# exhaustion surfaces TimeoutError/OSError, not just RpcError)
+_TRANSIENT = (RpcError, asyncio.TimeoutError, OSError, RuntimeError)
+
+
+class TestChaos:
+    def test_workload_survives_ops_storm(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=2).start()
+            try:
+                c = mc.client()
+                await c.create_table(kv_info("wl"), num_tablets=2)
+                await c.create_table(kv_info("bank"), num_tablets=2)
+                for t in ("wl", "bank"):
+                    await mc.wait_for_leaders(t)
+                await c.insert("bank", [{"k": i, "v": 100.0}
+                                        for i in range(8)])
+                await c._master_call("get_status_tablet", {})
+                await mc.wait_for_leaders("system.transactions")
+
+                acked = set()
+                stop = asyncio.Event()
+
+                async def writer(wid):
+                    i = 0
+                    while not stop.is_set():
+                        k = wid * 100000 + i
+                        try:
+                            await c.insert("wl", [{"k": k,
+                                                   "v": float(k)}])
+                            acked.add(k)
+                        except _TRANSIENT:
+                            pass      # retried ops may fail mid-move
+                        i += 1
+                        await asyncio.sleep(0.002)
+
+                async def transferer(seed):
+                    rng = random.Random(seed)
+                    while not stop.is_set():
+                        a, b = rng.sample(range(8), 2)
+                        t = None
+                        try:
+                            t = await c.transaction().begin()
+                            ra = await t.get("bank", {"k": a})
+                            rb = await t.get("bank", {"k": b})
+                            amt = rng.uniform(0, 10)
+                            await t.insert("bank", [
+                                {"k": a, "v": ra["v"] - amt},
+                                {"k": b, "v": rb["v"] + amt}])
+                            await t.commit()
+                        except _TRANSIENT:
+                            if t is not None and t.state == "PENDING":
+                                try:
+                                    await t.abort()
+                                except _TRANSIENT:
+                                    pass
+                        await asyncio.sleep(0.01)
+
+                workers = [asyncio.create_task(writer(w))
+                           for w in range(3)]
+                workers += [asyncio.create_task(transferer(s))
+                            for s in range(2)]
+
+                async def ops_storm():
+                    ct = await c._table("wl")
+                    parent = ct.locations[0].tablet_id
+                    await c._master_call("split_tablet",
+                                         {"tablet_id": parent},
+                                         timeout=60.0)
+                    await asyncio.sleep(0.5)
+                    for ts in mc.tservers:
+                        for p in list(ts.peers.values()):
+                            p.tablet.flush()
+                    snap = await c._master_call(
+                        "create_snapshot", {"table": "bank"},
+                        timeout=60.0)
+                    assert snap["snapshot_id"]
+                    # move one wl replica to the other tserver
+                    ct = await c._table("wl", refresh=True)
+                    loc = ct.locations[0]
+                    src = loc.replicas[0][0]
+                    dst = next(t.uuid for t in mc.tservers
+                               if t.uuid != src)
+                    try:
+                        await c._master_call(
+                            "move_replica",
+                            {"tablet_id": loc.tablet_id,
+                             "from": src, "to": dst}, timeout=60.0)
+                    except RpcError:
+                        pass          # moves may legitimately collide
+                    await asyncio.sleep(0.5)
+                    for ts in mc.tservers:
+                        for p in list(ts.peers.values()):
+                            if p.is_leader():
+                                await asyncio.get_running_loop() \
+                                    .run_in_executor(
+                                        None,
+                                        lambda p=p: p.tablet.compact(
+                                            major=False))
+
+                await ops_storm()
+                await asyncio.sleep(1.0)
+                stop.set()
+                results = await asyncio.gather(*workers,
+                                               return_exceptions=True)
+                unexpected = [r for r in results
+                              if isinstance(r, BaseException)]
+                assert not unexpected, unexpected   # no worker died
+                # the workload must have actually run
+                assert len(acked) > 50, len(acked)
+
+                # restart a tserver mid-state, then verify
+                await mc.restart_tserver(0)
+                for t in ("wl", "bank"):
+                    await mc.wait_for_leaders(t)
+                c2 = mc.client()
+                # every acked write is readable
+                rng = random.Random(7)
+                sample = (rng.sample(sorted(acked), 50)
+                          if len(acked) > 50 else sorted(acked))
+                for k in sample:
+                    row = await c2.get("wl", {"k": k})
+                    assert row is not None and row["v"] == float(k), k
+                agg = await c2.scan("wl", ReadRequest(
+                    "", aggregates=(AggSpec("count"),)))
+                assert int(agg.agg_values[0]) >= len(acked)
+                # bank conservation
+                await asyncio.sleep(0.5)
+                total = 0.0
+                for i in range(8):
+                    total += (await c2.get("bank", {"k": i}))["v"]
+                assert abs(total - 800.0) < 1e-6, total
+            finally:
+                await mc.shutdown()
+        run(go())
