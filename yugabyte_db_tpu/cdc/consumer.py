@@ -17,7 +17,7 @@ import asyncio
 from typing import Dict, List, Optional, Tuple
 
 from ..client import YBClient
-from ..docdb.operations import RowOp
+from ..docdb.operations import ReadRequest, RowOp
 from ..rpc.messenger import RpcError
 
 
@@ -164,10 +164,69 @@ class XClusterReplicator:
         shts = dict(self.stream._tablet_safe_ht)
         try:
             return await self._step_inner()
-        except Exception:
+        except Exception as e:
             self.stream.checkpoints = cps
             self.stream._tablet_safe_ht = shts
+            if isinstance(e, RpcError) and e.code == "CACHE_MISS_ERROR":
+                # WAL GC outran the stream (or setup started on a table
+                # with trimmed history): full resync, then stream from
+                # the recorded tails
+                return await self.resync()
             raise
+
+    async def resync(self) -> int:
+        """Bootstrap/recovery copy (reference: xCluster bootstrap via
+        snapshot + stream-from-checkpoint). Ordering that makes it
+        correct:
+        1. record each source tablet's log tail (held below any live
+           txn's first intent so its commit can replay);
+        2. pick a source snapshot HT R and copy rows AT R, writing
+           them at external_ht=R — changes after R stream with
+           ht > R and therefore win over the copy in target MVCC;
+        3. deletes reconcile: target rows absent from the source
+           snapshot are deleted at R (the WAL holding their delete
+           may be GC'd).
+        Changes between tail-record and the scan replay from the
+        stream and re-apply idempotently above R."""
+        src = self.stream.client
+        ct = await src._table(self.table, refresh=True)
+        tails = {}
+        snapshot_ht = 0
+        for loc in ct.locations:
+            r = await src._call_leader(
+                ct, loc.tablet_id, "get_changes",
+                {"tablet_id": loc.tablet_id, "from_index": -1})
+            tails[loc.tablet_id] = r["checkpoint"]
+            snapshot_ht = max(snapshot_ht, r.get("safe_ht") or 0)
+        pk_names = [c.name for c in ct.info.schema.key_columns]
+        n = 0
+        src_pks = set()
+        async for page in src.scan_pages(
+                self.table, ReadRequest("", read_ht=snapshot_ht or None),
+                page_size=2000):
+            for r in page:
+                src_pks.add(tuple(r[k] for k in pk_names))
+            await self.target.write(
+                self.table, [RowOp("upsert", r) for r in page],
+                external_ht=snapshot_ht or None)
+            n += len(page)
+        # reconcile deletes that happened during the unstreamable gap
+        stale = []
+        async for page in self.target.scan_pages(
+                self.table, ReadRequest("", columns=tuple(pk_names)),
+                page_size=2000):
+            for r in page:
+                if tuple(r[k] for k in pk_names) not in src_pks:
+                    stale.append({k: r[k] for k in pk_names})
+        if stale:
+            await self.target.write(
+                self.table, [RowOp("delete", r) for r in stale],
+                external_ht=snapshot_ht or None)
+        self.stream.checkpoints = dict(tails)
+        self.stream._pending_txns.clear()
+        await self.stream.commit_checkpoints()
+        self.replicated += n
+        return n
 
     async def _step_inner(self) -> int:
         changes = await self.stream.poll()

@@ -146,7 +146,8 @@ class TabletPeer:
             if self.on_alter is not None:
                 self.on_alter(d["table"])
         elif entry.etype == "txn_intents":
-            self.participant.apply_intent_entry(entry.payload)
+            self.participant.apply_intent_entry(entry.payload,
+                                                log_index=entry.index)
         elif entry.etype == "txn_apply":
             # frontier-covered applies replay as claim-release only; the
             # regular-store image of the txn is already in the SSTs

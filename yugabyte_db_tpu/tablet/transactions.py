@@ -233,6 +233,7 @@ class TransactionParticipant:
         self._read_holders: Dict[bytes, Set[str]] = {}
         self._txn_reads: Dict[str, Set[bytes]] = {}
         self._txn_meta: Dict[str, dict] = {}          # txn_id -> {start_ht}
+        self._intent_log_index: Dict[str, int] = {}   # txn_id -> first idx
         self._waiters: List[_Waiter] = []
         self.wait_timeout = 5.0
 
@@ -441,10 +442,12 @@ class TransactionParticipant:
         finally:
             meta.pop("probing", None)
 
-    def apply_intent_entry(self, payload: bytes):
+    def apply_intent_entry(self, payload: bytes, log_index: int = 0):
         """Raft apply of an intent batch: record in IntentsDB + memory."""
         m = msgpack.unpackb(payload, raw=False)
         txn_id = m["txn_id"]
+        if log_index and txn_id not in self._intent_log_index:
+            self._intent_log_index[txn_id] = log_index
         per_txn = self._intents.setdefault(txn_id, {})
         meta = self._txn_meta.setdefault(txn_id,
                                          {"start_ht": m["start_ht"]})
@@ -471,6 +474,7 @@ class TransactionParticipant:
         m = msgpack.unpackb(payload, raw=False)
         txn_id = m["txn_id"]
         commit_ht = m["commit_ht"]
+        self._intent_log_index.pop(txn_id, None)
         per_txn = self._intents.pop(txn_id, None) or {}
         if not skip_regular:
             by_table = {}
@@ -489,6 +493,7 @@ class TransactionParticipant:
     def apply_rollback_entry(self, payload: bytes):
         m = msgpack.unpackb(payload, raw=False)
         txn_id = m["txn_id"]
+        self._intent_log_index.pop(txn_id, None)
         per_txn = self._intents.pop(txn_id, None) or {}
         self._release(txn_id, per_txn.keys())
 
@@ -508,6 +513,12 @@ class TransactionParticipant:
         for w in self._waiters:
             if txn_id in w.blockers:
                 w.event.set()
+
+    def oldest_live_intent_index(self):
+        """Log index of the oldest intent batch whose txn is undecided
+        (None when no txn is live) — resync tail-seeks must not skip
+        past it or the commit replay would find no buffered intents."""
+        return min(self._intent_log_index.values(), default=None)
 
     def release_reads(self, txn_id: str) -> None:
         """Drop a txn's read locks (client-driven at commit/abort for

@@ -526,6 +526,21 @@ class TabletServer:
         peer = self._peer(payload["tablet_id"])
         from_index = payload.get("from_index", 0)
         limit = payload.get("limit", 1000)
+        if from_index < 0:
+            # tail seek (resync bootstrap): report the current committed
+            # position — held back below any LIVE txn's first intent so
+            # its eventual commit can re-read the intents — without any
+            # changes, so the consumer streams from "now" after a full
+            # copy
+            tail = peer.consensus.commit_index
+            oldest = peer.participant.oldest_live_intent_index()
+            if oldest is not None:
+                tail = min(tail, oldest - 1)
+            return {"changes": [],
+                    "checkpoint": tail,
+                    "safe_ht": peer.xcluster_safe_ht(
+                        self.clock.now().value)
+                    if peer.is_leader() else 0}
         if from_index + 1 < peer.log._first_index:
             # WAL GC trimmed past this consumer's checkpoint — the gap is
             # unrecoverable from the log; the consumer must resync
