@@ -935,3 +935,40 @@ class TestInsertSelect:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestNaturalOrderPushdown:
+    def test_range_pk_order_by_pushes_limit(self, tmp_path):
+        async def go():
+            from yugabyte_db_tpu.ql import SqlSession
+            from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute("CREATE TABLE ro (k bigint, v double, "
+                                "PRIMARY KEY (k ASC))")
+                await mc.wait_for_leaders("ro")
+                await s.execute("INSERT INTO ro (k, v) VALUES " + ", ".join(
+                    f"({i}, {float(i)})" for i in range(50)))
+                r = await s.execute("EXPLAIN SELECT k FROM ro "
+                                    "ORDER BY k LIMIT 3")
+                text = "\n".join(row["QUERY PLAN"] for row in r.rows)
+                assert "natural range-shard" in text and "pushed down" in text
+                r = await s.execute("SELECT k FROM ro ORDER BY k LIMIT 3")
+                assert [x["k"] for x in r.rows] == [0, 1, 2]
+                # with a predicate
+                r = await s.execute("SELECT k FROM ro WHERE k >= 40 "
+                                    "ORDER BY k LIMIT 5")
+                assert [x["k"] for x in r.rows] == [40, 41, 42, 43, 44]
+                # DESC over an ASC pk is NOT natural: client sort, right
+                # answer anyway
+                r = await s.execute("EXPLAIN SELECT k FROM ro "
+                                    "ORDER BY k DESC LIMIT 2")
+                text = "\n".join(row["QUERY PLAN"] for row in r.rows)
+                assert "client-side sort" in text
+                r = await s.execute("SELECT k FROM ro ORDER BY k DESC "
+                                    "LIMIT 2")
+                assert [x["k"] for x in r.rows] == [49, 48]
+            finally:
+                await mc.shutdown()
+        run(go())

@@ -287,8 +287,12 @@ class YBClient:
             await self._call_leader(ct, loc.tablet_id, "read", payload))
         return resp.rows[0] if resp.rows else None
 
-    async def scan(self, table: str, req: ReadRequest) -> ReadResponse:
-        """Fan out to every tablet; combine rows or partial aggregates."""
+    async def scan(self, table: str, req: ReadRequest,
+                   keep_all: bool = False) -> ReadResponse:
+        """Fan out to every tablet; combine rows or partial aggregates.
+        keep_all: skip the union-level LIMIT trim (callers that sort
+        client-side need every tablet's top-N, not the first N of an
+        arbitrary tablet order)."""
         ct = await self._table(table)
         req.table_id = ct.info.table_id
 

@@ -256,12 +256,18 @@ class SqlSession:
                     if stmt.where is not None:
                         lines.append("  Filter: pushed to tablets "
                                      "(device mask when columnar)")
+                    natural = self._natural_order(ct, stmt.order_by)
                     if stmt.order_by:
-                        lines.append("  Order By: client-side sort")
+                        lines.append(
+                            "  Order By: natural range-shard pk order "
+                            "(per-tablet merge)" if natural
+                            else "  Order By: client-side sort")
                     if stmt.limit is not None:
+                        push = (not (stmt.distinct or stmt.offset)
+                                and (natural or not stmt.order_by))
                         lines.append(
                             f"  Limit {stmt.limit}: "
-                            f"{'pushed down' if push_limit else 'client-side'}")
+                            f"{'pushed down' if push else 'client-side'}")
             if self._is_serializable():
                 lines.append("  Locks: SERIALIZABLE row read locks "
                              "on the read set")
@@ -493,11 +499,14 @@ class SqlSession:
         # plain row scan; LIMIT pushes down only when no client-side
         # reordering/dedup/offset must happen first
         columns = self._needed_columns(stmt, schema)
-        push_limit = (None if (stmt.order_by or stmt.distinct or stmt.offset)
-                      else stmt.limit)
+        natural = self._natural_order(ct, stmt.order_by)
+        push_limit = (stmt.limit
+                      if not (stmt.distinct or stmt.offset)
+                      and (natural or not stmt.order_by) else None)
         req = ReadRequest("", columns=tuple(columns), where=where,
                           read_ht=read_ht, limit=push_limit)
-        resp = await self.client.scan(stmt.table, req)
+        resp = await self.client.scan(stmt.table, req,
+                                      keep_all=natural)
         rows = [self._project_row(stmt, r, schema) for r in resp.rows]
         rows = self._order_limit(stmt, rows)
         return SqlResult(rows)
@@ -624,6 +633,22 @@ class SqlSession:
                     row[alias or bare] = r.get(it[1], r.get(bare))
             out.append(row)
         return SqlResult(self._order_limit(stmt, out))
+
+    @staticmethod
+    def _natural_order(ct, order_by) -> bool:
+        """True when ORDER BY follows the table's range-shard pk order
+        (each tablet already returns rows in encoded-key order, so a
+        pushed-down LIMIT per tablet is complete: the global top-N is a
+        subset of the per-tablet top-Ns)."""
+        if not order_by or ct.info.partition_schema.kind != "range":
+            return False
+        pk = ct.info.schema.key_columns
+        if len(order_by) > len(pk):
+            return False
+        for (name, desc), col in zip(order_by, pk):
+            if name != col.name or desc != bool(col.sort_desc):
+                return False
+        return True
 
     def _needed_columns(self, stmt: SelectStmt, schema) -> List[str]:
         if any(it[0] == "star" for it in stmt.items):
