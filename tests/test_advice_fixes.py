@@ -1,0 +1,291 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+
+1. A follower behind the leader's WAL-GC horizon must never accept a
+   gapped append (silent divergence); it recovers via leader-driven
+   snapshot install instead (reference: remote bootstrap for followers
+   behind log GC).
+2. txn status RPCs answer only from the caught-up status-tablet leader
+   (reference: TransactionStatusResolver leader-only status).
+3. WAL conflict truncation is crash-atomic (old chain or old+new, never
+   an empty window; reference: log truncation never deletes acked
+   entries first).
+4. Leader leases are measured from request SEND time, not ack-gather
+   return.
+5. Strong reads wait for the MVCC safe time to pass their read_ht
+   (reference: mvcc.cc SafeTime wait).
+"""
+import asyncio
+import os
+
+import pytest
+
+from yugabyte_db_tpu.consensus import Log, LogEntry
+from yugabyte_db_tpu.docdb import ReadRequest
+from yugabyte_db_tpu.ops import AggSpec
+from yugabyte_db_tpu.rpc.messenger import RpcError
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+from yugabyte_db_tpu.utils import flags
+from tests.test_load_balancer import kv_info
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestGappedAppendRejection:
+    def test_follower_rejects_gap(self, tmp_path):
+        """Unit: an append that would leave an index gap is rejected
+        with needs_bootstrap, not acked."""
+        async def go():
+            from yugabyte_db_tpu.consensus import (
+                PeerSpec, RaftConfig, RaftConsensus,
+            )
+            from yugabyte_db_tpu.rpc import Messenger
+            m = Messenger("gap-test")
+            log = Log(str(tmp_path / "wal"), fsync=False)
+            log.append([LogEntry(1, 1, "write", b"a"),
+                        LogEntry(1, 2, "write", b"b")])
+
+            async def apply(e):
+                pass
+
+            cfg = RaftConfig([PeerSpec("me", ("127.0.0.1", 0))])
+            c = RaftConsensus("t-gap", "me", cfg, log, m,
+                              str(tmp_path), apply)
+            # leader GC'd to index 10 and sends [11, 12]: gap past our
+            # last_index=2 — must reject with needs_bootstrap
+            resp = await c.rpc_update_consensus({
+                "term": 1, "leader": "ldr", "prev_index": 0,
+                "prev_term": 0,
+                "entries": [[1, 11, "write", b"x"],
+                            [1, 12, "write", b"y"]],
+                "commit_index": 12, "leader_ht": 0,
+            })
+            assert resp["success"] is False
+            assert resp.get("needs_bootstrap") is True
+            assert log.last_index == 2          # nothing appended
+            # contiguous append still accepted
+            resp = await c.rpc_update_consensus({
+                "term": 1, "leader": "ldr", "prev_index": 2,
+                "prev_term": 1,
+                "entries": [[1, 3, "write", b"c"]],
+                "commit_index": 3, "leader_ht": 0,
+            })
+            assert resp["success"] is True and log.last_index == 3
+            # with a snapshot floor, entries just above it are fine
+            c.snapshot_base_index = 50
+            resp = await c.rpc_update_consensus({
+                "term": 1, "leader": "ldr", "prev_index": 50,
+                "prev_term": 1,
+                "entries": [[1, 51, "write", b"z"]],
+                "commit_index": 3, "leader_ht": 0,
+            })
+            assert resp["success"] is True
+        run(go())
+
+    def test_lagging_follower_snapshot_install(self, tmp_path):
+        """End-to-end: follower down, leader writes + flushes + GCs its
+        WAL past the follower (lag cap = 0 retention for peers), then
+        the healed follower converges via leader-driven snapshot
+        install, not a spliced log."""
+        async def go():
+            flags.set_flag("log_segment_size_bytes", 1024)
+            flags.set_flag("log_gc_max_peer_lag_entries", 1)
+            try:
+                mc = await MiniCluster(str(tmp_path),
+                                       num_tservers=3).start()
+                c = mc.client()
+                await c.create_table(kv_info(), num_tablets=1,
+                                     replication_factor=3)
+                await mc.wait_for_leaders("kv")
+                await c.insert("kv", [{"k": i, "v": 1.0}
+                                      for i in range(20)])
+                # find leader + one follower tserver
+                leader_ts = follower_idx = None
+                for i, ts in enumerate(mc.tservers):
+                    for p in ts.peers.values():
+                        if p.is_leader():
+                            leader_ts = ts
+                        elif follower_idx is None:
+                            follower_idx = i
+                if leader_ts is None:
+                    for ts in mc.tservers:
+                        for p in ts.peers.values():
+                            if p.is_leader():
+                                leader_ts = ts
+                follower_uuid = mc.tservers[follower_idx].uuid
+                await mc.stop_tserver(follower_idx)
+                for batch in range(10):
+                    await c.insert("kv", [
+                        {"k": 100 + batch * 20 + i, "v": float(batch)}
+                        for i in range(20)])
+                peer = next(p for p in leader_ts.peers.values())
+                peer.tablet.flush()
+                assert peer.maybe_gc_log() > 0      # history is GONE
+                assert peer.log.first_index > 1
+                # heal the follower; leader must snapshot-install it
+                new_ts = await mc.restart_tserver(follower_idx)
+                fpeer = next(p for p in new_ts.peers.values())
+                deadline = asyncio.get_event_loop().time() + 30.0
+                while asyncio.get_event_loop().time() < deadline:
+                    await asyncio.sleep(0.1)
+                    fp = next(iter(new_ts.peers.values()), None)
+                    if fp is None:
+                        continue
+                    base = fp.consensus.snapshot_base_index
+                    if (base > 0 and fp.consensus.last_applied
+                            >= peer.consensus.commit_index):
+                        break
+                fp = next(iter(new_ts.peers.values()))
+                assert fp.consensus.snapshot_base_index > 0, \
+                    "follower was never snapshot-installed"
+                # follower data matches: count via follower read
+                resp = fp.tablet.read(ReadRequest(
+                    "", aggregates=(AggSpec("count"),)))
+                assert int(resp.agg_values[0]) == 220
+                # and the cluster still serves strongly
+                agg = await mc.client().scan("kv", ReadRequest(
+                    "", aggregates=(AggSpec("count"),)))
+                assert int(agg.agg_values[0]) == 220
+                await mc.shutdown()
+            finally:
+                flags.REGISTRY.reset("log_segment_size_bytes")
+                flags.REGISTRY.reset("log_gc_max_peer_lag_entries")
+        run(go())
+
+
+class TestRewriteTruncatedAtomicity:
+    def test_old_and_new_coexist_recovers(self, tmp_path):
+        """Crash between the rename and the old-segment deletes leaves
+        old+new segment files; recovery must produce the truncated
+        (new) state, never a misaligned splice."""
+        log = Log(str(tmp_path), fsync=False)
+        log.append([LogEntry(1, i, "write", b"old%d" % i)
+                    for i in range(1, 6)])
+        # snapshot the old chain before the conflict truncation
+        import shutil
+        saved = {}
+        for p in log._seg_paths():
+            with open(os.path.join(str(tmp_path), p), "rb") as f:
+                saved[p] = f.read()
+        log.append([LogEntry(2, 3, "write", b"new3")])
+        # resurrect the old segments next to the rewritten one
+        for name, data in saved.items():
+            path = os.path.join(str(tmp_path), name)
+            if not os.path.exists(path):
+                with open(path, "wb") as f:
+                    f.write(data)
+        log.close()
+        log2 = Log(str(tmp_path), fsync=False)
+        assert log2.last_index == 3
+        assert log2.entry(3).payload == b"new3"
+        assert log2.entry(4) is None
+
+    def test_tmp_file_ignored_on_recovery(self, tmp_path):
+        log = Log(str(tmp_path), fsync=False)
+        log.append([LogEntry(1, 1, "write", b"a")])
+        log.close()
+        # a crash mid-rewrite leaves a .tmp — recovery must skip it
+        with open(os.path.join(str(tmp_path), "wal-000099.tmp"),
+                  "wb") as f:
+            f.write(b"\x00" * 7)     # garbage
+        log2 = Log(str(tmp_path), fsync=False)
+        assert log2.last_index == 1
+
+
+class TestTxnStatusGate:
+    def test_follower_refuses_status(self, tmp_path):
+        """A status-tablet NON-leader must refuse txn_status rather than
+        answer unknown=ABORTED for a possibly committed txn."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=3).start()
+            c = mc.client()
+            await c.create_table(kv_info(), num_tablets=1,
+                                 replication_factor=3)
+            await mc.wait_for_leaders("kv")
+            txn = await c.transaction().begin()
+            await txn.insert("kv", [{"k": 1, "v": 1.0}])
+            st_loc = await txn._status_tablet()
+            st_tablet = st_loc.tablet_id
+            await txn.commit()
+            follower_ts = None
+            for ts in mc.tservers:
+                p = ts.peers.get(st_tablet)
+                if p is not None and not p.is_leader():
+                    follower_ts = ts
+                    break
+            assert follower_ts is not None
+            with pytest.raises(RpcError) as ei:
+                await c.messenger.call(
+                    follower_ts.messenger.addr, "tserver", "txn_status",
+                    {"tablet_id": st_tablet, "txn_id": txn.txn_id},
+                    timeout=5.0)
+            assert ei.value.code in ("LEADER_NOT_READY",)
+            await mc.shutdown()
+        run(go())
+
+
+class TestIntentRecoveryFromStore:
+    def test_recover_after_wal_loss(self, tmp_path):
+        """Intents that arrived as SST files (snapshot install) rebuild
+        participant memory without any WAL entries to replay."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            c = mc.client()
+            await c.create_table(kv_info(), num_tablets=1,
+                                 replication_factor=1)
+            await mc.wait_for_leaders("kv")
+            txn = await c.transaction().begin()
+            await txn.insert("kv", [{"k": 7, "v": 7.0}])
+            ts = mc.tservers[0]
+            peer = next(p for p in ts.peers.values()
+                        if p.participant._intents)
+            assert peer.participant._key_holder
+            # simulate a replica built purely from snapshot files:
+            # flush intents, blow away memory, recover from the store
+            peer.tablet.intents.flush()
+            keys_before = dict(peer.participant._key_holder)
+            peer.participant._intents.clear()
+            peer.participant._key_holder.clear()
+            peer.participant._txn_meta.clear()
+            n = peer.participant.recover_from_store()
+            assert n >= 1
+            assert peer.participant._key_holder == keys_before
+            # the recovered txn can still commit and apply
+            await txn.commit()
+            row = await c.get("kv", {"k": 7})
+            assert row is not None and row["v"] == 7.0
+            await mc.shutdown()
+        run(go())
+
+
+class TestSafeTimeReadGate:
+    def test_read_waits_for_inflight_write(self, tmp_path):
+        """A strong read picking read_ht=now() must not run ahead of a
+        queued write whose assigned HT is below it."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            c = mc.client()
+            await c.create_table(kv_info(), num_tablets=1,
+                                 replication_factor=1)
+            await mc.wait_for_leaders("kv")
+            await c.insert("kv", [{"k": 1, "v": 1.0}])
+            ts = mc.tservers[0]
+            peer = next(p for p in ts.peers.values())
+            now = peer.clock.now().value
+            # a write assigned an HT below now() sits in the queue,
+            # unreplicated: safe_read_ht must clamp below it
+            peer._write_queue.append(
+                ({"req": None, "ht": now - 1000}, asyncio.Future()))
+            assert peer.safe_read_ht(peer.clock.now().value) < now - 1000
+            # the read at read_ht=now blocks until the queue drains
+            read_task = asyncio.ensure_future(
+                peer.read(ReadRequest("", pk_eq={"k": 1}, read_ht=now)))
+            await asyncio.sleep(0.05)
+            assert not read_task.done(), \
+                "read ran ahead of an in-flight lower-HT write"
+            peer._write_queue.clear()
+            resp = await asyncio.wait_for(read_task, 5.0)
+            assert resp.rows and resp.rows[0]["v"] == 1.0
+            await mc.shutdown()
+        run(go())

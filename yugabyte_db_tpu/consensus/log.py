@@ -63,8 +63,9 @@ class Log:
 
     # --- recovery ---------------------------------------------------------
     def _seg_paths(self) -> List[str]:
+        # .tmp = incomplete truncation rewrite (crash mid-swap): ignore
         return sorted(p for p in os.listdir(self.dir)
-                      if p.startswith("wal-"))
+                      if p.startswith("wal-") and not p.endswith(".tmp"))
 
     def _recover(self) -> None:
         for name in self._seg_paths():
@@ -143,26 +144,50 @@ class Log:
         TEST_CRASH_POINT("wal:after_append")
 
     def _rewrite_truncated(self, last_keep: int) -> None:
-        """Physical truncation on conflict: rewrite from scratch into a
-        fresh segment chain (rare — only on divergent-follower repair)."""
+        """Physical truncation on conflict: rewrite into a fresh segment
+        (rare — only on divergent-follower repair). Crash-safe ordering:
+        the replacement segment is fully written + fsynced under a temp
+        name, atomically renamed into place, and only THEN are the old
+        segments removed. A crash at any point leaves either the old
+        chain intact or old+new together — recovery replays segments in
+        name order and the newer (highest-numbered) segment's entries
+        supersede the stale suffix via conflict truncation, so committed
+        entries are never lost (reference: log truncation rolls to a new
+        segment, never deletes acked entries first)."""
         self._truncate_mem(last_keep)
-        for p in self._segments:
+        old_segments = list(self._segments)
+        if self._active is not None:
+            self._active.close()
+            self._active = None
+        n = self._next_segment_number()
+        final_path = os.path.join(self.dir, f"wal-{n:06d}")
+        tmp_path = final_path + ".tmp"
+        buf = bytearray()
+        for e in self._entries:
+            buf += e.pack()
+        with open(tmp_path, "wb") as f:
+            f.write(buf)
+            f.flush()
+            if self.fsync:
+                os.fsync(f.fileno())
+        os.replace(tmp_path, final_path)
+        # persist the rename BEFORE the unlinks: on power loss, rename
+        # and remove are directory-metadata ops that can land in either
+        # order unless the directory itself is fsynced in between
+        if self.fsync:
+            dfd = os.open(self.dir, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+        for p in old_segments:
             try:
                 os.remove(p)
             except OSError:
                 pass
-        self._segments = []
-        if self._active is not None:
-            self._active.close()
-            self._active = None
-        self._roll_segment()
-        buf = bytearray()
-        for e in self._entries:
-            buf += e.pack()
-        self._active.write(buf)
-        self._active.flush()
-        if self.fsync:
-            os.fsync(self._active.fileno())
+        self._segments = [final_path]
+        self._active_path = final_path
+        self._active = open(final_path, "ab")
         self._active_size = len(buf)
 
     # --- reads ------------------------------------------------------------

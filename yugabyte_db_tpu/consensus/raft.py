@@ -140,6 +140,19 @@ class RaftConsensus:
         self._last_leader_contact = 0.0    # for pre-vote freshness checks
         self._commit_waiters: List[Tuple[int, asyncio.Future]] = []
         self.on_config_change = on_config_change
+        # Snapshot floor: with an empty log, entries may legitimately
+        # start at snapshot_base_index+1 (the flushed store covers all
+        # prior effects — set by TabletPeer after remote bootstrap /
+        # snapshot install). Appends leaving a gap past this floor are
+        # REJECTED (reference: followers behind log GC go through
+        # remote bootstrap, never a spliced log).
+        self.snapshot_base_index = 0
+        # async callback(PeerSpec) the leader fires when a peer has
+        # fallen behind our retained log and needs a snapshot install
+        self.on_peer_needs_bootstrap = None
+        self._bootstrap_inflight: set = set()
+        self._bootstrap_backoff: Dict[str, float] = {}
+        self._bootstrap_tasks: set = set()
         # adopt the newest config entry already in the log (restart path)
         for e in log.all_entries():
             if e.etype == "config":
@@ -175,6 +188,8 @@ class RaftConsensus:
         self.role = Role.FOLLOWER
         self.messenger.unregister_service(f"consensus-{self.tablet_id}")
         for t in self._tasks:
+            t.cancel()
+        for t in list(self._bootstrap_tasks):
             t.cancel()
         for _, _, fut in self._commit_waiters:
             if not fut.done():
@@ -450,6 +465,12 @@ class RaftConsensus:
         if self.role != Role.LEADER or not self.config.others(self.uuid):
             return
         peers = self.config.others(self.uuid)
+        # the lease is measured from the moment the round is SENT, not
+        # from ack-gather return: with delayed ack delivery a deposed
+        # leader must never compute a lease extending past the new
+        # leader's wait window (reference: leases anchored at request
+        # send time, consensus/README)
+        sent_at = time.monotonic()
         acks = await asyncio.gather(
             *[self._replicate_to(p) for p in peers])
         # lease renews only on a FRESH VOTER-majority ack in this round
@@ -457,18 +478,54 @@ class RaftConsensus:
         voter_acks = sum(1 for p, a in zip(peers, acks)
                          if a and p.role == "voter")
         if 1 + voter_acks >= self.config.majority:
-            now = time.monotonic()
-            if now >= self._lease_blocked_until:
-                self._lease_expiry = now + \
-                    flags.get("leader_lease_duration_ms") / 1000.0
+            if sent_at >= self._lease_blocked_until:
+                self._lease_expiry = max(
+                    self._lease_expiry,
+                    sent_at +
+                    flags.get("leader_lease_duration_ms") / 1000.0)
+
+    def _flag_needs_bootstrap(self, peer: PeerSpec) -> None:
+        """A peer needs entries we have GC'd: log walk-back can no
+        longer repair it. Hand it a full snapshot via the callback
+        (reference: remote bootstrap for followers behind log GC)."""
+        if (self.on_peer_needs_bootstrap is None
+                or peer.uuid in self._bootstrap_inflight
+                or time.monotonic()
+                < self._bootstrap_backoff.get(peer.uuid, 0.0)):
+            return
+        self._bootstrap_inflight.add(peer.uuid)
+
+        async def run():
+            try:
+                await self.on_peer_needs_bootstrap(peer)
+                # start replication right after the installed frontier
+                self.next_index[peer.uuid] = self.log.last_index + 1
+                self._bootstrap_backoff.pop(peer.uuid, None)
+            except Exception:
+                log.exception("%s: snapshot install to %s failed",
+                              self.tablet_id, peer.uuid)
+                # an unreachable peer must not trigger a full
+                # flush+checkpoint per heartbeat — back off
+                self._bootstrap_backoff[peer.uuid] = \
+                    time.monotonic() + 5.0
+            finally:
+                self._bootstrap_inflight.discard(peer.uuid)
+
+        t = asyncio.create_task(run())
+        self._bootstrap_tasks.add(t)
+        t.add_done_callback(self._bootstrap_tasks.discard)
 
     async def _replicate_to(self, peer: PeerSpec) -> bool:
         ni = self.next_index.get(peer.uuid, self.log.last_index + 1)
         prev = ni - 1
         prev_term = self.log.term_at(prev)
-        if prev_term is None:     # fell behind our cache — restart from 1
-            ni = 1
-            prev, prev_term = 0, 0
+        if prev_term is None:
+            # the peer's next entry fell behind our retained log (WAL
+            # GC'd past it). Never "restart from 1": entries_from(1)
+            # starts at _first_index and would splice a gap into the
+            # follower's log, silently diverging it. Snapshot instead.
+            self._flag_needs_bootstrap(peer)
+            return False
         entries = self.log.entries_from(ni)
         req = {
             "term": self.meta.current_term, "leader": self.uuid,
@@ -493,6 +550,9 @@ class RaftConsensus:
             self.next_index[peer.uuid] = match + 1
             await self._maybe_advance_commit()
             return True
+        if resp.get("needs_bootstrap"):
+            self._flag_needs_bootstrap(peer)
+            return False
         self.next_index[peer.uuid] = max(
             1, min(ni - 1, resp.get("last_index", ni - 1) + 1))
         return False
@@ -562,6 +622,11 @@ class RaftConsensus:
         self.clock.update(HybridTime(req["leader_ht"]))
         prev, prev_term = req["prev_index"], req["prev_term"]
         my_term = self.log.term_at(prev)
+        if my_term is None and 0 < prev <= self.snapshot_base_index:
+            # prev falls inside our installed snapshot: snapshot state
+            # only ever covers COMMITTED entries, which are identical
+            # in every log that has them — treat as a match
+            my_term = prev_term
         if prev > 0 and my_term != prev_term:
             return {"term": self.meta.current_term, "success": False,
                     "last_index": min(self.log.last_index, prev - 1)}
@@ -573,6 +638,16 @@ class RaftConsensus:
                 to_append.append(e)
         if to_append:
             first_new = to_append[0].index
+            # Gap check: entries must extend our log (or our installed
+            # snapshot floor) contiguously. A leader whose WAL GC has
+            # passed our tail can only repair us with a snapshot;
+            # appending past a gap would misalign every later index
+            # while acking success — silent divergence.
+            floor = max(self.log.last_index, self.snapshot_base_index)
+            if first_new > floor + 1:
+                return {"term": self.meta.current_term, "success": False,
+                        "last_index": self.log.last_index,
+                        "needs_bootstrap": True}
             self.log.append(to_append)
             # any pending waiter at a truncated index lost its entry
             still = []

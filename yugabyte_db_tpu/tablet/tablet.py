@@ -367,9 +367,14 @@ class Tablet:
     # --- snapshots --------------------------------------------------------
     def create_snapshot(self, out_dir: str) -> None:
         """Consistent tablet snapshot: flush + hard-link checkpoint
-        (reference: tablet/tablet_snapshots.cc:186,273)."""
+        (reference: tablet/tablet_snapshots.cc:186,273). Includes the
+        IntentsDB so a bootstrapped replica keeps in-flight txn
+        provisional records (reference: remote_bootstrap_session.cc
+        streams both rocksdb instances)."""
         self.flush()
         self.regular.checkpoint(os.path.join(out_dir, "regular"))
+        self.intents.flush()
+        self.intents.checkpoint(os.path.join(out_dir, "intents"))
 
     def trim_above_ht(self, cutoff: int) -> int:
         """Enforce a single-HT consistent cut: drop every version whose

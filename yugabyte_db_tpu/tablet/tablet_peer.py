@@ -54,7 +54,47 @@ class TabletPeer:
     # --- lifecycle --------------------------------------------------------
     async def start(self):
         self._bootstrap()
+        # Freshly remote-bootstrapped / snapshot-installed replica: the
+        # flushed store covers effects past the (empty or wiped) log.
+        # Publish that floor so consensus accepts entries starting just
+        # above it and never waits for entries that exist only as
+        # snapshot state (reference: remote bootstrap + InstallSnapshot
+        # semantics — snapshot covers committed entries only).
+        fr = self.tablet.regular.flushed_frontier().get("op_id")
+        if fr and int(fr[1]) > self.log.last_index:
+            c = self.consensus
+            c.snapshot_base_index = int(fr[1])
+            c.commit_index = max(c.commit_index, c.snapshot_base_index)
+            c.last_applied = max(c.last_applied, c.snapshot_base_index)
+        # intents that arrived as SST files (snapshot install / remote
+        # bootstrap) have no WAL entries to replay — rebuild participant
+        # state from the IntentsDB (idempotent with WAL replay)
+        self.participant.recover_from_store()
+        self.consensus.on_peer_needs_bootstrap = self._bootstrap_lagging_peer
         await self.consensus.start()
+
+    async def _bootstrap_lagging_peer(self, peer) -> None:
+        """Leader-driven snapshot install for a follower behind our WAL
+        GC horizon (reference: remote bootstrap triggered for peers the
+        log can no longer catch up, tserver/remote_bootstrap_*.cc).
+        Creates a local checkpoint and asks the lagging peer's tserver
+        to fetch + swap it in."""
+        import shutil
+        import uuid as _uuid
+        snapshot_id = f"rbs-{_uuid.uuid4().hex[:12]}"
+        d = os.path.join(self.tablet.dir, "snapshots", snapshot_id)
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(
+            None, lambda: self.tablet.create_snapshot(d))
+        try:
+            await self.consensus.messenger.call(
+                peer.addr, "tserver", "install_snapshot",
+                {"tablet_id": self.tablet.tablet_id,
+                 "snapshot_id": snapshot_id,
+                 "src_addr": list(self.consensus.messenger.addr)},
+                timeout=120.0)
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
 
     def _bootstrap(self):
         """WAL replay on restart happens THROUGH Raft: consensus restarts
@@ -96,25 +136,44 @@ class TabletPeer:
         await fut
         return WriteResponse(rows_affected=len(req.ops))
 
-    def xcluster_safe_ht(self, now_value: int) -> int:
-        """Upper bound below which no NEW commit can land: current HT
-        clamped under every queued write and every uncommitted log
-        suffix entry that already carries an assigned HT (the MVCC
-        safe-time analog, reference: mvcc.cc SafeTime). Without this,
-        a write with ht=100 sitting in the queue would let get_changes
-        advertise now()=105 as safe, then commit below it."""
+    def _pending_ht_bound(self, now_value: int, from_index: int) -> int:
+        """Current HT clamped under every queued write and every log
+        entry at-or-past `from_index` that already carries an assigned
+        HT (the MVCC safe-time analog, reference: mvcc.cc SafeTime)."""
         bound = now_value
         for p, _ in self._write_queue:
             bound = min(bound, p["ht"] - 1)
-        for e in self.log.entries_from(
-                self.consensus.commit_index + 1, 1000):
-            d = msgpack.unpackb(e.payload, raw=False)
+        for e in self.log.entries_from(from_index, 1000):
+            # etype check BEFORE unpack: noop (b"") and config (JSON)
+            # payloads are not msgpack and carry no HT anyway
             if e.etype == "write":
+                d = msgpack.unpackb(e.payload, raw=False)
                 for item in (d["batch"] if "batch" in d else [d]):
                     bound = min(bound, item["ht"] - 1)
             elif e.etype == "txn_apply":
+                d = msgpack.unpackb(e.payload, raw=False)
                 bound = min(bound, d["commit_ht"] - 1)
         return bound
+
+    def xcluster_safe_ht(self, now_value: int) -> int:
+        """Upper bound below which no NEW commit can land. Without
+        this, a write with ht=100 sitting in the queue would let
+        get_changes advertise now()=105 as safe, then commit below
+        it."""
+        return self._pending_ht_bound(
+            now_value, self.consensus.commit_index + 1)
+
+    def safe_read_ht(self, now_value: int) -> int:
+        """Upper bound at which a snapshot read sees a stable prefix:
+        like xcluster_safe_ht but anchored at last_APPLIED — an entry
+        that committed but hasn't hit the store yet is still invisible
+        to a scan, so reads must wait it out too. Fast path: nothing
+        in flight, the bound is just `now`."""
+        if (not self._write_queue
+                and self.consensus.last_applied >= self.log.last_index):
+            return now_value
+        return self._pending_ht_bound(
+            now_value, self.consensus.last_applied + 1)
 
     async def _drain_writes(self):
         while self._write_queue:
@@ -179,12 +238,16 @@ class TabletPeer:
                                     op_id=(entry.term, entry.index))
 
     # --- read path --------------------------------------------------------
-    def read(self, req: ReadRequest) -> ReadResponse:
+    async def read(self, req: ReadRequest) -> ReadResponse:
         """Strong reads: leader with a valid lease picks the read time
         (reference: tserver/read_query.cc PickReadTime + leader lease
-        checks). Follower (consistent-prefix) reads serve from any
-        replica at its applied state — the clock is ratcheted by leader
-        heartbeats, so the prefix is consistent though possibly stale."""
+        checks), then waits until the MVCC safe time passes it — an
+        in-flight write already holds an HT below now(), and a snapshot
+        read that ran ahead of it would return different rows on
+        re-read (reference: mvcc.cc SafeTime wait). Follower
+        (consistent-prefix) reads serve from any replica at its applied
+        state — the clock is ratcheted by leader heartbeats, so the
+        prefix is consistent though possibly stale."""
         if req.consistency == "follower":
             return self.tablet.read(req)
         if not self.consensus.is_leader():
@@ -193,6 +256,16 @@ class TabletPeer:
                 "LEADER_NOT_READY")
         if not self.consensus.has_leader_lease():
             raise RpcError("leader lease expired", "LEADER_HAS_NO_LEASE")
+        if req.read_ht is None:
+            req.read_ht = self.clock.now().value
+            req.server_assigned_read_ht = True
+        import time as _time
+        deadline = _time.monotonic() + 10.0
+        while self.safe_read_ht(self.clock.now().value) < req.read_ht:
+            if _time.monotonic() > deadline:
+                raise RpcError("in-flight writes below the read time "
+                               "did not drain", "TIMED_OUT")
+            await asyncio.sleep(0.0005)
         return self.tablet.read(req)
 
     def is_leader(self) -> bool:
@@ -244,7 +317,19 @@ class TabletPeer:
         op = frontier.get("op_id")
         if not op:
             return 0
+        from ..utils import flags as _flags
         cutoff = min(int(op[1]), self.consensus.commit_index)
+        if self.consensus.is_leader():
+            # don't GC entries a peer still needs — a peer behind our
+            # retained log can only recover via full snapshot install.
+            # Bounded: a peer lagging more than the retention cap (or
+            # at match 0 — never replicated / freshly added) doesn't
+            # hold GC hostage; it goes through snapshot install.
+            cap = _flags.get("log_gc_max_peer_lag_entries")
+            for p in self.consensus.config.others(self.consensus.uuid):
+                m = self.consensus.match_index.get(p.uuid, 0)
+                if m > 0 and cutoff - m < cap:
+                    cutoff = min(cutoff, m)
         if cutoff <= 0:
             return 0
         return self.log.gc(cutoff)

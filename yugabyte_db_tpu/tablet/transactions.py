@@ -459,8 +459,43 @@ class TransactionParticipant:
         for key, op in zip(m["keys"], m["req"]["ops"]):
             per_txn[key] = (table_id, op)
             self._key_holder[key] = txn_id
-            batch.put(intent_key(key, txn_id), msgpack.packb(op))
+            # the durable intent record is self-describing (doc key,
+            # txn, op, table, start_ht, status tablet) so a replica can
+            # rebuild participant state from the IntentsDB alone when
+            # the WAL below the flushed frontier is gone (reference:
+            # transaction_participant.cc intent loading at bootstrap)
+            batch.put(intent_key(key, txn_id), msgpack.packb({
+                "x": txn_id, "k": key, "o": op, "t": table_id,
+                "s": m["start_ht"], "st": m.get("status_tablet")}))
         self.tablet.intents.apply(batch)
+
+    def recover_from_store(self) -> int:
+        """Rebuild in-memory intent state from the IntentsDB (reference:
+        transaction_participant.cc loads running txns from intents at
+        bootstrap). Replay of `txn_intents` WAL entries rebuilds the
+        same state when the log is intact; this path covers replicas
+        whose WAL was wiped by snapshot install / remote bootstrap —
+        their intents arrive as SST files, never as log entries.
+        Idempotent with WAL replay. Returns intents recovered."""
+        n = 0
+        for _k, v in self.tablet.intents.iterate():
+            try:
+                d = msgpack.unpackb(v, raw=False)
+            except Exception:   # noqa: BLE001 — release tombstones etc.
+                continue
+            if not isinstance(d, dict) or "x" not in d:
+                continue        # release tombstone or legacy value
+            txn_id, key = d["x"], d["k"]
+            per_txn = self._intents.setdefault(txn_id, {})
+            if per_txn.get(key) is None:
+                per_txn[key] = (d.get("t", ""), d["o"])
+                n += 1
+            self._key_holder.setdefault(key, txn_id)
+            meta = self._txn_meta.setdefault(
+                txn_id, {"start_ht": d.get("s", 0)})
+            if d.get("st"):
+                meta.setdefault("status_tablet", d["st"])
+        return n
 
     # --- commit/abort ------------------------------------------------------
     def apply_commit_entry(self, payload: bytes, op_id=None,

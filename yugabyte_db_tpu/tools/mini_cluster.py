@@ -82,10 +82,17 @@ class MiniCluster:
 
     async def restart_tserver(self, idx: int):
         ts = self.tservers[idx]
+        old_addr = ts.messenger.addr
         await ts.shutdown()
         new = TabletServer(ts.uuid, ts.fs_root,
                            master_addrs=self.master_addrs())
-        await new.start()
+        # rebind the SAME endpoint: Raft peer configs and client meta
+        # caches address this node by host:port, exactly like a real
+        # deployment restarting in place
+        try:
+            await new.start(host=old_addr[0], port=old_addr[1])
+        except OSError:
+            await new.start()        # port raced away: fresh bind
         self.tservers[idx] = new
         return new
 

@@ -21,19 +21,47 @@ from ..tserver import TabletServer
 from ..tserver.webserver import StatusWebServer
 
 
+def _load_ports(data_dir: str) -> dict:
+    """Persisted server ports: Raft configs and catalog locations
+    address nodes by host:port, so a relaunch must rebind the SAME
+    endpoints (reference: yugabyted persists its server conf). First
+    start records the OS-assigned ports; later starts reuse them."""
+    import json
+    import os
+    path = os.path.join(data_dir, "ports.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            return json.load(f)
+    return {}
+
+
+def _save_ports(data_dir: str, ports: dict) -> None:
+    import json
+    import os
+    os.makedirs(data_dir, exist_ok=True)
+    with open(os.path.join(data_dir, "ports.json"), "w") as f:
+        json.dump(ports, f)
+
+
 async def serve(args):
+    ports = _load_ports(args.data_dir)
     master = Master(f"{args.data_dir}/master")
-    maddr = await master.start(port=args.master_port,
-                               auto_balance=args.auto_balance)
+    maddr = await master.start(
+        port=args.master_port or ports.get("master", 0),
+        auto_balance=args.auto_balance)
+    ports["master"] = maddr[1]
     print(f"master        : {maddr[0]}:{maddr[1]}")
     tservers = []
     for i in range(args.tservers):
         ts = TabletServer(f"ts-{i}", f"{args.data_dir}/ts-{i}",
                           master_addrs=[maddr])
-        addr = await ts.start(port=args.tserver_port + i
-                              if args.tserver_port else 0)
+        want = (args.tserver_port + i if args.tserver_port
+                else ports.get(f"ts-{i}", 0))
+        addr = await ts.start(port=want)
+        ports[f"ts-{i}"] = addr[1]
         tservers.append(ts)
         print(f"tserver ts-{i}  : {addr[0]}:{addr[1]}")
+    _save_ports(args.data_dir, ports)
     web = StatusWebServer("ybtpu", extra_handlers=master.web_handlers())
     waddr = await web.start(port=args.web_port)
     print(f"status ui     : http://{waddr[0]}:{waddr[1]}/metrics "

@@ -84,6 +84,14 @@ class TabletServer:
     async def _open_tablet(self, meta: dict) -> TabletPeer:
         info = TableInfo.from_wire(meta["table"])
         tablet_id = meta["tablet_id"]
+        # drop half-finished snapshot-install staging/retired dirs from
+        # a crash mid-install — only the live dirs are authoritative
+        import shutil
+        tdir = self._tablet_dir(tablet_id)
+        for leftover in ("regular.install", "intents.install",
+                         "regular.old", "intents.old", "wals.old"):
+            shutil.rmtree(os.path.join(tdir, leftover),
+                          ignore_errors=True)
         part = Partition(bytes.fromhex(meta["partition"][0]),
                          bytes.fromhex(meta["partition"][1]))
         tablet = Tablet(tablet_id, info, self._tablet_dir(tablet_id),
@@ -196,7 +204,7 @@ class TabletServer:
         req = read_request_from_wire(payload["req"])
         with TRACES.trace(f"read:{payload['tablet_id']}"):
             with wait_status("OnCpu_Read"):
-                resp = peer.read(req)
+                resp = await peer.read(req)
         return read_response_to_wire(resp)
 
     async def rpc_alter_table(self, payload) -> dict:
@@ -221,11 +229,13 @@ class TabletServer:
 
     # --- remote bootstrap ----------------------------------------------------
     async def _remote_bootstrap_fetch(self, src_addr, tablet_id: str,
-                                      snapshot_id: str, dst_dir: str):
+                                      snapshot_id: str, dst_dir: str,
+                                      subdir: str = "regular"):
         os.makedirs(dst_dir, exist_ok=True)
         listing = await self.messenger.call(
             src_addr, "tserver", "list_snapshot_files",
-            {"tablet_id": tablet_id, "snapshot_id": snapshot_id},
+            {"tablet_id": tablet_id, "snapshot_id": snapshot_id,
+             "subdir": subdir},
             timeout=30.0)
         for name, size in listing["files"]:
             out_path = os.path.join(dst_dir, name)
@@ -235,19 +245,89 @@ class TabletServer:
                     chunk = await self.messenger.call(
                         src_addr, "tserver", "fetch_snapshot_file",
                         {"tablet_id": tablet_id, "snapshot_id": snapshot_id,
-                         "name": name, "offset": offset,
+                         "name": name, "offset": offset, "subdir": subdir,
                          "length": 4 * 1024 * 1024}, timeout=60.0)
                     out.write(chunk["data"])
                     offset += len(chunk["data"])
                     if not chunk["data"]:
                         break
 
-    def _snapshot_dir(self, tablet_id: str, snapshot_id: str) -> str:
+    async def _fetch_tablet_state(self, src_addr, tablet_id: str,
+                                  snapshot_id: str, staging: dict):
+        """Fetch both stores of a tablet snapshot into staging dirs:
+        {"regular": path, "intents": path}. The intents store may be
+        absent in snapshots from older leaders — tolerated."""
+        await self._remote_bootstrap_fetch(
+            src_addr, tablet_id, snapshot_id, staging["regular"],
+            subdir="regular")
+        try:
+            await self._remote_bootstrap_fetch(
+                src_addr, tablet_id, snapshot_id, staging["intents"],
+                subdir="intents")
+        except RpcError as e:
+            if e.code != "NOT_FOUND":
+                raise
+
+    async def rpc_install_snapshot(self, payload) -> dict:
+        """Install a leader checkpoint over this lagging replica
+        (reference: remote bootstrap for followers behind log GC +
+        Raft InstallSnapshot semantics). Fetches the leader's snapshot
+        files first (the replica keeps serving), then swaps in the new
+        stores and wipes the stale WAL — snapshot state covers only
+        committed entries, so discarding the local log is Raft-safe.
+        Consensus metadata (term, vote) is preserved.
+
+        Crash-safe sequencing (renames only, no delete-then-copy
+        window): the WAL is retired FIRST — without a log the replica
+        presents as a cleanly bootstrapped node at whatever frontier
+        its store holds, so a crash at any later point leaves a state
+        the leader simply re-installs over; it can never leave a
+        non-empty GC'd WAL next to an empty store (which would fake a
+        commit floor) or a log contiguous-append violation."""
+        import shutil
+        tablet_id = payload["tablet_id"]
+        if tablet_id not in self.peers:
+            raise RpcError(f"tablet {tablet_id} not found", "NOT_FOUND")
+        d = self._tablet_dir(tablet_id)
+        staging = {s: os.path.join(d, f"{s}.install")
+                   for s in ("regular", "intents")}
+        for p in staging.values():
+            shutil.rmtree(p, ignore_errors=True)
+        await self._fetch_tablet_state(
+            tuple(payload["src_addr"]), tablet_id,
+            payload["snapshot_id"], staging)
+        with open(os.path.join(d, "tablet-meta.json")) as f:
+            meta = json.load(f)
+        peer = self.peers.pop(tablet_id)
+        await peer.shutdown()
+        # 1. retire the WAL (rename, not delete: cheap + atomic)
+        wals, wals_old = os.path.join(d, "wals"), os.path.join(d, "wals.old")
+        shutil.rmtree(wals_old, ignore_errors=True)
+        if os.path.isdir(wals):
+            os.rename(wals, wals_old)
+        # 2. swap each store: old -> .old, staged -> live
+        for s, staged in staging.items():
+            live, old = os.path.join(d, s), os.path.join(d, f"{s}.old")
+            shutil.rmtree(old, ignore_errors=True)
+            if os.path.isdir(live):
+                os.rename(live, old)
+            if os.path.isdir(staged):
+                os.rename(staged, live)
+        # 3. cleanup retired state
+        shutil.rmtree(wals_old, ignore_errors=True)
+        for s in staging:
+            shutil.rmtree(os.path.join(d, f"{s}.old"), ignore_errors=True)
+        await self._open_tablet(meta)
+        return {"ok": True}
+
+    def _snapshot_dir(self, tablet_id: str, snapshot_id: str,
+                      subdir: str = "regular") -> str:
         return os.path.join(self._tablet_dir(tablet_id), "snapshots",
-                            snapshot_id, "regular")
+                            snapshot_id, os.path.basename(subdir))
 
     async def rpc_list_snapshot_files(self, payload) -> dict:
-        d = self._snapshot_dir(payload["tablet_id"], payload["snapshot_id"])
+        d = self._snapshot_dir(payload["tablet_id"], payload["snapshot_id"],
+                               payload.get("subdir", "regular"))
         if not os.path.isdir(d):
             raise RpcError("snapshot not found", "NOT_FOUND")
         files = [(n, os.path.getsize(os.path.join(d, n)))
@@ -255,7 +335,8 @@ class TabletServer:
         return {"files": files}
 
     async def rpc_fetch_snapshot_file(self, payload) -> dict:
-        d = self._snapshot_dir(payload["tablet_id"], payload["snapshot_id"])
+        d = self._snapshot_dir(payload["tablet_id"], payload["snapshot_id"],
+                               payload.get("subdir", "regular"))
         name = os.path.basename(payload["name"])   # no path escapes
         path = os.path.join(d, name)
         if not os.path.isfile(path):
@@ -470,10 +551,10 @@ class TabletServer:
         req = ReadRequest(payload.get("table_id", ""),
                           pk_eq=payload["pk_row"],
                           read_ht=payload.get("read_ht"))
-        resp = peer.read(req)
+        resp = await peer.read(req)
         return {"row": resp.rows[0] if resp.rows else None}
 
-    # coordinator RPCs (valid on the status tablet leader)
+    # coordinator RPCs (valid on the caught-up status tablet leader)
     def _coordinator(self, tablet_id: str):
         peer = self._peer(tablet_id)
         if peer.coordinator is None:
@@ -481,6 +562,18 @@ class TabletServer:
                            "INVALID_ARGUMENT")
         if not peer.is_leader():
             raise RpcError("not leader", "LEADER_NOT_READY")
+        # A just-elected leader that hasn't applied its predecessors'
+        # entries yet would answer "unknown txn" = ABORTED for a
+        # COMMITTED transaction — participants would then roll back
+        # committed intents (atomicity violation). Gate on the term-
+        # opening noop being applied (reference: status answered only
+        # by the caught-up status-tablet leader; same gate the master
+        # catalog reads use).
+        c = peer.consensus
+        if c.last_applied < c.term_start_index:
+            raise RpcError(
+                f"leader not caught up (applied={c.last_applied} "
+                f"term_start={c.term_start_index})", "LEADER_NOT_READY")
         return peer.coordinator
 
     async def rpc_txn_begin(self, payload) -> dict:
@@ -493,10 +586,11 @@ class TabletServer:
         return await self._coordinator(payload["tablet_id"]).abort(payload)
 
     async def rpc_txn_status(self, payload) -> dict:
-        peer = self._peer(payload["tablet_id"])
-        if peer.coordinator is None:
-            raise RpcError("not a status tablet", "INVALID_ARGUMENT")
-        return await peer.coordinator.status(payload)
+        # leader + catch-up gated: a follower (or stale new leader)
+        # answering "unknown = ABORTED" for a committed txn would lose
+        # committed writes on the asking participant
+        return await self._coordinator(
+            payload["tablet_id"]).status(payload)
 
     # --- vector indexes ------------------------------------------------------
     async def rpc_build_vector_index(self, payload) -> dict:

@@ -137,6 +137,11 @@ DEFINE_RUNTIME("tpu_min_rows_for_pushdown", 4096,
 DEFINE_RUNTIME("raft_heartbeat_interval_ms", 50, "Raft leader heartbeat period.")
 DEFINE_RUNTIME("leader_lease_duration_ms", 2000, "Raft leader lease length.")
 DEFINE_RUNTIME("log_segment_size_bytes", 16 * 1024 * 1024, "WAL segment size.")
+DEFINE_RUNTIME("log_gc_max_peer_lag_entries", 100_000,
+               "Leader WAL retention bound for lagging peers: entries are "
+               "kept for a behind peer only while its lag stays under this; "
+               "beyond it GC proceeds and the peer recovers via snapshot "
+               "install (reference: log retention caps + remote bootstrap).")
 DEFINE_RUNTIME("memstore_flush_threshold_bytes", 64 * 1024 * 1024,
                "Memtable size that triggers a flush.")
 DEFINE_RUNTIME("max_clock_skew_ms", 500,
