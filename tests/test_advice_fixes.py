@@ -225,6 +225,61 @@ class TestTxnStatusGate:
         run(go())
 
 
+class TestInstallSwapRollForward:
+    def _mk(self, tmp_path, names):
+        for n in names:
+            d = os.path.join(str(tmp_path), n)
+            os.makedirs(d, exist_ok=True)
+            with open(os.path.join(d, "marker.txt"), "w") as f:
+                f.write(n)
+
+    def _content(self, tmp_path, n):
+        with open(os.path.join(str(tmp_path), n, "marker.txt")) as f:
+            return f.read()
+
+    def test_marker_present_rolls_forward(self, tmp_path):
+        """Crash right after the commit marker: staged state wins, old
+        stores and stale WAL retire."""
+        from yugabyte_db_tpu.tserver.tablet_server import TabletServer
+        self._mk(tmp_path, ["regular", "intents", "wals",
+                            "regular.install", "intents.install"])
+        with open(os.path.join(str(tmp_path), "install-commit"),
+                  "w") as f:
+            f.write("snap-1")
+        TabletServer._complete_install_swap(str(tmp_path))
+        assert self._content(tmp_path, "regular") == "regular.install"
+        assert self._content(tmp_path, "intents") == "intents.install"
+        left = set(os.listdir(str(tmp_path)))
+        assert "wals" not in left and "install-commit" not in left
+        assert not any(n.endswith((".old", ".install")) for n in left)
+
+    def test_marker_present_partial_swap_completes(self, tmp_path):
+        """Crash mid-swap (regular already swapped, intents not):
+        roll-forward finishes only what remains."""
+        from yugabyte_db_tpu.tserver.tablet_server import TabletServer
+        self._mk(tmp_path, ["regular", "regular.old", "intents",
+                            "intents.install", "wals"])
+        with open(os.path.join(str(tmp_path), "install-commit"),
+                  "w") as f:
+            f.write("snap-1")
+        TabletServer._complete_install_swap(str(tmp_path))
+        assert self._content(tmp_path, "regular") == "regular"
+        assert self._content(tmp_path, "intents") == "intents.install"
+        assert "wals" not in os.listdir(str(tmp_path))
+
+    def test_no_marker_discards_partial_fetch(self, tmp_path):
+        """Crash mid-fetch (no marker): live dirs untouched, partial
+        staging discarded."""
+        from yugabyte_db_tpu.tserver.tablet_server import TabletServer
+        self._mk(tmp_path, ["regular", "intents", "wals",
+                            "regular.install"])
+        TabletServer._complete_install_swap(str(tmp_path))
+        assert self._content(tmp_path, "regular") == "regular"
+        left = set(os.listdir(str(tmp_path)))
+        assert "wals" in left
+        assert "regular.install" not in left
+
+
 class TestIntentRecoveryFromStore:
     def test_recover_after_wal_loss(self, tmp_path):
         """Intents that arrived as SST files (snapshot install) rebuild

@@ -274,6 +274,25 @@ class Log:
         except Exception:
             return None
 
+    def wipe(self) -> None:
+        """Discard ALL entries and segments. Only valid when a store
+        snapshot frontier supersedes the entire log (snapshot install):
+        every entry here is either committed-and-covered by the store
+        or a never-committed stale-term leftover."""
+        if self._active is not None:
+            self._active.close()
+            self._active = None
+        for p in list(self._segments):
+            try:
+                os.remove(p)
+            except OSError:
+                pass
+        self._segments = []
+        self._entries = []
+        self._first_index = 1
+        self._active_path = None
+        self._active_size = 0
+
     def close(self) -> None:
         if self._active is not None:
             self._active.close()

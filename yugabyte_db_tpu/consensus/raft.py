@@ -153,6 +153,9 @@ class RaftConsensus:
         self._bootstrap_inflight: set = set()
         self._bootstrap_backoff: Dict[str, float] = {}
         self._bootstrap_tasks: set = set()
+        # sync callback fired after last_applied advances (safe-time
+        # waiters in the tablet peer wake on it)
+        self.on_applied = None
         # adopt the newest config entry already in the log (restart path)
         for e in log.all_entries():
             if e.etype == "config":
@@ -497,9 +500,17 @@ class RaftConsensus:
 
         async def run():
             try:
-                await self.on_peer_needs_bootstrap(peer)
-                # start replication right after the installed frontier
-                self.next_index[peer.uuid] = self.log.last_index + 1
+                frontier = await self.on_peer_needs_bootstrap(peer)
+                # resume replication exactly past the installed
+                # frontier — using our own last_index would overshoot
+                # entries appended during the (slow) install and force
+                # a walk-back (or another install) every time
+                if frontier:
+                    self.next_index[peer.uuid] = frontier + 1
+                    self.match_index[peer.uuid] = max(
+                        self.match_index.get(peer.uuid, 0), frontier)
+                else:
+                    self.next_index[peer.uuid] = self.log.last_index + 1
                 self._bootstrap_backoff.pop(peer.uuid, None)
             except Exception:
                 log.exception("%s: snapshot install to %s failed",
@@ -605,6 +616,8 @@ class RaftConsensus:
                             self.tablet_id, nxt, e.etype)
                         raise
                 self.last_applied = nxt
+            if self.on_applied is not None:
+                self.on_applied()
 
     # ------------------------------------------------------------------
     # Follower side

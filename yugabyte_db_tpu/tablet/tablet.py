@@ -365,16 +365,23 @@ class Tablet:
         return hits[:k]
 
     # --- snapshots --------------------------------------------------------
-    def create_snapshot(self, out_dir: str) -> None:
+    def create_snapshot(self, out_dir: str):
         """Consistent tablet snapshot: flush + hard-link checkpoint
         (reference: tablet/tablet_snapshots.cc:186,273). Includes the
         IntentsDB so a bootstrapped replica keeps in-flight txn
         provisional records (reference: remote_bootstrap_session.cc
-        streams both rocksdb instances)."""
+        streams both rocksdb instances). MUST be called from the apply
+        thread (the event loop): both checkpoints then form one
+        consistent cut — no txn apply can interleave between them and
+        leave e.g. release-tombstones in the intents checkpoint for
+        rows the regular checkpoint missed. Returns the regular store's
+        flushed op index (the snapshot's replication frontier)."""
         self.flush()
         self.regular.checkpoint(os.path.join(out_dir, "regular"))
         self.intents.flush()
         self.intents.checkpoint(os.path.join(out_dir, "intents"))
+        op = self.regular.flushed_frontier().get("op_id")
+        return int(op[1]) if op else None
 
     def trim_above_ht(self, cutoff: int) -> int:
         """Enforce a single-HT consistent cut: drop every version whose

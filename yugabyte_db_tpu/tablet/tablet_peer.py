@@ -44,6 +44,8 @@ class TabletPeer:
         self._write_queue: list = []
         self._batcher_task = None
         self.on_alter = None      # tserver persists new schema to meta
+        # wakes safe-time waiters when writes drain / entries apply
+        self._progress_event = asyncio.Event()
 
     async def alter(self, table_wire: dict):
         if not self.consensus.is_leader():
@@ -62,6 +64,12 @@ class TabletPeer:
         # semantics — snapshot covers committed entries only).
         fr = self.tablet.regular.flushed_frontier().get("op_id")
         if fr and int(fr[1]) > self.log.last_index:
+            if self.log.all_entries():
+                # the whole log sits below the store's frontier (can
+                # only happen around snapshot install): keeping it
+                # would leave an index gap once replication resumes
+                # past the frontier — every entry in it is obsolete
+                self.log.wipe()
             c = self.consensus
             c.snapshot_base_index = int(fr[1])
             c.commit_index = max(c.commit_index, c.snapshot_base_index)
@@ -71,21 +79,23 @@ class TabletPeer:
         # state from the IntentsDB (idempotent with WAL replay)
         self.participant.recover_from_store()
         self.consensus.on_peer_needs_bootstrap = self._bootstrap_lagging_peer
+        self.consensus.on_applied = self._notify_progress
         await self.consensus.start()
 
-    async def _bootstrap_lagging_peer(self, peer) -> None:
+    async def _bootstrap_lagging_peer(self, peer):
         """Leader-driven snapshot install for a follower behind our WAL
         GC horizon (reference: remote bootstrap triggered for peers the
         log can no longer catch up, tserver/remote_bootstrap_*.cc).
         Creates a local checkpoint and asks the lagging peer's tserver
-        to fetch + swap it in."""
+        to fetch + swap it in. Returns the snapshot's frontier index so
+        the leader resumes replication exactly past it. The checkpoint
+        runs synchronously ON the event loop: applies cannot interleave
+        between the regular and intents checkpoints (consistent cut)."""
         import shutil
         import uuid as _uuid
         snapshot_id = f"rbs-{_uuid.uuid4().hex[:12]}"
         d = os.path.join(self.tablet.dir, "snapshots", snapshot_id)
-        loop = asyncio.get_running_loop()
-        await loop.run_in_executor(
-            None, lambda: self.tablet.create_snapshot(d))
+        frontier = self.tablet.create_snapshot(d)
         try:
             await self.consensus.messenger.call(
                 peer.addr, "tserver", "install_snapshot",
@@ -95,6 +105,7 @@ class TabletPeer:
                 timeout=120.0)
         finally:
             shutil.rmtree(d, ignore_errors=True)
+        return frontier
 
     def _bootstrap(self):
         """WAL replay on restart happens THROUGH Raft: consensus restarts
@@ -175,6 +186,11 @@ class TabletPeer:
         return self._pending_ht_bound(
             now_value, self.consensus.last_applied + 1)
 
+    def _notify_progress(self):
+        """Wake safe-time waiters: the in-flight set changed."""
+        self._progress_event.set()
+        self._progress_event = asyncio.Event()
+
     async def _drain_writes(self):
         while self._write_queue:
             batch, self._write_queue = self._write_queue, []
@@ -186,10 +202,12 @@ class TabletPeer:
                 for _, fut in batch:
                     if not fut.done():
                         fut.set_exception(e)
+                self._notify_progress()
                 continue
             for _, fut in batch:
                 if not fut.done():
                     fut.set_result(None)
+            self._notify_progress()
 
     async def _apply_entry(self, entry: LogEntry):
         if entry.etype == "write":
@@ -265,7 +283,13 @@ class TabletPeer:
             if _time.monotonic() > deadline:
                 raise RpcError("in-flight writes below the read time "
                                "did not drain", "TIMED_OUT")
-            await asyncio.sleep(0.0005)
+            # event-driven wait (drain/apply progress sets it), with a
+            # timeout fallback for wakeups that race the state change
+            ev = self._progress_event
+            try:
+                await asyncio.wait_for(ev.wait(), 0.05)
+            except asyncio.TimeoutError:
+                pass
         return self.tablet.read(req)
 
     def is_leader(self) -> bool:

@@ -81,17 +81,48 @@ class TabletServer:
                 meta = json.load(f)
             await self._open_tablet(meta)
 
+    @staticmethod
+    def _complete_install_swap(tdir: str) -> None:
+        """Finish (or clean up after) a snapshot-install swap. The
+        marker file is written only once the staged dirs are FULLY
+        fetched, and removed only after the swap + cleanup completes —
+        so: marker present = staged state is authoritative, roll the
+        swap FORWARD deterministically; marker absent = any leftover
+        .install dirs are partial fetches, discard them. Either way no
+        crash point leaves the replica with an empty store or with a
+        stale WAL alongside a newer store (which would fake a commit
+        floor / break log index contiguity)."""
+        import shutil
+        marker = os.path.join(tdir, "install-commit")
+        if os.path.exists(marker):
+            for s in ("regular", "intents"):
+                staged = os.path.join(tdir, f"{s}.install")
+                live = os.path.join(tdir, s)
+                old = os.path.join(tdir, f"{s}.old")
+                if os.path.isdir(staged):
+                    shutil.rmtree(old, ignore_errors=True)
+                    if os.path.isdir(live):
+                        os.rename(live, old)
+                    os.rename(staged, live)
+            wals = os.path.join(tdir, "wals")
+            wals_old = os.path.join(tdir, "wals.old")
+            if os.path.isdir(wals):
+                shutil.rmtree(wals_old, ignore_errors=True)
+                os.rename(wals, wals_old)
+            for leftover in ("regular.old", "intents.old", "wals.old"):
+                shutil.rmtree(os.path.join(tdir, leftover),
+                              ignore_errors=True)
+            os.remove(marker)
+        else:
+            for leftover in ("regular.install", "intents.install"):
+                shutil.rmtree(os.path.join(tdir, leftover),
+                              ignore_errors=True)
+
     async def _open_tablet(self, meta: dict) -> TabletPeer:
         info = TableInfo.from_wire(meta["table"])
         tablet_id = meta["tablet_id"]
-        # drop half-finished snapshot-install staging/retired dirs from
-        # a crash mid-install — only the live dirs are authoritative
-        import shutil
-        tdir = self._tablet_dir(tablet_id)
-        for leftover in ("regular.install", "intents.install",
-                         "regular.old", "intents.old", "wals.old"):
-            shutil.rmtree(os.path.join(tdir, leftover),
-                          ignore_errors=True)
+        # roll forward / clean up any snapshot install a crash cut short
+        self._complete_install_swap(self._tablet_dir(tablet_id))
         part = Partition(bytes.fromhex(meta["partition"][0]),
                          bytes.fromhex(meta["partition"][1]))
         tablet = Tablet(tablet_id, info, self._tablet_dir(tablet_id),
@@ -293,31 +324,35 @@ class TabletServer:
                    for s in ("regular", "intents")}
         for p in staging.values():
             shutil.rmtree(p, ignore_errors=True)
+        # fetch while the replica keeps serving
         await self._fetch_tablet_state(
             tuple(payload["src_addr"]), tablet_id,
             payload["snapshot_id"], staging)
+        # re-check after the long fetch await: a racing delete (or a
+        # second leader's install) may have removed the peer meanwhile
+        peer = self.peers.pop(tablet_id, None)
+        if peer is None:
+            for p in staging.values():
+                shutil.rmtree(p, ignore_errors=True)
+            raise RpcError(f"tablet {tablet_id} went away during "
+                           "snapshot fetch", "NOT_FOUND")
         with open(os.path.join(d, "tablet-meta.json")) as f:
             meta = json.load(f)
-        peer = self.peers.pop(tablet_id)
         await peer.shutdown()
-        # 1. retire the WAL (rename, not delete: cheap + atomic)
-        wals, wals_old = os.path.join(d, "wals"), os.path.join(d, "wals.old")
-        shutil.rmtree(wals_old, ignore_errors=True)
-        if os.path.isdir(wals):
-            os.rename(wals, wals_old)
-        # 2. swap each store: old -> .old, staged -> live
-        for s, staged in staging.items():
-            live, old = os.path.join(d, s), os.path.join(d, f"{s}.old")
-            shutil.rmtree(old, ignore_errors=True)
-            if os.path.isdir(live):
-                os.rename(live, old)
-            if os.path.isdir(staged):
-                os.rename(staged, live)
-        # 3. cleanup retired state
-        shutil.rmtree(wals_old, ignore_errors=True)
-        for s in staging:
-            shutil.rmtree(os.path.join(d, f"{s}.old"), ignore_errors=True)
-        await self._open_tablet(meta)
+        try:
+            # commit point: the marker makes the staged state
+            # authoritative; any crash from here rolls FORWARD at the
+            # next open (see _complete_install_swap)
+            marker = os.path.join(d, "install-commit")
+            with open(marker, "w") as f:
+                f.write(payload["snapshot_id"])
+                f.flush()
+                os.fsync(f.fileno())
+            self._complete_install_swap(d)
+        finally:
+            # reopen no matter what — a failed swap must not leave the
+            # tablet unserved until process restart
+            await self._open_tablet(meta)
         return {"ok": True}
 
     def _snapshot_dir(self, tablet_id: str, snapshot_id: str,
