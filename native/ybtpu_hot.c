@@ -1,0 +1,391 @@
+/* ybtpu_hot — CPython extension for the per-op host hot path.
+ *
+ * Reference analog: the row materialization inside the DocDB point-read
+ * path (src/yb/dockv/pg_row.cc PgTableRow::SetValue and the packed-row
+ * decoders in src/yb/dockv/packed_row.cc) — the per-row work that the
+ * reference does in C++ and a Python loop cannot do at OLTP rates.
+ *
+ * Exposes one type: Extractor. Built once per (table codec, columnar
+ * block), it captures raw pointers into the block's numpy arrays (refs
+ * held, buffers pinned via the buffer protocol) plus a decode plan, and
+ * materializes row dicts with a single C call per point read.
+ *
+ * Column kinds in the plan:
+ *   0 fixed-width value column   (values array + nulls array)
+ *   1 varlen str value column    (ends uint32 + heap bytes + nulls)
+ *   2 varlen bytes value column  (ends uint32 + heap bytes + nulls)
+ *   3 fixed-width pk column      (values array, never null)
+ *   4 missing column             (always None — added after version)
+ * Fixed dtypes are passed as a single char: q=i64 i=i32 h=i16 b=i8
+ * d=f64 f=f32 ?=bool Q=u64 I=u32.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+typedef struct {
+    PyObject *name;      /* interned column name */
+    int kind;
+    char dtype;          /* fixed kinds only */
+    Py_buffer vals;      /* fixed: values; varlen: ends (uint32) */
+    Py_buffer nulls;     /* null mask (uint8/bool), may be absent */
+    Py_buffer heap;      /* varlen heap bytes */
+    int has_vals, has_nulls, has_heap;
+} ColPlan;
+
+typedef struct {
+    PyObject_HEAD
+    Py_ssize_t ncols;
+    ColPlan *cols;
+} Extractor;
+
+static void
+Extractor_dealloc(Extractor *self)
+{
+    for (Py_ssize_t i = 0; i < self->ncols; i++) {
+        ColPlan *c = &self->cols[i];
+        Py_XDECREF(c->name);
+        if (c->has_vals) PyBuffer_Release(&c->vals);
+        if (c->has_nulls) PyBuffer_Release(&c->nulls);
+        if (c->has_heap) PyBuffer_Release(&c->heap);
+    }
+    PyMem_Free(self->cols);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+/* new Extractor(plan) — plan: list of
+ * (name:str, kind:int, dtype:str1, values_or_ends, nulls, heap) */
+static PyObject *
+Extractor_new(PyTypeObject *type, PyObject *args, PyObject *kwds)
+{
+    PyObject *plan;
+    if (!PyArg_ParseTuple(args, "O", &plan))
+        return NULL;
+    if (!PyList_Check(plan)) {
+        PyErr_SetString(PyExc_TypeError, "plan must be a list");
+        return NULL;
+    }
+    Py_ssize_t n = PyList_GET_SIZE(plan);
+    Extractor *self = (Extractor *)type->tp_alloc(type, 0);
+    if (!self) return NULL;
+    self->ncols = 0;
+    self->cols = (ColPlan *)PyMem_Calloc(n, sizeof(ColPlan));
+    if (!self->cols) { Py_DECREF(self); return PyErr_NoMemory(); }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *t = PyList_GET_ITEM(plan, i);
+        PyObject *name, *vals, *nulls, *heap;
+        int kind;
+        const char *dt;
+        if (!PyArg_ParseTuple(t, "OisOOO", &name, &kind, &dt,
+                              &vals, &nulls, &heap)) {
+            Py_DECREF(self);
+            return NULL;
+        }
+        ColPlan *c = &self->cols[i];
+        c->name = name; Py_INCREF(name);
+        c->kind = kind;
+        c->dtype = dt[0] ? dt[0] : 'q';
+        if (vals != Py_None) {
+            if (PyObject_GetBuffer(vals, &c->vals, PyBUF_SIMPLE) < 0) {
+                self->ncols = i + 1; Py_DECREF(self); return NULL;
+            }
+            c->has_vals = 1;
+        }
+        if (nulls != Py_None) {
+            if (PyObject_GetBuffer(nulls, &c->nulls, PyBUF_SIMPLE) < 0) {
+                self->ncols = i + 1; Py_DECREF(self); return NULL;
+            }
+            c->has_nulls = 1;
+        }
+        if (heap != Py_None) {
+            if (PyObject_GetBuffer(heap, &c->heap, PyBUF_SIMPLE) < 0) {
+                self->ncols = i + 1; Py_DECREF(self); return NULL;
+            }
+            c->has_heap = 1;
+        }
+        self->ncols = i + 1;
+    }
+    return (PyObject *)self;
+}
+
+static inline PyObject *
+fixed_value(const ColPlan *c, Py_ssize_t pos)
+{
+    const char *p = (const char *)c->vals.buf;
+    switch (c->dtype) {
+    case 'q': return PyLong_FromLongLong(((const int64_t *)p)[pos]);
+    case 'i': return PyLong_FromLong(((const int32_t *)p)[pos]);
+    case 'h': return PyLong_FromLong(((const int16_t *)p)[pos]);
+    case 'b': return PyLong_FromLong(((const int8_t *)p)[pos]);
+    case 'Q': return PyLong_FromUnsignedLongLong(
+                  ((const uint64_t *)p)[pos]);
+    case 'I': return PyLong_FromUnsignedLong(((const uint32_t *)p)[pos]);
+    case 'd': return PyFloat_FromDouble(((const double *)p)[pos]);
+    case 'f': return PyFloat_FromDouble(((const float *)p)[pos]);
+    case '?': {
+        PyObject *r = ((const uint8_t *)p)[pos] ? Py_True : Py_False;
+        Py_INCREF(r);
+        return r;
+    }
+    default:
+        PyErr_Format(PyExc_ValueError, "bad dtype %c", c->dtype);
+        return NULL;
+    }
+}
+
+/* extract(pos) -> dict */
+static PyObject *
+Extractor_extract(Extractor *self, PyObject *arg)
+{
+    Py_ssize_t pos = PyLong_AsSsize_t(arg);
+    if (pos < 0 && PyErr_Occurred())
+        return NULL;
+    PyObject *out = _PyDict_NewPresized(self->ncols);
+    if (!out) return NULL;
+    for (Py_ssize_t i = 0; i < self->ncols; i++) {
+        const ColPlan *c = &self->cols[i];
+        PyObject *v = NULL;
+        if (c->kind == 4 ||
+            (c->has_nulls && ((const uint8_t *)c->nulls.buf)[pos])) {
+            v = Py_None; Py_INCREF(v);
+        } else if (c->kind == 0 || c->kind == 3) {
+            v = fixed_value(c, pos);
+        } else {  /* varlen: vals buffer = uint32 end offsets */
+            const uint32_t *ends = (const uint32_t *)c->vals.buf;
+            uint32_t lo = pos ? ends[pos - 1] : 0;
+            uint32_t hi = ends[pos];
+            const char *base = (const char *)c->heap.buf;
+            v = (c->kind == 1)
+                ? PyUnicode_DecodeUTF8(base + lo, hi - lo, "strict")
+                : PyBytes_FromStringAndSize(base + lo, hi - lo);
+        }
+        if (!v || PyDict_SetItem(out, c->name, v) < 0) {
+            Py_XDECREF(v);
+            Py_DECREF(out);
+            return NULL;
+        }
+        Py_DECREF(v);
+    }
+    return out;
+}
+
+static PyMethodDef Extractor_methods[] = {
+    {"extract", (PyCFunction)Extractor_extract, METH_O,
+     "extract(pos) -> row dict"},
+    {NULL}
+};
+
+static PyTypeObject ExtractorType = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "ybtpu_hot.Extractor",
+    .tp_basicsize = sizeof(Extractor),
+    .tp_dealloc = (destructor)Extractor_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_doc = "per-(codec, block) point-read row extractor",
+    .tp_methods = Extractor_methods,
+    .tp_new = Extractor_new,
+};
+
+/* ---------------------------------------------------------------------
+ * encode_doc_key(spec, values) -> bytes
+ *
+ * The DocKey prefix encoder (reference: src/yb/dockv/doc_key.cc
+ * DocKey::Encode) — byte-identical to the Python
+ * TableCodec.doc_key_prefix for the supported kinds. spec is built once
+ * per codec: (cotable_id:i64 (-1 = none), num_hash:int, kinds:bytes,
+ * descs:bytes). Kind codes: 0 int64, 1 int32, 2 double, 3 string,
+ * 4 timestamp, 5 bytes. values is a tuple of per-column Python values
+ * (None encodes kNull).
+ */
+#define VT_GROUP_END 0x03
+#define VT_U16_HASH 0x08
+#define VT_COTABLE 0x0A
+#define VT_NULL 0x20
+#define VT_INT32 0x24
+#define VT_INT64 0x26
+#define VT_DOUBLE 0x28
+#define VT_STRING 0x2A
+#define VT_TIMESTAMP 0x2C
+#define VT_BYTES 0x2E
+#define DESC_OFF 0x20
+#define VT_NULL_DESC 0x5E
+
+typedef struct {
+    uint8_t *buf;
+    Py_ssize_t len, cap;
+} KeyBuf;
+
+static int kb_reserve(KeyBuf *kb, Py_ssize_t extra)
+{
+    if (kb->len + extra <= kb->cap) return 0;
+    Py_ssize_t ncap = kb->cap * 2 + extra + 64;
+    uint8_t *nb = (uint8_t *)PyMem_Realloc(kb->buf, ncap);
+    if (!nb) { PyErr_NoMemory(); return -1; }
+    kb->buf = nb; kb->cap = ncap;
+    return 0;
+}
+
+static inline void kb_put(KeyBuf *kb, uint8_t b) { kb->buf[kb->len++] = b; }
+
+/* encode one entry; returns bytes appended or -1 */
+static int
+encode_entry(KeyBuf *kb, int kind, int desc, PyObject *v)
+{
+    if (v == Py_None) {
+        /* match the Python encoder: NULL pk components are unsupported
+         * (it raises) — erroring here routes to the same Python error */
+        PyErr_SetString(PyExc_TypeError, "NULL key component");
+        return -1;
+    }
+    if (kind == 0 || kind == 1 || kind == 4) {          /* ints */
+        int width = (kind == 1) ? 4 : 8;
+        uint8_t marker = (kind == 1) ? VT_INT32
+                       : (kind == 4) ? VT_TIMESTAMP : VT_INT64;
+        long long x = PyLong_AsLongLong(v);
+        if (x == -1 && PyErr_Occurred()) return -1;
+        uint64_t biased = (width == 8)
+            ? (uint64_t)x + 0x8000000000000000ULL
+            : (uint64_t)(uint32_t)((int64_t)x + 0x80000000LL);
+        if (kb_reserve(kb, 1 + width) < 0) return -1;
+        kb_put(kb, desc ? marker + DESC_OFF : marker);
+        for (int i = width - 1; i >= 0; i--) {
+            uint8_t b = (uint8_t)(biased >> (8 * i));
+            kb_put(kb, desc ? (uint8_t)~b : b);
+        }
+        return 0;
+    }
+    if (kind == 2) {                                     /* double */
+        double dv = PyFloat_AsDouble(v);
+        if (dv == -1.0 && PyErr_Occurred()) return -1;
+        uint64_t bits;
+        memcpy(&bits, &dv, 8);
+        if (bits & 0x8000000000000000ULL) bits = ~bits;
+        else bits |= 0x8000000000000000ULL;
+        if (kb_reserve(kb, 9) < 0) return -1;
+        kb_put(kb, desc ? VT_DOUBLE + DESC_OFF : VT_DOUBLE);
+        for (int i = 7; i >= 0; i--) {
+            uint8_t b = (uint8_t)(bits >> (8 * i));
+            kb_put(kb, desc ? (uint8_t)~b : b);
+        }
+        return 0;
+    }
+    if (kind == 3 || kind == 5) {                        /* string/bytes */
+        const char *raw;
+        Py_ssize_t rn;
+        if (kind == 3) {
+            raw = PyUnicode_AsUTF8AndSize(v, &rn);
+            if (!raw) return -1;
+        } else {
+            if (PyBytes_AsStringAndSize(v, (char **)&raw, &rn) < 0)
+                return -1;
+        }
+        if (kb_reserve(kb, 1 + 2 * rn + 2) < 0) return -1;
+        kb_put(kb, desc ? ((kind == 3 ? VT_STRING : VT_BYTES) + DESC_OFF)
+                        : (kind == 3 ? VT_STRING : VT_BYTES));
+        for (Py_ssize_t i = 0; i < rn; i++) {
+            uint8_t b = (uint8_t)raw[i];
+            if (b == 0) {
+                kb_put(kb, desc ? 0xFF : 0x00);
+                kb_put(kb, desc ? 0xFE : 0x01);
+            } else {
+                kb_put(kb, desc ? (uint8_t)~b : b);
+            }
+        }
+        kb_put(kb, desc ? 0xFF : 0x00);   /* terminator \x00\x00 */
+        kb_put(kb, desc ? 0xFF : 0x00);
+        return 0;
+    }
+    PyErr_Format(PyExc_ValueError, "bad key kind %d", kind);
+    return -1;
+}
+
+static PyObject *
+py_encode_doc_key(PyObject *mod, PyObject *args)
+{
+    long long cotable;
+    int num_hash;
+    Py_buffer kinds, descs;
+    PyObject *values;
+    if (!PyArg_ParseTuple(args, "(Liy*y*)O", &cotable, &num_hash,
+                          &kinds, &descs, &values))
+        return NULL;
+    PyObject *result = NULL;
+    KeyBuf kb = {NULL, 0, 0};
+    Py_ssize_t ncols = 0;
+    const uint8_t *kk = (const uint8_t *)kinds.buf;
+    const uint8_t *dd = (const uint8_t *)descs.buf;
+    if (!PyTuple_Check(values)) {
+        PyErr_SetString(PyExc_TypeError, "values must be a tuple");
+        goto done;
+    }
+    ncols = PyTuple_GET_SIZE(values);
+    if (ncols != kinds.len || ncols != descs.len) {
+        PyErr_SetString(PyExc_ValueError, "spec/values length mismatch");
+        goto done;
+    }
+    if (kb_reserve(&kb, 16) < 0) goto done;
+    if (cotable >= 0) {
+        kb_put(&kb, VT_COTABLE);
+        for (int i = 3; i >= 0; i--)
+            kb_put(&kb, (uint8_t)((uint64_t)cotable >> (8 * i)));
+    }
+    if (num_hash > 0) {
+        /* FNV-1a over the encoded hash entries, folded to 16 bits
+         * (must agree bit-for-bit with dockv/partition.py) */
+        Py_ssize_t hash_at = kb.len;
+        kb_put(&kb, VT_U16_HASH);
+        kb_put(&kb, 0); kb_put(&kb, 0);       /* patched below */
+        Py_ssize_t h0 = kb.len;
+        for (int i = 0; i < num_hash; i++) {
+            if (encode_entry(&kb, kk[i], dd[i],
+                             PyTuple_GET_ITEM(values, i)) < 0)
+                goto done;
+        }
+        uint64_t h = 0xCBF29CE484222325ULL;
+        for (Py_ssize_t i = h0; i < kb.len; i++)
+            h = (h ^ kb.buf[i]) * 0x100000001B3ULL;
+        h ^= h >> 32;
+        uint16_t h16 = (uint16_t)(h & 0xFFFF);
+        kb.buf[hash_at + 1] = (uint8_t)(h16 >> 8);
+        kb.buf[hash_at + 2] = (uint8_t)(h16 & 0xFF);
+        if (kb_reserve(&kb, 1) < 0) goto done;
+        kb_put(&kb, VT_GROUP_END);
+    }
+    for (Py_ssize_t i = num_hash; i < ncols; i++) {
+        if (encode_entry(&kb, kk[i], dd[i],
+                         PyTuple_GET_ITEM(values, i)) < 0)
+            goto done;
+    }
+    if (kb_reserve(&kb, 1) < 0) goto done;
+    kb_put(&kb, VT_GROUP_END);
+    result = PyBytes_FromStringAndSize((const char *)kb.buf, kb.len);
+done:
+    PyMem_Free(kb.buf);
+    PyBuffer_Release(&kinds);
+    PyBuffer_Release(&descs);
+    return result;
+}
+
+static PyMethodDef hot_methods[] = {
+    {"encode_doc_key", py_encode_doc_key, METH_VARARGS,
+     "encode_doc_key(spec, values) -> encoded DocKey bytes"},
+    {NULL}
+};
+
+static PyModuleDef hotmodule = {
+    PyModuleDef_HEAD_INIT, "ybtpu_hot",
+    "native host hot path (row extraction, key encode)", -1, hot_methods,
+};
+
+PyMODINIT_FUNC
+PyInit_ybtpu_hot(void)
+{
+    if (PyType_Ready(&ExtractorType) < 0)
+        return NULL;
+    PyObject *m = PyModule_Create(&hotmodule);
+    if (!m) return NULL;
+    Py_INCREF(&ExtractorType);
+    PyModule_AddObject(m, "Extractor", (PyObject *)&ExtractorType);
+    return m;
+}

@@ -1,0 +1,60 @@
+"""Loader for the ybtpu_hot CPython extension (native/ybtpu_hot.c).
+
+Auto-builds with g++ + the CPython headers on first import when the .so
+is missing. Every caller has a pure-Python fallback, so environments
+without a toolchain still work (same policy as storage/native_lib.py).
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sysconfig
+from typing import Optional
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "native")
+_SRC = os.path.join(_NATIVE_DIR, "ybtpu_hot.c")
+_SO = os.path.join(_NATIVE_DIR, "ybtpu_hot.so")
+
+_MOD = None
+_TRIED = False
+
+
+def _build() -> bool:
+    if not os.path.exists(_SRC):
+        return False
+    inc = sysconfig.get_paths()["include"]
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-shared", "-fPIC", f"-I{inc}", _SRC,
+             "-o", _SO],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except Exception:
+        return False
+
+
+def load():
+    """The extension module, or None when unavailable."""
+    global _MOD, _TRIED
+    if _TRIED:
+        return _MOD
+    _TRIED = True
+    if (not os.path.exists(_SO)
+            or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+        if not _build():
+            return None
+    try:
+        spec = importlib.util.spec_from_file_location("ybtpu_hot", _SO)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _MOD = mod
+    except Exception:
+        _MOD = None
+    return _MOD
+
+
+def available() -> bool:
+    return load() is not None

@@ -21,10 +21,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..dockv.key_encoding import ValueType
-from ..dockv.value import PrimitiveValue, ValueKind
+from ..dockv.value import PrimitiveValue, ValueKind, unwrap_ttl
 from ..ops.device_batch import build_batch
 from ..ops.scan import AggSpec, GroupSpec, ScanKernel
-from ..storage.columnar import ColumnarBlock
+from ..storage.columnar import ColumnarBlock, fnv64_bytes
 from ..storage.lsm import LsmStore, WriteBatch
 from ..utils import flags
 from ..utils.hybrid_time import ENCODED_SIZE, DocHybridTime, HybridTime
@@ -313,48 +313,53 @@ class DocReadOperation:
         """Newest visible version across memtable + SSTs, using per-SST
         bloom filters and columnar binary search (reference:
         DocDBTableReader point-get over BlockBasedTable::Get)."""
-        from ..dockv.value import unwrap_ttl
-        from ..storage.columnar import fnv64_bytes
         prefix = self.codec.doc_key_prefix(pk_row)
         h = fnv64_bytes(prefix)
+        plen = len(prefix)
+        kht = ValueType.kHybridTime
+        restart_hi = (read_ht + _skew_window_ht()
+                      if self._allow_restart else None)
 
-        window_hi = read_ht + _skew_window_ht()
-
-        def newest_visible(entries):
-            for k, v in entries:
-                if not k.startswith(prefix) or \
-                        k[len(prefix)] != ValueType.kHybridTime:
-                    return None
-                dht = DocHybridTime.decode_desc(k[-ENCODED_SIZE:])
-                if dht.ht.value > read_ht:
-                    if self._allow_restart and \
-                            dht.ht.value <= window_hi:
-                        # concurrent write inside the uncertainty window:
-                        # the writer's clock may be ahead — restart
-                        raise ReadRestartError(dht.ht.value)
-                    continue
-                return (dht, k, v)
-            return None
-
+        # best = (ht, write_id, key, value, block, pos)
         best = None
         with self.store._lock:
             mems = [self.store._mem] + list(self.store._frozen)
             ssts = list(self.store._ssts)
         for m in mems:
-            c = newest_visible(m.seek(prefix))
-            if c and (best is None or (c[0].ht.value, c[0].write_id) >
-                      (best[0].ht.value, best[0].write_id)):
-                best = c
+            if m.empty():
+                continue
+            for k, v in m.seek(prefix):
+                if not k.startswith(prefix) or k[plen] != kht:
+                    break
+                dht = DocHybridTime.decode_desc(k[-ENCODED_SIZE:])
+                ht = dht.ht.value
+                if ht > read_ht:
+                    if restart_hi is not None and ht <= restart_hi:
+                        # concurrent write inside the uncertainty
+                        # window: the writer's clock may be ahead
+                        raise ReadRestartError(ht)
+                    continue
+                if best is None or (ht, dht.write_id) > best[:2]:
+                    best = (ht, dht.write_id, k, v, None, None)
+                break
         for r in ssts:
             if not r.may_contain_hash(h):
                 continue
-            c = newest_visible(r.point_entries(prefix))
-            if c and (best is None or (c[0].ht.value, c[0].write_id) >
-                      (best[0].ht.value, best[0].write_id)):
+            found = r.point_find(prefix, read_ht, restart_hi)
+            if found is None:
+                continue
+            if found[0] == "restart":
+                raise ReadRestartError(found[1])
+            c = found[1:]
+            if best is None or c[:2] > best[:2]:
                 best = c
         if best is None:
             return None
-        _, k, v = best
+        _, _, k, v, cb, pos = best
+        if cb is not None:
+            # columnar winner: direct single-row decode (no TTL wrapper
+            # possible — TTL'd blocks never get a columnar sidecar)
+            return self.codec.decode_block_row(cb, pos, k)
         v, expire = unwrap_ttl(v)
         if expire is not None and expire <= read_ht:
             return None

@@ -96,6 +96,11 @@ class ColumnarBlock:
     # matrix — present on columnar-only blocks (bulk loads), where the KV
     # row region is omitted entirely and rows are reconstructed on demand.
     keys: Optional[np.ndarray] = None
+    # lazily-built void view of `keys` for binary search (point reads
+    # revisit hot blocks; rebuilding the view per lookup is an O(block)
+    # copy)
+    _void_keys: Optional[np.ndarray] = field(default=None, repr=False,
+                                             compare=False)
 
     # ------------------------------------------------------------------
     @classmethod
@@ -284,13 +289,17 @@ class ColumnarBlock:
         Pads/truncates `key` to the matrix width; doc-key prefix freedom
         makes zero padding order-correct."""
         assert self.keys is not None
-        w = self.keys.shape[1]
+        if self._void_keys is None:
+            w = self.keys.shape[1]
+            v = np.dtype((np.void, w))
+            object.__setattr__(
+                self, "_void_keys",
+                np.ascontiguousarray(self.keys).view(v).reshape(-1))
+        vk = self._void_keys
+        w = vk.dtype.itemsize
         probe = key[:w].ljust(w, b"\x00")
-        v = np.dtype((np.void, w))
-        rows = np.ascontiguousarray(self.keys).view(v).reshape(-1)
-        target = np.frombuffer(probe, np.uint8).reshape(1, w)
-        t = np.ascontiguousarray(target).view(v).reshape(-1)[0]
-        return int(np.searchsorted(rows, t, side="left"))
+        t = np.frombuffer(probe, vk.dtype)[0]
+        return int(np.searchsorted(vk, t, side="left"))
 
 
 def _varint_len(v: int) -> int:

@@ -380,45 +380,67 @@ class SstReader:
     def may_contain_hash(self, key_hash: int) -> bool:
         return self.bloom.may_contain(key_hash)
 
-    def point_entries(self, prefix: bytes) -> Iterator[Tuple[bytes, bytes]]:
-        """Entries whose key starts with `prefix` (a doc key), without
-        decoding whole columnar-only blocks — binary search in the block
-        keys matrix + single-row slice decode (the point-read fast path;
-        reference analog: BlockBasedTable::Get)."""
+    def point_find(self, prefix: bytes, read_ht: int,
+                   restart_hi: Optional[int] = None):
+        """Newest VISIBLE version of the doc key `prefix` in this SST —
+        the fused point-read hot path (reference analog:
+        BlockBasedTable::Get + DocDB visibility). Returns one of:
+          ("row", ht, write_id, key, value, block, pos)  — found;
+            columnar hits carry value=None and (block, pos) for lazy
+            single-row decode, row-path hits carry the raw value
+          ("restart", ht)  — a version inside the clock-uncertainty
+            window (read_ht, restart_hi] exists: caller restarts
+          None — no visible version here
+        Reads MVCC metadata straight from the columnar ht/write_id
+        arrays instead of decoding the key's DocHybridTime suffix."""
         import bisect
         bi = max(bisect.bisect_right(self._first_keys, prefix) - 1, 0)
+        plen = len(prefix)
         for i in range(bi, len(self.index)):
             e = self.index[i]
             if e.first_key > prefix and not e.first_key.startswith(prefix):
-                return
+                return None
             if e.last_key < prefix:
                 continue
             cb = (self.columnar_block(i)
                   if self.row_decoder is not None else None)
             if cb is not None and cb.keys is None:
-                cb = None   # variable-length PKs: no keys matrix to
-                            # binary-search; fall back to row decode
+                cb = None
             if cb is not None:
-                # columnar fast path whenever a sidecar exists (also for
-                # blocks that carry row data): binary search + single-row
-                # slice beats decoding the whole block for one key
                 pos = cb.searchsorted_key(prefix)
+                keys, hts, n = cb.keys, cb.ht, cb.n
                 advanced = False
-                while pos < cb.n and cb.keys[pos].tobytes().startswith(
-                        prefix):
-                    yield from self.row_decoder(cb.slice(pos, pos + 1))
-                    pos += 1
+                while pos < n:
+                    k = keys[pos].tobytes()
+                    if k[:plen] != prefix:
+                        break
                     advanced = True
-                if pos < cb.n:
-                    return       # walked past the prefix inside this block
+                    ht = int(hts[pos])
+                    if ht > read_ht:
+                        if restart_hi is not None and ht <= restart_hi:
+                            return ("restart", ht)
+                        pos += 1
+                        continue
+                    return ("row", ht, int(cb.write_id[pos]), k, None,
+                            cb, pos)
+                if pos < n:
+                    return None     # walked past the prefix in-block
                 if not advanced and pos == 0:
-                    return
+                    return None
             else:
+                from ..utils.hybrid_time import DocHybridTime, ENCODED_SIZE
                 for k, v in self._read_block(i):
                     if k >= prefix:
-                        if not k.startswith(prefix):
-                            return
-                        yield k, v
+                        if k[:plen] != prefix:
+                            return None
+                        dht = DocHybridTime.decode_desc(k[-ENCODED_SIZE:])
+                        ht = dht.ht.value
+                        if ht > read_ht:
+                            if restart_hi is not None and ht <= restart_hi:
+                                return ("restart", ht)
+                            continue
+                        return ("row", ht, dht.write_id, k, v, None, None)
+        return None
 
     # --- columnar access --------------------------------------------------
     def columnar_block(self, i: int) -> Optional[ColumnarBlock]:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 import threading
+from time import perf_counter as _perf_counter
 from typing import Dict, Optional
 
 import numpy as np
@@ -141,14 +142,13 @@ class Tablet:
 
     # --- reads ------------------------------------------------------------
     def read(self, req: ReadRequest) -> ReadResponse:
-        import time
-        t0 = time.perf_counter()
+        t0 = _perf_counter()
         if req.read_ht is None:
             req.read_ht = self.clock.now().value
             req.server_assigned_read_ht = True
         resp = self._read_ops.get(req.table_id, self._read_op).execute(req)
         self._m_reads.increment()
-        self._m_read_lat.increment((time.perf_counter() - t0) * 1e6)
+        self._m_read_lat.increment((_perf_counter() - t0) * 1e6)
         return resp
 
     def safe_time(self) -> HybridTime:

@@ -95,6 +95,13 @@ class TabletPeer:
         import uuid as _uuid
         snapshot_id = f"rbs-{_uuid.uuid4().hex[:12]}"
         d = os.path.join(self.tablet.dir, "snapshots", snapshot_id)
+        # bulk flush off-loop first (a large memtable flush on the event
+        # loop would stall heartbeats past the election timeout); the
+        # create_snapshot call on the loop then re-flushes near-nothing
+        # and hard-links, keeping the regular/intents cut consistent
+        loop = asyncio.get_running_loop()
+        await loop.run_in_executor(None, self.tablet.flush)
+        await loop.run_in_executor(None, self.tablet.intents.flush)
         frontier = self.tablet.create_snapshot(d)
         try:
             await self.consensus.messenger.call(

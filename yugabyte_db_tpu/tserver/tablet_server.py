@@ -319,6 +319,23 @@ class TabletServer:
         tablet_id = payload["tablet_id"]
         if tablet_id not in self.peers:
             raise RpcError(f"tablet {tablet_id} not found", "NOT_FOUND")
+        # serialize installs per tablet: two concurrent fetches would
+        # interleave writes into the same staging dirs and could commit
+        # a mixed-snapshot store as authoritative
+        installing = getattr(self, "_installing", None)
+        if installing is None:
+            installing = self._installing = set()
+        if tablet_id in installing:
+            raise RpcError(f"install already running for {tablet_id}",
+                           "TRY_AGAIN")
+        installing.add(tablet_id)
+        try:
+            return await self._do_install_snapshot(tablet_id, payload)
+        finally:
+            installing.discard(tablet_id)
+
+    async def _do_install_snapshot(self, tablet_id: str, payload) -> dict:
+        import shutil
         d = self._tablet_dir(tablet_id)
         staging = {s: os.path.join(d, f"{s}.install")
                    for s in ("regular", "intents")}
