@@ -37,6 +37,7 @@ typedef struct {
 typedef struct {
     PyObject_HEAD
     Py_ssize_t ncols;
+    Py_ssize_t nrows;
     ColPlan *cols;
 } Extractor;
 
@@ -54,13 +55,14 @@ Extractor_dealloc(Extractor *self)
     Py_TYPE(self)->tp_free((PyObject *)self);
 }
 
-/* new Extractor(plan) — plan: list of
+/* new Extractor(plan, nrows) — plan: list of
  * (name:str, kind:int, dtype:str1, values_or_ends, nulls, heap) */
 static PyObject *
 Extractor_new(PyTypeObject *type, PyObject *args, PyObject *kwds)
 {
     PyObject *plan;
-    if (!PyArg_ParseTuple(args, "O", &plan))
+    Py_ssize_t nrows;
+    if (!PyArg_ParseTuple(args, "On", &plan, &nrows))
         return NULL;
     if (!PyList_Check(plan)) {
         PyErr_SetString(PyExc_TypeError, "plan must be a list");
@@ -69,6 +71,7 @@ Extractor_new(PyTypeObject *type, PyObject *args, PyObject *kwds)
     Py_ssize_t n = PyList_GET_SIZE(plan);
     Extractor *self = (Extractor *)type->tp_alloc(type, 0);
     if (!self) return NULL;
+    self->nrows = nrows;
     self->ncols = 0;
     self->cols = (ColPlan *)PyMem_Calloc(n, sizeof(ColPlan));
     if (!self->cols) { Py_DECREF(self); return PyErr_NoMemory(); }
@@ -139,8 +142,12 @@ static PyObject *
 Extractor_extract(Extractor *self, PyObject *arg)
 {
     Py_ssize_t pos = PyLong_AsSsize_t(arg);
-    if (pos < 0 && PyErr_Occurred())
+    if (pos == -1 && PyErr_Occurred())
         return NULL;
+    if (pos < 0 || pos >= self->nrows) {
+        PyErr_Format(PyExc_IndexError, "row %zd out of range", pos);
+        return NULL;
+    }
     PyObject *out = _PyDict_NewPresized(self->ncols);
     if (!out) return NULL;
     for (Py_ssize_t i = 0; i < self->ncols; i++) {
@@ -244,6 +251,13 @@ encode_entry(KeyBuf *kb, int kind, int desc, PyObject *v)
                        : (kind == 4) ? VT_TIMESTAMP : VT_INT64;
         long long x = PyLong_AsLongLong(v);
         if (x == -1 && PyErr_Occurred()) return -1;
+        if (width == 4 && (x < INT32_MIN || x > INT32_MAX)) {
+            /* the Python encoder raises OverflowError here; silent
+             * truncation would key a DIFFERENT row */
+            PyErr_SetString(PyExc_OverflowError,
+                            "int32 key component out of range");
+            return -1;
+        }
         uint64_t biased = (width == 8)
             ? (uint64_t)x + 0x8000000000000000ULL
             : (uint64_t)(uint32_t)((int64_t)x + 0x80000000LL);

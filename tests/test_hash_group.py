@@ -1,0 +1,215 @@
+"""Device GROUP BY without declared domains (HashGroupSpec): sort +
+segment aggregation, no ANALYZE prerequisite (reference: unconditional
+aggregate pushdown, docdb/pgsql_operation.cc:3153-3163)."""
+import asyncio
+import tempfile
+
+import numpy as np
+import pytest
+
+from yugabyte_db_tpu.docdb.operations import ReadRequest
+from yugabyte_db_tpu.models.tpch import (
+    TPCH_Q1, LineitemTable, generate_lineitem, numpy_reference,
+)
+from yugabyte_db_tpu.ops import AggSpec
+from yugabyte_db_tpu.ops.scan import GroupSpec, HashGroupSpec
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _q1_hash_spec():
+    # same group columns as TPCH_Q1 but with NO domain declaration
+    return HashGroupSpec(cols=tuple(c for c, _, _ in TPCH_Q1.group.cols))
+
+
+class TestKernelHashGroup:
+    def test_q1_matches_reference_without_stats(self):
+        data = generate_lineitem(0.002)
+        table = LineitemTable(tempfile.mkdtemp(prefix="hg-"),
+                              num_tablets=1)
+        table.load(data)
+        t = table.tablets[0]
+        resp = t.read(ReadRequest(
+            "lineitem", where=TPCH_Q1.where, aggregates=TPCH_Q1.aggs,
+            group_by=_q1_hash_spec()))
+        assert resp.backend == "tpu"
+        assert resp.group_values is not None
+        ref = numpy_reference(TPCH_Q1, data)
+        counts = np.asarray(resp.group_counts)
+        live = np.nonzero(counts)[0]
+        assert len(live) == 6
+        for g in live:
+            rf = int(resp.group_values[0][g])
+            ls = int(resp.group_values[1][g])
+            want_qty, want_price, want_cnt = ref[rf + 3 * ls]
+            assert int(counts[g]) == want_cnt
+            assert abs(float(resp.agg_values[0][g]) - want_qty) < 1e-3
+            rel = abs(float(resp.agg_values[1][g]) - want_price) / \
+                max(want_price, 1e-9)
+            assert rel < 1e-5
+
+    def test_overflow_falls_back_to_cpu(self):
+        data = generate_lineitem(0.002)
+        table = LineitemTable(tempfile.mkdtemp(prefix="hgo-"),
+                              num_tablets=1)
+        table.load(data)
+        t = table.tablets[0]
+        # group by rowid: every row its own group — far past max_groups
+        spec = HashGroupSpec(cols=(0,), max_groups=64)
+        resp = t.read(ReadRequest(
+            "lineitem", aggregates=(AggSpec("count"),), group_by=spec,
+            limit=None))
+        assert resp.backend == "cpu"
+        assert len(np.asarray(resp.group_counts)) == len(data["rowid"])
+        assert np.asarray(resp.group_counts).sum() == len(data["rowid"])
+
+    def test_min_max_and_nulls(self):
+        """NULL group keys are excluded; min/max aggregate correctly."""
+        from yugabyte_db_tpu.docdb.operations import RowOp, WriteRequest
+        from yugabyte_db_tpu.docdb.table_codec import TableInfo
+        from yugabyte_db_tpu.dockv.packed_row import (
+            ColumnSchema, ColumnType, TableSchema,
+        )
+        from yugabyte_db_tpu.dockv.partition import PartitionSchema
+        from yugabyte_db_tpu.tablet import Tablet
+        schema = TableSchema((
+            ColumnSchema(0, "k", ColumnType.INT64, is_hash_key=True),
+            ColumnSchema(1, "g", ColumnType.INT64),
+            ColumnSchema(2, "v", ColumnType.FLOAT64),
+        ), 1)
+        info = TableInfo("t", "t", schema, PartitionSchema("hash", 1))
+        t = Tablet("t", info, tempfile.mkdtemp(prefix="hgn-"))
+        rows = []
+        for i in range(5000):
+            rows.append({"k": i, "g": None if i % 11 == 0 else i % 37,
+                         "v": float(i)})
+        t.apply_write(WriteRequest("t", [RowOp("upsert", r)
+                                         for r in rows]))
+        t.flush()
+        resp = t.read(ReadRequest(
+            "t", aggregates=(AggSpec("min", ("col", 2)),
+                             AggSpec("max", ("col", 2)),
+                             AggSpec("count")),
+            group_by=HashGroupSpec(cols=(1,))))
+        counts = np.asarray(resp.group_counts)
+        live = np.nonzero(counts)[0]
+        assert len(live) == 37
+        # python reference
+        ref = {}
+        for r in rows:
+            if r["g"] is None:
+                continue
+            st = ref.setdefault(r["g"], [np.inf, -np.inf, 0])
+            st[0] = min(st[0], r["v"])
+            st[1] = max(st[1], r["v"])
+            st[2] += 1
+        for g in live:
+            gv = int(resp.group_values[0][g])
+            assert float(resp.agg_values[0][g]) == ref[gv][0]
+            assert float(resp.agg_values[1][g]) == ref[gv][1]
+            assert int(counts[g]) == ref[gv][2]
+
+
+class TestMinMaxNullParity:
+    def test_all_null_group_min_is_null_on_both_paths(self):
+        """MIN/MAX over a group whose aggregated column is entirely NULL
+        must be SQL NULL on the device path AND the CPU path — not a
+        dtype sentinel, not 0."""
+        from yugabyte_db_tpu.docdb.operations import RowOp, WriteRequest
+        from yugabyte_db_tpu.docdb.table_codec import TableInfo
+        from yugabyte_db_tpu.dockv.packed_row import (
+            ColumnSchema, ColumnType, TableSchema,
+        )
+        from yugabyte_db_tpu.dockv.partition import PartitionSchema
+        from yugabyte_db_tpu.tablet import Tablet
+        from yugabyte_db_tpu.utils import flags
+        schema = TableSchema((
+            ColumnSchema(0, "k", ColumnType.INT64, is_hash_key=True),
+            ColumnSchema(1, "g", ColumnType.INT64),
+            ColumnSchema(2, "v", ColumnType.FLOAT64),
+        ), 1)
+        info = TableInfo("t", "t", schema, PartitionSchema("hash", 1))
+        t = Tablet("t", info, tempfile.mkdtemp(prefix="mmn-"))
+        rows = [{"k": i, "g": i % 2,
+                 "v": None if i % 2 == 0 else float(i)}
+                for i in range(6000)]
+        t.apply_write(WriteRequest("t", [RowOp("upsert", r)
+                                         for r in rows]))
+        t.flush()
+        req = lambda: ReadRequest(  # noqa: E731
+            "t", aggregates=(AggSpec("min", ("col", 2)),
+                             AggSpec("count")),
+            group_by=HashGroupSpec(cols=(1,)))
+        dev = t.read(req())
+        assert dev.backend == "tpu"
+        flags.set_flag("tpu_pushdown_enabled", False)
+        try:
+            cpu = t.read(req())
+        finally:
+            flags.set_flag("tpu_pushdown_enabled", True)
+        assert cpu.backend == "cpu"
+        for resp in (dev, cpu):
+            counts = np.asarray(resp.group_counts)
+            by_g = {}
+            for g in np.nonzero(counts)[0]:
+                by_g[int(np.asarray(resp.group_values[0])[g])] = \
+                    np.asarray(resp.agg_values[0], object)[g]
+            assert by_g[0] is None, resp.backend   # all-NULL group
+            assert float(by_g[1]) == 1.0, resp.backend
+
+
+class TestSqlHashGroup:
+    def test_group_by_without_analyze_pushes_down(self, tmp_path):
+        async def go():
+            from yugabyte_db_tpu.ql import SqlSession
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            s = SqlSession(mc.client())
+            await s.execute("CREATE TABLE m (k bigint, g bigint, "
+                            "v double, PRIMARY KEY (k))")
+            vals = ", ".join(f"({i}, {i % 53}, {i * 0.5})"
+                             for i in range(6000))
+            await s.execute(f"INSERT INTO m (k, g, v) VALUES {vals}")
+            # NO ANALYZE ran: must still push down (hash group)
+            ex = await s.execute(
+                "EXPLAIN SELECT g, sum(v), count(*) FROM m GROUP BY g")
+            plan = " ".join(str(r) for r in ex.rows)
+            assert "DEVICE pushdown: sort + segment" in plan
+            res = await s.execute(
+                "SELECT g, sum(v), count(*) FROM m GROUP BY g")
+            assert len(res.rows) == 53
+            by_g = {r["g"]: r for r in res.rows}
+            want = {}
+            for i in range(6000):
+                st = want.setdefault(i % 53, [0.0, 0])
+                st[0] += i * 0.5
+                st[1] += 1
+            for g, (sv, cnt) in want.items():
+                assert by_g[g]["count"] == cnt
+                assert abs(by_g[g]["sum_v"] - sv) < 1e-6
+            await mc.shutdown()
+        run(go())
+
+    def test_multi_tablet_hash_group_combine(self, tmp_path):
+        """Hash-group slots differ per tablet; the client must merge
+        partials by group KEY."""
+        async def go():
+            from yugabyte_db_tpu.ql import SqlSession
+            mc = await MiniCluster(str(tmp_path), num_tservers=2).start()
+            s = SqlSession(mc.client())
+            await s.execute("CREATE TABLE m2 (k bigint, g bigint, "
+                            "v double, PRIMARY KEY (k)) WITH tablets = 4")
+            vals = ", ".join(f"({i}, {i % 19}, 1.0)" for i in range(4000))
+            await s.execute(f"INSERT INTO m2 (k, g, v) VALUES {vals}")
+            res = await s.execute(
+                "SELECT g, count(*), sum(v) FROM m2 GROUP BY g")
+            assert len(res.rows) == 19
+            for r in res.rows:
+                g = r["g"]
+                want = len([i for i in range(4000) if i % 19 == g])
+                assert r["count"] == want
+                assert abs(r["sum_v"] - want) < 1e-9
+            await mc.shutdown()
+        run(go())

@@ -23,6 +23,41 @@ from ..dockv.partition import Partition
 from ..rpc.messenger import Messenger, RpcError
 
 
+def _item(x):
+    """Python scalar from a 0-d array / numpy scalar / plain value."""
+    if isinstance(x, np.ndarray):
+        return x.item()
+    if isinstance(x, np.generic):
+        return x.item()
+    return x
+
+
+def _merge_minmax(a, b, op):
+    """None-aware elementwise min/max over scalars or per-group arrays
+    (SQL semantics: NULL is the identity, never the answer over a
+    non-empty input set)."""
+    av, bv = np.asarray(a), np.asarray(b)
+    if av.ndim == 0:
+        x, y = av.item(), bv.item()
+        if x is None:
+            return bv
+        if y is None:
+            return av
+        return np.asarray(min(x, y) if op == "min" else max(x, y))
+    if av.dtype != object and bv.dtype != object:
+        return np.minimum(av, bv) if op == "min" else np.maximum(av, bv)
+    out = np.empty(av.shape, object)
+    for i in range(av.shape[0]):
+        x, y = _item(av[i]), _item(bv[i])
+        if x is None:
+            out[i] = y
+        elif y is None:
+            out[i] = x
+        else:
+            out[i] = min(x, y) if op == "min" else max(x, y)
+    return out
+
+
 @dataclass
 class TabletLocation:
     tablet_id: str
@@ -367,8 +402,10 @@ class YBClient:
                 rows = rows[:req.limit]
             return ReadResponse(rows=rows,
                                 backend=parts[0].backend if parts else "cpu")
-        from ..ops.scan import _expand_avg
+        from ..ops.scan import HashGroupSpec, _expand_avg
         aggs = _expand_avg(req.aggregates)
+        if isinstance(req.group_by, HashGroupSpec):
+            return self._combine_hash_groups(aggs, parts)
         total = None
         counts = None
         for p in parts:
@@ -390,13 +427,56 @@ class YBClient:
                     pass
                 elif _none(total[i]):
                     total[i] = vals[i]
-                elif a.op == "min":
-                    total[i] = np.minimum(total[i], vals[i])
                 else:
-                    total[i] = np.maximum(total[i], vals[i])
+                    total[i] = _merge_minmax(total[i], vals[i], a.op)
             if counts is not None:
                 counts = counts + np.asarray(p.group_counts)
         return ReadResponse(agg_values=tuple(total), group_counts=counts,
+                            backend=parts[0].backend if parts else "cpu")
+
+    @staticmethod
+    def _mm2(x, y, op):
+        """None-aware scalar min/max (SQL: NULL is the identity)."""
+        if x is None:
+            return y
+        if y is None:
+            return x
+        return min(x, y) if op == "min" else max(x, y)
+
+    def _combine_hash_groups(self, aggs, parts: List[ReadResponse]
+                             ) -> ReadResponse:
+        """Merge per-tablet hash-grouped partials BY GROUP KEY — slots
+        aren't aligned across tablets the way dictionary group ids are
+        (reference analog: pggate's client-side grouped-partial
+        combine)."""
+        merged: Dict[tuple, list] = {}
+        for p in parts:
+            if p.group_counts is None:
+                continue
+            counts = np.asarray(p.group_counts)
+            gvals = [np.asarray(g) for g in (p.group_values or ())]
+            vals = [np.asarray(v) for v in p.agg_values]
+            for g in np.nonzero(counts)[0]:
+                key = tuple(x[g].item() for x in gvals)
+                st = merged.get(key)
+                if st is None:
+                    merged[key] = [[v[g] for v in vals], int(counts[g])]
+                    continue
+                for i, a in enumerate(aggs):
+                    if a.op in ("sum", "count"):
+                        st[0][i] = st[0][i] + vals[i][g]
+                    else:
+                        st[0][i] = self._mm2(_item(st[0][i]),
+                                             _item(vals[i][g]), a.op)
+                st[1] += int(counts[g])
+        keys = list(merged)
+        outs = tuple(np.asarray([merged[k][0][i] for k in keys])
+                     for i in range(len(aggs)))
+        counts = np.asarray([merged[k][1] for k in keys], np.int64)
+        gvals = tuple(np.asarray([k[j] for k in keys])
+                      for j in range(len(keys[0]) if keys else 0))
+        return ReadResponse(agg_values=outs, group_counts=counts,
+                            group_values=gvals,
                             backend=parts[0].backend if parts else "cpu")
 
     # --- vector search ------------------------------------------------------

@@ -42,16 +42,19 @@ def load():
     if _TRIED:
         return _MOD
     _TRIED = True
-    if (not os.path.exists(_SO)
-            or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
-        if not _build():
-            return None
     try:
+        stale = (not os.path.exists(_SO)
+                 or (os.path.exists(_SRC)
+                     and os.path.getmtime(_SO) < os.path.getmtime(_SRC)))
+        if stale and not _build():
+            return None
         spec = importlib.util.spec_from_file_location("ybtpu_hot", _SO)
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         _MOD = mod
     except Exception:
+        # missing source next to a shipped .so, unreadable paths, ...:
+        # the pure-Python fallback must always remain available
         _MOD = None
     return _MOD
 

@@ -23,7 +23,7 @@ import numpy as np
 from ..dockv.key_encoding import ValueType
 from ..dockv.value import PrimitiveValue, ValueKind, unwrap_ttl
 from ..ops.device_batch import build_batch
-from ..ops.scan import AggSpec, GroupSpec, ScanKernel
+from ..ops.scan import AggSpec, GroupSpec, HashGroupSpec, ScanKernel
 from ..storage.columnar import ColumnarBlock, fnv64_bytes
 from ..storage.lsm import LsmStore, WriteBatch
 from ..utils import flags
@@ -85,6 +85,9 @@ class ReadResponse:
     rows: List[Dict[str, object]] = field(default_factory=list)
     agg_values: Optional[tuple] = None        # scalars or per-group arrays
     group_counts: Optional[object] = None
+    # hash-grouped results: per-group key values, aligned with
+    # group_counts / agg_values (order matches the HashGroupSpec cols)
+    group_values: Optional[tuple] = None
     paging_state: Optional[bytes] = None
     backend: str = "cpu"                      # which path executed
 
@@ -481,7 +484,9 @@ class DocReadOperation:
         for a in req.aggregates:
             if a.expr is not None:
                 referenced_columns(a.expr, needed)
-        if req.group_by is not None:
+        if isinstance(req.group_by, HashGroupSpec):
+            needed.update(req.group_by.cols)
+        elif req.group_by is not None:
             needed.update(cid for cid, _, _ in req.group_by.cols)
         try:
             if self.device_cache is not None:
@@ -505,9 +510,45 @@ class DocReadOperation:
         # multiple overlapping sources → force dedup mode via unique_keys
         if len(blocks) > 1:
             batch.unique_keys = False
+        # SQL NULL semantics for MIN/MAX over zero qualifying inputs:
+        # the kernel returns a dtype sentinel there, so run a hidden
+        # companion COUNT per min/max aggregate and replace sentinel
+        # results with None host-side (the CPU twin returns None too)
+        from ..ops.scan import _expand_avg
+        expanded = tuple(_expand_avg(req.aggregates))
+        minmax = [i for i, a in enumerate(expanded)
+                  if a.op in ("min", "max")]
+        aggs_run = expanded + tuple(AggSpec("count", expanded[i].expr)
+                                    for i in minmax)
+
+        def _nullify(outs):
+            outs = [np.asarray(o) for o in outs]
+            base, extras = outs[:len(expanded)], outs[len(expanded):]
+            for j, i in enumerate(minmax):
+                cnt = extras[j]
+                v = base[i]
+                if v.ndim == 0:
+                    base[i] = (np.asarray(None, object)
+                               if int(cnt) == 0 else v)
+                else:
+                    obj = v.astype(object)
+                    obj[np.asarray(cnt) == 0] = None
+                    base[i] = obj
+            return tuple(base)
+
+        if isinstance(req.group_by, HashGroupSpec):
+            outs, counts, _, gvals, n_groups = self.kernel.run(
+                batch, req.where, aggs_run, req.group_by, read_ht)
+            if int(n_groups) > req.group_by.max_groups:
+                return None     # distinct-group overflow: CPU fallback
+            return ReadResponse(
+                agg_values=_nullify(outs),
+                group_counts=np.asarray(counts),
+                group_values=tuple(np.asarray(g) for g in gvals),
+                backend="tpu")
         outs, counts, _ = self.kernel.run(
-            batch, req.where, req.aggregates, req.group_by, read_ht)
-        return ReadResponse(agg_values=tuple(np.asarray(o) for o in outs),
+            batch, req.where, aggs_run, req.group_by, read_ht)
+        return ReadResponse(agg_values=_nullify(outs),
                             group_counts=np.asarray(counts),
                             backend="tpu")
 
@@ -708,6 +749,16 @@ def _agg_accumulate(aggs, agg_state, group_state, group, idrow):
         for i, a in enumerate(aggs):
             agg_state[i] = _agg_step(a, agg_state[i], idrow)
         return
+    if isinstance(group, HashGroupSpec):
+        key = tuple(idrow.get(cid) for cid in group.cols)
+        if any(v is None for v in key):
+            return       # NULL group values are excluded (matches device)
+        st = group_state.setdefault(key,
+                                    [_agg_init(a) for a in aggs] + [0])
+        for i, a in enumerate(aggs):
+            st[i] = _agg_step(a, st[i], idrow)
+        st[-1] += 1
+        return
     gid = 0
     stride = 1
     for cid, domain, offset in group.cols:
@@ -730,12 +781,38 @@ def _agg_final(a: AggSpec, state):
 
 
 def _grouped_cpu_response(aggs, group_state, group) -> ReadResponse:
+    if isinstance(group, HashGroupSpec):
+        keys = list(group_state)
+        G = len(keys)
+        outs = []
+        for i, a in enumerate(aggs):
+            if a.op in ("min", "max"):
+                # SQL NULL for a group with zero qualifying inputs
+                arr = np.array(
+                    [_agg_final(a, group_state[k][i]) for k in keys],
+                    object)
+            else:
+                arr = np.zeros(G,
+                               np.float64 if a.op != "count" else np.int64)
+                for g, key in enumerate(keys):
+                    arr[g] = _agg_final(a, group_state[key][i]) or 0
+            outs.append(arr)
+        counts = np.asarray([group_state[k][-1] for k in keys], np.int64)
+        gvals = tuple(np.asarray([k[j] for k in keys])
+                      for j in range(len(group.cols)))
+        return ReadResponse(agg_values=tuple(outs), group_counts=counts,
+                            group_values=gvals, backend="cpu")
     G = group.num_groups
     outs = []
     for i, a in enumerate(aggs):
-        arr = np.zeros(G, np.float64 if a.op != "count" else np.int64)
-        for gid, st in group_state.items():
-            arr[gid] = _agg_final(a, st[i]) or 0
+        if a.op in ("min", "max"):
+            arr = np.full(G, None, object)
+            for gid, st in group_state.items():
+                arr[gid] = _agg_final(a, st[i])
+        else:
+            arr = np.zeros(G, np.float64 if a.op != "count" else np.int64)
+            for gid, st in group_state.items():
+                arr[gid] = _agg_final(a, st[i]) or 0
         outs.append(arr)
     counts = np.zeros(G, np.int64)
     for gid, st in group_state.items():

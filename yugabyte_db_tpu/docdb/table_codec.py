@@ -284,7 +284,7 @@ class TableCodec:
                                      ends, nulls, vl[1]))
                     else:
                         plan.append((name, 4, "q", None, None, None))
-                ext = hot.Extractor(plan)
+                ext = hot.Extractor(plan, cb.n)
             except Exception:
                 ext = None
         cache[self] = ext

@@ -10,7 +10,7 @@ from typing import Optional
 
 import numpy as np
 
-from ..ops.scan import AggSpec, GroupSpec
+from ..ops.scan import AggSpec, GroupSpec, HashGroupSpec
 from .operations import ReadRequest, ReadResponse, RowOp, WriteRequest, \
     WriteResponse
 
@@ -52,7 +52,11 @@ def read_request_to_wire(req: ReadRequest) -> dict:
         "columns": list(req.columns),
         "where": _expr_to_wire(req.where),
         "aggregates": [[a.op, _expr_to_wire(a.expr)] for a in req.aggregates],
-        "group_by": list(req.group_by.cols) if req.group_by else None,
+        "group_by": (
+            {"hash": list(req.group_by.cols),
+             "max": req.group_by.max_groups}
+            if isinstance(req.group_by, HashGroupSpec)
+            else list(req.group_by.cols) if req.group_by else None),
         "pk_eq": req.pk_eq,
         "pk_prefix": req.pk_prefix,
         "limit": req.limit,
@@ -69,8 +73,12 @@ def read_request_from_wire(d: dict) -> ReadRequest:
         where=_expr_from_wire(d.get("where")),
         aggregates=tuple(AggSpec(op, _expr_from_wire(e))
                          for op, e in (d.get("aggregates") or [])),
-        group_by=(GroupSpec(tuple(tuple(c) for c in d["group_by"]))
-                  if d.get("group_by") else None),
+        group_by=(
+            HashGroupSpec(tuple(d["group_by"]["hash"]),
+                          d["group_by"].get("max", 4096))
+            if isinstance(d.get("group_by"), dict)
+            else GroupSpec(tuple(tuple(c) for c in d["group_by"]))
+            if d.get("group_by") else None),
         pk_eq=d.get("pk_eq"),
         pk_prefix=d.get("pk_prefix"),
         limit=d.get("limit"),
@@ -87,6 +95,8 @@ def read_response_to_wire(resp: ReadResponse) -> dict:
                        if resp.agg_values is not None else None),
         "group_counts": (np.asarray(resp.group_counts).tolist()
                          if resp.group_counts is not None else None),
+        "group_values": ([np.asarray(v).tolist() for v in resp.group_values]
+                         if resp.group_values is not None else None),
         "paging_state": resp.paging_state,
         "backend": resp.backend,
     }
@@ -99,6 +109,8 @@ def read_response_from_wire(d: dict) -> ReadResponse:
                     if d.get("agg_values") is not None else None),
         group_counts=(np.asarray(d["group_counts"])
                       if d.get("group_counts") is not None else None),
+        group_values=(tuple(np.asarray(v) for v in d["group_values"])
+                      if d.get("group_values") is not None else None),
         paging_state=d.get("paging_state"),
         backend=d.get("backend", "cpu"),
     )

@@ -52,7 +52,7 @@ class GroupSpec:
     """GROUP BY over small-domain columns (dictionary/categorical encoded):
     cols = ((col_id, domain_size, offset), ...). Group id =
     sum((col - offset) * stride); total groups = prod(domains).
-    Large/unbounded domains go through the CPU fallback path."""
+    Large/unbounded domains use HashGroupSpec instead."""
     cols: Tuple[Tuple[int, int, int], ...]
 
     @property
@@ -61,6 +61,19 @@ class GroupSpec:
         for _, d, _ in self.cols:
             g *= d
         return g
+
+
+@dataclass(frozen=True)
+class HashGroupSpec:
+    """GROUP BY over ARBITRARY-domain fixed-width columns: device sort
+    by the group-key tuple + segment aggregation. Needs no pre-declared
+    domains or ANALYZE stats (reference: unconditional aggregate
+    pushdown, pgsql_operation.cc:3153-3163). `max_groups` caps the
+    per-batch distinct-group count — the kernel reports the true count
+    and the caller falls back to CPU grouping when it overflows.
+    NULL group values are excluded, matching GroupSpec's device path."""
+    cols: Tuple[int, ...]
+    max_groups: int = 4096
 
 
 def _mvcc_visible_latest(key_hash, ht, write_id, tombstone, valid, read_ht):
@@ -106,6 +119,64 @@ def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
             mask = mask & wv
             if wn is not None:
                 mask = mask & jnp.logical_not(wn)
+
+        if isinstance(group, HashGroupSpec):
+            # exclude NULL group values (same rule as the dict path)
+            for cid in group.cols:
+                gn = nulls.get(cid)
+                if gn is not None:
+                    mask = mask & jnp.logical_not(gn)
+            n = mask.shape[0]
+            G = group.max_groups
+            inv = jnp.logical_not(mask).astype(jnp.uint8)
+            gcols = [cols[cid] for cid in group.cols]
+            pos = jnp.arange(n, dtype=jnp.int32)
+            sorted_ = jax.lax.sort((inv, *gcols, pos),
+                                   num_keys=1 + len(gcols))
+            perm = sorted_[-1]
+            g_s = sorted_[1:-1]
+            valid_s = sorted_[0] == 0
+            changed = g_s[0][1:] != g_s[0][:-1]
+            for g in g_s[1:]:
+                changed = changed | (g[1:] != g[:-1])
+            first = valid_s & jnp.concatenate(
+                [jnp.array([True]), changed])
+            n_groups = jnp.sum(first, dtype=jnp.int32)
+            seg = jnp.clip(jnp.cumsum(first) - 1, 0, G - 1)
+            out = []
+            for op, f in agg_fns:
+                if f is None:
+                    out.append(jax.ops.segment_sum(
+                        valid_s.astype(jnp.int64), seg, G))
+                    continue
+                v, vn = f(cols, nulls, consts)
+                v_s = v[perm]
+                m = valid_s if vn is None else valid_s & \
+                    jnp.logical_not(vn)[perm]
+                if op == "count":
+                    out.append(jax.ops.segment_sum(
+                        m.astype(jnp.int64), seg, G))
+                elif op == "sum":
+                    out.append(jax.ops.segment_sum(
+                        jnp.where(m, v_s, 0), seg, G))
+                elif op == "min":
+                    out.append(jax.ops.segment_min(
+                        jnp.where(m, v_s, _type_max(v)), seg, G))
+                elif op == "max":
+                    out.append(jax.ops.segment_max(
+                        jnp.where(m, v_s, _type_min(v)), seg, G))
+                else:
+                    raise ValueError(op)
+            counts = jax.ops.segment_sum(valid_s.astype(jnp.int64),
+                                         seg, G)
+            # group-key values: within a segment every group col is
+            # constant; min over the segment (invalid rows masked to
+            # +inf/max) recovers it
+            gvals = tuple(
+                jax.ops.segment_min(
+                    jnp.where(valid_s, g, _type_max(g)), seg, G)
+                for g in g_s)
+            return tuple(out), counts, mask, gvals, n_groups
 
         if group is None:
             out = []
@@ -227,7 +298,8 @@ class ScanKernel:
         sig = (
             expr_signature(where) if where is not None else None,
             tuple(a.signature() for a in aggs),
-            group.cols if group else None,
+            (type(group).__name__, group.cols,
+             getattr(group, "max_groups", None)) if group else None,
             mvcc_mode, batch.padded_rows, col_sig,
         )
         fn = self._get(sig, where, aggs, group, mvcc_mode)

@@ -4,9 +4,11 @@ The round-1 stand-in for the reference's PG executor + pggate
 (reference: src/yb/yql/pggate/pggate.cc ExecSelect :1842, expression
 pushdown classification in src/postgres ybplan.c): WHERE clauses and
 scalar aggregates push down to tablets (and from there to the TPU scan
-kernels); GROUP BY uses device pushdown when every group column has a
-known small domain (declared via `stats`), otherwise falls back to
-client-side hash grouping over the projected rows.
+kernels); GROUP BY pushes down to the device unconditionally for
+numeric group keys — dictionary one-hot matmul when ANALYZE stats bound
+the domains, sort + segment aggregation (HashGroupSpec) otherwise —
+falling back to client-side hash grouping only for non-numeric keys or
+distinct-group overflow.
 """
 from __future__ import annotations
 
@@ -21,7 +23,7 @@ from ..docdb.operations import ReadRequest, RowOp, eval_expr_py
 from ..docdb.table_codec import TableInfo
 from ..dockv.packed_row import ColumnSchema, ColumnType, TableSchema
 from ..dockv.partition import PartitionSchema
-from ..ops.scan import AggSpec, GroupSpec
+from ..ops.scan import AggSpec, GroupSpec, HashGroupSpec
 from .parser import (
     AlterTableStmt, AnalyzeStmt, CreateIndexStmt, CreateTableStmt,
     DeleteStmt, DropTableStmt, ExplainStmt, InsertStmt, SelectStmt,
@@ -218,7 +220,12 @@ class SqlSession:
             elif stmt.group_by and (agg_items or having is not None):
                 gspec = (self._group_spec(stmt, schema)
                          if agg_items else None)
-                if gspec is not None:
+                if isinstance(gspec, HashGroupSpec):
+                    lines.append(
+                        f"Grouped Aggregate on {stmt.table} "
+                        f"(DEVICE pushdown: sort + segment "
+                        f"aggregation, up to {gspec.max_groups} groups)")
+                elif gspec is not None:
                     lines.append(
                         f"Grouped Aggregate on {stmt.table} "
                         f"(DEVICE pushdown: one-hot matmul over "
@@ -226,8 +233,8 @@ class SqlSession:
                 else:
                     lines.append(
                         f"Grouped Aggregate on {stmt.table} "
-                        f"(client hash grouping; declare stats "
-                        f"for device pushdown)")
+                        f"(client hash grouping over non-numeric "
+                        f"group keys)")
                 if stmt.where is not None:
                     lines.append("  Filter: pushed to tablets "
                                  "(device mask when columnar)")
@@ -810,15 +817,36 @@ class SqlSession:
                 r.pop(f"__h{i}", None)
         return kept
 
-    def _group_spec(self, stmt: SelectStmt, schema) -> Optional[GroupSpec]:
+    def _group_spec(self, stmt: SelectStmt, schema):
+        """Pushdown group spec: dictionary ids when ANALYZE stats bound
+        the domains (cheapest — one-hot matmul on the MXU), otherwise a
+        HashGroupSpec so arbitrary-domain numeric group keys STILL push
+        down (sort + segment aggregation on device; no stats
+        prerequisite — reference: unconditional aggregate pushdown,
+        pgsql_operation.cc:3153). Non-numeric keys return None
+        (client-side grouping)."""
         st = self.stats.get(stmt.table, {})
         cols = []
         for name in stmt.group_by:
             if name not in st:
-                return None
+                cols = None
+                break
             domain, offset = st[name]
             cols.append((schema.column_by_name(name).id, domain, offset))
-        return GroupSpec(cols=tuple(cols))
+        if cols is not None:
+            return GroupSpec(cols=tuple(cols))
+        hash_cols = []
+        for name in stmt.group_by:
+            try:
+                c = schema.column_by_name(name)
+            except Exception:
+                return None
+            if c.type not in (ColumnType.INT32, ColumnType.INT64,
+                              ColumnType.FLOAT64, ColumnType.FLOAT32,
+                              ColumnType.TIMESTAMP, ColumnType.BOOL):
+                return None
+            hash_cols.append(c.id)
+        return HashGroupSpec(cols=tuple(hash_cols))
 
     async def _grouped_pushdown(self, stmt, ct, where, gspec) -> SqlResult:
         schema = ct.info.schema
@@ -833,6 +861,27 @@ class SqlSession:
             read_ht=read_ht))
         counts = np.asarray(resp.group_counts)
         rows = []
+        if isinstance(gspec, HashGroupSpec):
+            schema_cols = {c.id: c for c in schema.columns}
+            for g in np.nonzero(counts)[0]:
+                row = {}
+                for j, (cid, name) in enumerate(zip(gspec.cols,
+                                                    stmt.group_by)):
+                    v = np.asarray(resp.group_values[j])[g].item()
+                    c = schema_cols[cid]
+                    if c.type in (ColumnType.INT32, ColumnType.INT64,
+                                  ColumnType.TIMESTAMP):
+                        v = int(v)
+                    elif c.type == ColumnType.BOOL:
+                        v = bool(v)
+                    row[name] = v
+                gvals = [np.asarray(v)[g] for v in resp.agg_values]
+                row.update(self._agg_row(stmt, gvals))
+                row.update(self._hidden_agg_row(
+                    refs, gvals, self._projected_slots(stmt)))
+                rows.append(row)
+            rows = self._having_filter(stmt, rows, refs)
+            return SqlResult(self._order_limit(stmt, rows))
         for gid in range(gspec.num_groups):
             if counts[gid] == 0:
                 continue
