@@ -1,0 +1,176 @@
+"""String predicates on the device path via per-batch dictionary
+encoding (SURVEY §7 hard-part 3; reference: varlen packed-row handling,
+dockv/schema_packing.h, pushdown eval doc_pg_expr.cc)."""
+import tempfile
+
+import numpy as np
+import pytest
+
+from yugabyte_db_tpu.docdb.operations import (
+    ReadRequest, RowOp, WriteRequest,
+)
+from yugabyte_db_tpu.docdb.table_codec import TableInfo
+from yugabyte_db_tpu.dockv.packed_row import (
+    ColumnSchema, ColumnType, TableSchema,
+)
+from yugabyte_db_tpu.dockv.partition import PartitionSchema
+from yugabyte_db_tpu.ops import AggSpec, Expr
+from yugabyte_db_tpu.tablet import Tablet
+
+C = Expr.col
+
+SHIPMODES = ["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK"]
+N = 30_000
+
+
+@pytest.fixture(scope="module")
+def tab():
+    schema = TableSchema((
+        ColumnSchema(0, "k", ColumnType.INT64, is_hash_key=True),
+        ColumnSchema(1, "shipmode", ColumnType.STRING),
+        ColumnSchema(2, "price", ColumnType.FLOAT64),
+        ColumnSchema(3, "qty", ColumnType.FLOAT64),
+    ), 1)
+    info = TableInfo("li", "li", schema, PartitionSchema("hash", 1))
+    t = Tablet("li", info, tempfile.mkdtemp(prefix="strp-"))
+    rng = np.random.default_rng(3)
+    modes = rng.integers(0, len(SHIPMODES), N)
+    t.bulk_load({
+        "k": np.arange(N, dtype=np.int64),
+        "shipmode": np.array([SHIPMODES[m] for m in modes], object),
+        "price": rng.uniform(900, 10_000, N),
+        "qty": rng.integers(1, 50, N).astype(np.float64),
+    })
+    t._rows = {
+        "mode": np.array([SHIPMODES[m] for m in modes]),
+        "price": None, "qty": None,
+    }
+    # keep the raw arrays for numpy reference checks
+    t._modes = np.array([SHIPMODES[m] for m in modes])
+    return t
+
+
+def _agg(t, where):
+    return t.read(ReadRequest(
+        "li", where=where, aggregates=(AggSpec("sum", C(2).node),
+                                       AggSpec("count"))))
+
+
+class TestStringPredicatePushdown:
+    def test_equality_runs_on_device(self, tab):
+        resp = _agg(tab, C(1).eq("RAIL").node)
+        assert resp.backend == "tpu"
+        m = tab._modes == "RAIL"
+        assert int(resp.agg_values[1]) == int(m.sum())
+
+    def test_q6_string_variant_matches_numpy(self, tab):
+        """Q6-style: numeric range + string equality, SUM pushdown —
+        end-to-end on the TPU path."""
+        where = ((C(3) < 24.0) & C(1).eq("SHIP")).node
+        resp = tab.read(ReadRequest(
+            "li", where=where,
+            aggregates=(AggSpec("sum", (C(2) * C(3)).node),)))
+        assert resp.backend == "tpu"
+        # numpy reference over the same loaded data
+        blocks = []
+        qty = price = modes = None
+        resp_all = tab.read(ReadRequest("li", columns=("qty", "price",
+                                                       "shipmode")))
+        qty = np.array([r["qty"] for r in resp_all.rows])
+        price = np.array([r["price"] for r in resp_all.rows])
+        modes = np.array([r["shipmode"] for r in resp_all.rows])
+        m = (qty < 24.0) & (modes == "SHIP")
+        want = float((price[m] * qty[m]).sum())
+        got = float(resp.agg_values[0])
+        assert abs(got - want) / max(abs(want), 1e-9) < 1e-3
+
+    def test_range_and_in_and_ne(self, tab):
+        cases = [
+            (C(1).node, "ge", "REG AIR",
+             tab._modes >= "REG AIR"),
+            (C(1).node, "lt", "MAIL", tab._modes < "MAIL"),
+        ]
+        for colnode, op, lit, ref in cases:
+            where = ("cmp", op, colnode, ("const", lit))
+            resp = _agg(tab, where)
+            assert resp.backend == "tpu", (op, lit)
+            assert int(resp.agg_values[1]) == int(ref.sum()), (op, lit)
+        resp = _agg(tab, C(1).isin(["AIR", "TRUCK", "nope"]).node)
+        assert resp.backend == "tpu"
+        want = int(np.isin(tab._modes, ["AIR", "TRUCK"]).sum())
+        assert int(resp.agg_values[1]) == want
+        resp = _agg(tab, C(1).ne("FOB").node)
+        assert resp.backend == "tpu"
+        assert int(resp.agg_values[1]) == int((tab._modes != "FOB").sum())
+
+    def test_equality_absent_value(self, tab):
+        resp = _agg(tab, C(1).eq("ZEBRA").node)
+        assert resp.backend == "tpu"
+        assert int(resp.agg_values[1]) == 0
+
+    def test_like_on_dictionary(self, tab):
+        resp = _agg(tab, ("like", C(1).node, "%AIR"))
+        assert resp.backend == "tpu"
+        want = int(np.char.endswith(tab._modes.astype(str), "AIR").sum())
+        assert int(resp.agg_values[1]) == want
+        resp = _agg(tab, ("like", C(1).node, "R__L"))
+        assert resp.backend == "tpu"
+        assert int(resp.agg_values[1]) == int((tab._modes == "RAIL").sum())
+
+    def test_filter_scan_with_string_predicate(self, tab):
+        resp = tab.read(ReadRequest(
+            "li", columns=("k", "shipmode"),
+            where=("like", C(1).node, "S%")))
+        assert resp.backend == "tpu"
+        want = int(np.char.startswith(tab._modes.astype(str), "S").sum())
+        assert len(resp.rows) == want
+        assert all(r["shipmode"].startswith("S") for r in resp.rows)
+
+    def test_cpu_twin_agrees(self, tab):
+        from yugabyte_db_tpu.utils import flags
+        where = (C(1).between("FOB", "RAIL") & (C(3) >= 10.0)).node
+        dev = _agg(tab, where)
+        assert dev.backend == "tpu"
+        flags.set_flag("tpu_pushdown_enabled", False)
+        try:
+            cpu = _agg(tab, where)
+        finally:
+            flags.set_flag("tpu_pushdown_enabled", True)
+        assert cpu.backend == "cpu"
+        assert int(dev.agg_values[1]) == int(cpu.agg_values[1])
+        rel = abs(float(dev.agg_values[0]) - float(cpu.agg_values[0])) / \
+            max(abs(float(cpu.agg_values[0])), 1e-9)
+        assert rel < 1e-3
+
+    def test_unrewritable_shape_falls_back(self, tab):
+        # string column inside arithmetic: no device translation
+        where = ("cmp", "eq", ("arith", "add", C(1).node,
+                               ("const", "x")), ("const", "yx"))
+        resp = _agg(tab, where)
+        assert resp.backend == "cpu"
+
+
+class TestNullStrings:
+    def test_null_strings_excluded_by_predicates(self):
+        schema = TableSchema((
+            ColumnSchema(0, "k", ColumnType.INT64, is_hash_key=True),
+            ColumnSchema(1, "s", ColumnType.STRING),
+        ), 1)
+        info = TableInfo("ns", "ns", schema, PartitionSchema("hash", 1))
+        t = Tablet("ns", info, tempfile.mkdtemp(prefix="nstr-"))
+        rows = [{"k": i, "s": None if i % 3 == 0 else f"v{i % 5}"}
+                for i in range(8000)]
+        t.apply_write(WriteRequest("ns", [RowOp("upsert", r)
+                                          for r in rows]))
+        t.flush()
+        resp = t.read(ReadRequest(
+            "ns", where=C(1).eq("v1").node,
+            aggregates=(AggSpec("count"),)))
+        want = len([r for r in rows if r["s"] == "v1"])
+        assert int(resp.agg_values[0]) == want
+        # IS NULL still works (on whatever path it takes)
+        resp = t.read(ReadRequest(
+            "ns", where=("isnull", C(1).node),
+            aggregates=(AggSpec("count"),)))
+        assert int(resp.agg_values[0]) == len(
+            [r for r in rows if r["s"] is None])

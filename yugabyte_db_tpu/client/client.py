@@ -32,29 +32,27 @@ def _item(x):
     return x
 
 
+def _mm2(x, y, op):
+    """None-aware scalar min/max (SQL: NULL is the identity)."""
+    if x is None:
+        return y
+    if y is None:
+        return x
+    return min(x, y) if op == "min" else max(x, y)
+
+
 def _merge_minmax(a, b, op):
     """None-aware elementwise min/max over scalars or per-group arrays
     (SQL semantics: NULL is the identity, never the answer over a
     non-empty input set)."""
     av, bv = np.asarray(a), np.asarray(b)
     if av.ndim == 0:
-        x, y = av.item(), bv.item()
-        if x is None:
-            return bv
-        if y is None:
-            return av
-        return np.asarray(min(x, y) if op == "min" else max(x, y))
+        return np.asarray(_mm2(av.item(), bv.item(), op))
     if av.dtype != object and bv.dtype != object:
         return np.minimum(av, bv) if op == "min" else np.maximum(av, bv)
     out = np.empty(av.shape, object)
     for i in range(av.shape[0]):
-        x, y = _item(av[i]), _item(bv[i])
-        if x is None:
-            out[i] = y
-        elif y is None:
-            out[i] = x
-        else:
-            out[i] = min(x, y) if op == "min" else max(x, y)
+        out[i] = _mm2(_item(av[i]), _item(bv[i]), op)
     return out
 
 
@@ -434,15 +432,6 @@ class YBClient:
         return ReadResponse(agg_values=tuple(total), group_counts=counts,
                             backend=parts[0].backend if parts else "cpu")
 
-    @staticmethod
-    def _mm2(x, y, op):
-        """None-aware scalar min/max (SQL: NULL is the identity)."""
-        if x is None:
-            return y
-        if y is None:
-            return x
-        return min(x, y) if op == "min" else max(x, y)
-
     def _combine_hash_groups(self, aggs, parts: List[ReadResponse]
                              ) -> ReadResponse:
         """Merge per-tablet hash-grouped partials BY GROUP KEY — slots
@@ -466,8 +455,8 @@ class YBClient:
                     if a.op in ("sum", "count"):
                         st[0][i] = st[0][i] + vals[i][g]
                     else:
-                        st[0][i] = self._mm2(_item(st[0][i]),
-                                             _item(vals[i][g]), a.op)
+                        st[0][i] = _mm2(_item(st[0][i]),
+                                       _item(vals[i][g]), a.op)
                 st[1] += int(counts[g])
         keys = list(merged)
         outs = tuple(np.asarray([merged[k][0][i] for k in keys])

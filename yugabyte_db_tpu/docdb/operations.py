@@ -118,8 +118,18 @@ def eval_expr_py(node: tuple, row: Dict[int, object]):
         r = eval_expr_py(node[3], row)
         if l is None or r is None:
             return None
-        return {"add": l + r, "sub": l - r, "mul": l * r,
-                "div": l / r}[node[1]]
+        # dispatch lazily: an eager dict literal would evaluate EVERY
+        # op (div-by-zero on add, str-minus-str on concat, ...)
+        op = node[1]
+        if op == "add":
+            return l + r
+        if op == "sub":
+            return l - r
+        if op == "mul":
+            return l * r
+        if op == "div":
+            return l / r
+        raise ValueError(op)
     if kind == "and":
         l = eval_expr_py(node[1], row)
         r = eval_expr_py(node[2], row)
@@ -473,6 +483,109 @@ class DocReadOperation:
                 b.unique_keys = b.unique_keys and len(blocks) == 1
         return blocks
 
+    # --- string predicates on device (dictionary rewrite) -----------------
+    class _Unrewritable(Exception):
+        pass
+
+    @classmethod
+    def _rewrite_strings(cls, node, dicts):
+        """Translate string predicates into dictionary-code space so
+        they run in the device kernel (SURVEY §7 hard-part 3; reference:
+        varlen handling in dockv/schema_packing.h + pushdown eval).
+        The per-batch dictionary is SORTED, so ordering predicates map
+        to code ranges; equality/IN map to exact codes; LIKE (and any
+        other string function) evaluates host-side over the dictionary
+        into a boolean LUT the kernel gathers. Raises _Unrewritable
+        when a string column is used outside these shapes."""
+        import bisect
+        kind = node[0]
+
+        def is_dict_col(x):
+            return (isinstance(x, (tuple, list)) and x
+                    and x[0] == "col" and x[1] in dicts)
+
+        def is_const_str(x):
+            return (isinstance(x, (tuple, list)) and x
+                    and x[0] == "const" and isinstance(x[1], str))
+
+        if kind == "cmp":
+            op, l, r = node[1], node[2], node[3]
+            if is_dict_col(l) and is_const_str(r):
+                d = dicts[l[1]]
+                v = r[1]
+                if op in ("eq", "ne"):
+                    i = bisect.bisect_left(d, v)
+                    code = i if i < len(d) and d[i] == v else -1
+                    return ("cmp", op, l, ("const", code))
+                if op == "lt":
+                    return ("cmp", "lt", l,
+                            ("const", bisect.bisect_left(d, v)))
+                if op == "le":
+                    return ("cmp", "lt", l,
+                            ("const", bisect.bisect_right(d, v)))
+                if op == "gt":
+                    return ("cmp", "ge", l,
+                            ("const", bisect.bisect_right(d, v)))
+                if op == "ge":
+                    return ("cmp", "ge", l,
+                            ("const", bisect.bisect_left(d, v)))
+            if is_dict_col(r) and is_const_str(l):
+                flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
+                        "eq": "eq", "ne": "ne"}
+                return cls._rewrite_strings(
+                    ("cmp", flip[op], r, l), dicts)
+            if is_dict_col(l) or is_dict_col(r):
+                raise cls._Unrewritable(node)
+            # neither side is directly a string column: still recurse —
+            # a nested expr may contain one (and must then fail or
+            # rewrite), falling through to the generic walk below
+        elif kind == "between":
+            x, lo, hi = node[1], node[2], node[3]
+            if is_dict_col(x):
+                if not (is_const_str(lo) and is_const_str(hi)):
+                    raise cls._Unrewritable(node)
+                return ("and",
+                        cls._rewrite_strings(("cmp", "ge", x, lo), dicts),
+                        cls._rewrite_strings(("cmp", "le", x, hi), dicts))
+        elif kind == "in":
+            x, vals = node[1], node[2]
+            if is_dict_col(x):
+                d = dicts[x[1]]
+                codes = []
+                for v in vals:
+                    if not isinstance(v, str):
+                        raise cls._Unrewritable(node)
+                    i = bisect.bisect_left(d, v)
+                    codes.append(int(i) if i < len(d) and d[i] == v
+                                 else -1)
+                return ("in", x, codes)
+            # generic walk must not treat the VALUES list as a node
+            return ("in", cls._rewrite_strings(x, dicts), vals)
+        if kind == "like":
+            x, pattern = node[1], node[2]
+            if not is_dict_col(x):
+                raise cls._Unrewritable(node)
+            import re as _re
+            pat = _re.compile(
+                "^" + _re.escape(pattern).replace("%", ".*")
+                .replace("_", ".") + "$")
+            d = dicts[x[1]]
+            lut = [1 if pat.match(s) else 0 for s in d]
+            return ("dictlut", x, lut)
+        if kind == "col" and node[1] in dicts:
+            # a bare string column outside a rewritable predicate
+            raise cls._Unrewritable(node)
+        if kind in ("const",):
+            return node
+        out = [kind]
+        for c in node[1:]:
+            if isinstance(c, (tuple, list)) and c and \
+                    isinstance(c[0], str):
+                out.append(cls._rewrite_strings(c, dicts))
+            else:
+                out.append(c)
+        return tuple(out)
+
     def _execute_tpu_aggregate(self, req: ReadRequest) -> Optional[ReadResponse]:
         blocks = self._collect_blocks()
         if not blocks:
@@ -510,12 +623,28 @@ class DocReadOperation:
         # multiple overlapping sources → force dedup mode via unique_keys
         if len(blocks) > 1:
             batch.unique_keys = False
+        where = req.where
+        aggregates = req.aggregates
+        if where is not None or any(a.expr is not None
+                                    for a in aggregates):
+            # runs even with no dictionaries: a leftover 'like' (or any
+            # string shape the kernel can't compile) must fall back
+            try:
+                if where is not None:
+                    where = self._rewrite_strings(where, batch.dicts)
+                aggregates = tuple(
+                    AggSpec(a.op,
+                            self._rewrite_strings(a.expr, batch.dicts)
+                            if a.expr is not None else None)
+                    for a in aggregates)
+            except self._Unrewritable:
+                return None   # string column outside a rewritable shape
         # SQL NULL semantics for MIN/MAX over zero qualifying inputs:
         # the kernel returns a dtype sentinel there, so run a hidden
         # companion COUNT per min/max aggregate and replace sentinel
         # results with None host-side (the CPU twin returns None too)
         from ..ops.scan import _expand_avg
-        expanded = tuple(_expand_avg(req.aggregates))
+        expanded = tuple(_expand_avg(aggregates))
         minmax = [i for i, a in enumerate(expanded)
                   if a.op in ("min", "max")]
         aggs_run = expanded + tuple(AggSpec("count", expanded[i].expr)
@@ -538,7 +667,7 @@ class DocReadOperation:
 
         if isinstance(req.group_by, HashGroupSpec):
             outs, counts, _, gvals, n_groups = self.kernel.run(
-                batch, req.where, aggs_run, req.group_by, read_ht)
+                batch, where, aggs_run, req.group_by, read_ht)
             if int(n_groups) > req.group_by.max_groups:
                 return None     # distinct-group overflow: CPU fallback
             return ReadResponse(
@@ -547,7 +676,7 @@ class DocReadOperation:
                 group_values=tuple(np.asarray(g) for g in gvals),
                 backend="tpu")
         outs, counts, _ = self.kernel.run(
-            batch, req.where, aggs_run, req.group_by, read_ht)
+            batch, where, aggs_run, req.group_by, read_ht)
         return ReadResponse(agg_values=_nullify(outs),
                             group_counts=np.asarray(counts),
                             backend="tpu")
@@ -572,7 +701,13 @@ class DocReadOperation:
         read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
         if len(blocks) > 1:
             batch.unique_keys = False
-        _, _, mask = self.kernel.run(batch, req.where, (), None, read_ht)
+        where = req.where
+        if where is not None:
+            try:
+                where = self._rewrite_strings(where, batch.dicts)
+            except self._Unrewritable:
+                return None
+        _, _, mask = self.kernel.run(batch, where, (), None, read_ht)
         sel = np.nonzero(np.asarray(mask))[0]
         if req.limit is not None and len(sel) > req.limit:
             sel = sel[:req.limit]

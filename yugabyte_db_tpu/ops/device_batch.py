@@ -49,6 +49,11 @@ class DeviceBatch:
     write_id: Optional[jnp.ndarray] = None
     tombstone: Optional[jnp.ndarray] = None
     unique_keys: bool = True
+    # string columns ride as int32 dictionary CODES in `cols`; the
+    # sorted dictionaries stay host-side here — predicates translate to
+    # code space (order-preserving) or LUT gathers before compilation
+    # (SURVEY §7 hard-part 3: varlen data in fixed-shape kernels)
+    dicts: Dict[int, np.ndarray] = field(default_factory=dict)
 
     @property
     def padded_rows(self) -> int:
@@ -82,7 +87,31 @@ def build_batch(blocks: Sequence[ColumnarBlock],
     padded = pad_to or bucket_rows(max(n, 1))
     cols: Dict[int, jnp.ndarray] = {}
     nulls: Dict[int, jnp.ndarray] = {}
+    dicts: Dict[int, np.ndarray] = {}
     for cid in columns:
+        if all(cid in b.varlen for b in blocks):
+            # string column: batch-global dictionary encoding — codes
+            # are order-preserving (sorted dict), so comparisons map to
+            # code space and LIKE maps to a host-built LUT
+            vparts, nparts = [], []
+            for b in blocks:
+                try:
+                    vparts.append(varlen_strings(b, cid))
+                except UnicodeDecodeError:
+                    # BINARY payloads (or corrupt strings) don't
+                    # dictionary-encode; same contract as any other
+                    # non-columnar column — the caller falls back
+                    raise KeyError(
+                        f"column {cid} not dictionary-encodable")
+                nparts.append(np.asarray(b.varlen[cid][2], bool))
+            values = np.concatenate(vparts)
+            null = np.concatenate(nparts)
+            values = np.where(null, "", values)   # stable unique input
+            uniq, codes = np.unique(values, return_inverse=True)
+            dicts[cid] = uniq
+            cols[cid] = jnp.asarray(_pad(codes.astype(np.int32), padded))
+            nulls[cid] = jnp.asarray(_pad(null, padded))
+            continue
         parts, nparts = [], []
         for b in blocks:
             if cid in b.fixed:
@@ -103,7 +132,7 @@ def build_batch(blocks: Sequence[ColumnarBlock],
     valid[:n] = True
     batch = DeviceBatch(
         n_rows=n, cols=cols, nulls=nulls, valid=jnp.asarray(valid),
-        unique_keys=all(b.unique_keys for b in blocks))
+        unique_keys=all(b.unique_keys for b in blocks), dicts=dicts)
     if with_mvcc:
         batch.key_hash = jnp.asarray(_pad(
             np.concatenate([b.key_hash for b in blocks]), padded))
@@ -114,6 +143,20 @@ def build_batch(blocks: Sequence[ColumnarBlock],
         tomb = np.concatenate([b.tombstone for b in blocks])
         batch.tombstone = jnp.asarray(_pad(tomb, padded))
     return batch
+
+
+def varlen_strings(b: ColumnarBlock, cid: int) -> np.ndarray:
+    """Decode one varlen column of a block into an object array of str
+    (raises on non-UTF8 payloads — the caller falls back to the CPU row
+    path for such blocks)."""
+    ends, heap, _nulls = b.varlen[cid]
+    out = np.empty(b.n, object)
+    lo = 0
+    for i in range(b.n):
+        hi = int(ends[i])
+        out[i] = heap[lo:hi].decode()
+        lo = hi
+    return out
 
 
 def _pad(arr: np.ndarray, n: int) -> np.ndarray:

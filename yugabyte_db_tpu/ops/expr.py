@@ -69,19 +69,29 @@ class Expr:
 
 
 _DEVICE_NODE_KINDS = {"col", "const", "cmp", "arith", "and", "or", "not",
-                      "between", "in", "isnull"}
+                      "between", "in", "isnull", "like", "dictlut"}
 
 
 def device_compatible(node: ExprNode) -> bool:
-    """True when every node kind compiles to the device kernel (json
-    extraction etc. stay on the CPU row path)."""
+    """True when every node kind MAY compile to the device kernel (json
+    extraction etc. stay on the CPU row path). "like" and string
+    comparisons qualify here because the string-predicate rewrite
+    (docdb/operations.py) turns them into code-space comparisons / LUT
+    gathers over dictionary-encoded columns; blocks that can't
+    dictionary-encode fall back later."""
     if node[0] not in _DEVICE_NODE_KINDS:
         return False
-    if node[0] == "in" and len(node[2]) > 64:
-        # the kernel unrolls one compare per value (and the signature
-        # includes the length, so every size recompiles) — large lists
-        # (IN-subquery results) run on the CPU set path instead
-        return False
+    if node[0] == "in":
+        # node[2] is a VALUES list, not an expr (a list of strings would
+        # otherwise be mistaken for a node); the kernel unrolls one
+        # compare per value and the signature includes the length, so
+        # large lists (IN-subquery results) run on the CPU set path
+        if len(node[2]) > 64:
+            return False
+        return device_compatible(node[1])
+    if node[0] == "like":
+        return isinstance(node[1], (tuple, list)) and \
+            device_compatible(node[1])
     for c in node[1:]:
         if isinstance(c, (tuple, list)) and c and isinstance(c[0], str):
             if not device_compatible(c):
@@ -102,6 +112,9 @@ def expr_signature(node: ExprNode) -> tuple:
         return ("col", node[1])
     if kind == "in":
         return ("in", expr_signature(node[1]), len(node[2]))
+    if kind == "dictlut":
+        # LUT length changes the traced const's shape -> part of the sig
+        return ("dictlut", expr_signature(node[1]), len(node[2]))
     return (kind,) + tuple(
         expr_signature(c) if isinstance(c, (tuple, list)) else c
         for c in node[1:])
@@ -115,6 +128,11 @@ def collect_constants(node: ExprNode, out: list) -> None:
     if kind == "in":
         collect_constants(node[1], out)
         out.extend(node[2])
+        return
+    if kind == "dictlut":
+        collect_constants(node[1], out)
+        import numpy as _np
+        out.append(_np.asarray(node[2], _np.bool_))
         return
     for c in node[1:]:
         if isinstance(c, (tuple, list)) and c and isinstance(c[0], str):
@@ -218,6 +236,19 @@ def compile_expr(node: ExprNode) -> Callable:
                 n_ = xn if xn is not None else jnp.zeros((), bool)
                 return n_, None
             return f
+        if kind == "dictlut":
+            # boolean lookup table over dictionary codes: the host
+            # evaluates an arbitrary string predicate (LIKE, regex, ...)
+            # over the (small) dictionary once; rows gather the verdict
+            xf = build(n[1])
+            idx = counter[0]
+            counter[0] += 1
+            def f(cols, nulls, consts):
+                xv, xn = xf(cols, nulls, consts)
+                lut = consts[idx]
+                safe = jnp.clip(xv, 0, lut.shape[0] - 1)
+                return jnp.take(lut, safe), xn
+            return f
         raise ValueError(f"unknown expr node {kind}")
 
     return build(node)
@@ -259,7 +290,7 @@ def referenced_columns(node: ExprNode, out: set | None = None) -> set:
     out = out if out is not None else set()
     if node[0] == "col":
         out.add(node[1])
-    elif node[0] in ("in", "like"):
+    elif node[0] in ("in", "like", "dictlut"):
         referenced_columns(node[1], out)
     elif node[0] == "json":
         referenced_columns(node[2], out)

@@ -841,8 +841,10 @@ class SqlSession:
                 c = schema.column_by_name(name)
             except Exception:
                 return None
+            # exact-on-device types only: floats would be rounded to
+            # f32 at batch formation, silently merging distinct f64
+            # group keys — those stay on exact client-side grouping
             if c.type not in (ColumnType.INT32, ColumnType.INT64,
-                              ColumnType.FLOAT64, ColumnType.FLOAT32,
                               ColumnType.TIMESTAMP, ColumnType.BOOL):
                 return None
             hash_cols.append(c.id)
