@@ -118,6 +118,14 @@ def eval_expr_py(node: tuple, row: Dict[int, object]):
         r = eval_expr_py(node[3], row)
         if l is None or r is None:
             return None
+        # Decimal refuses mixed arithmetic with float: promote the
+        # other operand (comparisons already allow the mix)
+        from decimal import Decimal
+        if isinstance(l, Decimal) != isinstance(r, Decimal):
+            if isinstance(l, Decimal):
+                r = Decimal(str(r))
+            else:
+                l = Decimal(str(l))
         # dispatch lazily: an eager dict literal would evaluate EVERY
         # op (div-by-zero on add, str-minus-str on concat, ...)
         op = node[1]
@@ -185,6 +193,58 @@ def eval_expr_py(node: tuple, row: Dict[int, object]):
         # note: escape() escaped % and _ as literals? re.escape leaves %
         # and _ unescaped in Python 3.7+, so the replace above is correct
         return _re.match(pat, str(v)) is not None
+    if kind == "fn":
+        # scalar functions, row-wise on the CPU path (reference: the
+        # ybgate-linked PG function library, docdb/docdb_pgapi.cc)
+        name = node[1]
+        if name == "now":
+            # normally constant-folded at bind time; name-evaluated
+            # contexts (CTE rows, join residuals) land here
+            import time as _time
+            return int(_time.time() * 1_000_000)
+        args = [eval_expr_py(a, row) for a in node[2:]]
+        if name == "coalesce":
+            for a in args:
+                if a is not None:
+                    return a
+            return None
+        if args and args[0] is None:
+            return None
+        a0 = args[0] if args else None
+        if name == "abs":
+            return abs(a0)
+        if name == "round":
+            nd = int(args[1]) if len(args) > 1 and args[1] is not None \
+                else 0
+            r = round(a0, nd)
+            return float(r) if isinstance(a0, float) else r
+        if name == "floor":
+            import math
+            return math.floor(a0)
+        if name == "ceil":
+            import math
+            return math.ceil(a0)
+        if name == "upper":
+            return str(a0).upper()
+        if name == "lower":
+            return str(a0).lower()
+        if name == "length":
+            return len(a0)
+        if name == "cast_numeric":
+            from decimal import Decimal
+            return a0 if isinstance(a0, Decimal) else Decimal(str(a0))
+        if name in ("cast_bigint", "cast_int", "cast_integer",
+                    "cast_int8", "cast_int4", "cast_smallint"):
+            if isinstance(a0, int):
+                return a0          # never round-trip int64 through f64
+            from decimal import ROUND_HALF_UP, Decimal
+            return int(Decimal(str(a0)).to_integral_value(ROUND_HALF_UP))
+        if name in ("cast_double", "cast_float8", "cast_float",
+                    "cast_real", "cast_float4"):
+            return float(a0)
+        if name in ("cast_text", "cast_varchar", "cast_string"):
+            return str(a0)
+        raise ValueError(f"unknown function {name}")
     if kind == "json":
         # ('json', 'text'|'value', expr, key) — PG ->> / -> semantics
         import json as _json
@@ -695,7 +755,16 @@ class DocReadOperation:
         proj_cols = ([schema.column_by_name(n) for n in req.columns]
                      if req.columns else list(schema.columns))
         try:
-            batch = build_batch(blocks, sorted(needed))
+            # same device cache as the aggregate path: repeated string-
+            # predicate scans must not rebuild dictionaries per query
+            if self.device_cache is not None:
+                key = (id(self.store), tuple(sorted(needed)),
+                       tuple(r.path for r in self.store.ssts),
+                       self.store.memtable_empty())
+                batch = self.device_cache.get_or_build(
+                    key, lambda: build_batch(blocks, sorted(needed)))
+            else:
+                batch = build_batch(blocks, sorted(needed))
         except KeyError:
             return None
         read_ht = req.read_ht if req.read_ht is not None else _MAX_HT

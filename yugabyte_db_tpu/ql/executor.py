@@ -74,9 +74,19 @@ class SqlSession:
         # {table: {column: (domain, offset)}}
         self.stats: Dict[str, Dict[str, Tuple[int, int]]] = {}
         self._txn = None    # active YBTransaction (BEGIN..COMMIT)
+        # materialized CTE rowsets visible to the current statement
+        self._cte_rows: Dict[str, List[dict]] = {}
 
     async def execute(self, sql: str) -> SqlResult:
-        stmt = parse_statement(sql)
+        return await self._dispatch(parse_statement(sql))
+
+    async def execute_script(self, sql: str) -> List[SqlResult]:
+        """Multi-statement script: results in statement order
+        (reference: the PG simple-query protocol runs whole scripts)."""
+        from .parser import parse_script
+        return [await self._dispatch(s) for s in parse_script(sql)]
+
+    async def _dispatch(self, stmt) -> SqlResult:
         if isinstance(stmt, CreateTableStmt):
             return await self._create(stmt)
         if isinstance(stmt, DropTableStmt):
@@ -128,6 +138,12 @@ class SqlSession:
         if alias:
             return alias
         it = stmt.items[idx]
+        if it[0] == "window":
+            # disambiguate same-function windows so the second can't
+            # silently overwrite the first's column
+            dups = [j for j, o in enumerate(stmt.items)
+                    if o[0] == "window" and o[1] == it[1]]
+            return it[1] if len(dups) == 1 else f"{it[1]}_{idx}"
         return (it[1] if it[0] == "col" else
                 _agg_name(it) if it[0] == "agg" else _expr_name(it[1]))
 
@@ -187,6 +203,12 @@ class SqlSession:
         the PG planner + yb_lsm cost hooks; ours mirrors _select's
         branch order exactly so the reported plan is the executed one)."""
         lines: List[str] = []
+        if isinstance(stmt, SelectStmt) and (
+                getattr(stmt, "ctes", None)
+                or stmt.table in self._cte_rows):
+            lines.append(f"CTE Scan on {stmt.table} "
+                         f"(materialized client-side)")
+            return SqlResult([{"QUERY PLAN": ln} for ln in lines])
         if isinstance(stmt, SelectStmt):
             ct = await self.client._table(stmt.table)
             schema = ct.info.schema
@@ -395,9 +417,21 @@ class SqlSession:
             return None
         kind = node[0]
         if kind == "col":
-            return ("col", schema.column_by_name(node[1]).id)
+            c = schema.column_by_name(node[1])
+            if c.type == ColumnType.DECIMAL:
+                # DECIMAL stores as text: comparisons/arithmetic must
+                # run over decimal.Decimal, not lexicographically —
+                # wrap the ref so the CPU evaluator converts (device
+                # path declines 'fn' nodes and falls back)
+                return ("fn", "cast_numeric", ("col", c.id))
+            return ("col", c.id)
         if kind == "const":
             return node
+        if kind == "fn" and node[1] == "now":
+            # statement-stable clock read at bind time (PG: now() is
+            # transaction-stable; ours is statement-stable)
+            import time as _time
+            return ("const", int(_time.time() * 1_000_000))
         if kind == "in":
             return ("in", self._bind(node[1], schema), node[2])
         if kind == "like":
@@ -456,10 +490,24 @@ class SqlSession:
         return tuple(out)
 
     async def _select(self, stmt: SelectStmt) -> SqlResult:
+        if getattr(stmt, "ctes", None):
+            # WITH: materialize each CTE in order (later CTEs and the
+            # outer query see earlier ones), scoped to this statement
+            import dataclasses
+            saved = dict(self._cte_rows)
+            try:
+                for name, sub in stmt.ctes.items():
+                    self._cte_rows[name] = (await self._select(sub)).rows
+                return await self._select(
+                    dataclasses.replace(stmt, ctes={}))
+            finally:
+                self._cte_rows = saved
         if stmt.where is not None:
             stmt.where = await self._resolve_subqueries(stmt.where)
         if getattr(stmt, "joins", None):
             return await self._select_join(stmt)
+        if stmt.table in self._cte_rows:
+            return self._rows_select(stmt, self._cte_rows[stmt.table])
         ct = await self.client._table(stmt.table)
         schema = ct.info.schema
         read_ht = self._txn.start_ht if self._txn is not None else None
@@ -507,14 +555,18 @@ class SqlSession:
         # reordering/dedup/offset must happen first
         columns = self._needed_columns(stmt, schema)
         natural = self._natural_order(ct, stmt.order_by)
+        has_window = any(it[0] == "window" for it in stmt.items)
         push_limit = (stmt.limit
-                      if not (stmt.distinct or stmt.offset)
+                      if not (stmt.distinct or stmt.offset or has_window)
                       and (natural or not stmt.order_by) else None)
         req = ReadRequest("", columns=tuple(columns), where=where,
                           read_ht=read_ht, limit=push_limit)
         resp = await self.client.scan(stmt.table, req,
                                       keep_all=natural)
-        rows = [self._project_row(stmt, r, schema) for r in resp.rows]
+        base_rows = resp.rows
+        if has_window:
+            self._apply_windows(stmt, base_rows)
+        rows = [self._project_row(stmt, r, schema) for r in base_rows]
         rows = self._order_limit(stmt, rows)
         return SqlResult(rows)
 
@@ -580,11 +632,16 @@ class SqlSession:
         from ..docdb.operations import eval_expr_py
         if self._is_serializable():
             for tname in [stmt.table] + [j.table for j in stmt.joins]:
+                if tname in self._cte_rows:
+                    continue   # materialized rows: nothing to lock
                 jct = await self.client._table(tname)
                 await self._lock_read_set(
                     tname, jct.info.schema, None, self._txn.start_ht)
-        # fetch whole tables (residual WHERE applies after the join)
+        # fetch whole tables (residual WHERE applies after the join);
+        # a name bound by the current WITH scope reads the CTE rowset
         async def fetch(table):
+            if table in self._cte_rows:
+                return self._cte_rows[table]
             resp = await self.client.scan(table, ReadRequest(""))
             return resp.rows
 
@@ -608,6 +665,7 @@ class SqlSession:
                 index.setdefault(rr.get(jc.right_col, rr.get(rcol)),
                                  []).append(rr)
             joined = []
+            matched_right: set = set()
             for lr in rows:
                 key = lr.get(jc.left_col,
                              lr.get(self._split_qual(jc.left_col)[1]))
@@ -617,11 +675,20 @@ class SqlSession:
                         merged = dict(lr)
                         merged.update(rr)
                         joined.append(merged)
-                elif jc.kind == "left":
+                        matched_right.add(id(rr))
+                elif jc.kind in ("left", "full"):
                     merged = dict(lr)
                     for k in (right_rows[0] if right_rows else {}):
                         merged.setdefault(k, None)
                     joined.append(merged)
+            if jc.kind in ("right", "full"):
+                # unmatched right rows with NULL left columns
+                left_keys = set(rows[0]) if rows else set()
+                for rr in right_rows:
+                    if id(rr) not in matched_right:
+                        merged = {k: None for k in left_keys}
+                        merged.update(rr)
+                        joined.append(merged)
             rows = joined
         # residual WHERE over merged rows (by name, not ids)
         if stmt.where is not None:
@@ -638,6 +705,160 @@ class SqlSession:
                     _, bare = self._split_qual(it[1])
                     alias = getattr(stmt, "aliases", {}).get(i)
                     row[alias or bare] = r.get(it[1], r.get(bare))
+            out.append(row)
+        return SqlResult(self._order_limit(stmt, out))
+
+    # --- window functions (client-side; reference: PG WindowAgg) --------
+    def _apply_windows(self, stmt: SelectStmt, rows: List[dict]) -> None:
+        """Compute window items and attach each value to its row under
+        the item's output name. Supports ROW_NUMBER/RANK/DENSE_RANK,
+        LAG/LEAD, and SUM/COUNT/MIN/MAX/AVG OVER (PARTITION BY ...
+        [ORDER BY ...]); ordered aggregates use PG's default frame
+        (RANGE UNBOUNDED PRECEDING .. CURRENT ROW: peers share the
+        cumulative value)."""
+        import functools
+        for i, it in enumerate(stmt.items):
+            if it[0] != "window":
+                continue
+            _, fn, expr, partition, worder, args = it
+            name = self._item_name(stmt, i)
+            parts: Dict[tuple, List[int]] = {}
+            for idx, r in enumerate(rows):
+                key = tuple(r.get(c) for c in partition)
+                parts.setdefault(key, []).append(idx)
+
+            def cmp_rows(a, b):
+                for col, desc in worder:
+                    x, y = rows[a].get(col), rows[b].get(col)
+                    if x == y:
+                        continue
+                    if x is None:            # NULLS LAST asc
+                        c = 1
+                    elif y is None:
+                        c = -1
+                    else:
+                        c = -1 if x < y else 1
+                    return -c if desc else c
+                return 0
+
+            for idxs in parts.values():
+                if worder:
+                    idxs = sorted(idxs,
+                                  key=functools.cmp_to_key(cmp_rows))
+                vals = [(_eval_by_name(expr, rows[j])
+                         if expr is not None else None) for j in idxs]
+                if fn == "row_number":
+                    for n_, j in enumerate(idxs, 1):
+                        rows[j][name] = n_
+                elif fn in ("rank", "dense_rank"):
+                    rank = drank = 0
+                    for n_, j in enumerate(idxs):
+                        if n_ == 0 or cmp_rows(idxs[n_ - 1], j) != 0:
+                            rank = n_ + 1
+                            drank += 1
+                        rows[j][name] = rank if fn == "rank" else drank
+                elif fn in ("lag", "lead"):
+                    off = int(args[0]) if args else 1
+                    for n_, j in enumerate(idxs):
+                        src = n_ - off if fn == "lag" else n_ + off
+                        rows[j][name] = (vals[src]
+                                         if 0 <= src < len(idxs)
+                                         else None)
+                elif fn in ("sum", "count", "min", "max", "avg"):
+                    if not worder:
+                        v = self._window_agg(fn, vals, expr, len(idxs))
+                        for j in idxs:
+                            rows[j][name] = v
+                    else:
+                        # cumulative, peers (order-key ties) share
+                        k = 0
+                        while k < len(idxs):
+                            e = k
+                            while e + 1 < len(idxs) and \
+                                    cmp_rows(idxs[e + 1], idxs[k]) == 0:
+                                e += 1
+                            v = self._window_agg(
+                                fn, vals[:e + 1], expr, e + 1)
+                            for j in idxs[k:e + 1]:
+                                rows[j][name] = v
+                            k = e + 1
+                else:
+                    raise ValueError(f"unknown window function {fn}")
+
+    @staticmethod
+    def _window_agg(fn, vals, expr, nrows):
+        if fn == "count":
+            return nrows if expr is None else \
+                len([v for v in vals if v is not None])
+        vv = [v for v in vals if v is not None]
+        if not vv:
+            return None
+        if fn == "sum":
+            return sum(vv)
+        if fn == "min":
+            return min(vv)
+        if fn == "max":
+            return max(vv)
+        return sum(vv) / len(vv)            # avg
+
+    # --- in-memory SELECT over materialized rows (CTE source) -----------
+    def _rows_select(self, stmt: SelectStmt, base_rows: List[dict]
+                     ) -> SqlResult:
+        """Full client-side execution of a SELECT whose FROM is a
+        materialized rowset (a CTE). Same feature surface as the table
+        path minus pushdowns."""
+        rows = [dict(r) for r in base_rows]
+        if stmt.where is not None:
+            rows = [r for r in rows
+                    if _eval_by_name(stmt.where, r) is True]
+        agg_items = [it for it in stmt.items if it[0] == "agg"]
+        if agg_items and not stmt.group_by:
+            out = {}
+            for i, it in enumerate(stmt.items):
+                if it[0] == "agg":
+                    out[self._item_name(stmt, i)] = \
+                        _agg_over_rows(it[1], it[2], rows)
+            return SqlResult([out])
+        if stmt.group_by and (agg_items
+                              or getattr(stmt, "having", None)):
+            groups: Dict[tuple, List[dict]] = {}
+            for r in rows:
+                key = tuple(r.get(c) for c in stmt.group_by)
+                groups.setdefault(key, []).append(r)
+            out_rows = []
+            for key, grows in groups.items():
+                row = dict(zip(stmt.group_by, key))
+                for i, it in enumerate(stmt.items):
+                    if it[0] == "agg":
+                        row[self._item_name(stmt, i)] = \
+                            _agg_over_rows(it[1], it[2], grows)
+                if stmt.having is not None:
+                    hv = _eval_by_name(
+                        _subst_aggrefs(stmt.having, grows), row)
+                    if hv is not True:
+                        continue
+                out_rows.append(row)
+            return SqlResult(self._order_limit(stmt, out_rows))
+        if any(it[0] == "window" for it in stmt.items):
+            self._apply_windows(stmt, rows)
+        out = []
+        for r in rows:
+            if any(it[0] == "star" for it in stmt.items):
+                out.append(dict(r))
+                continue
+            row = {}
+            for i, it in enumerate(stmt.items):
+                name = self._item_name(stmt, i)
+                if it[0] == "col":
+                    _, bare = self._split_qual(it[1])
+                    row[name] = r.get(it[1], r.get(bare))
+                elif it[0] == "window":
+                    row[name] = r.get(name)
+                elif it[0] == "expr":
+                    row[name] = _eval_by_name(it[1], r)
+            for col, _ in stmt.order_by:
+                if col not in row and col in r:
+                    row[col] = r[col]
             out.append(row)
         return SqlResult(self._order_limit(stmt, out))
 
@@ -666,6 +887,11 @@ class SqlSession:
                 names.add(it[1])
             elif it[0] == "expr":
                 self._collect_names(it[1], names)
+            elif it[0] == "window":
+                if it[2] is not None:
+                    self._collect_names(it[2], names)
+                names.update(it[3])
+                names.update(c for c, _ in it[4])
         alias_names = set(getattr(stmt, "aliases", {}).values())
         for col, _ in stmt.order_by:
             if col not in alias_names:   # aliases exist post-projection
@@ -687,6 +913,10 @@ class SqlSession:
         for i, it in enumerate(stmt.items):
             if it[0] == "col":
                 out[self._item_name(stmt, i)] = row.get(it[1])
+            elif it[0] == "window":
+                # computed by _apply_windows, attached under the name
+                name = self._item_name(stmt, i)
+                out[name] = row.get(name)
             elif it[0] == "expr":
                 bound = self._bind(it[1], schema)
                 idrow = {schema.column_by_name(k).id: v
@@ -742,8 +972,10 @@ class SqlSession:
                 out[name] = (s / c) if s is not None and c else None
                 vi += 2
             else:
+                import decimal
                 v = _scalar(values[vi])
-                out[name] = (v if v is None else
+                out[name] = (v if v is None
+                             or isinstance(v, decimal.Decimal) else
                              int(v) if op == "count" else float(v))
                 vi += 1
         return out
@@ -1031,6 +1263,39 @@ def _eval_by_name(node, row: dict):
 
 def _eval_wrap(node, row):
     return node
+
+
+def _agg_over_rows(op: str, expr, rows: List[dict]):
+    """Client-side aggregate over name-keyed rows (CTE / in-memory)."""
+    if op == "count" and expr is None:
+        return len(rows)
+    vals = [_eval_by_name(expr, r) for r in rows]
+    vals = [v for v in vals if v is not None]
+    if op == "count":
+        return len(vals)
+    if not vals:
+        return None
+    if op == "sum":
+        return sum(vals)
+    if op == "min":
+        return min(vals)
+    if op == "max":
+        return max(vals)
+    if op == "avg":
+        return sum(vals) / len(vals)
+    raise ValueError(op)
+
+
+def _subst_aggrefs(node, grows: List[dict]):
+    """Replace ("aggref", op, expr) leaves in a HAVING tree with their
+    computed value over the group's rows."""
+    if not isinstance(node, tuple):
+        return node
+    if node[0] == "aggref":
+        return ("const", _agg_over_rows(node[1], node[2], grows))
+    return (node[0],) + tuple(
+        _subst_aggrefs(c, grows) if isinstance(c, tuple) else c
+        for c in node[1:])
 
 
 def _expr_name(node) -> str:

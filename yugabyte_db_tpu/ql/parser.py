@@ -33,7 +33,15 @@ KEYWORDS = {
     "rollback", "transaction", "distinct", "offset", "like", "having",
     "explain", "analyze",
     "alter", "add", "column", "join", "inner", "left", "outer",
+    "right", "full", "over", "partition", "interval", "timestamp",
+    "date", "cast",
 }
+
+# window functions (besides the aggregate ops)
+WINDOW_FNS = {"row_number", "rank", "dense_rank", "lag", "lead"}
+# scalar functions evaluated row-wise on the CPU path
+SCALAR_FNS = {"now", "coalesce", "abs", "round", "upper", "lower",
+              "length", "floor", "ceil"}
 
 
 def tokenize(sql: str) -> List[Tuple[str, str]]:
@@ -122,7 +130,7 @@ class TxnStmt:
 @dataclass
 class JoinClause:
     table: str                  # right table
-    kind: str                   # 'inner' | 'left'
+    kind: str                   # 'inner' | 'left' | 'right' | 'full'
     left_col: str               # qualified or bare column of the LEFT side
     right_col: str              # column of the right table
 
@@ -131,6 +139,7 @@ class JoinClause:
 class SelectStmt:
     table: str
     # each item: ('col', name) | ('agg', op, expr|None) | ('star',)
+    #   | ('expr', ast) | ('window', fn, expr|None, partition, worder)
     items: List[tuple]
     where: Optional[tuple] = None             # AST over column NAMES
     group_by: List[str] = field(default_factory=list)
@@ -143,6 +152,9 @@ class SelectStmt:
     joins: List["JoinClause"] = field(default_factory=list)
     having: Optional[tuple] = None   # expr; ("aggref", op, expr) leaves
     aliases: Dict[int, str] = field(default_factory=dict)  # item idx -> AS
+    # WITH name AS (SELECT ...): materialized client-side; the outer
+    # query (and later CTEs) may use the name as a table
+    ctes: Dict[str, "SelectStmt"] = field(default_factory=dict)
 
 
 @dataclass
@@ -204,14 +216,20 @@ class Parser:
 
     # -- statements --
     def parse(self):
+        stmt = self.parse_one()
+        self.accept_op(";")
+        if self.peek() is not None:
+            raise ValueError(f"trailing tokens at {self.peek()}")
+        return stmt
+
+    def parse_one(self):
         t = self.peek()
         if t is None:
             raise ValueError("empty statement")
         word = t[1].lower()
         if word == "explain":
             self.next()
-            inner = self.parse()
-            return ExplainStmt(inner)
+            return ExplainStmt(self.parse_one())
 
         fn = {
             "create": self.create_table, "drop": self.drop_table,
@@ -219,14 +237,41 @@ class Parser:
             "delete": self.delete, "update": self.update,
             "begin": self.txn_stmt, "commit": self.txn_stmt,
             "rollback": self.txn_stmt, "alter": self.alter_table,
-            "analyze": self.analyze,
+            "analyze": self.analyze, "with": self.with_select,
         }.get(word)
         if fn is None:
             raise ValueError(f"unsupported statement {word!r}")
-        stmt = fn()
-        self.accept_op(";")
+        return fn()
+
+    def parse_many(self) -> List[object]:
+        """Multi-statement script: `stmt; stmt; ...` (reference: the PG
+        simple-query protocol executes whole scripts in one message)."""
+        out = []
+        while self.peek() is not None:
+            out.append(self.parse_one())
+            if not self.accept_op(";"):
+                break
         if self.peek() is not None:
             raise ValueError(f"trailing tokens at {self.peek()}")
+        return out
+
+    def with_select(self):
+        """WITH name AS (SELECT ...) [, ...] SELECT ... — CTEs
+        materialize client-side; later CTEs may reference earlier
+        ones."""
+        self.expect_kw("with")
+        ctes: Dict[str, SelectStmt] = {}
+        while True:
+            name = self.ident()
+            self.expect_kw("as")
+            self.expect_op("(")
+            sub = self.select()
+            self.expect_op(")")
+            ctes[name] = sub
+            if not self.accept_op(","):
+                break
+        stmt = self.select()
+        stmt.ctes = ctes
         return stmt
 
     def analyze(self):
@@ -424,6 +469,32 @@ class Parser:
             return -v
         raise ValueError(f"expected literal, got {t}")
 
+    def _over_clause(self):
+        """OVER ( [PARTITION BY cols] [ORDER BY col [ASC|DESC], ...] )"""
+        self.expect_op("(")
+        partition: List[str] = []
+        worder: List[Tuple[str, bool]] = []
+        if self.accept_kw("partition"):
+            self.expect_kw("by")
+            while True:
+                partition.append(self.ident())
+                if not self.accept_op(","):
+                    break
+        if self.accept_kw("order"):
+            self.expect_kw("by")
+            while True:
+                col = self.ident()
+                desc = False
+                if self.accept_kw("desc"):
+                    desc = True
+                else:
+                    self.accept_kw("asc")
+                worder.append((col, desc))
+                if not self.accept_op(","):
+                    break
+        self.expect_op(")")
+        return partition, worder
+
     def select(self):
         self.expect_kw("select")
         distinct = self.accept_kw("distinct")
@@ -434,18 +505,37 @@ class Parser:
                 items.append(("star",))
             else:
                 t = self.peek()
-                if t[0] == "kw" and t[1].lower() in ("count", "sum", "min",
-                                                     "max", "avg"):
+                is_agg_kw = (t[0] == "kw" and t[1].lower() in
+                             ("count", "sum", "min", "max", "avg"))
+                is_window_fn = (t[0] == "id"
+                                and t[1].lower() in WINDOW_FNS
+                                and self.pos + 1 < len(self.toks)
+                                and self.toks[self.pos + 1]
+                                == ("op", "("))
+                if is_agg_kw or is_window_fn:
                     op = self.next()[1].lower()
                     self.expect_op("(")
+                    args = []
                     if self.accept_op("*"):
                         expr = None
+                    elif self.peek() == ("op", ")"):
+                        expr = None           # row_number(), rank()
                     else:
                         expr = self.expr()
+                        while self.accept_op(","):   # lag(col, off)
+                            args.append(self.literal())
                     self.expect_op(")")
+                    if self.accept_kw("over"):
+                        partition, worder = self._over_clause()
+                        item = ("window", op, expr, tuple(partition),
+                                tuple(worder), tuple(args))
+                    elif is_window_fn:
+                        raise ValueError(f"{op}() requires OVER (...)")
+                    else:
+                        item = ("agg", op, expr)
                     if self.accept_kw("as"):
                         aliases[len(items)] = self.ident()
-                    items.append(("agg", op, expr))
+                    items.append(item)
                 else:
                     expr = self.expr()
                     if self.accept_kw("as"):
@@ -468,6 +558,14 @@ class Parser:
                 self.accept_kw("outer")
                 self.expect_kw("join")
                 kind = "left"
+            elif self.accept_kw("right"):
+                self.accept_kw("outer")
+                self.expect_kw("join")
+                kind = "right"
+            elif self.accept_kw("full"):
+                self.accept_kw("outer")
+                self.expect_kw("join")
+                kind = "full"
             else:
                 break
             rtable = self.ident()
@@ -684,14 +782,95 @@ class Parser:
                 inner = self.expr()
             self.expect_op(")")
             return ("aggref", op, inner)
+        # typed literals: TIMESTAMP '...' / DATE '...' -> micros since
+        # epoch; INTERVAL '<n> <unit>' -> micros (so +/- composes with
+        # timestamp columns as plain int64 arithmetic, device included)
+        if t[0] == "kw" and t[1].lower() in ("timestamp", "date"):
+            nt = (self.toks[self.pos + 1]
+                  if self.pos + 1 < len(self.toks) else None)
+            if nt is not None and nt[0] == "str":
+                self.next()
+                return ("const", parse_timestamp_micros(self.next()[1]))
+        if t[0] == "kw" and t[1].lower() == "interval":
+            self.next()
+            lit = self.next()
+            if lit[0] != "str":
+                raise ValueError("INTERVAL needs a quoted value")
+            return ("const", parse_interval_micros(lit[1]))
+        if t[0] == "kw" and t[1].lower() == "cast":
+            self.next()
+            self.expect_op("(")
+            inner = self.expr()
+            self.expect_kw("as")
+            ty = self.ident().lower()
+            if self.accept_op("("):
+                self.next()
+                self.expect_op(")")
+            self.expect_op(")")
+            return ("fn", "cast_" + ty, inner)
         if t[0] in ("num", "str") or (t[0] == "kw"
                                       and t[1].lower() == "null"):
             return ("const", self.literal())
         if t[0] == "op" and t[1] == "-":
             return ("const", self.literal())
         name = self.ident()
+        # scalar function call: now(), coalesce(a, b), upper(x), ...
+        if name.lower() in SCALAR_FNS and self.accept_op("("):
+            args = []
+            if not self.accept_op(")"):
+                while True:
+                    args.append(self.expr())
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+            return ("fn", name.lower(), *args)
         return ("col", name)
+
+
+_INTERVAL_UNITS = {
+    "microsecond": 1, "microseconds": 1,
+    "millisecond": 1000, "milliseconds": 1000,
+    "second": 1_000_000, "seconds": 1_000_000,
+    "minute": 60_000_000, "minutes": 60_000_000,
+    "hour": 3_600_000_000, "hours": 3_600_000_000,
+    "day": 86_400_000_000, "days": 86_400_000_000,
+    "week": 7 * 86_400_000_000, "weeks": 7 * 86_400_000_000,
+}
+
+
+def parse_interval_micros(text: str) -> int:
+    """'2 days', '1 hour 30 minutes', '-5 seconds' -> micros."""
+    parts = text.strip().split()
+    if len(parts) % 2 != 0:
+        raise ValueError(f"bad interval {text!r}")
+    total = 0
+    for i in range(0, len(parts), 2):
+        unit = _INTERVAL_UNITS.get(parts[i + 1].lower())
+        if unit is None:
+            raise ValueError(f"unknown interval unit {parts[i + 1]!r}")
+        total += int(float(parts[i]) * unit)
+    return total
+
+
+def parse_timestamp_micros(text: str) -> int:
+    """'YYYY-MM-DD[ HH:MM:SS[.ffffff]]' (UTC) -> micros since epoch."""
+    from datetime import datetime, timezone
+    text = text.strip()
+    for fmt in ("%Y-%m-%d %H:%M:%S.%f", "%Y-%m-%d %H:%M:%S",
+                "%Y-%m-%dT%H:%M:%S", "%Y-%m-%d"):
+        try:
+            dt = datetime.strptime(text, fmt).replace(tzinfo=timezone.utc)
+            return int(dt.timestamp() * 1_000_000)
+        except ValueError:
+            continue
+    raise ValueError(f"bad timestamp literal {text!r}")
 
 
 def parse_statement(sql: str):
     return Parser(tokenize(sql)).parse()
+
+
+def parse_script(sql: str) -> List[object]:
+    """Parse a multi-statement script (reference: PG simple-query
+    protocol scripts)."""
+    return Parser(tokenize(sql)).parse_many()

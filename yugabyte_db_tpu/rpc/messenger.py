@@ -66,6 +66,7 @@ async def _read_frame(reader) -> bytes:
 
 
 def _default(o):
+    import decimal
     import numpy as np
     if isinstance(o, np.integer):
         return int(o)
@@ -75,7 +76,17 @@ def _default(o):
         return o.tolist()
     if isinstance(o, tuple):
         return list(o)
+    if isinstance(o, decimal.Decimal):
+        # exact NUMERIC crosses the wire as a tagged ext type
+        return msgpack.ExtType(1, str(o).encode())
     raise TypeError(f"unserializable {type(o)}")
+
+
+def _ext_hook(code, data):
+    if code == 1:
+        import decimal
+        return decimal.Decimal(data.decode())
+    return msgpack.ExtType(code, data)
 
 
 class Connection:
@@ -95,7 +106,7 @@ class Connection:
             while True:
                 raw = await _read_frame(self.reader)
                 call_id, kind, _svc, _m, payload = msgpack.unpackb(
-                    raw, raw=False)
+                    raw, raw=False, ext_hook=_ext_hook)
                 fut = self.pending.pop(call_id, None)
                 if fut is not None and not fut.done():
                     if kind == _ERR:
@@ -206,7 +217,8 @@ class Messenger:
                     raw = await _read_frame(reader)
                 except RpcError:
                     break              # oversized frame: drop the conn
-                msg = msgpack.unpackb(raw, raw=False)
+                msg = msgpack.unpackb(raw, raw=False,
+                                      ext_hook=_ext_hook)
                 asyncio.create_task(self._dispatch(msg, writer))
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
