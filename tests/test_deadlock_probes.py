@@ -1,0 +1,141 @@
+"""Cross-tablet deadlock detection via coordinator probes + persisted
+SERIALIZABLE read locks surviving leader failover (reference:
+docdb/deadlock_detector.cc; kStrongRead intents in
+docdb/conflict_resolution.cc)."""
+import asyncio
+import time
+
+import pytest
+
+from yugabyte_db_tpu.rpc import RpcError
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+from tests.test_transactions import kv_info, make_cluster, run
+
+
+def _find_tablet_keys(c, mc, n_keys=3):
+    """Keys routed to n_keys DIFFERENT tablets of 'acct'."""
+    # partition routing is deterministic: probe keys until three land
+    # on distinct tablets
+    pass
+
+
+class TestCrossTabletDeadlock:
+    def test_three_tablet_cycle_resolves_via_probe(self, tmp_path):
+        """T1->T2->T3->T1 across three different tablets: no single
+        tablet sees a local cycle, so only the coordinator probes can
+        break it — and well before the 5s wait timeout."""
+        async def go():
+            mc, c = await make_cluster(str(tmp_path), tablets=8)
+            try:
+                ct = await c._table("acct")
+                # find three keys on three different tablets
+                by_tablet = {}
+                for k in range(200):
+                    pkey = ct.info.partition_schema.partition_key_for_row(
+                        ct.codec.pk_entries({"k": k, "bal": 0.0}))
+                    for loc in ct.locations:
+                        if loc.partition.contains(pkey):
+                            by_tablet.setdefault(loc.tablet_id, k)
+                            break
+                    if len(by_tablet) >= 3:
+                        break
+                keys = list(by_tablet.values())[:3]
+                assert len(keys) == 3
+                k1, k2, k3 = keys
+
+                txns = [await c.transaction().begin() for _ in range(3)]
+                t1, t2, t3 = txns
+                await t1.insert("acct", [{"k": k1, "bal": 1.0}])
+                await t2.insert("acct", [{"k": k2, "bal": 2.0}])
+                await t3.insert("acct", [{"k": k3, "bal": 3.0}])
+
+                outcomes = {}
+
+                async def step(txn, name, key):
+                    try:
+                        await txn.insert("acct", [{"k": key, "bal": 9.0}])
+                        await txn.commit()
+                        outcomes[name] = "committed"
+                    except RpcError as e:
+                        outcomes[name] = e.code
+
+                t0 = time.monotonic()
+                await asyncio.gather(
+                    step(t1, "t1", k2), step(t2, "t2", k3),
+                    step(t3, "t3", k1))
+                elapsed = time.monotonic() - t0
+                committed = [n for n, o in outcomes.items()
+                             if o == "committed"]
+                # the probe aborts exactly ONE victim (the youngest);
+                # its successor in the cycle then commits, and the
+                # remaining txn legitimately aborts via first-committer-
+                # wins against that commit — so exactly one commits
+                assert len(committed) == 1, outcomes
+                assert elapsed < 4.5, (
+                    f"cycle broke only at the wait timeout "
+                    f"({elapsed:.1f}s) — probes did not fire")
+            finally:
+                await mc.shutdown()
+        run(go())
+
+
+class TestPersistedReadLocks:
+    def test_read_locks_survive_leader_failover(self, tmp_path):
+        """SERIALIZABLE read locks replicate through Raft: after the
+        leader dies, the new leader still blocks conflicting writers
+        until the reader commits."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=3).start()
+            c = mc.client()
+            await c.create_table(kv_info(), num_tablets=1,
+                                 replication_factor=3)
+            await mc.wait_for_leaders("acct")
+            await c.insert("acct", [{"k": 1, "bal": 100.0}])
+            await c.messenger.call(mc.master.messenger.addr, "master",
+                                   "get_status_tablet", {})
+            await mc.wait_for_leaders("system.transactions")
+
+            reader = await c.transaction(
+                isolation="serializable").begin()
+            row = await reader.get("acct", {"k": 1})
+            assert row["bal"] == 100.0
+
+            # find + kill the acct tablet leader (not the status leader)
+            ct = await c._table("acct")
+            acct_tid = ct.locations[0].tablet_id
+            leader_idx = None
+            for i, ts in enumerate(mc.tservers):
+                p = ts.peers.get(acct_tid)
+                if p is not None and p.is_leader():
+                    leader_idx = i
+            assert leader_idx is not None
+            victim_uuid = mc.tservers[leader_idx].uuid
+            await mc.stop_tserver(leader_idx)
+            # wait for a new acct leader among survivors
+            deadline = asyncio.get_event_loop().time() + 20.0
+            new_leader = None
+            while asyncio.get_event_loop().time() < deadline:
+                for i, ts in enumerate(mc.tservers):
+                    if ts.uuid == victim_uuid or i == leader_idx:
+                        continue
+                    p = ts.peers.get(acct_tid)
+                    if p is not None and p.is_leader():
+                        new_leader = p
+                        break
+                if new_leader:
+                    break
+                await asyncio.sleep(0.1)
+            assert new_leader is not None, "no new leader elected"
+            # the new leader must still hold the read lock
+            assert new_leader.participant._read_holders, \
+                "read locks were lost in the failover"
+
+            # a conflicting writer must block/abort, not slip through
+            for ts in mc.tservers:
+                for p in ts.peers.values():
+                    p.participant.wait_timeout = 1.0
+            writer = await c.transaction().begin()
+            with pytest.raises(RpcError):
+                await writer.insert("acct", [{"k": 1, "bal": 0.0}])
+            await mc.shutdown()
+        run(go())

@@ -214,10 +214,17 @@ def eval_expr_py(node: tuple, row: Dict[int, object]):
         if name == "abs":
             return abs(a0)
         if name == "round":
+            # PG rounds half AWAY from zero; Python round() is
+            # half-to-even
+            from decimal import ROUND_HALF_UP, Decimal
             nd = int(args[1]) if len(args) > 1 and args[1] is not None \
                 else 0
-            r = round(a0, nd)
-            return float(r) if isinstance(a0, float) else r
+            q = Decimal(1).scaleb(-nd)
+            r = Decimal(str(a0)).quantize(q, ROUND_HALF_UP)
+            if isinstance(a0, Decimal):
+                return r
+            return float(r) if isinstance(a0, float) and nd > 0 \
+                else float(r) if isinstance(a0, float) else int(r)
         if name == "floor":
             import math
             return math.floor(a0)
@@ -665,7 +672,7 @@ class DocReadOperation:
             if self.device_cache is not None:
                 key = (id(self.store), tuple(sorted(needed)),
                        tuple(r.path for r in self.store.ssts),
-                       self.store.memtable_empty())
+                       self.store.write_generation())
                 batch = self.device_cache.get_or_build(
                     key, lambda: build_batch(blocks, sorted(needed)))
             else:
@@ -760,7 +767,7 @@ class DocReadOperation:
             if self.device_cache is not None:
                 key = (id(self.store), tuple(sorted(needed)),
                        tuple(r.path for r in self.store.ssts),
-                       self.store.memtable_empty())
+                       self.store.write_generation())
                 batch = self.device_cache.get_or_build(
                     key, lambda: build_batch(blocks, sorted(needed)))
             else:

@@ -694,6 +694,8 @@ class SqlSession:
         if stmt.where is not None:
             rows = [r for r in rows
                     if _eval_by_name(stmt.where, r) is True]
+        if any(it[0] == "window" for it in stmt.items):
+            self._apply_windows(stmt, rows)
         out = []
         for r in rows:
             if any(it[0] == "star" for it in stmt.items):
@@ -705,6 +707,9 @@ class SqlSession:
                     _, bare = self._split_qual(it[1])
                     alias = getattr(stmt, "aliases", {}).get(i)
                     row[alias or bare] = r.get(it[1], r.get(bare))
+                elif it[0] == "window":
+                    name = self._item_name(stmt, i)
+                    row[name] = r.get(name)
             out.append(row)
         return SqlResult(self._order_limit(stmt, out))
 
@@ -787,19 +792,7 @@ class SqlSession:
 
     @staticmethod
     def _window_agg(fn, vals, expr, nrows):
-        if fn == "count":
-            return nrows if expr is None else \
-                len([v for v in vals if v is not None])
-        vv = [v for v in vals if v is not None]
-        if not vv:
-            return None
-        if fn == "sum":
-            return sum(vv)
-        if fn == "min":
-            return min(vv)
-        if fn == "max":
-            return max(vv)
-        return sum(vv) / len(vv)            # avg
+        return _agg_vals(fn, vals, nrows if expr is None else None)
 
     # --- in-memory SELECT over materialized rows (CTE source) -----------
     def _rows_select(self, stmt: SelectStmt, base_rows: List[dict]
@@ -1265,25 +1258,32 @@ def _eval_wrap(node, row):
     return node
 
 
+def _agg_vals(op: str, vals, star_count=None):
+    """Shared values-level aggregate (window + CTE paths). star_count
+    set = COUNT(*) over that many rows."""
+    if op == "count" and star_count is not None:
+        return star_count
+    vv = [v for v in vals if v is not None]
+    if op == "count":
+        return len(vv)
+    if not vv:
+        return None
+    if op == "sum":
+        return sum(vv)
+    if op == "min":
+        return min(vv)
+    if op == "max":
+        return max(vv)
+    if op == "avg":
+        return sum(vv) / len(vv)
+    raise ValueError(op)
+
+
 def _agg_over_rows(op: str, expr, rows: List[dict]):
     """Client-side aggregate over name-keyed rows (CTE / in-memory)."""
     if op == "count" and expr is None:
         return len(rows)
-    vals = [_eval_by_name(expr, r) for r in rows]
-    vals = [v for v in vals if v is not None]
-    if op == "count":
-        return len(vals)
-    if not vals:
-        return None
-    if op == "sum":
-        return sum(vals)
-    if op == "min":
-        return min(vals)
-    if op == "max":
-        return max(vals)
-    if op == "avg":
-        return sum(vals) / len(vals)
-    raise ValueError(op)
+    return _agg_vals(op, [_eval_by_name(expr, r) for r in rows])
 
 
 def _subst_aggrefs(node, grows: List[dict]):

@@ -804,8 +804,8 @@ class Parser:
             self.expect_kw("as")
             ty = self.ident().lower()
             if self.accept_op("("):
-                self.next()
-                self.expect_op(")")
+                while not self.accept_op(")"):   # numeric(10, 2), ...
+                    self.next()
             self.expect_op(")")
             return ("fn", "cast_" + ty, inner)
         if t[0] in ("num", "str") or (t[0] == "kw"

@@ -75,6 +75,7 @@ class LsmStore:
         self._ssts: List[SstReader] = []       # newest first
         self._next_file = 0
         self._flushed_frontier: dict = {}
+        self._write_gen = 0
         self._mem_frontier: dict = {}
         self._load_manifest()
 
@@ -113,8 +114,15 @@ class LsmStore:
         with self._lock:
             for k, v in batch.entries:
                 self._mem.put(k, v)
+            self._write_gen += 1
             if batch.op_id is not None:
                 self._mem_frontier["op_id"] = list(batch.op_id)
+
+    def write_generation(self) -> int:
+        """Monotone counter bumped on every memtable write — device
+        batch cache keys include it so a cached batch can never hide a
+        newer committed write."""
+        return self._write_gen
 
     def should_flush(self) -> bool:
         return (self._mem.approximate_bytes()

@@ -232,6 +232,11 @@ class TabletPeer:
         elif entry.etype == "txn_intents":
             self.participant.apply_intent_entry(entry.payload,
                                                 log_index=entry.index)
+        elif entry.etype == "txn_read_locks":
+            self.participant.apply_read_lock_entry(entry.payload)
+        elif entry.etype == "txn_read_unlock":
+            d = msgpack.unpackb(entry.payload, raw=False)
+            self.participant.release_reads(d["txn_id"])
         elif entry.etype == "txn_apply":
             # frontier-covered applies replay as claim-release only; the
             # regular-store image of the txn is already in the SSTs

@@ -48,6 +48,13 @@ def intent_key(doc_key: bytes, txn_id: str) -> bytes:
     return doc_key + _INTENT_MARKER + txn_id.encode()
 
 
+def read_intent_key(doc_key: bytes, txn_id: str) -> bytes:
+    """SERIALIZABLE read-lock record (reference: kStrongRead intents,
+    docdb/intent.h) — distinct key space from the write intent of the
+    same (key, txn)."""
+    return doc_key + _INTENT_MARKER + txn_id.encode() + b"\x00R"
+
+
 def intent_prefix(doc_key: bytes) -> bytes:
     return doc_key + _INTENT_MARKER
 
@@ -60,11 +67,20 @@ class TransactionCoordinator:
     peer's Raft log as 'txn_status' entries; this class holds the applied
     state and drives participant notification."""
 
+    # wait-for edges reported by participants expire after this long
+    # (waiters re-report every wait round, so live edges stay fresh)
+    WAITS_TTL = 5.0
+    PROBE_MAX_PATH = 16
+
     def __init__(self, peer, messenger: Messenger):
         self.peer = peer                   # TabletPeer of the status tablet
         self.messenger = messenger
         self.txns: Dict[str, dict] = {}    # txn_id -> state
         self._apply_tasks: Set[asyncio.Task] = set()
+        # deadlock detection (reference: probe-based DeadlockDetector,
+        # docdb/deadlock_detector.cc): txn -> {"blockers": {h: st_info},
+        # "ts": monotonic, "start_ht": int}
+        self._waits: Dict[str, dict] = {}
 
     # --- RPC surface (registered via the tserver) -------------------------
     async def begin(self, payload) -> dict:
@@ -107,6 +123,102 @@ class TransactionCoordinator:
         return {"status": st["status"], "commit_ht": st.get("commit_ht"),
                 "start_ht": st.get("start_ht")}
 
+    # --- probe-based deadlock detection -----------------------------------
+    # Participants report wait-for edges for OUR txns; each report
+    # launches probes that chase the edges across status tablets. A
+    # probe whose path closes a cycle aborts exactly ONE member — the
+    # youngest (max start_ht, txn id as tie-break) — so concurrent
+    # probes around the same cycle agree on the victim (reference:
+    # docdb/deadlock_detector.cc probe forwarding + victim resolution).
+    async def report_waits(self, payload) -> dict:
+        txn_id = payload["txn_id"]
+        st = self.txns.get(txn_id)
+        if st is None or st["status"] != PENDING:
+            return {"ok": False}
+        blockers = {b: info for b, info in payload["blockers"].items()
+                    if info}
+        self._waits[txn_id] = {"blockers": blockers,
+                               "ts": time.monotonic(),
+                               "start_ht": st.get("start_ht", 0)}
+        for blocker, st_info in blockers.items():
+            self._spawn(self._send_probe(st_info, {
+                "target": blocker,
+                "path": [txn_id],
+                "hts": [st.get("start_ht", 0)],
+                "sts": [payload.get("self_status_tablet")],
+            }))
+        return {"ok": True}
+
+    def _spawn(self, coro):
+        t = asyncio.get_running_loop().create_task(coro)
+        self._apply_tasks.add(t)
+        t.add_done_callback(self._apply_tasks.discard)
+
+    async def _send_probe(self, st_info, probe) -> None:
+        if not st_info:
+            return
+        for addr in st_info.get("addrs", []):
+            try:
+                await self.messenger.call(
+                    tuple(addr), "tserver", "txn_probe",
+                    {"tablet_id": st_info["tablet_id"], **probe},
+                    timeout=2.0)
+                return
+            except (RpcError, asyncio.TimeoutError, OSError):
+                continue
+
+    async def probe(self, payload) -> dict:
+        """A probe arrived for `target`, one of OUR txns: if it still
+        waits, chase its edges; a path that closes a cycle elects and
+        aborts the youngest member."""
+        target = payload["target"]
+        st = self.txns.get(target)
+        if st is None or st["status"] != PENDING:
+            return {"ok": True}          # decided: no edge to chase
+        w = self._waits.get(target)
+        if w is None or time.monotonic() - w["ts"] > self.WAITS_TTL:
+            return {"ok": True}          # not (freshly) waiting
+        path = list(payload["path"])
+        if target in path or len(path) >= self.PROBE_MAX_PATH:
+            return {"ok": True}          # cycle handled via blockers below
+        new_path = path + [target]
+        new_hts = list(payload["hts"]) + [st.get("start_ht", 0)]
+        my_st = {"tablet_id": self.peer.tablet.tablet_id,
+                 "addrs": [list(self.messenger.addr)]}
+        new_sts = list(payload["sts"]) + [my_st]
+        for blocker, st_info in w["blockers"].items():
+            if blocker in new_path:
+                i = new_path.index(blocker)
+                cycle = list(zip(new_path[i:], new_hts[i:], new_sts[i:]))
+                victim = max(cycle, key=lambda c: (c[1], c[0]))
+                self._spawn(self._abort_victim(victim))
+            else:
+                self._spawn(self._send_probe(st_info, {
+                    "target": blocker, "path": new_path,
+                    "hts": new_hts, "sts": new_sts}))
+        return {"ok": True}
+
+    async def _abort_victim(self, victim) -> None:
+        txn_id, _ht, st_info = victim
+        if st_info is None:
+            return
+        try:
+            if st_info["tablet_id"] == self.peer.tablet.tablet_id:
+                await self.abort({"txn_id": txn_id, "participants": []})
+                return
+            for addr in st_info.get("addrs", []):
+                try:
+                    await self.messenger.call(
+                        tuple(addr), "tserver", "txn_abort",
+                        {"tablet_id": st_info["tablet_id"],
+                         "txn_id": txn_id, "participants": []},
+                        timeout=2.0)
+                    return
+                except (RpcError, asyncio.TimeoutError, OSError):
+                    continue
+        except RpcError:
+            pass   # already committed/aborted: nothing to break
+
     # --- Raft plumbing ------------------------------------------------------
     async def _replicate(self, mutation: dict):
         await self.peer.consensus.replicate(
@@ -123,6 +235,7 @@ class TransactionCoordinator:
                 "deadline": m.get("deadline"), "participants": []})
         elif op == "commit":
             st = self.txns.setdefault(txn_id, {"status": PENDING})
+            self._waits.pop(txn_id, None)
             if st["status"] == PENDING:
                 st["status"] = COMMITTED
                 st["commit_ht"] = m["commit_ht"]
@@ -130,6 +243,7 @@ class TransactionCoordinator:
                 self._schedule_apply(txn_id, st, "apply_txn")
         elif op == "abort":
             st = self.txns.setdefault(txn_id, {"status": PENDING})
+            self._waits.pop(txn_id, None)
             if st["status"] == PENDING:
                 st["status"] = ABORTED
                 st["participants"] = m.get("participants", [])
@@ -248,6 +362,12 @@ class TransactionParticipant:
         replicates (write-write race)."""
         codec = self.tablet._codec_for(req.table_id)
         keys = [codec.doc_key_prefix(op.row) for op in req.ops]
+        if status_tablet:
+            # BEFORE the conflict wait: the wait loop reports wait-for
+            # edges to this txn's coordinator (deadlock probes need the
+            # coordinator address while we are still blocked)
+            self._txn_meta.setdefault(txn_id, {})["status_tablet"] = \
+                status_tablet
         await self._resolve_conflicts(txn_id, start_ht, keys)
         # First-committer-wins (snapshot isolation): a committed write
         # NEWER than our snapshot on any target key is a conflict — the
@@ -352,6 +472,33 @@ class TransactionParticipant:
 
         await self._wait_for_unblock(txn_id, start_ht, blockers_of,
                                      on_clear, "read-lock")
+        # persist the read locks through Raft so a leader failover
+        # keeps them (reference: kStrongRead intents are durable,
+        # docdb/conflict_resolution.cc — previously leader-memory only)
+        await self.peer.consensus.replicate("txn_read_locks", msgpack.packb({
+            "txn_id": txn_id, "start_ht": start_ht, "keys": keys,
+            "status_tablet": status_tablet}))
+
+    def apply_read_lock_entry(self, payload: bytes):
+        """Raft apply of SERIALIZABLE read locks: register shared holds
+        + persist self-describing records in the IntentsDB (recovered
+        by recover_from_store on replicas whose WAL is gone)."""
+        m = msgpack.unpackb(payload, raw=False)
+        txn_id = m["txn_id"]
+        reads = self._txn_reads.setdefault(txn_id, set())
+        meta = self._txn_meta.setdefault(txn_id,
+                                         {"start_ht": m["start_ht"]})
+        if m.get("status_tablet"):
+            meta.setdefault("status_tablet", m["status_tablet"])
+        from ..storage.lsm import WriteBatch
+        batch = WriteBatch()
+        for k in m["keys"]:
+            self._read_holders.setdefault(k, set()).add(txn_id)
+            reads.add(k)
+            batch.put(read_intent_key(k, txn_id), msgpack.packb({
+                "x": txn_id, "k": k, "s": m["start_ht"],
+                "st": m.get("status_tablet"), "r": 1}))
+        self.tablet.intents.apply(batch)
 
     async def _resolve_conflicts(self, txn_id: str, start_ht: int,
                                  keys: List[bytes]):
@@ -385,6 +532,8 @@ class TransactionParticipant:
         empty, then run `on_clear` SYNCHRONOUSLY (registration must not
         await, or racing claimants would both pass)."""
         deadline = time.monotonic() + self.wait_timeout
+        last_reported: Set[str] = set()
+        last_report_t = 0.0
         while True:
             blockers = blockers_of()
             if not blockers:
@@ -398,22 +547,84 @@ class TransactionParticipant:
                 raise RpcError(
                     f"txn {txn_id} {what} timeout (blockers={blockers})",
                     "ABORTED")
+            # cross-tablet cycles: report our wait-for edges to the
+            # txn's coordinator, which probes them across status
+            # tablets (reference: docdb/deadlock_detector.cc). Reports
+            # only go out when the edge set CHANGED — re-launching the
+            # probe cascade every round would hammer the coordinators.
+            if blockers != last_reported or \
+                    time.monotonic() - last_report_t > 2.0:
+                # also refresh periodically: the coordinator expires
+                # edges after WAITS_TTL, and a cycle can form long
+                # after our first report when wait_timeout is raised
+                await self._report_waits(txn_id, blockers)
+                last_reported = set(blockers)
+                last_report_t = time.monotonic()
             w = _Waiter(txn_id, start_ht, asyncio.Event(), blockers)
             self._waiters.append(w)
+            timed_out = False
             try:
                 await asyncio.wait_for(
                     w.event.wait(),
                     min(0.5, max(deadline - time.monotonic(), 0.01)))
             except asyncio.TimeoutError:
-                pass
+                timed_out = True
             finally:
                 if w in self._waiters:
                     self._waiters.remove(w)
+            if not timed_out:
+                continue   # a blocker released: re-check immediately
             # status resolution (reference: TransactionStatusResolver):
             # a blocker may be decided at its coordinator without this
             # participant ever being notified (e.g. expired txn)
             for blocker in list(blockers):
                 await self._maybe_resolve_blocker(blocker)
+            # the deadlock detector may have chosen US as the victim —
+            # a decided own-status ends the wait immediately (only worth
+            # an RPC when nothing released: that is the deadlock shape)
+            own = await self._own_status(txn_id)
+            if own == ABORTED:
+                raise RpcError(
+                    f"txn {txn_id} aborted while waiting "
+                    f"(deadlock victim or expired)", "ABORTED")
+
+    async def _report_waits(self, txn_id: str, blockers) -> None:
+        meta = self._txn_meta.get(txn_id) or {}
+        st_info = meta.get("status_tablet")
+        if not st_info:
+            return
+        payload = {
+            "tablet_id": st_info["tablet_id"],
+            "txn_id": txn_id,
+            "self_status_tablet": st_info,
+            "blockers": {
+                b: (self._txn_meta.get(b) or {}).get("status_tablet")
+                for b in blockers},
+        }
+        for addr in st_info.get("addrs", []):
+            try:
+                await self.peer.consensus.messenger.call(
+                    tuple(addr), "tserver", "txn_report_waits",
+                    payload, timeout=2.0)
+                return
+            except (RpcError, asyncio.TimeoutError, OSError):
+                continue
+
+    async def _own_status(self, txn_id: str):
+        meta = self._txn_meta.get(txn_id) or {}
+        st_info = meta.get("status_tablet")
+        if not st_info:
+            return None
+        for addr in st_info.get("addrs", []):
+            try:
+                r = await self.peer.consensus.messenger.call(
+                    tuple(addr), "tserver", "txn_status",
+                    {"tablet_id": st_info["tablet_id"],
+                     "txn_id": txn_id}, timeout=2.0)
+                return r["status"]
+            except (RpcError, asyncio.TimeoutError, OSError):
+                continue
+        return None
 
     async def _maybe_resolve_blocker(self, txn_id: str) -> None:
         meta = self._txn_meta.get(txn_id) or {}
@@ -486,11 +697,16 @@ class TransactionParticipant:
             if not isinstance(d, dict) or "x" not in d:
                 continue        # release tombstone or legacy value
             txn_id, key = d["x"], d["k"]
-            per_txn = self._intents.setdefault(txn_id, {})
-            if per_txn.get(key) is None:
-                per_txn[key] = (d.get("t", ""), d["o"])
-                n += 1
-            self._key_holder.setdefault(key, txn_id)
+            if d.get("r"):
+                # persisted SERIALIZABLE read lock
+                self._read_holders.setdefault(key, set()).add(txn_id)
+                self._txn_reads.setdefault(txn_id, set()).add(key)
+            else:
+                per_txn = self._intents.setdefault(txn_id, {})
+                if per_txn.get(key) is None:
+                    per_txn[key] = (d.get("t", ""), d["o"])
+                    n += 1
+                self._key_holder.setdefault(key, txn_id)
             meta = self._txn_meta.setdefault(
                 txn_id, {"start_ht": d.get("s", 0)})
             if d.get("st"):
@@ -558,13 +774,20 @@ class TransactionParticipant:
     def release_reads(self, txn_id: str) -> None:
         """Drop a txn's read locks (client-driven at commit/abort for
         read-only participants; writer participants release via
-        apply/rollback)."""
+        apply/rollback). Tombstones the persisted records too."""
+        from ..dockv.value import PrimitiveValue
+        from ..storage.lsm import WriteBatch
+        batch = WriteBatch()
         for k in self._txn_reads.pop(txn_id, ()):
             holders = self._read_holders.get(k)
             if holders:
                 holders.discard(txn_id)
                 if not holders:
                     del self._read_holders[k]
+            batch.put(read_intent_key(k, txn_id),
+                      PrimitiveValue.tombstone().encode())
+        if batch.entries:
+            self.tablet.intents.apply(batch)
         for w in self._waiters:
             if txn_id in w.blockers:
                 w.event.set()

@@ -567,10 +567,13 @@ class TabletServer:
     async def rpc_txn_release_reads(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
         if not peer.is_leader():
-            # locks live only in leader memory; a follower "ok" would
-            # leave them held
             raise RpcError("not leader", "LEADER_NOT_READY")
-        peer.participant.release_reads(payload["txn_id"])
+        # replicated: read-lock acquisition goes through Raft, so the
+        # release must too — otherwise followers (future leaders)
+        # accumulate phantom locks for long-committed readers
+        import msgpack as _mp
+        await peer.consensus.replicate(
+            "txn_read_unlock", _mp.packb({"txn_id": payload["txn_id"]}))
         return {"ok": True}
 
     async def rpc_rollback_txn(self, payload) -> dict:
@@ -636,6 +639,15 @@ class TabletServer:
 
     async def rpc_txn_abort(self, payload) -> dict:
         return await self._coordinator(payload["tablet_id"]).abort(payload)
+
+    async def rpc_txn_report_waits(self, payload) -> dict:
+        """Participant-reported wait-for edges feeding the probe-based
+        deadlock detector (reference: docdb/deadlock_detector.cc)."""
+        return await self._coordinator(
+            payload["tablet_id"]).report_waits(payload)
+
+    async def rpc_txn_probe(self, payload) -> dict:
+        return await self._coordinator(payload["tablet_id"]).probe(payload)
 
     async def rpc_txn_status(self, payload) -> dict:
         # leader + catch-up gated: a follower (or stale new leader)
