@@ -1,0 +1,144 @@
+"""Raft-replicated SplitOperation: online split, replay idempotence,
+and leader crash at arbitrary points (reference:
+tablet/operations/split_operation.cc)."""
+import asyncio
+
+import pytest
+
+from yugabyte_db_tpu.docdb import ReadRequest
+from yugabyte_db_tpu.ops import AggSpec
+from yugabyte_db_tpu.rpc import RpcError
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+from yugabyte_db_tpu.utils import flags
+from tests.test_load_balancer import kv_info
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _count(c, table="kv"):
+    agg = await c.scan(table, ReadRequest(
+        "", aggregates=(AggSpec("count"),)))
+    return int(agg.agg_values[0])
+
+
+class TestRaftSplit:
+    def test_online_split_under_writes(self, tmp_path):
+        """Writes racing the split either land in the parent (before
+        the split entry) or re-route to children — none are lost."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            c = mc.client()
+            await c.create_table(kv_info(), num_tablets=1,
+                                 replication_factor=1)
+            await mc.wait_for_leaders("kv")
+            await c.insert("kv", [{"k": i, "v": 1.0} for i in range(100)])
+            ct = await c._table("kv")
+            parent = ct.locations[0].tablet_id
+
+            stop = asyncio.Event()
+            written = []
+
+            async def writer():
+                i = 100
+                while not stop.is_set():
+                    await c.insert("kv", [{"k": i, "v": 2.0}])
+                    written.append(i)
+                    i += 1
+                    await asyncio.sleep(0.002)
+
+            w = asyncio.create_task(writer())
+            await asyncio.sleep(0.1)
+            r = await c._master_call("split_tablet",
+                                     {"tablet_id": parent}, timeout=60.0)
+            await asyncio.sleep(0.3)
+            stop.set()
+            await w
+            ct = await c._table("kv", refresh=True)
+            assert {l.tablet_id for l in ct.locations} == \
+                {r["left"], r["right"]}
+            assert await _count(c) == 100 + len(written)
+            await mc.shutdown()
+        run(go())
+
+    def test_split_replays_idempotently_after_restart(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            c = mc.client()
+            await c.create_table(kv_info(), num_tablets=1,
+                                 replication_factor=1)
+            await mc.wait_for_leaders("kv")
+            await c.insert("kv", [{"k": i, "v": float(i)}
+                                  for i in range(60)])
+            ct = await c._table("kv")
+            await c._master_call(
+                "split_tablet", {"tablet_id": ct.locations[0].tablet_id},
+                timeout=60.0)
+            assert await _count(c) == 60
+            # restart: children reopen, the parent's split entry (if
+            # still in any WAL) must not re-split or duplicate data
+            await mc.restart_tserver(0)
+            await mc.wait_for_leaders("kv")
+            c2 = mc.client()
+            assert await _count(c2) == 60
+            row = await c2.get("kv", {"k": 42})
+            assert row["v"] == 42.0
+            await mc.shutdown()
+        run(go())
+
+    def test_leader_killed_mid_split_rf3(self, tmp_path):
+        """RF=3: kill the parent leader right after the split entry
+        replicates; the split must complete (entry committed -> applied
+        by the new leader) or cleanly retry — never lose data."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=3).start()
+            c = mc.client()
+            await c.create_table(kv_info(), num_tablets=1,
+                                 replication_factor=3)
+            await mc.wait_for_leaders("kv")
+            await c.insert("kv", [{"k": i, "v": float(i)}
+                                  for i in range(80)])
+            ct = await c._table("kv")
+            parent = ct.locations[0].tablet_id
+            leader_idx = None
+            for i, ts in enumerate(mc.tservers):
+                p = ts.peers.get(parent)
+                if p is not None and p.is_leader():
+                    leader_idx = i
+            assert leader_idx is not None
+
+            async def split_then_retry():
+                for _ in range(6):
+                    try:
+                        return await c._master_call(
+                            "split_tablet", {"tablet_id": parent},
+                            timeout=60.0)
+                    except RpcError:
+                        await asyncio.sleep(0.5)
+                raise AssertionError("split never completed")
+
+            task = asyncio.create_task(split_then_retry())
+            # kill the leader while the split is in flight
+            await asyncio.sleep(0.05)
+            await mc.stop_tserver(leader_idx)
+            r = await asyncio.wait_for(task, 120.0)
+            ct = await c._table("kv", refresh=True)
+            assert {l.tablet_id for l in ct.locations} == \
+                {r["left"], r["right"]}
+            # every row survived, across the remaining replicas
+            deadline = asyncio.get_event_loop().time() + 30.0
+            while True:
+                try:
+                    n = await _count(c)
+                    if n == 80:
+                        break
+                except RpcError:
+                    pass
+                assert asyncio.get_event_loop().time() < deadline, \
+                    "children never became fully available"
+                await asyncio.sleep(0.25)
+            row = await c.get("kv", {"k": 77})
+            assert row is not None and row["v"] == 77.0
+            await mc.shutdown()
+        run(go())

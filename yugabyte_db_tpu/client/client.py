@@ -223,28 +223,32 @@ class YBClient:
     async def write(self, table: str, ops: Sequence[RowOp],
                     external_ht: int | None = None) -> int:
         """Batcher: group ops per tablet, send in parallel, retry on
-        leadership changes. Maintains secondary-index tables
-        synchronously (reference: transactional index maintenance in
-        pggate; round-1 maintenance is non-transactional)."""
-        ct = await self._table(table)
-        if ct.indexes:
-            await self._maintain_indexes(ct, table, ops)
-        by_tablet: Dict[str, List[RowOp]] = {}
-        for op in ops:
-            loc = self._tablet_for_key(ct, op.row)
-            by_tablet.setdefault(loc.tablet_id, []).append(op)
+        leadership changes; a concurrent tablet split re-routes by key
+        against fresh locations (upserts/deletes are idempotent).
+        Maintains secondary-index tables synchronously (reference:
+        transactional index maintenance in pggate; round-1 maintenance
+        is non-transactional)."""
+        ct0 = await self._table(table)
+        if ct0.indexes:
+            await self._maintain_indexes(ct0, table, ops)
 
-        async def send(tablet_id: str, tops: List[RowOp]) -> int:
-            req = WriteRequest(ct.info.table_id, tops,
-                               external_ht=external_ht)
-            payload = {"tablet_id": tablet_id,
-                       "req": write_request_to_wire(req)}
-            return (await self._call_leader(ct, tablet_id, "write", payload)
-                    )["rows_affected"]
+        async def go(ct):
+            by_tablet: Dict[str, List[RowOp]] = {}
+            for op in ops:
+                loc = self._tablet_for_key(ct, op.row)
+                by_tablet.setdefault(loc.tablet_id, []).append(op)
 
-        results = await asyncio.gather(
-            *[send(tid, tops) for tid, tops in by_tablet.items()])
-        return sum(results)
+            async def send(tablet_id: str, tops: List[RowOp]) -> int:
+                req = WriteRequest(ct.info.table_id, tops,
+                                   external_ht=external_ht)
+                payload = {"tablet_id": tablet_id,
+                           "req": write_request_to_wire(req)}
+                return (await self._call_leader(
+                    ct, tablet_id, "write", payload))["rows_affected"]
+
+            return sum(await asyncio.gather(
+                *[send(tid, tops) for tid, tops in by_tablet.items()]))
+        return await self._retry_on_split(table, go)
 
     async def insert(self, table: str, rows: Sequence[dict]) -> int:
         return await self.write(table, [RowOp("upsert", r) for r in rows])
@@ -310,15 +314,32 @@ class YBClient:
         return len(rows)
 
     # --- DML: reads -------------------------------------------------------
-    async def get(self, table: str, pk_row: dict) -> Optional[dict]:
+    async def _retry_on_split(self, table: str, fn):
+        """Run `fn(ct)` retrying with refreshed locations when a tablet
+        splits underneath it (the split parent answers TABLET_SPLIT
+        until the catalog routes to its children)."""
         ct = await self._table(table)
-        loc = self._tablet_for_key(ct, pk_row)
-        req = ReadRequest(ct.info.table_id, pk_eq=pk_row)
-        payload = {"tablet_id": loc.tablet_id,
-                   "req": read_request_to_wire(req)}
-        resp = read_response_from_wire(
-            await self._call_leader(ct, loc.tablet_id, "read", payload))
-        return resp.rows[0] if resp.rows else None
+        for attempt in range(4):
+            try:
+                return await fn(ct)
+            except RpcError as e:
+                if e.code != "TABLET_SPLIT" or attempt == 3:
+                    raise
+                await asyncio.sleep(0.2 * (attempt + 1))
+                ct = await self._table(table, refresh=True)
+        raise RpcError("unreachable", "INTERNAL")
+
+    async def get(self, table: str, pk_row: dict) -> Optional[dict]:
+
+        async def go(ct):
+            loc = self._tablet_for_key(ct, pk_row)
+            req = ReadRequest(ct.info.table_id, pk_eq=pk_row)
+            payload = {"tablet_id": loc.tablet_id,
+                       "req": read_request_to_wire(req)}
+            resp = read_response_from_wire(await self._call_leader(
+                ct, loc.tablet_id, "read", payload))
+            return resp.rows[0] if resp.rows else None
+        return await self._retry_on_split(table, go)
 
     async def scan(self, table: str, req: ReadRequest,
                    keep_all: bool = False) -> ReadResponse:
@@ -329,7 +350,8 @@ class YBClient:
         ct = await self._table(table)
         req.table_id = ct.info.table_id
 
-        async def one(loc: TabletLocation) -> ReadResponse:
+        async def one(loc: TabletLocation,
+                      ct2: CachedTable) -> ReadResponse:
             rows: List[dict] = []
             paging = None
             first: Optional[ReadResponse] = None
@@ -342,7 +364,7 @@ class YBClient:
                 payload = {"tablet_id": loc.tablet_id,
                            "req": read_request_to_wire(r)}
                 resp = read_response_from_wire(await self._call_leader(
-                    ct, loc.tablet_id, "read", payload))
+                    ct2, loc.tablet_id, "read", payload))
                 if first is None:
                     first = resp
                 rows.extend(resp.rows)
@@ -354,8 +376,11 @@ class YBClient:
             first.rows = rows
             return first
 
-        parts = await asyncio.gather(*[one(l) for l in ct.locations])
-        return self._combine(req, parts)
+        async def go(ct2):
+            parts = await asyncio.gather(
+                *[one(l, ct2) for l in ct2.locations])
+            return self._combine(req, parts)
+        return await self._retry_on_split(table, go)
 
     async def scan_pages(self, table: str, req: ReadRequest,
                          page_size: int = 1000):
@@ -519,6 +544,10 @@ class YBClient:
                         addr, "tserver", method, payload, timeout=10.0)
                 except RpcError as e:
                     last_err = e
+                    if e.code == "TABLET_SPLIT":
+                        # the tablet split under us: the caller must
+                        # re-route by key against fresh locations
+                        raise
                     if e.code in ("LEADER_NOT_READY", "LEADER_HAS_NO_LEASE",
                                   "NOT_FOUND", "NETWORK_ERROR",
                                   "SERVICE_UNAVAILABLE"):
@@ -530,5 +559,11 @@ class YBClient:
             # refresh locations (leadership moved / tablet moved)
             await asyncio.sleep(0.1 * (attempt + 1))
             ct2 = await self._table(ct.info.name, refresh=True)
-            loc = next(l for l in ct2.locations if l.tablet_id == tablet_id)
+            loc2 = next((l for l in ct2.locations
+                         if l.tablet_id == tablet_id), None)
+            if loc2 is None:
+                # tablet no longer exists (split finished): re-route
+                raise RpcError(f"tablet {tablet_id} was split",
+                               "TABLET_SPLIT")
+            loc = loc2
         raise last_err or RpcError("exhausted retries", "TIMED_OUT")

@@ -782,36 +782,47 @@ class Master:
             [u, list(self.tservers[u]["addr"])]
             + (["observer"] if u in observers else [])
             for u in ent["replicas"] if u in self.tservers]
-        # Catch-up barrier: every replica must hold the full log before
-        # the replica-local split copies data (otherwise a lagging
-        # follower's children miss recent writes and can win elections
-        # with stale data). The reference avoids this by Raft-replicating
-        # the SplitOperation itself — planned for round 2.
-        if len(ent["replicas"]) > 1:
-            for u in ent["replicas"]:
+        # idempotent retry: children already in the catalog = done
+        if left_id in self.tablets and right_id in self.tablets:
+            return {"left": left_id, "right": right_id}
+        # Raft-replicated SplitOperation through the PARENT's log
+        # (reference: tablet/operations/split_operation.cc): online —
+        # no quiesce, no catch-up barrier; apply ordering guarantees
+        # every replica's children see exactly the pre-split state
+        await self.load_balancer._leader_call(
+            ent, tablet_id, "split_tablet_raft",
+            {"parent_id": tablet_id, "left_id": left_id,
+             "right_id": right_id, "split_key": split_key,
+             "partition": ent["partition"], "table": info_wire,
+             "raft_peers": raft_peers})
+        # barrier: wait until every reachable replica applied the split
+        # (created its children) before deleting parents — a lagging
+        # replica whose parent vanished early would never build them
+        deadline = asyncio.get_event_loop().time() + 30.0
+        pending = set(ent["replicas"])
+        while pending and asyncio.get_event_loop().time() < deadline:
+            for u in list(pending):
+                ts = self.tservers.get(u)
+                if ts is None:
+                    pending.discard(u)
+                    continue
                 try:
-                    await self.load_balancer._leader_call(
-                        ent, tablet_id, "wait_catchup", {"peer_uuid": u})
+                    st = await self.messenger.call(
+                        ts["addr"], "tserver", "tablet_status",
+                        {"tablet_id": tablet_id}, timeout=5.0)
+                    # done = the PARENT finished its split apply (its
+                    # split_done flag is written after the child copy
+                    # completes) or is already gone
+                    if not st.get("exists") or st.get("split_done"):
+                        pending.discard(u)
                 except (RpcError, asyncio.TimeoutError, OSError):
-                    pass
-        # phase 1: every replica copies (parent group stays at full
-        # strength so each replica's apply barrier can commit+apply its
-        # whole log); phase 2 deletes the parents
+                    pass   # dead replica: times out of the barrier
+            if pending:
+                await asyncio.sleep(0.1)
         for u in ent["replicas"]:
             ts = self.tservers.get(u)
-            if ts is None:
-                continue
-            await self.messenger.call(
-                ts["addr"], "tserver", "split_tablet",
-                {"parent_id": tablet_id, "left_id": left_id,
-                 "right_id": right_id, "split_key": split_key,
-                 "partition": ent["partition"], "table": info_wire,
-                 "raft_peers": raft_peers,
-                 "delete_parent": False}, timeout=60.0)
-        for u in ent["replicas"]:
-            ts = self.tservers.get(u)
-            if ts is None:
-                continue
+            if ts is None or u in pending:
+                continue   # never delete a parent that hasn't split yet
             try:
                 await self.messenger.call(
                     ts["addr"], "tserver", "delete_tablet",

@@ -44,6 +44,16 @@ class TabletPeer:
         self._write_queue: list = []
         self._batcher_task = None
         self.on_alter = None      # tserver persists new schema to meta
+        # Raft-replicated split (reference: tablet/operations/
+        # split_operation.cc): the tserver installs the apply hook; a
+        # split parent stops serving and hints clients to re-route
+        self.on_split = None
+        self.split_done = False
+        # write fence: set BEFORE the split entry replicates so no new
+        # write/intent entry can order AFTER it in the log (an entry
+        # behind the split would apply only to the doomed parent — a
+        # lost acknowledged write)
+        self.split_requested = False
         # wakes safe-time waiters when writes drain / entries apply
         self._progress_event = asyncio.Event()
 
@@ -136,6 +146,8 @@ class TabletPeer:
         """Group commit: concurrent writes queue and ride ONE Raft round
         (reference: Log group commit + ReplicateBatch batching,
         consensus/log.cc TaskStream)."""
+        if self.split_done or self.split_requested:
+            raise RpcError("tablet has been split", "TABLET_SPLIT")
         if not self.consensus.is_leader():
             raise RpcError(
                 f"not leader (hint={self.consensus.leader_hint()})",
@@ -201,6 +213,16 @@ class TabletPeer:
     async def _drain_writes(self):
         while self._write_queue:
             batch, self._write_queue = self._write_queue, []
+            if self.split_requested or self.split_done:
+                # the split entry is (about to be) in the log: anything
+                # we append now would order after it and be lost with
+                # the parent — fail so the client re-routes to children
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(RpcError(
+                            "tablet has been split", "TABLET_SPLIT"))
+                self._notify_progress()
+                continue
             payload = msgpack.packb({
                 "batch": [p for p, _ in batch]})
             try:
@@ -250,6 +272,16 @@ class TabletPeer:
             self.participant.apply_rollback_entry(entry.payload)
         elif entry.etype == "txn_status" and self.coordinator is not None:
             self.coordinator.apply_entry(entry.payload)
+        elif entry.etype == "split":
+            # every replica applies the split at the SAME log position:
+            # entries before it are applied (sequential apply), so the
+            # deterministic child copy sees identical parent state on
+            # every replica — online, no quiesce (reference:
+            # tablet/operations/split_operation.cc)
+            d = msgpack.unpackb(entry.payload, raw=False)
+            if self.on_split is not None:
+                await self.on_split(self, d)
+            self.split_done = True
 
     def _apply_payload(self, entry: LogEntry):
         # entries at-or-below the flushed frontier are already durable in
@@ -278,6 +310,8 @@ class TabletPeer:
         (consistent-prefix) reads serve from any replica at its applied
         state — the clock is ratcheted by leader heartbeats, so the
         prefix is consistent though possibly stale."""
+        if self.split_done:
+            raise RpcError("tablet has been split", "TABLET_SPLIT")
         if req.consistency == "follower":
             return self.tablet.read(req)
         if not self.consensus.is_leader():
@@ -310,6 +344,8 @@ class TabletPeer:
     # --- transactional write path ------------------------------------------
     async def write_txn(self, req: WriteRequest, txn_id: str,
                         start_ht: int, status_tablet=None) -> int:
+        if self.split_done or self.split_requested:
+            raise RpcError("tablet has been split", "TABLET_SPLIT")
         if not self.consensus.is_leader():
             raise RpcError(
                 f"not leader (hint={self.consensus.leader_hint()})",

@@ -162,6 +162,8 @@ class TabletServer:
                 json.dump(meta, f)
 
         peer.on_alter = persist_alter
+        peer.on_split = self._apply_split
+        peer.split_done = bool(meta.get("split_done"))
         self.peers[tablet_id] = peer
         await peer.start()
         return peer
@@ -462,72 +464,128 @@ class TabletServer:
         shutil.rmtree(d, ignore_errors=True)
         return {"ok": True}
 
-    async def rpc_split_tablet(self, payload) -> dict:
-        """Split a local tablet replica into two children at split_key.
-        Deterministic local copy on every replica (reference:
-        tablet/operations/split_operation.cc routes this through Raft; we
-        quiesce via the master instead this round)."""
+    async def rpc_split_tablet_raft(self, payload) -> dict:
+        """Split via a Raft-replicated SplitOperation through the
+        PARENT tablet's own log (reference: tablet/operations/
+        split_operation.cc) — online (no quiesce: racing writes simply
+        order before or after the split entry) and crash-consistent
+        (every replica, and WAL replay after any crash, applies the
+        same deterministic child copy at the same log position).
+        Idempotent: a retried split of an already-split parent returns
+        the same children."""
         parent_id = payload["parent_id"]
         parent = self._peer(parent_id)
-        from ..dockv.partition import Partition
-        split_key = bytes.fromhex(payload["split_key"])
-        # apply barrier: everything in the local log must be APPLIED to
-        # the store before we copy (log catch-up alone isn't enough — a
-        # replica applies committed entries asynchronously, and the
-        # parent is deleted right after the copy)
-        import time as _time
-        deadline = _time.monotonic() + 30.0
-        while (parent.consensus.last_applied < parent.log.last_index
-               and _time.monotonic() < deadline):
-            await asyncio.sleep(0.05)
-        if parent.consensus.last_applied < parent.log.last_index:
-            c = parent.consensus
-            raise RpcError(
-                f"split apply barrier timed out (applied="
-                f"{c.last_applied} last={parent.log.last_index})",
-                "TRY_AGAIN")
+        if parent.split_done or payload["left_id"] in self.peers:
+            return {"ok": True, "already": True}
+        if not parent.is_leader():
+            raise RpcError("not leader", "LEADER_NOT_READY")
         if parent.participant._key_holder:
-            # in-flight transactions hold intents on this tablet; their
-            # provisional writes would be dropped by the copy
+            # in-flight txn intents: their provisional records would
+            # need to split too — keep the reference's behavior of
+            # retrying after they resolve for the common path (children
+            # DO inherit any intents that race in, via the filtered
+            # intents copy + recover_from_store)
             raise RpcError("tablet has live transaction intents; retry "
                            "after they resolve", "TRY_AGAIN")
+        import msgpack as _mp
+        # fence BEFORE the entry: no write may order after the split
+        parent.split_requested = True
+        await parent.consensus.replicate("split", _mp.packb({
+            "left_id": payload["left_id"],
+            "right_id": payload["right_id"],
+            "split_key": payload["split_key"],
+            "partition": payload["partition"],
+            "table": payload["table"],
+            "raft_peers": payload["raft_peers"],
+        }))
+        return {"ok": True, "split_index": parent.consensus.last_applied}
+
+    async def _apply_split(self, parent, d) -> None:
+        """Raft-apply of a split entry (runs on EVERY replica and on
+        WAL replay): create the children and copy the parent's state,
+        filtered by the split key. Idempotent — replay with existing
+        children is a no-op."""
+        parent_id = parent.tablet.tablet_id
+        split_key = bytes.fromhex(d["split_key"])
+        if parent.split_done:
+            return                      # replayed after a COMPLETE split
+        # a crash mid-split leaves half-built children (dirs exist but
+        # data never copied — the parent meta's split_done flag, written
+        # LAST, is the completion marker): tear them down and redo
+        import shutil
+        for child_id in (d["left_id"], d["right_id"]):
+            stale = self.peers.pop(child_id, None)
+            if stale is not None:
+                await stale.shutdown()
+            shutil.rmtree(self._tablet_dir(child_id), ignore_errors=True)
         children = []
-        for side, child_id in (("left", payload["left_id"]),
-                               ("right", payload["right_id"])):
-            part = payload["partition"]
-            cpart = ([part[0], payload["split_key"]] if side == "left"
-                     else [payload["split_key"], part[1]])
+        for side, child_id in (("left", d["left_id"]),
+                               ("right", d["right_id"])):
+            part = d["partition"]
+            cpart = ([part[0], d["split_key"]] if side == "left"
+                     else [d["split_key"], part[1]])
             meta = {
-                "tablet_id": child_id, "table": payload["table"],
-                "partition": cpart, "raft_peers": payload["raft_peers"],
+                "tablet_id": child_id, "table": d["table"],
+                "partition": cpart, "raft_peers": d["raft_peers"],
                 "is_status_tablet": False,
             }
-            d = self._tablet_dir(child_id)
-            os.makedirs(d, exist_ok=True)
-            with open(os.path.join(d, "tablet-meta.json"), "w") as f:
+            cd = self._tablet_dir(child_id)
+            os.makedirs(cd, exist_ok=True)
+            with open(os.path.join(cd, "tablet-meta.json"), "w") as f:
                 json.dump(meta, f)
             peer = await self._open_tablet(meta)
             children.append(peer)
         # deterministic local copy of parent rows into children
         from ..storage.lsm import WriteBatch
         left, right = children
-        lb, rb = WriteBatch(), WriteBatch()
-        for k, v in parent.tablet.regular.iterate():
+
+        def side_of(k: bytes):
             # partition key = 2-byte hash prefix of the doc key
             pk = k[1:3] if k and k[0] == 0x08 else k[:2]
-            (lb if pk < split_key else rb).put(k, v)
+            return pk < split_key
+
+        lb, rb = WriteBatch(), WriteBatch()
+        for k, v in parent.tablet.regular.iterate():
+            (lb if side_of(k) else rb).put(k, v)
         left.tablet.regular.apply(lb)
         right.tablet.regular.apply(rb)
+        # in-flight intents split too: children rebuild participant
+        # state from their filtered IntentsDB copies
+        li, ri = WriteBatch(), WriteBatch()
+        for k, v in parent.tablet.intents.iterate():
+            (li if side_of(k) else ri).put(k, v)
+        if li.entries:
+            left.tablet.intents.apply(li)
+        if ri.entries:
+            right.tablet.intents.apply(ri)
         left.tablet.flush()
         right.tablet.flush()
-        # the parent replica is NOT deleted here: the master deletes all
-        # parents in a second phase once every replica has copied —
-        # deleting as-we-go would shrink the parent group under quorum
-        # and the last replica's apply barrier could never commit its
-        # log tail
-        if payload.get("delete_parent", True):
-            await self.rpc_delete_tablet({"tablet_id": parent_id})
-        return {"ok": True, "left_rows": len(lb), "right_rows": len(rb)}
+        for ch in children:
+            ch.participant.recover_from_store()
+        # persist the split state so a restarted replica keeps
+        # rejecting parent ops even before WAL replay reaches the entry
+        meta_path = os.path.join(self._tablet_dir(parent_id),
+                                 "tablet-meta.json")
+        try:
+            with open(meta_path) as f:
+                pmeta = json.load(f)
+            pmeta["split_done"] = True
+            with open(meta_path, "w") as f:
+                json.dump(pmeta, f)
+        except FileNotFoundError:
+            pass
+
+    async def rpc_tablet_status(self, payload) -> dict:
+        """Cheap per-replica probe used by the master's split barrier."""
+        peer = self.peers.get(payload["tablet_id"])
+        if peer is None:
+            return {"exists": False}
+        return {"exists": True, "split_done": peer.split_done,
+                "last_applied": peer.consensus.last_applied,
+                "is_leader": peer.is_leader()}
+
+    # (master split barrier probes the PARENT's split_done — see
+    # master/master.py rpc_split_tablet)
 
     async def rpc_flush(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
