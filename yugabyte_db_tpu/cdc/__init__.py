@@ -1,1 +1,2 @@
 from .consumer import CdcStream, XClusterReplicator  # noqa: F401
+from .virtual_wal import SlotInvalidError, VirtualWal  # noqa: F401

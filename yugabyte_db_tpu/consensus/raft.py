@@ -362,13 +362,21 @@ class RaftConsensus:
         self.log.append(list(entries))
 
     async def replicate(self, etype: str, payload: bytes,
-                        timeout: float = 30.0) -> int:
+                        timeout: float = 30.0, precheck=None) -> int:
         """Leader-only: append + replicate; resolves at commit with the
-        entry's index (reference: ReplicateBatch raft_consensus.cc:1224)."""
+        entry's index (reference: ReplicateBatch raft_consensus.cc:1224).
+
+        `precheck` (if given) runs INSIDE the append lock, immediately
+        before the log position is taken: the atomic seam for fences
+        like the tablet-split write fence — a caller that checked the
+        fence before awaiting here could otherwise append after a
+        fence entry that slipped in while it waited for the lock."""
         if self.role != Role.LEADER:
             raise RpcError(f"not leader (leader={self.leader_uuid})",
                            "LEADER_NOT_READY")
         async with self._replicate_lock:
+            if precheck is not None:
+                precheck()
             idx = self.log.last_index + 1
             await self._append_local(LogEntry(
                 self.meta.current_term, idx, etype, payload))

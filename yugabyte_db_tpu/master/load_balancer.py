@@ -27,6 +27,8 @@ class ClusterLoadBalancer:
     def _replica_counts(self) -> Dict[str, int]:
         counts = {u: 0 for u in self.master.live_tservers()}
         for ent in self.master.tablets.values():
+            if ent.get("hidden"):
+                continue   # CDC-retained split parent: not balanced
             for u in ent["replicas"]:
                 if u in counts:
                     counts[u] += 1
@@ -35,6 +37,8 @@ class ClusterLoadBalancer:
     def _leader_counts(self) -> Dict[str, int]:
         counts = {u: 0 for u in self.master.live_tservers()}
         for ent in self.master.tablets.values():
+            if ent.get("hidden"):
+                continue
             l = ent.get("leader")
             if l in counts:
                 counts[l] += 1
@@ -65,6 +69,10 @@ class ClusterLoadBalancer:
             return None
         # find a tablet on src not on dst
         for tablet_id, ent in self.master.tablets.items():
+            if ent.get("hidden"):
+                # moving a hidden parent would invalidate the replica
+                # addresses replication slots reach it by
+                continue
             if src in ent["replicas"] and dst not in ent["replicas"]:
                 ok = await self.move_replica(tablet_id, src, dst)
                 if ok:
@@ -195,6 +203,8 @@ class ClusterLoadBalancer:
             return None
         m = self.master
         for tablet_id, ent in m.tablets.items():
+            if ent.get("hidden"):
+                continue
             if ent.get("leader") == src and dst in ent["replicas"]:
                 try:
                     await m.messenger.call(

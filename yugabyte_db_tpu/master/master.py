@@ -50,6 +50,10 @@ class Master:
         # config (catalog-persisted); running replicator tasks live in
         # _xcluster_tasks on the leader only
         self.xcluster_replication: Dict[str, dict] = {}
+        # slot_id -> slot entry: the cdc_state-table analog for the
+        # CDC-SDK consumer API (reference: cdc/cdc_state_table.cc,
+        # replication-slot metadata in cdcsdk_virtual_wal.cc)
+        self.replication_slots: Dict[str, dict] = {}
         self._xcluster_tasks: Dict[str, object] = {}
         self._xcluster_reconcile_lock = asyncio.Lock()
         self.auto_balance = False   # ticked explicitly or via enable
@@ -89,6 +93,10 @@ class Master:
                 self.xcluster_replication[op[1]] = op[2]
             elif kind == "del_xcluster":
                 self.xcluster_replication.pop(op[1], None)
+            elif kind == "put_repl_slot":
+                self.replication_slots[op[1]] = op[2]
+            elif kind == "del_repl_slot":
+                self.replication_slots.pop(op[1], None)
         self._persist()
 
     async def _commit_catalog(self, ops) -> None:
@@ -133,12 +141,14 @@ class Master:
             self.tables = d["tables"]
             self.tablets = d["tablets"]
             self.xcluster_replication = d.get("xcluster", {})
+            self.replication_slots = d.get("repl_slots", {})
 
     def _persist(self):
         tmp = self._catalog_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"tables": self.tables, "tablets": self.tablets,
-                       "xcluster": self.xcluster_replication}, f)
+                       "xcluster": self.xcluster_replication,
+                       "repl_slots": self.replication_slots}, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._catalog_path)
@@ -168,6 +178,11 @@ class Master:
                 await self._ensure_xcluster_replicators()
             except Exception:   # noqa: BLE001
                 pass
+            if self.is_leader():
+                try:
+                    await self._gc_hidden_tablets()
+                except Exception:   # noqa: BLE001
+                    pass
             await asyncio.sleep(1.0)
 
     # --- balancing / placement RPCs ----------------------------------------
@@ -819,20 +834,31 @@ class Master:
                     pass   # dead replica: times out of the barrier
             if pending:
                 await asyncio.sleep(0.1)
-        for u in ent["replicas"]:
-            ts = self.tservers.get(u)
-            if ts is None or u in pending:
-                continue   # never delete a parent that hasn't split yet
-            try:
-                await self.messenger.call(
-                    ts["addr"], "tserver", "delete_tablet",
-                    {"tablet_id": tablet_id}, timeout=30.0)
-            except (RpcError, asyncio.TimeoutError, OSError):
-                pass   # replica gone/lagging: catalog del_tablet below
-                       # stops routing; the replica's disk copy is
-                       # orphaned until operator cleanup (a catalog-
-                       # driven GC sweep is future work)
+        # a parent covered by a CDC replication slot is HIDDEN, not
+        # deleted: its peers keep serving get_changes until every slot
+        # has drained past its split marker (reference: CDC-retained
+        # split parents — hidden tablets, master retains parents while
+        # cdc_state still references them)
+        tname = self.tables[table_id]["info"]["name"]
+
+        def _cdc_retains() -> bool:
+            # a slot whose state references the parent, or a just-
+            # created slot that hasn't persisted its tablet set yet
+            # (it may be about to adopt the parent; the GC sweep
+            # collects it once the slot's state shows otherwise)
+            return any(
+                tname in s.get("tables", ())
+                and (tablet_id in s.get("state", {}) or not s.get("state"))
+                for s in self.replication_slots.values())
+        # catalog commit comes FIRST: once the parent leaves the table's
+        # tablet list, no new slot can discover it — only then is it
+        # safe to destroy replicas
+        cdc_retained = _cdc_retains()
         ops = []
+        if cdc_retained:
+            hid = dict(ent)
+            hid["hidden"] = True
+            ops.append(["put_tablet", tablet_id, hid])
         for child_id, part in ((left_id, [ent["partition"][0], split_key]),
                                (right_id, [split_key, ent["partition"][1]])):
             ops.append(["put_tablet", child_id, {
@@ -840,12 +866,35 @@ class Master:
                 "partition": part, "replicas": list(ent["replicas"]),
                 "observers": sorted(observers),
                 "leader": None}])
-        ops.append(["del_tablet", tablet_id])
+        if not cdc_retained:
+            ops.append(["del_tablet", tablet_id])
         tent = dict(self.tables[table_id])
         tl = [t for t in tent["tablets"] if t != tablet_id]
         tent["tablets"] = tl + [left_id, right_id]
         ops.append(["put_table", table_id, tent])
         await self._commit_catalog(ops)
+        if not cdc_retained:
+            if _cdc_retains():
+                # a slot adopted the parent while the split barrier /
+                # catalog commit awaited: flip to hidden instead of
+                # destroying the data it needs
+                hid = dict(ent)
+                hid["hidden"] = True
+                await self._commit_catalog(
+                    [["put_tablet", tablet_id, hid]])
+            else:
+                for u in ent["replicas"]:
+                    ts = self.tservers.get(u)
+                    if ts is None or u in pending:
+                        continue  # never delete an unsplit parent
+                    try:
+                        await self.messenger.call(
+                            ts["addr"], "tserver", "delete_tablet",
+                            {"tablet_id": tablet_id}, timeout=30.0)
+                    except (RpcError, asyncio.TimeoutError, OSError):
+                        pass   # replica gone/lagging: already out of
+                               # the catalog; disk copy orphaned until
+                               # operator cleanup
         return {"left": left_id, "right": right_id}
 
     # --- CDC stream registry (reference: master cdcsdk_manager.cc,
@@ -984,6 +1033,110 @@ class Master:
                 return {"table": e["info"]["name"],
                         **e["cdc_streams"][payload["stream_id"]]}
         raise RpcError("stream not found", "NOT_FOUND")
+
+    # --- replication slots (CDC-SDK consumer API; reference:
+    # cdc_state_table.cc + the slot metadata the virtual WAL keeps in
+    # cdcsdk_virtual_wal.cc / CreateReplicationSlot in yb_client) --------
+    async def rpc_create_replication_slot(self, payload) -> dict:
+        self._check_leader()
+        name = payload.get("name") or f"slot-{uuidlib.uuid4().hex[:12]}"
+        if name in self.replication_slots:
+            raise RpcError(f"slot {name} already exists", "ALREADY_PRESENT")
+        tables = list(payload["tables"])
+        known = {e["info"]["name"] for e in self.tables.values()}
+        missing = [t for t in tables if t not in known]
+        if missing:
+            raise RpcError(f"tables not found: {missing}", "NOT_FOUND")
+        ent = {"tables": tables,
+               "state": {},            # tablet_id -> per-tablet state
+               "confirmed_lsn": None,  # [commit_ht, txn_key, seq]
+               "start_from": payload.get("start_from", "earliest")}
+        await self._commit_catalog([["put_repl_slot", name, ent]])
+        return {"slot_id": name}
+
+    async def rpc_get_replication_slot(self, payload) -> dict:
+        self._check_leader()
+        ent = self.replication_slots.get(payload["slot_id"])
+        if ent is None:
+            raise RpcError("slot not found", "NOT_FOUND")
+        return {"slot_id": payload["slot_id"], **ent}
+
+    async def rpc_update_replication_slot(self, payload) -> dict:
+        """Persist the consumer's acknowledged position: per-tablet
+        checkpoints (already held back below unconfirmed txns by the
+        virtual WAL) + the confirmed LSN, atomically."""
+        self._check_leader()
+        sid = payload["slot_id"]
+        if sid not in self.replication_slots:
+            raise RpcError("slot not found", "NOT_FOUND")
+        ent = dict(self.replication_slots[sid])
+        ent["state"] = payload["state"]
+        ent["confirmed_lsn"] = payload.get("confirmed_lsn")
+        if "decisions" in payload:
+            ent["decisions"] = payload["decisions"]
+        await self._commit_catalog([["put_repl_slot", sid, ent]])
+        return {"ok": True}
+
+    async def rpc_drop_replication_slot(self, payload) -> dict:
+        self._check_leader()
+        if payload["slot_id"] not in self.replication_slots:
+            raise RpcError("slot not found", "NOT_FOUND")
+        await self._commit_catalog([["del_repl_slot", payload["slot_id"]]])
+        return {"ok": True}
+
+    async def _gc_hidden_tablets(self) -> None:
+        """Delete CDC-retained split parents once every slot covering
+        their table has drained past the split marker (marked them
+        retired) or was dropped (reference: hidden-tablet cleanup in
+        catalog manager once no CDC stream retains them). Runs from the
+        maintenance loop — NOT inline in the consumer's confirm path,
+        where an unreachable tserver would stall every ack."""
+        for tid, ent in list(self.tablets.items()):
+            if not ent.get("hidden"):
+                continue
+            tent = self.tables.get(ent["table_id"])
+            tname = tent["info"]["name"] if tent else None
+
+            def _slot_needs(s) -> bool:
+                # only slots whose persisted state references this
+                # parent can replay from it (slots created after the
+                # split start at the children); such a slot is finished
+                # with it once its restart position reaches the split
+                # marker — `retired` alone still holds back below
+                # unconfirmed txns a restarted consumer must re-read
+                if tname not in s.get("tables", ()):
+                    return False
+                if not s.get("state"):
+                    # just-created slot racing the split: its tablet set
+                    # (possibly including this parent) isn't persisted
+                    # yet — keep the parent, matching the retention
+                    # predicate in rpc_split_tablet
+                    return True
+                st = s["state"].get(tid)
+                if st is None:
+                    return False
+                return not (st.get("retired")
+                            and st.get("checkpoint", 0)
+                            >= st.get("split_index", 0))
+            still_needed = any(_slot_needs(s)
+                               for s in self.replication_slots.values())
+            if still_needed:
+                continue
+            for u in ent["replicas"]:
+                ts = self.tservers.get(u)
+                if ts is None:
+                    continue
+                try:
+                    await self.messenger.call(
+                        ts["addr"], "tserver", "delete_tablet",
+                        {"tablet_id": tid}, timeout=5.0)
+                except (RpcError, asyncio.TimeoutError, OSError):
+                    pass
+            await self._commit_catalog([["del_tablet", tid]])
+
+    async def rpc_list_replication_slots(self, payload) -> dict:
+        self._check_leader()
+        return {"slots": sorted(self.replication_slots)}
 
     # --- AutoFlags (reference: master_auto_flags_manager.cc,
     # architecture/design/auto_flags.md) -----------------------------------

@@ -57,6 +57,15 @@ class TabletPeer:
         # wakes safe-time waiters when writes drain / entries apply
         self._progress_event = asyncio.Event()
 
+    def split_fence_check(self) -> None:
+        """Passed as `precheck` into consensus.replicate for every
+        data entry: runs inside the append lock, so no write/intent/
+        apply can take a log position after the split entry (the
+        check-then-await window would otherwise let one slip in while
+        waiting for the lock)."""
+        if self.split_requested or self.split_done:
+            raise RpcError("tablet has been split", "TABLET_SPLIT")
+
     async def alter(self, table_wire: dict):
         if not self.consensus.is_leader():
             raise RpcError("not leader", "LEADER_NOT_READY")
@@ -226,7 +235,8 @@ class TabletPeer:
             payload = msgpack.packb({
                 "batch": [p for p, _ in batch]})
             try:
-                await self.consensus.replicate("write", payload)
+                await self.consensus.replicate(
+                    "write", payload, precheck=self.split_fence_check)
             except Exception as e:   # noqa: BLE001 — propagate per-waiter
                 for _, fut in batch:
                     if not fut.done():
@@ -365,13 +375,16 @@ class TabletPeer:
 
     async def apply_txn(self, txn_id: str, commit_ht: int):
         import msgpack as _mp
-        await self.consensus.replicate("txn_apply", _mp.packb(
-            {"txn_id": txn_id, "commit_ht": commit_ht}))
+        await self.consensus.replicate(
+            "txn_apply", _mp.packb(
+                {"txn_id": txn_id, "commit_ht": commit_ht}),
+            precheck=self.split_fence_check)
 
     async def rollback_txn(self, txn_id: str):
         import msgpack as _mp
-        await self.consensus.replicate("txn_rollback", _mp.packb(
-            {"txn_id": txn_id}))
+        await self.consensus.replicate(
+            "txn_rollback", _mp.packb({"txn_id": txn_id}),
+            precheck=self.split_fence_check)
 
     def read_own_intent(self, txn_id: str, pk_row: dict,
                         table_id: str = ""):

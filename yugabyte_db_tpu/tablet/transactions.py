@@ -394,7 +394,9 @@ class TransactionParticipant:
             "table_id": req.table_id,
         })
         try:
-            await self.peer.consensus.replicate("txn_intents", payload)
+            await self.peer.consensus.replicate(
+                "txn_intents", payload,
+                precheck=self.peer.split_fence_check)
         except Exception:
             # undo claims that never got an applied intent
             per_txn = self._intents.get(txn_id, {})
@@ -475,9 +477,11 @@ class TransactionParticipant:
         # persist the read locks through Raft so a leader failover
         # keeps them (reference: kStrongRead intents are durable,
         # docdb/conflict_resolution.cc — previously leader-memory only)
-        await self.peer.consensus.replicate("txn_read_locks", msgpack.packb({
-            "txn_id": txn_id, "start_ht": start_ht, "keys": keys,
-            "status_tablet": status_tablet}))
+        await self.peer.consensus.replicate(
+            "txn_read_locks", msgpack.packb({
+                "txn_id": txn_id, "start_ht": start_ht, "keys": keys,
+                "status_tablet": status_tablet}),
+            precheck=self.peer.split_fence_check)
 
     def apply_read_lock_entry(self, payload: bytes):
         """Raft apply of SERIALIZABLE read locks: register shared holds

@@ -32,6 +32,18 @@ from ..utils.trace import ASH, TRACES, wait_status
 log = logging.getLogger("ybtpu.tserver")
 
 
+def _atomic_json(path: str, obj) -> None:
+    """Durable metadata write: tmp + fsync + rename, so a crash
+    mid-write never leaves a truncated tablet-meta.json the next
+    startup would fail to parse."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 class TabletServer:
     def __init__(self, uuid: str, fs_root: str,
                  master_addrs: Optional[List[Tuple[str, int]]] = None,
@@ -44,6 +56,10 @@ class TabletServer:
         self.messenger = Messenger(f"ts-{uuid}")
         self.clock = HybridClock()
         self.peers: Dict[str, TabletPeer] = {}
+        # split parent -> [child ids] (persisted in the parent's meta;
+        # routes txn apply/rollback decisions to the children that
+        # inherited the parent's in-flight intents)
+        self._split_children: Dict[str, list] = {}
         self._hb_task: Optional[asyncio.Task] = None
         self._running = False
         self.messenger.register_service("tserver", self)
@@ -141,10 +157,8 @@ class TabletServer:
         def persist_config(cfg, tablet_id=tablet_id, meta=meta):
             meta["raft_peers"] = [[p.uuid, list(p.addr), p.role]
                                   for p in cfg.peers]
-            path = os.path.join(self._tablet_dir(tablet_id),
-                                "tablet-meta.json")
-            with open(path, "w") as f:
-                json.dump(meta, f)
+            _atomic_json(os.path.join(self._tablet_dir(tablet_id),
+                                      "tablet-meta.json"), meta)
 
         peer.consensus.on_config_change = persist_config
 
@@ -156,14 +170,26 @@ class TabletServer:
                     tw if tw.get("table_id") != table_wire.get("table_id")
                     else table_wire
                     for tw in meta.get("colocated_tables", [])]
-            path = os.path.join(self._tablet_dir(tablet_id),
-                                "tablet-meta.json")
-            with open(path, "w") as f:
-                json.dump(meta, f)
+            _atomic_json(os.path.join(self._tablet_dir(tablet_id),
+                                      "tablet-meta.json"), meta)
 
         peer.on_alter = persist_alter
         peer.on_split = self._apply_split
         peer.split_done = bool(meta.get("split_done"))
+        if meta.get("split_children"):
+            self._split_children[tablet_id] = list(meta["split_children"])
+        # a child's split-complete marker names its parent: rebuild the
+        # parent->children decision-routing map even after the parent
+        # replica itself was deleted
+        mk = os.path.join(self._tablet_dir(tablet_id),
+                          "split-complete.json")
+        if os.path.exists(mk):
+            with open(mk) as f:
+                par = json.load(f).get("parent")
+            if par:
+                sibs = self._split_children.setdefault(par, [])
+                if tablet_id not in sibs:
+                    sibs.append(tablet_id)
         self.peers[tablet_id] = peer
         await peer.start()
         return peer
@@ -489,15 +515,32 @@ class TabletServer:
                            "after they resolve", "TRY_AGAIN")
         import msgpack as _mp
         # fence BEFORE the entry: no write may order after the split
+        # (writes re-check the fence INSIDE the append lock, so none can
+        # slip behind the split entry while we wait for replication)
         parent.split_requested = True
-        await parent.consensus.replicate("split", _mp.packb({
-            "left_id": payload["left_id"],
-            "right_id": payload["right_id"],
-            "split_key": payload["split_key"],
-            "partition": payload["partition"],
-            "table": payload["table"],
-            "raft_peers": payload["raft_peers"],
-        }))
+        try:
+            await parent.consensus.replicate("split", _mp.packb({
+                "left_id": payload["left_id"],
+                "right_id": payload["right_id"],
+                "split_key": payload["split_key"],
+                "partition": payload["partition"],
+                "table": payload["table"],
+                "raft_peers": payload["raft_peers"],
+            }))
+        except Exception:
+            # lift the fence ONLY if the entry never reached our log
+            # (LEADER_NOT_READY / precheck): the tablet would otherwise
+            # reject every write forever. An appended-but-uncommitted
+            # split entry ANYWHERE above last_applied keeps the fence —
+            # it may still commit after us (non-fenced entries like a
+            # term noop can sit above it, so scan, don't tail-check).
+            pending_split = any(
+                e.etype == "split"
+                for e in parent.log.entries_from(
+                    parent.consensus.last_applied + 1))
+            if not pending_split:
+                parent.split_requested = False
+            raise
         return {"ok": True, "split_index": parent.consensus.last_applied}
 
     async def _apply_split(self, parent, d) -> None:
@@ -509,18 +552,35 @@ class TabletServer:
         split_key = bytes.fromhex(d["split_key"])
         if parent.split_done:
             return                      # replayed after a COMPLETE split
-        # a crash mid-split leaves half-built children (dirs exist but
-        # data never copied — the parent meta's split_done flag, written
-        # LAST, is the completion marker): tear them down and redo
+
+        # Each child gets a durable "split-complete" marker as the LAST
+        # step of its build, BEFORE the parent's split_done flag. On
+        # replay, a marked child is a finished copy that may already
+        # hold acknowledged post-split writes — it must NOT be torn
+        # down; only unmarked (half-built) children are redone.
+        def _marker(child_id: str) -> str:
+            return os.path.join(self._tablet_dir(child_id),
+                                "split-complete.json")
+
         import shutil
-        for child_id in (d["left_id"], d["right_id"]):
+        rebuild = []                    # (side, child_id) still to build
+        children = {}                   # child_id -> peer
+        for side, child_id in (("left", d["left_id"]),
+                               ("right", d["right_id"])):
+            if os.path.exists(_marker(child_id)):
+                peer = self.peers.get(child_id)
+                if peer is None:
+                    with open(os.path.join(self._tablet_dir(child_id),
+                                           "tablet-meta.json")) as f:
+                        peer = await self._open_tablet(json.load(f))
+                children[child_id] = peer
+                continue
             stale = self.peers.pop(child_id, None)
             if stale is not None:
                 await stale.shutdown()
             shutil.rmtree(self._tablet_dir(child_id), ignore_errors=True)
-        children = []
-        for side, child_id in (("left", d["left_id"]),
-                               ("right", d["right_id"])):
+            rebuild.append((side, child_id))
+        for side, child_id in rebuild:
             part = d["partition"]
             cpart = ([part[0], d["split_key"]] if side == "left"
                      else [d["split_key"], part[1]])
@@ -531,47 +591,51 @@ class TabletServer:
             }
             cd = self._tablet_dir(child_id)
             os.makedirs(cd, exist_ok=True)
-            with open(os.path.join(cd, "tablet-meta.json"), "w") as f:
-                json.dump(meta, f)
+            _atomic_json(os.path.join(cd, "tablet-meta.json"), meta)
             peer = await self._open_tablet(meta)
-            children.append(peer)
-        # deterministic local copy of parent rows into children
-        from ..storage.lsm import WriteBatch
-        left, right = children
+            children[child_id] = peer
 
         def side_of(k: bytes):
             # partition key = 2-byte hash prefix of the doc key
             pk = k[1:3] if k and k[0] == 0x08 else k[:2]
             return pk < split_key
 
-        lb, rb = WriteBatch(), WriteBatch()
-        for k, v in parent.tablet.regular.iterate():
-            (lb if side_of(k) else rb).put(k, v)
-        left.tablet.regular.apply(lb)
-        right.tablet.regular.apply(rb)
-        # in-flight intents split too: children rebuild participant
-        # state from their filtered IntentsDB copies
-        li, ri = WriteBatch(), WriteBatch()
-        for k, v in parent.tablet.intents.iterate():
-            (li if side_of(k) else ri).put(k, v)
-        if li.entries:
-            left.tablet.intents.apply(li)
-        if ri.entries:
-            right.tablet.intents.apply(ri)
-        left.tablet.flush()
-        right.tablet.flush()
-        for ch in children:
+        # deterministic local copy of parent rows (and in-flight
+        # intents — children rebuild participant state from their
+        # filtered IntentsDB copies) into the children being built:
+        # one pass over the parent stores fills both sides' batches
+        from ..storage.lsm import WriteBatch
+        want = {cid for _, cid in rebuild}
+        reg = {cid: WriteBatch() for cid in want}
+        intents = {cid: WriteBatch() for cid in want}
+        if want:
+            for k, v in parent.tablet.regular.iterate():
+                cid = d["left_id"] if side_of(k) else d["right_id"]
+                if cid in want:
+                    reg[cid].put(k, v)
+            for k, v in parent.tablet.intents.iterate():
+                cid = d["left_id"] if side_of(k) else d["right_id"]
+                if cid in want:
+                    intents[cid].put(k, v)
+        for cid in want:
+            ch = children[cid]
+            ch.tablet.regular.apply(reg[cid])
+            if intents[cid].entries:
+                ch.tablet.intents.apply(intents[cid])
+            ch.tablet.flush()
             ch.participant.recover_from_store()
+            _atomic_json(_marker(cid), {"parent": parent_id})
         # persist the split state so a restarted replica keeps
         # rejecting parent ops even before WAL replay reaches the entry
         meta_path = os.path.join(self._tablet_dir(parent_id),
                                  "tablet-meta.json")
+        self._split_children[parent_id] = [d["left_id"], d["right_id"]]
         try:
             with open(meta_path) as f:
                 pmeta = json.load(f)
             pmeta["split_done"] = True
-            with open(meta_path, "w") as f:
-                json.dump(pmeta, f)
+            pmeta["split_children"] = [d["left_id"], d["right_id"]]
+            _atomic_json(meta_path, pmeta)
         except FileNotFoundError:
             pass
 
@@ -603,11 +667,70 @@ class TabletServer:
                                  payload.get("status_tablet"))
         return {"rows_affected": n}
 
+    async def _drive_txn_decision(self, tablet_id: str, method: str,
+                                  payload: dict) -> None:
+        """Land a txn apply/rollback in the right log(s) through splits:
+        a split parent's in-flight intents were copied to its children,
+        so the decision must reach EVERY child — local children via
+        their leader, remote/follower children by forwarding the same
+        RPC to their replicas (children elect leaders independently, so
+        the two can live on different tservers). Succeeds only when all
+        targets got the decision; mid-split or unreachable → retriable
+        (the coordinator re-drives)."""
+        peer = self.peers.get(tablet_id)
+        if peer is not None:
+            if peer.split_requested and not peer.split_done:
+                raise RpcError("tablet splitting; retry", "TRY_AGAIN")
+            if not peer.split_done:
+                if not peer.is_leader():
+                    raise RpcError("not leader", "LEADER_NOT_READY")
+                if method == "apply_txn":
+                    await peer.apply_txn(payload["txn_id"],
+                                         payload["commit_ht"])
+                else:
+                    await peer.rollback_txn(payload["txn_id"])
+                return
+        # split parent (possibly already deleted — the children's
+        # split-complete markers rebuild the routing map on restart)
+        children = self._split_children.get(tablet_id, [])
+        if not children:
+            if peer is None:
+                raise RpcError(f"tablet {tablet_id} not found",
+                               "NOT_FOUND")
+            raise RpcError("tablet split; children unknown here",
+                           "TRY_AGAIN")
+        for cid in children:
+            cpeer = self.peers.get(cid)
+            if cpeer is not None and cpeer.is_leader():
+                await self._drive_txn_decision(cid, method,
+                                               {**payload,
+                                                "tablet_id": cid})
+                continue
+            # forward to the child's replicas (its own config if local,
+            # else the parent's replica set the child was created on)
+            fallback = cpeer if cpeer is not None else peer
+            if fallback is None:
+                raise RpcError(f"child {cid} unknown here", "TRY_AGAIN")
+            addrs = [p.addr for p in fallback.consensus.config.peers]
+            delivered = False
+            for addr in addrs:
+                if addr == self.messenger.addr:
+                    continue
+                try:
+                    await self.messenger.call(
+                        addr, "tserver", method,
+                        {**payload, "tablet_id": cid}, timeout=5.0)
+                    delivered = True
+                    break
+                except (RpcError, asyncio.TimeoutError, OSError):
+                    continue
+            if not delivered:
+                raise RpcError(f"child {cid} unreachable for {method}",
+                               "TRY_AGAIN")
+
     async def rpc_apply_txn(self, payload) -> dict:
-        peer = self._peer(payload["tablet_id"])
-        if not peer.is_leader():
-            raise RpcError("not leader", "LEADER_NOT_READY")
-        await peer.apply_txn(payload["txn_id"], payload["commit_ht"])
+        await self._drive_txn_decision(payload["tablet_id"], "apply_txn",
+                                       payload)
         return {"ok": True}
 
     async def rpc_txn_lock_rows(self, payload) -> dict:
@@ -635,10 +758,8 @@ class TabletServer:
         return {"ok": True}
 
     async def rpc_rollback_txn(self, payload) -> dict:
-        peer = self._peer(payload["tablet_id"])
-        if not peer.is_leader():
-            raise RpcError("not leader", "LEADER_NOT_READY")
-        await peer.rollback_txn(payload["txn_id"])
+        await self._drive_txn_decision(payload["tablet_id"],
+                                       "rollback_txn", payload)
         return {"ok": True}
 
     async def rpc_txn_get(self, payload) -> dict:
@@ -791,6 +912,15 @@ class TabletServer:
                 d = _mp.unpackb(e.payload, raw=False)
                 changes.append({"op": "abort", "txn_id": d["txn_id"],
                                 "index": e.index})
+            elif e.etype == "split":
+                # the write fence guarantees nothing CDC-relevant orders
+                # after this entry: consumers retire the parent stream
+                # here and adopt the children (reference: CDC-through-
+                # split handling, cdcsdk_virtual_wal.cc GetTabletListAnd
+                # CheckOnBootstrap + children checkpoint seeding)
+                d = _mp.unpackb(e.payload, raw=False)
+                changes.append({"op": "split", "index": e.index,
+                                "children": [d["left_id"], d["right_id"]]})
         # xCluster safe time (reference: GetChanges safe_hybrid_time,
         # xcluster_safe_time_service.cc): when the consumer has drained
         # to commit_index, every future commit on this leader gets
