@@ -241,13 +241,16 @@ def main():
         "seconds": dev_s,
     }
 
-    # YCSB workload C (BASELINE config 1): engine-level point reads
+    # YCSB workload C (BASELINE config 1): engine-level point reads.
+    # A short untimed run first: the first few thousand ops pay block-
+    # cache warmup and would dominate a small timed run.
     from yugabyte_db_tpu.models.ycsb import YcsbTabletWorkload, usertable_info
     from yugabyte_db_tpu.tablet import Tablet
     yt = Tablet("ycsb", usertable_info(), tempfile.mkdtemp(prefix="ycsb-"))
     w = YcsbTabletWorkload(yt, n_rows=100_000)
     w.load()
-    rc = w.run("c", ops=int(os.environ.get("BENCH_YCSB_OPS", "2000")))
+    w.run("c", ops=2000)   # warm
+    rc = w.run("c", ops=int(os.environ.get("BENCH_YCSB_OPS", "20000")))
     results["ycsb_c"] = {"ops_per_s": rc.ops_per_sec}
 
     # Vector search micro (BASELINE config 5 at reduced scale by default;

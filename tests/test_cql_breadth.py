@@ -1,0 +1,207 @@
+"""CQL breadth: BATCH frames, password auth, collection types
+(reference: cql_message.cc CQLBatchRequest, cql_processor.cc auth
+handshake, ql/ptree/pt_type.h collection grammar)."""
+import asyncio
+import struct
+
+from yugabyte_db_tpu.ql.cql_server import CqlServer
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+from tests.test_wire_servers import cql_frame, longstr
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def parse_rows(body):
+    """Decode a RESULT Rows frame into (cols, [[bytes|None, ...]])
+    keeping raw cell bytes (callers decode per type)."""
+    (kind,) = struct.unpack(">i", body[:4])
+    assert kind == 2, kind
+    flags, ncols = struct.unpack(">ii", body[4:12])
+    pos = 12
+    if flags & 0x0002:
+        (ln,) = struct.unpack_from(">i", body, pos)
+        pos += 4 + ln
+    # global table spec
+    for _ in range(2):
+        (sl,) = struct.unpack_from(">H", body, pos)
+        pos += 2 + sl
+    cols = []
+    for _ in range(ncols):
+        (sl,) = struct.unpack_from(">H", body, pos)
+        name = body[pos + 2:pos + 2 + sl].decode()
+        pos += 2 + sl
+        (tid,) = struct.unpack_from(">H", body, pos)
+        pos += 2
+        if tid in (0x20, 0x22):      # list/set: element type
+            pos += 2
+        elif tid == 0x21:            # map: key + value types
+            pos += 4
+        cols.append((name, tid))
+    (nrows,) = struct.unpack_from(">i", body, pos)
+    pos += 4
+    rows = []
+    for _ in range(nrows):
+        row = []
+        for _ in range(ncols):
+            (ln,) = struct.unpack_from(">i", body, pos)
+            pos += 4
+            if ln < 0:
+                row.append(None)
+            else:
+                row.append(body[pos:pos + ln])
+                pos += ln
+        rows.append(row)
+    return cols, rows
+
+
+class TestBatch:
+    def test_batch_of_inserts(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            srv = CqlServer(mc.client())
+            addr = await srv.start()
+            try:
+                reader, writer = await asyncio.open_connection(*addr)
+                await cql_frame(writer, reader, 0x01, struct.pack(">H", 0))
+                await cql_frame(writer, reader, 0x07, longstr(
+                    "CREATE TABLE bt (k bigint, v double, "
+                    "PRIMARY KEY (k))"))
+                await mc.wait_for_leaders("bt")
+                # BATCH: 1 query-kind statement + 1 prepared statement
+                op, pbody = await cql_frame(writer, reader, 0x09, (
+                    lambda b: struct.pack(">i", len(b)) + b)(
+                        b"INSERT INTO bt (k, v) VALUES (?, ?)"))
+                assert op == 0x08
+                (plen,) = struct.unpack(">H", pbody[4:6])
+                pid = pbody[6:6 + plen]
+
+                def qstr(s):
+                    b = s.encode()
+                    return (b"\x00" + struct.pack(">i", len(b)) + b
+                            + struct.pack(">H", 0))
+
+                def prep(pid, *vals):
+                    out = b"\x01" + struct.pack(">H", len(pid)) + pid
+                    out += struct.pack(">H", len(vals))
+                    for v in vals:
+                        if isinstance(v, int):
+                            out += struct.pack(">iq", 8, v)
+                        else:
+                            raw = struct.pack(">d", v)
+                            out += struct.pack(">i", 8) + raw
+                    return out
+                body = b"\x00" + struct.pack(">H", 3)
+                body += qstr("INSERT INTO bt (k, v) VALUES (1, 1.5)")
+                body += qstr("INSERT INTO bt (k, v) VALUES (2, 2.5)")
+                body += prep(pid, 3, 3)   # both markers bound
+                body += struct.pack(">H", 0)  # consistency
+                op, rbody = await cql_frame(writer, reader, 0x0D, body)
+                assert op == 0x08, rbody
+                op, body = await cql_frame(
+                    writer, reader, 0x07,
+                    longstr("SELECT k FROM bt"))
+                cols, rows = parse_rows(body)
+                ks = sorted(struct.unpack(">q", r[0])[0] for r in rows)
+                assert ks == [1, 2, 3]
+                writer.close()
+            finally:
+                await srv.shutdown()
+                await mc.shutdown()
+        run(go())
+
+
+class TestAuth:
+    def test_password_handshake(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            srv = CqlServer(mc.client(), auth={"admin": "s3cret"})
+            addr = await srv.start()
+            try:
+                reader, writer = await asyncio.open_connection(*addr)
+                op, body = await cql_frame(writer, reader, 0x01,
+                                           struct.pack(">H", 0))
+                assert op == 0x03          # AUTHENTICATE
+                assert b"PasswordAuthenticator" in body
+                # queries refused before auth
+                op, _ = await cql_frame(writer, reader, 0x07, longstr(
+                    "SELECT * FROM system.local"))
+                assert op == 0x00          # ERROR
+                # wrong password
+                tok = b"\x00admin\x00wrong"
+                op, _ = await cql_frame(
+                    writer, reader, 0x0F,
+                    struct.pack(">i", len(tok)) + tok)
+                assert op == 0x00
+                # right password
+                tok = b"\x00admin\x00s3cret"
+                op, _ = await cql_frame(
+                    writer, reader, 0x0F,
+                    struct.pack(">i", len(tok)) + tok)
+                assert op == 0x10          # AUTH_SUCCESS
+                op, _ = await cql_frame(writer, reader, 0x07, longstr(
+                    "SELECT * FROM system.local"))
+                assert op == 0x08
+                writer.close()
+            finally:
+                await srv.shutdown()
+                await mc.shutdown()
+        run(go())
+
+
+class TestCollections:
+    def test_list_set_map_round_trip(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            srv = CqlServer(mc.client())
+            addr = await srv.start()
+            try:
+                reader, writer = await asyncio.open_connection(*addr)
+                await cql_frame(writer, reader, 0x01, struct.pack(">H", 0))
+                op, _ = await cql_frame(writer, reader, 0x07, longstr(
+                    "CREATE TABLE coll (k bigint, tags set<text>, "
+                    "names list<text>, attrs map<text, bigint>, "
+                    "PRIMARY KEY (k))"))
+                assert op == 0x08
+                await mc.wait_for_leaders("coll")
+                op, body = await cql_frame(writer, reader, 0x07, longstr(
+                    "INSERT INTO coll (k, tags, names, attrs) VALUES "
+                    "(1, {'b', 'a'}, ['x', 'y', 'x'], "
+                    "{'one': 1, 'two': 2})"))
+                assert op == 0x08, body
+                op, body = await cql_frame(writer, reader, 0x07, longstr(
+                    "SELECT tags, names, attrs FROM coll WHERE k = 1"))
+                assert op == 0x08, body
+                cols, rows = parse_rows(body)
+                assert [t for _, t in cols] == [0x22, 0x20, 0x21]
+                tags, names, attrs = rows[0]
+
+                def dec_seq(b):
+                    (n,) = struct.unpack_from(">i", b, 0)
+                    pos, out = 4, []
+                    for _ in range(n):
+                        (ln,) = struct.unpack_from(">i", b, pos)
+                        pos += 4
+                        out.append(b[pos:pos + ln].decode())
+                        pos += ln
+                    return out
+                assert dec_seq(tags) == ["a", "b"]     # set: sorted
+                assert dec_seq(names) == ["x", "y", "x"]
+                (n,) = struct.unpack_from(">i", attrs, 0)
+                pos, d = 4, {}
+                for _ in range(n):
+                    (ln,) = struct.unpack_from(">i", attrs, pos)
+                    pos += 4
+                    key = attrs[pos:pos + ln].decode()
+                    pos += ln
+                    (ln2,) = struct.unpack_from(">i", attrs, pos)
+                    pos += 4
+                    d[key] = struct.unpack_from(">q", attrs, pos)[0]
+                    pos += ln2
+                assert d == {"one": 1, "two": 2}
+                writer.close()
+            finally:
+                await srv.shutdown()
+                await mc.shutdown()
+        run(go())

@@ -1,0 +1,188 @@
+"""Redis command breadth: lists/sets/zsets/INCR-family/string ops,
+TYPE/KEYS/DEL across types (reference: redis command table
+src/yb/yql/redis/redisserver/redis_commands.cc, storage ops
+src/yb/docdb/redis_operation.cc)."""
+import asyncio
+
+from yugabyte_db_tpu.ql.redis_server import RedisServer
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+from tests.test_wire_servers import RedisClient
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _client(tmp_path):
+    mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+    srv = RedisServer(mc.client(), num_tablets=1)
+    addr = await srv.start()
+    reader, writer = await asyncio.open_connection(*addr)
+    return mc, srv, RedisClient(reader, writer), writer
+
+
+class TestStringsDepth:
+    def test_incr_family_and_string_ops(self, tmp_path):
+        async def go():
+            mc, srv, r, w = await _client(tmp_path)
+            try:
+                assert await r.cmd("SET", "n", "10") == "OK"
+                assert await r.cmd("INCRBY", "n", "5") == 15
+                assert await r.cmd("DECR", "n") == 14
+                assert await r.cmd("DECRBY", "n", "4") == 10
+                assert await r.cmd("INCRBYFLOAT", "n", "0.5") == "10.5"
+                # non-numeric INCR errors, value preserved
+                await r.cmd("SET", "s", "abc")
+                try:
+                    await r.cmd("INCR", "s")
+                    assert False, "INCR on non-int should error"
+                except RuntimeError:
+                    pass
+                assert await r.cmd("GET", "s") == "abc"
+                assert await r.cmd("APPEND", "s", "def") == 6
+                assert await r.cmd("STRLEN", "s") == 6
+                assert await r.cmd("GETRANGE", "s", "1", "3") == "bcd"
+                assert await r.cmd("GETRANGE", "s", "-3", "-1") == "def"
+                assert await r.cmd("SETRANGE", "s", "3", "DEF") == 6
+                assert await r.cmd("GET", "s") == "abcDEF"
+                assert await r.cmd("SETNX", "s", "zzz") == 0
+                assert await r.cmd("SETNX", "fresh", "zzz") == 1
+                assert await r.cmd("GETSET", "fresh", "yyy") == "zzz"
+                assert await r.cmd("GET", "fresh") == "yyy"
+            finally:
+                w.close()
+                await srv.shutdown()
+                await mc.shutdown()
+        run(go())
+
+
+class TestHashesDepth:
+    def test_hash_extended(self, tmp_path):
+        async def go():
+            mc, srv, r, w = await _client(tmp_path)
+            try:
+                await r.cmd("HSET", "h", "a", "1", "b", "2", "c", "3")
+                assert await r.cmd("HLEN", "h") == 3
+                assert await r.cmd("HEXISTS", "h", "a") == 1
+                assert await r.cmd("HEXISTS", "h", "zz") == 0
+                assert await r.cmd("HKEYS", "h") == ["a", "b", "c"]
+                assert await r.cmd("HVALS", "h") == ["1", "2", "3"]
+                assert await r.cmd("HMGET", "h", "a", "zz", "c") == \
+                    ["1", None, "3"]
+                assert await r.cmd("HINCRBY", "h", "a", "41") == 42
+            finally:
+                w.close()
+                await srv.shutdown()
+                await mc.shutdown()
+        run(go())
+
+
+class TestSets:
+    def test_set_commands(self, tmp_path):
+        async def go():
+            mc, srv, r, w = await _client(tmp_path)
+            try:
+                assert await r.cmd("SADD", "s", "a", "b", "c") == 3
+                assert await r.cmd("SADD", "s", "b", "d") == 1
+                assert await r.cmd("SCARD", "s") == 4
+                assert await r.cmd("SISMEMBER", "s", "a") == 1
+                assert await r.cmd("SISMEMBER", "s", "zz") == 0
+                assert await r.cmd("SMEMBERS", "s") == \
+                    ["a", "b", "c", "d"]
+                assert await r.cmd("SREM", "s", "a", "zz") == 1
+                assert await r.cmd("SCARD", "s") == 3
+            finally:
+                w.close()
+                await srv.shutdown()
+                await mc.shutdown()
+        run(go())
+
+
+class TestZsets:
+    def test_zset_commands(self, tmp_path):
+        async def go():
+            mc, srv, r, w = await _client(tmp_path)
+            try:
+                assert await r.cmd("ZADD", "z", "3", "c", "1", "a",
+                                   "2", "b") == 3
+                assert await r.cmd("ZCARD", "z") == 3
+                assert await r.cmd("ZSCORE", "z", "b") == "2"
+                assert await r.cmd("ZRANGE", "z", "0", "-1") == \
+                    ["a", "b", "c"]
+                assert await r.cmd("ZREVRANGE", "z", "0", "1") == \
+                    ["c", "b"]
+                assert await r.cmd("ZRANGE", "z", "0", "-1",
+                                   "WITHSCORES") == \
+                    ["a", "1", "b", "2", "c", "3"]
+                assert await r.cmd("ZRANGEBYSCORE", "z", "2", "+inf") == \
+                    ["b", "c"]
+                assert await r.cmd("ZRANGEBYSCORE", "z", "(1", "3") == \
+                    ["b", "c"]
+                assert await r.cmd("ZINCRBY", "z", "10", "a") == "11"
+                assert await r.cmd("ZRANGE", "z", "-1", "-1") == ["a"]
+                assert await r.cmd("ZREM", "z", "a", "zz") == 1
+                assert await r.cmd("ZCARD", "z") == 2
+                # update score of existing member: not a new element
+                assert await r.cmd("ZADD", "z", "9", "b") == 0
+                assert await r.cmd("ZSCORE", "z", "b") == "9"
+            finally:
+                w.close()
+                await srv.shutdown()
+                await mc.shutdown()
+        run(go())
+
+
+class TestLists:
+    def test_list_commands(self, tmp_path):
+        async def go():
+            mc, srv, r, w = await _client(tmp_path)
+            try:
+                assert await r.cmd("RPUSH", "l", "b", "c") == 2
+                assert await r.cmd("LPUSH", "l", "a") == 3
+                assert await r.cmd("LLEN", "l") == 3
+                assert await r.cmd("LRANGE", "l", "0", "-1") == \
+                    ["a", "b", "c"]
+                assert await r.cmd("LRANGE", "l", "1", "2") == ["b", "c"]
+                assert await r.cmd("LINDEX", "l", "0") == "a"
+                assert await r.cmd("LINDEX", "l", "-1") == "c"
+                assert await r.cmd("LSET", "l", "1", "B") == "OK"
+                assert await r.cmd("LPOP", "l") == "a"
+                assert await r.cmd("RPOP", "l") == "c"
+                assert await r.cmd("LRANGE", "l", "0", "-1") == ["B"]
+                assert await r.cmd("LPOP", "empty") is None
+            finally:
+                w.close()
+                await srv.shutdown()
+                await mc.shutdown()
+        run(go())
+
+
+class TestCrossType:
+    def test_type_keys_del_exists(self, tmp_path):
+        async def go():
+            mc, srv, r, w = await _client(tmp_path)
+            try:
+                await r.cmd("SET", "str1", "v")
+                await r.cmd("HSET", "h1", "f", "v")
+                await r.cmd("SADD", "set1", "m")
+                await r.cmd("ZADD", "z1", "1", "m")
+                await r.cmd("RPUSH", "l1", "v")
+                assert await r.cmd("TYPE", "str1") == "string"
+                assert await r.cmd("TYPE", "h1") == "hash"
+                assert await r.cmd("TYPE", "set1") == "set"
+                assert await r.cmd("TYPE", "z1") == "zset"
+                assert await r.cmd("TYPE", "l1") == "list"
+                assert await r.cmd("TYPE", "nope") == "none"
+                assert await r.cmd("EXISTS", "str1", "h1", "set1",
+                                   "z1", "l1", "nope") == 5
+                ks = await r.cmd("KEYS", "*1")
+                assert sorted(ks) == ["h1", "l1", "set1", "str1", "z1"]
+                # DEL works on every type
+                assert await r.cmd("DEL", "h1", "l1", "nope") == 2
+                assert await r.cmd("TYPE", "h1") == "none"
+                assert await r.cmd("LLEN", "l1") == 0
+            finally:
+                w.close()
+                await srv.shutdown()
+                await mc.shutdown()
+        run(go())

@@ -346,20 +346,19 @@ class VirtualWal:
         # could re-deliver the txn's provisional ops WITHOUT their
         # markers — which (same-log ordering: ops precede markers)
         # happens only via a retired parent whose restart position is
-        # still below its split marker. Commit decisions additionally
-        # release once the confirmed LSN is past their commit record.
+        # still below its split marker. While ANY such replay region
+        # exists, every decision stays: even a confirmed txn's intents
+        # can sit above another txn's held-back position and replay
+        # without them would re-buffer the txn undecidably, freezing
+        # the watermark.
         replay_region = any(
             s.get("retired")
             and s["checkpoint"] < s.get("split_index", 0)
             for s in state.values())
-        for key, dec in list(self._decisions.items()):
-            if key in self._txns:
-                continue                 # ops buffered: still needed
-            confirmed_past = (
-                dec[0] is not None
-                and tuple([dec[0], key]) < tuple(self.confirmed_lsn[:2]))
-            if confirmed_past or not replay_region:
-                del self._decisions[key]
+        if not replay_region:
+            for key in list(self._decisions):
+                if key not in self._txns:
+                    del self._decisions[key]
         await self.client._master_call(
             "update_replication_slot",
             {"slot_id": self.slot_id, "state": state,

@@ -192,34 +192,48 @@ def _compact_columnar(store, codec, blocks: List[ColumnarBlock],
     key_hash = np.concatenate([b.key_hash for b in blocks])
     sv = blocks[0].schema_version
 
-    # varlen gather: per column, rebuild (ends, heap) for selected rows
-    def gather_varlen(cid, sel_idx):
-        parts_ends, parts_heap, parts_null = [], [], []
-        offset = 0
-        ends_all, heaps, null_all, starts_all = [], [], [], []
-        row_src = []
-        base = 0
+    # varlen gather: per column, rebuild (ends, heap) for selected rows.
+    # Fully vectorized: per-block heaps concatenate once into a global
+    # byte array with rebased start/end offsets; the output heap is one
+    # fancy-index gather (repeat-offsets trick), no per-row loop.
+    varlen_cat = {}
+
+    def _cat_varlen(cid):
+        if cid in varlen_cat:
+            return varlen_cat[cid]
+        starts_all, ends_all, null_all, heaps = [], [], [], []
+        heap_base = 0
         for b in blocks:
             ends, heap, null = b.varlen[cid]
-            starts = np.concatenate([[0], ends[:-1]]).astype(np.int64)
-            ends_all.append(ends.astype(np.int64))
-            starts_all.append(starts)
+            ends = ends.astype(np.int64)
+            starts = np.concatenate([[0], ends[:-1]])
+            starts_all.append(starts + heap_base)
+            ends_all.append(ends + heap_base)
             null_all.append(null)
             heaps.append(heap)
-            row_src.append(np.full(b.n, len(heaps) - 1, np.int32))
-            base += b.n
-        ends_c = np.concatenate(ends_all)
-        starts_c = np.concatenate(starts_all)
-        null_c = np.concatenate(null_all)
-        src_c = np.concatenate(row_src)
-        out_heap = bytearray()
-        out_ends = np.zeros(len(sel_idx), np.uint32)
+            heap_base += len(heap)
+        cat = (np.concatenate(starts_all), np.concatenate(ends_all),
+               np.concatenate(null_all),
+               np.frombuffer(b"".join(heaps), np.uint8))
+        varlen_cat[cid] = cat
+        return cat
+
+    def gather_varlen(cid, sel_idx):
+        starts_c, ends_c, null_c, heap_c = _cat_varlen(cid)
         out_null = null_c[sel_idx]
-        for j, i in enumerate(sel_idx):
-            if not out_null[j]:
-                out_heap += heaps[src_c[i]][starts_c[i]:ends_c[i]]
-            out_ends[j] = len(out_heap)
-        return out_ends, bytes(out_heap), out_null
+        s = starts_c[sel_idx]
+        lens = np.where(out_null, 0, ends_c[sel_idx] - s)
+        out_ends = np.cumsum(lens, dtype=np.int64)
+        total = int(out_ends[-1]) if len(out_ends) else 0
+        if total == 0:
+            return out_ends.astype(np.uint32), b"", out_null
+        out_starts = out_ends - lens
+        # index i of the output maps to heap position:
+        #   src_start[row(i)] + (i - out_start[row(i)])
+        idx = (np.repeat(s, lens)
+               + np.arange(total, dtype=np.int64)
+               - np.repeat(out_starts, lens))
+        return out_ends.astype(np.uint32), heap_c[idx].tobytes(), out_null
 
     # concatenate each column ONCE; chunks below only gather
     fixed_cat = {cid: cat_fixed(cid) for cid in fixed_ids}

@@ -3,15 +3,17 @@
 Analog of the reference's CQL server (reference:
 src/yb/yql/cql/cqlserver/cql_server.cc, cql_processor.cc:244
 ProcessCall; frame handling in cqlserver/cql_message.cc). Implements the
-v4 wire framing and the STARTUP/OPTIONS/QUERY/PREPARE/EXECUTE opcodes,
-executing statements through the same SQL front end (the reference's
-QLProcessor parse/analyze/execute pipeline, ql/ql_processor.cc:449).
-Real Cassandra drivers can speak this subset (no auth, no compression,
-no paging frames yet).
+v4 wire framing and the STARTUP/OPTIONS/QUERY/PREPARE/EXECUTE/BATCH
+opcodes plus password authentication, executing statements through the
+same SQL front end (the reference's QLProcessor parse/analyze/execute
+pipeline, ql/ql_processor.cc:449). Collections (list/set/map — the
+reference's pt_type.h CQL types) store as JSON documents and are
+encoded with their proper CQL wire type ids on results.
 """
 from __future__ import annotations
 
 import asyncio
+import json as _json
 import struct
 from typing import Dict, List, Optional, Tuple
 
@@ -23,6 +25,7 @@ from .executor import SqlSession
 OP_ERROR, OP_STARTUP, OP_READY, OP_AUTHENTICATE = 0x00, 0x01, 0x02, 0x03
 OP_OPTIONS, OP_SUPPORTED, OP_QUERY, OP_RESULT = 0x05, 0x06, 0x07, 0x08
 OP_PREPARE, OP_EXECUTE = 0x09, 0x0A
+OP_BATCH, OP_AUTH_RESPONSE, OP_AUTH_SUCCESS = 0x0D, 0x0F, 0x10
 
 # result kinds
 K_VOID, K_ROWS, K_SET_KS, K_PREPARED, K_SCHEMA = 1, 2, 3, 4, 5
@@ -39,6 +42,175 @@ _CQL_TYPE = {
 def _string(s: str) -> bytes:
     b = s.encode()
     return struct.pack(">H", len(b)) + b
+
+
+def _parse_cql_collection(span: str):
+    """Parse one CQL collection literal span into Python (list for
+    list/set, dict for map). Raises ValueError on non-collection
+    brackets (e.g. a vector literal inside a string already skipped)."""
+    s = span.strip()
+    pos = [0]
+
+    def skip_ws(t):
+        while pos[0] < len(t) and t[pos[0]].isspace():
+            pos[0] += 1
+
+    def value(t):
+        skip_ws(t)
+        c = t[pos[0]]
+        if c == "'":
+            pos[0] += 1
+            out = []
+            while pos[0] < len(t):
+                if t[pos[0]] == "'":
+                    if pos[0] + 1 < len(t) and t[pos[0] + 1] == "'":
+                        out.append("'")
+                        pos[0] += 2
+                        continue
+                    pos[0] += 1
+                    return "".join(out)
+                out.append(t[pos[0]])
+                pos[0] += 1
+            raise ValueError("unterminated string")
+        if c == "[":
+            pos[0] += 1
+            items = []
+            skip_ws(t)
+            if t[pos[0]] == "]":
+                pos[0] += 1
+                return items
+            while True:
+                items.append(value(t))
+                skip_ws(t)
+                if t[pos[0]] == ",":
+                    pos[0] += 1
+                    continue
+                if t[pos[0]] == "]":
+                    pos[0] += 1
+                    return items
+                raise ValueError("bad list literal")
+        if c == "{":
+            pos[0] += 1
+            skip_ws(t)
+            if t[pos[0]] == "}":
+                pos[0] += 1
+                return []                # empty set
+            first = value(t)
+            skip_ws(t)
+            if t[pos[0]] == ":":         # map
+                pos[0] += 1
+                d = {str(first): value(t)}
+                while True:
+                    skip_ws(t)
+                    if t[pos[0]] == "}":
+                        pos[0] += 1
+                        return d
+                    if t[pos[0]] != ",":
+                        raise ValueError("bad map literal")
+                    pos[0] += 1
+                    k = value(t)
+                    skip_ws(t)
+                    if t[pos[0]] != ":":
+                        raise ValueError("bad map literal")
+                    pos[0] += 1
+                    d[str(k)] = value(t)
+            items = [first]              # set: stored as sorted list
+            while True:
+                skip_ws(t)
+                if t[pos[0]] == "}":
+                    pos[0] += 1
+                    return sorted(items, key=str)
+                if t[pos[0]] != ",":
+                    raise ValueError("bad set literal")
+                pos[0] += 1
+                items.append(value(t))
+        # number / bare token
+        j = pos[0]
+        while j < len(t) and t[j] not in ",]}:":
+            j += 1
+        tok = t[pos[0]:j].strip()
+        pos[0] = j
+        if not tok:
+            raise ValueError("empty element")
+        try:
+            return int(tok)
+        except ValueError:
+            try:
+                return float(tok)
+            except ValueError:
+                if tok.lower() in ("true", "false"):
+                    return tok.lower() == "true"
+                raise ValueError(f"bad literal {tok!r}") from None
+
+    v = value(s)
+    skip_ws(s)
+    if pos[0] != len(s):
+        raise ValueError("trailing data in collection literal")
+    if not isinstance(v, (list, dict)):
+        raise ValueError("not a collection")
+    return v
+
+
+# element CQL type name -> (wire type id, encoder)
+def _enc_text(v) -> bytes:
+    b = str(v).encode()
+    return struct.pack(">i", len(b)) + b
+
+
+def _enc_bigint(v) -> bytes:
+    return struct.pack(">iq", 8, int(v))
+
+
+def _enc_int(v) -> bytes:
+    return struct.pack(">ii", 4, int(v))
+
+
+def _enc_double(v) -> bytes:
+    return struct.pack(">id", 8, float(v))
+
+
+def _enc_bool(v) -> bytes:
+    return struct.pack(">i", 1) + (b"\x01" if v else b"\x00")
+
+
+_ELEM_TYPES = {
+    "text": (0x0D, _enc_text), "varchar": (0x0D, _enc_text),
+    "bigint": (0x02, _enc_bigint), "int": (0x09, _enc_int),
+    "double": (0x07, _enc_double), "float": (0x07, _enc_double),
+    "boolean": (0x04, _enc_bool),
+}
+
+
+def _collection_wire(ctype: str):
+    """'list<text>' -> (metadata bytes after the option id prefix is
+    handled by caller, encoder(value)->bytes). Caller writes the outer
+    option id; we return (option_bytes, value_encoder)."""
+    kind, inner = ctype.split("<", 1)
+    inner = inner.rstrip(">")
+    if kind == "map":
+        kt, vt = (p.strip() for p in inner.split(",", 1))
+        kid, kenc = _ELEM_TYPES.get(kt, _ELEM_TYPES["text"])
+        vid, venc = _ELEM_TYPES.get(vt, _ELEM_TYPES["text"])
+        meta = struct.pack(">HHH", 0x21, kid, vid)
+
+        def enc_map(v) -> bytes:
+            d = _json.loads(v) if isinstance(v, str) else v
+            body = struct.pack(">i", len(d))
+            for k in sorted(d):
+                body += kenc(k) + venc(d[k])
+            return struct.pack(">i", len(body)) + body
+        return meta, enc_map
+    tid = 0x20 if kind == "list" else 0x22
+    eid, eenc = _ELEM_TYPES.get(inner.strip(), _ELEM_TYPES["text"])
+    meta = struct.pack(">HH", tid, eid)
+
+    def enc_seq(v) -> bytes:
+        items = _json.loads(v) if isinstance(v, str) else v
+        body = struct.pack(">i", len(items))
+        for it in items:
+            body += eenc(it)
+        return struct.pack(">i", len(body)) + body
+    return meta, enc_seq
 
 
 def _bytes_value(v, ctype: Optional[str]) -> bytes:
@@ -60,13 +232,23 @@ def _bytes_value(v, ctype: Optional[str]) -> bytes:
 
 
 class CqlServer:
-    def __init__(self, client: YBClient, host="127.0.0.1", port=0):
+    def __init__(self, client: YBClient, host="127.0.0.1", port=0,
+                 auth: Optional[Dict[str, str]] = None):
+        """auth: user -> password; when set, the v4 SASL PLAIN
+        handshake is required before any statement (reference:
+        cql_processor.cc ProcessAuthResult /
+        PasswordAuthenticator)."""
         self.session = SqlSession(client)
         self.host, self.port = host, port
+        self.auth = auth
         self._server: Optional[asyncio.AbstractServer] = None
         self._prepared: Dict[bytes, str] = {}
         self._next_prep = 0
         self.addr: Optional[Tuple[str, int]] = None
+        # (table, column) -> full CQL collection type ("list<text>")
+        # learned from CREATE TABLE statements through this server;
+        # value-shape inference fills in after a server restart
+        self._coll_types: Dict[Tuple[str, str], str] = {}
 
     async def start(self):
         self._server = await asyncio.start_server(
@@ -80,6 +262,7 @@ class CqlServer:
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter):
+        conn = {"authed": self.auth is None}
         try:
             while True:
                 hdr = await reader.readexactly(9)
@@ -87,7 +270,7 @@ class CqlServer:
                                                                hdr[:5])
                 (length,) = struct.unpack(">I", hdr[5:9])
                 body = await reader.readexactly(length) if length else b""
-                resp = await self._process(opcode, body)
+                resp = await self._process(opcode, body, conn)
                 out_op, out_body = resp
                 writer.write(struct.pack(">BBhBI", 0x84, 0, stream, out_op,
                                          len(out_body)) + out_body)
@@ -100,11 +283,29 @@ class CqlServer:
             except Exception:
                 pass
 
-    async def _process(self, opcode: int, body: bytes
+    async def _process(self, opcode: int, body: bytes, conn: dict
                        ) -> Tuple[int, bytes]:
         try:
             if opcode == OP_STARTUP:
+                if self.auth is not None and not conn["authed"]:
+                    return OP_AUTHENTICATE, _string(
+                        "org.apache.cassandra.auth.PasswordAuthenticator")
                 return OP_READY, b""
+            if opcode == OP_AUTH_RESPONSE:
+                # SASL PLAIN token: \0user\0password
+                (n,) = struct.unpack(">i", body[:4])
+                token = body[4:4 + n] if n > 0 else b""
+                parts = token.split(b"\x00")
+                user = parts[1].decode() if len(parts) > 1 else ""
+                pw = parts[2].decode() if len(parts) > 2 else ""
+                if self.auth is not None and \
+                        self.auth.get(user) == pw and pw != "":
+                    conn["authed"] = True
+                    return OP_AUTH_SUCCESS, struct.pack(">i", -1)
+                return self._error(
+                    0x0100, f"bad credentials for '{user}'")
+            if not conn["authed"] and opcode not in (OP_OPTIONS,):
+                return self._error(0x0100, "authentication required")
             if opcode == OP_OPTIONS:
                 # string multimap: CQL_VERSION -> 3.4.5
                 out = struct.pack(">H", 1) + _string("CQL_VERSION") + \
@@ -138,6 +339,8 @@ class CqlServer:
                 if values:
                     sql = self._bind_qmarks(sql, values)
                 return OP_RESULT, await self._run(sql)
+            if opcode == OP_BATCH:
+                return OP_RESULT, await self._batch(body)
             return self._error(0x000A, f"unsupported opcode {opcode}")
         except Exception as e:   # noqa: BLE001 — surface as CQL error frame
             return self._error(0x2200, str(e))
@@ -145,11 +348,65 @@ class CqlServer:
     def _error(self, code: int, msg: str) -> Tuple[int, bytes]:
         return OP_ERROR, struct.pack(">i", code) + _string(msg)
 
+    async def _batch(self, body: bytes) -> bytes:
+        """BATCH frame (reference: cql_message.cc CQLBatchRequest):
+        <type><n:short> then per statement kind 0 (query string) or 1
+        (prepared id), each with bound values. Statements execute in
+        order through the SQL layer; DML-only like the reference."""
+        pos = 1                          # batch type (logged/unlogged)
+        (n,) = struct.unpack_from(">H", body, pos)
+        pos += 2
+        for _ in range(n):
+            kind = body[pos]
+            pos += 1
+            if kind == 0:
+                (qlen,) = struct.unpack_from(">i", body, pos)
+                pos += 4
+                sql = body[pos:pos + qlen].decode()
+                pos += qlen
+            else:
+                (plen,) = struct.unpack_from(">H", body, pos)
+                pos += 2
+                sql = self._prepared.get(body[pos:pos + plen])
+                pos += plen
+                if sql is None:
+                    raise ValueError("unprepared statement in batch")
+            (nv,) = struct.unpack_from(">H", body, pos)
+            pos += 2
+            values = []
+            for _ in range(nv):
+                v, pos = self._decode_value(body, pos)
+                values.append(v)
+            if values:
+                sql = self._bind_qmarks(sql, values)
+            await self._run(sql)
+        return struct.pack(">i", K_VOID)
+
     @staticmethod
-    def _execute_values(body: bytes, pos: int):
+    def _decode_value(body: bytes, pos: int):
+        """One [bytes] bound value -> (python value, new pos). Types
+        are heuristic — we advertise no bind metadata, so 8 bytes reads
+        as bigint, 4 as int, else utf8 text (shared by EXECUTE and
+        BATCH so the two can never drift)."""
+        (ln,) = struct.unpack_from(">i", body, pos)
+        pos += 4
+        if ln < 0:
+            return None, pos
+        raw = body[pos:pos + ln]
+        pos += ln
+        if ln == 8:
+            return struct.unpack(">q", raw)[0], pos
+        if ln == 4:
+            return struct.unpack(">i", raw)[0], pos
+        try:
+            return raw.decode(), pos
+        except UnicodeDecodeError:
+            return raw.hex(), pos
+
+    @classmethod
+    def _execute_values(cls, body: bytes, pos: int):
         """Bound values from an EXECUTE body (consistency + flags +
-        values). Types are heuristic — we advertise no bind metadata, so
-        we decode 8 bytes as bigint, 4 as int, else utf8 text."""
+        values), decoded via the shared heuristic in _decode_value."""
         try:
             pos += 2                    # consistency
             flags_ = body[pos]
@@ -160,22 +417,8 @@ class CqlServer:
             pos += 2
             out = []
             for _ in range(n):
-                (ln,) = struct.unpack_from(">i", body, pos)
-                pos += 4
-                if ln < 0:
-                    out.append(None)
-                    continue
-                raw = body[pos:pos + ln]
-                pos += ln
-                if ln == 8:
-                    out.append(struct.unpack(">q", raw)[0])
-                elif ln == 4:
-                    out.append(struct.unpack(">i", raw)[0])
-                else:
-                    try:
-                        out.append(raw.decode())
-                    except UnicodeDecodeError:
-                        out.append(raw.hex())
+                v, pos = cls._decode_value(body, pos)
+                out.append(v)
             return out
         except (struct.error, IndexError):
             return []
@@ -294,6 +537,72 @@ class CqlServer:
             return out
         return []   # unknown vtable (e.g. .types): empty result set
 
+    def _learn_collections(self, sql: str) -> None:
+        """Remember collection-typed columns from CREATE TABLE so
+        results encode them with real CQL collection type ids."""
+        import re as _re
+        m = _re.match(r"\s*create\s+table\s+(?:if\s+not\s+exists\s+)?"
+                      r"(\w+)", sql, _re.I)
+        if not m:
+            return
+        table = m.group(1)
+        for cm in _re.finditer(
+                r"(\w+)\s+((?:list|set|map)\s*<[^>]+>)", sql, _re.I):
+            ctype = _re.sub(r"\s+", "", cm.group(2).lower())
+            self._coll_types[(table, cm.group(1))] = ctype
+
+    @staticmethod
+    def _rewrite_collection_literals(sql: str) -> str:
+        """CQL collection literals -> JSON text literals the SQL layer
+        stores in the JSON column: ['a','b'] / {'a','b'} (set) /
+        {'k': 'v'} (map) become '["a","b"]' / '{"k": "v"}'."""
+        out = []
+        i, n = 0, len(sql)
+        while i < n:
+            ch = sql[i]
+            if ch == "'":                      # skip string literals
+                j = i + 1
+                while j < n:
+                    if sql[j] == "'" and j + 1 < n and sql[j + 1] == "'":
+                        j += 2
+                        continue
+                    if sql[j] == "'":
+                        break
+                    j += 1
+                out.append(sql[i:j + 1])
+                i = j + 1
+                continue
+            if ch in "[{":
+                close = {"[": "]", "{": "}"}[ch]
+                depth = 0
+                j = i
+                in_s = False
+                while j < n:
+                    c = sql[j]
+                    if in_s:
+                        in_s = c != "'"
+                    elif c == "'":
+                        in_s = True
+                    elif c in "[{":
+                        depth += 1
+                    elif c in "]}":
+                        depth -= 1
+                        if depth == 0:
+                            break
+                    j += 1
+                span = sql[i:j + 1]
+                try:
+                    out.append("'" + _json.dumps(
+                        _parse_cql_collection(span)).replace("'", "''")
+                        + "'")
+                except ValueError:
+                    out.append(span)
+                i = j + 1
+                continue
+            out.append(ch)
+            i += 1
+        return "".join(out)
+
     async def _run(self, sql: str, page_size=None,
                    paging_state=None) -> bytes:
         sys_rows = self._system_rows(sql)
@@ -301,6 +610,12 @@ class CqlServer:
             sys_rows = await self._system_schema_rows(sql)
         if sys_rows is not None:
             return self._rows_result(sys_rows)
+        self._learn_collections(sql)
+        if "[" in sql or "{" in sql:
+            sql = self._rewrite_collection_literals(sql)
+        import re as _re
+        tm = _re.search(r"\bfrom\s+(\w+)", sql, _re.I)
+        table = tm.group(1) if tm else None
         res = await self.session.execute(sql)
         if not res.rows:
             if res.status.startswith(("CREATE", "DROP")):
@@ -317,9 +632,10 @@ class CqlServer:
             if start + page_size < len(rows):
                 next_state = str(start + page_size).encode()
             rows = page
-        return self._rows_result(rows, next_state)
+        return self._rows_result(rows, next_state, table)
 
-    def _rows_result(self, rows, paging_state: bytes = None) -> bytes:
+    def _rows_result(self, rows, paging_state: bytes = None,
+                     table: Optional[str] = None) -> bytes:
         cols = list(rows[0].keys()) if rows else []
         body = struct.pack(">i", K_ROWS)
         flags_ = 0x0001 | (0x0002 if paging_state is not None else 0)
@@ -329,8 +645,15 @@ class CqlServer:
             body += struct.pack(">i", len(paging_state)) + paging_state
         body += _string("ybtpu") + _string("t")
         sample = rows[0] if rows else {}
+        encoders = {}
         for c in cols:
             body += _string(c)
+            ctype = self._coll_types.get((table, c)) if table else None
+            if ctype:
+                meta, enc = _collection_wire(ctype)
+                body += meta
+                encoders[c] = enc
+                continue
             v = sample.get(c)
             tid = 0x0D
             if isinstance(v, bool):
@@ -345,5 +668,9 @@ class CqlServer:
         body += struct.pack(">i", len(rows))
         for r in rows:
             for c in cols:
-                body += _bytes_value(r[c], None)
+                enc = encoders.get(c)
+                if enc is not None and r[c] is not None:
+                    body += enc(r[c])
+                else:
+                    body += _bytes_value(r[c], None)
         return body

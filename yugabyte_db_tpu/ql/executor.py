@@ -48,6 +48,16 @@ _TYPE_MAP = {
 }
 
 
+def resolve_type(typ: str):
+    """Column-type name -> storage ColumnType. CQL collections
+    (list<..>/set<..>/map<..>) store as JSON documents — the wire layer
+    (cql_server) owns their element typing (reference: collection
+    subdocuments in dockv; ours ride the JSON column path)."""
+    if typ.split("<", 1)[0] in ("list", "set", "map"):
+        return ColumnType.JSON
+    return _TYPE_MAP.get(typ)
+
+
 def parse_vector(text) -> "np.ndarray":
     if isinstance(text, (list, tuple)):
         return np.asarray(text, np.float32)
@@ -96,7 +106,7 @@ class SqlSession:
         if isinstance(stmt, AlterTableStmt):
             adds = []
             for cname, ctype in stmt.add_columns:
-                ct = _TYPE_MAP.get(ctype)
+                ct = resolve_type(ctype)
                 if ct is None:
                     raise ValueError(f"unknown type {ctype}")
                 adds.append((cname, ct))
@@ -333,7 +343,7 @@ class SqlSession:
         pk = stmt.primary_key
         range_sharded = getattr(stmt, "range_sharded", False)
         for i, (name, typ) in enumerate(stmt.columns):
-            ct = _TYPE_MAP.get(typ)
+            ct = resolve_type(typ)
             if ct is None:
                 raise ValueError(f"unknown type {typ}")
             cols.append(ColumnSchema(

@@ -316,10 +316,7 @@ class Parser:
                 pk = pk_cols
             else:
                 cname = self.ident()
-                ctype = self.ident().lower()
-                if self.accept_op("("):      # e.g. vector(768), varchar(32)
-                    self.next()              # dims/length (advisory)
-                    self.expect_op(")")
+                ctype = self._column_type()
                 cols.append((cname, ctype))
                 if self.accept_kw("primary"):
                     self.expect_kw("key")
@@ -340,6 +337,29 @@ class Parser:
             raise ValueError("PRIMARY KEY required")
         return CreateTableStmt(name, cols, pk, range_sharded, pk_desc,
                                num_hash, num_tablets, rf, ine)
+
+    def _column_type(self) -> str:
+        """One column type: plain (`bigint`), parameterized
+        (`vector(768)`, `varchar(32)` — parameter advisory), or a CQL
+        collection (`list<text>`, `set<bigint>`, `map<text, double>`,
+        `frozen<...>` — reference: ql/ptree/pt_type.h CQL type
+        grammar). Collections come back as one normalized string the
+        executor maps onto JSON storage."""
+        ctype = self.ident().lower()
+        if ctype == "frozen" and self.accept_op("<"):
+            inner = self._column_type()
+            self.expect_op(">")
+            return inner               # frozen<> is a storage hint
+        if ctype in ("list", "set", "map") and self.accept_op("<"):
+            inner = [self._column_type()]
+            while self.accept_op(","):
+                inner.append(self._column_type())
+            self.expect_op(">")
+            return f"{ctype}<{','.join(inner)}>"
+        if self.accept_op("("):        # e.g. vector(768), varchar(32)
+            self.next()                # dims/length (advisory)
+            self.expect_op(")")
+        return ctype
 
     def _create_index(self):
         name = self.ident()
@@ -368,11 +388,7 @@ class Parser:
             if self.accept_kw("add"):
                 self.accept_kw("column")
                 cname = self.ident()
-                ctype = self.ident().lower()
-                if self.accept_op("("):
-                    self.next()
-                    self.expect_op(")")
-                adds.append((cname, ctype))
+                adds.append((cname, self._column_type()))
             elif self.accept_kw("drop"):
                 self.accept_kw("column")
                 drops.append(self.ident())

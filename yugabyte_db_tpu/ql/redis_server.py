@@ -3,10 +3,22 @@
 Analog of the reference's Redis server over DocDB (reference:
 src/yb/yql/redis/redisserver/redis_service.cc, command table
 redis_commands.cc, parser redis_parser.cc; storage ops
-src/yb/docdb/redis_operation.cc). String and hash commands map to two
-system tables — redis_kv(k PK, v) and redis_hash(k hash PK, f range PK,
-v) — written through the normal tablet write path, so Redis data gets
-the same replication/MVCC/compaction machinery as SQL rows.
+src/yb/docdb/redis_operation.cc). Each Redis type maps to a system
+table written through the normal tablet write path, so Redis data gets
+the same replication/MVCC/compaction machinery as SQL rows:
+
+  strings -> redis_kv(k PK, v, expire_at)
+  hashes  -> redis_hash(k hash PK, f range PK, v)
+  sets    -> redis_set(k hash PK, m range PK)
+  zsets   -> redis_zset(k hash PK, m range PK, score)
+  lists   -> redis_list(k hash PK, seq range PK, v) — LPUSH allocates
+             decreasing seq, RPUSH increasing (the reference stores
+             lists the same way: subdoc index keys,
+             redis_operation.cc list append/prepend)
+
+Read-modify-write commands (INCR, LPUSH, SETRANGE, ...) are
+last-writer-wins under concurrency, like the reference's default
+(non-transactional) Redis path.
 """
 from __future__ import annotations
 
@@ -45,6 +57,45 @@ def _hash_info():
     ), version=1), PartitionSchema("hash", 1))
 
 
+def _set_info():
+    return TableInfo("", "system.redis_set", TableSchema(columns=(
+        ColumnSchema(0, "k", ColumnType.STRING, is_hash_key=True),
+        ColumnSchema(1, "m", ColumnType.STRING, is_range_key=True),
+    ), version=1), PartitionSchema("hash", 1))
+
+
+def _zset_info():
+    return TableInfo("", "system.redis_zset", TableSchema(columns=(
+        ColumnSchema(0, "k", ColumnType.STRING, is_hash_key=True),
+        ColumnSchema(1, "m", ColumnType.STRING, is_range_key=True),
+        ColumnSchema(2, "score", ColumnType.FLOAT64),
+    ), version=1), PartitionSchema("hash", 1))
+
+
+def _list_info():
+    return TableInfo("", "system.redis_list", TableSchema(columns=(
+        ColumnSchema(0, "k", ColumnType.STRING, is_hash_key=True),
+        ColumnSchema(1, "seq", ColumnType.FLOAT64, is_range_key=True),
+        ColumnSchema(2, "v", ColumnType.STRING),
+    ), version=1), PartitionSchema("hash", 1))
+
+
+def _fmt_score(s: float) -> str:
+    return str(int(s)) if s == int(s) else format(s, ".17g")
+
+
+def _parse_bound(s: str):
+    """ZRANGEBYSCORE bound: number, (number (exclusive), -inf/+inf."""
+    excl = s.startswith("(")
+    if excl:
+        s = s[1:]
+    if s in ("-inf", "+inf", "inf"):
+        v = float("-inf") if s == "-inf" else float("inf")
+    else:
+        v = float(s)
+    return v, excl
+
+
 class RedisServer:
     def __init__(self, client: YBClient, host="127.0.0.1", port=0,
                  num_tablets: int = 2):
@@ -65,7 +116,8 @@ class RedisServer:
         if self._ready:
             return
         names = {t["name"] for t in await self.client.list_tables()}
-        for info in (_kv_info(), _hash_info()):
+        for info in (_kv_info(), _hash_info(), _set_info(), _zset_info(),
+                     _list_info()):
             if info.name not in names:
                 await self.client.create_table(info,
                                                num_tablets=self.num_tablets)
@@ -171,6 +223,46 @@ class RedisServer:
             return None
         return row
 
+    async def _rows_for(self, table: str, key: str) -> List[dict]:
+        """All rows of one Redis object (eq-scan on the hash key)."""
+        resp = await self.client.scan(table, ReadRequest(
+            "", where=("cmp", "eq", ("col", 0), ("const", key))))
+        return resp.rows
+
+    async def _type_of(self, key: str) -> Optional[str]:
+        if await self._get_kv(key) is not None:
+            return "string"
+        for table, t in (("system.redis_hash", "hash"),
+                         ("system.redis_list", "list"),
+                         ("system.redis_set", "set"),
+                         ("system.redis_zset", "zset")):
+            if await self._rows_for(table, key):
+                return t
+        return None
+
+    async def _del_key(self, key: str) -> bool:
+        """Delete `key` whatever its type; True if anything existed."""
+        c = self.client
+        found = False
+        if await self._get_kv(key) is not None:
+            await c.delete("system.redis_kv", [{"k": key}])
+            found = True
+        for table, rk in (("system.redis_hash", "f"),
+                          ("system.redis_set", "m"),
+                          ("system.redis_zset", "m"),
+                          ("system.redis_list", "seq")):
+            rows = await self._rows_for(table, key)
+            if rows:
+                await c.delete(table, [{"k": key, rk: r[rk]}
+                                       for r in rows])
+                found = True
+        return found
+
+    async def _list_rows(self, key: str) -> List[dict]:
+        rows = await self._rows_for("system.redis_list", key)
+        rows.sort(key=lambda r: r["seq"])
+        return rows
+
     async def _dispatch(self, cmd: str, args: List[str]) -> bytes:
         c = self.client
         if cmd == "PING":
@@ -202,27 +294,110 @@ class RedisServer:
         if cmd in ("DEL", "UNLINK"):
             n = 0
             for k in args:
-                if await self._get_kv(k) is not None:
-                    await c.delete("system.redis_kv", [{"k": k}])
+                if await self._del_key(k):
                     n += 1
             return self._int(n)
         if cmd == "EXISTS":
             n = 0
             for k in args:
-                if await self._get_kv(k) is not None:
+                if await self._type_of(k) is not None:
                     n += 1
             return self._int(n)
+        if cmd == "TYPE":
+            return self._simple(await self._type_of(args[0]) or "none")
+        if cmd == "KEYS":
+            import fnmatch
+            keys = set()
+            now = time.time()
+            resp = await c.scan("system.redis_kv", ReadRequest(
+                "", columns=("k", "expire_at")))
+            keys.update(r["k"] for r in resp.rows
+                        if not (r.get("expire_at")
+                                and r["expire_at"] <= now))
+            for table in ("system.redis_hash", "system.redis_set",
+                          "system.redis_zset", "system.redis_list"):
+                resp = await c.scan(table, ReadRequest("", columns=("k",)))
+                keys.update(r["k"] for r in resp.rows)
+            return self._array(sorted(
+                k for k in keys if fnmatch.fnmatchcase(k, args[0])))
         if cmd in ("INCR", "INCRBY", "DECR", "DECRBY"):
             delta = 1 if cmd in ("INCR", "DECR") else int(args[1])
             if cmd.startswith("DECR"):
                 delta = -delta
             row = await self._get_kv(args[0])
-            cur = int(row["v"]) if row else 0
+            if row is not None:
+                try:
+                    cur = int(row["v"])
+                except ValueError:
+                    return self._error(
+                        "value is not an integer or out of range")
+            else:
+                cur = 0
             cur += delta
             await c.insert("system.redis_kv",
                            [{"k": args[0], "v": str(cur),
                              "expire_at": None}])
             return self._int(cur)
+        if cmd == "INCRBYFLOAT":
+            row = await self._get_kv(args[0])
+            cur = float(row["v"]) if row else 0.0
+            cur += float(args[1])
+            sval = _fmt_score(cur)
+            await c.insert("system.redis_kv",
+                           [{"k": args[0], "v": sval, "expire_at": None}])
+            return self._bulk(sval)
+        if cmd == "SETNX":
+            if await self._get_kv(args[0]) is not None:
+                return self._int(0)
+            await c.insert("system.redis_kv",
+                           [{"k": args[0], "v": args[1],
+                             "expire_at": None}])
+            return self._int(1)
+        if cmd == "GETSET":
+            row = await self._get_kv(args[0])
+            await c.insert("system.redis_kv",
+                           [{"k": args[0], "v": args[1],
+                             "expire_at": None}])
+            return self._bulk(row["v"] if row else None)
+        if cmd == "APPEND":
+            row = await self._get_kv(args[0])
+            v = (row["v"] if row else "") + args[1]
+            await c.insert("system.redis_kv",
+                           [{"k": args[0], "v": v, "expire_at":
+                             row.get("expire_at") if row else None}])
+            return self._int(len(v))
+        if cmd == "STRLEN":
+            row = await self._get_kv(args[0])
+            return self._int(len(row["v"]) if row else 0)
+        if cmd == "GETRANGE":
+            row = await self._get_kv(args[0])
+            if row is None:
+                return self._bulk("")
+            v = row["v"]
+            start, end = int(args[1]), int(args[2])
+            if start < 0:
+                start = max(len(v) + start, 0)
+            end = len(v) + end if end < 0 else end
+            return self._bulk(v[start:end + 1])
+        if cmd == "SETRANGE":
+            row = await self._get_kv(args[0])
+            v = row["v"] if row else ""
+            off = int(args[1])
+            if len(v) < off:
+                v = v + "\x00" * (off - len(v))
+            v = v[:off] + args[2] + v[off + len(args[2]):]
+            await c.insert("system.redis_kv",
+                           [{"k": args[0], "v": v, "expire_at":
+                             row.get("expire_at") if row else None}])
+            return self._int(len(v))
+        if cmd == "PERSIST":
+            row = await self._get_kv(args[0])
+            if row is None or not row.get("expire_at"):
+                return self._int(0)
+            await c.insert("system.redis_kv",
+                           [{"k": args[0], "v": row["v"],
+                             "expire_at": None}])
+            return self._int(1)
         if cmd == "EXPIRE":
             row = await self._get_kv(args[0])
             if row is None:
@@ -258,18 +433,201 @@ class RedisServer:
                     n += 1
             return self._int(n)
         if cmd == "HGETALL":
-            resp = await c.scan("system.redis_hash", ReadRequest(
-                "", where=("cmp", "eq", ("col", 0), ("const", args[0]))))
+            rows = await self._rows_for("system.redis_hash", args[0])
             out: List[Optional[str]] = []
-            for r in sorted(resp.rows, key=lambda r: r["f"]):
+            for r in sorted(rows, key=lambda r: r["f"]):
                 out.extend([r["f"], r["v"]])
             return self._array(out)
+        if cmd == "HMGET":
+            out = []
+            for f in args[1:]:
+                row = await c.get("system.redis_hash",
+                                  {"k": args[0], "f": f})
+                out.append(row["v"] if row else None)
+            return self._array(out)
+        if cmd == "HEXISTS":
+            row = await c.get("system.redis_hash",
+                              {"k": args[0], "f": args[1]})
+            return self._int(1 if row else 0)
+        if cmd == "HLEN":
+            return self._int(
+                len(await self._rows_for("system.redis_hash", args[0])))
+        if cmd == "HKEYS":
+            rows = await self._rows_for("system.redis_hash", args[0])
+            return self._array(sorted(r["f"] for r in rows))
+        if cmd == "HVALS":
+            rows = await self._rows_for("system.redis_hash", args[0])
+            return self._array(
+                [r["v"] for r in sorted(rows, key=lambda r: r["f"])])
+        if cmd == "HINCRBY":
+            row = await c.get("system.redis_hash",
+                              {"k": args[0], "f": args[1]})
+            cur = int(row["v"]) if row else 0
+            cur += int(args[2])
+            await c.insert("system.redis_hash",
+                           [{"k": args[0], "f": args[1], "v": str(cur)}])
+            return self._int(cur)
+
+        # --- sets (reference: redis_operation.cc RedisSetCommands) ------
+        if cmd == "SADD":
+            added = 0
+            for m in args[1:]:
+                if await c.get("system.redis_set",
+                               {"k": args[0], "m": m}) is None:
+                    await c.insert("system.redis_set",
+                                   [{"k": args[0], "m": m}])
+                    added += 1
+            return self._int(added)
+        if cmd == "SREM":
+            n = 0
+            for m in args[1:]:
+                if await c.get("system.redis_set",
+                               {"k": args[0], "m": m}) is not None:
+                    await c.delete("system.redis_set",
+                                   [{"k": args[0], "m": m}])
+                    n += 1
+            return self._int(n)
+        if cmd == "SISMEMBER":
+            row = await c.get("system.redis_set",
+                              {"k": args[0], "m": args[1]})
+            return self._int(1 if row else 0)
+        if cmd == "SMEMBERS":
+            rows = await self._rows_for("system.redis_set", args[0])
+            return self._array(sorted(r["m"] for r in rows))
+        if cmd == "SCARD":
+            return self._int(
+                len(await self._rows_for("system.redis_set", args[0])))
+
+        # --- sorted sets (reference: RedisSortedSetCommands) ------------
+        if cmd == "ZADD":
+            n = 0
+            for i in range(1, len(args), 2):
+                m = args[i + 1]
+                if await c.get("system.redis_zset",
+                               {"k": args[0], "m": m}) is None:
+                    n += 1
+                await c.insert("system.redis_zset",
+                               [{"k": args[0], "m": m,
+                                 "score": float(args[i])}])
+            return self._int(n)
+        if cmd == "ZSCORE":
+            row = await c.get("system.redis_zset",
+                              {"k": args[0], "m": args[1]})
+            return self._bulk(_fmt_score(row["score"]) if row else None)
+        if cmd == "ZREM":
+            n = 0
+            for m in args[1:]:
+                if await c.get("system.redis_zset",
+                               {"k": args[0], "m": m}) is not None:
+                    await c.delete("system.redis_zset",
+                                   [{"k": args[0], "m": m}])
+                    n += 1
+            return self._int(n)
+        if cmd == "ZCARD":
+            return self._int(
+                len(await self._rows_for("system.redis_zset", args[0])))
+        if cmd == "ZINCRBY":
+            row = await c.get("system.redis_zset",
+                              {"k": args[0], "m": args[2]})
+            cur = (row["score"] if row else 0.0) + float(args[1])
+            await c.insert("system.redis_zset",
+                           [{"k": args[0], "m": args[2], "score": cur}])
+            return self._bulk(_fmt_score(cur))
+        if cmd in ("ZRANGE", "ZREVRANGE"):
+            withscores = (len(args) > 3
+                          and args[3].upper() == "WITHSCORES")
+            rows = await self._rows_for("system.redis_zset", args[0])
+            rows.sort(key=lambda r: (r["score"], r["m"]),
+                      reverse=(cmd == "ZREVRANGE"))
+            start, stop = int(args[1]), int(args[2])
+            n = len(rows)
+            if start < 0:
+                start += n
+            stop = n + stop if stop < 0 else stop
+            sel = rows[max(start, 0):stop + 1]
+            out = []
+            for r in sel:
+                out.append(r["m"])
+                if withscores:
+                    out.append(_fmt_score(r["score"]))
+            return self._array(out)
+        if cmd == "ZRANGEBYSCORE":
+            lo, lo_x = _parse_bound(args[1])
+            hi, hi_x = _parse_bound(args[2])
+            withscores = (len(args) > 3
+                          and args[3].upper() == "WITHSCORES")
+            rows = await self._rows_for("system.redis_zset", args[0])
+            rows.sort(key=lambda r: (r["score"], r["m"]))
+            out = []
+            for r in rows:
+                s = r["score"]
+                if (s < lo or (lo_x and s == lo)
+                        or s > hi or (hi_x and s == hi)):
+                    continue
+                out.append(r["m"])
+                if withscores:
+                    out.append(_fmt_score(s))
+            return self._array(out)
+
+        # --- lists (reference: list ops in redis_operation.cc) ----------
+        if cmd in ("LPUSH", "RPUSH"):
+            rows = await self._list_rows(args[0])
+            if cmd == "LPUSH":
+                seq = (rows[0]["seq"] if rows else 0.0)
+                new = [{"k": args[0], "seq": seq - i - 1, "v": v}
+                       for i, v in enumerate(args[1:])]
+            else:
+                seq = (rows[-1]["seq"] if rows else 0.0)
+                new = [{"k": args[0], "seq": seq + i + 1, "v": v}
+                       for i, v in enumerate(args[1:])]
+            await c.insert("system.redis_list", new)
+            return self._int(len(rows) + len(new))
+        if cmd in ("LPOP", "RPOP"):
+            rows = await self._list_rows(args[0])
+            if not rows:
+                return self._bulk(None)
+            r = rows[0] if cmd == "LPOP" else rows[-1]
+            await c.delete("system.redis_list",
+                           [{"k": args[0], "seq": r["seq"]}])
+            return self._bulk(r["v"])
+        if cmd == "LLEN":
+            return self._int(len(await self._list_rows(args[0])))
+        if cmd == "LINDEX":
+            rows = await self._list_rows(args[0])
+            i = int(args[1])
+            if i < 0:
+                i += len(rows)
+            if 0 <= i < len(rows):
+                return self._bulk(rows[i]["v"])
+            return self._bulk(None)
+        if cmd == "LRANGE":
+            rows = await self._list_rows(args[0])
+            start, stop = int(args[1]), int(args[2])
+            n = len(rows)
+            if start < 0:
+                start += n
+            stop = n + stop if stop < 0 else stop
+            return self._array(
+                [r["v"] for r in rows[max(start, 0):stop + 1]])
+        if cmd == "LSET":
+            rows = await self._list_rows(args[0])
+            i = int(args[1])
+            if i < 0:
+                i += len(rows)
+            if not (0 <= i < len(rows)):
+                return self._error("index out of range")
+            await c.insert("system.redis_list",
+                           [{"k": args[0], "seq": rows[i]["seq"],
+                             "v": args[2]}])
+            return self._simple("OK")
         if cmd == "COMMAND":
             return self._array([])
         if cmd == "SELECT":
             return self._simple("OK")
         if cmd == "FLUSHALL":
-            for t in ("system.redis_kv", "system.redis_hash"):
+            for t in ("system.redis_kv", "system.redis_hash",
+                      "system.redis_set", "system.redis_zset",
+                      "system.redis_list"):
                 try:
                     await c.drop_table(t)
                 except RpcError:

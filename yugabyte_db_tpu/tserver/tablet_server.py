@@ -185,11 +185,13 @@ class TabletServer:
                           "split-complete.json")
         if os.path.exists(mk):
             with open(mk) as f:
-                par = json.load(f).get("parent")
+                mkd = json.load(f)
+            par = mkd.get("parent")
             if par:
                 sibs = self._split_children.setdefault(par, [])
-                if tablet_id not in sibs:
-                    sibs.append(tablet_id)
+                for sib in mkd.get("siblings", [tablet_id]):
+                    if sib not in sibs:
+                        sibs.append(sib)
         self.peers[tablet_id] = peer
         await peer.start()
         return peer
@@ -624,7 +626,12 @@ class TabletServer:
                 ch.tablet.intents.apply(intents[cid])
             ch.tablet.flush()
             ch.participant.recover_from_store()
-            _atomic_json(_marker(cid), {"parent": parent_id})
+            # siblings recorded so the decision-routing map rebuilds
+            # COMPLETELY from any one child (the other may live on a
+            # different tserver after a balancer move)
+            _atomic_json(_marker(cid), {
+                "parent": parent_id,
+                "siblings": [d["left_id"], d["right_id"]]})
         # persist the split state so a restarted replica keeps
         # rejecting parent ops even before WAL replay reaches the entry
         meta_path = os.path.join(self._tablet_dir(parent_id),
