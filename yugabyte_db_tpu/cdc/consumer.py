@@ -147,6 +147,13 @@ class XClusterReplicator:
         self._task: Optional[asyncio.Task] = None
         self._running = False
         self.replicated = 0
+        # source schema version already mirrored onto the target (DDL
+        # replication, reference: xCluster automatic-mode DDL queue —
+        # master/xcluster/xcluster_ddl_queue_handler.cc; ours
+        # reconciles the target schema whenever the source version
+        # moves, BEFORE applying that round's row images, because the
+        # row path silently drops columns the target doesn't know)
+        self._applied_schema_version: Optional[int] = None
 
     async def ensure_target_table(self):
         names = {t["name"] for t in await self.target.list_tables()}
@@ -228,8 +235,33 @@ class XClusterReplicator:
         self.replicated += n
         return n
 
+    async def _maybe_replicate_ddl(self, changes) -> None:
+        """Mirror source schema changes (ADD/DROP COLUMN) onto the
+        target BEFORE the round's row images apply. Normally a version
+        compare against the cache poll() just refreshed; when the round
+        actually carries changes the schema is re-fetched — an ALTER
+        landing between poll's refresh and get_changes would otherwise
+        leave this round's new-column values silently dropped by the
+        target's row path."""
+        src_ct = await self.stream.client._table(self.table,
+                                                 refresh=bool(changes))
+        ver = src_ct.info.schema.version
+        if ver == self._applied_schema_version:
+            return
+        tgt_ct = await self.target._table(self.table, refresh=True)
+        src_cols = {c.name: c for c in src_ct.info.schema.columns}
+        tgt_cols = {c.name: c for c in tgt_ct.info.schema.columns}
+        adds = [(c.name, c.type) for name, c in src_cols.items()
+                if name not in tgt_cols and not c.is_key]
+        drops = [name for name, c in tgt_cols.items()
+                 if name not in src_cols and not c.is_key]
+        if adds or drops:
+            await self.target.alter_table(self.table, adds, drops)
+        self._applied_schema_version = ver
+
     async def _step_inner(self) -> int:
         changes = await self.stream.poll()
+        await self._maybe_replicate_ddl(changes)
         n = 0
         if changes:
             # one target write per source commit HT, applied AT that HT
