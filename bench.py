@@ -176,6 +176,34 @@ def main():
             "speedup": cpu_t / tpu_t,
         }
 
+    # --- optional: hand-fused pallas scan vs the XLA kernel -------------
+    # (BENCH_PALLAS=1; the flag stays off otherwise so the driver's run
+    # never depends on the pallas TPU compile)
+    if os.environ.get("BENCH_PALLAS") == "1":
+        flags.set_flag("tpu_pallas_scan", True)
+        try:
+            pk = ScanKernel()
+            q = TPCH_Q6
+            batch = build_batch(blocks, sorted(q.columns))
+
+            def pallas_run():
+                outs, counts, m = pk.run(batch, q.where, q.aggs, q.group)
+                jax.block_until_ready(outs)
+                return outs, counts, m
+            _, _, m0 = pallas_run()
+            pl_t, (pl_out, _, _) = best_of(pallas_run, repeats)
+            ref = numpy_reference(q, data)
+            rel = abs(float(pl_out[0]) - ref) / max(abs(ref), 1e-9)
+            results["q6_pallas"] = {
+                "routed": m0 is None, "rows_per_s": n / pl_t,
+                "vs_xla": results["q6"]["tpu_s"] / pl_t,
+                "rel_err": rel,
+            }
+        except Exception as e:   # noqa: BLE001 — report, don't fail bench
+            results["q6_pallas"] = {"error": str(e)[:200]}
+        finally:
+            flags.set_flag("tpu_pallas_scan", False)
+
     # --- distributed Q1 (BASELINE config 3): 8 tablets ------------------
     dtable = LineitemTable(tempfile.mkdtemp(prefix="ybtpu-dist-"),
                            num_tablets=8)
@@ -296,6 +324,10 @@ def main():
             "mb_per_s": round(results["compaction"]["mb_per_s"], 2),
             "cpu_mb_per_s": round(results["compaction"]["cpu_mb_per_s"], 2),
             "vs_cpu": round(results["compaction"]["vs_cpu"], 3)},
+        **({"q6_pallas": {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in results["q6_pallas"].items()}}
+           if "q6_pallas" in results else {}),
         "ycsb_c_ops_per_s": round(results["ycsb_c"]["ops_per_s"], 1),
         "vector": {"n": results["vector"]["n"],
                    "dim": results["vector"]["dim"],

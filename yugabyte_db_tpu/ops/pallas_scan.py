@@ -145,3 +145,150 @@ def grouped_sum(gids: np.ndarray, values: np.ndarray, mask: np.ndarray,
     return np.asarray(grouped_sum_pallas(
         pad(gids), pad(values), pad(mask.astype(np.float32)), num_groups,
         interpret=interpret))
+
+
+# --------------------------------------------------------------------------
+# The GENERIC pallas scan path: the engine's compiled WHERE/aggregate
+# expressions (ops/expr.py emits plain jnp elementwise code, which
+# traces inside a pallas kernel unchanged) fused into one hand-blocked
+# kernel streaming each 4096-row block HBM -> VMEM once. Routed from
+# ScanKernel.run behind the `tpu_pallas_scan` flag for aggregate
+# queries whose columns are f32-exact (f32/f64/int32/bool) — the
+# pallas compute is f32, so int64 keys/timestamps stay on the XLA
+# path. Grouped queries use the one-hot MXU matmul per block.
+# --------------------------------------------------------------------------
+class PallasIneligible(Exception):
+    pass
+
+
+def build_generic_scan(where, agg_fns, group_cols, num_groups,
+                       col_order, null_order, n_consts,
+                       interpret: bool = False):
+    """Returns jitted fn(consts_f32, cols..., nulls..., valid) ->
+    (per-agg partials [grid] or [grid, G], count partials).
+
+    agg_fns: [(op, compiled_expr_or_None)]; group_cols: GroupSpec cols
+    tuple or None; col_order/null_order: cid tuples fixing ref order."""
+    from jax.experimental import pallas as pl
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+        smem = pltpu.SMEM
+    except ImportError:
+        smem = None
+    from .expr import compile_expr
+    where_fn = compile_expr(where) if where is not None else None
+    n_cols, n_nulls = len(col_order), len(null_order)
+    n_aggs = len(agg_fns)
+    G = num_groups
+
+    def kernel(consts_ref, *refs):
+        col_refs = refs[:n_cols]
+        null_refs = refs[n_cols:n_cols + n_nulls]
+        valid_ref = refs[n_cols + n_nulls]
+        out_refs = refs[n_cols + n_nulls + 1:]
+        cols = {cid: col_refs[i][:] for i, cid in enumerate(col_order)}
+        nulls = {cid: null_refs[i][:] > 0
+                 for i, cid in enumerate(null_order)}
+        consts = [consts_ref[i] for i in range(n_consts)]
+        mask = valid_ref[:] > 0
+        if where_fn is not None:
+            wv, wn = where_fn(cols, nulls, consts)
+            mask = mask & wv
+            if wn is not None:
+                mask = mask & jnp.logical_not(wn)
+        maskf = mask.astype(jnp.float32)
+        if G is None:
+            for oi, (op, f) in enumerate(agg_fns):
+                if f is None:
+                    out_refs[oi][0] = jnp.sum(maskf)
+                    continue
+                v, vn = f(cols, nulls, consts)
+                v = v.astype(jnp.float32)
+                m = maskf if vn is None else \
+                    maskf * jnp.logical_not(vn).astype(jnp.float32)
+                if op == "count":
+                    out_refs[oi][0] = jnp.sum(m)
+                elif op == "sum":
+                    # where, not multiply: garbage on masked rows may
+                    # be NaN and 0*NaN would poison the block partial
+                    out_refs[oi][0] = jnp.sum(
+                        jnp.where(m > 0, v, jnp.float32(0)))
+                elif op == "min":
+                    out_refs[oi][0] = jnp.min(
+                        jnp.where(m > 0, v, jnp.float32(np.inf)))
+                elif op == "max":
+                    out_refs[oi][0] = jnp.max(
+                        jnp.where(m > 0, v, jnp.float32(-np.inf)))
+            out_refs[n_aggs][0] = jnp.sum(maskf)
+            return
+        # grouped: one-hot [B, G] matmul per block (MXU)
+        gid = None
+        stride = 1
+        for cid, domain, offset in group_cols:
+            gn = nulls.get(cid)
+            if gn is not None:
+                mask = mask & jnp.logical_not(gn)
+            c = cols[cid].astype(jnp.float32) - offset
+            # clip exactly like the XLA kernel: out-of-domain values
+            # (stale ANALYZE stats) land in the edge bucket instead of
+            # aliasing into another group's id
+            c = jnp.clip(c, 0.0, float(domain - 1))
+            gid = c * stride if gid is None else gid + c * stride
+            stride *= domain
+        maskf = mask.astype(jnp.float32)
+        groups = jax.lax.broadcasted_iota(
+            jnp.float32, (gid.shape[0], G), 1)
+        onehot = (gid[:, None] == groups).astype(jnp.float32) \
+            * maskf[:, None]
+        for oi, (op, f) in enumerate(agg_fns):
+            if f is None:
+                out_refs[oi][0, :] = jnp.sum(onehot, axis=0)
+                continue
+            v, vn = f(cols, nulls, consts)
+            v = v.astype(jnp.float32)
+            oh = onehot if vn is None else \
+                onehot * jnp.logical_not(vn).astype(jnp.float32)[:, None]
+            if op == "count":
+                out_refs[oi][0, :] = jnp.sum(oh, axis=0)
+            elif op == "sum":
+                row_m = oh.max(axis=1)
+                vm = jnp.where(row_m > 0, v, jnp.float32(0))
+                out_refs[oi][0, :] = vm @ oh
+            elif op == "min":
+                out_refs[oi][0, :] = jnp.min(jnp.where(
+                    oh > 0, v[:, None], jnp.float32(np.inf)), axis=0)
+            elif op == "max":
+                out_refs[oi][0, :] = jnp.max(jnp.where(
+                    oh > 0, v[:, None], jnp.float32(-np.inf)), axis=0)
+        out_refs[n_aggs][0, :] = jnp.sum(onehot, axis=0)
+
+    @partial(jax.jit, static_argnames=())
+    def run(consts, col_arrs, null_arrs, valid):
+        n = valid.shape[0]
+        grid = n // BLOCK_ROWS
+        blk = pl.BlockSpec((BLOCK_ROWS,), lambda i: (i,))
+        scalar_spec = (pl.BlockSpec(memory_space=smem)
+                       if smem is not None
+                       else pl.BlockSpec((max(n_consts, 1),),
+                                         lambda i: (0,)))
+        if G is None:
+            out_specs = tuple(pl.BlockSpec((1,), lambda i: (i,))
+                              for _ in range(n_aggs + 1))
+            out_shape = tuple(jax.ShapeDtypeStruct((grid,), jnp.float32)
+                              for _ in range(n_aggs + 1))
+        else:
+            out_specs = tuple(pl.BlockSpec((1, G), lambda i: (i, 0))
+                              for _ in range(n_aggs + 1))
+            out_shape = tuple(
+                jax.ShapeDtypeStruct((grid, G), jnp.float32)
+                for _ in range(n_aggs + 1))
+        outs = pl.pallas_call(
+            kernel,
+            grid=(grid,),
+            in_specs=[scalar_spec] + [blk] * (n_cols + n_nulls + 1),
+            out_specs=out_specs,
+            out_shape=out_shape,
+            interpret=interpret,
+        )(consts, *col_arrs, *null_arrs, valid)
+        return outs
+    return run

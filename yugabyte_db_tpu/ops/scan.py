@@ -274,6 +274,106 @@ class ScanKernel:
             self.compiles += 1
         return fn
 
+    # dtypes the f32 pallas compute admits. int64 HTs/keys/timestamps
+    # never route; int32 columns additionally get a runtime |max| <
+    # 2^24 guard (below) so integer predicates stay exact. float64
+    # columns DO round to f32 in this path — sums carry ~1e-7 relative
+    # drift and f64 predicate boundaries can flip within that noise;
+    # that is the documented contract of the opt-in flag.
+    _PALLAS_DTYPES = ("float32", "float64", "int32", "int16", "int8",
+                      "bool")
+
+    def _try_pallas(self, sig, batch, where, aggs, group, mvcc_mode,
+                    consts):
+        """Route eligible aggregate scans through the hand-fused pallas
+        kernel (ops/pallas_scan.py). Returns the XLA-shaped result
+        tuple, or None when the query/batch shape is ineligible — the
+        caller falls back to the XLA kernel."""
+        if mvcc_mode != "none" or not aggs:
+            return None
+        if group is not None and (not isinstance(group, GroupSpec)
+                                  or group.num_groups > 64):
+            return None
+        if any(a.op not in ("sum", "count", "min", "max") for a in aggs):
+            return None
+        if batch.padded_rows % 4096 != 0:
+            return None
+        from .expr import referenced_columns
+        needed = set(referenced_columns(where)) if where is not None \
+            else set()
+        for a in aggs:
+            if a.expr is not None:
+                needed |= set(referenced_columns(a.expr))
+        if group is not None:
+            needed |= {cid for cid, _, _ in group.cols}
+        for cid in needed:
+            col = batch.cols.get(cid)
+            if col is None or str(col.dtype) not in self._PALLAS_DTYPES:
+                return None
+            if str(col.dtype) == "int32":
+                rng = batch.int32_ranges.setdefault(
+                    cid, (int(jnp.min(col)), int(jnp.max(col))))
+                if max(abs(rng[0]), abs(rng[1])) >= 2 ** 24:
+                    return None         # not f32-exact
+        for c in consts:
+            if np.ndim(c) != 0:
+                return None
+            if abs(float(c)) >= 2 ** 24:
+                return None             # not f32-exact
+        key = ("pallas", sig)
+        entry = self._cache.get(key)
+        if entry is False:
+            return None                 # known-failing shape
+        col_order = tuple(sorted(needed))
+        null_order = tuple(cid for cid in col_order
+                           if cid in batch.nulls)
+        try:
+            if entry is None:
+                from .pallas_scan import build_generic_scan
+                agg_fns = [
+                    (a.op,
+                     compile_expr(a.expr) if a.expr is not None else None)
+                    for a in aggs]
+                interpret = jax.default_backend() == "cpu"
+                entry = build_generic_scan(
+                    where, agg_fns,
+                    group.cols if group is not None else None,
+                    group.num_groups if group is not None else None,
+                    col_order, null_order, len(consts),
+                    interpret=interpret)
+                self._cache[key] = entry
+                self.compiles += 1
+            carr = jnp.asarray(
+                np.asarray([float(c) for c in consts] or [0.0],
+                           np.float32))
+            col_arrs = [batch.cols[cid].astype(jnp.float32)
+                        for cid in col_order]
+            null_arrs = [batch.nulls[cid].astype(jnp.float32)
+                         for cid in null_order]
+            outs = entry(carr, col_arrs, null_arrs,
+                         batch.valid.astype(jnp.float32))
+        except Exception:   # noqa: BLE001 — unsupported op inside the
+            self._cache[key] = False    # kernel: permanent XLA fallback
+            return None
+        agg_parts, cnt_parts = outs[:-1], outs[-1]
+        results = []
+        for a, p in zip(aggs, agg_parts):
+            if a.op in ("count",):
+                # per-block partials are exact ints (block <= 4096);
+                # sum them in int64 ON THE HOST so totals past 2^24
+                # stay exact, unlike an f32 device accumulation
+                r = np.asarray(p, np.float64).sum(axis=0).astype(np.int64)
+            elif a.op == "sum":
+                r = jnp.sum(p, axis=0)
+            elif a.op == "min":
+                r = jnp.min(p, axis=0)
+            else:
+                r = jnp.max(p, axis=0)
+            results.append(r)
+        counts = np.asarray(cnt_parts, np.float64).sum(axis=0).astype(
+            np.int64)
+        return tuple(results), counts, None
+
     def run(self, batch: DeviceBatch,
             where: Optional[tuple] = None,
             aggs: Sequence[AggSpec] = (),
@@ -302,6 +402,12 @@ class ScanKernel:
              getattr(group, "max_groups", None)) if group else None,
             mvcc_mode, batch.padded_rows, col_sig,
         )
+        from ..utils import flags as _flags
+        if _flags.get("tpu_pallas_scan"):
+            got = self._try_pallas(sig, batch, where, aggs, group,
+                                   mvcc_mode, consts)
+            if got is not None:
+                return got
         fn = self._get(sig, where, aggs, group, mvcc_mode)
         zeros_u64 = jnp.zeros(batch.padded_rows, jnp.uint64)
         zeros_u32 = jnp.zeros(batch.padded_rows, jnp.uint32)

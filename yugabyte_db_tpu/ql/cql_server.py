@@ -119,7 +119,10 @@ def _parse_cql_collection(span: str):
                 skip_ws(t)
                 if t[pos[0]] == "}":
                     pos[0] += 1
-                    return sorted(items, key=str)
+                    # numeric sets sort numerically, string sets
+                    # lexically (the CQL sorted-set contract)
+                    return sorted(items,
+                                  key=lambda x: (isinstance(x, str), x))
                 if t[pos[0]] != ",":
                     raise ValueError("bad set literal")
                 pos[0] += 1
@@ -246,8 +249,11 @@ class CqlServer:
         self._next_prep = 0
         self.addr: Optional[Tuple[str, int]] = None
         # (table, column) -> full CQL collection type ("list<text>")
-        # learned from CREATE TABLE statements through this server;
-        # value-shape inference fills in after a server restart
+        # learned from CREATE TABLE statements through this server.
+        # KNOWN LIMIT: the mapping is server-session-local — after a
+        # restart, collection columns of pre-existing tables encode as
+        # JSON text (type 0x0D) until the catalog grows a per-column
+        # original-type field.
         self._coll_types: Dict[Tuple[str, str], str] = {}
 
     async def start(self):

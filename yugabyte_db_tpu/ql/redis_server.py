@@ -84,6 +84,16 @@ def _fmt_score(s: float) -> str:
     return str(int(s)) if s == int(s) else format(s, ".17g")
 
 
+def _range(n: int, start: int, stop: int):
+    """Redis start/stop (inclusive, negatives from the end) -> Python
+    slice bounds. Shared by LRANGE/ZRANGE/ZREVRANGE/GETRANGE."""
+    if start < 0:
+        start += n
+    if stop < 0:
+        stop += n
+    return max(start, 0), stop + 1
+
+
 def _parse_bound(s: str):
     """ZRANGEBYSCORE bound: number, (number (exclusive), -inf/+inf."""
     excl = s.startswith("(")
@@ -230,32 +240,47 @@ class RedisServer:
         return resp.rows
 
     async def _type_of(self, key: str) -> Optional[str]:
-        if await self._get_kv(key) is not None:
+        """One concurrent probe across the five type tables (the
+        per-table lookups are independent; serial round-trips would
+        pay 5x the latency on misses)."""
+        kv, h, li, se, z = await asyncio.gather(
+            self._get_kv(key),
+            self._rows_for("system.redis_hash", key),
+            self._rows_for("system.redis_list", key),
+            self._rows_for("system.redis_set", key),
+            self._rows_for("system.redis_zset", key))
+        if kv is not None:
             return "string"
-        for table, t in (("system.redis_hash", "hash"),
-                         ("system.redis_list", "list"),
-                         ("system.redis_set", "set"),
-                         ("system.redis_zset", "zset")):
-            if await self._rows_for(table, key):
-                return t
+        if h:
+            return "hash"
+        if li:
+            return "list"
+        if se:
+            return "set"
+        if z:
+            return "zset"
         return None
 
     async def _del_key(self, key: str) -> bool:
         """Delete `key` whatever its type; True if anything existed."""
         c = self.client
+        tables = (("system.redis_hash", "f"), ("system.redis_set", "m"),
+                  ("system.redis_zset", "m"), ("system.redis_list", "seq"))
+        kv, *per_table = await asyncio.gather(
+            self._get_kv(key),
+            *[self._rows_for(t, key) for t, _ in tables])
         found = False
-        if await self._get_kv(key) is not None:
-            await c.delete("system.redis_kv", [{"k": key}])
+        deletes = []
+        if kv is not None:
+            deletes.append(c.delete("system.redis_kv", [{"k": key}]))
             found = True
-        for table, rk in (("system.redis_hash", "f"),
-                          ("system.redis_set", "m"),
-                          ("system.redis_zset", "m"),
-                          ("system.redis_list", "seq")):
-            rows = await self._rows_for(table, key)
+        for (table, rk), rows in zip(tables, per_table):
             if rows:
-                await c.delete(table, [{"k": key, rk: r[rk]}
-                                       for r in rows])
+                deletes.append(c.delete(
+                    table, [{"k": key, rk: r[rk]} for r in rows]))
                 found = True
+        if deletes:
+            await asyncio.gather(*deletes)
         return found
 
     async def _list_rows(self, key: str) -> List[dict]:
@@ -374,11 +399,8 @@ class RedisServer:
             if row is None:
                 return self._bulk("")
             v = row["v"]
-            start, end = int(args[1]), int(args[2])
-            if start < 0:
-                start = max(len(v) + start, 0)
-            end = len(v) + end if end < 0 else end
-            return self._bulk(v[start:end + 1])
+            lo, hi = _range(len(v), int(args[1]), int(args[2]))
+            return self._bulk(v[lo:hi])
         if cmd == "SETRANGE":
             row = await self._get_kv(args[0])
             v = row["v"] if row else ""
@@ -539,12 +561,8 @@ class RedisServer:
             rows = await self._rows_for("system.redis_zset", args[0])
             rows.sort(key=lambda r: (r["score"], r["m"]),
                       reverse=(cmd == "ZREVRANGE"))
-            start, stop = int(args[1]), int(args[2])
-            n = len(rows)
-            if start < 0:
-                start += n
-            stop = n + stop if stop < 0 else stop
-            sel = rows[max(start, 0):stop + 1]
+            lo, hi = _range(len(rows), int(args[1]), int(args[2]))
+            sel = rows[lo:hi]
             out = []
             for r in sel:
                 out.append(r["m"])
@@ -602,13 +620,8 @@ class RedisServer:
             return self._bulk(None)
         if cmd == "LRANGE":
             rows = await self._list_rows(args[0])
-            start, stop = int(args[1]), int(args[2])
-            n = len(rows)
-            if start < 0:
-                start += n
-            stop = n + stop if stop < 0 else stop
-            return self._array(
-                [r["v"] for r in rows[max(start, 0):stop + 1]])
+            lo, hi = _range(len(rows), int(args[1]), int(args[2]))
+            return self._array([r["v"] for r in rows[lo:hi]])
         if cmd == "LSET":
             rows = await self._list_rows(args[0])
             i = int(args[1])

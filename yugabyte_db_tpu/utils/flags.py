@@ -131,6 +131,10 @@ DEFINE_RUNTIME("tpu_pushdown_enabled", True,
                "backend (the yb_enable_tpu_pushdown analog).")
 DEFINE_RUNTIME("tpu_compaction_enabled", True,
                "Offload LSM compaction merge + MVCC GC to TPU kernels.")
+DEFINE_RUNTIME("tpu_pallas_scan", False,
+               "Route eligible aggregate scans through the hand-fused "
+               "pallas kernel (ops/pallas_scan.py) instead of the XLA "
+               "scan; f32 compute, so int64 columns stay on XLA.")
 DEFINE_RUNTIME("tpu_min_rows_for_pushdown", 4096,
                "Scans smaller than this stay on the CPU path: point reads "
                "must never pay a device round-trip.")
