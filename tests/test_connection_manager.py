@@ -1,0 +1,196 @@
+"""Connection manager (odyssey analog): transaction-level pooling of
+SqlSessions behind the PG wire protocol — many client sockets, a
+bounded session pool, fair queuing, and mid-transaction disconnect
+cleanup (reference: src/odyssey routing/pooling)."""
+import asyncio
+
+from yugabyte_db_tpu.ql.connection_manager import PooledPgServer
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+from tests.test_pg_wire import MiniPgClient
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _connect(addr):
+    reader, writer = await asyncio.open_connection(*addr)
+    c = MiniPgClient(reader, writer)
+    await c.startup()
+    return c, writer
+
+
+class TestPooling:
+    def test_many_clients_share_small_pool(self, tmp_path):
+        """20 concurrent clients over a 2-session pool: every statement
+        completes (excess queues instead of failing)."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            srv = PooledPgServer(mc.client(), pool_size=2)
+            addr = await srv.start()
+            try:
+                c0, w0 = await _connect(addr)
+                await c0.query("CREATE TABLE p (k bigint, v double, "
+                               "PRIMARY KEY (k)) WITH tablets = 1")
+                await mc.wait_for_leaders("p")
+
+                async def client(i):
+                    c, w = await _connect(addr)
+                    await c.query(f"INSERT INTO p (k, v) VALUES "
+                                  f"({i}, {float(i)})")
+                    msgs = await c.query(
+                        f"SELECT v FROM p WHERE k = {i}")
+                    w.close()
+                    return MiniPgClient.rows(msgs)
+                out = await asyncio.gather(*[client(i)
+                                             for i in range(20)])
+                assert all(r and float(r[0][0]) == float(i)
+                           for i, r in enumerate(out))
+                msgs = await c0.query("SELECT count(*) FROM p")
+                assert int(MiniPgClient.rows(msgs)[0][0]) == 20
+                assert srv.waits > 0, "pool never saturated: test is " \
+                    "not exercising queuing"
+                w0.close()
+            finally:
+                await srv.shutdown()
+                await mc.shutdown()
+        run(go())
+
+    def test_transaction_holds_one_session(self, tmp_path):
+        """A client inside BEGIN keeps ITS session across statements
+        (sees its own uncommitted writes) while other clients proceed
+        on the remaining pool."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            srv = PooledPgServer(mc.client(), pool_size=2)
+            addr = await srv.start()
+            try:
+                c0, w0 = await _connect(addr)
+                await c0.query("CREATE TABLE t (k bigint, v double, "
+                               "PRIMARY KEY (k)) WITH tablets = 1")
+                await mc.wait_for_leaders("t")
+                ca, wa = await _connect(addr)
+                cb, wb = await _connect(addr)
+                await ca.query("BEGIN")
+                await ca.query("INSERT INTO t (k, v) VALUES (1, 1.0)")
+                # txn client reads its OWN write (same session held)
+                msgs = await ca.query("SELECT v FROM t WHERE k = 1")
+                assert MiniPgClient.rows(msgs), "txn lost its session"
+                # other client: txn write invisible, own work fine
+                msgs = await cb.query("SELECT count(*) FROM t")
+                assert int(MiniPgClient.rows(msgs)[0][0]) == 0
+                await cb.query("INSERT INTO t (k, v) VALUES (5, 5.0)")
+                await ca.query("COMMIT")
+                msgs = await cb.query("SELECT count(*) FROM t")
+                assert int(MiniPgClient.rows(msgs)[0][0]) == 2
+                wa.close()
+                wb.close()
+                w0.close()
+            finally:
+                await srv.shutdown()
+                await mc.shutdown()
+        run(go())
+
+    def test_disconnect_mid_txn_rolls_back_and_returns_session(
+            self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            srv = PooledPgServer(mc.client(), pool_size=1)
+            addr = await srv.start()
+            try:
+                c0, w0 = await _connect(addr)
+                await c0.query("CREATE TABLE d (k bigint, v double, "
+                               "PRIMARY KEY (k)) WITH tablets = 1")
+                await mc.wait_for_leaders("d")
+                w0.close()
+                ca, wa = await _connect(addr)
+                await ca.query("BEGIN")
+                await ca.query("INSERT INTO d (k, v) VALUES (1, 1.0)")
+                wa.close()              # vanish mid-transaction
+                await asyncio.sleep(0.2)
+                # the single pooled session must come back, rolled back
+                cb, wb = await _connect(addr)
+                msgs = await cb.query("SELECT count(*) FROM d")
+                assert int(MiniPgClient.rows(msgs)[0][0]) == 0
+                # and the row is writable (no leaked intents/locks)
+                await cb.query("INSERT INTO d (k, v) VALUES (1, 9.0)")
+                msgs = await cb.query("SELECT v FROM d WHERE k = 1")
+                assert float(MiniPgClient.rows(msgs)[0][0]) == 9.0
+                wb.close()
+            finally:
+                await srv.shutdown()
+                await mc.shutdown()
+        run(go())
+
+
+class TestReadYourOwnWrites:
+    """RYOW overlay edge cases from review: projections without pk
+    columns, partial upserts + DELETE re-evaluation, LIMIT interplay."""
+
+    def test_projection_without_pk_still_overlays(self, tmp_path):
+        async def go():
+            from yugabyte_db_tpu.ql.executor import SqlSession
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            s = SqlSession(mc.client())
+            try:
+                await s.execute("CREATE TABLE r1 (k bigint, v double, "
+                                "PRIMARY KEY (k)) WITH tablets = 1")
+                await s.execute(
+                    "INSERT INTO r1 (k, v) VALUES (1, 10.0)")
+                await s.execute("BEGIN")
+                await s.execute("UPDATE r1 SET v = 20.0 WHERE k = 1")
+                r = await s.execute("SELECT v FROM r1 WHERE k = 1")
+                assert [x["v"] for x in r.rows] == [20.0], r.rows
+                await s.execute("DELETE FROM r1 WHERE k = 1")
+                r = await s.execute("SELECT v FROM r1 WHERE k = 1")
+                assert r.rows == [], r.rows
+                await s.execute("ROLLBACK")
+                r = await s.execute("SELECT v FROM r1 WHERE k = 1")
+                assert [x["v"] for x in r.rows] == [10.0]
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_delete_by_nonpk_col_with_partial_upsert(self, tmp_path):
+        async def go():
+            from yugabyte_db_tpu.ql.executor import SqlSession
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            s = SqlSession(mc.client())
+            try:
+                await s.execute("CREATE TABLE r2 (k bigint, a double, "
+                                "b double, PRIMARY KEY (k)) "
+                                "WITH tablets = 1")
+                await s.execute(
+                    "INSERT INTO r2 (k, a, b) VALUES (1, 0.0, 5.0)")
+                await s.execute("BEGIN")
+                # partial upsert touches a only; b stays 5 committed
+                await s.execute("INSERT INTO r2 (k, a) VALUES (1, 9.0)")
+                await s.execute("DELETE FROM r2 WHERE b = 5.0")
+                r = await s.execute("SELECT k FROM r2")
+                assert r.rows == [], r.rows
+                await s.execute("COMMIT")
+                r = await s.execute("SELECT k FROM r2")
+                assert r.rows == []
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_limit_not_undercut_by_overlay(self, tmp_path):
+        async def go():
+            from yugabyte_db_tpu.ql.executor import SqlSession
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            s = SqlSession(mc.client())
+            try:
+                await s.execute("CREATE TABLE r3 (k bigint, v double, "
+                                "PRIMARY KEY (k)) WITH tablets = 1")
+                await s.execute(
+                    "INSERT INTO r3 (k, v) VALUES "
+                    + ", ".join(f"({i}, {float(i)})" for i in range(20)))
+                await s.execute("BEGIN")
+                await s.execute("DELETE FROM r3 WHERE k = 0")
+                r = await s.execute("SELECT k FROM r3 LIMIT 10")
+                assert len(r.rows) == 10, len(r.rows)
+                await s.execute("ROLLBACK")
+            finally:
+                await mc.shutdown()
+        run(go())

@@ -36,6 +36,11 @@ class YBTransaction:
         self._participants: Dict[str, List[List]] = {}
         # tablets holding only our READ locks (need explicit release)
         self._read_participants: Dict[str, List[List]] = {}
+        # client-side write set: table -> {pk tuple -> RowOp}. The SQL
+        # layer overlays it on scans so a txn reads its own uncommitted
+        # writes (reference: read-your-own-writes via local intents in
+        # pggate's buffered operations)
+        self._writes: Dict[str, Dict[tuple, RowOp]] = {}
 
     # ------------------------------------------------------------------
     async def _status_tablet(self) -> TabletLocation:
@@ -117,7 +122,19 @@ class YBTransaction:
             if e.code in ("ABORTED", "DEADLOCK"):
                 await self.abort()
             raise
+        pk_names = [c.name for c in ct.info.schema.key_columns]
+        wset = self._writes.setdefault(table, {})
+        for op in ops:
+            pk = tuple(op.row.get(k) for k in pk_names)
+            if op.kind == "upsert" and wset.get(pk) is not None \
+                    and wset[pk].kind == "upsert":
+                # partial re-write of the same row merges columns
+                op = RowOp("upsert", {**wset[pk].row, **op.row})
+            wset[pk] = op
         return sum(results)
+
+    def pending_writes(self, table: str) -> Dict[tuple, RowOp]:
+        return self._writes.get(table, {})
 
     async def insert(self, table: str, rows: Sequence[dict]) -> int:
         return await self.write(table, [RowOp("upsert", r) for r in rows])

@@ -1,0 +1,74 @@
+"""Connection manager: PG-wire connection pooling (odyssey analog).
+
+Reference: src/odyssey — the YSQL Connection Manager that fronts the
+PostgreSQL backends with transaction-level pooling so thousands of
+client sockets share a bounded set of server connections. Our backend
+"connection" is a SqlSession (executor state + any open transaction),
+which is cheap — the pooling value here is bounding concurrent
+executor sessions and keeping per-statement multiplexing semantics
+identical to the reference:
+
+- transaction pooling: a client holds a leased session only while an
+  explicit transaction (BEGIN .. COMMIT/ROLLBACK) is open; otherwise
+  the session returns to the pool after every statement, so idle
+  clients hold nothing;
+- a client disconnect mid-transaction aborts the transaction before
+  the session is returned (no leaked locks/intents);
+- when the pool is exhausted, new statements QUEUE (fair FIFO via
+  asyncio.Queue) instead of failing — the backpressure model the
+  reference applies at its routing layer.
+"""
+from __future__ import annotations
+
+import asyncio
+
+from ..client import YBClient
+from .executor import SqlSession
+from .pg_server import PgServer
+
+
+class PooledPgServer(PgServer):
+    def __init__(self, client: YBClient, host="127.0.0.1", port=0,
+                 pool_size: int = 8):
+        super().__init__(client, host, port)
+        self.pool_size = pool_size
+        self._pool: asyncio.Queue = asyncio.Queue()
+        for _ in range(pool_size):
+            self._pool.put_nowait(SqlSession(client))
+        # observability: peak concurrent leases + total waits
+        self.leases = 0
+        self.waits = 0
+
+    async def _acquire(self, conn: dict) -> SqlSession:
+        s = conn.get("session")
+        if s is not None:
+            return s                  # inside an explicit transaction
+        if self._pool.empty():
+            self.waits += 1
+        s = await self._pool.get()
+        self.leases += 1
+        conn["session"] = s
+        return s
+
+    async def _maybe_release(self, conn: dict) -> None:
+        s = conn.get("session")
+        if s is None:
+            return
+        if s._txn is not None:
+            return                    # BEGIN open: lease spans the txn
+        conn["session"] = None
+        self._pool.put_nowait(s)
+
+    async def _on_disconnect(self, conn: dict) -> None:
+        """A client that vanishes mid-transaction must not leak its
+        session or its locks: roll the transaction back, then return
+        the session."""
+        s = conn.pop("session", None)
+        if s is None:
+            return
+        if s._txn is not None:
+            try:
+                await s.execute("ROLLBACK")
+            except Exception:   # noqa: BLE001 — session must return
+                s._txn = None
+        self._pool.put_nowait(s)

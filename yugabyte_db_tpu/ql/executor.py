@@ -569,16 +569,83 @@ class SqlSession:
         push_limit = (stmt.limit
                       if not (stmt.distinct or stmt.offset or has_window)
                       and (natural or not stmt.order_by) else None)
+        if self._txn is not None and \
+                self._txn.pending_writes(stmt.table):
+            # the write-set overlay needs pk columns to match rows and
+            # WHERE columns to re-evaluate merged rows; and a pushed
+            # LIMIT would undercount once the overlay drops rows
+            # (_order_limit still applies the limit client-side)
+            columns = self._overlay_columns(columns, schema, where)
+            push_limit = None
         req = ReadRequest("", columns=tuple(columns), where=where,
                           read_ht=read_ht, limit=push_limit)
         resp = await self.client.scan(stmt.table, req,
                                       keep_all=natural)
         base_rows = resp.rows
+        if self._txn is not None:
+            base_rows = self._overlay_txn_writes(
+                stmt.table, schema, where, base_rows)
         if has_window:
             self._apply_windows(stmt, base_rows)
         rows = [self._project_row(stmt, r, schema) for r in base_rows]
         rows = self._order_limit(stmt, rows)
         return SqlResult(rows)
+
+    @staticmethod
+    def _overlay_columns(columns, schema, where):
+        """Extend a scan projection with the pk + WHERE columns the
+        txn write-set overlay needs (extras drop at projection time)."""
+        from ..ops.expr import referenced_columns
+        by_id = {c.id: c.name for c in schema.columns}
+        need = list(columns)
+        for c in schema.key_columns:
+            if c.name not in need:
+                need.append(c.name)
+        if where is not None:
+            for cid in referenced_columns(where):
+                name = by_id.get(cid)
+                if name is not None and name not in need:
+                    need.append(name)
+        return need
+
+    def _overlay_txn_writes(self, table: str, schema, where, rows):
+        """Read-your-own-writes for plain scans inside a transaction:
+        the txn's client-side write set replaces/adds/deletes rows over
+        the snapshot scan (reference: pggate buffered-operation reads).
+        Aggregate and grouped paths stay snapshot-only — their pushdown
+        results can't be patched row-wise."""
+        pend = self._txn.pending_writes(table)
+        if not pend:
+            return rows
+        from ..docdb.operations import eval_expr_py
+        pk_names = [c.name for c in schema.key_columns]
+
+        def keep(r: dict) -> bool:
+            if where is None:
+                return True
+            idrow = {c.id: r.get(c.name) for c in schema.columns}
+            return eval_expr_py(where, idrow) is True
+
+        out = []
+        seen = set()
+        for r in rows:
+            pk = tuple(r.get(k) for k in pk_names)
+            op = pend.get(pk)
+            if op is None:
+                out.append(r)
+                continue
+            seen.add(pk)
+            if op.kind == "delete":
+                continue
+            merged = {**r, **op.row}
+            if keep(merged):
+                out.append(merged)
+        for pk, op in pend.items():
+            if pk in seen or op.kind == "delete":
+                continue
+            if keep(op.row):
+                out.append(dict(op.row))
+        return out
 
     async def _try_index_path(self, stmt, ct, where_bound):
         """WHERE col = const (optionally AND residual) with a secondary
@@ -1208,14 +1275,27 @@ class SqlSession:
         pk_cols = [c.name for c in schema.key_columns]
         read_ht = self._txn.start_ht if self._txn is not None else None
         where = self._bind(stmt.where, schema)
+        scan_cols = tuple(pk_cols)
+        if self._txn is not None and self._txn.pending_writes(stmt.table):
+            # the overlay re-evaluates WHERE on merged rows: project
+            # the WHERE columns too or committed values read as NULL
+            scan_cols = tuple(self._overlay_columns(pk_cols, schema,
+                                                    where))
         resp = await self.client.scan(stmt.table, ReadRequest(
-            "", columns=tuple(pk_cols), where=where, read_ht=read_ht))
-        if not resp.rows:
+            "", columns=scan_cols, where=where, read_ht=read_ht))
+        rows = resp.rows
+        if self._txn is not None:
+            # targets include the txn's OWN uncommitted rows (and
+            # exclude ones it already deleted)
+            rows = [{k: r.get(k) for k in pk_cols}
+                    for r in self._overlay_txn_writes(
+                        stmt.table, schema, where, rows)]
+        if not rows:
             return SqlResult([], "DELETE 0")
         if self._txn is not None:
-            n = await self._txn.delete(stmt.table, resp.rows)
+            n = await self._txn.delete(stmt.table, rows)
         else:
-            n = await self.client.delete(stmt.table, resp.rows)
+            n = await self.client.delete(stmt.table, rows)
         return SqlResult([], f"DELETE {n}")
 
     async def _update(self, stmt: UpdateStmt) -> SqlResult:
@@ -1228,9 +1308,13 @@ class SqlSession:
         where = self._bind(stmt.where, schema)
         resp = await self.client.scan(stmt.table, ReadRequest(
             "", where=where, read_ht=read_ht))
-        if not resp.rows:
+        rows = resp.rows
+        if self._txn is not None:
+            rows = self._overlay_txn_writes(stmt.table, schema, where,
+                                            rows)
+        if not rows:
             return SqlResult([], "UPDATE 0")
-        updated = [dict(r, **stmt.sets) for r in resp.rows]
+        updated = [dict(r, **stmt.sets) for r in rows]
         if self._txn is not None:
             n = await self._txn.insert(stmt.table, updated)
         else:

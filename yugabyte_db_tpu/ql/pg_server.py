@@ -54,11 +54,31 @@ class PgServer:
             self._server.close()
 
     # ------------------------------------------------------------------
+    # --- session provisioning (overridden by the connection manager) ----
+    async def _acquire(self, conn: dict) -> SqlSession:
+        if conn.get("session") is None:
+            conn["session"] = SqlSession(self.client)
+        return conn["session"]
+
+    async def _maybe_release(self, conn: dict) -> None:
+        pass                # dedicated-session mode keeps it attached
+
+    async def _on_disconnect(self, conn: dict) -> None:
+        pass
+
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter):
-        session = SqlSession(self.client)
+        conn = {"session": None}
         prepared = {}       # name -> sql with $n placeholders
         portals = {}        # name -> bound sql
+
+        async def run_query(body, **kw):
+            s = await self._acquire(conn)
+            try:
+                await self._query(s, body, writer, **kw)
+            finally:
+                await self._maybe_release(conn)
+
         try:
             if not await self._startup(reader, writer):
                 return
@@ -70,7 +90,7 @@ class PgServer:
                 if tag == b"X":
                     break
                 if tag == b"Q":
-                    await self._query(session, body, writer)
+                    await run_query(body)
                 elif tag == b"P":           # Parse
                     name, sql = self._parse_msg(body)
                     prepared[name] = sql
@@ -84,10 +104,8 @@ class PgServer:
                     writer.write(_msg(b"n"))        # rows described at Execute
                 elif tag == b"E":           # Execute
                     portal = body.split(b"\x00")[0].decode()
-                    await self._query(session,
-                                      portals.get(portal, "").encode()
-                                      + b"\x00", writer,
-                                      suppress_ready=True)
+                    await run_query(portals.get(portal, "").encode()
+                                    + b"\x00", suppress_ready=True)
                 elif tag == b"C":           # Close
                     writer.write(_msg(b"3"))        # CloseComplete
                 elif tag == b"S":           # Sync
@@ -103,6 +121,7 @@ class PgServer:
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
+            await self._on_disconnect(conn)
             try:
                 writer.close()
             except Exception:

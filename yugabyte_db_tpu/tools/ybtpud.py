@@ -15,7 +15,7 @@ import sys
 from ..master import Master
 from ..ql import SqlSession
 from ..ql.cql_server import CqlServer
-from ..ql.pg_server import PgServer
+from ..ql.connection_manager import PooledPgServer
 from ..ql.redis_server import RedisServer
 from ..tserver import TabletServer
 from ..tserver.webserver import StatusWebServer
@@ -69,9 +69,12 @@ async def serve(args):
 
     from ..client import YBClient
     client = YBClient(maddr)
-    pg = PgServer(YBClient(maddr))
+    # the connection manager IS the front door (reference: YSQL
+    # Connection Manager/odyssey fronting the PG backends)
+    pg = PooledPgServer(YBClient(maddr), pool_size=args.pg_pool_size)
     paddr = await pg.start()
-    print(f"ysql (pg wire): {paddr[0]}:{paddr[1]}")
+    print(f"ysql (pg wire): {paddr[0]}:{paddr[1]} "
+          f"(pooled, {args.pg_pool_size} sessions)")
     cql = CqlServer(client)
     caddr = await cql.start()
     print(f"ycql          : {caddr[0]}:{caddr[1]}")
@@ -140,6 +143,8 @@ def main(argv=None):
     p.add_argument("--tserver-port", type=int, default=0)
     p.add_argument("--web-port", type=int, default=0)
     p.add_argument("--auto-balance", action="store_true")
+    p.add_argument("--pg-pool-size", type=int, default=16,
+                   help="connection-manager backend session pool size")
     p.add_argument("--shell", action="store_true", default=True)
     p.add_argument("--no-shell", dest="shell", action="store_false")
     args = p.parse_args(argv)
