@@ -1,0 +1,110 @@
+"""yugabyted-ui analog: a single-page cluster dashboard.
+
+Reference: the yugabyted-ui SPA (reference repo: yugabyted-ui/ — a Go
+API server + React app). Ours is one dependency-free HTML page served
+by the embedded status webserver: it polls the same JSON endpoints the
+CLI uses (/status /tables /tablet-servers /tablets /metrics.json /ash
+/xcluster-safe-time) and renders cluster health, table/tablet layout,
+leader distribution, and live wait-state sampling. Panels whose
+endpoint a particular server doesn't expose (e.g. tserver-only pages)
+gray out instead of failing.
+"""
+
+DASHBOARD_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ybtpu cluster</title>
+<style>
+ :root { color-scheme: light dark; }
+ body { font-family: system-ui, sans-serif; margin: 0; background: #f6f7f9; color: #1a1d21; }
+ @media (prefers-color-scheme: dark) { body { background: #14161a; color: #e6e8eb; } .card { background: #1d2026 !important; box-shadow: none !important; } th { color: #9aa3ad !important; } }
+ header { padding: 14px 22px; background: #22262d; color: #fff; display: flex; align-items: baseline; gap: 14px; }
+ header h1 { font-size: 17px; margin: 0; font-weight: 600; }
+ header .sub { color: #9aa3ad; font-size: 12.5px; }
+ #grid { display: grid; grid-template-columns: repeat(auto-fit, minmax(330px, 1fr)); gap: 14px; padding: 16px 22px; }
+ .card { background: #fff; border-radius: 8px; padding: 14px 16px; box-shadow: 0 1px 2px rgba(16,24,40,.06); }
+ .card h2 { font-size: 13px; margin: 0 0 10px; text-transform: uppercase; letter-spacing: .04em; color: #687076; }
+ table { border-collapse: collapse; width: 100%; font-size: 12.5px; }
+ th { text-align: left; font-weight: 600; color: #687076; padding: 3px 8px 3px 0; }
+ td { padding: 3px 8px 3px 0; font-variant-numeric: tabular-nums; }
+ .ok { color: #18794e; } .bad { color: #cd2b31; }
+ .pill { display: inline-block; padding: 1px 7px; border-radius: 999px; font-size: 11px; background: #e6f4ea; color: #18794e; }
+ .pill.down { background: #ffe5e5; color: #cd2b31; }
+ .muted { color: #889096; }
+ .num { font-size: 22px; font-weight: 650; }
+ #stats { display: flex; gap: 26px; }
+ .statlbl { font-size: 11.5px; color: #687076; text-transform: uppercase; letter-spacing: .04em; }
+</style></head><body>
+<header><h1>ybtpu</h1><span class="sub" id="hdr">connecting…</span></header>
+<div id="grid">
+ <div class="card" style="grid-column: 1 / -1"><div id="stats"></div></div>
+ <div class="card"><h2>Tablet servers</h2><div id="tservers" class="muted">—</div></div>
+ <div class="card"><h2>Tables</h2><div id="tables" class="muted">—</div></div>
+ <div class="card"><h2>Tablets</h2><div id="tablets" class="muted">—</div></div>
+ <div class="card"><h2>Active session history (60s)</h2><div id="ash" class="muted">—</div></div>
+ <div class="card"><h2>xCluster safe time</h2><div id="xcl" class="muted">—</div></div>
+</div>
+<script>
+async function j(path) {
+  try { const r = await fetch(path); if (!r.ok) return null; return await r.json(); }
+  catch (e) { return null; }
+}
+function tbl(head, rows) {
+  if (!rows.length) return '<span class="muted">none</span>';
+  return '<table><tr>' + head.map(h => `<th>${h}</th>`).join('') + '</tr>'
+    + rows.map(r => '<tr>' + r.map(c => `<td>${c}</td>`).join('') + '</tr>').join('') + '</table>';
+}
+function stat(label, value) {
+  return `<div><div class="num">${value}</div><div class="statlbl">${label}</div></div>`;
+}
+async function tick() {
+  const [st, ts, tables, tablets, ash, xcl] = await Promise.all([
+    j('/status'), j('/tablet-servers'), j('/tables'), j('/tablets'),
+    j('/ash'), j('/xcluster-safe-time')]);
+  document.getElementById('hdr').textContent =
+    st ? `cluster "${st.name}" · ${new Date().toLocaleTimeString()}` : 'unreachable';
+  const live = ts ? ts.filter(s => s.alive).length : 0;
+  const ntab = tablets ? tablets.length : 0;
+  const leaders = tablets ? tablets.filter(t => t.leader).length : 0;
+  document.getElementById('stats').innerHTML =
+    stat('tservers live', ts ? `${live}/${ts.length}` : '—')
+    + stat('tables', tables ? tables.length : '—') + stat('tablets', ntab)
+    + stat('with leader', ntab ? `${leaders}/${ntab}` : '—');
+  if (ts) document.getElementById('tservers').innerHTML = tbl(
+    ['uuid', 'address', 'zone', 'state', 'tablets', 'leaders'],
+    ts.map(s => [s.ts_uuid, (s.addr || []).join(':'), s.zone || '—',
+      s.alive ? '<span class="pill">LIVE</span>' : '<span class="pill down">DOWN</span>',
+      s.tablets ?? '—', s.leaders ?? '—']));
+  if (tables) document.getElementById('tables').innerHTML = tbl(
+    ['name', 'tablets', 'v', 'indexes', 'cdc'],
+    tables.map(t => [t.name, t.tablets, t.schema_version,
+                     (t.indexes || []).length, t.cdc_streams ?? 0]));
+  if (tablets) {
+    const byId = {};
+    (tables || []).forEach(t => byId[t.table_id] = t.name);
+    document.getElementById('tablets').innerHTML = tbl(
+      ['tablet', 'table', 'leader', 'replicas'],
+      tablets.slice(0, 40).map(t => [t.tablet_id,
+        byId[t.table_id] || t.table_id || '—',
+        t.leader || '<span class="bad">none</span>',
+        (t.replicas || []).length]))
+      + (tablets.length > 40 ? `<div class="muted">… ${tablets.length - 40} more</div>` : '');
+  }
+  if (ash) {
+    const h = ash.wait_states_last_60s || {};
+    const rows = Object.entries(h).sort((a, b) => b[1] - a[1]);
+    document.getElementById('ash').innerHTML = rows.length
+      ? tbl(['wait state', 'samples'], rows.map(([k, v]) => [k, v]))
+      : '<span class="muted">idle</span>';
+  }
+  if (xcl) {
+    const rows = Object.entries(xcl);
+    document.getElementById('xcl').innerHTML = rows.length
+      ? tbl(['table', 'safe hybrid time'], rows)
+      : '<span class="muted">no inbound replication</span>';
+  }
+}
+tick(); setInterval(tick, 2000);
+</script></body></html>"""
+
+
+def dashboard_handler():
+    return DASHBOARD_HTML, "text/html"

@@ -19,7 +19,9 @@ from ..utils.trace import ASH, TRACES
 class StatusWebServer:
     def __init__(self, owner_name: str, extra_handlers: Optional[Dict] = None):
         self.owner_name = owner_name
+        from .ui import dashboard_handler
         self.handlers: Dict[str, Callable[[], Tuple[str, str]]] = {
+            "/": dashboard_handler,      # yugabyted-ui analog (SPA)
             "/metrics": self._metrics_prom,
             "/metrics.json": self._metrics_json,
             "/rpcz": self._rpcz,
