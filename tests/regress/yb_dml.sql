@@ -1,0 +1,15 @@
+-- basic DDL / DML / constraints of the core engine
+CREATE TABLE accounts (id bigint, owner text, balance double, PRIMARY KEY (id)) WITH tablets = 2;
+INSERT INTO accounts (id, owner, balance) VALUES (1, 'alice', 100.0), (2, 'bob', 250.5), (3, 'carol', 0.0);
+SELECT owner, balance FROM accounts WHERE balance > 50 ORDER BY id;
+UPDATE accounts SET balance = 300.0 WHERE owner = 'bob';
+SELECT sum(balance), count(*), min(balance), max(balance) FROM accounts;
+DELETE FROM accounts WHERE balance = 0.0;
+SELECT count(*) FROM accounts;
+SELECT owner FROM accounts WHERE owner LIKE 'a%';
+INSERT INTO accounts (id, owner) VALUES (4, 'dave');
+SELECT owner, balance FROM accounts WHERE balance IS NULL;
+SELECT id FROM accounts WHERE id IN (1, 4, 99) ORDER BY id;
+SELECT count(*) FROM accounts WHERE owner IN (SELECT owner FROM accounts WHERE balance > 200);
+DROP TABLE accounts;
+SELECT count(*) FROM accounts

@@ -1,0 +1,14 @@
+-- joins, grouping, HAVING, CTEs, windows
+CREATE TABLE dept (d bigint, dname text, PRIMARY KEY (d)) WITH tablets = 1;
+CREATE TABLE emp (e bigint, d bigint, sal double, PRIMARY KEY (e)) WITH tablets = 2;
+INSERT INTO dept (d, dname) VALUES (1, 'eng'), (2, 'ops'), (3, 'empty');
+INSERT INTO emp (e, d, sal) VALUES (10, 1, 100.0), (11, 1, 200.0), (12, 2, 150.0), (13, 99, 10.0);
+SELECT dname, sal FROM emp JOIN dept ON emp.d = dept.d ORDER BY sal;
+SELECT dname, sal FROM emp LEFT JOIN dept ON emp.d = dept.d ORDER BY sal;
+SELECT dname FROM emp RIGHT JOIN dept ON emp.d = dept.d WHERE sal IS NULL ORDER BY dname;
+SELECT d, sum(sal) AS total FROM emp GROUP BY d HAVING sum(sal) > 50 ORDER BY d;
+WITH rich AS (SELECT e, sal FROM emp WHERE sal >= 150) SELECT count(*) FROM rich;
+SELECT e, sal, rank() OVER (ORDER BY sal DESC) AS r FROM emp ORDER BY r LIMIT 3;
+SELECT d, avg(sal) FROM emp GROUP BY d ORDER BY d;
+DROP TABLE emp;
+DROP TABLE dept

@@ -1,0 +1,15 @@
+-- transactions: atomicity, rollback, read-your-own-writes
+CREATE TABLE t (k bigint, v double, PRIMARY KEY (k)) WITH tablets = 2;
+INSERT INTO t (k, v) VALUES (1, 10.0);
+BEGIN;
+INSERT INTO t (k, v) VALUES (2, 20.0);
+UPDATE t SET v = 11.0 WHERE k = 1;
+SELECT k, v FROM t ORDER BY k;
+ROLLBACK;
+SELECT k, v FROM t ORDER BY k;
+BEGIN;
+DELETE FROM t WHERE k = 1;
+SELECT count(*) FROM t WHERE k = 2;
+COMMIT;
+SELECT k FROM t;
+DROP TABLE t

@@ -1,0 +1,18 @@
+-- type depth: timestamps + intervals, decimals, booleans, text ops
+CREATE TABLE ev (id bigint, at timestamp, amt decimal, flag bool, note text, PRIMARY KEY (id)) WITH tablets = 1;
+INSERT INTO ev (id, at, amt, flag, note) VALUES (1, TIMESTAMP '2024-01-01 00:00:00', 10.25, true, 'alpha'), (2, TIMESTAMP '2024-06-15 12:30:00', 0.75, false, 'beta'), (3, TIMESTAMP '2025-01-01 00:00:00', 100.00, true, 'gamma');
+SELECT id FROM ev WHERE at >= TIMESTAMP '2024-06-01 00:00:00' ORDER BY id;
+SELECT id FROM ev WHERE at < TIMESTAMP '2024-01-01 00:00:00' + INTERVAL '45 days';
+SELECT sum(amt) FROM ev;
+SELECT id, amt * 2 AS dbl FROM ev WHERE flag = true ORDER BY id;
+SELECT count(*) FROM ev WHERE flag = false;
+SELECT note FROM ev WHERE note LIKE '%a' ORDER BY note;
+SELECT id, CASE WHEN amt > 50 THEN 'big' ELSE 'small' END AS size FROM ev ORDER BY id;
+SELECT min(at) FROM ev;
+UPDATE ev SET amt = 12.50 WHERE id = 2;
+SELECT id, amt FROM ev WHERE id = 2;
+CREATE TABLE ev2 (id bigint, amt decimal, PRIMARY KEY (id)) WITH tablets = 1;
+INSERT INTO ev2 (id, amt) SELECT id, amt * 2 FROM ev WHERE flag = true;
+SELECT id, amt FROM ev2 ORDER BY id;
+DROP TABLE ev2;
+DROP TABLE ev

@@ -104,6 +104,12 @@ def eval_expr_py(node: tuple, row: Dict[int, object]):
     kind = node[0]
     if kind == "col":
         return row.get(node[1])
+    if kind == "case":
+        n = node[1]
+        for i in range(n):
+            if eval_expr_py(node[2 + 2 * i], row) is True:
+                return eval_expr_py(node[3 + 2 * i], row)
+        return eval_expr_py(node[2 + 2 * n], row)
     if kind == "const":
         return node[1]
     if kind == "cmp":

@@ -399,6 +399,7 @@ class SqlSession:
                 return SqlResult([], "INSERT 0")
         vec_cols = {c.name for c in ct.info.schema.columns
                     if c.type == ColumnType.VECTOR}
+        dec_cols = _decimal_cols(ct.info.schema)
         rows = []
         for vals in stmt.rows:
             if len(vals) != len(cols):
@@ -408,6 +409,7 @@ class SqlSession:
                 if row[vc] is not None and not isinstance(
                         row[vc], (bytes, bytearray)):
                     row[vc] = parse_vector(row[vc]).tobytes()
+            self._coerce_decimals(dec_cols, row)
             rows.append(row)
         if self._txn is not None:
             n = await self._txn.insert(stmt.table, rows)
@@ -1298,6 +1300,15 @@ class SqlSession:
             n = await self.client.delete(stmt.table, rows)
         return SqlResult([], f"DELETE {n}")
 
+    @staticmethod
+    def _coerce_decimals(dec_cols, row: dict) -> None:
+        """DECIMAL stores as text: numeric values (literals, Decimal
+        results of INSERT..SELECT arithmetic, UPDATE SET values)
+        coerce to their canonical string form before packing."""
+        for dc in dec_cols & set(row):
+            if row[dc] is not None and not isinstance(row[dc], str):
+                row[dc] = str(row[dc])
+
     async def _update(self, stmt: UpdateStmt) -> SqlResult:
         self._invalidate_stats(stmt.table)
         if stmt.where is not None:
@@ -1315,11 +1326,19 @@ class SqlSession:
         if not rows:
             return SqlResult([], "UPDATE 0")
         updated = [dict(r, **stmt.sets) for r in rows]
+        dec_cols = _decimal_cols(schema)
+        for r in updated:
+            self._coerce_decimals(dec_cols, r)
         if self._txn is not None:
             n = await self._txn.insert(stmt.table, updated)
         else:
             n = await self.client.insert(stmt.table, updated)
         return SqlResult([], f"UPDATE {n}")
+
+
+def _decimal_cols(schema) -> set:
+    return {c.name for c in schema.columns
+            if c.type == ColumnType.DECIMAL}
 
 
 def _eval_by_name(node, row: dict):

@@ -34,7 +34,8 @@ KEYWORDS = {
     "explain", "analyze",
     "alter", "add", "column", "join", "inner", "left", "outer",
     "right", "full", "over", "partition", "interval", "timestamp",
-    "date", "cast",
+    "date", "cast", "case", "when", "then", "else", "end", "true",
+    "false",
 }
 
 # window functions (besides the aggregate ops)
@@ -480,6 +481,18 @@ class Parser:
             return t[1]
         if t[0] == "kw" and t[1].lower() == "null":
             return None
+        if t[0] == "kw" and t[1].lower() in ("true", "false"):
+            return t[1].lower() == "true"
+        if t[0] == "kw" and t[1].lower() in ("timestamp", "date"):
+            nxt = self.next()
+            if nxt[0] != "str":
+                raise ValueError(f"expected string after {t[1]}")
+            return parse_timestamp_micros(nxt[1])
+        if t[0] == "kw" and t[1].lower() == "interval":
+            nxt = self.next()
+            if nxt[0] != "str":
+                raise ValueError("expected string after INTERVAL")
+            return parse_interval_micros(nxt[1])
         if t[0] == "op" and t[1] == "-":
             v = self.literal()
             return -v
@@ -825,10 +838,29 @@ class Parser:
             self.expect_op(")")
             return ("fn", "cast_" + ty, inner)
         if t[0] in ("num", "str") or (t[0] == "kw"
-                                      and t[1].lower() == "null"):
+                                      and t[1].lower() in
+                                      ("null", "true", "false")):
             return ("const", self.literal())
         if t[0] == "op" and t[1] == "-":
             return ("const", self.literal())
+        if t[0] == "kw" and t[1].lower() == "case":
+            # searched CASE: WHEN cond THEN val ... [ELSE val] END
+            # AST is flattened so generic walkers recurse children:
+            # ("case", n_pairs, c1, v1, ..., cn, vn, else_node)
+            self.next()
+            parts = []
+            n_pairs = 0
+            while self.accept_kw("when"):
+                parts.append(self.expr())
+                self.expect_kw("then")
+                parts.append(self.expr())
+                n_pairs += 1
+            if not n_pairs:
+                raise ValueError("CASE requires at least one WHEN")
+            els = self.expr() if self.accept_kw("else") \
+                else ("const", None)
+            self.expect_kw("end")
+            return ("case", n_pairs, *parts, els)
         name = self.ident()
         # scalar function call: now(), coalesce(a, b), upper(x), ...
         if name.lower() in SCALAR_FNS and self.accept_op("("):

@@ -47,13 +47,20 @@ async function j(path) {
   try { const r = await fetch(path); if (!r.ok) return null; return await r.json(); }
   catch (e) { return null; }
 }
+function esc(v) {
+  return String(v).replace(/[&<>"']/g,
+    c => ({'&': '&amp;', '<': '&lt;', '>': '&gt;',
+           '"': '&quot;', "'": '&#39;'}[c]));
+}
+// cells render escaped; a cell may opt into markup via {html: "..."}
+function cell(c) { return (c && c.html !== undefined) ? c.html : esc(c); }
 function tbl(head, rows) {
   if (!rows.length) return '<span class="muted">none</span>';
-  return '<table><tr>' + head.map(h => `<th>${h}</th>`).join('') + '</tr>'
-    + rows.map(r => '<tr>' + r.map(c => `<td>${c}</td>`).join('') + '</tr>').join('') + '</table>';
+  return '<table><tr>' + head.map(h => `<th>${esc(h)}</th>`).join('') + '</tr>'
+    + rows.map(r => '<tr>' + r.map(c => `<td>${cell(c)}</td>`).join('') + '</tr>').join('') + '</table>';
 }
 function stat(label, value) {
-  return `<div><div class="num">${value}</div><div class="statlbl">${label}</div></div>`;
+  return `<div><div class="num">${esc(value)}</div><div class="statlbl">${esc(label)}</div></div>`;
 }
 async function tick() {
   const [st, ts, tables, tablets, ash, xcl] = await Promise.all([
@@ -71,7 +78,7 @@ async function tick() {
   if (ts) document.getElementById('tservers').innerHTML = tbl(
     ['uuid', 'address', 'zone', 'state', 'tablets', 'leaders'],
     ts.map(s => [s.ts_uuid, (s.addr || []).join(':'), s.zone || '—',
-      s.alive ? '<span class="pill">LIVE</span>' : '<span class="pill down">DOWN</span>',
+      s.alive ? {html: '<span class="pill">LIVE</span>'} : {html: '<span class="pill down">DOWN</span>'},
       s.tablets ?? '—', s.leaders ?? '—']));
   if (tables) document.getElementById('tables').innerHTML = tbl(
     ['name', 'tablets', 'v', 'indexes', 'cdc'],
@@ -84,7 +91,7 @@ async function tick() {
       ['tablet', 'table', 'leader', 'replicas'],
       tablets.slice(0, 40).map(t => [t.tablet_id,
         byId[t.table_id] || t.table_id || '—',
-        t.leader || '<span class="bad">none</span>',
+        t.leader || {html: '<span class="bad">none</span>'},
         (t.replicas || []).length]))
       + (tablets.length > 40 ? `<div class="muted">… ${tablets.length - 40} more</div>` : '');
   }
