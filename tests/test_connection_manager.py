@@ -194,3 +194,80 @@ class TestReadYourOwnWrites:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestAggregateReadYourOwnWrites:
+    """Aggregates and GROUP BY inside a transaction see the txn's own
+    uncommitted writes (scalar + grouped client-side folds over the
+    overlaid scan; previously snapshot-only)."""
+
+    def test_scalar_aggregates_see_pending_writes(self, tmp_path):
+        async def go():
+            from yugabyte_db_tpu.ql.executor import SqlSession
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            s = SqlSession(mc.client())
+            try:
+                await s.execute("CREATE TABLE ag (k bigint, v double, "
+                                "PRIMARY KEY (k)) WITH tablets = 1")
+                await s.execute("INSERT INTO ag (k, v) VALUES "
+                                "(1, 10.0), (2, 20.0), (3, 30.0)")
+                await s.execute("BEGIN")
+                await s.execute("INSERT INTO ag (k, v) VALUES (4, 40.0)")
+                await s.execute("UPDATE ag SET v = 25.0 WHERE k = 2")
+                await s.execute("DELETE FROM ag WHERE k = 1")
+                r = await s.execute(
+                    "SELECT count(*), sum(v), avg(v), min(v), max(v) "
+                    "FROM ag")
+                row = r.rows[0]
+                vals = list(row.values())
+                assert vals[0] == 3, row
+                assert abs(vals[1] - 95.0) < 1e-9, row
+                assert abs(vals[2] - 95.0 / 3) < 1e-9, row
+                assert vals[3] == 25.0 and vals[4] == 40.0, row
+                # WHERE + aggregate sees merged rows too
+                r = await s.execute(
+                    "SELECT count(*) FROM ag WHERE v >= 25.0")
+                assert list(r.rows[0].values())[0] == 3
+                await s.execute("ROLLBACK")
+                r = await s.execute("SELECT count(*), sum(v) FROM ag")
+                vals = list(r.rows[0].values())
+                assert vals[0] == 3 and abs(vals[1] - 60.0) < 1e-9
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_grouped_aggregates_see_pending_writes(self, tmp_path):
+        async def go():
+            from yugabyte_db_tpu.ql.executor import SqlSession
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            s = SqlSession(mc.client())
+            try:
+                await s.execute("CREATE TABLE gg (k bigint, grp text, "
+                                "v double, PRIMARY KEY (k)) "
+                                "WITH tablets = 1")
+                await s.execute(
+                    "INSERT INTO gg (k, grp, v) VALUES "
+                    "(1, 'a', 1.0), (2, 'a', 2.0), (3, 'b', 3.0)")
+                await s.execute("BEGIN")
+                await s.execute(
+                    "INSERT INTO gg (k, grp, v) VALUES (4, 'b', 7.0)")
+                await s.execute("DELETE FROM gg WHERE k = 1")
+                r = await s.execute(
+                    "SELECT grp, sum(v) FROM gg GROUP BY grp")
+                got = {row["grp"]: list(row.values())[1]
+                       for row in r.rows}
+                assert got == {"a": 2.0, "b": 10.0}, got
+                # HAVING over the merged groups
+                r = await s.execute(
+                    "SELECT grp, sum(v) FROM gg GROUP BY grp "
+                    "HAVING sum(v) > 5.0")
+                assert [row["grp"] for row in r.rows] == ["b"], r.rows
+                await s.execute("ROLLBACK")
+                r = await s.execute(
+                    "SELECT grp, sum(v) FROM gg GROUP BY grp")
+                got = {row["grp"]: list(row.values())[1]
+                       for row in r.rows}
+                assert got == {"a": 3.0, "b": 3.0}, got
+            finally:
+                await mc.shutdown()
+        run(go())

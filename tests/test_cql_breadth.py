@@ -205,3 +205,104 @@ class TestCollections:
                 await srv.shutdown()
                 await mc.shutdown()
         run(go())
+
+
+class TestCollectionTypesSurviveRestart:
+    def test_new_server_recovers_collection_typing(self, tmp_path):
+        """Collection typing is persisted in the catalog
+        (ColumnSchema.ql_type), not just learned from CREATE TABLE in
+        the serving process — a fresh CqlServer over the same cluster
+        must still encode list/set/map columns with real CQL type ids
+        (reference: QLTypePB params in DocDB schema)."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            srv = CqlServer(mc.client())
+            addr = await srv.start()
+            reader, writer = await asyncio.open_connection(*addr)
+            await cql_frame(writer, reader, 0x01, struct.pack(">H", 0))
+            op, _ = await cql_frame(writer, reader, 0x07, longstr(
+                "CREATE TABLE coll2 (k bigint, tags set<text>, "
+                "names list<text>, PRIMARY KEY (k))"))
+            assert op == 0x08
+            await mc.wait_for_leaders("coll2")
+            op, body = await cql_frame(writer, reader, 0x07, longstr(
+                "INSERT INTO coll2 (k, tags, names) VALUES "
+                "(1, {'b', 'a'}, ['x', 'y'])"))
+            assert op == 0x08, body
+            writer.close()
+            await srv.shutdown()
+
+            # "restart": a brand-new server with no session-local state
+            srv2 = CqlServer(mc.client())
+            addr2 = await srv2.start()
+            try:
+                r2, w2 = await asyncio.open_connection(*addr2)
+                await cql_frame(w2, r2, 0x01, struct.pack(">H", 0))
+                op, body = await cql_frame(w2, r2, 0x07, longstr(
+                    "SELECT tags, names FROM coll2 WHERE k = 1"))
+                assert op == 0x08, body
+                cols, rows = parse_rows(body)
+                assert [t for _, t in cols] == [0x22, 0x20], cols
+                # system_schema.columns reports the collection type too
+                op, body = await cql_frame(w2, r2, 0x07, longstr(
+                    "SELECT * FROM system_schema.columns"))
+                assert op == 0x08
+                assert b"set<text>" in body and b"list<text>" in body
+                w2.close()
+            finally:
+                await srv2.shutdown()
+                await mc.shutdown()
+        run(go())
+
+    def test_alter_add_collection_refreshes_typing(self, tmp_path):
+        """A collection column added via ALTER TABLE (even through a
+        different server) must encode with its real CQL type id — the
+        catalog latch is dropped on ALTER and ql_type flows through
+        alter_table."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            srv = CqlServer(mc.client())
+            addr = await srv.start()
+            try:
+                r, w = await asyncio.open_connection(*addr)
+                await cql_frame(w, r, 0x01, struct.pack(">H", 0))
+                op, _ = await cql_frame(w, r, 0x07, longstr(
+                    "CREATE TABLE coll3 (k bigint, v double, "
+                    "PRIMARY KEY (k))"))
+                assert op == 0x08
+                await mc.wait_for_leaders("coll3")
+                # query first so the table enters the loaded latch
+                op, _ = await cql_frame(w, r, 0x07, longstr(
+                    "INSERT INTO coll3 (k, v) VALUES (1, 2.0)"))
+                assert op == 0x08
+                op, _ = await cql_frame(w, r, 0x07, longstr(
+                    "SELECT v FROM coll3 WHERE k = 1"))
+                assert op == 0x08
+                # ALTER through a DIFFERENT server (session-local
+                # learning can't see it)
+                other = CqlServer(mc.client())
+                oaddr = await other.start()
+                r2, w2 = await asyncio.open_connection(*oaddr)
+                await cql_frame(w2, r2, 0x01, struct.pack(">H", 0))
+                op, _ = await cql_frame(w2, r2, 0x07, longstr(
+                    "ALTER TABLE coll3 ADD tags set<text>"))
+                assert op in (0x08,), op
+                w2.close()
+                await other.shutdown()
+                # first server: its client cache is stale, but the
+                # binding-miss refresh retries the statement and the
+                # version-keyed typing latch refreshes with it — no
+                # restart, no extra ALTER through this server needed
+                op, body = await cql_frame(w, r, 0x07, longstr(
+                    "INSERT INTO coll3 (k, tags) VALUES (2, {'x','y'})"))
+                assert op == 0x08, body
+                op, body = await cql_frame(w, r, 0x07, longstr(
+                    "SELECT tags FROM coll3 WHERE k = 2"))
+                assert op == 0x08, body
+                cols, rows = parse_rows(body)
+                assert [t for _, t in cols] == [0x22], cols
+                w.close()
+            finally:
+                await srv.shutdown()
+                await mc.shutdown()
+        run(go())

@@ -1,0 +1,91 @@
+"""Orphan-replica GC: the master's catalog-driven sweep deletes
+replicas a tserver keeps reporting that the catalog no longer maps to
+it (reference analog: tablet-report reconciliation issuing DeleteTablet
+from ProcessTabletReportBatch, master_heartbeat_service.cc:854)."""
+import asyncio
+import os
+
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+from yugabyte_db_tpu.utils import flags
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _wait(cond, timeout=15.0, interval=0.1):
+    t0 = asyncio.get_event_loop().time()
+    while asyncio.get_event_loop().time() - t0 < timeout:
+        if cond():
+            return True
+        await asyncio.sleep(interval)
+    return cond()
+
+
+class TestOrphanReplicaGC:
+    def test_stray_replica_deleted_after_grace(self, tmp_path):
+        async def go():
+            flags.set_flag("master_orphan_gc_grace_s", 1.0)
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                c = mc.client()
+                from tests.test_load_balancer import kv_info
+                await c.create_table(kv_info("kv"), num_tablets=1)
+                await mc.wait_for_leaders("kv")
+                await c.insert("kv", [{"k": 1, "v": 1.0}])
+                ts = mc.tservers[0]
+                legit = set(ts.peers)
+                # plant a stray replica the catalog knows nothing about
+                # (e.g. a split child left behind by an interrupted
+                # split, or a lost delete_tablet after a move)
+                ent = mc.master.tablets[next(iter(legit))]
+                await ts.rpc_create_tablet({
+                    "tablet_id": "stray-tablet-001",
+                    "table": dict(mc.master.tables[ent["table_id"]]
+                                  ["info"]),
+                    "partition": ent["partition"],
+                    "raft_peers": [[ts.uuid,
+                                    list(ts.messenger.addr)]],
+                })
+                assert "stray-tablet-001" in ts.peers
+                ok = await _wait(
+                    lambda: "stray-tablet-001" not in ts.peers)
+                assert ok, "orphan replica was not GCed"
+                assert not os.path.exists(
+                    ts._tablet_dir("stray-tablet-001"))
+                # catalog-mapped replicas survive the sweep
+                assert legit <= set(ts.peers)
+                rows = await c.get("kv", {"k": 1})
+                assert rows["v"] == 1.0
+            finally:
+                flags.set_flag("master_orphan_gc_grace_s", 20.0)
+                await mc.shutdown()
+        run(go())
+
+    def test_orphan_within_grace_survives(self, tmp_path):
+        async def go():
+            flags.set_flag("master_orphan_gc_grace_s", 3600.0)
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                c = mc.client()
+                from tests.test_load_balancer import kv_info
+                await c.create_table(kv_info("kv"), num_tablets=1)
+                await mc.wait_for_leaders("kv")
+                ts = mc.tservers[0]
+                ent = mc.master.tablets[next(iter(ts.peers))]
+                await ts.rpc_create_tablet({
+                    "tablet_id": "stray-tablet-002",
+                    "table": dict(mc.master.tables[ent["table_id"]]
+                                  ["info"]),
+                    "partition": ent["partition"],
+                    "raft_peers": [[ts.uuid,
+                                    list(ts.messenger.addr)]],
+                })
+                # several heartbeat + sweep cycles inside the grace
+                # window: the replica must NOT be condemned yet
+                await asyncio.sleep(2.5)
+                assert "stray-tablet-002" in ts.peers
+            finally:
+                flags.set_flag("master_orphan_gc_grace_s", 20.0)
+                await mc.shutdown()
+        run(go())

@@ -251,7 +251,8 @@ class XClusterReplicator:
         tgt_ct = await self.target._table(self.table, refresh=True)
         src_cols = {c.name: c for c in src_ct.info.schema.columns}
         tgt_cols = {c.name: c for c in tgt_ct.info.schema.columns}
-        adds = [(c.name, c.type) for name, c in src_cols.items()
+        adds = [(c.name, c.type, getattr(c, "ql_type", None))
+                for name, c in src_cols.items()
                 if name not in tgt_cols and not c.is_key]
         drops = [name for name, c in tgt_cols.items()
                  if name not in src_cols and not c.is_key]

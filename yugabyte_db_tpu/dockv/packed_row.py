@@ -71,6 +71,11 @@ class ColumnSchema:
     is_hash_key: bool = False
     is_range_key: bool = False
     sort_desc: bool = False   # range column sort order
+    # original query-layer type when richer than the storage type —
+    # e.g. a CQL collection ("list<text>") stored as JSON. Persisted in
+    # the catalog so wire servers recover element typing after restart
+    # (reference: QLTypePB params in common/ql_type.proto)
+    ql_type: "str | None" = None
 
     @property
     def is_key(self) -> bool:

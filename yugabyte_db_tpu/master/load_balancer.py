@@ -108,6 +108,10 @@ class ClusterLoadBalancer:
             + [[to_uuid, list(m.tservers[to_uuid]["addr"]), "observer"]]
         add_peers = cur_peers \
             + [[to_uuid, list(m.tservers[to_uuid]["addr"])]]
+        # the destination hosts the replica before the catalog records
+        # it (create_tablet precedes the replicas commit) — shield it
+        # from the orphan sweep for the whole move
+        m._gc_inflight.add((to_uuid, tablet_id))
         try:
             # 0. checkpoint the current leader so the new replica can
             #    remote-bootstrap instead of replaying the whole log
@@ -164,6 +168,8 @@ class ClusterLoadBalancer:
             return True
         except (RpcError, asyncio.TimeoutError, OSError):
             return False
+        finally:
+            m._gc_inflight.discard((to_uuid, tablet_id))
 
     async def _leader_change_config(self, ent, tablet_id, peers):
         await self._leader_call(ent, tablet_id, "change_config",

@@ -55,6 +55,12 @@ class Master:
         # replication-slot metadata in cdcsdk_virtual_wal.cc)
         self.replication_slots: Dict[str, dict] = {}
         self._xcluster_tasks: Dict[str, object] = {}
+        # (ts_uuid, tablet_id) -> first time reported as orphaned
+        self._orphan_seen: Dict[Tuple[str, str], float] = {}
+        # placements legitimately created ahead of their catalog commit
+        # (e.g. a move destination between create_tablet and the
+        # replicas update) — the orphan sweep must not touch them
+        self._gc_inflight: set = set()
         self._xcluster_reconcile_lock = asyncio.Lock()
         self.auto_balance = False   # ticked explicitly or via enable
         # sys-catalog Raft (None = standalone single master, still
@@ -181,6 +187,10 @@ class Master:
             if self.is_leader():
                 try:
                     await self._gc_hidden_tablets()
+                except Exception:   # noqa: BLE001
+                    pass
+                try:
+                    await self._gc_orphan_replicas()
                 except Exception:   # noqa: BLE001
                     pass
             await asyncio.sleep(1.0)
@@ -327,24 +337,33 @@ class Master:
                 "partition": [p.start.hex(), p.end.hex()],
                 "replicas": replicas, "leader": None,
             }
-        # create replicas on tservers
+        # create replicas on tservers — shielded from the orphan sweep
+        # until the catalog commit below records them (a many-tablet
+        # create on slow tservers can outlast any grace window)
         is_status = payload.get("is_status_tablet", False)
-        for tablet_id, ent in tablet_entries.items():
-            raft_peers = [[u, list(self.tservers[u]["addr"])]
-                          for u in ent["replicas"]]
-            for u in ent["replicas"]:
-                await self.messenger.call(
-                    self.tservers[u]["addr"], "tserver", "create_tablet",
-                    {"tablet_id": tablet_id, "table": info_wire,
-                     "partition": ent["partition"],
-                     "raft_peers": raft_peers,
-                     "is_status_tablet": is_status},
-                    timeout=10.0)
-        ops = [["put_table", table_id,
-                {"info": info_wire, "tablets": list(tablet_entries)}]]
-        ops += [["put_tablet", tid_, ent]
-                for tid_, ent in tablet_entries.items()]
-        await self._commit_catalog(ops)
+        shield = {(u, tid_) for tid_, ent in tablet_entries.items()
+                  for u in ent["replicas"]}
+        self._gc_inflight |= shield
+        try:
+            for tablet_id, ent in tablet_entries.items():
+                raft_peers = [[u, list(self.tservers[u]["addr"])]
+                              for u in ent["replicas"]]
+                for u in ent["replicas"]:
+                    await self.messenger.call(
+                        self.tservers[u]["addr"], "tserver",
+                        "create_tablet",
+                        {"tablet_id": tablet_id, "table": info_wire,
+                         "partition": ent["partition"],
+                         "raft_peers": raft_peers,
+                         "is_status_tablet": is_status},
+                        timeout=10.0)
+            ops = [["put_table", table_id,
+                    {"info": info_wire, "tablets": list(tablet_entries)}]]
+            ops += [["put_tablet", tid_, ent]
+                    for tid_, ent in tablet_entries.items()]
+            await self._commit_catalog(ops)
+        finally:
+            self._gc_inflight -= shield
         return {"table_id": table_id, "tablets": list(tablet_entries)}
 
     async def _create_colocated(self, payload, table_id, info_wire) -> dict:
@@ -414,10 +433,12 @@ class Master:
             (c.id for sch in (tuple(info.schema_history) + (info.schema,))
              for c in sch.columns), default=0)
         from ..dockv.packed_row import ColumnSchema as _CS
-        for cname, ctype in payload.get("add_columns", []):
+        for entry in payload.get("add_columns", []):
+            cname, ctype = entry[0], entry[1]
+            ql = entry[2] if len(entry) > 2 else None
             if any(c.name == cname for c in cols):
                 raise RpcError(f"column {cname} exists", "ALREADY_PRESENT")
-            cols.append(_CS(next_id, cname, ctype))
+            cols.append(_CS(next_id, cname, ctype, ql_type=ql))
             next_id += 1
         indexed = {spec.get("column")
                    for spec in ent.get("indexes", {}).values()}
@@ -751,30 +772,40 @@ class Master:
         info_wire["table_id"] = new_tid
         info_wire["name"] = new_name
         manifest = e["snapshots"][snapshot_id]["manifest"]
+        # shield the clone's tablets from the orphan sweep until the
+        # catalog commit records them
+        shield = {(m["ts_uuid"], f"{new_tid}-t{i}")
+                  for i, m in enumerate(manifest)}
+        self._gc_inflight |= shield
         tablet_entries = {}
-        for i, m in enumerate(manifest):
-            child = f"{new_tid}-t{i}"
-            u = m["ts_uuid"]
-            ts = self.tservers.get(u)
-            if ts is None:
-                raise RpcError(f"tserver {u} holding snapshot is gone",
-                               "SERVICE_UNAVAILABLE")
-            await self.messenger.call(
-                ts["addr"], "tserver", "create_tablet",
-                {"tablet_id": child, "table": info_wire,
-                 "partition": m["partition"],
-                 "raft_peers": [[u, list(ts["addr"])]],
-                 "seed_snapshot_dir": m["dir"],
-                 "trim_above_ht": e["snapshots"][snapshot_id].get(
-                     "snapshot_ht")}, timeout=30.0)
-            tablet_entries[child] = {
-                "tablet_id": child, "table_id": new_tid,
-                "partition": m["partition"], "replicas": [u],
-                "leader": None}
-        ops = [["put_table", new_tid,
-                {"info": info_wire, "tablets": list(tablet_entries)}]]
-        ops += [["put_tablet", t, e] for t, e in tablet_entries.items()]
-        await self._commit_catalog(ops)
+        try:
+            for i, m in enumerate(manifest):
+                child = f"{new_tid}-t{i}"
+                u = m["ts_uuid"]
+                ts = self.tservers.get(u)
+                if ts is None:
+                    raise RpcError(
+                        f"tserver {u} holding snapshot is gone",
+                        "SERVICE_UNAVAILABLE")
+                await self.messenger.call(
+                    ts["addr"], "tserver", "create_tablet",
+                    {"tablet_id": child, "table": info_wire,
+                     "partition": m["partition"],
+                     "raft_peers": [[u, list(ts["addr"])]],
+                     "seed_snapshot_dir": m["dir"],
+                     "trim_above_ht": e["snapshots"][snapshot_id].get(
+                         "snapshot_ht")}, timeout=30.0)
+                tablet_entries[child] = {
+                    "tablet_id": child, "table_id": new_tid,
+                    "partition": m["partition"], "replicas": [u],
+                    "leader": None}
+            ops = [["put_table", new_tid,
+                    {"info": info_wire, "tablets": list(tablet_entries)}]]
+            ops += [["put_tablet", t, e]
+                    for t, e in tablet_entries.items()]
+            await self._commit_catalog(ops)
+        finally:
+            self._gc_inflight -= shield
         return {"table_id": new_tid}
 
     # --- tablet splitting (reference: master/tablet_split_manager.cc) ------
@@ -1134,6 +1165,60 @@ class Master:
                     pass
             await self._commit_catalog([["del_tablet", tid]])
 
+    async def _gc_orphan_replicas(self) -> None:
+        """Catalog-driven orphan sweep: a replica a live tserver keeps
+        reporting that the catalog does not map to it — a deleted
+        table's tablet, a stray split child from an interrupted split,
+        a move source whose delete_tablet RPC was lost — is deleted on
+        that tserver after a grace period spanning several heartbeats
+        (reference: tablet-report reconciliation sending DeleteTablet
+        in ProcessTabletReportBatch, master_heartbeat_service.cc:854).
+        Leader-only, gated on term-start catalog catch-up so a freshly
+        elected leader's half-loaded catalog can't condemn replicas."""
+        if self.consensus is not None and \
+                self.consensus.last_applied < self.consensus.term_start_index:
+            return
+        now = time.monotonic()
+        grace = float(flags.get("master_orphan_gc_grace_s"))
+        live = set(self.live_tservers())
+        seen: Dict[Tuple[str, str], float] = self._orphan_seen
+        reported = set()
+        for u in live:
+            d = self.tservers[u]
+            for t in d.get("tablets", []):
+                tid = t["tablet_id"]
+                key = (u, tid)
+                reported.add(key)
+                ent = self.tablets.get(tid)
+                ok = ent is not None and (
+                    u in ent.get("replicas", [])
+                    or u in ent.get("observers", []))
+                # a split child (deterministic "<parent>l"/"<parent>r"
+                # id) whose PARENT is still in the catalog is a split
+                # in flight — or one interrupted before its catalog
+                # commit, which the split retry path re-adopts. Never
+                # condemn it; survives leader failover because it needs
+                # no leader-local state.
+                in_split = (tid[-1:] in ("l", "r")
+                            and tid[:-1] in self.tablets)
+                if ok or in_split or key in self._gc_inflight:
+                    seen.pop(key, None)
+                    continue
+                first = seen.setdefault(key, now)
+                if now - first < grace:
+                    continue
+                try:
+                    await self.messenger.call(
+                        d["addr"], "tserver", "delete_tablet",
+                        {"tablet_id": tid}, timeout=5.0)
+                    seen.pop(key, None)
+                except (RpcError, asyncio.TimeoutError, OSError):
+                    pass   # keep the aged tracker: retry next sweep
+        # forget trackers for replicas no longer reported (deleted, or
+        # the catalog re-adopted and then dropped them)
+        for key in [k for k in seen if k not in reported]:
+            seen.pop(key, None)
+
     async def rpc_list_replication_slots(self, payload) -> dict:
         self._check_leader()
         return {"slots": sorted(self.replication_slots)}
@@ -1164,20 +1249,26 @@ class Master:
         replicas = self._choose_replicas(live, rf, 0)
         tablet_id = f"{gid}-t0"
         raft_peers = [[u, list(self.tservers[u]["addr"])] for u in replicas]
-        for u in replicas:
-            await self.messenger.call(
-                self.tservers[u]["addr"], "tserver", "create_tablet",
-                {"tablet_id": tablet_id, "table": parent_wire,
-                 "partition": ["", ""], "raft_peers": raft_peers,
-                 "colocated": True}, timeout=30.0)
-        ent = {"tablet_id": tablet_id, "table_id": gid,
-               "partition": ["", ""], "replicas": replicas, "leader": None}
-        ops = [["put_table", gid, {"info": parent_wire,
-                                   "tablets": [tablet_id],
-                                   "tablegroup": name,
-                                   "next_cotable": 1}],
-               ["put_tablet", tablet_id, ent]]
-        await self._commit_catalog(ops)
+        shield = {(u, tablet_id) for u in replicas}
+        self._gc_inflight |= shield
+        try:
+            for u in replicas:
+                await self.messenger.call(
+                    self.tservers[u]["addr"], "tserver", "create_tablet",
+                    {"tablet_id": tablet_id, "table": parent_wire,
+                     "partition": ["", ""], "raft_peers": raft_peers,
+                     "colocated": True}, timeout=30.0)
+            ent = {"tablet_id": tablet_id, "table_id": gid,
+                   "partition": ["", ""], "replicas": replicas,
+                   "leader": None}
+            ops = [["put_table", gid, {"info": parent_wire,
+                                       "tablets": [tablet_id],
+                                       "tablegroup": name,
+                                       "next_cotable": 1}],
+                   ["put_tablet", tablet_id, ent]]
+            await self._commit_catalog(ops)
+        finally:
+            self._gc_inflight -= shield
         return {"tablegroup_id": gid, "tablet_id": tablet_id}
 
     def _find_tablegroup(self, name: str):

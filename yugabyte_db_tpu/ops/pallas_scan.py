@@ -10,7 +10,13 @@ under interpret mode on CPU for tests.
 
 Layout notes (pallas_guide): blocks are (8, 128)-aligned f32 tiles; we
 use (BLOCK_ROWS,) = 8*128 multiples so each block is a whole tile row
-set; scalars ride in SMEM.
+set; scalars ride in SMEM. Mosaic rejects sub-tile output blocks, and
+rank-1 outputs can't verify (XLA picks a size-dependent 1D tile T(512),
+T(1024), ... while Mosaic picks T(block)), so per-block partials are
+emitted as one full rank-2 (SUBLANES, lanes) f32 tile per grid step —
+a scalar partial broadcast across a (8, 128) tile, a grouped [G]
+partial broadcast across (8, G_pad) — and the host wrapper slices one
+representative element/row back out ([::SUBLANES, 0] / [::SUBLANES, :G]).
 """
 from __future__ import annotations
 
@@ -22,6 +28,27 @@ import jax.numpy as jnp
 import numpy as np
 
 BLOCK_ROWS = 8 * 128 * 4          # 4096 rows per grid step
+LANES = 128                       # TPU lane count (last-dim tile)
+SUBLANES = 8                      # f32 sublane count
+
+
+def _pad_lanes(g: int) -> int:
+    return ((g + LANES - 1) // LANES) * LANES
+
+
+# The package enables jax_enable_x64, which makes BlockSpec index maps
+# trace to i64 — Mosaic then fails to legalize the index-map func.return.
+# Every index map below casts to int32 explicitly.
+def _im1(i):
+    return (jnp.int32(i),)
+
+
+def _im1_0(i):
+    return (jnp.int32(0),)
+
+
+def _im2(i):
+    return (jnp.int32(i), jnp.int32(0))
 
 
 def _q6_kernel(scalars_ref, qty_ref, price_ref, disc_ref, ship_ref,
@@ -40,8 +67,9 @@ def _q6_kernel(scalars_ref, qty_ref, price_ref, disc_ref, ship_ref,
             & (disc >= disc_lo) & (disc <= disc_hi)
             & (qty < qty_max) & (valid > 0))
     maskf = mask.astype(jnp.float32)
-    sum_ref[0] = jnp.sum(price * disc * maskf)
-    cnt_ref[0] = jnp.sum(maskf)
+    sum_ref[...] = jnp.broadcast_to(jnp.sum(price * disc * maskf),
+                                    (SUBLANES, LANES))
+    cnt_ref[...] = jnp.broadcast_to(jnp.sum(maskf), (SUBLANES, LANES))
 
 
 @partial(jax.jit, static_argnames=("interpret",))
@@ -58,20 +86,24 @@ def q6_scan_pallas(qty, price, disc, shipdate, valid, scalars,
         smem = None
     n = qty.shape[0]
     grid = n // BLOCK_ROWS
-    blk = pl.BlockSpec((BLOCK_ROWS,), lambda i: (i,))
-    scalar_spec = (pl.BlockSpec(memory_space=smem) if smem is not None
-                   else pl.BlockSpec((5,), lambda i: (0,)))
+    blk = pl.BlockSpec((BLOCK_ROWS,), _im1)
+    # explicit shape + int32 index map: the default (map-less) SMEM spec
+    # traces an i64 index map under x64, which Mosaic can't legalize
+    scalar_spec = (pl.BlockSpec((5,), _im1_0, memory_space=smem)
+                   if smem is not None else pl.BlockSpec((5,), _im1_0))
     sums, cnts = pl.pallas_call(
         _q6_kernel,
         grid=(grid,),
         in_specs=[scalar_spec, blk, blk, blk, blk, blk],
-        out_specs=(pl.BlockSpec((1,), lambda i: (i,)),
-                   pl.BlockSpec((1,), lambda i: (i,))),
-        out_shape=(jax.ShapeDtypeStruct((grid,), jnp.float32),
-                   jax.ShapeDtypeStruct((grid,), jnp.float32)),
+        out_specs=(pl.BlockSpec((SUBLANES, LANES), _im2),
+                   pl.BlockSpec((SUBLANES, LANES), _im2)),
+        out_shape=(jax.ShapeDtypeStruct((grid * SUBLANES, LANES),
+                                        jnp.float32),
+                   jax.ShapeDtypeStruct((grid * SUBLANES, LANES),
+                                        jnp.float32)),
         interpret=interpret,
     )(scalars, qty, price, disc, shipdate, valid)
-    return jnp.sum(sums), jnp.sum(cnts)
+    return (jnp.sum(sums[::SUBLANES, 0]), jnp.sum(cnts[::SUBLANES, 0]))
 
 
 def q6_scan(qty: np.ndarray, price: np.ndarray, disc: np.ndarray,
@@ -105,11 +137,15 @@ def q6_scan(qty: np.ndarray, price: np.ndarray, disc: np.ndarray,
 def _grouped_kernel(gid_ref, val_ref, mask_ref, out_ref, *, num_groups):
     gid = gid_ref[:]
     val = val_ref[:] * mask_ref[:]
-    # one_hot via broadcasted iota compare: [B, G]
-    groups = jax.lax.broadcasted_iota(jnp.float32, (gid.shape[0],
-                                                    num_groups), 1)
-    onehot = (gid[:, None] == groups).astype(jnp.float32)
-    out_ref[0, :] = val @ onehot            # [B] @ [B, G] -> [G]
+    g_pad = _pad_lanes(num_groups)
+    # one_hot via broadcasted iota compare: [B, G_pad]. tpu.iota is
+    # integer-only, so build an i32 iota and compare against i32 gids.
+    groups = jax.lax.broadcasted_iota(jnp.int32, (gid.shape[0],
+                                                  g_pad), 1)
+    onehot = (gid.astype(jnp.int32)[:, None] == groups).astype(jnp.float32)
+    # 2D lhs: Mosaic's dot lowering rejects rank-1 operands
+    part = val[None, :] @ onehot            # [1, B] @ [B, G_pad]
+    out_ref[...] = jnp.broadcast_to(part, (SUBLANES, g_pad))
 
 
 @partial(jax.jit, static_argnames=("num_groups", "interpret"))
@@ -120,16 +156,18 @@ def grouped_sum_pallas(gids, values, mask, num_groups: int,
     from jax.experimental import pallas as pl
     n = gids.shape[0]
     grid = n // BLOCK_ROWS
-    blk = pl.BlockSpec((BLOCK_ROWS,), lambda i: (i,))
+    blk = pl.BlockSpec((BLOCK_ROWS,), _im1)
+    g_pad = _pad_lanes(num_groups)
     partials = pl.pallas_call(
         partial(_grouped_kernel, num_groups=num_groups),
         grid=(grid,),
         in_specs=[blk, blk, blk],
-        out_specs=pl.BlockSpec((1, num_groups), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((grid, num_groups), jnp.float32),
+        out_specs=pl.BlockSpec((SUBLANES, g_pad), _im2),
+        out_shape=jax.ShapeDtypeStruct((grid * SUBLANES, g_pad),
+                                       jnp.float32),
         interpret=interpret,
     )(gids, values, mask)
-    return jnp.sum(partials, axis=0)
+    return jnp.sum(partials[::SUBLANES, :num_groups], axis=0)
 
 
 def grouped_sum(gids: np.ndarray, values: np.ndarray, mask: np.ndarray,
@@ -197,29 +235,33 @@ def build_generic_scan(where, agg_fns, group_cols, num_groups,
             if wn is not None:
                 mask = mask & jnp.logical_not(wn)
         maskf = mask.astype(jnp.float32)
+
+        def put(ref, scalar):
+            ref[...] = jnp.broadcast_to(scalar, (SUBLANES, LANES))
+
         if G is None:
             for oi, (op, f) in enumerate(agg_fns):
                 if f is None:
-                    out_refs[oi][0] = jnp.sum(maskf)
+                    put(out_refs[oi], jnp.sum(maskf))
                     continue
                 v, vn = f(cols, nulls, consts)
                 v = v.astype(jnp.float32)
                 m = maskf if vn is None else \
                     maskf * jnp.logical_not(vn).astype(jnp.float32)
                 if op == "count":
-                    out_refs[oi][0] = jnp.sum(m)
+                    put(out_refs[oi], jnp.sum(m))
                 elif op == "sum":
                     # where, not multiply: garbage on masked rows may
                     # be NaN and 0*NaN would poison the block partial
-                    out_refs[oi][0] = jnp.sum(
-                        jnp.where(m > 0, v, jnp.float32(0)))
+                    put(out_refs[oi], jnp.sum(
+                        jnp.where(m > 0, v, jnp.float32(0))))
                 elif op == "min":
-                    out_refs[oi][0] = jnp.min(
-                        jnp.where(m > 0, v, jnp.float32(np.inf)))
+                    put(out_refs[oi], jnp.min(
+                        jnp.where(m > 0, v, jnp.float32(np.inf))))
                 elif op == "max":
-                    out_refs[oi][0] = jnp.max(
-                        jnp.where(m > 0, v, jnp.float32(-np.inf)))
-            out_refs[n_aggs][0] = jnp.sum(maskf)
+                    put(out_refs[oi], jnp.max(
+                        jnp.where(m > 0, v, jnp.float32(-np.inf))))
+            put(out_refs[n_aggs], jnp.sum(maskf))
             return
         # grouped: one-hot [B, G] matmul per block (MXU)
         gid = None
@@ -236,51 +278,64 @@ def build_generic_scan(where, agg_fns, group_cols, num_groups,
             gid = c * stride if gid is None else gid + c * stride
             stride *= domain
         maskf = mask.astype(jnp.float32)
+        g_pad = _pad_lanes(G)
+        # integer iota + i32 compare: tpu.iota is integer-only
         groups = jax.lax.broadcasted_iota(
-            jnp.float32, (gid.shape[0], G), 1)
-        onehot = (gid[:, None] == groups).astype(jnp.float32) \
-            * maskf[:, None]
+            jnp.int32, (gid.shape[0], g_pad), 1)
+        onehot = (gid.astype(jnp.int32)[:, None] == groups) \
+            .astype(jnp.float32) * maskf[:, None]
+
+        def put_g(ref, part):
+            ref[...] = jnp.broadcast_to(part[None, :], (SUBLANES, g_pad))
+
         for oi, (op, f) in enumerate(agg_fns):
             if f is None:
-                out_refs[oi][0, :] = jnp.sum(onehot, axis=0)
+                put_g(out_refs[oi], jnp.sum(onehot, axis=0))
                 continue
             v, vn = f(cols, nulls, consts)
             v = v.astype(jnp.float32)
             oh = onehot if vn is None else \
                 onehot * jnp.logical_not(vn).astype(jnp.float32)[:, None]
             if op == "count":
-                out_refs[oi][0, :] = jnp.sum(oh, axis=0)
+                put_g(out_refs[oi], jnp.sum(oh, axis=0))
             elif op == "sum":
                 row_m = oh.max(axis=1)
                 vm = jnp.where(row_m > 0, v, jnp.float32(0))
-                out_refs[oi][0, :] = vm @ oh
+                # 2D lhs: Mosaic's dot lowering rejects rank-1 operands
+                put_g(out_refs[oi], (vm[None, :] @ oh)[0])
             elif op == "min":
-                out_refs[oi][0, :] = jnp.min(jnp.where(
-                    oh > 0, v[:, None], jnp.float32(np.inf)), axis=0)
+                put_g(out_refs[oi], jnp.min(jnp.where(
+                    oh > 0, v[:, None], jnp.float32(np.inf)), axis=0))
             elif op == "max":
-                out_refs[oi][0, :] = jnp.max(jnp.where(
-                    oh > 0, v[:, None], jnp.float32(-np.inf)), axis=0)
-        out_refs[n_aggs][0, :] = jnp.sum(onehot, axis=0)
+                put_g(out_refs[oi], jnp.max(jnp.where(
+                    oh > 0, v[:, None], jnp.float32(-np.inf)), axis=0))
+        put_g(out_refs[n_aggs], jnp.sum(onehot, axis=0))
 
     @partial(jax.jit, static_argnames=())
     def run(consts, col_arrs, null_arrs, valid):
         n = valid.shape[0]
         grid = n // BLOCK_ROWS
-        blk = pl.BlockSpec((BLOCK_ROWS,), lambda i: (i,))
-        scalar_spec = (pl.BlockSpec(memory_space=smem)
+        blk = pl.BlockSpec((BLOCK_ROWS,), _im1)
+        scalar_spec = (pl.BlockSpec((max(n_consts, 1),), _im1_0,
+                                    memory_space=smem)
                        if smem is not None
-                       else pl.BlockSpec((max(n_consts, 1),),
-                                         lambda i: (0,)))
+                       else pl.BlockSpec((max(n_consts, 1),), _im1_0))
         if G is None:
-            out_specs = tuple(pl.BlockSpec((1,), lambda i: (i,))
-                              for _ in range(n_aggs + 1))
-            out_shape = tuple(jax.ShapeDtypeStruct((grid,), jnp.float32)
-                              for _ in range(n_aggs + 1))
-        else:
-            out_specs = tuple(pl.BlockSpec((1, G), lambda i: (i, 0))
-                              for _ in range(n_aggs + 1))
+            out_specs = tuple(
+                pl.BlockSpec((SUBLANES, LANES), _im2)
+                for _ in range(n_aggs + 1))
             out_shape = tuple(
-                jax.ShapeDtypeStruct((grid, G), jnp.float32)
+                jax.ShapeDtypeStruct((grid * SUBLANES, LANES),
+                                     jnp.float32)
+                for _ in range(n_aggs + 1))
+        else:
+            g_pad = _pad_lanes(G)
+            out_specs = tuple(
+                pl.BlockSpec((SUBLANES, g_pad), _im2)
+                for _ in range(n_aggs + 1))
+            out_shape = tuple(
+                jax.ShapeDtypeStruct((grid * SUBLANES, g_pad),
+                                     jnp.float32)
                 for _ in range(n_aggs + 1))
         outs = pl.pallas_call(
             kernel,
@@ -290,5 +345,9 @@ def build_generic_scan(where, agg_fns, group_cols, num_groups,
             out_shape=out_shape,
             interpret=interpret,
         )(consts, *col_arrs, *null_arrs, valid)
-        return outs
+        # slice the tile-broadcast partials back to [grid] / [grid, G]
+        # so the host reduce in ScanKernel._try_pallas is layout-blind
+        if G is None:
+            return tuple(o[::SUBLANES, 0] for o in outs)
+        return tuple(o[::SUBLANES, :G] for o in outs)
     return run

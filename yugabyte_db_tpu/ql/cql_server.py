@@ -248,13 +248,18 @@ class CqlServer:
         self._prepared: Dict[bytes, str] = {}
         self._next_prep = 0
         self.addr: Optional[Tuple[str, int]] = None
-        # (table, column) -> full CQL collection type ("list<text>")
-        # learned from CREATE TABLE statements through this server.
-        # KNOWN LIMIT: the mapping is server-session-local — after a
-        # restart, collection columns of pre-existing tables encode as
-        # JSON text (type 0x0D) until the catalog grows a per-column
-        # original-type field.
+        # (table, column) -> full CQL collection type ("list<text>"),
+        # learned from CREATE TABLE statements through this server AND
+        # lazily recovered from the catalog's per-column ql_type field
+        # (ColumnSchema.ql_type) — so collection columns of tables
+        # created before a server restart still encode with real CQL
+        # collection type ids.
         self._coll_types: Dict[Tuple[str, str], str] = {}
+        # table -> schema version whose ql_types were applied; keyed by
+        # version (not a plain latch) so an ALTER through ANOTHER
+        # server refreshes typing as soon as this client's cached
+        # schema observes the new version
+        self._coll_loaded: Dict[str, int] = {}
 
     async def start(self):
         self._server = await asyncio.start_server(
@@ -539,18 +544,46 @@ class CqlServer:
                         "keyspace_name": "ybtpu", "table_name": name,
                         "column_name": c.name, "kind": kind,
                         "position": c.id,
-                        "type": self._CQL_TYPES.get(c.type, "text")})
+                        "type": (getattr(c, "ql_type", None)
+                                 or self._CQL_TYPES.get(c.type, "text"))})
             return out
         return []   # unknown vtable (e.g. .types): empty result set
 
+    async def _load_catalog_coll_types(self, table: Optional[str]) -> None:
+        """Recover collection typing for tables created before this
+        server started: the catalog persists each column's original
+        CQL type in ColumnSchema.ql_type (reference: QLTypePB params
+        kept in DocDB's schema, yql_columns_vtable.cc)."""
+        if table is None:
+            return
+        try:
+            # client-cache hit in steady state: no extra master RPC
+            ct = await self.session.client._table(table)
+        except Exception:    # noqa: BLE001 — unknown table, or a
+            return          # transient master error: retry next query
+        ver = ct.info.schema.version
+        if self._coll_loaded.get(table) == ver:
+            return
+        # record the version only AFTER a successful fetch, so one
+        # failover-window miss doesn't permanently disable recovery
+        self._coll_loaded[table] = ver
+        for c in ct.info.schema.columns:
+            if getattr(c, "ql_type", None):
+                self._coll_types[(table, c.name)] = c.ql_type
+
     def _learn_collections(self, sql: str) -> None:
-        """Remember collection-typed columns from CREATE TABLE so
-        results encode them with real CQL collection type ids."""
+        """Remember collection-typed columns from CREATE TABLE / ALTER
+        TABLE ADD so results encode them with real CQL collection type
+        ids. ALTER also drops the catalog-loaded latch so a column
+        added through ANOTHER server is re-fetched on the next query."""
         import re as _re
         m = _re.match(r"\s*create\s+table\s+(?:if\s+not\s+exists\s+)?"
                       r"(\w+)", sql, _re.I)
-        if not m:
-            return
+        if m is None:
+            m = _re.match(r"\s*alter\s+table\s+(\w+)", sql, _re.I)
+            if m is None:
+                return
+            self._coll_loaded.pop(m.group(1), None)
         table = m.group(1)
         for cm in _re.finditer(
                 r"(\w+)\s+((?:list|set|map)\s*<[^>]+>)", sql, _re.I):
@@ -622,6 +655,7 @@ class CqlServer:
         import re as _re
         tm = _re.search(r"\bfrom\s+(\w+)", sql, _re.I)
         table = tm.group(1) if tm else None
+        await self._load_catalog_coll_types(table)
         res = await self.session.execute(sql)
         if not res.rows:
             if res.status.startswith(("CREATE", "DROP")):

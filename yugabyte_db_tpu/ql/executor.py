@@ -48,12 +48,17 @@ _TYPE_MAP = {
 }
 
 
+def is_collection_type(typ: str) -> bool:
+    """CQL collection type name (list<..>/set<..>/map<..>)."""
+    return typ.split("<", 1)[0] in ("list", "set", "map")
+
+
 def resolve_type(typ: str):
     """Column-type name -> storage ColumnType. CQL collections
     (list<..>/set<..>/map<..>) store as JSON documents — the wire layer
     (cql_server) owns their element typing (reference: collection
     subdocuments in dockv; ours ride the JSON column path)."""
-    if typ.split("<", 1)[0] in ("list", "set", "map"):
+    if is_collection_type(typ):
         return ColumnType.JSON
     return _TYPE_MAP.get(typ)
 
@@ -97,6 +102,24 @@ class SqlSession:
         return [await self._dispatch(s) for s in parse_script(sql)]
 
     async def _dispatch(self, stmt) -> SqlResult:
+        try:
+            return await self._dispatch_inner(stmt)
+        except KeyError as orig:
+            # an unknown column may just be a stale client schema cache
+            # (ALTER through another node): binding precedes any write
+            # RPC, so a one-shot refresh + retry is side-effect free
+            # (reference: catalog-version mismatch retry in pggate)
+            table = getattr(stmt, "table", None)
+            if table is None or table in self._cte_rows or isinstance(
+                    stmt, (CreateTableStmt, DropTableStmt)):
+                raise
+            try:
+                await self.client._table(table, refresh=True)
+            except Exception:   # noqa: BLE001 — not a real table (a
+                raise orig      # CTE or vtable): keep the original
+            return await self._dispatch_inner(stmt)
+
+    async def _dispatch_inner(self, stmt) -> SqlResult:
         if isinstance(stmt, CreateTableStmt):
             return await self._create(stmt)
         if isinstance(stmt, DropTableStmt):
@@ -109,7 +132,9 @@ class SqlSession:
                 ct = resolve_type(ctype)
                 if ct is None:
                     raise ValueError(f"unknown type {ctype}")
-                adds.append((cname, ct))
+                adds.append((cname, ct,
+                             ctype if is_collection_type(ctype)
+                             else None))
             v = await self.client.alter_table(
                 stmt.table, adds, getattr(stmt, "drop_columns", ()))
             return SqlResult([], f"ALTER TABLE (v{v})")
@@ -351,7 +376,8 @@ class SqlSession:
                 is_hash_key=(not range_sharded and name == pk[0]),
                 is_range_key=(name in pk if range_sharded
                               else name in pk[1:]),
-                sort_desc=name in getattr(stmt, "pk_desc", [])))
+                sort_desc=name in getattr(stmt, "pk_desc", []),
+                ql_type=typ if is_collection_type(typ) else None))
         schema = TableSchema(columns=tuple(cols), version=1)
         info = TableInfo(
             "", stmt.name, schema,
@@ -381,6 +407,11 @@ class SqlSession:
         self._invalidate_stats(stmt.table)
         ct = await self.client._table(stmt.table)
         cols = stmt.columns or [c.name for c in ct.info.schema.columns]
+        # validate names against the schema up front: an unknown column
+        # must raise (→ stale-cache refresh retry in _dispatch), never
+        # silently drop the value on the floor at codec time
+        for name in cols:
+            ct.info.schema.column_by_name(name)   # raises KeyError
         if getattr(stmt, "select", None) is not None:
             # INSERT INTO ... SELECT: run the select, map by POSITION.
             # Unaliased items get unique hidden aliases first so
@@ -537,6 +568,10 @@ class SqlSession:
         if (agg_items or getattr(stmt, "having", None) is not None) \
                 and not stmt.group_by:
             refs = self._having_refs(stmt)
+            if self._txn is not None and \
+                    self._txn.pending_writes(stmt.table):
+                return await self._scalar_agg_clientside(
+                    stmt, ct, where, refs, read_ht)
             aggs = tuple(AggSpec(op, self._bind(e, schema))
                          for _, op, e in agg_items) + \
                 tuple(AggSpec(op, self._bind(e, schema))
@@ -551,6 +586,12 @@ class SqlSession:
 
         if stmt.group_by and (
                 agg_items or getattr(stmt, "having", None) is not None):
+            if self._txn is not None and \
+                    self._txn.pending_writes(stmt.table):
+                # read-your-own-writes: grouped pushdown results can't
+                # be patched row-wise, so group client-side over the
+                # overlaid scan
+                return await self._grouped_clientside(stmt, ct, where)
             gspec = self._group_spec(stmt, schema) if agg_items else None
             if gspec is not None:
                 return await self._grouped_pushdown(stmt, ct, where, gspec)
@@ -610,12 +651,57 @@ class SqlSession:
                     need.append(name)
         return need
 
+    async def _scalar_agg_clientside(self, stmt, ct, where, refs,
+                                     read_ht) -> SqlResult:
+        """Scalar aggregates inside a txn with pending writes on the
+        table: the device pushdown result can't be patched row-wise, so
+        scan the needed columns, overlay the write set, and fold the
+        aggregates on the host (reference: pggate flushes buffered ops
+        before reads; we overlay instead — same visible semantics)."""
+        schema = ct.info.schema
+        agg_items = [it for it in stmt.items if it[0] == "agg"]
+        needed: set = set()
+        for _, op, e in agg_items:
+            if e is not None:
+                self._collect_names(e, needed)
+        for _op, e in refs:
+            if e is not None:
+                self._collect_names(e, needed)
+        cols = self._overlay_columns(sorted(needed), schema, where)
+        resp = await self.client.scan(stmt.table, ReadRequest(
+            "", columns=tuple(cols), where=where, read_ht=read_ht))
+        rows = self._overlay_txn_writes(stmt.table, schema, where,
+                                        resp.rows)
+        bound = [(op, self._bind(e, schema) if e else None)
+                 for _, op, e in agg_items] + \
+            [(op, self._bind(e, schema) if e else None)
+             for op, e in refs]
+        st = [_init(op) for op, _ in bound]
+        for r in rows:
+            idrow = {schema.column_by_name(k).id: v
+                     for k, v in r.items()}
+            for i, (op, e) in enumerate(bound):
+                st[i] = _step(op, e, st[i], idrow)
+        # expand into the (avg -> sum, count) slot layout _agg_row /
+        # _hidden_agg_row decode
+        values: list = []
+        for (op, _e), s in zip(bound, st):
+            if op == "avg":
+                s = s or (0, 0)
+                values.extend([s[0] if s[1] else None, s[1]])
+            else:
+                values.append(_final(op, s))
+        row = self._agg_row(stmt, values)
+        row.update(self._hidden_agg_row(
+            refs, values, self._projected_slots(stmt)))
+        return SqlResult(self._having_filter(stmt, [row], refs))
+
     def _overlay_txn_writes(self, table: str, schema, where, rows):
         """Read-your-own-writes for plain scans inside a transaction:
         the txn's client-side write set replaces/adds/deletes rows over
         the snapshot scan (reference: pggate buffered-operation reads).
-        Aggregate and grouped paths stay snapshot-only — their pushdown
-        results can't be patched row-wise."""
+        Aggregate and grouped queries route through the client-side
+        fold paths, which overlay the same way."""
         pend = self._txn.pending_writes(table)
         if not pend:
             return rows
@@ -1220,15 +1306,24 @@ class SqlSession:
         for _op, e in refs:
             if e is not None:
                 self._collect_names(e, needed)
+        cols = sorted(needed)
+        overlay = (self._txn is not None
+                   and self._txn.pending_writes(stmt.table))
+        if overlay:
+            cols = self._overlay_columns(cols, schema, where)
         resp = await self.client.scan(stmt.table, ReadRequest(
-            "", columns=tuple(sorted(needed)), where=where,
+            "", columns=tuple(cols), where=where,
             read_ht=read_ht))
+        scan_rows = resp.rows
+        if overlay:
+            scan_rows = self._overlay_txn_writes(stmt.table, schema,
+                                                 where, scan_rows)
         groups: Dict[tuple, list] = {}
         bound = [(op, self._bind(e, schema) if e else None)
                  for _, op, e in agg_items] + \
             [(op, self._bind(e, schema) if e else None)
              for op, e in refs]
-        for r in resp.rows:
+        for r in scan_rows:
             key = tuple(r.get(c) for c in stmt.group_by)
             st = groups.setdefault(key, [_init(op) for op, _ in bound])
             idrow = {schema.column_by_name(k).id: v for k, v in r.items()}
@@ -1315,6 +1410,8 @@ class SqlSession:
             stmt.where = await self._resolve_subqueries(stmt.where)
         ct = await self.client._table(stmt.table)
         schema = ct.info.schema
+        for name in stmt.sets:
+            schema.column_by_name(name)   # raises KeyError when stale
         read_ht = self._txn.start_ht if self._txn is not None else None
         where = self._bind(stmt.where, schema)
         resp = await self.client.scan(stmt.table, ReadRequest(

@@ -140,6 +140,12 @@ DEFINE_RUNTIME("tpu_min_rows_for_pushdown", 4096,
                "must never pay a device round-trip.")
 DEFINE_RUNTIME("raft_heartbeat_interval_ms", 50, "Raft leader heartbeat period.")
 DEFINE_RUNTIME("leader_lease_duration_ms", 2000, "Raft leader lease length.")
+DEFINE_RUNTIME("master_orphan_gc_grace_s", 60.0,
+               "A replica reported by a tserver but absent from the "
+               "catalog's replica set must stay orphaned this long "
+               "(across heartbeats) before the master deletes it — "
+               "longer than any in-flight create/split/move window "
+               "(splits and moves are also structurally protected).")
 DEFINE_RUNTIME("log_segment_size_bytes", 16 * 1024 * 1024, "WAL segment size.")
 DEFINE_RUNTIME("log_gc_max_peer_lag_entries", 100_000,
                "Leader WAL retention bound for lagging peers: entries are "
