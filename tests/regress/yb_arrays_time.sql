@@ -1,0 +1,27 @@
+-- arrays (PG t[] syntax over the JSON storage path) with ANY/ALL,
+-- array functions, array_agg, EXTRACT, date_trunc, mod, trunc, sqrt, power
+CREATE TABLE arr (k bigint, tags text[], nums bigint[], at timestamp, amt decimal, PRIMARY KEY (k)) WITH tablets = 1;
+INSERT INTO arr (k, tags, nums, at, amt) VALUES (1, ARRAY['x','y'], ARRAY[1,2,3], TIMESTAMP '2024-03-15 10:30:45', 10.25), (2, ARRAY['z'], ARRAY[4,5], TIMESTAMP '2025-01-01 00:00:00', 3.50), (3, ARRAY['x'], ARRAY[2,9], TIMESTAMP '2024-12-31 23:59:59', -7.125);
+SELECT k, nums[1] AS first, nums[2] AS second FROM arr ORDER BY k;
+SELECT k FROM arr WHERE nums[1] = 1;
+SELECT k FROM arr WHERE 2 = ANY(nums) ORDER BY k;
+SELECT k FROM arr WHERE 'x' = ANY(tags) ORDER BY k;
+SELECT k FROM arr WHERE 3 < ALL(nums);
+SELECT k FROM arr WHERE 99 = ANY(nums);
+SELECT k, array_length(nums, 1) AS n, cardinality(tags) AS c FROM arr ORDER BY k;
+SELECT array_position(nums, 9) AS pos FROM arr WHERE k = 3;
+SELECT array_append(nums, 100) AS app FROM arr WHERE k = 2;
+SELECT array_agg(k) AS ks FROM arr;
+SELECT k, array_agg(nums[1]) AS firsts FROM arr GROUP BY k ORDER BY k;
+SELECT nums[7] AS missing FROM arr WHERE k = 1;
+SELECT extract(year FROM at) AS y, extract(month FROM at) AS m, extract(day FROM at) AS d FROM arr ORDER BY k;
+SELECT extract(hour FROM at) AS h, extract(dow FROM at) AS dow FROM arr WHERE k = 1;
+SELECT k FROM arr WHERE at >= date_trunc('year', TIMESTAMP '2024-06-15 12:00:00') ORDER BY k;
+SELECT k FROM arr WHERE date_trunc('month', at) = TIMESTAMP '2024-12-01 00:00:00';
+SELECT k % 2 AS m, mod(k, 2) AS m2 FROM arr ORDER BY k;
+SELECT mod(-7, 3) AS neg_mod, trunc(amt) AS t, trunc(amt, 1) AS t1 FROM arr WHERE k = 3;
+SELECT sqrt(16.0) AS sq, power(2, 8) AS p, power(2.5, 2) AS pf FROM arr WHERE k = 1;
+SELECT sum(amt) AS s, avg(amt) AS a, min(amt) AS lo FROM arr;
+SELECT round(amt, 1) AS r FROM arr ORDER BY k;
+SELECT k FROM arr WHERE amt % 2 = 0.25 ORDER BY k;
+DROP TABLE arr

@@ -25,6 +25,7 @@ async def _wait(cond, timeout=15.0, interval=0.1):
 class TestOrphanReplicaGC:
     def test_stray_replica_deleted_after_grace(self, tmp_path):
         async def go():
+            prior = flags.get("master_orphan_gc_grace_s")
             flags.set_flag("master_orphan_gc_grace_s", 1.0)
             mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
             try:
@@ -58,12 +59,13 @@ class TestOrphanReplicaGC:
                 rows = await c.get("kv", {"k": 1})
                 assert rows["v"] == 1.0
             finally:
-                flags.set_flag("master_orphan_gc_grace_s", 20.0)
+                flags.set_flag("master_orphan_gc_grace_s", prior)
                 await mc.shutdown()
         run(go())
 
     def test_orphan_within_grace_survives(self, tmp_path):
         async def go():
+            prior = flags.get("master_orphan_gc_grace_s")
             flags.set_flag("master_orphan_gc_grace_s", 3600.0)
             mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
             try:
@@ -86,6 +88,6 @@ class TestOrphanReplicaGC:
                 await asyncio.sleep(2.5)
                 assert "stray-tablet-002" in ts.peers
             finally:
-                flags.set_flag("master_orphan_gc_grace_s", 20.0)
+                flags.set_flag("master_orphan_gc_grace_s", prior)
                 await mc.shutdown()
         run(go())

@@ -98,6 +98,93 @@ class ReadResponse:
 _IN_SET_CACHE: Dict[int, tuple] = {}
 
 
+def _pg_mod(l, r):
+    """PG %/mod(): truncates toward zero (Python's % floors)."""
+    if isinstance(l, int) and isinstance(r, int):
+        m = abs(l) % abs(r)
+        return -m if l < 0 else m
+    from decimal import Decimal
+    return Decimal(str(l)) % Decimal(str(r))
+
+
+def _as_array(v):
+    """Array value: a Python list, or the JSON-text form arrays/CQL
+    collections are stored as. None for NULL / non-array."""
+    if v is None or isinstance(v, list):
+        return v
+    if isinstance(v, (str, bytes)):
+        import json as _json
+        try:
+            out = _json.loads(v)
+        except (ValueError, TypeError):
+            return None
+        return out if isinstance(out, list) else None
+    return None
+
+
+_TRUNC_FIELDS = ("year", "month", "day", "hour", "minute", "second",
+                 "week")
+
+
+def _date_trunc(unit: str, micros):
+    """date_trunc('<unit>', ts_micros) -> micros at the truncation."""
+    if micros is None:
+        return None
+    from datetime import datetime, timedelta, timezone
+    dt = datetime.fromtimestamp(micros / 1e6, tz=timezone.utc)
+    unit = unit.lower()
+    if unit not in _TRUNC_FIELDS:
+        raise ValueError(f"date_trunc unit {unit!r}")
+    if unit == "week":
+        dt = (dt - timedelta(days=dt.weekday())).replace(
+            hour=0, minute=0, second=0, microsecond=0)
+    elif unit == "year":
+        dt = dt.replace(month=1, day=1, hour=0, minute=0, second=0,
+                        microsecond=0)
+    elif unit == "month":
+        dt = dt.replace(day=1, hour=0, minute=0, second=0,
+                        microsecond=0)
+    elif unit == "day":
+        dt = dt.replace(hour=0, minute=0, second=0, microsecond=0)
+    elif unit == "hour":
+        dt = dt.replace(minute=0, second=0, microsecond=0)
+    elif unit == "minute":
+        dt = dt.replace(second=0, microsecond=0)
+    else:                                  # second
+        dt = dt.replace(microsecond=0)
+    return int(dt.timestamp() * 1_000_000)
+
+
+def _extract_field(field: str, micros):
+    """EXTRACT(<field> FROM ts_micros) (reference: PG timestamp_part)."""
+    if micros is None:
+        return None
+    from datetime import datetime, timezone
+    dt = datetime.fromtimestamp(micros / 1e6, tz=timezone.utc)
+    f = field.lower()
+    if f == "epoch":
+        return micros / 1e6
+    if f == "year":
+        return dt.year
+    if f == "month":
+        return dt.month
+    if f == "day":
+        return dt.day
+    if f == "hour":
+        return dt.hour
+    if f == "minute":
+        return dt.minute
+    if f == "second":
+        return dt.second + dt.microsecond / 1e6
+    if f == "dow":
+        return (dt.weekday() + 1) % 7      # PG: Sunday = 0
+    if f == "doy":
+        return dt.timetuple().tm_yday
+    if f == "week":
+        return dt.isocalendar()[1]
+    raise ValueError(f"EXTRACT field {field!r}")
+
+
 def eval_expr_py(node: tuple, row: Dict[int, object]):
     """Evaluate the pushdown AST over one row ({col_id: value}); returns
     value or None for SQL NULL."""
@@ -143,6 +230,8 @@ def eval_expr_py(node: tuple, row: Dict[int, object]):
             return l * r
         if op == "div":
             return l / r
+        if op == "mod":
+            return _pg_mod(l, r)
         raise ValueError(op)
     if kind == "and":
         l = eval_expr_py(node[1], row)
@@ -199,6 +288,32 @@ def eval_expr_py(node: tuple, row: Dict[int, object]):
         # note: escape() escaped % and _ as literals? re.escape leaves %
         # and _ unescaped in Python 3.7+, so the replace above is correct
         return _re.match(pat, str(v)) is not None
+    if kind == "array":
+        # ARRAY[...] with non-constant elements; NULL elements kept
+        return [eval_expr_py(a, row) for a in node[1:]]
+    if kind == "anyall":
+        # ('anyall', 'any'|'all', cmpop, lhs, arr) — PG x <op> ANY/ALL
+        # with SQL three-valued semantics over NULL elements
+        lhs = eval_expr_py(node[3], row)
+        arr = _as_array(eval_expr_py(node[4], row))
+        if lhs is None or arr is None:
+            return None
+        import operator as _op
+        cmp = {"lt": _op.lt, "le": _op.le, "gt": _op.gt, "ge": _op.ge,
+               "eq": _op.eq, "ne": _op.ne}[node[2]]
+        saw_null = False
+        for e in arr:
+            if e is None:
+                saw_null = True
+                continue
+            hit = cmp(lhs, e)
+            if node[1] == "any" and hit:
+                return True
+            if node[1] == "all" and not hit:
+                return False
+        if saw_null:
+            return None
+        return node[1] == "all"
     if kind == "fn":
         # scalar functions, row-wise on the CPU path (reference: the
         # ybgate-linked PG function library, docdb/docdb_pgapi.cc)
@@ -214,6 +329,10 @@ def eval_expr_py(node: tuple, row: Dict[int, object]):
                 if a is not None:
                     return a
             return None
+        if name == "array_prepend":
+            # PG prepends a NULL element rather than returning NULL
+            arr = _as_array(args[1])
+            return None if arr is None else [args[0]] + arr
         if args and args[0] is None:
             return None
         a0 = args[0] if args else None
@@ -257,6 +376,59 @@ def eval_expr_py(node: tuple, row: Dict[int, object]):
             return float(a0)
         if name in ("cast_text", "cast_varchar", "cast_string"):
             return str(a0)
+        if name == "subscript":
+            # PG arrays are 1-based; out-of-bounds -> NULL
+            arr = _as_array(a0)
+            idx = args[1]
+            if arr is None or idx is None:
+                return None
+            i = int(idx)
+            return arr[i - 1] if 1 <= i <= len(arr) else None
+        if name in ("array_length", "cardinality"):
+            arr = _as_array(a0)
+            if arr is None:
+                return None
+            if name == "array_length" and len(args) > 1 \
+                    and args[1] not in (None, 1):
+                return None     # 1-D arrays only
+            return len(arr) if arr else (0 if name == "cardinality"
+                                         else None)
+        if name == "array_append":
+            arr = _as_array(a0)
+            return None if arr is None else arr + [args[1]]
+        if name == "array_position":
+            arr = _as_array(a0)
+            if arr is None:
+                return None
+            try:
+                return arr.index(args[1]) + 1
+            except ValueError:
+                return None
+        if name == "trunc":
+            from decimal import ROUND_DOWN, Decimal
+            nd = int(args[1]) if len(args) > 1 and args[1] is not None \
+                else 0
+            q = Decimal(1).scaleb(-nd)
+            r = Decimal(str(a0)).quantize(q, ROUND_DOWN)
+            if isinstance(a0, Decimal):
+                return r
+            return float(r) if isinstance(a0, float) else int(r)
+        if name == "sqrt":
+            import math
+            return math.sqrt(a0)
+        if name == "power":
+            from decimal import Decimal
+            if isinstance(a0, Decimal) or isinstance(args[1], Decimal):
+                return Decimal(str(a0)) ** Decimal(str(args[1]))
+            return a0 ** args[1]
+        if name == "mod":
+            if args[1] is None:
+                return None
+            return _pg_mod(a0, args[1])
+        if name == "date_trunc":
+            return _date_trunc(str(a0), args[1])
+        if name.startswith("extract_"):
+            return _extract_field(name[len("extract_"):], a0)
         raise ValueError(f"unknown function {name}")
     if kind == "json":
         # ('json', 'text'|'value', expr, key) — PG ->> / -> semantics
@@ -318,52 +490,141 @@ class DocWriteOperation:
 # --------------------------------------------------------------------------
 # Read operation
 # --------------------------------------------------------------------------
-def extract_pk_bounds(where, pk_col_id: int):
-    """(lower, upper_inclusive, residual) numeric bounds for the leading
-    range-PK column from a conjunctive WHERE (ScanChoices-lite;
-    reference: docdb/scan_choices.cc). Returns (None, None, where) when
-    no usable bound exists."""
-    lo = hi = None
-    residual = []
+_POINT_TYPES = ("int32", "int64", "timestamp", "string")
+_RANGE_TYPES = ("int32", "int64", "timestamp")
+_MAX_SKIP_SEGMENTS = 4096
 
-    def visit(node):
-        nonlocal lo, hi
-        if node[0] == "and":
-            visit(node[1])
-            visit(node[2])
-            return
-        if node[0] == "cmp" and node[2][0] == "col" \
-                and node[2][1] == pk_col_id and node[3][0] == "const":
-            op, v = node[1], node[3][1]
-            if op in ("ge", "gt", "eq"):
-                b = v if op != "gt" else v + 1
-                lo = b if lo is None else max(lo, b)
-            if op in ("le", "lt", "eq"):
-                b = v if op != "lt" else v - 1
-                hi = b if hi is None else min(hi, b)
-            if op in ("ge", "gt", "le", "lt", "eq"):
-                return
-        if node[0] == "between" and node[1][0] == "col" \
-                and node[1][1] == pk_col_id \
-                and node[2][0] == "const" and node[3][0] == "const":
-            lo = node[2][1] if lo is None else max(lo, node[2][1])
-            hi = node[3][1] if hi is None else min(hi, node[3][1])
-            return
-        residual.append(node)
+
+def extract_scan_options(where, range_cols):
+    """Multi-column skip-scan options (reference: hybrid/ScanChoices,
+    docdb/hybrid_scan_choices.cc): walk the conjuncts of `where` and,
+    following range-PK column order, collect per-column target sets —
+    point sets from =/IN on the leading columns, then one optional
+    numeric interval on the next column. Returns
+    (point_lists, interval, residual):
+      point_lists: [(ColumnSchema, sorted values)] for leading columns
+      interval:    (ColumnSchema, lo, hi) inclusive (either end None)
+                   or None
+      residual:    conjuncts NOT consumed by the bounds (re-checked
+                   row-wise), or None
+    Point lists enumerate in sorted order so the segment scan preserves
+    encoded-pk order (ORDER BY stays pushdown-compatible)."""
+    conjuncts = []
+
+    def flatten(n):
+        if n[0] == "and":
+            flatten(n[1])
+            flatten(n[2])
+        else:
+            conjuncts.append(n)
 
     if where is not None:
-        visit(where)
-    if lo is None and hi is None:
-        return None, None, where
+        flatten(where)
+
+    def col_of(n):
+        # (col, const) comparisons only, either operand order
+        if n[0] == "cmp":
+            if n[2][0] == "col" and n[3][0] == "const":
+                return n[2][1], n[1], n[3][1]
+            if n[3][0] == "col" and n[2][0] == "const":
+                flip = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le",
+                        "eq": "eq", "ne": "ne"}
+                return n[3][1], flip[n[1]], n[2][1]
+        return None
+
+    def norm_point(col, v):
+        """A point value an =/IN target on `col` can actually hit, or
+        None. Non-integral numerics can never equal an integer column
+        (consumed as provably-false, NOT truncated); type mismatches
+        are rejected so the conjunct stays residual."""
+        if col.type == "string":
+            return v if isinstance(v, str) else None
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return None
+        if isinstance(v, float):
+            return int(v) if float(v).is_integer() else None
+        return v
+
+    used = set()
+    point_lists = []
+    interval = None
+    import math
+    for col in range_cols:
+        pts = None
+        lo = hi = None
+        for i, n in enumerate(conjuncts):
+            if i in used:
+                continue
+            if n[0] == "in" and n[1] == ("col", col.id) \
+                    and col.type in _POINT_TYPES:
+                if not all(isinstance(v, (int, float, str))
+                           and not isinstance(v, bool)
+                           for v in n[2] if v is not None):
+                    continue       # untypeable list: stays residual
+                vals = {p for v in n[2] if v is not None
+                        for p in [norm_point(col, v)] if p is not None}
+                pts = vals if pts is None else pts & vals
+                used.add(i)
+                continue
+            c = col_of(n)
+            if c is None or c[0] != col.id:
+                continue
+            op, v = c[1], c[2]
+            if op == "eq" and col.type in _POINT_TYPES:
+                if col.type != "string" and not isinstance(
+                        v, (int, float)) or isinstance(v, bool):
+                    continue       # untypeable: stays residual
+                if col.type == "string" and not isinstance(v, str):
+                    continue
+                p = norm_point(col, v)
+                new = {p} if p is not None else set()
+                pts = new if pts is None else pts & new
+                used.add(i)
+            elif col.type in _RANGE_TYPES and op in ("ge", "gt") \
+                    and isinstance(v, (int, float)) \
+                    and not isinstance(v, bool):
+                # integer column: k >= 4.5 means k >= 5; k > 4.5 too
+                b = math.ceil(v) if op == "ge" else math.floor(v) + 1
+                lo = b if lo is None else max(lo, b)
+                used.add(i)
+            elif col.type in _RANGE_TYPES and op in ("le", "lt") \
+                    and isinstance(v, (int, float)) \
+                    and not isinstance(v, bool):
+                b = math.floor(v) if op == "le" else math.ceil(v) - 1
+                hi = b if hi is None else min(hi, b)
+                used.add(i)
+        if n_between := [i for i, n in enumerate(conjuncts)
+                         if i not in used and n[0] == "between"
+                         and n[1] == ("col", col.id)
+                         and n[2][0] == "const" and n[3][0] == "const"
+                         and col.type in _RANGE_TYPES
+                         and all(isinstance(n[j][1], (int, float))
+                                 and not isinstance(n[j][1], bool)
+                                 for j in (2, 3))]:
+            for i in n_between:
+                n = conjuncts[i]
+                blo, bhi = math.ceil(n[2][1]), math.floor(n[3][1])
+                lo = blo if lo is None else max(lo, blo)
+                hi = bhi if hi is None else min(hi, bhi)
+                used.add(i)
+        if pts is not None:
+            if lo is not None or hi is not None:
+                pts = {p for p in pts
+                       if (lo is None or p >= lo)
+                       and (hi is None or p <= hi)}
+            point_lists.append((col, sorted(pts)))
+            continue
+        if lo is not None or hi is not None:
+            interval = (col, lo, hi)
+        break       # first non-point column ends the enumerable prefix
+    residual = [n for i, n in enumerate(conjuncts) if i not in used]
     if not residual:
         res = None
-    elif len(residual) == 1:
-        res = residual[0]
     else:
         res = residual[0]
         for r in residual[1:]:
             res = ("and", res, r)
-    return lo, hi, res
+    return point_lists, interval, res
 
 
 def _skew_window_ht() -> int:
@@ -829,87 +1090,124 @@ class DocReadOperation:
                     return None   # column unavailable in columnar form
         return ReadResponse(rows=rows, backend="tpu")
 
-    def _scan_bounds(self, req: ReadRequest):
-        """Seek bounds for range-sharded single-range-PK tables: turn
-        leading-PK predicates into encoded key bounds."""
+    def _scan_segments(self, req: ReadRequest):
+        """Skip-scan segments for range-sharded tables (reference:
+        docdb/scan_choices.cc + hybrid_scan_choices.cc): =/IN target
+        sets on the leading range-PK columns enumerate into seek
+        segments, an interval on the following column bounds each
+        segment. Returns ([(lower, upper_exclusive, prefix)], residual)
+        in encoded-key order, or (None, where) when nothing usable —
+        the caller then runs one unbounded segment. Each segment's
+        `prefix` (may be b"") is required of every key (break past it)."""
         schema = self.codec.schema
         ps = self.codec.info.partition_schema
-        if ps.kind != "range" or len(schema.key_columns) != 1 \
-                or req.where is None:
-            return None, None, req.where
-        pk = schema.key_columns[0]
-        if pk.sort_desc or pk.type not in ("int32", "int64", "timestamp"):
-            return None, None, req.where
-        lo, hi, residual = extract_pk_bounds(req.where, pk.id)
-        if lo is None and hi is None:
-            return None, None, req.where
+        if ps.kind != "range" or req.where is None or \
+                any(c.sort_desc for c in schema.key_columns):
+            return None, req.where
+        point_lists, interval, residual = extract_scan_options(
+            req.where, schema.key_columns)
+        if not point_lists and interval is None:
+            return None, req.where
+        total = 1
+        for _c, vals in point_lists:
+            total *= max(len(vals), 0)
+            if total > _MAX_SKIP_SEGMENTS:
+                # too many combinations to enumerate: full scan +
+                # row-wise filter (no silent cap on correctness)
+                return None, req.where
+        if total == 0:
+            return [], residual          # provably-empty target set
+        from itertools import product
         from .table_codec import _KEV_MAKER
-        from ..dockv.key_encoding import DocKey
-        maker = _KEV_MAKER[pk.type]
-        enc = lambda v: DocKey.make(range=(maker(int(v)),)).encode()
-        lower = enc(lo) if lo is not None else None
-        # upper: inclusive bound -> everything below the NEXT key
-        upper = enc(hi + 1) if hi is not None else None
-        return lower, upper, residual
+        from ..dockv.key_encoding import encode_key_entry
+        base = self.codec.scan_prefix()
+        segments = []
+        combos = product(*[[(c, v) for v in vals]
+                           for c, vals in point_lists]) \
+            if point_lists else [()]
+        for combo in combos:
+            prefix = base + b"".join(
+                encode_key_entry(_KEV_MAKER[c.type](
+                    int(v) if c.type != "string" else v))
+                for c, v in combo)
+            lower, upper = prefix, prefix + b"\xff"
+            if interval is not None:
+                c, lo, hi = interval
+                maker = _KEV_MAKER[c.type]
+                if lo is not None:
+                    lower = prefix + encode_key_entry(maker(int(lo)))
+                if hi is not None:
+                    upper = prefix + encode_key_entry(maker(int(hi) + 1))
+            segments.append((lower, upper, prefix))
+        segments.sort(key=lambda s: s[0])
+        return segments, residual
 
     def _execute_cpu(self, req: ReadRequest) -> ReadResponse:
         read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
         table_prefix = self.codec.scan_prefix()
-        bound_lo, bound_hi, bounded_where = self._scan_bounds(req)
-        lower = req.paging_state or bound_lo or (table_prefix or None)
+        segments, scan_where = self._scan_segments(req)
+        if segments is None:
+            segments = [(table_prefix or None, None, b"")]
+        if req.paging_state:
+            # resume: drop segments the cursor already passed, clamp
+            # the containing one
+            resume = req.paging_state
+            segments = [
+                (max(lo or b"", resume), up, seg_pre)
+                for lo, up, seg_pre in segments
+                if up is None or up > resume]
         rows_out: List[Dict[str, object]] = []
         aggs = list(_expand_avg_cpu(req.aggregates))
         agg_state = [_agg_init(a) for a in aggs]
         group_state: Dict[int, list] = {}
         count = 0
-        last_key = None
         cur_prefix = None
         chosen = False
-        by_id = {c.id: c.name for c in self.codec.schema.columns}
         name_to_id = {c.name: c.id for c in self.codec.schema.columns}
-        scan_where = bounded_where if bound_lo is not None \
-            or bound_hi is not None else req.where
-        for k, v in self.store.iterate(lower=lower, upper=bound_hi):
-            if table_prefix and not k.startswith(table_prefix):
-                break                      # left this cotable's key space
-            marker = len(k) - _HT_SUFFIX
-            prefix = k[:marker]
-            if prefix != cur_prefix:
-                cur_prefix = prefix
-                chosen = False
-            if chosen:
-                continue
-            dht = DocHybridTime.decode_desc(k[-ENCODED_SIZE:])
-            if dht.ht.value > read_ht:
-                if self._allow_restart and \
-                        dht.ht.value <= read_ht + _skew_window_ht():
-                    raise ReadRestartError(dht.ht.value)
-                continue
-            chosen = True   # newest visible version of this doc key
-            from ..dockv.value import unwrap_ttl
-            v, expire = unwrap_ttl(v)
-            if expire is not None and expire <= read_ht:
-                continue    # expired
-            if v[0] == ValueKind.kTombstone:
-                continue
-            row = self.codec.decode_row(k, v)
-            if row is None:
-                continue
-            idrow = {name_to_id[n]: val for n, val in row.items()}
-            if scan_where is not None:
-                if eval_expr_py(scan_where, idrow) is not True:
+        for seg_lower, seg_upper, seg_prefix in segments:
+            for k, v in self.store.iterate(lower=seg_lower,
+                                           upper=seg_upper):
+                if table_prefix and not k.startswith(table_prefix):
+                    break                  # left this cotable's key space
+                if seg_prefix and not k.startswith(seg_prefix):
+                    break                  # left this skip-scan segment
+                marker = len(k) - _HT_SUFFIX
+                prefix = k[:marker]
+                if prefix != cur_prefix:
+                    cur_prefix = prefix
+                    chosen = False
+                if chosen:
                     continue
-            if aggs:
-                _agg_accumulate(aggs, agg_state, group_state, req.group_by,
-                                idrow)
-            else:
-                rows_out.append(self._project(row, req.columns))
-                count += 1
-                last_key = k
-                if req.limit is not None and count >= req.limit:
-                    return ReadResponse(
-                        rows=rows_out, paging_state=prefix + b"\xff",
-                        backend="cpu")
+                dht = DocHybridTime.decode_desc(k[-ENCODED_SIZE:])
+                if dht.ht.value > read_ht:
+                    if self._allow_restart and \
+                            dht.ht.value <= read_ht + _skew_window_ht():
+                        raise ReadRestartError(dht.ht.value)
+                    continue
+                chosen = True   # newest visible version of this doc key
+                from ..dockv.value import unwrap_ttl
+                v, expire = unwrap_ttl(v)
+                if expire is not None and expire <= read_ht:
+                    continue    # expired
+                if v[0] == ValueKind.kTombstone:
+                    continue
+                row = self.codec.decode_row(k, v)
+                if row is None:
+                    continue
+                idrow = {name_to_id[n]: val for n, val in row.items()}
+                if scan_where is not None:
+                    if eval_expr_py(scan_where, idrow) is not True:
+                        continue
+                if aggs:
+                    _agg_accumulate(aggs, agg_state, group_state,
+                                    req.group_by, idrow)
+                else:
+                    rows_out.append(self._project(row, req.columns))
+                    count += 1
+                    if req.limit is not None and count >= req.limit:
+                        return ReadResponse(
+                            rows=rows_out, paging_state=prefix + b"\xff",
+                            backend="cpu")
         if aggs:
             if req.group_by is not None:
                 return _grouped_cpu_response(aggs, group_state, req.group_by)

@@ -49,8 +49,9 @@ _TYPE_MAP = {
 
 
 def is_collection_type(typ: str) -> bool:
-    """CQL collection type name (list<..>/set<..>/map<..>)."""
-    return typ.split("<", 1)[0] in ("list", "set", "map")
+    """CQL collection (list<..>/set<..>/map<..>) or PG array (t[])."""
+    return (typ.split("<", 1)[0] in ("list", "set", "map")
+            or typ.endswith("[]"))
 
 
 def resolve_type(typ: str):
@@ -316,7 +317,35 @@ class SqlSession:
                         lines.append(f"  Limit {stmt.limit}: "
                                      f"client-side")
                 else:
-                    lines.append(f"Seq Scan on {stmt.table}")
+                    # mirror _scan_segments' guards exactly so the plan
+                    # reports what execution will actually do
+                    scan_kind = f"Seq Scan on {stmt.table}"
+                    schema = ct.info.schema
+                    if stmt.where is not None and \
+                            ct.info.partition_schema.kind == "range" \
+                            and not any(c.sort_desc
+                                        for c in schema.key_columns):
+                        from ..docdb.operations import (
+                            _MAX_SKIP_SEGMENTS, extract_scan_options,
+                        )
+                        pts, interval, _res = extract_scan_options(
+                            self._bind(stmt.where, schema),
+                            list(schema.key_columns))
+                        nseg = 1
+                        for _c, vals in pts:
+                            nseg *= len(vals)
+                        if pts and nseg == 0:
+                            scan_kind = (f"Skip Scan on {stmt.table} "
+                                         f"(empty target set)")
+                        elif pts and nseg <= _MAX_SKIP_SEGMENTS:
+                            scan_kind = (f"Skip Scan on {stmt.table} "
+                                         f"({nseg} segments"
+                                         + (", range-bounded)"
+                                            if interval else ")"))
+                        elif interval and not pts:
+                            scan_kind = (f"Range Scan on {stmt.table} "
+                                         f"(pk bounds)")
+                    lines.append(scan_kind)
                     if stmt.where is not None:
                         lines.append("  Filter: pushed to tablets "
                                      "(device mask when columnar)")
@@ -412,6 +441,8 @@ class SqlSession:
         # silently drop the value on the floor at codec time
         for name in cols:
             ct.info.schema.column_by_name(name)   # raises KeyError
+        json_cols = {c.name for c in ct.info.schema.columns
+                     if c.type == ColumnType.JSON}
         if getattr(stmt, "select", None) is not None:
             # INSERT INTO ... SELECT: run the select, map by POSITION.
             # Unaliased items get unique hidden aliases first so
@@ -440,6 +471,13 @@ class SqlSession:
                 if row[vc] is not None and not isinstance(
                         row[vc], (bytes, bytearray)):
                     row[vc] = parse_vector(row[vc]).tobytes()
+            for jc in json_cols & set(row):
+                # ARRAY[...] literals arrive as Python lists; JSON
+                # columns store text (same shape the CQL collection
+                # path writes)
+                if isinstance(row[jc], (list, dict)):
+                    import json as _json
+                    row[jc] = _json.dumps(row[jc])
             self._coerce_decimals(dec_cols, row)
             rows.append(row)
         if self._txn is not None:
@@ -568,8 +606,9 @@ class SqlSession:
         if (agg_items or getattr(stmt, "having", None) is not None) \
                 and not stmt.group_by:
             refs = self._having_refs(stmt)
-            if self._txn is not None and \
-                    self._txn.pending_writes(stmt.table):
+            exotic = any(it[1] == "array_agg" for it in agg_items)
+            if exotic or (self._txn is not None
+                          and self._txn.pending_writes(stmt.table)):
                 return await self._scalar_agg_clientside(
                     stmt, ct, where, refs, read_ht)
             aggs = tuple(AggSpec(op, self._bind(e, schema))
@@ -586,11 +625,13 @@ class SqlSession:
 
         if stmt.group_by and (
                 agg_items or getattr(stmt, "having", None) is not None):
-            if self._txn is not None and \
-                    self._txn.pending_writes(stmt.table):
-                # read-your-own-writes: grouped pushdown results can't
-                # be patched row-wise, so group client-side over the
-                # overlaid scan
+            if any(it[1] == "array_agg" for it in agg_items) or (
+                    self._txn is not None
+                    and self._txn.pending_writes(stmt.table)):
+                # read-your-own-writes (grouped pushdown results can't
+                # be patched row-wise) and host-only aggregates
+                # (array_agg) group client-side over the (overlaid)
+                # scan
                 return await self._grouped_clientside(stmt, ct, where)
             gspec = self._group_spec(stmt, schema) if agg_items else None
             if gspec is not None:
@@ -702,6 +743,8 @@ class SqlSession:
         the snapshot scan (reference: pggate buffered-operation reads).
         Aggregate and grouped queries route through the client-side
         fold paths, which overlay the same way."""
+        if self._txn is None:
+            return rows
         pend = self._txn.pending_writes(table)
         if not pend:
             return rows
@@ -1127,13 +1170,17 @@ class SqlSession:
             if op == "avg":
                 s = _scalar(values[vi])
                 c = _scalar(values[vi + 1])
+                import decimal
+                if isinstance(s, decimal.Decimal):
+                    c = int(c) if c is not None else c
                 out[name] = (s / c) if s is not None and c else None
                 vi += 2
             else:
                 import decimal
                 v = _scalar(values[vi])
                 out[name] = (v if v is None
-                             or isinstance(v, decimal.Decimal) else
+                             or isinstance(v, (decimal.Decimal, list))
+                             else
                              int(v) if op == "count" else float(v))
                 vi += 1
         return out
@@ -1171,6 +1218,9 @@ class SqlSession:
             if op == "avg":
                 sv = _scalar(values[vi])
                 cv = _scalar(values[vi + 1])
+                import decimal
+                if isinstance(sv, decimal.Decimal):
+                    cv = int(cv) if cv is not None else cv
                 out[f"__h{i}"] = (sv / cv) if sv is not None and cv \
                     else None
                 vi += 2
@@ -1514,7 +1564,9 @@ def _expr_name(node) -> str:
 
 def _scalar(v):
     """Aggregate output -> python scalar; None passes through (min/max
-    over zero rows)."""
+    over zero rows); lists pass through (array_agg)."""
+    if isinstance(v, list):
+        return v
     a = np.asarray(v)
     if a.dtype == object and a.shape == ():
         return a.item()
@@ -1532,6 +1584,8 @@ def _agg_name(it) -> str:
 
 
 def _init(op):
+    if op == "array_agg":
+        return []
     return 0 if op in ("sum", "count") else None
 
 
@@ -1539,6 +1593,9 @@ def _step(op, expr, state, idrow):
     if expr is None:
         return (state or 0) + 1
     v = eval_expr_py(expr, idrow)
+    if op == "array_agg":
+        state.append(v)     # PG array_agg keeps NULL elements
+        return state
     if v is None:
         return state
     if op == "count":

@@ -19,7 +19,7 @@ _TOKEN = re.compile(r"""
     \s*(?:
       (?P<num>\d+\.\d+(?:[eE][-+]?\d+)?|\d+)
     | (?P<str>'(?:[^']|'')*')
-    | (?P<op><->|->>|->|<=|>=|<>|!=|[=<>(),;*+\-/])
+    | (?P<op><->|->>|->|<=|>=|<>|!=|[=<>(),;*+\-/\[\]%])
     | (?P<word>[A-Za-z_][A-Za-z0-9_.]*)
     )""", re.VERBOSE)
 
@@ -35,14 +35,16 @@ KEYWORDS = {
     "alter", "add", "column", "join", "inner", "left", "outer",
     "right", "full", "over", "partition", "interval", "timestamp",
     "date", "cast", "case", "when", "then", "else", "end", "true",
-    "false",
+    "false", "array", "any", "all", "extract",
 }
 
 # window functions (besides the aggregate ops)
 WINDOW_FNS = {"row_number", "rank", "dense_rank", "lag", "lead"}
 # scalar functions evaluated row-wise on the CPU path
 SCALAR_FNS = {"now", "coalesce", "abs", "round", "upper", "lower",
-              "length", "floor", "ceil"}
+              "length", "floor", "ceil", "trunc", "sqrt", "power",
+              "mod", "date_trunc", "array_length", "cardinality",
+              "array_append", "array_prepend", "array_position"}
 
 
 def tokenize(sql: str) -> List[Tuple[str, str]]:
@@ -360,6 +362,9 @@ class Parser:
         if self.accept_op("("):        # e.g. vector(768), varchar(32)
             self.next()                # dims/length (advisory)
             self.expect_op(")")
+        if self.accept_op("["):        # PG array type: bigint[]
+            self.expect_op("]")
+            return ctype + "[]"
         return ctype
 
     def _create_index(self):
@@ -496,6 +501,17 @@ class Parser:
         if t[0] == "op" and t[1] == "-":
             v = self.literal()
             return -v
+        if t[0] == "kw" and t[1].lower() == "array":
+            # ARRAY[lit, ...] in a VALUES list -> Python list value
+            self.expect_op("[")
+            vals = []
+            if not self.accept_op("]"):
+                while True:
+                    vals.append(self.literal())
+                    if not self.accept_op(","):
+                        break
+                self.expect_op("]")
+            return vals
         raise ValueError(f"expected literal, got {t}")
 
     def _over_clause(self):
@@ -535,7 +551,10 @@ class Parser:
             else:
                 t = self.peek()
                 is_agg_kw = (t[0] == "kw" and t[1].lower() in
-                             ("count", "sum", "min", "max", "avg"))
+                             ("count", "sum", "min", "max", "avg")) or \
+                    (t[0] == "id" and t[1].lower() == "array_agg"
+                     and self.pos + 1 < len(self.toks)
+                     and self.toks[self.pos + 1] == ("op", "("))
                 is_window_fn = (t[0] == "id"
                                 and t[1].lower() in WINDOW_FNS
                                 and self.pos + 1 < len(self.toks)
@@ -719,9 +738,17 @@ class Parser:
         if t and t[0] == "op" and t[1] in ("=", "<>", "!=", "<", "<=", ">",
                                            ">="):
             op = self.next()[1]
-            right = self.add_expr()
             opname = {"=": "eq", "<>": "ne", "!=": "ne", "<": "lt",
                       "<=": "le", ">": "gt", ">=": "ge"}[op]
+            nt = self.peek()
+            if nt and nt[0] == "kw" and nt[1].lower() in ("any", "all"):
+                # x <op> ANY(arr) / ALL(arr) — PG array comparisons
+                which = self.next()[1].lower()
+                self.expect_op("(")
+                arr = self.expr()
+                self.expect_op(")")
+                return ("anyall", which, opname, left, arr)
+            right = self.add_expr()
             return ("cmp", opname, left, right)
         if t and t[0] == "kw" and t[1].lower() == "like":
             self.next()
@@ -777,6 +804,8 @@ class Parser:
                 left = ("arith", "mul", left, self.unary_expr())
             elif self.accept_op("/"):
                 left = ("arith", "div", left, self.unary_expr())
+            elif self.accept_op("%"):
+                left = ("arith", "mod", left, self.unary_expr())
             else:
                 return left
 
@@ -787,6 +816,11 @@ class Parser:
                 node = ("json", "text", node, self.literal())
             elif self.accept_op("->"):
                 node = ("json", "value", node, self.literal())
+            elif self.accept_op("["):
+                # 1-based array subscript: a[1], a[i+1]
+                idx = self.expr()
+                self.expect_op("]")
+                node = ("fn", "subscript", node, idx)
             else:
                 return node
 
@@ -826,6 +860,30 @@ class Parser:
             if lit[0] != "str":
                 raise ValueError("INTERVAL needs a quoted value")
             return ("const", parse_interval_micros(lit[1]))
+        if t[0] == "kw" and t[1].lower() == "array":
+            # ARRAY[e1, e2, ...] literal; all-constant arrays fold
+            self.next()
+            self.expect_op("[")
+            elems = []
+            if not self.accept_op("]"):
+                while True:
+                    elems.append(self.expr())
+                    if not self.accept_op(","):
+                        break
+                self.expect_op("]")
+            if all(e[0] == "const" for e in elems):
+                return ("const", [e[1] for e in elems])
+            return ("array", *elems)
+        if t[0] == "kw" and t[1].lower() == "extract":
+            # EXTRACT(field FROM ts) -> ("fn", "extract_<field>", ts)
+            self.next()
+            self.expect_op("(")
+            ft = self.next()
+            field = ft[1].lower()
+            self.expect_kw("from")
+            inner = self.expr()
+            self.expect_op(")")
+            return ("fn", "extract_" + field, inner)
         if t[0] == "kw" and t[1].lower() == "cast":
             self.next()
             self.expect_op("(")
