@@ -1,0 +1,24 @@
+-- string functions, || concat (text and arrays), UPDATE with
+-- expressions over the pre-image, NULLIF / GREATEST / LEAST
+CREATE TABLE st (k bigint, n text, v double, tags text[], PRIMARY KEY (k)) WITH tablets = 1;
+INSERT INTO st (k, n, v, tags) VALUES (1, 'alpha beta', 10.0, ARRAY['x']), (2, 'gamma', 20.0, ARRAY['y','z']);
+SELECT k, upper(n) AS up, length(n) AS ln FROM st ORDER BY k;
+SELECT substr(n, 7) AS tail, substr(n, 1, 5) AS head FROM st WHERE k = 1;
+SELECT replace(n, 'a', '@') AS rep, strpos(n, 'beta') AS pos FROM st WHERE k = 1;
+SELECT left(n, 3) AS l3, right(n, 2) AS r2, left(n, -2) AS lneg FROM st WHERE k = 2;
+SELECT lpad(n, 8, '.') AS lp, rpad(n, 8, '.') AS rp FROM st WHERE k = 2;
+SELECT split_part(n, ' ', 1) AS p1, split_part(n, ' ', 9) AS p9 FROM st WHERE k = 1;
+SELECT initcap(n) AS ic, reverse(n) AS rv, trim('  pad  ') AS tr FROM st WHERE k = 1;
+SELECT n || '-' || k AS joined FROM st ORDER BY k;
+SELECT concat(n, NULL, '!') AS skips_null FROM st WHERE k = 2;
+SELECT nullif(v, 10.0) AS nf1, nullif(v, 99.0) AS nf2 FROM st WHERE k = 1;
+SELECT greatest(v, 15.0, NULL) AS g, least(v, 15.0) AS l FROM st ORDER BY k;
+SELECT tags || ARRAY['w'] AS appended FROM st WHERE k = 1;
+SELECT k FROM st WHERE starts_with(n, 'al');
+UPDATE st SET v = v * 2 + 1 WHERE k = 1;
+SELECT v FROM st WHERE k = 1;
+UPDATE st SET n = upper(n), v = v - 0.5 WHERE k = 2;
+SELECT n, v FROM st WHERE k = 2;
+UPDATE st SET tags = array_append(tags, 'new') WHERE k = 2;
+SELECT array_length(tags, 1) AS n FROM st WHERE k = 2;
+DROP TABLE st

@@ -1,0 +1,26 @@
+-- EXISTS / NOT EXISTS, scalar subqueries, FROM-less SELECT,
+-- sequences and serial defaults
+CREATE SEQUENCE rs START 10;
+SELECT nextval('rs') AS v1;
+SELECT nextval('rs') AS v2;
+SELECT currval('rs') AS cur;
+CREATE TABLE qa (k bigint, v double, PRIMARY KEY (k)) WITH tablets = 1;
+CREATE TABLE qb (k bigint, w double, PRIMARY KEY (k)) WITH tablets = 1;
+INSERT INTO qa (k, v) VALUES (1, 1.0), (2, 2.0), (3, 3.0);
+INSERT INTO qb (k, w) VALUES (2, 9.0);
+SELECT k FROM qa WHERE EXISTS (SELECT k FROM qb) ORDER BY k;
+SELECT k FROM qa WHERE NOT EXISTS (SELECT k FROM qb WHERE w > 50.0) ORDER BY k;
+SELECT k FROM qa WHERE EXISTS (SELECT k FROM qb WHERE w > 50.0);
+SELECT k FROM qa WHERE v < (SELECT max(w) FROM qb) - 6.5 ORDER BY k;
+SELECT k, (SELECT count(*) FROM qb) AS nb FROM qa WHERE k = 3;
+SELECT k FROM qa WHERE v = (SELECT w FROM qb WHERE k = 77);
+SELECT 2 + 3 AS five, upper('ok') AS u;
+CREATE TABLE qs (id bigserial, tag text, PRIMARY KEY (id)) WITH tablets = 1;
+INSERT INTO qs (tag) VALUES ('first'), ('second');
+SELECT id, tag FROM qs ORDER BY id;
+INSERT INTO qs (id, tag) VALUES (nextval('rs'), 'manual');
+SELECT id FROM qs WHERE tag = 'manual';
+DROP SEQUENCE rs;
+DROP TABLE qs;
+DROP TABLE qa;
+DROP TABLE qb

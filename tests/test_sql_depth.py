@@ -260,3 +260,41 @@ class TestScalarFunctions:
     def test_fn_in_where(self, sess):
         r = sess("SELECT id FROM emp WHERE upper(name) = 'EVE'")
         assert [x["id"] for x in r.rows] == [5]
+
+
+class TestStrictNullFunctions:
+    def test_null_in_any_argument_yields_null(self, tmp_path):
+        """PG scalar functions are strict: a NULL in ANY argument
+        returns NULL (previously later-position NULLs crashed int()
+        or stringified to 'None')."""
+        import asyncio
+        from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+        from yugabyte_db_tpu.ql.executor import SqlSession
+
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute("CREATE TABLE sn (k bigint, n text, "
+                                "m bigint, PRIMARY KEY (k)) "
+                                "WITH tablets = 1")
+                await mc.wait_for_leaders("sn")
+                await s.execute("INSERT INTO sn (k, n, m) VALUES "
+                                "(1, 'hello', NULL)")
+                for q in [
+                    "SELECT substr(n, m) AS x FROM sn",
+                    "SELECT lpad(n, 8, NULL) AS x FROM sn",
+                    "SELECT replace(n, NULL, 'y') AS x FROM sn",
+                    "SELECT mod(k, m) AS x FROM sn",
+                ]:
+                    r = await s.execute(q)
+                    assert r.rows[0]["x"] is None, (q, r.rows)
+                # NULL-tolerant fns keep their special semantics
+                r = await s.execute(
+                    "SELECT concat(n, NULL, '!') AS c, "
+                    "greatest(k, m) AS g FROM sn")
+                assert r.rows[0]["c"] == "hello!"
+                assert r.rows[0]["g"] == 1
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())

@@ -89,6 +89,8 @@ class YBClient:
         self.master_addr = self.master_addrs[0]
         self.messenger = messenger or Messenger("client")
         self._tables: Dict[str, CachedTable] = {}     # name -> cache
+        self._seq_cache: Dict[str, list] = {}   # sequence -> cached block
+        self._seq_last: Dict[str, int] = {}     # sequence -> last nextval
 
     async def _master_call(self, method: str, payload, timeout: float = 30.0):
         """Call the leader master, failing over across known masters
@@ -167,6 +169,49 @@ class YBClient:
              "drop_columns": list(drop_columns)})
         self._tables.pop(name, None)
         return r["schema_version"]
+
+    # --- sequences (client-side block cache; reference:
+    # tserver/pg_client_session.cc PgSequenceCache) ------------------------
+    SEQUENCE_CACHE_SIZE = 50
+
+    async def create_sequence(self, name: str, start: int = 1,
+                              increment: int = 1,
+                              if_not_exists: bool = False) -> None:
+        await self._master_call("create_sequence", {
+            "name": name, "start": start, "increment": increment,
+            "if_not_exists": if_not_exists})
+
+    async def drop_sequence(self, name: str) -> None:
+        await self._master_call("drop_sequence", {"name": name})
+        self._seq_cache.pop(name, None)
+        self._seq_last.pop(name, None)   # currval dies with the seq
+
+    async def sequence_next(self, name: str) -> int:
+        """nextval(): serve from the locally cached block; allocate a
+        new block through the master (Raft-committed past the block
+        BEFORE use, so failover can only leave gaps, never repeats)."""
+        cached = self._seq_cache.get(name)
+        if cached:
+            v = cached.pop(0)
+            self._seq_last[name] = v
+            return v
+        r = await self._master_call("sequence_alloc", {
+            "name": name, "count": self.SEQUENCE_CACHE_SIZE})
+        vals = [r["first"] + i * r["increment"]
+                for i in range(r["count"])]
+        v = vals[0]
+        self._seq_cache[name] = vals[1:]
+        self._seq_last[name] = v
+        return v
+
+    def sequence_current(self, name: str) -> int:
+        """currval(): last value THIS session handed out (PG errors if
+        nextval was never called in the session)."""
+        if name not in self._seq_last:
+            raise RpcError(
+                f"currval of sequence {name!r} is not yet defined "
+                f"in this session", "INVALID_ARGUMENT")
+        return self._seq_last[name]
 
     async def drop_table(self, name: str) -> None:
         await self._master_call("drop_table", {"name": name})

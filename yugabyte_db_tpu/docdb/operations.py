@@ -98,6 +98,14 @@ class ReadResponse:
 _IN_SET_CACHE: Dict[int, tuple] = {}
 
 
+def _pg_text(v) -> str:
+    """Text form for string functions/||: SQL-style, not Python repr
+    (True -> 'true', Decimal prints plainly)."""
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    return v if isinstance(v, str) else str(v)
+
+
 def _pg_mod(l, r):
     """PG %/mod(): truncates toward zero (Python's % floors)."""
     if isinstance(l, int) and isinstance(r, int):
@@ -211,6 +219,16 @@ def eval_expr_py(node: tuple, row: Dict[int, object]):
         r = eval_expr_py(node[3], row)
         if l is None or r is None:
             return None
+        if node[1] == "concat":
+            # PG ||: text concat, array||array, array||elem, elem||array
+            if isinstance(l, list) or isinstance(r, list):
+                al, ar = _as_array(l), _as_array(r)
+                if al is not None and ar is not None:
+                    return al + ar
+                if al is not None:
+                    return al + [r]
+                return [l] + ar
+            return _pg_text(l) + _pg_text(r)
         # Decimal refuses mixed arithmetic with float: promote the
         # other operand (comparisons already allow the mix)
         from decimal import Decimal
@@ -333,8 +351,24 @@ def eval_expr_py(node: tuple, row: Dict[int, object]):
             # PG prepends a NULL element rather than returning NULL
             arr = _as_array(args[1])
             return None if arr is None else [args[0]] + arr
-        if args and args[0] is None:
-            return None
+        if name == "array_append":
+            # the appended ELEMENT may be SQL NULL
+            arr = _as_array(args[0])
+            return None if arr is None else arr + [args[1]]
+        if name == "concat":
+            # PG concat() skips NULLs (unlike ||)
+            return "".join(_pg_text(a) for a in args if a is not None)
+        if name == "nullif":
+            if args[0] is None:
+                return None
+            return None if args[0] == args[1] else args[0]
+        if name in ("greatest", "least"):
+            vals = [a for a in args if a is not None]
+            if not vals:
+                return None
+            return max(vals) if name == "greatest" else min(vals)
+        if any(a is None for a in args):
+            return None          # strict functions: NULL in -> NULL out
         a0 = args[0] if args else None
         if name == "abs":
             return abs(a0)
@@ -376,6 +410,69 @@ def eval_expr_py(node: tuple, row: Dict[int, object]):
             return float(a0)
         if name in ("cast_text", "cast_varchar", "cast_string"):
             return str(a0)
+        if name in ("substr", "substring"):
+            st = int(args[1])
+            ln = int(args[2]) if len(args) > 2 and args[2] is not None \
+                else None
+            sv = _pg_text(a0)
+            # PG: 1-based; start may be <= 0 (consumes length)
+            begin = st - 1
+            end = None if ln is None else begin + ln
+            begin = max(begin, 0)
+            if end is not None and end < begin:
+                end = begin
+            return sv[begin:end]
+        if name == "replace":
+            return _pg_text(a0).replace(_pg_text(args[1]),
+                                        _pg_text(args[2]))
+        if name == "trim":
+            return _pg_text(a0).strip(
+                _pg_text(args[1]) if len(args) > 1 else None)
+        if name == "ltrim":
+            return _pg_text(a0).lstrip(
+                _pg_text(args[1]) if len(args) > 1 else None)
+        if name == "rtrim":
+            return _pg_text(a0).rstrip(
+                _pg_text(args[1]) if len(args) > 1 else None)
+        if name == "strpos":
+            return _pg_text(a0).find(_pg_text(args[1])) + 1
+        if name == "left":
+            n_ = int(args[1])
+            sv = _pg_text(a0)
+            return sv[:n_] if n_ >= 0 else sv[:len(sv) + n_]
+        if name == "right":
+            n_ = int(args[1])
+            sv = _pg_text(a0)
+            if n_ == 0:
+                return ""
+            # n < 0: all but the first |n| characters (PG semantics)
+            return sv[-n_:] if n_ > 0 else sv[abs(n_):]
+        if name == "lpad":
+            sv, width = _pg_text(a0), int(args[1])
+            fill = _pg_text(args[2]) if len(args) > 2 else " "
+            if len(sv) >= width:
+                return sv[:width]
+            pad = (fill * width)[:width - len(sv)]
+            return pad + sv
+        if name == "rpad":
+            sv, width = _pg_text(a0), int(args[1])
+            fill = _pg_text(args[2]) if len(args) > 2 else " "
+            if len(sv) >= width:
+                return sv[:width]
+            return sv + (fill * width)[:width - len(sv)]
+        if name == "split_part":
+            parts = _pg_text(a0).split(_pg_text(args[1]))
+            i_ = int(args[2])
+            return parts[i_ - 1] if 1 <= i_ <= len(parts) else ""
+        if name == "starts_with":
+            return _pg_text(a0).startswith(_pg_text(args[1]))
+        if name == "initcap":
+            import re as _re2
+            return _re2.sub(r"[A-Za-z0-9]+",
+                            lambda m: m.group(0).capitalize(),
+                            _pg_text(a0))
+        if name == "reverse":
+            return _pg_text(a0)[::-1]
         if name == "subscript":
             # PG arrays are 1-based; out-of-bounds -> NULL
             arr = _as_array(a0)
@@ -393,9 +490,6 @@ def eval_expr_py(node: tuple, row: Dict[int, object]):
                 return None     # 1-D arrays only
             return len(arr) if arr else (0 if name == "cardinality"
                                          else None)
-        if name == "array_append":
-            arr = _as_array(a0)
-            return None if arr is None else arr + [args[1]]
         if name == "array_position":
             arr = _as_array(a0)
             if arr is None:
@@ -625,6 +719,36 @@ def extract_scan_options(where, range_cols):
         for r in residual[1:]:
             res = ("and", res, r)
     return point_lists, interval, res
+
+
+def classify_scan_options(schema, partition_kind: str, where):
+    """Shared skip-scan eligibility + shape, used by BOTH execution
+    (_scan_segments) and EXPLAIN so the reported plan can never drift
+    from what runs. Returns (kind, point_lists, interval, residual,
+    nseg) with kind in:
+      "seq"   — plain scan, residual = the original where
+      "empty" — provably-empty target set
+      "skip"  — enumerable point segments (nseg of them)
+      "range" — leading-interval bounds only
+    """
+    if partition_kind != "range" or where is None or \
+            any(c.sort_desc for c in schema.key_columns):
+        return ("seq", None, None, where, 0)
+    point_lists, interval, residual = extract_scan_options(
+        where, schema.key_columns)
+    if not point_lists and interval is None:
+        return ("seq", None, None, where, 0)
+    total = 1
+    for _c, vals in point_lists:
+        total *= len(vals)
+        if total > _MAX_SKIP_SEGMENTS:
+            # too many combinations to enumerate: full scan +
+            # row-wise filter (no silent cap on correctness)
+            return ("seq", None, None, where, 0)
+    if point_lists and total == 0:
+        return ("empty", point_lists, interval, residual, 0)
+    return ("skip" if point_lists else "range",
+            point_lists, interval, residual, total)
 
 
 def _skew_window_ht() -> int:
@@ -1101,22 +1225,12 @@ class DocReadOperation:
         `prefix` (may be b"") is required of every key (break past it)."""
         schema = self.codec.schema
         ps = self.codec.info.partition_schema
-        if ps.kind != "range" or req.where is None or \
-                any(c.sort_desc for c in schema.key_columns):
-            return None, req.where
-        point_lists, interval, residual = extract_scan_options(
-            req.where, schema.key_columns)
-        if not point_lists and interval is None:
-            return None, req.where
-        total = 1
-        for _c, vals in point_lists:
-            total *= max(len(vals), 0)
-            if total > _MAX_SKIP_SEGMENTS:
-                # too many combinations to enumerate: full scan +
-                # row-wise filter (no silent cap on correctness)
-                return None, req.where
-        if total == 0:
-            return [], residual          # provably-empty target set
+        kind, point_lists, interval, residual, _n = \
+            classify_scan_options(schema, ps.kind, req.where)
+        if kind == "seq":
+            return None, residual
+        if kind == "empty":
+            return [], residual
         from itertools import product
         from .table_codec import _KEV_MAKER
         from ..dockv.key_encoding import encode_key_entry

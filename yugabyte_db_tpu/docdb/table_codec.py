@@ -65,7 +65,8 @@ class TableInfo:
         return {
             "version": schema.version,
             "columns": [[c.id, c.name, c.type, c.nullable, c.is_hash_key,
-                         c.is_range_key, c.sort_desc, c.ql_type]
+                         c.is_range_key, c.sort_desc, c.ql_type,
+                         c.default_seq]
                         for c in schema.columns],
         }
 

@@ -76,6 +76,9 @@ class ColumnSchema:
     # the catalog so wire servers recover element typing after restart
     # (reference: QLTypePB params in common/ql_type.proto)
     ql_type: "str | None" = None
+    # serial/bigserial: the owned sequence feeding this column's
+    # INSERT default (reference: PG pg_attrdef nextval defaults)
+    default_seq: "str | None" = None
 
     @property
     def is_key(self) -> bool:

@@ -37,6 +37,19 @@ class Master:
         self.tables: Dict[str, dict] = {}      # table_id -> entry
         self.tablets: Dict[str, dict] = {}     # tablet_id -> entry
         self.tservers: Dict[str, dict] = {}    # ts_uuid -> {addr, last_hb}
+        # catalog-persisted maps below must be initialized BEFORE
+        # _load() so the snapshot's values survive __init__ (a later
+        # assignment would silently wipe them on standalone restart):
+        # table -> {source_master} inbound xCluster replication config
+        self.xcluster_replication: Dict[str, dict] = {}
+        # slot_id -> slot entry: the cdc_state-table analog for the
+        # CDC-SDK consumer API (reference: cdc/cdc_state_table.cc,
+        # replication-slot metadata in cdcsdk_virtual_wal.cc)
+        self.replication_slots: Dict[str, dict] = {}
+        # name -> {"next": int, "increment": int} (reference: PG
+        # sequences backed by PgSequenceCache chunks,
+        # tserver/pg_client_session.cc sequence ops)
+        self.sequences: Dict[str, dict] = {}
         self._load()
         self.messenger.register_service("master", self)
         self.messenger.register_service("master-heartbeat", self)
@@ -46,14 +59,6 @@ class Master:
         self._running = False
         # table -> replicated-up-to HT for inbound xCluster replication
         self._xcluster_safe_time: Dict[str, int] = {}
-        # table -> {source_master: [host, port]} inbound replication
-        # config (catalog-persisted); running replicator tasks live in
-        # _xcluster_tasks on the leader only
-        self.xcluster_replication: Dict[str, dict] = {}
-        # slot_id -> slot entry: the cdc_state-table analog for the
-        # CDC-SDK consumer API (reference: cdc/cdc_state_table.cc,
-        # replication-slot metadata in cdcsdk_virtual_wal.cc)
-        self.replication_slots: Dict[str, dict] = {}
         self._xcluster_tasks: Dict[str, object] = {}
         # (ts_uuid, tablet_id) -> first time reported as orphaned
         self._orphan_seen: Dict[Tuple[str, str], float] = {}
@@ -62,6 +67,9 @@ class Master:
         # replicas update) — the orphan sweep must not touch them
         self._gc_inflight: set = set()
         self._xcluster_reconcile_lock = asyncio.Lock()
+        # serializes sequence block allocation: the read-modify-commit
+        # spans an await (Raft replicate) and must not interleave
+        self._seq_lock = asyncio.Lock()
         self.auto_balance = False   # ticked explicitly or via enable
         # sys-catalog Raft (None = standalone single master, still
         # journals through a local single-peer group once started)
@@ -103,6 +111,10 @@ class Master:
                 self.replication_slots[op[1]] = op[2]
             elif kind == "del_repl_slot":
                 self.replication_slots.pop(op[1], None)
+            elif kind == "put_sequence":
+                self.sequences[op[1]] = op[2]
+            elif kind == "del_sequence":
+                self.sequences.pop(op[1], None)
         self._persist()
 
     async def _commit_catalog(self, ops) -> None:
@@ -148,13 +160,15 @@ class Master:
             self.tablets = d["tablets"]
             self.xcluster_replication = d.get("xcluster", {})
             self.replication_slots = d.get("repl_slots", {})
+            self.sequences = d.get("sequences", {})
 
     def _persist(self):
         tmp = self._catalog_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"tables": self.tables, "tablets": self.tablets,
                        "xcluster": self.xcluster_replication,
-                       "repl_slots": self.replication_slots}, f)
+                       "repl_slots": self.replication_slots,
+                       "sequences": self.sequences}, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._catalog_path)
@@ -1218,6 +1232,47 @@ class Master:
         # the catalog re-adopted and then dropped them)
         for key in [k for k in seen if k not in reported]:
             seen.pop(key, None)
+
+    # --- sequences (reference: PG sequence relations; allocation is
+    # Raft-replicated in BLOCKS so clients cache locally like
+    # PgSequenceCache and a master failover can only leave gaps,
+    # never duplicates) ---------------------------------------------------
+    async def rpc_create_sequence(self, payload) -> dict:
+        self._check_leader()
+        name = payload["name"]
+        if name in self.sequences:
+            if payload.get("if_not_exists"):
+                return {"ok": True, "existing": True}
+            raise RpcError(f"sequence {name} exists", "ALREADY_PRESENT")
+        ent = {"next": int(payload.get("start", 1)),
+               "increment": int(payload.get("increment", 1))}
+        await self._commit_catalog([["put_sequence", name, ent]])
+        return {"ok": True}
+
+    async def rpc_drop_sequence(self, payload) -> dict:
+        self._check_leader()
+        name = payload["name"]
+        if name not in self.sequences:
+            raise RpcError(f"sequence {name} not found", "NOT_FOUND")
+        await self._commit_catalog([["del_sequence", name]])
+        return {"ok": True}
+
+    async def rpc_sequence_alloc(self, payload) -> dict:
+        """Allocate a block of `count` values: the commit moves the
+        persisted next pointer PAST the block before any value is
+        handed out, so crashes/failovers skip numbers, never reuse."""
+        self._check_leader()
+        name = payload["name"]
+        count = max(1, int(payload.get("count", 1)))
+        async with self._seq_lock:
+            ent = self.sequences.get(name)
+            if ent is None:
+                raise RpcError(f"sequence {name} not found",
+                               "NOT_FOUND")
+            first, inc = ent["next"], ent["increment"]
+            new = dict(ent, next=first + count * inc)
+            await self._commit_catalog([["put_sequence", name, new]])
+        return {"first": first, "count": count, "increment": inc}
 
     async def rpc_list_replication_slots(self, payload) -> dict:
         self._check_leader()

@@ -92,6 +92,8 @@ def device_compatible(node: ExprNode) -> bool:
     if node[0] == "like":
         return isinstance(node[1], (tuple, list)) and \
             device_compatible(node[1])
+    if node[0] == "arith" and node[1] not in _ARITH:
+        return False       # e.g. "concat": CPU row path only
     for c in node[1:]:
         if isinstance(c, (tuple, list)) and c and isinstance(c[0], str):
             if not device_compatible(c):
@@ -146,6 +148,9 @@ _CMP = {
 _ARITH = {
     "add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
     "div": jnp.divide,
+    # mod matches the CPU path's PG truncate-toward-zero semantics
+    # (jnp.fmod truncates; jnp.mod floors)
+    "mod": jnp.fmod,
 }
 
 

@@ -25,9 +25,10 @@ from ..dockv.packed_row import ColumnSchema, ColumnType, TableSchema
 from ..dockv.partition import PartitionSchema
 from ..ops.scan import AggSpec, GroupSpec, HashGroupSpec
 from .parser import (
-    AlterTableStmt, AnalyzeStmt, CreateIndexStmt, CreateTableStmt,
-    DeleteStmt, DropTableStmt, ExplainStmt, InsertStmt, SelectStmt,
-    TxnStmt, UpdateStmt, parse_statement,
+    AlterTableStmt, AnalyzeStmt, CreateIndexStmt, CreateSequenceStmt,
+    CreateTableStmt, DeleteStmt, DropSequenceStmt, DropTableStmt,
+    ExplainStmt, InsertStmt, SelectStmt, TxnStmt, UpdateStmt,
+    parse_statement,
 )
 
 _TYPE_MAP = {
@@ -123,6 +124,21 @@ class SqlSession:
     async def _dispatch_inner(self, stmt) -> SqlResult:
         if isinstance(stmt, CreateTableStmt):
             return await self._create(stmt)
+        if isinstance(stmt, CreateSequenceStmt):
+            await self.client.create_sequence(
+                stmt.name, stmt.start, stmt.increment,
+                stmt.if_not_exists)
+            return SqlResult([], "CREATE SEQUENCE")
+        if isinstance(stmt, DropSequenceStmt):
+            from ..rpc.messenger import RpcError
+            try:
+                await self.client.drop_sequence(stmt.name)
+            except RpcError as e:
+                # IF EXISTS forgives only not-found — a leaderless
+                # master etc. must still surface
+                if not (stmt.if_exists and e.code == "NOT_FOUND"):
+                    raise
+            return SqlResult([], "DROP SEQUENCE")
         if isinstance(stmt, DropTableStmt):
             return await self._drop(stmt)
         if isinstance(stmt, InsertStmt):
@@ -317,34 +333,28 @@ class SqlSession:
                         lines.append(f"  Limit {stmt.limit}: "
                                      f"client-side")
                 else:
-                    # mirror _scan_segments' guards exactly so the plan
-                    # reports what execution will actually do
-                    scan_kind = f"Seq Scan on {stmt.table}"
+                    # the SAME classifier execution uses, so the plan
+                    # can never drift from actual behavior
+                    from ..docdb.operations import classify_scan_options
                     schema = ct.info.schema
-                    if stmt.where is not None and \
-                            ct.info.partition_schema.kind == "range" \
-                            and not any(c.sort_desc
-                                        for c in schema.key_columns):
-                        from ..docdb.operations import (
-                            _MAX_SKIP_SEGMENTS, extract_scan_options,
-                        )
-                        pts, interval, _res = extract_scan_options(
-                            self._bind(stmt.where, schema),
-                            list(schema.key_columns))
-                        nseg = 1
-                        for _c, vals in pts:
-                            nseg *= len(vals)
-                        if pts and nseg == 0:
-                            scan_kind = (f"Skip Scan on {stmt.table} "
-                                         f"(empty target set)")
-                        elif pts and nseg <= _MAX_SKIP_SEGMENTS:
-                            scan_kind = (f"Skip Scan on {stmt.table} "
-                                         f"({nseg} segments"
-                                         + (", range-bounded)"
-                                            if interval else ")"))
-                        elif interval and not pts:
-                            scan_kind = (f"Range Scan on {stmt.table} "
-                                         f"(pk bounds)")
+                    kind, _pts, interval, _res, nseg = \
+                        classify_scan_options(
+                            schema, ct.info.partition_schema.kind,
+                            self._bind(stmt.where, schema)
+                            if stmt.where is not None else None)
+                    if kind == "empty":
+                        scan_kind = (f"Skip Scan on {stmt.table} "
+                                     f"(empty target set)")
+                    elif kind == "skip":
+                        scan_kind = (f"Skip Scan on {stmt.table} "
+                                     f"({nseg} segments"
+                                     + (", range-bounded)"
+                                        if interval else ")"))
+                    elif kind == "range":
+                        scan_kind = (f"Range Scan on {stmt.table} "
+                                     f"(pk bounds)")
+                    else:
+                        scan_kind = f"Seq Scan on {stmt.table}"
                     lines.append(scan_kind)
                     if stmt.where is not None:
                         lines.append("  Filter: pushed to tablets "
@@ -396,8 +406,16 @@ class SqlSession:
         cols = []
         pk = stmt.primary_key
         range_sharded = getattr(stmt, "range_sharded", False)
+        serial_cols = []       # (column, owned sequence) to create
         for i, (name, typ) in enumerate(stmt.columns):
-            ct = resolve_type(typ)
+            default_seq = None
+            if typ in ("serial", "smallserial", "bigserial"):
+                ct = (ColumnType.INT64 if typ == "bigserial"
+                      else ColumnType.INT32)
+                default_seq = f"{stmt.name}_{name}_seq"
+                serial_cols.append(default_seq)
+            else:
+                ct = resolve_type(typ)
             if ct is None:
                 raise ValueError(f"unknown type {typ}")
             cols.append(ColumnSchema(
@@ -406,7 +424,10 @@ class SqlSession:
                 is_range_key=(name in pk if range_sharded
                               else name in pk[1:]),
                 sort_desc=name in getattr(stmt, "pk_desc", []),
-                ql_type=typ if is_collection_type(typ) else None))
+                ql_type=typ if is_collection_type(typ) else None,
+                default_seq=default_seq))
+        for seq in serial_cols:
+            await self.client.create_sequence(seq, if_not_exists=True)
         schema = TableSchema(columns=tuple(cols), version=1)
         info = TableInfo(
             "", stmt.name, schema,
@@ -478,6 +499,18 @@ class SqlSession:
                 if isinstance(row[jc], (list, dict)):
                     import json as _json
                     row[jc] = _json.dumps(row[jc])
+            from .parser import SeqFuncValue
+            for cname, v in list(row.items()):
+                if isinstance(v, SeqFuncValue):   # per inserted row
+                    row[cname] = (
+                        await self.client.sequence_next(v.name)
+                        if v.fn == "nextval"
+                        else self.client.sequence_current(v.name))
+            for c in ct.info.schema.columns:
+                # serial defaults for omitted columns
+                if getattr(c, "default_seq", None) and c.name not in row:
+                    row[c.name] = await self.client.sequence_next(
+                        c.default_seq)
             self._coerce_decimals(dec_cols, row)
             rows.append(row)
         if self._txn is not None:
@@ -539,11 +572,15 @@ class SqlSession:
             await self._txn.lock_rows(
                 table, [{n: r[n] for n in pk_names} for r in resp.rows])
 
-    async def _resolve_subqueries(self, node):
+    async def _resolve_subqueries(self, node, seq_ok: bool = False):
         """Replace ("in_subquery", expr, SelectStmt) with a plain
         ("in", expr, values) by running the subquery (semi-join via
         materialized value list — the reference plans these as hash
-        semi-joins; ours inlines, which also keeps pushdown working)."""
+        semi-joins; ours inlines, which also keeps pushdown working).
+        seq_ok: nextval()/currval() may resolve here ONLY in
+        single-row contexts (FROM-less SELECT) — statement-level
+        resolution in a multi-row scan would hand every row the same
+        value (PG evaluates per row), so those contexts raise."""
         if not isinstance(node, tuple):
             return node
         if node[0] == "in_subquery":
@@ -564,9 +601,41 @@ class SqlSession:
                 return ("or", in_node,
                         ("cmp", "eq", ("const", None), ("const", None)))
             return in_node
+        if node[0] == "fn" and node[1] in ("nextval", "currval"):
+            if not seq_ok:
+                raise ValueError(
+                    f"{node[1]}() is supported in INSERT VALUES, "
+                    f"serial column defaults, and single-row SELECT "
+                    f"(it would evaluate once per STATEMENT here, "
+                    f"not once per row)")
+            arg = node[2]
+            if arg[0] != "const" or not isinstance(arg[1], str):
+                raise ValueError(f"{node[1]}() needs a sequence name")
+            if node[1] == "nextval":
+                v = await self.client.sequence_next(arg[1])
+            else:
+                v = self.client.sequence_current(arg[1])
+            return ("const", v)
+        if node[0] == "exists_subquery":
+            # uncorrelated EXISTS: one probe row decides it
+            import dataclasses
+            sub = dataclasses.replace(node[1], limit=1)
+            res = await self._select(sub)
+            return ("const", bool(res.rows))
+        if node[0] == "scalar_subquery":
+            sub = node[1]
+            if len(sub.items) != 1 or sub.items[0][0] == "star":
+                raise ValueError(
+                    "scalar subquery must produce exactly one column")
+            res = await self._select(sub)
+            if len(res.rows) > 1:
+                raise ValueError(
+                    "scalar subquery produced more than one row")
+            v = next(iter(res.rows[0].values())) if res.rows else None
+            return ("const", v)
         out = []
         for c in node:
-            out.append(await self._resolve_subqueries(c)
+            out.append(await self._resolve_subqueries(c, seq_ok)
                        if isinstance(c, tuple) else c)
         return tuple(out)
 
@@ -585,6 +654,19 @@ class SqlSession:
                 self._cte_rows = saved
         if stmt.where is not None:
             stmt.where = await self._resolve_subqueries(stmt.where)
+        for i, it in enumerate(stmt.items):
+            if it[0] == "expr":
+                stmt.items[i] = ("expr", await self._resolve_subqueries(
+                    it[1], seq_ok=stmt.table is None))
+        if stmt.table is None:
+            # FROM-less constant SELECT: one row of evaluated items
+            row = {}
+            for i, it in enumerate(stmt.items):
+                if it[0] != "expr":
+                    raise ValueError(
+                        "FROM-less SELECT supports expressions only")
+                row[self._item_name(stmt, i)] = eval_expr_py(it[1], {})
+            return SqlResult([row])
         if getattr(stmt, "joins", None):
             return await self._select_join(stmt)
         if stmt.table in self._cte_rows:
@@ -1472,7 +1554,26 @@ class SqlSession:
                                             rows)
         if not rows:
             return SqlResult([], "UPDATE 0")
-        updated = [dict(r, **stmt.sets) for r in rows]
+        # SET targets are full expressions evaluated over the PRE-image
+        # of each row (SET a = b, b = a swaps, like PG); subqueries and
+        # sequence calls resolve statement-level first
+        bound_sets = {name: self._bind(
+            await self._resolve_subqueries(e), schema)
+            for name, e in stmt.sets.items()}
+        json_cols = {c.name for c in schema.columns
+                     if c.type == ColumnType.JSON}
+        updated = []
+        for r in rows:
+            idrow = {schema.column_by_name(k).id: v
+                     for k, v in r.items()}
+            nr = dict(r)
+            for name, e in bound_sets.items():
+                v = eval_expr_py(e, idrow)
+                if name in json_cols and isinstance(v, (list, dict)):
+                    import json as _json
+                    v = _json.dumps(v)
+                nr[name] = v
+            updated.append(nr)
         dec_cols = _decimal_cols(schema)
         for r in updated:
             self._coerce_decimals(dec_cols, r)

@@ -19,7 +19,7 @@ _TOKEN = re.compile(r"""
     \s*(?:
       (?P<num>\d+\.\d+(?:[eE][-+]?\d+)?|\d+)
     | (?P<str>'(?:[^']|'')*')
-    | (?P<op><->|->>|->|<=|>=|<>|!=|[=<>(),;*+\-/\[\]%])
+    | (?P<op><->|->>|->|\|\||<=|>=|<>|!=|[=<>(),;*+\-/\[\]%])
     | (?P<word>[A-Za-z_][A-Za-z0-9_.]*)
     )""", re.VERBOSE)
 
@@ -44,7 +44,12 @@ WINDOW_FNS = {"row_number", "rank", "dense_rank", "lag", "lead"}
 SCALAR_FNS = {"now", "coalesce", "abs", "round", "upper", "lower",
               "length", "floor", "ceil", "trunc", "sqrt", "power",
               "mod", "date_trunc", "array_length", "cardinality",
-              "array_append", "array_prepend", "array_position"}
+              "array_append", "array_prepend", "array_position",
+              "substr", "substring", "replace", "trim", "ltrim",
+              "rtrim", "strpos", "left", "right", "lpad", "rpad",
+              "split_part", "starts_with", "concat", "initcap",
+              "reverse", "nullif", "greatest", "least",
+              "nextval", "currval"}
 
 
 def tokenize(sql: str) -> List[Tuple[str, str]]:
@@ -101,6 +106,28 @@ class AlterTableStmt:
 
 @dataclass
 class DropTableStmt:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class SeqFuncValue:
+    """nextval('s') / currval('s') appearing in INSERT VALUES — the
+    executor resolves it per row (PG: one value per inserted row)."""
+    fn: str
+    name: str
+
+
+@dataclass
+class CreateSequenceStmt:
+    name: str
+    start: int = 1
+    increment: int = 1
+    if_not_exists: bool = False
+
+
+@dataclass
+class DropSequenceStmt:
     name: str
     if_exists: bool = False
 
@@ -285,6 +312,9 @@ class Parser:
         self.expect_kw("create")
         if self.accept_kw("index"):
             return self._create_index()
+        t = self.peek()
+        if t and t[0] == "id" and t[1].lower() == "sequence":
+            return self._create_sequence()
         self.expect_kw("table")
         ine = False
         if self.accept_kw("if"):
@@ -409,12 +439,46 @@ class Parser:
 
     def drop_table(self):
         self.expect_kw("drop")
+        t = self.peek()
+        if t and t[0] == "id" and t[1].lower() == "sequence":
+            self.next()
+            ie = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                ie = True
+            return DropSequenceStmt(self.ident(), ie)
         self.expect_kw("table")
         ie = False
         if self.accept_kw("if"):
             self.expect_kw("exists")
             ie = True
         return DropTableStmt(self.ident(), ie)
+
+    def _create_sequence(self):
+        """CREATE SEQUENCE [IF NOT EXISTS] name [START [WITH] n]
+        [INCREMENT [BY] n] (reference: PG sequence DDL)."""
+        self.next()                         # 'sequence'
+        ine = False
+        if self.accept_kw("if"):
+            if not self.accept_kw("not"):
+                raise ValueError("expected NOT after IF")
+            self.expect_kw("exists")
+            ine = True
+        name = self.ident()
+        start, increment = 1, 1
+        while True:
+            t = self.peek()
+            if t and t[0] == "id" and t[1].lower() == "start":
+                self.next()
+                self.accept_kw("with")
+                start = int(self.literal())
+            elif t and t[0] == "id" and t[1].lower() == "increment":
+                self.next()
+                self.accept_kw("by")
+                increment = int(self.literal())
+            else:
+                break
+        return CreateSequenceStmt(name, start, increment, ine)
 
     def insert(self):
         self.expect_kw("insert")
@@ -501,6 +565,14 @@ class Parser:
         if t[0] == "op" and t[1] == "-":
             v = self.literal()
             return -v
+        if t[0] == "id" and t[1].lower() in ("nextval", "currval") \
+                and self.peek() == ("op", "("):
+            self.next()
+            n = self.next()
+            if n[0] != "str":
+                raise ValueError(f"{t[1]}() needs a sequence name")
+            self.expect_op(")")
+            return SeqFuncValue(t[1].lower(), n[1])
         if t[0] == "kw" and t[1].lower() == "array":
             # ARRAY[lit, ...] in a VALUES list -> Python list value
             self.expect_op("[")
@@ -594,7 +666,9 @@ class Parser:
                         items.append(("expr", expr))
             if not self.accept_op(","):
                 break
-        self.expect_kw("from")
+        if not self.accept_kw("from"):
+            # FROM-less constant SELECT: SELECT 1, SELECT nextval('s')
+            return SelectStmt(None, items, aliases=aliases)
         table = self.ident()
         joins = []
         while True:
@@ -703,7 +777,9 @@ class Parser:
         while True:
             col = self.ident()
             self.expect_op("=")
-            sets[col] = self.literal()
+            # full expressions: SET v = v + 1, SET n = upper(n), ...
+            # (reference: PG UPDATE targetlist evaluation)
+            sets[col] = self.expr()
             if not self.accept_op(","):
                 break
         where = None
@@ -730,6 +806,15 @@ class Parser:
     def not_expr(self):
         if self.accept_kw("not"):
             return ("not", self.not_expr())
+        t = self.peek()
+        if t and t[0] == "kw" and t[1].lower() == "exists" \
+                and self.pos + 1 < len(self.toks) \
+                and self.toks[self.pos + 1] == ("op", "("):
+            self.next()
+            self.expect_op("(")
+            sub = self.select()
+            self.expect_op(")")
+            return ("exists_subquery", sub)
         return self.cmp_expr()
 
     def cmp_expr(self):
@@ -794,6 +879,8 @@ class Parser:
                 left = ("arith", "add", left, self.mul_expr())
             elif self.accept_op("-"):
                 left = ("arith", "sub", left, self.mul_expr())
+            elif self.accept_op("||"):
+                left = ("arith", "concat", left, self.mul_expr())
             else:
                 return left
 
@@ -828,6 +915,11 @@ class Parser:
 
     def _primary_expr(self):
         if self.accept_op("("):
+            t = self.peek()
+            if t and t[0] == "kw" and t[1].lower() == "select":
+                sub = self.select()
+                self.expect_op(")")
+                return ("scalar_subquery", sub)
             e = self.expr()
             self.expect_op(")")
             return e
