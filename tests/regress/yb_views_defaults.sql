@@ -1,0 +1,19 @@
+-- views (catalog-persisted SELECT bodies), column DEFAULT / NOT NULL,
+-- RETURNING on INSERT/UPDATE/DELETE
+CREATE TABLE tk (k bigint, status text DEFAULT 'new' NOT NULL, v double DEFAULT 1.5, PRIMARY KEY (k)) WITH tablets = 1;
+INSERT INTO tk (k) VALUES (1) RETURNING *;
+INSERT INTO tk (k, status, v) VALUES (2, 'open', 4.0), (3, 'done', 9.0) RETURNING k, status;
+INSERT INTO tk (k, status) VALUES (4, NULL);
+UPDATE tk SET v = v + 1 WHERE status = 'open' RETURNING k, v;
+UPDATE tk SET status = NULL WHERE k = 1;
+DELETE FROM tk WHERE k = 3 RETURNING k, v;
+SELECT k, status, v FROM tk ORDER BY k;
+CREATE VIEW live AS SELECT k, v FROM tk WHERE v > 2.0;
+SELECT k FROM live ORDER BY k;
+SELECT count(*), max(v) FROM live;
+CREATE OR REPLACE VIEW live AS SELECT k, v FROM tk WHERE v > 0.0;
+SELECT count(*) FROM live;
+DROP VIEW live;
+SELECT k FROM live;
+DROP VIEW IF EXISTS live;
+DROP TABLE tk

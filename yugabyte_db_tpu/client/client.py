@@ -213,6 +213,27 @@ class YBClient:
                 f"in this session", "INVALID_ARGUMENT")
         return self._seq_last[name]
 
+    async def create_view(self, name: str, select_sql: str,
+                          or_replace: bool = False) -> None:
+        await self._master_call("create_view", {
+            "name": name, "select_sql": select_sql,
+            "or_replace": or_replace})
+
+    async def drop_view(self, name: str) -> None:
+        await self._master_call("drop_view", {"name": name})
+
+    async def get_view(self, name: str) -> Optional[str]:
+        """View body SQL, or None. Uncached: views resolve only after a
+        table lookup misses, and redefinitions through other nodes must
+        be visible."""
+        try:
+            r = await self._master_call("get_view", {"name": name})
+        except RpcError as e:
+            if e.code == "NOT_FOUND":
+                return None
+            raise
+        return r["select_sql"]
+
     async def drop_table(self, name: str) -> None:
         await self._master_call("drop_table", {"name": name})
         self._tables.pop(name, None)

@@ -66,7 +66,7 @@ class TableInfo:
             "version": schema.version,
             "columns": [[c.id, c.name, c.type, c.nullable, c.is_hash_key,
                          c.is_range_key, c.sort_desc, c.ql_type,
-                         c.default_seq]
+                         c.default_seq, c.default_value]
                         for c in schema.columns],
         }
 

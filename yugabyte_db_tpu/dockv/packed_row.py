@@ -79,6 +79,9 @@ class ColumnSchema:
     # serial/bigserial: the owned sequence feeding this column's
     # INSERT default (reference: PG pg_attrdef nextval defaults)
     default_seq: "str | None" = None
+    # literal DEFAULT applied when INSERT omits the column
+    # (reference: PG pg_attrdef)
+    default_value: object = None
 
     @property
     def is_key(self) -> bool:

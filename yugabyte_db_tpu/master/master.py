@@ -50,6 +50,9 @@ class Master:
         # sequences backed by PgSequenceCache chunks,
         # tserver/pg_client_session.cc sequence ops)
         self.sequences: Dict[str, dict] = {}
+        # view name -> SELECT body SQL (persisted verbatim; expanded
+        # by the SQL layer at query time — reference: PG pg_views)
+        self.views: Dict[str, str] = {}
         self._load()
         self.messenger.register_service("master", self)
         self.messenger.register_service("master-heartbeat", self)
@@ -115,6 +118,10 @@ class Master:
                 self.sequences[op[1]] = op[2]
             elif kind == "del_sequence":
                 self.sequences.pop(op[1], None)
+            elif kind == "put_view":
+                self.views[op[1]] = op[2]
+            elif kind == "del_view":
+                self.views.pop(op[1], None)
         self._persist()
 
     async def _commit_catalog(self, ops) -> None:
@@ -161,6 +168,7 @@ class Master:
             self.xcluster_replication = d.get("xcluster", {})
             self.replication_slots = d.get("repl_slots", {})
             self.sequences = d.get("sequences", {})
+            self.views = d.get("views", {})
 
     def _persist(self):
         tmp = self._catalog_path + ".tmp"
@@ -168,7 +176,8 @@ class Master:
             json.dump({"tables": self.tables, "tablets": self.tablets,
                        "xcluster": self.xcluster_replication,
                        "repl_slots": self.replication_slots,
-                       "sequences": self.sequences}, f)
+                       "sequences": self.sequences,
+                       "views": self.views}, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._catalog_path)
@@ -1273,6 +1282,32 @@ class Master:
             new = dict(ent, next=first + count * inc)
             await self._commit_catalog([["put_sequence", name, new]])
         return {"first": first, "count": count, "increment": inc}
+
+    async def rpc_create_view(self, payload) -> dict:
+        self._check_leader()
+        name = payload["name"]
+        if name in self.views and not payload.get("or_replace"):
+            raise RpcError(f"view {name} exists", "ALREADY_PRESENT")
+        if any(t["info"]["name"] == name for t in self.tables.values()):
+            raise RpcError(f"{name} is a table", "ALREADY_PRESENT")
+        await self._commit_catalog([["put_view", name,
+                                     payload["select_sql"]]])
+        return {"ok": True}
+
+    async def rpc_drop_view(self, payload) -> dict:
+        self._check_leader()
+        name = payload["name"]
+        if name not in self.views:
+            raise RpcError(f"view {name} not found", "NOT_FOUND")
+        await self._commit_catalog([["del_view", name]])
+        return {"ok": True}
+
+    async def rpc_get_view(self, payload) -> dict:
+        sql = self.views.get(payload["name"])
+        if sql is None:
+            raise RpcError(f"view {payload['name']} not found",
+                           "NOT_FOUND")
+        return {"select_sql": sql}
 
     async def rpc_list_replication_slots(self, payload) -> dict:
         self._check_leader()

@@ -26,9 +26,9 @@ from ..dockv.partition import PartitionSchema
 from ..ops.scan import AggSpec, GroupSpec, HashGroupSpec
 from .parser import (
     AlterTableStmt, AnalyzeStmt, CreateIndexStmt, CreateSequenceStmt,
-    CreateTableStmt, DeleteStmt, DropSequenceStmt, DropTableStmt,
-    ExplainStmt, InsertStmt, SelectStmt, TxnStmt, UpdateStmt,
-    parse_statement,
+    CreateTableStmt, CreateViewStmt, DeleteStmt, DropSequenceStmt,
+    DropTableStmt, DropViewStmt, ExplainStmt, InsertStmt, SelectStmt,
+    TxnStmt, UpdateStmt, parse_statement,
 )
 
 _TYPE_MAP = {
@@ -124,6 +124,18 @@ class SqlSession:
     async def _dispatch_inner(self, stmt) -> SqlResult:
         if isinstance(stmt, CreateTableStmt):
             return await self._create(stmt)
+        if isinstance(stmt, CreateViewStmt):
+            await self.client.create_view(stmt.name, stmt.select_sql,
+                                          stmt.or_replace)
+            return SqlResult([], "CREATE VIEW")
+        if isinstance(stmt, DropViewStmt):
+            from ..rpc.messenger import RpcError
+            try:
+                await self.client.drop_view(stmt.name)
+            except RpcError as e:
+                if not (stmt.if_exists and e.code == "NOT_FOUND"):
+                    raise
+            return SqlResult([], "DROP VIEW")
         if isinstance(stmt, CreateSequenceStmt):
             await self.client.create_sequence(
                 stmt.name, stmt.start, stmt.increment,
@@ -420,12 +432,14 @@ class SqlSession:
                 raise ValueError(f"unknown type {typ}")
             cols.append(ColumnSchema(
                 i, name, ct,
+                nullable=name not in getattr(stmt, "not_null", ()),
                 is_hash_key=(not range_sharded and name == pk[0]),
                 is_range_key=(name in pk if range_sharded
                               else name in pk[1:]),
                 sort_desc=name in getattr(stmt, "pk_desc", []),
                 ql_type=typ if is_collection_type(typ) else None,
-                default_seq=default_seq))
+                default_seq=default_seq,
+                default_value=getattr(stmt, "defaults", {}).get(name)))
         for seq in serial_cols:
             await self.client.create_sequence(seq, if_not_exists=True)
         schema = TableSchema(columns=tuple(cols), version=1)
@@ -507,10 +521,19 @@ class SqlSession:
                         if v.fn == "nextval"
                         else self.client.sequence_current(v.name))
             for c in ct.info.schema.columns:
-                # serial defaults for omitted columns
-                if getattr(c, "default_seq", None) and c.name not in row:
+                if c.name in row:
+                    continue
+                # omitted columns: serial, then literal DEFAULT
+                if getattr(c, "default_seq", None):
                     row[c.name] = await self.client.sequence_next(
                         c.default_seq)
+                elif getattr(c, "default_value", None) is not None:
+                    row[c.name] = c.default_value
+            for c in ct.info.schema.columns:
+                if not c.nullable and row.get(c.name) is None:
+                    raise ValueError(
+                        f"null value in column {c.name!r} violates "
+                        f"not-null constraint")
             self._coerce_decimals(dec_cols, row)
             rows.append(row)
         if self._txn is not None:
@@ -522,6 +545,11 @@ class SqlSession:
                              for r in rows])
         else:
             n = await self.client.insert(stmt.table, rows)
+        if getattr(stmt, "returning", None):
+            return SqlResult(
+                self._returning_rows(stmt.returning, rows,
+                                     ct.info.schema),
+                f"INSERT {n}")
         return SqlResult([], f"INSERT {n}")
 
     # ------------------------------------------------------------------
@@ -671,7 +699,20 @@ class SqlSession:
             return await self._select_join(stmt)
         if stmt.table in self._cte_rows:
             return self._rows_select(stmt, self._cte_rows[stmt.table])
-        ct = await self.client._table(stmt.table)
+        from ..rpc.messenger import RpcError
+        try:
+            ct = await self.client._table(stmt.table)
+        except RpcError as e:
+            if e.code != "NOT_FOUND":
+                raise
+            # maybe a VIEW: materialize its body and run the outer
+            # query over the rows (same machinery as a CTE table)
+            view_sql = await self.client.get_view(stmt.table)
+            if view_sql is None:
+                raise
+            inner = parse_statement(view_sql)
+            rows = (await self._select(inner)).rows
+            return self._rows_select(stmt, rows)
         schema = ct.info.schema
         read_ht = self._txn.start_ht if self._txn is not None else None
         where = self._bind(stmt.where, schema)
@@ -1494,6 +1535,14 @@ class SqlSession:
             rows.append(out)
         return SqlResult(rows)
 
+    @staticmethod
+    def _returning_rows(returning, rows, schema) -> List[dict]:
+        """RETURNING projection over the written/deleted row images
+        (* follows schema column order, like PG)."""
+        if returning == ["*"]:
+            returning = [c.name for c in schema.columns]
+        return [{c: r.get(c) for c in returning} for r in rows]
+
     # ------------------------------------------------------------------
     async def _delete(self, stmt: DeleteStmt) -> SqlResult:
         self._invalidate_stats(stmt.table)
@@ -1504,8 +1553,12 @@ class SqlSession:
         pk_cols = [c.name for c in schema.key_columns]
         read_ht = self._txn.start_ht if self._txn is not None else None
         where = self._bind(stmt.where, schema)
+        returning = getattr(stmt, "returning", None)
         scan_cols = tuple(pk_cols)
-        if self._txn is not None and self._txn.pending_writes(stmt.table):
+        if returning:
+            scan_cols = ()        # full pre-image for the projection
+        elif self._txn is not None and \
+                self._txn.pending_writes(stmt.table):
             # the overlay re-evaluates WHERE on merged rows: project
             # the WHERE columns too or committed values read as NULL
             scan_cols = tuple(self._overlay_columns(pk_cols, schema,
@@ -1514,17 +1567,22 @@ class SqlSession:
             "", columns=scan_cols, where=where, read_ht=read_ht))
         rows = resp.rows
         if self._txn is not None:
-            # targets include the txn's OWN uncommitted rows (and
-            # exclude ones it already deleted)
-            rows = [{k: r.get(k) for k in pk_cols}
-                    for r in self._overlay_txn_writes(
-                        stmt.table, schema, where, rows)]
+            rows = self._overlay_txn_writes(stmt.table, schema, where,
+                                            rows)
+        pre_images = rows
+        # targets include the txn's OWN uncommitted rows (and exclude
+        # ones it already deleted)
+        rows = [{k: r.get(k) for k in pk_cols} for r in rows]
         if not rows:
             return SqlResult([], "DELETE 0")
         if self._txn is not None:
             n = await self._txn.delete(stmt.table, rows)
         else:
             n = await self.client.delete(stmt.table, rows)
+        if returning:
+            return SqlResult(
+                self._returning_rows(returning, pre_images, schema),
+                f"DELETE {n}")
         return SqlResult([], f"DELETE {n}")
 
     @staticmethod
@@ -1575,12 +1633,23 @@ class SqlSession:
                 nr[name] = v
             updated.append(nr)
         dec_cols = _decimal_cols(schema)
+        nn_cols = [c.name for c in schema.columns
+                   if not c.nullable and c.name in stmt.sets]
         for r in updated:
             self._coerce_decimals(dec_cols, r)
+            for name in nn_cols:
+                if r.get(name) is None:
+                    raise ValueError(
+                        f"null value in column {name!r} violates "
+                        f"not-null constraint")
         if self._txn is not None:
             n = await self._txn.insert(stmt.table, updated)
         else:
             n = await self.client.insert(stmt.table, updated)
+        if getattr(stmt, "returning", None):
+            return SqlResult(
+                self._returning_rows(stmt.returning, updated, schema),
+                f"UPDATE {n}")
         return SqlResult([], f"UPDATE {n}")
 
 

@@ -86,6 +86,8 @@ class CreateTableStmt:
     num_tablets: int = 2
     replication_factor: int = 1
     if_not_exists: bool = False
+    defaults: Dict[str, object] = field(default_factory=dict)
+    not_null: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -106,6 +108,19 @@ class AlterTableStmt:
 
 @dataclass
 class DropTableStmt:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class CreateViewStmt:
+    name: str
+    select_sql: str          # the view body, persisted verbatim
+    or_replace: bool = False
+
+
+@dataclass
+class DropViewStmt:
     name: str
     if_exists: bool = False
 
@@ -139,6 +154,7 @@ class InsertStmt:
     rows: List[List[object]]
     ttl_ms: Optional[int] = None
     select: Optional["SelectStmt"] = None   # INSERT INTO ... SELECT
+    returning: Optional[List[str]] = None   # column names or ["*"]
 
 
 @dataclass
@@ -191,6 +207,7 @@ class SelectStmt:
 class DeleteStmt:
     table: str
     where: Optional[tuple] = None
+    returning: Optional[List[str]] = None
 
 
 @dataclass
@@ -198,6 +215,7 @@ class UpdateStmt:
     table: str
     sets: Dict[str, object] = field(default_factory=dict)
     where: Optional[tuple] = None
+    returning: Optional[List[str]] = None
 
 
 class Parser:
@@ -331,6 +349,8 @@ class Parser:
         num_hash = 1
         range_sharded = False
         pk_desc: List[str] = []
+        defaults: Dict[str, object] = {}
+        not_null: List[str] = []
         while True:
             if self.accept_kw("primary"):
                 self.expect_kw("key")
@@ -351,9 +371,22 @@ class Parser:
                 cname = self.ident()
                 ctype = self._column_type()
                 cols.append((cname, ctype))
-                if self.accept_kw("primary"):
-                    self.expect_kw("key")
-                    pk = [cname]
+                # column constraints: DEFAULT <literal>, NOT NULL,
+                # [column] PRIMARY KEY — any order
+                while True:
+                    t = self.peek()
+                    if t and t[0] == "id" and t[1].lower() == "default":
+                        self.next()
+                        defaults[cname] = self.literal()
+                    elif t and t[0] == "kw" and t[1].lower() == "not":
+                        self.next()
+                        self.expect_kw("null")
+                        not_null.append(cname)
+                    elif self.accept_kw("primary"):
+                        self.expect_kw("key")
+                        pk = [cname]
+                    else:
+                        break
             if not self.accept_op(","):
                 break
         self.expect_op(")")
@@ -369,7 +402,8 @@ class Parser:
         if not pk:
             raise ValueError("PRIMARY KEY required")
         return CreateTableStmt(name, cols, pk, range_sharded, pk_desc,
-                               num_hash, num_tablets, rf, ine)
+                               num_hash, num_tablets, rf, ine,
+                               defaults, not_null)
 
     def _column_type(self) -> str:
         """One column type: plain (`bigint`), parameterized
@@ -516,7 +550,8 @@ class Parser:
         if self.accept_kw("using"):
             self.expect_kw("ttl")
             ttl_ms = int(float(self.next()[1]) * 1000)   # seconds -> ms
-        return InsertStmt(table, cols, rows, ttl_ms)
+        return InsertStmt(table, cols, rows, ttl_ms,
+                          returning=self._returning())
 
     def txn_stmt(self):
         t = self.next()[1].lower()
@@ -767,7 +802,20 @@ class Parser:
         where = None
         if self.accept_kw("where"):
             where = self.expr()
-        return DeleteStmt(table, where)
+        return DeleteStmt(table, where, self._returning())
+
+    def _returning(self):
+        """RETURNING * | col [, col ...] after INSERT/UPDATE/DELETE."""
+        t = self.peek()
+        if not (t and t[0] == "id" and t[1].lower() == "returning"):
+            return None
+        self.next()
+        if self.accept_op("*"):
+            return ["*"]
+        out = [self.ident()]
+        while self.accept_op(","):
+            out.append(self.ident())
+        return out
 
     def update(self):
         self.expect_kw("update")
@@ -785,7 +833,7 @@ class Parser:
         where = None
         if self.accept_kw("where"):
             where = self.expr()
-        return UpdateStmt(table, sets, where)
+        return UpdateStmt(table, sets, where, self._returning())
 
     # -- expressions over column NAMES (bound to ids later) --
     def expr(self):
@@ -1064,11 +1112,37 @@ def parse_timestamp_micros(text: str) -> int:
     raise ValueError(f"bad timestamp literal {text!r}")
 
 
+_VIEW_CREATE = re.compile(
+    r"\s*create\s+(or\s+replace\s+)?view\s+(\w+)\s+as\s+(.+?);?\s*$",
+    re.I | re.S)
+_VIEW_DROP = re.compile(
+    r"\s*drop\s+view\s+(if\s+exists\s+)?(\w+)\s*;?\s*$", re.I)
+
+
+def _try_parse_view(sql: str):
+    m = _VIEW_CREATE.match(sql)
+    if m:
+        body = m.group(3).strip()
+        sel = Parser(tokenize(body)).parse()     # validates the body
+        if not isinstance(sel, SelectStmt):
+            raise ValueError("CREATE VIEW body must be a SELECT")
+        return CreateViewStmt(m.group(2), body, bool(m.group(1)))
+    m = _VIEW_DROP.match(sql)
+    if m:
+        return DropViewStmt(m.group(2), bool(m.group(1)))
+    return None
+
+
 def parse_statement(sql: str):
+    v = _try_parse_view(sql)
+    if v is not None:
+        return v
     return Parser(tokenize(sql)).parse()
 
 
 def parse_script(sql: str) -> List[object]:
     """Parse a multi-statement script (reference: PG simple-query
     protocol scripts)."""
+    if _VIEW_CREATE.match(sql) or _VIEW_DROP.match(sql):
+        return [parse_statement(sql)]
     return Parser(tokenize(sql)).parse_many()
