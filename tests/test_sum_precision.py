@@ -1,0 +1,166 @@
+"""SUM accumulation soundness at scale (the round-2 bench failure class).
+
+Round 2's bench crashed because grouped device SUM ran through an f32
+one-hot matmul: past 2^24 a float32 accumulator cannot even represent
+the exact total, and MXU accumulation order made the drift
+device-dependent. The fix (ops/scan.py): exact int64 accumulation —
+integer-valued columns sum exactly end-to-end; float values quantize to
+int64 fixed point with a deterministic per-batch scale. These tests run
+the Q1 shape at 2M+ rows with group sums far beyond 2^24 on the
+TPU-representative f32 device dtype, so scale-dependent precision can
+never again pass tests but fail the bench.
+
+Reference semantics being matched: exact PG numeric aggregation in
+EvalAggregate (src/yb/docdb/pgsql_operation.cc:3153).
+"""
+import numpy as np
+import pytest
+
+from yugabyte_db_tpu.ops import AggSpec, Expr, ScanKernel
+from yugabyte_db_tpu.ops.device_batch import build_batch
+from yugabyte_db_tpu.ops.scan import GroupSpec, HashGroupSpec
+from yugabyte_db_tpu.storage.columnar import ColumnarBlock
+from yugabyte_db_tpu.utils import flags
+
+C = Expr.col
+N = 2_000_000
+QTY, PRICE, FLAG = 1, 2, 3
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(7)
+    return {
+        # integer-valued, per-group sums ~5.6e7 >> 2^24 (=1.7e7)
+        "qty": rng.integers(1, 200, N).astype(np.float64),
+        "price": rng.uniform(900.0, 105000.0, N),
+        "flag": rng.integers(0, 3, N).astype(np.int32),
+    }
+
+
+def _block(d):
+    n = len(d["qty"])
+    return ColumnarBlock.from_arrays(
+        schema_version=1,
+        key_hash=np.arange(n, dtype=np.uint64),
+        ht=np.full(n, 10, np.uint64),
+        fixed={
+            QTY: (d["qty"], np.zeros(n, bool)),
+            PRICE: (d["price"], np.zeros(n, bool)),
+            FLAG: (d["flag"], np.zeros(n, bool)),
+        },
+        tombstone=np.zeros(n, bool), unique_keys=True)
+
+
+@pytest.fixture(scope="module")
+def f32_batch(data):
+    # force the TPU-representative device dtype on the CPU test backend
+    flags.set_flag("device_float_dtype", "float32")
+    try:
+        yield build_batch([_block(data)], [QTY, PRICE, FLAG])
+    finally:
+        flags.set_flag("device_float_dtype", "auto")
+
+
+AGGS = (AggSpec("sum", C(QTY).node), AggSpec("sum", C(PRICE).node),
+        AggSpec("count"))
+
+
+def test_integral_column_ships_as_exact_int(f32_batch):
+    assert f32_batch.cols[QTY].dtype == np.int32      # integer-valued f64
+    assert f32_batch.cols[PRICE].dtype == np.float32  # fractional f64
+
+
+def test_grouped_sum_exact_past_2p24(data, f32_batch):
+    outs, counts, _ = ScanKernel().run(
+        f32_batch, None, AGGS, GroupSpec(cols=((FLAG, 3, 0),)))
+    for g in range(3):
+        m = data["flag"] == g
+        want_qty = data["qty"][m].sum()       # exact in f64 (< 2^53)
+        assert want_qty > 2 ** 24             # the round-2 failure regime
+        # integer-valued column: EXACT, no tolerance at all
+        assert float(outs[0][g]) == want_qty
+        # fractional column: only per-row f32 representation error
+        # (<= 2^-24 rel/row, all-positive => ~1.2e-7 on the sum) plus
+        # <= 1e-12 quantization; 1e-5 keeps two orders of margin
+        want_price = data["price"][m].sum()
+        assert abs(float(outs[1][g]) - want_price) <= 1e-5 * want_price
+        assert int(outs[2][g]) == int(counts[g]) == int(m.sum())
+
+
+def test_ungrouped_sum_exact(data, f32_batch):
+    outs, cnt, _ = ScanKernel().run(f32_batch, None, AGGS, None)
+    assert float(outs[0]) == data["qty"].sum()
+    want = data["price"].sum()
+    assert abs(float(outs[1]) - want) <= 1e-5 * want
+    assert int(cnt) == N
+
+
+def test_hash_grouped_sum_exact(data, f32_batch):
+    outs, counts, _, gvals, n_groups = ScanKernel().run(
+        f32_batch, None, AGGS, HashGroupSpec(cols=(FLAG,)))
+    assert int(n_groups) == 3
+    order = np.argsort(np.asarray(gvals[0])[:3])
+    for slot, g in zip(order, sorted(np.unique(data["flag"]))):
+        m = data["flag"] == g
+        assert float(outs[0][slot]) == data["qty"][m].sum()
+        want = data["price"][m].sum()
+        assert abs(float(outs[1][slot]) - want) <= 1e-5 * want
+        assert int(counts[slot]) == int(m.sum())
+
+
+def test_distributed_psum_matches_numpy(data):
+    """8-shard psum combine: int64 partials with a pmax-agreed scale
+    must land within the same bounds as the single-batch path — and the
+    integer column must be EXACT across the mesh."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    from yugabyte_db_tpu.parallel.distributed_scan import (
+        build_sharded_batch, distributed_scan_aggregate,
+    )
+    from yugabyte_db_tpu.parallel.mesh import tablet_mesh
+    flags.set_flag("device_float_dtype", "float32")
+    try:
+        tm = tablet_mesh(num_tablet_shards=8)
+        bounds = np.linspace(0, N, 9).astype(int)
+        shards = []
+        for i in range(8):
+            sl = slice(bounds[i], bounds[i + 1])
+            shards.append([_block({k: v[sl] for k, v in data.items()})])
+        sbatch = build_sharded_batch(tm, shards, [QTY, PRICE, FLAG])
+        outs, counts = distributed_scan_aggregate(
+            sbatch, None, AGGS, GroupSpec(cols=((FLAG, 3, 0),)))
+    finally:
+        flags.set_flag("device_float_dtype", "auto")
+    for g in range(3):
+        m = data["flag"] == g
+        assert float(outs[0][g]) == data["qty"][m].sum()
+        want = data["price"][m].sum()
+        assert abs(float(outs[1][g]) - want) <= 1e-5 * want
+        assert int(counts[g]) == int(m.sum())
+
+
+def test_degenerate_magnitudes_fall_back_to_float(data):
+    """|v| past the quantizable range and Inf/NaN inputs use the plain
+    float fallback lane instead of returning a garbage finite value."""
+    from yugabyte_db_tpu.ops.scan import GroupSpec as GS
+    d = {
+        "qty": np.array([1e60, 2.5, 1.0, 3.0]),
+        "price": np.array([1.0, 2.0, np.inf, 4.0]),
+        "flag": np.array([0, 0, 1, 1], np.int32),
+    }
+    batch = build_batch([_block(d)], [QTY, PRICE, FLAG])
+    outs, cnt, _ = ScanKernel().run(batch, None, AGGS, None)
+    # 1e60 stays on the (widened) f64 quantized path: error bounded by
+    # per-row quantization <= n_padded/2^63 ~ 4.4e-16 relative — NOT the
+    # garbage finite value the clipped scale used to produce
+    assert abs(float(outs[0]) - 1e60) <= 1e-12 * 1e60
+    assert np.isinf(float(outs[1]))                    # Inf propagates
+    assert int(cnt) == 4
+    outs, counts, _ = ScanKernel().run(
+        batch, None, AGGS, GS(cols=((FLAG, 2, 0),)))
+    assert abs(float(outs[0][0]) - 1e60) <= 1e-12 * 1e60
+    assert float(outs[0][1]) == 4.0
+    assert np.isinf(float(outs[1][1]))
+    assert float(outs[1][0]) == 3.0

@@ -1044,6 +1044,21 @@ class DocReadOperation:
                 out.append(c)
         return tuple(out)
 
+    def _cached_batch(self, blocks, needed):
+        """Build (or fetch from the device cache) the columnar batch for
+        `needed` columns. Every flag that affects batch formation must
+        key the cache: device_float_dtype is runtime-settable and baked
+        into the batch dtype at build time."""
+        if self.device_cache is None:
+            return build_batch(blocks, sorted(needed))
+        from ..utils import flags as _flags
+        key = (id(self.store), tuple(sorted(needed)),
+               tuple(r.path for r in self.store.ssts),
+               self.store.write_generation(),
+               _flags.get("device_float_dtype"))
+        return self.device_cache.get_or_build(
+            key, lambda: build_batch(blocks, sorted(needed)))
+
     def _execute_tpu_aggregate(self, req: ReadRequest) -> Optional[ReadResponse]:
         blocks = self._collect_blocks()
         if not blocks:
@@ -1060,14 +1075,7 @@ class DocReadOperation:
         elif req.group_by is not None:
             needed.update(cid for cid, _, _ in req.group_by.cols)
         try:
-            if self.device_cache is not None:
-                key = (id(self.store), tuple(sorted(needed)),
-                       tuple(r.path for r in self.store.ssts),
-                       self.store.write_generation())
-                batch = self.device_cache.get_or_build(
-                    key, lambda: build_batch(blocks, sorted(needed)))
-            else:
-                batch = build_batch(blocks, sorted(needed))
+            batch = self._cached_batch(blocks, needed)
         except KeyError:
             return None   # some column lacks columnar form → CPU path
         read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
@@ -1155,14 +1163,7 @@ class DocReadOperation:
         try:
             # same device cache as the aggregate path: repeated string-
             # predicate scans must not rebuild dictionaries per query
-            if self.device_cache is not None:
-                key = (id(self.store), tuple(sorted(needed)),
-                       tuple(r.path for r in self.store.ssts),
-                       self.store.write_generation())
-                batch = self.device_cache.get_or_build(
-                    key, lambda: build_batch(blocks, sorted(needed)))
-            else:
-                batch = build_batch(blocks, sorted(needed))
+            batch = self._cached_batch(blocks, needed)
         except KeyError:
             return None
         read_ht = req.read_ht if req.read_ht is not None else _MAX_HT

@@ -63,20 +63,63 @@ class DeviceBatch:
         return int(self.valid.shape[0])
 
 
-_F64_BLOCKLIST = ()
+def _float64_device_dtype() -> np.dtype:
+    """Device dtype for genuinely fractional f64 columns. CPU backends
+    keep f64 (full double-precision per-row eval). TPU ships f32 —
+    the MXU/VPU dtype — and relies on the scan kernel's exact int64
+    fixed-point accumulation (ops/scan.py) so SUMs don't drift; the
+    residual is the per-row f32 representation (<= 2^-24 relative).
+    The `device_float_dtype` flag (auto|float32|float64) overrides, so
+    tests can exercise the TPU-representative f32 path on CPU."""
+    from ..utils import flags
+    mode = flags.get("device_float_dtype")
+    if mode == "float64":
+        return np.dtype(np.float64)
+    if mode == "float32":
+        return np.dtype(np.float32)
+    if mode != "auto":
+        raise ValueError(
+            f"device_float_dtype must be auto|float32|float64, got "
+            f"{mode!r}")
+    import jax
+    return np.dtype(np.float64 if jax.default_backend() == "cpu"
+                    else np.float32)
+
+
+def _integral_int32(arr: np.ndarray) -> bool:
+    """True when every value is an exact integer within int32 range —
+    such f64 columns (counts, quantities, dict-coded values) ship as
+    int32 and aggregate exactly end-to-end. A cheap prefix sample
+    rejects typical fractional columns without a full pass."""
+    if arr.size == 0:
+        return True
+    head = arr[:1024]
+    if not (np.all(np.isfinite(head)) and np.all(head == np.rint(head))):
+        return False
+    if not (np.all(np.isfinite(arr)) and np.all(arr == np.rint(arr))):
+        return False
+    lo, hi = arr.min(), arr.max()
+    return -2**31 <= lo and hi < 2**31
+
+
+def f64_conversion(parts) -> Optional[np.dtype]:
+    """THE conversion policy for f64 columns, shared by the single-device
+    and sharded batch builders so the same table always ships the same
+    dtype: int32 when integer-valued in every given array (exact
+    end-to-end aggregation), else the backend/flag float dtype. Returns
+    the dtype to convert to, or None to keep f64."""
+    if not parts or any(p.dtype != np.float64 for p in parts):
+        return None
+    if all(_integral_int32(p) for p in parts):
+        return np.dtype(np.int32)
+    dd = _float64_device_dtype()
+    return None if dd == np.float64 else dd
 
 
 def _to_device_dtype(arr: np.ndarray) -> np.ndarray:
-    # Scans compute in f32/bf16 on TPU (MXU-friendly); f64 columns are
-    # converted at batch-formation time. Aggregation error is controlled by
-    # pairwise/psum trees and (for SUM) a compensated two-pass option in
-    # the kernel, not by keeping f64 on device.
     if arr.dtype == np.float64:
-        return arr.astype(np.float32)
-    if arr.dtype == np.int64:
-        # int64 is supported but slow on TPU; keep when values may exceed
-        # int32 (we can't know → keep int32 only when safe)
-        return arr
+        conv = f64_conversion([arr])
+        return arr if conv is None else arr.astype(conv)
     return arr
 
 

@@ -20,6 +20,27 @@ kernels:
 
 Aggregate partials come back in combinable form (sum/count/min/max) so
 the parallel layer can `lax.psum` them across a tablet mesh axis.
+
+Accumulation contract (SQL SUM must not drift with the device it runs
+on — reference semantics: exact PG numerics in EvalAggregate,
+src/yb/docdb/pgsql_operation.cc:3153):
+- SUM/COUNT accumulate EXACTLY in int64. Integer (and integer-valued)
+  columns sum exactly end-to-end. Float values are deterministically
+  quantized per batch to int64 fixed point — scale s = 2^k chosen so
+  n_rows * max|v| * s <= 2^62 cannot overflow — then summed exactly and
+  rescaled on the host in f64. The only error is per-row: the f32
+  device representation of the value itself (<= 2^-24 relative; f64 on
+  CPU backends) plus quantization <= n*max|v|/2^63. For a FIXED device
+  dtype and quantization scale the result is order-independent —
+  accumulation order (MXU vs VPU vs psum tree) can never change it;
+  error bounds do not grow with row count. Results may still differ at
+  the per-row-representation level between backends with different
+  device dtypes (f64 CPU vs f32 TPU) or between partitionings that
+  derive different scales (the scale depends on batch max|v| and the
+  padded row count).
+- MIN/MAX carry the value dtype (no accumulation error by nature).
+- The distributed kernel pmax-combines the quantization scale across
+  shards before quantizing, so int64 partials psum exactly over ICI.
 """
 from __future__ import annotations
 
@@ -97,10 +118,88 @@ def _mvcc_visible_latest(key_hash, ht, write_id, tombstone, valid, read_ht):
     return out
 
 
+# sums over <= this many groups unroll into per-group masked tree
+# reductions (pure VPU code); larger group counts use segment_sum
+_UNROLL_G = 16
+
+# scale sentinel meaning "integer-exact result, do not rescale"
+_NOSCALE = jnp.float32(0.0)
+
+
+def _sum_prep(v, m, n_total: int, axis_names: Tuple[str, ...] = ()):
+    """Per-row SUM input -> (q int64 [0 outside mask], scale, fsum).
+
+    Integer/bool values pass through exactly (scale sentinel 0.0,
+    fsum unused). Float values quantize to int64 fixed point with a
+    per-batch dynamic scale s = 2^k, k = floor(62 - log2(n_total) -
+    log2(max|v|)), which makes every downstream int64 accumulation
+    exact and overflow-free (sum <= n_total * max|v| * s <= 2^62). In
+    the distributed kernel `axis_names` pmax-combines max|v| so all
+    shards agree on s and the int64 partials can psum.
+
+    Degenerate inputs — non-finite values, or magnitudes where the
+    exponent would leave the dtype's exp2 range (possible for f64
+    columns past ~1e51 and for sub-1e-30 maxima) — can't quantize:
+    there the returned scale is NaN, q is zeroed, and the THIRD return
+    (the masked per-row values) lets the caller produce a plain float
+    fallback sum with the same grouping, which propagates Inf/NaN the
+    way PG's float8 SUM does (accumulation drift only in this
+    degenerate regime)."""
+    if jnp.issubdtype(v.dtype, jnp.integer) or v.dtype == jnp.bool_:
+        return jnp.where(m, v.astype(jnp.int64), 0), _NOSCALE, None
+    vm = jnp.where(m, v, 0)
+    vmax = jnp.max(jnp.abs(vm))
+    for ax in axis_names:
+        vmax = jax.lax.pmax(vmax, ax)
+    safe = jnp.maximum(vmax, jnp.asarray(1e-30, vm.dtype))
+    k = jnp.floor(62.0 - float(np.log2(max(n_total, 1))) - jnp.log2(safe))
+    # clip to the dtype's exp2 range; a BINDING clip (or Inf/NaN input)
+    # means quantization can't represent the data -> fall back to fsum
+    lo, hi = (-120.0, 120.0) if vm.dtype == jnp.float32 \
+        else (-1000.0, 1000.0)
+    kc = jnp.clip(k, lo, hi)
+    ok = jnp.isfinite(vmax) & (k == kc)
+    s = jnp.exp2(kc).astype(vm.dtype)
+    q = jnp.where(ok, jnp.rint(vm * s).astype(jnp.int64), 0)
+    s = jnp.where(ok, s, jnp.asarray(np.nan, s.dtype))
+    return q, s, vm
+
+
+def _grouped_sum(q, gid, G: int):
+    """Per-group sums in q's dtype (exact for the int64 fixed-point
+    lane; also builds the float fallback lane); q must already be 0
+    outside the row mask (so invalid rows are additive no-ops whatever
+    their gid)."""
+    if G <= _UNROLL_G:
+        return jnp.stack([jnp.sum(jnp.where(gid == g, q, 0))
+                          for g in range(G)])
+    return jax.ops.segment_sum(q, gid, G)
+
+
+def _grouped_extreme(v, m, gid, G: int, is_min: bool):
+    sentinel = _type_max(v) if is_min else _type_min(v)
+    masked = jnp.where(m, v, sentinel)
+    if G <= _UNROLL_G:
+        red = jnp.min if is_min else jnp.max
+        return jnp.stack([red(jnp.where(gid == g, masked, sentinel))
+                          for g in range(G)])
+    seg = jax.ops.segment_min if is_min else jax.ops.segment_max
+    return seg(masked, gid, G)
+
+
 def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
-                  group: Optional[GroupSpec], mvcc_mode: str):
+                  group: Optional[GroupSpec], mvcc_mode: str,
+                  axis_names: Tuple[str, ...] = (),
+                  row_multiplier: int = 1):
     """mvcc_mode: 'none' (valid only), 'visible' (ht filter, unique keys),
-    'dedup' (full newest-visible-version selection)."""
+    'dedup' (full newest-visible-version selection).
+
+    Returns a traceable fn whose result is
+      (agg_outs, agg_scales, counts, mask[, gvals, n_groups])
+    where each float SUM out is an exact int64 accumulation to be divided
+    by its scale host-side (scale 0.0 = integer-exact, keep as int64).
+    `axis_names`/`row_multiplier` let the distributed kernel agree on
+    quantization scales across `row_multiplier` mesh shards."""
     where_fn = compile_expr(where_node) if where_node is not None else None
     agg_fns = [(a.op, compile_expr(a.expr) if a.expr is not None else None)
                for a in agg_specs]
@@ -143,11 +242,13 @@ def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
                 [jnp.array([True]), changed])
             n_groups = jnp.sum(first, dtype=jnp.int32)
             seg = jnp.clip(jnp.cumsum(first) - 1, 0, G - 1)
-            out = []
+            n_total = n * row_multiplier
+            out, scales = [], []
             for op, f in agg_fns:
                 if f is None:
                     out.append(jax.ops.segment_sum(
                         valid_s.astype(jnp.int64), seg, G))
+                    scales.append(_NOSCALE)
                     continue
                 v, vn = f(cols, nulls, consts)
                 v_s = v[perm]
@@ -156,15 +257,21 @@ def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
                 if op == "count":
                     out.append(jax.ops.segment_sum(
                         m.astype(jnp.int64), seg, G))
+                    scales.append(_NOSCALE)
                 elif op == "sum":
-                    out.append(jax.ops.segment_sum(
-                        jnp.where(m, v_s, 0), seg, G))
+                    q, s, vm = _sum_prep(v_s, m, n_total, axis_names)
+                    out.append(jax.ops.segment_sum(q, seg, G))
+                    scales.append(
+                        s if vm is None
+                        else (s, jax.ops.segment_sum(vm, seg, G)))
                 elif op == "min":
                     out.append(jax.ops.segment_min(
                         jnp.where(m, v_s, _type_max(v)), seg, G))
+                    scales.append(_NOSCALE)
                 elif op == "max":
                     out.append(jax.ops.segment_max(
                         jnp.where(m, v_s, _type_min(v)), seg, G))
+                    scales.append(_NOSCALE)
                 else:
                     raise ValueError(op)
             counts = jax.ops.segment_sum(valid_s.astype(jnp.int64),
@@ -176,29 +283,40 @@ def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
                 jax.ops.segment_min(
                     jnp.where(valid_s, g, _type_max(g)), seg, G)
                 for g in g_s)
-            return tuple(out), counts, mask, gvals, n_groups
+            return (tuple(out), tuple(scales), counts, mask, gvals,
+                    n_groups)
 
+        n_total = mask.shape[0] * row_multiplier
         if group is None:
-            out = []
+            out, scales = [], []
             for op, f in agg_fns:
                 if f is None:
                     out.append(jnp.sum(mask, dtype=jnp.int64))
+                    scales.append(_NOSCALE)
                     continue
                 v, vn = f(cols, nulls, consts)
                 m = mask if vn is None else mask & jnp.logical_not(vn)
                 if op == "count":
                     out.append(jnp.sum(m, dtype=jnp.int64))
+                    scales.append(_NOSCALE)
                 elif op == "sum":
-                    out.append(jnp.sum(jnp.where(m, v, 0)))
+                    q, s, vm = _sum_prep(v, m, n_total, axis_names)
+                    out.append(jnp.sum(q))
+                    scales.append(s if vm is None else (s, jnp.sum(vm)))
                 elif op == "min":
                     out.append(jnp.min(jnp.where(m, v, _type_max(v))))
+                    scales.append(_NOSCALE)
                 elif op == "max":
                     out.append(jnp.max(jnp.where(m, v, _type_min(v))))
+                    scales.append(_NOSCALE)
                 else:
                     raise ValueError(op)
-            return tuple(out), jnp.sum(mask, dtype=jnp.int64), mask
+            return (tuple(out), tuple(scales),
+                    jnp.sum(mask, dtype=jnp.int64), mask)
 
-        # grouped: one-hot [N, G] matmul — rides the MXU.
+        # grouped over declared domains: dense group id + exact int64
+        # per-group reductions (small G unrolls into VPU tree sums;
+        # larger G uses segment_sum — still exact int64).
         # Rows with NULL in any group column are excluded (the device
         # group-id encoding has no NULL slot; PG's NULL group stays on
         # the CPU fallback path).
@@ -213,37 +331,68 @@ def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
             gid = c * stride if gid is None else gid + c * stride
             stride *= domain
         G = group.num_groups
-        onehot = jax.nn.one_hot(gid, G, dtype=jnp.float32)
-        onehot = onehot * mask.astype(jnp.float32)[:, None]
-        out = []
+        out, scales = [], []
         for op, f in agg_fns:
             if f is None:
-                out.append(jnp.sum(onehot, axis=0).astype(jnp.int64))
+                out.append(_grouped_sum(mask.astype(jnp.int64), gid, G))
+                scales.append(_NOSCALE)
                 continue
             v, vn = f(cols, nulls, consts)
             m = mask if vn is None else mask & jnp.logical_not(vn)
-            oh = (onehot if vn is None
-                  else onehot * jnp.logical_not(vn).astype(jnp.float32)[:, None])
             if op == "count":
-                out.append(jnp.sum(oh, axis=0).astype(jnp.int64))
+                out.append(_grouped_sum(m.astype(jnp.int64), gid, G))
+                scales.append(_NOSCALE)
             elif op == "sum":
-                out.append(v.astype(jnp.float32) @ oh)
+                q, s, vm = _sum_prep(v, m, n_total, axis_names)
+                out.append(_grouped_sum(q, gid, G))
+                scales.append(
+                    s if vm is None else (s, _grouped_sum(vm, gid, G)))
             elif op == "min":
-                gmask = (oh > 0)
-                big = _type_max(v)
-                out.append(jnp.min(
-                    jnp.where(gmask, v[:, None], big), axis=0))
+                out.append(_grouped_extreme(v, m, gid, G, True))
+                scales.append(_NOSCALE)
             elif op == "max":
-                small = _type_min(v)
-                gmask = (oh > 0)
-                out.append(jnp.max(
-                    jnp.where(gmask, v[:, None], small), axis=0))
+                out.append(_grouped_extreme(v, m, gid, G, False))
+                scales.append(_NOSCALE)
             else:
                 raise ValueError(op)
-        group_counts = jnp.sum(onehot, axis=0).astype(jnp.int64)
-        return tuple(out), group_counts, mask
+        group_counts = _grouped_sum(mask.astype(jnp.int64), gid, G)
+        return tuple(out), tuple(scales), group_counts, mask
 
     return fn
+
+
+def _rescale_outs(raw_outs, raw_scales):
+    """Host-side: divide int64 fixed-point sums by their scale (f64).
+    Scale entries are either the 0.0 sentinel (integer-exact result,
+    stays int64) or a (scale, float_fallback) pair — NaN scale means
+    quantization was impossible (Inf/NaN or out-of-range magnitudes)
+    and the plain float sum is the answer."""
+    final = []
+    for q, s in zip(raw_outs, raw_scales):
+        if isinstance(s, tuple):
+            sv = float(s[0])
+            fb = np.asarray(s[1], np.float64)
+            if np.isnan(sv):
+                final.append(fb)
+                continue
+            qv = np.asarray(q)
+            r = qv.astype(np.float64) / sv
+            # Per-(group) lane choice by worst-case error bound: the
+            # quantized lane's absolute error is <= 0.5*n_g granules,
+            # the float lane's is <= n_g*eps*sum|v|. For |q| granules
+            # of signal the quantized bound wins iff |q| >= 0.5/eps.
+            # Below that — e.g. a small-magnitude group under a scale
+            # set by a 15-decades-larger group elsewhere in the batch —
+            # the independently-summed float lane is more accurate
+            # (PG parity: each group's sum reflects its own values).
+            eps = 2.0 ** -24 if np.asarray(s[1]).dtype == np.float32 \
+                else 2.0 ** -53
+            use_q = np.abs(qv) >= 0.5 / eps
+            final.append(np.where(use_q, r, fb) if r.ndim
+                         else (r if use_q else fb))
+        else:
+            final.append(np.asarray(q))
+    return tuple(final)
 
 
 def _type_max(v):
@@ -364,7 +513,11 @@ class ScanKernel:
                 # stay exact, unlike an f32 device accumulation
                 r = np.asarray(p, np.float64).sum(axis=0).astype(np.int64)
             elif a.op == "sum":
-                r = jnp.sum(p, axis=0)
+                # combine per-block f32 partials in f64 on the host —
+                # residual error is the block-local (<=4096-row) f32
+                # accumulation, the documented contract of this opt-in
+                # flag; the default XLA path is exact (int64 fixed point)
+                r = np.asarray(p, np.float64).sum(axis=0)
             elif a.op == "min":
                 r = jnp.min(p, axis=0)
             else:
@@ -412,7 +565,7 @@ class ScanKernel:
         zeros_u64 = jnp.zeros(batch.padded_rows, jnp.uint64)
         zeros_u32 = jnp.zeros(batch.padded_rows, jnp.uint32)
         zeros_b = jnp.zeros(batch.padded_rows, bool)
-        return fn(
+        raw = fn(
             batch.cols, batch.nulls,
             [jnp.asarray(c) for c in consts], batch.valid,
             batch.key_hash if batch.key_hash is not None else zeros_u64,
@@ -421,6 +574,10 @@ class ScanKernel:
             batch.tombstone if batch.tombstone is not None else zeros_b,
             jnp.uint64(read_ht if read_ht is not None else 0xFFFFFFFFFFFFFFFF),
         )
+        # (outs, scales, counts, mask[, gvals, n_groups]) -> rescale the
+        # fixed-point sums host-side; callers keep the historical shape
+        # (outs, counts, mask[, gvals, n_groups])
+        return (_rescale_outs(raw[0], raw[1]),) + tuple(raw[2:])
 
 
 def _expand_avg(aggs: Sequence[AggSpec]) -> List[AggSpec]:

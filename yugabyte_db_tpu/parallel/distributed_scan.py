@@ -17,9 +17,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from ..ops.device_batch import DeviceBatch, bucket_rows, _pad
+from ..ops.device_batch import DeviceBatch, bucket_rows, _pad, f64_conversion
 from ..ops.expr import collect_constants, expr_signature
-from ..ops.scan import AggSpec, GroupSpec, _build_kernel, _expand_avg
+from ..ops.scan import (
+    AggSpec, GroupSpec, _build_kernel, _expand_avg, _rescale_outs,
+)
 from ..storage.columnar import ColumnarBlock
 from .mesh import BLOCKS_AXIS, TABLETS_AXIS, TabletMesh
 
@@ -88,11 +90,18 @@ def build_sharded_batch(tm: TabletMesh,
             extra_dims=arr.ndim - 2))
 
     for cid in columns:
-        def getv(b, cid=cid):
-            if cid in b.fixed:
-                v = b.fixed[cid][0]
-                return v.astype(np.float32) if v.dtype == np.float64 else v
-            return b.pk[cid]
+        # decide the device dtype GLOBALLY (all shards must agree) with
+        # the same policy as the single-device builder: integer-valued
+        # f64 columns ship as exact int32; fractional f64 follows the
+        # backend policy (f64 on CPU, f32 on TPU — sums stay exact via
+        # the kernel's int64 fixed-point accumulation)
+        conv = f64_conversion(
+            [b.fixed[cid][0] if cid in b.fixed else b.pk[cid]
+             for blocks in per_shard_blocks for b in blocks])
+
+        def getv(b, cid=cid, conv=conv):
+            v = b.fixed[cid][0] if cid in b.fixed else b.pk[cid]
+            return v.astype(conv) if conv is not None else v
 
         def getn(b, cid=cid):
             if cid in b.fixed:
@@ -129,8 +138,13 @@ class DistributedScanKernel:
         fn = self._cache.get(sig)
         if fn is not None:
             return fn
-        local = _build_kernel(where, aggs, group, mvcc_mode)
         axes = (TABLETS_AXIS, BLOCKS_AXIS)
+        S = tm.num_tablet_shards * tm.num_block_shards
+        # axis_names/row_multiplier: float SUMs pmax-combine max|v| across
+        # shards so every shard quantizes with the SAME int64 fixed-point
+        # scale — the int64 partials then psum EXACTLY over ICI
+        local = _build_kernel(where, aggs, group, mvcc_mode,
+                              axis_names=axes, row_multiplier=S)
 
         def shard_fn(cols, nulls, consts, valid, key_hash, ht, wid, tomb,
                      read_ht):
@@ -138,7 +152,7 @@ class DistributedScanKernel:
             sq = lambda a: a.reshape(a.shape[-1])
             lcols = {k: sq(v) for k, v in cols.items()}
             lnulls = {k: sq(v) for k, v in nulls.items()}
-            outs, counts, _ = local(
+            outs, scales, counts, _ = local(
                 lcols, lnulls, consts, sq(valid), sq(key_hash), sq(ht),
                 sq(wid), sq(tomb), read_ht)
             combined = []
@@ -154,7 +168,19 @@ class DistributedScanKernel:
                 combined.append(o)
             for ax in axes:
                 counts = jax.lax.psum(counts, ax)
-            return tuple(combined), counts
+            # scales are identical on every shard (pmax'd vmax) and pass
+            # through replicated; each float-sum fallback lane is a
+            # per-shard partial that psums like the int64 lane
+            cscales = []
+            for s in scales:
+                if isinstance(s, tuple):
+                    fb = s[1]
+                    for ax in axes:
+                        fb = jax.lax.psum(fb, ax)
+                    cscales.append((s[0], fb))
+                else:
+                    cscales.append(s)
+            return tuple(combined), tuple(cscales), counts
 
         spec3 = P(TABLETS_AXIS, BLOCKS_AXIS, None)
         in_specs = (
@@ -162,7 +188,8 @@ class DistributedScanKernel:
             P(), spec3, spec3, spec3, spec3, spec3, P())
         smapped = jax.shard_map(
             shard_fn, mesh=tm.mesh, in_specs=in_specs,
-            out_specs=(tuple(P() for _ in aggs), P()),
+            out_specs=(tuple(P() for _ in aggs), tuple(P() for _ in aggs),
+                       P()),
             check_vma=False)
         fn = jax.jit(smapped)
         self._cache[sig] = fn
@@ -198,11 +225,13 @@ class DistributedScanKernel:
             batch.padded_rows, col_sig,
         )
         fn = self._get(sig, tm, where, aggs, group, mvcc_mode)
-        return fn(batch.cols, batch.nulls,
-                  [jnp.asarray(c) for c in consts], batch.valid,
-                  batch.key_hash, batch.ht, batch.write_id, batch.tombstone,
-                  jnp.uint64(read_ht if read_ht is not None
-                             else 0xFFFFFFFFFFFFFFFF))
+        outs, scales, counts = fn(
+            batch.cols, batch.nulls,
+            [jnp.asarray(c) for c in consts], batch.valid,
+            batch.key_hash, batch.ht, batch.write_id, batch.tombstone,
+            jnp.uint64(read_ht if read_ht is not None
+                       else 0xFFFFFFFFFFFFFFFF))
+        return _rescale_outs(outs, scales), counts
 
 
 def sig_cols(sig) -> Tuple[int, ...]:

@@ -135,6 +135,12 @@ DEFINE_RUNTIME("tpu_pallas_scan", False,
                "Route eligible aggregate scans through the hand-fused "
                "pallas kernel (ops/pallas_scan.py) instead of the XLA "
                "scan; f32 compute, so int64 columns stay on XLA.")
+DEFINE_RUNTIME("device_float_dtype", "auto",
+               "Device representation of fractional f64 columns: 'auto' "
+               "keeps f64 on CPU backends and ships f32 on TPU (SUMs stay "
+               "exact via the scan kernel's int64 fixed-point "
+               "accumulation); 'float32'/'float64' force one (tests use "
+               "float32 to exercise the TPU-representative path on CPU).")
 DEFINE_RUNTIME("tpu_min_rows_for_pushdown", 4096,
                "Scans smaller than this stay on the CPU path: point reads "
                "must never pay a device round-trip.")
