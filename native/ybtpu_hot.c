@@ -381,9 +381,194 @@ done:
     return result;
 }
 
+/* ---------------------------------------------------------------------
+ * fnv64(bytes) -> int — FNV-1a 64-bit, byte-exact with
+ * storage/columnar.fnv64_bytes (the doc-key hash for blooms/dedup).
+ */
+static PyObject *
+py_fnv64(PyObject *mod, PyObject *arg)
+{
+    Py_buffer b;
+    if (PyObject_GetBuffer(arg, &b, PyBUF_SIMPLE) < 0)
+        return NULL;
+    uint64_t h = 0xCBF29CE484222325ULL;
+    const uint8_t *p = (const uint8_t *)b.buf;
+    for (Py_ssize_t i = 0; i < b.len; i++)
+        h = (h ^ p[i]) * 0x100000001B3ULL;
+    PyBuffer_Release(&b);
+    return PyLong_FromUnsignedLongLong(h);
+}
+
+/* ---------------------------------------------------------------------
+ * bloom_may_contain(bits, k, hash) -> bool — double-hash probe scheme,
+ * bit-exact with storage/sst.BloomFilter.may_contain.
+ */
+static PyObject *
+py_bloom_may_contain(PyObject *mod, PyObject *args)
+{
+    Py_buffer bits;
+    int k;
+    unsigned long long hash;
+    if (!PyArg_ParseTuple(args, "y*iK", &bits, &k, &hash))
+        return NULL;
+    uint64_t m = (uint64_t)bits.len * 8;
+    const uint8_t *bb = (const uint8_t *)bits.buf;
+    uint64_t h1 = hash, h2 = (h1 >> 33) | 1;
+    int hit = 1;
+    for (int i = 0; i < k; i++) {
+        uint64_t idx = (h1 + (uint64_t)i * h2) % m;
+        if (!((bb[idx >> 3] >> (idx & 7)) & 1)) { hit = 0; break; }
+    }
+    PyBuffer_Release(&bits);
+    return PyBool_FromLong(hit);
+}
+
+/* ---------------------------------------------------------------------
+ * BlockFinder — fused point-lookup over one columnar block: binary
+ * search of the fixed-width key matrix + the MVCC newest-visible walk
+ * that sst.point_find did row-at-a-time in Python (reference analog:
+ * BlockBasedTable::Get + DocDB visibility seek,
+ * src/yb/docdb/doc_rowwise_iterator.cc).
+ *
+ * find(prefix, read_ht, restart_hi) returns:
+ *   (pos, ht, write_id, tomb) — newest visible version row
+ *   ht_int                    — restart: version in (read_ht, restart_hi]
+ *   None                      — no visible version in this block
+ * restart_hi < 0 disables restart detection.
+ */
+typedef struct {
+    PyObject_HEAD
+    Py_buffer keys;      /* [n, width] uint8 rows, lexicographically sorted */
+    Py_buffer ht;        /* [n] uint64 */
+    Py_buffer wid;       /* [n] uint32 */
+    Py_buffer tomb;      /* [n] uint8/bool */
+    Py_ssize_t n, width;
+    int has_bufs;
+} BlockFinder;
+
+static void
+BlockFinder_dealloc(BlockFinder *self)
+{
+    if (self->has_bufs) {
+        PyBuffer_Release(&self->keys);
+        PyBuffer_Release(&self->ht);
+        PyBuffer_Release(&self->wid);
+        PyBuffer_Release(&self->tomb);
+    }
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static PyObject *
+BlockFinder_new(PyTypeObject *type, PyObject *args, PyObject *kwds)
+{
+    PyObject *keys, *ht, *wid, *tomb;
+    Py_ssize_t n, width;
+    if (!PyArg_ParseTuple(args, "OOOOnn", &keys, &ht, &wid, &tomb,
+                          &n, &width))
+        return NULL;
+    BlockFinder *self = (BlockFinder *)type->tp_alloc(type, 0);
+    if (!self) return NULL;
+    if (PyObject_GetBuffer(keys, &self->keys, PyBUF_SIMPLE) < 0 ||
+        PyObject_GetBuffer(ht, &self->ht, PyBUF_SIMPLE) < 0 ||
+        PyObject_GetBuffer(wid, &self->wid, PyBUF_SIMPLE) < 0 ||
+        PyObject_GetBuffer(tomb, &self->tomb, PyBUF_SIMPLE) < 0) {
+        /* release whichever succeeded */
+        if (self->keys.obj) PyBuffer_Release(&self->keys);
+        if (self->ht.obj) PyBuffer_Release(&self->ht);
+        if (self->wid.obj) PyBuffer_Release(&self->wid);
+        if (self->tomb.obj) PyBuffer_Release(&self->tomb);
+        Py_TYPE(self)->tp_free((PyObject *)self);
+        return NULL;
+    }
+    self->has_bufs = 1;
+    self->n = n;
+    self->width = width;
+    if (self->keys.len < n * width || self->ht.len < n * 8 ||
+        self->wid.len < n * 4 || self->tomb.len < n) {
+        PyErr_SetString(PyExc_ValueError, "BlockFinder buffer too short");
+        Py_DECREF(self);
+        return NULL;
+    }
+    return (PyObject *)self;
+}
+
+static PyObject *
+BlockFinder_find(BlockFinder *self, PyObject *args)
+{
+    Py_buffer prefix;
+    unsigned long long read_ht;
+    long long restart_hi;
+    if (!PyArg_ParseTuple(args, "y*KL", &prefix, &read_ht, &restart_hi))
+        return NULL;
+    const uint8_t *keys = (const uint8_t *)self->keys.buf;
+    const uint64_t *hts = (const uint64_t *)self->ht.buf;
+    const uint32_t *wids = (const uint32_t *)self->wid.buf;
+    const uint8_t *tombs = (const uint8_t *)self->tomb.buf;
+    Py_ssize_t W = self->width, n = self->n;
+    Py_ssize_t plen = prefix.len < W ? prefix.len : W;
+    const uint8_t *pp = (const uint8_t *)prefix.buf;
+
+    /* lower_bound over W-wide rows for the zero-padded probe: compare
+     * the first plen bytes, then the probe's zero padding is <= any
+     * remaining row byte, so rows equal on plen bytes are >= probe */
+    Py_ssize_t lo = 0, hi = n;
+    while (lo < hi) {
+        Py_ssize_t mid = (lo + hi) >> 1;
+        int c = memcmp(keys + mid * W, pp, plen);
+        if (c < 0) lo = mid + 1;
+        else hi = mid;
+    }
+    Py_ssize_t real_plen = prefix.len;
+    for (Py_ssize_t pos = lo; pos < n; pos++) {
+        const uint8_t *row = keys + pos * W;
+        /* rows are full keys (doc key + HT suffix), width >= prefix
+         * when the block holds this doc key; a shorter matrix cannot
+         * contain it */
+        if (real_plen > W || memcmp(row, pp, real_plen) != 0)
+            break;
+        uint64_t ht = hts[pos];
+        if (ht > read_ht) {
+            if (restart_hi >= 0 && ht <= (uint64_t)restart_hi) {
+                PyBuffer_Release(&prefix);
+                return PyLong_FromUnsignedLongLong(ht);
+            }
+            continue;
+        }
+        PyObject *r = Py_BuildValue(
+            "nKIi", pos, ht, (unsigned int)wids[pos],
+            (int)(tombs[pos] != 0));
+        PyBuffer_Release(&prefix);
+        return r;
+    }
+    PyBuffer_Release(&prefix);
+    Py_RETURN_NONE;
+}
+
+static PyMethodDef BlockFinder_methods[] = {
+    {"find", (PyCFunction)BlockFinder_find, METH_VARARGS,
+     "find(prefix, read_ht, restart_hi) -> (pos, ht, wid, tomb) | "
+     "restart_ht | None"},
+    {NULL}
+};
+
+static PyTypeObject BlockFinderType = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "ybtpu_hot.BlockFinder",
+    .tp_basicsize = sizeof(BlockFinder),
+    .tp_dealloc = (destructor)BlockFinder_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_doc = "fused columnar-block point lookup (search + MVCC walk)",
+    .tp_methods = BlockFinder_methods,
+    .tp_new = BlockFinder_new,
+};
+
 static PyMethodDef hot_methods[] = {
     {"encode_doc_key", py_encode_doc_key, METH_VARARGS,
      "encode_doc_key(spec, values) -> encoded DocKey bytes"},
+    {"fnv64", py_fnv64, METH_O,
+     "fnv64(bytes) -> FNV-1a 64-bit hash"},
+    {"bloom_may_contain", py_bloom_may_contain, METH_VARARGS,
+     "bloom_may_contain(bits, k, hash) -> bool"},
     {NULL}
 };
 
@@ -397,9 +582,13 @@ PyInit_ybtpu_hot(void)
 {
     if (PyType_Ready(&ExtractorType) < 0)
         return NULL;
+    if (PyType_Ready(&BlockFinderType) < 0)
+        return NULL;
     PyObject *m = PyModule_Create(&hotmodule);
     if (!m) return NULL;
     Py_INCREF(&ExtractorType);
     PyModule_AddObject(m, "Extractor", (PyObject *)&ExtractorType);
+    Py_INCREF(&BlockFinderType);
+    PyModule_AddObject(m, "BlockFinder", (PyObject *)&BlockFinderType);
     return m;
 }

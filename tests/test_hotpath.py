@@ -103,3 +103,56 @@ class TestExtractorEquivalence:
         for r in rows[::17]:
             got = t.read(ReadRequest("mix", pk_eq={"k": r["k"]})).rows[0]
             assert got == r, (got, r)
+
+
+class TestNativeBlockFinder:
+    """The fused native point lookup (BlockFinder) must agree with the
+    Python MVCC walk across versions, deletes, flush boundaries and
+    batched reads."""
+
+    def _tablet(self, tmp_path):
+        from yugabyte_db_tpu.docdb.operations import (
+            ReadRequest, RowOp, WriteRequest,
+        )
+        from yugabyte_db_tpu.models.ycsb import usertable_info
+        from yugabyte_db_tpu.tablet import Tablet
+        t = Tablet("ht", usertable_info(), str(tmp_path / "ht"))
+        return t, ReadRequest, RowOp, WriteRequest
+
+    def test_versions_deletes_and_flush(self, tmp_path):
+        t, ReadRequest, RowOp, WriteRequest = self._tablet(tmp_path)
+        row = lambda k, tag: {"ycsb_key": k,
+                              **{f"field{i}": tag for i in range(10)}}
+        for k in range(200):
+            t.apply_write(WriteRequest("usertable",
+                                       [RowOp("upsert", row(k, "v1"))]))
+        t.flush()
+        for k in range(0, 200, 2):           # overwrite evens post-flush
+            t.apply_write(WriteRequest("usertable",
+                                       [RowOp("upsert", row(k, "v2"))]))
+        for k in range(0, 200, 5):           # delete every 5th
+            t.apply_write(WriteRequest(
+                "usertable", [RowOp("delete", {"ycsb_key": k})]))
+        t.flush()
+        for k in (0, 1, 2, 5, 10, 55, 199):
+            got = t.read(ReadRequest("usertable",
+                                     pk_eq={"ycsb_key": k})).rows
+            if k % 5 == 0:
+                assert got == [], k
+            else:
+                want = "v2" if k % 2 == 0 else "v1"
+                assert got[0]["field0"] == want, k
+
+    def test_multi_read_matches_single(self, tmp_path):
+        t, ReadRequest, RowOp, WriteRequest = self._tablet(tmp_path)
+        import numpy as np
+        from yugabyte_db_tpu.models.ycsb import generate_rows
+        t.bulk_load(generate_rows(5000))
+        t.apply_write(WriteRequest("usertable", [RowOp(
+            "delete", {"ycsb_key": 17})]))
+        keys = [17, 3, 4999, 999999, 0]
+        batch = t.multi_read("usertable", [{"ycsb_key": k} for k in keys])
+        for k, b in zip(keys, batch):
+            single = t.read(ReadRequest("usertable",
+                                        pk_eq={"ycsb_key": k})).rows
+            assert (b is None and single == []) or single[0] == b, k

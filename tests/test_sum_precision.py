@@ -164,3 +164,18 @@ def test_degenerate_magnitudes_fall_back_to_float(data):
     assert float(outs[0][1]) == 4.0
     assert np.isinf(float(outs[1][1]))
     assert float(outs[1][0]) == 3.0
+
+
+def test_int_arithmetic_does_not_wrap():
+    """Integer-valued f64 columns ship as int32; products past 2^31
+    must widen to int64 instead of wrapping (expr compiler promotion)."""
+    d = {
+        "qty": np.full(8, 100000.0),       # integral -> int32 on device
+        "price": np.full(8, 100000.0),
+        "flag": np.zeros(8, np.int32),
+    }
+    batch = build_batch([_block(d)], [QTY, PRICE, FLAG])
+    assert batch.cols[QTY].dtype == np.int32
+    aggs = (AggSpec("sum", (C(QTY) * C(QTY)).node),)
+    outs, cnt, _ = ScanKernel().run(batch, None, aggs, None)
+    assert int(outs[0]) == 8 * 100000 * 100000     # 8e10 >> 2^31

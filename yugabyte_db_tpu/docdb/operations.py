@@ -751,8 +751,17 @@ def classify_scan_options(schema, partition_kind: str, where):
             point_lists, interval, residual, total)
 
 
+_SKEW_WINDOW = [None]
+flags.REGISTRY.on_change(
+    "max_clock_skew_ms", lambda v: _SKEW_WINDOW.__setitem__(0, None))
+
+
 def _skew_window_ht() -> int:
-    return flags.get("max_clock_skew_ms") * 1000 << 12
+    # cached: read on every point lookup, flag changes are rare
+    w = _SKEW_WINDOW[0]
+    if w is None:
+        w = _SKEW_WINDOW[0] = flags.get("max_clock_skew_ms") * 1000 << 12
+    return w
 
 
 class ReadRestartError(Exception):
@@ -779,26 +788,14 @@ class DocReadOperation:
         self._allow_restart = False
 
     # ---- point lookup ----------------------------------------------------
-    def get_row(self, pk_row: Dict[str, object], read_ht: int
-                ) -> Optional[Dict[str, object]]:
-        """Newest visible version across memtable + SSTs, using per-SST
-        bloom filters and columnar binary search (reference:
-        DocDBTableReader point-get over BlockBasedTable::Get)."""
-        prefix = self.codec.doc_key_prefix(pk_row)
-        h = fnv64_bytes(prefix)
+    def _find_best(self, prefix: bytes, read_ht: int, restart_hi,
+                   mems, ssts):
+        """Newest visible version tuple (ht, write_id, key, value,
+        block, pos) of one doc key across the snapshot, or None."""
         plen = len(prefix)
         kht = ValueType.kHybridTime
-        restart_hi = (read_ht + _skew_window_ht()
-                      if self._allow_restart else None)
-
-        # best = (ht, write_id, key, value, block, pos)
         best = None
-        with self.store._lock:
-            mems = [self.store._mem] + list(self.store._frozen)
-            ssts = list(self.store._ssts)
         for m in mems:
-            if m.empty():
-                continue
             for k, v in m.seek(prefix):
                 if not k.startswith(prefix) or k[plen] != kht:
                     break
@@ -813,6 +810,7 @@ class DocReadOperation:
                 if best is None or (ht, dht.write_id) > best[:2]:
                     best = (ht, dht.write_id, k, v, None, None)
                 break
+        h = fnv64_bytes(prefix)
         for r in ssts:
             if not r.may_contain_hash(h):
                 continue
@@ -824,8 +822,9 @@ class DocReadOperation:
             c = found[1:]
             if best is None or c[:2] > best[:2]:
                 best = c
-        if best is None:
-            return None
+        return best
+
+    def _decode_best(self, best, read_ht: int):
         _, _, k, v, cb, pos = best
         if cb is not None:
             # columnar winner: direct single-row decode (no TTL wrapper
@@ -835,6 +834,42 @@ class DocReadOperation:
         if expire is not None and expire <= read_ht:
             return None
         return self.codec.decode_row(k, v)
+
+    def get_row(self, pk_row: Dict[str, object], read_ht: int
+                ) -> Optional[Dict[str, object]]:
+        """Newest visible version across memtable + SSTs, using per-SST
+        bloom filters and the native fused block lookup (reference:
+        DocDBTableReader point-get over BlockBasedTable::Get)."""
+        prefix = self.codec.doc_key_prefix(pk_row)
+        restart_hi = (read_ht + _skew_window_ht()
+                      if self._allow_restart else None)
+        mems, ssts = self.store.read_snapshot()
+        best = self._find_best(prefix, read_ht, restart_hi, mems, ssts)
+        if best is None:
+            return None
+        return self._decode_best(best, read_ht)
+
+    def multi_get(self, pk_rows: Sequence[Dict[str, object]],
+                  read_ht: int, allow_restart: bool = False
+                  ) -> List[Optional[Dict[str, object]]]:
+        """Batched point lookups: one snapshot, one restart window, one
+        result list — the server-side batching seam concurrent sessions
+        share (reference analog: operation buffering in pggate,
+        src/yb/yql/pggate/pg_operation_buffer.cc, and doc_op batched
+        reads). Per-op request/clock/metric overhead amortizes across
+        the batch; the per-key work is the native encode+find+extract
+        path."""
+        restart_hi = (read_ht + _skew_window_ht()
+                      if allow_restart else None)
+        mems, ssts = self.store.read_snapshot()
+        prefix_of = self.codec.doc_key_prefix
+        out: List[Optional[Dict[str, object]]] = []
+        for pk_row in pk_rows:
+            best = self._find_best(prefix_of(pk_row), read_ht,
+                                   restart_hi, mems, ssts)
+            out.append(None if best is None
+                       else self._decode_best(best, read_ht))
+        return out
 
     # ---- scans -----------------------------------------------------------
     def execute(self, req: ReadRequest) -> ReadResponse:

@@ -68,9 +68,21 @@ class YcsbTabletWorkload:
         self.tablet.apply_write(WriteRequest(
             "usertable", [RowOp("upsert", row)]))
 
-    def run(self, workload: str, ops: int = 1000) -> WorkloadResult:
+    def run(self, workload: str, ops: int = 1000,
+            clients: int = 1) -> WorkloadResult:
+        """clients > 1 models that many concurrent sessions whose point
+        reads arrive together and batch at the server seam
+        (Tablet.multi_read) — the single-process analog of the
+        reference's multi-threaded YCSB drivers hitting pggate's
+        operation buffering. Only workload C (pure reads) batches."""
         read_frac = {"a": 0.5, "b": 0.95, "c": 1.0, "e": 0.95}[workload]
         keys = self.rng.integers(0, self.n, ops)
+        if workload == "c" and clients > 1:
+            t0 = time.perf_counter()
+            for i in range(0, ops, clients):
+                batch = [{"ycsb_key": int(k)} for k in keys[i:i + clients]]
+                self.tablet.multi_read("usertable", batch)
+            return WorkloadResult(ops, time.perf_counter() - t0)
         coins = self.rng.random(ops)
         t0 = time.perf_counter()
         for k, c in zip(keys, coins):

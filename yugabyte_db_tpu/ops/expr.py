@@ -182,10 +182,20 @@ def compile_expr(node: ExprNode) -> Callable:
             return f
         if kind == "arith":
             op = _ARITH[n[1]]
+            promote = n[1] in ("add", "sub", "mul")
             lf, rf = build(n[2]), build(n[3])
             def f(cols, nulls, consts):
                 lv, ln = lf(cols, nulls, consts)
                 rv, rn = rf(cols, nulls, consts)
+                if promote:
+                    # int-int arithmetic runs in int64: integer-valued
+                    # f64 columns ship as int32 (device_batch), and an
+                    # int32 product/sum past 2^31 would silently wrap
+                    # (PG semantics: int ops widen, numeric is exact)
+                    lv, rv = jnp.asarray(lv), jnp.asarray(rv)
+                    if jnp.issubdtype(lv.dtype, jnp.integer) and \
+                            jnp.issubdtype(rv.dtype, jnp.integer):
+                        lv = lv.astype(jnp.int64)
                 return op(lv, rv), _or_null(ln, rn)
             return f
         if kind == "and":

@@ -38,7 +38,26 @@ def fnv64_rows(mat: np.ndarray) -> np.ndarray:
     return h
 
 
+_HOT = None
+
+
+def native_hot():
+    """Cached accessor for the ybtpu_hot CPython extension (or None).
+    The import must stay call-time lazy — a module-level import of
+    docdb.hotpath from the storage layer would cycle through
+    docdb/__init__. This is the ONE shared memo; other storage modules
+    import it rather than re-rolling the idiom."""
+    global _HOT
+    if _HOT is None:
+        from ..docdb.hotpath import load as _load_hot
+        _HOT = _load_hot() or False
+    return _HOT or None
+
+
 def fnv64_bytes(data: bytes) -> int:
+    hot = native_hot()
+    if hot is not None:
+        return hot.fnv64(data)
     h = 0xCBF29CE484222325
     for b in data:
         h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF

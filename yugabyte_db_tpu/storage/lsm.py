@@ -76,6 +76,8 @@ class LsmStore:
         self._next_file = 0
         self._flushed_frontier: dict = {}
         self._write_gen = 0
+        self._struct_gen = 0           # bumps on flush/compact/replace
+        self._snap = None              # cached (gen-key, (mems, ssts))
         self._mem_frontier: dict = {}
         self._load_manifest()
 
@@ -124,6 +126,24 @@ class LsmStore:
         newer committed write."""
         return self._write_gen
 
+    def read_snapshot(self):
+        """Cached ([non-empty memtables], [ssts]) for the point-read hot
+        path: rebuilding these lists under the lock on every get was
+        measurable at OLTP rates. The key covers both data writes
+        (_write_gen) and structural changes (_struct_gen), so a stale
+        snapshot can never be served after a write, flush or compaction
+        it does not contain."""
+        key = (self._write_gen, self._struct_gen)
+        snap = self._snap
+        if snap is not None and snap[0] == key:
+            return snap[1]
+        with self._lock:
+            mems = [m for m in [self._mem] + list(self._frozen)
+                    if not m.empty()]
+            val = (mems, list(self._ssts))
+            self._snap = ((self._write_gen, self._struct_gen), val)
+        return val
+
     def should_flush(self) -> bool:
         return (self._mem.approximate_bytes()
                 >= flags.get("memstore_flush_threshold_bytes"))
@@ -139,6 +159,7 @@ class LsmStore:
             frontier = dict(self._mem_frontier)
             self._frozen.append(mem)
             self._mem = MemTable()
+            self._struct_gen += 1
             self._mem_frontier = {}
         path = self._new_sst_path()
         w = SstWriter(path, columnar_builder=self.columnar_builder)
@@ -150,6 +171,7 @@ class LsmStore:
         with self._lock:
             self._ssts.insert(0, SstReader(path, row_decoder=self.row_decoder))
             self._frozen.remove(mem)
+            self._struct_gen += 1
             if "op_id" in frontier:
                 self._flushed_frontier["op_id"] = frontier["op_id"]
             self._write_manifest()
@@ -166,6 +188,7 @@ class LsmStore:
         w.finish()
         with self._lock:
             self._ssts.insert(0, SstReader(path, row_decoder=self.row_decoder))
+            self._struct_gen += 1
             self._write_manifest()
         return path
 
@@ -262,6 +285,7 @@ class LsmStore:
             kept = [r for r in self._ssts if id(r) not in old_set]
             # output is older than anything not in the inputs → append last
             self._ssts = kept + [new_reader]
+            self._struct_gen += 1
             self._write_manifest()
         for r in old:
             try:

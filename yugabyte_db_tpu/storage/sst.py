@@ -26,10 +26,33 @@ import numpy as np
 
 from ..utils.hybrid_time import ENCODED_SIZE as _HT_ENC
 from . import native_lib
-from .columnar import ColumnarBlock, fnv64_bytes, fnv64_keys
+from .columnar import (ColumnarBlock, fnv64_bytes, fnv64_keys,
+                       native_hot as _hot_mod)
 
 _HT_MARKER = 0x05          # dockv ValueType.kHybridTime
 _HT_SUFFIX = _HT_ENC + 1
+
+def _native_finder(cb: ColumnarBlock):
+    """Build (and cache on the block) the native fused point-lookup
+    (native/ybtpu_hot.c BlockFinder); None when unavailable."""
+    f = getattr(cb, "_finder", False)
+    if f is not False:
+        return f
+    hot = _hot_mod()
+    f = None
+    if hot is not None and cb.keys is not None and cb.n:
+        try:
+            keys = np.ascontiguousarray(cb.keys)
+            ht = np.ascontiguousarray(cb.ht.astype(np.uint64, copy=False))
+            wid = np.ascontiguousarray(
+                cb.write_id.astype(np.uint32, copy=False))
+            tomb = np.ascontiguousarray(
+                cb.tombstone.astype(np.uint8, copy=False))
+            f = hot.BlockFinder(keys, ht, wid, tomb, cb.n, keys.shape[1])
+        except Exception:
+            f = None
+    object.__setattr__(cb, "_finder", f)
+    return f
 
 
 def _doc_key_of(k: bytes) -> bytes:
@@ -67,6 +90,10 @@ class BloomFilter:
         return cls(bits, k)
 
     def may_contain(self, key_hash: int) -> bool:
+        hot = _hot_mod()
+        if hot is not None:
+            return hot.bloom_may_contain(self.bits, self.k,
+                                         key_hash & 0xFFFFFFFFFFFFFFFF)
         m = len(self.bits) * 8
         h1 = key_hash & 0xFFFFFFFFFFFFFFFF
         h2 = ((h1 >> 33) | 1)
@@ -407,6 +434,22 @@ class SstReader:
             if cb is not None and cb.keys is None:
                 cb = None
             if cb is not None:
+                fnd = _native_finder(cb)
+                if fnd is not None:
+                    r = fnd.find(prefix, read_ht,
+                                 -1 if restart_hi is None else restart_hi)
+                    if isinstance(r, tuple):
+                        pos, ht, wid, _tomb = r
+                        return ("row", ht, wid,
+                                cb.keys[pos].tobytes(), None, cb, pos)
+                    if r is not None:
+                        return ("restart", r)
+                    # nothing visible HERE; this doc key's versions
+                    # continue into the next block only when they run
+                    # through the block's last key
+                    if e.last_key[:plen] == prefix:
+                        continue
+                    return None
                 pos = cb.searchsorted_key(prefix)
                 keys, hts, n = cb.keys, cb.ht, cb.n
                 advanced = False

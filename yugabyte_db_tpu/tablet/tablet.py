@@ -22,7 +22,7 @@ from ..docdb.compaction import (
 )
 from ..docdb.operations import (
     DocReadOperation, DocWriteOperation, ReadRequest, ReadResponse,
-    WriteRequest, WriteResponse,
+    ReadRestartError, WriteRequest, WriteResponse,
 )
 from ..docdb.table_codec import TableCodec, TableInfo
 from ..ops.device_batch import DeviceBlockCache
@@ -150,6 +150,29 @@ class Tablet:
         self._m_reads.increment()
         self._m_read_lat.increment((_perf_counter() - t0) * 1e6)
         return resp
+
+    def multi_read(self, table_id: str, pk_rows, read_ht=None):
+        """Batched point reads: the engine seam where concurrent
+        sessions' point lookups amortize per-op overhead (reference
+        analog: pggate operation buffering / doc_op batching). Returns
+        a row dict (or None) per pk_row, all at one read point."""
+        t0 = _perf_counter()
+        server_assigned = read_ht is None
+        if server_assigned:
+            read_ht = self.clock.now().value
+        op = self._read_ops.get(table_id, self._read_op)
+        for _attempt in range(3):
+            try:
+                rows = op.multi_get(pk_rows, read_ht,
+                                    allow_restart=server_assigned)
+                break
+            except ReadRestartError as e:
+                read_ht = e.restart_ht
+        else:
+            rows = op.multi_get(pk_rows, read_ht, allow_restart=False)
+        self._m_reads.increment(len(pk_rows))
+        self._m_read_lat.increment((_perf_counter() - t0) * 1e6)
+        return rows
 
     def safe_time(self) -> HybridTime:
         return self.clock.now()

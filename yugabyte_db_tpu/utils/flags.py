@@ -65,6 +65,9 @@ class FlagRegistry:
     def reset(self, name: str) -> None:
         f = self._flags[name]
         f.value = f.default
+        # observers (e.g. cached derived values) must see resets too
+        for cb in f.callbacks:
+            cb(f.value)
 
 
 REGISTRY = FlagRegistry()
