@@ -133,10 +133,41 @@ class ColocatedRepackingFeed(DocDbCompactionFeed):
         return [_repack_entry(*ent, k, v)]
 
 
+def native_merge_gc(keys: np.ndarray, run_starts: np.ndarray,
+                    ht: np.ndarray, tomb: np.ndarray, cutoff: int):
+    """CPU twin of merge_gc_split_kernel built on the native C k-way
+    merge (native/ybtpu_native.cpp kway_merge; reference analog:
+    rocksdb MergingIterator + DocDBCompactionFeed): merge the per-SST
+    sorted runs of full keys, then apply the SAME vectorized retention
+    rules over the merged order. Returns (order, keep) with the
+    run_merge_gc contract, or None when the native library is absent."""
+    from ..storage import native_lib
+    got = native_lib.kway_merge_fixed(keys, run_starts)
+    if got is None:
+        return None
+    order, dup = got
+    dk_s = keys[order][:, :-_HT_SUFFIX]
+    same_dockey = np.concatenate(
+        [[False], (dk_s[1:] == dk_s[:-1]).all(axis=1)])
+    ht_s = ht[order]
+    tomb_s = tomb[order]
+    leq = ht_s <= np.uint64(cutoff)
+    prev_leq = np.concatenate([[False], leq[:-1]])
+    # versions sort newest-first within a doc key, so its <=cutoff rows
+    # are contiguous at the tail: "first leq" = leq with no leq right
+    # before it in the same key (identical rule to the device kernel)
+    first_leq = leq & (~same_dockey | ~prev_leq)
+    keep = ~dup & ((ht_s > np.uint64(cutoff)) | (first_leq & ~tomb_s))
+    return order, keep
+
+
 def tpu_compact(store: LsmStore, codec: TableCodec, history_cutoff: int,
                 inputs: Optional[Sequence[SstReader]] = None,
-                block_rows: int = 65536) -> Optional[str]:
-    """Major (or selected-input) compaction through the device kernel.
+                block_rows: int = 65536,
+                backend: str = "device") -> Optional[str]:
+    """Major (or selected-input) compaction through the device sort
+    kernel (backend="device") or the native C k-way merge
+    (backend="native") — both feed the same vectorized column gathers.
 
     Returns the new SST path, or None if there was nothing to do. Falls
     back to materialized row gathering when inputs aren't uniformly
@@ -148,34 +179,52 @@ def tpu_compact(store: LsmStore, codec: TableCodec, history_cutoff: int,
         return None
 
     col_sources: List[ColumnarBlock] = []
+    run_starts = [0]
     all_columnar = True
     for r in inputs:
+        rows = 0
         for i in range(r.num_blocks()):
             cb = r.columnar_block(i)
             if cb is None or cb.keys is None:
                 all_columnar = False
                 break
             col_sources.append(cb)
+            rows += cb.n
         if not all_columnar:
             break
+        run_starts.append(run_starts[-1] + rows)
 
     if all_columnar and col_sources:
         widths = {cb.keys.shape[1] for cb in col_sources}
         if len(widths) == 1:
             return _compact_columnar(store, codec, col_sources, inputs,
-                                     history_cutoff, block_rows)
+                                     history_cutoff, block_rows,
+                                     np.asarray(run_starts, np.int64),
+                                     backend)
     return _compact_rows(store, codec, inputs, history_cutoff)
 
 
 def _compact_columnar(store, codec, blocks: List[ColumnarBlock],
-                      inputs, cutoff: int, block_rows: int) -> str:
+                      inputs, cutoff: int, block_rows: int,
+                      run_starts: np.ndarray, backend: str) -> str:
     keys = np.concatenate([b.keys for b in blocks])
     tomb = np.concatenate([b.tombstone for b in blocks])
     dk, ht, wid = split_ht_suffix(keys)
-    dk_words = keys_to_words(dk)
-    from ..ops.compaction import run_merge_gc
-    order, keep = run_merge_gc(dk_words, ht, wid, tomb, cutoff)
+    got = None
+    if backend == "native":
+        got = native_merge_gc(keys, run_starts, ht, tomb, cutoff)
+    if got is None:
+        from ..ops.compaction import run_merge_gc
+        got = run_merge_gc(keys_to_words(dk), ht, wid, tomb, cutoff)
+    order, keep = got
     sel = order[keep]                       # kept rows, in sorted key order
+    # adjacent-distinct doc keys over ALL kept rows, computed once (the
+    # per-output-block unique_keys flags are slices of this)
+    if len(sel) > 1:
+        dk_sel = dk[sel]
+        distinct_adj = (dk_sel[1:] != dk_sel[:-1]).any(axis=1)
+    else:
+        distinct_adj = np.ones(0, bool)
 
     # concatenate all columns once, then gather
     def cat_fixed(cid):
@@ -239,23 +288,37 @@ def _compact_columnar(store, codec, blocks: List[ColumnarBlock],
     fixed_cat = {cid: cat_fixed(cid) for cid in fixed_ids}
     pk_cat = {cid: cat_pk(cid) for cid in pk_ids}
     path = store._new_sst_path()
-    w = SstWriter(path)
-    for s in range(0, len(sel), block_rows):
-        chunk = sel[s:s + block_rows]
-        if not len(chunk):
-            continue
-        fixed = {cid: (fixed_cat[cid][0][chunk], fixed_cat[cid][1][chunk])
-                 for cid in fixed_ids}
-        pk = {cid: pk_cat[cid][chunk] for cid in pk_ids}
-        varlen = {cid: gather_varlen(cid, chunk) for cid in varlen_ids}
-        out = ColumnarBlock.from_arrays(
-            schema_version=sv,
-            key_hash=key_hash[chunk],
-            ht=ht[chunk], write_id=wid[chunk],
-            pk=pk, fixed=fixed, varlen=varlen,
-            tombstone=tomb[chunk],
-            keys=keys[chunk], unique_keys=_unique(dk_words, sel, s, block_rows))
-        w.add_columnar_block(out)
+    w = SstWriter(path, stream_columnar=True)
+    # pipeline: file writes of block k overlap the gathers of block k+1
+    # (the write releases the GIL; the reference's CompactionJob
+    # similarly overlaps merge work with output IO)
+    from concurrent.futures import ThreadPoolExecutor
+    with ThreadPoolExecutor(max_workers=1) as pool:
+        pending = None
+        for s in range(0, len(sel), block_rows):
+            chunk = sel[s:s + block_rows]
+            if not len(chunk):
+                continue
+            fixed = {cid: (fixed_cat[cid][0][chunk],
+                           fixed_cat[cid][1][chunk])
+                     for cid in fixed_ids}
+            pk = {cid: pk_cat[cid][chunk] for cid in pk_ids}
+            varlen = {cid: gather_varlen(cid, chunk)
+                      for cid in varlen_ids}
+            out = ColumnarBlock.from_arrays(
+                schema_version=sv,
+                key_hash=key_hash[chunk],
+                ht=ht[chunk], write_id=wid[chunk],
+                pk=pk, fixed=fixed, varlen=varlen,
+                tombstone=tomb[chunk],
+                keys=keys[chunk],
+                unique_keys=bool(
+                    distinct_adj[s:s + len(chunk) - 1].all()))
+            if pending is not None:
+                pending.result()
+            pending = pool.submit(w.add_columnar_block, out)
+        if pending is not None:
+            pending.result()
     frontier = _merge_frontier(inputs)
     w.set_frontier(**frontier)
     w.finish()
@@ -263,12 +326,6 @@ def _compact_columnar(store, codec, blocks: List[ColumnarBlock],
     return path
 
 
-def _unique(dk_words, sel, s, block_rows) -> bool:
-    chunk = sel[s:s + block_rows]
-    if len(chunk) < 2:
-        return True
-    rows = dk_words[chunk]
-    return bool((rows[1:] != rows[:-1]).any(axis=1).all())
 
 
 def _compact_rows(store, codec, inputs, cutoff: int) -> str:

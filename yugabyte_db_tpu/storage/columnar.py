@@ -217,13 +217,17 @@ class ColumnarBlock:
             unique_keys=unique_keys, keys=keys)
 
     # ------------------------------------------------------------------
-    def serialize(self) -> bytes:
-        bufs: List[bytes] = []
+    def serialize_parts(self) -> Tuple[bytes, List[object]]:
+        """(header bytes, payload buffers). Buffers are buffer-protocol
+        objects (contiguous ndarrays / bytes) so callers can stream them
+        to a file without materializing one giant bytes — compaction
+        writes hundreds of MB through here."""
+        bufs: List[object] = []
         def ref(arr: np.ndarray) -> dict:
-            raw = np.ascontiguousarray(arr).tobytes()
-            bufs.append(raw)
+            a = np.ascontiguousarray(arr)
+            bufs.append(a)
             return {"dtype": str(arr.dtype), "shape": list(arr.shape),
-                    "len": len(raw)}
+                    "len": a.nbytes}
         meta = {
             "n": self.n, "sv": self.schema_version, "uniq": self.unique_keys,
             "keys": ref(self.keys) if self.keys is not None else None,
@@ -237,7 +241,13 @@ class ColumnarBlock:
             bufs.append(heap)
             meta["varlen"][str(k)] = [ref(ends), {"len": len(heap)}, ref(null)]
         head = msgpack.packb(meta)
-        return struct.pack("<I", len(head)) + head + b"".join(bufs)
+        return struct.pack("<I", len(head)) + head, bufs
+
+    def serialize(self) -> bytes:
+        head, bufs = self.serialize_parts()
+        return head + b"".join(
+            b if isinstance(b, bytes) else memoryview(b).cast("B")
+            for b in bufs)
 
     @classmethod
     def deserialize(cls, data: bytes) -> "ColumnarBlock":

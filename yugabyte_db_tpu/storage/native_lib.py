@@ -148,6 +148,27 @@ def bloom_build(hashes: np.ndarray, nbits: int, k: int) -> Optional[np.ndarray]:
     return bits
 
 
+def kway_merge_fixed(mat: np.ndarray, run_starts: np.ndarray
+                     ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """K-way merge over a fixed-width key matrix [N, W] (uint8 rows,
+    lexicographically sorted within each run). run_starts: [R+1] row
+    boundaries, runs newest-first. Returns (merged row order, exact-dup
+    flags) without materializing per-key bytes objects."""
+    lib = _load()
+    if lib is None:
+        return None
+    n, w = mat.shape
+    mat = np.ascontiguousarray(mat)
+    off = np.arange(n + 1, dtype=np.uint64) * np.uint64(w)
+    run_starts = np.ascontiguousarray(run_starts, np.int64)
+    out_idx = np.empty(n, np.int64)
+    out_dup = np.empty(n, np.uint8)
+    cnt = lib.kway_merge(_ptr(mat.reshape(-1), _u8p), _ptr(off, _u64p),
+                         _ptr(run_starts, _i64p), len(run_starts) - 1,
+                         _ptr(out_idx, _i64p), _ptr(out_dup, _u8p))
+    return out_idx[:cnt], out_dup[:cnt].astype(bool)
+
+
 def kway_merge(runs: Sequence[Sequence[bytes]]
                ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
     """runs: newest-first lists of sorted keys. Returns (global row order,

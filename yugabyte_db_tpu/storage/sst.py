@@ -80,6 +80,10 @@ class BloomFilter:
         m = max(64, n * bits_per_key)
         m = (m + 7) // 8 * 8
         k = max(1, min(30, int(round(bits_per_key * 0.69))))
+        nat = native_lib.bloom_build(
+            np.asarray(key_hashes, np.uint64), m, k)
+        if nat is not None:
+            return cls(nat, k)
         bits = np.zeros(m // 8, np.uint8)
         h1 = key_hashes.astype(np.uint64)
         h2 = (h1 >> np.uint64(33)) | np.uint64(1)
@@ -190,10 +194,17 @@ class BlockIndexEntry:
 
 class SstWriter:
     def __init__(self, path: str, block_rows: int = DEFAULT_BLOCK_ROWS,
-                 columnar_builder: Optional[ColumnarBuilderFn] = None):
+                 columnar_builder: Optional[ColumnarBuilderFn] = None,
+                 stream_columnar: bool = False):
         self.path = path
         self.block_rows = block_rows
         self.columnar_builder = columnar_builder
+        if stream_columnar:
+            from ..utils import flags as _flags
+            stream_columnar = not _flags.get("encrypt_data_at_rest")
+        self._stream = stream_columnar
+        self._sf = None
+        self._stream_index: List[BlockIndexEntry] = []
         self._entries: List[Tuple[bytes, bytes]] = []
         self._blocks: List[Sequence[Tuple[bytes, bytes]]] = []
         self._key_hashes: List[np.ndarray] = []
@@ -218,7 +229,15 @@ class SstWriter:
     def add_columnar_block(self, cb: ColumnarBlock) -> None:
         """Bulk-load fast path: a sorted, keyed ColumnarBlock becomes a
         columnar-ONLY block — no row region is materialized; readers
-        reconstruct KV entries on demand via their row_decoder."""
+        reconstruct KV entries on demand via their row_decoder.
+
+        In stream mode (SstWriter(..., stream_columnar=True)) the block
+        is serialized to the output file IMMEDIATELY — the write
+        releases the GIL, so compaction overlaps output IO with the
+        next block's column gathers (reference analog: CompactionJob
+        interleaving merge work with file writes). Only valid for
+        columnar-only SSTs; falls back to buffering when encryption at
+        rest is on (that path needs the whole image in memory)."""
         if cb.keys is None or cb.n == 0:
             raise ValueError("columnar-only blocks need a keys matrix")
         if self._entries:
@@ -230,6 +249,27 @@ class SstWriter:
         if self._last_key is not None and first < self._last_key:
             raise ValueError("keys must be added in sorted order")
         self._last_key = last
+        if self._stream:
+            if self._blocks:
+                raise ValueError("stream mode cannot mix row blocks")
+            if self._sf is None:
+                self._sf = open(self.path + ".tmp", "wb",
+                                buffering=1 << 20)
+            e = BlockIndexEntry(
+                first_key=first, last_key=last, offset=0, length=0,
+                num_rows=cb.n, col_offset=self._sf.tell(), col_length=0)
+            head, bufs = cb.serialize_parts()
+            e.col_length = len(head)
+            self._sf.write(head)
+            for b in bufs:
+                e.col_length += (len(b) if isinstance(b, bytes)
+                                 else b.nbytes)
+                self._sf.write(b if isinstance(b, bytes)
+                               else memoryview(b).cast("B"))
+            self._stream_index.append(e)
+            self._key_hashes.append(cb.key_hash)
+            self._num_entries += cb.n
+            return
         self._blocks.append([])
         self._col_only.append(cb)
 
@@ -238,7 +278,51 @@ class SstWriter:
         UserFrontier in rocksdb files): op_id, max_ht, history_cutoff..."""
         self._frontier.update(kv)
 
+    def _finish_tail(self, f, index: List[BlockIndexEntry],
+                     row_hashes: List[bytes]) -> None:
+        """Bloom + index + footer, shared by the buffered and streaming
+        paths."""
+        parts = list(self._key_hashes)
+        if row_hashes:
+            parts.append(fnv64_keys(row_hashes))
+        hashes = (np.concatenate(parts) if parts
+                  else np.zeros(0, np.uint64))
+        bloom = BloomFilter.build(hashes)
+        bloom_off = f.tell()
+        braw = bloom.serialize()
+        f.write(braw)
+        idx_off = f.tell()
+        iraw = msgpack.packb([
+            [e.first_key, e.last_key, e.offset, e.length, e.num_rows,
+             e.col_offset, e.col_length] for e in index])
+        f.write(iraw)
+        meta = {
+            "num_entries": self._num_entries,
+            "min_key": self._min_key, "max_key": self._max_key,
+            "bloom_offset": bloom_off, "bloom_length": len(braw),
+            "index_offset": idx_off, "index_length": len(iraw),
+            "frontier": self._frontier,
+        }
+        fraw = msgpack.packb(meta)
+        f.write(fraw)
+        f.write(struct.pack("<I", len(fraw)))
+        f.write(MAGIC)
+
     def finish(self) -> dict:
+        if self._sf is not None:
+            # streaming mode: sections are already on disk; append tail
+            index = self._stream_index
+            if index:
+                self._min_key = index[0].first_key
+                self._max_key = index[-1].last_key
+            with self._sf as f:
+                self._finish_tail(f, index, [])
+                f.flush()
+                os.fsync(f.fileno())
+            self._sf = None
+            os.replace(self.path + ".tmp", self.path)
+            return {"path": self.path, "num_entries": self._num_entries,
+                    "min_key": self._min_key, "max_key": self._max_key}
         if self._entries:
             self._blocks.append(self._entries)
             self._col_only.append(None)
@@ -247,7 +331,13 @@ class SstWriter:
         tmp = self.path + ".tmp"
         row_hashes: List[bytes] = []
         import io
-        with io.BytesIO() as f:
+        from ..utils import flags as _flags
+        # Encryption needs the whole image in memory; otherwise STREAM
+        # straight to the file — compaction outputs are hundreds of MB
+        # and a BytesIO staging pass doubles the write cost.
+        encrypting = _flags.get("encrypt_data_at_rest")
+        with (io.BytesIO() if encrypting
+              else open(tmp, "wb", buffering=1 << 20)) as f:
             # data blocks (empty region for columnar-only blocks)
             for bi, blk in enumerate(self._blocks):
                 cb = self._col_only[bi]
@@ -274,48 +364,32 @@ class SstWriter:
                 if cb is None and self.columnar_builder is not None and blk:
                     cb = self.columnar_builder(blk)
                 if cb is not None:
-                    raw = cb.serialize()
+                    head, bufs = cb.serialize_parts()
                     index[i].col_offset = f.tell()
-                    index[i].col_length = len(raw)
-                    f.write(raw)
+                    index[i].col_length = len(head)
+                    f.write(head)
+                    for b in bufs:
+                        index[i].col_length += (
+                            len(b) if isinstance(b, bytes) else b.nbytes)
+                        f.write(b if isinstance(b, bytes)
+                                else memoryview(b).cast("B"))
                     self._key_hashes.append(cb.key_hash)
             # Bloom over doc-key hashes: columnar blocks carry doc-key
             # hashes (HT stripped); plain row blocks fall back to full-key
             # hashes, which the point-read path mirrors.
-            parts = list(self._key_hashes)
-            if row_hashes:
-                parts.append(fnv64_keys(row_hashes))
-            hashes = (np.concatenate(parts) if parts
-                      else np.zeros(0, np.uint64))
-            bloom = BloomFilter.build(hashes)
-            bloom_off = f.tell()
-            braw = bloom.serialize()
-            f.write(braw)
-            idx_off = f.tell()
-            iraw = msgpack.packb([
-                [e.first_key, e.last_key, e.offset, e.length, e.num_rows,
-                 e.col_offset, e.col_length] for e in index])
-            f.write(iraw)
-            meta = {
-                "num_entries": self._num_entries,
-                "min_key": self._min_key, "max_key": self._max_key,
-                "bloom_offset": bloom_off, "bloom_length": len(braw),
-                "index_offset": idx_off, "index_length": len(iraw),
-                "frontier": self._frontier,
-            }
-            fraw = msgpack.packb(meta)
-            f.write(fraw)
-            f.write(struct.pack("<I", len(fraw)))
-            f.write(MAGIC)
-            raw = f.getvalue()
-        from ..utils import flags as _flags
-        if _flags.get("encrypt_data_at_rest"):
+            self._finish_tail(f, index, row_hashes)
+            if encrypting:
+                raw = f.getvalue()
+            else:
+                f.flush()
+                os.fsync(f.fileno())
+        if encrypting:
             from ..utils.encryption import KEY_MANAGER
             raw = KEY_MANAGER.encrypt_file_bytes(raw)
-        with open(tmp, "wb") as out:
-            out.write(raw)
-            out.flush()
-            os.fsync(out.fileno())
+            with open(tmp, "wb") as out:
+                out.write(raw)
+                out.flush()
+                os.fsync(out.fileno())
         os.replace(tmp, self.path)
         self._blocks = []
         return {"path": self.path, "num_entries": self._num_entries,
@@ -329,11 +403,20 @@ class SstReader:
         docdb layer, which owns the packed-row schema)."""
         self.path = path
         self.row_decoder = row_decoder
-        with open(path, "rb") as f:
-            self._data = f.read()
+        # mmap instead of an eager read: compaction outputs are hundreds
+        # of MB and pages fault in lazily as blocks are touched (the
+        # reference's BlockBasedTable reads blocks on demand the same
+        # way). Encrypted files still need the full image to decrypt.
+        import mmap as _mmap
         from ..utils.encryption import KEY_MANAGER, MAGIC as ENC_MAGIC
-        if self._data.startswith(ENC_MAGIC):
-            self._data = KEY_MANAGER.decrypt_file_bytes(self._data)
+        with open(path, "rb") as f:
+            head = f.read(len(ENC_MAGIC))
+            if head.startswith(ENC_MAGIC):
+                f.seek(0)
+                self._data = KEY_MANAGER.decrypt_file_bytes(f.read())
+            else:
+                self._data = _mmap.mmap(f.fileno(), 0,
+                                        access=_mmap.ACCESS_READ)
         d = self._data
         if d[-8:] != MAGIC:
             raise ValueError(f"{path}: bad SST magic")

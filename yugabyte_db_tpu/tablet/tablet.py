@@ -206,9 +206,15 @@ class Tablet:
             path = self.regular.compact(
                 inputs=inputs,
                 feed=ColocatedRepackingFeed(cutoff, self.codecs.values()))
-        elif flags.get("tpu_compaction_enabled") and not multi_version:
+        elif not multi_version:
+            # single-schema tablets: device sort kernel, or the native C
+            # k-way merge + vectorized GC when the device is disabled —
+            # the honest CPU baseline (reference:
+            # rocksdb/db/compaction_job.cc ProcessKeyValueCompaction)
+            backend = ("device" if flags.get("tpu_compaction_enabled")
+                       else "native")
             path = tpu_compact(self.regular, self.codec, cutoff,
-                               inputs=inputs)
+                               inputs=inputs, backend=backend)
         else:
             # mixed schema versions compact on the CPU feed, which also
             # repacks surviving rows to the latest schema version
