@@ -139,13 +139,30 @@ def native_merge_gc(keys: np.ndarray, run_starts: np.ndarray,
     merge (native/ybtpu_native.cpp kway_merge; reference analog:
     rocksdb MergingIterator + DocDBCompactionFeed): merge the per-SST
     sorted runs of full keys, then apply the SAME vectorized retention
-    rules over the merged order. Returns (order, keep) with the
-    run_merge_gc contract, or None when the native library is absent."""
+    rules over the merged order. Falls back to a numpy stable sort when
+    the native library is absent (never the device kernel — callers
+    chose this backend to stay off the accelerator). Returns
+    (order, keep) with the run_merge_gc contract.
+
+    No TTL term is needed here: TTL-wrapped values never get a columnar
+    sidecar (table_codec.columnar_builder bails on kMergeFlags), so
+    columnar inputs are TTL-free by construction — TTL GC lives in the
+    row paths (_compact_rows, DocDbCompactionFeed)."""
     from ..storage import native_lib
     got = native_lib.kway_merge_fixed(keys, run_starts)
     if got is None:
-        return None
-    order, dup = got
+        # Pure-numpy fallback: stable sort over the full encoded keys
+        # (dockey asc, then ht desc — the encoding's own order). Keeps
+        # the CPU backend on the CPU when the native library is absent
+        # instead of silently running the device kernel against the
+        # tpu_compaction_enabled=False flag.
+        v = np.ascontiguousarray(keys).view(
+            np.dtype((np.void, keys.shape[1]))).reshape(-1)
+        order = np.argsort(v, kind="stable").astype(np.int64)
+        ks = v[order]
+        dup = np.concatenate([[False], ks[1:] == ks[:-1]])
+    else:
+        order, dup = got
     dk_s = keys[order][:, :-_HT_SUFFIX]
     same_dockey = np.concatenate(
         [[False], (dk_s[1:] == dk_s[:-1]).all(axis=1)])
@@ -201,6 +218,12 @@ def tpu_compact(store: LsmStore, codec: TableCodec, history_cutoff: int,
                                      history_cutoff, block_rows,
                                      np.asarray(run_starts, np.int64),
                                      backend)
+    if backend == "native":
+        # non-columnar inputs (TTL'd rows, mixed widths) on the CPU
+        # backend: the streaming GC feed — full retention rules incl.
+        # TTL expiry, and no device kernel behind a disabled flag
+        return store.compact(inputs=inputs,
+                             feed=DocDbCompactionFeed(history_cutoff))
     return _compact_rows(store, codec, inputs, history_cutoff)
 
 
@@ -329,7 +352,15 @@ def _compact_columnar(store, codec, blocks: List[ColumnarBlock],
 
 
 def _compact_rows(store, codec, inputs, cutoff: int) -> str:
-    """Fallback: materialize entries, sort+GC on device, gather rows."""
+    """Fallback: materialize entries, sort+GC on device, gather rows.
+
+    TTL-wrapped values (kMergeFlags) are never columnar (see
+    table_codec.columnar_builder), so EVERY TTL'd row compacts through
+    here — this path must therefore carry the same TTL-expiry retention
+    rule as DocDbCompactionFeed (reference:
+    src/yb/docdb/docdb_compaction_context.cc:783): the surviving
+    first-version-<=-cutoff row is still dropped when its expire hybrid
+    time is at or before the cutoff."""
     entries: List[Tuple[bytes, bytes]] = []
     for r in inputs:
         entries.extend(r.iterate())
@@ -340,10 +371,13 @@ def _compact_rows(store, codec, inputs, cutoff: int) -> str:
         w.finish()
         store.replace_ssts(inputs, path)
         return path
+    from ..dockv.value import unwrap_ttl
     lens = [len(k) for k, _ in entries]
     wmax = max(lens)
     tomb = np.fromiter((v[0] == ValueKind.kTombstone for _, v in entries),
                        bool, len(entries))
+    expire = np.fromiter(((unwrap_ttl(v)[1] or 0) for _, v in entries),
+                         np.uint64, len(entries))
     # split suffix per-entry then pad doc keys
     from ..ops.compaction import compact_runs
     keys_mat = np.zeros((len(entries), wmax), np.uint8)
@@ -358,9 +392,25 @@ def _compact_rows(store, codec, inputs, cutoff: int) -> str:
             runs.append((np.frombuffer(k, np.uint8)[None, :],
                          tomb[i:i + 1]))
         order, keep = compact_runs(runs, cutoff)
+    sel = order[keep]
+    if len(sel) and expire.any():
+        # TTL-expiry retention term: the first-version-<=-cutoff
+        # survivor is still dropped when its TTL expired at or before
+        # the cutoff (rows inside the retention window keep their
+        # envelope; readers apply TTL at read time). HT decodes only
+        # for candidate rows — kept rows with an expired envelope.
+        exp_sel = expire[sel]
+        maybe = (exp_sel != 0) & (exp_sel <= np.uint64(cutoff))
+        if maybe.any():
+            ht_sel = np.fromiter(
+                (DocHybridTime.decode_desc(
+                    entries[int(i)][0][-ENCODED_SIZE:]).ht.value
+                 if m else 0
+                 for i, m in zip(sel, maybe)), np.uint64, len(sel))
+            sel = sel[~(maybe & (ht_sel <= np.uint64(cutoff)))]
     path = store._new_sst_path()
     w = SstWriter(path, columnar_builder=codec.columnar_builder)
-    for i in order[keep]:
+    for i in sel:
         w.add(*entries[int(i)])
     w.set_frontier(**_merge_frontier(inputs))
     w.finish()

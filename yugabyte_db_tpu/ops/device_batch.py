@@ -57,6 +57,12 @@ class DeviceBatch:
     # per-column (min, max) memo for int32 columns — the pallas route
     # checks f32-exactness once per batch, not once per query
     int32_ranges: Dict[int, tuple] = field(default_factory=dict)
+    # host-side per-column value bounds (min, max) in f64, computed once
+    # at batch build — ops/expr.expr_bound turns these into STATIC
+    # fixed-point SUM scales so the scan kernel needs no device
+    # max-reduction or float fallback lane (absent/non-finite entries
+    # route that SUM to the dynamic-scale path)
+    col_bounds: Dict[int, Tuple[float, float]] = field(default_factory=dict)
 
     @property
     def padded_rows(self) -> int:
@@ -134,6 +140,7 @@ def build_batch(blocks: Sequence[ColumnarBlock],
     cols: Dict[int, jnp.ndarray] = {}
     nulls: Dict[int, jnp.ndarray] = {}
     dicts: Dict[int, np.ndarray] = {}
+    col_bounds: Dict[int, Tuple[float, float]] = {}
     for cid in columns:
         if all(cid in b.varlen for b in blocks):
             # string column: batch-global dictionary encoding — codes
@@ -172,13 +179,16 @@ def build_batch(blocks: Sequence[ColumnarBlock],
                     f"column {cid} not available in columnar form")
         arr = _to_device_dtype(np.concatenate(parts))
         null = np.concatenate(nparts)
+        if arr.size and arr.dtype.kind in "fiu":
+            col_bounds[cid] = (float(arr.min()), float(arr.max()))
         cols[cid] = jnp.asarray(_pad(arr, padded))
         nulls[cid] = jnp.asarray(_pad(null, padded))
     valid = np.zeros(padded, bool)
     valid[:n] = True
     batch = DeviceBatch(
         n_rows=n, cols=cols, nulls=nulls, valid=jnp.asarray(valid),
-        unique_keys=all(b.unique_keys for b in blocks), dicts=dicts)
+        unique_keys=all(b.unique_keys for b in blocks), dicts=dicts,
+        col_bounds=col_bounds)
     if with_mvcc:
         batch.key_hash = jnp.asarray(_pad(
             np.concatenate([b.key_hash for b in blocks]), padded))

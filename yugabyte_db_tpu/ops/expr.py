@@ -18,6 +18,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, Sequence, Tuple, Union
 
 import jax.numpy as jnp
+import numpy as np
 
 ExprNode = Union[tuple, list]
 
@@ -299,6 +300,65 @@ def _or3_null(lv, ln, rv, rn):
         return None
     return jnp.logical_and(any_null,
                            jnp.logical_not(jnp.logical_or(l_true, r_true)))
+
+
+def expr_bound(node: ExprNode, col_bounds: Dict[int, Tuple[float, float]],
+               mag_limit: float = np.inf) -> Tuple[float, float] | None:
+    """Interval-arithmetic bound (lo, hi) of an arithmetic expression
+    from host-cached per-column value ranges, or None when unboundable
+    (missing column stats, non-finite data, unsupported node, or ANY
+    intermediate interval exceeding `mag_limit`).
+
+    Powers the scan kernel's STATIC fixed-point SUM scales: knowing
+    max|expr| before tracing lets the kernel quantize in the same fused
+    pass as the predicate — no separate device max-reduction and no
+    float fallback lane (the r03 Q1/Q6 regression). Conservative is
+    fine; loose bounds only coarsen the quantization granule.
+
+    `mag_limit` is the device float dtype's finite range: an
+    intermediate that can overflow ON DEVICE (e.g. an f32 product of
+    two in-range columns) would evaluate to Inf there even if the final
+    result is small, so such expressions must stay on the dynamic path
+    with its Inf/NaN float fallback lane."""
+    def clip(b):
+        if b is None or max(abs(b[0]), abs(b[1])) > mag_limit:
+            return None
+        return b
+
+    kind = node[0]
+    if kind == "col":
+        b = col_bounds.get(node[1])
+        if b is None or not (np.isfinite(b[0]) and np.isfinite(b[1])):
+            return None
+        return clip((float(b[0]), float(b[1])))
+    if kind == "const":
+        v = node[1]
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return None
+        v = float(v)
+        return clip((v, v)) if np.isfinite(v) else None
+    if kind == "arith":
+        lb = expr_bound(node[2], col_bounds, mag_limit)
+        rb = expr_bound(node[3], col_bounds, mag_limit)
+        if lb is None or rb is None:
+            return None
+        op = node[1]
+        if op == "add":
+            return clip((lb[0] + rb[0], lb[1] + rb[1]))
+        if op == "sub":
+            return clip((lb[0] - rb[1], lb[1] - rb[0]))
+        if op == "mul":
+            ps = (lb[0] * rb[0], lb[0] * rb[1],
+                  lb[1] * rb[0], lb[1] * rb[1])
+            return clip((min(ps), max(ps)))
+        if op == "div":
+            # only safe when the divisor interval excludes 0
+            if rb[0] > 0 or rb[1] < 0:
+                ps = (lb[0] / rb[0], lb[0] / rb[1],
+                      lb[1] / rb[0], lb[1] / rb[1])
+                return clip((min(ps), max(ps)))
+        return None
+    return None
 
 
 def referenced_columns(node: ExprNode, out: set | None = None) -> set:

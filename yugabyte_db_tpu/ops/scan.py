@@ -26,21 +26,37 @@ on — reference semantics: exact PG numerics in EvalAggregate,
 src/yb/docdb/pgsql_operation.cc:3153):
 - SUM/COUNT accumulate EXACTLY in int64. Integer (and integer-valued)
   columns sum exactly end-to-end. Float values are deterministically
-  quantized per batch to int64 fixed point — scale s = 2^k chosen so
-  n_rows * max|v| * s <= 2^62 cannot overflow — then summed exactly and
+  quantized to int64 fixed point — scale s = 2^k chosen so
+  n_rows * bound * s < 2^62 cannot overflow — then summed exactly and
   rescaled on the host in f64. The only error is per-row: the f32
   device representation of the value itself (<= 2^-24 relative; f64 on
-  CPU backends) plus quantization <= n*max|v|/2^63. For a FIXED device
-  dtype and quantization scale the result is order-independent —
+  CPU backends) plus quantization <= 0.5 granule/row. For a FIXED
+  device dtype and quantization scale the result is order-independent —
   accumulation order (MXU vs VPU vs psum tree) can never change it;
   error bounds do not grow with row count. Results may still differ at
   the per-row-representation level between backends with different
   device dtypes (f64 CPU vs f32 TPU) or between partitionings that
-  derive different scales (the scale depends on batch max|v| and the
-  padded row count).
+  derive different scales.
+- The scale is STATIC when host-side column stats can bound the
+  aggregate expression (ops/expr.expr_bound over DeviceBatch.col_bounds
+  — the common case): it arrives as a runtime scalar, so quantization
+  fuses into the predicate pass with no device max-reduction and no
+  second lane (this is what recovered the r03 Q1/Q6 regression). SUMs
+  over unboundable expressions or degenerate magnitudes fall back to
+  the DYNAMIC per-batch scale (in-kernel max-reduce) with a float
+  fallback lane for Inf/NaN propagation.
+- Grouped-SUM absolute error is <= 0.5 * n_g granules at the
+  batch-global granule (set by the batch-wide bound). A group whose own
+  values are many decades smaller than the batch bound sees that
+  ABSOLUTE error floor — negligible in batch terms, but potentially
+  visible relative to that group's own small sum. The dynamic path's
+  fallback lane picks the independently-summed float lane for such
+  small-|q| groups; the static path accepts the documented absolute
+  bound in exchange for single-pass speed.
 - MIN/MAX carry the value dtype (no accumulation error by nature).
-- The distributed kernel pmax-combines the quantization scale across
-  shards before quantizing, so int64 partials psum exactly over ICI.
+- Distributed: static scales derive from GLOBAL column bounds, so int64
+  partials psum exactly over ICI with no pre-collective; dynamic scales
+  pmax-combine max|v| across shards first.
 """
 from __future__ import annotations
 
@@ -118,12 +134,56 @@ def _mvcc_visible_latest(key_hash, ht, write_id, tombstone, valid, read_ht):
     return out
 
 
-# sums over <= this many groups unroll into per-group masked tree
-# reductions (pure VPU code); larger group counts use segment_sum
+# sums over <= this many groups MAY unroll into per-group masked tree
+# reductions (pure VPU code); larger group counts always use segment_sum
 _UNROLL_G = 16
 
 # scale sentinel meaning "integer-exact result, do not rescale"
 _NOSCALE = jnp.float32(0.0)
+
+
+def _group_strategy() -> str:
+    """Reduction strategy for small-G grouped aggregates. CPU XLA does
+    not fuse G unrolled masked reductions into one pass (measured ~7x
+    slower on TPC-H Q1), so CPU uses scatter-add segment_sum; TPU keeps
+    the unrolled VPU reductions (scatter is the slow op there)."""
+    from ..utils import flags as _flags
+    s = _flags.get("scan_group_strategy")
+    if s == "auto":
+        return "segment" if jax.default_backend() == "cpu" else "unroll"
+    return s
+
+
+def _scale_for(bound: float, n_total: int):
+    """Static fixed-point scale 2^k for a float SUM whose per-row values
+    are bounded by `bound` (host-side interval arithmetic over column
+    stats): k = floor(61 - log2 n - log2 bound) makes n_total rows of
+    |v|<=bound sum to < 2^61 in int64 with no possible overflow (one
+    spare bit vs 2^62 absorbs f32 rounding of v itself). Returns an f32
+    scale (powers of two are exact in f32; the kernel casts to the value
+    dtype), or None when the magnitude regime can't quantize — the
+    caller then uses the dynamic in-kernel scale with its degenerate
+    fallbacks."""
+    if not np.isfinite(bound):
+        return None
+    if bound <= 0.0:
+        return np.float32(1.0)      # all values are exactly 0
+    k = np.floor(61.0 - np.log2(max(n_total, 1)) - np.log2(bound))
+    if k < -120.0 or k > 120.0:     # out of f32-exp / int64 range
+        return None
+    return np.float32(2.0 ** k)
+
+
+def _sum_prep_static(v, m, scale):
+    """Static-scale twin of _sum_prep: the scale is a host-derived
+    runtime scalar, so quantization fuses into the predicate pass —
+    no device max-reduction, no float fallback lane. Returns (q int64,
+    scale) with q zero outside the mask."""
+    if jnp.issubdtype(v.dtype, jnp.integer) or v.dtype == jnp.bool_:
+        return jnp.where(m, v.astype(jnp.int64), 0), _NOSCALE
+    vm = jnp.where(m, v, 0)
+    q = jnp.rint(vm * scale.astype(vm.dtype)).astype(jnp.int64)
+    return q, scale
 
 
 def _sum_prep(v, m, n_total: int, axis_names: Tuple[str, ...] = ()):
@@ -165,21 +225,22 @@ def _sum_prep(v, m, n_total: int, axis_names: Tuple[str, ...] = ()):
     return q, s, vm
 
 
-def _grouped_sum(q, gid, G: int):
+def _grouped_sum(q, gid, G: int, strategy: str = "unroll"):
     """Per-group sums in q's dtype (exact for the int64 fixed-point
     lane; also builds the float fallback lane); q must already be 0
     outside the row mask (so invalid rows are additive no-ops whatever
     their gid)."""
-    if G <= _UNROLL_G:
+    if strategy == "unroll" and G <= _UNROLL_G:
         return jnp.stack([jnp.sum(jnp.where(gid == g, q, 0))
                           for g in range(G)])
     return jax.ops.segment_sum(q, gid, G)
 
 
-def _grouped_extreme(v, m, gid, G: int, is_min: bool):
+def _grouped_extreme(v, m, gid, G: int, is_min: bool,
+                     strategy: str = "unroll"):
     sentinel = _type_max(v) if is_min else _type_min(v)
     masked = jnp.where(m, v, sentinel)
-    if G <= _UNROLL_G:
+    if strategy == "unroll" and G <= _UNROLL_G:
         red = jnp.min if is_min else jnp.max
         return jnp.stack([red(jnp.where(gid == g, masked, sentinel))
                           for g in range(G)])
@@ -190,7 +251,9 @@ def _grouped_extreme(v, m, gid, G: int, is_min: bool):
 def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
                   group: Optional[GroupSpec], mvcc_mode: str,
                   axis_names: Tuple[str, ...] = (),
-                  row_multiplier: int = 1):
+                  row_multiplier: int = 1,
+                  static_sums: Tuple[bool, ...] = (),
+                  strategy: str = "unroll"):
     """mvcc_mode: 'none' (valid only), 'visible' (ht filter, unique keys),
     'dedup' (full newest-visible-version selection).
 
@@ -199,13 +262,27 @@ def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
     where each float SUM out is an exact int64 accumulation to be divided
     by its scale host-side (scale 0.0 = integer-exact, keep as int64).
     `axis_names`/`row_multiplier` let the distributed kernel agree on
-    quantization scales across `row_multiplier` mesh shards."""
+    quantization scales across `row_multiplier` mesh shards.
+
+    `static_sums[i]` marks SUM aggregates whose fixed-point scale is
+    host-derived from column stats (expr_bound) and arrives as the
+    runtime arg `sum_scales[i]` — the fast path: quantization fuses
+    into the predicate pass with no device max-reduce and no float
+    fallback lane. Non-static SUMs keep the dynamic in-kernel scale
+    with its degenerate-magnitude fallbacks."""
     where_fn = compile_expr(where_node) if where_node is not None else None
     agg_fns = [(a.op, compile_expr(a.expr) if a.expr is not None else None)
                for a in agg_specs]
+    static_sums = static_sums or (False,) * len(agg_fns)
+
+    def _prep(i, v, m, n_total, sum_scales):
+        if static_sums[i]:
+            q, s = _sum_prep_static(v, m, sum_scales[i])
+            return q, s, None
+        return _sum_prep(v, m, n_total, axis_names)
 
     def fn(cols, nulls, consts, valid, key_hash, ht, write_id, tombstone,
-           read_ht):
+           read_ht, sum_scales):
         if mvcc_mode == "none":
             mask = valid
         elif mvcc_mode == "visible":
@@ -244,7 +321,7 @@ def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
             seg = jnp.clip(jnp.cumsum(first) - 1, 0, G - 1)
             n_total = n * row_multiplier
             out, scales = [], []
-            for op, f in agg_fns:
+            for i, (op, f) in enumerate(agg_fns):
                 if f is None:
                     out.append(jax.ops.segment_sum(
                         valid_s.astype(jnp.int64), seg, G))
@@ -259,7 +336,7 @@ def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
                         m.astype(jnp.int64), seg, G))
                     scales.append(_NOSCALE)
                 elif op == "sum":
-                    q, s, vm = _sum_prep(v_s, m, n_total, axis_names)
+                    q, s, vm = _prep(i, v_s, m, n_total, sum_scales)
                     out.append(jax.ops.segment_sum(q, seg, G))
                     scales.append(
                         s if vm is None
@@ -289,7 +366,7 @@ def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
         n_total = mask.shape[0] * row_multiplier
         if group is None:
             out, scales = [], []
-            for op, f in agg_fns:
+            for i, (op, f) in enumerate(agg_fns):
                 if f is None:
                     out.append(jnp.sum(mask, dtype=jnp.int64))
                     scales.append(_NOSCALE)
@@ -300,7 +377,7 @@ def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
                     out.append(jnp.sum(m, dtype=jnp.int64))
                     scales.append(_NOSCALE)
                 elif op == "sum":
-                    q, s, vm = _sum_prep(v, m, n_total, axis_names)
+                    q, s, vm = _prep(i, v, m, n_total, sum_scales)
                     out.append(jnp.sum(q))
                     scales.append(s if vm is None else (s, jnp.sum(vm)))
                 elif op == "min":
@@ -332,30 +409,34 @@ def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
             stride *= domain
         G = group.num_groups
         out, scales = [], []
-        for op, f in agg_fns:
+        for i, (op, f) in enumerate(agg_fns):
             if f is None:
-                out.append(_grouped_sum(mask.astype(jnp.int64), gid, G))
+                out.append(_grouped_sum(mask.astype(jnp.int64), gid, G,
+                                        strategy))
                 scales.append(_NOSCALE)
                 continue
             v, vn = f(cols, nulls, consts)
             m = mask if vn is None else mask & jnp.logical_not(vn)
             if op == "count":
-                out.append(_grouped_sum(m.astype(jnp.int64), gid, G))
+                out.append(_grouped_sum(m.astype(jnp.int64), gid, G,
+                                        strategy))
                 scales.append(_NOSCALE)
             elif op == "sum":
-                q, s, vm = _sum_prep(v, m, n_total, axis_names)
-                out.append(_grouped_sum(q, gid, G))
+                q, s, vm = _prep(i, v, m, n_total, sum_scales)
+                out.append(_grouped_sum(q, gid, G, strategy))
                 scales.append(
-                    s if vm is None else (s, _grouped_sum(vm, gid, G)))
+                    s if vm is None
+                    else (s, _grouped_sum(vm, gid, G, strategy)))
             elif op == "min":
-                out.append(_grouped_extreme(v, m, gid, G, True))
+                out.append(_grouped_extreme(v, m, gid, G, True, strategy))
                 scales.append(_NOSCALE)
             elif op == "max":
-                out.append(_grouped_extreme(v, m, gid, G, False))
+                out.append(_grouped_extreme(v, m, gid, G, False, strategy))
                 scales.append(_NOSCALE)
             else:
                 raise ValueError(op)
-        group_counts = _grouped_sum(mask.astype(jnp.int64), gid, G)
+        group_counts = _grouped_sum(mask.astype(jnp.int64), gid, G,
+                                    strategy)
         return tuple(out), tuple(scales), group_counts, mask
 
     return fn
@@ -363,10 +444,11 @@ def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
 
 def _rescale_outs(raw_outs, raw_scales):
     """Host-side: divide int64 fixed-point sums by their scale (f64).
-    Scale entries are either the 0.0 sentinel (integer-exact result,
-    stays int64) or a (scale, float_fallback) pair — NaN scale means
-    quantization was impossible (Inf/NaN or out-of-range magnitudes)
-    and the plain float sum is the answer."""
+    Scale entries are: the 0.0 sentinel (integer-exact result, stays
+    int64); a bare nonzero scale (static host-derived fixed point:
+    divide); or a (scale, float_fallback) pair from the dynamic path —
+    NaN scale there means quantization was impossible (Inf/NaN or
+    out-of-range magnitudes) and the plain float sum is the answer."""
     final = []
     for q, s in zip(raw_outs, raw_scales):
         if isinstance(s, tuple):
@@ -391,7 +473,11 @@ def _rescale_outs(raw_outs, raw_scales):
             final.append(np.where(use_q, r, fb) if r.ndim
                          else (r if use_q else fb))
         else:
-            final.append(np.asarray(q))
+            sv = float(np.asarray(s))
+            if sv == 0.0:
+                final.append(np.asarray(q))       # integer-exact
+            else:
+                final.append(np.asarray(q).astype(np.float64) / sv)
     return tuple(final)
 
 
@@ -414,10 +500,13 @@ class ScanKernel:
         self._cache: Dict[tuple, object] = {}
         self.compiles = 0
 
-    def _get(self, sig, where_node, aggs, group, mvcc_mode, donate=False):
+    def _get(self, sig, where_node, aggs, group, mvcc_mode, static_sums,
+             strategy):
         fn = self._cache.get(sig)
         if fn is None:
-            raw = _build_kernel(where_node, aggs, group, mvcc_mode)
+            raw = _build_kernel(where_node, aggs, group, mvcc_mode,
+                                static_sums=static_sums,
+                                strategy=strategy)
             fn = jax.jit(raw)
             self._cache[sig] = fn
             self.compiles += 1
@@ -460,8 +549,9 @@ class ScanKernel:
             if col is None or str(col.dtype) not in self._PALLAS_DTYPES:
                 return None
             if str(col.dtype) == "int32":
-                rng = batch.int32_ranges.setdefault(
-                    cid, (int(jnp.min(col)), int(jnp.max(col))))
+                rng = batch.col_bounds.get(cid) or \
+                    batch.int32_ranges.setdefault(
+                        cid, (int(jnp.min(col)), int(jnp.max(col))))
                 if max(abs(rng[0]), abs(rng[1])) >= 2 ** 24:
                     return None         # not f32-exact
         for c in consts:
@@ -548,12 +638,15 @@ class ScanKernel:
                 collect_constants(a.expr, consts)
         col_sig = tuple(sorted(
             (cid, str(v.dtype)) for cid, v in batch.cols.items()))
+        static_sums, scale_args = _static_scales(
+            aggs, batch.col_bounds, batch.padded_rows, batch.cols)
+        strategy = _group_strategy()
         sig = (
             expr_signature(where) if where is not None else None,
             tuple(a.signature() for a in aggs),
             (type(group).__name__, group.cols,
              getattr(group, "max_groups", None)) if group else None,
-            mvcc_mode, batch.padded_rows, col_sig,
+            mvcc_mode, batch.padded_rows, col_sig, static_sums, strategy,
         )
         from ..utils import flags as _flags
         if _flags.get("tpu_pallas_scan"):
@@ -561,7 +654,8 @@ class ScanKernel:
                                    mvcc_mode, consts)
             if got is not None:
                 return got
-        fn = self._get(sig, where, aggs, group, mvcc_mode)
+        fn = self._get(sig, where, aggs, group, mvcc_mode, static_sums,
+                       strategy)
         zeros_u64 = jnp.zeros(batch.padded_rows, jnp.uint64)
         zeros_u32 = jnp.zeros(batch.padded_rows, jnp.uint32)
         zeros_b = jnp.zeros(batch.padded_rows, bool)
@@ -573,11 +667,46 @@ class ScanKernel:
             batch.write_id if batch.write_id is not None else zeros_u32,
             batch.tombstone if batch.tombstone is not None else zeros_b,
             jnp.uint64(read_ht if read_ht is not None else 0xFFFFFFFFFFFFFFFF),
+            scale_args,
         )
         # (outs, scales, counts, mask[, gvals, n_groups]) -> rescale the
         # fixed-point sums host-side; callers keep the historical shape
         # (outs, counts, mask[, gvals, n_groups])
         return (_rescale_outs(raw[0], raw[1]),) + tuple(raw[2:])
+
+
+def _static_scales(aggs: Sequence[AggSpec],
+                   col_bounds: Dict[int, Tuple[float, float]],
+                   n_total: int, cols=None):
+    """Per-agg static fixed-point scales from host column stats.
+    Returns (static_flags, scale_args) — scale_args are runtime jnp
+    scalars (0.0 placeholders for non-static entries) so changing data
+    bounds never recompiles the kernel. `cols` (col_id -> device array)
+    supplies dtypes: expressions touching f32 columns cap every
+    intermediate interval at the f32 finite range, since an f32 product
+    can overflow to Inf on device even when the final bound is small
+    and the static path has no Inf fallback lane."""
+    from .expr import expr_bound, referenced_columns
+    flags_, scales = [], []
+    for a in aggs:
+        s = None
+        if a.op == "sum" and a.expr is not None and col_bounds:
+            # f32 cap applies whenever the device may EVALUATE the
+            # expression in f32: any f32 column, or a non-CPU backend
+            # (TPU has no f64, so even int-column exprs mixed with
+            # float constants compute in f32 there)
+            mag = 1.0e306
+            if jax.default_backend() != "cpu" or (
+                    cols is not None and any(
+                        str(getattr(cols.get(c), "dtype", "")) == "float32"
+                        for c in referenced_columns(a.expr))):
+                mag = 3.0e38
+            b = expr_bound(a.expr, col_bounds, mag_limit=mag)
+            if b is not None:
+                s = _scale_for(max(abs(b[0]), abs(b[1])), n_total)
+        flags_.append(s is not None)
+        scales.append(jnp.float32(s if s is not None else 0.0))
+    return tuple(flags_), tuple(scales)
 
 
 def _expand_avg(aggs: Sequence[AggSpec]) -> List[AggSpec]:

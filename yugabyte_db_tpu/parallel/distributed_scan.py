@@ -20,7 +20,8 @@ from jax.sharding import PartitionSpec as P
 from ..ops.device_batch import DeviceBatch, bucket_rows, _pad, f64_conversion
 from ..ops.expr import collect_constants, expr_signature
 from ..ops.scan import (
-    AggSpec, GroupSpec, _build_kernel, _expand_avg, _rescale_outs,
+    AggSpec, GroupSpec, _build_kernel, _expand_avg, _group_strategy,
+    _rescale_outs, _static_scales,
 )
 from ..storage.columnar import ColumnarBlock
 from .mesh import BLOCKS_AXIS, TABLETS_AXIS, TabletMesh
@@ -33,6 +34,10 @@ class ShardedBatch:
     n_rows_per_shard: List[int]
     cols: Dict[int, jnp.ndarray]
     nulls: Dict[int, jnp.ndarray]
+    # GLOBAL per-column (min, max) across all shards — static SUM scales
+    # derived from these are identical on every shard, so int64 partials
+    # psum exactly over ICI with no in-kernel pmax round
+    col_bounds: Dict[int, Tuple[float, float]]
     valid: jnp.ndarray
     key_hash: jnp.ndarray
     ht: jnp.ndarray
@@ -43,11 +48,12 @@ class ShardedBatch:
 
     @property
     def padded_rows(self) -> int:
-        return int(self.valid.shape[1])
+        # valid is [tablet_shards, block_shards, N] after device_put
+        return int(self.valid.shape[-1])
 
     @property
     def num_shards(self) -> int:
-        return int(self.valid.shape[0])
+        return int(np.prod(self.valid.shape[:-1]))
 
 
 def build_sharded_batch(tm: TabletMesh,
@@ -82,6 +88,7 @@ def build_sharded_batch(tm: TabletMesh,
 
     cols: Dict[int, jnp.ndarray] = {}
     nulls: Dict[int, jnp.ndarray] = {}
+    col_bounds: Dict[int, Tuple[float, float]] = {}
 
     def put(tm, arr):
         T, B = tm.num_tablet_shards, tm.num_block_shards
@@ -107,7 +114,12 @@ def build_sharded_batch(tm: TabletMesh,
             if cid in b.fixed:
                 return b.fixed[cid][1]
             return np.zeros(b.n, bool)
-        cols[cid] = put(tm, stack(getv))
+        stacked = stack(getv)
+        if stacked.size and stacked.dtype.kind in "fiu":
+            # padding zeros are included — harmless: masked rows
+            # contribute 0 to any SUM, the bound only sets the scale
+            col_bounds[cid] = (float(stacked.min()), float(stacked.max()))
+        cols[cid] = put(tm, stacked)
         nulls[cid] = put(tm, stack(getn, bool))
     valid_rows = []
     for n in ns:
@@ -116,6 +128,7 @@ def build_sharded_batch(tm: TabletMesh,
         valid_rows.append(v)
     return ShardedBatch(
         n_rows_per_shard=ns, cols=cols, nulls=nulls,
+        col_bounds=col_bounds,
         valid=put(tm, np.stack(valid_rows)),
         key_hash=put(tm, stack(lambda b: b.key_hash, np.uint64)),
         ht=put(tm, stack(lambda b: b.ht, np.uint64)),
@@ -134,27 +147,31 @@ class DistributedScanKernel:
         self._cache: Dict[tuple, object] = {}
         self.compiles = 0
 
-    def _get(self, sig, tm: TabletMesh, where, aggs, group, mvcc_mode):
+    def _get(self, sig, tm: TabletMesh, where, aggs, group, mvcc_mode,
+             static_sums, strategy):
         fn = self._cache.get(sig)
         if fn is not None:
             return fn
         axes = (TABLETS_AXIS, BLOCKS_AXIS)
         S = tm.num_tablet_shards * tm.num_block_shards
-        # axis_names/row_multiplier: float SUMs pmax-combine max|v| across
-        # shards so every shard quantizes with the SAME int64 fixed-point
-        # scale — the int64 partials then psum EXACTLY over ICI
+        # static SUM scales derive from GLOBAL host-side column bounds,
+        # so every shard quantizes identically and the int64 partials
+        # psum EXACTLY over ICI with no collective before the sum; SUMs
+        # without usable bounds fall back to the dynamic in-kernel scale,
+        # where axis_names pmax-combines max|v| across shards first
         local = _build_kernel(where, aggs, group, mvcc_mode,
-                              axis_names=axes, row_multiplier=S)
+                              axis_names=axes, row_multiplier=S,
+                              static_sums=static_sums, strategy=strategy)
 
         def shard_fn(cols, nulls, consts, valid, key_hash, ht, wid, tomb,
-                     read_ht):
+                     read_ht, sum_scales):
             # local shard view: [1, 1, N] → [N]
             sq = lambda a: a.reshape(a.shape[-1])
             lcols = {k: sq(v) for k, v in cols.items()}
             lnulls = {k: sq(v) for k, v in nulls.items()}
             outs, scales, counts, _ = local(
                 lcols, lnulls, consts, sq(valid), sq(key_hash), sq(ht),
-                sq(wid), sq(tomb), read_ht)
+                sq(wid), sq(tomb), read_ht, sum_scales)
             combined = []
             for a, o in zip(aggs, outs):
                 kind = _COMBINE["count" if a.expr is None else a.op]
@@ -185,7 +202,7 @@ class DistributedScanKernel:
         spec3 = P(TABLETS_AXIS, BLOCKS_AXIS, None)
         in_specs = (
             {k: spec3 for k in sig_cols(sig)}, {k: spec3 for k in sig_cols(sig)},
-            P(), spec3, spec3, spec3, spec3, spec3, P())
+            P(), spec3, spec3, spec3, spec3, spec3, P(), P())
         smapped = jax.shard_map(
             shard_fn, mesh=tm.mesh, in_specs=in_specs,
             out_specs=(tuple(P() for _ in aggs), tuple(P() for _ in aggs),
@@ -218,24 +235,30 @@ class DistributedScanKernel:
         col_sig = tuple(sorted(
             (cid, str(v.dtype)) for cid, v in batch.cols.items()))
         tm = batch.mesh
+        static_sums, scale_args = _static_scales(
+            aggs, batch.col_bounds,
+            batch.padded_rows * batch.num_shards, batch.cols)
+        strategy = _group_strategy()
         sig = (
             id(tm.mesh), expr_signature(where) if where is not None else None,
             tuple(a.signature() for a in aggs),
             group.cols if group else None, mvcc_mode,
-            batch.padded_rows, col_sig,
+            batch.padded_rows, col_sig, static_sums, strategy,
         )
-        fn = self._get(sig, tm, where, aggs, group, mvcc_mode)
+        fn = self._get(sig, tm, where, aggs, group, mvcc_mode,
+                       static_sums, strategy)
         outs, scales, counts = fn(
             batch.cols, batch.nulls,
             [jnp.asarray(c) for c in consts], batch.valid,
             batch.key_hash, batch.ht, batch.write_id, batch.tombstone,
             jnp.uint64(read_ht if read_ht is not None
-                       else 0xFFFFFFFFFFFFFFFF))
+                       else 0xFFFFFFFFFFFFFFFF),
+            scale_args)
         return _rescale_outs(outs, scales), counts
 
 
 def sig_cols(sig) -> Tuple[int, ...]:
-    return tuple(cid for cid, _ in sig[-1])
+    return tuple(cid for cid, _ in sig[-3])
 
 
 _DEFAULT = DistributedScanKernel()

@@ -217,6 +217,11 @@ class SstWriter:
         self._col_only: List[Optional[ColumnarBlock]] = []
 
     def add(self, key: bytes, value: bytes) -> None:
+        if self._sf is not None:
+            # streaming finish() returns early and would silently drop
+            # buffered row entries — refuse the mix up front
+            raise ValueError("stream mode cannot mix row entries after "
+                             "streamed columnar blocks")
         if self._last_key is not None and key < self._last_key:
             raise ValueError("keys must be added in sorted order")
         self._last_key = key

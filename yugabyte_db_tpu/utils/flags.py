@@ -144,6 +144,12 @@ DEFINE_RUNTIME("device_float_dtype", "auto",
                "exact via the scan kernel's int64 fixed-point "
                "accumulation); 'float32'/'float64' force one (tests use "
                "float32 to exercise the TPU-representative path on CPU).")
+DEFINE_RUNTIME("scan_group_strategy", "auto",
+               "Grouped-aggregate reduction strategy: 'segment' "
+               "(scatter-add segment_sum — fastest on CPU backends), "
+               "'unroll' (per-group masked tree reductions — pure VPU "
+               "code, no scatter, for TPU), or 'auto' (segment on cpu, "
+               "unroll elsewhere).")
 DEFINE_RUNTIME("tpu_min_rows_for_pushdown", 4096,
                "Scans smaller than this stay on the CPU path: point reads "
                "must never pay a device round-trip.")
