@@ -38,30 +38,45 @@ def best_of(fn, n, *args):
     return min(ts), out
 
 
-def probe_device(timeouts=(90, 180, 300)):
+def probe_device(timeouts=None):
     """Check the accelerator actually responds before committing the
     process to it (the tunneled TPU can wedge — a hung jax.devices()
     would otherwise hang the whole benchmark). Probed in a subprocess so
-    a hang can be killed; RETRIES with escalating timeouts because the
-    first contact can legitimately be slow, and the attempt log is
-    carried into the output JSON so a fallback is loud, not silent."""
+    a hang can be killed; RETRIES with escalating timeouts (first
+    contact + first compile can legitimately take minutes over the
+    tunnel), and the attempt log — including /dev/accel* device-node
+    state — is carried into the output JSON so a fallback is loud, not
+    silent. BENCH_PROBE_TIMEOUTS overrides (comma-separated seconds;
+    '0' skips probing and goes straight to CPU)."""
+    import glob
     import subprocess
-    attempts = []
+    env_t = os.environ.get("BENCH_PROBE_TIMEOUTS")
+    if env_t is not None:
+        timeouts = [int(x) for x in env_t.split(",") if x.strip()]
+        if timeouts == [0]:
+            return False, [{"skipped": "BENCH_PROBE_TIMEOUTS=0"}]
+    timeouts = timeouts or (120, 420)
+    accel = sorted(glob.glob("/dev/accel*")) or ["<none>"]
+    attempts = [{"dev_accel": accel,
+                 "jax_platforms_env": os.environ.get("JAX_PLATFORMS", "")}]
     for t in timeouts:
         t0 = time.time()
         try:
             r = subprocess.run(
                 [sys.executable, "-c",
                  "import jax, jax.numpy as jnp;"
-                 "print(float(jnp.ones((8, 8)).sum()))"],
+                 "d = jax.devices();"
+                 "print(float(jnp.ones((8, 8)).sum()), d[0])"],
                 timeout=t, capture_output=True)
             ok = r.returncode == 0
             err = (r.stderr or b"")[-300:].decode("utf-8", "replace") \
                 if not ok else ""
+            dev = (r.stdout or b"").decode("utf-8", "replace").strip()
         except subprocess.TimeoutExpired:
-            ok, err = False, f"hung past {t}s (killed)"
+            ok, err, dev = False, f"hung past {t}s (killed)", ""
         attempts.append({"timeout_s": t, "ok": ok,
                          "elapsed_s": round(time.time() - t0, 1),
+                         **({"device": dev} if ok else {}),
                          **({"error": err} if err else {})})
         if ok:
             return True, attempts

@@ -1,7 +1,13 @@
 #!/bin/sh
-# Build the native storage library (see ybtpu_native.cpp).
+# Build both native libraries into their HOST-FINGERPRINTED paths
+# (yugabyte_db_tpu/hostfp.py): .so files built on one machine must never
+# load on another (-march=native code SIGILLs on older CPUs). The Python
+# loaders auto-build on first import; this script just forces it now.
 set -e
-cd "$(dirname "$0")"
-g++ -O3 -march=native -std=c++17 -shared -fPIC \
-    ybtpu_native.cpp -o libybtpu_native.so
-echo "built $(pwd)/libybtpu_native.so"
+cd "$(dirname "$0")/.."
+python - <<'PYEOF'
+from yugabyte_db_tpu.storage import native_lib
+from yugabyte_db_tpu.docdb import hotpath
+print("native_lib:", "ok" if native_lib.available() else "FAILED", native_lib._SO)
+print("hotpath   :", "ok" if hotpath.load() else "FAILED", hotpath._SO)
+PYEOF

@@ -54,10 +54,14 @@ if _os.environ.get("YBTPU_PLATFORM"):
 
 # Persistent XLA compilation cache: TPU sort/scan kernels are expensive to
 # compile (tens of seconds over the tunnel); cache them across processes.
+# Namespaced by host fingerprint — repo snapshots move between machines,
+# and code compiled for another CPU's feature set can SIGILL (hostfp.py).
+from .hostfp import host_fingerprint as _host_fp  # noqa: E402
+
 _cache_dir = _os.environ.get(
     "YBTPU_COMPILE_CACHE",
     _os.path.join(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
-                  ".jax_cache"))
+                  ".jax_cache", _host_fp()))
 try:
     _jax.config.update("jax_compilation_cache_dir", _cache_dir)
     _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -16,7 +16,11 @@ _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__)))), "native")
 _SRC = os.path.join(_NATIVE_DIR, "ybtpu_hot.c")
-_SO = os.path.join(_NATIVE_DIR, "ybtpu_hot.so")
+# host-fingerprinted: a .so built on another machine must never load
+# (repo snapshots travel across hosts; see hostfp.py)
+from ..hostfp import host_fingerprint as _host_fp  # noqa: E402
+
+_SO = os.path.join(_NATIVE_DIR, f"ybtpu_hot.{_host_fp()}.so")
 
 _MOD = None
 _TRIED = False

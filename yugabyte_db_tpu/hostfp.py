@@ -1,0 +1,44 @@
+"""Host fingerprint for compiled-artifact cache keys.
+
+Compiled artifacts — the persistent XLA compilation cache and the
+auto-built native .so files — are only valid on hosts with the same CPU
+feature set. Benchmark/CI environments snapshot the repo directory
+(including ignored build products) across machines, and loading code
+compiled for another host ranges from silent slowdowns to SIGILL (the
+r03 bench tail warned exactly this). Keying every artifact path by a
+hash of the CPU identity makes a foreign artifact invisible rather than
+load-then-crash: the new host just rebuilds into its own namespace.
+
+Stdlib-only and import-cycle-free: this must be importable from the
+package __init__ before jax configuration.
+"""
+from __future__ import annotations
+
+import hashlib
+import platform
+
+_FP: str | None = None
+
+
+def host_fingerprint() -> str:
+    """Short stable hash of (arch, CPU model, CPU feature flags)."""
+    global _FP
+    if _FP is None:
+        parts = [platform.machine(), platform.system()]
+        try:
+            with open("/proc/cpuinfo") as f:
+                seen = set()
+                for line in f:
+                    key = line.split(":", 1)[0].strip()
+                    # one "model name" + one "flags" line covers the
+                    # feature set the compilers specialize for
+                    if key in ("model name", "flags") and key not in seen:
+                        seen.add(key)
+                        parts.append(line.strip())
+                    if len(seen) == 2:
+                        break
+        except OSError:
+            pass            # non-Linux: arch alone still partitions
+        _FP = hashlib.blake2b(
+            "\n".join(parts).encode(), digest_size=6).hexdigest()
+    return _FP

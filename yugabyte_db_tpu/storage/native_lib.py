@@ -19,7 +19,11 @@ _TRIED = False
 _NATIVE_DIR = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     "native")
-_SO = os.path.join(_NATIVE_DIR, "libybtpu_native.so")
+# host-fingerprinted: a .so built on another machine must never load
+# (repo snapshots travel across hosts; see hostfp.py)
+from ..hostfp import host_fingerprint as _host_fp  # noqa: E402
+
+_SO = os.path.join(_NATIVE_DIR, f"libybtpu_native.{_host_fp()}.so")
 
 _u8p = ctypes.POINTER(ctypes.c_uint8)
 _u64p = ctypes.POINTER(ctypes.c_uint64)
