@@ -52,7 +52,10 @@ def probe_device(timeouts=None):
     import subprocess
     env_t = os.environ.get("BENCH_PROBE_TIMEOUTS")
     if env_t is not None:
-        timeouts = [int(x) for x in env_t.split(",") if x.strip()]
+        try:
+            timeouts = [int(x) for x in env_t.split(",") if x.strip()]
+        except ValueError:
+            timeouts = None     # malformed: keep the defaults
         if timeouts == [0]:
             return False, [{"skipped": "BENCH_PROBE_TIMEOUTS=0"}]
     timeouts = timeouts or (120, 420)

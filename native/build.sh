@@ -1,13 +1,21 @@
 #!/bin/sh
 # Build both native libraries into their HOST-FINGERPRINTED paths
-# (yugabyte_db_tpu/hostfp.py): .so files built on one machine must never
-# load on another (-march=native code SIGILLs on older CPUs). The Python
-# loaders auto-build on first import; this script just forces it now.
+# (yugabyte_db_tpu/hostfp.py): .so files are compiled -march=native, so
+# one built on another machine must never load here. The Python loaders
+# auto-build on first import; this script forces it now and FAILS LOUD.
 set -e
 cd "$(dirname "$0")/.."
-python - <<'PYEOF'
+"${PYTHON:-python3}" - <<'PYEOF'
+import sys
 from yugabyte_db_tpu.storage import native_lib
 from yugabyte_db_tpu.docdb import hotpath
-print("native_lib:", "ok" if native_lib.available() else "FAILED", native_lib._SO)
-print("hotpath   :", "ok" if hotpath.load() else "FAILED", hotpath._SO)
+ok1 = native_lib.available()
+ok2 = hotpath.load() is not None
+print("native_lib:", "ok" if ok1 else "FAILED", native_lib._SO)
+if not ok1 and native_lib.last_build_error:
+    print(native_lib.last_build_error, file=sys.stderr)
+print("hotpath   :", "ok" if ok2 else "FAILED", hotpath._SO)
+if not ok2 and hotpath.last_build_error:
+    print(hotpath.last_build_error, file=sys.stderr)
+sys.exit(0 if (ok1 and ok2) else 1)
 PYEOF

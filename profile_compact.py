@@ -1,0 +1,47 @@
+"""Profile device vs native compaction (throwaway)."""
+import os, tempfile, time
+os.environ.setdefault("YBTPU_PLATFORM", "cpu")
+import numpy as np
+from yugabyte_db_tpu.models.tpch import generate_lineitem, LineitemTable
+from yugabyte_db_tpu.utils.hybrid_time import HybridTime
+from yugabyte_db_tpu.utils import flags
+
+data = generate_lineitem(float(os.environ.get("BENCH_SF", "1.0")))
+n = len(data["rowid"])
+n_ssts = int(os.environ.get("N_SSTS", "100"))
+rows_per = int(os.environ.get("ROWS_PER", "20000"))
+
+
+def make(tag):
+    t = LineitemTable(tempfile.mkdtemp(prefix=f"comp-{tag}-"),
+                      num_tablets=1).tablets[0]
+    base_us = int(time.time() * 1e6)
+    for i in range(n_ssts):
+        fresh = (i * rows_per) % max(n - rows_per, 1)
+        sel = np.arange(fresh, fresh + rows_per) % n
+        if i > 0:
+            prev = (sel - rows_per // 4) % n
+            sel[: rows_per // 4] = prev[: rows_per // 4]
+        batch = {k: v[sel] for k, v in data.items()}
+        t.bulk_load(batch, ht=HybridTime.from_micros(base_us + i * 1000))
+    return t
+
+for backend, flag in (("device", True), ("native", False)):
+    t = make(backend)
+    total = t.approximate_size()
+    flags.set_flag("tpu_compaction_enabled", flag)
+    t0 = time.perf_counter()
+    t.compact()
+    dt = time.perf_counter() - t0
+    print(f"{backend}: {total/1e6:.1f} MB in {dt:.2f}s = "
+          f"{total/1e6/dt:.1f} MB/s")
+flags.REGISTRY.reset("tpu_compaction_enabled")
+
+# phase breakdown for the device path
+import cProfile, pstats
+t = make("prof")
+flags.set_flag("tpu_compaction_enabled", True)
+pr = cProfile.Profile(); pr.enable()
+t.compact()
+pr.disable()
+pstats.Stats(pr).sort_stats("cumulative").print_stats(18)

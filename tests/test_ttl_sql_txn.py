@@ -46,10 +46,13 @@ class TestTtl:
             flags.REGISTRY.reset("tpu_compaction_enabled")
         assert sum(1 for _ in t.regular.iterate()) == 0
 
-    @pytest.mark.parametrize("device", [True, False])
-    def test_compaction_gcs_expired_device_path(self, tmp_path, device):
-        """TTL GC through tpu_compact both ways (device kernel + native
-        merge): mixed expired / live / no-TTL rows, multiple SSTs."""
+    @pytest.mark.parametrize("backend", ["device", "native"])
+    def test_compaction_gcs_expired_device_path(self, tmp_path, backend):
+        """TTL GC through tpu_compact both ways (device sort kernel and
+        native/feed merge — driven directly since Tablet cost-routes
+        away from the device kernel on CPU-only backends): mixed
+        expired / live / no-TTL rows, multiple SSTs."""
+        from yugabyte_db_tpu.docdb.compaction import tpu_compact
         clock = HybridClock(MockPhysicalClock(1_000_000))
         t = Tablet("ttl-3", make_info(), str(tmp_path), clock=clock)
         t.apply_write(WriteRequest("t1", [
@@ -61,17 +64,31 @@ class TestTtl:
             RowOp("upsert", {"k": 3, "v": 3.0, "s": "live"},
                   ttl_ms=10_000_000_000)]))
         t.flush()
-        from yugabyte_db_tpu.utils import flags
-        flags.set_flag("tpu_compaction_enabled", device)
-        try:
-            t.compact()
-        finally:
-            flags.REGISTRY.reset("tpu_compaction_enabled")
+        tpu_compact(t.regular, t.codec, t.history_cutoff(),
+                    backend=backend)
         keys = sorted(r["k"] for r in
                       t.read(ReadRequest("t1", columns=("k", "s"))).rows)
         assert keys == [2, 3]
         # the expired row's versions are physically gone
         assert sum(1 for _ in t.regular.iterate()) == 2
+
+    def test_tablet_compact_cost_routes_ttl(self, tmp_path):
+        """Through the Tablet surface (flag on, CPU backend): TTL rows
+        are still GC'd — routing must never lose the expiry rule."""
+        clock = HybridClock(MockPhysicalClock(1_000_000))
+        t = Tablet("ttl-4", make_info(), str(tmp_path), clock=clock)
+        t.apply_write(WriteRequest("t1", [
+            RowOp("upsert", {"k": 1, "v": 1.0, "s": "dead"},
+                  ttl_ms=1000)]))
+        t.flush()
+        clock._physical.advance_micros(3_000_000_000)
+        from yugabyte_db_tpu.utils import flags
+        flags.set_flag("tpu_compaction_enabled", True)
+        try:
+            t.compact()
+        finally:
+            flags.REGISTRY.reset("tpu_compaction_enabled")
+        assert sum(1 for _ in t.regular.iterate()) == 0
 
 
 class TestSqlTxn:

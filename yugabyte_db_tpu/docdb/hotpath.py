@@ -26,17 +26,29 @@ _MOD = None
 _TRIED = False
 
 
+last_build_error: Optional[str] = None
+
+
 def _build() -> bool:
+    global last_build_error
     if not os.path.exists(_SRC):
+        last_build_error = f"source missing: {_SRC}"
         return False
     inc = sysconfig.get_paths()["include"]
     try:
+        # -march=native is safe: the output path is host-fingerprinted,
+        # so this .so can never load on a different CPU
         subprocess.run(
-            ["g++", "-O3", "-shared", "-fPIC", f"-I{inc}", _SRC,
-             "-o", _SO],
+            ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+             f"-I{inc}", _SRC, "-o", _SO],
             check=True, capture_output=True, timeout=120)
         return True
-    except Exception:
+    except subprocess.CalledProcessError as e:
+        last_build_error = (e.stderr or b"")[-2000:].decode(
+            "utf-8", "replace")
+        return False
+    except Exception as e:  # noqa: BLE001 — import-time must not raise
+        last_build_error = repr(e)
         return False
 
 

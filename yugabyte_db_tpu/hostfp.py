@@ -25,18 +25,19 @@ def host_fingerprint() -> str:
     global _FP
     if _FP is None:
         parts = [platform.machine(), platform.system()]
+        # one line per key covers the feature set compilers specialize
+        # for: x86 exposes "model name"/"flags"; ARM exposes
+        # "CPU implementer"/"CPU part"/"Features" instead
+        want = ("model name", "flags", "Features", "CPU part",
+                "CPU implementer")
         try:
             with open("/proc/cpuinfo") as f:
                 seen = set()
                 for line in f:
                     key = line.split(":", 1)[0].strip()
-                    # one "model name" + one "flags" line covers the
-                    # feature set the compilers specialize for
-                    if key in ("model name", "flags") and key not in seen:
+                    if key in want and key not in seen:
                         seen.add(key)
                         parts.append(line.strip())
-                    if len(seen) == 2:
-                        break
         except OSError:
             pass            # non-Linux: arch alone still partitions
         _FP = hashlib.blake2b(

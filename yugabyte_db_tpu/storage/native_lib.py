@@ -30,17 +30,29 @@ _u64p = ctypes.POINTER(ctypes.c_uint64)
 _i64p = ctypes.POINTER(ctypes.c_int64)
 
 
+last_build_error: Optional[str] = None
+
+
 def _build() -> bool:
+    global last_build_error
     src = os.path.join(_NATIVE_DIR, "ybtpu_native.cpp")
     if not os.path.exists(src):
+        last_build_error = f"source missing: {src}"
         return False
     try:
+        # -march=native is safe: the output path is host-fingerprinted,
+        # so this .so can never load on a different CPU
         subprocess.run(
-            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", src,
-             "-o", _SO],
+            ["g++", "-O3", "-march=native", "-std=c++17", "-shared",
+             "-fPIC", src, "-o", _SO],
             check=True, capture_output=True, timeout=120)
         return True
-    except Exception:
+    except subprocess.CalledProcessError as e:
+        last_build_error = (e.stderr or b"")[-2000:].decode(
+            "utf-8", "replace")
+        return False
+    except Exception as e:  # noqa: BLE001 — import-time must not raise
+        last_build_error = repr(e)
         return False
 
 
