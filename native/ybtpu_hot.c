@@ -137,17 +137,10 @@ fixed_value(const ColPlan *c, Py_ssize_t pos)
     }
 }
 
-/* extract(pos) -> dict */
+/* core row materialization shared by extract() and PointReader */
 static PyObject *
-Extractor_extract(Extractor *self, PyObject *arg)
+extract_row(Extractor *self, Py_ssize_t pos)
 {
-    Py_ssize_t pos = PyLong_AsSsize_t(arg);
-    if (pos == -1 && PyErr_Occurred())
-        return NULL;
-    if (pos < 0 || pos >= self->nrows) {
-        PyErr_Format(PyExc_IndexError, "row %zd out of range", pos);
-        return NULL;
-    }
     PyObject *out = _PyDict_NewPresized(self->ncols);
     if (!out) return NULL;
     for (Py_ssize_t i = 0; i < self->ncols; i++) {
@@ -175,6 +168,20 @@ Extractor_extract(Extractor *self, PyObject *arg)
         Py_DECREF(v);
     }
     return out;
+}
+
+/* extract(pos) -> dict */
+static PyObject *
+Extractor_extract(Extractor *self, PyObject *arg)
+{
+    Py_ssize_t pos = PyLong_AsSsize_t(arg);
+    if (pos == -1 && PyErr_Occurred())
+        return NULL;
+    if (pos < 0 || pos >= self->nrows) {
+        PyErr_Format(PyExc_IndexError, "row %zd out of range", pos);
+        return NULL;
+    }
+    return extract_row(self, pos);
 }
 
 static PyMethodDef Extractor_methods[] = {
@@ -492,21 +499,21 @@ BlockFinder_new(PyTypeObject *type, PyObject *args, PyObject *kwds)
     return (PyObject *)self;
 }
 
-static PyObject *
-BlockFinder_find(BlockFinder *self, PyObject *args)
+/* in-block newest-visible walk shared by find() and PointReader.
+ * Returns: 1 found (pos/ht/wid/tomb set), 2 restart (ht set),
+ * 0 nothing visible here. */
+static int
+blockfinder_walk(BlockFinder *self, const uint8_t *pp, Py_ssize_t plen_real,
+                 uint64_t read_ht, int64_t restart_hi,
+                 Py_ssize_t *out_pos, uint64_t *out_ht, uint32_t *out_wid,
+                 int *out_tomb)
 {
-    Py_buffer prefix;
-    unsigned long long read_ht;
-    long long restart_hi;
-    if (!PyArg_ParseTuple(args, "y*KL", &prefix, &read_ht, &restart_hi))
-        return NULL;
     const uint8_t *keys = (const uint8_t *)self->keys.buf;
     const uint64_t *hts = (const uint64_t *)self->ht.buf;
     const uint32_t *wids = (const uint32_t *)self->wid.buf;
     const uint8_t *tombs = (const uint8_t *)self->tomb.buf;
     Py_ssize_t W = self->width, n = self->n;
-    Py_ssize_t plen = prefix.len < W ? prefix.len : W;
-    const uint8_t *pp = (const uint8_t *)prefix.buf;
+    Py_ssize_t plen = plen_real < W ? plen_real : W;
 
     /* lower_bound over W-wide rows for the zero-padded probe: compare
      * the first plen bytes, then the probe's zero padding is <= any
@@ -518,29 +525,50 @@ BlockFinder_find(BlockFinder *self, PyObject *args)
         if (c < 0) lo = mid + 1;
         else hi = mid;
     }
-    Py_ssize_t real_plen = prefix.len;
     for (Py_ssize_t pos = lo; pos < n; pos++) {
         const uint8_t *row = keys + pos * W;
         /* rows are full keys (doc key + HT suffix), width >= prefix
          * when the block holds this doc key; a shorter matrix cannot
          * contain it */
-        if (real_plen > W || memcmp(row, pp, real_plen) != 0)
+        if (plen_real > W || memcmp(row, pp, plen_real) != 0)
             break;
         uint64_t ht = hts[pos];
         if (ht > read_ht) {
             if (restart_hi >= 0 && ht <= (uint64_t)restart_hi) {
-                PyBuffer_Release(&prefix);
-                return PyLong_FromUnsignedLongLong(ht);
+                *out_ht = ht;
+                return 2;
             }
             continue;
         }
-        PyObject *r = Py_BuildValue(
-            "nKIi", pos, ht, (unsigned int)wids[pos],
-            (int)(tombs[pos] != 0));
-        PyBuffer_Release(&prefix);
-        return r;
+        *out_pos = pos;
+        *out_ht = ht;
+        *out_wid = wids[pos];
+        *out_tomb = tombs[pos] != 0;
+        return 1;
     }
+    return 0;
+}
+
+static PyObject *
+BlockFinder_find(BlockFinder *self, PyObject *args)
+{
+    Py_buffer prefix;
+    unsigned long long read_ht;
+    long long restart_hi;
+    if (!PyArg_ParseTuple(args, "y*KL", &prefix, &read_ht, &restart_hi))
+        return NULL;
+    Py_ssize_t pos = 0;
+    uint64_t ht = 0;
+    uint32_t wid = 0;
+    int tomb = 0;
+    int rc = blockfinder_walk(self, (const uint8_t *)prefix.buf,
+                              prefix.len, read_ht, restart_hi,
+                              &pos, &ht, &wid, &tomb);
     PyBuffer_Release(&prefix);
+    if (rc == 2)
+        return PyLong_FromUnsignedLongLong(ht);
+    if (rc == 1)
+        return Py_BuildValue("nKIi", pos, ht, (unsigned int)wid, tomb);
     Py_RETURN_NONE;
 }
 
@@ -560,6 +588,237 @@ static PyTypeObject BlockFinderType = {
     .tp_doc = "fused columnar-block point lookup (search + MVCC walk)",
     .tp_methods = BlockFinder_methods,
     .tp_new = BlockFinder_new,
+};
+
+/* ---------------------------------------------------------------------
+ * PointReader — whole-SST batched point lookup: bloom probe + block
+ * bisect + the BlockFinder walk + Extractor row materialization for a
+ * LIST of encoded doc-key prefixes in ONE C call (reference analog:
+ * MultiGet batching over BlockBasedTable::Get,
+ * src/yb/rocksdb/db/db_impl.cc, driven by pggate operation buffering,
+ * src/yb/yql/pggate/pg_operation_buffer.cc).
+ *
+ * find_many(prefixes, read_ht, restart_hi) returns a list, one entry
+ * per prefix:
+ *   (ht, wid, dict|None) — newest visible version in this SST (dict is
+ *                          None for a tombstone: it must still win the
+ *                          cross-SST merge)
+ *   int                  — restart: a version in (read_ht, restart_hi]
+ *   None                 — no visible version in this SST
+ *   NotImplemented       — this key needs the Python path here (block
+ *                          without a finder/extractor)
+ */
+typedef struct {
+    PyObject_HEAD
+    Py_ssize_t nblocks;
+    PyObject *firsts;       /* tuple of bytes (owned) */
+    PyObject *lasts;        /* tuple of bytes (owned) */
+    PyObject *finders;      /* tuple of BlockFinder|None (owned) */
+    PyObject *extractors;   /* tuple of Extractor|None (owned) */
+    Py_buffer bloom;        /* bloom bit array; absent when bloom_k==0 */
+    int bloom_k;
+    int has_bloom;
+} PointReader;
+
+static void
+PointReader_dealloc(PointReader *self)
+{
+    Py_XDECREF(self->firsts);
+    Py_XDECREF(self->lasts);
+    Py_XDECREF(self->finders);
+    Py_XDECREF(self->extractors);
+    if (self->has_bloom) PyBuffer_Release(&self->bloom);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+/* PointReader(firsts, lasts, finders, extractors, bloom_bits|None, k) */
+static PyObject *
+PointReader_new(PyTypeObject *type, PyObject *args, PyObject *kwds)
+{
+    PyObject *firsts, *lasts, *finders, *extractors, *bloom;
+    int k;
+    if (!PyArg_ParseTuple(args, "OOOOOi", &firsts, &lasts, &finders,
+                          &extractors, &bloom, &k))
+        return NULL;
+    if (!PyTuple_Check(firsts) || !PyTuple_Check(lasts) ||
+        !PyTuple_Check(finders) || !PyTuple_Check(extractors)) {
+        PyErr_SetString(PyExc_TypeError, "expected tuples");
+        return NULL;
+    }
+    Py_ssize_t n = PyTuple_GET_SIZE(firsts);
+    if (PyTuple_GET_SIZE(lasts) != n || PyTuple_GET_SIZE(finders) != n ||
+        PyTuple_GET_SIZE(extractors) != n) {
+        PyErr_SetString(PyExc_ValueError, "length mismatch");
+        return NULL;
+    }
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if (!PyBytes_Check(PyTuple_GET_ITEM(firsts, i)) ||
+            !PyBytes_Check(PyTuple_GET_ITEM(lasts, i))) {
+            PyErr_SetString(PyExc_TypeError, "keys must be bytes");
+            return NULL;
+        }
+        PyObject *f = PyTuple_GET_ITEM(finders, i);
+        PyObject *e = PyTuple_GET_ITEM(extractors, i);
+        if ((f != Py_None && !PyObject_TypeCheck(f, &BlockFinderType)) ||
+            (e != Py_None && !PyObject_TypeCheck(e, &ExtractorType))) {
+            PyErr_SetString(PyExc_TypeError,
+                            "finders/extractors type mismatch");
+            return NULL;
+        }
+    }
+    PointReader *self = (PointReader *)type->tp_alloc(type, 0);
+    if (!self) return NULL;
+    self->nblocks = n;
+    self->firsts = firsts; Py_INCREF(firsts);
+    self->lasts = lasts; Py_INCREF(lasts);
+    self->finders = finders; Py_INCREF(finders);
+    self->extractors = extractors; Py_INCREF(extractors);
+    self->bloom_k = k;
+    self->has_bloom = 0;
+    if (bloom != Py_None && k > 0) {
+        if (PyObject_GetBuffer(bloom, &self->bloom, PyBUF_SIMPLE) < 0) {
+            Py_DECREF(self);
+            return NULL;
+        }
+        self->has_bloom = 1;
+    }
+    return (PyObject *)self;
+}
+
+/* bytes-vs-prefix lexicographic compare (memcmp + length tiebreak) */
+static inline int
+bytes_cmp(const uint8_t *a, Py_ssize_t an, const uint8_t *b, Py_ssize_t bn)
+{
+    Py_ssize_t m = an < bn ? an : bn;
+    int c = memcmp(a, b, m);
+    if (c) return c;
+    return (an > bn) - (an < bn);
+}
+
+/* one key through this SST; returns new ref or NULL on error */
+static PyObject *
+pointreader_find_one(PointReader *self, const uint8_t *pp, Py_ssize_t plen,
+                     uint64_t read_ht, int64_t restart_hi)
+{
+    if (self->has_bloom) {
+        uint64_t h = 0xCBF29CE484222325ULL;
+        for (Py_ssize_t i = 0; i < plen; i++)
+            h = (h ^ pp[i]) * 0x100000001B3ULL;
+        uint64_t m = (uint64_t)self->bloom.len * 8;
+        const uint8_t *bb = (const uint8_t *)self->bloom.buf;
+        uint64_t h2 = (h >> 33) | 1;
+        for (int i = 0; i < self->bloom_k; i++) {
+            uint64_t idx = (h + (uint64_t)i * h2) % m;
+            if (!((bb[idx >> 3] >> (idx & 7)) & 1))
+                Py_RETURN_NONE;
+        }
+    }
+    /* bisect_right(firsts, prefix) - 1, clamped to 0 */
+    Py_ssize_t lo = 0, hi = self->nblocks;
+    while (lo < hi) {
+        Py_ssize_t mid = (lo + hi) >> 1;
+        PyObject *fk = PyTuple_GET_ITEM(self->firsts, mid);
+        if (bytes_cmp((const uint8_t *)PyBytes_AS_STRING(fk),
+                      PyBytes_GET_SIZE(fk), pp, plen) <= 0)
+            lo = mid + 1;
+        else
+            hi = mid;
+    }
+    Py_ssize_t b = lo > 0 ? lo - 1 : 0;
+    for (; b < self->nblocks; b++) {
+        PyObject *fko = PyTuple_GET_ITEM(self->firsts, b);
+        const uint8_t *fk = (const uint8_t *)PyBytes_AS_STRING(fko);
+        Py_ssize_t fkn = PyBytes_GET_SIZE(fko);
+        if (bytes_cmp(fk, fkn, pp, plen) > 0 &&
+            !(fkn >= plen && memcmp(fk, pp, plen) == 0))
+            Py_RETURN_NONE;      /* block starts past the doc key */
+        PyObject *lko = PyTuple_GET_ITEM(self->lasts, b);
+        const uint8_t *lk = (const uint8_t *)PyBytes_AS_STRING(lko);
+        Py_ssize_t lkn = PyBytes_GET_SIZE(lko);
+        if (bytes_cmp(lk, lkn, pp, plen) < 0)
+            continue;            /* block ends before the doc key */
+        PyObject *fo = PyTuple_GET_ITEM(self->finders, b);
+        PyObject *eo = PyTuple_GET_ITEM(self->extractors, b);
+        if (fo == Py_None || eo == Py_None) {
+            Py_INCREF(Py_NotImplemented);   /* python fallback */
+            return Py_NotImplemented;
+        }
+        Py_ssize_t pos = 0;
+        uint64_t ht = 0;
+        uint32_t wid = 0;
+        int tomb = 0;
+        int rc = blockfinder_walk((BlockFinder *)fo, pp, plen, read_ht,
+                                  restart_hi, &pos, &ht, &wid, &tomb);
+        if (rc == 2)
+            return PyLong_FromUnsignedLongLong(ht);
+        if (rc == 1) {
+            PyObject *row;
+            if (tomb) {
+                row = Py_None; Py_INCREF(row);
+            } else {
+                row = extract_row((Extractor *)eo, pos);
+                if (!row) return NULL;
+            }
+            PyObject *r = Py_BuildValue("KIN", ht, (unsigned int)wid,
+                                        row);
+            return r;
+        }
+        /* nothing visible here; the doc key's versions continue into
+         * the next block only when they run through this block's last
+         * key */
+        if (lkn >= plen && memcmp(lk, pp, plen) == 0)
+            continue;
+        Py_RETURN_NONE;
+    }
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+PointReader_find_many(PointReader *self, PyObject *args)
+{
+    PyObject *prefixes;
+    unsigned long long read_ht;
+    long long restart_hi;
+    if (!PyArg_ParseTuple(args, "OKL", &prefixes, &read_ht, &restart_hi))
+        return NULL;
+    if (!PyList_Check(prefixes)) {
+        PyErr_SetString(PyExc_TypeError, "prefixes must be a list");
+        return NULL;
+    }
+    Py_ssize_t n = PyList_GET_SIZE(prefixes);
+    PyObject *out = PyList_New(n);
+    if (!out) return NULL;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *p = PyList_GET_ITEM(prefixes, i);
+        if (!PyBytes_Check(p)) {
+            PyErr_SetString(PyExc_TypeError, "prefix must be bytes");
+            Py_DECREF(out);
+            return NULL;
+        }
+        PyObject *r = pointreader_find_one(
+            self, (const uint8_t *)PyBytes_AS_STRING(p),
+            PyBytes_GET_SIZE(p), read_ht, restart_hi);
+        if (!r) { Py_DECREF(out); return NULL; }
+        PyList_SET_ITEM(out, i, r);
+    }
+    return out;
+}
+
+static PyMethodDef PointReader_methods[] = {
+    {"find_many", (PyCFunction)PointReader_find_many, METH_VARARGS,
+     "find_many(prefixes, read_ht, restart_hi) -> list"},
+    {NULL}
+};
+
+static PyTypeObject PointReaderType = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "ybtpu_hot.PointReader",
+    .tp_basicsize = sizeof(PointReader),
+    .tp_dealloc = (destructor)PointReader_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_doc = "whole-SST batched point lookup",
+    .tp_methods = PointReader_methods,
+    .tp_new = PointReader_new,
 };
 
 static PyMethodDef hot_methods[] = {
@@ -590,5 +849,9 @@ PyInit_ybtpu_hot(void)
     PyModule_AddObject(m, "Extractor", (PyObject *)&ExtractorType);
     Py_INCREF(&BlockFinderType);
     PyModule_AddObject(m, "BlockFinder", (PyObject *)&BlockFinderType);
+    if (PyType_Ready(&PointReaderType) < 0)
+        return NULL;
+    Py_INCREF(&PointReaderType);
+    PyModule_AddObject(m, "PointReader", (PyObject *)&PointReaderType);
     return m;
 }

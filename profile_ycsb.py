@@ -1,0 +1,22 @@
+"""Profile YCSB-C single vs batched point reads (throwaway)."""
+import os, tempfile, time, cProfile, pstats
+os.environ.setdefault("YBTPU_PLATFORM", "cpu")
+from yugabyte_db_tpu.models.ycsb import YcsbTabletWorkload, usertable_info
+from yugabyte_db_tpu.tablet import Tablet
+
+t = Tablet("ycsb", usertable_info(), tempfile.mkdtemp(prefix="ycsb-"))
+w = YcsbTabletWorkload(t, n_rows=100_000)
+w.load()
+w.run("c", ops=2000)
+for tag, kw in (("single", {}), ("batch16", {"clients": 16})):
+    best = 0
+    for _ in range(3):
+        r = w.run("c", ops=30000, **kw)
+        best = max(best, r.ops_per_sec)
+    print(f"{tag}: {best:.0f} ops/s")
+
+pr = cProfile.Profile()
+pr.enable()
+w.run("c", ops=30000)
+pr.disable()
+pstats.Stats(pr).sort_stats("cumulative").print_stats(22)

@@ -835,15 +835,53 @@ class DocReadOperation:
             return None
         return self.codec.decode_row(k, v)
 
+    def _native_best(self, prefixes: List[bytes], ssts, read_ht: int,
+                     restart_hi):
+        """Cross-SST merge of PointReader.find_many results: one C call
+        per SST does bloom+bisect+MVCC-walk+extract for the whole key
+        list. Returns (best, slow) where best[i] is the winning
+        (ht, wid, row dict|None-for-tombstone) and slow is the set of
+        key indices needing the per-key Python path (non-columnar
+        blocks) — or None when any SST lacks a native reader."""
+        readers = []
+        for r in ssts:
+            pr = r.point_reader(self.codec)
+            if pr is None:
+                return None
+            readers.append(pr)
+        n = len(prefixes)
+        best: List = [None] * n
+        slow: set = set()
+        rh = -1 if restart_hi is None else restart_hi
+        for pr in readers:
+            for i, got in enumerate(pr.find_many(prefixes, read_ht, rh)):
+                if got is None:
+                    continue
+                if got is NotImplemented:
+                    slow.add(i)
+                    continue
+                if isinstance(got, int):
+                    raise ReadRestartError(got)
+                b = best[i]
+                if b is None or got[:2] > b[:2]:
+                    best[i] = got
+        return best, slow
+
     def get_row(self, pk_row: Dict[str, object], read_ht: int
                 ) -> Optional[Dict[str, object]]:
         """Newest visible version across memtable + SSTs, using per-SST
-        bloom filters and the native fused block lookup (reference:
+        bloom filters and the native fused whole-SST lookup (reference:
         DocDBTableReader point-get over BlockBasedTable::Get)."""
         prefix = self.codec.doc_key_prefix(pk_row)
         restart_hi = (read_ht + _skew_window_ht()
                       if self._allow_restart else None)
         mems, ssts = self.store.read_snapshot()
+        if all(m.empty() for m in mems):
+            got = self._native_best([prefix], ssts, read_ht, restart_hi)
+            if got is not None:
+                best, slow = got
+                if not slow:
+                    return best[0][2] if best[0] is not None else None
         best = self._find_best(prefix, read_ht, restart_hi, mems, ssts)
         if best is None:
             return None
@@ -855,20 +893,37 @@ class DocReadOperation:
         """Batched point lookups: one snapshot, one restart window, one
         result list — the server-side batching seam concurrent sessions
         share (reference analog: operation buffering in pggate,
-        src/yb/yql/pggate/pg_operation_buffer.cc, and doc_op batched
-        reads). Per-op request/clock/metric overhead amortizes across
-        the batch; the per-key work is the native encode+find+extract
-        path."""
+        src/yb/yql/pggate/pg_operation_buffer.cc, and MultiGet-style
+        batched reads). The whole batch runs in ONE C call per SST
+        (PointReader.find_many: bloom + block bisect + MVCC walk + row
+        materialization); only keys touching non-columnar blocks or
+        non-empty memtables take the per-key Python path."""
         restart_hi = (read_ht + _skew_window_ht()
                       if allow_restart else None)
         mems, ssts = self.store.read_snapshot()
         prefix_of = self.codec.doc_key_prefix
+        prefixes = [prefix_of(r) for r in pk_rows]
+        n = len(prefixes)
+        got = None
+        if all(m.empty() for m in mems):
+            # writes in flight would need a per-key memtable merge —
+            # then the per-key path below is the whole story
+            got = self._native_best(prefixes, ssts, read_ht, restart_hi)
+        if got is None:
+            best: List = [None] * n
+            slow = set(range(n))
+        else:
+            best, slow = got
         out: List[Optional[Dict[str, object]]] = []
-        for pk_row in pk_rows:
-            best = self._find_best(prefix_of(pk_row), read_ht,
-                                   restart_hi, mems, ssts)
-            out.append(None if best is None
-                       else self._decode_best(best, read_ht))
+        for i in range(n):
+            if i in slow:
+                f = self._find_best(prefixes[i], read_ht, restart_hi,
+                                    mems, ssts)
+                out.append(None if f is None
+                           else self._decode_best(f, read_ht))
+            else:
+                b = best[i]
+                out.append(b[2] if b is not None else None)
         return out
 
     # ---- scans -----------------------------------------------------------

@@ -439,6 +439,7 @@ class SstReader:
         self._first_keys = [e.first_key for e in self.index]
         self._col_cache: dict = {}
         self._row_cache: dict = {}   # block idx -> decoded entries
+        self._point_readers: dict = {}   # codec -> native PointReader|None
 
     @property
     def file_size(self) -> int:
@@ -494,6 +495,53 @@ class SstReader:
 
     def may_contain_hash(self, key_hash: int) -> bool:
         return self.bloom.may_contain(key_hash)
+
+    def point_reader(self, codec):
+        """Native whole-SST batched point reader bound to `codec`
+        (native/ybtpu_hot.c PointReader): bloom probe + block bisect +
+        MVCC walk + row materialization for a LIST of doc-key prefixes
+        in one C call. None when the extension or any prerequisite is
+        unavailable — callers fall back to per-key point_find. Cached
+        per codec OBJECT (an ALTER creates a new codec; SSTs are
+        immutable so no other invalidation is needed)."""
+        cache = self._point_readers
+        pr = cache.get(codec, False)
+        if pr is not False:
+            return pr
+        hot = _hot_mod()
+        pr = None
+        # eager build deserializes and PINS every columnar block for the
+        # reader's lifetime — right for point-read-hot tablets, wrong
+        # for huge scan-oriented SSTs, so cap by total rows (the per-key
+        # fallback path pins only the blocks it visits)
+        from ..utils import flags as _flags
+        total_rows = sum(e.num_rows for e in self.index)
+        if total_rows > _flags.get("native_point_reader_max_rows"):
+            cache[codec] = None
+            return None
+        if hot is not None and hasattr(hot, "PointReader") and self.index:
+            try:
+                firsts, lasts, finders, extractors = [], [], [], []
+                for i, e in enumerate(self.index):
+                    cb = self.columnar_block(i)
+                    fnd = ext = None
+                    if cb is not None and cb.keys is not None:
+                        fnd = _native_finder(cb)
+                        ext = codec._native_extractor(cb)
+                    firsts.append(e.first_key)
+                    lasts.append(e.last_key)
+                    finders.append(fnd)
+                    extractors.append(ext)
+                bits = np.ascontiguousarray(self.bloom.bits) \
+                    if self.bloom is not None else None
+                pr = hot.PointReader(
+                    tuple(firsts), tuple(lasts), tuple(finders),
+                    tuple(extractors), bits,
+                    self.bloom.k if self.bloom is not None else 0)
+            except Exception:
+                pr = None
+        cache[codec] = pr
+        return pr
 
     def point_find(self, prefix: bytes, read_ht: int,
                    restart_hi: Optional[int] = None):

@@ -150,6 +150,11 @@ DEFINE_RUNTIME("scan_group_strategy", "auto",
                "'unroll' (per-group masked tree reductions — pure VPU "
                "code, no scatter, for TPU), or 'auto' (segment on cpu, "
                "unroll elsewhere).")
+DEFINE_RUNTIME("native_point_reader_max_rows", 4_000_000,
+               "SSTs above this row count skip the eager native "
+               "PointReader (it deserializes and pins every columnar "
+               "block); their point reads use the per-block path, which "
+               "pins only visited blocks.")
 DEFINE_RUNTIME("tpu_min_rows_for_pushdown", 4096,
                "Scans smaller than this stay on the CPU path: point reads "
                "must never pay a device round-trip.")
