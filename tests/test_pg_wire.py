@@ -168,3 +168,88 @@ class TestPgWire:
                 await srv.shutdown()
                 await mc.shutdown()
         run(go())
+
+    def test_binary_params_and_results(self, tmp_path):
+        """Extended protocol with BINARY parameter and result formats
+        (format code 1), the psycopg3-default mode: int8/float8/text
+        params arrive big-endian, results return binary when Bind's
+        result-format codes ask for it."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            srv = PgServer(mc.client())
+            addr = await srv.start()
+            try:
+                reader, writer = await asyncio.open_connection(*addr)
+                c = MiniPgClient(reader, writer)
+                await c.startup()
+                await c.query("CREATE TABLE bp (k bigint, v double, "
+                              "s text, PRIMARY KEY (k))")
+                await mc.wait_for_leaders("bp")
+
+                def parse(name, sql, ptypes=()):
+                    body = name.encode() + b"\x00" + sql.encode() + b"\x00"
+                    body += struct.pack(">H", len(ptypes))
+                    for t in ptypes:
+                        body += struct.pack(">I", t)
+                    return b"P" + struct.pack(">I", len(body) + 4) + body
+
+                def bind(portal, stmt, raws, pfmts, rfmts):
+                    body = portal.encode() + b"\x00" + stmt.encode() + b"\x00"
+                    body += struct.pack(f">H{len(pfmts)}H", len(pfmts),
+                                        *pfmts)
+                    body += struct.pack(">H", len(raws))
+                    for raw in raws:
+                        body += struct.pack(">i", len(raw)) + raw
+                    body += struct.pack(f">H{len(rfmts)}H", len(rfmts),
+                                        *rfmts)
+                    return b"B" + struct.pack(">I", len(body) + 4) + body
+
+                def execute(portal):
+                    body = portal.encode() + b"\x00" + struct.pack(">i", 0)
+                    return b"E" + struct.pack(">I", len(body) + 4) + body
+
+                sync = b"S" + struct.pack(">I", 4)
+                # binary int8 + float8 + text params (OIDs declared)
+                writer.write(parse("b1", "INSERT INTO bp (k, v, s) "
+                                         "VALUES ($1, $2, $3)",
+                                   (20, 701, 25)))
+                writer.write(bind("", "b1",
+                                  [struct.pack(">q", 42),
+                                   struct.pack(">d", 2.75),
+                                   b"bin"],
+                                  (1, 1, 1), ()))
+                writer.write(execute(""))
+                writer.write(sync)
+                await writer.drain()
+                msgs = await c.read_until(b"Z")
+                assert not any(t == b"E" for t, _ in msgs), msgs
+                # read back with BINARY results (one code applies to all)
+                writer.write(parse("b2", "SELECT k, v, s FROM bp "
+                                         "WHERE k = $1", (20,)))
+                writer.write(bind("", "b2", [struct.pack(">q", 42)],
+                                  (1,), (1,)))
+                writer.write(execute(""))
+                writer.write(sync)
+                await writer.drain()
+                msgs = await c.read_until(b"Z")
+                drow = next(b for t, b in msgs if t == b"D")
+                (n,) = struct.unpack_from(">H", drow)
+                assert n == 3
+                pos = 2
+                vals = []
+                for _ in range(n):
+                    (ln,) = struct.unpack_from(">i", drow, pos)
+                    pos += 4
+                    vals.append(drow[pos:pos + ln])
+                    pos += ln
+                assert struct.unpack(">q", vals[0])[0] == 42
+                assert struct.unpack(">d", vals[1])[0] == 2.75
+                assert vals[2] == b"bin"
+                # RowDescription carries format code 1
+                trow = next(b for t, b in msgs if t == b"T")
+                assert trow[-2:] == struct.pack(">h", 1)
+                writer.close()
+            finally:
+                await srv.shutdown()
+                await mc.shutdown()
+        run(go())

@@ -20,6 +20,7 @@ import numpy as np
 
 from ..client import YBClient
 from ..docdb.operations import ReadRequest, RowOp, eval_expr_py
+from ..utils import flags
 from ..docdb.table_codec import TableInfo
 from ..dockv.packed_row import ColumnSchema, ColumnType, TableSchema
 from ..dockv.partition import PartitionSchema
@@ -208,8 +209,10 @@ class SqlSession:
             dups = [j for j, o in enumerate(stmt.items)
                     if o[0] == "window" and o[1] == it[1]]
             return it[1] if len(dups) == 1 else f"{it[1]}_{idx}"
-        return (it[1] if it[0] == "col" else
-                _agg_name(it) if it[0] == "agg" else _expr_name(it[1]))
+        if it[0] == "col":
+            # PG semantics: SELECT a.attname projects as "attname"
+            return it[1].split(".", 1)[1] if "." in it[1] else it[1]
+        return (_agg_name(it) if it[0] == "agg" else _expr_name(it[1]))
 
     # max distinct-domain width eligible for device GROUP BY (one-hot
     # matmul columns scale with the domain product)
@@ -668,6 +671,13 @@ class SqlSession:
         return tuple(out)
 
     async def _select(self, stmt: SelectStmt) -> SqlResult:
+        if stmt.table is not None and not getattr(stmt, "joins", None):
+            # single-table FROM with an alias: SELECT e.name FROM emp e
+            # — strip the alias/table qualifier everywhere so binding
+            # sees bare schema names
+            quals = {q for q in (getattr(stmt, "table_alias", None),
+                                 stmt.table) if q}
+            _dequalify_stmt(stmt, quals)
         if getattr(stmt, "ctes", None):
             # WITH: materialize each CTE in order (later CTEs and the
             # outer query see earlier ones), scoped to this statement
@@ -699,6 +709,12 @@ class SqlSession:
             return await self._select_join(stmt)
         if stmt.table in self._cte_rows:
             return self._rows_select(stmt, self._cte_rows[stmt.table])
+        from .pg_catalog import is_virtual, rows_for
+        if is_virtual(stmt.table):
+            # pg_catalog / information_schema: materialized from the
+            # live catalog, then the normal row-select machinery
+            return self._rows_select(
+                stmt, await rows_for(stmt.table, self.client))
         from ..rpc.messenger import RpcError
         try:
             ct = await self.client._table(stmt.table)
@@ -956,27 +972,157 @@ class SqlSession:
     def _split_qual(name: str):
         return name.split(".", 1) if "." in name else (None, name)
 
+    def _join_pushdown(self, stmt: SelectStmt):
+        """Split the WHERE into per-table pushable conjuncts (reference:
+        pushdown classification in src/postgres .../ybplan.c). A
+        conjunct pushes to table T when every referenced column resolves
+        UNIQUELY to T — via a 'T.col' qualifier (alias-aware) or a bare
+        name found in exactly one joined real table — and T is not the
+        NULL-SUPPLYING side of any outer join (filtering that side
+        before the join changes which rows NULL-extend: WHERE sal IS
+        NULL over a RIGHT JOIN must see the real match set). Pushed
+        conjuncts stay in the residual too: NULL-extended rows must
+        still be filtered, and double evaluation of inner rows is
+        harmless."""
+        lbl0 = stmt.table_alias or stmt.table
+        tables = [lbl0] + [j.alias or j.table for j in stmt.joins]
+        nullable = set()
+        for j in stmt.joins:
+            jl = j.alias or j.table
+            if j.kind in ("right", "full"):
+                nullable.add(lbl0)
+                nullable.update(j2.alias or j2.table
+                                for j2 in stmt.joins if j2 is not j)
+            if j.kind in ("left", "full"):
+                nullable.add(jl)
+        per_table: Dict[str, list] = {}
+        if stmt.where is None:
+            return per_table
+
+        def conjuncts(n):
+            if isinstance(n, tuple) and n and n[0] == "and":
+                return conjuncts(n[1]) + conjuncts(n[2])
+            return [n]
+
+        def owner_of(names: set) -> Optional[str]:
+            owner = None
+            for name in names:
+                q, bare = self._split_qual(name)
+                cands = []
+                for t in tables:
+                    if q is not None and q != t:
+                        continue
+                    sch = self._join_schemas.get(t)
+                    if sch is None:
+                        # CTE/virtual/unknown: cannot prove ownership
+                        # of a bare name — only a qualifier decides
+                        if q == t:
+                            cands.append(t)
+                        elif q is None:
+                            return None
+                        continue
+                    try:
+                        sch.column_by_name(bare)
+                        cands.append(t)
+                    except Exception:  # noqa: BLE001 — not this table
+                        pass
+                if len(cands) != 1:
+                    return None
+                if owner is None:
+                    owner = cands[0]
+                elif owner != cands[0]:
+                    return None
+            return owner
+
+        for c in conjuncts(stmt.where):
+            names: set = set()
+            self._collect_names(c, names)
+            if not names:
+                continue
+            owner = owner_of(names)
+            if owner is not None and owner not in nullable \
+                    and self._join_schemas.get(owner) is not None:
+                per_table.setdefault(owner, []).append(
+                    _strip_qualifiers(c))
+        return per_table
+
     async def _select_join(self, stmt: SelectStmt) -> SqlResult:
-        """Hash join executed client-side (reference picks between
-        YB batched nested loop / hash joins in the PG planner; round-1
-        planner always hash-joins on the equi-key)."""
+        """Joins executed at the client tier, like the reference's PG
+        backend over pggate — but with the storage engine doing the
+        filtering: single-table WHERE conjuncts push into each side's
+        scan, and the inner side of an equi-join fetches by BATCHES of
+        join keys pushed down as IN-lists (reference:
+        src/postgres/src/backend/executor/nodeYbBatchedNestloop.c)
+        instead of materializing the whole table. Falls back to a full
+        inner fetch + hash join when the outer key set is large."""
         from ..docdb.operations import eval_expr_py
+        from .pg_catalog import is_virtual, rows_for
         if self._is_serializable():
             for tname in [stmt.table] + [j.table for j in stmt.joins]:
-                if tname in self._cte_rows:
+                if tname in self._cte_rows or is_virtual(tname):
                     continue   # materialized rows: nothing to lock
                 jct = await self.client._table(tname)
                 await self._lock_read_set(
                     tname, jct.info.schema, None, self._txn.start_ht)
-        # fetch whole tables (residual WHERE applies after the join);
-        # a name bound by the current WITH scope reads the CTE rowset
-        async def fetch(table):
+        # schemas of the REAL tables involved, keyed by their LABEL in
+        # the query text (alias when given); None for CTE/virtual
+        lbl0 = stmt.table_alias or stmt.table
+        pairs = [(lbl0, stmt.table)] + \
+            [(j.alias or j.table, j.table) for j in stmt.joins]
+        self._join_schemas = {}
+        real_of = {}
+        for label, tname in pairs:
+            real_of[label] = tname
+            sch = None
+            if tname not in self._cte_rows and not is_virtual(tname):
+                try:
+                    sch = (await self.client._table(tname)).info.schema
+                except Exception:  # noqa: BLE001 — resolved at fetch
+                    sch = None
+            self._join_schemas[label] = sch
+        pushed = self._join_pushdown(stmt)
+
+        # a name bound by the current WITH scope reads the CTE rowset;
+        # pg_catalog/information_schema names materialize virtual rows
+        async def fetch(label, extra=None):
+            table = real_of.get(label, label)
             if table in self._cte_rows:
                 return self._cte_rows[table]
-            resp = await self.client.scan(table, ReadRequest(""))
+            if is_virtual(table):
+                return await rows_for(table, self.client)
+            sch = self._join_schemas[label]
+            node = None
+            for c in pushed.get(label, ()):
+                node = c if node is None else ("and", node, c)
+            if extra is not None:
+                node = extra if node is None else ("and", node, extra)
+            where = self._bind(node, sch) if node is not None else None
+            resp = await self.client.scan(table,
+                                          ReadRequest("", where=where))
             return resp.rows
 
-        left_rows = await fetch(stmt.table)
+        async def fetch_inner(jc, label, keys):
+            """Batched-IN fetch of the join's inner side; None when the
+            key set is too large (caller full-scans instead)."""
+            if (jc.table in self._cte_rows or is_virtual(jc.table)
+                    or self._join_schemas[label] is None):
+                return None
+            keys = [k for k in keys if k is not None]
+            if len(keys) > flags.get("bnl_max_keys"):
+                return None
+            _, rcol = self._split_qual(jc.right_col)
+            try:
+                self._join_schemas[label].column_by_name(rcol)
+            except Exception:  # noqa: BLE001 — joined on expr/alias
+                return None
+            batch = flags.get("bnl_batch_size")
+            out = []
+            for i in range(0, len(keys), batch):
+                out.extend(await fetch(
+                    label, ("in", ("col", rcol), keys[i:i + batch])))
+            return out
+
+        left_rows = await fetch(lbl0)
         # qualify row dicts: {"t.col": v, "col": v (unqualified wins last)}
         def qualify(rows, tname):
             out = []
@@ -986,9 +1132,30 @@ class SqlSession:
                 out.append(q)
             return out
 
-        rows = qualify(left_rows, stmt.table)
+        rows = qualify(left_rows, lbl0)
         for jc in stmt.joins:
-            right_rows = qualify(await fetch(jc.table), jc.table)
+            jlabel = jc.alias or jc.table
+            right_rows = None
+            if jc.kind in ("inner", "left"):
+                # outer-key batches push down; dedup preserves order
+                lkey = self._split_qual(jc.left_col)[1]
+                keys = list(dict.fromkeys(
+                    lr.get(jc.left_col, lr.get(lkey)) for lr in rows))
+                right_rows = await fetch_inner(jc, jlabel, keys)
+            if right_rows is None:
+                right_rows = await fetch(jlabel)
+            right_rows = qualify(right_rows, jlabel)
+            # NULL-extension column set: when the (batched) inner fetch
+            # returned nothing, the schema still names the columns the
+            # outer rows must carry as NULLs
+            if right_rows:
+                right_cols = set(right_rows[0])
+            elif self._join_schemas.get(jlabel) is not None:
+                names = [c.name for c in
+                         self._join_schemas[jlabel].columns]
+                right_cols = {f"{jlabel}.{n}" for n in names} | set(names)
+            else:
+                right_cols = set()
             # build hash table on the right join key
             _, rcol = self._split_qual(jc.right_col)
             index: Dict[object, list] = {}
@@ -1009,7 +1176,7 @@ class SqlSession:
                         matched_right.add(id(rr))
                 elif jc.kind in ("left", "full"):
                     merged = dict(lr)
-                    for k in (right_rows[0] if right_rows else {}):
+                    for k in right_cols:
                         merged.setdefault(k, None)
                     joined.append(merged)
             if jc.kind in ("right", "full"):
@@ -1025,6 +1192,13 @@ class SqlSession:
         if stmt.where is not None:
             rows = [r for r in rows
                     if _eval_by_name(stmt.where, r) is True]
+        if stmt.group_by or any(it[0] == "agg" for it in stmt.items):
+            # aggregates over the join result: the materialized-rows
+            # engine (same machinery as CTE sources)
+            import dataclasses
+            sub = dataclasses.replace(stmt, where=None, joins=[],
+                                      ctes={})
+            return self._rows_select(sub, rows)
         if any(it[0] == "window" for it in stmt.items):
             self._apply_windows(stmt, rows)
         out = []
@@ -1656,6 +1830,56 @@ class SqlSession:
 def _decimal_cols(schema) -> set:
     return {c.name for c in schema.columns
             if c.type == ColumnType.DECIMAL}
+
+
+def _dequalify_name(name: str, quals: set) -> str:
+    if isinstance(name, str) and "." in name:
+        q, bare = name.split(".", 1)
+        if q in quals:
+            return bare
+    return name
+
+
+def _dequalify_node(node, quals: set):
+    if not isinstance(node, tuple) or not node:
+        return node
+    if node[0] == "col":
+        return ("col", _dequalify_name(node[1], quals))
+    return tuple(_dequalify_node(c, quals) if isinstance(c, tuple) else c
+                 for c in node)
+
+
+def _dequalify_stmt(stmt, quals: set) -> None:
+    """Strip `alias.`/`table.` qualifiers from every name position of a
+    single-table SELECT, in place (the join path keeps qualifiers — it
+    resolves them against per-table labels instead)."""
+    if stmt.where is not None:
+        stmt.where = _dequalify_node(stmt.where, quals)
+    if getattr(stmt, "having", None) is not None:
+        stmt.having = _dequalify_node(stmt.having, quals)
+    for i, it in enumerate(stmt.items):
+        if it[0] == "col":
+            stmt.items[i] = ("col", _dequalify_name(it[1], quals))
+        elif it[0] == "expr":
+            stmt.items[i] = ("expr", _dequalify_node(it[1], quals))
+        elif it[0] == "agg" and it[2] is not None:
+            stmt.items[i] = ("agg", it[1],
+                             _dequalify_node(it[2], quals))
+    stmt.group_by = [_dequalify_name(n, quals) for n in stmt.group_by]
+    stmt.order_by = [(_dequalify_name(n, quals), d)
+                     for n, d in stmt.order_by]
+
+
+def _strip_qualifiers(node):
+    """('col', 't.name') -> ('col', 'name') throughout an AST — pushed
+    join conjuncts bind against the owning table's schema by bare
+    column name."""
+    if not isinstance(node, tuple) or not node:
+        return node
+    if node[0] == "col" and isinstance(node[1], str) and "." in node[1]:
+        return ("col", node[1].split(".", 1)[1])
+    return tuple(_strip_qualifiers(c) if isinstance(c, tuple) else c
+                 for c in node)
 
 
 def _eval_by_name(node, row: dict):

@@ -179,6 +179,7 @@ class JoinClause:
     kind: str                   # 'inner' | 'left' | 'right' | 'full'
     left_col: str               # qualified or bare column of the LEFT side
     right_col: str              # column of the right table
+    alias: Optional[str] = None  # FROM t [AS] a — 'a' qualifies columns
 
 
 @dataclass
@@ -201,6 +202,7 @@ class SelectStmt:
     # WITH name AS (SELECT ...): materialized client-side; the outer
     # query (and later CTEs) may use the name as a table
     ctes: Dict[str, "SelectStmt"] = field(default_factory=dict)
+    table_alias: Optional[str] = None   # FROM t [AS] a
 
 
 @dataclass
@@ -705,6 +707,7 @@ class Parser:
             # FROM-less constant SELECT: SELECT 1, SELECT nextval('s')
             return SelectStmt(None, items, aliases=aliases)
         table = self.ident()
+        table_alias = self._table_alias()
         joins = []
         while True:
             kind = None
@@ -726,11 +729,12 @@ class Parser:
             else:
                 break
             rtable = self.ident()
+            ralias = self._table_alias()
             self.expect_kw("on")
             lcol = self.ident()
             self.expect_op("=")
             rcol = self.ident()
-            joins.append(JoinClause(rtable, kind, lcol, rcol))
+            joins.append(JoinClause(rtable, kind, lcol, rcol, ralias))
         where = None
         if self.accept_kw("where"):
             where = self.expr()
@@ -775,7 +779,26 @@ class Parser:
         if self.accept_kw("offset"):
             offset = int(self.next()[1])
         return SelectStmt(table, items, where, group, order, limit, knn,
-                          distinct, offset, joins, having, aliases)
+                          distinct, offset, joins, having, aliases,
+                          table_alias=table_alias)
+
+    # clause starters that must not be eaten as a table alias
+    _ALIAS_STOP = frozenset((
+        "join", "inner", "left", "right", "full", "cross", "on",
+        "where", "group", "having", "order", "limit", "offset",
+        "union", "intersect", "except", "returning", "using", "set",
+        "for", "as"))
+
+    def _table_alias(self) -> Optional[str]:
+        """Optional `[AS] alias` after a table name in FROM/JOIN."""
+        if self.accept_kw("as"):
+            return self.ident()
+        t = self.peek()
+        if t and t[0] == "id" and t[1].lower() not in self._ALIAS_STOP \
+                and "." not in t[1]:
+            self.next()
+            return t[1]
+        return None
 
     def delete(self):
         self.expect_kw("delete")

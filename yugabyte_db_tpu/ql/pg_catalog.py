@@ -1,0 +1,224 @@
+"""pg_catalog / information_schema virtual tables over the live catalog.
+
+The reference serves these from its forked PostgreSQL's real system
+catalogs persisted in the sys catalog tablet (reference:
+src/yb/master/sys_catalog.cc + initdb-created pg_catalog). Here the
+master's catalog is the single source of truth, and these views
+materialize rows from it ON DEMAND — the same design as the YCQL
+virtual system tables (ql/cql_server.py _system_schema_rows; reference:
+src/yb/master/yql_virtual_table.h). Drivers and tools introspect
+through them: `psql \\d`-style queries, ORMs reading
+information_schema.columns, admin UIs reading pg_settings.
+
+Any SELECT whose FROM names one of these tables is answered from the
+materialized rows through the normal row-select machinery (WHERE,
+projections, ORDER BY, JOINs against them all work).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..dockv.packed_row import ColumnType
+
+# PG type OIDs for our column types
+_TYPE_OID = {
+    ColumnType.BOOL: 16,
+    ColumnType.INT32: 23,
+    ColumnType.INT64: 20,
+    ColumnType.FLOAT32: 700,
+    ColumnType.FLOAT64: 701,
+    ColumnType.STRING: 25,
+    ColumnType.BINARY: 17,
+    ColumnType.TIMESTAMP: 1114,
+    ColumnType.DECIMAL: 1700,
+    ColumnType.JSON: 3802,
+}
+
+_TYPE_NAME = {
+    ColumnType.BOOL: "boolean",
+    ColumnType.INT32: "integer",
+    ColumnType.INT64: "bigint",
+    ColumnType.FLOAT32: "real",
+    ColumnType.FLOAT64: "double precision",
+    ColumnType.STRING: "text",
+    ColumnType.BINARY: "bytea",
+    ColumnType.TIMESTAMP: "timestamp without time zone",
+    ColumnType.DECIMAL: "numeric",
+    ColumnType.JSON: "jsonb",
+}
+
+# fixed rows for pg_type (the OIDs drivers actually look up)
+_PG_TYPES = [
+    (16, "bool", 1), (17, "bytea", -1), (20, "int8", 8),
+    (21, "int2", 2), (23, "int4", 4), (25, "text", -1),
+    (700, "float4", 4), (701, "float8", 8), (1043, "varchar", -1),
+    (1114, "timestamp", 8), (1184, "timestamptz", 8), (1700, "numeric", -1),
+    (2950, "uuid", 16), (3802, "jsonb", -1), (18, "char", 1),
+    (19, "name", 64), (26, "oid", 4),
+]
+
+_NSP_CATALOG = 11        # pg_catalog
+_NSP_PUBLIC = 2200       # public
+_NSP_INFO = 13183        # information_schema
+
+
+def _oid_of(table_id: str) -> int:
+    """Stable per-table OID derived from the immutable table id."""
+    h = 0xCBF29CE484222325
+    for b in table_id.encode():
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return 16384 + (h % 2000000000)
+
+
+VIRTUAL_TABLES = frozenset({
+    "pg_catalog.pg_class", "pg_class",
+    "pg_catalog.pg_namespace", "pg_namespace",
+    "pg_catalog.pg_attribute", "pg_attribute",
+    "pg_catalog.pg_type", "pg_type",
+    "pg_catalog.pg_index", "pg_index",
+    "pg_catalog.pg_tables", "pg_tables",
+    "pg_catalog.pg_database", "pg_database",
+    "pg_catalog.pg_settings", "pg_settings",
+    "pg_catalog.pg_proc", "pg_proc",
+    "information_schema.tables",
+    "information_schema.columns",
+    "information_schema.schemata",
+    "information_schema.table_constraints",
+    "information_schema.key_column_usage",
+})
+
+
+def is_virtual(name: str) -> bool:
+    return name.lower() in VIRTUAL_TABLES
+
+
+async def rows_for(name: str, client) -> Optional[List[Dict]]:
+    """Materialize the named virtual table from the live catalog."""
+    name = name.lower()
+    if name not in VIRTUAL_TABLES:
+        return None
+    short = name.split(".", 1)[-1] if name.startswith("pg_catalog.") \
+        else name
+
+    if short == "pg_type":
+        return [{"oid": oid, "typname": t, "typlen": ln,
+                 "typnamespace": _NSP_CATALOG, "typtype": "b"}
+                for oid, t, ln in _PG_TYPES]
+    if short == "pg_namespace":
+        return [
+            {"oid": _NSP_CATALOG, "nspname": "pg_catalog"},
+            {"oid": _NSP_PUBLIC, "nspname": "public"},
+            {"oid": _NSP_INFO, "nspname": "information_schema"},
+        ]
+    if short == "pg_database":
+        return [{"oid": 5, "datname": "yugabyte", "encoding": 6,
+                 "datcollate": "C", "datctype": "C",
+                 "datallowconn": True}]
+    if short == "pg_settings":
+        from ..utils import flags
+        return [{"name": n, "setting": str(f.value),
+                 "category": "ybtpu",
+                 "context": "user" if f.runtime else "postmaster",
+                 "short_desc": f.help}
+                for n, f in flags.REGISTRY.items()]
+    if short == "pg_proc":
+        return []        # no server-side functions yet; empty is valid
+
+    tables = await client.list_tables()
+    infos = []
+    for t in tables:
+        if t["name"].startswith("system."):
+            continue
+        try:
+            ct = await client._table(t["name"])
+        except Exception:  # noqa: BLE001 — table dropped mid-listing
+            continue
+        infos.append((t, ct.info))
+
+    if short == "pg_class":
+        out = []
+        for t, info in infos:
+            out.append({"oid": _oid_of(t["table_id"]),
+                        "relname": info.name,
+                        "relnamespace": _NSP_PUBLIC,
+                        "relkind": "r", "relnatts":
+                            len(info.schema.columns),
+                        "reltuples": -1.0, "relhasindex": False,
+                        "relispartition": False})
+        return out
+    if short == "pg_tables":
+        return [{"schemaname": "public", "tablename": info.name,
+                 "tableowner": "yugabyte", "hasindexes": False}
+                for _, info in infos]
+    if short == "pg_attribute":
+        out = []
+        for t, info in infos:
+            rel = _oid_of(t["table_id"])
+            for i, c in enumerate(info.schema.columns):
+                out.append({"attrelid": rel, "attname": c.name,
+                            "atttypid": _TYPE_OID.get(c.type, 25),
+                            "attnum": i + 1,
+                            "attnotnull": c.is_hash_key or c.is_range_key,
+                            "attisdropped": False})
+        return out
+    if short == "pg_index":
+        out = []
+        for t, info in infos:
+            rel = _oid_of(t["table_id"])
+            pk_nums = [i + 1 for i, c in enumerate(info.schema.columns)
+                       if c.is_hash_key or c.is_range_key]
+            if pk_nums:
+                out.append({"indexrelid": rel + 1, "indrelid": rel,
+                            "indnatts": len(pk_nums),
+                            "indisunique": True, "indisprimary": True,
+                            "indkey": " ".join(map(str, pk_nums))})
+        return out
+
+    if name == "information_schema.schemata":
+        return [{"catalog_name": "yugabyte", "schema_name": s,
+                 "schema_owner": "yugabyte"}
+                for s in ("public", "pg_catalog", "information_schema")]
+    if name == "information_schema.tables":
+        return [{"table_catalog": "yugabyte", "table_schema": "public",
+                 "table_name": info.name, "table_type": "BASE TABLE"}
+                for _, info in infos]
+    if name == "information_schema.columns":
+        out = []
+        for _, info in infos:
+            for i, c in enumerate(info.schema.columns):
+                out.append({
+                    "table_catalog": "yugabyte",
+                    "table_schema": "public",
+                    "table_name": info.name,
+                    "column_name": c.name,
+                    "ordinal_position": i + 1,
+                    "data_type": _TYPE_NAME.get(c.type, "text"),
+                    "is_nullable":
+                        "NO" if (c.is_hash_key or c.is_range_key)
+                        else "YES",
+                    "column_default": None,
+                })
+        return out
+    if name == "information_schema.table_constraints":
+        return [{"constraint_catalog": "yugabyte",
+                 "constraint_schema": "public",
+                 "constraint_name": f"{info.name}_pkey",
+                 "table_schema": "public", "table_name": info.name,
+                 "constraint_type": "PRIMARY KEY"}
+                for _, info in infos]
+    if name == "information_schema.key_column_usage":
+        out = []
+        for _, info in infos:
+            pos = 0
+            for c in info.schema.columns:
+                if c.is_hash_key or c.is_range_key:
+                    pos += 1
+                    out.append({
+                        "constraint_name": f"{info.name}_pkey",
+                        "table_schema": "public",
+                        "table_name": info.name,
+                        "column_name": c.name,
+                        "ordinal_position": pos,
+                    })
+        return out
+    return None

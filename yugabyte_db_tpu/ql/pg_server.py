@@ -8,8 +8,11 @@ results. Supported: StartupMessage (incl. SSLRequest refusal),
 password-free auth, Query with multi-statement strings, RowDescription/
 DataRow/CommandComplete/EmptyQueryResponse, ErrorResponse with
 SQLSTATE, Terminate, and the extended query protocol (Parse/Bind/
-Describe/Execute/Sync/Close) with text-format $n parameter binding —
-enough for psycopg-style drivers in their default mode.
+Describe/Execute/Sync/Close) with BOTH text and binary formats:
+Parse-declared parameter OIDs, binary parameter decode (int2/4/8,
+float4/8, bool, text), Bind result-format codes honored with binary
+DataRow encoding, and ParameterDescription on statement Describe —
+the psycopg2 (text) and psycopg3 (binary-preferring) modes both work.
 """
 from __future__ import annotations
 
@@ -92,20 +95,46 @@ class PgServer:
                 if tag == b"Q":
                     await run_query(body)
                 elif tag == b"P":           # Parse
-                    name, sql = self._parse_msg(body)
-                    prepared[name] = sql
+                    name, sql, ptypes = self._parse_msg(body)
+                    prepared[name] = (sql, ptypes)
                     writer.write(_msg(b"1"))        # ParseComplete
                 elif tag == b"B":           # Bind
-                    portal, stmt_name, params = self._bind_msg(body)
-                    sql = prepared.get(stmt_name, "")
-                    portals[portal] = self._substitute(sql, params)
-                    writer.write(_msg(b"2"))        # BindComplete
-                elif tag == b"D":           # Describe — NoData for writes,
-                    writer.write(_msg(b"n"))        # rows described at Execute
+                    try:
+                        portal, stmt_name, pfmts, raws, rfmts = \
+                            self._bind_msg(body)
+                        sql, ptypes = prepared.get(stmt_name, ("", ()))
+                        params = [
+                            self._decode_param(
+                                raw, pfmts[i] if i < len(pfmts) else 0,
+                                ptypes[i] if i < len(ptypes) else 0)
+                            for i, raw in enumerate(raws)]
+                        portals[portal] = (self._substitute(sql, params),
+                                           rfmts)
+                        writer.write(_msg(b"2"))    # BindComplete
+                    except Exception as e:  # noqa: BLE001 — wire frame,
+                        # not a dead connection (e.g. an unsupported
+                        # binary parameter OID)
+                        writer.write(self._error("22P03", str(e)))
+                        writer.write(_msg(b"Z", b"I"))
+                        await writer.drain()
+                elif tag == b"D":           # Describe
+                    kind = body[:1]
+                    dname = body[1:].split(b"\x00")[0].decode()
+                    if kind == b"S":
+                        # statement: declared (or unspecified) param
+                        # OIDs, rows described at Execute
+                        _, ptypes = prepared.get(dname, ("", ()))
+                        writer.write(_msg(
+                            b"t", struct.pack(">H", len(ptypes))
+                            + b"".join(struct.pack(">I", t)
+                                       for t in ptypes)))
+                    writer.write(_msg(b"n"))        # NoData
                 elif tag == b"E":           # Execute
                     portal = body.split(b"\x00")[0].decode()
-                    await run_query(portals.get(portal, "").encode()
-                                    + b"\x00", suppress_ready=True)
+                    sql, rfmts = portals.get(portal, ("", ()))
+                    await run_query(sql.encode() + b"\x00",
+                                    suppress_ready=True,
+                                    result_formats=rfmts)
                 elif tag == b"C":           # Close
                     writer.write(_msg(b"3"))        # CloseComplete
                 elif tag == b"S":           # Sync
@@ -132,11 +161,23 @@ class PgServer:
         name_end = body.index(b"\x00")
         name = body[:name_end].decode()
         rest = body[name_end + 1:]
-        sql = rest[:rest.index(b"\x00")].decode()
-        return name, sql
+        sql_end = rest.index(b"\x00")
+        sql = rest[:sql_end].decode()
+        # declared parameter type OIDs (0 = unspecified)
+        off = sql_end + 1
+        ptypes: tuple = ()
+        if off + 2 <= len(rest):
+            try:
+                (ntypes,) = struct.unpack_from(">H", rest, off)
+                ptypes = struct.unpack_from(f">{ntypes}I", rest, off + 2)
+            except struct.error:
+                ptypes = ()
+        return name, sql, ptypes
 
     @staticmethod
     def _bind_msg(body: bytes):
+        """-> (portal, stmt_name, per-param format codes, raw param
+        bytes (None for NULL), result format codes)."""
         pos = body.index(b"\x00")
         portal = body[:pos].decode()
         body2 = body[pos + 1:]
@@ -145,19 +186,51 @@ class PgServer:
         rest = body2[pos2 + 1:]
         off = 0
         (nfmt,) = struct.unpack_from(">H", rest, off)
+        fmts = struct.unpack_from(f">{nfmt}H", rest, off + 2)
         off += 2 + 2 * nfmt
         (nparams,) = struct.unpack_from(">H", rest, off)
         off += 2
-        params = []
+        pfmts = PgServer._expand_formats(fmts, nparams)
+        raws = []
         for _ in range(nparams):
             (plen,) = struct.unpack_from(">i", rest, off)
             off += 4
             if plen < 0:
-                params.append(None)
+                raws.append(None)
             else:
-                params.append(rest[off:off + plen].decode())
+                raws.append(rest[off:off + plen])
                 off += plen
-        return portal, stmt_name, params
+        (nrfmt,) = struct.unpack_from(">H", rest, off)
+        rfmts = struct.unpack_from(f">{nrfmt}H", rest, off + 2)
+        return portal, stmt_name, pfmts, raws, rfmts
+
+    @staticmethod
+    def _decode_param(raw, fmt: int, oid: int):
+        """Wire parameter -> text form for $n substitution. Binary
+        (format 1) decodes by the Parse-declared OID (reference: PG
+        binary input functions; the extended protocol's typed
+        parameters)."""
+        if raw is None:
+            return None
+        if fmt == 0:
+            return raw.decode()
+        if oid == 20 or (oid == 0 and len(raw) == 8):
+            return str(struct.unpack(">q", raw)[0])
+        if oid == 23 or (oid == 0 and len(raw) == 4):
+            return str(struct.unpack(">i", raw)[0])
+        if oid == 21:
+            return str(struct.unpack(">h", raw)[0])
+        if oid == 701:
+            return repr(struct.unpack(">d", raw)[0])
+        if oid == 700:
+            return repr(struct.unpack(">f", raw)[0])
+        if oid == 16:
+            # tagged bare literal: only BINARY bool params inline
+            # unquoted — the text string 'true' must stay a string
+            return ("bare", "true" if raw != b"\x00" else "false")
+        if oid in (25, 1043, 19):
+            return raw.decode()
+        raise ValueError(f"unsupported binary parameter oid {oid}")
 
     @staticmethod
     def _substitute(sql: str, params):
@@ -171,6 +244,8 @@ class PgServer:
             v = params[i - 1]
             if v is None:
                 lit = "NULL"
+            elif isinstance(v, tuple) and v[0] == "bare":
+                lit = v[1]          # binary-decoded bool literal
             elif num.match(v):
                 lit = v
             else:
@@ -236,7 +311,8 @@ class PgServer:
 
     # ------------------------------------------------------------------
     async def _query(self, session: SqlSession, body: bytes, writer,
-                     suppress_ready: bool = False):
+                     suppress_ready: bool = False,
+                     result_formats: tuple = ()):
         sql = body.rstrip(b"\x00").decode()
         statements = self._split_statements(sql)
         if not statements:
@@ -253,9 +329,12 @@ class PgServer:
                 break
             if res.rows:
                 cols = list(res.rows[0].keys())
-                writer.write(self._row_description(cols, res.rows[0]))
+                fmts = self._expand_formats(result_formats, len(cols))
+                writer.write(self._row_description(cols, res.rows[0],
+                                                   fmts))
                 for r in res.rows:
-                    writer.write(self._data_row([r.get(c) for c in cols]))
+                    writer.write(self._data_row(
+                        [r.get(c) for c in cols], fmts))
                 writer.write(_msg(b"C", _cstr(f"SELECT {len(res.rows)}")))
             else:
                 tag = res.status if res.status != "OK" else "SELECT 0"
@@ -264,9 +343,20 @@ class PgServer:
             writer.write(_msg(b"Z", b"I"))
         await writer.drain()
 
-    def _row_description(self, cols: List[str], sample: dict) -> bytes:
+    @staticmethod
+    def _expand_formats(rfmts: tuple, ncols: int) -> tuple:
+        """Bind's result-format shorthand: () = all text, one code =
+        applies to every column."""
+        if not rfmts:
+            return (0,) * ncols
+        if len(rfmts) == 1:
+            return (rfmts[0],) * ncols
+        return tuple(rfmts[:ncols]) + (0,) * max(0, ncols - len(rfmts))
+
+    def _row_description(self, cols: List[str], sample: dict,
+                         fmts: tuple = ()) -> bytes:
         body = struct.pack(">H", len(cols))
-        for c in cols:
+        for i, c in enumerate(cols):
             v = sample.get(c)
             if isinstance(v, bool):
                 oid, size = _OID_BOOL, 1
@@ -278,16 +368,30 @@ class PgServer:
                 oid, size = _OID_BYTEA, -1
             else:
                 oid, size = _OID_TEXT, -1
-            body += _cstr(c) + struct.pack(">IHIhih", 0, 0, oid, size, -1, 0)
+            fmt = fmts[i] if i < len(fmts) else 0
+            body += _cstr(c) + struct.pack(">IHIhih", 0, 0, oid, size,
+                                           -1, fmt)
         return _msg(b"T", body)
 
-    def _data_row(self, values: List) -> bytes:
+    def _data_row(self, values: List, fmts: tuple = ()) -> bytes:
         body = struct.pack(">H", len(values))
-        for v in values:
+        for i, v in enumerate(values):
             if v is None:
                 body += struct.pack(">i", -1)
                 continue
-            if isinstance(v, bool):
+            if (fmts[i] if i < len(fmts) else 0) == 1:
+                # binary result format, matched to the described OID
+                if isinstance(v, bool):
+                    raw = b"\x01" if v else b"\x00"
+                elif isinstance(v, int):
+                    raw = struct.pack(">q", v)
+                elif isinstance(v, float):
+                    raw = struct.pack(">d", v)
+                elif isinstance(v, bytes):
+                    raw = v
+                else:
+                    raw = str(v).encode()
+            elif isinstance(v, bool):
                 raw = b"t" if v else b"f"
             elif isinstance(v, bytes):
                 raw = b"\\x" + v.hex().encode()

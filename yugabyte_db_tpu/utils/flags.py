@@ -62,6 +62,12 @@ class FlagRegistry:
     def all(self) -> dict[str, Any]:
         return {n: f.value for n, f in self._flags.items()}
 
+    def items(self) -> list[tuple[str, Flag]]:
+        """Sorted (name, Flag) pairs — introspection surfaces
+        (pg_settings, /flags web endpoint)."""
+        with self._lock:
+            return sorted(self._flags.items())
+
     def reset(self, name: str) -> None:
         f = self._flags[name]
         f.value = f.default
@@ -150,6 +156,15 @@ DEFINE_RUNTIME("scan_group_strategy", "auto",
                "'unroll' (per-group masked tree reductions — pure VPU "
                "code, no scatter, for TPU), or 'auto' (segment on cpu, "
                "unroll elsewhere).")
+DEFINE_RUNTIME("bnl_batch_size", 1024,
+               "Join-key batch size for batched-nested-loop joins: the "
+               "inner side fetches WHERE inner_col IN (batch) pushed to "
+               "storage per batch of outer keys (reference: "
+               "yb_bnl_batch_size GUC / nodeYbBatchedNestloop.c).")
+DEFINE_RUNTIME("bnl_max_keys", 65536,
+               "Above this many distinct outer join keys the planner "
+               "falls back to a full inner fetch + hash join instead "
+               "of batched IN pushdown.")
 DEFINE_RUNTIME("native_point_reader_max_rows", 4_000_000,
                "SSTs above this row count skip the eager native "
                "PointReader (it deserializes and pins every columnar "
