@@ -280,17 +280,25 @@ def main():
     # --- compaction at spec (BASELINE config 4): N-SST major merge ------
     n_ssts = int(os.environ.get("BENCH_COMPACT_SSTS", "100"))
     rows_per = int(os.environ.get("BENCH_COMPACT_ROWS", "20000"))
-    ct = _make_compaction_tablet(data, n_ssts, rows_per, "dev")
-    total_bytes = ct.approximate_size()
-    flags.set_flag("tpu_compaction_enabled", True)
-    t0 = time.perf_counter()
-    ct.compact()
-    dev_s = time.perf_counter() - t0
-    ct2 = _make_compaction_tablet(data, n_ssts, rows_per, "cpu")
-    flags.set_flag("tpu_compaction_enabled", False)
-    t0 = time.perf_counter()
-    ct2.compact()
-    cpu_comp_s = time.perf_counter() - t0
+
+    def timed_compaction(flag, tag):
+        # best-of-2: the first run on a fresh tablet pays cold page
+        # cache + lazy imports, which otherwise skews the ratio
+        best = None
+        for i in range(2):
+            ct = _make_compaction_tablet(data, n_ssts, rows_per,
+                                         f"{tag}{i}")
+            nonlocal_bytes = ct.approximate_size()
+            flags.set_flag("tpu_compaction_enabled", flag)
+            t0 = time.perf_counter()
+            ct.compact()
+            dt = time.perf_counter() - t0
+            if best is None or dt < best[0]:
+                best = (dt, nonlocal_bytes)
+        return best
+
+    dev_s, total_bytes = timed_compaction(True, "dev")
+    cpu_comp_s, _ = timed_compaction(False, "cpu")
     flags.set_flag("tpu_compaction_enabled", True)
     results["compaction"] = {
         "ssts": n_ssts, "input_mb": total_bytes / 1e6,
@@ -318,26 +326,30 @@ def main():
     results["ycsb_c"] = {"ops_per_s": rc.ops_per_sec,
                          "batched16_ops_per_s": rb.ops_per_sec}
 
-    # Vector search micro (BASELINE config 5 at reduced scale by default;
-    # BENCH_FULL=1 runs 1M x 768)
+    # Vector search (BASELINE config 5): the reduced config plus the
+    # full 1M x 768 spec config, time-boxed via fewer k-means iters
+    # (BENCH_VECTOR_FULL=0 skips the big one)
     from yugabyte_db_tpu.ops.vector import IvfFlatIndex
-    full = os.environ.get("BENCH_FULL") == "1"
-    vn, vd = (1_000_000, 768) if full else (200_000, 128)
-    rngv = np.random.default_rng(0)
-    base = rngv.normal(size=(vn, vd)).astype(np.float32)
-    t0 = time.perf_counter()
-    idx = IvfFlatIndex.build(base, nlists=200 if full else 64, iters=5)
-    build_s = time.perf_counter() - t0
-    q = base[:64] + 0.001
-    idx.search(q, k=10, nprobe=8)   # warm/compile
-    t0 = time.perf_counter()
-    for _ in range(5):
-        idx.search(q, k=10, nprobe=8)
-    search_s = (time.perf_counter() - t0) / 5
-    results["vector"] = {
-        "n": vn, "dim": vd, "build_s": build_s,
-        "qps": 64 / search_s,
-    }
+
+    def vector_bench(vn, vd, nlists, iters, repeats_v):
+        rngv = np.random.default_rng(0)
+        vbase = rngv.normal(size=(vn, vd)).astype(np.float32)
+        t0 = time.perf_counter()
+        idx = IvfFlatIndex.build(vbase, nlists=nlists, iters=iters,
+                                 sample=50_000)
+        build_s = time.perf_counter() - t0
+        vq = vbase[:64] + 0.001
+        idx.search(vq, k=10, nprobe=8)   # warm/compile
+        t0 = time.perf_counter()
+        for _ in range(repeats_v):
+            idx.search(vq, k=10, nprobe=8)
+        search_s = (time.perf_counter() - t0) / repeats_v
+        return {"n": vn, "dim": vd, "build_s": build_s,
+                "qps": 64 / search_s}
+
+    results["vector"] = vector_bench(200_000, 128, 64, 5, 5)
+    if os.environ.get("BENCH_VECTOR_FULL", "1") != "0":
+        results["vector_full"] = vector_bench(1_000_000, 768, 200, 2, 2)
 
     q6 = results["q6"]
     line = {
@@ -372,6 +384,12 @@ def main():
                    "dim": results["vector"]["dim"],
                    "build_s": round(results["vector"]["build_s"], 2),
                    "search_qps": round(results["vector"]["qps"], 1)},
+        **({"vector_full": {
+            "n": results["vector_full"]["n"],
+            "dim": results["vector_full"]["dim"],
+            "build_s": round(results["vector_full"]["build_s"], 2),
+            "search_qps": round(results["vector_full"]["qps"], 1)}}
+           if "vector_full" in results else {}),
     }
     print(json.dumps(line))
 

@@ -1,0 +1,70 @@
+"""Profile vector search variants (throwaway)."""
+import os, time
+os.environ.setdefault("YBTPU_PLATFORM", "cpu")
+import numpy as np
+import jax, jax.numpy as jnp
+from yugabyte_db_tpu.ops.vector import IvfFlatIndex, exact_search, l2_distance2
+
+n, d = 200_000, 128
+rng = np.random.default_rng(0)
+base = rng.normal(size=(n, d)).astype(np.float32)
+q = base[:64] + 0.001
+
+t0 = time.perf_counter()
+idx = IvfFlatIndex.build(base, nlists=64, iters=5)
+print(f"build: {time.perf_counter()-t0:.2f}s")
+
+idx.search(q, k=10, nprobe=8)
+t0 = time.perf_counter()
+for _ in range(5):
+    idx.search(q, k=10, nprobe=8)
+dt = (time.perf_counter() - t0) / 5
+print(f"ivf search: {dt*1e3:.1f} ms/batch  {64/dt:.0f} qps")
+
+bj = jnp.asarray(base)
+qj = jnp.asarray(q)
+jax.block_until_ready(exact_search(qj, bj, 10))
+t0 = time.perf_counter()
+for _ in range(5):
+    jax.block_until_ready(exact_search(qj, bj, 10))
+dt = (time.perf_counter() - t0) / 5
+print(f"exact bf16: {dt*1e3:.1f} ms/batch  {64/dt:.0f} qps")
+
+@jax.jit
+def exact_f32(queries, base, k=10):
+    dots = queries @ base.T
+    qn = jnp.sum(queries ** 2, axis=1, keepdims=True)
+    bn = jnp.sum(base ** 2, axis=1)
+    dist = qn + bn[None, :] - 2.0 * dots
+    neg, i = jax.lax.top_k(-dist, 10)
+    return -neg, i
+
+jax.block_until_ready(exact_f32(qj, bj))
+t0 = time.perf_counter()
+for _ in range(5):
+    jax.block_until_ready(exact_f32(qj, bj))
+dt = (time.perf_counter() - t0) / 5
+print(f"exact f32: {dt*1e3:.1f} ms/batch  {64/dt:.0f} qps")
+
+# numpy BLAS reference
+t0 = time.perf_counter()
+for _ in range(5):
+    dots = q @ base.T
+    dist = (q**2).sum(1)[:, None] + (base**2).sum(1)[None, :] - 2*dots
+    part = np.argpartition(dist, 10, axis=1)[:, :10]
+dt = (time.perf_counter() - t0) / 5
+print(f"numpy f32: {dt*1e3:.1f} ms/batch  {64/dt:.0f} qps")
+
+# new routed search
+idx2 = IvfFlatIndex.build(base, nlists=64, iters=5)
+dd, ii = idx2.search(q, k=10, nprobe=8)
+de, ie = exact_search(qj, bj, 10)
+print("routed==exact idx match:", float((ii == np.asarray(ie)).mean()))
+t0 = time.perf_counter()
+for _ in range(5):
+    idx2.search(q, k=10, nprobe=8)
+dt = (time.perf_counter() - t0) / 5
+print(f"routed search: {dt*1e3:.1f} ms/batch  {64/dt:.0f} qps")
+# small batch keeps gather path
+d1, i1 = idx2.search(q[:2], k=10, nprobe=8)
+print("small-batch ok:", d1.shape, i1.shape)

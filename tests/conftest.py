@@ -17,3 +17,8 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+# the package skips the persistent XLA compile cache on CPU backends
+# (XLA:CPU AOT entries can fail the loader's machine check); make the
+# CPU choice visible to yugabyte_db_tpu/__init__.py before its import
+os.environ.setdefault("YBTPU_PLATFORM", "cpu")

@@ -1,0 +1,106 @@
+"""Catalog-version write fencing: a session holding a pre-ALTER schema
+must not write through it (reference: catalog version invalidation +
+YsqlBackendsManager, src/yb/master/ysql_backends_manager.cc; schema
+version mismatch checks in tserver/tablet_service.cc)."""
+import asyncio
+
+import pytest
+
+from yugabyte_db_tpu.docdb.table_codec import TableInfo
+from yugabyte_db_tpu.dockv.packed_row import (ColumnSchema, ColumnType,
+                                              TableSchema)
+from yugabyte_db_tpu.dockv.partition import PartitionSchema
+from yugabyte_db_tpu.docdb.operations import ReadRequest
+from yugabyte_db_tpu.rpc.messenger import RpcError
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+
+def _info(name, cols):
+    schema = TableSchema(columns=tuple(
+        ColumnSchema(i, n, t, is_hash_key=hk)
+        for i, (n, t, hk) in enumerate(cols)), version=1)
+    return TableInfo(name, name, schema, PartitionSchema("hash", 1))
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_stale_session_cannot_write_dropped_column(tmp_path):
+    async def go():
+        mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+        try:
+            a = mc.client()
+            b = mc.client()
+            await a.create_table(_info("ft", [
+                ("k", "int64", True), ("v", "float64", False),
+                ("s", "string", False)]), num_tablets=1)
+            await mc.wait_for_leaders("ft")
+            # both sessions cache the v1 schema
+            await a.insert("ft", [{"k": 1, "v": 1.0, "s": "x"}])
+            await b.insert("ft", [{"k": 2, "v": 2.0, "s": "y"}])
+            # A drops 'v'; B still holds the old schema
+            await a.alter_table("ft", drop_columns=["v"])
+            with pytest.raises(RpcError) as ei:
+                await b.insert("ft", [{"k": 3, "v": 3.0, "s": "z"}])
+            assert "dropped" in str(ei.value) or \
+                ei.value.code == "SCHEMA_MISMATCH"
+            # writes to live columns self-heal via refresh + retry
+            n = await b.insert("ft", [{"k": 4, "s": "ok"}])
+            assert n == 1
+            rows = (await a.scan("ft", ReadRequest(""))).rows
+            assert {r["k"] for r in rows} == {1, 2, 4}
+            assert all("v" not in r for r in rows)
+        finally:
+            await mc.shutdown()
+    run(go())
+
+
+def test_fence_applies_before_replication(tmp_path):
+    """The mismatch must be rejected at the serving edge — nothing may
+    reach the WAL (a restart must not replay a stale write)."""
+    async def go():
+        mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+        try:
+            a = mc.client()
+            b = mc.client()
+            await a.create_table(_info("ft2", [
+                ("k", "int64", True),
+                ("v", "float64", False)]), num_tablets=1)
+            await mc.wait_for_leaders("ft2")
+            await b.insert("ft2", [{"k": 1, "v": 1.0}])
+            await a.alter_table("ft2", add_columns=[("w", "float64")])
+            # stale B: transparently refreshes and succeeds (no dropped
+            # columns involved)
+            assert await b.insert("ft2", [{"k": 2, "v": 2.0}]) == 1
+            rows = (await a.scan("ft2", ReadRequest(""))).rows
+            assert {r["k"] for r in rows} == {1, 2}
+        finally:
+            await mc.shutdown()
+    run(go())
+
+
+def test_txn_write_path_is_fenced(tmp_path):
+    """Provisional (transactional) writes carry the same fence: a txn
+    session on a pre-ALTER schema cannot write intents through it."""
+    async def go():
+        mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+        try:
+            a = mc.client()
+            b = mc.client()
+            await a.create_table(_info("ft3", [
+                ("k", "int64", True), ("v", "float64", False)]),
+                num_tablets=1)
+            await mc.wait_for_leaders("ft3")
+            await b.insert("ft3", [{"k": 1, "v": 1.0}])  # warm B's cache
+            await a.alter_table("ft3", drop_columns=["v"])
+            from yugabyte_db_tpu.docdb.operations import RowOp
+            txn = await b.transaction().begin()
+            with pytest.raises(RpcError) as ei:
+                await txn.write("ft3", [RowOp("upsert",
+                                              {"k": 2, "v": 9.0})])
+            assert ei.value.code == "SCHEMA_MISMATCH"
+            await txn.abort()
+        finally:
+            await mc.shutdown()
+    asyncio.run(go())

@@ -1,0 +1,67 @@
+"""yb-ts-cli analog: per-tserver ops against a live in-process cluster
+(reference role: src/yb/tools/ts-cli.cc)."""
+import asyncio
+import json
+
+from yugabyte_db_tpu.ql import SqlSession
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+from yugabyte_db_tpu.tools.ts_cli import run_command
+
+
+class _Args:
+    def __init__(self, server, command, args=()):
+        self.server = server
+        self.command = command
+        self.args = list(args)
+
+
+def test_ts_cli_ops(tmp_path, capsys):
+    async def go():
+        mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+        try:
+            s = SqlSession(mc.client())
+            await s.execute("CREATE TABLE tc (k bigint, v double, "
+                            "PRIMARY KEY (k))")
+            await mc.wait_for_leaders("tc")
+            await s.execute("INSERT INTO tc (k, v) VALUES (1, 1.0)")
+            ts = mc.tservers[0]
+            addr = f"{ts.messenger.addr[0]}:{ts.messenger.addr[1]}"
+
+            assert await run_command(_Args(addr, "status")) == 0
+            out = json.loads(capsys.readouterr().out)
+            assert out["uuid"] == ts.uuid and out["tablets"]
+
+            assert await run_command(_Args(addr, "list_tablets")) == 0
+            tablets = json.loads(capsys.readouterr().out)
+            tid = tablets[0]["tablet_id"]
+
+            assert await run_command(
+                _Args(addr, "flush_tablet", [tid])) == 0
+            capsys.readouterr()
+            assert await run_command(
+                _Args(addr, "compact_tablet", [tid])) == 0
+            capsys.readouterr()
+            assert await run_command(
+                _Args(addr, "tablet_status", [tid])) == 0
+            st = json.loads(capsys.readouterr().out)
+            assert st["exists"] is True
+
+            assert await run_command(
+                _Args(addr, "set_flag",
+                      ["tpu_min_rows_for_pushdown", "9999"])) == 0
+            flagout = json.loads(capsys.readouterr().out)
+            assert flagout["value"] == 9999
+            from yugabyte_db_tpu.utils import flags
+            assert flags.get("tpu_min_rows_for_pushdown") == 9999
+            flags.REGISTRY.reset("tpu_min_rows_for_pushdown")
+
+            assert await run_command(_Args(addr, "mem_trackers")) == 0
+            capsys.readouterr()
+            assert await run_command(_Args(addr, "server_clock")) == 0
+            capsys.readouterr()
+            # unknown command and missing args fail cleanly
+            assert await run_command(_Args(addr, "nope")) == 2
+            assert await run_command(_Args(addr, "set_flag", ["x"])) == 2
+        finally:
+            await mc.shutdown()
+    asyncio.run(go())

@@ -56,14 +56,31 @@ if _os.environ.get("YBTPU_PLATFORM"):
 # compile (tens of seconds over the tunnel); cache them across processes.
 # Namespaced by host fingerprint — repo snapshots move between machines,
 # and code compiled for another CPU's feature set can SIGILL (hostfp.py).
+# CPU backends skip the cache entirely: their compiles are fast, and
+# XLA:CPU AOT entries embed tuning pseudo-features (prefer-no-gather
+# etc.) that fail the loader's machine check even on the same host —
+# the r03 bench-tail warning class.
 from .hostfp import host_fingerprint as _host_fp  # noqa: E402
 
-_cache_dir = _os.environ.get(
-    "YBTPU_COMPILE_CACHE",
-    _os.path.join(_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
-                  ".jax_cache", _host_fp()))
-try:
-    _jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-except Exception:  # older jax without the knob — fine, just slower
-    pass
+_platform_env = (_os.environ.get("YBTPU_PLATFORM")
+                 or _os.environ.get("JAX_PLATFORMS", ""))
+if _platform_env:
+    _accel_likely = "cpu" not in _platform_env.lower()
+else:
+    # no explicit platform: probe device nodes instead of initializing
+    # a backend here (jax.default_backend() could hang on a wedged
+    # tunnel); no accelerator nodes -> CPU backend -> no cache
+    import glob as _glob
+    _accel_likely = bool(_glob.glob("/dev/accel*")
+                         or _glob.glob("/dev/nvidia*"))
+if _accel_likely:
+    _cache_dir = _os.environ.get(
+        "YBTPU_COMPILE_CACHE",
+        _os.path.join(
+            _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+            ".jax_cache", _host_fp()))
+    try:
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # older jax without the knob — fine, just slower
+        pass

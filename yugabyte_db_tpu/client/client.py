@@ -306,7 +306,8 @@ class YBClient:
 
             async def send(tablet_id: str, tops: List[RowOp]) -> int:
                 req = WriteRequest(ct.info.table_id, tops,
-                                   external_ht=external_ht)
+                                   external_ht=external_ht,
+                                   schema_version=ct.info.schema.version)
                 payload = {"tablet_id": tablet_id,
                            "req": write_request_to_wire(req)}
                 return (await self._call_leader(
@@ -314,7 +315,29 @@ class YBClient:
 
             return sum(await asyncio.gather(
                 *[send(tid, tops) for tid, tops in by_tablet.items()]))
-        return await self._retry_on_split(table, go)
+
+        # catalog-version fence retries: a concurrent DDL moved the
+        # schema — refresh the cached table and re-send; ops that only
+        # touch still-live columns succeed, anything referencing a
+        # dropped column fails loudly instead of writing through a
+        # stale schema. Bounded retries with backoff cover the window
+        # where tablets already adopted the new schema but the master's
+        # catalog commit (which refresh reads) hasn't landed yet.
+        for attempt in range(4):
+            try:
+                return await self._retry_on_split(table, go)
+            except RpcError as e:
+                if e.code != "SCHEMA_MISMATCH" or attempt == 3:
+                    raise
+                await asyncio.sleep(0.05 * (attempt + 1))
+                ct = await self._table(table, refresh=True)
+                live = {c.name for c in ct.info.schema.columns}
+                for op in ops:
+                    gone = set(op.row) - live
+                    if gone:
+                        raise RpcError(
+                            f"column(s) {sorted(gone)} dropped by a "
+                            f"concurrent ALTER on {table}", "NOT_FOUND")
 
     async def insert(self, table: str, rows: Sequence[dict]) -> int:
         return await self.write(table, [RowOp("upsert", r) for r in rows])

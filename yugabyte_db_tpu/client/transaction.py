@@ -106,7 +106,11 @@ class YBTransaction:
         async def send(tablet_id: str, tops: List[RowOp]) -> int:
             loc = next(l for l in ct.locations if l.tablet_id == tablet_id)
             self._participants[tablet_id] = [list(a) for _, a in loc.replicas]
-            req = WriteRequest(ct.info.table_id, tops)
+            # same catalog-version fence as the non-txn path: a txn
+            # session holding a pre-ALTER schema must not write intents
+            # through it either
+            req = WriteRequest(ct.info.table_id, tops,
+                               schema_version=ct.info.schema.version)
             payload = {"tablet_id": tablet_id,
                        "req": write_request_to_wire(req),
                        "txn_id": self.txn_id, "start_ht": self.start_ht,

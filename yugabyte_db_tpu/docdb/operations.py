@@ -51,6 +51,13 @@ class WriteRequest:
     # writes so safe-time reads see a consistent cut (reference:
     # external hybrid time in docdb / xcluster_write_interface)
     external_ht: int | None = None
+    # catalog-version fence: the CLIENT's cached schema version; the
+    # serving tablet rejects a mismatch before replicating, so a
+    # session holding a pre-ALTER schema can never write through it
+    # (reference: catalog version checks + YsqlBackendsManager,
+    # src/yb/master/ysql_backends_manager.cc). None = unfenced
+    # (internal paths, WAL replay)
+    schema_version: int | None = None
 
 
 @dataclass

@@ -35,6 +35,8 @@ def write_request_to_wire(req: WriteRequest) -> dict:
            "ops": [[o.kind, o.row, o.ttl_ms] for o in req.ops]}
     if req.external_ht is not None:
         out["external_ht"] = req.external_ht
+    if req.schema_version is not None:
+        out["schema_version"] = req.schema_version
     return out
 
 
@@ -43,7 +45,8 @@ def write_request_from_wire(d: dict) -> WriteRequest:
         d["table_id"],
         [RowOp(op[0], op[1], op[2] if len(op) > 2 else None)
          for op in d["ops"]],
-        external_ht=d.get("external_ht"))
+        external_ht=d.get("external_ht"),
+        schema_version=d.get("schema_version"))
 
 
 def read_request_to_wire(req: ReadRequest) -> dict:

@@ -18,13 +18,19 @@ import jax.numpy as jnp
 import numpy as np
 
 
+def _mm_dtype():
+    """Distance-matmul operand dtype: bf16 on accelerators (MXU-native,
+    halves HBM traffic; f32 accumulation), f32 on CPU backends (bf16
+    there is emulation, not a win)."""
+    return jnp.bfloat16 if jax.default_backend() != "cpu" else jnp.float32
+
+
 @jax.jit
 def l2_distance2(queries: jnp.ndarray, base: jnp.ndarray) -> jnp.ndarray:
     """Squared L2 distances [Q, N] = |q|^2 + |b|^2 - 2 q.b (MXU matmul)."""
-    q = queries.astype(jnp.bfloat16)
-    b = base.astype(jnp.bfloat16)
+    mm = _mm_dtype()
     dots = jax.lax.dot_general(
-        q, b, (((1,), (1,)), ((), ())),
+        queries.astype(mm), base.astype(mm), (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
     qn = jnp.sum(queries.astype(jnp.float32) ** 2, axis=1, keepdims=True)
     bn = jnp.sum(base.astype(jnp.float32) ** 2, axis=1)
@@ -34,8 +40,9 @@ def l2_distance2(queries: jnp.ndarray, base: jnp.ndarray) -> jnp.ndarray:
 
 @jax.jit
 def inner_product(queries: jnp.ndarray, base: jnp.ndarray) -> jnp.ndarray:
+    mm = _mm_dtype()
     return jax.lax.dot_general(
-        queries.astype(jnp.bfloat16), base.astype(jnp.bfloat16),
+        queries.astype(mm), base.astype(mm),
         (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
 
 
@@ -92,8 +99,9 @@ class IvfFlatIndex:
         self.centroids = jnp.asarray(centroids, jnp.float32)   # [K, D]
         self.lists = jnp.asarray(lists)                        # [K, M] int32
         self.list_lens = jnp.asarray(list_lens)                # [K] int32
-        # bf16 on device halves HBM footprint; distances accumulate in f32
-        self.vectors = jnp.asarray(vectors, jnp.bfloat16)      # [N, D]
+        # matmul dtype: bf16 on accelerators (halves HBM; f32 accum),
+        # f32 on CPU (bf16 is emulated there)
+        self.vectors = jnp.asarray(vectors, _mm_dtype())       # [N, D]
         self.norms = jnp.sum(jnp.asarray(vectors, jnp.float32) ** 2,
                              axis=1)                           # [N] f32
 
@@ -133,8 +141,8 @@ class IvfFlatIndex:
         cand = cand.reshape(q_, p_ * m_)
         cand_valid = (jnp.arange(m_)[None, None, :]
                       < self.list_lens[probe][:, :, None]).reshape(q_, p_ * m_)
-        vecs = self.vectors[cand]                             # [Q, C, D] bf16
-        dots = jnp.einsum("qd,qcd->qc", queries.astype(jnp.bfloat16), vecs,
+        vecs = self.vectors[cand]                   # [Q, C, D] mm dtype
+        dots = jnp.einsum("qd,qcd->qc", queries.astype(_mm_dtype()), vecs,
                           preferred_element_type=jnp.float32)
         d = (jnp.sum(queries.astype(jnp.float32) ** 2, axis=1, keepdims=True)
              + self.norms[cand] - 2.0 * dots)
@@ -142,9 +150,60 @@ class IvfFlatIndex:
         neg, pos = jax.lax.top_k(-d, k)
         return -neg, jnp.take_along_axis(cand, pos, axis=1)
 
+    @partial(jax.jit, static_argnames=("self", "k", "chunk"))
+    def _search_full(self, queries, k: int, chunk: int):
+        """Batched full-scan k-NN in N-chunks: per-chunk distance
+        matmul + top-k, then a final top-k over the per-chunk winners.
+        Exact, pure MXU, one shared read of the vector matrix for the
+        whole query batch — on TPU this is HBM-optimal whenever the
+        batch's probe lists would union to most of the dataset
+        (reading per-query gathered lists costs Q*nprobe/nlists reads
+        of the matrix; one shared pass costs exactly one)."""
+        n, d_ = self.vectors.shape
+        pad = (-n) % chunk
+        vec = jnp.pad(self.vectors, ((0, pad), (0, 0)))
+        nrm = jnp.pad(self.norms, (0, pad), constant_values=jnp.inf)
+        nchunks = (n + pad) // chunk
+        vec = vec.reshape(nchunks, chunk, d_)
+        nrm = nrm.reshape(nchunks, chunk)
+        qn = jnp.sum(queries.astype(jnp.float32) ** 2, axis=1,
+                     keepdims=True)
+        mm = _mm_dtype()
+        qmm = queries.astype(mm)
+
+        def body(carry, xs):
+            v, m = xs
+            dots = jax.lax.dot_general(
+                qmm, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            dist = qn + m[None, :] - 2.0 * dots
+            neg, pos = jax.lax.top_k(-dist, k)
+            return carry, (neg, pos)
+
+        _, (negs, poss) = jax.lax.scan(
+            body, 0, (vec, nrm))                   # [C, Q, k] each
+        negs = jnp.moveaxis(negs, 0, 1).reshape(queries.shape[0], -1)
+        poss = (jnp.moveaxis(poss, 0, 1)
+                + (jnp.arange(nchunks) * chunk)[None, :, None]
+                ).reshape(queries.shape[0], -1)
+        neg, sel = jax.lax.top_k(negs, k)
+        return jnp.maximum(-neg, 0.0), jnp.take_along_axis(poss, sel,
+                                                           axis=1)
+
     def search(self, queries: np.ndarray, k: int = 10, nprobe: int = 8
                ) -> Tuple[np.ndarray, np.ndarray]:
-        d, i = self._search(jnp.asarray(queries, jnp.float32), k, nprobe)
+        """Routes by batch size: when the batch's probed lists would
+        union to (most of) the whole index, one shared full-scan matmul
+        is both cheaper in HBM reads and exact; small batches keep the
+        per-query IVF gather (reads only nprobe lists)."""
+        q = jnp.asarray(queries, jnp.float32)
+        nlists = int(self.centroids.shape[0])
+        if len(queries) * nprobe >= nlists:
+            chunk = 1 << 17
+            d, i = self._search_full(q, k, min(chunk,
+                                               self.vectors.shape[0]))
+        else:
+            d, i = self._search(q, k, nprobe)
         return np.asarray(d), np.asarray(i)
 
     def __hash__(self):   # jit static self: identity-hashable

@@ -95,6 +95,13 @@ class Tablet:
     def _codec_for(self, table_id: str) -> TableCodec:
         return self.codecs.get(table_id, self.codec)
 
+    def schema_version_of(self, table_id: str) -> Optional[int]:
+        """Current schema version for the catalog-version write fence
+        (None when the table is unknown here — the write will fail with
+        a clearer error downstream)."""
+        codec = self._codec_for(table_id)
+        return codec.info.schema.version if codec is not None else None
+
     def alter_table(self, new_info: TableInfo) -> None:
         """Online schema change (reference: ChangeMetadataOperation,
         tablet/operations/change_metadata_operation.cc): adopt the new

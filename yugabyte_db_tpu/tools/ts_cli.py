@@ -1,0 +1,95 @@
+"""yb-ts-cli analog: per-TABLET-SERVER operations addressed directly at
+one tserver's RPC endpoint (reference: src/yb/tools/ts-cli.cc — the ops
+surface an operator points at a single node, no master involved).
+
+    python -m yugabyte_db_tpu.tools.ts_cli --server HOST:PORT <cmd> ...
+
+Commands:
+    status                      server uuid + per-tablet role/size/ssts
+    list_tablets                tablet ids with leadership
+    tablet_status TABLET_ID     one tablet's replica state
+    flush_tablet TABLET_ID      flush its memtable to an SST
+    compact_tablet TABLET_ID    major-compact it
+    mem_trackers                memory accounting rollup
+    server_clock                current hybrid time
+    set_flag NAME VALUE         hot-update a runtime flag on this server
+    list_flags                  all flag values on this server
+    leader_stepdown TABLET_ID   ask the replica to step down
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from ..rpc.messenger import Messenger, RpcError
+
+_MIN_ARGS = {"tablet_status": 1, "flush_tablet": 1, "compact_tablet": 1,
+             "set_flag": 2, "leader_stepdown": 1}
+
+_RPC_OF = {
+    "status": "status",
+    "tablet_status": "tablet_status",
+    "flush_tablet": "flush",
+    "compact_tablet": "compact",
+    "mem_trackers": "mem_trackers",
+    "server_clock": "server_clock",
+    "set_flag": "set_flag",
+    "list_flags": "list_flags",
+    "leader_stepdown": "leader_stepdown",
+}
+
+
+async def run_command(args) -> int:
+    host, port = args.server.rsplit(":", 1)
+    addr = (host, int(port))
+    m = Messenger("ts-cli")
+    await m.start()
+    try:
+        cmd, pos = args.command, args.args
+        if len(pos) < _MIN_ARGS.get(cmd, 0):
+            print(f"{cmd}: needs {_MIN_ARGS[cmd]} argument(s)",
+                  file=sys.stderr)
+            return 2
+        if cmd == "list_tablets":
+            r = await m.call(addr, "tserver", "status", {}, timeout=10.0)
+            out = [{"tablet_id": tid, **info}
+                   for tid, info in sorted(r["tablets"].items())]
+        elif cmd in ("tablet_status", "flush_tablet", "compact_tablet",
+                     "leader_stepdown"):
+            r = await m.call(addr, "tserver", _RPC_OF[cmd],
+                             {"tablet_id": pos[0]}, timeout=300.0)
+            out = r
+        elif cmd == "set_flag":
+            out = await m.call(addr, "tserver", "set_flag",
+                               {"name": pos[0], "value": pos[1]},
+                               timeout=10.0)
+        elif cmd in _RPC_OF:
+            out = await m.call(addr, "tserver", _RPC_OF[cmd], {},
+                               timeout=30.0)
+        else:
+            print(f"unknown command {cmd}", file=sys.stderr)
+            return 2
+        print(json.dumps(out, indent=1, default=str))
+        return 0
+    except (RpcError, OSError, asyncio.TimeoutError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    finally:
+        await m.shutdown()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="ts_cli", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--server", required=True,
+                    help="tserver RPC endpoint HOST:PORT")
+    ap.add_argument("command")
+    ap.add_argument("args", nargs="*")
+    return asyncio.run(run_command(ap.parse_args(argv)))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -255,6 +255,18 @@ class TabletServer:
     async def rpc_write(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
         req = write_request_from_wire(payload["req"])
+        if req.schema_version is not None:
+            # catalog-version fence: reject BEFORE replicating so a
+            # stale session's write (e.g. into a dropped column) can
+            # never reach the WAL; the client refreshes and retries
+            # (reference: schema version mismatch checks in
+            # tablet_service.cc + ysql_backends_manager.cc)
+            cur = peer.tablet.schema_version_of(req.table_id)
+            if cur is not None and req.schema_version != cur:
+                raise RpcError(
+                    f"schema version mismatch for {req.table_id}: "
+                    f"request {req.schema_version}, tablet {cur}",
+                    "SCHEMA_MISMATCH")
         with TRACES.trace(f"write:{payload['tablet_id']}"):
             with wait_status("OnCpu_WriteApply"):
                 resp = await peer.write(req)
@@ -670,6 +682,13 @@ class TabletServer:
     async def rpc_txn_write(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
         req = write_request_from_wire(payload["req"])
+        if req.schema_version is not None:
+            cur = peer.tablet.schema_version_of(req.table_id)
+            if cur is not None and req.schema_version != cur:
+                raise RpcError(
+                    f"schema version mismatch for {req.table_id}: "
+                    f"request {req.schema_version}, tablet {cur}",
+                    "SCHEMA_MISMATCH")
         n = await peer.write_txn(req, payload["txn_id"], payload["start_ht"],
                                  payload.get("status_tablet"))
         return {"rows_affected": n}
@@ -963,6 +982,26 @@ class TabletServer:
                 for tid, p in self.peers.items()
             },
         }
+
+    async def rpc_set_flag(self, payload) -> dict:
+        """Hot-update a runtime flag on THIS server (reference:
+        yb-ts-cli set_flag / server/server_base_options flag RPC)."""
+        from ..utils import flags as _flags
+        name, value = payload["name"], payload["value"]
+        old = _flags.get(name)          # KeyError -> RPC error surface
+        if isinstance(old, bool):
+            value = str(value).lower() in ("1", "true", "on", "yes")
+        elif isinstance(old, int):
+            value = int(value)
+        elif isinstance(old, float):
+            value = float(value)
+        _flags.set_flag(name, value)
+        return {"name": name, "old": old, "value": value}
+
+    async def rpc_list_flags(self, payload) -> dict:
+        from ..utils import flags as _flags
+        return {"flags": {n: repr(f.value)
+                          for n, f in _flags.REGISTRY.items()}}
 
     # --- heartbeats -------------------------------------------------------
     async def _heartbeat_loop(self):
