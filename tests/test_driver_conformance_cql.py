@@ -1,0 +1,62 @@
+"""cassandra-driver conformance against the YCQL server (skip-if-absent;
+see test_driver_conformance.py for the rationale)."""
+import asyncio
+import threading
+
+import pytest
+
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+cassandra = pytest.importorskip("cassandra",
+                                reason="cassandra-driver not installed")
+
+
+def test_cassandra_driver_crud(tmp_path):
+    from cassandra.cluster import Cluster
+
+    loop = asyncio.new_event_loop()
+    state = {}
+    ready = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            from yugabyte_db_tpu.ql.cql_server import CqlServer
+            state["mc"] = await MiniCluster(str(tmp_path),
+                                            num_tservers=1).start()
+            state["srv"] = CqlServer(state["mc"].client())
+            state["addr"] = await state["srv"].start()
+            ready.set()
+        loop.create_task(boot())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert ready.wait(30)
+    try:
+        host, port = state["addr"]
+        cluster = Cluster([host], port=port,
+                          connect_timeout=20)
+        session = cluster.connect()
+        session.execute(
+            "CREATE KEYSPACE IF NOT EXISTS ks WITH replication = "
+            "{'class': 'SimpleStrategy', 'replication_factor': 1}")
+        session.execute("CREATE TABLE ks.t (k bigint PRIMARY KEY, "
+                        "v double, s text)")
+        session.execute(
+            "INSERT INTO ks.t (k, v, s) VALUES (1, 2.5, 'one')")
+        ps = session.prepare(
+            "INSERT INTO ks.t (k, v, s) VALUES (?, ?, ?)")
+        session.execute(ps, (2, 3.5, "two"))
+        rows = list(session.execute("SELECT k, v, s FROM ks.t"))
+        assert sorted((r.k, r.v, r.s) for r in rows) == [
+            (1, 2.5, "one"), (2, 3.5, "two")]
+        cluster.shutdown()
+    finally:
+        async def stop():
+            await state["srv"].shutdown()
+            await state["mc"].shutdown()
+            loop.stop()
+        asyncio.run_coroutine_threadsafe(stop(), loop)
+        t.join(timeout=10)

@@ -1,0 +1,56 @@
+"""redis-py conformance against the YEDIS server (skip-if-absent; see
+test_driver_conformance.py for the rationale)."""
+import asyncio
+import threading
+
+import pytest
+
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+redis = pytest.importorskip("redis", reason="redis-py not installed")
+
+
+def test_redis_py_basic(tmp_path):
+    loop = asyncio.new_event_loop()
+    state = {}
+    ready = threading.Event()
+
+    def run():
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            from yugabyte_db_tpu.ql.redis_server import RedisServer
+            state["mc"] = await MiniCluster(str(tmp_path),
+                                            num_tservers=1).start()
+            state["srv"] = RedisServer(state["mc"].client())
+            state["addr"] = await state["srv"].start()
+            ready.set()
+        loop.create_task(boot())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    assert ready.wait(30)
+    try:
+        host, port = state["addr"]
+        r = redis.Redis(host=host, port=port, socket_timeout=20)
+        assert r.ping()
+        r.set("k1", "v1")
+        assert r.get("k1") == b"v1"
+        assert r.incr("cnt") == 1
+        assert r.incr("cnt") == 2
+        r.hset("h", "f", "x")
+        assert r.hget("h", "f") == b"x"
+        r.rpush("l", "a", "b")
+        assert r.lrange("l", 0, -1) == [b"a", b"b"]
+        r.sadd("s", "m1", "m2")
+        assert r.sismember("s", "m1")
+        assert r.delete("k1") == 1
+        assert r.get("k1") is None
+    finally:
+        async def stop():
+            await state["srv"].shutdown()
+            await state["mc"].shutdown()
+            loop.stop()
+        asyncio.run_coroutine_threadsafe(stop(), loop)
+        t.join(timeout=10)

@@ -1,0 +1,170 @@
+"""Tablespaces / geo-placement: per-zone replica minimums, preferred
+leader zones, placement-aware balancing (reference:
+master/ysql_tablespace_manager.cc, placement handling + preferred-zone
+leader affinity in master/cluster_balance.cc)."""
+import asyncio
+
+import pytest
+
+from yugabyte_db_tpu.docdb.table_codec import TableInfo
+from yugabyte_db_tpu.dockv.packed_row import (ColumnSchema, ColumnType,
+                                              TableSchema)
+from yugabyte_db_tpu.dockv.partition import PartitionSchema
+from yugabyte_db_tpu.ql import SqlSession
+from yugabyte_db_tpu.rpc.messenger import RpcError
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+
+def _info(name):
+    schema = TableSchema(columns=(
+        ColumnSchema(0, "k", ColumnType.INT64, is_hash_key=True),
+        ColumnSchema(1, "v", ColumnType.FLOAT64),
+    ), version=1)
+    return TableInfo(name, name, schema, PartitionSchema("hash", 1))
+
+
+def _zone_of(mc, uuid):
+    return mc.master.tservers[uuid]["zone"]
+
+
+def test_create_honors_tablespace_minimums(tmp_path):
+    async def go():
+        mc = await MiniCluster(str(tmp_path), num_tservers=4,
+                               zones=["z1", "z1", "z2", "z3"]).start()
+        try:
+            c = mc.client()
+            await c.create_tablespace(
+                "geo", placement=[{"zone": "z2", "min_replicas": 1},
+                                  {"zone": "z3", "min_replicas": 1}],
+                preferred_zones=["z2"])
+            assert "geo" in await c.list_tablespaces()
+            await c.create_table(_info("gt"), num_tablets=2,
+                                 replication_factor=3, tablespace="geo")
+            for ent in mc.master.tablets.values():
+                zones = {_zone_of(mc, u) for u in ent["replicas"]}
+                assert {"z2", "z3"} <= zones, zones
+            # unknown tablespace is rejected
+            with pytest.raises(RpcError):
+                await c.create_table(_info("bad"), tablespace="nope")
+            # in-use tablespace cannot drop
+            with pytest.raises(RpcError):
+                await c.drop_tablespace("geo")
+        finally:
+            await mc.shutdown()
+    asyncio.run(go())
+
+
+def test_universe_placement_default(tmp_path):
+    async def go():
+        mc = await MiniCluster(str(tmp_path), num_tservers=3,
+                               zones=["za", "zb", "zb"]).start()
+        try:
+            c = mc.client()
+            await c.set_placement_info(
+                placement=[{"zone": "za", "min_replicas": 1}])
+            await c.create_table(_info("ut"), num_tablets=2,
+                                 replication_factor=2)
+            for ent in mc.master.tablets.values():
+                zones = {_zone_of(mc, u) for u in ent["replicas"]}
+                assert "za" in zones
+        finally:
+            await mc.shutdown()
+    asyncio.run(go())
+
+
+def test_lb_repairs_placement_violation(tmp_path):
+    """A tablet violating its zone minimums gets a repair move."""
+    async def go():
+        mc = await MiniCluster(str(tmp_path), num_tservers=4,
+                               zones=["z1", "z1", "z2", "z2"]).start()
+        try:
+            c = mc.client()
+            await c.create_table(_info("rt"), num_tablets=1,
+                                 replication_factor=2)
+            await mc.wait_for_leaders("rt")
+            # force both replicas into z1 by rewriting the catalog,
+            # then declare a policy requiring one replica in z2
+            m = mc.master
+            z1 = [u for u in m.tservers if _zone_of(mc, u) == "z1"]
+            tid, ent = next((t, e) for t, e in m.tablets.items())
+            if set(ent["replicas"]) != set(z1):
+                # move any z2 replica to the unused z1 server
+                for u in list(ent["replicas"]):
+                    if _zone_of(mc, u) == "z2":
+                        dst = next(x for x in z1
+                                   if x not in ent["replicas"])
+                        ok = await m.load_balancer.move_replica(
+                            tid, u, dst)
+                        assert ok
+                        ent = m.tablets[tid]
+            assert {_zone_of(mc, u) for u in ent["replicas"]} == {"z1"}
+            await c.create_tablespace(
+                "need-z2", placement=[{"zone": "z2",
+                                       "min_replicas": 1}])
+            m.tables[ent["table_id"]]["tablespace"] = "need-z2"
+            # LB tick must repair the violation
+            for _ in range(6):
+                action = await m.load_balancer.tick()
+                if action and "placement" in action:
+                    break
+                await asyncio.sleep(0.1)
+            ent = m.tablets[tid]
+            zones = {_zone_of(mc, u) for u in ent["replicas"]}
+            assert "z2" in zones, zones
+        finally:
+            await mc.shutdown()
+    asyncio.run(go())
+
+
+def test_preferred_zone_leader_stepdown(tmp_path):
+    async def go():
+        mc = await MiniCluster(str(tmp_path), num_tservers=3,
+                               zones=["z1", "z2", "z2"]).start()
+        try:
+            c = mc.client()
+            await c.set_placement_info(preferred_zones=["z1"])
+            await c.create_table(_info("pt"), num_tablets=1,
+                                 replication_factor=3)
+            await mc.wait_for_leaders("pt")
+            m = mc.master
+            tid, ent = next((t, e) for t, e in m.tablets.items()
+                            if e["table_id"] ==
+                            next(i for i, t2 in m.tables.items()
+                                 if t2["info"]["name"] == "pt"))
+            # drive ticks until the leader lands in z1
+            for _ in range(30):
+                await m.load_balancer.tick()
+                await asyncio.sleep(0.2)
+                # heartbeats refresh leadership reports
+                ent = m.tablets[tid]
+                if ent.get("leader") and \
+                        _zone_of(mc, ent["leader"]) == "z1":
+                    break
+            assert ent.get("leader") is not None
+            assert _zone_of(mc, ent["leader"]) == "z1"
+        finally:
+            await mc.shutdown()
+    asyncio.run(go())
+
+
+def test_sql_create_tablespace_option(tmp_path):
+    async def go():
+        mc = await MiniCluster(str(tmp_path), num_tservers=2,
+                               zones=["z1", "z2"]).start()
+        try:
+            c = mc.client()
+            await c.create_tablespace(
+                "sp", placement=[{"zone": "z2", "min_replicas": 1}])
+            s = SqlSession(c)
+            await s.execute("CREATE TABLE st (k bigint, v double, "
+                            "PRIMARY KEY (k)) WITH tablets = 1 "
+                            "WITH tablespace = 'sp'")
+            m = mc.master
+            tid = next(i for i, t in m.tables.items()
+                       if t["info"]["name"] == "st")
+            assert m.tables[tid].get("tablespace") == "sp"
+            ent = m.tablets[m.tables[tid]["tablets"][0]]
+            assert {_zone_of(mc, u) for u in ent["replicas"]} == {"z2"}
+        finally:
+            await mc.shutdown()
+    asyncio.run(go())

@@ -114,10 +114,36 @@ class YBClient:
         raise last or RpcError("no master reachable", "TIMED_OUT")
 
     # --- DDL --------------------------------------------------------------
+    async def create_tablespace(self, name: str, placement=(),
+                                preferred_zones=(),
+                                or_replace: bool = False) -> None:
+        """Named geo-placement policy (reference: YSQL tablespaces,
+        master/ysql_tablespace_manager.cc). placement: iterable of
+        {"zone": z, "min_replicas": n}."""
+        await self._master_call("create_tablespace", {
+            "name": name, "placement": list(placement),
+            "preferred_zones": list(preferred_zones),
+            "or_replace": or_replace})
+
+    async def drop_tablespace(self, name: str) -> None:
+        await self._master_call("drop_tablespace", {"name": name})
+
+    async def list_tablespaces(self) -> dict:
+        return (await self._master_call("list_tablespaces",
+                                        {}))["tablespaces"]
+
+    async def set_placement_info(self, placement=(),
+                                 preferred_zones=()) -> None:
+        """Universe-wide placement defaults + preferred leader zones."""
+        await self._master_call("set_placement_info", {
+            "placement": list(placement),
+            "preferred_zones": list(preferred_zones)})
+
     async def create_table(self, info: TableInfo, num_tablets: int = 2,
                            replication_factor: int = 1,
                            tablegroup: Optional[str] = None,
-                           split_rows=None) -> str:
+                           split_rows=None,
+                           tablespace: Optional[str] = None) -> str:
         """split_rows: for range-sharded tables, PK rows whose encoded
         keys become the tablet split points."""
         split_points = None
@@ -132,7 +158,8 @@ class YBClient:
             {"name": info.name, "table": info.to_wire(),
              "num_tablets": num_tablets,
              "replication_factor": replication_factor,
-             "tablegroup": tablegroup, "split_points": split_points})
+             "tablegroup": tablegroup, "split_points": split_points,
+             "tablespace_name": tablespace})
         return resp["table_id"]
 
     async def create_tablegroup(self, name: str,

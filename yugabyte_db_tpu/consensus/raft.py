@@ -696,10 +696,13 @@ class RaftConsensus:
                 "last_index": self.log.last_index}
 
     # ------------------------------------------------------------------
-    async def step_down(self):
+    async def step_down(self, transfer_to: Optional[str] = None):
         """Graceful leadership handoff (reference: LeaderStepDown RPC):
         push one final round of appends, then become a follower with a
-        long election deadline so a peer wins the next election."""
+        long election deadline so a peer wins the next election. With
+        `transfer_to`, nudge that peer to campaign immediately (Raft
+        leadership transfer / TimeoutNow, §3.10) so the next leader is
+        the intended one rather than whichever timer fires first."""
         if self.role != Role.LEADER:
             return
         await self._broadcast()
@@ -707,6 +710,23 @@ class RaftConsensus:
         self._lease_expiry = 0.0
         base = flags.get("raft_heartbeat_interval_ms") / 1000.0
         self._election_deadline = time.monotonic() + base * 20
+        if transfer_to:
+            spec = next((p for p in self.config.peers
+                         if p.uuid == transfer_to), None)
+            if spec is not None:
+                try:
+                    await self.messenger.call(
+                        spec.addr, f"consensus-{self.tablet_id}",
+                        "timeout_now", {}, timeout=2.0)
+                except Exception:  # noqa: BLE001 — best-effort nudge;
+                    pass           # the normal timer elects otherwise
+
+    async def rpc_timeout_now(self, req) -> dict:
+        """TimeoutNow (leadership transfer target): campaign right away
+        instead of waiting for the election timer."""
+        if self.role != Role.LEADER:
+            await self._run_election()
+        return {"ok": True}
 
     def is_leader(self) -> bool:
         return self.role == Role.LEADER

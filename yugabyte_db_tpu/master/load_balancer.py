@@ -17,11 +17,17 @@ from ..rpc.messenger import RpcError
 
 
 class ClusterLoadBalancer:
+    # seconds between preferred-zone stepdowns of the SAME tablet: the
+    # transfer is best-effort (the target must win its election), so
+    # retries must not become per-tick availability churn
+    STEPDOWN_COOLDOWN_S = 15.0
+
     def __init__(self, master):
         self.master = master
         self.moves_done = 0
         self.leader_moves_done = 0
         self.blacklist: set = set()          # ts uuids being drained
+        self._stepdown_at: Dict[str, float] = {}   # tablet -> last try
 
     # --- state ------------------------------------------------------------
     def _replica_counts(self) -> Dict[str, int]:
@@ -44,13 +50,91 @@ class ClusterLoadBalancer:
                 counts[l] += 1
         return counts
 
+    def _zone_of(self, u: str) -> str:
+        ts = self.master.tservers.get(u) or {}
+        return ts.get("zone", "zone-default")
+
+    def _zone_counts(self, ent) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for u in ent["replicas"]:
+            z = self._zone_of(u)
+            out[z] = out.get(z, 0) + 1
+        return out
+
+    def _placement_violation_after(self, ent, src: str) -> bool:
+        """True if removing the replica on `src` would take a placement
+        block below its minimum."""
+        pol = self.master.placement_of(ent["table_id"])
+        if not pol or not pol.get("placement"):
+            return False
+        zc = self._zone_counts(ent)
+        z = self._zone_of(src)
+        for block in pol["placement"]:
+            if block.get("zone") == z and \
+                    zc.get(z, 0) - 1 < block.get("min_replicas", 1):
+                return True
+        return False
+
     # --- one balancing step -------------------------------------------------
     async def tick(self) -> Optional[str]:
-        """Returns a description of the action taken, or None."""
+        """Returns a description of the action taken, or None.
+        Priority order mirrors the reference's ClusterLoadBalancer:
+        placement repair first (a tablet violating its geo policy),
+        then replica-count balance, then leader placement/balance."""
+        action = await self._maybe_fix_placement()
+        if action:
+            return action
         action = await self._maybe_move_replica()
         if action:
             return action
         return await self._maybe_move_leader()
+
+    async def _maybe_fix_placement(self) -> Optional[str]:
+        """Move one replica to satisfy an unmet per-zone minimum
+        (reference: placement-block handling in cluster_balance.cc)."""
+        m = self.master
+        live = set(m.live_tservers()) - self.blacklist
+        for tablet_id, ent in m.tablets.items():
+            if ent.get("hidden"):
+                continue
+            pol = m.placement_of(ent["table_id"])
+            if not pol or not pol.get("placement"):
+                continue
+            zc = self._zone_counts(ent)
+            for block in pol["placement"]:
+                zone, need = block.get("zone"), block.get(
+                    "min_replicas", 1)
+                if zc.get(zone, 0) >= need:
+                    continue
+                dsts = [u for u in live
+                        if self._zone_of(u) == zone
+                        and u not in ent["replicas"]]
+                if not dsts:
+                    continue       # zone has no capacity: leave as-is
+                dst = min(dsts, key=lambda u: len(
+                    m.tservers[u].get("tablets", [])))
+                # move out of the most over-represented zone (one whose
+                # count exceeds its own minimum, or isn't in the
+                # policy) — but NEVER out of a zone sitting at its own
+                # minimum: an unsatisfiable policy must converge to
+                # best-effort, not oscillate replicas between zones
+                mins = {b.get("zone"): b.get("min_replicas", 1)
+                        for b in pol["placement"]}
+                srcs = sorted(
+                    ent["replicas"],
+                    key=lambda u: zc.get(self._zone_of(u), 0)
+                    - mins.get(self._zone_of(u), 0),
+                    reverse=True)
+                for src in srcs:
+                    sz = self._zone_of(src)
+                    if sz == zone or \
+                            zc.get(sz, 0) - 1 < mins.get(sz, 0):
+                        continue
+                    if await self.move_replica(tablet_id, src, dst):
+                        self.moves_done += 1
+                        return (f"placement {tablet_id} {src}->{dst} "
+                                f"(zone {zone})")
+        return None
 
     async def _maybe_move_replica(self) -> Optional[str]:
         counts = self._replica_counts()
@@ -67,17 +151,34 @@ class ClusterLoadBalancer:
         overloaded = src in self.blacklist and counts[src] > 0
         if not overloaded and counts[src] - counts.get(dst, 0) < 2:
             return None
-        # find a tablet on src not on dst
+        # find a tablet on src and a destination whose move keeps its
+        # policy: prefer the globally least-loaded dst, but a tablet
+        # pinned to src's zone by a placement minimum may instead move
+        # to a same-zone destination (otherwise draining the only node
+        # of a required zone could wedge)
+        src_zone = self._zone_of(src)
+        same_zone_dsts = sorted(
+            (u for u in eligible_dst
+             if u != src and self._zone_of(u) == src_zone),
+            key=eligible_dst.get)
         for tablet_id, ent in self.master.tablets.items():
             if ent.get("hidden"):
                 # moving a hidden parent would invalidate the replica
                 # addresses replication slots reach it by
                 continue
-            if src in ent["replicas"] and dst not in ent["replicas"]:
-                ok = await self.move_replica(tablet_id, src, dst)
-                if ok:
+            if src not in ent["replicas"]:
+                continue
+            pinned = self._placement_violation_after(ent, src)
+            cands = ([dst] if not pinned
+                     or self._zone_of(dst) == src_zone
+                     else same_zone_dsts)
+            for d in cands:
+                if d in ent["replicas"]:
+                    continue
+                if await self.move_replica(tablet_id, src, d):
                     self.moves_done += 1
-                    return f"moved {tablet_id} {src}->{dst}"
+                    return f"moved {tablet_id} {src}->{d}"
+                break       # move failed: try the next tablet
         return None
 
     async def move_replica(self, tablet_id: str, from_uuid: str,
@@ -200,6 +301,45 @@ class ClusterLoadBalancer:
         raise last or RpcError(f"no leader for {method}", "TIMED_OUT")
 
     async def _maybe_move_leader(self) -> Optional[str]:
+        m = self.master
+        live = set(m.live_tservers())
+        # preferred-zone pass (reference: set_preferred_zones +
+        # leader affinity in cluster_balance.cc): a leader sitting
+        # outside its table's preferred zones transfers to a LIVE
+        # replica inside one (targeted TimeoutNow), with a per-tablet
+        # cooldown — the transfer is best-effort and must not churn
+        import time as _time
+        for tablet_id, ent in m.tablets.items():
+            leader = ent.get("leader")
+            if ent.get("hidden") or not leader or \
+                    leader not in m.tservers:
+                continue
+            pol = m.placement_of(ent["table_id"])
+            pref = (pol or {}).get("preferred_zones") or []
+            if not pref or self._zone_of(leader) in pref:
+                continue
+            target = next(
+                (u for u in ent["replicas"]
+                 if u != leader and u in live
+                 and self._zone_of(u) in pref), None)
+            if target is None:
+                continue
+            now = _time.monotonic()
+            if now - self._stepdown_at.get(tablet_id, 0.0) < \
+                    self.STEPDOWN_COOLDOWN_S:
+                continue
+            self._stepdown_at[tablet_id] = now
+            try:
+                await m.messenger.call(
+                    m.tservers[leader]["addr"], "tserver",
+                    "leader_stepdown",
+                    {"tablet_id": tablet_id, "target_uuid": target},
+                    timeout=10.0)
+                self.leader_moves_done += 1
+                return (f"stepdown {tablet_id} -> {target} "
+                        f"(preferred zone(s) {pref})")
+            except (RpcError, asyncio.TimeoutError, OSError):
+                continue
         counts = self._leader_counts()
         if len(counts) < 2:
             return None
@@ -207,7 +347,6 @@ class ClusterLoadBalancer:
         dst = min(counts, key=counts.get)
         if counts[src] - counts[dst] < 2:
             return None
-        m = self.master
         for tablet_id, ent in m.tablets.items():
             if ent.get("hidden"):
                 continue

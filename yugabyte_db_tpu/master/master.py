@@ -53,6 +53,14 @@ class Master:
         # view name -> SELECT body SQL (persisted verbatim; expanded
         # by the SQL layer at query time — reference: PG pg_views)
         self.views: Dict[str, str] = {}
+        # tablespace name -> placement policy (reference: YSQL
+        # tablespaces as geo-placement policies,
+        # master/ysql_tablespace_manager.cc):
+        #   {"placement": [{"zone": z, "min_replicas": n}, ...],
+        #    "preferred_zones": [z, ...]}
+        # the reserved name "cluster" is the universe-wide default
+        # (reference: --placement_* flags / set_preferred_zones)
+        self.tablespaces: Dict[str, dict] = {}
         self._load()
         self.messenger.register_service("master", self)
         self.messenger.register_service("master-heartbeat", self)
@@ -122,6 +130,10 @@ class Master:
                 self.views[op[1]] = op[2]
             elif kind == "del_view":
                 self.views.pop(op[1], None)
+            elif kind == "put_tablespace":
+                self.tablespaces[op[1]] = op[2]
+            elif kind == "del_tablespace":
+                self.tablespaces.pop(op[1], None)
         self._persist()
 
     async def _commit_catalog(self, ops) -> None:
@@ -169,6 +181,7 @@ class Master:
             self.replication_slots = d.get("repl_slots", {})
             self.sequences = d.get("sequences", {})
             self.views = d.get("views", {})
+            self.tablespaces = d.get("tablespaces", {})
 
     def _persist(self):
         tmp = self._catalog_path + ".tmp"
@@ -177,7 +190,8 @@ class Master:
                        "xcluster": self.xcluster_replication,
                        "repl_slots": self.replication_slots,
                        "sequences": self.sequences,
-                       "views": self.views}, f)
+                       "views": self.views,
+                       "tablespaces": self.tablespaces}, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._catalog_path)
@@ -351,10 +365,16 @@ class Master:
                         for h in payload.get("split_points") or []]
         parts = info.partition_schema.create_partitions(
             num_tablets, split_points=split_points or None)
+        tspace = payload.get("tablespace_name")
+        if tspace and tspace not in self.tablespaces:
+            raise RpcError(f"tablespace {tspace} not found", "NOT_FOUND")
+        policy = (self.tablespaces.get(tspace) if tspace
+                  else self.tablespaces.get("cluster")) or {}
         tablet_entries = {}
         for i, p in enumerate(parts):
             tablet_id = f"{table_id}-t{i}"
-            replicas = self._choose_replicas(live, rf, i)
+            replicas = self._choose_replicas(
+                live, rf, i, placement=policy.get("placement"))
             tablet_entries[tablet_id] = {
                 "tablet_id": tablet_id, "table_id": table_id,
                 "partition": [p.start.hex(), p.end.hex()],
@@ -380,8 +400,10 @@ class Master:
                          "raft_peers": raft_peers,
                          "is_status_tablet": is_status},
                         timeout=10.0)
-            ops = [["put_table", table_id,
-                    {"info": info_wire, "tablets": list(tablet_entries)}]]
+            tent = {"info": info_wire, "tablets": list(tablet_entries)}
+            if tspace:
+                tent["tablespace"] = tspace
+            ops = [["put_table", table_id, tent]]
             ops += [["put_tablet", tid_, ent]
                     for tid_, ent in tablet_entries.items()]
             await self._commit_catalog(ops)
@@ -414,26 +436,89 @@ class Master:
         await self._commit_catalog(ops)
         return {"table_id": table_id, "tablets": [tablet_id]}
 
-    def _choose_replicas(self, live: List[str], rf: int, salt: int
-                         ) -> List[str]:
+    def _choose_replicas(self, live: List[str], rf: int, salt: int,
+                         placement: Optional[list] = None) -> List[str]:
         """Zone-spreading, least-loaded placement (reference: placement
-        policy handling in cluster_balance.cc/catalog_manager): pick one
-        replica per zone round-robin before doubling up."""
+        policy handling in cluster_balance.cc/catalog_manager): satisfy
+        the policy's per-zone minimums first, then pick one replica per
+        zone round-robin before doubling up."""
         chosen: List[str] = []
         used_zones: Dict[str, int] = {}
         candidates = sorted(
             live, key=lambda u: (len(self.tservers[u].get("tablets", [])),
                                  hash((u, salt)) & 0xFFFF))
-        while len(chosen) < rf and candidates:
-            best = min(candidates, key=lambda u: (
-                used_zones.get(self.tservers[u].get("zone", "z"), 0),
-                len(self.tservers[u].get("tablets", [])),
-                hash((u, salt)) & 0xFFFF))
+
+        def take(best):
             chosen.append(best)
             z = self.tservers[best].get("zone", "z")
             used_zones[z] = used_zones.get(z, 0) + 1
             candidates.remove(best)
+
+        for block in placement or ():
+            zone, need = block.get("zone"), block.get("min_replicas", 1)
+            for _ in range(need):
+                if len(chosen) >= rf:
+                    break
+                in_zone = [u for u in candidates
+                           if self.tservers[u].get("zone") == zone]
+                if not in_zone:
+                    break        # zone unavailable: best-effort remainder
+                take(min(in_zone, key=lambda u: (
+                    len(self.tservers[u].get("tablets", [])),
+                    hash((u, salt)) & 0xFFFF)))
+        while len(chosen) < rf and candidates:
+            take(min(candidates, key=lambda u: (
+                used_zones.get(self.tservers[u].get("zone", "z"), 0),
+                len(self.tservers[u].get("tablets", [])),
+                hash((u, salt)) & 0xFFFF)))
         return chosen
+
+    def placement_of(self, table_id: str) -> Optional[dict]:
+        """Effective placement policy for a table: its tablespace if
+        set, else the universe default ('cluster'), else None."""
+        ent = self.tables.get(table_id)
+        name = (ent or {}).get("tablespace")
+        pol = self.tablespaces.get(name) if name else None
+        return pol or self.tablespaces.get("cluster")
+
+    # --- tablespaces / geo-placement (reference:
+    # master/ysql_tablespace_manager.cc, set_preferred_zones) ------------
+    async def rpc_create_tablespace(self, payload) -> dict:
+        self._check_leader()
+        name = payload["name"]
+        if name in self.tablespaces and not payload.get("or_replace"):
+            raise RpcError(f"tablespace {name} exists", "ALREADY_PRESENT")
+        pol = {"placement": list(payload.get("placement") or []),
+               "preferred_zones": list(payload.get("preferred_zones")
+                                       or [])}
+        await self._commit_catalog([["put_tablespace", name, pol]])
+        return {"name": name}
+
+    async def rpc_drop_tablespace(self, payload) -> dict:
+        self._check_leader()
+        name = payload["name"]
+        if name not in self.tablespaces:
+            raise RpcError(f"tablespace {name} not found", "NOT_FOUND")
+        used = [e["info"]["name"] for e in self.tables.values()
+                if e.get("tablespace") == name]
+        if used:
+            raise RpcError(f"tablespace {name} in use by {used}",
+                           "INVALID_ARGUMENT")
+        await self._commit_catalog([["del_tablespace", name]])
+        return {"ok": True}
+
+    async def rpc_list_tablespaces(self, payload) -> dict:
+        return {"tablespaces": dict(self.tablespaces)}
+
+    async def rpc_set_placement_info(self, payload) -> dict:
+        """Universe-wide placement + preferred zones (the reserved
+        'cluster' tablespace)."""
+        self._check_leader()
+        pol = {"placement": list(payload.get("placement") or []),
+               "preferred_zones": list(payload.get("preferred_zones")
+                                       or [])}
+        await self._commit_catalog([["put_tablespace", "cluster", pol]])
+        return {"ok": True}
 
     async def rpc_alter_table(self, payload) -> dict:
         """ADD COLUMN: bump the schema version, replicate the new schema

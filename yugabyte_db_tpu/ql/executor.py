@@ -452,7 +452,8 @@ class SqlSession:
             else PartitionSchema("hash", 1))
         await self.client.create_table(
             info, num_tablets=stmt.num_tablets,
-            replication_factor=stmt.replication_factor)
+            replication_factor=stmt.replication_factor,
+            tablespace=getattr(stmt, "tablespace", None))
         return SqlResult([], "CREATE TABLE")
 
     def _invalidate_stats(self, table: str) -> None:

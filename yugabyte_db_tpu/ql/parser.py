@@ -88,6 +88,7 @@ class CreateTableStmt:
     if_not_exists: bool = False
     defaults: Dict[str, object] = field(default_factory=dict)
     not_null: List[str] = field(default_factory=list)
+    tablespace: Optional[str] = None   # WITH tablespace = 'name'
 
 
 @dataclass
@@ -392,20 +393,22 @@ class Parser:
             if not self.accept_op(","):
                 break
         self.expect_op(")")
-        num_tablets, rf = 2, 1
+        num_tablets, rf, tspace = 2, 1, None
         while self.accept_kw("with"):
             k = self.ident().lower()
             self.expect_op("=")
-            v = int(self.next()[1])
+            t = self.next()
             if k == "tablets":
-                num_tablets = v
+                num_tablets = int(t[1])
             elif k == "replication":
-                rf = v
+                rf = int(t[1])
+            elif k == "tablespace":
+                tspace = str(t[1])
         if not pk:
             raise ValueError("PRIMARY KEY required")
         return CreateTableStmt(name, cols, pk, range_sharded, pk_desc,
                                num_hash, num_tablets, rf, ine,
-                               defaults, not_null)
+                               defaults, not_null, tablespace=tspace)
 
     def _column_type(self) -> str:
         """One column type: plain (`bigint`), parameterized

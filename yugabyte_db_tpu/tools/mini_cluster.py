@@ -18,10 +18,14 @@ from ..tserver import TabletServer
 
 class MiniCluster:
     def __init__(self, root: str, num_tservers: int = 3,
-                 num_masters: int = 1):
+                 num_masters: int = 1,
+                 zones: Optional[List[str]] = None):
+        """zones: per-tserver zone labels (index-aligned, cycled when
+        shorter) for geo-placement tests."""
         self.root = root
         self.num_tservers = num_tservers
         self.num_masters = num_masters
+        self.zones = zones
         self.masters: List[Master] = []
         self.tservers: List[TabletServer] = []
 
@@ -54,8 +58,10 @@ class MiniCluster:
                 await asyncio.sleep(0.05)
         maddrs = self.master_addrs()
         for i in range(self.num_tservers):
+            zone = (self.zones[i % len(self.zones)] if self.zones
+                    else "zone-default")
             ts = TabletServer(f"ts-{i}", os.path.join(self.root, f"ts-{i}"),
-                              master_addrs=maddrs)
+                              master_addrs=maddrs, zone=zone)
             await ts.start()
             self.tservers.append(ts)
         await self.wait_for_tservers()

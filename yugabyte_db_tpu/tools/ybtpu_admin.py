@@ -13,7 +13,10 @@ restore_snapshot_schedule SCHEDULE_ID AT_UNIX_TS NEW_TABLE,
 setup_xcluster SOURCE_HOST:PORT TABLE, drop_xcluster TABLE,
 list_xcluster,
 split_tablet TABLET_ID, move_replica TABLET_ID FROM TO, balance_tick,
-blacklist TS_UUID, compact_table TABLE, flush_table TABLE
+blacklist TS_UUID, compact_table TABLE, flush_table TABLE,
+create_tablespace NAME ZONE:MIN[,ZONE:MIN...] [PREF[,PREF...]],
+set_placement_info ZONE:MIN[,...] [PREF[,...]], list_tablespaces,
+drop_tablespace NAME
 """
 from __future__ import annotations
 
@@ -33,6 +36,8 @@ _MIN_ARGS = {
     "split_tablet": 1, "move_replica": 3, "blacklist": 1,
     "setup_xcluster": 2, "drop_xcluster": 1,
     "compact_table": 1, "flush_table": 1,
+    "create_tablespace": 2, "set_placement_info": 1,
+    "drop_tablespace": 1,
 }
 
 
@@ -112,6 +117,26 @@ async def run_command(args) -> int:
         print(json.dumps(r))
     elif cmd == "blacklist":
         r = await m.call(maddr, "master", "blacklist", {"ts_uuid": a[0]})
+        print(json.dumps(r))
+    elif cmd in ("create_tablespace", "set_placement_info"):
+        # args: [NAME] ZONE:MIN[,ZONE:MIN...] [PREF_ZONE[,PREF_ZONE...]]
+        pos = 0 if cmd == "set_placement_info" else 1
+        placement = [{"zone": z, "min_replicas": int(n)}
+                     for z, n in (b.split(":") for b in
+                                  a[pos].split(",") if b)]
+        pref = a[pos + 1].split(",") if len(a) > pos + 1 else []
+        payload = {"placement": placement, "preferred_zones": pref}
+        if cmd == "create_tablespace":
+            payload["name"] = a[0]
+        r = await m.call(maddr, "master", cmd, payload, timeout=30.0)
+        print(json.dumps(r))
+    elif cmd == "list_tablespaces":
+        r = await m.call(maddr, "master", "list_tablespaces", {},
+                         timeout=30.0)
+        print(json.dumps(r, indent=1))
+    elif cmd == "drop_tablespace":
+        r = await m.call(maddr, "master", "drop_tablespace",
+                         {"name": a[0]}, timeout=30.0)
         print(json.dumps(r))
     elif cmd in ("compact_table", "flush_table"):
         method = "compact" if cmd == "compact_table" else "flush"

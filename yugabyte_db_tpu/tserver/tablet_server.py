@@ -458,7 +458,8 @@ class TabletServer:
 
     async def rpc_leader_stepdown(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
-        await peer.consensus.step_down()
+        await peer.consensus.step_down(
+            transfer_to=payload.get("target_uuid"))
         return {"ok": True}
 
     async def rpc_server_clock(self, payload) -> dict:
