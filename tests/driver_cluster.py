@@ -1,0 +1,45 @@
+"""Shared helper for external-driver conformance tests: run a
+MiniCluster + one wire server on a background event loop so synchronous
+drivers (psycopg, cassandra-driver, redis-py) can connect from the test
+thread."""
+import asyncio
+import threading
+
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+
+class ClusterThread:
+    """`server_factory(client) -> server` where server has async
+    start() -> addr and shutdown()."""
+
+    def __init__(self, tmp_path, server_factory):
+        self.tmp = str(tmp_path)
+        self.server_factory = server_factory
+        self.loop = asyncio.new_event_loop()
+        self.addr = None
+        self.ready = threading.Event()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+
+        async def boot():
+            self.mc = await MiniCluster(self.tmp, num_tservers=1).start()
+            self.srv = self.server_factory(self.mc.client())
+            self.addr = await self.srv.start()
+            self.ready.set()
+        self.loop.create_task(boot())
+        self.loop.run_forever()
+
+    def __enter__(self):
+        self.thread.start()
+        assert self.ready.wait(30)
+        return self
+
+    def __exit__(self, *exc):
+        async def stop():
+            await self.srv.shutdown()
+            await self.mc.shutdown()
+            self.loop.stop()
+        asyncio.run_coroutine_threadsafe(stop(), self.loop)
+        self.thread.join(timeout=10)

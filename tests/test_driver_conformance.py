@@ -10,58 +10,23 @@ cassandra-driver redis` is possible. The in-repo wire tests
 same framing byte-for-byte, so protocol drift is still caught without
 the drivers — these add the actual-client handshake/behavior layer.
 """
-import asyncio
-import socket
-import threading
 import time
 
 import pytest
 
-from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+from tests.driver_cluster import ClusterThread
 
 psycopg = pytest.importorskip("psycopg", reason="psycopg not installed")
 
 
-class ClusterThread:
-    """Run MiniCluster + wire servers on a background event loop so
-    synchronous drivers can connect from the test thread."""
-
-    def __init__(self, tmp_path):
-        self.tmp = str(tmp_path)
-        self.loop = asyncio.new_event_loop()
-        self.pg_addr = None
-        self.ready = threading.Event()
-        self.thread = threading.Thread(target=self._run, daemon=True)
-
-    def _run(self):
-        asyncio.set_event_loop(self.loop)
-
-        async def boot():
-            from yugabyte_db_tpu.ql.pg_server import PgServer
-            self.mc = await MiniCluster(self.tmp, num_tservers=1).start()
-            self.pg = PgServer(self.mc.client())
-            self.pg_addr = await self.pg.start()
-            self.ready.set()
-        self.loop.create_task(boot())
-        self.loop.run_forever()
-
-    def __enter__(self):
-        self.thread.start()
-        assert self.ready.wait(30)
-        return self
-
-    def __exit__(self, *exc):
-        async def stop():
-            await self.pg.shutdown()
-            await self.mc.shutdown()
-            self.loop.stop()
-        asyncio.run_coroutine_threadsafe(stop(), self.loop)
-        self.thread.join(timeout=10)
+def _pg_cluster(tmp_path):
+    from yugabyte_db_tpu.ql.pg_server import PgServer
+    return ClusterThread(tmp_path, PgServer)
 
 
 def test_psycopg_crud_and_prepared(tmp_path):
-    with ClusterThread(tmp_path) as ct:
-        host, port = ct.pg_addr
+    with _pg_cluster(tmp_path) as ct:
+        host, port = ct.addr
         with psycopg.connect(host=host, port=port, dbname="yb",
                              user="yb", autocommit=True) as conn:
             cur = conn.cursor()
@@ -89,8 +54,8 @@ def test_psycopg_crud_and_prepared(tmp_path):
 
 
 def test_psycopg_txn(tmp_path):
-    with ClusterThread(tmp_path) as ct:
-        host, port = ct.pg_addr
+    with _pg_cluster(tmp_path) as ct:
+        host, port = ct.addr
         with psycopg.connect(host=host, port=port, dbname="yb",
                              user="yb", autocommit=True) as conn:
             cur = conn.cursor()

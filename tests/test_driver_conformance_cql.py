@@ -1,11 +1,8 @@
 """cassandra-driver conformance against the YCQL server (skip-if-absent;
 see test_driver_conformance.py for the rationale)."""
-import asyncio
-import threading
-
 import pytest
 
-from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+from tests.driver_cluster import ClusterThread
 
 cassandra = pytest.importorskip("cassandra",
                                 reason="cassandra-driver not installed")
@@ -13,31 +10,10 @@ cassandra = pytest.importorskip("cassandra",
 
 def test_cassandra_driver_crud(tmp_path):
     from cassandra.cluster import Cluster
-
-    loop = asyncio.new_event_loop()
-    state = {}
-    ready = threading.Event()
-
-    def run():
-        asyncio.set_event_loop(loop)
-
-        async def boot():
-            from yugabyte_db_tpu.ql.cql_server import CqlServer
-            state["mc"] = await MiniCluster(str(tmp_path),
-                                            num_tservers=1).start()
-            state["srv"] = CqlServer(state["mc"].client())
-            state["addr"] = await state["srv"].start()
-            ready.set()
-        loop.create_task(boot())
-        loop.run_forever()
-
-    t = threading.Thread(target=run, daemon=True)
-    t.start()
-    assert ready.wait(30)
-    try:
-        host, port = state["addr"]
-        cluster = Cluster([host], port=port,
-                          connect_timeout=20)
+    from yugabyte_db_tpu.ql.cql_server import CqlServer
+    with ClusterThread(tmp_path, CqlServer) as ct:
+        host, port = ct.addr
+        cluster = Cluster([host], port=port, connect_timeout=20)
         session = cluster.connect()
         session.execute(
             "CREATE KEYSPACE IF NOT EXISTS ks WITH replication = "
@@ -53,10 +29,3 @@ def test_cassandra_driver_crud(tmp_path):
         assert sorted((r.k, r.v, r.s) for r in rows) == [
             (1, 2.5, "one"), (2, 3.5, "two")]
         cluster.shutdown()
-    finally:
-        async def stop():
-            await state["srv"].shutdown()
-            await state["mc"].shutdown()
-            loop.stop()
-        asyncio.run_coroutine_threadsafe(stop(), loop)
-        t.join(timeout=10)

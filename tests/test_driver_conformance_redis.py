@@ -1,38 +1,16 @@
 """redis-py conformance against the YEDIS server (skip-if-absent; see
 test_driver_conformance.py for the rationale)."""
-import asyncio
-import threading
-
 import pytest
 
-from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+from tests.driver_cluster import ClusterThread
 
 redis = pytest.importorskip("redis", reason="redis-py not installed")
 
 
 def test_redis_py_basic(tmp_path):
-    loop = asyncio.new_event_loop()
-    state = {}
-    ready = threading.Event()
-
-    def run():
-        asyncio.set_event_loop(loop)
-
-        async def boot():
-            from yugabyte_db_tpu.ql.redis_server import RedisServer
-            state["mc"] = await MiniCluster(str(tmp_path),
-                                            num_tservers=1).start()
-            state["srv"] = RedisServer(state["mc"].client())
-            state["addr"] = await state["srv"].start()
-            ready.set()
-        loop.create_task(boot())
-        loop.run_forever()
-
-    t = threading.Thread(target=run, daemon=True)
-    t.start()
-    assert ready.wait(30)
-    try:
-        host, port = state["addr"]
+    from yugabyte_db_tpu.ql.redis_server import RedisServer
+    with ClusterThread(tmp_path, RedisServer) as ct:
+        host, port = ct.addr
         r = redis.Redis(host=host, port=port, socket_timeout=20)
         assert r.ping()
         r.set("k1", "v1")
@@ -47,10 +25,3 @@ def test_redis_py_basic(tmp_path):
         assert r.sismember("s", "m1")
         assert r.delete("k1") == 1
         assert r.get("k1") is None
-    finally:
-        async def stop():
-            await state["srv"].shutdown()
-            await state["mc"].shutdown()
-            loop.stop()
-        asyncio.run_coroutine_threadsafe(stop(), loop)
-        t.join(timeout=10)

@@ -215,11 +215,15 @@ class RaftConsensus:
     def _min_election_timeout(self) -> float:
         return flags.get("raft_heartbeat_interval_ms") / 1000.0 * 4
 
-    async def _run_election(self):
+    async def _run_election(self, force: bool = False):
         # pre-vote (reference: raft_consensus.cc pre-elections): probe a
         # majority WITHOUT bumping our term, so a partitioned or flaky
-        # node can't inflate terms and depose a healthy leader on rejoin
-        if len(self.config.peers) > 1:
+        # node can't inflate terms and depose a healthy leader on
+        # rejoin. `force` (leadership transfer, Raft §3.10 TimeoutNow)
+        # skips it: followers that JUST heard from the deliberately
+        # departing leader would deny pre-vote as "leader fresh" —
+        # vetoing exactly the election the leader asked for.
+        if len(self.config.peers) > 1 and not force:
             if not await self._run_pre_vote():
                 self._election_deadline = self._new_election_deadline()
                 return
@@ -723,9 +727,10 @@ class RaftConsensus:
 
     async def rpc_timeout_now(self, req) -> dict:
         """TimeoutNow (leadership transfer target): campaign right away
-        instead of waiting for the election timer."""
+        instead of waiting for the election timer, bypassing pre-vote
+        (the other followers' leader-freshness would veto it)."""
         if self.role != Role.LEADER:
-            await self._run_election()
+            await self._run_election(force=True)
         return {"ok": True}
 
     def is_leader(self) -> bool:

@@ -358,16 +358,23 @@ class Master:
         table_id = payload.get("table_id") or f"tbl-{uuidlib.uuid4().hex[:12]}"
         info_wire = dict(payload["table"])
         info_wire["table_id"] = table_id
+        tspace = payload.get("tablespace_name")
+        if tspace and tspace not in self.tablespaces:
+            raise RpcError(f"tablespace {tspace} not found", "NOT_FOUND")
         if payload.get("tablegroup"):
+            if tspace:
+                # a colocated table lives in its tablegroup's tablet —
+                # per-table placement cannot apply there (reference: PG
+                # rejects TABLESPACE on colocated relations too)
+                raise RpcError(
+                    "tablespace cannot be combined with a tablegroup",
+                    "INVALID_ARGUMENT")
             return await self._create_colocated(payload, table_id, info_wire)
         info = TableInfo.from_wire(info_wire)
         split_points = [bytes.fromhex(h)
                         for h in payload.get("split_points") or []]
         parts = info.partition_schema.create_partitions(
             num_tablets, split_points=split_points or None)
-        tspace = payload.get("tablespace_name")
-        if tspace and tspace not in self.tablespaces:
-            raise RpcError(f"tablespace {tspace} not found", "NOT_FOUND")
         policy = (self.tablespaces.get(tspace) if tspace
                   else self.tablespaces.get("cluster")) or {}
         tablet_entries = {}

@@ -404,6 +404,10 @@ class Parser:
                 rf = int(t[1])
             elif k == "tablespace":
                 tspace = str(t[1])
+            else:
+                # a typo'd option silently placing replicas anywhere
+                # would be a geo-compliance hole — fail loudly
+                raise ValueError(f"unknown WITH option {k!r}")
         if not pk:
             raise ValueError("PRIMARY KEY required")
         return CreateTableStmt(name, cols, pk, range_sharded, pk_desc,

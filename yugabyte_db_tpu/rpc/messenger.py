@@ -6,7 +6,16 @@ libev+epoll reactors. Wire format: 4-byte length + msgpack envelope
 [call_id, kind, service, method, payload]; responses multiplex over the
 same connection by call id (like the reference's InboundCall tracking).
 Local calls short-circuit the socket entirely (reference:
-rpc/local_call.h). Binary payloads ride msgpack bytes (sidecar analog).
+rpc/local_call.h).
+
+SIDECARS (reference: src/yb/rpc/sidecars.h): a handler may return
+`Sidecars(payload, buffers)` — the buffers ride the wire RAW after the
+envelope frame, skipping msgpack encode and per-frame zlib entirely,
+and land at the caller substituted back into the payload wherever
+`sidecar_ref(i)` markers sit. Local short-circuit calls substitute the
+original buffer objects with zero copies. This is the big-payload path
+(remote-bootstrap file chunks, CDC batches); small structured payloads
+keep riding plain msgpack.
 
 Services register as objects: `async def rpc_<method>(self, payload)`.
 """
@@ -37,6 +46,41 @@ class RpcError(Exception):
     def __init__(self, message: str, code: str = "REMOTE_ERROR"):
         super().__init__(message)
         self.code = code
+
+
+_SIDECAR_EXT = 3
+
+
+def sidecar_ref(i: int):
+    """Marker placed INSIDE a Sidecars payload where buffer i belongs."""
+    return msgpack.ExtType(_SIDECAR_EXT, struct.pack("<I", i))
+
+
+class Sidecars:
+    """Handler return wrapper: `payload` with sidecar_ref(i) markers +
+    `buffers` (bytes / memoryview / buffer-protocol objects) shipped raw
+    after the envelope."""
+
+    def __init__(self, payload, buffers):
+        self.payload = payload
+        self.buffers = list(buffers)
+
+    def resolve(self):
+        """Substitute the buffer OBJECTS into the payload (the local
+        short-circuit path: zero copies)."""
+        return _substitute_sidecars(self.payload, self.buffers)
+
+
+def _substitute_sidecars(node, buffers):
+    if isinstance(node, msgpack.ExtType) and node.code == _SIDECAR_EXT:
+        (i,) = struct.unpack("<I", node.data)
+        return buffers[i]
+    if isinstance(node, dict):
+        return {k: _substitute_sidecars(v, buffers)
+                for k, v in node.items()}
+    if isinstance(node, (list, tuple)):
+        return [_substitute_sidecars(v, buffers) for v in node]
+    return node
 
 
 def _pack(obj) -> bytes:
@@ -89,6 +133,34 @@ def _ext_hook(code, data):
     return msgpack.ExtType(code, data)
 
 
+async def _read_sidecars(reader, payload, lens):
+    if sum(lens) > _MAX_FRAME:
+        raise RpcError("oversized sidecars")
+    buffers = [await reader.readexactly(n) for n in lens]
+    return _substitute_sidecars(payload, buffers)
+
+
+def _write_response(writer, call_id, service, method, result) -> None:
+    """Serialize a handler result: plain payloads as one msgpack frame,
+    Sidecars as envelope + raw buffers (no msgpack/zlib on the bulk).
+
+    MUST stay free of awaits: concurrent _dispatch tasks share the
+    writer, and the envelope + buffers are only atomic on the stream
+    because every write here lands in the transport buffer within one
+    synchronous block."""
+    if isinstance(result, Sidecars):
+        views = [memoryview(b).cast("B") for b in result.buffers]
+        env = msgpack.packb(
+            [call_id, _RESP, service, method, result.payload,
+             [v.nbytes for v in views]],
+            use_bin_type=True, default=_default)
+        writer.write(struct.pack("<I", len(env)) + env)
+        for v in views:
+            writer.write(v)
+        return
+    writer.write(_pack([call_id, _RESP, service, method, result]))
+
+
 class Connection:
     """One multiplexed client connection."""
 
@@ -104,9 +176,14 @@ class Connection:
     async def _read_loop(self):
         try:
             while True:
+                # RpcError here (oversized frame/sidecars) is handled
+                # with the connection-drop path below
                 raw = await _read_frame(self.reader)
-                call_id, kind, _svc, _m, payload = msgpack.unpackb(
-                    raw, raw=False, ext_hook=_ext_hook)
+                msg = msgpack.unpackb(raw, raw=False, ext_hook=_ext_hook)
+                call_id, kind, _svc, _m, payload = msg[:5]
+                if len(msg) > 5 and msg[5]:
+                    payload = await _read_sidecars(self.reader, payload,
+                                                   msg[5])
                 fut = self.pending.pop(call_id, None)
                 if fut is not None and not fut.done():
                     if kind == _ERR:
@@ -115,7 +192,7 @@ class Connection:
                     else:
                         fut.set_result(payload)
         except (asyncio.IncompleteReadError, ConnectionError, OSError,
-                asyncio.CancelledError):
+                asyncio.CancelledError, RpcError):
             pass
         finally:
             self.closed = True
@@ -215,10 +292,17 @@ class Messenger:
             while True:
                 try:
                     raw = await _read_frame(reader)
+                    msg = msgpack.unpackb(raw, raw=False,
+                                          ext_hook=_ext_hook)
+                    if len(msg) > 5 and msg[5]:
+                        # request-side sidecars: read them HERE
+                        # (in-order on the stream) before dispatching
+                        # concurrently
+                        msg = list(msg)
+                        msg[4] = await _read_sidecars(reader, msg[4],
+                                                      msg[5])
                 except RpcError:
-                    break              # oversized frame: drop the conn
-                msg = msgpack.unpackb(raw, raw=False,
-                                      ext_hook=_ext_hook)
+                    break   # oversized frame/sidecars: drop the conn
                 asyncio.create_task(self._dispatch(msg, writer))
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
@@ -230,10 +314,15 @@ class Messenger:
                 pass
 
     async def _dispatch(self, msg, writer):
-        call_id, kind, service, method, payload = msg
+        call_id, kind, service, method, payload = msg[:5]
         try:
             result = await self._invoke(service, method, payload)
-            out = _pack([call_id, _RESP, service, method, result])
+            try:
+                _write_response(writer, call_id, service, method, result)
+                await writer.drain()
+            except (ConnectionError, OSError):
+                pass
+            return
         except Exception as e:  # noqa: BLE001 — errors cross the wire
             if not isinstance(e, RpcError):
                 logging.getLogger("ybtpu.rpc").exception(
@@ -263,8 +352,11 @@ class Messenger:
         """Client call; local short-circuit when addr is our own server."""
         self.calls_sent += 1
         if self.addr is not None and tuple(addr) == tuple(self.addr):
-            return await asyncio.wait_for(
+            res = await asyncio.wait_for(
                 self._invoke(service, method, payload), timeout)
+            if isinstance(res, Sidecars):
+                return res.resolve()    # zero-copy local substitution
+            return res
         key = tuple(addr)
         lock = self._conn_locks.setdefault(key, asyncio.Lock())
         async with lock:

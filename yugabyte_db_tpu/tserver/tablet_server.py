@@ -428,7 +428,7 @@ class TabletServer:
                  for n in sorted(os.listdir(d))]
         return {"files": files}
 
-    async def rpc_fetch_snapshot_file(self, payload) -> dict:
+    async def rpc_fetch_snapshot_file(self, payload):
         d = self._snapshot_dir(payload["tablet_id"], payload["snapshot_id"],
                                payload.get("subdir", "regular"))
         name = os.path.basename(payload["name"])   # no path escapes
@@ -438,7 +438,11 @@ class TabletServer:
         with open(path, "rb") as f:
             f.seek(payload.get("offset", 0))
             data = f.read(payload.get("length", 4 * 1024 * 1024))
-        return {"data": data}
+        # remote bootstrap streams whole SSTs/WALs: the chunk rides as a
+        # raw sidecar, skipping msgpack + per-frame zlib (reference:
+        # sidecar-carried data in remote_bootstrap_service.cc)
+        from ..rpc.messenger import Sidecars, sidecar_ref
+        return Sidecars({"data": sidecar_ref(0)}, [data])
 
     # --- membership / leadership --------------------------------------------
     async def rpc_change_config(self, payload) -> dict:
