@@ -1,0 +1,16 @@
+-- table aliases + pg_catalog / information_schema introspection
+CREATE TABLE acc (id bigint, owner text, bal double, PRIMARY KEY (id)) WITH tablets = 1;
+INSERT INTO acc (id, owner, bal) VALUES (1, 'ann', 10.5), (2, 'bo', 20.0), (3, 'cy', 0.0);
+SELECT a.owner FROM acc a WHERE a.id = 2;
+SELECT a.owner, a.bal FROM acc AS a WHERE a.bal > 5 ORDER BY a.bal DESC;
+SELECT a.owner AS who, sum(a.bal) AS total FROM acc a GROUP BY a.owner ORDER BY who;
+SELECT relname, relkind FROM pg_catalog.pg_class ORDER BY relname;
+SELECT tablename FROM pg_tables ORDER BY tablename;
+SELECT table_name, table_type FROM information_schema.tables ORDER BY table_name;
+SELECT column_name, data_type, is_nullable FROM information_schema.columns WHERE table_name = 'acc' ORDER BY ordinal_position;
+SELECT constraint_name, constraint_type FROM information_schema.table_constraints ORDER BY constraint_name;
+SELECT c.column_name FROM information_schema.key_column_usage c WHERE c.table_name = 'acc';
+SELECT a.attname, a.attnum FROM pg_attribute a JOIN pg_class c ON a.attrelid = c.oid WHERE c.relname = 'acc' ORDER BY a.attnum;
+SELECT typname FROM pg_type WHERE oid = 701;
+SELECT nspname FROM pg_namespace ORDER BY nspname;
+DROP TABLE acc

@@ -1,0 +1,16 @@
+-- multi-way joins with pushed predicates + batched inner fetch
+CREATE TABLE region (r bigint, rname text, PRIMARY KEY (r)) WITH tablets = 1;
+CREATE TABLE nation (n bigint, r bigint, nname text, PRIMARY KEY (n)) WITH tablets = 1;
+CREATE TABLE city (c bigint, n bigint, cname text, pop bigint, PRIMARY KEY (c)) WITH tablets = 2;
+INSERT INTO region (r, rname) VALUES (1, 'west'), (2, 'east');
+INSERT INTO nation (n, r, nname) VALUES (10, 1, 'aa'), (11, 1, 'bb'), (12, 2, 'cc');
+INSERT INTO city (c, n, cname, pop) VALUES (100, 10, 'u', 5), (101, 10, 'v', 9), (102, 11, 'w', 3), (103, 12, 'x', 7), (104, 99, 'orphan', 1);
+SELECT cname, nname FROM city JOIN nation ON city.n = nation.n ORDER BY cname;
+SELECT cname, nname, rname FROM city JOIN nation ON city.n = nation.n JOIN region ON nation.r = region.r WHERE city.pop > 4 ORDER BY cname;
+SELECT cname, nname FROM city LEFT JOIN nation ON city.n = nation.n WHERE city.pop < 2 ORDER BY cname;
+SELECT rname, count(*) AS cities, sum(pop) AS people FROM city JOIN nation ON city.n = nation.n JOIN region ON nation.r = region.r GROUP BY rname ORDER BY rname;
+SELECT n.nname, count(*) AS k FROM city c JOIN nation n ON c.n = n.n GROUP BY n.nname HAVING count(*) > 1 ORDER BY k;
+SELECT nname FROM nation LEFT JOIN city ON nation.n = city.n WHERE cname IS NULL;
+DROP TABLE city;
+DROP TABLE nation;
+DROP TABLE region

@@ -1,0 +1,16 @@
+-- CASE / COALESCE / NULLIF / string + math scalar functions
+CREATE TABLE ppl (id bigint, name text, age bigint, nick text, PRIMARY KEY (id)) WITH tablets = 1;
+INSERT INTO ppl (id, name, age, nick) VALUES (1, 'Alice', 17, NULL), (2, 'bob', 34, 'B'), (3, 'Carol', 70, NULL);
+SELECT name, CASE WHEN age < 18 THEN 'minor' WHEN age < 65 THEN 'adult' ELSE 'senior' END AS bracket FROM ppl ORDER BY id;
+SELECT name, COALESCE(nick, name) AS display FROM ppl ORDER BY id;
+SELECT NULLIF(1, 1) AS a, NULLIF(2, 1) AS b;
+SELECT GREATEST(3, 7, 5) AS g, LEAST(3, 7, 5) AS l;
+SELECT upper(name) AS u, lower(name) AS lo, length(name) AS n FROM ppl WHERE id = 1;
+SELECT substr(name, 1, 3) AS pre, reverse(name) AS rev FROM ppl WHERE id = 3;
+SELECT concat(name, '/', age) AS tag FROM ppl ORDER BY id;
+SELECT replace(name, 'o', '0') AS s FROM ppl WHERE id = 2;
+SELECT abs(-7) AS a, round(2.718, 2) AS r;
+SELECT id, age % 7 AS m FROM ppl ORDER BY id;
+SELECT CAST(age AS text) AS t FROM ppl WHERE id = 2;
+SELECT count(*) FROM ppl WHERE nick IS NULL;
+DROP TABLE ppl

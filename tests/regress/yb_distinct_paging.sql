@@ -1,0 +1,14 @@
+-- DISTINCT / OFFSET / multi-key ORDER BY / IN / BETWEEN / LIKE / RETURNING
+CREATE TABLE ev (id bigint, kind text, sev bigint, host text, PRIMARY KEY (id)) WITH tablets = 2;
+INSERT INTO ev (id, kind, sev, host) VALUES (1, 'warn', 2, 'a'), (2, 'err', 3, 'a'), (3, 'warn', 2, 'b'), (4, 'info', 1, 'b'), (5, 'err', 3, 'c'), (6, 'warn', 1, 'c');
+SELECT DISTINCT kind FROM ev ORDER BY kind;
+SELECT kind, sev FROM ev ORDER BY sev DESC, kind ASC LIMIT 3;
+SELECT id FROM ev ORDER BY id LIMIT 2 OFFSET 3;
+SELECT id FROM ev WHERE kind IN ('err', 'info') ORDER BY id;
+SELECT id FROM ev WHERE sev BETWEEN 2 AND 3 ORDER BY id;
+SELECT id FROM ev WHERE kind LIKE 'w%' ORDER BY id;
+SELECT id FROM ev WHERE kind LIKE '%r%' AND host = 'a' ORDER BY id;
+UPDATE ev SET sev = 9 WHERE kind = 'err' RETURNING id, sev;
+DELETE FROM ev WHERE sev = 9 RETURNING id;
+SELECT count(*) FROM ev;
+DROP TABLE ev

@@ -1,0 +1,12 @@
+-- tablespaces: DDL, catalog view, placement-bound tables
+CREATE TABLESPACE hot WITH placement = 'zone-default:1' WITH preferred = 'zone-default';
+SELECT spcname, spcoptions FROM pg_tablespace ORDER BY spcname;
+CREATE TABLE metrics (k bigint, v double, PRIMARY KEY (k)) WITH tablets = 1 WITH tablespace = 'hot';
+INSERT INTO metrics (k, v) VALUES (1, 1.5), (2, 2.5);
+SELECT sum(v) FROM metrics;
+CREATE TABLE bad (k bigint, PRIMARY KEY (k)) WITH tablespace = 'missing';
+DROP TABLESPACE hot;
+DROP TABLE metrics;
+DROP TABLESPACE hot;
+SELECT spcname FROM pg_tablespace;
+DROP TABLESPACE hot

@@ -27,9 +27,10 @@ from ..dockv.partition import PartitionSchema
 from ..ops.scan import AggSpec, GroupSpec, HashGroupSpec
 from .parser import (
     AlterTableStmt, AnalyzeStmt, CreateIndexStmt, CreateSequenceStmt,
-    CreateTableStmt, CreateViewStmt, DeleteStmt, DropSequenceStmt,
-    DropTableStmt, DropViewStmt, ExplainStmt, InsertStmt, SelectStmt,
-    TxnStmt, UpdateStmt, parse_statement,
+    CreateTableStmt, CreateTablespaceStmt, CreateViewStmt, DeleteStmt,
+    DropSequenceStmt, DropTableStmt, DropTablespaceStmt, DropViewStmt,
+    ExplainStmt, InsertStmt, SelectStmt, TxnStmt, UpdateStmt,
+    parse_statement,
 )
 
 _TYPE_MAP = {
@@ -137,6 +138,16 @@ class SqlSession:
                 if not (stmt.if_exists and e.code == "NOT_FOUND"):
                     raise
             return SqlResult([], "DROP VIEW")
+        if isinstance(stmt, CreateTablespaceStmt):
+            await self.client.create_tablespace(
+                stmt.name,
+                placement=[{"zone": z, "min_replicas": n}
+                           for z, n in stmt.placement],
+                preferred_zones=stmt.preferred_zones)
+            return SqlResult([], "CREATE TABLESPACE")
+        if isinstance(stmt, DropTablespaceStmt):
+            await self.client.drop_tablespace(stmt.name)
+            return SqlResult([], "DROP TABLESPACE")
         if isinstance(stmt, CreateSequenceStmt):
             await self.client.create_sequence(
                 stmt.name, stmt.start, stmt.increment,
@@ -1325,8 +1336,11 @@ class SqlSession:
                 key = tuple(r.get(c) for c in stmt.group_by)
                 groups.setdefault(key, []).append(r)
             out_rows = []
+            gmap = self._group_out_map(stmt)
             for key, grows in groups.items():
-                row = dict(zip(stmt.group_by, key))
+                row = {}
+                for gname, gv in zip(stmt.group_by, key):
+                    self._put_group_value(gmap, row, gname, gv)
                 for i, it in enumerate(stmt.items):
                     if it[0] == "agg":
                         row[self._item_name(stmt, i)] = \
@@ -1447,9 +1461,8 @@ class SqlSession:
             rows = rows[off:]
         if stmt.limit is not None:
             rows = rows[:stmt.limit]
-        # strip sort-only carried columns from the output
-        if stmt.order_by and not any(it[0] == "star"
-                                     for it in stmt.items):
+        # strip sort-only / group-key carried columns from the output
+        if not any(it[0] == "star" for it in stmt.items):
             projected = {self._item_name(stmt, i)
                          for i in range(len(stmt.items))}
             rows = [{k: v for k, v in r.items() if k in projected}
@@ -1588,6 +1601,31 @@ class SqlSession:
             hash_cols.append(c.id)
         return HashGroupSpec(cols=tuple(hash_cols))
 
+    def _group_out_map(self, stmt) -> Dict[str, list]:
+        """group-by name -> ALL projected output names for it (aliases
+        included) — computed once per statement, consumed per group
+        row."""
+        out: Dict[str, list] = {}
+        for gname in stmt.group_by:
+            bare = self._split_qual(gname)[1]
+            out[gname] = [
+                self._item_name(stmt, i)
+                for i, it in enumerate(stmt.items)
+                if it[0] == "col"
+                and self._split_qual(it[1])[1] == bare]
+        return out
+
+    @staticmethod
+    def _put_group_value(gmap: Dict[str, list], row: dict, gname: str,
+                         v) -> None:
+        """Store a group-key value under its raw column name (for ORDER
+        BY/HAVING references) and EVERY projected output name — `SELECT
+        a.owner AS who ... GROUP BY a.owner` must emit a 'who' column,
+        and _order_limit strips the non-projected raw duplicate."""
+        row[gname] = v
+        for name in gmap.get(gname, ()):
+            row[name] = v
+
     async def _grouped_pushdown(self, stmt, ct, where, gspec) -> SqlResult:
         schema = ct.info.schema
         read_ht = self._txn.start_ht if self._txn is not None else None
@@ -1601,6 +1639,7 @@ class SqlSession:
             read_ht=read_ht))
         counts = np.asarray(resp.group_counts)
         rows = []
+        gmap = self._group_out_map(stmt)
         if isinstance(gspec, HashGroupSpec):
             schema_cols = {c.id: c for c in schema.columns}
             for g in np.nonzero(counts)[0]:
@@ -1614,7 +1653,7 @@ class SqlSession:
                         v = int(v)
                     elif c.type == ColumnType.BOOL:
                         v = bool(v)
-                    row[name] = v
+                    self._put_group_value(gmap, row, name, v)
                 gvals = [np.asarray(v)[g] for v in resp.agg_values]
                 row.update(self._agg_row(stmt, gvals))
                 row.update(self._hidden_agg_row(
@@ -1629,7 +1668,8 @@ class SqlSession:
             rem = gid
             for (cid, domain, offset), name in zip(gspec.cols,
                                                    stmt.group_by):
-                row[name] = rem % domain + offset
+                self._put_group_value(gmap, row, name,
+                                      rem % domain + offset)
                 rem //= domain
             gvals = [np.asarray(v)[gid] for v in resp.agg_values]
             row.update(self._agg_row(stmt, gvals))
@@ -1678,8 +1718,11 @@ class SqlSession:
             for i, (op, e) in enumerate(bound):
                 st[i] = _step(op, e, st[i], idrow)
         rows = []
+        gmap = self._group_out_map(stmt)
         for key, st in groups.items():
-            row = dict(zip(stmt.group_by, key))
+            row = {}
+            for gname, gv in zip(stmt.group_by, key):
+                self._put_group_value(gmap, row, gname, gv)
             for j, (idx, it) in enumerate(agg_indexed):
                 row[self._item_name(stmt, idx)] = _final(bound[j][0],
                                                          st[j])

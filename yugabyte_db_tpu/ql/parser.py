@@ -126,6 +126,19 @@ class DropViewStmt:
     if_exists: bool = False
 
 
+@dataclass
+class CreateTablespaceStmt:
+    name: str
+    # [(zone, min_replicas)] parsed from WITH placement = 'z:n,z:n'
+    placement: List[Tuple[str, int]] = field(default_factory=list)
+    preferred_zones: List[str] = field(default_factory=list)
+
+
+@dataclass
+class DropTablespaceStmt:
+    name: str
+
+
 @dataclass(frozen=True)
 class SeqFuncValue:
     """nextval('s') / currval('s') appearing in INSERT VALUES — the
@@ -336,6 +349,8 @@ class Parser:
         t = self.peek()
         if t and t[0] == "id" and t[1].lower() == "sequence":
             return self._create_sequence()
+        if t and t[0] == "id" and t[1].lower() == "tablespace":
+            return self._create_tablespace()
         self.expect_kw("table")
         ine = False
         if self.accept_kw("if"):
@@ -480,6 +495,30 @@ class Parser:
                 "ALTER TABLE supports ADD COLUMN / DROP COLUMN")
         return AlterTableStmt(table, adds, drops)
 
+    def _create_tablespace(self):
+        """CREATE TABLESPACE name WITH placement = 'z:n[,z:n...]'
+        [WITH preferred = 'z[,z...]'] — the placement string is the
+        compact form of YB's replica_placement option (reference: YSQL
+        CREATE TABLESPACE ... WITH (replica_placement='{json}'))."""
+        self.next()                       # 'tablespace'
+        name = self.ident()
+        placement: List[Tuple[str, int]] = []
+        preferred: List[str] = []
+        while self.accept_kw("with"):
+            k = self.ident().lower()
+            self.expect_op("=")
+            t = self.next()
+            if k == "placement":
+                for part in str(t[1]).split(","):
+                    zone, _, n = part.partition(":")
+                    placement.append((zone.strip(), int(n or 1)))
+            elif k == "preferred":
+                preferred = [z.strip() for z in str(t[1]).split(",")
+                             if z.strip()]
+            else:
+                raise ValueError(f"unknown WITH option {k!r}")
+        return CreateTablespaceStmt(name, placement, preferred)
+
     def drop_table(self):
         self.expect_kw("drop")
         t = self.peek()
@@ -490,6 +529,9 @@ class Parser:
                 self.expect_kw("exists")
                 ie = True
             return DropSequenceStmt(self.ident(), ie)
+        if t and t[0] == "id" and t[1].lower() == "tablespace":
+            self.next()
+            return DropTablespaceStmt(self.ident())
         self.expect_kw("table")
         ie = False
         if self.accept_kw("if"):

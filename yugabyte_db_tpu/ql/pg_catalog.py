@@ -80,6 +80,7 @@ VIRTUAL_TABLES = frozenset({
     "pg_catalog.pg_database", "pg_database",
     "pg_catalog.pg_settings", "pg_settings",
     "pg_catalog.pg_proc", "pg_proc",
+    "pg_catalog.pg_tablespace", "pg_tablespace",
     "information_schema.tables",
     "information_schema.columns",
     "information_schema.schemata",
@@ -123,6 +124,13 @@ async def rows_for(name: str, client) -> Optional[List[Dict]]:
                 for n, f in flags.REGISTRY.items()]
     if short == "pg_proc":
         return []        # no server-side functions yet; empty is valid
+    if short == "pg_tablespace":
+        spaces = await client.list_tablespaces()
+        return [{"spcname": n,
+                 "spcoptions": ",".join(
+                     f"{b.get('zone')}:{b.get('min_replicas', 1)}"
+                     for b in (pol.get("placement") or []))}
+                for n, pol in sorted(spaces.items())]
 
     tables = await client.list_tables()
     infos = []

@@ -20,7 +20,8 @@ from ..docdb.wire import (
     read_request_from_wire, read_response_to_wire, write_request_from_wire,
 )
 from ..dockv.partition import Partition
-from ..rpc.messenger import Messenger, RpcError
+from ..rpc.messenger import (Messenger, RpcError, Sidecars,
+                             sidecar_ref)
 from ..tablet.tablet import Tablet
 from ..tablet.tablet_peer import TabletPeer
 import logging
@@ -441,7 +442,6 @@ class TabletServer:
         # remote bootstrap streams whole SSTs/WALs: the chunk rides as a
         # raw sidecar, skipping msgpack + per-frame zlib (reference:
         # sidecar-carried data in remote_bootstrap_service.cc)
-        from ..rpc.messenger import Sidecars, sidecar_ref
         return Sidecars({"data": sidecar_ref(0)}, [data])
 
     # --- membership / leadership --------------------------------------------
