@@ -193,3 +193,38 @@ def test_left_join_empty_inner_keeps_columns(tmp_path):
         finally:
             await mc.shutdown()
     run(go())
+
+
+def test_join_order_cost_choice(tmp_path):
+    """ANALYZE row counts drive join order: the smaller side becomes
+    the BNL outer (reference: PG planner join ordering)."""
+    async def go():
+        mc, s = await _cluster(tmp_path)
+        try:
+            for i in range(120):
+                await s.execute(
+                    f"INSERT INTO emp (id, name, dept, sal) VALUES "
+                    f"({i}, 'e{i}', {i % 4}, 1.0)")
+            for d in range(4):
+                await s.execute(f"INSERT INTO dept (dept, dname) "
+                                f"VALUES ({d}, 'd{d}')")
+            await s.execute("ANALYZE emp")
+            await s.execute("ANALYZE dept")
+            r = await s.execute(
+                "EXPLAIN SELECT name, dname FROM emp JOIN dept "
+                "ON emp.dept = dept.dept")
+            plan = "\n".join(row["QUERY PLAN"] for row in r.rows)
+            assert "Batched Nested Loop" in plan
+            assert "Join order: dept outer" in plan, plan
+            # and the reordered execution is still correct
+            r = await s.execute(
+                "SELECT count(*) AS n FROM emp JOIN dept "
+                "ON emp.dept = dept.dept")
+            assert r.rows[0]["n"] == 120
+            r = await s.execute(
+                "SELECT name, dname FROM emp JOIN dept "
+                "ON emp.dept = dept.dept WHERE emp.id = 7")
+            assert r.rows == [{"name": "e7", "dname": "d3"}]
+        finally:
+            await mc.shutdown()
+    run(go())

@@ -92,9 +92,15 @@ class SqlSession:
         # optional per-table column stats enabling device GROUP BY:
         # {table: {column: (domain, offset)}}
         self.stats: Dict[str, Dict[str, Tuple[int, int]]] = {}
+        # ANALYZE-recorded row counts: the planner's cardinality
+        # estimates (join-order choice, BNL eligibility reporting)
+        self.rowcounts: Dict[str, int] = {}
         self._txn = None    # active YBTransaction (BEGIN..COMMIT)
         # materialized CTE rowsets visible to the current statement
         self._cte_rows: Dict[str, List[dict]] = {}
+        # per-statement join-side schemas (label -> schema|None), set
+        # by _select_join/_explain via _gather_join_schemas
+        self._join_schemas: Dict[str, object] = {}
 
     async def execute(self, sql: str) -> SqlResult:
         return await self._dispatch(parse_statement(sql))
@@ -270,6 +276,7 @@ class SqlSession:
             if 0 < domain <= self._ANALYZE_MAX_DOMAIN:
                 st[c.name] = (domain, lo)
         self.stats[stmt.table] = st
+        self.rowcounts[stmt.table] = int(total)
         return SqlResult(
             [{"column": k, "domain": d, "offset": o}
              for k, (d, o) in sorted(st.items())],
@@ -303,10 +310,45 @@ class SqlSession:
                 lines.append("  -> per-tablet IVF-flat index + re-rank"
                              " (exact device search if no index)")
             elif getattr(stmt, "joins", None):
-                lines.append(f"Hash Join ({stmt.joins[0].kind}) "
-                             f"{stmt.table} ⋈ "
-                             f"{', '.join(j.table for j in stmt.joins)}")
-                lines.append("  -> full scans, client-side hash build")
+                import dataclasses
+                probe = dataclasses.replace(
+                    stmt, joins=list(stmt.joins))
+                self._join_schemas, _real = \
+                    await self._gather_join_schemas(probe)
+                self._maybe_swap_join(probe)
+                swapped = probe.table != stmt.table
+                pushed = self._join_pushdown(probe)
+                for jc in probe.joins:
+                    lbl = jc.alias or jc.table
+                    sch = self._join_schemas.get(lbl)
+                    rcol_ok = False
+                    if sch is not None:
+                        try:
+                            sch.column_by_name(
+                                self._split_qual(jc.right_col)[1])
+                            rcol_ok = True
+                        except Exception:  # noqa: BLE001
+                            pass
+                    # mirror fetch_inner's ELIGIBILITY exactly; the
+                    # runtime key-count fallback is reported as such
+                    bnl = (jc.kind in ("inner", "left") and rcol_ok
+                           and jc.table not in self._cte_rows)
+                    strat = ("Batched Nested Loop (inner IN-key "
+                             "batches; hash join past bnl_max_keys "
+                             "outer keys)" if bnl else "Hash Join")
+                    lines.append(f"{strat} ({jc.kind}) {probe.table} "
+                                 f"⋈ {jc.table}")
+                if swapped:
+                    lines.append(f"  Join order: {probe.table} outer "
+                                 f"(ANALYZE: "
+                                 f"{self.rowcounts.get(probe.table)} "
+                                 f"rows < "
+                                 f"{self.rowcounts.get(probe.joins[0].table)})")
+                for lbl, conjs in sorted(pushed.items()):
+                    lines.append(f"  Pushed to {lbl}: {len(conjs)} "
+                                 f"predicate(s)")
+                if not pushed:
+                    lines.append("  Residual WHERE: client-side")
             elif agg_items and not stmt.group_by:
                 lines.append(f"Aggregate on {stmt.table} "
                              f"(pushed to tablets; TPU scan kernel "
@@ -472,6 +514,7 @@ class SqlSession:
         to the recorded domain): any DML or DDL on the table voids
         them until the next ANALYZE."""
         self.stats.pop(table, None)
+        self.rowcounts.pop(table, None)
 
     async def _drop(self, stmt: DropTableStmt) -> SqlResult:
         self._invalidate_stats(stmt.name)
@@ -1058,6 +1101,77 @@ class SqlSession:
                     _strip_qualifiers(c))
         return per_table
 
+    def _maybe_swap_join(self, stmt: SelectStmt) -> None:
+        """Cost-based join-order choice for a single INNER equi-join
+        (reference: the PG planner's cheapest-path join ordering fed by
+        ANALYZE): the SMALLER side should be the OUTER — fewer rows
+        fetched eagerly and fewer distinct keys pushed down in BNL
+        batches. Uses ANALYZE row counts; without stats for both sides
+        the written order stands."""
+        if len(stmt.joins) != 1 or stmt.joins[0].kind != "inner":
+            return
+        if any(it[0] == "star" for it in stmt.items):
+            # SELECT * column order follows the WRITTEN table order;
+            # a swap would flip it (PG keeps projection order stable
+            # regardless of join order)
+            return
+        jc = stmt.joins[0]
+        left_n = self.rowcounts.get(stmt.table)
+        right_n = self.rowcounts.get(jc.table)
+        if left_n is None or right_n is None or right_n >= left_n:
+            return
+        schemas = [s for s in (self._join_schemas or {}).values()
+                   if s is not None]
+        if len(schemas) == 2:
+            # a bare column name living in BOTH tables resolves to the
+            # merge-order winner; a swap would flip which value an
+            # ambiguous reference sees — keep the written order there
+            names: set = set()
+            if stmt.where is not None:
+                self._collect_names(stmt.where, names)
+            for it in stmt.items:
+                if it[0] == "col":
+                    names.add(it[1])
+                elif it[0] in ("expr", "agg") and it[-1] is not None \
+                        and isinstance(it[-1], tuple):
+                    self._collect_names(it[-1], names)
+            names |= {n for n, _ in stmt.order_by}
+            names |= set(stmt.group_by)
+            for name in names:
+                q, bare = self._split_qual(name)
+                if q is not None:
+                    continue
+                in_both = all(
+                    any(c.name == bare for c in sch.columns)
+                    for sch in schemas)
+                if in_both:
+                    return
+        from .parser import JoinClause
+        stmt.table, jc_table = jc.table, stmt.table
+        stmt.table_alias, jc_alias = jc.alias, stmt.table_alias
+        stmt.joins = [JoinClause(jc_table, "inner", jc.right_col,
+                                 jc.left_col, jc_alias)]
+
+    async def _gather_join_schemas(self, stmt):
+        """(label -> schema|None, label -> real table name) for every
+        side of a join query — label is the alias when given. None
+        schema = CTE / virtual / unknown (resolved at fetch time).
+        Shared by execution and EXPLAIN so the two can never drift."""
+        from .pg_catalog import is_virtual
+        pairs = [(stmt.table_alias or stmt.table, stmt.table)] + \
+            [(j.alias or j.table, j.table) for j in stmt.joins]
+        schemas, real_of = {}, {}
+        for label, tname in pairs:
+            real_of[label] = tname
+            sch = None
+            if tname not in self._cte_rows and not is_virtual(tname):
+                try:
+                    sch = (await self.client._table(tname)).info.schema
+                except Exception:  # noqa: BLE001 — resolved at fetch
+                    sch = None
+            schemas[label] = sch
+        return schemas, real_of
+
     async def _select_join(self, stmt: SelectStmt) -> SqlResult:
         """Joins executed at the client tier, like the reference's PG
         backend over pggate — but with the storage engine doing the
@@ -1066,7 +1180,9 @@ class SqlSession:
         join keys pushed down as IN-lists (reference:
         src/postgres/src/backend/executor/nodeYbBatchedNestloop.c)
         instead of materializing the whole table. Falls back to a full
-        inner fetch + hash join when the outer key set is large."""
+        inner fetch + hash join when the outer key set is large. Join
+        order for single inner joins is cost-chosen from ANALYZE row
+        counts (_maybe_swap_join)."""
         from ..docdb.operations import eval_expr_py
         from .pg_catalog import is_virtual, rows_for
         if self._is_serializable():
@@ -1076,22 +1192,10 @@ class SqlSession:
                 jct = await self.client._table(tname)
                 await self._lock_read_set(
                     tname, jct.info.schema, None, self._txn.start_ht)
-        # schemas of the REAL tables involved, keyed by their LABEL in
-        # the query text (alias when given); None for CTE/virtual
+        self._join_schemas, real_of = \
+            await self._gather_join_schemas(stmt)
+        self._maybe_swap_join(stmt)   # labels survive the swap
         lbl0 = stmt.table_alias or stmt.table
-        pairs = [(lbl0, stmt.table)] + \
-            [(j.alias or j.table, j.table) for j in stmt.joins]
-        self._join_schemas = {}
-        real_of = {}
-        for label, tname in pairs:
-            real_of[label] = tname
-            sch = None
-            if tname not in self._cte_rows and not is_virtual(tname):
-                try:
-                    sch = (await self.client._table(tname)).info.schema
-                except Exception:  # noqa: BLE001 — resolved at fetch
-                    sch = None
-            self._join_schemas[label] = sch
         pushed = self._join_pushdown(stmt)
 
         # a name bound by the current WITH scope reads the CTE rowset;
