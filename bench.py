@@ -325,6 +325,12 @@ def main():
     rb = w.run("c", ops=ycsb_ops, clients=16)
     results["ycsb_c"] = {"ops_per_s": rc.ops_per_sec,
                          "batched16_ops_per_s": rb.ops_per_sec}
+    # workloads A (50/50 read-update) and E (short scans) round out the
+    # reference's YCSB table (ycsb-ysql.md:186,190)
+    ra = w.run("a", ops=max(2000, ycsb_ops // 4))
+    re_ = w.run("e", ops=max(1000, ycsb_ops // 10))
+    results["ycsb_a"] = {"ops_per_s": ra.ops_per_sec}
+    results["ycsb_e"] = {"ops_per_s": re_.ops_per_sec}
 
     # Vector search (BASELINE config 5): the reduced config plus the
     # full 1M x 768 spec config, time-boxed via fewer k-means iters
@@ -380,6 +386,8 @@ def main():
         "ycsb_c_ops_per_s": round(results["ycsb_c"]["ops_per_s"], 1),
         "ycsb_c16_ops_per_s": round(
             results["ycsb_c"]["batched16_ops_per_s"], 1),
+        "ycsb_a_ops_per_s": round(results["ycsb_a"]["ops_per_s"], 1),
+        "ycsb_e_ops_per_s": round(results["ycsb_e"]["ops_per_s"], 1),
         "vector": {"n": results["vector"]["n"],
                    "dim": results["vector"]["dim"],
                    "build_s": round(results["vector"]["build_s"], 2),

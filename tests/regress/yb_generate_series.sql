@@ -1,0 +1,11 @@
+-- generate_series + INSERT ... SELECT (bulk population idiom)
+SELECT count(*) AS n, sum(i) AS s FROM generate_series(1, 100) i;
+SELECT i FROM generate_series(2, 11, 3) i ORDER BY i;
+SELECT i * 2 AS dbl FROM generate_series(1, 4) i ORDER BY dbl;
+CREATE TABLE gs (k bigint, v double, g bigint, PRIMARY KEY (k)) WITH tablets = 2;
+INSERT INTO gs SELECT i, i * 1.5, i % 3 FROM generate_series(1, 1000) i;
+SELECT count(*) FROM gs;
+SELECT sum(v) FROM gs;
+SELECT g, count(*) AS c FROM gs GROUP BY g ORDER BY g;
+SELECT k FROM gs WHERE k > 997 ORDER BY k;
+DROP TABLE gs

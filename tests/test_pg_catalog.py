@@ -228,3 +228,41 @@ def test_join_order_cost_choice(tmp_path):
         finally:
             await mc.shutdown()
     run(go())
+
+
+def test_generate_series_join(tmp_path):
+    async def go():
+        mc, s = await _cluster(tmp_path)
+        try:
+            for d in range(3):
+                await s.execute(f"INSERT INTO dept (dept, dname) "
+                                f"VALUES ({d}, 'd{d}')")
+            r = await s.execute(
+                "SELECT i, dname FROM generate_series(0, 4) i "
+                "JOIN dept ON i.i = dept.dept ORDER BY i")
+            assert [(x["i"], x["dname"]) for x in r.rows] == [
+                (0, "d0"), (1, "d1"), (2, "d2")]
+        finally:
+            await mc.shutdown()
+    run(go())
+
+
+def test_out_of_range_keys_enumerate_safely(tmp_path):
+    async def go():
+        mc, s = await _cluster(tmp_path)
+        try:
+            await s.execute("CREATE TABLE i32t (k int, v double, "
+                            "PRIMARY KEY (k)) WITH tablets = 1")
+            await mc.wait_for_leaders("i32t")
+            await s.execute("INSERT INTO i32t (k, v) VALUES (1, 1.0), "
+                            "(2147483647, 2.0)")
+            r = await s.execute(
+                "SELECT k FROM i32t WHERE k IN (1, 5000000000)")
+            assert [x["k"] for x in r.rows] == [1]
+            r = await s.execute(
+                "SELECT k FROM i32t WHERE k BETWEEN 2147483640 "
+                "AND 2147483650")
+            assert [x["k"] for x in r.rows] == [2147483647]
+        finally:
+            await mc.shutdown()
+    run(go())

@@ -955,6 +955,11 @@ class DocReadOperation:
             return ReadResponse(rows=rows, backend="cpu")
         if req.pk_prefix is not None:
             return self._prefix_scan(req)
+        if (not req.aggregates and req.where is not None
+                and req.paging_state is None):
+            got = self._hash_enumerated_read(req)
+            if got is not None:
+                return got
         if req.aggregates and self._tpu_eligible(req):
             resp = self._execute_tpu_aggregate(req)
             if resp is not None:
@@ -1000,6 +1005,56 @@ class DocReadOperation:
                 if req.limit is not None and len(rows_out) >= req.limit:
                     break
         return ReadResponse(rows=rows_out, backend="cpu")
+
+    def _hash_enumerated_read(self, req: ReadRequest):
+        """Short-range scans on a single-INTEGER-hash-PK table become
+        batched point gets: hash sharding cannot seek key ranges, but a
+        small enumerable target set (BETWEEN span, IN list, =) IS a
+        MultiGet — the YCSB-E shape (reference: point segments in
+        docdb/hybrid_scan_choices.cc; rocksdb MultiGet). Returns a
+        ReadResponse or None when the shape doesn't apply."""
+        schema = self.codec.info.schema
+        kcs = schema.key_columns
+        if (len(kcs) != 1 or kcs[0].type not in ("int32", "int64")
+                or self.codec.info.partition_schema.kind != "hash"):
+            return None
+        point_lists, interval, residual = extract_scan_options(
+            req.where, kcs)
+        # constants outside the column's width can never match a stored
+        # key (and would overflow the key encoder) — clamp/drop them,
+        # matching what the row-wise filter would return
+        kmin, kmax = ((-2**31, 2**31 - 1) if kcs[0].type == "int32"
+                      else (-2**63, 2**63 - 1))
+        if point_lists:
+            keys = [k for k in point_lists[0][1] if kmin <= k <= kmax]
+        elif interval is not None and interval[1] is not None \
+                and interval[2] is not None:
+            lo = max(int(interval[1]), kmin)
+            hi = min(int(interval[2]), kmax)
+            if hi - lo + 1 > flags.get("hash_scan_enumerate_max"):
+                return None
+            keys = range(lo, hi + 1)
+        else:
+            return None
+        if len(keys) > flags.get("hash_scan_enumerate_max"):
+            return None
+        name = kcs[0].name
+        read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
+        rows = self.multi_get([{name: int(k)} for k in keys], read_ht,
+                              allow_restart=self._allow_restart)
+        by_id = {c.name: c.id for c in schema.columns}
+        out = []
+        for r in rows:
+            if r is None:
+                continue
+            if residual is not None:
+                idrow = {by_id[n]: v for n, v in r.items()}
+                if eval_expr_py(residual, idrow) is not True:
+                    continue
+            out.append(self._project(r, req.columns))
+            if req.limit is not None and len(out) >= req.limit:
+                break
+        return ReadResponse(rows=out, backend="cpu")
 
     def _tpu_eligible(self, req: ReadRequest) -> bool:
         if not flags.get("tpu_pushdown_enabled"):

@@ -760,6 +760,29 @@ class SqlSession:
                         "FROM-less SELECT supports expressions only")
                 row[self._item_name(stmt, i)] = eval_expr_py(it[1], {})
             return SqlResult([row])
+        if getattr(stmt, "series", None) is not None:
+            # FROM generate_series(lo, hi[, step]): materialize the set
+            # (PG set-returning function; column named by the alias)
+            lo, hi, step = stmt.series
+            if step == 0:
+                raise ValueError("generate_series step cannot be 0")
+            name = stmt.table_alias or "generate_series"
+            end = hi + (1 if step > 0 else -1)
+            rows = [{name: v} for v in range(lo, end, step)]
+            if getattr(stmt, "joins", None):
+                # joined series: register the rowset like a CTE for the
+                # join engine's materialized-table path, scoped to this
+                # statement
+                saved = self._cte_rows.get(stmt.table)
+                self._cte_rows[stmt.table] = rows
+                try:
+                    return await self._select_join(stmt)
+                finally:
+                    if saved is None:
+                        self._cte_rows.pop(stmt.table, None)
+                    else:
+                        self._cte_rows[stmt.table] = saved
+            return self._rows_select(stmt, rows)
         if getattr(stmt, "joins", None):
             return await self._select_join(stmt)
         if stmt.table in self._cte_rows:
@@ -1116,36 +1139,48 @@ class SqlSession:
             # regardless of join order)
             return
         jc = stmt.joins[0]
+        if stmt.table in self._cte_rows or jc.table in self._cte_rows:
+            # a CTE shadowing a base-table name would both hijack the
+            # base table's rowcount estimate and dodge the ambiguity
+            # guard (no schema) — written order stands
+            return
         left_n = self.rowcounts.get(stmt.table)
         right_n = self.rowcounts.get(jc.table)
         if left_n is None or right_n is None or right_n >= left_n:
             return
         schemas = [s for s in (self._join_schemas or {}).values()
                    if s is not None]
-        if len(schemas) == 2:
-            # a bare column name living in BOTH tables resolves to the
-            # merge-order winner; a swap would flip which value an
-            # ambiguous reference sees — keep the written order there
-            names: set = set()
-            if stmt.where is not None:
-                self._collect_names(stmt.where, names)
-            for it in stmt.items:
-                if it[0] == "col":
-                    names.add(it[1])
-                elif it[0] in ("expr", "agg") and it[-1] is not None \
-                        and isinstance(it[-1], tuple):
-                    self._collect_names(it[-1], names)
-            names |= {n for n, _ in stmt.order_by}
-            names |= set(stmt.group_by)
-            for name in names:
-                q, bare = self._split_qual(name)
-                if q is not None:
-                    continue
-                in_both = all(
-                    any(c.name == bare for c in sch.columns)
-                    for sch in schemas)
-                if in_both:
-                    return
+        if len(schemas) != 2:
+            return     # can't prove the swap is reference-safe
+        # a bare column name living in BOTH tables resolves to the
+        # merge-order winner; a swap would flip which value an
+        # ambiguous reference sees — keep the written order there
+        names: set = set()
+        if stmt.where is not None:
+            self._collect_names(stmt.where, names)
+        for it in stmt.items:
+            if it[0] == "col":
+                names.add(it[1])
+            elif it[0] in ("expr", "agg") and it[-1] is not None \
+                    and isinstance(it[-1], tuple):
+                self._collect_names(it[-1], names)
+            elif it[0] == "window":
+                # ('window', fn, expr|None, partition, worder)
+                if it[2] is not None and isinstance(it[2], tuple):
+                    self._collect_names(it[2], names)
+                names |= set(it[3] or ())
+                names |= {n for n, _ in (it[4] or ())}
+        names |= {n for n, _ in stmt.order_by}
+        names |= set(stmt.group_by)
+        for name in names:
+            q, bare = self._split_qual(name)
+            if q is not None:
+                continue
+            in_both = all(
+                any(c.name == bare for c in sch.columns)
+                for sch in schemas)
+            if in_both:
+                return
         from .parser import JoinClause
         stmt.table, jc_table = jc.table, stmt.table
         stmt.table_alias, jc_alias = jc.alias, stmt.table_alias

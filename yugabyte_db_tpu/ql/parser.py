@@ -217,6 +217,9 @@ class SelectStmt:
     # query (and later CTEs) may use the name as a table
     ctes: Dict[str, "SelectStmt"] = field(default_factory=dict)
     table_alias: Optional[str] = None   # FROM t [AS] a
+    # FROM generate_series(lo, hi[, step]): (lo, hi, step) — the rows
+    # materialize client-side (PG set-returning function)
+    series: Optional[Tuple[int, int, int]] = None
 
 
 @dataclass
@@ -756,6 +759,16 @@ class Parser:
             # FROM-less constant SELECT: SELECT 1, SELECT nextval('s')
             return SelectStmt(None, items, aliases=aliases)
         table = self.ident()
+        series = None
+        if table.lower() == "generate_series" and self.accept_op("("):
+            args = [int(self.literal())]
+            while self.accept_op(","):
+                args.append(int(self.literal()))
+            self.expect_op(")")
+            if len(args) not in (2, 3):
+                raise ValueError("generate_series takes 2 or 3 args")
+            series = (args[0], args[1],
+                      args[2] if len(args) == 3 else 1)
         table_alias = self._table_alias()
         joins = []
         while True:
@@ -829,7 +842,7 @@ class Parser:
             offset = int(self.next()[1])
         return SelectStmt(table, items, where, group, order, limit, knn,
                           distinct, offset, joins, having, aliases,
-                          table_alias=table_alias)
+                          table_alias=table_alias, series=series)
 
     # clause starters that must not be eaten as a table alias
     _ALIAS_STOP = frozenset((

@@ -156,6 +156,11 @@ DEFINE_RUNTIME("scan_group_strategy", "auto",
                "'unroll' (per-group masked tree reductions — pure VPU "
                "code, no scatter, for TPU), or 'auto' (segment on cpu, "
                "unroll elsewhere).")
+DEFINE_RUNTIME("hash_scan_enumerate_max", 1024,
+               "Max enumerable key-target count for rewriting a "
+               "short range/IN scan over a single-integer-hash-PK "
+               "table into batched point gets (hash sharding cannot "
+               "seek key ranges; a small target set IS a MultiGet).")
 DEFINE_RUNTIME("bnl_batch_size", 1024,
                "Join-key batch size for batched-nested-loop joins: the "
                "inner side fetches WHERE inner_col IN (batch) pushed to "
