@@ -328,8 +328,10 @@ def main():
     # workloads A (50/50 read-update) and E (short scans) round out the
     # reference's YCSB table (ycsb-ysql.md:186,190)
     ra = w.run("a", ops=max(2000, ycsb_ops // 4))
+    rb_ = w.run("b", ops=max(2000, ycsb_ops // 4))
     re_ = w.run("e", ops=max(1000, ycsb_ops // 10))
     results["ycsb_a"] = {"ops_per_s": ra.ops_per_sec}
+    results["ycsb_b"] = {"ops_per_s": rb_.ops_per_sec}
     results["ycsb_e"] = {"ops_per_s": re_.ops_per_sec}
 
     # Vector search (BASELINE config 5): the reduced config plus the
@@ -387,6 +389,7 @@ def main():
         "ycsb_c16_ops_per_s": round(
             results["ycsb_c"]["batched16_ops_per_s"], 1),
         "ycsb_a_ops_per_s": round(results["ycsb_a"]["ops_per_s"], 1),
+        "ycsb_b_ops_per_s": round(results["ycsb_b"]["ops_per_s"], 1),
         "ycsb_e_ops_per_s": round(results["ycsb_e"]["ops_per_s"], 1),
         "vector": {"n": results["vector"]["n"],
                    "dim": results["vector"]["dim"],

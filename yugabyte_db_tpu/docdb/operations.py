@@ -795,10 +795,9 @@ class DocReadOperation:
         self._allow_restart = False
 
     # ---- point lookup ----------------------------------------------------
-    def _find_best(self, prefix: bytes, read_ht: int, restart_hi,
-                   mems, ssts):
-        """Newest visible version tuple (ht, write_id, key, value,
-        block, pos) of one doc key across the snapshot, or None."""
+    def _mem_best(self, prefix: bytes, read_ht: int, restart_hi, mems):
+        """Newest visible memtable version of one doc key as a
+        (ht, write_id, key, value, None, None) tuple, or None."""
         plen = len(prefix)
         kht = ValueType.kHybridTime
         best = None
@@ -817,6 +816,13 @@ class DocReadOperation:
                 if best is None or (ht, dht.write_id) > best[:2]:
                     best = (ht, dht.write_id, k, v, None, None)
                 break
+        return best
+
+    def _find_best(self, prefix: bytes, read_ht: int, restart_hi,
+                   mems, ssts):
+        """Newest visible version tuple (ht, write_id, key, value,
+        block, pos) of one doc key across the snapshot, or None."""
+        best = self._mem_best(prefix, read_ht, restart_hi, mems)
         h = fnv64_bytes(prefix)
         for r in ssts:
             if not r.may_contain_hash(h):
@@ -878,17 +884,23 @@ class DocReadOperation:
                 ) -> Optional[Dict[str, object]]:
         """Newest visible version across memtable + SSTs, using per-SST
         bloom filters and the native fused whole-SST lookup (reference:
-        DocDBTableReader point-get over BlockBasedTable::Get)."""
+        DocDBTableReader point-get over BlockBasedTable::Get). A
+        non-empty memtable contributes its candidate via a cheap seek
+        merged against the native SST result — mixed read/write
+        workloads keep the C path for the expensive part."""
         prefix = self.codec.doc_key_prefix(pk_row)
         restart_hi = (read_ht + _skew_window_ht()
                       if self._allow_restart else None)
         mems, ssts = self.store.read_snapshot()
-        if all(m.empty() for m in mems):
-            got = self._native_best([prefix], ssts, read_ht, restart_hi)
-            if got is not None:
-                best, slow = got
-                if not slow:
-                    return best[0][2] if best[0] is not None else None
+        got = self._native_best([prefix], ssts, read_ht, restart_hi)
+        if got is not None:
+            best, slow = got
+            if not slow:
+                mb = self._mem_best(prefix, read_ht, restart_hi, mems)
+                nb = best[0]
+                if mb is not None and (nb is None or mb[:2] > nb[:2]):
+                    return self._decode_best(mb, read_ht)
+                return nb[2] if nb is not None else None
         best = self._find_best(prefix, read_ht, restart_hi, mems, ssts)
         if best is None:
             return None
@@ -911,16 +923,13 @@ class DocReadOperation:
         prefix_of = self.codec.doc_key_prefix
         prefixes = [prefix_of(r) for r in pk_rows]
         n = len(prefixes)
-        got = None
-        if all(m.empty() for m in mems):
-            # writes in flight would need a per-key memtable merge —
-            # then the per-key path below is the whole story
-            got = self._native_best(prefixes, ssts, read_ht, restart_hi)
+        got = self._native_best(prefixes, ssts, read_ht, restart_hi)
         if got is None:
             best: List = [None] * n
             slow = set(range(n))
         else:
             best, slow = got
+        mem_active = [m for m in mems if not m.empty()]
         out: List[Optional[Dict[str, object]]] = []
         for i in range(n):
             if i in slow:
@@ -928,9 +937,15 @@ class DocReadOperation:
                                     mems, ssts)
                 out.append(None if f is None
                            else self._decode_best(f, read_ht))
-            else:
-                b = best[i]
-                out.append(b[2] if b is not None else None)
+                continue
+            b = best[i]
+            if mem_active:
+                mb = self._mem_best(prefixes[i], read_ht, restart_hi,
+                                    mem_active)
+                if mb is not None and (b is None or mb[:2] > b[:2]):
+                    out.append(self._decode_best(mb, read_ht))
+                    continue
+            out.append(b[2] if b is not None else None)
         return out
 
     # ---- scans -----------------------------------------------------------
