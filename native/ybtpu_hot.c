@@ -21,6 +21,8 @@
  */
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
+#include <float.h>
+#include <math.h>
 #include <stdint.h>
 #include <string.h>
 
@@ -591,6 +593,275 @@ static PyTypeObject BlockFinderType = {
 };
 
 /* ---------------------------------------------------------------------
+ * Packer — packed-row V2 encoder (reference: dockv/packed_row.h
+ * RowPackerV2), the per-row write hot path: null bitmap + fixed-width
+ * region + varlen end-offsets + heap, assembled in one C pass from the
+ * {col_id: value} dict. Built once per SchemaPacking.
+ *
+ * Packer(header, plan, bitmap_size, fixed_size, nvar) with plan =
+ * [(id:int, kind:int, fmt:str1, off:int)] over all columns in bitmap
+ * order; kind 0 = fixed (fmt one of q i h d f ?), 1 = varlen str,
+ * 2 = varlen bytes.
+ */
+typedef struct {
+    PyObject *id;        /* boxed column id for dict lookup */
+    int kind;
+    char fmt;
+    int off;             /* fixed region offset */
+} PackCol;
+
+typedef struct {
+    PyObject_HEAD
+    Py_ssize_t ncols, nvar;
+    Py_ssize_t bitmap_size, fixed_size;
+    PyObject *header;    /* bytes */
+    PackCol *cols;
+} Packer;
+
+static void
+Packer_dealloc(Packer *self)
+{
+    for (Py_ssize_t i = 0; i < self->ncols; i++)
+        Py_XDECREF(self->cols[i].id);
+    PyMem_Free(self->cols);
+    Py_XDECREF(self->header);
+    Py_TYPE(self)->tp_free((PyObject *)self);
+}
+
+static PyObject *
+Packer_new(PyTypeObject *type, PyObject *args, PyObject *kwds)
+{
+    PyObject *header, *plan;
+    Py_ssize_t bitmap_size, fixed_size, nvar;
+    if (!PyArg_ParseTuple(args, "SOnnn", &header, &plan, &bitmap_size,
+                          &fixed_size, &nvar))
+        return NULL;
+    if (!PyList_Check(plan)) {
+        PyErr_SetString(PyExc_TypeError, "plan must be a list");
+        return NULL;
+    }
+    Packer *self = (Packer *)type->tp_alloc(type, 0);
+    if (!self) return NULL;
+    self->ncols = 0;          /* set only once cols is allocated —
+                               * dealloc walks cols up to ncols */
+    self->nvar = nvar;
+    self->bitmap_size = bitmap_size;
+    self->fixed_size = fixed_size;
+    self->header = header; Py_INCREF(header);
+    self->cols = (PackCol *)PyMem_Calloc(PyList_GET_SIZE(plan),
+                                         sizeof(PackCol));
+    if (!self->cols) { Py_DECREF(self); return PyErr_NoMemory(); }
+    self->ncols = PyList_GET_SIZE(plan);
+    for (Py_ssize_t i = 0; i < self->ncols; i++) {
+        long id_, kind, off;
+        const char *fmt;
+        if (!PyArg_ParseTuple(PyList_GET_ITEM(plan, i), "llsl",
+                              &id_, &kind, &fmt, &off)) {
+            Py_DECREF(self);
+            return NULL;
+        }
+        self->cols[i].id = PyLong_FromLong(id_);
+        self->cols[i].kind = (int)kind;
+        self->cols[i].fmt = fmt[0];
+        self->cols[i].off = (int)off;
+        if (!self->cols[i].id) { Py_DECREF(self); return NULL; }
+    }
+    return (PyObject *)self;
+}
+
+static int
+pack_fixed(uint8_t *dst, char fmt, PyObject *v)
+{
+    if (fmt == 'd' || fmt == 'f') {
+        double dv = PyFloat_AsDouble(v);
+        if (dv == -1.0 && PyErr_Occurred()) return -1;
+        if (fmt == 'd') memcpy(dst, &dv, 8);
+        else {
+            if (isfinite(dv) && (dv > FLT_MAX || dv < -FLT_MAX)) {
+                /* struct.pack('<f') semantics: finite doubles past the
+                 * f32 range fail loudly, never silently become inf */
+                PyErr_SetString(PyExc_OverflowError,
+                                "float too large for float32 column");
+                return -1;
+            }
+            float fv = (float)dv;
+            memcpy(dst, &fv, 4);
+        }
+        return 0;
+    }
+    if (fmt == '?') {
+        int b = PyObject_IsTrue(v);
+        if (b < 0) return -1;
+        *dst = (uint8_t)b;
+        return 0;
+    }
+    PyObject *ix = PyNumber_Index(v);   /* struct-module semantics */
+    if (!ix) return -1;
+    long long x = PyLong_AsLongLong(ix);
+    Py_DECREF(ix);
+    if (x == -1 && PyErr_Occurred()) return -1;
+    switch (fmt) {
+    case 'q': memcpy(dst, &x, 8); return 0;
+    case 'i': {
+        if (x < INT32_MIN || x > INT32_MAX) goto range;
+        int32_t y = (int32_t)x; memcpy(dst, &y, 4); return 0;
+    }
+    case 'h': {
+        if (x < INT16_MIN || x > INT16_MAX) goto range;
+        int16_t y = (int16_t)x; memcpy(dst, &y, 2); return 0;
+    }
+    default:
+        PyErr_Format(PyExc_ValueError, "bad pack fmt %c", fmt);
+        return -1;
+    }
+range:
+    PyErr_SetString(PyExc_OverflowError, "value out of column range");
+    return -1;
+}
+
+static PyObject *
+Packer_pack(Packer *self, PyObject *values)
+{
+    if (!PyDict_Check(values)) {
+        PyErr_SetString(PyExc_TypeError, "values must be a dict");
+        return NULL;
+    }
+    Py_ssize_t hlen = PyBytes_GET_SIZE(self->header);
+    /* declarations up front: the error paths jump over them (g++
+     * rejects a goto crossing initializations) */
+    const char **vp = NULL;
+    Py_ssize_t *vl = NULL;
+    Py_buffer *vbufs = NULL;            /* held buffer-protocol views */
+    uint8_t *fixed_scratch = NULL;
+    Py_ssize_t heap_len = 0, vi = 0, total, heap_pos, nheld = 0;
+    PyObject *out = NULL;
+    uint8_t *buf, *bitmap, *fixed, *ends, *heap;
+    uint8_t bitmap_scratch[64];
+    if (self->bitmap_size > (Py_ssize_t)sizeof(bitmap_scratch)) {
+        PyErr_SetString(PyExc_ValueError, "too many columns");
+        return NULL;
+    }
+    memset(bitmap_scratch, 0, sizeof(bitmap_scratch));
+    if (self->nvar) {
+        vp = (const char **)PyMem_Malloc(self->nvar * sizeof(char *));
+        vl = (Py_ssize_t *)PyMem_Malloc(
+            self->nvar * sizeof(Py_ssize_t));
+        vbufs = (Py_buffer *)PyMem_Calloc(self->nvar,
+                                          sizeof(Py_buffer));
+        if (!vp || !vl || !vbufs) {
+            PyMem_Free(vp); PyMem_Free(vl); PyMem_Free(vbufs);
+            return PyErr_NoMemory();
+        }
+    }
+    if (self->fixed_size) {
+        fixed_scratch = (uint8_t *)PyMem_Calloc(1, self->fixed_size);
+        if (!fixed_scratch) {
+            PyMem_Free(vp); PyMem_Free(vl); PyMem_Free(vbufs);
+            return PyErr_NoMemory();
+        }
+    }
+    /* pass 1 does ALL value conversion — including fixed columns,
+     * whose __index__/__float__ may run arbitrary Python — so the
+     * cached varlen pointers can't be invalidated afterwards; held
+     * buffer views pin non-bytes sources (bytearray/memoryview) */
+    for (Py_ssize_t i = 0; i < self->ncols; i++) {
+        PackCol *c = &self->cols[i];
+        PyObject *v = PyDict_GetItem(values, c->id);   /* borrowed */
+        if (v == NULL || v == Py_None) {
+            bitmap_scratch[i >> 3] |= (uint8_t)(1 << (i & 7));
+            if (c->kind != 0) { vp[vi] = NULL; vl[vi] = 0; vi++; }
+            continue;
+        }
+        if (c->kind == 0) {
+            if (pack_fixed(fixed_scratch + c->off, c->fmt, v) < 0)
+                goto fail;
+            continue;
+        }
+        if (PyUnicode_Check(v)) {
+            Py_ssize_t n = 0;
+            const char *p = PyUnicode_AsUTF8AndSize(v, &n);
+            if (!p) goto fail;
+            vp[vi] = p; vl[vi] = n;
+        } else if (PyBytes_Check(v)) {
+            vp[vi] = PyBytes_AS_STRING(v);
+            vl[vi] = PyBytes_GET_SIZE(v);
+        } else if (PyObject_CheckBuffer(v)) {
+            /* bytearray / memoryview / numpy bytes — pinned until the
+             * copy completes (matches the Python packer's bytes(v)) */
+            if (PyObject_GetBuffer(v, &vbufs[vi], PyBUF_SIMPLE) < 0)
+                goto fail;
+            nheld = vi + 1;
+            vp[vi] = (const char *)vbufs[vi].buf;
+            vl[vi] = vbufs[vi].len;
+        } else {
+            PyErr_SetString(PyExc_TypeError,
+                            "varlen column value must be str or "
+                            "bytes-like");
+            goto fail;
+        }
+        heap_len += vl[vi];
+        vi++;
+    }
+    if (heap_len > (Py_ssize_t)UINT32_MAX) {
+        PyErr_SetString(PyExc_OverflowError,
+                        "packed-row heap exceeds uint32 offsets");
+        goto fail;
+    }
+    total = hlen + self->bitmap_size + self->fixed_size
+        + 4 * self->nvar + heap_len;
+    out = PyBytes_FromStringAndSize(NULL, total);
+    if (!out) goto fail;
+    /* pass 2: pure memcpy assembly — no Python re-entry */
+    buf = (uint8_t *)PyBytes_AS_STRING(out);
+    memcpy(buf, PyBytes_AS_STRING(self->header), hlen);
+    bitmap = buf + hlen;
+    memcpy(bitmap, bitmap_scratch, self->bitmap_size);
+    fixed = bitmap + self->bitmap_size;
+    if (self->fixed_size)
+        memcpy(fixed, fixed_scratch, self->fixed_size);
+    ends = fixed + self->fixed_size;
+    heap = ends + 4 * self->nvar;
+    heap_pos = 0;
+    for (vi = 0; vi < self->nvar; vi++) {
+        if (vl[vi]) {
+            memcpy(heap + heap_pos, vp[vi], vl[vi]);
+            heap_pos += vl[vi];
+        }
+        uint32_t e = (uint32_t)heap_pos;
+        memcpy(ends + 4 * vi, &e, 4);
+    }
+    for (Py_ssize_t i = 0; i < nheld; i++)
+        if (vbufs[i].obj) PyBuffer_Release(&vbufs[i]);
+    PyMem_Free(vp); PyMem_Free(vl); PyMem_Free(vbufs);
+    PyMem_Free(fixed_scratch);
+    return out;
+fail:
+    for (Py_ssize_t i = 0; i < nheld; i++)
+        if (vbufs[i].obj) PyBuffer_Release(&vbufs[i]);
+    PyMem_Free(vp); PyMem_Free(vl); PyMem_Free(vbufs);
+    PyMem_Free(fixed_scratch);
+    Py_XDECREF(out);
+    return NULL;
+}
+
+static PyMethodDef Packer_methods[] = {
+    {"pack", (PyCFunction)Packer_pack, METH_O,
+     "pack({col_id: value}) -> packed row bytes (header included)"},
+    {NULL}
+};
+
+static PyTypeObject PackerType = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "ybtpu_hot.Packer",
+    .tp_basicsize = sizeof(Packer),
+    .tp_dealloc = (destructor)Packer_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_doc = "packed-row V2 encoder",
+    .tp_methods = Packer_methods,
+    .tp_new = Packer_new,
+};
+
+/* ---------------------------------------------------------------------
  * PointReader — whole-SST batched point lookup: bloom probe + block
  * bisect + the BlockFinder walk + Extractor row materialization for a
  * LIST of encoded doc-key prefixes in ONE C call (reference analog:
@@ -853,5 +1124,9 @@ PyInit_ybtpu_hot(void)
         return NULL;
     Py_INCREF(&PointReaderType);
     PyModule_AddObject(m, "PointReader", (PyObject *)&PointReaderType);
+    if (PyType_Ready(&PackerType) < 0)
+        return NULL;
+    Py_INCREF(&PackerType);
+    PyModule_AddObject(m, "Packer", (PyObject *)&PackerType);
     return m;
 }

@@ -173,14 +173,59 @@ class SchemaPacking:
 
 class RowPacker:
     """Packs value columns into a single packed-row value
-    (reference: dockv/packed_row.h:285,311 RowPackerV1/V2)."""
+    (reference: dockv/packed_row.h:285,311 RowPackerV1/V2). The hot
+    path runs in C (native/ybtpu_hot.c Packer) when every column type
+    is in its supported set; exotic types (json/decimal/vector carry
+    pre-encoded values with looser typing) keep the Python packer.
+    Outputs are byte-identical; invalid values fail loudly on both
+    paths, though the exception CLASS may differ (struct.error on the
+    Python path vs TypeError/OverflowError natively)."""
+
+    _NATIVE_FIXED = {ColumnType.BOOL: "?", ColumnType.INT32: "i",
+                     ColumnType.INT64: "q", ColumnType.TIMESTAMP: "q",
+                     ColumnType.FLOAT32: "f", ColumnType.FLOAT64: "d"}
+    _NATIVE_VARLEN = {ColumnType.STRING: 1, ColumnType.BINARY: 2}
 
     def __init__(self, packing: SchemaPacking):
         self.packing = packing
         self._header = _encode_varint_unsigned(packing.schema_version)
+        self._native = False            # built lazily on first pack
+
+    def _native_packer(self):
+        if self._native is False:
+            self._native = None
+            from ..storage.columnar import native_hot
+            hot = native_hot()
+            if hot is not None and hasattr(hot, "Packer"):
+                p = self.packing
+                plan = []
+                # the C packer's bitmap scratch caps at 64 bytes (512
+                # columns); wider schemas keep the Python path
+                ok = p.bitmap_size <= 64
+                for c in p.all_columns:
+                    if c.type in self._NATIVE_FIXED:
+                        plan.append((c.id, 0, self._NATIVE_FIXED[c.type],
+                                     p.fixed_offsets[c.id]))
+                    elif c.type in self._NATIVE_VARLEN:
+                        plan.append((c.id, self._NATIVE_VARLEN[c.type],
+                                     "s", 0))
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    try:
+                        self._native = hot.Packer(
+                            bytes(self._header), plan, p.bitmap_size,
+                            p.fixed_size, len(p.varlen_columns))
+                    except Exception:
+                        self._native = None
+        return self._native
 
     def pack(self, values: Dict[int, object]) -> bytes:
         """values: column id -> python value (None for NULL)."""
+        nat = self._native_packer()
+        if nat is not None:
+            return nat.pack(values)
         p = self.packing
         bitmap = bytearray(p.bitmap_size)
         fixed = bytearray(p.fixed_size)
