@@ -334,6 +334,41 @@ def main():
     results["ycsb_b"] = {"ops_per_s": rb_.ops_per_sec}
     results["ycsb_e"] = {"ops_per_s": re_.ops_per_sec}
 
+    # TPC-C-style NEW-ORDER/PAYMENT through REAL distributed txns on an
+    # in-process cluster (reference headline bench; tpmC here is the
+    # UNCONSTRAINED NewOrder rate — no spec think times). BENCH_TPCC_S
+    # bounds the run; 0 skips.
+    tpcc_s = float(os.environ.get("BENCH_TPCC_S", "10"))
+    if tpcc_s > 0:
+        import asyncio as _aio
+        from yugabyte_db_tpu.models.tpcc import TpccWorkload
+        from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+        async def run_tpcc():
+            mc = await MiniCluster(
+                tempfile.mkdtemp(prefix="ybtpu-tpcc-"),
+                num_tservers=1).start()
+            try:
+                c = mc.client()
+                wload = TpccWorkload(c, warehouses=1)
+                await wload.create_tables(num_tablets=1)
+                for t_ in ("warehouse", "district", "customer", "stock",
+                           "orders", "order_line", "history"):
+                    await mc.wait_for_leaders(t_)
+                await wload.load()
+                await wload.run(seconds=2.0, concurrency=4)   # warm
+                return await wload.run(seconds=tpcc_s, concurrency=8)
+            finally:
+                await mc.shutdown()
+        try:
+            tr = _aio.run(run_tpcc())
+            results["tpcc"] = {
+                "tpmc_unconstrained": tr.tpmc,
+                "new_orders": tr.new_orders, "payments": tr.payments,
+                "aborts": tr.aborts, "seconds": tr.seconds}
+        except Exception as e:   # noqa: BLE001 — report, don't fail bench
+            results["tpcc"] = {"error": str(e)[:200]}
+
     # Vector search (BASELINE config 5): the reduced config plus the
     # full 1M x 768 spec config, time-boxed via fewer k-means iters
     # (BENCH_VECTOR_FULL=0 skips the big one)
@@ -390,6 +425,9 @@ def main():
             results["ycsb_c"]["batched16_ops_per_s"], 1),
         "ycsb_a_ops_per_s": round(results["ycsb_a"]["ops_per_s"], 1),
         "ycsb_b_ops_per_s": round(results["ycsb_b"]["ops_per_s"], 1),
+        **({"tpcc": {k: (round(v, 1) if isinstance(v, float) else v)
+                     for k, v in results["tpcc"].items()}}
+           if "tpcc" in results else {}),
         "ycsb_e_ops_per_s": round(results["ycsb_e"]["ops_per_s"], 1),
         "vector": {"n": results["vector"]["n"],
                    "dim": results["vector"]["dim"],

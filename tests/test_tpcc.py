@@ -1,0 +1,41 @@
+"""TPC-C-style NEW-ORDER/PAYMENT through real distributed transactions
+(reference: the TPC-C headline benchmark,
+docs/content/stable/benchmark/tpcc/)."""
+import asyncio
+
+from yugabyte_db_tpu.models.tpcc import (TpccWorkload,
+                                         verify_consistency)
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+
+def test_tpcc_mix_and_consistency(tmp_path):
+    async def go():
+        mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+        try:
+            c = mc.client()
+            w = TpccWorkload(c, warehouses=1)
+            await w.create_tables(num_tablets=1)
+            for t in ("warehouse", "district", "customer", "stock",
+                      "orders", "order_line", "history"):
+                await mc.wait_for_leaders(t)
+            await w.load()
+            res = await w.run(seconds=4.0, concurrency=3)
+            assert res.new_orders > 0 and res.payments > 0
+            # the spec's consistency probes must hold after the storm
+            checks = await verify_consistency(c, 0)
+            assert all(checks.values()), checks
+            # order lines exist for committed orders
+            from yugabyte_db_tpu.docdb.operations import ReadRequest
+            orders = (await c.scan("orders", ReadRequest(""))).rows
+            lines = (await c.scan("order_line", ReadRequest(""))).rows
+            by_o = {}
+            for l in lines:
+                okey = l["ol_key"] // 16
+                by_o[okey] = by_o.get(okey, 0) + 1
+            for o in orders:
+                assert by_o.get(o["o_key"], 0) == o["o_ol_cnt"], o
+            print(f"tpcc: {res.new_orders} NO / {res.payments} PAY / "
+                  f"{res.aborts} aborts -> {res.tpmc:.0f} tpmC*")
+        finally:
+            await mc.shutdown()
+    asyncio.run(go())

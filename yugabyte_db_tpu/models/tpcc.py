@@ -1,0 +1,277 @@
+"""TPC-C-style OLTP workload (reference: the reference's headline
+benchmark, docs/content/stable/benchmark/tpcc/ — run there via the
+benchbase fork). This is the ENGINE-level analog: the standard schema
+subset (warehouse/district/customer/stock/orders/order_line/history)
+and the two transactions that dominate the mix — NEW-ORDER (45%) and
+PAYMENT (43%) — executed through the REAL distributed transaction layer
+(snapshot isolation, multi-tablet writes). Conflict-aborted
+transactions are counted as `aborts` — the terminal moves on to a
+fresh transaction rather than re-running the same one, so tpmC here
+under-counts relative to a spec driver that retries aborted NewOrders
+verbatim.
+
+The spec's tpmC is think-time-capped at 12.86 per warehouse; with no
+think times we report the raw NewOrder rate and derive an
+"unconstrained tpmC" (NewOrders/min) — comparable across rounds, not
+against spec-audited numbers.
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+from typing import Dict, List
+
+import numpy as np
+
+from ..docdb.operations import ReadRequest, RowOp
+from ..docdb.table_codec import TableInfo
+from ..dockv.packed_row import ColumnSchema, ColumnType, TableSchema
+from ..dockv.partition import PartitionSchema
+from ..rpc.messenger import RpcError
+
+I64, F64, STR, I32 = (ColumnType.INT64, ColumnType.FLOAT64,
+                      ColumnType.STRING, ColumnType.INT32)
+
+
+def _mk(name, cols, num_hash=1, num_key=None):
+    """cols: [(name, type)]; the first `num_key` columns form the PK
+    (first num_hash of them hashed, the rest range)."""
+    nk = num_key if num_key is not None else num_hash
+    schema = TableSchema(columns=tuple(
+        ColumnSchema(i, n, t,
+                     is_hash_key=(i < num_hash),
+                     is_range_key=(num_hash <= i < nk))
+        for i, (n, t) in enumerate(cols)), version=1)
+    return TableInfo(name, name, schema, PartitionSchema("hash", num_hash))
+
+
+TABLES = {
+    "warehouse": _mk("warehouse", [
+        ("w_id", I64), ("w_name", STR), ("w_ytd", F64)]),
+    "district": _mk("district", [
+        ("d_key", I64), ("d_w_id", I64), ("d_id", I64),
+        ("d_next_o_id", I64), ("d_ytd", F64)]),
+    "customer": _mk("customer", [
+        ("c_key", I64), ("c_w_id", I64), ("c_d_id", I64),
+        ("c_id", I64), ("c_name", STR), ("c_balance", F64),
+        ("c_ytd_payment", F64)]),
+    "stock": _mk("stock", [
+        ("s_key", I64), ("s_w_id", I64), ("s_i_id", I64),
+        ("s_quantity", I64), ("s_ytd", F64)]),
+    "orders": _mk("orders", [
+        ("o_key", I64), ("o_w_id", I64), ("o_d_id", I64),
+        ("o_id", I64), ("o_c_id", I64), ("o_ol_cnt", I64),
+        ("o_entry_d", I64)]),
+    "order_line": _mk("order_line", [
+        ("ol_key", I64), ("ol_w_id", I64), ("ol_o_id", I64),
+        ("ol_number", I64), ("ol_i_id", I64), ("ol_quantity", I64),
+        ("ol_amount", F64)]),
+    "history": _mk("history", [
+        ("h_key", I64), ("h_w_id", I64), ("h_c_id", I64),
+        ("h_amount", F64), ("h_date", I64)]),
+}
+
+DISTRICTS_PER_W = 10
+ITEMS = 1000            # reduced item catalog (spec: 100_000)
+CUSTOMERS_PER_D = 30    # reduced (spec: 3000)
+
+
+def _dkey(w, d):
+    return w * DISTRICTS_PER_W + d
+
+
+def _ckey(w, d, c):
+    return (_dkey(w, d)) * (CUSTOMERS_PER_D + 1) + c
+
+
+def _skey(w, i):
+    return w * (ITEMS + 1) + i
+
+
+@dataclass
+class TpccResult:
+    new_orders: int
+    payments: int
+    aborts: int          # conflict-aborted txns (not retried)
+    seconds: float
+
+    @property
+    def tpmc(self) -> float:
+        """Unconstrained NewOrders per minute."""
+        return self.new_orders / self.seconds * 60 if self.seconds else 0
+
+
+class TpccWorkload:
+    """Engine-level TPC-C over a YBClient (real txns, real tablets)."""
+
+    def __init__(self, client, warehouses: int = 1, seed: int = 7):
+        self.client = client
+        self.w = warehouses
+        self.rng = np.random.default_rng(seed)
+
+    async def create_tables(self, num_tablets: int = 2):
+        for info in TABLES.values():
+            await self.client.create_table(info, num_tablets=num_tablets)
+
+    async def load(self):
+        for w in range(self.w):
+            await self.client.insert("warehouse", [
+                {"w_id": w, "w_name": f"W{w}", "w_ytd": 0.0}])
+            await self.client.insert("district", [
+                {"d_key": _dkey(w, d), "d_w_id": w, "d_id": d,
+                 "d_next_o_id": 1, "d_ytd": 0.0}
+                for d in range(DISTRICTS_PER_W)])
+            for d in range(DISTRICTS_PER_W):
+                await self.client.insert("customer", [
+                    {"c_key": _ckey(w, d, c), "c_w_id": w, "c_d_id": d,
+                     "c_id": c, "c_name": f"C{c}", "c_balance": 0.0,
+                     "c_ytd_payment": 0.0}
+                    for c in range(CUSTOMERS_PER_D)])
+            step = 200
+            for lo in range(0, ITEMS, step):
+                await self.client.insert("stock", [
+                    {"s_key": _skey(w, i), "s_w_id": w, "s_i_id": i,
+                     "s_quantity": 100, "s_ytd": 0.0}
+                    for i in range(lo, min(lo + step, ITEMS))])
+
+    async def new_order(self, w: int, d: int) -> bool:
+        """NEW-ORDER: read+bump the district's next order id, insert
+        the order + its lines, decrement the picked items' stock — one
+        distributed transaction (reference: the NewOrder procedure)."""
+        rng = self.rng
+        c = int(rng.integers(0, CUSTOMERS_PER_D))
+        n_lines = int(rng.integers(5, 16))
+        items = rng.choice(ITEMS, size=n_lines, replace=False)
+        txn = await self.client.transaction().begin()
+        try:
+            drow = await txn.get(
+                "district", {"d_key": _dkey(w, d)})
+            o_id = int(drow["d_next_o_id"])
+            await txn.write("district", [RowOp("upsert", {
+                **drow, "d_next_o_id": o_id + 1})])
+            okey = _dkey(w, d) * 1_000_000 + o_id
+            await txn.write("orders", [RowOp("upsert", {
+                "o_key": okey, "o_w_id": w, "o_d_id": d, "o_id": o_id,
+                "o_c_id": c, "o_ol_cnt": n_lines,
+                "o_entry_d": int(time.time() * 1e6)})])
+            ol_ops, st_ops = [], []
+            for ln, i in enumerate(items):
+                i = int(i)
+                srow = await txn.get("stock",
+                                     {"s_key": _skey(w, i)})
+                qty = int(rng.integers(1, 11))
+                new_q = int(srow["s_quantity"]) - qty
+                if new_q < 10:
+                    new_q += 91
+                st_ops.append(RowOp("upsert", {
+                    **srow, "s_quantity": new_q,
+                    "s_ytd": float(srow["s_ytd"]) + qty}))
+                ol_ops.append(RowOp("upsert", {
+                    "ol_key": okey * 16 + ln, "ol_w_id": w,
+                    "ol_o_id": o_id, "ol_number": ln, "ol_i_id": i,
+                    "ol_quantity": qty, "ol_amount": qty * 7.5}))
+            await txn.write("stock", st_ops)
+            await txn.write("order_line", ol_ops)
+            await txn.commit()
+            return True
+        except (RpcError, asyncio.TimeoutError, OSError):
+            # conflicts AND transport failures count as one aborted
+            # txn; the intents release via the abort below
+            try:
+                await txn.abort()
+            except Exception:   # noqa: BLE001 — already aborted
+                pass
+            return False
+
+    async def payment(self, w: int, d: int) -> bool:
+        rng = self.rng
+        c = int(rng.integers(0, CUSTOMERS_PER_D))
+        amount = float(rng.uniform(1.0, 5000.0))
+        txn = await self.client.transaction().begin()
+        try:
+            wrow = await txn.get("warehouse", {"w_id": w})
+            await txn.write("warehouse", [RowOp("upsert", {
+                **wrow, "w_ytd": float(wrow["w_ytd"]) + amount})])
+            crow = await txn.get(
+                "customer", {"c_key": _ckey(w, d, c)})
+            await txn.write("customer", [RowOp("upsert", {
+                **crow,
+                "c_balance": float(crow["c_balance"]) - amount,
+                "c_ytd_payment":
+                    float(crow["c_ytd_payment"]) + amount})])
+            await txn.write("history", [RowOp("upsert", {
+                "h_key": int(rng.integers(0, 2**62)), "h_w_id": w,
+                "h_c_id": c, "h_amount": amount,
+                "h_date": int(time.time() * 1e6)})])
+            await txn.commit()
+            return True
+        except (RpcError, asyncio.TimeoutError, OSError):
+            try:
+                await txn.abort()
+            except Exception:   # noqa: BLE001
+                pass
+            return False
+
+    async def run(self, seconds: float = 10.0,
+                  concurrency: int = 4) -> TpccResult:
+        """Mixed NEW-ORDER/PAYMENT drivers, `concurrency` concurrent
+        terminals, each bound to its own district (the spec's terminal
+        model — cross-terminal conflicts still occur on warehouse rows
+        and shared stock)."""
+        stats = {"no": 0, "pay": 0, "abort": 0}
+        stop_at = time.perf_counter() + seconds
+
+        async def terminal(tid: int):
+            rng = np.random.default_rng(1000 + tid)
+            w = tid % self.w
+            d = tid % DISTRICTS_PER_W
+            while time.perf_counter() < stop_at:
+                if rng.random() < 0.51:          # NewOrder share
+                    ok = await self.new_order(w, d)
+                    if ok:
+                        stats["no"] += 1
+                    else:
+                        stats["abort"] += 1
+                else:
+                    ok = await self.payment(w, d)
+                    if ok:
+                        stats["pay"] += 1
+                    else:
+                        stats["abort"] += 1
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*[terminal(i) for i in range(concurrency)])
+        dt = time.perf_counter() - t0
+        return TpccResult(stats["no"], stats["pay"], stats["abort"], dt)
+
+
+async def verify_consistency(client, w: int) -> Dict[str, bool]:
+    """Spec-style consistency probes: (1) every district's d_next_o_id-1
+    equals its max o_id; (2) warehouse w_ytd equals the sum of its
+    districts' payments... simplified: w_ytd == sum(history amounts)."""
+    out = {}
+    ok = True
+    max_o: Dict[int, int] = {}
+    for o in (await client.scan("orders", ReadRequest(""))).rows:
+        if o["o_w_id"] == w:
+            max_o[o["o_d_id"]] = max(max_o.get(o["o_d_id"], 0),
+                                     o["o_id"])
+    for drow in (await client.scan("district", ReadRequest(""))).rows:
+        if drow["d_w_id"] != w:
+            continue
+        omax = max_o.get(drow["d_id"], 0)
+        if omax > 0 and drow["d_next_o_id"] != omax + 1:
+            ok = False
+    out["district_order_ids"] = ok
+    wrow = (await client.scan("warehouse", ReadRequest(""))).rows
+    w_ytd = sum(r["w_ytd"] for r in wrow if r["w_id"] == w)
+    hsum = sum(r["h_amount"] for r in
+               (await client.scan("history", ReadRequest(""))).rows
+               if r["h_w_id"] == w)
+    # incremental read-add-store vs one fresh sum differ by order-
+    # dependent f64 rounding: a RELATIVE bound stays stable as the
+    # totals grow
+    out["warehouse_ytd_matches_history"] = \
+        abs(w_ytd - hsum) <= 1e-9 * max(1.0, abs(hsum))
+    return out
