@@ -1,0 +1,73 @@
+#!/bin/bash
+# TPU-window watchdog: probe the axon tunnel every PROBE_INTERVAL
+# seconds (default 900 — round 2 proved windows can be ~20 minutes, so
+# hourly is too coarse); the moment a probe succeeds, fire tpu_smoke.py
+# (<5 min of device time, appends to TPU_RESULTS.md) and then the full
+# bench.py, and attempt to commit the evidence.  Every attempt is
+# logged to TPU_PROBE_LOG.jsonl so "zero windows" is provable.
+#
+# Re-runs the full pipeline only when HEAD moved since the last
+# successful on-device run (state in .tpu_probe_state, written with the
+# POST-commit HEAD so the watchdog's own evidence commit doesn't
+# re-trigger itself).  Failed smoke runs (device up, check failed) are
+# committed too — failure evidence is still evidence — and advance the
+# state so the same failure isn't re-appended every interval.
+set -u
+cd "$(dirname "$0")/.."
+REPO="$PWD"
+LOG="$REPO/TPU_PROBE_LOG.jsonl"
+STATE="$REPO/.tpu_probe_state"
+INTERVAL="${PROBE_INTERVAL:-900}"
+
+log() {  # log '{"k":"v"}'-style JSON fields
+    echo "{\"ts\": \"$(date -u +%FT%TZ)\", $1}" >> "$LOG"
+}
+
+while true; do
+    out=$(timeout 150 python -c "
+import jax
+d = jax.devices()
+print(d[0])" 2>/dev/null)
+    rc=$?
+    if [ $rc -ne 0 ] || echo "$out" | grep -qi cpu; then
+        log "\"probe\": \"down\", \"rc\": $rc"
+        sleep "$INTERVAL"
+        continue
+    fi
+    head=$(git rev-parse --short HEAD 2>/dev/null)
+    last=$(cat "$STATE" 2>/dev/null || echo none)
+    if [ "$head" = "$last" ]; then
+        log "\"probe\": \"up\", \"device\": \"$out\", \"action\": \"already-validated-at-$head\""
+        sleep "$INTERVAL"
+        continue
+    fi
+    log "\"probe\": \"up\", \"device\": \"$out\", \"action\": \"smoke+bench\""
+    SMOKE_SKIP_PROBE=1 timeout 900 python tpu_smoke.py \
+        > "$REPO/.tpu_smoke_last.json" 2> "$REPO/.tpu_smoke_last.err"
+    smoke_rc=$?
+    log "\"smoke_rc\": $smoke_rc"
+    if [ $smoke_rc -eq 2 ]; then
+        # probe said up but smoke saw no device (window closed mid-way)
+        sleep "$INTERVAL"
+        continue
+    fi
+    commit_files="TPU_RESULTS.md TPU_PROBE_LOG.jsonl"
+    msg="On-device TPU evidence: tpu_smoke (rc=$smoke_rc) at $head"
+    if [ $smoke_rc -eq 0 ]; then
+        # full bench (bounded; the smoke evidence is already on disk)
+        timeout 3600 python bench.py > "$REPO/BENCH_tpu_live.json" \
+            2> "$REPO/.bench_tpu_live.err"
+        bench_rc=$?
+        log "\"bench_rc\": $bench_rc"
+        if [ $bench_rc -eq 0 ] && [ -s "$REPO/BENCH_tpu_live.json" ]; then
+            commit_files="$commit_files BENCH_tpu_live.json"
+            msg="On-device TPU evidence: tpu_smoke + bench at $head"
+        fi
+    fi
+    git add $commit_files 2>/dev/null
+    git commit -m "$msg" 2>/dev/null \
+        && log "\"committed\": true" || log "\"committed\": false"
+    # post-commit HEAD: the evidence commit must not re-trigger a run
+    git rev-parse --short HEAD > "$STATE" 2>/dev/null
+    sleep "$INTERVAL"
+done
