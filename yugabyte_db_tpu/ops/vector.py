@@ -85,6 +85,63 @@ def kmeans(data: np.ndarray, k: int, iters: int = 10,
     return np.asarray(out)
 
 
+@partial(jax.jit, static_argnames=("k", "nprobe"))
+def _ivf_probe_search(queries, centroids, lists, list_lens, vec_flat,
+                      norms_flat, k: int, nprobe: int):
+    """Per-query IVF gather search.  Every array is a TRACED operand —
+    never close over the dataset: a static `self` would bake multi-GB
+    arrays into the executable as XLA constants (minutes of constant
+    folding at lowering, a recompile per dataset — the round-4 bench
+    pathology)."""
+    dc = l2_distance2(queries, centroids)                 # [Q, K]
+    _, probe = jax.lax.top_k(-dc, nprobe)                 # [Q, nprobe]
+    cand = lists[probe]                                   # [Q, nprobe, M]
+    q_, p_, m_ = cand.shape
+    cand = cand.reshape(q_, p_ * m_)
+    cand_valid = (jnp.arange(m_)[None, None, :]
+                  < list_lens[probe][:, :, None]).reshape(q_, p_ * m_)
+    vecs = vec_flat[cand]                       # [Q, C, D] mm dtype
+    dots = jnp.einsum("qd,qcd->qc", queries.astype(vec_flat.dtype), vecs,
+                      preferred_element_type=jnp.float32)
+    d = (jnp.sum(queries.astype(jnp.float32) ** 2, axis=1, keepdims=True)
+         + norms_flat[cand] - 2.0 * dots)
+    d = jnp.where(cand_valid, jnp.maximum(d, 0.0), jnp.inf)
+    neg, pos = jax.lax.top_k(-d, k)
+    return -neg, jnp.take_along_axis(cand, pos, axis=1)
+
+
+@partial(jax.jit, static_argnames=("k",))
+def _full_scan_search(queries, vec_chunks, nrm_chunks, k: int):
+    """Batched full-scan k-NN over a pre-chunked [C, chunk, D] matrix:
+    per-chunk distance matmul + top-k under lax.scan, then a final
+    top-k over the per-chunk winners.  Exact, pure MXU, one shared HBM
+    read of the matrix for the whole query batch.  The chunked layout
+    is built ONCE at index construction (padded rows carry inf norms,
+    so they can never win a top-k slot) — the jit does no padding and
+    captures no constants."""
+    nchunks, chunk, _ = vec_chunks.shape
+    qn = jnp.sum(queries.astype(jnp.float32) ** 2, axis=1, keepdims=True)
+    qmm = queries.astype(vec_chunks.dtype)
+
+    def body(carry, xs):
+        v, m = xs
+        dots = jax.lax.dot_general(
+            qmm, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dist = qn + m[None, :] - 2.0 * dots
+        neg, pos = jax.lax.top_k(-dist, k)
+        return carry, (neg, pos)
+
+    _, (negs, poss) = jax.lax.scan(
+        body, 0, (vec_chunks, nrm_chunks))         # [C, Q, k] each
+    negs = jnp.moveaxis(negs, 0, 1).reshape(queries.shape[0], -1)
+    poss = (jnp.moveaxis(poss, 0, 1)
+            + (jnp.arange(nchunks) * chunk)[None, :, None]
+            ).reshape(queries.shape[0], -1)
+    neg, sel = jax.lax.top_k(negs, k)
+    return jnp.maximum(-neg, 0.0), jnp.take_along_axis(poss, sel, axis=1)
+
+
 class IvfFlatIndex:
     """IVF-flat ANN index (pgvector `ivfflat` analog).
 
@@ -92,18 +149,91 @@ class IvfFlatIndex:
     centroid -> per-list row-id buckets padded to a rectangle so the
     whole index is three device arrays. Search: find `nprobe` nearest
     centroids per query, gather those lists, one distance matmul + top_k.
+
+    The vector matrix is stored once, in the chunked [C, chunk, D]
+    layout the full-scan path streams (padded tail rows have inf
+    norms); the gather path reads it through a free flat reshape.  All
+    search entry points pass the arrays as traced jit operands — see
+    _ivf_probe_search for why self must never be static.
     """
+
+    #: rows per full-scan chunk (bounds per-step VMEM/working set)
+    CHUNK = 1 << 17
 
     def __init__(self, centroids: np.ndarray, lists: np.ndarray,
                  list_lens: np.ndarray, vectors: jnp.ndarray):
         self.centroids = jnp.asarray(centroids, jnp.float32)   # [K, D]
         self.lists = jnp.asarray(lists)                        # [K, M] int32
         self.list_lens = jnp.asarray(list_lens)                # [K] int32
+        self.n = int(np.shape(vectors)[0])
+        self.dim = int(np.shape(vectors)[1])
+        self._np = None               # CPU list-major twin
+        self._chunks_cache = None     # lazy device layout on CPU
+        self._src = None              # numpy source (CPU twin only)
+        if jax.default_backend() == "cpu" and self.n:
+            # CPU twin: list-major layout (vectors sorted by IVF list,
+            # each list a contiguous slice).  On a compute-bound host
+            # the probed search is one small GEMM per list — no
+            # [Q, nprobe*maxlen, D] gather materialization and no
+            # second resident copy: the chunked device layout is built
+            # lazily, only if a device kernel is driven directly.
+            v_np = np.ascontiguousarray(np.asarray(vectors, np.float32))
+            norms_np = np.einsum("nd,nd->n", v_np, v_np)
+            lists_np = np.asarray(self.lists)
+            lens_np = np.asarray(self.list_lens).astype(np.int64)
+            # row-major boolean pick keeps list grouping: one pass,
+            # no per-list host round-trips
+            mask = np.arange(lists_np.shape[1])[None, :] < lens_np[:, None]
+            ids = lists_np[mask].astype(np.int64)
+            starts = np.concatenate(
+                [[0], np.cumsum(lens_np)[:-1]]).astype(np.int64)
+            cent = np.asarray(self.centroids)
+            self._np = {
+                "ids": ids, "starts": starts, "counts": lens_np,
+                "sorted": np.ascontiguousarray(v_np[ids]),
+                "sorted_norms": norms_np[ids],
+                "cent": cent,
+                "cent_norms": (cent ** 2).sum(1),
+            }
+            self._src = v_np
+        else:
+            self._chunks_cache = self._build_chunks(
+                jnp.asarray(vectors, jnp.float32))
+
+    def _build_chunks(self, v32: jnp.ndarray):
+        """[C, chunk, D] mm-dtype matrix + [C, chunk] f32 norms with
+        inf-padded tail (padded rows can never win a top-k slot)."""
+        norms = jnp.sum(v32 ** 2, axis=1)
+        chunk = max(1, min(self.CHUNK, self.n))
+        pad = (-self.n) % chunk
         # matmul dtype: bf16 on accelerators (halves HBM; f32 accum),
         # f32 on CPU (bf16 is emulated there)
-        self.vectors = jnp.asarray(vectors, _mm_dtype())       # [N, D]
-        self.norms = jnp.sum(jnp.asarray(vectors, jnp.float32) ** 2,
-                             axis=1)                           # [N] f32
+        vec = jnp.pad(v32.astype(_mm_dtype()), ((0, pad), (0, 0)))
+        nrm = jnp.pad(norms, (0, pad), constant_values=jnp.inf)
+        return (vec.reshape(-1, chunk, self.dim), nrm.reshape(-1, chunk))
+
+    @property
+    def _vec(self) -> jnp.ndarray:
+        if self._chunks_cache is None:
+            self._chunks_cache = self._build_chunks(
+                jnp.asarray(self._src, jnp.float32))
+        return self._chunks_cache[0]
+
+    @property
+    def _nrm(self) -> jnp.ndarray:
+        if self._chunks_cache is None:
+            self._chunks_cache = self._build_chunks(
+                jnp.asarray(self._src, jnp.float32))
+        return self._chunks_cache[1]
+
+    @property
+    def vectors(self) -> jnp.ndarray:
+        """[N, D] flat view (reshape over contiguous dims is free)."""
+        return self._vec.reshape(-1, self.dim)[: self.n]
+
+    @property
+    def norms(self) -> jnp.ndarray:
+        return self._nrm.reshape(-1)[: self.n]
 
     @classmethod
     def build(cls, data: np.ndarray, nlists: int = 100,
@@ -132,82 +262,76 @@ class IvfFlatIndex:
             lists[li, :len(seg)] = seg
         return cls(cent, lists, lens, jnp.asarray(data, jnp.float32))
 
-    @partial(jax.jit, static_argnames=("self", "k", "nprobe"))
-    def _search(self, queries, k: int, nprobe: int):
-        dc = l2_distance2(queries, self.centroids)            # [Q, K]
-        _, probe = jax.lax.top_k(-dc, nprobe)                 # [Q, nprobe]
-        cand = self.lists[probe]                              # [Q, nprobe, M]
-        q_, p_, m_ = cand.shape
-        cand = cand.reshape(q_, p_ * m_)
-        cand_valid = (jnp.arange(m_)[None, None, :]
-                      < self.list_lens[probe][:, :, None]).reshape(q_, p_ * m_)
-        vecs = self.vectors[cand]                   # [Q, C, D] mm dtype
-        dots = jnp.einsum("qd,qcd->qc", queries.astype(_mm_dtype()), vecs,
-                          preferred_element_type=jnp.float32)
-        d = (jnp.sum(queries.astype(jnp.float32) ** 2, axis=1, keepdims=True)
-             + self.norms[cand] - 2.0 * dots)
-        d = jnp.where(cand_valid, jnp.maximum(d, 0.0), jnp.inf)
-        neg, pos = jax.lax.top_k(-d, k)
-        return -neg, jnp.take_along_axis(cand, pos, axis=1)
-
-    @partial(jax.jit, static_argnames=("self", "k", "chunk"))
-    def _search_full(self, queries, k: int, chunk: int):
-        """Batched full-scan k-NN in N-chunks: per-chunk distance
-        matmul + top-k, then a final top-k over the per-chunk winners.
-        Exact, pure MXU, one shared read of the vector matrix for the
-        whole query batch — on TPU this is HBM-optimal whenever the
-        batch's probe lists would union to most of the dataset
-        (reading per-query gathered lists costs Q*nprobe/nlists reads
-        of the matrix; one shared pass costs exactly one)."""
-        n, d_ = self.vectors.shape
-        pad = (-n) % chunk
-        vec = jnp.pad(self.vectors, ((0, pad), (0, 0)))
-        nrm = jnp.pad(self.norms, (0, pad), constant_values=jnp.inf)
-        nchunks = (n + pad) // chunk
-        vec = vec.reshape(nchunks, chunk, d_)
-        nrm = nrm.reshape(nchunks, chunk)
-        qn = jnp.sum(queries.astype(jnp.float32) ** 2, axis=1,
-                     keepdims=True)
-        mm = _mm_dtype()
-        qmm = queries.astype(mm)
-
-        def body(carry, xs):
-            v, m = xs
-            dots = jax.lax.dot_general(
-                qmm, v, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            dist = qn + m[None, :] - 2.0 * dots
-            neg, pos = jax.lax.top_k(-dist, k)
-            return carry, (neg, pos)
-
-        _, (negs, poss) = jax.lax.scan(
-            body, 0, (vec, nrm))                   # [C, Q, k] each
-        negs = jnp.moveaxis(negs, 0, 1).reshape(queries.shape[0], -1)
-        poss = (jnp.moveaxis(poss, 0, 1)
-                + (jnp.arange(nchunks) * chunk)[None, :, None]
-                ).reshape(queries.shape[0], -1)
-        neg, sel = jax.lax.top_k(negs, k)
-        return jnp.maximum(-neg, 0.0), jnp.take_along_axis(poss, sel,
-                                                           axis=1)
+    def _cpu_list_search(self, q: np.ndarray, k: int, nprobe: int
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+        """List-major IVF search on the host (the CPU twin of the
+        device kernels).  For each probed list, one contiguous
+        [q_l, D] x [D, list_len] GEMM + a partial sort; per-query
+        results merge across lists.  Total work ~= Q*nprobe*(N/nlists)
+        *D MACs — at 1M x 768 / Q=64 / nprobe=8/200 that is ~25x fewer
+        FLOPs than the exhaustive scan a single core cannot afford."""
+        s = self._np
+        nq = len(q)
+        cd = ((q ** 2).sum(1)[:, None] + s["cent_norms"][None, :]
+              - 2.0 * q @ s["cent"].T)                     # [Q, K]
+        npb = min(nprobe, cd.shape[1])
+        probe = np.argpartition(cd, npb - 1, axis=1)[:, :npb]
+        qn = (q ** 2).sum(1)
+        # collect per-query candidate (dist, id) pairs across probed
+        # lists, then ONE partial sort per query at the end (a partial
+        # sort per (query, list) costs more than the gemv work at small
+        # per-list query counts)
+        cand_d = [[] for _ in range(nq)]
+        cand_i = [[] for _ in range(nq)]
+        for li in np.unique(probe):
+            qs = np.nonzero((probe == li).any(axis=1))[0]
+            st, c = s["starts"][li], s["counts"][li]
+            if c == 0:
+                continue
+            seg = s["sorted"][st:st + c]                   # [c, D]
+            # seg-major orientation: M=c is large, the BLAS-friendly
+            # shape for the typically tiny per-list query count
+            dots = seg @ q[qs].T                           # [c, q_l]
+            dist = (qn[qs][None, :]
+                    + s["sorted_norms"][st:st + c, None] - 2.0 * dots)
+            ids = s["ids"][st:st + c]
+            for j, qi in enumerate(qs):
+                cand_d[qi].append(dist[:, j])
+                cand_i[qi].append(ids)
+        D = np.full((nq, k), np.inf, np.float32)
+        I = np.zeros((nq, k), np.int64)
+        for qi in range(nq):
+            if not cand_d[qi]:
+                continue
+            dd = np.concatenate(cand_d[qi])
+            ii = np.concatenate(cand_i[qi])
+            kk = min(k, len(dd))
+            sel = np.argpartition(dd, kk - 1)[:kk]
+            o = np.argsort(dd[sel])
+            D[qi, :kk] = dd[sel][o]
+            I[qi, :kk] = ii[sel][o]
+        return np.maximum(D, 0.0), I
 
     def search(self, queries: np.ndarray, k: int = 10, nprobe: int = 8
                ) -> Tuple[np.ndarray, np.ndarray]:
         """Routes by batch size: when the batch's probed lists would
         union to (most of) the whole index, one shared full-scan matmul
         is both cheaper in HBM reads and exact; small batches keep the
-        per-query IVF gather (reads only nprobe lists)."""
+        per-query IVF gather (reads only nprobe lists).  The gather
+        path also materializes a [Q, nprobe*maxlen, D] candidate
+        tensor, so it is only ever the right shape for SMALL batches —
+        measured on CPU at 200K x 128 / Q=64 it is 5x SLOWER than the
+        shared full scan despite 25x fewer MACs."""
+        if self._np is not None:
+            return self._cpu_list_search(
+                np.asarray(queries, np.float32), k, nprobe)
         q = jnp.asarray(queries, jnp.float32)
         nlists = int(self.centroids.shape[0])
         if len(queries) * nprobe >= nlists:
-            chunk = 1 << 17
-            d, i = self._search_full(q, k, min(chunk,
-                                               self.vectors.shape[0]))
+            d, i = _full_scan_search(q, self._vec, self._nrm, k)
         else:
-            d, i = self._search(q, k, nprobe)
+            d, i = _ivf_probe_search(
+                q, self.centroids, self.lists, self.list_lens,
+                self._vec.reshape(-1, self.dim), self._nrm.reshape(-1),
+                k, nprobe)
         return np.asarray(d), np.asarray(i)
-
-    def __hash__(self):   # jit static self: identity-hashable
-        return id(self)
-
-    def __eq__(self, other):
-        return self is other
