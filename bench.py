@@ -362,10 +362,10 @@ def main():
                 await mc.shutdown()
         try:
             tr = _aio.run(run_tpcc())
-            results["tpcc"] = {
-                "tpmc_unconstrained": tr.tpmc,
-                "new_orders": tr.new_orders, "payments": tr.payments,
-                "aborts": tr.aborts, "seconds": tr.seconds}
+            import dataclasses as _dc
+            results["tpcc"] = {**_dc.asdict(tr),
+                               "tpmc_unconstrained": tr.tpmc,
+                               "abort_rate": tr.abort_rate}
         except Exception as e:   # noqa: BLE001 — report, don't fail bench
             results["tpcc"] = {"error": str(e)[:200]}
 

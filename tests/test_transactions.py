@@ -7,7 +7,7 @@ import asyncio
 import pytest
 
 from yugabyte_db_tpu.client import YBTransaction
-from yugabyte_db_tpu.docdb import ReadRequest
+from yugabyte_db_tpu.docdb import ReadRequest, RowOp
 from yugabyte_db_tpu.docdb.table_codec import TableInfo
 from yugabyte_db_tpu.dockv.packed_row import (
     ColumnSchema, ColumnType, TableSchema,
@@ -363,6 +363,94 @@ class TestSerializableStress:
                 assert total == float(len(committed)), \
                     (total, len(committed))
                 assert committed   # at least some made progress
+            finally:
+                await mc.shutdown()
+        run(go())
+
+
+class TestForUpdate:
+    """SELECT ... FOR UPDATE locking reads (reference: row locks via
+    kStrongWrite intents + READ COMMITTED statement read times)."""
+
+    def test_hot_row_rmw_serializes_without_aborts(self, tmp_path):
+        """N concurrent read-modify-writes of ONE row through
+        for_update all commit (the wait queue serializes them) and no
+        update is lost — the exact shape that aborts ~50% of the time
+        under plain SI first-committer-wins."""
+        async def go():
+            mc, c = await make_cluster(str(tmp_path))
+            try:
+                async def incr(amount):
+                    txn = await c.transaction().begin()
+                    row = await txn.get("acct", {"k": 3}, for_update=True)
+                    await txn.write("acct", [RowOp("upsert", {
+                        **row, "bal": row["bal"] + amount})])
+                    await txn.commit()
+                await asyncio.gather(*[incr(10.0) for _ in range(12)])
+                final = await c.get("acct", {"k": 3})
+                assert final["bal"] == 100.0 + 12 * 10.0
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_lock_released_on_abort(self, tmp_path):
+        async def go():
+            mc, c = await make_cluster(str(tmp_path))
+            try:
+                t1 = await c.transaction().begin()
+                await t1.get("acct", {"k": 5}, for_update=True)
+                await t1.abort()
+                # a second locking read must not wait out the timeout
+                t2 = await c.transaction().begin()
+                row = await asyncio.wait_for(
+                    t2.get("acct", {"k": 5}, for_update=True), 3.0)
+                assert row["bal"] == 100.0
+                await t2.commit()
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_lock_released_on_commit_without_write(self, tmp_path):
+        """A txn that locks a row but never writes it must still
+        release the claim at commit (placeholder-only participant)."""
+        async def go():
+            mc, c = await make_cluster(str(tmp_path))
+            try:
+                t1 = await c.transaction().begin()
+                await t1.get("acct", {"k": 7}, for_update=True)
+                await t1.commit()
+                t2 = await c.transaction().begin()
+                row = await asyncio.wait_for(
+                    t2.get("acct", {"k": 7}, for_update=True), 3.0)
+                assert row is not None
+                await t2.commit()
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_locking_read_sees_latest_committed(self, tmp_path):
+        """A for_update read inside an older snapshot returns the
+        LATEST committed version (statement read time), not the stale
+        snapshot — the lost-update guard depends on it."""
+        async def go():
+            mc, c = await make_cluster(str(tmp_path))
+            try:
+                t1 = await c.transaction().begin()   # old snapshot
+                await t1.get("acct", {"k": 9})       # plain read: 100
+                # another txn bumps the row AFTER t1's snapshot
+                t2 = await c.transaction().begin()
+                row = await t2.get("acct", {"k": 9}, for_update=True)
+                await t2.write("acct", [RowOp("upsert", {
+                    **row, "bal": 150.0})])
+                await t2.commit()
+                # t1's locking read now sees 150, and its write sticks
+                row = await t1.get("acct", {"k": 9}, for_update=True)
+                assert row["bal"] == 150.0
+                await t1.write("acct", [RowOp("upsert", {
+                    **row, "bal": row["bal"] + 1})])
+                await t1.commit()
+                final = await c.get("acct", {"k": 9})
+                assert final["bal"] == 151.0
             finally:
                 await mc.shutdown()
         run(go())

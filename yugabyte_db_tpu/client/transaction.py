@@ -41,6 +41,13 @@ class YBTransaction:
         # writes (reference: read-your-own-writes via local intents in
         # pggate's buffered operations)
         self._writes: Dict[str, Dict[tuple, RowOp]] = {}
+        # FOR UPDATE lock times: (table, pk tuple) -> lock ht.  A later
+        # write of a locked row validates first-committer-wins against
+        # the LOCK time (the exclusive claim makes that sound), which
+        # is what lets hot-row read-modify-writes serialize through the
+        # wait queue instead of aborting (reference: READ COMMITTED
+        # per-statement read times + FOR UPDATE row locks)
+        self._lock_hts: Dict[Tuple[str, tuple], int] = {}
 
     # ------------------------------------------------------------------
     async def _status_tablet(self) -> TabletLocation:
@@ -103,6 +110,8 @@ class YBTransaction:
         status_info = {"tablet_id": status_loc.tablet_id,
                        "addrs": [list(a) for _, a in status_loc.replicas]}
 
+        pk_names_ = [c.name for c in ct.info.schema.key_columns]
+
         async def send(tablet_id: str, tops: List[RowOp]) -> int:
             loc = next(l for l in ct.locations if l.tablet_id == tablet_id)
             self._participants[tablet_id] = [list(a) for _, a in loc.replicas]
@@ -115,6 +124,12 @@ class YBTransaction:
                        "req": write_request_to_wire(req),
                        "txn_id": self.txn_id, "start_ht": self.start_ht,
                        "status_tablet": status_info}
+            if self._lock_hts:
+                hts = [self._lock_hts.get(
+                    (table, tuple(op.row.get(k) for k in pk_names_)))
+                    for op in tops]
+                if any(hts):
+                    payload["op_read_hts"] = hts
             r = await self.client._call_leader(ct, tablet_id, "txn_write",
                                                payload)
             return r["rows_affected"]
@@ -126,10 +141,9 @@ class YBTransaction:
             if e.code in ("ABORTED", "DEADLOCK"):
                 await self.abort()
             raise
-        pk_names = [c.name for c in ct.info.schema.key_columns]
         wset = self._writes.setdefault(table, {})
         for op in ops:
-            pk = tuple(op.row.get(k) for k in pk_names)
+            pk = tuple(op.row.get(k) for k in pk_names_)
             if op.kind == "upsert" and wset.get(pk) is not None \
                     and wset[pk].kind == "upsert":
                 # partial re-write of the same row merges columns
@@ -146,15 +160,35 @@ class YBTransaction:
     async def delete(self, table: str, pk_rows: Sequence[dict]) -> int:
         return await self.write(table, [RowOp("delete", r) for r in pk_rows])
 
-    async def get(self, table: str, pk_row: dict) -> Optional[dict]:
-        """Read-your-own-writes point get at the txn snapshot."""
+    async def get(self, table: str, pk_row: dict,
+                  for_update: bool = False) -> Optional[dict]:
+        """Read-your-own-writes point get at the txn snapshot.
+
+        `for_update=True` makes it a LOCKING read (SELECT ... FOR
+        UPDATE): the row's key is claimed exclusively (waiting out the
+        current holder via the wait queue), the LATEST committed
+        version is returned, and a later write of the row in this txn
+        validates against the lock time — hot-row read-modify-writes
+        then serialize instead of aborting under first-committer-wins
+        (reference: FOR UPDATE row locks through docdb intents +
+        READ COMMITTED statement read times)."""
         assert self.state == PENDING
         ct = await self.client._table(table)
         loc = self.client._tablet_for_key(ct, pk_row)
         payload = {"tablet_id": loc.tablet_id, "txn_id": self.txn_id,
                    "pk_row": pk_row, "read_ht": self.start_ht,
                    "table_id": ct.info.table_id}
-        if self.isolation == "serializable":
+        if for_update:
+            status_loc = await self._status_tablet()
+            payload["for_update"] = True
+            payload["status_tablet"] = {
+                "tablet_id": status_loc.tablet_id,
+                "addrs": [list(a) for _, a in status_loc.replicas]}
+            # the locked tablet is a full participant: commit/abort
+            # must reach it to release the exclusive claim
+            self._participants[loc.tablet_id] = [
+                list(a) for _, a in loc.replicas]
+        elif self.isolation == "serializable":
             status_loc = await self._status_tablet()
             payload["serializable"] = True
             payload["status_tablet"] = {
@@ -169,6 +203,10 @@ class YBTransaction:
             if e.code in ("ABORTED", "DEADLOCK"):
                 await self.abort()
             raise
+        if for_update and r.get("lock_ht"):
+            pk_names = [c.name for c in ct.info.schema.key_columns]
+            pk = tuple(pk_row.get(k) for k in pk_names)
+            self._lock_hts[(table, pk)] = r["lock_ht"]
         row = r.get("row")
         if row is not None and r.get("from_intent"):
             # intents store only written columns; merge over snapshot? For

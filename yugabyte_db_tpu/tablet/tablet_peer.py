@@ -353,7 +353,8 @@ class TabletPeer:
 
     # --- transactional write path ------------------------------------------
     async def write_txn(self, req: WriteRequest, txn_id: str,
-                        start_ht: int, status_tablet=None) -> int:
+                        start_ht: int, status_tablet=None,
+                        op_read_hts=None) -> int:
         if self.split_done or self.split_requested:
             raise RpcError("tablet has been split", "TABLET_SPLIT")
         if not self.consensus.is_leader():
@@ -361,7 +362,19 @@ class TabletPeer:
                 f"not leader (hint={self.consensus.leader_hint()})",
                 "LEADER_NOT_READY")
         return await self.participant.write_intents(
-            req, txn_id, start_ht, status_tablet)
+            req, txn_id, start_ht, status_tablet, op_read_hts)
+
+    async def lock_for_update(self, keys, txn_id: str, start_ht: int,
+                              status_tablet=None) -> int:
+        """FOR UPDATE row locks (leader only); returns the lock ht."""
+        if self.split_done or self.split_requested:
+            raise RpcError("tablet has been split", "TABLET_SPLIT")
+        if not self.consensus.is_leader():
+            raise RpcError(
+                f"not leader (hint={self.consensus.leader_hint()})",
+                "LEADER_NOT_READY")
+        return await self.participant.lock_for_update(
+            txn_id, start_ht, keys, status_tablet)
 
     async def lock_reads(self, keys, txn_id: str, start_ht: int,
                          status_tablet=None) -> None:

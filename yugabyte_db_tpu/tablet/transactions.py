@@ -352,14 +352,42 @@ class TransactionParticipant:
         self.wait_timeout = 5.0
 
     # --- write path --------------------------------------------------------
+    async def lock_for_update(self, txn_id: str, start_ht: int,
+                              keys: List[bytes],
+                              status_tablet=None) -> int:
+        """Pessimistic row lock for a locking read (SELECT ... FOR
+        UPDATE; reference: kStrongWrite intents taken by locking reads,
+        docdb/conflict_resolution.cc, and READ COMMITTED's per-
+        statement read time, tablet/running_transaction.cc).  Waits in
+        the wait queue until the keys' current holders decide, claims
+        them exclusively, and returns the lock hybrid time: a read at
+        that ht sees the latest committed version, and a later write of
+        the key may validate first-committer-wins against the LOCK time
+        instead of the txn snapshot — sound because the exclusive claim
+        guarantees no other commit lands on the key after it.  The
+        claim itself is leader-memory (like the wait queue): if a
+        failover drops it, the relaxed validation still catches any
+        interleaved commit, because it rechecks the regular store at
+        write time."""
+        if status_tablet:
+            self._txn_meta.setdefault(txn_id, {})["status_tablet"] = \
+                status_tablet
+        await self._resolve_conflicts(txn_id, start_ht, keys)
+        return self.peer.clock.now().value
+
     async def write_intents(self, req: WriteRequest, txn_id: str,
-                            start_ht: int, status_tablet=None) -> int:
+                            start_ht: int, status_tablet=None,
+                            op_read_hts=None) -> int:
         """Resolve conflicts then Raft-replicate the intent batch.
 
         The key claims happen SYNCHRONOUSLY (no await) the moment the
         conflict check passes — otherwise two concurrent writers of the
         same key would both pass the check before either intent
-        replicates (write-write race)."""
+        replicates (write-write race).
+
+        `op_read_hts` (aligned with req.ops) carries per-key read-time
+        overrides from FOR UPDATE locking reads: validation for those
+        keys is against the lock time, not the txn snapshot."""
         codec = self.tablet._codec_for(req.table_id)
         keys = [codec.doc_key_prefix(op.row) for op in req.ops]
         if status_tablet:
@@ -373,16 +401,19 @@ class TransactionParticipant:
         # NEWER than our snapshot on any target key is a conflict — the
         # reference checks regular-DB records against the read time in
         # ResolveTransactionConflicts (docdb/conflict_resolution.cc).
-        for k in keys:
+        for i, k in enumerate(keys):
+            eff_ht = start_ht
+            if op_read_hts and i < len(op_read_hts) and op_read_hts[i]:
+                eff_ht = max(start_ht, op_read_hts[i])
             committed = self._newest_committed_ht(k)
-            if committed is not None and committed > start_ht:
+            if committed is not None and committed > eff_ht:
                 per_txn = self._intents.get(txn_id, {})
                 self._release(txn_id,
                               [kk for kk in keys
                                if per_txn.get(kk) is None])
                 raise RpcError(
                     f"txn {txn_id} write conflict: key modified at "
-                    f"{committed} after snapshot {start_ht}", "ABORTED")
+                    f"{committed} after snapshot {eff_ht}", "ABORTED")
         if status_tablet:
             self._txn_meta.setdefault(txn_id, {})["status_tablet"] = \
                 status_tablet

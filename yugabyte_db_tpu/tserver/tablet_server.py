@@ -695,7 +695,8 @@ class TabletServer:
                     f"request {req.schema_version}, tablet {cur}",
                     "SCHEMA_MISMATCH")
         n = await peer.write_txn(req, payload["txn_id"], payload["start_ht"],
-                                 payload.get("status_tablet"))
+                                 payload.get("status_tablet"),
+                                 payload.get("op_read_hts"))
         return {"rows_affected": n}
 
     async def _drive_txn_decision(self, tablet_id: str, method: str,
@@ -800,7 +801,18 @@ class TabletServer:
         protection)."""
         from ..docdb.operations import ReadRequest
         peer = self._peer(payload["tablet_id"])
-        if payload.get("serializable"):
+        lock_ht = None
+        if payload.get("for_update"):
+            # locking read: claim the key exclusively (waiting out the
+            # current holder), then read the LATEST committed version —
+            # the reference's SELECT ... FOR UPDATE / READ COMMITTED
+            # statement-read shape
+            codec = peer.tablet._codec_for(payload.get("table_id", ""))
+            key = codec.doc_key_prefix(payload["pk_row"])
+            lock_ht = await peer.lock_for_update(
+                [key], payload["txn_id"], payload.get("read_ht") or 0,
+                payload.get("status_tablet"))
+        elif payload.get("serializable"):
             codec = peer.tablet._codec_for(payload.get("table_id", ""))
             key = codec.doc_key_prefix(payload["pk_row"])
             await peer.lock_reads([key], payload["txn_id"],
@@ -811,13 +823,16 @@ class TabletServer:
         if own is not None:
             kind, row = own[0], own[1]
             if kind == "delete":
-                return {"row": None, "from_intent": True}
-            return {"row": row, "from_intent": True}
+                return {"row": None, "from_intent": True,
+                        **({"lock_ht": lock_ht} if lock_ht else {})}
+            return {"row": row, "from_intent": True,
+                    **({"lock_ht": lock_ht} if lock_ht else {})}
         req = ReadRequest(payload.get("table_id", ""),
                           pk_eq=payload["pk_row"],
-                          read_ht=payload.get("read_ht"))
+                          read_ht=lock_ht or payload.get("read_ht"))
         resp = await peer.read(req)
-        return {"row": resp.rows[0] if resp.rows else None}
+        return {"row": resp.rows[0] if resp.rows else None,
+                **({"lock_ht": lock_ht} if lock_ht else {})}
 
     # coordinator RPCs (valid on the caught-up status tablet leader)
     def _coordinator(self, tablet_id: str):
