@@ -1,0 +1,41 @@
+-- SAVEPOINT / ROLLBACK TO / RELEASE subtransactions (reference:
+-- SetActiveSubTransaction + RollbackToSubTransaction through pggate,
+-- src/yb/tserver/pg_client.proto)
+CREATE TABLE ledger (id bigint, amt bigint, PRIMARY KEY (id)) WITH tablets = 2;
+INSERT INTO ledger (id, amt) VALUES (1, 10), (2, 20);
+BEGIN;
+INSERT INTO ledger (id, amt) VALUES (3, 30);
+SAVEPOINT a;
+INSERT INTO ledger (id, amt) VALUES (4, 40);
+UPDATE ledger SET amt = 11 WHERE id = 1;
+SELECT id, amt FROM ledger ORDER BY id;
+ROLLBACK TO SAVEPOINT a;
+SELECT id, amt FROM ledger ORDER BY id;
+INSERT INTO ledger (id, amt) VALUES (5, 50);
+SAVEPOINT b;
+DELETE FROM ledger WHERE id = 2;
+SELECT count(*) FROM ledger;
+ROLLBACK TO b;
+SELECT count(*) FROM ledger;
+RELEASE SAVEPOINT b;
+COMMIT;
+SELECT id, amt FROM ledger ORDER BY id;
+-- nested savepoints: rolling back the outer discards the inner too
+BEGIN;
+SAVEPOINT outer_sp;
+UPDATE ledger SET amt = 999 WHERE id = 1;
+SAVEPOINT inner_sp;
+UPDATE ledger SET amt = 888 WHERE id = 2;
+ROLLBACK TO outer_sp;
+SELECT id, amt FROM ledger ORDER BY id;
+COMMIT;
+-- the savepoint survives its own rollback and can be reused
+BEGIN;
+SAVEPOINT s;
+INSERT INTO ledger (id, amt) VALUES (6, 60);
+ROLLBACK TO s;
+INSERT INTO ledger (id, amt) VALUES (7, 70);
+ROLLBACK TO s;
+COMMIT;
+SELECT id FROM ledger ORDER BY id;
+DROP TABLE ledger;

@@ -1,0 +1,25 @@
+-- set operations: UNION [ALL] / INTERSECT [ALL] / EXCEPT [ALL]
+-- (reference: PG set ops, optimizer/prep/prepunion.c)
+CREATE TABLE north (id bigint, city text, pop bigint, PRIMARY KEY (id)) WITH tablets = 2;
+CREATE TABLE south (id bigint, city text, pop bigint, PRIMARY KEY (id)) WITH tablets = 2;
+INSERT INTO north (id, city, pop) VALUES (1, 'oslo', 700), (2, 'turku', 200), (3, 'kyoto', 1400);
+INSERT INTO south (id, city, pop) VALUES (1, 'lima', 900), (2, 'turku', 200), (3, 'kyoto', 1400), (4, 'perth', 2000);
+SELECT city FROM north UNION SELECT city FROM south ORDER BY city;
+SELECT city FROM north UNION ALL SELECT city FROM south ORDER BY city;
+SELECT city, pop FROM north INTERSECT SELECT city, pop FROM south ORDER BY city;
+SELECT city FROM north EXCEPT SELECT city FROM south;
+SELECT city FROM south EXCEPT SELECT city FROM north ORDER BY city;
+SELECT city FROM south EXCEPT ALL SELECT city FROM north ORDER BY city;
+-- precedence: INTERSECT binds tighter than UNION
+SELECT city FROM north INTERSECT SELECT city FROM south UNION SELECT 'extra' ORDER BY city;
+-- trailing LIMIT/OFFSET applies to the whole result
+SELECT city FROM north UNION SELECT city FROM south ORDER BY city DESC LIMIT 3 OFFSET 1;
+-- set ops over aggregates and expressions
+SELECT count(*) FROM north UNION SELECT count(*) FROM south ORDER BY count;
+-- parenthesized right operand keeps its own LIMIT
+SELECT city FROM north UNION ALL (SELECT city FROM south ORDER BY city LIMIT 1) ORDER BY city;
+-- trailing clause binds to the WHOLE result even through an INTERSECT chain
+SELECT city FROM north UNION SELECT city FROM south INTERSECT SELECT city FROM south ORDER BY city LIMIT 2;
+EXPLAIN SELECT city FROM north UNION SELECT city FROM south;
+DROP TABLE north;
+DROP TABLE south;

@@ -1,0 +1,127 @@
+"""Subtransactions: SAVEPOINT / ROLLBACK TO SAVEPOINT / RELEASE
+(reference: SetActiveSubTransaction + RollbackToSubTransaction through
+pggate, src/yb/tserver/pg_client.proto; aborted-subtxn intent filtering
+in docdb).  SQL-level behavior is covered by regress/yb_savepoints.sql;
+these tests drive the engine edges: CDC correctness after a partial
+rollback, durable pruning across a crash, and multi-tablet pruning."""
+import asyncio
+
+from yugabyte_db_tpu.cdc import VirtualWal
+from yugabyte_db_tpu.docdb import RowOp
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+from tests.test_load_balancer import kv_info
+from tests.test_cdc_virtual_wal import drain, check_stream_shape, rows_of
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestSavepoints:
+    def test_cdc_emits_only_surviving_subtxn_rows(self, tmp_path):
+        """A committed txn whose savepoint was rolled back emits ONLY
+        the surviving rows to CDC — the discarded subtransaction's
+        writes never reach the stream (VERDICT r4 item 4)."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                c = mc.client()
+                await c.create_table(kv_info(), num_tablets=2)
+                await mc.wait_for_leaders("kv")
+                vw = await VirtualWal.create(c, ["kv"])
+                txn = await c.transaction().begin()
+                await txn.insert("kv", [{"k": 1, "v": 1.0}])
+                txn.savepoint("sp")
+                await txn.insert("kv", [{"k": 2, "v": 2.0},
+                                        {"k": 3, "v": 3.0}])
+                await txn.rollback_to("sp")
+                await txn.insert("kv", [{"k": 4, "v": 4.0}])
+                await txn.commit()
+                recs = await drain(vw, want_commits=1)
+                check_stream_shape(recs)
+                ks = sorted(k for _, k in rows_of(recs))
+                assert ks == [1, 4], f"CDC leaked rolled-back rows: {ks}"
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_prune_survives_crash_recovery(self, tmp_path):
+        """The sub-rollback prune is Raft-replicated and re-writes the
+        durable intent records: after a SIGKILL-style restart mid-txn,
+        replay + IntentsDB recovery must not resurrect discarded
+        intents when the commit finally applies."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                c = mc.client()
+                await c.create_table(kv_info(), num_tablets=1)
+                await mc.wait_for_leaders("kv")
+                txn = await c.transaction().begin()
+                await txn.insert("kv", [{"k": 10, "v": 1.0}])
+                txn.savepoint("sp")
+                await txn.insert("kv", [{"k": 11, "v": 2.0}])
+                # overwrite a pre-savepoint key inside the subtxn: the
+                # prune must restore the sub-0 intent, not drop the key
+                await txn.write("kv", [RowOp("upsert",
+                                             {"k": 10, "v": 9.0})])
+                await txn.rollback_to("sp")
+                # hard restart BEFORE commit: participant state must
+                # rebuild from WAL replay + IntentsDB records
+                await mc.restart_tserver(0)
+                await mc.wait_for_leaders("kv")
+                await txn.commit()
+                rows = {r["k"]: r["v"]
+                        for r in (await c.scan_all("kv")).rows} \
+                    if hasattr(c, "scan_all") else None
+                if rows is None:
+                    from yugabyte_db_tpu.docdb import ReadRequest
+                    rows = {r["k"]: r["v"] for r in
+                            (await c.scan("kv", ReadRequest(""))).rows}
+                assert rows == {10: 1.0}, rows
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_multi_tablet_subtxn_rollback(self, tmp_path):
+        """Savepoint writes spanning tablets prune on EVERY
+        participant."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                c = mc.client()
+                await c.create_table(kv_info(), num_tablets=4)
+                await mc.wait_for_leaders("kv")
+                txn = await c.transaction().begin()
+                await txn.insert("kv", [{"k": i, "v": 0.0}
+                                        for i in range(4)])
+                txn.savepoint("sp")
+                await txn.insert("kv", [{"k": 100 + i, "v": 1.0}
+                                        for i in range(16)])
+                await txn.rollback_to("sp")
+                await txn.commit()
+                from yugabyte_db_tpu.docdb import ReadRequest
+                ks = sorted(r["k"] for r in
+                            (await c.scan("kv", ReadRequest(""))).rows)
+                assert ks == [0, 1, 2, 3], ks
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_release_then_commit_keeps_writes(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                c = mc.client()
+                await c.create_table(kv_info(), num_tablets=2)
+                await mc.wait_for_leaders("kv")
+                txn = await c.transaction().begin()
+                txn.savepoint("sp")
+                await txn.insert("kv", [{"k": 1, "v": 5.0}])
+                txn.release_savepoint("sp")
+                await txn.commit()
+                from yugabyte_db_tpu.docdb import ReadRequest
+                rows = (await c.scan("kv", ReadRequest(""))).rows
+                assert [r["k"] for r in rows] == [1]
+            finally:
+                await mc.shutdown()
+        run(go())

@@ -88,6 +88,18 @@ class CdcStream:
                                     "txn_id": ch["txn_id"]})
                 elif ch["op"] == "abort":
                     self._pending_txns.pop(ch["txn_id"], None)
+                elif ch["op"] == "abort_sub":
+                    # ROLLBACK TO SAVEPOINT: discard this tablet's
+                    # buffered provisional records of the rolled-back
+                    # subtransactions (per-tablet log order makes the
+                    # sub >= from_sub filter exact)
+                    chs = self._pending_txns.get(ch["txn_id"])
+                    if chs:
+                        self._pending_txns[ch["txn_id"]] = [
+                            p for p in chs
+                            if not (p.get("tablet_id") == loc.tablet_id
+                                    and p.get("sub", 0)
+                                    >= ch["from_sub"])]
                 else:
                     out.append(ch)
             # hold the checkpoint back to before the OLDEST still-pending

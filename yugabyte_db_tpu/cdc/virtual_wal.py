@@ -240,12 +240,27 @@ class VirtualWal:
                 if dec is not None and dec[0] is None:
                     continue            # already known aborted
                 t = self._txns.setdefault(ch["txn_id"], _TxnBuf())
-                t.ops.append({"op": op, "row": ch["row"], "table": table})
+                t.ops.append({"op": op, "row": ch["row"], "table": table,
+                              "tid": tid, "sub": ch.get("sub", 0)})
                 t.pending_tids.add(tid)
                 t.min_idx[tid] = min(t.min_idx.get(tid, ch["index"]),
                                      ch["index"])
                 if dec is not None:
                     t.commit_ht = dec[0]
+            elif op == "abort_sub":
+                # ROLLBACK TO SAVEPOINT: drop this txn's buffered ops
+                # from THIS tablet with sub >= from_sub.  Per-tablet
+                # scope is what makes this exact: the tablet's log
+                # orders its discarded intents before the marker and
+                # any post-rollback (fresh-subtxn) intents after it
+                # (reference: aborted-SubtxnSet filtering in
+                # cdc/cdcsdk_producer.cc)
+                t = self._txns.get(ch["txn_id"])
+                if t is not None:
+                    t.ops = [o for o in t.ops
+                             if not (o.get("tid") == tid
+                                     and o.get("sub", 0)
+                                     >= ch["from_sub"])]
             elif op == "commit":
                 self._decisions.setdefault(
                     ch["txn_id"], [ch["ht"], tid, ch["index"]])

@@ -48,6 +48,16 @@ class YBTransaction:
         # wait queue instead of aborting (reference: READ COMMITTED
         # per-statement read times + FOR UPDATE row locks)
         self._lock_hts: Dict[Tuple[str, tuple], int] = {}
+        # subtransactions (SAVEPOINT): every write RPC carries the
+        # current sub id; ROLLBACK TO prunes intents with sub >= the
+        # savepoint's threshold on every participant and restores the
+        # client-side overlays from the snapshot taken at SAVEPOINT
+        # (reference: SetActiveSubTransaction/RollbackToSubTransaction
+        # in src/yb/tserver/pg_client.proto, SubtxnSet filtering)
+        self._sub_id = 0
+        self._next_sub = 1
+        # name -> (threshold sub id, writes snapshot, lock_hts snapshot)
+        self._savepoints: List[Tuple[str, int, dict, dict]] = []
 
     # ------------------------------------------------------------------
     async def _status_tablet(self) -> TabletLocation:
@@ -130,6 +140,8 @@ class YBTransaction:
                     for op in tops]
                 if any(hts):
                     payload["op_read_hts"] = hts
+            if self._sub_id:
+                payload["sub_id"] = self._sub_id
             r = await self.client._call_leader(ct, tablet_id, "txn_write",
                                                payload)
             return r["rows_affected"]
@@ -249,6 +261,77 @@ class YBTransaction:
                 await self.abort()
             raise
         return sum(results)
+
+    # --- subtransactions (SAVEPOINT) ----------------------------------
+    def savepoint(self, name: str) -> None:
+        """SAVEPOINT name: subsequent writes belong to a new
+        subtransaction; a later ROLLBACK TO discards exactly them."""
+        assert self.state == PENDING
+        import copy
+        self._savepoints.append(
+            (name, self._next_sub,
+             copy.deepcopy(self._writes), dict(self._lock_hts)))
+        self._sub_id = self._next_sub
+        self._next_sub += 1
+
+    async def rollback_to(self, name: str) -> None:
+        """ROLLBACK TO SAVEPOINT: discard every write made since the
+        savepoint (server-side intent prune on all participants +
+        client-side overlay restore); the savepoint stays valid.  Row
+        locks acquired since are retained, as in PG."""
+        assert self.state == PENDING
+        import copy
+        idx = max((i for i, sp in enumerate(self._savepoints)
+                   if sp[0] == name), default=None)
+        if idx is None:
+            raise RpcError(f"savepoint {name!r} does not exist",
+                           "NOT_FOUND")
+        _, threshold, wsnap, lsnap = self._savepoints[idx]
+        # prune EVERY participant first; client state only mutates
+        # after all acks.  A participant that cannot be pruned leaves
+        # server and client state divergent — the only safe outcome is
+        # aborting the whole transaction (a later commit would persist
+        # a half-rolled-back subtransaction).
+        try:
+            for tablet_id, addrs in list(self._participants.items()):
+                last = None
+                for addr in addrs:
+                    try:
+                        await self.client.messenger.call(
+                            tuple(addr), "tserver", "txn_rollback_sub",
+                            {"tablet_id": tablet_id,
+                             "txn_id": self.txn_id,
+                             "from_sub": threshold}, timeout=5.0)
+                        last = None
+                        break
+                    except (RpcError, OSError,
+                            asyncio.TimeoutError) as e:
+                        last = e
+                if last is not None:
+                    raise RpcError(
+                        f"could not roll back subtxn on {tablet_id}: "
+                        f"{last}", "TIMED_OUT")
+        except RpcError:
+            await self.abort()
+            raise
+        # drop savepoints declared after this one; keep this one
+        del self._savepoints[idx + 1:]
+        self._writes = copy.deepcopy(wsnap)
+        self._lock_hts.update(lsnap)   # locks persist; hts restore adds
+        # fresh subtransaction for what follows (PG semantics)
+        self._sub_id = self._next_sub
+        self._next_sub += 1
+
+    def release_savepoint(self, name: str) -> None:
+        """RELEASE SAVEPOINT: merge the subtransaction into its parent
+        (no server action — surviving intents simply keep their ids)."""
+        assert self.state == PENDING
+        idx = max((i for i, sp in enumerate(self._savepoints)
+                   if sp[0] == name), default=None)
+        if idx is None:
+            raise RpcError(f"savepoint {name!r} does not exist",
+                           "NOT_FOUND")
+        del self._savepoints[idx:]
 
     # ------------------------------------------------------------------
     async def commit(self) -> int:

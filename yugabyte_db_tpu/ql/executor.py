@@ -29,7 +29,7 @@ from .parser import (
     AlterTableStmt, AnalyzeStmt, CreateIndexStmt, CreateSequenceStmt,
     CreateTableStmt, CreateTablespaceStmt, CreateViewStmt, DeleteStmt,
     DropSequenceStmt, DropTableStmt, DropTablespaceStmt, DropViewStmt,
-    ExplainStmt, InsertStmt, SelectStmt, TxnStmt, UpdateStmt,
+    ExplainStmt, InsertStmt, SelectStmt, SetOpStmt, TxnStmt, UpdateStmt,
     parse_statement,
 )
 
@@ -201,6 +201,8 @@ class SqlSession:
             return await self._explain(stmt.inner)
         if isinstance(stmt, AnalyzeStmt):
             return await self._analyze(stmt)
+        if isinstance(stmt, SetOpStmt):
+            return await self._set_op(stmt)
         if isinstance(stmt, SelectStmt):
             if stmt.knn is not None:
                 return await self._knn_select(stmt)
@@ -288,6 +290,20 @@ class SqlSession:
         the PG planner + yb_lsm cost hooks; ours mirrors _select's
         branch order exactly so the reported plan is the executed one)."""
         lines: List[str] = []
+        if isinstance(stmt, SetOpStmt):
+            label = {"union": "Append" if stmt.all else "HashSetOp Union",
+                     "intersect": "HashSetOp Intersect",
+                     "except": "HashSetOp Except"}[stmt.op]
+            lines.append(label + (" All" if stmt.all and
+                                  stmt.op != "union" else ""))
+            for side in (stmt.left, stmt.right):
+                sub = await self._explain(side)
+                lines.extend("  -> " + r["QUERY PLAN"] if i == 0
+                             else "     " + r["QUERY PLAN"]
+                             for i, r in enumerate(sub.rows))
+            if stmt.order_by:
+                lines.append(f"Sort: {', '.join(c for c, _ in stmt.order_by)}")
+            return SqlResult([{"QUERY PLAN": ln} for ln in lines])
         if isinstance(stmt, SelectStmt) and (
                 getattr(stmt, "ctes", None)
                 or stmt.table in self._cte_rows):
@@ -459,6 +475,15 @@ class SqlSession:
             return SqlResult([], "BEGIN")
         if self._txn is None:
             raise ValueError("no transaction in progress")
+        if stmt.kind == "savepoint":
+            self._txn.savepoint(stmt.name)
+            return SqlResult([], "SAVEPOINT")
+        if stmt.kind == "rollback_to":
+            await self._txn.rollback_to(stmt.name)
+            return SqlResult([], "ROLLBACK")
+        if stmt.kind == "release":
+            self._txn.release_savepoint(stmt.name)
+            return SqlResult([], "RELEASE")
         txn, self._txn = self._txn, None
         if stmt.kind == "commit":
             await txn.commit()
@@ -724,6 +749,95 @@ class SqlSession:
             out.append(await self._resolve_subqueries(c, seq_ok)
                        if isinstance(c, tuple) else c)
         return tuple(out)
+
+    async def _set_op(self, stmt: SetOpStmt) -> SqlResult:
+        """UNION/INTERSECT/EXCEPT combine (reference: PG set ops via
+        Append/SetOp plan nodes, optimizer/prep/prepunion.c).  Operands
+        run through the normal select path; rows combine POSITIONALLY
+        with the left operand's column names (PG semantics); a hoisted
+        trailing ORDER BY/LIMIT applies to the whole result."""
+        if stmt.ctes:
+            import dataclasses
+            saved = dict(self._cte_rows)
+            try:
+                for name, sub in stmt.ctes.items():
+                    self._cte_rows[name] = (await self._select(sub)).rows
+                return await self._set_op(
+                    dataclasses.replace(stmt, ctes={}))
+            finally:
+                self._cte_rows = saved
+        left = await self._dispatch_inner(stmt.left)
+        right = await self._dispatch_inner(stmt.right)
+        names = (list(left.rows[0].keys()) if left.rows
+                 else list(right.rows[0].keys()) if right.rows else [])
+        if left.rows and right.rows and \
+                len(left.rows[0]) != len(right.rows[0]):
+            raise ValueError(
+                f"each {stmt.op.upper()} query must have the same "
+                f"number of columns ({len(left.rows[0])} vs "
+                f"{len(right.rows[0])})")
+
+        def freeze(v):
+            return tuple(freeze(x) for x in v) if isinstance(v, list) \
+                else v
+
+        lt = [tuple(freeze(v) for v in r.values()) for r in left.rows]
+        rt = [tuple(freeze(v) for v in r.values()) for r in right.rows]
+        if stmt.op == "union":
+            if stmt.all:
+                out = lt + rt
+            else:
+                seen, out = set(), []
+                for t in lt + rt:
+                    if t not in seen:
+                        seen.add(t)
+                        out.append(t)
+        elif stmt.op == "intersect":
+            if stmt.all:
+                # multiset intersection: keep min(count_l, count_r)
+                from collections import Counter
+                rc = Counter(rt)
+                out = []
+                for t in lt:
+                    if rc.get(t, 0) > 0:
+                        rc[t] -= 1
+                        out.append(t)
+            else:
+                rs, seen, out = set(rt), set(), []
+                for t in lt:
+                    if t in rs and t not in seen:
+                        seen.add(t)
+                        out.append(t)
+        else:   # except
+            if stmt.all:
+                from collections import Counter
+                rc = Counter(rt)
+                out = []
+                for t in lt:
+                    if rc.get(t, 0) > 0:
+                        rc[t] -= 1
+                    else:
+                        out.append(t)
+            else:
+                rs, seen, out = set(rt), set(), []
+                for t in lt:
+                    if t not in rs and t not in seen:
+                        seen.add(t)
+                        out.append(t)
+        rows = [dict(zip(names, t)) for t in out]
+        if stmt.order_by:
+            for col, desc in reversed(stmt.order_by):
+                if rows and col not in rows[0]:
+                    raise ValueError(
+                        f"ORDER BY column {col!r} is not in the "
+                        f"set-op output")
+                rows.sort(key=lambda r: (r[col] is None, r[col]),
+                          reverse=desc)
+        if stmt.offset:
+            rows = rows[stmt.offset:]
+        if stmt.limit is not None:
+            rows = rows[: stmt.limit]
+        return SqlResult(rows)
 
     async def _select(self, stmt: SelectStmt) -> SqlResult:
         if stmt.table is not None and not getattr(stmt, "joins", None):

@@ -36,6 +36,8 @@ KEYWORDS = {
     "right", "full", "over", "partition", "interval", "timestamp",
     "date", "cast", "case", "when", "then", "else", "end", "true",
     "false", "array", "any", "all", "extract",
+    "union", "intersect", "except", "savepoint", "release", "to",
+    "unique", "references", "foreign", "constraint", "for",
 }
 
 # window functions (besides the aggregate ops)
@@ -183,8 +185,13 @@ class AnalyzeStmt:
 
 @dataclass
 class TxnStmt:
-    kind: str   # 'begin' | 'commit' | 'rollback'
+    # 'begin' | 'commit' | 'rollback' | 'savepoint' | 'rollback_to'
+    # | 'release'  (reference: subtransactions through pggate —
+    # SetActiveSubTransaction / RollbackToSubTransaction in
+    # src/yb/tserver/pg_client.proto)
+    kind: str
     isolation: str = "snapshot"
+    name: Optional[str] = None     # savepoint name
 
 
 @dataclass
@@ -220,6 +227,25 @@ class SelectStmt:
     # FROM generate_series(lo, hi[, step]): (lo, hi, step) — the rows
     # materialize client-side (PG set-returning function)
     series: Optional[Tuple[int, int, int]] = None
+
+
+@dataclass
+class SetOpStmt:
+    """UNION [ALL] / INTERSECT [ALL] / EXCEPT [ALL] tree (reference:
+    PG set operations through the YSQL executor; the reference's
+    planner builds Append/SetOp nodes —
+    src/postgres/src/backend/optimizer/prep/prepunion.c).  PG
+    precedence: INTERSECT binds tighter; UNION/EXCEPT associate left.
+    A trailing ORDER BY/LIMIT/OFFSET applies to the WHOLE result and
+    is hoisted here off the right-most non-parenthesized operand."""
+    op: str                     # 'union' | 'intersect' | 'except'
+    all: bool                   # ALL keeps duplicates
+    left: object                # SelectStmt | SetOpStmt
+    right: object               # SelectStmt | SetOpStmt
+    order_by: List[Tuple[str, bool]] = field(default_factory=list)
+    limit: Optional[int] = None
+    offset: int = 0
+    ctes: Dict[str, "SelectStmt"] = field(default_factory=dict)
 
 
 @dataclass
@@ -300,11 +326,12 @@ class Parser:
 
         fn = {
             "create": self.create_table, "drop": self.drop_table,
-            "insert": self.insert, "select": self.select,
+            "insert": self.insert, "select": self.select_expr,
             "delete": self.delete, "update": self.update,
             "begin": self.txn_stmt, "commit": self.txn_stmt,
             "rollback": self.txn_stmt, "alter": self.alter_table,
             "analyze": self.analyze, "with": self.with_select,
+            "savepoint": self.txn_stmt, "release": self.txn_stmt,
         }.get(word)
         if fn is None:
             raise ValueError(f"unsupported statement {word!r}")
@@ -337,9 +364,93 @@ class Parser:
             ctes[name] = sub
             if not self.accept_op(","):
                 break
-        stmt = self.select()
+        stmt = self.select_expr()
         stmt.ctes = ctes
         return stmt
+
+    # -- set operations (UNION / INTERSECT / EXCEPT) -----------------------
+    def select_expr(self):
+        """PG precedence: INTERSECT > UNION = EXCEPT, left-assoc.  A
+        trailing ORDER BY/LIMIT/OFFSET absorbed by the right-most plain
+        operand is hoisted to apply to the whole set-op result (PG's
+        grammar attaches it to the top level); a parenthesized operand
+        keeps its own clauses."""
+        left, _ = self._intersect_expr()
+        while True:
+            t = self.peek()
+            if not (t and t[0] == "kw" and t[1].lower() in
+                    ("union", "except")):
+                break
+            op = self.next()[1].lower()
+            all_ = self.accept_kw("all")
+            if not all_:
+                self.accept_kw("distinct")
+            right, right_paren = self._intersect_expr()
+            left = self._hoist(SetOpStmt(op, all_, left, right),
+                               right_paren)
+        if isinstance(left, SetOpStmt):
+            # trailing clauses the right-most operand did NOT absorb
+            # (FROM-less or parenthesized last operand): they belong to
+            # the whole set-op result
+            if self.accept_kw("order"):
+                self.expect_kw("by")
+                while True:
+                    col = self.ident()
+                    desc = bool(self.accept_kw("desc"))
+                    if not desc:
+                        self.accept_kw("asc")
+                    left.order_by.append((col, desc))
+                    if not self.accept_op(","):
+                        break
+            if self.accept_kw("limit"):
+                left.limit = int(self.next()[1])
+            if self.accept_kw("offset"):
+                left.offset = int(self.next()[1])
+        return left
+
+    def _intersect_expr(self):
+        left, left_paren = self._select_primary()
+        while True:
+            t = self.peek()
+            if not (t and t[0] == "kw" and t[1].lower() == "intersect"):
+                break
+            self.next()
+            all_ = self.accept_kw("all")
+            if not all_:
+                self.accept_kw("distinct")
+            right, right_paren = self._select_primary()
+            left = self._hoist(SetOpStmt("intersect", all_, left, right),
+                               right_paren)
+            # propagate the RIGHT-MOST leaf's paren-ness: a trailing
+            # clause it absorbed must keep hoisting to the outer
+            # UNION/EXCEPT level (a UNION b INTERSECT c ORDER BY x
+            # orders the WHOLE result)
+            left_paren = right_paren
+        return left, left_paren
+
+    def _select_primary(self):
+        """One operand: plain SELECT or a parenthesized select_expr.
+        Returns (stmt, was_parenthesized)."""
+        if self.accept_op("("):
+            inner = self.select_expr()
+            self.expect_op(")")
+            return inner, True
+        return self.select(), False
+
+    @staticmethod
+    def _hoist(node: "SetOpStmt", right_paren: bool) -> "SetOpStmt":
+        """Move a trailing ORDER BY/LIMIT/OFFSET that the right-most
+        plain operand absorbed up to the set-op level.  The right
+        operand may itself be a set-op chain (a UNION b INTERSECT c
+        ORDER BY x): its own _hoist already lifted the clauses to ITS
+        top, so one more lift reaches the new top."""
+        r = node.right
+        if not right_paren and isinstance(r, (SelectStmt, SetOpStmt)) \
+                and (r.order_by or r.limit is not None or r.offset):
+            node.order_by, r.order_by = r.order_by, []
+            node.limit, r.limit = r.limit, None
+            node.offset, r.offset = r.offset, 0
+        return node
 
     def analyze(self):
         self.expect_kw("analyze")
@@ -609,6 +720,14 @@ class Parser:
 
     def txn_stmt(self):
         t = self.next()[1].lower()
+        if t == "savepoint":
+            return TxnStmt("savepoint", name=self.ident())
+        if t == "release":
+            self.accept_kw("savepoint")
+            return TxnStmt("release", name=self.ident())
+        if t == "rollback" and self.accept_kw("to"):
+            self.accept_kw("savepoint")
+            return TxnStmt("rollback_to", name=self.ident())
         self.accept_kw("transaction")
         iso = "snapshot"
         # BEGIN [TRANSACTION] [ISOLATION LEVEL] (SERIALIZABLE|SNAPSHOT)

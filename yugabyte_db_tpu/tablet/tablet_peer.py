@@ -280,6 +280,8 @@ class TabletPeer:
                 skip_regular=covered)
         elif entry.etype == "txn_rollback":
             self.participant.apply_rollback_entry(entry.payload)
+        elif entry.etype == "txn_sub_rollback":
+            self.participant.apply_sub_rollback_entry(entry.payload)
         elif entry.etype == "txn_status" and self.coordinator is not None:
             self.coordinator.apply_entry(entry.payload)
         elif entry.etype == "split":
@@ -354,7 +356,7 @@ class TabletPeer:
     # --- transactional write path ------------------------------------------
     async def write_txn(self, req: WriteRequest, txn_id: str,
                         start_ht: int, status_tablet=None,
-                        op_read_hts=None) -> int:
+                        op_read_hts=None, sub_id: int = 0) -> int:
         if self.split_done or self.split_requested:
             raise RpcError("tablet has been split", "TABLET_SPLIT")
         if not self.consensus.is_leader():
@@ -362,7 +364,20 @@ class TabletPeer:
                 f"not leader (hint={self.consensus.leader_hint()})",
                 "LEADER_NOT_READY")
         return await self.participant.write_intents(
-            req, txn_id, start_ht, status_tablet, op_read_hts)
+            req, txn_id, start_ht, status_tablet, op_read_hts, sub_id)
+
+    async def rollback_sub_txn(self, txn_id: str, from_sub: int):
+        """ROLLBACK TO SAVEPOINT on this participant (leader only):
+        Raft-replicates the prune so it survives failover."""
+        if not self.consensus.is_leader():
+            raise RpcError(
+                f"not leader (hint={self.consensus.leader_hint()})",
+                "LEADER_NOT_READY")
+        import msgpack as _mp
+        await self.consensus.replicate(
+            "txn_sub_rollback",
+            _mp.packb({"txn_id": txn_id, "from_sub": from_sub}),
+            precheck=self.split_fence_check)
 
     async def lock_for_update(self, keys, txn_id: str, start_ht: int,
                               status_tablet=None) -> int:

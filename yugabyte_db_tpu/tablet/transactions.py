@@ -336,7 +336,14 @@ class TransactionParticipant:
     def __init__(self, peer):
         self.peer = peer
         self.tablet = peer.tablet
-        # txn_id -> {doc_key -> RowOp wire}
+        # txn_id -> {doc_key -> [(sub_id, table_id, op wire), ...]}
+        # appended in write order; an EMPTY list is a claim placeholder
+        # (FOR UPDATE lock, or a conflict-check pass awaiting its
+        # replicated intent).  Subtransaction rollback prunes entries
+        # with sub_id >= the rolled-back savepoint (reference:
+        # aborted-subtxn filtering in intent apply,
+        # docdb/intent_aware_iterator.cc + SubtxnSet in
+        # common/transaction.h)
         self._intents: Dict[str, Dict[bytes, list]] = {}
         self._key_holder: Dict[bytes, str] = {}       # doc_key -> txn_id
         # SERIALIZABLE read locks (reference: kStrongRead intents in
@@ -377,7 +384,7 @@ class TransactionParticipant:
 
     async def write_intents(self, req: WriteRequest, txn_id: str,
                             start_ht: int, status_tablet=None,
-                            op_read_hts=None) -> int:
+                            op_read_hts=None, sub_id: int = 0) -> int:
         """Resolve conflicts then Raft-replicate the intent batch.
 
         The key claims happen SYNCHRONOUSLY (no await) the moment the
@@ -410,7 +417,7 @@ class TransactionParticipant:
                 per_txn = self._intents.get(txn_id, {})
                 self._release(txn_id,
                               [kk for kk in keys
-                               if per_txn.get(kk) is None])
+                               if not per_txn.get(kk)])
                 raise RpcError(
                     f"txn {txn_id} write conflict: key modified at "
                     f"{committed} after snapshot {eff_ht}", "ABORTED")
@@ -422,7 +429,7 @@ class TransactionParticipant:
             "txn_id": txn_id, "start_ht": start_ht,
             "req": write_request_to_wire(req),
             "keys": keys, "status_tablet": status_tablet,
-            "table_id": req.table_id,
+            "table_id": req.table_id, "sub": sub_id,
         })
         try:
             await self.peer.consensus.replicate(
@@ -432,7 +439,7 @@ class TransactionParticipant:
             # undo claims that never got an applied intent
             per_txn = self._intents.get(txn_id, {})
             self._release(txn_id,
-                          [k for k in keys if per_txn.get(k) is None])
+                          [k for k in keys if not per_txn.get(k)])
             raise
         return len(req.ops)
 
@@ -557,7 +564,7 @@ class TransactionParticipant:
             self._txn_meta.setdefault(txn_id, {"start_ht": start_ht})
             for k in keys:
                 self._key_holder[k] = txn_id
-                per_txn.setdefault(k, None)   # placeholder until apply
+                per_txn.setdefault(k, [])   # placeholder until apply
         await self._wait_for_unblock(txn_id, start_ht, blockers_of,
                                      on_clear, "conflict")
 
@@ -702,16 +709,24 @@ class TransactionParticipant:
         from ..storage.lsm import WriteBatch
         batch = WriteBatch()
         table_id = m.get("table_id", "")
+        sub = m.get("sub", 0)
         for key, op in zip(m["keys"], m["req"]["ops"]):
-            per_txn[key] = (table_id, op)
+            ents = per_txn.setdefault(key, [])
+            if not isinstance(ents, list):     # legacy single-op value
+                ents = [(0, ents[0], ents[1])]
+                per_txn[key] = ents
+            ents.append((sub, table_id, op))
             self._key_holder[key] = txn_id
             # the durable intent record is self-describing (doc key,
-            # txn, op, table, start_ht, status tablet) so a replica can
-            # rebuild participant state from the IntentsDB alone when
-            # the WAL below the flushed frontier is gone (reference:
-            # transaction_participant.cc intent loading at bootstrap)
+            # txn, the full per-subtxn op list, table, start_ht, status
+            # tablet) so a replica can rebuild participant state from
+            # the IntentsDB alone when the WAL below the flushed
+            # frontier is gone (reference: transaction_participant.cc
+            # intent loading at bootstrap); the whole list re-writes so
+            # a savepoint rollback can durably prune a suffix
             batch.put(intent_key(key, txn_id), msgpack.packb({
-                "x": txn_id, "k": key, "o": op, "t": table_id,
+                "x": txn_id, "k": key,
+                "e": [[s, t, o] for s, t, o in ents],
                 "s": m["start_ht"], "st": m.get("status_tablet")}))
         self.tablet.intents.apply(batch)
 
@@ -738,8 +753,11 @@ class TransactionParticipant:
                 self._txn_reads.setdefault(txn_id, set()).add(key)
             else:
                 per_txn = self._intents.setdefault(txn_id, {})
-                if per_txn.get(key) is None:
-                    per_txn[key] = (d.get("t", ""), d["o"])
+                if not per_txn.get(key):
+                    if "e" in d:
+                        per_txn[key] = [tuple(x) for x in d["e"]]
+                    else:          # legacy single-op record
+                        per_txn[key] = [(0, d.get("t", ""), d["o"])]
                     n += 1
                 self._key_holder.setdefault(key, txn_id)
             meta = self._txn_meta.setdefault(
@@ -764,10 +782,12 @@ class TransactionParticipant:
         per_txn = self._intents.pop(txn_id, None) or {}
         if not skip_regular:
             by_table = {}
-            for ent in per_txn.values():
-                if ent is None:
-                    continue
-                table_id, op = ent
+            for ents in per_txn.values():
+                if not ents:
+                    continue       # claim placeholder, nothing written
+                # the LAST surviving entry is the key's final state
+                # (savepoint rollbacks already pruned their suffixes)
+                _sub, table_id, op = ents[-1]
                 by_table.setdefault(table_id, []).append(
                     RowOp(op[0], op[1], op[2] if len(op) > 2 else None))
             for table_id, ops in by_table.items():
@@ -782,6 +802,52 @@ class TransactionParticipant:
         self._intent_log_index.pop(txn_id, None)
         per_txn = self._intents.pop(txn_id, None) or {}
         self._release(txn_id, per_txn.keys())
+
+    def apply_sub_rollback_entry(self, payload: bytes):
+        """Raft apply of ROLLBACK TO SAVEPOINT: prune every intent
+        entry with sub_id >= the rolled-back savepoint's id.  Keys left
+        with no surviving entries release their claims (and FOR UPDATE
+        locks taken inside the subtransaction release with them); keys
+        with older entries re-write their durable record so the prune
+        survives bootstrap (reference: RollbackToSubTransaction in
+        tserver/pg_client.proto + aborted-SubtxnSet intent filtering)."""
+        from ..dockv.value import PrimitiveValue
+        from ..storage.lsm import WriteBatch
+        m = msgpack.unpackb(payload, raw=False)
+        txn_id, from_sub = m["txn_id"], m["from_sub"]
+        per_txn = self._intents.get(txn_id)
+        if not per_txn:
+            return
+        batch = WriteBatch()
+        emptied = []
+        meta = self._txn_meta.get(txn_id) or {}
+        for key, ents in list(per_txn.items()):
+            if not ents:
+                continue           # bare claim: sub unknown, keep —
+                #                    only commit/abort releases it
+            kept = [e for e in ents if e[0] < from_sub]
+            if len(kept) == len(ents):
+                continue
+            if kept:
+                per_txn[key] = kept
+                batch.put(intent_key(key, txn_id), msgpack.packb({
+                    "x": txn_id, "k": key,
+                    "e": [[s, t, o] for s, t, o in kept],
+                    "s": meta.get("start_ht", 0),
+                    "st": meta.get("status_tablet")}))
+            else:
+                del per_txn[key]
+                emptied.append(key)
+                if self._key_holder.get(key) == txn_id:
+                    del self._key_holder[key]
+                batch.put(intent_key(key, txn_id),
+                          PrimitiveValue.tombstone().encode())
+        if batch.entries:
+            self.tablet.intents.apply(batch)
+        if emptied:
+            for w in self._waiters:
+                if txn_id in w.blockers:
+                    w.event.set()
 
     def _release(self, txn_id: str, keys):
         from ..storage.lsm import WriteBatch
@@ -831,8 +897,8 @@ class TransactionParticipant:
     def own_intent(self, txn_id: str, doc_key: bytes) -> Optional[list]:
         per_txn = self._intents.get(txn_id)
         if per_txn:
-            ent = per_txn.get(doc_key)
-            return ent[1] if ent is not None else None
+            ents = per_txn.get(doc_key)
+            return ents[-1][2] if ents else None
         return None
 
     def has_foreign_intents(self, txn_id: Optional[str] = None) -> bool:

@@ -696,8 +696,20 @@ class TabletServer:
                     "SCHEMA_MISMATCH")
         n = await peer.write_txn(req, payload["txn_id"], payload["start_ht"],
                                  payload.get("status_tablet"),
-                                 payload.get("op_read_hts"))
+                                 payload.get("op_read_hts"),
+                                 payload.get("sub_id", 0))
         return {"rows_affected": n}
+
+    async def rpc_txn_rollback_sub(self, payload) -> dict:
+        """ROLLBACK TO SAVEPOINT: prune this participant's intents with
+        sub_id >= from_sub (reference: RollbackToSubTransaction,
+        tserver/pg_client.proto).  Routed through splits like
+        apply/rollback — a split parent's in-flight intents were copied
+        to its children, so the prune must reach every child or the
+        rolled-back writes would commit there."""
+        await self._drive_txn_decision(payload["tablet_id"],
+                                       "txn_rollback_sub", payload)
+        return {"ok": True}
 
     async def _drive_txn_decision(self, tablet_id: str, method: str,
                                   payload: dict) -> None:
@@ -719,6 +731,9 @@ class TabletServer:
                 if method == "apply_txn":
                     await peer.apply_txn(payload["txn_id"],
                                          payload["commit_ht"])
+                elif method == "txn_rollback_sub":
+                    await peer.rollback_sub_txn(payload["txn_id"],
+                                                payload["from_sub"])
                 else:
                     await peer.rollback_txn(payload["txn_id"])
                 return
@@ -949,7 +964,18 @@ class TabletServer:
                 for op in d["req"]["ops"]:
                     changes.append({"op": op[0], "row": op[1],
                                     "txn_id": d["txn_id"],
+                                    "sub": d.get("sub", 0),
                                     "provisional": True, "index": e.index})
+            elif e.etype == "txn_sub_rollback":
+                # ROLLBACK TO SAVEPOINT: consumers discard this txn's
+                # buffered provisional records from THIS tablet with
+                # sub >= from_sub (log order guarantees the discarded
+                # intents came first and any later ones are a fresh
+                # subtransaction)
+                d = _mp.unpackb(e.payload, raw=False)
+                changes.append({"op": "abort_sub", "txn_id": d["txn_id"],
+                                "from_sub": d["from_sub"],
+                                "index": e.index})
             elif e.etype == "txn_apply":
                 d = _mp.unpackb(e.payload, raw=False)
                 changes.append({"op": "commit", "txn_id": d["txn_id"],
