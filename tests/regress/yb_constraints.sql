@@ -1,0 +1,28 @@
+-- UNIQUE + FOREIGN KEY constraints (reference: unique indexes via
+-- yb_access/yb_lsm.c:233-366 and FK checks through the PG executor)
+CREATE TABLE country (code text PRIMARY KEY, name text UNIQUE) WITH tablets = 1;
+CREATE TABLE city (id bigint PRIMARY KEY, name text, country_code text REFERENCES country (code)) WITH tablets = 1;
+INSERT INTO country (code, name) VALUES ('no', 'norway'), ('jp', 'japan');
+INSERT INTO country (code, name) VALUES ('xx', 'norway');
+INSERT INTO city (id, name, country_code) VALUES (1, 'oslo', 'no'), (2, 'kyoto', 'jp');
+INSERT INTO city (id, name, country_code) VALUES (3, 'atlantis', 'zz');
+INSERT INTO city (id, name, country_code) VALUES (4, 'unknown', NULL);
+UPDATE city SET country_code = 'zz' WHERE id = 1;
+UPDATE city SET country_code = 'jp' WHERE id = 1;
+SELECT id, name, country_code FROM city ORDER BY id;
+-- freeing a unique value by UPDATE, then reusing it
+UPDATE country SET name = 'nippon' WHERE code = 'jp';
+INSERT INTO country (code, name) VALUES ('xj', 'japan');
+SELECT code, name FROM country ORDER BY code;
+-- CREATE UNIQUE INDEX on a column with existing duplicates fails
+CREATE TABLE dup (k bigint PRIMARY KEY, v bigint) WITH tablets = 1;
+INSERT INTO dup (k, v) VALUES (1, 7), (2, 7);
+CREATE UNIQUE INDEX dup_v ON dup (v);
+-- multi-row statement with an internal duplicate is rejected whole
+CREATE TABLE mr (k bigint PRIMARY KEY, v text UNIQUE) WITH tablets = 1;
+INSERT INTO mr (k, v) VALUES (1, 'a'), (2, 'a');
+SELECT count(*) FROM mr;
+DROP TABLE city;
+DROP TABLE country;
+DROP TABLE dup;
+DROP TABLE mr;

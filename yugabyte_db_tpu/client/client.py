@@ -76,6 +76,60 @@ class CachedTable:
     codec: TableCodec
     locations: List[TabletLocation]
     indexes: Dict[str, dict] = None
+    # [{column, parent_table, parent_column}] — SQL-layer existence
+    # checks on child writes (reference: FK via the PG executor)
+    foreign_keys: List[dict] = None
+
+
+async def build_index_ops(ct, table: str, ops, getter):
+    """Index mutations for a batch of base-table ops — the ONE place
+    the per-index row shapes live (used by both the non-transactional
+    client path and YBTransaction).  `getter(table, pk_row)` reads the
+    base row's pre-image.  Returns [(index_name, idx_ops, undo_ops)]:
+    undo_ops exactly invert idx_ops (computed here because only this
+    function still holds the old row needed to restore a deleted
+    entry).
+
+    Shapes (reference: index tables in catalog_manager; unique layout
+    yb_access/yb_lsm.c:233-366): non-unique entries key on
+    (value, base pk); UNIQUE entries key on the value alone (base pk
+    in the row payload) and write as insert-if-absent so duplicates
+    collide on the shared doc key."""
+    pk_names = [c.name for c in ct.info.schema.key_columns]
+    out = []
+    for index_name, spec in ct.indexes.items():
+        col = spec["column"]
+        unique = spec.get("unique")
+        idx_ops: List[RowOp] = []
+        undo_ops: List[RowOp] = []
+        for op in ops:
+            pk_row = {n: op.row[n] for n in pk_names if n in op.row}
+            old = await getter(table, pk_row) if pk_row else None
+            full_old = old and {col: old[col],
+                                **{f"base_{n}": old[n]
+                                   for n in pk_names}}
+            if old is not None and old.get(col) is not None:
+                if op.kind == "delete" or old.get(col) != op.row.get(col):
+                    # unique index keys on the value alone: the delete
+                    # targets {col}; base_* live in the value
+                    idx_ops.append(RowOp("delete", {
+                        col: old[col]} if unique else dict(full_old)))
+                    undo_ops.append(RowOp("upsert", dict(full_old)))
+            if op.kind in ("upsert", "insert") \
+                    and op.row.get(col) is not None:
+                if old is not None and old.get(col) == op.row.get(col):
+                    continue   # entry already present for this row
+                new_row = {col: op.row[col],
+                           **{f"base_{n}": op.row[n] for n in pk_names}}
+                # unique: insert-if-absent so a duplicate value
+                # collides on the shared doc key and is rejected
+                idx_ops.append(RowOp("insert" if unique else "upsert",
+                                     new_row))
+                undo_ops.append(RowOp("delete", {
+                    col: op.row[col]} if unique else new_row))
+        if idx_ops:
+            out.append((index_name, idx_ops, undo_ops))
+    return out
 
 
 class YBClient:
@@ -143,7 +197,8 @@ class YBClient:
                            replication_factor: int = 1,
                            tablegroup: Optional[str] = None,
                            split_rows=None,
-                           tablespace: Optional[str] = None) -> str:
+                           tablespace: Optional[str] = None,
+                           foreign_keys=None) -> str:
         """split_rows: for range-sharded tables, PK rows whose encoded
         keys become the tablet split points."""
         split_points = None
@@ -159,7 +214,8 @@ class YBClient:
              "num_tablets": num_tablets,
              "replication_factor": replication_factor,
              "tablegroup": tablegroup, "split_points": split_points,
-             "tablespace_name": tablespace})
+             "tablespace_name": tablespace,
+             "foreign_keys": list(foreign_keys or [])})
         return resp["table_id"]
 
     async def create_tablegroup(self, name: str,
@@ -285,7 +341,8 @@ class YBClient:
                           for r in l["replicas"] if r["addr"]],
                 leader=l.get("leader")))
         cached = CachedTable(info, TableCodec(info), locs,
-                             resp.get("indexes") or {})
+                             resp.get("indexes") or {},
+                             resp.get("foreign_keys") or [])
         self._tables[name] = cached
         return cached
 
@@ -322,8 +379,9 @@ class YBClient:
         transactional index maintenance in pggate; round-1 maintenance
         is non-transactional)."""
         ct0 = await self._table(table)
+        index_undo = None
         if ct0.indexes:
-            await self._maintain_indexes(ct0, table, ops)
+            index_undo = await self._maintain_indexes(ct0, table, ops)
 
         async def go(ct):
             by_tablet: Dict[str, List[RowOp]] = {}
@@ -350,21 +408,30 @@ class YBClient:
         # stale schema. Bounded retries with backoff cover the window
         # where tablets already adopted the new schema but the master's
         # catalog commit (which refresh reads) hasn't landed yet.
-        for attempt in range(4):
-            try:
-                return await self._retry_on_split(table, go)
-            except RpcError as e:
-                if e.code != "SCHEMA_MISMATCH" or attempt == 3:
-                    raise
-                await asyncio.sleep(0.05 * (attempt + 1))
-                ct = await self._table(table, refresh=True)
-                live = {c.name for c in ct.info.schema.columns}
-                for op in ops:
-                    gone = set(op.row) - live
-                    if gone:
-                        raise RpcError(
-                            f"column(s) {sorted(gone)} dropped by a "
-                            f"concurrent ALTER on {table}", "NOT_FOUND")
+        try:
+            for attempt in range(4):
+                try:
+                    return await self._retry_on_split(table, go)
+                except RpcError as e:
+                    if e.code != "SCHEMA_MISMATCH" or attempt == 3:
+                        raise
+                    await asyncio.sleep(0.05 * (attempt + 1))
+                    ct = await self._table(table, refresh=True)
+                    live = {c.name for c in ct.info.schema.columns}
+                    for op in ops:
+                        gone = set(op.row) - live
+                        if gone:
+                            raise RpcError(
+                                f"column(s) {sorted(gone)} dropped by a "
+                                f"concurrent ALTER on {table}",
+                                "NOT_FOUND")
+        except Exception:
+            # base write failed after index maintenance: undo the index
+            # entries, or an orphan unique entry would deny the value
+            # to every future insert
+            if index_undo:
+                await self._undo_index_ops(index_undo)
+            raise
 
     async def insert(self, table: str, rows: Sequence[dict]) -> int:
         return await self.write(table, [RowOp("upsert", r) for r in rows])
@@ -373,24 +440,29 @@ class YBClient:
         return await self.write(table, [RowOp("delete", r) for r in pk_rows])
 
     async def _maintain_indexes(self, ct, table: str, ops):
-        pk_names = [c.name for c in ct.info.schema.key_columns]
-        for index_name, spec in ct.indexes.items():
-            col = spec["column"]
-            idx_ops: List[RowOp] = []
-            for op in ops:
-                pk_row = {n: op.row[n] for n in pk_names if n in op.row}
-                old = await self.get(table, pk_row) if pk_row else None
-                if old is not None and old.get(col) is not None:
-                    if op.kind == "delete" or old.get(col) != op.row.get(col):
-                        idx_ops.append(RowOp("delete", {
-                            col: old[col],
-                            **{f"base_{n}": old[n] for n in pk_names}}))
-                if op.kind == "upsert" and op.row.get(col) is not None:
-                    idx_ops.append(RowOp("upsert", {
-                        col: op.row[col],
-                        **{f"base_{n}": op.row[n] for n in pk_names}}))
-            if idx_ops:
-                await self.write(index_name, idx_ops)
+        """Non-transactional maintenance (reference: transactional
+        maintenance lives in YBTransaction): index writes go FIRST (a
+        unique violation must reject the statement before the base row
+        lands); if the base write later fails the caller undoes them
+        via the returned compensation ops — otherwise an orphan unique
+        entry would permanently deny the value.  A crash between the
+        two writes can still leak an entry; the transactional path has
+        no such window."""
+        undo: List[tuple] = []
+        for index_name, idx_ops, undo_ops in await build_index_ops(
+                ct, table, ops, self.get):
+            await self.write(index_name, idx_ops)
+            undo.append((index_name, undo_ops))
+        return undo
+
+    async def _undo_index_ops(self, undo) -> None:
+        for index_name, ops in reversed(undo):
+            if not ops:
+                continue
+            try:
+                await self.write(index_name, ops)
+            except Exception:   # noqa: BLE001 — best-effort compensation
+                pass
 
     async def index_lookup(self, table: str, index_name: str, value
                            ) -> List[dict]:
@@ -410,12 +482,18 @@ class YBClient:
                 for r in resp.rows]
 
     async def create_secondary_index(self, table: str, index_name: str,
-                                     column: str) -> int:
+                                     column: str,
+                                     unique: bool = False) -> int:
         """Create + backfill (reference: online backfill,
-        master/backfill_index.cc — ours quiesces via full scan)."""
+        master/backfill_index.cc — ours quiesces via full scan).  A
+        UNIQUE index keys the index table by the indexed value alone,
+        so duplicate inserts collide on one doc key and the write
+        path's insert-if-absent gate rejects them; the backfill itself
+        surfaces pre-existing duplicates as DUPLICATE_KEY."""
         await self._master_call(
             "create_secondary_index",
-            {"table": table, "index_name": index_name, "column": column},
+            {"table": table, "index_name": index_name, "column": column,
+             "unique": unique},
             timeout=60.0)
         self._tables.pop(table, None)
         ct = await self._table(table)
@@ -424,9 +502,11 @@ class YBClient:
             "", columns=tuple(pk_names + [column])))
         rows = [r for r in resp.rows if r.get(column) is not None]
         if rows:
-            await self.insert(index_name, [
-                {column: r[column],
-                 **{f"base_{n}": r[n] for n in pk_names}} for r in rows])
+            await self.write(index_name, [
+                RowOp("insert" if unique else "upsert",
+                      {column: r[column],
+                       **{f"base_{n}": r[n] for n in pk_names}})
+                for r in rows])
         return len(rows)
 
     # --- DML: reads -------------------------------------------------------

@@ -109,8 +109,40 @@ class YBTransaction:
         return self
 
     async def write(self, table: str, ops: Sequence[RowOp]) -> int:
+        """Transactional write with index maintenance: index mutations
+        ride the SAME transaction (intents on the index tablets commit
+        or abort atomically with the base write — reference:
+        transactional maintenance through pggate's buffered
+        operations).  The whole statement runs under an implicit
+        subtransaction (PG's per-statement subtxn): a mid-statement
+        failure — e.g. a unique violation AFTER another index's intent
+        was already written — rolls back only this statement's
+        intents, never leaving a ghost index entry in a txn that later
+        commits."""
         assert self.state == PENDING, f"txn is {self.state}"
         ct = await self.client._table(table)
+        if not ct.indexes:
+            return await self._write_rows(table, ops, ct)
+        from .client import build_index_ops
+        sp = f"__stmt_{self._next_sub}"
+        self.savepoint(sp)
+        try:
+            for index_name, idx_ops, _undo in await build_index_ops(
+                    ct, table, ops, self.get):
+                ict = await self.client._table(index_name)
+                await self._write_rows(index_name, idx_ops, ict)
+            n = await self._write_rows(table, ops, ct)
+        except RpcError as e:
+            if self.state == PENDING and e.code not in ("ABORTED",
+                                                        "DEADLOCK"):
+                await self.rollback_to(sp)
+                self.release_savepoint(sp)
+            raise
+        self.release_savepoint(sp)
+        return n
+
+    async def _write_rows(self, table: str, ops: Sequence[RowOp],
+                          ct) -> int:
         by_tablet: Dict[str, List[RowOp]] = {}
         for op in ops:
             loc = self.client._tablet_for_key(ct, op.row)

@@ -38,7 +38,12 @@ _HT_SUFFIX = ENCODED_SIZE + 1
 # --------------------------------------------------------------------------
 @dataclass
 class RowOp:
-    kind: str                      # 'upsert' | 'delete'
+    # 'upsert' | 'delete' | 'insert' — 'insert' is insert-if-absent:
+    # the write path rejects it with DUPLICATE_KEY when a live row
+    # already exists at the key (the conflict-causing op unique indexes
+    # are built from; reference: unique-index insertion through
+    # yb_lsm.c:233-366 where the index doc key IS the indexed value)
+    kind: str
     row: Dict[str, object]         # full row for upsert; PK columns for delete
     ttl_ms: Optional[int] = None   # row TTL (None = forever)
 
@@ -574,7 +579,9 @@ class DocWriteOperation:
         from ..dockv.value import wrap_ttl
         for op in self.request.ops:
             dht = DocHybridTime(ht, wid)
-            if op.kind == "upsert":
+            if op.kind in ("upsert", "insert"):
+                # 'insert' duplicates were rejected on the leader before
+                # replication; at apply it writes like an upsert
                 k, v = self.codec.encode_write(op.row, dht)
                 if op.ttl_ms:
                     expire = ht.add_micros(op.ttl_ms * 1000).value

@@ -410,6 +410,12 @@ class Master:
             tent = {"info": info_wire, "tablets": list(tablet_entries)}
             if tspace:
                 tent["tablespace"] = tspace
+            if payload.get("foreign_keys"):
+                # [{column, parent_table, parent_column}] — enforced by
+                # the SQL layer as an existence check in the writing
+                # txn (reference: FK enforcement through the PG
+                # executor over YB indexes)
+                tent["foreign_keys"] = payload["foreign_keys"]
             ops = [["put_table", table_id, tent]]
             ops += [["put_tablet", tid_, ent]
                     for tid_, ent in tablet_entries.items()]
@@ -644,7 +650,8 @@ class Master:
             if tid == table_id or e["info"]["name"] == name:
                 return {"table": e["info"],
                         "locations": self._locations(tid),
-                        "indexes": e.get("indexes", {})}
+                        "indexes": e.get("indexes", {}),
+                        "foreign_keys": e.get("foreign_keys", [])}
         raise RpcError(f"table {name or table_id} not found", "NOT_FOUND")
 
     def _locations(self, table_id: str) -> List[dict]:
@@ -1478,10 +1485,16 @@ class Master:
         base_info = TableInfo.from_wire(base["info"])
         col = base_info.schema.column_by_name(column)
         pk_cols = base_info.schema.key_columns
+        unique = bool(payload.get("unique"))
         cols = [ColumnSchema(0, column, col.type, is_hash_key=True)]
         for i, c in enumerate(pk_cols):
+            # UNIQUE: the index doc key is ONLY the indexed value, so
+            # two inserts of one value hit the same key and the write
+            # path's insert-if-absent / txn conflict machinery lets
+            # exactly one commit (reference: unique-index key layout in
+            # yb_access/yb_lsm.c:233-366 — base PK moves to the value)
             cols.append(ColumnSchema(i + 1, f"base_{c.name}", c.type,
-                                     is_range_key=True))
+                                     is_range_key=not unique))
         idx_info = TableInfo(
             "", index_name, TableSchema(tuple(cols), 1),
             PartitionSchema("hash", 1))
@@ -1493,7 +1506,7 @@ class Master:
         idxs = dict(tent.get("indexes", {}))
         idxs[index_name] = {
             "column": column, "index_table": index_name,
-            "base_pk": [c.name for c in pk_cols]}
+            "base_pk": [c.name for c in pk_cols], "unique": unique}
         tent["indexes"] = idxs
         await self._commit_catalog([["put_table", tid, tent]])
         return {"index_table_id": resp["table_id"]}

@@ -195,7 +195,8 @@ class SqlSession:
                     stmt.table, stmt.column, stmt.lists)
             else:
                 n = await self.client.create_secondary_index(
-                    stmt.table, stmt.name, stmt.column)
+                    stmt.table, stmt.name, stmt.column,
+                    unique=getattr(stmt, "unique", False))
             return SqlResult([], f"CREATE INDEX ({n} rows)")
         if isinstance(stmt, ExplainStmt):
             return await self._explain(stmt.inner)
@@ -528,10 +529,36 @@ class SqlSession:
             "", stmt.name, schema,
             PartitionSchema("range", 0) if range_sharded
             else PartitionSchema("hash", 1))
+        fks = [{"column": c, "parent_table": pt, "parent_column": pc}
+               for c, pt, pc in getattr(stmt, "foreign_keys", [])]
+        for fk in fks:
+            # the parent column must be its table's PK (our FK-lite
+            # scope: existence checks by point get) — validate at DDL
+            # time so a typo fails CREATE, not every later INSERT.
+            # Self-referential FKs (REFERENCES the table being created,
+            # e.g. emp.mgr -> emp.id) validate against the schema in
+            # hand: the table doesn't exist yet.
+            if fk["parent_table"] == stmt.name:
+                pk_names = pk
+            else:
+                pct = await self.client._table(fk["parent_table"])
+                pk_names = [c.name for c in pct.info.schema.key_columns]
+            if [fk["parent_column"]] != pk_names:
+                raise ValueError(
+                    f"REFERENCES {fk['parent_table']}"
+                    f"({fk['parent_column']}): referenced column must "
+                    f"be the single-column primary key {pk_names}")
         await self.client.create_table(
             info, num_tablets=stmt.num_tablets,
             replication_factor=stmt.replication_factor,
-            tablespace=getattr(stmt, "tablespace", None))
+            tablespace=getattr(stmt, "tablespace", None),
+            foreign_keys=fks)
+        # UNIQUE columns: enforced through unique secondary indexes
+        # (the index doc key is the value itself, so duplicates collide
+        # — reference: yb_access/yb_lsm.c:233-366)
+        for col in getattr(stmt, "unique_cols", []):
+            await self.client.create_secondary_index(
+                stmt.name, f"{stmt.name}_{col}_key", col, unique=True)
         return SqlResult([], "CREATE TABLE")
 
     def _invalidate_stats(self, table: str) -> None:
@@ -619,6 +646,7 @@ class SqlSession:
                         f"not-null constraint")
             self._coerce_decimals(dec_cols, row)
             rows.append(row)
+        await self._check_foreign_keys(ct, rows)
         if self._txn is not None:
             n = await self._txn.insert(stmt.table, rows)
         elif stmt.ttl_ms:
@@ -634,6 +662,30 @@ class SqlSession:
                                      ct.info.schema),
                 f"INSERT {n}")
         return SqlResult([], f"INSERT {n}")
+
+    async def _check_foreign_keys(self, ct, rows) -> None:
+        """FK-lite: REFERENCES enforced as an existence check inside
+        the writing transaction (reference: FK enforcement through the
+        PG executor over YB row locks — we check existence without the
+        parent KEY SHARE lock, so a concurrent parent delete can race;
+        parent-side RESTRICT is not enforced)."""
+        for fk in getattr(ct, "foreign_keys", None) or []:
+            col, parent = fk["column"], fk["parent_table"]
+            pcol = fk["parent_column"]
+            for row in rows:
+                v = row.get(col)
+                if v is None:
+                    continue           # NULL FK is always valid (PG)
+                if self._txn is not None:
+                    found = await self._txn.get(parent, {pcol: v})
+                else:
+                    found = await self.client.get(parent, {pcol: v})
+                if found is None:
+                    raise ValueError(
+                        f'insert or update on table "{ct.info.name}" '
+                        f'violates foreign key constraint: key '
+                        f'({col})=({v}) is not present in table '
+                        f'"{parent}"')
 
     # ------------------------------------------------------------------
     def _bind(self, node, schema: TableSchema):
@@ -2113,6 +2165,9 @@ class SqlSession:
                     raise ValueError(
                         f"null value in column {name!r} violates "
                         f"not-null constraint")
+        if any(fk["column"] in stmt.sets
+               for fk in getattr(ct, "foreign_keys", None) or []):
+            await self._check_foreign_keys(ct, updated)
         if self._txn is not None:
             n = await self._txn.insert(stmt.table, updated)
         else:

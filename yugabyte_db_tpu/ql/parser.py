@@ -91,6 +91,11 @@ class CreateTableStmt:
     defaults: Dict[str, object] = field(default_factory=dict)
     not_null: List[str] = field(default_factory=list)
     tablespace: Optional[str] = None   # WITH tablespace = 'name'
+    unique_cols: List[str] = field(default_factory=list)
+    # [(column, parent_table, parent_column)] from REFERENCES /
+    # FOREIGN KEY clauses
+    foreign_keys: List[Tuple[str, str, str]] = field(
+        default_factory=list)
 
 
 @dataclass
@@ -100,6 +105,7 @@ class CreateIndexStmt:
     column: str
     method: str = "lsm"     # 'lsm' secondary index | 'ivfflat' vector ANN
     lists: int = 100
+    unique: bool = False    # CREATE UNIQUE INDEX
 
 
 @dataclass
@@ -458,6 +464,9 @@ class Parser:
 
     def create_table(self):
         self.expect_kw("create")
+        if self.accept_kw("unique"):
+            self.expect_kw("index")
+            return self._create_index(unique=True)
         if self.accept_kw("index"):
             return self._create_index()
         t = self.peek()
@@ -483,6 +492,16 @@ class Parser:
         pk_desc: List[str] = []
         defaults: Dict[str, object] = {}
         not_null: List[str] = []
+        unique_cols: List[str] = []
+        foreign_keys: List[Tuple[str, str, str]] = []
+
+        def fk_clause(col):
+            parent = self.ident()
+            self.expect_op("(")
+            pcol = self.ident()
+            self.expect_op(")")
+            foreign_keys.append((col, parent, pcol))
+
         while True:
             if self.accept_kw("primary"):
                 self.expect_kw("key")
@@ -499,6 +518,36 @@ class Parser:
                         break
                 self.expect_op(")")
                 pk = pk_cols
+            elif self.accept_kw("unique"):
+                # table-level UNIQUE (col)
+                self.expect_op("(")
+                unique_cols.append(self.ident())
+                self.expect_op(")")
+            elif self.accept_kw("foreign"):
+                # FOREIGN KEY (col) REFERENCES parent (pcol)
+                self.expect_kw("key")
+                self.expect_op("(")
+                fcol = self.ident()
+                self.expect_op(")")
+                self.expect_kw("references")
+                fk_clause(fcol)
+            elif self.accept_kw("constraint"):
+                self.ident()           # constraint name (not stored)
+                if self.accept_kw("unique"):
+                    self.expect_op("(")
+                    unique_cols.append(self.ident())
+                    self.expect_op(")")
+                elif self.accept_kw("foreign"):
+                    self.expect_kw("key")
+                    self.expect_op("(")
+                    fcol = self.ident()
+                    self.expect_op(")")
+                    self.expect_kw("references")
+                    fk_clause(fcol)
+                else:
+                    raise ValueError(
+                        "only UNIQUE / FOREIGN KEY named constraints "
+                        "are supported")
             else:
                 cname = self.ident()
                 ctype = self._column_type()
@@ -517,6 +566,10 @@ class Parser:
                     elif self.accept_kw("primary"):
                         self.expect_kw("key")
                         pk = [cname]
+                    elif self.accept_kw("unique"):
+                        unique_cols.append(cname)
+                    elif self.accept_kw("references"):
+                        fk_clause(cname)
                     else:
                         break
             if not self.accept_op(","):
@@ -541,7 +594,9 @@ class Parser:
             raise ValueError("PRIMARY KEY required")
         return CreateTableStmt(name, cols, pk, range_sharded, pk_desc,
                                num_hash, num_tablets, rf, ine,
-                               defaults, not_null, tablespace=tspace)
+                               defaults, not_null, tablespace=tspace,
+                               unique_cols=unique_cols,
+                               foreign_keys=foreign_keys)
 
     def _column_type(self) -> str:
         """One column type: plain (`bigint`), parameterized
@@ -569,7 +624,7 @@ class Parser:
             return ctype + "[]"
         return ctype
 
-    def _create_index(self):
+    def _create_index(self, unique: bool = False):
         name = self.ident()
         self.expect_kw("on")
         table = self.ident()
@@ -584,7 +639,8 @@ class Parser:
             k = self.ident().lower()
             self.expect_op("=")
             lists = int(self.next()[1])
-        return CreateIndexStmt(name, table, column, method, lists)
+        return CreateIndexStmt(name, table, column, method, lists,
+                               unique=unique)
 
     def alter_table(self):
         self.expect_kw("alter")

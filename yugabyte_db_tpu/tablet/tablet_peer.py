@@ -43,6 +43,9 @@ class TabletPeer:
                             if is_status_tablet else None)
         self._write_queue: list = []
         self._batcher_task = None
+        # leader-memory reservations for in-flight 'insert' ops (unique
+        # index gate: check + reserve happen atomically on the loop)
+        self._pending_inserts: set = set()
         self.on_alter = None      # tserver persists new schema to meta
         # Raft-replicated split (reference: tablet/operations/
         # split_operation.cc): the tserver installs the apply hook; a
@@ -151,6 +154,43 @@ class TabletPeer:
         self.log.close()
 
     # --- write path -------------------------------------------------------
+    def _check_inserts(self, req: WriteRequest) -> list:
+        """insert-if-absent gate for 'insert' ops (unique indexes): a
+        live committed row at the key, a pending queued insert of the
+        same key, or a live transactional claim is a DUPLICATE.  Runs
+        on the leader BEFORE enqueue; the single event loop makes
+        check+reserve atomic, so two racing inserts of one key cannot
+        both pass (reference: unique-index conflict through docdb
+        intents, yb_access/yb_lsm.c:233-366).  Returns the reserved
+        keys (caller releases after the write resolves)."""
+        from ..docdb.operations import ReadRequest
+        codec = self.tablet._codec_for(req.table_id)
+        reserved = []
+        try:
+            for op in req.ops:
+                if op.kind != "insert":
+                    continue
+                key = codec.doc_key_prefix(op.row)
+                if key in self._pending_inserts or \
+                        key in self.participant._key_holder:
+                    raise RpcError(
+                        "duplicate key value violates unique "
+                        "constraint", "DUPLICATE_KEY")
+                pk_row = {c.name: op.row[c.name]
+                          for c in codec.info.schema.key_columns}
+                rr = ReadRequest(req.table_id, pk_eq=pk_row)
+                if self.tablet.read(rr).rows:
+                    raise RpcError(
+                        "duplicate key value violates unique "
+                        "constraint", "DUPLICATE_KEY")
+                self._pending_inserts.add(key)
+                reserved.append(key)
+        except Exception:
+            for k in reserved:
+                self._pending_inserts.discard(k)
+            raise
+        return reserved
+
     async def write(self, req: WriteRequest) -> WriteResponse:
         """Group commit: concurrent writes queue and ride ONE Raft round
         (reference: Log group commit + ReplicateBatch batching,
@@ -161,6 +201,7 @@ class TabletPeer:
             raise RpcError(
                 f"not leader (hint={self.consensus.leader_hint()})",
                 "LEADER_NOT_READY")
+        reserved = self._check_inserts(req)
         if req.external_ht is not None:
             # HLC merge keeps local time ahead of the imported HT
             self.clock.update(HybridTime(req.external_ht))
@@ -172,7 +213,11 @@ class TabletPeer:
         self._write_queue.append((payload, fut))
         if self._batcher_task is None or self._batcher_task.done():
             self._batcher_task = asyncio.create_task(self._drain_writes())
-        await fut
+        try:
+            await fut
+        finally:
+            for k in reserved:
+                self._pending_inserts.discard(k)
         return WriteResponse(rows_affected=len(req.ops))
 
     def _pending_ht_bound(self, now_value: int, from_index: int) -> int:

@@ -421,6 +421,50 @@ class TransactionParticipant:
                 raise RpcError(
                     f"txn {txn_id} write conflict: key modified at "
                     f"{committed} after snapshot {eff_ht}", "ABORTED")
+        # insert-if-absent ('insert' ops, the unique-index primitive):
+        # we hold the exclusive claim, so the only way a duplicate can
+        # appear is an already-committed live row — check the regular
+        # store NOW; racing transactional inserts serialize on the
+        # claim and the loser fails this same check after the winner's
+        # commit applies (reference: yb_access/yb_lsm.c:233-366)
+        from ..docdb.operations import ReadRequest as _RR
+        batch_inserts = set()   # same key twice in ONE batch is a dup
+        for i, (k, op) in enumerate(zip(keys, req.ops)):
+            if op.kind != "insert":
+                continue
+            if k in batch_inserts:
+                self._release(txn_id,
+                              [kk for kk in keys
+                               if not self._intents.get(txn_id,
+                                                        {}).get(kk)])
+                raise RpcError(
+                    "duplicate key value violates unique constraint",
+                    "DUPLICATE_KEY")
+            batch_inserts.add(k)
+            pk_row = {c.name: op.row[c.name]
+                      for c in codec.info.schema.key_columns}
+            own = self._intents.get(txn_id, {}).get(k)
+            if own:
+                last = own[-1]
+                if last[2][0] != "delete":
+                    self._release(txn_id,
+                                  [kk for kk in keys
+                                   if not self._intents.get(
+                                       txn_id, {}).get(kk)])
+                    raise RpcError(
+                        "duplicate key value violates unique "
+                        "constraint", "DUPLICATE_KEY")
+                continue               # own delete pending: re-insert ok
+            if k in self.peer._pending_inserts or \
+                    self.tablet.read(_RR(req.table_id,
+                                         pk_eq=pk_row)).rows:
+                self._release(txn_id,
+                              [kk for kk in keys
+                               if not self._intents.get(txn_id,
+                                                        {}).get(kk)])
+                raise RpcError(
+                    "duplicate key value violates unique constraint",
+                    "DUPLICATE_KEY")
         if status_tablet:
             self._txn_meta.setdefault(txn_id, {})["status_tablet"] = \
                 status_tablet
