@@ -300,3 +300,98 @@ class TestSqlConstraints:
             finally:
                 await mc.shutdown()
         run(go())
+
+    def test_unique_update_move_failure_keeps_old_entry(self, tmp_path):
+        """UPDATE moving a unique value onto a taken one must fail
+        WITHOUT un-indexing the old value (inserts run before deletes,
+        in separate batches)."""
+        async def go():
+            mc, c = await make_cluster(str(tmp_path))
+            try:
+                await c.insert("users", [{"id": 1, "email": "x@x"},
+                                         {"id": 2, "email": "y@x"}])
+                with pytest.raises(RpcError):
+                    await c.insert("users", [{"id": 1, "email": "y@x"}])
+                # x@x must still be indexed to row 1
+                pks = await c.index_lookup("users", "users_email_key",
+                                           "x@x")
+                assert [p["id"] for p in pks] == [1]
+                row = await c.get("users", {"id": 1})
+                assert row["email"] == "x@x"
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_multi_index_partial_failure_undoes_earlier(self, tmp_path):
+        """Non-txn insert: when a later unique index rejects, entries
+        already written to earlier indexes are compensated away."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path),
+                                   num_tservers=1).start()
+            try:
+                c = mc.client()
+                s = SqlSession(c)
+                await s.execute(
+                    "CREATE TABLE mi (k bigint PRIMARY KEY, a text, "
+                    "b text) WITH tablets = 1")
+                await s.execute("CREATE INDEX mi_a ON mi (a)")
+                await s.execute("CREATE UNIQUE INDEX mi_b ON mi (b)")
+                await s.execute(
+                    "INSERT INTO mi (k, a, b) VALUES (1, 'p', 'u')")
+                with pytest.raises(RpcError):
+                    await s.execute("INSERT INTO mi (k, a, b) "
+                                    "VALUES (2, 'q', 'u')")
+                pks = await c.index_lookup("mi", "mi_a", "q")
+                assert pks == [], pks     # no orphan in mi_a
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_failed_unique_backfill_deregisters_index(self, tmp_path):
+        """A CREATE UNIQUE INDEX that fails on pre-existing duplicates
+        must leave NO registered index behind: later inserts are not
+        gated, and the index can be recreated after the fix."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path),
+                                   num_tservers=1).start()
+            try:
+                c = mc.client()
+                await c.create_table(users_info(), num_tablets=1)
+                await mc.wait_for_leaders("users")
+                await c.insert("users", [{"id": 1, "email": "d@x"},
+                                         {"id": 2, "email": "d@x"}])
+                with pytest.raises(RpcError):
+                    await c.create_secondary_index(
+                        "users", "u_email", "email", unique=True)
+                # no half-registered gate: same value inserts twice
+                await c.insert("users", [{"id": 3, "email": "e@x"}])
+                await c.insert("users", [{"id": 4, "email": "e@x"}])
+                # fix the duplicates, recreate cleanly
+                await c.delete("users", [{"id": 2}, {"id": 4}])
+                n = await c.create_secondary_index(
+                    "users", "u_email", "email", unique=True)
+                assert n == 2
+                with pytest.raises(RpcError):
+                    await c.insert("users", [{"id": 5, "email": "d@x"}])
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_self_ref_fk_same_statement(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path),
+                                   num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute(
+                    "CREATE TABLE emp3 (id bigint PRIMARY KEY, "
+                    "mgr bigint REFERENCES emp3 (id)) WITH tablets = 1")
+                # later row references an earlier row of the SAME
+                # statement, and a row references itself (PG-legal)
+                await s.execute("INSERT INTO emp3 (id, mgr) "
+                                "VALUES (5, 5), (6, 5)")
+                r = await s.execute("SELECT count(*) FROM emp3")
+                assert r.rows[0]["count"] == 2
+            finally:
+                await mc.shutdown()
+        run(go())

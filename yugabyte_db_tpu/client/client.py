@@ -96,25 +96,32 @@ async def build_index_ops(ct, table: str, ops, getter):
     in the row payload) and write as insert-if-absent so duplicates
     collide on the shared doc key."""
     pk_names = [c.name for c in ct.info.schema.key_columns]
+    # ONE pre-image fetch per base op (not per index): with N indexes
+    # the old shape multiplied point reads (and RPC round trips on the
+    # transactional path) by N
+    olds = []
+    for op in ops:
+        pk_row = {n: op.row[n] for n in pk_names if n in op.row}
+        olds.append(await getter(table, pk_row) if pk_row else None)
     out = []
     for index_name, spec in ct.indexes.items():
         col = spec["column"]
         unique = spec.get("unique")
-        idx_ops: List[RowOp] = []
-        undo_ops: List[RowOp] = []
-        for op in ops:
-            pk_row = {n: op.row[n] for n in pk_names if n in op.row}
-            old = await getter(table, pk_row) if pk_row else None
-            full_old = old and {col: old[col],
-                                **{f"base_{n}": old[n]
-                                   for n in pk_names}}
-            if old is not None and old.get(col) is not None:
+        ins_ops: List[RowOp] = []
+        del_ops: List[RowOp] = []
+        ins_undo: List[RowOp] = []
+        del_undo: List[RowOp] = []
+        for op, old in zip(ops, olds):
+            full_old = old and old.get(col) is not None and {
+                col: old[col],
+                **{f"base_{n}": old[n] for n in pk_names}}
+            if full_old:
                 if op.kind == "delete" or old.get(col) != op.row.get(col):
                     # unique index keys on the value alone: the delete
                     # targets {col}; base_* live in the value
-                    idx_ops.append(RowOp("delete", {
+                    del_ops.append(RowOp("delete", {
                         col: old[col]} if unique else dict(full_old)))
-                    undo_ops.append(RowOp("upsert", dict(full_old)))
+                    del_undo.append(RowOp("upsert", dict(full_old)))
             if op.kind in ("upsert", "insert") \
                     and op.row.get(col) is not None:
                 if old is not None and old.get(col) == op.row.get(col):
@@ -123,12 +130,19 @@ async def build_index_ops(ct, table: str, ops, getter):
                            **{f"base_{n}": op.row[n] for n in pk_names}}
                 # unique: insert-if-absent so a duplicate value
                 # collides on the shared doc key and is rejected
-                idx_ops.append(RowOp("insert" if unique else "upsert",
+                ins_ops.append(RowOp("insert" if unique else "upsert",
                                      new_row))
-                undo_ops.append(RowOp("delete", {
+                ins_undo.append(RowOp("delete", {
                     col: op.row[col]} if unique else new_row))
-        if idx_ops:
-            out.append((index_name, idx_ops, undo_ops))
+        # inserts BEFORE deletes, as separate batches: a unique UPDATE
+        # moving a value (delete old + insert new) must fail on the
+        # duplicate check before the delete lands — a single batch
+        # splits across index tablets and could apply the delete while
+        # the insert is rejected, silently un-indexing the old value
+        if ins_ops:
+            out.append((index_name, ins_ops, ins_undo))
+        if del_ops:
+            out.append((index_name, del_ops, del_undo))
     return out
 
 
@@ -449,10 +463,18 @@ class YBClient:
         two writes can still leak an entry; the transactional path has
         no such window."""
         undo: List[tuple] = []
-        for index_name, idx_ops, undo_ops in await build_index_ops(
-                ct, table, ops, self.get):
-            await self.write(index_name, idx_ops)
-            undo.append((index_name, undo_ops))
+        try:
+            for index_name, idx_ops, undo_ops in await build_index_ops(
+                    ct, table, ops, self.get):
+                await self.write(index_name, idx_ops)
+                undo.append((index_name, undo_ops))
+        except Exception:
+            # partial failure (e.g. a later unique index rejected a
+            # duplicate): undo the indexes already written — an orphan
+            # entry would point at a base row that never lands (and for
+            # unique indexes would deny the value forever)
+            await self._undo_index_ops(undo)
+            raise
         return undo
 
     async def _undo_index_ops(self, undo) -> None:
@@ -502,11 +524,25 @@ class YBClient:
             "", columns=tuple(pk_names + [column])))
         rows = [r for r in resp.rows if r.get(column) is not None]
         if rows:
-            await self.write(index_name, [
-                RowOp("insert" if unique else "upsert",
-                      {column: r[column],
-                       **{f"base_{n}": r[n] for n in pk_names}})
-                for r in rows])
+            try:
+                await self.write(index_name, [
+                    RowOp("insert" if unique else "upsert",
+                          {column: r[column],
+                           **{f"base_{n}": r[n] for n in pk_names}})
+                    for r in rows])
+            except RpcError:
+                # failed backfill (pre-existing duplicates): a
+                # half-registered index would miss lookups AND deny
+                # values through its insert-if-absent gate — deregister
+                # it so the DDL fails cleanly and can be retried
+                try:
+                    await self._master_call(
+                        "drop_secondary_index",
+                        {"table": table, "index_name": index_name},
+                        timeout=30.0)
+                finally:
+                    self._tables.pop(table, None)
+                raise
         return len(rows)
 
     # --- DML: reads -------------------------------------------------------

@@ -28,6 +28,7 @@ from ..storage.columnar import ColumnarBlock, fnv64_bytes
 from ..storage.lsm import LsmStore, WriteBatch
 from ..utils import flags
 from ..utils.hybrid_time import ENCODED_SIZE, DocHybridTime, HybridTime
+from .hotpath import load as _hot_mod
 from .table_codec import TableCodec
 
 _HT_SUFFIX = ENCODED_SIZE + 1
@@ -809,6 +810,9 @@ class DocReadOperation:
         kht = ValueType.kHybridTime
         best = None
         for m in mems:
+            if not m.may_contain_row(prefix):
+                continue    # O(1) negative guard: most probes on
+                #             read-heavy workloads miss the memtable
             for k, v in m.seek(prefix):
                 if not k.startswith(prefix) or k[plen] != kht:
                     break
@@ -856,7 +860,7 @@ class DocReadOperation:
         return self.codec.decode_row(k, v)
 
     def _native_best(self, prefixes: List[bytes], ssts, read_ht: int,
-                     restart_hi):
+                     restart_hi, want_cols=None):
         """Cross-SST merge of PointReader.find_many results: one C call
         per SST does bloom+bisect+MVCC-walk+extract for the whole key
         list. Returns (best, slow) where best[i] is the winning
@@ -874,7 +878,8 @@ class DocReadOperation:
         slow: set = set()
         rh = -1 if restart_hi is None else restart_hi
         for pr in readers:
-            for i, got in enumerate(pr.find_many(prefixes, read_ht, rh)):
+            for i, got in enumerate(pr.find_many(prefixes, read_ht, rh,
+                                                 want_cols)):
                 if got is None:
                     continue
                 if got is NotImplemented:
@@ -914,8 +919,8 @@ class DocReadOperation:
         return self._decode_best(best, read_ht)
 
     def multi_get(self, pk_rows: Sequence[Dict[str, object]],
-                  read_ht: int, allow_restart: bool = False
-                  ) -> List[Optional[Dict[str, object]]]:
+                  read_ht: int, allow_restart: bool = False,
+                  columns=None) -> List[Optional[Dict[str, object]]]:
         """Batched point lookups: one snapshot, one restart window, one
         result list — the server-side batching seam concurrent sessions
         share (reference analog: operation buffering in pggate,
@@ -926,17 +931,42 @@ class DocReadOperation:
         non-empty memtables take the per-key Python path."""
         restart_hi = (read_ht + _skew_window_ht()
                       if allow_restart else None)
-        mems, ssts = self.store.read_snapshot()
         prefix_of = self.codec.doc_key_prefix
         prefixes = [prefix_of(r) for r in pk_rows]
+        # C-side projection: rows materialize with ONLY these columns
+        # (short range scans would otherwise decode 10 payload strings
+        # per row just for the caller to drop them); memtable/slow-path
+        # rows stay full and the caller's projection normalizes
+        want = tuple(columns) if columns else None
+        return self._multi_get_prefixes(prefixes, read_ht, restart_hi,
+                                        want)
+
+    def _multi_get_prefixes(self, prefixes: List[bytes], read_ht: int,
+                            restart_hi, want=None
+                            ) -> List[Optional[Dict[str, object]]]:
+        mems, ssts = self.store.read_snapshot()
         n = len(prefixes)
-        got = self._native_best(prefixes, ssts, read_ht, restart_hi)
+        got = self._native_best(prefixes, ssts, read_ht, restart_hi,
+                                want)
         if got is None:
             best: List = [None] * n
             slow = set(range(n))
         else:
             best, slow = got
         mem_active = [m for m in mems if not m.empty()]
+        # direct prefix-set membership beats a method call per
+        # (key, memtable) pair; a foreign-layout memtable disables the
+        # shortcut and probes unconditionally
+        mem_guarded = [m for m in mem_active if not m._foreign_layout]
+        probe_all = len(mem_guarded) != len(mem_active)
+        mem_sets = [m._row_prefixes for m in mem_guarded]
+        if len(mem_sets) == 1:
+            # the common steady state: one active memtable — a plain
+            # set-membership beats an any() genexpr per key
+            ms0 = mem_sets[0]
+            mem_sets = None
+        else:
+            ms0 = None
         out: List[Optional[Dict[str, object]]] = []
         for i in range(n):
             if i in slow:
@@ -947,11 +977,14 @@ class DocReadOperation:
                 continue
             b = best[i]
             if mem_active:
-                mb = self._mem_best(prefixes[i], read_ht, restart_hi,
-                                    mem_active)
-                if mb is not None and (b is None or mb[:2] > b[:2]):
-                    out.append(self._decode_best(mb, read_ht))
-                    continue
+                p = prefixes[i]
+                if probe_all or (p in ms0 if ms0 is not None
+                                 else any(p in ms for ms in mem_sets)):
+                    mb = self._mem_best(p, read_ht, restart_hi,
+                                        mem_active)
+                    if mb is not None and (b is None or mb[:2] > b[:2]):
+                        out.append(self._decode_best(mb, read_ht))
+                        continue
             out.append(b[2] if b is not None else None)
         return out
 
@@ -1040,8 +1073,18 @@ class DocReadOperation:
         if (len(kcs) != 1 or kcs[0].type not in ("int32", "int64")
                 or self.codec.info.partition_schema.kind != "hash"):
             return None
-        point_lists, interval, residual = extract_scan_options(
-            req.where, kcs)
+        w = req.where
+        if (w is not None and w[0] == "between" and w[1][0] == "col"
+                and w[1][1] == kcs[0].id and w[2][0] == "const"
+                and w[3][0] == "const"
+                and type(w[2][1]) is int and type(w[3][1]) is int):
+            # the hot shape (YCSB-E: BETWEEN k AND k+9 on the int PK)
+            # skips the generic conjunct walk entirely
+            point_lists, interval, residual = \
+                None, (kcs[0], w[2][1], w[3][1]), None
+        else:
+            point_lists, interval, residual = extract_scan_options(
+                req.where, kcs)
         # constants outside the column's width can never match a stored
         # key (and would overflow the key encoder) — clamp/drop them,
         # matching what the row-wise filter would return
@@ -1062,10 +1105,30 @@ class DocReadOperation:
             return None
         name = kcs[0].name
         read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
-        rows = self.multi_get([{name: int(k)} for k in keys], read_ht,
-                              allow_restart=self._allow_restart)
+        # residual predicates need their referenced columns too — only
+        # project in C when the bounds consumed the whole WHERE
+        want = tuple(req.columns) if (req.columns and residual is None) \
+            else None
+        hot = _hot_mod()
+        spec = getattr(self.codec, "_key_spec", None)
+        if hot is not None and spec is not None:
+            # inline single-int key encoding: one native call per key
+            # with no per-key dict/genexpr wrapping (the enumerated
+            # scan is called tens of thousands of times per second)
+            restart_hi = (read_ht + _skew_window_ht()
+                          if self._allow_restart else None)
+            enc = hot.encode_doc_key
+            prefixes = [enc(spec, (int(k),)) for k in keys]
+            rows = self._multi_get_prefixes(prefixes, read_ht,
+                                            restart_hi, want)
+        else:
+            rows = self.multi_get([{name: int(k)} for k in keys],
+                                  read_ht,
+                                  allow_restart=self._allow_restart,
+                                  columns=want)
         by_id = {c.name: c.id for c in schema.columns}
         out = []
+        nwant = len(want) if want else -1
         for r in rows:
             if r is None:
                 continue
@@ -1073,7 +1136,10 @@ class DocReadOperation:
                 idrow = {by_id[n]: v for n, v in r.items()}
                 if eval_expr_py(residual, idrow) is not True:
                     continue
-            out.append(self._project(r, req.columns))
+            # rows the native reader projected are already final;
+            # memtable/slow-path rows are full and still need the cut
+            out.append(r if len(r) == nwant
+                       else self._project(r, req.columns))
             if req.limit is not None and len(out) >= req.limit:
                 break
         return ReadResponse(rows=out, backend="cpu")

@@ -1511,6 +1511,30 @@ class Master:
         await self._commit_catalog([["put_table", tid, tent]])
         return {"index_table_id": resp["table_id"]}
 
+    async def rpc_drop_secondary_index(self, payload) -> dict:
+        """Deregister + drop an index table (used by DROP INDEX and by
+        the client when a unique backfill fails — a registered index
+        with no backfilled entries would both miss lookups and deny
+        values via its insert-if-absent gate)."""
+        base_name = payload["table"]
+        index_name = payload["index_name"]
+        tid = next((t for t, e in self.tables.items()
+                    if e["info"]["name"] == base_name), None)
+        if tid is None:
+            raise RpcError(f"table {base_name} not found", "NOT_FOUND")
+        tent = dict(self.tables[tid])
+        idxs = dict(tent.get("indexes", {}))
+        if index_name not in idxs:
+            raise RpcError(f"index {index_name} not found", "NOT_FOUND")
+        del idxs[index_name]
+        tent["indexes"] = idxs
+        await self._commit_catalog([["put_table", tid, tent]])
+        try:
+            await self.rpc_drop_table({"name": index_name})
+        except RpcError:
+            pass     # index table already gone: deregistration stands
+        return {"ok": True}
+
     async def rpc_get_status_tablet(self, payload) -> dict:
         """Return (creating on demand) the transaction status tablet
         (reference: client-side status-tablet picking,

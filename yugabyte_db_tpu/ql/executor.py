@@ -672,10 +672,17 @@ class SqlSession:
         for fk in getattr(ct, "foreign_keys", None) or []:
             col, parent = fk["column"], fk["parent_table"]
             pcol = fk["parent_column"]
+            # self-referential statements: a row may reference another
+            # row of the SAME statement (or itself) — PG checks per row
+            # as inserted, so sibling pk values count as present
+            sibling_pks = ({row.get(pcol) for row in rows}
+                           if parent == ct.info.name else ())
             for row in rows:
                 v = row.get(col)
                 if v is None:
                     continue           # NULL FK is always valid (PG)
+                if v in sibling_pks:
+                    continue
                 if self._txn is not None:
                     found = await self._txn.get(parent, {pcol: v})
                 else:
