@@ -410,6 +410,36 @@ def main():
     if os.environ.get("BENCH_VECTOR_FULL", "1") != "0":
         results["vector_full"] = vector_bench(1_000_000, 768, 200, 2, 2)
 
+    # --- driver-conformance accounting (VERDICT r4 item 8) --------------
+    # The external-driver suites (psycopg / cassandra-driver / redis-py)
+    # need real drivers that cannot be installed in this image; a
+    # pytest skip must never read as coverage, so the bench records
+    # exactly which suites RAN (and their outcome) vs were SKIPPED and
+    # why.  If a driver ever appears in the image, the suite runs here
+    # automatically and its result replaces the skip entry.
+    import subprocess as _sp
+    driver_conf = {"ran": {}, "skipped": {}}
+    for mod, suite in (("psycopg", "tests/test_driver_conformance.py"),
+                       ("cassandra", "tests/test_driver_conformance_cql.py"),
+                       ("redis", "tests/test_driver_conformance_redis.py")):
+        try:
+            __import__(mod)
+        except ImportError:
+            driver_conf["skipped"][suite] = f"driver {mod!r} not installed"
+            continue
+        try:
+            r = _sp.run([sys.executable, "-m", "pytest", suite, "-q",
+                         "--no-header"],
+                        capture_output=True, timeout=600,
+                        cwd=os.path.dirname(os.path.abspath(__file__)))
+            tail = (r.stdout or b"").decode("utf-8", "replace")
+            tail = tail.strip().splitlines()[-1] if tail.strip() else ""
+            driver_conf["ran"][suite] = {
+                "passed": r.returncode == 0, "summary": tail[:120]}
+        except Exception as e:   # noqa: BLE001 — account, don't fail bench
+            driver_conf["ran"][suite] = {"passed": False,
+                                         "summary": str(e)[:120]}
+
     q6 = results["q6"]
     line = {
         "metric": "tpch_q6_sf%g_tpu_rows_per_sec" % sf,
@@ -445,6 +475,7 @@ def main():
                      for k, v in results["tpcc"].items()}}
            if "tpcc" in results else {}),
         "ycsb_e_ops_per_s": round(results["ycsb_e"]["ops_per_s"], 1),
+        "driver_conformance": driver_conf,
         "vector": {"n": results["vector"]["n"],
                    "dim": results["vector"]["dim"],
                    "build_s": round(results["vector"]["build_s"], 2),

@@ -466,11 +466,22 @@ class YBClient:
         try:
             for index_name, idx_ops, undo_ops in await build_index_ops(
                     ct, table, ops, self.get):
-                await self.write(index_name, idx_ops)
-                undo.append((index_name, undo_ops))
+                if any(o.kind == "insert" for o in idx_ops):
+                    # unique inserts go ONE AT A TIME: a multi-op batch
+                    # fans out across index tablets concurrently, and a
+                    # duplicate rejection on one tablet cannot tell us
+                    # which sibling ops applied — blanket-undoing the
+                    # failed batch could delete the EXISTING owner's
+                    # entry.  Per-op writes make applied == undone.
+                    for o, u in zip(idx_ops, undo_ops):
+                        await self.write(index_name, [o])
+                        undo.append((index_name, [u]))
+                else:
+                    await self.write(index_name, idx_ops)
+                    undo.append((index_name, undo_ops))
         except Exception:
             # partial failure (e.g. a later unique index rejected a
-            # duplicate): undo the indexes already written — an orphan
+            # duplicate): undo the entries already written — an orphan
             # entry would point at a base row that never lands (and for
             # unique indexes would deny the value forever)
             await self._undo_index_ops(undo)
@@ -540,8 +551,13 @@ class YBClient:
                         "drop_secondary_index",
                         {"table": table, "index_name": index_name},
                         timeout=30.0)
-                finally:
-                    self._tables.pop(table, None)
+                except Exception:   # noqa: BLE001
+                    # deregistration itself failed (master failover):
+                    # the ORIGINAL duplicate-key error must surface,
+                    # not the transport error; re-running the DDL
+                    # retries the cleanup
+                    pass
+                self._tables.pop(table, None)
                 raise
         return len(rows)
 

@@ -44,7 +44,12 @@ class MemTable:
         if not self._foreign_layout:
             if len(key) > _HT_SUFFIX and \
                     key[-_HT_SUFFIX] == ValueType.kHybridTime:
-                self._row_prefixes.add(key[:-_HT_SUFFIX])
+                p = key[:-_HT_SUFFIX]
+                if p not in self._row_prefixes:
+                    self._row_prefixes.add(p)
+                    # the guard set is real memory: count it toward the
+                    # flush trigger like keys/values
+                    self._bytes += len(p)
             else:
                 self._foreign_layout = True
 

@@ -413,10 +413,13 @@ class SstReader:
         # reference's BlockBasedTable reads blocks on demand the same
         # way). Encrypted files still need the full image to decrypt.
         import mmap as _mmap
-        from ..utils.encryption import KEY_MANAGER, MAGIC as ENC_MAGIC
+        from ..utils.encryption import (
+            KEY_MANAGER, MAGIC as ENC_MAGIC, MAGIC_V2 as ENC_MAGIC_V2,
+        )
         with open(path, "rb") as f:
             head = f.read(len(ENC_MAGIC))
-            if head.startswith(ENC_MAGIC):
+            if head.startswith(ENC_MAGIC) or \
+                    head.startswith(ENC_MAGIC_V2):
                 f.seek(0)
                 self._data = KEY_MANAGER.decrypt_file_bytes(f.read())
             else:
