@@ -1,0 +1,23 @@
+-- multi-join cost-based ordering: greedy left-deep from ANALYZE
+-- cardinalities (reference: PG planner join ordering + batched-NL
+-- costing, nodeYbBatchedNestloop.c)
+CREATE TABLE fact (id bigint PRIMARY KEY, d1_id bigint, qty bigint) WITH tablets = 1;
+CREATE TABLE dim1 (id bigint PRIMARY KEY, d2_id bigint, name text) WITH tablets = 1;
+CREATE TABLE dim2 (id bigint PRIMARY KEY, region text) WITH tablets = 1;
+INSERT INTO dim2 (id, region) VALUES (1, 'north'), (2, 'south');
+INSERT INTO dim1 (id, d2_id, name) SELECT g, 1 + g % 2, 'd' || g FROM generate_series(1, 20) AS g;
+INSERT INTO fact (id, d1_id, qty) SELECT g, 1 + g % 20, g % 7 FROM generate_series(1, 200) AS g;
+-- without stats: written order stands
+EXPLAIN SELECT fact.id, dim2.region FROM fact JOIN dim1 ON fact.d1_id = dim1.id JOIN dim2 ON dim1.d2_id = dim2.id;
+SELECT fact.id, dim2.region FROM fact JOIN dim1 ON fact.d1_id = dim1.id JOIN dim2 ON dim1.d2_id = dim2.id ORDER BY fact.id LIMIT 4;
+ANALYZE fact;
+ANALYZE dim1;
+ANALYZE dim2;
+-- with stats: EXPLAIN shows the non-written greedy order (smallest outer)
+EXPLAIN SELECT fact.id, dim2.region FROM fact JOIN dim1 ON fact.d1_id = dim1.id JOIN dim2 ON dim1.d2_id = dim2.id;
+-- and the reordered plan returns the same rows
+SELECT fact.id, dim2.region FROM fact JOIN dim1 ON fact.d1_id = dim1.id JOIN dim2 ON dim1.d2_id = dim2.id ORDER BY fact.id LIMIT 4;
+SELECT dim2.region, sum(fact.qty) FROM fact JOIN dim1 ON fact.d1_id = dim1.id JOIN dim2 ON dim1.d2_id = dim2.id GROUP BY dim2.region ORDER BY dim2.region;
+DROP TABLE fact;
+DROP TABLE dim1;
+DROP TABLE dim2;

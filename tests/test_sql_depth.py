@@ -298,3 +298,67 @@ class TestStrictNullFunctions:
             finally:
                 await mc.shutdown()
         asyncio.run(go())
+
+
+class TestJoinOrderBySemantics:
+    """Join ORDER BY reference rules (review-found regressions):
+    qualified refs always mean the table column (never an alias), and
+    PG's DISTINCT/ORDER BY select-list rule."""
+
+    def test_qualified_order_col_not_shadowed_by_alias(self, tmp_path):
+        async def go():
+            from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute("CREATE TABLE a (id bigint PRIMARY KEY,"
+                                " name text) WITH tablets = 1")
+                await s.execute(
+                    "CREATE TABLE b (id bigint PRIMARY KEY, "
+                    "a_id bigint, amt bigint) WITH tablets = 1")
+                await s.execute(
+                    "INSERT INTO a (id, name) VALUES (1, 'x'), (2, 'y')")
+                await s.execute("INSERT INTO b (id, a_id, amt) VALUES "
+                                "(10, 1, 5), (11, 1, 7), (12, 2, 3)")
+                # alias 'name' shadows a.name's bare name; ORDER BY
+                # a.name must still sort by the TABLE column
+                r = await s.execute(
+                    "SELECT b.amt AS name FROM a "
+                    "JOIN b ON a.id = b.a_id ORDER BY a.name, b.amt")
+                assert [row["name"] for row in r.rows] == [5, 7, 3]
+                # sort-only qualified column, plain case
+                r = await s.execute(
+                    "SELECT a.name FROM a JOIN b ON a.id = b.a_id "
+                    "ORDER BY b.amt")
+                assert [row["name"] for row in r.rows] == ["y", "x", "x"]
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())
+
+    def test_distinct_order_by_must_be_projected(self, tmp_path):
+        async def go():
+            from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+            import pytest as _pt
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute("CREATE TABLE a (id bigint PRIMARY KEY,"
+                                " name text) WITH tablets = 1")
+                await s.execute(
+                    "CREATE TABLE b (id bigint PRIMARY KEY, "
+                    "a_id bigint, amt bigint) WITH tablets = 1")
+                await s.execute(
+                    "INSERT INTO a (id, name) VALUES (1, 'x'), (2, 'y')")
+                await s.execute("INSERT INTO b (id, a_id, amt) VALUES "
+                                "(10, 1, 5), (11, 1, 7), (12, 2, 3)")
+                with _pt.raises(ValueError, match="select list"):
+                    await s.execute(
+                        "SELECT DISTINCT name FROM a "
+                        "JOIN b ON a.id = b.a_id ORDER BY b.amt")
+                r = await s.execute(
+                    "SELECT DISTINCT name FROM a "
+                    "JOIN b ON a.id = b.a_id ORDER BY name")
+                assert r.rows == [{"name": "x"}, {"name": "y"}]
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())

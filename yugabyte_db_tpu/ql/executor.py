@@ -332,8 +332,11 @@ class SqlSession:
                     stmt, joins=list(stmt.joins))
                 self._join_schemas, _real = \
                     await self._gather_join_schemas(probe)
-                self._maybe_swap_join(probe)
+                self._maybe_reorder_joins(probe)
                 swapped = probe.table != stmt.table
+                reordered = (probe.table != stmt.table or
+                             [j.table for j in probe.joins]
+                             != [j.table for j in stmt.joins])
                 pushed = self._join_pushdown(probe)
                 for jc in probe.joins:
                     lbl = jc.alias or jc.table
@@ -355,7 +358,16 @@ class SqlSession:
                              "outer keys)" if bnl else "Hash Join")
                     lines.append(f"{strat} ({jc.kind}) {probe.table} "
                                  f"⋈ {jc.table}")
-                if swapped:
+                if len(stmt.joins) >= 2 and reordered:
+                    chain = " -> ".join(
+                        [probe.table] + [j.table for j in probe.joins])
+                    est = ", ".join(
+                        f"{t}={self.rowcounts.get(t)}"
+                        for t in [probe.table]
+                        + [j.table for j in probe.joins])
+                    lines.append(f"  Join order: {chain} "
+                                 f"(ANALYZE greedy left-deep: {est})")
+                elif swapped:
                     lines.append(f"  Join order: {probe.table} outer "
                                  f"(ANALYZE: "
                                  f"{self.rowcounts.get(probe.table)} "
@@ -1297,6 +1309,125 @@ class SqlSession:
                     _strip_qualifiers(c))
         return per_table
 
+    def _ambiguous_bare_refs(self, stmt: SelectStmt, schemas) -> bool:
+        """True when any BARE column reference in the statement exists
+        in 2+ of the joined schemas: such a reference resolves to the
+        merge-order winner, so ANY reorder could flip the value it
+        sees — the written order must stand."""
+        names: set = set()
+        if stmt.where is not None:
+            self._collect_names(stmt.where, names)
+        for it in stmt.items:
+            if it[0] == "col":
+                names.add(it[1])
+            elif it[0] in ("expr", "agg") and it[-1] is not None \
+                    and isinstance(it[-1], tuple):
+                self._collect_names(it[-1], names)
+            elif it[0] == "window":
+                # ('window', fn, expr|None, partition, worder)
+                if it[2] is not None and isinstance(it[2], tuple):
+                    self._collect_names(it[2], names)
+                names |= set(it[3] or ())
+                names |= {n for n, _ in (it[4] or ())}
+        names |= {n for n, _ in stmt.order_by}
+        names |= set(stmt.group_by)
+        for name in names:
+            q, bare = self._split_qual(name)
+            if q is not None:
+                continue
+            holders = sum(1 for sch in schemas
+                          if any(c.name == bare for c in sch.columns))
+            if holders >= 2:
+                return True
+        return False
+
+    def _maybe_reorder_joins(self, stmt: SelectStmt) -> None:
+        """Greedy left-deep join ordering for ALL-INNER equi-join
+        chains of 2+ joins (reference: the PG planner's cheapest-path
+        ordering over ANALYZE cardinalities + batched-NL costing,
+        nodeYbBatchedNestloop.c; yql/pggate/pg_doc_op.h:115-126 for the
+        per-hop BNL batch fan-out the order controls).  The smallest
+        estimated table becomes the outer; each hop adds the smallest
+        remaining table CONNECTED to the placed set (a disconnected
+        pick would be a cross join).  Requires ANALYZE counts and
+        schemas for every side; single joins keep the swap path."""
+        if len(stmt.joins) < 2:
+            return self._maybe_swap_join(stmt)
+        if any(j.kind != "inner" for j in stmt.joins):
+            return
+        if any(it[0] == "star" for it in stmt.items):
+            return           # SELECT * follows the written order (PG)
+        labels = [stmt.table_alias or stmt.table] + \
+            [j.alias or j.table for j in stmt.joins]
+        real_of = {stmt.table_alias or stmt.table: stmt.table}
+        alias_of = {stmt.table_alias or stmt.table: stmt.table_alias}
+        for j in stmt.joins:
+            real_of[j.alias or j.table] = j.table
+            alias_of[j.alias or j.table] = j.alias
+        if any(real_of[l] in self._cte_rows for l in labels):
+            return
+        schemas = {l: (self._join_schemas or {}).get(l) for l in labels}
+        if any(s is None for s in schemas.values()):
+            return
+        counts = {l: self.rowcounts.get(real_of[l]) for l in labels}
+        if any(c is None for c in counts.values()):
+            return
+        if self._ambiguous_bare_refs(stmt, list(schemas.values())):
+            return
+
+        def owner_of(col: str, exclude: str):
+            """Label owning a (possibly qualified) column reference."""
+            q, bare = self._split_qual(col)
+            if q is not None:
+                return q if q in schemas else None
+            holders = [l for l in labels if l != exclude
+                       and any(c.name == bare
+                               for c in schemas[l].columns)]
+            return holders[0] if len(holders) == 1 else None
+
+        # undirected equi-join edges: (label_a, col_a, label_b, col_b)
+        edges = []
+        for j in stmt.joins:
+            jl = j.alias or j.table
+            ol = owner_of(j.left_col, exclude=jl)
+            if ol is None:
+                return       # can't prove which side the key lives on
+            edges.append((ol, self._split_qual(j.left_col)[1],
+                          jl, self._split_qual(j.right_col)[1]))
+
+        order = [min(labels, key=lambda l: counts[l])]
+        new_joins = []
+        remaining = list(edges)
+        while len(order) < len(labels):
+            placed = set(order)
+            cands = {}
+            for (a, ca, b, cb) in remaining:
+                if a in placed and b not in placed:
+                    cands.setdefault(b, (a, ca, cb))
+                elif b in placed and a not in placed:
+                    cands.setdefault(a, (b, cb, ca))
+            if not cands:
+                return       # disconnected: would need a cross join
+            nxt = min(cands, key=lambda l: counts[l])
+            anchor, acol, ncol = cands[nxt]
+            from .parser import JoinClause
+            new_joins.append(JoinClause(
+                real_of[nxt], "inner",
+                left_col=f"{anchor}.{acol}", right_col=ncol,
+                alias=alias_of[nxt] if alias_of[nxt] is not None
+                else (nxt if nxt != real_of[nxt] else None)))
+            order.append(nxt)
+            remaining = [e for e in remaining
+                         if not ((e[0] == nxt and e[2] == anchor)
+                                 or (e[2] == nxt and e[0] == anchor))]
+        if order == labels:
+            return           # stats agree with the written order
+        base = order[0]
+        stmt.table = real_of[base]
+        stmt.table_alias = alias_of[base] if alias_of[base] is not None \
+            else (base if base != real_of[base] else None)
+        stmt.joins = new_joins
+
     def _maybe_swap_join(self, stmt: SelectStmt) -> None:
         """Cost-based join-order choice for a single INNER equi-join
         (reference: the PG planner's cheapest-path join ordering fed by
@@ -1328,32 +1459,8 @@ class SqlSession:
         # a bare column name living in BOTH tables resolves to the
         # merge-order winner; a swap would flip which value an
         # ambiguous reference sees — keep the written order there
-        names: set = set()
-        if stmt.where is not None:
-            self._collect_names(stmt.where, names)
-        for it in stmt.items:
-            if it[0] == "col":
-                names.add(it[1])
-            elif it[0] in ("expr", "agg") and it[-1] is not None \
-                    and isinstance(it[-1], tuple):
-                self._collect_names(it[-1], names)
-            elif it[0] == "window":
-                # ('window', fn, expr|None, partition, worder)
-                if it[2] is not None and isinstance(it[2], tuple):
-                    self._collect_names(it[2], names)
-                names |= set(it[3] or ())
-                names |= {n for n, _ in (it[4] or ())}
-        names |= {n for n, _ in stmt.order_by}
-        names |= set(stmt.group_by)
-        for name in names:
-            q, bare = self._split_qual(name)
-            if q is not None:
-                continue
-            in_both = all(
-                any(c.name == bare for c in sch.columns)
-                for sch in schemas)
-            if in_both:
-                return
+        if self._ambiguous_bare_refs(stmt, schemas):
+            return
         from .parser import JoinClause
         stmt.table, jc_table = jc.table, stmt.table
         stmt.table_alias, jc_alias = jc.alias, stmt.table_alias
@@ -1402,7 +1509,7 @@ class SqlSession:
                     tname, jct.info.schema, None, self._txn.start_ht)
         self._join_schemas, real_of = \
             await self._gather_join_schemas(stmt)
-        self._maybe_swap_join(stmt)   # labels survive the swap
+        self._maybe_reorder_joins(stmt)   # labels survive the reorder
         lbl0 = stmt.table_alias or stmt.table
         pushed = self._join_pushdown(stmt)
 
@@ -1539,6 +1646,22 @@ class SqlSession:
                 elif it[0] == "window":
                     name = self._item_name(stmt, i)
                     row[name] = r.get(name)
+            # carry sort-only columns through the projection so
+            # _order_limit can sort by them (it strips them after).
+            # A QUALIFIED ref (t.col) always means the table column —
+            # never an output alias that happens to share the bare name
+            # (PG: aliases are only reachable by their bare name) — so
+            # it carries under its qualified key even when an alias
+            # shadows the bare one.
+            for col, _d in stmt.order_by:
+                if col in row:
+                    continue
+                q, bare = self._split_qual(col)
+                if q is None:
+                    if bare not in row:
+                        row[col] = r.get(col, r.get(bare))
+                else:
+                    row[col] = r.get(col)
             out.append(row)
         return SqlResult(self._order_limit(stmt, out))
 
@@ -1757,17 +1880,40 @@ class SqlSession:
 
     def _order_limit(self, stmt: SelectStmt, rows: List[dict]) -> List[dict]:
         if getattr(stmt, "distinct", False):
+            star = any(it[0] == "star" for it in stmt.items)
+            projected = None if star else {
+                self._item_name(stmt, i) for i in range(len(stmt.items))}
+            if projected is not None:
+                # PG rule: for SELECT DISTINCT, ORDER BY expressions
+                # must appear in the select list — otherwise the sort
+                # key of a deduplicated row is ill-defined
+                for col, _d in stmt.order_by:
+                    _, bare = self._split_qual(col)
+                    if col not in projected and bare not in projected:
+                        raise ValueError(
+                            "for SELECT DISTINCT, ORDER BY expressions "
+                            "must appear in the select list")
             seen = set()
             out = []
             for r in rows:
-                key = tuple(sorted((k, repr(v)) for k, v in r.items()))
+                # dedup over the PROJECTED columns only: carried
+                # sort-only keys must not make equal rows distinct
+                key = tuple(sorted(
+                    (k, repr(v)) for k, v in r.items()
+                    if projected is None or k in projected))
                 if key not in seen:
                     seen.add(key)
                     out.append(r)
             rows = out
         for col, desc in reversed(stmt.order_by):
-            rows.sort(key=lambda r, c=col: (r.get(c) is None, r.get(c)),
-                      reverse=desc)
+            # a qualified ORDER BY column (t.col) sorts projected rows
+            # whose output key is the bare name — fall back to it
+            _, bare = self._split_qual(col)
+
+            def _key(r, c=col, b=bare):
+                v = r[c] if c in r else r.get(b)
+                return (v is None, v)
+            rows.sort(key=_key, reverse=desc)
         off = getattr(stmt, "offset", 0)
         if off:
             rows = rows[off:]
