@@ -1,0 +1,39 @@
+-- PG-strict INSERT + ON CONFLICT arbitration + SELECT FOR UPDATE
+-- (reference: PG ON CONFLICT over arbiter indexes and RowMarkType
+-- locks through the YB executor)
+CREATE TABLE kv (k bigint PRIMARY KEY, v bigint, tag text UNIQUE) WITH tablets = 1;
+INSERT INTO kv (k, v, tag) VALUES (1, 10, 'a'), (2, 20, 'b');
+-- plain INSERT is strict: duplicate PK errors
+INSERT INTO kv (k, v, tag) VALUES (1, 99, 'z');
+-- DO NOTHING skips the conflicting row, inserts the fresh one
+INSERT INTO kv (k, v, tag) VALUES (1, 99, 'z'), (3, 30, 'c') ON CONFLICT DO NOTHING;
+SELECT k, v, tag FROM kv ORDER BY k;
+-- DO UPDATE applies SET over the existing row (excluded.* = proposed)
+INSERT INTO kv (k, v, tag) VALUES (1, 99, 'a1') ON CONFLICT (k) DO UPDATE SET v = excluded.v, tag = excluded.tag;
+SELECT k, v, tag FROM kv ORDER BY k;
+-- SET expressions may read the existing row (the counter idiom)
+INSERT INTO kv (k, v, tag) VALUES (2, 5, 'b') ON CONFLICT (k) DO UPDATE SET v = v + excluded.v;
+SELECT v FROM kv WHERE k = 2;
+-- arbitrating on a UNIQUE column: conflict found via its index
+INSERT INTO kv (k, v, tag) VALUES (9, 1, 'c') ON CONFLICT (tag) DO UPDATE SET v = 31;
+SELECT k, v, tag FROM kv ORDER BY k;
+-- unique violation still errors when the target does not arbitrate it
+INSERT INTO kv (k, v, tag) VALUES (10, 1, 'c');
+-- RETURNING reports what was actually written
+INSERT INTO kv (k, v, tag) VALUES (1, 77, 'r1') ON CONFLICT (k) DO UPDATE SET v = excluded.v RETURNING k, v, tag;
+INSERT INTO kv (k, v, tag) VALUES (1, 88, 'r2') ON CONFLICT DO NOTHING RETURNING k, v;
+-- the declared arbiter must cover the violated constraint
+INSERT INTO kv (k, v, tag) VALUES (1, 0, 'fresh') ON CONFLICT (tag) DO NOTHING;
+-- DO UPDATE may re-key the row (delete + strict insert)
+INSERT INTO kv (k, v, tag) VALUES (2, 0, 'x') ON CONFLICT (k) DO UPDATE SET k = 20;
+SELECT k, v, tag FROM kv ORDER BY k;
+-- FOR UPDATE: locking reads inside a transaction (lock + latest read)
+BEGIN;
+SELECT v FROM kv WHERE k = 1 FOR UPDATE;
+UPDATE kv SET v = v + 1 WHERE k = 1;
+COMMIT;
+SELECT v FROM kv WHERE k = 1;
+-- FOR UPDATE restrictions match PG
+SELECT count(*) FROM kv FOR UPDATE;
+SELECT k FROM kv UNION SELECT k FROM kv FOR UPDATE;
+DROP TABLE kv;

@@ -164,7 +164,11 @@ class TestReadYourOwnWrites:
                     "INSERT INTO r2 (k, a, b) VALUES (1, 0.0, 5.0)")
                 await s.execute("BEGIN")
                 # partial upsert touches a only; b stays 5 committed
-                await s.execute("INSERT INTO r2 (k, a) VALUES (1, 9.0)")
+                # (PG-strict INSERT requires the explicit ON CONFLICT
+                # form for upsert semantics)
+                await s.execute("INSERT INTO r2 (k, a) VALUES (1, 9.0) "
+                                "ON CONFLICT (k) DO UPDATE "
+                                "SET a = excluded.a")
                 await s.execute("DELETE FROM r2 WHERE b = 5.0")
                 r = await s.execute("SELECT k FROM r2")
                 assert r.rows == [], r.rows

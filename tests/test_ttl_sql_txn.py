@@ -103,7 +103,7 @@ class TestSqlTxn:
                 await s.execute("INSERT INTO a (k, v) VALUES (1, 10), (2, 20)")
                 # trigger status tablet creation + leadership
                 await s.execute("BEGIN")
-                await s.execute("INSERT INTO a (k, v) VALUES (1, 99)")
+                await s.execute("UPDATE a SET v = 99 WHERE k = 1")
                 await s.execute("COMMIT")
                 await mc.wait_for_leaders("system.transactions")
                 await asyncio.sleep(0.3)

@@ -83,9 +83,12 @@ class TestVectorSql:
                     f"SELECT id FROM docs ORDER BY embedding <-> "
                     f"'{tlit}' LIMIT 1")
                 assert r.rows[0]["id"] == 100
-                # overwrite an indexed row: new vector wins
+                # overwrite an indexed row: new vector wins (PG-strict
+                # INSERT needs the explicit upsert form)
                 await s.execute(
-                    f"INSERT INTO docs (id, embedding) VALUES (5, '{tlit}')")
+                    f"INSERT INTO docs (id, embedding) VALUES (5, '{tlit}') "
+                    f"ON CONFLICT (id) DO UPDATE "
+                    f"SET embedding = excluded.embedding")
                 r = await s.execute(
                     f"SELECT id FROM docs ORDER BY embedding <-> "
                     f"'{tlit}' LIMIT 2")

@@ -134,15 +134,32 @@ async def build_index_ops(ct, table: str, ops, getter):
                                      new_row))
                 ins_undo.append(RowOp("delete", {
                     col: op.row[col]} if unique else new_row))
-        # inserts BEFORE deletes, as separate batches: a unique UPDATE
-        # moving a value (delete old + insert new) must fail on the
-        # duplicate check before the delete lands — a single batch
-        # splits across index tablets and could apply the delete while
-        # the insert is rejected, silently un-indexing the old value
-        if ins_ops:
-            out.append((index_name, ins_ops, ins_undo))
+        # Batch ordering within one index:
+        #   1. inserts of values NOT being handed over (fail-fast on a
+        #      real duplicate BEFORE any delete lands — a single mixed
+        #      batch splits across index tablets and could apply the
+        #      delete while the insert is rejected, un-indexing the old
+        #      value),
+        #   2. all deletes,
+        #   3. "handover" inserts — values this same statement is
+        #      RELEASING (a re-keying update moves the value to a new
+        #      base pk): they can only succeed after their delete.
+        if unique:
+            released = {o.row[col] for o in del_ops}
+            safe = [i for i, o in enumerate(ins_ops)
+                    if o.row[col] not in released]
+            hand = [i for i, o in enumerate(ins_ops)
+                    if o.row[col] in released]
+        else:
+            safe, hand = list(range(len(ins_ops))), []
+        if safe:
+            out.append((index_name, [ins_ops[i] for i in safe],
+                        [ins_undo[i] for i in safe]))
         if del_ops:
             out.append((index_name, del_ops, del_undo))
+        if hand:
+            out.append((index_name, [ins_ops[i] for i in hand],
+                        [ins_undo[i] for i in hand]))
     return out
 
 

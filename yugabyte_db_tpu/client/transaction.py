@@ -121,8 +121,16 @@ class YBTransaction:
         commits."""
         assert self.state == PENDING, f"txn is {self.state}"
         ct = await self.client._table(table)
-        if not ct.indexes:
+        has_insert = any(op.kind == "insert" for op in ops)
+        if not ct.indexes and not (has_insert and len(ops) > 1):
+            # single-part statement: its one batch is atomic per tablet
+            # and a cross-tablet 'insert' cannot half-fail with one op
             return await self._write_rows(table, ops, ct)
+        # multi-part statement (index maintenance and/or a multi-row
+        # strict insert that fans out per tablet): run under an
+        # implicit subtransaction so a mid-statement failure — e.g. a
+        # unique violation AFTER sibling intents were written — prunes
+        # exactly this statement's intents (PG's per-statement subtxn)
         from .client import build_index_ops
         sp = f"__stmt_{self._next_sub}"
         self.savepoint(sp)

@@ -659,21 +659,178 @@ class SqlSession:
             self._coerce_decimals(dec_cols, row)
             rows.append(row)
         await self._check_foreign_keys(ct, rows)
-        if self._txn is not None:
-            n = await self._txn.insert(stmt.table, rows)
-        elif stmt.ttl_ms:
-            from ..docdb.operations import RowOp
-            n = await self.client.write(
-                stmt.table, [RowOp("upsert", r, ttl_ms=stmt.ttl_ms)
-                             for r in rows])
+        oc = getattr(stmt, "on_conflict", None)
+        if oc is not None:
+            n, written = await self._insert_on_conflict(ct, stmt, rows,
+                                                        oc)
         else:
-            n = await self.client.insert(stmt.table, rows)
+            # PG semantics: plain INSERT is STRICT — an existing PK (or
+            # unique value, via the index write path) raises duplicate
+            # key instead of silently upserting (reference: PG INSERT
+            # through the YB executor; upserts are the explicit
+            # ON CONFLICT DO UPDATE form)
+            ops = [RowOp("insert", r, ttl_ms=stmt.ttl_ms)
+                   for r in rows]
+            if self._txn is not None:
+                n = await self._txn.write(stmt.table, ops)
+            elif len(ops) == 1:
+                n = await self.client.write(stmt.table, ops)
+            else:
+                # statement atomicity without a txn: a multi-row batch
+                # fans out per tablet, and one tablet's DUPLICATE must
+                # not leave sibling rows applied — write sequentially
+                # and compensate (each applied row was verifiably
+                # fresh, so deleting it restores the pre-statement
+                # state)
+                done = []
+                try:
+                    for op in ops:
+                        await self.client.write(stmt.table, [op])
+                        done.append(op)
+                except Exception:
+                    pk_names = [c.name for c in
+                                ct.info.schema.key_columns]
+                    for op in reversed(done):
+                        try:
+                            await self.client.delete(
+                                stmt.table,
+                                [{k: op.row[k] for k in pk_names}])
+                        except Exception:   # noqa: BLE001
+                            pass            # best-effort compensation
+                    raise
+                n = len(done)
+            written = rows
         if getattr(stmt, "returning", None):
             return SqlResult(
-                self._returning_rows(stmt.returning, rows,
+                self._returning_rows(stmt.returning, written,
                                      ct.info.schema),
                 f"INSERT {n}")
         return SqlResult([], f"INSERT {n}")
+
+    async def _insert_on_conflict(self, ct, stmt, rows, oc):
+        """INSERT ... ON CONFLICT (reference: PG ON CONFLICT over
+        arbiter indexes; the arbiter here is the PK or a unique-indexed
+        target column).  Each row tries a strict insert; on
+        DUPLICATE_KEY the arbiter is checked — a conflict the target
+        does NOT cover re-raises (PG: the arbiter must infer the
+        violated constraint) — then DO NOTHING skips the row and
+        DO UPDATE applies the SET expressions over the EXISTING row
+        with `excluded.col` resolving to the proposed value.  Returns
+        (applied_count, final_rows) so RETURNING reports what was
+        actually written."""
+        from ..rpc.messenger import RpcError
+        schema = ct.info.schema
+        pk_names = [c.name for c in schema.key_columns]
+        target = oc[1]
+        if oc[0] == "update" and target is None:
+            raise ValueError(
+                "ON CONFLICT DO UPDATE requires a conflict target "
+                "(column)")
+
+        async def write(ops):
+            if self._txn is not None:
+                return await self._txn.write(stmt.table, ops)
+            return await self.client.write(stmt.table, ops)
+
+        async def get(pk_row):
+            if self._txn is not None:
+                return await self._txn.get(stmt.table, pk_row)
+            return await self.client.get(stmt.table, pk_row)
+
+        applied = 0
+        final_rows = []
+        for r in rows:
+            try:
+                await write([RowOp("insert", r, ttl_ms=stmt.ttl_ms)])
+                applied += 1
+                final_rows.append(r)
+                continue
+            except RpcError as e:
+                if e.code != "DUPLICATE_KEY":
+                    raise
+                dup_err = e
+            kind, existing = await self._conflict_row(ct, r, get)
+            if existing is None:
+                # the conflicting row vanished between the failed
+                # insert and the lookup — retry the insert once
+                await write([RowOp("insert", r, ttl_ms=stmt.ttl_ms)])
+                applied += 1
+                final_rows.append(r)
+                continue
+            if target is not None and kind != target:
+                # the violated constraint is not the declared arbiter
+                raise dup_err
+            if oc[0] == "nothing":
+                continue
+            merged = dict(existing)
+            idrow = {c.id: existing.get(c.name) for c in schema.columns}
+            from ..docdb.operations import eval_expr_py as _eval
+            for name, e in oc[2].items():
+                schema.column_by_name(name)     # unknown SET target
+                e2 = self._subst_excluded(e, r)
+                v = _eval(
+                    self._bind(await self._resolve_subqueries(e2),
+                               schema), idrow)
+                merged[name] = v
+            if any(merged[k] != existing[k] for k in pk_names):
+                # SET moved the primary key: PG performs the re-keying
+                # update — delete the old row, strict-insert the new
+                # key (a collision there errors, as in PG)
+                await write([RowOp("delete",
+                                   {k: existing[k] for k in pk_names}),
+                             RowOp("insert", merged,
+                                   ttl_ms=stmt.ttl_ms)])
+            else:
+                await write([RowOp("upsert", merged,
+                                   ttl_ms=stmt.ttl_ms)])
+            applied += 1
+            final_rows.append(merged)
+        return applied, final_rows
+
+    async def _conflict_row(self, ct, row, get):
+        """(conflicting column name, existing row|None) for the
+        constraint a strict insert collided with: the PK (name = the
+        single pk column) or a unique-indexed column.  Inside a
+        transaction the conflict may be the txn's OWN uncommitted
+        write, which the committed-snapshot index lookup misses — the
+        client-side write set is searched too."""
+        schema = ct.info.schema
+        pk_names = [c.name for c in schema.key_columns]
+        if all(n in row for n in pk_names):
+            got = await get({n: row[n] for n in pk_names})
+            if got is not None:
+                return (pk_names[0] if len(pk_names) == 1 else
+                        tuple(pk_names)), got
+        pend = (self._txn.pending_writes(ct.info.name)
+                if self._txn is not None else {})
+        for index_name, spec in (ct.indexes or {}).items():
+            col = spec["column"]
+            if not spec.get("unique") or row.get(col) is None:
+                continue
+            for op in pend.values():
+                if op.kind != "delete" \
+                        and op.row.get(col) == row[col]:
+                    full = await get({n: op.row[n] for n in pk_names})
+                    return col, (full if full is not None
+                                 else dict(op.row))
+            pks = await self.client.index_lookup(
+                ct.info.name, index_name, row[col])
+            if pks:
+                got = await get(pks[0])
+                if got is not None:
+                    return col, got
+        return None, None
+
+    def _subst_excluded(self, node, proposed: dict):
+        """Replace excluded.col refs in an ON CONFLICT SET expression
+        with the proposed row's value as a constant."""
+        if not isinstance(node, tuple):
+            return node
+        if node[0] == "col" and isinstance(node[1], str) \
+                and node[1].lower().startswith("excluded."):
+            return ("const", proposed.get(node[1][9:]))
+        return tuple(self._subst_excluded(x, proposed)
+                     if isinstance(x, tuple) else x for x in node)
 
     async def _check_foreign_keys(self, ct, rows) -> None:
         """FK-lite: REFERENCES enforced as an existence check inside
@@ -930,6 +1087,15 @@ class SqlSession:
                     dataclasses.replace(stmt, ctes={}))
             finally:
                 self._cte_rows = saved
+        if getattr(stmt, "for_update", False) and (
+                getattr(stmt, "joins", None) or stmt.group_by
+                or stmt.distinct
+                or any(it[0] in ("agg", "window") for it in stmt.items)
+                or stmt.knn is not None or stmt.table is None):
+            # PG restricts row locking to plain row-returning scans
+            raise ValueError(
+                "FOR UPDATE is not allowed with joins, aggregates, "
+                "GROUP BY, DISTINCT, or window functions")
         if stmt.where is not None:
             stmt.where = await self._resolve_subqueries(stmt.where)
         for i, it in enumerate(stmt.items):
@@ -1052,15 +1218,19 @@ class SqlSession:
         columns = self._needed_columns(stmt, schema)
         natural = self._natural_order(ct, stmt.order_by)
         has_window = any(it[0] == "window" for it in stmt.items)
+        for_update = getattr(stmt, "for_update", False) \
+            and self._txn is not None
         push_limit = (stmt.limit
-                      if not (stmt.distinct or stmt.offset or has_window)
+                      if not (stmt.distinct or stmt.offset or has_window
+                              or for_update)
                       and (natural or not stmt.order_by) else None)
-        if self._txn is not None and \
-                self._txn.pending_writes(stmt.table):
-            # the write-set overlay needs pk columns to match rows and
-            # WHERE columns to re-evaluate merged rows; and a pushed
-            # LIMIT would undercount once the overlay drops rows
-            # (_order_limit still applies the limit client-side)
+        if for_update or (self._txn is not None
+                          and self._txn.pending_writes(stmt.table)):
+            # the write-set overlay (and FOR UPDATE's per-row locking)
+            # needs pk columns to match rows and WHERE columns to
+            # re-evaluate merged rows; and a pushed LIMIT would
+            # undercount once the overlay drops rows (_order_limit
+            # still applies the limit client-side)
             columns = self._overlay_columns(columns, schema, where)
             push_limit = None
         req = ReadRequest("", columns=tuple(columns), where=where,
@@ -1071,6 +1241,27 @@ class SqlSession:
         if self._txn is not None:
             base_rows = self._overlay_txn_writes(
                 stmt.table, schema, where, base_rows)
+        if for_update:
+            # SELECT ... FOR UPDATE: lock each matched row exclusively
+            # and re-read its LATEST committed version; rows that no
+            # longer satisfy the WHERE after the lock drop out — PG's
+            # EvalPlanQual recheck (reference: RowMarkType row locks
+            # through pggate + docdb intents)
+            pk_names = [c.name for c in schema.key_columns]
+            locked = []
+            for r in base_rows:
+                fresh = await self._txn.get(
+                    stmt.table, {n: r[n] for n in pk_names},
+                    for_update=True)
+                if fresh is None:
+                    continue
+                if where is not None:
+                    idrow = {c.id: fresh.get(c.name)
+                             for c in schema.columns}
+                    if eval_expr_py(where, idrow) is not True:
+                        continue
+                locked.append(fresh)
+            base_rows = locked
         if has_window:
             self._apply_windows(stmt, base_rows)
         rows = [self._project_row(stmt, r, schema) for r in base_rows]
@@ -1886,10 +2077,20 @@ class SqlSession:
             if projected is not None:
                 # PG rule: for SELECT DISTINCT, ORDER BY expressions
                 # must appear in the select list — otherwise the sort
-                # key of a deduplicated row is ill-defined
+                # key of a deduplicated row is ill-defined.  An ORDER
+                # BY naming the SOURCE column of an aliased item
+                # (SELECT a AS x ... ORDER BY a) matches the select
+                # list in PG, so source columns count as projected.
+                sources = set()
+                for it in stmt.items:
+                    if it[0] == "col":
+                        sources.add(it[1])
+                        sources.add(self._split_qual(it[1])[1])
                 for col, _d in stmt.order_by:
                     _, bare = self._split_qual(col)
-                    if col not in projected and bare not in projected:
+                    if col not in projected and bare not in projected \
+                            and col not in sources \
+                            and bare not in sources:
                         raise ValueError(
                             "for SELECT DISTINCT, ORDER BY expressions "
                             "must appear in the select list")

@@ -177,6 +177,12 @@ class InsertStmt:
     ttl_ms: Optional[int] = None
     select: Optional["SelectStmt"] = None   # INSERT INTO ... SELECT
     returning: Optional[List[str]] = None   # column names or ["*"]
+    # ON CONFLICT clause (reference: PG ON CONFLICT / YB upsert paths):
+    # None = plain strict insert (duplicate PK/unique errors);
+    # ("nothing", target_col|None) = DO NOTHING;
+    # ("update", target_col|None, {col: expr}) = DO UPDATE SET — exprs
+    # may reference existing columns and excluded.col (proposed row)
+    on_conflict: Optional[tuple] = None
 
 
 @dataclass
@@ -233,6 +239,9 @@ class SelectStmt:
     # FROM generate_series(lo, hi[, step]): (lo, hi, step) — the rows
     # materialize client-side (PG set-returning function)
     series: Optional[Tuple[int, int, int]] = None
+    # SELECT ... FOR UPDATE: lock the read set exclusively (reference:
+    # row locks via docdb intents, the pggate RowMarkType plumbing)
+    for_update: bool = False
 
 
 @dataclass
@@ -412,6 +421,16 @@ class Parser:
                 left.limit = int(self.next()[1])
             if self.accept_kw("offset"):
                 left.offset = int(self.next()[1])
+
+            def _has_for_update(node):
+                if isinstance(node, SetOpStmt):
+                    return (_has_for_update(node.left)
+                            or _has_for_update(node.right))
+                return getattr(node, "for_update", False)
+            if _has_for_update(left):
+                raise ValueError(
+                    "FOR UPDATE is not allowed with "
+                    "UNION/INTERSECT/EXCEPT")
         return left
 
     def _intersect_expr(self):
@@ -771,8 +790,42 @@ class Parser:
         if self.accept_kw("using"):
             self.expect_kw("ttl")
             ttl_ms = int(float(self.next()[1]) * 1000)   # seconds -> ms
+        on_conflict = self._on_conflict()
         return InsertStmt(table, cols, rows, ttl_ms,
-                          returning=self._returning())
+                          returning=self._returning(),
+                          on_conflict=on_conflict)
+
+    def _on_conflict(self):
+        """[ON CONFLICT [(col)] DO NOTHING | DO UPDATE SET c = expr...]
+        (reference: PG ON CONFLICT over arbiter indexes; ours arbitrates
+        on the PK or a unique-indexed target column)."""
+        if not self.accept_kw("on"):
+            return None
+        t = self.next()
+        if t[1].lower() != "conflict":
+            raise ValueError("expected CONFLICT after ON")
+        target = None
+        if self.accept_op("("):
+            target = self.ident()
+            self.expect_op(")")
+        t = self.next()
+        if t[1].lower() != "do":
+            raise ValueError("expected DO in ON CONFLICT")
+        if self.accept_kw("update"):
+            self.expect_kw("set")
+            sets = {}
+            while True:
+                name = self.ident()
+                self.expect_op("=")
+                sets[name] = self.expr()
+                if not self.accept_op(","):
+                    break
+            return ("update", target, sets)
+        t = self.next()
+        if t[1].lower() != "nothing":
+            raise ValueError(
+                "expected NOTHING or UPDATE in ON CONFLICT DO")
+        return ("nothing", target)
 
     def txn_stmt(self):
         t = self.next()[1].lower()
@@ -1015,9 +1068,14 @@ class Parser:
         offset = 0
         if self.accept_kw("offset"):
             offset = int(self.next()[1])
+        for_update = False
+        if self.accept_kw("for"):
+            self.expect_kw("update")
+            for_update = True
         return SelectStmt(table, items, where, group, order, limit, knn,
                           distinct, offset, joins, having, aliases,
-                          table_alias=table_alias, series=series)
+                          table_alias=table_alias, series=series,
+                          for_update=for_update)
 
     # clause starters that must not be eaten as a table alias
     _ALIAS_STOP = frozenset((
