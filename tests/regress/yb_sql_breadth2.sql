@@ -1,0 +1,17 @@
+-- round-5 SQL breadth: TRUNCATE / COUNT(DISTINCT) / ILIKE / NULLS
+CREATE TABLE w (k bigint PRIMARY KEY, grp text, v bigint) WITH tablets = 2;
+INSERT INTO w (k, grp, v) VALUES (1, 'A', 5), (2, 'a', 5), (3, 'b', 7), (4, 'A', NULL), (5, 'b', 5);
+SELECT count(distinct v) FROM w;
+SELECT count(distinct grp) FROM w;
+SELECT grp, count(distinct v) FROM w GROUP BY grp ORDER BY grp;
+SELECT k FROM w WHERE grp ILIKE 'a%' ORDER BY k;
+SELECT grp FROM w WHERE grp LIKE 'a%' ORDER BY k;
+SELECT k FROM w ORDER BY v ASC NULLS LAST, k LIMIT 3;
+SELECT k FROM w ORDER BY v DESC NULLS FIRST LIMIT 2;
+-- non-default NULLS ordering is rejected, not silently wrong
+SELECT k FROM w ORDER BY v ASC NULLS FIRST;
+TRUNCATE TABLE w;
+SELECT count(*) FROM w;
+INSERT INTO w (k, grp, v) VALUES (10, 'fresh', 1);
+SELECT k, grp FROM w;
+DROP TABLE w;

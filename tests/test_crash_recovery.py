@@ -121,3 +121,96 @@ class TestRandomizedWithTtl:
                 assert got.rows and got.rows[0]["v"] == ent[1], f"k={k}"
             else:
                 assert not got.rows, f"k={k} should be gone"
+
+
+class TestTruncateRecovery:
+    def test_truncate_replays_after_sigkill(self, tmp_path):
+        """The Raft-replicated truncate survives a crash: replay must
+        not resurrect pre-truncate rows (the manifest persists the
+        empty SST set atomically and the flushed frontier advances to
+        the truncate op)."""
+        async def go():
+            from yugabyte_db_tpu.docdb import ReadRequest
+            from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+            from tests.test_load_balancer import kv_info
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                c = mc.client()
+                await c.create_table(kv_info(), num_tablets=2)
+                await mc.wait_for_leaders("kv")
+                await c.insert("kv", [{"k": i, "v": float(i)}
+                                      for i in range(100)])
+                # flush some of it so SST deletion is exercised too
+                for p in mc.tservers[0].peers.values():
+                    p.tablet.flush()
+                await c.insert("kv", [{"k": 1000 + i, "v": 0.0}
+                                      for i in range(20)])
+                await c.truncate_table("kv")
+                rows = (await c.scan("kv", ReadRequest(""))).rows
+                assert rows == []
+                await c.insert("kv", [{"k": 7, "v": 7.0}])
+                await mc.restart_tserver(0)
+                await mc.wait_for_leaders("kv")
+                rows = (await c.scan("kv", ReadRequest(""))).rows
+                assert [(r["k"], r["v"]) for r in rows] == [(7, 7.0)]
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())
+
+    def test_truncate_discards_inflight_compaction_output(self, tmp_path):
+        """A compaction that snapshotted its inputs before TRUNCATE
+        must not install its merged output afterward (it would
+        resurrect every truncated row); a flush whose frozen memtable
+        was truncated away likewise discards its SST."""
+        import os
+        from yugabyte_db_tpu.storage.lsm import LsmStore, WriteBatch
+        from yugabyte_db_tpu.storage.sst import SstWriter
+        st = LsmStore(str(tmp_path / "s"), name="regular")
+        for i in range(3):
+            b = WriteBatch()
+            for j in range(50):
+                b.put(b"k%02d%02d" % (i, j), b"v")
+            st.apply(b)
+            st.flush()
+        _, ssts = st.read_snapshot()
+        inputs = list(ssts)
+        st.truncate()
+        path = st._new_sst_path()
+        w = SstWriter(path)
+        w.add(b"resurrected", b"x")
+        w.finish()
+        st.replace_ssts(inputs, path)
+        _, ssts = st.read_snapshot()
+        assert len(ssts) == 0
+        assert not os.path.exists(path)
+
+    def test_concurrent_on_conflict_increments_lose_nothing(self,
+                                                            tmp_path):
+        """ON CONFLICT DO UPDATE locks the conflicting row: concurrent
+        `SET v = v + 1` statements serialize (PG semantics), no lost
+        updates."""
+        async def go():
+            from yugabyte_db_tpu.ql.executor import SqlSession
+            from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                c = mc.client()
+                s0 = SqlSession(c)
+                await s0.execute("CREATE TABLE ci (k bigint PRIMARY "
+                                 "KEY, v bigint) WITH tablets = 1")
+                await s0.execute("INSERT INTO ci (k, v) VALUES (1, 0)")
+                await c.messenger.call(mc.master.messenger.addr,
+                                       "master", "get_status_tablet", {})
+                await mc.wait_for_leaders("system.transactions")
+
+                async def incr():
+                    s = SqlSession(c)
+                    await s.execute(
+                        "INSERT INTO ci (k, v) VALUES (1, 1) "
+                        "ON CONFLICT (k) DO UPDATE SET v = v + 1")
+                await asyncio.gather(*[incr() for _ in range(8)])
+                r = await s0.execute("SELECT v FROM ci WHERE k = 1")
+                assert r.rows[0]["v"] == 8
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())

@@ -464,6 +464,27 @@ class YBClient:
                 await self._undo_index_ops(index_undo)
             raise
 
+    async def truncate_table(self, table: str) -> int:
+        """TRUNCATE: Raft-replicated per-tablet store drop, fanned out
+        to every tablet leader (reference: TRUNCATE through the tablet
+        service; non-transactional like the reference's).  Secondary
+        indexes truncate with the base table."""
+        ct = await self._table(table)
+
+        async def go(ct_):
+            async def one(loc):
+                await self._call_leader(
+                    ct_, loc.tablet_id, "truncate_tablet",
+                    {"tablet_id": loc.tablet_id,
+                     "table_id": ct_.info.table_id})
+            await asyncio.gather(*[one(l) for l in ct_.locations])
+            return len(ct_.locations)
+
+        n = await self._retry_on_split(table, go)
+        for index_name in (ct.indexes or {}):
+            await self.truncate_table(index_name)
+        return n
+
     async def insert(self, table: str, rows: Sequence[dict]) -> int:
         return await self.write(table, [RowOp("upsert", r) for r in rows])
 

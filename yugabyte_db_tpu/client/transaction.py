@@ -140,11 +140,18 @@ class YBTransaction:
                 ict = await self.client._table(index_name)
                 await self._write_rows(index_name, idx_ops, ict)
             n = await self._write_rows(table, ops, ct)
-        except RpcError as e:
-            if self.state == PENDING and e.code not in ("ABORTED",
-                                                        "DEADLOCK"):
-                await self.rollback_to(sp)
-                self.release_savepoint(sp)
+        except Exception as e:   # noqa: BLE001 — any failure mode must
+            # roll the statement back (transport timeouts included: a
+            # ghost index intent from a half-written statement would
+            # otherwise commit with the txn)
+            code = getattr(e, "code", None)
+            if self.state == PENDING and code not in ("ABORTED",
+                                                      "DEADLOCK"):
+                try:
+                    await self.rollback_to(sp)
+                    self.release_savepoint(sp)
+                except Exception:   # noqa: BLE001 — rollback_to aborts
+                    pass            # the txn itself on failure
             raise
         self.release_savepoint(sp)
         return n

@@ -309,7 +309,7 @@ def eval_expr_py(node: tuple, row: Dict[int, object]):
         return x in vals
     if kind == "isnull":
         return eval_expr_py(node[1], row) is None
-    if kind == "like":
+    if kind in ("like", "ilike"):
         import re as _re
         v = eval_expr_py(node[1], row)
         if v is None:
@@ -318,7 +318,9 @@ def eval_expr_py(node: tuple, row: Dict[int, object]):
             "_", ".") + "$"
         # note: escape() escaped % and _ as literals? re.escape leaves %
         # and _ unescaped in Python 3.7+, so the replace above is correct
-        return _re.match(pat, str(v)) is not None
+        return _re.match(pat, str(v),
+                         _re.IGNORECASE if kind == "ilike" else 0) \
+            is not None
     if kind == "array":
         # ARRAY[...] with non-constant elements; NULL elements kept
         return [eval_expr_py(a, row) for a in node[1:]]
@@ -1259,14 +1261,15 @@ class DocReadOperation:
                 return ("in", x, codes)
             # generic walk must not treat the VALUES list as a node
             return ("in", cls._rewrite_strings(x, dicts), vals)
-        if kind == "like":
+        if kind in ("like", "ilike"):
             x, pattern = node[1], node[2]
             if not is_dict_col(x):
                 raise cls._Unrewritable(node)
             import re as _re
             pat = _re.compile(
                 "^" + _re.escape(pattern).replace("%", ".*")
-                .replace("_", ".") + "$")
+                .replace("_", ".") + "$",
+                _re.IGNORECASE if kind == "ilike" else 0)
             d = dicts[x[1]]
             lut = [1 if pat.match(s) else 0 for s in d]
             return ("dictlut", x, lut)

@@ -70,7 +70,8 @@ class Expr:
 
 
 _DEVICE_NODE_KINDS = {"col", "const", "cmp", "arith", "and", "or", "not",
-                      "between", "in", "isnull", "like", "dictlut"}
+                      "between", "in", "isnull", "like", "ilike",
+                      "dictlut"}
 
 
 def device_compatible(node: ExprNode) -> bool:
@@ -90,7 +91,7 @@ def device_compatible(node: ExprNode) -> bool:
         if len(node[2]) > 64:
             return False
         return device_compatible(node[1])
-    if node[0] == "like":
+    if node[0] in ("like", "ilike"):
         return isinstance(node[1], (tuple, list)) and \
             device_compatible(node[1])
     if node[0] == "arith" and node[1] not in _ARITH:
@@ -365,7 +366,7 @@ def referenced_columns(node: ExprNode, out: set | None = None) -> set:
     out = out if out is not None else set()
     if node[0] == "col":
         out.add(node[1])
-    elif node[0] in ("in", "like", "dictlut"):
+    elif node[0] in ("in", "like", "ilike", "dictlut"):
         referenced_columns(node[1], out)
     elif node[0] == "json":
         referenced_columns(node[2], out)

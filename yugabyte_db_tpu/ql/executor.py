@@ -29,8 +29,8 @@ from .parser import (
     AlterTableStmt, AnalyzeStmt, CreateIndexStmt, CreateSequenceStmt,
     CreateTableStmt, CreateTablespaceStmt, CreateViewStmt, DeleteStmt,
     DropSequenceStmt, DropTableStmt, DropTablespaceStmt, DropViewStmt,
-    ExplainStmt, InsertStmt, SelectStmt, SetOpStmt, TxnStmt, UpdateStmt,
-    parse_statement,
+    ExplainStmt, InsertStmt, SelectStmt, SetOpStmt, TruncateStmt,
+    TxnStmt, UpdateStmt, parse_statement,
 )
 
 _TYPE_MAP = {
@@ -202,6 +202,14 @@ class SqlSession:
             return await self._explain(stmt.inner)
         if isinstance(stmt, AnalyzeStmt):
             return await self._analyze(stmt)
+        if isinstance(stmt, TruncateStmt):
+            if self._txn is not None:
+                raise ValueError(
+                    "TRUNCATE cannot run inside a transaction here "
+                    "(non-MVCC store drop, like the reference's)")
+            self._invalidate_stats(stmt.table)
+            await self.client.truncate_table(stmt.table)
+            return SqlResult([], "TRUNCATE TABLE")
         if isinstance(stmt, SetOpStmt):
             return await self._set_op(stmt)
         if isinstance(stmt, SelectStmt):
@@ -676,17 +684,22 @@ class SqlSession:
             elif len(ops) == 1:
                 n = await self.client.write(stmt.table, ops)
             else:
-                # statement atomicity without a txn: a multi-row batch
-                # fans out per tablet, and one tablet's DUPLICATE must
-                # not leave sibling rows applied — write sequentially
-                # and compensate (each applied row was verifiably
-                # fresh, so deleting it restores the pre-statement
-                # state)
-                done = []
+                # statement atomicity without a txn: one fan-out batch
+                # could apply some tablets and reject another — write
+                # per-TABLET batches sequentially (a tablet batch is
+                # atomic server-side: the insert gate rejects it whole)
+                # and compensate applied batches on failure (each
+                # applied row was verifiably fresh, so deleting it
+                # restores the pre-statement state)
+                by_tablet: Dict[str, list] = {}
+                for op in ops:
+                    loc = self.client._tablet_for_key(ct, op.row)
+                    by_tablet.setdefault(loc.tablet_id, []).append(op)
+                done: list = []
                 try:
-                    for op in ops:
-                        await self.client.write(stmt.table, [op])
-                        done.append(op)
+                    for tops in by_tablet.values():
+                        await self.client.write(stmt.table, tops)
+                        done.extend(tops)
                 except Exception:
                     pk_names = [c.name for c in
                                 ct.info.schema.key_columns]
@@ -762,30 +775,61 @@ class SqlSession:
                 raise dup_err
             if oc[0] == "nothing":
                 continue
-            merged = dict(existing)
-            idrow = {c.id: existing.get(c.name) for c in schema.columns}
-            from ..docdb.operations import eval_expr_py as _eval
-            for name, e in oc[2].items():
-                schema.column_by_name(name)     # unknown SET target
-                e2 = self._subst_excluded(e, r)
-                v = _eval(
-                    self._bind(await self._resolve_subqueries(e2),
-                               schema), idrow)
-                merged[name] = v
-            if any(merged[k] != existing[k] for k in pk_names):
-                # SET moved the primary key: PG performs the re-keying
-                # update — delete the old row, strict-insert the new
-                # key (a collision there errors, as in PG)
-                await write([RowOp("delete",
-                                   {k: existing[k] for k in pk_names}),
-                             RowOp("insert", merged,
-                                   ttl_ms=stmt.ttl_ms)])
-            else:
-                await write([RowOp("upsert", merged,
-                                   ttl_ms=stmt.ttl_ms)])
+            merged = await self._apply_do_update(ct, stmt, r, existing,
+                                                 oc[2])
             applied += 1
             final_rows.append(merged)
         return applied, final_rows
+
+    async def _apply_do_update(self, ct, stmt, r, existing, sets):
+        """The DO UPDATE arm, with PG's row-lock semantics: the
+        conflicting row is locked FOR UPDATE, the SET expressions
+        evaluate over its LATEST version, and the write rides the same
+        transaction — concurrent `SET v = v + excluded.v` statements
+        serialize instead of losing updates.  Autocommit statements
+        open an internal single-statement transaction (which also
+        makes a PK-moving update's delete+insert atomic); inside an
+        explicit txn the row is locked in place."""
+        from ..docdb.operations import eval_expr_py as _eval
+        schema = ct.info.schema
+        pk_names = [c.name for c in schema.key_columns]
+        pk_row = {k: existing[k] for k in pk_names}
+        own_txn = None
+        txn = self._txn
+        if txn is None:
+            own_txn = txn = await self.client.transaction().begin()
+        try:
+            locked = await txn.get(stmt.table, pk_row, for_update=True)
+            if locked is None:
+                locked = dict(existing)   # vanished: treat pre-image
+            merged = dict(locked)
+            idrow = {c.id: locked.get(c.name) for c in schema.columns}
+            for name, e in sets.items():
+                schema.column_by_name(name)     # unknown SET target
+                e2 = self._subst_excluded(e, r)
+                merged[name] = _eval(
+                    self._bind(await self._resolve_subqueries(e2),
+                               schema), idrow)
+            if any(merged[k] != locked.get(k) for k in pk_names):
+                # SET moved the primary key: PG performs the re-keying
+                # update — delete the old row, strict-insert the new
+                # key (one txn: atomic; a collision there errors)
+                await txn.write(stmt.table, [
+                    RowOp("delete", pk_row),
+                    RowOp("insert", merged, ttl_ms=stmt.ttl_ms)])
+            else:
+                await txn.write(stmt.table, [
+                    RowOp("upsert", merged, ttl_ms=stmt.ttl_ms)])
+            if own_txn is not None:
+                await own_txn.commit()
+            return merged
+        except BaseException:
+            if own_txn is not None:
+                try:
+                    await own_txn.abort()
+                except Exception:   # noqa: BLE001
+                    pass
+            raise
 
     async def _conflict_row(self, ct, row, get):
         """(conflicting column name, existing row|None) for the
@@ -887,8 +931,8 @@ class SqlSession:
             return ("const", int(_time.time() * 1_000_000))
         if kind == "in":
             return ("in", self._bind(node[1], schema), node[2])
-        if kind == "like":
-            return ("like", self._bind(node[1], schema), node[2])
+        if kind in ("like", "ilike"):
+            return (kind, self._bind(node[1], schema), node[2])
         if kind == "json":
             return ("json", node[1], self._bind(node[2], schema), node[3])
         return (kind,) + tuple(
@@ -1174,7 +1218,8 @@ class SqlSession:
         if (agg_items or getattr(stmt, "having", None) is not None) \
                 and not stmt.group_by:
             refs = self._having_refs(stmt)
-            exotic = any(it[1] == "array_agg" for it in agg_items)
+            exotic = any(it[1] in ("array_agg", "count_distinct")
+                         for it in agg_items)
             if exotic or (self._txn is not None
                           and self._txn.pending_writes(stmt.table)):
                 return await self._scalar_agg_clientside(
@@ -1193,7 +1238,8 @@ class SqlSession:
 
         if stmt.group_by and (
                 agg_items or getattr(stmt, "having", None) is not None):
-            if any(it[1] == "array_agg" for it in agg_items) or (
+            if any(it[1] in ("array_agg", "count_distinct")
+                   for it in agg_items) or (
                     self._txn is not None
                     and self._txn.pending_writes(stmt.table)):
                 # read-your-own-writes (grouped pushdown results can't
@@ -2151,7 +2197,8 @@ class SqlSession:
                 out[name] = (v if v is None
                              or isinstance(v, (decimal.Decimal, list))
                              else
-                             int(v) if op == "count" else float(v))
+                             int(v) if op in ("count", "count_distinct")
+                             else float(v))
                 vi += 1
         return out
 
@@ -2686,6 +2733,8 @@ def _agg_name(it) -> str:
 def _init(op):
     if op == "array_agg":
         return []
+    if op == "count_distinct":
+        return set()
     return 0 if op in ("sum", "count") else None
 
 
@@ -2697,6 +2746,9 @@ def _step(op, expr, state, idrow):
         state.append(v)     # PG array_agg keeps NULL elements
         return state
     if v is None:
+        return state
+    if op == "count_distinct":
+        state.add(v if not isinstance(v, list) else tuple(v))
         return state
     if op == "count":
         return (state or 0) + 1
@@ -2716,6 +2768,8 @@ def _final(op, state):
         if not state or state[1] == 0:
             return None
         return state[0] / state[1]
+    if op == "count_distinct":
+        return len(state)
     if op in ("sum", "count"):
         return state or 0
     return state

@@ -38,6 +38,7 @@ KEYWORDS = {
     "false", "array", "any", "all", "extract",
     "union", "intersect", "except", "savepoint", "release", "to",
     "unique", "references", "foreign", "constraint", "for",
+    "truncate", "ilike", "nulls",
 }
 
 # window functions (besides the aggregate ops)
@@ -113,6 +114,13 @@ class AlterTableStmt:
     table: str
     add_columns: List[Tuple[str, str]]
     drop_columns: List[str] = field(default_factory=list)
+
+
+@dataclass
+class TruncateStmt:
+    """TRUNCATE [TABLE] name (reference: tablet truncate through the
+    tablet service — non-transactional, like the reference's)."""
+    table: str
 
 
 @dataclass
@@ -347,6 +355,7 @@ class Parser:
             "rollback": self.txn_stmt, "alter": self.alter_table,
             "analyze": self.analyze, "with": self.with_select,
             "savepoint": self.txn_stmt, "release": self.txn_stmt,
+            "truncate": self.truncate_stmt,
         }.get(word)
         if fn is None:
             raise ValueError(f"unsupported statement {word!r}")
@@ -480,6 +489,11 @@ class Parser:
     def analyze(self):
         self.expect_kw("analyze")
         return AnalyzeStmt(self.ident())
+
+    def truncate_stmt(self):
+        self.expect_kw("truncate")
+        self.accept_kw("table")
+        return TruncateStmt(self.ident())
 
     def create_table(self):
         self.expect_kw("create")
@@ -953,7 +967,11 @@ class Parser:
                     op = self.next()[1].lower()
                     self.expect_op("(")
                     args = []
-                    if self.accept_op("*"):
+                    if op == "count" and self.accept_kw("distinct"):
+                        # COUNT(DISTINCT e): distinct-fold on the host
+                        op = "count_distinct"
+                        expr = self.expr()
+                    elif self.accept_op("*"):
                         expr = None
                     elif self.peek() == ("op", ")"):
                         expr = None           # row_number(), rank()
@@ -1059,6 +1077,19 @@ class Parser:
                     desc = True
                 else:
                     self.accept_kw("asc")
+                if self.accept_kw("nulls"):
+                    which = self.ident().lower()
+                    if which not in ("first", "last"):
+                        raise ValueError(
+                            "expected FIRST or LAST after NULLS")
+                    # PG defaults: NULLS LAST for ASC, FIRST for DESC —
+                    # the engine sorts exactly that way; the
+                    # non-default combinations are not implemented
+                    if (which == "first") != desc:
+                        raise ValueError(
+                            "non-default NULLS ordering is not "
+                            "supported (ASC implies NULLS LAST, "
+                            "DESC implies NULLS FIRST)")
                 order.append((col, desc))
                 if not self.accept_op(","):
                     break
@@ -1201,12 +1232,12 @@ class Parser:
                 return ("anyall", which, opname, left, arr)
             right = self.add_expr()
             return ("cmp", opname, left, right)
-        if t and t[0] == "kw" and t[1].lower() == "like":
-            self.next()
+        if t and t[0] == "kw" and t[1].lower() in ("like", "ilike"):
+            op = self.next()[1].lower()
             pat = self.next()
             if pat[0] != "str":
-                raise ValueError("LIKE pattern must be a string")
-            return ("like", left, pat[1])
+                raise ValueError(f"{op.upper()} pattern must be a string")
+            return (op, left, pat[1])
         if t and t[0] == "kw" and t[1].lower() == "between":
             self.next()
             lo = self.add_expr()

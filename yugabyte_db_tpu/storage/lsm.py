@@ -169,6 +169,15 @@ class LsmStore:
         w.finish()
         TEST_CRASH_POINT("flush:before_manifest")
         with self._lock:
+            if mem not in self._frozen:
+                # a TRUNCATE dropped the frozen memtable while this
+                # flush wrote it out — installing the SST would
+                # resurrect truncated rows
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                return None
             self._ssts.insert(0, SstReader(path, row_decoder=self.row_decoder))
             self._frozen.remove(mem)
             self._struct_gen += 1
@@ -176,6 +185,38 @@ class LsmStore:
                 self._flushed_frontier["op_id"] = frontier["op_id"]
             self._write_manifest()
         return path
+
+    def truncate(self, op_id=None) -> int:
+        """Drop EVERYTHING: memtables, frozen memtables, and SST files
+        (reference: tablet truncate, src/yb/tablet/tablet.cc Truncate —
+        replaces the RocksDB instances wholesale rather than writing
+        tombstones).  The manifest persists the empty state atomically
+        so a crash right after cannot resurrect deleted SSTs, and the
+        flushed frontier advances to the truncate op so WAL replay
+        resumes AFTER it (pre-truncate writes need not replay — their
+        effect is gone either way).  Returns the number of SST files
+        removed."""
+        with self._lock:
+            removed = list(self._ssts)
+            self._mem = MemTable()
+            self._frozen = []
+            self._ssts = []
+            self._mem_frontier = {}
+            self._struct_gen += 1
+            self._write_gen += 1
+            self._snap = None
+            if op_id is not None:
+                self._flushed_frontier["op_id"] = list(op_id)
+            self._write_manifest()
+        n = 0
+        for r in removed:
+            try:
+                r.close() if hasattr(r, "close") else None
+                os.unlink(r.path)
+                n += 1
+            except OSError:
+                pass
+        return n
 
     def ingest_sst(self, build: Callable[[SstWriter], None],
                    frontier: Optional[dict] = None) -> str:
@@ -280,8 +321,20 @@ class LsmStore:
 
     def replace_ssts(self, old: Sequence[SstReader], new_path: str) -> None:
         with self._lock:
-            new_reader = SstReader(new_path, row_decoder=self.row_decoder)
             old_set = {id(r) for r in old}
+            live = {id(r) for r in self._ssts}
+            if not old_set <= live:
+                # the input set changed under the merge — a TRUNCATE
+                # (or competing compaction) removed inputs while the
+                # merge ran off-lock.  Installing the merged output
+                # would resurrect rows the store no longer owns; the
+                # merge result is simply discarded.
+                try:
+                    os.remove(new_path)
+                except OSError:
+                    pass
+                return
+            new_reader = SstReader(new_path, row_decoder=self.row_decoder)
             kept = [r for r in self._ssts if id(r) not in old_set]
             # output is older than anything not in the inputs → append last
             self._ssts = kept + [new_reader]

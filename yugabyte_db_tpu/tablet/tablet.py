@@ -185,6 +185,53 @@ class Tablet:
         return self.clock.now()
 
     # --- maintenance ------------------------------------------------------
+    def truncate_table(self, table_id: str, op_id=None,
+                       ht=None) -> int:
+        """TRUNCATE (reference: tablet truncate, tablet/tablet.cc
+        Truncate — replaces the stores rather than writing tombstones).
+        Dedicated tablets drop the whole regular store in one shot;
+        colocated tablets tombstone only the cotable's key range.
+        Vector indexes over the table reset with it.  Returns rows/SSTs
+        affected (wholesale: SST count; colocated: rows tombstoned)."""
+        codec = self._codec_for(table_id)
+        if table_id == self.info.table_id:
+            # vector indexes only ever cover the tablet's primary table
+            with self._vector_build_lock:
+                self.vector_indexes.clear()
+        if not self.colocated:
+            return self.regular.truncate(op_id=op_id)
+        # colocated: delete the cotable's rows (prefix tombstones at a
+        # fresh HT — MVCC-correct, compaction reclaims)
+        prefix = codec.scan_prefix()
+        from ..dockv.key_encoding import ValueType
+        from ..utils.hybrid_time import (
+            ENCODED_SIZE, DocHybridTime,
+        )
+        from ..storage.lsm import WriteBatch
+        from ..dockv.value import PrimitiveValue
+        mems, ssts = self.regular.read_snapshot()
+        seen = set()
+        from ..utils.hybrid_time import HybridTime as _HT
+        ht = _HT(ht) if ht is not None else self.clock.now()
+        batch = WriteBatch(op_id=op_id)
+        wid = 0
+        for src in list(mems) + list(ssts):
+            it = src.iterate() if hasattr(src, "iterate") else ()
+            for k, _v in it:
+                if not k.startswith(prefix):
+                    continue
+                dk = k[:-(ENCODED_SIZE + 1)]
+                if dk in seen:
+                    continue
+                seen.add(dk)
+                batch.put(dk + bytes([ValueType.kHybridTime])
+                          + DocHybridTime(ht, wid).encode_desc(),
+                          PrimitiveValue.tombstone().encode())
+                wid += 1
+        if batch.entries:
+            self.regular.apply(batch)
+        return len(seen)
+
     def flush(self) -> Optional[str]:
         path = self.regular.flush()
         if path:

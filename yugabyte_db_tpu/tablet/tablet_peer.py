@@ -327,6 +327,13 @@ class TabletPeer:
             self.participant.apply_rollback_entry(entry.payload)
         elif entry.etype == "txn_sub_rollback":
             self.participant.apply_sub_rollback_entry(entry.payload)
+        elif entry.etype == "truncate":
+            d = msgpack.unpackb(entry.payload, raw=False)
+            if d.get("ht"):
+                self.clock.update(HybridTime(d["ht"]))
+            self.tablet.truncate_table(d["table_id"],
+                                       op_id=(entry.term, entry.index),
+                                       ht=d.get("ht"))
         elif entry.etype == "txn_status" and self.coordinator is not None:
             self.coordinator.apply_entry(entry.payload)
         elif entry.etype == "split":
@@ -410,6 +417,33 @@ class TabletPeer:
                 "LEADER_NOT_READY")
         return await self.participant.write_intents(
             req, txn_id, start_ht, status_tablet, op_read_hts, sub_id)
+
+    async def truncate(self, table_id: str):
+        """Raft-replicated TRUNCATE (reference: tablet truncate
+        operation, tablet/operations/truncate_operation.cc): every
+        replica drops the table's data at the same log position.
+        Refused while transactional intents are live on this tablet —
+        truncate is non-MVCC, and yanking rows under an in-flight txn
+        would break its snapshot."""
+        if self.split_done or self.split_requested:
+            raise RpcError("tablet has been split", "TABLET_SPLIT")
+        if not self.consensus.is_leader():
+            raise RpcError(
+                f"not leader (hint={self.consensus.leader_hint()})",
+                "LEADER_NOT_READY")
+        if self.participant.has_foreign_intents():
+            raise RpcError(
+                "cannot TRUNCATE while transactions hold intents on "
+                "this tablet", "TRY_AGAIN")
+        import msgpack as _mp
+        # the tombstone hybrid time is assigned ONCE at replicate time
+        # and carried in the entry: replays and followers must apply at
+        # the SAME ht, or a re-applied truncate would shadow
+        # post-truncate writes (colocated path writes MVCC tombstones)
+        await self.consensus.replicate(
+            "truncate", _mp.packb({"table_id": table_id,
+                                   "ht": self.clock.now().value}),
+            precheck=self.split_fence_check)
 
     async def rollback_sub_txn(self, txn_id: str, from_sub: int):
         """ROLLBACK TO SAVEPOINT on this participant (leader only):

@@ -700,6 +700,13 @@ class TabletServer:
                                  payload.get("sub_id", 0))
         return {"rows_affected": n}
 
+    async def rpc_truncate_tablet(self, payload) -> dict:
+        """Raft-replicated tablet truncate (reference: TruncateRequest
+        through the tablet service)."""
+        peer = self._peer(payload["tablet_id"])
+        await peer.truncate(payload["table_id"])
+        return {"ok": True}
+
     async def rpc_txn_rollback_sub(self, payload) -> dict:
         """ROLLBACK TO SAVEPOINT: prune this participant's intents with
         sub_id >= from_sub (reference: RollbackToSubTransaction,
