@@ -14,4 +14,10 @@ TRUNCATE TABLE w;
 SELECT count(*) FROM w;
 INSERT INTO w (k, grp, v) VALUES (10, 'fresh', 1);
 SELECT k, grp FROM w;
+-- ORDER BY expressions and ordinals
+INSERT INTO w (k, grp, v) VALUES (11, 'Mid', 3), (12, 'zz', 2);
+SELECT upper(grp) FROM w ORDER BY upper(grp);
+SELECT k, grp FROM w ORDER BY 2 DESC, 1;
+SELECT length(grp) AS n, k FROM w ORDER BY length(grp), k;
+SELECT grp FROM w ORDER BY 9;
 DROP TABLE w;

@@ -1098,6 +1098,12 @@ class SqlSession:
                         out.append(t)
         rows = [dict(zip(names, t)) for t in out]
         if stmt.order_by:
+            # resolve ordinal sentinels positionally against the
+            # set-op output columns (PG: ORDER BY 1 = first column)
+            stmt.order_by = [
+                ((names[int(c[6:])] if c.startswith("__ord:")
+                  and int(c[6:]) < len(names) else c), d)
+                for c, d in stmt.order_by]
             for col, desc in reversed(stmt.order_by):
                 if rows and col not in rows[0]:
                     raise ValueError(
@@ -1112,6 +1118,27 @@ class SqlSession:
         return SqlResult(rows)
 
     async def _select(self, stmt: SelectStmt) -> SqlResult:
+        if stmt.order_by and any(
+                c.startswith("__ord:") for c, _ in stmt.order_by):
+            # ORDER BY <ordinal> / ORDER BY <select-list expression>:
+            # the parser encoded the matched item's index; resolve it
+            # to the item's output name ONCE, before any consumer.
+            # Duplicate output names would make the name-keyed sort
+            # read the WRONG item's values — refuse instead.
+            all_names = [self._item_name(stmt, i)
+                         for i in range(len(stmt.items))]
+            resolved = []
+            for c, d in stmt.order_by:
+                if c.startswith("__ord:"):
+                    name = all_names[int(c[6:])]
+                    if all_names.count(name) > 1:
+                        raise ValueError(
+                            f"ORDER BY position refers to output name "
+                            f"{name!r} which is duplicated in the "
+                            f"select list; alias the columns")
+                    c = name
+                resolved.append((c, d))
+            stmt.order_by = resolved
         if stmt.table is not None and not getattr(stmt, "joins", None):
             # single-table FROM with an alias: SELECT e.name FROM emp e
             # — strip the alias/table qualifier everywhere so binding
@@ -2077,9 +2104,12 @@ class SqlSession:
                     self._collect_names(it[2], names)
                 names.update(it[3])
                 names.update(c for c, _ in it[4])
-        alias_names = set(getattr(stmt, "aliases", {}).values())
+        item_names = {self._item_name(stmt, i)
+                      for i in range(len(stmt.items))}
         for col, _ in stmt.order_by:
-            if col not in alias_names:   # aliases exist post-projection
+            # output names (aliases, function names) exist only
+            # post-projection — never ask the scan for them
+            if col not in item_names:
                 names.add(col)
         return sorted(names)
 
@@ -2706,6 +2736,11 @@ def _subst_aggrefs(node, grows: List[dict]):
 
 
 def _expr_name(node) -> str:
+    """PG-style output name for an expression item: function calls
+    project under the function's name (SELECT upper(t) -> column
+    "upper"); anything else keeps the generic name."""
+    if isinstance(node, tuple) and node and node[0] == "fn":
+        return node[1]
     return "expr"
 
 

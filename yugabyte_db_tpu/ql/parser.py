@@ -419,7 +419,17 @@ class Parser:
             if self.accept_kw("order"):
                 self.expect_kw("by")
                 while True:
-                    col = self.ident()
+                    t = self.peek()
+                    if t and t[0] == "num":
+                        # positional: _set_op resolves the sentinel
+                        # against the set-op output columns
+                        self.next()
+                        if "." in t[1] or "e" in t[1].lower():
+                            raise ValueError(
+                                "non-integer constant in ORDER BY")
+                        col = f"__ord:{int(t[1]) - 1}"
+                    else:
+                        col = self.ident()
                     desc = bool(self.accept_kw("desc"))
                     if not desc:
                         self.accept_kw("asc")
@@ -1065,13 +1075,47 @@ class Parser:
         if self.accept_kw("order"):
             self.expect_kw("by")
             while True:
-                col = self.ident()
-                if self.accept_op("<->"):
-                    t = self.next()
-                    if t[0] != "str":
-                        raise ValueError("vector literal must be a string")
-                    knn = (col, t[1])
-                    break
+                t = self.peek()
+                if t and t[0] == "num":
+                    # ORDER BY <ordinal> (PG: position in the select
+                    # list) — encoded as an item-index sentinel the
+                    # executor resolves to the item's output name
+                    self.next()
+                    if "." in t[1] or "e" in t[1].lower():
+                        raise ValueError(
+                            "non-integer constant in ORDER BY")
+                    if any(it[0] == "star" for it in items):
+                        raise ValueError(
+                            "ORDER BY <position> with SELECT * is not "
+                            "supported; name the column")
+                    idx = int(t[1]) - 1
+                    if not (0 <= idx < len(items)):
+                        raise ValueError(
+                            f"ORDER BY position {t[1]} is not in the "
+                            f"select list")
+                    col = f"__ord:{idx}"
+                else:
+                    e = self.expr()
+                    if e[0] == "col":
+                        col = e[1]
+                        if self.accept_op("<->"):
+                            t = self.next()
+                            if t[0] != "str":
+                                raise ValueError(
+                                    "vector literal must be a string")
+                            knn = (col, t[1])
+                            break
+                    else:
+                        # ORDER BY <expression>: PG sorts by the
+                        # MATCHING select-list expression
+                        idx = next(
+                            (i for i, it in enumerate(items)
+                             if it[0] == "expr" and it[1] == e), None)
+                        if idx is None:
+                            raise ValueError(
+                                "ORDER BY expression must appear in "
+                                "the select list")
+                        col = f"__ord:{idx}"
                 desc = False
                 if self.accept_kw("desc"):
                     desc = True
