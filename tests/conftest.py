@@ -22,3 +22,9 @@ jax.config.update("jax_enable_x64", True)
 # (XLA:CPU AOT entries can fail the loader's machine check); make the
 # CPU choice visible to yugabyte_db_tpu/__init__.py before its import
 os.environ.setdefault("YBTPU_PLATFORM", "cpu")
+
+# state-invariant sanitizer (utils/sanitizer.py — the TSAN/DCHECK-build
+# analog): every MiniCluster shutdown sweeps claims-vs-intents,
+# read-lock symmetry, memtable probe guards, and manifest consistency,
+# so every test drive doubles as an invariant check
+os.environ.setdefault("YBTPU_SANITIZE", "1")

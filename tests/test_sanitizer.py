@@ -1,0 +1,123 @@
+"""State-invariant sanitizer (the TSAN/DCHECK-build analog; reference:
+yb_build.sh sanitizer builds + per-subsystem consistency DCHECKs).
+Positive checks: clean clusters sweep clean after real workloads.
+Negative checks: seeded corruptions of each invariant class are
+caught."""
+import asyncio
+
+from yugabyte_db_tpu.docdb import RowOp
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+from yugabyte_db_tpu.utils import sanitizer
+from tests.test_load_balancer import kv_info
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestSanitizer:
+    def test_clean_after_txn_workload(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                c = mc.client()
+                await c.create_table(kv_info(), num_tablets=2)
+                await mc.wait_for_leaders("kv")
+                await c.insert("kv", [{"k": i, "v": float(i)}
+                                      for i in range(50)])
+                await c.messenger.call(mc.master.messenger.addr,
+                                       "master", "get_status_tablet", {})
+                await mc.wait_for_leaders("system.transactions")
+                txn = await c.transaction().begin()
+                await txn.insert("kv", [{"k": 100, "v": 1.0}])
+                await txn.get("kv", {"k": 5}, for_update=True)
+                await txn.commit()
+                t2 = await c.transaction().begin()
+                await t2.insert("kv", [{"k": 101, "v": 2.0}])
+                await t2.abort()
+                assert sanitizer.check_cluster(mc) == []
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_detects_leaked_claim(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                c = mc.client()
+                await c.create_table(kv_info(), num_tablets=1)
+                await mc.wait_for_leaders("kv")
+                peer = next(iter(mc.tservers[0].peers.values()))
+                # seed: a claim with no intent entry
+                peer.participant._key_holder[b"ghost"] = "txn-x"
+                vs = sanitizer.check_cluster(mc)
+                assert any("leaked claim" in v for v in vs), vs
+                del peer.participant._key_holder[b"ghost"]
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_detects_double_writer(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                c = mc.client()
+                await c.create_table(kv_info(), num_tablets=1)
+                await mc.wait_for_leaders("kv")
+                peer = next(iter(mc.tservers[0].peers.values()))
+                p = peer.participant
+                p._key_holder[b"dup"] = "txn-a"
+                p._intents["txn-a"] = {b"dup": [(0, "t", ["upsert", {}])]}
+                p._intents["txn-b"] = {b"dup": [(0, "t", ["upsert", {}])]}
+                vs = sanitizer.check_cluster(mc)
+                assert any("two writers" in v for v in vs), vs
+                p._intents.clear()
+                del p._key_holder[b"dup"]
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_detects_memtable_guard_false_negative(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                c = mc.client()
+                await c.create_table(kv_info(), num_tablets=1)
+                await mc.wait_for_leaders("kv")
+                await c.insert("kv", [{"k": 1, "v": 1.0}])
+                peer = next(iter(mc.tservers[0].peers.values()))
+                mem = peer.tablet.regular._mem
+                assert not mem.empty()
+                # seed: drop a prefix from the guard set — point reads
+                # would miss the row; the sanitizer must flag it
+                mem._row_prefixes.clear()
+                vs = sanitizer.check_cluster(mc)
+                assert any("FALSE NEGATIVE" in v for v in vs), vs
+                # restore so shutdown under YBTPU_SANITIZE stays green
+                from yugabyte_db_tpu.storage.memtable import _HT_SUFFIX
+                for k in mem._map.keys():
+                    mem._row_prefixes.add(k[:-_HT_SUFFIX])
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_detects_missing_sst_file(self, tmp_path):
+        async def go():
+            import os
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                c = mc.client()
+                await c.create_table(kv_info(), num_tablets=1)
+                await mc.wait_for_leaders("kv")
+                await c.insert("kv", [{"k": i, "v": 0.0}
+                                      for i in range(10)])
+                peer = next(iter(mc.tservers[0].peers.values()))
+                peer.tablet.flush()
+                _, ssts = peer.tablet.regular.read_snapshot()
+                os.rename(ssts[0].path, ssts[0].path + ".hidden")
+                vs = sanitizer.check_cluster(mc)
+                assert any("missing SST" in v for v in vs), vs
+                os.rename(ssts[0].path + ".hidden", ssts[0].path)
+            finally:
+                await mc.shutdown()
+        run(go())

@@ -41,6 +41,11 @@ class MiniCluster:
         return [m.messenger.addr for m in self.masters]
 
     async def start(self) -> "MiniCluster":
+        if os.environ.get("YBTPU_LOOP_MONITOR") == "1":
+            # blocked-event-loop detector (utils/sanitizer.py): logs
+            # any callback stalling the loop past the threshold
+            from ..utils.sanitizer import enable_loop_monitor
+            enable_loop_monitor()
         for i in range(self.num_masters):
             m = Master(os.path.join(self.root, f"master-{i}"), uuid=f"m{i}")
             await m.start()
@@ -129,9 +134,24 @@ class MiniCluster:
         raise TimeoutError(f"no leaders for {table}")
 
     async def shutdown(self):
+        # sanitizer sweep (reference: TSAN/DCHECK builds): every test
+        # drive doubles as a state-invariant check — claims vs intents,
+        # read-lock symmetry, memtable probe guards, manifest/file
+        # consistency.  Violations are collected BEFORE teardown but
+        # raised AFTER it: servers must not leak into later tests, and
+        # the raise must not happen mid-finally where it would mask a
+        # test's own exception during teardown.
+        violations = []
+        if os.environ.get("YBTPU_SANITIZE") == "1":
+            from ..utils import sanitizer
+            violations = sanitizer.check_cluster(self)
         for ts in self.tservers:
             await ts.shutdown()
         for m in self.masters:
             if m.consensus is not None:
                 await m.consensus.shutdown()
             await m.shutdown()
+        if violations:
+            raise AssertionError(
+                "sanitizer violations at cluster shutdown:\n  "
+                + "\n  ".join(violations))

@@ -1,0 +1,143 @@
+"""State-invariant sanitizer: the TSAN/ASAN analog for this runtime.
+
+The reference ships TSAN/ASAN builds and debug invariant checks
+(reference: build flags in yb_build.sh, DCHECK families in
+src/yb/util/logging.h and per-subsystem consistency checks).  A
+Python/asyncio runtime has different hazard classes — state shared
+between the event loop and executor threads (flush/compaction), and
+bookkeeping that must stay mutually consistent across async
+interleavings (intents vs claims, read locks, the memtable's
+point-probe guard).  This module checks those invariants directly:
+
+- `check_tablet(tablet)` / `check_participant(p)` /
+  `check_store(store)` return human-readable violation strings
+  (empty = clean).
+- `check_cluster(mc)` sweeps every peer of a MiniCluster; the test
+  conftest runs it at cluster shutdown when YBTPU_SANITIZE=1 so any
+  test drive doubles as an invariant sweep.
+- `enable_loop_monitor()` turns on asyncio debug slow-callback
+  reporting — the "blocked event loop" detector (a loop stall is this
+  runtime's closest analog to a lock-order inversion).
+"""
+from __future__ import annotations
+
+import os
+from typing import List
+
+from ..dockv.key_encoding import ValueType
+from ..storage.memtable import _HT_SUFFIX
+
+
+def check_store(store, label: str = "store") -> List[str]:
+    """LSM store invariants: manifest files exist on disk, the
+    memtable's row-prefix guard has NO false negatives (a false
+    negative silently drops committed rows from point reads), and
+    frozen memtables are all frozen."""
+    out: List[str] = []
+    with store._lock:
+        mems = [store._mem] + list(store._frozen)
+        ssts = list(store._ssts)
+        frozen = list(store._frozen)
+    for r in ssts:
+        if not os.path.exists(r.path):
+            # re-check under the lock: a concurrent compaction may
+            # have legitimately replaced + unlinked this reader
+            # between our snapshot and the exists() probe
+            with store._lock:
+                still_live = any(x is r for x in store._ssts)
+            if still_live and not os.path.exists(r.path):
+                out.append(
+                    f"{label}: manifest lists missing SST {r.path}")
+    for m in frozen:
+        if not m.frozen:
+            out.append(f"{label}: unfrozen memtable in frozen list")
+    for i, m in enumerate(mems):
+        if m._foreign_layout:
+            continue        # guard disabled: probes run unconditionally
+        for k in m._map.keys():
+            if len(k) > _HT_SUFFIX and \
+                    k[-_HT_SUFFIX] == ValueType.kHybridTime:
+                if k[:-_HT_SUFFIX] not in m._row_prefixes:
+                    out.append(
+                        f"{label}: memtable[{i}] row-prefix guard "
+                        f"FALSE NEGATIVE for key {k!r} — point reads "
+                        f"would miss this row")
+                    break
+    return out
+
+
+def check_participant(p, label: str = "participant") -> List[str]:
+    """Transaction-participant invariants (reference: the consistency
+    DCHECKs around transaction_participant.cc):
+
+    - every exclusive key claim belongs to a transaction that still
+      has an intent (or claim placeholder) for that key;
+    - every intent key of a txn is either claimed by it or by nobody
+      (a claim by ANOTHER txn means two writers passed conflict
+      resolution on one key — the write-write race);
+    - read-lock bookkeeping is symmetric."""
+    out: List[str] = []
+    for k, txn in list(p._key_holder.items()):
+        per = p._intents.get(txn)
+        if per is None or k not in per:
+            out.append(f"{label}: claim on {k!r} by {txn} with no "
+                       f"intent entry (leaked claim)")
+    for txn, per in list(p._intents.items()):
+        for k, ents in per.items():
+            holder = p._key_holder.get(k)
+            if holder is not None and holder != txn and ents:
+                out.append(
+                    f"{label}: key {k!r} has intents from {txn} but "
+                    f"is claimed by {holder} — two writers passed "
+                    f"conflict resolution")
+    for txn, keys in list(p._txn_reads.items()):
+        for k in keys:
+            if txn not in p._read_holders.get(k, ()):
+                out.append(f"{label}: read-lock bookkeeping asymmetry "
+                           f"for {txn} on {k!r}")
+    for k, holders in list(p._read_holders.items()):
+        for txn in holders:
+            if k not in p._txn_reads.get(txn, ()):
+                out.append(f"{label}: read-holder {txn} on {k!r} "
+                           f"missing from _txn_reads")
+    return out
+
+
+def check_tablet(tablet, label: str = "tablet") -> List[str]:
+    out = check_store(tablet.regular, f"{label}.regular")
+    out += check_store(tablet.intents, f"{label}.intents")
+    return out
+
+
+def check_peer(peer) -> List[str]:
+    label = f"peer[{peer.tablet.tablet_id}]"
+    out = check_tablet(peer.tablet, label)
+    out += check_participant(peer.participant, label)
+    return out
+
+
+def check_cluster(mc) -> List[str]:
+    """Sweep every tablet peer of a MiniCluster (or any object with
+    .tservers[*].peers)."""
+    out: List[str] = []
+    for ts in getattr(mc, "tservers", []):
+        for peer in getattr(ts, "peers", {}).values():
+            out += check_peer(peer)
+    return out
+
+
+def enable_loop_monitor(threshold_s: float = 0.25) -> None:
+    """asyncio slow-callback reporting: a callback blocking the loop
+    past `threshold_s` logs a warning with the offending callable —
+    the single-loop runtime's analog of a lock-held-too-long/TSAN
+    report.  (The reference's equivalent is the long-operation
+    tracker, util/operation_counter.cc.)  Must be called from INSIDE
+    the running loop (MiniCluster.start wires it when
+    YBTPU_LOOP_MONITOR=1)."""
+    import asyncio
+    try:
+        loop = asyncio.get_running_loop()
+    except RuntimeError:
+        return
+    loop.slow_callback_duration = threshold_s
+    loop.set_debug(True)
