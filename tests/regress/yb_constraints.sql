@@ -22,6 +22,11 @@ CREATE UNIQUE INDEX dup_v ON dup (v);
 CREATE TABLE mr (k bigint PRIMARY KEY, v text UNIQUE) WITH tablets = 1;
 INSERT INTO mr (k, v) VALUES (1, 'a'), (2, 'a');
 SELECT count(*) FROM mr;
+-- parent-delete RESTRICT: a referenced parent row cannot be deleted
+DELETE FROM country WHERE code = 'jp';
+DELETE FROM city WHERE country_code = 'jp';
+DELETE FROM country WHERE code = 'jp';
+SELECT code FROM country ORDER BY code;
 DROP TABLE city;
 DROP TABLE country;
 DROP TABLE dup;

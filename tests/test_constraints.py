@@ -395,3 +395,47 @@ class TestSqlConstraints:
             finally:
                 await mc.shutdown()
         run(go())
+
+    def test_fk_restrict_parent_delete(self, tmp_path):
+        """Parent-delete RESTRICT: committed children block, txn-view
+        children count (deleted don't, added do), self-referential
+        statements pass (PG NO ACTION shape)."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path),
+                                   num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute("CREATE TABLE pp (id bigint PRIMARY "
+                                "KEY, n text) WITH tablets = 1")
+                await s.execute(
+                    "CREATE TABLE cc (id bigint PRIMARY KEY, p_id "
+                    "bigint REFERENCES pp (id)) WITH tablets = 1")
+                await s.execute(
+                    "INSERT INTO pp (id, n) VALUES (1, 'a'), (2, 'b')")
+                await s.execute("INSERT INTO cc (id, p_id) VALUES "
+                                "(10, 1)")
+                with pytest.raises(ValueError, match="still referenced"):
+                    await s.execute("DELETE FROM pp WHERE id = 1")
+                await s.execute("DELETE FROM pp WHERE id = 2")
+                # txn: child-then-parent in one txn is legal
+                await s.execute("BEGIN")
+                await s.execute("DELETE FROM cc WHERE id = 10")
+                await s.execute("DELETE FROM pp WHERE id = 1")
+                await s.execute("COMMIT")
+                # txn-added child blocks the parent delete
+                await s.execute("INSERT INTO pp (id, n) VALUES (5, 'e')")
+                await s.execute("BEGIN")
+                await s.execute("INSERT INTO cc (id, p_id) VALUES "
+                                "(50, 5)")
+                with pytest.raises(ValueError, match="still referenced"):
+                    await s.execute("DELETE FROM pp WHERE id = 5")
+                await s.execute("ROLLBACK")
+                # self-referential row deletes cleanly
+                await s.execute(
+                    "CREATE TABLE se (id bigint PRIMARY KEY, mgr "
+                    "bigint REFERENCES se (id)) WITH tablets = 1")
+                await s.execute("INSERT INTO se (id, mgr) VALUES (1, 1)")
+                await s.execute("DELETE FROM se WHERE id = 1")
+            finally:
+                await mc.shutdown()
+        run(go())

@@ -5,7 +5,6 @@ Negative checks: seeded corruptions of each invariant class are
 caught."""
 import asyncio
 
-from yugabyte_db_tpu.docdb import RowOp
 from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
 from yugabyte_db_tpu.utils import sanitizer
 from tests.test_load_balancer import kv_info
@@ -51,8 +50,8 @@ class TestSanitizer:
                 # seed: a claim with no intent entry
                 peer.participant._key_holder[b"ghost"] = "txn-x"
                 vs = sanitizer.check_cluster(mc)
-                assert any("leaked claim" in v for v in vs), vs
                 del peer.participant._key_holder[b"ghost"]
+                assert any("leaked claim" in v for v in vs), vs
             finally:
                 await mc.shutdown()
         run(go())
@@ -70,9 +69,9 @@ class TestSanitizer:
                 p._intents["txn-a"] = {b"dup": [(0, "t", ["upsert", {}])]}
                 p._intents["txn-b"] = {b"dup": [(0, "t", ["upsert", {}])]}
                 vs = sanitizer.check_cluster(mc)
-                assert any("two writers" in v for v in vs), vs
                 p._intents.clear()
                 del p._key_holder[b"dup"]
+                assert any("two writers" in v for v in vs), vs
             finally:
                 await mc.shutdown()
         run(go())
@@ -92,11 +91,12 @@ class TestSanitizer:
                 # would miss the row; the sanitizer must flag it
                 mem._row_prefixes.clear()
                 vs = sanitizer.check_cluster(mc)
-                assert any("FALSE NEGATIVE" in v for v in vs), vs
-                # restore so shutdown under YBTPU_SANITIZE stays green
+                # restore BEFORE asserting so a failed assert can't
+                # cascade into the shutdown sweep's own error
                 from yugabyte_db_tpu.storage.memtable import _HT_SUFFIX
                 for k in mem._map.keys():
                     mem._row_prefixes.add(k[:-_HT_SUFFIX])
+                assert any("FALSE NEGATIVE" in v for v in vs), vs
             finally:
                 await mc.shutdown()
         run(go())
@@ -116,8 +116,8 @@ class TestSanitizer:
                 _, ssts = peer.tablet.regular.read_snapshot()
                 os.rename(ssts[0].path, ssts[0].path + ".hidden")
                 vs = sanitizer.check_cluster(mc)
-                assert any("missing SST" in v for v in vs), vs
                 os.rename(ssts[0].path + ".hidden", ssts[0].path)
+                assert any("missing SST" in v for v in vs), vs
             finally:
                 await mc.shutdown()
         run(go())

@@ -573,6 +573,7 @@ class SqlSession:
             replication_factor=stmt.replication_factor,
             tablespace=getattr(stmt, "tablespace", None),
             foreign_keys=fks)
+        self._invalidate_fk_children()
         # UNIQUE columns: enforced through unique secondary indexes
         # (the index doc key is the value itself, so duplicates collide
         # — reference: yb_access/yb_lsm.c:233-366)
@@ -590,6 +591,7 @@ class SqlSession:
 
     async def _drop(self, stmt: DropTableStmt) -> SqlResult:
         self._invalidate_stats(stmt.name)
+        self._invalidate_fk_children()
         if stmt.if_exists:
             names = {t["name"] for t in await self.client.list_tables()}
             if stmt.name not in names:
@@ -875,6 +877,89 @@ class SqlSession:
             return ("const", proposed.get(node[1][9:]))
         return tuple(self._subst_excluded(x, proposed)
                      if isinstance(x, tuple) else x for x in node)
+
+    async def _fk_children(self, parent: str):
+        """[(child_table, fk_column)] referencing `parent`.  The map
+        builds lazily from the catalog once per session and refreshes
+        on this session's DDL; FKs created by OTHER sessions after the
+        first build are missed until a refresh (documented FK-lite
+        scope).  Reference: pg_constraint lookups feeding the PG
+        executor's RESTRICT checks."""
+        if getattr(self, "_fk_child_map", None) is None:
+            m: Dict[str, list] = {}
+            for t in await self.client.list_tables():
+                name = t["name"]
+                if "." in name:
+                    continue        # system./schema-qualified vtables
+                try:
+                    cct = await self.client._table(name)
+                except Exception:   # noqa: BLE001 — vtables etc.
+                    continue
+                for fk in getattr(cct, "foreign_keys", None) or []:
+                    m.setdefault(fk["parent_table"], []).append(
+                        (name, fk["column"]))
+            self._fk_child_map = m
+        return self._fk_child_map.get(parent, [])
+
+    async def _check_fk_restrict(self, ct, pk_cols, pk_rows) -> None:
+        """Parent-side RESTRICT: deleting a row still referenced by a
+        child FK fails (reference: PG's NO ACTION/RESTRICT through the
+        executor; checked via child scans — an index on the FK column
+        accelerates it when present, as in PG).  The check sees the
+        TRANSACTION's view: children the txn already deleted don't
+        count, children it added do; and rows deleted by this SAME
+        statement never count as referencing (the self-referential
+        DELETE case, matching PG's end-of-statement NO ACTION)."""
+        children = await self._fk_children(ct.info.name)
+        if not children or len(pk_cols) != 1:
+            return
+        pk = pk_cols[0]
+        stmt_pks = {tuple(r[k] for k in pk_cols) for r in pk_rows}
+        for child, col in children:
+            cct = await self.client._table(child)
+            child_pk = [c.name for c in cct.info.schema.key_columns]
+            pend = (self._txn.pending_writes(child)
+                    if self._txn is not None else {})
+            deleted_pks = {p for p, op in pend.items()
+                           if op.kind == "delete"}
+            idx_name = next(
+                (n for n, spec in (cct.indexes or {}).items()
+                 if spec["column"] == col), None)
+            for r in pk_rows:
+                v = r[pk]
+                if idx_name is not None:
+                    refs = await self.client.index_lookup(child,
+                                                          idx_name, v)
+                else:
+                    cid = cct.info.schema.column_by_name(col).id
+                    resp = await self.client.scan(child, ReadRequest(
+                        "", columns=tuple({col, *child_pk}),
+                        where=("cmp", "eq", ("col", cid),
+                               ("const", v))))
+                    refs = resp.rows
+                live = []
+                for ref in refs:
+                    rpk = tuple(ref.get(k) for k in child_pk)
+                    if rpk in deleted_pks:
+                        continue   # txn already deleted this child
+                    if child == ct.info.name and rpk in stmt_pks:
+                        continue   # being deleted by this statement
+                    live.append(ref)
+                # children the txn ADDED (uncommitted) also reference
+                for p, op in pend.items():
+                    if op.kind != "delete" and op.row.get(col) == v \
+                            and not (child == ct.info.name
+                                     and p in stmt_pks):
+                        live.append(op.row)
+                if live:
+                    raise ValueError(
+                        f'update or delete on table "{ct.info.name}" '
+                        f'violates foreign key constraint on table '
+                        f'"{child}": key ({pk})=({v}) is still '
+                        f'referenced')
+
+    def _invalidate_fk_children(self) -> None:
+        self._fk_child_map = None
 
     async def _check_foreign_keys(self, ct, rows) -> None:
         """FK-lite: REFERENCES enforced as an existence check inside
@@ -2529,6 +2614,7 @@ class SqlSession:
         rows = [{k: r.get(k) for k in pk_cols} for r in rows]
         if not rows:
             return SqlResult([], "DELETE 0")
+        await self._check_fk_restrict(ct, pk_cols, rows)
         if self._txn is not None:
             n = await self._txn.delete(stmt.table, rows)
         else:
