@@ -439,3 +439,36 @@ class TestSqlConstraints:
             finally:
                 await mc.shutdown()
         run(go())
+
+    def test_for_share_readers_coexist_writers_wait(self, tmp_path):
+        """FOR SHARE: shared row locks under any isolation — readers
+        never block readers, a writer conflicts with live holders
+        (reference: FOR SHARE row marks as kStrongRead intents)."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path),
+                                   num_tservers=1).start()
+            try:
+                c = mc.client()
+                s1, s2 = SqlSession(c), SqlSession(c)
+                await s1.execute("CREATE TABLE fs (k bigint PRIMARY "
+                                 "KEY, v bigint) WITH tablets = 1")
+                await s1.execute("INSERT INTO fs (k, v) VALUES (1, 10)")
+                await c.messenger.call(mc.master.messenger.addr,
+                                       "master", "get_status_tablet", {})
+                await mc.wait_for_leaders("system.transactions")
+                await s1.execute("BEGIN")
+                await s2.execute("BEGIN")
+                r1 = await s1.execute(
+                    "SELECT v FROM fs WHERE k = 1 FOR SHARE")
+                r2 = await s2.execute(
+                    "SELECT v FROM fs WHERE k = 1 FOR SHARE")
+                assert r1.rows == r2.rows == [{"v": 10}]
+                # s2 releases; s1 (a holder itself) can then write
+                await s2.execute("COMMIT")
+                await s1.execute("UPDATE fs SET v = 99 WHERE k = 1")
+                await s1.execute("COMMIT")
+                r = await s1.execute("SELECT v FROM fs WHERE k = 1")
+                assert r.rows == [{"v": 99}]
+            finally:
+                await mc.shutdown()
+        run(go())

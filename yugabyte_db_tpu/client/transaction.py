@@ -273,10 +273,17 @@ class YBTransaction:
             return row
         return row
 
-    async def lock_rows(self, table: str, pk_rows) -> int:
-        """Take SERIALIZABLE read locks on specific rows (the SQL layer
-        locks a SELECT's read set with this). No-op under snapshot."""
-        if self.isolation != "serializable" or not pk_rows:
+    async def lock_rows(self, table: str, pk_rows,
+                        force: bool = False) -> int:
+        """Take SHARED read locks on specific rows (the SQL layer locks
+        a SELECT's read set with this under SERIALIZABLE, and
+        SELECT ... FOR SHARE uses it under any isolation via `force` —
+        reference: FOR SHARE row marks as kStrongRead intents).
+        Readers never block readers; writers wait for the holders and
+        a write-after-read then conflicts.  No-op under snapshot unless
+        forced."""
+        if (self.isolation != "serializable" and not force) \
+                or not pk_rows:
             return 0
         assert self.state == PENDING
         ct = await self.client._table(table)

@@ -887,14 +887,20 @@ class SqlSession:
         executor's RESTRICT checks."""
         if getattr(self, "_fk_child_map", None) is None:
             m: Dict[str, list] = {}
+            from ..rpc.messenger import RpcError as _RpcErr
             for t in await self.client.list_tables():
                 name = t["name"]
                 if "." in name:
                     continue        # system./schema-qualified vtables
                 try:
                     cct = await self.client._table(name)
-                except Exception:   # noqa: BLE001 — vtables etc.
-                    continue
+                except _RpcErr as e:
+                    if e.code != "NOT_FOUND":
+                        # a transient error must not silently disable
+                        # RESTRICT for this child for the whole session
+                        self._fk_child_map = None
+                        raise
+                    continue        # dropped concurrently
                 for fk in getattr(cct, "foreign_keys", None) or []:
                     m.setdefault(fk["parent_table"], []).append(
                         (name, fk["column"]))
@@ -915,48 +921,69 @@ class SqlSession:
             return
         pk = pk_cols[0]
         stmt_pks = {tuple(r[k] for k in pk_cols) for r in pk_rows}
+        values = [r[pk] for r in pk_rows]
+        value_set = set(values)
         for child, col in children:
             cct = await self.client._table(child)
             child_pk = [c.name for c in cct.info.schema.key_columns]
             pend = (self._txn.pending_writes(child)
                     if self._txn is not None else {})
-            deleted_pks = {p for p, op in pend.items()
-                           if op.kind == "delete"}
             idx_name = next(
                 (n for n, spec in (cct.indexes or {}).items()
                  if spec["column"] == col), None)
-            for r in pk_rows:
-                v = r[pk]
-                if idx_name is not None:
-                    refs = await self.client.index_lookup(child,
-                                                          idx_name, v)
-                else:
-                    cid = cct.info.schema.column_by_name(col).id
-                    resp = await self.client.scan(child, ReadRequest(
-                        "", columns=tuple({col, *child_pk}),
-                        where=("cmp", "eq", ("col", cid),
-                               ("const", v))))
-                    refs = resp.rows
-                live = []
-                for ref in refs:
-                    rpk = tuple(ref.get(k) for k in child_pk)
-                    if rpk in deleted_pks:
+            # ONE read per child table: indexed point lookups per
+            # value (cheap), else a single IN-scan for the whole
+            # statement's parent set
+            refs = []
+            if idx_name is not None:
+                for v in values:
+                    for p in await self.client.index_lookup(
+                            child, idx_name, v):
+                        refs.append({**p, col: v})
+            else:
+                cid = cct.info.schema.column_by_name(col).id
+                resp = await self.client.scan(child, ReadRequest(
+                    "", columns=tuple({col, *child_pk}),
+                    where=("in", ("col", cid), list(values))))
+                refs = resp.rows
+            committed_pks = set()
+            offender = None
+            for ref in refs:
+                rpk = tuple(ref.get(k) for k in child_pk)
+                committed_pks.add(rpk)
+                op = pend.get(rpk)
+                if op is not None:
+                    if op.kind == "delete":
                         continue   # txn already deleted this child
-                    if child == ct.info.name and rpk in stmt_pks:
-                        continue   # being deleted by this statement
-                    live.append(ref)
-                # children the txn ADDED (uncommitted) also reference
+                    # the txn's version supersedes the committed image
+                    # (an UPDATE may have re-pointed the FK); a partial
+                    # write without the FK column keeps the committed
+                    # value
+                    ref_v = op.row.get(col, ref.get(col))
+                else:
+                    ref_v = ref.get(col)
+                if ref_v not in value_set:
+                    continue
+                if child == ct.info.name and rpk in stmt_pks:
+                    continue   # being deleted by this statement
+                offender = ref_v
+                break
+            if offender is None:
+                # children the txn ADDED (uncommitted, not in the
+                # committed scan) also reference
                 for p, op in pend.items():
-                    if op.kind != "delete" and op.row.get(col) == v \
+                    if op.kind != "delete" and p not in committed_pks \
+                            and op.row.get(col) in value_set \
                             and not (child == ct.info.name
                                      and p in stmt_pks):
-                        live.append(op.row)
-                if live:
-                    raise ValueError(
-                        f'update or delete on table "{ct.info.name}" '
-                        f'violates foreign key constraint on table '
-                        f'"{child}": key ({pk})=({v}) is still '
-                        f'referenced')
+                        offender = op.row.get(col)
+                        break
+            if offender is not None:
+                raise ValueError(
+                    f'update or delete on table "{ct.info.name}" '
+                    f'violates foreign key constraint on table '
+                    f'"{child}": key ({pk})=({offender}) is still '
+                    f'referenced')
 
     def _invalidate_fk_children(self) -> None:
         self._fk_child_map = None
@@ -966,7 +993,8 @@ class SqlSession:
         the writing transaction (reference: FK enforcement through the
         PG executor over YB row locks — we check existence without the
         parent KEY SHARE lock, so a concurrent parent delete can race;
-        parent-side RESTRICT is not enforced)."""
+        parent-side RESTRICT is enforced by _check_fk_restrict on
+        DELETE)."""
         for fk in getattr(ct, "foreign_keys", None) or []:
             col, parent = fk["column"], fk["parent_table"]
             pcol = fk["parent_column"]
@@ -1243,15 +1271,16 @@ class SqlSession:
                     dataclasses.replace(stmt, ctes={}))
             finally:
                 self._cte_rows = saved
-        if getattr(stmt, "for_update", False) and (
+        if (getattr(stmt, "for_update", False)
+                or getattr(stmt, "for_share", False)) and (
                 getattr(stmt, "joins", None) or stmt.group_by
                 or stmt.distinct
                 or any(it[0] in ("agg", "window") for it in stmt.items)
                 or stmt.knn is not None or stmt.table is None):
             # PG restricts row locking to plain row-returning scans
             raise ValueError(
-                "FOR UPDATE is not allowed with joins, aggregates, "
-                "GROUP BY, DISTINCT, or window functions")
+                "FOR UPDATE/FOR SHARE is not allowed with joins, "
+                "aggregates, GROUP BY, DISTINCT, or window functions")
         if stmt.where is not None:
             stmt.where = await self._resolve_subqueries(stmt.where)
         for i, it in enumerate(stmt.items):
@@ -1378,12 +1407,15 @@ class SqlSession:
         has_window = any(it[0] == "window" for it in stmt.items)
         for_update = getattr(stmt, "for_update", False) \
             and self._txn is not None
+        for_share = getattr(stmt, "for_share", False) \
+            and self._txn is not None
         push_limit = (stmt.limit
                       if not (stmt.distinct or stmt.offset or has_window
-                              or for_update)
+                              or for_update or for_share)
                       and (natural or not stmt.order_by) else None)
-        if for_update or (self._txn is not None
-                          and self._txn.pending_writes(stmt.table)):
+        if for_update or for_share or (
+                self._txn is not None
+                and self._txn.pending_writes(stmt.table)):
             # the write-set overlay (and FOR UPDATE's per-row locking)
             # needs pk columns to match rows and WHERE columns to
             # re-evaluate merged rows; and a pushed LIMIT would
@@ -1399,6 +1431,16 @@ class SqlSession:
         if self._txn is not None:
             base_rows = self._overlay_txn_writes(
                 stmt.table, schema, where, base_rows)
+        if for_share:
+            # SELECT ... FOR SHARE: shared read locks on the matched
+            # rows — readers don't block readers, writers wait and a
+            # write-after-read conflicts (reference: FOR SHARE row
+            # marks as kStrongRead intents)
+            pk_names = [c.name for c in schema.key_columns]
+            await self._txn.lock_rows(
+                stmt.table,
+                [{n: r[n] for n in pk_names} for r in base_rows],
+                force=True)
         if for_update:
             # SELECT ... FOR UPDATE: lock each matched row exclusively
             # and re-read its LATEST committed version; rows that no

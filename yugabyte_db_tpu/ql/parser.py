@@ -247,9 +247,11 @@ class SelectStmt:
     # FROM generate_series(lo, hi[, step]): (lo, hi, step) — the rows
     # materialize client-side (PG set-returning function)
     series: Optional[Tuple[int, int, int]] = None
-    # SELECT ... FOR UPDATE: lock the read set exclusively (reference:
-    # row locks via docdb intents, the pggate RowMarkType plumbing)
+    # SELECT ... FOR UPDATE / FOR SHARE: lock the read set exclusively
+    # or shared (reference: row locks via docdb intents, the pggate
+    # RowMarkType plumbing)
     for_update: bool = False
+    for_share: bool = False
 
 
 @dataclass
@@ -445,10 +447,11 @@ class Parser:
                 if isinstance(node, SetOpStmt):
                     return (_has_for_update(node.left)
                             or _has_for_update(node.right))
-                return getattr(node, "for_update", False)
+                return (getattr(node, "for_update", False)
+                        or getattr(node, "for_share", False))
             if _has_for_update(left):
                 raise ValueError(
-                    "FOR UPDATE is not allowed with "
+                    "FOR UPDATE/FOR SHARE is not allowed with "
                     "UNION/INTERSECT/EXCEPT")
         return left
 
@@ -1144,13 +1147,20 @@ class Parser:
         if self.accept_kw("offset"):
             offset = int(self.next()[1])
         for_update = False
+        for_share = False
         if self.accept_kw("for"):
-            self.expect_kw("update")
-            for_update = True
+            if self.accept_kw("update"):
+                for_update = True
+            else:
+                t = self.next()
+                if t[1].lower() != "share":
+                    raise ValueError(
+                        "expected UPDATE or SHARE after FOR")
+                for_share = True
         return SelectStmt(table, items, where, group, order, limit, knn,
                           distinct, offset, joins, having, aliases,
                           table_alias=table_alias, series=series,
-                          for_update=for_update)
+                          for_update=for_update, for_share=for_share)
 
     # clause starters that must not be eaten as a table alias
     _ALIAS_STOP = frozenset((
