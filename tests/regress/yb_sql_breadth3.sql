@@ -1,0 +1,15 @@
+-- round-5 SQL breadth batch 3: postfix NOT, IS DISTINCT FROM,
+-- string_agg, LIMIT ALL
+CREATE TABLE b3 (k bigint PRIMARY KEY, g text, v bigint, s text) WITH tablets = 1;
+INSERT INTO b3 (k, g, v, s) VALUES (1, 'a', 5, 'ax'), (2, 'a', NULL, 'by'), (3, 'b', 5, 'az'), (4, 'b', 7, NULL);
+SELECT k FROM b3 WHERE s NOT LIKE 'a%' ORDER BY k;
+SELECT k FROM b3 WHERE s NOT ILIKE 'A%' ORDER BY k;
+SELECT k FROM b3 WHERE k NOT IN (1, 3) ORDER BY k;
+SELECT k FROM b3 WHERE k NOT BETWEEN 2 AND 3 ORDER BY k;
+SELECT k FROM b3 WHERE v IS DISTINCT FROM 5 ORDER BY k;
+SELECT k FROM b3 WHERE v IS NOT DISTINCT FROM NULL ORDER BY k;
+SELECT string_agg(s, ',') FROM b3;
+SELECT g, string_agg(s, '-') FROM b3 GROUP BY g ORDER BY g;
+SELECT string_agg(s, ',') FROM b3 WHERE k > 100;
+SELECT k FROM b3 ORDER BY k LIMIT ALL;
+DROP TABLE b3;

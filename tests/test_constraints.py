@@ -463,6 +463,18 @@ class TestSqlConstraints:
                 r2 = await s2.execute(
                     "SELECT v FROM fs WHERE k = 1 FOR SHARE")
                 assert r1.rows == r2.rows == [{"v": 10}]
+                # a writer in a THIRD session conflicts while the
+                # share locks are live (this is the teeth of the test:
+                # it fails if lock_rows(force=True) stops locking)
+                s3 = SqlSession(c)
+                await s3.execute("BEGIN")
+                with pytest.raises(RpcError):
+                    await s3.execute(
+                        "UPDATE fs SET v = 77 WHERE k = 1")
+                try:
+                    await s3.execute("ROLLBACK")
+                except Exception:   # noqa: BLE001 — already aborted
+                    pass
                 # s2 releases; s1 (a holder itself) can then write
                 await s2.execute("COMMIT")
                 await s1.execute("UPDATE fs SET v = 99 WHERE k = 1")

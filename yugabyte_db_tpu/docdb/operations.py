@@ -305,10 +305,23 @@ def eval_expr_py(node: tuple, row: Dict[int, object]):
                     _IN_SET_CACHE.clear()
                 ent = (node, set(vals))
                 _IN_SET_CACHE[id(node)] = ent
-            return x in ent[1]
-        return x in vals
+            if x in ent[1]:
+                return True
+            # SQL 3VL: x IN (..., NULL) is UNKNOWN on a non-match —
+            # which matters under NOT IN (PG returns zero rows)
+            return None if None in ent[1] else False
+        if x in vals:
+            return True
+        return None if any(v is None for v in vals) else False
     if kind == "isnull":
         return eval_expr_py(node[1], row) is None
+    if kind == "isdistinct":
+        a = eval_expr_py(node[1], row)
+        b = eval_expr_py(node[2], row)
+        # null-safe: NULL is not distinct from NULL (never returns NULL)
+        if a is None or b is None:
+            return (a is None) != (b is None)
+        return a != b
     if kind in ("like", "ilike"):
         import re as _re
         v = eval_expr_py(node[1], row)

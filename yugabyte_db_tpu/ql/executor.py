@@ -1046,6 +1046,11 @@ class SqlSession:
             return ("in", self._bind(node[1], schema), node[2])
         if kind in ("like", "ilike"):
             return (kind, self._bind(node[1], schema), node[2])
+        if kind == "isdistinct":
+            return ("isdistinct", self._bind(node[1], schema),
+                    self._bind(node[2], schema))
+        if kind == "sagg":
+            return ("sagg", self._bind(node[1], schema), node[2])
         if kind == "json":
             return ("json", node[1], self._bind(node[2], schema), node[3])
         return (kind,) + tuple(
@@ -1359,7 +1364,8 @@ class SqlSession:
         if (agg_items or getattr(stmt, "having", None) is not None) \
                 and not stmt.group_by:
             refs = self._having_refs(stmt)
-            exotic = any(it[1] in ("array_agg", "count_distinct")
+            exotic = any(it[1] in ("array_agg", "count_distinct",
+                                   "string_agg")
                          for it in agg_items)
             if exotic or (self._txn is not None
                           and self._txn.pending_writes(stmt.table)):
@@ -1379,7 +1385,8 @@ class SqlSession:
 
         if stmt.group_by and (
                 agg_items or getattr(stmt, "having", None) is not None):
-            if any(it[1] in ("array_agg", "count_distinct")
+            if any(it[1] in ("array_agg", "count_distinct",
+                             "string_agg")
                    for it in agg_items) or (
                     self._txn is not None
                     and self._txn.pending_writes(stmt.table)):
@@ -1407,8 +1414,12 @@ class SqlSession:
         has_window = any(it[0] == "window" for it in stmt.items)
         for_update = getattr(stmt, "for_update", False) \
             and self._txn is not None
-        for_share = getattr(stmt, "for_share", False) \
-            and self._txn is not None
+        for_share = (getattr(stmt, "for_share", False)
+                     and self._txn is not None
+                     # SERIALIZABLE already locks the read set via
+                     # _lock_read_set — a second round would be
+                     # redundant RPCs
+                     and not self._is_serializable())
         push_limit = (stmt.limit
                       if not (stmt.distinct or stmt.offset or has_window
                               or for_update or for_share)
@@ -2352,7 +2363,8 @@ class SqlSession:
                 import decimal
                 v = _scalar(values[vi])
                 out[name] = (v if v is None
-                             or isinstance(v, (decimal.Decimal, list))
+                             or isinstance(v, (decimal.Decimal, list,
+                                               str))
                              else
                              int(v) if op in ("count", "count_distinct")
                              else float(v))
@@ -2848,6 +2860,15 @@ def _agg_over_rows(op: str, expr, rows: List[dict]):
     """Client-side aggregate over name-keyed rows (CTE / in-memory)."""
     if op == "count" and expr is None:
         return len(rows)
+    if op == "string_agg":
+        vals = [_eval_by_name(expr[1], r) for r in rows]
+        vals = [str(v) for v in vals if v is not None]
+        return expr[2].join(vals) if vals else None
+    if op == "count_distinct":
+        vals = {v if not isinstance(v, list) else tuple(v)
+                for r in rows
+                if (v := _eval_by_name(expr, r)) is not None}
+        return len(vals)
     return _agg_vals(op, [_eval_by_name(expr, r) for r in rows])
 
 
@@ -2874,8 +2895,9 @@ def _expr_name(node) -> str:
 
 def _scalar(v):
     """Aggregate output -> python scalar; None passes through (min/max
-    over zero rows); lists pass through (array_agg)."""
-    if isinstance(v, list):
+    over zero rows); lists pass through (array_agg); strings pass
+    through (string_agg)."""
+    if isinstance(v, (list, str)):
         return v
     a = np.asarray(v)
     if a.dtype == object and a.shape == ():
@@ -2901,7 +2923,19 @@ def _init(op):
     return 0 if op in ("sum", "count") else None
 
 
+def _sagg_step(expr, state, idrow):
+    v = eval_expr_py(expr[1], idrow)
+    if v is None:
+        return state
+    if state is None:
+        state = (expr[2], [])
+    state[1].append(str(v))
+    return state
+
+
 def _step(op, expr, state, idrow):
+    if op == "string_agg":
+        return _sagg_step(expr, state, idrow)
     if expr is None:
         return (state or 0) + 1
     v = eval_expr_py(expr, idrow)
@@ -2933,6 +2967,8 @@ def _final(op, state):
         return state[0] / state[1]
     if op == "count_distinct":
         return len(state)
+    if op == "string_agg":
+        return None if state is None else state[0].join(state[1])
     if op in ("sum", "count"):
         return state or 0
     return state

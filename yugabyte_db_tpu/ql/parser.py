@@ -968,7 +968,8 @@ class Parser:
                 t = self.peek()
                 is_agg_kw = (t[0] == "kw" and t[1].lower() in
                              ("count", "sum", "min", "max", "avg")) or \
-                    (t[0] == "id" and t[1].lower() == "array_agg"
+                    (t[0] == "id"
+                     and t[1].lower() in ("array_agg", "string_agg")
                      and self.pos + 1 < len(self.toks)
                      and self.toks[self.pos + 1] == ("op", "("))
                 is_window_fn = (t[0] == "id"
@@ -984,6 +985,16 @@ class Parser:
                         # COUNT(DISTINCT e): distinct-fold on the host
                         op = "count_distinct"
                         expr = self.expr()
+                    elif op == "string_agg":
+                        # string_agg(e, 'delim'): host fold; the
+                        # delimiter rides in the expr slot wrapper
+                        e = self.expr()
+                        self.expect_op(",")
+                        d = self.literal()
+                        if not isinstance(d, str):
+                            raise ValueError(
+                                "string_agg delimiter must be a string")
+                        expr = ("sagg", e, d)
                     elif self.accept_op("*"):
                         expr = None
                     elif self.peek() == ("op", ")"):
@@ -1142,7 +1153,10 @@ class Parser:
                     break
         limit = None
         if self.accept_kw("limit"):
-            limit = int(self.next()[1])
+            if self.accept_kw("all"):
+                limit = None        # PG: LIMIT ALL = no limit
+            else:
+                limit = int(self.next()[1])
         offset = 0
         if self.accept_kw("offset"):
             offset = int(self.next()[1])
@@ -1286,6 +1300,36 @@ class Parser:
                 return ("anyall", which, opname, left, arr)
             right = self.add_expr()
             return ("cmp", opname, left, right)
+        if t and t[0] == "kw" and t[1].lower() == "not":
+            # postfix negation: x NOT LIKE/ILIKE/BETWEEN/IN ...
+            nt = self.toks[self.pos + 1] if self.pos + 1 < len(
+                self.toks) else None
+            if nt and nt[0] == "kw" and nt[1].lower() in (
+                    "like", "ilike", "between", "in"):
+                self.next()
+                return ("not", self._comparison_tail(left))
+        if t and t[0] == "kw" and t[1].lower() in ("like", "ilike",
+                                                   "between", "in"):
+            return self._comparison_tail(left)
+        if t and t[0] == "kw" and t[1].lower() == "is":
+            self.next()
+            neg = self.accept_kw("not")
+            if self.accept_kw("distinct"):
+                # IS [NOT] DISTINCT FROM: null-safe comparison
+                t2 = self.next()
+                if t2[1].lower() != "from":
+                    raise ValueError("expected FROM after IS DISTINCT")
+                right = self.add_expr()
+                node = ("isdistinct", left, right)
+                return ("not", node) if neg else node
+            self.expect_kw("null")
+            node = ("isnull", left)
+            return ("not", node) if neg else node
+        return left
+
+    def _comparison_tail(self, left):
+        """The LIKE/ILIKE/BETWEEN/IN tail after an optional NOT."""
+        t = self.peek()
         if t and t[0] == "kw" and t[1].lower() in ("like", "ilike"):
             op = self.next()[1].lower()
             pat = self.next()
@@ -1303,10 +1347,10 @@ class Parser:
             self.expect_op("(")
             nt = self.peek()
             if nt and nt[0] == "kw" and nt[1].lower() == "select":
-                sub = self.select()
-                self.expect_op(")")
                 # semi-join: executor runs the subquery first and
                 # inlines its single-column values
+                sub = self.select()
+                self.expect_op(")")
                 return ("in_subquery", left, sub)
             vals = []
             while True:
@@ -1315,13 +1359,7 @@ class Parser:
                     break
             self.expect_op(")")
             return ("in", left, vals)
-        if t and t[0] == "kw" and t[1].lower() == "is":
-            self.next()
-            neg = self.accept_kw("not")
-            self.expect_kw("null")
-            node = ("isnull", left)
-            return ("not", node) if neg else node
-        return left
+        raise ValueError(f"expected LIKE/BETWEEN/IN after NOT, got {t}")
 
     def add_expr(self):
         left = self.mul_expr()
