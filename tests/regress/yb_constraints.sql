@@ -29,5 +29,12 @@ DELETE FROM country WHERE code = 'jp';
 SELECT code FROM country ORDER BY code;
 DROP TABLE city;
 DROP TABLE country;
+-- composite UNIQUE: duplicates collide on the full tuple
+CREATE TABLE pair (id bigint PRIMARY KEY, a bigint, b text, UNIQUE (a, b)) WITH tablets = 1;
+INSERT INTO pair (id, a, b) VALUES (1, 1, 'x'), (2, 1, 'y');
+INSERT INTO pair (id, a, b) VALUES (3, 1, 'x');
+INSERT INTO pair (id, a, b) VALUES (4, 2, 'x');
+SELECT id FROM pair ORDER BY id;
+DROP TABLE pair;
 DROP TABLE dup;
 DROP TABLE mr;

@@ -484,3 +484,52 @@ class TestSqlConstraints:
             finally:
                 await mc.shutdown()
         run(go())
+
+    def test_composite_unique_and_index(self, tmp_path):
+        """Multi-column UNIQUE + composite secondary indexes: the
+        index doc key is the full value tuple (first column hashed,
+        rest range), so duplicates collide on the whole tuple while
+        partial matches insert freely; prefix lookups narrow by every
+        provided column."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path),
+                                   num_tservers=1).start()
+            try:
+                c = mc.client()
+                s = SqlSession(c)
+                await s.execute(
+                    "CREATE TABLE co (id bigint PRIMARY KEY, a bigint, "
+                    "b text, q bigint, UNIQUE (a, b)) WITH tablets = 2")
+                await s.execute("INSERT INTO co (id, a, b, q) VALUES "
+                                "(1, 7, 'x', 2), (2, 7, 'y', 3), "
+                                "(3, 8, 'x', 1)")
+                with pytest.raises(RpcError):
+                    await s.execute("INSERT INTO co (id, a, b, q) "
+                                    "VALUES (4, 7, 'x', 9)")
+                # same first column, different second: fine
+                await s.execute("INSERT INTO co (id, a, b, q) VALUES "
+                                "(5, 7, 'z', 1)")
+                # tuple freed by moving one component
+                await s.execute("UPDATE co SET b = 'w' WHERE id = 1")
+                await s.execute("INSERT INTO co (id, a, b, q) VALUES "
+                                "(6, 7, 'x', 4)")
+                # ON CONFLICT arbitrates on the composite unique
+                await s.execute(
+                    "INSERT INTO co (id, a, b, q) VALUES (9, 7, 'z', 5)"
+                    " ON CONFLICT (a) DO UPDATE SET q = q + excluded.q")
+                r = await s.execute("SELECT q FROM co WHERE id = 5")
+                assert r.rows == [{"q": 6}]
+                # composite non-unique index: full and prefix lookups
+                await s.execute("CREATE INDEX co_aq ON co (a, q)")
+                pks = await c.index_lookup("co", "co_aq", [7, 6])
+                assert [p["id"] for p in pks] == [5]
+                pks = sorted(p["id"] for p in
+                             await c.index_lookup("co", "co_aq", [7]))
+                assert pks == [1, 2, 5, 6]
+                # string components end with terminators: 'c' is not a
+                # prefix-match of 'cd'
+                await s.execute("INSERT INTO co (id, a, b, q) VALUES "
+                                "(11, 9, 'cd', 1), (12, 9, 'c', 1)")
+            finally:
+                await mc.shutdown()
+        run(go())

@@ -105,35 +105,48 @@ async def build_index_ops(ct, table: str, ops, getter):
         olds.append(await getter(table, pk_row) if pk_row else None)
     out = []
     for index_name, spec in ct.indexes.items():
-        col = spec["column"]
+        cols = spec.get("columns") or [spec["column"]]
         unique = spec.get("unique")
         ins_ops: List[RowOp] = []
         del_ops: List[RowOp] = []
         ins_undo: List[RowOp] = []
         del_undo: List[RowOp] = []
+
+        def vals_of(row):
+            # a row indexes only when EVERY indexed column is non-NULL
+            # (single-column behavior generalized; unique-wise this
+            # approximates PG's NULLS-DISTINCT semantics)
+            vs = tuple(row.get(c) for c in cols)
+            return None if any(v is None for v in vs) else vs
+
+        def entry_key(vs):
+            return dict(zip(cols, vs))
+
         for op, old in zip(ops, olds):
-            full_old = old and old.get(col) is not None and {
-                col: old[col],
+            old_vs = vals_of(old) if old else None
+            full_old = old_vs and {
+                **entry_key(old_vs),
                 **{f"base_{n}": old[n] for n in pk_names}}
+            new_vs = (vals_of(op.row)
+                      if op.kind in ("upsert", "insert") else None)
             if full_old:
-                if op.kind == "delete" or old.get(col) != op.row.get(col):
-                    # unique index keys on the value alone: the delete
-                    # targets {col}; base_* live in the value
-                    del_ops.append(RowOp("delete", {
-                        col: old[col]} if unique else dict(full_old)))
+                if op.kind == "delete" or old_vs != new_vs:
+                    # unique index keys on the value tuple alone: the
+                    # delete targets it; base_* live in the value
+                    del_ops.append(RowOp("delete", entry_key(old_vs)
+                                         if unique else dict(full_old)))
                     del_undo.append(RowOp("upsert", dict(full_old)))
-            if op.kind in ("upsert", "insert") \
-                    and op.row.get(col) is not None:
-                if old is not None and old.get(col) == op.row.get(col):
+            if new_vs is not None:
+                if old_vs == new_vs:
                     continue   # entry already present for this row
-                new_row = {col: op.row[col],
+                new_row = {**entry_key(new_vs),
                            **{f"base_{n}": op.row[n] for n in pk_names}}
-                # unique: insert-if-absent so a duplicate value
+                # unique: insert-if-absent so a duplicate value tuple
                 # collides on the shared doc key and is rejected
                 ins_ops.append(RowOp("insert" if unique else "upsert",
                                      new_row))
-                ins_undo.append(RowOp("delete", {
-                    col: op.row[col]} if unique else new_row))
+                ins_undo.append(RowOp("delete", entry_key(new_vs)
+                                     if unique else new_row))
         # Batch ordering within one index:
         #   1. inserts of values NOT being handed over (fail-fast on a
         #      real duplicate BEFORE any delete lands — a single mixed
@@ -145,11 +158,13 @@ async def build_index_ops(ct, table: str, ops, getter):
         #      RELEASING (a re-keying update moves the value to a new
         #      base pk): they can only succeed after their delete.
         if unique:
-            released = {o.row[col] for o in del_ops}
+            def key_of(o):
+                return tuple(o.row.get(c) for c in cols)
+            released = {key_of(o) for o in del_ops}
             safe = [i for i, o in enumerate(ins_ops)
-                    if o.row[col] not in released]
+                    if key_of(o) not in released]
             hand = [i for i, o in enumerate(ins_ops)
-                    if o.row[col] in released]
+                    if key_of(o) in released]
         else:
             safe, hand = list(range(len(ins_ops))), []
         if safe:
@@ -538,13 +553,19 @@ class YBClient:
     async def index_lookup(self, table: str, index_name: str, value
                            ) -> List[dict]:
         """Indexed-equality lookup: prefix-scan the index tablet owning
-        `value`, return base-table PK rows."""
+        the value, return base-table PK rows.  `value` is a scalar for
+        single-column indexes or a list/tuple for composite ones (a
+        PREFIX of the index columns suffices — the first column routes
+        the hash)."""
         ct = await self._table(table)
         spec = ct.indexes[index_name]
         ict = await self._table(spec["index_table"])
-        col = spec["column"]
-        loc = self._tablet_for_hash_key(ict, {col: value})
-        req = ReadRequest(ict.info.table_id, pk_prefix={col: value})
+        cols = spec.get("columns") or [spec["column"]]
+        vals = (list(value) if isinstance(value, (list, tuple))
+                else [value])
+        prefix = dict(zip(cols, vals))
+        loc = self._tablet_for_hash_key(ict, prefix)
+        req = ReadRequest(ict.info.table_id, pk_prefix=prefix)
         payload = {"tablet_id": loc.tablet_id,
                    "req": read_request_to_wire(req)}
         resp = read_response_from_wire(
@@ -553,30 +574,34 @@ class YBClient:
                 for r in resp.rows]
 
     async def create_secondary_index(self, table: str, index_name: str,
-                                     column: str,
-                                     unique: bool = False) -> int:
+                                     column, unique: bool = False
+                                     ) -> int:
         """Create + backfill (reference: online backfill,
         master/backfill_index.cc — ours quiesces via full scan).  A
         UNIQUE index keys the index table by the indexed value alone,
         so duplicate inserts collide on one doc key and the write
         path's insert-if-absent gate rejects them; the backfill itself
         surfaces pre-existing duplicates as DUPLICATE_KEY."""
+        columns = (list(column) if isinstance(column, (list, tuple))
+                   else [column])
         await self._master_call(
             "create_secondary_index",
-            {"table": table, "index_name": index_name, "column": column,
+            {"table": table, "index_name": index_name,
+             "column": columns[0], "columns": columns,
              "unique": unique},
             timeout=60.0)
         self._tables.pop(table, None)
         ct = await self._table(table)
         pk_names = [c.name for c in ct.info.schema.key_columns]
         resp = await self.scan(table, ReadRequest(
-            "", columns=tuple(pk_names + [column])))
-        rows = [r for r in resp.rows if r.get(column) is not None]
+            "", columns=tuple(pk_names + columns)))
+        rows = [r for r in resp.rows
+                if all(r.get(c) is not None for c in columns)]
         if rows:
             try:
                 await self.write(index_name, [
                     RowOp("insert" if unique else "upsert",
-                          {column: r[column],
+                          {**{c: r[c] for c in columns},
                            **{f"base_{n}": r[n] for n in pk_names}})
                     for r in rows])
             except RpcError:

@@ -201,8 +201,11 @@ class TableCodec:
             self.info.cotable_id.to_bytes(4, "big")
 
     def hash_prefix(self, row: Dict[str, object]) -> bytes:
-        """Encoded prefix covering just the hash components — used for
-        prefix scans (e.g. secondary-index lookups by indexed value)."""
+        """Encoded prefix covering the hash components plus any
+        CONTIGUOUS leading range components present in `row` — used for
+        prefix scans (secondary-index lookups by indexed value; a
+        composite index narrows by every provided column, not just the
+        hashed first one)."""
         from ..dockv.key_encoding import KeyBytes
         ps = self.info.partition_schema
         entries = []
@@ -214,6 +217,19 @@ class TableCodec:
         kb.append_hash(hash_key_for(entries))
         for e in entries:
             kb.append_entry(e)
+        range_cols = [c for c in self._pk_cols[ps.num_hash_columns:]]
+        provided = []
+        for c in range_cols:
+            if c.name not in row or row[c.name] is None:
+                break       # prefix must stay contiguous in pk order
+            provided.append(c)
+        if provided:
+            # the hash group closes with kGroupEnd before range
+            # components (DocKey layout) — without it the prefix can
+            # never match a stored key
+            kb.append_group_end()
+            for c in provided:
+                kb.append_entry(_KEV_MAKER[c.type](row[c.name]))
         return kb.data()
 
     def decode_row(self, key: bytes, value: bytes) -> Optional[Dict[str, object]]:

@@ -1476,24 +1476,30 @@ class Master:
         backfills existing rows at creation."""
         base_name = payload["table"]
         index_name = payload["index_name"]
-        column = payload["column"]
+        columns = payload.get("columns") or [payload["column"]]
         tid = next((t for t, e in self.tables.items()
                     if e["info"]["name"] == base_name), None)
         if tid is None:
             raise RpcError(f"table {base_name} not found", "NOT_FOUND")
         base = self.tables[tid]
         base_info = TableInfo.from_wire(base["info"])
-        col = base_info.schema.column_by_name(column)
         pk_cols = base_info.schema.key_columns
         unique = bool(payload.get("unique"))
-        cols = [ColumnSchema(0, column, col.type, is_hash_key=True)]
+        # composite index key: first indexed column hashed, the rest
+        # range — the doc key is the FULL value tuple, so a UNIQUE
+        # index collides two inserts of one tuple on the same key and
+        # the write path's insert-if-absent / txn conflict machinery
+        # lets exactly one commit (reference: unique-index key layout
+        # in yb_access/yb_lsm.c:233-366 — base PK moves to the value)
+        cols = []
+        for i, cname in enumerate(columns):
+            col = base_info.schema.column_by_name(cname)
+            cols.append(ColumnSchema(i, cname, col.type,
+                                     is_hash_key=(i == 0),
+                                     is_range_key=(i > 0)))
+        off = len(columns)
         for i, c in enumerate(pk_cols):
-            # UNIQUE: the index doc key is ONLY the indexed value, so
-            # two inserts of one value hit the same key and the write
-            # path's insert-if-absent / txn conflict machinery lets
-            # exactly one commit (reference: unique-index key layout in
-            # yb_access/yb_lsm.c:233-366 — base PK moves to the value)
-            cols.append(ColumnSchema(i + 1, f"base_{c.name}", c.type,
+            cols.append(ColumnSchema(off + i, f"base_{c.name}", c.type,
                                      is_range_key=not unique))
         idx_info = TableInfo(
             "", index_name, TableSchema(tuple(cols), 1),
@@ -1505,7 +1511,8 @@ class Master:
         tent = dict(base)
         idxs = dict(tent.get("indexes", {}))
         idxs[index_name] = {
-            "column": column, "index_table": index_name,
+            "column": columns[0], "columns": list(columns),
+            "index_table": index_name,
             "base_pk": [c.name for c in pk_cols], "unique": unique}
         tent["indexes"] = idxs
         await self._commit_catalog([["put_table", tid, tent]])

@@ -90,6 +90,11 @@ def device_compatible(node: ExprNode) -> bool:
         # large lists (IN-subquery results) run on the CPU set path
         if len(node[2]) > 64:
             return False
+        if any(v is None for v in node[2]):
+            # IN (..., NULL) carries SQL 3VL (a non-match is UNKNOWN,
+            # which matters under NOT IN) — only the CPU row evaluator
+            # implements that; the compiled kernel must not see it
+            return False
         return device_compatible(node[1])
     if node[0] in ("like", "ilike"):
         return isinstance(node[1], (tuple, list)) and \

@@ -191,11 +191,16 @@ class SqlSession:
             ct = await self.client._table(stmt.table)
             col = ct.info.schema.column_by_name(stmt.column)
             if col.type == ColumnType.VECTOR or stmt.method == "ivfflat":
+                if len(getattr(stmt, "columns", None) or [1]) > 1:
+                    raise ValueError(
+                        "ivfflat indexes cover exactly one vector "
+                        "column")
                 n = await self.client.build_vector_index(
                     stmt.table, stmt.column, stmt.lists)
             else:
                 n = await self.client.create_secondary_index(
-                    stmt.table, stmt.name, stmt.column,
+                    stmt.table, stmt.name,
+                    getattr(stmt, "columns", None) or stmt.column,
                     unique=getattr(stmt, "unique", False))
             return SqlResult([], f"CREATE INDEX ({n} rows)")
         if isinstance(stmt, ExplainStmt):
@@ -578,8 +583,10 @@ class SqlSession:
         # (the index doc key is the value itself, so duplicates collide
         # — reference: yb_access/yb_lsm.c:233-366)
         for col in getattr(stmt, "unique_cols", []):
+            cols = list(col) if isinstance(col, tuple) else [col]
             await self.client.create_secondary_index(
-                stmt.name, f"{stmt.name}_{col}_key", col, unique=True)
+                stmt.name, f"{stmt.name}_{'_'.join(cols)}_key", cols,
+                unique=True)
         return SqlResult([], "CREATE TABLE")
 
     def _invalidate_stats(self, table: str) -> None:
@@ -850,17 +857,20 @@ class SqlSession:
         pend = (self._txn.pending_writes(ct.info.name)
                 if self._txn is not None else {})
         for index_name, spec in (ct.indexes or {}).items():
-            col = spec["column"]
-            if not spec.get("unique") or row.get(col) is None:
+            icols = spec.get("columns") or [spec["column"]]
+            col = icols[0]
+            if not spec.get("unique") or \
+                    any(row.get(c) is None for c in icols):
                 continue
+            vals = [row[c] for c in icols]
             for op in pend.values():
-                if op.kind != "delete" \
-                        and op.row.get(col) == row[col]:
+                if op.kind != "delete" and all(
+                        op.row.get(c) == row[c] for c in icols):
                     full = await get({n: op.row[n] for n in pk_names})
                     return col, (full if full is not None
                                  else dict(op.row))
             pks = await self.client.index_lookup(
-                ct.info.name, index_name, row[col])
+                ct.info.name, index_name, vals)
             if pks:
                 got = await get(pks[0])
                 if got is not None:
@@ -1046,11 +1056,6 @@ class SqlSession:
             return ("in", self._bind(node[1], schema), node[2])
         if kind in ("like", "ilike"):
             return (kind, self._bind(node[1], schema), node[2])
-        if kind == "isdistinct":
-            return ("isdistinct", self._bind(node[1], schema),
-                    self._bind(node[2], schema))
-        if kind == "sagg":
-            return ("sagg", self._bind(node[1], schema), node[2])
         if kind == "json":
             return ("json", node[1], self._bind(node[2], schema), node[3])
         return (kind,) + tuple(

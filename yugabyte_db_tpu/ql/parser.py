@@ -103,10 +103,11 @@ class CreateTableStmt:
 class CreateIndexStmt:
     name: str
     table: str
-    column: str
+    column: str             # first indexed column
     method: str = "lsm"     # 'lsm' secondary index | 'ivfflat' vector ANN
     lists: int = 100
     unique: bool = False    # CREATE UNIQUE INDEX
+    columns: List[str] = field(default_factory=list)   # full list
 
 
 @dataclass
@@ -565,10 +566,15 @@ class Parser:
                 self.expect_op(")")
                 pk = pk_cols
             elif self.accept_kw("unique"):
-                # table-level UNIQUE (col)
+                # table-level UNIQUE (col[, col...]) — composite
+                # constraints store the tuple
                 self.expect_op("(")
-                unique_cols.append(self.ident())
+                ucs = [self.ident()]
+                while self.accept_op(","):
+                    ucs.append(self.ident())
                 self.expect_op(")")
+                unique_cols.append(ucs[0] if len(ucs) == 1
+                                   else tuple(ucs))
             elif self.accept_kw("foreign"):
                 # FOREIGN KEY (col) REFERENCES parent (pcol)
                 self.expect_kw("key")
@@ -581,8 +587,12 @@ class Parser:
                 self.ident()           # constraint name (not stored)
                 if self.accept_kw("unique"):
                     self.expect_op("(")
-                    unique_cols.append(self.ident())
+                    ucs = [self.ident()]
+                    while self.accept_op(","):
+                        ucs.append(self.ident())
                     self.expect_op(")")
+                    unique_cols.append(ucs[0] if len(ucs) == 1
+                                       else tuple(ucs))
                 elif self.accept_kw("foreign"):
                     self.expect_kw("key")
                     self.expect_op("(")
@@ -678,7 +688,10 @@ class Parser:
         if self.accept_kw("using"):
             method = self.ident().lower()
         self.expect_op("(")
-        column = self.ident()
+        columns = [self.ident()]
+        while self.accept_op(","):
+            columns.append(self.ident())
+        column = columns[0]
         self.expect_op(")")
         lists = 100
         while self.accept_kw("with"):
@@ -686,7 +699,7 @@ class Parser:
             self.expect_op("=")
             lists = int(self.next()[1])
         return CreateIndexStmt(name, table, column, method, lists,
-                               unique=unique)
+                               unique=unique, columns=columns)
 
     def alter_table(self):
         self.expect_kw("alter")
