@@ -1,0 +1,18 @@
+-- correlated subqueries: per-row subplans (reference: PG correlated
+-- SubPlans — Vars with varlevelsup > 0 — through the YSQL executor)
+CREATE TABLE author (id bigint PRIMARY KEY, name text) WITH tablets = 1;
+CREATE TABLE book (id bigint PRIMARY KEY, author_id bigint, pages bigint) WITH tablets = 1;
+INSERT INTO author (id, name) VALUES (1, 'ann'), (2, 'bob'), (3, 'cyd');
+INSERT INTO book (id, author_id, pages) VALUES (1, 1, 100), (2, 1, 250), (3, 2, 50);
+-- correlated EXISTS / NOT EXISTS
+SELECT name FROM author WHERE EXISTS (SELECT 1 FROM book WHERE book.author_id = author.id AND book.pages > 200) ORDER BY name;
+SELECT name FROM author WHERE NOT EXISTS (SELECT 1 FROM book WHERE book.author_id = author.id) ORDER BY name;
+-- correlated scalar subquery in the select list
+SELECT name, (SELECT count(*) FROM book WHERE book.author_id = author.id) AS books FROM author ORDER BY name;
+SELECT name, (SELECT max(pages) FROM book WHERE book.author_id = author.id) AS longest FROM author ORDER BY name;
+-- correlated scalar in WHERE, mixed with a pushable conjunct
+SELECT name FROM author WHERE id < 3 AND (SELECT count(*) FROM book WHERE book.author_id = author.id) = 1 ORDER BY name;
+-- correlated IN
+SELECT name FROM author WHERE id IN (SELECT author_id FROM book WHERE book.pages < author.id * 100) ORDER BY name;
+DROP TABLE book;
+DROP TABLE author;

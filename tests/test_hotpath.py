@@ -65,17 +65,25 @@ class TestDocKeyEncodeEquivalence:
                 assert codec.doc_key_prefix(row) == \
                     codec.doc_key(row).encode(), row
 
-    def test_null_pk_component_errors_like_python(self):
-        """NULL pk components are unsupported on both paths: the C
-        encoder must not silently produce bytes where Python raises."""
+    def test_null_pk_components(self):
+        """NULL RANGE components encode as kNull (PG indexes rows with
+        NULL key parts — composite index entries need it); the C fast
+        path declines them and the Python fallback produces the bytes,
+        so both paths stay consistent.  NULL HASH components still
+        error — they route the tablet."""
         schema = TableSchema((
             ColumnSchema(0, "a", ColumnType.INT64, is_hash_key=True),
             ColumnSchema(1, "b", ColumnType.STRING, is_range_key=True),
         ), 1)
         codec = TableCodec(TableInfo("t", "t", schema,
                                      PartitionSchema("hash", 1)))
+        k_null = codec.doc_key_prefix({"a": 5, "b": None})
+        k_val = codec.doc_key_prefix({"a": 5, "b": "x"})
+        assert k_null != k_val
+        # stable and distinct from any real value's encoding
+        assert k_null == codec.doc_key_prefix({"a": 5, "b": None})
         with pytest.raises(Exception):
-            codec.doc_key_prefix({"a": 5, "b": None})
+            codec.doc_key_prefix({"a": None, "b": "x"})
 
 
 class TestExtractorEquivalence:

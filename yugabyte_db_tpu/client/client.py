@@ -113,11 +113,20 @@ async def build_index_ops(ct, table: str, ops, getter):
         del_undo: List[RowOp] = []
 
         def vals_of(row):
-            # a row indexes only when EVERY indexed column is non-NULL
-            # (single-column behavior generalized; unique-wise this
-            # approximates PG's NULLS-DISTINCT semantics)
+            # non-unique: a row indexes when its FIRST (hash-routing)
+            # column is non-NULL — NULL range components encode as
+            # kNull, so composite entries with trailing NULLs still
+            # serve first-column lookups (PG indexes such rows).
+            # UNIQUE: any NULL skips the entry — PG's NULLS-DISTINCT
+            # means NULL-bearing tuples never conflict, so they must
+            # not occupy a shared doc key (documented approximation:
+            # they are not index-servable either).
             vs = tuple(row.get(c) for c in cols)
-            return None if any(v is None for v in vs) else vs
+            if vs[0] is None:
+                return None
+            if unique and any(v is None for v in vs):
+                return None
+            return vs
 
         def entry_key(vs):
             return dict(zip(cols, vs))
@@ -596,7 +605,9 @@ class YBClient:
         resp = await self.scan(table, ReadRequest(
             "", columns=tuple(pk_names + columns)))
         rows = [r for r in resp.rows
-                if all(r.get(c) is not None for c in columns)]
+                if r.get(columns[0]) is not None
+                and (not unique or all(r.get(c) is not None
+                                       for c in columns))]
         if rows:
             try:
                 await self.write(index_name, [

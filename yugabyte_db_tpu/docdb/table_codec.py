@@ -152,8 +152,16 @@ class TableCodec:
     # --- scalar paths -----------------------------------------------------
     def pk_entries(self, row: Dict[str, object]) -> List[KeyEntryValue]:
         out = []
-        for c in self._pk_cols:
+        nh = self.info.partition_schema.num_hash_columns
+        for i, c in enumerate(self._pk_cols):
             v = row[c.name]
+            if v is None and i >= nh:
+                # NULL range components encode as kNull (PG indexes
+                # rows with NULL key parts; hash components still
+                # require a value — they route the tablet)
+                e = KeyEntryValue.null(desc=c.sort_desc)
+                out.append(e)
+                continue
             maker = _KEV_MAKER[c.type]
             e = maker(v)
             if c.sort_desc:

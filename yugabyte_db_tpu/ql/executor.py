@@ -1078,11 +1078,142 @@ class SqlSession:
             await self._txn.lock_rows(
                 table, [{n: r[n] for n in pk_names} for r in resp.rows])
 
-    async def _resolve_subqueries(self, node, seq_ok: bool = False):
+    async def _correlate(self, sub, outer_schema, outer_names):
+        """Detect outer references in a subquery (reference: PG
+        correlated subplans — Vars with varlevelsup > 0).  Returns
+        (sub', params): sub' has every outer reference in its WHERE
+        replaced by an ("outerref", bare_name) placeholder; params is
+        the referenced outer column set.  A reference is OUTER when it
+        is qualified with the outer table/alias, or bare, absent from
+        the inner schema, and present in the outer one."""
+        if sub.table is None or sub.table in self._cte_rows \
+                or getattr(sub, "joins", None):
+            return sub, []
+        try:
+            inner_schema = (await self.client._table(
+                sub.table)).info.schema
+        except Exception:   # noqa: BLE001 — vtable etc: no detection
+            return sub, []
+        inner_cols = {c.name for c in inner_schema.columns}
+        outer_cols = {c.name for c in outer_schema.columns}
+        # an ALIAS hides the table name inside the subquery (PG): with
+        # FROM t t2, a t.x reference is an OUTER reference
+        inner_quals = {sub.table_alias or sub.table}
+        params: list = []
+
+        def walk(n):
+            if not isinstance(n, tuple):
+                return n
+            if n[0] == "col" and isinstance(n[1], str):
+                q, bare = self._split_qual(n[1])
+                if q is not None and q in outer_names \
+                        and q not in inner_quals:
+                    if bare not in params:
+                        params.append(bare)
+                    return ("outerref", bare)
+                if q is None and bare not in inner_cols \
+                        and bare in outer_cols:
+                    if bare not in params:
+                        params.append(bare)
+                    return ("outerref", bare)
+                return n
+            return tuple(walk(c) if isinstance(c, tuple) else c
+                         for c in n)
+
+        if sub.where is None:
+            return sub, []
+        import dataclasses
+        new_where = walk(sub.where)
+        if not params:
+            return sub, []
+        return dataclasses.replace(sub, where=new_where), params
+
+    @staticmethod
+    def _subst_outerrefs(node, row: dict):
+        if not isinstance(node, tuple):
+            return node
+        if node[0] == "outerref":
+            return ("const", row.get(node[1]))
+        return tuple(SqlSession._subst_outerrefs(c, row)
+                     if isinstance(c, tuple) else c for c in node)
+
+    async def _replace_corr(self, node, row: dict, cache: dict):
+        """Replace every correlated marker in an AST with its computed
+        plain form for this outer row."""
+        if not isinstance(node, tuple):
+            return node
+        if node[0] == "corr":
+            return await self._corr_to_ast(node, row, cache)
+        out = []
+        for c in node:
+            out.append(await self._replace_corr(c, row, cache)
+                       if isinstance(c, tuple) else c)
+        return tuple(out)
+
+    async def _corr_to_ast(self, corr, row: dict, cache: dict):
+        """One correlated marker -> a plain AST for this outer row
+        (executing the subquery with the row's values substituted;
+        memoized per distinct parameter tuple)."""
+        _, kind, sub, params = corr[:4]
+        key = (id(corr), tuple(row.get(p) for p in params))
+        if key in cache:
+            return cache[key]
+        import dataclasses
+        bound_sub = dataclasses.replace(
+            sub, where=self._subst_outerrefs(sub.where, row))
+        if kind == "exists":
+            bound_sub = dataclasses.replace(bound_sub, limit=1)
+            res = await self._select(bound_sub)
+            out = ("const", bool(res.rows))
+        elif kind == "scalar":
+            res = await self._select(bound_sub)
+            if len(res.rows) > 1:
+                raise ValueError(
+                    "scalar subquery produced more than one row")
+            v = (next(iter(res.rows[0].values()))
+                 if res.rows else None)
+            out = ("const", v)
+        else:   # "in"
+            res = await self._select(bound_sub)
+            raw = [next(iter(r.values())) for r in res.rows]
+            vals = sorted({v for v in raw if v is not None})
+            in_node = ("in", corr[4], vals)
+            if any(v is None for v in raw):
+                out = ("or", in_node,
+                       ("cmp", "eq", ("const", None), ("const", None)))
+            else:
+                out = in_node
+        cache[key] = out
+        return out
+
+    async def _eval_corr_conjunct(self, node, row: dict, schema,
+                                  cache: dict) -> bool:
+        """Evaluate a WHERE conjunct containing correlated markers for
+        one outer row."""
+        plain = await self._replace_corr(node, row, cache)
+        from ..docdb.operations import eval_expr_py
+        idrow = {c.id: row.get(c.name) for c in schema.columns}
+        return eval_expr_py(self._bind(plain, schema), idrow) is True
+
+    @staticmethod
+    def _has_corr(node) -> bool:
+        if not isinstance(node, tuple):
+            return False
+        if node[0] == "corr":
+            return True
+        return any(SqlSession._has_corr(c) for c in node
+                   if isinstance(c, tuple))
+
+    async def _resolve_subqueries(self, node, seq_ok: bool = False,
+                                  outer=None):
         """Replace ("in_subquery", expr, SelectStmt) with a plain
         ("in", expr, values) by running the subquery (semi-join via
         materialized value list — the reference plans these as hash
         semi-joins; ours inlines, which also keeps pushdown working).
+        With `outer` = (schema, {names}) context, CORRELATED subqueries
+        (referencing outer columns) defer to per-row evaluation via
+        ("corr", kind, sub, params[, expr]) markers instead of
+        executing here.
         seq_ok: nextval()/currval() may resolve here ONLY in
         single-row contexts (FROM-less SELECT) — statement-level
         resolution in a multi-row scan would hand every row the same
@@ -1091,6 +1222,17 @@ class SqlSession:
             return node
         if node[0] == "in_subquery":
             sub = node[2]
+            if outer is not None:
+                sub_c, params = await self._correlate(sub, *outer)
+                if params:
+                    if len(sub_c.items) != 1 \
+                            or sub_c.items[0][0] == "star":
+                        raise ValueError(
+                            "IN (SELECT ...) must produce exactly one "
+                            "column")
+                    inner = await self._resolve_subqueries(
+                        node[1], seq_ok, outer)
+                    return ("corr", "in", sub_c, params, inner)
             # static shape check (deterministic even on empty results)
             if len(sub.items) != 1 or sub.items[0][0] == "star":
                 raise ValueError(
@@ -1123,6 +1265,10 @@ class SqlSession:
                 v = self.client.sequence_current(arg[1])
             return ("const", v)
         if node[0] == "exists_subquery":
+            if outer is not None:
+                sub_c, params = await self._correlate(node[1], *outer)
+                if params:
+                    return ("corr", "exists", sub_c, params)
             # uncorrelated EXISTS: one probe row decides it
             import dataclasses
             sub = dataclasses.replace(node[1], limit=1)
@@ -1133,6 +1279,10 @@ class SqlSession:
             if len(sub.items) != 1 or sub.items[0][0] == "star":
                 raise ValueError(
                     "scalar subquery must produce exactly one column")
+            if outer is not None:
+                sub_c, params = await self._correlate(sub, *outer)
+                if params:
+                    return ("corr", "scalar", sub_c, params)
             res = await self._select(sub)
             if len(res.rows) > 1:
                 raise ValueError(
@@ -1141,7 +1291,7 @@ class SqlSession:
             return ("const", v)
         out = []
         for c in node:
-            out.append(await self._resolve_subqueries(c, seq_ok)
+            out.append(await self._resolve_subqueries(c, seq_ok, outer)
                        if isinstance(c, tuple) else c)
         return tuple(out)
 
@@ -1291,12 +1441,53 @@ class SqlSession:
             raise ValueError(
                 "FOR UPDATE/FOR SHARE is not allowed with joins, "
                 "aggregates, GROUP BY, DISTINCT, or window functions")
+        # outer context for correlated-subquery detection: only plain
+        # single-real-table scans support per-row subplan evaluation
+        outer = None
+        if stmt.table is not None and not getattr(stmt, "joins", None) \
+                and stmt.table not in self._cte_rows:
+            try:
+                outer_schema = (await self.client._table(
+                    stmt.table)).info.schema
+                outer = (outer_schema,
+                         {stmt.table, stmt.table_alias or stmt.table})
+            except Exception:   # noqa: BLE001 — vtables etc.
+                outer = None
         if stmt.where is not None:
-            stmt.where = await self._resolve_subqueries(stmt.where)
+            stmt.where = await self._resolve_subqueries(stmt.where,
+                                                        outer=outer)
         for i, it in enumerate(stmt.items):
             if it[0] == "expr":
                 stmt.items[i] = ("expr", await self._resolve_subqueries(
-                    it[1], seq_ok=stmt.table is None))
+                    it[1], seq_ok=stmt.table is None, outer=outer))
+        corr_where: list = []
+        if stmt.where is not None and self._has_corr(stmt.where):
+            # split AND-conjuncts: uncorrelated parts stay pushable,
+            # correlated ones evaluate client-side per row (PG:
+            # correlated subplans re-execute per outer row)
+            conjs: list = []
+
+            def flatten(n):
+                if isinstance(n, tuple) and n[0] == "and":
+                    flatten(n[1])
+                    flatten(n[2])
+                else:
+                    conjs.append(n)
+            flatten(stmt.where)
+            push = [c for c in conjs if not self._has_corr(c)]
+            corr_where = [c for c in conjs if self._has_corr(c)]
+            w = None
+            for c in push:
+                w = c if w is None else ("and", w, c)
+            stmt.where = w
+        corr_items = [i for i, it in enumerate(stmt.items)
+                      if it[0] == "expr" and self._has_corr(it[1])]
+        if (corr_where or corr_items) and (
+                stmt.group_by or stmt.distinct
+                or any(it[0] in ("agg", "window") for it in stmt.items)):
+            raise ValueError(
+                "correlated subqueries are supported in plain row "
+                "scans (no aggregates/GROUP BY/DISTINCT here yet)")
         if stmt.table is None:
             # FROM-less constant SELECT: one row of evaluated items
             row = {}
@@ -1406,8 +1597,10 @@ class SqlSession:
             return await self._grouped_clientside(stmt, ct, where)
 
         # index-accelerated equality lookup (reference: index scans via
-        # yb_lsm.c index AM)
-        idx_rows = await self._try_index_path(stmt, ct, where)
+        # yb_lsm.c index AM) — not when correlated parts remain: the
+        # early return would skip their per-row evaluation
+        idx_rows = (None if (corr_where or corr_items)
+                    else await self._try_index_path(stmt, ct, where))
         if idx_rows is not None:
             rows = [self._project_row(stmt, r, schema) for r in idx_rows]
             return SqlResult(self._order_limit(stmt, rows))
@@ -1429,6 +1622,21 @@ class SqlSession:
                       if not (stmt.distinct or stmt.offset or has_window
                               or for_update or for_share)
                       and (natural or not stmt.order_by) else None)
+        if corr_where:
+            # client-side correlated filtering: project the conjuncts'
+            # outer columns and never push a limit (rows drop after the
+            # scan)
+            need: set = set()
+            for conj in corr_where:
+                self._collect_names(conj, need)
+            cols_set = set(columns)
+            for n in need:
+                bare = self._split_qual(n)[1]
+                if bare not in cols_set and any(
+                        c.name == bare for c in schema.columns):
+                    columns = list(columns) + [bare]
+                    cols_set.add(bare)
+            push_limit = None
         if for_update or for_share or (
                 self._txn is not None
                 and self._txn.pending_writes(stmt.table)):
@@ -1447,6 +1655,38 @@ class SqlSession:
         if self._txn is not None:
             base_rows = self._overlay_txn_writes(
                 stmt.table, schema, where, base_rows)
+        if corr_where:
+            cache: dict = {}
+            kept = []
+            for r in base_rows:
+                ok = True
+                for conj in corr_where:
+                    if not await self._eval_corr_conjunct(
+                            conj, r, schema, cache):
+                        ok = False
+                        break
+                if ok:
+                    kept.append(r)
+            base_rows = kept
+        if corr_items:
+            # correlated scalar subqueries in the select list: compute
+            # per outer row, then project as a synthetic column under
+            # the item's original output name (eval_expr_py is the
+            # module-level import — a local import here would shadow it
+            # for the WHOLE function, breaking earlier uses)
+            cache_i: dict = {}
+            for i in corr_items:
+                name = self._item_name(stmt, i)
+                key = f"__corr{i}"
+                for r in base_rows:
+                    ast = await self._replace_corr(
+                        stmt.items[i][1], r, cache_i)
+                    idrow = {c.id: r.get(c.name)
+                             for c in schema.columns}
+                    r[key] = eval_expr_py(self._bind(ast, schema),
+                                          idrow)
+                stmt.aliases[i] = stmt.aliases.get(i, name)
+                stmt.items[i] = ("col", key)
         if for_share:
             # SELECT ... FOR SHARE: shared read locks on the matched
             # rows — readers don't block readers, writers wait and a
@@ -2259,6 +2499,13 @@ class SqlSession:
     def _collect_names(self, node, out: set):
         if node[0] == "col":
             out.add(node[1])
+            return
+        if node[0] == "corr":
+            # a correlated marker needs its OUTER parameter columns;
+            # the inner SelectStmt's names are another table's
+            out.update(node[3])
+            if len(node) > 4 and isinstance(node[4], tuple):
+                self._collect_names(node[4], out)
             return
         for c in node[1:]:
             if isinstance(c, tuple):

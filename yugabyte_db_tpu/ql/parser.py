@@ -568,13 +568,7 @@ class Parser:
             elif self.accept_kw("unique"):
                 # table-level UNIQUE (col[, col...]) — composite
                 # constraints store the tuple
-                self.expect_op("(")
-                ucs = [self.ident()]
-                while self.accept_op(","):
-                    ucs.append(self.ident())
-                self.expect_op(")")
-                unique_cols.append(ucs[0] if len(ucs) == 1
-                                   else tuple(ucs))
+                unique_cols.append(self._unique_col_list())
             elif self.accept_kw("foreign"):
                 # FOREIGN KEY (col) REFERENCES parent (pcol)
                 self.expect_kw("key")
@@ -586,13 +580,7 @@ class Parser:
             elif self.accept_kw("constraint"):
                 self.ident()           # constraint name (not stored)
                 if self.accept_kw("unique"):
-                    self.expect_op("(")
-                    ucs = [self.ident()]
-                    while self.accept_op(","):
-                        ucs.append(self.ident())
-                    self.expect_op(")")
-                    unique_cols.append(ucs[0] if len(ucs) == 1
-                                       else tuple(ucs))
+                    unique_cols.append(self._unique_col_list())
                 elif self.accept_kw("foreign"):
                     self.expect_kw("key")
                     self.expect_op("(")
@@ -653,6 +641,15 @@ class Parser:
                                defaults, not_null, tablespace=tspace,
                                unique_cols=unique_cols,
                                foreign_keys=foreign_keys)
+
+    def _unique_col_list(self):
+        """Parenthesized UNIQUE column list -> name or tuple."""
+        self.expect_op("(")
+        ucs = [self.ident()]
+        while self.accept_op(","):
+            ucs.append(self.ident())
+        self.expect_op(")")
+        return ucs[0] if len(ucs) == 1 else tuple(ucs)
 
     def _column_type(self) -> str:
         """One column type: plain (`bigint`), parameterized
