@@ -281,7 +281,31 @@ class XClusterReplicator:
             # (external hybrid time) so target reads at xCluster safe
             # time see exactly the source's consistent cut
             groups: List[Tuple[int, List[RowOp]]] = []
+
+            async def flush_groups():
+                nonlocal n
+                for ht_, ops_ in groups:
+                    await self.target.write(self.table, ops_,
+                                            external_ht=ht_ or None)
+                    self.replicated += len(ops_)
+                    n += len(ops_)
+                groups.clear()
+
             for c in changes:
+                if c["op"] == "truncate":
+                    # source TRUNCATE replicates as a target truncate
+                    # at the same stream position — earlier changes
+                    # must land first, later ones after.  One statement
+                    # emits one WAL entry PER TABLET at one shared ht:
+                    # apply once, skip the siblings (re-applying would
+                    # wipe later rows already flushed to the target)
+                    if c.get("ht") == getattr(self, "_last_truncate_ht",
+                                              None):
+                        continue
+                    self._last_truncate_ht = c.get("ht")
+                    await flush_groups()
+                    await self.target.truncate_table(self.table)
+                    continue
                 op = RowOp("delete" if c["op"] == "delete" else "upsert",
                            c["row"])
                 ht = c.get("ht", 0)
@@ -289,11 +313,7 @@ class XClusterReplicator:
                     groups[-1][1].append(op)
                 else:
                     groups.append((ht, [op]))
-            for ht, ops in groups:
-                await self.target.write(self.table, ops,
-                                        external_ht=ht or None)
-                self.replicated += len(ops)
-                n += len(ops)
+            await flush_groups()
         # checkpoint persists only after the target accepted the batch
         await self.stream.commit_checkpoints()
         await self._publish_safe_time()

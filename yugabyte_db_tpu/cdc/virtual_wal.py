@@ -276,6 +276,19 @@ class VirtualWal:
                     t.pending_tids.discard(tid)
                     if not t.pending_tids:
                         del self._txns[ch["txn_id"]]
+            elif op == "truncate":
+                # TRUNCATE streams as ONE logical record (PG logical
+                # replication emits one TRUNCATE message): the N
+                # per-tablet WAL entries share a statement ht, so they
+                # merge into a single txn keyed by it
+                key = "tr-%s-%d" % (table, ch["ht"])
+                t = self._txns.setdefault(key, _TxnBuf())
+                if not t.ops:
+                    t.ops.append({"op": "TRUNCATE", "row": None,
+                                  "table": table})
+                t.commit_ht = ch["ht"]
+                t.min_idx[tid] = min(t.min_idx.get(tid, ch["index"]),
+                                     ch["index"])
             else:
                 # plain committed write: a singleton auto-applied txn
                 # keyed by its log position (stable across replays)

@@ -496,13 +496,23 @@ class YBClient:
         ct = await self._table(table)
 
         async def go(ct_):
+            # ONE statement hybrid time: the first tablet's leader
+            # mints it, the rest apply at the same ht — consumers see
+            # one logical truncate, replays stay deterministic
+            locs = list(ct_.locations)
+            r0 = await self._call_leader(
+                ct_, locs[0].tablet_id, "truncate_tablet",
+                {"tablet_id": locs[0].tablet_id,
+                 "table_id": ct_.info.table_id})
+            ht = r0.get("ht")
+
             async def one(loc):
                 await self._call_leader(
                     ct_, loc.tablet_id, "truncate_tablet",
                     {"tablet_id": loc.tablet_id,
-                     "table_id": ct_.info.table_id})
-            await asyncio.gather(*[one(l) for l in ct_.locations])
-            return len(ct_.locations)
+                     "table_id": ct_.info.table_id, "ht": ht})
+            await asyncio.gather(*[one(l) for l in locs[1:]])
+            return len(locs)
 
         n = await self._retry_on_split(table, go)
         for index_name in (ct.indexes or {}):

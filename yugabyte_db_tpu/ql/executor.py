@@ -2524,8 +2524,11 @@ class SqlSession:
                 out[name] = row.get(name)
             elif it[0] == "expr":
                 bound = self._bind(it[1], schema)
-                idrow = {schema.column_by_name(k).id: v
-                         for k, v in row.items()}
+                # synthetic keys (__corrN carriers etc.) are not schema
+                # columns — only real columns feed the evaluator
+                known = {c.name: c.id for c in schema.columns}
+                idrow = {known[k]: v for k, v in row.items()
+                         if k in known}
                 out[self._item_name(stmt, i)] = eval_expr_py(bound, idrow)
         # carry ORDER BY source columns through so post-projection sort
         # works even when they're aliased or not projected; _order_limit

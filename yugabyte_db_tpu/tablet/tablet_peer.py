@@ -418,7 +418,7 @@ class TabletPeer:
         return await self.participant.write_intents(
             req, txn_id, start_ht, status_tablet, op_read_hts, sub_id)
 
-    async def truncate(self, table_id: str):
+    async def truncate(self, table_id: str, ht: int = None):
         """Raft-replicated TRUNCATE (reference: tablet truncate
         operation, tablet/operations/truncate_operation.cc): every
         replica drops the table's data at the same log position.
@@ -436,14 +436,20 @@ class TabletPeer:
                 "cannot TRUNCATE while transactions hold intents on "
                 "this tablet", "TRY_AGAIN")
         import msgpack as _mp
-        # the tombstone hybrid time is assigned ONCE at replicate time
-        # and carried in the entry: replays and followers must apply at
-        # the SAME ht, or a re-applied truncate would shadow
-        # post-truncate writes (colocated path writes MVCC tombstones)
+        # the hybrid time is assigned ONCE for the whole statement (the
+        # first tablet's leader mints it; the client fans it out) and
+        # carried in every tablet's entry: replays and followers apply
+        # at the SAME ht, consumers can DEDUP the per-tablet records,
+        # and post-truncate writes always sort after it (each leader's
+        # clock ratchets on apply)
+        if ht is None:
+            ht = self.clock.now().value
+        else:
+            self.clock.update(HybridTime(ht))
         await self.consensus.replicate(
-            "truncate", _mp.packb({"table_id": table_id,
-                                   "ht": self.clock.now().value}),
+            "truncate", _mp.packb({"table_id": table_id, "ht": ht}),
             precheck=self.split_fence_check)
+        return ht
 
     async def rollback_sub_txn(self, txn_id: str, from_sub: int):
         """ROLLBACK TO SAVEPOINT on this participant (leader only):

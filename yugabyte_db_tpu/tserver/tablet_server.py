@@ -704,8 +704,9 @@ class TabletServer:
         """Raft-replicated tablet truncate (reference: TruncateRequest
         through the tablet service)."""
         peer = self._peer(payload["tablet_id"])
-        await peer.truncate(payload["table_id"])
-        return {"ok": True}
+        ht = await peer.truncate(payload["table_id"],
+                                 payload.get("ht"))
+        return {"ok": True, "ht": ht}
 
     async def rpc_txn_rollback_sub(self, payload) -> dict:
         """ROLLBACK TO SAVEPOINT: prune this participant's intents with
@@ -991,6 +992,11 @@ class TabletServer:
                 d = _mp.unpackb(e.payload, raw=False)
                 changes.append({"op": "abort", "txn_id": d["txn_id"],
                                 "index": e.index})
+            elif e.etype == "truncate":
+                d = _mp.unpackb(e.payload, raw=False)
+                changes.append({"op": "truncate",
+                                "table_id": d.get("table_id", ""),
+                                "ht": d.get("ht", 0), "index": e.index})
             elif e.etype == "split":
                 # the write fence guarantees nothing CDC-relevant orders
                 # after this entry: consumers retire the parent stream
