@@ -1465,21 +1465,7 @@ class SqlSession:
             # split AND-conjuncts: uncorrelated parts stay pushable,
             # correlated ones evaluate client-side per row (PG:
             # correlated subplans re-execute per outer row)
-            conjs: list = []
-
-            def flatten(n):
-                if isinstance(n, tuple) and n[0] == "and":
-                    flatten(n[1])
-                    flatten(n[2])
-                else:
-                    conjs.append(n)
-            flatten(stmt.where)
-            push = [c for c in conjs if not self._has_corr(c)]
-            corr_where = [c for c in conjs if self._has_corr(c)]
-            w = None
-            for c in push:
-                w = c if w is None else ("and", w, c)
-            stmt.where = w
+            stmt.where, corr_where = self._split_conjuncts(stmt.where)
         corr_items = [i for i, it in enumerate(stmt.items)
                       if it[0] == "expr" and self._has_corr(it[1])]
         if (corr_where or corr_items) and (
@@ -1656,18 +1642,8 @@ class SqlSession:
             base_rows = self._overlay_txn_writes(
                 stmt.table, schema, where, base_rows)
         if corr_where:
-            cache: dict = {}
-            kept = []
-            for r in base_rows:
-                ok = True
-                for conj in corr_where:
-                    if not await self._eval_corr_conjunct(
-                            conj, r, schema, cache):
-                        ok = False
-                        break
-                if ok:
-                    kept.append(r)
-            base_rows = kept
+            base_rows = await self._filter_corr_rows(base_rows,
+                                                     corr_where, schema)
         if corr_items:
             # correlated scalar subqueries in the select list: compute
             # per outer row, then project as a synthetic column under
@@ -2894,8 +2870,10 @@ class SqlSession:
     # ------------------------------------------------------------------
     async def _delete(self, stmt: DeleteStmt) -> SqlResult:
         self._invalidate_stats(stmt.table)
+        corr = []
         if stmt.where is not None:
-            stmt.where = await self._resolve_subqueries(stmt.where)
+            stmt.where, corr = await self._split_corr_where(
+                stmt.table, None, stmt.where)
         ct = await self.client._table(stmt.table)
         schema = ct.info.schema
         pk_cols = [c.name for c in schema.key_columns]
@@ -2911,12 +2889,15 @@ class SqlSession:
             # the WHERE columns too or committed values read as NULL
             scan_cols = tuple(self._overlay_columns(pk_cols, schema,
                                                     where))
+        if corr:
+            scan_cols = ()     # correlated conjuncts read any column
         resp = await self.client.scan(stmt.table, ReadRequest(
             "", columns=scan_cols, where=where, read_ht=read_ht))
         rows = resp.rows
         if self._txn is not None:
             rows = self._overlay_txn_writes(stmt.table, schema, where,
                                             rows)
+        rows = await self._filter_corr_rows(rows, corr, schema)
         pre_images = rows
         # targets include the txn's OWN uncommitted rows (and exclude
         # ones it already deleted)
@@ -2943,10 +2924,64 @@ class SqlSession:
             if row[dc] is not None and not isinstance(row[dc], str):
                 row[dc] = str(row[dc])
 
+    @staticmethod
+    def _split_conjuncts(resolved):
+        """AND-conjunct split: (pushable_where, correlated_conjuncts)."""
+        conjs: list = []
+
+        def flatten(n):
+            if isinstance(n, tuple) and n[0] == "and":
+                flatten(n[1])
+                flatten(n[2])
+            else:
+                conjs.append(n)
+        flatten(resolved)
+        push = [c for c in conjs if not SqlSession._has_corr(c)]
+        corr = [c for c in conjs if SqlSession._has_corr(c)]
+        w = None
+        for c in push:
+            w = c if w is None else ("and", w, c)
+        return w, corr
+
+    async def _split_corr_where(self, stmt_table, table_alias, where):
+        """(pushable_where, corr_conjuncts) for a DML statement's WHERE
+        with possible correlated subqueries — the DML scans all rows
+        matching the pushable part and filters the correlated remainder
+        client-side (same shape as _select)."""
+        try:
+            outer_schema = (await self.client._table(
+                stmt_table)).info.schema
+            outer = (outer_schema, {stmt_table,
+                                    table_alias or stmt_table})
+        except Exception:   # noqa: BLE001
+            outer = None
+        resolved = await self._resolve_subqueries(where, outer=outer)
+        if not self._has_corr(resolved):
+            return resolved, []
+        return self._split_conjuncts(resolved)
+
+    async def _filter_corr_rows(self, rows, corr, schema):
+        if not corr:
+            return rows
+        cache: dict = {}
+        kept = []
+        for r in rows:
+            ok = True
+            for conj in corr:
+                if not await self._eval_corr_conjunct(conj, r, schema,
+                                                      cache):
+                    ok = False
+                    break
+            if ok:
+                kept.append(r)
+        return kept
+
     async def _update(self, stmt: UpdateStmt) -> SqlResult:
         self._invalidate_stats(stmt.table)
+        corr = []
         if stmt.where is not None:
-            stmt.where = await self._resolve_subqueries(stmt.where)
+            stmt.where, corr = await self._split_corr_where(
+                stmt.table, None, stmt.where)
         ct = await self.client._table(stmt.table)
         schema = ct.info.schema
         for name in stmt.sets:
@@ -2959,14 +2994,25 @@ class SqlSession:
         if self._txn is not None:
             rows = self._overlay_txn_writes(stmt.table, schema, where,
                                             rows)
+        rows = await self._filter_corr_rows(rows, corr, schema)
         if not rows:
             return SqlResult([], "UPDATE 0")
         # SET targets are full expressions evaluated over the PRE-image
         # of each row (SET a = b, b = a swaps, like PG); subqueries and
         # sequence calls resolve statement-level first
-        bound_sets = {name: self._bind(
-            await self._resolve_subqueries(e), schema)
-            for name, e in stmt.sets.items()}
+        bound_sets = {}
+        for name, e in stmt.sets.items():
+            if e == ("default",):
+                col = schema.column_by_name(name)
+                if getattr(col, "default_seq", None):
+                    raise ValueError(
+                        "SET ... = DEFAULT on a serial column is not "
+                        "supported (per-row nextval)")
+                bound_sets[name] = ("const",
+                                    getattr(col, "default_value", None))
+            else:
+                bound_sets[name] = self._bind(
+                    await self._resolve_subqueries(e), schema)
         json_cols = {c.name for c in schema.columns
                      if c.type == ColumnType.JSON}
         updated = []

@@ -1252,9 +1252,15 @@ class Parser:
         while True:
             col = self.ident()
             self.expect_op("=")
-            # full expressions: SET v = v + 1, SET n = upper(n), ...
-            # (reference: PG UPDATE targetlist evaluation)
-            sets[col] = self.expr()
+            t = self.peek()
+            if t and t[0] == "id" and t[1].lower() == "default":
+                # SET col = DEFAULT: the column's declared default
+                self.next()
+                sets[col] = ("default",)
+            else:
+                # full expressions: SET v = v + 1, SET n = upper(n),
+                # ... (reference: PG UPDATE targetlist evaluation)
+                sets[col] = self.expr()
             if not self.accept_op(","):
                 break
         where = None

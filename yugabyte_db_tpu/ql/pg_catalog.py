@@ -134,6 +134,7 @@ async def rows_for(name: str, client) -> Optional[List[Dict]]:
 
     tables = await client.list_tables()
     infos = []
+    cts = {}
     for t in tables:
         if t["name"].startswith("system."):
             continue
@@ -142,6 +143,14 @@ async def rows_for(name: str, client) -> Optional[List[Dict]]:
         except Exception:  # noqa: BLE001 — table dropped mid-listing
             continue
         infos.append((t, ct.info))
+        cts[ct.info.name] = ct
+    # index backing tables are INDEXES to SQL users (PG: relkind 'i',
+    # absent from information_schema.tables)
+    index_tables = {spec["index_table"]
+                    for ct in cts.values()
+                    for spec in (ct.indexes or {}).values()}
+    user_infos = [(t, i) for t, i in infos
+                  if i.name not in index_tables]
 
     if short == "pg_class":
         out = []
@@ -149,9 +158,12 @@ async def rows_for(name: str, client) -> Optional[List[Dict]]:
             out.append({"oid": _oid_of(t["table_id"]),
                         "relname": info.name,
                         "relnamespace": _NSP_PUBLIC,
-                        "relkind": "r", "relnatts":
+                        "relkind": ("i" if info.name in index_tables
+                                    else "r"), "relnatts":
                             len(info.schema.columns),
-                        "reltuples": -1.0, "relhasindex": False,
+                        "reltuples": -1.0, "relhasindex": bool(
+                            getattr(cts.get(info.name), "indexes",
+                                    None)),
                         "relispartition": False})
         return out
     if short == "pg_tables":
@@ -187,10 +199,12 @@ async def rows_for(name: str, client) -> Optional[List[Dict]]:
                  "schema_owner": "yugabyte"}
                 for s in ("public", "pg_catalog", "information_schema")]
     if name == "information_schema.tables":
+        infos = user_infos
         return [{"table_catalog": "yugabyte", "table_schema": "public",
                  "table_name": info.name, "table_type": "BASE TABLE"}
                 for _, info in infos]
     if name == "information_schema.columns":
+        infos = user_infos
         out = []
         for _, info in infos:
             for i, c in enumerate(info.schema.columns):
@@ -208,15 +222,37 @@ async def rows_for(name: str, client) -> Optional[List[Dict]]:
                 })
         return out
     if name == "information_schema.table_constraints":
-        return [{"constraint_catalog": "yugabyte",
-                 "constraint_schema": "public",
-                 "constraint_name": f"{info.name}_pkey",
-                 "table_schema": "public", "table_name": info.name,
-                 "constraint_type": "PRIMARY KEY"}
-                for _, info in infos]
+        out = []
+        for _, info in user_infos:
+            out.append({"constraint_catalog": "yugabyte",
+                        "constraint_schema": "public",
+                        "constraint_name": f"{info.name}_pkey",
+                        "table_schema": "public",
+                        "table_name": info.name,
+                        "constraint_type": "PRIMARY KEY"})
+            ct = cts.get(info.name)
+            for idx_name, spec in (getattr(ct, "indexes", None)
+                                   or {}).items():
+                if spec.get("unique"):
+                    out.append({"constraint_catalog": "yugabyte",
+                                "constraint_schema": "public",
+                                "constraint_name": idx_name,
+                                "table_schema": "public",
+                                "table_name": info.name,
+                                "constraint_type": "UNIQUE"})
+            for i, fk in enumerate(getattr(ct, "foreign_keys", None)
+                                   or []):
+                out.append({"constraint_catalog": "yugabyte",
+                            "constraint_schema": "public",
+                            "constraint_name":
+                                f"{info.name}_{fk['column']}_fkey",
+                            "table_schema": "public",
+                            "table_name": info.name,
+                            "constraint_type": "FOREIGN KEY"})
+        return out
     if name == "information_schema.key_column_usage":
         out = []
-        for _, info in infos:
+        for _, info in user_infos:
             pos = 0
             for c in info.schema.columns:
                 if c.is_hash_key or c.is_range_key:
@@ -228,5 +264,24 @@ async def rows_for(name: str, client) -> Optional[List[Dict]]:
                         "column_name": c.name,
                         "ordinal_position": pos,
                     })
+            ct = cts.get(info.name)
+            for idx_name, spec in (getattr(ct, "indexes", None)
+                                   or {}).items():
+                if not spec.get("unique"):
+                    continue
+                for i, col in enumerate(spec.get("columns")
+                                        or [spec["column"]]):
+                    out.append({"constraint_name": idx_name,
+                                "table_schema": "public",
+                                "table_name": info.name,
+                                "column_name": col,
+                                "ordinal_position": i + 1})
+            for fk in getattr(ct, "foreign_keys", None) or []:
+                out.append({"constraint_name":
+                                f"{info.name}_{fk['column']}_fkey",
+                            "table_schema": "public",
+                            "table_name": info.name,
+                            "column_name": fk["column"],
+                            "ordinal_position": 1})
         return out
     return None
