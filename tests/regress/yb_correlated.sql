@@ -16,3 +16,27 @@ SELECT name FROM author WHERE id < 3 AND (SELECT count(*) FROM book WHERE book.a
 SELECT name FROM author WHERE id IN (SELECT author_id FROM book WHERE book.pages < author.id * 100) ORDER BY name;
 DROP TABLE book;
 DROP TABLE author;
+-- correlated DML: per-row subplans in UPDATE/DELETE WHERE
+CREATE TABLE a2 (id bigint PRIMARY KEY, v bigint DEFAULT 9) WITH tablets = 1;
+CREATE TABLE b2 (id bigint PRIMARY KEY, a_id bigint) WITH tablets = 1;
+INSERT INTO a2 (id, v) VALUES (1, 10), (2, 20), (3, 30);
+INSERT INTO b2 (id, a_id) VALUES (1, 1), (2, 3);
+UPDATE a2 SET v = 0 WHERE EXISTS (SELECT 1 FROM b2 WHERE b2.a_id = a2.id);
+SELECT id, v FROM a2 ORDER BY id;
+DELETE FROM a2 WHERE NOT EXISTS (SELECT 1 FROM b2 WHERE b2.a_id = a2.id);
+SELECT id FROM a2 ORDER BY id;
+UPDATE a2 SET v = DEFAULT WHERE id = 1;
+SELECT v FROM a2 WHERE id = 1;
+DROP TABLE b2;
+DROP TABLE a2;
+-- join DML: UPDATE ... FROM and DELETE ... USING
+CREATE TABLE acc (id bigint PRIMARY KEY, bal bigint) WITH tablets = 1;
+CREATE TABLE adj (id bigint PRIMARY KEY, acc_id bigint, delta bigint) WITH tablets = 1;
+INSERT INTO acc (id, bal) VALUES (1, 100), (2, 200), (3, 300);
+INSERT INTO adj (id, acc_id, delta) VALUES (1, 1, 5), (2, 3, 7);
+UPDATE acc SET bal = bal + adj.delta FROM adj WHERE adj.acc_id = acc.id;
+SELECT id, bal FROM acc ORDER BY id;
+DELETE FROM acc USING adj WHERE adj.acc_id = acc.id AND adj.delta > 6;
+SELECT id FROM acc ORDER BY id;
+DROP TABLE adj;
+DROP TABLE acc;

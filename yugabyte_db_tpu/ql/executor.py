@@ -2868,8 +2868,87 @@ class SqlSession:
         return [{c: r.get(c) for c in returning} for r in rows]
 
     # ------------------------------------------------------------------
+    async def _update_from(self, stmt: UpdateStmt) -> SqlResult:
+        """UPDATE t SET ... FROM u WHERE ... — SET and WHERE reference
+        both tables; evaluation is name-based over the merged row."""
+        ct = await self.client._table(stmt.table)
+        schema = ct.info.schema
+        for name in stmt.sets:
+            schema.column_by_name(name)
+        pairs = await self._dml_join_rows(
+            stmt.table, stmt.from_table, stmt.from_alias, stmt.where)
+        if not pairs:
+            return SqlResult([], "UPDATE 0")
+        dec_cols = _decimal_cols(schema)
+        nn_cols = [c.name for c in schema.columns
+                   if not c.nullable and c.name in stmt.sets]
+        json_cols = {c.name for c in schema.columns
+                     if c.type == ColumnType.JSON}
+        updated = []
+        for tr, merged in pairs:
+            nr = dict(tr)
+            for name, e in stmt.sets.items():
+                if e == ("default",):
+                    col = schema.column_by_name(name)
+                    if getattr(col, "default_seq", None):
+                        raise ValueError(
+                            "SET ... = DEFAULT on a serial column is "
+                            "not supported (per-row nextval)")
+                    nr[name] = getattr(col, "default_value", None)
+                else:
+                    v = _eval_by_name(e, merged)
+                    if name in json_cols and isinstance(v, (list,
+                                                            dict)):
+                        import json as _json
+                        v = _json.dumps(v)
+                    nr[name] = v
+            self._coerce_decimals(dec_cols, nr)
+            for name in nn_cols:
+                if nr.get(name) is None:
+                    raise ValueError(
+                        f"null value in column {name!r} violates "
+                        f"not-null constraint")
+            updated.append(nr)
+        if any(fk["column"] in stmt.sets
+               for fk in getattr(ct, "foreign_keys", None) or []):
+            await self._check_foreign_keys(ct, updated)
+        if self._txn is not None:
+            n = await self._txn.insert(stmt.table, updated)
+        else:
+            n = await self.client.insert(stmt.table, updated)
+        if getattr(stmt, "returning", None):
+            return SqlResult(
+                self._returning_rows(stmt.returning, updated, schema),
+                f"UPDATE {n}")
+        return SqlResult([], f"UPDATE {n}")
+
+    async def _delete_using(self, stmt: DeleteStmt) -> SqlResult:
+        """DELETE FROM t USING u WHERE ... (PG delete with a using
+        list)."""
+        ct = await self.client._table(stmt.table)
+        schema = ct.info.schema
+        pk_cols = [c.name for c in schema.key_columns]
+        pairs = await self._dml_join_rows(
+            stmt.table, stmt.using_table, stmt.using_alias, stmt.where)
+        if not pairs:
+            return SqlResult([], "DELETE 0")
+        pre_images = [tr for tr, _ in pairs]
+        await self._check_fk_restrict(ct, pk_cols, pre_images)
+        pk_rows = [{k: tr[k] for k in pk_cols} for tr in pre_images]
+        if self._txn is not None:
+            n = await self._txn.delete(stmt.table, pk_rows)
+        else:
+            n = await self.client.delete(stmt.table, pk_rows)
+        if getattr(stmt, "returning", None):
+            return SqlResult(
+                self._returning_rows(stmt.returning, pre_images,
+                                     schema), f"DELETE {n}")
+        return SqlResult([], f"DELETE {n}")
+
     async def _delete(self, stmt: DeleteStmt) -> SqlResult:
         self._invalidate_stats(stmt.table)
+        if getattr(stmt, "using_table", None):
+            return await self._delete_using(stmt)
         corr = []
         if stmt.where is not None:
             stmt.where, corr = await self._split_corr_where(
@@ -2976,8 +3055,120 @@ class SqlSession:
                 kept.append(r)
         return kept
 
+    async def _dml_join_rows(self, target: str, aux_table: str,
+                             aux_alias, where):
+        """Matched (target_row, merged_row) pairs for UPDATE..FROM /
+        DELETE..USING (reference: PG's join DML plans — ours pushes
+        target-only conjuncts into the target scan and runs a
+        client-side nested loop over the materialized aux table; the
+        FIRST matching aux row wins, matching PG's 'one arbitrary
+        match' contract).  `merged_row` carries the target's columns
+        (bare + qualified) overlaid with the aux table's (qualified,
+        bare only where not clashing) for name-based SET/WHERE
+        evaluation.  Scans read at the transaction snapshot with the
+        write-set overlaid on BOTH tables (read-your-own-writes)."""
+        where = await self._resolve_subqueries(where) \
+            if where is not None else None
+        if where is not None and self._has_corr(where):
+            raise ValueError(
+                "correlated subqueries are not supported in join DML "
+                "(UPDATE ... FROM / DELETE ... USING)")
+        t_ct = await self.client._table(target)
+        a_ct = await self.client._table(aux_table)
+        read_ht = self._txn.start_ht if self._txn is not None else None
+        # push target-only conjuncts into the target scan (a conjunct
+        # qualifies when every referenced name resolves in the target
+        # and is unqualified-or-target-qualified and NOT an aux column
+        # ambiguity)
+        t_label = target
+        a_label = aux_alias or aux_table
+        t_cols = {c.name for c in t_ct.info.schema.columns}
+        a_cols = {c.name for c in a_ct.info.schema.columns}
+        push_w = None
+        client_w = where
+        if where is not None:
+            conjs: list = []
+
+            def flatten(n):
+                if isinstance(n, tuple) and n[0] == "and":
+                    flatten(n[1])
+                    flatten(n[2])
+                else:
+                    conjs.append(n)
+            flatten(where)
+
+            def target_only(conj):
+                names: set = set()
+                self._collect_names(conj, names)
+                for n in names:
+                    q, bare = self._split_qual(n)
+                    if q is not None and q != t_label:
+                        return False
+                    if q is None and (bare not in t_cols
+                                      or bare in a_cols):
+                        return False
+                    if bare not in t_cols:
+                        return False
+                return True
+            pushed = [c for c in conjs if target_only(c)]
+            rest = [c for c in conjs if not target_only(c)]
+            for c in pushed:
+                push_w = c if push_w is None else ("and", push_w, c)
+            client_w = None
+            for c in rest:
+                client_w = c if client_w is None \
+                    else ("and", client_w, c)
+        bound_push = None
+        if push_w is not None:
+            quals = {t_label}
+            bound_push = self._bind(
+                self._strip_quals(push_w, quals), t_ct.info.schema)
+        t_rows = (await self.client.scan(
+            target, ReadRequest("", where=bound_push,
+                                read_ht=read_ht))).rows
+        if self._txn is not None:
+            t_rows = self._overlay_txn_writes(
+                target, t_ct.info.schema, bound_push, t_rows)
+        a_rows = (await self.client.scan(
+            aux_table, ReadRequest("", read_ht=read_ht))).rows
+        if self._txn is not None:
+            a_rows = self._overlay_txn_writes(
+                aux_table, a_ct.info.schema, None, a_rows)
+        out = []
+        for tr in t_rows:
+            merged_base = {f"{t_label}.{k}": v for k, v in tr.items()}
+            merged_base.update(tr)
+            for ar in a_rows:
+                m = dict(merged_base)
+                m.update({f"{a_label}.{k}": v for k, v in ar.items()})
+                for k, v in ar.items():
+                    if k not in tr:
+                        m[k] = v
+                if client_w is None or \
+                        _eval_by_name(client_w, m) is True:
+                    out.append((tr, m))
+                    break
+        return out
+
+    @staticmethod
+    def _strip_quals(node, quals: set):
+        """Remove table/alias qualifiers owned by `quals` from column
+        refs so schema binding sees bare names."""
+        if not isinstance(node, tuple):
+            return node
+        if node[0] == "col" and isinstance(node[1], str) \
+                and "." in node[1]:
+            q, bare = node[1].split(".", 1)
+            if q in quals:
+                return ("col", bare)
+            return node
+        return tuple(SqlSession._strip_quals(c, quals)
+                     if isinstance(c, tuple) else c for c in node)
+
     async def _update(self, stmt: UpdateStmt) -> SqlResult:
         self._invalidate_stats(stmt.table)
+        if getattr(stmt, "from_table", None):
+            return await self._update_from(stmt)
         corr = []
         if stmt.where is not None:
             stmt.where, corr = await self._split_corr_where(

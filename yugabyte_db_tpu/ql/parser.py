@@ -279,6 +279,10 @@ class DeleteStmt:
     table: str
     where: Optional[tuple] = None
     returning: Optional[List[str]] = None
+    # DELETE FROM t USING u [AS a]: WHERE may reference both tables;
+    # matched target rows delete (reference: PG delete with using list)
+    using_table: Optional[str] = None
+    using_alias: Optional[str] = None
 
 
 @dataclass
@@ -287,6 +291,11 @@ class UpdateStmt:
     sets: Dict[str, object] = field(default_factory=dict)
     where: Optional[tuple] = None
     returning: Optional[List[str]] = None
+    # UPDATE t SET ... FROM u [AS a]: SET/WHERE may reference u's
+    # columns; the first matching u row per target applies (PG: one
+    # arbitrary match)
+    from_table: Optional[str] = None
+    from_alias: Optional[str] = None
 
 
 class Parser:
@@ -1226,10 +1235,16 @@ class Parser:
             self.expect_op("=")
             rcol = self.ident()
             joins.append(JoinClause(rtable, kind, lcol, rcol))
+        using_table = using_alias = None
+        if self.accept_kw("using"):
+            using_table = self.ident()
+            using_alias = self._table_alias()
         where = None
         if self.accept_kw("where"):
             where = self.expr()
-        return DeleteStmt(table, where, self._returning())
+        return DeleteStmt(table, where, self._returning(),
+                          using_table=using_table,
+                          using_alias=using_alias)
 
     def _returning(self):
         """RETURNING * | col [, col ...] after INSERT/UPDATE/DELETE."""
@@ -1263,10 +1278,15 @@ class Parser:
                 sets[col] = self.expr()
             if not self.accept_op(","):
                 break
+        from_table = from_alias = None
+        if self.accept_kw("from"):
+            from_table = self.ident()
+            from_alias = self._table_alias()
         where = None
         if self.accept_kw("where"):
             where = self.expr()
-        return UpdateStmt(table, sets, where, self._returning())
+        return UpdateStmt(table, sets, where, self._returning(),
+                          from_table=from_table, from_alias=from_alias)
 
     # -- expressions over column NAMES (bound to ids later) --
     def expr(self):

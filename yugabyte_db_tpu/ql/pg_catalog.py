@@ -168,8 +168,10 @@ async def rows_for(name: str, client) -> Optional[List[Dict]]:
         return out
     if short == "pg_tables":
         return [{"schemaname": "public", "tablename": info.name,
-                 "tableowner": "yugabyte", "hasindexes": False}
-                for _, info in infos]
+                 "tableowner": "yugabyte",
+                 "hasindexes": bool(getattr(cts.get(info.name),
+                                            "indexes", None))}
+                for _, info in user_infos]
     if short == "pg_attribute":
         out = []
         for t, info in infos:
