@@ -13,3 +13,19 @@ SELECT g, string_agg(s, '-') FROM b3 GROUP BY g ORDER BY g;
 SELECT string_agg(s, ',') FROM b3 WHERE k > 100;
 SELECT k FROM b3 ORDER BY k LIMIT ALL;
 DROP TABLE b3;
+-- GROUP BY expressions + ANY/ALL over arrays
+CREATE TABLE gx (k bigint PRIMARY KEY, g text, v bigint) WITH tablets = 1;
+INSERT INTO gx (k, g, v) VALUES (1, 'Ab', 5), (2, 'ab', 6), (3, 'cd', 1);
+SELECT upper(g), count(*) FROM gx GROUP BY upper(g) ORDER BY 1;
+SELECT CASE WHEN v > 5 THEN 'hi' ELSE 'lo' END AS band, sum(v) FROM gx GROUP BY CASE WHEN v > 5 THEN 'hi' ELSE 'lo' END ORDER BY band;
+SELECT k FROM gx WHERE g = ANY(ARRAY['Ab', 'zz']) ORDER BY k;
+SELECT k FROM gx WHERE v > ALL(ARRAY[1, 4]) ORDER BY k;
+DROP TABLE gx;
+-- GROUP BY ordinals, expression HAVING, no-aggregate grouping
+CREATE TABLE gy (k bigint PRIMARY KEY, g text, v bigint) WITH tablets = 1;
+INSERT INTO gy (k, g, v) VALUES (1, 'Ab', 5), (2, 'ab', 6), (3, 'cd', 1);
+SELECT upper(g), count(*) FROM gy GROUP BY 1 ORDER BY 1;
+SELECT upper(g) FROM gy GROUP BY upper(g) ORDER BY 1;
+SELECT g, v FROM gy GROUP BY g, v ORDER BY g;
+SELECT upper(g), sum(v) FROM gy GROUP BY upper(g) HAVING upper(g) = 'AB';
+DROP TABLE gy;

@@ -24,6 +24,16 @@ def _expr_to_wire(node):
 def _expr_from_wire(node):
     if node is None:
         return None
+    kind = node[0] if node else None
+    # PAYLOAD positions must come back verbatim: a ("const", [..])
+    # ARRAY literal, an ("in", x, values) list, or a ("dictlut", x,
+    # lut) table is DATA, not an AST child — blanket tuple-izing turned
+    # ARRAY consts into tuples that _as_array then rejected (x = ANY
+    # (ARRAY[...]) silently matched nothing after one RPC hop)
+    if kind == "const":
+        return ("const", node[1])
+    if kind in ("in", "dictlut"):
+        return (kind, _expr_from_wire(node[1]), node[2])
     out = []
     for x in node:
         out.append(_expr_from_wire(x) if isinstance(x, list) else x)

@@ -1565,8 +1565,13 @@ class SqlSession:
             rows = self._having_filter(stmt, [row], refs)
             return SqlResult(rows)
 
-        if stmt.group_by and (
-                agg_items or getattr(stmt, "having", None) is not None):
+        if stmt.group_by:
+            if getattr(stmt, "group_exprs", None):
+                # GROUP BY <expression>: synthetic per-row columns —
+                # host grouping only; matching select items project
+                # the computed value under their PG output name
+                self._rewrite_group_expr_items(stmt)
+                return await self._grouped_clientside(stmt, ct, where)
             if any(it[1] in ("array_agg", "count_distinct",
                              "string_agg")
                    for it in agg_items) or (
@@ -2387,8 +2392,13 @@ class SqlSession:
                     out[self._item_name(stmt, i)] = \
                         _agg_over_rows(it[1], it[2], rows)
             return SqlResult([out])
-        if stmt.group_by and (agg_items
-                              or getattr(stmt, "having", None)):
+        if stmt.group_by:
+            gexprs = getattr(stmt, "group_exprs", None) or {}
+            if gexprs:
+                self._rewrite_group_expr_items(stmt)
+                for r in rows:
+                    for g, ast in gexprs.items():
+                        r[g] = _eval_by_name(ast, r)
             groups: Dict[tuple, List[dict]] = {}
             for r in rows:
                 key = tuple(r.get(c) for c in stmt.group_by)
@@ -2399,6 +2409,10 @@ class SqlSession:
                 row = {}
                 for gname, gv in zip(stmt.group_by, key):
                     self._put_group_value(gmap, row, gname, gv)
+                    if gname.startswith("__g"):
+                        # HAVING may reference the synthetic column
+                        # (_order_limit strips it from the output)
+                        row.setdefault(gname, gv)
                 for i, it in enumerate(stmt.items):
                     if it[0] == "agg":
                         row[self._item_name(stmt, i)] = \
@@ -2785,15 +2799,48 @@ class SqlSession:
         rows = self._having_filter(stmt, rows, refs)
         return SqlResult(self._order_limit(stmt, rows))
 
+    def _rewrite_group_expr_items(self, stmt) -> None:
+        """A select item whose expr EQUALS a GROUP BY expression
+        projects the synthetic grouping column under the item's PG
+        output name (SELECT upper(g) ... GROUP BY upper(g)); the SAME
+        substitution applies inside HAVING, which evaluates over group
+        rows where the base columns are gone."""
+        gexprs = getattr(stmt, "group_exprs", None) or {}
+        if not gexprs:
+            return
+        for i, it in enumerate(stmt.items):
+            if it[0] != "expr":
+                continue
+            for gname, ast in gexprs.items():
+                if it[1] == ast:
+                    stmt.aliases[i] = stmt.aliases.get(
+                        i, self._item_name(stmt, i))
+                    stmt.items[i] = ("col", gname)
+                    break
+        if getattr(stmt, "having", None) is not None:
+            def subst(n):
+                if not isinstance(n, tuple):
+                    return n
+                for gname, ast in gexprs.items():
+                    if n == ast:
+                        return ("col", gname)
+                return tuple(subst(c) if isinstance(c, tuple) else c
+                             for c in n)
+            stmt.having = subst(stmt.having)
+
     async def _grouped_clientside(self, stmt, ct, where) -> SqlResult:
-        """Hash grouping over projected rows (arbitrary-domain GROUP BY)."""
+        """Hash grouping over projected rows (arbitrary-domain GROUP BY;
+        GROUP BY expressions compute synthetic columns per row)."""
         schema = ct.info.schema
         read_ht = self._txn.start_ht if self._txn is not None else None
         agg_indexed = [(i, it) for i, it in enumerate(stmt.items)
                        if it[0] == "agg"]
         agg_items = [it for _, it in agg_indexed]
         refs = self._having_refs(stmt)
-        needed = set(stmt.group_by)
+        gexprs = getattr(stmt, "group_exprs", None) or {}
+        needed = {g for g in stmt.group_by if g not in gexprs}
+        for ast in gexprs.values():
+            self._collect_names(ast, needed)
         for _, op, e in agg_items:
             if e is not None:
                 self._collect_names(e, needed)
@@ -2817,10 +2864,15 @@ class SqlSession:
                  for _, op, e in agg_items] + \
             [(op, self._bind(e, schema) if e else None)
              for op, e in refs]
+        bound_gexprs = {g: self._bind(ast, schema)
+                        for g, ast in gexprs.items()}
+        known = {c.name: c.id for c in schema.columns}
         for r in scan_rows:
+            idrow = {known[k]: v for k, v in r.items() if k in known}
+            for g, be in bound_gexprs.items():
+                r[g] = eval_expr_py(be, idrow)
             key = tuple(r.get(c) for c in stmt.group_by)
             st = groups.setdefault(key, [_init(op) for op, _ in bound])
-            idrow = {schema.column_by_name(k).id: v for k, v in r.items()}
             for i, (op, e) in enumerate(bound):
                 st[i] = _step(op, e, st[i], idrow)
         rows = []
@@ -2829,6 +2881,10 @@ class SqlSession:
             row = {}
             for gname, gv in zip(stmt.group_by, key):
                 self._put_group_value(gmap, row, gname, gv)
+                if gname.startswith("__g"):
+                    # HAVING may reference the synthetic expression
+                    # column (_order_limit strips it from the output)
+                    row.setdefault(gname, gv)
             for j, (idx, it) in enumerate(agg_indexed):
                 row[self._item_name(stmt, idx)] = _final(bound[j][0],
                                                          st[j])

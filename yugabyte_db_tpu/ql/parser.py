@@ -248,6 +248,9 @@ class SelectStmt:
     # FROM generate_series(lo, hi[, step]): (lo, hi, step) — the rows
     # materialize client-side (PG set-returning function)
     series: Optional[Tuple[int, int, int]] = None
+    # GROUP BY <expression> entries: synthetic name -> AST (grouped
+    # client-side; matching select items rewrite to the synthetic col)
+    group_exprs: Dict[str, tuple] = field(default_factory=dict)
     # SELECT ... FOR UPDATE / FOR SHARE: lock the read set exclusively
     # or shared (reference: row locks via docdb intents, the pggate
     # RowMarkType plumbing)
@@ -1090,10 +1093,42 @@ class Parser:
         if self.accept_kw("where"):
             where = self.expr()
         group = []
+        group_exprs = {}
         if self.accept_kw("group"):
             self.expect_kw("by")
             while True:
-                group.append(self.ident())
+                t = self.peek()
+                if t and t[0] == "num":
+                    # GROUP BY <ordinal>: select-list position (PG)
+                    self.next()
+                    if "." in t[1] or "e" in t[1].lower():
+                        raise ValueError(
+                            "non-integer constant in GROUP BY")
+                    idx = int(t[1]) - 1
+                    if not (0 <= idx < len(items)):
+                        raise ValueError(
+                            f"GROUP BY position {t[1]} is not in the "
+                            f"select list")
+                    it = items[idx]
+                    if it[0] == "col":
+                        ge = ("col", it[1])
+                    elif it[0] == "expr":
+                        ge = it[1]
+                    else:
+                        raise ValueError(
+                            "GROUP BY position must reference a "
+                            "column or expression item")
+                else:
+                    ge = self.expr()
+                if ge[0] == "col":
+                    group.append(ge[1])
+                else:
+                    # GROUP BY <expression>: synthetic grouping column
+                    # computed per row client-side (PG groups by any
+                    # expression)
+                    gname = f"__g{len(group_exprs)}"
+                    group_exprs[gname] = ge
+                    group.append(gname)
                 if not self.accept_op(","):
                     break
         having = None
@@ -1193,7 +1228,8 @@ class Parser:
         return SelectStmt(table, items, where, group, order, limit, knn,
                           distinct, offset, joins, having, aliases,
                           table_alias=table_alias, series=series,
-                          for_update=for_update, for_share=for_share)
+                          for_update=for_update, for_share=for_share,
+                          group_exprs=group_exprs)
 
     # clause starters that must not be eaten as a table alias
     _ALIAS_STOP = frozenset((
