@@ -2409,14 +2409,13 @@ class SqlSession:
                 row = {}
                 for gname, gv in zip(stmt.group_by, key):
                     self._put_group_value(gmap, row, gname, gv)
-                    if gname.startswith("__g"):
-                        # HAVING may reference the synthetic column
-                        # (_order_limit strips it from the output)
-                        row.setdefault(gname, gv)
                 for i, it in enumerate(stmt.items):
                     if it[0] == "agg":
                         row[self._item_name(stmt, i)] = \
                             _agg_over_rows(it[1], it[2], grows)
+                    elif it[0] == "expr":
+                        row[self._item_name(stmt, i)] = _eval_by_name(
+                            it[1], row)
                 if stmt.having is not None:
                     hv = _eval_by_name(
                         _subst_aggrefs(stmt.having, grows), row)
@@ -2808,24 +2807,30 @@ class SqlSession:
         gexprs = getattr(stmt, "group_exprs", None) or {}
         if not gexprs:
             return
+
+        def subst(n):
+            if not isinstance(n, tuple):
+                return n
+            for gname, ast in gexprs.items():
+                if n == ast:
+                    return ("col", gname)
+            return tuple(subst(c) if isinstance(c, tuple) else c
+                         for c in n)
+
         for i, it in enumerate(stmt.items):
             if it[0] != "expr":
                 continue
-            for gname, ast in gexprs.items():
-                if it[1] == ast:
-                    stmt.aliases[i] = stmt.aliases.get(
-                        i, self._item_name(stmt, i))
-                    stmt.items[i] = ("col", gname)
-                    break
+            matched = next((g for g, ast in gexprs.items()
+                            if it[1] == ast), None)
+            if matched is not None:
+                stmt.aliases[i] = stmt.aliases.get(
+                    i, self._item_name(stmt, i))
+                stmt.items[i] = ("col", matched)
+            else:
+                # expressions BUILT ON the group key (upper(g) || '!')
+                # substitute the key and evaluate over the group row
+                stmt.items[i] = ("expr", subst(it[1]))
         if getattr(stmt, "having", None) is not None:
-            def subst(n):
-                if not isinstance(n, tuple):
-                    return n
-                for gname, ast in gexprs.items():
-                    if n == ast:
-                        return ("col", gname)
-                return tuple(subst(c) if isinstance(c, tuple) else c
-                             for c in n)
             stmt.having = subst(stmt.having)
 
     async def _grouped_clientside(self, stmt, ct, where) -> SqlResult:
@@ -2881,13 +2886,16 @@ class SqlSession:
             row = {}
             for gname, gv in zip(stmt.group_by, key):
                 self._put_group_value(gmap, row, gname, gv)
-                if gname.startswith("__g"):
-                    # HAVING may reference the synthetic expression
-                    # column (_order_limit strips it from the output)
-                    row.setdefault(gname, gv)
             for j, (idx, it) in enumerate(agg_indexed):
                 row[self._item_name(stmt, idx)] = _final(bound[j][0],
                                                          st[j])
+            for i2, it2 in enumerate(stmt.items):
+                if it2[0] == "expr":
+                    # expression over the group key(s): evaluate over
+                    # the assembled group row (the key substitution
+                    # happened in _rewrite_group_expr_items)
+                    row[self._item_name(stmt, i2)] = _eval_by_name(
+                        it2[1], row)
             for j in range(len(refs)):
                 i = len(agg_items) + j
                 row[f"__h{j}"] = _final(bound[i][0], st[i])
@@ -3337,6 +3345,9 @@ def _dequalify_stmt(stmt, quals: set) -> None:
             stmt.items[i] = ("agg", it[1],
                              _dequalify_node(it[2], quals))
     stmt.group_by = [_dequalify_name(n, quals) for n in stmt.group_by]
+    if getattr(stmt, "group_exprs", None):
+        stmt.group_exprs = {g: _dequalify_node(ast, quals)
+                            for g, ast in stmt.group_exprs.items()}
     stmt.order_by = [(_dequalify_name(n, quals), d)
                      for n, d in stmt.order_by]
 
