@@ -38,3 +38,11 @@ SELECT id FROM pair ORDER BY id;
 DROP TABLE pair;
 DROP TABLE dup;
 DROP TABLE mr;
+-- CHECK constraints (column and table level, NULL passes)
+CREATE TABLE ck (k bigint PRIMARY KEY, v bigint CHECK (v > 0), w bigint, CHECK (w < 100)) WITH tablets = 1;
+INSERT INTO ck (k, v, w) VALUES (1, 5, 50);
+INSERT INTO ck (k, v, w) VALUES (2, -1, 50);
+UPDATE ck SET w = 200 WHERE k = 1;
+INSERT INTO ck (k, v, w) VALUES (3, NULL, NULL);
+SELECT k FROM ck ORDER BY k;
+DROP TABLE ck;

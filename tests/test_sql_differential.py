@@ -20,7 +20,7 @@ def run(coro):
     return asyncio.run(coro)
 
 
-N_ROWS = 6000     # above tpu_min_rows_for_pushdown so kernels engage
+N_ROWS = 6000
 
 
 def _gen_queries(rng):
@@ -79,11 +79,19 @@ class TestSqlDifferential:
         async def go():
             rng = random.Random(20260730)
             mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            from yugabyte_db_tpu.ops import scan as _scan_mod
+            orig_run = _scan_mod.ScanKernel.run
             try:
                 s = SqlSession(mc.client())
+                # ONE tablet + flushed SSTs + a lowered threshold so
+                # the pushdown gate (per-tablet SST rows >=
+                # tpu_min_rows_for_pushdown; memtable rows count as 0)
+                # actually engages — and PROVE it below by counting
+                # ScanKernel.run invocations, or the diff silently
+                # compares the interpreter against itself
                 await s.execute(
                     "CREATE TABLE dt (k bigint PRIMARY KEY, a bigint, "
-                    "b bigint, s text, f double) WITH tablets = 2")
+                    "b bigint, s text, f double) WITH tablets = 1")
                 rows = []
                 for k in range(N_ROWS):
                     a = rng.randint(0, 99)
@@ -98,7 +106,17 @@ class TestSqlDifferential:
                     await s.execute(
                         "INSERT INTO dt (k, a, b, s, f) VALUES "
                         + ", ".join(rows[lo:lo + 500]))
+                for ts_ in mc.tservers:
+                    for peer in ts_.peers.values():
+                        peer.tablet.flush()
+                flags.set_flag("tpu_min_rows_for_pushdown", 64)
                 await s.execute("ANALYZE dt")
+                kernel_runs = {"n": 0}
+
+                def counting_run(self_, *a, **kw):
+                    kernel_runs["n"] += 1
+                    return orig_run(self_, *a, **kw)
+                _scan_mod.ScanKernel.run = counting_run
                 queries = _gen_queries(rng)
                 diffs = []
                 for q in queries:
@@ -109,12 +127,18 @@ class TestSqlDifferential:
                     if _norm(r_dev.rows) != _norm(r_cpu.rows):
                         diffs.append(
                             (q, r_dev.rows[:3], r_cpu.rows[:3]))
+                _scan_mod.ScanKernel.run = orig_run
+                assert kernel_runs["n"] > 0, (
+                    "the pushdown side never reached the scan kernel — "
+                    "the differential is vacuous")
                 assert not diffs, (
                     f"{len(diffs)} divergences between the pushdown "
                     f"and interpreter paths:\n" + "\n".join(
                         f"  {q}\n    dev: {d}\n    cpu: {c}"
                         for q, d, c in diffs))
             finally:
+                _scan_mod.ScanKernel.run = orig_run
                 flags.REGISTRY.reset("tpu_pushdown_enabled")
+                flags.REGISTRY.reset("tpu_min_rows_for_pushdown")
                 await mc.shutdown()
         run(go())

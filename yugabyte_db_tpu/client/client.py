@@ -79,6 +79,8 @@ class CachedTable:
     # [{column, parent_table, parent_column}] — SQL-layer existence
     # checks on child writes (reference: FK via the PG executor)
     foreign_keys: List[dict] = None
+    # CHECK constraint ASTs (name-based), evaluated per written row
+    checks: List[tuple] = None
 
 
 async def build_index_ops(ct, table: str, ops, getter):
@@ -253,7 +255,7 @@ class YBClient:
                            tablegroup: Optional[str] = None,
                            split_rows=None,
                            tablespace: Optional[str] = None,
-                           foreign_keys=None) -> str:
+                           foreign_keys=None, checks=None) -> str:
         """split_rows: for range-sharded tables, PK rows whose encoded
         keys become the tablet split points."""
         split_points = None
@@ -270,7 +272,8 @@ class YBClient:
              "replication_factor": replication_factor,
              "tablegroup": tablegroup, "split_points": split_points,
              "tablespace_name": tablespace,
-             "foreign_keys": list(foreign_keys or [])})
+             "foreign_keys": list(foreign_keys or []),
+             "checks": [list(c) for c in (checks or [])]})
         return resp["table_id"]
 
     async def create_tablegroup(self, name: str,
@@ -395,9 +398,12 @@ class YBClient:
                 replicas=[(r["ts_uuid"], tuple(r["addr"]))
                           for r in l["replicas"] if r["addr"]],
                 leader=l.get("leader")))
+        from ..docdb.wire import _expr_from_wire
         cached = CachedTable(info, TableCodec(info), locs,
                              resp.get("indexes") or {},
-                             resp.get("foreign_keys") or [])
+                             resp.get("foreign_keys") or [],
+                             [_expr_from_wire(c)
+                              for c in resp.get("checks") or []])
         self._tables[name] = cached
         return cached
 

@@ -416,6 +416,10 @@ class Master:
                 # txn (reference: FK enforcement through the PG
                 # executor over YB indexes)
                 tent["foreign_keys"] = payload["foreign_keys"]
+            if payload.get("checks"):
+                # CHECK constraint ASTs (wire list form) — evaluated
+                # per written row by the SQL layer
+                tent["checks"] = payload["checks"]
             ops = [["put_table", table_id, tent]]
             ops += [["put_tablet", tid_, ent]
                     for tid_, ent in tablet_entries.items()]
@@ -561,8 +565,21 @@ class Master:
                 raise RpcError(f"column {cname} exists", "ALREADY_PRESENT")
             cols.append(_CS(next_id, cname, ctype, ql_type=ql))
             next_id += 1
-        indexed = {spec.get("column")
-                   for spec in ent.get("indexes", {}).values()}
+        indexed = set()
+        for spec in ent.get("indexes", {}).values():
+            indexed.update(spec.get("columns") or [spec.get("column")])
+
+        def _check_cols(node, out):
+            if not isinstance(node, (list, tuple)) or not node:
+                return
+            if node[0] == "col" and isinstance(node[1], str):
+                out.add(node[1].split(".", 1)[-1])
+                return
+            for c in node[1:]:
+                _check_cols(c, out)
+        check_refs: set = set()
+        for chk in ent.get("checks", []):
+            _check_cols(chk, check_refs)
         for cname in payload.get("drop_columns", []):
             target = next((c for c in cols if c.name == cname), None)
             if target is None:
@@ -575,6 +592,13 @@ class Master:
                     f"cannot drop column {cname}: a secondary index "
                     f"depends on it (drop the index first)",
                     "INVALID_ARGUMENT")
+            if cname in check_refs:
+                # a stale CHECK AST would resolve the dropped column to
+                # NULL and silently pass every row (PG rejects the DROP
+                # without CASCADE)
+                raise RpcError(
+                    f"cannot drop column {cname}: a CHECK constraint "
+                    f"depends on it", "INVALID_ARGUMENT")
             cols.remove(target)
         new_schema = TableSchema(columns=tuple(cols),
                                  version=info.schema.version + 1)
@@ -651,7 +675,8 @@ class Master:
                 return {"table": e["info"],
                         "locations": self._locations(tid),
                         "indexes": e.get("indexes", {}),
-                        "foreign_keys": e.get("foreign_keys", [])}
+                        "foreign_keys": e.get("foreign_keys", []),
+                        "checks": e.get("checks", [])}
         raise RpcError(f"table {name or table_id} not found", "NOT_FOUND")
 
     def _locations(self, table_id: str) -> List[dict]:

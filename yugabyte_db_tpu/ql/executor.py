@@ -573,11 +573,21 @@ class SqlSession:
                     f"REFERENCES {fk['parent_table']}"
                     f"({fk['parent_column']}): referenced column must "
                     f"be the single-column primary key {pk_names}")
+        checks = list(getattr(stmt, "checks", []) or [])
+        col_names = {n for n, _ in stmt.columns}
+        for chk in checks:
+            refs: set = set()
+            self._collect_names(chk, refs)
+            unknown = {self._split_qual(r)[1] for r in refs} - col_names
+            if unknown:
+                raise ValueError(
+                    f"CHECK constraint references unknown column(s) "
+                    f"{sorted(unknown)}")
         await self.client.create_table(
             info, num_tablets=stmt.num_tablets,
             replication_factor=stmt.replication_factor,
             tablespace=getattr(stmt, "tablespace", None),
-            foreign_keys=fks)
+            foreign_keys=fks, checks=checks)
         self._invalidate_fk_children()
         # UNIQUE columns: enforced through unique secondary indexes
         # (the index doc key is the value itself, so duplicates collide
@@ -675,6 +685,7 @@ class SqlSession:
                         f"not-null constraint")
             self._coerce_decimals(dec_cols, row)
             rows.append(row)
+        self._check_check_constraints(ct, rows)
         await self._check_foreign_keys(ct, rows)
         oc = getattr(stmt, "on_conflict", None)
         if oc is not None:
@@ -819,6 +830,7 @@ class SqlSession:
                 merged[name] = _eval(
                     self._bind(await self._resolve_subqueries(e2),
                                schema), idrow)
+            self._check_check_constraints(ct, [merged])
             if any(merged[k] != locked.get(k) for k in pk_names):
                 # SET moved the primary key: PG performs the re-keying
                 # update — delete the old row, strict-insert the new
@@ -997,6 +1009,17 @@ class SqlSession:
 
     def _invalidate_fk_children(self) -> None:
         self._fk_child_map = None
+
+    def _check_check_constraints(self, ct, rows) -> None:
+        """CHECK constraints: a row passes unless the expression is
+        FALSE (NULL passes, as in PG).  Evaluated name-based per
+        written row (reference: CHECK through the PG executor)."""
+        for chk in getattr(ct, "checks", None) or []:
+            for row in rows:
+                if _eval_by_name(chk, row) is False:
+                    raise ValueError(
+                        f'new row for relation "{ct.info.name}" '
+                        f'violates check constraint')
 
     async def _check_foreign_keys(self, ct, rows) -> None:
         """FK-lite: REFERENCES enforced as an existence check inside
@@ -2973,6 +2996,7 @@ class SqlSession:
                         f"null value in column {name!r} violates "
                         f"not-null constraint")
             updated.append(nr)
+        self._check_check_constraints(ct, updated)
         if any(fk["column"] in stmt.sets
                for fk in getattr(ct, "foreign_keys", None) or []):
             await self._check_foreign_keys(ct, updated)
@@ -3292,6 +3316,7 @@ class SqlSession:
                     raise ValueError(
                         f"null value in column {name!r} violates "
                         f"not-null constraint")
+        self._check_check_constraints(ct, updated)
         if any(fk["column"] in stmt.sets
                for fk in getattr(ct, "foreign_keys", None) or []):
             await self._check_foreign_keys(ct, updated)

@@ -38,7 +38,7 @@ KEYWORDS = {
     "false", "array", "any", "all", "extract",
     "union", "intersect", "except", "savepoint", "release", "to",
     "unique", "references", "foreign", "constraint", "for",
-    "truncate", "ilike", "nulls",
+    "truncate", "ilike", "nulls", "check",
 }
 
 # window functions (besides the aggregate ops)
@@ -97,6 +97,9 @@ class CreateTableStmt:
     # FOREIGN KEY clauses
     foreign_keys: List[Tuple[str, str, str]] = field(
         default_factory=list)
+    # CHECK constraint expression ASTs (name-based; evaluated per row
+    # on INSERT/UPDATE — reference: CHECK through the PG executor)
+    checks: List[tuple] = field(default_factory=list)
 
 
 @dataclass
@@ -553,6 +556,7 @@ class Parser:
         not_null: List[str] = []
         unique_cols: List[str] = []
         foreign_keys: List[Tuple[str, str, str]] = []
+        checks: List[tuple] = []
 
         def fk_clause(col):
             parent = self.ident()
@@ -581,6 +585,10 @@ class Parser:
                 # table-level UNIQUE (col[, col...]) — composite
                 # constraints store the tuple
                 unique_cols.append(self._unique_col_list())
+            elif self.accept_kw("check"):
+                self.expect_op("(")
+                checks.append(self.expr())
+                self.expect_op(")")
             elif self.accept_kw("foreign"):
                 # FOREIGN KEY (col) REFERENCES parent (pcol)
                 self.expect_kw("key")
@@ -593,6 +601,10 @@ class Parser:
                 self.ident()           # constraint name (not stored)
                 if self.accept_kw("unique"):
                     unique_cols.append(self._unique_col_list())
+                elif self.accept_kw("check"):
+                    self.expect_op("(")
+                    checks.append(self.expr())
+                    self.expect_op(")")
                 elif self.accept_kw("foreign"):
                     self.expect_kw("key")
                     self.expect_op("(")
@@ -624,6 +636,10 @@ class Parser:
                         pk = [cname]
                     elif self.accept_kw("unique"):
                         unique_cols.append(cname)
+                    elif self.accept_kw("check"):
+                        self.expect_op("(")
+                        checks.append(self.expr())
+                        self.expect_op(")")
                     elif self.accept_kw("references"):
                         fk_clause(cname)
                     else:
@@ -652,7 +668,8 @@ class Parser:
                                num_hash, num_tablets, rf, ine,
                                defaults, not_null, tablespace=tspace,
                                unique_cols=unique_cols,
-                               foreign_keys=foreign_keys)
+                               foreign_keys=foreign_keys,
+                               checks=checks)
 
     def _unique_col_list(self):
         """Parenthesized UNIQUE column list -> name or tuple."""
