@@ -318,6 +318,17 @@ class SqlSession:
             if stmt.order_by:
                 lines.append(f"Sort: {', '.join(c for c, _ in stmt.order_by)}")
             return SqlResult([{"QUERY PLAN": ln} for ln in lines])
+        def _has_subquery(n):
+            if not isinstance(n, tuple):
+                return False
+            if n[0] in ("exists_subquery", "scalar_subquery",
+                        "in_subquery"):
+                return True
+            return any(_has_subquery(c) for c in n
+                       if isinstance(c, tuple))
+        subplan_note = (isinstance(stmt, SelectStmt)
+                        and stmt.where is not None
+                        and _has_subquery(stmt.where))
         if isinstance(stmt, SelectStmt) and (
                 getattr(stmt, "ctes", None)
                 or stmt.table in self._cte_rows):
