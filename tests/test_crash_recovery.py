@@ -214,3 +214,33 @@ class TestTruncateRecovery:
             finally:
                 await mc.shutdown()
         asyncio.run(go())
+
+    def test_snapshot_restore_recovers_pre_truncate_data(self, tmp_path):
+        """A snapshot taken BEFORE a truncate restores the
+        pre-truncate rows into a clone — truncate must not damage
+        snapshot hard-links (the store swaps files wholesale)."""
+        async def go():
+            from yugabyte_db_tpu.docdb import ReadRequest
+            from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+            from tests.test_load_balancer import kv_info
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                c = mc.client()
+                await c.create_table(kv_info(), num_tablets=2)
+                await mc.wait_for_leaders("kv")
+                await c.insert("kv", [{"k": i, "v": float(i)}
+                                      for i in range(30)])
+                snap = await c._master_call("create_snapshot",
+                                            {"table": "kv"})
+                await c.truncate_table("kv")
+                assert (await c.scan("kv", ReadRequest(""))).rows == []
+                await c._master_call(
+                    "restore_snapshot",
+                    {"snapshot_id": snap["snapshot_id"],
+                     "new_name": "kv_before"})
+                await mc.wait_for_leaders("kv_before")
+                rows = (await c.scan("kv_before", ReadRequest(""))).rows
+                assert sorted(r["k"] for r in rows) == list(range(30))
+            finally:
+                await mc.shutdown()
+        run(go())

@@ -225,7 +225,7 @@ class Tablet:
                     continue
                 seen.add(dk)
                 batch.put(dk + bytes([ValueType.kHybridTime])
-                          + DocHybridTime(ht, wid).encode_desc(),
+                          + DocHybridTime(ht, wid).encoded_desc(),
                           PrimitiveValue.tombstone().encode())
                 wid += 1
         if batch.entries:
