@@ -362,3 +362,30 @@ class TestJoinOrderBySemantics:
             finally:
                 await mc.shutdown()
         asyncio.run(go())
+
+
+class TestExplainAnalyze:
+    def test_explain_analyze_runs_and_reports_actuals(self, tmp_path):
+        async def go():
+            from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute("CREATE TABLE ea (k bigint PRIMARY "
+                                "KEY, v bigint) WITH tablets = 1")
+                await s.execute(
+                    "INSERT INTO ea (k, v) VALUES (1, 1), (2, 2)")
+                r = await s.execute(
+                    "EXPLAIN ANALYZE SELECT k FROM ea WHERE v > 1")
+                plan = [x["QUERY PLAN"] for x in r.rows]
+                assert any("Actual rows: 1" in ln for ln in plan), plan
+                assert any(ln.startswith("Execution Time:")
+                           for ln in plan), plan
+                # DML side effects apply, as in PG
+                await s.execute(
+                    "EXPLAIN ANALYZE UPDATE ea SET v = 9 WHERE k = 1")
+                r = await s.execute("SELECT v FROM ea WHERE k = 1")
+                assert r.rows == [{"v": 9}]
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())

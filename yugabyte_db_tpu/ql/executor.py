@@ -204,7 +204,22 @@ class SqlSession:
                     unique=getattr(stmt, "unique", False))
             return SqlResult([], f"CREATE INDEX ({n} rows)")
         if isinstance(stmt, ExplainStmt):
-            return await self._explain(stmt.inner)
+            plan = await self._explain(stmt.inner)
+            if not getattr(stmt, "analyze", False):
+                return plan
+            # EXPLAIN ANALYZE: run the statement for real and append
+            # actuals (reference: PG EXPLAIN ANALYZE; DML side effects
+            # apply, as in PG)
+            import time as _time
+            t0 = _time.perf_counter()
+            res = await self._dispatch_inner(stmt.inner)
+            ms = (_time.perf_counter() - t0) * 1e3
+            lines = list(plan.rows)
+            lines.append({"QUERY PLAN":
+                          f"  Actual rows: {len(res.rows)}"})
+            lines.append({"QUERY PLAN":
+                          f"Execution Time: {ms:.3f} ms"})
+            return SqlResult(lines)
         if isinstance(stmt, AnalyzeStmt):
             return await self._analyze(stmt)
         if isinstance(stmt, TruncateStmt):

@@ -200,6 +200,7 @@ class InsertStmt:
 @dataclass
 class ExplainStmt:
     inner: object
+    analyze: bool = False   # EXPLAIN ANALYZE: execute + actuals
 
 
 @dataclass
@@ -363,7 +364,8 @@ class Parser:
         word = t[1].lower()
         if word == "explain":
             self.next()
-            return ExplainStmt(self.parse_one())
+            analyze = bool(self.accept_kw("analyze"))
+            return ExplainStmt(self.parse_one(), analyze=analyze)
 
         fn = {
             "create": self.create_table, "drop": self.drop_table,
