@@ -14,3 +14,9 @@ SELECT id, age % 7 AS m FROM ppl ORDER BY id;
 SELECT CAST(age AS text) AS t FROM ppl WHERE id = 2;
 SELECT count(*) FROM ppl WHERE nick IS NULL;
 DROP TABLE ppl
+-- simple-form CASE (base WHEN value) rewrites to searched CASE
+CREATE TABLE sc (k bigint PRIMARY KEY, b boolean) WITH tablets = 1;
+INSERT INTO sc (k, b) VALUES (1, true), (2, false), (3, NULL);
+SELECT k, CASE b WHEN true THEN 'yes' WHEN false THEN 'no' ELSE 'unk' END AS a FROM sc ORDER BY k;
+SELECT CASE k WHEN 1 THEN 'one' WHEN 2 THEN 'two' END AS n FROM sc ORDER BY k;
+DROP TABLE sc;

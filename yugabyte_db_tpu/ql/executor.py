@@ -45,6 +45,7 @@ _TYPE_MAP = {
     "bool": ColumnType.BOOL, "boolean": ColumnType.BOOL,
     "timestamp": ColumnType.TIMESTAMP,
     "bytea": ColumnType.BINARY, "blob": ColumnType.BINARY,
+    "binary": ColumnType.BINARY,
     "jsonb": ColumnType.JSON, "json": ColumnType.JSON,
     "decimal": ColumnType.DECIMAL, "numeric": ColumnType.DECIMAL,
     "vector": ColumnType.VECTOR,

@@ -1574,14 +1574,23 @@ class Parser:
         if t[0] == "op" and t[1] == "-":
             return ("const", self.literal())
         if t[0] == "kw" and t[1].lower() == "case":
-            # searched CASE: WHEN cond THEN val ... [ELSE val] END
+            # searched CASE: WHEN cond THEN val ... [ELSE val] END.
+            # Simple-form CASE <base> WHEN v THEN ... rewrites to the
+            # searched form with <base> = v conditions (PG semantics).
             # AST is flattened so generic walkers recurse children:
             # ("case", n_pairs, c1, v1, ..., cn, vn, else_node)
             self.next()
+            base = None
+            nt = self.peek()
+            if not (nt and nt[0] == "kw" and nt[1].lower() == "when"):
+                base = self.expr()
             parts = []
             n_pairs = 0
             while self.accept_kw("when"):
-                parts.append(self.expr())
+                cond = self.expr()
+                if base is not None:
+                    cond = ("cmp", "eq", base, cond)
+                parts.append(cond)
                 self.expect_kw("then")
                 parts.append(self.expr())
                 n_pairs += 1
