@@ -13,7 +13,7 @@ SELECT abs(-7) AS a, round(2.718, 2) AS r;
 SELECT id, age % 7 AS m FROM ppl ORDER BY id;
 SELECT CAST(age AS text) AS t FROM ppl WHERE id = 2;
 SELECT count(*) FROM ppl WHERE nick IS NULL;
-DROP TABLE ppl
+DROP TABLE ppl;
 -- simple-form CASE (base WHEN value) rewrites to searched CASE
 CREATE TABLE sc (k bigint PRIMARY KEY, b boolean) WITH tablets = 1;
 INSERT INTO sc (k, b) VALUES (1, true), (2, false), (3, NULL);
