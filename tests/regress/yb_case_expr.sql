@@ -20,3 +20,8 @@ INSERT INTO sc (k, b) VALUES (1, true), (2, false), (3, NULL);
 SELECT k, CASE b WHEN true THEN 'yes' WHEN false THEN 'no' ELSE 'unk' END AS a FROM sc ORDER BY k;
 SELECT CASE k WHEN 1 THEN 'one' WHEN 2 THEN 'two' END AS n FROM sc ORDER BY k;
 DROP TABLE sc;
+-- binary type alias maps to bytea storage
+CREATE TABLE bt (k bigint PRIMARY KEY, payload binary) WITH tablets = 1;
+INSERT INTO bt (k) VALUES (1);
+SELECT k FROM bt WHERE payload IS NULL;
+DROP TABLE bt;

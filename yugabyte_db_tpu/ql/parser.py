@@ -1584,6 +1584,27 @@ class Parser:
             nt = self.peek()
             if not (nt and nt[0] == "kw" and nt[1].lower() == "when"):
                 base = self.expr()
+
+                def _volatile(n):
+                    if not isinstance(n, tuple):
+                        return False
+                    if n[0] == "fn" and n[1] in ("nextval", "currval",
+                                                 "now"):
+                        return True
+                    if n[0] in ("scalar_subquery", "exists_subquery",
+                                "in_subquery"):
+                        return True
+                    return any(_volatile(c) for c in n
+                               if isinstance(c, tuple))
+                if _volatile(base):
+                    # the rewrite DUPLICATES the base into every arm;
+                    # a volatile base would evaluate once per arm (PG
+                    # evaluates it once) — refuse rather than be
+                    # silently wrong
+                    raise ValueError(
+                        "CASE <expr> WHEN with a volatile base "
+                        "(sequences, now(), subqueries) is not "
+                        "supported; use searched CASE WHEN <cond>")
             parts = []
             n_pairs = 0
             while self.accept_kw("when"):
