@@ -25,3 +25,6 @@ CREATE TABLE bt (k bigint PRIMARY KEY, payload binary) WITH tablets = 1;
 INSERT INTO bt (k) VALUES (1);
 SELECT k FROM bt WHERE payload IS NULL;
 DROP TABLE bt;
+CREATE SEQUENCE vseq;
+SELECT CASE nextval('vseq') WHEN 1 THEN 'one' ELSE 'other' END AS c;
+DROP SEQUENCE vseq;

@@ -1,0 +1,12 @@
+-- CTEs and views (reference: PG WITH + view expansion in YSQL)
+CREATE TABLE base (k bigint PRIMARY KEY, g text, v bigint) WITH tablets = 1;
+INSERT INTO base (k, g, v) VALUES (1, 'x', 5), (2, 'y', 7), (3, 'x', 9);
+WITH t AS (SELECT g, v FROM base WHERE v > 5) SELECT g, sum(v) FROM t GROUP BY g ORDER BY g;
+WITH a AS (SELECT k, v FROM base), b AS (SELECT k FROM a WHERE v > 6) SELECT count(*) FROM b;
+CREATE VIEW big_rows AS SELECT k, g FROM base WHERE v >= 7;
+SELECT k, g FROM big_rows ORDER BY k;
+CREATE OR REPLACE VIEW big_rows AS SELECT k FROM base WHERE v >= 9;
+SELECT k FROM big_rows;
+DROP VIEW big_rows;
+SELECT k FROM big_rows;
+DROP TABLE base;

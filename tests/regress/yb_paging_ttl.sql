@@ -1,0 +1,10 @@
+-- LIMIT/OFFSET paging + row TTL (reference: paging state + expiring rows)
+CREATE TABLE pg (k bigint PRIMARY KEY, v bigint) WITH tablets = 2;
+INSERT INTO pg (k, v) SELECT g, g * 10 FROM generate_series(1, 25) AS g;
+SELECT k FROM pg ORDER BY k LIMIT 5;
+SELECT k FROM pg ORDER BY k LIMIT 5 OFFSET 10;
+SELECT k FROM pg ORDER BY k DESC LIMIT 3;
+SELECT count(*) FROM pg WHERE k BETWEEN 5 AND 24;
+INSERT INTO pg (k, v) VALUES (100, 1) USING TTL 30;
+SELECT count(*) FROM pg WHERE k = 100;
+DROP TABLE pg;

@@ -1,0 +1,16 @@
+-- sequences + serial columns (reference: PG sequences over YB)
+CREATE SEQUENCE s1;
+SELECT nextval('s1') AS a;
+SELECT nextval('s1') AS b;
+SELECT currval('s1') AS c;
+CREATE TABLE ser (id bigserial PRIMARY KEY, tag text) WITH tablets = 1;
+INSERT INTO ser (tag) VALUES ('p');
+INSERT INTO ser (tag) VALUES ('q');
+SELECT id, tag FROM ser ORDER BY id;
+CREATE SEQUENCE s2 START WITH 100;
+INSERT INTO ser (id, tag) VALUES (nextval('s2'), 'r');
+SELECT count(*) FROM ser;
+SELECT id FROM ser ORDER BY id;
+DROP SEQUENCE s2;
+DROP TABLE ser;
+DROP SEQUENCE s1;

@@ -1,0 +1,19 @@
+-- transaction semantics observable in one session: read-your-writes,
+-- rollback, isolation BEGIN variants, txn aggregate overlays
+CREATE TABLE tx (k bigint PRIMARY KEY, v bigint) WITH tablets = 2;
+INSERT INTO tx (k, v) VALUES (1, 10), (2, 20);
+BEGIN;
+INSERT INTO tx (k, v) VALUES (3, 30);
+SELECT count(*), sum(v) FROM tx;
+UPDATE tx SET v = 11 WHERE k = 1;
+SELECT v FROM tx WHERE k = 1;
+DELETE FROM tx WHERE k = 2;
+SELECT k FROM tx ORDER BY k;
+ROLLBACK;
+SELECT k, v FROM tx ORDER BY k;
+BEGIN TRANSACTION ISOLATION LEVEL SERIALIZABLE;
+SELECT v FROM tx WHERE k = 1;
+UPDATE tx SET v = 99 WHERE k = 1;
+COMMIT;
+SELECT v FROM tx WHERE k = 1;
+DROP TABLE tx;

@@ -1,0 +1,11 @@
+-- window functions (reference: PG WindowAgg through the YSQL executor)
+CREATE TABLE w (k bigint PRIMARY KEY, g text, v bigint) WITH tablets = 1;
+INSERT INTO w (k, g, v) VALUES (1, 'a', 10), (2, 'a', 30), (3, 'b', 20), (4, 'b', 20), (5, 'a', 20);
+SELECT k, row_number() OVER (ORDER BY k) FROM w ORDER BY k;
+SELECT k, v, rank() OVER (ORDER BY v) AS r FROM w ORDER BY k;
+SELECT k, v, dense_rank() OVER (ORDER BY v) AS dr FROM w ORDER BY k;
+SELECT k, g, sum(v) OVER (PARTITION BY g ORDER BY k) AS run FROM w ORDER BY k;
+SELECT k, lag(v, 1) OVER (ORDER BY k) AS prev, lead(v, 1) OVER (ORDER BY k) AS nxt FROM w ORDER BY k;
+SELECT k, count(*) OVER (PARTITION BY g) AS cnt FROM w ORDER BY k;
+SELECT k, avg(v) OVER (PARTITION BY g) AS mean FROM w ORDER BY k;
+DROP TABLE w;
