@@ -333,6 +333,50 @@ encode_entry(KeyBuf *kb, int kind, int desc, PyObject *v)
     return -1;
 }
 
+static int
+build_doc_key(long long cotable, int num_hash, const uint8_t *kk,
+              const uint8_t *dd, Py_ssize_t ncols, PyObject *values,
+              KeyBuf *kb)
+{
+    kb->len = 0;
+    if (kb_reserve(kb, 16) < 0) return -1;
+    if (cotable >= 0) {
+        kb_put(kb, VT_COTABLE);
+        for (int i = 3; i >= 0; i--)
+            kb_put(kb, (uint8_t)((uint64_t)cotable >> (8 * i)));
+    }
+    if (num_hash > 0) {
+        /* FNV-1a over the encoded hash entries, folded to 16 bits
+         * (must agree bit-for-bit with dockv/partition.py) */
+        Py_ssize_t hash_at = kb->len;
+        kb_put(kb, VT_U16_HASH);
+        kb_put(kb, 0); kb_put(kb, 0);       /* patched below */
+        Py_ssize_t h0 = kb->len;
+        for (int i = 0; i < num_hash; i++) {
+            if (encode_entry(kb, kk[i], dd[i],
+                             PyTuple_GET_ITEM(values, i)) < 0)
+                return -1;
+        }
+        uint64_t h = 0xCBF29CE484222325ULL;
+        for (Py_ssize_t i = h0; i < kb->len; i++)
+            h = (h ^ kb->buf[i]) * 0x100000001B3ULL;
+        h ^= h >> 32;
+        uint16_t h16 = (uint16_t)(h & 0xFFFF);
+        kb->buf[hash_at + 1] = (uint8_t)(h16 >> 8);
+        kb->buf[hash_at + 2] = (uint8_t)(h16 & 0xFF);
+        if (kb_reserve(kb, 1) < 0) return -1;
+        kb_put(kb, VT_GROUP_END);
+    }
+    for (Py_ssize_t i = num_hash; i < ncols; i++) {
+        if (encode_entry(kb, kk[i], dd[i],
+                         PyTuple_GET_ITEM(values, i)) < 0)
+            return -1;
+    }
+    if (kb_reserve(kb, 1) < 0) return -1;
+    kb_put(kb, VT_GROUP_END);
+    return 0;
+}
+
 static PyObject *
 py_encode_doc_key(PyObject *mod, PyObject *args)
 {
@@ -345,53 +389,19 @@ py_encode_doc_key(PyObject *mod, PyObject *args)
         return NULL;
     PyObject *result = NULL;
     KeyBuf kb = {NULL, 0, 0};
-    Py_ssize_t ncols = 0;
-    const uint8_t *kk = (const uint8_t *)kinds.buf;
-    const uint8_t *dd = (const uint8_t *)descs.buf;
     if (!PyTuple_Check(values)) {
         PyErr_SetString(PyExc_TypeError, "values must be a tuple");
         goto done;
     }
-    ncols = PyTuple_GET_SIZE(values);
-    if (ncols != kinds.len || ncols != descs.len) {
+    if (PyTuple_GET_SIZE(values) != kinds.len ||
+        PyTuple_GET_SIZE(values) != descs.len) {
         PyErr_SetString(PyExc_ValueError, "spec/values length mismatch");
         goto done;
     }
-    if (kb_reserve(&kb, 16) < 0) goto done;
-    if (cotable >= 0) {
-        kb_put(&kb, VT_COTABLE);
-        for (int i = 3; i >= 0; i--)
-            kb_put(&kb, (uint8_t)((uint64_t)cotable >> (8 * i)));
-    }
-    if (num_hash > 0) {
-        /* FNV-1a over the encoded hash entries, folded to 16 bits
-         * (must agree bit-for-bit with dockv/partition.py) */
-        Py_ssize_t hash_at = kb.len;
-        kb_put(&kb, VT_U16_HASH);
-        kb_put(&kb, 0); kb_put(&kb, 0);       /* patched below */
-        Py_ssize_t h0 = kb.len;
-        for (int i = 0; i < num_hash; i++) {
-            if (encode_entry(&kb, kk[i], dd[i],
-                             PyTuple_GET_ITEM(values, i)) < 0)
-                goto done;
-        }
-        uint64_t h = 0xCBF29CE484222325ULL;
-        for (Py_ssize_t i = h0; i < kb.len; i++)
-            h = (h ^ kb.buf[i]) * 0x100000001B3ULL;
-        h ^= h >> 32;
-        uint16_t h16 = (uint16_t)(h & 0xFFFF);
-        kb.buf[hash_at + 1] = (uint8_t)(h16 >> 8);
-        kb.buf[hash_at + 2] = (uint8_t)(h16 & 0xFF);
-        if (kb_reserve(&kb, 1) < 0) goto done;
-        kb_put(&kb, VT_GROUP_END);
-    }
-    for (Py_ssize_t i = num_hash; i < ncols; i++) {
-        if (encode_entry(&kb, kk[i], dd[i],
-                         PyTuple_GET_ITEM(values, i)) < 0)
-            goto done;
-    }
-    if (kb_reserve(&kb, 1) < 0) goto done;
-    kb_put(&kb, VT_GROUP_END);
+    if (build_doc_key(cotable, num_hash, (const uint8_t *)kinds.buf,
+                      (const uint8_t *)descs.buf,
+                      PyTuple_GET_SIZE(values), values, &kb) < 0)
+        goto done;
     result = PyBytes_FromStringAndSize((const char *)kb.buf, kb.len);
 done:
     PyMem_Free(kb.buf);
@@ -1110,9 +1120,188 @@ static PyTypeObject PointReaderType = {
     .tp_new = PointReader_new,
 };
 
+/* ---------------------------------------------------------------------
+ * range_read(spec, lo, hi, readers, read_ht, restart_hi, want_cols,
+ *            mem_set) -> list
+ *
+ * Fused enumerated-range scan for a single-int-hash-PK table (the
+ * YCSB-E shape; reference: point segments in
+ * src/yb/docdb/hybrid_scan_choices.cc driving rocksdb MultiGet): for
+ * every integer key in [lo, hi] this encodes the DocKey, runs the
+ * bloom+bisect+MVCC point lookup against EVERY PointReader (one per
+ * SST), and merges winners by (commit ht, write id) — all without
+ * surfacing per-key intermediates to Python.
+ *
+ * Per-key results:
+ *   dict  - final visible row (projected when want_cols given)
+ *   None  - no visible row (absent or tombstone)
+ *   (prefix, got) - the key needs Python attention:
+ *       got NotImplemented -> non-columnar block, per-key slow path
+ *       got int            -> read-restart hybrid time (raise)
+ *       got tuple|None     -> native best; the key hit the memtable
+ *                             guard set, caller merges _mem_best
+ * mem_set is the single active memtable's row-prefix set (exact
+ * membership, storage/memtable.py) or None when no memtable probe is
+ * needed.
+ */
+static PyObject *
+hot_range_read(PyObject *mod, PyObject *args)
+{
+    long long cotable, lo, hi;
+    int num_hash;
+    Py_buffer kinds, descs;
+    PyObject *readers, *want, *mem_set;
+    unsigned long long read_ht;
+    long long restart_hi;
+    if (!PyArg_ParseTuple(args, "(Liy*y*)LLOKLOO", &cotable, &num_hash,
+                          &kinds, &descs, &lo, &hi, &readers, &read_ht,
+                          &restart_hi, &want, &mem_set))
+        return NULL;
+    PyObject *out = NULL;
+    KeyBuf kb = {NULL, 0, 0};
+    Py_ssize_t nr = 0, n = 0;
+    unsigned long long span = 0;
+    PyObject *wc = NULL;
+    if (want != Py_None && !PyTuple_Check(want)) {
+        PyErr_SetString(PyExc_TypeError, "want_cols must be tuple|None");
+        goto fail;
+    }
+    if (mem_set != Py_None && !PySet_Check(mem_set)) {
+        PyErr_SetString(PyExc_TypeError, "mem_set must be a set|None");
+        goto fail;
+    }
+    if (!PyTuple_Check(readers)) {
+        PyErr_SetString(PyExc_TypeError, "readers must be a tuple");
+        goto fail;
+    }
+    nr = PyTuple_GET_SIZE(readers);
+    for (Py_ssize_t i = 0; i < nr; i++) {
+        if (!PyObject_TypeCheck(PyTuple_GET_ITEM(readers, i),
+                                &PointReaderType)) {
+            PyErr_SetString(PyExc_TypeError, "readers[i]: PointReader");
+            goto fail;
+        }
+    }
+    if (kinds.len != 1 || descs.len != 1 || num_hash != 1) {
+        PyErr_SetString(PyExc_ValueError,
+                        "range_read needs a single hash key column");
+        goto fail;
+    }
+    span = (unsigned long long)hi - (unsigned long long)lo;
+    if (hi < lo || span >= 1000000ULL) {
+        PyErr_SetString(PyExc_ValueError, "bad key range");
+        goto fail;
+    }
+    n = (Py_ssize_t)(span + 1);
+    out = PyList_New(n);
+    if (!out) goto fail;
+    wc = want == Py_None ? NULL : want;
+    for (Py_ssize_t idx = 0; idx < n; idx++) {
+        long long k = lo + (long long)idx;
+        PyObject *kv = PyLong_FromLongLong(k);
+        if (!kv) goto fail;
+        PyObject *vals = PyTuple_Pack(1, kv);
+        Py_DECREF(kv);
+        if (!vals) goto fail;
+        int erc = build_doc_key(cotable, num_hash,
+                                (const uint8_t *)kinds.buf,
+                                (const uint8_t *)descs.buf, 1, vals, &kb);
+        Py_DECREF(vals);
+        if (erc < 0) goto fail;
+        const uint8_t *pp = kb.buf;
+        Py_ssize_t plen = kb.len;
+        PyObject *best = NULL;       /* (ht, wid, row) winner so far */
+        PyObject *attention = NULL;  /* NotImplemented | restart int */
+        for (Py_ssize_t r = 0; r < nr; r++) {
+            PyObject *got = pointreader_find_one(
+                (PointReader *)PyTuple_GET_ITEM(readers, r),
+                pp, plen, read_ht, restart_hi, wc);
+            if (!got) { Py_XDECREF(best); goto fail; }
+            if (got == Py_None) { Py_DECREF(got); continue; }
+            if (got == Py_NotImplemented || PyLong_Check(got)) {
+                attention = got;
+                break;
+            }
+            if (best == NULL) {
+                best = got;
+                continue;
+            }
+            /* compare (ht, wid) — unsigned, boxed by find_one */
+            uint64_t bht = PyLong_AsUnsignedLongLong(
+                PyTuple_GET_ITEM(best, 0));
+            uint64_t ght = PyLong_AsUnsignedLongLong(
+                PyTuple_GET_ITEM(got, 0));
+            uint64_t bw = PyLong_AsUnsignedLongLong(
+                PyTuple_GET_ITEM(best, 1));
+            uint64_t gw = PyLong_AsUnsignedLongLong(
+                PyTuple_GET_ITEM(got, 1));
+            if (PyErr_Occurred()) {
+                Py_DECREF(got); Py_DECREF(best); goto fail;
+            }
+            if (ght > bht || (ght == bht && gw > bw)) {
+                Py_DECREF(best);
+                best = got;
+            } else {
+                Py_DECREF(got);
+            }
+        }
+        PyObject *slot;
+        int mem_hit = 0;
+        if (!attention && mem_set != Py_None) {
+            PyObject *pb = PyBytes_FromStringAndSize((const char *)pp,
+                                                     plen);
+            if (!pb) { Py_XDECREF(best); goto fail; }
+            mem_hit = PySet_Contains(mem_set, pb);
+            if (mem_hit < 0) {
+                Py_DECREF(pb); Py_XDECREF(best); goto fail;
+            }
+            if (mem_hit) {
+                slot = PyTuple_Pack(2, pb, best ? best : Py_None);
+                Py_DECREF(pb);
+                Py_XDECREF(best);
+                if (!slot) goto fail;
+                PyList_SET_ITEM(out, idx, slot);
+                continue;
+            }
+            Py_DECREF(pb);
+        }
+        if (attention) {
+            Py_XDECREF(best);
+            PyObject *pb = PyBytes_FromStringAndSize((const char *)pp,
+                                                     plen);
+            if (!pb) { Py_DECREF(attention); goto fail; }
+            slot = PyTuple_Pack(2, pb, attention);
+            Py_DECREF(pb);
+            Py_DECREF(attention);
+            if (!slot) goto fail;
+        } else if (best) {
+            slot = PyTuple_GET_ITEM(best, 2);   /* row dict | None */
+            Py_INCREF(slot);
+            Py_DECREF(best);
+        } else {
+            slot = Py_None;
+            Py_INCREF(slot);
+        }
+        PyList_SET_ITEM(out, idx, slot);
+    }
+    PyMem_Free(kb.buf);
+    PyBuffer_Release(&kinds);
+    PyBuffer_Release(&descs);
+    return out;
+fail:
+    Py_XDECREF(out);
+    PyMem_Free(kb.buf);
+    PyBuffer_Release(&kinds);
+    PyBuffer_Release(&descs);
+    return NULL;
+}
+
 static PyMethodDef hot_methods[] = {
     {"encode_doc_key", py_encode_doc_key, METH_VARARGS,
      "encode_doc_key(spec, values) -> encoded DocKey bytes"},
+    {"range_read", hot_range_read, METH_VARARGS,
+     "range_read(spec, lo, hi, readers, read_ht, restart_hi, want_cols,"
+     " mem_set) -> per-key rows/attention list"},
     {"fnv64", py_fnv64, METH_O,
      "fnv64(bytes) -> FNV-1a 64-bit hash"},
     {"bloom_may_contain", py_bloom_may_contain, METH_VARARGS,

@@ -1003,6 +1003,73 @@ class DocReadOperation:
             out.append(b[2] if b is not None else None)
         return out
 
+    def _enumerated_multi_get(self, hot, spec, keys, read_ht: int,
+                              want) -> List[Optional[Dict[str, object]]]:
+        """Per-key path for enumerated scans: inline single-int key
+        encoding (one native call per key, no per-key dict/genexpr
+        wrapping) feeding the batched prefix MultiGet."""
+        restart_hi = (read_ht + _skew_window_ht()
+                      if self._allow_restart else None)
+        enc = hot.encode_doc_key
+        prefixes = [enc(spec, (int(k),)) for k in keys]
+        return self._multi_get_prefixes(prefixes, read_ht, restart_hi,
+                                        want)
+
+    def _range_read_fused(self, hot, spec, keys: range, read_ht: int,
+                          want) -> List[Optional[Dict[str, object]]]:
+        """Contiguous-int-key MultiGet through ONE C call
+        (ybtpu_hot.range_read): key encode + per-SST bloom/bisect/MVCC
+        walk + cross-SST merge + memtable-guard probe all happen below
+        the interpreter; only keys the C side flags (memtable hit,
+        non-columnar block, read restart) surface for per-key Python
+        handling. Mirrors _multi_get_prefixes semantics exactly —
+        falls back to it when the snapshot shape disqualifies the
+        fused path (reader-less SST, multiple or foreign-layout
+        memtables)."""
+        restart_hi = (read_ht + _skew_window_ht()
+                      if self._allow_restart else None)
+        mems, ssts = self.store.read_snapshot()
+
+        def fallback():
+            return self._enumerated_multi_get(hot, spec, keys, read_ht,
+                                              want)
+
+        readers = []
+        for r in ssts:
+            pr = r.point_reader(self.codec)
+            if pr is None:
+                return fallback()
+            readers.append(pr)
+        mem_active = [m for m in mems if not m.empty()]
+        if any(m._foreign_layout for m in mem_active) \
+                or len(mem_active) > 1:
+            return fallback()
+        ms0 = mem_active[0]._row_prefixes if mem_active else None
+        rh = -1 if restart_hi is None else restart_hi
+        res = hot.range_read(spec, keys.start, keys.stop - 1,
+                             tuple(readers), read_ht, rh, want, ms0)
+        out: List[Optional[Dict[str, object]]] = []
+        for item in res:
+            if type(item) is not tuple:
+                out.append(item)       # final row dict | None
+                continue
+            p, got = item
+            if got is NotImplemented:
+                f = self._find_best(p, read_ht, restart_hi, mems, ssts)
+                out.append(None if f is None
+                           else self._decode_best(f, read_ht))
+                continue
+            if isinstance(got, int):
+                raise ReadRestartError(got)
+            # memtable-guard hit: merge the memtable candidate against
+            # the native winner by (commit ht, write id)
+            mb = self._mem_best(p, read_ht, restart_hi, mem_active)
+            if mb is not None and (got is None or mb[:2] > got[:2]):
+                out.append(self._decode_best(mb, read_ht))
+            else:
+                out.append(got[2] if got is not None else None)
+        return out
+
     # ---- scans -----------------------------------------------------------
     def execute(self, req: ReadRequest) -> ReadResponse:
         if req.server_assigned_read_ht:
@@ -1126,16 +1193,14 @@ class DocReadOperation:
             else None
         hot = _hot_mod()
         spec = getattr(self.codec, "_key_spec", None)
-        if hot is not None and spec is not None:
-            # inline single-int key encoding: one native call per key
-            # with no per-key dict/genexpr wrapping (the enumerated
-            # scan is called tens of thousands of times per second)
-            restart_hi = (read_ht + _skew_window_ht()
-                          if self._allow_restart else None)
-            enc = hot.encode_doc_key
-            prefixes = [enc(spec, (int(k),)) for k in keys]
-            rows = self._multi_get_prefixes(prefixes, read_ht,
-                                            restart_hi, want)
+        if (hot is not None and spec is not None
+                and isinstance(keys, range) and keys
+                and len(keys) < 1_000_000
+                and hasattr(hot, "range_read")):
+            rows = self._range_read_fused(hot, spec, keys, read_ht, want)
+        elif hot is not None and spec is not None:
+            rows = self._enumerated_multi_get(hot, spec, keys, read_ht,
+                                              want)
         else:
             rows = self.multi_get([{name: int(k)} for k in keys],
                                   read_ht,
