@@ -1,0 +1,14 @@
+-- EXPLAIN plan shapes (reference: PG EXPLAIN over YB scan/agg pushdown)
+CREATE TABLE ex1 (k bigint PRIMARY KEY, g bigint, v bigint) WITH tablets = 1;
+CREATE TABLE ex2 (k bigint PRIMARY KEY, w bigint) WITH tablets = 1;
+CREATE INDEX exg ON ex1 (g);
+EXPLAIN SELECT * FROM ex1 WHERE k = 1;
+EXPLAIN SELECT v FROM ex1 WHERE g = 5;
+EXPLAIN SELECT sum(v) FROM ex1;
+EXPLAIN SELECT g, count(*) FROM ex1 GROUP BY g;
+EXPLAIN SELECT ex1.v, ex2.w FROM ex1 JOIN ex2 ON ex1.k = ex2.k WHERE ex2.w > 3;
+EXPLAIN SELECT v FROM ex1 ORDER BY v LIMIT 3;
+DROP INDEX exg;
+EXPLAIN SELECT v FROM ex1 WHERE g = 5;
+DROP TABLE ex2;
+DROP TABLE ex1;

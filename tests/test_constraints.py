@@ -533,3 +533,92 @@ class TestSqlConstraints:
             finally:
                 await mc.shutdown()
         run(go())
+
+
+class TestDropIndex:
+    def test_drop_index_sql(self, tmp_path):
+        """DROP INDEX deregisters the index (writes stop maintaining
+        it, the planner stops using it), drops its backing table, and
+        frees the name for re-creation; IF EXISTS forgives absence
+        (reference: DROP INDEX -> master DeleteTable on the index
+        relation, src/yb/master/catalog_manager.cc)."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path),
+                                   num_tservers=1).start()
+            try:
+                c = mc.client()
+                s = SqlSession(c)
+                await s.execute(
+                    "CREATE TABLE di (k bigint PRIMARY KEY, a text) "
+                    "WITH tablets = 1")
+                await s.execute("CREATE INDEX di_a ON di (a)")
+                await s.execute(
+                    "INSERT INTO di (k, a) VALUES (1, 'x'), (2, 'y')")
+                r = await s.execute("EXPLAIN SELECT k FROM di "
+                                    "WHERE a = 'x'")
+                assert "Index Lookup" in r.rows[0]["QUERY PLAN"]
+                await s.execute("DROP INDEX di_a")
+                # planner reverts to seq scan; queries still answer
+                r = await s.execute("EXPLAIN SELECT k FROM di "
+                                    "WHERE a = 'x'")
+                assert "Seq Scan" in r.rows[0]["QUERY PLAN"]
+                r = await s.execute("SELECT k FROM di WHERE a = 'x'")
+                assert [x["k"] for x in r.rows] == [1]
+                # the backing table is gone from the catalog
+                names = {t["name"] for t in await c.list_tables()}
+                assert "di_a" not in names
+                # writes no longer maintain the dropped index; the
+                # name is free for a fresh index that backfills anew
+                await s.execute(
+                    "INSERT INTO di (k, a) VALUES (3, 'z')")
+                await s.execute("CREATE INDEX di_a ON di (a)")
+                pks = await c.index_lookup("di", "di_a", "z")
+                assert [p["k"] for p in pks] == [3]
+                await s.execute("DROP INDEX di_a")
+                with pytest.raises(Exception):
+                    await s.execute("DROP INDEX di_a")
+                await s.execute("DROP INDEX IF EXISTS di_a")
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())
+
+    def test_concurrent_drop_heals_other_clients_cache(self, tmp_path):
+        """A client that cached the index list before another session
+        ran DROP INDEX must not fail its base-table writes forever:
+        the NOT_FOUND from the dead index table triggers a catalog
+        refresh and the write proceeds (both txn and non-txn paths)."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path),
+                                   num_tservers=1).start()
+            try:
+                a, b = mc.client(), mc.client()
+                sa = SqlSession(a)
+                await sa.execute(
+                    "CREATE TABLE cd (k bigint PRIMARY KEY, a text) "
+                    "WITH tablets = 1")
+                await sa.execute("CREATE INDEX cd_a ON cd (a)")
+                # B populates its cache with the index registered
+                await b.write("cd", [RowOp("upsert",
+                                           {"k": 1, "a": "x"})])
+                assert (await b._table("cd")).indexes
+                await sa.execute("DROP INDEX cd_a")
+                # non-txn write through B's stale cache must succeed
+                await b.write("cd", [RowOp("upsert",
+                                           {"k": 2, "a": "y"})])
+                assert not (await b._table("cd")).indexes
+                # and a txn write from a third stale client too
+                c = mc.client()
+                await sa.execute("CREATE INDEX cd_a ON cd (a)")
+                await c.write("cd", [RowOp("upsert",
+                                           {"k": 3, "a": "z"})])
+                await sa.execute("DROP INDEX cd_a")
+                sc = SqlSession(c)
+                await sc.execute("BEGIN")
+                await sc.execute(
+                    "INSERT INTO cd (k, a) VALUES (4, 'w')")
+                await sc.execute("COMMIT")
+                r = await sc.execute("SELECT count(*) FROM cd")
+                assert r.rows[0]["count"] == 4
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())

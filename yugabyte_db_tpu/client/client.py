@@ -544,19 +544,31 @@ class YBClient:
         try:
             for index_name, idx_ops, undo_ops in await build_index_ops(
                     ct, table, ops, self.get):
-                if any(o.kind == "insert" for o in idx_ops):
-                    # unique inserts go ONE AT A TIME: a multi-op batch
-                    # fans out across index tablets concurrently, and a
-                    # duplicate rejection on one tablet cannot tell us
-                    # which sibling ops applied — blanket-undoing the
-                    # failed batch could delete the EXISTING owner's
-                    # entry.  Per-op writes make applied == undone.
-                    for o, u in zip(idx_ops, undo_ops):
-                        await self.write(index_name, [o])
-                        undo.append((index_name, [u]))
-                else:
-                    await self.write(index_name, idx_ops)
-                    undo.append((index_name, undo_ops))
+                try:
+                    if any(o.kind == "insert" for o in idx_ops):
+                        # unique inserts go ONE AT A TIME: a multi-op
+                        # batch fans out across index tablets
+                        # concurrently, and a duplicate rejection on
+                        # one tablet cannot tell us which sibling ops
+                        # applied — blanket-undoing the failed batch
+                        # could delete the EXISTING owner's entry.
+                        # Per-op writes make applied == undone.
+                        for o, u in zip(idx_ops, undo_ops):
+                            await self.write(index_name, [o])
+                            undo.append((index_name, [u]))
+                    else:
+                        await self.write(index_name, idx_ops)
+                        undo.append((index_name, undo_ops))
+                except RpcError as e:
+                    # a concurrent DROP INDEX removed the index table:
+                    # skip the dead index (its undo entries are moot —
+                    # compensation writes would hit the same NOT_FOUND
+                    # and are swallowed) instead of failing the user's
+                    # base write forever off a stale cache
+                    if e.code == "NOT_FOUND" and await \
+                            self.index_dropped(table, index_name):
+                        continue
+                    raise
         except Exception:
             # partial failure (e.g. a later unique index rejected a
             # duplicate): undo the entries already written — an orphan
@@ -650,6 +662,30 @@ class YBClient:
                 self._tables.pop(table, None)
                 raise
         return len(rows)
+
+    async def drop_secondary_index(self, index_name: str,
+                                   table: str | None = None) -> None:
+        """Deregister + drop a secondary index in ONE master RPC —
+        the master owns the index registry and resolves the base
+        relation itself (reference: DROP INDEX through master
+        DeleteTable on the index relation, catalog_manager.cc)."""
+        resp = await self._master_call(
+            "drop_secondary_index",
+            {"table": table, "index_name": index_name}, timeout=30.0)
+        self._tables.pop(resp.get("table") or table, None)
+        self._tables.pop(index_name, None)
+
+    async def index_dropped(self, table: str, index_name: str) -> bool:
+        """After an index-table write failed NOT_FOUND: was the index
+        dropped concurrently by another client?  The refresh heals
+        this client's cached index list either way; True means the
+        caller should skip maintaining the dead index rather than
+        fail the user's base-table write."""
+        try:
+            ct = await self._table(table, refresh=True)
+        except Exception:   # noqa: BLE001 — can't tell; let the
+            return False    # original error surface
+        return index_name not in (ct.indexes or {})
 
     # --- DML: reads -------------------------------------------------------
     async def _retry_on_split(self, table: str, fn):

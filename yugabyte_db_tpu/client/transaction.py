@@ -137,8 +137,24 @@ class YBTransaction:
         try:
             for index_name, idx_ops, _undo in await build_index_ops(
                     ct, table, ops, self.get):
-                ict = await self.client._table(index_name)
-                await self._write_rows(index_name, idx_ops, ict)
+                ict = None
+                try:
+                    ict = await self.client._table(index_name)
+                    await self._write_rows(index_name, idx_ops, ict)
+                except RpcError as e:
+                    # concurrent DROP INDEX: heal the stale cache and
+                    # skip the dead index instead of failing the
+                    # statement forever (mirrors the non-txn path).
+                    # _write_rows registers participants BEFORE the
+                    # intent RPC — deregister the dead index tablets
+                    # or commit's apply fan-out would chase them
+                    if e.code == "NOT_FOUND" and await \
+                            self.client.index_dropped(table,
+                                                      index_name):
+                        for l in (ict.locations if ict else []):
+                            self._participants.pop(l.tablet_id, None)
+                        continue
+                    raise
             n = await self._write_rows(table, ops, ct)
         except Exception as e:   # noqa: BLE001 — any failure mode must
             # roll the statement back (transport timeouts included: a

@@ -666,6 +666,19 @@ class Master:
         return {"ok": True}
 
     # --- lookups ----------------------------------------------------------
+    async def rpc_get_tablet_locations(self, payload) -> dict:
+        """Tablet-id existence + current replica addresses (the txn
+        coordinator arbitrates dead-vs-moved participants with this;
+        reference: GetTabletLocations in master_client.proto)."""
+        self._check_leader()
+        ent = self.tablets.get(payload["tablet_id"])
+        if ent is None:
+            raise RpcError(f"tablet {payload['tablet_id']} not found",
+                           "NOT_FOUND")
+        return {"replicas": [list(self.tservers[u]["addr"])
+                             for u in ent["replicas"]
+                             if u in self.tservers]}
+
     async def rpc_get_table(self, payload) -> dict:
         self._check_leader()
         name = payload.get("name")
@@ -1548,12 +1561,25 @@ class Master:
         the client when a unique backfill fails — a registered index
         with no backfilled entries would both miss lookups and deny
         values via its insert-if-absent gate)."""
-        base_name = payload["table"]
+        base_name = payload.get("table")
         index_name = payload["index_name"]
-        tid = next((t for t, e in self.tables.items()
-                    if e["info"]["name"] == base_name), None)
-        if tid is None:
-            raise RpcError(f"table {base_name} not found", "NOT_FOUND")
+        if base_name is not None:
+            tid = next((t for t, e in self.tables.items()
+                        if e["info"]["name"] == base_name), None)
+            if tid is None:
+                raise RpcError(f"table {base_name} not found",
+                               "NOT_FOUND")
+        else:
+            # DROP INDEX names only the index: the registry owner (this
+            # master) resolves the base relation, like the reference's
+            # catalog manager resolving an index relation to its
+            # indexed table
+            tid = next((t for t, e in self.tables.items()
+                        if index_name in (e.get("indexes") or {})),
+                       None)
+            if tid is None:
+                raise RpcError(f"index {index_name} not found",
+                               "NOT_FOUND")
         tent = dict(self.tables[tid])
         idxs = dict(tent.get("indexes", {}))
         if index_name not in idxs:
@@ -1565,7 +1591,7 @@ class Master:
             await self.rpc_drop_table({"name": index_name})
         except RpcError:
             pass     # index table already gone: deregistration stands
-        return {"ok": True}
+        return {"ok": True, "table": tent["info"]["name"]}
 
     async def rpc_get_status_tablet(self, payload) -> dict:
         """Return (creating on demand) the transaction status tablet

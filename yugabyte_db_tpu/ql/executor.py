@@ -20,6 +20,7 @@ import numpy as np
 
 from ..client import YBClient
 from ..docdb.operations import ReadRequest, RowOp, eval_expr_py
+from ..rpc.messenger import RpcError
 from ..utils import flags
 from ..docdb.table_codec import TableInfo
 from ..dockv.packed_row import ColumnSchema, ColumnType, TableSchema
@@ -28,7 +29,8 @@ from ..ops.scan import AggSpec, GroupSpec, HashGroupSpec
 from .parser import (
     AlterTableStmt, AnalyzeStmt, CreateIndexStmt, CreateSequenceStmt,
     CreateTableStmt, CreateTablespaceStmt, CreateViewStmt, DeleteStmt,
-    DropSequenceStmt, DropTableStmt, DropTablespaceStmt, DropViewStmt,
+    DropIndexStmt, DropSequenceStmt, DropTableStmt, DropTablespaceStmt,
+    DropViewStmt,
     ExplainStmt, InsertStmt, SelectStmt, SetOpStmt, TruncateStmt,
     TxnStmt, UpdateStmt, parse_statement,
 )
@@ -172,6 +174,8 @@ class SqlSession:
             return SqlResult([], "DROP SEQUENCE")
         if isinstance(stmt, DropTableStmt):
             return await self._drop(stmt)
+        if isinstance(stmt, DropIndexStmt):
+            return await self._drop_index(stmt)
         if isinstance(stmt, InsertStmt):
             return await self._insert(stmt)
         if isinstance(stmt, AlterTableStmt):
@@ -642,6 +646,18 @@ class SqlSession:
                 return SqlResult([], "OK")
         await self.client.drop_table(stmt.name)
         return SqlResult([], "DROP TABLE")
+
+    async def _drop_index(self, stmt: DropIndexStmt) -> SqlResult:
+        """One master RPC: the master owns the index registry and
+        resolves the base relation itself (PG resolves DROP INDEX by
+        relation; client-side resolution would read stale caches)."""
+        try:
+            await self.client.drop_secondary_index(stmt.name)
+        except RpcError as e:
+            if stmt.if_exists and e.code == "NOT_FOUND":
+                return SqlResult([], "OK")
+            raise
+        return SqlResult([], "DROP INDEX")
 
     async def _insert(self, stmt: InsertStmt) -> SqlResult:
         self._invalidate_stats(stmt.table)

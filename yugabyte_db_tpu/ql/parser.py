@@ -134,6 +134,12 @@ class DropTableStmt:
 
 
 @dataclass
+class DropIndexStmt:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
 class CreateViewStmt:
     name: str
     select_sql: str          # the view body, persisted verbatim
@@ -789,6 +795,12 @@ class Parser:
         if t and t[0] == "id" and t[1].lower() == "tablespace":
             self.next()
             return DropTablespaceStmt(self.ident())
+        if self.accept_kw("index"):
+            ie = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                ie = True
+            return DropIndexStmt(self.ident(), ie)
         self.expect_kw("table")
         ie = False
         if self.accept_kw("if"):

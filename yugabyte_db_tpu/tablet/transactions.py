@@ -75,6 +75,7 @@ class TransactionCoordinator:
     def __init__(self, peer, messenger: Messenger):
         self.peer = peer                   # TabletPeer of the status tablet
         self.messenger = messenger
+        self.master_addrs: list = []       # wired by the hosting tserver
         self.txns: Dict[str, dict] = {}    # txn_id -> state
         self._apply_tasks: Set[asyncio.Task] = set()
         # deadlock detection (reference: probe-based DeadlockDetector,
@@ -268,6 +269,7 @@ class TransactionCoordinator:
                        "commit_ht": st.get("commit_ht")}
             done = False
             for attempt in range(10):
+                all_not_found = bool(addrs)
                 for addr in addrs:
                     try:
                         await self.messenger.call(
@@ -275,14 +277,58 @@ class TransactionCoordinator:
                             timeout=5.0)
                         done = True
                         break
-                    except (RpcError, asyncio.TimeoutError, OSError):
+                    except RpcError as e:
+                        if e.code != "NOT_FOUND":
+                            all_not_found = False
+                        continue
+                    except (asyncio.TimeoutError, OSError):
+                        all_not_found = False
                         continue
                 if done:
                     break
+                if all_not_found:
+                    # every recorded replica answers NOT_FOUND: either
+                    # the tablet was deleted (DROP TABLE/INDEX raced
+                    # the txn — its intents died with it, count it
+                    # notified or the sweep re-drives forever) or the
+                    # load balancer moved every replica — the master
+                    # arbitrates, and a move retries against the fresh
+                    # addresses
+                    gone, fresh = await self._resolve_tablet(tablet_id)
+                    if gone:
+                        done = True
+                        break
+                    if fresh:
+                        addrs = p["addrs"] = fresh
                 await asyncio.sleep(0.2 * (attempt + 1))
             all_ok = all_ok and done
         if all_ok:
             st["resolved"] = True
+
+    async def _resolve_tablet(self, tablet_id: str):
+        """(gone, fresh_addrs) for a participant whose recorded
+        replicas all answer NOT_FOUND.  The master owns the tablet
+        registry: NOT_FOUND there means deleted; a hit returns the
+        CURRENT replica addresses (post-move).  Unreachable master →
+        (False, None): keep retrying / let the sweep re-drive."""
+        if not self.master_addrs:
+            # no master wired (direct-construction scope): trust the
+            # unanimous replica answer
+            return True, None
+        for maddr in self.master_addrs:
+            try:
+                r = await self.messenger.call(
+                    tuple(maddr), "master", "get_tablet_locations",
+                    {"tablet_id": tablet_id}, timeout=5.0)
+                fresh = [list(a) for a in r.get("replicas") or []]
+                return False, fresh or None
+            except RpcError as e:
+                if e.code == "NOT_FOUND":
+                    return True, None
+                continue        # not the leader etc. — try the next
+            except (asyncio.TimeoutError, OSError):
+                continue
+        return False, None
 
     async def sweep(self):
         """Leader-side periodic pass (reference: coordinator poll task):

@@ -863,6 +863,11 @@ class TabletServer:
         if peer.coordinator is None:
             raise RpcError(f"{tablet_id} is not a status tablet",
                            "INVALID_ARGUMENT")
+        if self.master_addrs and not peer.coordinator.master_addrs:
+            # dead-participant arbitration needs the tablet registry
+            # owner (covers every peer-creation site: create,
+            # bootstrap, remote bootstrap)
+            peer.coordinator.master_addrs = list(self.master_addrs)
         if not peer.is_leader():
             raise RpcError("not leader", "LEADER_NOT_READY")
         # A just-elected leader that hasn't applied its predecessors'
