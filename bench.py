@@ -383,10 +383,15 @@ def main():
                                  sample=50_000)
         build_s = time.perf_counter() - t0
         vq = vbase[:64] + 0.001
-        idx.search(vq, k=10, nprobe=8)   # warm/compile
+        # probe a quarter of the lists: isotropic data is IVF's worst
+        # case (true neighbors scatter across lists), and the qps
+        # headroom over the CPU twin is better spent on recall than on
+        # a bigger number at recall nobody would run in production
+        np_ = max(8, nlists // 4)
+        idx.search(vq, k=10, nprobe=np_)   # warm/compile
         t0 = time.perf_counter()
         for _ in range(repeats_v):
-            idx.search(vq, k=10, nprobe=8)
+            idx.search(vq, k=10, nprobe=np_)
         search_s = (time.perf_counter() - t0) / repeats_v
         # honesty: IVF search is approximate — report recall@10 vs an
         # exact scan on a query subsample so qps can't silently trade
@@ -394,7 +399,7 @@ def main():
         # same routing as the QPS loop: search the FULL 64-query batch
         # (routing is batch-size dependent), compare a subsample
         nq_r = 16
-        _, ids = idx.search(vq, k=10, nprobe=8)
+        _, ids = idx.search(vq, k=10, nprobe=np_)
         ids = ids[:nq_r]
         import jax.numpy as _jnp
         _, ref_ids = exact_search(_jnp.asarray(vq[:nq_r]),
@@ -404,6 +409,7 @@ def main():
             len(set(ids[i]) & set(ref_ids[i])) / 10.0
             for i in range(nq_r)]))
         return {"n": vn, "dim": vd, "build_s": build_s,
+                "nprobe": np_,
                 "qps": 64 / search_s, "recall_at_10": recall}
 
     results["vector"] = vector_bench(200_000, 128, 64, 5, 5)
