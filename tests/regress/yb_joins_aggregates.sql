@@ -11,4 +11,9 @@ WITH rich AS (SELECT e, sal FROM emp WHERE sal >= 150) SELECT count(*) FROM rich
 SELECT e, sal, rank() OVER (ORDER BY sal DESC) AS r FROM emp ORDER BY r LIMIT 3;
 SELECT d, avg(sal) FROM emp GROUP BY d ORDER BY d;
 DROP TABLE emp;
-DROP TABLE dept
+DROP TABLE dept;
+CREATE TABLE agt (k bigint PRIMARY KEY, v bigint, f double) WITH tablets = 2;
+INSERT INTO agt (k, v, f) VALUES (1, 10, 1.5), (2, 20, 2.5);
+SELECT sum(v), min(v), max(v) FROM agt;
+SELECT sum(f), avg(v) FROM agt;
+DROP TABLE agt;

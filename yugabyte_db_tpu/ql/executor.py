@@ -2672,12 +2672,15 @@ class SqlSession:
             else:
                 import decimal
                 v = _scalar(values[vi])
+                # _scalar owns the numeric typing (integer columns stay
+                # integral, float inputs stay float); count just forces
+                # int for the odd object-dtype escape
                 out[name] = (v if v is None
                              or isinstance(v, (decimal.Decimal, list,
                                                str))
                              else
                              int(v) if op in ("count", "count_distinct")
-                             else float(v))
+                             else v)
                 vi += 1
         return out
 
@@ -2723,7 +2726,7 @@ class SqlSession:
             else:
                 v = _scalar(values[vi])
                 out[f"__h{i}"] = (v if v is None else
-                                  int(v) if op == "count" else float(v))
+                                  int(v) if op == "count" else v)
                 vi += 1
         return out
 
@@ -3529,6 +3532,10 @@ def _scalar(v):
     a = np.asarray(v)
     if a.dtype == object and a.shape == ():
         return a.item()
+    if np.issubdtype(a.dtype, np.integer):
+        # sum/min/max over integer columns stay integral (PG:
+        # sum(bigint) -> numeric printed without a fraction)
+        return int(a)
     return float(a)
 
 
