@@ -1,0 +1,14 @@
+-- JSON operators (reference: jsonb -> / ->> through YSQL pushdown)
+CREATE TABLE j (k bigint PRIMARY KEY, doc json) WITH tablets = 1;
+INSERT INTO j (k, doc) VALUES (1, '{"a": 1, "b": {"c": [10, 20]}, "tag": "x"}');
+INSERT INTO j (k, doc) VALUES (2, '{"a": 2, "b": null, "tag": "y"}');
+INSERT INTO j (k, doc) VALUES (3, '{"a": 3, "tag": "x"}');
+SELECT k, doc->'a' AS a FROM j ORDER BY k;
+SELECT doc->'b'->'c'->0 AS c0 FROM j WHERE k = 1;
+SELECT k, doc->>'tag' AS tag FROM j ORDER BY k;
+SELECT k FROM j WHERE doc->>'tag' = 'x' ORDER BY k;
+SELECT count(*) FROM j WHERE doc->'b' IS NOT NULL;
+SELECT doc->>'tag' AS tag, count(*) FROM j GROUP BY doc->>'tag' ORDER BY tag;
+UPDATE j SET doc = '{"a": 9, "tag": "z"}' WHERE k = 3;
+SELECT doc->>'tag' FROM j WHERE k = 3;
+DROP TABLE j;
