@@ -1,4 +1,7 @@
 -- JSON operators (reference: jsonb -> / ->> through YSQL pushdown)
+-- DOCUMENTED DEVIATION: our evaluator folds a JSON null into SQL NULL
+-- (PG keeps 'null'::jsonb distinct, so doc->'b' IS NOT NULL counts
+-- the {"b": null} row in PG but not here)
 CREATE TABLE j (k bigint PRIMARY KEY, doc json) WITH tablets = 1;
 INSERT INTO j (k, doc) VALUES (1, '{"a": 1, "b": {"c": [10, 20]}, "tag": "x"}');
 INSERT INTO j (k, doc) VALUES (2, '{"a": 2, "b": null, "tag": "y"}');

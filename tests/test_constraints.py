@@ -622,3 +622,198 @@ class TestDropIndex:
             finally:
                 await mc.shutdown()
         asyncio.run(go())
+
+
+class TestFkActions:
+    """ON DELETE CASCADE / SET NULL referential actions (reference:
+    PG referential action triggers; ours run statement-inline through
+    the executor's FK machinery)."""
+
+    async def _setup(self, tmp_path):
+        mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+        s = SqlSession(mc.client())
+        await s.execute("CREATE TABLE p (id bigint PRIMARY KEY, nm "
+                        "text) WITH tablets = 1")
+        await s.execute(
+            "CREATE TABLE c1 (id bigint PRIMARY KEY, pid bigint "
+            "REFERENCES p (id) ON DELETE CASCADE) WITH tablets = 1")
+        await s.execute(
+            "CREATE TABLE g (id bigint PRIMARY KEY, cid bigint "
+            "REFERENCES c1 (id) ON DELETE CASCADE) WITH tablets = 1")
+        await s.execute(
+            "CREATE TABLE c2 (id bigint PRIMARY KEY, pid bigint "
+            "REFERENCES p (id) ON DELETE SET NULL) WITH tablets = 1")
+        await s.execute("INSERT INTO p (id, nm) VALUES (1,'a'),(2,'b')")
+        await s.execute(
+            "INSERT INTO c1 (id, pid) VALUES (10,1),(11,1),(12,2)")
+        await s.execute("INSERT INTO g (id, cid) VALUES (100,10)")
+        await s.execute("INSERT INTO c2 (id, pid) VALUES (20,1),(21,2)")
+        return mc, s
+
+    def test_cascade_chain_and_set_null(self, tmp_path):
+        async def go():
+            mc, s = await self._setup(tmp_path)
+            try:
+                await s.execute("DELETE FROM p WHERE id = 1")
+                r = await s.execute("SELECT id FROM c1 ORDER BY id")
+                assert [x["id"] for x in r.rows] == [12]
+                r = await s.execute("SELECT id FROM g")
+                assert r.rows == []          # grandchild cascaded
+                r = await s.execute("SELECT id, pid FROM c2 "
+                                    "ORDER BY id")
+                assert [(x["id"], x["pid"]) for x in r.rows] == \
+                    [(20, None), (21, 2)]
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())
+
+    def test_restrict_grandchild_vetoes_cascade(self, tmp_path):
+        async def go():
+            mc, s = await self._setup(tmp_path)
+            try:
+                await s.execute(
+                    "CREATE TABLE gr (id bigint PRIMARY KEY, cid "
+                    "bigint REFERENCES c1 (id)) WITH tablets = 1")
+                await s.execute("INSERT INTO gr (id, cid) "
+                                "VALUES (200, 11)")
+                with pytest.raises(Exception, match="still referenced"):
+                    await s.execute("DELETE FROM p WHERE id = 1")
+                # nothing was half-deleted outside a txn? the veto runs
+                # BEFORE any delete of that child's rows, and the
+                # parent row survives
+                r = await s.execute("SELECT count(*) FROM p")
+                assert r.rows[0]["count"] == 2
+                r = await s.execute("SELECT count(*) FROM c1")
+                assert r.rows[0]["count"] == 3
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())
+
+    def test_cascade_inside_txn_rolls_back(self, tmp_path):
+        async def go():
+            mc, s = await self._setup(tmp_path)
+            try:
+                await s.execute("BEGIN")
+                await s.execute("DELETE FROM p WHERE id = 1")
+                r = await s.execute("SELECT count(*) FROM c1")
+                assert r.rows[0]["count"] == 1
+                await s.execute("ROLLBACK")
+                r = await s.execute("SELECT count(*) FROM c1")
+                assert r.rows[0]["count"] == 3
+                r = await s.execute("SELECT count(*) FROM g")
+                assert r.rows[0]["count"] == 1
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())
+
+    def test_self_referential_cascade_cycle(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path),
+                                   num_tservers=1).start()
+            s = SqlSession(mc.client())
+            try:
+                await s.execute(
+                    "CREATE TABLE emp (id bigint PRIMARY KEY, mgr "
+                    "bigint REFERENCES emp (id) ON DELETE CASCADE) "
+                    "WITH tablets = 1")
+                await s.execute("INSERT INTO emp (id, mgr) VALUES "
+                                "(1, NULL)")
+                await s.execute("INSERT INTO emp (id, mgr) VALUES "
+                                "(2, 1), (3, 2)")
+                # mutual cycle: 4 <-> 5
+                await s.execute("INSERT INTO emp (id, mgr) VALUES "
+                                "(4, 1)")
+                await s.execute("INSERT INTO emp (id, mgr) VALUES "
+                                "(5, 4)")
+                await s.execute("UPDATE emp SET mgr = 5 WHERE id = 4")
+                await s.execute("DELETE FROM emp WHERE id = 1")
+                # the 1->2->3 chain cascades; the detached 4<->5 cycle
+                # references no deleted row and survives (PG semantics)
+                r = await s.execute("SELECT id FROM emp ORDER BY id")
+                assert [x["id"] for x in r.rows] == [4, 5]
+                # deleting INTO the cycle takes both without looping
+                await s.execute("DELETE FROM emp WHERE id = 4")
+                r = await s.execute("SELECT count(*) FROM emp")
+                assert r.rows[0]["count"] == 0
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())
+
+    def test_sibling_restrict_vetoes_before_any_cascade_write(
+            self, tmp_path):
+        """A RESTRICT child of the SAME parent must veto before the
+        cascade/set-null SIBLINGS write anything — even outside a
+        transaction (the plan/check/execute split)."""
+        async def go():
+            mc, s = await self._setup(tmp_path)
+            try:
+                await s.execute(
+                    "CREATE TABLE hold (id bigint PRIMARY KEY, pid "
+                    "bigint REFERENCES p (id) ON DELETE RESTRICT) "
+                    "WITH tablets = 1")
+                await s.execute("INSERT INTO hold (id, pid) "
+                                "VALUES (300, 1)")
+                with pytest.raises(Exception, match="still referenced"):
+                    await s.execute("DELETE FROM p WHERE id = 1")
+                # cascade siblings untouched, set-null sibling intact
+                r = await s.execute("SELECT count(*) FROM c1")
+                assert r.rows[0]["count"] == 3
+                r = await s.execute("SELECT count(*) FROM g")
+                assert r.rows[0]["count"] == 1
+                r = await s.execute("SELECT pid FROM c2 WHERE id = 20")
+                assert r.rows[0]["pid"] == 1
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())
+
+    def test_set_null_on_not_null_column_vetoes(self, tmp_path):
+        """ON DELETE SET NULL against a NOT NULL FK column must error
+        (PG 23502) before any write, not store a NULL."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path),
+                                   num_tservers=1).start()
+            s = SqlSession(mc.client())
+            try:
+                await s.execute("CREATE TABLE p2 (id bigint PRIMARY "
+                                "KEY) WITH tablets = 1")
+                await s.execute(
+                    "CREATE TABLE c3 (id bigint PRIMARY KEY, pid "
+                    "bigint NOT NULL REFERENCES p2 (id) ON DELETE "
+                    "SET NULL) WITH tablets = 1")
+                await s.execute("INSERT INTO p2 (id) VALUES (1)")
+                await s.execute("INSERT INTO c3 (id, pid) "
+                                "VALUES (10, 1)")
+                with pytest.raises(ValueError, match="not-null"):
+                    await s.execute("DELETE FROM p2 WHERE id = 1")
+                r = await s.execute("SELECT pid FROM c3 WHERE id = 10")
+                assert r.rows[0]["pid"] == 1
+                r = await s.execute("SELECT count(*) FROM p2")
+                assert r.rows[0]["count"] == 1
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())
+
+    def test_deep_cascade_chain(self, tmp_path):
+        """Cascade depth is a worklist, not recursion: a 600-link
+        self-referential chain deletes in one statement."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path),
+                                   num_tservers=1).start()
+            s = SqlSession(mc.client())
+            try:
+                await s.execute(
+                    "CREATE TABLE ln (id bigint PRIMARY KEY, prev "
+                    "bigint REFERENCES ln (id) ON DELETE CASCADE) "
+                    "WITH tablets = 1")
+                c = mc.client()
+                await c.write("ln", [RowOp("upsert",
+                                           {"id": 0, "prev": None})])
+                await c.write("ln", [RowOp("upsert",
+                                           {"id": i, "prev": i - 1})
+                                     for i in range(1, 600)])
+                await s.execute("DELETE FROM ln WHERE id = 0")
+                r = await s.execute("SELECT count(*) FROM ln")
+                assert r.rows[0]["count"] == 0
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())

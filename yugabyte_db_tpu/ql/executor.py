@@ -585,8 +585,9 @@ class SqlSession:
             "", stmt.name, schema,
             PartitionSchema("range", 0) if range_sharded
             else PartitionSchema("hash", 1))
-        fks = [{"column": c, "parent_table": pt, "parent_column": pc}
-               for c, pt, pc in getattr(stmt, "foreign_keys", [])]
+        fks = [{"column": c, "parent_table": pt, "parent_column": pc,
+                "on_delete": act}
+               for c, pt, pc, act in getattr(stmt, "foreign_keys", [])]
         for fk in fks:
             # the parent column must be its table's PK (our FK-lite
             # scope: existence checks by point get) — validate at DDL
@@ -968,11 +969,13 @@ class SqlSession:
                     continue        # dropped concurrently
                 for fk in getattr(cct, "foreign_keys", None) or []:
                     m.setdefault(fk["parent_table"], []).append(
-                        (name, fk["column"]))
+                        (name, fk["column"],
+                         fk.get("on_delete") or "restrict"))
             self._fk_child_map = m
         return self._fk_child_map.get(parent, [])
 
-    async def _check_fk_restrict(self, ct, pk_cols, pk_rows) -> None:
+    async def _check_fk_restrict(self, ct, pk_cols, pk_rows,
+                                 planned=None) -> None:
         """Parent-side RESTRICT: deleting a row still referenced by a
         child FK fails (reference: PG's NO ACTION/RESTRICT through the
         executor; checked via child scans — an index on the FK column
@@ -988,7 +991,9 @@ class SqlSession:
         stmt_pks = {tuple(r[k] for k in pk_cols) for r in pk_rows}
         values = [r[pk] for r in pk_rows]
         value_set = set(values)
-        for child, col in children:
+        for child, col, action in children:
+            if action != "restrict":
+                continue    # cascade / set-null handled before this
             cct = await self.client._table(child)
             child_pk = [c.name for c in cct.info.schema.key_columns]
             pend = (self._txn.pending_writes(child)
@@ -1016,6 +1021,9 @@ class SqlSession:
             for ref in refs:
                 rpk = tuple(ref.get(k) for k in child_pk)
                 committed_pks.add(rpk)
+                if planned is not None and \
+                        rpk in planned.get(child, ()):
+                    continue   # the cascade plan deletes this child
                 op = pend.get(rpk)
                 if op is not None:
                     if op.kind == "delete":
@@ -1040,7 +1048,9 @@ class SqlSession:
                     if op.kind != "delete" and p not in committed_pks \
                             and op.row.get(col) in value_set \
                             and not (child == ct.info.name
-                                     and p in stmt_pks):
+                                     and p in stmt_pks) \
+                            and not (planned is not None and
+                                     p in planned.get(child, ())):
                         offender = op.row.get(col)
                         break
             if offender is not None:
@@ -1052,6 +1062,149 @@ class SqlSession:
 
     def _invalidate_fk_children(self) -> None:
         self._fk_child_map = None
+
+    async def _fk_referencing(self, child: str, col: str, value_set
+                              ) -> Tuple[list, list]:
+        """(child_pk_cols, child rows referencing any of value_set) in
+        the TRANSACTION's view: committed rows overlaid with the txn's
+        pending writes (re-pointed FKs honored, txn-deleted rows
+        excluded, txn-added rows included)."""
+        cct = await self.client._table(child)
+        child_pk = [c.name for c in cct.info.schema.key_columns]
+        pend = (self._txn.pending_writes(child)
+                if self._txn is not None else {})
+        idx_name = next(
+            (n for n, spec in (cct.indexes or {}).items()
+             if spec["column"] == col), None)
+        if idx_name is not None:
+            # indexed point lookups per value beat one IN-scan
+            committed = []
+            for v in value_set:
+                for p in await self.client.index_lookup(
+                        child, idx_name, v):
+                    committed.append({**p, col: v})
+        else:
+            cid = cct.info.schema.column_by_name(col).id
+            resp = await self.client.scan(child, ReadRequest(
+                "", columns=tuple({col, *child_pk}),
+                where=("in", ("col", cid), list(value_set))))
+            committed = resp.rows
+        out = []
+        committed_pks = set()
+        for ref in committed:
+            rpk = tuple(ref.get(k) for k in child_pk)
+            committed_pks.add(rpk)
+            op = pend.get(rpk)
+            if op is not None:
+                if op.kind == "delete":
+                    continue
+                ref = {**ref, **op.row}
+            if ref.get(col) in value_set:
+                out.append(ref)
+        for p, op in pend.items():
+            if op.kind != "delete" and p not in committed_pks \
+                    and op.row.get(col) in value_set:
+                out.append(dict(op.row))
+        return child_pk, out
+
+    async def _delete_with_fk_actions(self, ct, pk_cols, pk_rows
+                                      ) -> int:
+        """Parent delete with ON DELETE CASCADE / SET NULL referential
+        actions (reference: PG's referential action triggers — ours
+        run statement-inline).  Three phases so a RESTRICT veto (or a
+        NOT NULL veto on a SET NULL target) ANYWHERE in the action
+        tree fires before ANY write lands:
+          1. plan — breadth-first over the cascade graph collecting
+             child deletes / set-nulls; `planned` (table -> pk set)
+             breaks self-referential cycles, and the iteration is a
+             worklist, not recursion, so chain depth is unbounded,
+          2. check — every visited table's RESTRICT children veto,
+             ignoring rows the plan itself deletes,
+          3. execute — deepest level first (children before parents),
+             the parent delete last, all under ONE statement
+             subtransaction inside a txn so a mid-plan failure can't
+             commit a half-applied cascade.
+        Returns the parent rows_affected."""
+        planned: Dict[str, set] = {}
+        plan: list = []       # (table, "delete"|"set null", rows)
+        visited: list = []    # (cct, pk_cols, rows) for restrict pass
+        frontier = [(ct, pk_cols, pk_rows)]
+        while frontier:
+            nxt = []
+            for ct_, pk_cols_, rows_ in frontier:
+                planned.setdefault(ct_.info.name, set()).update(
+                    tuple(r[k] for k in pk_cols_) for r in rows_)
+                visited.append((ct_, pk_cols_, rows_))
+                if len(pk_cols_) != 1:
+                    continue   # composite-PK FK scope: restrict only
+                children = await self._fk_children(ct_.info.name)
+                values = {r[pk_cols_[0]] for r in rows_}
+                for child, col, action in children:
+                    if action == "restrict":
+                        continue
+                    child_pk, refs = await self._fk_referencing(
+                        child, col, values)
+                    refs = [r for r in refs
+                            if tuple(r.get(k) for k in child_pk)
+                            not in planned.get(child, ())]
+                    if not refs:
+                        continue
+                    cct = await self.client._table(child)
+                    if action == "set null":
+                        cs = cct.info.schema.column_by_name(col)
+                        if not cs.nullable or col in child_pk:
+                            raise ValueError(
+                                f'null value in column "{col}" of '
+                                f'relation "{child}" violates '
+                                f'not-null constraint (ON DELETE '
+                                f'SET NULL)')
+                        plan.append((child, "set null", [
+                            {**{k: r.get(k) for k in child_pk},
+                             col: None} for r in refs]))
+                        continue
+                    nxt.append((cct, child_pk, refs))
+                    plan.append((child, "delete", [
+                        {k: r.get(k) for k in child_pk}
+                        for r in refs]))
+            frontier = nxt
+        for ct_, pk_cols_, rows_ in visited:
+            await self._check_fk_restrict(ct_, pk_cols_, rows_,
+                                          planned)
+        parent_rows = [{k: r[k] for k in pk_cols} for r in pk_rows]
+        writes = [(child, action, rows) for child, action, rows
+                  in reversed(plan)]       # deepest level first
+        writes.append((ct.info.name, "delete", parent_rows))
+
+        async def execute():
+            n = 0
+            for child, action, rows in writes:
+                self._invalidate_stats(child)
+                ops = [RowOp("upsert" if action == "set null"
+                             else "delete", r) for r in rows]
+                if self._txn is not None:
+                    m = await self._txn.write(child, ops)
+                else:
+                    m = await self.client.write(child, ops)
+                n = m
+            return n      # last write is the parent delete
+
+        if self._txn is None or len(writes) == 1:
+            return await execute()
+        # one statement subtransaction around the WHOLE cascade + the
+        # parent delete (each _txn.write only brackets its own ops)
+        sp = f"__fk_{self._txn._next_sub}"
+        self._txn.savepoint(sp)
+        try:
+            n = await execute()
+        except Exception:
+            try:
+                await self._txn.rollback_to(sp)
+                self._txn.release_savepoint(sp)
+            except Exception:   # noqa: BLE001 — rollback_to aborts
+                pass            # the txn itself on failure
+            raise
+        self._txn.release_savepoint(sp)
+        return n
 
     def _check_check_constraints(self, ct, rows) -> None:
         """CHECK constraints: a row passes unless the expression is
@@ -3067,12 +3220,10 @@ class SqlSession:
         if not pairs:
             return SqlResult([], "DELETE 0")
         pre_images = [tr for tr, _ in pairs]
-        await self._check_fk_restrict(ct, pk_cols, pre_images)
-        pk_rows = [{k: tr[k] for k in pk_cols} for tr in pre_images]
-        if self._txn is not None:
-            n = await self._txn.delete(stmt.table, pk_rows)
-        else:
-            n = await self.client.delete(stmt.table, pk_rows)
+        # plans + restrict-checks the whole referential-action tree
+        # (root included) before any write lands, then executes the
+        # cascade and the parent delete as one statement
+        n = await self._delete_with_fk_actions(ct, pk_cols, pre_images)
         if getattr(stmt, "returning", None):
             return SqlResult(
                 self._returning_rows(stmt.returning, pre_images,
@@ -3117,11 +3268,10 @@ class SqlSession:
         rows = [{k: r.get(k) for k in pk_cols} for r in rows]
         if not rows:
             return SqlResult([], "DELETE 0")
-        await self._check_fk_restrict(ct, pk_cols, rows)
-        if self._txn is not None:
-            n = await self._txn.delete(stmt.table, rows)
-        else:
-            n = await self.client.delete(stmt.table, rows)
+        # plans + restrict-checks the whole referential-action tree
+        # (root included) before any write lands, then executes the
+        # cascade and the parent delete as one statement
+        n = await self._delete_with_fk_actions(ct, pk_cols, rows)
         if returning:
             return SqlResult(
                 self._returning_rows(returning, pre_images, schema),

@@ -93,9 +93,10 @@ class CreateTableStmt:
     not_null: List[str] = field(default_factory=list)
     tablespace: Optional[str] = None   # WITH tablespace = 'name'
     unique_cols: List[str] = field(default_factory=list)
-    # [(column, parent_table, parent_column)] from REFERENCES /
-    # FOREIGN KEY clauses
-    foreign_keys: List[Tuple[str, str, str]] = field(
+    # [(column, parent_table, parent_column, on_delete_action)] from
+    # REFERENCES / FOREIGN KEY clauses; action is "restrict",
+    # "cascade", or "set null"
+    foreign_keys: List[Tuple[str, str, str, str]] = field(
         default_factory=list)
     # CHECK constraint expression ASTs (name-based; evaluated per row
     # on INSERT/UPDATE — reference: CHECK through the PG executor)
@@ -334,6 +335,15 @@ class Parser:
             return True
         return False
 
+    def _accept_word(self, word: str) -> bool:
+        """Accept a NON-RESERVED word (lexes as an identifier) in a
+        clause position, e.g. CASCADE in ON DELETE CASCADE."""
+        t = self.peek()
+        if t and t[0] == "id" and t[1].lower() == word:
+            self.pos += 1
+            return True
+        return False
+
     def expect_kw(self, word):
         if not self.accept_kw(word):
             raise ValueError(f"expected {word.upper()} at {self.peek()}")
@@ -563,7 +573,7 @@ class Parser:
         defaults: Dict[str, object] = {}
         not_null: List[str] = []
         unique_cols: List[str] = []
-        foreign_keys: List[Tuple[str, str, str]] = []
+        foreign_keys: List[Tuple[str, str, str, str]] = []
         checks: List[tuple] = []
 
         def fk_clause(col):
@@ -571,7 +581,30 @@ class Parser:
             self.expect_op("(")
             pcol = self.ident()
             self.expect_op(")")
-            foreign_keys.append((col, parent, pcol))
+            action = "restrict"
+            if self.accept_kw("on"):
+                self.expect_kw("delete")
+                # CASCADE/RESTRICT/NO ACTION aren't reserved words —
+                # match them as identifiers so they stay usable as
+                # column names elsewhere
+                if self._accept_word("cascade"):
+                    action = "cascade"
+                elif self._accept_word("restrict"):
+                    action = "restrict"
+                elif self.accept_kw("set"):
+                    self.expect_kw("null")
+                    action = "set null"
+                elif self._accept_word("no"):
+                    if not self._accept_word("action"):
+                        raise ValueError(
+                            f"expected ACTION at {self.peek()}")
+                    action = "restrict"   # end-of-statement check,
+                    #                       like our RESTRICT
+                else:
+                    raise ValueError(
+                        "expected CASCADE, RESTRICT, SET NULL or "
+                        f"NO ACTION at {self.peek()}")
+            foreign_keys.append((col, parent, pcol, action))
 
         while True:
             if self.accept_kw("primary"):
