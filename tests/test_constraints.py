@@ -817,3 +817,82 @@ class TestFkActions:
             finally:
                 await mc.shutdown()
         asyncio.run(go())
+
+    def test_set_null_preserves_other_columns(self, tmp_path):
+        """SET NULL rewrites only the FK column — sibling payload
+        columns must survive (upserts are full-row packed writes, so
+        the plan must carry the whole row)."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path),
+                                   num_tservers=1).start()
+            s = SqlSession(mc.client())
+            try:
+                await s.execute("CREATE TABLE p3 (id bigint PRIMARY "
+                                "KEY) WITH tablets = 1")
+                await s.execute(
+                    "CREATE TABLE c4 (id bigint PRIMARY KEY, pid "
+                    "bigint REFERENCES p3 (id) ON DELETE SET NULL, "
+                    "payload text) WITH tablets = 1")
+                await s.execute("INSERT INTO p3 (id) VALUES (1)")
+                await s.execute("INSERT INTO c4 (id, pid, payload) "
+                                "VALUES (10, 1, 'important')")
+                await s.execute("DELETE FROM p3 WHERE id = 1")
+                r = await s.execute("SELECT pid, payload FROM c4 "
+                                    "WHERE id = 10")
+                assert r.rows[0]["pid"] is None
+                assert r.rows[0]["payload"] == "important"
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())
+
+    def test_cascade_wins_over_set_null_on_same_row(self, tmp_path):
+        """A child row with BOTH actions toward one parent deletes
+        (PG: the cascade trigger removes it; the set-null update then
+        matches nothing) — regardless of FK declaration order."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path),
+                                   num_tservers=1).start()
+            s = SqlSession(mc.client())
+            try:
+                await s.execute("CREATE TABLE p4 (id bigint PRIMARY "
+                                "KEY) WITH tablets = 1")
+                await s.execute(
+                    "CREATE TABLE c5 (id bigint PRIMARY KEY, "
+                    "a bigint REFERENCES p4 (id) ON DELETE SET NULL, "
+                    "b bigint REFERENCES p4 (id) ON DELETE CASCADE) "
+                    "WITH tablets = 1")
+                await s.execute("INSERT INTO p4 (id) VALUES (1)")
+                await s.execute("INSERT INTO c5 (id, a, b) "
+                                "VALUES (10, 1, 1)")
+                await s.execute("DELETE FROM p4 WHERE id = 1")
+                r = await s.execute("SELECT count(*) FROM c5")
+                assert r.rows[0]["count"] == 0
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())
+
+    def test_on_update_no_action_parses(self, tmp_path):
+        async def go():
+            mc = await MiniCluster(str(tmp_path),
+                                   num_tservers=1).start()
+            s = SqlSession(mc.client())
+            try:
+                await s.execute("CREATE TABLE p5 (id bigint PRIMARY "
+                                "KEY) WITH tablets = 1")
+                await s.execute(
+                    "CREATE TABLE c6 (id bigint PRIMARY KEY, pid "
+                    "bigint REFERENCES p5 (id) ON DELETE CASCADE "
+                    "ON UPDATE NO ACTION) WITH tablets = 1")
+                with pytest.raises(ValueError, match="ON UPDATE"):
+                    await s.execute(
+                        "CREATE TABLE c7 (id bigint PRIMARY KEY, pid "
+                        "bigint REFERENCES p5 (id) ON UPDATE CASCADE) "
+                        "WITH tablets = 1")
+                # NO ACTION keeps its name in the catalog
+                r = await s.execute(
+                    "SELECT delete_rule FROM information_schema."
+                    "referential_constraints")
+                assert r.rows[0]["delete_rule"] == "CASCADE"
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())

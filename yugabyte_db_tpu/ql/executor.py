@@ -992,8 +992,8 @@ class SqlSession:
         values = [r[pk] for r in pk_rows]
         value_set = set(values)
         for child, col, action in children:
-            if action != "restrict":
-                continue    # cascade / set-null handled before this
+            if action in ("cascade", "set null"):
+                continue    # handled by the action plan before this
             cct = await self.client._table(child)
             child_pk = [c.name for c in cct.info.schema.key_columns]
             pend = (self._txn.pending_writes(child)
@@ -1063,12 +1063,14 @@ class SqlSession:
     def _invalidate_fk_children(self) -> None:
         self._fk_child_map = None
 
-    async def _fk_referencing(self, child: str, col: str, value_set
-                              ) -> Tuple[list, list]:
+    async def _fk_referencing(self, child: str, col: str, value_set,
+                              full: bool = False) -> Tuple[list, list]:
         """(child_pk_cols, child rows referencing any of value_set) in
         the TRANSACTION's view: committed rows overlaid with the txn's
         pending writes (re-pointed FKs honored, txn-deleted rows
-        excluded, txn-added rows included)."""
+        excluded, txn-added rows included).  `full=True` returns whole
+        rows (SET NULL rewrites the row, so every column must ride
+        along — upserts are full-row packed writes)."""
         cct = await self.client._table(child)
         child_pk = [c.name for c in cct.info.schema.key_columns]
         pend = (self._txn.pending_writes(child)
@@ -1076,7 +1078,7 @@ class SqlSession:
         idx_name = next(
             (n for n, spec in (cct.indexes or {}).items()
              if spec["column"] == col), None)
-        if idx_name is not None:
+        if idx_name is not None and not full:
             # indexed point lookups per value beat one IN-scan
             committed = []
             for v in value_set:
@@ -1086,7 +1088,8 @@ class SqlSession:
         else:
             cid = cct.info.schema.column_by_name(col).id
             resp = await self.client.scan(child, ReadRequest(
-                "", columns=tuple({col, *child_pk}),
+                "", columns=() if full
+                else tuple({col, *child_pk}),
                 where=("in", ("col", cid), list(value_set))))
             committed = resp.rows
         out = []
@@ -1126,24 +1129,24 @@ class SqlSession:
              commit a half-applied cascade.
         Returns the parent rows_affected."""
         planned: Dict[str, set] = {}
-        plan: list = []       # (table, "delete"|"set null", rows)
+        plan: list = []    # (table, "delete"|"set null", rows, pk_cols)
         visited: list = []    # (cct, pk_cols, rows) for restrict pass
+        planned.setdefault(ct.info.name, set()).update(
+            tuple(r[k] for k in pk_cols) for r in pk_rows)
         frontier = [(ct, pk_cols, pk_rows)]
         while frontier:
             nxt = []
             for ct_, pk_cols_, rows_ in frontier:
-                planned.setdefault(ct_.info.name, set()).update(
-                    tuple(r[k] for k in pk_cols_) for r in rows_)
                 visited.append((ct_, pk_cols_, rows_))
                 if len(pk_cols_) != 1:
                     continue   # composite-PK FK scope: restrict only
                 children = await self._fk_children(ct_.info.name)
                 values = {r[pk_cols_[0]] for r in rows_}
                 for child, col, action in children:
-                    if action == "restrict":
-                        continue
+                    if action not in ("cascade", "set null"):
+                        continue   # restrict / no action veto below
                     child_pk, refs = await self._fk_referencing(
-                        child, col, values)
+                        child, col, values, full=(action == "set null"))
                     refs = [r for r in refs
                             if tuple(r.get(k) for k in child_pk)
                             not in planned.get(child, ())]
@@ -1158,26 +1161,42 @@ class SqlSession:
                                 f'relation "{child}" violates '
                                 f'not-null constraint (ON DELETE '
                                 f'SET NULL)')
+                        # full-row rewrite: upserts pack every value
+                        # column, so the whole row must ride along
                         plan.append((child, "set null", [
-                            {**{k: r.get(k) for k in child_pk},
-                             col: None} for r in refs]))
+                            {**r, col: None} for r in refs], child_pk))
                         continue
+                    # mark planned at DISCOVERY time: a same-level
+                    # sibling path to the same row must not plan it
+                    # twice (diamond fan-in)
+                    planned.setdefault(child, set()).update(
+                        tuple(r.get(k) for k in child_pk)
+                        for r in refs)
                     nxt.append((cct, child_pk, refs))
                     plan.append((child, "delete", [
                         {k: r.get(k) for k in child_pk}
-                        for r in refs]))
+                        for r in refs], child_pk))
             frontier = nxt
         for ct_, pk_cols_, rows_ in visited:
             await self._check_fk_restrict(ct_, pk_cols_, rows_,
                                           planned)
         parent_rows = [{k: r[k] for k in pk_cols} for r in pk_rows]
-        writes = [(child, action, rows) for child, action, rows
-                  in reversed(plan)]       # deepest level first
-        writes.append((ct.info.name, "delete", parent_rows))
+        writes = list(reversed(plan))      # deepest level first
+        writes.append((ct.info.name, "delete", parent_rows, pk_cols))
 
         async def execute():
             n = 0
-            for child, action, rows in writes:
+            for child, action, rows, cpk in writes:
+                if action == "set null":
+                    # cascade wins over set-null on the SAME row (a
+                    # child with both actions toward one parent): a
+                    # planned-deleted row must not resurrect as a
+                    # ghost upsert
+                    rows = [r for r in rows
+                            if tuple(r.get(k) for k in cpk)
+                            not in planned.get(child, ())]
+                    if not rows:
+                        continue
                 self._invalidate_stats(child)
                 ops = [RowOp("upsert" if action == "set null"
                              else "delete", r) for r in rows]

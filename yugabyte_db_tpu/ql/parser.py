@@ -581,29 +581,45 @@ class Parser:
             self.expect_op("(")
             pcol = self.ident()
             self.expect_op(")")
-            action = "restrict"
-            if self.accept_kw("on"):
-                self.expect_kw("delete")
+            action = "no action"
+
+            def ref_action():
                 # CASCADE/RESTRICT/NO ACTION aren't reserved words —
                 # match them as identifiers so they stay usable as
                 # column names elsewhere
                 if self._accept_word("cascade"):
-                    action = "cascade"
-                elif self._accept_word("restrict"):
-                    action = "restrict"
-                elif self.accept_kw("set"):
+                    return "cascade"
+                if self._accept_word("restrict"):
+                    return "restrict"
+                if self.accept_kw("set"):
                     self.expect_kw("null")
-                    action = "set null"
-                elif self._accept_word("no"):
+                    return "set null"
+                if self._accept_word("no"):
                     if not self._accept_word("action"):
                         raise ValueError(
                             f"expected ACTION at {self.peek()}")
-                    action = "restrict"   # end-of-statement check,
-                    #                       like our RESTRICT
+                    return "no action"
+                raise ValueError(
+                    "expected CASCADE, RESTRICT, SET NULL or "
+                    f"NO ACTION at {self.peek()}")
+
+            while self.accept_kw("on"):
+                if self.accept_kw("delete"):
+                    action = ref_action()
+                elif self.accept_kw("update"):
+                    # ON UPDATE: only the PG-default no-op forms parse
+                    # (our PKs are immutable through UPDATE re-keying's
+                    # insert+delete, so CASCADE/SET NULL can't be
+                    # honored — reject them loudly)
+                    ua = ref_action()
+                    if ua not in ("no action", "restrict"):
+                        raise ValueError(
+                            f"ON UPDATE {ua.upper()} is not "
+                            "supported (ON UPDATE NO ACTION / "
+                            "RESTRICT only)")
                 else:
                     raise ValueError(
-                        "expected CASCADE, RESTRICT, SET NULL or "
-                        f"NO ACTION at {self.peek()}")
+                        f"expected DELETE or UPDATE at {self.peek()}")
             foreign_keys.append((col, parent, pcol, action))
 
         while True:

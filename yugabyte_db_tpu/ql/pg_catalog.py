@@ -86,6 +86,7 @@ VIRTUAL_TABLES = frozenset({
     "information_schema.schemata",
     "information_schema.table_constraints",
     "information_schema.key_column_usage",
+    "information_schema.referential_constraints",
 })
 
 
@@ -285,5 +286,21 @@ async def rows_for(name: str, client) -> Optional[List[Dict]]:
                             "table_name": info.name,
                             "column_name": fk["column"],
                             "ordinal_position": 1})
+        return out
+    if name == "information_schema.referential_constraints":
+        out = []
+        for _, info in user_infos:
+            ct = cts.get(info.name)
+            for fk in getattr(ct, "foreign_keys", None) or []:
+                act = (fk.get("on_delete") or "restrict").upper()
+                out.append({
+                    "constraint_catalog": "yugabyte",
+                    "constraint_schema": "public",
+                    "constraint_name":
+                        f"{info.name}_{fk['column']}_fkey",
+                    "unique_constraint_name":
+                        f"{fk['parent_table']}_pkey",
+                    "update_rule": "NO ACTION",
+                    "delete_rule": act})
         return out
     return None
