@@ -896,3 +896,30 @@ class TestFkActions:
             finally:
                 await mc.shutdown()
         asyncio.run(go())
+
+    def test_two_set_null_fks_both_null(self, tmp_path):
+        """A child with TWO SET NULL FKs toward one parent nulls both
+        columns (merged row image, not two restoring upserts)."""
+        async def go():
+            mc = await MiniCluster(str(tmp_path),
+                                   num_tservers=1).start()
+            s = SqlSession(mc.client())
+            try:
+                await s.execute("CREATE TABLE p6 (id bigint PRIMARY "
+                                "KEY) WITH tablets = 1")
+                await s.execute(
+                    "CREATE TABLE c8 (id bigint PRIMARY KEY, "
+                    "a bigint REFERENCES p6 (id) ON DELETE SET NULL, "
+                    "b bigint REFERENCES p6 (id) ON DELETE SET NULL) "
+                    "WITH tablets = 1")
+                await s.execute("INSERT INTO p6 (id) VALUES (1)")
+                await s.execute("INSERT INTO c8 (id, a, b) "
+                                "VALUES (10, 1, 1)")
+                await s.execute("DELETE FROM p6 WHERE id = 1")
+                r = await s.execute("SELECT a, b FROM c8 "
+                                    "WHERE id = 10")
+                assert r.rows[0]["a"] is None
+                assert r.rows[0]["b"] is None
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())

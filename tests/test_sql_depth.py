@@ -389,3 +389,116 @@ class TestExplainAnalyze:
             finally:
                 await mc.shutdown()
         asyncio.run(go())
+
+
+class TestPkMovingUpdate:
+    """UPDATE that SETs a primary-key column re-keys like PG: old key
+    deleted, new key strict-inserted (collision errors), children
+    referencing the moved key veto (ON UPDATE NO ACTION scope)."""
+
+    def test_rekey_overlap_collision_and_fk_veto(self, tmp_path):
+        async def go():
+            from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+            mc = await MiniCluster(str(tmp_path),
+                                   num_tservers=1).start()
+            s = SqlSession(mc.client())
+            try:
+                await s.execute("CREATE TABLE t (k bigint PRIMARY "
+                                "KEY, v bigint) WITH tablets = 2")
+                await s.execute("INSERT INTO t (k, v) VALUES "
+                                "(1, 10), (2, 20), (3, 30)")
+                await s.execute("UPDATE t SET k = 100 WHERE k = 1")
+                r = await s.execute("SELECT k FROM t ORDER BY k")
+                assert [x["k"] for x in r.rows] == [2, 3, 100]
+                # overlapping moves re-key cleanly
+                await s.execute("UPDATE t SET k = k + 1 WHERE k < 10")
+                r = await s.execute("SELECT k, v FROM t ORDER BY k")
+                assert [(x["k"], x["v"]) for x in r.rows] == \
+                    [(3, 20), (4, 30), (100, 10)]
+                # collision with an existing key errors
+                with pytest.raises(Exception, match="duplicate"):
+                    await s.execute("UPDATE t SET k = 100 WHERE k = 3")
+                # txn rollback restores the original keys
+                await s.execute("BEGIN")
+                await s.execute("UPDATE t SET k = 200 WHERE k = 4")
+                r = await s.execute("SELECT k FROM t ORDER BY k")
+                assert [x["k"] for x in r.rows] == [3, 100, 200]
+                await s.execute("ROLLBACK")
+                r = await s.execute("SELECT k FROM t ORDER BY k")
+                assert [x["k"] for x in r.rows] == [3, 4, 100]
+                # a referenced key cannot move away from its children
+                await s.execute(
+                    "CREATE TABLE ch (id bigint PRIMARY KEY, tk "
+                    "bigint REFERENCES t (k)) WITH tablets = 1")
+                await s.execute("INSERT INTO ch (id, tk) "
+                                "VALUES (1, 3)")
+                with pytest.raises(Exception,
+                                   match="still referenced"):
+                    await s.execute("UPDATE t SET k = 5 WHERE k = 3")
+                r = await s.execute("SELECT k FROM t ORDER BY k")
+                assert [x["k"] for x in r.rows] == [3, 4, 100]
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())
+
+    def test_txn_rekey_collision_keeps_row(self, tmp_path):
+        """Inside an explicit txn a re-key whose strict insert
+        collides must roll the WHOLE statement back — committing must
+        not make the old row vanish."""
+        async def go():
+            from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+            mc = await MiniCluster(str(tmp_path),
+                                   num_tservers=1).start()
+            s = SqlSession(mc.client())
+            try:
+                await s.execute("CREATE TABLE tx2 (k bigint PRIMARY "
+                                "KEY, v bigint) WITH tablets = 1")
+                await s.execute("INSERT INTO tx2 (k, v) VALUES "
+                                "(1, 10), (2, 20)")
+                await s.execute("BEGIN")
+                with pytest.raises(Exception, match="duplicate"):
+                    await s.execute("UPDATE tx2 SET k = 2 WHERE k = 1")
+                await s.execute("COMMIT")
+                r = await s.execute("SELECT k FROM tx2 ORDER BY k")
+                assert [x["k"] for x in r.rows] == [1, 2]
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())
+
+    def test_overlapping_shift_of_referenced_keys_allowed(
+            self, tmp_path):
+        """Moving k=k+1 over a referenced key that the SAME statement
+        re-creates passes (end-of-statement NO ACTION), while moving
+        a referenced key away with no replacement still vetoes —
+        including when the child declared ON DELETE CASCADE (delete
+        actions don't fire for updates)."""
+        async def go():
+            from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+            mc = await MiniCluster(str(tmp_path),
+                                   num_tservers=1).start()
+            s = SqlSession(mc.client())
+            try:
+                await s.execute("CREATE TABLE par2 (k bigint PRIMARY "
+                                "KEY) WITH tablets = 1")
+                await s.execute(
+                    "CREATE TABLE ch2 (id bigint PRIMARY KEY, pk2 "
+                    "bigint REFERENCES par2 (k) ON DELETE CASCADE) "
+                    "WITH tablets = 1")
+                await s.execute("INSERT INTO par2 (k) VALUES (2), (3)")
+                await s.execute("INSERT INTO ch2 (id, pk2) "
+                                "VALUES (1, 3)")
+                # 2->3, 3->4: key 3 re-created by the same statement
+                await s.execute("UPDATE par2 SET k = k + 1")
+                r = await s.execute("SELECT k FROM par2 ORDER BY k")
+                assert [x["k"] for x in r.rows] == [3, 4]
+                # moving 3 away entirely: child still references it,
+                # and ON DELETE CASCADE must NOT delete the child
+                with pytest.raises(Exception,
+                                   match="still referenced"):
+                    await s.execute(
+                        "UPDATE par2 SET k = 9 WHERE k = 3")
+                r = await s.execute("SELECT count(*) FROM ch2")
+                assert r.rows[0]["count"] == 1
+            finally:
+                await mc.shutdown()
+        asyncio.run(go())

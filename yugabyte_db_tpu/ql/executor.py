@@ -975,7 +975,8 @@ class SqlSession:
         return self._fk_child_map.get(parent, [])
 
     async def _check_fk_restrict(self, ct, pk_cols, pk_rows,
-                                 planned=None) -> None:
+                                 planned=None,
+                                 all_actions: bool = False) -> None:
         """Parent-side RESTRICT: deleting a row still referenced by a
         child FK fails (reference: PG's NO ACTION/RESTRICT through the
         executor; checked via child scans — an index on the FK column
@@ -992,8 +993,12 @@ class SqlSession:
         values = [r[pk] for r in pk_rows]
         value_set = set(values)
         for child, col, action in children:
-            if action in ("cascade", "set null"):
-                continue    # handled by the action plan before this
+            if action in ("cascade", "set null") and not all_actions:
+                # handled by the DELETE action plan; an UPDATE re-key
+                # passes all_actions=True — ON DELETE actions don't
+                # fire for updates, so every child vetoes (ON UPDATE
+                # NO ACTION)
+                continue
             cct = await self.client._table(child)
             child_pk = [c.name for c in cct.info.schema.key_columns]
             pend = (self._txn.pending_writes(child)
@@ -1078,13 +1083,19 @@ class SqlSession:
         idx_name = next(
             (n for n, spec in (cct.indexes or {}).items()
              if spec["column"] == col), None)
-        if idx_name is not None and not full:
-            # indexed point lookups per value beat one IN-scan
+        if idx_name is not None:
+            # indexed point lookups per value beat one IN-scan; the
+            # full-row case follows each index hit with a point get
             committed = []
             for v in value_set:
                 for p in await self.client.index_lookup(
                         child, idx_name, v):
-                    committed.append({**p, col: v})
+                    if full:
+                        row = await self.client.get(child, p)
+                        if row is not None:
+                            committed.append(row)
+                    else:
+                        committed.append({**p, col: v})
         else:
             cid = cct.info.schema.column_by_name(col).id
             resp = await self.client.scan(child, ReadRequest(
@@ -1130,6 +1141,8 @@ class SqlSession:
         Returns the parent rows_affected."""
         planned: Dict[str, set] = {}
         plan: list = []    # (table, "delete"|"set null", rows, pk_cols)
+        setnull_acc: Dict[str, tuple] = {}   # child -> (pk_cols,
+        #                                      {pk: merged row image})
         visited: list = []    # (cct, pk_cols, rows) for restrict pass
         planned.setdefault(ct.info.name, set()).update(
             tuple(r[k] for k in pk_cols) for r in pk_rows)
@@ -1162,9 +1175,19 @@ class SqlSession:
                                 f'not-null constraint (ON DELETE '
                                 f'SET NULL)')
                         # full-row rewrite: upserts pack every value
-                        # column, so the whole row must ride along
-                        plan.append((child, "set null", [
-                            {**r, col: None} for r in refs], child_pk))
+                        # column, so the whole row must ride along.
+                        # Accumulate per (child, pk) — a child with
+                        # TWO set-null FKs toward the parent must null
+                        # both columns in ONE row image, not restore
+                        # one with the other's upsert
+                        acc = setnull_acc.setdefault(
+                            child, (child_pk, {}))[1]
+                        for r in refs:
+                            rpk = tuple(r.get(k) for k in child_pk)
+                            if rpk in acc:
+                                acc[rpk][col] = None
+                            else:
+                                acc[rpk] = {**r, col: None}
                         continue
                     # mark planned at DISCOVERY time: a same-level
                     # sibling path to the same row must not plan it
@@ -1177,6 +1200,8 @@ class SqlSession:
                         {k: r.get(k) for k in child_pk}
                         for r in refs], child_pk))
             frontier = nxt
+        for child, (cpk, acc) in setnull_acc.items():
+            plan.append((child, "set null", list(acc.values()), cpk))
         for ct_, pk_cols_, rows_ in visited:
             await self._check_fk_restrict(ct_, pk_cols_, rows_,
                                           planned)
@@ -3218,10 +3243,8 @@ class SqlSession:
         if any(fk["column"] in stmt.sets
                for fk in getattr(ct, "foreign_keys", None) or []):
             await self._check_foreign_keys(ct, updated)
-        if self._txn is not None:
-            n = await self._txn.insert(stmt.table, updated)
-        else:
-            n = await self.client.insert(stmt.table, updated)
+        n = await self._write_update_rows(
+            ct, schema, [tr for tr, _ in pairs], updated)
         if getattr(stmt, "returning", None):
             return SqlResult(
                 self._returning_rows(stmt.returning, updated, schema),
@@ -3535,15 +3558,90 @@ class SqlSession:
         if any(fk["column"] in stmt.sets
                for fk in getattr(ct, "foreign_keys", None) or []):
             await self._check_foreign_keys(ct, updated)
-        if self._txn is not None:
-            n = await self._txn.insert(stmt.table, updated)
-        else:
-            n = await self.client.insert(stmt.table, updated)
+        n = await self._write_update_rows(ct, schema, rows, updated)
         if getattr(stmt, "returning", None):
             return SqlResult(
                 self._returning_rows(stmt.returning, updated, schema),
                 f"UPDATE {n}")
         return SqlResult([], f"UPDATE {n}")
+
+    async def _write_update_rows(self, ct, schema, pre_rows,
+                                 updated) -> int:
+        """Write an UPDATE's post-images.  A row whose SET moved the
+        primary key re-keys like PG: the old key deletes and the new
+        key strict-inserts (a collision errors), with deletes batched
+        BEFORE inserts so overlapping moves (SET k = k + 1) land; a
+        moved key still referenced by a child FK vetoes (ON UPDATE is
+        NO ACTION scope)."""
+        pk_names = [c.name for c in schema.key_columns]
+        moved_old, deletes, inserts, upserts = [], [], [], []
+        seen_pks = set()
+        for r, nr in zip(pre_rows, updated):
+            rpk = tuple(r.get(k) for k in pk_names)
+            if rpk in seen_pks:
+                # a multi-matching UPDATE ... FROM join lists the same
+                # target row once per match; PG applies one of them
+                continue
+            seen_pks.add(rpk)
+            if any(nr.get(k) != r.get(k) for k in pk_names):
+                moved_old.append(r)
+                deletes.append(RowOp(
+                    "delete", {k: r[k] for k in pk_names}))
+                inserts.append(RowOp("insert", nr))
+            else:
+                upserts.append(RowOp("upsert", nr))
+        n = len(seen_pks)
+        if moved_old and len(pk_names) == 1:
+            # end-of-statement NO ACTION: a moved-away key that the
+            # SAME statement re-creates (overlapping shift, k = k + 1)
+            # is still present afterwards and does not veto
+            recreated = {op.row[pk_names[0]] for op in inserts}
+            vetoed = [r for r in moved_old
+                      if r[pk_names[0]] not in recreated]
+            if vetoed:
+                await self._check_fk_restrict(
+                    ct, pk_names, vetoed, all_actions=True)
+
+        async def run_writes(write):
+            for ops in (deletes, inserts, upserts):
+                if ops:
+                    await write(ct.info.name, ops)
+
+        if not moved_old:
+            await run_writes(self._txn.write if self._txn is not None
+                             else self.client.write)
+            return n
+        if self._txn is None:
+            # re-keying outside a txn runs under an IMPLICIT one: the
+            # delete must not survive a strict-insert collision (PG's
+            # statement atomicity — the row would simply vanish)
+            own = await self.client.transaction().begin()
+            try:
+                await run_writes(own.write)
+                await own.commit()
+            except BaseException:
+                try:
+                    await own.abort()
+                except Exception:   # noqa: BLE001
+                    pass
+                raise
+            return n
+        # inside an explicit txn the three batches share one statement
+        # subtransaction (each _txn.write only brackets its own ops) —
+        # a mid-statement duplicate-key must not leak the delete
+        sp = f"__rekey_{self._txn._next_sub}"
+        self._txn.savepoint(sp)
+        try:
+            await run_writes(self._txn.write)
+        except Exception:
+            try:
+                await self._txn.rollback_to(sp)
+                self._txn.release_savepoint(sp)
+            except Exception:   # noqa: BLE001 — rollback_to aborts
+                pass            # the txn itself on failure
+            raise
+        self._txn.release_savepoint(sp)
+        return n
 
 
 def _decimal_cols(schema) -> set:
