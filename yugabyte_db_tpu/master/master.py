@@ -665,6 +665,51 @@ class Master:
             + [["del_tablet", t] for t in self.tables[tid]["tablets"]])
         return {"ok": True}
 
+    async def rpc_add_table_constraint(self, payload) -> dict:
+        """ALTER TABLE ADD CONSTRAINT: append an FK or CHECK to the
+        catalog entry (the executor validates existing rows first;
+        UNIQUE goes through index creation instead — reference:
+        AddForeignKey/AddCheck through catalog_manager AlterTable)."""
+        self._check_leader()
+        name = payload["table"]
+        tid = next((t for t, e in self.tables.items()
+                    if e["info"]["name"] == name), None)
+        if tid is None:
+            raise RpcError(f"table {name} not found", "NOT_FOUND")
+        tent = dict(self.tables[tid])
+        if payload.get("foreign_key"):
+            fks = list(tent.get("foreign_keys", []))
+            fks.append(dict(payload["foreign_key"]))
+            tent["foreign_keys"] = fks
+        if payload.get("check") is not None:
+            cks = list(tent.get("checks", []))
+            cks.append(payload["check"])
+            tent["checks"] = cks
+        await self._commit_catalog([["put_table", tid, tent]])
+        return {"ok": True}
+
+    async def rpc_drop_table_constraint(self, payload) -> dict:
+        """ALTER TABLE DROP CONSTRAINT for FOREIGN KEYs: remove by the
+        stored or synthesized ({table}_{column}_fkey) name."""
+        self._check_leader()
+        name = payload["table"]
+        cname = payload["constraint_name"]
+        tid = next((t for t, e in self.tables.items()
+                    if e["info"]["name"] == name), None)
+        if tid is None:
+            raise RpcError(f"table {name} not found", "NOT_FOUND")
+        tent = dict(self.tables[tid])
+        fks = list(tent.get("foreign_keys", []))
+        keep = [fk for fk in fks
+                if (fk.get("name")
+                    or f"{name}_{fk['column']}_fkey") != cname]
+        if len(keep) == len(fks):
+            raise RpcError(f"constraint {cname} not found",
+                           "NOT_FOUND")
+        tent["foreign_keys"] = keep
+        await self._commit_catalog([["put_table", tid, tent]])
+        return {"ok": True}
+
     # --- lookups ----------------------------------------------------------
     async def rpc_get_tablet_locations(self, payload) -> dict:
         """Tablet-id existence + current replica addresses (the txn

@@ -179,6 +179,11 @@ class SqlSession:
         if isinstance(stmt, InsertStmt):
             return await self._insert(stmt)
         if isinstance(stmt, AlterTableStmt):
+            if getattr(stmt, "add_constraints", None) or \
+                    getattr(stmt, "drop_constraints", None):
+                await self._alter_constraints(stmt)
+                if not stmt.add_columns and not stmt.drop_columns:
+                    return SqlResult([], "ALTER TABLE")
             adds = []
             for cname, ctype in stmt.add_columns:
                 ct = resolve_type(ctype)

@@ -119,6 +119,10 @@ class AlterTableStmt:
     table: str
     add_columns: List[Tuple[str, str]]
     drop_columns: List[str] = field(default_factory=list)
+    # ("fk", name|None, col, parent, pcol, action) |
+    # ("check", name|None, expr) | ("unique", name|None, [cols])
+    add_constraints: List[tuple] = field(default_factory=list)
+    drop_constraints: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -343,6 +347,53 @@ class Parser:
             self.pos += 1
             return True
         return False
+
+    def _fk_tail(self):
+        """After REFERENCES: `parent (pcol) [ON DELETE action]
+        [ON UPDATE action]` -> (parent, pcol, delete_action)."""
+        parent = self.ident()
+        self.expect_op("(")
+        pcol = self.ident()
+        self.expect_op(")")
+        action = "no action"
+
+        def ref_action():
+            # CASCADE/RESTRICT/NO ACTION aren't reserved words —
+            # match them as identifiers so they stay usable as
+            # column names elsewhere
+            if self._accept_word("cascade"):
+                return "cascade"
+            if self._accept_word("restrict"):
+                return "restrict"
+            if self.accept_kw("set"):
+                self.expect_kw("null")
+                return "set null"
+            if self._accept_word("no"):
+                if not self._accept_word("action"):
+                    raise ValueError(
+                        f"expected ACTION at {self.peek()}")
+                return "no action"
+            raise ValueError(
+                "expected CASCADE, RESTRICT, SET NULL or "
+                f"NO ACTION at {self.peek()}")
+
+        while self.accept_kw("on"):
+            if self.accept_kw("delete"):
+                action = ref_action()
+            elif self.accept_kw("update"):
+                # ON UPDATE: only the PG-default no-op forms parse
+                # (our PKs are immutable through UPDATE re-keying's
+                # insert+delete, so CASCADE/SET NULL can't be
+                # honored — reject them loudly)
+                ua = ref_action()
+                if ua not in ("no action", "restrict"):
+                    raise ValueError(
+                        f"ON UPDATE {ua.upper()} is not supported "
+                        "(ON UPDATE NO ACTION / RESTRICT only)")
+            else:
+                raise ValueError(
+                    f"expected DELETE or UPDATE at {self.peek()}")
+        return parent, pcol, action
 
     def expect_kw(self, word):
         if not self.accept_kw(word):
@@ -577,49 +628,7 @@ class Parser:
         checks: List[tuple] = []
 
         def fk_clause(col):
-            parent = self.ident()
-            self.expect_op("(")
-            pcol = self.ident()
-            self.expect_op(")")
-            action = "no action"
-
-            def ref_action():
-                # CASCADE/RESTRICT/NO ACTION aren't reserved words —
-                # match them as identifiers so they stay usable as
-                # column names elsewhere
-                if self._accept_word("cascade"):
-                    return "cascade"
-                if self._accept_word("restrict"):
-                    return "restrict"
-                if self.accept_kw("set"):
-                    self.expect_kw("null")
-                    return "set null"
-                if self._accept_word("no"):
-                    if not self._accept_word("action"):
-                        raise ValueError(
-                            f"expected ACTION at {self.peek()}")
-                    return "no action"
-                raise ValueError(
-                    "expected CASCADE, RESTRICT, SET NULL or "
-                    f"NO ACTION at {self.peek()}")
-
-            while self.accept_kw("on"):
-                if self.accept_kw("delete"):
-                    action = ref_action()
-                elif self.accept_kw("update"):
-                    # ON UPDATE: only the PG-default no-op forms parse
-                    # (our PKs are immutable through UPDATE re-keying's
-                    # insert+delete, so CASCADE/SET NULL can't be
-                    # honored — reject them loudly)
-                    ua = ref_action()
-                    if ua not in ("no action", "restrict"):
-                        raise ValueError(
-                            f"ON UPDATE {ua.upper()} is not "
-                            "supported (ON UPDATE NO ACTION / "
-                            "RESTRICT only)")
-                else:
-                    raise ValueError(
-                        f"expected DELETE or UPDATE at {self.peek()}")
+            parent, pcol, action = self._fk_tail()
             foreign_keys.append((col, parent, pcol, action))
 
         while True:
@@ -790,22 +799,62 @@ class Parser:
         table = self.ident()
         adds = []
         drops: List[str] = []
+        add_cons: List[tuple] = []
+        drop_cons: List[str] = []
+
+        def constraint_def(name):
+            if self.accept_kw("foreign"):
+                self.expect_kw("key")
+                self.expect_op("(")
+                col = self.ident()
+                self.expect_op(")")
+                self.expect_kw("references")
+                parent, pcol, action = self._fk_tail()
+                add_cons.append(("fk", name, col, parent, pcol,
+                                 action))
+            elif self.accept_kw("check"):
+                self.expect_op("(")
+                add_cons.append(("check", name, self.expr()))
+                self.expect_op(")")
+            elif self.accept_kw("unique"):
+                self.expect_op("(")
+                cols = [self.ident()]
+                while self.accept_op(","):
+                    cols.append(self.ident())
+                self.expect_op(")")
+                add_cons.append(("unique", name, cols))
+            else:
+                raise ValueError(
+                    "expected FOREIGN KEY, CHECK or UNIQUE at "
+                    f"{self.peek()}")
+
         while True:
             if self.accept_kw("add"):
-                self.accept_kw("column")
-                cname = self.ident()
-                adds.append((cname, self._column_type()))
+                t = self.peek()
+                if self.accept_kw("constraint"):
+                    constraint_def(self.ident())
+                elif t and t[0] == "kw" and t[1].lower() in (
+                        "foreign", "check", "unique"):
+                    constraint_def(None)
+                else:
+                    self.accept_kw("column")
+                    cname = self.ident()
+                    adds.append((cname, self._column_type()))
             elif self.accept_kw("drop"):
-                self.accept_kw("column")
-                drops.append(self.ident())
+                if self.accept_kw("constraint"):
+                    drop_cons.append(self.ident())
+                else:
+                    self.accept_kw("column")
+                    drops.append(self.ident())
             else:
                 break
             if not self.accept_op(","):
                 break
-        if not adds and not drops:
+        if not (adds or drops or add_cons or drop_cons):
             raise ValueError(
-                "ALTER TABLE supports ADD COLUMN / DROP COLUMN")
-        return AlterTableStmt(table, adds, drops)
+                "ALTER TABLE supports ADD/DROP COLUMN and "
+                "ADD/DROP CONSTRAINT")
+        return AlterTableStmt(table, adds, drops, add_cons, drop_cons)
 
     def _create_tablespace(self):
         """CREATE TABLESPACE name WITH placement = 'z:n[,z:n...]'
