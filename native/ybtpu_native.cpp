@@ -254,4 +254,114 @@ int64_t kway_merge(const uint8_t* keys, const uint64_t* offsets,
     return emitted;
 }
 
+// --------------------------------------------------------------------------
+// Row gather / gather-scatter. The compaction pipeline's encode stage
+// moves ~100 bytes/row from source blocks into merged-order output
+// buffers; doing the row memcpys here keeps that stage off the GIL so
+// it genuinely overlaps the merge and write stages.
+// --------------------------------------------------------------------------
+// Fixed-size element loops (memcpy of a compile-time size lowers to a
+// single unaligned load/store — sources can be unaligned mmap views, so
+// typed pointer casts would be UB). A per-row variable-size memcpy call
+// is ~3x slower at 8 bytes. Macro instead of a template: this block has
+// C linkage.
+#define YB_GATHER_W(W)                                                  \
+    for (int64_t i = 0; i < n; ++i) {                                   \
+        memcpy(dst + i * (W), src + idx[i] * (W), (W));                 \
+    }                                                                   \
+    return;
+
+#define YB_GS_W(W)                                                      \
+    for (int64_t i = 0; i < n; ++i) {                                   \
+        memcpy(dst + dst_idx[i] * (W), src + src_idx[i] * (W), (W));    \
+    }                                                                   \
+    return;
+
+void gather_rows(const uint8_t* src, int64_t row_bytes,
+                 const int64_t* idx, int64_t n, uint8_t* dst) {
+    switch (row_bytes) {
+        case 1: YB_GATHER_W(1)
+        case 2: YB_GATHER_W(2)
+        case 4: YB_GATHER_W(4)
+        case 8: YB_GATHER_W(8)
+        case 16: YB_GATHER_W(16)
+    }
+    for (int64_t i = 0; i < n; ++i) {
+        memcpy(dst + i * row_bytes, src + idx[i] * row_bytes,
+               (size_t)row_bytes);
+    }
+}
+
+void gather_scatter_rows(const uint8_t* src, int64_t row_bytes,
+                         const int64_t* src_idx, const int64_t* dst_idx,
+                         int64_t n, uint8_t* dst) {
+    switch (row_bytes) {
+        case 1: YB_GS_W(1)
+        case 2: YB_GS_W(2)
+        case 4: YB_GS_W(4)
+        case 8: YB_GS_W(8)
+        case 16: YB_GS_W(16)
+    }
+    for (int64_t i = 0; i < n; ++i) {
+        memcpy(dst + dst_idx[i] * row_bytes,
+               src + src_idx[i] * row_bytes, (size_t)row_bytes);
+    }
+}
+
+// --------------------------------------------------------------------------
+// Fixed-width k-way merge over NON-CONTIGUOUS sorted segments (the
+// pipelined compaction frontier: each segment is a row range of one
+// decoded — possibly mmap-backed — block, so no concatenated key matrix
+// ever materializes). seg_ptrs[s] points at segment s's first key;
+// segment s holds seg_rows[s] keys of `width` bytes. Emits positions in
+// the VIRTUAL concatenation of the segments (base[s] + row) in merged
+// order, plus exact-duplicate flags; key ties prefer the lower segment
+// index (earlier-activated block). Returns rows emitted.
+// --------------------------------------------------------------------------
+struct SegItem {
+    const uint8_t* key;
+    int32_t seg;
+    int64_t pos;     // virtual concatenated position
+    int64_t row;     // row within segment
+};
+
+int64_t kway_merge_segs(const uint8_t* const* seg_ptrs,
+                        const int64_t* seg_rows, int32_t num_segs,
+                        int64_t width, int64_t* out_indices,
+                        uint8_t* out_dup) {
+    struct Cmp {
+        int64_t w;
+        bool operator()(const SegItem& a, const SegItem& b) const {
+            int c = memcmp(a.key, b.key, (size_t)w);
+            if (c) return c > 0;          // min-heap by key
+            return a.seg > b.seg;         // tie: lower segment first
+        }
+    };
+    std::priority_queue<SegItem, std::vector<SegItem>, Cmp> heap(
+        Cmp{width});
+    std::vector<int64_t> base(num_segs + 1, 0);
+    for (int32_t s = 0; s < num_segs; ++s) {
+        base[s + 1] = base[s] + seg_rows[s];
+        if (seg_rows[s] > 0) {
+            heap.push({seg_ptrs[s], s, base[s], 0});
+        }
+    }
+    int64_t emitted = 0;
+    const uint8_t* last_key = nullptr;
+    while (!heap.empty()) {
+        SegItem it = heap.top();
+        heap.pop();
+        out_indices[emitted] = it.pos;
+        out_dup[emitted] =
+            (last_key && memcmp(it.key, last_key, (size_t)width) == 0)
+            ? 1 : 0;
+        ++emitted;
+        last_key = it.key;
+        if (it.row + 1 < seg_rows[it.seg]) {
+            heap.push({it.key + width, it.seg, it.pos + 1, it.row + 1});
+        }
+    }
+    return emitted;
+}
+
 }  // extern "C"

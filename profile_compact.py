@@ -1,7 +1,25 @@
-"""Profile device vs native compaction (throwaway)."""
-import os, tempfile, time
+"""Profile the compaction engine (pipelined chunked vs monolithic CPU).
+
+Default: human-readable backend comparison + cProfile phase breakdown.
+--json: one JSON object on stdout with
+  - backends: MB/s per backend (pipelined native, monolithic baseline)
+  - chunk_sweep: MB/s + pipeline stage timings per frontier budget
+  - kernel_cache: merge-kernel compile counts for a first and a
+    same-shape second device-backend compaction (shape-stable caching
+    means the second must report 0 compiles)
+Env knobs: BENCH_SF (default 1.0), N_SSTS (default 100), ROWS_PER
+(default 20000), PROFILE_CHUNK_SWEEP (comma-separated row budgets).
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
 os.environ.setdefault("YBTPU_PLATFORM", "cpu")
+
 import numpy as np
+
 from yugabyte_db_tpu.models.tpch import generate_lineitem, LineitemTable
 from yugabyte_db_tpu.utils.hybrid_time import HybridTime
 from yugabyte_db_tpu.utils import flags
@@ -10,6 +28,7 @@ data = generate_lineitem(float(os.environ.get("BENCH_SF", "1.0")))
 n = len(data["rowid"])
 n_ssts = int(os.environ.get("N_SSTS", "100"))
 rows_per = int(os.environ.get("ROWS_PER", "20000"))
+as_json = "--json" in sys.argv
 
 
 def make(tag):
@@ -26,22 +45,83 @@ def make(tag):
         t.bulk_load(batch, ht=HybridTime.from_micros(base_us + i * 1000))
     return t
 
-for backend, flag in (("device", True), ("native", False)):
-    t = make(backend)
+
+def timed_compact(flag):
+    t = make("dev" if flag else "cpu")
     total = t.approximate_size()
     flags.set_flag("tpu_compaction_enabled", flag)
     t0 = time.perf_counter()
     t.compact()
     dt = time.perf_counter() - t0
-    print(f"{backend}: {total/1e6:.1f} MB in {dt:.2f}s = "
-          f"{total/1e6/dt:.1f} MB/s")
-flags.REGISTRY.reset("tpu_compaction_enabled")
+    return total, dt
 
-# phase breakdown for the device path
-import cProfile, pstats
-t = make("prof")
-flags.set_flag("tpu_compaction_enabled", True)
-pr = cProfile.Profile(); pr.enable()
-t.compact()
-pr.disable()
-pstats.Stats(pr).sort_stats("cumulative").print_stats(18)
+
+from yugabyte_db_tpu.docdb.compaction import (LAST_COMPACTION_STATS,
+                                              tpu_compact)
+
+if as_json:
+    out = {"n_ssts": n_ssts, "rows_per_sst": rows_per,
+           "rows": n_ssts * rows_per}
+    # backend comparison (same harness as bench.py config 4)
+    out["backends"] = {}
+    for name, flag in (("pipelined_native", True), ("baseline", False)):
+        total, dt = timed_compact(flag)
+        out["backends"][name] = {
+            "mb": round(total / 1e6, 1), "seconds": round(dt, 3),
+            "mb_per_s": round(total / 1e6 / dt, 1)}
+        if flag:
+            out["backends"][name]["pipeline"] = {
+                k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in LAST_COMPACTION_STATS.items()}
+    flags.REGISTRY.reset("tpu_compaction_enabled")
+    # chunk-size sweep over the pipelined engine
+    sweep_env = os.environ.get("PROFILE_CHUNK_SWEEP", "131072,262144,524288")
+    out["chunk_sweep"] = []
+    flags.set_flag("tpu_compaction_enabled", True)
+    for chunk in (int(x) for x in sweep_env.split(",") if x.strip()):
+        flags.set_flag("compaction_chunk_rows", chunk)
+        total, dt = timed_compact(True)
+        s = dict(LAST_COMPACTION_STATS)
+        out["chunk_sweep"].append({
+            "chunk_rows": chunk, "mb_per_s": round(total / 1e6 / dt, 1),
+            "chunks": s.get("chunks"),
+            "frontier_rows": s.get("frontier_rows"),
+            "emitted_rows": s.get("emitted_rows"),
+            "stage_s": {k: round(s.get(k, 0.0), 4)
+                        for k in ("decode_wait_s", "merge_wait_s",
+                                  "gather_s", "write_wait_s")}})
+    flags.REGISTRY.reset("compaction_chunk_rows")
+    flags.REGISTRY.reset("tpu_compaction_enabled")
+    # kernel-cache behavior: two same-shape device-backend compactions.
+    # Shape-stable bucketing means the first compiles at most a few
+    # signatures and the second compiles none.
+    kc = {}
+    for run in ("first", "second"):
+        t = make(f"kc-{run}")
+        tpu_compact(t.regular, t.codec, t.history_cutoff(),
+                    backend="device")
+        s = dict(LAST_COMPACTION_STATS)
+        kc[run] = {"kernel_compiles": s.get("kernel_compiles"),
+                   "kernel_calls": s.get("kernel_calls"),
+                   "kernel_cache_hits": s.get("kernel_cache_hits"),
+                   "chunks": s.get("chunks")}
+    out["kernel_cache"] = kc
+    print(json.dumps(out))
+else:
+    for backend, flag in (("device", True), ("native", False)):
+        total, dt = timed_compact(flag)
+        print(f"{backend}: {total/1e6:.1f} MB in {dt:.2f}s = "
+              f"{total/1e6/dt:.1f} MB/s")
+    flags.REGISTRY.reset("tpu_compaction_enabled")
+
+    # phase breakdown for the pipelined path
+    import cProfile
+    import pstats
+    t = make("prof")
+    flags.set_flag("tpu_compaction_enabled", True)
+    pr = cProfile.Profile()
+    pr.enable()
+    t.compact()
+    pr.disable()
+    flags.REGISTRY.reset("tpu_compaction_enabled")
+    pstats.Stats(pr).sort_stats("cumulative").print_stats(18)

@@ -12,6 +12,12 @@ from yugabyte_db_tpu.utils.encryption import (
 )
 from yugabyte_db_tpu.utils.trace import ASH, TRACE, TRACES, wait_status
 
+from yugabyte_db_tpu.utils.encryption import aes_available
+
+requires_aes = pytest.mark.skipif(
+    not aes_available(),
+    reason="cryptography provider not installed in this image")
+
 
 def run(coro):
     return asyncio.run(coro)
@@ -189,6 +195,7 @@ class TestAesCtr:
     AES-CTR) with the BLAKE2b keystream as documented fallback and a
     format-versioned envelope keeping every combination readable."""
 
+    @requires_aes
     def test_aes_stream_roundtrip_random_access(self):
         from yugabyte_db_tpu.utils.encryption import (AesCtrStream,
                                                       aes_available)
@@ -202,6 +209,7 @@ class TestAesCtr:
             assert cs.xor(enc[off:off + 77], offset=off) == \
                 data[off:off + 77]
 
+    @requires_aes
     def test_envelope_selects_aes_and_rotates(self):
         from yugabyte_db_tpu.utils.encryption import (
             CIPHER_AES_CTR, MAGIC_V2, UniverseKeyManager)
@@ -244,6 +252,7 @@ class TestAesCtr:
                   + CipherStream(b"K" * 32, nonce).xor(raw))
         assert km.decrypt_file_bytes(legacy) == raw
 
+    @requires_aes
     def test_mixed_cipher_files_coexist(self):
         from yugabyte_db_tpu.utils.encryption import (
             CIPHER_AES_CTR, CIPHER_BLAKE2B, UniverseKeyManager)

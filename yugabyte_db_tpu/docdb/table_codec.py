@@ -502,17 +502,27 @@ class TableCodec:
                 hi = np.frombuffer(partition.end.ljust(part_keys.shape[1],
                                                        b"\x00"), np.uint8)
                 keep &= ~_rows_ge(part_keys, hi)
-        idx = np.nonzero(keep)[0]
-        doc_keys = doc_keys[idx]
+        if keep.all():
+            # single-tablet load: skip the identity gather (copies the
+            # whole key matrix for nothing at 6M-row bench scale)
+            idx = np.arange(n, dtype=np.int64)
+        else:
+            idx = np.nonzero(keep)[0]
+            doc_keys = doc_keys[idx]
+            if ps.kind == "hash":
+                hashes = hashes[idx]
         full = bulk.append_hybrid_times(
             doc_keys,
             np.full(len(idx), ht.value, np.uint64),
             np.arange(len(idx), dtype=np.uint32))
-        # sort rows by encoded doc key
-        order = np.argsort(
-            np.ascontiguousarray(doc_keys).view(
-                np.dtype((np.void, doc_keys.shape[1]))).reshape(-1),
-            kind="stable")
+        # sort rows by encoded doc key — numeric single-pass sort when
+        # the PK packs into one word (bulk.bulk_sort_order), byte-matrix
+        # comparison sort otherwise
+        comps = [(np.asarray(columns[c.name])[idx]
+                  if len(idx) != n else np.asarray(columns[c.name]),
+                  c.type, c.sort_desc) for c in self._pk_cols]
+        order = bulk.bulk_sort_order(
+            hashes if ps.kind == "hash" else None, comps, doc_keys)
         full = full[order]
         sorted_idx = idx[order]
         # all doc keys share one width here, so the matrix FNV is byte-

@@ -148,6 +148,65 @@ def append_hybrid_times(doc_keys: np.ndarray, ht_values: np.ndarray,
     return np.concatenate([doc_keys, marker, ht_be, wid_be], axis=1)
 
 
+#: packable integer component types -> their STORAGE dtype. Values must
+#: wrap through the storage dtype before biasing, exactly like the
+#: byte encoders do (encode_int32_column casts via astype(np.int32)),
+#: or out-of-range inputs would sort differently than their encodings.
+_PACKABLE_TYPES = {"int32": np.int32, "int64": np.int64,
+                   "timestamp": np.int64}
+
+
+def bulk_sort_order(hash_values: Optional[np.ndarray],
+                    components: Sequence[tuple],
+                    doc_keys: np.ndarray) -> np.ndarray:
+    """Sort order of N rows by encoded-doc-key byte order, computed from
+    the ORIGINAL numeric columns instead of a row-wise byte matrix.
+
+    components: [(values, type_name, desc)] per PK component, in key
+    order. For integer-typed components the order-preserving encoding is
+    a monotone byte mapping, so the key order equals the numeric order —
+    and when the value ranges fit, every component packs into ONE uint64
+    whose single radix argsort beats the generic void-dtype comparison
+    sort on the encoded matrix ~3x (the bulk-ingest hot sort).
+
+    Falls back to the byte-matrix argsort for non-integer or
+    wide-range keys; byte order is always the ground truth."""
+    parts: List[np.ndarray] = []
+    spans: List[int] = []
+    if hash_values is not None:
+        parts.append(hash_values.astype(np.uint64))
+        spans.append(1 << 16)
+    ok = len(doc_keys) > 0
+    if ok:
+        for values, tname, desc in components:
+            dtype = _PACKABLE_TYPES.get(tname)
+            if dtype is None:
+                ok = False
+                break
+            u = (np.asarray(values).astype(dtype).astype(np.int64)
+                 .astype(np.uint64) + np.uint64(1 << 63))
+            if desc:
+                u = ~u
+            lo = u.min()
+            u = u - lo
+            span = int(u.max()) + 1
+            parts.append(u)
+            spans.append(span)
+    if ok and parts:
+        total_bits = sum(max(1, int(s - 1).bit_length()) for s in spans)
+        if total_bits <= 63:
+            packed = np.zeros(len(doc_keys), np.uint64)
+            for u, s in zip(parts, spans):
+                packed = (packed << np.uint64(
+                    max(1, int(s - 1).bit_length()))) | u
+            return np.argsort(packed, kind="stable")
+        if len(parts) <= 3:
+            return np.lexsort(tuple(reversed(parts)))
+    v = np.ascontiguousarray(doc_keys).view(
+        np.dtype((np.void, doc_keys.shape[1]))).reshape(-1)
+    return np.argsort(v, kind="stable")
+
+
 def keys_to_bytes_list(enc: np.ndarray) -> List[bytes]:
     """Materialize row-wise byte strings (host-side boundary ops only)."""
     flat = enc.tobytes()

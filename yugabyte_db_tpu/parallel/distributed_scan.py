@@ -24,7 +24,7 @@ from ..ops.scan import (
     _rescale_outs, _static_scales,
 )
 from ..storage.columnar import ColumnarBlock
-from .mesh import BLOCKS_AXIS, TABLETS_AXIS, TabletMesh
+from .mesh import BLOCKS_AXIS, TABLETS_AXIS, TabletMesh, shard_map_compat
 
 
 @dataclass
@@ -203,11 +203,10 @@ class DistributedScanKernel:
         in_specs = (
             {k: spec3 for k in sig_cols(sig)}, {k: spec3 for k in sig_cols(sig)},
             P(), spec3, spec3, spec3, spec3, spec3, P(), P())
-        smapped = jax.shard_map(
+        smapped = shard_map_compat(
             shard_fn, mesh=tm.mesh, in_specs=in_specs,
             out_specs=(tuple(P() for _ in aggs), tuple(P() for _ in aggs),
-                       P()),
-            check_vma=False)
+                       P()))
         fn = jax.jit(smapped)
         self._cache[sig] = fn
         self.compiles += 1

@@ -250,7 +250,12 @@ class ColumnarBlock:
             for b in bufs)
 
     @classmethod
-    def deserialize(cls, data: bytes) -> "ColumnarBlock":
+    def deserialize(cls, data, copy: bool = True) -> "ColumnarBlock":
+        """Rebuild a block from its serialized form. With copy=False and
+        a buffer-backed `data` (e.g. a memoryview over the SST mmap) the
+        arrays are zero-copy READ-ONLY views — the compaction pipeline
+        reads each input row once, so materializing owned copies first
+        would double its memory traffic for nothing."""
         hlen = struct.unpack_from("<I", data)[0]
         meta = msgpack.unpackb(data[4:4 + hlen], strict_map_key=False)
         pos = 4 + hlen
@@ -259,10 +264,11 @@ class ColumnarBlock:
             nonlocal pos
             raw = data[pos:pos + ref["len"]]
             pos += ref["len"]
-            return np.frombuffer(raw, dtype=np.dtype(ref["dtype"])).reshape(
-                ref["shape"]).copy()
+            arr = np.frombuffer(raw, dtype=np.dtype(ref["dtype"])).reshape(
+                ref["shape"])
+            return arr.copy() if copy else arr
 
-        def take_raw(n) -> bytes:
+        def take_raw(n):
             nonlocal pos
             raw = data[pos:pos + n]
             pos += n
@@ -311,6 +317,47 @@ class ColumnarBlock:
             out.varlen[cid] = (new_ends,
                                heap[starts:int(ends[hi - 1]) if hi else 0],
                                null[lo:hi])
+        return out
+
+    @classmethod
+    def concat(cls, blocks: Sequence["ColumnarBlock"]) -> "ColumnarBlock":
+        """Row-wise concatenation of blocks with identical column sets
+        (the output-side twin of `slice`; the compaction pipeline buffers
+        gathered chunk pieces and cuts exact-size output blocks from the
+        concatenation). Varlen end-offsets are rebased onto the joined
+        heap. `unique_keys` is NOT derived — callers that know the
+        adjacency set it explicitly."""
+        if len(blocks) == 1:
+            return blocks[0]
+        first = blocks[0]
+        out = cls(
+            n=sum(b.n for b in blocks),
+            schema_version=first.schema_version,
+            key_hash=np.concatenate([b.key_hash for b in blocks]),
+            ht=np.concatenate([b.ht for b in blocks]),
+            write_id=np.concatenate([b.write_id for b in blocks]),
+            tombstone=np.concatenate([b.tombstone for b in blocks]),
+            unique_keys=False,
+            keys=(np.concatenate([b.keys for b in blocks])
+                  if first.keys is not None else None))
+        for cid in first.pk:
+            out.pk[cid] = np.concatenate([b.pk[cid] for b in blocks])
+        for cid in first.fixed:
+            out.fixed[cid] = (
+                np.concatenate([b.fixed[cid][0] for b in blocks]),
+                np.concatenate([b.fixed[cid][1] for b in blocks]))
+        for cid in first.varlen:
+            ends_all, nulls, heaps = [], [], []
+            base = 0
+            for b in blocks:
+                ends, heap, null = b.varlen[cid]
+                ends_all.append(ends.astype(np.int64) + base)
+                nulls.append(null)
+                heaps.append(bytes(heap))
+                base += len(heaps[-1])
+            out.varlen[cid] = (
+                np.concatenate(ends_all).astype(np.uint32),
+                b"".join(heaps), np.concatenate(nulls))
         return out
 
     def searchsorted_key(self, key: bytes) -> int:

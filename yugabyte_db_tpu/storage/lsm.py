@@ -57,6 +57,18 @@ class CompactionFeed:
     def feed(self, key: bytes, value: bytes) -> List[Tuple[bytes, bytes]]:
         return [(key, value)]
 
+    def feed_block(self, entries: Sequence[Tuple[bytes, bytes]]
+                   ) -> List[Tuple[bytes, bytes]]:
+        """Chunked seam: the store hands the merged stream over in
+        batches so a vectorized feed can process whole sorted runs at
+        once (the pipelined device engine in docdb/compaction.py is the
+        canonical implementation). Default delegates to per-row feed —
+        subclasses override exactly one of the two."""
+        out: List[Tuple[bytes, bytes]] = []
+        for k, v in entries:
+            out.extend(self.feed(k, v))
+        return out
+
     def flush(self) -> List[Tuple[bytes, bytes]]:
         return []
 
@@ -301,10 +313,19 @@ class LsmStore:
         feed = feed or CompactionFeed()
         path = self._new_sst_path()
         w = SstWriter(path, columnar_builder=self.columnar_builder)
-        # merge newest-first sources; exact dup keys keep newest
+        # merge newest-first sources; exact dup keys keep newest. The
+        # stream goes through the feed in chunks (feed_block) so
+        # vectorized feeds see whole sorted runs, not single rows.
         merged = merging_iterator([r.iterate() for r in inputs])
-        for k, v in merged:
-            for ok, ov in feed.feed(k, v):
+        batch: List[Tuple[bytes, bytes]] = []
+        for kv in merged:
+            batch.append(kv)
+            if len(batch) >= 4096:
+                for ok, ov in feed.feed_block(batch):
+                    w.add(ok, ov)
+                batch = []
+        if batch:
+            for ok, ov in feed.feed_block(batch):
                 w.add(ok, ov)
         for ok, ov in feed.flush():
             w.add(ok, ov)

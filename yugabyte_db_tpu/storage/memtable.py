@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from typing import Iterator, Optional, Tuple
 
-from sortedcontainers import SortedDict
+from ..utils.sortedcompat import SortedDict
 
 from ..dockv.key_encoding import ValueType
 from ..utils.hybrid_time import ENCODED_SIZE

@@ -23,7 +23,19 @@ _NATIVE_DIR = os.path.join(
 # (repo snapshots travel across hosts; see hostfp.py)
 from ..hostfp import host_fingerprint as _host_fp  # noqa: E402
 
-_SO = os.path.join(_NATIVE_DIR, f"libybtpu_native.{_host_fp()}.so")
+def _src_tag() -> str:
+    """Short hash of the C++ source so an edited library rebuilds into a
+    fresh .so instead of loading a stale build missing new symbols."""
+    import hashlib
+    try:
+        with open(os.path.join(_NATIVE_DIR, "ybtpu_native.cpp"), "rb") as f:
+            return hashlib.sha1(f.read()).hexdigest()[:8]
+    except OSError:
+        return "nosrc"
+
+
+_SO = os.path.join(_NATIVE_DIR,
+                   f"libybtpu_native.{_host_fp()}.{_src_tag()}.so")
 
 _u8p = ctypes.POINTER(ctypes.c_uint8)
 _u64p = ctypes.POINTER(ctypes.c_uint64)
@@ -84,6 +96,14 @@ def _load() -> Optional[ctypes.CDLL]:
     lib.kway_merge.argtypes = [_u8p, _u64p, _i64p, ctypes.c_int32, _i64p,
                                _u8p]
     lib.kway_merge.restype = ctypes.c_int64
+    lib.kway_merge_segs.argtypes = [ctypes.POINTER(ctypes.c_void_p),
+                                    _i64p, ctypes.c_int32,
+                                    ctypes.c_int64, _i64p, _u8p]
+    lib.kway_merge_segs.restype = ctypes.c_int64
+    lib.gather_rows.argtypes = [_u8p, ctypes.c_int64, _i64p,
+                                ctypes.c_int64, _u8p]
+    lib.gather_scatter_rows.argtypes = [_u8p, ctypes.c_int64, _i64p,
+                                        _i64p, ctypes.c_int64, _u8p]
     _LIB = lib
     return lib
 
@@ -183,6 +203,85 @@ def kway_merge_fixed(mat: np.ndarray, run_starts: np.ndarray
                          _ptr(run_starts, _i64p), len(run_starts) - 1,
                          _ptr(out_idx, _i64p), _ptr(out_dup, _u8p))
     return out_idx[:cnt], out_dup[:cnt].astype(bool)
+
+
+def kway_merge_segments(segs: Sequence[np.ndarray]
+                        ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """K-way merge over sorted fixed-width key segments WITHOUT
+    concatenating them: each seg is a C-contiguous [Ni, W] uint8 matrix
+    (typically a row-range view of a block's — possibly mmap-backed —
+    key matrix). Returns (order, dup) where order indexes the virtual
+    concatenation of the segments. The call releases the GIL (ctypes),
+    so the pipelined compaction's merge stage overlaps host work."""
+    lib = _load()
+    if lib is None or not segs:
+        return None
+    w = segs[0].shape[1]
+    n = 0
+    ptrs = (ctypes.c_void_p * len(segs))()
+    rows = np.empty(len(segs), np.int64)
+    for i, s in enumerate(segs):
+        if s.shape[1] != w or not s.flags["C_CONTIGUOUS"]:
+            return None
+        ptrs[i] = s.ctypes.data
+        rows[i] = s.shape[0]
+        n += s.shape[0]
+    out_idx = np.empty(n, np.int64)
+    out_dup = np.empty(n, np.uint8)
+    cnt = lib.kway_merge_segs(ptrs, _ptr(rows, _i64p), len(segs),
+                              w, _ptr(out_idx, _i64p), _ptr(out_dup, _u8p))
+    return out_idx[:cnt], out_dup[:cnt].astype(bool)
+
+
+def _row_bytes(arr: np.ndarray) -> int:
+    """Per-row byte count treating axis-0 as rows (itemsize for 1-D,
+    itemsize * row width for 2-D)."""
+    rb = arr.dtype.itemsize
+    for d in arr.shape[1:]:
+        rb *= d
+    return rb
+
+
+def gather_rows(src: np.ndarray, idx: np.ndarray,
+                dst: np.ndarray) -> bool:
+    """dst[i] = src[idx[i]] row-wise via the native library (GIL-free
+    memcpy loop). Returns False when unavailable/ineligible — caller
+    falls back to numpy fancy indexing. src/dst must be C-contiguous
+    with identical row widths."""
+    lib = _load()
+    if lib is None or not src.flags["C_CONTIGUOUS"] \
+            or not dst.flags["C_CONTIGUOUS"]:
+        return False
+    rb = _row_bytes(src)
+    if rb != _row_bytes(dst):
+        return False
+    idx = np.ascontiguousarray(idx, np.int64)
+    lib.gather_rows(
+        ctypes.cast(src.ctypes.data, _u8p),
+        rb, _ptr(idx, _i64p), len(idx),
+        ctypes.cast(dst.ctypes.data, _u8p))
+    return True
+
+
+def gather_scatter_rows(src: np.ndarray, src_idx: np.ndarray,
+                        dst: np.ndarray, dst_idx: np.ndarray) -> bool:
+    """dst[dst_idx[i]] = src[src_idx[i]] row-wise via the native library
+    (GIL-free). Returns False when unavailable — caller falls back to
+    numpy."""
+    lib = _load()
+    if lib is None or not src.flags["C_CONTIGUOUS"] \
+            or not dst.flags["C_CONTIGUOUS"]:
+        return False
+    rb = _row_bytes(src)
+    if rb != _row_bytes(dst):
+        return False
+    src_idx = np.ascontiguousarray(src_idx, np.int64)
+    dst_idx = np.ascontiguousarray(dst_idx, np.int64)
+    lib.gather_scatter_rows(
+        ctypes.cast(src.ctypes.data, _u8p), rb,
+        _ptr(src_idx, _i64p), _ptr(dst_idx, _i64p), len(src_idx),
+        ctypes.cast(dst.ctypes.data, _u8p))
+    return True
 
 
 def kway_merge(runs: Sequence[Sequence[bytes]]

@@ -313,6 +313,24 @@ class SstWriter:
         f.write(struct.pack("<I", len(fraw)))
         f.write(MAGIC)
 
+    def abort(self) -> None:
+        """Tear down a partially-written SST (pipelined compaction aborts
+        mid-stream when an input turns out ineligible): close the
+        streaming handle and unlink the .tmp — the final path was never
+        created, so the store state is untouched."""
+        if self._sf is not None:
+            try:
+                self._sf.close()
+            except OSError:
+                pass
+            self._sf = None
+        try:
+            os.unlink(self.path + ".tmp")
+        except OSError:
+            pass
+        self._entries = []
+        self._blocks = []
+
     def finish(self) -> dict:
         if self._sf is not None:
             # streaming mode: sections are already on disk; append tail
@@ -635,6 +653,23 @@ class SstReader:
         cb = ColumnarBlock.deserialize(
             self._data[e.col_offset:e.col_offset + e.col_length])
         return self._cache_put(self._col_cache, i, cb, 32)
+
+    def read_columnar(self, i: int) -> Optional[ColumnarBlock]:
+        """Streaming (uncached) columnar-block read for the compaction
+        pipeline: the decode-ahead stage touches every block exactly
+        once and holds its own reference until the block is fully
+        merged, so routing the read through the point-read cache would
+        evict the hot working set AND pin decoded blocks past their
+        lifetime. Arrays are zero-copy read-only views over the file
+        mapping — pages fault in when the merge actually touches them,
+        and numpy's base-reference keeps the mapping alive even after
+        the input SST is unlinked post-compaction."""
+        e = self.index[i]
+        if e.col_offset < 0:
+            return None
+        return ColumnarBlock.deserialize(
+            memoryview(self._data)[e.col_offset:e.col_offset
+                                   + e.col_length], copy=False)
 
     def columnar_blocks(self, lower: Optional[bytes] = None,
                         upper: Optional[bytes] = None

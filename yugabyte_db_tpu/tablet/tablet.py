@@ -261,20 +261,20 @@ class Tablet:
                 inputs=inputs,
                 feed=ColocatedRepackingFeed(cutoff, self.codecs.values()))
         elif not multi_version:
-            # single-schema tablets: device sort kernel, or the native C
-            # k-way merge + vectorized GC when the device is disabled —
-            # the honest CPU baseline (reference:
+            # single-schema tablets: the pipelined chunked engine when
+            # the offload flag is on — device merge kernel on a real
+            # accelerator, native C k-way merge per chunk on CPU-only
+            # backends (the XLA sort on CPU is strictly slower than the
+            # native merge, measured ~2x, so the flag never routes it
+            # there). Flag off keeps the pre-pipeline monolithic native
+            # merge — the honest CPU baseline (reference:
             # rocksdb/db/compaction_job.cc ProcessKeyValueCompaction).
-            # Cost-routing: "device" only wins when a real accelerator
-            # backs it — on a CPU-only backend the XLA merge sort is
-            # strictly slower than the native C k-way merge (measured
-            # ~2x), so the flag routes native there instead of
-            # pretending the fallback is an offload.
             import jax as _jax
-            backend = ("device"
-                       if flags.get("tpu_compaction_enabled")
-                       and _jax.default_backend() != "cpu"
-                       else "native")
+            if flags.get("tpu_compaction_enabled"):
+                backend = ("device" if _jax.default_backend() != "cpu"
+                           else "native")
+            else:
+                backend = "baseline"
             path = tpu_compact(self.regular, self.codec, cutoff,
                                inputs=inputs, backend=backend)
         else:

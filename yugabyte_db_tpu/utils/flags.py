@@ -140,6 +140,10 @@ DEFINE_RUNTIME("tpu_pushdown_enabled", True,
                "backend (the yb_enable_tpu_pushdown analog).")
 DEFINE_RUNTIME("tpu_compaction_enabled", True,
                "Offload LSM compaction merge + MVCC GC to TPU kernels.")
+DEFINE_RUNTIME("compaction_chunk_rows", 524288,
+               "Frontier capacity (rows) of the pipelined chunked "
+               "compaction engine; rounded up to a power of two so the "
+               "merge kernel compiles once per shape bucket.")
 DEFINE_RUNTIME("tpu_pallas_scan", False,
                "Route eligible aggregate scans through the hand-fused "
                "pallas kernel (ops/pallas_scan.py) instead of the XLA "
