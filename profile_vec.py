@@ -1,70 +1,125 @@
-"""Profile vector search variants (throwaway)."""
-import os, time
-os.environ.setdefault("YBTPU_PLATFORM", "cpu")
-import numpy as np
-import jax, jax.numpy as jnp
-from yugabyte_db_tpu.ops.vector import IvfFlatIndex, exact_search, l2_distance2
+"""Profile the vector/ subsystem: recall/qps frontier sweeps.
 
-n, d = 200_000, 128
+--json: one JSON object on stdout (mirroring profile_compact.py) with
+  * an IVF nprobe x rerank_c sweep (CPU twin + device-kernel bucket)
+    emitting the recall/qps frontier at the profiled scale,
+  * an HNSW ef_search sweep at a host-friendly scale,
+  * kernel-compile accounting for the jitted two-stage path (same
+    contract as the compaction kernels: pow2 buckets compile once).
+
+Env knobs: PROF_VEC_N (default 200000), PROF_VEC_D (128),
+PROF_VEC_LISTS (256), PROF_VEC_HNSW_N (20000), PROF_VEC_REPEATS (3).
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("YBTPU_PLATFORM", "cpu")
+
+import numpy as np   # noqa: E402
+
+from yugabyte_db_tpu.ops.vector import exact_search   # noqa: E402
+from yugabyte_db_tpu.vector import (                  # noqa: E402
+    HnswIndex, TwoStageIvfIndex,
+)
+from yugabyte_db_tpu.vector.ivf import (              # noqa: E402
+    kernel_cache_stats, reset_kernel_stats,
+)
+
+as_json = "--json" in sys.argv
+n = int(os.environ.get("PROF_VEC_N", "200000"))
+d = int(os.environ.get("PROF_VEC_D", "128"))
+nlists = int(os.environ.get("PROF_VEC_LISTS", "256"))
+hnsw_n = int(os.environ.get("PROF_VEC_HNSW_N", "20000"))
+repeats = int(os.environ.get("PROF_VEC_REPEATS", "3"))
+
 rng = np.random.default_rng(0)
 base = rng.normal(size=(n, d)).astype(np.float32)
 q = base[:64] + 0.001
 
-t0 = time.perf_counter()
-idx = IvfFlatIndex.build(base, nlists=64, iters=5)
-print(f"build: {time.perf_counter()-t0:.2f}s")
+import jax.numpy as jnp   # noqa: E402
 
-idx.search(q, k=10, nprobe=8)
-t0 = time.perf_counter()
-for _ in range(5):
-    idx.search(q, k=10, nprobe=8)
-dt = (time.perf_counter() - t0) / 5
-print(f"ivf search: {dt*1e3:.1f} ms/batch  {64/dt:.0f} qps")
+_, ref_ids = exact_search(jnp.asarray(q[:16]), jnp.asarray(base), 10)
+ref_ids = np.asarray(ref_ids)
 
-bj = jnp.asarray(base)
-qj = jnp.asarray(q)
-jax.block_until_ready(exact_search(qj, bj, 10))
-t0 = time.perf_counter()
-for _ in range(5):
-    jax.block_until_ready(exact_search(qj, bj, 10))
-dt = (time.perf_counter() - t0) / 5
-print(f"exact bf16: {dt*1e3:.1f} ms/batch  {64/dt:.0f} qps")
 
-@jax.jit
-def exact_f32(queries, base, k=10):
-    dots = queries @ base.T
-    qn = jnp.sum(queries ** 2, axis=1, keepdims=True)
-    bn = jnp.sum(base ** 2, axis=1)
-    dist = qn + bn[None, :] - 2.0 * dots
-    neg, i = jax.lax.top_k(-dist, 10)
-    return -neg, i
+def recall_of(ids):
+    return float(np.mean([
+        len(set(ids[i]) & set(ref_ids[i])) / 10.0 for i in range(16)]))
 
-jax.block_until_ready(exact_f32(qj, bj))
-t0 = time.perf_counter()
-for _ in range(5):
-    jax.block_until_ready(exact_f32(qj, bj))
-dt = (time.perf_counter() - t0) / 5
-print(f"exact f32: {dt*1e3:.1f} ms/batch  {64/dt:.0f} qps")
 
-# numpy BLAS reference
-t0 = time.perf_counter()
-for _ in range(5):
-    dots = q @ base.T
-    dist = (q**2).sum(1)[:, None] + (base**2).sum(1)[None, :] - 2*dots
-    part = np.argpartition(dist, 10, axis=1)[:, :10]
-dt = (time.perf_counter() - t0) / 5
-print(f"numpy f32: {dt*1e3:.1f} ms/batch  {64/dt:.0f} qps")
+def timed(fn):
+    fn()                      # warm / compile
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        out = fn()
+        t0_last = out
+    return (time.perf_counter() - t0) / repeats, t0_last
 
-# new routed search
-idx2 = IvfFlatIndex.build(base, nlists=64, iters=5)
-dd, ii = idx2.search(q, k=10, nprobe=8)
-de, ie = exact_search(qj, bj, 10)
-print("routed==exact idx match:", float((ii == np.asarray(ie)).mean()))
+
+out = {"n": n, "dim": d, "nlists": nlists, "queries": 64}
+
 t0 = time.perf_counter()
-for _ in range(5):
-    idx2.search(q, k=10, nprobe=8)
-dt = (time.perf_counter() - t0) / 5
-print(f"routed search: {dt*1e3:.1f} ms/batch  {64/dt:.0f} qps")
-# small batch keeps gather path
-d1, i1 = idx2.search(q[:2], k=10, nprobe=8)
-print("small-batch ok:", d1.shape, i1.shape)
+ivf = TwoStageIvfIndex.build(base, nlists=nlists, iters=5,
+                             sample=50_000)
+out["ivf_build_s"] = round(time.perf_counter() - t0, 2)
+
+# ---- IVF frontier: nprobe x rerank_c ---------------------------------
+frontier = []
+for nprobe in (max(1, nlists // 16), max(1, nlists // 8),
+               max(1, nlists // 4), max(1, nlists // 2)):
+    dt, (_, ids) = timed(lambda: ivf.search(q, k=10, nprobe=nprobe))
+    frontier.append({"backend": "cpu", "nprobe": nprobe,
+                     "candidate_pool": int(ivf.last_pool_rows),
+                     "qps": round(64 / dt, 1),
+                     "recall_at_10": round(recall_of(ids), 3)})
+reset_kernel_stats()
+for nprobe in (max(1, nlists // 8), max(1, nlists // 4)):
+    for rerank_c in (64, 256):
+        dt, (_, ids) = timed(lambda: ivf.search(
+            q, k=10, nprobe=nprobe, rerank_c=rerank_c,
+            backend="device"))
+        frontier.append({"backend": "device-kernel", "nprobe": nprobe,
+                         "rerank_c": rerank_c,
+                         "candidate_pool": int(ivf.last_pool_rows),
+                         "qps": round(64 / dt, 1),
+                         "recall_at_10": round(recall_of(ids), 3)})
+out["ivf_frontier"] = frontier
+# shape-stable buckets: the 4 (nprobe, rerank_c) points above compile
+# once each; the repeat calls inside timed() must all be cache hits
+out["ivf_kernel_cache"] = kernel_cache_stats()
+
+# ---- HNSW frontier: ef_search ----------------------------------------
+hq = base[:64] + 0.001
+_, href = exact_search(jnp.asarray(hq[:16]), jnp.asarray(base[:hnsw_n]),
+                       10)
+href = np.asarray(href)
+t0 = time.perf_counter()
+hnsw = HnswIndex.build(base[:hnsw_n], m=16, ef_construction=80)
+out["hnsw_build_s"] = round(time.perf_counter() - t0, 2)
+out["hnsw_n"] = hnsw_n
+hfrontier = []
+for ef in (16, 32, 64, 128):
+    dt, (_, ids) = timed(lambda: hnsw.search(hq, k=10, ef_search=ef))
+    hfrontier.append({"ef_search": ef, "qps": round(64 / dt, 1),
+                      "recall_at_10": round(float(np.mean(
+                          [len(set(ids[i]) & set(href[i])) / 10.0
+                           for i in range(16)])), 3)})
+out["hnsw_frontier"] = hfrontier
+
+if as_json:
+    print(json.dumps(out))
+else:
+    print(f"n={n} d={d} nlists={nlists} "
+          f"(ivf build {out['ivf_build_s']}s, "
+          f"hnsw build {out['hnsw_build_s']}s @ n={hnsw_n})")
+    for f in frontier:
+        extra = (f" c={f['rerank_c']}" if "rerank_c" in f else "")
+        print(f"  ivf[{f['backend']}] nprobe={f['nprobe']}{extra}: "
+              f"{f['qps']} qps recall={f['recall_at_10']} "
+              f"pool={f['candidate_pool']}")
+    print(f"  kernel cache: {out['ivf_kernel_cache']}")
+    for f in hfrontier:
+        print(f"  hnsw ef={f['ef_search']}: {f['qps']} qps "
+              f"recall={f['recall_at_10']}")

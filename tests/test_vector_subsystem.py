@@ -1,0 +1,324 @@
+"""The vector/ subsystem: ANN registry, two-stage IVF, HNSW, index
+persistence across tablet restart, and the USING hnsw DDL path
+(reference analogs: src/yb/ann_methods/ registration, hnsw/hnsw.cc,
+vector_index/vector_lsm.cc chunk persistence)."""
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from yugabyte_db_tpu.parallel import sharded_ann_search
+from yugabyte_db_tpu.vector import (
+    AnnIndex, HnswIndex, TwoStageIvfIndex, available_methods,
+    get_index_cls,
+)
+from yugabyte_db_tpu.vector.ivf import kernel_cache_stats
+from yugabyte_db_tpu.vector.registry import load_index
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def brute_force(base: np.ndarray, q: np.ndarray, k: int) -> np.ndarray:
+    """The oracle: exact top-k ids by squared L2."""
+    d = ((q ** 2).sum(1)[:, None] + (base ** 2).sum(1)[None, :]
+         - 2.0 * q @ base.T)
+    return np.argsort(d, axis=1, kind="stable")[:, :k]
+
+
+def recall_at(ids: np.ndarray, ref: np.ndarray, k: int = 10) -> float:
+    return float(np.mean([len(set(ids[i][:k]) & set(ref[i][:k])) / k
+                          for i in range(len(ref))]))
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    rng = np.random.default_rng(7)
+    base = rng.normal(size=(2000, 16)).astype(np.float32)
+    q = base[:24] + 0.001
+    return base, q, brute_force(base, q, 10)
+
+
+class TestRegistry:
+    def test_methods_and_dispatch(self):
+        assert "ivfflat" in available_methods()
+        assert "hnsw" in available_methods()
+        assert get_index_cls("ivfflat") is TwoStageIvfIndex
+        assert get_index_cls("ivf") is TwoStageIvfIndex   # alias
+        assert get_index_cls("hnsw") is HnswIndex
+
+    def test_unknown_method(self):
+        with pytest.raises(ValueError, match="unknown vector index"):
+            get_index_cls("usearch")
+
+
+class TestRecallHarness:
+    """recall@10 vs the brute-force oracle, asserted per index type."""
+
+    def test_ivfflat_recall(self, corpus):
+        base, q, ref = corpus
+        idx = TwoStageIvfIndex.build(base, nlists=16, iters=8)
+        _, ids = idx.search(q, k=10, nprobe=8)
+        assert recall_at(ids, ref) >= 0.9
+
+    def test_ivfflat_full_probe_is_exact(self, corpus):
+        base, q, ref = corpus
+        idx = TwoStageIvfIndex.build(base, nlists=16, iters=8)
+        _, ids = idx.search(q, k=10, nprobe=16)
+        assert recall_at(ids, ref) == 1.0
+
+    def test_hnsw_recall(self, corpus):
+        base, q, ref = corpus
+        idx = HnswIndex.build(base, m=12, ef_construction=60)
+        _, ids = idx.search(q, k=10, ef_search=64)
+        assert recall_at(ids, ref) >= 0.9
+
+    def test_hnsw_ef_trades_recall(self, corpus):
+        """The ef_search knob is live: a wider beam can't lose recall."""
+        base, q, ref = corpus
+        idx = HnswIndex.build(base, m=12, ef_construction=60)
+        lo = recall_at(idx.search(q, k=10, ef_search=10)[1], ref)
+        hi = recall_at(idx.search(q, k=10, ef_search=128)[1], ref)
+        assert hi >= lo
+        assert hi >= 0.9
+
+
+class TestDeviceKernel:
+    """The jitted two-stage path (runs on the CPU backend here; the
+    same code is the accelerator hot path)."""
+
+    def test_two_stage_exact_at_full_width(self, corpus):
+        base, q, ref = corpus
+        idx = TwoStageIvfIndex.build(base, nlists=16, iters=8)
+        _, ids = idx.search(q, k=10, nprobe=16, rerank_c=2048,
+                            backend="device")
+        assert recall_at(ids, ref) == 1.0
+
+    def test_compile_accounting_shape_stable(self, corpus):
+        base, q, _ = corpus
+        idx = TwoStageIvfIndex.build(base, nlists=16, iters=8)
+        idx.search(q, k=10, nprobe=4, rerank_c=64, backend="device")
+        before = kernel_cache_stats()
+        idx.search(q, k=10, nprobe=4, rerank_c=64, backend="device")
+        # repeat same bucket: a call, a cache hit, NO new compile
+        idx.search(q[:5], k=10, nprobe=4, rerank_c=64,
+                   backend="device")   # 5 pads into the pow2=8 bucket
+        after = kernel_cache_stats()
+        assert after["compiles"] == before["compiles"] + 1  # Q=8 bucket
+        assert after["calls"] == before["calls"] + 2
+        assert after["cache_hits"] >= before["cache_hits"] + 1
+
+    def test_k_wider_than_pool_pads(self, corpus):
+        """k larger than the probed pool (tiny lists, nprobe=1 — the
+        shape Tablet.vector_search's dead-row over-fetch produces)
+        must pad with inf/-1, not raise in the kernel's top_k."""
+        base, q, _ = corpus
+        idx = TwoStageIvfIndex.build(base, nlists=900, iters=2)
+        d, i = idx.search(q[:2], k=70, nprobe=1, backend="device")
+        assert d.shape == (2, 70) and i.shape == (2, 70)
+        assert (i[:, -1] == -1).all() and np.isinf(d[:, -1]).all()
+        valid = i[0] >= 0
+        assert valid.any()
+
+    def test_pool_instrumentation(self, corpus):
+        base, q, _ = corpus
+        idx = TwoStageIvfIndex.build(base, nlists=16, iters=8)
+        idx.search(q, k=10, nprobe=4)
+        assert 0 < idx.last_pool_rows <= len(base)
+
+
+class TestPersistence:
+    def test_ivf_save_load_search_roundtrip(self, corpus, tmp_path):
+        base, q, _ = corpus
+        idx = TwoStageIvfIndex.build(base, nlists=16, iters=8)
+        idx.add(np.full((3, 16), 5.0, np.float32))   # tail rides along
+        idx.save(str(tmp_path / "ivf"))
+        idx2 = AnnIndex.load(str(tmp_path / "ivf"))
+        assert isinstance(idx2, TwoStageIvfIndex)
+        assert idx2.size == idx.size == len(base) + 3
+        d1, i1 = idx.search(q, k=10, nprobe=8)
+        d2, i2 = idx2.search(q, k=10, nprobe=8)
+        assert np.array_equal(i1, i2)
+        assert np.allclose(d1, d2)
+
+    def test_hnsw_save_load_search_roundtrip(self, corpus, tmp_path):
+        base, q, _ = corpus
+        idx = HnswIndex.build(base[:500], m=8, ef_construction=40)
+        idx.save(str(tmp_path / "hnsw"))
+        idx2 = AnnIndex.load(str(tmp_path / "hnsw"))
+        assert isinstance(idx2, HnswIndex)
+        d1, i1 = idx.search(q, k=5)
+        d2, i2 = idx2.search(q, k=5)
+        assert np.array_equal(i1, i2)
+        # and the loaded graph keeps accepting inserts
+        idx2.add(np.full((1, 16), 9.0, np.float32))
+        assert idx2.search(np.full(16, 9.0, np.float32), k=1)[1][0][0] \
+            == 500
+
+    def test_torn_payload_degrades_to_none(self, tmp_path):
+        p = tmp_path / "torn"
+        p.mkdir()
+        (p / "meta.json").write_text("{not json")
+        assert load_index(str(p)) is None
+        assert load_index(str(tmp_path / "absent")) is None
+
+    def test_vectors_in_id_order(self, corpus):
+        base, _, _ = corpus
+        idx = TwoStageIvfIndex.build(base, nlists=16, iters=4)
+        back = idx.vectors_in_id_order()
+        assert np.array_equal(back, base)
+        assert np.array_equal(idx.vector_of(17), base[17])
+
+
+class TestShardedAnnSearch:
+    def test_mixed_method_shards(self, corpus):
+        """Sharded all_gather-style search works ACROSS index types:
+        per-shard top-k + global re-rank equals the oracle over the
+        concatenated base when every shard searches exactly."""
+        base, q, ref = corpus
+        shards = np.array_split(base, 4)
+        indexes = [
+            TwoStageIvfIndex.build(shards[0], nlists=8, iters=4),
+            HnswIndex.build(shards[1], m=8, ef_construction=60),
+            TwoStageIvfIndex.build(shards[2], nlists=8, iters=4),
+            HnswIndex.build(shards[3], m=8, ef_construction=60),
+        ]
+        d, i = sharded_ann_search(q, indexes, k=10, nprobe=8,
+                                  ef_search=128)
+        assert d.shape == (len(q), 10) and i.shape == (len(q), 10)
+        assert recall_at(i, ref) >= 0.9
+        assert bool((np.diff(d, axis=1) >= -1e-5).all())
+
+
+class TestTabletRestartSurvival:
+    def test_index_survives_restart(self, tmp_path):
+        """Build through DDL, restart the tserver, and require (a) the
+        persisted index LOADED (frozen chunk populated, not rebuilt
+        empty), (b) post-build writes reconciled into the delta, and
+        (c) `<->` ORDER BY answers correctly afterwards."""
+        from yugabyte_db_tpu.ql import SqlSession
+        from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute(
+                    "CREATE TABLE docs (id bigint, embedding vector(8), "
+                    "PRIMARY KEY (id)) WITH tablets = 1")
+                await mc.wait_for_leaders("docs")
+                rng = np.random.default_rng(3)
+                vecs = rng.normal(size=(40, 8)).astype(np.float32)
+                for i in range(40):
+                    v = "[" + ",".join(f"{x:.5f}" for x in vecs[i]) + "]"
+                    await s.execute(
+                        f"INSERT INTO docs (id, embedding) VALUES "
+                        f"({i}, '{v}')")
+                await s.execute(
+                    "CREATE INDEX de ON docs USING ivfflat (embedding) "
+                    "WITH lists = 4")
+                tv = "[" + ",".join("9.0" for _ in range(8)) + "]"
+                await s.execute(
+                    f"INSERT INTO docs (id, embedding) VALUES "
+                    f"(100, '{tv}')")
+                await mc.restart_tserver(0)
+                await mc.wait_for_leaders("docs")
+                peer = next(p for p in mc.tservers[0].peers.values())
+                states = list(peer.tablet.vector_indexes.values())
+                assert states, "persisted index did not load"
+                st = states[0]
+                assert st.method == "ivfflat"
+                assert len(st.pks) == 40          # frozen chunk intact
+                assert st.idx is not None and st.idx.size == 40
+                # post-build write reconciled (delta or fold), visible:
+                s2 = SqlSession(mc.client())
+                r = await s2.execute(
+                    f"SELECT id FROM docs ORDER BY embedding <-> "
+                    f"'{tv}' LIMIT 1")
+                assert r.rows[0]["id"] == 100
+                q = vecs[17] + 0.001
+                qlit = "[" + ",".join(f"{x:.5f}" for x in q) + "]"
+                r2 = await s2.execute(
+                    f"SELECT id FROM docs ORDER BY embedding <-> "
+                    f"'{qlit}' LIMIT 3")
+                assert r2.rows[0]["id"] == 17
+            finally:
+                await mc.shutdown()
+        run(go())
+
+
+class TestHnswDdlRegress:
+    def test_using_hnsw_order_by(self, tmp_path):
+        """USING hnsw DDL with WITH options + `<->` ORDER BY routing
+        (the regress twin of test_vector_sql's ivfflat case)."""
+        from yugabyte_db_tpu.ql import SqlSession
+        from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute(
+                    "CREATE TABLE docs (id bigint, body text, "
+                    "embedding vector(8), PRIMARY KEY (id)) "
+                    "WITH tablets = 2")
+                await mc.wait_for_leaders("docs")
+                rng = np.random.default_rng(0)
+                vecs = rng.normal(size=(40, 8)).astype(np.float32)
+                for i in range(40):
+                    v = "[" + ",".join(f"{x:.5f}" for x in vecs[i]) + "]"
+                    await s.execute(
+                        f"INSERT INTO docs (id, body, embedding) VALUES "
+                        f"({i}, 'doc{i}', '{v}')")
+                r = await s.execute(
+                    "CREATE INDEX de ON docs USING hnsw (embedding) "
+                    "WITH (m = 8, ef_construction = 40, ef_search = 48)")
+                assert "40 rows" in r.status
+                # the tablet states carry the method + options through
+                for ts in mc.tservers:
+                    for p in ts.peers.values():
+                        for st in p.tablet.vector_indexes.values():
+                            assert st.method == "hnsw"
+                            assert st.options.get("m") == 8
+                q = vecs[17] + 0.001
+                qlit = "[" + ",".join(f"{x:.5f}" for x in q) + "]"
+                r2 = await s.execute(
+                    f"SELECT id, body FROM docs ORDER BY embedding <-> "
+                    f"'{qlit}' LIMIT 3")
+                assert r2.rows[0]["id"] == 17
+                assert r2.rows[0]["distance"] <= r2.rows[1]["distance"]
+                # write after build: delta path over the graph index
+                tv = "[" + ",".join("9.0" for _ in range(8)) + "]"
+                await s.execute(
+                    f"INSERT INTO docs (id, body, embedding) VALUES "
+                    f"(100, 'new', '{tv}')")
+                r3 = await s.execute(
+                    f"SELECT id FROM docs ORDER BY embedding <-> "
+                    f"'{tv}' LIMIT 1")
+                assert r3.rows[0]["id"] == 100
+            finally:
+                await mc.shutdown()
+        run(go())
+
+    def test_unknown_using_method_errors(self, tmp_path):
+        from yugabyte_db_tpu.ql import SqlSession
+        from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute(
+                    "CREATE TABLE docs (id bigint, embedding vector(4), "
+                    "PRIMARY KEY (id)) WITH tablets = 1")
+                await mc.wait_for_leaders("docs")
+                with pytest.raises(ValueError,
+                                   match="unknown vector index"):
+                    await s.execute(
+                        "CREATE INDEX de ON docs USING usearch "
+                        "(embedding)")
+            finally:
+                await mc.shutdown()
+        run(go())

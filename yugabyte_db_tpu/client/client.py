@@ -869,21 +869,31 @@ class YBClient:
 
     # --- vector search ------------------------------------------------------
     async def build_vector_index(self, table: str, column: str,
-                                 lists: int = 100) -> int:
+                                 lists: int = 100,
+                                 method: str = "ivfflat",
+                                 options: Optional[dict] = None) -> int:
+        """Build an ANN index (any registry method: ivfflat / hnsw) on
+        every tablet of `table`; returns total rows indexed."""
         ct = await self._table(table)
         total = 0
         for loc in ct.locations:
             r = await self._call_leader(ct, loc.tablet_id,
                                         "build_vector_index",
                                         {"tablet_id": loc.tablet_id,
-                                         "column": column, "lists": lists})
+                                         "column": column, "lists": lists,
+                                         "method": method,
+                                         "options": dict(options or {})})
             total += r["indexed"]
         return total
 
     async def vector_search(self, table: str, column: str, query,
-                            k: int = 10, nprobe: int = 8):
+                            k: int = 10, nprobe: int = 8,
+                            ef_search: Optional[int] = None):
         """Distributed kNN: per-tablet top-k, client-side re-rank
-        (the RPC twin of parallel/vector.py's all_gather path)."""
+        (the RPC twin of parallel/vector.py's all_gather path).
+        `nprobe` drives IVF probing, `ef_search` the HNSW beam; each
+        tablet falls back to its index's build-time options when a
+        knob does not apply."""
         ct = await self._table(table)
         hits = []
         for loc in ct.locations:
@@ -891,7 +901,7 @@ class YBClient:
                 ct, loc.tablet_id, "vector_search",
                 {"tablet_id": loc.tablet_id, "column": column,
                  "query": list(map(float, query)), "k": k,
-                 "nprobe": nprobe})
+                 "nprobe": nprobe, "ef_search": ef_search})
             hits.extend((pk, d) for pk, d in r["hits"])
         hits.sort(key=lambda h: h[1])
         return hits[:k]

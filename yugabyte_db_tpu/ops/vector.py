@@ -7,6 +7,12 @@ for the MXU; the TPU-idiomatic method is IVF-flat: k-means clustering
 (pure matmuls) + probed exhaustive search (one [Q,D]x[D,N] matmul per
 probe set), in bf16 with f32 accumulation. Exact search over 1M x 768
 is a single big matmul — often faster end-to-end than HNSW on CPU.
+
+This module is the KERNEL layer (distance matmuls, k-means, the legacy
+flat `IvfFlatIndex`).  The index SUBSYSTEM — the pluggable ANN registry
+the executor's `USING ivfflat|hnsw` DDL resolves through, the two-stage
+IVF (multi-probe + GEMM re-rank) and the HNSW graph twin, with
+per-tablet persistence — lives in `yugabyte_db_tpu/vector/`.
 """
 from __future__ import annotations
 

@@ -1,3 +1,3 @@
 from .mesh import tablet_mesh, TabletMesh  # noqa: F401
 from .distributed_scan import DistributedScanKernel, distributed_scan_aggregate  # noqa: F401
-from .vector import sharded_exact_search  # noqa: F401
+from .vector import sharded_ann_search, sharded_exact_search  # noqa: F401

@@ -54,3 +54,32 @@ def sharded_exact_search(tm: TabletMesh, queries: np.ndarray,
     d, i = fn(jnp.asarray(queries, jnp.float32),
               base_sharded.reshape(T, B, n_shard, -1))
     return np.asarray(d), np.asarray(i)
+
+
+def sharded_ann_search(queries: np.ndarray, indexes, k: int,
+                       **params) -> Tuple[np.ndarray, np.ndarray]:
+    """Sharded search across per-shard ANN indexes (any registry
+    method — the index-aware twin of sharded_exact_search's all_gather
+    merge): per-shard top-k through each AnnIndex, then one host-side
+    gather + re-rank with ids offset into the global row space
+    (shard s owns ids [sum(sizes[:s]), sum(sizes[:s+1]))).  Shards may
+    mix methods (an IVF shard next to an HNSW shard) — the merge only
+    sees (distance, global id) pairs."""
+    q = np.asarray(queries, np.float32)
+    if q.ndim == 1:
+        q = q[None, :]
+    all_d = []
+    all_i = []
+    offset = 0
+    for idx in indexes:
+        d, i = idx.search(q, k=min(k, max(idx.size, 1)), **params)
+        gi = np.where(i >= 0, i + offset, -1)
+        all_d.append(np.asarray(d, np.float32))
+        all_i.append(gi.astype(np.int64))
+        offset += idx.size
+    if not all_d:
+        return (np.full((len(q), k), np.inf, np.float32),
+                np.full((len(q), k), -1, np.int64))
+    from ..vector.registry import merge_topk
+    return merge_topk(np.concatenate(all_d, axis=1),
+                      np.concatenate(all_i, axis=1), k)

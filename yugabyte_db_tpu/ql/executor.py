@@ -200,13 +200,23 @@ class SqlSession:
         if isinstance(stmt, CreateIndexStmt):
             ct = await self.client._table(stmt.table)
             col = ct.info.schema.column_by_name(stmt.column)
-            if col.type == ColumnType.VECTOR or stmt.method == "ivfflat":
+            if col.type == ColumnType.VECTOR or stmt.method != "lsm":
+                from ..vector import available_methods, get_index_cls
+                method = (stmt.method if stmt.method != "lsm"
+                          else "ivfflat")
+                get_index_cls(method)   # unknown USING method -> error
                 if len(getattr(stmt, "columns", None) or [1]) > 1:
                     raise ValueError(
-                        "ivfflat indexes cover exactly one vector "
-                        "column")
+                        f"{method} indexes cover exactly one vector "
+                        f"column (available ANN methods: "
+                        f"{available_methods()})")
+                if col.type != ColumnType.VECTOR:
+                    raise ValueError(
+                        f"USING {method} requires a vector column, "
+                        f"got {stmt.column!r}")
                 n = await self.client.build_vector_index(
-                    stmt.table, stmt.column, stmt.lists)
+                    stmt.table, stmt.column, stmt.lists,
+                    method=method, options=stmt.options)
             else:
                 n = await self.client.create_secondary_index(
                     stmt.table, stmt.name,
@@ -373,8 +383,9 @@ class SqlSession:
             if stmt.knn is not None:
                 lines.append(f"kNN Search on {stmt.table} "
                              f"({stmt.knn[0]})")
-                lines.append("  -> per-tablet IVF-flat index + re-rank"
-                             " (exact device search if no index)")
+                lines.append("  -> per-tablet ANN index (registry: "
+                             "ivfflat two-stage | hnsw) + re-rank "
+                             "(exact device search if no index)")
             elif getattr(stmt, "joins", None):
                 import dataclasses
                 probe = dataclasses.replace(

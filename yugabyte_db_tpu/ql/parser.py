@@ -108,10 +108,13 @@ class CreateIndexStmt:
     name: str
     table: str
     column: str             # first indexed column
-    method: str = "lsm"     # 'lsm' secondary index | 'ivfflat' vector ANN
+    method: str = "lsm"     # 'lsm' secondary | ANN method (ivfflat/hnsw)
     lists: int = 100
     unique: bool = False    # CREATE UNIQUE INDEX
     columns: List[str] = field(default_factory=list)   # full list
+    # WITH (k = v, ...) storage options, e.g. lists / m /
+    # ef_construction / ef_search — passed through to the ANN registry
+    options: Dict[str, int] = field(default_factory=dict)
 
 
 @dataclass
@@ -785,13 +788,25 @@ class Parser:
             columns.append(self.ident())
         column = columns[0]
         self.expect_op(")")
-        lists = 100
+        # WITH [(] k = v [, k = v ...] [)] — pgvector-style storage
+        # options (lists / m / ef_construction / ef_search), collected
+        # verbatim for the ANN registry; `lists` stays a first-class
+        # field for the legacy ivfflat path
+        options: Dict[str, int] = {}
         while self.accept_kw("with"):
-            k = self.ident().lower()
-            self.expect_op("=")
-            lists = int(self.next()[1])
+            paren = self.accept_op("(")
+            while True:
+                k = self.ident().lower()
+                self.expect_op("=")
+                options[k] = int(self.next()[1])
+                if not self.accept_op(","):
+                    break
+            if paren:
+                self.expect_op(")")
+        lists = int(options.get("lists", 100))
         return CreateIndexStmt(name, table, column, method, lists,
-                               unique=unique, columns=columns)
+                               unique=unique, columns=columns,
+                               options=options)
 
     def alter_table(self):
         self.expect_kw("alter")

@@ -35,18 +35,32 @@ _DEVICE_CACHE = DeviceBlockCache()
 
 
 class _VectorIndexState:
-    """One ANN index: a frozen IVF chunk plus a mutable delta — the
-    vector-LSM shape (reference: vector_index/vector_lsm.cc)."""
+    """One ANN index: a frozen chunk (any registry method) plus a
+    mutable delta — the vector-LSM shape (reference:
+    vector_index/vector_lsm.cc)."""
 
-    def __init__(self, col_name: str, nlists: int):
+    def __init__(self, col_name: str, method: str = "ivfflat",
+                 options: Optional[dict] = None):
         self.col_name = col_name
-        self.nlists = nlists
-        self.idx = None               # frozen IvfFlatIndex (or None)
+        self.method = method
+        self.options = dict(options or {})
+        self.idx = None               # frozen AnnIndex (or None)
         self.pks: list = []           # row ids aligned with idx vectors
         self.frozen_keys: set = set()  # pk_keys present in the chunk
+        self.frozen_pos: Dict[tuple, int] = {}   # pk_key -> index id
         # pk_key -> (pk_row, vector_bytes, expire_at_wall or None)
         self.delta: Dict[tuple, tuple] = {}
         self.dead: set = set()        # frozen pk_keys hidden by del/upsert
+        # pk_keys any write touched while a bootstrap scan-diff is in
+        # flight (None otherwise): the merge must not overwrite them —
+        # in particular a DELETE of a non-frozen key leaves no
+        # delta/dead trace, and the scan's pre-delete image would
+        # otherwise resurrect the row
+        self.touched: Optional[set] = None
+
+    @property
+    def nlists(self) -> int:
+        return int(self.options.get("lists", 100))
 
 
 class Tablet:
@@ -198,6 +212,9 @@ class Tablet:
             # vector indexes only ever cover the tablet's primary table
             with self._vector_build_lock:
                 self.vector_indexes.clear()
+                import shutil
+                shutil.rmtree(os.path.join(self.dir, "vecidx"),
+                              ignore_errors=True)
         if not self.colocated:
             return self.regular.truncate(op_id=op_id)
         # colocated: delete the cotable's rows (prefix tombstones at a
@@ -323,32 +340,54 @@ class Tablet:
             vecs.append(np.frombuffer(v, np.float32))
         return pks, (np.stack(vecs) if vecs else np.zeros((0, 1), np.float32))
 
-    def build_vector_index(self, col_name: str, nlists: int = 100) -> int:
-        """(Re)build the frozen IVF chunk. Safe against writes racing a
-        background fold: overlay entries recorded before the scan fold
-        into the chunk and are dropped; entries that arrive during the
-        build are carried over into the new state."""
-        from ..ops.vector import IvfFlatIndex
+    def build_vector_index(self, col_name: str, nlists: int = 100,
+                           method: str = "ivfflat",
+                           options: Optional[dict] = None) -> int:
+        """(Re)build the frozen ANN chunk through the index registry
+        (``method`` is the DDL's USING clause). Safe against writes
+        racing a background fold: overlay entries recorded before the
+        scan fold into the chunk and are dropped; entries that arrive
+        during the build are carried over into the new state."""
         cid = self.info.schema.column_by_name(col_name).id
+        options = dict(options or {})
+        options.setdefault("lists", nlists)
         with self._vector_build_lock:
             return self._build_vector_index_locked(
-                IvfFlatIndex, cid, col_name, nlists)
+                cid, col_name, method, options)
 
-    def _build_vector_index_locked(self, IvfFlatIndex, cid,
-                                   col_name, nlists) -> int:
+    @staticmethod
+    def _build_ann(method: str, options: dict, vecs) -> "object":
+        """Registry dispatch with per-method option mapping (the DDL's
+        WITH options are method-namespaced, like pgvector's)."""
+        from ..vector import get_index_cls
+        cls = get_index_cls(method)
+        if method in ("ivfflat", "ivf"):
+            # build() itself clamps nlists to the row count
+            return cls.build(
+                vecs, nlists=int(options.get("lists", 100)),
+                iters=int(options.get("iters", 10)))
+        if method == "hnsw":
+            return cls.build(
+                vecs, m=int(options.get("m", 16)),
+                ef_construction=int(options.get("ef_construction", 100)),
+                ef_search=int(options.get("ef_search", 64)))
+        return cls.build(vecs, **options)
+
+    def _build_vector_index_locked(self, cid, col_name, method,
+                                   options) -> int:
         old = self.vector_indexes.get(cid)
         with self._lock:
             pending = dict(old.delta) if old else {}
             deadsnap = set(old.dead) if old else set()
         pks, vecs = self._scan_vectors(col_name)
         pk_names = tuple(c.name for c in self.info.schema.key_columns)
-        state = _VectorIndexState(col_name, nlists)
+        state = _VectorIndexState(col_name, method, options)
         if len(vecs):
-            n = max(1, min(nlists, len(vecs) // 2 or 1))
-            state.idx = IvfFlatIndex.build(vecs, nlists=n)
+            state.idx = self._build_ann(method, options, vecs)
             state.pks = pks
-            state.frozen_keys = {tuple(p[n_] for n_ in pk_names)
-                                 for p in pks}
+            state.frozen_pos = {tuple(p[n_] for n_ in pk_names): i
+                                for i, p in enumerate(pks)}
+            state.frozen_keys = set(state.frozen_pos)
         with self._lock:
             if old is not None:
                 # identity check: keep only entries written AFTER the
@@ -360,6 +399,7 @@ class Tablet:
                 # the delta copy is newer — hide the frozen one
                 state.dead |= set(state.delta) & state.frozen_keys
             self.vector_indexes[cid] = state
+        self._persist_vector_index(cid, state)
         return len(pks)
 
     def _maintain_vector_indexes(self, req: WriteRequest) -> None:
@@ -370,6 +410,7 @@ class Tablet:
             return
         import time as _time
         pk_names = tuple(c.name for c in self.info.schema.key_columns)
+        import numpy as _np
         with self._lock:
             for state in self.vector_indexes.values():
                 for op in req.ops:
@@ -377,6 +418,24 @@ class Tablet:
                         pk_key = tuple(op.row[n] for n in pk_names)
                     except KeyError:
                         continue
+                    if state.touched is not None:
+                        state.touched.add(pk_key)
+                    if op.kind != "delete" and op.ttl_ms is None:
+                        # WAL-replay idempotence: a re-applied write
+                        # whose vector EQUALS the frozen copy (and that
+                        # nothing newer shadows) must not degrade the
+                        # frozen chunk into delta churn on every
+                        # restart
+                        i = state.frozen_pos.get(pk_key)
+                        v = op.row.get(state.col_name)
+                        if (i is not None and v is not None
+                                and pk_key not in state.dead
+                                and pk_key not in state.delta):
+                            fv = state.idx.vector_of(i)
+                            nv = _np.frombuffer(bytes(v), _np.float32)
+                            if (nv.shape == fv.shape
+                                    and _np.array_equal(nv, fv)):
+                                continue
                     state.delta.pop(pk_key, None)
                     # dead only hides FROZEN copies; fresh inserts never
                     # grow it (it bounds the search over-fetch)
@@ -393,21 +452,25 @@ class Tablet:
                             expire)
 
     def maybe_rebuild_vector_indexes(self) -> int:
-        """Fold an outgrown delta back into the frozen IVF index
+        """Fold an outgrown delta back into the frozen ANN index
         (background-compaction analog). Returns indexes rebuilt."""
         n = 0
         for cid, state in list(self.vector_indexes.items()):
             churn = len(state.delta) + len(state.dead)
             if churn and churn >= max(64, len(state.pks) // 5):
-                self.build_vector_index(state.col_name, state.nlists)
+                self.build_vector_index(state.col_name, state.nlists,
+                                        state.method, state.options)
                 n += 1
         return n
 
     def vector_search(self, col_name: str, query, k: int = 10,
-                      nprobe: int = 8):
-        """Top-k (pk row, distance) for one tablet: IVF over the frozen
-        chunk + exact search over the live delta, merged; falls back to
-        full exact search when no index is built."""
+                      nprobe: int = 8, ef_search=None):
+        """Top-k (pk row, distance) for one tablet: the frozen ANN
+        index (any registry method) + exact search over the live
+        delta, merged; falls back to full exact search when no index
+        is built.  ``nprobe`` drives IVF probing, ``ef_search`` the
+        HNSW beam; either falls back to the index's build-time option
+        when None."""
         import time as _time
         import numpy as np
         from ..ops.vector import exact_search
@@ -436,10 +499,12 @@ class Tablet:
             idx, pks = state.idx, state.pks
             # over-fetch so post-filtering dead rows still fills k
             k_ = min(k + len(dead), len(pks))
-            d, ids = idx.search(q, k=k_, nprobe=min(nprobe,
-                                                    len(idx.list_lens)))
+            params = {"nprobe": nprobe,
+                      "ef_search": ef_search
+                      or state.options.get("ef_search")}
+            d, ids = idx.search(q, k=k_, **params)
             for dist, i in zip(d[0], ids[0]):
-                if not np.isfinite(float(dist)):
+                if int(i) < 0 or not np.isfinite(float(dist)):
                     continue          # top_k padding, not a real hit
                 pk = pks[int(i)]
                 if tuple(pk[n] for n in pk_names) not in dead:
@@ -454,6 +519,136 @@ class Tablet:
                                         np.asarray(ids)[0])]
         hits.sort(key=lambda h: h[1])
         return hits[:k]
+
+    # --- vector-index persistence (reference: vector_lsm.cc chunk
+    # files next to tablet data; ours: vecidx/<col_id>/ under the
+    # tablet directory, loaded + scan-diffed on bootstrap) -------------
+    def _vecidx_dir(self, cid: int) -> str:
+        return os.path.join(self.dir, "vecidx", str(cid))
+
+    def _persist_vector_index(self, cid: int,
+                              state: _VectorIndexState) -> None:
+        """Best-effort durable copy of the frozen chunk + its pk map.
+        Failures degrade to rebuild-on-bootstrap, never break the
+        build itself."""
+        import msgpack
+        try:
+            if state.idx is None:
+                import shutil
+                shutil.rmtree(self._vecidx_dir(cid), ignore_errors=True)
+                return
+            path = self._vecidx_dir(cid)
+            state.idx.save(path)
+            tmp = os.path.join(path, ".tablet_meta.tmp")
+            with open(tmp, "wb") as f:
+                f.write(msgpack.packb(
+                    {"col_name": state.col_name,
+                     "method": state.method,
+                     "options": state.options,
+                     "pks": state.pks}, use_bin_type=True))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(path, "tablet_meta.msgpack"))
+        except Exception:   # noqa: BLE001 — persistence is an optimization
+            import logging
+            logging.getLogger(__name__).exception(
+                "vector index persist failed for %s/%s",
+                self.tablet_id, cid)
+
+    def bootstrap_vector_indexes(self) -> int:
+        """Load persisted ANN indexes and reconcile them with the
+        CURRENT store via a scan-diff (rows written after the last
+        save land in the delta; frozen rows that vanished or changed
+        are hidden), so an index survives restart instead of being
+        rebuilt per process.  Safe off the event loop (the tserver
+        runs it in an executor): the state installs BEFORE the scan,
+        so concurrent applies maintain it through the normal write
+        path, and the diff merge skips any key maintenance touched
+        since install (their version is newer — in particular a
+        concurrent delete must not be resurrected by the scan's
+        pre-delete image).  A torn/unreadable payload falls back to a
+        full rebuild with the recorded method/options (rebuild-on-
+        bootstrap); with no readable metadata the dir is ignored and
+        the next CREATE INDEX starts fresh.  Returns indexes
+        restored."""
+        import msgpack
+        import numpy as np
+        from ..vector.registry import load_index
+        root = os.path.join(self.dir, "vecidx")
+        if not os.path.isdir(root) or self.colocated:
+            return 0
+        pk_names = tuple(c.name for c in self.info.schema.key_columns)
+        restored = 0
+        for ent in sorted(os.listdir(root)):
+            path = os.path.join(root, ent)
+            try:
+                with open(os.path.join(path, "tablet_meta.msgpack"),
+                          "rb") as f:
+                    tmeta = msgpack.unpackb(f.read(), raw=False,
+                                            strict_map_key=False)
+                cid = self.info.schema.column_by_name(
+                    tmeta["col_name"]).id
+                if str(cid) != ent:
+                    continue        # schema changed under the index
+            except Exception:   # noqa: BLE001 — no metadata: ignore dir
+                continue
+            idx = load_index(path)
+            # pks are positional: pks[i] owns index id i
+            pks = [dict(p) for p in tmeta.get("pks", [])]
+            if idx is None or idx.size != len(pks):
+                # torn payload: rebuild from the store with the
+                # recorded shape (the "rebuild" half of the contract)
+                self.build_vector_index(
+                    tmeta["col_name"],
+                    int(tmeta.get("options", {}).get("lists", 100)),
+                    tmeta.get("method", "ivfflat"),
+                    tmeta.get("options"))
+                restored += 1
+                continue
+            state = _VectorIndexState(tmeta["col_name"],
+                                      tmeta.get("method", "ivfflat"),
+                                      tmeta.get("options"))
+            state.idx = idx
+            state.pks = pks
+            state.frozen_pos = {tuple(p[n] for n in pk_names): i
+                                for i, p in enumerate(pks)}
+            state.frozen_keys = set(state.frozen_pos)
+            # install FIRST: concurrent applies (WAL replay) maintain
+            # the delta through the normal write path from here on,
+            # and record every touched key so the merge below defers
+            # to them (deletes of non-frozen keys leave no delta/dead
+            # trace — `touched` is their only footprint)
+            state.touched = set()
+            with self._lock:
+                self.vector_indexes[cid] = state
+            # scan-diff against the live store
+            cur_pks, cur_vecs = self._scan_vectors(state.col_name)
+            frozen = idx.vectors_in_id_order()
+            pos = state.frozen_pos
+            cur_keys = set()
+            diff = []
+            for j, pk in enumerate(cur_pks):
+                key = tuple(pk[n] for n in pk_names)
+                cur_keys.add(key)
+                i = pos.get(key)
+                if i is not None and np.array_equal(cur_vecs[j],
+                                                    frozen[i]):
+                    continue
+                diff.append((key, (pk, cur_vecs[j].tobytes(), None),
+                             i is not None))
+            with self._lock:
+                for key, entry, was_frozen in diff:
+                    if key in state.touched or key in state.delta \
+                            or key in state.dead:
+                        continue    # maintenance got there first
+                    state.delta[key] = entry
+                    if was_frozen:
+                        state.dead.add(key)
+                state.dead |= state.frozen_keys - cur_keys \
+                    - set(state.delta) - state.touched
+                state.touched = None
+            restored += 1
+        return restored
 
     # --- snapshots --------------------------------------------------------
     def create_snapshot(self, out_dir: str):

@@ -195,6 +195,22 @@ class TabletServer:
                         sibs.append(sib)
         self.peers[tablet_id] = peer
         await peer.start()
+        # persisted ANN indexes load + scan-diff here, after the store
+        # is open (WAL replay re-commits through Raft and maintains the
+        # delta via the normal write path once the state is installed).
+        # Executor, not inline: the scan-diff — and the full rebuild a
+        # torn payload falls back to — must not stall the event loop
+        # (same rationale as rpc_build_vector_index).
+        if os.path.isdir(os.path.join(tablet.dir, "vecidx")):
+            try:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, tablet.bootstrap_vector_indexes)
+            except Exception:   # noqa: BLE001 — a broken index payload
+                # must never keep the tablet from serving; but silence
+                # here would make "index quietly gone after restart"
+                # undiagnosable
+                log.exception("vector index bootstrap failed for %s",
+                              tablet_id)
         return peer
 
     async def rpc_create_tablet(self, payload) -> dict:
@@ -912,19 +928,22 @@ class TabletServer:
     # --- vector indexes ------------------------------------------------------
     async def rpc_build_vector_index(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
-        # executor: the build (scan + k-means) must not stall the event
-        # loop, and the per-index build lock serializes it against the
-        # background fold which also runs in an executor thread
+        # executor: the build (scan + k-means / graph construction)
+        # must not stall the event loop, and the per-index build lock
+        # serializes it against the background fold which also runs in
+        # an executor thread
         n = await asyncio.get_running_loop().run_in_executor(
             None, lambda: peer.tablet.build_vector_index(
-                payload["column"], payload.get("lists", 100)))
+                payload["column"], payload.get("lists", 100),
+                payload.get("method", "ivfflat"),
+                payload.get("options")))
         return {"indexed": n}
 
     async def rpc_vector_search(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
         hits = peer.tablet.vector_search(
             payload["column"], payload["query"], payload.get("k", 10),
-            payload.get("nprobe", 8))
+            payload.get("nprobe", 8), payload.get("ef_search"))
         return {"hits": [[pk, d] for pk, d in hits]}
 
     # --- CDC (reference: src/yb/cdc/cdc_service.cc GetChanges) --------------

@@ -1,0 +1,23 @@
+"""Vector-index subsystem: a pluggable ANN registry with two engines.
+
+The executor's ``CREATE INDEX ... USING ivfflat|hnsw`` DDL resolves an
+index method through :mod:`registry`; tablets hold the built
+:class:`AnnIndex` objects and persist them alongside tablet data
+(reference: src/yb/vector_index/vector_lsm.cc and the usearch/hnswlib
+wrappers in src/yb/ann_methods/ — ours swaps the backends for a
+TPU-shaped two-stage IVF and a numpy-native HNSW twin).
+
+  * ``ivf``  — two-stage device-friendly IVF: multi-probe candidate
+    generation over centroid distances into a wide top-C pool, then an
+    exact full-precision GEMM re-rank (one extra GEMM, the MXU-shaped
+    hot path), with shape-stable pow2 candidate buckets and
+    compile-count accounting mirroring ops/compaction.py.
+  * ``hnsw`` — graph index for the host path: numpy adjacency arrays,
+    greedy layered descent, ``ef_search`` knob — the recall-frontier
+    twin of the IVF engine.
+"""
+from .registry import (  # noqa: F401
+    AnnIndex, available_methods, get_index_cls, register_index,
+)
+from .ivf import TwoStageIvfIndex  # noqa: F401
+from .hnsw import HnswIndex  # noqa: F401

@@ -1,0 +1,459 @@
+"""Two-stage IVF: multi-probe candidate generation + exact GEMM re-rank.
+
+The device-friendly ANN engine (reference analog: the IVF half of
+pgvector's ivfflat, re-shaped for the MXU).  Search is two GEMM-shaped
+stages:
+
+  stage 1 — candidate generation: one [Q, D] x [D, K] centroid-distance
+  matmul, per-query top-``nprobe`` lists (multi-probe), gather of the
+  probed lists' rows into a wide candidate pool;
+
+  stage 2 — re-rank: ONE exact full-precision GEMM over the pool plus a
+  top-k.  On accelerators stage 1 scores the gathered pool in the
+  matmul dtype (bf16) and keeps only the top-``rerank_c`` candidates,
+  so the exact f32 stage touches a narrow pow2 bucket; on CPU both
+  stages are f32 and stage 2 runs list-major as a blocked shared GEMM
+  over the BATCH's probed-list union (the union is naturally small —
+  centroid ranking is strongly correlated across queries — and a
+  shared scan of it beats per-query masked GEMMs by ~2x measured).
+
+All jitted entry points take pow2-bucketed shapes (queries, list
+rectangle, candidate pool), so the kernels compile once per bucket —
+``kernel_cache_stats()`` mirrors ops/compaction.py's accounting.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..ops.vector import kmeans, l2_distance2
+from .registry import AnnIndex, merge_topk, register_index
+
+#: process-lifetime kernel-compile accounting (same contract as
+#: ops/compaction.py KERNEL_STATS): a signature is one static-shape
+#: tuple; jax.jit compiles exactly once per signature, so "compiles"
+#: counts cache misses and repeat searches of the same bucket report
+#: zero new compiles.
+_KERNEL_SIGS: set = set()
+KERNEL_STATS = {"compiles": 0, "calls": 0, "cache_hits": 0}
+
+
+def kernel_cache_stats() -> dict:
+    return dict(KERNEL_STATS)
+
+
+def reset_kernel_stats() -> None:
+    KERNEL_STATS.update(compiles=0, calls=0, cache_hits=0)
+
+
+def _note_kernel_call(sig: tuple) -> None:
+    KERNEL_STATS["calls"] += 1
+    if sig in _KERNEL_SIGS:
+        KERNEL_STATS["cache_hits"] += 1
+    else:
+        _KERNEL_SIGS.add(sig)
+        KERNEL_STATS["compiles"] += 1
+
+
+def _pow2(n: int, floor: int = 1) -> int:
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _two_stage_device_search(queries, centroids, lists, list_lens,
+                             vec_flat, norms_flat, k: int, nprobe: int,
+                             rerank_c: int):
+    """Jit wrapper with compile accounting.  Every array is a traced
+    operand — never close over the dataset (a static self would bake
+    multi-GB arrays into the executable as XLA constants)."""
+    sig = ("two_stage", queries.shape, centroids.shape[0],
+           lists.shape[1], k, nprobe, rerank_c)
+    _note_kernel_call(sig)
+    return _two_stage_kernel(queries, centroids, lists, list_lens,
+                             vec_flat, norms_flat, k=k, nprobe=nprobe,
+                             rerank_c=rerank_c)
+
+
+def _lazy_jit():
+    """Import jax lazily so pure-CPU hosts importing the package for
+    the numpy path don't pay backend init."""
+    global _two_stage_kernel
+    import jax
+    import jax.numpy as jnp
+
+    @partial(jax.jit, static_argnames=("k", "nprobe", "rerank_c"))
+    def _two_stage_kernel(queries, centroids, lists, list_lens,
+                          vec_flat, norms_flat, k: int, nprobe: int,
+                          rerank_c: int):
+        # ---- stage 1: multi-probe candidate generation ----
+        dc = l2_distance2(queries, centroids)             # [Q, K]
+        _, probe = jax.lax.top_k(-dc, nprobe)             # [Q, nprobe]
+        cand = lists[probe]                               # [Q, np, M]
+        q_, p_, m_ = cand.shape
+        cand = cand.reshape(q_, p_ * m_)                  # [Q, C0]
+        valid = (jnp.arange(m_)[None, None, :]
+                 < list_lens[probe][:, :, None]).reshape(q_, p_ * m_)
+        # coarse scores in the matmul dtype (bf16 on accelerators):
+        # cheap wide pass that only has to RANK well enough for the
+        # top-C pool to contain the true top-k
+        vecs = vec_flat[cand]                             # [Q, C0, D]
+        dots = jnp.einsum("qd,qcd->qc",
+                          queries.astype(vec_flat.dtype), vecs,
+                          preferred_element_type=jnp.float32)
+        qn = jnp.sum(queries.astype(jnp.float32) ** 2, axis=1,
+                     keepdims=True)
+        d1 = qn + norms_flat[cand] - 2.0 * dots
+        d1 = jnp.where(valid, d1, jnp.inf)
+        c_ = min(rerank_c, p_ * m_)
+        _, sel = jax.lax.top_k(-d1, c_)                   # [Q, C]
+        pool = jnp.take_along_axis(cand, sel, axis=1)
+        pool_valid = jnp.take_along_axis(valid, sel, axis=1)
+        # ---- stage 2: exact full-precision GEMM re-rank ----
+        pv = vec_flat[pool].astype(jnp.float32)           # [Q, C, D]
+        dots2 = jnp.einsum("qd,qcd->qc",
+                           queries.astype(jnp.float32), pv,
+                           preferred_element_type=jnp.float32)
+        d2 = qn + norms_flat[pool] - 2.0 * dots2
+        d2 = jnp.where(pool_valid, jnp.maximum(d2, 0.0), jnp.inf)
+        neg, pos = jax.lax.top_k(-d2, k)
+        ids = jnp.take_along_axis(pool, pos, axis=1)
+        ids = jnp.where(jnp.isfinite(-neg), ids, -1)
+        return -neg, ids
+    return _two_stage_kernel
+
+
+_two_stage_kernel = None
+
+
+@register_index("ivfflat", "ivf")
+class TwoStageIvfIndex(AnnIndex):
+    """IVF with two-stage search behind the AnnIndex contract.
+
+    Layout is list-major: vectors sorted by IVF list so each list is a
+    contiguous slice (``starts``/``counts``), with the positional id of
+    every sorted row in ``ids``.  The same layout serves both backends:
+    the CPU path scans contiguous probed slices with blocked BLAS
+    GEMMs; the device path reads it through flat gathers with the list
+    rectangle padded to a pow2 width so rebuilds keep the compiled
+    kernel signature.
+
+    ``add`` appends to an exact-searched tail (the index's own delta);
+    folding the tail back into the lists is a rebuild — the tablet's
+    vector-LSM maintenance owns when that happens.
+    """
+
+    #: rows per CPU re-rank block: big enough for near-peak BLAS on the
+    #: [Q, D] x [D, block] shape, small enough that the [Q, block]
+    #: distance tile stays cache-resident for the row-contiguous
+    #: top-k partition (measured 1M x 768 / Q=64: 8-32K rows all ~77
+    #: qps where 128K drops to ~40)
+    CPU_BLOCK = 1 << 14
+
+    def __init__(self, centroids: np.ndarray, sorted_vecs: np.ndarray,
+                 ids: np.ndarray, starts: np.ndarray, counts: np.ndarray,
+                 options: Optional[dict] = None):
+        self.cent = np.ascontiguousarray(centroids, dtype=np.float32)
+        self.cent_norms = np.einsum("kd,kd->k", self.cent, self.cent)
+        self.sorted = np.ascontiguousarray(sorted_vecs, dtype=np.float32)
+        self.sorted_norms = np.einsum("nd,nd->n", self.sorted,
+                                      self.sorted)
+        self.ids = np.asarray(ids, np.int64)
+        self.starts = np.asarray(starts, np.int64)
+        self.counts = np.asarray(counts, np.int64)
+        self.options = dict(options or {})
+        self._tail_vecs: list = []        # added after build (add())
+        self._tail_ids: list = []
+        self._next_id = (int(self.ids.max()) + 1) if len(self.ids) else 0
+        self._device = None               # lazy jnp twin for the kernel
+        #: instrumentation: candidate-pool rows of the LAST search
+        #: (CPU: probed-union row count; device: the rerank_c bucket) —
+        #: the bench records it next to nprobe so qps/recall claims
+        #: carry their work parameters
+        self.last_pool_rows = 0
+
+    # ---- construction ----------------------------------------------------
+    @classmethod
+    def build(cls, data: np.ndarray, nlists: int = 100, iters: int = 10,
+              sample: int = 100_000, seed: int = 0,
+              **extra) -> "TwoStageIvfIndex":
+        data = np.asarray(data, np.float32)
+        n = len(data)
+        nlists = max(1, min(nlists, max(1, n // 2 or 1)))
+        if n == 0:
+            d = data.shape[1] if data.ndim == 2 else 1
+            z = np.zeros((0,), np.int64)
+            return cls(np.zeros((1, d), np.float32),
+                       np.zeros((0, d), np.float32), z,
+                       np.zeros(1, np.int64), np.zeros(1, np.int64),
+                       {"nlists": 1, "iters": iters, "seed": seed})
+        rng = np.random.default_rng(seed)
+        samp = (data if n <= sample
+                else data[rng.choice(n, sample, replace=False)])
+        cent = kmeans(samp, nlists, iters, seed)
+        assign = cls._assign(data, cent)
+        order = np.argsort(assign, kind="stable")
+        counts = np.bincount(assign, minlength=nlists).astype(np.int64)
+        starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+        return cls(cent, data[order], order.astype(np.int64), starts,
+                   counts, {"nlists": nlists, "iters": iters,
+                            "seed": seed, **extra})
+
+    @staticmethod
+    def _assign(data: np.ndarray, cent: np.ndarray) -> np.ndarray:
+        """Nearest-centroid assignment, chunked so peak memory stays
+        bounded (device kernel when one is attached, BLAS otherwise)."""
+        import jax.numpy as jnp
+        n = len(data)
+        assign = np.empty(n, np.int32)
+        step = 1 << 18
+        centd = jnp.asarray(cent, jnp.float32)
+        for i in range(0, n, step):
+            d = l2_distance2(jnp.asarray(data[i:i + step], jnp.float32),
+                             centd)
+            assign[i:i + step] = np.asarray(jnp.argmin(d, axis=1))
+        return assign
+
+    def add(self, vectors: np.ndarray) -> None:
+        vectors = np.asarray(vectors, np.float32)
+        if vectors.ndim == 1:
+            vectors = vectors[None, :]
+        for v in vectors:
+            self._tail_vecs.append(v)
+            self._tail_ids.append(self._next_id)
+            self._next_id += 1
+
+    # ---- size ------------------------------------------------------------
+    @property
+    def size(self) -> int:
+        return len(self.ids) + len(self._tail_ids)
+
+    @property
+    def dim(self) -> int:
+        return self.sorted.shape[1] if self.sorted.ndim == 2 else 1
+
+    @property
+    def nlists(self) -> int:
+        return len(self.counts)
+
+    # ---- search ----------------------------------------------------------
+    def default_nprobe(self) -> int:
+        """Recall-biased default: a quarter of the lists (isotropic
+        data is IVF's worst case; see the bench's rationale)."""
+        return max(1, self.nlists // 4)
+
+    def search(self, queries: np.ndarray, k: int = 10,
+               nprobe: Optional[int] = None,
+               rerank_c: Optional[int] = None,
+               backend: Optional[str] = None, **_ignored
+               ) -> Tuple[np.ndarray, np.ndarray]:
+        q = np.asarray(queries, np.float32)
+        if q.ndim == 1:
+            q = q[None, :]
+        nprobe = min(nprobe or self.default_nprobe(), self.nlists)
+        nprobe = max(1, nprobe)
+        if backend is None:
+            import jax
+            backend = ("device" if jax.default_backend() != "cpu"
+                       else "cpu")
+        if len(self.ids) == 0:
+            D = np.full((len(q), k), np.inf, np.float32)
+            I = np.full((len(q), k), -1, np.int64)
+        elif backend == "device":
+            D, I = self._device_search(q, k, nprobe, rerank_c)
+        else:
+            D, I = self._cpu_search(q, k, nprobe)
+        if self._tail_ids:
+            D, I = self._merge_tail(q, k, D, I)
+        return D, I
+
+    # ---- CPU twin: blocked shared GEMM over the probed-list union -------
+    def _cpu_search(self, q: np.ndarray, k: int, nprobe: int
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Stage 1 picks per-query probe lists; stage 2 re-ranks the
+        batch's probed-list UNION with blocked [block, D] x [D, Q]
+        GEMMs + per-block partial top-k.  Sharing the union across the
+        batch wastes no work in practice (probe sets overlap heavily —
+        centroid ranking is dominated by a global list component on
+        real and isotropic data alike) and keeps every GEMM at a
+        BLAS-friendly shape; each query's candidate set is a superset
+        of its own probed lists, so recall can only improve over the
+        per-query gather."""
+        nq = len(q)
+        cd = (np.einsum("qd,qd->q", q, q)[:, None] + self.cent_norms[None]
+              - 2.0 * q @ self.cent.T)                     # [Q, K]
+        if nprobe < self.nlists:
+            probe = np.argpartition(cd, nprobe - 1, axis=1)[:, :nprobe]
+            union = np.unique(probe)
+        else:
+            union = np.arange(self.nlists)
+        union = union[self.counts[union] > 0]
+        if len(union) == 0:
+            self.last_pool_rows = 0
+            return (np.full((nq, k), np.inf, np.float32),
+                    np.full((nq, k), -1, np.int64))
+        # contiguous row segments of the union (lists are list-major
+        # slices; adjacent probed lists coalesce into one segment).
+        # Segment i spans union positions heads[i] .. heads[i+1]-1, so
+        # its row range ends at the LAST coalesced list's end — never
+        # at the next segment's start (that would sweep every
+        # unprobed list sitting between two probed runs into the scan).
+        # Gap-tolerant: two probed runs separated by fewer than
+        # GAP_ROWS unprobed rows merge anyway — scanning the small gap
+        # (its rows become extra exact-ranked candidates; recall can
+        # only improve) is cheaper than fragmenting the blocked GEMM
+        # into sub-block segments (measured ~15% at 1M x 768 with ~400
+        # scattered probed lists).  last_pool_rows reports the rows
+        # actually scanned, gaps included.
+        seg_start = self.starts[union]
+        seg_end = seg_start + self.counts[union]
+        gap = self.CPU_BLOCK // 4
+        keep = np.ones(len(union), bool)
+        keep[1:] = seg_start[1:] > seg_end[:-1] + gap
+        heads = np.nonzero(keep)[0]
+        seg_lo = seg_start[heads]
+        seg_hi = np.concatenate([seg_end[heads[1:] - 1], seg_end[-1:]])
+        self.last_pool_rows = int((seg_hi - seg_lo).sum())
+        # re-split long segments into GEMM blocks.  Query-major
+        # orientation throughout: dots [Q, block] keeps each query's
+        # distance row contiguous, so both the BLAS epilogue and the
+        # per-block argpartition stream cache lines instead of striding
+        # (measured ~1.7x over the block-major orientation at 1M x 768)
+        qn = np.einsum("qd,qd->q", q, q)
+        win_d: list = []
+        win_i: list = []
+        for lo, hi in zip(seg_lo, seg_hi):
+            lo, hi = int(lo), int(hi)
+            for b0 in range(lo, hi, self.CPU_BLOCK):
+                b1 = min(b0 + self.CPU_BLOCK, hi)
+                dots = q @ self.sorted[b0:b1].T             # [Q, b]
+                dist = (qn[:, None] - 2.0 * dots
+                        + self.sorted_norms[None, b0:b1])
+                kk = min(k, b1 - b0)
+                sel = np.argpartition(dist, kk - 1, axis=1)[:, :kk]
+                win_d.append(np.take_along_axis(dist, sel, axis=1))
+                win_i.append(self.ids[b0 + sel])
+        D, I = merge_topk(np.concatenate(win_d, axis=1),
+                          np.concatenate(win_i, axis=1), k)
+        return np.maximum(D, 0.0), I
+
+    # ---- device path: jitted two-stage kernel ---------------------------
+    def _device_arrays(self):
+        """Lazy device twin: flat vectors in the matmul dtype, f32
+        norms, and the list rectangle padded to a pow2 width (stable
+        kernel signature across rebuilds of similar size)."""
+        if self._device is None:
+            import jax.numpy as jnp
+            from ..ops.vector import _mm_dtype
+            m = _pow2(max(1, int(self.counts.max()) if len(self.counts)
+                          else 1), floor=8)
+            lists = np.zeros((self.nlists, m), np.int32)
+            for li in range(self.nlists):
+                s, c = int(self.starts[li]), int(self.counts[li])
+                lists[li, :c] = np.arange(s, s + c)
+            self._device = {
+                "cent": jnp.asarray(self.cent, jnp.float32),
+                "lists": jnp.asarray(lists),
+                "lens": jnp.asarray(self.counts.astype(np.int32)),
+                "vecs": jnp.asarray(self.sorted).astype(_mm_dtype()),
+                "norms": jnp.asarray(self.sorted_norms, jnp.float32),
+            }
+        return self._device
+
+    def _device_search(self, q: np.ndarray, k: int, nprobe: int,
+                       rerank_c: Optional[int]
+                       ) -> Tuple[np.ndarray, np.ndarray]:
+        global _two_stage_kernel
+        import jax.numpy as jnp
+        if _two_stage_kernel is None:
+            _lazy_jit()
+        dv = self._device_arrays()
+        n = len(self.ids)
+        # the kernel's pool is at most nprobe * padded-list-width wide;
+        # top_k(k) over a narrower pool would raise, so clamp and pad
+        # the missing slots with inf/-1 like every other search path
+        m_pad = int(dv["lists"].shape[1])
+        k_eff = min(k, n, nprobe * m_pad)
+        c = rerank_c or self.options.get("rerank_c") or 4 * k
+        c = _pow2(max(min(c, n), k_eff))
+        self.last_pool_rows = c
+        # pow2 query bucket: searches of 1..Q queries share compiles
+        qb = _pow2(len(q))
+        qpad = np.zeros((qb, q.shape[1]), np.float32)
+        qpad[:len(q)] = q
+        d, i = _two_stage_device_search(
+            jnp.asarray(qpad), dv["cent"], dv["lists"], dv["lens"],
+            dv["vecs"], dv["norms"], k_eff, nprobe, c)
+        d = np.asarray(d)[:len(q)]
+        i = np.asarray(i, np.int64)[:len(q)]
+        # positions -> positional ids; -1 padding stays -1
+        i = np.where(i >= 0, self.ids[np.clip(i, 0, max(n - 1, 0))], -1)
+        if k_eff < k:
+            d = np.pad(d, ((0, 0), (0, k - k_eff)),
+                       constant_values=np.inf)
+            i = np.pad(i, ((0, 0), (0, k - k_eff)), constant_values=-1)
+        return d, i
+
+    # ---- tail (add()-ed vectors): exact merge ---------------------------
+    def _merge_tail(self, q: np.ndarray, k: int, D: np.ndarray,
+                    I: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        tv = np.stack(self._tail_vecs)
+        ti = np.asarray(self._tail_ids, np.int64)
+        dist = (np.einsum("qd,qd->q", q, q)[:, None]
+                + np.einsum("td,td->t", tv, tv)[None, :]
+                - 2.0 * q @ tv.T)
+        dist = np.maximum(dist, 0.0)
+        return merge_topk(
+            np.concatenate([D, dist], axis=1),
+            np.concatenate(
+                [I, np.broadcast_to(ti, (len(q), len(ti)))], axis=1),
+            k)
+
+    def _inv_ids(self) -> np.ndarray:
+        """positional id -> sorted-row position (built once, 8 bytes a
+        row — not a second copy of the vectors)."""
+        if getattr(self, "_inv", None) is None:
+            n = len(self.ids)
+            self._inv = np.empty(n, np.int64)
+            self._inv[self.ids] = np.arange(n)
+        return self._inv
+
+    def vectors_in_id_order(self) -> np.ndarray:
+        out = self.sorted[self._inv_ids()]
+        if self._tail_vecs:
+            out = np.concatenate([out, np.stack(self._tail_vecs)])
+        return out
+
+    def vector_of(self, id_: int) -> np.ndarray:
+        n = len(self.ids)
+        if id_ >= n:
+            return self._tail_vecs[id_ - n]
+        return self.sorted[self._inv_ids()[id_]]
+
+    # ---- persistence -----------------------------------------------------
+    def _state_arrays(self) -> Dict[str, np.ndarray]:
+        tail_v = (np.stack(self._tail_vecs) if self._tail_vecs
+                  else np.zeros((0, self.dim), np.float32))
+        return {"cent": self.cent, "sorted": self.sorted,
+                "ids": self.ids, "starts": self.starts,
+                "counts": self.counts, "tail_vecs": tail_v,
+                "tail_ids": np.asarray(self._tail_ids, np.int64)}
+
+    def _state_meta(self) -> dict:
+        return {"options": self.options}
+
+    @classmethod
+    def _from_state(cls, arrays: Dict[str, np.ndarray],
+                    meta: dict) -> "TwoStageIvfIndex":
+        idx = cls(arrays["cent"], arrays["sorted"], arrays["ids"],
+                  arrays["starts"], arrays["counts"],
+                  meta.get("options"))
+        if len(arrays.get("tail_ids", ())):
+            idx._tail_vecs = list(arrays["tail_vecs"])
+            idx._tail_ids = [int(x) for x in arrays["tail_ids"]]
+            idx._next_id = max(idx._next_id,
+                               max(idx._tail_ids) + 1)
+        return idx
