@@ -138,6 +138,232 @@ def probe_device(timeouts=None):
     return ok, attempts
 
 
+def ycsb_overload_bench():
+    """YCSB-C at 2x saturation through the REAL RPC path, scheduler ON
+    vs OFF (the PR-3 headline): an open loop offers 2x the measured
+    closed-loop saturation rate; ON must hold p99 via bounded queues +
+    typed sheds (SERVICE_UNAVAILABLE + retry_after_ms) where OFF lets
+    the backlog stack into seconds of latency.  Returns the comparison
+    dict (or {"error": ...}); BENCH_OVERLOAD_S=0 skips."""
+    import asyncio
+
+    duration = float(os.environ.get("BENCH_OVERLOAD_S", "2.5"))
+    if duration <= 0:
+        return None
+
+    async def run():
+        from yugabyte_db_tpu.docdb.operations import ReadRequest
+        from yugabyte_db_tpu.docdb.wire import read_request_to_wire
+        from yugabyte_db_tpu.models.ycsb import usertable_info
+        from yugabyte_db_tpu.rpc.messenger import Messenger, RpcError
+        from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+        from yugabyte_db_tpu.utils import flags as _flags
+
+        n_rows = 20000
+        mc = await MiniCluster(tempfile.mkdtemp(prefix="ybtpu-ol-"),
+                               num_tservers=1).start()
+        conns = []
+        try:
+            c = mc.client()
+            await c.create_table(usertable_info(), num_tablets=1,
+                                 replication_factor=1)
+            await mc.wait_for_leaders("usertable")
+            rows = [{"ycsb_key": i,
+                     **{f"field{j}": "x" * 100 for j in range(10)}}
+                    for i in range(n_rows)]
+            for i in range(0, n_rows, 2000):
+                await c.insert("usertable", rows[i:i + 2000])
+            ct = await c._table("usertable")
+            loc = ct.locations[0]
+            addr = loc.leader_addr()
+            # flush: scans measure the columnar/pushdown path (the
+            # steady state), not a 20k-row memtable decode per query
+            await c.messenger.call(addr, "tserver", "flush",
+                                   {"tablet_id": loc.tablet_id},
+                                   timeout=30.0)
+            # 64 distinct connections (a fleet of clients, not one
+            # pipelined socket): per-connection inflight caps cannot
+            # compose into a global bound across a fleet — holding
+            # latency here is exactly the scheduler's job
+            conns = [Messenger(f"ol-{i}") for i in range(64)]
+            rng = np.random.default_rng(2)
+
+            def payload():
+                return {"tablet_id": loc.tablet_id,
+                        "req": read_request_to_wire(ReadRequest(
+                            ct.info.table_id,
+                            pk_eq={"ycsb_key":
+                                   int(rng.integers(0, n_rows))}))}
+
+            from yugabyte_db_tpu.ops.scan import AggSpec
+
+            def scan_payload():
+                # one fixed aggregate signature: under load every
+                # queued copy coalesces into ONE kernel launch
+                return {"tablet_id": loc.tablet_id,
+                        "req": read_request_to_wire(ReadRequest(
+                            ct.info.table_id,
+                            aggregates=(AggSpec("count"),)))}
+
+            async def closed_loop(dur, workers=64, pl=payload):
+                stop = time.perf_counter() + dur
+                count = 0
+
+                async def w(i):
+                    nonlocal count
+                    m = conns[i % len(conns)]
+                    while time.perf_counter() < stop:
+                        await m.call(addr, "tserver", "read", pl(),
+                                     timeout=30.0)
+                        count += 1
+                await asyncio.gather(*[w(i) for i in range(workers)])
+                return count / dur
+
+            async def open_loop(rate, dur, deadline_s=2.0, pl=payload):
+                """Open loop at `rate` for `dur` seconds.  Every op
+                carries a realistic client DEADLINE: a completion past
+                it is wasted server work the client no longer wants —
+                achieved ops/s counts in-SLA completions only (the
+                goodput an overloaded server actually delivers)."""
+                lat, tasks = [], []
+                shed = timed_out = conn_reset = 0
+                retry_after = []
+
+                async def one(i):
+                    nonlocal shed, timed_out, conn_reset
+                    m = conns[i % len(conns)]
+                    t0 = time.perf_counter()
+                    try:
+                        await m.call(addr, "tserver", "read", pl(),
+                                     timeout=deadline_s)
+                        lat.append(time.perf_counter() - t0)
+                    except asyncio.TimeoutError:
+                        timed_out += 1
+                    except RpcError as e:
+                        if e.code == "SERVICE_UNAVAILABLE":
+                            shed += 1
+                            if e.retry_after_ms and len(retry_after) < 64:
+                                retry_after.append(e.retry_after_ms)
+                        elif e.code == "NETWORK_ERROR":
+                            # a sibling op's deadline evicted this conn
+                            # mid-flight — an overload casualty too
+                            conn_reset += 1
+                        else:
+                            raise
+                total = int(rate * dur)
+                interval = 1.0 / rate
+                t_start = time.perf_counter()
+                for i in range(total):
+                    due = t_start + i * interval
+                    now = time.perf_counter()
+                    if now < due:
+                        await asyncio.sleep(due - now)
+                    tasks.append(asyncio.ensure_future(one(i)))
+                await asyncio.gather(*tasks)
+                wall = time.perf_counter() - t_start
+                lat_ms = sorted(x * 1e3 for x in lat)
+
+                def pct(q):
+                    if not lat_ms:
+                        return 0.0
+                    return lat_ms[min(len(lat_ms) - 1,
+                                      int(q * len(lat_ms)))]
+                return {"offered_ops_per_s": round(rate, 1),
+                        "achieved_ops_per_s": round(len(lat) / wall, 1),
+                        "ok": len(lat), "shed": shed,
+                        "timed_out": timed_out, "conn_reset": conn_reset,
+                        "deadline_s": deadline_s,
+                        "shed_rate": round(shed / max(1, total), 3),
+                        "retry_after_ms_seen": (
+                            [min(retry_after), max(retry_after)]
+                            if retry_after else None),
+                        "p50_ms": round(pct(0.5), 2),
+                        "p99_ms": round(pct(0.99), 2)}
+
+            async def paired_overload(pl, sat):
+                # PAIRED, interleaved rounds (the Q6/compaction
+                # discipline): ON and OFF run back-to-back inside each
+                # round so co-tenant noise hits both sides of a round
+                # equally; keep each side's best-achieved run, ratio
+                # from those
+                on_rounds, off_rounds = [], []
+                for _ in range(2):
+                    on_rounds.append(
+                        await open_loop(2 * sat, duration, pl=pl))
+                    _flags.set_flag("scheduler_enabled", False)
+                    try:
+                        off_rounds.append(
+                            await open_loop(2 * sat, duration, pl=pl))
+                    finally:
+                        _flags.set_flag("scheduler_enabled", True)
+                on = max(on_rounds,
+                         key=lambda r: r["achieved_ops_per_s"])
+                off = max(off_rounds,
+                          key=lambda r: r["achieved_ops_per_s"])
+                return {"saturation_ops_per_s": round(sat, 1),
+                        "scheduler_on": on, "scheduler_off": off,
+                        "p99_ratio_rounds": [
+                            round(a["p99_ms"] / max(b["p99_ms"], 1e-9), 3)
+                            for a, b in zip(on_rounds, off_rounds)],
+                        "p99_ratio_on_vs_off": round(
+                            on["p99_ms"] / max(off["p99_ms"], 1e-9), 3),
+                        "achieved_ratio_on_vs_off": round(
+                            on["achieved_ops_per_s"]
+                            / max(off["achieved_ops_per_s"], 1e-9), 3)}
+
+            await closed_loop(0.5)                    # warm
+            sat = await closed_loop(1.5)
+            points = await paired_overload(payload, sat)
+            # scan lane: same-signature aggregates coalesce into ONE
+            # kernel launch per batch — under overload the scheduler
+            # turns N queued copies into one engine execution, a real
+            # capacity multiplier (the accelerator-boundary batching
+            # the subsystem exists for)
+            await closed_loop(0.5, pl=scan_payload)   # warm/compile
+            scan_sat = await closed_loop(1.5, pl=scan_payload)
+            scans = await paired_overload(scan_payload, scan_sat)
+            return {"point_reads": points, "agg_scans": scans}
+        finally:
+            for m in conns:
+                await m.shutdown()
+            await mc.shutdown()
+
+    try:
+        return asyncio.run(run())
+    except Exception as e:   # noqa: BLE001 — report, don't fail bench
+        return {"error": str(e)[:200]}
+
+
+# ratio keys whose value < 1.0 means "slower than the baseline it was
+# measured against" — surfaced as a WARN in the bench tail instead of
+# sitting silently inside the JSON (satellite of PR 3; Q6's r05
+# vs_baseline of 0.923 went unnoticed for a round)
+_RATIO_KEYS = ("vs_baseline", "speedup", "vs_cpu", "vs_xla",
+               "p99_ratio_on_vs_off", "achieved_ratio_on_vs_off")
+
+
+def warn_regressed_ratios(node, path="", out=None):
+    """Collect (path, value) for every ratio key below 1.0."""
+    if out is None:
+        out = []
+    if isinstance(node, dict):
+        for k, v in node.items():
+            p = f"{path}.{k}" if path else k
+            if k in _RATIO_KEYS and isinstance(v, (int, float)):
+                # p99_ratio: LOWER is better (scheduler holds latency);
+                # everything else: lower than 1.0 is a regression
+                bad = (v > 0.5 if k == "p99_ratio_on_vs_off"
+                       else v < 1.0)
+                if bad:
+                    out.append((p, v))
+            else:
+                warn_regressed_ratios(v, p, out)
+    elif isinstance(node, list):
+        for i, v in enumerate(node):
+            warn_regressed_ratios(v, f"{path}[{i}]", out)
+    return out
+
+
 def _make_compaction_tablet(data, n_ssts, rows_per_sst, tag):
     """A tablet with `n_ssts` SSTables: sequential loads with 25%
     overlapping (re-written) keys so the merge has real MVCC work
@@ -405,6 +631,13 @@ def main():
     results["ycsb_b"] = {"ops_per_s": rb_.ops_per_sec}
     results["ycsb_e"] = {"ops_per_s": re_.ops_per_sec}
 
+    # YCSB-C at 2x saturation through the RPC path: scheduler ON vs
+    # OFF (admission control + micro-batching headline; BENCH_OVERLOAD_S
+    # bounds each side, 0 skips)
+    ol = ycsb_overload_bench()
+    if ol is not None:
+        results["ycsb_overload"] = ol
+
     # TPC-C-style NEW-ORDER/PAYMENT through REAL distributed txns on an
     # in-process cluster (reference headline bench; tpmC here is the
     # UNCONSTRAINED NewOrder rate — no spec think times). BENCH_TPCC_S
@@ -581,12 +814,21 @@ def main():
                      for k, v in results["tpcc"].items()}}
            if "tpcc" in results else {}),
         "ycsb_e_ops_per_s": round(results["ycsb_e"]["ops_per_s"], 1),
+        **({"ycsb_overload": results["ycsb_overload"]}
+           if "ycsb_overload" in results else {}),
         "driver_conformance": driver_conf,
         "vector": _vector_line(results["vector"]),
         **({"vector_full": _vector_line(results["vector_full"])}
            if "vector_full" in results else {}),
     }
     print(json.dumps(line))
+    # regression visibility: any kernel-vs-baseline ratio below 1.0 (or
+    # an overload p99 ratio the scheduler failed to hold) lands as a
+    # WARN in the bench tail (stderr keeps the one-JSON-line stdout
+    # contract) instead of hiding inside the blob
+    for path, v in warn_regressed_ratios(line):
+        print(f"WARN: ratio {path}={v} regressed past its threshold",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -1,22 +1,133 @@
-"""Profile YCSB-C single vs batched point reads (throwaway)."""
-import os, tempfile, time, cProfile, pstats
+"""Profile YCSB point ops.
+
+Default mode (legacy): engine-level single vs batched point reads with
+a cProfile dump.
+
+`--json` mode: the RPC-path YCSB through a real MiniCluster with the
+request scheduler on — prints ONE JSON object with ops/s next to the
+scheduler's own accounting (per-lane depth/wait histograms, batch-size
+distribution, group-commit fan-in), so batching policy is tunable from
+data instead of guesswork.  Env knobs: PROFILE_OPS (default 4000),
+PROFILE_CLIENTS (default 16), PROFILE_ROWS (default 20000).
+"""
+import os
+import sys
+import tempfile
+import time
+
 os.environ.setdefault("YBTPU_PLATFORM", "cpu")
-from yugabyte_db_tpu.models.ycsb import YcsbTabletWorkload, usertable_info
-from yugabyte_db_tpu.tablet import Tablet
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
-t = Tablet("ycsb", usertable_info(), tempfile.mkdtemp(prefix="ycsb-"))
-w = YcsbTabletWorkload(t, n_rows=100_000)
-w.load()
-w.run("c", ops=2000)
-for tag, kw in (("single", {}), ("batch16", {"clients": 16})):
-    best = 0
-    for _ in range(3):
-        r = w.run("c", ops=30000, **kw)
-        best = max(best, r.ops_per_sec)
-    print(f"{tag}: {best:.0f} ops/s")
 
-pr = cProfile.Profile()
-pr.enable()
-w.run("c", ops=30000)
-pr.disable()
-pstats.Stats(pr).sort_stats("cumulative").print_stats(22)
+def legacy_profile():
+    import cProfile
+    import pstats
+    from yugabyte_db_tpu.models.ycsb import YcsbTabletWorkload, \
+        usertable_info
+    from yugabyte_db_tpu.tablet import Tablet
+
+    t = Tablet("ycsb", usertable_info(), tempfile.mkdtemp(prefix="ycsb-"))
+    w = YcsbTabletWorkload(t, n_rows=100_000)
+    w.load()
+    w.run("c", ops=2000)
+    for tag, kw in (("single", {}), ("batch16", {"clients": 16})):
+        best = 0
+        for _ in range(3):
+            r = w.run("c", ops=30000, **kw)
+            best = max(best, r.ops_per_sec)
+        print(f"{tag}: {best:.0f} ops/s")
+
+    pr = cProfile.Profile()
+    pr.enable()
+    w.run("c", ops=30000)
+    pr.disable()
+    pstats.Stats(pr).sort_stats("cumulative").print_stats(22)
+
+
+async def rpc_profile() -> dict:
+    """RPC-path YCSB-C (+ a write phase) against one tserver; returns
+    ops/s + live scheduler stats."""
+    import asyncio
+
+    from yugabyte_db_tpu.docdb.operations import ReadRequest, RowOp
+    from yugabyte_db_tpu.models.ycsb import usertable_info
+    from yugabyte_db_tpu.ops.scan import AggSpec
+    from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+    ops = int(os.environ.get("PROFILE_OPS", "4000"))
+    clients = int(os.environ.get("PROFILE_CLIENTS", "16"))
+    n_rows = int(os.environ.get("PROFILE_ROWS", "20000"))
+
+    mc = await MiniCluster(tempfile.mkdtemp(prefix="ycsb-rpc-"),
+                           num_tservers=1).start()
+    try:
+        c = mc.client()
+        await c.create_table(usertable_info(), num_tablets=1,
+                             replication_factor=1)
+        await mc.wait_for_leaders("usertable")
+        rows = [{"ycsb_key": i,
+                 **{f"field{j}": "x" * 100 for j in range(10)}}
+                for i in range(n_rows)]
+        for i in range(0, n_rows, 2000):
+            await c.insert("usertable", rows[i:i + 2000])
+
+        import numpy as np
+        rng = np.random.default_rng(1)
+        keys = rng.integers(0, n_rows, ops)
+
+        async def read_worker(sl):
+            for k in sl:
+                await c.get("usertable", {"ycsb_key": int(k)})
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*[
+            read_worker(keys[i::clients]) for i in range(clients)])
+        read_s = time.perf_counter() - t0
+
+        wkeys = rng.integers(0, n_rows, ops // 2)
+
+        async def write_worker(sl):
+            for k in sl:
+                await c.write("usertable", [RowOp("upsert", {
+                    "ycsb_key": int(k),
+                    **{f"field{j}": "u" * 100 for j in range(10)}})])
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*[
+            write_worker(wkeys[i::clients]) for i in range(clients)])
+        write_s = time.perf_counter() - t0
+
+        # a burst of identical aggregate scans: exercises coalescing
+        t0 = time.perf_counter()
+        await asyncio.gather(*[
+            c.scan("usertable", ReadRequest(
+                "", aggregates=(AggSpec("count"),)))
+            for _ in range(32)])
+        scan_s = time.perf_counter() - t0
+
+        stats = await c.messenger.call(
+            mc.tservers[0].messenger.addr, "tserver",
+            "scheduler_stats", {})
+        return {
+            "metric": "ycsb_rpc_profile",
+            "clients": clients,
+            "read_ops_per_s": round(ops / read_s, 1),
+            "write_ops_per_s": round((ops // 2) / write_s, 1),
+            "agg_scans_per_s": round(32 / scan_s, 1),
+            "scheduler": stats,
+        }
+    finally:
+        await mc.shutdown()
+
+
+def main():
+    if "--json" in sys.argv:
+        import asyncio
+        import json
+        print(json.dumps(asyncio.run(rpc_profile())))
+    else:
+        legacy_profile()
+
+
+if __name__ == "__main__":
+    main()

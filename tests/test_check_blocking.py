@@ -1,0 +1,57 @@
+"""tools/check_blocking.py wired into tier-1: the scheduler multiplexes
+every lane over one event loop, so an unannotated blocking call inside
+an async handler in tserver/ or rpc/ is a bug — this test makes it a
+failing build instead of a latency mystery."""
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_no_unannotated_blocking_calls():
+    sys.path.insert(0, os.path.join(HERE, "tools"))
+    try:
+        import check_blocking
+    finally:
+        sys.path.pop(0)
+    findings = check_blocking.scan(base=HERE)
+    assert not findings, (
+        "blocking calls inside async def bodies (annotate with "
+        f"'# {check_blocking.ALLOW_MARK}: <reason>' only if genuinely "
+        f"bounded): {findings}")
+
+
+def test_detection_suppression_and_nesting(tmp_path):
+    """The pass itself: flags time.sleep/open in async bodies, skips
+    nested sync defs (executor targets), honors blocking-ok marks."""
+    bad = tmp_path / "pkg" / "bad.py"
+    bad.parent.mkdir()
+    bad.write_text(
+        "import time\n"
+        "async def handler():\n"
+        "    time.sleep(1)\n"
+        "    f = open('/tmp/x')\n"
+        "    def helper():\n"
+        "        open('/tmp/y')   # nested sync def: executor target\n"
+        "    return f\n")
+    sys.path.insert(0, os.path.join(HERE, "tools"))
+    try:
+        import check_blocking
+    finally:
+        sys.path.pop(0)
+    findings = check_blocking.scan(roots=("pkg",), base=str(tmp_path))
+    names = sorted(n for _, _, n in findings)
+    assert names == ["open", "time.sleep"], findings
+    bad.write_text(
+        "import time\n"
+        "async def handler():\n"
+        "    time.sleep(1)   # blocking-ok: test fixture\n")
+    assert check_blocking.scan(roots=("pkg",),
+                               base=str(tmp_path)) == []
+    # CLI contract: exit 1 on findings in the real tree would fail the
+    # build; here just confirm the entrypoint runs clean on the repo
+    tool = os.path.join(HERE, "tools", "check_blocking.py")
+    r = subprocess.run([sys.executable, tool], capture_output=True,
+                       text=True)
+    assert r.returncode == 0, r.stdout

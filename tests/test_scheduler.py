@@ -1,0 +1,431 @@
+"""Request-scheduler subsystem tests (yugabyte_db_tpu/sched/).
+
+Covers the PR-3 acceptance surface:
+- admission control + typed sheds (retry_after_ms) under fault-injected
+  lane stalls and forced sheds,
+- group-commit write batching is durability-equivalent to serial
+  writes (WAL replay parity after a tserver restart),
+- batched point-read and coalesced-scan responses byte-identical to
+  their unbatched (scheduler-off) equivalents,
+- client backoff honors retry_after_ms,
+- the maintenance lane cannot starve foreground reads,
+- per-connection messenger inflight cap,
+- scheduler off = direct dispatch (flag revert path).
+"""
+import asyncio
+import time
+
+import pytest
+
+from yugabyte_db_tpu.docdb.operations import ReadRequest, RowOp
+from yugabyte_db_tpu.docdb.wire import read_request_to_wire
+from yugabyte_db_tpu.models.ycsb import usertable_info
+from yugabyte_db_tpu.ops.scan import AggSpec
+from yugabyte_db_tpu.rpc.messenger import Messenger, RpcError
+from yugabyte_db_tpu.sched import Lane, OverloadError, RequestScheduler
+from yugabyte_db_tpu.sched.batching import ScanItem
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+from yugabyte_db_tpu.utils import fault_injection as fi
+from yugabyte_db_tpu.utils import flags
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    fi.clear_lane_stalls()
+    fi.clear_forced_sheds()
+    for f in ("scheduler_enabled", "sched_point_read_depth",
+              "sched_scan_depth", "sched_maintenance_depth",
+              "rpc_max_inflight_per_connection",
+              "sched_cut_through_min_interval_us"):
+        flags.REGISTRY.reset(f)
+
+
+async def _cluster(tmp, n_rows=400):
+    mc = await MiniCluster(str(tmp), num_tservers=1).start()
+    c = mc.client()
+    await c.create_table(usertable_info(), num_tablets=1,
+                         replication_factor=1)
+    await mc.wait_for_leaders("usertable")
+    rows = [{"ycsb_key": i,
+             **{f"field{j}": f"v{i}-{j}" for j in range(10)}}
+            for i in range(n_rows)]
+    await c.insert("usertable", rows)
+    return mc, c, rows
+
+
+class TestAdmission:
+    def test_stalled_lane_sheds_with_retry_after(self):
+        """Stall the scan lane; fill it past depth; admission must
+        shed with typed SERVICE_UNAVAILABLE + retry_after_ms while the
+        queue stays bounded."""
+        async def run():
+            flags.set_flag("sched_scan_depth", 8)
+            s = RequestScheduler("t-stall")
+            fi.stall_lane("scan")
+
+            async def work():
+                return {"ok": 1}
+
+            loop = asyncio.get_running_loop()
+            tasks = [loop.create_task(
+                s.submit_grouped(Lane.SCAN, ("sig", i), ScanItem(work)))
+                for i in range(30)]
+            await asyncio.sleep(0.1)    # sheds resolve; admitted park
+            sheds = [t.exception() for t in tasks
+                     if t.done() and t.exception() is not None]
+            assert sheds, "no sheds despite stalled lane over depth"
+            assert all(isinstance(e, OverloadError) for e in sheds)
+            assert all(e.code == "SERVICE_UNAVAILABLE" for e in sheds)
+            assert all(e.retry_after_ms >= 1 for e in sheds)
+            st = s.lanes[Lane.SCAN]
+            assert st.depth <= st.cfg.max_depth
+            # release: every admitted request completes
+            fi.release_lane("scan")
+            results = await asyncio.gather(*tasks,
+                                           return_exceptions=True)
+            done = [r for r in results if isinstance(r, dict)]
+            assert len(done) + len(sheds) == 30
+            await s.shutdown()
+        asyncio.run(run())
+
+    def test_forced_shed_rejects_everything(self):
+        async def run():
+            s = RequestScheduler("t-force")
+            fi.force_shed_lane("point_read")
+
+            async def work():
+                return 1
+            with pytest.raises(OverloadError) as ei:
+                await s.submit(Lane.POINT_READ, work)
+            assert ei.value.retry_after_ms >= 1
+            fi.clear_forced_sheds()
+            assert await s.submit(Lane.POINT_READ, work) == 1
+            await s.shutdown()
+        asyncio.run(run())
+
+    def test_retry_after_crosses_the_wire(self, tmp_path):
+        """A shed on the server arrives at a remote caller as an
+        RpcError with code + retry_after_ms intact."""
+        async def run():
+            mc, c, rows = await _cluster(tmp_path)
+            try:
+                fi.force_shed_lane("point_read")
+                ts = mc.tservers[0]
+                m = Messenger("probe")
+                ct = await c._table("usertable")
+                loc = ct.locations[0]
+                with pytest.raises(RpcError) as ei:
+                    await m.call(ts.messenger.addr, "tserver", "read",
+                                 {"tablet_id": loc.tablet_id,
+                                  "req": read_request_to_wire(ReadRequest(
+                                      ct.info.table_id,
+                                      pk_eq={"ycsb_key": 1}))},
+                                 timeout=5.0)
+                assert ei.value.code == "SERVICE_UNAVAILABLE"
+                assert ei.value.retry_after_ms >= 1
+                await m.shutdown()
+            finally:
+                fi.clear_forced_sheds()
+                await mc.shutdown()
+        asyncio.run(run())
+
+
+class TestGroupCommitDurability:
+    def test_replay_parity_with_serial_writes(self, tmp_path):
+        """Rows written through group commit must survive a tserver
+        restart (WAL replay) identical to rows written serially with
+        the scheduler off — same visible data either way."""
+        async def run():
+            mc, c, rows = await _cluster(tmp_path, n_rows=1)
+            try:
+                # concurrent single-row writes -> group commit merges
+                batch_rows = [
+                    {"ycsb_key": 1000 + i,
+                     **{f"field{j}": f"b{i}-{j}" for j in range(10)}}
+                    for i in range(60)]
+                await asyncio.gather(
+                    *[c.insert("usertable", [r]) for r in batch_rows])
+                # serial writes with the scheduler OFF (the baseline)
+                flags.set_flag("scheduler_enabled", False)
+                serial_rows = [
+                    {"ycsb_key": 2000 + i,
+                     **{f"field{j}": f"s{i}-{j}" for j in range(10)}}
+                    for i in range(20)]
+                for r in serial_rows:
+                    await c.insert("usertable", [r])
+                flags.set_flag("scheduler_enabled", True)
+                # fanin proves merging actually happened
+                ts = mc.tservers[0]
+                st = ts.scheduler.lanes[Lane.POINT_WRITE]
+                assert st.m_fanin._max and st.m_fanin._max > 1, \
+                    "group commit never merged anything"
+                # restart: WAL replay rebuilds state from the log
+                ts2 = await mc.restart_tserver(0)
+                await mc.wait_for_leaders("usertable")
+                for r in batch_rows + serial_rows:
+                    got = await c.get("usertable",
+                                      {"ycsb_key": r["ycsb_key"]})
+                    assert got == r, f"replay lost/changed {r['ycsb_key']}"
+            finally:
+                flags.set_flag("scheduler_enabled", True)
+                await mc.shutdown()
+        asyncio.run(run())
+
+    def test_same_key_last_write_wins_in_one_group(self, tmp_path):
+        """Two writes of the same key merged into one group: the later
+        member's value wins (write_id order preserves arrival order)."""
+        async def run():
+            mc, c, rows = await _cluster(tmp_path, n_rows=1)
+            try:
+                ts = mc.tservers[0]
+                tablet_id = (await c._table("usertable")) \
+                    .locations[0].tablet_id
+                peer = ts.peers[tablet_id]
+                from yugabyte_db_tpu.docdb.operations import WriteRequest
+                from yugabyte_db_tpu.sched.batching import (
+                    WriteItem, dispatch_write_group)
+                loop = asyncio.get_running_loop()
+                mk = lambda v: WriteRequest("usertable", [RowOp(
+                    "upsert", {"ycsb_key": 7,
+                               **{f"field{j}": v for j in range(10)}})])
+                items = [(WriteItem(peer, mk("first")),
+                          loop.create_future(), 0, 0.0),
+                         (WriteItem(peer, mk("second")),
+                          loop.create_future(), 0, 0.0)]
+                st = ts.scheduler.lanes[Lane.POINT_WRITE]
+                await dispatch_write_group(items, st.m_fanin)
+                got = await c.get("usertable", {"ycsb_key": 7})
+                assert got["field0"] == "second"
+            finally:
+                await mc.shutdown()
+        asyncio.run(run())
+
+
+class TestBatchedReadParity:
+    def test_batched_point_reads_byte_identical(self, tmp_path):
+        """Wire responses from the batched multi_get path must equal
+        the unbatched (scheduler-off) responses byte for byte."""
+        async def run():
+            mc, c, rows = await _cluster(tmp_path)
+            try:
+                ts = mc.tservers[0]
+                ct = await c._table("usertable")
+                loc = ct.locations[0]
+
+                def req(i, cols=()):
+                    return {"tablet_id": loc.tablet_id,
+                            "req": read_request_to_wire(ReadRequest(
+                                ct.info.table_id,
+                                columns=tuple(cols),
+                                pk_eq={"ycsb_key": i}))}
+                keys = list(range(0, 40)) + [9999]   # incl. a miss
+                # batched: concurrent -> grouped through the scheduler
+                batched = await asyncio.gather(
+                    *[ts.rpc_read(req(i)) for i in keys])
+                proj = await asyncio.gather(
+                    *[ts.rpc_read(req(i, ("ycsb_key", "field3")))
+                      for i in keys])
+                flags.set_flag("scheduler_enabled", False)
+                direct = [await ts.rpc_read(req(i)) for i in keys]
+                dproj = [await ts.rpc_read(req(i, ("ycsb_key",
+                                                   "field3")))
+                         for i in keys]
+                flags.set_flag("scheduler_enabled", True)
+                assert batched == direct
+                assert proj == dproj
+                import msgpack
+                assert msgpack.packb(batched) == msgpack.packb(direct)
+            finally:
+                await mc.shutdown()
+        asyncio.run(run())
+
+    def test_coalesced_scans_byte_identical(self, tmp_path):
+        """N identical aggregate scans coalesced into one execution
+        return exactly what N unbatched executions return."""
+        async def run():
+            mc, c, rows = await _cluster(tmp_path)
+            try:
+                ts = mc.tservers[0]
+                ct = await c._table("usertable")
+                loc = ct.locations[0]
+
+                def req():
+                    return {"tablet_id": loc.tablet_id,
+                            "req": read_request_to_wire(ReadRequest(
+                                ct.info.table_id,
+                                aggregates=(AggSpec("count"),
+                                            AggSpec("min", ("col", 0)),
+                                            AggSpec("max", ("col", 0)))))}
+                # force the coalescing path regardless of EWMA state
+                fi.stall_lane("scan")
+                loop = asyncio.get_running_loop()
+                tasks = [loop.create_task(ts.rpc_read(req()))
+                         for _ in range(10)]
+                await asyncio.sleep(0.05)   # all queued into one group
+                fi.release_lane("scan")
+                coalesced = await asyncio.gather(*tasks)
+                st = ts.scheduler.lanes[Lane.SCAN]
+                assert st.m_batch._max and st.m_batch._max >= 10
+                flags.set_flag("scheduler_enabled", False)
+                direct = await ts.rpc_read(req())
+                flags.set_flag("scheduler_enabled", True)
+                import msgpack
+                for r in coalesced:
+                    assert msgpack.packb(r) == msgpack.packb(direct)
+            finally:
+                await mc.shutdown()
+        asyncio.run(run())
+
+
+class TestClientBackoff:
+    def test_client_honors_retry_after(self, tmp_path):
+        """Two typed sheds carrying retry_after_ms=100 must make the
+        client sleep jittered-exponentially (>= 50ms then >= 100ms —
+        the jitter floor) before the third attempt succeeds."""
+        async def run():
+            mc, c, rows = await _cluster(tmp_path, n_rows=10)
+            try:
+                real_call = c.messenger.call
+                calls = {"shed": 0}
+
+                async def flaky(addr, service, method, payload,
+                                timeout=10.0):
+                    if method == "read" and calls["shed"] < 2:
+                        calls["shed"] += 1
+                        raise RpcError("overloaded",
+                                       "SERVICE_UNAVAILABLE",
+                                       retry_after_ms=100)
+                    return await real_call(addr, service, method,
+                                           payload, timeout=timeout)
+                c.messenger.call = flaky
+                t0 = time.monotonic()
+                got = await c.get("usertable", {"ycsb_key": 3})
+                dt = time.monotonic() - t0
+                assert got is not None and got["field0"] == "v3-0"
+                assert calls["shed"] == 2
+                # jitter floor: 0.5 * 100ms + 0.5 * 200ms = 150ms
+                assert dt >= 0.14, f"client did not back off: {dt:.3f}s"
+            finally:
+                await mc.shutdown()
+        asyncio.run(run())
+
+    def test_shed_window_heals_transparently(self, tmp_path):
+        """A forced-shed window that clears while the client is backing
+        off ends in success, not an error surfaced to the caller."""
+        async def run():
+            mc, c, rows = await _cluster(tmp_path, n_rows=10)
+            try:
+                fi.force_shed_lane("point_read")
+                asyncio.get_running_loop().call_later(
+                    0.05, fi.clear_forced_sheds)
+                got = await c.get("usertable", {"ycsb_key": 3})
+                assert got is not None and got["field0"] == "v3-0"
+            finally:
+                fi.clear_forced_sheds()
+                await mc.shutdown()
+        asyncio.run(run())
+
+
+class TestLaneIsolation:
+    def test_maintenance_cannot_starve_foreground_reads(self, tmp_path):
+        """Saturate + stall the maintenance lane; foreground point
+        reads must still be served promptly (separate lanes, separate
+        dispatch slots)."""
+        async def run():
+            mc, c, rows = await _cluster(tmp_path)
+            try:
+                ts = mc.tservers[0]
+                ct = await c._table("usertable")
+                loc = ct.locations[0]
+                fi.stall_lane("maintenance")
+                maint = [asyncio.get_running_loop().create_task(
+                    ts.rpc_flush({"tablet_id": loc.tablet_id}))
+                    for _ in range(8)]
+                await asyncio.sleep(0.02)
+                t0 = time.monotonic()
+                for i in range(20):
+                    got = await c.get("usertable", {"ycsb_key": i})
+                    assert got is not None
+                dt = time.monotonic() - t0
+                assert dt < 2.0, f"reads starved: {dt:.2f}s"
+                fi.release_lane("maintenance")
+                await asyncio.gather(*maint)
+            finally:
+                fi.clear_lane_stalls()
+                await mc.shutdown()
+        asyncio.run(run())
+
+
+class TestMessengerInflightCap:
+    def test_over_cap_frames_rejected_typed(self, tmp_path):
+        """One connection pipelining past the per-connection cap gets
+        typed SERVICE_UNAVAILABLE rejects; the server stays healthy and
+        serves the conn again afterwards."""
+        async def run():
+            mc, c, rows = await _cluster(tmp_path)
+            try:
+                flags.set_flag("rpc_max_inflight_per_connection", 4)
+                # stall the lane so inflight dispatch tasks pile up
+                fi.stall_lane("point_read")
+                ts = mc.tservers[0]
+                ct = await c._table("usertable")
+                loc = ct.locations[0]
+                m = Messenger("flood")
+
+                def req(i):
+                    return {"tablet_id": loc.tablet_id,
+                            "req": read_request_to_wire(ReadRequest(
+                                ct.info.table_id,
+                                pk_eq={"ycsb_key": i % 100}))}
+                tasks = [asyncio.get_running_loop().create_task(
+                    m.call(ts.messenger.addr, "tserver", "read",
+                           req(i), timeout=10.0)) for i in range(40)]
+                await asyncio.sleep(0.1)
+                fi.release_lane("point_read")
+                results = await asyncio.gather(*tasks,
+                                               return_exceptions=True)
+                sheds = [r for r in results if isinstance(r, RpcError)
+                         and r.code == "SERVICE_UNAVAILABLE"]
+                ok = [r for r in results if isinstance(r, dict)]
+                assert sheds, "cap never rejected"
+                assert all(r.retry_after_ms for r in sheds)
+                assert ok, "cap rejected everything"
+                # connection still usable
+                r = await m.call(ts.messenger.addr, "tserver", "read",
+                                 req(1), timeout=5.0)
+                assert r["rows"]
+                await m.shutdown()
+            finally:
+                fi.clear_lane_stalls()
+                await mc.shutdown()
+        asyncio.run(run())
+
+
+class TestFlagRevert:
+    def test_scheduler_off_is_direct_dispatch(self, tmp_path):
+        """scheduler_enabled=False reverts to the pre-scheduler path:
+        reads/writes work, no lane accounting moves."""
+        async def run():
+            flags.set_flag("scheduler_enabled", False)
+            mc, c, rows = await _cluster(tmp_path, n_rows=50)
+            try:
+                ts = mc.tservers[0]
+                before = {ln.value: st.m_admitted.value()
+                          for ln, st in ts.scheduler.lanes.items()}
+                await asyncio.gather(
+                    *[c.get("usertable", {"ycsb_key": i})
+                      for i in range(20)])
+                await c.insert("usertable", [{
+                    "ycsb_key": 999,
+                    **{f"field{j}": "x" for j in range(10)}}])
+                resp = await c.scan("usertable", ReadRequest(
+                    "", aggregates=(AggSpec("count"),)))
+                assert int(resp.agg_values[0]) == 51
+                after = {ln.value: st.m_admitted.value()
+                         for ln, st in ts.scheduler.lanes.items()}
+                assert before == after, "scheduler saw traffic while off"
+            finally:
+                flags.set_flag("scheduler_enabled", True)
+                await mc.shutdown()
+        asyncio.run(run())

@@ -32,6 +32,21 @@ def _item(x):
     return x
 
 
+def _overload_backoff_s(e: Exception, attempt: int,
+                        cap_s: float = 2.0) -> Optional[float]:
+    """Client half of the typed overload contract: a SERVICE_UNAVAILABLE
+    shed carrying retry_after_ms becomes a JITTERED EXPONENTIAL backoff
+    seeded by the server's own estimate — retries spread out instead of
+    stampeding back in lockstep (reference analog: client backoff on
+    "server overloaded" responses, async_rpc.cc retry delays)."""
+    ra = getattr(e, "retry_after_ms", None)
+    if not ra:
+        return None
+    import random
+    base = (ra / 1000.0) * (2 ** min(attempt, 5))
+    return min(cap_s, base) * random.uniform(0.5, 1.0)
+
+
 def _mm2(x, y, op):
     """None-aware scalar min/max (SQL: NULL is the identity)."""
     if x is None:
@@ -221,7 +236,8 @@ class YBClient:
                 except (asyncio.TimeoutError, OSError) as e:
                     last = e
                     continue
-            await asyncio.sleep(0.1 * (attempt + 1))
+            await asyncio.sleep(_overload_backoff_s(last, attempt)
+                                or 0.1 * (attempt + 1))
         raise last or RpcError("no master reachable", "TIMED_OUT")
 
     # --- DDL --------------------------------------------------------------
@@ -922,6 +938,7 @@ class YBClient:
             if la is not None:
                 addrs.append(la)
             addrs += [a for _, a in loc.replicas if a not in addrs]
+            overload_s: Optional[float] = None
             for addr in addrs:
                 try:
                     return await self.messenger.call(
@@ -932,14 +949,30 @@ class YBClient:
                         # the tablet split under us: the caller must
                         # re-route by key against fresh locations
                         raise
+                    if e.code == "SERVICE_UNAVAILABLE":
+                        # typed overload shed: honor the server's
+                        # retry_after_ms (jittered exponential) instead
+                        # of hammering the next replica immediately —
+                        # followers would only answer LEADER_NOT_READY
+                        # while adding load the server just asked us
+                        # to shed
+                        overload_s = _overload_backoff_s(e, attempt)
+                        if overload_s is not None:
+                            break
+                        continue
                     if e.code in ("LEADER_NOT_READY", "LEADER_HAS_NO_LEASE",
-                                  "NOT_FOUND", "NETWORK_ERROR",
-                                  "SERVICE_UNAVAILABLE"):
+                                  "NOT_FOUND", "NETWORK_ERROR"):
                         continue
                     raise
                 except (asyncio.TimeoutError, OSError) as e:
                     last_err = e
                     continue
+            if overload_s is not None:
+                # pure overload: the leader is alive, just shedding —
+                # back off and retry the SAME locations (no refresh:
+                # leadership did not move)
+                await asyncio.sleep(overload_s)
+                continue
             # refresh locations (leadership moved / tablet moved)
             await asyncio.sleep(0.1 * (attempt + 1))
             ct2 = await self._table(ct.info.name, refresh=True)

@@ -22,13 +22,25 @@ Services register as objects: `async def rpc_<method>(self, payload)`.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import itertools
 import logging
 import struct
+import time
 import zlib
 from typing import Any, Dict, Optional, Tuple
 
 import msgpack
+
+# Wall-in time of the frame the current handler task is serving (set
+# just before the dispatch task is created, so the task's context
+# captures it).  Lets downstream layers (the request scheduler) measure
+# TRUE wire inter-arrival: handler tasks run serially behind blocking
+# work, so admission-time stamps would inflate inter-arrival to
+# whatever the service time is and a concurrent burst would look like a
+# sequential trickle.  0.0 = not an RPC task (local call, internal).
+RECEIVED_AT: contextvars.ContextVar[float] = contextvars.ContextVar(
+    "rpc_received_at", default=0.0)
 
 _REQ = 0
 _RESP = 1
@@ -43,9 +55,22 @@ _COMPRESS_BIT = 0x8000_0000
 
 
 class RpcError(Exception):
-    def __init__(self, message: str, code: str = "REMOTE_ERROR"):
+    def __init__(self, message: str, code: str = "REMOTE_ERROR",
+                 retry_after_ms: Optional[int] = None):
         super().__init__(message)
         self.code = code
+        # typed overload pushback (SERVICE_UNAVAILABLE sheds): how long
+        # the caller should back off before retrying; carried across
+        # the wire in the error payload
+        self.retry_after_ms = retry_after_ms
+
+
+def _inflight_cap() -> int:
+    from ..utils import flags    # lazy: rpc must not import-cycle utils
+    try:
+        return int(flags.get("rpc_max_inflight_per_connection"))
+    except KeyError:
+        return 0
 
 
 _SIDECAR_EXT = 3
@@ -187,8 +212,10 @@ class Connection:
                 fut = self.pending.pop(call_id, None)
                 if fut is not None and not fut.done():
                     if kind == _ERR:
-                        fut.set_exception(RpcError(payload.get("message", ""),
-                                                   payload.get("code", "")))
+                        fut.set_exception(RpcError(
+                            payload.get("message", ""),
+                            payload.get("code", ""),
+                            retry_after_ms=payload.get("retry_after_ms")))
                     else:
                         fut.set_result(payload)
         except (asyncio.IncompleteReadError, ConnectionError, OSError,
@@ -262,6 +289,14 @@ class Messenger:
     def __init__(self, name: str = "messenger", tls=None):
         self.name = name
         self.tls_server, self.tls_client = tls if tls else (None, None)
+        # optional edge admission gate: probe(service, method, payload)
+        # -> retry_after_ms when the request should be shed BEFORE a
+        # dispatch task is spawned (reference analog: the queue-limit
+        # reject at the rpc/service_pool.cc edge).  Rejecting here costs
+        # a frame decode + one error frame — no task, no handler — so
+        # overload pushback consumes a fraction of a served call.  The
+        # tserver installs its scheduler's probe at construction.
+        self.overload_probe = None
         self.services: Dict[str, object] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._conns: Dict[Tuple[str, int], Connection] = {}
@@ -288,6 +323,13 @@ class Messenger:
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter):
         self._incoming.add(writer)
+        # per-connection inflight cap: one misbehaving client pipelining
+        # thousands of calls must not occupy every dispatch slot on the
+        # server — over-cap frames are rejected immediately with the
+        # typed overload status (+ retry_after_ms) instead of spawning
+        # an unbounded task per frame (reference analog: rpc queue
+        # limits in rpc/service_pool.cc)
+        inflight: set = set()
         try:
             while True:
                 try:
@@ -303,7 +345,31 @@ class Messenger:
                                                       msg[5])
                 except RpcError:
                     break   # oversized frame/sidecars: drop the conn
-                asyncio.create_task(self._dispatch(msg, writer))
+                cap = _inflight_cap()
+                if cap and len(inflight) >= cap and msg[1] == _REQ:
+                    writer.write(_pack([
+                        msg[0], _ERR, msg[2], msg[3],
+                        {"message": "connection over inflight cap "
+                                    f"({cap})",
+                         "code": "SERVICE_UNAVAILABLE",
+                         "retry_after_ms": 25}]))
+                    await writer.drain()
+                    continue
+                probe = self.overload_probe
+                if probe is not None and msg[1] == _REQ:
+                    ra = probe(msg[2], msg[3], msg[4])
+                    if ra:
+                        writer.write(_pack([
+                            msg[0], _ERR, msg[2], msg[3],
+                            {"message": "server overloaded",
+                             "code": "SERVICE_UNAVAILABLE",
+                             "retry_after_ms": int(ra)}]))
+                        await writer.drain()
+                        continue
+                RECEIVED_AT.set(time.monotonic())
+                t = asyncio.create_task(self._dispatch(msg, writer))
+                inflight.add(t)
+                t.add_done_callback(inflight.discard)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
@@ -329,8 +395,11 @@ class Messenger:
                     "unhandled error in %s.%s", service, method)
             code = getattr(e, "code", "REMOTE_ERROR")
             code = code.name if hasattr(code, "name") else str(code)
-            out = _pack([call_id, _ERR, service, method,
-                         {"message": str(e), "code": code}])
+            err = {"message": str(e), "code": code}
+            ra = getattr(e, "retry_after_ms", None)
+            if ra is not None:
+                err["retry_after_ms"] = int(ra)
+            out = _pack([call_id, _ERR, service, method, err])
         try:
             writer.write(out)
             await writer.drain()

@@ -1,0 +1,28 @@
+"""Admission-controlled request scheduler with dynamic micro-batching.
+
+The marshalling layer between RPC dispatch and tablet execution
+(Tailwind's framing: the accelerator boundary is a batching problem —
+work must arrive in accelerator-friendly chunks to amortize launch
+cost).  Three pieces:
+
+- lanes.py: classification of inbound work into priority lanes
+  (point read / point write / scan / txn / maintenance) with per-lane
+  depth and memory budgets.
+- scheduler.py: bounded admission (typed ServiceUnavailable +
+  retry_after_ms on overload instead of latency collapse), per-lane
+  worker pools, and dynamic micro-batch windows that coalesce
+  same-tablet point writes into one WAL append + one tablet apply
+  (group commit) and same-signature scans into one kernel launch
+  through the ops/scan.py signature-keyed kernel cache.
+
+The tserver routes its data-path RPCs through here when the
+`scheduler_enabled` runtime flag is on; flag off reverts to the
+direct-dispatch path.
+"""
+from .batching import PointReadItem, ScanItem, WriteItem
+from .lanes import Lane, LaneConfig, classify_read
+from .scheduler import OverloadError, RequestScheduler, canon
+
+__all__ = ["Lane", "LaneConfig", "OverloadError", "PointReadItem",
+           "RequestScheduler", "ScanItem", "WriteItem", "canon",
+           "classify_read"]

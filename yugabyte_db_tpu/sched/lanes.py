@@ -1,0 +1,71 @@
+"""Priority lanes: classification + per-lane budgets.
+
+Reference analog: the RPC ServicePool priority queues + tablet server
+admission gates (src/yb/rpc/service_pool.cc queue limit,
+tserver/tablet_server.cc memory-based throttling).  Ours classifies at
+the request level so the scheduler can apply per-class queueing,
+batching, and shedding policy instead of one FIFO for everything.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Optional
+
+
+class Lane(enum.Enum):
+    POINT_READ = "point_read"     # pk_eq / pk_prefix lookups
+    POINT_WRITE = "point_write"   # plain writes (group-commit eligible)
+    SCAN = "scan"                 # scans / aggregate pushdown (coalescible)
+    TXN = "txn"                   # txn control + intent writes (never queued
+    #                               behind each other: admission-only)
+    MAINTENANCE = "maintenance"   # compaction / flush / index builds
+
+
+@dataclass
+class LaneConfig:
+    """Budgets for one lane.
+
+    workers None = admission-only: the lane counts in-flight work and
+    sheds past its depth, but every admitted request dispatches
+    immediately (no worker pool).  Required for the TXN lane — txn
+    control ops can transitively depend on EACH OTHER (a conflict wait
+    resolves only when another txn's apply/rollback lands), so a
+    bounded worker pool could deadlock against itself.
+    """
+    max_depth: int                 # queued + inflight admission bound
+    soft_bytes: int                # memory-based soft limit (estimated)
+    workers: Optional[int] = None  # worker-pool size (None = admission-only)
+    max_batch: int = 1             # micro-batch cap (1 = no batching)
+    max_wait_us: int = 0           # micro-batch window upper bound
+
+
+# Defaults sized for the in-process cluster: deep enough that normal
+# test/bench traffic never sheds, bounded enough that a 2x-saturation
+# open loop sheds instead of stacking seconds of queue. Tunable via the
+# sched_* runtime flags (utils/flags.py), applied at scheduler
+# construction (tserver start).
+DEFAULT_CONFIGS = {
+    Lane.POINT_READ: LaneConfig(max_depth=512, soft_bytes=64 << 20,
+                                workers=16, max_batch=64,
+                                max_wait_us=1000),
+    Lane.POINT_WRITE: LaneConfig(max_depth=2048, soft_bytes=64 << 20,
+                                 workers=4, max_batch=64,
+                                 max_wait_us=1000),
+    Lane.SCAN: LaneConfig(max_depth=512, soft_bytes=128 << 20,
+                          workers=2, max_batch=32, max_wait_us=2000),
+    Lane.TXN: LaneConfig(max_depth=4096, soft_bytes=64 << 20,
+                         workers=None),
+    Lane.MAINTENANCE: LaneConfig(max_depth=64, soft_bytes=256 << 20,
+                                 workers=1),
+}
+
+
+def classify_read(req_wire: dict) -> Lane:
+    """Lane for a read request (wire dict): full-PK / hash-prefix
+    lookups are point reads; everything else (filter scans, aggregate
+    pushdown, paged scans) is scan-class work."""
+    if req_wire.get("pk_eq") is not None \
+            or req_wire.get("pk_prefix") is not None:
+        return Lane.POINT_READ
+    return Lane.SCAN

@@ -172,20 +172,27 @@ class Tablet:
         self._m_read_lat.increment((_perf_counter() - t0) * 1e6)
         return resp
 
-    def multi_read(self, table_id: str, pk_rows, read_ht=None):
+    def multi_read(self, table_id: str, pk_rows, read_ht=None,
+                   allow_restart=None):
         """Batched point reads: the engine seam where concurrent
         sessions' point lookups amortize per-op overhead (reference
         analog: pggate operation buffering / doc_op batching). Returns
-        a row dict (or None) per pk_row, all at one read point."""
+        a row dict (or None) per pk_row, all at one read point.
+        `allow_restart` defaults to "read point was server-assigned";
+        a caller that pre-assigned (and safe-time-waited) its own read
+        point but still wants uncertainty-window restarts — the
+        scheduler's batched read path — passes True explicitly."""
         t0 = _perf_counter()
         server_assigned = read_ht is None
+        if allow_restart is None:
+            allow_restart = server_assigned
         if server_assigned:
             read_ht = self.clock.now().value
         op = self._read_ops.get(table_id, self._read_op)
         for _attempt in range(3):
             try:
                 rows = op.multi_get(pk_rows, read_ht,
-                                    allow_restart=server_assigned)
+                                    allow_restart=allow_restart)
                 break
             except ReadRestartError as e:
                 read_ht = e.restart_ht

@@ -402,6 +402,45 @@ class TabletPeer:
                 pass
         return self.tablet.read(req)
 
+    async def read_points(self, table_id: str, pk_rows: list) -> list:
+        """Batched same-tablet strong point gets (the scheduler's
+        point-read micro-batch lands here): the split/leader/lease
+        gates, the server-assigned read point and the MVCC safe-time
+        wait run ONCE for the whole group — each member's read point is
+        at-or-above its own arrival, since the group formed before this
+        call — then the engine's fused multi_get serves every key in
+        one pass (same per-key result as read() with pk_eq; parity
+        pinned by tests/test_scheduler.py).  Returns a row-or-None per
+        pk_row."""
+        if self.split_done:
+            raise RpcError("tablet has been split", "TABLET_SPLIT")
+        if not self.consensus.is_leader():
+            raise RpcError(
+                f"not leader (hint={self.consensus.leader_hint()})",
+                "LEADER_NOT_READY")
+        if not self.consensus.has_leader_lease():
+            raise RpcError("leader lease expired", "LEADER_HAS_NO_LEASE")
+        read_ht = self.clock.now().value
+        import time as _time
+        deadline = _time.monotonic() + 10.0
+        while self.safe_read_ht(self.clock.now().value) < read_ht:
+            if _time.monotonic() > deadline:
+                raise RpcError("in-flight writes below the read time "
+                               "did not drain", "TIMED_OUT")
+            ev = self._progress_event
+            try:
+                await asyncio.wait_for(ev.wait(), 0.05)
+            except asyncio.TimeoutError:
+                pass
+        # read EXACTLY at the waited-out read point (a fresh clock.now
+        # inside multi_read could run ahead of a write queued during
+        # the wait — a write below the read point the wait never
+        # covered); allow_restart keeps the single-read contract's
+        # uncertainty-window restarts
+        return self.tablet.multi_read(table_id, pk_rows,
+                                      read_ht=read_ht,
+                                      allow_restart=True)
+
     def is_leader(self) -> bool:
         return self.consensus.is_leader()
 

@@ -62,10 +62,20 @@ async def serve(args):
         tservers.append(ts)
         print(f"tserver ts-{i}  : {addr[0]}:{addr[1]}")
     _save_ports(args.data_dir, ports)
-    web = StatusWebServer("ybtpu", extra_handlers=master.web_handlers())
+    def scheduler_handler():
+        # per-tserver request-scheduler lanes: depth/shed/wait/batch —
+        # the dashboard's scheduler panel and ops curl this
+        import json as _json
+        return _json.dumps(
+            {ts.uuid: {"enabled": ts.scheduler.enabled(),
+                       "lanes": ts.scheduler.stats()}
+             for ts in tservers}, indent=1), "application/json"
+
+    web = StatusWebServer("ybtpu", extra_handlers={
+        **master.web_handlers(), "/scheduler": scheduler_handler})
     waddr = await web.start(port=args.web_port)
     print(f"status ui     : http://{waddr[0]}:{waddr[1]}/metrics "
-          f"(/tables /tablet-servers /tablets /rpcz /ash)")
+          f"(/tables /tablet-servers /tablets /scheduler /rpcz /ash)")
 
     from ..client import YBClient
     client = YBClient(maddr)

@@ -22,6 +22,8 @@ from ..docdb.wire import (
 from ..dockv.partition import Partition
 from ..rpc.messenger import (Messenger, RpcError, Sidecars,
                              sidecar_ref)
+from ..sched import (Lane, PointReadItem, RequestScheduler, ScanItem,
+                     WriteItem, canon, classify_read)
 from ..tablet.tablet import Tablet
 from ..tablet.tablet_peer import TabletPeer
 import logging
@@ -63,6 +65,13 @@ class TabletServer:
         self._split_children: Dict[str, list] = {}
         self._hb_task: Optional[asyncio.Task] = None
         self._running = False
+        # admission-controlled scheduler between RPC dispatch and
+        # tablet execution (sched/): data-path RPCs route through it
+        # when `scheduler_enabled` is on; flag off = direct dispatch
+        self.scheduler = RequestScheduler(f"ts-{uuid}")
+        # edge gate: saturated-lane requests shed at the frame edge,
+        # before a dispatch task is even spawned
+        self.messenger.overload_probe = self.scheduler.overload_probe
         self.messenger.register_service("tserver", self)
 
     # --- lifecycle --------------------------------------------------------
@@ -78,6 +87,7 @@ class TabletServer:
         self._running = False
         if self._hb_task:
             self._hb_task.cancel()
+        await self.scheduler.shutdown()
         for p in self.peers.values():
             await p.shutdown()
         await self.messenger.shutdown()
@@ -94,7 +104,7 @@ class TabletServer:
             meta_path = os.path.join(root, tablet_id, "tablet-meta.json")
             if not os.path.exists(meta_path):
                 continue
-            with open(meta_path) as f:
+            with open(meta_path) as f:   # blocking-ok: tiny meta, startup
                 meta = json.load(f)
             await self._open_tablet(meta)
 
@@ -185,7 +195,7 @@ class TabletServer:
         mk = os.path.join(self._tablet_dir(tablet_id),
                           "split-complete.json")
         if os.path.exists(mk):
-            with open(mk) as f:
+            with open(mk) as f:   # blocking-ok: tiny split marker
                 mkd = json.load(f)
             par = mkd.get("parent")
             if par:
@@ -243,6 +253,7 @@ class TabletServer:
             await self._remote_bootstrap_fetch(
                 tuple(rb["addr"]), rb["tablet_id"], rb["snapshot_id"],
                 os.path.join(d, "regular"))
+        # blocking-ok: tiny metadata file
         with open(os.path.join(d, "tablet-meta.json"), "w") as f:
             json.dump(meta, f)
         peer = await self._open_tablet(meta)
@@ -273,11 +284,12 @@ class TabletServer:
         peer = self._peer(payload["tablet_id"])
         req = write_request_from_wire(payload["req"])
         if req.schema_version is not None:
-            # catalog-version fence: reject BEFORE replicating so a
-            # stale session's write (e.g. into a dropped column) can
-            # never reach the WAL; the client refreshes and retries
-            # (reference: schema version mismatch checks in
-            # tablet_service.cc + ysql_backends_manager.cc)
+            # catalog-version fence: reject BEFORE replicating (and
+            # before any scheduler queueing) so a stale session's write
+            # (e.g. into a dropped column) can never reach the WAL; the
+            # client refreshes and retries (reference: schema version
+            # mismatch checks in tablet_service.cc +
+            # ysql_backends_manager.cc)
             cur = peer.tablet.schema_version_of(req.table_id)
             if cur is not None and req.schema_version != cur:
                 raise RpcError(
@@ -286,16 +298,73 @@ class TabletServer:
                     "SCHEMA_MISMATCH")
         with TRACES.trace(f"write:{payload['tablet_id']}"):
             with wait_status("OnCpu_WriteApply"):
-                resp = await peer.write(req)
-        return {"rows_affected": resp.rows_affected}
+                if not self.scheduler.enabled():
+                    resp = await peer.write(req)
+                    return {"rows_affected": resp.rows_affected}
+                cost = 256 + 256 * len(req.ops)
+                # group commit merges only writes whose semantics are
+                # invariant under merging: same tablet + table + schema
+                # fence (the group key), no imported external HT, and
+                # no insert-if-absent ops (one duplicate would fail the
+                # whole merged batch's innocent neighbors)
+                if req.external_ht is None and \
+                        all(op.kind != "insert" for op in req.ops):
+                    key = (payload["tablet_id"], req.table_id,
+                           req.schema_version)
+                    return await self.scheduler.submit_grouped(
+                        Lane.POINT_WRITE, key, WriteItem(peer, req),
+                        cost_bytes=cost)
+
+                async def run():
+                    resp = await peer.write(req)
+                    return {"rows_affected": resp.rows_affected}
+                return await self.scheduler.submit(
+                    Lane.POINT_WRITE, run, cost_bytes=cost)
 
     async def rpc_read(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
-        req = read_request_from_wire(payload["req"])
-        with TRACES.trace(f"read:{payload['tablet_id']}"):
-            with wait_status("OnCpu_Read"):
-                resp = await peer.read(req)
-        return read_response_to_wire(resp)
+
+        async def run():
+            req = read_request_from_wire(payload["req"])
+            with TRACES.trace(f"read:{payload['tablet_id']}"):
+                with wait_status("OnCpu_Read"):
+                    resp = await peer.read(req)
+            return read_response_to_wire(resp)
+        if not self.scheduler.enabled():
+            return await run()
+        lane = classify_read(payload["req"])
+        if lane is Lane.POINT_READ:
+            r = payload["req"]
+            # batched multi_get eligibility: a plain strong point get
+            # with a server-assigned read point and no pushdown — the
+            # shape whose group shares one gate + read point + fused
+            # engine lookup (projection re-applied per member)
+            if (r.get("pk_eq") is not None and not r.get("where")
+                    and not r.get("aggregates")
+                    and r.get("read_ht") is None
+                    and not r.get("paging_state")
+                    and r.get("consistency", "strong") == "strong"):
+                key = ("pr", payload["tablet_id"], r["table_id"])
+                # trace/ASH here: the grouped dispatch never runs run(),
+                # so instrumentation must wrap the submit (span covers
+                # queue wait + the shared batched execution)
+                with TRACES.trace(f"read:{payload['tablet_id']}"):
+                    with wait_status("OnCpu_Read"):
+                        return await self.scheduler.submit_grouped(
+                            Lane.POINT_READ, key, PointReadItem(peer, r),
+                            cost_bytes=512)
+            return await self.scheduler.submit(Lane.POINT_READ, run,
+                                               cost_bytes=512)
+        # scan/aggregate: same-signature requests queued together
+        # execute ONCE — one batched kernel launch through the
+        # signature-keyed ops/scan.py cache — and share the response.
+        # The group executes with a read point resolved at dispatch
+        # (after every member arrived), so coalescing never serves a
+        # member data older than its own arrival; explicit read points
+        # are part of the signature (identical snapshot only).
+        sig = (payload["tablet_id"], canon(payload["req"]))
+        return await self.scheduler.submit_grouped(
+            Lane.SCAN, sig, ScanItem(run), cost_bytes=4096)
 
     async def rpc_alter_table(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
@@ -310,10 +379,10 @@ class TabletServer:
         peer.tablet.add_table(info)
         meta_path = os.path.join(self._tablet_dir(payload["tablet_id"]),
                                  "tablet-meta.json")
-        with open(meta_path) as f:
+        with open(meta_path) as f:   # blocking-ok: tiny metadata file
             meta = json.load(f)
         meta.setdefault("colocated_tables", []).append(payload["table"])
-        with open(meta_path, "w") as f:
+        with open(meta_path, "w") as f:   # blocking-ok: tiny metadata file
             json.dump(meta, f)
         return {"ok": True}
 
@@ -329,6 +398,7 @@ class TabletServer:
             timeout=30.0)
         for name, size in listing["files"]:
             out_path = os.path.join(dst_dir, name)
+            # blocking-ok: buffered writes of bounded 4MB chunks
             with open(out_path, "wb") as out:
                 offset = 0
                 while offset < size:
@@ -412,6 +482,7 @@ class TabletServer:
                 shutil.rmtree(p, ignore_errors=True)
             raise RpcError(f"tablet {tablet_id} went away during "
                            "snapshot fetch", "NOT_FOUND")
+        # blocking-ok: tiny metadata file
         with open(os.path.join(d, "tablet-meta.json")) as f:
             meta = json.load(f)
         await peer.shutdown()
@@ -420,10 +491,10 @@ class TabletServer:
             # authoritative; any crash from here rolls FORWARD at the
             # next open (see _complete_install_swap)
             marker = os.path.join(d, "install-commit")
-            with open(marker, "w") as f:
+            with open(marker, "w") as f:   # blocking-ok: commit marker
                 f.write(payload["snapshot_id"])
                 f.flush()
-                os.fsync(f.fileno())
+                os.fsync(f.fileno())   # blocking-ok: durable commit point
             self._complete_install_swap(d)
         finally:
             # reopen no matter what — a failed swap must not leave the
@@ -452,6 +523,7 @@ class TabletServer:
         path = os.path.join(d, name)
         if not os.path.isfile(path):
             raise RpcError(f"no such snapshot file {name}", "NOT_FOUND")
+        # blocking-ok: bounded 4MB chunk read (remote bootstrap)
         with open(path, "rb") as f:
             f.seek(payload.get("offset", 0))
             data = f.read(payload.get("length", 4 * 1024 * 1024))
@@ -605,6 +677,7 @@ class TabletServer:
             if os.path.exists(_marker(child_id)):
                 peer = self.peers.get(child_id)
                 if peer is None:
+                    # blocking-ok: tiny metadata file
                     with open(os.path.join(self._tablet_dir(child_id),
                                            "tablet-meta.json")) as f:
                         peer = await self._open_tablet(json.load(f))
@@ -671,7 +744,7 @@ class TabletServer:
                                  "tablet-meta.json")
         self._split_children[parent_id] = [d["left_id"], d["right_id"]]
         try:
-            with open(meta_path) as f:
+            with open(meta_path) as f:   # blocking-ok: tiny meta
                 pmeta = json.load(f)
             pmeta["split_done"] = True
             pmeta["split_children"] = [d["left_id"], d["right_id"]]
@@ -693,11 +766,20 @@ class TabletServer:
 
     async def rpc_flush(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
-        return {"path": peer.tablet.flush()}
+
+        async def run():
+            return {"path": peer.tablet.flush()}
+        return await self.scheduler.submit(Lane.MAINTENANCE, run)
 
     async def rpc_compact(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
-        return {"path": peer.tablet.compact()}
+
+        async def run():
+            # executor: the merge must not stall the event loop; the
+            # maintenance lane bounds how many run at once
+            return {"path": await asyncio.get_running_loop()
+                    .run_in_executor(None, peer.tablet.compact)}
+        return await self.scheduler.submit(Lane.MAINTENANCE, run)
 
     # --- transactions -------------------------------------------------------
     async def rpc_txn_write(self, payload) -> dict:
@@ -710,11 +792,20 @@ class TabletServer:
                     f"schema version mismatch for {req.table_id}: "
                     f"request {req.schema_version}, tablet {cur}",
                     "SCHEMA_MISMATCH")
-        n = await peer.write_txn(req, payload["txn_id"], payload["start_ht"],
-                                 payload.get("status_tablet"),
-                                 payload.get("op_read_hts"),
-                                 payload.get("sub_id", 0))
-        return {"rows_affected": n}
+
+        async def run():
+            n = await peer.write_txn(
+                req, payload["txn_id"], payload["start_ht"],
+                payload.get("status_tablet"),
+                payload.get("op_read_hts"), payload.get("sub_id", 0))
+            return {"rows_affected": n}
+        # TXN lane is admission-only (bounded + sheddable, but every
+        # admitted request dispatches immediately): an intent write may
+        # wait on a conflicting txn whose apply/rollback arrives as
+        # another request — queueing those behind each other in a
+        # bounded worker pool could deadlock
+        return await self.scheduler.submit(
+            Lane.TXN, run, cost_bytes=256 + 256 * len(req.ops))
 
     async def rpc_truncate_tablet(self, payload) -> dict:
         """Raft-replicated tablet truncate (reference: TruncateRequest
@@ -800,9 +891,11 @@ class TabletServer:
                                "TRY_AGAIN")
 
     async def rpc_apply_txn(self, payload) -> dict:
-        await self._drive_txn_decision(payload["tablet_id"], "apply_txn",
-                                       payload)
-        return {"ok": True}
+        async def run():
+            await self._drive_txn_decision(payload["tablet_id"],
+                                           "apply_txn", payload)
+            return {"ok": True}
+        return await self.scheduler.submit(Lane.TXN, run, cost_bytes=256)
 
     async def rpc_txn_lock_rows(self, payload) -> dict:
         """Bulk SERIALIZABLE read locks for rows a txn scanned (the SQL
@@ -829,9 +922,11 @@ class TabletServer:
         return {"ok": True}
 
     async def rpc_rollback_txn(self, payload) -> dict:
-        await self._drive_txn_decision(payload["tablet_id"],
-                                       "rollback_txn", payload)
-        return {"ok": True}
+        async def run():
+            await self._drive_txn_decision(payload["tablet_id"],
+                                           "rollback_txn", payload)
+            return {"ok": True}
+        return await self.scheduler.submit(Lane.TXN, run, cost_bytes=256)
 
     async def rpc_txn_get(self, payload) -> dict:
         """Point get inside a txn: own-intent overlay, else snapshot read
@@ -928,16 +1023,19 @@ class TabletServer:
     # --- vector indexes ------------------------------------------------------
     async def rpc_build_vector_index(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
-        # executor: the build (scan + k-means / graph construction)
-        # must not stall the event loop, and the per-index build lock
-        # serializes it against the background fold which also runs in
-        # an executor thread
-        n = await asyncio.get_running_loop().run_in_executor(
-            None, lambda: peer.tablet.build_vector_index(
-                payload["column"], payload.get("lists", 100),
-                payload.get("method", "ivfflat"),
-                payload.get("options")))
-        return {"indexed": n}
+
+        async def run():
+            # executor: the build (scan + k-means / graph construction)
+            # must not stall the event loop, and the per-index build
+            # lock serializes it against the background fold which also
+            # runs in an executor thread
+            n = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: peer.tablet.build_vector_index(
+                    payload["column"], payload.get("lists", 100),
+                    payload.get("method", "ivfflat"),
+                    payload.get("options")))
+            return {"indexed": n}
+        return await self.scheduler.submit(Lane.MAINTENANCE, run)
 
     async def rpc_vector_search(self, payload) -> dict:
         peer = self._peer(payload["tablet_id"])
@@ -1055,6 +1153,13 @@ class TabletServer:
             }
         return {"tablets": out}
 
+    async def rpc_scheduler_stats(self, payload) -> dict:
+        """Live scheduler lane stats (depths, sheds, wait/batch/fanin
+        histograms) — the webserver /scheduler endpoint and
+        profile_ycsb --json read these."""
+        return {"enabled": self.scheduler.enabled(),
+                "lanes": self.scheduler.stats()}
+
     async def rpc_status(self, payload) -> dict:
         return {
             "uuid": self.uuid,
@@ -1114,9 +1219,15 @@ class TabletServer:
                 for p in list(self.peers.values()):
                     try:
                         if p.is_leader() and p.tablet.num_sst_files() >= 4:
-                            await asyncio.get_running_loop().run_in_executor(
-                                None, lambda p=p: p.tablet.compact(
-                                    major=False))
+                            async def run(p=p):
+                                await asyncio.get_running_loop() \
+                                    .run_in_executor(
+                                        None, lambda: p.tablet.compact(
+                                            major=False))
+                            # maintenance lane: bounded + isolated from
+                            # the foreground lanes' dispatch slots
+                            await self.scheduler.submit(Lane.MAINTENANCE,
+                                                        run)
                     except Exception:
                         log.exception("background compaction failed for %s",
                                       p.tablet.tablet_id)

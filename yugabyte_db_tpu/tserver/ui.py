@@ -41,6 +41,7 @@ DASHBOARD_HTML = """<!doctype html>
  <div class="card"><h2>Tablets</h2><div id="tablets" class="muted">—</div></div>
  <div class="card"><h2>Active session history (60s)</h2><div id="ash" class="muted">—</div></div>
  <div class="card"><h2>xCluster safe time</h2><div id="xcl" class="muted">—</div></div>
+ <div class="card" style="grid-column: 1 / -1"><h2>Request scheduler</h2><div id="sched" class="muted">—</div></div>
 </div>
 <script>
 async function j(path) {
@@ -63,9 +64,9 @@ function stat(label, value) {
   return `<div><div class="num">${esc(value)}</div><div class="statlbl">${esc(label)}</div></div>`;
 }
 async function tick() {
-  const [st, ts, tables, tablets, ash, xcl] = await Promise.all([
+  const [st, ts, tables, tablets, ash, xcl, sched] = await Promise.all([
     j('/status'), j('/tablet-servers'), j('/tables'), j('/tablets'),
-    j('/ash'), j('/xcluster-safe-time')]);
+    j('/ash'), j('/xcluster-safe-time'), j('/scheduler')]);
   document.getElementById('hdr').textContent =
     st ? `cluster "${st.name}" · ${new Date().toLocaleTimeString()}` : 'unreachable';
   const live = ts ? ts.filter(s => s.alive).length : 0;
@@ -107,6 +108,25 @@ async function tick() {
     document.getElementById('xcl').innerHTML = rows.length
       ? tbl(['table', 'safe hybrid time'], rows)
       : '<span class="muted">no inbound replication</span>';
+  }
+  if (sched) {
+    // one row per (tserver, lane): live depth, sheds, queue-wait p99,
+    // micro-batch / group-commit fan-in
+    const rows = [];
+    for (const [uuid, s] of Object.entries(sched)) {
+      for (const [lane, v] of Object.entries(s.lanes || {})) {
+        rows.push([uuid, lane, v.depth,
+          v.shed ? {html: `<span class="bad">${esc(v.shed)}</span>`} : 0,
+          v.admitted, (v.wait_us && v.wait_us.p99 / 1000).toFixed(1),
+          (v.batch_size && v.batch_size.mean) || '—',
+          (v.group_commit_fanin && v.group_commit_fanin.count)
+            ? v.group_commit_fanin.mean : '—']);
+      }
+    }
+    document.getElementById('sched').innerHTML = rows.length
+      ? tbl(['tserver', 'lane', 'depth', 'shed', 'admitted',
+             'wait p99 ms', 'batch', 'fanin'], rows)
+      : '<span class="muted">scheduler off</span>';
   }
 }
 tick(); setInterval(tick, 2000);

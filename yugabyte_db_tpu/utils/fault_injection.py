@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import random
 import threading
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict
 
 from . import flags
 from .status import StatusError, io_error
@@ -72,3 +72,67 @@ def MAYBE_FAULT(fraction_flag: str = "TEST_fault_crash_fraction") -> None:
     frac = flags.get(fraction_flag)
     if frac and _rng.random() < frac:
         raise StatusError(io_error(f"injected fault ({fraction_flag})"))
+
+
+# --- scheduler lane hooks -------------------------------------------------
+# Deterministic overload drivers for the request scheduler (sched/):
+# a STALLED lane's workers hold before dispatch (admission keeps
+# running, so the queue fills and typed sheds become observable); a
+# FORCE-SHED lane rejects every admission with the typed
+# SERVICE_UNAVAILABLE + retry_after_ms. Both are no-ops unless a test
+# arms them — the TEST_ gflag pattern.
+
+_lane_stalls: Dict[str, object] = {}     # lane name -> asyncio.Event
+_forced_sheds: set = set()
+
+
+def stall_lane(lane: str, event=None):
+    """Arm a stall on `lane`; returns the release Event (creates one
+    when not given). Workers dispatching that lane wait on it."""
+    import asyncio
+    ev = event or asyncio.Event()
+    with _lock:
+        _lane_stalls[lane] = ev
+    return ev
+
+
+def release_lane(lane: str) -> None:
+    with _lock:
+        ev = _lane_stalls.pop(lane, None)
+    if ev is not None:
+        ev.set()
+
+
+def clear_lane_stalls() -> None:
+    with _lock:
+        evs = list(_lane_stalls.values())
+        _lane_stalls.clear()
+    for ev in evs:
+        ev.set()
+
+
+async def lane_stall_wait(lane: str) -> None:
+    """Called by scheduler workers before dispatching a group."""
+    ev = _lane_stalls.get(lane)
+    if ev is not None:
+        await ev.wait()
+
+
+def force_shed_lane(lane: str) -> None:
+    with _lock:
+        _forced_sheds.add(lane)
+
+
+def clear_forced_sheds() -> None:
+    with _lock:
+        _forced_sheds.clear()
+
+
+def lane_shed_forced(lane: str) -> bool:
+    return lane in _forced_sheds
+
+
+def lane_armed(lane: str) -> bool:
+    """True when a stall is armed on `lane` — the scheduler's inline
+    cut-through is skipped so the stall (worker-path) applies."""
+    return lane in _lane_stalls

@@ -208,6 +208,57 @@ DEFINE_RUNTIME("history_retention_interval_sec", 900,
 DEFINE_RUNTIME("encrypt_data_at_rest", False,
                "Encrypt SST files with the active universe key.")
 
+# --- request scheduler (sched/) -------------------------------------------
+DEFINE_RUNTIME("scheduler_enabled", True,
+               "Route tserver data-path RPCs through the admission-"
+               "controlled request scheduler (priority lanes, typed "
+               "overload sheds, dynamic micro-batching). Off = the "
+               "direct per-RPC dispatch path.")
+DEFINE_RUNTIME("sched_point_read_depth", 512,
+               "Point-read lane admission bound (queued + inflight): "
+               "bounds worst-case queueing of admitted point reads to "
+               "depth/drain-rate; past it the lane sheds with "
+               "retry_after_ms and the client backs off.")
+DEFINE_RUNTIME("sched_point_write_depth", 2048,
+               "Point-write lane admission bound.")
+DEFINE_RUNTIME("sched_scan_depth", 512,
+               "Scan/aggregate lane admission bound.")
+DEFINE_RUNTIME("sched_txn_depth", 4096,
+               "Txn lane admission bound (admission-only: txn control "
+               "never queues behind txn control, which could deadlock).")
+DEFINE_RUNTIME("sched_maintenance_depth", 64,
+               "Maintenance lane admission bound.")
+DEFINE_RUNTIME("sched_read_max_batch", 64,
+               "Point-read batching cap: same-tablet strong point gets "
+               "coalesced into one engine multi_get (one leader/lease "
+               "gate + one read point + one fused lookup).")
+DEFINE_RUNTIME("sched_read_max_wait_us", 1000,
+               "Upper bound of the adaptive point-read micro-batch "
+               "window.")
+DEFINE_RUNTIME("sched_write_max_batch", 64,
+               "Group-commit cap: same-tablet plain writes coalesced "
+               "into one WAL append + one tablet apply.")
+DEFINE_RUNTIME("sched_write_max_wait_us", 1000,
+               "Upper bound of the adaptive write micro-batch window; "
+               "the actual wait adapts to the arrival rate and is zero "
+               "on an idle lane.")
+DEFINE_RUNTIME("sched_scan_max_batch", 32,
+               "Scan-coalescing cap: same-signature scans share one "
+               "batched kernel launch.")
+DEFINE_RUNTIME("sched_scan_max_wait_us", 2000,
+               "Upper bound of the adaptive scan micro-batch window.")
+DEFINE_RUNTIME("sched_cut_through_min_interval_us", 500,
+               "Below this recent inter-arrival time a lane stops "
+               "inline cut-through dispatch and defers to the "
+               "queue+worker path so same-sweep arrivals coalesce "
+               "into one batch (the engine is synchronous: inline "
+               "execution leaves no await-window to batch in).")
+DEFINE_RUNTIME("rpc_max_inflight_per_connection", 1024,
+               "Per-connection dispatch-slot cap: frames past this many "
+               "in-flight calls on one connection are rejected with the "
+               "typed overload status, so one misbehaving client cannot "
+               "occupy every dispatch slot.")
+
 # TEST_ flags (reference: DEFINE_test_flag, util/flags/flag_tags.h:311)
 DEFINE_RUNTIME("TEST_fault_crash_fraction", 0.0,
                "Probabilistic fault injection fraction (MAYBE_FAULT analog).")
