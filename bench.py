@@ -364,6 +364,33 @@ def warn_regressed_ratios(node, path="", out=None):
     return out
 
 
+def warn_suppression_growth(base_dir=None):
+    """Collect WARN lines when the static-analysis suppression count
+    grew past tools/analyze/baseline.json — annotations accreting
+    instead of hazards being fixed is its own regression."""
+    here = base_dir or os.path.dirname(os.path.abspath(__file__))
+    out = []
+    try:
+        sys.path.insert(0, os.path.join(here, "tools"))
+        try:
+            from analyze import ALL_PASSES, ProjectIndex, run_analysis
+        finally:
+            sys.path.pop(0)
+        with open(os.path.join(here, "tools", "analyze",
+                               "baseline.json")) as f:
+            baseline = json.load(f)["suppressions"]
+        report = run_analysis(ProjectIndex(here), ALL_PASSES)
+        for pass_id, n in sorted(report["suppressions"].items()):
+            if n > baseline.get(pass_id, 0):
+                out.append(
+                    f"analysis suppressions for {pass_id} grew to {n} "
+                    f"(baseline {baseline.get(pass_id, 0)}) — fix the "
+                    f"hazard or commit a new baseline deliberately")
+    except Exception as e:   # noqa: BLE001 — account, don't fail bench
+        out.append(f"analysis suppression check failed: {e!r:.120}")
+    return out
+
+
 def _make_compaction_tablet(data, n_ssts, rows_per_sst, tag):
     """A tablet with `n_ssts` SSTables: sequential loads with 25%
     overlapping (re-written) keys so the merge has real MVCC work
@@ -829,6 +856,8 @@ def main():
     for path, v in warn_regressed_ratios(line):
         print(f"WARN: ratio {path}={v} regressed past its threshold",
               file=sys.stderr)
+    for msg in warn_suppression_growth():
+        print(f"WARN: {msg}", file=sys.stderr)
 
 
 if __name__ == "__main__":

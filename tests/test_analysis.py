@@ -1,0 +1,408 @@
+"""tools/analyze/ wired into tier-1.
+
+Three layers:
+
+1. PASS FIXTURES — for each of the five passes: a true positive the
+   pass must catch, the same hazard suppressed with a reasoned
+   annotation, and a clean negative that must NOT fire (the negatives
+   encode the idioms the real tree depends on — `.shape` math inside
+   jit bodies, executor-target sync defs, async-with on asyncio locks).
+2. WHOLE-TREE — the real `yugabyte_db_tpu/` must produce ZERO
+   unannotated findings, so any new hazard is a failing build from the
+   day the pass shipped.
+3. CONTRACTS — the run.py --json schema (pass ids, counts, findings,
+   suppression tally, per-pass wall time), the suppression-vs-baseline
+   tally bench.py WARNs on, and the wall-time budget that keeps the
+   sweep from bloating the tier-1 timeout.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(HERE, "tools"))
+from analyze import (ALL_PASSES, ProjectIndex, get_pass,  # noqa: E402
+                     run_analysis)
+
+#: generous ceiling for the whole five-pass sweep over the full tree —
+#: the sweep measures ~2-6s here; the budget exists so a pass that goes
+#: accidentally quadratic fails tier-1 instead of eating the 870s cap.
+WALL_BUDGET_MS = 60_000
+
+
+def _run(tmp_path, files, pass_id):
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    index = ProjectIndex(str(tmp_path), roots=("pkg",))
+    return run_analysis(index, [get_pass(pass_id)])
+
+
+def _findings(report):
+    return [(f["path"], f["line"], f["detail"]) for f in report["findings"]]
+
+
+# --- 1. per-pass fixtures --------------------------------------------------
+
+class TestAsyncBlocking:
+    def test_true_positive(self, tmp_path):
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import time, os, subprocess
+            async def handler():
+                time.sleep(1)
+                os.fsync(3)
+                subprocess.run(["ls"])
+            """}, "async_blocking")
+        assert sorted(d for _, _, d in _findings(r)) == [
+            "os.fsync", "subprocess.run", "time.sleep"]
+
+    def test_suppressed_with_reason(self, tmp_path):
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import time
+            async def handler():
+                time.sleep(1)   # analysis-ok(async_blocking): test stall
+                time.sleep(2)   # blocking-ok: legacy alias honored
+            """}, "async_blocking")
+        assert r["findings"] == []
+        assert r["suppressions"]["async_blocking"] == 2
+
+    def test_bare_marker_suppresses_nothing(self, tmp_path):
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import time
+            async def handler():
+                time.sleep(1)   # analysis-ok(async_blocking):
+            """}, "async_blocking")
+        assert len(r["findings"]) == 1   # reason is mandatory
+
+    def test_clean_negative(self, tmp_path):
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import asyncio, time
+            def sync_helper():
+                time.sleep(1)            # sync context: fine
+            async def handler():
+                await asyncio.sleep(1)   # the correct spelling
+                def executor_target():
+                    time.sleep(1)        # nested sync def: fine
+                return executor_target
+            """}, "async_blocking")
+        assert r["findings"] == []
+
+
+class TestLockHeldAwait:
+    def test_true_positive(self, tmp_path):
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                async def work(self):
+                    with self._lock:
+                        await self.other()
+            """}, "lock_held_await")
+        assert [(l, d) for _, l, d in _findings(r)] == [(7, "self._lock")]
+
+    def test_suppressed_with_reason(self, tmp_path):
+        r = _run(tmp_path, {"pkg/a.py": """\
+            class C:
+                async def work(self):
+                    with self._lock:
+                        # analysis-ok(lock_held_await): lock-free await
+                        await self.other()
+            """}, "lock_held_await")
+        assert r["findings"] == []
+        assert r["suppressions"]["lock_held_await"] == 1
+
+    def test_clean_negative(self, tmp_path):
+        r = _run(tmp_path, {"pkg/a.py": """\
+            class C:
+                async def work(self):
+                    async with self._alock:     # asyncio lock: fine
+                        await self.other()
+                    with self._lock:
+                        self.x = 1              # no await held: fine
+                    with self._lock:
+                        def helper():           # nested def: its own
+                            pass                # awaits, its own locks
+                    await self.other()
+            """}, "lock_held_await")
+        assert r["findings"] == []
+
+
+class TestJitHazards:
+    def test_true_positives(self, tmp_path):
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import jax, numpy as np
+            import jax.numpy as jnp
+            from functools import partial
+            @partial(jax.jit, static_argnames=("k",))
+            def kern(x, y, k):
+                if y > 0:                 # python branch on traced
+                    x = x + 1
+                v = float(x)              # host cast
+                w = np.asarray(y)         # host numpy mid-trace
+                s = x.sum().item()        # host sync
+                return x + k
+            def driver(a):
+                return kern(jnp.zeros(50000), a, k=4)   # literal shape
+            """}, "jit_hazards")
+        details = sorted(d for _, _, d in _findings(r))
+        assert details == ["kern:float", "kern:if", "kern:item",
+                           "kern:jnp.zeros", "kern:np.asarray"]
+
+    def test_suppressed_with_reason(self, tmp_path):
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import jax
+            @jax.jit
+            def kern(x):
+                if x > 0:  # analysis-ok(jit_hazards): proven static
+                    return x
+                return -x
+            """}, "jit_hazards")
+        assert r["findings"] == []
+        assert r["suppressions"]["jit_hazards"] == 1
+
+    def test_clean_negative_shape_math_untaints(self, tmp_path):
+        # the exact idiom ops/compaction.py + vector/ivf.py live on:
+        # .shape unpacking yields static python ints, branches and
+        # range() over them are fine, as is jax.jit-by-assignment
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import jax
+            import jax.numpy as jnp
+            from functools import partial
+            @partial(jax.jit, static_argnames=("num_words",))
+            def kern(words, ht, num_words):
+                n = words.shape[0]
+                ops = tuple(words[:, i] for i in range(1, num_words))
+                if num_words > 2:            # static arg: fine
+                    ht = ht + 1
+                first = jnp.where(ht > 0, words[:, 0], jnp.uint64(0))
+                c = min(num_words, n)        # static math: fine
+                return first, ops, c
+            def _raw(x):
+                m = x.shape[1]
+                return x.reshape(x.shape[0] * m)
+            fn = jax.jit(_raw)
+            def debug_path():
+                # direct raw call runs EAGERLY — no compile, no trap
+                return _raw(jnp.zeros((4, 500)))
+            class Unrelated:
+                def kern(self):          # leaf-name collision: fine
+                    return jnp.ones(128)
+            """}, "jit_hazards")
+        assert r["findings"] == []
+
+
+class TestFlagDrift:
+    FILES = {
+        "pkg/flags.py": """\
+            def DEFINE_RUNTIME(name, default, help=""):
+                pass
+            DEFINE_RUNTIME("used_flag", 7, "wired below")
+            DEFINE_RUNTIME("dead_flag", 1, "nobody reads this")
+            DEFINE_RUNTIME("sched_point_read_depth", 512, "dynamic read")
+            DEFINE_RUNTIME("doc_flag", 4, "defaults to 9")
+            DEFINE_RUNTIME("doc_flag2", 4, "window size (default: 3)")
+            DEFINE_RUNTIME("doc_flag3", 4, "uses the default backend")
+            """,
+        "pkg/user.py": """\
+            from . import flags
+            def f(lane):
+                a = flags.get("used_flag")
+                b = flags.get(f"sched_{lane}_depth")
+                c = flags.get("missing_flag")
+                return a, b, c
+            """,
+    }
+
+    def test_true_positives(self, tmp_path):
+        r = _run(tmp_path, dict(self.FILES), "flag_drift")
+        got = {(p, d) for p, _, d in _findings(r)}
+        assert ("pkg/flags.py", "dead_flag") in got       # never read
+        assert ("pkg/user.py", "missing_flag") in got     # never defined
+        assert ("pkg/flags.py", "doc_flag") in got        # help disagrees
+        assert ("pkg/flags.py", "doc_flag2") in got       # "(default: 3)"
+        # prose "the default backend" is not a value claim
+        assert not any(d == "doc_flag3" and "documents default" in
+                       f["message"] for f, (_, _, d) in
+                       zip(r["findings"], _findings(r)))
+        # dynamic f-string read covers the sched_*_depth flag
+        assert not any(d == "sched_point_read_depth" for _, _, d in
+                       _findings(r))
+
+    def test_duplicate_default_drift(self, tmp_path):
+        files = dict(self.FILES)
+        files["pkg/extra.py"] = """\
+            from .flags import DEFINE_RUNTIME
+            DEFINE_RUNTIME("used_flag", 8, "second default loses")
+            """
+        r = _run(tmp_path, files, "flag_drift")
+        assert any(d == "used_flag" and "re-defined" in m for (_, _, d), m
+                   in zip(_findings(r),
+                          [f["message"] for f in r["findings"]]))
+
+    def test_suppressed_with_reason(self, tmp_path):
+        files = dict(self.FILES)
+        files["pkg/flags.py"] = files["pkg/flags.py"].replace(
+            'DEFINE_RUNTIME("dead_flag", 1, "nobody reads this")',
+            'DEFINE_RUNTIME("dead_flag", 1, "r")  '
+            '# analysis-ok(flag_drift): reserved for r07')
+        r = _run(tmp_path, files, "flag_drift")
+        assert not any(d == "dead_flag" for _, _, d in _findings(r))
+        assert r["suppressions"]["flag_drift"] == 1
+
+    def test_clean_negative(self, tmp_path):
+        r = _run(tmp_path, {
+            "pkg/flags.py": """\
+                def DEFINE_RUNTIME(name, default, help=""):
+                    pass
+                DEFINE_RUNTIME("wired", True, "read next door")
+                """,
+            "pkg/user.py": """\
+                from . import flags
+                def f():
+                    data = {}
+                    data.get("not_a_flag")    # dict get: out of scope
+                    return flags.get("wired")
+                """}, "flag_drift")
+        assert r["findings"] == []
+
+
+class TestSharedStateRaces:
+    def test_true_positive(self, tmp_path):
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import asyncio
+            class Srv:
+                def flush(self):
+                    self.stats["flushes"] = 1      # thread side
+                async def handler(self):
+                    self.stats["reads"] = 2        # loop side
+                async def kick(self):
+                    loop = asyncio.get_running_loop()
+                    await loop.run_in_executor(None, self.flush)
+            """}, "shared_state_races")
+        assert [d for _, _, d in _findings(r)] == ["Srv.stats"]
+
+    def test_executor_lambda_counts_as_thread_side(self, tmp_path):
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import asyncio
+            class Srv:
+                async def handler(self):
+                    self.stats["reads"] = 2          # loop side
+                async def kick(self):
+                    loop = asyncio.get_running_loop()
+                    await loop.run_in_executor(
+                        None, lambda: self.stats.update(x=1))
+            """}, "shared_state_races")
+        assert [d for _, _, d in _findings(r)] == ["Srv.stats"]
+
+    def test_suppressed_with_reason(self, tmp_path):
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import asyncio
+            class Srv:
+                def flush(self):
+                    # analysis-ok(shared_state_races): torn-read-safe
+                    self.n = 1
+                async def handler(self):
+                    self.n = 2
+                async def kick(self):
+                    loop = asyncio.get_running_loop()
+                    await loop.run_in_executor(None, self.flush)
+            """}, "shared_state_races")
+        assert r["findings"] == []
+        assert r["suppressions"]["shared_state_races"] == 1
+
+    def test_clean_negative_locked_both_sides(self, tmp_path):
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import asyncio, threading
+            class Srv:
+                def flush(self):
+                    with self._lock:
+                        self.stats["flushes"] = 1
+                async def handler(self):
+                    with self._lock:
+                        self.stats["reads"] = 2
+                async def kick(self):
+                    loop = asyncio.get_running_loop()
+                    await loop.run_in_executor(None, self.flush)
+            class NotATarget:
+                def helper(self):
+                    self.x = 1      # never shipped to an executor
+                async def h(self):
+                    self.x = 2
+            """}, "shared_state_races")
+        assert r["findings"] == []
+
+
+# --- 2 + 3. whole tree, schema, budget, baseline ---------------------------
+
+@pytest.fixture(scope="module")
+def tree_report():
+    index = ProjectIndex(HERE)
+    return run_analysis(index, ALL_PASSES)
+
+
+def test_whole_tree_zero_unannotated_findings(tree_report):
+    assert tree_report["parse_errors"] == [], tree_report["parse_errors"]
+    assert tree_report["findings"] == [], (
+        "unannotated static-analysis findings — fix them or annotate "
+        "with `# analysis-ok(<pass>): <reason>`:\n" + "\n".join(
+            f"{f['path']}:{f['line']}: [{f['pass']}] {f['message']}"
+            for f in tree_report["findings"]))
+
+
+def test_all_five_passes_ran(tree_report):
+    assert [p["id"] for p in tree_report["passes"]] == [
+        "async_blocking", "lock_held_await", "jit_hazards",
+        "flag_drift", "shared_state_races"]
+
+
+def test_wall_time_budget(tree_report):
+    # r05 carry-over hygiene: the sweep must not bloat tier-1
+    assert tree_report["wall_ms"] < WALL_BUDGET_MS, tree_report["passes"]
+    for p in tree_report["passes"]:
+        assert p["wall_ms"] >= 0.0
+
+
+def test_suppressions_do_not_exceed_baseline(tree_report):
+    with open(os.path.join(HERE, "tools", "analyze",
+                           "baseline.json")) as f:
+        baseline = json.load(f)["suppressions"]
+    for pass_id, n in tree_report["suppressions"].items():
+        assert n <= baseline.get(pass_id, 0), (
+            f"suppression count for {pass_id} grew to {n} vs committed "
+            f"baseline {baseline.get(pass_id, 0)} — fix the hazard or "
+            f"bump tools/analyze/baseline.json deliberately")
+
+
+def test_run_py_json_schema():
+    r = subprocess.run(
+        [sys.executable, os.path.join(HERE, "tools", "analyze", "run.py"),
+         "--json"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    for key in ("passes", "findings", "suppressions", "total_findings",
+                "total_suppressed", "wall_ms", "parse_errors"):
+        assert key in report, key
+    assert report["total_findings"] == 0
+    assert set(report["suppressions"]) == {p.id for p in ALL_PASSES}
+    for p in report["passes"]:
+        assert {"id", "title", "findings", "suppressed",
+                "wall_ms"} <= set(p)
+
+
+def test_run_py_exits_nonzero_on_findings(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "import time\nasync def h():\n    time.sleep(1)\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(HERE, "tools", "analyze", "run.py"),
+         "--base", str(tmp_path), "--pass", "async_blocking", "pkg"],
+        capture_output=True, text=True)
+    assert r.returncode == 1, r.stdout
+    assert "time.sleep" in r.stdout

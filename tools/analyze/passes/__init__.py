@@ -1,0 +1,33 @@
+"""Pass registry: one module per pass, ``PASS`` is the singleton.
+
+Adding a pass (see ANALYSIS.md):
+1. subclass :class:`analyze.core.AnalysisPass` in a new module here,
+2. export a ``PASS`` instance and add it to ``ALL_PASSES``,
+3. give tests/test_analysis.py a true-positive, a suppressed, and a
+   clean-negative fixture for it,
+4. run ``python tools/analyze/run.py`` and fix or annotate what it
+   finds — the whole-tree tier-1 sweep must stay at zero.
+"""
+from . import (async_blocking, flag_drift, jit_hazards, lock_held_await,
+               shared_state_races)
+
+ALL_PASSES = (
+    async_blocking.PASS,
+    lock_held_await.PASS,
+    jit_hazards.PASS,
+    flag_drift.PASS,
+    shared_state_races.PASS,
+)
+
+_BY_ID = {p.id: p for p in ALL_PASSES}
+
+
+def get_pass(pass_id: str):
+    try:
+        return _BY_ID[pass_id]
+    except KeyError:
+        raise KeyError(
+            f"unknown pass {pass_id!r}; known: {sorted(_BY_ID)}") from None
+
+
+__all__ = ["ALL_PASSES", "get_pass"]

@@ -1,0 +1,84 @@
+"""Pass: blocking calls lexically inside ``async def`` bodies.
+
+The scheduler (yugabyte_db_tpu/sched/) multiplexes every lane's
+dispatch over one event loop, so a synchronous stall inside an async
+handler no longer slows one RPC — it freezes admission, batching
+windows, Raft heartbeats and lease renewal for the whole server.
+
+Generalizes the original tools/check_blocking.py pass (tserver/ + rpc/
+only; time.sleep / open / os.fsync) to the whole tree with a wider
+offender set.  Nested sync ``def`` bodies are NOT flagged — they are
+frequently executor targets; nested async defs get their own scan.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import AnalysisPass, Finding, ModuleInfo, ProjectIndex, call_name
+
+#: dotted call names that stall the loop.  Name-based on purpose: the
+#: analyzer never imports the code it checks.  `open` covers the sync
+#: read/write family (a handle opened on the loop gets read on the
+#: loop); socket module resolvers/connects block on the network.
+BLOCKING = {
+    "time.sleep",
+    "open", "io.open",
+    "os.fsync", "os.fdatasync", "os.sync",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output",
+    "socket.create_connection", "socket.getaddrinfo",
+    "socket.gethostbyname", "socket.gethostbyaddr",
+    "shutil.copyfile", "shutil.copytree", "shutil.rmtree",
+    "os.replace", "os.rename",
+}
+
+_HINTS = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "open": "wrap in `run_in_executor` for anything non-trivial",
+    "io.open": "wrap in `run_in_executor` for anything non-trivial",
+    "os.fsync": "fsync is a device stall; move it to an executor",
+    "os.fdatasync": "fdatasync is a device stall; move it to an executor",
+}
+_DEFAULT_HINT = ("move the call into `run_in_executor`, or annotate "
+                 "`# analysis-ok(async_blocking): <reason>` if the stall "
+                 "is genuinely bounded")
+
+
+class AsyncBlockingPass(AnalysisPass):
+    id = "async_blocking"
+    title = "blocking call inside async def"
+    hint = _DEFAULT_HINT
+
+    def run(self, index: ProjectIndex) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in index.modules():
+            if mod.tree is not None:
+                self.scan_module(mod, out)
+        return out
+
+    def scan_module(self, mod: ModuleInfo, out: List[Finding]) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                for stmt in node.body:
+                    self._scan(mod, stmt, out)
+
+    def _scan(self, mod: ModuleInfo, node: ast.AST,
+              out: List[Finding]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.Lambda)):
+            return                      # executor-target territory
+        if isinstance(node, ast.AsyncFunctionDef):
+            return                      # scanned by its own walk visit
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in BLOCKING:
+                out.append(self.finding(
+                    mod, node.lineno,
+                    f"blocking call `{name}` inside async def",
+                    detail=name,
+                    hint=_HINTS.get(name, _DEFAULT_HINT)))
+        for child in ast.iter_child_nodes(node):
+            self._scan(mod, child, out)
+
+
+PASS = AsyncBlockingPass()

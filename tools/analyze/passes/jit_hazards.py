@@ -1,0 +1,352 @@
+"""Pass: host-sync / shape hazards inside jitted kernel bodies.
+
+The Q1/Q6 and compaction north-star numbers depend on the compile-once
+property: every kernel compiles once per pow2 shape bucket and never
+syncs the host mid-trace.  Three mechanical ways the tree can lose it:
+
+1. HOST SYNC inside a jit body — ``.item()`` / ``.tolist()`` /
+   ``.block_until_ready()`` on a traced value, ``float()``/``int()`` of
+   a traced value, ``np.asarray`` of a traced value.  Under tracing
+   these either error late or silently fall back to op-by-op dispatch.
+2. PYTHON CONTROL FLOW on a traced value — ``if``/``while``/``assert``
+   on a tensor makes the trace shape- or value-dependent (a recompile
+   per branch at best, TracerBoolConversionError at worst).
+3. LITERAL SHAPES at jitted call sites — building a literal-shaped
+   array directly in the call (``kernel(jnp.zeros(50000), ...)``)
+   bypasses the pow2-bucket helpers, so every input size is a fresh
+   compile.
+
+Taintedness is tracked intraprocedurally: non-static parameters are
+traced; ``.shape`` / ``.ndim`` / ``.dtype`` / ``.size`` and ``len()``
+of a traced value are Python-static and UNTAINT, which is exactly the
+idiom the repo's kernels use for bucket math.
+
+Jitted functions are found by decorator (``@jax.jit``,
+``@partial(jax.jit, static_argnames=...)``) and by assignment
+(``fn = jax.jit(raw)`` where ``raw`` is a module-local def).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import AnalysisPass, Finding, ModuleInfo, ProjectIndex, call_name
+
+_UNTAINT_ATTRS = {"shape", "ndim", "dtype", "size"}
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_HOST_CAST_FUNCS = {"float", "int", "bool", "complex"}
+_HOST_ASARRAY = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",
+                 "onp.asarray", "onp.array"}
+_ARRAY_CTORS = {f"{m}.{f}" for m in ("np", "jnp", "numpy", "jax.numpy")
+                for f in ("zeros", "ones", "empty", "full", "arange")}
+
+
+def _jit_target(dec: ast.expr) -> Optional[Tuple[bool, ast.expr]]:
+    """Is this decorator / call expression a jax.jit wrapper?  Returns
+    (True, call_node_or_None) when it is."""
+    if isinstance(dec, (ast.Attribute, ast.Name)):
+        name = ast.unparse(dec)
+        if name in ("jax.jit", "jit"):
+            return True, None
+    if isinstance(dec, ast.Call):
+        fname = call_name(dec)
+        if fname in ("jax.jit", "jit"):
+            return True, dec
+        if fname in ("partial", "functools.partial") and dec.args:
+            inner = dec.args[0]
+            if isinstance(inner, (ast.Attribute, ast.Name)) \
+                    and ast.unparse(inner) in ("jax.jit", "jit"):
+                return True, dec
+    return None
+
+
+def _static_names(call: Optional[ast.Call],
+                  fn: Optional[ast.FunctionDef]) -> Set[str]:
+    """static_argnames / static_argnums out of a jit/partial call."""
+    out: Set[str] = set()
+    if call is None:
+        return out
+    params: List[str] = []
+    if fn is not None:
+        a = fn.args
+        params = [x.arg for x in a.posonlyargs + a.args]
+    for kw in call.keywords:
+        v = kw.value
+        if kw.arg == "static_argnames":
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                out.add(v.value)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                out.update(e.value for e in v.elts
+                           if isinstance(e, ast.Constant)
+                           and isinstance(e.value, str))
+        elif kw.arg == "static_argnums":
+            nums = []
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                nums = [v.value]
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                nums = [e.value for e in v.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, int)]
+            out.update(params[i] for i in nums if 0 <= i < len(params))
+        elif kw.arg is not None and fn is not None:
+            # partial(jax.jit, ...) can't bind kernel params, but
+            # partial(kernel, x=...) style never reaches here
+            pass
+    return out
+
+
+class JitHazardsPass(AnalysisPass):
+    id = "jit_hazards"
+    title = "host-sync / shape hazard inside a jitted kernel"
+    hint = ("keep jit bodies pure-traced: jnp.where over Python if, "
+            "static_argnames for real constants, pow2 bucket helpers "
+            "for shapes")
+
+    def run(self, index: ProjectIndex) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in index.modules():
+            if mod.tree is not None:
+                self._scan_module(mod, out)
+        return out
+
+    # --- per-module -------------------------------------------------------
+    def _scan_module(self, mod: ModuleInfo, out: List[Finding]) -> None:
+        defs: Dict[str, ast.FunctionDef] = {}
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.FunctionDef):
+                defs.setdefault(node.name, node)
+
+        jitted: Dict[str, Tuple[ast.FunctionDef, Set[str]]] = {}
+        jitted_names: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.FunctionDef):
+                for dec in node.decorator_list:
+                    hit = _jit_target(dec)
+                    if hit:
+                        statics = _static_names(hit[1], node)
+                        jitted[node.name] = (node, statics)
+                        jitted_names.add(node.name)
+            elif isinstance(node, ast.Assign) and isinstance(node.value,
+                                                             ast.Call):
+                hit = _jit_target(node.value)
+                if hit and hit[1] is not None and hit[1].args:
+                    wrapped = hit[1].args[0]
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            jitted_names.add(tgt.id)
+                    if isinstance(wrapped, ast.Name) \
+                            and wrapped.id in defs:
+                        fn = defs[wrapped.id]
+                        jitted[fn.name] = (fn,
+                                           _static_names(hit[1], fn))
+
+        for fn, statics in jitted.values():
+            self._scan_jit_fn(mod, fn, statics, out)
+        # jitted_names holds the actual jitted callables: decorated def
+        # names + jit()-assignment targets.  A raw def wrapped by
+        # assignment is deliberately NOT included — calling it directly
+        # runs eagerly (no compile, no trap).
+        self._scan_call_sites(mod, jitted_names, out)
+
+    # --- call-site literal-shape check ------------------------------------
+    def _scan_call_sites(self, mod: ModuleInfo, jitted_names: Set[str],
+                         out: List[Finding]) -> None:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            # bare-Name calls only: jitted names are module-local, and
+            # leaf-matching attribute calls would flag any method that
+            # happens to share a jitted fn's name
+            if not (isinstance(node.func, ast.Name)
+                    and node.func.id in jitted_names):
+                continue
+            fname = node.func.id
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Call) \
+                        and call_name(arg) in _ARRAY_CTORS and arg.args \
+                        and _is_literal_shape(arg.args[0]):
+                    out.append(self.finding(
+                        mod, arg.lineno,
+                        f"literal-shaped `{call_name(arg)}` built at the "
+                        f"call site of jitted `{fname}` — bypasses the "
+                        f"pow2 bucket helpers, so each input size is a "
+                        f"fresh compile",
+                        detail=f"{fname}:{call_name(arg)}",
+                        hint="round the shape through the module's pow2 "
+                             "bucket helper before the kernel call"))
+
+    # --- jit-body taint walk ----------------------------------------------
+    def _scan_jit_fn(self, mod: ModuleInfo, fn: ast.FunctionDef,
+                     statics: Set[str], out: List[Finding]) -> None:
+        a = fn.args
+        params = [x.arg for x in a.posonlyargs + a.args + a.kwonlyargs]
+        taint = {p for p in params if p not in statics}
+        self._scan_block(mod, fn.body, taint, fn.name, out)
+
+    def _scan_block(self, mod: ModuleInfo, stmts, taint: Set[str],
+                    where: str, out: List[Finding]) -> None:
+        for s in stmts:
+            self._scan_stmt(mod, s, taint, where, out)
+
+    def _scan_stmt(self, mod: ModuleInfo, s: ast.stmt, taint: Set[str],
+                   where: str, out: List[Finding]) -> None:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested body sees outer traced names as closures; its own
+            # params shadow (we cannot know if they are traced)
+            inner = set(taint)
+            ia = s.args
+            for x in ia.posonlyargs + ia.args + ia.kwonlyargs:
+                inner.discard(x.arg)
+            self._scan_block(mod, s.body, inner, where, out)
+            return
+        if isinstance(s, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = s.value
+            t = self._expr(mod, value, taint, where, out) \
+                if value is not None else False
+            targets = s.targets if isinstance(s, ast.Assign) else [s.target]
+            if isinstance(s, ast.AugAssign):
+                t = t or self._names_tainted(s.target, taint)
+            for tgt in targets:
+                self._mark(tgt, taint, t)
+            return
+        if isinstance(s, ast.For):
+            it = self._expr(mod, s.iter, taint, where, out)
+            if it:
+                out.append(self.finding(
+                    mod, s.lineno,
+                    f"Python `for` over a traced value in jitted "
+                    f"`{where}` — unrolls per element (or errors); use "
+                    f"lax.scan/fori_loop",
+                    detail=f"{where}:for"))
+            self._mark(s.target, taint, it)
+            self._scan_block(mod, s.body, taint, where, out)
+            self._scan_block(mod, s.orelse, taint, where, out)
+            return
+        if isinstance(s, (ast.If, ast.While)):
+            kind = "if" if isinstance(s, ast.If) else "while"
+            if self._expr(mod, s.test, taint, where, out):
+                out.append(self.finding(
+                    mod, s.lineno,
+                    f"Python `{kind}` on a traced value in jitted "
+                    f"`{where}` — shape/value-dependent trace (use "
+                    f"jnp.where / lax.cond)",
+                    detail=f"{where}:{kind}"))
+            self._scan_block(mod, s.body, taint, where, out)
+            self._scan_block(mod, s.orelse, taint, where, out)
+            return
+        if isinstance(s, ast.Assert):
+            if self._expr(mod, s.test, taint, where, out):
+                out.append(self.finding(
+                    mod, s.lineno,
+                    f"assert on a traced value in jitted `{where}` — "
+                    f"bool() of a tracer",
+                    detail=f"{where}:assert"))
+            return
+        # generic statements: scan child expressions / blocks
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, ast.expr):
+                self._expr(mod, child, taint, where, out)
+            elif isinstance(child, ast.stmt):
+                self._scan_stmt(mod, child, taint, where, out)
+            elif isinstance(child, (ast.excepthandler, ast.withitem)):
+                for sub in ast.iter_child_nodes(child):
+                    if isinstance(sub, ast.expr):
+                        self._expr(mod, sub, taint, where, out)
+                    elif isinstance(sub, ast.stmt):
+                        self._scan_stmt(mod, sub, taint, where, out)
+
+    @staticmethod
+    def _mark(target: ast.expr, taint: Set[str], tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            (taint.add if tainted else taint.discard)(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                JitHazardsPass._mark(e, taint, tainted)
+        elif isinstance(target, ast.Starred):
+            JitHazardsPass._mark(target.value, taint, tainted)
+
+    @staticmethod
+    def _names_tainted(e: ast.expr, taint: Set[str]) -> bool:
+        return any(isinstance(n, ast.Name) and n.id in taint
+                   for n in ast.walk(e))
+
+    def _expr(self, mod: ModuleInfo, e: ast.expr, taint: Set[str],
+              where: str, out: List[Finding]) -> bool:
+        """Scan an expression for hazards; returns its taintedness."""
+        if isinstance(e, ast.Name):
+            return e.id in taint
+        if isinstance(e, ast.Constant):
+            return False
+        if isinstance(e, ast.Attribute):
+            base = self._expr(mod, e.value, taint, where, out)
+            if e.attr in _UNTAINT_ATTRS:
+                return False            # Python-static under tracing
+            return base
+        if isinstance(e, ast.Lambda):
+            return False                # opaque; sort keys etc.
+        if isinstance(e, ast.IfExp):
+            if self._expr(mod, e.test, taint, where, out):
+                out.append(self.finding(
+                    mod, e.lineno,
+                    f"conditional expression on a traced value in jitted "
+                    f"`{where}` — use jnp.where",
+                    detail=f"{where}:ifexp"))
+            t1 = self._expr(mod, e.body, taint, where, out)
+            t2 = self._expr(mod, e.orelse, taint, where, out)
+            return t1 or t2
+        if isinstance(e, ast.Call):
+            fname = call_name(e)
+            func_taint = False
+            if isinstance(e.func, ast.Attribute):
+                func_taint = self._expr(mod, e.func.value, taint,
+                                        where, out)
+                if e.func.attr in _HOST_SYNC_METHODS and func_taint:
+                    out.append(self.finding(
+                        mod, e.lineno,
+                        f"host sync `.{e.func.attr}()` on a traced value "
+                        f"in jitted `{where}`",
+                        detail=f"{where}:{e.func.attr}"))
+            arg_taints = [self._expr(mod, a, taint, where, out)
+                          for a in e.args]
+            kw_taints = [self._expr(mod, k.value, taint, where, out)
+                         for k in e.keywords]
+            any_arg = any(arg_taints) or any(kw_taints)
+            if fname in _HOST_CAST_FUNCS and any_arg:
+                out.append(self.finding(
+                    mod, e.lineno,
+                    f"`{fname}()` of a traced value in jitted `{where}` "
+                    f"— forces a host sync / concretization",
+                    detail=f"{where}:{fname}"))
+            if fname in _HOST_ASARRAY and any_arg:
+                out.append(self.finding(
+                    mod, e.lineno,
+                    f"`{fname}` of a traced value in jitted `{where}` — "
+                    f"pulls the value to host numpy mid-trace",
+                    detail=f"{where}:{fname}"))
+            if fname == "len":
+                return False            # len of traced is static
+            return func_taint or any_arg
+        if isinstance(e, (ast.ListComp, ast.SetComp, ast.DictComp,
+                          ast.GeneratorExp)):
+            # approximate: tainted if any comprehension input is
+            return self._names_tainted(e, taint)
+        # BinOp/UnaryOp/Compare/BoolOp/Subscript/Tuple/... : any child
+        t = False
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.expr):
+                t = self._expr(mod, child, taint, where, out) or t
+            elif isinstance(child, ast.comprehension):
+                t = self._names_tainted(child, taint) or t
+        return t
+
+
+def _is_literal_shape(e: ast.expr) -> bool:
+    if isinstance(e, ast.Constant) and isinstance(e.value, int):
+        return True
+    if isinstance(e, (ast.Tuple, ast.List)):
+        return all(isinstance(x, ast.Constant) and isinstance(x.value, int)
+                   for x in e.elts) and bool(e.elts)
+    return False
+
+
+PASS = JitHazardsPass()

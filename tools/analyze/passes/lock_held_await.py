@@ -1,0 +1,66 @@
+"""Pass: ``await`` while holding a SYNC lock.
+
+Inside an async function, ``with self._lock: ... await ...`` parks the
+coroutine while a *threading* lock stays held.  Every other task on the
+loop that touches the same lock then blocks the whole loop (the classic
+asyncio deadlock), and the critical section's invariants span an
+arbitrary suspension point.  ``async with`` on an asyncio.Lock is the
+correct spelling and is not flagged — awaiting under an async lock is
+the normal cooperative pattern.
+
+The pass is lexical: an ``await`` anywhere inside a sync ``with``
+statement whose context expression looks like a lock (terminal name
+matches lock/mutex/rlock), stopping at nested function boundaries.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import (AnalysisPass, Finding, ModuleInfo, ProjectIndex,
+                    is_lockish)
+
+
+class LockHeldAwaitPass(AnalysisPass):
+    id = "lock_held_await"
+    title = "await while holding a sync lock"
+    hint = ("use `asyncio.Lock` + `async with`, or move the await out "
+            "of the critical section")
+
+    def run(self, index: ProjectIndex) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in index.modules():
+            if mod.tree is None:
+                continue
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.AsyncFunctionDef):
+                    for stmt in node.body:
+                        self._scan(mod, stmt, None, out)
+        return out
+
+    def _scan(self, mod: ModuleInfo, node: ast.AST, held: str,
+              out: List[Finding]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return      # a nested function's awaits run on its own call
+        if isinstance(node, ast.With):
+            lockish = [ast.unparse(i.context_expr) for i in node.items
+                       if is_lockish(i.context_expr)]
+            inner = held or (lockish[0] if lockish else None)
+            for item in node.items:     # `with await acquire():` edge
+                self._scan(mod, item, held, out)
+            for child in node.body:
+                self._scan(mod, child, inner, out)
+            return
+        if isinstance(node, ast.Await) and held is not None:
+            out.append(self.finding(
+                mod, node.lineno,
+                f"await while holding sync lock `{held}` — other tasks "
+                f"contending on it will block the event loop",
+                detail=held))
+            # keep walking: the awaited expression may nest more awaits
+        for child in ast.iter_child_nodes(node):
+            self._scan(mod, child, held, out)
+
+
+PASS = LockHeldAwaitPass()

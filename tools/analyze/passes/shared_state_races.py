@@ -1,0 +1,229 @@
+"""Pass: attributes mutated from both an executor thread and the loop.
+
+The scheduler/compaction overlap creates exactly this bug shape: the
+tserver ships ``tablet.compact`` / ``tablet.flush`` to
+``run_in_executor`` threads while async handlers keep serving reads and
+maintenance against the same object.  Any instance attribute both sides
+mutate without a shared lock is a data race (list/dict corruption under
+the C-API, torn multi-field invariants even under the GIL).
+
+Two phases over the whole tree:
+
+1. collect executor-target names — the callables handed to
+   ``run_in_executor(...)``, ``<pool>.submit(...)`` and
+   ``threading.Thread(target=...)``; for ``self.tablet.flush`` the
+   terminal attr ``flush`` is recorded (cross-object resolution is
+   name-based on purpose: the pass runs without imports).
+2. per class: a sync method whose name is an executor target is
+   THREAD-side; every async method is LOOP-side.  An attribute with an
+   unlocked write on one side and any write on the other is a finding
+   (locked-vs-unlocked still races — both sides must hold the lock).
+
+Writes = ``self.X = / += ...``, ``self.X[...] = ...``, and mutator
+calls (``self.X.append/update/pop/...``).  A write lexically inside
+``with <lock>:`` / ``async with <lock>:`` counts as locked.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import (AnalysisPass, Finding, ModuleInfo, ProjectIndex,
+                    call_name, is_lockish, terminal_attr)
+
+_MUTATORS = {"append", "extend", "insert", "add", "update", "pop",
+             "popitem", "clear", "remove", "discard", "sort",
+             "appendleft", "popleft", "setdefault"}
+
+
+def _executor_targets(mods: List[ModuleInfo]) -> Set[str]:
+    targets: Set[str] = set()
+
+    def note(expr: ast.expr) -> None:
+        if isinstance(expr, ast.Call):   # partial(self.m, ...) et al.
+            if expr.args:
+                note(expr.args[0])
+            for kw in expr.keywords:
+                note(kw.value)
+            return
+        if isinstance(expr, ast.Lambda):
+            return   # no name to match; _scan_class reads its body
+        t = terminal_attr(expr)
+        if t:
+            targets.add(t)
+
+    for mod in mods:
+        if mod.tree is None:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = call_name(node)
+            leaf = fname.split(".")[-1]
+            if leaf == "run_in_executor" and len(node.args) >= 2:
+                note(node.args[1])
+            elif leaf == "submit" and node.args and (
+                    "executor" in fname.lower() or "pool" in fname.lower()):
+                note(node.args[0])
+            elif leaf == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        note(kw.value)
+    return targets
+
+
+class _Write:
+    __slots__ = ("attr", "line", "locked", "method")
+
+    def __init__(self, attr: str, line: int, locked: bool, method: str):
+        self.attr = attr
+        self.line = line
+        self.locked = locked
+        self.method = method
+
+
+def _collect_writes(fn, method: str) -> List[_Write]:
+    out: List[_Write] = []
+
+    def self_attr(expr: ast.expr) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            return expr.attr
+        return None
+
+    def scan(node: ast.AST, locked: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            inner = locked or any(is_lockish(i.context_expr)
+                                  for i in node.items)
+            for child in node.body:
+                scan(child, inner)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                base = tgt
+                if isinstance(base, (ast.Subscript,)):
+                    base = base.value
+                a = self_attr(base)
+                if a:
+                    out.append(_Write(a, node.lineno, locked, method))
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS:
+            a = self_attr(node.func.value)
+            if a:
+                out.append(_Write(a, node.lineno, locked, method))
+        for child in ast.iter_child_nodes(node):
+            scan(child, locked)
+
+    for stmt in fn.body:
+        scan(stmt, False)
+    return out
+
+
+def _executor_lambda(call: ast.Call) -> Optional[ast.Lambda]:
+    """The Lambda handed to an executor in this call, if any —
+    `run_in_executor(None, lambda: ...)` has no name for the phase-1
+    target set, so its body is read directly where it appears."""
+    fname = call_name(call)
+    leaf = fname.split(".")[-1]
+    cand: Optional[ast.expr] = None
+    if leaf == "run_in_executor" and len(call.args) >= 2:
+        cand = call.args[1]
+    elif leaf == "submit" and call.args and (
+            "executor" in fname.lower() or "pool" in fname.lower()):
+        cand = call.args[0]
+    elif leaf == "Thread":
+        for kw in call.keywords:
+            if kw.arg == "target":
+                cand = kw.value
+    return cand if isinstance(cand, ast.Lambda) else None
+
+
+def _lambda_writes(lam: ast.Lambda, method: str) -> List[_Write]:
+    """Mutator calls on self attributes inside a lambda body (a lambda
+    can't assign to attributes, so mutators are the only write form)."""
+    out: List[_Write] = []
+    for node in ast.walk(lam.body):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS \
+                and isinstance(node.func.value, ast.Attribute) \
+                and isinstance(node.func.value.value, ast.Name) \
+                and node.func.value.value.id == "self":
+            out.append(_Write(node.func.value.attr, node.lineno, False,
+                              f"{method}:<lambda>"))
+    return out
+
+
+class SharedStateRacesPass(AnalysisPass):
+    id = "shared_state_races"
+    title = "attribute mutated from executor thread and event loop"
+    hint = ("guard both sides with one threading.Lock (the loop side "
+            "holds it only for the mutation, never across an await), "
+            "or confine the attribute to one context")
+
+    def run(self, index: ProjectIndex) -> List[Finding]:
+        out: List[Finding] = []
+        mods = index.modules()
+        targets = _executor_targets(mods)
+        # no early-out on an empty target set: inline executor lambdas
+        # contribute thread-side writes without a name to match
+        for mod in mods:
+            if mod.tree is None:
+                continue
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.ClassDef):
+                    self._scan_class(mod, node, targets, out)
+        return out
+
+    def _scan_class(self, mod: ModuleInfo, cls: ast.ClassDef,
+                    targets: Set[str], out: List[Finding]) -> None:
+        thread_writes: List[_Write] = []
+        loop_writes: List[_Write] = []
+        for item in cls.body:
+            if isinstance(item, ast.FunctionDef) and item.name in targets \
+                    and item.name != "__init__":
+                thread_writes.extend(_collect_writes(item, item.name))
+            elif isinstance(item, ast.AsyncFunctionDef):
+                loop_writes.extend(_collect_writes(item, item.name))
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # inline executor lambdas mutate on a thread no matter
+                # which kind of method ships them
+                for node in ast.walk(item):
+                    if isinstance(node, ast.Call):
+                        lam = _executor_lambda(node)
+                        if lam is not None:
+                            thread_writes.extend(
+                                _lambda_writes(lam, item.name))
+        if not thread_writes or not loop_writes:
+            return
+        by_attr_thread: Dict[str, List[_Write]] = {}
+        for w in thread_writes:
+            by_attr_thread.setdefault(w.attr, []).append(w)
+        by_attr_loop: Dict[str, List[_Write]] = {}
+        for w in loop_writes:
+            by_attr_loop.setdefault(w.attr, []).append(w)
+        for attr in sorted(set(by_attr_thread) & set(by_attr_loop)):
+            tw = by_attr_thread[attr]
+            lw = by_attr_loop[attr]
+            unlocked = [w for w in tw + lw if not w.locked]
+            if not unlocked:
+                continue
+            anchor = unlocked[0]
+            t0, l0 = tw[0], lw[0]
+            out.append(self.finding(
+                mod, anchor.line,
+                f"`{cls.name}.{attr}` is mutated from executor-target "
+                f"`{t0.method}` (line {t0.line}) and async "
+                f"`{l0.method}` (line {l0.line}) without a shared "
+                f"lock on both sides",
+                detail=f"{cls.name}.{attr}"))
+
+
+PASS = SharedStateRacesPass()

@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""CLI for the static-analysis framework.
+
+    python tools/analyze/run.py                    # all passes, human
+    python tools/analyze/run.py --json             # machine schema
+    python tools/analyze/run.py --pass jit_hazards --pass flag_drift
+    python tools/analyze/run.py yugabyte_db_tpu/sched   # narrower roots
+
+Exit status: 1 when any unsuppressed finding exists, else 0.
+
+The ``--json`` schema (consumed by tests/test_analysis.py and the
+bench.py WARN tail):
+
+    {"passes": [{"id", "title", "findings": N, "suppressed": N,
+                 "wall_ms": F}],
+     "findings": [{"path", "line", "pass", "message", "detail",
+                   "hint"}],
+     "suppressions": {pass_id: N},
+     "total_findings": N, "total_suppressed": N, "wall_ms": F,
+     "parse_errors": [{"path", "error"}]}
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))       # tools/ -> `analyze`
+
+from analyze import ALL_PASSES, ProjectIndex, get_pass, run_analysis  # noqa: E402
+from analyze.core import DEFAULT_ROOTS  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="multi-pass static analysis for event-loop, "
+                    "JAX-kernel and concurrency hazards")
+    ap.add_argument("roots", nargs="*", default=list(DEFAULT_ROOTS),
+                    help="analysis roots relative to the repo "
+                         "(default: %s)" % (DEFAULT_ROOTS,))
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the machine schema on stdout")
+    ap.add_argument("--pass", action="append", dest="passes", default=[],
+                    metavar="ID", help="run only this pass (repeatable)")
+    ap.add_argument("--base", default=os.path.dirname(os.path.dirname(_HERE)),
+                    help="repo root (default: two levels up)")
+    args = ap.parse_args(argv)
+
+    passes = ([get_pass(p) for p in args.passes] if args.passes
+              else list(ALL_PASSES))
+    index = ProjectIndex(args.base, roots=args.roots)
+    report = run_analysis(index, passes)
+
+    if args.as_json:
+        print(json.dumps(report))
+    else:
+        for f in report["findings"]:
+            h = f"  [fix: {f['hint']}]" if f["hint"] else ""
+            print(f"{f['path']}:{f['line']}: [{f['pass']}] "
+                  f"{f['message']}{h}")
+        for e in report["parse_errors"]:
+            print(f"{e['path']}: PARSE ERROR {e['error']}")
+        tally = ", ".join(
+            f"{p['id']}: {p['findings']} finding(s), {p['suppressed']} "
+            f"suppressed, {p['wall_ms']:.0f}ms"
+            for p in report["passes"])
+        print(f"-- {tally}")
+        print(f"-- total: {report['total_findings']} finding(s), "
+              f"{report['total_suppressed']} suppressed, "
+              f"{report['wall_ms']:.0f}ms")
+    return 1 if (report["total_findings"]
+                 or report["parse_errors"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,112 +1,51 @@
 #!/usr/bin/env python
-"""Static pass: blocking calls inside `async def` bodies.
+"""Thin compatibility shim over tools/analyze/ (the framework owns the
+pass now — see ANALYSIS.md).
 
-The request scheduler (yugabyte_db_tpu/sched/) multiplexes every lane's
-dispatch over the one event loop, so a synchronous stall inside an
-async handler no longer slows one RPC — it freezes admission, batching
-windows, Raft heartbeats and lease renewal for the whole server.  This
-pass flags the classic offenders lexically inside `async def` bodies:
-
-- time.sleep(...)          (use asyncio.sleep)
-- open(...)                (sync file I/O; use run_in_executor for
-                            anything non-trivial)
-- os.fsync(...)            (device stall on the loop)
-
-Scope: yugabyte_db_tpu/tserver/ and yugabyte_db_tpu/rpc/ — the two
-packages on the scheduler's dispatch path.  Nested (non-async) `def`
-bodies are NOT flagged: they are frequently executor targets.
-
-A finding is suppressed when its line (or the line above) carries a
-`blocking-ok: <reason>` comment — the annotation documents WHY the
-stall is acceptable (tiny metadata file, bounded chunk, ...) and makes
-new unannotated stalls a test failure (tests/test_check_blocking.py
-wires this into tier-1).
+Historically this file WAS the blocking-call lint: time.sleep / open /
+os.fsync inside ``async def`` bodies of tserver/ + rpc/.  The pass
+lives on as ``analyze.passes.async_blocking`` with a wider offender set
+and whole-tree scope; this shim keeps the old CLI and the old
+``scan()`` contract (``[(path, lineno, dotted_name)]``, default roots
+tserver/ + rpc/) so tests/test_check_blocking.py and any muscle-memory
+invocations keep working, and `blocking-ok:` annotations stay honored
+(the framework treats them as an alias of
+``analysis-ok(async_blocking)``).
 
 Usage: python tools/check_blocking.py [path ...]; exits 1 on findings.
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
 from typing import List, Tuple
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
+
+from analyze import ProjectIndex, run_analysis  # noqa: E402
+from analyze.passes.async_blocking import PASS as _PASS  # noqa: E402
 
 ALLOW_MARK = "blocking-ok"
 
 DEFAULT_ROOTS = ("yugabyte_db_tpu/tserver", "yugabyte_db_tpu/rpc")
 
 
-def _call_name(node: ast.Call) -> str:
-    """Dotted name of a call target ('time.sleep', 'open', ...)."""
-    f = node.func
-    parts: List[str] = []
-    while isinstance(f, ast.Attribute):
-        parts.append(f.attr)
-        f = f.value
-    if isinstance(f, ast.Name):
-        parts.append(f.id)
-    return ".".join(reversed(parts))
-
-
-BLOCKING = {"time.sleep", "open", "os.fsync"}
-
-
-class _AsyncBodyScanner(ast.NodeVisitor):
-    """Collect blocking calls lexically inside async def bodies,
-    stopping at nested function definitions (sync helpers are often
-    executor targets; nested async defs get their own visit)."""
-
-    def __init__(self):
-        self.findings: List[Tuple[int, str]] = []
-
-    def visit_AsyncFunctionDef(self, node):
-        for stmt in node.body:
-            self._scan(stmt)
-        # nested async defs are scanned when _scan reaches them
-
-    def _scan(self, node):
-        if isinstance(node, (ast.FunctionDef, ast.Lambda)):
-            return                      # executor-target territory
-        if isinstance(node, ast.AsyncFunctionDef):
-            self.visit_AsyncFunctionDef(node)
-            return
-        if isinstance(node, ast.Call):
-            name = _call_name(node)
-            if name in BLOCKING:
-                self.findings.append((node.lineno, name))
-        for child in ast.iter_child_nodes(node):
-            self._scan(child)
+def scan(roots=DEFAULT_ROOTS, base: str = ".") -> List[Tuple[str, int, str]]:
+    index = ProjectIndex(base, roots=roots)
+    report = run_analysis(index, [_PASS])
+    return [(os.path.join(index.base, f["path"]), f["line"], f["detail"])
+            for f in report["findings"]]
 
 
 def scan_file(path: str) -> List[Tuple[str, int, str]]:
-    with open(path) as f:
-        src = f.read()
-    lines = src.splitlines()
-    scanner = _AsyncBodyScanner()
-    scanner.visit(ast.parse(src, filename=path))
-    out = []
-    for lineno, name in scanner.findings:
-        here = lines[lineno - 1] if lineno <= len(lines) else ""
-        above = lines[lineno - 2] if lineno >= 2 else ""
-        if ALLOW_MARK in here or ALLOW_MARK in above:
-            continue
-        out.append((path, lineno, name))
-    return out
-
-
-def scan(roots=DEFAULT_ROOTS, base: str = ".") -> List[Tuple[str, int, str]]:
-    findings = []
-    for root in roots:
-        rootp = os.path.join(base, root)
-        for dirpath, _dirs, files in os.walk(rootp):
-            for fn in sorted(files):
-                if fn.endswith(".py"):
-                    findings.extend(scan_file(os.path.join(dirpath, fn)))
-    return findings
+    base = os.path.dirname(os.path.abspath(path)) or "."
+    return scan(roots=(os.path.basename(path),), base=base)
 
 
 def main(argv) -> int:
-    base = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base = os.path.dirname(_HERE)
     roots = argv[1:] or DEFAULT_ROOTS
     findings = scan(roots, base)
     for path, lineno, name in findings:

@@ -133,7 +133,10 @@ class TabletPeer:
                  "src_addr": list(self.consensus.messenger.addr)},
                 timeout=120.0)
         finally:
-            shutil.rmtree(d, ignore_errors=True)
+            # the snapshot dir is a whole checkpoint (hard links, but
+            # potentially thousands of entries) — delete off-loop
+            await loop.run_in_executor(
+                None, lambda: shutil.rmtree(d, ignore_errors=True))
         return frontier
 
     def _bootstrap(self):

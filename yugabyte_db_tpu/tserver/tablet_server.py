@@ -47,6 +47,69 @@ def _atomic_json(path: str, obj) -> None:
     os.replace(tmp, path)
 
 
+def _rmtree(path: str) -> None:
+    """Executor target: tablet/snapshot dirs can be GBs of SST files —
+    an inline rmtree on the event loop stalls every lane's dispatch,
+    Raft heartbeats included."""
+    import shutil
+    shutil.rmtree(path, ignore_errors=True)
+
+
+_DELETING_MARK = ".deleting-"
+
+
+async def _rmtree_off_loop(path: str) -> None:
+    """Detach `path` from its visible name synchronously (one rename —
+    observers that saw the owning state change never see a half-deleted
+    tree at the old path), then bulk-delete the tombstone off-loop.
+    `_sweep_tombstones` finishes the job at startup for any tombstone a
+    crash leaves behind, at any depth under tablets/."""
+    import uuid
+    tomb = f"{path}{_DELETING_MARK}{uuid.uuid4().hex[:8]}"
+    try:
+        # analysis-ok(async_blocking): single dir-entry metadata op
+        os.rename(path, tomb)
+    except FileNotFoundError:
+        return
+    except OSError:
+        tomb = path                 # busy/odd fs: delete in place
+    await asyncio.get_running_loop().run_in_executor(None, _rmtree, tomb)
+
+
+def _sweep_tombstones(root: str) -> None:
+    """Executor target: remove every crash-left `.deleting-` tombstone
+    under `root`, at any depth — delete-tablet, delete-snapshot and
+    install-staging renames can all crash between the rename and the
+    off-loop rmtree, leaving `<x>.deleting-yyyy` dirs (hard-linked
+    snapshot tombstones would otherwise pin deleted SST data forever)."""
+    import shutil
+    for dirpath, dirs, _files in os.walk(root):
+        doomed = [d for d in dirs if _DELETING_MARK in d]
+        for d in doomed:
+            shutil.rmtree(os.path.join(dirpath, d), ignore_errors=True)
+        dirs[:] = [d for d in dirs if _DELETING_MARK not in d]
+
+
+def _seed_clone(src: str, dst: str) -> None:
+    """Executor target: seed a store dir from a checkpoint.  Copy into
+    a unique tmp dir + atomic rename, so a concurrent duplicate
+    create_tablet (master RPC retry racing a long copy) can never
+    observe — or open the tablet from — a half-copied `dst`: the rename
+    loser just discards its tmp (a crash leaves only an ignored tmp
+    dir, never a partial `dst`)."""
+    import shutil
+    import uuid
+    if os.path.exists(dst):
+        return
+    tmp = f"{dst}.seed-{uuid.uuid4().hex[:8]}"
+    shutil.copytree(src, tmp)
+    try:
+        os.rename(tmp, dst)
+    except OSError:
+        # racer renamed first; its copy is complete — keep theirs
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 class TabletServer:
     def __init__(self, uuid: str, fs_root: str,
                  master_addrs: Optional[List[Tuple[str, int]]] = None,
@@ -100,7 +163,14 @@ class TabletServer:
         root = os.path.join(self.fs_root, "tablets")
         if not os.path.isdir(root):
             return
+        # finish crashed deletes first: a tablet tombstone's meta must
+        # NOT resurrect the tablet, and nested snapshot/staging
+        # tombstones would pin hard-linked SST data forever
+        await asyncio.get_running_loop().run_in_executor(
+            None, _sweep_tombstones, root)
         for tablet_id in sorted(os.listdir(root)):
+            if _DELETING_MARK in tablet_id:
+                continue      # tombstoned mid-startup by a delete RPC
             meta_path = os.path.join(root, tablet_id, "tablet-meta.json")
             if not os.path.exists(meta_path):
                 continue
@@ -227,6 +297,25 @@ class TabletServer:
         tablet_id = payload["tablet_id"]
         if tablet_id in self.peers:
             return {"ok": True, "existing": True}
+        # the body awaits (seed copy / remote-bootstrap fetch), so a
+        # master retry can arrive mid-create; a duplicate must WAIT for
+        # the first attempt rather than race it into two live peers on
+        # one directory (same shape as rpc_install_snapshot's guard,
+        # but idempotent: create_tablet's contract is "exists after")
+        creating = getattr(self, "_creating", None)
+        if creating is None:
+            creating = self._creating = set()
+        while tablet_id in creating:
+            await asyncio.sleep(0.01)
+        if tablet_id in self.peers:
+            return {"ok": True, "existing": True}
+        creating.add(tablet_id)
+        try:
+            return await self._do_create_tablet(tablet_id, payload)
+        finally:
+            creating.discard(tablet_id)
+
+    async def _do_create_tablet(self, tablet_id: str, payload) -> dict:
         d = self._tablet_dir(tablet_id)
         os.makedirs(d, exist_ok=True)
         meta = {
@@ -241,10 +330,12 @@ class TabletServer:
         seed = payload.get("seed_snapshot_dir")
         if seed:
             # restore-as-clone: seed the regular store from a checkpoint
-            import shutil
-            dst = os.path.join(d, "regular")
-            if not os.path.exists(dst):
-                shutil.copytree(os.path.join(seed, "regular"), dst)
+            # (a whole tablet's SSTs — copy off-loop; tmp+rename inside
+            # _seed_clone keeps a racing duplicate create from seeing a
+            # half-copied store)
+            await asyncio.get_running_loop().run_in_executor(
+                None, _seed_clone, os.path.join(seed, "regular"),
+                os.path.join(d, "regular"))
         rb = payload.get("remote_bootstrap")
         if rb:
             # Remote bootstrap (reference: tserver/remote_bootstrap_*.cc):
@@ -269,8 +360,7 @@ class TabletServer:
         peer = self.peers.pop(tablet_id, None)
         if peer:
             await peer.shutdown()
-        import shutil
-        shutil.rmtree(self._tablet_dir(tablet_id), ignore_errors=True)
+        await _rmtree_off_loop(self._tablet_dir(tablet_id))
         return {"ok": True}
 
     # --- data-path RPCs ---------------------------------------------------
@@ -444,7 +534,6 @@ class TabletServer:
         the leader simply re-installs over; it can never leave a
         non-empty GC'd WAL next to an empty store (which would fake a
         commit floor) or a log contiguous-append violation."""
-        import shutil
         tablet_id = payload["tablet_id"]
         if tablet_id not in self.peers:
             raise RpcError(f"tablet {tablet_id} not found", "NOT_FOUND")
@@ -464,12 +553,13 @@ class TabletServer:
             installing.discard(tablet_id)
 
     async def _do_install_snapshot(self, tablet_id: str, payload) -> dict:
-        import shutil
         d = self._tablet_dir(tablet_id)
         staging = {s: os.path.join(d, f"{s}.install")
                    for s in ("regular", "intents")}
         for p in staging.values():
-            shutil.rmtree(p, ignore_errors=True)
+            # stale staging from a crashed install can be a full
+            # checkpoint's worth of files
+            await _rmtree_off_loop(p)
         # fetch while the replica keeps serving
         await self._fetch_tablet_state(
             tuple(payload["src_addr"]), tablet_id,
@@ -479,7 +569,7 @@ class TabletServer:
         peer = self.peers.pop(tablet_id, None)
         if peer is None:
             for p in staging.values():
-                shutil.rmtree(p, ignore_errors=True)
+                await _rmtree_off_loop(p)
             raise RpcError(f"tablet {tablet_id} went away during "
                            "snapshot fetch", "NOT_FOUND")
         # blocking-ok: tiny metadata file
@@ -591,10 +681,9 @@ class TabletServer:
     async def rpc_delete_snapshot(self, payload) -> dict:
         """Drop a tablet checkpoint dir (reference: DeleteTabletSnapshot
         in tablet/tablet_snapshots.cc). Idempotent."""
-        import shutil
         d = os.path.join(self._tablet_dir(payload["tablet_id"]),
                          "snapshots", payload["snapshot_id"])
-        shutil.rmtree(d, ignore_errors=True)
+        await _rmtree_off_loop(d)
         return {"ok": True}
 
     async def rpc_split_tablet_raft(self, payload) -> dict:
@@ -669,7 +758,6 @@ class TabletServer:
             return os.path.join(self._tablet_dir(child_id),
                                 "split-complete.json")
 
-        import shutil
         rebuild = []                    # (side, child_id) still to build
         children = {}                   # child_id -> peer
         for side, child_id in (("left", d["left_id"]),
@@ -686,7 +774,7 @@ class TabletServer:
             stale = self.peers.pop(child_id, None)
             if stale is not None:
                 await stale.shutdown()
-            shutil.rmtree(self._tablet_dir(child_id), ignore_errors=True)
+            await _rmtree_off_loop(self._tablet_dir(child_id))
             rebuild.append((side, child_id))
         for side, child_id in rebuild:
             part = d["partition"]
