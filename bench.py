@@ -339,7 +339,8 @@ def ycsb_overload_bench():
 # sitting silently inside the JSON (satellite of PR 3; Q6's r05
 # vs_baseline of 0.923 went unnoticed for a round)
 _RATIO_KEYS = ("vs_baseline", "speedup", "vs_cpu", "vs_xla",
-               "p99_ratio_on_vs_off", "achieved_ratio_on_vs_off")
+               "p99_ratio_on_vs_off", "achieved_ratio_on_vs_off",
+               "stream_vs_mono")
 
 
 def warn_regressed_ratios(node, path="", out=None):
@@ -529,6 +530,65 @@ def main():
             "speedup": max(ratios),
             "ratio_rounds": [round(r, 3) for r in ratios],
         }
+
+    # --- cold-scan split: streaming chunk pipeline vs monolithic batch --
+    # The headline q6/q1 numbers above are WARM-scan rates (batch already
+    # on device; kernel time only).  A COLD scan also pays batch
+    # formation — decode + concat + pad + device_put — which the r05
+    # monolithic path ran serially before the first kernel byte.  This
+    # block measures both cold paths (monolithic = r05 behavior =
+    # streaming_scan_enabled=False; streaming = pow2-chunk pipeline with
+    # batch formation overlapped against kernel dispatch) and reports
+    # the batch-build vs kernel time split, so batch-formation wins are
+    # visible separately from kernel wins.
+    from yugabyte_db_tpu.ops.stream_scan import (LAST_STREAM_STATS,
+                                                 streaming_scan_aggregate)
+    cold_results = {}
+    for q in (TPCH_Q6, TPCH_Q1):
+        cols = sorted(q.columns)
+        mono_build_s = [0.0]
+
+        def mono_cold():
+            t0 = time.perf_counter()
+            b = build_batch(blocks, cols)
+            mono_build_s[0] = time.perf_counter() - t0
+            outs, counts, _ = kernel.run(b, q.where, q.aggs, q.group)
+            jax.block_until_ready(outs)
+            return outs
+
+        def stream_cold():
+            return streaming_scan_aggregate(blocks, cols, q.where,
+                                            q.aggs, q.group,
+                                            kernel=kernel)
+        if stream_cold() is None:   # compile; None = too few chunks to
+            # stream (tiny BENCH_SF) — the cold comparison is mono-only
+            cold_results[q.name] = {"stream": "declined (too few chunks)"}
+            continue
+        rounds = max(2, repeats // 2)
+        mono_rounds = []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            mono_cold()
+            mono_rounds.append((time.perf_counter() - t0,
+                                mono_build_s[0]))
+        mono_t, mono_build = min(mono_rounds)   # split from the SAME round
+        stream_t, (souts, scounts) = best_of(stream_cold, rounds)
+        if q.name == "q6":
+            ref = numpy_reference(q, data)
+            rel = abs(float(souts[0]) - ref) / max(abs(ref), 1e-9)
+            assert rel < 1e-5, f"q6 stream mismatch: {float(souts[0])}"
+        else:
+            check_q1([np.asarray(o) for o in souts],
+                     np.asarray(scounts), numpy_reference(q, data))
+        cold_results[q.name] = {
+            "mono_rows_per_s": round(n / mono_t, 1),
+            "stream_rows_per_s": round(n / stream_t, 1),
+            "stream_vs_mono": round(mono_t / stream_t, 3),
+            "mono_split": {"batch_build_s": round(mono_build, 4),
+                           "kernel_s": round(mono_t - mono_build, 4)},
+            "stream_split": dict(LAST_STREAM_STATS),
+        }
+    results["cold_scan"] = cold_results
 
     # --- optional: hand-fused pallas scan vs the XLA kernel -------------
     # (BENCH_PALLAS=1; the flag stays off otherwise so the driver's run
@@ -817,6 +877,9 @@ def main():
         **({"device_probe_failures": probe_log} if device_fallback else {}),
         "rows": n,
         "load_rows_per_s": round(loaded / load_s, 1),
+        # warm rates above; cold-scan split below (batch formation vs
+        # kernel, streaming pipeline vs the r05 monolithic build)
+        "cold_scan": results["cold_scan"],
         "q1": {"tpu_rows_per_s": round(results["q1"]["tpu_rows_per_s"], 1),
                "speedup": round(results["q1"]["speedup"], 3)},
         "q1_dist8": {

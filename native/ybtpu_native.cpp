@@ -309,6 +309,112 @@ void gather_scatter_rows(const uint8_t* src, int64_t row_bytes,
 }
 
 // --------------------------------------------------------------------------
+// FUSED multi-column gather / gather-scatter / copy. One call moves EVERY
+// output lane of a chunk (values, null masks, ht/write_id/tombstone, key
+// matrix) instead of one ctypes round-trip per column: the whole
+// row-marshalling loop runs GIL-free next to the data (the host-side
+// near-data-processing move), so the compaction encode stage and the
+// batch-formation stage genuinely overlap the merge / kernel stages on
+// a 2-core host. Jobs are parallel arrays; per-job index pointers may
+// alias (all columns of one segment share one permutation).
+//   src_idx[j] == NULL -> identity source rows 0..n-1
+//   dst_idx[j] == NULL -> dense output rows 0..n-1
+// All row offsets are int64 throughout — a >2 GiB byte offset
+// (row_bytes * idx) must never wrap through int32 (tests cover this).
+// --------------------------------------------------------------------------
+static inline void gather_one(const uint8_t* src, uint8_t* dst,
+                              int64_t row_bytes, const int64_t* src_idx,
+                              const int64_t* dst_idx, int64_t n) {
+    if (src_idx && dst_idx) {
+        switch (row_bytes) {
+            case 1: YB_GS_W(1)
+            case 2: YB_GS_W(2)
+            case 4: YB_GS_W(4)
+            case 8: YB_GS_W(8)
+            case 16: YB_GS_W(16)
+        }
+        for (int64_t i = 0; i < n; ++i) {
+            memcpy(dst + dst_idx[i] * row_bytes,
+                   src + src_idx[i] * row_bytes, (size_t)row_bytes);
+        }
+        return;
+    }
+    if (src_idx) {
+        const int64_t* idx = src_idx;
+        switch (row_bytes) {
+            case 1: YB_GATHER_W(1)
+            case 2: YB_GATHER_W(2)
+            case 4: YB_GATHER_W(4)
+            case 8: YB_GATHER_W(8)
+            case 16: YB_GATHER_W(16)
+        }
+        for (int64_t i = 0; i < n; ++i) {
+            memcpy(dst + i * row_bytes, src + idx[i] * row_bytes,
+                   (size_t)row_bytes);
+        }
+        return;
+    }
+    if (dst_idx) {      // pure scatter of a contiguous source range
+        for (int64_t i = 0; i < n; ++i) {
+            memcpy(dst + dst_idx[i] * row_bytes, src + i * row_bytes,
+                   (size_t)row_bytes);
+        }
+        return;
+    }
+    memcpy(dst, src, (size_t)(n * row_bytes));
+}
+
+void gather_multi(const uint8_t* const* src, uint8_t* const* dst,
+                  const int64_t* row_bytes,
+                  const int64_t* const* src_idx,
+                  const int64_t* const* dst_idx,
+                  const int64_t* counts, int64_t njobs) {
+    for (int64_t j = 0; j < njobs; ++j) {
+        gather_one(src[j], dst[j], row_bytes[j], src_idx[j], dst_idx[j],
+                   counts[j]);
+    }
+}
+
+// Plain segmented copy: job j copies nbytes[j] from src[j] to dst[j].
+// The batch-formation concat+pad (many blocks x many columns) becomes
+// ONE GIL-free call instead of a python loop of np copies.
+void copy_multi(const uint8_t* const* src, uint8_t* const* dst,
+                const int64_t* nbytes, int64_t njobs) {
+    for (int64_t j = 0; j < njobs; ++j) {
+        memcpy(dst[j], src[j], (size_t)nbytes[j]);
+    }
+}
+
+// Varlen heap gather: per output row i, copy lens[i] bytes from
+// heap+src_start[i] to out+dst_start[i]. Replaces the numpy
+// repeat-offsets trick, which materializes an int64 index entry (16
+// bytes across src+dst) per HEAP BYTE moved.
+void gather_heap(const uint8_t* heap, const int64_t* src_start,
+                 const int64_t* dst_start, const int64_t* lens,
+                 int64_t n, uint8_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        if (lens[i])
+            memcpy(out + dst_start[i], heap + src_start[i],
+                   (size_t)lens[i]);
+    }
+}
+
+// Row-wise FNV-1a over a fixed-width [n, w] uint8 matrix (the key-hash
+// lane of bulk-built blocks; twin of storage/columnar.fnv64_rows which
+// makes w full numpy passes over the rows).
+void fnv64_rows_fixed(const uint8_t* mat, int64_t n, int64_t w,
+                      uint64_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        uint64_t h = 0xCBF29CE484222325ULL;
+        const uint8_t* row = mat + i * w;
+        for (int64_t j = 0; j < w; ++j) {
+            h = (h ^ row[j]) * 0x100000001B3ULL;
+        }
+        out[i] = h;
+    }
+}
+
+// --------------------------------------------------------------------------
 // Fixed-width k-way merge over NON-CONTIGUOUS sorted segments (the
 // pipelined compaction frontier: each segment is a row range of one
 // decoded — possibly mmap-backed — block, so no concatenated key matrix

@@ -89,7 +89,13 @@ if as_json:
             "emitted_rows": s.get("emitted_rows"),
             "stage_s": {k: round(s.get(k, 0.0), 4)
                         for k in ("decode_wait_s", "merge_wait_s",
-                                  "gather_s", "write_wait_s")}})
+                                  "gather_s", "write_wait_s")},
+            # fused gather/encode accounting: one GIL-released native
+            # call should carry ~all jobs; fallback_calls > 0 means a
+            # column shape fell back to per-column numpy gathers
+            "gather": {k: s.get(k) for k in
+                       ("fused_gather_calls", "fused_gather_jobs",
+                        "gather_fallback_calls")}})
     flags.REGISTRY.reset("compaction_chunk_rows")
     flags.REGISTRY.reset("tpu_compaction_enabled")
     # kernel-cache behavior: two same-shape device-backend compactions.

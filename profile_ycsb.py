@@ -115,9 +115,33 @@ async def rpc_profile() -> dict:
             "write_ops_per_s": round((ops // 2) / write_s, 1),
             "agg_scans_per_s": round(32 / scan_s, 1),
             "scheduler": stats,
+            "bulk_load": bulk_load_profile(),
         }
     finally:
         await mc.shutdown()
+
+
+def bulk_load_profile(n_rows: int = 200_000) -> dict:
+    """Engine-level bulk-load stage split: the fused gather/encode
+    feeder vs the streaming SST write stage (tablet.LAST_BULK_LOAD_STATS
+    from one usertable-shaped load).  gather ~= wall - write overlap
+    means the pipeline is producer-bound; write_stage_s ~= wall means
+    the disk is the wall."""
+    import numpy as np
+    from yugabyte_db_tpu.models.ycsb import usertable_info
+    from yugabyte_db_tpu.tablet import Tablet
+    from yugabyte_db_tpu.tablet.tablet import LAST_BULK_LOAD_STATS
+
+    t = Tablet("ycsb-bulk", usertable_info(),
+               tempfile.mkdtemp(prefix="ycsb-bulk-"))
+    payload = np.asarray(["x" * 100], object).repeat(n_rows)
+    cols = {"ycsb_key": np.arange(n_rows, dtype=np.int64),
+            **{f"field{j}": payload for j in range(10)}}
+    t0 = time.perf_counter()
+    loaded = t.bulk_load(cols)
+    wall = time.perf_counter() - t0
+    return {"rows": loaded, "rows_per_s": round(loaded / wall, 1),
+            **LAST_BULK_LOAD_STATS}
 
 
 def main():

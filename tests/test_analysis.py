@@ -338,6 +338,110 @@ class TestSharedStateRaces:
         assert r["findings"] == []
 
 
+class TestUnawaitedCoroutine:
+    def test_true_positives(self, tmp_path):
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import asyncio
+            async def refresh():
+                pass
+            class Srv:
+                async def _flush(self):
+                    pass
+                async def handler(self):
+                    self._flush()                    # coroutine dropped
+                    refresh()                        # module-level coro
+                    asyncio.gather(self._flush())    # builtin awaitable
+                    asyncio.create_task(self._flush())   # F&F task
+                    asyncio.ensure_future(refresh())     # F&F task
+            """}, "unawaited_coroutine")
+        details = sorted(d for _, _, d in _findings(r))
+        assert details == ["asyncio.create_task", "asyncio.ensure_future",
+                           "asyncio.gather", "refresh", "self._flush"]
+
+    def test_suppressed_with_reason(self, tmp_path):
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import asyncio
+            class Srv:
+                async def _bg(self):
+                    pass
+                async def go(self):
+                    # analysis-ok(unawaited_coroutine): supervised set
+                    asyncio.create_task(self._bg())
+            """}, "unawaited_coroutine")
+        assert r["findings"] == []
+        assert r["suppressions"]["unawaited_coroutine"] == 1
+
+    def test_clean_negatives(self, tmp_path):
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import asyncio
+            def close():                   # sync twin un-flags the name
+                pass
+            class Srv:
+                async def _bg(self):
+                    pass
+                async def close(self):
+                    pass
+                async def run(self):
+                    await self._bg()                    # awaited: fine
+                    t = asyncio.create_task(self._bg())     # handle kept
+                    self.tasks.append(
+                        asyncio.create_task(self._bg()))    # stored
+                    asyncio.create_task(
+                        self._bg()).add_done_callback(print)  # chained
+                    await t
+                    close()            # sync/async collision: not ours
+                    self.writer.write(b"x")   # non-self receiver: the
+                                              # stdlib sync write shape
+            """}, "unawaited_coroutine")
+        assert [d for _, _, d in _findings(r)
+                if d not in ("asyncio.create_task",)] == []
+        # the kept/stored/chained create_task forms must NOT fire either
+        assert r["findings"] == []
+
+    def test_taskgroup_spawn_not_flagged(self, tmp_path):
+        # TaskGroup holds strong refs + propagates exceptions: its
+        # discarded create_task handle is the documented safe pattern
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import asyncio
+            class Srv:
+                async def _bg(self):
+                    pass
+                async def run(self):
+                    async with asyncio.TaskGroup() as tg:
+                        tg.create_task(self._bg())       # fine
+                    loop = asyncio.get_running_loop()
+                    loop.create_task(self._bg())         # weak set: bug
+                    asyncio.get_running_loop().create_task(
+                        self._bg())                      # weak set: bug
+            """}, "unawaited_coroutine")
+        assert sorted(d for _, _, d in _findings(r)) == \
+            ["create_task", "loop.create_task"]
+
+    def test_nested_class_rescopes(self, tmp_path):
+        # a class nested inside a method must NOT inherit the outer
+        # class's async-method set (its sync self.flush() is fine) —
+        # and a dropped coroutine inside an except block IS caught
+        r = _run(tmp_path, {"pkg/a.py": """\
+            class Outer:
+                async def flush(self):
+                    pass
+                def make(self):
+                    class Inner:
+                        def flush(self):
+                            pass
+                        def go(self):
+                            self.flush()        # sync: fine
+                    return Inner
+                async def run(self):
+                    try:
+                        await self.flush()
+                    except Exception:
+                        self.flush()            # dropped coroutine
+            """}, "unawaited_coroutine")
+        assert [(l, d) for _, l, d in _findings(r)] == \
+            [(15, "self.flush")]
+
+
 # --- 2 + 3. whole tree, schema, budget, baseline ---------------------------
 
 @pytest.fixture(scope="module")
@@ -355,10 +459,10 @@ def test_whole_tree_zero_unannotated_findings(tree_report):
             for f in tree_report["findings"]))
 
 
-def test_all_five_passes_ran(tree_report):
+def test_all_passes_ran(tree_report):
     assert [p["id"] for p in tree_report["passes"]] == [
         "async_blocking", "lock_held_await", "jit_hazards",
-        "flag_drift", "shared_state_races"]
+        "flag_drift", "shared_state_races", "unawaited_coroutine"]
 
 
 def test_wall_time_budget(tree_report):

@@ -1,0 +1,234 @@
+"""Parity suite for the fused native gather/scatter/encode layer
+(native/ybtpu_native.cpp gather_multi/copy_multi/gather_heap/
+fnv64_rows_fixed via storage/native_lib.py).
+
+Every test builds the same output twice — once through the native fused
+call, once through the pure-numpy fallback oracle — and asserts byte
+identity.  Shapes cover what the hot paths actually send: mixed column
+widths (1/2/4/8-byte lanes plus wide uint8 key matrices), null-mask
+lanes, empty inputs, non-contiguous/duplicated/reversed permutations,
+and (slow) a source large enough that a byte offset overflows int32 —
+the >2 GiB safety check for the int64 offset arithmetic.
+"""
+import numpy as np
+import pytest
+
+from yugabyte_db_tpu.storage import native_lib
+
+
+RNG = np.random.default_rng(1234)
+
+#: the hot paths' lane shapes: (dtype, row-shape suffix)
+LANES = [
+    (np.uint8, ()),          # tombstone / null masks
+    (np.int16, ()),
+    (np.uint32, ()),         # write_id
+    (np.uint64, ()),         # ht / key_hash
+    (np.float64, ()),        # value columns
+    (np.uint8, (25,)),       # doc-key matrix rows
+    (np.uint8, (38,)),       # full SubDocKey matrix rows
+    (np.int64, (3,)),        # multi-word rows
+]
+
+
+def _src(n, dtype, suffix):
+    if dtype == np.float64:
+        return RNG.normal(size=(n,) + suffix)
+    info = np.iinfo(dtype)
+    return RNG.integers(info.min, int(info.max) + 1, (n,) + suffix,
+                        dtype=dtype)
+
+
+def _jobs(n_src, idx, dst_idx, n_out):
+    jobs, oracle = [], []
+    for dtype, suffix in LANES:
+        src = _src(n_src, dtype, suffix)
+        dst_native = np.zeros((n_out,) + suffix, dtype)
+        dst_oracle = np.zeros((n_out,) + suffix, dtype)
+        jobs.append((src, dst_native, idx, dst_idx))
+        oracle.append((src, dst_oracle, idx, dst_idx))
+    return jobs, oracle
+
+
+def _assert_parity(jobs, oracle):
+    native_ok = native_lib.gather_multi(jobs)
+    native_lib.gather_multi_fallback(oracle)
+    if not native_ok:
+        pytest.skip("native library unavailable — fallback is the "
+                    "only implementation; nothing to compare")
+    for (_, got, _, _), (_, want, _, _) in zip(jobs, oracle):
+        assert got.dtype == want.dtype
+        np.testing.assert_array_equal(got, want)
+
+
+class TestFusedGatherParity:
+    def test_gather_mixed_widths(self):
+        idx = RNG.integers(0, 1000, 700).astype(np.int64)
+        jobs, oracle = _jobs(1000, idx, None, 700)
+        _assert_parity(jobs, oracle)
+
+    def test_gather_scatter_mixed_widths(self):
+        idx = RNG.integers(0, 500, 300).astype(np.int64)
+        dst_idx = RNG.permutation(900)[:300].astype(np.int64)
+        jobs, oracle = _jobs(500, idx, dst_idx, 900)
+        _assert_parity(jobs, oracle)
+
+    def test_pure_copy_and_scatter_only(self):
+        src = _src(400, np.uint64, ())
+        for didx in (None,
+                     RNG.permutation(400).astype(np.int64)):
+            got = np.zeros(400, np.uint64)
+            want = np.zeros(400, np.uint64)
+            jobs = [(src, got, None, didx)]
+            ora = [(src, want, None, didx)]
+            _assert_parity(jobs, ora)
+
+    def test_non_contiguous_permutations(self):
+        # strided / reversed / duplicated index shapes: callers must
+        # pre-coerce to contiguous int64; the wrapper REFUSES the
+        # non-contiguous form instead of silently misreading it
+        base = np.arange(2000, dtype=np.int64)
+        strided = base[::2]
+        assert not strided.flags["C_CONTIGUOUS"] or strided.base is not None
+        src = _src(2000, np.uint64, ())
+        dst = np.zeros(1000, np.uint64)
+        if native_lib.available():
+            assert not native_lib.gather_multi(
+                [(src, dst, base[::2], None)])
+        # the coerced form gathers identically to numpy
+        idx = np.ascontiguousarray(base[::2])
+        rev = np.ascontiguousarray(base[::-1][:1000])
+        dup = np.zeros(1000, np.int64) + 7
+        for perm in (idx, rev, dup):
+            jobs, oracle = _jobs(2000, perm, None, 1000)
+            _assert_parity(jobs, oracle)
+
+    def test_wrong_index_dtype_refused(self):
+        if not native_lib.available():
+            pytest.skip("native library unavailable")
+        src = _src(100, np.uint64, ())
+        dst = np.zeros(50, np.uint64)
+        assert not native_lib.gather_multi(
+            [(src, dst, np.arange(50, dtype=np.int32), None)])
+
+    def test_row_width_mismatch_refused(self):
+        if not native_lib.available():
+            pytest.skip("native library unavailable")
+        src = _src(100, np.uint8, (25,))
+        dst = np.zeros((50, 38), np.uint8)
+        assert not native_lib.gather_multi(
+            [(src, dst, np.arange(50, dtype=np.int64), None)])
+
+    def test_empty_inputs(self):
+        idx = np.zeros(0, np.int64)
+        jobs, oracle = _jobs(10, idx, None, 0)
+        _assert_parity(jobs, oracle)
+        # empty job list: False (nothing fused), fallback no-ops
+        assert not native_lib.gather_multi([])
+        native_lib.gather_multi_fallback([])
+
+    def test_gather_columns_forced_fallback_parity(self, monkeypatch):
+        # with the library forced away, gather_columns must produce the
+        # same bytes through the numpy fallback (the no-toolchain path)
+        monkeypatch.setattr(native_lib, "_LIB", None)
+        monkeypatch.setattr(native_lib, "_TRIED", True)
+        idx = RNG.integers(0, 300, 200).astype(np.int64)
+        jobs, oracle = _jobs(300, idx, None, 200)
+        assert not native_lib.available()
+        native_lib.gather_columns(jobs)
+        for (_, got, _, _), (src, want, i, d) in zip(jobs, oracle):
+            np.testing.assert_array_equal(got, src[i])
+
+    def test_gather_columns_entry_point(self):
+        # the one entry hot paths call: must produce oracle output
+        # whether or not the native library loaded
+        idx = RNG.integers(0, 300, 200).astype(np.int64)
+        jobs, oracle = _jobs(300, idx, None, 200)
+        native_lib.gather_columns(jobs)
+        native_lib.gather_multi_fallback(oracle)
+        for (_, got, _, _), (_, want, _, _) in zip(jobs, oracle):
+            np.testing.assert_array_equal(got, want)
+
+
+class TestCopyMulti:
+    def test_segmented_copy_parity(self):
+        srcs = [_src(n, np.float64, ()) for n in (100, 1, 4096)]
+        out_native = np.zeros(4197, np.float64)
+        out_oracle = np.zeros(4197, np.float64)
+        jobs, pos = [], 0
+        for s in srcs:
+            jobs.append((s, out_native[pos:pos + len(s)]))
+            out_oracle[pos:pos + len(s)] = s
+            pos += len(s)
+        if not native_lib.copy_multi(jobs):
+            pytest.skip("native library unavailable")
+        np.testing.assert_array_equal(out_native, out_oracle)
+
+    def test_nbytes_mismatch_refused(self):
+        if not native_lib.available():
+            pytest.skip("native library unavailable")
+        assert not native_lib.copy_multi(
+            [(np.zeros(4, np.int64), np.zeros(3, np.int64))])
+
+
+class TestGatherHeap:
+    def test_varlen_heap_parity(self):
+        heap = RNG.integers(0, 256, 5000).astype(np.uint8)
+        lens = RNG.integers(0, 40, 200).astype(np.int64)
+        src_start = RNG.integers(0, 4900, 200).astype(np.int64)
+        src_start = np.minimum(src_start, 5000 - lens)
+        out_ends = np.cumsum(lens)
+        dst_start = np.ascontiguousarray(out_ends - lens)
+        out = np.zeros(int(out_ends[-1]), np.uint8)
+        if not native_lib.gather_heap(heap, src_start, dst_start,
+                                      lens, out):
+            pytest.skip("native library unavailable")
+        want = np.concatenate(
+            [heap[s:s + l] for s, l in zip(src_start, lens)])
+        np.testing.assert_array_equal(out, want)
+
+    def test_zero_length_rows(self):
+        heap = np.arange(16, dtype=np.uint8)
+        lens = np.zeros(5, np.int64)
+        zeros = np.zeros(5, np.int64)
+        out = np.zeros(0, np.uint8)
+        if not native_lib.gather_heap(heap, zeros, zeros, lens, out):
+            pytest.skip("native library unavailable")
+
+
+class TestFnvRows:
+    def test_matches_numpy_and_scalar(self):
+        from yugabyte_db_tpu.storage.columnar import (_HASH_MULT,
+                                                      _HASH_OFF,
+                                                      fnv64_bytes)
+        mat = RNG.integers(0, 256, (500, 25)).astype(np.uint8)
+        nat = native_lib.fnv64_rows_fixed(mat)
+        if nat is None:
+            pytest.skip("native library unavailable")
+        ref = np.full(mat.shape[0], _HASH_OFF)
+        for j in range(mat.shape[1]):
+            ref = (ref ^ mat[:, j].astype(np.uint64)) * _HASH_MULT
+        np.testing.assert_array_equal(nat, ref)
+        assert int(nat[0]) == fnv64_bytes(mat[0].tobytes())
+
+
+@pytest.mark.slow
+class TestLargeOffsets:
+    def test_gather_beyond_2gib_byte_offsets(self):
+        """>2 GiB-index safety: row_bytes * idx must be computed in
+        int64 — an int32 wrap would read ~2 GiB below the intended
+        offset and corrupt the gather silently."""
+        if not native_lib.available():
+            pytest.skip("native library unavailable")
+        row = 512
+        n = (1 << 31) // row + 64           # ~2.03 GiB + a little
+        src = np.zeros((n, row), np.uint8)
+        marks = np.asarray([0, n // 2, n - 2, n - 1], np.int64)
+        for m in marks:
+            src[m, :8] = np.frombuffer(
+                np.uint64(m).tobytes(), np.uint8)
+        dst = np.zeros((len(marks), row), np.uint8)
+        assert native_lib.gather_multi([(src, dst, marks, None)])
+        for i, m in enumerate(marks):
+            got = int(np.frombuffer(dst[i, :8].tobytes(), np.uint64)[0])
+            assert got == int(m), f"row {m}: offset arithmetic wrapped"

@@ -1,0 +1,208 @@
+"""Streaming scan pipeline: parity vs the monolithic batch path, MVCC
+chunk-safety refusals, flag revert, and the generic stage pipeline."""
+import tempfile
+import time
+
+import numpy as np
+import pytest
+
+from yugabyte_db_tpu.models.tpch import (LineitemTable, TPCH_Q1, TPCH_Q6,
+                                         generate_lineitem,
+                                         numpy_reference)
+from yugabyte_db_tpu.ops import stream_scan
+from yugabyte_db_tpu.ops.device_batch import build_batch
+from yugabyte_db_tpu.ops.scan import AggSpec, ScanKernel
+from yugabyte_db_tpu.storage.pipeline import StreamPipeline, stream_map
+from yugabyte_db_tpu.utils import flags
+
+
+# --- the generic stage pipeline -------------------------------------------
+
+class TestPipeline:
+    def test_order_and_results(self):
+        p = StreamPipeline([lambda x: x + 1, lambda x: x * 2])
+        assert list(p.run(range(32))) == [(i + 1) * 2 for i in range(32)]
+        assert p.items == 32
+
+    def test_error_propagates_and_tears_down(self):
+        def boom(x):
+            if x == 5:
+                raise ValueError("x5")
+            return x
+        with pytest.raises(ValueError, match="x5"):
+            list(stream_map(range(64), [boom, lambda x: x]))
+
+    def test_early_close_does_not_deadlock(self):
+        p = StreamPipeline([lambda x: x, lambda x: x], depth=2)
+        g = p.run(range(10_000))
+        assert next(g) == 0
+        g.close()       # must not hang on the bounded queues
+
+    def test_stages_overlap(self):
+        # two 30ms stages over 6 items: serial would be ~0.36s,
+        # overlapped ~0.21s; assert meaningfully below serial
+        def slow(x):
+            time.sleep(0.03)
+            return x
+        t0 = time.perf_counter()
+        assert list(stream_map(range(6), [slow, slow])) == list(range(6))
+        assert time.perf_counter() - t0 < 0.31
+
+    def test_empty_and_feeder_error(self):
+        assert list(stream_map([], [lambda x: x])) == []
+
+        def bad_iter():
+            yield 1
+            raise RuntimeError("feeder died")
+        with pytest.raises(RuntimeError, match="feeder died"):
+            list(stream_map(bad_iter(), [lambda x: x]))
+
+
+# --- streaming scan parity ------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lineitem():
+    data = generate_lineitem(0.02)          # 120k rows
+    table = LineitemTable(tempfile.mkdtemp(prefix="stream-scan-"),
+                          num_tablets=1)
+    table.load(data, block_rows=16384)
+    t = table.tablets[0]
+    blocks = []
+    for r in t.regular.ssts:
+        for i in range(r.num_blocks()):
+            blocks.append(r.columnar_block(i))
+    return data, table, blocks
+
+
+class TestStreamScanParity:
+    def _both(self, blocks, q, read_ht=None, chunk_rows=32768):
+        kernel = ScanKernel()
+        got = stream_scan.streaming_scan_aggregate(
+            blocks, sorted(q.columns), q.where, q.aggs, q.group,
+            read_ht, kernel=kernel, chunk_rows=chunk_rows)
+        assert got is not None
+        batch = build_batch(blocks, sorted(q.columns))
+        mono = kernel.run(batch, q.where, q.aggs, q.group, read_ht)
+        return got, mono
+
+    def test_q6_matches_monolithic_and_numpy(self, lineitem):
+        data, _t, blocks = lineitem
+        (souts, scnt), (mouts, mcnt, _) = self._both(blocks, TPCH_Q6)
+        ref = numpy_reference(TPCH_Q6, data)
+        assert abs(float(souts[0]) - ref) <= 1e-6 * abs(ref)
+        assert abs(float(souts[0]) - float(mouts[0])) \
+            <= 1e-6 * abs(float(mouts[0]))
+        assert int(scnt) == int(mcnt)
+
+    def test_q1_grouped_matches(self, lineitem):
+        data, _t, blocks = lineitem
+        (souts, scnt), (mouts, mcnt, _) = self._both(blocks, TPCH_Q1)
+        ref = numpy_reference(TPCH_Q1, data)
+        for g in range(6):
+            wq, wp, wc = ref[g]
+            assert int(np.asarray(scnt)[g]) == wc
+            assert abs(float(souts[0][g]) - wq) <= 1e-9 * max(abs(wq), 1)
+            assert abs(float(souts[1][g]) - wp) \
+                <= 1e-5 * max(abs(wp), 1e-9)
+
+    def test_with_read_point_visible_rows(self, lineitem):
+        data, table, blocks = lineitem
+        read_ht = table.tablets[0].clock.now().value
+        (souts, scnt), (mouts, mcnt, _) = self._both(
+            blocks, TPCH_Q6, read_ht=read_ht)
+        assert abs(float(souts[0]) - float(mouts[0])) \
+            <= 1e-6 * max(abs(float(mouts[0])), 1e-9)
+
+    def test_single_chunk_declines(self, lineitem):
+        _data, _t, blocks = lineitem
+        got = stream_scan.streaming_scan_aggregate(
+            blocks, sorted(TPCH_Q6.columns), TPCH_Q6.where,
+            TPCH_Q6.aggs, None, None, chunk_rows=10_000_000)
+        assert got is None      # < min_chunks: monolithic is better
+
+    def test_minmax_empty_match_sentinels_combine(self, lineitem):
+        # a WHERE no row satisfies: min/max sentinels must survive the
+        # cross-chunk combine so the executor's NULL rule still fires
+        from yugabyte_db_tpu.ops import Expr
+        _data, _t, blocks = lineitem
+        C = Expr.col
+        where = (C(5) < -10).node        # shipdate < -10: empty
+        kernel = ScanKernel()
+        got = stream_scan.streaming_scan_aggregate(
+            blocks, [1, 5], where,
+            (AggSpec("min", C(1).node), AggSpec("count")), None, None,
+            kernel=kernel, chunk_rows=32768)
+        assert got is not None
+        outs, _counts = got
+        assert int(outs[1]) == 0
+        v = np.asarray(outs[0])
+        if np.issubdtype(v.dtype, np.integer):
+            assert int(v) == np.iinfo(v.dtype).max   # MIN sentinel
+        else:
+            assert not np.isfinite(float(v)) and float(v) > 0
+
+
+class TestChunkSafety:
+    def _blocks(self, t):
+        out = []
+        for r in t.regular.ssts:
+            for i in range(r.num_blocks()):
+                out.append(r.columnar_block(i))
+        return out
+
+    def test_single_sorted_sst_is_safe(self, lineitem):
+        _d, _t, blocks = lineitem
+        assert stream_scan.chunk_safe_mvcc(blocks)
+
+    def test_overlapping_ssts_refused(self):
+        # two bulk loads of the SAME keys: block sequence restarts ->
+        # boundary monotonicity breaks -> not chunk-safe
+        data = generate_lineitem(0.005)
+        table = LineitemTable(tempfile.mkdtemp(prefix="overlap-"),
+                              num_tablets=1)
+        t = table.tablets[0]
+        t.bulk_load(data, block_rows=8192)
+        t.bulk_load(data, block_rows=8192)
+        blocks = self._blocks(t)
+        assert len(t.regular.ssts) == 2
+        assert not stream_scan.chunk_safe_mvcc(blocks)
+
+    def test_non_unique_block_refused(self, lineitem):
+        _d, _t, blocks = lineitem
+        blocks = [b for b in blocks]
+        blocks[0].unique_keys = False
+        try:
+            assert not stream_scan.chunk_safe_mvcc(blocks)
+        finally:
+            blocks[0].unique_keys = True
+
+    def test_missing_keys_matrix_refused(self, lineitem):
+        _d, _t, blocks = lineitem
+        saved = blocks[0].keys
+        blocks[0].keys = None
+        try:
+            assert not stream_scan.chunk_safe_mvcc(blocks)
+        finally:
+            blocks[0].keys = saved
+
+
+class TestExecutorWiring:
+    def test_flag_off_reproduces_monolithic(self, lineitem):
+        data, table_tablet, _blocks = lineitem
+        data_table = LineitemTable(tempfile.mkdtemp(prefix="flagoff-"),
+                                   num_tablets=1)
+        data_table.load(data, block_rows=16384)
+        flags.set_flag("streaming_chunk_rows", 32768)
+        try:
+            stream_scan.LAST_STREAM_STATS.clear()
+            on, on_cnt = data_table.run(TPCH_Q6)
+            assert stream_scan.LAST_STREAM_STATS.get("chunks", 0) >= 2
+            flags.set_flag("streaming_scan_enabled", False)
+            stream_scan.LAST_STREAM_STATS.clear()
+            off, off_cnt = data_table.run(TPCH_Q6)
+            assert not stream_scan.LAST_STREAM_STATS
+            assert abs(float(on[0]) - float(off[0])) \
+                <= 1e-6 * max(abs(float(off[0])), 1e-9)
+        finally:
+            flags.REGISTRY.reset("streaming_scan_enabled")
+            flags.REGISTRY.reset("streaming_chunk_rows")

@@ -80,9 +80,14 @@ class ProjectIndex:
     on demand (flag_drift reads bench.py / profile scripts / tests this
     way without widening every other pass's scope)."""
 
-    def __init__(self, base: str, roots: Sequence[str] = DEFAULT_ROOTS):
+    def __init__(self, base: str, roots: Sequence[str] = DEFAULT_ROOTS,
+                 overlay: Optional[Dict[str, str]] = None):
         self.base = os.path.abspath(base)
         self.roots = tuple(roots)
+        #: rel path -> source text that REPLACES the on-disk file (the
+        #: pre-commit hook overlays staged INDEX content so a partially
+        #: staged file is checked against the bytes being committed)
+        self.overlay = dict(overlay or {})
         self._cache: Dict[str, Optional[ModuleInfo]] = {}
         self._modules: Optional[List[ModuleInfo]] = None
 
@@ -91,12 +96,15 @@ class ProjectIndex:
             return self._cache[rel]
         path = os.path.join(self.base, rel)
         mi: Optional[ModuleInfo] = None
-        try:
-            with open(path, encoding="utf-8") as f:
-                src = f.read()
-        except OSError:
-            self._cache[rel] = None
-            return None
+        if rel in self.overlay:
+            src = self.overlay[rel]
+        else:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    src = f.read()
+            except OSError:
+                self._cache[rel] = None
+                return None
         try:
             tree = ast.parse(src, filename=path)
             err = None

@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Install the static-analysis sweep as a git pre-commit hook.
+
+    python tools/analyze/install_hook.py             # install
+    python tools/analyze/install_hook.py --uninstall # remove ours
+    python tools/analyze/install_hook.py --force     # replace foreign hook
+
+The hook runs ``tools/analyze/run.py --staged`` — the full pass set
+over only the STAGED .py files inside the analysis roots — so findings
+land at commit time instead of in the next tier-1 run.  A commit with
+unsuppressed findings is blocked; annotate with
+``# analysis-ok(<pass>): <reason>`` (see ANALYSIS.md) or fix the
+hazard.  ``git commit --no-verify`` bypasses in an emergency.
+
+The installer refuses to overwrite a pre-existing hook it did not
+write (``--force`` replaces it), and uninstall removes only our own.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import stat
+import subprocess
+import sys
+
+MARKER = "# installed by tools/analyze/install_hook.py"
+
+HOOK = f"""#!/bin/sh
+{MARKER}
+# Static-analysis sweep over staged files; blocks the commit on any
+# unsuppressed finding. Bypass in an emergency: git commit --no-verify
+repo_root=$(git rev-parse --show-toplevel) || exit 0
+exec "${{ANALYZE_PYTHON:-python3}}" \\
+    "$repo_root/tools/analyze/run.py" --staged --base "$repo_root"
+"""
+
+
+def _git_dir(base: str) -> str:
+    r = subprocess.run(["git", "rev-parse", "--git-dir"], cwd=base,
+                       capture_output=True, text=True, check=True)
+    path = r.stdout.strip()
+    return path if os.path.isabs(path) else os.path.join(base, path)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="install/remove the analysis pre-commit hook")
+    ap.add_argument("--base", default=os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))),
+        help="repo root (default: two levels up from this file)")
+    ap.add_argument("--force", action="store_true",
+                    help="replace a pre-existing foreign pre-commit hook")
+    ap.add_argument("--uninstall", action="store_true",
+                    help="remove the hook if (and only if) we installed it")
+    args = ap.parse_args(argv)
+
+    try:
+        hooks_dir = os.path.join(_git_dir(args.base), "hooks")
+    except (OSError, subprocess.SubprocessError) as e:
+        print(f"not a git repository ({e}); nothing to install",
+              file=sys.stderr)
+        return 1
+    os.makedirs(hooks_dir, exist_ok=True)
+    hook_path = os.path.join(hooks_dir, "pre-commit")
+    existing = None
+    if os.path.exists(hook_path):
+        with open(hook_path, encoding="utf-8", errors="replace") as f:
+            existing = f.read()
+
+    if args.uninstall:
+        if existing is None:
+            print("no pre-commit hook installed")
+            return 0
+        if MARKER not in existing:
+            print(f"{hook_path} was not installed by this tool; "
+                  f"refusing to remove it", file=sys.stderr)
+            return 1
+        os.unlink(hook_path)
+        print(f"removed {hook_path}")
+        return 0
+
+    if existing is not None and MARKER not in existing and not args.force:
+        print(f"{hook_path} already exists and was not installed by "
+              f"this tool; re-run with --force to replace it",
+              file=sys.stderr)
+        return 1
+    with open(hook_path, "w") as f:
+        f.write(HOOK)
+    os.chmod(hook_path, os.stat(hook_path).st_mode | stat.S_IXUSR
+             | stat.S_IXGRP | stat.S_IXOTH)
+    print(f"installed {hook_path} (runs `tools/analyze/run.py --staged` "
+          f"on every commit; bypass with --no-verify)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,7 +9,7 @@ Adding a pass (see ANALYSIS.md):
    finds — the whole-tree tier-1 sweep must stay at zero.
 """
 from . import (async_blocking, flag_drift, jit_hazards, lock_held_await,
-               shared_state_races)
+               shared_state_races, unawaited_coroutine)
 
 ALL_PASSES = (
     async_blocking.PASS,
@@ -17,6 +17,7 @@ ALL_PASSES = (
     jit_hazards.PASS,
     flag_drift.PASS,
     shared_state_races.PASS,
+    unawaited_coroutine.PASS,
 )
 
 _BY_ID = {p.id: p for p in ALL_PASSES}

@@ -1,0 +1,142 @@
+"""Pass: un-awaited coroutine calls and fire-and-forget tasks.
+
+Two hazards with the same shape — a discarded awaitable:
+
+1. A coroutine call whose result is thrown away as a bare expression
+   statement: calling an ``async def`` returns a coroutine object;
+   discarding it means the body NEVER RUNS (python warns "coroutine was
+   never awaited" only at GC time, far from the call site).
+2. ``asyncio.create_task(...)`` / ``ensure_future(...)`` whose handle
+   is immediately discarded: the loop holds only a weak set of tasks,
+   so the task can be garbage-collected mid-flight and an exception
+   inside it is never observed.  Keeping the handle (assignment,
+   ``tasks.append(...)``) or chaining ``.add_done_callback(...)``
+   (which makes the statement's terminal call ``add_done_callback``)
+   both escape the flag; so does ``tg.create_task(...)`` on a TaskGroup
+   (strong references, structured exception propagation) — only
+   module-/loop-receiver spawners flag.
+
+Coroutine-ness is resolved only where the evidence is local and
+unambiguous — stdlib sync twins (``StreamWriter.write``,
+``Executor.shutdown``) share leaf names with tree-local async defs, so
+bare leaf-name matching drowns in false positives.  Flagged forms:
+
+- ``self.m(...)`` where the ENCLOSING CLASS defines ``async def m``;
+- a bare ``f(...)`` where the same module defines ``async def f`` at
+  module level (and no sync ``def f`` anywhere in the module);
+- ``asyncio.gather/wait/wait_for/shield/sleep`` results discarded.
+
+Dotted cross-module calls are out of scope (documented recall
+tradeoff; ANALYSIS.md known limits).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..core import AnalysisPass, Finding, ModuleInfo, ProjectIndex, call_name
+
+#: builtin awaitable producers whose discarded result is always a bug
+_BUILTIN_AWAITABLES = {
+    "asyncio.gather", "asyncio.wait", "asyncio.wait_for",
+    "asyncio.shield", "asyncio.sleep",
+}
+
+#: task spawners whose discarded handle is a fire-and-forget task
+_SPAWNERS = {"create_task", "ensure_future"}
+
+
+def _loop_spawner(name: str, leaf: str) -> bool:
+    """True when the spawner receiver is the module / an event loop —
+    the forms whose tasks live in the loop's WEAK set.  A TaskGroup's
+    ``tg.create_task(...)`` holds a strong reference and propagates
+    exceptions, so discarding that handle is the documented pattern and
+    must not flag."""
+    prefix = name[:-len(leaf)].rstrip(".")
+    return (prefix in ("", "asyncio", "aio", "loop")
+            or prefix.endswith((".loop", "_loop")))
+
+
+class UnawaitedCoroutinePass(AnalysisPass):
+    id = "unawaited_coroutine"
+    title = "un-awaited coroutine / fire-and-forget task"
+    hint = ("await it; or keep the task handle and chain "
+            ".add_done_callback so failures surface")
+
+    def run(self, index: ProjectIndex) -> List[Finding]:
+        out: List[Finding] = []
+        for mod in index.modules():
+            if mod.tree is None:
+                continue
+            mod_async: Set[str] = set()
+            mod_sync: Set[str] = set()
+            for node in mod.tree.body:
+                if isinstance(node, ast.AsyncFunctionDef):
+                    mod_async.add(node.name)
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.FunctionDef):
+                    mod_sync.add(node.name)
+            bare_async = mod_async - mod_sync
+            self._scan_body(mod, mod.tree.body, None, bare_async, out)
+        return out
+
+    # ------------------------------------------------------------------
+    def _scan_body(self, mod: ModuleInfo, body, cls_async: Optional[Set[str]],
+                   bare_async: Set[str], out: List[Finding]) -> None:
+        """Recursive statement walk that RE-SCOPES at every ClassDef —
+        a class nested inside a method gets its OWN async-method set
+        (ast.walk would carry the outer class's set into it and flag
+        the inner class's sync self-calls)."""
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                methods = {n.name for n in node.body
+                           if isinstance(n, ast.AsyncFunctionDef)}
+                sync = {n.name for n in node.body
+                        if isinstance(n, ast.FunctionDef)}
+                self._scan_body(mod, node.body, methods - sync,
+                                bare_async, out)
+                continue
+            if isinstance(node, ast.Expr) and \
+                    isinstance(node.value, ast.Call):
+                self._check_call(mod, node, cls_async, bare_async, out)
+            # recurse into child STATEMENTS (body/orelse/finally of
+            # compound statements, nested function defs) with the SAME
+            # class scope — a nested sync def still closes over the
+            # enclosing `self`
+            self._scan_body(
+                mod, [c for c in ast.iter_child_nodes(node)
+                      if isinstance(c, (ast.stmt, ast.ExceptHandler,
+                                        ast.match_case))],
+                cls_async, bare_async, out)
+
+    def _check_call(self, mod: ModuleInfo, stmt: ast.Expr,
+                    cls_async: Optional[Set[str]], bare_async: Set[str],
+                    out: List[Finding]) -> None:
+        call = stmt.value
+        name = call_name(call)
+        leaf = name.rsplit(".", 1)[-1]
+        if leaf in _SPAWNERS and _loop_spawner(name, leaf):
+            out.append(self.finding(
+                mod, stmt.lineno,
+                f"`{name}(...)` handle discarded — the loop keeps only "
+                f"a weak reference, so the task can be GC'd mid-flight "
+                f"and its exception is never observed",
+                detail=name))
+            return
+        is_self_method = (isinstance(call.func, ast.Attribute)
+                          and isinstance(call.func.value, ast.Name)
+                          and call.func.value.id == "self"
+                          and cls_async is not None
+                          and call.func.attr in cls_async)
+        is_bare = (isinstance(call.func, ast.Name)
+                   and call.func.id in bare_async)
+        if name in _BUILTIN_AWAITABLES or is_self_method or is_bare:
+            out.append(self.finding(
+                mod, stmt.lineno,
+                f"coroutine `{name}(...)` is never awaited — the call "
+                f"builds a coroutine object and discards it; the body "
+                f"never runs",
+                detail=name))
+
+
+PASS = UnawaitedCoroutinePass()

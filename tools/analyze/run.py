@@ -33,6 +33,32 @@ from analyze import ALL_PASSES, ProjectIndex, get_pass, run_analysis  # noqa: E4
 from analyze.core import DEFAULT_ROOTS  # noqa: E402
 
 
+def _staged_files(base: str) -> list:
+    """Repo-relative paths staged for commit (added/copied/modified/
+    renamed — deletions have nothing to analyze)."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            ["git", "diff", "--cached", "--name-only",
+             "--diff-filter=ACMR"],
+            cwd=base, capture_output=True, text=True, timeout=30,
+            check=True)
+    except (OSError, subprocess.SubprocessError):
+        return []
+    return [ln.strip() for ln in r.stdout.splitlines() if ln.strip()]
+
+
+def _index_content(base: str, rel: str):
+    """The staged (index) content of `rel`, or None when unreadable."""
+    import subprocess
+    try:
+        r = subprocess.run(["git", "show", f":{rel}"], cwd=base,
+                           capture_output=True, timeout=30, check=True)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return r.stdout.decode("utf-8", "replace")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="multi-pass static analysis for event-loop, "
@@ -46,12 +72,49 @@ def main(argv=None) -> int:
                     metavar="ID", help="run only this pass (repeatable)")
     ap.add_argument("--base", default=os.path.dirname(os.path.dirname(_HERE)),
                     help="repo root (default: two levels up)")
+    ap.add_argument("--staged", action="store_true",
+                    help="analyze only git-staged .py files inside the "
+                         "default analysis roots (the pre-commit hook "
+                         "mode; exits 0 when nothing relevant is "
+                         "staged)")
     args = ap.parse_args(argv)
 
     passes = ([get_pass(p) for p in args.passes] if args.passes
               else list(ALL_PASSES))
-    index = ProjectIndex(args.base, roots=args.roots)
+    roots = args.roots
+    staged = None
+    if args.staged:
+        staged = {f for f in _staged_files(args.base)
+                  if f.endswith(".py")
+                  and any(f == r or f.startswith(r.rstrip("/") + "/")
+                          for r in DEFAULT_ROOTS)}
+        if not staged:
+            if args.as_json:
+                print(json.dumps({"passes": [], "findings": [],
+                                  "suppressions": {}, "total_findings": 0,
+                                  "total_suppressed": 0, "wall_ms": 0.0,
+                                  "parse_errors": []}))
+            else:
+                print("analyze --staged: no staged files under "
+                      f"{DEFAULT_ROOTS}; nothing to check")
+            return 0
+        # whole-program passes (flag_drift's defs-vs-reads join) are
+        # only meaningful over the full roots: analyze EVERYTHING, then
+        # gate the commit on findings in the staged files alone
+        roots = list(DEFAULT_ROOTS)
+    # staged files are analyzed at their INDEX content, not the working
+    # tree — a partially staged file is checked against the bytes that
+    # will actually land in the commit
+    overlay = {rel: src for rel in (staged or ())
+               if (src := _index_content(args.base, rel)) is not None}
+    index = ProjectIndex(args.base, roots=roots, overlay=overlay)
     report = run_analysis(index, passes)
+    if staged is not None:
+        report["findings"] = [f for f in report["findings"]
+                              if f["path"] in staged]
+        report["parse_errors"] = [e for e in report["parse_errors"]
+                                  if e["path"] in staged]
+        report["total_findings"] = len(report["findings"])
 
     if args.as_json:
         print(json.dumps(report))

@@ -532,7 +532,11 @@ class _BlockCutter:
             self._cut(final=False)
 
     def _submit(self, blk: ColumnarBlock) -> None:
-        while len(self._pending) >= 2:
+        # 3 writes in flight: with incremental fsync the writer thread
+        # periodically stalls on the device flush, and a depth-2 window
+        # would propagate that stall straight into the gather stage
+        # (~20 MB of buffered blocks at the default block_rows)
+        while len(self._pending) >= 3:
             t0 = time.perf_counter()
             self._pending.popleft().result()
             self.write_wait_s += time.perf_counter() - t0
@@ -602,7 +606,8 @@ def _gather_seg_rows(key_segs, run_starts: np.ndarray,
     """Gather key rows at virtual-concatenation `positions` straight
     from the per-segment matrices into one [n, W] matrix — the shape of
     a concatenate-then-fancy-index without ever building the
-    concatenation."""
+    concatenation. All segments move in ONE fused GIL-free call."""
+    from ..storage import native_lib
     n = len(positions)
     width = key_segs[0].shape[1]
     out = np.empty((n, width), np.uint8)
@@ -611,10 +616,12 @@ def _gather_seg_rows(key_segs, run_starts: np.ndarray,
     grp = np.argsort(seg_of, kind="stable")
     counts = np.bincount(seg_of, minlength=len(key_segs))
     bnd = np.concatenate([[0], np.cumsum(counts)])
+    jobs = []
     for si, seg in enumerate(key_segs):
-        dst = grp[bnd[si]:bnd[si + 1]]
+        dst = np.ascontiguousarray(grp[bnd[si]:bnd[si + 1]])
         if len(dst):
-            _gs(seg, local[dst], out, dst)
+            jobs.append((seg, out, np.ascontiguousarray(local[dst]), dst))
+    native_lib.gather_columns(jobs)
     return out
 
 
@@ -702,8 +709,16 @@ def _native_chunk_merge(keys_buf: np.ndarray, run_starts: np.ndarray,
                            lambda: tomb[order],
                            carry_key, carry_leq, cutoff)
     ke = keep[:n_emit]
-    sel = order[:n_emit][ke]
-    kept = (_g(keys_buf, sel), ht[sel], wid[sel], tomb[sel])
+    sel = np.ascontiguousarray(order[:n_emit][ke])
+    keys_o = np.empty((len(sel), width), np.uint8)
+    ht_o = np.empty(len(sel), ht.dtype)
+    wid_o = np.empty(len(sel), wid.dtype)
+    tomb_o = np.empty(len(sel), tomb.dtype)
+    from ..storage import native_lib
+    native_lib.gather_columns([
+        (keys_buf, keys_o, sel, None), (ht, ht_o, sel, None),
+        (wid, wid_o, sel, None), (tomb, tomb_o, sel, None)])
+    kept = (keys_o, ht_o, wid_o, tomb_o)
     return order, n_emit, keep, kept
 
 
@@ -785,6 +800,11 @@ def _compact_columnar_chunked(store, codec, inputs: Sequence[SstReader],
              "emitted_rows": 0, "kept_rows": 0, "m_cap": m_cap,
              "m_growths": 0, "decode_wait_s": 0.0, "merge_wait_s": 0.0,
              "gather_s": 0.0, "write_wait_s": 0.0,
+             # counted LOCALLY at the gather_chunk call site — the
+             # native_lib globals also tick for concurrent scans'
+             # batch builds, which would pollute a delta
+             "fused_gather_calls": 0, "fused_gather_jobs": 0,
+             "gather_fallback_calls": 0,
              "kernel_stats_before": kernel_cache_stats()}
 
     # pipeline width adapts to the machine: with 4+ cores the encode
@@ -799,7 +819,9 @@ def _compact_columnar_chunked(store, codec, inputs: Sequence[SstReader],
     encode_pool = (ThreadPoolExecutor(max_workers=1)
                    if encode_async else None)          # stage 3, ordered
     path = store._new_sst_path()
-    w = SstWriter(path, stream_columnar=True)
+    # incremental fsync from the write worker: the disk flush overlaps
+    # later chunks' merge/gather instead of landing as one serial tail
+    w = SstWriter(path, stream_columnar=True, sync_every_bytes=64 << 20)
     cutter = _BlockCutter(w, write_pool, block_rows)
 
     active: List[_ActiveBlock] = []
@@ -970,16 +992,19 @@ def _compact_columnar_chunked(store, codec, inputs: Sequence[SstReader],
         stats["merge_wait_s"] += time.perf_counter() - t0
         return order, n_emit, keep, kept_rows
 
-    def gather_chunk(fr, order, n_emit, keep, kept_rows):
+    def gather_chunk(fr, order, n_emit, keep, kept_rows, seg_of=None):
         """Stage 3 (encode worker): gather emitted+kept rows from their
         source blocks into one output piece, in merged order, and hand
         it to the block cutter. `kept_rows` (native backend) carries the
-        keys/MVCC columns the merge worker already gathered."""
+        keys/MVCC columns the merge worker already gathered; `seg_of`
+        (when given) reuses the emit-prefix segmentation the main loop
+        computed for advance() instead of re-searching."""
         t0 = time.perf_counter()
         segs, rows, seg_starts, seg_lo, _bound, _bufs = fr
         ord_e = order[:n_emit]
         keep_e = keep[:n_emit]
-        seg_of = np.searchsorted(seg_starts[1:], ord_e, side="right")
+        if seg_of is None:
+            seg_of = np.searchsorted(seg_starts[1:], ord_e, side="right")
         local = ord_e - seg_starts[seg_of] + seg_lo[seg_of]
         kept = np.nonzero(keep_e)[0]
         n_keep = len(kept)
@@ -988,6 +1013,7 @@ def _compact_columnar_chunked(store, codec, inputs: Sequence[SstReader],
         sv, fixed_ids, pk_ids, varlen_ids = col_spec
         piece = None
         if n_keep:
+            from ..storage import native_lib
             key_hash = np.empty(n_keep, np.uint64)
             if kept_rows is not None:
                 keys_o, ht_o, wid_o, tomb_o = kept_rows
@@ -1012,24 +1038,46 @@ def _compact_columnar_chunked(store, codec, inputs: Sequence[SstReader],
                 vals, _nulls = segs[0][0].cb.fixed[cid]
                 fixed_o[cid] = (np.empty(n_keep, vals.dtype),
                                 np.empty(n_keep, bool))
+            # ONE fused GIL-free call moves every lane of every segment
+            # (key_hash, MVCC lanes, keys matrix, pk + fixed columns):
+            # the encode stage stops serializing on per-column python
+            # dispatch and genuinely overlaps the merge/write stages
+            jobs = []
+            seg_src: List[Optional[np.ndarray]] = []
+            seg_dst: List[Optional[np.ndarray]] = []
             for si, (ab, _lo, _hi) in enumerate(segs):
-                dst = grp[bnd[si]:bnd[si + 1]]
+                dst = np.ascontiguousarray(grp[bnd[si]:bnd[si + 1]])
                 if not len(dst):
+                    seg_src.append(None)
+                    seg_dst.append(None)
                     continue
-                src = klocal[dst]
+                src = np.ascontiguousarray(klocal[dst])
+                seg_src.append(src)
+                seg_dst.append(dst)
                 cb = ab.cb
-                _gs(cb.key_hash, src, key_hash, dst)
+                jobs.append((cb.key_hash, key_hash, src, dst))
                 if kept_rows is None:
-                    _gs(cb.ht, src, ht_o, dst)
-                    _gs(cb.write_id, src, wid_o, dst)
-                    _gs(cb.tombstone, src, tomb_o, dst)
-                    _gs(ab.keys, src, keys_o, dst)
+                    jobs.append((cb.ht, ht_o, src, dst))
+                    jobs.append((cb.write_id, wid_o, src, dst))
+                    jobs.append((cb.tombstone, tomb_o, src, dst))
+                    jobs.append((ab.keys, keys_o, src, dst))
                 for cid in pk_ids:
-                    _gs(cb.pk[cid], src, pk_o[cid], dst)
+                    jobs.append((cb.pk[cid], pk_o[cid], src, dst))
                 for cid in fixed_ids:
                     vals, nulls = cb.fixed[cid]
-                    _gs(vals, src, fixed_o[cid][0], dst)
-                    _gs(nulls, src, fixed_o[cid][1], dst)
+                    jobs.append((vals, fixed_o[cid][0], src, dst))
+                    jobs.append((nulls, fixed_o[cid][1], src, dst))
+            if native_lib.gather_multi(jobs):
+                stats["fused_gather_calls"] += 1
+                stats["fused_gather_jobs"] += len(jobs)
+            else:
+                stats["gather_fallback_calls"] += 1
+                native_lib.gather_multi_fallback(jobs)
+            for si, (ab, _lo, _hi) in enumerate(segs):
+                src, dst = seg_src[si], seg_dst[si]
+                if src is None:
+                    continue
+                cb = ab.cb
                 for cid in varlen_ids:
                     _ends, _heap, null = cb.varlen[cid]
                     starts, ends = ab.vstarts[cid]
@@ -1045,20 +1093,25 @@ def _compact_columnar_chunked(store, codec, inputs: Sequence[SstReader],
                 total = int(out_ends[-1]) if n_keep else 0
                 heap_o = np.empty(total, np.uint8)
                 for si, (ab, _lo, _hi) in enumerate(segs):
-                    dst = grp[bnd[si]:bnd[si + 1]]
-                    if not len(dst):
+                    dst = seg_dst[si]
+                    if dst is None:
                         continue
-                    src = klocal[dst]
-                    l_arr = lens[dst]
-                    tot = int(l_arr.sum())
-                    if not tot:
+                    src = seg_src[si]
+                    l_arr = np.ascontiguousarray(lens[dst])
+                    if not int(l_arr.sum()):
                         continue
                     starts, _ends = ab.vstarts[cid]
-                    ramp = (np.arange(tot, dtype=np.int64)
-                            - np.repeat(np.cumsum(l_arr) - l_arr, l_arr))
-                    src_idx = np.repeat(starts[src], l_arr) + ramp
-                    dst_idx = np.repeat(out_starts[dst], l_arr) + ramp
-                    heap_o[dst_idx] = ab.heaps[cid][src_idx]
+                    ss = np.ascontiguousarray(starts[src])
+                    ds_ = np.ascontiguousarray(out_starts[dst])
+                    if not native_lib.gather_heap(ab.heaps[cid], ss, ds_,
+                                                  l_arr, heap_o):
+                        tot = int(l_arr.sum())
+                        ramp = (np.arange(tot, dtype=np.int64)
+                                - np.repeat(np.cumsum(l_arr) - l_arr,
+                                            l_arr))
+                        src_idx = np.repeat(ss, l_arr) + ramp
+                        dst_idx = np.repeat(ds_, l_arr) + ramp
+                        heap_o[dst_idx] = ab.heaps[cid][src_idx]
                 varlen_o[cid] = (out_ends.astype(np.uint32),
                                  heap_o.tobytes(), varlen_null[cid])
             piece = ColumnarBlock.from_arrays(
@@ -1070,21 +1123,20 @@ def _compact_columnar_chunked(store, codec, inputs: Sequence[SstReader],
         if piece is not None:
             cutter.add(piece)
 
-    def advance(fr, order, n_emit):
+    def advance(fr, ord_e, seg_of, counts):
         """Move block cursors past the emitted prefix, release finished
-        blocks, and compute the next chunk's MVCC carry."""
+        blocks, and compute the next chunk's MVCC carry. `seg_of` /
+        `counts` are the emit-prefix segmentation shared with
+        gather_chunk (computed once per chunk in the main loop)."""
         nonlocal carry
         segs, rows, seg_starts, seg_lo, _bound, _bufs = fr
-        if n_emit == 0:
+        if not len(ord_e):
             return
-        ord_e = order[:n_emit]
-        seg_of = np.searchsorted(seg_starts[1:], ord_e, side="right")
-        counts = np.bincount(seg_of, minlength=len(segs))
         for si, (ab, _lo, _hi) in enumerate(segs):
             ab.cursor += int(counts[si])
         active[:] = [ab for ab in active if ab.cursor < ab.n]
         last = int(ord_e[-1])
-        si = int(np.searchsorted(seg_starts[1:], last, side="right"))
+        si = int(seg_of[-1])
         ab = segs[si][0]
         li = last - int(seg_starts[si]) + int(seg_lo[si])
         ht_last = int(ab.cb.ht[li])
@@ -1125,14 +1177,25 @@ def _compact_columnar_chunked(store, codec, inputs: Sequence[SstReader],
             stats["chunks"] += 1
             stats["frontier_rows"] += fr[1]
             stats["emitted_rows"] += n_emit
-            advance(fr, order, n_emit)
+            # emit-prefix segmentation, computed ONCE per chunk and
+            # shared by advance() and gather_chunk()
+            ord_e = order[:n_emit]
+            if n_emit:
+                seg_of_e = np.searchsorted(fr[2][1:], ord_e,
+                                           side="right")
+                counts_e = np.bincount(seg_of_e, minlength=len(fr[0]))
+            else:
+                seg_of_e = np.zeros(0, np.int64)
+                counts_e = np.zeros(len(fr[0]), np.int64)
+            advance(fr, ord_e, seg_of_e, counts_e)
             if encode_async:
                 while len(enc_q) >= 2:  # backpressure: ≤2 in flight
                     enc_q.popleft().result()
                 enc_q.append(encode_pool.submit(
-                    gather_chunk, fr, order, n_emit, keep, kept_rows))
+                    gather_chunk, fr, order, n_emit, keep, kept_rows,
+                    seg_of_e))
             else:
-                prev = (fr, order, n_emit, keep, kept_rows)
+                prev = (fr, order, n_emit, keep, kept_rows, seg_of_e)
         if encode_async:
             while enc_q:
                 enc_q.popleft().result()

@@ -794,6 +794,27 @@ def _skew_window_ht() -> int:
     return w
 
 
+def _nullify_minmax(expanded, minmax, outs):
+    """SQL NULL semantics for MIN/MAX over zero qualifying inputs: the
+    kernel returns a dtype sentinel there, so each min/max aggregate ran
+    with a hidden companion COUNT appended after `expanded`; zero-count
+    results become None host-side (the CPU twin returns None too).
+    Shared by the monolithic and streaming aggregate paths."""
+    outs = [np.asarray(o) for o in outs]
+    base, extras = outs[:len(expanded)], outs[len(expanded):]
+    for j, i in enumerate(minmax):
+        cnt = extras[j]
+        v = base[i]
+        if v.ndim == 0:
+            base[i] = (np.asarray(None, object)
+                       if int(cnt) == 0 else v)
+        else:
+            obj = v.astype(object)
+            obj[np.asarray(cnt) == 0] = None
+            base[i] = obj
+    return tuple(base)
+
+
 class ReadRestartError(Exception):
     """Internal: a record inside the clock-uncertainty window was seen;
     the read must restart at restart_ht (reference: read restarts in
@@ -1365,20 +1386,73 @@ class DocReadOperation:
                 out.append(c)
         return tuple(out)
 
+    def _batch_cache_key(self, needed) -> tuple:
+        """THE device-cache key for batches over this store's current
+        contents. Every flag that affects batch formation must be in
+        here: device_float_dtype is runtime-settable and baked into the
+        batch dtype at build time. Shared by the monolithic and
+        streaming paths (the streaming path appends its chunk plan), so
+        a new formation-affecting flag is added in exactly one place."""
+        return (id(self.store), tuple(sorted(needed)),
+                tuple(r.path for r in self.store.ssts),
+                self.store.write_generation(),
+                flags.get("device_float_dtype"))
+
     def _cached_batch(self, blocks, needed):
         """Build (or fetch from the device cache) the columnar batch for
-        `needed` columns. Every flag that affects batch formation must
-        key the cache: device_float_dtype is runtime-settable and baked
-        into the batch dtype at build time."""
+        `needed` columns."""
         if self.device_cache is None:
             return build_batch(blocks, sorted(needed))
-        from ..utils import flags as _flags
-        key = (id(self.store), tuple(sorted(needed)),
-               tuple(r.path for r in self.store.ssts),
-               self.store.write_generation(),
-               _flags.get("device_float_dtype"))
         return self.device_cache.get_or_build(
-            key, lambda: build_batch(blocks, sorted(needed)))
+            self._batch_cache_key(needed),
+            lambda: build_batch(blocks, sorted(needed)))
+
+    def _try_streaming_aggregate(self, req: ReadRequest, blocks, needed,
+                                 read_ht: int) -> Optional[ReadResponse]:
+        """Chunked pipelined aggregate (ops/stream_scan.py) for scans it
+        can serve exactly; None falls through to the monolithic batch.
+        Dictionary-column predicates, hash grouping, and MVCC-unsafe
+        block sequences are rejected inside streaming_scan_aggregate."""
+        if not flags.get("streaming_scan_enabled"):
+            return None
+        from ..ops.stream_scan import streaming_scan_aggregate
+        from ..ops.scan import _expand_avg
+        cache = self.device_cache
+        key = (self._batch_cache_key(needed)
+               if cache is not None else None)
+        expanded = tuple(_expand_avg(req.aggregates))
+        minmax = [i for i, a in enumerate(expanded)
+                  if a.op in ("min", "max")]
+        aggs_run = expanded + tuple(AggSpec("count", expanded[i].expr)
+                                    for i in minmax)
+        got = streaming_scan_aggregate(
+            blocks, sorted(needed), req.where, aggs_run, req.group_by,
+            read_ht, kernel=self.kernel, cache=cache, cache_key=key)
+        if got is None:
+            return None
+        # uncertainty-window restart check only once the streaming path
+        # is actually serving the read — a scan that falls through to
+        # the monolithic/CPU paths keeps their own (possibly narrower)
+        # restart behavior, exactly as before this path existed
+        self._check_restart_window(blocks, read_ht)
+        outs, counts = got
+        outs = _nullify_minmax(expanded, minmax, outs)
+        return ReadResponse(agg_values=outs,
+                            group_counts=np.asarray(counts),
+                            backend="tpu")
+
+    def _check_restart_window(self, blocks, read_ht: int) -> None:
+        """Raise ReadRestartError when any block holds a record inside
+        (read_ht, read_ht + skew] — the coarse whole-block uncertainty
+        check shared by the monolithic and streaming aggregate paths."""
+        if not (self._allow_restart and read_ht != _MAX_HT):
+            return
+        window_hi = read_ht + _skew_window_ht()
+        for b in blocks:
+            amb = b.ht[(b.ht > np.uint64(read_ht))
+                       & (b.ht <= np.uint64(window_hi))]
+            if len(amb):
+                raise ReadRestartError(int(amb.max()))
 
     def _execute_tpu_aggregate(self, req: ReadRequest) -> Optional[ReadResponse]:
         blocks = self._collect_blocks()
@@ -1395,18 +1469,15 @@ class DocReadOperation:
             needed.update(req.group_by.cols)
         elif req.group_by is not None:
             needed.update(cid for cid, _, _ in req.group_by.cols)
+        read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
+        resp = self._try_streaming_aggregate(req, blocks, needed, read_ht)
+        if resp is not None:
+            return resp
         try:
             batch = self._cached_batch(blocks, needed)
         except KeyError:
             return None   # some column lacks columnar form → CPU path
-        read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
-        if self._allow_restart and read_ht != _MAX_HT:
-            window_hi = read_ht + _skew_window_ht()
-            for b in blocks:
-                amb = b.ht[(b.ht > np.uint64(read_ht))
-                           & (b.ht <= np.uint64(window_hi))]
-                if len(amb):
-                    raise ReadRestartError(int(amb.max()))
+        self._check_restart_window(blocks, read_ht)
         # multiple overlapping sources → force dedup mode via unique_keys
         if len(blocks) > 1:
             batch.unique_keys = False
@@ -1438,19 +1509,7 @@ class DocReadOperation:
                                     for i in minmax)
 
         def _nullify(outs):
-            outs = [np.asarray(o) for o in outs]
-            base, extras = outs[:len(expanded)], outs[len(expanded):]
-            for j, i in enumerate(minmax):
-                cnt = extras[j]
-                v = base[i]
-                if v.ndim == 0:
-                    base[i] = (np.asarray(None, object)
-                               if int(cnt) == 0 else v)
-                else:
-                    obj = v.astype(object)
-                    obj[np.asarray(cnt) == 0] = None
-                    base[i] = obj
-            return tuple(base)
+            return _nullify_minmax(expanded, minmax, outs)
 
         if isinstance(req.group_by, HashGroupSpec):
             outs, counts, _, gvals, n_groups = self.kernel.run(

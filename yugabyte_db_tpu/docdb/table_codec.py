@@ -469,12 +469,30 @@ class TableCodec:
     def bulk_blocks(self, columns: Dict[str, np.ndarray],
                     ht: HybridTime, block_rows: int = 65536,
                     partition=None) -> List[ColumnarBlock]:
-        """Turn user column arrays into sorted columnar-only blocks.
+        """Materialized form of :meth:`bulk_blocks_iter` (tests and small
+        loads; the tablet ingest path streams the iterator instead)."""
+        return list(self.bulk_blocks_iter(columns, ht,
+                                          block_rows=block_rows,
+                                          partition=partition))
+
+    def bulk_blocks_iter(self, columns: Dict[str, np.ndarray],
+                         ht: HybridTime, block_rows: int = 65536,
+                         partition=None):
+        """Turn user column arrays into sorted columnar-only blocks,
+        yielded one at a time so the ingest pipeline overlaps block k's
+        fused gather with block k-1's file write.
 
         Requirements (bulk fast path): every PK component fixed-width
         numeric. Varlen value columns are allowed.
         partition: optional Partition — rows outside it are dropped
         (used when loading a table across several tablets).
+
+        The global phase (key encode, partition hash, sort order, row
+        hashes) is vectorized numpy/native; per block, ONE fused
+        GIL-released native call (storage/native_lib.gather_multi)
+        gathers the key matrix, key-hash lane, and every fixed-width
+        column through the sort permutation — no per-column python
+        gather loop remains on the hot path.
         """
         n = len(next(iter(columns.values())))
         ps = self.info.partition_schema
@@ -502,7 +520,8 @@ class TableCodec:
                 hi = np.frombuffer(partition.end.ljust(part_keys.shape[1],
                                                        b"\x00"), np.uint8)
                 keep &= ~_rows_ge(part_keys, hi)
-        if keep.all():
+        identity = bool(keep.all())
+        if identity:
             # single-tablet load: skip the identity gather (copies the
             # whole key matrix for nothing at 6M-row bench scale)
             idx = np.arange(n, dtype=np.int64)
@@ -511,6 +530,8 @@ class TableCodec:
             doc_keys = doc_keys[idx]
             if ps.kind == "hash":
                 hashes = hashes[idx]
+        if not len(idx):
+            return
         full = bulk.append_hybrid_times(
             doc_keys,
             np.full(len(idx), ht.value, np.uint64),
@@ -519,46 +540,70 @@ class TableCodec:
         # the PK packs into one word (bulk.bulk_sort_order), byte-matrix
         # comparison sort otherwise
         comps = [(np.asarray(columns[c.name])[idx]
-                  if len(idx) != n else np.asarray(columns[c.name]),
+                  if not identity else np.asarray(columns[c.name]),
                   c.type, c.sort_desc) for c in self._pk_cols]
-        order = bulk.bulk_sort_order(
-            hashes if ps.kind == "hash" else None, comps, doc_keys)
-        full = full[order]
-        sorted_idx = idx[order]
-        # all doc keys share one width here, so the matrix FNV is byte-
+        order = np.ascontiguousarray(
+            bulk.bulk_sort_order(hashes if ps.kind == "hash" else None,
+                                 comps, doc_keys), np.int64)
+        # row hashes over the UNSORTED doc keys (one native pass); the
+        # per-block gather moves the u64 lane through the permutation.
+        # All doc keys share one width here, so the matrix FNV is byte-
         # exact with fnv64_bytes — consistent with flush-built blocks
-        sorted_keys = doc_keys[order]
-        key_hash = _fnv_rows(sorted_keys)
-        if len(sorted_keys) > 1:
-            uniq = bool((sorted_keys[1:] != sorted_keys[:-1])
-                        .any(axis=1).all())
-        else:
-            uniq = True
-        write_ids = np.arange(len(idx), dtype=np.uint32)[order]
-        blocks = []
-        for s in range(0, len(sorted_idx), block_rows):
-            sel = sorted_idx[s:s + block_rows]
-            bn = len(sel)
+        key_hash_all = _fnv_rows(doc_keys)
+        from ..storage import native_lib
+        arrs = {c.id: np.asarray(columns[c.name])
+                for c in self.schema.columns}
+        dk_w = doc_keys.shape[1]
+        prev_last_dk = None
+        for s in range(0, len(order), block_rows):
+            ord_b = np.ascontiguousarray(order[s:s + block_rows])
+            bn = len(ord_b)
+            sel = ord_b if identity else np.ascontiguousarray(idx[ord_b])
+            keys_b = np.empty((bn, full.shape[1]), np.uint8)
+            kh_b = np.empty(bn, np.uint64)
+            jobs = [(full, keys_b, ord_b, None),
+                    (key_hash_all, kh_b, ord_b, None)]
             fixed, varlen, pk = {}, {}, {}
+            slow_cols = []
             for c in self.schema.columns:
-                arr = np.asarray(columns[c.name])[sel]
-                if c.is_key:
-                    pk[c.id] = arr
-                elif ColumnType.is_fixed(c.type):
-                    fixed[c.id] = (arr, np.zeros(bn, bool))
+                arr = arrs[c.id]
+                if c.is_key or ColumnType.is_fixed(c.type):
+                    if arr.dtype != object and arr.flags["C_CONTIGUOUS"]:
+                        out = np.empty((bn,) + arr.shape[1:], arr.dtype)
+                        jobs.append((arr, out, sel, None))
+                    else:
+                        out = arr[sel]
+                    if c.is_key:
+                        pk[c.id] = out
+                    else:
+                        fixed[c.id] = (out, np.zeros(bn, bool))
                 else:
-                    raws = [x.encode() if isinstance(x, str) else bytes(x)
-                            for x in arr]
-                    ends = np.cumsum([len(r) for r in raws]).astype(np.uint32)
-                    varlen[c.id] = (ends, b"".join(raws), np.zeros(bn, bool))
-            blocks.append(ColumnarBlock.from_arrays(
+                    slow_cols.append((c, arr))
+            native_lib.gather_columns(jobs)
+            for c, arr in slow_cols:
+                raws = [x.encode() if isinstance(x, str) else bytes(x)
+                        for x in arr[sel]]
+                ends = np.cumsum([len(r) for r in raws]).astype(np.uint32)
+                varlen[c.id] = (ends, b"".join(raws), np.zeros(bn, bool))
+            # unique-keys: adjacent-distinct doc keys inside the block,
+            # plus the boundary row against the previous block (a
+            # boundary duplicate marks this block non-unique, keeping
+            # the batch-level all() exactly as conservative as the old
+            # whole-load flag)
+            dk_b = keys_b[:, :dk_w]
+            uniq = bool((dk_b[1:] != dk_b[:-1]).any(axis=1).all()) \
+                if bn > 1 else True
+            if prev_last_dk is not None and \
+                    prev_last_dk == dk_b[0].tobytes():
+                uniq = False
+            prev_last_dk = dk_b[-1].tobytes()
+            yield ColumnarBlock.from_arrays(
                 schema_version=self.schema.version,
-                key_hash=key_hash[s:s + bn],
+                key_hash=kh_b,
                 ht=np.full(bn, ht.value, np.uint64),
-                write_id=write_ids[s:s + bn],
+                write_id=ord_b.astype(np.uint32),
                 pk=pk, fixed=fixed, varlen=varlen,
-                keys=full[s:s + bn], unique_keys=uniq))
-        return blocks
+                keys=keys_b, unique_keys=uniq)
 
 
 def _rows_ge(mat: np.ndarray, bound: np.ndarray) -> np.ndarray:

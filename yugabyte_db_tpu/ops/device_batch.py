@@ -122,25 +122,45 @@ def f64_conversion(parts) -> Optional[np.dtype]:
     return None if dd == np.float64 else dd
 
 
-def _to_device_dtype(arr: np.ndarray) -> np.ndarray:
-    if arr.dtype == np.float64:
-        conv = f64_conversion([arr])
-        return arr if conv is None else arr.astype(conv)
-    return arr
-
-
 def build_batch(blocks: Sequence[ColumnarBlock],
                 columns: Sequence[int],
                 with_mvcc: bool = True,
                 pad_to: Optional[int] = None) -> DeviceBatch:
     """Concatenate columnar blocks and ship the requested columns to
-    device, padded to a row bucket."""
+    device, padded to a row bucket.
+
+    Batch formation is a single fused pass: every column (and MVCC
+    lane) fills its padded host buffer directly — per-block segments of
+    matching dtype accumulate into ONE GIL-released native copy
+    (storage/native_lib.copy_multi) instead of a np.concatenate followed
+    by a second pad copy per column.  The streaming scan pipeline runs
+    this per chunk on a worker thread, overlapped with the previous
+    chunk's kernel dispatch."""
     n = sum(b.n for b in blocks)
     padded = pad_to or bucket_rows(max(n, 1))
     cols: Dict[int, jnp.ndarray] = {}
     nulls: Dict[int, jnp.ndarray] = {}
     dicts: Dict[int, np.ndarray] = {}
     col_bounds: Dict[int, Tuple[float, float]] = {}
+    copy_jobs: List[Tuple[np.ndarray, np.ndarray]] = []
+    host_cols: Dict[int, Tuple[np.ndarray, np.ndarray]] = {}
+
+    def fill(parts: List[np.ndarray],
+             out_dtype: Optional[np.dtype] = None) -> np.ndarray:
+        """Padded buffer filled from per-block parts; same-dtype
+        contiguous segments defer into the one fused native copy."""
+        dt = out_dtype or parts[0].dtype
+        out = np.zeros((padded,) + parts[0].shape[1:], dt)
+        pos = 0
+        for p in parts:
+            m = len(p)
+            if p.dtype == dt and p.flags["C_CONTIGUOUS"]:
+                copy_jobs.append((p, out[pos:pos + m]))
+            else:
+                out[pos:pos + m] = p    # converting assignment
+            pos += m
+        return out
+
     for cid in columns:
         if all(cid in b.varlen for b in blocks):
             # string column: batch-global dictionary encoding — codes
@@ -177,27 +197,40 @@ def build_batch(blocks: Sequence[ColumnarBlock],
             else:
                 raise KeyError(
                     f"column {cid} not available in columnar form")
-        arr = _to_device_dtype(np.concatenate(parts))
-        null = np.concatenate(nparts)
-        if arr.size and arr.dtype.kind in "fiu":
-            col_bounds[cid] = (float(arr.min()), float(arr.max()))
-        cols[cid] = jnp.asarray(_pad(arr, padded))
-        nulls[cid] = jnp.asarray(_pad(null, padded))
+        conv = (f64_conversion(parts)
+                if parts and parts[0].dtype == np.float64 else None)
+        arr = fill(parts, conv)
+        if n and arr.dtype.kind in "fiu":
+            # bounds from the parts (the padded tail is zeros and must
+            # not contaminate the stats the static SUM scales use)
+            col_bounds[cid] = (
+                float(min(p.min() for p in parts if p.size)),
+                float(max(p.max() for p in parts if p.size)))
+        host_cols[cid] = (arr, fill(nparts))
     valid = np.zeros(padded, bool)
     valid[:n] = True
+    mvcc_host = None
+    if with_mvcc:
+        mvcc_host = (fill([b.key_hash for b in blocks]),
+                     fill([b.ht for b in blocks]),
+                     fill([b.write_id for b in blocks]),
+                     fill([b.tombstone for b in blocks]))
+    from ..storage import native_lib
+    if copy_jobs and not native_lib.copy_multi(copy_jobs):
+        for s, d in copy_jobs:
+            d[:] = s
+    for cid, (arr, null) in host_cols.items():
+        cols[cid] = jnp.asarray(arr)
+        nulls[cid] = jnp.asarray(null)
     batch = DeviceBatch(
         n_rows=n, cols=cols, nulls=nulls, valid=jnp.asarray(valid),
         unique_keys=all(b.unique_keys for b in blocks), dicts=dicts,
         col_bounds=col_bounds)
-    if with_mvcc:
-        batch.key_hash = jnp.asarray(_pad(
-            np.concatenate([b.key_hash for b in blocks]), padded))
-        batch.ht = jnp.asarray(_pad(
-            np.concatenate([b.ht for b in blocks]), padded))
-        batch.write_id = jnp.asarray(_pad(
-            np.concatenate([b.write_id for b in blocks]), padded))
-        tomb = np.concatenate([b.tombstone for b in blocks])
-        batch.tombstone = jnp.asarray(_pad(tomb, padded))
+    if mvcc_host is not None:
+        batch.key_hash = jnp.asarray(mvcc_host[0])
+        batch.ht = jnp.asarray(mvcc_host[1])
+        batch.write_id = jnp.asarray(mvcc_host[2])
+        batch.tombstone = jnp.asarray(mvcc_host[3])
     return batch
 
 

@@ -1,0 +1,188 @@
+"""Streaming pow2-chunk scan — cold scans without whole-batch
+materialization.
+
+The monolithic cold scan pays decode + concat + pad + device_put for
+EVERY row before the first kernel byte executes, and its padded bucket
+can overshoot the true row count by up to 2x (6M rows pad to 8M).  Here
+the block list is cut into chunks of consecutive whole blocks
+(~``streaming_chunk_rows`` rows, padded to ONE shared pow2 bucket), and
+a :class:`storage.pipeline.StreamPipeline` overlaps chunk k+1's batch
+formation (fused native copy, GIL-released) with chunk k's kernel
+execution.  Each chunk hits the SAME kernel-cache signature — one
+compile serves the whole stream — and chunk batches land in the device
+cache individually, so a warm re-scan re-dispatches cached chunks with
+zero host work.
+
+Aggregate partials combine host-side with the same rules the
+distributed layer uses (sum/count add — int64 partials stay exact —
+min/max take elementwise extremes); per-chunk static SUM scales rescale
+before combining, so chunk boundaries never change the documented
+accumulation contract.
+
+MVCC correctness bounds what may stream: with a read point set, a doc
+key's versions must not span a chunk boundary.  ``chunk_safe_mvcc``
+proves the sufficient condition — every block carries a keys matrix,
+is internally unique, and consecutive blocks' boundary DOC KEYS differ
+— which holds exactly for the bulk-load / post-compaction single-SST
+shape the cold-scan benchmarks measure.  Everything else (overlapping
+SSTs, memtable overlays, hash-grouped or dictionary-column scans)
+falls back to the monolithic path; ``streaming_scan_enabled=False``
+forces it, keeping the honest r05 baseline reproducible.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..storage.columnar import ColumnarBlock
+from ..storage.pipeline import StreamPipeline
+from ..utils import flags
+from ..utils.hybrid_time import ENCODED_SIZE
+from .device_batch import bucket_rows, build_batch
+from .scan import AggSpec, HashGroupSpec, ScanKernel, _expand_avg
+
+_HT_SUFFIX = ENCODED_SIZE + 1   # DocHybridTime suffix + kHybridTime marker
+
+#: stats of the most recent streaming scan (read by bench/profile
+#: scripts; informational only)
+LAST_STREAM_STATS: dict = {}
+
+
+def plan_chunks(blocks: Sequence[ColumnarBlock],
+                chunk_rows: int) -> List[List[ColumnarBlock]]:
+    """Cut the block list into runs of consecutive WHOLE blocks of
+    ~chunk_rows rows (block granularity keeps every array a zero-copy
+    view until the fused fill)."""
+    chunks: List[List[ColumnarBlock]] = []
+    cur: List[ColumnarBlock] = []
+    rows = 0
+    for b in blocks:
+        cur.append(b)
+        rows += b.n
+        if rows >= chunk_rows:
+            chunks.append(cur)
+            cur, rows = [], 0
+    if cur:
+        chunks.append(cur)
+    return chunks
+
+
+def chunk_safe_mvcc(blocks: Sequence[ColumnarBlock]) -> bool:
+    """True when chunking at any block boundary preserves MVCC
+    semantics: all blocks are internally unique-keyed, carry keys
+    matrices, and no doc key straddles two consecutive blocks — so the
+    newest-visible-version choice never needs to see two chunks."""
+    prev_last: Optional[bytes] = None
+    for b in blocks:
+        if not b.unique_keys or b.keys is None or b.n == 0:
+            return False
+        if b.keys.shape[1] <= _HT_SUFFIX:
+            return False
+        # boundary doc keys must be STRICTLY ascending across the whole
+        # block sequence: that proves the blocks are one globally-sorted
+        # disjoint run (a second overlapping SST — or a memtable overlay
+        # — breaks monotonicity at its first block and fails here)
+        first_dk = b.keys[0, :-_HT_SUFFIX].tobytes()
+        if prev_last is not None and prev_last >= first_dk:
+            return False
+        prev_last = b.keys[-1, :-_HT_SUFFIX].tobytes()
+    return True
+
+
+def _combine(aggs: Tuple[AggSpec, ...], acc: Optional[list],
+             new: Sequence) -> list:
+    if acc is None:
+        return [np.asarray(o) for o in new]
+    for i, a in enumerate(aggs):
+        if a.op in ("sum", "count"):
+            acc[i] = acc[i] + np.asarray(new[i])
+        elif a.op == "min":
+            acc[i] = np.minimum(acc[i], np.asarray(new[i]))
+        elif a.op == "max":
+            acc[i] = np.maximum(acc[i], np.asarray(new[i]))
+        else:   # pragma: no cover — _expand_avg leaves only these four
+            raise ValueError(a.op)
+    return acc
+
+
+def streaming_scan_aggregate(
+        blocks: Sequence[ColumnarBlock], columns: Sequence[int],
+        where: Optional[tuple], aggs: Sequence[AggSpec],
+        group=None, read_ht: Optional[int] = None,
+        kernel: Optional[ScanKernel] = None,
+        chunk_rows: Optional[int] = None,
+        cache=None, cache_key: Optional[tuple] = None,
+        min_chunks: int = 3):
+    """Chunked scan-aggregate over `blocks`.
+
+    Returns ``(agg_values, counts)`` — the shapes of
+    ``ScanKernel.run(...)[:2]`` — or None when the scan isn't
+    streamable (caller uses the monolithic batch):
+      - HashGroupSpec (per-chunk group sets can't combine densely),
+      - a needed column only available in varlen/dictionary form
+        (per-chunk dictionaries would shear predicate rewrites),
+      - a read point over blocks that aren't provably chunk-safe,
+      - fewer than `min_chunks` chunks (at 2 marginal chunks the
+        per-chunk dispatch overhead measured SLOWER than monolithic on
+        the 2-core box; the win needs real depth to amortize).
+
+    `cache`/`cache_key`: optional DeviceBlockCache — chunk batches land
+    under ``cache_key + ("chunk", i)`` so a warm re-scan re-dispatches
+    device-resident chunks with zero batch formation.
+    """
+    if isinstance(group, HashGroupSpec):
+        return None
+    for b in blocks:
+        for cid in columns:
+            if not (cid in b.fixed or cid in b.pk):
+                return None
+    if read_ht is not None and not chunk_safe_mvcc(blocks):
+        return None
+    chunk_rows = chunk_rows or int(flags.get("streaming_chunk_rows"))
+    chunks = plan_chunks(blocks, chunk_rows)
+    if len(chunks) < min_chunks:
+        return None
+    kernel = kernel or _default_kernel()
+    aggs = tuple(_expand_avg(aggs))
+    cols_sorted = sorted(columns)
+    # one shared pow2 bucket: every full chunk reuses one kernel-cache
+    # signature (the last, short chunk pads up to the same bucket)
+    bucket = bucket_rows(max(max(sum(b.n for b in c) for c in chunks), 1))
+
+    def build(item):
+        ci, chunk = item
+        if cache is not None and cache_key is not None:
+            # the chunk plan (target rows + bucket) is part of the key:
+            # a runtime streaming_chunk_rows change re-plans the chunks,
+            # and batches cached under the OLD plan must never serve the
+            # new one (rows would double-count); stale entries LRU out
+            return cache.get_or_build(
+                cache_key + ("chunk", chunk_rows, bucket, ci),
+                lambda: build_batch(chunk, cols_sorted, pad_to=bucket))
+        return build_batch(chunk, cols_sorted, pad_to=bucket)
+
+    pipe = StreamPipeline([build], depth=2, name="stream-scan")
+    acc = None
+    counts_acc = None
+    kernel_s = 0.0
+    import time
+    for batch in pipe.run(enumerate(chunks)):
+        t0 = time.perf_counter()
+        outs, counts, _ = kernel.run(batch, where, aggs, group, read_ht)
+        kernel_s += time.perf_counter() - t0
+        acc = _combine(aggs, acc, outs)
+        counts_acc = (np.asarray(counts) if counts_acc is None
+                      else counts_acc + np.asarray(counts))
+    LAST_STREAM_STATS.clear()
+    LAST_STREAM_STATS.update({
+        "chunks": len(chunks), "bucket_rows": bucket,
+        "build_s": round(pipe.stage_s[0], 4),
+        "kernel_s": round(kernel_s, 4),
+        "consumer_wait_s": round(pipe.wait_s, 4)})
+    return tuple(acc), counts_acc
+
+
+def _default_kernel() -> ScanKernel:
+    from .scan import _DEFAULT_KERNEL
+    return _DEFAULT_KERNEL

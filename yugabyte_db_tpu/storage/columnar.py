@@ -30,8 +30,14 @@ _HASH_OFF = np.uint64(0xCBF29CE484222325)
 
 
 def fnv64_rows(mat: np.ndarray) -> np.ndarray:
-    """Row-wise FNV-1a 64-bit over an [N, L] uint8 matrix (vectorized over
-    rows; loop over the short L axis)."""
+    """Row-wise FNV-1a 64-bit over an [N, L] uint8 matrix — one native
+    GIL-released pass when the library is built (the bulk-load key-hash
+    lane), else vectorized numpy (loop over the short L axis)."""
+    from . import native_lib
+    if mat.dtype == np.uint8 and mat.ndim == 2:
+        nat = native_lib.fnv64_rows_fixed(np.ascontiguousarray(mat))
+        if nat is not None:
+            return nat
     h = np.full(mat.shape[0], _HASH_OFF)
     for j in range(mat.shape[1]):
         h = (h ^ mat[:, j].astype(np.uint64)) * _HASH_MULT

@@ -231,11 +231,21 @@ class LsmStore:
         return n
 
     def ingest_sst(self, build: Callable[[SstWriter], None],
-                   frontier: Optional[dict] = None) -> str:
-        """Bulk load: caller fills a writer (rows or columnar blocks)."""
+                   frontier: Optional[dict] = None,
+                   stream: bool = False) -> str:
+        """Bulk load: caller fills a writer (rows or columnar blocks).
+        ``stream=True`` opens the writer in stream-columnar mode: each
+        add_columnar_block hits the file immediately (the write releases
+        the GIL), so a pipelined builder overlaps gathers with IO."""
         path = self._new_sst_path()
-        w = SstWriter(path, columnar_builder=self.columnar_builder)
-        build(w)
+        w = SstWriter(path, columnar_builder=self.columnar_builder,
+                      stream_columnar=stream,
+                      sync_every_bytes=(64 << 20) if stream else None)
+        try:
+            build(w)
+        except BaseException:
+            w.abort()
+            raise
         if frontier:
             w.set_frontier(**frontier)
         w.finish()

@@ -104,6 +104,14 @@ def _load() -> Optional[ctypes.CDLL]:
                                 ctypes.c_int64, _u8p]
     lib.gather_scatter_rows.argtypes = [_u8p, ctypes.c_int64, _i64p,
                                         _i64p, ctypes.c_int64, _u8p]
+    _vpp = ctypes.POINTER(ctypes.c_void_p)
+    lib.gather_multi.argtypes = [_vpp, _vpp, _i64p, _vpp, _vpp, _i64p,
+                                 ctypes.c_int64]
+    lib.copy_multi.argtypes = [_vpp, _vpp, _i64p, ctypes.c_int64]
+    lib.gather_heap.argtypes = [_u8p, _i64p, _i64p, _i64p,
+                                ctypes.c_int64, _u8p]
+    lib.fnv64_rows_fixed.argtypes = [_u8p, ctypes.c_int64, ctypes.c_int64,
+                                     _u64p]
     _LIB = lib
     return lib
 
@@ -282,6 +290,159 @@ def gather_scatter_rows(src: np.ndarray, src_idx: np.ndarray,
         _ptr(src_idx, _i64p), _ptr(dst_idx, _i64p), len(src_idx),
         ctypes.cast(dst.ctypes.data, _u8p))
     return True
+
+
+#: counters for the profile scripts: fused-call vs fallback tallies
+GATHER_STATS = {"fused_calls": 0, "fused_jobs": 0, "fallback_calls": 0}
+
+
+def gather_multi(jobs: Sequence[tuple]) -> bool:
+    """THE fused multi-column gather/scatter: one GIL-released native
+    call executes every (src, dst, src_idx, dst_idx) job — all value
+    columns, null masks, and the ht/write_id/tombstone/key lanes of a
+    chunk move together instead of one ctypes round-trip per column.
+
+    Each job is ``(src, dst, src_idx, dst_idx)``:
+      - ``src_idx is None``  -> identity source rows 0..n-1
+      - ``dst_idx is None``  -> dense output rows 0..n-1
+    Index arrays MUST already be int64 and C-contiguous (callers build
+    them once per chunk and share them across jobs — re-coercing per job
+    would reintroduce the per-column python cost this exists to remove).
+
+    Returns False (caller falls back to numpy fancy indexing) when the
+    library is unavailable or ANY job is ineligible: non-contiguous
+    src/dst, mismatched row widths, or non-int64 indexes."""
+    lib = _load()
+    if lib is None or not jobs:
+        return False
+    n_jobs = len(jobs)
+    src_p = (ctypes.c_void_p * n_jobs)()
+    dst_p = (ctypes.c_void_p * n_jobs)()
+    sidx_p = (ctypes.c_void_p * n_jobs)()
+    didx_p = (ctypes.c_void_p * n_jobs)()
+    rb = np.empty(n_jobs, np.int64)
+    cnt = np.empty(n_jobs, np.int64)
+    for j, (src, dst, src_idx, dst_idx) in enumerate(jobs):
+        if not src.flags["C_CONTIGUOUS"] or not dst.flags["C_CONTIGUOUS"]:
+            return False
+        r = _row_bytes(src)
+        if r != _row_bytes(dst):
+            return False
+        n = None
+        for idx in (src_idx, dst_idx):
+            if idx is None:
+                continue
+            if idx.dtype != np.int64 or not idx.flags["C_CONTIGUOUS"]:
+                return False
+            if n is None:
+                n = len(idx)
+            elif len(idx) != n:
+                return False
+        if n is None:       # pure copy: row counts must agree
+            n = len(src)
+            if len(dst) < n:
+                return False
+        elif dst_idx is None and len(dst) < n:
+            # dense gather into an undersized dst would write past the
+            # buffer — refuse (index VALUES remain the caller's
+            # contract, like the raw pointer math of the C entry)
+            return False
+        elif src_idx is None and len(src) < n:
+            return False    # scatter reading past a short source
+        src_p[j] = src.ctypes.data
+        dst_p[j] = dst.ctypes.data
+        sidx_p[j] = src_idx.ctypes.data if src_idx is not None else None
+        didx_p[j] = dst_idx.ctypes.data if dst_idx is not None else None
+        rb[j] = r
+        cnt[j] = n
+    lib.gather_multi(src_p, dst_p, _ptr(rb, _i64p), sidx_p, didx_p,
+                     _ptr(cnt, _i64p), n_jobs)
+    GATHER_STATS["fused_calls"] += 1
+    GATHER_STATS["fused_jobs"] += n_jobs
+    return True
+
+
+def gather_multi_fallback(jobs: Sequence[tuple]) -> None:
+    """Numpy twin of gather_multi (also the parity oracle in tests)."""
+    GATHER_STATS["fallback_calls"] += 1
+    for src, dst, src_idx, dst_idx in jobs:
+        if src_idx is None and dst_idx is None:
+            dst[:len(src)] = src
+        elif dst_idx is None:
+            dst[:len(src_idx)] = src[src_idx]
+        elif src_idx is None:
+            dst[dst_idx] = src[:len(dst_idx)]
+        else:
+            dst[dst_idx] = src[src_idx]
+
+
+def gather_columns(jobs: Sequence[tuple]) -> None:
+    """gather_multi with automatic numpy fallback — the one entry point
+    hot paths call."""
+    if not gather_multi(jobs):
+        gather_multi_fallback(jobs)
+
+
+def copy_multi(jobs: Sequence[Tuple[np.ndarray, np.ndarray]]) -> bool:
+    """One GIL-released call copying every (src, dst) pair byte-wise —
+    the batch-formation concat+pad (blocks x columns) fused into a
+    single native call. Pairs must be C-contiguous with equal nbytes;
+    returns False for the numpy fallback."""
+    lib = _load()
+    if lib is None or not jobs:
+        return False
+    n_jobs = len(jobs)
+    src_p = (ctypes.c_void_p * n_jobs)()
+    dst_p = (ctypes.c_void_p * n_jobs)()
+    nb = np.empty(n_jobs, np.int64)
+    for j, (src, dst) in enumerate(jobs):
+        if not src.flags["C_CONTIGUOUS"] or not dst.flags["C_CONTIGUOUS"] \
+                or src.nbytes != dst.nbytes:
+            return False
+        src_p[j] = src.ctypes.data
+        dst_p[j] = dst.ctypes.data
+        nb[j] = src.nbytes
+    lib.copy_multi(src_p, dst_p, _ptr(nb, _i64p), n_jobs)
+    GATHER_STATS["fused_calls"] += 1
+    GATHER_STATS["fused_jobs"] += n_jobs
+    return True
+
+
+def gather_heap(heap: np.ndarray, src_start: np.ndarray,
+                dst_start: np.ndarray, lens: np.ndarray,
+                out: np.ndarray) -> bool:
+    """Varlen heap gather: out[dst_start[i]:+lens[i]] =
+    heap[src_start[i]:+lens[i]] per row, GIL-free. False -> caller uses
+    the numpy repeat-offsets fallback."""
+    lib = _load()
+    if lib is None:
+        return False
+    if heap.dtype != np.uint8 or not heap.flags["C_CONTIGUOUS"] \
+            or not out.flags["C_CONTIGUOUS"]:
+        return False
+    n = len(lens)
+    if len(src_start) != n or len(dst_start) != n:
+        return False
+    for a in (src_start, dst_start, lens):
+        if a.dtype != np.int64 or not a.flags["C_CONTIGUOUS"]:
+            return False
+    lib.gather_heap(_ptr(heap, _u8p), _ptr(src_start, _i64p),
+                    _ptr(dst_start, _i64p), _ptr(lens, _i64p), n,
+                    _ptr(out, _u8p))
+    return True
+
+
+def fnv64_rows_fixed(mat: np.ndarray) -> Optional[np.ndarray]:
+    """Row-wise FNV-1a over an [N, W] uint8 matrix in one native pass
+    (None -> caller uses the numpy per-column loop)."""
+    lib = _load()
+    if lib is None or mat.dtype != np.uint8 or mat.ndim != 2 \
+            or not mat.flags["C_CONTIGUOUS"]:
+        return None
+    out = np.empty(mat.shape[0], np.uint64)
+    lib.fnv64_rows_fixed(_ptr(mat.reshape(-1), _u8p), mat.shape[0],
+                         mat.shape[1], _ptr(out, _u64p))
+    return out
 
 
 def kway_merge(runs: Sequence[Sequence[bytes]]

@@ -33,6 +33,10 @@ from ..utils.hybrid_time import HybridClock, HybridTime
 # process-wide device block cache shared by all tablets (HBM is global)
 _DEVICE_CACHE = DeviceBlockCache()
 
+#: stage split of the most recent bulk_load (read by profile_ycsb.py
+#: --json; informational only)
+LAST_BULK_LOAD_STATS: dict = {}
+
 
 class _VectorIndexState:
     """One ANN index: a frozen chunk (any registry method) plus a
@@ -315,18 +319,46 @@ class Tablet:
                   block_rows: int = 65536) -> int:
         """Vectorized ingest of column arrays (rows outside this tablet's
         partition are dropped, so the same arrays can be fed to every
-        tablet of a table)."""
+        tablet of a table).
+
+        Streams through the shared stage pipeline: the codec's fused
+        per-block gather (GIL-released native call) runs on the feeder
+        thread while the previous block's serialize+write (also
+        GIL-released) runs on the writer stage — gather and IO overlap
+        instead of running as two serial phases."""
+        import itertools
+        import time as _time
+        from ..storage.pipeline import StreamPipeline
         ht = ht or self.clock.now()
-        blocks = self.codec.bulk_blocks(columns, ht, block_rows=block_rows,
-                                        partition=self.partition)
-        if not blocks:
-            return 0
+        t0 = _time.perf_counter()
+        blocks = self.codec.bulk_blocks_iter(
+            columns, ht, block_rows=block_rows, partition=self.partition)
+        try:
+            first = next(blocks)
+        except StopIteration:
+            return 0        # everything partition-filtered: no SST
+        n = 0
+        stats: dict = {}
+
         def build(w):
-            for b in blocks:
-                w.add_columnar_block(b)
-        self.regular.ingest_sst(build)
-        n = sum(b.n for b in blocks)
+            nonlocal n
+            pipe = StreamPipeline(
+                [lambda blk: (w.add_columnar_block(blk), blk.n)[1]],
+                depth=2, name="bulk-load")
+            for bn in pipe.run(itertools.chain([first], blocks)):
+                n += bn
+            stats.update(pipe.stats(),
+                         write_stage_s=round(pipe.stage_s[0], 4))
+        self.regular.ingest_sst(build, stream=True)
         self._m_rows_written.increment(n)
+        LAST_BULK_LOAD_STATS.clear()
+        LAST_BULK_LOAD_STATS.update({
+            "rows": n, "blocks": stats.get("items"),
+            "wall_s": round(_time.perf_counter() - t0, 4),
+            # feeder thread = global encode/sort + fused per-block
+            # gathers; write stage = serialize + GIL-released file write
+            "write_stage_s": stats.get("write_stage_s"),
+            "gather_wait_s": stats.get("consumer_wait_s")})
         return n
 
     # --- vector indexes (reference: vector_index/vector_lsm.cc,

@@ -144,6 +144,16 @@ DEFINE_RUNTIME("compaction_chunk_rows", 524288,
                "Frontier capacity (rows) of the pipelined chunked "
                "compaction engine; rounded up to a power of two so the "
                "merge kernel compiles once per shape bucket.")
+DEFINE_RUNTIME("streaming_scan_enabled", True,
+               "Stream cold aggregate scans as pow2-bucket chunks "
+               "through the overlapped batch-formation pipeline "
+               "(ops/stream_scan.py) instead of materializing one "
+               "monolithic padded batch first. Off = the monolithic "
+               "r05 batch path, the honest comparison baseline.")
+DEFINE_RUNTIME("streaming_chunk_rows", 1 << 20,
+               "Target rows per streamed scan chunk; the chunk bucket "
+               "is the pow2 ceiling, so every chunk of a scan shares "
+               "one kernel-cache signature.")
 DEFINE_RUNTIME("tpu_pallas_scan", False,
                "Route eligible aggregate scans through the hand-fused "
                "pallas kernel (ops/pallas_scan.py) instead of the XLA "
