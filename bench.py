@@ -340,7 +340,7 @@ def ycsb_overload_bench():
 # vs_baseline of 0.923 went unnoticed for a round)
 _RATIO_KEYS = ("vs_baseline", "speedup", "vs_cpu", "vs_xla",
                "p99_ratio_on_vs_off", "achieved_ratio_on_vs_off",
-               "stream_vs_mono")
+               "stream_vs_mono", "v2_vs_v1_bytes", "prune_speedup")
 
 
 def warn_regressed_ratios(node, path="", out=None):
@@ -390,6 +390,15 @@ def warn_suppression_growth(base_dir=None):
     except Exception as e:   # noqa: BLE001 — account, don't fail bench
         out.append(f"analysis suppression check failed: {e!r:.120}")
     return out
+
+
+def _logical_row_bytes(info) -> int:
+    """User-data bytes per row straight from the schema (fixed-width
+    columns only — the lineitem shape): the write-amp denominator,
+    so 'bytes written / logical bytes' is comparable across formats."""
+    from yugabyte_db_tpu.dockv.packed_row import ColumnType
+    return sum(ColumnType.FIXED_WIDTHS.get(c.type, 8)
+               for c in info.schema.columns)
 
 
 def _make_compaction_tablet(data, n_ssts, rows_per_sst, tag):
@@ -454,6 +463,32 @@ def main():
     load_s = time.perf_counter() - t0
     tablet = table.tablets[0]
 
+    # --- bulk-load output-byte accounting (v2 format satellite) ---------
+    # logical bytes = raw user column data; write-amp is what the
+    # on-disk format adds on top (keys/MVCC/index/bloom). The small v1
+    # comparison load yields v2_vs_v1_bytes (>= 1.0 means v2 is
+    # smaller), surfacing byte regressions like speed regressions.
+    lrb = _logical_row_bytes(table.info)
+    out_bytes = sum(r.file_size for r in tablet.regular.ssts)
+    flags.set_flag("sst_format_version", 1)
+    try:
+        v1_table = LineitemTable(tempfile.mkdtemp(prefix="ybtpu-v1-"),
+                                 num_tablets=1)
+        v1_table.load(data)
+        v1_bytes = sum(r.file_size
+                       for r in v1_table.tablets[0].regular.ssts)
+    finally:
+        flags.REGISTRY.reset("sst_format_version")
+    bulk_load_block = {
+        "rows": loaded, "load_rows_per_s": round(loaded / load_s, 1),
+        "output_bytes": out_bytes,
+        "output_bytes_per_row": round(out_bytes / max(loaded, 1), 2),
+        "write_amp": round(out_bytes / max(loaded * lrb, 1), 3),
+        "v1_output_bytes_per_row": round(v1_bytes / max(loaded, 1), 2),
+        "v2_vs_v1_bytes": round(v1_bytes / max(out_bytes, 1), 3),
+        "format_version": flags.get("sst_format_version"),
+    }
+
     blocks = []
     for r in tablet.regular.ssts:
         for i in range(r.num_blocks()):
@@ -481,6 +516,7 @@ def main():
                 f"{want_price}"
 
     results = {}
+    results["bulk_load"] = bulk_load_block
     kernel = ScanKernel()
     for q in (TPCH_Q6, TPCH_Q1):
         batch = build_batch(blocks, sorted(q.columns))
@@ -588,6 +624,63 @@ def main():
                            "kernel_s": round(mono_t - mono_build, 4)},
             "stream_split": dict(LAST_STREAM_STATS),
         }
+    # --- zone-map pruning on a selective Q6-style scan ------------------
+    # Hash sharding scrambles rowid across blocks, so the prune scenario
+    # uses the range-sharded clone (rowid-clustered blocks): Q6's
+    # predicates plus a selective rowid range. Paired ON/OFF rounds;
+    # the skipped-block counter comes from the streaming stats.
+    try:
+        from yugabyte_db_tpu.docdb.operations import (
+            LAST_SCAN_PRUNE_STATS, ReadRequest)
+        from yugabyte_db_tpu.models.tpch import lineitem_range_info
+        from yugabyte_db_tpu.ops import Expr
+        from yugabyte_db_tpu.ops.stream_scan import LAST_STREAM_STATS
+        from yugabyte_db_tpu.tablet import Tablet
+        from yugabyte_db_tpu.utils.hybrid_time import HybridTime
+        from yugabyte_db_tpu.models.tpch import ROWID, TPCH_Q6
+
+        rt = Tablet("lineitem-range", lineitem_range_info(),
+                    tempfile.mkdtemp(prefix="ybtpu-zp-"))
+        rt.bulk_load(data, ht=HybridTime.from_micros(
+            int(time.time() * 1e6)))
+        hi = n // 8
+        zwhere = ("and", TPCH_Q6.where,
+                  (Expr.col(ROWID) < hi).node)
+        zreq = ReadRequest("lineitem_r", where=zwhere,
+                           aggregates=TPCH_Q6.aggs)
+
+        def zp_round():
+            return rt.read(zreq)
+
+        zp_round()   # compile + warm
+        on_t, on_r = best_of(zp_round, max(2, repeats // 2))
+        skipped = (LAST_STREAM_STATS.get("zone_blocks_pruned")
+                   or LAST_SCAN_PRUNE_STATS.get("blocks_pruned", 0))
+        total_blk = (LAST_STREAM_STATS.get("zone_blocks_total")
+                     or LAST_SCAN_PRUNE_STATS.get("blocks_total", 0))
+        flags.set_flag("zone_map_pruning", False)
+        try:
+            zp_round()   # warm the unpruned batches too
+            off_t, off_r = best_of(zp_round, max(2, repeats // 2))
+        finally:
+            flags.REGISTRY.reset("zone_map_pruning")
+        m = ((data["l_shipdate"] >= 8766) & (data["l_shipdate"] < 9131)
+             & (data["l_discount"] >= 0.05) & (data["l_discount"] <= 0.07)
+             & (data["l_quantity"] < 24.0) & (data["rowid"] < hi))
+        ref = (data["l_extendedprice"][m] * data["l_discount"][m]).sum()
+        for r in (on_r, off_r):
+            rel = abs(float(np.asarray(r.agg_values[0])) - ref) \
+                / max(abs(ref), 1e-9)
+            assert rel < 1e-5, f"zone-prune q6 mismatch: {rel}"
+        cold_results["zone_prune_q6"] = {
+            "selectivity": round(hi / n, 3),
+            "blocks_skipped": int(skipped),
+            "blocks_total": int(total_blk),
+            "on_s": round(on_t, 4), "off_s": round(off_t, 4),
+            "prune_speedup": round(off_t / on_t, 3),
+        }
+    except Exception as e:   # noqa: BLE001 — report, don't fail bench
+        cold_results["zone_prune_q6"] = {"error": str(e)[:200]}
     results["cold_scan"] = cold_results
 
     # --- optional: hand-fused pallas scan vs the XLA kernel -------------
@@ -665,31 +758,66 @@ def main():
     rows_per = int(os.environ.get("BENCH_COMPACT_ROWS", "20000"))
 
     def timed_compaction_once(flag, tag):
-        ct = _make_compaction_tablet(data, n_ssts, rows_per, tag)
-        nbytes = ct.approximate_size()
-        flags.set_flag("tpu_compaction_enabled", flag)
-        t0 = time.perf_counter()
-        ct.compact()
-        return time.perf_counter() - t0, nbytes
+        # the CPU side is the full PRE-PR configuration: monolithic
+        # baseline engine AND sst_format_version=1 for both the input
+        # tablet and the output, so vs_cpu measures the complete
+        # engine+format upgrade and the cpu output doubles as the v1
+        # byte yardstick for v2_vs_v1_bytes
+        if not flag:
+            flags.set_flag("sst_format_version", 1)
+        try:
+            ct = _make_compaction_tablet(data, n_ssts, rows_per, tag)
+            nbytes = ct.approximate_size()
+            flags.set_flag("tpu_compaction_enabled", flag)
+            t0 = time.perf_counter()
+            ct.compact()
+            dt = time.perf_counter() - t0
+        finally:
+            if not flag:
+                flags.REGISTRY.reset("sst_format_version")
+        out = ct.regular.ssts[0]
+        return dt, nbytes, out.file_size, out.num_entries
 
     # best-of-2 rounds, modes INTERLEAVED inside each round: the two
     # paths then see the same machine conditions (page cache, competing
     # load), so the ratio measures the engines rather than system drift;
     # round 0 additionally absorbs cold imports for both
+    from yugabyte_db_tpu.docdb.compaction import LAST_COMPACTION_STATS
     dev_s = cpu_comp_s = None
-    total_bytes = 0
+    dev_in = cpu_in = dev_out = dev_rows = cpu_out = 0
+    dev_pipeline = {}
     for i in range(2):
-        d, total_bytes = timed_compaction_once(True, f"dev{i}")
-        c, _ = timed_compaction_once(False, f"cpu{i}")
+        d, dev_in, dev_out, dev_rows = \
+            timed_compaction_once(True, f"dev{i}")
+        if dev_s is None or d < dev_s:
+            dev_pipeline = {k: (round(v, 4) if isinstance(v, float)
+                                else v)
+                            for k, v in LAST_COMPACTION_STATS.items()
+                            if k != "lanes"}
+        c, cpu_in, cpu_out, _ = timed_compaction_once(False, f"cpu{i}")
         dev_s = d if dev_s is None else min(dev_s, d)
         cpu_comp_s = c if cpu_comp_s is None else min(cpu_comp_s, c)
     flags.set_flag("tpu_compaction_enabled", True)
+    lrb = _logical_row_bytes(table.info)
     results["compaction"] = {
-        "ssts": n_ssts, "input_mb": total_bytes / 1e6,
-        "mb_per_s": total_bytes / 1e6 / dev_s,
-        "cpu_mb_per_s": total_bytes / 1e6 / cpu_comp_s,
+        # input byte counts differ per world (the v2 inputs are ~3x
+        # smaller on disk): each rate is computed over its own bytes
+        "ssts": n_ssts, "input_mb": dev_in / 1e6,
+        "cpu_input_mb": cpu_in / 1e6,
+        "mb_per_s": dev_in / 1e6 / dev_s,
+        "cpu_mb_per_s": cpu_in / 1e6 / cpu_comp_s,
         "vs_cpu": cpu_comp_s / dev_s,
         "seconds": dev_s,
+        # output-byte surgery accounting: the baseline run writes the
+        # pre-v2 format, so v2_vs_v1_bytes = v1 bytes / v2 bytes on
+        # the SAME logical output (>= 1.0 means v2 is smaller)
+        "output_rows": dev_rows,
+        "output_bytes_per_row": round(dev_out / max(dev_rows, 1), 2),
+        "v1_output_bytes_per_row": round(cpu_out / max(dev_rows, 1), 2),
+        "v2_vs_v1_bytes": round(cpu_out / max(dev_out, 1), 3),
+        "write_amp": round(dev_out / max(dev_rows * lrb, 1), 3),
+        "write_wait_s": dev_pipeline.get("write_wait_s"),
+        "pipeline": dev_pipeline,
     }
 
     # YCSB workload C (BASELINE config 1): engine-level point reads.
@@ -877,6 +1005,7 @@ def main():
         **({"device_probe_failures": probe_log} if device_fallback else {}),
         "rows": n,
         "load_rows_per_s": round(loaded / load_s, 1),
+        "bulk_load": results["bulk_load"],
         # warm rates above; cold-scan split below (batch formation vs
         # kernel, streaming pipeline vs the r05 monolithic build)
         "cold_scan": results["cold_scan"],
@@ -890,7 +1019,15 @@ def main():
             "input_mb": round(results["compaction"]["input_mb"], 1),
             "mb_per_s": round(results["compaction"]["mb_per_s"], 2),
             "cpu_mb_per_s": round(results["compaction"]["cpu_mb_per_s"], 2),
-            "vs_cpu": round(results["compaction"]["vs_cpu"], 3)},
+            "vs_cpu": round(results["compaction"]["vs_cpu"], 3),
+            "output_bytes_per_row":
+                results["compaction"]["output_bytes_per_row"],
+            "v1_output_bytes_per_row":
+                results["compaction"]["v1_output_bytes_per_row"],
+            "v2_vs_v1_bytes": results["compaction"]["v2_vs_v1_bytes"],
+            "write_amp": results["compaction"]["write_amp"],
+            "write_wait_s": results["compaction"]["write_wait_s"],
+            "pipeline": results["compaction"]["pipeline"]},
         **({"q6_pallas": {
             k: (round(v, 4) if isinstance(v, float) else v)
             for k, v in results["q6_pallas"].items()}}

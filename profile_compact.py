@@ -47,13 +47,23 @@ def make(tag):
 
 
 def timed_compact(flag):
-    t = make("dev" if flag else "cpu")
-    total = t.approximate_size()
-    flags.set_flag("tpu_compaction_enabled", flag)
-    t0 = time.perf_counter()
-    t.compact()
-    dt = time.perf_counter() - t0
-    return total, dt
+    # the baseline side runs the full pre-PR world: monolithic engine
+    # AND sst_format_version=1 (inputs and output), so its output is
+    # the v1 byte yardstick for v2_vs_v1_bytes
+    if not flag:
+        flags.set_flag("sst_format_version", 1)
+    try:
+        t = make("dev" if flag else "cpu")
+        total = t.approximate_size()
+        flags.set_flag("tpu_compaction_enabled", flag)
+        t0 = time.perf_counter()
+        t.compact()
+        dt = time.perf_counter() - t0
+    finally:
+        if not flag:
+            flags.REGISTRY.reset("sst_format_version")
+    out = t.regular.ssts[0]
+    return total, dt, out.file_size, out.num_entries
 
 
 from yugabyte_db_tpu.docdb.compaction import (LAST_COMPACTION_STATS,
@@ -65,14 +75,33 @@ if as_json:
     # backend comparison (same harness as bench.py config 4)
     out["backends"] = {}
     for name, flag in (("pipelined_native", True), ("baseline", False)):
-        total, dt = timed_compact(flag)
+        total, dt, out_bytes, out_rows = timed_compact(flag)
         out["backends"][name] = {
             "mb": round(total / 1e6, 1), "seconds": round(dt, 3),
-            "mb_per_s": round(total / 1e6 / dt, 1)}
+            "mb_per_s": round(total / 1e6 / dt, 1),
+            # the baseline backend writes the pre-v2 (v1) format, so
+            # these two entries ARE the per-format byte comparison
+            "output_bytes": out_bytes, "output_rows": out_rows,
+            "output_bytes_per_row": round(out_bytes / max(out_rows, 1),
+                                          2)}
         if flag:
+            s = dict(LAST_COMPACTION_STATS)
+            lanes = s.pop("lanes", {})
             out["backends"][name]["pipeline"] = {
                 k: (round(v, 4) if isinstance(v, float) else v)
-                for k, v in LAST_COMPACTION_STATS.items()}
+                for k, v in s.items()}
+            # per-lane encoded-size breakdown: encoding chosen +
+            # pre/post bytes, so the v2 win is attributable per lane
+            out["backends"][name]["lanes"] = {
+                ln: {"pre_bytes": e["pre_bytes"],
+                     "post_bytes": e["post_bytes"],
+                     "ratio": round(e["post_bytes"]
+                                    / max(e["pre_bytes"], 1), 3),
+                     "encodings": e["encodings"]}
+                for ln, e in sorted(lanes.items())}
+    v1b = out["backends"]["baseline"]["output_bytes"]
+    v2b = out["backends"]["pipelined_native"]["output_bytes"]
+    out["v2_vs_v1_bytes"] = round(v1b / max(v2b, 1), 3)
     flags.REGISTRY.reset("tpu_compaction_enabled")
     # chunk-size sweep over the pipelined engine
     sweep_env = os.environ.get("PROFILE_CHUNK_SWEEP", "131072,262144,524288")
@@ -80,7 +109,7 @@ if as_json:
     flags.set_flag("tpu_compaction_enabled", True)
     for chunk in (int(x) for x in sweep_env.split(",") if x.strip()):
         flags.set_flag("compaction_chunk_rows", chunk)
-        total, dt = timed_compact(True)
+        total, dt, _ob, _or = timed_compact(True)
         s = dict(LAST_COMPACTION_STATS)
         out["chunk_sweep"].append({
             "chunk_rows": chunk, "mb_per_s": round(total / 1e6 / dt, 1),
@@ -115,9 +144,10 @@ if as_json:
     print(json.dumps(out))
 else:
     for backend, flag in (("device", True), ("native", False)):
-        total, dt = timed_compact(flag)
+        total, dt, ob, orows = timed_compact(flag)
         print(f"{backend}: {total/1e6:.1f} MB in {dt:.2f}s = "
-              f"{total/1e6/dt:.1f} MB/s")
+              f"{total/1e6/dt:.1f} MB/s  "
+              f"(out {ob/max(orows,1):.1f} B/row)")
     flags.REGISTRY.reset("tpu_compaction_enabled")
 
     # phase breakdown for the pipelined path

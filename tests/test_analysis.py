@@ -442,6 +442,64 @@ class TestUnawaitedCoroutine:
             [(15, "self.flush")]
 
 
+class TestFormatGate:
+    FILES = {
+        "pkg/writer.py": """\
+            from .sstlib import SstWriter
+            def dump(path, cb):
+                w = SstWriter(path, format_version=2)
+                head, bufs = cb.serialize_parts(version=2)
+                return w, head, bufs
+            """,
+        "pkg/sstlib.py": """\
+            class SstWriter:
+                def __init__(self, path, format_version=None):
+                    self.path = path
+            """,
+    }
+
+    def test_true_positives(self, tmp_path):
+        r = _run(tmp_path, dict(self.FILES), "format_gate")
+        got = {(p, d) for p, _, d in _findings(r)}
+        assert ("pkg/writer.py", "format_version=2") in got
+        assert ("pkg/writer.py", "version=2") in got
+
+    def test_generic_version_kwarg_not_flagged(self, tmp_path):
+        """`version=2` on non-serializer callees (schema versions etc.)
+        is unrelated to the on-disk format and must not fire."""
+        files = dict(self.FILES)
+        files["pkg/writer.py"] = """\
+            def make():
+                return TableSchema(columns=(), version=2)
+            """
+        r = _run(tmp_path, files, "format_gate")
+        assert _findings(r) == []
+
+    def test_pinning_v1_allowed(self, tmp_path):
+        files = dict(self.FILES)
+        files["pkg/writer.py"] = """\
+            from .sstlib import SstWriter
+            def dump(path, cb, fmt):
+                w = SstWriter(path, format_version=1)   # baseline pin
+                return cb.serialize_parts(version=fmt)  # flag-resolved
+            """
+        r = _run(tmp_path, files, "format_gate")
+        assert _findings(r) == []
+
+    def test_suppressed_with_reason(self, tmp_path):
+        files = dict(self.FILES)
+        files["pkg/writer.py"] = """\
+            from .sstlib import SstWriter
+            def dump(path, cb):
+                # analysis-ok(format_gate): migration tool writes v2 on purpose
+                w = SstWriter(path, format_version=2)
+                return w
+            """
+        r = _run(tmp_path, files, "format_gate")
+        assert _findings(r) == []
+        assert r["suppressions"]["format_gate"] == 1
+
+
 # --- 2 + 3. whole tree, schema, budget, baseline ---------------------------
 
 @pytest.fixture(scope="module")
@@ -462,7 +520,8 @@ def test_whole_tree_zero_unannotated_findings(tree_report):
 def test_all_passes_ran(tree_report):
     assert [p["id"] for p in tree_report["passes"]] == [
         "async_blocking", "lock_held_await", "jit_hazards",
-        "flag_drift", "shared_state_races", "unawaited_coroutine"]
+        "flag_drift", "shared_state_races", "unawaited_coroutine",
+        "format_gate"]
 
 
 def test_wall_time_budget(tree_report):

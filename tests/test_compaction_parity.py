@@ -217,6 +217,130 @@ class TestGoldenParity:
         assert entries_of(tb) == entries_of(ta)
 
 
+class TestV2FormatGoldenParity:
+    """v2 on-disk format round-trips through the compaction engines:
+    whatever mix of block formats feeds the merge, the output entry
+    stream must stay byte-identical to the CPU feed over v1 inputs."""
+
+    def _set(self, v):
+        flags.set_flag("sst_format_version", v)
+
+    def _reset(self):
+        flags.REGISTRY.reset("sst_format_version")
+
+    def build(self, t, clock):
+        t.apply_write(WriteRequest("t1", [
+            RowOp("upsert", {"k": i, "v": float(i) * 1.7, "s": f"s{i%5}"})
+            for i in range(400)]))
+        t.flush()
+        t.apply_write(WriteRequest("t1", [
+            RowOp("delete", {"k": i}) for i in range(0, 400, 5)]))
+        t.flush()
+        clock._physical.advance_micros(2_000_000_000)
+
+    def test_v1_written_v2_compacted(self, tmp_path):
+        """Inputs written v1, compaction writes v2: parity + the output
+        actually moved to v2."""
+        try:
+            self._set(1)
+            ta, tb = build_pair(tmp_path, self.build)
+            self._set(2)
+            ref, got = compact_both_ways(ta, tb)
+            assert got == ref
+            assert tb.regular.ssts[0].format_version == 2
+        finally:
+            self._reset()
+
+    def test_v2_written_v1_compacted(self, tmp_path):
+        """Inputs written v2 (keyless blocks), compaction pinned back to
+        v1: the derived keys must rebuild exactly for the merge AND the
+        output demotes cleanly."""
+        try:
+            self._set(2)
+            ta, tb = build_pair(tmp_path, self.build)
+            self._set(1)
+            ref, got = compact_both_ways(ta, tb)
+            assert got == ref
+            assert tb.regular.ssts[0].format_version == 1
+        finally:
+            self._reset()
+
+    def test_mixed_version_inputs(self, tmp_path):
+        """One tablet holding v1 AND v2 SSTs compacts to the same
+        stream as an all-v1 twin."""
+        def mixed_build(t, clock):
+            self._set(1)
+            t.apply_write(WriteRequest("t1", [
+                RowOp("upsert", {"k": i, "v": 1.0, "s": "a"})
+                for i in range(300)]))
+            t.flush()
+            self._set(2)
+            t.apply_write(WriteRequest("t1", [
+                RowOp("upsert", {"k": i, "v": 2.0, "s": "b"})
+                for i in range(150, 450)]))
+            t.flush()
+            clock._physical.advance_micros(2_000_000_000)
+
+        def v1_build(t, clock):
+            self._set(1)
+            t.apply_write(WriteRequest("t1", [
+                RowOp("upsert", {"k": i, "v": 1.0, "s": "a"})
+                for i in range(300)]))
+            t.flush()
+            t.apply_write(WriteRequest("t1", [
+                RowOp("upsert", {"k": i, "v": 2.0, "s": "b"})
+                for i in range(150, 450)]))
+            t.flush()
+            clock._physical.advance_micros(2_000_000_000)
+
+        try:
+            clock = HybridClock(MockPhysicalClock(1_000_000))
+            tm = Tablet("mix-par", make_info(), str(tmp_path / "mix"),
+                        clock=clock)
+            mixed_build(tm, clock)
+            assert {r.format_version for r in tm.regular.ssts} == {1, 2}
+            clock2 = HybridClock(MockPhysicalClock(1_000_000))
+            tv = Tablet("v1-par", make_info(), str(tmp_path / "v1"),
+                        clock=clock2)
+            v1_build(tv, clock2)
+            self._set(2)
+            tv.regular.compact(
+                feed=DocDbCompactionFeed(tv.history_cutoff()))
+            got = tpu_compact(tm.regular, tm.codec, tm.history_cutoff(),
+                              backend="native")
+            assert got is not None
+            assert entries_of(tm) == entries_of(tv)
+        finally:
+            self._reset()
+
+    def test_incompressible_lanes_fall_back_raw(self, tmp_path):
+        """Random f64 values defeat every encoding; encode-only-if-
+        smaller must keep them raw with zero size growth and full
+        parity."""
+        rng = np.random.default_rng(9)
+        vals = rng.random(500) * 1e6
+
+        def build(t, clock):
+            t.apply_write(WriteRequest("t1", [
+                RowOp("upsert", {"k": i, "v": float(vals[i]), "s": "x"})
+                for i in range(500)]))
+            t.flush()
+            clock._physical.advance_micros(2_000_000_000)
+
+        try:
+            self._set(2)
+            ta, tb = build_pair(tmp_path, build)
+            ref, got = compact_both_ways(ta, tb)
+            assert got == ref
+            lanes = LAST_COMPACTION_STATS["lanes"]
+            fv = lanes["fixed_vals"]
+            # the v column stayed raw; size never exceeds the v1 dump
+            assert fv["post_bytes"] <= fv["pre_bytes"]
+            assert fv["encodings"].get("raw", 0) >= 1
+        finally:
+            self._reset()
+
+
 class TestCorruptSuffixDegrade:
     def test_check_ht_suffix_raises_structured(self):
         bad = np.zeros((4, 20), np.uint8)       # no kHybridTime marker

@@ -177,13 +177,34 @@ class TestChunkSafety:
             blocks[0].unique_keys = True
 
     def test_missing_keys_matrix_refused(self, lineitem):
+        """A block with neither a keys matrix nor v2 boundary keys
+        can't prove chunk safety (boundary keys ALONE are sufficient —
+        that's the v2 keyless contract chunk_safe_mvcc now honors)."""
         _d, _t, blocks = lineitem
-        saved = blocks[0].keys
-        blocks[0].keys = None
+        b = blocks[0]
+        saved = (b.keys, b._first_key, b._last_key)
+        b.keys = None
+        b._first_key = b._last_key = None
         try:
             assert not stream_scan.chunk_safe_mvcc(blocks)
         finally:
-            blocks[0].keys = saved
+            b.keys, b._first_key, b._last_key = saved
+
+    def test_boundary_keys_alone_suffice(self, lineitem):
+        """v2 keyless blocks prove chunk safety from stored boundary
+        keys without materializing the derived matrix."""
+        _d, _t, blocks = lineitem
+        saved = [(b.keys, b._first_key, b._last_key) for b in blocks]
+        try:
+            for b in blocks:
+                fk, lk = b.first_full_key(), b.last_full_key()
+                b.keys = None
+                b._first_key, b._last_key = fk, lk
+            assert stream_scan.chunk_safe_mvcc(blocks)
+            assert all(b._keys is None for b in blocks)  # no rebuilds
+        finally:
+            for b, (k, f, l) in zip(blocks, saved):
+                b.keys, b._first_key, b._last_key = k, f, l
 
 
 class TestExecutorWiring:

@@ -8,8 +8,8 @@ Adding a pass (see ANALYSIS.md):
 4. run ``python tools/analyze/run.py`` and fix or annotate what it
    finds — the whole-tree tier-1 sweep must stay at zero.
 """
-from . import (async_blocking, flag_drift, jit_hazards, lock_held_await,
-               shared_state_races, unawaited_coroutine)
+from . import (async_blocking, flag_drift, format_gate, jit_hazards,
+               lock_held_await, shared_state_races, unawaited_coroutine)
 
 ALL_PASSES = (
     async_blocking.PASS,
@@ -18,6 +18,7 @@ ALL_PASSES = (
     flag_drift.PASS,
     shared_state_races.PASS,
     unawaited_coroutine.PASS,
+    format_gate.PASS,
 )
 
 _BY_ID = {p.id: p for p in ALL_PASSES}

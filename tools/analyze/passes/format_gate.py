@@ -1,0 +1,89 @@
+"""Pass: the sst_format_version gate must not be bypassable.
+
+The v2 columnar block format is gated by the ``sst_format_version``
+runtime flag, resolved in exactly one place
+(``storage/sst.py resolve_format_version``) so that flag value 1
+reproduces the pre-v2 bytes everywhere. The gate drifts the moment any
+writer hardcodes the new version instead of resolving the flag:
+
+1. ``format_version=2`` passed as a LITERAL to ANY call in product
+   code — an SstWriter call site that would emit v2 even when the flag
+   says 1.
+2. ``version=2`` passed as a literal to a ``serialize``/
+   ``serialize_parts`` call (the block serializer's parameter name).
+   The bare ``version`` kwarg is common in unrelated APIs
+   (TableSchema(version=...)), so it only counts on serializer
+   callees.
+3. A literal ``2`` compared against or assigned around the resolver is
+   fine; only explicit version-selecting call arguments are flagged.
+
+Pinning the OLD format (``format_version=1`` — the baseline compaction
+path does this deliberately) is always allowed: it can only ever make
+output MORE compatible, never leak v2 past the flag.
+
+tests/ are out of scope (they construct v2 blocks directly to test the
+codec), as is storage/sst.py itself (the resolver's home).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import AnalysisPass, Finding, ProjectIndex
+
+#: kwargs that select an on-disk version: `format_version` anywhere;
+#: the generic `version` only on serializer callees (other APIs use
+#: `version` for schema versions etc.)
+_SERIALIZER_NAMES = {"serialize", "serialize_parts"}
+#: the resolver's home — the one module allowed to know the number
+_ALLOWED = ("yugabyte_db_tpu/storage/sst.py",
+            "yugabyte_db_tpu/storage/columnar.py",
+            "yugabyte_db_tpu/storage/lane_codec.py")
+
+
+def _callee_name(node: ast.Call) -> str:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+class FormatGatePass(AnalysisPass):
+    id = "format_gate"
+    title = "sst_format_version gate drift"
+    hint = ("resolve the on-disk format through the sst_format_version "
+            "flag (storage/sst.py resolve_format_version) instead of "
+            "hardcoding the new version; pinning format_version=1 is "
+            "always allowed")
+
+    def run(self, index: ProjectIndex) -> List[Finding]:
+        out: List[Finding] = []
+        for mi in index.modules():
+            if mi.tree is None or mi.rel.replace("\\", "/") in _ALLOWED:
+                continue
+            for node in ast.walk(mi.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                for kw in node.keywords:
+                    if kw.arg != "format_version" and not (
+                            kw.arg == "version"
+                            and _callee_name(node) in _SERIALIZER_NAMES):
+                        continue
+                    v = kw.value
+                    if isinstance(v, ast.Constant) \
+                            and isinstance(v.value, int) \
+                            and v.value >= 2:
+                        out.append(Finding(
+                            path=mi.rel, line=node.lineno,
+                            pass_id=self.id,
+                            message=(f"hardcoded on-disk format "
+                                     f"`{kw.arg}={v.value}` bypasses "
+                                     "the sst_format_version flag gate"),
+                            detail=f"{kw.arg}={v.value}",
+                            hint=self.hint))
+        return out
+
+
+PASS = FormatGatePass()

@@ -380,7 +380,13 @@ def _compact_columnar(store, codec, blocks: List[ColumnarBlock],
     fixed_cat = {cid: cat_fixed(cid) for cid in fixed_ids}
     pk_cat = {cid: cat_pk(cid) for cid in pk_ids}
     path = store._new_sst_path()
-    w = SstWriter(path, stream_columnar=True)
+    # format follows the sst_format_version flag like every other
+    # writer (bench pins the flag to 1 around its baseline runs to get
+    # the pre-PR byte yardstick — that is a harness concern, not this
+    # engine's: an operator running baseline compactions must still get
+    # the format they configured)
+    w = SstWriter(path, stream_columnar=True,
+                  key_builder=codec.derive_keys)
     # pipeline: file writes of block k overlap the gathers of block k+1
     # (the write releases the GIL; the reference's CompactionJob
     # similarly overlaps merge work with output IO)
@@ -820,8 +826,11 @@ def _compact_columnar_chunked(store, codec, inputs: Sequence[SstReader],
                    if encode_async else None)          # stage 3, ordered
     path = store._new_sst_path()
     # incremental fsync from the write worker: the disk flush overlaps
-    # later chunks' merge/gather instead of landing as one serial tail
-    w = SstWriter(path, stream_columnar=True, sync_every_bytes=64 << 20)
+    # later chunks' merge/gather instead of landing as one serial tail.
+    # key_builder lets the v2 writer drop derivable key matrices (and
+    # readers of the output rebuild them through the same codec call).
+    w = SstWriter(path, stream_columnar=True, sync_every_bytes=64 << 20,
+                  key_builder=codec.derive_keys)
     cutter = _BlockCutter(w, write_pool, block_rows)
 
     active: List[_ActiveBlock] = []
@@ -1118,6 +1127,11 @@ def _compact_columnar_chunked(store, codec, inputs: Sequence[SstReader],
                 schema_version=sv, key_hash=key_hash, ht=ht_o,
                 write_id=wid_o, pk=pk_o, fixed=fixed_o, varlen=varlen_o,
                 tombstone=tomb_o, keys=keys_o, unique_keys=False)
+            # derivability is row-wise, so a gather from all-proven
+            # source blocks is itself proven (skips the write-side
+            # re-encode verify in the v2 serializer)
+            piece.keys_proven = all(ab.cb.keys_proven
+                                    for ab, _lo, _hi in segs)
         stats["gather_s"] += time.perf_counter() - t0
         stats["kept_rows"] += n_keep
         if piece is not None:
@@ -1226,6 +1240,12 @@ def _compact_columnar_chunked(store, codec, inputs: Sequence[SstReader],
         stats["kernel_cache_hits"] = (after["cache_hits"]
                                       - before["cache_hits"])
         stats["write_wait_s"] = cutter.write_wait_s
+        stats["format_version"] = w._fmt
+        stats["lanes"] = w.lane_stats.get("lanes", {})
+        try:
+            stats["output_bytes"] = os.path.getsize(path)
+        except OSError:
+            stats["output_bytes"] = 0
         LAST_COMPACTION_STATS.clear()
         LAST_COMPACTION_STATS.update(stats)
     store.replace_ssts(inputs, path)

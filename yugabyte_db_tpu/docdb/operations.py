@@ -33,6 +33,10 @@ from .table_codec import TableCodec
 
 _HT_SUFFIX = ENCODED_SIZE + 1
 
+#: zone-map pruning tally of the most recent pushdown scan (read by
+#: bench.py's cold_scan block; informational only)
+LAST_SCAN_PRUNE_STATS: dict = {}
+
 
 # --------------------------------------------------------------------------
 # Requests (wire format objects)
@@ -1398,14 +1402,42 @@ class DocReadOperation:
                 self.store.write_generation(),
                 flags.get("device_float_dtype"))
 
-    def _cached_batch(self, blocks, needed):
+    def _cached_batch(self, blocks, needed, extra: tuple = ()):
         """Build (or fetch from the device cache) the columnar batch for
-        `needed` columns."""
+        `needed` columns. `extra` extends the cache key — the zone-map
+        prune signature rides here so a batch built from one predicate's
+        pruned block set never serves another predicate."""
         if self.device_cache is None:
             return build_batch(blocks, sorted(needed))
         return self.device_cache.get_or_build(
-            self._batch_cache_key(needed),
+            self._batch_cache_key(needed) + extra,
             lambda: build_batch(blocks, sorted(needed)))
+
+    def _zone_prune(self, blocks, where, read_ht):
+        """Zone-map block pruning for the monolithic pushdown paths:
+        (kept_blocks, cache_key_extra). MVCC-gated exactly like the
+        streaming path — pruning is only sound when every doc key lives
+        wholly inside one block (chunk_safe over the FULL list), since
+        dropping a block may otherwise unmask an older version of a key
+        that survives elsewhere. Tallies LAST_SCAN_PRUNE_STATS either
+        way so the bench counter reads fresh values per scan."""
+        stats = {"blocks_total": len(blocks), "blocks_pruned": 0}
+        LAST_SCAN_PRUNE_STATS.clear()
+        LAST_SCAN_PRUNE_STATS.update(stats)
+        if where is None or not flags.get("zone_map_pruning"):
+            return blocks, ()
+        # a read point ALWAYS flows into the kernel's MVCC selection in
+        # these paths (even _MAX_HT), so the chunk-safety proof is
+        # unconditionally required before dropping any block
+        from ..ops.stream_scan import chunk_safe_mvcc
+        if read_ht is not None and not chunk_safe_mvcc(blocks):
+            return blocks, ()
+        from ..ops.scan import zone_prune_blocks
+        kept, kept_idx = zone_prune_blocks(blocks, where)
+        if len(kept) == len(blocks):
+            return blocks, ()
+        LAST_SCAN_PRUNE_STATS["blocks_pruned"] = len(blocks) - len(kept)
+        return kept, ("zp", kept_idx)
 
     def _try_streaming_aggregate(self, req: ReadRequest, blocks, needed,
                                  read_ht: int) -> Optional[ReadResponse]:
@@ -1473,8 +1505,13 @@ class DocReadOperation:
         resp = self._try_streaming_aggregate(req, blocks, needed, read_ht)
         if resp is not None:
             return resp
+        # zone-map pruning ahead of the monolithic batch build; the
+        # restart window below still checks the FULL block list (a
+        # pruned block's ambiguous-HT rows keep today's restart
+        # behavior)
+        kept, prune_key = self._zone_prune(blocks, req.where, read_ht)
         try:
-            batch = self._cached_batch(blocks, needed)
+            batch = self._cached_batch(kept, needed, prune_key)
         except KeyError:
             return None   # some column lacks columnar form → CPU path
         self._check_restart_window(blocks, read_ht)
@@ -1540,14 +1577,16 @@ class DocReadOperation:
         schema = self.codec.schema
         proj_cols = ([schema.column_by_name(n) for n in req.columns]
                      if req.columns else list(schema.columns))
+        read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
+        all_blocks = blocks
+        blocks, prune_key = self._zone_prune(blocks, req.where, read_ht)
         try:
             # same device cache as the aggregate path: repeated string-
             # predicate scans must not rebuild dictionaries per query
-            batch = self._cached_batch(blocks, needed)
+            batch = self._cached_batch(blocks, needed, prune_key)
         except KeyError:
             return None
-        read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
-        if len(blocks) > 1:
+        if len(all_blocks) > 1:
             batch.unique_keys = False
         where = req.where
         if where is not None:

@@ -361,6 +361,76 @@ class TableCodec:
                 out[name] = None   # column added after this version
         return out
 
+    # --- v2 keyless blocks: key matrix derivation -------------------------
+    def derive_keys(self, cb: ColumnarBlock) -> Optional[np.ndarray]:
+        """Rebuild a block's full encoded SubDocKey matrix from its pk
+        columns + ht/write_id lanes — THE v2 keyless-block contract.
+
+        Writers call this to VERIFY a block's keys matrix is byte-
+        derivable before dropping it from the serialized form; readers
+        call the same function (bound as the SST key_builder) to rebuild
+        lazily, so write-time verification proves read-time exactness.
+
+        The whole rebuild is the vectorized bulk-load encode pipeline
+        (dockv/bulk.py): per-component column encode, fused 16-bit
+        partition hash, one concatenate, one vectorized HT-suffix
+        append — no per-row Python. None when the pk shape is
+        underivable (varlen/unsupported component types, missing pk
+        arrays, cotable prefixes) — such blocks keep inline keys."""
+        if self.info.cotable_id is not None:
+            return None
+        ps = self.info.partition_schema
+        pk_blocks = []
+        for c in self._pk_cols:
+            enc = _BULK_ENC.get(c.type)
+            arr = cb.pk.get(c.id)
+            if enc is None or arr is None or len(arr) != cb.n:
+                return None
+            try:
+                pk_blocks.append(enc(np.asarray(arr), c.sort_desc))
+            except (TypeError, ValueError):
+                return None
+        if not pk_blocks:
+            return None
+        n = cb.n
+        hashes = None
+        nh = 0
+        if ps.kind == "hash":
+            nh = ps.num_hash_columns
+            hash_input = (pk_blocks[0] if nh == 1
+                          else np.concatenate(pk_blocks[:nh], axis=1))
+            hashes = bulk.fast_hash16_from_encoded(hash_input)
+        # one preallocated fill instead of encode_doc_keys +
+        # append_hybrid_times (each a full-matrix concat copy — this
+        # runs per block on the compaction decode path, so the extra
+        # 27 B/row copy was measurable); byte layout identical to the
+        # bulk pipeline, asserted by the v1-vs-v2 entry-equality tests
+        from ..dockv.key_encoding import ValueType as _VT
+        width = (sum(b.shape[1] for b in pk_blocks) + 1
+                 + (4 if hashes is not None else 0) + 13)
+        out = np.empty((n, width), np.uint8)
+        pos = 0
+        if hashes is not None:
+            out[:, 0] = _VT.kUInt16Hash
+            out[:, 1:3] = hashes.astype(">u2").view(np.uint8).reshape(-1, 2)
+            pos = 3
+            for b in pk_blocks[:nh]:
+                out[:, pos:pos + b.shape[1]] = b
+                pos += b.shape[1]
+            out[:, pos] = _VT.kGroupEnd
+            pos += 1
+        for b in pk_blocks[nh:]:
+            out[:, pos:pos + b.shape[1]] = b
+            pos += b.shape[1]
+        out[:, pos] = _VT.kGroupEnd
+        out[:, pos + 1] = _VT.kHybridTime
+        out[:, pos + 2:pos + 10] = (~np.asarray(cb.ht, np.uint64)).astype(
+            ">u8").view(np.uint8).reshape(-1, 8)
+        out[:, pos + 10:pos + 14] = (~np.asarray(
+            cb.write_id, np.uint32)).astype(">u4").view(
+                np.uint8).reshape(-1, 4)
+        return out
+
     # --- columnar builder / row decoder (plugged into LsmStore) -----------
     def columnar_builder(self, entries: Sequence[Tuple[bytes, bytes]]
                          ) -> Optional[ColumnarBlock]:
@@ -438,7 +508,11 @@ class TableCodec:
     def row_decoder(self, blk: ColumnarBlock) -> List[Tuple[bytes, bytes]]:
         """Reconstruct KV entries from a columnar-only block (slow path,
         used by CPU merges/point-reads over bulk-loaded SSTs)."""
-        assert blk.keys is not None
+        if blk.keys is None:   # property: rebuilds v2 keyless blocks
+            raise ValueError(
+                "columnar-only block has no keys matrix and no bound "
+                "key_builder — a v2 keyless block must be read through "
+                "its table codec")
         packing = self.info.packings.get(blk.schema_version)
         packer = RowPacker(packing)
         out = []
@@ -597,13 +671,20 @@ class TableCodec:
                     prev_last_dk == dk_b[0].tobytes():
                 uniq = False
             prev_last_dk = dk_b[-1].tobytes()
-            yield ColumnarBlock.from_arrays(
+            blk = ColumnarBlock.from_arrays(
                 schema_version=self.schema.version,
                 key_hash=kh_b,
                 ht=np.full(bn, ht.value, np.uint64),
                 write_id=ord_b.astype(np.uint32),
                 pk=pk, fixed=fixed, varlen=varlen,
                 keys=keys_b, unique_keys=uniq)
+            # keys were built by the exact pipeline derive_keys replays
+            # (same encoders, same fast hash, write_id == encoded
+            # suffix by construction), so derivability is proven with
+            # no write-time verify; cotable prefixes would break the
+            # replay (derive_keys refuses them)
+            blk.keys_proven = self.info.cotable_id is None
+            yield blk
 
 
 def _rows_ge(mat: np.ndarray, bound: np.ndarray) -> np.ndarray:

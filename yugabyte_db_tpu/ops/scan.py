@@ -721,6 +721,136 @@ def _expand_avg(aggs: Sequence[AggSpec]) -> List[AggSpec]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Zone-map block pruning (v2 SST blocks carry per-block min/max maps)
+# ---------------------------------------------------------------------------
+
+def _f32_widen(lo, hi):
+    """Widen a float interval to cover f32 re-rounding: the device may
+    evaluate the column (and predicate constants) in float32, where a
+    value just below a boundary can round ONTO it — e.g. f64
+    0.0499999999 becomes f32(0.05) and satisfies `>= 0.05`. One f32 ulp
+    outward on each end covers every such crossing; on f64 backends the
+    widening merely forfeits a sliver of pruning."""
+    lo_w = float(np.nextafter(np.float32(lo), np.float32(-np.inf)))
+    hi_w = float(np.nextafter(np.float32(hi), np.float32(np.inf)))
+    return (min(lo, lo_w), max(hi, hi_w))
+
+
+def _zone_interval(node, zmap):
+    """Conservative (lo, hi) interval of an expression over a block
+    described by its zone map, or None when unboundable. Integer lanes
+    stay exact python ints (no float roundoff at int64 block
+    boundaries); float lanes widen to the f32 envelope (_f32_widen)
+    because the kernel may evaluate them in the device float dtype."""
+    kind = node[0]
+    if kind == "col":
+        b = zmap.get(node[1])
+        if b is not None and (isinstance(b[0], float)
+                              or isinstance(b[1], float)):
+            return _f32_widen(b[0], b[1])
+        return b
+    if kind == "const":
+        v = node[1]
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            return None
+        if isinstance(v, float):
+            # the kernel may round the constant itself to f32: an exact
+            # zone bound equal to the f32-rounded constant must still
+            # count as overlapping
+            return _f32_widen(v, v)
+        return (v, v)
+    if kind == "arith":
+        lb = _zone_interval(node[2], zmap)
+        rb = _zone_interval(node[3], zmap)
+        if lb is None or rb is None:
+            return None
+        op = node[1]
+        if op == "add":
+            out = (lb[0] + rb[0], lb[1] + rb[1])
+        elif op == "sub":
+            out = (lb[0] - rb[1], lb[1] - rb[0])
+        elif op == "mul":
+            prods = (lb[0] * rb[0], lb[0] * rb[1],
+                     lb[1] * rb[0], lb[1] * rb[1])
+            out = (min(prods), max(prods))
+        else:
+            return None
+        if isinstance(out[0], float) or isinstance(out[1], float):
+            out = _f32_widen(out[0], out[1])   # per-op device rounding
+        return out
+    return None
+
+
+def zone_maybe_match(where, zmap) -> bool:
+    """Conservative zone-map test: False ONLY when the block's value
+    ranges PROVE no row can satisfy `where` — then the whole block can
+    skip batch formation. True on anything unprovable (missing zone
+    map entries, string predicates, NOT, unsupported shapes).
+
+    NULL semantics line up with the kernel: zone maps cover non-null
+    values only and a NULL comparison never matches, so a block pruned
+    on its non-null range cannot hide a NULL row that would have
+    matched."""
+    if not zmap:
+        return True
+    kind = where[0]
+    if kind == "and":
+        return all(zone_maybe_match(c, zmap) for c in where[1:])
+    if kind == "or":
+        return any(zone_maybe_match(c, zmap) for c in where[1:])
+    if kind == "between":
+        return (zone_maybe_match(("cmp", "ge", where[1], where[2]), zmap)
+                and zone_maybe_match(("cmp", "le", where[1], where[3]),
+                                     zmap))
+    if kind == "in":
+        x, vals = where[1], where[2]
+        b = _zone_interval(x, zmap)
+        if b is None:
+            return True
+        return any(isinstance(v, (int, float)) and not isinstance(v, bool)
+                   and b[0] <= v <= b[1] for v in vals) or not vals
+    if kind == "cmp":
+        op = where[1]
+        lb = _zone_interval(where[2], zmap)
+        rb = _zone_interval(where[3], zmap)
+        if lb is None or rb is None:
+            return True
+        if op == "lt":
+            return lb[0] < rb[1]
+        if op == "le":
+            return lb[0] <= rb[1]
+        if op == "gt":
+            return lb[1] > rb[0]
+        if op == "ge":
+            return lb[1] >= rb[0]
+        if op == "eq":
+            return lb[0] <= rb[1] and lb[1] >= rb[0]
+        if op == "ne":
+            return not (lb[0] == lb[1] == rb[0] == rb[1])
+        return True
+    return True
+
+
+def zone_prune_blocks(blocks, where):
+    """Split `blocks` into (kept_blocks, kept_indices) by their zone
+    maps — indices are positions in the input list, the stable prune
+    identity device-cache keys embed (two predicates pruning different
+    sets must never share a cached batch). Never returns an empty kept
+    list: aggregates/filters still need one (non-matching) block to
+    keep result shapes and NULL semantics on the device path, so the
+    cheapest block survives as the representative when everything
+    proves unmatchable."""
+    if where is None:
+        return list(blocks), tuple(range(len(blocks)))
+    kept_idx = [i for i, b in enumerate(blocks)
+                if getattr(b, "zmap", None) is None
+                or zone_maybe_match(where, b.zmap)]
+    if not kept_idx and blocks:
+        kept_idx = [min(range(len(blocks)), key=lambda i: blocks[i].n)]
+    return [blocks[i] for i in kept_idx], tuple(kept_idx)
+
+
 _DEFAULT_KERNEL = ScanKernel()
 
 

@@ -70,23 +70,30 @@ def plan_chunks(blocks: Sequence[ColumnarBlock],
 
 def chunk_safe_mvcc(blocks: Sequence[ColumnarBlock]) -> bool:
     """True when chunking at any block boundary preserves MVCC
-    semantics: all blocks are internally unique-keyed, carry keys
-    matrices, and no doc key straddles two consecutive blocks — so the
-    newest-visible-version choice never needs to see two chunks."""
+    semantics: all blocks are internally unique-keyed, carry (or can
+    derive) keys, and no doc key straddles two consecutive blocks — so
+    the newest-visible-version choice never needs to see two chunks.
+
+    Only BOUNDARY keys are consulted (first_full_key/last_full_key), so
+    v2 keyless blocks prove safety from their stored boundary keys
+    without materializing the derived key matrix."""
     prev_last: Optional[bytes] = None
     for b in blocks:
-        if not b.unique_keys or b.keys is None or b.n == 0:
+        if not b.unique_keys or b.n == 0 or not (
+                b.keys_derivable or b.first_full_key() is not None):
             return False
-        if b.keys.shape[1] <= _HT_SUFFIX:
+        first = b.first_full_key()
+        last = b.last_full_key()
+        if first is None or last is None or len(first) <= _HT_SUFFIX:
             return False
         # boundary doc keys must be STRICTLY ascending across the whole
         # block sequence: that proves the blocks are one globally-sorted
         # disjoint run (a second overlapping SST — or a memtable overlay
         # — breaks monotonicity at its first block and fails here)
-        first_dk = b.keys[0, :-_HT_SUFFIX].tobytes()
+        first_dk = first[:-_HT_SUFFIX]
         if prev_last is not None and prev_last >= first_dk:
             return False
-        prev_last = b.keys[-1, :-_HT_SUFFIX].tobytes()
+        prev_last = last[:-_HT_SUFFIX]
     return True
 
 
@@ -137,11 +144,31 @@ def streaming_scan_aggregate(
         for cid in columns:
             if not (cid in b.fixed or cid in b.pk):
                 return None
-    if read_ht is not None and not chunk_safe_mvcc(blocks):
+    chunk_safe = chunk_safe_mvcc(blocks)
+    if read_ht is not None and not chunk_safe:
         return None
+    # zone-map pruning: skip whole blocks whose v2 min/max maps prove
+    # the WHERE can't match, BEFORE any batch formation. Safe exactly
+    # when each doc key lives in one block (chunk_safe over the FULL
+    # list — a pruned block can then never hide a newer version of a
+    # surviving key); with no read point every row stands alone and
+    # pruning is unconditionally safe.
+    pruned = 0
+    kept_idx = None
+    if where is not None and flags.get("zone_map_pruning") \
+            and (read_ht is None or chunk_safe):
+        from .scan import zone_prune_blocks
+        kept, kept_idx = zone_prune_blocks(blocks, where)
+        pruned = len(blocks) - len(kept)
+        if pruned:
+            blocks = kept
     chunk_rows = chunk_rows or int(flags.get("streaming_chunk_rows"))
     chunks = plan_chunks(blocks, chunk_rows)
-    if len(chunks) < min_chunks:
+    if len(chunks) < min_chunks and not pruned:
+        # min_chunks guards the unpruned case only (2 marginal chunks
+        # measured slower than one monolithic batch); once zone maps
+        # dropped blocks, streaming the small remainder beats falling
+        # back to the monolithic path, which would rebuild it anyway
         return None
     kernel = kernel or _default_kernel()
     aggs = tuple(_expand_avg(aggs))
@@ -149,6 +176,11 @@ def streaming_scan_aggregate(
     # one shared pow2 bucket: every full chunk reuses one kernel-cache
     # signature (the last, short chunk pads up to the same bucket)
     bucket = bucket_rows(max(max(sum(b.n for b in c) for c in chunks), 1))
+
+    # pruning changes which blocks land in which chunk, so the kept-set
+    # INDICES are part of the device-cache identity — a batch cached
+    # under one predicate's prune must never serve another predicate's
+    prune_sig = ("zp", kept_idx) if pruned else ()
 
     def build(item):
         ci, chunk = item
@@ -158,7 +190,7 @@ def streaming_scan_aggregate(
             # and batches cached under the OLD plan must never serve the
             # new one (rows would double-count); stale entries LRU out
             return cache.get_or_build(
-                cache_key + ("chunk", chunk_rows, bucket, ci),
+                cache_key + ("chunk", chunk_rows, bucket, ci) + prune_sig,
                 lambda: build_batch(chunk, cols_sorted, pad_to=bucket))
         return build_batch(chunk, cols_sorted, pad_to=bucket)
 
@@ -177,6 +209,8 @@ def streaming_scan_aggregate(
     LAST_STREAM_STATS.clear()
     LAST_STREAM_STATS.update({
         "chunks": len(chunks), "bucket_rows": bucket,
+        "zone_blocks_pruned": pruned,
+        "zone_blocks_total": len(blocks) + pruned,
         "build_s": round(pipe.stage_s[0], 4),
         "kernel_s": round(kernel_s, 4),
         "consumer_wait_s": round(pipe.wait_s, 4)})

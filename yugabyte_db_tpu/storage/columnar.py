@@ -11,11 +11,21 @@ scan/filter/aggregate kernels consume it directly (ops/scan.py).
 Blocks are built either from packed-row KV entries (flush/compaction
 path) or straight from user arrays (bulk load path), and serialize into
 the SST's columnar section.
+
+Two on-disk formats coexist (FORMAT.md):
+
+  v1  every lane dumped raw, keys matrix always inline — byte-identical
+      to the pre-v2 writer; ``sst_format_version=1`` pins it.
+  v2  the keys matrix is DROPPED when it is provably derivable from the
+      pk columns + ht/write_id lanes (the writer re-encodes and
+      byte-compares before committing to the drop; readers rebuild
+      lazily through a bound key_builder), every lane goes through the
+      lane_codec "encode only if smaller" menu, and the header carries
+      per-block min/max zone maps the scan pushdown prunes on.
 """
 from __future__ import annotations
 
 import struct
-from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import msgpack
@@ -24,6 +34,11 @@ import numpy as np
 from ..dockv.key_encoding import _decode_varint_unsigned
 from ..dockv.packed_row import ColumnType, SchemaPacking
 from ..dockv.value import ValueKind
+from . import lane_codec
+
+#: newest block format this build can read/write; deserialize rejects
+#: anything newer with a clear error instead of misparsing it
+SUPPORTED_FORMAT_VERSION = 2
 
 _HASH_MULT = np.uint64(0x100000001B3)
 _HASH_OFF = np.uint64(0xCBF29CE484222325)
@@ -95,37 +110,122 @@ def fnv64_keys(keys: Sequence[bytes]) -> np.ndarray:
     return h
 
 
-@dataclass
 class ColumnarBlock:
-    """Struct-of-arrays form of one sorted run of rows."""
+    """Struct-of-arrays form of one sorted run of rows.
 
-    n: int
-    schema_version: int
-    # MVCC per-row metadata
-    key_hash: np.ndarray            # uint64 — FNV of encoded DocKey (no HT)
-    ht: np.ndarray                  # uint64 — HybridTime.value
-    write_id: np.ndarray            # uint32
-    tombstone: np.ndarray           # bool
-    # primary key component values (fixed-width components only)
-    pk: Dict[int, np.ndarray] = field(default_factory=dict)
-    # fixed-width value columns: col id -> (values, null_mask)
-    fixed: Dict[int, Tuple[np.ndarray, np.ndarray]] = field(default_factory=dict)
-    # varlen value columns: col id -> (end_offsets uint32 [n], heap bytes,
-    # null_mask)
-    varlen: Dict[int, Tuple[np.ndarray, bytes, np.ndarray]] = field(
-        default_factory=dict)
-    # True when every doc key appears exactly once in this block (post-
-    # compaction / bulk-load blocks) — enables the no-dedup scan fast path.
-    unique_keys: bool = True
-    # Optional full encoded SubDocKeys (incl. HT suffix) as an [N, L] uint8
-    # matrix — present on columnar-only blocks (bulk loads), where the KV
-    # row region is omitted entirely and rows are reconstructed on demand.
-    keys: Optional[np.ndarray] = None
-    # lazily-built void view of `keys` for binary search (point reads
-    # revisit hot blocks; rebuilding the view per lookup is an O(block)
-    # copy)
-    _void_keys: Optional[np.ndarray] = field(default=None, repr=False,
-                                             compare=False)
+    Attributes:
+      n, schema_version
+      key_hash  uint64 — FNV of encoded DocKey (no HT)
+      ht        uint64 — HybridTime.value
+      write_id  uint32
+      tombstone bool
+      pk        {col id: values} — fixed-width PK component values
+      fixed     {col id: (values, null_mask)}
+      varlen    {col id: (end_offsets uint32 [n], heap bytes, null_mask)}
+      unique_keys  True when every doc key appears exactly once in this
+                   block (post-compaction / bulk-load blocks) — enables
+                   the no-dedup scan fast path.
+      keys      optional full encoded SubDocKeys (incl. HT suffix) as an
+                [N, L] uint8 matrix — present on columnar-only blocks
+                (bulk loads), where the KV row region is omitted
+                entirely and rows are reconstructed on demand. For v2
+                keyless blocks this is a LAZY property: the matrix
+                rebuilds from pk + ht/write_id through the bound
+                key_builder on first access.
+      zmap      {col id: (min, max)} per-block zone map over non-null
+                values of pk + fixed value columns (v2 blocks only) —
+                the scan pushdown prunes whole blocks on it.
+    """
+
+    __slots__ = ("n", "schema_version", "key_hash", "ht", "write_id",
+                 "tombstone", "pk", "fixed", "varlen", "unique_keys",
+                 "zmap", "keys_proven", "_keys",
+                 "_key_thunk", "_first_key", "_last_key", "_void_keys",
+                 "_finder", "_extractors", "__weakref__")
+
+    def __init__(self, n: int, schema_version: int,
+                 key_hash: np.ndarray, ht: np.ndarray,
+                 write_id: np.ndarray, tombstone: np.ndarray,
+                 pk: Optional[Dict[int, np.ndarray]] = None,
+                 fixed: Optional[Dict[int, Tuple[np.ndarray, np.ndarray]]] = None,
+                 varlen: Optional[Dict[int, Tuple[np.ndarray, bytes, np.ndarray]]] = None,
+                 unique_keys: bool = True,
+                 keys: Optional[np.ndarray] = None):
+        self.n = n
+        self.schema_version = schema_version
+        self.key_hash = key_hash
+        self.ht = ht
+        self.write_id = write_id
+        self.tombstone = tombstone
+        self.pk = pk if pk is not None else {}
+        self.fixed = fixed if fixed is not None else {}
+        self.varlen = varlen if varlen is not None else {}
+        self.unique_keys = unique_keys
+        self.zmap: Optional[Dict[int, Tuple[object, object]]] = None
+        # True when every row's key is PROVEN byte-derivable from the
+        # pk + ht/write_id lanes: set by the bulk builder (keys were
+        # built by the very function derive_keys replays), by v2
+        # deserialize of derived blocks (write-time verify passed), and
+        # propagated row-wise through slice/concat/gather — the v2
+        # serializer then drops keys without re-verifying (a full
+        # re-encode per block otherwise sits on the write path)
+        self.keys_proven: bool = False
+        self._keys: Optional[np.ndarray] = None
+        self._key_thunk = None         # callable(cb) -> ndarray | None
+        self._first_key: Optional[bytes] = None
+        self._last_key: Optional[bytes] = None
+        # lazily-built void view of `keys` for binary search (point
+        # reads revisit hot blocks; rebuilding the view per lookup is an
+        # O(block) copy)
+        self._void_keys: Optional[np.ndarray] = None
+        if keys is not None:
+            self.keys = keys
+
+    # --- lazy keys matrix --------------------------------------------
+    @property
+    def keys(self) -> Optional[np.ndarray]:
+        """Full encoded SubDocKey matrix. For v2 keyless blocks the
+        first access rebuilds it through the bound key_builder (one
+        fused vectorized re-encode from pk + ht + write_id); None when
+        the block has no keys and no way to derive them."""
+        if self._keys is None and self._key_thunk is not None:
+            thunk, self._key_thunk = self._key_thunk, None
+            self._keys = thunk(self)
+        return self._keys
+
+    @keys.setter
+    def keys(self, v: Optional[np.ndarray]) -> None:
+        self._keys = v
+        self._void_keys = None
+
+    @property
+    def keys_derivable(self) -> bool:
+        """True when a keys matrix is available or can be rebuilt."""
+        return self._keys is not None or self._key_thunk is not None
+
+    def bind_key_builder(self, builder) -> None:
+        """Attach the lazy rebuild callback of a v2 keyless block (set
+        by SstReader from the docdb codec's derive_keys)."""
+        if self._keys is None and builder is not None:
+            self._key_thunk = builder
+
+    def first_full_key(self) -> Optional[bytes]:
+        """First row's full encoded key WITHOUT materializing a derived
+        keys matrix when the serialized boundary keys are present."""
+        if self._keys is not None:
+            return self._keys[0].tobytes() if self.n else None
+        if self._first_key is not None:
+            return self._first_key
+        k = self.keys                  # may invoke the rebuild thunk
+        return k[0].tobytes() if k is not None and self.n else None
+
+    def last_full_key(self) -> Optional[bytes]:
+        if self._keys is not None:
+            return self._keys[-1].tobytes() if self.n else None
+        if self._last_key is not None:
+            return self._last_key
+        k = self.keys
+        return k[-1].tobytes() if k is not None and self.n else None
 
     # ------------------------------------------------------------------
     @classmethod
@@ -223,11 +323,26 @@ class ColumnarBlock:
             unique_keys=unique_keys, keys=keys)
 
     # ------------------------------------------------------------------
-    def serialize_parts(self) -> Tuple[bytes, List[object]]:
+    def serialize_parts(self, version: int = 1, key_builder=None,
+                        stats: Optional[dict] = None
+                        ) -> Tuple[bytes, List[object]]:
         """(header bytes, payload buffers). Buffers are buffer-protocol
         objects (contiguous ndarrays / bytes) so callers can stream them
         to a file without materializing one giant bytes — compaction
-        writes hundreds of MB through here."""
+        writes hundreds of MB through here.
+
+        version=1 reproduces the pre-v2 bytes EXACTLY (the
+        ``sst_format_version=1`` gate). version=2 drops the keys matrix
+        when ``key_builder(self)`` rebuilds it byte-identically, runs
+        every lane through lane_codec, and embeds zone maps; `stats`
+        (optional dict) accumulates the per-lane encode accounting."""
+        if version == 1:
+            return self._serialize_v1()
+        if version != 2:
+            raise ValueError(f"unknown block format version {version}")
+        return self._serialize_v2(key_builder, stats)
+
+    def _serialize_v1(self) -> Tuple[bytes, List[object]]:
         bufs: List[object] = []
         def ref(arr: np.ndarray) -> dict:
             a = np.ascontiguousarray(arr)
@@ -249,38 +364,168 @@ class ColumnarBlock:
         head = msgpack.packb(meta)
         return struct.pack("<I", len(head)) + head, bufs
 
-    def serialize(self) -> bytes:
-        head, bufs = self.serialize_parts()
+    def _serialize_v2(self, key_builder, stats: Optional[dict]
+                      ) -> Tuple[bytes, List[object]]:
+        bufs: List[object] = []
+
+        def lane(name: str, arr: np.ndarray) -> dict:
+            m, parts, enc = lane_codec.encode_lane(arr)
+            bufs.extend(parts)
+            lane_codec.tally(stats, name, arr.nbytes,
+                             sum(p.nbytes for p in parts), enc)
+            return m
+
+        keys = self.keys
+        keys_meta = None
+        if keys is not None:
+            drop = False
+            if key_builder is not None:
+                if self.keys_proven:
+                    # row-wise derivability already proven upstream
+                    # (bulk construction or gathered from proven
+                    # blocks): skip the full re-encode+compare
+                    drop = True
+                else:
+                    derived = None
+                    try:
+                        derived = key_builder(self)
+                    except Exception:  # noqa: BLE001 — derivation is an
+                        derived = None  # optimization, never a crasher
+                    drop = (derived is not None
+                            and derived.shape == keys.shape
+                            and derived.dtype == keys.dtype
+                            and np.array_equal(derived, keys))
+            if drop:
+                keys_meta = {"drv": 1}
+                lane_codec.tally(stats, "keys", keys.nbytes, 0, "derived")
+            else:
+                keys_meta = lane("keys", keys)
+        meta = {
+            "v": 2,
+            "n": self.n, "sv": self.schema_version, "uniq": self.unique_keys,
+            "keys": keys_meta,
+            "key_hash": lane("key_hash", self.key_hash),
+            "ht": lane("ht", self.ht),
+            "wid": lane("write_id", self.write_id),
+            "tomb": lane("tombstone", self.tombstone),
+            "pk": {str(k): lane("pk", v) for k, v in self.pk.items()},
+            "fixed": {str(k): [lane("fixed_vals", v), lane("fixed_null", m)]
+                      for k, (v, m) in self.fixed.items()},
+            "varlen": {},
+        }
+        for k, (ends, heap, null) in self.varlen.items():
+            # heap rides FIRST in the payload stream (the v1 order, so
+            # the shared deserializer walks both formats identically)
+            hb = (heap if isinstance(heap, (bytes, bytearray))
+                  else np.ascontiguousarray(heap))
+            bufs.append(hb)
+            lane_codec.tally(stats, "varlen_heap", len(heap), len(heap),
+                             "raw")
+            meta["varlen"][str(k)] = [lane("varlen_ends", ends),
+                                      {"len": len(heap)},
+                                      lane("varlen_null", null)]
+        if keys is not None and self.n:
+            meta["k0"] = keys[0].tobytes()
+            meta["k1"] = keys[-1].tobytes()
+        zmap = self._build_zone_map()
+        if zmap:
+            meta["zmap"] = {str(c): [lo, hi] for c, (lo, hi) in
+                            zmap.items()}
+        head = msgpack.packb(meta)
+        lane_codec.tally(stats, "header", len(head) + 4, len(head) + 4,
+                         "raw")
+        return struct.pack("<I", len(head)) + head, bufs
+
+    def _build_zone_map(self) -> Dict[int, Tuple[object, object]]:
+        """Per-column (min, max) over non-null values of pk + fixed
+        value columns. Exact python ints for integer lanes (no float
+        rounding at int64 magnitudes — the prune comparisons must be
+        safe at block boundaries); floats skip when NaN is present."""
+        out: Dict[int, Tuple[object, object]] = {}
+        if not self.n:
+            return out
+
+        def bounds(arr: np.ndarray, null: Optional[np.ndarray]):
+            if arr.ndim != 1 or arr.dtype.kind not in "iuf":
+                return None
+            v = arr if null is None else arr[~null]
+            if not len(v):
+                return None
+            lo, hi = v.min(), v.max()
+            if arr.dtype.kind == "f":
+                if not (np.isfinite(lo) and np.isfinite(hi)):
+                    return None
+                return (float(lo), float(hi))
+            return (int(lo), int(hi))
+
+        for cid, arr in self.pk.items():
+            b = bounds(np.asarray(arr), None)
+            if b is not None:
+                out[cid] = b
+        for cid, (vals, null) in self.fixed.items():
+            b = bounds(np.asarray(vals), np.asarray(null))
+            if b is not None:
+                out[cid] = b
+        return out
+
+    def serialize(self, version: int = 1, key_builder=None) -> bytes:
+        head, bufs = self.serialize_parts(version, key_builder)
         return head + b"".join(
             b if isinstance(b, bytes) else memoryview(b).cast("B")
             for b in bufs)
 
     @classmethod
-    def deserialize(cls, data, copy: bool = True) -> "ColumnarBlock":
+    def deserialize(cls, data, copy: bool = True,
+                    max_version: int = SUPPORTED_FORMAT_VERSION
+                    ) -> "ColumnarBlock":
         """Rebuild a block from its serialized form. With copy=False and
         a buffer-backed `data` (e.g. a memoryview over the SST mmap) the
         arrays are zero-copy READ-ONLY views — the compaction pipeline
         reads each input row once, so materializing owned copies first
-        would double its memory traffic for nothing."""
+        would double its memory traffic for nothing. (v2 lanes that were
+        lane-encoded decode into small owned arrays either way; raw
+        lanes stay views.)
+
+        Blocks newer than ``max_version`` raise a clear ValueError — the
+        v2-written/v1-reader rejection path — instead of misparsing."""
         hlen = struct.unpack_from("<I", data)[0]
         meta = msgpack.unpackb(data[4:4 + hlen], strict_map_key=False)
+        version = meta.get("v", 1)
+        if version > max_version:
+            raise ValueError(
+                f"columnar block format v{version} is newer than this "
+                f"reader supports (<= v{max_version}); upgrade before "
+                "reading this SST")
         pos = 4 + hlen
 
-        def take(ref) -> np.ndarray:
-            nonlocal pos
-            raw = data[pos:pos + ref["len"]]
-            pos += ref["len"]
-            arr = np.frombuffer(raw, dtype=np.dtype(ref["dtype"])).reshape(
-                ref["shape"])
-            return arr.copy() if copy else arr
-
-        def take_raw(n):
+        def fetch(n):
             nonlocal pos
             raw = data[pos:pos + n]
             pos += n
             return raw
 
-        keys = take(meta["keys"]) if meta.get("keys") is not None else None
+        if version == 1:
+            def take(ref) -> np.ndarray:
+                raw = fetch(ref["len"])
+                arr = np.frombuffer(raw, dtype=np.dtype(ref["dtype"])
+                                    ).reshape(ref["shape"])
+                return arr.copy() if copy else arr
+        else:
+            def take(ref) -> np.ndarray:
+                enc = ref.get("enc")
+                arr = lane_codec.decode_lane(ref, fetch)
+                if enc is None and copy:
+                    return arr.copy()
+                return arr
+
+        keys_meta = meta.get("keys")
+        keys = None
+        derived = False
+        if keys_meta is not None:
+            if keys_meta.get("drv"):
+                derived = True
+            else:
+                keys = take(keys_meta)
         blk = cls(
             n=meta["n"], schema_version=meta["sv"],
             key_hash=take(meta["key_hash"]), ht=take(meta["ht"]),
@@ -293,10 +538,19 @@ class ColumnarBlock:
             m = take(mref)
             blk.fixed[int(k)] = (v, m)
         for k, (eref, heapinfo, nref) in meta["varlen"].items():
-            heap = take_raw(heapinfo["len"])
+            heap = fetch(heapinfo["len"])
             ends = take(eref)
             null = take(nref)
             blk.varlen[int(k)] = (ends, heap, null)
+        if version >= 2:
+            if derived:
+                blk.keys_proven = True     # write-time verify passed
+            if meta.get("k0") is not None:
+                blk._first_key = meta["k0"]
+                blk._last_key = meta["k1"]
+            z = meta.get("zmap")
+            if z:
+                blk.zmap = {int(c): (b[0], b[1]) for c, b in z.items()}
         return blk
 
     def visible_mask(self, read_ht: int) -> np.ndarray:
@@ -312,6 +566,7 @@ class ColumnarBlock:
             write_id=self.write_id[lo:hi], tombstone=self.tombstone[lo:hi],
             unique_keys=self.unique_keys,
             keys=self.keys[lo:hi] if self.keys is not None else None)
+        out.keys_proven = self.keys_proven   # row-wise property
         for cid, arr in self.pk.items():
             out.pk[cid] = arr[lo:hi]
         for cid, (v, m) in self.fixed.items():
@@ -346,6 +601,7 @@ class ColumnarBlock:
             unique_keys=False,
             keys=(np.concatenate([b.keys for b in blocks])
                   if first.keys is not None else None))
+        out.keys_proven = all(b.keys_proven for b in blocks)
         for cid in first.pk:
             out.pk[cid] = np.concatenate([b.pk[cid] for b in blocks])
         for cid in first.fixed:

@@ -1,0 +1,186 @@
+"""Per-lane lightweight encodings for the v2 columnar SST block format.
+
+Each block lane (an MVCC column, a null mask, a value column, varlen
+end-offsets) is encoded independently with the cheapest scheme that
+actually shrinks it — the strict "encode only if smaller" rule: every
+candidate's exact encoded size is compared against the raw dump and raw
+wins ties, so an incompressible lane (random f64 prices, FNV key
+hashes) costs zero bytes and zero decode work over v1.
+
+The menu targets the shapes LSM MVCC lanes actually take ("Columnar
+Formats for Schemaless LSM-based Document Stores" exploits the same
+structure):
+
+  const   one value repeated (bulk-load ht lanes, all-false tombstone
+          and null masks)                      -> 1 value
+  dconst  arithmetic progression (write_id = arange, sequential
+          row ids, fixed-width varlen offsets) -> first + step
+  delta   wraparound deltas zigzag-packed into the narrowest unsigned
+          dtype (slowly-varying hts, varlen end offsets of short
+          strings)                             -> first + n-1 narrow
+  rle     run values + run lengths (sparse tombstone/null masks,
+          sorted low-cardinality lanes)        -> 2 * runs
+  dict    sorted uniques + narrow codes (low-cardinality value
+          columns: quantities, discounts, date columns, the ht set of
+          a multi-SST compaction output)       -> uniques + n codes
+
+All encoders operate on an unsigned-integer VIEW of the lane (floats
+and bools reinterpret bit-exactly), so NaN payloads and signed zeros
+round-trip byte-identically; the decoders are plain numpy — the decode
+oracle the tests replay against the original arrays.
+
+Buffer metadata rides in the block's msgpack header: a raw lane keeps
+the v1 ``{"dtype", "shape", "len"}`` shape; an encoded lane adds
+``"enc"`` plus per-part buffer descriptors, so v1 readers that predate
+this module never see the keys (they reject on the block's version tag
+first).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+#: unsigned view dtype per itemsize — encodings reinterpret, never
+#: convert, so float/bool lanes round-trip bit-exactly
+_UVIEW = {1: np.uint8, 2: np.uint16, 4: np.uint32, 8: np.uint64}
+_NARROW = (np.uint8, np.uint16, np.uint32)
+
+#: dict encoding is only attempted when a small prefix sample stays
+#: under this many distinct values — np.unique over the full lane is
+#: O(n log n) and must not run on high-cardinality lanes just to fail
+_DICT_SAMPLE = 2048
+_DICT_SAMPLE_MAX = 384
+
+
+def _uview(arr: np.ndarray) -> Optional[np.ndarray]:
+    """1-D same-width unsigned reinterpret of a lane (None when the
+    dtype has no unsigned twin — such lanes stay raw)."""
+    if arr.ndim != 1:
+        return None
+    u = _UVIEW.get(arr.dtype.itemsize)
+    if u is None or arr.dtype.kind not in "iufb":
+        return None
+    return np.ascontiguousarray(arr).view(u)
+
+
+def _narrowest(maxval: int) -> Optional[np.dtype]:
+    for dt in _NARROW:
+        if maxval <= np.iinfo(dt).max:
+            return np.dtype(dt)
+    return None
+
+
+def encode_lane(arr: np.ndarray) -> Tuple[dict, List[np.ndarray], str]:
+    """(meta, buffers, encoding_name) for one lane. The meta carries
+    everything decode_lane needs; buffers are contiguous ndarrays the
+    caller streams to the file in order."""
+    raw = np.ascontiguousarray(arr)
+    raw_meta = {"dtype": str(arr.dtype), "shape": list(arr.shape),
+                "len": raw.nbytes}
+    u = _uview(raw)
+    n = 0 if u is None else len(u)
+    if u is None or n < 2:
+        return raw_meta, [raw], "raw"
+    cands: List[Tuple[int, str, list, List[np.ndarray]]] = []
+
+    diffs = u[1:] - u[:-1]            # wraparound delta in lane width
+    # const / dconst: O(n) checks, no buffers beyond 1-2 values
+    if not diffs.any():
+        cands.append((raw.dtype.itemsize, "const", [], [u[:1]]))
+    elif n > 2 and not (diffs[1:] != diffs[0]).any():
+        cands.append((2 * raw.dtype.itemsize, "dconst", [], [u[:2]]))
+    else:
+        # delta: zigzag the signed wraparound deltas into the
+        # narrowest dtype that fits
+        signed = diffs.view(np.dtype(f"i{raw.dtype.itemsize}"))
+        neg = np.where(signed < 0, np.iinfo(u.dtype).max,
+                       0).astype(u.dtype)       # all-ones for negatives
+        zz = (diffs << np.uint8(1)) ^ neg
+        ndt = _narrowest(int(zz.max()))
+        if ndt is not None and ndt.itemsize < raw.dtype.itemsize:
+            zzn = zz.astype(ndt)
+            cands.append((raw.dtype.itemsize + zzn.nbytes, "delta",
+                          [str(ndt)], [u[:1], zzn]))
+        # rle: boundaries already known from diffs
+        bnd = np.nonzero(diffs)[0]
+        runs = len(bnd) + 1
+        rle_bytes = runs * (raw.dtype.itemsize + 4)
+        if rle_bytes < raw.nbytes:
+            starts = np.concatenate([[0], bnd + 1])
+            lens = np.diff(np.concatenate([starts, [n]])).astype(np.uint32)
+            cands.append((rle_bytes, "rle", [], [u[starts], lens]))
+        # dict: sample-guarded full unique
+        if len(np.unique(u[:_DICT_SAMPLE])) <= _DICT_SAMPLE_MAX:
+            uniq, codes = np.unique(u, return_inverse=True)
+            cdt = _narrowest(len(uniq) - 1)
+            if cdt is not None and cdt.itemsize < raw.dtype.itemsize:
+                size = uniq.nbytes + n * cdt.itemsize
+                if size < raw.nbytes:
+                    cands.append((size, "dict", [len(uniq), str(cdt)],
+                                  [uniq, codes.astype(cdt)]))
+    if not cands:
+        return raw_meta, [raw], "raw"
+    size, enc, extra, bufs = min(cands, key=lambda c: c[0])
+    if size >= raw.nbytes:            # encode ONLY if strictly smaller
+        return raw_meta, [raw], "raw"
+    bufs = [np.ascontiguousarray(b) for b in bufs]
+    meta = {"dtype": str(arr.dtype), "shape": list(arr.shape),
+            "enc": enc, "x": extra,
+            "parts": [b.nbytes for b in bufs]}
+    return meta, bufs, enc
+
+
+def decode_lane(meta: dict, fetch: Callable[[int], object]) -> np.ndarray:
+    """Rebuild a lane from its meta + the file stream. ``fetch(nbytes)``
+    returns the next raw byte region (bytes/memoryview; may be a
+    zero-copy view of the SST mapping for raw lanes)."""
+    dt = np.dtype(meta["dtype"])
+    shape = tuple(meta["shape"])
+    enc = meta.get("enc")
+    if enc is None:
+        raw = fetch(meta["len"])
+        return np.frombuffer(raw, dtype=dt).reshape(shape)
+    n = shape[0]
+    udt = np.dtype(_UVIEW[dt.itemsize])
+    parts = [np.frombuffer(fetch(nb), np.uint8) for nb in meta["parts"]]
+    if enc == "const":
+        u = np.broadcast_to(parts[0].view(udt), (n,))
+    elif enc == "dconst":
+        fs = parts[0].view(udt)
+        step = (fs[1:] - fs[:1])[0]              # wraparound-exact
+        u = fs[0] + step * np.arange(n, dtype=udt)
+    elif enc == "delta":
+        zz = parts[1].view(np.dtype(meta["x"][0])).astype(udt)
+        signed = ((zz >> np.uint8(1))
+                  ^ (-(zz & np.uint8(1)).astype(
+                      np.dtype(f"i{dt.itemsize}"))).view(udt))
+        u = np.cumsum(np.concatenate([parts[0].view(udt), signed]),
+                      dtype=udt)
+    elif enc == "rle":
+        vals = parts[0].view(udt)
+        lens = parts[1].view(np.uint32)
+        u = np.repeat(vals, lens.astype(np.int64))
+    elif enc == "dict":
+        k, cdt = meta["x"]
+        uniq = parts[0].view(udt)
+        codes = parts[1].view(np.dtype(cdt))
+        u = uniq[codes]
+    else:
+        raise ValueError(f"unknown lane encoding {enc!r}")
+    out = np.ascontiguousarray(u).view(dt).reshape(shape)
+    return out
+
+
+def tally(stats: Optional[dict], lane: str, pre: int, post: int,
+          enc: str) -> None:
+    """Accumulate per-lane encode accounting (profile_compact --json's
+    per-lane breakdown); no-op when the caller passed no stats dict."""
+    if stats is None:
+        return
+    lanes = stats.setdefault("lanes", {})
+    ent = lanes.setdefault(lane, {"pre_bytes": 0, "post_bytes": 0,
+                                  "encodings": {}})
+    ent["pre_bytes"] += pre
+    ent["post_bytes"] += post
+    ent["encodings"][enc] = ent["encodings"].get(enc, 0) + 1

@@ -75,11 +75,16 @@ class CompactionFeed:
 
 class LsmStore:
     def __init__(self, directory: str, name: str = "db",
-                 columnar_builder=None, row_decoder=None):
+                 columnar_builder=None, row_decoder=None,
+                 key_builder=None):
         self.dir = directory
         self.name = name
         self.columnar_builder = columnar_builder
         self.row_decoder = row_decoder
+        # v2 keyless-block support: rebuilds a block's key matrix from
+        # its pk + MVCC lanes (docdb codec callable); writers verify
+        # key drops against it, readers re-derive lazily through it
+        self.key_builder = key_builder
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.RLock()
         self._mem = MemTable()
@@ -107,7 +112,8 @@ class LsmStore:
         self._flushed_frontier = m.get("flushed_frontier", {})
         for fname in m["ssts"]:
             self._ssts.append(SstReader(os.path.join(self.dir, fname),
-                                        row_decoder=self.row_decoder))
+                                        row_decoder=self.row_decoder,
+                                        key_builder=self.key_builder))
 
     def _write_manifest(self) -> None:
         m = {
@@ -174,7 +180,8 @@ class LsmStore:
             self._struct_gen += 1
             self._mem_frontier = {}
         path = self._new_sst_path()
-        w = SstWriter(path, columnar_builder=self.columnar_builder)
+        w = SstWriter(path, columnar_builder=self.columnar_builder,
+                      key_builder=self.key_builder)
         for k, v in mem.iterate():
             w.add(k, v)
         w.set_frontier(**frontier)
@@ -190,7 +197,8 @@ class LsmStore:
                 except OSError:
                     pass
                 return None
-            self._ssts.insert(0, SstReader(path, row_decoder=self.row_decoder))
+            self._ssts.insert(0, SstReader(path, row_decoder=self.row_decoder,
+                                           key_builder=self.key_builder))
             self._frozen.remove(mem)
             self._struct_gen += 1
             if "op_id" in frontier:
@@ -240,7 +248,8 @@ class LsmStore:
         path = self._new_sst_path()
         w = SstWriter(path, columnar_builder=self.columnar_builder,
                       stream_columnar=stream,
-                      sync_every_bytes=(64 << 20) if stream else None)
+                      sync_every_bytes=(64 << 20) if stream else None,
+                      key_builder=self.key_builder)
         try:
             build(w)
         except BaseException:
@@ -250,7 +259,8 @@ class LsmStore:
             w.set_frontier(**frontier)
         w.finish()
         with self._lock:
-            self._ssts.insert(0, SstReader(path, row_decoder=self.row_decoder))
+            self._ssts.insert(0, SstReader(path, row_decoder=self.row_decoder,
+                                           key_builder=self.key_builder))
             self._struct_gen += 1
             self._write_manifest()
         return path
@@ -322,7 +332,8 @@ class LsmStore:
             return None
         feed = feed or CompactionFeed()
         path = self._new_sst_path()
-        w = SstWriter(path, columnar_builder=self.columnar_builder)
+        w = SstWriter(path, columnar_builder=self.columnar_builder,
+                      key_builder=self.key_builder)
         # merge newest-first sources; exact dup keys keep newest. The
         # stream goes through the feed in chunks (feed_block) so
         # vectorized feeds see whole sorted runs, not single rows.
@@ -365,7 +376,8 @@ class LsmStore:
                 except OSError:
                     pass
                 return
-            new_reader = SstReader(new_path, row_decoder=self.row_decoder)
+            new_reader = SstReader(new_path, row_decoder=self.row_decoder,
+                                   key_builder=self.key_builder)
             kept = [r for r in self._ssts if id(r) not in old_set]
             # output is older than anything not in the inputs → append last
             self._ssts = kept + [new_reader]

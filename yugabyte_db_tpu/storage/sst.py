@@ -26,8 +26,22 @@ import numpy as np
 
 from ..utils.hybrid_time import ENCODED_SIZE as _HT_ENC
 from . import native_lib
-from .columnar import (ColumnarBlock, fnv64_bytes, fnv64_keys,
-                       native_hot as _hot_mod)
+from .columnar import (SUPPORTED_FORMAT_VERSION, ColumnarBlock,
+                       fnv64_bytes, fnv64_keys, native_hot as _hot_mod)
+
+
+def resolve_format_version() -> int:
+    """THE writer-side gate for the on-disk block format: v2 only when
+    ``sst_format_version`` is exactly 2; anything else (including a
+    missing registry in odd test harnesses) writes the byte-identical
+    v1 format. Every SstWriter resolves through here, so no writer can
+    emit v2 while the flag says 1."""
+    from ..utils import flags as _flags
+    try:
+        v = int(_flags.get("sst_format_version"))
+    except Exception:   # noqa: BLE001 — default to the compatible format
+        return 1
+    return 2 if v == 2 else 1
 
 _HT_MARKER = 0x05          # dockv ValueType.kHybridTime
 _HT_SUFFIX = _HT_ENC + 1
@@ -196,10 +210,26 @@ class SstWriter:
     def __init__(self, path: str, block_rows: int = DEFAULT_BLOCK_ROWS,
                  columnar_builder: Optional[ColumnarBuilderFn] = None,
                  stream_columnar: bool = False,
-                 sync_every_bytes: Optional[int] = None):
+                 sync_every_bytes: Optional[int] = None,
+                 format_version: Optional[int] = None,
+                 key_builder=None):
         self.path = path
         self.block_rows = block_rows
         self.columnar_builder = columnar_builder
+        # on-disk block format: None resolves the sst_format_version
+        # flag ONCE at construction (a mid-write flag flip must not mix
+        # formats inside one file); explicit 1 pins the pre-v2 bytes
+        # (the baseline compaction path measures against it)
+        self._fmt = (resolve_format_version() if format_version is None
+                     else (2 if format_version == 2 else 1))
+        # v2 only: callable(cb) -> rebuilt keys matrix | None. When the
+        # rebuild byte-matches, the block serializes WITHOUT its keys
+        # matrix (readers re-derive lazily through the same callable).
+        self.key_builder = key_builder if self._fmt == 2 else None
+        #: per-lane encode accounting accumulated across this file's
+        #: blocks (profile_compact --json reads it off the compaction
+        #: stats; {"lanes": {lane: {pre_bytes, post_bytes, encodings}}})
+        self.lane_stats: dict = {}
         if stream_columnar:
             from ..utils import flags as _flags
             stream_columnar = not _flags.get("encrypt_data_at_rest")
@@ -251,14 +281,20 @@ class SstWriter:
         interleaving merge work with file writes). Only valid for
         columnar-only SSTs; falls back to buffering when encryption at
         rest is on (that path needs the whole image in memory)."""
-        if cb.keys is None or cb.n == 0:
-            raise ValueError("columnar-only blocks need a keys matrix")
+        if cb.n == 0:
+            raise ValueError("columnar-only blocks need rows")
+        # boundary keys come from the helpers, not cb.keys directly: a
+        # keyless v2 block (deserialized from another SST) indexes by
+        # its stored boundary keys without materializing the matrix
+        first = cb.first_full_key()
+        last = cb.last_full_key()
+        if first is None or last is None:
+            raise ValueError("columnar-only blocks need a keys matrix "
+                             "or derived key bounds")
         if self._entries:
             self._blocks.append(self._entries)
             self._col_only.append(None)
             self._entries = []
-        first = cb.keys[0].tobytes()
-        last = cb.keys[-1].tobytes()
         if self._last_key is not None and first < self._last_key:
             raise ValueError("keys must be added in sorted order")
         self._last_key = last
@@ -271,7 +307,8 @@ class SstWriter:
             e = BlockIndexEntry(
                 first_key=first, last_key=last, offset=0, length=0,
                 num_rows=cb.n, col_offset=self._sf.tell(), col_length=0)
-            head, bufs = cb.serialize_parts()
+            head, bufs = cb.serialize_parts(self._fmt, self.key_builder,
+                                            self.lane_stats)
             e.col_length = len(head)
             self._sf.write(head)
             for b in bufs:
@@ -321,6 +358,10 @@ class SstWriter:
             "index_offset": idx_off, "index_length": len(iraw),
             "frontier": self._frontier,
         }
+        if self._fmt != 1:
+            # v1 files stay byte-identical to the pre-v2 writer: the
+            # key only appears once the format actually moved
+            meta["format_version"] = self._fmt
         fraw = msgpack.packb(meta)
         f.write(fraw)
         f.write(struct.pack("<I", len(fraw)))
@@ -379,8 +420,8 @@ class SstWriter:
                 cb = self._col_only[bi]
                 if cb is not None:
                     index.append(BlockIndexEntry(
-                        first_key=cb.keys[0].tobytes(),
-                        last_key=cb.keys[-1].tobytes(),
+                        first_key=cb.first_full_key(),
+                        last_key=cb.last_full_key(),
                         offset=f.tell(), length=0, num_rows=cb.n))
                     self._num_entries += cb.n
                 else:
@@ -400,7 +441,8 @@ class SstWriter:
                 if cb is None and self.columnar_builder is not None and blk:
                     cb = self.columnar_builder(blk)
                 if cb is not None:
-                    head, bufs = cb.serialize_parts()
+                    head, bufs = cb.serialize_parts(
+                        self._fmt, self.key_builder, self.lane_stats)
                     index[i].col_offset = f.tell()
                     index[i].col_length = len(head)
                     f.write(head)
@@ -433,12 +475,17 @@ class SstWriter:
 
 
 class SstReader:
-    def __init__(self, path: str, row_decoder=None):
+    def __init__(self, path: str, row_decoder=None, key_builder=None):
         """row_decoder: callable(ColumnarBlock) -> List[(key, value)] —
         reconstructs KV entries for columnar-only blocks (provided by the
-        docdb layer, which owns the packed-row schema)."""
+        docdb layer, which owns the packed-row schema).
+        key_builder: callable(ColumnarBlock) -> keys matrix | None —
+        lazily rebuilds the full key matrix of v2 keyless blocks from
+        their pk + ht/write_id lanes (the same codec callable the writer
+        verified the drop against)."""
         self.path = path
         self.row_decoder = row_decoder
+        self.key_builder = key_builder
         # mmap instead of an eager read: compaction outputs are hundreds
         # of MB and pages fault in lazily as blocks are touched (the
         # reference's BlockBasedTable reads blocks on demand the same
@@ -461,6 +508,13 @@ class SstReader:
             raise ValueError(f"{path}: bad SST magic")
         (flen,) = struct.unpack_from("<I", d, len(d) - 12)
         meta = msgpack.unpackb(d[len(d) - 12 - flen:len(d) - 12])
+        self.format_version = meta.get("format_version", 1)
+        if self.format_version > SUPPORTED_FORMAT_VERSION:
+            raise ValueError(
+                f"{path}: SST format v{self.format_version} is newer "
+                f"than this reader supports "
+                f"(<= v{SUPPORTED_FORMAT_VERSION}); upgrade the reader "
+                "before opening this file")
         self.num_entries = meta["num_entries"]
         self.min_key: bytes = meta["min_key"] or b""
         self.max_key: bytes = meta["max_key"] or b""
@@ -665,6 +719,7 @@ class SstReader:
             return cached
         cb = ColumnarBlock.deserialize(
             self._data[e.col_offset:e.col_offset + e.col_length])
+        cb.bind_key_builder(self.key_builder)
         return self._cache_put(self._col_cache, i, cb, 32)
 
     def read_columnar(self, i: int) -> Optional[ColumnarBlock]:
@@ -680,9 +735,11 @@ class SstReader:
         e = self.index[i]
         if e.col_offset < 0:
             return None
-        return ColumnarBlock.deserialize(
+        cb = ColumnarBlock.deserialize(
             memoryview(self._data)[e.col_offset:e.col_offset
                                    + e.col_length], copy=False)
+        cb.bind_key_builder(self.key_builder)
+        return cb
 
     def columnar_blocks(self, lower: Optional[bytes] = None,
                         upper: Optional[bytes] = None

@@ -86,7 +86,8 @@ class Tablet:
             os.path.join(directory, "regular"), name="regular",
             columnar_builder=(None if colocated
                               else self.codec.columnar_builder),
-            row_decoder=(None if colocated else self.codec.row_decoder))
+            row_decoder=(None if colocated else self.codec.row_decoder),
+            key_builder=(None if colocated else self.codec.derive_keys))
         self.intents = LsmStore(
             os.path.join(directory, "intents"), name="intents")
         self._read_op = DocReadOperation(
@@ -137,8 +138,13 @@ class Tablet:
             if not self.colocated:
                 self.regular.columnar_builder = merged.columnar_builder
                 self.regular.row_decoder = merged.row_decoder
+                # key derivation depends only on the pk/partition shape,
+                # which ALTER cannot change — rebinding keeps the codec
+                # object current all the same
+                self.regular.key_builder = merged.derive_keys
                 for r in self.regular.ssts:
                     r.row_decoder = merged.row_decoder
+                    r.key_builder = merged.derive_keys
             from ..docdb.operations import DocReadOperation
             self._read_op = DocReadOperation(
                 merged, self.regular, device_cache=_DEVICE_CACHE)

@@ -218,6 +218,23 @@ DEFINE_RUNTIME("history_retention_interval_sec", 900,
 DEFINE_RUNTIME("encrypt_data_at_rest", False,
                "Encrypt SST files with the active universe key.")
 
+DEFINE_RUNTIME("sst_format_version", 2,
+               "On-disk columnar SST block format version (default 2). "
+               "2 = v2 blocks: keys matrix dropped when derivable from "
+               "pk+ht/write_id, per-lane delta/dict/RLE encodings "
+               "(encode only if smaller), per-block min/max zone maps. "
+               "1 = the pre-v2 format, byte-identical to the old "
+               "writer. Readers handle both versions side by side; "
+               "storage/sst.py resolve_format_version is the ONLY "
+               "writer gate, so no writer can emit v2 while this is 1.")
+DEFINE_RUNTIME("zone_map_pruning", True,
+               "Consult v2 per-block min/max zone maps in the scan "
+               "pushdown paths to skip whole blocks whose value ranges "
+               "cannot satisfy the WHERE predicate (gated on MVCC "
+               "chunk-safety so a pruned block can never hide a newer "
+               "row version). Off = every block reaches batch "
+               "formation, the pre-zone-map behavior.")
+
 # --- request scheduler (sched/) -------------------------------------------
 DEFINE_RUNTIME("scheduler_enabled", True,
                "Route tserver data-path RPCs through the admission-"
