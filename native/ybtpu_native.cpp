@@ -415,6 +415,54 @@ void fnv64_rows_fixed(const uint8_t* mat, int64_t n, int64_t w,
 }
 
 // --------------------------------------------------------------------------
+// Near-data predicate pre-filter: AND of per-column inclusive range
+// tests over ENCODED fixed-width lanes, evaluated next to the mmap'd
+// SST bytes before any batch formation (the bypass reader's near-data
+// processing move; reference inspiration: Taurus page-store pushdown).
+// Each predicate p tests  lo <= col[i] <= hi  with NULL rows failing;
+// `keep` is the conjunction across all predicates.  Loads go through
+// memcpy into a local: lanes can be unaligned views straight over the
+// file mapping, so typed pointer dereference would be UB (same
+// discipline as the gather loops above).
+// dtype codes (mirrored in storage/native_lib.py): 1=i32 2=i64 3=f32
+// 4=f64 5=u32.  Integer predicates use the i64 bounds, float ones the
+// f64 bounds.
+// --------------------------------------------------------------------------
+#define YB_PF_LOOP(T, LO, HI)                                           \
+    {                                                                   \
+        const uint8_t* base = (const uint8_t*)cols[p];                  \
+        for (int64_t i = 0; i < n; ++i) {                               \
+            T v;                                                        \
+            memcpy(&v, base + i * (int64_t)sizeof(T), sizeof(T));       \
+            keep[i] &= (uint8_t)((!nu || !nu[i]) &&                     \
+                                 v >= (LO) && v <= (HI));               \
+        }                                                               \
+    }                                                                   \
+    break;
+
+void prefilter_ranges(const void* const* cols, const int64_t* dtypes,
+                      const uint8_t* const* nulls,
+                      const double* lo_f, const double* hi_f,
+                      const int64_t* lo_i, const int64_t* hi_i,
+                      int64_t npreds, int64_t n, uint8_t* keep) {
+    for (int64_t i = 0; i < n; ++i) keep[i] = 1;
+    for (int64_t p = 0; p < npreds; ++p) {
+        const uint8_t* nu = nulls[p];
+        switch (dtypes[p]) {
+            case 1: YB_PF_LOOP(int32_t, lo_i[p], hi_i[p])
+            case 2: YB_PF_LOOP(int64_t, lo_i[p], hi_i[p])
+            case 3: YB_PF_LOOP(float, lo_f[p], hi_f[p])
+            case 4: YB_PF_LOOP(double, lo_f[p], hi_f[p])
+            case 5: YB_PF_LOOP(uint32_t, lo_i[p], hi_i[p])
+            default:
+                // unknown dtype: keep every row (the python binding
+                // never sends one, but a stale .so must fail safe)
+                break;
+        }
+    }
+}
+
+// --------------------------------------------------------------------------
 // Fixed-width k-way merge over NON-CONTIGUOUS sorted segments (the
 // pipelined compaction frontier: each segment is a row range of one
 // decoded — possibly mmap-backed — block, so no concatenated key matrix

@@ -1,0 +1,143 @@
+"""Profile the analytics bypass engine's stage split.
+
+`--json` prints ONE JSON object breaking a bypass Q6/Q1 scan into its
+stages — pin (flush + lease), block collection, prefilter, batch
+formation, kernel dispatch, combine — plus the keyless-scan counters
+(key_rebuilds MUST stay 0), the prefilter selectivity split, and a
+prefilter ON/OFF and chunk-size sweep so the near-data filter's win
+and the chunk plan are tunable from data.
+
+Env knobs: PROFILE_SF (default 0.1), PROFILE_ROUNDS (default 3),
+PROFILE_CHUNK_SWEEP (comma list of chunk_rows; default
+"262144,1048576").
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("YBTPU_PLATFORM", "cpu")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def profile_json() -> dict:
+    import numpy as np
+
+    from yugabyte_db_tpu.bypass import BypassSession, pin_tablet
+    from yugabyte_db_tpu.bypass.prefilter import LAST_PREFILTER_STATS
+    from yugabyte_db_tpu.bypass.scan import (collect_keyless_blocks,
+                                             open_snapshot_readers)
+    from yugabyte_db_tpu.models.tpch import (TPCH_Q1, TPCH_Q6,
+                                             generate_lineitem,
+                                             lineitem_range_info,
+                                             numpy_reference)
+    from yugabyte_db_tpu.ops.stream_scan import LAST_STREAM_STATS
+    from yugabyte_db_tpu.storage import native_lib
+    from yugabyte_db_tpu.storage.columnar import KEY_REBUILD_STATS
+    from yugabyte_db_tpu.tablet import Tablet
+
+    sf = float(os.environ.get("PROFILE_SF", "0.1"))
+    rounds = int(os.environ.get("PROFILE_ROUNDS", "3"))
+    sweep = [int(x) for x in os.environ.get(
+        "PROFILE_CHUNK_SWEEP", "262144,1048576").split(",") if x]
+
+    data = generate_lineitem(sf)
+    n = len(data["rowid"])
+    t = Tablet("li-prof", lineitem_range_info(),
+               tempfile.mkdtemp(prefix="bypass-prof-"))
+    t.bulk_load(data, block_rows=65536)
+
+    # stage split measured once, un-warmed (the cold path IS the
+    # product: a session is one-shot by design)
+    t0 = time.perf_counter()
+    snap = pin_tablet(t)
+    pin_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    readers = open_snapshot_readers(snap)
+    blocks, bstats = collect_keyless_blocks(readers)
+    collect_s = time.perf_counter() - t0
+    snap.close()
+
+    out = {
+        "rows": n, "sf": sf,
+        "native_prefilter": native_lib.available(),
+        "pin_s": round(pin_s, 4),
+        "collect_blocks_s": round(collect_s, 4),
+        "blocks": bstats["blocks"],
+        "keyless_blocks": bstats["keyless_blocks"],
+        "queries": {},
+    }
+
+    for q, name in ((TPCH_Q6, "q6"), (TPCH_Q1, "q1")):
+        ref = numpy_reference(q, data)
+        modes = {}
+        for tag, pf in (("prefilter_on", True), ("prefilter_off", False)):
+            r0 = KEY_REBUILD_STATS["rebuilds"]
+            with BypassSession([t], prefilter=pf) as s:
+                best = None
+                for _ in range(rounds):
+                    t0 = time.perf_counter()
+                    outs, counts, stats = s.scan_aggregate(
+                        q.where, q.aggs, q.group)
+                    wall = time.perf_counter() - t0
+                    if best is None or wall < best[0]:
+                        best = (wall, stats, dict(LAST_STREAM_STATS))
+            wall, stats, stream = best
+            if name == "q6":
+                rel = abs(float(outs[0]) - ref) / max(abs(ref), 1e-9)
+                assert rel < 1e-5, f"q6 mismatch {rel}"
+            modes[tag] = {
+                "wall_s": round(wall, 4),
+                "rows_per_s": round(n / wall, 1),
+                "path": stats.get("paths"),
+                "key_rebuilds": KEY_REBUILD_STATS["rebuilds"] - r0,
+                "build_s": stream.get("build_s"),
+                "kernel_s": stream.get("kernel_s"),
+                "consumer_wait_s": stream.get("consumer_wait_s"),
+                "zone_blocks_pruned": stream.get("zone_blocks_pruned"),
+                "prefilter_rows_in": stats.get("prefilter_rows_in", 0),
+                "prefilter_rows_kept": stats.get("prefilter_rows_kept",
+                                                 0),
+                "prefilter_blocks_compacted":
+                    LAST_PREFILTER_STATS["blocks_compacted"] if pf
+                    else 0,
+            }
+        pin = modes["prefilter_on"]
+        off = modes["prefilter_off"]
+        modes["prefilter_speedup"] = round(
+            off["wall_s"] / max(pin["wall_s"], 1e-9), 3)
+        out["queries"][name] = modes
+
+    chunk_sweep = {}
+    for cr in sweep:
+        with BypassSession([t], chunk_rows=cr, min_chunks=1) as s:
+            s.scan_aggregate(TPCH_Q6.where, TPCH_Q6.aggs, None)  # warm
+            best = None
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                s.scan_aggregate(TPCH_Q6.where, TPCH_Q6.aggs, None)
+                wall = time.perf_counter() - t0
+                best = wall if best is None else min(best, wall)
+        chunk_sweep[str(cr)] = {
+            "wall_s": round(best, 4),
+            "rows_per_s": round(n / best, 1),
+            "chunks": LAST_STREAM_STATS.get("chunks"),
+            "bucket_rows": LAST_STREAM_STATS.get("bucket_rows"),
+        }
+    out["q6_chunk_sweep"] = chunk_sweep
+    out["gather_stats"] = dict(native_lib.GATHER_STATS)
+    out["prefilter_calls"] = dict(native_lib.PREFILTER_STATS)
+    return out
+
+
+def main():
+    if "--json" in sys.argv:
+        print(json.dumps(profile_json()))
+        return
+    print("usage: profile_bypass.py --json", file=sys.stderr)
+    sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()

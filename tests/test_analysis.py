@@ -500,6 +500,62 @@ class TestFormatGate:
         assert r["suppressions"]["format_gate"] == 1
 
 
+class TestLayering:
+    """bypass/ must not import tserver/sched/rpc — the subsystem's
+    isolation guarantee as a tier-1 fact."""
+
+    def _run_scoped(self, tmp_path, files):
+        import textwrap as _tw
+        for rel, src in files.items():
+            p = tmp_path / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(_tw.dedent(src))
+        index = ProjectIndex(str(tmp_path),
+                             roots=("yugabyte_db_tpu",))
+        return run_analysis(index, [get_pass("layering")])
+
+    def test_true_positives(self, tmp_path):
+        r = self._run_scoped(tmp_path, {
+            "yugabyte_db_tpu/bypass/bad.py": """\
+                import yugabyte_db_tpu.tserver.tablet_server
+                from yugabyte_db_tpu.rpc import messenger
+                from ..sched.lanes import Lane
+                from .. import rpc
+                def f():
+                    from ..tserver import tablet_server
+                    return tablet_server
+                """})
+        layers = sorted(d.split(":")[0] for _, _, d in _findings(r))
+        assert layers == ["rpc", "rpc", "sched", "tserver", "tserver"]
+
+    def test_suppressed_with_reason(self, tmp_path):
+        r = self._run_scoped(tmp_path, {
+            "yugabyte_db_tpu/bypass/bad.py": """\
+                from ..rpc import messenger  # analysis-ok(layering): fixture
+                """})
+        assert r["findings"] == []
+        assert r["suppressions"]["layering"] == 1
+
+    def test_clean_negatives(self, tmp_path):
+        """Allowed seams (storage/ops/parallel/docdb), sibling-package
+        imports of the same names, and other layers importing tserver
+        must not fire."""
+        r = self._run_scoped(tmp_path, {
+            "yugabyte_db_tpu/bypass/ok.py": """\
+                from ..storage.lsm import LsmStore
+                from ..ops import stream_scan
+                from ..parallel.distributed_scan import ShardedBatch
+                from ..docdb.operations import ReadResponse
+                from .errors import BypassIneligible
+                import numpy.rpc_like as rpcx    # not our layer
+                """,
+            "yugabyte_db_tpu/client/uses_rpc.py": """\
+                from ..rpc.messenger import Messenger
+                from ..tserver import tablet_server
+                """})
+        assert _findings(r) == []
+
+
 # --- 2 + 3. whole tree, schema, budget, baseline ---------------------------
 
 @pytest.fixture(scope="module")
@@ -521,7 +577,7 @@ def test_all_passes_ran(tree_report):
     assert [p["id"] for p in tree_report["passes"]] == [
         "async_blocking", "lock_held_await", "jit_hazards",
         "flag_drift", "shared_state_races", "unawaited_coroutine",
-        "format_gate"]
+        "format_gate", "layering"]
 
 
 def test_wall_time_budget(tree_report):

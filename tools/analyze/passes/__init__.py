@@ -9,7 +9,8 @@ Adding a pass (see ANALYSIS.md):
    finds — the whole-tree tier-1 sweep must stay at zero.
 """
 from . import (async_blocking, flag_drift, format_gate, jit_hazards,
-               lock_held_await, shared_state_races, unawaited_coroutine)
+               layering, lock_held_await, shared_state_races,
+               unawaited_coroutine)
 
 ALL_PASSES = (
     async_blocking.PASS,
@@ -19,6 +20,7 @@ ALL_PASSES = (
     shared_state_races.PASS,
     unawaited_coroutine.PASS,
     format_gate.PASS,
+    layering.PASS,
 )
 
 _BY_ID = {p.id: p for p in ALL_PASSES}

@@ -1,0 +1,120 @@
+"""Pass: bypass-subsystem layering — ``bypass/`` must not import the
+hot path it exists to avoid.
+
+The analytics bypass engine's whole value proposition is STRUCTURAL
+isolation from the tserver data path: pins come from the storage
+layer, SST files open directly, kernels dispatch in the caller.  The
+moment a ``yugabyte_db_tpu/bypass/`` module imports ``tserver``,
+``sched`` or ``rpc`` — at module level or inside any function — that
+guarantee is one refactor away from quietly becoming "bypass calls the
+scheduler"; this pass makes the dependency direction a tier-1 fact
+rather than a comment.
+
+Detected shapes (absolute and relative spellings):
+
+1. ``import yugabyte_db_tpu.tserver...`` / ``from yugabyte_db_tpu.rpc
+   import ...`` anywhere in a bypass module.
+2. ``from ..tserver import ...`` / ``from .. import sched`` — relative
+   imports resolved against the module's package path.
+
+Known lexical limits (same spirit as the other passes): only DIRECT
+imports are checked — a transitive edge through an allowed layer
+(e.g. docdb) is the imported layer's responsibility, and dynamic
+``importlib`` indirection is invisible.  The forbidden set is a pass
+constant so a future subsystem with its own layering rule can extend
+the table rather than fork the pass.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..core import AnalysisPass, Finding, ProjectIndex
+
+#: (scoped directory prefix -> module names its files must not import)
+LAYER_RULES = {
+    "yugabyte_db_tpu/bypass/": ("tserver", "sched", "rpc"),
+}
+
+_PKG_ROOT = "yugabyte_db_tpu"
+
+
+def _module_package(rel: str) -> List[str]:
+    """Dotted package path of a repo-relative module file (the package
+    containing it), e.g. yugabyte_db_tpu/bypass/scan.py ->
+    ['yugabyte_db_tpu', 'bypass']."""
+    parts = rel.replace("\\", "/").split("/")
+    return parts[:-1]
+
+
+def _resolve_relative(pkg: List[str], level: int, module: str) -> str:
+    """Absolute dotted target of a level-N relative import from pkg."""
+    base = pkg[:len(pkg) - (level - 1)] if level > 1 else list(pkg)
+    return ".".join(base + ([module] if module else []))
+
+
+class LayeringPass(AnalysisPass):
+    id = "layering"
+    title = "subsystem layering violations"
+    hint = ("the bypass engine must stay independent of the tserver "
+            "hot path: take data through storage/ops/parallel seams, "
+            "or move the coupling into the client layer")
+
+    def _check_target(self, rel: str, forbidden, target: str):
+        """First forbidden layer named by dotted import target, if
+        any (targets are absolute, e.g. yugabyte_db_tpu.rpc.messenger
+        or a bare top-level name)."""
+        parts = target.split(".")
+        if parts and parts[0] == _PKG_ROOT:
+            parts = parts[1:]
+        return parts[0] if parts and parts[0] in forbidden else None
+
+    def run(self, index: ProjectIndex) -> List[Finding]:
+        out: List[Finding] = []
+        for mi in index.modules():
+            rel = mi.rel.replace("\\", "/")
+            rules = [layers for prefix, layers in LAYER_RULES.items()
+                     if rel.startswith(prefix)]
+            if not rules or mi.tree is None:
+                continue
+            forbidden = tuple(ly for layers in rules for ly in layers)
+            pkg = _module_package(rel)
+            for node in ast.walk(mi.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        hit = self._check_target(rel, forbidden, a.name)
+                        if hit:
+                            out.append(self._finding(mi, node, hit,
+                                                     a.name))
+                elif isinstance(node, ast.ImportFrom):
+                    if node.level:
+                        target = _resolve_relative(
+                            pkg, node.level, node.module or "")
+                    else:
+                        target = node.module or ""
+                    hit = self._check_target(rel, forbidden, target)
+                    if hit is None:
+                        # `from .. import rpc` / `from yugabyte_db_tpu
+                        # import tserver` — the layer arrives as the
+                        # imported NAME, not the module path
+                        for a in node.names:
+                            h2 = self._check_target(
+                                rel, forbidden, f"{target}.{a.name}")
+                            if h2:
+                                hit = h2
+                                target = f"{target}.{a.name}"
+                                break
+                    if hit:
+                        out.append(self._finding(mi, node, hit, target))
+        return out
+
+    def _finding(self, mi, node, layer: str, target: str) -> Finding:
+        return self.finding(
+            mi, node.lineno,
+            f"bypass module imports the `{layer}` layer "
+            f"({target}) — the subsystem's isolation guarantee "
+            "forbids this dependency",
+            detail=f"{layer}:{target}")
+
+
+PASS = LayeringPass()

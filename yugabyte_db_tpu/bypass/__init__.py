@@ -1,0 +1,38 @@
+"""Analytics bypass reader: snapshot-consistent SST-direct scans that
+never touch the tserver hot path.
+
+The subsystem in one breath: :func:`pin_tablet` freezes a read point
+and leases the SST file set against file GC (storage/lsm.py refcount
+lease); :mod:`bypass.scan` opens the leased files directly and streams
+their v2 columnar blocks — keyless, gated on stored k0/k1 boundary
+keys — through the shared pow2-bucket kernel pipeline with a near-data
+predicate pre-filter (:mod:`bypass.prefilter`, GIL-released native
+range pass) compacting rows before batch formation; and
+:class:`BypassSession` fans that out across tablet shards, combining
+partials host-side (byte-identical to the RPC fan-out) or over a
+device mesh (parallel/distributed_scan.py psum).
+
+Layering is the point: this package must not import ``tserver``,
+``sched`` or ``rpc`` — enforced by the tools/analyze ``layering``
+pass.  Ineligible shapes raise :class:`BypassIneligible` with a typed
+reason and callers fall back to the RPC path, which serves everything.
+"""
+from .errors import (ALL_REASONS, REASON_COLUMN_NOT_FIXED,
+                     REASON_EXPR_SHAPE, REASON_FLAG_OFF,
+                     REASON_HASH_GROUP, REASON_MEMTABLE_ACTIVE,
+                     REASON_NO_COLUMNAR, REASON_NO_SSTS,
+                     REASON_NOT_AGGREGATE, REASON_NOT_CHUNK_SAFE,
+                     BypassIneligible)
+from .pinner import TabletSnapshot, pin_tablet
+from .scan import (bypass_scan_aggregate, collect_keyless_blocks,
+                   open_snapshot_readers)
+from .session import BypassSession, combine_partials
+
+__all__ = [
+    "ALL_REASONS", "BypassIneligible", "BypassSession",
+    "REASON_COLUMN_NOT_FIXED", "REASON_EXPR_SHAPE", "REASON_FLAG_OFF",
+    "REASON_HASH_GROUP", "REASON_MEMTABLE_ACTIVE", "REASON_NO_COLUMNAR",
+    "REASON_NO_SSTS", "REASON_NOT_AGGREGATE", "REASON_NOT_CHUNK_SAFE",
+    "TabletSnapshot", "bypass_scan_aggregate", "collect_keyless_blocks",
+    "combine_partials", "open_snapshot_readers", "pin_tablet",
+]

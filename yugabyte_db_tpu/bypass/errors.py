@@ -1,0 +1,52 @@
+"""Typed bypass-ineligibility: every reason a tablet falls back to the
+RPC scan path is a named constant, carried on the exception and counted
+per session, so callers (and tests) can assert WHY a scan refused to
+bypass instead of pattern-matching error strings.
+
+The contract mirrors the streaming-scan fallbacks (ops/stream_scan.py):
+a bypass refusal is never an error to the user — the client routes the
+query back through the ordinary RPC path, which serves every shape.
+"""
+from __future__ import annotations
+
+#: master switch off (client-level routing refusal)
+REASON_FLAG_OFF = "flag_off"
+#: memtable (active or frozen) still holds rows after the flush
+#: attempts — rows at or below the read point may not be on disk yet
+REASON_MEMTABLE_ACTIVE = "memtable_active"
+#: the tablet has no SST files (nothing to scan directly; the RPC path
+#: answers from the memtable)
+REASON_NO_SSTS = "no_ssts"
+#: an SST block lacks a columnar sidecar (row-format-only data)
+REASON_NO_COLUMNAR = "no_columnar_block"
+#: block sequence is not provably one disjoint sorted unique-key run
+#: (overlapping SSTs, duplicate doc keys, or missing boundary keys)
+REASON_NOT_CHUNK_SAFE = "not_chunk_safe"
+#: a referenced column exists only in varlen/dictionary form — the
+#: keyless scanner serves fixed-width lanes only
+REASON_COLUMN_NOT_FIXED = "column_not_fixed"
+#: hash-grouped aggregates don't combine densely across shards
+REASON_HASH_GROUP = "hash_group"
+#: the expression shape can't compile to the device kernel
+REASON_EXPR_SHAPE = "expr_shape"
+#: no aggregates in the request (the bypass engine serves
+#: scan-and-aggregate shapes only, not row streams)
+REASON_NOT_AGGREGATE = "not_aggregate"
+
+ALL_REASONS = (
+    REASON_FLAG_OFF, REASON_MEMTABLE_ACTIVE, REASON_NO_SSTS,
+    REASON_NO_COLUMNAR, REASON_NOT_CHUNK_SAFE, REASON_COLUMN_NOT_FIXED,
+    REASON_HASH_GROUP, REASON_EXPR_SHAPE, REASON_NOT_AGGREGATE,
+)
+
+
+class BypassIneligible(Exception):
+    """This tablet/query cannot be served by the bypass reader; the
+    caller falls back to the RPC path.  `reason` is one of the
+    REASON_* constants; `detail` is free-form context for logs."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        self.reason = reason
+        self.detail = detail
+        super().__init__(f"bypass ineligible: {reason}"
+                         + (f" ({detail})" if detail else ""))

@@ -1,0 +1,130 @@
+"""Snapshot pinner: a frozen, snapshot-consistent read point plus a
+leased SST file list that survives concurrent compaction and flush.
+
+The invariant a pinned snapshot guarantees: every row version with
+``ht <= read_ht`` lives in the pinned SST files.  It holds because
+
+  1. ``read_ht`` is taken from the tablet clock FIRST (and the clock is
+     ratcheted past an externally supplied read point), so every write
+     applied after the pin gets a strictly larger hybrid time — such
+     rows may land in pinned SSTs (a racing flush) but MVCC filtering
+     at ``read_ht`` makes them invisible, never wrong;
+  2. the memtable is flushed until empty, and the pin itself
+     (``LsmStore.pin_ssts(require_empty_memtable=True)``) re-verifies
+     emptiness under the same lock that installs flush output — so no
+     row at or below the read point can still be memory-only when the
+     file list is captured;
+  3. the lease refcounts the files against the store's GC: compaction
+     replaces the live set but the physical unlink of pinned inputs is
+     deferred until release (storage/lsm.py), and a crashed leaseholder
+     leaves only unmanifested files the next open sweeps.
+
+This is what turns "analytics must not queue behind point traffic"
+from a scheduling policy into a structural guarantee: after ``pin``,
+the scan never talks to the tserver again.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..storage.lsm import SstLease
+from ..utils.hybrid_time import HybridTime
+from .errors import REASON_MEMTABLE_ACTIVE, REASON_NO_SSTS, BypassIneligible
+
+
+@dataclass
+class TabletSnapshot:
+    """One tablet's frozen read point + leased SST file set.  The codec
+    rides along for schema access (column ids/dtypes); the SST files
+    themselves are opened by the scanner, NOT through the store."""
+
+    tablet_id: str
+    read_ht: int
+    sst_paths: List[str]
+    lease: SstLease
+    codec: object                      # docdb TableCodec
+    stats: dict = field(default_factory=dict)
+
+    def close(self) -> None:
+        self.lease.release()
+
+    @property
+    def closed(self) -> bool:
+        return self.lease.released
+
+
+def pin_tablet(tablet, read_ht: Optional[int] = None,
+               table_id: Optional[str] = None,
+               max_flush_attempts: int = 4,
+               allow_empty: bool = False,
+               safe_time_fn=None, safe_wait_s: float = 10.0
+               ) -> TabletSnapshot:
+    """Pin `tablet` at a frozen read point.  Raises BypassIneligible
+    (memtable_active) when rows at/below the read point cannot be
+    proven on-disk after ``max_flush_attempts`` flushes, or (no_ssts)
+    when the tablet has no SST files at all (unless ``allow_empty``).
+
+    ``safe_time_fn``: callable(now_value) -> MVCC safe read HT (a
+    TabletPeer's ``safe_read_ht``).  REQUIRED for correctness when the
+    tablet serves a consensus pipeline: a write is ASSIGNED its hybrid
+    time at enqueue (TabletPeer.write), so a row with ht <= read_ht
+    can sit in the raft queue — invisible to the memtable — while we
+    pin.  Polling until safe time passes the read point closes that
+    window exactly like the RPC read path's wait; later writes are
+    then assigned ht > read_ht by clock monotonicity.  Direct-apply
+    tablets (bulk load / apply_write callers, no queue) need no
+    safe_time_fn — their writes hit the memtable synchronously."""
+    if read_ht is None:
+        read_ht = tablet.clock.now().value
+    else:
+        # ratchet: writes applied after this line can never be assigned
+        # a hybrid time at or below the externally chosen read point
+        tablet.clock.update(HybridTime(read_ht))
+    if safe_time_fn is not None:
+        deadline = time.monotonic() + safe_wait_s
+        # FIRST call unguarded: a mis-wired safe_time_fn (wrong arity,
+        # wrong object) must surface as its real error, not burn the
+        # whole wait and masquerade as memtable_active
+        if safe_time_fn(tablet.clock.now().value) < read_ht:
+            while True:
+                try:
+                    if safe_time_fn(tablet.clock.now().value) >= read_ht:
+                        break
+                except Exception:   # noqa: BLE001 — transient cross-
+                    pass            # thread misread of in-flight
+                    #                 state: re-poll
+                if time.monotonic() > deadline:
+                    raise BypassIneligible(
+                        REASON_MEMTABLE_ACTIVE,
+                        f"tablet {tablet.tablet_id}: in-flight writes "
+                        "below the read point did not drain")
+                time.sleep(0.002)
+    store = tablet.regular
+    lease = None
+    for attempt in range(max_flush_attempts):
+        if attempt:
+            # another thread's flush is mid-install (frozen memtable
+            # drained off-lock); yield rather than spin
+            time.sleep(0.005 * attempt)
+        if not store.memtable_empty():
+            tablet.flush()
+        lease = store.pin_ssts(require_empty_memtable=True)
+        if lease is not None:
+            break
+    if lease is None:
+        raise BypassIneligible(
+            REASON_MEMTABLE_ACTIVE,
+            f"tablet {tablet.tablet_id}: memtable still holds rows "
+            f"after {max_flush_attempts} flush attempts")
+    if not lease.paths and not allow_empty:
+        lease.release()
+        raise BypassIneligible(
+            REASON_NO_SSTS, f"tablet {tablet.tablet_id} has no SSTs")
+    codec = tablet._codec_for(table_id) if table_id else tablet.codec
+    return TabletSnapshot(
+        tablet_id=tablet.tablet_id, read_ht=read_ht,
+        sst_paths=list(lease.paths), lease=lease, codec=codec,
+        stats={"flush_attempts": attempt + 1,
+               "pinned_files": len(lease.paths)})

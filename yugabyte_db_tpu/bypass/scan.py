@@ -1,0 +1,186 @@
+"""Keyless v2 SST-direct scan engine.
+
+Opens a pinned snapshot's SST files directly (fresh readers over the
+leased paths — never the store's own reader list, and with NO
+key_builder bound, so a key-matrix rebuild is structurally impossible:
+there is no thunk to fire) and streams their columnar blocks through
+the shared pow2-bucket chunk pipeline (ops/stream_scan.py).  The v2
+format's promise finally cashes out here: eligibility, zone-map
+pruning, chunk-safety and SST-run ordering all read only the stored
+boundary keys (k0/k1), so an all-v2 tablet scans end-to-end with ZERO
+key-matrix rebuilds (``KEY_REBUILD_STATS`` asserts it in tests).
+
+Eligibility is typed (errors.py): anything the engine cannot serve
+exactly — hash groups, varlen-only columns, non-chunk-safe block
+sequences, kernel-incompatible expressions — raises BypassIneligible
+and the caller falls back to the RPC path.  What IS served is
+byte-identical to the RPC scan path at the same read point: the same
+zone-prune gate, the same chunk plan and shared bucket, the same
+kernel and combine rules, and the same monolithic twin under
+``min_chunks`` (the near-data prefilter preserves this bit-for-bit —
+see bypass/prefilter.py).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.device_batch import bucket_rows, build_batch
+from ..ops.scan import AggSpec, HashGroupSpec, ScanKernel, _expand_avg
+from ..ops.stream_scan import (LAST_STREAM_STATS, chunk_safe_mvcc,
+                               streaming_scan_aggregate)
+from ..storage.columnar import KEY_REBUILD_STATS, ColumnarBlock
+from ..storage.sst import SstReader
+from ..utils import flags
+from .errors import (REASON_COLUMN_NOT_FIXED, REASON_EXPR_SHAPE,
+                     REASON_HASH_GROUP, REASON_NO_COLUMNAR,
+                     REASON_NOT_AGGREGATE, REASON_NOT_CHUNK_SAFE,
+                     BypassIneligible)
+from .prefilter import make_prefilter
+
+
+def open_snapshot_readers(snap) -> List[SstReader]:
+    """Fresh SstReaders over a snapshot's leased paths.  No row_decoder
+    and — deliberately — no key_builder: the keyless scanner has no
+    lazy-rebuild path to fall into."""
+    return [SstReader(p, row_decoder=None, key_builder=None)
+            for p in snap.sst_paths]
+
+
+def collect_keyless_blocks(readers: Sequence[SstReader]
+                           ) -> Tuple[List[ColumnarBlock], dict]:
+    """All columnar blocks of the snapshot, as ONE candidate sorted
+    run: per-SST block runs are ordered by their first stored boundary
+    key (newest-first install order is irrelevant for a disjoint set;
+    interleaved/overlapping runs are caught by the chunk-safety check
+    downstream, which this ordering deliberately feeds)."""
+    runs: List[List[ColumnarBlock]] = []
+    keyless = 0
+    total = 0
+    for r in readers:
+        run: List[ColumnarBlock] = []
+        for i in range(r.num_blocks()):
+            cb = r.read_columnar(i)
+            if cb is None:
+                raise BypassIneligible(
+                    REASON_NO_COLUMNAR,
+                    f"{r.path}: block {i} has no columnar sidecar")
+            total += 1
+            if cb._keys is None:
+                keyless += 1
+            run.append(cb)
+        if run:
+            runs.append(run)
+
+    def run_key(run: List[ColumnarBlock]) -> bytes:
+        k0, _ = run[0].boundary_keys(materialize=False)
+        return k0 if k0 is not None else b""
+
+    runs.sort(key=run_key)
+    blocks = [b for run in runs for b in run]
+    return blocks, {"blocks": total, "keyless_blocks": keyless,
+                    "ssts": len(readers)}
+
+
+def bypass_scan_aggregate(
+        blocks: Sequence[ColumnarBlock],
+        where: Optional[tuple], aggs: Sequence[AggSpec],
+        group, read_ht: int,
+        kernel: Optional[ScanKernel] = None,
+        chunk_rows: Optional[int] = None,
+        prefilter_enabled: Optional[bool] = None,
+        min_chunks: int = 3) -> Tuple[tuple, np.ndarray, dict]:
+    """Aggregate `blocks` at `read_ht` without touching the tserver.
+    Returns (agg_values, counts, stats); raises BypassIneligible with a
+    typed reason for every shape the engine cannot serve exactly."""
+    if not aggs:
+        raise BypassIneligible(REASON_NOT_AGGREGATE)
+    if isinstance(group, HashGroupSpec):
+        raise BypassIneligible(REASON_HASH_GROUP)
+    from ..ops.expr import device_compatible, referenced_columns
+    if where is not None and not device_compatible(where):
+        raise BypassIneligible(REASON_EXPR_SHAPE, "where")
+    for a in aggs:
+        if a.expr is not None and not device_compatible(a.expr):
+            raise BypassIneligible(REASON_EXPR_SHAPE, "aggregate expr")
+    needed: set = set()
+    if where is not None:
+        referenced_columns(where, needed)
+    for a in aggs:
+        if a.expr is not None:
+            referenced_columns(a.expr, needed)
+    if group is not None:
+        needed.update(cid for cid, _, _ in group.cols)
+    for b in blocks:
+        for cid in needed:
+            if not (cid in b.fixed or cid in b.pk):
+                raise BypassIneligible(
+                    REASON_COLUMN_NOT_FIXED, f"column {cid}")
+    # the ONE structural gate: every doc key lives wholly inside one
+    # block of one globally-sorted disjoint unique-key run, proven from
+    # stored boundary keys alone
+    if not chunk_safe_mvcc(blocks):
+        raise BypassIneligible(REASON_NOT_CHUNK_SAFE)
+    if prefilter_enabled is None:
+        prefilter_enabled = flags.get("bypass_prefilter_enabled")
+    if kernel is None:
+        from ..docdb.operations import _SHARED_KERNEL
+        kernel = _SHARED_KERNEL
+    rebuilds0 = KEY_REBUILD_STATS["rebuilds"]
+    cols_sorted = sorted(needed)
+    expanded = tuple(_expand_avg(aggs))
+    minmax = [i for i, a in enumerate(expanded)
+              if a.op in ("min", "max")]
+    aggs_run = expanded + tuple(AggSpec("count", expanded[i].expr)
+                                for i in minmax)
+    pf = (make_prefilter(where, cols_sorted)
+          if prefilter_enabled else None)
+    stats: dict = {}
+    got = streaming_scan_aggregate(
+        blocks, cols_sorted, where, aggs_run, group, read_ht,
+        kernel=kernel, chunk_rows=chunk_rows, prefilter=pf,
+        min_chunks=min_chunks)
+    if got is None:
+        got = _monolithic_twin(blocks, cols_sorted, where, aggs_run,
+                               group, read_ht, kernel, pf)
+        stats["path"] = "monolithic"
+    else:
+        stats["path"] = "streaming"
+        stats.update(LAST_STREAM_STATS)
+    outs, counts = got
+    from ..docdb.operations import _nullify_minmax
+    outs = _nullify_minmax(expanded, minmax, outs)
+    stats["key_rebuilds"] = KEY_REBUILD_STATS["rebuilds"] - rebuilds0
+    if pf is not None:
+        from .prefilter import LAST_PREFILTER_STATS
+        stats.setdefault("prefilter_rows_in",
+                         LAST_PREFILTER_STATS["rows_in"])
+        stats.setdefault("prefilter_rows_kept",
+                         LAST_PREFILTER_STATS["rows_kept"])
+    return outs, np.asarray(counts), stats
+
+
+def _monolithic_twin(blocks, cols_sorted, where, aggs_run, group,
+                     read_ht, kernel, pf):
+    """The under-min_chunks shape, mirroring the RPC monolithic
+    aggregate path bit-for-bit (zone-prune gate, single bucket over the
+    kept rows, unique_keys forced off for multi-block inputs) so bypass
+    results stay byte-identical whichever shape the row count picks."""
+    from ..ops.scan import zone_prune_blocks
+    kept = list(blocks)
+    if where is not None and flags.get("zone_map_pruning"):
+        # bypass blocks are always chunk-safe (the caller verified), so
+        # pruning is unconditionally sound here
+        kept, _ = zone_prune_blocks(kept, where)
+    if pf is not None:
+        batch = build_batch(
+            pf(kept), cols_sorted,
+            pad_to=bucket_rows(max(sum(b.n for b in kept), 1)),
+            bounds_blocks=kept)
+    else:
+        batch = build_batch(kept, cols_sorted)
+    if len(blocks) > 1:
+        batch.unique_keys = False
+    outs, counts, _ = kernel.run(batch, where, aggs_run, group, read_ht)
+    return outs, counts

@@ -1,0 +1,257 @@
+"""BypassSession: the user-facing face of the analytics bypass engine.
+
+One session = one frozen read point over a set of tablet shards, pinned
+against compaction/flush for the session's lifetime.  TPC-H Q1/Q6
+shaped aggregates run through :func:`bypass_scan_aggregate` per shard
+and combine across shards either host-side (the client-side partial
+combine, byte-identical to the RPC fan-out's) or — when a device mesh
+is available — via the psum/pmin/pmax collectives of
+parallel/distributed_scan.py (the ICI combine the ROADMAP's
+"scales with replicas" story points at).
+
+The session NEVER touches the tserver: pins come from the storage
+layer, files are opened directly, kernels dispatch from the calling
+thread.  That is the structural isolation guarantee — analytics load
+cannot queue behind (or ahead of) point traffic on the event loop.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops.scan import AggSpec, _expand_avg, combine_agg_partials
+from .errors import REASON_NO_SSTS, BypassIneligible
+from .pinner import TabletSnapshot, pin_tablet
+from .scan import (bypass_scan_aggregate, collect_keyless_blocks,
+                   open_snapshot_readers)
+
+
+def combine_partials(aggs: Sequence[AggSpec], parts: List[tuple],
+                     counts_parts: List[np.ndarray]
+                     ) -> Tuple[tuple, Optional[np.ndarray]]:
+    """Host-side cross-shard combine — LITERALLY the client's RPC
+    partial combine (`ops.scan.combine_agg_partials`, one shared
+    implementation), applied in the same shard order, so bypass and
+    RPC fan-out results cannot drift."""
+    return combine_agg_partials(tuple(_expand_avg(aggs)), parts,
+                                counts_parts)
+
+
+class BypassSession:
+    """Snapshot-consistent SST-direct analytics session.
+
+    ``tablets``: the LOCAL tablet shard objects of a read replica
+    co-located with the caller (pass them in the same order the RPC
+    fan-out would visit, so host-combined results match bit-for-bit).
+    TabletPeer objects are accepted too and are the right choice for
+    consensus-served tablets: the pinner then waits on the peer's MVCC
+    safe time so a write enqueued (with its HT already assigned) but
+    not yet applied can never be missing from the snapshot.
+    ``read_ht``: explicit read point; defaults to the newest tablet
+    clock reading, ratcheted into every shard's clock by the pinner.
+
+    Context manager; `close()` releases every SST lease (pinned files
+    the store dropped meanwhile are physically reclaimed then).
+    """
+
+    def __init__(self, tablets: Sequence, read_ht: Optional[int] = None,
+                 table_id: Optional[str] = None,
+                 chunk_rows: Optional[int] = None,
+                 prefilter: Optional[bool] = None,
+                 min_chunks: int = 3):
+        if not tablets:
+            raise ValueError("BypassSession needs at least one tablet")
+        shards = []                       # (tablet, safe_time_fn|None)
+        for t in tablets:
+            if hasattr(t, "safe_read_ht") and hasattr(t, "tablet"):
+                shards.append((t.tablet, t.safe_read_ht))
+            else:
+                shards.append((t, None))
+        auto_read_ht = read_ht is None
+        if auto_read_ht:
+            read_ht = max(t.clock.now().value for t, _ in shards)
+        self.chunk_rows = chunk_rows
+        self.prefilter = prefilter
+        self.min_chunks = min_chunks
+        self.snapshots: List[TabletSnapshot] = []
+        self._readers = []          # keep mmaps alive for the session
+        self._blocks: List[list] = []
+        self._closed = False
+        try:
+            # a session-chosen read point follows the RPC path's
+            # server-assigned semantics: rows inside the clock-
+            # uncertainty window (read_ht, read_ht + skew] force a
+            # restart at the ambiguous time — re-PIN, since rows at the
+            # higher point must be on disk too.  Explicit caller read
+            # points are snapshot reads and never restart, exactly like
+            # the RPC path; the final attempt accepts (the multi_read
+            # bounded-restart discipline).
+            for attempt in range(3 if auto_read_ht else 1):
+                self._open_shards(shards, read_ht, table_id)
+                if not auto_read_ht or attempt == 2:
+                    break
+                amb = self._max_ambiguous_ht(read_ht)
+                if amb is None:
+                    break
+                self._release_shards()
+                read_ht = amb
+            self.read_ht = read_ht
+        except BaseException:
+            self.close()
+            raise
+
+    def _open_shards(self, shards, read_ht: int, table_id) -> None:
+        for t, safe_fn in shards:
+            snap = pin_tablet(t, read_ht=read_ht, table_id=table_id,
+                              allow_empty=True, safe_time_fn=safe_fn)
+            self.snapshots.append(snap)
+        for snap in self.snapshots:
+            readers = open_snapshot_readers(snap)
+            blocks, bstats = collect_keyless_blocks(readers)
+            snap.stats.update(bstats)
+            self._readers.append(readers)
+            self._blocks.append(blocks)
+
+    def _release_shards(self) -> None:
+        for snap in self.snapshots:
+            snap.close()
+        self.snapshots = []
+        self._readers = []
+        self._blocks = []
+
+    def _max_ambiguous_ht(self, read_ht: int):
+        """Newest hybrid time inside the clock-uncertainty window
+        across every pinned block, or None when the window is clean
+        (the coarse whole-block check the RPC aggregate paths use)."""
+        from ..docdb.operations import _skew_window_ht
+        window_hi = np.uint64(read_ht + _skew_window_ht())
+        lo = np.uint64(read_ht)
+        amb = None
+        for blocks in self._blocks:
+            for b in blocks:
+                a = b.ht[(b.ht > lo) & (b.ht <= window_hi)]
+                if len(a):
+                    m = int(a.max())
+                    amb = m if amb is None else max(amb, m)
+        return amb
+
+    # ------------------------------------------------------------------
+    def scan_aggregate(self, where, aggs: Sequence[AggSpec],
+                       group=None, combine: str = "host"
+                       ) -> Tuple[tuple, Optional[np.ndarray], dict]:
+        """Run one aggregate scan at the session read point across all
+        pinned shards.  combine='host' reproduces the RPC fan-out's
+        partial combine exactly; combine='mesh' psum-combines on a
+        device mesh (one device per shard; raises ValueError when the
+        backend has too few devices — no silent fallback, callers pick
+        deliberately).  Raises BypassIneligible (typed) when any shard
+        can't be served exactly."""
+        if self._closed:
+            raise RuntimeError("BypassSession is closed")
+        if combine == "mesh":
+            return self._scan_mesh(where, aggs, group)
+        if combine != "host":
+            raise ValueError(f"unknown combine mode {combine!r}")
+        parts, counts_parts = [], []
+        stats = self.stats()
+        stats.update(key_rebuilds=0, prefilter_rows_in=0,
+                     prefilter_rows_kept=0, combine="host",
+                     shards_scanned=0)
+        for blocks in self._blocks:
+            if not blocks:
+                continue            # empty shard: combine identity
+            outs, counts, sstats = bypass_scan_aggregate(
+                blocks, where, aggs, group, self.read_ht,
+                chunk_rows=self.chunk_rows,
+                prefilter_enabled=self.prefilter,
+                min_chunks=self.min_chunks)
+            parts.append(outs)
+            counts_parts.append(counts)
+            stats["shards_scanned"] += 1
+            stats["key_rebuilds"] += sstats.get("key_rebuilds", 0)
+            stats["prefilter_rows_in"] += sstats.get(
+                "prefilter_rows_in", 0)
+            stats["prefilter_rows_kept"] += sstats.get(
+                "prefilter_rows_kept", 0)
+            stats.setdefault("paths", []).append(sstats.get("path"))
+        if not parts:
+            raise BypassIneligible(REASON_NO_SSTS,
+                                   "every shard is empty")
+        outs, counts = combine_partials(aggs, parts, counts_parts)
+        return outs, counts, stats
+
+    def _scan_mesh(self, where, aggs, group):
+        """psum-combine across shards on a device mesh: one tablet
+        shard per device, partial aggregates combined over ICI by
+        parallel/distributed_scan.py.  Serves sum/count/avg shapes with
+        the distributed kernel's documented accumulation contract (no
+        per-chunk streaming, no prefilter — the sharded batch is built
+        whole)."""
+        import jax
+
+        from ..parallel.distributed_scan import (
+            build_sharded_batch, distributed_scan_aggregate)
+        from ..parallel.mesh import tablet_mesh
+        shards = [b for b in self._blocks if b]
+        if not shards:
+            raise BypassIneligible(REASON_NO_SSTS,
+                                   "every shard is empty")
+        devices = jax.devices()
+        if len(devices) < len(shards):
+            raise ValueError(
+                f"mesh combine needs {len(shards)} devices, "
+                f"backend has {len(devices)}")
+        from ..ops.expr import referenced_columns
+        needed: set = set()
+        if where is not None:
+            referenced_columns(where, needed)
+        for a in aggs:
+            if a.expr is not None:
+                referenced_columns(a.expr, needed)
+        if group is not None:
+            needed.update(cid for cid, _, _ in group.cols)
+        from ..ops.stream_scan import chunk_safe_mvcc
+        from .errors import REASON_NOT_CHUNK_SAFE
+        for blocks in shards:
+            if not chunk_safe_mvcc(blocks):
+                raise BypassIneligible(REASON_NOT_CHUNK_SAFE)
+        tm = tablet_mesh(num_tablet_shards=len(shards),
+                         num_block_shards=1,
+                         devices=devices[:len(shards)])
+        batch = build_sharded_batch(tm, shards, sorted(needed))
+        outs, counts = distributed_scan_aggregate(
+            batch, where, tuple(aggs), group, self.read_ht)
+        stats = self.stats()
+        stats.update(combine="mesh", shards_scanned=len(shards))
+        return tuple(np.asarray(o) for o in outs), \
+            np.asarray(counts), stats
+
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        keyless = sum(s.stats.get("keyless_blocks", 0)
+                      for s in self.snapshots)
+        return {"read_ht": self.read_ht,
+                "shards": len(self.snapshots),
+                "pinned_files": sum(len(s.sst_paths)
+                                    for s in self.snapshots),
+                "blocks": sum(s.stats.get("blocks", 0)
+                              for s in self.snapshots),
+                "keyless_blocks": keyless}
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for snap in self.snapshots:
+            snap.close()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def __enter__(self) -> "BypassSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -20,16 +20,10 @@ from ..docdb.wire import (
     read_request_to_wire, read_response_from_wire, write_request_to_wire,
 )
 from ..dockv.partition import Partition
+# partial-combine rules + scalar unwrap shared with the bypass
+# session's host combine (ops/scan.py — one implementation, no drift)
+from ..ops.scan import _mm2, _scalar_of as _item, combine_agg_partials
 from ..rpc.messenger import Messenger, RpcError
-
-
-def _item(x):
-    """Python scalar from a 0-d array / numpy scalar / plain value."""
-    if isinstance(x, np.ndarray):
-        return x.item()
-    if isinstance(x, np.generic):
-        return x.item()
-    return x
 
 
 def _overload_backoff_s(e: Exception, attempt: int,
@@ -45,30 +39,6 @@ def _overload_backoff_s(e: Exception, attempt: int,
     import random
     base = (ra / 1000.0) * (2 ** min(attempt, 5))
     return min(cap_s, base) * random.uniform(0.5, 1.0)
-
-
-def _mm2(x, y, op):
-    """None-aware scalar min/max (SQL: NULL is the identity)."""
-    if x is None:
-        return y
-    if y is None:
-        return x
-    return min(x, y) if op == "min" else max(x, y)
-
-
-def _merge_minmax(a, b, op):
-    """None-aware elementwise min/max over scalars or per-group arrays
-    (SQL semantics: NULL is the identity, never the answer over a
-    non-empty input set)."""
-    av, bv = np.asarray(a), np.asarray(b)
-    if av.ndim == 0:
-        return np.asarray(_mm2(av.item(), bv.item(), op))
-    if av.dtype != object and bv.dtype != object:
-        return np.minimum(av, bv) if op == "min" else np.maximum(av, bv)
-    out = np.empty(av.shape, object)
-    for i in range(av.shape[0]):
-        out[i] = _mm2(_item(av[i]), _item(bv[i]), op)
-    return out
 
 
 @dataclass
@@ -217,6 +187,14 @@ class YBClient:
         self._tables: Dict[str, CachedTable] = {}     # name -> cache
         self._seq_cache: Dict[str, list] = {}   # sequence -> cached block
         self._seq_last: Dict[str, int] = {}     # sequence -> last nextval
+        # analytics bypass: callable(table name) -> local Tablet shard
+        # objects of a co-located read replica (None/missing = no local
+        # replica, scans stay on the RPC path)
+        self._bypass_provider = None
+        #: last scan_bypass routing outcome: {"used": bool, "reason":
+        #: typed fallback reason | None, "stats": session stats | None}
+        self.last_bypass: Dict[str, object] = {
+            "used": False, "reason": None, "stats": None}
 
     async def _master_call(self, method: str, payload, timeout: float = 30.0):
         """Call the leader master, failing over across known masters
@@ -772,6 +750,64 @@ class YBClient:
             return self._combine(req, parts)
         return await self._retry_on_split(table, go)
 
+    # --- analytics bypass routing ----------------------------------------
+    def set_bypass_provider(self, provider) -> None:
+        """Register the local-replica provider for scan_bypass:
+        callable(table name) -> ordered shard objects (TabletPeer
+        preferred — the session then waits on MVCC safe time before
+        pinning; bare Tablet works for direct-apply replicas), in the
+        order the RPC fan-out visits so combined partials match; or
+        None when no local replica exists."""
+        self._bypass_provider = provider
+
+    async def scan_bypass(self, table: str,
+                          req: ReadRequest) -> ReadResponse:
+        """Route an aggregate scan through the SST-direct bypass engine
+        (yugabyte_db_tpu/bypass/) when `bypass_reader_enabled` is on
+        and a local replica is registered; every refusal — flag off, no
+        local tablets, a request shape the engine doesn't serve
+        (point/prefix lookups, paging, row scans), typed engine
+        ineligibility — falls back to the ordinary RPC scan path,
+        recording why in ``last_bypass``.  With the flag off (the
+        default) this IS `scan`, byte for byte."""
+        from ..utils import flags as _flags
+        self.last_bypass = {"used": False, "reason": None, "stats": None}
+        if not _flags.get("bypass_reader_enabled"):
+            from ..bypass.errors import REASON_FLAG_OFF
+            self.last_bypass["reason"] = REASON_FLAG_OFF
+            return await self.scan(table, req)
+        if (not req.aggregates or req.pk_eq is not None
+                or req.pk_prefix is not None
+                or req.paging_state is not None):
+            # whole-tablet aggregates are the ONLY bypass shape; a
+            # keyed/paged/row request must keep its RPC semantics
+            self.last_bypass["reason"] = "request_shape"
+            return await self.scan(table, req)
+        tablets = (self._bypass_provider(table)
+                   if self._bypass_provider is not None else None)
+        if not tablets:
+            self.last_bypass["reason"] = "no_local_replica"
+            return await self.scan(table, req)
+        from ..bypass import BypassIneligible, BypassSession
+
+        def _run():
+            # heavy synchronous pin+scan work; the executor keeps the
+            # event loop (and with it every point RPC this client has
+            # in flight) unblocked — the isolation the subsystem is for
+            with BypassSession(tablets, read_ht=req.read_ht) as s:
+                outs, counts, stats = s.scan_aggregate(
+                    req.where, req.aggregates, req.group_by)
+                return outs, counts, stats
+        loop = asyncio.get_running_loop()
+        try:
+            outs, counts, stats = await loop.run_in_executor(None, _run)
+        except BypassIneligible as e:
+            self.last_bypass["reason"] = e.reason
+            return await self.scan(table, req)
+        self.last_bypass = {"used": True, "reason": None, "stats": stats}
+        return ReadResponse(agg_values=outs, group_counts=counts,
+                            backend="bypass")
+
     async def scan_pages(self, table: str, req: ReadRequest,
                          page_size: int = 1000):
         """Streaming scan with DOUBLE-BUFFERED paging: while the caller
@@ -819,32 +855,10 @@ class YBClient:
         aggs = _expand_avg(req.aggregates)
         if isinstance(req.group_by, HashGroupSpec):
             return self._combine_hash_groups(aggs, parts)
-        total = None
-        counts = None
-        for p in parts:
-            vals = [np.asarray(v) for v in p.agg_values]
-            if total is None:
-                total = vals
-                counts = (np.asarray(p.group_counts)
-                          if p.group_counts is not None else None)
-                continue
-            def _none(x):
-                return x is None or (
-                    isinstance(x, np.ndarray) and x.dtype == object
-                    and x.shape == () and x.item() is None)
-
-            for i, a in enumerate(aggs):
-                if a.op in ("sum", "count"):
-                    total[i] = total[i] + vals[i]
-                elif _none(vals[i]):      # empty tablet: min/max identity
-                    pass
-                elif _none(total[i]):
-                    total[i] = vals[i]
-                else:
-                    total[i] = _merge_minmax(total[i], vals[i], a.op)
-            if counts is not None:
-                counts = counts + np.asarray(p.group_counts)
-        return ReadResponse(agg_values=tuple(total), group_counts=counts,
+        total, counts = combine_agg_partials(
+            aggs, [p.agg_values for p in parts],
+            [p.group_counts for p in parts])
+        return ReadResponse(agg_values=total, group_counts=counts,
                             backend=parts[0].backend if parts else "cpu")
 
     def _combine_hash_groups(self, aggs, parts: List[ReadResponse]

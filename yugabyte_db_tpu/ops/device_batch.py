@@ -125,7 +125,9 @@ def f64_conversion(parts) -> Optional[np.dtype]:
 def build_batch(blocks: Sequence[ColumnarBlock],
                 columns: Sequence[int],
                 with_mvcc: bool = True,
-                pad_to: Optional[int] = None) -> DeviceBatch:
+                pad_to: Optional[int] = None,
+                bounds_blocks: Optional[Sequence[ColumnarBlock]] = None
+                ) -> DeviceBatch:
     """Concatenate columnar blocks and ship the requested columns to
     device, padded to a row bucket.
 
@@ -135,7 +137,15 @@ def build_batch(blocks: Sequence[ColumnarBlock],
     (storage/native_lib.copy_multi) instead of a np.concatenate followed
     by a second pad copy per column.  The streaming scan pipeline runs
     this per chunk on a worker thread, overlapped with the previous
-    chunk's kernel dispatch."""
+    chunk's kernel dispatch.
+
+    ``bounds_blocks``: when given, the f64 conversion policy and the
+    per-column bounds (the inputs to the kernel's static SUM scales)
+    come from THESE blocks instead of `blocks`.  The bypass reader's
+    near-data pre-filter compacts provably-unmatched rows out of a
+    chunk but passes the unfiltered chunk here, so the device dtype and
+    quantization scales — and therefore every aggregate bit — stay
+    identical to the unfiltered scan."""
     n = sum(b.n for b in blocks)
     padded = pad_to or bucket_rows(max(n, 1))
     cols: Dict[int, jnp.ndarray] = {}
@@ -185,27 +195,38 @@ def build_batch(blocks: Sequence[ColumnarBlock],
             cols[cid] = jnp.asarray(_pad(codes.astype(np.int32), padded))
             nulls[cid] = jnp.asarray(_pad(null, padded))
             continue
-        parts, nparts = [], []
-        for b in blocks:
-            if cid in b.fixed:
-                v, m = b.fixed[cid]
-                parts.append(v)
-                nparts.append(m)
-            elif cid in b.pk:
-                parts.append(b.pk[cid])
-                nparts.append(np.zeros(b.n, bool))
-            else:
-                raise KeyError(
-                    f"column {cid} not available in columnar form")
-        conv = (f64_conversion(parts)
-                if parts and parts[0].dtype == np.float64 else None)
+        def lane_parts(src_blocks, with_nulls=True):
+            ps, nps = [], []
+            for b in src_blocks:
+                if cid in b.fixed:
+                    v, m = b.fixed[cid]
+                    ps.append(v)
+                    if with_nulls:
+                        nps.append(m)
+                elif cid in b.pk:
+                    ps.append(b.pk[cid])
+                    if with_nulls:
+                        nps.append(np.zeros(b.n, bool))
+                else:
+                    raise KeyError(
+                        f"column {cid} not available in columnar form")
+            return ps, nps
+
+        parts, nparts = lane_parts(blocks)
+        stat_parts = (parts if bounds_blocks is None
+                      else lane_parts(bounds_blocks,
+                                      with_nulls=False)[0])
+        conv = (f64_conversion(stat_parts)
+                if stat_parts and stat_parts[0].dtype == np.float64
+                else None)
         arr = fill(parts, conv)
-        if n and arr.dtype.kind in "fiu":
+        stat_n = sum(len(p) for p in stat_parts)
+        if stat_n and arr.dtype.kind in "fiu":
             # bounds from the parts (the padded tail is zeros and must
             # not contaminate the stats the static SUM scales use)
             col_bounds[cid] = (
-                float(min(p.min() for p in parts if p.size)),
-                float(max(p.max() for p in parts if p.size)))
+                float(min(p.min() for p in stat_parts if p.size)),
+                float(max(p.max() for p in stat_parts if p.size)))
         host_cols[cid] = (arr, fill(nparts))
     valid = np.zeros(padded, bool)
     valid[:n] = True

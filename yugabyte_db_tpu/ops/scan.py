@@ -722,6 +722,80 @@ def _expand_avg(aggs: Sequence[AggSpec]) -> List[AggSpec]:
 
 
 # ---------------------------------------------------------------------------
+# Cross-shard partial combine — THE one implementation of "sum/count
+# add, min/max take None-aware elementwise extremes" shared by the
+# client's RPC fan-out (client/client.py _combine) and the bypass
+# session's host combine, so the two paths cannot drift apart.
+# ---------------------------------------------------------------------------
+
+def _scalar_of(x):
+    """Python scalar from a 0-d array / numpy scalar / plain value."""
+    if isinstance(x, (np.ndarray, np.generic)):
+        return x.item()
+    return x
+
+
+def _mm2(x, y, op):
+    """None-aware scalar min/max (SQL: NULL is the identity)."""
+    if x is None:
+        return y
+    if y is None:
+        return x
+    return min(x, y) if op == "min" else max(x, y)
+
+
+def merge_minmax(a, b, op):
+    """None-aware elementwise min/max over scalars or per-group arrays
+    (SQL semantics: NULL is the identity, never the answer over a
+    non-empty input set)."""
+    av, bv = np.asarray(a), np.asarray(b)
+    if av.ndim == 0:
+        return np.asarray(_mm2(av.item(), bv.item(), op))
+    if av.dtype != object and bv.dtype != object:
+        return np.minimum(av, bv) if op == "min" else np.maximum(av, bv)
+    out = np.empty(av.shape, object)
+    for i in range(av.shape[0]):
+        out[i] = _mm2(_scalar_of(av[i]), _scalar_of(bv[i]), op)
+    return out
+
+
+def agg_is_none(x) -> bool:
+    """A whole-shard NULL aggregate (empty tablet min/max)."""
+    return x is None or (isinstance(x, np.ndarray) and x.dtype == object
+                         and x.shape == () and x.item() is None)
+
+
+def combine_agg_partials(expanded_aggs: Sequence[AggSpec],
+                         parts: Sequence[Sequence],
+                         counts_parts: Sequence):
+    """Combine per-shard (agg_values, group_counts) partials in shard
+    order: sum/count add, min/max merge via :func:`merge_minmax` with
+    None as the identity.  `expanded_aggs` must already be
+    avg-expanded; returns (tuple of combined values, combined counts
+    or None)."""
+    total = None
+    counts = None
+    for vals, cnts in zip(parts, counts_parts):
+        vals = [np.asarray(v) for v in vals]
+        if total is None:
+            total = vals
+            counts = np.asarray(cnts) if cnts is not None else None
+            continue
+        for i, a in enumerate(expanded_aggs):
+            if a.op in ("sum", "count"):
+                total[i] = total[i] + vals[i]
+            elif agg_is_none(vals[i]):
+                pass
+            elif agg_is_none(total[i]):
+                total[i] = vals[i]
+            else:
+                total[i] = merge_minmax(total[i], vals[i], a.op)
+        if counts is not None:
+            counts = counts + np.asarray(cnts)
+    return (tuple(total) if total is not None else ()), counts
+
+
+# ---------------------------------------------------------------------------
 # Zone-map block pruning (v2 SST blocks carry per-block min/max maps)
 # ---------------------------------------------------------------------------
 

@@ -70,20 +70,22 @@ def plan_chunks(blocks: Sequence[ColumnarBlock],
 
 def chunk_safe_mvcc(blocks: Sequence[ColumnarBlock]) -> bool:
     """True when chunking at any block boundary preserves MVCC
-    semantics: all blocks are internally unique-keyed, carry (or can
-    derive) keys, and no doc key straddles two consecutive blocks — so
-    the newest-visible-version choice never needs to see two chunks.
+    semantics: all blocks are internally unique-keyed, carry boundary
+    keys, and no doc key straddles two consecutive blocks — so the
+    newest-visible-version choice never needs to see two chunks.
 
-    Only BOUNDARY keys are consulted (first_full_key/last_full_key), so
-    v2 keyless blocks prove safety from their stored boundary keys
-    without materializing the derived key matrix."""
+    Only BOUNDARY keys are consulted (``boundary_keys`` with
+    ``materialize=False``), so v2 keyless blocks prove safety from
+    their stored k0/k1 without EVER materializing the derived key
+    matrix: a block that has neither an inline matrix nor stored
+    boundary keys is simply declared unsafe (the monolithic path
+    serves it) rather than paying a whole-block rebuild inside an
+    eligibility check."""
     prev_last: Optional[bytes] = None
     for b in blocks:
-        if not b.unique_keys or b.n == 0 or not (
-                b.keys_derivable or b.first_full_key() is not None):
+        if not b.unique_keys or b.n == 0:
             return False
-        first = b.first_full_key()
-        last = b.last_full_key()
+        first, last = b.boundary_keys(materialize=False)
         if first is None or last is None or len(first) <= _HT_SUFFIX:
             return False
         # boundary doc keys must be STRICTLY ascending across the whole
@@ -120,7 +122,7 @@ def streaming_scan_aggregate(
         kernel: Optional[ScanKernel] = None,
         chunk_rows: Optional[int] = None,
         cache=None, cache_key: Optional[tuple] = None,
-        min_chunks: int = 3):
+        min_chunks: int = 3, prefilter=None):
     """Chunked scan-aggregate over `blocks`.
 
     Returns ``(agg_values, counts)`` — the shapes of
@@ -137,6 +139,15 @@ def streaming_scan_aggregate(
     `cache`/`cache_key`: optional DeviceBlockCache — chunk batches land
     under ``cache_key + ("chunk", i)`` so a warm re-scan re-dispatches
     device-resident chunks with zero batch formation.
+
+    `prefilter`: optional callable(chunk blocks) -> compacted blocks —
+    the bypass reader's near-data pre-filter drops provably-unmatched
+    rows before batch formation.  The batch still pads to the shared
+    UNFILTERED bucket and takes its dtype policy + static-scale bounds
+    from the unfiltered chunk (``bounds_blocks``), so results stay
+    byte-identical to the unfiltered scan; mutually exclusive with the
+    device cache (a one-shot snapshot scan has no warm re-scan to
+    serve).
     """
     if isinstance(group, HashGroupSpec):
         return None
@@ -182,8 +193,16 @@ def streaming_scan_aggregate(
     # under one predicate's prune must never serve another predicate's
     prune_sig = ("zp", kept_idx) if pruned else ()
 
+    pf_stats = {"rows_in": 0, "rows_kept": 0}
+
     def build(item):
         ci, chunk = item
+        if prefilter is not None:
+            kept_blocks = prefilter(chunk)
+            pf_stats["rows_in"] += sum(b.n for b in chunk)
+            pf_stats["rows_kept"] += sum(b.n for b in kept_blocks)
+            return build_batch(kept_blocks, cols_sorted, pad_to=bucket,
+                               bounds_blocks=chunk)
         if cache is not None and cache_key is not None:
             # the chunk plan (target rows + bucket) is part of the key:
             # a runtime streaming_chunk_rows change re-plans the chunks,
@@ -199,6 +218,9 @@ def streaming_scan_aggregate(
     counts_acc = None
     kernel_s = 0.0
     import time
+
+    from ..storage.columnar import KEY_REBUILD_STATS
+    rebuilds0 = KEY_REBUILD_STATS["rebuilds"]
     for batch in pipe.run(enumerate(chunks)):
         t0 = time.perf_counter()
         outs, counts, _ = kernel.run(batch, where, aggs, group, read_ht)
@@ -211,6 +233,11 @@ def streaming_scan_aggregate(
         "chunks": len(chunks), "bucket_rows": bucket,
         "zone_blocks_pruned": pruned,
         "zone_blocks_total": len(blocks) + pruned,
+        # lazy key-matrix rebuilds paid DURING this scan — the keyless
+        # v2 contract is that this stays 0 (tests assert it)
+        "key_rebuilds": KEY_REBUILD_STATS["rebuilds"] - rebuilds0,
+        "prefilter_rows_in": pf_stats["rows_in"],
+        "prefilter_rows_kept": pf_stats["rows_kept"],
         "build_s": round(pipe.stage_s[0], 4),
         "kernel_s": round(kernel_s, 4),
         "consumer_wait_s": round(pipe.wait_s, 4)})

@@ -40,6 +40,13 @@ from . import lane_codec
 #: anything newer with a clear error instead of misparsing it
 SUPPORTED_FORMAT_VERSION = 2
 
+#: lazy key-matrix rebuild tally: every time a v2 keyless block's
+#: ``keys`` property fires its key_builder thunk, one rebuild (and the
+#: block's row count) lands here.  The analytics scan paths promise to
+#: never pay this cost — tests and the bypass reader assert the counter
+#: stays flat across a scan; point reads/merges legitimately increment.
+KEY_REBUILD_STATS = {"rebuilds": 0, "rows": 0}
+
 _HASH_MULT = np.uint64(0x100000001B3)
 _HASH_OFF = np.uint64(0xCBF29CE484222325)
 
@@ -190,6 +197,8 @@ class ColumnarBlock:
         the block has no keys and no way to derive them."""
         if self._keys is None and self._key_thunk is not None:
             thunk, self._key_thunk = self._key_thunk, None
+            KEY_REBUILD_STATS["rebuilds"] += 1
+            KEY_REBUILD_STATS["rows"] += self.n
             self._keys = thunk(self)
         return self._keys
 
@@ -209,23 +218,34 @@ class ColumnarBlock:
         if self._keys is None and builder is not None:
             self._key_thunk = builder
 
+    def boundary_keys(self, materialize: bool = True
+                      ) -> Tuple[Optional[bytes], Optional[bytes]]:
+        """(first, last) full encoded keys of the block.  Consults the
+        materialized matrix or the stored v2 boundary keys (k0/k1);
+        with ``materialize=False`` it returns ``(None, None)`` instead
+        of firing the lazy key_builder — eligibility and zone-prune
+        decisions use this form so a pruning pass can never pay a
+        whole-block key rebuild."""
+        if self._keys is not None:
+            if not self.n:
+                return None, None
+            return self._keys[0].tobytes(), self._keys[-1].tobytes()
+        if self._first_key is not None:
+            return self._first_key, self._last_key
+        if not materialize:
+            return None, None
+        k = self.keys                  # may invoke the rebuild thunk
+        if k is None or not self.n:
+            return None, None
+        return k[0].tobytes(), k[-1].tobytes()
+
     def first_full_key(self) -> Optional[bytes]:
         """First row's full encoded key WITHOUT materializing a derived
         keys matrix when the serialized boundary keys are present."""
-        if self._keys is not None:
-            return self._keys[0].tobytes() if self.n else None
-        if self._first_key is not None:
-            return self._first_key
-        k = self.keys                  # may invoke the rebuild thunk
-        return k[0].tobytes() if k is not None and self.n else None
+        return self.boundary_keys()[0]
 
     def last_full_key(self) -> Optional[bytes]:
-        if self._keys is not None:
-            return self._keys[-1].tobytes() if self.n else None
-        if self._last_key is not None:
-            return self._last_key
-        k = self.keys
-        return k[-1].tobytes() if k is not None and self.n else None
+        return self.boundary_keys()[1]
 
     # ------------------------------------------------------------------
     @classmethod

@@ -15,9 +15,10 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import threading
 from dataclasses import dataclass, field
-from typing import Callable, Iterator, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from ..utils import flags
 from ..utils.fault_injection import MAYBE_FAULT, TEST_CRASH_POINT
@@ -73,6 +74,43 @@ class CompactionFeed:
         return []
 
 
+class SstLease:
+    """Refcount lease over an LsmStore's live SST FILES (not readers):
+    while held, compaction/truncate may remove the files from the store
+    but their physical deletion is deferred until the last lease drops
+    (reference analog: rocksdb's version refcounting keeping obsolete
+    files alive for open iterators).  Out-of-band readers — the
+    analytics bypass engine — open the leased paths directly, so the
+    lease is what makes "scan a tablet's SST set without the tserver"
+    safe against concurrent file GC.
+
+    Release exactly once via :meth:`release` (or the context manager);
+    a lease leaked by a crashed process leaves unmanifested files on
+    disk, which the store's open-time sweep reclaims."""
+
+    def __init__(self, store: "LsmStore", paths: List[str],
+                 frontier: dict):
+        self.store = store
+        self.paths = paths
+        self.frontier = frontier
+        self._released = False
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self.store._release_pins(self.paths)
+
+    @property
+    def released(self) -> bool:
+        return self._released
+
+    def __enter__(self) -> "SstLease":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
 class LsmStore:
     def __init__(self, directory: str, name: str = "db",
                  columnar_builder=None, row_decoder=None,
@@ -96,7 +134,13 @@ class LsmStore:
         self._struct_gen = 0           # bumps on flush/compact/replace
         self._snap = None              # cached (gen-key, (mems, ssts))
         self._mem_frontier: dict = {}
+        # out-of-band reader leases: path -> refcount; paths the store
+        # dropped while pinned wait in _deferred until the last lease
+        # releases them (then the physical unlink happens)
+        self._pins: Dict[str, int] = {}
+        self._deferred: set = set()
         self._load_manifest()
+        self._sweep_unmanifested()
 
     # --- manifest ---------------------------------------------------------
     @property
@@ -127,6 +171,89 @@ class LsmStore:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._manifest_path)
+
+    def _sweep_unmanifested(self) -> None:
+        """Crash-safe sweep at open (the PR-4 tombstone discipline
+        applied to SST files): the manifest is the single source of
+        truth for live SSTs, so any ``<name>.NNNNNN.sst`` (or its
+        ``.tmp``) in the directory that the manifest does not reference
+        is garbage — a flush/ingest that crashed before its manifest
+        install, or a pin-deferred delete whose process died before the
+        lease released.  Both are reclaimed here, before any reader or
+        new lease can observe them.  No live LsmStore writes into this
+        directory while __init__ runs, so the sweep races nothing."""
+        live = {os.path.basename(r.path) for r in self._ssts}
+        pat = re.compile(re.escape(self.name) + r"\.\d{6,}\.sst(\.tmp)?$")
+        try:
+            entries = os.listdir(self.dir)
+        except OSError:
+            return
+        for fn in entries:
+            if pat.fullmatch(fn) and fn not in live:
+                try:
+                    os.unlink(os.path.join(self.dir, fn))
+                except OSError:
+                    pass
+
+    # --- out-of-band reader leases ----------------------------------------
+    def pin_ssts(self, require_empty_memtable: bool = False
+                 ) -> Optional[SstLease]:
+        """Lease the CURRENT live SST set against file GC.  With
+        ``require_empty_memtable`` the pin only succeeds while no
+        memtable (active or frozen) holds rows — checked under the same
+        lock that installs flush output, so the returned file set is a
+        complete image of everything applied before the pin (the
+        snapshot pinner's atomicity requirement); returns None when a
+        memtable is busy and the caller retries after a flush."""
+        with self._lock:
+            if require_empty_memtable and not (
+                    self._mem.empty() and not self._frozen):
+                return None
+            paths = [r.path for r in self._ssts]
+            for p in paths:
+                self._pins[p] = self._pins.get(p, 0) + 1
+            frontier = dict(self._flushed_frontier)
+        return SstLease(self, paths, frontier)
+
+    def _release_pins(self, paths: Sequence[str]) -> None:
+        drop: List[str] = []
+        with self._lock:
+            for p in paths:
+                c = self._pins.get(p, 0) - 1
+                if c > 0:
+                    self._pins[p] = c
+                else:
+                    self._pins.pop(p, None)
+                    if p in self._deferred:
+                        self._deferred.discard(p)
+                        drop.append(p)
+        for p in drop:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    def _gc_file(self, path: str) -> None:
+        """Physical SST removal for files the store no longer owns
+        (compaction inputs, truncate victims).  Deletion defers while
+        any lease pins the path — the last release performs the unlink;
+        a crash in the deferred window leaves an unmanifested file the
+        next open sweeps."""
+        with self._lock:
+            if self._pins.get(path, 0) > 0:
+                self._deferred.add(path)
+                return
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+    def pin_stats(self) -> dict:
+        """Live lease accounting (tests + the bypass session stats)."""
+        with self._lock:
+            return {"pinned_files": sum(1 for c in self._pins.values()
+                                        if c > 0),
+                    "deferred_deletes": len(self._deferred)}
 
     # --- writes -----------------------------------------------------------
     def apply(self, batch: WriteBatch) -> None:
@@ -232,10 +359,10 @@ class LsmStore:
         for r in removed:
             try:
                 r.close() if hasattr(r, "close") else None
-                os.unlink(r.path)
-                n += 1
             except OSError:
                 pass
+            self._gc_file(r.path)
+            n += 1
         return n
 
     def ingest_sst(self, build: Callable[[SstWriter], None],
@@ -384,10 +511,7 @@ class LsmStore:
             self._struct_gen += 1
             self._write_manifest()
         for r in old:
-            try:
-                os.remove(r.path)
-            except OSError:
-                pass
+            self._gc_file(r.path)
 
     # --- checkpoint -------------------------------------------------------
     def checkpoint(self, out_dir: str) -> None:

@@ -112,6 +112,10 @@ def _load() -> Optional[ctypes.CDLL]:
                                 ctypes.c_int64, _u8p]
     lib.fnv64_rows_fixed.argtypes = [_u8p, ctypes.c_int64, ctypes.c_int64,
                                      _u64p]
+    lib.prefilter_ranges.argtypes = [
+        _vpp, _i64p, _vpp,
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+        _i64p, _i64p, ctypes.c_int64, ctypes.c_int64, _u8p]
     _LIB = lib
     return lib
 
@@ -443,6 +447,98 @@ def fnv64_rows_fixed(mat: np.ndarray) -> Optional[np.ndarray]:
     lib.fnv64_rows_fixed(_ptr(mat.reshape(-1), _u8p), mat.shape[0],
                          mat.shape[1], _ptr(out, _u64p))
     return out
+
+
+#: dtype -> prefilter_ranges code (the C switch); anything else falls
+#: back to the numpy oracle
+_PREFILTER_DTYPES = {
+    np.dtype(np.int32): 1, np.dtype(np.int64): 2,
+    np.dtype(np.float32): 3, np.dtype(np.float64): 4,
+    np.dtype(np.uint32): 5,
+}
+
+#: counters for the profile scripts: native vs fallback prefilter calls
+PREFILTER_STATS = {"native_calls": 0, "fallback_calls": 0}
+
+_I64_MIN, _I64_MAX = -(1 << 63), (1 << 63) - 1
+
+
+def prefilter_ranges(preds: Sequence[tuple], n: int
+                     ) -> Optional[np.ndarray]:
+    """Near-data predicate pre-filter: one GIL-released native call
+    evaluates EVERY (values, nulls, lo, hi) inclusive range predicate
+    over the encoded lanes and ANDs the results into a keep mask
+    (uint8[n]; NULL rows fail their predicate).  Returns None — caller
+    uses :func:`prefilter_ranges_fallback` — when the library is
+    unavailable or any lane is ineligible: unsupported dtype,
+    non-contiguous / misaligned buffer (lanes can be raw views over the
+    SST mmap, where typed access needs natural alignment), length
+    mismatch, or integer bounds outside int64."""
+    lib = _load()
+    if lib is None or not preds:
+        return None
+    np_ = len(preds)
+    col_p = (ctypes.c_void_p * np_)()
+    null_p = (ctypes.c_void_p * np_)()
+    dt = np.empty(np_, np.int64)
+    lo_f = np.zeros(np_, np.float64)
+    hi_f = np.zeros(np_, np.float64)
+    lo_i = np.zeros(np_, np.int64)
+    hi_i = np.zeros(np_, np.int64)
+    for j, (vals, nulls, lo, hi) in enumerate(preds):
+        code = _PREFILTER_DTYPES.get(vals.dtype)
+        if code is None or vals.ndim != 1 or len(vals) != n \
+                or not vals.flags["C_CONTIGUOUS"] \
+                or vals.ctypes.data % vals.dtype.itemsize:
+            return None
+        if nulls is not None:
+            if nulls.dtype != np.bool_ or len(nulls) != n \
+                    or not nulls.flags["C_CONTIGUOUS"]:
+                return None
+            null_p[j] = nulls.ctypes.data
+        if code in (1, 2, 5):
+            if not (_I64_MIN <= lo <= _I64_MAX
+                    and _I64_MIN <= hi <= _I64_MAX):
+                return None
+            lo_i[j], hi_i[j] = int(lo), int(hi)
+        else:
+            lo_f[j], hi_f[j] = float(lo), float(hi)
+        col_p[j] = vals.ctypes.data
+        dt[j] = code
+    keep = np.empty(n, np.uint8)
+    lib.prefilter_ranges(
+        col_p, _ptr(dt, _i64p), null_p,
+        lo_f.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        hi_f.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        _ptr(lo_i, _i64p), _ptr(hi_i, _i64p), np_, n, _ptr(keep, _u8p))
+    PREFILTER_STATS["native_calls"] += 1
+    return keep
+
+
+def prefilter_ranges_fallback(preds: Sequence[tuple],
+                              n: int) -> np.ndarray:
+    """Numpy twin of prefilter_ranges (also the parity oracle in
+    tests): identical keep-mask semantics, pure numpy."""
+    PREFILTER_STATS["fallback_calls"] += 1
+    keep = np.ones(n, bool)
+    for vals, nulls, lo, hi in preds:
+        if vals.dtype.kind == "f":
+            m = (vals >= np.float64(lo)) & (vals <= np.float64(hi))
+        else:
+            m = (vals >= lo) & (vals <= hi)
+        if nulls is not None:
+            m = m & ~nulls
+        keep &= m
+    return keep.astype(np.uint8)
+
+
+def prefilter_mask(preds: Sequence[tuple], n: int) -> np.ndarray:
+    """prefilter_ranges with automatic numpy fallback — the one entry
+    point the bypass reader calls (the gather_columns idiom)."""
+    got = prefilter_ranges(preds, n)
+    if got is None:
+        got = prefilter_ranges_fallback(preds, n)
+    return got
 
 
 def kway_merge(runs: Sequence[Sequence[bytes]]

@@ -227,6 +227,22 @@ DEFINE_RUNTIME("sst_format_version", 2,
                "writer. Readers handle both versions side by side; "
                "storage/sst.py resolve_format_version is the ONLY "
                "writer gate, so no writer can emit v2 while this is 1.")
+DEFINE_RUNTIME("bypass_reader_enabled", False,
+               "Route eligible aggregate scans through the analytics "
+               "bypass engine (yugabyte_db_tpu/bypass/): snapshot-"
+               "pinned SST-direct scans that never touch the tserver "
+               "hot path. Off (the default) keeps the RPC scan path "
+               "byte-identical to a build without the subsystem; "
+               "ineligible shapes always fall back to RPC with a "
+               "typed reason.")
+DEFINE_RUNTIME("bypass_prefilter_enabled", True,
+               "Near-data predicate pre-filter inside the bypass "
+               "reader: fixed-width comparison conjuncts evaluate "
+               "against encoded lanes in one GIL-released native pass "
+               "and provably-unmatched rows are dropped before batch "
+               "formation. Result bits are unchanged (the batch keeps "
+               "the unfiltered dtype policy, bucket and static-scale "
+               "bounds); off = every row reaches batch formation.")
 DEFINE_RUNTIME("zone_map_pruning", True,
                "Consult v2 per-block min/max zone maps in the scan "
                "pushdown paths to skip whole blocks whose value ranges "
