@@ -566,6 +566,109 @@ def bypass_scan_bench():
         return {"error": str(e)[:200]}
 
 
+def tpch_bypass_bench(data, repeats):
+    """TPC-H Q1/Q6 routed through ``client.scan_bypass`` (ROADMAP
+    bypass item (e)): the SAME lineitem rows served from a one-tserver
+    mini cluster, each query measured over the RPC hot path
+    (``client.scan``) and the SST-direct bypass engine back-to-back,
+    so the headline q6/q1 blocks report a bypass column by default and
+    ``bypass_vs_hotpath`` regression-WARNs like any other ratio.
+    BENCH_TPCH_BYPASS=0 skips (the column then reads "skipped")."""
+    import asyncio
+
+    if os.environ.get("BENCH_TPCH_BYPASS", "1") == "0":
+        return None
+
+    async def run():
+        from yugabyte_db_tpu.docdb.operations import ReadRequest
+        from yugabyte_db_tpu.models.tpch import (
+            TPCH_Q1, TPCH_Q6, lineitem_range_info, numpy_reference)
+        from yugabyte_db_tpu.utils import flags
+
+        n_li = len(data["rowid"])
+        mc = await __import__(
+            "yugabyte_db_tpu.tools.mini_cluster",
+            fromlist=["MiniCluster"]).MiniCluster(
+                tempfile.mkdtemp(prefix="ybtpu-tpchbp-"),
+                num_tservers=1).start()
+        try:
+            c = mc.client()
+            await c.create_table(lineitem_range_info(), num_tablets=1,
+                                 replication_factor=1)
+            await mc.wait_for_leaders("lineitem_r")
+            ts = mc.tservers[0]
+            li_peer = next(p for p in ts.peers.values()
+                           if p.tablet.info.name == "lineitem_r")
+            li_peer.tablet.bulk_load(data, block_rows=65536)
+            c.set_bypass_provider(
+                lambda table: [li_peer] if table == "lineitem_r"
+                else None)
+            flags.set_flag("bypass_reader_enabled", True)
+            out = {}
+            rounds = max(2, repeats // 2)
+            for q in (TPCH_Q6, TPCH_Q1):
+                def req():
+                    return ReadRequest("", where=q.where,
+                                       aggregates=q.aggs,
+                                       group_by=q.group)
+                hot_warm = await c.scan("lineitem_r", req())
+                byp_warm = await c.scan_bypass("lineitem_r", req())
+                assert c.last_bypass["used"], (
+                    f"{q.name}: bypass fell back "
+                    f"({c.last_bypass['reason']})")
+                # parity: q6 vs direct numpy; q1 bypass-vs-hotpath
+                # elementwise (the byte-level parity proof lives in
+                # tests/test_bypass_reader.py — this guards the BENCH
+                # wiring, and a mismatch must fail the bench)
+                if q.name == "q6":
+                    ref = numpy_reference(q, data)
+                    got = float(byp_warm.agg_values[0])
+                    assert abs(got - ref) / max(abs(ref), 1e-9) < 1e-5, \
+                        f"bypass q6 mismatch: {got} vs {ref}"
+                else:
+                    for hv, bv in zip(hot_warm.agg_values,
+                                      byp_warm.agg_values):
+                        ha, ba = np.asarray(hv, dtype=np.float64), \
+                            np.asarray(bv, dtype=np.float64)
+                        assert np.allclose(ha, ba, rtol=1e-5), \
+                            f"bypass q1 mismatch: {ba} vs {ha}"
+                # PAIRED rounds (hot, bypass back-to-back) so driver-box
+                # contention cancels in the ratio, as in the main loop
+                pairs = []
+                for _ in range(rounds):
+                    t0 = time.perf_counter()
+                    await c.scan("lineitem_r", req())
+                    hot_t = time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    await c.scan_bypass("lineitem_r", req())
+                    pairs.append((hot_t, time.perf_counter() - t0))
+                hot_t = min(h for h, _ in pairs)
+                byp_t = min(b for _, b in pairs)
+                st = c.last_bypass["stats"] or {}
+                out[q.name] = {
+                    "hotpath_rows_per_s": round(n_li / hot_t, 1),
+                    "bypass_rows_per_s": round(n_li / byp_t, 1),
+                    # best-of-N over best-of-N, consistent with the
+                    # rows/s columns above (a max() of per-pair ratios
+                    # would let one stalled hot round mask a real
+                    # bypass regression from the WARN tail)
+                    "bypass_vs_hotpath": round(hot_t / byp_t, 3),
+                    "keyless_blocks": st.get("keyless_blocks"),
+                    "blocks": st.get("blocks"),
+                }
+            return out
+        finally:
+            flags.REGISTRY.reset("bypass_reader_enabled")
+            await mc.shutdown()
+
+    try:
+        return asyncio.run(run())
+    except AssertionError:
+        raise   # a parity mismatch IS a bench failure, not a column
+    except Exception as e:   # noqa: BLE001 — report, don't fail bench
+        return {"error": str(e)[:200]}
+
+
 # ratio keys whose value < 1.0 means "slower than the baseline it was
 # measured against" — surfaced as a WARN in the bench tail instead of
 # sitting silently inside the JSON (satellite of PR 3; Q6's r05
@@ -608,7 +711,10 @@ def warn_regressed_ratios(node, path="", out=None):
 def warn_suppression_growth(base_dir=None):
     """Collect WARN lines when the static-analysis suppression count
     grew past tools/analyze/baseline.json — annotations accreting
-    instead of hazards being fixed is its own regression."""
+    instead of hazards being fixed is its own regression — or when the
+    sweep's own wall clock grew past 1.5x the recorded
+    ``analyze_wall_ms`` (the engine rides in tier-1 and the pre-commit
+    hook; its cost is tracked like any hot path)."""
     here = base_dir or os.path.dirname(os.path.abspath(__file__))
     out = []
     try:
@@ -619,14 +725,24 @@ def warn_suppression_growth(base_dir=None):
             sys.path.pop(0)
         with open(os.path.join(here, "tools", "analyze",
                                "baseline.json")) as f:
-            baseline = json.load(f)["suppressions"]
-        report = run_analysis(ProjectIndex(here), ALL_PASSES)
+            base = json.load(f)
+        baseline = base["suppressions"]
+        report = run_analysis(ProjectIndex(
+            here, cache_dir=os.path.join(here, ".analyze_cache")),
+            ALL_PASSES)
         for pass_id, n in sorted(report["suppressions"].items()):
             if n > baseline.get(pass_id, 0):
                 out.append(
                     f"analysis suppressions for {pass_id} grew to {n} "
                     f"(baseline {baseline.get(pass_id, 0)}) — fix the "
                     f"hazard or commit a new baseline deliberately")
+        base_ms = base.get("analyze_wall_ms")
+        if base_ms and report["wall_ms"] > 1.5 * base_ms:
+            out.append(
+                f"analyze_wall_ms grew to {report['wall_ms']:.0f} "
+                f"(baseline {base_ms}, limit 1.5x) — the analysis "
+                f"engine's own cost regressed; profile the passes or "
+                f"re-record the baseline deliberately")
     except Exception as e:   # noqa: BLE001 — account, don't fail bench
         out.append(f"analysis suppression check failed: {e!r:.120}")
     return out
@@ -806,6 +922,16 @@ def main():
             "speedup": max(ratios),
             "ratio_rounds": [round(r, 3) for r in ratios],
         }
+
+    # --- the bypass column: Q1/Q6 through client.scan_bypass ------------
+    bp = tpch_bypass_bench(data, repeats)
+    for qn in ("q6", "q1"):
+        if bp is None:
+            results[qn]["bypass"] = "skipped (BENCH_TPCH_BYPASS=0)"
+        elif "error" in bp:
+            results[qn]["bypass"] = {"error": bp["error"]}
+        else:
+            results[qn]["bypass"] = bp[qn]
 
     # --- cold-scan split: streaming chunk pipeline vs monolithic batch --
     # The headline q6/q1 numbers above are WARM-scan rates (batch already
@@ -1244,6 +1370,9 @@ def main():
                               len(q6["ratio_rounds"]) // 2], 3),
                       "tpu_s": round(q6["tpu_s"], 4),
                       "cpu_s": round(q6["cpu_s"], 4)},
+        # RPC hot path vs SST-direct bypass on the same rows (ROADMAP
+        # bypass item (e)); bypass_vs_hotpath WARN-wires like any ratio
+        "q6_bypass": q6["bypass"],
         "device": str(dev) + (" (FALLBACK: accelerator unreachable)"
                               if device_fallback else ""),
         **({"device_probe_failures": probe_log} if device_fallback else {}),
@@ -1254,7 +1383,8 @@ def main():
         # kernel, streaming pipeline vs the r05 monolithic build)
         "cold_scan": results["cold_scan"],
         "q1": {"tpu_rows_per_s": round(results["q1"]["tpu_rows_per_s"], 1),
-               "speedup": round(results["q1"]["speedup"], 3)},
+               "speedup": round(results["q1"]["speedup"], 3),
+               "bypass": results["q1"]["bypass"]},
         "q1_dist8": {
             "rows_per_s": round(results["q1_dist"]["rows_per_s"], 1),
             "combine": results["q1_dist"]["combine"]},

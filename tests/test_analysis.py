@@ -1,19 +1,25 @@
 """tools/analyze/ wired into tier-1.
 
-Three layers:
+Four layers:
 
-1. PASS FIXTURES — for each of the five passes: a true positive the
-   pass must catch, the same hazard suppressed with a reasoned
-   annotation, and a clean negative that must NOT fire (the negatives
-   encode the idioms the real tree depends on — `.shape` math inside
-   jit bodies, executor-target sync defs, async-with on asyncio locks).
-2. WHOLE-TREE — the real `yugabyte_db_tpu/` must produce ZERO
+1. PASS FIXTURES — for each pass: a true positive the pass must
+   catch, the same hazard suppressed with a reasoned annotation, and a
+   clean negative that must NOT fire (the negatives encode the idioms
+   the real tree depends on — `.shape` math inside jit bodies,
+   executor-target sync defs, async-with on asyncio locks).  The
+   interprocedural passes add a TRANSITIVE triple each (hazard behind
+   a helper), plus the pre-fix product shapes the engine was built to
+   catch (master._persist's fsync under an async commit).
+2. CALL GRAPH — the shared interprocedural layer's own contract:
+   alias chains, method resolution across (multi-module) inheritance,
+   recursion termination, and the persisted facts-cache speedup.
+3. WHOLE-TREE — the real `yugabyte_db_tpu/` must produce ZERO
    unannotated findings, so any new hazard is a failing build from the
    day the pass shipped.
-3. CONTRACTS — the run.py --json schema (pass ids, counts, findings,
-   suppression tally, per-pass wall time), the suppression-vs-baseline
-   tally bench.py WARNs on, and the wall-time budget that keeps the
-   sweep from bloating the tier-1 timeout.
+4. CONTRACTS — the run.py --json schema (pass ids, counts, findings,
+   suppression tally, per-pass wall time), the --changed incremental
+   mode, the suppression-vs-baseline tally bench.py WARNs on, and the
+   wall-time budget that keeps the sweep from bloating tier-1.
 """
 import json
 import os
@@ -556,6 +562,678 @@ class TestLayering:
         assert _findings(r) == []
 
 
+# --- interprocedural: the call graph itself --------------------------------
+
+class TestCallGraph:
+    def _graph(self, tmp_path, files):
+        for rel, src in files.items():
+            p = tmp_path / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(textwrap.dedent(src))
+        index = ProjectIndex(str(tmp_path), roots=("pkg",))
+        return index.call_graph()
+
+    def test_alias_chain_resolution(self, tmp_path):
+        g = self._graph(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/util.py": """\
+                def helper():
+                    pass
+                """,
+            "pkg/a.py": """\
+                from pkg import util
+                fn = util.helper
+                fn2 = fn
+                def caller():
+                    fn2()
+                """})
+        assert g.resolve("pkg/a.py", "caller", "fn2") \
+            == "pkg/util.py::helper"
+
+    def test_method_resolution_across_inheritance(self, tmp_path):
+        g = self._graph(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/base.py": """\
+                class Base:
+                    def close(self):
+                        pass
+                """,
+            "pkg/sub.py": """\
+                from pkg.base import Base
+                class Mid(Base):
+                    pass
+                class Sub(Mid):
+                    def open(self):
+                        self.close()     # binds Base.close via the MRO
+                """})
+        assert g.resolve("pkg/sub.py", "Sub.open", "self.close") \
+            == "pkg/base.py::Base.close"
+        # an override wins over the base definition
+        g2 = self._graph(tmp_path / "o", {
+            "pkg/__init__.py": "",
+            "pkg/m.py": """\
+                class A:
+                    def f(self):
+                        pass
+                class B(A):
+                    def f(self):
+                        pass
+                    def g(self):
+                        self.f()
+                """})
+        assert g2.resolve("pkg/m.py", "B.g", "self.f") == "pkg/m.py::B.f"
+
+    def test_classname_and_module_qualified_calls(self, tmp_path):
+        g = self._graph(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/m.py": """\
+                import pkg.util
+                from pkg.util import helper as h
+                class C:
+                    def m(self):
+                        pass
+                def f():
+                    C.m(None)
+                    pkg.util.helper()
+                    h()
+                """,
+            "pkg/util.py": """\
+                def helper():
+                    pass
+                """})
+        assert g.resolve("pkg/m.py", "f", "C.m") == "pkg/m.py::C.m"
+        assert g.resolve("pkg/m.py", "f", "pkg.util.helper") \
+            == "pkg/util.py::helper"
+        assert g.resolve("pkg/m.py", "f", "h") == "pkg/util.py::helper"
+
+    def test_recursion_terminates(self, tmp_path):
+        g = self._graph(tmp_path, {
+            "pkg/__init__.py": "",
+            "pkg/r.py": """\
+                import time
+                def a():
+                    b()
+                def b():
+                    a()
+                    time.sleep(1)
+                def solo():
+                    solo()
+                    time.sleep(2)
+                """})
+
+        def direct(key):
+            d = g.def_fact(key)
+            return {t: ln for ln, t in d["calls"]
+                    if t == "time.sleep"} if d else {}
+
+        # mutual recursion: summaries converge and still see the hazard
+        s = g.summarize(g.key("pkg/r.py", "a"), "t", direct,
+                        lambda k: True)
+        assert "time.sleep" in s
+        s2 = g.summarize(g.key("pkg/r.py", "solo"), "t", direct,
+                         lambda k: True)
+        assert "time.sleep" in s2
+
+    def test_facts_cache_hit_speedup(self, tmp_path):
+        files = {"pkg/__init__.py": ""}
+        for i in range(30):
+            files[f"pkg/m{i}.py"] = "def f():\n    pass\n" * 40
+        for rel, src in files.items():
+            p = tmp_path / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(src)
+        cache = str(tmp_path / ".analyze_cache")
+        i1 = ProjectIndex(str(tmp_path), roots=("pkg",), cache_dir=cache)
+        g1 = i1.call_graph()
+        assert g1.stats["cache_misses"] == len(files)
+        i2 = ProjectIndex(str(tmp_path), roots=("pkg",), cache_dir=cache)
+        g2 = i2.call_graph()
+        assert g2.stats["cache_hits"] == len(files)
+        assert g2.stats["cache_misses"] == 0
+        # the cached run must actually be cheaper, not just "hit"
+        assert g2.stats["build_ms"] < g1.stats["build_ms"]
+        # identical facts either way
+        assert g2.facts == g1.facts
+        # an edited file is re-extracted, the rest stay cached
+        p = tmp_path / "pkg/m0.py"
+        p.write_text("def f():\n    pass\ndef g():\n    pass\n")
+        os.utime(p, (1, 1))
+        i3 = ProjectIndex(str(tmp_path), roots=("pkg",), cache_dir=cache)
+        g3 = i3.call_graph()
+        assert g3.stats["cache_misses"] == 1
+        assert "g" in g3.facts["pkg/m0.py"]["defs"]
+
+
+# --- interprocedural: transitive pass upgrades ------------------------------
+
+class TestAsyncBlockingTransitive:
+    def test_true_positive_reports_chain(self, tmp_path):
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/a.py": """\
+            import shutil
+            def nuke(path):
+                shutil.rmtree(path)
+            def indirection(path):
+                nuke(path)
+            async def handler(path):
+                indirection(path)
+            """}, "async_blocking")
+        assert [(l, d) for _, l, d in _findings(r)] == [
+            (7, "shutil.rmtree")]
+        msg = r["findings"][0]["message"]
+        # the full helper chain is the finding's evidence
+        assert "indirection" in msg and "nuke" in msg \
+            and "shutil.rmtree" in msg
+
+    def test_cross_module_chain(self, tmp_path):
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/helpers.py": """\
+            import subprocess
+            def run_tool():
+                subprocess.run(["x"])
+            """,
+                            "pkg/srv.py": """\
+            from pkg.helpers import run_tool
+            async def handler():
+                run_tool()
+            """}, "async_blocking")
+        assert [(p, l, d) for p, l, d in _findings(r)] == [
+            ("pkg/srv.py", 3, "subprocess.run")]
+
+    def test_suppression_at_direct_site_does_not_taint(self, tmp_path):
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/a.py": """\
+            import time
+            def bounded_wait():
+                time.sleep(0.001)  # analysis-ok(async_blocking): bounded
+            async def handler():
+                bounded_wait()
+            """}, "async_blocking")
+        assert r["findings"] == []
+
+    def test_suppression_at_call_site(self, tmp_path):
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/a.py": """\
+            import time
+            def helper():
+                time.sleep(1)
+            async def handler():
+                helper()   # analysis-ok(async_blocking): startup only
+            """}, "async_blocking")
+        assert r["findings"] == []
+        assert r["suppressions"]["async_blocking"] == 1
+
+    def test_clean_negatives(self, tmp_path):
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/a.py": """\
+            import asyncio, time
+            def tiny_config():
+                open("/tmp/x")        # lexical-only offender: does NOT
+                #                       taint callers (accepted idiom)
+            def stall():
+                time.sleep(1)
+            async def co_helper():
+                await asyncio.sleep(0)
+            async def handler():
+                tiny_config()
+                await co_helper()     # async callee: scanned on its own
+                await asyncio.get_running_loop().run_in_executor(
+                    None, stall)      # executor dispatch, not a call
+            """}, "async_blocking")
+        assert r["findings"] == []
+
+
+class TestLockHeldAwaitTransitive:
+    def test_true_positive_blocking_under_lock(self, tmp_path):
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/a.py": """\
+            import time
+            class C:
+                def _drain(self):
+                    time.sleep(1)
+                async def work(self):
+                    with self._lock:
+                        self._drain()
+            """}, "lock_held_await")
+        assert [(l, d) for _, l, d in _findings(r)] == [
+            (7, "self._lock->time.sleep")]
+        assert "_drain" in r["findings"][0]["message"]
+
+    def test_suppressed_with_reason(self, tmp_path):
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/a.py": """\
+            import time
+            class C:
+                def _drain(self):
+                    time.sleep(1)
+                async def work(self):
+                    with self._lock:
+                        # analysis-ok(lock_held_await): bounded drain
+                        self._drain()
+            """}, "lock_held_await")
+        assert r["findings"] == []
+        assert r["suppressions"]["lock_held_await"] == 1
+
+    def test_clean_negatives(self, tmp_path):
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/a.py": """\
+            import time
+            class C:
+                def _fast(self):
+                    return self.x + 1
+                def _stall(self):
+                    time.sleep(1)
+                async def work(self):
+                    with self._lock:
+                        self._fast()       # no blocking in the summary
+                    self._stall()          # blocking, but no lock held
+            """}, "lock_held_await")
+        assert r["findings"] == []
+
+
+class TestSharedStateRacesResolved:
+    def test_name_collision_no_longer_overapproximates(self, tmp_path):
+        # Shipper hands ITS OWN self.flush to an executor; Bystander
+        # merely shares the method NAME.  The class-resolved pass must
+        # flag Shipper only (terminal-name matching flagged both).
+        src = {"pkg/__init__.py": "",
+               "pkg/a.py": """\
+            class Shipper:
+                def flush(self):
+                    self.buf = []
+                async def go(self):
+                    self.buf = [1]
+                    await self._loop.run_in_executor(None, self.flush)
+            class Bystander:
+                def flush(self):
+                    self.buf = []
+                async def go(self):
+                    self.buf = [1]
+            """}
+        r = _run(tmp_path, src, "shared_state_races")
+        paths = {(p, l) for p, l, _ in _findings(r)}
+        assert ("pkg/a.py", 3) in paths or ("pkg/a.py", 5) in paths
+        assert all(l < 7 for _, l in paths), (
+            "Bystander got flagged through a shared method name:\n"
+            + str(r["findings"]))
+
+    def test_subclass_override_stays_thread_side(self, tmp_path):
+        # Base ships self.flush to an executor; Sub OVERRIDES flush —
+        # for Sub instances the override is what runs on the thread,
+        # so its unlocked writes must still race Sub's async methods
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/base.py": """\
+            class Base:
+                def flush(self):
+                    pass
+                async def go(self):
+                    await self._loop.run_in_executor(None, self.flush)
+            """,
+                            "pkg/sub.py": """\
+            from pkg.base import Base
+            class Sub(Base):
+                def flush(self):
+                    self.dirty = []
+                async def serve(self):
+                    self.dirty = [1]
+            """}, "shared_state_races")
+        assert any(p == "pkg/sub.py" for p, _, _ in _findings(r)), (
+            "the override lost its thread-side marking:\n"
+            + str(r["findings"]))
+
+    def test_unresolvable_target_still_falls_back(self, tmp_path):
+        # `peer.tablet.flush` has an unknowable receiver: the terminal-
+        # name fallback must keep flagging a same-named sync mutator
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/a.py": """\
+            def ship(peer, loop):
+                loop.run_in_executor(None, peer.tablet.flush)
+            class T:
+                def flush(self):
+                    self.rows = []
+                async def ingest(self):
+                    self.rows = [1]
+            """}, "shared_state_races")
+        assert len(r["findings"]) >= 1
+
+
+# --- new graph-powered passes ----------------------------------------------
+
+class TestLockOrder:
+    def test_true_positive_ab_ba_cycle(self, tmp_path):
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/a.py": """\
+            class S:
+                async def handler(self):
+                    with self._meta_lock:
+                        with self._flush_lock:
+                            self.x = 1
+                def compact(self):
+                    with self._flush_lock:
+                        with self._meta_lock:
+                            self.y = 1
+            """}, "lock_order")
+        assert len(r["findings"]) == 1
+        msg = r["findings"][0]["message"]
+        assert "_meta_lock" in msg and "_flush_lock" in msg
+        assert "deadlock" in msg
+
+    def test_transitive_cycle_through_helper(self, tmp_path):
+        # handler holds A and CALLS a helper that takes B; compact
+        # takes B then A directly — the cycle spans a call edge
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/a.py": """\
+            class S:
+                def _drain(self):
+                    with self._flush_lock:
+                        self.q = []
+                async def handler(self):
+                    with self._meta_lock:
+                        self._drain()
+                def compact(self):
+                    with self._flush_lock:
+                        with self._meta_lock:
+                            self.y = 1
+            """}, "lock_order")
+        assert len(r["findings"]) == 1
+        assert "via" in r["findings"][0]["message"]
+
+    def test_suppressed_with_reason(self, tmp_path):
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/a.py": """\
+            class S:
+                async def handler(self):
+                    with self._a_lock:
+                        # analysis-ok(lock_order): B-holders never take A
+                        with self._b_lock:
+                            self.x = 1
+                def compact(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            self.y = 1
+            """}, "lock_order")
+        assert r["findings"] == []
+        assert r["suppressions"]["lock_order"] == 1
+
+    def test_clean_negatives(self, tmp_path):
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/a.py": """\
+            class S:
+                async def handler(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            self.x = 1
+                def compact(self):
+                    with self._a_lock:     # same global order: fine
+                        with self._b_lock:
+                            self.y = 1
+            class T:
+                def one(self):
+                    with self._b_lock:     # same NAMES, different class
+                        with self._a_lock: # = different locks: no cycle
+                            self.z = 1
+            """}, "lock_order")
+        assert r["findings"] == []
+
+    def test_base_class_lock_is_one_lock(self, tmp_path):
+        # the lock lives on the base; two subclasses ordering it
+        # against their own lock INCONSISTENTLY is a real cycle
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/a.py": """\
+            import threading
+            class Base:
+                def __init__(self):
+                    self._install_lock = threading.Lock()
+            class U(Base):
+                def f(self):
+                    with self._install_lock:
+                        with self._side_lock:
+                            self.x = 1
+            class V(Base):
+                def g(self):
+                    with self._side_lock:
+                        with self._install_lock:
+                            self.y = 1
+            """}, "lock_order")
+        # U._side and V._side are DIFFERENT locks (each class assigns
+        # its own), so no cycle exists here; only the shared base lock
+        # could close one.  The negative pins the identity rule.
+        assert r["findings"] == []
+        r2 = _run(tmp_path / "pos", {"pkg/__init__.py": "",
+                                     "pkg/a.py": """\
+            import threading
+            class Base:
+                def __init__(self):
+                    self._install_lock = threading.Lock()
+                    self._gc_lock = threading.Lock()
+            class U(Base):
+                def f(self):
+                    with self._install_lock:
+                        with self._gc_lock:
+                            self.x = 1
+            class V(Base):
+                def g(self):
+                    with self._gc_lock:
+                        with self._install_lock:
+                            self.y = 1
+            """}, "lock_order")
+        assert len(r2["findings"]) == 1
+
+class TestResourceBalance:
+    def test_discarded_lease_always_leaks(self, tmp_path):
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/a.py": """\
+            def pin(store):
+                store.pin_ssts(require_empty_memtable=True)
+            """}, "resource_balance")
+        assert [(l, d) for _, l, d in _findings(r)] == [
+            (2, "pin_ssts:discarded")]
+
+    def test_early_return_skips_release(self, tmp_path):
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/a.py": """\
+            def scan(store, cond):
+                lease = store.pin_ssts()
+                if cond:
+                    return None
+                lease.release()
+                return 1
+            """}, "resource_balance")
+        assert [(l, d) for _, l, d in _findings(r)] == [
+            (4, "pin_ssts:lease")]
+
+    def test_fall_through_never_released(self, tmp_path):
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/a.py": """\
+            def peek(path):
+                f = open(path)
+                f.read(4)
+            """}, "resource_balance")
+        assert [(l, d) for _, l, d in _findings(r)] == [(2, "open:f")]
+
+    def test_gauge_early_return_skips_decrement(self, tmp_path):
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/a.py": """\
+            class L:
+                def admit(self, shed):
+                    self._inflight += 1
+                    if shed:
+                        return False
+                    self.dispatch()
+                    self._inflight -= 1
+                    return True
+            """}, "resource_balance")
+        assert [(l, d) for _, l, d in _findings(r)] == [
+            (5, "gauge:self._inflight")]
+
+    def test_suppressed_with_reason(self, tmp_path):
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/a.py": """\
+            def scan(store, cond):
+                lease = store.pin_ssts()
+                if cond:
+                    # analysis-ok(resource_balance): owner releases
+                    return None
+                lease.release()
+            """}, "resource_balance")
+        assert r["findings"] == []
+        assert r["suppressions"]["resource_balance"] == 1
+
+    def test_clean_negatives(self, tmp_path):
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/a.py": """\
+            import contextlib
+
+            def ctx_owned(path):
+                with open(path) as f:      # context manager owns it
+                    return f.read()
+
+            def try_finally(store):
+                lease = store.pin_ssts()
+                try:
+                    return work(lease)
+                finally:
+                    lease.release()
+
+            def transfer(store):
+                lease = store.pin_ssts()
+                return Snapshot(lease=lease)   # ownership moved out
+
+            def stored(self, store):
+                lease = store.pin_ssts()
+                self._lease = lease            # escapes to the owner
+
+            def with_stmt_release(path):
+                f = open(path)
+                with contextlib.closing(f):
+                    return f.read()
+
+            def release_then_raise(store, cond):
+                lease = store.pin_ssts()
+                if not lease.paths:
+                    lease.release()
+                    raise ValueError("empty")  # raising exits exempt
+                lease.release()
+                return 1
+
+            class Cache:
+                def put(self, k, v, size):
+                    self._bytes += size
+                    while self._bytes > self.cap:
+                        self._bytes -= self.evict()
+                    return v                   # dec behind the return:
+                    #                            eviction accounting,
+                    #                            not an in-flight pair
+
+            def parser(s):
+                depth = 0
+                for ch in s:
+                    depth += 1
+                    if ch == ")":
+                        depth -= 1
+                    if depth > 40:
+                        return None            # bare local: no gauge
+                return depth
+
+            def monotonic(self):
+                self._stats += 1               # inc-only: a counter
+                return self._stats
+            """}, "resource_balance")
+        assert r["findings"] == []
+
+    def test_pinner_shape_is_clean(self, tmp_path):
+        # the REAL bypass/pinner.py shape: acquire in a retry loop,
+        # release+raise on the empty branch, transfer via the returned
+        # snapshot — zero findings, pinned as a regression fixture
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/a.py": """\
+            def pin_snapshot(store, attempts):
+                lease = None
+                for attempt in range(attempts):
+                    lease = store.pin_ssts(require_empty_memtable=True)
+                    if lease is not None:
+                        break
+                if lease is None:
+                    raise RuntimeError("memtable active")
+                if not lease.paths:
+                    lease.release()
+                    raise RuntimeError("no ssts")
+                return Snapshot(lease=lease, paths=list(lease.paths))
+            """}, "resource_balance")
+        assert r["findings"] == []
+
+
+# --- the pre-fix product shapes the engine was built to catch ---------------
+
+class TestPreFixProductShapes:
+    """Minimal reproductions of hazards that lived in yugabyte_db_tpu/
+    BEFORE this PR's fixes — invisible to the lexical passes, caught by
+    the interprocedural engine.  These pin the engine's reason to
+    exist: if a refactor re-introduces the shape, tier-1 names it."""
+
+    def test_master_persist_fsync_under_async_commit(self, tmp_path):
+        # pre-fix master.py: async _commit_catalog -> sync _persist()
+        # -> open/fsync/replace inline on the event loop
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/master.py": """\
+            import json, os
+            class Master:
+                def _persist(self):
+                    with open(self._path + ".tmp", "w") as f:
+                        json.dump(self.tables, f)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    os.replace(self._path + ".tmp", self._path)
+                async def _commit_catalog(self, ops):
+                    self.apply(ops)
+                    self._persist()
+            """}, "async_blocking")
+        details = sorted(d for _, _, d in _findings(r))
+        assert "os.fsync" in details, r["findings"]
+        assert all(l == 11 for _, l, _ in _findings(r)), (
+            "the finding must land on the async-side call line")
+
+    def test_tserver_meta_write_under_async_split(self, tmp_path):
+        # pre-fix tablet_server.py: async _apply_split calling the
+        # sync _atomic_json helper (fsync + cross-FS-safe replace)
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/ts.py": """\
+            import json, os
+            def _atomic_json(path, obj):
+                with open(path + ".tmp", "w") as f:
+                    json.dump(obj, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(path + ".tmp", path)
+            class TabletServer:
+                async def _apply_split(self, meta):
+                    _atomic_json(self._marker(meta["id"]), meta)
+            """}, "async_blocking")
+        assert any(d == "os.fsync" for _, _, d in _findings(r))
+
+    def test_fixed_master_shape_is_clean(self, tmp_path):
+        # the POST-fix shape: serialize on the loop, fsync in the
+        # executor — the engine must see it as clean (else the fix
+        # would have needed an annotation, which the tentpole forbids)
+        r = _run(tmp_path, {"pkg/__init__.py": "",
+                            "pkg/master.py": """\
+            import asyncio, json, os
+            class Master:
+                def _write(self, data):
+                    with open(self._path + ".tmp", "w") as f:
+                        f.write(data)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    os.replace(self._path + ".tmp", self._path)
+                async def _commit_catalog(self, ops):
+                    self.apply(ops)
+                    data = json.dumps(self.tables)
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self._write, data)
+            """}, "async_blocking")
+        assert r["findings"] == []
+
+
 # --- 2 + 3. whole tree, schema, budget, baseline ---------------------------
 
 @pytest.fixture(scope="module")
@@ -577,7 +1255,7 @@ def test_all_passes_ran(tree_report):
     assert [p["id"] for p in tree_report["passes"]] == [
         "async_blocking", "lock_held_await", "jit_hazards",
         "flag_drift", "shared_state_races", "unawaited_coroutine",
-        "format_gate", "layering"]
+        "format_gate", "layering", "lock_order", "resource_balance"]
 
 
 def test_wall_time_budget(tree_report):
@@ -612,6 +1290,52 @@ def test_run_py_json_schema():
     for p in report["passes"]:
         assert {"id", "title", "findings", "suppressed",
                 "wall_ms"} <= set(p)
+
+
+def test_run_py_changed_mode(tmp_path):
+    """--changed <range>: whole-tree index, findings gated to the
+    changed files — the CI / pre-push incremental contract."""
+    pkg = tmp_path / "yugabyte_db_tpu"
+    pkg.mkdir()
+    (pkg / "clean.py").write_text("def ok():\n    return 1\n")
+    env = {**os.environ, "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+
+    def git(*a):
+        subprocess.run(["git", *a], cwd=tmp_path, check=True, env=env,
+                       capture_output=True)
+
+    git("init", "-q", ".")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    # a NEW hazard lands in the tree (git add so the range diff sees
+    # the untracked file); clean.py is untouched
+    (pkg / "bad.py").write_text(
+        "import time\nasync def h():\n    time.sleep(1)\n")
+    git("add", "-A")
+    run_py = os.path.join(HERE, "tools", "analyze", "run.py")
+    r = subprocess.run(
+        [sys.executable, run_py, "--base", str(tmp_path),
+         "--changed", "HEAD", "--json", "--no-cache"],
+        capture_output=True, text=True)
+    assert r.returncode == 1, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    assert {f["path"] for f in report["findings"]} == \
+        {"yugabyte_db_tpu/bad.py"}
+    # an unresolvable range is a hard error, not a silent full run
+    r2 = subprocess.run(
+        [sys.executable, run_py, "--base", str(tmp_path),
+         "--changed", "no-such-ref..HEAD", "--no-cache"],
+        capture_output=True, text=True)
+    assert r2.returncode == 2, r2.stdout + r2.stderr
+    # nothing changed in range => trivially clean exit
+    git("add", "-A")
+    git("commit", "-qm", "hazard (committed so the range is empty)")
+    r3 = subprocess.run(
+        [sys.executable, run_py, "--base", str(tmp_path),
+         "--changed", "HEAD", "--no-cache"],
+        capture_output=True, text=True)
+    assert r3.returncode == 0, r3.stdout + r3.stderr
 
 
 def test_run_py_exits_nonzero_on_findings(tmp_path):

@@ -1,0 +1,637 @@
+"""Project-wide call graph: the interprocedural layer under the passes.
+
+The lexical passes see one function at a time; this module sees the
+whole program.  Three stages, all name-based (the analyzer never
+imports the code it checks):
+
+1. **Facts extraction** — one AST walk per file produces a
+   JSON-serializable facts dict: every def (module functions, class
+   methods, nested defs) with its raw call sites, every class with its
+   bases / methods / ``self.X`` attribute assignments, the import
+   table (absolute and relative spellings, ``as`` renames), and
+   module-level aliases (``fn = mod.helper``).  Facts are *per-file
+   pure*, which is what makes the on-disk cache sound: an entry keyed
+   on ``(path, mtime_ns, size)`` can never go stale because of an edit
+   to a *different* file.
+
+2. **Resolution** — a call's dotted text is resolved in its def's
+   scope: local nested defs, module defs/classes, alias chains
+   (bounded), the import table, ``self.x()``/``cls.x()`` through the
+   enclosing class's MRO (project-local bases followed cross-module),
+   ``ClassName.x()``, and absolute ``pkg.mod.fn`` forms.  Unresolvable
+   targets return None — propagation under-approximates rather than
+   guesses (a terminal-name fallback is each pass's own choice).
+
+3. **Summaries** — ``summarize()`` computes per-def hazard summaries
+   (e.g. "blocking calls reachable from here") as a memoized DFS over
+   the edge lists, cycle-guarded and depth-bounded, storing one
+   *witness step* per hazard so full call chains can be reconstructed
+   for findings without storing exponential path sets.
+
+Known resolution limits (ANALYSIS.md "interprocedural contract"):
+calls through container/attribute indirection (``self.handlers[k]()``,
+``obj.attr.fn()`` where ``obj`` is not self/cls/a module), calls on
+values returned from calls, lambda bodies, and ``functools.partial``
+objects invoked later are not resolved; star imports are ignored;
+alias chains are followed to depth 6 and summaries to depth 25.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .core import ModuleInfo, call_name
+
+#: bump to invalidate every persisted .analyze_cache facts entry when
+#: the extraction schema changes
+FACTS_VERSION = 1
+
+#: alias chains (`a = b`, `b = mod.f`) followed at most this deep
+_ALIAS_DEPTH = 6
+#: summaries stop descending past this call depth (recursion guard is
+#: separate; this bounds pathological but acyclic chains)
+_SUMMARY_DEPTH = 25
+
+
+def module_dotted(rel: str) -> str:
+    """Repo-relative file path -> dotted module name
+    (``pkg/sub/__init__.py`` -> ``pkg.sub``)."""
+    rel = rel.replace("\\", "/")
+    if rel.endswith("/__init__.py"):
+        rel = rel[: -len("/__init__.py")]
+    elif rel.endswith("__init__.py"):
+        rel = rel[: -len("__init__.py")].rstrip("/")
+    elif rel.endswith(".py"):
+        rel = rel[:-3]
+    return rel.replace("/", ".")
+
+
+def _package_parts(rel: str) -> List[str]:
+    """The package a module's relative imports resolve against."""
+    dotted = module_dotted(rel)
+    parts = dotted.split(".") if dotted else []
+    if rel.replace("\\", "/").endswith("/__init__.py"):
+        return parts               # the package itself
+    return parts[:-1]
+
+
+def iter_defs(tree: ast.Module):
+    """Yield ``(qual, cls_qual, node)`` for every function def in the
+    module, with the SAME qual scheme the facts extractor uses — the
+    bridge that lets a pass walking the AST look its current def up in
+    the graph.  ``cls_qual`` is the enclosing class qual when the def
+    is a direct class member, else None."""
+
+    def walk(stmts, scope: List[str], cls: Optional[str]):
+        for s in stmts:
+            if isinstance(s, ast.ClassDef):
+                cqual = ".".join(scope + [s.name])
+                yield from walk(s.body, scope + [s.name], cqual)
+            elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ".".join(scope + [s.name])
+                yield qual, cls, s
+                yield from walk(s.body, scope + [s.name], None)
+            else:
+                children = [c for c in ast.iter_child_nodes(s)
+                            if isinstance(c, (ast.stmt,
+                                              ast.ExceptHandler,
+                                              ast.match_case))]
+                if children:
+                    yield from walk(children, scope, cls)
+
+    yield from walk(tree.body, [], None)
+
+
+def _collect_calls(body) -> List[List]:
+    """Raw ``[line, dotted-text]`` call sites in a def body, stopping
+    at nested def/class/lambda boundaries (those run in their own
+    context — a nested def only contributes when it is CALLED, which
+    shows up as an edge to its own def)."""
+    out: List[List] = []
+
+    def go(n: ast.AST) -> None:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef, ast.Lambda)):
+            return
+        if isinstance(n, ast.Call):
+            t = call_name(n)
+            if t:
+                out.append([n.lineno, t])
+        for c in ast.iter_child_nodes(n):
+            go(c)
+
+    for s in body:
+        go(s)
+    return out
+
+
+def extract_facts(mod: ModuleInfo) -> dict:
+    """One module's call-graph facts (pure function of the file)."""
+    pkg = _package_parts(mod.rel)
+    facts: dict = {"module": module_dotted(mod.rel), "defs": {},
+                   "classes": {}, "imports": {}, "aliases": {},
+                   "globals": []}
+    if mod.tree is None:
+        return facts
+    gl: set = set()
+
+    def add_import(node) -> None:
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    facts["imports"][a.asname] = a.name
+            return
+        if node.level:
+            base = pkg[: len(pkg) - (node.level - 1)] if node.level > 1 \
+                else list(pkg)
+            target = ".".join(base + ([node.module] if node.module
+                                      else []))
+        else:
+            target = node.module or ""
+        for a in node.names:
+            if a.name == "*":
+                continue
+            full = f"{target}.{a.name}" if target else a.name
+            facts["imports"][a.asname or a.name] = full
+
+    def add_def(node, scope: List[str], cls: Optional[str]) -> None:
+        qual = ".".join(scope + [node.name])
+        locals_: Dict[str, str] = {}
+        for s in node.body:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                locals_[s.name] = f"{qual}.{s.name}"
+        facts["defs"][qual] = {
+            "name": node.name, "qual": qual,
+            "async": isinstance(node, ast.AsyncFunctionDef),
+            "line": node.lineno, "cls": cls,
+            "calls": _collect_calls(node.body),
+            "locals": locals_,
+        }
+        if cls is not None:
+            centry = facts["classes"].get(cls)
+            if centry is not None:
+                centry["methods"][node.name] = qual
+                for n in ast.walk(node):
+                    if isinstance(n, (ast.Assign, ast.AnnAssign,
+                                      ast.AugAssign)):
+                        tgts = n.targets if isinstance(n, ast.Assign) \
+                            else [n.target]
+                        for t in tgts:
+                            if isinstance(t, ast.Attribute) \
+                                    and isinstance(t.value, ast.Name) \
+                                    and t.value.id == "self" \
+                                    and t.attr not in centry["attrs"]:
+                                centry["attrs"].append(t.attr)
+
+    def walk(stmts, scope: List[str], cls: Optional[str],
+             top: bool) -> None:
+        for s in stmts:
+            if isinstance(s, (ast.Import, ast.ImportFrom)):
+                add_import(s)
+            elif isinstance(s, ast.ClassDef):
+                cqual = ".".join(scope + [s.name])
+                facts["classes"][cqual] = {
+                    "bases": [ast.unparse(b) for b in s.bases
+                              if isinstance(b, (ast.Name,
+                                                ast.Attribute))],
+                    "methods": {}, "attrs": []}
+                if top:
+                    gl.add(s.name)
+                walk(s.body, scope + [s.name], cqual, False)
+            elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                add_def(s, scope, cls)
+                if top:
+                    gl.add(s.name)
+                walk(s.body, scope + [s.name], None, False)
+            else:
+                if top and isinstance(s, (ast.Assign, ast.AnnAssign)):
+                    tgts = s.targets if isinstance(s, ast.Assign) \
+                        else [s.target]
+                    for t in tgts:
+                        if isinstance(t, ast.Name):
+                            gl.add(t.id)
+                            if isinstance(s.value, (ast.Name,
+                                                    ast.Attribute)):
+                                facts["aliases"][t.id] = \
+                                    ast.unparse(s.value)
+                children = [c for c in ast.iter_child_nodes(s)
+                            if isinstance(c, (ast.stmt,
+                                              ast.ExceptHandler,
+                                              ast.match_case))]
+                if children:
+                    walk(children, scope, cls, top)
+
+    walk(mod.tree.body, [], None, True)
+    facts["globals"] = sorted(gl)
+    return facts
+
+
+# --- persisted facts cache -------------------------------------------------
+
+class FactsCache:
+    """One JSON file under ``.analyze_cache/`` mapping rel path ->
+    ``{"k": [mtime_ns, size], "f": facts}``.  A stale key or a
+    FACTS_VERSION bump is simply a miss; writes go through a tmp +
+    atomic-replace so a crashed run never leaves a torn cache."""
+
+    def __init__(self, cache_dir: str):
+        self.path = os.path.join(cache_dir, "callgraph_facts.json")
+        self._dirty = False
+        self._files: Dict[str, dict] = {}
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                data = json.load(f)
+            if data.get("v") == FACTS_VERSION:
+                self._files = data.get("files", {})
+        except (OSError, ValueError):
+            pass
+
+    def get(self, rel: str, key: Optional[Tuple[int, int]]):
+        if key is None:
+            return None
+        e = self._files.get(rel)
+        if e is not None and e.get("k") == list(key):
+            return e["f"]
+        return None
+
+    def put(self, rel: str, key: Optional[Tuple[int, int]],
+            facts: dict) -> None:
+        if key is None:
+            return
+        self._files[rel] = {"k": list(key), "f": facts}
+        self._dirty = True
+
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        try:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump({"v": FACTS_VERSION, "files": self._files}, f)
+            os.replace(tmp, self.path)
+            self._dirty = False
+        except OSError:
+            pass                      # cache is best-effort only
+
+
+# --- the graph -------------------------------------------------------------
+
+class CallGraph:
+    """Resolution + edges + summaries over the extracted facts.
+
+    Def keys are ``"<rel>::<qual>"`` strings; ``None`` always means
+    "could not resolve" and every consumer treats it as no-edge."""
+
+    def __init__(self, facts_by_rel: Dict[str, dict], stats: dict):
+        self.facts = facts_by_rel
+        self.stats = stats
+        self.mod_rel = {f["module"]: rel
+                        for rel, f in facts_by_rel.items() if f["module"]}
+        self._edges: Dict[str, List[Tuple[int, str, Optional[str]]]] = {}
+        self._memos: Dict[str, Dict[str, dict]] = {}
+        self.stats["defs"] = sum(len(f["defs"])
+                                 for f in facts_by_rel.values())
+
+    # -- lookups -----------------------------------------------------------
+    @staticmethod
+    def key(rel: str, qual: str) -> str:
+        return f"{rel}::{qual}"
+
+    @staticmethod
+    def split(key: str) -> Tuple[str, str]:
+        rel, _, qual = key.partition("::")
+        return rel, qual
+
+    def def_fact(self, key: str) -> Optional[dict]:
+        rel, qual = self.split(key)
+        f = self.facts.get(rel)
+        return f["defs"].get(qual) if f else None
+
+    def is_async(self, key: str) -> bool:
+        d = self.def_fact(key)
+        return bool(d and d["async"])
+
+    def defs(self):
+        for rel, f in self.facts.items():
+            for qual, d in f["defs"].items():
+                yield self.key(rel, qual), d
+
+    def class_fact(self, rel: str, cls_qual: str) -> Optional[dict]:
+        f = self.facts.get(rel)
+        return f["classes"].get(cls_qual) if f else None
+
+    # -- resolution --------------------------------------------------------
+    def resolve(self, rel: str, def_qual: Optional[str],
+                text: str) -> Optional[str]:
+        """Resolve a call's dotted text in the scope of def
+        ``def_qual`` of module ``rel`` (def_qual None = module scope).
+        Returns a def key or None."""
+        return self._resolve_text(rel, def_qual, text, 0)
+
+    def _resolve_text(self, rel: str, def_qual: Optional[str],
+                      text: str, depth: int) -> Optional[str]:
+        if depth > _ALIAS_DEPTH or not text:
+            return None
+        f = self.facts.get(rel)
+        if f is None:
+            return None
+        parts = text.split(".")
+        head = parts[0]
+        if head in ("self", "cls"):
+            if len(parts) != 2 or def_qual is None:
+                return None
+            d = f["defs"].get(def_qual)
+            cls = d["cls"] if d else self._enclosing_class(rel, def_qual)
+            if cls is None:
+                return None
+            return self.resolve_method(rel, cls, parts[1])
+        if len(parts) == 1:
+            # innermost-out: nested defs of the enclosing def chain
+            if def_qual is not None:
+                for anc in self._def_ancestry(f, def_qual):
+                    loc = f["defs"][anc]["locals"].get(head)
+                    if loc is not None:
+                        return self.key(rel, loc)
+            d = f["defs"].get(head)
+            if d is not None and d["cls"] is None:
+                return self.key(rel, head)
+            if head in f["classes"]:
+                return self.resolve_method(rel, head, "__init__")
+            if head in f["aliases"]:
+                return self._resolve_text(rel, None, f["aliases"][head],
+                                          depth + 1)
+            if head in f["imports"]:
+                return self._absolute(f["imports"][head])
+            return None
+        rest = ".".join(parts[1:])
+        if head in f["aliases"]:
+            return self._resolve_text(
+                rel, None, f"{f['aliases'][head]}.{rest}", depth + 1)
+        if head in f["imports"]:
+            return self._absolute(f"{f['imports'][head]}.{rest}")
+        if head in f["classes"] and len(parts) == 2:
+            return self.resolve_method(rel, head, parts[1])
+        return self._absolute(text)
+
+    def _def_ancestry(self, f: dict, def_qual: str) -> List[str]:
+        """def_qual plus every enclosing def qual that exists, in
+        innermost-out order (``f.g.h`` -> [f.g.h, f.g, f])."""
+        out = []
+        parts = def_qual.split(".")
+        for i in range(len(parts), 0, -1):
+            q = ".".join(parts[:i])
+            if q in f["defs"]:
+                out.append(q)
+        return out
+
+    def _enclosing_class(self, rel: str, def_qual: str) -> Optional[str]:
+        f = self.facts[rel]
+        parts = def_qual.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            q = ".".join(parts[:i])
+            if q in f["classes"]:
+                return q
+        return None
+
+    def _absolute(self, dotted: str) -> Optional[str]:
+        """Resolve an absolute dotted target: longest module-path
+        prefix owned by the project, remainder a def, a class
+        (-> ``__init__``) or ``Class.method``."""
+        parts = dotted.split(".")
+        for i in range(len(parts), 0, -1):
+            mod = ".".join(parts[:i])
+            rel = self.mod_rel.get(mod)
+            if rel is None:
+                continue
+            rest = parts[i:]
+            f = self.facts[rel]
+            if not rest:
+                return None
+            if len(rest) == 1:
+                d = f["defs"].get(rest[0])
+                if d is not None and d["cls"] is None:
+                    return self.key(rel, rest[0])
+                if rest[0] in f["classes"]:
+                    return self.resolve_method(rel, rest[0], "__init__")
+                alias = f["aliases"].get(rest[0])
+                if alias is not None:
+                    return self._resolve_text(rel, None, alias, 1)
+                return None
+            if len(rest) == 2 and rest[0] in f["classes"]:
+                return self.resolve_method(rel, rest[0], rest[1])
+            return None
+        return None
+
+    def resolve_class(self, rel: str, text: str,
+                      _depth: int = 0) -> Optional[Tuple[str, str]]:
+        """Resolve a class reference (base-class expr, ClassName use)
+        to ``(rel, cls_qual)``."""
+        if _depth > _ALIAS_DEPTH or not text:
+            return None
+        f = self.facts.get(rel)
+        if f is None:
+            return None
+        parts = text.split(".")
+        if len(parts) == 1:
+            if text in f["classes"]:
+                return rel, text
+            if text in f["aliases"]:
+                return self.resolve_class(rel, f["aliases"][text],
+                                          _depth + 1)
+            if text in f["imports"]:
+                return self._absolute_class(f["imports"][text])
+            return None
+        head = parts[0]
+        if head in f["aliases"]:
+            return self.resolve_class(
+                rel, ".".join([f["aliases"][head]] + parts[1:]),
+                _depth + 1)
+        if head in f["imports"]:
+            return self._absolute_class(
+                ".".join([f["imports"][head]] + parts[1:]))
+        return self._absolute_class(text)
+
+    def _absolute_class(self, dotted: str) -> Optional[Tuple[str, str]]:
+        parts = dotted.split(".")
+        for i in range(len(parts), 0, -1):
+            mod = ".".join(parts[:i])
+            rel = self.mod_rel.get(mod)
+            if rel is None:
+                continue
+            rest = ".".join(parts[i:])
+            if rest and rest in self.facts[rel]["classes"]:
+                return rel, rest
+            return None
+        return None
+
+    def resolve_method(self, rel: str, cls_qual: str, name: str,
+                       _seen=None) -> Optional[str]:
+        """Method lookup through the project-local MRO (DFS over bases,
+        cross-module, visited-guarded)."""
+        if _seen is None:
+            _seen = set()
+        if (rel, cls_qual) in _seen or len(_seen) > 32:
+            return None
+        _seen.add((rel, cls_qual))
+        c = self.class_fact(rel, cls_qual)
+        if c is None:
+            return None
+        q = c["methods"].get(name)
+        if q is not None:
+            return self.key(rel, q)
+        for base in c["bases"]:
+            hit = self.resolve_class(rel, base)
+            if hit is None:
+                continue
+            r = self.resolve_method(hit[0], hit[1], name, _seen)
+            if r is not None:
+                return r
+        return None
+
+    def is_subclass(self, rel: str, cls_qual: str, anc_rel: str,
+                    anc_qual: str, _seen=None) -> bool:
+        """True when (rel, cls_qual) is (anc_rel, anc_qual) or inherits
+        from it through project-local bases (cross-module, guarded)."""
+        if (rel, cls_qual) == (anc_rel, anc_qual):
+            return True
+        if _seen is None:
+            _seen = set()
+        if (rel, cls_qual) in _seen or len(_seen) > 64:
+            return False
+        _seen.add((rel, cls_qual))
+        c = self.class_fact(rel, cls_qual)
+        if c is None:
+            return False
+        for base in c["bases"]:
+            hit = self.resolve_class(rel, base)
+            if hit is not None and self.is_subclass(
+                    hit[0], hit[1], anc_rel, anc_qual, _seen):
+                return True
+        return False
+
+    def defining_class(self, rel: str, cls_qual: str,
+                       attr: str, _seen=None) -> Tuple[str, str]:
+        """The MRO class whose methods assign ``self.<attr>`` — the
+        canonical owner for lock identity (a base-class lock acquired
+        from two subclasses is ONE lock)."""
+        if _seen is None:
+            _seen = set()
+        if (rel, cls_qual) in _seen or len(_seen) > 32:
+            return rel, cls_qual
+        _seen.add((rel, cls_qual))
+        c = self.class_fact(rel, cls_qual)
+        if c is None:
+            return rel, cls_qual
+        if attr in c["attrs"]:
+            return rel, cls_qual
+        for base in c["bases"]:
+            hit = self.resolve_class(rel, base)
+            if hit is None:
+                continue
+            r2, q2 = self.defining_class(hit[0], hit[1], attr, _seen)
+            c2 = self.class_fact(r2, q2)
+            if c2 is not None and attr in c2["attrs"]:
+                return r2, q2
+        return rel, cls_qual
+
+    # -- edges + summaries -------------------------------------------------
+    def edges(self, key: str) -> List[Tuple[int, str, Optional[str]]]:
+        """Resolved call edges of one def: (line, text, target-key)."""
+        cached = self._edges.get(key)
+        if cached is not None:
+            return cached
+        d = self.def_fact(key)
+        out: List[Tuple[int, str, Optional[str]]] = []
+        if d is not None:
+            rel, qual = self.split(key)
+            for line, text in d["calls"]:
+                out.append((line, text, self.resolve(rel, qual, text)))
+        self._edges[key] = out
+        return out
+
+    def summarize(self, key: str, tag: str,
+                  direct: Callable[[str], Dict[str, int]],
+                  follow: Callable[[str], bool]) -> Dict[str, tuple]:
+        """Per-def hazard summary ``{name: (line, via_key|None)}``.
+
+        ``direct(key)`` yields the def's own hazards (name -> line);
+        ``follow(target_key)`` gates which resolved edges propagate.
+        One witness step per hazard; chains come from ``chain()``.
+        Memoized per tag; cycles contribute nothing on the back edge
+        (members still see each other's forward summaries)."""
+        memo = self._memos.setdefault(tag, {})
+
+        def go(k: str, stack: set, depth: int) -> Dict[str, tuple]:
+            if k in memo:
+                return memo[k]
+            if k in stack or depth > _SUMMARY_DEPTH:
+                return {}
+            out = {n: (ln, None) for n, ln in direct(k).items()}
+            stack.add(k)
+            for line, _text, tgt in self.edges(k):
+                if tgt is None or tgt == k or not follow(tgt):
+                    continue
+                for n in go(tgt, stack, depth + 1):
+                    out.setdefault(n, (line, tgt))
+            stack.discard(k)
+            memo[k] = out
+            return out
+
+        return go(key, set(), 0)
+
+    def chain(self, key: str, hazard: str, tag: str,
+              direct: Callable[[str], Dict[str, int]],
+              follow: Callable[[str], bool],
+              ) -> List[Tuple[str, str, int]]:
+        """Witness chain for a summarized hazard:
+        ``[(rel, qual, line), ...]`` from ``key`` down to the def
+        making the direct hazardous call."""
+        out: List[Tuple[str, str, int]] = []
+        k: Optional[str] = key
+        for _ in range(_SUMMARY_DEPTH + 1):
+            if k is None:
+                break
+            s = self.summarize(k, tag, direct, follow)
+            if hazard not in s:
+                break
+            line, nxt = s[hazard]
+            rel, qual = self.split(k)
+            out.append((rel, qual, line))
+            k = nxt
+        return out
+
+
+def build_graph(index) -> CallGraph:
+    """Extract (or cache-load) facts for every module in the index and
+    assemble the graph.  ``index`` is a ProjectIndex; its optional
+    ``cache_dir`` enables the persisted facts cache."""
+    t0 = time.perf_counter()
+    cache = None
+    cache_dir = getattr(index, "cache_dir", None)
+    if cache_dir:
+        cache = FactsCache(cache_dir)
+    hits = misses = 0
+    facts_by_rel: Dict[str, dict] = {}
+    for mod in index.modules():
+        key = getattr(mod, "stat_key", None)
+        if mod.rel in getattr(index, "overlay", {}):
+            key = None                # staged content: never cached
+        facts = cache.get(mod.rel, key) if cache else None
+        if facts is None:
+            facts = extract_facts(mod)
+            misses += 1
+            if cache is not None:
+                cache.put(mod.rel, key, facts)
+        else:
+            hits += 1
+        facts_by_rel[mod.rel] = facts
+    if cache is not None:
+        cache.save()
+    stats = {"files": len(facts_by_rel), "cache_hits": hits,
+             "cache_misses": misses,
+             "build_ms": round((time.perf_counter() - t0) * 1e3, 2)}
+    return CallGraph(facts_by_rel, stats)

@@ -71,6 +71,9 @@ class ModuleInfo:
     lines: List[str]
     tree: Optional[ast.Module]    # None on syntax error
     parse_error: Optional[str] = None
+    #: (mtime_ns, size) at read time — the facts-cache key; None for
+    #: overlay content (staged bytes have no stable on-disk identity)
+    stat_key: Optional[Tuple[int, int]] = None
 
 
 class ProjectIndex:
@@ -78,28 +81,42 @@ class ProjectIndex:
 
     ``modules()`` walks the roots; ``module(rel)`` parses any repo file
     on demand (flag_drift reads bench.py / profile scripts / tests this
-    way without widening every other pass's scope)."""
+    way without widening every other pass's scope).  ``call_graph()``
+    lazily builds the shared interprocedural graph; with ``cache_dir``
+    set, its per-file extraction facts persist across runs keyed on
+    (path, mtime, size) so a repeat run re-walks only changed files."""
 
     def __init__(self, base: str, roots: Sequence[str] = DEFAULT_ROOTS,
-                 overlay: Optional[Dict[str, str]] = None):
+                 overlay: Optional[Dict[str, str]] = None,
+                 cache_dir: Optional[str] = None):
         self.base = os.path.abspath(base)
         self.roots = tuple(roots)
         #: rel path -> source text that REPLACES the on-disk file (the
         #: pre-commit hook overlays staged INDEX content so a partially
         #: staged file is checked against the bytes being committed)
         self.overlay = dict(overlay or {})
+        self.cache_dir = cache_dir
         self._cache: Dict[str, Optional[ModuleInfo]] = {}
         self._modules: Optional[List[ModuleInfo]] = None
+        self._graph = None
 
     def module(self, rel: str) -> Optional[ModuleInfo]:
         if rel in self._cache:
             return self._cache[rel]
         path = os.path.join(self.base, rel)
         mi: Optional[ModuleInfo] = None
+        stat_key = None
         if rel in self.overlay:
             src = self.overlay[rel]
         else:
             try:
+                # stat BEFORE read: if a writer lands between the two,
+                # the key describes the older content and the next run
+                # simply misses — the reverse order could persist facts
+                # of the old bytes under the new key, a permanently
+                # stale cache entry
+                st = os.stat(path)
+                stat_key = (st.st_mtime_ns, st.st_size)
                 with open(path, encoding="utf-8") as f:
                     src = f.read()
             except OSError:
@@ -111,9 +128,18 @@ class ProjectIndex:
         except SyntaxError as e:
             tree, err = None, str(e)
         mi = ModuleInfo(path=path, rel=rel, source=src,
-                        lines=src.splitlines(), tree=tree, parse_error=err)
+                        lines=src.splitlines(), tree=tree, parse_error=err,
+                        stat_key=stat_key)
         self._cache[rel] = mi
         return mi
+
+    def call_graph(self):
+        """The shared interprocedural call graph (built once per run,
+        however many passes consume it)."""
+        if self._graph is None:
+            from .callgraph import build_graph
+            self._graph = build_graph(self)
+        return self._graph
 
     def modules(self) -> List[ModuleInfo]:
         # every pass calls this; the tree walk is memoized alongside
@@ -272,4 +298,6 @@ def run_analysis(index: ProjectIndex,
     report["total_findings"] = len(report["findings"])
     report["total_suppressed"] = sum(report["suppressions"].values())
     report["wall_ms"] = round(total_ms, 2)
+    if index._graph is not None:
+        report["callgraph"] = dict(index._graph.stats)
     return report

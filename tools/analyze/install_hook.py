@@ -1,16 +1,22 @@
 #!/usr/bin/env python
-"""Install the static-analysis sweep as a git pre-commit hook.
+"""Install the static-analysis sweep as git pre-commit/pre-push hooks.
 
-    python tools/analyze/install_hook.py             # install
+    python tools/analyze/install_hook.py             # install pre-commit
+    python tools/analyze/install_hook.py --pre-push  # + pre-push (CI twin)
     python tools/analyze/install_hook.py --uninstall # remove ours
     python tools/analyze/install_hook.py --force     # replace foreign hook
 
-The hook runs ``tools/analyze/run.py --staged`` — the full pass set
-over only the STAGED .py files inside the analysis roots — so findings
-land at commit time instead of in the next tier-1 run.  A commit with
-unsuppressed findings is blocked; annotate with
+The pre-commit hook runs ``tools/analyze/run.py --staged`` — the full
+pass set over the whole tree, findings gated to the STAGED .py files —
+so findings land at commit time instead of in the next tier-1 run.
+The optional pre-push hook runs ``run.py --changed <remote>..<local>``
+per pushed ref: the same incremental report CI runs, catching commits
+made with ``--no-verify`` before they leave the machine.  A hook
+failure blocks the commit/push; annotate with
 ``# analysis-ok(<pass>): <reason>`` (see ANALYSIS.md) or fix the
-hazard.  ``git commit --no-verify`` bypasses in an emergency.
+hazard.  ``git commit/push --no-verify`` bypasses in an emergency.
+Repeat runs reuse the persisted ``.analyze_cache/`` facts, so the
+hook's cost is one tree walk plus the changed files' re-extraction.
 
 The installer refuses to overwrite a pre-existing hook it did not
 write (``--force`` replaces it), and uninstall removes only our own.
@@ -34,6 +40,34 @@ exec "${{ANALYZE_PYTHON:-python3}}" \\
     "$repo_root/tools/analyze/run.py" --staged --base "$repo_root"
 """
 
+PUSH_HOOK = f"""#!/bin/sh
+{MARKER}
+# Static-analysis sweep over the commits being pushed (the CI report,
+# run locally). Bypass in an emergency: git push --no-verify
+repo_root=$(git rev-parse --show-toplevel) || exit 0
+status=0
+while read local_ref local_sha remote_ref remote_sha; do
+    # branch deletion: nothing outgoing to analyze
+    case "$local_sha" in *[!0]*) ;; *) continue ;; esac
+    if case "$remote_sha" in *[!0]*) false ;; esac; then
+        # new remote branch: no base to diff against — full sweep
+        range=""
+    else
+        range="$remote_sha..$local_sha"
+    fi
+    if [ -n "$range" ]; then
+        "${{ANALYZE_PYTHON:-python3}}" \\
+            "$repo_root/tools/analyze/run.py" \\
+            --changed "$range" --base "$repo_root" || status=1
+    else
+        "${{ANALYZE_PYTHON:-python3}}" \\
+            "$repo_root/tools/analyze/run.py" \\
+            --base "$repo_root" || status=1
+    fi
+done
+exit $status
+"""
+
 
 def _git_dir(base: str) -> str:
     r = subprocess.run(["git", "rev-parse", "--git-dir"], cwd=base,
@@ -49,9 +83,14 @@ def main(argv=None) -> int:
         os.path.dirname(os.path.abspath(__file__)))),
         help="repo root (default: two levels up from this file)")
     ap.add_argument("--force", action="store_true",
-                    help="replace a pre-existing foreign pre-commit hook")
+                    help="replace a pre-existing foreign hook")
     ap.add_argument("--uninstall", action="store_true",
-                    help="remove the hook if (and only if) we installed it")
+                    help="remove the hook(s) if (and only if) we "
+                         "installed them")
+    ap.add_argument("--pre-push", action="store_true", dest="pre_push",
+                    help="also install the pre-push hook (run.py "
+                         "--changed over each pushed ref — the CI "
+                         "report, locally)")
     args = ap.parse_args(argv)
 
     try:
@@ -61,36 +100,49 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 1
     os.makedirs(hooks_dir, exist_ok=True)
-    hook_path = os.path.join(hooks_dir, "pre-commit")
-    existing = None
-    if os.path.exists(hook_path):
-        with open(hook_path, encoding="utf-8", errors="replace") as f:
-            existing = f.read()
 
-    if args.uninstall:
-        if existing is None:
-            print("no pre-commit hook installed")
-            return 0
-        if MARKER not in existing:
-            print(f"{hook_path} was not installed by this tool; "
-                  f"refusing to remove it", file=sys.stderr)
-            return 1
-        os.unlink(hook_path)
-        print(f"removed {hook_path}")
-        return 0
+    hooks = [("pre-commit", HOOK,
+              "runs `tools/analyze/run.py --staged` on every commit")]
+    if args.pre_push or args.uninstall:
+        hooks.append(("pre-push", PUSH_HOOK,
+                      "runs `tools/analyze/run.py --changed "
+                      "<remote>..<local>` on every push"))
 
-    if existing is not None and MARKER not in existing and not args.force:
-        print(f"{hook_path} already exists and was not installed by "
-              f"this tool; re-run with --force to replace it",
-              file=sys.stderr)
-        return 1
-    with open(hook_path, "w") as f:
-        f.write(HOOK)
-    os.chmod(hook_path, os.stat(hook_path).st_mode | stat.S_IXUSR
-             | stat.S_IXGRP | stat.S_IXOTH)
-    print(f"installed {hook_path} (runs `tools/analyze/run.py --staged` "
-          f"on every commit; bypass with --no-verify)")
-    return 0
+    rc = 0
+    for name, content, blurb in hooks:
+        hook_path = os.path.join(hooks_dir, name)
+        existing = None
+        if os.path.exists(hook_path):
+            with open(hook_path, encoding="utf-8", errors="replace") as f:
+                existing = f.read()
+
+        if args.uninstall:
+            if existing is None:
+                print(f"no {name} hook installed")
+                continue
+            if MARKER not in existing:
+                print(f"{hook_path} was not installed by this tool; "
+                      f"refusing to remove it", file=sys.stderr)
+                rc = 1
+                continue
+            os.unlink(hook_path)
+            print(f"removed {hook_path}")
+            continue
+
+        if existing is not None and MARKER not in existing \
+                and not args.force:
+            print(f"{hook_path} already exists and was not installed "
+                  f"by this tool; re-run with --force to replace it",
+                  file=sys.stderr)
+            rc = 1
+            continue
+        with open(hook_path, "w") as f:
+            f.write(content)
+        os.chmod(hook_path, os.stat(hook_path).st_mode | stat.S_IXUSR
+                 | stat.S_IXGRP | stat.S_IXOTH)
+        print(f"installed {hook_path} ({blurb}; bypass with "
+              f"--no-verify)")
+    return rc
 
 
 if __name__ == "__main__":

@@ -9,7 +9,8 @@ Adding a pass (see ANALYSIS.md):
    finds — the whole-tree tier-1 sweep must stay at zero.
 """
 from . import (async_blocking, flag_drift, format_gate, jit_hazards,
-               layering, lock_held_await, shared_state_races,
+               layering, lock_held_await, lock_order,
+               resource_balance, shared_state_races,
                unawaited_coroutine)
 
 ALL_PASSES = (
@@ -21,6 +22,8 @@ ALL_PASSES = (
     unawaited_coroutine.PASS,
     format_gate.PASS,
     layering.PASS,
+    lock_order.PASS,
+    resource_balance.PASS,
 )
 
 _BY_ID = {p.id: p for p in ALL_PASSES}

@@ -1,21 +1,42 @@
-"""Pass: blocking calls lexically inside ``async def`` bodies.
+"""Pass: blocking calls inside ``async def`` bodies — direct AND
+reached transitively through sync helpers.
 
 The scheduler (yugabyte_db_tpu/sched/) multiplexes every lane's
 dispatch over one event loop, so a synchronous stall inside an async
 handler no longer slows one RPC — it freezes admission, batching
 windows, Raft heartbeats and lease renewal for the whole server.
 
-Generalizes the original tools/check_blocking.py pass (tserver/ + rpc/
-only; time.sleep / open / os.fsync) to the whole tree with a wider
-offender set.  Nested sync ``def`` bodies are NOT flagged — they are
-frequently executor targets; nested async defs get their own scan.
+Two layers:
+
+1. LEXICAL (the original pass): a blocking dotted call written
+   directly in an async def body.  Nested sync ``def`` bodies are NOT
+   flagged — they are frequently executor targets; nested async defs
+   get their own scan.
+2. TRANSITIVE (call-graph powered): a call from an async def that
+   resolves to a *sync* project def whose bounded-depth summary
+   contains a blocking call — the ``async def handler():
+   self._cleanup()`` / ``def _cleanup(): shutil.rmtree(...)`` shape
+   the lexical layer was blind to.  The finding lands on the call line
+   in the async def and reports the full helper chain.  Propagation
+   follows only SYNC callees (an awaited async callee is scanned on
+   its own), and a blocking call already suppressed at its own line
+   (``analysis-ok(async_blocking)`` / ``blocking-ok``) is an
+   acknowledged bounded stall — it does not taint its callers.
+
+Transitive propagation uses the STRONG blocker set (sleeps, fsync,
+subprocess, socket resolvers, tree copies/removals, cross-FS renames).
+Bare ``open``/``io.open`` stay lexical-only: one helper opening a tiny
+metadata file is the repo's accepted idiom (13 annotated sites), and
+propagating it would make every config-reading helper taint every
+caller — the signal drowns.  ANALYSIS.md documents the split.
 """
 from __future__ import annotations
 
 import ast
-from typing import List
+from typing import Dict, List, Set
 
-from ..core import AnalysisPass, Finding, ModuleInfo, ProjectIndex, call_name
+from ..core import (AnalysisPass, Finding, ModuleInfo, ProjectIndex,
+                    call_name, is_suppressed)
 
 #: dotted call names that stall the loop.  Name-based on purpose: the
 #: analyzer never imports the code it checks.  `open` covers the sync
@@ -33,6 +54,11 @@ BLOCKING = {
     "os.replace", "os.rename",
 }
 
+#: the subset that taints callers transitively — unbounded or
+#: device/network stalls.  `open`/`io.open` are deliberately absent
+#: (see module docstring).
+TRANSITIVE_BLOCKING = BLOCKING - {"open", "io.open"}
+
 _HINTS = {
     "time.sleep": "use `await asyncio.sleep(...)`",
     "open": "wrap in `run_in_executor` for anything non-trivial",
@@ -45,6 +71,20 @@ _DEFAULT_HINT = ("move the call into `run_in_executor`, or annotate "
                  "is genuinely bounded")
 
 
+def render_chain(graph, start_text: str, hops, hazard: str) -> str:
+    """``helper() -> _cleanup (storage/lsm.py:93) -> shutil.rmtree``:
+    the witness path from the async-side call down to the direct
+    blocking call."""
+    parts = [f"{start_text}()"]
+    for i in range(1, len(hops)):
+        # hop i is named at the line in hop i-1 that calls it
+        parts.append(f"{hops[i][1]} ({hops[i - 1][0]}:{hops[i - 1][2]})")
+    last = hops[-1] if hops else None
+    tail = f"{hazard} ({last[0]}:{last[2]})" if last else hazard
+    parts.append(tail)
+    return " -> ".join(parts)
+
+
 class AsyncBlockingPass(AnalysisPass):
     id = "async_blocking"
     title = "blocking call inside async def"
@@ -55,8 +95,10 @@ class AsyncBlockingPass(AnalysisPass):
         for mod in index.modules():
             if mod.tree is not None:
                 self.scan_module(mod, out)
+        self._scan_transitive(index, out)
         return out
 
+    # --- layer 1: lexical -------------------------------------------------
     def scan_module(self, mod: ModuleInfo, out: List[Finding]) -> None:
         for node in ast.walk(mod.tree):
             if isinstance(node, ast.AsyncFunctionDef):
@@ -79,6 +121,56 @@ class AsyncBlockingPass(AnalysisPass):
                     hint=_HINTS.get(name, _DEFAULT_HINT)))
         for child in ast.iter_child_nodes(node):
             self._scan(mod, child, out)
+
+    # --- layer 2: transitive (call graph) ---------------------------------
+    def _scan_transitive(self, index: ProjectIndex,
+                         out: List[Finding]) -> None:
+        graph = index.call_graph()
+
+        def direct(key: str) -> Dict[str, int]:
+            d = graph.def_fact(key)
+            if d is None:
+                return {}
+            rel, _ = graph.split(key)
+            mod = index.module(rel)
+            hits: Dict[str, int] = {}
+            for line, text in d["calls"]:
+                if text in TRANSITIVE_BLOCKING and text not in hits \
+                        and mod is not None \
+                        and not is_suppressed(mod, line, self.id):
+                    hits[text] = line
+            return hits
+
+        def follow(key: str) -> bool:
+            return not graph.is_async(key)
+
+        seen: Set[tuple] = set()
+        for key, d in graph.defs():
+            if not d["async"]:
+                continue
+            rel, qual = graph.split(key)
+            mod = index.module(rel)
+            if mod is None:
+                continue
+            for line, text, tgt in graph.edges(key):
+                if tgt is None or graph.is_async(tgt):
+                    continue
+                summ = graph.summarize(tgt, self.id, direct, follow)
+                for bname in sorted(summ):
+                    sig = (rel, line, bname)
+                    if sig in seen:
+                        continue
+                    seen.add(sig)
+                    hops = graph.chain(tgt, bname, self.id, direct,
+                                       follow)
+                    out.append(self.finding(
+                        mod, line,
+                        f"blocking call `{bname}` reached from async "
+                        f"def `{d['name']}` via sync call chain: "
+                        f"{render_chain(graph, text, hops, bname)}",
+                        detail=bname,
+                        hint=_HINTS.get(bname, _DEFAULT_HINT)))
+        return
 
 
 PASS = AsyncBlockingPass()

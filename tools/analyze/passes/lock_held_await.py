@@ -1,4 +1,4 @@
-"""Pass: ``await`` while holding a SYNC lock.
+"""Pass: the event loop must not be parked while a SYNC lock is held.
 
 Inside an async function, ``with self._lock: ... await ...`` parks the
 coroutine while a *threading* lock stays held.  Every other task on the
@@ -8,17 +8,27 @@ arbitrary suspension point.  ``async with`` on an asyncio.Lock is the
 correct spelling and is not flagged — awaiting under an async lock is
 the normal cooperative pattern.
 
-The pass is lexical: an ``await`` anywhere inside a sync ``with``
-statement whose context expression looks like a lock (terminal name
-matches lock/mutex/rlock), stopping at nested function boundaries.
+Two layers:
+
+1. LEXICAL: an ``await`` anywhere inside a sync ``with`` statement
+   whose context expression looks like a lock (terminal name matches
+   lock/mutex/rlock), stopping at nested function boundaries.
+2. TRANSITIVE (call-graph powered): a call under a held sync lock that
+   resolves to a sync project def whose bounded-depth summary contains
+   a STRONG blocking call (the ``async_blocking`` transitive set).
+   A sync helper cannot await, but it CAN stall the whole loop with
+   the lock held — every contender then queues behind a device/network
+   stall instead of a few bytecodes.  The finding reports the helper
+   chain; a blocking call suppressed at its own line does not taint.
 """
 from __future__ import annotations
 
 import ast
-from typing import List
+from typing import Dict, List, Optional, Set
 
 from ..core import (AnalysisPass, Finding, ModuleInfo, ProjectIndex,
-                    is_lockish)
+                    call_name, is_lockish, is_suppressed)
+from .async_blocking import TRANSITIVE_BLOCKING, render_chain
 
 
 class LockHeldAwaitPass(AnalysisPass):
@@ -29,17 +39,43 @@ class LockHeldAwaitPass(AnalysisPass):
 
     def run(self, index: ProjectIndex) -> List[Finding]:
         out: List[Finding] = []
+        from ..callgraph import iter_defs
+        graph = index.call_graph()
+
+        def direct(key: str) -> Dict[str, int]:
+            d = graph.def_fact(key)
+            if d is None:
+                return {}
+            rel, _ = graph.split(key)
+            m = index.module(rel)
+            hits: Dict[str, int] = {}
+            for line, text in d["calls"]:
+                if text in TRANSITIVE_BLOCKING and text not in hits \
+                        and m is not None \
+                        and not is_suppressed(m, line, "async_blocking") \
+                        and not is_suppressed(m, line, self.id):
+                    hits[text] = line
+            return hits
+
+        def follow(key: str) -> bool:
+            return not graph.is_async(key)
+
         for mod in index.modules():
             if mod.tree is None:
                 continue
-            for node in ast.walk(mod.tree):
-                if isinstance(node, ast.AsyncFunctionDef):
-                    for stmt in node.body:
-                        self._scan(mod, stmt, None, out)
+            for qual, _cls, node in iter_defs(mod.tree):
+                if not isinstance(node, ast.AsyncFunctionDef):
+                    continue
+                for stmt in node.body:
+                    self._scan(mod, stmt, None, qual, graph, direct,
+                               follow, out)
         return out
 
-    def _scan(self, mod: ModuleInfo, node: ast.AST, held: str,
-              out: List[Finding]) -> None:
+    def _scan(self, mod: ModuleInfo, node: ast.AST, held: Optional[str],
+              qual: str, graph, direct, follow,
+              out: List[Finding], _seen: Optional[Set] = None) -> None:
+        if _seen is None:
+            _seen = set()
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.Lambda)):
             return      # a nested function's awaits run on its own call
@@ -48,9 +84,11 @@ class LockHeldAwaitPass(AnalysisPass):
                        if is_lockish(i.context_expr)]
             inner = held or (lockish[0] if lockish else None)
             for item in node.items:     # `with await acquire():` edge
-                self._scan(mod, item, held, out)
+                self._scan(mod, item, held, qual, graph, direct, follow,
+                           out, _seen)
             for child in node.body:
-                self._scan(mod, child, inner, out)
+                self._scan(mod, child, inner, qual, graph, direct,
+                           follow, out, _seen)
             return
         if isinstance(node, ast.Await) and held is not None:
             out.append(self.finding(
@@ -59,8 +97,36 @@ class LockHeldAwaitPass(AnalysisPass):
                 f"contending on it will block the event loop",
                 detail=held))
             # keep walking: the awaited expression may nest more awaits
+        if isinstance(node, ast.Call) and held is not None:
+            self._check_call(mod, node, held, qual, graph, direct,
+                             follow, out, _seen)
         for child in ast.iter_child_nodes(node):
-            self._scan(mod, child, held, out)
+            self._scan(mod, child, held, qual, graph, direct, follow,
+                       out, _seen)
+
+    def _check_call(self, mod: ModuleInfo, node: ast.Call, held: str,
+                    qual: str, graph, direct, follow, out: List[Finding],
+                    _seen: Set) -> None:
+        text = call_name(node)
+        if not text:
+            return
+        tgt = graph.resolve(mod.rel, qual, text)
+        if tgt is None or graph.is_async(tgt):
+            return
+        summ = graph.summarize(tgt, "lock_held_blocking", direct, follow)
+        for bname in sorted(summ):
+            sig = (mod.rel, node.lineno, held, bname)
+            if sig in _seen:
+                continue
+            _seen.add(sig)
+            hops = graph.chain(tgt, bname, "lock_held_blocking",
+                               direct, follow)
+            out.append(self.finding(
+                mod, node.lineno,
+                f"blocking call `{bname}` reached while holding sync "
+                f"lock `{held}` — every contender queues behind the "
+                f"stall: {render_chain(graph, text, hops, bname)}",
+                detail=f"{held}->{bname}"))
 
 
 PASS = LockHeldAwaitPass()

@@ -1,0 +1,301 @@
+"""Pass: lock-acquisition-order cycles — the deadlock shape the
+compaction-executor / async-handler overlap keeps inviting.
+
+If one code path acquires lock A then (still holding A) lock B, and
+another path acquires B then A, the two paths deadlock the moment they
+interleave — a sync pair across two executor threads wedges both
+threads; a sync lock on the event loop against an executor thread
+wedges the WHOLE server (every lane's dispatch shares that loop).
+The order relation is global and crosses function boundaries, so no
+lexical pass can see it; this one builds the project-wide
+lock-acquisition-order graph and flags every cycle.
+
+How it works:
+
+1. ACQUISITION SITES — every ``with <lock>:`` / ``async with <lock>:``
+   whose context expression looks like a lock (core.is_lockish).
+   Sync and async locks both participate: an asyncio.Lock cycle
+   deadlocks tasks exactly like a threading.Lock cycle deadlocks
+   threads.
+2. LOCK IDENTITY — ``self.X`` normalizes to the MRO class that
+   assigns ``self.X`` (a base-class lock acquired from two subclasses
+   is ONE lock; same-named attrs on unrelated classes are different
+   locks); module globals normalize through the import table; any
+   expression that is not a plain name chain (``self._locks[k]``) is
+   scoped to its function so textual coincidence across functions can
+   never fabricate an edge.
+3. EDGES — acquiring B while A is held adds A->B.  Lexical nesting
+   gives direct edges; a CALL made while holding A adds A->B for
+   every lock B in the callee's bounded-depth transitive acquisition
+   summary (sync and async callees both followed).
+4. CYCLES — strongly connected components of the order graph; every
+   SCC with two or more locks produces one finding anchored at its
+   first acquisition edge, with the full cycle and each edge's
+   acquisition site in the message.  Self-edges are NOT flagged:
+   re-acquiring the same name is usually an RLock and name-based
+   analysis cannot tell (documented limit).
+
+Suppression anchors at the reported acquisition line:
+``# analysis-ok(lock_order): <reason>``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..core import (AnalysisPass, Finding, ModuleInfo, ProjectIndex,
+                    call_name, is_lockish)
+
+_PLAIN = frozenset("abcdefghijklmnopqrstuvwxyz"
+                   "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789._")
+
+
+class _Edge:
+    __slots__ = ("rel", "line", "qual", "via")
+
+    def __init__(self, rel: str, line: int, qual: str,
+                 via: Optional[str]):
+        self.rel = rel          # module of the acquisition that closed
+        self.line = line        # the edge (the B-acquire site)
+        self.qual = qual        # def it happens in
+        self.via = via          # call text when the edge is transitive
+
+
+class LockOrderPass(AnalysisPass):
+    id = "lock_order"
+    title = "lock-acquisition-order cycle (deadlock)"
+    hint = ("acquire the locks in one global order everywhere (or "
+            "collapse the pair into a single lock); see the cycle "
+            "sites in the message")
+
+    def run(self, index: ProjectIndex) -> List[Finding]:
+        from ..callgraph import iter_defs
+        graph = index.call_graph()
+        #: def key -> {lock_id: first-acquisition line}
+        def_locks: Dict[str, Dict[str, int]] = {}
+        #: (a, b) -> first witness edge
+        edges: Dict[Tuple[str, str], _Edge] = {}
+        #: deferred transitive checks: (key, line, text, held-snapshot)
+        pending: List[Tuple[str, int, str, Tuple[str, ...]]] = []
+
+        for mod in index.modules():
+            if mod.tree is None:
+                continue
+            for qual, _cls, node in iter_defs(mod.tree):
+                key = graph.key(mod.rel, qual)
+                acq = def_locks.setdefault(key, {})
+                self._scan_def(graph, mod, qual, node, acq, edges,
+                               pending)
+
+        def direct(key: str) -> Dict[str, int]:
+            return def_locks.get(key, {})
+
+        def follow(key: str) -> bool:
+            return True          # async callees order locks too
+
+        for key, line, text, held in pending:
+            rel, qual = graph.split(key)
+            tgt = graph.resolve(rel, qual, text)
+            if tgt is None:
+                continue
+            summ = graph.summarize(tgt, self.id, direct, follow)
+            for lid in summ:
+                for h in held:
+                    if h != lid and (h, lid) not in edges:
+                        edges[(h, lid)] = _Edge(rel, line, qual, text)
+
+        return self._cycle_findings(index, edges)
+
+    # --- per-def lexical scan ---------------------------------------------
+    def _scan_def(self, graph, mod: ModuleInfo, qual: str, node,
+                  acq: Dict[str, int],
+                  edges: Dict[Tuple[str, str], _Edge],
+                  pending: List) -> None:
+        key = graph.key(mod.rel, qual)
+
+        def walk(n: ast.AST, held: Tuple[str, ...]) -> None:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef, ast.Lambda)):
+                return
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                inner = held
+                for item in n.items:
+                    walk(item.context_expr, inner)
+                    if is_lockish(item.context_expr):
+                        lid = self._lock_id(graph, mod.rel, qual,
+                                            item.context_expr)
+                        if lid not in acq:
+                            acq[lid] = n.lineno
+                        for h in inner:
+                            if h != lid and (h, lid) not in edges:
+                                edges[(h, lid)] = _Edge(
+                                    mod.rel, n.lineno, qual, None)
+                        if lid not in inner:
+                            inner = inner + (lid,)
+                for child in n.body:
+                    walk(child, inner)
+                return
+            if isinstance(n, ast.Call) and held:
+                text = call_name(n)
+                if text:
+                    pending.append((key, n.lineno, text, held))
+            for c in ast.iter_child_nodes(n):
+                walk(c, held)
+
+        for stmt in node.body:
+            walk(stmt, ())
+
+    # --- lock identity ----------------------------------------------------
+    def _lock_id(self, graph, rel: str, def_qual: str,
+                 expr: ast.expr) -> str:
+        text = ast.unparse(expr)
+        if not set(text) <= _PLAIN:
+            # subscripts / calls / anything computed: function-scoped,
+            # so textual coincidence across functions can't alias
+            return f"{rel}::{def_qual}:{text}"
+        parts = text.split(".")
+        f = graph.facts.get(rel)
+        if parts[0] in ("self", "cls"):
+            cls = None
+            if f is not None:
+                d = f["defs"].get(def_qual)
+                cls = (d["cls"] if d and d["cls"]
+                       else graph._enclosing_class(rel, def_qual))
+            if cls is None:
+                return f"{rel}::{def_qual}:{text}"
+            if len(parts) == 2:
+                r2, c2 = graph.defining_class(rel, cls, parts[1])
+                return f"{r2}::{c2}.{parts[1]}"
+            return f"{rel}::{cls}.{'.'.join(parts[1:])}"
+        if len(parts) == 1:
+            if f is not None and parts[0] in f["globals"]:
+                return f"{rel}::{parts[0]}"
+            return f"{rel}::{def_qual}:{parts[0]}"
+        if f is not None and parts[0] in f["imports"]:
+            target = f["imports"][parts[0]] + "." + ".".join(parts[1:])
+            tparts = target.split(".")
+            for i in range(len(tparts) - 1, 0, -1):
+                rel2 = graph.mod_rel.get(".".join(tparts[:i]))
+                if rel2 is not None:
+                    return f"{rel2}::{'.'.join(tparts[i:])}"
+        return f"{rel}::{text}"
+
+    # --- cycle detection --------------------------------------------------
+    def _cycle_findings(self, index: ProjectIndex,
+                        edges: Dict[Tuple[str, str], _Edge],
+                        ) -> List[Finding]:
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in edges:
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        for v in adj.values():
+            v.sort()
+        sccs = _tarjan(adj)
+        out: List[Finding] = []
+        for comp in sorted((sorted(c) for c in sccs if len(c) > 1)):
+            cyc = _find_cycle(comp, adj)
+            if not cyc:
+                continue
+            cyc_edges = [(cyc[i], cyc[(i + 1) % len(cyc)])
+                         for i in range(len(cyc))]
+            witnesses = [edges[e] for e in cyc_edges if e in edges]
+            if not witnesses:
+                continue
+            anchor = min(witnesses, key=lambda w: (w.rel, w.line))
+            mod = index.module(anchor.rel)
+            if mod is None:
+                continue
+            steps = []
+            for (a, b), w in zip(cyc_edges, witnesses):
+                via = f" via {w.via}()" if w.via else ""
+                steps.append(f"`{_short(a)}` -> `{_short(b)}` "
+                             f"({w.rel}:{w.line} in {w.qual}{via})")
+            out.append(self.finding(
+                mod, anchor.line,
+                "lock-order cycle — these paths deadlock when they "
+                "interleave: " + "; ".join(steps),
+                detail=" -> ".join(_short(x) for x in
+                                   cyc + [cyc[0]])))
+        return out
+
+
+def _short(lock_id: str) -> str:
+    return lock_id.split("::", 1)[-1]
+
+
+def _tarjan(adj: Dict[str, List[str]]) -> List[List[str]]:
+    """Iterative Tarjan SCC (the lock graph is small, but no pass may
+    depend on the recursion limit)."""
+    idx: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on: Dict[str, bool] = {}
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    for root in sorted(adj):
+        if root in idx:
+            continue
+        work = [(root, iter(adj[root]))]
+        idx[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on[root] = True
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in idx:
+                    idx[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on[w] = True
+                    work.append((w, iter(adj[w])))
+                    advanced = True
+                    break
+                if on.get(w):
+                    low[v] = min(low[v], idx[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == idx[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on[w] = False
+                    comp.append(w)
+                    if w == v:
+                        break
+                sccs.append(comp)
+    return sccs
+
+
+def _find_cycle(comp: List[str],
+                adj: Dict[str, List[str]]) -> List[str]:
+    """One simple cycle through an SCC, starting at its smallest
+    member (deterministic)."""
+    comp_set = set(comp)
+    start = comp[0]
+    path: List[str] = [start]
+    seen = {start}
+
+    def dfs(v: str) -> Optional[List[str]]:
+        for w in adj.get(v, ()):
+            if w == start and len(path) > 1:
+                return list(path)
+            if w in comp_set and w not in seen:
+                seen.add(w)
+                path.append(w)
+                r = dfs(w)
+                if r is not None:
+                    return r
+                path.pop()
+        return None
+
+    return dfs(start) or []
+
+
+PASS = LockOrderPass()

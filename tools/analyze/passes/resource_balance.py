@@ -1,0 +1,412 @@
+"""Pass: acquire/release balance for leases, handles and gauges.
+
+The bypass engine's isolation numbers rest on a refcount discipline:
+``LsmStore.pin_ssts`` defers physical SST deletion until the lease is
+released, so a leaked ``SstLease`` pins compacted gigabytes FOREVER —
+no crash, no error, just disk that never comes back (the PR-7 lease
+sweeper only covers process death, not a live leak).  The same shape
+applies to raw ``open``/``mmap.mmap`` handles held by long-running
+server code, and to +=/-= gauge pairs (in-flight counters) whose early
+return skews admission decisions from then on.
+
+Flow-sensitive, per function, per acquired name:
+
+- ACQUIRE: ``x = <recv>.pin_ssts(...)`` (released by ``x.release()``),
+  ``x = open/io.open/os.fdopen/mmap.mmap(...)`` (released by
+  ``x.close()``).  A ``with ... as x`` acquisition is owned by the
+  context manager and exempt; ``with x:`` / ``with
+  contextlib.closing(x):`` later counts as a release.
+- OWNERSHIP TRANSFER: ``return``/``yield`` of the binding is a
+  transfer on THAT exit (other exits still must release); a binding
+  that escapes the function — stored into an attribute/subscript/
+  container, passed as a call argument, captured by a nested
+  def/lambda, or rebound — disowns the whole analysis (the receiver's
+  balance is its own function's problem).  A ``pin_ssts`` result that
+  is DISCARDED outright is always a leak.
+- EXITS: per the lease contract, every acquire must reach a release on
+  all NON-RAISING exits: a ``return`` between acquire and release, or
+  falling off the end of the function still holding, is a finding.
+  Raising exits are exempt (callers of raising code clean up via the
+  crash sweep / context managers); a release inside a ``finally``
+  covers every exit of its try, returns included.
+- GAUGES: when ONE function both increments and decrements the same
+  ``+=``/``-=`` target (``self._inflight += 1 ... -= 1``), a return
+  between the two that skips the decrement is flagged.  Functions that
+  only increment (monotonic stats counters like KEY_REBUILD_STATS)
+  are not paired and never flag.  Only attribute/subscript targets
+  participate (a bare local counter dies with the frame — parser depth
+  counters are not gauges), and the flagged return must jump OVER a
+  decrement later in source order (a return behind every decrement —
+  cache-eviction accounting — skips nothing).
+
+Known limits (by design): conditional aliasing and cross-function
+hand-off protocols other than the escape forms above are not tracked;
+loops are walked once (no fixpoint); generators are skipped wholesale
+(their frames outlive any lexical exit).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from ..core import (AnalysisPass, Finding, ModuleInfo, ProjectIndex,
+                    call_name)
+
+#: leaf method names that acquire (matched on any receiver — the
+#: receiver's type is unknowable without imports) -> release method
+_ACQUIRE_METHODS = {"pin_ssts": ("release", "close")}
+#: dotted callables that acquire -> release method
+_ACQUIRE_CALLS = {"open": ("close",), "io.open": ("close",),
+                  "os.fdopen": ("close",), "mmap.mmap": ("close",)}
+#: acquire calls whose DISCARDED result is always a leak (a dropped
+#: file handle is closed by CPython's refcounting; a dropped lease
+#: pins SSTs until process exit)
+_NEVER_DISCARD = {"pin_ssts"}
+
+_HELD, _RELEASED = "held", "released"
+_COMPOUND = (ast.If, ast.Try, ast.For, ast.AsyncFor, ast.While,
+             ast.With, ast.AsyncWith)
+
+
+def _acquire_info(call: ast.Call) -> Optional[Tuple[str, tuple]]:
+    """(kind, release-methods) when this call acquires a resource."""
+    name = call_name(call)
+    if not name:
+        return None
+    if name in _ACQUIRE_CALLS:
+        return name, _ACQUIRE_CALLS[name]
+    leaf = name.rsplit(".", 1)[-1]
+    if leaf in _ACQUIRE_METHODS and "." in name:
+        return leaf, _ACQUIRE_METHODS[leaf]
+    return None
+
+
+class _Tracker:
+    """Flow walk for ONE acquisition: reports non-raising exits that
+    skip the release.  ``leaf_*`` callbacks classify simple
+    statements; compound statements are structured here so a release
+    in one branch never masks a leak in the other."""
+
+    def __init__(self, var: Optional[str], leaf_release, leaf_escape,
+                 returns_transfer):
+        self.var = var
+        self.leaf_release = leaf_release     # leaf stmt -> bool
+        self.leaf_escape = leaf_escape       # leaf stmt -> bool
+        self.returns_transfer = returns_transfer   # Return -> bool
+        self.escaped = False
+        self.leaks: List[Tuple[int, str]] = []
+
+    def block(self, stmts, state: str, fin: bool) -> str:
+        for s in stmts:
+            if self.escaped:
+                return _RELEASED
+            state = self.stmt(s, state, fin)
+        return state
+
+    def stmt(self, s: ast.stmt, state: str, fin: bool) -> str:
+        if isinstance(s, ast.Return):
+            if not self.returns_transfer(s) \
+                    and state == _HELD and not fin:
+                self.leaks.append((s.lineno, "return"))
+            return _RELEASED          # flow ends here; statements after
+            #                           this exit (sibling branches,
+            #                           fall-through) judge themselves
+        if isinstance(s, ast.Raise):
+            return _RELEASED          # raising exits are exempt AND
+            #                           terminate the path
+        if isinstance(s, ast.If):
+            s1 = self.block(s.body, state, fin)
+            s2 = self.block(s.orelse, state, fin)
+            if s1 == s2:
+                return s1
+            return _HELD if _HELD in (s1, s2) else state
+        if isinstance(s, (ast.For, ast.AsyncFor, ast.While)):
+            s1 = self.block(s.body, state, fin)
+            s2 = self.block(s.orelse, s1, fin)
+            return _HELD if _HELD in (s1, s2, state) else state
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            for item in s.items:
+                if self._with_releases(item.context_expr):
+                    self.block(s.body, _RELEASED, fin)
+                    return _RELEASED
+            return self.block(s.body, state, fin)
+        if isinstance(s, ast.Try):
+            fin_rel = any(self._contains_release(fs)
+                          for fs in s.finalbody)
+            covers = fin or fin_rel
+            st = self.block(s.body, state, covers)
+            st = self.block(s.orelse, st, covers)
+            for h in s.handlers:
+                self.block(h.body, state, covers)
+            st = self.block(s.finalbody, st, fin)
+            return _RELEASED if fin_rel else st
+        # leaf statements (incl. nested defs: capture check)
+        if self.leaf_escape(s):
+            self.escaped = True
+            return _RELEASED
+        if self.leaf_release(s):
+            return _RELEASED
+        return state
+
+    def _contains_release(self, s: ast.stmt) -> bool:
+        if isinstance(s, _COMPOUND):
+            kids = [c for c in ast.iter_child_nodes(s)
+                    if isinstance(c, (ast.stmt, ast.ExceptHandler))]
+            return any(self._contains_release(k) for k in kids)
+        if isinstance(s, ast.ExceptHandler):
+            return any(self._contains_release(k) for k in s.body)
+        return self.leaf_release(s)
+
+    def _with_releases(self, expr: ast.expr) -> bool:
+        if self.var is None:
+            return False
+        if isinstance(expr, ast.Name) and expr.id == self.var:
+            return True
+        if isinstance(expr, ast.Call) and expr.args:
+            a = expr.args[0]
+            if isinstance(a, ast.Name) and a.id == self.var \
+                    and call_name(expr).rsplit(".", 1)[-1] == "closing":
+                return True
+        return False
+
+
+class ResourceBalancePass(AnalysisPass):
+    id = "resource_balance"
+    title = "unbalanced acquire/release (lease, handle or gauge leak)"
+    hint = ("release on every non-raising exit (try/finally or a "
+            "context manager), or hand the resource off explicitly "
+            "(return it / store it on the owner)")
+
+    def run(self, index: ProjectIndex) -> List[Finding]:
+        from ..callgraph import iter_defs
+        out: List[Finding] = []
+        for mod in index.modules():
+            if mod.tree is None:
+                continue
+            for _qual, _cls, node in iter_defs(mod.tree):
+                self._scan_def(mod, node, out)
+        return out
+
+    # ------------------------------------------------------------------
+    def _scan_def(self, mod: ModuleInfo, fn, out: List[Finding]) -> None:
+        body = fn.body
+        stmts = list(self._own_stmts(body))
+        if any(isinstance(n, (ast.Yield, ast.YieldFrom))
+               for s in stmts if not isinstance(
+                   s, (ast.FunctionDef, ast.AsyncFunctionDef))
+               for n in self._own_walk(s)):
+            return    # generator frames outlive the walk; out of scope
+        for s in stmts:
+            acq = self._stmt_acquisition(s)
+            if acq is not None:
+                var, kind, rel_methods, line = acq
+                self._check_resource(mod, body, s, var, kind,
+                                     rel_methods, line, out)
+        self._check_gauges(mod, body, stmts, out)
+
+    @staticmethod
+    def _own_walk(s: ast.AST):
+        """ast.walk stopping at nested def/class/lambda boundaries."""
+        stack = [s]
+        while stack:
+            n = stack.pop()
+            yield n
+            for c in ast.iter_child_nodes(n):
+                if not isinstance(c, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef, ast.Lambda)):
+                    stack.append(c)
+
+    @classmethod
+    def _own_stmts(cls, body):
+        """Every statement of the function EXCLUDING nested def/class
+        bodies (they balance their own resources)."""
+        for s in body:
+            yield s
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            for c in ast.iter_child_nodes(s):
+                if isinstance(c, ast.stmt):
+                    yield from cls._own_stmts([c])
+                elif isinstance(c, (ast.ExceptHandler, ast.match_case)):
+                    yield from cls._own_stmts(c.body)
+
+    @staticmethod
+    def _stmt_acquisition(s: ast.stmt):
+        """(var|None, kind, release_methods, line) when `s` acquires."""
+        if isinstance(s, ast.Assign) and isinstance(s.value, ast.Call) \
+                and len(s.targets) == 1 \
+                and isinstance(s.targets[0], ast.Name):
+            info = _acquire_info(s.value)
+            if info is not None:
+                return s.targets[0].id, info[0], info[1], s.lineno
+        if isinstance(s, ast.Expr) and isinstance(s.value, ast.Call):
+            info = _acquire_info(s.value)
+            if info is not None and info[0] in _NEVER_DISCARD:
+                return None, info[0], info[1], s.lineno
+        return None
+
+    # ------------------------------------------------------------------
+    def _check_resource(self, mod: ModuleInfo, body, acq_stmt,
+                        var: Optional[str], kind: str, rel_methods,
+                        line: int, out: List[Finding]) -> None:
+        if var is None:
+            out.append(self.finding(
+                mod, line,
+                f"`{kind}(...)` result discarded — the lease is never "
+                f"released, so its pinned files leak until process "
+                f"exit",
+                detail=f"{kind}:discarded"))
+            return
+        release_names = set(rel_methods)
+
+        def leaf_release(s: ast.stmt) -> bool:
+            for n in self._own_walk(s):
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr in release_names \
+                        and isinstance(n.func.value, ast.Name) \
+                        and n.func.value.id == var:
+                    return True
+            return False
+
+        def leaf_escape(s: ast.stmt) -> bool:
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return any(isinstance(n, ast.Name) and n.id == var
+                           for n in ast.walk(s))
+            for n in self._own_walk(s):
+                if isinstance(n, ast.Lambda) and any(
+                        isinstance(m, ast.Name) and m.id == var
+                        for m in ast.walk(n)):
+                    return True
+                if isinstance(n, (ast.Yield, ast.YieldFrom)) \
+                        and n.value is not None \
+                        and self._uses(n.value, var):
+                    return True
+                if isinstance(n, ast.Call):
+                    if isinstance(n.func, ast.Attribute) \
+                            and isinstance(n.func.value, ast.Name) \
+                            and n.func.value.id == var:
+                        continue      # method ON the resource: a use
+                    for a in list(n.args) + [k.value for k in
+                                             n.keywords]:
+                        if isinstance(a, ast.Name) and a.id == var:
+                            return True
+                if isinstance(n, ast.Assign):
+                    if self._uses(n.value, var) and any(
+                            not isinstance(t, ast.Name)
+                            for t in n.targets):
+                        return True   # self.x = var / d[k] = var
+                    if any(isinstance(t, ast.Name) and t.id == var
+                           for t in n.targets) and n is not acq_stmt:
+                        return True   # rebinding: aliasing, not ours
+                if isinstance(n, (ast.List, ast.Tuple, ast.Set,
+                                  ast.Dict)):
+                    for elt in ast.iter_child_nodes(n):
+                        if isinstance(elt, ast.Name) and elt.id == var:
+                            return True
+            return False
+
+        def returns_transfer(s: ast.Return) -> bool:
+            return s.value is not None and self._uses(s.value, var)
+
+        tr = _Tracker(var, leaf_release, leaf_escape, returns_transfer)
+        state = self._walk_from(tr, body, acq_stmt)
+        if tr.escaped:
+            return
+        if state == _HELD:
+            tr.leaks.append((line, "fall-through"))
+        released_somewhere = any(leaf_release(s)
+                                 for s in self._own_stmts(body))
+        for leak_line, how in tr.leaks:
+            if how == "return":
+                msg = (f"`{var} = {kind}(...)` (line {line}) is not "
+                       f"released on the return exit at line "
+                       f"{leak_line}")
+            elif released_somewhere:
+                msg = (f"`{var} = {kind}(...)` is not released on the "
+                       f"fall-through exit")
+            else:
+                msg = (f"`{var} = {kind}(...)` is never released on "
+                       f"any path")
+            out.append(self.finding(mod, leak_line, msg,
+                                    detail=f"{kind}:{var}"))
+
+    @staticmethod
+    def _uses(expr: ast.expr, var: str) -> bool:
+        return any(isinstance(n, ast.Name) and n.id == var
+                   for n in ast.walk(expr))
+
+    def _walk_from(self, tr: _Tracker, body, acq_stmt) -> str:
+        """Evaluate the function body with the resource becoming HELD
+        at ``acq_stmt``; statements before it are state-neutral."""
+        armed = [False]
+        orig_stmt = tr.stmt
+
+        def stmt(s, state, fin):
+            if s is acq_stmt:
+                armed[0] = True
+                return _HELD
+            if not armed[0]:
+                if isinstance(s, _COMPOUND):
+                    return orig_stmt(s, state, fin)
+                return state
+            return orig_stmt(s, state, fin)
+
+        tr.stmt = stmt
+        return tr.block(body, _RELEASED, False)
+
+    # ------------------------------------------------------------------
+    def _check_gauges(self, mod: ModuleInfo, body, stmts,
+                      out: List[Finding]) -> None:
+        incs: Dict[str, ast.AugAssign] = {}
+        decs: Dict[str, List[ast.AugAssign]] = {}
+        for s in stmts:
+            if not isinstance(s, ast.AugAssign):
+                continue
+            try:
+                t = ast.unparse(s.target)
+            except Exception:     # noqa: BLE001 — exotic target
+                continue
+            if isinstance(s.op, ast.Add):
+                incs.setdefault(t, s)
+            elif isinstance(s.op, ast.Sub):
+                decs.setdefault(t, []).append(s)
+        for t, inc in sorted(incs.items()):
+            if t not in decs:
+                continue          # monotonic counter: not a gauge
+            if "." not in t and "[" not in t:
+                continue          # bare local (parser depth counter
+                #                   etc.): dies with the frame, cannot
+                #                   drift anything
+            dec_stmts = decs[t]
+
+            def leaf_release(s: ast.stmt, _d=dec_stmts) -> bool:
+                return any(c is d for d in _d
+                           for c in self._own_walk(s))
+
+            tr = _Tracker(None, leaf_release, lambda s: False,
+                          lambda r: False)
+            self._walk_from(tr, body, inc)
+            last_dec = max(d.lineno for d in dec_stmts)
+            for leak_line, how in tr.leaks:
+                if how != "return":
+                    continue      # fall-through without dec = the
+                    #               inc/dec live in different branches
+                if leak_line > last_dec:
+                    continue      # every decrement is behind this
+                    #               return: nothing was jumped over
+                    #               (cache-eviction accounting, not an
+                    #               in-flight pair)
+                out.append(self.finding(
+                    mod, leak_line,
+                    f"gauge `{t}` incremented at line {inc.lineno} but "
+                    f"the return at line {leak_line} skips the "
+                    f"matching decrement — the counter drifts and "
+                    f"every later admission decision inherits the "
+                    f"skew",
+                    detail=f"gauge:{t}"))
+
+
+PASS = ResourceBalancePass()

@@ -9,14 +9,19 @@ the C-API, torn multi-field invariants even under the GIL).
 
 Two phases over the whole tree:
 
-1. collect executor-target names — the callables handed to
+1. collect executor targets — the callables handed to
    ``run_in_executor(...)``, ``<pool>.submit(...)`` and
-   ``threading.Thread(target=...)``; for ``self.tablet.flush`` the
-   terminal attr ``flush`` is recorded (cross-object resolution is
-   name-based on purpose: the pass runs without imports).
-2. per class: a sync method whose name is an executor target is
-   THREAD-side; every async method is LOOP-side.  An attribute with an
-   unlocked write on one side and any write on the other is a finding
+   ``threading.Thread(target=...)``.  Targets are RESOLVED through the
+   project call graph to their actual defining class
+   (``self.flush`` shipped from class C binds exactly ``C.flush`` —
+   or the base class that defines it), so a class that merely shares a
+   method NAME with somebody's executor target is no longer
+   thread-side.  Only targets the graph cannot resolve
+   (``peer.tablet.flush`` — receiver type unknown) fall back to the
+   old terminal-name over-approximation.
+2. per class: a sync method that is an executor target is THREAD-side;
+   every async method is LOOP-side.  An attribute with an unlocked
+   write on one side and any write on the other is a finding
    (locked-vs-unlocked still races — both sides must hold the lock).
 
 Writes = ``self.X = / += ...``, ``self.X[...] = ...``, and mutator
@@ -36,40 +41,96 @@ _MUTATORS = {"append", "extend", "insert", "add", "update", "pop",
              "appendleft", "popleft", "setdefault"}
 
 
-def _executor_targets(mods: List[ModuleInfo]) -> Set[str]:
-    targets: Set[str] = set()
+def _expr_text(e: ast.expr) -> str:
+    """Dotted text of a Name/Attribute chain ('self.flush'), '' when
+    the expr is anything else."""
+    parts: List[str] = []
+    while isinstance(e, ast.Attribute):
+        parts.append(e.attr)
+        e = e.value
+    if isinstance(e, ast.Name):
+        parts.append(e.id)
+        return ".".join(reversed(parts))
+    return ""
 
-    def note(expr: ast.expr) -> None:
+
+def _executor_targets(index: ProjectIndex, mods: List[ModuleInfo],
+                      ) -> Tuple[Set[Tuple[str, str, str]], Set[str]]:
+    """(resolved, unresolved): resolved = (rel, class_qual, method) of
+    every graph-resolvable executor target; unresolved = terminal
+    names of the rest (the old over-approximation, kept only where
+    resolution genuinely fails)."""
+    from ..callgraph import iter_defs
+    graph = index.call_graph()
+    resolved: Set[Tuple[str, str, str]] = set()
+    unresolved: Set[str] = set()
+
+    def note(rel: str, qual: Optional[str], expr: ast.expr) -> None:
         if isinstance(expr, ast.Call):   # partial(self.m, ...) et al.
             if expr.args:
-                note(expr.args[0])
+                note(rel, qual, expr.args[0])
             for kw in expr.keywords:
-                note(kw.value)
+                note(rel, qual, kw.value)
             return
         if isinstance(expr, ast.Lambda):
             return   # no name to match; _scan_class reads its body
+        text = _expr_text(expr)
+        if text:
+            tgt = graph.resolve(rel, qual, text)
+            if tgt is not None:
+                fact = graph.def_fact(tgt)
+                if fact is not None and fact["cls"] is not None:
+                    rel_t, _ = graph.split(tgt)
+                    resolved.add((rel_t, fact["cls"], fact["name"]))
+                    return
+                if fact is not None:
+                    return   # module-level fn: not a method, no class
         t = terminal_attr(expr)
         if t:
-            targets.add(t)
+            unresolved.add(t)
+
+    def scan_calls(rel: str, qual: Optional[str], body) -> None:
+        def go(n: ast.AST) -> None:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                return          # nested defs scanned with their own qual
+            if isinstance(n, ast.Call):
+                fname = call_name(n)
+                leaf = fname.split(".")[-1]
+                if leaf == "run_in_executor" and len(n.args) >= 2:
+                    note(rel, qual, n.args[1])
+                elif leaf == "submit" and n.args and (
+                        "executor" in fname.lower()
+                        or "pool" in fname.lower()):
+                    note(rel, qual, n.args[0])
+                elif leaf == "Thread":
+                    for kw in n.keywords:
+                        if kw.arg == "target":
+                            note(rel, qual, kw.value)
+            for c in ast.iter_child_nodes(n):
+                go(c)
+        for s in body:
+            go(s)
 
     for mod in mods:
         if mod.tree is None:
             continue
-        for node in ast.walk(mod.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            fname = call_name(node)
-            leaf = fname.split(".")[-1]
-            if leaf == "run_in_executor" and len(node.args) >= 2:
-                note(node.args[1])
-            elif leaf == "submit" and node.args and (
-                    "executor" in fname.lower() or "pool" in fname.lower()):
-                note(node.args[0])
-            elif leaf == "Thread":
-                for kw in node.keywords:
-                    if kw.arg == "target":
-                        note(kw.value)
-    return targets
+        module_level = [s for s in mod.tree.body]
+        scan_calls(mod.rel, None, module_level)
+        for qual, _cls, node in iter_defs(mod.tree):
+            scan_calls(mod.rel, qual, node.body)
+    # a subclass OVERRIDE of a shipped method is what actually runs on
+    # the executor thread for subclass instances: every project class
+    # that inherits from a resolved target's class and redefines the
+    # method is thread-side too (resolution alone binds only the
+    # MRO-defining class and would silently drop the override)
+    for rel, f in graph.facts.items():
+        for cq, c in f["classes"].items():
+            for (r_t, c_t, m) in list(resolved):
+                if m in c["methods"] and (rel, cq) != (r_t, c_t) \
+                        and graph.is_subclass(rel, cq, r_t, c_t):
+                    resolved.add((rel, cq, m))
+    return resolved, unresolved
 
 
 class _Write:
@@ -126,6 +187,28 @@ def _collect_writes(fn, method: str) -> List[_Write]:
     return out
 
 
+def _iter_classes(tree: ast.Module):
+    """Yield ``(cls_qual, ClassDef)`` with the call graph's qual
+    scheme (nesting joined with '.') so resolved executor targets can
+    be matched against the class being scanned."""
+
+    def walk(stmts, scope):
+        for s in stmts:
+            if isinstance(s, ast.ClassDef):
+                yield ".".join(scope + [s.name]), s
+                yield from walk(s.body, scope + [s.name])
+            elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from walk(s.body, scope + [s.name])
+            else:
+                children = [c for c in ast.iter_child_nodes(s)
+                            if isinstance(c, (ast.stmt, ast.ExceptHandler,
+                                              ast.match_case))]
+                if children:
+                    yield from walk(children, scope)
+
+    yield from walk(tree.body, [])
+
+
 def _executor_lambda(call: ast.Call) -> Optional[ast.Lambda]:
     """The Lambda handed to an executor in this call, if any —
     `run_in_executor(None, lambda: ...)` has no name for the phase-1
@@ -171,23 +254,27 @@ class SharedStateRacesPass(AnalysisPass):
     def run(self, index: ProjectIndex) -> List[Finding]:
         out: List[Finding] = []
         mods = index.modules()
-        targets = _executor_targets(mods)
+        resolved, unresolved = _executor_targets(index, mods)
         # no early-out on an empty target set: inline executor lambdas
         # contribute thread-side writes without a name to match
         for mod in mods:
             if mod.tree is None:
                 continue
-            for node in ast.walk(mod.tree):
-                if isinstance(node, ast.ClassDef):
-                    self._scan_class(mod, node, targets, out)
+            for cls_qual, node in _iter_classes(mod.tree):
+                self._scan_class(mod, cls_qual, node, resolved,
+                                 unresolved, out)
         return out
 
-    def _scan_class(self, mod: ModuleInfo, cls: ast.ClassDef,
-                    targets: Set[str], out: List[Finding]) -> None:
+    def _scan_class(self, mod: ModuleInfo, cls_qual: str,
+                    cls: ast.ClassDef,
+                    resolved: Set[Tuple[str, str, str]],
+                    unresolved: Set[str], out: List[Finding]) -> None:
         thread_writes: List[_Write] = []
         loop_writes: List[_Write] = []
         for item in cls.body:
-            if isinstance(item, ast.FunctionDef) and item.name in targets \
+            if isinstance(item, ast.FunctionDef) \
+                    and ((mod.rel, cls_qual, item.name) in resolved
+                         or item.name in unresolved) \
                     and item.name != "__init__":
                 thread_writes.extend(_collect_writes(item, item.name))
             elif isinstance(item, ast.AsyncFunctionDef):

@@ -5,8 +5,17 @@
     python tools/analyze/run.py --json             # machine schema
     python tools/analyze/run.py --pass jit_hazards --pass flag_drift
     python tools/analyze/run.py yugabyte_db_tpu/sched   # narrower roots
+    python tools/analyze/run.py --changed origin/main..HEAD   # CI mode
 
-Exit status: 1 when any unsuppressed finding exists, else 0.
+Exit status: 1 when any unsuppressed finding exists, else 0 (2 on a
+bad --changed range).
+
+Incremental modes (``--staged`` for the pre-commit hook, ``--changed
+<git-range>`` for CI / pre-push) still analyze the WHOLE tree — the
+interprocedural passes need every caller — but report only findings
+in the staged/changed files.  Repeat runs stay cheap because the call
+graph's per-file facts persist under ``.analyze_cache/`` keyed on
+(path, mtime, size); ``--no-cache`` opts out.
 
 The ``--json`` schema (consumed by tests/test_analysis.py and the
 bench.py WARN tail):
@@ -48,6 +57,23 @@ def _staged_files(base: str) -> list:
     return [ln.strip() for ln in r.stdout.splitlines() if ln.strip()]
 
 
+def _changed_files(base: str, git_range: str):
+    """Repo-relative paths changed across ``git_range`` (committed
+    AND working-tree edits — `run.py --changed origin/main` right
+    before committing sees what the commit will contain).  Returns
+    None when git cannot resolve the range."""
+    import subprocess
+    try:
+        r = subprocess.run(
+            ["git", "diff", "--name-only", "--diff-filter=ACMR",
+             git_range, "--"],
+            cwd=base, capture_output=True, text=True, timeout=30,
+            check=True)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return [ln.strip() for ln in r.stdout.splitlines() if ln.strip()]
+
+
 def _index_content(base: str, rel: str):
     """The staged (index) content of `rel`, or None when unreadable."""
     import subprocess
@@ -77,43 +103,71 @@ def main(argv=None) -> int:
                          "default analysis roots (the pre-commit hook "
                          "mode; exits 0 when nothing relevant is "
                          "staged)")
+    ap.add_argument("--changed", metavar="GIT-RANGE",
+                    help="report only findings in files changed across "
+                         "this git range (e.g. origin/main..HEAD); the "
+                         "index still covers the whole tree so "
+                         "interprocedural findings stay sound")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="skip the persisted .analyze_cache/ facts "
+                         "cache (forces a full re-parse)")
     args = ap.parse_args(argv)
 
     passes = ([get_pass(p) for p in args.passes] if args.passes
               else list(ALL_PASSES))
     roots = args.roots
-    staged = None
+    focus = None        # report-only file set (staged/changed modes)
+    focus_label = None
     if args.staged:
-        staged = {f for f in _staged_files(args.base)
-                  if f.endswith(".py")
-                  and any(f == r or f.startswith(r.rstrip("/") + "/")
-                          for r in DEFAULT_ROOTS)}
-        if not staged:
+        focus = {f for f in _staged_files(args.base)
+                 if f.endswith(".py")
+                 and any(f == r or f.startswith(r.rstrip("/") + "/")
+                         for r in DEFAULT_ROOTS)}
+        focus_label = "staged"
+    elif args.changed:
+        changed = _changed_files(args.base, args.changed)
+        if changed is None:
+            print(f"analyze --changed: git could not resolve range "
+                  f"{args.changed!r}", file=sys.stderr)
+            return 2
+        focus = {f for f in changed
+                 if f.endswith(".py")
+                 and any(f == r or f.startswith(r.rstrip("/") + "/")
+                         for r in DEFAULT_ROOTS)}
+        focus_label = f"changed in {args.changed}"
+    if focus is not None:
+        if not focus:
             if args.as_json:
                 print(json.dumps({"passes": [], "findings": [],
                                   "suppressions": {}, "total_findings": 0,
                                   "total_suppressed": 0, "wall_ms": 0.0,
                                   "parse_errors": []}))
             else:
-                print("analyze --staged: no staged files under "
+                print(f"analyze: no {focus_label} files under "
                       f"{DEFAULT_ROOTS}; nothing to check")
             return 0
-        # whole-program passes (flag_drift's defs-vs-reads join) are
-        # only meaningful over the full roots: analyze EVERYTHING, then
-        # gate the commit on findings in the staged files alone
+        # whole-program passes (flag_drift's defs-vs-reads join, the
+        # call graph) are only meaningful over the full roots: analyze
+        # EVERYTHING, then gate on findings in the focus files alone
         roots = list(DEFAULT_ROOTS)
     # staged files are analyzed at their INDEX content, not the working
     # tree — a partially staged file is checked against the bytes that
-    # will actually land in the commit
-    overlay = {rel: src for rel in (staged or ())
+    # will actually land in the commit.  --changed deliberately reads
+    # the CHECKOUT: in CI the checkout IS the range head; a local
+    # pre-push from a dirty tree is told about the hazards as they
+    # stand now (the next push re-checks whatever actually lands)
+    overlay = {rel: src for rel in (focus if args.staged else ())
                if (src := _index_content(args.base, rel)) is not None}
-    index = ProjectIndex(args.base, roots=roots, overlay=overlay)
+    cache_dir = None if args.no_cache else os.path.join(
+        args.base, ".analyze_cache")
+    index = ProjectIndex(args.base, roots=roots, overlay=overlay,
+                         cache_dir=cache_dir)
     report = run_analysis(index, passes)
-    if staged is not None:
+    if focus is not None:
         report["findings"] = [f for f in report["findings"]
-                              if f["path"] in staged]
+                              if f["path"] in focus]
         report["parse_errors"] = [e for e in report["parse_errors"]
-                                  if e["path"] in staged]
+                                  if e["path"] in focus]
         report["total_findings"] = len(report["findings"])
 
     if args.as_json:

@@ -33,6 +33,8 @@ class Master:
         self.uuid = uuid
         os.makedirs(fs_root, exist_ok=True)
         self.messenger = Messenger(f"master-{uuid}")
+        # created lazily on the serving loop (no loop exists yet here)
+        self._persist_alock = None
         # sys catalog state (the Raft-replicated state machine)
         self.tables: Dict[str, dict] = {}      # table_id -> entry
         self.tablets: Dict[str, dict] = {}     # tablet_id -> entry
@@ -134,7 +136,7 @@ class Master:
                 self.tablespaces[op[1]] = op[2]
             elif kind == "del_tablespace":
                 self.tablespaces.pop(op[1], None)
-        self._persist()
+        await self._persist_off_loop()
 
     async def _commit_catalog(self, ops) -> None:
         """Apply catalog deltas through Raft when running replicated;
@@ -183,18 +185,42 @@ class Master:
             self.views = d.get("views", {})
             self.tablespaces = d.get("tablespaces", {})
 
-    def _persist(self):
+    def _dump_catalog(self) -> str:
+        """Serialize the catalog ON the loop — the dicts are loop
+        state, so snapshotting here (not in the executor) is what
+        keeps the bytes internally consistent."""
+        return json.dumps({"tables": self.tables, "tablets": self.tablets,
+                           "xcluster": self.xcluster_replication,
+                           "repl_slots": self.replication_slots,
+                           "sequences": self.sequences,
+                           "views": self.views,
+                           "tablespaces": self.tablespaces})
+
+    def _write_catalog(self, data: str) -> None:
+        """Durable write (executor target: fsync is a device stall)."""
         tmp = self._catalog_path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"tables": self.tables, "tablets": self.tablets,
-                       "xcluster": self.xcluster_replication,
-                       "repl_slots": self.replication_slots,
-                       "sequences": self.sequences,
-                       "views": self.views,
-                       "tablespaces": self.tablespaces}, f)
+            f.write(data)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._catalog_path)
+
+    def _persist(self):
+        self._write_catalog(self._dump_catalog())
+
+    async def _persist_off_loop(self):
+        """Catalog persistence without stalling the loop: snapshot the
+        state synchronously, then fsync+rename in the executor.  The
+        lock serializes writers (concurrent standalone commits would
+        race the shared .tmp path and could land an older snapshot
+        over a newer one); there is no suspension point between the
+        snapshot and the lock acquire, so write order == apply order."""
+        data = self._dump_catalog()
+        if self._persist_alock is None:
+            self._persist_alock = asyncio.Lock()
+        async with self._persist_alock:
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._write_catalog, data)
 
     # --- lifecycle --------------------------------------------------------
     async def start(self, host: str = "127.0.0.1", port: int = 0,

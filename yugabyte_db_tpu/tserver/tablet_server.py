@@ -38,13 +38,29 @@ log = logging.getLogger("ybtpu.tserver")
 def _atomic_json(path: str, obj) -> None:
     """Durable metadata write: tmp + fsync + rename, so a crash
     mid-write never leaves a truncated tablet-meta.json the next
-    startup would fail to parse."""
+    startup would fail to parse.  Sync form for sync callers (raft
+    config-persist callbacks run off-loop already); async code must
+    use ``_atomic_json_off_loop`` — the fsync is a device stall."""
+    _write_atomic_json(path, json.dumps(obj))
+
+
+def _write_atomic_json(path: str, data: str) -> None:
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
-        json.dump(obj, f)
+        f.write(data)
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+
+
+async def _atomic_json_off_loop(path: str, obj) -> None:
+    """_atomic_json without the loop stall: serialize on the loop (the
+    dict is loop state — snapshotting here keeps the bytes consistent
+    even if the caller mutates it later), fsync+rename in the
+    executor."""
+    data = json.dumps(obj)
+    await asyncio.get_running_loop().run_in_executor(
+        None, _write_atomic_json, path, data)
 
 
 def _rmtree(path: str) -> None:
@@ -218,8 +234,14 @@ class TabletServer:
     async def _open_tablet(self, meta: dict) -> TabletPeer:
         info = TableInfo.from_wire(meta["table"])
         tablet_id = meta["tablet_id"]
-        # roll forward / clean up any snapshot install a crash cut short
-        self._complete_install_swap(self._tablet_dir(tablet_id))
+        # roll forward / clean up any snapshot install a crash cut
+        # short — staged stores can be GBs of SSTs, so the rename/
+        # rmtree sequence runs in the executor (the swap itself is
+        # marker-gated and idempotent, and installs for this tablet
+        # are serialized by the _installing guard)
+        await asyncio.get_running_loop().run_in_executor(
+            None, self._complete_install_swap,
+            self._tablet_dir(tablet_id))
         part = Partition(bytes.fromhex(meta["partition"][0]),
                          bytes.fromhex(meta["partition"][1]))
         tablet = Tablet(tablet_id, info, self._tablet_dir(tablet_id),
@@ -585,7 +607,9 @@ class TabletServer:
                 f.write(payload["snapshot_id"])
                 f.flush()
                 os.fsync(f.fileno())   # blocking-ok: durable commit point
-            self._complete_install_swap(d)
+            # the swap renames/rmtrees whole stores — executor, not loop
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._complete_install_swap, d)
         finally:
             # reopen no matter what — a failed swap must not leave the
             # tablet unserved until process restart
@@ -787,7 +811,8 @@ class TabletServer:
             }
             cd = self._tablet_dir(child_id)
             os.makedirs(cd, exist_ok=True)
-            _atomic_json(os.path.join(cd, "tablet-meta.json"), meta)
+            await _atomic_json_off_loop(
+                os.path.join(cd, "tablet-meta.json"), meta)
             peer = await self._open_tablet(meta)
             children[child_id] = peer
 
@@ -823,7 +848,7 @@ class TabletServer:
             # siblings recorded so the decision-routing map rebuilds
             # COMPLETELY from any one child (the other may live on a
             # different tserver after a balancer move)
-            _atomic_json(_marker(cid), {
+            await _atomic_json_off_loop(_marker(cid), {
                 "parent": parent_id,
                 "siblings": [d["left_id"], d["right_id"]]})
         # persist the split state so a restarted replica keeps
@@ -836,7 +861,7 @@ class TabletServer:
                 pmeta = json.load(f)
             pmeta["split_done"] = True
             pmeta["split_children"] = [d["left_id"], d["right_id"]]
-            _atomic_json(meta_path, pmeta)
+            await _atomic_json_off_loop(meta_path, pmeta)
         except FileNotFoundError:
             pass
 
