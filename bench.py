@@ -582,7 +582,8 @@ def tpch_bypass_bench(data, repeats):
     async def run():
         from yugabyte_db_tpu.docdb.operations import ReadRequest
         from yugabyte_db_tpu.models.tpch import (
-            TPCH_Q1, TPCH_Q6, lineitem_range_info, numpy_reference)
+            TPCH_Q6, lineitem_range_info, lineitem_str_data,
+            lineitem_str_info, numpy_reference, tpch_q1_str)
         from yugabyte_db_tpu.utils import flags
 
         n_li = len(data["rowid"])
@@ -593,59 +594,90 @@ def tpch_bypass_bench(data, repeats):
                 num_tservers=1).start()
         try:
             c = mc.client()
-            await c.create_table(lineitem_range_info(), num_tablets=1,
-                                 replication_factor=1)
-            await mc.wait_for_leaders("lineitem_r")
+            # q6 scans the numeric range-sharded clone; q1 scans the
+            # STRING-keyed clone through the dict-grouped kernel, so
+            # the bypass column exercises the group-keyed partial
+            # combine (ops/scan.combine_grouped_partials) on BOTH the
+            # hot-path client fan-out and the bypass session
             ts = mc.tservers[0]
-            li_peer = next(p for p in ts.peers.values()
-                           if p.tablet.info.name == "lineitem_r")
-            li_peer.tablet.bulk_load(data, block_rows=65536)
+            peers = {}
+            for info, rows in ((lineitem_range_info(), data),
+                               (lineitem_str_info(),
+                                lineitem_str_data(data))):
+                await c.create_table(info, num_tablets=1,
+                                     replication_factor=1)
+                await mc.wait_for_leaders(info.name)
+                peer = next(p for p in ts.peers.values()
+                            if p.tablet.info.name == info.name)
+                peer.tablet.bulk_load(rows, block_rows=65536)
+                peers[info.name] = peer
             c.set_bypass_provider(
-                lambda table: [li_peer] if table == "lineitem_r"
+                lambda table: [peers[table]] if table in peers
                 else None)
             flags.set_flag("bypass_reader_enabled", True)
             out = {}
             rounds = max(2, repeats // 2)
-            for q in (TPCH_Q6, TPCH_Q1):
+            q1s = tpch_q1_str()
+            for q, tab in ((TPCH_Q6, "lineitem_r"),
+                           (q1s, "lineitem_s")):
                 def req():
                     return ReadRequest("", where=q.where,
                                        aggregates=q.aggs,
                                        group_by=q.group)
-                hot_warm = await c.scan("lineitem_r", req())
-                byp_warm = await c.scan_bypass("lineitem_r", req())
+                hot_warm = await c.scan(tab, req())
+                byp_warm = await c.scan_bypass(tab, req())
                 assert c.last_bypass["used"], (
                     f"{q.name}: bypass fell back "
                     f"({c.last_bypass['reason']})")
-                # parity: q6 vs direct numpy; q1 bypass-vs-hotpath
-                # elementwise (the byte-level parity proof lives in
-                # tests/test_bypass_reader.py — this guards the BENCH
-                # wiring, and a mismatch must fail the bench)
+                # parity: q6 vs direct numpy; q1 bypass-vs-hotpath BY
+                # GROUP KEY (slot order vs first-seen order differ; the
+                # byte-level parity proof lives in tests/ — this guards
+                # the BENCH wiring, and a mismatch must fail the bench)
                 if q.name == "q6":
                     ref = numpy_reference(q, data)
                     got = float(byp_warm.agg_values[0])
                     assert abs(got - ref) / max(abs(ref), 1e-9) < 1e-5, \
                         f"bypass q6 mismatch: {got} vs {ref}"
                 else:
-                    for hv, bv in zip(hot_warm.agg_values,
-                                      byp_warm.agg_values):
-                        ha, ba = np.asarray(hv, dtype=np.float64), \
-                            np.asarray(bv, dtype=np.float64)
-                        assert np.allclose(ha, ba, rtol=1e-5), \
-                            f"bypass q1 mismatch: {ba} vs {ha}"
+                    def keyed(resp):
+                        cnt = np.asarray(resp.group_counts)
+                        return {
+                            tuple(str(v[g]) for v in resp.group_values):
+                            (int(cnt[g]),) + tuple(
+                                float(np.asarray(v)[g])
+                                for v in resp.agg_values)
+                            for g in np.nonzero(cnt)[0]}
+                    hk, bk = keyed(hot_warm), keyed(byp_warm)
+                    assert set(hk) == set(bk), (hk.keys(), bk.keys())
+                    for k in hk:
+                        assert hk[k][0] == bk[k][0], f"{k} count"
+                        assert np.allclose(hk[k][1:], bk[k][1:],
+                                           rtol=1e-5), (k, hk[k], bk[k])
+                    # grouped bypass stays keyless: zero key-matrix
+                    # rebuilds across warm-up AND the timed rounds
+                    # (counter-asserted again below)
                 # PAIRED rounds (hot, bypass back-to-back) so driver-box
                 # contention cancels in the ratio, as in the main loop
                 pairs = []
                 for _ in range(rounds):
                     t0 = time.perf_counter()
-                    await c.scan("lineitem_r", req())
+                    await c.scan(tab, req())
                     hot_t = time.perf_counter() - t0
                     t0 = time.perf_counter()
-                    await c.scan_bypass("lineitem_r", req())
+                    await c.scan_bypass(tab, req())
                     pairs.append((hot_t, time.perf_counter() - t0))
                 hot_t = min(h for h, _ in pairs)
                 byp_t = min(b for _, b in pairs)
                 st = c.last_bypass["stats"] or {}
-                out[q.name] = {
+                key_rebuilds = st.get("key_rebuilds", 0)
+                if q.name == "q1_str":
+                    # the keyless contract, counter-asserted in the
+                    # bench too: grouped bypass must never rebuild a
+                    # key matrix (bypass-session-scoped counter)
+                    assert key_rebuilds == 0, \
+                        f"grouped bypass rebuilt {key_rebuilds} key " \
+                        "matrices — the keyless contract broke"
+                out["q1" if q.name == "q1_str" else q.name] = {
                     "hotpath_rows_per_s": round(n_li / hot_t, 1),
                     "bypass_rows_per_s": round(n_li / byp_t, 1),
                     # best-of-N over best-of-N, consistent with the
@@ -655,6 +687,9 @@ def tpch_bypass_bench(data, repeats):
                     "bypass_vs_hotpath": round(hot_t / byp_t, 3),
                     "keyless_blocks": st.get("keyless_blocks"),
                     "blocks": st.get("blocks"),
+                    **({"grouped_combine": "combine_grouped_partials",
+                        "key_rebuilds": key_rebuilds}
+                       if q.name == "q1_str" else {}),
                 }
             return out
         finally:
@@ -669,6 +704,196 @@ def tpch_bypass_bench(data, repeats):
         return {"error": str(e)[:200]}
 
 
+def q1_grouped_bench(data, repeats):
+    """Dict-key GROUP BY on device vs the interpreted GROUP BY
+    (ROADMAP operator-frontier rungs (b)+(d)): TPC-H Q1 over the
+    string-keyed lineitem variant (l_returnflag/l_linestatus as real
+    STRINGs), streamed end-to-end through the grouped-aggregation
+    kernel, against the row-at-a-time interpreter that served every
+    string GROUP BY before this PR (``grouped_pushdown_enabled=False``
+    is byte-for-byte that path).  Also: the numpy CPU twin
+    (ops/grouped_scan.grouped_aggregate_cpu — the parity oracle,
+    recorded for the accelerator-box comparison, NOT a WARN ratio on
+    this CPU-only image) and a group-cardinality sweep (4 -> 4096
+    occupied slots) over synthetic dictionary-coded keys.
+
+    The interpreter chews ~40k rows/s, so the comparison runs on a
+    row-capped slice (BENCH_Q1G_ROWS, default 393216 = 6 chunks of
+    65536) — both sides measure the SAME table, so the ratio is fair
+    and the bench stays bounded."""
+    from yugabyte_db_tpu.docdb.operations import ReadRequest
+    from yugabyte_db_tpu.models.tpch import (lineitem_str_data,
+                                             lineitem_str_info,
+                                             numpy_reference,
+                                             tpch_q1_str)
+    from yugabyte_db_tpu.ops.grouped_scan import (GROUPED_STATS,
+                                                  LAST_GROUPED_STATS,
+                                                  DictGroupSpec,
+                                                  decode_slot_groups,
+                                                  grouped_aggregate_cpu,
+                                                  make_dict_plan)
+    from yugabyte_db_tpu.ops import Expr
+    from yugabyte_db_tpu.ops.scan import AggSpec, ScanKernel
+    from yugabyte_db_tpu.ops.stream_scan import streaming_scan_aggregate
+    from yugabyte_db_tpu.tablet import Tablet
+    from yugabyte_db_tpu.utils import flags
+
+    n_g = min(len(data["rowid"]),
+              int(os.environ.get("BENCH_Q1G_ROWS", str(6 * 65536))))
+    sdata = lineitem_str_data({k: v[:n_g] for k, v in data.items()})
+    t = Tablet("lineitem-s", lineitem_str_info(),
+               tempfile.mkdtemp(prefix="ybtpu-q1g-"))
+    t.bulk_load(sdata, block_rows=65536)
+    q = tpch_q1_str()
+
+    def req():
+        return ReadRequest("lineitem_s", where=q.where,
+                           aggregates=q.aggs, group_by=q.group)
+
+    def by_key(resp):
+        counts = np.asarray(resp.group_counts)
+        out = {}
+        for g in np.nonzero(counts)[0]:
+            out[tuple(str(v[g]) for v in resp.group_values)] = \
+                (int(counts[g]),) + tuple(
+                    float(np.asarray(v)[g]) for v in resp.agg_values)
+        return out
+
+    flags.set_flag("streaming_chunk_rows", 65536)
+    try:
+        launches0 = GROUPED_STATS["launches"]
+        grouped_warm = t.read(req())        # compile + warm
+        assert grouped_warm.backend == "tpu", "grouped pushdown fell back"
+        assert LAST_GROUPED_STATS.get("path") == "streaming", \
+            f"expected the STREAMED grouped path, got {LAST_GROUPED_STATS}"
+        # paired rounds: grouped and interpreted back-to-back, as in the
+        # headline loop, so box contention cancels in the ratio
+        rounds = max(2, repeats // 2)
+        pairs = []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            gresp = t.read(req())
+            g_t = time.perf_counter() - t0
+            flags.set_flag("grouped_pushdown_enabled", False)
+            try:
+                t0 = time.perf_counter()
+                iresp = t.read(req())
+                i_t = time.perf_counter() - t0
+            finally:
+                flags.REGISTRY.reset("grouped_pushdown_enabled")
+            assert iresp.backend == "cpu"
+            pairs.append((g_t, i_t))
+        gstats = dict(LAST_GROUPED_STATS)
+        g_t = min(g for g, _ in pairs)
+        i_t = min(i for _, i in pairs)
+        # parity: device grouped vs interpreted, keyed by group values
+        # (exact counts; l_quantity is integer-valued -> exact int64 SUM
+        # lane; fractional price sums carry only f32 representation
+        # error, same tolerance ladder as check_q1) — and vs numpy
+        ga, ia = by_key(gresp), by_key(iresp)
+        assert set(ga) == set(ia), (set(ga), set(ia))
+        ref = numpy_reference(q, sdata)
+        for k in ga:
+            assert ga[k][0] == ia[k][0] == ref[k][2], f"{k} count"
+            assert ga[k][1] == ia[k][1] == ref[k][0], f"{k} qty"
+            assert abs(ga[k][2] - ref[k][1]) / max(ref[k][1], 1e-9) \
+                < 1e-5, f"{k} price"
+
+        # the numpy CPU twin on the same blocks (cold: its own dict plan)
+        blocks = []
+        for r in t.regular.ssts:
+            for i in range(r.num_blocks()):
+                blocks.append(r.columnar_block(i))
+        cols = sorted(q.columns)
+
+        def twin():
+            return grouped_aggregate_cpu(blocks, cols, q.where, q.aggs,
+                                         q.group)
+        twin_t, (touts, tcounts, tspill) = best_of(twin, rounds)
+        assert tspill == 0
+        _, tc, tg = decode_slot_groups(
+            q.group, make_dict_plan(blocks, q.group.cols).dicts,
+            touts, tcounts)
+        for i, k in enumerate(zip(*(map(str, g) for g in tg))):
+            assert int(tc[i]) == ref[k][2], f"twin {k} count"
+
+        out = {
+            "rows": n_g,
+            "grouped_rows_per_s": round(n_g / g_t, 1),
+            "interp_rows_per_s": round(n_g / i_t, 1),
+            "grouped_vs_interp": round(i_t / g_t, 3),
+            "twin_rows_per_s": round(n_g / twin_t, 1),
+            "vs_cpu_twin": round(twin_t / g_t, 3),
+            "kernel_launches": GROUPED_STATS["launches"] - launches0,
+            "spill_fallbacks": GROUPED_STATS["spill_fallbacks"],
+            "stream_split": gstats,
+        }
+
+        # --- group-cardinality sweep: 4 -> 4096 occupied groups -------
+        # synthetic dictionary-coded keys, one column per cardinality,
+        # ONE table/load; each cardinality lands in its own pow2 slot
+        # bucket (8 .. 8192 incl. the spill slot) = one compile each,
+        # counted via the fresh kernel's own accounting
+        from yugabyte_db_tpu.docdb.table_codec import TableInfo
+        from yugabyte_db_tpu.dockv.packed_row import (ColumnSchema,
+                                                      ColumnType,
+                                                      TableSchema)
+        from yugabyte_db_tpu.dockv.partition import PartitionSchema
+        cards = [4, 64, 1024, 4096]
+        n_sw = 262144
+        rng = np.random.default_rng(7)
+        sw_schema = TableSchema(
+            (ColumnSchema(0, "k", ColumnType.INT64, is_hash_key=True),)
+            + tuple(ColumnSchema(i + 1, f"g{c}", ColumnType.STRING)
+                    for i, c in enumerate(cards))
+            + (ColumnSchema(len(cards) + 1, "v", ColumnType.FLOAT64),),
+            1)
+        sw = Tablet("grpsweep", TableInfo(
+            "grpsweep", "grpsweep", sw_schema,
+            PartitionSchema("hash", 1)),
+            tempfile.mkdtemp(prefix="ybtpu-q1gsw-"))
+        sw.bulk_load({
+            "k": np.arange(n_sw, dtype=np.int64),
+            **{f"g{c}": np.array([f"g{i:04d}" for i in range(c)],
+                                 object)[rng.integers(0, c, n_sw)]
+               for c in cards},
+            "v": rng.integers(1, 100, n_sw).astype(np.float64),
+        }, block_rows=32768)
+        sw_blocks = []
+        for r in sw.regular.ssts:
+            for i in range(r.num_blocks()):
+                sw_blocks.append(r.columnar_block(i))
+        skern = ScanKernel()
+        sweep = {}
+        for i, c in enumerate(cards):
+            spec = DictGroupSpec(cols=(i + 1,), max_slots=8192)
+            aggs = (AggSpec("sum", Expr.col(len(cards) + 1).node),
+                    AggSpec("count"))
+
+            def srun():
+                gout = {}
+                got = streaming_scan_aggregate(
+                    sw_blocks, [i + 1, len(cards) + 1], None, aggs,
+                    spec, None, kernel=skern, chunk_rows=32768,
+                    grouped_out=gout)
+                assert got is not None and gout["spill"] == 0
+                return got
+            srun()      # compile this slot bucket
+            sw_t, _ = best_of(srun, rounds)
+            sweep[str(c)] = {
+                "rows_per_s": round(n_sw / sw_t, 1),
+                "num_slots": LAST_GROUPED_STATS["num_slots"],
+                "slots_occupied": LAST_GROUPED_STATS["slots_occupied"],
+                "dict_merge_s": LAST_GROUPED_STATS["dict_merge_s"],
+                "kernel_s": LAST_GROUPED_STATS["kernel_s"],
+            }
+        out["cardinality_sweep"] = sweep
+        out["sweep_compiles"] = skern.compiles
+        return out
+    finally:
+        flags.REGISTRY.reset("streaming_chunk_rows")
+
+
 # ratio keys whose value < 1.0 means "slower than the baseline it was
 # measured against" — surfaced as a WARN in the bench tail instead of
 # sitting silently inside the JSON (satellite of PR 3; Q6's r05
@@ -676,7 +901,8 @@ def tpch_bypass_bench(data, repeats):
 _RATIO_KEYS = ("vs_baseline", "speedup", "vs_cpu", "vs_xla",
                "p99_ratio_on_vs_off", "achieved_ratio_on_vs_off",
                "stream_vs_mono", "v2_vs_v1_bytes", "prune_speedup",
-               "bypass_vs_hotpath", "bypass_p99_impact")
+               "bypass_vs_hotpath", "bypass_p99_impact",
+               "grouped_vs_interp")
 
 
 def warn_regressed_ratios(node, path="", out=None):
@@ -1049,6 +1275,12 @@ def main():
         cold_results["zone_prune_q6"] = {"error": str(e)[:200]}
     results["cold_scan"] = cold_results
 
+    # --- q1_grouped: dict-key GROUP BY kernel vs interpreted ------------
+    # (operator-frontier rungs (b)+(d): string group keys aggregate on
+    # device over scan-global dictionary codes; grouped_vs_interp
+    # WARN-wires like stream_vs_mono)
+    results["q1_grouped"] = q1_grouped_bench(data, repeats)
+
     # --- optional: hand-fused pallas scan vs the XLA kernel -------------
     # (BENCH_PALLAS=1; the flag stays off otherwise so the driver's run
     # never depends on the pallas TPU compile)
@@ -1385,6 +1617,9 @@ def main():
         "q1": {"tpu_rows_per_s": round(results["q1"]["tpu_rows_per_s"], 1),
                "speedup": round(results["q1"]["speedup"], 3),
                "bypass": results["q1"]["bypass"]},
+        # string-keyed Q1 through the streamed grouped kernel vs the
+        # interpreted GROUP BY (+ cardinality sweep, CPU-twin oracle)
+        "q1_grouped": results["q1_grouped"],
         "q1_dist8": {
             "rows_per_s": round(results["q1_dist"]["rows_per_s"], 1),
             "combine": results["q1_dist"]["combine"]},

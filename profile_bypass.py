@@ -3,9 +3,11 @@
 `--json` prints ONE JSON object breaking a bypass Q6/Q1 scan into its
 stages — pin (flush + lease), block collection, prefilter, batch
 formation, kernel dispatch, combine — plus the keyless-scan counters
-(key_rebuilds MUST stay 0), the prefilter selectivity split, and a
+(key_rebuilds MUST stay 0), the prefilter selectivity split, a
 prefilter ON/OFF and chunk-size sweep so the near-data filter's win
-and the chunk plan are tunable from data.
+and the chunk plan are tunable from data, and a grouped-scan stage
+split (q1_grouped: dict-merge / build / kernel / combine wall, slot
+occupancy, compile counts for the dict-key GROUP BY route).
 
 Env knobs: PROFILE_SF (default 0.1), PROFILE_ROUNDS (default 3),
 PROFILE_CHUNK_SWEEP (comma list of chunk_rows; default
@@ -108,6 +110,57 @@ def profile_json() -> dict:
         modes["prefilter_speedup"] = round(
             off["wall_s"] / max(pin["wall_s"], 1e-9), 3)
         out["queries"][name] = modes
+
+    # --- grouped-scan stage split: dict-key GROUP BY via bypass --------
+    # Q1 over the string-keyed lineitem (dict-grouped kernel, keyless):
+    # dict-merge / batch-build / kernel / cross-shard combine wall per
+    # stage, slot occupancy, and the shared kernel's compile counter —
+    # the knobs behind grouped_max_slots and streaming_chunk_rows.
+    from yugabyte_db_tpu.docdb.operations import _SHARED_KERNEL
+    from yugabyte_db_tpu.models.tpch import (lineitem_str_data,
+                                             lineitem_str_info,
+                                             tpch_q1_str)
+    from yugabyte_db_tpu.ops.grouped_scan import (GROUPED_STATS,
+                                                  LAST_GROUPED_STATS)
+    st = Tablet("li-prof-s", lineitem_str_info(),
+                tempfile.mkdtemp(prefix="bypass-prof-s-"))
+    st.bulk_load(lineitem_str_data(data), block_rows=65536)
+    q1g = tpch_q1_str()
+    ref_g = numpy_reference(q1g, data)
+    c0 = _SHARED_KERNEL.compiles
+    l0 = GROUPED_STATS["launches"]
+    r0 = KEY_REBUILD_STATS["rebuilds"]
+    with BypassSession([st], chunk_rows=65536) as s:
+        best = None
+        for _ in range(rounds):
+            gout: dict = {}
+            t0 = time.perf_counter()
+            gouts, gcounts, gstats = s.scan_aggregate(
+                q1g.where, q1g.aggs, q1g.group, grouped_out=gout)
+            wall = time.perf_counter() - t0
+            if best is None or wall < best[0]:
+                best = (wall, gstats, dict(LAST_GROUPED_STATS),
+                        dict(LAST_STREAM_STATS))
+    wall, gstats, grouped, stream = best
+    counts = np.asarray(gcounts)
+    for g in range(len(counts)):
+        key = tuple(str(v[g]) for v in gout["group_values"])
+        assert int(counts[g]) == ref_g[key][2], f"q1_grouped {key}"
+    out["q1_grouped"] = {
+        "wall_s": round(wall, 4),
+        "rows_per_s": round(n / wall, 1),
+        "path": gstats.get("paths"),
+        "dict_merge_s": grouped.get("dict_merge_s"),
+        "build_s": stream.get("build_s"),
+        "kernel_s": grouped.get("kernel_s"),
+        "combine_s": gstats.get("combine_s"),
+        "num_slots": grouped.get("num_slots"),
+        "slots_occupied": grouped.get("slots_occupied"),
+        "spilled_rows": grouped.get("spilled_rows"),
+        "kernel_launches": GROUPED_STATS["launches"] - l0,
+        "kernel_compiles": _SHARED_KERNEL.compiles - c0,
+        "key_rebuilds": KEY_REBUILD_STATS["rebuilds"] - r0,
+    }
 
     chunk_sweep = {}
     for cr in sweep:

@@ -7,8 +7,11 @@ a cProfile dump.
 request scheduler on — prints ONE JSON object with ops/s next to the
 scheduler's own accounting (per-lane depth/wait histograms, batch-size
 distribution, group-commit fan-in), so batching policy is tunable from
-data instead of guesswork.  Env knobs: PROFILE_OPS (default 4000),
-PROFILE_CLIENTS (default 16), PROFILE_ROWS (default 20000).
+data instead of guesswork; plus a grouped-scan stage split
+(dict-merge / build / kernel / combine wall, slot occupancy, compile
+counts for the dict-key GROUP BY kernel).  Env knobs: PROFILE_OPS
+(default 4000), PROFILE_CLIENTS (default 16), PROFILE_ROWS (default
+20000).
 """
 import os
 import sys
@@ -116,6 +119,7 @@ async def rpc_profile() -> dict:
             "agg_scans_per_s": round(32 / scan_s, 1),
             "scheduler": stats,
             "bulk_load": bulk_load_profile(),
+            "grouped_scan": grouped_scan_profile(),
         }
     finally:
         await mc.shutdown()
@@ -142,6 +146,81 @@ def bulk_load_profile(n_rows: int = 200_000) -> dict:
     wall = time.perf_counter() - t0
     return {"rows": loaded, "rows_per_s": round(loaded / wall, 1),
             **LAST_BULK_LOAD_STATS}
+
+
+def grouped_scan_profile(n_rows: int = 200_000, rounds: int = 3) -> dict:
+    """Engine-level dict-key GROUP BY stage split: Q1 over the
+    string-keyed lineitem through the streamed grouped kernel
+    (tablet.read, grouped_pushdown_enabled on), reporting dict-merge /
+    batch-build / kernel / per-chunk combine wall, slot occupancy, and
+    the shared kernel's launch+compile counters — the same stage keys
+    profile_bypass.py reports for the bypass route, so the two paths
+    compare cell-for-cell."""
+    import numpy as np
+    from yugabyte_db_tpu.docdb.operations import (_SHARED_KERNEL,
+                                                  ReadRequest)
+    from yugabyte_db_tpu.models.tpch import (ROWS_PER_SF,
+                                             generate_lineitem,
+                                             lineitem_str_data,
+                                             lineitem_str_info,
+                                             numpy_reference,
+                                             tpch_q1_str)
+    from yugabyte_db_tpu.ops.grouped_scan import (GROUPED_STATS,
+                                                  LAST_GROUPED_STATS)
+    from yugabyte_db_tpu.ops.stream_scan import LAST_STREAM_STATS
+    from yugabyte_db_tpu.tablet import Tablet
+    from yugabyte_db_tpu.utils import flags
+
+    data = generate_lineitem(n_rows / ROWS_PER_SF)
+    n = len(data["rowid"])
+    t = Tablet("li-grp-prof", lineitem_str_info(),
+               tempfile.mkdtemp(prefix="grp-prof-"))
+    t.bulk_load(lineitem_str_data(data), block_rows=32768)
+    q = tpch_q1_str()
+
+    def req():
+        return ReadRequest("lineitem_s", where=q.where,
+                           aggregates=q.aggs, group_by=q.group)
+
+    flags.set_flag("streaming_chunk_rows", 32768)
+    try:
+        c0 = _SHARED_KERNEL.compiles
+        l0 = GROUPED_STATS["launches"]
+        resp = t.read(req())            # compile + warm
+        assert resp.backend == "tpu", "grouped pushdown fell back"
+        compile_launches = GROUPED_STATS["launches"] - l0
+        best = None
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            resp = t.read(req())
+            wall = time.perf_counter() - t0
+            if best is None or wall < best[0]:
+                best = (wall, dict(LAST_GROUPED_STATS),
+                        dict(LAST_STREAM_STATS))
+        wall, grouped, stream = best
+        ref = numpy_reference(q, data)
+        counts = np.asarray(resp.group_counts)
+        for g in np.nonzero(counts)[0]:
+            key = tuple(str(v[g]) for v in resp.group_values)
+            assert int(counts[g]) == ref[key][2], f"grouped {key}"
+        return {
+            "rows": n,
+            "wall_s": round(wall, 4),
+            "rows_per_s": round(n / wall, 1),
+            "path": grouped.get("path"),
+            "dict_merge_s": grouped.get("dict_merge_s"),
+            "build_s": stream.get("build_s"),
+            "kernel_s": grouped.get("kernel_s"),
+            "combine_s": grouped.get("combine_s"),
+            "num_slots": grouped.get("num_slots"),
+            "slots_occupied": grouped.get("slots_occupied"),
+            "spilled_rows": grouped.get("spilled_rows"),
+            "chunks": stream.get("chunks"),
+            "launches_per_scan": compile_launches,
+            "kernel_compiles": _SHARED_KERNEL.compiles - c0,
+        }
+    finally:
+        flags.REGISTRY.reset("streaming_chunk_rows")
 
 
 def main():

@@ -202,6 +202,64 @@ class TestJitHazards:
             """}, "jit_hazards")
         assert r["findings"] == []
 
+    def test_grouped_scatter_idiom_clean(self, tmp_path):
+        # the grouped-aggregation kernel's segment-sum/scatter-add shape
+        # (ops/grouped_scan.grouped_reduce): group-slot count S is a
+        # STATIC pow2 (part of the signature — branching on it is
+        # fine), dictionary domain sizes arrive as TRACED scalars and
+        # only ever feed jnp arithmetic, the spill count leaves the
+        # kernel as an output instead of steering trace-time control
+        # flow
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import jax
+            import jax.numpy as jnp
+            from functools import partial
+            @partial(jax.jit, static_argnames=("S",))
+            def grouped(codes, vals, mask, domains, S):
+                gid = None
+                stride = jnp.int64(1)
+                for i in range(len(domains)):   # static arity: fine
+                    c = codes.astype(jnp.int64)
+                    gid = c * stride if gid is None else gid + c * stride
+                    stride = stride * domains[i].astype(jnp.int64)
+                spill_slot = S - 1           # static math on S: fine
+                in_range = gid < spill_slot
+                spilled = jnp.sum(mask & jnp.logical_not(in_range))
+                gid_c = jnp.where(mask & in_range, gid,
+                                  spill_slot).astype(jnp.int32)
+                out = jnp.zeros(S, jnp.int64).at[gid_c].add(
+                    jnp.where(mask, vals, 0))
+                if S > 4:                    # static branch: fine
+                    out = out + 0
+                return out, spilled
+            """}, "jit_hazards")
+        assert r["findings"] == []
+
+    def test_grouped_scatter_idiom_true_positives(self, tmp_path):
+        # the shapes the grouped kernel must NEVER take: the traced
+        # spill count / domain product steering Python control flow, or
+        # a host round-trip mid-trace to size the slot array
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import jax
+            import jax.numpy as jnp
+            @jax.jit
+            def bad_grouped(codes, vals, mask, dom):
+                prod = dom * 2
+                if prod > 4096:            # python branch on traced
+                    return jnp.zeros(1, jnp.int64), jnp.int64(0)
+                spilled = jnp.sum(mask)
+                n = int(spilled)           # host cast of traced count
+                gid = codes.astype(jnp.int32)
+                out = jnp.zeros(4096, jnp.int64).at[gid].add(
+                    jnp.where(mask, vals, 0))
+                while spilled > 0:         # python loop on traced
+                    spilled = spilled - 1
+                return out, spilled
+            """}, "jit_hazards")
+        details = sorted(d for _, _, d in _findings(r))
+        assert details == ["bad_grouped:if", "bad_grouped:int",
+                           "bad_grouped:while"]
+
 
 class TestFlagDrift:
     FILES = {

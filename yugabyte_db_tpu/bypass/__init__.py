@@ -19,9 +19,10 @@ reason and callers fall back to the RPC path, which serves everything.
 """
 from .errors import (ALL_REASONS, REASON_COLUMN_NOT_FIXED,
                      REASON_EXPR_SHAPE, REASON_FLAG_OFF,
-                     REASON_HASH_GROUP, REASON_MEMTABLE_ACTIVE,
-                     REASON_NO_COLUMNAR, REASON_NO_SSTS,
-                     REASON_NOT_AGGREGATE, REASON_NOT_CHUNK_SAFE,
+                     REASON_GROUPED_OFF, REASON_HASH_GROUP,
+                     REASON_MEMTABLE_ACTIVE, REASON_NO_COLUMNAR,
+                     REASON_NO_SSTS, REASON_NOT_AGGREGATE,
+                     REASON_NOT_CHUNK_SAFE, REASON_SLOT_OVERFLOW,
                      BypassIneligible)
 from .pinner import TabletSnapshot, pin_tablet
 from .scan import (bypass_scan_aggregate, collect_keyless_blocks,
@@ -31,8 +32,9 @@ from .session import BypassSession, combine_partials
 __all__ = [
     "ALL_REASONS", "BypassIneligible", "BypassSession",
     "REASON_COLUMN_NOT_FIXED", "REASON_EXPR_SHAPE", "REASON_FLAG_OFF",
-    "REASON_HASH_GROUP", "REASON_MEMTABLE_ACTIVE", "REASON_NO_COLUMNAR",
-    "REASON_NO_SSTS", "REASON_NOT_AGGREGATE", "REASON_NOT_CHUNK_SAFE",
+    "REASON_GROUPED_OFF", "REASON_HASH_GROUP", "REASON_MEMTABLE_ACTIVE",
+    "REASON_NO_COLUMNAR", "REASON_NO_SSTS", "REASON_NOT_AGGREGATE",
+    "REASON_NOT_CHUNK_SAFE", "REASON_SLOT_OVERFLOW",
     "TabletSnapshot", "bypass_scan_aggregate", "collect_keyless_blocks",
     "combine_partials", "open_snapshot_readers", "pin_tablet",
 ]

@@ -32,11 +32,18 @@ REASON_EXPR_SHAPE = "expr_shape"
 #: no aggregates in the request (the bypass engine serves
 #: scan-and-aggregate shapes only, not row streams)
 REASON_NOT_AGGREGATE = "not_aggregate"
+#: dict-grouped scan overflowed the device slot budget — the RPC path's
+#: interpreted GROUP BY serves the over-cardinality group set
+REASON_SLOT_OVERFLOW = "grouped_slot_overflow"
+#: dict-grouped scan while grouped_pushdown_enabled is off — the RPC
+#: path's interpreted GROUP BY is the flag-off contract
+REASON_GROUPED_OFF = "grouped_pushdown_off"
 
 ALL_REASONS = (
     REASON_FLAG_OFF, REASON_MEMTABLE_ACTIVE, REASON_NO_SSTS,
     REASON_NO_COLUMNAR, REASON_NOT_CHUNK_SAFE, REASON_COLUMN_NOT_FIXED,
     REASON_HASH_GROUP, REASON_EXPR_SHAPE, REASON_NOT_AGGREGATE,
+    REASON_SLOT_OVERFLOW, REASON_GROUPED_OFF,
 )
 
 

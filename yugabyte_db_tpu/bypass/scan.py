@@ -27,6 +27,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..ops.device_batch import bucket_rows, build_batch
+from ..ops.grouped_scan import DictGroupSpec
 from ..ops.scan import AggSpec, HashGroupSpec, ScanKernel, _expand_avg
 from ..ops.stream_scan import (LAST_STREAM_STATS, chunk_safe_mvcc,
                                streaming_scan_aggregate)
@@ -34,8 +35,9 @@ from ..storage.columnar import KEY_REBUILD_STATS, ColumnarBlock
 from ..storage.sst import SstReader
 from ..utils import flags
 from .errors import (REASON_COLUMN_NOT_FIXED, REASON_EXPR_SHAPE,
-                     REASON_HASH_GROUP, REASON_NO_COLUMNAR,
-                     REASON_NOT_AGGREGATE, REASON_NOT_CHUNK_SAFE,
+                     REASON_GROUPED_OFF, REASON_HASH_GROUP,
+                     REASON_NO_COLUMNAR, REASON_NOT_AGGREGATE,
+                     REASON_NOT_CHUNK_SAFE, REASON_SLOT_OVERFLOW,
                      BypassIneligible)
 from .prefilter import make_prefilter
 
@@ -90,14 +92,29 @@ def bypass_scan_aggregate(
         kernel: Optional[ScanKernel] = None,
         chunk_rows: Optional[int] = None,
         prefilter_enabled: Optional[bool] = None,
-        min_chunks: int = 3) -> Tuple[tuple, np.ndarray, dict]:
+        min_chunks: int = 3,
+        grouped_out: Optional[dict] = None
+        ) -> Tuple[tuple, np.ndarray, dict]:
     """Aggregate `blocks` at `read_ht` without touching the tserver.
     Returns (agg_values, counts, stats); raises BypassIneligible with a
-    typed reason for every shape the engine cannot serve exactly."""
+    typed reason for every shape the engine cannot serve exactly.
+
+    A :class:`DictGroupSpec` group serves KEYLESSLY too: string group
+    columns ride as dictionary codes (stored v2 dict lanes or the
+    per-block byte-level unique — row strings never decode), the
+    grouped kernel aggregates into slot arrays, and the caller receives
+    COMPACTED per-shard partials — ``grouped_out['group_values']``
+    carries the decoded string keys aligned with the returned counts,
+    ready for the shared group-keyed combine.  Slot overflow raises
+    ``REASON_SLOT_OVERFLOW`` (the RPC path's interpreted GROUP BY
+    serves the over-cardinality set)."""
     if not aggs:
         raise BypassIneligible(REASON_NOT_AGGREGATE)
     if isinstance(group, HashGroupSpec):
         raise BypassIneligible(REASON_HASH_GROUP)
+    dict_group = isinstance(group, DictGroupSpec)
+    if dict_group and not flags.get("grouped_pushdown_enabled"):
+        raise BypassIneligible(REASON_GROUPED_OFF)
     from ..ops.expr import device_compatible, referenced_columns
     if where is not None and not device_compatible(where):
         raise BypassIneligible(REASON_EXPR_SHAPE, "where")
@@ -110,11 +127,17 @@ def bypass_scan_aggregate(
     for a in aggs:
         if a.expr is not None:
             referenced_columns(a.expr, needed)
-    if group is not None:
+    if dict_group:
+        needed.update(group.cols)
+    elif group is not None:
         needed.update(cid for cid, _, _ in group.cols)
     for b in blocks:
         for cid in needed:
-            if not (cid in b.fixed or cid in b.pk):
+            # varlen (string) columns are servable too: they ride as
+            # dictionary codes (string predicates compare as integers,
+            # DictGroupSpec keys aggregate as code strides); columns
+            # with no columnar form at all stay typed-ineligible
+            if not (cid in b.fixed or cid in b.pk or cid in b.varlen):
                 raise BypassIneligible(
                     REASON_COLUMN_NOT_FIXED, f"column {cid}")
     # the ONE structural gate: every doc key lives wholly inside one
@@ -134,23 +157,49 @@ def bypass_scan_aggregate(
               if a.op in ("min", "max")]
     aggs_run = expanded + tuple(AggSpec("count", expanded[i].expr)
                                 for i in minmax)
+    # the near-data prefilter compacts blocks through the fused
+    # FIXED-lane gather — compacted pseudo-blocks carry no varlen
+    # lanes, so a scan whose columns ride as dictionary codes (string
+    # predicates, DictGroupSpec group keys) must run unfiltered; the
+    # streaming path makes the same call (compacted blocks would have
+    # no dictionary remap entries)
+    rides_codes = any(
+        not all(cid in b.fixed or cid in b.pk for b in blocks)
+        for cid in cols_sorted)
     pf = (make_prefilter(where, cols_sorted)
-          if prefilter_enabled else None)
+          if prefilter_enabled and not rides_codes else None)
     stats: dict = {}
+    gout: Optional[dict] = {} if dict_group else None
     got = streaming_scan_aggregate(
         blocks, cols_sorted, where, aggs_run, group, read_ht,
         kernel=kernel, chunk_rows=chunk_rows, prefilter=pf,
-        min_chunks=min_chunks)
+        min_chunks=min_chunks, grouped_out=gout)
+    group_dicts = None
     if got is None:
         got = _monolithic_twin(blocks, cols_sorted, where, aggs_run,
                                group, read_ht, kernel, pf)
+        if dict_group:
+            got, group_dicts = got
         stats["path"] = "monolithic"
     else:
+        if dict_group:
+            if gout.get("spill"):
+                raise BypassIneligible(
+                    REASON_SLOT_OVERFLOW,
+                    f"{gout['spill']} rows past "
+                    f"{gout['num_slots']} slots")
+            group_dicts = gout["dicts"]
         stats["path"] = "streaming"
         stats.update(LAST_STREAM_STATS)
     outs, counts = got
     from ..docdb.operations import _nullify_minmax
     outs = _nullify_minmax(expanded, minmax, outs)
+    if dict_group:
+        from ..ops.grouped_scan import decode_slot_groups
+        outs, counts, gvals = decode_slot_groups(
+            group, group_dicts, outs, counts)
+        if grouped_out is not None:
+            grouped_out["group_values"] = gvals
     stats["key_rebuilds"] = KEY_REBUILD_STATS["rebuilds"] - rebuilds0
     if pf is not None:
         from .prefilter import LAST_PREFILTER_STATS
@@ -165,22 +214,62 @@ def _monolithic_twin(blocks, cols_sorted, where, aggs_run, group,
                      read_ht, kernel, pf):
     """The under-min_chunks shape, mirroring the RPC monolithic
     aggregate path bit-for-bit (zone-prune gate, single bucket over the
-    kept rows, unique_keys forced off for multi-block inputs) so bypass
-    results stay byte-identical whichever shape the row count picks."""
+    kept rows, unique_keys forced off for multi-block inputs, string
+    predicates rewritten against the batch dictionaries) so bypass
+    results stay byte-identical whichever shape the row count picks.
+    Dict-grouped scans return ``((outs, counts), batch dictionaries)``
+    — the caller decodes slots through the same dictionaries the group
+    ids were encoded with."""
     from ..ops.scan import zone_prune_blocks
     kept = list(blocks)
     if where is not None and flags.get("zone_map_pruning"):
         # bypass blocks are always chunk-safe (the caller verified), so
         # pruning is unconditionally sound here
         kept, _ = zone_prune_blocks(kept, where)
-    if pf is not None:
-        batch = build_batch(
-            pf(kept), cols_sorted,
-            pad_to=bucket_rows(max(sum(b.n for b in kept), 1)),
-            bounds_blocks=kept)
-    else:
-        batch = build_batch(kept, cols_sorted)
+    try:
+        if pf is not None:
+            batch = build_batch(
+                pf(kept), cols_sorted,
+                pad_to=bucket_rows(max(sum(b.n for b in kept), 1)),
+                bounds_blocks=kept)
+        else:
+            batch = build_batch(kept, cols_sorted)
+    except KeyError as e:
+        # build_batch's documented fall-back contract: a varlen column
+        # that can't dictionary-encode (binary / non-UTF8 payloads)
+        # raises KeyError — typed here so client routing falls back to
+        # the RPC path instead of crashing.  Scoped to the batch build
+        # alone: a KeyError from kernel dispatch below would be a real
+        # bug and must propagate, not masquerade as ineligibility.
+        raise BypassIneligible(REASON_COLUMN_NOT_FIXED, str(e))
     if len(blocks) > 1:
         batch.unique_keys = False
+    if batch.dicts and (where is not None
+                        or any(a.expr is not None for a in aggs_run)):
+        from ..docdb.operations import DocReadOperation
+        try:
+            where, aggs_run = DocReadOperation.rewrite_where_and_aggs(
+                where, aggs_run, batch.dicts)
+        except DocReadOperation._Unrewritable:
+            raise BypassIneligible(
+                REASON_EXPR_SHAPE, "string column outside a "
+                "rewritable predicate shape")
+    if isinstance(group, DictGroupSpec):
+        from ..ops.grouped_scan import domain_product
+        if any(c not in batch.dicts for c in group.cols):
+            raise BypassIneligible(
+                REASON_COLUMN_NOT_FIXED,
+                "group column has no dictionary form")
+        if domain_product(group, batch.dicts) >= 2 ** 31:
+            raise BypassIneligible(
+                REASON_SLOT_OVERFLOW,
+                "group domain product exceeds 2^31 (group id would "
+                "wrap)")
+        outs, counts, _, spill = kernel.run(batch, where, aggs_run,
+                                            group, read_ht)
+        if int(spill) > 0:
+            raise BypassIneligible(
+                REASON_SLOT_OVERFLOW, f"{int(spill)} rows spilled")
+        return (outs, counts), batch.dicts
     outs, counts, _ = kernel.run(batch, where, aggs_run, group, read_ht)
     return outs, counts

@@ -16,6 +16,7 @@ cannot queue behind (or ahead of) point traffic on the event loop.
 """
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -138,7 +139,8 @@ class BypassSession:
 
     # ------------------------------------------------------------------
     def scan_aggregate(self, where, aggs: Sequence[AggSpec],
-                       group=None, combine: str = "host"
+                       group=None, combine: str = "host",
+                       grouped_out: Optional[dict] = None
                        ) -> Tuple[tuple, Optional[np.ndarray], dict]:
         """Run one aggregate scan at the session read point across all
         pinned shards.  combine='host' reproduces the RPC fan-out's
@@ -146,14 +148,29 @@ class BypassSession:
         device mesh (one device per shard; raises ValueError when the
         backend has too few devices — no silent fallback, callers pick
         deliberately).  Raises BypassIneligible (typed) when any shard
-        can't be served exactly."""
+        can't be served exactly.
+
+        Dict-grouped scans (:class:`DictGroupSpec`) merge per-shard
+        COMPACTED partials by group key through
+        ``ops.scan.combine_grouped_partials`` — the exact function the
+        client's RPC fan-out combine uses, so bypass and RPC grouped
+        results cannot drift; pass ``grouped_out`` (a dict) to receive
+        ``{'group_values': per-column key arrays}`` aligned with the
+        returned counts.  Mesh combine does not serve grouped scans
+        (per-shard dictionaries don't align into one psum lattice)."""
         if self._closed:
             raise RuntimeError("BypassSession is closed")
+        from ..ops.grouped_scan import DictGroupSpec
+        dict_group = isinstance(group, DictGroupSpec)
         if combine == "mesh":
+            if dict_group:
+                raise ValueError(
+                    "mesh combine does not serve dict-grouped scans; "
+                    "use combine='host'")
             return self._scan_mesh(where, aggs, group)
         if combine != "host":
             raise ValueError(f"unknown combine mode {combine!r}")
-        parts, counts_parts = [], []
+        parts, counts_parts, grouped_parts = [], [], []
         stats = self.stats()
         stats.update(key_rebuilds=0, prefilter_rows_in=0,
                      prefilter_rows_kept=0, combine="host",
@@ -161,13 +178,18 @@ class BypassSession:
         for blocks in self._blocks:
             if not blocks:
                 continue            # empty shard: combine identity
+            gout: dict = {}
             outs, counts, sstats = bypass_scan_aggregate(
                 blocks, where, aggs, group, self.read_ht,
                 chunk_rows=self.chunk_rows,
                 prefilter_enabled=self.prefilter,
-                min_chunks=self.min_chunks)
+                min_chunks=self.min_chunks,
+                grouped_out=gout if dict_group else None)
             parts.append(outs)
             counts_parts.append(counts)
+            if dict_group:
+                grouped_parts.append(
+                    (outs, counts, gout["group_values"]))
             stats["shards_scanned"] += 1
             stats["key_rebuilds"] += sstats.get("key_rebuilds", 0)
             stats["prefilter_rows_in"] += sstats.get(
@@ -178,6 +200,15 @@ class BypassSession:
         if not parts:
             raise BypassIneligible(REASON_NO_SSTS,
                                    "every shard is empty")
+        if dict_group:
+            from ..ops.scan import combine_grouped_partials
+            t0 = time.perf_counter()
+            outs, counts, gvals = combine_grouped_partials(
+                tuple(_expand_avg(aggs)), grouped_parts)
+            stats["combine_s"] = round(time.perf_counter() - t0, 4)
+            if grouped_out is not None:
+                grouped_out["group_values"] = gvals
+            return outs, counts, stats
         outs, counts = combine_partials(aggs, parts, counts_parts)
         return outs, counts, stats
 

@@ -22,7 +22,7 @@ from ..docdb.wire import (
 from ..dockv.partition import Partition
 # partial-combine rules + scalar unwrap shared with the bypass
 # session's host combine (ops/scan.py — one implementation, no drift)
-from ..ops.scan import _mm2, _scalar_of as _item, combine_agg_partials
+from ..ops.scan import combine_agg_partials
 from ..rpc.messenger import Messenger, RpcError
 
 
@@ -794,19 +794,22 @@ class YBClient:
             # heavy synchronous pin+scan work; the executor keeps the
             # event loop (and with it every point RPC this client has
             # in flight) unblocked — the isolation the subsystem is for
+            gout: dict = {}
             with BypassSession(tablets, read_ht=req.read_ht) as s:
                 outs, counts, stats = s.scan_aggregate(
-                    req.where, req.aggregates, req.group_by)
-                return outs, counts, stats
+                    req.where, req.aggregates, req.group_by,
+                    grouped_out=gout)
+                return outs, counts, gout.get("group_values"), stats
         loop = asyncio.get_running_loop()
         try:
-            outs, counts, stats = await loop.run_in_executor(None, _run)
+            outs, counts, gvals, stats = await loop.run_in_executor(
+                None, _run)
         except BypassIneligible as e:
             self.last_bypass["reason"] = e.reason
             return await self.scan(table, req)
         self.last_bypass = {"used": True, "reason": None, "stats": stats}
         return ReadResponse(agg_values=outs, group_counts=counts,
-                            backend="bypass")
+                            group_values=gvals, backend="bypass")
 
     async def scan_pages(self, table: str, req: ReadRequest,
                          page_size: int = 1000):
@@ -851,50 +854,27 @@ class YBClient:
                 rows = rows[:req.limit]
             return ReadResponse(rows=rows,
                                 backend=parts[0].backend if parts else "cpu")
-        from ..ops.scan import HashGroupSpec, _expand_avg
+        from ..ops.grouped_scan import DictGroupSpec
+        from ..ops.scan import (HashGroupSpec, _expand_avg,
+                                combine_grouped_partials)
         aggs = _expand_avg(req.aggregates)
-        if isinstance(req.group_by, HashGroupSpec):
-            return self._combine_hash_groups(aggs, parts)
+        if isinstance(req.group_by, (HashGroupSpec, DictGroupSpec)):
+            # merge per-tablet grouped partials BY GROUP KEY — slots
+            # aren't aligned across tablets (each shard merges its own
+            # dictionary / sees its own distinct hash keys).  ONE shared
+            # implementation with the bypass host combine (reference
+            # analog: pggate's client-side grouped-partial combine).
+            outs, counts, gvals = combine_grouped_partials(
+                aggs, [(p.agg_values, p.group_counts, p.group_values)
+                       for p in parts])
+            return ReadResponse(agg_values=outs, group_counts=counts,
+                                group_values=gvals,
+                                backend=parts[0].backend if parts
+                                else "cpu")
         total, counts = combine_agg_partials(
             aggs, [p.agg_values for p in parts],
             [p.group_counts for p in parts])
         return ReadResponse(agg_values=total, group_counts=counts,
-                            backend=parts[0].backend if parts else "cpu")
-
-    def _combine_hash_groups(self, aggs, parts: List[ReadResponse]
-                             ) -> ReadResponse:
-        """Merge per-tablet hash-grouped partials BY GROUP KEY — slots
-        aren't aligned across tablets the way dictionary group ids are
-        (reference analog: pggate's client-side grouped-partial
-        combine)."""
-        merged: Dict[tuple, list] = {}
-        for p in parts:
-            if p.group_counts is None:
-                continue
-            counts = np.asarray(p.group_counts)
-            gvals = [np.asarray(g) for g in (p.group_values or ())]
-            vals = [np.asarray(v) for v in p.agg_values]
-            for g in np.nonzero(counts)[0]:
-                key = tuple(x[g].item() for x in gvals)
-                st = merged.get(key)
-                if st is None:
-                    merged[key] = [[v[g] for v in vals], int(counts[g])]
-                    continue
-                for i, a in enumerate(aggs):
-                    if a.op in ("sum", "count"):
-                        st[0][i] = st[0][i] + vals[i][g]
-                    else:
-                        st[0][i] = _mm2(_item(st[0][i]),
-                                       _item(vals[i][g]), a.op)
-                st[1] += int(counts[g])
-        keys = list(merged)
-        outs = tuple(np.asarray([merged[k][0][i] for k in keys])
-                     for i in range(len(aggs)))
-        counts = np.asarray([merged[k][1] for k in keys], np.int64)
-        gvals = tuple(np.asarray([k[j] for k in keys])
-                      for j in range(len(keys[0]) if keys else 0))
-        return ReadResponse(agg_values=outs, group_counts=counts,
-                            group_values=gvals,
                             backend=parts[0].backend if parts else "cpu")
 
     # --- vector search ------------------------------------------------------

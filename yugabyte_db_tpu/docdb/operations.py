@@ -23,6 +23,7 @@ import numpy as np
 from ..dockv.key_encoding import ValueType
 from ..dockv.value import PrimitiveValue, ValueKind, unwrap_ttl
 from ..ops.device_batch import build_batch
+from ..ops.grouped_scan import DictGroupSpec
 from ..ops.scan import AggSpec, GroupSpec, HashGroupSpec, ScanKernel
 from ..storage.columnar import ColumnarBlock, fnv64_bytes
 from ..storage.lsm import LsmStore, WriteBatch
@@ -1291,6 +1292,23 @@ class DocReadOperation:
         pass
 
     @classmethod
+    def rewrite_where_and_aggs(cls, where, aggs, dicts):
+        """Apply :meth:`_rewrite_strings` to a WHERE node and every
+        AggSpec expr in one shot — ``(where, aggs)`` in dictionary-code
+        space.  THE one rewrite entry shared by the monolithic device
+        path, the streaming dictionary plan and the bypass twin, so the
+        three routes cannot drift.  Raises ``_Unrewritable``; callers
+        pick their fallback (device paths return None, bypass raises a
+        typed reason)."""
+        if where is not None:
+            where = cls._rewrite_strings(where, dicts)
+        aggs = tuple(
+            AggSpec(a.op, cls._rewrite_strings(a.expr, dicts)
+                    if a.expr is not None else None)
+            for a in aggs)
+        return where, aggs
+
+    @classmethod
     def _rewrite_strings(cls, node, dicts):
         """Translate string predicates into dictionary-code space so
         they run in the device kernel (SURVEY §7 hard-part 3; reference:
@@ -1440,11 +1458,17 @@ class DocReadOperation:
         return kept, ("zp", kept_idx)
 
     def _try_streaming_aggregate(self, req: ReadRequest, blocks, needed,
-                                 read_ht: int) -> Optional[ReadResponse]:
+                                 read_ht: int):
         """Chunked pipelined aggregate (ops/stream_scan.py) for scans it
         can serve exactly; None falls through to the monolithic batch.
-        Dictionary-column predicates, hash grouping, and MVCC-unsafe
-        block sequences are rejected inside streaming_scan_aggregate."""
+        Hash grouping and MVCC-unsafe block sequences are rejected
+        inside streaming_scan_aggregate; string (dictionary) columns —
+        predicates and DictGroupSpec group keys — stream through the
+        scan-global dictionary plan.  Returns ``_SPILLED`` when a
+        dict-grouped scan overflowed its slot budget: the monolithic
+        batch would spill identically (same dictionaries, same slot
+        bucket), so the caller must go STRAIGHT to the interpreted
+        GROUP BY instead of paying a second full device pass."""
         if not flags.get("streaming_scan_enabled"):
             return None
         from ..ops.stream_scan import streaming_scan_aggregate
@@ -1457,11 +1481,20 @@ class DocReadOperation:
                   if a.op in ("min", "max")]
         aggs_run = expanded + tuple(AggSpec("count", expanded[i].expr)
                                     for i in minmax)
+        dict_group = isinstance(req.group_by, DictGroupSpec)
+        grouped_out: Optional[dict] = {} if dict_group else None
         got = streaming_scan_aggregate(
             blocks, sorted(needed), req.where, aggs_run, req.group_by,
-            read_ht, kernel=self.kernel, cache=cache, cache_key=key)
+            read_ht, kernel=self.kernel, cache=cache, cache_key=key,
+            grouped_out=grouped_out)
         if got is None:
             return None
+        if dict_group and grouped_out.get("spill"):
+            # slot overflow: the spill slot aggregated an unknown mix of
+            # groups — results are unusable, revert to the interpreter
+            from ..ops.grouped_scan import GROUPED_STATS
+            GROUPED_STATS["spill_fallbacks"] += 1
+            return _SPILLED
         # uncertainty-window restart check only once the streaming path
         # is actually serving the read — a scan that falls through to
         # the monolithic/CPU paths keeps their own (possibly narrower)
@@ -1469,6 +1502,13 @@ class DocReadOperation:
         self._check_restart_window(blocks, read_ht)
         outs, counts = got
         outs = _nullify_minmax(expanded, minmax, outs)
+        if dict_group:
+            from ..ops.grouped_scan import decode_slot_groups
+            outs_c, counts_c, gvals = decode_slot_groups(
+                req.group_by, grouped_out["dicts"], outs, counts)
+            return ReadResponse(agg_values=outs_c,
+                                group_counts=counts_c,
+                                group_values=gvals, backend="tpu")
         return ReadResponse(agg_values=outs,
                             group_counts=np.asarray(counts),
                             backend="tpu")
@@ -1497,12 +1537,17 @@ class DocReadOperation:
         for a in req.aggregates:
             if a.expr is not None:
                 referenced_columns(a.expr, needed)
-        if isinstance(req.group_by, HashGroupSpec):
+        if isinstance(req.group_by, (HashGroupSpec, DictGroupSpec)):
             needed.update(req.group_by.cols)
         elif req.group_by is not None:
             needed.update(cid for cid, _, _ in req.group_by.cols)
+        if isinstance(req.group_by, DictGroupSpec) \
+                and not flags.get("grouped_pushdown_enabled"):
+            return None     # interpreted GROUP BY (the flag-off path)
         read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
         resp = self._try_streaming_aggregate(req, blocks, needed, read_ht)
+        if resp is _SPILLED:
+            return None     # over-cardinality: interpreted GROUP BY
         if resp is not None:
             return resp
         # zone-map pruning ahead of the monolithic batch build; the
@@ -1525,13 +1570,8 @@ class DocReadOperation:
             # runs even with no dictionaries: a leftover 'like' (or any
             # string shape the kernel can't compile) must fall back
             try:
-                if where is not None:
-                    where = self._rewrite_strings(where, batch.dicts)
-                aggregates = tuple(
-                    AggSpec(a.op,
-                            self._rewrite_strings(a.expr, batch.dicts)
-                            if a.expr is not None else None)
-                    for a in aggregates)
+                where, aggregates = self.rewrite_where_and_aggs(
+                    where, aggregates, batch.dicts)
             except self._Unrewritable:
                 return None   # string column outside a rewritable shape
         # SQL NULL semantics for MIN/MAX over zero qualifying inputs:
@@ -1558,6 +1598,24 @@ class DocReadOperation:
                 group_counts=np.asarray(counts),
                 group_values=tuple(np.asarray(g) for g in gvals),
                 backend="tpu")
+        if isinstance(req.group_by, DictGroupSpec):
+            from ..ops.grouped_scan import (GROUPED_STATS,
+                                            decode_slot_groups,
+                                            domain_product)
+            gspec = req.group_by
+            if any(c not in batch.dicts for c in gspec.cols) or \
+                    domain_product(gspec, batch.dicts) >= 2 ** 31:
+                return None     # no dictionary / gid would wrap: CPU
+            outs, counts, _, spill = self.kernel.run(
+                batch, where, aggs_run, gspec, read_ht)
+            if int(spill) > 0:
+                GROUPED_STATS["spill_fallbacks"] += 1
+                return None     # slot overflow: interpreted GROUP BY
+            outs_c, counts_c, gvals = decode_slot_groups(
+                gspec, batch.dicts, _nullify(outs), counts)
+            return ReadResponse(agg_values=outs_c,
+                                group_counts=counts_c,
+                                group_values=gvals, backend="tpu")
         outs, counts, _ = self.kernel.run(
             batch, where, aggs_run, req.group_by, read_ht)
         return ReadResponse(agg_values=_nullify(outs),
@@ -1578,6 +1636,10 @@ class DocReadOperation:
         proj_cols = ([schema.column_by_name(n) for n in req.columns]
                      if req.columns else list(schema.columns))
         read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
+        resp = self._try_streaming_filter(req, blocks, needed,
+                                          proj_cols, read_ht)
+        if resp is not None:
+            return resp
         all_blocks = blocks
         blocks, prune_key = self._zone_prune(blocks, req.where, read_ht)
         try:
@@ -1598,7 +1660,52 @@ class DocReadOperation:
         sel = np.nonzero(np.asarray(mask))[0]
         if req.limit is not None and len(sel) > req.limit:
             sel = sel[:req.limit]
-        # gather projected columns across blocks (vectorized per column)
+        rows = self._gather_rows(blocks, sel, proj_cols)
+        if rows is None:
+            return None   # column unavailable in columnar form
+        return ReadResponse(rows=rows, backend="tpu")
+
+    def _try_streaming_filter(self, req: ReadRequest, blocks, needed,
+                              proj_cols, read_ht: int
+                              ) -> Optional[ReadResponse]:
+        """Streamed filter-pushdown ROW path: per-chunk WHERE masks on
+        device overlapped with the next chunk's batch formation, rows
+        gathered host-side per chunk (ops/stream_scan.py
+        streaming_scan_filter). None falls through to the monolithic
+        batch."""
+        if not flags.get("streaming_scan_enabled"):
+            return None
+        # projection availability must hold for EVERY block up front:
+        # the per-chunk materializer cannot un-stream rows it already
+        # emitted when a later chunk's block lacks a column
+        for b in blocks:
+            for c in proj_cols:
+                if not (c.id in b.fixed or c.id in b.pk
+                        or c.id in b.varlen):
+                    return None
+        from ..ops.stream_scan import streaming_scan_filter
+        cache = self.device_cache
+        key = (self._batch_cache_key(needed) + ("rows",)
+               if cache is not None else None)
+
+        def materialize(chunk_blocks, sel):
+            return self._gather_rows(chunk_blocks, sel, proj_cols) or []
+
+        rows = streaming_scan_filter(
+            blocks, sorted(needed), req.where, read_ht, materialize,
+            limit=req.limit, kernel=self.kernel, cache=cache,
+            cache_key=key)
+        if rows is None:
+            return None
+        return ReadResponse(rows=rows, backend="tpu")
+
+    def _gather_rows(self, blocks, sel, proj_cols
+                     ) -> Optional[List[Dict[str, object]]]:
+        """Materialize selected row indices (positions in the
+        concatenated block list) into projected row dicts — vectorized
+        per (column, block); shared by the monolithic and streamed
+        filter-pushdown row paths. None when a projected column has no
+        columnar form."""
         rows: List[Dict[str, object]] = [dict() for _ in range(len(sel))]
         offsets = np.cumsum([0] + [b.n for b in blocks])
         blk_of = np.searchsorted(offsets, sel, side="right") - 1
@@ -1632,7 +1739,7 @@ class DocReadOperation:
                                                else raw)
                 else:
                     return None   # column unavailable in columnar form
-        return ReadResponse(rows=rows, backend="tpu")
+        return rows
 
     def _scan_segments(self, req: ReadRequest):
         """Skip-scan segments for range-sharded tables (reference:
@@ -1760,6 +1867,11 @@ class DocReadOperation:
 _MAX_HT = 0xFFFFFFFFFFFFFFFF - 1
 _SHARED_KERNEL = ScanKernel()
 
+#: sentinel from _try_streaming_aggregate: the dict-grouped scan
+#: overflowed its slot budget — skip the monolithic device pass (it
+#: would spill identically) and serve the interpreted GROUP BY
+_SPILLED = object()
+
 
 def _expand_avg_cpu(aggs):
     for a in aggs:
@@ -1798,7 +1910,9 @@ def _agg_accumulate(aggs, agg_state, group_state, group, idrow):
         for i, a in enumerate(aggs):
             agg_state[i] = _agg_step(a, agg_state[i], idrow)
         return
-    if isinstance(group, HashGroupSpec):
+    if isinstance(group, (HashGroupSpec, DictGroupSpec)):
+        # interpreted GROUP BY keys by value tuple — the slot-overflow
+        # and flag-off fallback for DictGroupSpec lands here
         key = tuple(idrow.get(cid) for cid in group.cols)
         if any(v is None for v in key):
             return       # NULL group values are excluded (matches device)
@@ -1830,7 +1944,7 @@ def _agg_final(a: AggSpec, state):
 
 
 def _grouped_cpu_response(aggs, group_state, group) -> ReadResponse:
-    if isinstance(group, HashGroupSpec):
+    if isinstance(group, (HashGroupSpec, DictGroupSpec)):
         keys = list(group_state)
         G = len(keys)
         outs = []

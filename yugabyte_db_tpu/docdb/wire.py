@@ -10,6 +10,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..ops.grouped_scan import DictGroupSpec
 from ..ops.scan import AggSpec, GroupSpec, HashGroupSpec
 from .operations import ReadRequest, ReadResponse, RowOp, WriteRequest, \
     WriteResponse
@@ -69,6 +70,9 @@ def read_request_to_wire(req: ReadRequest) -> dict:
             {"hash": list(req.group_by.cols),
              "max": req.group_by.max_groups}
             if isinstance(req.group_by, HashGroupSpec)
+            else {"dict": list(req.group_by.cols),
+                  "max": req.group_by.max_slots}
+            if isinstance(req.group_by, DictGroupSpec)
             else list(req.group_by.cols) if req.group_by else None),
         "pk_eq": req.pk_eq,
         "pk_prefix": req.pk_prefix,
@@ -87,8 +91,11 @@ def read_request_from_wire(d: dict) -> ReadRequest:
         aggregates=tuple(AggSpec(op, _expr_from_wire(e))
                          for op, e in (d.get("aggregates") or [])),
         group_by=(
-            HashGroupSpec(tuple(d["group_by"]["hash"]),
-                          d["group_by"].get("max", 4096))
+            (HashGroupSpec(tuple(d["group_by"]["hash"]),
+                           d["group_by"].get("max", 4096))
+             if "hash" in d["group_by"]
+             else DictGroupSpec(tuple(d["group_by"]["dict"]),
+                                d["group_by"].get("max", 4096)))
             if isinstance(d.get("group_by"), dict)
             else GroupSpec(tuple(tuple(c) for c in d["group_by"]))
             if d.get("group_by") else None),

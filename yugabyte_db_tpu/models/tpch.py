@@ -60,6 +60,41 @@ def lineitem_range_info() -> TableInfo:
                      PartitionSchema("range", 0))
 
 
+#: TPC-H's actual flag domains — the string-keyed lineitem variant maps
+#: the synthetic int codes onto them so Q1's GROUP BY runs over real
+#: dictionary-encoded string columns (the dict-key grouped kernel's
+#: target shape)
+RETFLAG_STRINGS = np.array(["A", "N", "R"], object)
+LINESTATUS_STRINGS = np.array(["F", "O"], object)
+
+
+def lineitem_str_info() -> TableInfo:
+    """Range-sharded lineitem clone with STRING l_returnflag /
+    l_linestatus (the TPC-H spec's actual types). Q1 over this shape is
+    the dict-key grouped-aggregation benchmark: group keys ride as
+    dictionary codes, the GROUP BY aggregates on device, and the
+    interpreted row-at-a-time path is the flag-off baseline."""
+    cols = lineitem_schema().columns
+    str_cols = (ColumnSchema(cols[0].id, cols[0].name, cols[0].type,
+                             is_range_key=True),) + cols[1:RETFLAG] + (
+        ColumnSchema(RETFLAG, "l_returnflag", ColumnType.STRING),
+        ColumnSchema(LINESTATUS, "l_linestatus", ColumnType.STRING),
+    )
+    return TableInfo("lineitem_s", "lineitem_s",
+                     TableSchema(columns=str_cols, version=1),
+                     PartitionSchema("range", 0))
+
+
+def lineitem_str_data(data: Dict[str, np.ndarray]
+                      ) -> Dict[str, np.ndarray]:
+    """The same rows as `data` (generate_lineitem output) with the flag
+    columns mapped onto their TPC-H string domains."""
+    out = dict(data)
+    out["l_returnflag"] = RETFLAG_STRINGS[data["l_returnflag"]]
+    out["l_linestatus"] = LINESTATUS_STRINGS[data["l_linestatus"]]
+    return out
+
+
 def generate_lineitem(sf: float, seed: int = 0) -> Dict[str, np.ndarray]:
     """Synthetic lineitem with TPC-H-like distributions (uniforms per the
     spec's value ranges)."""
@@ -121,6 +156,18 @@ TPCH_Q1 = QuerySpec(
 )
 
 
+# Q1 over the string-keyed lineitem: identical WHERE and aggregate
+# list, GROUP BY the two STRING flag columns through the dict-key
+# grouped kernel (ops/grouped_scan.py). The 8-slot bucket (6 groups +
+# spill) is the kernel's smallest shape above _MIN_SLOTS.
+def tpch_q1_str() -> QuerySpec:
+    from ..ops.grouped_scan import DictGroupSpec
+    return QuerySpec(
+        name="q1_str", where=TPCH_Q1.where, aggs=TPCH_Q1.aggs,
+        group=DictGroupSpec(cols=(RETFLAG, LINESTATUS)),
+        columns=TPCH_Q1.columns)
+
+
 def numpy_reference(query: QuerySpec, data: Dict[str, np.ndarray]):
     """Direct numpy answer for verification."""
     qty, price, disc = (data["l_quantity"], data["l_extendedprice"],
@@ -136,6 +183,20 @@ def numpy_reference(query: QuerySpec, data: Dict[str, np.ndarray]):
         for g in range(6):
             mg = m & (gid == g)
             out[g] = (qty[mg].sum(), price[mg].sum(), int(mg.sum()))
+        return out
+    if query.name == "q1_str":
+        # {(returnflag, linestatus) strings: (qty_sum, price_sum, count)}
+        # — accepts int-coded OR string flag columns
+        rf, ls = data["l_returnflag"], data["l_linestatus"]
+        if rf.dtype != object:
+            rf, ls = RETFLAG_STRINGS[rf], LINESTATUS_STRINGS[ls]
+        m = data["l_shipdate"] <= _Q1_CUT
+        out = {}
+        for rv in RETFLAG_STRINGS:
+            for lv in LINESTATUS_STRINGS:
+                mg = m & (rf == rv) & (ls == lv)
+                out[(rv, lv)] = (qty[mg].sum(), price[mg].sum(),
+                                 int(mg.sum()))
         return out
     raise ValueError(query.name)
 

@@ -126,8 +126,8 @@ def build_batch(blocks: Sequence[ColumnarBlock],
                 columns: Sequence[int],
                 with_mvcc: bool = True,
                 pad_to: Optional[int] = None,
-                bounds_blocks: Optional[Sequence[ColumnarBlock]] = None
-                ) -> DeviceBatch:
+                bounds_blocks: Optional[Sequence[ColumnarBlock]] = None,
+                dict_plan=None) -> DeviceBatch:
     """Concatenate columnar blocks and ship the requested columns to
     device, padded to a row bucket.
 
@@ -145,7 +145,16 @@ def build_batch(blocks: Sequence[ColumnarBlock],
     near-data pre-filter compacts provably-unmatched rows out of a
     chunk but passes the unfiltered chunk here, so the device dtype and
     quantization scales — and therefore every aggregate bit — stay
-    identical to the unfiltered scan."""
+    identical to the unfiltered scan.
+
+    ``dict_plan``: an ops/grouped_scan.DictPlan covering the scan's
+    string columns — their code arrays fill from the plan's per-block
+    SCAN-GLOBAL remapped codes (no row-string decode, dictionaries
+    shared across every chunk of a streamed scan) and ``batch.dicts``
+    carries the plan's global dictionaries.  Without a plan, string
+    columns fall back to the per-batch dictionary build below (itself
+    served by the per-block dictionary merge when every block
+    dictionary-encodes, decoding rows only as a last resort)."""
     n = sum(b.n for b in blocks)
     padded = pad_to or bucket_rows(max(n, 1))
     cols: Dict[int, jnp.ndarray] = {}
@@ -172,10 +181,37 @@ def build_batch(blocks: Sequence[ColumnarBlock],
         return out
 
     for cid in columns:
+        if dict_plan is not None and cid in dict_plan.dicts:
+            # scan-global dictionary plan: per-block codes are already
+            # remapped into the shared dictionary — a pure int32 fill,
+            # no row-string decode, one dictionary for every chunk
+            code_parts = [dict_plan.block_codes(cid, b) for b in blocks]
+            nparts = [np.asarray(b.varlen[cid][2], bool)
+                      for b in blocks]
+            dicts[cid] = dict_plan.dicts[cid]
+            arr = fill(code_parts) if code_parts else \
+                np.zeros(padded, np.int32)
+            host_cols[cid] = (arr, fill(nparts) if nparts
+                              else np.zeros(padded, bool))
+            continue
         if all(cid in b.varlen for b in blocks):
             # string column: batch-global dictionary encoding — codes
             # are order-preserving (sorted dict), so comparisons map to
-            # code space and LIKE maps to a host-built LUT
+            # code space and LIKE maps to a host-built LUT.  The merge
+            # of per-block dictionaries (stored v2 dict lanes or the
+            # one-time byte-level unique) serves this without decoding
+            # rows; blocks that can't dictionary-encode fall back to
+            # the decode loop below
+            got = _dict_merge_column(blocks, cid)
+            if got is not None:
+                uniq, code_parts = got
+                null = np.concatenate(
+                    [np.asarray(b.varlen[cid][2], bool)
+                     for b in blocks])
+                dicts[cid] = uniq
+                arr = fill(code_parts)
+                host_cols[cid] = (arr, _pad(null, padded))
+                continue
             vparts, nparts = [], []
             for b in blocks:
                 try:
@@ -253,6 +289,24 @@ def build_batch(blocks: Sequence[ColumnarBlock],
         batch.write_id = jnp.asarray(mvcc_host[2])
         batch.tombstone = jnp.asarray(mvcc_host[3])
     return batch
+
+
+def _dict_merge_column(blocks: Sequence[ColumnarBlock], cid: int):
+    """(global uniq, per-block global-code arrays) through the
+    per-block dictionary merge — row strings are never decoded, only
+    each block's (few) uniques. None when any block can't
+    dictionary-encode; the caller then decodes rows the old way."""
+    per = []
+    for b in blocks:
+        got = b.dict_varlen(cid)
+        if got is None:
+            return None
+        per.append(got)
+    from ..storage.lane_codec import merge_dicts
+    uniq, remaps = merge_dicts([u for u, _ in per])
+    parts = [np.ascontiguousarray(remap[codes])
+             for (_, codes), remap in zip(per, remaps)]
+    return uniq, parts
 
 
 def varlen_strings(b: ColumnarBlock, cid: int) -> np.ndarray:

@@ -69,6 +69,8 @@ import numpy as np
 
 from .device_batch import DeviceBatch
 from .expr import collect_constants, compile_expr, expr_signature
+from .grouped_scan import (DictGroupSpec, ResolvedDictGroup,
+                           grouped_reduce, resolve_group)
 
 _UINT64_MAX = jnp.uint64(0xFFFFFFFFFFFFFFFF)
 
@@ -282,7 +284,7 @@ def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
         return _sum_prep(v, m, n_total, axis_names)
 
     def fn(cols, nulls, consts, valid, key_hash, ht, write_id, tombstone,
-           read_ht, sum_scales):
+           read_ht, sum_scales, group_domains=()):
         if mvcc_mode == "none":
             mask = valid
         elif mvcc_mode == "visible":
@@ -295,6 +297,14 @@ def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
             mask = mask & wv
             if wn is not None:
                 mask = mask & jnp.logical_not(wn)
+
+        if isinstance(group, ResolvedDictGroup):
+            # dict-key grouped aggregation (ops/grouped_scan.py): dense
+            # stride encoding of scan-global dictionary codes, pow2
+            # slot bucket, spill-slot overflow detection
+            return grouped_reduce(group, agg_fns, _prep, cols, nulls,
+                                  consts, mask, group_domains,
+                                  sum_scales, strategy)
 
         if isinstance(group, HashGroupSpec):
             # exclude NULL group values (same rule as the dict path)
@@ -622,7 +632,10 @@ class ScanKernel:
             aggs: Sequence[AggSpec] = (),
             group: Optional[GroupSpec] = None,
             read_ht: Optional[int] = None):
-        """Returns (agg_results tuple, count_or_group_counts, mask)."""
+        """Returns (agg_results tuple, count_or_group_counts, mask).
+        HashGroupSpec adds (group_values, n_groups); DictGroupSpec adds
+        a trailing spill count (nonzero = slot overflow, the caller
+        must revert to the interpreted GROUP BY)."""
         aggs = tuple(_expand_avg(aggs))
         if read_ht is None:
             mvcc_mode = "none"
@@ -636,6 +649,15 @@ class ScanKernel:
         for a in aggs:
             if a.expr is not None:
                 collect_constants(a.expr, consts)
+        domain_args: tuple = ()
+        if isinstance(group, DictGroupSpec):
+            # resolve against the batch's scan-global dictionaries: the
+            # pow2 slot bucket is static (kernel signature), dictionary
+            # sizes are runtime scalars (growth inside one bucket never
+            # recompiles).  KeyError = a group column with no dictionary
+            # (caller falls back).
+            group, domains = resolve_group(group, batch.dicts)
+            domain_args = tuple(jnp.int32(d) for d in domains)
         col_sig = tuple(sorted(
             (cid, str(v.dtype)) for cid, v in batch.cols.items()))
         static_sums, scale_args = _static_scales(
@@ -645,7 +667,9 @@ class ScanKernel:
             expr_signature(where) if where is not None else None,
             tuple(a.signature() for a in aggs),
             (type(group).__name__, group.cols,
-             getattr(group, "max_groups", None)) if group else None,
+             getattr(group, "max_groups",
+                     getattr(group, "num_slots", None))) if group
+            else None,
             mvcc_mode, batch.padded_rows, col_sig, static_sums, strategy,
         )
         from ..utils import flags as _flags
@@ -659,6 +683,9 @@ class ScanKernel:
         zeros_u64 = jnp.zeros(batch.padded_rows, jnp.uint64)
         zeros_u32 = jnp.zeros(batch.padded_rows, jnp.uint32)
         zeros_b = jnp.zeros(batch.padded_rows, bool)
+        if isinstance(group, ResolvedDictGroup):
+            from .grouped_scan import GROUPED_STATS
+            GROUPED_STATS["launches"] += 1
         raw = fn(
             batch.cols, batch.nulls,
             [jnp.asarray(c) for c in consts], batch.valid,
@@ -667,11 +694,11 @@ class ScanKernel:
             batch.write_id if batch.write_id is not None else zeros_u32,
             batch.tombstone if batch.tombstone is not None else zeros_b,
             jnp.uint64(read_ht if read_ht is not None else 0xFFFFFFFFFFFFFFFF),
-            scale_args,
+            scale_args, domain_args,
         )
-        # (outs, scales, counts, mask[, gvals, n_groups]) -> rescale the
-        # fixed-point sums host-side; callers keep the historical shape
-        # (outs, counts, mask[, gvals, n_groups])
+        # (outs, scales, counts, mask[, gvals, n_groups | spill]) ->
+        # rescale the fixed-point sums host-side; callers keep the
+        # historical shape (outs, counts, mask[, ...])
         return (_rescale_outs(raw[0], raw[1]),) + tuple(raw[2:])
 
 
@@ -793,6 +820,54 @@ def combine_agg_partials(expanded_aggs: Sequence[AggSpec],
         if counts is not None:
             counts = counts + np.asarray(cnts)
     return (tuple(total) if total is not None else ()), counts
+
+
+def combine_grouped_partials(expanded_aggs: Sequence[AggSpec],
+                             parts: Sequence[tuple]):
+    """Group-KEYED partial merge — THE one implementation shared by the
+    client's RPC hash/dict-grouped fan-out combine, the bypass
+    session's host combine, and any path whose per-shard group slots
+    don't align (each shard merges its own dictionary, so slot i means
+    different keys on different shards).
+
+    ``parts``: per-shard ``(agg_values, counts, group_values)`` with
+    compacted present-group arrays (group_values = one array per group
+    column, aligned with counts). Returns ``(agg_values, counts,
+    group_values)`` merged by key in first-seen shard order: sum/count
+    add, min/max merge via :func:`merge_minmax` with None as the
+    identity."""
+    merged: Dict[tuple, list] = {}
+    for vals, cnts, gvals in parts:
+        if cnts is None:
+            continue
+        counts = np.asarray(cnts)
+        gv = [np.asarray(g) for g in (gvals or ())]
+        vv = [np.asarray(v) for v in vals]
+        for g in range(len(counts)):
+            if counts[g] == 0:
+                continue
+            # object (string) arrays index to plain str — only numpy
+            # scalars need .item() unwrapping into hashable python
+            key = tuple(x[g].item() if isinstance(x[g], np.generic)
+                        else x[g] for x in gv)
+            st = merged.get(key)
+            if st is None:
+                merged[key] = [[v[g] for v in vv], int(counts[g])]
+                continue
+            for i, a in enumerate(expanded_aggs):
+                if a.op in ("sum", "count"):
+                    st[0][i] = st[0][i] + vv[i][g]
+                else:
+                    st[0][i] = _mm2(_scalar_of(st[0][i]),
+                                    _scalar_of(vv[i][g]), a.op)
+            st[1] += int(counts[g])
+    keys = list(merged)
+    outs = tuple(np.asarray([merged[k][0][i] for k in keys])
+                 for i in range(len(expanded_aggs)))
+    counts = np.asarray([merged[k][1] for k in keys], np.int64)
+    gvals = tuple(np.asarray([k[j] for k in keys])
+                  for j in range(len(keys[0]) if keys else 0))
+    return outs, counts, gvals
 
 
 # ---------------------------------------------------------------------------

@@ -40,6 +40,9 @@ from ..storage.pipeline import StreamPipeline
 from ..utils import flags
 from ..utils.hybrid_time import ENCODED_SIZE
 from .device_batch import bucket_rows, build_batch
+from .grouped_scan import (LAST_GROUPED_STATS, DictGroupSpec,
+                           dict_cols_needed, domain_product,
+                           make_dict_plan, resolve_group)
 from .scan import AggSpec, HashGroupSpec, ScanKernel, _expand_avg
 
 _HT_SUFFIX = ENCODED_SIZE + 1   # DocHybridTime suffix + kHybridTime marker
@@ -115,6 +118,39 @@ def _combine(aggs: Tuple[AggSpec, ...], acc: Optional[list],
     return acc
 
 
+def _plan_dict_columns(blocks, columns, where, aggs, group):
+    """Scan-global dictionary planning + string-predicate rewrite for a
+    streamed scan.  Returns ``(plan, where, aggs, ok)``: plan is None
+    when no column needs dictionary form; ok=False means the scan can't
+    stream (no columnar/dictionary form, over-wide group domain, or a
+    string column used outside a rewritable predicate shape)."""
+    dcids = dict_cols_needed(blocks, columns)
+    if dcids is None:
+        return None, where, aggs, False
+    dict_group = isinstance(group, DictGroupSpec)
+    if dict_group:
+        if not flags.get("grouped_pushdown_enabled"):
+            return None, where, aggs, False
+        for cid in group.cols:
+            if not all(cid in b.varlen for b in blocks):
+                return None, where, aggs, False
+        dcids = sorted(set(dcids) | set(group.cols))
+    if not dcids:
+        return None, where, aggs, True
+    plan = make_dict_plan(blocks, dcids)
+    if plan is None:
+        return None, where, aggs, False
+    if dict_group and domain_product(group, plan.dicts) >= 2 ** 31:
+        return None, where, aggs, False     # gid arithmetic would wrap
+    from ..docdb.operations import DocReadOperation
+    try:
+        where, aggs = DocReadOperation.rewrite_where_and_aggs(
+            where, aggs, plan.dicts)
+    except DocReadOperation._Unrewritable:
+        return None, where, aggs, False
+    return plan, where, aggs, True
+
+
 def streaming_scan_aggregate(
         blocks: Sequence[ColumnarBlock], columns: Sequence[int],
         where: Optional[tuple], aggs: Sequence[AggSpec],
@@ -122,23 +158,40 @@ def streaming_scan_aggregate(
         kernel: Optional[ScanKernel] = None,
         chunk_rows: Optional[int] = None,
         cache=None, cache_key: Optional[tuple] = None,
-        min_chunks: int = 3, prefilter=None):
+        min_chunks: int = 3, prefilter=None,
+        grouped_out: Optional[dict] = None):
     """Chunked scan-aggregate over `blocks`.
 
     Returns ``(agg_values, counts)`` — the shapes of
     ``ScanKernel.run(...)[:2]`` — or None when the scan isn't
     streamable (caller uses the monolithic batch):
       - HashGroupSpec (per-chunk group sets can't combine densely),
-      - a needed column only available in varlen/dictionary form
-        (per-chunk dictionaries would shear predicate rewrites),
+      - a needed column with no columnar/dictionary form, or a string
+        column used outside a rewritable predicate shape,
+      - a DictGroupSpec while ``grouped_pushdown_enabled`` is off,
       - a read point over blocks that aren't provably chunk-safe,
       - fewer than `min_chunks` chunks (at 2 marginal chunks the
         per-chunk dispatch overhead measured SLOWER than monolithic on
         the 2-core box; the win needs real depth to amortize).
 
+    String columns stream through the scan-global dictionary plan
+    (ops/grouped_scan.make_dict_plan): one merged dictionary for the
+    whole scan, per-chunk codes remapped into it at batch formation, so
+    string predicates run as integer compares and a
+    :class:`DictGroupSpec` GROUP BY aggregates densely into shared slot
+    arrays that combine across chunks by plain addition/extremes.  For
+    a dict-grouped scan the caller passes ``grouped_out`` (a dict) and
+    receives ``{"spill": total spilled rows, "dicts": the scan-global
+    dictionaries, "num_slots": slot bucket}`` — nonzero spill means the
+    slot budget overflowed and the results MUST be discarded for the
+    interpreted path.
+
     `cache`/`cache_key`: optional DeviceBlockCache — chunk batches land
     under ``cache_key + ("chunk", i)`` so a warm re-scan re-dispatches
-    device-resident chunks with zero batch formation.
+    device-resident chunks with zero batch formation.  The scan-global
+    dictionary identity is part of the chunk key: two scans whose
+    merged dictionaries differ can never share a cached batch of
+    remapped codes.
 
     `prefilter`: optional callable(chunk blocks) -> compacted blocks —
     the bypass reader's near-data pre-filter drops provably-unmatched
@@ -147,14 +200,18 @@ def streaming_scan_aggregate(
     from the unfiltered chunk (``bounds_blocks``), so results stay
     byte-identical to the unfiltered scan; mutually exclusive with the
     device cache (a one-shot snapshot scan has no warm re-scan to
-    serve).
+    serve) and with the dictionary plan (compacted blocks have no
+    remap entries).
     """
     if isinstance(group, HashGroupSpec):
         return None
-    for b in blocks:
-        for cid in columns:
-            if not (cid in b.fixed or cid in b.pk):
-                return None
+    dict_group = isinstance(group, DictGroupSpec)
+    plan, where, aggs, ok = _plan_dict_columns(blocks, columns, where,
+                                               aggs, group)
+    if not ok or (dict_group and plan is None):
+        return None
+    if plan is not None:
+        prefilter = None    # compacted blocks have no remap entries
     chunk_safe = chunk_safe_mvcc(blocks)
     if read_ht is not None and not chunk_safe:
         return None
@@ -192,6 +249,11 @@ def streaming_scan_aggregate(
     # INDICES are part of the device-cache identity — a batch cached
     # under one predicate's prune must never serve another predicate's
     prune_sig = ("zp", kept_idx) if pruned else ()
+    # ... and the scan-global dictionary identity too: a batch of codes
+    # remapped under one merged dictionary must never serve a scan that
+    # merged a different one (same store key, different dict contents —
+    # e.g. plans built over different block subsets)
+    dict_sig = (("dict",) + plan.identity) if plan is not None else ()
 
     pf_stats = {"rows_in": 0, "rows_kept": 0}
 
@@ -209,25 +271,38 @@ def streaming_scan_aggregate(
             # and batches cached under the OLD plan must never serve the
             # new one (rows would double-count); stale entries LRU out
             return cache.get_or_build(
-                cache_key + ("chunk", chunk_rows, bucket, ci) + prune_sig,
-                lambda: build_batch(chunk, cols_sorted, pad_to=bucket))
-        return build_batch(chunk, cols_sorted, pad_to=bucket)
+                cache_key + ("chunk", chunk_rows, bucket, ci)
+                + prune_sig + dict_sig,
+                lambda: build_batch(chunk, cols_sorted, pad_to=bucket,
+                                    dict_plan=plan))
+        return build_batch(chunk, cols_sorted, pad_to=bucket,
+                           dict_plan=plan)
 
     pipe = StreamPipeline([build], depth=2, name="stream-scan")
     acc = None
     counts_acc = None
+    spill_acc = 0
     kernel_s = 0.0
+    combine_s = 0.0
     import time
 
     from ..storage.columnar import KEY_REBUILD_STATS
     rebuilds0 = KEY_REBUILD_STATS["rebuilds"]
     for batch in pipe.run(enumerate(chunks)):
         t0 = time.perf_counter()
-        outs, counts, _ = kernel.run(batch, where, aggs, group, read_ht)
+        if dict_group:
+            outs, counts, _, spill = kernel.run(batch, where, aggs,
+                                                group, read_ht)
+            spill_acc += int(spill)
+        else:
+            outs, counts, _ = kernel.run(batch, where, aggs, group,
+                                         read_ht)
         kernel_s += time.perf_counter() - t0
+        t0 = time.perf_counter()
         acc = _combine(aggs, acc, outs)
         counts_acc = (np.asarray(counts) if counts_acc is None
                       else counts_acc + np.asarray(counts))
+        combine_s += time.perf_counter() - t0
     LAST_STREAM_STATS.clear()
     LAST_STREAM_STATS.update({
         "chunks": len(chunks), "bucket_rows": bucket,
@@ -240,8 +315,123 @@ def streaming_scan_aggregate(
         "prefilter_rows_kept": pf_stats["rows_kept"],
         "build_s": round(pipe.stage_s[0], 4),
         "kernel_s": round(kernel_s, 4),
+        "combine_s": round(combine_s, 4),
         "consumer_wait_s": round(pipe.wait_s, 4)})
+    if dict_group:
+        resolved, _ = resolve_group(group, plan.dicts)
+        occupied = int(np.count_nonzero(
+            np.asarray(counts_acc)[:resolved.num_slots - 1])) \
+            if counts_acc is not None else 0
+        LAST_GROUPED_STATS.clear()
+        LAST_GROUPED_STATS.update({
+            "path": "streaming", "num_slots": resolved.num_slots,
+            "slots_occupied": occupied, "spilled_rows": spill_acc,
+            "dict_merge_s": round(plan.merge_s, 4),
+            "kernel_s": round(kernel_s, 4),
+            "combine_s": round(combine_s, 4)})
+        if grouped_out is not None:
+            grouped_out.update(spill=spill_acc, dicts=plan.dicts,
+                               num_slots=resolved.num_slots)
+    elif plan is not None:
+        LAST_STREAM_STATS["dict_merge_s"] = round(plan.merge_s, 4)
     return tuple(acc), counts_acc
+
+
+def streaming_scan_filter(
+        blocks: Sequence[ColumnarBlock], columns: Sequence[int],
+        where: Optional[tuple], read_ht: Optional[int],
+        materialize, limit: Optional[int] = None,
+        kernel: Optional[ScanKernel] = None,
+        chunk_rows: Optional[int] = None,
+        cache=None, cache_key: Optional[tuple] = None,
+        min_chunks: int = 2):
+    """Streamed filter-pushdown ROW path (ROADMAP operator-frontier
+    rung (a)): per-chunk WHERE masks compute on device while the next
+    chunk's batch forms on the pipeline thread; matching rows
+    materialize host-side per chunk through ``materialize(chunk_blocks,
+    local_indices) -> rows`` (the caller owns projection/row shape).
+
+    Returns the accumulated row list, or None when the scan can't
+    stream (same eligibility as the aggregate path; with a read point
+    the block sequence must be chunk-safe so the newest-visible-version
+    choice never spans chunks).  String predicates stream through the
+    scan-global dictionary plan exactly like the aggregate path.
+    ``limit``: stop dispatching once this many rows matched — the
+    pipeline closes early, which is the row-path win the monolithic
+    batch can't have."""
+    plan, where, _, ok = _plan_dict_columns(blocks, columns, where,
+                                            (), None)
+    if not ok:
+        return None
+    chunk_safe = chunk_safe_mvcc(blocks)
+    if read_ht is not None and not chunk_safe:
+        return None
+    pruned = 0
+    kept_idx = None
+    if where is not None and flags.get("zone_map_pruning") \
+            and (read_ht is None or chunk_safe):
+        from .scan import zone_prune_blocks
+        kept, kept_idx = zone_prune_blocks(blocks, where)
+        pruned = len(blocks) - len(kept)
+        if pruned:
+            blocks = kept
+    chunk_rows = chunk_rows or int(flags.get("streaming_chunk_rows"))
+    chunks = plan_chunks(blocks, chunk_rows)
+    if len(chunks) < min_chunks and not pruned:
+        return None
+    kernel = kernel or _default_kernel()
+    cols_sorted = sorted(columns)
+    bucket = bucket_rows(max(max(sum(b.n for b in c) for c in chunks), 1))
+    prune_sig = ("zp", kept_idx) if pruned else ()
+    dict_sig = (("dict",) + plan.identity) if plan is not None else ()
+
+    def build(item):
+        ci, chunk = item
+        if cache is not None and cache_key is not None:
+            return cache.get_or_build(
+                cache_key + ("chunk", chunk_rows, bucket, ci)
+                + prune_sig + dict_sig,
+                lambda: build_batch(chunk, cols_sorted, pad_to=bucket,
+                                    dict_plan=plan)), chunk
+        return build_batch(chunk, cols_sorted, pad_to=bucket,
+                           dict_plan=plan), chunk
+
+    pipe = StreamPipeline([build], depth=2, name="stream-rows")
+    rows: list = []
+    kernel_s = 0.0
+    import time
+    from ..storage.columnar import KEY_REBUILD_STATS
+    rebuilds0 = KEY_REBUILD_STATS["rebuilds"]
+    chunks_run = 0
+    run = pipe.run(enumerate(chunks))
+    try:
+        for batch, chunk in run:
+            t0 = time.perf_counter()
+            _, _, mask = kernel.run(batch, where, (), None, read_ht)
+            kernel_s += time.perf_counter() - t0
+            sel = np.nonzero(np.asarray(mask))[0]
+            chunks_run += 1
+            if limit is not None and len(rows) + len(sel) > limit:
+                sel = sel[:limit - len(rows)]
+            rows.extend(materialize(chunk, sel))
+            if limit is not None and len(rows) >= limit:
+                break
+    finally:
+        close = getattr(run, "close", None)
+        if close is not None:
+            close()     # early exit: tear the pipeline down cleanly
+    LAST_STREAM_STATS.clear()
+    LAST_STREAM_STATS.update({
+        "chunks": len(chunks), "chunks_run": chunks_run,
+        "bucket_rows": bucket, "rows_out": len(rows),
+        "zone_blocks_pruned": pruned,
+        "zone_blocks_total": len(blocks) + pruned,
+        "key_rebuilds": KEY_REBUILD_STATS["rebuilds"] - rebuilds0,
+        "prefilter_rows_in": 0, "prefilter_rows_kept": 0,
+        "build_s": round(pipe.stage_s[0], 4),
+        "kernel_s": round(kernel_s, 4),
+        "consumer_wait_s": round(pipe.wait_s, 4)})
+    return rows
 
 
 def _default_kernel() -> ScanKernel:

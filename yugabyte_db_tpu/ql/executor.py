@@ -25,6 +25,7 @@ from ..utils import flags
 from ..docdb.table_codec import TableInfo
 from ..dockv.packed_row import ColumnSchema, ColumnType, TableSchema
 from ..dockv.partition import PartitionSchema
+from ..ops.grouped_scan import DictGroupSpec
 from ..ops.scan import AggSpec, GroupSpec, HashGroupSpec
 from .parser import (
     AlterTableStmt, AnalyzeStmt, CreateIndexStmt, CreateSequenceStmt,
@@ -2975,8 +2976,11 @@ class SqlSession:
         HashGroupSpec so arbitrary-domain numeric group keys STILL push
         down (sort + segment aggregation on device; no stats
         prerequisite — reference: unconditional aggregate pushdown,
-        pgsql_operation.cc:3153). Non-numeric keys return None
-        (client-side grouping)."""
+        pgsql_operation.cc:3153). All-string keys push down as a
+        DictGroupSpec — the dict-key grouped kernel aggregates over
+        scan-global dictionary codes with a server-side interpreted
+        fallback on slot overflow (ops/grouped_scan.py). Other
+        non-numeric keys return None (client-side grouping)."""
         st = self.stats.get(stmt.table, {})
         cols = []
         for name in stmt.group_by:
@@ -2987,12 +2991,22 @@ class SqlSession:
             cols.append((schema.column_by_name(name).id, domain, offset))
         if cols is not None:
             return GroupSpec(cols=tuple(cols))
+        try:
+            gcols = [schema.column_by_name(n) for n in stmt.group_by]
+        except Exception:
+            return None
+        if all(c.type == ColumnType.STRING for c in gcols) \
+                and flags.get("grouped_pushdown_enabled"):
+            # Q1's shape: GROUP BY over low-cardinality string columns.
+            # The server aggregates dictionary CODES on device; an
+            # over-cardinality group set spills and reverts to the
+            # server's interpreted GROUP BY — either way the response
+            # is compacted (group_values, counts) keyed rows
+            return DictGroupSpec(
+                cols=tuple(c.id for c in gcols),
+                max_slots=int(flags.get("grouped_max_slots")))
         hash_cols = []
-        for name in stmt.group_by:
-            try:
-                c = schema.column_by_name(name)
-            except Exception:
-                return None
+        for c in gcols:
             # exact-on-device types only: floats would be rounded to
             # f32 at batch formation, silently merging distinct f64
             # group keys — those stay on exact client-side grouping
@@ -3041,7 +3055,10 @@ class SqlSession:
         counts = np.asarray(resp.group_counts)
         rows = []
         gmap = self._group_out_map(stmt)
-        if isinstance(gspec, HashGroupSpec):
+        if isinstance(gspec, (HashGroupSpec, DictGroupSpec)):
+            # compacted (group_values, counts) keyed rows — hash groups
+            # and dict (string-key) groups share the shape; dict group
+            # values arrive as strings and project unconverted
             schema_cols = {c.id: c for c in schema.columns}
             for g in np.nonzero(counts)[0]:
                 row = {}
@@ -3054,6 +3071,8 @@ class SqlSession:
                         v = int(v)
                     elif c.type == ColumnType.BOOL:
                         v = bool(v)
+                    elif c.type == ColumnType.STRING:
+                        v = str(v)
                     self._put_group_value(gmap, row, name, v)
                 gvals = [np.asarray(v)[g] for v in resp.agg_values]
                 row.update(self._agg_row(stmt, gvals))

@@ -148,6 +148,7 @@ class ColumnarBlock:
                  "tombstone", "pk", "fixed", "varlen", "unique_keys",
                  "zmap", "keys_proven", "_keys",
                  "_key_thunk", "_first_key", "_last_key", "_void_keys",
+                 "_vdicts", "_vdict_cache",
                  "_finder", "_extractors", "__weakref__")
 
     def __init__(self, n: int, schema_version: int,
@@ -185,6 +186,13 @@ class ColumnarBlock:
         # reads revisit hot blocks; rebuilding the view per lookup is an
         # O(block) copy)
         self._void_keys: Optional[np.ndarray] = None
+        # varlen dictionary state: `_vdicts[cid]` holds raw on-disk dict
+        # parts (uniq_lens, uniq_heap, codes) when the block was stored
+        # dict-coded; `_vdict_cache[(cid, max_card)]` memoizes
+        # dict_varlen() results (False = known-uncodable under that cap)
+        # so the per-block dictionary is built at most once per cap
+        self._vdicts: Dict[int, tuple] = {}
+        self._vdict_cache: Dict[tuple, object] = {}
         if keys is not None:
             self.keys = keys
 
@@ -246,6 +254,48 @@ class ColumnarBlock:
 
     def last_full_key(self) -> Optional[bytes]:
         return self.boundary_keys()[1]
+
+    # --- varlen dictionaries ------------------------------------------
+    def dict_varlen(self, cid: int, max_card: int = 1 << 16):
+        """Block-local dictionary view of one varlen (string) column:
+        ``(uniq, codes)`` with `uniq` a SORTED object array of str and
+        `codes` int32 row codes into it (NULL rows code as "").  None
+        when the column can't dictionary-encode (over-long rows, too
+        many distinct values, non-UTF8 payloads).
+
+        Sourced from the stored v2 dict-coded lane when present (zero
+        row-string decodes), else built once with the byte-level
+        void-view unique (rows are never decoded; only the few uniques
+        are).  Memoized per (block, max_card) — a low-cap miss must not
+        poison a later higher-cap call — and consumed by scan-global
+        dictionary merges / remap tables (lane_codec.merge_dicts)."""
+        got = self._vdict_cache.get((cid, max_card))
+        if got is not None:
+            return got if got is not False else None
+        out = None
+        try:
+            stored = self._vdicts.get(cid)
+            if stored is not None:
+                ulens, uheap, codes = stored
+                out = (lane_codec.decode_dict_strings(ulens, uheap),
+                       np.asarray(codes, np.int32))
+            elif cid in self.varlen:
+                ends, heap, null = self.varlen[cid]
+                # no sample guard here: this dict serves the grouped
+                # kernel / predicate remap (bounded by max_card), not a
+                # write-time smaller-or-skip decision
+                coded = lane_codec.varlen_code_rows(
+                    ends, heap, null, max_card=max_card,
+                    sample_guard=False)
+                if coded is not None:
+                    ulens, uheap, codes = coded
+                    out = (lane_codec.decode_dict_strings(ulens, uheap),
+                           codes)
+        except UnicodeDecodeError:
+            out = None
+        self._vdict_cache[(cid, max_card)] = out if out is not None \
+            else False
+        return out
 
     # ------------------------------------------------------------------
     @classmethod
@@ -434,6 +484,12 @@ class ColumnarBlock:
             "varlen": {},
         }
         for k, (ends, heap, null) in self.varlen.items():
+            dict_meta = self._dict_varlen_parts(ends, heap, null, bufs,
+                                                stats)
+            if dict_meta is not None:
+                meta["varlen"][str(k)] = [dict_meta, {"len": 0},
+                                          lane("varlen_null", null)]
+                continue
             # heap rides FIRST in the payload stream (the v1 order, so
             # the shared deserializer walks both formats identically)
             hb = (heap if isinstance(heap, (bytes, bytearray))
@@ -455,6 +511,63 @@ class ColumnarBlock:
         lane_codec.tally(stats, "header", len(head) + 4, len(head) + 4,
                          "raw")
         return struct.pack("<I", len(head)) + head, bufs
+
+    def _dict_varlen_parts(self, ends, heap, null, bufs: List[object],
+                           stats: Optional[dict]):
+        """v2 dict coding of one varlen lane: uniques (lens + heap) +
+        narrow codes replace the row heap + ends lane when STRICTLY
+        smaller than their raw dump.  Only lanes whose NULL rows carry
+        zero-length payloads qualify — reconstruction (codes -> per-row
+        payloads) must round-trip the original (ends, heap) bytes
+        exactly.  Returns the lane meta dict, or None to keep raw."""
+        n = len(ends)
+        if n < 2:
+            return None
+        ends64 = np.asarray(ends, np.int64)
+        lens = np.diff(np.concatenate([[0], ends64]))
+        if null is not None and np.asarray(null, bool).any() and \
+                lens[np.asarray(null, bool)].any():
+            return None               # lossy for non-empty NULL payloads
+        coded = lane_codec.varlen_code_rows(ends, heap, null,
+                                            max_card=0xFFFF)
+        if coded is None:
+            return None
+        ulens, uheap, codes = coded
+        k = len(ulens)
+        cdt = np.dtype(np.uint8 if k <= 0x100 else np.uint16)
+        raw_basis = len(heap) + np.asarray(ends).nbytes
+        size = ulens.nbytes + uheap.nbytes + n * cdt.itemsize
+        if size >= raw_basis:
+            return None
+        codes_n = np.ascontiguousarray(codes.astype(cdt))
+        bufs.extend([np.ascontiguousarray(ulens),
+                     np.ascontiguousarray(uheap), codes_n])
+        lane_codec.tally(stats, "varlen_dict", raw_basis, size, "dict")
+        return {"venc": "dict", "k": k, "cdt": str(cdt),
+                "parts": [ulens.nbytes, uheap.nbytes, codes_n.nbytes]}
+
+    @staticmethod
+    def _decode_dict_varlen(vmeta: dict, fetch):
+        """Inverse of _dict_varlen_parts: rebuild the exact (ends, heap)
+        pair and return the raw dict parts for dict_varlen()."""
+        ulens = np.frombuffer(fetch(vmeta["parts"][0]), np.uint8)
+        uheap = bytes(fetch(vmeta["parts"][1]))
+        codes = np.frombuffer(fetch(vmeta["parts"][2]),
+                              np.dtype(vmeta["cdt"])).astype(np.int32)
+        u_ends = np.cumsum(ulens.astype(np.int64))
+        u_starts = u_ends - ulens
+        row_lens = ulens[codes].astype(np.int64)
+        ends = np.cumsum(row_lens).astype(np.uint32)
+        total = int(row_lens.sum())
+        if total:
+            hb = np.frombuffer(uheap, np.uint8)
+            starts_out = ends.astype(np.int64) - row_lens
+            off = np.arange(total, dtype=np.int64) - \
+                np.repeat(starts_out, row_lens)
+            heap = hb[np.repeat(u_starts[codes], row_lens) + off].tobytes()
+        else:
+            heap = b""
+        return ends, heap, (ulens, uheap, codes)
 
     def _build_zone_map(self) -> Dict[int, Tuple[object, object]]:
         """Per-column (min, max) over non-null values of pk + fixed
@@ -559,7 +672,11 @@ class ColumnarBlock:
             blk.fixed[int(k)] = (v, m)
         for k, (eref, heapinfo, nref) in meta["varlen"].items():
             heap = fetch(heapinfo["len"])
-            ends = take(eref)
+            if eref.get("venc") == "dict":
+                ends, heap, parts = cls._decode_dict_varlen(eref, fetch)
+                blk._vdicts[int(k)] = parts
+            else:
+                ends = take(eref)
             null = take(nref)
             blk.varlen[int(k)] = (ends, heap, null)
         if version >= 2:

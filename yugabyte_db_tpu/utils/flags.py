@@ -170,6 +170,24 @@ DEFINE_RUNTIME("scan_group_strategy", "auto",
                "'unroll' (per-group masked tree reductions — pure VPU "
                "code, no scatter, for TPU), or 'auto' (segment on cpu, "
                "unroll elsewhere).")
+DEFINE_RUNTIME("grouped_pushdown_enabled", True,
+               "Serve GROUP BY over dictionary-encoded (string) key "
+               "columns on the device grouped-aggregation kernel "
+               "(ops/grouped_scan.py): chunk-local dictionary codes "
+               "remap into one scan-global dictionary, group ids "
+               "scatter into pow2 slot buckets, and string equality/IN "
+               "predicates ride along as integer compares. Off — or "
+               "any over-cardinality group set that overflows the slot "
+               "budget — reverts to the interpreted row-at-a-time "
+               "GROUP BY path.")
+DEFINE_RUNTIME("grouped_max_slots", 4096,
+               "Group-slot budget of the device grouped-aggregation "
+               "kernel (rounded up to a power of two, one slot "
+               "reserved for overflow spill). Scans whose scan-global "
+               "dictionary domain product exceeds the budget launch "
+               "optimistically: rows landing in the spill slot are "
+               "counted and a nonzero spill reverts the whole scan to "
+               "the interpreted GROUP BY.")
 DEFINE_RUNTIME("hash_scan_enumerate_max", 1024,
                "Max enumerable key-target count for rewriting a "
                "short range/IN scan over a single-integer-hash-PK "
