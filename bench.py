@@ -334,6 +334,414 @@ def ycsb_overload_bench():
         return {"error": str(e)[:200]}
 
 
+def cluster_overload_bench():
+    """Live fire on a REAL multi-process cluster (cluster/): 1 master +
+    3 tservers + 1 open-loop driver, every one its own OS process with
+    its own event loop and GIL — the shape the single-loop benches
+    above cannot measure.  Four legs, one cluster:
+
+    (a) scheduler ON vs OFF at 2x the measured saturation (paired
+        rounds; the PR-3 separation without the shared-loop noise),
+    (b) SLA-bounded goodput THROUGH a live tablet auto-split plus a
+        blacklist-drain rebalance (balancer replica moves = the
+        remote-bootstrap catch-up path) while the driver keeps firing
+        (`split_goodput_ratio` vs the calm scheduler-ON round),
+    (c) a seeded chaos round — SIGKILL a peer + stall a disk mid-load,
+        restart with backoff — followed by a quiesced byte-verify of
+        EVERY acked write (`chaos_missing`/`chaos_mismatched` WARN on
+        any nonzero: acked data may never vanish),
+    (d) bypass aggregate scans served by a SEPARATE replica process
+        (rpc_bypass_scan) under the same point-write fire:
+        `cluster_bypass_p95_impact` (the WARN gate — round p99s are
+        spike-dominated on 2 cores, p95 medians hold steady) is the
+        write-lane tail with scans / without — compare to the
+        single-loop `bypass_p99_impact` (ROADMAP: separate-process
+        bypass should approach 1.0).
+
+    BENCH_CLUSTER_S bounds each phase (0 skips); BENCH_CHAOS_SEED
+    replays a chaos round bit-for-bit."""
+    import asyncio
+
+    duration = float(os.environ.get("BENCH_CLUSTER_S", "2.5"))
+    if duration <= 0:
+        return None
+    seed = int(os.environ.get("BENCH_CHAOS_SEED", "42"))
+
+    async def run():
+        from yugabyte_db_tpu.cluster import (ChaosController,
+                                             ClusterSupervisor)
+        from yugabyte_db_tpu.docdb.operations import ReadRequest
+        from yugabyte_db_tpu.docdb.wire import read_request_to_wire
+        from yugabyte_db_tpu.ops.scan import AggSpec
+
+        sup = await ClusterSupervisor(
+            tempfile.mkdtemp(prefix="ybtpu-cluster-"),
+            num_tservers=3).start()
+        out = {"processes": len(sup.procs) + 2}   # + driver + this one
+        try:
+            await sup.spawn_driver("drv-0")
+            setup = await sup.call(
+                "drv-0", "driver", "setup",
+                {"rows": 2000, "num_tablets": 2,
+                 "replication_factor": 2}, timeout=120.0)
+            table_id = setup["table_id"]
+            sat = (await sup.call(
+                "drv-0", "driver", "saturation",
+                {"seconds": 1.5, "workers": 32}, timeout=60.0)
+            )["ops_per_s"]
+            # cap the offered rate: the open loop materializes one task
+            # per op and this box is 2 cores
+            rate = min(2.0 * sat, 4000.0)
+            out["saturation_ops_per_s"] = round(sat, 1)
+            out["offered_ops_per_s"] = round(rate, 1)
+
+            async def phase(tag, seconds=None, rate_=None, wf=1.0):
+                return await sup.call(
+                    "drv-0", "driver", "run_phase",
+                    {"rate": rate_ or rate,
+                     "seconds": seconds or duration,
+                     "write_fraction": wf,
+                     "sla_ms": 2000, "tag": tag}, timeout=180.0)
+
+            # (a) scheduler ON/OFF, paired interleaved rounds ----------
+            # mixed 50/50 read/write at 2x saturation: point-read
+            # fusion + write group commit are where the scheduler's
+            # micro-batching pays, and the separation is measured from
+            # REMOTE processes (the shape the single-loop ycsb_overload
+            # bench could not isolate)
+            on_rounds, off_rounds = [], []
+            for i in range(2):
+                on_rounds.append(await phase(f"on{i}", wf=0.5))
+                await sup.set_flag_all("scheduler_enabled", False,
+                                       roles=("tserver",))
+                try:
+                    off_rounds.append(await phase(f"off{i}", wf=0.5))
+                finally:
+                    await sup.set_flag_all("scheduler_enabled", True,
+                                           roles=("tserver",))
+            on = max(on_rounds, key=lambda r: r["achieved_ops_per_s"])
+            off = max(off_rounds, key=lambda r: r["achieved_ops_per_s"])
+            out["scheduler"] = {
+                "on": on, "off": off,
+                "p99_ratio_rounds": [
+                    round(a["p99_ms"] / max(b["p99_ms"], 1e-9), 3)
+                    for a, b in zip(on_rounds, off_rounds)],
+                # own keys (and thresholds): the single-loop block's
+                # p99_ratio_on_vs_off threshold (0.5) is calibrated
+                # for in-process dispatch; across real processes the
+                # driver-side p99 includes client backoff+retries, so
+                # the bar is "ON is not worse" at matched goodput
+                "cluster_p99_on_vs_off": round(
+                    on["p99_ms"] / max(off["p99_ms"], 1e-9), 3),
+                "cluster_achieved_on_vs_off": round(
+                    on["achieved_ops_per_s"]
+                    / max(off["achieved_ops_per_s"], 1e-9), 3)}
+
+            # (b) goodput through live split + rebalance ---------------
+            # the control-plane legs run at 1x saturation, not 2x: the
+            # question is what a SUSTAINABLE load loses to a live
+            # split+rebalance, not how overload shed composes with it.
+            # Cool down first — leg (a)'s 2x rounds leave a server-side
+            # backlog that would zero the calm reference's goodput
+            await asyncio.sleep(duration)
+            await phase("settle", rate_=min(sat, 3000.0))
+            calm = await phase("calm", rate_=min(sat, 3000.0))
+            await sup.call("master-0", "master", "set_flag",
+                           {"name": "tablet_split_size_threshold_bytes",
+                            "value": 120_000}, timeout=10.0)
+            await sup.call("master-0", "master", "set_flag",
+                           {"name": "enable_automatic_tablet_splitting",
+                            "value": True}, timeout=10.0)
+            await sup.spawn_tserver(3)
+            await sup.wait_tservers_live()
+            await sup.call("master-0", "master", "blacklist",
+                           {"ts_uuid": "ts-0"}, timeout=10.0)
+            cp_phases, lb_actions = [], []
+            split_fired = drained = False
+            deadline = time.monotonic() + max(45.0, 18 * duration)
+            while time.monotonic() < deadline:
+                cp_phases.append(await phase("cp",
+                                             rate_=min(sat, 3000.0)))
+                for _ in range(2):   # each tick = at most one move
+                    r = await sup.call("master-0", "master",
+                                       "balance_tick", {}, timeout=15.0)
+                    if r.get("action"):
+                        lb_actions.append(r["action"])
+                snap = await sup.call("master-0", "master",
+                                      "metrics_snapshot", {},
+                                      timeout=10.0)
+                if not split_fired and \
+                        len(snap["tablet_reports"]) > 2:
+                    split_fired = True
+                    # one live split is the measurement; stop the
+                    # splitter so the drain chases a FIXED replica set
+                    # instead of freshly split children forever
+                    await sup.call(
+                        "master-0", "master", "set_flag",
+                        {"name": "enable_automatic_tablet_splitting",
+                         "value": False}, timeout=10.0)
+                ts0 = await sup.call("ts-0", "tserver",
+                                     "metrics_snapshot", {},
+                                     timeout=10.0)
+                drained = not ts0["tablets"]
+                if split_fired and drained:
+                    break
+            await sup.call("master-0", "master", "set_flag",
+                           {"name": "enable_automatic_tablet_splitting",
+                            "value": False}, timeout=10.0)
+            worst = min(cp_phases, key=lambda r: r["achieved_ops_per_s"])
+            mean_ach = (sum(p["achieved_ops_per_s"] for p in cp_phases)
+                        / len(cp_phases))
+            out["split_rebalance"] = {
+                "split_fired": split_fired,
+                "ts0_drained": drained,
+                "balancer_actions": lb_actions[:8],
+                "phases": len(cp_phases),
+                "calm_1x": calm,
+                "worst_phase": worst,
+                "mean_achieved_ops_per_s": round(mean_ach, 1),
+                # SLA-bounded goodput through the convulsion, vs the
+                # calm 1x round on the same cluster
+                "split_goodput_ratio": round(
+                    mean_ach
+                    / max(calm["achieved_ops_per_s"], 1e-9), 3)}
+
+            # (c) seeded chaos round + quiesced byte-verify ------------
+            chaos = ChaosController(sup, seed=seed)
+            plan = chaos.plan_round(kills=1, stalls=1, stall_s=1.0,
+                                    round_s=duration, spare=("ts-0",))
+            load = asyncio.ensure_future(
+                phase("chaos", seconds=duration + 2.0))
+            try:
+                log = await chaos.run_round(plan)
+                chaos_phase = await load
+            finally:
+                if not load.done():   # run_round raised: reap the
+                    load.cancel()     # driver phase before teardown
+                    try:
+                        await load
+                    except (Exception, asyncio.CancelledError):
+                        pass
+            await chaos.clear_all()
+            verify = await sup.call("drv-0", "driver", "verify", {},
+                                    timeout=600.0)
+            out["chaos"] = {"seed": seed,
+                            "plan": [list(e.as_tuple()) for e in plan],
+                            "executed": [list(x) for x in log],
+                            "phase": chaos_phase, "verify": verify}
+            out["chaos_missing"] = verify["missing"]
+            out["chaos_mismatched"] = verify["mismatched"]
+            out["chaos_unreachable"] = verify["unreachable"]
+
+            # (d) bypass from a SEPARATE replica process ---------------
+            # the single-loop bypass_scan bench's shape, with real
+            # process isolation: point writes fire at usertable while
+            # aggregate scans hit a SEPARATE analytics table (written
+            # once, flushed — the keyless scanner needs clean runs)
+            # served via rpc_bypass_scan by a follower tserver process
+            from yugabyte_db_tpu.docdb.table_codec import TableInfo
+            from yugabyte_db_tpu.dockv.packed_row import (
+                ColumnSchema, ColumnType, TableSchema)
+            from yugabyte_db_tpu.dockv.partition import PartitionSchema
+            ainfo = TableInfo("", "analytics", TableSchema(columns=(
+                ColumnSchema(0, "k", ColumnType.INT64,
+                             is_hash_key=True),
+                ColumnSchema(1, "v", ColumnType.FLOAT64)), version=1),
+                PartitionSchema("hash", 1))
+            c = sup.client()
+            try:
+                await c.create_table(ainfo, num_tablets=1,
+                                     replication_factor=2)
+                n_a = 10_000
+                for lo in range(0, n_a, 2000):
+                    await c.insert("analytics", [
+                        {"k": i, "v": float(i)}
+                        for i in range(lo, lo + 2000)])
+                act = await c._table("analytics", refresh=True)
+                for loc in act.locations:
+                    await c.messenger.call(
+                        loc.leader_addr(), "tserver", "flush",
+                        {"tablet_id": loc.tablet_id}, timeout=30.0)
+                a_table_id = act.info.table_id
+                leaders = {loc.leader for loc in act.locations}
+            finally:
+                await c.messenger.shutdown()
+            # scan from a process that leads NONE of the analytics
+            # tablets — the purest "analytics replica" (its store holds
+            # follower-applied rows; the pinner's safe-time wait plus a
+            # local flush give it a clean snapshot)
+            victim = None
+            for name in sup.tserver_names():
+                if not sup.procs[name].alive():
+                    continue
+                snap = await sup.call(name, "tserver",
+                                      "metrics_snapshot", {},
+                                      timeout=10.0)
+                mine = {t: d for t, d in snap["tablets"].items()
+                        if t.startswith(a_table_id)}
+                if mine and snap["uuid"] not in leaders:
+                    victim = name
+                    break
+                if mine and victim is None:
+                    victim = name          # fallback: any replica host
+            await sup.call(victim, "tserver", "set_flag",
+                           {"name": "bypass_reader_enabled",
+                            "value": True}, timeout=10.0)
+            agg_req = read_request_to_wire(ReadRequest(
+                a_table_id, aggregates=(AggSpec("count"),
+                                        AggSpec("sum", ("col", 1)))))
+            byp_req = {"table_id": a_table_id, "req": agg_req}
+            # the same aggregate THROUGH the hot path: an ordinary
+            # `read` RPC at the analytics leader (the contrast round)
+            lloc = act.locations[0]
+            rpc_req = {"tablet_id": lloc.tablet_id, "req": agg_req}
+            leader_name = victim
+            for n in sup.tserver_names():
+                if not sup.procs[n].alive():
+                    continue
+                u = (await sup.call(n, "tserver", "metrics_snapshot",
+                                    {}, timeout=10.0))["uuid"]
+                if u == lloc.leader:
+                    leader_name = n
+                    break
+            # writes at 1x saturation: the isolation question is what
+            # analytics traffic does to a HEALTHY write lane (at 2x
+            # the p99 already sits at the SLA ceiling and the ratio
+            # saturates); scans are PACED — an analytics session, not
+            # a scan storm, so the ratio measures loop/GIL coupling
+            # rather than raw 2-core oversubscription.  Re-probe
+            # saturation first: the cluster behind it (split children,
+            # moved replicas, restarted peers) is not the one the
+            # opening probe measured
+            sat2 = (await sup.call(
+                "drv-0", "driver", "saturation",
+                {"seconds": 1.0, "workers": 32}, timeout=60.0)
+            )["ops_per_s"]
+            byp_rate = min(sat2, 3000.0)
+            out["post_chaos_saturation_ops_per_s"] = round(sat2, 1)
+            scan_every_s = 0.25
+            # warm both scan paths (first bypass round pays the local
+            # follower flush + kernel compile; the RPC round its own
+            # compile) so no timed round carries a compile
+            await sup.call(victim, "tserver", "bypass_scan", byp_req,
+                           timeout=60.0)
+
+            async def scan_loop(stop_at, call, stats):
+                while time.monotonic() < stop_at:
+                    t0 = time.monotonic()
+                    try:
+                        r = await call()
+                        stats["rounds"] += 1
+                        stats["last"] = r.get("stats")
+                    except Exception as e:   # noqa: BLE001 — the
+                        # write-lane p99 is the metric; a scan refusal
+                        # (e.g. a flush race) is counted, not fatal
+                        stats["errors"] += 1
+                        stats["last_error"] = str(e)[:120]
+                    dt = time.monotonic() - t0
+                    if dt < scan_every_s:
+                        await asyncio.sleep(scan_every_s - dt)
+
+            byp_dur = max(duration, 3.0)
+
+            async def measured_round(tag, call, stats):
+                # `stats` accumulates ACROSS rounds — the reported
+                # scan counts must cover all 3, not just the last
+                stop_at = time.monotonic() + byp_dur
+                scans = asyncio.ensure_future(
+                    scan_loop(stop_at, call, stats))
+                try:
+                    ph = await phase(tag, rate_=byp_rate,
+                                     seconds=byp_dur)
+                    await scans
+                finally:
+                    if not scans.done():   # phase raised: reap
+                        scans.cancel()
+                        try:
+                            await scans
+                        except (Exception, asyncio.CancelledError):
+                            pass
+                return ph
+
+            def _byp_call():
+                return sup.call(victim, "tserver", "bypass_scan",
+                                byp_req, timeout=60.0)
+
+            def _rpc_call():
+                return sup.call(leader_name, "tserver", "read",
+                                rpc_req, timeout=60.0)
+
+            # paired interleaved rounds, MEDIAN per side: a flush
+            # pause landing in one 3s window swings a single round's
+            # p99 several-fold on this box, and best-of would let one
+            # lucky round hide a real coupling
+            def med(rounds, key):
+                vals = sorted(r[key] for r in rounds)
+                return vals[len(vals) // 2]
+            bases, byps, rpcs = [], [], []
+            byp_stats = {"rounds": 0, "errors": 0, "last": None,
+                         "last_error": None}
+            rpc_stats = {"rounds": 0, "errors": 0, "last": None,
+                         "last_error": None}
+            for i in range(3):
+                bases.append(await phase(f"bypbase{i}",
+                                         rate_=byp_rate,
+                                         seconds=byp_dur))
+                byps.append(await measured_round(
+                    f"bypload{i}", _byp_call, byp_stats))
+                rpcs.append(await measured_round(
+                    f"rpcload{i}", _rpc_call, rpc_stats))
+            out["bypass_from_replica"] = {
+                "replica_process": victim,
+                "leader_process": leader_name,
+                "analytics_rows": n_a,
+                "scan_every_s": scan_every_s,
+                "rounds": 3,
+                "bypass_scan_rounds": byp_stats["rounds"],
+                "bypass_scan_errors": byp_stats["errors"],
+                "scan_stats": byp_stats["last"],
+                **({"scan_last_error": byp_stats["last_error"]}
+                   if byp_stats["last_error"] else {}),
+                "rpc_scan_rounds": rpc_stats["rounds"],
+                "p99_ms_no_scan": med(bases, "p99_ms"),
+                "p99_ms_with_bypass": med(byps, "p99_ms"),
+                "p99_ms_with_rpc_scans": med(rpcs, "p99_ms"),
+                "p99_ms_rounds": {
+                    "base": [r["p99_ms"] for r in bases],
+                    "bypass": [r["p99_ms"] for r in byps],
+                    "rpc": [r["p99_ms"] for r in rpcs]},
+                "write_lane_no_scan": bases[-1],
+                "write_lane_with_bypass": byps[-1],
+                "write_lane_with_rpc_scans": rpcs[-1],
+                # bypass from a real replica process vs the same
+                # aggregate through the leader's hot path: the p99
+                # impact ratios the ROADMAP bypass item (c) asks for
+                # (medians across rounds; p95 twin recorded for the
+                # noise floor on this 2-core box)
+                "cluster_bypass_p99_impact": round(
+                    med(byps, "p99_ms")
+                    / max(med(bases, "p99_ms"), 1e-9), 3),
+                "rpc_scan_p99_impact": round(
+                    med(rpcs, "p99_ms")
+                    / max(med(bases, "p99_ms"), 1e-9), 3),
+                "cluster_bypass_p95_impact": round(
+                    med(byps, "p95_ms")
+                    / max(med(bases, "p95_ms"), 1e-9), 3),
+                "rpc_scan_p95_impact": round(
+                    med(rpcs, "p95_ms")
+                    / max(med(bases, "p95_ms"), 1e-9), 3)}
+            return out
+        finally:
+            await sup.shutdown()
+
+    try:
+        return asyncio.run(run())
+    except Exception as e:   # noqa: BLE001 — report, don't fail bench
+        if os.environ.get("BENCH_DEBUG"):
+            raise
+        return {"error": str(e)[:300]}
+
+
 def bypass_scan_bench():
     """Analytics bypass under live point-write fire: a 2x-saturation
     open-loop YCSB point-WRITE load rides the real RPC path while Q6
@@ -902,7 +1310,14 @@ _RATIO_KEYS = ("vs_baseline", "speedup", "vs_cpu", "vs_xla",
                "p99_ratio_on_vs_off", "achieved_ratio_on_vs_off",
                "stream_vs_mono", "v2_vs_v1_bytes", "prune_speedup",
                "bypass_vs_hotpath", "bypass_p99_impact",
-               "grouped_vs_interp")
+               "grouped_vs_interp", "split_goodput_ratio",
+               "cluster_bypass_p95_impact", "cluster_p99_on_vs_off",
+               "cluster_achieved_on_vs_off")
+
+#: keys where ANY nonzero value is a regression (acked data vanished
+#: or corrupted across a chaos round — never acceptable)
+_NONZERO_BAD_KEYS = ("chaos_missing", "chaos_mismatched",
+                     "chaos_unreachable")
 
 
 def warn_regressed_ratios(node, path="", out=None):
@@ -922,9 +1337,31 @@ def warn_regressed_ratios(node, path="", out=None):
                     bad = v > 0.5
                 elif k == "bypass_p99_impact":
                     bad = v > 2.0
+                elif k == "cluster_bypass_p95_impact":
+                    # the gate rides the p95 ratio, not p99: on 2
+                    # cores a round's p99 is its ~50th-highest sample
+                    # and flush-pause spikes swing it ~20x run to run
+                    # (p99_ms_rounds records the spread), while the
+                    # p95 medians hold steady; a REAL event-loop
+                    # coupling reads 10x+ either way
+                    bad = v > 2.0
+                elif k == "cluster_p99_on_vs_off":
+                    # cross-process: driver p99 includes client
+                    # backoff/retry; the bar is "scheduler ON is not
+                    # WORSE", with headroom for 2-core noise
+                    bad = v > 1.5
+                elif k == "cluster_achieved_on_vs_off":
+                    bad = v < 0.9
+                elif k == "split_goodput_ratio":
+                    # goodput through a live split+rebalance may dip,
+                    # but collapsing past 4x is a control-plane stall
+                    bad = v < 0.25
                 else:
                     bad = v < 1.0
                 if bad:
+                    out.append((p, v))
+            elif k in _NONZERO_BAD_KEYS and isinstance(v, (int, float)):
+                if v > 0:
                     out.append((p, v))
             else:
                 warn_regressed_ratios(v, p, out)
@@ -1455,6 +1892,14 @@ def main():
     if ol is not None:
         results["ycsb_overload"] = ol
 
+    # live fire on a REAL multi-process cluster: scheduler separation,
+    # goodput through split+rebalance, seeded chaos with byte-verify,
+    # bypass from a separate replica process (BENCH_CLUSTER_S bounds
+    # each phase, 0 skips)
+    co = cluster_overload_bench()
+    if co is not None:
+        results["cluster_overload"] = co
+
     # TPC-C-style NEW-ORDER/PAYMENT through REAL distributed txns on an
     # in-process cluster (reference headline bench; tpmC here is the
     # UNCONSTRAINED NewOrder rate — no spec think times). BENCH_TPCC_S
@@ -1652,6 +2097,8 @@ def main():
         "ycsb_e_ops_per_s": round(results["ycsb_e"]["ops_per_s"], 1),
         **({"ycsb_overload": results["ycsb_overload"]}
            if "ycsb_overload" in results else {}),
+        **({"cluster_overload": results["cluster_overload"]}
+           if "cluster_overload" in results else {}),
         **({"bypass_scan": results["bypass_scan"]}
            if "bypass_scan" in results else {}),
         "driver_conformance": driver_conf,

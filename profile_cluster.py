@@ -1,0 +1,120 @@
+"""Profile the multi-process cluster harness.
+
+`--json` prints ONE JSON object timing the harness's own moving parts
+— process spawn → READY latency per role, driver setup/load rate,
+closed-loop saturation, one open-loop phase at 1x and 2x with the
+latency split, graceful-drain wall (SIGTERM → exit 0) vs
+kill+restart-to-READY wall, and a cross-process control-RPC
+round-trip cost (`metrics_snapshot` / `arm_fault`) — so harness
+overhead is separable from the database behavior it measures
+(a supervisor that takes 4s to notice READY would silently eat the
+chaos round's restart budget).
+
+Env knobs: PROFILE_CLUSTER_TSERVERS (default 2), PROFILE_CLUSTER_ROWS
+(default 500), PROFILE_CLUSTER_PHASE_S (default 1.5).
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("YBTPU_PLATFORM", "cpu")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def profile_json() -> dict:
+    import asyncio
+
+    from yugabyte_db_tpu.cluster import ClusterSupervisor
+
+    n_ts = int(os.environ.get("PROFILE_CLUSTER_TSERVERS", "2"))
+    rows = int(os.environ.get("PROFILE_CLUSTER_ROWS", "500"))
+    phase_s = float(os.environ.get("PROFILE_CLUSTER_PHASE_S", "1.5"))
+
+    async def run():
+        out = {"num_tservers": n_ts, "rows": rows, "phase_s": phase_s}
+        sup = ClusterSupervisor(
+            tempfile.mkdtemp(prefix="ybtpu-profcl-"),
+            num_tservers=0)
+        t0 = time.perf_counter()
+        await sup.start()                      # master only
+        out["master_ready_s"] = round(time.perf_counter() - t0, 3)
+        try:
+            spawns = []
+            for i in range(n_ts):
+                t0 = time.perf_counter()
+                await sup.spawn_tserver(i)
+                spawns.append(round(time.perf_counter() - t0, 3))
+            await sup.wait_tservers_live()
+            out["tserver_ready_s"] = spawns
+
+            t0 = time.perf_counter()
+            await sup.spawn_driver("drv-0")
+            out["driver_ready_s"] = round(time.perf_counter() - t0, 3)
+
+            t0 = time.perf_counter()
+            await sup.call("drv-0", "driver", "setup",
+                           {"rows": rows, "num_tablets": 2,
+                            "replication_factor": min(2, max(1, n_ts))},
+                           timeout=120.0)
+            load_s = time.perf_counter() - t0
+            out["setup_s"] = round(load_s, 3)
+            out["load_rows_per_s"] = round(rows / max(load_s, 1e-9), 1)
+
+            # control-RPC round-trip cost (the supervisor's assertion
+            # surface — it rides inside every bench/chaos loop)
+            for method, payload in (("metrics_snapshot", {}),
+                                    ("fault_status", {})):
+                t0 = time.perf_counter()
+                for _ in range(20):
+                    await sup.call("ts-0", "tserver", method, payload,
+                                   timeout=10.0)
+                out[f"{method}_rtt_ms"] = round(
+                    (time.perf_counter() - t0) / 20 * 1e3, 2)
+
+            sat = (await sup.call("drv-0", "driver", "saturation",
+                                  {"seconds": phase_s, "workers": 32},
+                                  timeout=60.0))["ops_per_s"]
+            out["saturation_ops_per_s"] = round(sat, 1)
+            for label, mult in (("phase_1x", 1.0), ("phase_2x", 2.0)):
+                out[label] = await sup.call(
+                    "drv-0", "driver", "run_phase",
+                    {"rate": min(mult * sat, 4000.0),
+                     "seconds": phase_s, "sla_ms": 2000,
+                     "tag": label}, timeout=120.0)
+
+            # drain vs crash-restart walls
+            t0 = time.perf_counter()
+            code = await sup.stop("ts-0", drain=True)
+            out["drain_s"] = round(time.perf_counter() - t0, 3)
+            out["drain_exit_code"] = code
+            t0 = time.perf_counter()
+            await sup.restart("ts-0")
+            out["restart_after_drain_s"] = round(
+                time.perf_counter() - t0, 3)
+            await sup.kill("ts-0")
+            t0 = time.perf_counter()
+            await sup.restart("ts-0")
+            out["restart_after_kill_s"] = round(
+                time.perf_counter() - t0, 3)
+            return out
+        finally:
+            await sup.shutdown()
+
+    return asyncio.run(run())
+
+
+def main(argv=None):
+    args = argv if argv is not None else sys.argv[1:]
+    out = profile_json()
+    if "--json" in args:
+        print(json.dumps(out))
+    else:
+        for k, v in out.items():
+            print(f"{k}: {v}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

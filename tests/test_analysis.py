@@ -619,6 +619,32 @@ class TestLayering:
                 """})
         assert _findings(r) == []
 
+    def test_cluster_rule(self, tmp_path):
+        """cluster/ may import client/rpc/utils/models but never
+        server-side internals (tserver/tablet/master/storage/...) —
+        the harness talks to servers ONLY over RPC."""
+        r = self._run_scoped(tmp_path, {
+            "yugabyte_db_tpu/cluster/ok.py": """\
+                from ..client import YBClient
+                from ..rpc.messenger import Messenger
+                from ..utils.metrics import REGISTRY
+                from ..models.ycsb import usertable_info
+                """,
+            "yugabyte_db_tpu/cluster/bad.py": """\
+                from ..tserver import TabletServer
+                from ..tablet.tablet_peer import TabletPeer
+                import yugabyte_db_tpu.storage.lsm
+                from ..master import Master
+                def f():
+                    from ..bypass import BypassSession
+                    return BypassSession
+                """})
+        layers = sorted(d.split(":")[0] for _, _, d in _findings(r))
+        assert layers == ["bypass", "master", "storage", "tablet",
+                         "tserver"]
+        assert all(f == "yugabyte_db_tpu/cluster/bad.py"
+                   for f, _, _ in _findings(r))
+
 
 # --- interprocedural: the call graph itself --------------------------------
 

@@ -1,0 +1,432 @@
+"""Multi-process cluster harness (cluster/): supervisor, driver,
+chaos, and crash-recovery across REAL process kills.
+
+Tier-1 keeps the short shapes (a 2-tserver smoke, the two SIGKILL
+crash-recovery tests — each cluster spins up in a couple of seconds);
+the 2x-saturation / auto-split / rebalance / chaos rounds run under
+``-m slow`` (CLUSTER.md documents the split).
+
+Every test creates its own supervisor inside its own asyncio.run: the
+supervisor owns a client-side Messenger bound to the running loop, so
+nothing here can be shared across event loops.
+"""
+import asyncio
+import os
+import time
+
+import pytest
+
+from yugabyte_db_tpu.cluster import ChaosController, ClusterSupervisor
+from yugabyte_db_tpu.cluster.supervisor import ManagedProcess
+from yugabyte_db_tpu.docdb.operations import ReadRequest
+from yugabyte_db_tpu.docdb.wire import read_request_to_wire
+from yugabyte_db_tpu.ops.scan import AggSpec
+from yugabyte_db_tpu.rpc.messenger import RpcError
+from yugabyte_db_tpu.utils.fault_injection import HARD_CRASH_EXIT_CODE
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _driver_setup(sup, rows=200, num_tablets=2, rf=2, **kw):
+    await sup.spawn_driver("drv-0")
+    return await sup.call("drv-0", "driver", "setup",
+                          {"rows": rows, "num_tablets": num_tablets,
+                           "replication_factor": rf, **kw},
+                          timeout=90.0)
+
+
+async def _verify_zero_loss(sup, timeout=120.0):
+    v = await sup.call("drv-0", "driver", "verify", {}, timeout=timeout)
+    assert v["missing"] == 0 and v["mismatched"] == 0 \
+        and v["unreachable"] == 0, v
+    return v
+
+
+# --------------------------------------------------------------------------
+# process-free units
+# --------------------------------------------------------------------------
+class TestSupervisorUnits:
+    def test_backoff_schedule_monotone_capped(self):
+        delays = [ClusterSupervisor.backoff_delay(i) for i in range(10)]
+        assert delays[0] == 0.0
+        assert delays == sorted(delays)
+        assert delays[9] == ClusterSupervisor.BACKOFF_S[-1]
+
+    def test_chaos_plan_seeded_deterministic(self):
+        """Same seed + same cluster shape = identical plan; spare is
+        never a victim; kills get a paired restart."""
+        def fake_sup():
+            sup = ClusterSupervisor.__new__(ClusterSupervisor)
+            sup.procs = {
+                f"ts-{i}": ManagedProcess(
+                    name=f"ts-{i}", role="tserver", module="m",
+                    args=[], env={}, log_path="/", data_dir="/")
+                for i in range(4)}
+            return sup
+        plans = [ChaosController(fake_sup(), seed=7).plan_round(
+            kills=2, stalls=1, round_s=3.0, spare=("ts-0",))
+            for _ in range(2)]
+        assert [e.as_tuple() for e in plans[0]] == \
+            [e.as_tuple() for e in plans[1]]
+        assert all(e.victim != "ts-0" for e in plans[0])
+        kills = [e for e in plans[0] if e.kind == "kill"]
+        restarts = {e.victim: e for e in plans[0] if e.kind == "restart"}
+        assert len(kills) == 2
+        for k in kills:
+            assert restarts[k.victim].at_s > k.at_s
+        # a different seed reshuffles (victims or times)
+        other = ChaosController(fake_sup(), seed=8).plan_round(
+            kills=2, stalls=1, round_s=3.0, spare=("ts-0",))
+        assert [e.as_tuple() for e in other] != \
+            [e.as_tuple() for e in plans[0]]
+
+
+# --------------------------------------------------------------------------
+# tier-1 multi-process shapes (seconds each, real OS processes)
+# --------------------------------------------------------------------------
+class TestClusterSmoke:
+    def test_smoke_load_verify_drain(self, tmp_path):
+        """2 tservers + master + driver as real processes: load, open
+        loop, zero-loss verify, cross-process metrics/fault RPCs,
+        graceful SIGTERM drain (exit 0), restart on the SAME port."""
+        async def main():
+            sup = await ClusterSupervisor(str(tmp_path),
+                                          num_tservers=2).start()
+            try:
+                r = await _driver_setup(sup, rows=120, rf=2)
+                assert r["rows"] == 120 and r["table_id"]
+                ph = await sup.call(
+                    "drv-0", "driver", "run_phase",
+                    {"rate": 150, "seconds": 1.0, "sla_ms": 4000},
+                    timeout=30.0)
+                assert ph["ok"] > 0
+                await _verify_zero_loss(sup)
+
+                # cross-process metrics snapshot (the satellite's
+                # assertion surface): pid proves it is the CHILD's
+                snap = await sup.call("ts-0", "tserver",
+                                      "metrics_snapshot", {}, timeout=10.0)
+                assert snap["pid"] != os.getpid()
+                assert snap["tablets"] and all(
+                    "wal_index" in t for t in snap["tablets"].values())
+
+                # fault arming round-trips cross-process
+                st = await sup.call("ts-0", "tserver", "arm_fault",
+                                    {"crash_points": ["p:x"],
+                                     "disk_stall_s": 0.0},
+                                    timeout=10.0)
+                assert st["status"]["crash_points"] == ["p:x"]
+                st = await sup.call("ts-0", "tserver", "arm_fault",
+                                    {"clear_all": True}, timeout=10.0)
+                assert st["status"]["crash_points"] == []
+
+                # graceful drain: exit 0 + DRAINED marker, memtables
+                # flushed so the restart replays (almost) nothing
+                code = await sup.stop("ts-1", drain=True)
+                assert code == 0
+                with open(sup.procs["ts-1"].log_path) as f:
+                    assert "DRAINED" in f.read()
+                old_port = sup.procs["ts-1"].port
+                await sup.restart("ts-1")
+                assert sup.procs["ts-1"].port == old_port
+                await sup.wait_tservers_live()
+                await _verify_zero_loss(sup)
+            finally:
+                await sup.shutdown()
+        run(main())
+
+    def test_monitor_restarts_unexpected_death(self, tmp_path):
+        """The auto-restart monitor: a child dying OUTSIDE the
+        supervisor (SIGKILL straight at the pid — stopped stays False)
+        is respawned with backoff on its own port, and the data
+        survives via WAL replay."""
+        async def main():
+            sup = await ClusterSupervisor(str(tmp_path),
+                                          num_tservers=1).start()
+            try:
+                await _driver_setup(sup, rows=80, num_tablets=1, rf=1,
+                                    flush=False)
+                await sup.start_monitor()
+                mp = sup.procs["ts-0"]
+                old_port = mp.port
+                os.kill(mp.proc.pid, 9)     # not via sup.stop/kill
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    if mp.restarts >= 1 and mp.alive() \
+                            and mp.addr is not None:
+                        break
+                    await asyncio.sleep(0.1)
+                assert mp.restarts >= 1 and mp.alive()
+                assert mp.port == old_port
+                await sup.wait_tservers_live()
+                await _verify_zero_loss(sup)
+            finally:
+                await sup.shutdown()
+        run(main())
+
+    def test_bypass_scan_from_replica_process(self, tmp_path):
+        """An aggregate served through the bypass engine by a SEPARATE
+        replica process (rpc_bypass_scan): correct result, zero key
+        rebuilds, and flag-off refusal."""
+        async def main():
+            sup = await ClusterSupervisor(str(tmp_path),
+                                          num_tservers=2).start()
+            try:
+                r = await _driver_setup(sup, rows=300, rf=2, flush=True)
+                table_id = r["table_id"]
+                req = {"table_id": table_id,
+                       "req": read_request_to_wire(ReadRequest(
+                           table_id,
+                           aggregates=(AggSpec("count"),
+                                       AggSpec("sum", ("col", 0)))))}
+                with pytest.raises(RpcError):   # flag off on the child
+                    await sup.call("ts-1", "tserver", "bypass_scan",
+                                   req, timeout=30.0)
+                await sup.call("ts-1", "tserver", "set_flag",
+                               {"name": "bypass_reader_enabled",
+                                "value": True}, timeout=10.0)
+                resp = await sup.call("ts-1", "tserver", "bypass_scan",
+                                      req, timeout=60.0)
+                assert resp["agg_values"][0] == 300.0
+                assert resp["agg_values"][1] == 300 * 299 / 2
+                assert resp["stats"]["key_rebuilds"] == 0
+            finally:
+                await sup.shutdown()
+        run(main())
+
+
+class TestCrashRecoveryRealKill:
+    """SIGKILL-fidelity crash recovery: the armed crash point os._exits
+    the CHILD process (no atexit, no finally), and the restart must
+    reclaim everything via the PR-4 tombstone / PR-7 unmanifested-SST
+    sweeps."""
+
+    def test_kill_mid_flush_sweeps_unmanifested_sst(self, tmp_path):
+        """Env-handshake-armed `flush:before_manifest` kills the
+        tserver with the SST fully written but NOT in the manifest; the
+        restart must sweep the orphan file and recover every acked row
+        from the WAL."""
+        async def main():
+            sup = await ClusterSupervisor(str(tmp_path),
+                                          num_tservers=1).start()
+            try:
+                # arm via the ENV handshake on a fresh process: the
+                # point is live before the first request (the RPC route
+                # is exercised by the smoke test above)
+                await sup.stop("ts-0", drain=False)
+                sup.procs["ts-0"].env.update({
+                    "YBTPU_CRASH_POINTS": "flush:before_manifest",
+                    "YBTPU_CRASH_HARD": "1"})
+                await sup.restart("ts-0")
+                await sup.wait_tservers_live()
+                st = await sup.call("ts-0", "tserver", "fault_status",
+                                    {}, timeout=10.0)
+                assert st["status"]["crash_points"] == \
+                    ["flush:before_manifest"]
+                assert st["status"]["hard_crash"] is True
+
+                r = await _driver_setup(sup, rows=100, num_tablets=1,
+                                        rf=1, flush=False)
+                snap = await sup.call("ts-0", "tserver",
+                                      "metrics_snapshot", {}, timeout=10.0)
+                tablet_id = next(iter(snap["tablets"]))
+                with pytest.raises((RpcError, asyncio.TimeoutError,
+                                    OSError)):
+                    await sup.call("ts-0", "tserver", "flush",
+                                   {"tablet_id": tablet_id}, timeout=15.0)
+                await sup._wait_exit(sup.procs["ts-0"], 10.0)
+                assert sup.procs["ts-0"].exit_code() == \
+                    HARD_CRASH_EXIT_CODE
+
+                # the orphan: a full .sst on disk, absent from the
+                # manifest the crash never wrote
+                reg = os.path.join(str(tmp_path), "ts-0", "tablets",
+                                   tablet_id, "regular")
+                orphans = [f for f in os.listdir(reg)
+                           if f.endswith(".sst")]
+                assert orphans, "crash point fired before the SST wrote"
+
+                sup.procs["ts-0"].env.pop("YBTPU_CRASH_POINTS")
+                sup.procs["ts-0"].env.pop("YBTPU_CRASH_HARD")
+                sup.procs["ts-0"].stopped = True
+                await sup.restart("ts-0")
+                await sup.wait_tservers_live()
+                await _verify_zero_loss(sup)
+                # the sweep reclaimed the unmanifested file at open
+                left = set(os.listdir(reg))
+                assert not (set(orphans) & left), (orphans, left)
+            finally:
+                await sup.shutdown()
+        run(main())
+
+    def test_kill_mid_split_rebuilds_child(self, tmp_path):
+        """`split:before_marker` kills the tserver with the first split
+        child's data flushed but its split-complete marker absent; the
+        restarted process must rebuild the children from the replayed
+        split entry and lose nothing."""
+        async def main():
+            sup = await ClusterSupervisor(str(tmp_path),
+                                          num_tservers=1).start()
+            try:
+                await _driver_setup(sup, rows=200, num_tablets=1, rf=1,
+                                    flush=False)
+                snap = await sup.call("ts-0", "tserver",
+                                      "metrics_snapshot", {}, timeout=10.0)
+                tablet_id = next(iter(snap["tablets"]))
+                await sup.call("ts-0", "tserver", "arm_fault",
+                               {"crash_points": ["split:before_marker"],
+                                "hard": True}, timeout=10.0)
+                with pytest.raises((RpcError, asyncio.TimeoutError,
+                                    OSError)):
+                    await sup.call("master-0", "master", "split_tablet",
+                                   {"tablet_id": tablet_id}, timeout=20.0)
+                await sup._wait_exit(sup.procs["ts-0"], 10.0)
+                assert sup.procs["ts-0"].exit_code() == \
+                    HARD_CRASH_EXIT_CODE
+
+                sup.procs["ts-0"].stopped = True
+                await sup.restart("ts-0")
+                await sup.wait_tservers_live()
+                # the replayed split entry rebuilt BOTH children (the
+                # parent stops serving; each child carries a marker)
+                deadline = time.monotonic() + 30
+                children = []
+                while time.monotonic() < deadline:
+                    snap = await sup.call("ts-0", "tserver",
+                                          "metrics_snapshot", {},
+                                          timeout=10.0)
+                    children = [t for t in snap["tablets"]
+                                if t != tablet_id]
+                    if len(children) == 2:
+                        break
+                    await asyncio.sleep(0.25)
+                assert len(children) == 2, snap["tablets"]
+                await _verify_zero_loss(sup)
+            finally:
+                await sup.shutdown()
+        run(main())
+
+
+# --------------------------------------------------------------------------
+# full live-fire shapes (slow: 2x saturation, control plane, chaos)
+# --------------------------------------------------------------------------
+@pytest.mark.slow
+class TestClusterLiveFire:
+    def test_overload_sheds_not_collapse(self, tmp_path):
+        """Open loop at 2x the measured saturation: the cluster sheds /
+        slows but completes the phase, and every acked write survives."""
+        async def main():
+            sup = await ClusterSupervisor(str(tmp_path),
+                                          num_tservers=2).start()
+            try:
+                await _driver_setup(sup, rows=300, rf=2)
+                sat = await sup.call("drv-0", "driver", "saturation",
+                                     {"seconds": 1.5, "workers": 32},
+                                     timeout=60.0)
+                rate = max(200.0, 2.0 * sat["ops_per_s"])
+                ph = await sup.call(
+                    "drv-0", "driver", "run_phase",
+                    {"rate": rate, "seconds": 3.0, "sla_ms": 2000},
+                    timeout=120.0)
+                assert ph["ok"] > 0
+                await _verify_zero_loss(sup)
+            finally:
+                await sup.shutdown()
+        run(main())
+
+    def test_autosplit_under_live_load(self, tmp_path):
+        """enable_automatic_tablet_splitting + a lowered size threshold
+        while the driver fires: the master splits a tablet THROUGH the
+        online Raft split path, under load, and nothing is lost."""
+        async def main():
+            sup = await ClusterSupervisor(str(tmp_path),
+                                          num_tservers=2).start()
+            try:
+                await _driver_setup(sup, rows=200, num_tablets=2, rf=2)
+                await sup.call("master-0", "master", "set_flag",
+                               {"name":
+                                "tablet_split_size_threshold_bytes",
+                                "value": 40_000}, timeout=10.0)
+                await sup.call("master-0", "master", "set_flag",
+                               {"name":
+                                "enable_automatic_tablet_splitting",
+                                "value": True}, timeout=10.0)
+                ntab, deadline = 2, time.monotonic() + 45
+                while time.monotonic() < deadline:
+                    await sup.call("drv-0", "driver", "run_phase",
+                                   {"rate": 300, "seconds": 1.0,
+                                    "sla_ms": 4000}, timeout=30.0)
+                    snap = await sup.call("master-0", "master",
+                                          "metrics_snapshot", {},
+                                          timeout=10.0)
+                    ntab = len(snap["tablet_reports"])
+                    if ntab > 2:
+                        break
+                assert ntab > 2, "auto-split did not fire under load"
+                await _verify_zero_loss(sup)
+            finally:
+                await sup.shutdown()
+        run(main())
+
+    def test_rebalance_drains_blacklisted_tserver(self, tmp_path):
+        """Blacklist-driven rebalance under load: a third tserver joins,
+        the blacklisted one drains via balancer replica moves (the
+        remote-bootstrap catch-up path), writes keep landing."""
+        async def main():
+            sup = await ClusterSupervisor(str(tmp_path), num_tservers=2,
+                                          auto_balance=True).start()
+            try:
+                await _driver_setup(sup, rows=200, num_tablets=2, rf=2)
+                await sup.spawn_tserver(2)
+                await sup.wait_tservers_live()
+                await sup.call("master-0", "master", "blacklist",
+                               {"ts_uuid": "ts-0"}, timeout=10.0)
+                load = asyncio.ensure_future(sup.call(
+                    "drv-0", "driver", "run_phase",
+                    {"rate": 200, "seconds": 4.0, "sla_ms": 4000},
+                    timeout=60.0))
+                drained, deadline = False, time.monotonic() + 45
+                while time.monotonic() < deadline:
+                    snap = await sup.call("ts-0", "tserver",
+                                          "metrics_snapshot", {},
+                                          timeout=10.0)
+                    if not snap["tablets"]:
+                        drained = True
+                        break
+                    await asyncio.sleep(0.5)
+                ph = await load
+                assert ph["ok"] > 0
+                assert drained, "blacklisted tserver still owns replicas"
+                await _verify_zero_loss(sup)
+            finally:
+                await sup.shutdown()
+        run(main())
+
+    def test_seeded_chaos_round_zero_loss(self, tmp_path):
+        """A seeded kill + disk-stall + restart round under load: the
+        plan's PAIRED restart brings the victim back, the stall
+        clears, and the quiesced byte-verify finds every acked write
+        intact."""
+        async def main():
+            sup = await ClusterSupervisor(str(tmp_path),
+                                          num_tservers=3).start()
+            try:
+                await _driver_setup(sup, rows=200, num_tablets=2, rf=3)
+                chaos = ChaosController(sup, seed=42)
+                plan = chaos.plan_round(kills=1, stalls=1, stall_s=1.0,
+                                        round_s=2.0)
+                load = asyncio.ensure_future(sup.call(
+                    "drv-0", "driver", "run_phase",
+                    {"rate": 250, "seconds": 4.0, "sla_ms": 4000},
+                    timeout=90.0))
+                log = await chaos.run_round(plan)
+                assert any(o.startswith("exit=") for *_, o in log)
+                ph = await load
+                assert ph["ok"] > 0
+                await chaos.clear_all()
+                await _verify_zero_loss(sup)
+            finally:
+                await sup.shutdown()
+        run(main())

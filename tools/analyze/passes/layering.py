@@ -32,8 +32,20 @@ from typing import List
 from ..core import AnalysisPass, Finding, ProjectIndex
 
 #: (scoped directory prefix -> module names its files must not import)
+#:
+#: - bypass/ exists to avoid the tserver hot path, so it must not
+#:   import it (or the scheduler, or the rpc stack it sidesteps);
+#: - cluster/ is the multi-process harness: it talks to servers ONLY
+#:   over RPC and process signals, so it may import client/rpc/utils
+#:   (and the models vocabulary) but never reach into server-side
+#:   internals — importing tserver/tablet/storage would let the
+#:   supervisor "fix" cluster state in-process, which is exactly the
+#:   single-loop shortcut the subsystem exists to kill.
 LAYER_RULES = {
     "yugabyte_db_tpu/bypass/": ("tserver", "sched", "rpc"),
+    "yugabyte_db_tpu/cluster/": ("tserver", "tablet", "master", "sched",
+                                 "storage", "consensus", "bypass",
+                                 "docdb", "dockv", "ops"),
 }
 
 _PKG_ROOT = "yugabyte_db_tpu"
@@ -56,9 +68,10 @@ def _resolve_relative(pkg: List[str], level: int, module: str) -> str:
 class LayeringPass(AnalysisPass):
     id = "layering"
     title = "subsystem layering violations"
-    hint = ("the bypass engine must stay independent of the tserver "
-            "hot path: take data through storage/ops/parallel seams, "
-            "or move the coupling into the client layer")
+    hint = ("scoped subsystems keep their dependency direction: bypass "
+            "takes data through storage/ops/parallel seams (never "
+            "tserver/sched/rpc); cluster talks to servers only over "
+            "RPC/client/signals (never server internals)")
 
     def _check_target(self, rel: str, forbidden, target: str):
         """First forbidden layer named by dotted import target, if
@@ -109,9 +122,11 @@ class LayeringPass(AnalysisPass):
         return out
 
     def _finding(self, mi, node, layer: str, target: str) -> Finding:
+        rel = mi.rel.replace("\\", "/")
+        sub = rel.split("/")[1] if "/" in rel else rel
         return self.finding(
             mi, node.lineno,
-            f"bypass module imports the `{layer}` layer "
+            f"{sub} module imports the `{layer}` layer "
             f"({target}) — the subsystem's isolation guarantee "
             "forbids this dependency",
             detail=f"{layer}:{target}")

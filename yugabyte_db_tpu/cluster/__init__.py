@@ -1,0 +1,19 @@
+"""Multi-process cluster harness (ISSUE 10 / ROADMAP "multi-process
+cluster under live fire").
+
+Everything else in this repo runs clients and servers on one event
+loop and one GIL; this package spawns them as REAL OS processes —
+N tservers + a master + remote load-driver processes — so availability
+and load behavior can be engineered and measured the way Taurus
+separates compute and storage into independently-failing processes.
+
+Layering (enforced by the tools/analyze `layering` pass in tier-1):
+``cluster/`` talks to servers ONLY over RPC and process signals — it
+may import client/rpc/utils (and the model/request vocabulary) but
+never ``tserver``/``tablet`` internals.
+"""
+from .chaos import ChaosController, ChaosEvent
+from .supervisor import ClusterSupervisor, ManagedProcess
+
+__all__ = ["ChaosController", "ChaosEvent", "ClusterSupervisor",
+           "ManagedProcess"]

@@ -1,0 +1,126 @@
+"""Cross-process chaos controller.
+
+Arms the existing utils/fault_injection.py machinery in CHILD
+processes (via the servers' ``arm_fault`` control RPC or the
+supervisor's env handshake), then kills peers and stalls disks on a
+SEEDED schedule — the same round replays identically given the same
+seed and cluster shape, so a chaos failure is reproducible instead of
+anecdotal (reference analog: the ExternalMiniCluster crash itests +
+TEST_ flag fault points, run against real forked daemons).
+
+An event is a plain tuple so plans are printable/serializable:
+
+    ("kill",       victim, at_s)            SIGKILL, no drain code runs
+    ("disk_stall", victim, at_s, stall_s)   storage write path hangs
+    ("crash_point", victim, at_s, name)     armed hard -> process dies
+                                            at the named product seam
+    ("restart",    victim, at_s)            respawn with backoff
+"""
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from .supervisor import ClusterSupervisor
+
+
+@dataclass(frozen=True)
+class ChaosEvent:
+    kind: str                     # kill | disk_stall | crash_point | restart
+    victim: str                   # managed-process name (ts-i)
+    at_s: float                   # offset into the round
+    arg: Optional[object] = None  # stall seconds / crash-point name
+
+    def as_tuple(self) -> tuple:
+        return (self.kind, self.victim, self.at_s) + (
+            (self.arg,) if self.arg is not None else ())
+
+
+class ChaosController:
+    def __init__(self, sup: ClusterSupervisor, seed: int = 0):
+        self.sup = sup
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.executed: List[tuple] = []
+
+    def plan_round(self, kills: int = 1, stalls: int = 1,
+                   stall_s: float = 1.0, round_s: float = 2.0,
+                   spare: Sequence[str] = (),
+                   restart_after_s: float = 0.5) -> List[ChaosEvent]:
+        """Derive one round's schedule from the seed: victims and times
+        are rng-chosen from the CURRENT tserver set (minus `spare` —
+        e.g. the node a test needs alive), kills get a paired restart.
+        Deterministic: same seed + same cluster shape = same plan."""
+        candidates = sorted(n for n in self.sup.tserver_names()
+                            if n not in spare)
+        if not candidates:
+            raise ValueError("no chaos candidates (all spared)")
+        events: List[ChaosEvent] = []
+        kill_victims = []
+        for _ in range(min(kills, len(candidates))):
+            v = self.rng.choice([c for c in candidates
+                                 if c not in kill_victims] or candidates)
+            at = round(self.rng.uniform(0.1, max(0.2, round_s / 2)), 3)
+            kill_victims.append(v)
+            events.append(ChaosEvent("kill", v, at))
+            events.append(ChaosEvent("restart", v,
+                                     round(at + restart_after_s, 3)))
+        for _ in range(stalls):
+            # stall a peer that is NOT being killed when possible: a
+            # dead process can't exercise its storage path
+            alive = [c for c in candidates if c not in kill_victims]
+            v = self.rng.choice(alive or candidates)
+            at = round(self.rng.uniform(0.1, max(0.2, round_s / 2)), 3)
+            events.append(ChaosEvent("disk_stall", v, at, stall_s))
+        return sorted(events, key=lambda e: (e.at_s, e.kind, e.victim))
+
+    async def run_round(self, events: Sequence[ChaosEvent]) -> List[tuple]:
+        """Execute a planned round against the live cluster.  Waits are
+        relative to the round start; the executed log (with outcomes)
+        is returned and kept on the controller for the bench record.
+        Each event is contained: a failed arm/restart (e.g. a stall
+        aimed at a peer that is dead right now, or a READY timeout on
+        a slow box) logs an error outcome and the round CONTINUES —
+        losing the paired restart to an earlier event's failure would
+        turn one transient error into a wedged cluster."""
+        t0 = time.monotonic()
+        log: List[tuple] = []
+        for ev in sorted(events, key=lambda e: e.at_s):
+            delay = ev.at_s - (time.monotonic() - t0)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            try:
+                outcome = await self._execute(ev)
+            except Exception as e:   # noqa: BLE001 — contained above
+                outcome = f"error={type(e).__name__}: {str(e)[:80]}"
+            log.append(ev.as_tuple() + (outcome,))
+            self.executed.append(log[-1])
+        return log
+
+    async def _execute(self, ev: ChaosEvent) -> str:
+        if ev.kind == "kill":
+            code = await self.sup.kill(ev.victim)
+            return f"exit={code}"
+        if ev.kind == "restart":
+            await self.sup.restart(ev.victim)
+            return "ready"
+        if ev.kind == "disk_stall":
+            stall_s = float(ev.arg) if ev.arg is not None else 1.0
+            await self.sup.call(ev.victim, "tserver", "arm_fault",
+                                {"disk_stall_s": stall_s},
+                                timeout=10.0)
+            return f"stalled={stall_s}s"
+        if ev.kind == "crash_point":
+            await self.sup.call(ev.victim, "tserver", "arm_fault",
+                                {"crash_points": [str(ev.arg)],
+                                 "hard": True}, timeout=10.0)
+            return f"armed={ev.arg}"
+        raise ValueError(f"unknown chaos event kind {ev.kind!r}")
+
+    async def clear_all(self) -> None:
+        """Disarm every fault on every live server (round teardown)."""
+        await self.sup.call_all("arm_fault", {"clear_all": True},
+                                best_effort=True)

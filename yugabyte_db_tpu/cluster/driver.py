@@ -1,0 +1,337 @@
+"""Open-loop YCSB load-driver process.
+
+    python -m yugabyte_db_tpu.cluster.driver --masters host:port[,...]
+
+A REMOTE client fleet as one real OS process (spawned by
+cluster/supervisor.py): it owns a pool of YBClients on its own event
+loop/GIL, fires an OPEN loop — ops are launched on the offered-rate
+clock, never gated on completions, so server backpressure shows up as
+latency/sheds instead of silently throttling the offered load — and
+ships per-op latency histograms back to the supervisor over its
+``driver`` RPC service:
+
+- ``setup``      create + load the usertable (rows/tablets/RF knobs)
+- ``saturation`` closed-loop probe: the rate the cluster sustains
+- ``run_phase``  open loop at an offered rate with an SLA deadline;
+                 returns p50/p95/p99, achieved (in-SLA) goodput, shed/
+                 timeout counts; every acked write's full row token is
+                 remembered for later verification
+- ``verify``     quiesced re-read of every acked write, byte-compared
+                 against what was acked (the chaos round's zero-data-
+                 loss check)
+- ``quit``       graceful exit
+
+Layering: this module talks to the cluster ONLY through the public
+client (tools/analyze `layering` forbids tserver/tablet imports here).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..client import YBClient
+from ..models.ycsb import usertable_info
+from ..rpc.messenger import Messenger, RpcError
+from ..utils.metrics import REGISTRY
+
+#: fields written per row — the byte-verify compares every one
+_N_FIELDS = 10
+#: fresh write keys start here, far above any base-row key
+_WRITE_KEY_BASE = 10_000_000
+
+# transient faults an op can surface while the cluster splits, moves
+# replicas, or loses a peer (client retry exhaustion includes OSError/
+# RuntimeError, not just RpcError)
+_TRANSIENT = (RpcError, asyncio.TimeoutError, OSError, RuntimeError)
+
+
+def _row_token(tag: str, key: int) -> str:
+    return f"{tag}:{key}:{'v' * 20}"
+
+
+def _make_row(tag: str, key: int) -> dict:
+    token = _row_token(tag, key)
+    return {"ycsb_key": key,
+            **{f"field{j}": token for j in range(_N_FIELDS)}}
+
+
+class LoadDriver:
+    """The in-process half: an RPC service over a YBClient pool."""
+
+    def __init__(self, master_addrs: List[Tuple[str, int]],
+                 n_clients: int = 8):
+        self.master_addrs = master_addrs
+        self.messenger = Messenger("driver")
+        self.messenger.register_service("driver", self)
+        self.clients = [YBClient(master_addrs=master_addrs)
+                        for _ in range(n_clients)]
+        self.table = "usertable"
+        self.base_rows = 0
+        self._key_seq = _WRITE_KEY_BASE
+        self._acked: Dict[int, str] = {}    # key -> acked row token
+        self._lat_hist = REGISTRY.entity("server", "driver") \
+            .histogram("op_latency_us", "per-op client-side latency")
+        self.quit_event = asyncio.Event()
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0):
+        return await self.messenger.start(host, port)
+
+    async def shutdown(self):
+        for c in self.clients:
+            await c.messenger.shutdown()
+        await self.messenger.shutdown()
+
+    # --- control RPCs -----------------------------------------------------
+    async def rpc_ping(self, payload) -> dict:
+        return {"ok": True, "pid": os.getpid(),
+                "acked": len(self._acked)}
+
+    async def rpc_setup(self, payload) -> dict:
+        """Create + load the usertable; returns once every tablet has
+        an elected, client-visible leader (the driver-side readiness
+        barrier)."""
+        rows = int(payload.get("rows", 1000))
+        c = self.clients[0]
+        info = usertable_info()
+        await c.create_table(
+            info, num_tablets=int(payload.get("num_tablets", 2)),
+            replication_factor=int(payload.get("replication_factor", 1)))
+        await self._wait_leaders(timeout=float(payload.get(
+            "leader_timeout_s", 30.0)))
+        tag = payload.get("tag", "base")
+        loaded = 0
+        for lo in range(0, rows, 500):
+            batch = [_make_row(tag, k)
+                     for k in range(lo, min(lo + 500, rows))]
+            for attempt in range(20):
+                try:
+                    await c.insert(self.table, batch)
+                    break
+                except _TRANSIENT:
+                    if attempt == 19:
+                        raise
+                    await asyncio.sleep(0.1)
+                    c._tables.clear()
+            loaded += len(batch)
+        self.base_rows = rows
+        if payload.get("flush", True):
+            await self._flush_all()
+        ct = await c._table(self.table, refresh=True)
+        return {"ok": True, "rows": loaded,
+                "table_id": ct.info.table_id}
+
+    async def _wait_leaders(self, timeout: float = 30.0) -> None:
+        c = self.clients[0]
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                ct = await c._table(self.table, refresh=True)
+                if all(l.leader is not None and l.leader_addr() is not None
+                       for l in ct.locations):
+                    return
+            except _TRANSIENT:
+                pass
+            await asyncio.sleep(0.1)
+        raise RpcError(f"no leaders for {self.table}", "TIMED_OUT")
+
+    async def _flush_all(self) -> None:
+        c = self.clients[0]
+        ct = await c._table(self.table, refresh=True)
+        for loc in ct.locations:
+            addr = loc.leader_addr()
+            if addr is None:
+                continue
+            try:
+                await c.messenger.call(addr, "tserver", "flush",
+                                       {"tablet_id": loc.tablet_id},
+                                       timeout=30.0)
+            except _TRANSIENT:
+                pass
+
+    async def rpc_saturation(self, payload) -> dict:
+        """Closed-loop probe: `workers` back-to-back op streams for
+        `seconds`; the resulting rate is the saturation point the open
+        loop doubles."""
+        seconds = float(payload.get("seconds", 1.5))
+        workers = int(payload.get("workers", 32))
+        write_fraction = float(payload.get("write_fraction", 1.0))
+        tag = payload.get("tag", "sat")
+        rng = np.random.default_rng(int(payload.get("seed", 1)))
+        stop_at = time.perf_counter() + seconds
+        done = 0
+
+        async def w(i: int):
+            nonlocal done
+            c = self.clients[i % len(self.clients)]
+            while time.perf_counter() < stop_at:
+                try:
+                    await self._one_op(c, rng, tag, write_fraction,
+                                       sla_s=30.0)
+                    done += 1
+                except _TRANSIENT:
+                    await asyncio.sleep(0.01)
+        await asyncio.gather(*[w(i) for i in range(workers)])
+        return {"ops_per_s": round(done / seconds, 1), "ok": done}
+
+    def _alloc_key(self) -> int:
+        self._key_seq += 1
+        return self._key_seq
+
+    async def _one_op(self, c: YBClient, rng, tag: str,
+                      write_fraction: float, sla_s: float) -> None:
+        if rng.random() < write_fraction or self.base_rows == 0:
+            k = self._alloc_key()
+            token_row = _make_row(tag, k)
+            await asyncio.wait_for(c.insert(self.table, [token_row]),
+                                   sla_s)
+            # acked only on completion: a cancelled op may or may not
+            # have landed, and the verifier checks acked ⊆ database
+            self._acked[k] = token_row["field0"]
+        else:
+            k = int(rng.integers(0, self.base_rows))
+            await asyncio.wait_for(
+                c.get(self.table, {"ycsb_key": k}), sla_s)
+
+    async def rpc_run_phase(self, payload) -> dict:
+        """Open loop: `rate` ops/s for `seconds`, each op under an SLA
+        deadline of `sla_ms`.  Achieved ops/s counts IN-SLA completions
+        only — the goodput an overloaded or convulsing cluster actually
+        delivers to clients that still want the answer."""
+        rate = float(payload["rate"])
+        seconds = float(payload.get("seconds", 2.0))
+        sla_s = float(payload.get("sla_ms", 2000)) / 1e3
+        write_fraction = float(payload.get("write_fraction", 1.0))
+        tag = payload.get("tag", "phase")
+        rng = np.random.default_rng(int(payload.get("seed", 2)))
+        lat: List[float] = []
+        shed = timed_out = conn_err = 0
+        tasks = []
+
+        async def one(i: int):
+            nonlocal shed, timed_out, conn_err
+            c = self.clients[i % len(self.clients)]
+            t0 = time.perf_counter()
+            try:
+                await self._one_op(c, rng, tag, write_fraction, sla_s)
+                dt = time.perf_counter() - t0
+                lat.append(dt)
+                self._lat_hist.increment(dt * 1e6)
+            except asyncio.TimeoutError:
+                timed_out += 1
+            except RpcError as e:
+                if e.code == "SERVICE_UNAVAILABLE":
+                    shed += 1
+                else:
+                    conn_err += 1
+            except (OSError, RuntimeError):
+                conn_err += 1
+        total = max(1, int(rate * seconds))
+        interval = 1.0 / max(rate, 1e-6)
+        t_start = time.perf_counter()
+        for i in range(total):
+            due = t_start + i * interval
+            now = time.perf_counter()
+            if now < due:
+                await asyncio.sleep(due - now)
+            tasks.append(asyncio.ensure_future(one(i)))
+        await asyncio.gather(*tasks)
+        wall = time.perf_counter() - t_start
+        lat_ms = sorted(x * 1e3 for x in lat)
+
+        def pct(q: float) -> float:
+            if not lat_ms:
+                return 0.0
+            return lat_ms[min(len(lat_ms) - 1, int(q * len(lat_ms)))]
+        return {"offered_ops_per_s": round(rate, 1),
+                "achieved_ops_per_s": round(len(lat) / wall, 1),
+                "ok": len(lat), "shed": shed, "timed_out": timed_out,
+                "conn_err": conn_err, "sla_ms": sla_s * 1e3,
+                "p50_ms": round(pct(0.5), 2),
+                "p95_ms": round(pct(0.95), 2),
+                "p99_ms": round(pct(0.99), 2),
+                "acked_total": len(self._acked)}
+
+    async def rpc_verify(self, payload) -> dict:
+        """Quiesced re-read: every acked write must be present with its
+        acked bytes (all fields) — the chaos round's zero-data-loss
+        assertion.  Per-key bounded retries ride out the last of a
+        recovery (both transient ERRORS and not-yet-visible None
+        reads); the three failure kinds stay separate so a lagging
+        recovery (`unreachable`) can never masquerade as real loss
+        (`missing` = a read that SUCCEEDED and found nothing) — a
+        zero-loss check asserts all three are zero."""
+        sample = payload.get("sample")
+        keys = sorted(self._acked)
+        if sample and len(keys) > int(sample):
+            rng = np.random.default_rng(int(payload.get("seed", 3)))
+            keys = sorted(rng.choice(np.asarray(keys), size=int(sample),
+                                     replace=False).tolist())
+        c = self.clients[0]
+        missing: List[int] = []
+        mismatched: List[int] = []
+        unreachable: List[int] = []
+        for k in keys:
+            token = self._acked[k]
+            row = None
+            read_ok = False
+            for attempt in range(10):
+                read_ok = False
+                try:
+                    row = await c.get(self.table, {"ycsb_key": k})
+                    read_ok = True
+                    if row is not None:
+                        break
+                except _TRANSIENT:
+                    c._tables.clear()
+                await asyncio.sleep(0.2)
+            if row is None:
+                (missing if read_ok else unreachable).append(k)
+            elif any(row.get(f"field{j}") != token
+                     for j in range(_N_FIELDS)):
+                mismatched.append(k)
+        return {"checked": len(keys), "acked": len(self._acked),
+                "missing": len(missing), "mismatched": len(mismatched),
+                "unreachable": len(unreachable),
+                "missing_examples": missing[:5],
+                "mismatched_examples": mismatched[:5],
+                "unreachable_examples": unreachable[:5]}
+
+    async def rpc_quit(self, payload) -> dict:
+        self.quit_event.set()
+        return {"ok": True}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(prog="ybtpu-driver")
+    p.add_argument("--masters", required=True)
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--clients", type=int, default=8)
+    args = p.parse_args(argv)
+    masters: List[Tuple[str, int]] = []
+    for hp in args.masters.split(","):
+        if hp:
+            h, pt = hp.rsplit(":", 1)
+            masters.append((h, int(pt)))
+
+    async def run():
+        # the ONE process contract (READY/DRAINED markers, signal
+        # set) lives in server_main._serve; the driver only adds its
+        # `quit` RPC as an extra stop trigger
+        from ..tools.server_main import _serve
+        drv = LoadDriver(masters, n_clients=args.clients)
+        addr = await drv.start(port=args.port)
+        await _serve(addr, drv.shutdown, stop=drv.quit_event)
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,443 @@
+"""ClusterSupervisor: real OS processes under one orchestrator.
+
+The multi-process analog of tools/mini_cluster.py (reference:
+integration-tests/external_mini_cluster.h, the forked-daemon harness):
+spawns yb-master/yb-tserver/driver analogs via spawn-safe module entry
+points (``python -m yugabyte_db_tpu.tools.server_main`` /
+``...cluster.driver``), gives each its own data dir and log file,
+gates on a readiness barrier, and exposes the two stop shapes a real
+deployment has — SIGTERM drain (flush + WAL close + lease release,
+exit 0) and SIGKILL crash — plus restart with exponential backoff.
+
+Supervisor protocol (CLUSTER.md):
+
+- layout: ``<root>/<name>/`` data dir per process,
+  ``<root>/logs/<name>.log`` capturing stdout+stderr;
+- readiness: the child prints ``READY <host>:<port>`` as its first
+  line (into its log file); the supervisor polls the log, so no pipe
+  management can deadlock a wedged child — and a child that dies
+  before READY fails fast with its log tail in the error;
+- ports: first spawn binds port 0 (the OS chooses); restarts rebind
+  the SAME endpoint, because Raft configs and client caches address
+  nodes by host:port;
+- control: the supervisor holds a client-side Messenger and reaches
+  children through their normal RPC services (set_flag, arm_fault,
+  metrics_snapshot, ...) — there is no second control channel to
+  drift from the real one.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..rpc.messenger import Messenger, RpcError
+
+_READY_PREFIX = "READY "
+
+
+@dataclass
+class ManagedProcess:
+    """One supervised child: its spawn recipe (for restarts) + state."""
+
+    name: str
+    role: str                          # master | tserver | driver
+    module: str
+    args: List[str]
+    env: Dict[str, str]
+    log_path: str
+    data_dir: str
+    proc: Optional[subprocess.Popen] = None
+    addr: Optional[Tuple[str, int]] = None
+    port: int = 0                      # pinned after first readiness
+    restarts: int = 0
+    stopped: bool = False              # deliberate stop (monitor ignores)
+    #: byte offset up to which the (append-only) log has been scanned
+    #: for READY lines: each incarnation prints exactly one, so a
+    #: restart's barrier only sees FRESH lines past this offset — and
+    #: each poll reads O(new bytes), not the whole history
+    log_scan_pos: int = 0
+    _fail_streak: int = field(default=0, repr=False)
+    _last_start: float = field(default=0.0, repr=False)
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    def exit_code(self) -> Optional[int]:
+        return None if self.proc is None else self.proc.poll()
+
+
+class ClusterSupervisor:
+    """Spawn and drive a master + N tservers (+ driver processes).
+
+    Async context manager::
+
+        sup = await ClusterSupervisor(root, num_tservers=3).start()
+        try:
+            drv = await sup.spawn_driver("drv-0")
+            ...
+        finally:
+            await sup.shutdown()
+    """
+
+    #: restart backoff schedule (seconds) indexed by the current
+    #: consecutive-fast-failure streak, capped at the last entry
+    BACKOFF_S = (0.0, 0.25, 0.5, 1.0, 2.0, 5.0)
+    #: a child alive at least this long resets its failure streak
+    STABLE_UPTIME_S = 5.0
+
+    def __init__(self, root: str, num_tservers: int = 2,
+                 zones: Optional[List[str]] = None,
+                 auto_balance: bool = False,
+                 env: Optional[Dict[str, str]] = None,
+                 ready_timeout_s: float = 60.0):
+        self.root = str(root)
+        self.num_tservers = num_tservers
+        self.zones = zones
+        self.auto_balance = auto_balance
+        self.ready_timeout_s = ready_timeout_s
+        self.procs: Dict[str, ManagedProcess] = {}
+        self.messenger = Messenger("cluster-supervisor")
+        self._monitor_task: Optional[asyncio.Task] = None
+        self._base_env = dict(os.environ)
+        self._base_env.setdefault("YBTPU_PLATFORM", "cpu")
+        # the repo root must be importable in children no matter where
+        # the supervisor itself was launched from
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        pp = self._base_env.get("PYTHONPATH", "")
+        if pkg_root not in pp.split(os.pathsep):
+            self._base_env["PYTHONPATH"] = (
+                pkg_root + (os.pathsep + pp if pp else ""))
+        if env:
+            self._base_env.update(env)
+
+    # --- naming -----------------------------------------------------------
+    def master_name(self) -> str:
+        return "master-0"
+
+    def tserver_names(self) -> List[str]:
+        return [n for n, p in self.procs.items() if p.role == "tserver"]
+
+    def master_addrs(self) -> List[Tuple[str, int]]:
+        return [p.addr for p in self.procs.values()
+                if p.role == "master" and p.addr is not None]
+
+    def _masters_arg(self) -> str:
+        return ",".join(f"{h}:{p}" for h, p in self.master_addrs())
+
+    # --- spawning ---------------------------------------------------------
+    def _spawn(self, mp: ManagedProcess, port: Optional[int] = None
+               ) -> None:
+        os.makedirs(os.path.dirname(mp.log_path), exist_ok=True)
+        os.makedirs(mp.data_dir, exist_ok=True)
+        args = list(mp.args)
+        if port is not None:
+            args += ["--port", str(port)]
+        log = open(mp.log_path, "ab", buffering=0)
+        try:
+            mp.proc = subprocess.Popen(
+                [sys.executable, "-m", mp.module] + args,
+                stdout=log, stderr=subprocess.STDOUT, env=mp.env,
+                start_new_session=True)
+        finally:
+            log.close()           # the child owns the fd now
+        mp.stopped = False
+        mp.addr = None
+        mp._last_start = time.monotonic()
+
+    def _make_proc(self, name: str, role: str, module: str,
+                   args: List[str], extra_env: Optional[dict] = None
+                   ) -> ManagedProcess:
+        env = dict(self._base_env)
+        if extra_env:
+            env.update(extra_env)
+        mp = ManagedProcess(
+            name=name, role=role, module=module, args=args, env=env,
+            log_path=os.path.join(self.root, "logs", f"{name}.log"),
+            data_dir=os.path.join(self.root, name))
+        self.procs[name] = mp
+        return mp
+
+    async def start(self) -> "ClusterSupervisor":
+        name = self.master_name()
+        args = ["master", "--fs-root",
+                os.path.join(self.root, name), "--uuid", "m0"]
+        if self.auto_balance:
+            args.append("--auto-balance")
+        mp = self._make_proc(name, "master",
+                             "yugabyte_db_tpu.tools.server_main", args)
+        self._spawn(mp, port=0)
+        barriers: List[asyncio.Task] = []
+        try:
+            await self.wait_ready(name)
+            # spawn every tserver FIRST, then gate: the children's
+            # interpreter boots (the dominant startup cost) overlap
+            names = [self._make_tserver(i).name
+                     for i in range(self.num_tservers)]
+            barriers = [asyncio.ensure_future(self.wait_ready(n))
+                        for n in names]
+            await asyncio.gather(*barriers)
+            await self.wait_tservers_live()
+        except BaseException:
+            for t in barriers:   # gather leaves siblings running
+                t.cancel()
+            # a failed barrier must not strand the children already
+            # spawned (start_new_session detaches them from us): the
+            # caller never got the supervisor back, so nobody else
+            # can shut them down
+            await self.shutdown()
+            raise
+        return self
+
+    def _make_tserver(self, i: int, extra_env: Optional[dict] = None
+                      ) -> ManagedProcess:
+        name = f"ts-{i}"
+        zone = (self.zones[i % len(self.zones)] if self.zones
+                else "zone-default")
+        mp = self._make_proc(
+            name, "tserver", "yugabyte_db_tpu.tools.server_main",
+            ["tserver", "--fs-root", os.path.join(self.root, name),
+             "--uuid", name, "--masters", self._masters_arg(),
+             "--zone", zone], extra_env)
+        self._spawn(mp, port=0)
+        return mp
+
+    async def spawn_tserver(self, i: int,
+                            extra_env: Optional[dict] = None
+                            ) -> ManagedProcess:
+        mp = self._make_tserver(i, extra_env)
+        await self.wait_ready(mp.name)
+        return mp
+
+    async def spawn_driver(self, name: str,
+                           extra_args: Optional[List[str]] = None,
+                           extra_env: Optional[dict] = None
+                           ) -> ManagedProcess:
+        """A remote load-driver process (cluster/driver.py) wired at
+        this cluster's masters; drive it through its `driver` RPC
+        service."""
+        mp = self._make_proc(
+            name, "driver", "yugabyte_db_tpu.cluster.driver",
+            ["--masters", self._masters_arg()] + list(extra_args or ()),
+            extra_env)
+        self._spawn(mp, port=0)
+        await self.wait_ready(name)
+        return mp
+
+    # --- readiness barrier ------------------------------------------------
+    def _tail(self, mp: ManagedProcess, n: int = 12) -> str:
+        try:
+            with open(mp.log_path, "r", errors="replace") as f:
+                return "".join(f.readlines()[-n:])
+        except OSError:
+            return "<no log>"
+
+    async def wait_ready(self, name: str,
+                         timeout: Optional[float] = None) -> Tuple[str, int]:
+        """Poll the child's log for a FRESH READY line (one past the
+        scanned offset — restarts append, so the barrier can never
+        accept the dead predecessor's line); fail fast (with the log
+        tail) if the process dies first.  Each poll reads only the
+        bytes appended since the last one."""
+        mp = self.procs[name]
+        deadline = time.monotonic() + (timeout or self.ready_timeout_s)
+        while time.monotonic() < deadline:
+            ready: Optional[str] = None
+            try:
+                # analysis-ok(async_blocking): reads only new bytes
+                with open(mp.log_path, "rb") as f:
+                    f.seek(mp.log_scan_pos)
+                    chunk = f.read()
+            except OSError:
+                chunk = b""
+            if chunk:
+                # consume complete lines only: a partially-flushed
+                # line stays unscanned for the next poll
+                cut = chunk.rfind(b"\n") + 1
+                for ln in chunk[:cut].decode(
+                        errors="replace").splitlines():
+                    if ln.startswith(_READY_PREFIX):
+                        ready = ln
+                mp.log_scan_pos += cut
+            if ready is not None:
+                host, port = ready[len(_READY_PREFIX):] \
+                    .strip().rsplit(":", 1)
+                mp.addr = (host, int(port))
+                mp.port = mp.addr[1]
+                return mp.addr
+            if not mp.alive():
+                raise RuntimeError(
+                    f"{name} exited (code {mp.exit_code()}) before "
+                    f"READY; log tail:\n{self._tail(mp)}")
+            await asyncio.sleep(0.05)
+        raise TimeoutError(f"{name} not READY after "
+                           f"{timeout or self.ready_timeout_s}s; log "
+                           f"tail:\n{self._tail(mp)}")
+
+    async def wait_tservers_live(self, count: Optional[int] = None,
+                                 timeout: float = 30.0) -> None:
+        """Readiness barrier part 2: the master must see the tservers'
+        heartbeats before tables can place replicas on them."""
+        want = count if count is not None else len(self.tserver_names())
+        maddr = self.master_addrs()[0]
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                r = await self.messenger.call(maddr, "master",
+                                              "list_tservers", {},
+                                              timeout=5.0)
+                live = sum(1 for d in r["tservers"].values()
+                           if d.get("live"))
+                if live >= want:
+                    return
+            except (RpcError, asyncio.TimeoutError, OSError):
+                pass
+            await asyncio.sleep(0.1)
+        raise TimeoutError(f"{want} tservers not live at the master")
+
+    # --- stop / crash / restart -------------------------------------------
+    async def stop(self, name: str, drain: bool = True,
+                   timeout: float = 20.0) -> int:
+        """SIGTERM drain (the graceful path — exit code 0 means the
+        flush+WAL-close drain completed) or SIGKILL crash."""
+        mp = self.procs[name]
+        mp.stopped = True
+        if not mp.alive():
+            return mp.exit_code() or 0
+        mp.proc.send_signal(signal.SIGTERM if drain else signal.SIGKILL)
+        code = await self._wait_exit(mp, timeout)
+        if code is None:
+            mp.proc.kill()
+            code = await self._wait_exit(mp, 5.0)
+        return code if code is not None else -9
+
+    async def kill(self, name: str) -> int:
+        """Crash fidelity: SIGKILL, no drain code runs at all."""
+        return await self.stop(name, drain=False)
+
+    async def _wait_exit(self, mp: ManagedProcess,
+                         timeout: float) -> Optional[int]:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            code = mp.proc.poll()
+            if code is not None:
+                return code
+            await asyncio.sleep(0.05)
+        return None
+
+    async def restart(self, name: str, backoff: bool = True) -> None:
+        """Respawn a child on ITS OWN port + data dir, applying the
+        exponential backoff policy: fast consecutive failures (uptime
+        under STABLE_UPTIME_S) back off exponentially; a stable run
+        resets the streak."""
+        mp = self.procs[name]
+        if mp.alive():
+            await self.stop(name)
+        # the streak counts consecutive SHORT-LIVED incarnations: a
+        # child that ran stably restarts with no delay (deliberate
+        # chaos/test restarts must not accrue backoff), a fast-dying
+        # one backs off exponentially
+        uptime = time.monotonic() - mp._last_start
+        if uptime >= self.STABLE_UPTIME_S:
+            mp._fail_streak = 0
+        else:
+            mp._fail_streak += 1
+        delay = self.backoff_delay(mp._fail_streak) if backoff else 0.0
+        if delay > 0:
+            await asyncio.sleep(delay)
+        mp.restarts += 1
+        self._spawn(mp, port=mp.port or 0)
+        await self.wait_ready(name)
+
+    @classmethod
+    def backoff_delay(cls, streak: int) -> float:
+        return cls.BACKOFF_S[min(streak, len(cls.BACKOFF_S) - 1)]
+
+    async def start_monitor(self) -> None:
+        """Auto-restart policy: watch for UNEXPECTED exits (not stopped
+        through the supervisor) and restart with backoff — the chaos
+        layer kills peers and this brings them back."""
+        if self._monitor_task is None:
+            self._monitor_task = asyncio.create_task(self._monitor())
+
+    async def _monitor(self):
+        while True:
+            for name, mp in list(self.procs.items()):
+                if mp.proc is not None and not mp.alive() \
+                        and not mp.stopped:
+                    try:
+                        await self.restart(name)
+                    except Exception:   # noqa: BLE001 — keep watching;
+                        # the next sweep retries with a longer backoff
+                        pass
+            await asyncio.sleep(0.25)
+
+    # --- control RPC ------------------------------------------------------
+    async def call(self, name: str, service: str, method: str,
+                   payload: dict, timeout: float = 30.0):
+        mp = self.procs[name]
+        if mp.addr is None:
+            raise RuntimeError(f"{name} has no address (not ready)")
+        return await self.messenger.call(mp.addr, service, method,
+                                         payload, timeout=timeout)
+
+    async def call_all(self, method: str, payload: dict,
+                       roles: Tuple[str, ...] = ("tserver", "master"),
+                       timeout: float = 10.0,
+                       best_effort: bool = False) -> Dict[str, object]:
+        """Broadcast one control RPC to every LIVE server process of
+        the given roles (the role names double as their service
+        names); returns {process name: response}.  best_effort
+        contains per-server failures (teardown sweeps) instead of
+        aborting the broadcast on the first dead-mid-call peer."""
+        out: Dict[str, object] = {}
+        for name, mp in self.procs.items():
+            if mp.role not in roles or not mp.alive():
+                continue
+            try:
+                out[name] = await self.call(name, mp.role, method,
+                                            payload, timeout=timeout)
+            except Exception:   # noqa: BLE001 — contained per spec
+                if not best_effort:
+                    raise
+        return out
+
+    async def set_flag_all(self, flag: str, value,
+                           roles: Tuple[str, ...] = ("tserver", "master")
+                           ) -> None:
+        """Flip a runtime flag in every live server process (the
+        cross-process analog of flags.set_flag in MiniCluster benches)."""
+        await self.call_all("set_flag", {"name": flag, "value": value},
+                            roles=roles)
+
+    def client(self):
+        """A YBClient wired at this cluster's masters (caller owns the
+        messenger shutdown)."""
+        from ..client import YBClient
+        return YBClient(master_addrs=self.master_addrs())
+
+    # --- teardown ---------------------------------------------------------
+    async def shutdown(self, drain: bool = False) -> None:
+        """Stop everything (drivers first, then tservers, then the
+        master).  drain=True SIGTERMs; the default kills — tests that
+        assert on the drain path call stop(name, drain=True) explicitly
+        and check the exit code."""
+        if self._monitor_task is not None:
+            self._monitor_task.cancel()
+            self._monitor_task = None
+        order = {"driver": 0, "tserver": 1, "master": 2}
+        for name, mp in sorted(self.procs.items(),
+                               key=lambda kv: order.get(kv[1].role, 3)):
+            try:
+                await self.stop(name, drain=drain,
+                                timeout=10.0 if drain else 5.0)
+            except Exception:   # noqa: BLE001 — teardown best-effort
+                if mp.proc is not None:
+                    mp.proc.kill()
+        await self.messenger.shutdown()

@@ -80,7 +80,10 @@ class ClusterLoadBalancer:
         """Returns a description of the action taken, or None.
         Priority order mirrors the reference's ClusterLoadBalancer:
         placement repair first (a tablet violating its geo policy),
-        then replica-count balance, then leader placement/balance."""
+        then replica-count balance, then leader placement/balance.
+        Every selection loop below iterates a SNAPSHOT of
+        master.tablets: the loops await mid-iteration, and a
+        concurrent auto-split or heartbeat mutates the live dict."""
         action = await self._maybe_fix_placement()
         if action:
             return action
@@ -94,7 +97,7 @@ class ClusterLoadBalancer:
         (reference: placement-block handling in cluster_balance.cc)."""
         m = self.master
         live = set(m.live_tservers()) - self.blacklist
-        for tablet_id, ent in m.tablets.items():
+        for tablet_id, ent in list(m.tablets.items()):
             if ent.get("hidden"):
                 continue
             pol = m.placement_of(ent["table_id"])
@@ -161,7 +164,7 @@ class ClusterLoadBalancer:
             (u for u in eligible_dst
              if u != src and self._zone_of(u) == src_zone),
             key=eligible_dst.get)
-        for tablet_id, ent in self.master.tablets.items():
+        for tablet_id, ent in list(self.master.tablets.items()):
             if ent.get("hidden"):
                 # moving a hidden parent would invalidate the replica
                 # addresses replication slots reach it by
@@ -309,7 +312,7 @@ class ClusterLoadBalancer:
         # replica inside one (targeted TimeoutNow), with a per-tablet
         # cooldown — the transfer is best-effort and must not churn
         import time as _time
-        for tablet_id, ent in m.tablets.items():
+        for tablet_id, ent in list(m.tablets.items()):
             leader = ent.get("leader")
             if ent.get("hidden") or not leader or \
                     leader not in m.tservers:
@@ -347,7 +350,7 @@ class ClusterLoadBalancer:
         dst = min(counts, key=counts.get)
         if counts[src] - counts[dst] < 2:
             return None
-        for tablet_id, ent in m.tablets.items():
+        for tablet_id, ent in list(m.tablets.items()):
             if ent.get("hidden"):
                 continue
             if ent.get("leader") == src and dst in ent["replicas"]:

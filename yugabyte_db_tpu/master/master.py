@@ -84,6 +84,13 @@ class Master:
         # spans an await (Raft replicate) and must not interleave
         self._seq_lock = asyncio.Lock()
         self.auto_balance = False   # ticked explicitly or via enable
+        # tablet_id -> {"size_bytes", "wal_index", "at", "ops_s"}:
+        # leader-reported store size + EWMA write rate differentiated
+        # from successive heartbeat wal_index deltas (the auto-split
+        # size/traffic triggers read these; volatile, not catalog)
+        self._tablet_reports: Dict[str, dict] = {}
+        # tablets with an auto-split (or barrier) currently in flight
+        self._splitting: set = set()
         # sys-catalog Raft (None = standalone single master, still
         # journals through a local single-peer group once started)
         self.consensus = None
@@ -256,7 +263,62 @@ class Master:
                     await self._gc_orphan_replicas()
                 except Exception:   # noqa: BLE001
                     pass
+                try:
+                    await self._maybe_auto_split()
+                except Exception:   # noqa: BLE001 — the splitter must
+                    # never kill the maintenance loop; a failed split
+                    # retries when the report crosses the threshold
+                    # again
+                    pass
+            # reports accrete per leader heartbeat (on EVERY master —
+            # tservers heartbeat them all); drop entries whose tablet
+            # was dropped/split/hidden meanwhile so the dict (and
+            # metrics_snapshot) tracks LIVE tablets only
+            for tid in list(self._tablet_reports):
+                ent = self.tablets.get(tid)
+                if ent is None or ent.get("hidden"):
+                    self._tablet_reports.pop(tid, None)
             await asyncio.sleep(1.0)
+
+    async def _maybe_auto_split(self) -> Optional[str]:
+        """Tablet auto-splitting on size/traffic thresholds (reference:
+        the tablet-split manager behind enable_automatic_tablet_
+        splitting + tablet_split_low_phase_*): at most ONE split per
+        maintenance tick, chosen from leader heartbeat reports — size
+        crossing `tablet_split_size_threshold_bytes`, or sustained
+        write rate crossing `tablet_split_traffic_threshold_ops_s`
+        (EWMA over heartbeat wal_index deltas).  Runs THROUGH
+        rpc_split_tablet, i.e. the same Raft-replicated online split +
+        replica barrier the manual path uses — under live load, not in
+        a quiesced window."""
+        if not flags.get("enable_automatic_tablet_splitting"):
+            return None
+        size_thresh = flags.get("tablet_split_size_threshold_bytes")
+        rate_thresh = flags.get("tablet_split_traffic_threshold_ops_s")
+        max_tablets = flags.get("tablet_split_max_tablets_per_table")
+        for tablet_id, ent in list(self.tablets.items()):
+            if ent.get("hidden") or tablet_id in self._splitting:
+                continue
+            table = self.tables.get(ent.get("table_id"))
+            if table is None or \
+                    len(table.get("tablets", [])) >= max_tablets:
+                continue
+            rep = self._tablet_reports.get(tablet_id)
+            if rep is None:
+                continue
+            oversized = rep.get("size_bytes", 0) >= size_thresh
+            hot = rate_thresh > 0 and rep.get("ops_s", 0.0) >= rate_thresh
+            if not (oversized or hot):
+                continue
+            self._splitting.add(tablet_id)
+            try:
+                r = await self.rpc_split_tablet({"tablet_id": tablet_id})
+            finally:
+                self._splitting.discard(tablet_id)
+                self._tablet_reports.pop(tablet_id, None)
+            return (f"auto-split {tablet_id} -> {r['left']},{r['right']} "
+                    f"({'size' if oversized else 'traffic'})")
+        return None
 
     # --- balancing / placement RPCs ----------------------------------------
     async def rpc_move_replica(self, payload) -> dict:
@@ -275,6 +337,43 @@ class Master:
         cluster_balance.cc)."""
         self.load_balancer.blacklist.add(payload["ts_uuid"])
         return {"ok": True}
+
+    # --- cross-process control endpoint (cluster/ harness) -----------------
+    async def rpc_arm_fault(self, payload) -> dict:
+        """Arm fault-injection state in THIS master process (same
+        contract as the tserver endpoint — the chaos controller arms
+        whichever process it targets)."""
+        from ..utils import fault_injection as fi
+        return {"status": fi.arm_from_spec(payload or {})}
+
+    async def rpc_fault_status(self, payload) -> dict:
+        from ..utils import fault_injection as fi
+        return {"status": fi.fault_status()}
+
+    async def rpc_set_flag(self, payload) -> dict:
+        """Hot-update a runtime flag on THIS master (mirrors the
+        tserver RPC — the supervisor flips control-plane flags like
+        enable_automatic_tablet_splitting cross-process with it)."""
+        name = payload["name"]
+        # unknown flag -> KeyError -> RPC error surface
+        old, value = flags.coerce_and_set(name, payload["value"])
+        return {"name": name, "old": old, "value": value}
+
+    async def rpc_metrics_snapshot(self, payload) -> dict:
+        from ..utils import fault_injection as fi
+        from ..utils import metrics as _metrics
+        return {
+            "uuid": self.uuid,
+            **_metrics.snapshot(),
+            "faults": fi.fault_status(),
+            "balancer": {"moves_done": self.load_balancer.moves_done,
+                         "leader_moves_done":
+                             self.load_balancer.leader_moves_done},
+            "tablet_reports": {
+                tid: {"size_bytes": r.get("size_bytes", 0),
+                      "ops_s": round(r.get("ops_s", 0.0), 1)}
+                for tid, r in self._tablet_reports.items()},
+        }
 
     async def shutdown(self):
         self._running = False
@@ -340,17 +439,36 @@ class Master:
     # --- TS registry ------------------------------------------------------
     async def rpc_ts_heartbeat(self, payload) -> dict:
         uuid = payload["ts_uuid"]
+        now = time.monotonic()
         self.tservers[uuid] = {
             "addr": tuple(payload["addr"]),
-            "last_hb": time.monotonic(),
+            "last_hb": now,
             "tablets": payload.get("tablets", []),
             "zone": payload.get("zone", "zone-default"),
         }
-        # track leadership reports for client routing
+        # track leadership reports for client routing; differentiate
+        # the LEADER's wal_index across heartbeats into a per-tablet
+        # write rate (EWMA — one noisy heartbeat gap must not fake a
+        # traffic spike) for the auto-split traffic trigger
         for t in payload.get("tablets", []):
             ent = self.tablets.get(t["tablet_id"])
             if ent is not None and t["is_leader"]:
                 ent["leader"] = uuid
+                if ent.get("hidden"):
+                    # CDC-retained split parent: routed but never a
+                    # split candidate — don't re-accrete its report
+                    continue
+                rep = self._tablet_reports.get(t["tablet_id"])
+                ops_s = 0.0
+                wi = t.get("wal_index")
+                if rep is not None and wi is not None and \
+                        rep.get("wal_index") is not None:
+                    dt = max(now - rep["at"], 1e-3)
+                    inst = max(0, wi - rep["wal_index"]) / dt
+                    ops_s = 0.5 * rep.get("ops_s", 0.0) + 0.5 * inst
+                self._tablet_reports[t["tablet_id"]] = {
+                    "size_bytes": t.get("size_bytes", 0),
+                    "wal_index": wi, "at": now, "ops_s": ops_s}
         return {"ok": True, "leader_master": True}
 
     def live_tservers(self) -> List[str]:

@@ -21,7 +21,8 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterator, List, Optional, Sequence, Tuple
 
 from ..utils import flags
-from ..utils.fault_injection import MAYBE_FAULT, TEST_CRASH_POINT
+from ..utils.fault_injection import (MAYBE_FAULT, TEST_CRASH_POINT,
+                                     TEST_DISK_STALL)
 from .memtable import MemTable
 from .merge import merging_iterator
 from .sst import SstReader, SstWriter
@@ -307,6 +308,9 @@ class LsmStore:
             self._struct_gen += 1
             self._mem_frontier = {}
         path = self._new_sst_path()
+        # chaos seam: an armed disk stall holds THIS thread (the flush
+        # caller), exactly like a hung device under the SST write
+        TEST_DISK_STALL()
         w = SstWriter(path, columnar_builder=self.columnar_builder,
                       key_builder=self.key_builder)
         for k, v in mem.iterate():

@@ -156,6 +156,26 @@ class TabletPeer:
         await self.consensus.shutdown()
         self.log.close()
 
+    async def graceful_shutdown(self):
+        """SIGTERM drain (the supervisor's clean-stop path, vs the
+        SIGKILL crash path which skips straight to process death):
+        flush both stores' memtables off-loop — the restarted replica
+        then serves from SSTs whose flushed frontier covers the log,
+        instead of replaying the whole WAL tail — and only then close
+        consensus and the WAL.  Flush-before-close ordering matters:
+        the flushed frontier must be durable before the log stops
+        accepting the entries that produced it."""
+        loop = asyncio.get_running_loop()
+        try:
+            await loop.run_in_executor(None, self.tablet.flush)
+            # LsmStore.flush is a no-op on an empty memtable
+            await loop.run_in_executor(None, self.tablet.intents.flush)
+        except Exception:   # noqa: BLE001 — a failed flush must not
+            # block the drain; restart falls back to full WAL replay,
+            # which is exactly the crash path and always correct
+            pass
+        await self.shutdown()
+
     # --- write path -------------------------------------------------------
     def _check_inserts(self, req: WriteRequest) -> list:
         """insert-if-absent gate for 'insert' ops (unique indexes): a

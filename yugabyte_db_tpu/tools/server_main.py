@@ -1,42 +1,88 @@
 """Standalone server entry points (real processes).
 
     python -m yugabyte_db_tpu.tools.server_main master \
-        --fs-root DIR --port P
+        --fs-root DIR --port P [--uuid m0] [--auto-balance]
     python -m yugabyte_db_tpu.tools.server_main tserver \
-        --uuid ts-0 --fs-root DIR --port P --masters host:port[,host:port]
+        --uuid ts-0 --fs-root DIR --port P --masters host:port[,host:port] \
+        [--zone z]
 
 The process analog of yb-master/yb-tserver binaries (reference:
-src/yb/master/master_main.cc, tserver/tablet_server_main.cc); used by
-the ExternalMiniCluster test harness for crash/restart fidelity
-(reference: integration-tests/external_mini_cluster.h).
+src/yb/master/master_main.cc, tserver/tablet_server_main.cc); spawned
+by the multi-process cluster supervisor (cluster/supervisor.py) and by
+the ExternalMiniCluster-style tests for crash/restart fidelity.
+
+Process contract (CLUSTER.md):
+
+- the first stdout line once serving is ``READY <host>:<port>`` —
+  supervisors redirect stdout to the process log file and poll it;
+- SIGTERM = graceful drain (tserver: release bypass SST leases, flush
+  memtables, close WALs; master: stop loops, persist nothing extra —
+  the catalog is already durable per commit), then exit 0.  SIGKILL =
+  crash: nothing runs, restart takes the recovery path;
+- env handshake read BEFORE serving: ``YBTPU_CRASH_POINTS`` (comma
+  list) arms crash points, ``YBTPU_CRASH_HARD=1`` makes them kill the
+  process for real, ``YBTPU_FLAGS`` (``name=value,...``) presets
+  runtime flags — so faults/flags can cover even the first request.
 """
 from __future__ import annotations
 
 import argparse
 import asyncio
+import signal
 import sys
+
+
+def _apply_env_handshake():
+    import os
+
+    from ..utils import fault_injection, flags
+    fault_injection.arm_from_env()
+    spec = os.environ.get("YBTPU_FLAGS", "")
+    for item in spec.split(","):
+        item = item.strip()
+        if not item or "=" not in item:
+            continue
+        name, value = item.split("=", 1)
+        flags.coerce_and_set(name, value)   # unknown flag -> loud crash
+
+
+async def _serve(addr, drain, stop=None) -> None:
+    """The supervisor's process contract (CLUSTER.md), in ONE place
+    for every child role: READY line + wait for SIGTERM/SIGINT (or an
+    externally-set `stop` event — the driver's `quit` RPC), then the
+    graceful drain and the DRAINED marker.  A supervisor that wants
+    crash semantics sends SIGKILL instead and none of this runs."""
+    stop = stop if stop is not None else asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    print(f"READY {addr[0]}:{addr[1]}", flush=True)
+    await stop.wait()
+    await drain()
+    print("DRAINED", flush=True)
 
 
 async def run_master(args):
     from ..master import Master
-    m = Master(args.fs_root)
-    addr = await m.start(port=args.port)
-    print(f"READY {addr[0]}:{addr[1]}", flush=True)
-    while True:
-        await asyncio.sleep(3600)
+    _apply_env_handshake()
+    m = Master(args.fs_root, uuid=args.uuid or "m0")
+    addr = await m.start(port=args.port, auto_balance=args.auto_balance)
+    await _serve(addr, m.shutdown)
 
 
 async def run_tserver(args):
     from ..tserver import TabletServer
+    _apply_env_handshake()
     masters = []
     for hp in args.masters.split(","):
+        if not hp:
+            continue
         h, p = hp.rsplit(":", 1)
         masters.append((h, int(p)))
-    ts = TabletServer(args.uuid, args.fs_root, master_addrs=masters)
+    ts = TabletServer(args.uuid or "ts-0", args.fs_root,
+                      master_addrs=masters, zone=args.zone)
     addr = await ts.start(port=args.port)
-    print(f"READY {addr[0]}:{addr[1]}", flush=True)
-    while True:
-        await asyncio.sleep(3600)
+    await _serve(addr, lambda: ts.shutdown(graceful=True))
 
 
 def main(argv=None):
@@ -44,8 +90,12 @@ def main(argv=None):
     p.add_argument("role", choices=["master", "tserver"])
     p.add_argument("--fs-root", required=True)
     p.add_argument("--port", type=int, default=0)
-    p.add_argument("--uuid", default="ts-0")
+    p.add_argument("--uuid", default=None)
     p.add_argument("--masters", default="")
+    p.add_argument("--zone", default="zone-default")
+    p.add_argument("--auto-balance", action="store_true",
+                   help="master only: run load-balancer ticks in the "
+                        "maintenance loop")
     args = p.parse_args(argv)
     try:
         asyncio.run(run_master(args) if args.role == "master"

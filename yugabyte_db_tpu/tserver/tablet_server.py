@@ -29,6 +29,7 @@ from ..tablet.tablet_peer import TabletPeer
 import logging
 
 from ..utils import flags
+from ..utils.fault_injection import TEST_CRASH_POINT
 from ..utils.hybrid_time import HybridClock
 from ..utils.trace import ASH, TRACES, wait_status
 
@@ -69,6 +70,17 @@ def _rmtree(path: str) -> None:
     Raft heartbeats included."""
     import shutil
     shutil.rmtree(path, ignore_errors=True)
+
+
+def _close_sessions(sessions) -> None:
+    """Executor target: release every live bypass session's SST leases
+    (graceful-drain path; close is idempotent and must not abort the
+    drain)."""
+    for s in sessions:
+        try:
+            s.close()
+        except Exception:   # noqa: BLE001 — drain regardless
+            pass
 
 
 _DELETING_MARK = ".deleting-"
@@ -152,6 +164,11 @@ class TabletServer:
         # before a dispatch task is even spawned
         self.messenger.overload_probe = self.scheduler.overload_probe
         self.messenger.register_service("tserver", self)
+        # live bypass sessions opened by rpc_bypass_scan: tracked so a
+        # graceful drain can release their SST leases before the stores
+        # close (a crash leaves only unmanifested files the next open
+        # sweeps — the lease discipline's crash half)
+        self._bypass_sessions: set = set()
 
     # --- lifecycle --------------------------------------------------------
     async def start(self, host: str = "127.0.0.1", port: int = 0):
@@ -162,13 +179,31 @@ class TabletServer:
             self._hb_task = asyncio.create_task(self._heartbeat_loop())
         return self.messenger.addr
 
-    async def shutdown(self):
+    async def shutdown(self, graceful: bool = False):
+        """Stop the server.  ``graceful`` is the SIGTERM drain contract
+        the cluster supervisor relies on (CLUSTER.md): release bypass
+        SST leases, flush every tablet's memtables, close WALs — so a
+        drained node restarts serving from SSTs with nothing to replay
+        and no leaked lease pins.  The default (crash-adjacent) path
+        keeps the old behavior: consensus stops, WAL closes, memtables
+        are simply lost to replay."""
         self._running = False
         if self._hb_task:
             self._hb_task.cancel()
         await self.scheduler.shutdown()
+        if graceful:
+            # lease release first: a pinned compaction-victim SST is
+            # physically unlinked on the last release, which must
+            # happen while the store still owns its manifest
+            sessions = list(self._bypass_sessions)
+            self._bypass_sessions.clear()
+            await asyncio.get_running_loop().run_in_executor(
+                None, _close_sessions, sessions)
         for p in self.peers.values():
-            await p.shutdown()
+            if graceful:
+                await p.graceful_shutdown()
+            else:
+                await p.shutdown()
         await self.messenger.shutdown()
 
     # --- tablet management (TSTabletManager analog) -----------------------
@@ -844,6 +879,10 @@ class TabletServer:
             if intents[cid].entries:
                 ch.tablet.intents.apply(intents[cid])
             ch.tablet.flush()
+            # crash fidelity seam (real-process harness): die with the
+            # child's data copied but its split-complete marker absent —
+            # restart must rebuild this child from the replayed entry
+            TEST_CRASH_POINT("split:before_marker")
             ch.participant.recover_from_store()
             # siblings recorded so the decision-routing map rebuilds
             # COMPLETELY from any one child (the other may live on a
@@ -1284,19 +1323,98 @@ class TabletServer:
             },
         }
 
+    # --- cross-process control endpoint (cluster/ harness) -----------------
+    # The supervisor/chaos controller's seam into a running server:
+    # fault arming and metric snapshots must be reachable from OUTSIDE
+    # the process (ISSUE 10 satellite).  The env handshake in
+    # server_main covers points that must be live before the first
+    # request; these RPCs cover everything armed mid-run.
+
+    async def rpc_arm_fault(self, payload) -> dict:
+        """Arm crash/sync/stall fault state in THIS process from a spec
+        dict (utils/fault_injection.arm_from_spec); `clear_all` resets
+        first.  Returns the resulting fault status."""
+        from ..utils import fault_injection as fi
+        return {"status": fi.arm_from_spec(payload or {})}
+
+    async def rpc_fault_status(self, payload) -> dict:
+        from ..utils import fault_injection as fi
+        return {"status": fi.fault_status()}
+
+    async def rpc_metrics_snapshot(self, payload) -> dict:
+        """Process-wide metric snapshot + per-tablet store stats — the
+        supervisor's assertion surface (cross-process analog of reading
+        utils/metrics.REGISTRY in-process)."""
+        from ..utils import fault_injection as fi
+        from ..utils import metrics as _metrics
+        return {
+            "uuid": self.uuid,
+            **_metrics.snapshot(),
+            "faults": fi.fault_status(),
+            "scheduler": {"enabled": self.scheduler.enabled(),
+                          "lanes": self.scheduler.stats()},
+            "tablets": {
+                tid: {"leader": p.is_leader(),
+                      "size": p.tablet.approximate_size(),
+                      "ssts": p.tablet.num_sst_files(),
+                      "wal_index": p.consensus.last_applied,
+                      "pins": p.tablet.regular.pin_stats()}
+                for tid, p in self.peers.items()},
+        }
+
+    async def rpc_bypass_scan(self, payload) -> dict:
+        """Serve an aggregate scan through the analytics bypass engine
+        over THIS process's local replicas — the "bypass from a REAL
+        separate replica process" shape (Breaking Database Lock-in):
+        the session pins this node's SSTs and scans them in an executor
+        thread, so a replica process can serve analytics while the
+        leader process's event loop never sees the query.  Leadership
+        is NOT required: a follower's applied state plus the pinner's
+        MVCC safe-time wait give a consistent snapshot."""
+        from ..bypass import BypassIneligible, BypassSession
+        from ..docdb.wire import read_request_from_wire
+        if not flags.get("bypass_reader_enabled"):
+            raise RpcError("bypass_reader_enabled is off on this server",
+                           "BYPASS_DISABLED")
+        table_id = payload["table_id"]
+        req = read_request_from_wire(payload["req"])
+        if req.group_by is not None:
+            raise RpcError("remote bypass serves flat aggregates only",
+                           "BYPASS_INELIGIBLE")
+        peers = [p for _tid, p in sorted(self.peers.items())
+                 if not p.split_done and table_id in p.tablet.tables()]
+        if not peers:
+            raise RpcError(f"no local replica of table {table_id}",
+                           "NOT_FOUND")
+
+        def _run():
+            with BypassSession(peers, read_ht=req.read_ht,
+                               table_id=table_id) as s:
+                self._bypass_sessions.add(s)
+                try:
+                    outs, counts, stats = s.scan_aggregate(
+                        req.where, req.aggregates, group=req.group_by)
+                    return ([float(x) for x in outs],
+                            s.read_ht, stats)
+                finally:
+                    self._bypass_sessions.discard(s)
+        try:
+            outs, read_ht, stats = await asyncio.get_running_loop() \
+                .run_in_executor(None, _run)
+        except BypassIneligible as e:
+            raise RpcError(f"bypass ineligible: {e.reason}",
+                           "BYPASS_INELIGIBLE")
+        return {"agg_values": outs, "read_ht": read_ht,
+                "stats": {k: v for k, v in (stats or {}).items()
+                          if isinstance(v, (int, float, str, bool))}}
+
     async def rpc_set_flag(self, payload) -> dict:
         """Hot-update a runtime flag on THIS server (reference:
         yb-ts-cli set_flag / server/server_base_options flag RPC)."""
         from ..utils import flags as _flags
-        name, value = payload["name"], payload["value"]
-        old = _flags.get(name)          # KeyError -> RPC error surface
-        if isinstance(old, bool):
-            value = str(value).lower() in ("1", "true", "on", "yes")
-        elif isinstance(old, int):
-            value = int(value)
-        elif isinstance(old, float):
-            value = float(value)
-        _flags.set_flag(name, value)
+        name = payload["name"]
+        # unknown flag -> KeyError -> RPC error surface
+        old, value = _flags.coerce_and_set(name, payload["value"])
         return {"name": name, "old": old, "value": value}
 
     async def rpc_list_flags(self, payload) -> dict:
@@ -1364,7 +1482,11 @@ class TabletServer:
             "tablets": [
                 {"tablet_id": tid, "is_leader": p.is_leader(),
                  "size_bytes": p.tablet.approximate_size(),
-                 "num_ssts": p.tablet.num_sst_files()}
+                 "num_ssts": p.tablet.num_sst_files(),
+                 # applied WAL position: the master differentiates
+                 # successive reports into a write rate (the auto-split
+                 # traffic trigger's input)
+                 "wal_index": p.consensus.last_applied}
                 for tid, p in self.peers.items()
             ],
         }

@@ -9,8 +9,10 @@ activate only when tests arm them.
 """
 from __future__ import annotations
 
+import os
 import random
 import threading
+import time
 from typing import Callable, Dict
 
 from . import flags
@@ -30,6 +32,12 @@ _crash_points: set = set()
 _sync_callbacks: Dict[str, Callable[[], None]] = {}
 _rng = random.Random(0)
 _lock = threading.Lock()
+# hard-crash mode (real-process harness): an armed crash point kills
+# the PROCESS (`os._exit` — no atexit, no flushing, no finally blocks),
+# the reference TEST_CRASH_POINT semantics (util/crash_point.h:32).
+# In-process tests keep the default raise-CrashPointHit behavior.
+_hard_crash = False
+HARD_CRASH_EXIT_CODE = 134
 
 
 def arm_crash_point(name: str) -> None:
@@ -42,8 +50,17 @@ def clear_crash_points() -> None:
         _crash_points.clear()
 
 
+def set_hard_crash(on: bool) -> None:
+    global _hard_crash
+    _hard_crash = bool(on)
+
+
 def TEST_CRASH_POINT(name: str) -> None:
     if name in _crash_points:
+        if _hard_crash:
+            # real process death: nothing between here and the kernel —
+            # no buffered writes land, exactly like SIGKILL at this line
+            os._exit(HARD_CRASH_EXIT_CODE)
         raise CrashPointHit(name)
 
 
@@ -136,3 +153,104 @@ def lane_armed(lane: str) -> bool:
     """True when a stall is armed on `lane` — the scheduler's inline
     cut-through is skipped so the stall (worker-path) applies."""
     return lane in _lane_stalls
+
+
+# --- disk stall -------------------------------------------------------------
+# A slow/hung device under the storage write path (the chaos layer's
+# "stall disks" lever): while armed, TEST_DISK_STALL() blocks its
+# calling thread — flush/compaction executor threads, exactly where a
+# real fsync would hang.  Sliced sleeps so clearing releases promptly.
+
+_disk_stall_until = 0.0               # time.monotonic deadline
+
+
+def stall_disk(seconds: float) -> None:
+    global _disk_stall_until
+    _disk_stall_until = time.monotonic() + float(seconds)
+
+
+def clear_disk_stall() -> None:
+    global _disk_stall_until
+    _disk_stall_until = 0.0
+
+
+def TEST_DISK_STALL() -> None:
+    """Called by storage write paths (flush) before touching the disk."""
+    while True:
+        remaining = _disk_stall_until - time.monotonic()
+        if remaining <= 0:
+            return
+        time.sleep(min(remaining, 0.05))
+
+
+# --- cross-process arming ---------------------------------------------------
+# The harness seam (ISSUE 10 satellite): crash/sync-point arming and
+# fault state must be reachable from OUTSIDE the process.  Two routes:
+# a control RPC (tserver/master `arm_fault` -> arm_from_spec) for
+# points armed while the server runs, and an env handshake
+# (YBTPU_CRASH_POINTS / YBTPU_CRASH_HARD, read by server_main before
+# serving) for points that must be live from the very first write.
+
+def fault_status() -> dict:
+    """Observable fault-injection state (control RPC `fault_status`)."""
+    with _lock:
+        crash = sorted(_crash_points)
+        sheds = sorted(_forced_sheds)
+        stalled = sorted(_lane_stalls)
+    return {
+        "crash_points": crash,
+        "hard_crash": _hard_crash,
+        "disk_stall_remaining_s": round(
+            max(0.0, _disk_stall_until - time.monotonic()), 3),
+        "forced_shed_lanes": sheds,
+        "stalled_lanes": stalled,
+        "fault_fraction": flags.get("TEST_fault_crash_fraction"),
+    }
+
+
+def arm_from_spec(spec: dict) -> dict:
+    """Arm fault state from a plain dict (RPC payload / env handshake).
+    Only the keys present are touched, so repeated calls compose;
+    returns the resulting `fault_status()`."""
+    if spec.get("clear_all"):
+        clear_all()
+    if "hard" in spec:
+        set_hard_crash(bool(spec["hard"]))
+    for name in spec.get("crash_points", ()):
+        arm_crash_point(name)
+    if "disk_stall_s" in spec:
+        stall_disk(float(spec["disk_stall_s"]))
+    for lane in spec.get("force_shed_lanes", ()):
+        force_shed_lane(lane)
+    if "fault_fraction" in spec:
+        flags.set_flag("TEST_fault_crash_fraction",
+                       float(spec["fault_fraction"]))
+    return fault_status()
+
+
+def arm_from_env(environ=None) -> None:
+    """Env handshake read at process startup (server_main), BEFORE the
+    server serves its first request: YBTPU_CRASH_POINTS is a comma
+    list of crash-point names, YBTPU_CRASH_HARD=1 makes them kill the
+    process for real."""
+    env = os.environ if environ is None else environ
+    spec: dict = {}
+    pts = env.get("YBTPU_CRASH_POINTS", "")
+    names = [p.strip() for p in pts.split(",") if p.strip()]
+    if names:
+        spec["crash_points"] = names
+    if env.get("YBTPU_CRASH_HARD") == "1":
+        spec["hard"] = True
+    if spec:
+        arm_from_spec(spec)
+
+
+def clear_all() -> None:
+    """Reset every armed fault (control RPC clear + test teardown)."""
+    clear_crash_points()
+    clear_sync_points()
+    clear_forced_sheds()
+    clear_lane_stalls()
+    clear_disk_stall()
+    set_hard_crash(False)
+    flags.set_flag("TEST_fault_crash_fraction", 0.0)

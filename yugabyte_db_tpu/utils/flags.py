@@ -97,6 +97,22 @@ def set_flag(name: str, value: Any) -> None:
     REGISTRY.set(name, value)
 
 
+def coerce_and_set(name: str, value: Any) -> tuple:
+    """Set a flag from an UNTYPED wire/env value, coercing it to the
+    current value's type (the set_flag RPCs and the YBTPU_FLAGS env
+    handshake all parse the same way — one parser, no drift).  Unknown
+    flags raise KeyError loudly.  Returns (old, coerced)."""
+    old = get(name)
+    if isinstance(old, bool):
+        value = str(value).lower() in ("1", "true", "on", "yes")
+    elif isinstance(old, int):
+        value = int(value)
+    elif isinstance(old, float):
+        value = float(value)
+    set_flag(name, value)
+    return old, value
+
+
 # --- AutoFlags ------------------------------------------------------------
 # A flag whose value auto-promotes from `initial` to `target` only once the
 # whole universe is upgraded (reference: util/flags/auto_flags.h). We track
@@ -319,6 +335,27 @@ DEFINE_RUNTIME("rpc_max_inflight_per_connection", 1024,
                "in-flight calls on one connection are rejected with the "
                "typed overload status, so one misbehaving client cannot "
                "occupy every dispatch slot.")
+
+# --- control plane under load (master auto-split; cluster/ harness) -------
+DEFINE_RUNTIME("enable_automatic_tablet_splitting", False,
+               "Master-driven tablet auto-splitting: each maintenance "
+               "tick the leader master splits at most one tablet whose "
+               "leader-reported size or write rate crossed its "
+               "threshold (reference: the tablet-splitting manager "
+               "behind enable_automatic_tablet_splitting).")
+DEFINE_RUNTIME("tablet_split_size_threshold_bytes", 64 * 1024 * 1024,
+               "Auto-split a tablet once its leader reports at least "
+               "this many bytes (tablet_split_low_phase_size_"
+               "threshold_bytes analog).")
+DEFINE_RUNTIME("tablet_split_traffic_threshold_ops_s", 0.0,
+               "Auto-split a tablet whose write rate (WAL entries/s, "
+               "EWMA over master heartbeats) sustains above this; "
+               "0 disables the traffic trigger and leaves only the "
+               "size threshold.")
+DEFINE_RUNTIME("tablet_split_max_tablets_per_table", 16,
+               "Auto-splitting stops growing a table past this many "
+               "tablets (outstanding_tablet_split_limit analog — "
+               "bounds split storms under hot-key load).")
 
 # TEST_ flags (reference: DEFINE_test_flag, util/flags/flag_tags.h:311)
 DEFINE_RUNTIME("TEST_fault_crash_fraction", 0.0,

@@ -8,6 +8,7 @@ Entities: server / table / tablet, each with attributes.
 from __future__ import annotations
 
 import bisect
+import os
 import threading
 from dataclasses import dataclass, field
 
@@ -129,7 +130,11 @@ class MetricRegistry:
             return self._entities[key]
 
     def entities(self):
-        return list(self._entities.values())
+        # snapshot under the lock: registrations come from RPC-handler
+        # and executor threads alike, and list(dict) raises if the dict
+        # grows mid-iteration
+        with self._lock:
+            return list(self._entities.values())
 
     def to_prometheus(self) -> str:
         """Render all metrics in Prometheus text exposition format
@@ -169,3 +174,27 @@ class MetricRegistry:
 
 
 REGISTRY = MetricRegistry()
+
+
+def snapshot() -> dict:
+    """One JSON-able image of every registered metric plus the owning
+    pid — the cross-process face of the registry (control RPC
+    `metrics_snapshot`; the in-process callers keep using REGISTRY
+    directly).  Histograms ship count/sum/percentiles so supervisors
+    can assert on latency without reaching into the process."""
+    out = {"pid": os.getpid(), "entities": []}
+    for e in REGISTRY.entities():
+        ent = {"type": e.type, "id": e.id, "attributes": e.attributes,
+               "metrics": {}}
+        # list() first: worker threads register metrics concurrently
+        for m in list(e.metrics.values()):
+            if isinstance(m, Histogram):
+                ent["metrics"][m.name] = {
+                    "count": m.count(), "mean_us": m.mean(),
+                    "p50_us": m.percentile(50),
+                    "p95_us": m.percentile(95),
+                    "p99_us": m.percentile(99)}
+            else:
+                ent["metrics"][m.name] = m.value()
+        out["entities"].append(ent)
+    return out
