@@ -437,6 +437,85 @@ def cluster_overload_bench():
                     on["achieved_ops_per_s"]
                     / max(off["achieved_ops_per_s"], 1e-9), 3)}
 
+            # (a2) write-path fusion levers ON/OFF, paired ------------
+            # pure-write rounds at 2x (the write path dominates):
+            # async flush (no apply-thread SST stall), fused consensus
+            # appends (one fsync + one round per coalesced batch) and
+            # cross-tablet dispatch fusion flipped together — the
+            # PR-11 claim that `cluster_achieved_on_vs_off` ~1.0 was
+            # unclaimed fusion, now measured as its own paired leg
+            fusion_flags = ("async_flush_enabled",
+                            "fused_replicate_enabled",
+                            "sched_cross_tablet_fusion")
+            # cool down leg (a)'s 2x backlog first (same reason leg
+            # (b) settles), then force REAL flush traffic: at the
+            # default 64MB threshold a short round never flushes and
+            # the async-flush lever would measure nothing — 1MB makes
+            # each round pay several memtable flushes, ON as frozen
+            # handoffs to the flush executor, OFF as inline
+            # apply-thread stalls (the ~20x p99 source)
+            await asyncio.sleep(duration)
+            await phase("fuse-settle", wf=1.0)
+            await sup.set_flag_all("memstore_flush_threshold_bytes",
+                                   1_000_000, roles=("tserver",))
+            fon_rounds, foff_rounds = [], []
+            try:
+                for i in range(2):
+                    fon_rounds.append(await phase(f"fuse-on{i}",
+                                                  wf=1.0))
+                    for fl in fusion_flags:
+                        await sup.set_flag_all(fl, False,
+                                               roles=("tserver",))
+                    try:
+                        foff_rounds.append(await phase(f"fuse-off{i}",
+                                                       wf=1.0))
+                    finally:
+                        for fl in fusion_flags:
+                            await sup.set_flag_all(fl, True,
+                                                   roles=("tserver",))
+            finally:
+                await sup.set_flag_all("memstore_flush_threshold_bytes",
+                                       64 * 1024 * 1024,
+                                       roles=("tserver",))
+            fon = max(fon_rounds,
+                      key=lambda r: r["achieved_ops_per_s"])
+            foff = max(foff_rounds,
+                       key=lambda r: r["achieved_ops_per_s"])
+            # flush/fusion counters from the live servers: the
+            # counter-assert that handoffs actually happened and
+            # coalesced groups rode fused appends
+            fuse_counters = {"flush_stalls_avoided": 0,
+                             "fused_appends": 0,
+                             "fused_append_fanin_mean": []}
+            for name in sup.tserver_names():
+                if not sup.procs[name].alive():
+                    continue
+                snap = await sup.call(name, "tserver",
+                                      "metrics_snapshot", {},
+                                      timeout=10.0)
+                for ent in snap.get("entities", []):
+                    for mname, v in ent.get("metrics", {}).items():
+                        if mname == "flush_stalls_avoided":
+                            fuse_counters["flush_stalls_avoided"] += v
+                        elif mname == "fused_appends":
+                            fuse_counters["fused_appends"] += v
+                        elif mname == "fused_append_fanin" and \
+                                isinstance(v, dict) and v.get("count"):
+                            fuse_counters[
+                                "fused_append_fanin_mean"].append(
+                                    v.get("mean_us", 0.0))
+            fm = fuse_counters["fused_append_fanin_mean"]
+            fuse_counters["fused_append_fanin_mean"] = (
+                round(sum(fm) / len(fm), 2) if fm else None)
+            out["write_fusion"] = {
+                "on": fon, "off": foff,
+                "counters": fuse_counters,
+                "cluster_fused_p99_on_vs_off": round(
+                    fon["p99_ms"] / max(foff["p99_ms"], 1e-9), 3),
+                "cluster_fused_achieved_on_vs_off": round(
+                    fon["achieved_ops_per_s"]
+                    / max(foff["achieved_ops_per_s"], 1e-9), 3)}
+
             # (b) goodput through live split + rebalance ---------------
             # the control-plane legs run at 1x saturation, not 2x: the
             # question is what a SUSTAINABLE load loses to a live
@@ -710,6 +789,16 @@ def cluster_overload_bench():
                     "base": [r["p99_ms"] for r in bases],
                     "bypass": [r["p99_ms"] for r in byps],
                     "rpc": [r["p99_ms"] for r in rpcs]},
+                # max/median of each side's round p99s: flush-pause
+                # luck swung this ~20x before async flush; the PR-11
+                # acceptance bar is <= 3x (WARN-wired as
+                # cluster_p99_spread — the worst side)
+                "cluster_p99_spread": max(
+                    round(max(vals) / max(sorted(vals)[len(vals) // 2],
+                                          1e-9), 3)
+                    for vals in ([r["p99_ms"] for r in bases],
+                                 [r["p99_ms"] for r in byps],
+                                 [r["p99_ms"] for r in rpcs])),
                 "write_lane_no_scan": bases[-1],
                 "write_lane_with_bypass": byps[-1],
                 "write_lane_with_rpc_scans": rpcs[-1],
@@ -1312,7 +1401,9 @@ _RATIO_KEYS = ("vs_baseline", "speedup", "vs_cpu", "vs_xla",
                "bypass_vs_hotpath", "bypass_p99_impact",
                "grouped_vs_interp", "split_goodput_ratio",
                "cluster_bypass_p95_impact", "cluster_p99_on_vs_off",
-               "cluster_achieved_on_vs_off")
+               "cluster_achieved_on_vs_off", "cluster_p99_spread",
+               "cluster_fused_p99_on_vs_off",
+               "cluster_fused_achieved_on_vs_off")
 
 #: keys where ANY nonzero value is a regression (acked data vanished
 #: or corrupted across a chaos round — never acceptable)
@@ -1351,7 +1442,21 @@ def warn_regressed_ratios(node, path="", out=None):
                     # WORSE", with headroom for 2-core noise
                     bad = v > 1.5
                 elif k == "cluster_achieved_on_vs_off":
-                    bad = v < 0.9
+                    # tightened from 0.9 in PR 11: the fusion levers
+                    # (async flush, fused appends, cross-tablet
+                    # dispatch) are claimed — scheduler ON must now
+                    # WIN at matched goodput, not merely tie
+                    bad = v < 1.0
+                elif k == "cluster_fused_achieved_on_vs_off":
+                    bad = v < 1.0
+                elif k == "cluster_fused_p99_on_vs_off":
+                    # fusion ON must not worsen the write p99 (2-core
+                    # noise headroom mirrors cluster_p99_on_vs_off)
+                    bad = v > 1.5
+                elif k == "cluster_p99_spread":
+                    # per-round p99 max/median: flush-pause luck made
+                    # this ~20x pre-async-flush; the PR-11 bar is 3x
+                    bad = v > 3.0
                 elif k == "split_goodput_ratio":
                     # goodput through a live split+rebalance may dip,
                     # but collapsing past 4x is a control-plane stall

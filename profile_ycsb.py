@@ -95,10 +95,38 @@ async def rpc_profile() -> dict:
                     "ycsb_key": int(k),
                     **{f"field{j}": "u" * 100 for j in range(10)}})])
 
+        from yugabyte_db_tpu.tablet.tablet import FLUSH_APPLY_STATS
+        from yugabyte_db_tpu.tablet.tablet_peer import (
+            WRITE_PATH_STATS, reset_write_path_stats)
+        reset_write_path_stats()
+        flush0 = dict(FLUSH_APPLY_STATS)
         t0 = time.perf_counter()
         await asyncio.gather(*[
             write_worker(wkeys[i::clients]) for i in range(clients)])
         write_s = time.perf_counter() - t0
+        # write-path stage split: admission wait lives in the
+        # scheduler stats below (point_write lane wait_us); the rest
+        # of the path — group merge / replicate (append+fsync+commit)
+        # / apply / flush handoff — accumulates here.  entries/batches
+        # is the group-commit fanin: batches == WAL 'write' entries,
+        # so ops/batches >> 1 proves coalesced groups rode ONE
+        # LogEntry batch each
+        write_path = {
+            "ops": ops // 2,
+            "group_merge_s": round(WRITE_PATH_STATS["group_merge_s"], 4),
+            "replicate_s": round(WRITE_PATH_STATS["replicate_s"], 4),
+            "apply_s": round(WRITE_PATH_STATS["apply_s"], 4),
+            "wal_entries": WRITE_PATH_STATS["batches"],
+            "queued_writes_per_entry": round(
+                WRITE_PATH_STATS["entries"]
+                / max(WRITE_PATH_STATS["batches"], 1), 2),
+            "flush_handoff_s": round(
+                FLUSH_APPLY_STATS["handoff_s"] - flush0["handoff_s"], 4),
+            "flush_inline_s": round(
+                FLUSH_APPLY_STATS["inline_s"] - flush0["inline_s"], 4),
+            "background_flushes": (FLUSH_APPLY_STATS["background_flushes"]
+                                   - flush0["background_flushes"]),
+        }
 
         # a burst of identical aggregate scans: exercises coalescing
         t0 = time.perf_counter()
@@ -117,6 +145,7 @@ async def rpc_profile() -> dict:
             "read_ops_per_s": round(ops / read_s, 1),
             "write_ops_per_s": round((ops // 2) / write_s, 1),
             "agg_scans_per_s": round(32 / scan_s, 1),
+            "write_path": write_path,
             "scheduler": stats,
             "bulk_load": bulk_load_profile(),
             "grouped_scan": grouped_scan_profile(),

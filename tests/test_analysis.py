@@ -1318,6 +1318,124 @@ class TestPreFixProductShapes:
         assert r["findings"] == []
 
 
+#: the pre-fix PR-11 write-path stall, minimally: Raft apply (async)
+#: -> sync _apply_payload -> self.tablet.apply_write (attr typed by
+#: the annotated __init__ param) -> Tablet.flush -> self.regular.flush
+#: (attr typed by its constructor) -> SST write + fsync ON THE APPLY
+#: THREAD.  Both attr hops need the call graph's attribute typing —
+#: the lexical layers and the PR-8 engine were blind to this chain.
+_APPLY_FLUSH_SHAPE = {
+    "pkg/__init__.py": "",
+    "pkg/store.py": """\
+        import os
+        class LsmStore:
+            def flush(self):
+                with open(self._path + ".tmp", "w") as f:
+                    f.write(self._mem)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(self._path + ".tmp", self._path)
+    """,
+    "pkg/tablet.py": """\
+        from .store import LsmStore
+        class Tablet:
+            def __init__(self, directory):
+                self.regular = LsmStore(directory)
+            def apply_write(self, req):
+                self.regular.apply(req)
+                if self.regular.should_flush():
+                    self.flush()
+            def flush(self):
+                return self.regular.flush()
+    """,
+    "pkg/peer.py": """\
+        from .tablet import Tablet
+        class TabletPeer:
+            def __init__(self, tablet: Tablet):
+                self.tablet = tablet
+            def _apply_payload(self, entry):
+                self.tablet.apply_write(entry.req)
+            async def _apply_entry(self, entry):
+                self._apply_payload(entry)
+    """,
+}
+
+
+class TestWritePathHotPath:
+    """PR-11 rule: a synchronous LsmStore.flush reachable from the
+    Raft apply path is an apply-thread stall — the ~20x p99 round
+    swing the cluster harness measured.  Pinned pre-fix; the post-fix
+    tree (frozen-memtable handoff to the flush executor) gates clean
+    via test_whole_tree_zero_unannotated_findings."""
+
+    def test_prefix_apply_write_flush_shape_flagged(self, tmp_path):
+        r = _run(tmp_path, _APPLY_FLUSH_SHAPE, "async_blocking")
+        details = {d for _, _, d in _findings(r)}
+        assert "os.fsync" in details, r["findings"]
+        # the finding lands on the async-side call in _apply_entry
+        assert any(p.endswith("peer.py") and l == 8
+                   for p, l, _ in _findings(r)), r["findings"]
+
+    def test_edge_annotation_stops_taint_without_silencing_helper(
+            self, tmp_path):
+        # annotating the INTERMEDIATE flush call (the flag-gated
+        # legacy revert shape) stops the taint at that edge only: an
+        # unannotated second path through the same helper still flags
+        files = dict(_APPLY_FLUSH_SHAPE)
+        files["pkg/tablet.py"] = """\
+            from .store import LsmStore
+            class Tablet:
+                def __init__(self, directory):
+                    self.regular = LsmStore(directory)
+                def apply_write(self, req):
+                    self.regular.apply(req)
+                    if self.regular.should_flush():
+                        # analysis-ok(async_blocking): bounded revert
+                        self.flush()
+                def flush(self):
+                    return self.regular.flush()
+        """
+        files["pkg/other.py"] = """\
+            from .tablet import Tablet
+            class Maintenance:
+                def __init__(self, tablet: Tablet):
+                    self.tablet = tablet
+                async def tick(self):
+                    self.tablet.flush()
+        """
+        r = _run(tmp_path, files, "async_blocking")
+        paths = {p for p, _, _ in _findings(r)}
+        assert not any(p.endswith("peer.py") for p in paths), (
+            "annotated edge must stop the apply-path taint",
+            r["findings"])
+        assert any(p.endswith("other.py") for p in paths), (
+            "the unannotated path through Tablet.flush must still "
+            "flag", r["findings"])
+
+    def test_attr_type_conflict_poisons_resolution(self, tmp_path):
+        # an attr assigned two different classes resolves to neither
+        # (under-approximate, never guess)
+        files = dict(_APPLY_FLUSH_SHAPE)
+        files["pkg/peer.py"] = """\
+            from .tablet import Tablet
+            class Other:
+                def noop(self):
+                    return 1
+            class TabletPeer:
+                def __init__(self, tablet: Tablet):
+                    self.tablet = tablet
+                    if tablet is None:
+                        self.tablet = Other()
+                def _apply_payload(self, entry):
+                    self.tablet.apply_write(entry.req)
+                async def _apply_entry(self, entry):
+                    self._apply_payload(entry)
+        """
+        r = _run(tmp_path, files, "async_blocking")
+        assert not any(p.endswith("peer.py")
+                       for p, _, _ in _findings(r)), r["findings"]
+
+
 # --- 2 + 3. whole tree, schema, budget, baseline ---------------------------
 
 @pytest.fixture(scope="module")

@@ -373,9 +373,11 @@ class TestFallbackReasons:
         snap.close()
 
     def test_memtable_active(self, tmp_path):
-        """A frozen memtable that never drains (a stuck foreign flush)
-        must produce the typed memtable_active refusal, not a wrong
-        answer."""
+        """A frozen memtable owned by a stuck foreign flush (the flush
+        IO lock held, the drain never completing) must produce the
+        typed memtable_active refusal, not a wrong answer and not a
+        hang — the pinner's drain is best-effort (wait=False), so a
+        wedged flusher exhausts the bounded attempts."""
         t = Tablet("by-mem", _num_info(), str(tmp_path / "by-mem"))
         t.apply_write(WriteRequest(t.info.table_id, ops=[
             RowOp("upsert", {"k": 1, "v": 1.0, "g": 0})]))
@@ -383,10 +385,18 @@ class TestFallbackReasons:
         stuck = MemTable()
         stuck.put(b"zz", b"v")
         t.regular._frozen.append(stuck)
-        with pytest.raises(BypassIneligible) as ei:
-            pin_tablet(t, max_flush_attempts=2)
-        assert ei.value.reason == REASON_MEMTABLE_ACTIVE
-        t.regular._frozen.remove(stuck)
+        t.regular._flush_io_lock.acquire()     # the wedged flusher
+        try:
+            with pytest.raises(BypassIneligible) as ei:
+                pin_tablet(t, max_flush_attempts=2)
+            assert ei.value.reason == REASON_MEMTABLE_ACTIVE
+        finally:
+            t.regular._flush_io_lock.release()
+            t.regular._frozen.remove(stuck)
+        # flusher un-wedges -> the retry drains and the pin succeeds
+        snap = pin_tablet(t)
+        assert len(snap.sst_paths) >= 1
+        snap.close()
 
 
 class TestPinLease:

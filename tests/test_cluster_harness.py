@@ -261,6 +261,72 @@ class TestCrashRecoveryRealKill:
                 await sup.shutdown()
         run(main())
 
+    def test_kill_mid_flush_with_frozen_backlog_replays_all(
+            self, tmp_path):
+        """PR-11 async-flush crash seam: SIGKILL at
+        ``flush:before_manifest`` while the BACKGROUND flush executor
+        owns the write and more frozen memtables are queued behind it
+        (a disk stall holds the first flush while a tiny
+        memstore threshold keeps freezing new ones).  Restart must
+        sweep the unmanifested SST and replay every acked write —
+        frozen-memtable state is memory-only, so the WAL (whose GC
+        gates on the flushed frontier) still covers all of it."""
+        async def main():
+            sup = await ClusterSupervisor(str(tmp_path),
+                                          num_tservers=1).start()
+            try:
+                await sup.stop("ts-0", drain=False)
+                sup.procs["ts-0"].env.update({
+                    "YBTPU_CRASH_POINTS": "flush:before_manifest",
+                    "YBTPU_CRASH_HARD": "1"})
+                await sup.restart("ts-0")
+                await sup.wait_tservers_live()
+
+                r = await _driver_setup(sup, rows=60, num_tablets=1,
+                                        rf=1, flush=False)
+                snap = await sup.call("ts-0", "tserver",
+                                      "metrics_snapshot", {},
+                                      timeout=10.0)
+                tablet_id = next(iter(snap["tablets"]))
+                # tiny flush threshold + roomy frozen bound + a disk
+                # stall on the first background flush: applies keep
+                # freezing while the flush worker is held, so the
+                # crash fires with a REAL frozen backlog behind it
+                for name, val in (
+                        ("memstore_flush_threshold_bytes", 15_000),
+                        ("max_frozen_memtables", 8)):
+                    await sup.call("ts-0", "tserver", "set_flag",
+                                   {"name": name, "value": val},
+                                   timeout=10.0)
+                await sup.call("ts-0", "tserver", "arm_fault",
+                               {"disk_stall_s": 1.0}, timeout=10.0)
+                await sup.call(
+                    "drv-0", "driver", "run_phase",
+                    {"rate": 600.0, "seconds": 3.0,
+                     "write_fraction": 1.0, "sla_ms": 2000,
+                     "tag": "backlog"}, timeout=60.0)
+                await sup._wait_exit(sup.procs["ts-0"], 20.0)
+                assert sup.procs["ts-0"].exit_code() == \
+                    HARD_CRASH_EXIT_CODE
+
+                reg = os.path.join(str(tmp_path), "ts-0", "tablets",
+                                   tablet_id, "regular")
+                orphans = [f for f in os.listdir(reg)
+                           if f.endswith(".sst")]
+                assert orphans, "crash fired before any SST wrote"
+
+                sup.procs["ts-0"].env.pop("YBTPU_CRASH_POINTS")
+                sup.procs["ts-0"].env.pop("YBTPU_CRASH_HARD")
+                sup.procs["ts-0"].stopped = True
+                await sup.restart("ts-0")
+                await sup.wait_tservers_live()
+                await _verify_zero_loss(sup)
+                left = set(os.listdir(reg))
+                assert not (set(orphans) & left), (orphans, left)
+            finally:
+                await sup.shutdown()
+        run(main())
+
     def test_kill_mid_split_rebuilds_child(self, tmp_path):
         """`split:before_marker` kills the tserver with the first split
         child's data flushed but its split-complete marker absent; the

@@ -37,7 +37,9 @@ def _clean():
     for f in ("scheduler_enabled", "sched_point_read_depth",
               "sched_scan_depth", "sched_maintenance_depth",
               "rpc_max_inflight_per_connection",
-              "sched_cut_through_min_interval_us"):
+              "sched_cut_through_min_interval_us",
+              "fused_replicate_enabled", "async_flush_enabled",
+              "sched_cross_tablet_fusion"):
         flags.REGISTRY.reset(f)
 
 
@@ -427,5 +429,172 @@ class TestFlagRevert:
                 assert before == after, "scheduler saw traffic while off"
             finally:
                 flags.set_flag("scheduler_enabled", True)
+                await mc.shutdown()
+        asyncio.run(run())
+
+
+class TestFusedWritePath:
+    """PR-11 write-path fusion: fused consensus appends (one WAL
+    append + one replicate round per accumulated group — the
+    ReplicateBatch shape), one LogEntry batch per coalesced scheduler
+    write group, cross-tablet dispatch fusion, and flag reverts."""
+
+    def test_concurrent_replicates_fuse_into_one_append(self, tmp_path):
+        """Replicate calls queued while an append is pending ride ONE
+        fused append: the counter sees one append, the fanin histogram
+        sees the whole group, and every caller gets its own index."""
+        async def run():
+            mc, c, rows = await _cluster(tmp_path, n_rows=1)
+            try:
+                ts = mc.tservers[0]
+                tablet_id = (await c._table("usertable")) \
+                    .locations[0].tablet_id
+                cons = ts.peers[tablet_id].consensus
+                a0 = cons._m_fused_appends.value()
+                idxs = await asyncio.gather(
+                    *[cons.replicate("noop", b"") for _ in range(8)])
+                assert sorted(idxs) == idxs and len(set(idxs)) == 8
+                # all 8 queued in one loop sweep -> one fused append
+                assert cons._m_fused_appends.value() == a0 + 1
+                assert cons._m_fused_fanin._max >= 8
+                assert cons.log.last_index == idxs[-1]
+            finally:
+                await mc.shutdown()
+        asyncio.run(run())
+
+    def test_coalesced_group_is_one_log_entry_batch(self, tmp_path):
+        """Concurrent client writes that the scheduler coalesces land
+        as FEWER WAL entries than requests — each coalesced group one
+        LogEntry batch — and write_id order inside the merged batch is
+        arrival order (the replay-parity invariant)."""
+        async def run():
+            mc, c, rows = await _cluster(tmp_path, n_rows=1)
+            try:
+                ts = mc.tservers[0]
+                tablet_id = (await c._table("usertable")) \
+                    .locations[0].tablet_id
+                peer = ts.peers[tablet_id]
+                n0 = sum(1 for e in peer.log.all_entries()
+                         if e.etype == "write")
+                n_req = 48
+                await asyncio.gather(*[
+                    c.insert("usertable", [{
+                        "ycsb_key": 5000 + i,
+                        **{f"field{j}": f"f{i}-{j}" for j in range(10)}}])
+                    for i in range(n_req)])
+                entries = [e for e in peer.log.all_entries()
+                           if e.etype == "write"]
+                n_entries = len(entries) - n0
+                st = ts.scheduler.lanes[Lane.POINT_WRITE]
+                assert st.m_fanin._max > 1, "no group ever coalesced"
+                assert n_entries < n_req, (
+                    f"{n_req} writes produced {n_entries} WAL entries "
+                    "— coalesced groups did not share entries")
+                # every write readable (write_id order preserved the
+                # per-member effects through the merged batches)
+                for i in range(n_req):
+                    got = await c.get("usertable",
+                                      {"ycsb_key": 5000 + i})
+                    assert got["field0"] == f"f{i}-0"
+            finally:
+                await mc.shutdown()
+        asyncio.run(run())
+
+    def test_fused_replicate_off_reverts(self, tmp_path):
+        """fused_replicate_enabled=0: the per-call append path serves
+        identical results (the byte-identical revert leg)."""
+        async def run():
+            flags.set_flag("fused_replicate_enabled", False)
+            mc, c, rows = await _cluster(tmp_path, n_rows=1)
+            try:
+                ts = mc.tservers[0]
+                tablet_id = (await c._table("usertable")) \
+                    .locations[0].tablet_id
+                cons = ts.peers[tablet_id].consensus
+                a0 = cons._m_fused_appends.value()
+                await asyncio.gather(*[
+                    c.insert("usertable", [{
+                        "ycsb_key": 7000 + i,
+                        **{f"field{j}": f"o{i}" for j in range(10)}}])
+                    for i in range(12)])
+                assert cons._m_fused_appends.value() == a0, \
+                    "flag off must bypass the fused drainer"
+                for i in range(12):
+                    got = await c.get("usertable", {"ycsb_key": 7000 + i})
+                    assert got["field3"] == f"o{i}"
+            finally:
+                await mc.shutdown()
+        asyncio.run(run())
+
+    def test_cross_tablet_fusion_one_wakeup_drains_ready_groups(self):
+        """With the lane stalled, queued groups pile up; the released
+        worker's ONE wakeup drains and dispatches them all (bounded by
+        sched_fusion_max_groups), observable in the fused-wakeup
+        histogram.  Flag off: one group per wakeup."""
+        from yugabyte_db_tpu.sched.lanes import LaneConfig
+
+        async def run(fusion_on):
+            flags.set_flag("sched_cross_tablet_fusion", fusion_on)
+            # distinct owner per leg: the metrics registry keys lane
+            # entities by owner, and a shared histogram would leak the
+            # first leg's max into the second
+            sched = RequestScheduler(f"t-fuse-{fusion_on}", configs={
+                Lane.SCAN: LaneConfig(max_depth=64, soft_bytes=1 << 20,
+                                      workers=1, max_batch=4)})
+            done = []
+
+            def mk(i):
+                async def payload():
+                    done.append(i)
+                    return i
+                return payload
+
+            fi.stall_lane("scan")
+            tasks = [asyncio.create_task(
+                sched.submit_grouped(Lane.SCAN, key=("k", i), payload=mk(i)))
+                for i in range(5)]
+            await asyncio.sleep(0.05)
+            fi.release_lane("scan")
+            res = await asyncio.gather(*tasks)
+            assert sorted(res) == list(range(5))
+            st = sched.lanes[Lane.SCAN]
+            await sched.shutdown()
+            return st.m_fused_wakeup._max
+
+        assert asyncio.run(run(True)) == 5
+        flags.REGISTRY.reset("sched_cross_tablet_fusion")
+        assert asyncio.run(run(False)) == 1
+
+    def test_replay_parity_with_fusion_flags_flipped(self, tmp_path):
+        """Rows written with the fusion levers ON and OFF in the same
+        log replay identically across a restart (WAL-replay parity —
+        fusion changes batching at the durability boundary, never log
+        content)."""
+        async def run():
+            mc, c, rows = await _cluster(tmp_path, n_rows=1)
+            try:
+                mk = lambda base, tag: [
+                    {"ycsb_key": base + i,
+                     **{f"field{j}": f"{tag}{i}-{j}" for j in range(10)}}
+                    for i in range(16)]
+                await asyncio.gather(
+                    *[c.insert("usertable", [r]) for r in mk(8000, "a")])
+                flags.set_flag("fused_replicate_enabled", False)
+                flags.set_flag("async_flush_enabled", False)
+                await asyncio.gather(
+                    *[c.insert("usertable", [r]) for r in mk(8100, "b")])
+                flags.set_flag("fused_replicate_enabled", True)
+                flags.set_flag("async_flush_enabled", True)
+                await mc.restart_tserver(0)
+                await mc.wait_for_leaders("usertable")
+                for base, tag in ((8000, "a"), (8100, "b")):
+                    for i in range(16):
+                        got = await c.get("usertable",
+                                          {"ycsb_key": base + i})
+                        assert got == {
+                            "ycsb_key": base + i,
+                            **{f"field{j}": f"{tag}{i}-{j}"
+                               for j in range(10)}}, (base, i)
+            finally:
                 await mc.shutdown()
         asyncio.run(run())

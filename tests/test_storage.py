@@ -250,6 +250,106 @@ class TestLsm:
         assert snap.get(kv(3)[0]) == kv(3)[1]
 
 
+class TestAsyncFlushSeams:
+    """The PR-11 frozen-memtable handoff: freeze_active (pointer swap
+    on the apply thread) + flush_frozen (SST write on the flush
+    executor), with flush() as the synchronous drain-everything
+    barrier every existing caller (pinner, DDL, shutdown) relies on."""
+
+    def test_write_during_flush_byte_parity(self, tmp_path):
+        """Writes landing in the fresh active memtable while a frozen
+        one drains must read back byte-identical, before AND after the
+        drain (the write-during-flush seam)."""
+        db = LsmStore(str(tmp_path))
+        db.apply(WriteBatch([kv(i) for i in range(40)], op_id=(1, 5)))
+        assert db.freeze_active() is True
+        # overwrite half the frozen rows + add new ones: newest-wins
+        # must hold across frozen/active, then across sst/active
+        db.apply(WriteBatch(
+            [(kv(i)[0], b"new%d" % i) for i in range(20)]
+            + [kv(i) for i in range(100, 110)], op_id=(1, 6)))
+        expect = {kv(i)[0]: (b"new%d" % i if i < 20 else kv(i)[1])
+                  for i in range(40)}
+        expect.update({kv(i)[0]: kv(i)[1] for i in range(100, 110)})
+        assert dict(db.iterate()) == expect          # pre-drain view
+        assert db.flush_frozen() is not None
+        assert dict(db.iterate()) == expect          # post-drain view
+        assert db.frozen_count() == 0
+        assert db.flushed_frontier()["op_id"] == [1, 5]
+        db.flush()                                    # drain active too
+        assert dict(db.iterate()) == expect
+        assert db.flushed_frontier()["op_id"] == [1, 6]
+
+    def test_frozen_backlog_drains_oldest_first_frontier_monotone(
+            self, tmp_path):
+        db = LsmStore(str(tmp_path))
+        for n in range(3):
+            db.apply(WriteBatch([kv(100 * n + i) for i in range(10)],
+                                op_id=(1, n + 1)))
+            assert db.freeze_active() is True
+        assert db.frozen_count() == 3
+        seen = []
+        while db.frozen_count():
+            assert db.flush_frozen() is not None
+            seen.append(db.flushed_frontier()["op_id"])
+        # oldest-first install: the frontier only ever advances
+        assert seen == [[1, 1], [1, 2], [1, 3]]
+        assert {k for k, _ in db.iterate()} == {
+            kv(100 * n + i)[0] for n in range(3) for i in range(10)}
+        # reopen: all three SSTs manifested, replay starts past (1,3)
+        db2 = LsmStore(str(tmp_path))
+        assert db2.flushed_frontier()["op_id"] == [1, 3]
+        assert db2.get(kv(205)[0]) == kv(205)[1]
+
+    def test_truncate_racing_background_flush_never_resurrects(
+            self, tmp_path):
+        """TRUNCATE while a frozen memtable is mid-write on the flush
+        executor: the install must detect the drop and unlink its SST
+        instead of resurrecting truncated rows."""
+        import threading
+        from yugabyte_db_tpu.utils import fault_injection as fi
+        db = LsmStore(str(tmp_path))
+        db.apply(WriteBatch([kv(i) for i in range(30)], op_id=(1, 1)))
+        assert db.freeze_active() is True
+        fi.stall_disk(0.4)      # hold the flush worker pre-write
+        try:
+            t = threading.Thread(target=db.flush_frozen)
+            t.start()
+            db.truncate(op_id=(1, 2))          # race the stalled write
+            t.join(timeout=10.0)
+            assert not t.is_alive()
+        finally:
+            fi.clear_disk_stall()
+        assert list(db.iterate()) == []
+        assert db.ssts == []
+        # no orphan SST file either (unlinked at install-detect)
+        leftovers = [f for f in os.listdir(str(tmp_path))
+                     if f.endswith(".sst")]
+        assert leftovers == []
+        # and a reopen stays truncated with the truncate frontier
+        db2 = LsmStore(str(tmp_path))
+        assert list(db2.iterate()) == []
+        assert db2.flushed_frontier()["op_id"] == [1, 2]
+
+    def test_pin_refused_while_frozen_then_succeeds_after_drain(
+            self, tmp_path):
+        """The bypass pinner's require_empty_memtable contract covers
+        FROZEN memtables too: a pin while the flush executor still
+        owes a drain returns None (caller retries), and tablet.flush's
+        drain-everything barrier makes the retry succeed."""
+        db = LsmStore(str(tmp_path))
+        db.apply(WriteBatch([kv(i) for i in range(10)], op_id=(1, 1)))
+        db.flush()                       # one durable SST to lease
+        db.apply(WriteBatch([kv(i, b"x") for i in range(10)],
+                            op_id=(1, 2)))
+        assert db.freeze_active() is True
+        assert db.pin_ssts(require_empty_memtable=True) is None
+        assert db.flush_frozen() is not None
+        lease = db.pin_ssts(require_empty_memtable=True)
+        assert lease is not None and len(lease.paths) == 2
+        lease.release()
+
+
 class TestPointEntriesVarlenPk:
     def test_point_reads_with_string_pk_sidecars(self, tmp_path):
         """Variable-length PKs produce sidecars WITHOUT a keys matrix;

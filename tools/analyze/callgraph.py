@@ -18,9 +18,14 @@ imports the code it checks):
    scope: local nested defs, module defs/classes, alias chains
    (bounded), the import table, ``self.x()``/``cls.x()`` through the
    enclosing class's MRO (project-local bases followed cross-module),
-   ``ClassName.x()``, and absolute ``pkg.mod.fn`` forms.  Unresolvable
-   targets return None — propagation under-approximates rather than
-   guesses (a terminal-name fallback is each pass's own choice).
+   ``ClassName.x()``, absolute ``pkg.mod.fn`` forms, and — one hop of
+   attribute typing — ``self.attr.m()`` where the enclosing class
+   assigns ``self.attr = Ctor(...)`` or ``self.attr = param`` with an
+   annotated parameter (the ``TabletPeer.tablet -> Tablet`` shape the
+   write-path hot-path rule needs; conflicting assignments kill the
+   type).  Unresolvable targets return None — propagation
+   under-approximates rather than guesses (a terminal-name fallback is
+   each pass's own choice).
 
 3. **Summaries** — ``summarize()`` computes per-def hazard summaries
    (e.g. "blocking calls reachable from here") as a memoized DFS over
@@ -47,7 +52,7 @@ from .core import ModuleInfo, call_name
 
 #: bump to invalidate every persisted .analyze_cache facts entry when
 #: the extraction schema changes
-FACTS_VERSION = 1
+FACTS_VERSION = 2
 
 #: alias chains (`a = b`, `b = mod.f`) followed at most this deep
 _ALIAS_DEPTH = 6
@@ -128,6 +133,36 @@ def _collect_calls(body) -> List[List]:
     return out
 
 
+def _note_attr_type(centry: dict, attr: str, assign: ast.AST,
+                    ann: Dict[str, str]) -> None:
+    """Record ``self.<attr>``'s class when the assignment shape names
+    one: ``self.x = Ctor(...)`` (the constructor's dotted text) or
+    ``self.x = param`` with an annotated parameter.  Assignments that
+    disagree — or any re-assignment the shapes can't type, past the
+    initial ``self.x = None`` idiom — poison the attr (recorded as
+    None) so resolution under-approximates instead of guessing."""
+    types = centry.setdefault("attr_types", {})
+    v = assign.value if not isinstance(assign, ast.AugAssign) else None
+    t: Optional[str] = None
+    if isinstance(v, ast.Call):
+        t = call_name(v)
+        # lowercase head = a factory function, not a class ctor; typing
+        # through it would need return-type inference — skip
+        if not t or not t.split(".")[-1][:1].isupper():
+            t = None
+    elif isinstance(v, ast.Name):
+        t = ann.get(v.id)
+    elif isinstance(v, ast.Constant) and v.value is None:
+        return          # `self.x = None` (Optional idiom): neutral —
+        #                 the non-None assignment governs the type
+    if attr in types and types[attr] != t:
+        types[attr] = None              # conflicting shapes: poison
+    elif t is not None:
+        types.setdefault(attr, t)
+    else:
+        types[attr] = None              # untypeable re-assignment
+
+
 def extract_facts(mod: ModuleInfo) -> dict:
     """One module's call-graph facts (pure function of the file)."""
     pkg = _package_parts(mod.rel)
@@ -174,6 +209,18 @@ def extract_facts(mod: ModuleInfo) -> dict:
             centry = facts["classes"].get(cls)
             if centry is not None:
                 centry["methods"][node.name] = qual
+                # parameter annotations type `self.x = param` assigns
+                ann: Dict[str, str] = {}
+                for a in (node.args.posonlyargs + node.args.args
+                          + node.args.kwonlyargs):
+                    if a.annotation is None:
+                        continue
+                    t = a.annotation
+                    if isinstance(t, ast.Constant) and \
+                            isinstance(t.value, str):
+                        ann[a.arg] = t.value        # "Tablet" string form
+                    elif isinstance(t, (ast.Name, ast.Attribute)):
+                        ann[a.arg] = ast.unparse(t)
                 for n in ast.walk(node):
                     if isinstance(n, (ast.Assign, ast.AnnAssign,
                                       ast.AugAssign)):
@@ -182,9 +229,10 @@ def extract_facts(mod: ModuleInfo) -> dict:
                         for t in tgts:
                             if isinstance(t, ast.Attribute) \
                                     and isinstance(t.value, ast.Name) \
-                                    and t.value.id == "self" \
-                                    and t.attr not in centry["attrs"]:
-                                centry["attrs"].append(t.attr)
+                                    and t.value.id == "self":
+                                if t.attr not in centry["attrs"]:
+                                    centry["attrs"].append(t.attr)
+                                _note_attr_type(centry, t.attr, n, ann)
 
     def walk(stmts, scope: List[str], cls: Optional[str],
              top: bool) -> None:
@@ -342,13 +390,21 @@ class CallGraph:
         parts = text.split(".")
         head = parts[0]
         if head in ("self", "cls"):
-            if len(parts) != 2 or def_qual is None:
+            if def_qual is None or len(parts) not in (2, 3):
                 return None
             d = f["defs"].get(def_qual)
             cls = d["cls"] if d else self._enclosing_class(rel, def_qual)
             if cls is None:
                 return None
-            return self.resolve_method(rel, cls, parts[1])
+            if len(parts) == 2:
+                return self.resolve_method(rel, cls, parts[1])
+            # self.<attr>.<m>(): one hop through the attr's recorded
+            # type (ctor / annotated-param assignment in this class's
+            # MRO) — the TabletPeer.tablet.apply_write shape
+            hit = self._attr_type(rel, cls, parts[1])
+            if hit is None:
+                return None
+            return self.resolve_method(hit[0], hit[1], parts[2])
         if len(parts) == 1:
             # innermost-out: nested defs of the enclosing def chain
             if def_qual is not None:
@@ -376,6 +432,35 @@ class CallGraph:
         if head in f["classes"] and len(parts) == 2:
             return self.resolve_method(rel, head, parts[1])
         return self._absolute(text)
+
+    def _attr_type(self, rel: str, cls_qual: str, attr: str,
+                   _seen=None) -> Optional[Tuple[str, str]]:
+        """Resolve ``self.<attr>``'s class for (rel, cls_qual): walk
+        the MRO for an ``attr_types`` entry and resolve the recorded
+        type text in its DEFINING module's import context.  Returns
+        ``(rel, cls_qual)`` of the attr's class, or None (unrecorded /
+        poisoned / unresolvable)."""
+        if _seen is None:
+            _seen = set()
+        if (rel, cls_qual) in _seen or len(_seen) > 32:
+            return None
+        _seen.add((rel, cls_qual))
+        c = self.class_fact(rel, cls_qual)
+        if c is None:
+            return None
+        t = c.get("attr_types", {}).get(attr)
+        if t is not None:
+            return self.resolve_class(rel, t)
+        if attr in c.get("attr_types", {}):
+            return None                 # poisoned: conflicting shapes
+        for base in c["bases"]:
+            hit = self.resolve_class(rel, base)
+            if hit is None:
+                continue
+            r = self._attr_type(hit[0], hit[1], attr, _seen)
+            if r is not None:
+                return r
+        return None
 
     def _def_ancestry(self, f: dict, def_qual: str) -> List[str]:
         """def_qual plus every enclosing def qual that exists, in
@@ -555,14 +640,22 @@ class CallGraph:
 
     def summarize(self, key: str, tag: str,
                   direct: Callable[[str], Dict[str, int]],
-                  follow: Callable[[str], bool]) -> Dict[str, tuple]:
+                  follow: Callable[[str], bool],
+                  edge_ok: Optional[Callable[[str, int], bool]] = None,
+                  ) -> Dict[str, tuple]:
         """Per-def hazard summary ``{name: (line, via_key|None)}``.
 
         ``direct(key)`` yields the def's own hazards (name -> line);
-        ``follow(target_key)`` gates which resolved edges propagate.
-        One witness step per hazard; chains come from ``chain()``.
-        Memoized per tag; cycles contribute nothing on the back edge
-        (members still see each other's forward summaries)."""
+        ``follow(target_key)`` gates which resolved edges propagate;
+        ``edge_ok(key, line)`` (optional) drops individual CALL SITES
+        from propagation — the seam that lets a pass honor an
+        ``analysis-ok(<pass>)`` annotation on an intermediate sync call
+        (e.g. a flag-gated legacy path) without silencing the helper
+        for every other caller.  One witness step per hazard; chains
+        come from ``chain()``.  Memoized per tag; cycles contribute
+        nothing on the back edge (members still see each other's
+        forward summaries).  Callers must pass a consistent
+        direct/follow/edge_ok triple per tag."""
         memo = self._memos.setdefault(tag, {})
 
         def go(k: str, stack: set, depth: int) -> Dict[str, tuple]:
@@ -575,6 +668,8 @@ class CallGraph:
             for line, _text, tgt in self.edges(k):
                 if tgt is None or tgt == k or not follow(tgt):
                     continue
+                if edge_ok is not None and not edge_ok(k, line):
+                    continue
                 for n in go(tgt, stack, depth + 1):
                     out.setdefault(n, (line, tgt))
             stack.discard(k)
@@ -586,6 +681,7 @@ class CallGraph:
     def chain(self, key: str, hazard: str, tag: str,
               direct: Callable[[str], Dict[str, int]],
               follow: Callable[[str], bool],
+              edge_ok: Optional[Callable[[str, int], bool]] = None,
               ) -> List[Tuple[str, str, int]]:
         """Witness chain for a summarized hazard:
         ``[(rel, qual, line), ...]`` from ``key`` down to the def
@@ -595,7 +691,7 @@ class CallGraph:
         for _ in range(_SUMMARY_DEPTH + 1):
             if k is None:
                 break
-            s = self.summarize(k, tag, direct, follow)
+            s = self.summarize(k, tag, direct, follow, edge_ok)
             if hazard not in s:
                 break
             line, nxt = s[hazard]

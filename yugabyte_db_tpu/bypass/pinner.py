@@ -109,7 +109,11 @@ def pin_tablet(tablet, read_ht: Optional[int] = None,
             # drained off-lock); yield rather than spin
             time.sleep(0.005 * attempt)
         if not store.memtable_empty():
-            tablet.flush()
+            # best-effort drain (wait=False): a stuck foreign flush
+            # holding the store's flush IO lock must exhaust the
+            # bounded attempts into the typed refusal below, not hang
+            # the pin forever behind a dead disk
+            tablet.flush(wait=False)
         lease = store.pin_ssts(require_empty_memtable=True)
         if lease is not None:
             break

@@ -29,7 +29,7 @@ from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 import logging
 
 from ..rpc.messenger import Messenger, RpcError
-from ..utils import flags
+from ..utils import flags, metrics
 
 log = logging.getLogger("ybtpu.consensus")
 from ..utils.hybrid_time import HybridClock, HybridTime
@@ -166,6 +166,16 @@ class RaftConsensus:
             self.commit_index = self.last_applied = log._first_index - 1
         self._apply_lock = asyncio.Lock()
         self._replicate_lock = asyncio.Lock()
+        # fused-append accumulator (fused_replicate_enabled): replicate
+        # calls arriving while an append round is in flight queue here
+        # and the drainer appends them as ONE log write (one fsync) +
+        # ONE broadcast round — the ReplicateBatch shape (reference:
+        # raft_consensus.cc:1224)
+        self._pending_appends: List[tuple] = []
+        self._append_drainer: Optional[asyncio.Task] = None
+        ent = metrics.REGISTRY.entity("consensus", tablet_id)
+        self._m_fused_appends = ent.counter("fused_appends")
+        self._m_fused_fanin = ent.histogram("fused_append_fanin")
         self._tasks: List[asyncio.Task] = []
         self._running = False
         # registered as a messenger service per tablet
@@ -194,6 +204,12 @@ class RaftConsensus:
             t.cancel()
         for t in list(self._bootstrap_tasks):
             t.cancel()
+        if self._append_drainer is not None:
+            self._append_drainer.cancel()
+        for _, _, _, fut in self._pending_appends:
+            if not fut.done():
+                fut.cancel()
+        self._pending_appends = []
         for _, _, fut in self._commit_waiters:
             if not fut.done():
                 fut.cancel()
@@ -232,6 +248,10 @@ class RaftConsensus:
         self.role = Role.CANDIDATE
         self.meta.current_term += 1
         self.meta.voted_for = self.uuid
+        # tiny cmeta fsync — term+vote MUST be durable before any vote
+        # RPC leaves, and yielding the loop here would let a
+        # concurrent vote interleave the check-then-persist pair
+        # analysis-ok(async_blocking): bounded vote-durability barrier
         self.meta.save()
         term = self.meta.current_term
         self._election_deadline = self._new_election_deadline()
@@ -317,6 +337,9 @@ class RaftConsensus:
         grant = up_to_date and self.meta.voted_for in (None, req["candidate"])
         if grant:
             self.meta.voted_for = req["candidate"]
+            # tiny cmeta fsync — the vote must persist before the
+            # grant is sent, atomically with the voted_for check
+            # analysis-ok(async_blocking): bounded vote-durability
             self.meta.save()
             self._election_deadline = self._new_election_deadline()
         return {"term": self.meta.current_term, "granted": grant}
@@ -325,6 +348,8 @@ class RaftConsensus:
         if term > self.meta.current_term:
             self.meta.current_term = term
             self.meta.voted_for = None
+            # tiny cmeta fsync — the term bump must be durable first
+            # analysis-ok(async_blocking): bounded term-durability
             self.meta.save()
         if self.role == Role.LEADER:
             self._lease_expiry = 0.0
@@ -363,12 +388,23 @@ class RaftConsensus:
     # Replication
     # ------------------------------------------------------------------
     async def _append_local(self, *entries: LogEntry):
+        # the WAL group-commit fsync IS the durability boundary —
+        # index assignment + append + fsync must not interleave with
+        # other appends (fused appends amortize it per batch)
+        # analysis-ok(async_blocking): the durability boundary itself
         self.log.append(list(entries))
 
     async def replicate(self, etype: str, payload: bytes,
                         timeout: float = 30.0, precheck=None) -> int:
         """Leader-only: append + replicate; resolves at commit with the
         entry's index (reference: ReplicateBatch raft_consensus.cc:1224).
+
+        With ``fused_replicate_enabled`` (default) concurrent calls
+        coalesce through the append drainer: every call queued while a
+        round is in flight rides ONE log append (one fsync) and ONE
+        broadcast round — N writes/txn entries stop paying N durability
+        round-trips.  Flag off serializes one append + one round per
+        call (the pre-fusion path, behavior-identical log content).
 
         `precheck` (if given) runs INSIDE the append lock, immediately
         before the log position is taken: the atomic seam for fences
@@ -378,6 +414,13 @@ class RaftConsensus:
         if self.role != Role.LEADER:
             raise RpcError(f"not leader (leader={self.leader_uuid})",
                            "LEADER_NOT_READY")
+        if flags.get("fused_replicate_enabled"):
+            fut = asyncio.get_running_loop().create_future()
+            self._pending_appends.append((etype, payload, precheck, fut))
+            if self._append_drainer is None or self._append_drainer.done():
+                self._append_drainer = asyncio.create_task(
+                    self._drain_appends())
+            return await asyncio.wait_for(fut, timeout)
         async with self._replicate_lock:
             if precheck is not None:
                 precheck()
@@ -392,6 +435,68 @@ class RaftConsensus:
         await self._broadcast()
         await asyncio.wait_for(fut, timeout)
         return idx
+
+    async def _drain_appends(self):
+        """Fused-append drainer: take EVERYTHING queued, append it as
+        one LogEntry batch under one lock acquisition — one WAL write,
+        one fsync — then push one broadcast round for the whole group.
+        Entries queued during that round fuse into the next one, so the
+        append pipeline self-paces to the replication round trip (the
+        dynamic group-commit window, consensus/log.cc TaskStream).
+        Commit waiters resolve per entry through _advance_commit, each
+        with its own index."""
+        while self._pending_appends:
+            group, self._pending_appends = self._pending_appends, []
+            try:
+                await self._append_group(group)
+            except asyncio.CancelledError:
+                # shutdown cancelled us mid-group: the popped group's
+                # futures are in neither _pending_appends nor (all of)
+                # _commit_waiters — cancel them here or their callers
+                # hang out the full replicate timeout
+                for _, _, _, fut in group:
+                    if not fut.done():
+                        fut.cancel()
+                raise
+            except Exception as e:  # noqa: BLE001 — a failed append
+                # (disk error) must fail the GROUP's callers, not hang
+                # them to timeout while the drainer dies silently
+                for _, _, _, fut in group:
+                    if not fut.done():
+                        fut.set_exception(e)
+
+    async def _append_group(self, group: List[tuple]):
+        async with self._replicate_lock:
+            term = self.meta.current_term
+            entries: List[LogEntry] = []
+            if self.role != Role.LEADER:
+                for _, _, _, fut in group:
+                    if not fut.done():
+                        fut.set_exception(RpcError(
+                            f"not leader (leader={self.leader_uuid})",
+                            "LEADER_NOT_READY"))
+                return
+            for etype, payload, precheck, fut in group:
+                if fut.done():
+                    continue            # caller timed out while queued
+                if precheck is not None:
+                    try:
+                        precheck()
+                    except Exception as e:  # noqa: BLE001 — per-
+                        fut.set_exception(e)  # member fence reject
+                        continue
+                idx = self.log.last_index + 1 + len(entries)
+                entries.append(LogEntry(term, idx, etype, payload))
+                self._commit_waiters.append((idx, term, fut))
+            if not entries:
+                return
+            await self._append_local(*entries)
+            self._m_fused_appends.increment()
+            self._m_fused_fanin.increment(len(entries))
+            if not self.config.others(self.uuid):
+                await self._advance_commit(self.log.last_index)
+                return
+        await self._broadcast()
 
     # ------------------------------------------------------------------
     # Membership change (single-server at a time; config applies at
@@ -673,6 +778,9 @@ class RaftConsensus:
                 return {"term": self.meta.current_term, "success": False,
                         "last_index": self.log.last_index,
                         "needs_bootstrap": True}
+            # follower WAL fsync — the entries must be durable before
+            # success is acked, ordered against the conflict check
+            # analysis-ok(async_blocking): the durability boundary
             self.log.append(to_append)
             # any pending waiter at a truncated index lost its entry
             still = []

@@ -293,6 +293,8 @@ class Master:
         a quiesced window."""
         if not flags.get("enable_automatic_tablet_splitting"):
             return None
+        if self._split_throttled():
+            return None
         size_thresh = flags.get("tablet_split_size_threshold_bytes")
         rate_thresh = flags.get("tablet_split_traffic_threshold_ops_s")
         max_tablets = flags.get("tablet_split_max_tablets_per_table")
@@ -319,6 +321,29 @@ class Master:
             return (f"auto-split {tablet_id} -> {r['left']},{r['right']} "
                     f"({'size' if oversized else 'traffic'})")
         return None
+
+    def _split_throttled(self) -> bool:
+        """Drain-aware split throttling (the outstanding_tablet_split_
+        limit behavior): auto-splitting pauses while a blacklist drain
+        still has replicas to move — every split mid-drain hands the
+        rebalancer two fresh children to chase, so the drain never
+        converges (measured in the PR-10 cluster harness) — and while
+        the in-flight split count sits at the limit.  Manual
+        rpc_split_tablet stays available either way."""
+        limit = flags.get("outstanding_tablet_split_limit")
+        if limit <= 0:
+            return False
+        if len(self._splitting) >= limit:
+            return True
+        bl = self.load_balancer.blacklist
+        if not bl:
+            return False
+        for ent in self.tablets.values():
+            if ent.get("hidden"):
+                continue
+            if any(u in bl for u in ent.get("replicas", ())):
+                return True             # drain still in flight
+        return False
 
     # --- balancing / placement RPCs ----------------------------------------
     async def rpc_move_replica(self, payload) -> dict:

@@ -9,6 +9,7 @@ size (recompilation churn — SURVEY.md hard part #7).
 """
 from __future__ import annotations
 
+import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -341,34 +342,49 @@ class DeviceBlockCache:
         self._bytes = 0
         self.hits = 0
         self.misses = 0
+        # invalidations arrive from flush/compaction executor threads
+        # while the event loop serves lookups — the map needs a lock
+        # (the bare-dict iterate-while-pop race the background flush
+        # path would otherwise hit constantly)
+        self._lock = threading.Lock()
 
     def get_or_build(self, key: tuple, builder) -> DeviceBatch:
-        if key in self._map:
-            self.hits += 1
-            self._map.move_to_end(key)
-            return self._map[key][0]
-        self.misses += 1
+        with self._lock:
+            if key in self._map:
+                self.hits += 1
+                self._map.move_to_end(key)
+                return self._map[key][0]
+            self.misses += 1
         batch = builder()
         size = _batch_bytes(batch)
-        self._map[key] = (batch, size)
-        self._bytes += size
-        while self._bytes > self.capacity and len(self._map) > 1:
-            _, (old, osize) = self._map.popitem(last=False)
-            self._bytes -= osize
-            del old
+        with self._lock:
+            if key in self._map:
+                # a racing builder (flush thread vs loop) landed the
+                # same key while we built off-lock: keep the resident
+                # entry — inserting ours would double-count _bytes
+                self._map.move_to_end(key)
+                return self._map[key][0]
+            self._map[key] = (batch, size)
+            self._bytes += size
+            while self._bytes > self.capacity and len(self._map) > 1:
+                _, (old, osize) = self._map.popitem(last=False)
+                self._bytes -= osize
+                del old
         return batch
 
     def invalidate_prefix(self, prefix: tuple) -> None:
         """Drop entries whose key starts with prefix (e.g. an SST was
         compacted away)."""
-        drop = [k for k in self._map if k[:len(prefix)] == prefix]
-        for k in drop:
-            _, size = self._map.pop(k)
-            self._bytes -= size
+        with self._lock:
+            drop = [k for k in self._map if k[:len(prefix)] == prefix]
+            for k in drop:
+                _, size = self._map.pop(k)
+                self._bytes -= size
 
     def clear(self):
-        self._map.clear()
-        self._bytes = 0
+        with self._lock:
+            self._map.clear()
+            self._bytes = 0
 
 
 def _batch_bytes(b: DeviceBatch) -> int:

@@ -19,6 +19,7 @@ three batch shapes and their correctness arguments:
 """
 from __future__ import annotations
 
+from time import perf_counter as _perf_counter
 from typing import List
 
 
@@ -56,8 +57,16 @@ class ScanItem:
 
 async def dispatch_write_group(items: List[tuple], fanin_hist) -> None:
     """GROUP COMMIT: merge the group's ops into one WriteRequest → one
-    Raft item (one WAL append) + one tablet apply."""
+    Raft item + one tablet apply.  Ops keep arrival order, so write_id
+    order within the merged batch IS the members' serial order.  The
+    merged request rides the peer's write queue, where same-sweep
+    requests pack into ONE LogEntry batch, and — with
+    ``fused_replicate_enabled`` — concurrent entries (other tables,
+    txn ops) further fuse into one WAL append + one replicate round
+    (the ReplicateBatch shape)."""
     from ..docdb.operations import WriteRequest
+    from ..tablet.tablet_peer import WRITE_PATH_STATS
+    t0 = _perf_counter()
     first = items[0][0]
     ops = []
     for wb, _, _, _ in items:
@@ -65,6 +74,7 @@ async def dispatch_write_group(items: List[tuple], fanin_hist) -> None:
     merged = WriteRequest(first.req.table_id, ops,
                           schema_version=first.req.schema_version)
     fanin_hist.increment(len(items))
+    WRITE_PATH_STATS["group_merge_s"] += _perf_counter() - t0
     await first.peer.write(merged)
     for wb, fut, _, _ in items:
         if not fut.done():

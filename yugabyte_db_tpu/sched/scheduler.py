@@ -121,6 +121,9 @@ class _LaneState:
         self.m_batch = ent.histogram("sched_batch_size")
         self.m_occupancy = ent.histogram("sched_window_occupancy_pct")
         self.m_fanin = ent.histogram("sched_group_commit_fanin")
+        # groups dispatched per fused worker wakeup (>1 = cross-tablet
+        # fusion actually collapsed loop sweeps)
+        self.m_fused_wakeup = ent.histogram("sched_fused_groups_per_wakeup")
 
     @property
     def depth(self) -> int:
@@ -376,40 +379,76 @@ class RequestScheduler:
                         fut.set_exception(RpcError(
                             "scheduler shut down", "SHUTDOWN_IN_PROGRESS"))
                 raise
-            g.started = True
-            if st.groups.get(g.key) is g:
-                del st.groups[g.key]
-            items = g.items
-            n = len(items)
-            st.queued -= n
-            st.queued_bytes -= sum(it[2] for it in items)
-            st.inflight += n
-            now = time.monotonic()
-            for _, _, _, t_in in items:
-                st.m_wait.increment((now - t_in) * 1e6)
-            st.m_batch.increment(n)
-            st.m_occupancy.increment(100.0 * n / max(1, st.cfg.max_batch))
-            # armed lane stall (fault injection): hold the dispatch —
-            # admission keeps running, so tests can fill the queue and
-            # observe typed sheds + foreground/background isolation
-            try:
-                await fi.lane_stall_wait(st.lane.value)
-                t0 = time.monotonic()
-                await self._dispatch_group(st, items)
-                st.service_ms.update((time.monotonic() - t0) * 1e3)
-            except asyncio.CancelledError:
-                for _, fut, _, _ in items:
-                    if not fut.done():
-                        fut.set_exception(RpcError(
-                            "scheduler shut down", "SHUTDOWN_IN_PROGRESS"))
-                raise
-            except Exception as e:  # noqa: BLE001 — fan the error out
-                for _, fut, _, _ in items:
-                    if not fut.done():
-                        fut.set_exception(e)
-            finally:
-                st.inflight -= n
-                st.m_depth.set(st.depth)
+            batch = [self._take_group(st, g)]
+            # cross-tablet batch fusion: every group already READY in
+            # the queue rides THIS wakeup (bounded) and dispatches
+            # concurrently below — N same-table groups on different
+            # tablets cost one loop sweep + one accounting pass
+            # instead of N worker wakeups, and a coalesced device
+            # scan's kernel execution overlaps the next group's batch
+            # formation (the StreamPipeline stages release the GIL)
+            # batched lanes only (max_batch > 1): an admission-
+            # serialized lane like MAINTENANCE runs workers=1 exactly
+            # so compactions/index builds never overlap — fusing its
+            # queue would gather N of them concurrently and break the
+            # isolation the lane exists for
+            if flags.get("sched_cross_tablet_fusion") \
+                    and st.cfg.max_batch > 1:
+                cap = int(flags.get("sched_fusion_max_groups"))
+                while len(batch) <= cap:
+                    try:
+                        g2 = st.queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                    batch.append(self._take_group(st, g2))
+            st.m_fused_wakeup.increment(len(batch))
+            if len(batch) == 1:
+                await self._run_group(st, batch[0])
+            else:
+                await asyncio.gather(
+                    *[self._run_group(st, items) for items in batch])
+
+    def _take_group(self, st: _LaneState, g: _Group) -> List[tuple]:
+        """Synchronous dequeue bookkeeping for one group (no awaits —
+        admission must never observe a group as both queued and
+        inflight, or neither)."""
+        g.started = True
+        if st.groups.get(g.key) is g:
+            del st.groups[g.key]
+        items = g.items
+        n = len(items)
+        st.queued -= n
+        st.queued_bytes -= sum(it[2] for it in items)
+        st.inflight += n
+        now = time.monotonic()
+        for _, _, _, t_in in items:
+            st.m_wait.increment((now - t_in) * 1e6)
+        st.m_batch.increment(n)
+        st.m_occupancy.increment(100.0 * n / max(1, st.cfg.max_batch))
+        return items
+
+    async def _run_group(self, st: _LaneState, items: List[tuple]):
+        # armed lane stall (fault injection): hold the dispatch —
+        # admission keeps running, so tests can fill the queue and
+        # observe typed sheds + foreground/background isolation
+        try:
+            await fi.lane_stall_wait(st.lane.value)
+            t0 = time.monotonic()
+            await self._dispatch_group(st, items)
+            st.service_ms.update((time.monotonic() - t0) * 1e3)
+        except asyncio.CancelledError:
+            for _, fut, _, _ in items:
+                if not fut.done():
+                    fut.set_exception(RpcError(
+                        "scheduler shut down", "SHUTDOWN_IN_PROGRESS"))
+            raise
+        except Exception as e:  # noqa: BLE001 — fan the error out
+            for _, fut, _, _ in items:
+                if not fut.done():
+                    fut.set_exception(e)
+        finally:
+            st.inflight -= len(items)
+            st.m_depth.set(st.depth)
 
     async def _dispatch_group(self, st: _LaneState, items: List[tuple]):
         first = items[0][0]
@@ -487,5 +526,9 @@ class RequestScheduler:
                     "count": st.m_fanin.count(),
                     "mean": round(st.m_fanin.mean(), 2),
                     "max": st.m_fanin._max},
+                "fused_groups_per_wakeup": {
+                    "count": st.m_fused_wakeup.count(),
+                    "mean": round(st.m_fused_wakeup.mean(), 2),
+                    "max": st.m_fused_wakeup._max},
             }
         return out

@@ -126,8 +126,15 @@ class LsmStore:
         self.key_builder = key_builder
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.RLock()
+        # serializes the file-writing half of flushes (background
+        # executor vs an inline drain): frozen memtables must hit disk
+        # oldest-first or the newest-first SST order and the flushed
+        # frontier would both break
+        self._flush_io_lock = threading.Lock()
         self._mem = MemTable()
         self._frozen: List[MemTable] = []
+        # id(frozen memtable) -> the _mem_frontier captured at freeze
+        self._frozen_frontiers: Dict[int, dict] = {}
         self._ssts: List[SstReader] = []       # newest first
         self._next_file = 0
         self._flushed_frontier: dict = {}
@@ -294,48 +301,101 @@ class LsmStore:
         return (self._mem.approximate_bytes()
                 >= flags.get("memstore_flush_threshold_bytes"))
 
-    def flush(self) -> Optional[str]:
-        """Freeze the memtable and write it as an SST. Returns new SST path
-        (None if nothing to flush)."""
+    def freeze_active(self) -> bool:
+        """Freeze the active memtable into the frozen queue — a pure
+        in-memory pointer swap (the fast half of a flush; the async
+        flush path hands the slow half to a background executor).
+        Returns True when a new frozen memtable was produced."""
         with self._lock:
             if self._mem.empty():
-                return None
+                return False
             mem = self._mem
             mem.freeze()
-            frontier = dict(self._mem_frontier)
             self._frozen.append(mem)
+            self._frozen_frontiers[id(mem)] = dict(self._mem_frontier)
             self._mem = MemTable()
             self._struct_gen += 1
             self._mem_frontier = {}
-        path = self._new_sst_path()
-        # chaos seam: an armed disk stall holds THIS thread (the flush
-        # caller), exactly like a hung device under the SST write
-        TEST_DISK_STALL()
-        w = SstWriter(path, columnar_builder=self.columnar_builder,
-                      key_builder=self.key_builder)
-        for k, v in mem.iterate():
-            w.add(k, v)
-        w.set_frontier(**frontier)
-        w.finish()
-        TEST_CRASH_POINT("flush:before_manifest")
+        return True
+
+    def frozen_count(self) -> int:
         with self._lock:
-            if mem not in self._frozen:
-                # a TRUNCATE dropped the frozen memtable while this
-                # flush wrote it out — installing the SST would
-                # resurrect truncated rows
-                try:
-                    os.unlink(path)
-                except OSError:
-                    pass
-                return None
-            self._ssts.insert(0, SstReader(path, row_decoder=self.row_decoder,
-                                           key_builder=self.key_builder))
-            self._frozen.remove(mem)
-            self._struct_gen += 1
-            if "op_id" in frontier:
-                self._flushed_frontier["op_id"] = frontier["op_id"]
-            self._write_manifest()
-        return path
+            return len(self._frozen)
+
+    def flush_frozen(self, wait: bool = True) -> Optional[str]:
+        """Write the OLDEST frozen memtable to an SST and install it
+        (the slow half of a flush — file write, fsync, manifest).
+        Serialized under the flush IO lock so a background flush and an
+        inline drain can never install out of order; the flushed
+        frontier and newest-first SST order therefore stay monotone.
+        ``wait=False`` gives up immediately when another flush owns the
+        IO lock (the pinner's bounded-attempt contract: a stuck foreign
+        flush must surface as a typed refusal, never a hang).
+        Returns the new SST path, or None when there was nothing to do,
+        the lock was busy (wait=False), or a TRUNCATE raced the write."""
+        if not self._flush_io_lock.acquire(blocking=wait):
+            return None
+        try:
+            with self._lock:
+                if not self._frozen:
+                    return None
+                mem = self._frozen[0]
+                frontier = dict(self._frozen_frontiers.get(id(mem), {}))
+            path = self._new_sst_path()
+            # chaos seam: an armed disk stall holds THIS thread (the
+            # flush worker), exactly like a hung device under the SST
+            # write
+            TEST_DISK_STALL()
+            w = SstWriter(path, columnar_builder=self.columnar_builder,
+                          key_builder=self.key_builder)
+            for k, v in mem.iterate():
+                w.add(k, v)
+            w.set_frontier(**frontier)
+            w.finish()
+            TEST_CRASH_POINT("flush:before_manifest")
+            with self._lock:
+                if mem not in self._frozen:
+                    # a TRUNCATE dropped the frozen memtable while this
+                    # flush wrote it out — installing the SST would
+                    # resurrect truncated rows
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    return None
+                self._ssts.insert(
+                    0, SstReader(path, row_decoder=self.row_decoder,
+                                 key_builder=self.key_builder))
+                self._frozen.remove(mem)
+                self._frozen_frontiers.pop(id(mem), None)
+                self._struct_gen += 1
+                if "op_id" in frontier:
+                    cur = self._flushed_frontier.get("op_id")
+                    if cur is None or frontier["op_id"] > cur:
+                        self._flushed_frontier["op_id"] = frontier["op_id"]
+                self._write_manifest()
+            return path
+        finally:
+            self._flush_io_lock.release()
+
+    def flush(self, wait: bool = True) -> Optional[str]:
+        """Freeze the memtable and drain EVERY frozen memtable to SSTs
+        synchronously (helping any in-flight background flush along —
+        the IO lock serializes installs).  ``wait=False`` is the
+        pinner's best-effort drain: it never blocks behind a foreign
+        flush that owns the IO lock.  Returns the last SST path
+        written (None if nothing flushed)."""
+        self.freeze_active()
+        last = None
+        while True:
+            with self._lock:
+                if not self._frozen:
+                    return last
+            p = self.flush_frozen(wait=wait)
+            if p is not None:
+                last = p
+            elif not wait:
+                return last     # foreign flush owns the IO lock
 
     def truncate(self, op_id=None) -> int:
         """Drop EVERYTHING: memtables, frozen memtables, and SST files
@@ -351,6 +411,7 @@ class LsmStore:
             removed = list(self._ssts)
             self._mem = MemTable()
             self._frozen = []
+            self._frozen_frontiers = {}
             self._ssts = []
             self._mem_frontier = {}
             self._struct_gen += 1

@@ -10,12 +10,16 @@ directly.
 """
 from __future__ import annotations
 
+import logging
 import os
 import threading
+from concurrent.futures import ThreadPoolExecutor
 from time import perf_counter as _perf_counter
 from typing import Dict, Optional
 
 import numpy as np
+
+log = logging.getLogger("ybtpu.tablet")
 
 from ..docdb.compaction import (
     DocDbCompactionFeed, RepackingCompactionFeed, tpu_compact,
@@ -33,9 +37,24 @@ from ..utils.hybrid_time import HybridClock, HybridTime
 # process-wide device block cache shared by all tablets (HBM is global)
 _DEVICE_CACHE = DeviceBlockCache()
 
+# bounded background flush executor shared by all tablets: the async
+# flush path (async_flush_enabled) freezes the memtable on the apply
+# thread and runs the SST write + fsync here (reference: the RocksDB
+# high-priority flush thread pool).  Two workers: one flush streaming
+# to a stalled disk must not park every other tablet's flush behind it.
+_FLUSH_POOL = ThreadPoolExecutor(max_workers=2,
+                                 thread_name_prefix="bg-flush")
+
 #: stage split of the most recent bulk_load (read by profile_ycsb.py
 #: --json; informational only)
 LAST_BULK_LOAD_STATS: dict = {}
+
+#: process-wide flush-on-apply accounting: what the apply thread paid
+#: (``handoff_s`` = freeze + submit, ``inline_s`` = backpressure or
+#: flag-off inline drains) vs what moved to the flush executor
+#: (``background_flushes``).  Read by profile_ycsb.py --json.
+FLUSH_APPLY_STATS = {"handoff_s": 0.0, "inline_s": 0.0, "handoffs": 0,
+                     "inline_flushes": 0, "background_flushes": 0}
 
 
 class _VectorIndexState:
@@ -103,6 +122,11 @@ class Tablet:
         self._m_rows_written = ent.counter("rows_inserted")
         self._m_reads = ent.counter("read_ops")
         self._m_read_lat = ent.histogram("read_latency_us")
+        # what the APPLY THREAD paid for flush work per trigger — the
+        # histogram whose collapse (inline SST write -> pointer swap)
+        # the cluster bench's p99-round-spread gate rides on
+        self._m_flush_pause = ent.histogram("flush_pause_ms")
+        self._m_stalls_avoided = ent.counter("flush_stalls_avoided")
 
     # --- colocation ---------------------------------------------------------
     def add_table(self, info: TableInfo) -> None:
@@ -168,8 +192,65 @@ class Tablet:
         self._maintain_vector_indexes(req)
         self._m_rows_written.increment(n)
         if self.regular.should_flush():
-            self.flush()
+            self._flush_on_apply()
         return WriteResponse(rows_affected=n)
+
+    def _flush_on_apply(self) -> None:
+        """Flush trigger on the apply path.  Async (default): freeze
+        the active memtable — an in-memory pointer swap — and hand the
+        SST write + fsync to the background flush executor, so the
+        apply thread (the Raft apply loop) never waits on disk.
+        Backpressure: past ``max_frozen_memtables`` frozen memtables
+        the apply thread drains one inline instead, bounding memory and
+        the WAL-replay window.  Flag off reverts to the legacy inline
+        flush.  ``flush_pause_ms`` records what the apply thread paid
+        either way — the stall this histogram measured (~20x p99 round
+        swings in ``cluster_overload``) is what async flush removes."""
+        t0 = _perf_counter()
+        try:
+            if not flags.get("async_flush_enabled"):
+                # flag-gated legacy revert — async_flush_enabled=1
+                # (the default) hands the SST write to the executor
+                # analysis-ok(async_blocking): deliberate inline flush
+                self.flush()
+                FLUSH_APPLY_STATS["inline_flushes"] += 1
+                FLUSH_APPLY_STATS["inline_s"] += _perf_counter() - t0
+                return
+            if self.regular.freeze_active():
+                self._m_stalls_avoided.increment()
+                FLUSH_APPLY_STATS["handoffs"] += 1
+                _FLUSH_POOL.submit(self._background_flush)
+            while (self.regular.frozen_count()
+                   > flags.get("max_frozen_memtables")):
+                # the executor fell behind; the apply thread helps
+                # drain one frozen memtable, bounding frozen memory
+                ti = _perf_counter()
+                # analysis-ok(async_blocking): deliberate backpressure
+                if self.regular.flush_frozen() is not None:
+                    _DEVICE_CACHE.invalidate_prefix((id(self.regular),))
+                FLUSH_APPLY_STATS["inline_flushes"] += 1
+                FLUSH_APPLY_STATS["inline_s"] += _perf_counter() - ti
+            FLUSH_APPLY_STATS["handoff_s"] += _perf_counter() - t0
+        finally:
+            self._m_flush_pause.increment((_perf_counter() - t0) * 1e3)
+
+    def _background_flush(self) -> None:
+        """Flush-executor job: drain frozen memtables (oldest first,
+        serialized by the store's flush IO lock) until the queue is
+        empty, invalidating the device cache per install.  NON-blocking
+        on the IO lock: if another flush owns it, bail — that owner's
+        own drain loop covers everything queued, and a worker parked on
+        one store's stalled disk would starve every other tablet's
+        flushes (the pool is 2 workers wide).  A failed flush leaves
+        the frozen memtable queued — the next trigger, an inline drain,
+        or the shutdown flush retries it."""
+        try:
+            while self.regular.flush_frozen(wait=False) is not None:
+                _DEVICE_CACHE.invalidate_prefix((id(self.regular),))
+                FLUSH_APPLY_STATS["background_flushes"] += 1
+        except Exception:   # noqa: BLE001 — must not kill the pool
+            log.exception("%s: background flush failed (frozen "
+                          "memtable retained for retry)", self.tablet_id)
 
     # --- reads ------------------------------------------------------------
     def read(self, req: ReadRequest) -> ReadResponse:
@@ -266,8 +347,8 @@ class Tablet:
             self.regular.apply(batch)
         return len(seen)
 
-    def flush(self) -> Optional[str]:
-        path = self.regular.flush()
+    def flush(self, wait: bool = True) -> Optional[str]:
+        path = self.regular.flush(wait=wait)
         if path:
             _DEVICE_CACHE.invalidate_prefix((id(self.regular),))
         return path

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import os
+from time import perf_counter as _perf_counter
 from typing import Optional
 
 import msgpack
@@ -23,6 +24,20 @@ from ..docdb.wire import write_request_from_wire, write_request_to_wire
 from ..rpc.messenger import Messenger, RpcError
 from ..utils.hybrid_time import HybridClock, HybridTime
 from .tablet import Tablet
+
+#: process-wide write-path stage accounting (read by profile_ycsb.py
+#: --json next to the scheduler's admission-wait histograms, and by
+#: tests asserting the fused-append shape; informational only).
+#: ``replicate_s`` covers append+fsync+commit wait, ``apply_s`` the
+#: state-machine apply, ``entries``/``batches`` the group-commit fanin
+#: (batches == WAL entries of type 'write'; entries == member writes).
+WRITE_PATH_STATS = {"replicate_s": 0.0, "apply_s": 0.0,
+                    "group_merge_s": 0.0, "entries": 0, "batches": 0}
+
+
+def reset_write_path_stats() -> None:
+    WRITE_PATH_STATS.update(replicate_s=0.0, apply_s=0.0,
+                            group_merge_s=0.0, entries=0, batches=0)
 
 
 class TabletPeer:
@@ -124,6 +139,11 @@ class TabletPeer:
         loop = asyncio.get_running_loop()
         await loop.run_in_executor(None, self.tablet.flush)
         await loop.run_in_executor(None, self.tablet.intents.flush)
+        # deliberate on-loop consistent cut: both stores were just
+        # flushed off-loop, so this flush is near-empty, and yielding
+        # between the regular and intents checkpoints would let a txn
+        # apply interleave the cut
+        # analysis-ok(async_blocking): bounded near-empty barrier
         frontier = self.tablet.create_snapshot(d)
         try:
             await self.consensus.messenger.call(
@@ -302,6 +322,7 @@ class TabletPeer:
                 continue
             payload = msgpack.packb({
                 "batch": [p for p, _ in batch]})
+            t0 = _perf_counter()
             try:
                 await self.consensus.replicate(
                     "write", payload, precheck=self.split_fence_check)
@@ -311,6 +332,9 @@ class TabletPeer:
                         fut.set_exception(e)
                 self._notify_progress()
                 continue
+            WRITE_PATH_STATS["replicate_s"] += _perf_counter() - t0
+            WRITE_PATH_STATS["batches"] += 1
+            WRITE_PATH_STATS["entries"] += len(batch)
             for _, fut in batch:
                 if not fut.done():
                     fut.set_result(None)
@@ -324,8 +348,12 @@ class TabletPeer:
             d = msgpack.unpackb(entry.payload, raw=False)
             # flush first: every pre-alter write must sit at-or-below
             # the flushed frontier so a restart never replays it under
-            # the post-alter codec
-            self.tablet.flush()
+            # the post-alter codec.  Off-loop: a large memtable's SST
+            # write on the apply loop would stall heartbeats; apply
+            # order is preserved because _apply_committed awaits each
+            # entry before the next (the DDL barrier holds)
+            await asyncio.get_running_loop().run_in_executor(
+                None, self.tablet.flush)
             self.tablet.alter_table(TableInfo.from_wire(d["table"]))
             if self.on_alter is not None:
                 self.on_alter(d["table"])
@@ -354,6 +382,10 @@ class TabletPeer:
             d = msgpack.unpackb(entry.payload, raw=False)
             if d.get("ht"):
                 self.clock.update(HybridTime(d["ht"]))
+            # TRUNCATE is a rare DDL barrier applied in log order —
+            # the manifest rewrite is tiny, file unlinks defer
+            # through the lease GC
+            # analysis-ok(async_blocking): bounded DDL barrier
             self.tablet.truncate_table(d["table_id"],
                                        op_id=(entry.term, entry.index),
                                        ht=d.get("ht"))
@@ -381,10 +413,12 @@ class TabletPeer:
             return
         d = msgpack.unpackb(entry.payload, raw=False)
         items = d["batch"] if "batch" in d else [d]
+        t0 = _perf_counter()
         for item in items:
             req = write_request_from_wire(item["req"])
             self.tablet.apply_write(req, ht=HybridTime(item["ht"]),
                                     op_id=(entry.term, entry.index))
+        WRITE_PATH_STATS["apply_s"] += _perf_counter() - t0
 
     # --- read path --------------------------------------------------------
     async def read(self, req: ReadRequest) -> ReadResponse:

@@ -1358,7 +1358,11 @@ class TabletServer:
                       "size": p.tablet.approximate_size(),
                       "ssts": p.tablet.num_sst_files(),
                       "wal_index": p.consensus.last_applied,
-                      "pins": p.tablet.regular.pin_stats()}
+                      "pins": p.tablet.regular.pin_stats(),
+                      # async-flush visibility: frozen memtables still
+                      # awaiting the background flush executor
+                      "frozen_memtables":
+                          p.tablet.regular.frozen_count()}
                 for tid, p in self.peers.items()},
         }
 

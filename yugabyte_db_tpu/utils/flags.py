@@ -242,6 +242,27 @@ DEFINE_RUNTIME("log_gc_max_peer_lag_entries", 100_000,
                "install (reference: log retention caps + remote bootstrap).")
 DEFINE_RUNTIME("memstore_flush_threshold_bytes", 64 * 1024 * 1024,
                "Memtable size that triggers a flush.")
+DEFINE_RUNTIME("async_flush_enabled", True,
+               "Memtable flushes run on a background flush executor: "
+               "the apply thread freezes the active memtable (an "
+               "in-memory pointer swap) and returns immediately, so a "
+               "Raft apply never stalls behind an SST write + fsync. "
+               "Off reverts to the inline flush on the apply path "
+               "(byte-identical on-disk state either way).")
+DEFINE_RUNTIME("max_frozen_memtables", 2,
+               "Backpressure bound for async flush: once this many "
+               "frozen memtables await the background flush executor, "
+               "the apply thread drains one inline instead of freezing "
+               "another (reference: max_write_buffer_number — bounded "
+               "memory, bounded WAL-replay window).")
+DEFINE_RUNTIME("fused_replicate_enabled", True,
+               "Group-fused consensus appends (the ReplicateBatch "
+               "shape, raft_consensus.cc:1224): replicate() calls that "
+               "arrive while an append round is in flight coalesce "
+               "into ONE WAL append (one fsync) and ONE broadcast "
+               "round. Off reverts to one append + one round per "
+               "call; log CONTENT is identical either way — fusion "
+               "changes batching at the durability boundary only.")
 DEFINE_RUNTIME("max_clock_skew_ms", 500,
                "Clock uncertainty window: strong reads restart when they "
                "encounter records within (read_ht, read_ht + skew].")
@@ -356,6 +377,27 @@ DEFINE_RUNTIME("tablet_split_max_tablets_per_table", 16,
                "Auto-splitting stops growing a table past this many "
                "tablets (outstanding_tablet_split_limit analog — "
                "bounds split storms under hot-key load).")
+DEFINE_RUNTIME("outstanding_tablet_split_limit", 1,
+               "At most this many auto-splits in flight at once, and "
+               "NONE while a blacklist drain is rebalancing replicas "
+               "(the load balancer would otherwise chase freshly "
+               "split children forever — measured in the PR-10 "
+               "cluster harness). 0 removes the bound.")
+DEFINE_RUNTIME("sched_cross_tablet_fusion", True,
+               "One scheduler-worker wakeup dispatches up to "
+               "sched_fusion_max_groups ready groups from its lane's "
+               "queue (concurrently), not just the group that woke "
+               "it: same-signature work on DIFFERENT tablets shares "
+               "one loop sweep and one admission pass, and coalesced "
+               "device scans overlap one group's batch formation with "
+               "another's kernel execution. Off dispatches one group "
+               "per wakeup.")
+DEFINE_RUNTIME("sched_fusion_max_groups", 8,
+               "Cap on extra groups one fused worker wakeup may drain "
+               "from its lane queue.  NB: a fused wakeup dispatches "
+               "its groups concurrently, so a lane's worst-case "
+               "in-flight dispatch count is workers x (this cap + 1), "
+               "not workers.")
 
 # TEST_ flags (reference: DEFINE_test_flag, util/flags/flag_tags.h:311)
 DEFINE_RUNTIME("TEST_fault_crash_fraction", 0.0,
