@@ -699,11 +699,20 @@ def cluster_overload_bench():
             byp_rate = min(sat2, 3000.0)
             out["post_chaos_saturation_ops_per_s"] = round(sat2, 1)
             scan_every_s = 0.25
-            # warm both scan paths (first bypass round pays the local
-            # follower flush + kernel compile; the RPC round its own
-            # compile) so no timed round carries a compile
+            # PINNED compile-warm rounds before anything measured
+            # (ROADMAP write-path item (d)): the first bypass scan pays
+            # the local follower flush + kernel compile, the first RPC
+            # read its own scan-kernel compile, and the first write
+            # phase the leaders' apply-path warmup.  A single kernel
+            # compile landing inside one 3s measured round swung that
+            # round's p99 several-fold on this box and tripped the
+            # cluster_p99_spread <= 3x WARN; with all three warmed, the
+            # spread gate measures the engine, not XLA.
             await sup.call(victim, "tserver", "bypass_scan", byp_req,
                            timeout=60.0)
+            await sup.call(leader_name, "tserver", "read", rpc_req,
+                           timeout=60.0)
+            await phase("compile_warm", rate_=byp_rate, seconds=1.0)
 
             async def scan_loop(stop_at, call, stats):
                 while time.monotonic() < stop_at:
@@ -1391,6 +1400,174 @@ def q1_grouped_bench(data, repeats):
         flags.REGISTRY.reset("streaming_chunk_rows")
 
 
+def tpch_join_bench(data, repeats):
+    """Device hash join + fused plans (ROADMAP operator-ladder rung
+    (c)): a TPC-H Q3/Q5-shaped join+group query — lineitem JOIN orders
+    ON l_orderkey = o_orderkey, grouped by the o_orderpriority string
+    payload — measured three ways on the SAME table:
+
+      fused        ONE device program per plan signature
+                   (filter -> probe -> gather -> group -> aggregate,
+                   ops/plan_fusion.py, streamed pow2 chunks)
+      per-operator each operator its own program + host round-trip:
+                   device filter-pushdown ROW scan materializes the
+                   matching probe rows, then a host hash join + numpy
+                   group-aggregate (the operator-at-a-time path the
+                   fused plan replaces)
+      interpreted  join_pushdown_enabled=False — the row-at-a-time
+                   CPU join, byte-for-byte the pre-device semantics
+
+    Correctness asserts against direct numpy; the plan-kernel compile
+    count is ASSERTED flat across repeated runs AND across a 2x data
+    growth at the same plan shape (the pow2-bucket contract).  Row cap
+    BENCH_JOIN_ROWS (default 4 chunks of 32768) keeps the interpreted
+    leg bounded."""
+    from yugabyte_db_tpu.docdb.operations import ReadRequest
+    from yugabyte_db_tpu.models.tpch import (PRIO_STRINGS,
+                                             generate_orders,
+                                             lineitem_join_data,
+                                             lineitem_join_info,
+                                             numpy_reference_join,
+                                             orders_build_wire,
+                                             tpch_q3ish)
+    from yugabyte_db_tpu.ops.join_scan import (LAST_JOIN_STATS,
+                                               hash_join_cpu)
+    from yugabyte_db_tpu.ops.plan_fusion import (LAST_PLAN_STATS,
+                                                 default_plan_kernel)
+    from yugabyte_db_tpu.tablet import Tablet
+    from yugabyte_db_tpu.utils import flags
+
+    n_j = min(len(data["rowid"]),
+              int(os.environ.get("BENCH_JOIN_ROWS", str(4 * 32768))))
+    n_orders = max(n_j // 4, 1)
+    odata = generate_orders(n_orders)
+    ldata = lineitem_join_data({k: v[:n_j] for k, v in data.items()},
+                               n_orders)
+    q = tpch_q3ish()
+    wire = orders_build_wire(q, odata)
+    t = Tablet("lineitem-j", lineitem_join_info(),
+               tempfile.mkdtemp(prefix="ybtpu-join-"))
+    t.bulk_load(ldata, block_rows=32768)
+    flags.set_flag("streaming_chunk_rows", 32768)
+    kern = default_plan_kernel()
+
+    def req():
+        return ReadRequest("lineitem_j", where=q.probe_where,
+                           aggregates=q.aggs, group_by=q.group,
+                           join=wire)
+
+    def by_key(resp):
+        counts = np.asarray(resp.group_counts)
+        return {str(resp.group_values[0][g]):
+                (int(counts[g]), float(np.asarray(resp.agg_values[0])[g]))
+                for g in np.nonzero(counts)[0]}
+
+    try:
+        fused_warm = t.read(req())          # compile + warm
+        assert fused_warm.backend == "tpu", "fused join fell back"
+        assert LAST_PLAN_STATS.get("path") == "streaming", \
+            LAST_PLAN_STATS
+        compiles_warm = kern.compiles
+        ref = numpy_reference_join(q, ldata, odata)
+        fk = by_key(fused_warm)
+        for p in PRIO_STRINGS:
+            want_c, want_rev = ref[p]
+            if want_c == 0:
+                assert p not in fk
+                continue
+            assert fk[p][0] == want_c, (p, fk[p], ref[p])
+            assert abs(fk[p][1] - want_rev) / max(want_rev, 1e-9) \
+                < 1e-5, (p, fk[p], ref[p])
+
+        # --- per-operator: device row filter, host join+group ---------
+        probe_cols = ("l_extendedprice", "l_discount", "l_orderkey")
+
+        def per_operator():
+            rows = t.read(ReadRequest(
+                "lineitem_j", columns=probe_cols,
+                where=q.probe_where)).rows
+            ok = np.asarray([r["l_orderkey"] for r in rows], np.int64)
+            price = np.asarray([r["l_extendedprice"] for r in rows])
+            disc = np.asarray([r["l_discount"] for r in rows])
+            midx = hash_join_cpu(ok, np.asarray(wire.keys))
+            m = midx >= 0
+            prio = np.asarray(wire.payload[list(wire.payload)[0]][0],
+                              object)[np.clip(midx, 0, None)]
+            rev = price * (1.0 - disc)
+            return {p: (int((m & (prio == p)).sum()),
+                        float(rev[m & (prio == p)].sum()))
+                    for p in PRIO_STRINGS}
+        op_warm = per_operator()
+        for p in PRIO_STRINGS:
+            assert op_warm[p][0] == ref[p][0], (p, op_warm[p], ref[p])
+
+        # paired rounds: fused / per-operator / interpreted
+        # back-to-back so box contention cancels in the ratios
+        rounds = max(2, repeats // 2)
+        trip = []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            t.read(req())
+            f_t = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            per_operator()
+            o_t = time.perf_counter() - t0
+            flags.set_flag("join_pushdown_enabled", False)
+            try:
+                t0 = time.perf_counter()
+                iresp = t.read(req())
+                i_t = time.perf_counter() - t0
+            finally:
+                flags.REGISTRY.reset("join_pushdown_enabled")
+            assert iresp.backend == "cpu"
+            trip.append((f_t, o_t, i_t))
+        f_t = min(x for x, _, _ in trip)
+        o_t = min(x for _, x, _ in trip)
+        i_t = min(x for _, _, x in trip)
+        ik = by_key(iresp)
+        assert set(ik) == set(fk)
+        for p in fk:
+            assert fk[p][0] == ik[p][0], (p, fk[p], ik[p])
+
+        # compile budget: repeated runs at the same plan shape compiled
+        # NOTHING new...
+        assert kern.compiles == compiles_warm, \
+            "plan kernel recompiled at an unchanged plan shape"
+        # ...and 2x data growth (same chunk bucket, same build bucket)
+        # must not either
+        n2 = min(len(data["rowid"]), 2 * n_j)
+        ldata2 = lineitem_join_data(
+            {k: v[:n2] for k, v in data.items()}, n_orders)
+        t2 = Tablet("lineitem-j2", lineitem_join_info(),
+                    tempfile.mkdtemp(prefix="ybtpu-join2-"))
+        t2.bulk_load(ldata2, block_rows=32768)
+        growth = t2.read(req())
+        assert growth.backend == "tpu"
+        assert kern.compiles == compiles_warm, \
+            "plan kernel recompiled on data growth inside the bucket"
+
+        return {
+            "rows": n_j,
+            "build_rows": int(LAST_PLAN_STATS.get("n_build", 0)),
+            "build_slots": int(LAST_PLAN_STATS.get("num_slots", 0)),
+            "fused_rows_per_s": round(n_j / f_t, 1),
+            "per_operator_rows_per_s": round(n_j / o_t, 1),
+            "interp_rows_per_s": round(n_j / i_t, 1),
+            "fused_vs_interp": round(i_t / f_t, 3),
+            "fused_vs_operator": round(o_t / f_t, 3),
+            "plan_compiles": kern.compiles,
+            "plan_launches": kern.launches,
+            "plan_cache_hits": kern.cache_hits,
+            "plan_signatures": len(kern.sig_compiles),
+            "compiles_flat_across_growth": True,   # asserted above
+            "build_table": dict(LAST_JOIN_STATS),
+            "stage_split": {k: v for k, v in LAST_PLAN_STATS.items()
+                            if k.endswith("_s") or k == "chunks"},
+        }
+    finally:
+        flags.REGISTRY.reset("streaming_chunk_rows")
+
+
 # ratio keys whose value < 1.0 means "slower than the baseline it was
 # measured against" — surfaced as a WARN in the bench tail instead of
 # sitting silently inside the JSON (satellite of PR 3; Q6's r05
@@ -1399,7 +1576,8 @@ _RATIO_KEYS = ("vs_baseline", "speedup", "vs_cpu", "vs_xla",
                "p99_ratio_on_vs_off", "achieved_ratio_on_vs_off",
                "stream_vs_mono", "v2_vs_v1_bytes", "prune_speedup",
                "bypass_vs_hotpath", "bypass_p99_impact",
-               "grouped_vs_interp", "split_goodput_ratio",
+               "grouped_vs_interp", "fused_vs_interp",
+               "fused_vs_operator", "split_goodput_ratio",
                "cluster_bypass_p95_impact", "cluster_p99_on_vs_off",
                "cluster_achieved_on_vs_off", "cluster_p99_spread",
                "cluster_fused_p99_on_vs_off",
@@ -1822,6 +2000,16 @@ def main():
     # device over scan-global dictionary codes; grouped_vs_interp
     # WARN-wires like stream_vs_mono)
     results["q1_grouped"] = q1_grouped_bench(data, repeats)
+
+    # --- device hash join + fused plans (Q3/Q5-shaped join+group) -------
+    try:
+        results["tpch_join"] = tpch_join_bench(data, repeats)
+    except AssertionError:
+        raise   # a parity/compile-budget break IS a bench failure
+    except Exception as e:   # noqa: BLE001 — report, don't fail bench
+        if os.environ.get("BENCH_DEBUG"):
+            raise
+        results["tpch_join"] = {"error": str(e)[:300]}
 
     # --- optional: hand-fused pallas scan vs the XLA kernel -------------
     # (BENCH_PALLAS=1; the flag stays off otherwise so the driver's run

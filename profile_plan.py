@@ -1,0 +1,194 @@
+"""Profile the fused-plan (device hash join) stage split.
+
+`--json` prints ONE JSON object breaking a TPC-H Q3/Q5-shaped fused
+join+group query into its stages — build-table construction, probe
+batch formation, fused kernel dispatch, host combine — plus the
+plan-kernel cache counters (compiles PER PLAN SIGNATURE must stay 1
+however many launches/growth steps run), a chunk-size sweep, and a
+build-side-size sweep showing bucket boundaries (the ONLY places a new
+compile is allowed).
+
+Env knobs: PROFILE_SF (default 0.1), PROFILE_ROUNDS (default 3),
+PROFILE_CHUNK_SWEEP (comma list of chunk_rows; default "32768,131072"),
+PROFILE_BUILD_SWEEP (comma list of build-row counts; default
+"500,2000,20000").
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("YBTPU_PLATFORM", "cpu")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def profile_json() -> dict:
+    import numpy as np
+
+    from yugabyte_db_tpu.docdb.operations import ReadRequest
+    from yugabyte_db_tpu.models.tpch import (PRIO_STRINGS,
+                                             generate_lineitem,
+                                             generate_orders,
+                                             lineitem_join_data,
+                                             lineitem_join_info,
+                                             numpy_reference_join,
+                                             orders_build_wire,
+                                             prio_build_col,
+                                             tpch_q3ish)
+    from yugabyte_db_tpu.ops.join_scan import (JOIN_STATS, JoinWire,
+                                               LAST_JOIN_STATS)
+    from yugabyte_db_tpu.ops.plan_fusion import (LAST_PLAN_STATS,
+                                                 PLAN_STATS,
+                                                 FusedPlanKernel,
+                                                 default_plan_kernel)
+    from yugabyte_db_tpu.tablet import Tablet
+    from yugabyte_db_tpu.utils import flags
+
+    sf = float(os.environ.get("PROFILE_SF", "0.1"))
+    rounds = int(os.environ.get("PROFILE_ROUNDS", "3"))
+    chunk_sweep = [int(x) for x in os.environ.get(
+        "PROFILE_CHUNK_SWEEP", "32768,131072").split(",") if x]
+    build_sweep = [int(x) for x in os.environ.get(
+        "PROFILE_BUILD_SWEEP", "500,2000,20000").split(",") if x]
+
+    data = generate_lineitem(sf)
+    n = len(data["rowid"])
+    n_orders = max(n // 4, 1)
+    odata = generate_orders(n_orders)
+    ldata = lineitem_join_data(data, n_orders)
+    t = Tablet("li-plan", lineitem_join_info(),
+               tempfile.mkdtemp(prefix="plan-prof-"))
+    t.bulk_load(ldata, block_rows=32768)
+    q = tpch_q3ish()
+    wire = orders_build_wire(q, odata)
+    out: dict = {"rows": n, "orders": n_orders,
+                 "build_rows": int(len(wire.keys))}
+
+    def req():
+        return ReadRequest("lineitem_j", where=q.probe_where,
+                           aggregates=q.aggs, group_by=q.group,
+                           join=wire)
+
+    # cold run: the whole stage split with nothing warm
+    flags.set_flag("streaming_chunk_rows", 32768)
+    kern = default_plan_kernel()
+    t0 = time.perf_counter()
+    resp = t.read(req())
+    cold_s = time.perf_counter() - t0
+    assert resp.backend == "tpu", "fused plan fell back"
+    ref = numpy_reference_join(q, ldata, odata)
+    got = {str(resp.group_values[0][g]):
+           int(np.asarray(resp.group_counts)[g])
+           for g in np.nonzero(np.asarray(resp.group_counts))[0]}
+    for p in PRIO_STRINGS:
+        assert got.get(str(p), 0) == ref[p][0], (p, got, ref[p])
+    out["cold"] = {"wall_s": round(cold_s, 4),
+                   "stage_split": dict(LAST_PLAN_STATS),
+                   "build_table": dict(LAST_JOIN_STATS)}
+
+    # warm rounds: cache-resident chunks, zero compiles
+    c0 = kern.compiles
+    best = None
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        t.read(req())
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    out["warm"] = {"wall_s": round(best, 4),
+                   "rows_per_s": round(n / best, 1),
+                   "stage_split": dict(LAST_PLAN_STATS),
+                   "new_compiles": kern.compiles - c0}
+    assert kern.compiles == c0, "warm rounds must not compile"
+
+    # plan-cache accounting: compiles per signature (each must be 1)
+    out["plan_cache"] = {
+        "compiles": kern.compiles,
+        "launches": kern.launches,
+        "cache_hits": kern.cache_hits,
+        "signatures": len(kern.sig_compiles),
+        "compiles_per_signature": sorted(kern.sig_compiles.values()),
+        "process_stats": dict(PLAN_STATS),
+        "join_builds": JOIN_STATS["builds"],
+        "join_fallbacks": JOIN_STATS["fallbacks"],
+    }
+
+    # chunk-size sweep
+    sweep = {}
+    for cr in chunk_sweep:
+        flags.set_flag("streaming_chunk_rows", cr)
+        t.read(req())      # compile this bucket if new
+        t0 = time.perf_counter()
+        t.read(req())
+        dt = time.perf_counter() - t0
+        sweep[str(cr)] = {
+            "rows_per_s": round(n / dt, 1),
+            "chunks": LAST_PLAN_STATS.get("chunks"),
+            "bucket_rows": LAST_PLAN_STATS.get("bucket_rows"),
+            "kernel_s": LAST_PLAN_STATS.get("kernel_s"),
+            "batch_build_s": LAST_PLAN_STATS.get("batch_build_s"),
+        }
+    out["chunk_sweep"] = sweep
+    flags.REGISTRY.reset("streaming_chunk_rows")
+
+    # build-side sweep: growth inside one pow2 table bucket never
+    # compiles; crossing a bucket boundary compiles exactly once
+    flags.set_flag("streaming_chunk_rows", 32768)
+    bsweep = {}
+    skern = FusedPlanKernel()
+    rng = np.random.default_rng(3)
+    BID = prio_build_col()
+    from yugabyte_db_tpu.ops.plan_fusion import streaming_plan_aggregate
+    from yugabyte_db_tpu.ops.scan import AggSpec
+    from yugabyte_db_tpu.ops.expr import Expr
+    blocks = [r.columnar_block(i) for r in t.regular.ssts
+              for i in range(r.num_blocks())]
+    from yugabyte_db_tpu.models.tpch import (DISCOUNT, EXTPRICE,
+                                             L_ORDERKEY, SHIPDATE)
+    aggs = (AggSpec("sum", Expr.col(EXTPRICE).node), AggSpec("count"))
+    from yugabyte_db_tpu.ops.grouped_scan import DictGroupSpec
+    for nb in build_sweep:
+        w = JoinWire(
+            probe_col=L_ORDERKEY,
+            keys=rng.choice(n_orders, size=min(nb, n_orders),
+                            replace=False).astype(np.int64),
+            payload={BID: (np.asarray(
+                [f"P{i % 5}" for i in range(min(nb, n_orders))],
+                object), None)})
+        pre = skern.compiles
+        got = streaming_plan_aggregate(
+            blocks, [EXTPRICE, DISCOUNT, SHIPDATE, L_ORDERKEY],
+            q.probe_where, aggs, DictGroupSpec(cols=(BID,)), None, w,
+            kernel=skern, chunk_rows=32768)
+        t0 = time.perf_counter()
+        streaming_plan_aggregate(
+            blocks, [EXTPRICE, DISCOUNT, SHIPDATE, L_ORDERKEY],
+            q.probe_where, aggs, DictGroupSpec(cols=(BID,)), None, w,
+            kernel=skern, chunk_rows=32768)
+        dt = time.perf_counter() - t0
+        assert got is not None
+        bsweep[str(nb)] = {
+            "table_slots": LAST_PLAN_STATS.get("num_slots"),
+            "new_compiles": skern.compiles - pre,
+            "build_table_s": LAST_PLAN_STATS.get("build_table_s"),
+            "rows_per_s": round(n / dt, 1),
+        }
+    out["build_sweep"] = bsweep
+    out["build_sweep_compiles"] = skern.compiles
+    flags.REGISTRY.reset("streaming_chunk_rows")
+    return out
+
+
+def main() -> int:
+    t0 = time.perf_counter()
+    out = profile_json()
+    out["total_wall_s"] = round(time.perf_counter() - t0, 2)
+    if "--json" in sys.argv:
+        print(json.dumps(out, indent=2, default=str))
+    else:
+        print(json.dumps(out, indent=2, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -101,7 +101,8 @@ def _reset_flags():
     yield
     for f in ("grouped_pushdown_enabled", "grouped_max_slots",
               "streaming_chunk_rows", "streaming_scan_enabled",
-              "sst_format_version", "tpu_min_rows_for_pushdown"):
+              "sst_format_version", "tpu_min_rows_for_pushdown",
+              "grouped_spill_merge_enabled"):
         flags.REGISTRY.reset(f)
 
 
@@ -267,12 +268,14 @@ class TestFallbacks:
         assert _by_key(resp) == _by_key(off)
 
     def test_streamed_spill_skips_monolithic_pass(self, strtab):
-        # with streaming active, an over-cardinality scan must pay ONE
-        # device pass (the streamed one that detected the spill), then
-        # go straight to the interpreter: one spill fallback, and no
-        # extra grouped kernel launches beyond the streamed chunks
+        # with streaming active and the partial-spill MERGE disabled,
+        # an over-cardinality scan must pay ONE device pass (the
+        # streamed one that detected the spill), then go straight to
+        # the interpreter: one spill fallback, and no extra grouped
+        # kernel launches beyond the streamed chunks
         t, _ = strtab
         flags.set_flag("streaming_chunk_rows", 4096)
+        flags.set_flag("grouped_spill_merge_enabled", False)
         _grouped_read(t)                     # warm the chunk plan/cache
         fb0 = GROUPED_STATS["spill_fallbacks"]
         l0 = GROUPED_STATS["launches"]
@@ -283,6 +286,25 @@ class TestFallbacks:
         assert GROUPED_STATS["spill_fallbacks"] == fb0 + 1
         assert chunks >= 3
         assert GROUPED_STATS["launches"] - l0 == chunks
+
+    def test_streamed_spill_merges_partials(self, strtab):
+        # DEFAULT spill behavior since the partial-spill merge: device
+        # slots below the spill slot keep their exact partials, the
+        # spilled rows re-aggregate on the interpreted tail, and the
+        # combined answer equals the full interpreted GROUP BY — no
+        # full re-scan, backend stays tpu
+        t, _ = strtab
+        flags.set_flag("streaming_chunk_rows", 4096)
+        m0 = GROUPED_STATS["spill_merges"]
+        fb0 = GROUPED_STATS["spill_fallbacks"]
+        resp = _grouped_read(t, spec=DictGroupSpec(cols=(1, 2),
+                                                   max_slots=4))
+        assert resp.backend == "tpu"
+        assert GROUPED_STATS["spill_merges"] == m0 + 1
+        assert GROUPED_STATS["spill_fallbacks"] == fb0
+        flags.set_flag("grouped_pushdown_enabled", False)
+        off = _grouped_read(t)
+        assert _by_key(resp) == _by_key(off)
 
     def test_flag_off_reverts(self, strtab):
         t, _ = strtab

@@ -1,0 +1,701 @@
+"""Device hash join + fused plans + window kernels: device-vs-CPU-twin
+bitwise parity (empty build side, nulls, dangling FKs, dict-coded
+string keys, chunk-straddling probes, bucket-growth-without-recompile,
+flag revert), fused-plan vs operator-at-a-time identity across the
+monolithic, streaming and bypass routes, the SQL fused-join pushdown,
+window segment-scan parity, and the shared-consts-offset regression
+the fused-plan work exposed in the scan kernel."""
+import asyncio
+import tempfile
+
+import numpy as np
+import pytest
+
+from yugabyte_db_tpu.bypass import BypassIneligible, BypassSession
+from yugabyte_db_tpu.docdb.operations import (ReadRequest, RowOp,
+                                              WriteRequest)
+from yugabyte_db_tpu.docdb.table_codec import TableInfo
+from yugabyte_db_tpu.docdb.wire import (read_request_from_wire,
+                                        read_request_to_wire)
+from yugabyte_db_tpu.dockv.packed_row import (ColumnSchema, ColumnType,
+                                              TableSchema)
+from yugabyte_db_tpu.dockv.partition import PartitionSchema
+from yugabyte_db_tpu.ops.expr import Expr
+from yugabyte_db_tpu.ops.grouped_scan import (DictGroupSpec,
+                                              decode_slot_groups)
+from yugabyte_db_tpu.ops.join_scan import (BUILD_COL_BASE, JoinIneligible,
+                                           JoinWire, build_hash_table,
+                                           hash_join_cpu,
+                                           make_join_runtime,
+                                           table_bucket)
+from yugabyte_db_tpu.ops.plan_fusion import (FusedPlanKernel,
+                                             fused_plan_cpu,
+                                             monolithic_plan_aggregate,
+                                             streaming_plan_aggregate)
+from yugabyte_db_tpu.ops.scan import AggSpec
+from yugabyte_db_tpu.ops.window_scan import (WindowKernel, window_cpu)
+from yugabyte_db_tpu.tablet import Tablet
+from yugabyte_db_tpu.utils import flags
+
+C = Expr.col
+BID = BUILD_COL_BASE
+N = 24_000
+
+
+def _probe_tablet(prefix, n=N, seed=3, block_rows=4096, n_keys=600,
+                  frac=False):
+    """Probe table: k (PK), fk int64 (FK, some dangling past n_keys//?),
+    val f64, ship int32."""
+    schema = TableSchema((
+        ColumnSchema(0, "k", ColumnType.INT64, is_hash_key=True),
+        ColumnSchema(1, "fk", ColumnType.INT64),
+        ColumnSchema(2, "val", ColumnType.FLOAT64),
+        ColumnSchema(3, "ship", ColumnType.INT32),
+    ), 1)
+    info = TableInfo("probe", "probe", schema, PartitionSchema("hash", 1))
+    t = Tablet("probe", info, tempfile.mkdtemp(prefix=prefix))
+    rng = np.random.default_rng(seed)
+    data = {
+        "k": np.arange(n, dtype=np.int64),
+        "fk": rng.integers(0, n_keys, n).astype(np.int64),
+        "val": (rng.uniform(1.0, 100.0, n) if frac
+                else rng.integers(1, 100, n).astype(np.float64)),
+        "ship": rng.integers(0, 100, n).astype(np.int32),
+    }
+    t.bulk_load(data, block_rows=block_rows)
+    return t, data
+
+
+def _blocks(t):
+    return [r.columnar_block(i) for r in t.regular.ssts
+            for i in range(r.num_blocks())]
+
+
+def _build_wire(n_build=500, probe_col=1, with_null_payload=False,
+                seed=7):
+    """Build side: keys 0..n_build-1, string priority payload +
+    numeric weight payload (weight nulls injected on request)."""
+    rng = np.random.default_rng(seed)
+    prio = np.array([f"P{i % 5}" for i in range(n_build)], object)
+    w = rng.integers(1, 10, n_build).astype(np.int64)
+    wn = (np.arange(n_build) % 7 == 0) if with_null_payload else None
+    return JoinWire(probe_col=probe_col,
+                    keys=np.arange(n_build, dtype=np.int64),
+                    payload={BID: (prio, None), BID + 1: (w, wn)})
+
+
+_WHERE = (C(3) < 50).node
+_AGGS = (AggSpec("sum", C(2).node), AggSpec("count"),
+         AggSpec("sum", C(BID + 1).node))
+_GROUP = DictGroupSpec(cols=(BID,))
+
+
+def _join_req(wire, aggs=_AGGS, group=_GROUP, where=_WHERE):
+    r = ReadRequest("probe", where=where, aggregates=aggs,
+                    group_by=group, join=wire)
+    # every request crosses the wire codec, like a real RPC
+    return read_request_from_wire(read_request_to_wire(r))
+
+
+def _by_key(resp):
+    counts = np.asarray(resp.group_counts)
+    out = {}
+    for g in np.nonzero(counts)[0]:
+        key = tuple(str(v[g]) for v in resp.group_values)
+        out[key] = (int(counts[g]),) + tuple(
+            np.asarray(v)[g] for v in resp.agg_values)
+    return out
+
+
+@pytest.fixture(autouse=True)
+def _reset_flags():
+    yield
+    for f in ("join_pushdown_enabled", "plan_fusion_enabled",
+              "window_pushdown_enabled", "join_max_build_slots",
+              "streaming_chunk_rows", "streaming_scan_enabled",
+              "grouped_pushdown_enabled", "tpu_min_rows_for_pushdown",
+              "bypass_reader_enabled"):
+        flags.REGISTRY.reset(f)
+
+
+# --- unit: build table / probe twin ---------------------------------------
+
+class TestJoinUnits:
+    def test_table_bucket_load_factor(self):
+        assert table_bucket(0, 1 << 16) == 8
+        assert table_bucket(4, 1 << 16) == 8
+        assert table_bucket(5, 1 << 16) == 16
+        assert table_bucket(256, 1 << 16) == 512
+        with pytest.raises(JoinIneligible):
+            table_bucket(40_000, 1 << 16)   # needs 131072 > cap
+
+    def test_linear_probe_invariant(self):
+        # adversarial clustering: many keys hashing near each other —
+        # every key must be reachable from its home slot with no empty
+        # slot in between (the device walk's exactness condition)
+        rng = np.random.default_rng(0)
+        keys = rng.choice(1 << 40, size=300, replace=False).astype(
+            np.int64)
+        S = table_bucket(len(keys), 1 << 16)
+        used, tkey, tval = build_hash_table(keys, S)
+        from yugabyte_db_tpu.ops.join_scan import _home_slots
+        homes = _home_slots(keys, S)
+        for i, k in enumerate(keys):
+            s = int(homes[i])
+            steps = 0
+            while True:
+                assert used[s], f"empty slot inside chain of key {k}"
+                if tkey[s] == k:
+                    assert tval[s] == i
+                    break
+                s = (s + 1) & (S - 1)
+                steps += 1
+                assert steps < S
+
+    def test_duplicate_keys_raise(self):
+        with pytest.raises(JoinIneligible):
+            build_hash_table(np.array([3, 5, 3], np.int64), 8)
+
+    def test_hash_join_cpu_dangling_and_empty(self):
+        probe = np.array([5, 0, 99, 2], np.int64)
+        build = np.array([2, 5, 7], np.int64)
+        got = hash_join_cpu(probe, build)
+        assert list(got) == [1, -1, -1, 0]
+        assert list(hash_join_cpu(probe, np.zeros(0, np.int64))) \
+            == [-1, -1, -1, -1]
+
+    def test_string_keys_map_through_probe_dict(self):
+        d = np.array(["A", "C", "D"], object)
+        wire = JoinWire(probe_col=9,
+                        keys=np.array(["C", "B", "A"], object),
+                        payload={})
+        rt = make_join_runtime(wire, {9: d})
+        # C->1, B absent -> distinct negative sentinel, A->0
+        assert rt.keys_mapped[0] == 1 and rt.keys_mapped[2] == 0
+        assert rt.keys_mapped[1] < 0
+
+    def test_string_keys_without_dict_refused(self):
+        wire = JoinWire(probe_col=9,
+                        keys=np.array(["C"], object), payload={})
+        with pytest.raises(JoinIneligible):
+            make_join_runtime(wire, {})
+
+
+# --- fused plan: device vs CPU twin, bitwise ------------------------------
+
+class TestFusedPlanParity:
+    def test_device_matches_twin_bitwise(self):
+        # FRACTIONAL probe values: the fixed-point SUM lane quantizes
+        # and the twin replays that exact contract — bitwise on x64
+        t, _ = _probe_tablet("twin-", frac=True)
+        blocks = _blocks(t)
+        wire = _build_wire(with_null_payload=True)
+        aggs = _AGGS + (AggSpec("min", C(2).node),
+                        AggSpec("max", C(BID + 1).node))
+        kern = FusedPlanKernel()
+        gout = {}
+        douts, dcounts = monolithic_plan_aggregate(
+            blocks, [1, 2, 3], _WHERE, aggs, _GROUP, None, wire,
+            kernel=kern, grouped_out=gout)
+        assert not gout.get("spill")
+        from yugabyte_db_tpu.ops.device_batch import bucket_rows
+        touts, tcounts, tspill, tdicts = fused_plan_cpu(
+            blocks, [1, 2, 3], _WHERE, aggs, _GROUP, wire, None,
+            n_total=bucket_rows(N))
+        assert tspill == 0
+        nslots = len(np.asarray(tcounts))
+        assert np.array_equal(np.asarray(dcounts)[:nslots],
+                              np.asarray(tcounts))
+        occ = np.asarray(tcounts) > 0
+        for dv, cv in zip(douts, touts):
+            da = np.asarray(dv)[:nslots]
+            assert np.array_equal(da[occ].astype(np.float64),
+                                  np.asarray(cv)[occ].astype(
+                                      np.float64)), (da, cv)
+
+    def test_fused_vs_interpreted_byte_identity(self):
+        # integer-valued lanes end to end: the device's exact int64
+        # accumulation makes fused results BYTE-identical to the
+        # interpreted join, keyed by group value
+        t, _ = _probe_tablet("int-")
+        fused = t.read(_join_req(_build_wire()))
+        assert fused.backend == "tpu"
+        flags.set_flag("join_pushdown_enabled", False)
+        interp = t.read(_join_req(_build_wire()))
+        assert interp.backend == "cpu"
+        fk, ik = _by_key(fused), _by_key(interp)
+        assert set(fk) == set(ik)
+        for k in fk:
+            assert fk[k][0] == ik[k][0]
+            assert float(fk[k][1]) == float(ik[k][1])   # sum(val)
+            assert float(fk[k][3]) == float(ik[k][3])   # sum(weight)
+
+    def test_dangling_fks_drop(self):
+        # n_keys=600 but build side only covers 0..499: rows with fk
+        # >= 500 are dangling and must drop from BOTH paths
+        t, data = _probe_tablet("dang-")
+        wire = _build_wire(n_build=500)
+        fused = t.read(_join_req(wire))
+        m = (data["ship"] < 50) & (data["fk"] < 500)
+        total = sum(c for c, *_ in _by_key(fused).values())
+        assert total == int(m.sum())
+
+    def test_empty_build_side(self):
+        t, _ = _probe_tablet("empty-", n=8000)
+        wire = JoinWire(probe_col=1, keys=np.zeros(0, np.int64),
+                        payload={BID: (np.zeros(0, object), None),
+                                 BID + 1: (np.zeros(0, np.int64),
+                                           None)})
+        fused = t.read(_join_req(wire))
+        assert sum(np.asarray(fused.group_counts)) == 0
+        flags.set_flag("join_pushdown_enabled", False)
+        interp = t.read(_join_req(wire))
+        assert _by_key(fused) == _by_key(interp) == {}
+
+    def test_null_fk_and_null_payload(self):
+        # NULL FKs (written through the row path) never match; NULL
+        # payload values are excluded from their aggregate but the row
+        # still counts — identical in fused and interpreted paths
+        schema = TableSchema((
+            ColumnSchema(0, "k", ColumnType.INT64, is_hash_key=True),
+            ColumnSchema(1, "fk", ColumnType.INT64),
+            ColumnSchema(2, "val", ColumnType.FLOAT64),
+            ColumnSchema(3, "ship", ColumnType.INT32),
+        ), 1)
+        info = TableInfo("probe", "probe", schema,
+                         PartitionSchema("hash", 1))
+        t = Tablet("probe", info, tempfile.mkdtemp(prefix="nullfk-"))
+        rows = [{"k": i, "fk": None if i % 5 == 0 else i % 20,
+                 "val": float(i % 9), "ship": i % 100}
+                for i in range(6000)]
+        t.apply_write(WriteRequest("probe", [RowOp("upsert", r)
+                                             for r in rows]))
+        flags.set_flag("tpu_min_rows_for_pushdown", 0)
+        wire = _build_wire(n_build=20, with_null_payload=True)
+        fused = t.read(_join_req(wire))
+        flags.set_flag("join_pushdown_enabled", False)
+        interp = t.read(_join_req(wire))
+        fk, ik = _by_key(fused), _by_key(interp)
+        assert set(fk) == set(ik) and fk
+        for k in fk:
+            assert fk[k][0] == ik[k][0]
+            assert float(fk[k][1]) == float(ik[k][1])
+            assert float(fk[k][3]) == float(ik[k][3])
+
+    def test_string_join_keys_dict_coded(self):
+        # the probe FK is a STRING column: build keys map through the
+        # scan-global dictionary, unmapped build keys can never match
+        schema = TableSchema((
+            ColumnSchema(0, "k", ColumnType.INT64, is_hash_key=True),
+            ColumnSchema(1, "fks", ColumnType.STRING),
+            ColumnSchema(2, "val", ColumnType.FLOAT64),
+        ), 1)
+        info = TableInfo("probe", "probe", schema,
+                         PartitionSchema("hash", 1))
+        t = Tablet("probe", info, tempfile.mkdtemp(prefix="strk-"))
+        rng = np.random.default_rng(5)
+        n = 12_000
+        fkv = rng.integers(0, 40, n)
+        t.bulk_load({
+            "k": np.arange(n, dtype=np.int64),
+            "fks": np.array([f"K{v:02d}" for v in fkv], object),
+            "val": rng.integers(1, 50, n).astype(np.float64),
+        }, block_rows=4096)
+        keys = np.array([f"K{v:02d}" for v in range(30)]
+                        + ["ZZ-never"], object)
+        prio = np.array([f"P{i % 3}" for i in range(31)], object)
+        wire = JoinWire(probe_col=1, keys=keys,
+                        payload={BID: (prio, None)})
+        req = ReadRequest("probe",
+                          aggregates=(AggSpec("sum", C(2).node),
+                                      AggSpec("count")),
+                          group_by=DictGroupSpec(cols=(BID,)),
+                          join=wire)
+        fused = t.read(read_request_from_wire(read_request_to_wire(req)))
+        assert fused.backend == "tpu"
+        flags.set_flag("join_pushdown_enabled", False)
+        req2 = ReadRequest("probe",
+                           aggregates=(AggSpec("sum", C(2).node),
+                                       AggSpec("count")),
+                           group_by=DictGroupSpec(cols=(BID,)),
+                           join=wire)
+        interp = t.read(req2)
+        fk, ik = _by_key(fused), _by_key(interp)
+        assert set(fk) == set(ik) and fk
+        for k in fk:
+            assert fk[k][0] == ik[k][0]
+            assert float(fk[k][1]) == float(ik[k][1])
+        assert sum(c for c, *_ in fk.values()) \
+            == int((fkv < 30).sum())
+
+    def test_duplicate_build_keys_fall_back_interpreted(self):
+        # duplicate build keys are a typed device refusal; the
+        # interpreted landing path serves them with FULL inner-join
+        # semantics — one output per matching build row (a probe row
+        # whose FK matches 3 build rows counts 3 times), never a
+        # silent last-wins overwrite
+        t, data = _probe_tablet("dup-", n=6000)
+        keys = np.zeros(3, np.int64)        # all duplicate (key 0)
+        wire = JoinWire(probe_col=1, keys=keys,
+                        payload={BID: (np.array(["A", "B", "A"],
+                                                object), None)})
+        from yugabyte_db_tpu.ops.join_scan import JOIN_STATS
+        fb0 = JOIN_STATS["fallbacks"]
+        resp = t.read(_join_req(wire))
+        assert resp.backend == "cpu"        # typed fallback, served
+        assert JOIN_STATS["fallbacks"] == fb0 + 1
+        n_match = int(((data["fk"] == 0) & (data["ship"] < 50)).sum())
+        got = _by_key(resp)
+        assert got[("A",)][0] == 2 * n_match   # two 'A' build rows
+        assert got[("B",)][0] == n_match
+
+    def test_float_build_keys_never_truncate(self):
+        # float build keys ship VERBATIM over the wire; non-integer
+        # values are a typed device refusal and the interpreted join
+        # matches the TRUE float values — 3.5 must not become 3
+        t, data = _probe_tablet("fkeys-", n=6000)
+        wire = JoinWire(probe_col=1,
+                        keys=np.array([2.0, 3.5]),
+                        payload={BID: (np.array(["X", "Y"], object),
+                                       None)})
+        resp = t.read(_join_req(wire))
+        assert resp.backend == "cpu"        # non-integer key: typed
+        got = _by_key(resp)
+        n2 = int(((data["fk"] == 2) & (data["ship"] < 50)).sum())
+        assert got.get(("X",), (0,))[0] == n2
+        assert ("Y",) not in got            # 3.5 matches NO int fk
+        # integer-VALUED float keys are exact and serve on device
+        wire2 = JoinWire(probe_col=1,
+                         keys=np.arange(500).astype(np.float64),
+                         payload={BID: (np.array(
+                             [f"P{i % 5}" for i in range(500)],
+                             object), None),
+                             BID + 1: (np.ones(500, np.int64), None)})
+        resp2 = t.read(_join_req(wire2))
+        assert resp2.backend == "tpu"
+
+    def test_flag_revert(self):
+        t, _ = _probe_tablet("flag-", n=8000)
+        from yugabyte_db_tpu.ops.plan_fusion import PLAN_STATS
+        l0 = PLAN_STATS["launches"]
+        flags.set_flag("join_pushdown_enabled", False)
+        resp = t.read(_join_req(_build_wire()))
+        assert resp.backend == "cpu"
+        assert PLAN_STATS["launches"] == l0
+
+
+# --- routes: streaming / monolithic / bypass byte-identity ----------------
+
+class TestFusedPlanRoutes:
+    def test_chunk_straddling_probes_stream_exactly(self):
+        # small chunks: probe rows for one build key straddle many
+        # chunk boundaries; streamed partials must combine to the
+        # monolithic answer BIT-for-bit on integer lanes
+        t, _ = _probe_tablet("strad-", block_rows=2048)
+        flags.set_flag("streaming_chunk_rows", 2048)
+        from yugabyte_db_tpu.ops.plan_fusion import LAST_PLAN_STATS
+        streamed = t.read(_join_req(_build_wire()))
+        assert streamed.backend == "tpu"
+        assert LAST_PLAN_STATS.get("path") == "streaming"
+        assert LAST_PLAN_STATS["chunks"] >= 3
+        flags.set_flag("streaming_scan_enabled", False)
+        mono = t.read(_join_req(_build_wire()))
+        assert LAST_PLAN_STATS.get("path") == "monolithic"
+        sk, mk = _by_key(streamed), _by_key(mono)
+        assert set(sk) == set(mk)
+        for k in sk:
+            assert sk[k][0] == mk[k][0]
+            assert float(sk[k][1]) == float(mk[k][1])
+            assert float(sk[k][3]) == float(mk[k][3])
+
+    def test_bypass_route_byte_identical(self):
+        # the bypass session's fused plan must equal the RPC route's
+        # answer byte-for-byte at the same chunk plan (streaming) and
+        # under min_chunks (monolithic twin)
+        t, _ = _probe_tablet("byp-", block_rows=4096)
+        flags.set_flag("streaming_chunk_rows", 4096)
+        wire = _build_wire()
+        rpc = t.read(_join_req(wire))
+        assert rpc.backend == "tpu"
+        gout = {}
+        with BypassSession([t], read_ht=None) as s:
+            outs, counts, stats = s.scan_aggregate(
+                _WHERE, _AGGS, _GROUP, grouped_out=gout, join=wire)
+        assert stats["key_rebuilds"] == 0
+        bk = {}
+        for g in np.nonzero(np.asarray(counts))[0]:
+            key = tuple(str(v[g]) for v in gout["group_values"])
+            bk[key] = (int(np.asarray(counts)[g]),) + tuple(
+                np.asarray(v)[g] for v in outs)
+        rk = _by_key(rpc)
+        assert set(bk) == set(rk)
+        for k in bk:
+            assert bk[k][0] == rk[k][0]
+            assert float(bk[k][1]) == float(rk[k][1])
+            assert float(bk[k][3]) == float(rk[k][3])
+
+    def test_bypass_typed_reasons(self):
+        t, _ = _probe_tablet("bypr-", n=6000)
+        wire = _build_wire()
+        with BypassSession([t], read_ht=None) as s:
+            flags.set_flag("join_pushdown_enabled", False)
+            with pytest.raises(BypassIneligible) as e1:
+                s.scan_aggregate(_WHERE, _AGGS, _GROUP, join=wire)
+            assert e1.value.reason == "join_pushdown_off"
+            flags.REGISTRY.reset("join_pushdown_enabled")
+            dup = JoinWire(probe_col=1,
+                           keys=np.zeros(4, np.int64),
+                           payload={BID: (np.array(["A"] * 4, object),
+                                          None)})
+            with pytest.raises(BypassIneligible) as e2:
+                s.scan_aggregate(_WHERE, _AGGS, _GROUP, join=dup)
+            assert e2.value.reason == "join_shape"
+            assert "duplicate" in e2.value.detail
+
+    def test_growth_never_recompiles_at_same_plan_shape(self):
+        # the acceptance gate: MORE data (more chunks, same shared
+        # pow2 chunk bucket) and a BIGGER build side (same pow2 table
+        # bucket) reuse the cached program — compile count stays flat
+        flags.set_flag("streaming_chunk_rows", 4096)
+        kern = FusedPlanKernel()
+        wire_a = _build_wire(n_build=100)
+        wire_b = _build_wire(n_build=120)   # same 256-slot bucket
+        t1, _ = _probe_tablet("g1-", n=3 * 4096, block_rows=4096)
+        t2, _ = _probe_tablet("g2-", n=9 * 4096, block_rows=4096)
+        aggs = (AggSpec("sum", C(2).node), AggSpec("count"))
+        got = streaming_plan_aggregate(
+            _blocks(t1), [1, 2, 3], _WHERE, aggs, _GROUP, None,
+            wire_a, kernel=kern, chunk_rows=4096)
+        assert got is not None
+        c0 = kern.compiles
+        assert c0 == 1
+        for t, wire in ((t2, wire_a), (t1, wire_b), (t2, wire_b)):
+            got = streaming_plan_aggregate(
+                _blocks(t), [1, 2, 3], _WHERE, aggs, _GROUP, None,
+                wire, kernel=kern, chunk_rows=4096)
+            assert got is not None
+        assert kern.compiles == c0, "recompiled at the same plan shape"
+        assert len(kern.sig_compiles) == 1
+        assert all(v == 1 for v in kern.sig_compiles.values())
+
+
+# --- the consts-offset regression the fused-plan work exposed -------------
+
+class TestSharedConstsOffsets:
+    def test_where_and_agg_constants_do_not_collide(self):
+        # BEFORE the offset fix every compiled expression indexed the
+        # shared runtime-consts list from 0, so an aggregate
+        # expression's literal read the WHERE's first constant: TPC-H
+        # Q1's revenue sums were silently wrong on the device path.
+        from yugabyte_db_tpu.models.tpch import (TPCH_Q1,
+                                                 generate_lineitem,
+                                                 lineitem_info)
+        from yugabyte_db_tpu.ops.device_batch import build_batch
+        from yugabyte_db_tpu.ops.scan import ScanKernel
+        data = {k: v[:32768] for k, v in generate_lineitem(0.1).items()}
+        t = Tablet("li", lineitem_info(),
+                   tempfile.mkdtemp(prefix="consts-"))
+        t.bulk_load(data, block_rows=32768)
+        blocks = _blocks(t)
+        batch = build_batch(blocks, sorted(TPCH_Q1.columns))
+        outs, counts, _ = ScanKernel().run(batch, TPCH_Q1.where,
+                                           TPCH_Q1.aggs, TPCH_Q1.group)
+        m = data["l_shipdate"] <= 10471
+        gid = data["l_returnflag"] + 3 * data["l_linestatus"]
+        price, disc, tax = (data["l_extendedprice"],
+                            data["l_discount"], data["l_tax"])
+        for g in range(6):
+            mg = m & (gid == g)
+            want_disc = (price[mg] * (1 - disc[mg])).sum()
+            want_charge = (price[mg] * (1 - disc[mg])
+                           * (1 + tax[mg])).sum()
+            got_disc = float(np.asarray(outs[2])[g])
+            got_charge = float(np.asarray(outs[3])[g])
+            assert abs(got_disc - want_disc) / want_disc < 1e-5
+            assert abs(got_charge - want_charge) / want_charge < 1e-5
+
+
+# --- window kernels -------------------------------------------------------
+
+class TestWindowKernel:
+    OPS = [("row_number",), ("rank",), ("dense_rank",), ("lag", 1),
+           ("lead", 2), ("sum", 1), ("sum", 0), ("count", 1),
+           ("rolling_sum", 3), ("min", 0), ("max", 1),
+           ("count_star", 1)]
+
+    def _sorted_case(self, n=5000, seed=1):
+        rng = np.random.default_rng(seed)
+        part = rng.integers(0, 37, n)
+        order = rng.integers(0, 15, n)
+        vals = rng.integers(-50, 50, n).astype(np.int64)
+        vnull = rng.random(n) < 0.1
+        perm = np.lexsort((order, part))
+        p_s, o_s = part[perm], order[perm]
+        seg = np.ones(n, bool)
+        seg[1:] = p_s[1:] != p_s[:-1]
+        peer = np.zeros(n, bool)
+        peer[1:] = (o_s[1:] != o_s[:-1]) & ~seg[1:]
+        return seg, peer, vals[perm], vnull[perm]
+
+    def test_device_matches_twin(self):
+        seg, peer, v, vn = self._sorted_case()
+        values = [None if op[0] in ("row_number", "rank", "dense_rank",
+                                    "count_star") else v
+                  for op in self.OPS]
+        nulls = [None if x is None else vn for x in values]
+        kern = WindowKernel()
+        dev = kern.run(self.OPS, seg, peer, values, nulls)
+        twin = window_cpu(self.OPS, seg, peer, values, nulls)
+        for op, (dv, dm), (tv, tm) in zip(self.OPS, dev, twin):
+            assert np.array_equal(dm, tm), op
+            ok = ~dm
+            assert np.array_equal(np.asarray(dv)[ok], tv[ok]), op
+
+    def test_compile_cache_holds(self):
+        seg, peer, v, vn = self._sorted_case(n=3000, seed=2)
+        kern = WindowKernel()
+        kern.run([("rank",), ("sum", 1)], seg, peer, [None, v],
+                 [None, vn])
+        c0 = kern.compiles
+        seg2, peer2, v2, vn2 = self._sorted_case(n=3000, seed=9)
+        kern.run([("rank",), ("sum", 1)], seg2, peer2, [None, v2],
+                 [None, vn2])
+        assert kern.compiles == c0
+
+    def test_cumulative_sum_peers_share(self):
+        # one partition, an order-key tie: peers share the cumulative
+        # value at the peer-group end (PG's default RANGE frame)
+        seg = np.array([True, False, False, False])
+        peer = np.array([False, False, True, False])
+        v = np.array([1, 2, 4, 8], np.int64)
+        vn = np.zeros(4, bool)
+        kern = WindowKernel()
+        (out, om), = kern.run([("sum", 1)], seg, peer, [v], [vn])
+        assert list(out) == [3, 3, 15, 15]
+        assert not om.any()
+
+
+# --- SQL: the fused join pushdown end to end ------------------------------
+
+class TestSqlFusedJoin:
+    def _run(self, coro):
+        return asyncio.run(coro)
+
+    def test_sql_join_group_fused_vs_classic(self, tmp_path):
+        from yugabyte_db_tpu.ql import SqlSession
+        from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+        from yugabyte_db_tpu.ops.plan_fusion import PLAN_STATS
+
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute(
+                    "CREATE TABLE facts (k bigint, fk bigint, v double,"
+                    " PRIMARY KEY (k))")
+                await s.execute(
+                    "CREATE TABLE dims (dk bigint, name text, w bigint,"
+                    " PRIMARY KEY (dk))")
+                vals = ",".join(f"({i}, {i % 7}, {float(i % 11)})"
+                                for i in range(400))
+                await s.execute(
+                    "INSERT INTO facts (k, fk, v) VALUES " + vals)
+                dv = ",".join(f"({d}, 'name{d % 3}', {d * 10})"
+                              for d in range(5))
+                await s.execute(
+                    "INSERT INTO dims (dk, name, w) VALUES " + dv)
+                flags.set_flag("tpu_min_rows_for_pushdown", 0)
+                q = ("SELECT name, sum(v) AS sv, count(*) AS c, "
+                     "sum(w) AS sw FROM facts JOIN dims ON fk = dk "
+                     "WHERE v > 2 AND w < 40 GROUP BY name "
+                     "ORDER BY name")
+                l0 = PLAN_STATS["launches"]
+                r1 = (await s.execute(q)).rows
+                assert PLAN_STATS["launches"] > l0, \
+                    "SQL fused join never reached the plan kernel"
+                flags.set_flag("plan_fusion_enabled", False)
+                r2 = (await s.execute(q)).rows
+                # integer-valued lanes: results must be identical
+                assert r1 == r2
+                # scalar shape too
+                flags.REGISTRY.reset("plan_fusion_enabled")
+                q2 = ("SELECT count(*) AS c, sum(v) AS sv FROM facts "
+                      "JOIN dims ON fk = dk WHERE w < 40")
+                r3 = (await s.execute(q2)).rows
+                flags.set_flag("plan_fusion_enabled", False)
+                r4 = (await s.execute(q2)).rows
+                assert r3 == r4
+            finally:
+                await mc.shutdown()
+        self._run(go())
+
+    def test_sql_join_decimal_where_matches_classic(self, tmp_path):
+        # DECIMAL columns store as text: the fused binder must wrap
+        # them in cast_numeric exactly like _bind, or the interpreted
+        # fallback compares text against numbers (review regression)
+        from yugabyte_db_tpu.ql import SqlSession
+        from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute(
+                    "CREATE TABLE f2 (k bigint, fk bigint, d numeric,"
+                    " PRIMARY KEY (k))")
+                await s.execute(
+                    "CREATE TABLE d2 (dk bigint, name text,"
+                    " PRIMARY KEY (dk))")
+                vals = ",".join(f"({i}, {i % 3}, {(i % 9) / 100})"
+                                for i in range(60))
+                await s.execute(
+                    "INSERT INTO f2 (k, fk, d) VALUES " + vals)
+                await s.execute(
+                    "INSERT INTO d2 (dk, name) VALUES (0,'a'),"
+                    "(1,'b'),(2,'a')")
+                flags.set_flag("tpu_min_rows_for_pushdown", 0)
+                q = ("SELECT name, count(*) AS c FROM f2 JOIN d2 "
+                     "ON fk = dk WHERE d > 0.05 GROUP BY name "
+                     "ORDER BY name")
+                r1 = (await s.execute(q)).rows
+                # i%9 in {6,7,8} passes d > 0.05; fk=i%3 maps those to
+                # 'a' (fk 0,2) 6+6 and 'b' (fk 1) 6.  (The CLASSIC
+                # client join can't serve this residual shape — decimal
+                # text vs float in _eval_by_name is a pre-existing
+                # limitation — so the fused path is compared against
+                # the arithmetic, not against it.)
+                assert r1 == [{"name": "a", "c": 12},
+                              {"name": "b", "c": 6}]
+            finally:
+                await mc.shutdown()
+        self._run(go())
+
+    def test_sql_windows_device_bit_identical(self, tmp_path):
+        from yugabyte_db_tpu.ql import SqlSession
+        from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+        from yugabyte_db_tpu.ops.window_scan import WINDOW_STATS
+
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute("CREATE TABLE w (k bigint, g bigint, "
+                                "v bigint, PRIMARY KEY (k))")
+                vals = ",".join(f"({i}, {i % 5}, {(i * 7) % 23})"
+                                for i in range(200))
+                await s.execute("INSERT INTO w (k, g, v) VALUES " + vals)
+                q = ("SELECT k, rank() OVER (PARTITION BY g ORDER BY v)"
+                     " AS rk, sum(v) OVER (PARTITION BY g ORDER BY v) "
+                     "AS s, lag(v) OVER (PARTITION BY g ORDER BY v) "
+                     "AS lg, row_number() OVER (PARTITION BY g "
+                     "ORDER BY v DESC) AS rn FROM w ORDER BY k")
+                l0 = WINDOW_STATS["launches"]
+                r1 = (await s.execute(q)).rows
+                assert WINDOW_STATS["launches"] > l0, \
+                    "window kernel never launched"
+                flags.set_flag("window_pushdown_enabled", False)
+                r2 = (await s.execute(q)).rows
+                assert r1 == r2
+            finally:
+                await mc.shutdown()
+        self._run(go())

@@ -38,12 +38,20 @@ REASON_SLOT_OVERFLOW = "grouped_slot_overflow"
 #: dict-grouped scan while grouped_pushdown_enabled is off — the RPC
 #: path's interpreted GROUP BY is the flag-off contract
 REASON_GROUPED_OFF = "grouped_pushdown_off"
+#: join request while join_pushdown_enabled / plan fusion is off — the
+#: RPC path's interpreted join is the flag-off contract
+REASON_JOIN_OFF = "join_pushdown_off"
+#: the shipped build side can't be served exactly by the device join
+#: (duplicate keys, oversized table, unsupported key type) — carries
+#: the ops/join_scan typed reason in `detail`
+REASON_JOIN_SHAPE = "join_shape"
 
 ALL_REASONS = (
     REASON_FLAG_OFF, REASON_MEMTABLE_ACTIVE, REASON_NO_SSTS,
     REASON_NO_COLUMNAR, REASON_NOT_CHUNK_SAFE, REASON_COLUMN_NOT_FIXED,
     REASON_HASH_GROUP, REASON_EXPR_SHAPE, REASON_NOT_AGGREGATE,
-    REASON_SLOT_OVERFLOW, REASON_GROUPED_OFF,
+    REASON_SLOT_OVERFLOW, REASON_GROUPED_OFF, REASON_JOIN_OFF,
+    REASON_JOIN_SHAPE,
 )
 
 

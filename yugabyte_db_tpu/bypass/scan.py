@@ -36,6 +36,7 @@ from ..storage.sst import SstReader
 from ..utils import flags
 from .errors import (REASON_COLUMN_NOT_FIXED, REASON_EXPR_SHAPE,
                      REASON_GROUPED_OFF, REASON_HASH_GROUP,
+                     REASON_JOIN_OFF, REASON_JOIN_SHAPE,
                      REASON_NO_COLUMNAR, REASON_NOT_AGGREGATE,
                      REASON_NOT_CHUNK_SAFE, REASON_SLOT_OVERFLOW,
                      BypassIneligible)
@@ -207,6 +208,114 @@ def bypass_scan_aggregate(
                          LAST_PREFILTER_STATS["rows_in"])
         stats.setdefault("prefilter_rows_kept",
                          LAST_PREFILTER_STATS["rows_kept"])
+    return outs, np.asarray(counts), stats
+
+
+def bypass_plan_aggregate(
+        blocks: Sequence[ColumnarBlock],
+        where: Optional[tuple], aggs: Sequence[AggSpec],
+        group, read_ht: int, join_wire,
+        chunk_rows: Optional[int] = None,
+        min_chunks: int = 3,
+        grouped_out: Optional[dict] = None
+        ) -> Tuple[tuple, np.ndarray, dict]:
+    """Fused-plan (FK-equijoin) aggregate over a pinned snapshot —
+    the bypass route of ops/plan_fusion.py.  The probe scan streams
+    keylessly exactly like bypass_scan_aggregate (same chunk-safety
+    gate, same shared bucket); the build side probes inside the fused
+    program.  Raises BypassIneligible with a typed reason for every
+    shape the engine cannot serve exactly; ``REASON_JOIN_SHAPE``
+    carries the ops/join_scan typed reason in its detail."""
+    from ..ops.join_scan import BUILD_COL_BASE, JoinIneligible
+    from ..ops.plan_fusion import (default_plan_kernel,
+                                   monolithic_plan_aggregate,
+                                   streaming_plan_aggregate)
+    if not aggs:
+        raise BypassIneligible(REASON_NOT_AGGREGATE)
+    if isinstance(group, HashGroupSpec):
+        raise BypassIneligible(REASON_HASH_GROUP)
+    if not flags.get("join_pushdown_enabled"):
+        raise BypassIneligible(REASON_JOIN_OFF)
+    dict_group = isinstance(group, DictGroupSpec)
+    if dict_group and not flags.get("grouped_pushdown_enabled"):
+        raise BypassIneligible(REASON_GROUPED_OFF)
+    from ..ops.expr import device_compatible, referenced_columns
+    if where is not None and not device_compatible(where):
+        raise BypassIneligible(REASON_EXPR_SHAPE, "where")
+    for a in aggs:
+        if a.expr is not None and not device_compatible(a.expr):
+            raise BypassIneligible(REASON_EXPR_SHAPE, "aggregate expr")
+    needed: set = set()
+    if where is not None:
+        referenced_columns(where, needed)
+    for a in aggs:
+        if a.expr is not None:
+            referenced_columns(a.expr, needed)
+    if dict_group:
+        needed.update(group.cols)
+    elif group is not None:
+        needed.update(cid for cid, _, _ in group.cols)
+    needed = {c for c in needed if c < BUILD_COL_BASE}
+    needed.add(join_wire.probe_col)
+    for b in blocks:
+        for cid in needed:
+            if not (cid in b.fixed or cid in b.pk or cid in b.varlen):
+                raise BypassIneligible(
+                    REASON_COLUMN_NOT_FIXED, f"column {cid}")
+    if not chunk_safe_mvcc(blocks):
+        raise BypassIneligible(REASON_NOT_CHUNK_SAFE)
+    kernel = default_plan_kernel()
+    rebuilds0 = KEY_REBUILD_STATS["rebuilds"]
+    cols_sorted = sorted(needed)
+    expanded = tuple(_expand_avg(aggs))
+    minmax = [i for i, a in enumerate(expanded)
+              if a.op in ("min", "max")]
+    aggs_run = expanded + tuple(AggSpec("count", expanded[i].expr)
+                                for i in minmax)
+    stats: dict = {}
+    gout: Optional[dict] = {} if dict_group else None
+    from ..docdb.operations import DocReadOperation
+    try:
+        got = streaming_plan_aggregate(
+            blocks, cols_sorted, where, aggs_run, group, read_ht,
+            join_wire, kernel=kernel, chunk_rows=chunk_rows,
+            min_chunks=min_chunks, grouped_out=gout)
+        if got is None:
+            try:
+                got = monolithic_plan_aggregate(
+                    blocks, cols_sorted, where, aggs_run, group,
+                    read_ht, join_wire, kernel=kernel,
+                    grouped_out=gout)
+            except KeyError as e:
+                raise BypassIneligible(REASON_COLUMN_NOT_FIXED, str(e))
+            stats["path"] = "monolithic"
+        else:
+            stats["path"] = "streaming"
+    except JoinIneligible as e:
+        raise BypassIneligible(REASON_JOIN_SHAPE, e.reason)
+    except DocReadOperation._Unrewritable:
+        raise BypassIneligible(
+            REASON_EXPR_SHAPE,
+            "string column outside a rewritable predicate shape")
+    if dict_group and gout.get("spill"):
+        raise BypassIneligible(
+            REASON_SLOT_OVERFLOW,
+            f"{gout['spill']} rows past {gout['num_slots']} slots")
+    outs, counts = got
+    from ..docdb.operations import _nullify_minmax
+    outs = _nullify_minmax(expanded, minmax, outs)
+    if dict_group:
+        from ..ops.grouped_scan import decode_slot_groups
+        outs, counts, gvals = decode_slot_groups(
+            group, gout["dicts"], outs, counts)
+        if grouped_out is not None:
+            grouped_out["group_values"] = gvals
+    stats["key_rebuilds"] = KEY_REBUILD_STATS["rebuilds"] - rebuilds0
+    from ..ops.plan_fusion import LAST_PLAN_STATS
+    # keep the session-scoped key_rebuilds (it covers block collection
+    # too, not just the chunk pipeline)
+    stats.update({k: v for k, v in LAST_PLAN_STATS.items()
+                  if k not in ("path", "key_rebuilds")})
     return outs, np.asarray(counts), stats
 
 

@@ -140,7 +140,8 @@ class BypassSession:
     # ------------------------------------------------------------------
     def scan_aggregate(self, where, aggs: Sequence[AggSpec],
                        group=None, combine: str = "host",
-                       grouped_out: Optional[dict] = None
+                       grouped_out: Optional[dict] = None,
+                       join=None
                        ) -> Tuple[tuple, Optional[np.ndarray], dict]:
         """Run one aggregate scan at the session read point across all
         pinned shards.  combine='host' reproduces the RPC fan-out's
@@ -163,10 +164,10 @@ class BypassSession:
         from ..ops.grouped_scan import DictGroupSpec
         dict_group = isinstance(group, DictGroupSpec)
         if combine == "mesh":
-            if dict_group:
+            if dict_group or join is not None:
                 raise ValueError(
-                    "mesh combine does not serve dict-grouped scans; "
-                    "use combine='host'")
+                    "mesh combine does not serve dict-grouped or "
+                    "join scans; use combine='host'")
             return self._scan_mesh(where, aggs, group)
         if combine != "host":
             raise ValueError(f"unknown combine mode {combine!r}")
@@ -179,12 +180,20 @@ class BypassSession:
             if not blocks:
                 continue            # empty shard: combine identity
             gout: dict = {}
-            outs, counts, sstats = bypass_scan_aggregate(
-                blocks, where, aggs, group, self.read_ht,
-                chunk_rows=self.chunk_rows,
-                prefilter_enabled=self.prefilter,
-                min_chunks=self.min_chunks,
-                grouped_out=gout if dict_group else None)
+            if join is not None:
+                from .scan import bypass_plan_aggregate
+                outs, counts, sstats = bypass_plan_aggregate(
+                    blocks, where, aggs, group, self.read_ht, join,
+                    chunk_rows=self.chunk_rows,
+                    min_chunks=self.min_chunks,
+                    grouped_out=gout if dict_group else None)
+            else:
+                outs, counts, sstats = bypass_scan_aggregate(
+                    blocks, where, aggs, group, self.read_ht,
+                    chunk_rows=self.chunk_rows,
+                    prefilter_enabled=self.prefilter,
+                    min_chunks=self.min_chunks,
+                    grouped_out=gout if dict_group else None)
             parts.append(outs)
             counts_parts.append(counts)
             if dict_group:

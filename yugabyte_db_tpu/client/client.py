@@ -728,7 +728,8 @@ class YBClient:
                     req.table_id, columns=req.columns, where=req.where,
                     aggregates=req.aggregates, group_by=req.group_by,
                     limit=req.limit, paging_state=paging,
-                    read_ht=req.read_ht, consistency=req.consistency)
+                    read_ht=req.read_ht, consistency=req.consistency,
+                    join=req.join)
                 payload = {"tablet_id": loc.tablet_id,
                            "req": read_request_to_wire(r)}
                 resp = read_response_from_wire(await self._call_leader(
@@ -798,7 +799,7 @@ class YBClient:
             with BypassSession(tablets, read_ht=req.read_ht) as s:
                 outs, counts, stats = s.scan_aggregate(
                     req.where, req.aggregates, req.group_by,
-                    grouped_out=gout)
+                    grouped_out=gout, join=req.join)
                 return outs, counts, gout.get("group_values"), stats
         loop = asyncio.get_running_loop()
         try:

@@ -83,6 +83,13 @@ class ReadRequest:
     where: Optional[tuple] = None            # expr AST over column IDS
     aggregates: Tuple[AggSpec, ...] = ()     # aggregate pushdown
     group_by: Optional[GroupSpec] = None
+    # FK-equijoin pushdown: the (small, pre-filtered) build side ships
+    # WITH the request (ops/join_scan.JoinWire) — keys + payload
+    # columns, referenced from `aggregates`/`group_by` at ids >=
+    # BUILD_COL_BASE.  Aggregate requests only; `where` stays a
+    # probe-side predicate (build-side filters are applied by the
+    # sender before shipping the build rows).
+    join: Optional[object] = None
     pk_eq: Optional[Dict[str, object]] = None  # full-PK point lookup
     pk_prefix: Optional[Dict[str, object]] = None  # hash-cols prefix scan
     limit: Optional[int] = None
@@ -1118,6 +1125,8 @@ class DocReadOperation:
             return ReadResponse(rows=rows, backend="cpu")
         if req.pk_prefix is not None:
             return self._prefix_scan(req)
+        if req.join is not None and req.aggregates:
+            return self._execute_join_aggregate(req)
         if (not req.aggregates and req.where is not None
                 and req.paging_state is None):
             got = self._hash_enumerated_read(req)
@@ -1490,9 +1499,26 @@ class DocReadOperation:
         if got is None:
             return None
         if dict_group and grouped_out.get("spill"):
-            # slot overflow: the spill slot aggregated an unknown mix of
-            # groups — results are unusable, revert to the interpreter
+            # slot overflow: slots BELOW the spill slot still hold exact
+            # per-group partials (every in-range row scattered to its own
+            # slot regardless of the overflow) — only the spill slot
+            # aggregated an unknown mix.  The partial-spill merge keeps
+            # the hot device partials and re-aggregates just the spilled
+            # rows on the interpreted tail; when it can't run, revert to
+            # the full interpreted re-scan as before.
             from ..ops.grouped_scan import GROUPED_STATS
+            if flags.get("grouped_spill_merge_enabled"):
+                # restart window over the FULL pre-prune block list,
+                # exactly like the normal streamed path and the
+                # interpreted re-scan — a zone-pruned block's
+                # ambiguous-HT rows must keep forcing the restart
+                self._check_restart_window(blocks, read_ht)
+                resp = self._grouped_spill_merge(
+                    req, grouped_out, expanded, minmax, aggs_run, got,
+                    read_ht)
+                if resp is not None:
+                    GROUPED_STATS["spill_merges"] += 1
+                    return resp
             GROUPED_STATS["spill_fallbacks"] += 1
             return _SPILLED
         # uncertainty-window restart check only once the streaming path
@@ -1512,6 +1538,90 @@ class DocReadOperation:
         return ReadResponse(agg_values=outs,
                             group_counts=np.asarray(counts),
                             backend="tpu")
+
+    def _grouped_spill_merge(self, req: ReadRequest, gout: dict,
+                             expanded, minmax, aggs_run, got,
+                             read_ht: int) -> Optional[ReadResponse]:
+        """Partial-spill merge (PR-9 named follow-on): device slots
+        below the spill slot keep their exact partials; rows whose
+        group id landed at/past it re-aggregate on the interpreted
+        tail (same WHERE, same MVCC-visible mask — valid because the
+        streamed path already proved the blocks chunk-safe, i.e. one
+        visible version per doc key); the two partials combine through
+        the shared group-keyed combine.  The partials are DISJOINT by
+        construction (a group's id is fixed: it is either in range or
+        spilled), so the combine is a pure union.  Returns None when
+        the merge can't run — caller reverts to the full re-scan."""
+        plan = gout.get("plan")
+        blocks = gout.get("blocks")
+        if plan is None or not blocks:
+            return None
+        spec = req.group_by
+        dicts = gout["dicts"]
+        spill_slot = gout["num_slots"] - 1
+        outs, counts = got
+        counts_hot = np.asarray(counts).copy()
+        counts_hot[spill_slot:] = 0
+        from ..ops.grouped_scan import decode_slot_groups
+        dev_part = decode_slot_groups(
+            spec, dicts, [np.asarray(o) for o in outs], counts_hot)
+        # replay the device's group-id encoding over the SAME remapped
+        # codes to find which rows spilled
+        gid = None
+        gnull = None
+        stride = 1
+        for cid in spec.cols:
+            codes = np.concatenate(
+                [plan.block_codes(cid, b) for b in blocks])
+            nl = np.concatenate(
+                [np.asarray(b.varlen[cid][2], bool) for b in blocks])
+            gid = (codes.astype(np.int64) * stride if gid is None
+                   else gid + codes.astype(np.int64) * stride)
+            gnull = nl if gnull is None else (gnull | nl)
+            stride *= max(len(dicts[cid]), 1)
+        ht = np.concatenate([b.ht for b in blocks])
+        tomb = np.concatenate([b.tombstone for b in blocks])
+        vis = (ht <= np.uint64(read_ht)) & ~tomb
+        sel = np.flatnonzero(vis & ~gnull & (gid >= spill_slot))
+        schema = self.codec.schema
+        from ..ops.expr import referenced_columns
+        needed = set(spec.cols)
+        if req.where is not None:
+            referenced_columns(req.where, needed)
+        for a in req.aggregates:
+            if a.expr is not None:
+                referenced_columns(a.expr, needed)
+        by_id = {c.id: c for c in schema.columns}
+        if any(c not in by_id for c in needed):
+            return None
+        proj = [by_id[c] for c in sorted(needed)]
+        rows = self._gather_rows(blocks, sel, proj)
+        if rows is None:
+            return None
+        aggs_list = list(aggs_run)
+        dummy_state = [None] * len(aggs_list)
+        group_state: Dict[object, list] = {}
+        name_to_id = {c.name: c.id for c in schema.columns}
+        for row in rows:
+            idrow = {name_to_id[nm]: v for nm, v in row.items()}
+            if req.where is not None and \
+                    eval_expr_py(req.where, idrow) is not True:
+                continue
+            _agg_accumulate(aggs_list, dummy_state, group_state, spec,
+                            idrow)
+        tail = _grouped_cpu_response(aggs_list, group_state, spec)
+        from ..ops.scan import combine_grouped_partials
+        merged_outs, merged_counts, merged_gvals = \
+            combine_grouped_partials(
+                tuple(aggs_run),
+                [dev_part, (tail.agg_values, tail.group_counts,
+                            tail.group_values)])
+        # (the caller already ran the restart-window check over the
+        # FULL pre-prune block list)
+        outs_f = _nullify_minmax(expanded, minmax, merged_outs)
+        return ReadResponse(agg_values=outs_f,
+                            group_counts=merged_counts,
+                            group_values=merged_gvals, backend="tpu")
 
     def _check_restart_window(self, blocks, read_ht: int) -> None:
         """Raise ReadRestartError when any block holds a record inside
@@ -1621,6 +1731,201 @@ class DocReadOperation:
         return ReadResponse(agg_values=_nullify(outs),
                             group_counts=np.asarray(counts),
                             backend="tpu")
+
+    # ---- FK-equijoin pushdown (ReadRequest.join) -------------------------
+    def _join_eligible(self, req: ReadRequest) -> bool:
+        if not flags.get("tpu_pushdown_enabled"):
+            return False
+        from ..ops.expr import device_compatible
+        if req.where is not None and not device_compatible(req.where):
+            return False
+        for a in req.aggregates:
+            if a.expr is not None and not device_compatible(a.expr):
+                return False
+        approx_rows = sum(r.num_entries for r in self.store.ssts)
+        return approx_rows >= flags.get("tpu_min_rows_for_pushdown")
+
+    def _execute_join_aggregate(self, req: ReadRequest) -> ReadResponse:
+        """Aggregate request with a shipped build side: the fused-plan
+        device path (filter -> probe -> gather -> group -> aggregate in
+        ONE program, ops/plan_fusion.py) when eligible, the interpreted
+        row-at-a-time join otherwise — typed JoinIneligible refusals
+        and every device-ineligible shape land on the same interpreted
+        path, so the answer never depends on which path ran."""
+        from ..ops.join_scan import JOIN_STATS, JoinIneligible
+        if flags.get("join_pushdown_enabled") and \
+                self._join_eligible(req):
+            try:
+                resp = self._execute_fused_join(req)
+                if resp is not None:
+                    return resp
+            except JoinIneligible:
+                JOIN_STATS["fallbacks"] += 1
+        return self._execute_join_cpu(req)
+
+    def _execute_fused_join(self, req: ReadRequest
+                            ) -> Optional[ReadResponse]:
+        from ..ops.join_scan import BUILD_COL_BASE
+        from ..ops.plan_fusion import (default_plan_kernel,
+                                       monolithic_plan_aggregate,
+                                       streaming_plan_aggregate)
+        group = req.group_by
+        if isinstance(group, HashGroupSpec):
+            return None
+        dict_group = isinstance(group, DictGroupSpec)
+        if dict_group and not flags.get("grouped_pushdown_enabled"):
+            return None
+        blocks = self._collect_blocks()
+        if not blocks:
+            return None
+        from ..ops.expr import referenced_columns
+        needed = set()
+        if req.where is not None:
+            referenced_columns(req.where, needed)
+        for a in req.aggregates:
+            if a.expr is not None:
+                referenced_columns(a.expr, needed)
+        if dict_group:
+            needed.update(group.cols)
+        elif group is not None:
+            needed.update(cid for cid, _, _ in group.cols)
+        needed = {c for c in needed if c < BUILD_COL_BASE}
+        needed.add(req.join.probe_col)
+        read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
+        from ..ops.scan import _expand_avg
+        expanded = tuple(_expand_avg(req.aggregates))
+        minmax = [i for i, a in enumerate(expanded)
+                  if a.op in ("min", "max")]
+        aggs_run = expanded + tuple(AggSpec("count", expanded[i].expr)
+                                    for i in minmax)
+        kernel = default_plan_kernel()
+        cache = self.device_cache
+        key = (self._batch_cache_key(needed)
+               if cache is not None else None)
+        gout: Optional[dict] = {} if dict_group else None
+        got = None
+        if flags.get("streaming_scan_enabled"):
+            got = streaming_plan_aggregate(
+                blocks, sorted(needed), req.where, aggs_run, group,
+                read_ht, req.join, kernel=kernel, cache=cache,
+                cache_key=key, grouped_out=gout)
+        if got is None:
+            try:
+                got = monolithic_plan_aggregate(
+                    blocks, sorted(needed), req.where, aggs_run,
+                    group, read_ht, req.join, kernel=kernel,
+                    cache=cache, cache_key=key, grouped_out=gout)
+            except KeyError:
+                return None   # probe column lacks columnar form
+            except self._Unrewritable:
+                return None   # string predicate outside rewrite shapes
+        if dict_group and gout.get("spill"):
+            from ..ops.grouped_scan import GROUPED_STATS
+            GROUPED_STATS["spill_fallbacks"] += 1
+            return None       # slot overflow: interpreted join
+        self._check_restart_window(blocks, read_ht)
+        outs, counts = got
+        outs = _nullify_minmax(expanded, minmax, outs)
+        if dict_group:
+            from ..ops.grouped_scan import decode_slot_groups
+            outs_c, counts_c, gvals = decode_slot_groups(
+                group, gout["dicts"], outs, counts)
+            return ReadResponse(agg_values=outs_c,
+                                group_counts=counts_c,
+                                group_values=gvals, backend="tpu")
+        return ReadResponse(agg_values=outs,
+                            group_counts=np.asarray(counts),
+                            backend="tpu")
+
+    def _iter_visible_idrows(self, read_ht: int):
+        """Newest visible version of every row as a {col_id: value}
+        dict — the interpreted scan loop the CPU join path feeds on
+        (same MVCC walk as _execute_cpu, minus segments/paging, which
+        join requests never carry)."""
+        table_prefix = self.codec.scan_prefix()
+        name_to_id = {c.name: c.id for c in self.codec.schema.columns}
+        cur_prefix = None
+        chosen = False
+        from ..dockv.value import unwrap_ttl
+        for k, v in self.store.iterate(lower=table_prefix or None):
+            if table_prefix and not k.startswith(table_prefix):
+                break
+            marker = len(k) - _HT_SUFFIX
+            prefix = k[:marker]
+            if prefix != cur_prefix:
+                cur_prefix = prefix
+                chosen = False
+            if chosen:
+                continue
+            dht = DocHybridTime.decode_desc(k[-ENCODED_SIZE:])
+            if dht.ht.value > read_ht:
+                if self._allow_restart and \
+                        dht.ht.value <= read_ht + _skew_window_ht():
+                    raise ReadRestartError(dht.ht.value)
+                continue
+            chosen = True
+            v, expire = unwrap_ttl(v)
+            if expire is not None and expire <= read_ht:
+                continue
+            if v[0] == ValueKind.kTombstone:
+                continue
+            row = self.codec.decode_row(k, v)
+            if row is None:
+                continue
+            yield {name_to_id[n]: val for n, val in row.items()}
+
+    def _execute_join_cpu(self, req: ReadRequest) -> ReadResponse:
+        """Interpreted FK-equijoin aggregate: row-at-a-time probe scan,
+        a Python dict over the shipped build keys, payload values
+        merged into the row under their build-column ids — the
+        correctness reference the fused plan is tested against and the
+        fallback for every ineligible shape."""
+        wire = req.join
+        read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
+        keys = np.asarray(wire.keys)
+        # key -> ALL matching build rows: duplicate build keys (a shape
+        # the device path refuses with a typed reason) keep full SQL
+        # inner-join semantics here — one output row per matching build
+        # row, never a silent last-wins overwrite
+        lookup: Dict[object, list] = {}
+        for i in range(len(keys)):
+            k = keys[i]
+            lookup.setdefault(
+                k.item() if isinstance(k, np.generic) else k,
+                []).append(i)
+        payload = {}
+        for bid, (vals, nls) in wire.payload.items():
+            vals = np.asarray(vals)
+            nls = (np.asarray(nls, bool) if nls is not None
+                   else np.zeros(len(keys), bool))
+            payload[bid] = (vals, nls)
+        aggs = list(_expand_avg_cpu(req.aggregates))
+        agg_state = [_agg_init(a) for a in aggs]
+        group_state: Dict[object, list] = {}
+        probe_col = wire.probe_col
+        for idrow in self._iter_visible_idrows(read_ht):
+            if req.where is not None and \
+                    eval_expr_py(req.where, idrow) is not True:
+                continue
+            fk = idrow.get(probe_col)
+            if fk is None:
+                continue                 # NULL FK never matches
+            matches = lookup.get(fk)
+            if matches is None:
+                continue                 # dangling FK: inner join drops
+            for bi in matches:
+                for bid, (vals, nls) in payload.items():
+                    bv = vals[bi]
+                    idrow[bid] = None if nls[bi] else (
+                        bv.item() if isinstance(bv, np.generic) else bv)
+                _agg_accumulate(aggs, agg_state, group_state,
+                                req.group_by, idrow)
+        if req.group_by is not None:
+            return _grouped_cpu_response(aggs, group_state,
+                                         req.group_by)
+        vals = tuple(_agg_final(a, s) for a, s in zip(aggs, agg_state))
+        return ReadResponse(agg_values=vals, backend="cpu",
+                            group_counts=None)
 
     def _execute_tpu_filter(self, req: ReadRequest) -> Optional[ReadResponse]:
         """Filter-pushdown row scan: the WHERE mask computes on device,

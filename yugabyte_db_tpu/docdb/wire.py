@@ -11,9 +11,69 @@ from typing import Optional
 import numpy as np
 
 from ..ops.grouped_scan import DictGroupSpec
+from ..ops.join_scan import JoinWire
 from ..ops.scan import AggSpec, GroupSpec, HashGroupSpec
 from .operations import ReadRequest, ReadResponse, RowOp, WriteRequest, \
     WriteResponse
+
+
+def _join_to_wire(j: JoinWire) -> dict:
+    """Build side -> msgpack-able dict.  Keys/values serialize as
+    lists (the build side is small by contract — join_max_build_slots
+    bounds it); None-valued entries survive via explicit null masks."""
+    payload = {}
+    for bid, (vals, nulls) in j.payload.items():
+        va = np.asarray(vals)
+        nl = (np.asarray(nulls, bool) if nulls is not None
+              else np.zeros(len(va), bool))
+        # msgpack map keys must be strings (strict_map_key on the
+        # messenger) — the reader int()s them back
+        payload[str(int(bid))] = ["str" if va.dtype == object
+                             or va.dtype.kind in ("U", "S") else "num",
+                             [None if m else
+                              (v if isinstance(v, str) else
+                               v.item() if isinstance(v, np.generic)
+                               else v)
+                              for v, m in zip(va, nl)],
+                             nl.tolist()]
+    keys = np.asarray(j.keys)
+    if keys.dtype == object or keys.dtype.kind in ("U", "S"):
+        kind, wkeys = "str", [str(k) for k in keys]
+    elif keys.dtype.kind == "f":
+        # floats ship VERBATIM: truncating here would let a request
+        # that crossed the wire match different rows than the same
+        # request served locally (the server's typed key-type check
+        # decides what to do with non-integer values)
+        kind, wkeys = "float", [float(k) for k in keys]
+    else:
+        kind, wkeys = "int", keys.astype(np.int64).tolist()
+    return {"probe_col": j.probe_col,
+            "keys": wkeys,
+            "key_kind": kind,
+            "payload": payload}
+
+
+def _join_from_wire(d: Optional[dict]) -> Optional[JoinWire]:
+    if d is None:
+        return None
+    kind = d.get("key_kind", "int")
+    if kind == "str":
+        keys = np.asarray(list(d["keys"]), object)
+    elif kind == "float":
+        keys = np.asarray(d["keys"], np.float64)
+    else:
+        keys = np.asarray(d["keys"], np.int64)
+    payload = {}
+    for bid, (kind, vals, nulls) in (d.get("payload") or {}).items():
+        nl = np.asarray(nulls, bool)
+        if kind == "str":
+            va = np.asarray([v if v is not None else "" for v in vals],
+                            object)
+        else:
+            va = np.asarray([v if v is not None else 0 for v in vals])
+        payload[int(bid)] = (va, nl)
+    return JoinWire(probe_col=d["probe_col"], keys=keys,
+                    payload=payload)
 
 
 def _expr_to_wire(node):
@@ -80,6 +140,8 @@ def read_request_to_wire(req: ReadRequest) -> dict:
         "paging_state": req.paging_state,
         "read_ht": req.read_ht,
         "consistency": req.consistency,
+        "join": (_join_to_wire(req.join)
+                 if req.join is not None else None),
     }
 
 
@@ -105,6 +167,7 @@ def read_request_from_wire(d: dict) -> ReadRequest:
         paging_state=d.get("paging_state"),
         read_ht=d.get("read_ht"),
         consistency=d.get("consistency", "strong"),
+        join=_join_from_wire(d.get("join")),
     )
 
 

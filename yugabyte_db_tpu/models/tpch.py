@@ -168,6 +168,147 @@ def tpch_q1_str() -> QuerySpec:
         columns=TPCH_Q1.columns)
 
 
+# ---------------------------------------------------------------------------
+# Join workload (Q3/Q5-shaped): orders build side + orderkey'd lineitem
+# ---------------------------------------------------------------------------
+
+#: appended column id on the join-enabled lineitem clone
+L_ORDERKEY = 8
+
+O_ORDERKEY, O_ORDERDATE, O_PRIO = 0, 1, 2
+
+#: TPC-H o_orderpriority domain — the string dimension attribute the
+#: fused join+group plan groups by (dict-coded build payload)
+PRIO_STRINGS = np.array(["1-URGENT", "2-HIGH", "3-MEDIUM",
+                         "4-NOT SPECIFIED", "5-LOW"], object)
+
+#: lineitems per order (the TPC-H fanout is 1..7, avg 4)
+LINES_PER_ORDER = 4
+
+
+def orders_schema() -> TableSchema:
+    return TableSchema(columns=(
+        ColumnSchema(O_ORDERKEY, "o_orderkey", ColumnType.INT64,
+                     is_range_key=True),
+        ColumnSchema(O_ORDERDATE, "o_orderdate", ColumnType.INT32),
+        ColumnSchema(O_PRIO, "o_orderpriority", ColumnType.STRING),
+    ), version=1)
+
+
+def orders_info() -> TableInfo:
+    return TableInfo("orders", "orders", orders_schema(),
+                     PartitionSchema("range", 0))
+
+
+def lineitem_join_info() -> TableInfo:
+    """Range-sharded lineitem clone carrying the l_orderkey FK — the
+    probe side of the fused join plans."""
+    cols = lineitem_schema().columns
+    jcols = (ColumnSchema(cols[0].id, cols[0].name, cols[0].type,
+                          is_range_key=True),) + cols[1:] + (
+        ColumnSchema(L_ORDERKEY, "l_orderkey", ColumnType.INT64),)
+    return TableInfo("lineitem_j", "lineitem_j",
+                     TableSchema(columns=jcols, version=1),
+                     PartitionSchema("range", 0))
+
+
+def generate_orders(n_orders: int, seed: int = 1
+                    ) -> Dict[str, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    return {
+        "o_orderkey": np.arange(n_orders, dtype=np.int64),
+        "o_orderdate": rng.integers(8036, 10592, n_orders
+                                    ).astype(np.int32),
+        "o_orderpriority": PRIO_STRINGS[rng.integers(0, 5, n_orders)],
+    }
+
+
+def lineitem_join_data(data: Dict[str, np.ndarray],
+                       n_orders: int) -> Dict[str, np.ndarray]:
+    """`data` rows plus an l_orderkey FK: LINES_PER_ORDER consecutive
+    lineitems share one order (clipped into the key domain)."""
+    out = dict(data)
+    out["l_orderkey"] = np.minimum(
+        data["rowid"] // LINES_PER_ORDER,
+        max(n_orders - 1, 0)).astype(np.int64)
+    return out
+
+
+@dataclass(frozen=True)
+class JoinQuerySpec:
+    """A fused filter->join->group->aggregate plan shape: probe-side
+    WHERE over lineitem_j ids, a build-side orders filter (applied by
+    the SENDER before shipping — inner-join semantics make build-side
+    filtering equivalent to a post-join predicate), aggregates/group
+    over probe ids + build payload ids (>= BUILD_COL_BASE)."""
+    name: str
+    probe_where: Optional[tuple]
+    build_date_lo: int
+    build_date_hi: int
+    aggs: Tuple[AggSpec, ...]
+    group: object
+    probe_columns: Tuple[int, ...]
+
+
+def prio_build_col() -> int:
+    from ..ops.join_scan import BUILD_COL_BASE
+    return BUILD_COL_BASE
+
+
+#: one quarter of o_orderdate — keeps the shipped build side small
+#: (the dimension-side contract of the join pushdown)
+_Q3_LO, _Q3_HI = _D1994, _D1994 + 91
+
+
+def tpch_q3ish() -> JoinQuerySpec:
+    """Q3/Q5-shaped: revenue by o_orderpriority over one order
+    quarter.  SELECT o_orderpriority, sum(l_extendedprice *
+    (1 - l_discount)), count(*) FROM lineitem JOIN orders ON
+    l_orderkey = o_orderkey WHERE l_shipdate >= 1994-01-01 AND
+    o_orderdate in the quarter GROUP BY o_orderpriority."""
+    from ..ops.grouped_scan import DictGroupSpec
+    return JoinQuerySpec(
+        name="q3ish",
+        probe_where=(C(SHIPDATE) >= _D1994).node,
+        build_date_lo=_Q3_LO, build_date_hi=_Q3_HI,
+        aggs=(AggSpec("sum", (C(EXTPRICE)
+                              * (Expr.const(1.0) - C(DISCOUNT))).node),
+              AggSpec("count")),
+        group=DictGroupSpec(cols=(prio_build_col(),)),
+        probe_columns=(EXTPRICE, DISCOUNT, SHIPDATE, L_ORDERKEY),
+    )
+
+
+def orders_build_wire(q: JoinQuerySpec, odata: Dict[str, np.ndarray]):
+    """The shipped build side for `q`: orders keys inside the date
+    window + the o_orderpriority payload column."""
+    from ..ops.join_scan import JoinWire
+    m = ((odata["o_orderdate"] >= q.build_date_lo)
+         & (odata["o_orderdate"] < q.build_date_hi))
+    return JoinWire(
+        probe_col=L_ORDERKEY,
+        keys=odata["o_orderkey"][m],
+        payload={prio_build_col(): (odata["o_orderpriority"][m],
+                                    None)})
+
+
+def numpy_reference_join(q: JoinQuerySpec,
+                         ldata: Dict[str, np.ndarray],
+                         odata: Dict[str, np.ndarray]):
+    """{o_orderpriority: (count, revenue)} straight from numpy."""
+    ok = ldata["l_orderkey"]
+    od = odata["o_orderdate"][ok]
+    m = ((ldata["l_shipdate"] >= _D1994)
+         & (od >= q.build_date_lo) & (od < q.build_date_hi))
+    prio = odata["o_orderpriority"][ok]
+    rev = ldata["l_extendedprice"] * (1.0 - ldata["l_discount"])
+    out = {}
+    for p in PRIO_STRINGS:
+        mg = m & (prio == p)
+        out[p] = (int(mg.sum()), float(rev[mg].sum()))
+    return out
+
+
 def numpy_reference(query: QuerySpec, data: Dict[str, np.ndarray]):
     """Direct numpy answer for verification."""
     qty, price, disc = (data["l_quantity"], data["l_extendedprice"],

@@ -161,14 +161,27 @@ _ARITH = {
 }
 
 
-def compile_expr(node: ExprNode) -> Callable:
+def const_count(node: ExprNode) -> int:
+    """How many runtime-constant slots `node` consumes — the offset
+    stride for compiling several expressions against ONE shared consts
+    list (a kernel's where + aggregate expressions)."""
+    out: list = []
+    collect_constants(node, out)
+    return len(out)
+
+
+def compile_expr(node: ExprNode, offset: int = 0) -> Callable:
     """Compile an AST into fn(cols, nulls, consts) -> (values, is_null).
 
     cols/nulls: dict col_id -> [N] arrays. consts: flat list of scalar
     jnp values in collect_constants order (so literals are runtime args,
-    not baked into the compiled kernel).
+    not baked into the compiled kernel).  ``offset`` is this
+    expression's starting index in the SHARED consts list — a kernel
+    that concatenates several expressions' constants (WHERE first, then
+    each aggregate, the ScanKernel.run order) must compile each
+    expression at its cumulative offset or their const slots collide.
     """
-    counter = [0]
+    counter = [offset]
 
     def build(n: ExprNode) -> Callable:
         kind = n[0]

@@ -51,8 +51,11 @@ from ..storage.columnar import ColumnarBlock
 LAST_GROUPED_STATS: dict = {}
 
 #: process-wide grouped-kernel accounting (compiles tallied by
-#: ScanKernel; launches/spills tallied here)
-GROUPED_STATS = {"launches": 0, "spill_fallbacks": 0}
+#: ScanKernel; launches/spills tallied here; spill_merges counts
+#: slot overflows served by the partial-spill merge instead of a full
+#: interpreted re-scan)
+GROUPED_STATS = {"launches": 0, "spill_fallbacks": 0,
+                 "spill_merges": 0}
 
 #: slot budgets are powers of two in this band — small enough that a
 #: Q1-shaped 8-slot kernel stays pure VPU code, large enough for a

@@ -250,6 +250,115 @@ def _grouped_extreme(v, m, gid, G: int, is_min: bool,
     return seg(masked, gid, G)
 
 
+def visibility_mask(mvcc_mode: str, valid, key_hash, ht, write_id,
+                    tombstone, read_ht):
+    """The MVCC row mask — THE one implementation shared by the scan
+    kernel and the fused plan kernel (ops/plan_fusion.py).  mvcc_mode:
+    'none' (valid only), 'visible' (ht filter, unique keys), 'dedup'
+    (full newest-visible-version selection)."""
+    import jax.numpy as jnp
+    if mvcc_mode == "none":
+        return valid
+    if mvcc_mode == "visible":
+        return valid & (ht <= read_ht) & jnp.logical_not(tombstone)
+    return _mvcc_visible_latest(key_hash, ht, write_id, tombstone,
+                                valid, read_ht)
+
+
+def masked_aggregate(group, agg_fns, prep, cols, nulls, consts, mask,
+                     domains, sum_scales, n_total: int,
+                     strategy: str):
+    """Aggregate the masked rows — the traceable group/agg tail shared
+    by the scan kernel and the fused plan kernel, so the two programs
+    cannot drift.  Handles ResolvedDictGroup (dict-code strides into a
+    pow2 slot bucket, via grouped_reduce), dense GroupSpec, and the
+    ungrouped scalar path; HashGroupSpec stays a scan-kernel-only shape
+    (its sort machinery has no fused-plan use).  Return shapes match
+    the historical _build_kernel contract."""
+    import jax.numpy as jnp
+    if isinstance(group, ResolvedDictGroup):
+        # dict-key grouped aggregation (ops/grouped_scan.py): dense
+        # stride encoding of scan-global dictionary codes, pow2 slot
+        # bucket, spill-slot overflow detection
+        return grouped_reduce(group, agg_fns, prep, cols, nulls,
+                              consts, mask, domains, sum_scales,
+                              strategy)
+    if group is None:
+        out, scales = [], []
+        for i, (op, f) in enumerate(agg_fns):
+            if f is None:
+                out.append(jnp.sum(mask, dtype=jnp.int64))
+                scales.append(_NOSCALE)
+                continue
+            v, vn = f(cols, nulls, consts)
+            m = mask if vn is None else mask & jnp.logical_not(vn)
+            if op == "count":
+                out.append(jnp.sum(m, dtype=jnp.int64))
+                scales.append(_NOSCALE)
+            elif op == "sum":
+                q, s, vm = prep(i, v, m, n_total, sum_scales)
+                out.append(jnp.sum(q))
+                scales.append(s if vm is None else (s, jnp.sum(vm)))
+            elif op == "min":
+                out.append(jnp.min(jnp.where(m, v, _type_max(v))))
+                scales.append(_NOSCALE)
+            elif op == "max":
+                out.append(jnp.max(jnp.where(m, v, _type_min(v))))
+                scales.append(_NOSCALE)
+            else:
+                raise ValueError(op)
+        return (tuple(out), tuple(scales),
+                jnp.sum(mask, dtype=jnp.int64), mask)
+
+    # grouped over declared domains: dense group id + exact int64
+    # per-group reductions (small G unrolls into VPU tree sums;
+    # larger G uses segment_sum — still exact int64).
+    # Rows with NULL in any group column are excluded (the device
+    # group-id encoding has no NULL slot; PG's NULL group stays on
+    # the CPU fallback path).
+    gid = None
+    stride = 1
+    for cid, domain, offset in group.cols:
+        gn = nulls.get(cid)
+        if gn is not None:
+            mask = mask & jnp.logical_not(gn)
+        c = cols[cid].astype(jnp.int32) - offset
+        c = jnp.clip(c, 0, domain - 1)
+        gid = c * stride if gid is None else gid + c * stride
+        stride *= domain
+    G = group.num_groups
+    out, scales = [], []
+    for i, (op, f) in enumerate(agg_fns):
+        if f is None:
+            out.append(_grouped_sum(mask.astype(jnp.int64), gid, G,
+                                    strategy))
+            scales.append(_NOSCALE)
+            continue
+        v, vn = f(cols, nulls, consts)
+        m = mask if vn is None else mask & jnp.logical_not(vn)
+        if op == "count":
+            out.append(_grouped_sum(m.astype(jnp.int64), gid, G,
+                                    strategy))
+            scales.append(_NOSCALE)
+        elif op == "sum":
+            q, s, vm = prep(i, v, m, n_total, sum_scales)
+            out.append(_grouped_sum(q, gid, G, strategy))
+            scales.append(
+                s if vm is None
+                else (s, _grouped_sum(vm, gid, G, strategy)))
+        elif op == "min":
+            out.append(_grouped_extreme(v, m, gid, G, True, strategy))
+            scales.append(_NOSCALE)
+        elif op == "max":
+            out.append(_grouped_extreme(v, m, gid, G, False, strategy))
+            scales.append(_NOSCALE)
+        else:
+            raise ValueError(op)
+    group_counts = _grouped_sum(mask.astype(jnp.int64), gid, G,
+                                strategy)
+    return tuple(out), tuple(scales), group_counts, mask
+
+
 def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
                   group: Optional[GroupSpec], mvcc_mode: str,
                   axis_names: Tuple[str, ...] = (),
@@ -272,9 +381,22 @@ def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
     into the predicate pass with no device max-reduce and no float
     fallback lane. Non-static SUMs keep the dynamic in-kernel scale
     with its degenerate-magnitude fallbacks."""
+    # the kernel's consts list concatenates WHERE constants first, then
+    # each aggregate expression's, in AggSpec order — every compile
+    # lands at its cumulative offset so the slots can never collide
+    # (they DID collide before the fused-plan work: an aggregate
+    # expression's literal read the WHERE's first constant whenever
+    # both carried any)
+    from .expr import const_count
+    off = const_count(where_node) if where_node is not None else 0
     where_fn = compile_expr(where_node) if where_node is not None else None
-    agg_fns = [(a.op, compile_expr(a.expr) if a.expr is not None else None)
-               for a in agg_specs]
+    agg_fns = []
+    for a in agg_specs:
+        if a.expr is None:
+            agg_fns.append((a.op, None))
+        else:
+            agg_fns.append((a.op, compile_expr(a.expr, offset=off)))
+            off += const_count(a.expr)
     static_sums = static_sums or (False,) * len(agg_fns)
 
     def _prep(i, v, m, n_total, sum_scales):
@@ -285,26 +407,13 @@ def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
 
     def fn(cols, nulls, consts, valid, key_hash, ht, write_id, tombstone,
            read_ht, sum_scales, group_domains=()):
-        if mvcc_mode == "none":
-            mask = valid
-        elif mvcc_mode == "visible":
-            mask = valid & (ht <= read_ht) & jnp.logical_not(tombstone)
-        else:
-            mask = _mvcc_visible_latest(key_hash, ht, write_id, tombstone,
-                                        valid, read_ht)
+        mask = visibility_mask(mvcc_mode, valid, key_hash, ht, write_id,
+                               tombstone, read_ht)
         if where_fn is not None:
             wv, wn = where_fn(cols, nulls, consts)
             mask = mask & wv
             if wn is not None:
                 mask = mask & jnp.logical_not(wn)
-
-        if isinstance(group, ResolvedDictGroup):
-            # dict-key grouped aggregation (ops/grouped_scan.py): dense
-            # stride encoding of scan-global dictionary codes, pow2
-            # slot bucket, spill-slot overflow detection
-            return grouped_reduce(group, agg_fns, _prep, cols, nulls,
-                                  consts, mask, group_domains,
-                                  sum_scales, strategy)
 
         if isinstance(group, HashGroupSpec):
             # exclude NULL group values (same rule as the dict path)
@@ -373,81 +482,9 @@ def _build_kernel(where_node, agg_specs: Tuple[AggSpec, ...],
             return (tuple(out), tuple(scales), counts, mask, gvals,
                     n_groups)
 
-        n_total = mask.shape[0] * row_multiplier
-        if group is None:
-            out, scales = [], []
-            for i, (op, f) in enumerate(agg_fns):
-                if f is None:
-                    out.append(jnp.sum(mask, dtype=jnp.int64))
-                    scales.append(_NOSCALE)
-                    continue
-                v, vn = f(cols, nulls, consts)
-                m = mask if vn is None else mask & jnp.logical_not(vn)
-                if op == "count":
-                    out.append(jnp.sum(m, dtype=jnp.int64))
-                    scales.append(_NOSCALE)
-                elif op == "sum":
-                    q, s, vm = _prep(i, v, m, n_total, sum_scales)
-                    out.append(jnp.sum(q))
-                    scales.append(s if vm is None else (s, jnp.sum(vm)))
-                elif op == "min":
-                    out.append(jnp.min(jnp.where(m, v, _type_max(v))))
-                    scales.append(_NOSCALE)
-                elif op == "max":
-                    out.append(jnp.max(jnp.where(m, v, _type_min(v))))
-                    scales.append(_NOSCALE)
-                else:
-                    raise ValueError(op)
-            return (tuple(out), tuple(scales),
-                    jnp.sum(mask, dtype=jnp.int64), mask)
-
-        # grouped over declared domains: dense group id + exact int64
-        # per-group reductions (small G unrolls into VPU tree sums;
-        # larger G uses segment_sum — still exact int64).
-        # Rows with NULL in any group column are excluded (the device
-        # group-id encoding has no NULL slot; PG's NULL group stays on
-        # the CPU fallback path).
-        gid = None
-        stride = 1
-        for cid, domain, offset in group.cols:
-            gn = nulls.get(cid)
-            if gn is not None:
-                mask = mask & jnp.logical_not(gn)
-            c = cols[cid].astype(jnp.int32) - offset
-            c = jnp.clip(c, 0, domain - 1)
-            gid = c * stride if gid is None else gid + c * stride
-            stride *= domain
-        G = group.num_groups
-        out, scales = [], []
-        for i, (op, f) in enumerate(agg_fns):
-            if f is None:
-                out.append(_grouped_sum(mask.astype(jnp.int64), gid, G,
-                                        strategy))
-                scales.append(_NOSCALE)
-                continue
-            v, vn = f(cols, nulls, consts)
-            m = mask if vn is None else mask & jnp.logical_not(vn)
-            if op == "count":
-                out.append(_grouped_sum(m.astype(jnp.int64), gid, G,
-                                        strategy))
-                scales.append(_NOSCALE)
-            elif op == "sum":
-                q, s, vm = _prep(i, v, m, n_total, sum_scales)
-                out.append(_grouped_sum(q, gid, G, strategy))
-                scales.append(
-                    s if vm is None
-                    else (s, _grouped_sum(vm, gid, G, strategy)))
-            elif op == "min":
-                out.append(_grouped_extreme(v, m, gid, G, True, strategy))
-                scales.append(_NOSCALE)
-            elif op == "max":
-                out.append(_grouped_extreme(v, m, gid, G, False, strategy))
-                scales.append(_NOSCALE)
-            else:
-                raise ValueError(op)
-        group_counts = _grouped_sum(mask.astype(jnp.int64), gid, G,
-                                    strategy)
-        return tuple(out), tuple(scales), group_counts, mask
+        return masked_aggregate(group, agg_fns, _prep, cols, nulls,
+                                consts, mask, group_domains, sum_scales,
+                                mask.shape[0] * row_multiplier, strategy)
 
     return fn
 
@@ -578,11 +615,17 @@ class ScanKernel:
                            if cid in batch.nulls)
         try:
             if entry is None:
+                from .expr import const_count
                 from .pallas_scan import build_generic_scan
-                agg_fns = [
-                    (a.op,
-                     compile_expr(a.expr) if a.expr is not None else None)
-                    for a in aggs]
+                off = const_count(where) if where is not None else 0
+                agg_fns = []
+                for a in aggs:
+                    if a.expr is None:
+                        agg_fns.append((a.op, None))
+                        continue
+                    agg_fns.append(
+                        (a.op, compile_expr(a.expr, offset=off)))
+                    off += const_count(a.expr)
                 interpret = jax.default_backend() == "cpu"
                 entry = build_generic_scan(
                     where, agg_fns,

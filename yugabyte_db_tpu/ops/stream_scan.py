@@ -330,8 +330,12 @@ def streaming_scan_aggregate(
             "kernel_s": round(kernel_s, 4),
             "combine_s": round(combine_s, 4)})
         if grouped_out is not None:
+            # plan + post-prune block list ride along so the caller's
+            # partial-spill merge can replay the device's group ids
+            # host-side (the codes ARE the plan's remapped codes)
             grouped_out.update(spill=spill_acc, dicts=plan.dicts,
-                               num_slots=resolved.num_slots)
+                               num_slots=resolved.num_slots,
+                               plan=plan, blocks=list(blocks))
     elif plan is not None:
         LAST_STREAM_STATS["dict_merge_s"] = round(plan.merge_s, 4)
     return tuple(acc), counts_acc

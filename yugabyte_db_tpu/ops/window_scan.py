@@ -1,0 +1,352 @@
+"""Window functions over the sorted scan — segment scans, no scatter.
+
+The grouped kernel (ops/grouped_scan.py) scatters rows into group
+slots; a window function is the same machinery MINUS the scatter: rows
+sort by (partition, order) host-side, partition/peer boundaries become
+boolean lanes, and every supported function is a vectorized segment
+scan over the sorted axis:
+
+- row_number / rank / dense_rank — cummax over boundary-stamped
+  indices (rank = peer-group start relative to segment start).
+- lag / lead — shifted gathers clamped to the segment (NULL outside).
+- SUM / COUNT — global cumsum minus the segment-start base; the
+  cumulative (ordered) frame shares the value across order-key peers
+  exactly like PG's default RANGE frame; the un-ordered frame
+  broadcasts the segment total.
+- rolling SUM (ROWS k-1 PRECEDING .. CURRENT ROW) — two cumsum
+  gathers, window clamped at the segment start.
+- MIN / MAX — segment totals via the same peer-end gather; cumulative
+  frames via a boundary-respecting associative scan.
+
+Kernels are jitted per (op list, pow2 row bucket, value dtypes) —
+the compile-once contract of every other kernel in ops/.  Integer
+value lanes accumulate exactly in int64 (the executor's device window
+hook routes ONLY such lanes plus the arithmetic-free functions, so SQL
+results stay bit-identical to the Python path it replaces);
+:func:`window_cpu` is the numpy twin used for parity tests.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: process-wide window-kernel accounting
+WINDOW_STATS = {"launches": 0, "fallbacks": 0}
+
+#: supported op heads (ops are tuples: ("lag", 2), ("sum", True) ...)
+VALUE_OPS = {"lag", "lead", "sum", "count", "min", "max",
+             "rolling_sum"}
+NO_VALUE_OPS = {"row_number", "rank", "dense_rank", "count_star"}
+
+
+def _seg_bounds(seg_start, idx, n):
+    """(start_idx, end_idx) per row: nearest segment boundary at-or-
+    before / segment last row at-or-after."""
+    import jax
+    import jax.numpy as jnp
+    start_idx = jax.lax.cummax(jnp.where(seg_start, idx, -1))
+    seg_last = jnp.concatenate(
+        [seg_start[1:], jnp.ones(1, bool)])
+    a = jnp.where(seg_last, idx, n)
+    end_idx = jax.lax.cummin(a[::-1])[::-1]
+    return start_idx, end_idx
+
+
+def _seg_cum(q, start_idx):
+    """Within-segment inclusive cumsum of q (q already 0 where null /
+    invalid): global cumsum minus the value just before the segment
+    start — exact for int64 lanes."""
+    import jax.numpy as jnp
+    c = jnp.cumsum(q)
+    base = jnp.where(start_idx > 0,
+                     c[jnp.clip(start_idx - 1, 0, None)], 0)
+    return c - base
+
+
+def _seg_scan_extreme(v, seg_id, is_min: bool):
+    """Cumulative within-segment min/max via a boundary-respecting
+    associative scan over (segment id, value) pairs."""
+    import jax
+    import jax.numpy as jnp
+
+    def combine(a, b):
+        sa, va = a
+        sb, vb = b
+        same = sa == sb
+        red = jnp.minimum(va, vb) if is_min else jnp.maximum(va, vb)
+        return sb, jnp.where(same, red, vb)
+
+    _, out = jax.lax.associative_scan(combine, (seg_id, v))
+    return out
+
+
+def _build_window_kernel(op_sig: tuple, n_pad: int):
+    """Traceable fn(seg_start, peer_start, valid, vals, nulls) ->
+    tuple of (out, null_mask) per op.  op_sig entries:
+    (head, param, value_dtype|None)."""
+    import jax
+    import jax.numpy as jnp
+
+    def fn(seg_start, peer_start, valid, vals, nulls):
+        n = n_pad
+        idx = jnp.arange(n, dtype=jnp.int32)
+        start_idx, end_idx = _seg_bounds(seg_start, idx, n)
+        new_peer = seg_start | peer_start
+        pstart_idx = jax.lax.cummax(jnp.where(new_peer, idx, -1))
+        # peer-group LAST row: the next row opens a new peer group or
+        # the segment ends here
+        peer_last = jnp.concatenate(
+            [new_peer[1:], jnp.ones(1, bool)]) | (idx == end_idx)
+        a = jnp.where(peer_last, idx, n)
+        pend_idx = jax.lax.cummin(a[::-1])[::-1]
+        seg_id = jnp.cumsum(seg_start.astype(jnp.int32))
+        outs = []
+        vi = 0
+        for head, param, vdt in op_sig:
+            if head in ("row_number", "rank", "dense_rank",
+                        "count_star"):
+                if head == "row_number":
+                    outs.append((idx - start_idx + 1,
+                                 jnp.zeros(n, bool)))
+                elif head == "rank":
+                    outs.append((pstart_idx - start_idx + 1,
+                                 jnp.zeros(n, bool)))
+                elif head == "dense_rank":
+                    d = jnp.cumsum(new_peer.astype(jnp.int32))
+                    outs.append((d - d[jnp.clip(start_idx, 0, None)]
+                                 + 1, jnp.zeros(n, bool)))
+                else:   # count_star
+                    c = _seg_cum(valid.astype(jnp.int64), start_idx)
+                    where_at = pend_idx if param else end_idx
+                    outs.append((c[where_at], jnp.zeros(n, bool)))
+                continue
+            v = vals[vi]
+            vn = nulls[vi]
+            vi += 1
+            if head in ("lag", "lead"):
+                src = idx - param if head == "lag" else idx + param
+                ok = (src >= start_idx) & (src <= end_idx)
+                srcc = jnp.clip(src, 0, n - 1)
+                outs.append((v[srcc], jnp.logical_not(ok) | vn[srcc]))
+                continue
+            nn = (valid & jnp.logical_not(vn))
+            if head in ("sum", "count", "rolling_sum"):
+                q = jnp.where(nn, v, 0).astype(jnp.int64) \
+                    if head != "count" else nn.astype(jnp.int64)
+                c = _seg_cum(q, start_idx)
+                cnt = _seg_cum(nn.astype(jnp.int64), start_idx)
+                if head == "rolling_sum":
+                    # c is the WITHIN-segment cumsum, so the window
+                    # base is just c at lo-1 (same segment when
+                    # lo > start)
+                    lo = jnp.maximum(idx - (param - 1), start_idx)
+                    base = jnp.where(lo > start_idx,
+                                     c[jnp.clip(lo - 1, 0, None)], 0)
+                    val_out = c - base
+                    cbase = jnp.where(lo > start_idx,
+                                      cnt[jnp.clip(lo - 1, 0, None)],
+                                      0)
+                    cnt_out = cnt - cbase
+                elif param:          # cumulative: peers share
+                    val_out = c[pend_idx]
+                    cnt_out = cnt[pend_idx]
+                else:                # whole partition
+                    val_out = c[end_idx]
+                    cnt_out = cnt[end_idx]
+                if head == "count":
+                    outs.append((cnt_out, jnp.zeros(n, bool)))
+                else:
+                    outs.append((val_out, cnt_out == 0))
+                continue
+            if head in ("min", "max"):
+                is_min = head == "min"
+                sent = (jnp.iinfo(v.dtype).max if is_min
+                        else jnp.iinfo(v.dtype).min) \
+                    if jnp.issubdtype(v.dtype, jnp.integer) \
+                    else (jnp.inf if is_min else -jnp.inf)
+                masked = jnp.where(nn, v, sent)
+                cnt = _seg_cum(nn.astype(jnp.int64), start_idx)
+                run = _seg_scan_extreme(masked, seg_id, is_min)
+                if param:            # cumulative: peers share
+                    outs.append((run[pend_idx],
+                                 cnt[pend_idx] == 0))
+                else:
+                    outs.append((run[end_idx], cnt[end_idx] == 0))
+                continue
+            raise ValueError(head)
+        return tuple(outs)
+
+    return jax.jit(fn)
+
+
+def window_bucket(n: int) -> int:
+    from .device_batch import bucket_rows
+    return bucket_rows(max(n, 1))
+
+
+class WindowKernel:
+    """Signature-keyed cache of jitted window-segment kernels."""
+
+    def __init__(self):
+        self._cache: Dict[tuple, object] = {}
+        self.compiles = 0
+
+    def run(self, ops: Sequence[tuple], seg_start: np.ndarray,
+            peer_start: np.ndarray,
+            values: Sequence[Optional[np.ndarray]],
+            value_nulls: Sequence[Optional[np.ndarray]]
+            ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """Run `ops` over ONE sorted row set.  ``ops``: (head, param)
+        tuples aligned with `values` (None for arithmetic-free heads).
+        Rows are padded to the pow2 bucket; returns per-op (values,
+        null_mask) numpy arrays trimmed back to the true length."""
+        import jax.numpy as jnp
+        n = len(seg_start)
+        n_pad = window_bucket(n)
+        valid = np.zeros(n_pad, bool)
+        valid[:n] = True
+        seg = np.zeros(n_pad, bool)
+        seg[:n] = seg_start
+        if n_pad > n:
+            seg[n] = True          # padding is its own segment
+        peer = np.zeros(n_pad, bool)
+        peer[:n] = peer_start
+        vals, nulls, op_sig = [], [], []
+        for op, v, vn in zip(ops, values, value_nulls):
+            head, param = op[0], (op[1] if len(op) > 1 else 0)
+            if head in NO_VALUE_OPS:
+                op_sig.append((head, param, None))
+                continue
+            va = np.zeros(n_pad, v.dtype)
+            va[:n] = v
+            na = np.ones(n_pad, bool)
+            na[:n] = vn if vn is not None else False
+            vals.append(jnp.asarray(va))
+            nulls.append(jnp.asarray(na))
+            op_sig.append((head, param, str(v.dtype)))
+        sig = (tuple(op_sig), n_pad)
+        fn = self._cache.get(sig)
+        if fn is None:
+            fn = _build_window_kernel(tuple(op_sig), n_pad)
+            self._cache[sig] = fn
+            self.compiles += 1
+        WINDOW_STATS["launches"] += 1
+        raw = fn(jnp.asarray(seg), jnp.asarray(peer),
+                 jnp.asarray(valid), tuple(vals), tuple(nulls))
+        return [(np.asarray(o)[:n], np.asarray(m)[:n]) for o, m in raw]
+
+
+_DEFAULT_WINDOW_KERNEL = WindowKernel()
+
+
+def default_window_kernel() -> WindowKernel:
+    return _DEFAULT_WINDOW_KERNEL
+
+
+# ---------------------------------------------------------------------------
+# Numpy twin — parity oracle for the kernel's segment scans
+# ---------------------------------------------------------------------------
+
+def window_cpu(ops: Sequence[tuple], seg_start: np.ndarray,
+               peer_start: np.ndarray,
+               values: Sequence[Optional[np.ndarray]],
+               value_nulls: Sequence[Optional[np.ndarray]]
+               ) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Segment-by-segment numpy replay of the kernel contract."""
+    n = len(seg_start)
+    bounds = list(np.flatnonzero(seg_start)) + [n]
+    outs = []
+    new_peer = seg_start | peer_start
+    for op, v, vn in zip(ops, values, value_nulls):
+        head, param = op[0], (op[1] if len(op) > 1 else 0)
+        if head in NO_VALUE_OPS:
+            v = vn = None
+        else:
+            vn = np.zeros(n, bool) if vn is None else vn
+        out = np.zeros(n, np.int64 if v is None or
+                       v.dtype.kind in "ib" else v.dtype)
+        om = np.zeros(n, bool)
+        for s, e in zip(bounds[:-1], bounds[1:]):
+            idx = np.arange(s, e)
+            peers = np.cumsum(new_peer[s:e]) - 1
+            if head == "row_number":
+                out[s:e] = idx - s + 1
+            elif head == "rank":
+                firsts = np.flatnonzero(new_peer[s:e])
+                out[s:e] = firsts[peers] + 1
+            elif head == "dense_rank":
+                out[s:e] = peers + 1
+            elif head == "count_star":
+                if param:
+                    pend = np.zeros(e - s, np.int64)
+                    last = e - s - 1
+                    for i in range(e - s - 1, -1, -1):
+                        pend[i] = last
+                        if new_peer[s + i]:
+                            last = i - 1
+                    out[s:e] = pend + 1
+                else:
+                    out[s:e] = e - s
+            elif head in ("lag", "lead"):
+                src = idx + (param if head == "lead" else -param)
+                ok = (src >= s) & (src < e)
+                sc = np.clip(src, s, e - 1)
+                out[s:e] = v[sc]
+                om[s:e] = ~ok | vn[sc]
+            elif head in ("sum", "count", "rolling_sum"):
+                nn = ~vn[s:e]
+                q = (np.where(nn, v[s:e], 0).astype(np.int64)
+                     if head != "count" else nn.astype(np.int64))
+                c = np.cumsum(q)
+                cn = np.cumsum(nn.astype(np.int64))
+                if head == "rolling_sum":
+                    lo = np.maximum(idx - s - (param - 1), 0)
+                    base = np.where(lo > 0, c[np.clip(lo - 1, 0, None)],
+                                    0)
+                    cb = np.where(lo > 0, cn[np.clip(lo - 1, 0, None)],
+                                  0)
+                    out[s:e] = c - base
+                    om[s:e] = (cn - cb) == 0
+                elif param:
+                    # cumulative, peers share the peer-group-end value
+                    pend = np.zeros(e - s, np.int64)
+                    last = e - s - 1
+                    for i in range(e - s - 1, -1, -1):
+                        pend[i] = last
+                        if new_peer[s + i]:
+                            last = i - 1
+                    vals_out = c[pend]
+                    cnts = cn[pend]
+                    out[s:e] = cnts if head == "count" else vals_out
+                    om[s:e] = False if head == "count" else cnts == 0
+                else:
+                    out[s:e] = cn[-1] if head == "count" else c[-1]
+                    om[s:e] = False if head == "count" else cn[-1] == 0
+            elif head in ("min", "max"):
+                nn = ~vn[s:e]
+                sel = v[s:e]
+                red = np.minimum if head == "min" else np.maximum
+                sent = (np.iinfo(sel.dtype).max if head == "min"
+                        else np.iinfo(sel.dtype).min) \
+                    if sel.dtype.kind in "iu" else \
+                    (np.inf if head == "min" else -np.inf)
+                masked = np.where(nn, sel, sent)
+                run = red.accumulate(masked)
+                cn = np.cumsum(nn.astype(np.int64))
+                if param:
+                    pend = np.zeros(e - s, np.int64)
+                    last = e - s - 1
+                    for i in range(e - s - 1, -1, -1):
+                        pend[i] = last
+                        if new_peer[s + i]:
+                            last = i - 1
+                    out[s:e] = run[pend]
+                    om[s:e] = cn[pend] == 0
+                else:
+                    out[s:e] = run[-1]
+                    om[s:e] = cn[-1] == 0
+            else:
+                raise ValueError(head)
+        outs.append((out, om))
+    return outs

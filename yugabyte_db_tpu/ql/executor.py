@@ -2170,11 +2170,6 @@ class SqlSession:
         if stmt.where is None:
             return per_table
 
-        def conjuncts(n):
-            if isinstance(n, tuple) and n and n[0] == "and":
-                return conjuncts(n[1]) + conjuncts(n[2])
-            return [n]
-
         def owner_of(names: set) -> Optional[str]:
             owner = None
             for name in names:
@@ -2205,7 +2200,7 @@ class SqlSession:
                     return None
             return owner
 
-        for c in conjuncts(stmt.where):
+        for c in _conjuncts(stmt.where):
             names: set = set()
             self._collect_names(c, names)
             if not names:
@@ -2420,6 +2415,9 @@ class SqlSession:
         self._maybe_reorder_joins(stmt)   # labels survive the reorder
         lbl0 = stmt.table_alias or stmt.table
         pushed = self._join_pushdown(stmt)
+        fused = await self._try_fused_join(stmt, pushed, real_of)
+        if fused is not None:
+            return fused
 
         # a name bound by the current WITH scope reads the CTE rowset;
         # pg_catalog/information_schema names materialize virtual rows
@@ -2573,6 +2571,245 @@ class SqlSession:
             out.append(row)
         return SqlResult(self._order_limit(stmt, out))
 
+    # --- fused join+group+aggregate pushdown (ops/plan_fusion.py) -------
+    class _NoFuse(Exception):
+        pass
+
+    async def _try_fused_join(self, stmt: SelectStmt, pushed,
+                              real_of) -> Optional[SqlResult]:
+        """Push a single INNER FK-equijoin + GROUP BY + aggregates down
+        as ONE fused plan: the (filtered) build side ships with the
+        probe-table scan request and the whole
+        filter->probe->gather->group->aggregate shape runs as one
+        device program per tablet (ops/plan_fusion.py), partials
+        combining through the ordinary grouped fan-out combine.  The
+        operator-at-a-time client join stays the path for every shape
+        this doesn't cover (None return), and `plan_fusion_enabled`
+        off restores it wholesale."""
+        if not (flags.get("plan_fusion_enabled")
+                and flags.get("join_pushdown_enabled")):
+            return None
+        if len(stmt.joins) != 1 or stmt.joins[0].kind != "inner":
+            return None
+        if getattr(stmt, "having", None) is not None \
+                or getattr(stmt, "distinct", False) \
+                or getattr(stmt, "group_exprs", None):
+            return None
+        from .pg_catalog import is_virtual
+        lbl0 = stmt.table_alias or stmt.table
+        jc = stmt.joins[0]
+        jlabel = jc.alias or jc.table
+        probe_t = real_of.get(lbl0, lbl0)
+        build_t = real_of.get(jlabel, jlabel)
+        for tname in (probe_t, build_t):
+            if tname in self._cte_rows or is_virtual(tname):
+                return None
+        if self._txn is not None and (
+                self._txn.pending_writes(probe_t)
+                or self._txn.pending_writes(build_t)):
+            return None       # write-set overlay can't patch partials
+        psch = self._join_schemas.get(lbl0)
+        bsch = self._join_schemas.get(jlabel)
+        if psch is None or bsch is None:
+            return None
+        agg_items = [(i, it) for i, it in enumerate(stmt.items)
+                     if it[0] == "agg"]
+        if not agg_items or any(it[0] not in ("agg", "col")
+                                for it in stmt.items):
+            return None
+        if any(it[1] not in ("sum", "count", "min", "max", "avg")
+               for _, it in agg_items):
+            return None
+        gset = {self._split_qual(g)[1] for g in stmt.group_by}
+        for i, it in enumerate(stmt.items):
+            if it[0] == "col" and self._split_qual(it[1])[1] not in gset:
+                return None
+        # the WHERE must split entirely into single-side conjuncts
+        # (cross-table residuals need the materialized join) — the
+        # SAME splitter _join_pushdown used, so the totality check
+        # counts exactly what was pushed
+        if stmt.where is not None:
+            total = len(_conjuncts(stmt.where))
+            if sum(len(v) for v in pushed.values()) != total:
+                return None
+        if any(lbl not in (lbl0, jlabel) for lbl in pushed):
+            return None
+
+        def _has(sch, bare):
+            try:
+                return sch.column_by_name(bare)
+            except Exception:  # noqa: BLE001 — not this table
+                return None
+
+        def side_of(name):
+            q, bare = self._split_qual(name)
+            pc, bc = _has(psch, bare), _has(bsch, bare)
+            if q == lbl0 or (q is None and pc is not None
+                             and bc is None):
+                return ("p", pc) if pc is not None else None
+            if q == jlabel or (q is None and bc is not None
+                               and pc is None):
+                return ("b", bc) if bc is not None else None
+            return None
+
+        from ..ops.join_scan import BUILD_COL_BASE, JoinWire
+        payload_ids: Dict[str, int] = {}
+        agg_payload: set = set()
+
+        def bind_mixed(n, in_agg=False):
+            if not isinstance(n, tuple):
+                return n
+            if n[0] == "col":
+                s = side_of(n[1])
+                if s is None:
+                    raise self._NoFuse()
+                side, col = s
+                if side == "p":
+                    if col.type == ColumnType.DECIMAL:
+                        # mirror _bind: DECIMAL stores as text — wrap
+                        # so the (interpreted) evaluator converts; the
+                        # device path declines fn nodes and falls back
+                        return ("fn", "cast_numeric", ("col", col.id))
+                    return ("col", col.id)
+                if col.type == ColumnType.DECIMAL:
+                    raise self._NoFuse()   # payload can't ship decimals
+                bid = payload_ids.setdefault(
+                    col.name, BUILD_COL_BASE + len(payload_ids))
+                if in_agg:
+                    agg_payload.add(col.name)
+                return ("col", bid)
+            if n[0] == "const":
+                return n
+            if n[0] == "fn" and n[1] == "now":
+                # mirror _bind: statement-stable clock read, folded at
+                # bind time (never per-row on the server)
+                import time as _time
+                return ("const", int(_time.time() * 1_000_000))
+            if n[0] in ("in", "like", "ilike", "dictlut"):
+                return (n[0], bind_mixed(n[1], in_agg)) + tuple(n[2:])
+            return (n[0],) + tuple(
+                bind_mixed(c, in_agg) if isinstance(c, tuple) else c
+                for c in n[1:])
+
+        try:
+            # join keys: one column per side, either written order
+            s_l, s_r = side_of(jc.left_col), side_of(jc.right_col)
+            if s_l is None or s_r is None or s_l[0] == s_r[0]:
+                return None
+            (probe_key, build_key) = (
+                (s_l[1], s_r[1]) if s_l[0] == "p" else (s_r[1], s_l[1]))
+            aggs = []
+            for _i, it in agg_items:
+                if it[2] is None:
+                    aggs.append(AggSpec("count"))
+                else:
+                    aggs.append(AggSpec(it[1], bind_mixed(it[2],
+                                                          in_agg=True)))
+            gcols = []
+            gmeta = []
+            for g in stmt.group_by:
+                s = side_of(g)
+                if s is None or s[1].type != ColumnType.STRING:
+                    return None     # dict-group shape: string keys only
+                side, col = s
+                if side == "p":
+                    gcols.append(col.id)
+                else:
+                    gcols.append(payload_ids.setdefault(
+                        col.name, BUILD_COL_BASE + len(payload_ids)))
+                gmeta.append(col)
+            pw = None
+            for c in pushed.get(lbl0, ()):
+                pw = c if pw is None else ("and", pw, c)
+            pwhere = bind_mixed(pw) if pw is not None else None
+        except self._NoFuse:
+            return None
+        # payload columns referenced by AGGREGATES must be numeric —
+        # string payloads ride as dictionary codes, which only group
+        # keys may consume (an aggregate over codes would be garbage)
+        _numeric = (ColumnType.INT32, ColumnType.INT64,
+                    ColumnType.TIMESTAMP, ColumnType.BOOL,
+                    ColumnType.FLOAT64)
+        for name in agg_payload:
+            if _has(bsch, name).type not in _numeric:
+                return None
+        # join KEYS must be exactly representable as int64 or strings —
+        # FLOAT64 keys would truncate under int() and silently change
+        # which rows match; the classic client join owns float keys
+        if build_key.type not in (ColumnType.INT32, ColumnType.INT64,
+                                  ColumnType.TIMESTAMP, ColumnType.BOOL,
+                                  ColumnType.STRING):
+            return None
+        # --- fetch + ship the (filtered) build side -------------------
+        # the probe's txn read point applies to the build scan too —
+        # a mixed-snapshot join (build at latest, probe at start_ht)
+        # could produce a row set no single snapshot contains
+        read_ht = self._txn.start_ht if self._txn is not None else None
+        bw = None
+        for c in pushed.get(jlabel, ()):
+            bw = c if bw is None else ("and", bw, c)
+        bwhere = self._bind(bw, bsch) if bw is not None else None
+        bcols = tuple({build_key.name, *payload_ids})
+        bresp = await self.client.scan(
+            build_t, ReadRequest("", columns=bcols, where=bwhere,
+                                 read_ht=read_ht))
+        keys, prows = [], []
+        for r in bresp.rows:
+            k = r.get(build_key.name)
+            if k is None:
+                continue              # NULL keys can never inner-match
+            keys.append(k)
+            prows.append(r)
+        if len(set(keys)) != len(keys):
+            return None   # duplicate build keys multiply rows: the
+            #               materialized client join owns that shape
+        if build_key.type == ColumnType.STRING:
+            keys_arr = np.asarray(keys, object)
+        else:
+            keys_arr = np.asarray([int(k) for k in keys], np.int64)
+        payload = {}
+        for name, bid in payload_ids.items():
+            col = _has(bsch, name)
+            vals = [r.get(name) for r in prows]
+            nulls = np.asarray([v is None for v in vals], bool)
+            if col.type == ColumnType.STRING:
+                arr = np.asarray([v if v is not None else ""
+                                  for v in vals], object)
+            elif col.type == ColumnType.FLOAT64:
+                arr = np.asarray([v if v is not None else 0.0
+                                  for v in vals], np.float64)
+            else:
+                arr = np.asarray([int(v) if v is not None else 0
+                                  for v in vals], np.int64)
+            payload[bid] = (arr, nulls)
+        wire = JoinWire(probe_col=probe_key.id, keys=keys_arr,
+                        payload=payload)
+        group = DictGroupSpec(
+            cols=tuple(gcols),
+            max_slots=int(flags.get("grouped_max_slots"))) \
+            if gcols else None
+        resp = await self.client.scan(probe_t, ReadRequest(
+            "", where=pwhere, aggregates=tuple(aggs), group_by=group,
+            read_ht=read_ht, join=wire))
+        # --- format: mirror of the grouped-pushdown row builder -------
+        if group is None:
+            return SqlResult(
+                [self._agg_row(stmt, list(resp.agg_values or ()))])
+        counts = np.asarray(resp.group_counts) \
+            if resp.group_counts is not None else np.zeros(0, np.int64)
+        gmap = self._group_out_map(stmt)
+        rows = []
+        for g in np.nonzero(counts)[0]:
+            row = {}
+            for j, name in enumerate(stmt.group_by):
+                v = np.asarray(resp.group_values[j])[g]
+                v = v.item() if isinstance(v, np.generic) else v
+                self._put_group_value(gmap, row, name, str(v))
+            gvals = [np.asarray(v)[g] for v in resp.agg_values]
+            row.update(self._agg_row(stmt, gvals))
+            rows.append(row)
+        return SqlResult(self._order_limit(stmt, rows))
+
     # --- window functions (client-side; reference: PG WindowAgg) --------
     def _apply_windows(self, stmt: SelectStmt, rows: List[dict]) -> None:
         """Compute window items and attach each value to its row under
@@ -2580,7 +2817,19 @@ class SqlSession:
         LAG/LEAD, and SUM/COUNT/MIN/MAX/AVG OVER (PARTITION BY ...
         [ORDER BY ...]); ordered aggregates use PG's default frame
         (RANGE UNBOUNDED PRECEDING .. CURRENT ROW: peers share the
-        cumulative value)."""
+        cumulative value).
+
+        Eligible shapes route through the vectorized segment-scan
+        window kernels (ops/window_scan.py, window_pushdown_enabled):
+        one np.lexsort replaces the per-partition Python sorts and the
+        rank/lag/frame loops become cummax/cumsum scans.  The device
+        hook only takes shapes it can answer BIT-identically to this
+        Python path (arithmetic-free functions, exact-integer SUM
+        lanes, NULL-free partition/order keys) — everything else stays
+        here."""
+        if flags.get("window_pushdown_enabled") and rows:
+            if self._apply_windows_device(stmt, rows):
+                return
         import functools
         for i, it in enumerate(stmt.items):
             if it[0] != "window":
@@ -2649,6 +2898,146 @@ class SqlSession:
                             k = e + 1
                 else:
                     raise ValueError(f"unknown window function {fn}")
+
+    def _apply_windows_device(self, stmt: SelectStmt,
+                              rows: List[dict]) -> bool:
+        """Kernel route for window items (ops/window_scan.py): ONE
+        np.lexsort per (partition, order) spec, then every function is
+        a vectorized segment scan.  Takes the statement only when EVERY
+        item is eligible for a bit-identical answer (never splits a
+        statement across paths): supported function, NULL/NaN-free
+        partition+order keys of one orderable type, exact-integer value
+        lanes for arithmetic frames.  Returns False untaken."""
+        from ..ops.window_scan import default_window_kernel
+        witems = [(i, it) for i, it in enumerate(stmt.items)
+                  if it[0] == "window"]
+        n = len(rows)
+
+        def codes_of(vals):
+            kinds = {type(v) for v in vals}
+            if kinds <= {int, bool}:
+                arr = np.asarray([int(v) for v in vals], np.int64)
+            elif kinds <= {int, bool, float}:
+                arr = np.asarray([float(v) for v in vals], np.float64)
+                if np.isnan(arr).any():
+                    return None
+            elif kinds == {str}:
+                arr = np.asarray(vals)
+            else:
+                return None
+            uniq, codes = np.unique(arr, return_inverse=True)
+            return codes.astype(np.int64), len(uniq)
+
+        by_spec: Dict[tuple, list] = {}
+        for i, it in witems:
+            _, fn, expr, partition, worder, args = it
+            by_spec.setdefault(
+                (tuple(partition or ()), tuple(worder or ())),
+                []).append((i, fn, expr, args))
+        plans = []
+        for (partition, worder), items in by_spec.items():
+            pkeys, okeys = [], []
+            for cname in partition:
+                vals = [r.get(cname) for r in rows]
+                if any(v is None for v in vals):
+                    return False
+                got = codes_of(vals)
+                if got is None:
+                    return False
+                pkeys.append(got[0])
+            for cname, desc in worder:
+                vals = [r.get(cname) for r in rows]
+                if any(v is None for v in vals):
+                    return False
+                got = codes_of(vals)
+                if got is None:
+                    return False
+                codes, nu = got
+                okeys.append((nu - 1 - codes) if desc else codes)
+            ops, values, nulls, metas = [], [], [], []
+            for i, fn, expr, args in items:
+                name = self._item_name(stmt, i)
+                if fn in ("row_number", "rank", "dense_rank"):
+                    ops.append((fn,))
+                    values.append(None)
+                    nulls.append(None)
+                elif fn in ("lag", "lead"):
+                    off = int(args[0]) if args else 1
+                    if expr is None or off < 0:
+                        return False
+                    vals = [_eval_by_name(expr, r) for r in rows]
+                    kinds = {type(v) for v in vals if v is not None}
+                    if kinds <= {int}:
+                        arr = np.asarray(
+                            [0 if v is None else int(v) for v in vals],
+                            np.int64)
+                    elif kinds <= {int, float}:
+                        arr = np.asarray(
+                            [0.0 if v is None else float(v)
+                             for v in vals], np.float64)
+                    else:
+                        return False
+                    ops.append((fn, off))
+                    values.append(arr)
+                    nulls.append(np.asarray([v is None for v in vals],
+                                            bool))
+                elif fn in ("sum", "count", "min", "max"):
+                    cum = 1 if worder else 0
+                    if expr is None:
+                        if fn != "count":
+                            return False
+                        ops.append(("count_star", cum))
+                        values.append(None)
+                        nulls.append(None)
+                        metas.append((i, fn, name))
+                        continue
+                    vals = [_eval_by_name(expr, r) for r in rows]
+                    kinds = {type(v) for v in vals if v is not None}
+                    if fn == "count":
+                        arr = np.zeros(n, np.int64)   # mask-only lane
+                    elif kinds <= {int, bool}:
+                        # exact int64 segment sums/extremes — the ONLY
+                        # arithmetic lanes whose kernel answer is
+                        # bit-identical to the Python fold
+                        arr = np.asarray(
+                            [0 if v is None else int(v) for v in vals],
+                            np.int64)
+                    else:
+                        return False
+                    ops.append((fn, cum))
+                    values.append(arr)
+                    nulls.append(np.asarray([v is None for v in vals],
+                                            bool))
+                else:
+                    return False
+                metas.append((i, fn, name))
+            plans.append((pkeys, okeys, ops, values, nulls, metas))
+        kern = default_window_kernel()
+        for pkeys, okeys, ops, values, nulls, metas in plans:
+            keys = pkeys + okeys
+            perm = (np.lexsort(tuple(reversed(keys))) if keys
+                    else np.arange(n))
+            seg = np.zeros(n, bool)
+            if n:
+                seg[0] = True
+            for kk in pkeys:
+                ks = kk[perm]
+                seg[1:] |= ks[1:] != ks[:-1]
+            peer = np.zeros(n, bool)
+            for kk in okeys:
+                ks = kk[perm]
+                peer[1:] |= ks[1:] != ks[:-1]
+            svalues = [None if v is None else v[perm] for v in values]
+            snulls = [None if m is None else m[perm] for m in nulls]
+            outs = kern.run(ops, seg, peer, svalues, snulls)
+            for (ov, om), (_i, _fn, name) in zip(outs, metas):
+                is_f = ov.dtype.kind == "f"
+                for k in range(n):
+                    ri = int(perm[k])
+                    rows[ri][name] = (
+                        None if om[k] else
+                        float(ov[k]) if is_f else int(ov[k]))
+        return True
 
     @staticmethod
     def _window_agg(fn, vals, expr, nrows):
@@ -3723,6 +4112,16 @@ def _dequalify_stmt(stmt, quals: set) -> None:
                             for g, ast in stmt.group_exprs.items()}
     stmt.order_by = [(_dequalify_name(n, quals), d)
                      for n, d in stmt.order_by]
+
+
+def _conjuncts(n):
+    """Flatten a WHERE tree into its top-level AND conjuncts — THE one
+    splitter shared by _join_pushdown and _try_fused_join, so the
+    fused path's 'every conjunct was pushed' totality check counts
+    exactly what the pushdown classifier saw."""
+    if isinstance(n, tuple) and n and n[0] == "and":
+        return _conjuncts(n[1]) + _conjuncts(n[2])
+    return [n]
 
 
 def _strip_qualifiers(node):

@@ -204,6 +204,46 @@ DEFINE_RUNTIME("grouped_max_slots", 4096,
                "optimistically: rows landing in the spill slot are "
                "counted and a nonzero spill reverts the whole scan to "
                "the interpreted GROUP BY.")
+DEFINE_RUNTIME("join_pushdown_enabled", True,
+               "Serve FK-equijoin aggregate requests (ReadRequest.join) "
+               "on the device hash-join kernel (ops/join_scan.py): the "
+               "shipped build side becomes a pow2-bucket open-addressed "
+               "table, the probe runs inside the scan program, and "
+               "build-side payload columns gather by match index. Off "
+               "— or any shape the kernel cannot serve exactly "
+               "(duplicate build keys, oversized build side, "
+               "incompatible expressions) — reverts to the interpreted "
+               "row-at-a-time join path, byte-for-byte the pre-device "
+               "semantics.")
+DEFINE_RUNTIME("plan_fusion_enabled", True,
+               "Compile whole filter->join->group->aggregate plan "
+               "shapes into ONE jitted device program per canonical "
+               "plan signature (ops/plan_fusion.py). Off keeps every "
+               "operator its own program + host round-trip (the "
+               "operator-at-a-time path): the SQL tier stops pushing "
+               "joins down and executes them client-side.")
+DEFINE_RUNTIME("window_pushdown_enabled", True,
+               "Evaluate eligible window functions (row_number/rank/"
+               "dense_rank/lag/lead and exact-integer SUM frames) "
+               "through the vectorized segment-scan window kernels "
+               "(ops/window_scan.py) instead of the row-at-a-time "
+               "Python loop. Ineligible shapes (float arithmetic "
+               "frames, NULL partition/order keys, unsupported "
+               "functions) always fall back; off forces the Python "
+               "path.")
+DEFINE_RUNTIME("join_max_build_slots", 65536,
+               "Pow2 cap on the device hash-join build table (slots = "
+               "smallest pow2 >= 2x build rows, so load factor stays "
+               "<= 0.5). Build sides needing more slots fall back to "
+               "the interpreted join with a typed reason.")
+DEFINE_RUNTIME("grouped_spill_merge_enabled", True,
+               "Partial-spill merge for over-cardinality device GROUP "
+               "BYs: slots below the spill slot keep their (exact) "
+               "device partials, rows that landed in the spill slot "
+               "re-aggregate on the interpreted tail, and the two "
+               "partials combine through combine_grouped_partials — "
+               "so slot overflow no longer pays a full interpreted "
+               "re-scan. Off reverts to the full re-scan fallback.")
 DEFINE_RUNTIME("hash_scan_enumerate_max", 1024,
                "Max enumerable key-target count for rewriting a "
                "short range/IN scan over a single-integer-hash-PK "
