@@ -766,19 +766,59 @@ def cluster_overload_bench():
             def med(rounds, key):
                 vals = sorted(r[key] for r in rounds)
                 return vals[len(vals) // 2]
+
+            # --- per-round ASH deltas (p99 attribution) ------------
+            # every measured round brackets a tracez sweep of the live
+            # tservers; the per-state CUMULATIVE tallies diff into a
+            # wait-state delta for that round, so an over-spread p99
+            # gets labeled with its dominant wait instead of being
+            # "flush-pause luck" (cluster_p99_attribution below)
+            from yugabyte_db_tpu.cluster.collector import (
+                attribute_rounds, merge_ash_cumulative)
+
+            async def ash_cum():
+                dumps = []
+                for nm in sup.tserver_names():
+                    if not sup.procs[nm].alive():
+                        continue
+                    try:
+                        dumps.append(await sup.call(
+                            nm, "tserver", "tracez", {}, timeout=10.0))
+                    except Exception:   # noqa: BLE001 — a draining
+                        continue        # peer drops out of the diff
+                return merge_ash_cumulative(dumps)
+
+            attr_rounds = []
+
+            async def attributed(tag, factory):
+                pre = await ash_cum()
+                r = await factory()
+                post = await ash_cum()
+                delta = {s: post.get(s, 0) - pre.get(s, 0)
+                         for s in post
+                         if post.get(s, 0) > pre.get(s, 0)}
+                attr_rounds.append({"tag": tag, "p99_ms": r["p99_ms"],
+                                    "wait_delta": delta})
+                return r
+
             bases, byps, rpcs = [], [], []
             byp_stats = {"rounds": 0, "errors": 0, "last": None,
                          "last_error": None}
             rpc_stats = {"rounds": 0, "errors": 0, "last": None,
                          "last_error": None}
             for i in range(3):
-                bases.append(await phase(f"bypbase{i}",
-                                         rate_=byp_rate,
-                                         seconds=byp_dur))
-                byps.append(await measured_round(
-                    f"bypload{i}", _byp_call, byp_stats))
-                rpcs.append(await measured_round(
-                    f"rpcload{i}", _rpc_call, rpc_stats))
+                bases.append(await attributed(
+                    f"bypbase{i}",
+                    lambda i=i: phase(f"bypbase{i}", rate_=byp_rate,
+                                      seconds=byp_dur)))
+                byps.append(await attributed(
+                    f"bypload{i}",
+                    lambda i=i: measured_round(f"bypload{i}",
+                                               _byp_call, byp_stats)))
+                rpcs.append(await attributed(
+                    f"rpcload{i}",
+                    lambda i=i: measured_round(f"rpcload{i}",
+                                               _rpc_call, rpc_stats)))
             out["bypass_from_replica"] = {
                 "replica_process": victim,
                 "leader_process": leader_name,
@@ -828,6 +868,11 @@ def cluster_overload_bench():
                 "rpc_scan_p95_impact": round(
                     med(rpcs, "p95_ms")
                     / max(med(bases, "p95_ms"), 1e-9), 3)}
+            # every round whose p99 exceeds the 3x spread gate gets
+            # its dominant wait state (flush/fsync/queue/compile/
+            # lock/cpu) — the ISSUE 14 acceptance key
+            out["cluster_p99_attribution"] = attribute_rounds(
+                attr_rounds, spread_gate=3.0)
             return out
         finally:
             await sup.shutdown()
@@ -1568,6 +1613,125 @@ def tpch_join_bench(data, repeats):
         flags.REGISTRY.reset("streaming_chunk_rows")
 
 
+def trace_overhead_bench():
+    """The observability layer must not tax the hot path it observes
+    (ISSUE 14 acceptance: headline rates within 2% with tracing at
+    default sampling).  Paired interleaved rounds through the REAL RPC
+    path (MiniCluster): YCSB-shaped point read/write ops and a
+    Q6-shaped aggregate scan, measured with trace_sampling_rate=0 vs
+    the flag DEFAULT (plus the ASH sampler thread running, as in a
+    real server).  `trace_ycsb_on_vs_off` / `trace_q6_on_vs_off` are
+    best-of-round ratios WARN-wired below 0.98.  BENCH_TRACE_S=0
+    skips."""
+    import asyncio
+
+    dur = float(os.environ.get("BENCH_TRACE_S", "1.0"))
+    if dur <= 0:
+        return None
+
+    async def run():
+        from yugabyte_db_tpu.docdb.operations import ReadRequest, RowOp
+        from yugabyte_db_tpu.docdb.table_codec import TableInfo
+        from yugabyte_db_tpu.dockv.packed_row import (
+            ColumnSchema, ColumnType, TableSchema)
+        from yugabyte_db_tpu.dockv.partition import PartitionSchema
+        from yugabyte_db_tpu.ops.scan import AggSpec
+        from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+        from yugabyte_db_tpu.utils import flags as _flags
+        from yugabyte_db_tpu.utils.trace import ASH
+
+        info = TableInfo("", "tracebench", TableSchema(columns=(
+            ColumnSchema(0, "k", ColumnType.INT64, is_hash_key=True),
+            ColumnSchema(1, "v", ColumnType.FLOAT64)), version=1),
+            PartitionSchema("hash", 1))
+        mc = await MiniCluster(tempfile.mkdtemp(prefix="ybtpu-trace-"),
+                               num_tservers=1).start()
+        default_rate = None
+        try:
+            c = mc.client()
+            await c.create_table(info, num_tablets=1,
+                                 replication_factor=1)
+            await mc.wait_for_leaders("tracebench")
+            n_rows = 50_000
+            for lo in range(0, n_rows, 5000):
+                await c.insert("tracebench", [
+                    {"k": i, "v": float(i)}
+                    for i in range(lo, lo + 5000)])
+            agg_req = ReadRequest(
+                (await c._table("tracebench")).info.table_id,
+                aggregates=(AggSpec("count"), AggSpec("sum", ("col", 1))))
+            # the sampler thread runs during BOTH sides (a real server
+            # always has it); only root sampling is toggled
+            ASH.start()
+
+            async def ycsb_round():
+                ops = 0
+                stop = time.monotonic() + dur
+
+                async def worker(base):
+                    nonlocal ops
+                    i = base
+                    while time.monotonic() < stop:
+                        if i % 4 == 0:
+                            await c.write("tracebench", [RowOp(
+                                "upsert", {"k": i % n_rows,
+                                           "v": float(i)})])
+                        else:
+                            await c.get("tracebench",
+                                        {"k": i % n_rows})
+                        ops += 1
+                        i += 7
+                t0 = time.perf_counter()
+                await asyncio.gather(*[worker(j * 131) for j in range(8)])
+                return ops / (time.perf_counter() - t0)
+
+            async def q6_round():
+                scans = 0
+                t0 = time.perf_counter()
+                while time.perf_counter() - t0 < dur:
+                    await c.scan("tracebench", agg_req)
+                    scans += 1
+                return scans * n_rows / (time.perf_counter() - t0)
+
+            default_rate = _flags.REGISTRY._flags[
+                "trace_sampling_rate"].default
+            sides = {"off": 0.0, "on": default_rate}
+            res = {"off": {"ycsb": [], "q6": []},
+                   "on": {"ycsb": [], "q6": []}}
+            # warm both paths (kernel compile + connection setup)
+            await ycsb_round()
+            await q6_round()
+            for _ in range(2):          # paired, interleaved
+                for side, rate in sides.items():
+                    _flags.set_flag("trace_sampling_rate", rate)
+                    res[side]["ycsb"].append(await ycsb_round())
+                    res[side]["q6"].append(await q6_round())
+            return {
+                "seconds_per_round": dur,
+                "default_sampling_rate": default_rate,
+                "ycsb_ops_per_s_off": round(max(res["off"]["ycsb"]), 1),
+                "ycsb_ops_per_s_on": round(max(res["on"]["ycsb"]), 1),
+                "q6_rows_per_s_off": round(max(res["off"]["q6"]), 1),
+                "q6_rows_per_s_on": round(max(res["on"]["q6"]), 1),
+                "trace_ycsb_on_vs_off": round(
+                    max(res["on"]["ycsb"]) / max(res["off"]["ycsb"]), 3),
+                "trace_q6_on_vs_off": round(
+                    max(res["on"]["q6"]) / max(res["off"]["q6"]), 3),
+            }
+        finally:
+            from yugabyte_db_tpu.utils import flags as _flags2
+            if default_rate is not None:
+                _flags2.set_flag("trace_sampling_rate", default_rate)
+            await mc.shutdown()
+
+    try:
+        return asyncio.run(run())
+    except Exception as e:   # noqa: BLE001 — report, don't fail bench
+        if os.environ.get("BENCH_DEBUG"):
+            raise
+        return {"error": str(e)[:300]}
+
+
 # ratio keys whose value < 1.0 means "slower than the baseline it was
 # measured against" — surfaced as a WARN in the bench tail instead of
 # sitting silently inside the JSON (satellite of PR 3; Q6's r05
@@ -1581,7 +1745,8 @@ _RATIO_KEYS = ("vs_baseline", "speedup", "vs_cpu", "vs_xla",
                "cluster_bypass_p95_impact", "cluster_p99_on_vs_off",
                "cluster_achieved_on_vs_off", "cluster_p99_spread",
                "cluster_fused_p99_on_vs_off",
-               "cluster_fused_achieved_on_vs_off")
+               "cluster_fused_achieved_on_vs_off",
+               "trace_ycsb_on_vs_off", "trace_q6_on_vs_off")
 
 #: keys where ANY nonzero value is a regression (acked data vanished
 #: or corrupted across a chaos round — never acceptable)
@@ -1639,6 +1804,12 @@ def warn_regressed_ratios(node, path="", out=None):
                     # goodput through a live split+rebalance may dip,
                     # but collapsing past 4x is a control-plane stall
                     bad = v < 0.25
+                elif k in ("trace_ycsb_on_vs_off",
+                           "trace_q6_on_vs_off"):
+                    # tracing at DEFAULT sampling may cost at most 2%
+                    # of the hot path it observes (ISSUE 14 overhead
+                    # gate; 0.98 = the 2% bar)
+                    bad = v < 0.98
                 else:
                     bad = v < 1.0
                 if bad:
@@ -2193,6 +2364,13 @@ def main():
     if co is not None:
         results["cluster_overload"] = co
 
+    # observability overhead gate: headline YCSB/Q6 rates through the
+    # RPC path with tracing at default sampling vs off (BENCH_TRACE_S
+    # bounds each round, 0 skips; ratios WARN below 0.98)
+    tr = trace_overhead_bench()
+    if tr is not None:
+        results["trace_overhead"] = tr
+
     # TPC-C-style NEW-ORDER/PAYMENT through REAL distributed txns on an
     # in-process cluster (reference headline bench; tpmC here is the
     # UNCONSTRAINED NewOrder rate — no spec think times). BENCH_TPCC_S
@@ -2392,6 +2570,8 @@ def main():
            if "ycsb_overload" in results else {}),
         **({"cluster_overload": results["cluster_overload"]}
            if "cluster_overload" in results else {}),
+        **({"trace_overhead": results["trace_overhead"]}
+           if "trace_overhead" in results else {}),
         **({"bypass_scan": results["bypass_scan"]}
            if "bypass_scan" in results else {}),
         "driver_conformance": driver_conf,

@@ -84,6 +84,40 @@ def profile_json() -> dict:
                      "seconds": phase_s, "sla_ms": 2000,
                      "tag": label}, timeout=120.0)
 
+            # --- trace_overhead: paired sampled-on/off phases --------
+            # the ISSUE 14 overhead gate across REAL processes: the
+            # same 1x phase with trace_sampling_rate=0 vs the default
+            # on every tserver (the ASH sampler thread always runs in
+            # server_main), interleaved, best-of.  WARN at >2% cost.
+            from yugabyte_db_tpu.utils import flags as _flags
+            default_rate = _flags.REGISTRY._flags[
+                "trace_sampling_rate"].default
+            t_res = {"off": [], "on": []}
+            for i in range(2):
+                for side, rate in (("off", 0.0), ("on", default_rate)):
+                    await sup.set_flag_all("trace_sampling_rate", rate,
+                                           roles=("tserver",))
+                    ph = await sup.call(
+                        "drv-0", "driver", "run_phase",
+                        {"rate": min(sat, 4000.0), "seconds": phase_s,
+                         "sla_ms": 2000, "tag": f"trace-{side}{i}"},
+                        timeout=120.0)
+                    t_res[side].append(ph["achieved_ops_per_s"])
+            await sup.set_flag_all("trace_sampling_rate", default_rate,
+                                   roles=("tserver",))
+            ratio = round(max(t_res["on"])
+                          / max(max(t_res["off"]), 1e-9), 3)
+            out["trace_overhead"] = {
+                "default_sampling_rate": default_rate,
+                "achieved_ops_per_s_off": round(max(t_res["off"]), 1),
+                "achieved_ops_per_s_on": round(max(t_res["on"]), 1),
+                "on_vs_off": ratio,
+            }
+            if ratio < 0.98:
+                print(f"WARN: cluster trace_overhead on_vs_off={ratio} "
+                      "— tracing at default sampling costs >2% of "
+                      "cluster goodput", file=sys.stderr)
+
             # drain vs crash-restart walls
             t0 = time.perf_counter()
             code = await sup.stop("ts-0", drain=True)

@@ -139,6 +139,51 @@ async def rpc_profile() -> dict:
         stats = await c.messenger.call(
             mc.tservers[0].messenger.addr, "tserver",
             "scheduler_stats", {})
+
+        # --- trace_overhead: paired sampled-on/off read rounds -------
+        # the ISSUE 14 overhead gate in profile form: point reads at
+        # default sampling vs sampling off, interleaved, best-of; the
+        # ASH sampler thread runs on both sides (a real server always
+        # has it).  WARN at >2% cost.
+        from yugabyte_db_tpu.utils import flags as _flags
+        from yugabyte_db_tpu.utils.trace import ASH
+        ASH.start()
+        t_ops = max(500, ops // 4)
+        t_keys = rng.integers(0, n_rows, t_ops)
+
+        async def trace_round():
+            async def w(sl):
+                for k in sl:
+                    await c.get("usertable", {"ycsb_key": int(k)})
+            t0 = time.perf_counter()
+            await asyncio.gather(*[
+                w(t_keys[i::clients]) for i in range(clients)])
+            return t_ops / (time.perf_counter() - t0)
+
+        default_rate = _flags.REGISTRY._flags[
+            "trace_sampling_rate"].default
+        rates = {"off": 0.0, "on": default_rate}
+        t_res = {"off": [], "on": []}
+        try:
+            for _ in range(2):
+                for side, rate in rates.items():
+                    _flags.set_flag("trace_sampling_rate", rate)
+                    t_res[side].append(await trace_round())
+        finally:
+            _flags.set_flag("trace_sampling_rate", default_rate)
+        trace_overhead = {
+            "ops_per_round": t_ops,
+            "default_sampling_rate": default_rate,
+            "read_ops_per_s_off": round(max(t_res["off"]), 1),
+            "read_ops_per_s_on": round(max(t_res["on"]), 1),
+            "on_vs_off": round(max(t_res["on"]) / max(t_res["off"]), 3),
+        }
+        if trace_overhead["on_vs_off"] < 0.98:
+            print(f"WARN: trace_overhead on_vs_off="
+                  f"{trace_overhead['on_vs_off']} — tracing at default "
+                  "sampling costs >2% of the read hot path",
+                  file=sys.stderr)
+
         return {
             "metric": "ycsb_rpc_profile",
             "clients": clients,
@@ -147,6 +192,7 @@ async def rpc_profile() -> dict:
             "agg_scans_per_s": round(32 / scan_s, 1),
             "write_path": write_path,
             "scheduler": stats,
+            "trace_overhead": trace_overhead,
             "bulk_load": bulk_load_profile(),
             "grouped_scan": grouped_scan_profile(),
         }

@@ -793,6 +793,110 @@ class TestLayering:
 
 # --- interprocedural: the call graph itself --------------------------------
 
+class TestTraceDiscipline:
+    """wait_status() states are a closed vocabulary: every call-site
+    literal must come from the canonical trace.WAIT_STATES table (a
+    typo'd state silently vanishes from every ASH histogram)."""
+
+    TABLE = """\
+        WAIT_STATES = frozenset({
+            "Idle",
+            "WAL_Fsync",
+            "Flush_SstWrite",
+        })
+        def wait_status(state, component=""):
+            pass
+        """
+
+    def _run_with_table(self, tmp_path, files):
+        import textwrap as _tw
+        files = dict(files)
+        files["yugabyte_db_tpu/utils/trace.py"] = self.TABLE
+        for rel, src in files.items():
+            p = tmp_path / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(_tw.dedent(src))
+        index = ProjectIndex(str(tmp_path), roots=("yugabyte_db_tpu",))
+        return run_analysis(index, [get_pass("trace_discipline")])
+
+    def test_true_positive_free_text(self, tmp_path):
+        r = self._run_with_table(tmp_path, {
+            "yugabyte_db_tpu/a.py": """\
+                from .utils.trace import wait_status
+                def f():
+                    with wait_status("WalFsyncTypo"):
+                        pass
+                """})
+        assert [d for _, _, d in _findings(r)] == ["WalFsyncTypo"]
+
+    def test_true_positive_non_literal(self, tmp_path):
+        r = self._run_with_table(tmp_path, {
+            "yugabyte_db_tpu/a.py": """\
+                from .utils import trace
+                def f(state):
+                    with trace.wait_status(state):
+                        pass
+                """})
+        assert [d for _, _, d in _findings(r)] == ["non-literal"]
+
+    def test_suppressed_with_reason(self, tmp_path):
+        r = self._run_with_table(tmp_path, {
+            "yugabyte_db_tpu/a.py": """\
+                from .utils.trace import wait_status
+                def f():
+                    with wait_status("Legacy"):   # analysis-ok(trace_discipline): fixture
+                        pass
+                """})
+        assert r["findings"] == []
+        assert r["suppressions"]["trace_discipline"] == 1
+
+    def test_clean_negative(self, tmp_path):
+        """Canonical literals (bare and attribute-qualified calls) and
+        unrelated call names must not fire."""
+        r = self._run_with_table(tmp_path, {
+            "yugabyte_db_tpu/a.py": """\
+                from .utils import trace
+                from .utils.trace import wait_status
+                def f():
+                    with wait_status("WAL_Fsync"):
+                        pass
+                    with trace.wait_status("Flush_SstWrite",
+                                           component="flush"):
+                        pass
+                    return trace.current_wait_state()
+                """})
+        assert _findings(r) == []
+
+    def test_no_table_no_findings(self, tmp_path):
+        """A tree without a WAIT_STATES table (bare fixture) produces
+        nothing rather than flagging every call."""
+        import textwrap as _tw
+        p = tmp_path / "yugabyte_db_tpu" / "a.py"
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(_tw.dedent("""\
+            def wait_status(s):
+                pass
+            def f():
+                with wait_status("Whatever"):
+                    pass
+            """))
+        index = ProjectIndex(str(tmp_path), roots=("yugabyte_db_tpu",))
+        r = run_analysis(index, [get_pass("trace_discipline")])
+        assert _findings(r) == []
+
+    def test_real_tree_table_discovered(self):
+        """The pass finds the REAL canonical table in utils/trace.py
+        (so it tracks table growth with zero pass edits)."""
+        sys.path.insert(0, os.path.join(HERE, "tools"))
+        from analyze.passes.trace_discipline import find_state_table
+        from yugabyte_db_tpu.utils.trace import WAIT_STATES
+        index = ProjectIndex(HERE, roots=("yugabyte_db_tpu",))
+        mod, states = find_state_table(index)
+        assert mod is not None
+        assert mod.rel.replace("\\", "/").endswith("utils/trace.py")
+        assert states == set(WAIT_STATES)
+
+
 class TestCallGraph:
     def _graph(self, tmp_path, files):
         for rel, src in files.items():
@@ -1602,7 +1706,8 @@ def test_all_passes_ran(tree_report):
     assert [p["id"] for p in tree_report["passes"]] == [
         "async_blocking", "lock_held_await", "jit_hazards",
         "flag_drift", "shared_state_races", "unawaited_coroutine",
-        "format_gate", "layering", "lock_order", "resource_balance"]
+        "format_gate", "layering", "lock_order", "resource_balance",
+        "trace_discipline"]
 
 
 def test_wall_time_budget(tree_report):

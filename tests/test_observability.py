@@ -1,5 +1,7 @@
 """Tracing/ASH/webserver/encryption/CLI tests."""
 import asyncio
+import threading
+import time
 import urllib.request
 
 import numpy as np
@@ -7,10 +9,13 @@ import pytest
 
 from yugabyte_db_tpu.tserver.webserver import StatusWebServer
 from yugabyte_db_tpu.utils import flags, metrics
+from yugabyte_db_tpu.utils import trace as trace_mod
 from yugabyte_db_tpu.utils.encryption import (
     CipherStream, KEY_MANAGER, UniverseKeyManager,
 )
-from yugabyte_db_tpu.utils.trace import ASH, TRACE, TRACES, wait_status
+from yugabyte_db_tpu.utils.trace import (
+    ASH, AshSampler, TRACE, TRACES, wait_status,
+)
 
 from yugabyte_db_tpu.utils.encryption import aes_available
 
@@ -40,6 +45,461 @@ class TestTrace:
         ASH.sample_once()
         hist = ASH.histogram()
         assert hist.get("WaitingOnRaft", 0) >= 1
+
+
+class TestSpanPropagation:
+    """ISSUE 14: span context flows through task spawn, executor hops
+    (explicit capture) and the RPC wire; sampled=0 propagates no-op."""
+
+    def test_child_span_inherits_trace_and_parents(self):
+        with TRACES.trace("root") as root:
+            with TRACES.span("child") as child:
+                assert child.trace_id == root.trace_id
+                assert child.parent_id == root.span_id
+                assert child.span_id != root.span_id
+
+    def test_contextvar_survives_task_spawn(self):
+        async def go():
+            with TRACES.trace("root") as root:
+                async def task_body():
+                    return trace_mod.current_context()
+                ctx = await asyncio.create_task(task_body())
+                assert ctx.trace_id == root.trace_id
+                assert ctx.span_id == root.span_id
+        run(go())
+
+    def test_executor_hop_needs_explicit_capture(self):
+        async def go():
+            loop = asyncio.get_running_loop()
+            with TRACES.trace("root") as root:
+                # WITHOUT capture: the thread sees no context
+                naked = await loop.run_in_executor(
+                    None, trace_mod.current_context)
+                assert naked is None
+
+                # WITH explicit capture + use_context: the thread-side
+                # span lands in the same trace, parented correctly
+                ctx = trace_mod.current_context()
+
+                def thread_side():
+                    with trace_mod.use_context(ctx):
+                        with TRACES.span("thread-work",
+                                         child_only=True) as sp:
+                            return (sp.trace_id, sp.parent_id)
+                tid, pid = await loop.run_in_executor(None, thread_side)
+                assert tid == root.trace_id
+                assert pid == root.span_id
+        run(go())
+
+    def test_rpc_wire_roundtrip_parents_server_span(self):
+        from yugabyte_db_tpu.rpc.messenger import Messenger
+
+        class Svc:
+            async def rpc_ping(self, payload):
+                ctx = trace_mod.current_context()
+                return {"trace_id": ctx.trace_id if ctx else 0,
+                        "sampled": bool(ctx and ctx.sampled)}
+
+        async def go():
+            m1, m2 = Messenger("c"), Messenger("s")
+            m2.register_service("svc", Svc())
+            addr = await m2.start()
+            try:
+                with TRACES.trace("client-op") as root:
+                    r = await m1.call(addr, "svc", "ping", {})
+                    assert r["sampled"]
+                    assert r["trace_id"] == root.trace_id
+                # chain: root <- rpc.c.svc.ping <- rpc.s.svc.ping
+                recent = {t.name: t for t in TRACES.recent}
+                cspan = recent["rpc.c.svc.ping"]
+                sspan = recent["rpc.s.svc.ping"]
+                assert cspan.parent_id == root.span_id
+                assert sspan.parent_id == cspan.span_id
+                assert sspan.trace_id == root.trace_id
+            finally:
+                await m1.shutdown()
+                await m2.shutdown()
+        run(go())
+
+    def test_unsampled_propagates_as_noop(self):
+        from yugabyte_db_tpu.rpc.messenger import Messenger
+
+        class Svc:
+            async def rpc_ping(self, payload):
+                # downstream spans under an unsampled context must be
+                # the shared no-op (nothing recorded)
+                with TRACES.span("inner", child_only=True) as sp:
+                    return {"sampled": sp.sampled}
+
+        async def go():
+            m1, m2 = Messenger("c"), Messenger("s")
+            m2.register_service("svc", Svc())
+            addr = await m2.start()
+            flags.set_flag("trace_sampling_rate", 0.0)
+            try:
+                before = len(TRACES.recent)
+                r = await m1.call(addr, "svc", "ping", {})
+                assert r["sampled"] is False
+                assert len(TRACES.recent) == before   # zero new spans
+            finally:
+                flags.REGISTRY.reset("trace_sampling_rate")
+                await m1.shutdown()
+                await m2.shutdown()
+        run(go())
+
+    def test_root_sampling_rate_zero_and_one(self):
+        flags.set_flag("trace_sampling_rate", 0.0)
+        try:
+            with TRACES.span("maybe") as sp:
+                assert not sp.sampled
+            flags.set_flag("trace_sampling_rate", 1.0)
+            with TRACES.span("always") as sp:
+                assert sp.sampled
+        finally:
+            flags.REGISTRY.reset("trace_sampling_rate")
+
+    def test_wire_inject_extract(self):
+        assert trace_mod.extract(None) is None
+        assert trace_mod.extract([1, 2, 0]).sampled is False
+        ctx = trace_mod.extract([7, 9, 1])
+        assert (ctx.trace_id, ctx.span_id, ctx.sampled) == (7, 9, True)
+        assert trace_mod.extract("garbage") is None
+
+
+class TestTraceRegistryRaces:
+    def test_add_never_throws_after_finish(self):
+        with TRACES.trace("t") as t:
+            pass
+        t.add("late event")          # after finish(): no raise
+        t.set_tag("late", True)
+
+    def test_rpcz_snapshot_race_with_appender(self):
+        """A thread hammering Trace.add while rpcz() dumps must never
+        raise (events snapshot under the registry lock)."""
+        stop = threading.Event()
+        errors = []
+
+        def appender():
+            try:
+                with TRACES.trace("racy") as t:
+                    while not stop.is_set():
+                        t.add("x")
+            except Exception as e:   # noqa: BLE001
+                errors.append(e)
+
+        th = threading.Thread(target=appender)
+        th.start()
+        try:
+            for _ in range(200):
+                TRACES.rpcz()
+                TRACES.tracez()
+        finally:
+            stop.set()
+            th.join(5.0)
+        assert not errors
+
+    def test_tracez_stamped_with_pid_and_ts(self):
+        import os as _os
+        with TRACES.trace("snap"):
+            TRACE("e")
+        d = TRACES.tracez()
+        assert d["pid"] == _os.getpid()
+        assert abs(d["ts"] - time.time()) < 5.0
+        assert any(s["name"] == "snap" for s in d["spans"])
+        assert "wait_states" in d["ash"]
+
+
+class TestAsh:
+    def test_provider_crash_swallowed(self):
+        """Regression for sample_once's bare except: one crashing
+        provider must not kill the sampler or starve later providers."""
+        sampler = AshSampler()
+
+        def bad():
+            raise RuntimeError("provider exploded")
+        hits = []
+
+        def good():
+            hits.append(1)
+            return ("good", "WAL_Fsync")
+        sampler.register(bad)
+        sampler.register(good)
+        sampler.sample_once()
+        sampler.sample_once()
+        assert sampler.samples_taken == 2
+        assert len(hits) == 2
+        assert sampler.histogram().get("WAL_Fsync", 0) >= 2
+        assert sampler.summary()["cumulative"]["WAL_Fsync"] >= 2
+
+    def test_wait_status_feeds_sampler_across_threads(self):
+        """The active-wait table is process-global: a sampler running
+        in THIS thread sees a wait_status scope held by another."""
+        sampler = AshSampler()
+        entered = threading.Event()
+        release = threading.Event()
+
+        def blocked_thread():
+            with wait_status("Flush_SstWrite", component="flush"):
+                entered.set()
+                release.wait(5.0)
+
+        th = threading.Thread(target=blocked_thread)
+        th.start()
+        try:
+            assert entered.wait(5.0)
+            sampler.sample_once()
+        finally:
+            release.set()
+            th.join(5.0)
+        assert sampler.histogram().get("Flush_SstWrite", 0) >= 1
+        by_comp = sampler.summary()["by_component"]
+        assert "flush" in by_comp
+
+    def test_wait_status_rejects_free_text(self):
+        with pytest.raises(ValueError):
+            with wait_status("TotallyMadeUpState"):
+                pass
+
+    def test_sampler_thread_start_stop(self):
+        sampler = AshSampler()
+        sampler.start(interval_ms=5)
+        time.sleep(0.1)
+        sampler.stop()
+        assert sampler.samples_taken >= 2
+
+    def test_provider_deduped_against_wait_scope(self):
+        """A component provider echoing a state already published by a
+        wait_status scope that tick must not double-count it (the
+        session-weighted scope signal wins)."""
+        sampler = AshSampler()
+        sampler.register(lambda: ("flush:x", "Flush_SstWrite"))
+        with wait_status("Flush_SstWrite", component="flush"):
+            sampler.sample_once()
+        assert sampler.summary()["cumulative"]["Flush_SstWrite"] == 1
+        # without the scope, the provider's coarse signal DOES count
+        sampler.sample_once()
+        assert sampler.summary()["cumulative"]["Flush_SstWrite"] == 2
+
+    def test_unregister_stops_provider(self):
+        sampler = AshSampler()
+        calls = []
+
+        def p():
+            calls.append(1)
+            return ("c", "Compaction_Run")
+        sampler.register(p)
+        sampler.sample_once()
+        sampler.unregister(p)
+        sampler.unregister(p)     # idempotent
+        sampler.sample_once()
+        assert len(calls) == 1
+
+
+class TestHistogramSnapshot:
+    def test_single_pass_matches_percentile(self):
+        h = metrics.Histogram("h")
+        for v in (1, 10, 100, 1000, 10000, 100000):
+            for _ in range(7):
+                h.increment(v)
+        st = h.snapshot_stats()
+        assert st["count"] == h.count()
+        assert st["mean_us"] == pytest.approx(h.mean())
+        for p in (50, 95, 99):
+            assert st[f"p{p}_us"] == h.percentile(p)
+
+    def test_empty_histogram(self):
+        h = metrics.Histogram("e")
+        st = h.snapshot_stats()
+        assert st == {"count": 0, "mean_us": 0.0, "p50_us": 0.0,
+                      "p95_us": 0.0, "p99_us": 0.0}
+
+    def test_metrics_snapshot_stamped(self):
+        import os as _os
+        snap = metrics.snapshot()
+        assert snap["pid"] == _os.getpid()
+        assert abs(snap["ts"] - time.time()) < 5.0
+
+
+class TestCollector:
+    def _dump(self, pid, spans):
+        return {"pid": pid, "ts": time.time(), "spans": spans,
+                "active": [], "ash": {}}
+
+    def _span(self, tid, sid, parent, name):
+        return {"trace_id": tid, "span_id": sid, "parent_id": parent,
+                "name": name, "start_unix": time.time(),
+                "duration_ms": 1.0, "finished": True, "tags": {},
+                "events": []}
+
+    def test_stitch_across_processes(self):
+        from yugabyte_db_tpu.cluster.collector import stitch, tree_names
+        d1 = self._dump(100, [self._span(1, 10, 0, "client"),
+                              self._span(1, 11, 10, "rpc.c.write")])
+        d2 = self._dump(200, [self._span(1, 12, 11, "rpc.s.write"),
+                              self._span(1, 13, 12, "tablet.apply")])
+        trees = stitch([d1, d2])
+        assert set(trees) == {1}
+        t = trees[1]
+        assert t["span_count"] == 4
+        assert t["pids"] == [100, 200]
+        assert len(t["roots"]) == 1
+        names = tree_names(t["roots"][0])
+        assert names == ["client", "rpc.c.write", "rpc.s.write",
+                         "tablet.apply"]
+
+    def test_orphan_span_becomes_root(self):
+        from yugabyte_db_tpu.cluster.collector import stitch
+        d = self._dump(1, [self._span(5, 50, 999, "orphan")])
+        trees = stitch([d])
+        assert trees[5]["roots"][0]["name"] == "orphan"
+
+    def test_dominant_wait_and_attribution(self):
+        from yugabyte_db_tpu.cluster.collector import (
+            attribute_rounds, dominant_wait)
+        # CPU buckets excluded while a blocking state exists
+        assert dominant_wait({"OnCpu_Read": 100,
+                              "Flush_SstWrite": 5}) == "Flush_SstWrite"
+        # pure-CPU window: CPU is the honest fallback
+        assert dominant_wait({"OnCpu_Read": 9}) == "OnCpu_Read"
+        assert dominant_wait({}) is None
+        rounds = [
+            {"tag": "r0", "p99_ms": 10.0, "wait_delta": {}},
+            {"tag": "r1", "p99_ms": 11.0,
+             "wait_delta": {"WAL_Fsync": 2}},
+            {"tag": "spike", "p99_ms": 200.0,
+             "wait_delta": {"Flush_SstWrite": 40, "WAL_Fsync": 3}},
+        ]
+        attr = attribute_rounds(rounds, spread_gate=3.0)
+        assert attr["over_spread_rounds"] == ["spike"]
+        spike = [r for r in attr["rounds"] if r["tag"] == "spike"][0]
+        assert spike["over_spread"]
+        assert spike["dominant_wait"] == "Flush_SstWrite"
+        assert spike["category"] == "flush"
+
+    def test_every_wait_state_has_category(self):
+        from yugabyte_db_tpu.cluster.collector import WAIT_CATEGORIES
+        from yugabyte_db_tpu.utils.trace import WAIT_STATES
+        uncovered = {s for s in WAIT_STATES if s != "Idle"} \
+            - set(WAIT_CATEGORIES)
+        assert not uncovered, (
+            f"wait states missing an attribution category: {uncovered}")
+
+
+class TestDeviceTelemetry:
+    @staticmethod
+    def _batch():
+        from tests.test_ops_scan import make_block
+        from yugabyte_db_tpu.ops.device_batch import build_batch
+        blk, _ = make_block(n=512, seed=3)
+        return build_batch([blk], [1, 2])
+
+    def test_scan_launch_span_tagged(self):
+        from yugabyte_db_tpu.ops import AggSpec, Expr, scan_aggregate
+        batch = self._batch()
+        where = (Expr.col(1) < 25.0).node
+        aggs = (AggSpec("sum", Expr.col(2).node), AggSpec("count"))
+        with TRACES.trace("scan-op") as t:
+            scan_aggregate(batch, where, aggs)
+            scan_aggregate(batch, where, aggs)
+        spans = [s for s in TRACES.recent
+                 if s.trace_id == t.trace_id
+                 and s.name == "device.scan"]
+        assert len(spans) == 2
+        # first launch may or may not compile (shared kernel cache is
+        # process-global), but the second MUST hit with the same sig
+        assert spans[-1].tags["codepath"] == "cache_hit"
+        assert spans[0].tags["signature"] == spans[1].tags["signature"]
+        assert spans[0].tags["bucket"] == batch.padded_rows
+        assert spans[0].tags["rows"] == batch.n_rows
+
+    def test_no_spans_without_sampled_trace(self):
+        from yugabyte_db_tpu.ops import AggSpec, scan_aggregate
+        batch = self._batch()
+        before = len([s for s in TRACES.recent
+                      if s.name == "device.scan"])
+        scan_aggregate(batch, None, (AggSpec("count"),))
+        after = len([s for s in TRACES.recent
+                     if s.name == "device.scan"])
+        assert after == before
+
+
+class TestClusterSpanTree:
+    """ISSUE 14 acceptance: ONE acked cluster write produces ONE
+    stitched cross-process span tree — client (this process) ->
+    leader tserver (RPC server span, raft append+fsync, tablet apply,
+    flush handoff) -> follower (consensus RPC server span, WAL
+    append) — assembled from rpc_tracez dumps by cluster/collector."""
+
+    def test_write_span_tree_stitches_across_processes(self, tmp_path):
+        import os as _os
+
+        from yugabyte_db_tpu.cluster import ClusterSupervisor
+        from yugabyte_db_tpu.cluster.collector import (
+            collect_cluster_tracez, stitch, tree_names)
+        from yugabyte_db_tpu.docdb.table_codec import TableInfo
+        from yugabyte_db_tpu.dockv.packed_row import (
+            ColumnSchema, ColumnType, TableSchema)
+        from yugabyte_db_tpu.dockv.partition import PartitionSchema
+
+        info = TableInfo("", "kv", TableSchema(columns=(
+            ColumnSchema(0, "k", ColumnType.INT64, is_hash_key=True),
+            ColumnSchema(1, "v", ColumnType.FLOAT64)), version=1),
+            PartitionSchema("hash", 1))
+
+        async def main():
+            sup = await ClusterSupervisor(str(tmp_path),
+                                          num_tservers=2).start()
+            c = None
+            try:
+                c = sup.client()
+                await c.create_table(info, num_tablets=1,
+                                     replication_factor=2)
+                # a tiny flush threshold makes THIS write cross it, so
+                # the apply triggers the flush-executor handoff and the
+                # tree gets its flush.background leaf
+                await sup.set_flag_all("memstore_flush_threshold_bytes",
+                                       2000, roles=("tserver",))
+                with TRACES.trace("user-write") as root:
+                    n = await c.insert("kv", [
+                        {"k": i, "v": float(i)} for i in range(200)])
+                assert n == 200
+                # follower append + leader apply + background flush all
+                # finish within the replicate round; give stragglers a
+                # moment before dumping
+                await asyncio.sleep(1.0)
+                dumps = await collect_cluster_tracez(sup)
+                local = TRACES.tracez()
+                local["process"] = "test-client"
+                trees = stitch(dumps + [local])
+                assert root.trace_id in trees, (
+                    "the root trace vanished from every dump")
+                t = trees[root.trace_id]
+                names = []
+                for r in t["roots"]:
+                    names.extend(tree_names(r))
+                # client -> leader -> follower: at least 3 distinct
+                # pids contribute spans (test process + 2 tservers)
+                assert len(t["pids"]) >= 3, (t["pids"], names)
+                assert _os.getpid() in t["pids"]
+
+                def has(prefix):
+                    return any(nm.startswith(prefix) for nm in names)
+                assert has("rpc.c.tserver.write"), names   # client stamp
+                assert has("rpc.s.tserver.write"), names   # leader serve
+                # leader append+fsync (fused or legacy path)
+                assert has("raft.append_group") or \
+                    has("raft.replicate"), names
+                # follower WAL append via the consensus RPC
+                assert has("rpc.s.consensus-"), names
+                assert has("raft.follower_append"), names
+                # state-machine apply + flush-executor handoff
+                assert has("tablet.apply"), names
+                assert has("flush.background"), names
+            finally:
+                if c is not None:
+                    await c.messenger.shutdown()
+                await sup.shutdown()
+        run(main())
 
 
 class TestEncryption:

@@ -11,7 +11,7 @@ Adding a pass (see ANALYSIS.md):
 from . import (async_blocking, flag_drift, format_gate, jit_hazards,
                layering, lock_held_await, lock_order,
                resource_balance, shared_state_races,
-               unawaited_coroutine)
+               trace_discipline, unawaited_coroutine)
 
 ALL_PASSES = (
     async_blocking.PASS,
@@ -24,6 +24,7 @@ ALL_PASSES = (
     layering.PASS,
     lock_order.PASS,
     resource_balance.PASS,
+    trace_discipline.PASS,
 )
 
 _BY_ID = {p.id: p for p in ALL_PASSES}

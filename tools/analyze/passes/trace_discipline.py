@@ -1,0 +1,118 @@
+"""Pass: ASH wait-state discipline — no free-text drift.
+
+The whole value of wait-state attribution (``cluster_p99_attribution``,
+the rpc_tracez histograms) rests on wait states being a CLOSED
+vocabulary: the bench's category mapping, the collector's dominant-wait
+logic and every dashboard keys on exact strings.  One typo'd
+``wait_status("WalFsync")`` site would silently vanish from every
+histogram while looking instrumented.
+
+Contract enforced tree-wide:
+
+1. Every ``wait_status(...)`` call's state argument must be a STRING
+   LITERAL — a variable/attribute/f-string cannot be checked against
+   the table and is flagged (suppressible where a computed state is
+   genuinely needed).
+2. Every literal must appear in the canonical ``WAIT_STATES`` table
+   (the frozenset assigned in ``yugabyte_db_tpu/utils/trace.py`` —
+   discovered from the AST, so the pass tracks the table as it grows
+   with zero pass edits).
+
+Known lexical limits: the table is discovered as the first module-level
+``WAIT_STATES = frozenset({...})`` / set-literal assignment in the
+indexed tree (fixtures define their own mini table); indirect calls
+through aliases other than ``*wait_status`` are invisible.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..core import AnalysisPass, Finding, ProjectIndex, call_name
+
+
+def _literal_states(value: ast.expr) -> Optional[Set[str]]:
+    """String members of a frozenset({...}) / set / tuple literal."""
+    if isinstance(value, ast.Call) and call_name(value) == "frozenset" \
+            and value.args:
+        value = value.args[0]
+    if isinstance(value, (ast.Set, ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for el in value.elts:
+            if not (isinstance(el, ast.Constant)
+                    and isinstance(el.value, str)):
+                return None
+            out.add(el.value)
+        return out
+    return None
+
+
+def find_state_table(index: ProjectIndex):
+    """(module, states) of the canonical WAIT_STATES table, preferring
+    the real utils/trace.py over any other definition."""
+    best = None
+    for mi in index.modules():
+        if mi.tree is None:
+            continue
+        for node in mi.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(isinstance(t, ast.Name) and t.id == "WAIT_STATES"
+                       for t in node.targets):
+                continue
+            states = _literal_states(node.value)
+            if states is None:
+                continue
+            if mi.rel.replace("\\", "/").endswith("utils/trace.py"):
+                return mi, states
+            if best is None:
+                best = (mi, states)
+    return best if best is not None else (None, None)
+
+
+class TraceDisciplinePass(AnalysisPass):
+    id = "trace_discipline"
+    title = "ASH wait-state discipline (canonical WAIT_STATES only)"
+    hint = ("wait_status() states are a closed vocabulary: add the "
+            "state to trace.WAIT_STATES (and the collector's category "
+            "map) instead of inventing a string at the call site")
+
+    def run(self, index: ProjectIndex) -> List[Finding]:
+        table_mod, states = find_state_table(index)
+        if not states:
+            return []     # no table in this tree (bare fixture)
+        out: List[Finding] = []
+        for mi in index.modules():
+            if mi.tree is None or mi is table_mod:
+                continue
+            for node in ast.walk(mi.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if not (name == "wait_status"
+                        or name.endswith(".wait_status")):
+                    continue
+                if not node.args:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Constant) \
+                        and isinstance(arg.value, str):
+                    if arg.value not in states:
+                        out.append(self.finding(
+                            mi, node.lineno,
+                            f"wait_status({arg.value!r}) is not in the "
+                            "canonical trace.WAIT_STATES table — "
+                            "free-text wait states vanish from every "
+                            "ASH histogram and attribution map",
+                            detail=arg.value))
+                else:
+                    out.append(self.finding(
+                        mi, node.lineno,
+                        "wait_status() state is not a string literal — "
+                        "the canonical-table check cannot see a "
+                        "computed state",
+                        detail="non-literal"))
+        return out
+
+
+PASS = TraceDisciplinePass()

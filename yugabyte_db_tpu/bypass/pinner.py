@@ -31,6 +31,7 @@ from typing import List, Optional
 
 from ..storage.lsm import SstLease
 from ..utils.hybrid_time import HybridTime
+from ..utils.trace import wait_status
 from .errors import REASON_MEMTABLE_ACTIVE, REASON_NO_SSTS, BypassIneligible
 
 
@@ -88,19 +89,21 @@ def pin_tablet(tablet, read_ht: Optional[int] = None,
         # wrong object) must surface as its real error, not burn the
         # whole wait and masquerade as memtable_active
         if safe_time_fn(tablet.clock.now().value) < read_ht:
-            while True:
-                try:
-                    if safe_time_fn(tablet.clock.now().value) >= read_ht:
-                        break
-                except Exception:   # noqa: BLE001 — transient cross-
-                    pass            # thread misread of in-flight
-                    #                 state: re-poll
-                if time.monotonic() > deadline:
-                    raise BypassIneligible(
-                        REASON_MEMTABLE_ACTIVE,
-                        f"tablet {tablet.tablet_id}: in-flight writes "
-                        "below the read point did not drain")
-                time.sleep(0.002)
+            with wait_status("SafeTime_Wait", component="bypass"):
+                while True:
+                    try:
+                        if safe_time_fn(
+                                tablet.clock.now().value) >= read_ht:
+                            break
+                    except Exception:   # noqa: BLE001 — transient
+                        pass            # cross-thread misread of in-
+                        #                 flight state: re-poll
+                    if time.monotonic() > deadline:
+                        raise BypassIneligible(
+                            REASON_MEMTABLE_ACTIVE,
+                            f"tablet {tablet.tablet_id}: in-flight "
+                            "writes below the read point did not drain")
+                    time.sleep(0.002)
     store = tablet.regular
     lease = None
     for attempt in range(max_flush_attempts):

@@ -13,7 +13,11 @@ may import client/rpc/utils (and the model/request vocabulary) but
 never ``tserver``/``tablet`` internals.
 """
 from .chaos import ChaosController, ChaosEvent
+from .collector import (attribute_rounds, collect_cluster_tracez,
+                        dominant_wait, stitch, tree_names)
 from .supervisor import ClusterSupervisor, ManagedProcess
 
 __all__ = ["ChaosController", "ChaosEvent", "ClusterSupervisor",
-           "ManagedProcess"]
+           "ManagedProcess", "attribute_rounds",
+           "collect_cluster_tracez", "dominant_wait", "stitch",
+           "tree_names"]

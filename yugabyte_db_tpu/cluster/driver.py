@@ -92,6 +92,13 @@ class LoadDriver:
         return {"ok": True, "pid": os.getpid(),
                 "acked": len(self._acked)}
 
+    async def rpc_tracez(self, payload) -> dict:
+        """Client-side span dump: the driver process ROOTS traces (its
+        YBClient calls are the sampling edge), so the collector needs
+        its dump to stitch complete client->server trees."""
+        from ..utils.trace import TRACES
+        return TRACES.tracez()
+
     async def rpc_setup(self, payload) -> dict:
         """Create + load the usertable; returns once every tablet has
         an elected, client-visible leader (the driver-side readiness

@@ -20,6 +20,7 @@ import msgpack
 
 from ..utils import flags
 from ..utils.fault_injection import TEST_CRASH_POINT
+from ..utils.trace import wait_status
 
 ENTRY_HDR = struct.Struct("<II")   # payload_len, crc32
 
@@ -124,7 +125,9 @@ class Log:
         self._active_size = 0
 
     def append(self, entries: List[LogEntry], sync: bool = True) -> None:
-        """Group-commit append: one write + one fsync for the batch."""
+        """Group-commit append: one write + one fsync for the batch.
+        The fsync publishes a ``WAL_Fsync`` ASH wait state — the
+        sampler thread attributes blocked time here from outside."""
         if not entries:
             return
         if self._active is None or self._active_size >= flags.get(
@@ -139,7 +142,8 @@ class Log:
         self._active.write(buf)
         self._active.flush()
         if sync and self.fsync:
-            os.fsync(self._active.fileno())
+            with wait_status("WAL_Fsync", component="wal"):
+                os.fsync(self._active.fileno())
         self._active_size += len(buf)
         TEST_CRASH_POINT("wal:after_append")
 

@@ -30,10 +30,17 @@ import logging
 
 from ..rpc.messenger import Messenger, RpcError
 from ..utils import flags, metrics
+from ..utils import trace as _trace
 
 log = logging.getLogger("ybtpu.consensus")
 from ..utils.hybrid_time import HybridClock, HybridTime
 from .log import Log, LogEntry
+
+
+#: process-wide count of in-flight append/replicate rounds — the ASH
+#: "raft" provider reads it (registered by the tserver), so a sampler
+#: tick can attribute a stall to consensus even between wait scopes
+REPLICATE_INFLIGHT = {"n": 0}
 
 
 class Role:
@@ -206,7 +213,7 @@ class RaftConsensus:
             t.cancel()
         if self._append_drainer is not None:
             self._append_drainer.cancel()
-        for _, _, _, fut in self._pending_appends:
+        for _, _, _, fut, _ in self._pending_appends:
             if not fut.done():
                 fut.cancel()
         self._pending_appends = []
@@ -416,25 +423,41 @@ class RaftConsensus:
                            "LEADER_NOT_READY")
         if flags.get("fused_replicate_enabled"):
             fut = asyncio.get_running_loop().create_future()
-            self._pending_appends.append((etype, payload, precheck, fut))
+            # the drainer task runs in its own context: capture the
+            # caller's trace context with the entry so the fused
+            # append/broadcast spans can parent under a real request
+            self._pending_appends.append(
+                (etype, payload, precheck, fut, _trace.current_context()))
             if self._append_drainer is None or self._append_drainer.done():
                 self._append_drainer = asyncio.create_task(
                     self._drain_appends())
-            return await asyncio.wait_for(fut, timeout)
-        async with self._replicate_lock:
-            if precheck is not None:
-                precheck()
-            idx = self.log.last_index + 1
-            await self._append_local(LogEntry(
-                self.meta.current_term, idx, etype, payload))
-            if not self.config.others(self.uuid):
-                await self._advance_commit(idx)
+            with _trace.TRACES.span("raft.replicate", child_only=True,
+                                    tags={"fused": True}):
+                return await asyncio.wait_for(fut, timeout)
+        with _trace.TRACES.span("raft.replicate", child_only=True,
+                                tags={"fused": False}) as sp:
+            REPLICATE_INFLIGHT["n"] += 1
+            try:
+                async with self._replicate_lock:
+                    if precheck is not None:
+                        precheck()
+                    idx = self.log.last_index + 1
+                    await self._append_local(LogEntry(
+                        self.meta.current_term, idx, etype, payload))
+                    sp.add(f"appended idx={idx}")
+                    if not self.config.others(self.uuid):
+                        await self._advance_commit(idx)
+                        return idx
+                    fut = asyncio.get_running_loop().create_future()
+                    self._commit_waiters.append(
+                        (idx, self.meta.current_term, fut))
+                with _trace.TRACES.span("raft.broadcast",
+                                        child_only=True):
+                    await self._broadcast()
+                await asyncio.wait_for(fut, timeout)
                 return idx
-            fut = asyncio.get_running_loop().create_future()
-            self._commit_waiters.append((idx, self.meta.current_term, fut))
-        await self._broadcast()
-        await asyncio.wait_for(fut, timeout)
-        return idx
+            finally:
+                REPLICATE_INFLIGHT["n"] -= 1
 
     async def _drain_appends(self):
         """Fused-append drainer: take EVERYTHING queued, append it as
@@ -454,29 +477,47 @@ class RaftConsensus:
                 # futures are in neither _pending_appends nor (all of)
                 # _commit_waiters — cancel them here or their callers
                 # hang out the full replicate timeout
-                for _, _, _, fut in group:
+                for _, _, _, fut, _ in group:
                     if not fut.done():
                         fut.cancel()
                 raise
             except Exception as e:  # noqa: BLE001 — a failed append
                 # (disk error) must fail the GROUP's callers, not hang
                 # them to timeout while the drainer dies silently
-                for _, _, _, fut in group:
+                for _, _, _, fut, _ in group:
                     if not fut.done():
                         fut.set_exception(e)
 
     async def _append_group(self, group: List[tuple]):
+        # the fused group's spans parent under the FIRST member that
+        # carries a sampled context (the drainer task has none of its
+        # own) — fanin tags how many entries shared the fsync+round.
+        # An all-unsampled group EXPLICITLY clears the ambient context:
+        # the long-lived drainer task inherited whatever request
+        # created it, and a no-op here would parent this group's spans
+        # under that stale, unrelated trace.
+        gctx = next((c for _, _, _, _, c in group
+                     if c is not None and c.sampled),
+                    _trace.SpanContext(0, 0, False))
+        REPLICATE_INFLIGHT["n"] += 1
+        try:
+            with _trace.use_context(gctx):
+                await self._append_group_traced(group)
+        finally:
+            REPLICATE_INFLIGHT["n"] -= 1
+
+    async def _append_group_traced(self, group: List[tuple]):
         async with self._replicate_lock:
             term = self.meta.current_term
             entries: List[LogEntry] = []
             if self.role != Role.LEADER:
-                for _, _, _, fut in group:
+                for _, _, _, fut, _ in group:
                     if not fut.done():
                         fut.set_exception(RpcError(
                             f"not leader (leader={self.leader_uuid})",
                             "LEADER_NOT_READY"))
                 return
-            for etype, payload, precheck, fut in group:
+            for etype, payload, precheck, fut, _ in group:
                 if fut.done():
                     continue            # caller timed out while queued
                 if precheck is not None:
@@ -490,13 +531,16 @@ class RaftConsensus:
                 self._commit_waiters.append((idx, term, fut))
             if not entries:
                 return
-            await self._append_local(*entries)
+            with _trace.TRACES.span("raft.append_group", child_only=True,
+                                    tags={"fanin": len(entries)}):
+                await self._append_local(*entries)
             self._m_fused_appends.increment()
             self._m_fused_fanin.increment(len(entries))
             if not self.config.others(self.uuid):
                 await self._advance_commit(self.log.last_index)
                 return
-        await self._broadcast()
+        with _trace.TRACES.span("raft.broadcast", child_only=True):
+            await self._broadcast()
 
     # ------------------------------------------------------------------
     # Membership change (single-server at a time; config applies at
@@ -780,8 +824,11 @@ class RaftConsensus:
                         "needs_bootstrap": True}
             # follower WAL fsync — the entries must be durable before
             # success is acked, ordered against the conflict check
-            # analysis-ok(async_blocking): the durability boundary
-            self.log.append(to_append)
+            with _trace.TRACES.span("raft.follower_append",
+                                    child_only=True,
+                                    tags={"n": len(to_append)}):
+                # analysis-ok(async_blocking): the durability boundary
+                self.log.append(to_append)
             # any pending waiter at a truncated index lost its entry
             still = []
             for idx, term, fut in self._commit_waiters:

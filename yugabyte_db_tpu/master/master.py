@@ -205,11 +205,13 @@ class Master:
 
     def _write_catalog(self, data: str) -> None:
         """Durable write (executor target: fsync is a device stall)."""
+        from ..utils.trace import wait_status
         tmp = self._catalog_path + ".tmp"
         with open(tmp, "w") as f:
             f.write(data)
             f.flush()
-            os.fsync(f.fileno())
+            with wait_status("Catalog_Fsync", component="master"):
+                os.fsync(f.fileno())
         os.replace(tmp, self._catalog_path)
 
     def _persist(self):
@@ -383,6 +385,14 @@ class Master:
         # unknown flag -> KeyError -> RPC error surface
         old, value = flags.coerce_and_set(name, payload["value"])
         return {"name": name, "old": old, "value": value}
+
+    async def rpc_tracez(self, payload) -> dict:
+        """Sampled span dump + ASH histograms for the master process
+        (same contract as the tserver's rpc_tracez; CLUSTER.md)."""
+        from ..utils import trace as _trace
+        out = _trace.TRACES.tracez()
+        out["uuid"] = self.uuid
+        return out
 
     async def rpc_metrics_snapshot(self, payload) -> dict:
         from ..utils import fault_injection as fi

@@ -221,7 +221,23 @@ def make_dict_plan(blocks: Sequence[ColumnarBlock],
     """Merge per-block dictionaries for `cids` into scan-global ones
     and remap every block's local codes. None when any (block, column)
     can't dictionary-encode — the caller falls back to the legacy
-    decode path / interpreter. Row strings are never decoded here."""
+    decode path / interpreter. Row strings are never decoded here.
+    Emits a per-scan ``device.dict_plan`` telemetry span (the host
+    stage that feeds the grouped kernel) when a sampled trace is
+    ambient."""
+    from ..utils import trace as _trace
+    with _trace.device_span("dict_plan",
+                            signature=tuple(sorted(cids)),
+                            rows=sum(b.n for b in blocks)) as sp:
+        plan = _make_dict_plan(blocks, cids, max_card)
+        if sp is not None:
+            sp.set_tag("eligible", plan is not None)
+        return plan
+
+
+def _make_dict_plan(blocks: Sequence[ColumnarBlock],
+                    cids: Sequence[int],
+                    max_card: int = 1 << 16) -> Optional[DictPlan]:
     t0 = time.perf_counter()
     dicts: Dict[int, np.ndarray] = {}
     codes: Dict[int, Dict[int, np.ndarray]] = {}

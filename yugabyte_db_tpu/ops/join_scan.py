@@ -221,6 +221,23 @@ def _pad_to(arr: np.ndarray, n: int) -> np.ndarray:
 def make_join_runtime(wire: JoinWire,
                       probe_dicts: Dict[int, np.ndarray],
                       max_slots: Optional[int] = None) -> JoinRuntime:
+    """Resolve a JoinWire against the probe scan's dictionaries,
+    emitting a ``device.join_build`` telemetry span (build rows +
+    slot bucket) when a sampled trace is ambient."""
+    from ..utils import trace as _trace
+    with _trace.device_span("join_build",
+                            signature=(wire.probe_col,
+                                       len(wire.keys)),
+                            rows=len(wire.keys)) as sp:
+        rt = _make_join_runtime(wire, probe_dicts, max_slots)
+        if sp is not None:
+            sp.set_tag("slots", rt.num_slots)
+        return rt
+
+
+def _make_join_runtime(wire: JoinWire,
+                       probe_dicts: Dict[int, np.ndarray],
+                       max_slots: Optional[int] = None) -> JoinRuntime:
     """Resolve a JoinWire against the probe scan's dictionaries.
 
     String build keys map into the probe column's sorted dictionary

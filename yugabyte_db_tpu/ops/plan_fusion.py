@@ -204,6 +204,7 @@ class FusedPlanKernel:
             strategy, join_shape,
         )
         fn = self._cache.get(sig)
+        compiled = fn is None
         if fn is None:
             fn = self._build(where, aggs, resolved, mvcc_mode,
                              join_shape, static_sums, strategy)
@@ -220,23 +221,31 @@ class FusedPlanKernel:
         zeros_u64 = jnp.zeros(batch.padded_rows, jnp.uint64)
         zeros_u32 = jnp.zeros(batch.padded_rows, jnp.uint32)
         zeros_b = jnp.zeros(batch.padded_rows, bool)
-        raw = fn(
-            batch.cols, batch.nulls,
-            [jnp.asarray(c) for c in consts], batch.valid,
-            batch.key_hash if batch.key_hash is not None else zeros_u64,
-            batch.ht if batch.ht is not None else zeros_u64,
-            batch.write_id if batch.write_id is not None else zeros_u32,
-            batch.tombstone if batch.tombstone is not None else zeros_b,
-            jnp.uint64(read_ht if read_ht is not None
-                       else 0xFFFFFFFFFFFFFFFF),
-            scale_args, domain_args,
-            jnp.asarray(join_rt.used), jnp.asarray(join_rt.table_key),
-            jnp.asarray(join_rt.table_val),
-            tuple(jnp.asarray(join_rt.payload_vals[bid])
-                  for bid in join_rt.build_cols),
-            tuple(jnp.asarray(join_rt.payload_nulls[bid])
-                  for bid in join_rt.build_cols),
-        )
+        from ..utils import trace as _trace
+        with _trace.device_span("fused_plan", signature=sig,
+                                compiled=compiled,
+                                bucket=batch.padded_rows,
+                                rows=batch.n_rows):
+            raw = fn(
+                batch.cols, batch.nulls,
+                [jnp.asarray(c) for c in consts], batch.valid,
+                batch.key_hash if batch.key_hash is not None
+                else zeros_u64,
+                batch.ht if batch.ht is not None else zeros_u64,
+                batch.write_id if batch.write_id is not None
+                else zeros_u32,
+                batch.tombstone if batch.tombstone is not None
+                else zeros_b,
+                jnp.uint64(read_ht if read_ht is not None
+                           else 0xFFFFFFFFFFFFFFFF),
+                scale_args, domain_args,
+                jnp.asarray(join_rt.used), jnp.asarray(join_rt.table_key),
+                jnp.asarray(join_rt.table_val),
+                tuple(jnp.asarray(join_rt.payload_vals[bid])
+                      for bid in join_rt.build_cols),
+                tuple(jnp.asarray(join_rt.payload_nulls[bid])
+                      for bid in join_rt.build_cols),
+            )
         return (_rescale_outs(raw[0], raw[1]),) + tuple(raw[2:])
 
 

@@ -613,6 +613,7 @@ class ScanKernel:
         col_order = tuple(sorted(needed))
         null_order = tuple(cid for cid in col_order
                            if cid in batch.nulls)
+        entry_was_compiled = entry is None
         try:
             if entry is None:
                 from .expr import const_count
@@ -642,8 +643,13 @@ class ScanKernel:
                         for cid in col_order]
             null_arrs = [batch.nulls[cid].astype(jnp.float32)
                          for cid in null_order]
-            outs = entry(carr, col_arrs, null_arrs,
-                         batch.valid.astype(jnp.float32))
+            from ..utils import trace as _trace
+            with _trace.device_span("pallas_scan", signature=key,
+                                    compiled=entry_was_compiled,
+                                    bucket=batch.padded_rows,
+                                    rows=batch.n_rows):
+                outs = entry(carr, col_arrs, null_arrs,
+                             batch.valid.astype(jnp.float32))
         except Exception:   # noqa: BLE001 — unsupported op inside the
             self._cache[key] = False    # kernel: permanent XLA fallback
             return None
@@ -716,29 +722,39 @@ class ScanKernel:
             mvcc_mode, batch.padded_rows, col_sig, static_sums, strategy,
         )
         from ..utils import flags as _flags
+        from ..utils import trace as _trace
         if _flags.get("tpu_pallas_scan"):
             got = self._try_pallas(sig, batch, where, aggs, group,
                                    mvcc_mode, consts)
             if got is not None:
                 return got
+        pre = self.compiles
         fn = self._get(sig, where, aggs, group, mvcc_mode, static_sums,
                        strategy)
+        compiled = self.compiles > pre
         zeros_u64 = jnp.zeros(batch.padded_rows, jnp.uint64)
         zeros_u32 = jnp.zeros(batch.padded_rows, jnp.uint32)
         zeros_b = jnp.zeros(batch.padded_rows, bool)
         if isinstance(group, ResolvedDictGroup):
             from .grouped_scan import GROUPED_STATS
             GROUPED_STATS["launches"] += 1
-        raw = fn(
-            batch.cols, batch.nulls,
-            [jnp.asarray(c) for c in consts], batch.valid,
-            batch.key_hash if batch.key_hash is not None else zeros_u64,
-            batch.ht if batch.ht is not None else zeros_u64,
-            batch.write_id if batch.write_id is not None else zeros_u32,
-            batch.tombstone if batch.tombstone is not None else zeros_b,
-            jnp.uint64(read_ht if read_ht is not None else 0xFFFFFFFFFFFFFFFF),
-            scale_args, domain_args,
-        )
+        with _trace.device_span("scan", signature=sig, compiled=compiled,
+                                bucket=batch.padded_rows,
+                                rows=batch.n_rows):
+            raw = fn(
+                batch.cols, batch.nulls,
+                [jnp.asarray(c) for c in consts], batch.valid,
+                batch.key_hash if batch.key_hash is not None
+                else zeros_u64,
+                batch.ht if batch.ht is not None else zeros_u64,
+                batch.write_id if batch.write_id is not None
+                else zeros_u32,
+                batch.tombstone if batch.tombstone is not None
+                else zeros_b,
+                jnp.uint64(read_ht if read_ht is not None
+                           else 0xFFFFFFFFFFFFFFFF),
+                scale_args, domain_args,
+            )
         # (outs, scales, counts, mask[, gvals, n_groups | spill]) ->
         # rescale the fixed-point sums host-side; callers keep the
         # historical shape (outs, counts, mask[, ...])

@@ -73,6 +73,19 @@ def _inflight_cap() -> int:
         return 0
 
 
+_trace = None
+
+
+def _trace_mod():
+    """Lazy utils.trace handle (same import-cycle discipline as the
+    flags helper above), cached after the first call."""
+    global _trace
+    if _trace is None:
+        from ..utils import trace
+        _trace = trace
+    return _trace
+
+
 _SIDECAR_EXT = 3
 
 
@@ -230,11 +243,17 @@ class Connection:
             self.pending.clear()
 
     async def call(self, service: str, method: str, payload: Any,
-                   timeout: float) -> Any:
+                   timeout: float, tctx=None) -> Any:
         call_id = next(self.ids)
         fut = asyncio.get_running_loop().create_future()
         self.pending[call_id] = fut
-        self.writer.write(_pack([call_id, _REQ, service, method, payload]))
+        # trace context rides as envelope element 6 (after the sidecar
+        # lens slot, which stays None on plain requests) — the
+        # (trace_id, span_id, sampled) stamp every frame carries
+        frame = ([call_id, _REQ, service, method, payload, None, tctx]
+                 if tctx is not None
+                 else [call_id, _REQ, service, method, payload])
+        self.writer.write(_pack(frame))
         await self.writer.drain()
         try:
             return await asyncio.wait_for(fut, timeout)
@@ -367,7 +386,8 @@ class Messenger:
                         await writer.drain()
                         continue
                 RECEIVED_AT.set(time.monotonic())
-                t = asyncio.create_task(self._dispatch(msg, writer))
+                tctx = msg[6] if len(msg) > 6 else None
+                t = asyncio.create_task(self._dispatch(msg, writer, tctx))
                 inflight.add(t)
                 t.add_done_callback(inflight.discard)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
@@ -379,10 +399,15 @@ class Messenger:
             except Exception:
                 pass
 
-    async def _dispatch(self, msg, writer):
+    async def _dispatch(self, msg, writer, tctx=None):
         call_id, kind, service, method, payload = msg[:5]
+        tr = _trace_mod()
         try:
-            result = await self._invoke(service, method, payload)
+            # re-establish the caller's trace context for this handler
+            # task; _invoke opens the server span (shared with the
+            # local short-circuit path, so both spell one span shape)
+            with tr.use_context(tr.extract(tctx)):
+                result = await self._invoke(service, method, payload)
             try:
                 _write_response(writer, call_id, service, method, result)
                 await writer.drain()
@@ -414,40 +439,56 @@ class Messenger:
         if fn is None:
             raise RpcError(f"unknown method {service}.{method}", "NOT_FOUND")
         self.calls_handled += 1
-        return await fn(payload)
+        # server span: child of the propagated context (remote frames)
+        # or of the in-process client span (local short-circuit); a
+        # no-op when the trace is unsampled
+        with _trace_mod().TRACES.span(f"rpc.s.{service}.{method}",
+                                      child_only=True):
+            return await fn(payload)
 
     async def call(self, addr: Tuple[str, int], service: str, method: str,
                    payload: Any = None, timeout: float = 10.0) -> Any:
-        """Client call; local short-circuit when addr is our own server."""
+        """Client call; local short-circuit when addr is our own server.
+
+        Every outgoing call is stamped with the ambient trace context:
+        the client span opened here is the root-sampling edge (no
+        ambient context -> roll ``trace_sampling_rate``), and remote
+        frames carry ``[trace_id, span_id, sampled]`` so the server's
+        span parents under this one — the cross-process seam of the
+        span tree."""
         self.calls_sent += 1
-        if self.addr is not None and tuple(addr) == tuple(self.addr):
-            res = await asyncio.wait_for(
-                self._invoke(service, method, payload), timeout)
-            if isinstance(res, Sidecars):
-                return res.resolve()    # zero-copy local substitution
-            return res
-        key = tuple(addr)
-        lock = self._conn_locks.setdefault(key, asyncio.Lock())
-        async with lock:
-            conn = self._conns.get(key)
-            if conn is None or conn.closed:
-                reader, writer = await asyncio.open_connection(
-                    *addr, ssl=self.tls_client)
-                conn = Connection(reader, writer)
-                self._conns[key] = conn
-        try:
-            return await conn.call(service, method, payload, timeout)
-        except RpcError as e:
-            if e.code == "NETWORK_ERROR":
-                self._conns.pop(key, None)
-            raise
-        except asyncio.TimeoutError:
-            # the connection may be wedged (half-open socket): evict so
-            # the next call reconnects
-            if self._conns.get(key) is conn:
-                self._conns.pop(key, None)
-                conn.close()
-            raise
+        tr = _trace_mod()
+        with tr.TRACES.span(f"rpc.c.{service}.{method}"):
+            if self.addr is not None and tuple(addr) == tuple(self.addr):
+                res = await asyncio.wait_for(
+                    self._invoke(service, method, payload), timeout)
+                if isinstance(res, Sidecars):
+                    return res.resolve()    # zero-copy local substitution
+                return res
+            tctx = tr.inject()
+            key = tuple(addr)
+            lock = self._conn_locks.setdefault(key, asyncio.Lock())
+            async with lock:
+                conn = self._conns.get(key)
+                if conn is None or conn.closed:
+                    reader, writer = await asyncio.open_connection(
+                        *addr, ssl=self.tls_client)
+                    conn = Connection(reader, writer)
+                    self._conns[key] = conn
+            try:
+                return await conn.call(service, method, payload, timeout,
+                                       tctx=tctx)
+            except RpcError as e:
+                if e.code == "NETWORK_ERROR":
+                    self._conns.pop(key, None)
+                raise
+            except asyncio.TimeoutError:
+                # the connection may be wedged (half-open socket):
+                # evict so the next call reconnects
+                if self._conns.get(key) is conn:
+                    self._conns.pop(key, None)
+                    conn.close()
+                raise
 
     async def shutdown(self):
         for c in self._conns.values():

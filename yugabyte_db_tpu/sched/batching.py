@@ -22,15 +22,21 @@ from __future__ import annotations
 from time import perf_counter as _perf_counter
 from typing import List
 
+from ..utils import trace as _trace
+
 
 class WriteItem:
-    """A plain write queued for group commit."""
+    """A plain write queued for group commit.  ``tctx`` captures the
+    submitter's trace context at construction (the RPC handler's
+    context) — the worker task that dispatches the group runs in its
+    own context, so the dispatch span bridges back explicitly."""
 
-    __slots__ = ("peer", "req")
+    __slots__ = ("peer", "req", "tctx")
 
     def __init__(self, peer, req):
         self.peer = peer
         self.req = req
+        self.tctx = _trace.current_context()
 
 
 class PointReadItem:
@@ -38,21 +44,23 @@ class PointReadItem:
     is the wire dict (pk_eq set, no pushdown, no explicit read point —
     the tserver checks eligibility before routing here)."""
 
-    __slots__ = ("peer", "req_wire")
+    __slots__ = ("peer", "req_wire", "tctx")
 
     def __init__(self, peer, req_wire):
         self.peer = peer
         self.req_wire = req_wire
+        self.tctx = _trace.current_context()
 
 
 class ScanItem:
     """A scan/aggregate read queued for signature coalescing; `run`
     executes it (once per GROUP)."""
 
-    __slots__ = ("run",)
+    __slots__ = ("run", "tctx")
 
     def __init__(self, run):
         self.run = run
+        self.tctx = _trace.current_context()
 
 
 async def dispatch_write_group(items: List[tuple], fanin_hist) -> None:
@@ -75,7 +83,13 @@ async def dispatch_write_group(items: List[tuple], fanin_hist) -> None:
                           schema_version=first.req.schema_version)
     fanin_hist.increment(len(items))
     WRITE_PATH_STATS["group_merge_s"] += _perf_counter() - t0
-    await first.peer.write(merged)
+    # dispatch span parents under the FIRST member's request (the
+    # worker task has no ambient context of its own); fanin tags how
+    # many requests shared this one WAL append + apply
+    with _trace.use_context(first.tctx):
+        with _trace.TRACES.span("sched.dispatch.write", child_only=True,
+                                tags={"fanin": len(items)}):
+            await first.peer.write(merged)
     for wb, fut, _, _ in items:
         if not fut.done():
             fut.set_result({"rows_affected": len(wb.req.ops)})
@@ -91,7 +105,11 @@ async def dispatch_point_read_group(items: List[tuple]) -> None:
     first = items[0][0]
     table_id = first.req_wire["table_id"]
     pk_rows = [it[0].req_wire["pk_eq"] for it in items]
-    rows = await first.peer.read_points(table_id, pk_rows)
+    with _trace.use_context(first.tctx):
+        with _trace.TRACES.span("sched.dispatch.point_read",
+                                child_only=True,
+                                tags={"fanin": len(items)}):
+            rows = await first.peer.read_points(table_id, pk_rows)
     for (pr, fut, _, _), row in zip(items, rows):
         cols = tuple(pr.req_wire.get("columns") or ())
         if row is not None and cols:
@@ -109,7 +127,10 @@ async def dispatch_scan_group(items: List[tuple]) -> None:
     explicit read points are part of the signature (identical
     snapshot only)."""
     sb = items[0][0]
-    resp = await sb.run()
+    with _trace.use_context(sb.tctx):
+        with _trace.TRACES.span("sched.dispatch.scan", child_only=True,
+                                tags={"fanin": len(items)}):
+            resp = await sb.run()
     for _, fut, _, _ in items:
         if not fut.done():
             # top-level copy per waiter: local short-circuit callers

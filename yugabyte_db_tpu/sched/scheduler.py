@@ -42,6 +42,7 @@ from typing import Callable, Dict, List, Optional
 from ..rpc.messenger import RECEIVED_AT, RpcError
 from ..utils import fault_injection as fi
 from ..utils import flags, metrics
+from ..utils.trace import TRACE, TRACES, wait_status
 from .batching import (PointReadItem, ScanItem, WriteItem,
                        dispatch_point_read_group, dispatch_scan_group,
                        dispatch_write_group)
@@ -291,6 +292,7 @@ class RequestScheduler:
             # admission-only lane (TXN class — queueing txn control
             # behind txn control can deadlock), or cut-through on an
             # idle pooled lane: dispatch immediately
+            TRACE(f"sched.admit lane={st.lane.value} cut_through")
             st.inflight += 1
             t0 = time.monotonic()
             try:
@@ -306,7 +308,13 @@ class RequestScheduler:
         st.queued_bytes += cost_bytes
         st.m_depth.set(st.depth)
         st.queue.put_nowait(g)
-        return await fut
+        # the queue span measures admission -> dequeue -> dispatch ->
+        # result for THIS request; the worker-side dispatch span (the
+        # shared execution) parents under the group's first member
+        with TRACES.span(f"sched.queue.{st.lane.value}", child_only=True,
+                         tags={"depth": st.depth}):
+            with wait_status("SchedQueue_Wait", component="sched"):
+                return await fut
 
     # --- batched submission ----------------------------------------------
     async def submit_grouped(self, lane: Lane, key, payload, *,
@@ -328,6 +336,7 @@ class RequestScheduler:
         now = time.monotonic()
         if st.queued == 0 and st.inflight < (st.cfg.workers or 1) \
                 and not st.busy() and not fi.lane_armed(st.lane.value):
+            TRACE(f"sched.admit lane={st.lane.value} cut_through")
             st.inflight += 1
             st.m_batch.increment(1)
             st.m_occupancy.increment(100.0 / max(1, st.cfg.max_batch))
@@ -349,7 +358,11 @@ class RequestScheduler:
         g.items.append((payload, fut, cost_bytes, now))
         st.queued += 1
         st.queued_bytes += cost_bytes
-        return await fut
+        with TRACES.span(f"sched.queue.{st.lane.value}", child_only=True,
+                         tags={"depth": st.depth,
+                               "group_members": len(g.items)}):
+            with wait_status("SchedQueue_Wait", component="sched"):
+                return await fut
 
     # --- worker loop ------------------------------------------------------
     async def _worker(self, st: _LaneState):

@@ -32,7 +32,9 @@ from ..docdb.table_codec import TableCodec, TableInfo
 from ..ops.device_batch import DeviceBlockCache
 from ..storage.lsm import LsmStore
 from ..utils import flags, metrics
+from ..utils import trace as _trace
 from ..utils.hybrid_time import HybridClock, HybridTime
+from ..utils.trace import wait_status
 
 # process-wide device block cache shared by all tablets (HBM is global)
 _DEVICE_CACHE = DeviceBlockCache()
@@ -219,22 +221,30 @@ class Tablet:
             if self.regular.freeze_active():
                 self._m_stalls_avoided.increment()
                 FLUSH_APPLY_STATS["handoffs"] += 1
-                _FLUSH_POOL.submit(self._background_flush)
+                _trace.TRACE("flush.handoff")
+                # explicit context capture: the flush-executor thread
+                # has no contextvars from this task, so the handoff
+                # span would otherwise detach from the request tree
+                _FLUSH_POOL.submit(self._background_flush,
+                                   _trace.current_context())
             while (self.regular.frozen_count()
                    > flags.get("max_frozen_memtables")):
                 # the executor fell behind; the apply thread helps
                 # drain one frozen memtable, bounding frozen memory
                 ti = _perf_counter()
-                # analysis-ok(async_blocking): deliberate backpressure
-                if self.regular.flush_frozen() is not None:
-                    _DEVICE_CACHE.invalidate_prefix((id(self.regular),))
+                with wait_status("Flush_MemtableBackpressure",
+                                 component="flush"):
+                    # analysis-ok(async_blocking): deliberate backpressure
+                    if self.regular.flush_frozen() is not None:
+                        _DEVICE_CACHE.invalidate_prefix(
+                            (id(self.regular),))
                 FLUSH_APPLY_STATS["inline_flushes"] += 1
                 FLUSH_APPLY_STATS["inline_s"] += _perf_counter() - ti
             FLUSH_APPLY_STATS["handoff_s"] += _perf_counter() - t0
         finally:
             self._m_flush_pause.increment((_perf_counter() - t0) * 1e3)
 
-    def _background_flush(self) -> None:
+    def _background_flush(self, tctx=None) -> None:
         """Flush-executor job: drain frozen memtables (oldest first,
         serialized by the store's flush IO lock) until the queue is
         empty, invalidating the device cache per install.  NON-blocking
@@ -243,11 +253,23 @@ class Tablet:
         one store's stalled disk would starve every other tablet's
         flushes (the pool is 2 workers wide).  A failed flush leaves
         the frozen memtable queued — the next trigger, an inline drain,
-        or the shutdown flush retries it."""
+        or the shutdown flush retries it.  ``tctx`` is the apply-side
+        trace context captured at the handoff (executor threads see no
+        contextvars), so the SST write shows up in the request's span
+        tree."""
         try:
-            while self.regular.flush_frozen(wait=False) is not None:
-                _DEVICE_CACHE.invalidate_prefix((id(self.regular),))
-                FLUSH_APPLY_STATS["background_flushes"] += 1
+            with _trace.use_context(tctx):
+                with _trace.TRACES.span("flush.background",
+                                        child_only=True) as sp:
+                    with wait_status("Flush_SstWrite", component="flush"):
+                        n = 0
+                        while self.regular.flush_frozen(wait=False) \
+                                is not None:
+                            _DEVICE_CACHE.invalidate_prefix(
+                                (id(self.regular),))
+                            FLUSH_APPLY_STATS["background_flushes"] += 1
+                            n += 1
+                    sp.set_tag("flushed", n)
         except Exception:   # noqa: BLE001 — must not kill the pool
             log.exception("%s: background flush failed (frozen "
                           "memtable retained for retry)", self.tablet_id)

@@ -22,7 +22,9 @@ from ..docdb.operations import ReadRequest, ReadResponse, WriteRequest, \
     WriteResponse
 from ..docdb.wire import write_request_from_wire, write_request_to_wire
 from ..rpc.messenger import Messenger, RpcError
+from ..utils import trace as _trace
 from ..utils.hybrid_time import HybridClock, HybridTime
+from ..utils.trace import wait_status
 from .tablet import Tablet
 
 #: process-wide write-path stage accounting (read by profile_ycsb.py
@@ -414,10 +416,13 @@ class TabletPeer:
         d = msgpack.unpackb(entry.payload, raw=False)
         items = d["batch"] if "batch" in d else [d]
         t0 = _perf_counter()
-        for item in items:
-            req = write_request_from_wire(item["req"])
-            self.tablet.apply_write(req, ht=HybridTime(item["ht"]),
-                                    op_id=(entry.term, entry.index))
+        with _trace.TRACES.span("tablet.apply", child_only=True,
+                                tags={"tablet": self.tablet.tablet_id,
+                                      "entries": len(items)}):
+            for item in items:
+                req = write_request_from_wire(item["req"])
+                self.tablet.apply_write(req, ht=HybridTime(item["ht"]),
+                                        op_id=(entry.term, entry.index))
         WRITE_PATH_STATS["apply_s"] += _perf_counter() - t0
 
     # --- read path --------------------------------------------------------
@@ -446,17 +451,18 @@ class TabletPeer:
             req.server_assigned_read_ht = True
         import time as _time
         deadline = _time.monotonic() + 10.0
-        while self.safe_read_ht(self.clock.now().value) < req.read_ht:
-            if _time.monotonic() > deadline:
-                raise RpcError("in-flight writes below the read time "
-                               "did not drain", "TIMED_OUT")
-            # event-driven wait (drain/apply progress sets it), with a
-            # timeout fallback for wakeups that race the state change
-            ev = self._progress_event
-            try:
-                await asyncio.wait_for(ev.wait(), 0.05)
-            except asyncio.TimeoutError:
-                pass
+        with wait_status("SafeTime_Wait", component="mvcc"):
+            while self.safe_read_ht(self.clock.now().value) < req.read_ht:
+                if _time.monotonic() > deadline:
+                    raise RpcError("in-flight writes below the read time "
+                                   "did not drain", "TIMED_OUT")
+                # event-driven wait (drain/apply progress sets it), with
+                # a timeout fallback for wakeups racing the state change
+                ev = self._progress_event
+                try:
+                    await asyncio.wait_for(ev.wait(), 0.05)
+                except asyncio.TimeoutError:
+                    pass
         return self.tablet.read(req)
 
     async def read_points(self, table_id: str, pk_rows: list) -> list:
@@ -480,15 +486,16 @@ class TabletPeer:
         read_ht = self.clock.now().value
         import time as _time
         deadline = _time.monotonic() + 10.0
-        while self.safe_read_ht(self.clock.now().value) < read_ht:
-            if _time.monotonic() > deadline:
-                raise RpcError("in-flight writes below the read time "
-                               "did not drain", "TIMED_OUT")
-            ev = self._progress_event
-            try:
-                await asyncio.wait_for(ev.wait(), 0.05)
-            except asyncio.TimeoutError:
-                pass
+        with wait_status("SafeTime_Wait", component="mvcc"):
+            while self.safe_read_ht(self.clock.now().value) < read_ht:
+                if _time.monotonic() > deadline:
+                    raise RpcError("in-flight writes below the read time "
+                                   "did not drain", "TIMED_OUT")
+                ev = self._progress_event
+                try:
+                    await asyncio.wait_for(ev.wait(), 0.05)
+                except asyncio.TimeoutError:
+                    pass
         # read EXACTLY at the waited-out read point (a fresh clock.now
         # inside multi_read could run ahead of a write queued during
         # the wait — a write below the read point the wait never

@@ -62,17 +62,33 @@ async def _serve(addr, drain, stop=None) -> None:
     print("DRAINED", flush=True)
 
 
+def _start_ash_sampler():
+    """Background ASH wait-state sampler (utils/trace.AshSampler): one
+    daemon thread per server process, ticking every
+    ``ash_sample_interval_ms`` — what rpc_tracez's histograms and the
+    bench's p99 attribution read."""
+    from ..utils.trace import ASH
+    ASH.start()
+    return ASH
+
+
 async def run_master(args):
     from ..master import Master
     _apply_env_handshake()
+    ash = _start_ash_sampler()
     m = Master(args.fs_root, uuid=args.uuid or "m0")
     addr = await m.start(port=args.port, auto_balance=args.auto_balance)
-    await _serve(addr, m.shutdown)
+
+    async def drain():
+        await m.shutdown()
+        ash.stop()
+    await _serve(addr, drain)
 
 
 async def run_tserver(args):
     from ..tserver import TabletServer
     _apply_env_handshake()
+    ash = _start_ash_sampler()
     masters = []
     for hp in args.masters.split(","):
         if not hp:
@@ -82,7 +98,11 @@ async def run_tserver(args):
     ts = TabletServer(args.uuid or "ts-0", args.fs_root,
                       master_addrs=masters, zone=args.zone)
     addr = await ts.start(port=args.port)
-    await _serve(addr, lambda: ts.shutdown(graceful=True))
+
+    async def drain():
+        await ts.shutdown(graceful=True)
+        ash.stop()
+    await _serve(addr, drain)
 
 
 def main(argv=None):

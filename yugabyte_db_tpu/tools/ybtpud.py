@@ -44,6 +44,11 @@ def _save_ports(data_dir: str, ports: dict) -> None:
 
 
 async def serve(args):
+    # background ASH wait-state sampler (same as server_main): the
+    # /ash endpoint and rpc_tracez histograms are live from the first
+    # request in the all-in-one dev server too
+    from ..utils.trace import ASH
+    ASH.start()
     ports = _load_ports(args.data_dir)
     master = Master(f"{args.data_dir}/master")
     maddr = await master.start(

@@ -190,6 +190,11 @@ class TabletServer:
         self._running = False
         if self._hb_task:
             self._hb_task.cancel()
+        # the ASH sampler is process-global: a dead server's provider
+        # closures must not keep reporting its retained state forever
+        for p in getattr(self, "_ash_providers", ()):
+            ASH.unregister(p)
+        self._ash_providers = []
         await self.scheduler.shutdown()
         if graceful:
             # lease release first: a pinned compaction-victim SST is
@@ -443,8 +448,13 @@ class TabletServer:
                     f"schema version mismatch for {req.table_id}: "
                     f"request {req.schema_version}, tablet {cur}",
                     "SCHEMA_MISMATCH")
-        with TRACES.trace(f"write:{payload['tablet_id']}"):
-            with wait_status("OnCpu_WriteApply"):
+        # sampled span (child of the messenger's server span): the
+        # legacy always-on trace() here taxed EVERY write for a dump
+        # nobody read; sampling keeps the hot path under the bench's
+        # trace-overhead gate while sampled requests get full nesting
+        with TRACES.span(f"tserver.write:{payload['tablet_id']}",
+                         child_only=True):
+            with wait_status("OnCpu_WriteApply", component="tserver"):
                 if not self.scheduler.enabled():
                     resp = await peer.write(req)
                     return {"rows_affected": resp.rows_affected}
@@ -473,8 +483,9 @@ class TabletServer:
 
         async def run():
             req = read_request_from_wire(payload["req"])
-            with TRACES.trace(f"read:{payload['tablet_id']}"):
-                with wait_status("OnCpu_Read"):
+            with TRACES.span(f"tserver.read:{payload['tablet_id']}",
+                             child_only=True):
+                with wait_status("OnCpu_Read", component="tserver"):
                     resp = await peer.read(req)
             return read_response_to_wire(resp)
         if not self.scheduler.enabled():
@@ -495,8 +506,9 @@ class TabletServer:
                 # trace/ASH here: the grouped dispatch never runs run(),
                 # so instrumentation must wrap the submit (span covers
                 # queue wait + the shared batched execution)
-                with TRACES.trace(f"read:{payload['tablet_id']}"):
-                    with wait_status("OnCpu_Read"):
+                with TRACES.span(f"tserver.read:{payload['tablet_id']}",
+                                 child_only=True):
+                    with wait_status("OnCpu_Read", component="tserver"):
                         return await self.scheduler.submit_grouped(
                             Lane.POINT_READ, key, PointReadItem(peer, r),
                             cost_bytes=512)
@@ -1391,17 +1403,24 @@ class TabletServer:
             raise RpcError(f"no local replica of table {table_id}",
                            "NOT_FOUND")
 
+        from ..utils import trace as _trace
+        tctx = _trace.current_context()   # executor threads see no
+                                          # contextvars: bridge explicitly
+
         def _run():
-            with BypassSession(peers, read_ht=req.read_ht,
-                               table_id=table_id) as s:
-                self._bypass_sessions.add(s)
-                try:
-                    outs, counts, stats = s.scan_aggregate(
-                        req.where, req.aggregates, group=req.group_by)
-                    return ([float(x) for x in outs],
-                            s.read_ht, stats)
-                finally:
-                    self._bypass_sessions.discard(s)
+            with _trace.use_context(tctx), \
+                    _trace.TRACES.span("bypass.scan", child_only=True), \
+                    wait_status("Bypass_Scan", component="bypass"):
+                with BypassSession(peers, read_ht=req.read_ht,
+                                   table_id=table_id) as s:
+                    self._bypass_sessions.add(s)
+                    try:
+                        outs, counts, stats = s.scan_aggregate(
+                            req.where, req.aggregates, group=req.group_by)
+                        return ([float(x) for x in outs],
+                                s.read_ht, stats)
+                    finally:
+                        self._bypass_sessions.discard(s)
         try:
             outs, read_ht, stats = await asyncio.get_running_loop() \
                 .run_in_executor(None, _run)
@@ -1411,6 +1430,16 @@ class TabletServer:
         return {"agg_values": outs, "read_ht": read_ht,
                 "stats": {k: v for k, v in (stats or {}).items()
                           if isinstance(v, (int, float, str, bool))}}
+
+    async def rpc_tracez(self, payload) -> dict:
+        """Sampled span dump + ASH wait-state histograms for THIS
+        process, pid+timestamp stamped — the cross-process face of the
+        observability layer (CLUSTER.md; cluster/collector.py stitches
+        dumps from every process into span trees)."""
+        from ..utils import trace as _trace
+        out = _trace.TRACES.tracez()
+        out["uuid"] = self.uuid
+        return out
 
     async def rpc_set_flag(self, payload) -> dict:
         """Hot-update a runtime flag on THIS server (reference:
@@ -1427,13 +1456,54 @@ class TabletServer:
                           for n, f in _flags.REGISTRY.items()}}
 
     # --- heartbeats -------------------------------------------------------
+    def _register_ash_providers(self) -> None:
+        """Component wait-state providers for the ASH sampler: the
+        scheduler's lanes, the flush executor, raft and compaction —
+        coarse "is this component busy/backlogged" signals.  The
+        sampler dedupes them against states already published by
+        wait_status scopes that tick (the session-weighted signal
+        wins; providers only fill the gaps).  Handles are kept so
+        shutdown can UNREGISTER — the sampler is process-global, and
+        a dead server's closures must not keep reporting."""
+        from ..consensus.raft import REPLICATE_INFLIGHT
+
+        def sched_provider():
+            queued = sum(st.queued
+                         for st in self.scheduler.lanes.values())
+            return (f"sched:{self.uuid}",
+                    "SchedQueue_Wait" if queued else "Idle")
+
+        def flush_provider():
+            frozen = sum(p.tablet.regular.frozen_count()
+                         for p in list(self.peers.values()))
+            return (f"flush:{self.uuid}",
+                    "Flush_SstWrite" if frozen else "Idle")
+
+        def raft_provider():
+            return (f"raft:{self.uuid}", "Raft_Replicate"
+                    if REPLICATE_INFLIGHT["n"] > 0 else "Idle")
+
+        def compaction_provider():
+            st = self.scheduler.lanes.get(Lane.MAINTENANCE)
+            busy = st is not None and st.inflight > 0
+            return (f"compaction:{self.uuid}",
+                    "Compaction_Run" if busy else "Idle")
+
+        self._ash_providers = [sched_provider, flush_provider,
+                               raft_provider, compaction_provider]
+        for p in self._ash_providers:
+            ASH.register(p)
+
     async def _heartbeat_loop(self):
-        from ..utils.trace import current_wait_state
-        ASH.register(lambda: (f"ts-{self.uuid}", current_wait_state()))
+        self._register_ash_providers()
         ticks = 0
         while self._running:
             await self._heartbeat_once()
-            ASH.sample_once()
+            if ASH._thread is None:
+                # no background sampler in this process (in-process
+                # test clusters): the heartbeat keeps ASH minimally
+                # live; server_main/ybtpud run the real thread
+                ASH.sample_once()
             ticks += 1
             if ticks % 10 == 0:      # ~every 2s: txn coordinator sweep
                 for p in list(self.peers.values()):

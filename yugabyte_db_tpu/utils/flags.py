@@ -439,6 +439,26 @@ DEFINE_RUNTIME("sched_fusion_max_groups", 8,
                "in-flight dispatch count is workers x (this cap + 1), "
                "not workers.")
 
+# --- observability (utils/trace.py; ISSUE 14) -----------------------------
+DEFINE_RUNTIME("trace_sampling_rate", 0.01,
+               "Fraction of trace ROOTS (requests with no propagated "
+               "context) that record spans; propagated decisions "
+               "(sampled bit on the RPC frame) always win, so a "
+               "harness forcing a sampled root gets the full "
+               "cross-process tree regardless of this rate. 0 "
+               "disables root sampling entirely; the default keeps "
+               "the layer's hot-path cost under the bench-asserted "
+               "2% overhead gate (trace_overhead blocks).")
+DEFINE_RUNTIME("ash_sample_interval_ms", 50,
+               "Period of the background ASH wait-state sampler "
+               "thread (utils/trace.AshSampler.start; started by "
+               "tools/server_main in every server process). Cheap by "
+               "construction: one pass over the active-wait table + "
+               "registered providers per tick.")
+DEFINE_RUNTIME("tracez_keep", 512,
+               "Finished spans retained per process for rpc_tracez / "
+               "rpcz dumps (bounded ring; oldest evicted).")
+
 # TEST_ flags (reference: DEFINE_test_flag, util/flags/flag_tags.h:311)
 DEFINE_RUNTIME("TEST_fault_crash_fraction", 0.0,
                "Probabilistic fault injection fraction (MAYBE_FAULT analog).")

@@ -97,6 +97,37 @@ class Histogram:
     def count(self) -> int:
         return self._total
 
+    def snapshot_stats(self, ps=(50, 95, 99)) -> dict:
+        """count/mean + all requested percentiles from ONE bucket walk
+        under ONE lock acquisition — the bounded-cost path
+        ``snapshot()`` and the Prometheus writer use (the per-
+        ``percentile()`` path re-walked the buckets under its own lock
+        once per percentile, per histogram, per snapshot)."""
+        with self._lock:
+            total = self._total
+            if total == 0:
+                out = {"count": 0, "mean_us": 0.0}
+                out.update({f"p{p}_us": 0.0 for p in ps})
+                return out
+            s = self._sum
+            counts = list(self._counts)
+        out = {"count": total, "mean_us": s / total}
+        targets = [(p, total * p / 100.0) for p in sorted(ps)]
+        ti = 0
+        acc = 0
+        for i, c in enumerate(counts):
+            acc += c
+            bound = float(self._BOUNDS[i] if i < len(self._BOUNDS)
+                          else self._BOUNDS[-1])
+            while ti < len(targets) and acc >= targets[ti][1]:
+                out[f"p{targets[ti][0]}_us"] = bound
+                ti += 1
+            if ti >= len(targets):
+                break
+        for p, _ in targets[ti:]:
+            out[f"p{p}_us"] = float(self._BOUNDS[-1])
+        return out
+
 
 @dataclass
 class MetricEntity:
@@ -150,12 +181,13 @@ class MetricRegistry:
                 elif isinstance(m, Gauge):
                     out.append(f"{m.name}{{{labels}}} {m.value()}")
                 elif isinstance(m, Histogram):
-                    out.append(f"{m.name}_count{{{labels}}} {m.count()}")
+                    st = m.snapshot_stats()
+                    out.append(f"{m.name}_count{{{labels}}} {st['count']}")
                     out.append(f"{m.name}_sum{{{labels}}} {m._sum}")
                     for p in (50, 95, 99):
                         out.append(
                             f"{m.name}{{{labels},quantile=\"0.{p}\"}} "
-                            f"{m.percentile(p)}")
+                            f"{st[f'p{p}_us']}")
         return "\n".join(out) + "\n"
 
     def to_json(self) -> list:
@@ -181,19 +213,20 @@ def snapshot() -> dict:
     pid — the cross-process face of the registry (control RPC
     `metrics_snapshot`; the in-process callers keep using REGISTRY
     directly).  Histograms ship count/sum/percentiles so supervisors
-    can assert on latency without reaching into the process."""
-    out = {"pid": os.getpid(), "entities": []}
+    can assert on latency without reaching into the process.  Stamped
+    with pid AND wall time so a harness collector can order dumps from
+    many processes (the same contract as trace.tracez())."""
+    import time as _time
+    out = {"pid": os.getpid(), "ts": _time.time(), "entities": []}
     for e in REGISTRY.entities():
         ent = {"type": e.type, "id": e.id, "attributes": e.attributes,
                "metrics": {}}
         # list() first: worker threads register metrics concurrently
         for m in list(e.metrics.values()):
             if isinstance(m, Histogram):
-                ent["metrics"][m.name] = {
-                    "count": m.count(), "mean_us": m.mean(),
-                    "p50_us": m.percentile(50),
-                    "p95_us": m.percentile(95),
-                    "p99_us": m.percentile(99)}
+                # one lock + one bucket walk per histogram (the old
+                # path paid a separate locked walk per percentile)
+                ent["metrics"][m.name] = m.snapshot_stats()
             else:
                 ent["metrics"][m.name] = m.value()
         out["entities"].append(ent)

@@ -1,48 +1,190 @@
-"""Request tracing + ASH (Active Session History) wait-state sampling.
+"""Distributed request tracing + ASH (Active Session History).
 
 Reference: per-request Trace objects appended via TRACE() macros and
 dumped on slow requests or /rpcz (src/yb/util/trace.h:88-113); ASH
 cross-component wait-state annotation via SET_WAIT_STATUS /
 SCOPED_WAIT_STATUS (src/yb/ash/wait_state.h:35-66) with a background
 sampler feeding a history buffer.
+
+This module is the system-wide observability layer (ISSUE 14):
+
+- SPANS: every ``Trace`` is a span in a distributed trace — it carries
+  ``(trace_id, span_id, parent_id, sampled)``.  ``TRACES.span(name)``
+  opens a child of the ambient context (one ``contextvars`` read);
+  roots are sampled at ``trace_sampling_rate`` so the layer stays
+  cheap by default.  The RPC layer injects/extracts the 3-tuple wire
+  form ``[trace_id, span_id, sampled]`` on every frame
+  (rpc/messenger.py), which is how one user write becomes one
+  cross-process span tree (client -> leader append/fsync -> follower
+  append -> apply -> flush handoff).
+- EXECUTOR HOPS: a ``contextvars`` context does NOT survive
+  ``run_in_executor`` / ``ThreadPoolExecutor.submit``.  Callers bridge
+  explicitly: capture ``current_context()`` before the hop and wrap
+  the thread-side body in ``use_context(ctx)`` (the flush executor,
+  bypass sessions and compaction jobs all do).
+- ASH: ``wait_status(state)`` scopes publish into a process-global
+  active-wait table that a background sampler thread
+  (``ASH.start()``; ``ash_sample_interval_ms``) snapshots — so a
+  sampler can see a WAL fsync or a frozen-memtable backpressure stall
+  in SOME OTHER thread, which the old contextvar-only read never
+  could.  States come from the canonical ``WAIT_STATES`` table; free
+  text raises here and is rejected statically by the
+  ``trace_discipline`` analysis pass.
+- tracez(): the pid+timestamp-stamped cross-process dump served by the
+  ``rpc_tracez`` RPCs and stitched by cluster/collector.py.
 """
 from __future__ import annotations
 
 import contextvars
+import itertools
+import os
+import random
 import threading
 import time
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Deque, Dict, List, Optional
+from typing import Deque, Dict, List, NamedTuple, Optional
 
 _current_trace: contextvars.ContextVar = contextvars.ContextVar(
     "ybtpu_trace", default=None)
 
 
+class SpanContext(NamedTuple):
+    """The propagated identity of a span: what crosses RPC frames and
+    executor hops.  ``sampled=False`` propagates as a no-op — children
+    allocate nothing and record nothing."""
+
+    trace_id: int
+    span_id: int
+    sampled: bool
+
+
+#: the ambient context after an UNSAMPLED root decision: children see
+#: "a trace exists and it is off" instead of re-rolling the sampler.
+_UNSAMPLED_CTX = SpanContext(0, 0, False)
+
+_rng = random.Random(os.urandom(8))
+
+
+def _new_id() -> int:
+    return _rng.getrandbits(63) or 1
+
+
+def _flag(name: str, default):
+    from . import flags
+    try:
+        return flags.get(name)
+    except KeyError:        # flag module not initialized (unit tests)
+        return default
+
+
 @dataclass
 class Trace:
+    """One span.  Kept under its historical name: the pre-span
+    ``Trace`` API (``add``/``finish``/``dump``) is a strict subset."""
+
     name: str
+    trace_id: int = 0
+    span_id: int = 0
+    parent_id: int = 0
+    sampled: bool = True
     start: float = field(default_factory=time.monotonic)
+    start_unix: float = field(default_factory=time.time)
     events: List[tuple] = field(default_factory=list)
+    tags: Dict[str, object] = field(default_factory=dict)
     done: Optional[float] = None
+    dropped_events: int = 0
+
+    #: per-span event cap: a chatty span (a tight loop calling TRACE)
+    #: must stay O(1) memory and O(cap) to dump — past the cap events
+    #: are counted, not stored (the count lands in the dump tail)
+    MAX_EVENTS = 512
 
     def add(self, message: str) -> None:
-        self.events.append((time.monotonic() - self.start, message))
+        # monotonic-stamp fast path: stamps relative to `start`, and
+        # never throws — a late event (a thread racing finish(), or a
+        # registry dump mid-append) degrades to a dropped event, not an
+        # exception on the hot path it instruments
+        try:
+            if len(self.events) < self.MAX_EVENTS:
+                self.events.append(
+                    (time.monotonic() - self.start, message))
+            else:
+                self.dropped_events += 1
+        except Exception:   # noqa: BLE001 — observability must not throw
+            pass
+
+    def set_tag(self, key: str, value) -> None:
+        try:
+            self.tags[key] = value
+        except Exception:   # noqa: BLE001 — observability must not throw
+            pass
+
+    @property
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id, self.sampled)
 
     def finish(self) -> float:
         self.done = time.monotonic()
         return self.done - self.start
 
-    def dump(self) -> str:
-        out = [f"trace {self.name} ({(self.done or time.monotonic()) - self.start:.6f}s)"]
-        for dt, msg in self.events:
+    def duration_s(self) -> float:
+        return (self.done or time.monotonic()) - self.start
+
+    def dump(self, events: Optional[list] = None) -> str:
+        evs = list(self.events) if events is None else events
+        out = [f"trace {self.name} ({self.duration_s():.6f}s)"]
+        for dt, msg in evs:
             out.append(f"  {dt*1000:8.3f}ms  {msg}")
+        if self.dropped_events:
+            out.append(f"  ... {self.dropped_events} events dropped "
+                       f"(cap {self.MAX_EVENTS})")
         return "\n".join(out)
+
+    def to_dict(self) -> dict:
+        """Wire/JSON form for tracez dumps (events capped so one chatty
+        span cannot bloat a cross-process dump)."""
+        evs = list(self.events)[:256]
+        return {
+            "trace_id": self.trace_id, "span_id": self.span_id,
+            "parent_id": self.parent_id, "name": self.name,
+            "start_unix": self.start_unix,
+            "duration_ms": round(self.duration_s() * 1e3, 3),
+            "finished": self.done is not None,
+            "tags": dict(self.tags),
+            "events": [[round(dt * 1e3, 3), str(m)] for dt, m in evs],
+        }
+
+
+class _NoopSpan:
+    """Shared span stand-in when the trace is unsampled: every method
+    is a no-op, so callers never branch."""
+
+    __slots__ = ()
+    sampled = False
+    trace_id = span_id = parent_id = 0
+    name = ""
+
+    def add(self, message: str) -> None:
+        pass
+
+    def set_tag(self, key: str, value) -> None:
+        pass
+
+    def finish(self) -> float:
+        return 0.0
+
+    @property
+    def context(self) -> SpanContext:
+        return _UNSAMPLED_CTX
+
+
+_NOOP = _NoopSpan()
 
 
 class TraceRegistry:
-    """Keeps recent finished traces for /rpcz-style introspection."""
+    """Keeps recent finished spans for /rpcz + rpc_tracez."""
 
     def __init__(self, keep: int = 200, slow_threshold_s: float = 0.5):
         self.recent: Deque[Trace] = deque(maxlen=keep)
@@ -51,9 +193,49 @@ class TraceRegistry:
         self._lock = threading.Lock()
         self._next = 0
 
+    def _ensure_keep(self) -> None:
+        keep = int(_flag("tracez_keep", self.recent.maxlen or 200))
+        if keep > 0 and keep != self.recent.maxlen:
+            with self._lock:
+                self.recent = deque(self.recent, maxlen=keep)
+
     @contextmanager
-    def trace(self, name: str):
-        t = Trace(name)
+    def span(self, name: str, parent="inherit", tags: Optional[dict] = None,
+             child_only: bool = False, force: bool = False):
+        """Open a span.
+
+        - parent="inherit" (default): child of the ambient context.
+        - No ambient context: a ROOT, sampled at
+          ``trace_sampling_rate`` (``force=True`` records regardless —
+          the legacy ``trace()`` API and test harnesses use it;
+          ``child_only=True`` refuses to root at all — for seams like
+          raft broadcasts that are only meaningful inside a request).
+        - Unsampled context: yields a shared no-op span.
+        """
+        cur = _current_trace.get() if parent == "inherit" else parent
+        pctx = cur.context if isinstance(cur, Trace) else cur
+        if pctx is not None and not pctx.sampled and not force:
+            yield _NOOP
+            return
+        if pctx is None and not force:
+            if child_only:
+                yield _NOOP
+                return
+            rate = float(_flag("trace_sampling_rate", 0.0))
+            if rate <= 0.0 or _rng.random() >= rate:
+                token = _current_trace.set(_UNSAMPLED_CTX)
+                try:
+                    yield _NOOP
+                finally:
+                    _current_trace.reset(token)
+                return
+        inherit = pctx is not None and pctx.sampled
+        t = Trace(name,
+                  trace_id=pctx.trace_id if inherit else _new_id(),
+                  parent_id=pctx.span_id if inherit else 0,
+                  span_id=_new_id())
+        if tags:
+            t.tags.update(tags)
         with self._lock:
             tid = self._next
             self._next += 1
@@ -68,14 +250,37 @@ class TraceRegistry:
                 self.active.pop(tid, None)
                 self.recent.append(t)
 
+    @contextmanager
+    def trace(self, name: str):
+        """Legacy always-recorded trace (now: a force-sampled span —
+        a child when a sampled context is ambient, a root otherwise)."""
+        with self.span(name, force=True) as t:
+            yield t
+
     def rpcz(self) -> dict:
+        # event lists snapshot UNDER the registry lock: handler threads
+        # append to active traces while we dump (the PR-14 race fix —
+        # the old path iterated live lists outside any lock)
         with self._lock:
-            return {
-                "active": [t.dump() for t in self.active.values()],
-                "recent_slow": [
-                    t.dump() for t in self.recent
-                    if t.done and (t.done - t.start) > self.slow_threshold_s],
-            }
+            act = [(t, list(t.events)) for t in self.active.values()]
+            rec = [(t, list(t.events)) for t in self.recent
+                   if t.done and (t.done - t.start) > self.slow_threshold_s]
+        return {
+            "active": [t.dump(evs) for t, evs in act],
+            "recent_slow": [t.dump(evs) for t, evs in rec],
+        }
+
+    def tracez(self) -> dict:
+        """Cross-process span dump: pid+timestamp stamped so a
+        harness-side collector can order dumps from many processes
+        (cluster/collector.py stitches them into span trees)."""
+        self._ensure_keep()
+        with self._lock:
+            spans = [t.to_dict() for t in self.recent]
+            active = [t.to_dict() for t in self.active.values()]
+        return {"pid": os.getpid(), "ts": time.time(),
+                "spans": spans, "active": active,
+                "ash": ASH.summary()}
 
 
 TRACES = TraceRegistry()
@@ -83,22 +288,140 @@ TRACES = TraceRegistry()
 
 def TRACE(message: str) -> None:
     t = _current_trace.get()
-    if t is not None:
+    if isinstance(t, Trace):
         t.add(message)
 
 
-# --- ASH ------------------------------------------------------------------
-_wait_state: contextvars.ContextVar = contextvars.ContextVar(
-    "ybtpu_wait_state", default="Idle")
+def current_context() -> Optional[SpanContext]:
+    """The ambient span context (for explicit capture across executor
+    hops, scheduler queues and fused-append groups)."""
+    cur = _current_trace.get()
+    if cur is None:
+        return None
+    return cur.context if isinstance(cur, Trace) else cur
+
+
+def inject() -> Optional[list]:
+    """Wire form of the ambient context: ``[trace_id, span_id,
+    sampled]`` (what every RPC frame carries), or None when no trace
+    has been started at all."""
+    ctx = current_context()
+    if ctx is None:
+        return None
+    return [ctx.trace_id, ctx.span_id, 1 if ctx.sampled else 0]
+
+
+def extract(wire) -> Optional[SpanContext]:
+    """Parse the wire 3-tuple back into a SpanContext (None/garbage ->
+    no context: the frame predates tracing or carries nothing)."""
+    try:
+        if not wire:
+            return None
+        tid, sid, sampled = wire[0], wire[1], wire[2]
+        if not sampled:
+            return _UNSAMPLED_CTX
+        return SpanContext(int(tid), int(sid), True)
+    except Exception:   # noqa: BLE001 — a bad frame must not kill RPC
+        return None
 
 
 @contextmanager
-def wait_status(state: str):
-    """SCOPED_WAIT_STATUS analog."""
-    token = _wait_state.set(state)
+def use_context(ctx: Optional[SpanContext]):
+    """Re-establish a captured context on the far side of an executor
+    or thread hop (contextvars do NOT survive ``run_in_executor``)."""
+    if ctx is None:
+        yield
+        return
+    token = _current_trace.set(ctx)
     try:
         yield
     finally:
+        _current_trace.reset(token)
+
+
+@contextmanager
+def device_span(kind: str, signature=None, compiled: bool = False,
+                bucket=None, rows=None):
+    """Per-kernel-launch telemetry: a span tagged {signature,
+    compile|cache_hit, bucket, rows}, so a compile landing inside a
+    measured round is VISIBLE in the trace instead of inferred from
+    compile counters.  One contextvar read when no sampled trace is
+    ambient — safe on the hot path."""
+    cur = _current_trace.get()
+    if not isinstance(cur, Trace):
+        yield None
+        return
+    sig = (f"{hash(signature) & 0xFFFFFFFFFFFFFFFF:016x}"
+           if signature is not None else None)
+    with TRACES.span(
+            f"device.{kind}", child_only=True,
+            tags={"signature": sig,
+                  "codepath": "compile" if compiled else "cache_hit",
+                  "bucket": bucket, "rows": rows}) as sp:
+        yield sp
+
+
+# --- ASH ------------------------------------------------------------------
+
+#: Canonical wait-state table — the ONLY strings ``wait_status()``
+#: accepts.  The ``trace_discipline`` analysis pass statically rejects
+#: any call-site literal outside this set (no free-text drift), and the
+#: runtime check below makes a missed site fail loudly in tests.
+WAIT_STATES = frozenset({
+    "Idle",
+    # on-CPU request classes (the not-blocked buckets)
+    "OnCpu_Read",
+    "OnCpu_WriteApply",
+    # durability boundaries
+    "WAL_Fsync",
+    "Catalog_Fsync",
+    # consensus
+    "Raft_Replicate",
+    "Raft_ApplyWait",
+    # MVCC / leadership waits
+    "SafeTime_Wait",
+    "LeaderLease_Wait",
+    # storage / flush executor
+    "Flush_MemtableBackpressure",
+    "Flush_SstWrite",
+    "Compaction_Run",
+    # device kernels
+    "Device_BlockUntilReady",
+    "Device_Compile",
+    # scheduler
+    "SchedQueue_Wait",
+    # analytics bypass
+    "Bypass_Scan",
+    # generic lock contention
+    "Lock_Wait",
+})
+
+_wait_state: contextvars.ContextVar = contextvars.ContextVar(
+    "ybtpu_wait_state", default="Idle")
+
+#: process-global active-wait table: key -> (component, state).
+#: Writers are lock-free (GIL-atomic dict set/pop); the sampler thread
+#: snapshots it, retrying the rare resize race.
+_ACTIVE_WAITS: Dict[int, tuple] = {}
+_wait_seq = itertools.count()
+
+
+@contextmanager
+def wait_status(state: str, component: str = ""):
+    """SCOPED_WAIT_STATUS analog.  Publishes into the process-global
+    active-wait table so the ASH sampler THREAD can attribute blocked
+    time in any thread/task, not just its own context."""
+    if state not in WAIT_STATES:
+        raise ValueError(
+            f"wait state {state!r} is not in the canonical "
+            f"trace.WAIT_STATES table (trace_discipline)")
+    token = _wait_state.set(state)
+    key = next(_wait_seq)
+    _ACTIVE_WAITS[key] = (component, state)
+    try:
+        yield
+    finally:
+        _ACTIVE_WAITS.pop(key, None)
         _wait_state.reset(token)
 
 
@@ -107,36 +430,125 @@ def current_wait_state() -> str:
 
 
 class AshSampler:
-    """Periodic sampler of wait states into a bounded history ring."""
+    """Periodic sampler of wait states into a bounded history ring.
+
+    ``sample_once`` snapshots the process-global active-wait table plus
+    every registered provider; ``start()`` runs it on a background
+    daemon thread every ``ash_sample_interval_ms``.  A crashing
+    provider is swallowed (it must never kill the sampler — regression
+    pinned in tests/test_observability.py)."""
 
     def __init__(self, keep: int = 10_000):
         self.samples: Deque[tuple] = deque(maxlen=keep)
         self._registered: List = []   # callables returning (name, state)
         self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.samples_taken = 0
+        # monotonic per-state tallies: unlike the sliding-window
+        # histogram these DIFF cleanly across round boundaries, which
+        # is what the bench's p99 attribution needs
+        self._cum: Dict[str, int] = {}
 
     def register(self, provider) -> None:
         with self._lock:
             self._registered.append(provider)
 
+    def unregister(self, provider) -> None:
+        """Remove a provider (server shutdown must not leave closures
+        over dead servers reporting forever on the process-global
+        sampler)."""
+        with self._lock:
+            try:
+                self._registered.remove(provider)
+            except ValueError:
+                pass
+
     def sample_once(self) -> None:
         now = time.time()
+        waits: List[tuple] = []
+        for _ in range(4):
+            try:
+                waits = list(_ACTIVE_WAITS.values())
+                break
+            except RuntimeError:   # resized mid-iteration: retry
+                continue
+        seen_states = set()
+        for comp, state in waits:
+            if state != "Idle":
+                self._record(now, comp or "wait", state)
+                seen_states.add(state)
         with self._lock:
             providers = list(self._registered)
         for p in providers:
             try:
                 name, state = p()
-            except Exception:
-                continue
-            if state != "Idle":
-                self.samples.append((now, name, state))
+            except Exception:   # noqa: BLE001 — a crashing provider
+                continue        # must never kill the sampler
+            # providers are COARSE fallbacks: a state already sampled
+            # from a wait_status scope this tick (session-weighted,
+            # the better signal) is not double-counted by a component
+            # saying the same thing
+            if state != "Idle" and state not in seen_states:
+                self._record(now, name, state)
+        self.samples_taken += 1
+
+    def _record(self, now: float, name: str, state: str) -> None:
+        self.samples.append((now, name, state))
+        self._cum[state] = self._cum.get(state, 0) + 1
+
+    def start(self, interval_ms: Optional[float] = None) -> None:
+        """Run the sampler on a daemon thread (idempotent).  Without
+        an explicit ``interval_ms`` the ``ash_sample_interval_ms``
+        flag is re-read every tick — it is a RUNTIME flag, so a hot
+        update through rpc_set_flag takes effect immediately."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop = threading.Event()
+        stop = self._stop
+
+        def loop():
+            while True:
+                iv = (interval_ms if interval_ms is not None
+                      else _flag("ash_sample_interval_ms", 50))
+                if stop.wait(max(1.0, float(iv)) / 1000.0):
+                    return
+                self.sample_once()
+
+        self._thread = threading.Thread(target=loop, name="ash-sampler",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            self._stop.set()
+            self._thread.join(1.0)
+            self._thread = None
 
     def histogram(self, last_s: float = 60.0) -> Dict[str, int]:
         cutoff = time.time() - last_s
         out: Dict[str, int] = {}
-        for ts, _name, state in self.samples:
+        for ts, _name, state in list(self.samples):
             if ts >= cutoff:
                 out[state] = out.get(state, 0) + 1
         return out
+
+    def summary(self, last_s: float = 60.0) -> dict:
+        """JSON-able image for rpc_tracez: windowed histogram,
+        monotonic per-state tallies, per-component split."""
+        cutoff = time.time() - last_s
+        by_state: Dict[str, int] = {}
+        by_comp: Dict[str, Dict[str, int]] = {}
+        for ts, name, state in list(self.samples):
+            if ts < cutoff:
+                continue
+            by_state[state] = by_state.get(state, 0) + 1
+            d = by_comp.setdefault(name, {})
+            d[state] = d.get(state, 0) + 1
+        return {"wait_states": by_state,
+                "by_component": by_comp,
+                "cumulative": dict(self._cum),
+                "samples_taken": self.samples_taken}
 
 
 ASH = AshSampler()
