@@ -1737,6 +1737,7 @@ def trace_overhead_bench():
 # sitting silently inside the JSON (satellite of PR 3; Q6's r05
 # vs_baseline of 0.923 went unnoticed for a round)
 _RATIO_KEYS = ("vs_baseline", "speedup", "vs_cpu", "vs_xla",
+               "shred_vs_interp",
                "p99_ratio_on_vs_off", "achieved_ratio_on_vs_off",
                "stream_vs_mono", "v2_vs_v1_bytes", "prune_speedup",
                "bypass_vs_hotpath", "bypass_p99_impact",
@@ -1896,6 +1897,76 @@ def _make_compaction_tablet(data, n_ssts, rows_per_sst, tag):
         t.bulk_load(batch, ht=HybridTime.from_micros(base_us + i * 1000))
     assert len(t.regular.ssts) >= n_ssts
     return t
+
+
+def doc_scan_bench(repeats):
+    """Document shredding (docstore/): a selective path predicate +
+    aggregates over ~1M JSON documents, shredded v2 lanes on the
+    device path vs the interpreted row-at-a-time JSON extractor
+    (``doc_shred_enabled=False`` at read time is byte-for-byte that
+    path over the SAME SSTs).  The request exercises the int-path
+    compare, the exact int64 SUM over a shredded lane, and the
+    dict-code MAX decode satellite in one shot; shred_coverage (the
+    fraction of scanned rows served from shredded lanes) is asserted
+    nonzero and shred_vs_interp WARN-wires like stream_vs_mono.
+    Interpreted rounds cost ~10s/M rows, so the interpreted side runs
+    once (the >=10x margin dwarfs round noise)."""
+    from yugabyte_db_tpu.docdb.operations import ReadRequest
+    from yugabyte_db_tpu.docstore import (DOC_STATS, DOC_WRITE_STATS,
+                                          LAST_DOC_STATS)
+    from yugabyte_db_tpu.models.docbench import (doc_qty_query,
+                                                 docs_info,
+                                                 generate_docs)
+    from yugabyte_db_tpu.tablet import Tablet
+    from yugabyte_db_tpu.utils import flags
+
+    n = int(os.environ.get("BENCH_DOC_ROWS", str(1_000_000)))
+    data = generate_docs(n)
+    t = Tablet("docs-bench", docs_info(),
+               tempfile.mkdtemp(prefix="ybtpu-doc-"))
+    t0 = time.perf_counter()
+    t.bulk_load(data, block_rows=65536)
+    load_s = time.perf_counter() - t0
+    where, aggs = doc_qty_query()
+
+    def req():
+        return ReadRequest("docs", where=where, aggregates=aggs)
+
+    warm = t.read(req())                   # compile + warm
+    assert warm.backend == "tpu", \
+        f"doc pushdown fell back: {DOC_STATS}"
+    coverage = LAST_DOC_STATS.get("coverage", 0.0)
+    assert coverage > 0, f"shred_coverage {coverage}"
+    shred_ts = []
+    for _ in range(max(2, repeats)):
+        t0 = time.perf_counter()
+        sresp = t.read(req())
+        shred_ts.append(time.perf_counter() - t0)
+    flags.set_flag("doc_shred_enabled", False)
+    try:
+        t0 = time.perf_counter()
+        iresp = t.read(req())
+        interp_t = time.perf_counter() - t0
+    finally:
+        flags.REGISTRY.reset("doc_shred_enabled")
+    assert iresp.backend == "cpu"
+    a = [np.asarray(v).tolist() for v in sresp.agg_values]
+    b = [np.asarray(v).tolist() for v in iresp.agg_values]
+    assert a == b, f"doc shredded/interpreted parity: {a} != {b}"
+    shred_t = min(shred_ts)
+    return {
+        "rows": n, "load_s": round(load_s, 2),
+        "agg_values": a,
+        "shred_rows_per_s": round(n / shred_t, 1),
+        "interp_rows_per_s": round(n / interp_t, 1),
+        "shred_s": round(shred_t, 4),
+        "interp_s": round(interp_t, 4),
+        "shred_vs_interp": round(interp_t / shred_t, 2),
+        "shred_coverage": coverage,
+        "paths_referenced": LAST_DOC_STATS.get("paths"),
+        "write_stats": dict(DOC_WRITE_STATS),
+        "fallback_reasons": dict(DOC_STATS.get("reasons", {})),
+    }
 
 
 def main():
@@ -2171,6 +2242,17 @@ def main():
     # device over scan-global dictionary codes; grouped_vs_interp
     # WARN-wires like stream_vs_mono)
     results["q1_grouped"] = q1_grouped_bench(data, repeats)
+
+    # --- document shredding: path predicates over JSON as columnar
+    # lanes vs the interpreted extractor (docstore/) -------------------
+    try:
+        results["doc_scan"] = doc_scan_bench(repeats)
+    except AssertionError:
+        raise   # a parity/coverage break IS a bench failure
+    except Exception as e:   # noqa: BLE001 — report, don't fail bench
+        if os.environ.get("BENCH_DEBUG"):
+            raise
+        results["doc_scan"] = {"error": str(e)[:300]}
 
     # --- device hash join + fused plans (Q3/Q5-shaped join+group) -------
     try:
@@ -2536,6 +2618,7 @@ def main():
         # string-keyed Q1 through the streamed grouped kernel vs the
         # interpreted GROUP BY (+ cardinality sweep, CPU-twin oracle)
         "q1_grouped": results["q1_grouped"],
+        "doc_scan": results["doc_scan"],
         "q1_dist8": {
             "rows_per_s": round(results["q1_dist"]["rows_per_s"], 1),
             "combine": results["q1_dist"]["combine"]},

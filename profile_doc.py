@@ -1,0 +1,143 @@
+"""Profile the document-shredding subsystem's stage split.
+
+`--json` prints ONE JSON object covering both sides of the docstore:
+
+  write side   per-path lane bytes + lane-codec encoding chosen +
+               presence-lane bytes (a re-shred of every SST block's
+               JSON lane through docstore.shred with a stats dict),
+               with the infer/shred wall split
+  scan side    shredded path-predicate scan stage split (rewrite +
+               attach wall, streamed batch-build vs kernel wall from
+               LAST_STREAM_STATS, coverage), against the interpreted
+               extractor wall on the same SSTs
+
+Env knobs: PROFILE_DOC_ROWS (default 200000), PROFILE_ROUNDS
+(default 3), PROFILE_DOC_CHUNK (streamed chunk rows, default 65536).
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("YBTPU_PLATFORM", "cpu")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def profile_json() -> dict:
+    import numpy as np
+
+    from yugabyte_db_tpu.docdb.operations import ReadRequest
+    from yugabyte_db_tpu.docstore import (DOC_STATS, DOC_WRITE_STATS,
+                                          LAST_DOC_STATS, shred_lanes)
+    from yugabyte_db_tpu.docstore.shred import infer_paths, \
+        serialize_shred
+    from yugabyte_db_tpu.models.docbench import (DOC_COL,
+                                                 doc_qty_query,
+                                                 docs_info,
+                                                 generate_docs)
+    from yugabyte_db_tpu.ops.stream_scan import LAST_STREAM_STATS
+    from yugabyte_db_tpu.tablet import Tablet
+    from yugabyte_db_tpu.utils import flags
+
+    n = int(os.environ.get("PROFILE_DOC_ROWS", "200000"))
+    rounds = int(os.environ.get("PROFILE_ROUNDS", "3"))
+    chunk = int(os.environ.get("PROFILE_DOC_CHUNK", "65536"))
+
+    data = generate_docs(n)
+    t = Tablet("docs-prof", docs_info(),
+               tempfile.mkdtemp(prefix="doc-prof-"))
+    t0 = time.perf_counter()
+    t.bulk_load(data, block_rows=65536)
+    load_s = time.perf_counter() - t0
+
+    # --- write side: re-shred every block's JSON lane with stats ----
+    lane_stats: dict = {}
+    infer_s = 0.0
+    shred_s = 0.0
+    blocks = 0
+    r = t.regular.ssts[0]
+    for i in range(r.num_blocks()):
+        cb = r.columnar_block(i)
+        ends, heap, null = cb.varlen[DOC_COL]
+        t0 = time.perf_counter()
+        infer_paths(ends, heap, null)
+        infer_s += time.perf_counter() - t0
+        t0 = time.perf_counter()
+        bufs: list = []
+        serialize_shred(ends, heap, null, bufs, lane_stats)
+        shred_s += time.perf_counter() - t0
+        blocks += 1
+    raw_json_bytes = sum(
+        len(r.columnar_block(i).varlen[DOC_COL][1])
+        for i in range(r.num_blocks()))
+    write_side = {
+        "blocks": blocks,
+        "raw_json_bytes": raw_json_bytes,
+        "infer_s": round(infer_s, 4),
+        # serialize_shred re-runs inference internally; the pure
+        # shred/encode wall is the difference
+        "shred_encode_s": round(max(shred_s - infer_s, 0.0), 4),
+        "per_path": lane_stats.get("shred_paths", {}),
+        "lane_encodings": {
+            k: v for k, v in lane_stats.get("lanes", {}).items()},
+        "cumulative_write_stats": dict(DOC_WRITE_STATS),
+    }
+
+    # --- scan side: shredded vs interpreted stage split -------------
+    where, aggs = doc_qty_query()
+    flags.set_flag("streaming_chunk_rows", chunk)
+
+    def req():
+        return ReadRequest("docs", where=where, aggregates=aggs)
+
+    warm = t.read(req())
+    assert warm.backend == "tpu", f"fell back: {DOC_STATS}"
+    shred_ts = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        t.read(req())
+        shred_ts.append(time.perf_counter() - t0)
+    stream = dict(LAST_STREAM_STATS)
+    doc_stats = dict(LAST_DOC_STATS)
+    flags.set_flag("doc_shred_enabled", False)
+    try:
+        t0 = time.perf_counter()
+        t.read(req())
+        interp_t = time.perf_counter() - t0
+    finally:
+        flags.REGISTRY.reset("doc_shred_enabled")
+    flags.REGISTRY.reset("streaming_chunk_rows")
+    shred_t = min(shred_ts)
+    return {
+        "rows": n, "load_s": round(load_s, 3),
+        "write_side": write_side,
+        "scan_side": {
+            "shred_s": round(shred_t, 4),
+            "interp_s": round(interp_t, 4),
+            "shred_rows_per_s": round(n / shred_t, 1),
+            "interp_rows_per_s": round(n / interp_t, 1),
+            "shred_vs_interp": round(interp_t / shred_t, 2),
+            "coverage": doc_stats.get("coverage"),
+            "paths_referenced": doc_stats.get("paths"),
+            "stream_build_s": stream.get("build_s"),
+            "stream_kernel_s": stream.get("kernel_s"),
+            "stream_chunks": stream.get("chunks"),
+            "zone_blocks_pruned": stream.get("zone_blocks_pruned"),
+            "key_rebuilds": stream.get("key_rebuilds"),
+        },
+        "fallback_reasons": dict(DOC_STATS.get("reasons", {})),
+    }
+
+
+def main() -> int:
+    out = profile_json()
+    if "--json" in sys.argv:
+        print(json.dumps(out))
+    else:
+        print(json.dumps(out, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

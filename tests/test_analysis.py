@@ -708,6 +708,35 @@ class TestFormatGate:
         assert _findings(r) == []
         assert r["suppressions"]["format_gate"] == 1
 
+    def test_shred_cols_literal_on_serializer_flagged(self, tmp_path):
+        """A serializer call feeding a non-empty literal shred_cols
+        would emit shredded doc lanes even with doc_shred_enabled off
+        — the writer gate lives in SstWriter, nowhere else."""
+        files = dict(self.FILES)
+        files["pkg/writer.py"] = """\
+            def dump(cb, fmt, kb):
+                return cb.serialize_parts(fmt, kb, None,
+                                          shred_cols=(1, 2))
+            """
+        r = _run(tmp_path, files, "format_gate")
+        assert [d for _, _, d in _findings(r)] == ["shred_cols literal"]
+
+    def test_shred_cols_through_writer_allowed(self, tmp_path):
+        """SstWriter(shred_cols=...) resolves the doc_shred_enabled
+        flag itself — threading codec.shred_cols through it (or an
+        empty/None literal on a serializer) is the sanctioned path."""
+        files = dict(self.FILES)
+        files["pkg/writer.py"] = """\
+            from .sstlib import SstWriter
+            def dump(path, cb, codec, fmt, kb):
+                w = SstWriter(path, shred_cols=codec.shred_cols)
+                head, bufs = cb.serialize_parts(fmt, kb, None,
+                                                shred_cols=())
+                return w, head, bufs
+            """
+        r = _run(tmp_path, files, "format_gate")
+        assert _findings(r) == []
+
 
 class TestLayering:
     """bypass/ must not import tserver/sched/rpc — the subsystem's
@@ -788,6 +817,27 @@ class TestLayering:
         assert layers == ["bypass", "master", "storage", "tablet",
                          "tserver"]
         assert all(f == "yugabyte_db_tpu/cluster/bad.py"
+                   for f, _, _ in _findings(r))
+
+    def test_docstore_rule(self, tmp_path):
+        """docstore/ is a pure library: storage/dockv/ops/utils (and
+        docdb for the shared rewrite) are fine; tserver/tablet/rpc
+        never — shredding must not reach into server layers."""
+        r = self._run_scoped(tmp_path, {
+            "yugabyte_db_tpu/docstore/ok.py": """\
+                from ..storage import lane_codec
+                from ..dockv.packed_row import ColumnType
+                from ..ops.scan import AggSpec
+                from ..utils import flags
+                """,
+            "yugabyte_db_tpu/docstore/bad.py": """\
+                from ..tserver import TabletServer
+                from ..tablet.tablet import Tablet
+                import yugabyte_db_tpu.rpc.messenger
+                """})
+        layers = sorted(d.split(":")[0] for _, _, d in _findings(r))
+        assert layers == ["rpc", "tablet", "tserver"]
+        assert all(f == "yugabyte_db_tpu/docstore/bad.py"
                    for f, _, _ in _findings(r))
 
 

@@ -1,0 +1,768 @@
+"""Document shredding subsystem (yugabyte_db_tpu/docstore/).
+
+The contract under test: shredded doc-path pushdown is BITWISE equal
+to the interpreted JSON extractor at the same read point, every shape
+it cannot serve falls back typed (and still answers correctly), the
+v2 writer with ``doc_shred_enabled=False`` is byte-identical to a
+build without the subsystem, and compaction re-shreds its output.
+"""
+import json
+import tempfile
+
+import numpy as np
+import pytest
+
+from yugabyte_db_tpu.docdb.operations import (ReadRequest, RowOp,
+                                              WriteRequest)
+from yugabyte_db_tpu.docdb.table_codec import TableInfo
+from yugabyte_db_tpu.dockv.packed_row import (ColumnSchema, ColumnType,
+                                              TableSchema)
+from yugabyte_db_tpu.dockv.partition import PartitionSchema
+from yugabyte_db_tpu.docstore import (DOC_STATS, LAST_DOC_STATS,
+                                      shred_lanes, vcid_for)
+from yugabyte_db_tpu.docstore.errors import (REASON_DOC_SHAPE,
+                                             REASON_UNSHREDDED_BLOCK)
+from yugabyte_db_tpu.ops.scan import AggSpec
+from yugabyte_db_tpu.tablet import Tablet
+from yugabyte_db_tpu.utils import flags
+
+
+def J(key, inner=("col", 1)):
+    return ("json", "text", inner, key)
+
+
+def CASTI(n):
+    return ("fn", "cast_bigint", n)
+
+
+def CASTF(n):
+    return ("fn", "cast_double", n)
+
+
+def docs_info():
+    schema = TableSchema(columns=(
+        ColumnSchema(0, "id", ColumnType.INT64, is_range_key=True),
+        ColumnSchema(1, "doc", ColumnType.JSON),
+    ), version=1)
+    return TableInfo("docs", "docs", schema, PartitionSchema("range", 0))
+
+
+def make_doc(i):
+    d = {"qty": int(i % 50), "price": float(i) * 1.5 + 0.25,
+         "tag": ["alpha", "beta", "gamma"][i % 3],
+         "meta": {"region": ["us", "eu"][i % 2]},
+         "arr": [1, 2]}
+    if i % 7 == 0:
+        d.pop("qty")
+    if i % 11 == 0:
+        d["qty_null"] = None
+    return d
+
+
+def write_docs(t, lo, hi, mutate=None):
+    rows = []
+    for i in range(lo, hi):
+        d = make_doc(i)
+        if mutate:
+            mutate(i, d)
+        rows.append({"id": i, "doc": json.dumps(d)})
+    t.apply_write(WriteRequest("docs", [RowOp("upsert", r)
+                                       for r in rows]), t.clock.now())
+
+
+@pytest.fixture()
+def low_pushdown():
+    flags.set_flag("tpu_min_rows_for_pushdown", 64)
+    yield
+    flags.REGISTRY.reset("tpu_min_rows_for_pushdown")
+
+
+@pytest.fixture()
+def docs_tablet(tmp_path, low_pushdown):
+    t = Tablet("docs-t", docs_info(), str(tmp_path / "docs"))
+    write_docs(t, 0, 4000)
+    t.regular.flush()
+    return t
+
+
+def read_both(t, **kw):
+    """(shredded response, interpreted response) for one request; the
+    interpreted side runs with doc_shred_enabled off over the SAME
+    SSTs — byte-for-byte the pre-subsystem read path."""
+    r1 = t.read(ReadRequest("docs", **kw))
+    flags.set_flag("doc_shred_enabled", False)
+    try:
+        r2 = t.read(ReadRequest("docs", **kw))
+    finally:
+        flags.REGISTRY.reset("doc_shred_enabled")
+    assert r2.backend == "cpu"
+    return r1, r2
+
+
+def assert_parity(t, pushdown=True, **kw):
+    r1, r2 = read_both(t, **kw)
+    if pushdown:
+        assert r1.backend == "tpu", f"fell back: {DOC_STATS['reasons']}"
+    else:
+        assert r1.backend == "cpu"
+    if r1.agg_values is not None:
+        a = [np.asarray(v).tolist() for v in r1.agg_values]
+        b = [np.asarray(v).tolist() for v in r2.agg_values]
+        assert a == b, f"{a} != {b}"
+    else:
+        assert r1.rows == r2.rows
+    return r1
+
+
+# ---------------------------------------------------------------------------
+# Write-side inference units
+# ---------------------------------------------------------------------------
+
+class TestShredInference:
+    def _lane(self, docs):
+        texts = [json.dumps(d).encode() if d is not None else b""
+                 for d in docs]
+        ends = np.cumsum([len(x) for x in texts]).astype(np.uint32)
+        heap = b"".join(texts)
+        null = np.array([d is None for d in docs])
+        return ends, heap, null
+
+    def test_kinds_and_presence(self):
+        docs = [{"i": 1, "f": 1.5, "s": "x", "b": True},
+                {"i": 2, "f": 2.5, "s": "y", "b": False},
+                {"f": 3.5, "s": "z", "b": True, "i": None}]
+        out = shred_lanes(*self._lane(docs))
+        assert out[("i",)][0] == "i"
+        assert out[("f",)][0] == "f"
+        assert out[("s",)][0] == "s"
+        # bool shreds as its JSON text — what the interpreter returns
+        assert out[("b",)][0] == "s"
+        ulens, uheap, codes = out[("b",)][1]
+        from yugabyte_db_tpu.storage.lane_codec import \
+            decode_dict_strings
+        assert set(decode_dict_strings(ulens, uheap)) == \
+            {"true", "false"}
+        # JSON null and absence are both just not-present
+        assert out[("i",)][2].tolist() == [True, True, False]
+        assert out[("i",)][3] == (1, 2)     # exact int bounds
+
+    def test_heterogeneous_and_arrays_refused(self):
+        docs = [{"m": 1, "a": [1], "fi": 1},
+                {"m": "one", "a": [2], "fi": 2.0}]
+        out = shred_lanes(*self._lane(docs))
+        assert ("m",) not in out            # int+str mix
+        assert ("a",) not in out            # arrays never shred
+        assert ("fi",) not in out           # int+float mix
+
+    def test_ancestor_purity(self):
+        # rows where the parent is an embedded-JSON STRING: the
+        # interpreter still descends (it parses the text), a shredded
+        # child cannot — the whole subtree must stay raw
+        docs = [{"p": {"x": 1}}, {"p": json.dumps({"x": 2})}]
+        out = shred_lanes(*self._lane(docs))
+        assert ("p", "x") not in out
+        # pure-object parents are fine
+        docs = [{"p": {"x": 1}}, {"p": {"x": 2}}, {"p": None}]
+        out = shred_lanes(*self._lane(docs))
+        assert out[("p", "x")][0] == "i"
+
+    def test_coverage_and_max_paths(self):
+        docs = [{"common": i} if i else
+                {"common": i, "rare": 1} for i in range(100)]
+        out = shred_lanes(*self._lane(docs))
+        assert ("common",) in out
+        assert ("rare",) not in out         # 1% coverage: not worth it
+        docs = [{f"k{j}": j for j in range(8)} for _ in range(10)]
+        out = shred_lanes(*self._lane(docs), max_paths=3)
+        assert len(out) == 3
+
+    def test_int64_overflow_refused(self):
+        docs = [{"big": 2 ** 70}, {"big": 1}]
+        out = shred_lanes(*self._lane(docs))
+        assert ("big",) not in out
+
+    def test_unparseable_docs_are_absent(self):
+        texts = [b'{"k": 1}', b"not json", b'{"k": 2}']
+        ends = np.cumsum([len(x) for x in texts]).astype(np.uint32)
+        out = shred_lanes(ends, b"".join(texts), None)
+        assert out[("k",)][2].tolist() == [True, False, True]
+
+    def test_nonfinite_floats_refused(self):
+        # json.loads accepts Infinity/NaN; their dumps spellings can
+        # never repr-round-trip, and 'NaN' == 'NaN' is TRUE as text
+        # while float NaN never compares equal — such paths stay raw
+        texts = [b'{"x": Infinity, "y": 1.5}', b'{"x": NaN, "y": 2.5}']
+        ends = np.cumsum([len(t) for t in texts]).astype(np.uint32)
+        out = shred_lanes(ends, b"".join(texts), None)
+        assert ("x",) not in out
+        assert out[("y",)][0] == "f"
+
+
+# ---------------------------------------------------------------------------
+# Golden parity: shredded vs interpreted, bitwise, same read point
+# ---------------------------------------------------------------------------
+
+class TestGoldenParity:
+    def test_string_predicates(self, docs_tablet):
+        t = docs_tablet
+        assert_parity(t, where=("cmp", "eq", J("tag"),
+                                ("const", "beta")),
+                      aggregates=(AggSpec("count"),))
+        assert_parity(t, where=("cmp", "gt", J("tag"),
+                                ("const", "alpha")),
+                      aggregates=(AggSpec("count"),))
+        assert_parity(t, where=("in", J("tag"), ["alpha", "gamma"]),
+                      aggregates=(AggSpec("count"),))
+        assert_parity(t, where=("between", J("tag"),
+                                ("const", "alpha"), ("const", "beta")),
+                      aggregates=(AggSpec("count"),))
+        assert_parity(t, where=("like", J("tag"), "%amm%"),
+                      aggregates=(AggSpec("count"),))
+
+    def test_nested_path(self, docs_tablet):
+        assert_parity(docs_tablet,
+                      where=("cmp", "eq", J("region", J("meta")),
+                             ("const", "eu")),
+                      aggregates=(AggSpec("count"),))
+
+    def test_numeric_casts(self, docs_tablet):
+        t = docs_tablet
+        r = assert_parity(
+            t, where=("cmp", "lt", CASTI(J("qty")), ("const", 10)),
+            aggregates=(AggSpec("sum", CASTI(J("qty"))),
+                        AggSpec("count"),
+                        AggSpec("min", CASTI(J("qty"))),
+                        AggSpec("max", CASTI(J("qty")))))
+        assert int(np.asarray(r.agg_values[0])) > 0
+        assert_parity(
+            t, where=("between", CASTF(J("price")), ("const", 100.0),
+                      ("const", 900.0)),
+            aggregates=(AggSpec("sum", CASTF(J("price"))),
+                        AggSpec("count")))
+
+    def test_text_eq_canonical(self, docs_tablet):
+        t = docs_tablet
+        assert_parity(t, where=("cmp", "eq", J("qty"), ("const", "7")),
+                      aggregates=(AggSpec("count"),))
+        # out-of-int64 canonical text: must compile to constant-false
+        # (interpreted: no int64 value's text matches), never reach
+        # jnp.asarray with an unrepresentable constant
+        assert_parity(t, where=("cmp", "eq", J("qty"),
+                                ("const", str(2 ** 64 + 1))),
+                      aggregates=(AggSpec("count"),))
+        # non-finite canonical-looking text over a float path
+        assert_parity(t, where=("cmp", "eq", J("price"),
+                                ("const", "inf")),
+                      aggregates=(AggSpec("count"),))
+        # non-canonical text can never equal an int's JSON text:
+        # constant-false (but absent rows stay NULL) — still pushdown
+        assert_parity(t, where=("cmp", "eq", J("qty"),
+                                ("const", "07")),
+                      aggregates=(AggSpec("count"),))
+        assert_parity(t, where=("cmp", "ne", J("qty"),
+                                ("const", "7.5")),
+                      aggregates=(AggSpec("count"),))
+        assert_parity(t, where=("in", J("qty"), ["7", "9", "x"]),
+                      aggregates=(AggSpec("count"),))
+
+    def test_presence_shapes(self, docs_tablet):
+        t = docs_tablet
+        assert_parity(t, where=("isnull", J("qty")),
+                      aggregates=(AggSpec("count"),))
+        assert_parity(t, where=("not", ("isnull", J("qty"))),
+                      aggregates=(AggSpec("count"),))
+        # COUNT(path) counts presence; json-null and missing both NULL
+        assert_parity(t, aggregates=(AggSpec("count", J("qty")),
+                                     AggSpec("count", J("tag")),
+                                     AggSpec("count")))
+
+    def test_string_minmax_decode(self, docs_tablet):
+        r = assert_parity(docs_tablet,
+                          aggregates=(AggSpec("min", J("tag")),
+                                      AggSpec("max", J("tag"))))
+        assert np.asarray(r.agg_values[0]).item() == "alpha"
+        assert np.asarray(r.agg_values[1]).item() == "gamma"
+
+    def test_row_filter_path(self, docs_tablet):
+        r = assert_parity(docs_tablet,
+                          where=("cmp", "eq", J("tag"),
+                                 ("const", "beta")),
+                          columns=("id",))
+        assert len(r.rows) > 0
+
+    def test_combined_doc_and_scalar_predicate(self, docs_tablet):
+        assert_parity(docs_tablet,
+                      where=("and",
+                             ("cmp", "lt", ("col", 0), ("const", 2000)),
+                             ("cmp", "eq", J("tag"),
+                              ("const", "alpha"))),
+                      aggregates=(AggSpec("count"),))
+
+    def test_coverage_counter(self, docs_tablet):
+        assert_parity(docs_tablet,
+                      where=("cmp", "eq", J("tag"), ("const", "beta")),
+                      aggregates=(AggSpec("count"),))
+        assert LAST_DOC_STATS["coverage"] > 0
+        assert LAST_DOC_STATS["paths"] == 1
+
+    def test_vcid_stability(self, docs_tablet):
+        v1 = vcid_for(1, ("tag",))
+        assert_parity(docs_tablet,
+                      where=("cmp", "eq", J("tag"), ("const", "beta")),
+                      aggregates=(AggSpec("count"),))
+        assert vcid_for(1, ("tag",)) == v1
+
+    def test_attach_never_mutates_cached_blocks(self, docs_tablet):
+        """Derived vcid lanes live on scan-lifetime CLONES: the cached
+        SstReader blocks — also read by compaction, point reads and
+        concurrent scans, and the source of any re-serialization —
+        must stay untouched by a doc scan."""
+        from yugabyte_db_tpu.docstore.pushdown import DOC_COL_BASE
+        t = docs_tablet
+        assert_parity(t, where=("cmp", "eq", J("tag"),
+                                ("const", "beta")),
+                      aggregates=(AggSpec("sum", CASTI(J("qty"))),
+                                  AggSpec("count")))
+        for r in t.regular.ssts:
+            for i in range(r.num_blocks()):
+                cb = r.columnar_block(i)
+                assert all(c < DOC_COL_BASE for c in cb.fixed)
+                assert all(c < DOC_COL_BASE for c in cb.varlen)
+                assert all(c < DOC_COL_BASE
+                           for c in (cb.zmap or {}))
+        # and a compaction AFTER doc scans sees clean inputs
+        write_docs(t, 4000, 4500)
+        t.regular.flush()
+        t.compact()
+        assert_parity(t, where=("cmp", "eq", J("tag"),
+                                ("const", "beta")),
+                      aggregates=(AggSpec("count"),))
+
+
+# ---------------------------------------------------------------------------
+# Typed fallbacks — every unservable shape answers interpreted
+# ---------------------------------------------------------------------------
+
+class TestFallbacks:
+    def test_text_ordering_over_numeric_path(self, docs_tablet):
+        # '10' < '5' lexicographically: pushing a numeric compare
+        # would CHANGE answers — must stay interpreted
+        DOC_STATS["reasons"].clear()
+        assert_parity(docs_tablet, pushdown=False,
+                      where=("cmp", "gt", J("qty"), ("const", "10")),
+                      aggregates=(AggSpec("count"),))
+        assert DOC_STATS["reasons"].get(REASON_DOC_SHAPE, 0) >= 1
+
+    def test_array_path(self, docs_tablet):
+        assert_parity(docs_tablet, pushdown=False,
+                      where=("cmp", "eq", J("arr"), ("const", "[1, 2]")),
+                      aggregates=(AggSpec("count"),))
+
+    def test_minmax_over_numeric_path_text(self, docs_tablet):
+        # interpreted MIN over int-path TEXT is lexicographic
+        assert_parity(docs_tablet, pushdown=False,
+                      aggregates=(AggSpec("min", J("qty")),))
+
+    def test_memtable_rows_fall_back(self, docs_tablet):
+        t = docs_tablet
+        write_docs(t, 4000, 4100)      # unflushed: no shredded lanes
+        DOC_STATS["reasons"].clear()
+        assert_parity(t, pushdown=False,
+                      where=("cmp", "eq", J("tag"), ("const", "beta")),
+                      aggregates=(AggSpec("count"),))
+        assert DOC_STATS["reasons"].get(REASON_UNSHREDDED_BLOCK, 0) >= 1
+        # flush: every block shredded again → pushdown resumes
+        t.regular.flush()
+        assert_parity(t, where=("cmp", "eq", J("tag"),
+                                ("const", "beta")),
+                      aggregates=(AggSpec("count"),))
+
+    def test_heterogeneous_path_falls_back(self, tmp_path,
+                                           low_pushdown):
+        t = Tablet("docs-h", docs_info(), str(tmp_path / "h"))
+        write_docs(t, 0, 1000,
+                   mutate=lambda i, d: d.__setitem__(
+                       "qty", "many" if i % 5 == 0 else d.get("qty", 0)))
+        t.regular.flush()
+        DOC_STATS["reasons"].clear()
+        assert_parity(t, pushdown=False,
+                      where=("cmp", "eq", J("qty"), ("const", "3")),
+                      aggregates=(AggSpec("count"),))
+        assert DOC_STATS["reasons"].get(REASON_UNSHREDDED_BLOCK, 0) >= 1
+
+    def test_mixed_v1_v2_ssts(self, tmp_path, low_pushdown):
+        t = Tablet("docs-m", docs_info(), str(tmp_path / "m"))
+        flags.set_flag("sst_format_version", 1)
+        try:
+            write_docs(t, 0, 1000)
+            t.regular.flush()              # v1 SST: no shredded lanes
+        finally:
+            flags.REGISTRY.reset("sst_format_version")
+        write_docs(t, 1000, 2000)
+        t.regular.flush()                  # v2 shredded SST
+        DOC_STATS["reasons"].clear()
+        assert_parity(t, pushdown=False,
+                      where=("cmp", "eq", J("tag"), ("const", "beta")),
+                      aggregates=(AggSpec("count"),))
+        assert DOC_STATS["reasons"].get(REASON_UNSHREDDED_BLOCK, 0) >= 1
+
+    def test_flag_off_no_pushdown(self, docs_tablet):
+        flags.set_flag("doc_shred_enabled", False)
+        try:
+            r = docs_tablet.read(ReadRequest(
+                "docs",
+                where=("cmp", "eq", J("tag"), ("const", "beta")),
+                aggregates=(AggSpec("count"),)))
+            assert r.backend == "cpu"
+        finally:
+            flags.REGISTRY.reset("doc_shred_enabled")
+
+
+# ---------------------------------------------------------------------------
+# Format discipline
+# ---------------------------------------------------------------------------
+
+class TestFormatGate:
+    def _entries(self, t):
+        return [(k, v) for k, v in t.regular._mem.iterate()]
+
+    def test_flag_off_byte_identity_oracle(self, tmp_path,
+                                           low_pushdown):
+        """doc_shred_enabled=False must reproduce the PRE-SHRED v2
+        writer byte-for-byte.  The oracle is the pre-PR call shape:
+        an SstWriter constructed WITHOUT any shred argument — exactly
+        what every writer in the tree was before the subsystem."""
+        from yugabyte_db_tpu.storage.sst import SstWriter
+        t = Tablet("docs-o", docs_info(), str(tmp_path / "o"))
+        write_docs(t, 0, 1000)
+        entries = self._entries(t)
+        codec = t.codec
+
+        def write(path, **kw):
+            w = SstWriter(str(path),
+                          columnar_builder=codec.columnar_builder,
+                          key_builder=codec.derive_keys, **kw)
+            for k, v in entries:
+                w.add(k, v)
+            w.finish()
+            return (tmp_path / path).read_bytes() \
+                if not str(path).startswith("/") \
+                else open(path, "rb").read()
+
+        flags.set_flag("doc_shred_enabled", False)
+        try:
+            off_bytes = write(tmp_path / "off.sst",
+                              shred_cols=codec.shred_cols)
+        finally:
+            flags.REGISTRY.reset("doc_shred_enabled")
+        oracle_bytes = write(tmp_path / "oracle.sst")   # pre-PR shape
+        assert off_bytes == oracle_bytes
+        on_bytes = write(tmp_path / "on.sst",
+                         shred_cols=codec.shred_cols)
+        assert on_bytes != oracle_bytes
+        assert b"shred" in on_bytes and b"shred" not in off_bytes
+
+    def test_v1_never_shreds(self, tmp_path, low_pushdown):
+        from yugabyte_db_tpu.storage.sst import SstWriter
+        t = Tablet("docs-v1", docs_info(), str(tmp_path / "v1"))
+        write_docs(t, 0, 500)
+        w = SstWriter(str(tmp_path / "f1.sst"),
+                      columnar_builder=t.codec.columnar_builder,
+                      format_version=1,
+                      shred_cols=t.codec.shred_cols)
+        assert w.shred_cols == ()
+
+    def test_old_reader_shape_unaffected(self, docs_tablet):
+        """Shred lanes ride at the END of the payload stream and under
+        a meta key old readers never touch: every standard lane of a
+        shredded block must deserialize to the same bytes as its
+        unshredded twin."""
+        from yugabyte_db_tpu.storage.columnar import ColumnarBlock
+        t = docs_tablet
+        r = t.regular.ssts[0]
+        cb = r.columnar_block(0)
+        assert cb.shred            # shredded on disk
+        plain = cb.serialize(2, t.codec.derive_keys)   # no shred arg
+        twin = ColumnarBlock.deserialize(plain)
+        assert not twin.shred
+        for cid in cb.varlen:
+            e1, h1, n1 = cb.varlen[cid]
+            e2, h2, n2 = twin.varlen[cid]
+            assert bytes(h1) == bytes(h2)
+            assert np.array_equal(np.asarray(e1), np.asarray(e2))
+            assert np.array_equal(np.asarray(n1), np.asarray(n2))
+        assert np.array_equal(cb.ht, twin.ht)
+
+
+# ---------------------------------------------------------------------------
+# Compaction re-shreds
+# ---------------------------------------------------------------------------
+
+class TestCompactionReshred:
+    def test_compaction_output_is_shredded(self, tmp_path,
+                                           low_pushdown):
+        t = Tablet("docs-c", docs_info(), str(tmp_path / "c"))
+        write_docs(t, 0, 1500)
+        t.regular.flush()
+        write_docs(t, 1500, 3000)
+        t.regular.flush()
+        assert len(t.regular.ssts) == 2
+        t.compact()
+        assert len(t.regular.ssts) == 1
+        r = t.regular.ssts[0]
+        for i in range(r.num_blocks()):
+            cb = r.columnar_block(i)
+            assert cb.shred.get(1), f"block {i} lost its shred lanes"
+        # and pushdown parity holds over the compacted tablet
+        assert_parity(t, where=("cmp", "eq", J("tag"),
+                                ("const", "gamma")),
+                      aggregates=(AggSpec("sum", CASTI(J("qty"))),
+                                  AggSpec("count")))
+
+
+# ---------------------------------------------------------------------------
+# Zone pruning over shredded lanes
+# ---------------------------------------------------------------------------
+
+class TestZonePrune:
+    def test_shredded_lane_prunes_blocks(self, tmp_path, low_pushdown):
+        # value-clustered int path: qty == id // 500, so each 4096-row
+        # block covers ~8 distinct values and a selective equality
+        # should prune most blocks
+        t = Tablet("docs-z", docs_info(), str(tmp_path / "z"))
+        write_docs(t, 0, 8192,
+                   mutate=lambda i, d: d.__setitem__("qty", i // 500))
+        t.regular.flush()
+        flags.set_flag("streaming_chunk_rows", 4096)
+        try:
+            from yugabyte_db_tpu.ops.stream_scan import \
+                LAST_STREAM_STATS
+            r = assert_parity(
+                t, where=("cmp", "eq", CASTI(J("qty")), ("const", 3)),
+                aggregates=(AggSpec("count"),))
+            assert r.backend == "tpu"
+            assert LAST_STREAM_STATS.get("zone_blocks_pruned", 0) > 0
+        finally:
+            flags.REGISTRY.reset("streaming_chunk_rows")
+
+
+# ---------------------------------------------------------------------------
+# Bypass route
+# ---------------------------------------------------------------------------
+
+class TestBypassDoc:
+    def _tablet(self, tmp_path):
+        t = Tablet("docs-b", docs_info(), str(tmp_path / "b"))
+        write_docs(t, 0, 6000)
+        t.regular.flush()
+        return t
+
+    def test_keyless_doc_scan_parity(self, tmp_path, low_pushdown):
+        from yugabyte_db_tpu.bypass.session import BypassSession
+        t = self._tablet(tmp_path)
+        where = ("cmp", "eq", J("tag"), ("const", "alpha"))
+        aggs = (AggSpec("sum", CASTI(J("qty"))), AggSpec("count"),
+                AggSpec("max", J("tag")))
+        with BypassSession([t]) as s:
+            outs, counts, stats = s.scan_aggregate(where, aggs)
+            assert stats["key_rebuilds"] == 0
+            rpc = t.read(ReadRequest("docs", where=where,
+                                     aggregates=aggs,
+                                     read_ht=s.read_ht))
+        assert [np.asarray(v).tolist() for v in outs] == \
+            [np.asarray(v).tolist() for v in rpc.agg_values]
+
+    def test_typed_reason_flag_off(self, tmp_path, low_pushdown):
+        from yugabyte_db_tpu.bypass.errors import (REASON_DOC_OFF,
+                                                   BypassIneligible)
+        from yugabyte_db_tpu.bypass.session import BypassSession
+        t = self._tablet(tmp_path)
+        flags.set_flag("doc_shred_enabled", False)
+        try:
+            with BypassSession([t]) as s:
+                with pytest.raises(BypassIneligible) as ei:
+                    s.scan_aggregate(
+                        ("cmp", "eq", J("tag"), ("const", "alpha")),
+                        (AggSpec("count"),))
+                assert ei.value.reason == REASON_DOC_OFF
+        finally:
+            flags.REGISTRY.reset("doc_shred_enabled")
+
+    def test_typed_reason_doc_shape(self, tmp_path, low_pushdown):
+        from yugabyte_db_tpu.bypass.errors import (REASON_DOC_SHAPE,
+                                                   BypassIneligible)
+        from yugabyte_db_tpu.bypass.session import BypassSession
+        t = self._tablet(tmp_path)
+        with BypassSession([t]) as s:
+            with pytest.raises(BypassIneligible) as ei:
+                # text ordering over a numeric path
+                s.scan_aggregate(
+                    ("cmp", "gt", J("qty"), ("const", "10")),
+                    (AggSpec("count"),))
+            assert ei.value.reason == REASON_DOC_SHAPE
+
+
+# ---------------------------------------------------------------------------
+# Aggregate-over-string-payload satellite (plain string columns)
+# ---------------------------------------------------------------------------
+
+class TestDictMinMaxSatellite:
+    @pytest.fixture()
+    def str_tablet(self, tmp_path, low_pushdown):
+        from yugabyte_db_tpu.models.tpch import (generate_lineitem,
+                                                 lineitem_str_data,
+                                                 lineitem_str_info)
+        data = lineitem_str_data(
+            {k: v[:40_000] for k, v in generate_lineitem(0.01).items()})
+        t = Tablet("ls", lineitem_str_info(), str(tmp_path / "ls"))
+        t.bulk_load(data, block_rows=8192)
+        return t
+
+    def _interp(self, t, req_kw):
+        flags.set_flag("tpu_pushdown_enabled", False)
+        try:
+            return t.read(ReadRequest("lineitem_s", **req_kw))
+        finally:
+            flags.REGISTRY.reset("tpu_pushdown_enabled")
+
+    def test_scalar_minmax_decodes(self, str_tablet):
+        kw = dict(aggregates=(AggSpec("min", ("col", 6)),
+                              AggSpec("max", ("col", 6)),
+                              AggSpec("count", ("col", 6))))
+        r = str_tablet.read(ReadRequest("lineitem_s", **kw))
+        assert r.backend == "tpu"
+        ref = self._interp(str_tablet, kw)
+        assert [np.asarray(v).tolist() for v in r.agg_values] == \
+            [np.asarray(v).tolist() for v in ref.agg_values]
+        assert np.asarray(r.agg_values[0]).item() == "A"
+
+    def test_minmax_with_predicate_streams(self, str_tablet):
+        flags.set_flag("streaming_chunk_rows", 8192)
+        try:
+            from yugabyte_db_tpu.ops.stream_scan import \
+                LAST_STREAM_STATS
+            kw = dict(
+                where=("cmp", "gt", ("col", 1), ("const", 25.0)),
+                aggregates=(AggSpec("max", ("col", 6)),
+                            AggSpec("min", ("col", 7)),
+                            AggSpec("count")))
+            r = str_tablet.read(ReadRequest("lineitem_s", **kw))
+            assert r.backend == "tpu"
+            assert LAST_STREAM_STATS.get("chunks", 0) >= 3
+            ref = self._interp(str_tablet, kw)
+            assert [np.asarray(v).tolist() for v in r.agg_values] == \
+                [np.asarray(v).tolist() for v in ref.agg_values]
+        finally:
+            flags.REGISTRY.reset("streaming_chunk_rows")
+
+    def test_grouped_minmax_payload(self, str_tablet):
+        from yugabyte_db_tpu.ops.grouped_scan import DictGroupSpec
+        kw = dict(aggregates=(AggSpec("max", ("col", 6)),
+                              AggSpec("sum", ("col", 1))),
+                  group_by=DictGroupSpec((7,)))
+        r = str_tablet.read(ReadRequest("lineitem_s", **kw))
+        assert r.backend == "tpu"
+        flags.set_flag("grouped_pushdown_enabled", False)
+        try:
+            ref = str_tablet.read(ReadRequest("lineitem_s", **kw))
+        finally:
+            flags.REGISTRY.reset("grouped_pushdown_enabled")
+
+        def by_key(resp):
+            out = {}
+            counts = np.asarray(resp.group_counts)
+            for g in range(len(counts)):
+                key = tuple(str(np.asarray(v)[g])
+                            for v in resp.group_values)
+                out[key] = (int(counts[g]),) + tuple(
+                    np.asarray(v)[g] for v in resp.agg_values)
+            return out
+
+        assert by_key(r).keys() == by_key(ref).keys()
+        for k, (c1, mx1, s1) in by_key(r).items():
+            c2, mx2, s2 = by_key(ref)[k]
+            assert (c1, str(mx1)) == (c2, str(mx2))
+            assert float(s1) == pytest.approx(float(s2))
+
+    def test_min_empty_input_is_null(self, str_tablet):
+        kw = dict(where=("cmp", "lt", ("col", 1), ("const", -1.0)),
+                  aggregates=(AggSpec("min", ("col", 6)),
+                              AggSpec("count")))
+        r = str_tablet.read(ReadRequest("lineitem_s", **kw))
+        assert r.backend == "tpu"
+        assert np.asarray(r.agg_values[0]).item() is None
+        assert int(np.asarray(r.agg_values[1])) == 0
+
+    def test_sum_over_string_still_refused(self, str_tablet):
+        # only min/max/count ride the codes lane; SUM(string) keeps
+        # the interpreted path (where it raises, as it always did)
+        r = None
+        try:
+            r = str_tablet.read(ReadRequest(
+                "lineitem_s",
+                aggregates=(AggSpec("sum", ("col", 6)),)))
+        except TypeError:
+            return                      # interpreted path raised: fine
+        assert r.backend == "cpu"
+
+
+# ---------------------------------------------------------------------------
+# SQL end-to-end: ->/->> predicates and aggregates through the executor
+# ---------------------------------------------------------------------------
+
+class TestSqlDocPushdown:
+    def test_sql_doc_predicates(self, tmp_path, low_pushdown):
+        import asyncio
+
+        from yugabyte_db_tpu.ql.executor import SqlSession
+        from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+        async def go():
+            mc = await MiniCluster(str(tmp_path),
+                                   num_tservers=1).start()
+            s = SqlSession(mc.client())
+            try:
+                await s.execute(
+                    "CREATE TABLE dt (k bigint, doc jsonb, "
+                    "PRIMARY KEY (k))")
+                await mc.wait_for_leaders("dt")
+                vals = ", ".join(
+                    "({}, '{}')".format(
+                        i, json.dumps(make_doc(i)).replace("'", "''"))
+                    for i in range(600))
+                await s.execute(
+                    f"INSERT INTO dt (k, doc) VALUES {vals}")
+                for ts in mc.tservers:
+                    for p in ts.peers.values():
+                        p.tablet.flush()
+
+                async def both(sql):
+                    r1 = await s.execute(sql)
+                    flags.set_flag("doc_shred_enabled", False)
+                    try:
+                        r2 = await s.execute(sql)
+                    finally:
+                        flags.REGISTRY.reset("doc_shred_enabled")
+                    assert r1.rows == r2.rows, sql
+                    return r1
+
+                r = await both("SELECT count(*) FROM dt "
+                               "WHERE doc->>'tag' = 'alpha'")
+                assert r.rows[0]["count"] == 200
+                r = await both(
+                    "SELECT sum(cast(doc->>'qty' AS bigint)) AS q "
+                    "FROM dt WHERE doc->'meta'->>'region' = 'eu'")
+                assert r.rows[0]["q"] > 0
+                r = await both("SELECT min(doc->>'tag') AS lo, "
+                               "max(doc->>'tag') AS hi FROM dt")
+                assert (r.rows[0]["lo"], r.rows[0]["hi"]) == \
+                    ("alpha", "gamma")
+                r = await both(
+                    "SELECT k FROM dt WHERE doc->>'tag' = 'beta' "
+                    "AND cast(doc->>'qty' AS bigint) < 5 ORDER BY k")
+                assert r.rows and all(
+                    row["k"] % 3 == 1 for row in r.rows)
+            finally:
+                await mc.shutdown()
+
+        asyncio.run(go())

@@ -67,6 +67,30 @@ class FormatGatePass(AnalysisPass):
                 if not isinstance(node, ast.Call):
                     continue
                 for kw in node.keywords:
+                    if kw.arg == "shred_cols" \
+                            and _callee_name(node) in _SERIALIZER_NAMES:
+                        # the doc_shred_enabled writer gate lives in
+                        # SstWriter: a serializer call site feeding a
+                        # non-empty literal shred_cols would emit
+                        # shredded lanes even when the flag says off.
+                        # (SstWriter(shred_cols=...) is always fine —
+                        # the constructor resolves the flag.)
+                        v = kw.value
+                        if (isinstance(v, (ast.List, ast.Tuple, ast.Set))
+                                and v.elts) or (
+                                isinstance(v, ast.Constant)
+                                and v.value not in (None, ())):
+                            out.append(Finding(
+                                path=mi.rel, line=node.lineno,
+                                pass_id=self.id,
+                                message=("literal `shred_cols` on a "
+                                         "serializer call bypasses the "
+                                         "doc_shred_enabled writer "
+                                         "gate (SstWriter resolves "
+                                         "the flag)"),
+                                detail="shred_cols literal",
+                                hint=self.hint))
+                        continue
                     if kw.arg != "format_version" and not (
                             kw.arg == "version"
                             and _callee_name(node) in _SERIALIZER_NAMES):

@@ -46,6 +46,10 @@ LAYER_RULES = {
     "yugabyte_db_tpu/cluster/": ("tserver", "tablet", "master", "sched",
                                  "storage", "consensus", "bypass",
                                  "docdb", "dockv", "ops"),
+    # pure library: shredding/pushdown over storage+ops seams only —
+    # may import storage/dockv/ops/utils (and docdb for the shared
+    # expression rewrite), never server layers
+    "yugabyte_db_tpu/docstore/": ("tserver", "tablet", "rpc"),
 }
 
 _PKG_ROOT = "yugabyte_db_tpu"

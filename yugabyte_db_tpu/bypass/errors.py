@@ -45,13 +45,20 @@ REASON_JOIN_OFF = "join_pushdown_off"
 #: (duplicate keys, oversized table, unsupported key type) — carries
 #: the ops/join_scan typed reason in `detail`
 REASON_JOIN_SHAPE = "join_shape"
+#: doc-path request while doc_shred_enabled is off — the RPC path's
+#: interpreted extractor is the flag-off contract
+REASON_DOC_OFF = "doc_shred_off"
+#: a doc-path shape the shredded lanes can't serve bit-identically
+#: (unshredded/heterogeneous path, text-order compare over a numeric
+#: lane, ...) — carries the docstore typed reason in `detail`
+REASON_DOC_SHAPE = "doc_shape"
 
 ALL_REASONS = (
     REASON_FLAG_OFF, REASON_MEMTABLE_ACTIVE, REASON_NO_SSTS,
     REASON_NO_COLUMNAR, REASON_NOT_CHUNK_SAFE, REASON_COLUMN_NOT_FIXED,
     REASON_HASH_GROUP, REASON_EXPR_SHAPE, REASON_NOT_AGGREGATE,
     REASON_SLOT_OVERFLOW, REASON_GROUPED_OFF, REASON_JOIN_OFF,
-    REASON_JOIN_SHAPE,
+    REASON_JOIN_SHAPE, REASON_DOC_OFF, REASON_DOC_SHAPE,
 )
 
 

@@ -34,7 +34,8 @@ from ..ops.stream_scan import (LAST_STREAM_STATS, chunk_safe_mvcc,
 from ..storage.columnar import KEY_REBUILD_STATS, ColumnarBlock
 from ..storage.sst import SstReader
 from ..utils import flags
-from .errors import (REASON_COLUMN_NOT_FIXED, REASON_EXPR_SHAPE,
+from .errors import (REASON_COLUMN_NOT_FIXED, REASON_DOC_OFF,
+                     REASON_DOC_SHAPE, REASON_EXPR_SHAPE,
                      REASON_GROUPED_OFF, REASON_HASH_GROUP,
                      REASON_JOIN_OFF, REASON_JOIN_SHAPE,
                      REASON_NO_COLUMNAR, REASON_NOT_AGGREGATE,
@@ -116,6 +117,21 @@ def bypass_scan_aggregate(
     dict_group = isinstance(group, DictGroupSpec)
     if dict_group and not flags.get("grouped_pushdown_enabled"):
         raise BypassIneligible(REASON_GROUPED_OFF)
+    # doc-path shapes rewrite onto shredded virtual lanes FIRST — the
+    # keyless scanner then serves them like any derived column (the
+    # shredded lanes need no key matrix, so zero key rebuilds hold)
+    from ..docstore import pushdown as _doc
+    if _doc.exprs_have_doc(where, aggs):
+        if not flags.get("doc_shred_enabled"):
+            raise BypassIneligible(REASON_DOC_OFF)
+        from ..docstore.errors import DocIneligible
+        try:
+            where, aggs, _refs, blocks = _doc.prepare_doc_scan(
+                where, aggs, blocks)
+        except DocIneligible as e:
+            raise BypassIneligible(
+                REASON_DOC_SHAPE,
+                e.reason + (f": {e.detail}" if e.detail else ""))
     from ..ops.expr import device_compatible, referenced_columns
     if where is not None and not device_compatible(where):
         raise BypassIneligible(REASON_EXPR_SHAPE, "where")
@@ -171,14 +187,16 @@ def bypass_scan_aggregate(
           if prefilter_enabled and not rides_codes else None)
     stats: dict = {}
     gout: Optional[dict] = {} if dict_group else None
+    dict_out: dict = {}
     got = streaming_scan_aggregate(
         blocks, cols_sorted, where, aggs_run, group, read_ht,
         kernel=kernel, chunk_rows=chunk_rows, prefilter=pf,
-        min_chunks=min_chunks, grouped_out=gout)
+        min_chunks=min_chunks, grouped_out=gout, dict_out=dict_out)
     group_dicts = None
     if got is None:
         got = _monolithic_twin(blocks, cols_sorted, where, aggs_run,
-                               group, read_ht, kernel, pf)
+                               group, read_ht, kernel, pf,
+                               dict_out=dict_out)
         if dict_group:
             got, group_dicts = got
         stats["path"] = "monolithic"
@@ -193,8 +211,13 @@ def bypass_scan_aggregate(
         stats["path"] = "streaming"
         stats.update(LAST_STREAM_STATS)
     outs, counts = got
-    from ..docdb.operations import _nullify_minmax
+    from ..docdb.operations import _nullify_minmax, dict_minmax_decode
     outs = _nullify_minmax(expanded, minmax, outs)
+    # dict-code MIN/MAX decode happens PER SHARD, before the session's
+    # cross-shard combine — each shard merged its own dictionary, so
+    # codes must never leave the shard
+    outs = dict_minmax_decode(expanded, outs,
+                              dict_out.get("dicts") or {})
     if dict_group:
         from ..ops.grouped_scan import decode_slot_groups
         outs, counts, gvals = decode_slot_groups(
@@ -320,7 +343,7 @@ def bypass_plan_aggregate(
 
 
 def _monolithic_twin(blocks, cols_sorted, where, aggs_run, group,
-                     read_ht, kernel, pf):
+                     read_ht, kernel, pf, dict_out: dict = None):
     """The under-min_chunks shape, mirroring the RPC monolithic
     aggregate path bit-for-bit (zone-prune gate, single bucket over the
     kept rows, unique_keys forced off for multi-block inputs, string
@@ -353,6 +376,8 @@ def _monolithic_twin(blocks, cols_sorted, where, aggs_run, group,
         raise BypassIneligible(REASON_COLUMN_NOT_FIXED, str(e))
     if len(blocks) > 1:
         batch.unique_keys = False
+    if dict_out is not None:
+        dict_out["dicts"] = batch.dicts
     if batch.dicts and (where is not None
                         or any(a.expr is not None for a in aggs_run)):
         from ..docdb.operations import DocReadOperation

@@ -386,7 +386,8 @@ def _compact_columnar(store, codec, blocks: List[ColumnarBlock],
     # engine's: an operator running baseline compactions must still get
     # the format they configured)
     w = SstWriter(path, stream_columnar=True,
-                  key_builder=codec.derive_keys)
+                  key_builder=codec.derive_keys,
+                  shred_cols=codec.shred_cols)
     # pipeline: file writes of block k overlap the gathers of block k+1
     # (the write releases the GIL; the reference's CompactionJob
     # similarly overlaps merge work with output IO)
@@ -830,7 +831,8 @@ def _compact_columnar_chunked(store, codec, inputs: Sequence[SstReader],
     # key_builder lets the v2 writer drop derivable key matrices (and
     # readers of the output rebuild them through the same codec call).
     w = SstWriter(path, stream_columnar=True, sync_every_bytes=64 << 20,
-                  key_builder=codec.derive_keys)
+                  key_builder=codec.derive_keys,
+                  shred_cols=codec.shred_cols)
     cutter = _BlockCutter(w, write_pool, block_rows)
 
     active: List[_ActiveBlock] = []
@@ -1268,7 +1270,8 @@ def _compact_rows(store, codec, inputs, cutoff: int) -> str:
     if not entries:
         # nothing to write; just drop inputs
         path = store._new_sst_path()
-        w = SstWriter(path, columnar_builder=codec.columnar_builder)
+        w = SstWriter(path, columnar_builder=codec.columnar_builder,
+                      shred_cols=codec.shred_cols)
         w.finish()
         store.replace_ssts(inputs, path)
         return path
@@ -1310,7 +1313,8 @@ def _compact_rows(store, codec, inputs, cutoff: int) -> str:
                  for i, m in zip(sel, maybe)), np.uint64, len(sel))
             sel = sel[~(maybe & (ht_sel <= np.uint64(cutoff)))]
     path = store._new_sst_path()
-    w = SstWriter(path, columnar_builder=codec.columnar_builder)
+    w = SstWriter(path, columnar_builder=codec.columnar_builder,
+                  shred_cols=codec.shred_cols)
     for i in sel:
         w.add(*entries[int(i)])
     w.set_frontier(**_merge_frontier(inputs))

@@ -827,6 +827,49 @@ def _nullify_minmax(expanded, minmax, outs):
     return tuple(base)
 
 
+def dict_minmax_decode(expanded, outs, dicts):
+    """Decode dict-code MIN/MAX aggregate results back into strings —
+    the host half of the aggregate-over-string-payload pushdown
+    (ROADMAP fused-plan item (d)): the kernel min/maxes the CODES lane
+    of a dictionary column (code order == string order for the sorted
+    dictionary), and each surviving code maps through the scan-global
+    payload dictionary here, BEFORE any cross-shard combine (per-shard
+    dictionaries differ, so codes must never leave the shard).
+
+    ``expanded`` aligns with ``outs``; only entries whose expr is a
+    bare column with a dictionary entry decode.  Out-of-range codes
+    (pre-nullify kernel sentinels for zero-input groups) and None
+    (post-nullify) map to None.  Shared by the monolithic, streaming,
+    spill-merge and bypass routes."""
+    if not dicts:
+        return tuple(outs)
+    outs = list(outs)
+    for i, a in enumerate(expanded):
+        if i >= len(outs) or a.op not in ("min", "max"):
+            continue
+        e = a.expr
+        if not (isinstance(e, (tuple, list)) and e and e[0] == "col"
+                and e[1] in dicts):
+            continue
+        d = dicts[e[1]]
+
+        def dec(x, _d=d):
+            if x is None:
+                return None
+            c = int(x)
+            return str(_d[c]) if 0 <= c < len(_d) else None
+
+        v = np.asarray(outs[i])
+        if v.ndim == 0:
+            outs[i] = np.asarray(dec(v.item()), object)
+        else:
+            obj = v.astype(object)
+            for g in range(len(obj)):
+                obj[g] = dec(obj[g])
+            outs[i] = obj
+    return tuple(outs)
+
+
 class ReadRestartError(Exception):
     """Internal: a record inside the clock-uncertainty window was seen;
     the read must restart at restart_ht (reference: read restarts in
@@ -1263,13 +1306,55 @@ class DocReadOperation:
         if not flags.get("tpu_pushdown_enabled"):
             return False
         from ..ops.expr import device_compatible
-        if req.where is not None and not device_compatible(req.where):
+        compatible = device_compatible
+        json_cols = set(getattr(self.codec, "shred_cols", ()))
+        if json_cols and flags.get("doc_shred_enabled"):
+            # doc-path shapes MAY rewrite onto shredded lanes — judge
+            # the rest of the expression with doc shapes neutralized
+            # (the block-level rewrite still falls back typed when a
+            # path turns out unshredded/heterogeneous)
+            from ..docstore.pushdown import doc_compatible
+
+            def compatible(n, _jc=json_cols):
+                return doc_compatible(n, _jc)
+        if req.where is not None and not compatible(req.where):
             return False
         for a in req.aggregates:
-            if a.expr is not None and not device_compatible(a.expr):
+            if a.expr is not None and not compatible(a.expr):
                 return False
         approx_rows = sum(r.num_entries for r in self.store.ssts)
         return approx_rows >= flags.get("tpu_min_rows_for_pushdown")
+
+    def _maybe_doc_rewrite(self, req: ReadRequest, blocks):
+        """Doc-path pushdown (docstore/): when the request references
+        JSON paths, rewrite them onto shredded virtual lanes (blocks
+        mutated in place by attach_shredded) and return a request in
+        vcid space.  Returns `req` unchanged when no doc shapes are
+        present; None when the shapes can't be served bit-identically
+        (typed fallback recorded — caller takes the interpreted
+        path)."""
+        json_cols = set(getattr(self.codec, "shred_cols", ()))
+        if not json_cols:
+            return req
+        from ..docstore import pushdown as _doc
+        if not _doc.exprs_have_doc(req.where, req.aggregates):
+            return req
+        from ..docstore.errors import REASON_OFF, DocIneligible
+        if not flags.get("doc_shred_enabled"):
+            _doc.record_fallback(REASON_OFF)
+            return None
+        try:
+            where, aggs, _refs, attached = _doc.prepare_doc_scan(
+                req.where, req.aggregates, blocks, json_cols)
+        except DocIneligible as e:
+            _doc.record_fallback(e.reason)
+            return None
+        # the attached lanes live on scan-lifetime CLONES — splice them
+        # into the caller's list so the shared cached originals (also
+        # read by compaction/point reads) stay untouched
+        blocks[:] = attached
+        from dataclasses import replace
+        return replace(req, where=where, aggregates=aggs)
 
     def _collect_blocks(self) -> Optional[List[ColumnarBlock]]:
         """All columnar blocks across SSTs + a block built from memtable
@@ -1301,21 +1386,38 @@ class DocReadOperation:
         pass
 
     @classmethod
-    def rewrite_where_and_aggs(cls, where, aggs, dicts):
+    def rewrite_where_and_aggs(cls, where, aggs, dicts,
+                               allow_dict_minmax: bool = True):
         """Apply :meth:`_rewrite_strings` to a WHERE node and every
         AggSpec expr in one shot — ``(where, aggs)`` in dictionary-code
         space.  THE one rewrite entry shared by the monolithic device
         path, the streaming dictionary plan and the bypass twin, so the
         three routes cannot drift.  Raises ``_Unrewritable``; callers
         pick their fallback (device paths return None, bypass raises a
-        typed reason)."""
+        typed reason).
+
+        ``allow_dict_minmax``: MIN/MAX/COUNT over a bare dictionary
+        (string) column pass through as-is — the kernel aggregates the
+        CODES lane (sorted dictionary: code order IS string order) and
+        the caller decodes the winning code back through the
+        scan-global dictionary (:func:`dict_minmax_decode`).  Routes
+        with no decode step (the fused plan kernel) pass False and
+        keep the historical typed refusal."""
         if where is not None:
             where = cls._rewrite_strings(where, dicts)
-        aggs = tuple(
-            AggSpec(a.op, cls._rewrite_strings(a.expr, dicts)
-                    if a.expr is not None else None)
-            for a in aggs)
-        return where, aggs
+        out = []
+        for a in aggs:
+            e = a.expr
+            if e is None:
+                out.append(a)
+                continue
+            if allow_dict_minmax and a.op in ("min", "max", "count") \
+                    and isinstance(e, (tuple, list)) and e \
+                    and e[0] == "col" and e[1] in dicts:
+                out.append(a)          # codes lane serves it directly
+                continue
+            out.append(AggSpec(a.op, cls._rewrite_strings(e, dicts)))
+        return where, tuple(out)
 
     @classmethod
     def _rewrite_strings(cls, node, dicts):
@@ -1403,6 +1505,12 @@ class DocReadOperation:
             d = dicts[x[1]]
             lut = [1 if pat.match(s) else 0 for s in d]
             return ("dictlut", x, lut)
+        if kind == "isnull":
+            x = node[1]
+            if is_dict_col(x):
+                # null-mask read only — codes are never compared, so
+                # IS NULL over a dictionary column needs no rewrite
+                return node
         if kind == "col" and node[1] in dicts:
             # a bare string column outside a rewritable predicate
             raise cls._Unrewritable(node)
@@ -1492,10 +1600,11 @@ class DocReadOperation:
                                     for i in minmax)
         dict_group = isinstance(req.group_by, DictGroupSpec)
         grouped_out: Optional[dict] = {} if dict_group else None
+        dict_out: dict = {}
         got = streaming_scan_aggregate(
             blocks, sorted(needed), req.where, aggs_run, req.group_by,
             read_ht, kernel=self.kernel, cache=cache, cache_key=key,
-            grouped_out=grouped_out)
+            grouped_out=grouped_out, dict_out=dict_out)
         if got is None:
             return None
         if dict_group and grouped_out.get("spill"):
@@ -1528,6 +1637,8 @@ class DocReadOperation:
         self._check_restart_window(blocks, read_ht)
         outs, counts = got
         outs = _nullify_minmax(expanded, minmax, outs)
+        outs = dict_minmax_decode(expanded, outs,
+                                  dict_out.get("dicts") or {})
         if dict_group:
             from ..ops.grouped_scan import decode_slot_groups
             outs_c, counts_c, gvals = decode_slot_groups(
@@ -1563,8 +1674,12 @@ class DocReadOperation:
         counts_hot = np.asarray(counts).copy()
         counts_hot[spill_slot:] = 0
         from ..ops.grouped_scan import decode_slot_groups
-        dev_part = decode_slot_groups(
-            spec, dicts, [np.asarray(o) for o in outs], counts_hot)
+        # dict-code MIN/MAX lanes decode to strings BEFORE the combine:
+        # the interpreted tail's partials are strings (it min/maxes the
+        # actual payload), and codes must never mix with them
+        dev_outs = dict_minmax_decode(
+            tuple(aggs_run), [np.asarray(o) for o in outs], dicts)
+        dev_part = decode_slot_groups(spec, dicts, dev_outs, counts_hot)
         # replay the device's group-id encoding over the SAME remapped
         # codes to find which rows spilled
         gid = None
@@ -1640,6 +1755,9 @@ class DocReadOperation:
         blocks = self._collect_blocks()
         if not blocks:
             return None
+        req = self._maybe_doc_rewrite(req, blocks)
+        if req is None:
+            return None     # typed doc fallback: interpreted row path
         needed = set()
         from ..ops.expr import referenced_columns
         if req.where is not None:
@@ -1696,7 +1814,9 @@ class DocReadOperation:
                                     for i in minmax)
 
         def _nullify(outs):
-            return _nullify_minmax(expanded, minmax, outs)
+            return dict_minmax_decode(
+                expanded, _nullify_minmax(expanded, minmax, outs),
+                batch.dicts)
 
         if isinstance(req.group_by, HashGroupSpec):
             outs, counts, _, gvals, n_groups = self.kernel.run(
@@ -1935,6 +2055,9 @@ class DocReadOperation:
         blocks = self._collect_blocks()
         if not blocks:
             return None
+        req = self._maybe_doc_rewrite(req, blocks)
+        if req is None:
+            return None     # typed doc fallback: interpreted row path
         from ..ops.expr import referenced_columns
         needed = set(referenced_columns(req.where))
         schema = self.codec.schema

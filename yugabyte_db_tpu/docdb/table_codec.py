@@ -135,6 +135,12 @@ class TableCodec:
              c.type in (ColumnType.STRING, ColumnType.JSON,
                         ColumnType.DECIMAL))
             for c in self.schema.value_columns)
+        # JSON value columns: candidates for document shredding
+        # (docstore/) — threaded as `shred_cols` through LsmStore /
+        # SstWriter, where the doc_shred_enabled gate resolves per file
+        self.shred_cols = tuple(
+            c.id for c in self.schema.value_columns
+            if c.type == ColumnType.JSON)
         # native DocKey-prefix encoder spec (None = unsupported pk
         # shape, Python path used)
         self._key_spec = None
@@ -515,6 +521,10 @@ class TableCodec:
                 "its table codec")
         packing = self.info.packings.get(blk.schema_version)
         packer = RowPacker(packing)
+        # derived lanes (shredded doc paths, join build columns) are
+        # scan-lifetime acceleration structures, not row data —
+        # reconstruction reads schema columns only
+        from ..storage.columnar import DERIVED_COL_BASE as _DERIVED_BASE
         out = []
         for i in range(blk.n):
             key = blk.keys[i].tobytes()
@@ -523,8 +533,12 @@ class TableCodec:
                 continue
             values: Dict[int, object] = {}
             for cid, (vals, nulls) in blk.fixed.items():
+                if cid >= _DERIVED_BASE:
+                    continue
                 values[cid] = None if nulls[i] else vals[i].item()
             for cid, (ends, heap, nulls) in blk.varlen.items():
+                if cid >= _DERIVED_BASE:
+                    continue
                 if nulls[i]:
                     values[cid] = None
                 else:

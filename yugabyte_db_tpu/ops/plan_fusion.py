@@ -284,8 +284,10 @@ def _plan_probe_dicts(blocks, columns, where, aggs, group):
         return None, where, aggs, False
     from ..docdb.operations import DocReadOperation
     try:
+        # no dict-code decode step exists on the fused-plan route:
+        # bare dict-col MIN/MAX keeps its typed refusal here
         where, aggs = DocReadOperation.rewrite_where_and_aggs(
-            where, aggs, plan.dicts)
+            where, aggs, plan.dicts, allow_dict_minmax=False)
     except DocReadOperation._Unrewritable:
         return None, where, aggs, False
     return plan, where, aggs, True
@@ -464,7 +466,7 @@ def monolithic_plan_aggregate(
     if where is not None or any(a.expr is not None for a in aggs):
         from ..docdb.operations import DocReadOperation
         where, aggs = DocReadOperation.rewrite_where_and_aggs(
-            where, aggs, batch.dicts)
+            where, aggs, batch.dicts, allow_dict_minmax=False)
     t_build = time.perf_counter()
     join_rt = make_join_runtime(join_wire, batch.dicts)
     build_table_s = time.perf_counter() - t_build
@@ -536,7 +538,8 @@ def fused_plan_cpu(blocks, columns: Sequence[int], where,
             raise ValueError("not dictionary-encodable")
     if where is not None or any(a.expr is not None for a in aggs):
         where, aggs = DocReadOperation.rewrite_where_and_aggs(
-            where, aggs, plan.dicts if plan is not None else {})
+            where, aggs, plan.dicts if plan is not None else {},
+            allow_dict_minmax=False)
     join_rt = make_join_runtime(join_wire,
                                 plan.dicts if plan is not None else {})
     cols: Dict[int, np.ndarray] = {}

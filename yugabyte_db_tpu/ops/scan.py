@@ -588,6 +588,12 @@ class ScanKernel:
             else set()
         for a in aggs:
             if a.expr is not None:
+                # dict-code MIN/MAX (aggregate-over-string-payload):
+                # the f32 pallas pipeline would round code indices —
+                # those shapes stay on the exact XLA path
+                if any(cid in batch.dicts
+                       for cid in referenced_columns(a.expr)):
+                    return None
                 needed |= set(referenced_columns(a.expr))
         if group is not None:
             needed |= {cid for cid, _, _ in group.cols}

@@ -159,7 +159,8 @@ def streaming_scan_aggregate(
         chunk_rows: Optional[int] = None,
         cache=None, cache_key: Optional[tuple] = None,
         min_chunks: int = 3, prefilter=None,
-        grouped_out: Optional[dict] = None):
+        grouped_out: Optional[dict] = None,
+        dict_out: Optional[dict] = None):
     """Chunked scan-aggregate over `blocks`.
 
     Returns ``(agg_values, counts)`` — the shapes of
@@ -212,6 +213,11 @@ def streaming_scan_aggregate(
         return None
     if plan is not None:
         prefilter = None    # compacted blocks have no remap entries
+        if dict_out is not None:
+            # the scan-global dictionaries the returned partials were
+            # coded in — callers decode dict-code MIN/MAX results
+            # through them (docdb.operations.dict_minmax_decode)
+            dict_out["dicts"] = plan.dicts
     chunk_safe = chunk_safe_mvcc(blocks)
     if read_ht is not None and not chunk_safe:
         return None

@@ -4233,6 +4233,10 @@ def _scalar(v):
     a = np.asarray(v)
     if a.dtype == object and a.shape == ():
         return a.item()
+    if a.dtype.kind in "US" and a.shape == ():
+        # string MIN/MAX served on device (dict-code decode) comes
+        # back as a numpy unicode scalar after the wire round-trip
+        return str(a.item())
     if np.issubdtype(a.dtype, np.integer):
         # sum/min/max over integer columns stay integral (PG:
         # sum(bigint) -> numeric printed without a fraction)

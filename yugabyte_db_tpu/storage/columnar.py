@@ -40,6 +40,13 @@ from . import lane_codec
 #: anything newer with a clear error instead of misparsing it
 SUPPORTED_FORMAT_VERSION = 2
 
+#: column ids at or above this are DERIVED scan-lifetime lanes, never
+#: row data: join build columns live at 1<<20 (ops/join_scan) and
+#: shredded doc paths at 1<<24 (docstore/pushdown).  Serializers and
+#: row reconstruction skip them — a derived lane must never persist
+#: as an ordinary column (its id is only meaningful in-process)
+DERIVED_COL_BASE = 1 << 20
+
 #: lazy key-matrix rebuild tally: every time a v2 keyless block's
 #: ``keys`` property fires its key_builder thunk, one rebuild (and the
 #: block's row count) lands here.  The analytics scan paths promise to
@@ -148,7 +155,7 @@ class ColumnarBlock:
                  "tombstone", "pk", "fixed", "varlen", "unique_keys",
                  "zmap", "keys_proven", "_keys",
                  "_key_thunk", "_first_key", "_last_key", "_void_keys",
-                 "_vdicts", "_vdict_cache",
+                 "_vdicts", "_vdict_cache", "shred",
                  "_finder", "_extractors", "__weakref__")
 
     def __init__(self, n: int, schema_version: int,
@@ -193,6 +200,13 @@ class ColumnarBlock:
         # so the per-block dictionary is built at most once per cap
         self._vdicts: Dict[int, tuple] = {}
         self._vdict_cache: Dict[tuple, object] = {}
+        # shredded document lanes (docstore/): {json col id: {path
+        # tuple: (kind, payload, present bool[n], bounds)}} — derived
+        # acceleration lanes the v2 serializer emits behind
+        # doc_shred_enabled; the raw JSON varlen lane stays the source
+        # of truth, so slice/concat/gather deliberately do NOT carry
+        # these (compaction re-shreds from the raw payload at write)
+        self.shred: Dict[int, Dict[tuple, tuple]] = {}
         if keys is not None:
             self.keys = keys
 
@@ -394,7 +408,8 @@ class ColumnarBlock:
 
     # ------------------------------------------------------------------
     def serialize_parts(self, version: int = 1, key_builder=None,
-                        stats: Optional[dict] = None
+                        stats: Optional[dict] = None,
+                        shred_cols: Tuple[int, ...] = ()
                         ) -> Tuple[bytes, List[object]]:
         """(header bytes, payload buffers). Buffers are buffer-protocol
         objects (contiguous ndarrays / bytes) so callers can stream them
@@ -405,12 +420,17 @@ class ColumnarBlock:
         ``sst_format_version=1`` gate). version=2 drops the keys matrix
         when ``key_builder(self)`` rebuilds it byte-identically, runs
         every lane through lane_codec, and embeds zone maps; `stats`
-        (optional dict) accumulates the per-lane encode accounting."""
+        (optional dict) accumulates the per-lane encode accounting.
+
+        ``shred_cols``: JSON column ids to document-shred (docstore/) —
+        v2 only, resolved by SstWriter behind ``doc_shred_enabled``;
+        the default () keeps the output byte-identical to the
+        pre-shred v2 writer."""
         if version == 1:
             return self._serialize_v1()
         if version != 2:
             raise ValueError(f"unknown block format version {version}")
-        return self._serialize_v2(key_builder, stats)
+        return self._serialize_v2(key_builder, stats, shred_cols)
 
     def _serialize_v1(self) -> Tuple[bytes, List[object]]:
         bufs: List[object] = []
@@ -425,16 +445,21 @@ class ColumnarBlock:
             "key_hash": ref(self.key_hash), "ht": ref(self.ht),
             "wid": ref(self.write_id), "tomb": ref(self.tombstone),
             "pk": {str(k): ref(v) for k, v in self.pk.items()},
-            "fixed": {str(k): [ref(v), ref(m)] for k, (v, m) in self.fixed.items()},
+            "fixed": {str(k): [ref(v), ref(m)]
+                      for k, (v, m) in self.fixed.items()
+                      if k < DERIVED_COL_BASE},
             "varlen": {},
         }
         for k, (ends, heap, null) in self.varlen.items():
+            if k >= DERIVED_COL_BASE:
+                continue
             bufs.append(heap)
             meta["varlen"][str(k)] = [ref(ends), {"len": len(heap)}, ref(null)]
         head = msgpack.packb(meta)
         return struct.pack("<I", len(head)) + head, bufs
 
-    def _serialize_v2(self, key_builder, stats: Optional[dict]
+    def _serialize_v2(self, key_builder, stats: Optional[dict],
+                      shred_cols: Tuple[int, ...] = ()
                       ) -> Tuple[bytes, List[object]]:
         bufs: List[object] = []
 
@@ -480,10 +505,13 @@ class ColumnarBlock:
             "tomb": lane("tombstone", self.tombstone),
             "pk": {str(k): lane("pk", v) for k, v in self.pk.items()},
             "fixed": {str(k): [lane("fixed_vals", v), lane("fixed_null", m)]
-                      for k, (v, m) in self.fixed.items()},
+                      for k, (v, m) in self.fixed.items()
+                      if k < DERIVED_COL_BASE},
             "varlen": {},
         }
         for k, (ends, heap, null) in self.varlen.items():
+            if k >= DERIVED_COL_BASE:
+                continue
             dict_meta = self._dict_varlen_parts(ends, heap, null, bufs,
                                                 stats)
             if dict_meta is not None:
@@ -500,6 +528,24 @@ class ColumnarBlock:
             meta["varlen"][str(k)] = [lane("varlen_ends", ends),
                                       {"len": len(heap)},
                                       lane("varlen_null", null)]
+        # shredded document lanes ride LAST in the payload stream:
+        # readers that predate the docstore module walk their known
+        # lanes by explicit byte lengths and never reach these buffers
+        if shred_cols:
+            # call-time lazy import (the native_hot idiom): docstore
+            # imports storage at module scope, never the reverse
+            from ..docstore import shred as _doc_shred
+            shred_meta = {}
+            for cid in sorted(shred_cols):
+                vl = self.varlen.get(cid)
+                if vl is None:
+                    continue
+                entries = _doc_shred.serialize_shred(
+                    vl[0], vl[1], vl[2], bufs, stats)
+                if entries:
+                    shred_meta[str(cid)] = entries
+            if shred_meta:
+                meta["shred"] = shred_meta
         if keys is not None and self.n:
             meta["k0"] = keys[0].tobytes()
             meta["k1"] = keys[-1].tobytes()
@@ -596,6 +642,8 @@ class ColumnarBlock:
             if b is not None:
                 out[cid] = b
         for cid, (vals, null) in self.fixed.items():
+            if cid >= DERIVED_COL_BASE:
+                continue    # scan-lifetime lane: never persisted
             b = bounds(np.asarray(vals), np.asarray(null))
             if b is not None:
                 out[cid] = b
@@ -680,6 +728,12 @@ class ColumnarBlock:
             null = take(nref)
             blk.varlen[int(k)] = (ends, heap, null)
         if version >= 2:
+            sh = meta.get("shred")
+            if sh:
+                from ..docstore import shred as _doc_shred
+                for cid_s, entries in sh.items():
+                    blk.shred[int(cid_s)] = _doc_shred.deserialize_shred(
+                        entries, fetch, cls._decode_dict_varlen)
             if derived:
                 blk.keys_proven = True     # write-time verify passed
             if meta.get("k0") is not None:

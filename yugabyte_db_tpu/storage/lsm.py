@@ -115,7 +115,7 @@ class SstLease:
 class LsmStore:
     def __init__(self, directory: str, name: str = "db",
                  columnar_builder=None, row_decoder=None,
-                 key_builder=None):
+                 key_builder=None, shred_cols=None):
         self.dir = directory
         self.name = name
         self.columnar_builder = columnar_builder
@@ -124,6 +124,9 @@ class LsmStore:
         # its pk + MVCC lanes (docdb codec callable); writers verify
         # key drops against it, readers re-derive lazily through it
         self.key_builder = key_builder
+        # JSON column ids to document-shred at flush (docstore/);
+        # SstWriter resolves the doc_shred_enabled gate per file
+        self.shred_cols = tuple(shred_cols or ())
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.RLock()
         # serializes the file-writing half of flushes (background
@@ -347,7 +350,8 @@ class LsmStore:
             # write
             TEST_DISK_STALL()
             w = SstWriter(path, columnar_builder=self.columnar_builder,
-                          key_builder=self.key_builder)
+                          key_builder=self.key_builder,
+                          shred_cols=self.shred_cols)
             for k, v in mem.iterate():
                 w.add(k, v)
             w.set_frontier(**frontier)
@@ -441,7 +445,8 @@ class LsmStore:
         w = SstWriter(path, columnar_builder=self.columnar_builder,
                       stream_columnar=stream,
                       sync_every_bytes=(64 << 20) if stream else None,
-                      key_builder=self.key_builder)
+                      key_builder=self.key_builder,
+                      shred_cols=self.shred_cols)
         try:
             build(w)
         except BaseException:
@@ -525,7 +530,8 @@ class LsmStore:
         feed = feed or CompactionFeed()
         path = self._new_sst_path()
         w = SstWriter(path, columnar_builder=self.columnar_builder,
-                      key_builder=self.key_builder)
+                      key_builder=self.key_builder,
+                      shred_cols=self.shred_cols)
         # merge newest-first sources; exact dup keys keep newest. The
         # stream goes through the feed in chunks (feed_block) so
         # vectorized feeds see whole sorted runs, not single rows.

@@ -212,7 +212,7 @@ class SstWriter:
                  stream_columnar: bool = False,
                  sync_every_bytes: Optional[int] = None,
                  format_version: Optional[int] = None,
-                 key_builder=None):
+                 key_builder=None, shred_cols=None):
         self.path = path
         self.block_rows = block_rows
         self.columnar_builder = columnar_builder
@@ -226,6 +226,20 @@ class SstWriter:
         # rebuild byte-matches, the block serializes WITHOUT its keys
         # matrix (readers re-derive lazily through the same callable).
         self.key_builder = key_builder if self._fmt == 2 else None
+        # v2 only: JSON column ids to document-shred (docstore/).
+        # THE doc_shred_enabled writer gate: resolved ONCE here (a
+        # mid-write flag flip must not mix shredded and unshredded
+        # blocks in one file); flag off — or format 1 — pins the
+        # byte-identical pre-shred output.
+        self.shred_cols: tuple = ()
+        if shred_cols and self._fmt == 2:
+            from ..utils import flags as _flags
+            try:
+                enabled = bool(_flags.get("doc_shred_enabled"))
+            except Exception:   # noqa: BLE001 — odd harness: stay
+                enabled = False  # byte-compatible
+            if enabled:
+                self.shred_cols = tuple(shred_cols)
         #: per-lane encode accounting accumulated across this file's
         #: blocks (profile_compact --json reads it off the compaction
         #: stats; {"lanes": {lane: {pre_bytes, post_bytes, encodings}}})
@@ -308,7 +322,8 @@ class SstWriter:
                 first_key=first, last_key=last, offset=0, length=0,
                 num_rows=cb.n, col_offset=self._sf.tell(), col_length=0)
             head, bufs = cb.serialize_parts(self._fmt, self.key_builder,
-                                            self.lane_stats)
+                                            self.lane_stats,
+                                            self.shred_cols)
             e.col_length = len(head)
             self._sf.write(head)
             for b in bufs:
@@ -442,7 +457,8 @@ class SstWriter:
                     cb = self.columnar_builder(blk)
                 if cb is not None:
                     head, bufs = cb.serialize_parts(
-                        self._fmt, self.key_builder, self.lane_stats)
+                        self._fmt, self.key_builder, self.lane_stats,
+                        self.shred_cols)
                     index[i].col_offset = f.tell()
                     index[i].col_length = len(head)
                     f.write(head)

@@ -108,7 +108,8 @@ class Tablet:
             columnar_builder=(None if colocated
                               else self.codec.columnar_builder),
             row_decoder=(None if colocated else self.codec.row_decoder),
-            key_builder=(None if colocated else self.codec.derive_keys))
+            key_builder=(None if colocated else self.codec.derive_keys),
+            shred_cols=(None if colocated else self.codec.shred_cols))
         self.intents = LsmStore(
             os.path.join(directory, "intents"), name="intents")
         self._read_op = DocReadOperation(
@@ -168,6 +169,7 @@ class Tablet:
                 # which ALTER cannot change — rebinding keeps the codec
                 # object current all the same
                 self.regular.key_builder = merged.derive_keys
+                self.regular.shred_cols = merged.shred_cols
                 for r in self.regular.ssts:
                     r.row_decoder = merged.row_decoder
                     r.key_builder = merged.derive_keys

@@ -322,6 +322,24 @@ DEFINE_RUNTIME("sst_format_version", 2,
                "writer. Readers handle both versions side by side; "
                "storage/sst.py resolve_format_version is the ONLY "
                "writer gate, so no writer can emit v2 while this is 1.")
+DEFINE_RUNTIME("doc_shred_enabled", True,
+               "Shred scalar JSON document paths ($.a.b) into derived "
+               "per-path columnar v2 lanes at flush/compaction time "
+               "(yugabyte_db_tpu/docstore/): int/float values become "
+               "fixed lanes with presence bitmaps and per-block zone "
+               "maps, string/bool values dictionary-code, and doc "
+               "predicates/aggregates push down to device integer "
+               "compares exactly like scalar columns. The raw JSON "
+               "payload always stays on disk, so paths that resist "
+               "shredding (heterogeneous types, arrays, low coverage) "
+               "fall back to the interpreted row path byte-identically. "
+               "Off = the v2 writer emits byte-identical pre-shred "
+               "output and every doc predicate runs interpreted.")
+DEFINE_RUNTIME("doc_shred_max_paths", 16,
+               "Per-column cap on shredded document paths per block; "
+               "when a block's inferred path schema is wider, the "
+               "highest-coverage paths win and the rest stay in the "
+               "raw JSON payload (interpreted fallback).")
 DEFINE_RUNTIME("bypass_reader_enabled", False,
                "Route eligible aggregate scans through the analytics "
                "bypass engine (yugabyte_db_tpu/bypass/): snapshot-"
