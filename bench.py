@@ -1117,6 +1117,233 @@ def bypass_scan_bench():
         return {"error": str(e)[:200]}
 
 
+def matview_bench():
+    """Incremental materialized views under live write fire (matview/):
+    N registered GROUP BY views fold the CDC stream while a
+    2x-saturation open-loop point-write load rides the RPC path.
+    Reports the view-staleness p50/p99 sampled through the round, the
+    write-lane p99 with and without the maintainers running
+    (`matview_p99_impact` — informational, the maintainers share the
+    client event loop), and the headline `matview_vs_rescan` ratio:
+    serving the freshest answer from the maintained partials vs
+    re-answering the same GROUP BY with a full grouped rescan per
+    read — WARN-wired, incremental must WIN (> 1).
+    BENCH_MATVIEW_S bounds the round (0 skips); BENCH_MATVIEW_ROWS
+    sizes the base table; BENCH_MATVIEW_VIEWS sets N."""
+    import asyncio
+
+    duration = float(os.environ.get("BENCH_MATVIEW_S", "2.5"))
+    if duration <= 0:
+        return None
+    n_rows = int(os.environ.get("BENCH_MATVIEW_ROWS", "20000"))
+    n_views = int(os.environ.get("BENCH_MATVIEW_VIEWS", "3"))
+    n_groups = 16
+
+    async def run():
+        from yugabyte_db_tpu.docdb.operations import (
+            ReadRequest, RowOp, WriteRequest)
+        from yugabyte_db_tpu.docdb.table_codec import TableInfo
+        from yugabyte_db_tpu.docdb.wire import write_request_to_wire
+        from yugabyte_db_tpu.dockv.packed_row import (
+            ColumnSchema, ColumnType, TableSchema)
+        from yugabyte_db_tpu.dockv.partition import PartitionSchema
+        from yugabyte_db_tpu.matview import ViewDef
+        from yugabyte_db_tpu.ops.scan import AggSpec, HashGroupSpec
+        from yugabyte_db_tpu.rpc.messenger import Messenger, RpcError
+        from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+        schema = TableSchema(columns=(
+            ColumnSchema(0, "k", ColumnType.INT64, is_hash_key=True),
+            ColumnSchema(1, "g", ColumnType.INT64),
+            ColumnSchema(2, "v", ColumnType.INT64),
+        ), version=1)
+        info = TableInfo("", "kv", schema, PartitionSchema("hash", 1))
+        mc = await MiniCluster(tempfile.mkdtemp(prefix="ybtpu-mv-"),
+                               num_tservers=1).start()
+        conns = []
+        try:
+            c = mc.client()
+            await c.create_table(info, num_tablets=1,
+                                 replication_factor=1)
+            await mc.wait_for_leaders("kv")
+            rng = np.random.default_rng(11)
+            for lo in range(0, n_rows, 2000):
+                await c.insert("kv", [
+                    {"k": i, "g": i % n_groups,
+                     "v": int(rng.integers(0, 1 << 20))}
+                    for i in range(lo, min(lo + 2000, n_rows))])
+
+            # N views over the same stream: plain partials, MIN/MAX
+            # (the retraction/re-scan path), and a filtered slice
+            defs = [
+                ViewDef("mv_sum", "kv", "", ["g"],
+                        [("count", None, "cnt"),
+                         ("sum", ("col", "v"), "total")]),
+                ViewDef("mv_mm", "kv", "", ["g"],
+                        [("min", ("col", "v"), "lo"),
+                         ("max", ("col", "v"), "hi")]),
+                ViewDef("mv_flt", "kv", "", ["g"],
+                        [("count", None, "cnt"),
+                         ("sum", ("col", "v"), "total")],
+                        where=("cmp", "ge", ("col", "v"),
+                               ("const", 1 << 19))),
+            ][:n_views]
+            mts = [await c.matviews().create(vd) for vd in defs]
+
+            ct = await c._table("kv")
+            loc = ct.locations[0]
+            addr = loc.leader_addr()
+            conns = [Messenger(f"mv-{i}") for i in range(32)]
+
+            def wr_payload():
+                k = int(rng.integers(0, n_rows))   # updates: retraction
+                return {"tablet_id": loc.tablet_id,
+                        "req": write_request_to_wire(WriteRequest(
+                            ct.info.table_id, ops=[RowOp("upsert", {
+                                "k": k, "g": k % n_groups,
+                                "v": int(rng.integers(0, 1 << 20))})]))}
+
+            async def write_closed(dur, workers=32):
+                stop = time.perf_counter() + dur
+                done = [0]
+
+                async def w(i):
+                    m = conns[i % len(conns)]
+                    while time.perf_counter() < stop:
+                        try:
+                            await m.call(addr, "tserver", "write",
+                                         wr_payload(), timeout=2.0)
+                            done[0] += 1
+                        except (asyncio.TimeoutError, RpcError, OSError):
+                            pass
+                await asyncio.gather(*[w(i) for i in range(workers)])
+                return done[0] / max(dur, 1e-9)
+
+            async def write_open(rate, dur, sample_staleness=False):
+                lat, tasks, staleness = [], [], []
+                dropped = 0
+
+                async def one(i):
+                    nonlocal dropped
+                    m = conns[i % len(conns)]
+                    t0 = time.perf_counter()
+                    try:
+                        await m.call(addr, "tserver", "write",
+                                     wr_payload(), timeout=2.0)
+                        lat.append(time.perf_counter() - t0)
+                    except (asyncio.TimeoutError, RpcError, OSError):
+                        dropped += 1
+                total = int(rate * dur)
+                interval = 1.0 / rate
+                t_start = time.perf_counter()
+                for i in range(total):
+                    due = t_start + i * interval
+                    now = time.perf_counter()
+                    if now < due:
+                        await asyncio.sleep(due - now)
+                    if sample_staleness and i % 25 == 0:
+                        staleness.extend(mt.staleness_ms()
+                                         for mt in mts)
+                    tasks.append(asyncio.ensure_future(one(i)))
+                await asyncio.gather(*tasks)
+                lat_ms = sorted(x * 1e3 for x in lat)
+
+                def pct(vals, q):
+                    if not vals:
+                        return 0.0
+                    vals = sorted(vals)
+                    return vals[min(len(vals) - 1, int(q * len(vals)))]
+                out = {"achieved_ops_per_s": round(
+                           len(lat) / max(dur, 1e-9), 1),
+                       "dropped": dropped,
+                       "p50_ms": round(pct(lat_ms, 0.5), 2),
+                       "p99_ms": round(pct(lat_ms, 0.99), 2)}
+                if sample_staleness:
+                    finite = [s for s in staleness
+                              if s != float("inf")]
+                    out["staleness_p50_ms"] = round(
+                        pct(finite, 0.5), 2)
+                    out["staleness_p99_ms"] = round(
+                        pct(finite, 0.99), 2)
+                return out
+
+            sat = await write_closed(1.0)
+            rate = 2 * sat
+            # round A: maintainers quiesced — the write-p99 baseline
+            for mt in mts:
+                await mt.stop()
+            alone = await write_open(rate, duration)
+            # round B: maintainers folding live
+            for mt in mts:
+                mt.start()
+            with_mv = await write_open(rate, duration,
+                                       sample_staleness=True)
+
+            # incremental serve vs repeated full grouped rescan: the
+            # view answers at its watermark after folding ONE delta;
+            # the rescan re-answers the identical GROUP BY from scratch
+            vd0, mt0 = defs[0], mts[0]
+            for mt in mts[1:]:
+                await mt.stop()          # isolate the measured view
+            gspec = HashGroupSpec(cols=(1,))
+            aggs = (AggSpec("count"), AggSpec("sum", ("col", 2)))
+            reads = int(os.environ.get("BENCH_MATVIEW_READS", "15"))
+            # drain round B's fold backlog first: the measured reads
+            # time the steady state (fold ONE delta, serve), not the
+            # overload recovery
+            await c.matviews().read_rows(vd0.name, max_staleness_ms=0.0)
+            t0 = time.perf_counter()
+            for _ in range(reads):
+                await conns[0].call(addr, "tserver", "write",
+                                    wr_payload(), timeout=2.0)
+                await c.matviews().read_rows(
+                    vd0.name, max_staleness_ms=0.0)
+            t_inc = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for _ in range(reads):
+                await conns[0].call(addr, "tserver", "write",
+                                    wr_payload(), timeout=2.0)
+                await c.scan("kv", ReadRequest(
+                    "", aggregates=aggs, group_by=gspec))
+            t_rescan = time.perf_counter() - t0
+
+            stats = {vd.name: {k: st[k] for k in
+                               ("txns_applied", "rows_added",
+                                "rows_retracted", "minmax_rescans",
+                                "budget_exceeded", "full_rescans")}
+                     for vd, st in ((vd, c.matviews().stats(vd.name))
+                                    for vd in defs)}
+            return {
+                "views": len(defs), "base_rows": n_rows,
+                "write_saturation_ops_per_s": round(sat, 1),
+                "offered_write_ops_per_s": round(rate, 1),
+                "write_alone": alone,
+                "write_with_matviews": with_mv,
+                "matview_p99_impact": round(
+                    with_mv["p99_ms"] / max(alone["p99_ms"], 1e-9), 3),
+                "staleness_p50_ms": with_mv.pop("staleness_p50_ms"),
+                "staleness_p99_ms": with_mv.pop("staleness_p99_ms"),
+                "incremental_read_ms": round(t_inc * 1e3 / reads, 2),
+                "rescan_read_ms": round(t_rescan * 1e3 / reads, 2),
+                "matview_vs_rescan": round(t_rescan / max(t_inc, 1e-9),
+                                           3),
+                "maintainer_stats": stats,
+            }
+        finally:
+            try:
+                await c.matviews().stop()
+            except Exception:
+                pass
+            for m in conns:
+                await m.shutdown()
+            await mc.shutdown()
+
+    try:
+        return asyncio.run(run())
+    except Exception as e:   # noqa: BLE001 — report, don't fail bench
+        return {"error": str(e)[:200]}
+
+
 def tpch_bypass_bench(data, repeats):
     """TPC-H Q1/Q6 routed through ``client.scan_bypass`` (ROADMAP
     bypass item (e)): the SAME lineitem rows served from a one-tserver
@@ -1747,7 +1974,8 @@ _RATIO_KEYS = ("vs_baseline", "speedup", "vs_cpu", "vs_xla",
                "cluster_achieved_on_vs_off", "cluster_p99_spread",
                "cluster_fused_p99_on_vs_off",
                "cluster_fused_achieved_on_vs_off",
-               "trace_ycsb_on_vs_off", "trace_q6_on_vs_off")
+               "trace_ycsb_on_vs_off", "trace_q6_on_vs_off",
+               "matview_vs_rescan")
 
 #: keys where ANY nonzero value is a regression (acked data vanished
 #: or corrupted across a chaos round — never acceptable)
@@ -2434,6 +2662,14 @@ def main():
     if bp is not None:
         results["bypass_scan"] = bp
 
+    # incremental matviews fed by the CDC stream under 2x write load:
+    # staleness p99, write-lane p99 impact, and the incremental-vs-
+    # full-rescan serve ratio (matview_vs_rescan WARNs below 1;
+    # BENCH_MATVIEW_S=0 skips)
+    mv = matview_bench()
+    if mv is not None:
+        results["matview"] = mv
+
     ol = ycsb_overload_bench()
     if ol is not None:
         results["ycsb_overload"] = ol
@@ -2657,6 +2893,8 @@ def main():
            if "trace_overhead" in results else {}),
         **({"bypass_scan": results["bypass_scan"]}
            if "bypass_scan" in results else {}),
+        **({"matview": results["matview"]}
+           if "matview" in results else {}),
         "driver_conformance": driver_conf,
         "vector": _vector_line(results["vector"]),
         **({"vector_full": _vector_line(results["vector_full"])}

@@ -1,0 +1,184 @@
+"""Stage profile of the incremental-matview maintainer (matview/).
+
+One registered view (count + sum + min/max over a 16-group INT64 key)
+seeds from a pinned read point, then folds a churn batch of updates
+and extremum deletes off the CDC stream. The maintainer's wall clock
+splits into the stages ViewMaintainer.stage_s accumulates:
+
+  seed    - slot creation + watermark pin + the ONE grouped seed scan
+  stream  - VirtualWal.get_consistent_changes (change-record drain)
+  fold    - txn apply: before-image point reads, combine + retract
+  rescan  - bounded per-group MIN/MAX repair scans after retraction
+  persist - catalog checkpoint writes + confirm_flush
+
+alongside the retraction/re-scan counters (rows_added, rows_retracted,
+before_image_reads, minmax_rescans, budget_exceeded, full_rescans) and
+a timed REFRESH (the full-rescan escape hatch) for contrast. Parity is
+asserted inside: the folded view must bit-match a host fold of a full
+scan at the view's watermark.
+
+Usage:
+  python profile_matview.py --json
+
+Env knobs: PROFILE_MV_ROWS (base-table rows, default 20000),
+PROFILE_MV_CHURN (churn ops folded through the stream, default 2000).
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("YBTPU_PLATFORM", "cpu")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+N_GROUPS = 16
+
+
+def profile_json() -> dict:
+    import asyncio
+    import tempfile
+
+    import numpy as np
+
+    from yugabyte_db_tpu.docdb.table_codec import TableInfo
+    from yugabyte_db_tpu.dockv.packed_row import (ColumnSchema, ColumnType,
+                                                  TableSchema)
+    from yugabyte_db_tpu.dockv.partition import PartitionSchema
+    from yugabyte_db_tpu.matview import ViewDef
+    from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+    n_rows = int(os.environ.get("PROFILE_MV_ROWS", "20000"))
+    n_churn = int(os.environ.get("PROFILE_MV_CHURN", "2000"))
+
+    schema = TableSchema(columns=(
+        ColumnSchema(0, "k", ColumnType.INT64, is_hash_key=True),
+        ColumnSchema(1, "g", ColumnType.INT64),
+        ColumnSchema(2, "v", ColumnType.INT64),
+    ), version=1)
+    info = TableInfo("", "kv", schema, PartitionSchema("hash", 1))
+
+    async def run() -> dict:
+        mc = await MiniCluster(tempfile.mkdtemp(prefix="ybtpu-mvprof-"),
+                               num_tservers=1).start()
+        try:
+            c = mc.client()
+            await c.create_table(info, num_tablets=1,
+                                 replication_factor=1)
+            await mc.wait_for_leaders("kv")
+
+            rng = np.random.default_rng(23)
+            vals = {}
+            t0 = time.perf_counter()
+            for lo in range(0, n_rows, 2000):
+                batch = [{"k": i, "g": i % N_GROUPS,
+                          "v": int(rng.integers(0, 1 << 20))}
+                         for i in range(lo, min(lo + 2000, n_rows))]
+                for r in batch:
+                    vals[r["k"]] = r["v"]
+                await c.insert("kv", batch)
+            load_s = time.perf_counter() - t0
+
+            vd = ViewDef("mv_prof", "kv", "", ["g"],
+                         [("count", None, "cnt"),
+                          ("sum", ("col", "v"), "total"),
+                          ("min", ("col", "v"), "lo"),
+                          ("max", ("col", "v"), "hi")])
+            t0 = time.perf_counter()
+            mt = await c.matviews().create(vd)
+            create_s = time.perf_counter() - t0
+
+            # churn: updates of existing keys (each one an add + a
+            # retract through the fold), plus deletes of four groups'
+            # current maxima — guaranteed dirty MIN/MAX slots, so the
+            # rescan stage is exercised under the default budget
+            t0 = time.perf_counter()
+            ks = rng.integers(0, n_rows, size=n_churn)
+            for lo in range(0, n_churn, 500):
+                batch = [{"k": int(k), "g": int(k) % N_GROUPS,
+                          "v": int(rng.integers(0, 1 << 20))}
+                         for k in ks[lo:lo + 500]]
+                for r in batch:
+                    vals[r["k"]] = r["v"]
+                await c.insert("kv", batch)
+            doomed = []
+            for g in range(4):
+                gk = max((k for k in vals if k % N_GROUPS == g),
+                         key=vals.__getitem__)
+                doomed.append({"k": gk})
+            await c.delete("kv", doomed)
+            churn_s = time.perf_counter() - t0
+
+            # drain the whole backlog to the freshest watermark; the
+            # stage split below covers seed + every fold round
+            t0 = time.perf_counter()
+            rows, meta = await c.matviews().read_rows(
+                "mv_prof", max_staleness_ms=0.0)
+            catch_up_s = time.perf_counter() - t0
+
+            # parity gate: host fold of a full scan at the view's
+            # watermark must bit-match the maintained partials
+            from yugabyte_db_tpu.docdb.operations import ReadRequest
+            resp = await c.scan(
+                "kv", ReadRequest("", read_ht=mt.watermark_ht))
+            ref = {}
+            for r in resp.rows:
+                cnt, tot, lo_, hi = ref.get(
+                    r["g"], (0, 0, None, None))
+                ref[r["g"]] = (
+                    cnt + 1, tot + r["v"],
+                    r["v"] if lo_ is None else min(lo_, r["v"]),
+                    r["v"] if hi is None else max(hi, r["v"]))
+            got = {r["g"]: (int(r["cnt"]), int(r["total"]),
+                            int(r["lo"]), int(r["hi"])) for r in rows}
+            assert got == ref, "matview fold diverged from host fold"
+
+            st = dict(mt.counters)
+            assert st["minmax_rescans"] >= 1, \
+                "extremum deletes produced no rescans"
+            assert st["rows_retracted"] >= int(n_churn * 0.9), \
+                "update churn produced no retractions"
+            # capture the split before REFRESH re-enters the seed stage
+            stages = {k: round(v, 6) for k, v in mt.stage_s.items()}
+
+            # the escape hatch, timed for contrast with the fold
+            t0 = time.perf_counter()
+            await c.matviews().refresh("mv_prof")
+            refresh_s = time.perf_counter() - t0
+            return {
+                "rows": n_rows,
+                "churn_ops": n_churn + len(doomed),
+                "groups": N_GROUPS,
+                "load_s": round(load_s, 3),
+                "create_s": round(create_s, 3),
+                "churn_write_s": round(churn_s, 3),
+                "catch_up_s": round(catch_up_s, 3),
+                "refresh_s": round(refresh_s, 3),
+                "stage_s": stages,
+                "seed_route": st["seed_route"],
+                "staleness_ms": round(meta["staleness_ms"], 3),
+                "counters": {k: st[k] for k in (
+                    "seeds", "txns_applied", "rows_added",
+                    "rows_retracted", "before_image_reads",
+                    "minmax_rescans", "budget_exceeded",
+                    "full_rescans")},
+            }
+        finally:
+            try:
+                await c.matviews().stop()
+            except Exception:
+                pass
+            await mc.shutdown()
+
+    return asyncio.run(run())
+
+
+def main() -> None:
+    if "--json" in sys.argv:
+        print(json.dumps(profile_json()))
+        return
+    sys.stderr.write(__doc__ + "\n")
+    sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()

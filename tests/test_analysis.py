@@ -408,6 +408,31 @@ class TestFlagDrift:
                    in zip(_findings(r),
                           [f["message"] for f in r["findings"]]))
 
+    def test_matview_flag_surface(self, tmp_path):
+        """The matview flag family (ISSUE 17) as a drift fixture: every
+        flag read in the subsystem is wired clean; an aspirational flag
+        nobody folded in yet (the classic way a knob rots) fires."""
+        r = _run(tmp_path, {
+            "pkg/flags.py": """\
+                def DEFINE_RUNTIME(name, default, help=""):
+                    pass
+                DEFINE_RUNTIME("matview_enabled", True, "gate")
+                DEFINE_RUNTIME("matview_rescan_budget", 8, "cap")
+                DEFINE_RUNTIME("matview_max_staleness_ms", 500.0, "bound")
+                DEFINE_RUNTIME("matview_poll_ms", 50, "cadence")
+                DEFINE_RUNTIME("matview_parallel_seed", 4, "unwired")
+                """,
+            "pkg/maintainer.py": """\
+                from . import flags
+                def f():
+                    return (flags.get("matview_enabled"),
+                            flags.get("matview_rescan_budget"),
+                            flags.get("matview_max_staleness_ms"),
+                            flags.get("matview_poll_ms"))
+                """}, "flag_drift")
+        got = {d for _, _, d in _findings(r)}
+        assert got == {"matview_parallel_seed"}
+
     def test_suppressed_with_reason(self, tmp_path):
         files = dict(self.FILES)
         files["pkg/flags.py"] = files["pkg/flags.py"].replace(
@@ -838,6 +863,34 @@ class TestLayering:
         layers = sorted(d.split(":")[0] for _, _, d in _findings(r))
         assert layers == ["rpc", "tablet", "tserver"]
         assert all(f == "yugabyte_db_tpu/docstore/bad.py"
+                   for f, _, _ in _findings(r))
+
+    def test_matview_rule(self, tmp_path):
+        """matview/ folds exclusively through client RPCs, the CDC slot
+        API and the ops combine seam (cdc/client/ops/utils/models are
+        fine); importing tserver/tablet/storage/consensus would let a
+        maintainer read a memtable directly, bypassing the pinned read
+        point the whole design hangs on."""
+        r = self._run_scoped(tmp_path, {
+            "yugabyte_db_tpu/matview/ok.py": """\
+                from ..cdc.virtual_wal import VirtualWal
+                from ..client.client import YBClient
+                from ..ops.scan import combine_grouped_partials
+                from ..utils import flags
+                from ..models.ycsb import usertable_info
+                from .errors import MatviewIneligible
+                """,
+            "yugabyte_db_tpu/matview/bad.py": """\
+                from ..tserver import TabletServer
+                from ..tablet.tablet_peer import TabletPeer
+                import yugabyte_db_tpu.storage.lsm
+                def f():
+                    from ..consensus import RaftConsensus
+                    return RaftConsensus
+                """})
+        layers = sorted(d.split(":")[0] for _, _, d in _findings(r))
+        assert layers == ["consensus", "storage", "tablet", "tserver"]
+        assert all(f == "yugabyte_db_tpu/matview/bad.py"
                    for f, _, _ in _findings(r))
 
 

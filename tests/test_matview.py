@@ -1,0 +1,481 @@
+"""Incremental materialized views (matview/): retraction algebra vs
+recompute-from-scratch, seed-vs-incremental bitwise parity under live
+DML, MIN/MAX rescan budgets, restart/attach resume, bounded-staleness
+reads, flag-off inertness (reference: PG materialized views + the
+CDC-SDK consumer shape the maintainer rides on)."""
+import asyncio
+
+import numpy as np
+import pytest
+
+from yugabyte_db_tpu.docdb import ReadRequest
+from yugabyte_db_tpu.dockv.packed_row import (ColumnSchema, ColumnType,
+                                              TableSchema)
+from yugabyte_db_tpu.matview import (MatviewDisabledError,
+                                     MatviewIneligible, ViewDef)
+from yugabyte_db_tpu.matview.definition import validate
+from yugabyte_db_tpu.matview.errors import (REASON_AGG_OP,
+                                            REASON_GROUP_COL_TYPE,
+                                            REASON_INEXACT_SUM_LANE,
+                                            REASON_NO_GROUP_BY,
+                                            REASON_RESCAN_BUDGET)
+from yugabyte_db_tpu.ops.grouped_scan import retract_grouped_cpu
+from yugabyte_db_tpu.ops.scan import (AggSpec, _keyed_partials,
+                                      retract_grouped_partials)
+from yugabyte_db_tpu.ql.executor import SqlSession
+from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+from yugabyte_db_tpu.utils import flags
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# --- retraction algebra (pure unit: keyed inverse vs recompute) -----------
+
+#: count / sum(v) / min(v) / max(v) — the avg-expanded shape both
+#: retraction implementations take
+AGGS = (AggSpec("count"), AggSpec("sum", 1),
+        AggSpec("min", 1), AggSpec("max", 1))
+
+
+def fold_rows(rows):
+    """Recompute-from-scratch reference: keyed triple over (g, v)."""
+    groups = {}
+    for g, v in rows:
+        st = groups.setdefault(g, [0, 0, None, None])
+        st[0] += 1
+        st[1] += v
+        st[2] = v if st[2] is None else min(st[2], v)
+        st[3] = v if st[3] is None else max(st[3], v)
+    keys = sorted(groups)
+    outs = tuple(np.asarray([groups[k][i] for k in keys])
+                 for i in range(4))
+    counts = np.asarray([groups[k][0] for k in keys], np.int64)
+    return outs, counts, (np.asarray(keys),)
+
+
+class TestRetractGroupedPartials:
+    def test_sum_count_bitwise_vs_recompute(self):
+        rows = [(i % 5, i * 7 - 30) for i in range(40)]
+        gone = rows[3:19:2]
+        kept = [r for i, r in enumerate(rows)
+                if not (3 <= i < 19 and (i - 3) % 2 == 0)]
+        out, dirty = retract_grouped_partials(
+            AGGS, fold_rows(rows), fold_rows(gone))
+        got = _keyed_partials(out)
+        ref = _keyed_partials(fold_rows(kept))
+        assert set(got) == set(ref)
+        for k in ref:
+            # count and sum lanes are the exact inverse — bit-identical
+            assert int(got[k][0][0]) == int(ref[k][0][0])
+            assert int(got[k][0][1]) == int(ref[k][0][1])
+            assert got[k][1] == ref[k][1]
+
+    def test_minmax_non_extremum_needs_no_rescan(self):
+        """Retracting values strictly inside (min, max) leaves every
+        lane bit-identical to recompute with an empty dirty list."""
+        rows = [(0, v) for v in (1, 5, 9, 5, 7)] + \
+               [(1, v) for v in (-4, 0, 12, 3)]
+        gone = [(0, 5), (1, 3)]
+        kept = [(0, 1), (0, 9), (0, 5), (0, 7), (1, -4), (1, 0), (1, 12)]
+        out, dirty = retract_grouped_partials(
+            AGGS, fold_rows(rows), fold_rows(gone))
+        assert dirty == []
+        got, ref = _keyed_partials(out), _keyed_partials(fold_rows(kept))
+        assert set(got) == set(ref)
+        for k in ref:
+            assert [int(x) for x in got[k][0]] == \
+                [int(x) for x in ref[k][0]]
+
+    def test_minmax_extremum_reports_dirty_slot(self):
+        rows = [(0, 1), (0, 5), (0, 9)]
+        out, dirty = retract_grouped_partials(
+            AGGS, fold_rows(rows), fold_rows([(0, 1)]))
+        # min lane (index 2) is dirty; max lane untouched; the stale
+        # survivor is kept verbatim for the caller's re-scan
+        assert dirty == [((0,), 2)]
+        assert int(_keyed_partials(out)[(0,)][0][2]) == 1
+
+    def test_group_drops_at_zero_and_is_not_dirty(self):
+        rows = [(0, 3), (0, 8), (1, 4)]
+        out, dirty = retract_grouped_partials(
+            AGGS, fold_rows(rows), fold_rows([(0, 3), (0, 8)]))
+        assert dirty == []
+        assert set(_keyed_partials(out)) == {(1,)}
+
+    def test_over_retract_and_unknown_group_raise(self):
+        base = fold_rows([(0, 3)])
+        with pytest.raises(ValueError):
+            retract_grouped_partials(AGGS, base, fold_rows([(7, 1)]))
+        with pytest.raises(ValueError):
+            retract_grouped_partials(AGGS, base,
+                                     fold_rows([(0, 3), (0, 3)]))
+
+    def test_numpy_twin_matches_keyed_path(self):
+        """retract_grouped_cpu over slot-aligned arrays == the keyed
+        version on the same data (alive slots; dirty mask == list)."""
+        rows = [(s, v) for s in range(6)
+                for v in (s * 10, s * 10 + 5, s * 10 + 9)]
+        gone = [(0, 0), (2, 25), (3, 39), (5, 50), (5, 55), (5, 59)]
+        bo, bc, _ = fold_rows(rows)
+        do, dc, _ = fold_rows(gone)
+        # align the delta onto base slots (missing slots = identity)
+        dvals = [np.zeros_like(np.asarray(bo[i])) for i in range(4)]
+        dcnts = np.zeros_like(bc)
+        for j, s in enumerate(sorted({g for g, _ in gone})):
+            for i in range(4):
+                dvals[i][s] = np.asarray(do[i])[j]
+            dcnts[s] = dc[j]
+        outs, ncnt, dirty = retract_grouped_cpu(
+            AGGS, bo, bc, dvals, dcnts)
+        kout, kdirty = retract_grouped_partials(
+            AGGS, (bo, bc, (np.arange(6),)),
+            (do, dc, (np.asarray(sorted({g for g, _ in gone})),)))
+        keyed = _keyed_partials(kout)
+        for s in range(6):
+            if ncnt[s] == 0:
+                assert (s,) not in keyed
+                continue
+            assert int(ncnt[s]) == keyed[(s,)][1]
+            for i in (0, 1):                     # exact lanes
+                assert int(outs[i][s]) == int(keyed[(s,)][0][i])
+        assert {(k[0], i) for k, i in kdirty} == \
+            {(s, i) for i in range(4) for s in range(6) if dirty[i][s]}
+        with pytest.raises(ValueError):
+            retract_grouped_cpu(AGGS, bo, bc, dvals, bc + 1)
+
+
+# --- eligibility (typed refusals at registration) -------------------------
+
+def _schema():
+    return TableSchema(columns=(
+        ColumnSchema(0, "k", ColumnType.INT64, is_hash_key=True),
+        ColumnSchema(1, "g", ColumnType.INT64),
+        ColumnSchema(2, "v", ColumnType.INT64),
+        ColumnSchema(3, "f", ColumnType.FLOAT64),
+    ), version=1)
+
+
+def _vd(**kw):
+    base = dict(name="mv", table="kv", select_sql="",
+                group_by=["g"], aggs=[("count", None, "cnt")])
+    base.update(kw)
+    return ViewDef(**base)
+
+
+class TestEligibility:
+    def _reason(self, vd):
+        with pytest.raises(MatviewIneligible) as ei:
+            validate(vd, _schema())
+        return ei.value.reason
+
+    def test_typed_refusals(self):
+        assert self._reason(_vd(group_by=[])) == REASON_NO_GROUP_BY
+        assert self._reason(_vd(group_by=["f"])) == REASON_GROUP_COL_TYPE
+        assert self._reason(_vd(
+            aggs=[("avg", ("col", "v"), "a")])) == REASON_AGG_OP
+        assert self._reason(_vd(
+            aggs=[("sum", ("col", "f"), "s")])) == REASON_INEXACT_SUM_LANE
+        # int-lane arithmetic is admitted; float constants are not
+        validate(_vd(aggs=[("sum", ("arith", "add", ("col", "v"),
+                                    ("const", 1)), "s")]), _schema())
+        assert self._reason(_vd(
+            aggs=[("sum", ("arith", "add", ("col", "v"),
+                           ("const", 1.5)), "s")])) \
+            == REASON_INEXACT_SUM_LANE
+
+    def test_wire_roundtrip(self):
+        from yugabyte_db_tpu.matview import viewdef_from_wire
+        vd = _vd(aggs=[("sum", ("col", "v"), "s"),
+                       ("count", None, "cnt")],
+                 where=("and",
+                        ("cmp", "ge", ("col", "v"), ("const", 0)),
+                        ("in", ("col", "g"), [1, 2, 3])),
+                 group_out={"g": ["g", "grp"]})
+        import json
+        assert viewdef_from_wire(
+            json.loads(json.dumps(vd.to_wire()))) == vd
+
+
+# --- live cluster: parity, budgets, restart, staleness, flag gate ---------
+
+DDL = "CREATE TABLE kv (k bigint PRIMARY KEY, g bigint, v bigint)"
+MV = ("CREATE MATERIALIZED VIEW {n} AS SELECT g, count(*) AS cnt, "
+      "sum(v) AS total{mm} FROM kv WHERE v >= 0 GROUP BY g")
+
+
+async def _cluster(tmp_path):
+    mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+    c = mc.client()
+    sess = SqlSession(c)
+    await sess.execute(DDL)
+    await mc.wait_for_leaders("kv")
+    return mc, c, sess
+
+
+async def _reference(c, where_ok, read_ht):
+    """Fresh fold at the view's watermark — the parity oracle."""
+    resp = await c.scan("kv", ReadRequest("", read_ht=read_ht))
+    return {k: [int(v[0]), int(v[1]),
+                (None if v[2] is None else int(v[2])),
+                (None if v[3] is None else int(v[3]))]
+            for k, v in fold_keyed(
+                [r for r in resp.rows if where_ok(r)]).items()}
+
+
+def fold_keyed(rows):
+    out = {}
+    for r in rows:
+        st = out.setdefault((int(r["g"]),), [0, 0, None, None])
+        v = int(r["v"])
+        st[0] += 1
+        st[1] += v
+        st[2] = v if st[2] is None else min(st[2], v)
+        st[3] = v if st[3] is None else max(st[3], v)
+    return out
+
+
+def view_keyed(rows):
+    return {(int(r["g"]),): [int(r["cnt"]), int(r["total"]),
+                             (None if r.get("lo") is None
+                              else int(r["lo"])),
+                             (None if r.get("hi") is None
+                              else int(r["hi"]))]
+            for r in rows}
+
+
+class TestIncrementalParity:
+    def test_sum_count_parity_zero_rescans(self, tmp_path):
+        """Interleaved inserts/updates/deletes: the SUM/COUNT view
+        answers bit-identically to a fresh scan at its watermark with
+        ZERO per-group rescans and zero full rescans — the exact-
+        retraction path carries everything."""
+        async def go():
+            mc, c, sess = await _cluster(tmp_path)
+            try:
+                for i in range(30):
+                    await sess.execute(
+                        f"INSERT INTO kv VALUES ({i}, {i % 4}, {i * 3})")
+                await sess.execute(MV.format(n="mv_sc", mm=""))
+                for i in range(30, 45):
+                    await sess.execute(
+                        f"INSERT INTO kv VALUES ({i}, {i % 4}, "
+                        f"{(i - 37) * 5})")                 # some v < 0
+                for i in range(0, 20, 3):
+                    await sess.execute(
+                        f"UPDATE kv SET v = {i * 11} WHERE k = {i}")
+                for i in range(1, 25, 5):
+                    await sess.execute(f"DELETE FROM kv WHERE k = {i}")
+                rows, meta = await c.matviews().read_rows(
+                    "mv_sc", max_staleness_ms=0.0)
+                ref = await _reference(c, lambda r: int(r["v"]) >= 0,
+                                       meta["watermark_ht"])
+                got = {k: v[:2] for k, v in view_keyed(rows).items()}
+                assert got == {k: v[:2] for k, v in ref.items()}
+                st = c.matviews().stats("mv_sc")
+                assert st["minmax_rescans"] == 0
+                assert st["full_rescans"] == 0
+                assert st["rows_retracted"] > 0
+            finally:
+                await c.matviews().stop()
+                await mc.shutdown()
+        run(go())
+
+    def test_minmax_parity_with_bounded_rescans(self, tmp_path):
+        """MIN/MAX under deletes of group extrema: still bit-identical,
+        with the per-slot re-scans COUNTED (and only fired when the
+        retracted value challenged the survivor)."""
+        async def go():
+            mc, c, sess = await _cluster(tmp_path)
+            try:
+                for i in range(24):
+                    await sess.execute(
+                        f"INSERT INTO kv VALUES ({i}, {i % 3}, {i * 10})")
+                await sess.execute(
+                    MV.format(n="mv_mm",
+                              mm=", min(v) AS lo, max(v) AS hi"))
+                # k=21 holds group 0's max (210); k=1 holds group 1's
+                # min (10): both deletions force a re-scan
+                await sess.execute("DELETE FROM kv WHERE k = 21")
+                await sess.execute("DELETE FROM kv WHERE k = 1")
+                # non-extremum churn must NOT rescan further
+                await sess.execute("UPDATE kv SET v = 55 WHERE k = 4")
+                rows, meta = await c.matviews().read_rows(
+                    "mv_mm", max_staleness_ms=0.0)
+                ref = await _reference(c, lambda r: int(r["v"]) >= 0,
+                                       meta["watermark_ht"])
+                assert view_keyed(rows) == ref
+                st = c.matviews().stats("mv_mm")
+                assert 1 <= st["minmax_rescans"] <= \
+                    int(flags.get("matview_rescan_budget"))
+                assert st["budget_exceeded"] == 0
+                assert st["full_rescans"] == 0
+            finally:
+                await c.matviews().stop()
+                await mc.shutdown()
+        run(go())
+
+    def test_rescan_budget_exceeded_falls_back_typed(self, tmp_path):
+        """budget 0: the first challenged MIN/MAX slot trips the typed
+        fallback — counted, reason recorded, view re-seeded and STILL
+        bit-correct."""
+        async def go():
+            mc, c, sess = await _cluster(tmp_path)
+            flags.set_flag("matview_rescan_budget", 0)
+            try:
+                for i in range(12):
+                    await sess.execute(
+                        f"INSERT INTO kv VALUES ({i}, {i % 2}, {i * 10})")
+                await sess.execute(
+                    MV.format(n="mv_b",
+                              mm=", min(v) AS lo, max(v) AS hi"))
+                await sess.execute("DELETE FROM kv WHERE k = 0")  # min g0
+                rows, meta = await c.matviews().read_rows(
+                    "mv_b", max_staleness_ms=0.0)
+                ref = await _reference(c, lambda r: int(r["v"]) >= 0,
+                                       meta["watermark_ht"])
+                assert view_keyed(rows) == ref
+                st = c.matviews().stats("mv_b")
+                assert st["budget_exceeded"] >= 1
+                assert st["last_fallback_reason"] == REASON_RESCAN_BUDGET
+                assert st["full_rescans"] >= 1
+                assert st["minmax_rescans"] == 0
+            finally:
+                flags.REGISTRY.reset("matview_rescan_budget")
+                await c.matviews().stop()
+                await mc.shutdown()
+        run(go())
+
+    def test_refresh_is_a_counted_full_rescan(self, tmp_path):
+        async def go():
+            mc, c, sess = await _cluster(tmp_path)
+            try:
+                for i in range(10):
+                    await sess.execute(
+                        f"INSERT INTO kv VALUES ({i}, {i % 2}, {i})")
+                await sess.execute(MV.format(n="mv_r", mm=""))
+                await sess.execute("REFRESH MATERIALIZED VIEW mv_r")
+                rows, meta = await c.matviews().read_rows(
+                    "mv_r", max_staleness_ms=0.0)
+                ref = await _reference(c, lambda r: int(r["v"]) >= 0,
+                                       meta["watermark_ht"])
+                assert {k: v[:2] for k, v in view_keyed(rows).items()} \
+                    == {k: v[:2] for k, v in ref.items()}
+                st = c.matviews().stats("mv_r")
+                assert st["seeds"] == 2 and st["full_rescans"] == 1
+            finally:
+                await c.matviews().stop()
+                await mc.shutdown()
+        run(go())
+
+
+class TestRestartResume:
+    def test_attach_resumes_from_watermark(self, tmp_path):
+        """Maintainer host 'crashes' (manager stops, client discarded);
+        writes land while nobody watches; a FRESH client attaches from
+        the master catalog and folds forward from the persisted
+        watermark — no re-seed (seeds stays 1), catalog reload proven
+        against the on-disk sys catalog."""
+        async def go():
+            mc, c, sess = await _cluster(tmp_path)
+            try:
+                for i in range(16):
+                    await sess.execute(
+                        f"INSERT INTO kv VALUES ({i}, {i % 2}, {i * 2})")
+                await sess.execute(MV.format(n="mv_p", mm=""))
+                # quiesce the fold loop at a persisted checkpoint
+                await c.matviews().read_rows("mv_p", max_staleness_ms=0.0)
+                await c.matviews().stop()
+                # the definition + state survive in the on-disk catalog
+                from yugabyte_db_tpu.master import Master
+                m2 = Master(mc.masters[0].fs_root, uuid="reload-probe")
+                assert "mv_p" in m2.matviews
+                assert m2.matviews["mv_p"]["state"]["partials"]
+                # writes while detached
+                await sess.execute("INSERT INTO kv VALUES (100, 0, 999)")
+                await sess.execute("DELETE FROM kv WHERE k = 3")
+                # fresh process: new client, lookup attaches + resumes
+                c2 = mc.client()
+                sess2 = SqlSession(c2)
+                rows, meta = await c2.matviews().read_rows(
+                    "mv_p", max_staleness_ms=0.0)
+                ref = await _reference(c2, lambda r: int(r["v"]) >= 0,
+                                       meta["watermark_ht"])
+                assert {k: v[:2] for k, v in view_keyed(rows).items()} \
+                    == {k: v[:2] for k, v in ref.items()}
+                st = c2.matviews().stats("mv_p")
+                assert st["seeds"] == 1, "attach must not re-seed"
+                # and the SQL surface serves it with staleness attached
+                res = await sess2.execute("SELECT g, cnt FROM mv_p")
+                assert res.staleness_ms is not None
+                assert len(res.rows) == len(rows)
+                await c2.matviews().stop()
+            finally:
+                await c.matviews().stop()
+                await mc.shutdown()
+        run(go())
+
+
+class TestBoundedStaleness:
+    def test_read_surfaces_and_enforces_staleness(self, tmp_path):
+        async def go():
+            mc, c, sess = await _cluster(tmp_path)
+            try:
+                for i in range(8):
+                    await sess.execute(
+                        f"INSERT INTO kv VALUES ({i}, {i % 2}, {i})")
+                await sess.execute(MV.format(n="mv_s", mm=""))
+                mt = await c.matviews().lookup("mv_s")
+                await mt.stop()                 # freeze the fold loop
+                await sess.execute("INSERT INTO kv VALUES (50, 1, 7)")
+                await asyncio.sleep(0.05)
+                # lenient bound: serve stale, but SURFACE the staleness
+                rows, meta = await c.matviews().read_rows(
+                    "mv_s", max_staleness_ms=60_000.0)
+                assert meta["staleness_ms"] >= 0.0
+                assert not meta["caught_up"]
+                # tight bound: the read must first catch up, then serve
+                rows, meta = await c.matviews().read_rows(
+                    "mv_s", max_staleness_ms=0.0)
+                assert meta["caught_up"]
+                ref = await _reference(c, lambda r: int(r["v"]) >= 0,
+                                       meta["watermark_ht"])
+                assert {k: v[:2] for k, v in view_keyed(rows).items()} \
+                    == {k: v[:2] for k, v in ref.items()}
+                assert any(int(r["total"]) for r in rows)
+            finally:
+                await c.matviews().stop()
+                await mc.shutdown()
+        run(go())
+
+
+class TestFlagGate:
+    def test_flag_off_is_inert(self, tmp_path):
+        async def go():
+            mc, c, sess = await _cluster(tmp_path)
+            try:
+                flags.set_flag("matview_enabled", False)
+                with pytest.raises(MatviewDisabledError):
+                    await sess.execute(MV.format(n="mv_off", mm=""))
+                assert await c.matviews().lookup("mv_off") is None
+                # SELECT falls through to the plain NOT_FOUND path
+                from yugabyte_db_tpu.rpc import RpcError
+                with pytest.raises(RpcError) as ei:
+                    await sess.execute("SELECT * FROM mv_off")
+                assert ei.value.code == "NOT_FOUND"
+                flags.REGISTRY.reset("matview_enabled")
+                # on again: full lifecycle works and DROP removes the
+                # catalog entry + slot
+                await sess.execute("INSERT INTO kv VALUES (1, 0, 5)")
+                await sess.execute(MV.format(n="mv_on", mm=""))
+                assert await c.list_matviews() == ["mv_on"]
+                await sess.execute("DROP MATERIALIZED VIEW mv_on")
+                assert await c.list_matviews() == []
+                assert await c._master_call(
+                    "list_replication_slots", {}) == {"slots": []}
+            finally:
+                flags.REGISTRY.reset("matview_enabled")
+                await c.matviews().stop()
+                await mc.shutdown()
+        run(go())

@@ -50,6 +50,14 @@ LAYER_RULES = {
     # may import storage/dockv/ops/utils (and docdb for the shared
     # expression rewrite), never server layers
     "yugabyte_db_tpu/docstore/": ("tserver", "tablet", "rpc"),
+    # matview maintainers reach the cluster ONLY through client RPCs,
+    # the CDC slot API and the ops combine seam (cdc/client/ops/utils/
+    # models allowed) — importing server internals would let a
+    # maintainer "fold" straight out of a tablet's memtable, which is
+    # exactly the consistency shortcut the pinned-read-point + stream
+    # design exists to kill
+    "yugabyte_db_tpu/matview/": ("tserver", "tablet", "storage",
+                                 "consensus"),
 }
 
 _PKG_ROOT = "yugabyte_db_tpu"
@@ -75,7 +83,9 @@ class LayeringPass(AnalysisPass):
     hint = ("scoped subsystems keep their dependency direction: bypass "
             "takes data through storage/ops/parallel seams (never "
             "tserver/sched/rpc); cluster talks to servers only over "
-            "RPC/client/signals (never server internals)")
+            "RPC/client/signals (never server internals); matview "
+            "folds through client/cdc/ops seams (never "
+            "tserver/tablet/storage/consensus)")
 
     def _check_target(self, rel: str, forbidden, target: str):
         """First forbidden layer named by dotted import target, if

@@ -369,6 +369,42 @@ class YBClient:
             raise
         return r["select_sql"]
 
+    # --- materialized views (matview/) ------------------------------------
+    def matviews(self):
+        """The per-client incremental-matview manager (lazy: the
+        subsystem imports only when a matview surface is touched)."""
+        if getattr(self, "_matview_mgr", None) is None:
+            from ..matview.manager import MatviewManager
+            self._matview_mgr = MatviewManager(self)
+        return self._matview_mgr
+
+    async def create_matview(self, name: str, viewdef: dict,
+                             slot_id: Optional[str] = None,
+                             state: Optional[dict] = None) -> None:
+        await self._master_call("create_matview", {
+            "name": name, "def": viewdef, "slot_id": slot_id,
+            "state": state})
+
+    async def get_matview(self, name: str) -> Optional[dict]:
+        try:
+            r = await self._master_call("get_matview", {"name": name})
+        except RpcError as e:
+            if e.code == "NOT_FOUND":
+                return None
+            raise
+        return r["matview"]
+
+    async def update_matview(self, name: str, **fields) -> None:
+        await self._master_call("update_matview",
+                                {"name": name, **fields})
+
+    async def drop_matview(self, name: str) -> None:
+        await self._master_call("drop_matview", {"name": name})
+
+    async def list_matviews(self) -> List[str]:
+        r = await self._master_call("list_matviews", {})
+        return r["matviews"]
+
     async def drop_table(self, name: str) -> None:
         await self._master_call("drop_table", {"name": name})
         self._tables.pop(name, None)

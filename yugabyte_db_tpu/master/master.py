@@ -55,6 +55,12 @@ class Master:
         # view name -> SELECT body SQL (persisted verbatim; expanded
         # by the SQL layer at query time — reference: PG pg_views)
         self.views: Dict[str, str] = {}
+        # materialized-view name -> {"def": structured ViewDef dict,
+        # "slot_id": CDC slot feeding the maintainer, "state": the
+        # maintainer's durable fold state (partials + applied LSN +
+        # watermark) — persisted BEFORE the slot's confirm_flush so a
+        # restarted maintainer resumes exactly-once (matview/)
+        self.matviews: Dict[str, dict] = {}
         # tablespace name -> placement policy (reference: YSQL
         # tablespaces as geo-placement policies,
         # master/ysql_tablespace_manager.cc):
@@ -139,6 +145,10 @@ class Master:
                 self.views[op[1]] = op[2]
             elif kind == "del_view":
                 self.views.pop(op[1], None)
+            elif kind == "put_matview":
+                self.matviews[op[1]] = op[2]
+            elif kind == "del_matview":
+                self.matviews.pop(op[1], None)
             elif kind == "put_tablespace":
                 self.tablespaces[op[1]] = op[2]
             elif kind == "del_tablespace":
@@ -190,6 +200,7 @@ class Master:
             self.replication_slots = d.get("repl_slots", {})
             self.sequences = d.get("sequences", {})
             self.views = d.get("views", {})
+            self.matviews = d.get("matviews", {})
             self.tablespaces = d.get("tablespaces", {})
 
     def _dump_catalog(self) -> str:
@@ -201,6 +212,7 @@ class Master:
                            "repl_slots": self.replication_slots,
                            "sequences": self.sequences,
                            "views": self.views,
+                           "matviews": self.matviews,
                            "tablespaces": self.tablespaces})
 
     def _write_catalog(self, data: str) -> None:
@@ -1669,6 +1681,62 @@ class Master:
             raise RpcError(f"view {payload['name']} not found",
                            "NOT_FOUND")
         return {"select_sql": sql}
+
+    # --- materialized views (matview/; reference: PG pg_matviews +
+    # the cdc_state slot metadata those maintainers consume) -------------
+    async def rpc_create_matview(self, payload) -> dict:
+        self._check_leader()
+        name = payload["name"]
+        if name in self.matviews:
+            raise RpcError(f"materialized view {name} exists",
+                           "ALREADY_PRESENT")
+        if name in self.views or any(
+                t["info"]["name"] == name for t in self.tables.values()):
+            raise RpcError(f"{name} is a table or view",
+                           "ALREADY_PRESENT")
+        ent = {"def": payload["def"],
+               "slot_id": payload.get("slot_id"),
+               "state": payload.get("state")}
+        await self._commit_catalog([["put_matview", name, ent]])
+        return {"ok": True}
+
+    async def rpc_get_matview(self, payload) -> dict:
+        ent = self.matviews.get(payload["name"])
+        if ent is None:
+            raise RpcError(
+                f"materialized view {payload['name']} not found",
+                "NOT_FOUND")
+        return {"matview": ent}
+
+    async def rpc_update_matview(self, payload) -> dict:
+        """Persist maintainer progress (fold state / slot rebind).
+        Callers persist state BEFORE confirm_flush on the slot: a crash
+        between the two replays already-applied txns, and the state's
+        applied LSN filters them — exactly-once without a second log."""
+        self._check_leader()
+        name = payload["name"]
+        ent = self.matviews.get(name)
+        if ent is None:
+            raise RpcError(f"materialized view {name} not found",
+                           "NOT_FOUND")
+        ent = dict(ent)
+        for k in ("state", "slot_id", "def"):
+            if k in payload:
+                ent[k] = payload[k]
+        await self._commit_catalog([["put_matview", name, ent]])
+        return {"ok": True}
+
+    async def rpc_drop_matview(self, payload) -> dict:
+        self._check_leader()
+        name = payload["name"]
+        if name not in self.matviews:
+            raise RpcError(f"materialized view {name} not found",
+                           "NOT_FOUND")
+        await self._commit_catalog([["del_matview", name]])
+        return {"ok": True}
+
+    async def rpc_list_matviews(self, payload) -> dict:
+        return {"matviews": sorted(self.matviews)}
 
     async def rpc_list_replication_slots(self, payload) -> dict:
         self._check_leader()

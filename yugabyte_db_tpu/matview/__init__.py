@@ -1,0 +1,42 @@
+"""Incremental materialized aggregate views fed by the CDC stream.
+
+ROADMAP's incremental-computation item, view half: dashboards that
+to date re-ran the same grouped scan on every refresh instead keep a
+registered **grouped-partial set** up to date from the change stream —
+the "Near Data Processing" thesis applied to the write path itself.
+
+A view is `SELECT <group cols>, <SUM/COUNT/MIN/MAX aggs> FROM t
+[WHERE ...] GROUP BY <cols>`:
+
+- **registration** (`CREATE MATERIALIZED VIEW` through ql/, definition
+  persisted in the master catalog) seeds the partials with ONE grouped
+  scan at a pinned read point — the same tails-then-snapshot alignment
+  the xCluster resync uses — then
+- a **maintainer** consumes the per-tablet change stream from exactly
+  that watermark (cdc/virtual_wal.py: resumable, split-transparent)
+  and folds insert deltas through the shared
+  `ops.scan.combine_grouped_partials`;
+- **deletes/updates** retract through the new
+  `ops.scan.retract_grouped_partials`: SUM/COUNT exactly (exact-int64
+  lanes per the ops/scan contract), MIN/MAX via a bounded, counted
+  per-group re-scan when the retracted value challenges the surviving
+  extremum (`matview_rescan_budget`; exceeding it is a typed event
+  answered by one full re-seed);
+- reads serve from the partials with **bounded staleness** — every
+  read surfaces its `staleness_ms`, and a read beyond
+  `matview_max_staleness_ms` first drives a synchronous catch-up fold.
+
+Layering: this package talks to the cluster only through the client /
+cdc / ops / utils seams — never tserver/tablet/storage/consensus
+internals (tools/analyze layering rule).
+"""
+from .definition import ViewDef, viewdef_from_wire
+from .errors import (MatviewDisabledError, MatviewError,
+                     MatviewIneligible, RescanBudgetExceeded)
+from .manager import MatviewManager
+
+__all__ = [
+    "MatviewManager", "ViewDef", "viewdef_from_wire",
+    "MatviewError", "MatviewDisabledError", "MatviewIneligible",
+    "RescanBudgetExceeded",
+]

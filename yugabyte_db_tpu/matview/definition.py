@@ -1,0 +1,258 @@
+"""View definitions: the structured, catalog-persisted form of
+`CREATE MATERIALIZED VIEW v AS SELECT ... WHERE ... GROUP BY ...`.
+
+The SQL layer parses the statement and hands this package a
+:class:`ViewDef` built from plain name-based ASTs — matview never
+imports ql/ (layering rule), and the catalog entry stores BOTH the
+original SELECT text (display, pg_matviews analog) and the structured
+definition (reload without a parser).
+
+Eligibility is decided here, at registration, and is typed: the
+incremental fold must answer BIT-IDENTICALLY to a fresh scan at the
+view's watermark, so every admitted shape has an exact retraction
+story. SUM lanes must be exact int64 (integer/bool expressions — the
+ops/scan.py contract; float SUMs quantize with per-batch scales and
+cannot be folded stably), MIN/MAX/COUNT ride on exact column types,
+and the WHERE predicate is restricted to the node kinds
+matview.expr evaluates (what the maintainer can't re-check row-wise,
+it must refuse up front).
+"""
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..dockv.packed_row import ColumnSchema, ColumnType, TableSchema
+from .errors import (REASON_AGG_OP, REASON_GROUP_COL_TYPE,
+                     REASON_INEXACT_SUM_LANE, REASON_NO_GROUP_BY,
+                     REASON_PREDICATE_SHAPE, MatviewIneligible)
+from .expr import SUPPORTED_KINDS
+
+#: aggregate ops the maintainer folds (avg is NOT here on purpose:
+#: its sum/count expansion would need result-layer recombination the
+#: matview read path doesn't own — register the two lanes instead)
+SUPPORTED_AGG_OPS = ("sum", "count", "min", "max")
+
+#: group-key column types with an exact host/device representation
+#: (floats would round at batch formation; json/vector don't key)
+GROUP_KEY_TYPES = (ColumnType.INT32, ColumnType.INT64,
+                   ColumnType.TIMESTAMP, ColumnType.BOOL,
+                   ColumnType.STRING)
+
+#: exact-int64 lanes per the ops/scan.py contract
+EXACT_INT_TYPES = (ColumnType.INT32, ColumnType.INT64,
+                   ColumnType.TIMESTAMP, ColumnType.BOOL)
+
+
+@dataclass
+class ViewDef:
+    """One registered materialized aggregate view.
+
+    ``aggs``: ``(op, expr, out_name)`` with name-based expression ASTs
+    (expr None = COUNT(*)); ``group_out``: group column name -> every
+    projected output name for it (aliases), the _rows_select contract;
+    ``where``: name-based predicate AST or None."""
+    name: str
+    table: str
+    select_sql: str
+    group_by: List[str]
+    aggs: List[Tuple[str, Optional[tuple], str]]
+    where: Optional[tuple] = None
+    group_out: Dict[str, List[str]] = field(default_factory=dict)
+
+    def to_wire(self) -> dict:
+        return {"name": self.name, "table": self.table,
+                "select_sql": self.select_sql,
+                "group_by": list(self.group_by),
+                "aggs": [[op, _listify(e), out]
+                         for op, e, out in self.aggs],
+                "where": _listify(self.where),
+                "group_out": {k: list(v)
+                              for k, v in self.group_out.items()}}
+
+
+def viewdef_from_wire(d: dict) -> ViewDef:
+    return ViewDef(
+        name=d["name"], table=d["table"], select_sql=d["select_sql"],
+        group_by=list(d["group_by"]),
+        aggs=[(op, _tuplize(e), out) for op, e, out in d["aggs"]],
+        where=_tuplize(d.get("where")),
+        group_out={k: list(v) for k, v in d.get("group_out", {}).items()})
+
+
+# --- AST plumbing (name-based trees <-> JSON, names -> ids) ---------------
+
+def _listify(node):
+    """Tuple AST -> JSON-serializable nested lists."""
+    if node is None:
+        return None
+    return [_listify(c) if isinstance(c, tuple) else
+            (list(c) if isinstance(c, list) else c) for c in node]
+
+
+def _tuplize(node):
+    """JSON nested lists -> tuple AST. IN value lists stay lists —
+    they are data, not child nodes."""
+    if node is None:
+        return None
+    kind = node[0]
+    if kind == "in":
+        return ("in", _tuplize(node[1]), list(node[2]))
+    return tuple(_tuplize(c) if isinstance(c, (list, tuple)) else c
+                 for c in node)
+
+
+def map_cols(node, fn):
+    """Rewrite every ("col", x) leaf through fn — the one transformer
+    both directions of name<->id binding share."""
+    if node is None:
+        return None
+    if node[0] == "col":
+        return ("col", fn(node[1]))
+    if node[0] == "in":
+        return ("in", map_cols(node[1], fn), node[2])
+    return (node[0],) + tuple(
+        map_cols(c, fn) if isinstance(c, tuple) else c
+        for c in node[1:])
+
+
+def bind_expr(node, schema: TableSchema):
+    """Name AST -> id-bound AST for server-side ReadRequests."""
+    return map_cols(node, lambda n: schema.column_by_name(n).id)
+
+
+def expr_columns(node) -> List[str]:
+    out: List[str] = []
+
+    def walk(n):
+        if n is None:
+            return
+        if n[0] == "col":
+            out.append(n[1])
+            return
+        for c in (n[1:] if n[0] != "in" else (n[1],)):
+            if isinstance(c, tuple):
+                walk(c)
+    walk(node)
+    return out
+
+
+def group_eq_where(bound_where, group_cids: List[int],
+                   key: tuple) -> tuple:
+    """The per-group re-scan predicate: view WHERE AND group cols ==
+    key — over ids, ready for a ReadRequest."""
+    node = None
+    for cid, v in zip(group_cids, key):
+        eq = ("cmp", "eq", ("col", cid), ("const", v))
+        node = eq if node is None else ("and", node, eq)
+    if bound_where is not None:
+        node = ("and", bound_where, node)
+    return node
+
+
+# --- eligibility ----------------------------------------------------------
+
+def _expr_kinds_ok(node) -> Optional[str]:
+    """First unsupported node kind in the tree, or None."""
+    if node is None:
+        return None
+    if not isinstance(node, tuple) or not node or \
+            not isinstance(node[0], str):
+        return repr(node)
+    if node[0] not in SUPPORTED_KINDS:
+        return node[0]
+    children = (node[1],) if node[0] == "in" else node[1:]
+    for c in children:
+        if isinstance(c, tuple):
+            bad = _expr_kinds_ok(c)
+            if bad is not None:
+                return bad
+    return None
+
+
+def _exact_int_expr(node, schema: TableSchema) -> bool:
+    """True when the expression is an exact-int64 lane end to end:
+    int/bool/timestamp columns, integer constants, +-* arithmetic.
+    Anything touching a float (or an opaque kind) fails — those SUMs
+    quantize on device and cannot retract bit-exactly."""
+    kind = node[0]
+    if kind == "col":
+        try:
+            c = schema.column_by_name(node[1])
+        except Exception:
+            return False
+        return c.type in EXACT_INT_TYPES
+    if kind == "const":
+        return isinstance(node[1], int) and not isinstance(node[1], bool) \
+            or isinstance(node[1], bool)
+    if kind == "arith" and node[1] in ("add", "sub", "mul"):
+        return _exact_int_expr(node[2], schema) \
+            and _exact_int_expr(node[3], schema)
+    return False
+
+
+def validate(viewdef: ViewDef, schema: TableSchema) -> None:
+    """Admit or refuse (typed) a definition against the live schema."""
+    if not viewdef.group_by:
+        raise MatviewIneligible(REASON_NO_GROUP_BY,
+                                "matviews are GROUP BY partial sets")
+    for name in viewdef.group_by:
+        try:
+            c = schema.column_by_name(name)
+        except Exception:
+            raise MatviewIneligible(REASON_GROUP_COL_TYPE,
+                                    f"unknown column {name!r}")
+        if c.type not in GROUP_KEY_TYPES:
+            raise MatviewIneligible(
+                REASON_GROUP_COL_TYPE,
+                f"{name} is {c.type}; group keys must be one of "
+                f"{GROUP_KEY_TYPES}")
+    if not viewdef.aggs:
+        raise MatviewIneligible(REASON_AGG_OP,
+                                "a matview needs at least one aggregate")
+    for op, e, out in viewdef.aggs:
+        if op not in SUPPORTED_AGG_OPS:
+            raise MatviewIneligible(REASON_AGG_OP, f"{op}({out})")
+        if e is None:
+            if op != "count":
+                raise MatviewIneligible(REASON_AGG_OP,
+                                        f"{op} needs an expression")
+            continue
+        bad = _expr_kinds_ok(e)
+        if bad is not None:
+            raise MatviewIneligible(REASON_PREDICATE_SHAPE,
+                                    f"aggregate expr kind {bad!r}")
+        if not _exact_int_expr(e, schema):
+            # min/max/count never re-accumulate, but device kernels may
+            # compute float lanes in f32 — exact types keep the fold
+            # and every scan backend bit-identical
+            raise MatviewIneligible(
+                REASON_INEXACT_SUM_LANE,
+                f"{op}({out}) is not an exact-int64 lane")
+        for cn in expr_columns(e):
+            schema.column_by_name(cn)     # KeyError -> caller surfaces
+    bad = _expr_kinds_ok(viewdef.where)
+    if bad is not None:
+        raise MatviewIneligible(REASON_PREDICATE_SHAPE,
+                                f"WHERE kind {bad!r}")
+    for cn in expr_columns(viewdef.where):
+        try:
+            schema.column_by_name(cn)
+        except Exception:
+            raise MatviewIneligible(REASON_PREDICATE_SHAPE,
+                                    f"unknown column {cn!r}")
+
+
+# --- group-key normalization ----------------------------------------------
+
+def key_normalizers(viewdef: ViewDef, schema: TableSchema):
+    """Per-group-column python-type normalizers: state keys, seed-scan
+    group values and CDC row values must hash equal."""
+    fns = []
+    for name in viewdef.group_by:
+        t = schema.column_by_name(name).type
+        if t == ColumnType.BOOL:
+            fns.append(lambda v: None if v is None else bool(v))
+        elif t == ColumnType.STRING:
+            fns.append(lambda v: None if v is None else str(v))
+        else:
+            fns.append(lambda v: None if v is None else int(v))
+    return fns

@@ -1,0 +1,53 @@
+"""Typed matview errors — every refusal carries a machine-readable
+reason so tests and callers branch on codes, not message text."""
+
+#: registration refusals (MatviewIneligible.reason)
+REASON_NO_GROUP_BY = "no_group_by"
+REASON_AGG_OP = "agg_op"
+REASON_INEXACT_SUM_LANE = "inexact_sum_lane"
+REASON_GROUP_COL_TYPE = "group_col_type"
+REASON_PREDICATE_SHAPE = "predicate_shape"
+REASON_SELECT_SHAPE = "select_shape"
+
+#: maintainer fallback reasons (stats["last_fallback_reason"])
+REASON_RESCAN_BUDGET = "rescan_budget_exceeded"
+REASON_SLOT_INVALID = "slot_invalidated"
+
+
+class MatviewError(Exception):
+    """Base of every matview-subsystem error."""
+
+
+class MatviewDisabledError(MatviewError):
+    """The matview_enabled flag is off: the surface refuses whole —
+    nothing registers, nothing serves, no existing path changes."""
+
+    def __init__(self):
+        super().__init__("materialized views are disabled "
+                         "(matview_enabled=false)")
+
+
+class MatviewIneligible(MatviewError):
+    """A view definition the incremental maintainer cannot keep
+    bit-exact (float SUM lanes, unsupported aggregate ops, opaque
+    predicates...). Registration-time and typed: the reason names the
+    first offending shape."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        self.reason = reason
+        super().__init__(f"matview ineligible ({reason})"
+                         + (f": {detail}" if detail else ""))
+
+
+class RescanBudgetExceeded(MatviewError):
+    """One fold round needed more MIN/MAX group re-scans than
+    matview_rescan_budget allows. The maintainer answers with a full
+    re-seed (counted, reason-tagged) — the view stays correct, the
+    event stays observable."""
+
+    def __init__(self, needed: int, budget: int):
+        self.needed = needed
+        self.budget = budget
+        super().__init__(
+            f"min/max retraction needs {needed} group re-scans; "
+            f"budget is {budget}")

@@ -1,0 +1,90 @@
+"""Name-keyed expression evaluation for the matview fold path.
+
+The maintainer folds CDC rows, which arrive as {column name: value}
+dicts — the client write shape, NOT the id-bound shape the server's
+pushdown AST uses. This evaluator runs the view's WHERE predicate and
+aggregate expressions directly over those rows, with the same SQL
+NULL semantics as docdb.operations.eval_expr_py (three-valued cmp/
+and/or, NULL propagation through arithmetic). Only the node kinds
+:func:`yugabyte_db_tpu.matview.definition.validate_expr` admits at
+registration ever reach it, so the restricted kind set here IS the
+eligibility surface, not a silent gap.
+"""
+from typing import Dict, Optional
+
+SUPPORTED_KINDS = frozenset(
+    ("col", "const", "cmp", "arith", "and", "or", "not", "between",
+     "in", "isnull"))
+
+_CMP = {"lt": lambda l, r: l < r, "le": lambda l, r: l <= r,
+        "gt": lambda l, r: l > r, "ge": lambda l, r: l >= r,
+        "eq": lambda l, r: l == r, "ne": lambda l, r: l != r}
+
+
+def eval_expr(node, row: Dict[str, object]):
+    """Evaluate a name-based AST over one row dict; None is SQL NULL
+    (a column missing from the row reads as NULL)."""
+    kind = node[0]
+    if kind == "col":
+        return row.get(node[1])
+    if kind == "const":
+        return node[1]
+    if kind == "cmp":
+        l = eval_expr(node[2], row)
+        r = eval_expr(node[3], row)
+        if l is None or r is None:
+            return None
+        return _CMP[node[1]](l, r)
+    if kind == "arith":
+        l = eval_expr(node[2], row)
+        r = eval_expr(node[3], row)
+        if l is None or r is None:
+            return None
+        op = node[1]
+        if op == "add":
+            return l + r
+        if op == "sub":
+            return l - r
+        if op == "mul":
+            return l * r
+        raise ValueError(op)
+    if kind == "and":
+        l = eval_expr(node[1], row)
+        r = eval_expr(node[2], row)
+        if l is False or r is False:
+            return False
+        if l is None or r is None:
+            return None
+        return l and r
+    if kind == "or":
+        l = eval_expr(node[1], row)
+        r = eval_expr(node[2], row)
+        if l is True or r is True:
+            return True
+        if l is None or r is None:
+            return None
+        return l or r
+    if kind == "not":
+        v = eval_expr(node[1], row)
+        return None if v is None else not v
+    if kind == "between":
+        x = eval_expr(node[1], row)
+        lo = eval_expr(node[2], row)
+        hi = eval_expr(node[3], row)
+        if x is None or lo is None or hi is None:
+            return None
+        return lo <= x <= hi
+    if kind == "in":
+        x = eval_expr(node[1], row)
+        if x is None:
+            return None
+        return x in tuple(node[2])
+    if kind == "isnull":
+        return eval_expr(node[1], row) is None
+    raise ValueError(f"unsupported matview expr kind {kind!r}")
+
+
+def passes(where: Optional[tuple], row: Dict[str, object]) -> bool:
+    """SQL WHERE semantics: the row counts only when the predicate is
+    exactly True (NULL filters out)."""
+    return where is None or eval_expr(where, row) is True

@@ -1,0 +1,139 @@
+"""The per-client matview registry: registration, attach-on-restart,
+bounded-staleness reads, refresh, drop.
+
+One manager hangs off a YBClient (``client.matviews()``); each
+registered or attached view gets a :class:`ViewMaintainer` running its
+fold loop as an in-process asyncio task — the same process-tree slot
+the xCluster replicator occupies (CLUSTER.md), not a server-side
+component: maintainers reach the cluster exclusively through client
+RPCs and the CDC slot API, so any node (or a dedicated process) can
+host them and a crashed host resumes from the catalog.
+"""
+from typing import Dict, List, Optional, Tuple
+
+from ..utils import flags
+from .definition import ViewDef, validate, viewdef_from_wire
+from .errors import MatviewDisabledError, MatviewError
+from .maintainer import ViewMaintainer
+
+
+def _check_enabled() -> None:
+    if not flags.get("matview_enabled"):
+        raise MatviewDisabledError()
+
+
+class MatviewManager:
+    def __init__(self, client):
+        self.client = client
+        self._views: Dict[str, ViewMaintainer] = {}
+        #: meta of the most recent read (staleness surfacing)
+        self.last_read: Optional[dict] = None
+
+    # --- lifecycle --------------------------------------------------------
+    async def create(self, viewdef: ViewDef,
+                     start: bool = True) -> ViewMaintainer:
+        """Register: validate eligibility, seed at a pinned read
+        point, start the maintainer, persist the definition."""
+        _check_enabled()
+        if await self.client.get_matview(viewdef.name) is not None:
+            from ..rpc.messenger import RpcError
+            raise RpcError(
+                f"materialized view {viewdef.name} exists",
+                "ALREADY_PRESENT")
+        ct = await self.client._table(viewdef.table)
+        validate(viewdef, ct.info.schema)
+        mt = ViewMaintainer(self.client, viewdef, ct.info.schema)
+        await mt.seed()
+        self._views[viewdef.name] = mt
+        if start:
+            mt.start()
+        return mt
+
+    async def lookup(self, name: str,
+                     start: bool = True) -> Optional[ViewMaintainer]:
+        """Running maintainer for `name`, attaching from the persisted
+        catalog entry if this process has none — None when the view
+        does not exist (callers fall through to plain views)."""
+        if not flags.get("matview_enabled"):
+            return None
+        mt = self._views.get(name)
+        if mt is not None:
+            return mt
+        ent = await self.client.get_matview(name)
+        if ent is None:
+            return None
+        viewdef = viewdef_from_wire(ent["def"])
+        ct = await self.client._table(viewdef.table)
+        mt = ViewMaintainer(self.client, viewdef, ct.info.schema)
+        await mt.attach(ent)
+        self._views[name] = mt
+        if start:
+            mt.start()
+        return mt
+
+    async def drop(self, name: str) -> None:
+        _check_enabled()
+        mt = self._views.pop(name, None)
+        ent = await self.client.get_matview(name)
+        if ent is None and mt is None:
+            raise MatviewError(f"materialized view {name} not found")
+        if mt is not None:
+            await mt.stop()
+            if mt.vw is not None:
+                try:
+                    await mt.vw.drop()
+                except Exception:
+                    pass
+        elif ent is not None and ent.get("slot_id"):
+            try:
+                await self.client._master_call(
+                    "drop_replication_slot", {"slot_id": ent["slot_id"]})
+            except Exception:
+                pass
+        if ent is not None:
+            await self.client.drop_matview(name)
+
+    async def refresh(self, name: str) -> None:
+        """REFRESH MATERIALIZED VIEW: the full-rescan escape hatch —
+        re-pin, re-seed, rebind the slot."""
+        _check_enabled()
+        mt = await self.lookup(name)
+        if mt is None:
+            raise MatviewError(f"materialized view {name} not found")
+        async with mt._round_lock:
+            await mt._reseed()
+
+    async def stop(self) -> None:
+        """Stop every maintainer loop (process shutdown / tests)."""
+        for mt in self._views.values():
+            await mt.stop()
+
+    # --- reads ------------------------------------------------------------
+    async def read_rows(self, name: str,
+                        max_staleness_ms: Optional[float] = None
+                        ) -> Tuple[List[dict], dict]:
+        """Serve the view from its partials with bounded staleness:
+        a read observing staleness beyond the bound first drives a
+        synchronous catch-up fold, then serves. Returns (rows, meta);
+        meta surfaces staleness_ms on EVERY read."""
+        _check_enabled()
+        mt = await self.lookup(name)
+        if mt is None:
+            raise MatviewError(f"materialized view {name} not found")
+        bound = (float(flags.get("matview_max_staleness_ms"))
+                 if max_staleness_ms is None else float(max_staleness_ms))
+        caught_up = False
+        if mt.staleness_ms() > bound:
+            await mt.catch_up()
+            caught_up = True
+        meta = {"view": name, "staleness_ms": mt.staleness_ms(),
+                "watermark_ht": mt.watermark_ht,
+                "caught_up": caught_up}
+        self.last_read = meta
+        return mt.rows(), meta
+
+    def stats(self, name: str) -> dict:
+        mt = self._views.get(name)
+        if mt is None:
+            raise MatviewError(f"materialized view {name} not attached")
+        return dict(mt.counters)

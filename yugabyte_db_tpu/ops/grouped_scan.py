@@ -442,3 +442,41 @@ def grouped_aggregate_cpu(blocks: Sequence[ColumnarBlock],
             raise ValueError(a.op)
     counts = np.bincount(gid_c[mask], minlength=S).astype(np.int64)
     return tuple(outs), counts, spilled
+
+
+def retract_grouped_cpu(aggs, vals, counts, delta_vals, delta_counts):
+    """Dense-slot numpy twin of ops/scan.py
+    :func:`~yugabyte_db_tpu.ops.scan.retract_grouped_partials`: both
+    operands are slot-ALIGNED arrays (slot i means the same group in
+    base and delta — the kernel-side layout, unlike the keyed triples
+    the client combine passes around). SUM/COUNT lanes subtract
+    exactly; MIN/MAX lanes cannot un-aggregate, so the twin returns a
+    per-(agg, slot) dirty mask marking every slot whose retracted
+    extremum challenges the surviving value (== the keyed version's
+    dirty list; the caller re-scans those slots). Slots whose row
+    count reaches zero clear to identity and are never dirty.
+
+    ``aggs`` must already be avg-expanded. Returns
+    ``(outs, new_counts, dirty)`` with ``dirty`` of shape
+    ``[len(aggs), slots]`` (bool)."""
+    counts = np.asarray(counts, np.int64)
+    dcounts = np.asarray(delta_counts, np.int64)
+    if np.any(dcounts > counts):
+        raise ValueError("retract of more rows than a slot holds")
+    new_counts = counts - dcounts
+    alive = new_counts > 0
+    outs = []
+    dirty = np.zeros((len(aggs), len(counts)), bool)
+    for i, a in enumerate(aggs):
+        v = np.asarray(vals[i])
+        dv = np.asarray(delta_vals[i])
+        if a.op in ("sum", "count"):
+            outs.append(np.where(alive, v - dv, np.zeros_like(v)))
+            continue
+        # min/max: a delta extremum at/past the base extremum means the
+        # surviving value may be stale — the kernel sentinel (inf /
+        # dtype extreme) is the empty-delta identity and never fires
+        challenge = (dv <= v) if a.op == "min" else (dv >= v)
+        dirty[i] = alive & (dcounts > 0) & challenge
+        outs.append(v.copy())
+    return tuple(outs), new_counts, dirty

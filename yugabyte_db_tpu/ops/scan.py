@@ -935,6 +935,84 @@ def combine_grouped_partials(expanded_aggs: Sequence[AggSpec],
     return outs, counts, gvals
 
 
+def _keyed_partials(part):
+    """Keyed dict view of one (agg_values, counts, group_values)
+    partial: group key tuple -> [agg scalars, count]."""
+    vals, cnts, gvals = part
+    out: Dict[tuple, list] = {}
+    if cnts is None:
+        return out
+    counts = np.asarray(cnts)
+    gv = [np.asarray(g) for g in (gvals or ())]
+    vv = [np.asarray(v) for v in vals]
+    for g in range(len(counts)):
+        if counts[g] == 0:
+            continue
+        key = tuple(x[g].item() if isinstance(x[g], np.generic)
+                    else x[g] for x in gv)
+        out[key] = [[_scalar_of(v[g]) for v in vv], int(counts[g])]
+    return out
+
+
+def retract_grouped_partials(expanded_aggs: Sequence[AggSpec],
+                             base: tuple, delta: tuple):
+    """Retraction-safe inverse of :func:`combine_grouped_partials` for
+    the incremental-matview fold (matview/): subtract a keyed grouped
+    delta (retracted rows, pre-aggregated per group) from a base
+    partial set.
+
+    SUM/COUNT retract exactly — the lanes are exact int64 per this
+    module's contract, so subtraction is the true inverse of the
+    combine's addition. MIN/MAX have no algebraic inverse: a retracted
+    value that CHALLENGES the surviving extremum (<= it for min, >= it
+    for max) is reported as a dirty slot instead of being guessed at;
+    the caller re-establishes those slots with a bounded, counted
+    per-group re-scan. Groups whose row count reaches zero are dropped
+    (their min/max slots are never dirty: there is nothing left to
+    re-establish).
+
+    ``base``/``delta``: ``(agg_values, counts, group_values)`` keyed
+    triples in combine_grouped_partials' compacted shape. Returns
+    ``(triple, dirty)`` where ``dirty`` is ``[(group_key, agg_index)]``
+    for min/max slots needing a re-scan (their surviving value is the
+    unretracted one, kept verbatim until the caller repairs it).
+    Raises ValueError when the delta retracts a group or count the
+    base never contained — that is a maintainer consistency bug, not a
+    recoverable state."""
+    merged = _keyed_partials(base)
+    dirty: List[tuple] = []
+    for key, (dvals, dcnt) in _keyed_partials(delta).items():
+        st = merged.get(key)
+        if st is None:
+            raise ValueError(
+                f"retract of unknown group {key!r}")
+        if dcnt > st[1]:
+            raise ValueError(
+                f"retract of {dcnt} rows from group {key!r} "
+                f"holding {st[1]}")
+        st[1] -= dcnt
+        if st[1] == 0:
+            del merged[key]
+            continue
+        for i, a in enumerate(expanded_aggs):
+            if a.op in ("sum", "count"):
+                st[0][i] = _scalar_of(st[0][i]) - _scalar_of(dvals[i])
+                continue
+            dv = _scalar_of(dvals[i])
+            bv = _scalar_of(st[0][i])
+            if dv is None:
+                continue             # NULL contributions never held a slot
+            if bv is None or (dv <= bv if a.op == "min" else dv >= bv):
+                dirty.append((key, i))
+    keys = list(merged)
+    outs = tuple(np.asarray([merged[k][0][i] for k in keys])
+                 for i in range(len(expanded_aggs)))
+    counts = np.asarray([merged[k][1] for k in keys], np.int64)
+    gvals = tuple(np.asarray([k[j] for k in keys])
+                  for j in range(len(keys[0]) if keys else 0))
+    return (outs, counts, gvals), dirty
+
+
 # ---------------------------------------------------------------------------
 # Zone-map block pruning (v2 SST blocks carry per-block min/max maps)
 # ---------------------------------------------------------------------------

@@ -28,11 +28,13 @@ from ..dockv.partition import PartitionSchema
 from ..ops.grouped_scan import DictGroupSpec
 from ..ops.scan import AggSpec, GroupSpec, HashGroupSpec
 from .parser import (
-    AlterTableStmt, AnalyzeStmt, CreateIndexStmt, CreateSequenceStmt,
+    AlterTableStmt, AnalyzeStmt, CreateIndexStmt, CreateMatViewStmt,
+    CreateSequenceStmt,
     CreateTableStmt, CreateTablespaceStmt, CreateViewStmt, DeleteStmt,
-    DropIndexStmt, DropSequenceStmt, DropTableStmt, DropTablespaceStmt,
-    DropViewStmt,
-    ExplainStmt, InsertStmt, SelectStmt, SetOpStmt, TruncateStmt,
+    DropIndexStmt, DropMatViewStmt, DropSequenceStmt, DropTableStmt,
+    DropTablespaceStmt, DropViewStmt,
+    ExplainStmt, InsertStmt, RefreshMatViewStmt, SelectStmt, SetOpStmt,
+    TruncateStmt,
     TxnStmt, UpdateStmt, parse_statement,
 )
 
@@ -83,6 +85,9 @@ def parse_vector(text) -> "np.ndarray":
 class SqlResult:
     rows: List[dict]
     status: str = "OK"
+    # set when the statement was served from a materialized view's
+    # maintained partials (matview/): the read's bounded staleness
+    staleness_ms: Optional[float] = None
 
     def __iter__(self):
         return iter(self.rows)
@@ -148,6 +153,22 @@ class SqlSession:
                 if not (stmt.if_exists and e.code == "NOT_FOUND"):
                     raise
             return SqlResult([], "DROP VIEW")
+        if isinstance(stmt, CreateMatViewStmt):
+            await self.client.matviews().create(self._matview_def(stmt))
+            return SqlResult([], "CREATE MATERIALIZED VIEW")
+        if isinstance(stmt, DropMatViewStmt):
+            from ..matview.errors import MatviewError
+            try:
+                await self.client.matviews().drop(stmt.name)
+            except MatviewError as e:
+                from ..matview.errors import MatviewDisabledError
+                if not stmt.if_exists \
+                        or isinstance(e, MatviewDisabledError):
+                    raise
+            return SqlResult([], "DROP MATERIALIZED VIEW")
+        if isinstance(stmt, RefreshMatViewStmt):
+            await self.client.matviews().refresh(stmt.name)
+            return SqlResult([], "REFRESH MATERIALIZED VIEW")
         if isinstance(stmt, CreateTablespaceStmt):
             await self.client.create_tablespace(
                 stmt.name,
@@ -1802,6 +1823,15 @@ class SqlSession:
         except RpcError as e:
             if e.code != "NOT_FOUND":
                 raise
+            # maybe a MATERIALIZED view: serve straight from the
+            # maintained grouped partials — no scan; the read carries
+            # its bounded staleness (matview/)
+            mvs = self.client.matviews()
+            if await mvs.lookup(stmt.table) is not None:
+                mrows, meta = await mvs.read_rows(stmt.table)
+                res = self._rows_select(stmt, mrows)
+                res.staleness_ms = meta["staleness_ms"]
+                return res
             # maybe a VIEW: materialize its body and run the outer
             # query over the rows (same machinery as a CTE table)
             view_sql = await self.client.get_view(stmt.table)
@@ -3358,6 +3388,46 @@ class SqlSession:
             for i in range(len(refs)):
                 r.pop(f"__h{i}", None)
         return kept
+
+    def _matview_def(self, stmt: CreateMatViewStmt):
+        """Structured ViewDef from a parsed CREATE MATERIALIZED VIEW —
+        the ql/matview seam: matview/ never imports the parser, so the
+        statement flattens HERE into name-based ASTs + output names,
+        and deeper (type-level) eligibility is decided by
+        matview.definition.validate against the live schema."""
+        from ..matview.definition import ViewDef
+        from ..matview.errors import (REASON_SELECT_SHAPE,
+                                      MatviewIneligible)
+        sel = stmt.select
+        for attr, what in (("joins", "JOIN"), ("order_by", "ORDER BY"),
+                           ("group_exprs", "GROUP BY expression"),
+                           ("distinct", "DISTINCT")):
+            if getattr(sel, attr, None):
+                raise MatviewIneligible(REASON_SELECT_SHAPE, what)
+        if getattr(sel, "having", None) is not None \
+                or getattr(sel, "limit", None) is not None \
+                or getattr(sel, "offset", None):
+            raise MatviewIneligible(REASON_SELECT_SHAPE,
+                                    "HAVING/LIMIT/OFFSET")
+        aggs = []
+        for i, it in enumerate(sel.items):
+            if it[0] == "col":
+                bare = self._split_qual(it[1])[1]
+                if bare not in sel.group_by:
+                    raise MatviewIneligible(
+                        REASON_SELECT_SHAPE,
+                        f"non-grouped column {it[1]}")
+            elif it[0] == "agg":
+                aggs.append((it[1], it[2], self._item_name(sel, i)))
+            else:
+                raise MatviewIneligible(
+                    REASON_SELECT_SHAPE,
+                    "only group columns and aggregates project")
+        return ViewDef(
+            name=stmt.name, table=sel.table,
+            select_sql=stmt.select_sql,
+            group_by=list(sel.group_by), aggs=aggs, where=sel.where,
+            group_out=self._group_out_map(sel))
 
     def _group_spec(self, stmt: SelectStmt, schema):
         """Pushdown group spec: dictionary ids when ANALYZE stats bound

@@ -161,6 +161,31 @@ class DropViewStmt:
 
 
 @dataclass
+class CreateMatViewStmt:
+    """CREATE MATERIALIZED VIEW name AS SELECT ... GROUP BY ... —
+    registers an incrementally-maintained grouped-partial set
+    (yugabyte_db_tpu/matview/). The body parses eagerly: the executor
+    builds the structured ViewDef from `select`, and `select_sql`
+    persists verbatim for display (pg_matviews analog)."""
+    name: str
+    select_sql: str
+    select: object
+
+
+@dataclass
+class DropMatViewStmt:
+    name: str
+    if_exists: bool = False
+
+
+@dataclass
+class RefreshMatViewStmt:
+    """REFRESH MATERIALIZED VIEW name — the full-rescan escape hatch:
+    re-pin a read point, re-seed the partials, rebind the stream."""
+    name: str
+
+
+@dataclass
 class CreateTablespaceStmt:
     name: str
     # [(zone, min_replicas)] parsed from WITH placement = 'z:n,z:n'
@@ -1804,9 +1829,38 @@ _VIEW_CREATE = re.compile(
     re.I | re.S)
 _VIEW_DROP = re.compile(
     r"\s*drop\s+view\s+(if\s+exists\s+)?(\w+)\s*;?\s*$", re.I)
+_MATVIEW_CREATE = re.compile(
+    r"\s*create\s+materialized\s+view\s+(\w+)\s+as\s+(.+?);?\s*$",
+    re.I | re.S)
+_MATVIEW_DROP = re.compile(
+    r"\s*drop\s+materialized\s+view\s+(if\s+exists\s+)?(\w+)\s*;?\s*$",
+    re.I)
+_MATVIEW_REFRESH = re.compile(
+    r"\s*refresh\s+materialized\s+view\s+(\w+)\s*;?\s*$", re.I)
+
+
+def _try_parse_matview(sql: str):
+    m = _MATVIEW_CREATE.match(sql)
+    if m:
+        body = m.group(2).strip()
+        sel = Parser(tokenize(body)).parse()     # validates the body
+        if not isinstance(sel, SelectStmt):
+            raise ValueError(
+                "CREATE MATERIALIZED VIEW body must be a SELECT")
+        return CreateMatViewStmt(m.group(1), body, sel)
+    m = _MATVIEW_DROP.match(sql)
+    if m:
+        return DropMatViewStmt(m.group(2), bool(m.group(1)))
+    m = _MATVIEW_REFRESH.match(sql)
+    if m:
+        return RefreshMatViewStmt(m.group(1))
+    return None
 
 
 def _try_parse_view(sql: str):
+    v = _try_parse_matview(sql)
+    if v is not None:
+        return v
     m = _VIEW_CREATE.match(sql)
     if m:
         body = m.group(3).strip()
@@ -1830,6 +1884,8 @@ def parse_statement(sql: str):
 def parse_script(sql: str) -> List[object]:
     """Parse a multi-statement script (reference: PG simple-query
     protocol scripts)."""
-    if _VIEW_CREATE.match(sql) or _VIEW_DROP.match(sql):
+    if _VIEW_CREATE.match(sql) or _VIEW_DROP.match(sql) \
+            or _MATVIEW_CREATE.match(sql) or _MATVIEW_DROP.match(sql) \
+            or _MATVIEW_REFRESH.match(sql):
         return [parse_statement(sql)]
     return Parser(tokenize(sql)).parse_many()

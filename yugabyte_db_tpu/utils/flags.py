@@ -477,6 +477,33 @@ DEFINE_RUNTIME("tracez_keep", 512,
                "Finished spans retained per process for rpc_tracez / "
                "rpcz dumps (bounded ring; oldest evicted).")
 
+# --- incremental materialized views (matview/; ISSUE 17) ------------------
+DEFINE_RUNTIME("matview_enabled", True,
+               "Incremental materialized aggregate views (yugabyte_db_"
+               "tpu/matview/): CREATE MATERIALIZED VIEW registers a "
+               "grouped-partial set seeded by one pinned-read-point "
+               "scan and maintained from the CDC change stream. The "
+               "flag gates only the new surface — with it off, "
+               "registration and matview reads raise a typed error "
+               "and every existing path keeps its shape.")
+DEFINE_RUNTIME("matview_rescan_budget", 8,
+               "Per-fold-round cap on MIN/MAX per-group re-scans (a "
+               "retraction that challenges the current extremum needs "
+               "one bounded group re-aggregate). Exceeding the budget "
+               "is a typed event: the maintainer falls back to one "
+               "full re-seed for the round and counts it.")
+DEFINE_RUNTIME("matview_max_staleness_ms", 500.0,
+               "Bounded-staleness read gate for matview reads: a read "
+               "observing view staleness (now - applied watermark) "
+               "beyond this bound first drives a synchronous catch-up "
+               "fold round, then serves. Every read surfaces its "
+               "staleness_ms either way.")
+DEFINE_RUNTIME("matview_poll_ms", 50,
+               "Idle poll period of a matview maintainer's fold loop "
+               "(the steady-state staleness knob: each round drains "
+               "the VirtualWal and advances the view watermark even "
+               "without new writes).")
+
 # TEST_ flags (reference: DEFINE_test_flag, util/flags/flag_tags.h:311)
 DEFINE_RUNTIME("TEST_fault_crash_fraction", 0.0,
                "Probabilistic fault injection fraction (MAYBE_FAULT analog).")
