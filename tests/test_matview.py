@@ -370,6 +370,119 @@ class TestIncrementalParity:
         run(go())
 
 
+class TestRoundAtomicity:
+    def test_mid_round_failure_loses_nothing(self, tmp_path):
+        """A transient failure mid-round (a before-image read dying on
+        a leader move) must not lose the drained txns or leave a
+        half-applied fold behind: the staged state rolls back whole,
+        the stream re-attaches from the slot's durable positions, and
+        the retry applies the same batch exactly once."""
+        async def go():
+            from yugabyte_db_tpu.rpc import RpcError
+            mc, c, sess = await _cluster(tmp_path)
+            try:
+                for i in range(12):
+                    await sess.execute(
+                        f"INSERT INTO kv VALUES ({i}, {i % 3}, {i * 10})")
+                await sess.execute(MV.format(n="mv_at", mm=""))
+                mt = await c.matviews().lookup("mv_at")
+                await mt.stop()              # drive rounds by hand
+                # a batch that needs before-image reads
+                await sess.execute("UPDATE kv SET v = 777 WHERE k = 5")
+                await sess.execute("DELETE FROM kv WHERE k = 7")
+                await sess.execute("INSERT INTO kv VALUES (90, 1, 123)")
+                pre = {k: [list(v), n] for k, (v, n) in mt.state.items()}
+                real, fired = mt._get_at, []
+
+                async def flaky(pk_row, read_ht):
+                    if not fired:
+                        fired.append(True)
+                        raise RpcError("leader moved",
+                                       "SERVICE_UNAVAILABLE")
+                    return await real(pk_row, read_ht)
+                mt._get_at = flaky
+                boom = False
+                for _ in range(400):
+                    try:
+                        await mt.round()
+                    except RpcError:
+                        boom = True
+                        break
+                    await asyncio.sleep(0.01)
+                assert boom, "the injected failure never fired"
+                # nothing half-applied, stream flagged for re-attach
+                assert {k: [list(v), n] for k, (v, n)
+                        in mt.state.items()} == pre
+                assert mt._stream_dirty
+                # retry path: catch-up replays the batch exactly once
+                rows, meta = await c.matviews().read_rows(
+                    "mv_at", max_staleness_ms=0.0)
+                ref = await _reference(c, lambda r: int(r["v"]) >= 0,
+                                       meta["watermark_ht"])
+                assert {k: v[:2] for k, v in view_keyed(rows).items()} \
+                    == {k: v[:2] for k, v in ref.items()}
+                st = c.matviews().stats("mv_at")
+                assert st["seeds"] == 1 and st["full_rescans"] == 0
+            finally:
+                await c.matviews().stop()
+                await mc.shutdown()
+        run(go())
+
+
+class TestSeedFailureCleanup:
+    def test_failed_seed_drops_fresh_slot(self, tmp_path):
+        """A seed that dies after the slot exists but before the
+        catalog entry must drop the slot (nothing else ever would —
+        it holds back WAL GC) and leave the name registrable."""
+        async def go():
+            from yugabyte_db_tpu.matview.maintainer import ViewMaintainer
+            mc, c, sess = await _cluster(tmp_path)
+            orig = ViewMaintainer._seed_scan
+            try:
+                await sess.execute("INSERT INTO kv VALUES (1, 0, 5)")
+
+                async def boom(self, read_ht):
+                    raise RuntimeError("seed scan died")
+                ViewMaintainer._seed_scan = boom
+                with pytest.raises(RuntimeError):
+                    await sess.execute(MV.format(n="mv_lk", mm=""))
+                ViewMaintainer._seed_scan = orig
+                assert await c._master_call(
+                    "list_replication_slots", {}) == {"slots": []}
+                assert await c.get_matview("mv_lk") is None
+                await sess.execute(MV.format(n="mv_lk", mm=""))
+            finally:
+                ViewMaintainer._seed_scan = orig
+                await c.matviews().stop()
+                await mc.shutdown()
+        run(go())
+
+
+class TestNamespaceSymmetry:
+    def test_table_and_view_cannot_shadow_matview(self, tmp_path):
+        """rpc_create_matview rejects names held by tables/views; the
+        reverse direction must hold too, or a later CREATE TABLE/VIEW
+        shadows the matview and makes it unreachable."""
+        async def go():
+            from yugabyte_db_tpu.rpc import RpcError
+            mc, c, sess = await _cluster(tmp_path)
+            try:
+                await sess.execute("INSERT INTO kv VALUES (1, 0, 5)")
+                await sess.execute(MV.format(n="mv_ns", mm=""))
+                with pytest.raises(RpcError) as ei:
+                    await sess.execute(
+                        "CREATE TABLE mv_ns (k bigint PRIMARY KEY)")
+                assert ei.value.code == "ALREADY_PRESENT"
+                with pytest.raises(RpcError) as ei:
+                    await sess.execute(
+                        "CREATE VIEW mv_ns AS SELECT k FROM kv")
+                assert ei.value.code == "ALREADY_PRESENT"
+            finally:
+                await c.matviews().stop()
+                await mc.shutdown()
+        run(go())
+
+
 class TestRestartResume:
     def test_attach_resumes_from_watermark(self, tmp_path):
         """Maintainer host 'crashes' (manager stops, client discarded);

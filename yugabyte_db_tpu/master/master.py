@@ -539,6 +539,11 @@ class Master:
         name = payload["name"]
         if any(t["info"]["name"] == name for t in self.tables.values()):
             raise RpcError(f"table {name} exists", "ALREADY_PRESENT")
+        if name in self.matviews:
+            # symmetric with rpc_create_matview: a table would shadow
+            # the matview in name resolution, making it unreachable
+            raise RpcError(f"{name} is a materialized view",
+                           "ALREADY_PRESENT")
         num_tablets = payload.get("num_tablets", 2)
         rf = payload.get("replication_factor", 1)
         live = self.live_tservers()
@@ -1663,6 +1668,9 @@ class Master:
             raise RpcError(f"view {name} exists", "ALREADY_PRESENT")
         if any(t["info"]["name"] == name for t in self.tables.values()):
             raise RpcError(f"{name} is a table", "ALREADY_PRESENT")
+        if name in self.matviews:
+            raise RpcError(f"{name} is a materialized view",
+                           "ALREADY_PRESENT")
         await self._commit_catalog([["put_view", name,
                                      payload["select_sql"]]])
         return {"ok": True}

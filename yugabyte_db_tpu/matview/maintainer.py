@@ -16,7 +16,10 @@ Lifecycle (the xCluster resync alignment, applied to aggregates):
    delete records carry only the PK — time travel IS the before-image
    store, bounded by the cluster's history retention like any stale
    read). Adds apply before retracts so an in-place update that raises
-   an extremum never triggers a spurious re-scan.
+   an extremum never triggers a spurious re-scan. A round is atomic:
+   draining pops txns from the VirtualWal, so a mid-round failure
+   rolls the staged fold back and re-attaches the slot at its durable
+   restart positions — the batch replays whole, never half-applies.
 3. **Repair** — retraction marks MIN/MAX slots dirty when the removed
    value challenged the survivor; those groups re-aggregate with one
    bounded per-group scan at the round's watermark (every folded txn
@@ -79,6 +82,10 @@ class ViewMaintainer:
             for op, e, _ in viewdef.aggs)
         # group key tuple -> [agg scalar list, row count]
         self.state: Dict[tuple, list] = {}
+        # set when a round failed after draining the VirtualWal: its
+        # in-memory buffers are past txns we never applied, so the next
+        # round must re-attach from the slot's durable positions first
+        self._stream_dirty = False
         self.seed_ht = 0
         self.watermark_ht = 0
         self.applied_lsn: Optional[list] = None
@@ -208,8 +215,10 @@ class ViewMaintainer:
             except asyncio.CancelledError:
                 raise
             except Exception:
-                # transient (leader moves, master failover): the next
-                # round retries from the slot's durable positions
+                # transient (leader moves, master failover): the round
+                # rolled its staged fold back and flagged the stream
+                # dirty, so the next round re-attaches the slot at its
+                # durable positions and replays the same batch
                 self.counters["loop_errors"] += 1
                 n = 0
             await asyncio.sleep(
@@ -238,23 +247,76 @@ class ViewMaintainer:
 
     async def _reseed(self) -> None:
         old = self.vw
-        self.vw = await VirtualWal.create(
+        snap = (self.state, self.seed_ht, self.watermark_ht,
+                self.applied_lsn, dict(self.counters))
+        new = await VirtualWal.create(
             self.client, [self.viewdef.table], start_from="now")
+        self.vw = new
         try:
-            if old is not None:
+            await self._seed_current_slot(first=False)
+        except BaseException:
+            try:
+                ent = await self.client.get_matview(self.viewdef.name)
+            except Exception:
+                ent = None
+            if ent is not None and ent.get("slot_id") == new.slot_id:
+                # the catalog rebound before the failure (the persist
+                # landed, confirm_flush did not): the seed is durable —
+                # keep it; the unconfirmed tail replays LSN-filtered
+                self._stream_dirty = False
+                if old is not None:
+                    try:
+                        await old.drop()
+                    except Exception:
+                        pass
+            else:
+                # the seed never reached the catalog: roll the fold
+                # state back whole and reclaim the slot nothing
+                # references (it would hold back WAL GC forever)
+                (self.state, self.seed_ht, self.watermark_ht,
+                 self.applied_lsn, self.counters) = snap
+                self.vw = old
+                try:
+                    await new.drop()
+                except Exception:
+                    pass
+            raise
+        self._stream_dirty = False
+        if old is not None:
+            try:
                 await old.drop()
+            except Exception:
+                pass                   # the catalog entry rebound already
+
+    async def _drop_unreferenced(self, vw: VirtualWal) -> None:
+        """Best-effort drop of a slot UNLESS the catalog references it
+        (then it is not a leak — the entry owns it)."""
+        try:
+            ent = await self.client.get_matview(self.viewdef.name)
+            if ent is None or ent.get("slot_id") != vw.slot_id:
+                await vw.drop()
         except Exception:
-            pass                       # the catalog entry rebinds anyway
-        await self._seed_current_slot(first=False)
+            pass
+
+    async def _recover_stream(self) -> None:
+        """Re-attach the VirtualWal at the slot's DURABLE restart
+        positions. confirm_flush holds those below every record of
+        every unconfirmed txn, so a batch a failed round drained (and
+        never confirmed) replays in full; the applied-LSN filter keeps
+        the replay exactly-once."""
+        self.vw = await VirtualWal.attach(self.client, self.vw.slot_id)
+        self._stream_dirty = False
 
     async def _round_inner(self) -> int:
+        if self._stream_dirty:
+            await self._recover_stream()
         t0 = time.perf_counter()
         recs = await self.vw.get_consistent_changes()
         self.stage_s["stream"] += time.perf_counter() - t0
         wm = self.vw._watermark()
-        if wm > 0:
-            self.watermark_ht = max(self.watermark_ht, wm)
         if not recs:
+            if wm > 0:
+                self.watermark_ht = max(self.watermark_ht, wm)
             return 0
         txns: List[dict] = []
         cur: Optional[dict] = None
@@ -267,23 +329,46 @@ class ViewMaintainer:
                 cur = None
             else:
                 cur["ops"].append(r)
-        dirty_keys: set = set()
+        # Stage the fold: get_consistent_changes POPPED these txns from
+        # the VirtualWal's buffers, so an in-process retry after a
+        # mid-round failure (leader move during a before-image read, a
+        # rescan RPC dying) would silently lose them. The batch applies
+        # whole — state, counters, watermark and applied LSN move
+        # together — or not at all: on failure the snapshot restores
+        # and the stream is flagged for re-attach from the slot's
+        # durable restart positions, which re-deliver the entire batch.
+        snap_state = {k: [list(vals), cnt]
+                      for k, (vals, cnt) in self.state.items()}
+        snap_counters = dict(self.counters)
         last_lsn = None
-        t0 = time.perf_counter()
-        for t in txns:
-            last_lsn = t["lsn"]
-            if t["ht"] <= self.seed_ht:
-                continue               # already inside the seed scan
-            if self.applied_lsn is not None \
-                    and _lsn_le(t["lsn"], self.applied_lsn):
-                continue               # replay of an applied txn
-            dirty_keys |= await self._apply_txn(t)
-            self.counters["txns_applied"] += 1
-        self.stage_s["fold"] += time.perf_counter() - t0
-        if dirty_keys:
+        try:
+            dirty_keys: set = set()
             t0 = time.perf_counter()
-            await self._rescan_groups(dirty_keys, max(wm, self.seed_ht))
-            self.stage_s["rescan"] += time.perf_counter() - t0
+            for t in txns:
+                last_lsn = t["lsn"]
+                if t["ht"] <= self.seed_ht:
+                    continue           # already inside the seed scan
+                if self.applied_lsn is not None \
+                        and _lsn_le(t["lsn"], self.applied_lsn):
+                    continue           # replay of an applied txn
+                dirty_keys |= await self._apply_txn(t)
+                self.counters["txns_applied"] += 1
+            self.stage_s["fold"] += time.perf_counter() - t0
+            if dirty_keys:
+                t0 = time.perf_counter()
+                await self._rescan_groups(dirty_keys,
+                                          max(wm, self.seed_ht))
+                self.stage_s["rescan"] += time.perf_counter() - t0
+        except BaseException:
+            self.state = snap_state
+            self.counters = snap_counters
+            self._stream_dirty = True
+            # the typed fallbacks in round() re-seed on top of this;
+            # the rollback matters there too — a re-seed that itself
+            # fails mid-flight must leave a consistent view behind
+            raise
+        if wm > 0:
+            self.watermark_ht = max(self.watermark_ht, wm)
         if last_lsn is not None:
             self.applied_lsn = last_lsn
             t0 = time.perf_counter()
@@ -437,6 +522,10 @@ class ViewMaintainer:
         return out
 
     def staleness_ms(self) -> float:
+        """Wall-clock lag of the applied watermark, CLIENT-clock
+        relative: this host's clock minus the physical component of
+        the tserver-assigned watermark, so client/tserver skew shifts
+        the number one-for-one (see matview_max_staleness_ms)."""
         if self.watermark_ht <= 0:
             return float("inf")
         return max(0.0, (_now_micros()

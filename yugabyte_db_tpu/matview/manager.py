@@ -43,7 +43,15 @@ class MatviewManager:
         ct = await self.client._table(viewdef.table)
         validate(viewdef, ct.info.schema)
         mt = ViewMaintainer(self.client, viewdef, ct.info.schema)
-        await mt.seed()
+        try:
+            await mt.seed()
+        except BaseException:
+            # a slot whose seed never reached the catalog has no
+            # referent left to drop it — it would hold back WAL GC on
+            # the table's tablets forever; reclaim it before surfacing
+            if mt.vw is not None:
+                await mt._drop_unreferenced(mt.vw)
+            raise
         self._views[viewdef.name] = mt
         if start:
             mt.start()

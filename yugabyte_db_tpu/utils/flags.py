@@ -497,7 +497,13 @@ DEFINE_RUNTIME("matview_max_staleness_ms", 500.0,
                "observing view staleness (now - applied watermark) "
                "beyond this bound first drives a synchronous catch-up "
                "fold round, then serves. Every read surfaces its "
-               "staleness_ms either way.")
+               "staleness_ms either way. Staleness compares the "
+               "CLIENT's wall clock against the physical component of "
+               "the tserver-assigned watermark, so client/tserver "
+               "clock skew shifts it one-for-one: skew past the bound "
+               "forces a catch-up on every read, negative skew masks "
+               "real staleness. Size the bound well above the "
+               "deployment's expected clock skew.")
 DEFINE_RUNTIME("matview_poll_ms", 50,
                "Idle poll period of a matview maintainer's fold loop "
                "(the steady-state staleness knob: each round drains "
