@@ -1788,6 +1788,401 @@ class TestWritePathHotPath:
                        for p, _, _ in _findings(r)), r["findings"]
 
 
+def _run_pass(tmp_path, files, pass_obj):
+    """Like _run but with a pass INSTANCE — the registry-driven passes
+    (cache_key_completeness, wire_drift) take synthetic registries."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    index = ProjectIndex(str(tmp_path), roots=("pkg",))
+    return run_analysis(index, [pass_obj])
+
+
+class TestRefusalFlow:
+    ERRORS = """\
+        class ScanIneligible(Exception):
+            pass
+        """
+
+    def test_true_positive_transitive(self, tmp_path):
+        # the raise and the broad except are two calls apart — the
+        # laundering shape no lexical check can see
+        r = _run(tmp_path, {
+            "pkg/errors.py": self.ERRORS,
+            "pkg/fast.py": """\
+                from pkg.errors import ScanIneligible
+                def fast_path(x):
+                    if x < 0:
+                        raise ScanIneligible("neg")
+                    return x
+                def mid(x):
+                    return fast_path(x)
+                def caller(x):
+                    try:
+                        return mid(x)
+                    except Exception:
+                        return None
+                """}, "refusal_flow")
+        assert _findings(r) == [("pkg/fast.py", 11, "ScanIneligible")]
+        # witness: the call that lets the refusal into this def
+        assert "mid()" in r["findings"][0]["message"]
+
+    def test_typed_catch_before_broad_is_clean(self, tmp_path):
+        r = _run(tmp_path, {
+            "pkg/errors.py": self.ERRORS,
+            "pkg/fast.py": """\
+                from pkg.errors import ScanIneligible
+                def fast_path(x):
+                    raise ScanIneligible("no")
+                def caller(x):
+                    try:
+                        return fast_path(x)
+                    except ScanIneligible:
+                        return None          # routed to fallback
+                    except Exception:
+                        return -1            # real bugs only
+                """}, "refusal_flow")
+        assert r["findings"] == []
+
+    def test_reraise_and_isinstance_route_are_clean(self, tmp_path):
+        r = _run(tmp_path, {
+            "pkg/errors.py": self.ERRORS,
+            "pkg/fast.py": """\
+                from pkg.errors import ScanIneligible
+                def fast_path(x):
+                    raise ScanIneligible("no")
+                def translating(x):
+                    try:
+                        return fast_path(x)
+                    except Exception:
+                        raise RuntimeError("ctx")   # not swallowed
+                def routing(x):
+                    try:
+                        return fast_path(x)
+                    except Exception as e:
+                        if isinstance(e, ScanIneligible):
+                            return None
+                        return -1
+                """}, "refusal_flow")
+        assert r["findings"] == []
+
+    def test_marker_class_caught_via_ancestor(self, tmp_path):
+        # marker-declared refusal outside an errors module; catching
+        # its stdlib ancestor (ValueError) is a typed catch
+        r = _run(tmp_path, {"pkg/keys.py": """\
+            # analysis: refusal-class
+            class KeyRefusal(ValueError):
+                pass
+            def parse(k):
+                raise KeyRefusal(k)
+            def ok(k):
+                try:
+                    return parse(k)
+                except ValueError:
+                    return None
+            def bad(k):
+                try:
+                    return parse(k)
+                except Exception:
+                    return None
+            """}, "refusal_flow")
+        assert _findings(r) == [("pkg/keys.py", 14, "KeyRefusal")]
+
+    def test_suppressed_with_reason(self, tmp_path):
+        r = _run(tmp_path, {
+            "pkg/errors.py": self.ERRORS,
+            "pkg/fast.py": """\
+                from pkg.errors import ScanIneligible
+                def fast_path(x):
+                    raise ScanIneligible("no")
+                def boundary(x):
+                    try:
+                        return fast_path(x)
+                    # analysis-ok(refusal_flow): protocol boundary
+                    except Exception:
+                        return None
+                """}, "refusal_flow")
+        assert r["findings"] == []
+        assert r["suppressions"]["refusal_flow"] == 1
+
+    def test_task_cancel_true_positive(self, tmp_path):
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import asyncio
+            async def shutdown(job):
+                task = asyncio.create_task(job())
+                task.cancel()
+            """}, "refusal_flow")
+        assert _findings(r) == [("pkg/a.py", 4, "task.cancel")]
+        assert "bpo-37658" in r["findings"][0]["message"]
+
+    def test_task_cancel_drain_loop_and_non_task_clean(self, tmp_path):
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import asyncio
+            async def shutdown(job, timer):
+                t = asyncio.create_task(job())
+                while not t.done():
+                    t.cancel()            # the bpo-37658 drain shape
+                    try:
+                        await t
+                    except asyncio.CancelledError:
+                        pass
+                timer.cancel()            # not a task: fine
+            def sync_stop(task):
+                task.cancel()             # sync def: out of scope
+            """}, "refusal_flow")
+        assert r["findings"] == []
+
+
+class TestCacheKeyCompleteness:
+    CACHE_MOD = """\
+        flags = {}
+        def _compute(x):
+            mode = flags.get("exact_mode")
+            return (x, mode)
+        class Engine:
+            def __init__(self):
+                self._cache = {}
+            def run(self, x):
+                key = ("k", x)
+                if key not in self._cache:
+                    self._cache[key] = _compute(x)
+                return self._cache[key]
+        """
+
+    @staticmethod
+    def _entry(**over):
+        ent = {"key_builder": ("pkg/cachemod.py", "Engine.run"),
+               "roots": [("pkg/cachemod.py", "Engine.run")],
+               "key_helpers": [], "allow": {}, "must_mention": []}
+        ent.update(over)
+        return ent
+
+    def _pass(self, **over):
+        from analyze.passes.cache_key_completeness import (
+            CacheKeyCompletenessPass)
+        return CacheKeyCompletenessPass([self._entry(**over)])
+
+    def test_flag_read_missing_from_key(self, tmp_path):
+        # the PR-9 shape: the keyed computation reads a flag the key
+        # never includes, one call below the key constructor
+        r = _run_pass(tmp_path, {"pkg/cachemod.py": self.CACHE_MOD},
+                      self._pass())
+        assert _findings(r) == [("pkg/cachemod.py", 8,
+                                 "Engine.run:exact_mode")]
+        assert "_compute" in r["findings"][0]["message"]  # witness chain
+
+    def test_flag_in_key_literal_is_clean(self, tmp_path):
+        fixed = self.CACHE_MOD.replace(
+            'key = ("k", x)',
+            'key = ("k", x, flags.get("exact_mode"))')
+        r = _run_pass(tmp_path, {"pkg/cachemod.py": fixed}, self._pass())
+        assert r["findings"] == []
+
+    def test_allow_reason_is_clean(self, tmp_path):
+        r = _run_pass(
+            tmp_path, {"pkg/cachemod.py": self.CACHE_MOD},
+            self._pass(allow={"exact_mode": "rebuilt outside the "
+                                            "cached lambda"}))
+        assert r["findings"] == []
+
+    def test_must_mention_lost_component(self, tmp_path):
+        r = _run_pass(
+            tmp_path, {"pkg/cachemod.py": self.CACHE_MOD},
+            self._pass(allow={"exact_mode": "n/a"},
+                       must_mention=[("prune_sig", "pruned identity")]))
+        assert _findings(r) == [("pkg/cachemod.py", 8,
+                                 "Engine.run:prune_sig")]
+
+    def test_stale_registry_entry(self, tmp_path):
+        r = _run_pass(
+            tmp_path, {"pkg/cachemod.py": self.CACHE_MOD},
+            self._pass(key_builder=("pkg/cachemod.py", "Engine.gone")))
+        assert [d for _, _, d in _findings(r)] == [
+            "pkg/cachemod.py::Engine.gone"]
+
+    def test_real_registry_pins_known_constructors(self):
+        # the registry is the contract: the known keyed caches stay
+        # registered, and the PR-9 regression input stays pinned
+        from analyze.passes.cache_key_completeness import REGISTRY
+        quals = {e["key_builder"][1] for e in REGISTRY}
+        assert {"DocReadOperation._batch_cache_key", "ScanKernel.run",
+                "FusedPlanKernel.run"} <= quals
+        batch = next(e for e in REGISTRY if e["key_builder"][1]
+                     == "DocReadOperation._batch_cache_key")
+        assert "device_float_dtype" in dict(batch["must_mention"])
+
+
+class TestWireDrift:
+    @staticmethod
+    def _entry(**over):
+        ent = {"dataclass": ("pkg/msg.py", "Ping"),
+               "encode": ("pkg/msg.py", "ping_to_wire"),
+               "decode": ("pkg/msg.py", "ping_from_wire"),
+               "ignore": {}, "combined": {}}
+        ent.update(over)
+        return ent
+
+    def _pass(self, **over):
+        from analyze.passes.wire_drift import WireDriftPass
+        return WireDriftPass([self._entry(**over)])
+
+    def test_field_dropped_by_both_codecs(self, tmp_path):
+        r = _run_pass(tmp_path, {"pkg/msg.py": """\
+            from dataclasses import dataclass
+            @dataclass
+            class Ping:
+                a: int
+                b: int
+                c: int = 0
+            def ping_to_wire(p):
+                return {"a": p.a, "b": p.b}
+            def ping_from_wire(d):
+                return Ping(a=d["a"], b=d["b"])
+            """}, self._pass())
+        assert sorted(d for _, _, d in _findings(r)) == [
+            "Ping.c:decode", "Ping.c:encode"]
+
+    def test_round_trip_and_positional_cover_clean(self, tmp_path):
+        r = _run_pass(tmp_path, {"pkg/msg.py": """\
+            from dataclasses import dataclass
+            @dataclass
+            class Ping:
+                a: int
+                b: int
+                c: int = 0
+            def ping_to_wire(p):
+                return (p.a, p.b, p.c)
+            def ping_from_wire(w):
+                first, second, third = w
+                return Ping(first, second, third)
+            """}, self._pass())
+        assert r["findings"] == []
+
+    def test_ignore_reason_is_clean(self, tmp_path):
+        r = _run_pass(tmp_path, {"pkg/msg.py": """\
+            from dataclasses import dataclass
+            @dataclass
+            class Ping:
+                a: int
+                c: int = 0
+            def ping_to_wire(p):
+                return {"a": p.a}
+            def ping_from_wire(d):
+                return Ping(a=d["a"])
+            """}, self._pass(ignore={"c": "server-local"}))
+        assert r["findings"] == []
+
+    def test_combiner_drops_partial_field(self, tmp_path):
+        files = {"pkg/msg.py": """\
+            from dataclasses import dataclass
+            @dataclass
+            class Ping:
+                a: int
+            def ping_to_wire(p):
+                return {"a": p.a}
+            def ping_from_wire(d):
+                return Ping(a=d["a"])
+            def combine(parts):
+                return sum(p.b for p in parts)
+            """}
+        combined = {"a": [("pkg/msg.py", "combine")]}
+        r = _run_pass(tmp_path, files, self._pass(combined=combined))
+        assert _findings(r) == [("pkg/msg.py", 9, "Ping.a:combine")]
+
+    def test_stale_registry_entry(self, tmp_path):
+        r = _run_pass(tmp_path, {"pkg/msg.py": "x = 1\n"}, self._pass())
+        assert [d for _, _, d in _findings(r)] == ["pkg/msg.py::Ping"]
+
+    def test_real_registry_pins_known_wire_types(self):
+        from analyze.passes.wire_drift import REGISTRY
+        names = {e["dataclass"][1] for e in REGISTRY}
+        assert {"ReadRequest", "ReadResponse", "WriteRequest", "RowOp",
+                "ViewDef"} <= names
+        req = next(e for e in REGISTRY
+                   if e["dataclass"][1] == "ReadRequest")
+        # server-assigned read point must never cross the wire
+        assert "server_assigned_read_ht" in req["ignore"]
+        resp = next(e for e in REGISTRY
+                    if e["dataclass"][1] == "ReadResponse")
+        assert set(resp["combined"]) >= {"agg_values", "group_counts",
+                                         "group_values"}
+
+
+class TestNumericExactness:
+    def test_narrow_sum_true_positive(self, tmp_path):
+        r = _run(tmp_path, {"pkg/k.py": """\
+            import jax.numpy as jnp
+            def f(col):
+                x = col.astype(jnp.int32)
+                return jnp.sum(x)
+            """}, "numeric_exactness")
+        assert _findings(r) == [("pkg/k.py", 4, "sum-dtype")]
+
+    def test_float_accumulator_true_positive(self, tmp_path):
+        r = _run(tmp_path, {"pkg/k.py": """\
+            import jax.numpy as jnp
+            def g(mask):
+                m = mask.astype(jnp.int32)
+                fm = m.astype(jnp.float32)
+                return jnp.sum(fm)
+            """}, "numeric_exactness")
+        assert _findings(r) == [("pkg/k.py", 5, "float-accumulator")]
+
+    def test_exact_accumulators_clean(self, tmp_path):
+        r = _run(tmp_path, {"pkg/k.py": """\
+            import jax.numpy as jnp
+            def f(col):
+                x = col.astype(jnp.int32)
+                a = jnp.sum(x, dtype=jnp.int64)   # explicit widen
+                y = col.astype(jnp.int64)
+                b = jnp.sum(y)                    # already wide
+                return a + b
+            """}, "numeric_exactness")
+        assert r["findings"] == []
+
+    def test_zone_envelope_rule(self, tmp_path):
+        r = _run(tmp_path, {
+            "pkg/consumer.py": """\
+                def prune(block, lo):
+                    return block.zmap[0] >= lo
+                """,
+            "pkg/ops/scan.py": """\
+                def _f32_widen(block):
+                    return block.zmap          # envelope impl: allowed
+                """}, "numeric_exactness")
+        assert _findings(r) == [("pkg/consumer.py", 2, "zone-envelope")]
+
+    def test_consts_offset_regression(self, tmp_path):
+        # the PR-12 shape: second compile_expr in the same def without
+        # offset= re-reads the first expression's constant table
+        r = _run(tmp_path, {"pkg/p.py": """\
+            from pkg.expr import compile_expr
+            def plan(e1, e2):
+                a = compile_expr(e1)
+                b = compile_expr(e2)
+                return a, b
+            def fixed(e1, e2):
+                a, n = compile_expr(e1)
+                b, _ = compile_expr(e2, offset=n)
+                return a, b
+            """, "pkg/expr.py": "def compile_expr(e, offset=0):\n"
+                                "    return e, offset\n"},
+            "numeric_exactness")
+        assert _findings(r) == [("pkg/p.py", 4, "consts-offset")]
+
+    def test_suppressed_with_reason(self, tmp_path):
+        r = _run(tmp_path, {"pkg/k.py": """\
+            import jax.numpy as jnp
+            def f(col):
+                x = col.astype(jnp.int32)
+                # analysis-ok(numeric_exactness): block-local partial
+                return jnp.sum(x)
+            """}, "numeric_exactness")
+        assert r["findings"] == []
+        assert r["suppressions"]["numeric_exactness"] == 1
+
+
 # --- 2 + 3. whole tree, schema, budget, baseline ---------------------------
 
 @pytest.fixture(scope="module")
@@ -1810,7 +2205,8 @@ def test_all_passes_ran(tree_report):
         "async_blocking", "lock_held_await", "jit_hazards",
         "flag_drift", "shared_state_races", "unawaited_coroutine",
         "format_gate", "layering", "lock_order", "resource_balance",
-        "trace_discipline"]
+        "trace_discipline", "refusal_flow", "cache_key_completeness",
+        "wire_drift", "numeric_exactness"]
 
 
 def test_wall_time_budget(tree_report):
@@ -1904,3 +2300,34 @@ def test_run_py_exits_nonzero_on_findings(tmp_path):
         capture_output=True, text=True)
     assert r.returncode == 1, r.stdout
     assert "time.sleep" in r.stdout
+
+
+def test_run_py_sarif_contract(tmp_path):
+    """--sarif writes a one-run SARIF 2.1.0 log: pass ids as rule ids,
+    findings as level=error results anchored at path:line."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "bad.py").write_text(
+        "import time\nasync def h():\n    time.sleep(1)\n")
+    out = tmp_path / "r.sarif"
+    r = subprocess.run(
+        [sys.executable, os.path.join(HERE, "tools", "analyze", "run.py"),
+         "--base", str(tmp_path), "--pass", "async_blocking",
+         "--sarif", str(out), "pkg"],
+        capture_output=True, text=True)
+    assert r.returncode == 1, r.stdout + r.stderr   # exit unchanged
+    log = json.loads(out.read_text())
+    assert log["version"] == "2.1.0"
+    run0 = log["runs"][0]
+    rules = run0["tool"]["driver"]["rules"]
+    assert [rl["id"] for rl in rules] == ["async_blocking"]
+    assert rules[0]["help"]["text"]          # the pass hint
+    results = run0["results"]
+    assert len(results) == 1
+    res = results[0]
+    assert res["ruleId"] == "async_blocking"
+    assert rules[res["ruleIndex"]]["id"] == res["ruleId"]
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "pkg/bad.py"
+    assert loc["region"]["startLine"] == 3
+    assert "time.sleep" in res["message"]["text"]

@@ -592,3 +592,34 @@ class TestFlagGate:
                 await c.matviews().stop()
                 await mc.shutdown()
         run(go())
+
+
+class TestLoopRefusalAccounting:
+    def test_typed_refusals_counted_apart_from_errors(self):
+        """Regression: the maintainer loop used to count typed
+        MatviewError refusals (no CDC watermark while leaders move,
+        catch-up stalls) as loop_errors — a wedged stream looked like
+        a bug storm.  The typed arm tallies them as loop_refusals
+        with the reason surfaced."""
+        from yugabyte_db_tpu.matview import maintainer as M
+
+        async def go():
+            vm = M.ViewMaintainer.__new__(M.ViewMaintainer)
+            vm.counters = M._fresh_counters()
+            calls = {"n": 0}
+
+            async def fake_round():
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    raise M.MatviewError("no_watermark")
+                if calls["n"] == 2:
+                    raise RuntimeError("boom")
+                raise asyncio.CancelledError
+
+            vm.round = fake_round
+            with pytest.raises(asyncio.CancelledError):
+                await vm._loop()
+            assert vm.counters["loop_refusals"] == 1
+            assert vm.counters["loop_errors"] == 1
+            assert vm.counters["last_fallback_reason"] == "no_watermark"
+        run(go())

@@ -122,6 +122,39 @@ class TestPallasRoutedPath:
             av, bv = np.asarray(a, np.float64), np.asarray(b, np.float64)
             assert np.allclose(av, bv, rtol=2e-4), (av, bv)
 
+    def test_refusals_are_typed_and_tallied(self):
+        # regression for the dead-PallasIneligible laundering: the
+        # eligibility gate used to silently `return None`, so a
+        # refused shape was indistinguishable from a bug.  Now every
+        # decline raises PallasIneligible(reason) and the dispatcher
+        # tallies it per reason.
+        from yugabyte_db_tpu.ops import Expr
+        from yugabyte_db_tpu.ops.pallas_scan import PallasIneligible
+        from yugabyte_db_tpu.ops.scan import AggSpec, ScanKernel
+        from yugabyte_db_tpu.utils import flags
+        C = Expr.col
+        batch = self._batch()
+        k = ScanKernel()
+        with pytest.raises(PallasIneligible, match="mvcc_or_no_aggs"):
+            k._pallas_eligible(batch, None, (), None, "snapshot", ())
+        with pytest.raises(PallasIneligible, match="agg_op"):
+            k._pallas_eligible(batch, None, (AggSpec("avg", C(0).node),),
+                               None, "none", ())
+        import jax.numpy as jnp
+        batch.cols[5] = jnp.asarray(
+            np.arange(batch.padded_rows, dtype=np.int64))
+        batch.nulls[5] = jnp.zeros(batch.padded_rows, bool)
+        flags.set_flag("tpu_pallas_scan", True)
+        try:
+            out, cnt, mask = k.run(batch, (C(5) >= 10).node,
+                                   (AggSpec("count"),))
+            assert mask is not None          # served by XLA fallback
+            assert k.pallas_refusals == {"column_dtype": 1}
+            k.run(batch, (C(5) >= 10).node, (AggSpec("count"),))
+            assert k.pallas_refusals == {"column_dtype": 2}
+        finally:
+            flags.set_flag("tpu_pallas_scan", False)
+
     def test_int64_columns_fall_back_to_xla(self):
         import jax.numpy as jnp
         from yugabyte_db_tpu.ops import Expr

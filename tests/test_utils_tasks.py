@@ -1,0 +1,116 @@
+"""utils/tasks.py — the shared bpo-37658 cancel-until-done drain.
+
+One ``task.cancel()`` is a request, not a guarantee: a completion
+racing the cancel inside ``asyncio.wait_for`` can swallow the
+CancelledError and leave the task running after shutdown returned.
+``cancel_and_drain`` re-cancels until the task is genuinely done;
+these tests pin that contract (including the hostile
+swallow-one-cancellation shape) so every converted shutdown site
+rests on tested machinery.  The static side of the same contract —
+new bare ``.cancel()`` sites are flagged — lives in
+``test_analysis.py::TestRefusalFlow``.
+"""
+import asyncio
+
+import pytest
+
+from yugabyte_db_tpu.utils.tasks import cancel_and_drain, drain_all
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+class TestCancelAndDrain:
+    def test_cancels_a_running_task(self):
+        async def go():
+            async def forever():
+                while True:
+                    await asyncio.sleep(3600)
+            t = asyncio.get_running_loop().create_task(forever())
+            await asyncio.sleep(0)
+            got = await cancel_and_drain(t)
+            assert got is t and t.done() and t.cancelled()
+        _run(go())
+
+    def test_survives_swallowed_cancellation(self):
+        # the bpo-37658 shape: the task eats the FIRST CancelledError
+        # (a racing completion inside wait_for does exactly this) —
+        # the drain must re-cancel rather than hang or return early
+        async def go():
+            swallowed = 0
+
+            async def stubborn():
+                nonlocal swallowed
+                while True:
+                    try:
+                        await asyncio.sleep(3600)
+                    except asyncio.CancelledError:
+                        if swallowed == 0:
+                            swallowed += 1
+                            continue          # swallow the first one
+                        raise
+            t = asyncio.get_running_loop().create_task(stubborn())
+            await asyncio.sleep(0)
+            await cancel_and_drain(t, wait_timeout=0.01)
+            assert t.done() and swallowed == 1
+        _run(go())
+
+    def test_none_and_finished_are_noops(self):
+        async def go():
+            assert await cancel_and_drain(None) is None
+
+            async def quick():
+                return 7
+            t = asyncio.get_running_loop().create_task(quick())
+            await t
+            got = await cancel_and_drain(t)
+            assert got.result() == 7      # result intact, not cancelled
+        _run(go())
+
+    def test_failed_task_exception_is_retrieved(self):
+        # no "Task exception was never retrieved" warning at GC
+        async def go():
+            async def boom():
+                raise RuntimeError("x")
+            t = asyncio.get_running_loop().create_task(boom())
+            await asyncio.sleep(0)
+            await cancel_and_drain(t)
+            assert t.done() and not t.cancelled()
+            assert isinstance(t.exception(), RuntimeError)
+        _run(go())
+
+
+class TestDrainAll:
+    def test_drains_everything_including_nones(self):
+        async def go():
+            async def forever():
+                while True:
+                    await asyncio.sleep(3600)
+            loop = asyncio.get_running_loop()
+            tasks = [loop.create_task(forever()) for _ in range(3)]
+            await asyncio.sleep(0)
+            await drain_all(tasks + [None])
+            assert all(t.done() for t in tasks)
+        _run(go())
+
+
+@pytest.mark.parametrize("site", [
+    "yugabyte_db_tpu/matview/maintainer.py",
+    "yugabyte_db_tpu/master/master.py",
+    "yugabyte_db_tpu/tserver/tablet_server.py",
+    "yugabyte_db_tpu/consensus/raft.py",
+    "yugabyte_db_tpu/sched/scheduler.py",
+    "yugabyte_db_tpu/cluster/supervisor.py",
+    "yugabyte_db_tpu/cdc/consumer.py",
+    "yugabyte_db_tpu/client/client.py",
+])
+def test_converted_sites_use_the_helper(site):
+    """The shutdown paths converted off bare .cancel() stay on the
+    shared drain (the analyzer flags NEW bare sites; this pins the
+    existing conversions by name)."""
+    import os
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(here, site)) as f:
+        src = f.read()
+    assert "cancel_and_drain" in src or "drain_all" in src, site

@@ -8,10 +8,11 @@ Adding a pass (see ANALYSIS.md):
 4. run ``python tools/analyze/run.py`` and fix or annotate what it
    finds — the whole-tree tier-1 sweep must stay at zero.
 """
-from . import (async_blocking, flag_drift, format_gate, jit_hazards,
-               layering, lock_held_await, lock_order,
+from . import (async_blocking, cache_key_completeness, flag_drift,
+               format_gate, jit_hazards, layering, lock_held_await,
+               lock_order, numeric_exactness, refusal_flow,
                resource_balance, shared_state_races,
-               trace_discipline, unawaited_coroutine)
+               trace_discipline, unawaited_coroutine, wire_drift)
 
 ALL_PASSES = (
     async_blocking.PASS,
@@ -25,6 +26,10 @@ ALL_PASSES = (
     lock_order.PASS,
     resource_balance.PASS,
     trace_discipline.PASS,
+    refusal_flow.PASS,
+    cache_key_completeness.PASS,
+    wire_drift.PASS,
+    numeric_exactness.PASS,
 )
 
 _BY_ID = {p.id: p for p in ALL_PASSES}

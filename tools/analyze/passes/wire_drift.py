@@ -1,0 +1,280 @@
+"""Pass: wire/codec drift — every field of a wire dataclass must
+round-trip through its codec pair (and partial-result fields must be
+combined).
+
+The RPC layer ships dataclasses as msgpack dicts through hand-written
+``*_to_wire`` / ``*_from_wire`` codec pairs.  Adding a field to the
+dataclass without teaching BOTH codecs silently drops it after one
+network hop — the request works in-process and in every single-node
+test, then loses the field the first time it crosses the wire (the
+shape of the ARRAY-const regression ``_expr_from_wire``'s docstring
+documents).  The dataclass and its codecs live in different modules,
+so only a cross-module check can keep them joined.
+
+How it works, per REGISTRY entry (one per wire dataclass):
+
+1. FIELDS — annotated assignments in the dataclass body (dataclass
+   fields), from the class AST.
+2. ENCODE — the encoder must read every field: an attribute access
+   ``.field`` or the string literal ``"field"`` anywhere in the
+   encoder's AST.  Missing -> finding at the encoder.
+3. DECODE — the decoder must restore every field: a ``field=`` kwarg
+   on a constructor call, positional constructor coverage (first N
+   params), or the string literal.  Missing -> finding at the decoder.
+4. IGNORE — fields that deliberately do NOT cross the wire carry a
+   registry reason (e.g. ``server_assigned_read_ht`` is assigned by
+   the SERVER after decode; serializing it would let a client forge a
+   server-assigned read point).
+5. COMBINED — partial-result fields (``agg_values``,
+   ``group_counts``, ...) must ALSO appear in every registered
+   combiner: a field that round-trips but is dropped when per-tablet
+   partials merge is the same user-visible loss one hop later.
+
+The registry is explicit on purpose — a new wire dataclass means a new
+entry (tests pin the known ones).  Suppress at the reported line:
+``# analysis-ok(wire_drift): <reason>``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..core import AnalysisPass, Finding, ProjectIndex
+
+_DOCDB = "yugabyte_db_tpu/docdb"
+_OPS = "yugabyte_db_tpu/ops"
+_MV = "yugabyte_db_tpu/matview"
+
+REGISTRY: Tuple[dict, ...] = (
+    {
+        "dataclass": (f"{_DOCDB}/operations.py", "ReadRequest"),
+        "encode": (f"{_DOCDB}/wire.py", "read_request_to_wire"),
+        "decode": (f"{_DOCDB}/wire.py", "read_request_from_wire"),
+        "ignore": {
+            "server_assigned_read_ht":
+                "server-local: set by the serving tablet AFTER decode "
+                "and consumed in-process; shipping it would let a "
+                "client forge a server-assigned (restartable) read "
+                "point",
+        },
+        "combined": {},
+    },
+    {
+        "dataclass": (f"{_DOCDB}/operations.py", "ReadResponse"),
+        "encode": (f"{_DOCDB}/wire.py", "read_response_to_wire"),
+        "decode": (f"{_DOCDB}/wire.py", "read_response_from_wire"),
+        "ignore": {},
+        # the client fan-out combine is the one place ReadResponse
+        # partials are unpacked by FIELD NAME into the shared
+        # combiners (scan.combine_*_partials take positional tuples)
+        "combined": {
+            "agg_values": [("yugabyte_db_tpu/client/client.py",
+                            "YBClient._combine")],
+            "group_counts": [("yugabyte_db_tpu/client/client.py",
+                              "YBClient._combine")],
+            "group_values": [("yugabyte_db_tpu/client/client.py",
+                              "YBClient._combine")],
+        },
+    },
+    {
+        "dataclass": (f"{_DOCDB}/operations.py", "WriteRequest"),
+        "encode": (f"{_DOCDB}/wire.py", "write_request_to_wire"),
+        "decode": (f"{_DOCDB}/wire.py", "write_request_from_wire"),
+        "ignore": {},
+        "combined": {},
+    },
+    {
+        "dataclass": (f"{_DOCDB}/operations.py", "RowOp"),
+        "encode": (f"{_DOCDB}/wire.py", "write_request_to_wire"),
+        "decode": (f"{_DOCDB}/wire.py", "write_request_from_wire"),
+        "ignore": {},
+        "combined": {},
+    },
+    {
+        "dataclass": (f"{_OPS}/join_scan.py", "JoinWire"),
+        "encode": (f"{_DOCDB}/wire.py", "_join_to_wire"),
+        "decode": (f"{_DOCDB}/wire.py", "_join_from_wire"),
+        "ignore": {},
+        "combined": {},
+    },
+    {
+        "dataclass": (f"{_OPS}/scan.py", "HashGroupSpec"),
+        "encode": (f"{_DOCDB}/wire.py", "read_request_to_wire"),
+        "decode": (f"{_DOCDB}/wire.py", "read_request_from_wire"),
+        "ignore": {},
+        "combined": {},
+    },
+    {
+        "dataclass": (f"{_OPS}/grouped_scan.py", "DictGroupSpec"),
+        "encode": (f"{_DOCDB}/wire.py", "read_request_to_wire"),
+        "decode": (f"{_DOCDB}/wire.py", "read_request_from_wire"),
+        "ignore": {},
+        "combined": {},
+    },
+    {
+        "dataclass": (f"{_MV}/definition.py", "ViewDef"),
+        "encode": (f"{_MV}/definition.py", "ViewDef.to_wire"),
+        "decode": (f"{_MV}/definition.py", "viewdef_from_wire"),
+        "ignore": {},
+        "combined": {},
+    },
+)
+
+
+class WireDriftPass(AnalysisPass):
+    id = "wire_drift"
+    title = "wire dataclass field not round-tripped by its codec pair"
+    hint = ("serialize the field in *_to_wire AND restore it in "
+            "*_from_wire (and add it to the partial combiners if it "
+            "carries results); if it deliberately stays server-local, "
+            "record an ignore reason in the wire_drift registry")
+
+    def __init__(self, registry: Optional[Sequence[dict]] = None):
+        #: overridable so fixture tests can run synthetic registries
+        self.registry: Tuple[dict, ...] = tuple(
+            REGISTRY if registry is None else registry)
+
+    def run(self, index: ProjectIndex) -> List[Finding]:
+        out: List[Finding] = []
+        for ent in self.registry:
+            drel, dcls = ent["dataclass"]
+            cls_node = _find_class(index, drel, dcls)
+            dmod = index.module(drel)
+            if cls_node is None or dmod is None:
+                anchor = dmod or index.modules()[0]
+                out.append(self.finding(
+                    anchor, 1,
+                    f"stale wire_drift registry entry: class {dcls!r} "
+                    f"not found in {drel}",
+                    detail=f"{drel}::{dcls}"))
+                continue
+            fields = _dataclass_fields(cls_node)
+            if not fields:
+                continue
+
+            enc_rel, enc_qual = ent["encode"]
+            dec_rel, dec_qual = ent["decode"]
+            enc = _find_def(index, enc_rel, enc_qual)
+            dec = _find_def(index, dec_rel, dec_qual)
+            for side, node, rel, qual in (("encoder", enc, enc_rel,
+                                           enc_qual),
+                                          ("decoder", dec, dec_rel,
+                                           dec_qual)):
+                if node is None:
+                    anchor = index.module(rel) or dmod
+                    out.append(self.finding(
+                        anchor, 1,
+                        f"stale wire_drift registry entry: {side} "
+                        f"{qual!r} not found in {rel}",
+                        detail=f"{rel}::{qual}"))
+            if enc is None or dec is None:
+                continue
+
+            enc_mod = index.module(enc_rel)
+            dec_mod = index.module(dec_rel)
+            enc_cover = _mentions(enc)
+            dec_cover = _mentions(dec) | _positional_cover(dec, dcls,
+                                                           fields)
+            for i, f in enumerate(fields):
+                if f in ent["ignore"]:
+                    continue
+                if f not in enc_cover:
+                    out.append(self.finding(
+                        enc_mod, enc.lineno,
+                        f"{dcls}.{f} is never serialized by "
+                        f"{enc_qual} — the field silently drops on "
+                        "the first network hop",
+                        detail=f"{dcls}.{f}:encode"))
+                if f not in dec_cover:
+                    out.append(self.finding(
+                        dec_mod, dec.lineno,
+                        f"{dcls}.{f} is never restored by "
+                        f"{dec_qual} — the field silently resets to "
+                        "its default after one network hop",
+                        detail=f"{dcls}.{f}:decode"))
+
+            for f, combiners in ent["combined"].items():
+                for crel, cqual in combiners:
+                    cnode = _find_def(index, crel, cqual)
+                    cmod = index.module(crel)
+                    if cnode is None or cmod is None:
+                        anchor = index.module(crel) or dmod
+                        out.append(self.finding(
+                            anchor, 1,
+                            f"stale wire_drift registry entry: "
+                            f"combiner {cqual!r} not found in {crel}",
+                            detail=f"{crel}::{cqual}"))
+                        continue
+                    if f not in _mentions(cnode):
+                        out.append(self.finding(
+                            cmod, cnode.lineno,
+                            f"{dcls}.{f} round-trips the wire but "
+                            f"{cqual} never combines it — the field "
+                            "is lost when per-tablet partials merge",
+                            detail=f"{dcls}.{f}:combine"))
+        return out
+
+
+# --- AST lookups ----------------------------------------------------------
+def _find_class(index: ProjectIndex, rel: str,
+                name: str) -> Optional[ast.ClassDef]:
+    mod = index.module(rel)
+    if mod is None or mod.tree is None:
+        return None
+    for n in ast.walk(mod.tree):
+        if isinstance(n, ast.ClassDef) and n.name == name:
+            return n
+    return None
+
+
+def _find_def(index: ProjectIndex, rel: str, qual: str):
+    mod = index.module(rel)
+    if mod is None or mod.tree is None:
+        return None
+    from ..callgraph import iter_defs
+    for q, _cls, node in iter_defs(mod.tree):
+        if q == qual:
+            return node
+    return None
+
+
+def _dataclass_fields(cls: ast.ClassDef) -> List[str]:
+    """Annotated-assignment names in declaration order (the dataclass
+    __init__ parameter order)."""
+    out: List[str] = []
+    for s in cls.body:
+        if isinstance(s, ast.AnnAssign) and isinstance(s.target,
+                                                       ast.Name):
+            out.append(s.target.id)
+    return out
+
+
+def _mentions(node: ast.AST) -> Set[str]:
+    """Names a def plausibly touches as fields: attribute accesses,
+    keyword arguments, and string literals."""
+    got: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute):
+            got.add(n.attr)
+        elif isinstance(n, ast.keyword) and n.arg:
+            got.add(n.arg)
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+            got.add(n.value)
+    return got
+
+
+def _positional_cover(node: ast.AST, cls_name: str,
+                      fields: List[str]) -> Set[str]:
+    """Fields covered positionally: ``Cls(a, b, key=...)`` covers the
+    first two declared fields."""
+    got: Set[str] = set()
+    for n in ast.walk(node):
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                and n.func.id == cls_name):
+            npos = sum(1 for a in n.args
+                       if not isinstance(a, ast.Starred))
+            got |= set(fields[:npos])
+    return got
+
+
+PASS = WireDriftPass()

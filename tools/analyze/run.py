@@ -3,6 +3,7 @@
 
     python tools/analyze/run.py                    # all passes, human
     python tools/analyze/run.py --json             # machine schema
+    python tools/analyze/run.py --sarif out.sarif  # SARIF 2.1.0 file
     python tools/analyze/run.py --pass jit_hazards --pass flag_drift
     python tools/analyze/run.py yugabyte_db_tpu/sched   # narrower roots
     python tools/analyze/run.py --changed origin/main..HEAD   # CI mode
@@ -27,6 +28,12 @@ bench.py WARN tail):
      "suppressions": {pass_id: N},
      "total_findings": N, "total_suppressed": N, "wall_ms": F,
      "parse_errors": [{"path", "error"}]}
+
+``--sarif <path>`` additionally writes the unsuppressed findings as a
+single-run SARIF 2.1.0 log (rules = the executed passes, ruleId = the
+pass id, the pass hint as the rule help text) so CI code-scanning
+uploads can annotate the diff; it composes with every other mode and
+does not change the exit status.
 """
 from __future__ import annotations
 
@@ -85,6 +92,71 @@ def _index_content(base: str, rel: str):
     return r.stdout.decode("utf-8", "replace")
 
 
+def _sarif_log(report: dict, passes) -> dict:
+    """The report as a one-run SARIF 2.1.0 log.  Pass ids become rule
+    ids (hint text as the rule help); parse errors ship as tool
+    notifications so an upload still shows WHY coverage shrank."""
+    by_id = {p.id: p for p in passes}
+    rules = [{
+        "id": pid,
+        "name": pid,
+        "shortDescription": {"text": by_id[pid].title},
+        "help": {"text": by_id[pid].hint},
+        "defaultConfiguration": {"level": "error"},
+    } for pid in sorted(by_id)]
+    rule_index = {r["id"]: i for i, r in enumerate(rules)}
+    results = [{
+        "ruleId": f["pass"],
+        "ruleIndex": rule_index[f["pass"]],
+        "level": "error",
+        "message": {"text": f["message"]},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": f["path"],
+                                     "uriBaseId": "SRCROOT"},
+                "region": {"startLine": max(1, f["line"])},
+            },
+        }],
+    } for f in report["findings"]]
+    notifications = [{
+        "level": "error",
+        "message": {"text": f"parse error: {e['error']}"},
+        "locations": [{
+            "physicalLocation": {
+                "artifactLocation": {"uri": e["path"],
+                                     "uriBaseId": "SRCROOT"},
+            },
+        }],
+    } for e in report["parse_errors"]]
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "yugabyte-tpu-analyze",
+                "informationUri": "tools/analyze/run.py",
+                "rules": rules,
+            }},
+            "invocations": [{
+                "executionSuccessful": True,
+                "toolExecutionNotifications": notifications,
+            }],
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }
+
+
+def _write_sarif(path: str, log: dict) -> None:
+    if path == "-":
+        print(json.dumps(log))
+        return
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(log, fh, indent=2)
+        fh.write("\n")
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="multi-pass static analysis for event-loop, "
@@ -94,6 +166,10 @@ def main(argv=None) -> int:
                          "(default: %s)" % (DEFAULT_ROOTS,))
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit the machine schema on stdout")
+    ap.add_argument("--sarif", metavar="PATH",
+                    help="also write unsuppressed findings as a SARIF "
+                         "2.1.0 log to PATH (ruleId = pass id; '-' "
+                         "for stdout)")
     ap.add_argument("--pass", action="append", dest="passes", default=[],
                     metavar="ID", help="run only this pass (repeatable)")
     ap.add_argument("--base", default=os.path.dirname(os.path.dirname(_HERE)),
@@ -137,6 +213,9 @@ def main(argv=None) -> int:
         focus_label = f"changed in {args.changed}"
     if focus is not None:
         if not focus:
+            if args.sarif:
+                _write_sarif(args.sarif, _sarif_log(
+                    {"findings": [], "parse_errors": []}, passes))
             if args.as_json:
                 print(json.dumps({"passes": [], "findings": [],
                                   "suppressions": {}, "total_findings": 0,
@@ -170,6 +249,8 @@ def main(argv=None) -> int:
                                   if e["path"] in focus]
         report["total_findings"] = len(report["findings"])
 
+    if args.sarif:
+        _write_sarif(args.sarif, _sarif_log(report, passes))
     if args.as_json:
         print(json.dumps(report))
     else:

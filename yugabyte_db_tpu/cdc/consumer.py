@@ -19,6 +19,7 @@ from typing import Dict, List, Optional, Tuple
 from ..client import YBClient
 from ..docdb.operations import ReadRequest, RowOp
 from ..rpc.messenger import RpcError
+from ..utils.tasks import cancel_and_drain
 
 
 class CdcStream:
@@ -348,5 +349,5 @@ class XClusterReplicator:
 
     async def stop(self):
         self._running = False
-        if self._task:
-            self._task.cancel()
+        await cancel_and_drain(self._task)
+        self._task = None

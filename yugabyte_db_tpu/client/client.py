@@ -20,6 +20,7 @@ from ..docdb.wire import (
     read_request_to_wire, read_response_from_wire, write_request_to_wire,
 )
 from ..dockv.partition import Partition
+from ..utils.tasks import cancel_and_drain
 # partial-combine rules + scalar unwrap shared with the bypass
 # session's host combine (ops/scan.py — one implementation, no drift)
 from ..ops.scan import combine_agg_partials
@@ -880,8 +881,9 @@ class YBClient:
                         yield resp.rows
         finally:
             # consumer broke out early: reap the in-flight prefetch
-            if nxt is not None and not nxt.done():
-                nxt.cancel()
+            # (drained, so a response racing the cancel can't leave an
+            # unretrieved task behind — bpo-37658)
+            await cancel_and_drain(nxt)
 
     def _combine(self, req: ReadRequest, parts: List[ReadResponse]
                  ) -> ReadResponse:

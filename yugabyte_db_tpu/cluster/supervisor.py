@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ..rpc.messenger import Messenger, RpcError
+from ..utils.tasks import cancel_and_drain, drain_all
 
 _READY_PREFIX = "READY "
 
@@ -185,8 +186,8 @@ class ClusterSupervisor:
             await asyncio.gather(*barriers)
             await self.wait_tservers_live()
         except BaseException:
-            for t in barriers:   # gather leaves siblings running
-                t.cancel()
+            # gather leaves siblings running; drain so none outlives us
+            await drain_all(barriers)
             # a failed barrier must not strand the children already
             # spawned (start_new_session detaches them from us): the
             # caller never got the supervisor back, so nobody else
@@ -428,9 +429,8 @@ class ClusterSupervisor:
         master).  drain=True SIGTERMs; the default kills — tests that
         assert on the drain path call stop(name, drain=True) explicitly
         and check the exit code."""
-        if self._monitor_task is not None:
-            self._monitor_task.cancel()
-            self._monitor_task = None
+        await cancel_and_drain(self._monitor_task)
+        self._monitor_task = None
         order = {"driver": 0, "tserver": 1, "master": 2}
         for name, mp in sorted(self.procs.items(),
                                key=lambda kv: order.get(kv[1].role, 3)):

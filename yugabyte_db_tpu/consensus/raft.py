@@ -34,6 +34,7 @@ from ..utils import trace as _trace
 
 log = logging.getLogger("ybtpu.consensus")
 from ..utils.hybrid_time import HybridClock, HybridTime
+from ..utils.tasks import cancel_and_drain, drain_all
 from .log import Log, LogEntry
 
 
@@ -207,12 +208,12 @@ class RaftConsensus:
         # forever and log appends would hit its removed WAL directory
         self.role = Role.FOLLOWER
         self.messenger.unregister_service(f"consensus-{self.tablet_id}")
-        for t in self._tasks:
-            t.cancel()
-        for t in list(self._bootstrap_tasks):
-            t.cancel()
-        if self._append_drainer is not None:
-            self._append_drainer.cancel()
+        # drain, don't fire-and-forget: a cancel landing in the same
+        # tick as an RPC completion can be swallowed (bpo-37658) and a
+        # deleted replica's election loop would keep campaigning
+        await drain_all(self._tasks)
+        await drain_all(list(self._bootstrap_tasks))
+        await cancel_and_drain(self._append_drainer)
         for _, _, _, fut, _ in self._pending_appends:
             if not fut.done():
                 fut.cancel()

@@ -23,6 +23,7 @@ from ..dockv.packed_row import ColumnSchema, TableSchema
 from ..dockv.partition import PartitionSchema
 from ..rpc.messenger import Messenger, RpcError
 from ..utils import flags
+from ..utils.tasks import cancel_and_drain
 
 TS_LIVENESS_S = 3.0
 
@@ -424,8 +425,8 @@ class Master:
 
     async def shutdown(self):
         self._running = False
-        if self._lb_task:
-            self._lb_task.cancel()
+        await cancel_and_drain(self._lb_task)
+        self._lb_task = None
         for ent in self._xcluster_tasks.values():
             await ent.stop()
         self._xcluster_tasks.clear()

@@ -44,6 +44,7 @@ from ..ops.scan import (AggSpec, HashGroupSpec, _keyed_partials,
                         _mm2, _scalar_of, combine_grouped_partials,
                         retract_grouped_partials)
 from ..utils import flags
+from ..utils.tasks import cancel_and_drain
 from .definition import (ViewDef, bind_expr, group_eq_where,
                          key_normalizers)
 from .errors import (REASON_RESCAN_BUDGET, REASON_SLOT_INVALID,
@@ -62,7 +63,8 @@ def _fresh_counters() -> dict:
             "rows_added": 0, "rows_retracted": 0,
             "before_image_reads": 0, "minmax_rescans": 0,
             "budget_exceeded": 0, "full_rescans": 0, "truncates": 0,
-            "loop_errors": 0, "last_fallback_reason": None}
+            "loop_errors": 0, "loop_refusals": 0,
+            "last_fallback_reason": None}
 
 
 class ViewMaintainer:
@@ -196,17 +198,11 @@ class ViewMaintainer:
 
     async def stop(self) -> None:
         t, self._task = self._task, None
-        if t is None:
-            return
         # re-cancel until the task actually ends: an in-flight RPC
         # completing in the same tick as the cancel can swallow the
         # CancelledError inside wait_for (bpo-37658), leaving the loop
-        # alive — one cancel() is a request, not a guarantee
-        while not t.done():
-            t.cancel()
-            await asyncio.wait([t], timeout=1.0)
-        if not t.cancelled():
-            t.exception()              # retrieve, never surfaces
+        # alive — cancel_and_drain is the shared spelling of the guard
+        await cancel_and_drain(t)
 
     async def _loop(self) -> None:
         while True:
@@ -214,6 +210,14 @@ class ViewMaintainer:
                 n = await self.round()
             except asyncio.CancelledError:
                 raise
+            except MatviewError as e:
+                # typed refusal out of the reseed path (no CDC
+                # watermark while leaders move / catch-up stall):
+                # retry next round, but counted APART from bugs so a
+                # wedged stream is visible as refusals, not errors
+                self.counters["loop_refusals"] += 1
+                self.counters["last_fallback_reason"] = str(e)
+                n = 0
             except Exception:
                 # transient (leader moves, master failover): the round
                 # rolled its staged fold back and flagged the stream

@@ -290,6 +290,10 @@ def build_generic_scan(where, agg_fns, group_cols, num_groups,
 
         for oi, (op, f) in enumerate(agg_fns):
             if f is None:
+                # mosaic has no int64 lanes; one block is <= 4096 rows
+                # so the f32 one-hot count partial is exact, and the
+                # host combines per-block partials in int64
+                # analysis-ok(numeric_exactness): block-exact f32 count
                 put_g(out_refs[oi], jnp.sum(onehot, axis=0))
                 continue
             v, vn = f(cols, nulls, consts)
@@ -297,6 +301,7 @@ def build_generic_scan(where, agg_fns, group_cols, num_groups,
             oh = onehot if vn is None else \
                 onehot * jnp.logical_not(vn).astype(jnp.float32)[:, None]
             if op == "count":
+                # analysis-ok(numeric_exactness): block-exact f32 count
                 put_g(out_refs[oi], jnp.sum(oh, axis=0))
             elif op == "sum":
                 row_m = oh.max(axis=1)
@@ -309,6 +314,7 @@ def build_generic_scan(where, agg_fns, group_cols, num_groups,
             elif op == "max":
                 put_g(out_refs[oi], jnp.max(jnp.where(
                     oh > 0, v[:, None], jnp.float32(-np.inf)), axis=0))
+        # analysis-ok(numeric_exactness): block-exact f32 count
         put_g(out_refs[n_aggs], jnp.sum(onehot, axis=0))
 
     @partial(jax.jit, static_argnames=())

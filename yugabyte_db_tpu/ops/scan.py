@@ -546,6 +546,9 @@ class ScanKernel:
     def __init__(self):
         self._cache: Dict[tuple, object] = {}
         self.compiles = 0
+        #: typed-refusal tally: PallasIneligible reason -> count (why
+        #: the pallas route declined; reads like bypass REASON_* stats)
+        self.pallas_refusals: Dict[str, int] = {}
 
     def _get(self, sig, where_node, aggs, group, mvcc_mode, static_sums,
              strategy):
@@ -568,21 +571,23 @@ class ScanKernel:
     _PALLAS_DTYPES = ("float32", "float64", "int32", "int16", "int8",
                       "bool")
 
-    def _try_pallas(self, sig, batch, where, aggs, group, mvcc_mode,
-                    consts):
-        """Route eligible aggregate scans through the hand-fused pallas
-        kernel (ops/pallas_scan.py). Returns the XLA-shaped result
-        tuple, or None when the query/batch shape is ineligible — the
-        caller falls back to the XLA kernel."""
+    def _pallas_eligible(self, batch, where, aggs, group, mvcc_mode,
+                         consts):
+        """Typed eligibility gate for the pallas route: returns the
+        referenced-column set, or raises PallasIneligible with the
+        refusal reason.  The refusal-flow contract: fast paths refuse
+        BY TYPE so dispatchers can route (and count) the decline —
+        a silent None return is indistinguishable from a bug."""
+        from .pallas_scan import PallasIneligible
         if mvcc_mode != "none" or not aggs:
-            return None
+            raise PallasIneligible("mvcc_or_no_aggs")
         if group is not None and (not isinstance(group, GroupSpec)
                                   or group.num_groups > 64):
-            return None
+            raise PallasIneligible("group_shape")
         if any(a.op not in ("sum", "count", "min", "max") for a in aggs):
-            return None
+            raise PallasIneligible("agg_op")
         if batch.padded_rows % 4096 != 0:
-            return None
+            raise PallasIneligible("bucket_rows")
         from .expr import referenced_columns
         needed = set(referenced_columns(where)) if where is not None \
             else set()
@@ -593,25 +598,42 @@ class ScanKernel:
                 # those shapes stay on the exact XLA path
                 if any(cid in batch.dicts
                        for cid in referenced_columns(a.expr)):
-                    return None
+                    raise PallasIneligible("dict_code_agg")
                 needed |= set(referenced_columns(a.expr))
         if group is not None:
             needed |= {cid for cid, _, _ in group.cols}
         for cid in needed:
             col = batch.cols.get(cid)
             if col is None or str(col.dtype) not in self._PALLAS_DTYPES:
-                return None
+                raise PallasIneligible("column_dtype")
             if str(col.dtype) == "int32":
                 rng = batch.col_bounds.get(cid) or \
                     batch.int32_ranges.setdefault(
                         cid, (int(jnp.min(col)), int(jnp.max(col))))
                 if max(abs(rng[0]), abs(rng[1])) >= 2 ** 24:
-                    return None         # not f32-exact
+                    raise PallasIneligible("int32_range")  # not f32-exact
         for c in consts:
             if np.ndim(c) != 0:
-                return None
+                raise PallasIneligible("const_shape")
             if abs(float(c)) >= 2 ** 24:
-                return None             # not f32-exact
+                raise PallasIneligible("const_range")  # not f32-exact
+        return needed
+
+    def _try_pallas(self, sig, batch, where, aggs, group, mvcc_mode,
+                    consts):
+        """Route eligible aggregate scans through the hand-fused pallas
+        kernel (ops/pallas_scan.py). Returns the XLA-shaped result
+        tuple, or None on a typed PallasIneligible refusal — the
+        caller falls back to the XLA kernel and the reason is tallied
+        in ``pallas_refusals``."""
+        from .pallas_scan import PallasIneligible
+        try:
+            needed = self._pallas_eligible(batch, where, aggs, group,
+                                           mvcc_mode, consts)
+        except PallasIneligible as e:
+            r = str(e)
+            self.pallas_refusals[r] = self.pallas_refusals.get(r, 0) + 1
+            return None
         key = ("pallas", sig)
         entry = self._cache.get(key)
         if entry is False:

@@ -353,6 +353,10 @@ class CqlServer:
             if opcode == OP_BATCH:
                 return OP_RESULT, await self._batch(body)
             return self._error(0x000A, f"unsupported opcode {opcode}")
+        # every failure, typed refusals included, surfaces to the
+        # client as a CQL error frame carrying the refusal's message;
+        # there is no further fallback to route to
+        # analysis-ok(refusal_flow): protocol boundary handler
         except Exception as e:   # noqa: BLE001 — surface as CQL error frame
             return self._error(0x2200, str(e))
 

@@ -42,6 +42,7 @@ from typing import Callable, Dict, List, Optional
 from ..rpc.messenger import RECEIVED_AT, RpcError
 from ..utils import fault_injection as fi
 from ..utils import flags, metrics
+from ..utils.tasks import drain_all
 from ..utils.trace import TRACE, TRACES, wait_status
 from .batching import (PointReadItem, ScanItem, WriteItem,
                        dispatch_point_read_group, dispatch_scan_group,
@@ -231,13 +232,10 @@ class RequestScheduler:
 
     async def shutdown(self) -> None:
         self._closed = True
-        for t in self._workers:
-            t.cancel()
-        for t in self._workers:
-            try:
-                await t
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass
+        # drain_all re-cancels until each worker is really done: a
+        # dispatch completing in the cancel's tick can swallow the
+        # CancelledError (bpo-37658) and a bare `await t` then hangs
+        await drain_all(self._workers)
         self._workers.clear()
         # fail anything still queued so callers don't hang on shutdown
         for st in self.lanes.values():

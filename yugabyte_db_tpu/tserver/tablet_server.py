@@ -31,6 +31,7 @@ import logging
 from ..utils import flags
 from ..utils.fault_injection import TEST_CRASH_POINT
 from ..utils.hybrid_time import HybridClock
+from ..utils.tasks import cancel_and_drain
 from ..utils.trace import ASH, TRACES, wait_status
 
 log = logging.getLogger("ybtpu.tserver")
@@ -188,8 +189,8 @@ class TabletServer:
         keeps the old behavior: consensus stops, WAL closes, memtables
         are simply lost to replay."""
         self._running = False
-        if self._hb_task:
-            self._hb_task.cancel()
+        await cancel_and_drain(self._hb_task)
+        self._hb_task = None
         # the ASH sampler is process-global: a dead server's provider
         # closures must not keep reporting its retained state forever
         for p in getattr(self, "_ash_providers", ()):
