@@ -1840,6 +1840,198 @@ def tpch_join_bench(data, repeats):
         flags.REGISTRY.reset("streaming_chunk_rows")
 
 
+def tpch_full_bench(repeats):
+    """The whole-query TPC-H gauntlet: EVERY query in the 22-query
+    registry (models/tpch.py tpch_queries) through the device path —
+    single-table scans and 2-stage fused join chains (lineitem_j ->
+    orders_c -> customer, ONE program under one shared visibility
+    mask) — with per-query compile budgets ASSERTED and per-query
+    fused_vs_interp ratios WARN-wired like any other ratio.
+
+    Inexpressible queries are REPORTED with their typed registry
+    reason (table_coverage / subquery_shape / semi_join / outer_join /
+    group_domain / expr_shape), never silently skipped.
+
+    Scale: BENCH_TPCH_SF picks the scale factor — default 0.1 (the
+    smoke gauntlet); the literal "full" uses the tpch_sf flag (default
+    10, the SF10 acceptance gauntlet); 0 skips.  The device leg runs
+    the full sf; the interpreted leg replays each query on a
+    row-capped clone (BENCH_TPCH_INTERP_ROWS, default 262144) so the
+    row-at-a-time baseline stays bounded, with device-vs-interpreted
+    PARITY asserted on that same capped clone."""
+    from yugabyte_db_tpu.docdb import operations as _ops
+    from yugabyte_db_tpu.docdb.operations import ReadRequest
+    from yugabyte_db_tpu.models.tpch import (CUSTOMERS_PER_SF,
+                                             ORDERS_PER_SF,
+                                             ROWS_PER_SF,
+                                             _chain_group,
+                                             chain_build_wires,
+                                             generate_customer,
+                                             generate_lineitem,
+                                             generate_orders_cust,
+                                             lineitem_join_data,
+                                             lineitem_join_info,
+                                             lineitem_str_data,
+                                             lineitem_str_info,
+                                             numpy_reference,
+                                             numpy_reference_chain,
+                                             tpch_queries)
+    from yugabyte_db_tpu.ops.plan_fusion import (LAST_PLAN_STATS,
+                                                 default_plan_kernel)
+    from yugabyte_db_tpu.tablet import Tablet
+    from yugabyte_db_tpu.utils import flags
+
+    raw = os.environ.get("BENCH_TPCH_SF", "0.1")
+    sf = float(flags.get("tpch_sf")) if raw == "full" else float(raw)
+    if sf <= 0:
+        return None
+    n = int(ROWS_PER_SF * sf)
+    n_orders = max(int(ORDERS_PER_SF * sf), 1)
+    n_cust = max(int(CUSTOMERS_PER_SF * sf), 1)
+    n_cap = min(n, int(os.environ.get("BENCH_TPCH_INTERP_ROWS",
+                                      str(262144))))
+    data = generate_lineitem(sf)
+    ldata = lineitem_join_data(data, n_orders)
+    odata = generate_orders_cust(n_orders, n_cust)
+    cdata = generate_customer(n_cust)
+    base = tempfile.mkdtemp(prefix="ybtpu-tpch-full-")
+    block_rows = 65536
+    t_j = Tablet("li-full-j", lineitem_join_info(), f"{base}/j")
+    t_j.bulk_load(ldata, block_rows=block_rows)
+    t_s = Tablet("li-full-s", lineitem_str_info(), f"{base}/s")
+    t_s.bulk_load(lineitem_str_data(data), block_rows=block_rows)
+    cap_l = {k: v[:n_cap] for k, v in ldata.items()}
+    cap_d = {k: v[:n_cap] for k, v in data.items()}
+    t_jc = Tablet("li-cap-j", lineitem_join_info(), f"{base}/jc")
+    t_jc.bulk_load(cap_l, block_rows=32768)
+    t_sc = Tablet("li-cap-s", lineitem_str_info(), f"{base}/sc")
+    t_sc.bulk_load(lineitem_str_data(cap_d), block_rows=32768)
+    flags.set_flag("streaming_chunk_rows", min(block_rows, 1 << 20))
+    # chain build sides at TPC-H scale are FACT-sized (orders is
+    # 1.5M/SF; q3 ships ~45% of them) — raise the build cap to the
+    # pow2 hard maximum so the gauntlet measures the device path
+    # instead of refusing it.  Bucket growth across SFs is exactly
+    # what the plan signature absorbs (one compile per bucket).
+    flags.set_flag("join_max_build_slots", 1 << 24)
+    pkern = default_plan_kernel()
+    skern = _ops._SHARED_KERNEL
+    rounds = max(2, repeats // 2)
+
+    def by_key(resp):
+        counts = np.asarray(resp.group_counts)
+        return {tuple(str(gv[g]) for gv in resp.group_values):
+                (int(counts[g]),
+                 float(np.asarray(resp.agg_values[0])[g]))
+                for g in np.nonzero(counts)[0]}
+
+    def run_query(e):
+        q = e.spec
+        if e.kind == "chain":
+            wires = chain_build_wires(q, odata, cdata)
+            tab, tab_cap = t_j, t_jc
+            interp_flag = "join_pushdown_enabled"
+
+            def req():
+                return ReadRequest("lineitem_j", where=q.probe_where,
+                                   aggregates=q.aggs,
+                                   group_by=_chain_group(q.group_col),
+                                   join=wires)
+            ref = numpy_reference_chain(q, cap_l, odata, cdata)
+        else:
+            tab, tab_cap = ((t_s, t_sc) if q.name == "q1_str"
+                            else (t_j, t_jc))
+            interp_flag = "tpu_pushdown_enabled"
+
+            def req():
+                return ReadRequest(tab.info.name,
+                                   where=q.where, aggregates=q.aggs,
+                                   group_by=q.group)
+            ref = numpy_reference(q, cap_d)
+
+        # warm (compile) then timed rounds with the compile count
+        # ASSERTED flat — the per-query compile budget
+        warm = tab.read(req())
+        assert warm.backend == "tpu", \
+            f"{e.name}: device path fell back ({warm.backend})"
+        c_p, c_s = pkern.compiles, skern.compiles
+        best = None
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            tab.read(req())
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        assert pkern.compiles == c_p and skern.compiles == c_s, \
+            f"{e.name}: recompiled at an unchanged plan shape"
+        split = {k: v for k, v in LAST_PLAN_STATS.items()
+                 if k.endswith("_s") or k in ("chunks", "join_stages",
+                                              "num_slots")} \
+            if e.kind == "chain" else {}
+
+        # parity + fused_vs_interp on the capped clone (paired rounds)
+        pairs = []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            dresp = tab_cap.read(req())
+            d_t = time.perf_counter() - t0
+            flags.set_flag(interp_flag, False)
+            try:
+                t0 = time.perf_counter()
+                iresp = tab_cap.read(req())
+                i_t = time.perf_counter() - t0
+            finally:
+                flags.REGISTRY.reset(interp_flag)
+            pairs.append((d_t, i_t))
+        assert dresp.backend == "tpu" and iresp.backend == "cpu", \
+            (e.name, dresp.backend, iresp.backend)
+        if e.kind == "chain" or q.group is not None:
+            dk, ik = by_key(dresp), by_key(iresp)
+            assert set(dk) == set(ik), (e.name, set(dk) ^ set(ik))
+            for g in dk:
+                assert dk[g][0] == ik[g][0], (e.name, g, dk[g], ik[g])
+            want = ({(str(g),): c for g, (c, _) in ref.items() if c}
+                    if e.kind == "chain" else None)
+            if want is not None:
+                assert {g: c for g, (c, _) in dk.items()} == want, \
+                    (e.name, dk, want)
+        else:
+            dv = float(np.asarray(dresp.agg_values[0]))
+            iv = float(np.asarray(iresp.agg_values[0]))
+            assert abs(dv - iv) / max(abs(iv), 1e-9) < 1e-5, \
+                (e.name, dv, iv)
+            assert abs(dv - ref) / max(abs(ref), 1e-9) < 1e-5, \
+                (e.name, dv, ref)
+        return {
+            "kind": e.kind, "note": e.note, "rows": n,
+            "rows_per_s": round(n / best, 1),
+            "interp_rows_per_s": round(n_cap / min(i for _, i in pairs),
+                                       1),
+            "fused_vs_interp": round(
+                max(i / d for d, i in pairs), 3),
+            "new_compiles_after_warm": 0,   # asserted above
+            **({"stage_split": split} if split else {}),
+        }
+
+    out = {"sf": sf, "rows": n, "orders": n_orders,
+           "customers": n_cust, "interp_cap_rows": n_cap,
+           "queries": {}}
+    try:
+        for name, e in tpch_queries().items():
+            if e.kind == "inexpressible":
+                out["queries"][name] = {"inexpressible": e.reason,
+                                        "note": e.note}
+                continue
+            out["queries"][name] = run_query(e)
+        out["expressible"] = sorted(
+            k for k, v in out["queries"].items()
+            if "inexpressible" not in v)
+        out["plan_compiles_per_signature"] = \
+            sorted(pkern.sig_compiles.values())
+    finally:
+        flags.REGISTRY.reset("streaming_chunk_rows")
+        flags.REGISTRY.reset("join_max_build_slots")
+    return out
+
+
 def trace_overhead_bench():
     """The observability layer must not tax the hot path it observes
     (ISSUE 14 acceptance: headline rates within 2% with tracing at
@@ -2492,6 +2684,21 @@ def main():
             raise
         results["tpch_join"] = {"error": str(e)[:300]}
 
+    # --- whole-query gauntlet: the 22-query TPC-H registry --------------
+    # (BENCH_TPCH_SF sets the scale — 0.1 smoke default, "full" = the
+    # tpch_sf flag's SF10, 0 skips; inexpressible queries report typed
+    # reasons, fused_vs_interp WARN-wires per query)
+    try:
+        tf = tpch_full_bench(repeats)
+        results["tpch_full"] = (tf if tf is not None
+                                else "skipped (BENCH_TPCH_SF=0)")
+    except AssertionError:
+        raise   # a parity/compile-budget break IS a bench failure
+    except Exception as e:   # noqa: BLE001 — report, don't fail bench
+        if os.environ.get("BENCH_DEBUG"):
+            raise
+        results["tpch_full"] = {"error": str(e)[:300]}
+
     # --- optional: hand-fused pallas scan vs the XLA kernel -------------
     # (BENCH_PALLAS=1; the flag stays off otherwise so the driver's run
     # never depends on the pallas TPU compile)
@@ -2854,6 +3061,10 @@ def main():
         # string-keyed Q1 through the streamed grouped kernel vs the
         # interpreted GROUP BY (+ cardinality sweep, CPU-twin oracle)
         "q1_grouped": results["q1_grouped"],
+        # whole-query TPC-H gauntlet: the 22-query registry (runnable
+        # adapted specs or typed inexpressible reasons); every
+        # per-query fused_vs_interp in the subtree WARN-wires
+        "tpch_full": results["tpch_full"],
         "doc_scan": results["doc_scan"],
         "q1_dist8": {
             "rows_per_s": round(results["q1_dist"]["rows_per_s"], 1),

@@ -175,6 +175,67 @@ def profile_json() -> dict:
         }
     out["build_sweep"] = bsweep
     out["build_sweep_compiles"] = skern.compiles
+
+    # per-TPC-H-query stage splits: every CHAIN query in the 22-query
+    # registry (models/tpch.py tpch_queries) runs its 2-stage fused
+    # plan (lineitem_j -> orders_c -> customer) cold + warm, reporting
+    # the same build/batch/kernel/combine split the Q3ish block above
+    # reports — so a regression in ONE query's split is visible per
+    # query, not averaged away
+    from yugabyte_db_tpu.models.tpch import (CUSTOMERS_PER_SF,
+                                             ORDERS_PER_SF,
+                                             _chain_group,
+                                             chain_build_wires,
+                                             generate_customer,
+                                             generate_orders_cust,
+                                             tpch_queries)
+    n_orders_c = max(int(ORDERS_PER_SF * sf), 1)
+    n_cust = max(int(CUSTOMERS_PER_SF * sf), 1)
+    odata_c = generate_orders_cust(n_orders_c, n_cust)
+    cdata = generate_customer(n_cust)
+    ldata_c = lineitem_join_data(data, n_orders_c)
+    tc = Tablet("li-plan-c", lineitem_join_info(),
+                tempfile.mkdtemp(prefix="plan-prof-c-"))
+    tc.bulk_load(ldata_c, block_rows=32768)
+    flags.set_flag("streaming_chunk_rows", 32768)
+    flags.set_flag("join_max_build_slots", 1 << 24)
+    qkern = default_plan_kernel()
+    per_q = {}
+    for name, e in tpch_queries().items():
+        if e.kind != "chain":
+            continue
+        cq = e.spec
+        wires = chain_build_wires(cq, odata_c, cdata)
+
+        def creq():
+            return ReadRequest("lineitem_j", where=cq.probe_where,
+                               aggregates=cq.aggs,
+                               group_by=_chain_group(cq.group_col),
+                               join=wires)
+        c_pre = qkern.compiles
+        t0 = time.perf_counter()
+        r = tc.read(creq())
+        cold_q = time.perf_counter() - t0
+        assert r.backend == "tpu", (name, r.backend)
+        cold_split = dict(LAST_PLAN_STATS)
+        t0 = time.perf_counter()
+        tc.read(creq())
+        warm_q = time.perf_counter() - t0
+        per_q[name] = {
+            "cold_wall_s": round(cold_q, 4),
+            "warm_wall_s": round(warm_q, 4),
+            "warm_rows_per_s": round(n / warm_q, 1),
+            "join_stages": cold_split.get("join_stages"),
+            "num_slots": cold_split.get("num_slots"),
+            "warm_stage_split": {
+                k: v for k, v in LAST_PLAN_STATS.items()
+                if k.endswith("_s") or k == "chunks"},
+            "compiles": qkern.compiles - c_pre,
+        }
+        assert qkern.compiles - c_pre <= 1, \
+            (name, "one signature, one compile")
+    out["tpch_chain_queries"] = per_q
+    flags.REGISTRY.reset("join_max_build_slots")
     flags.REGISTRY.reset("streaming_chunk_rows")
     return out
 

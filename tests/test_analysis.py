@@ -343,6 +343,67 @@ class TestJitHazardsJoinWindow:
             """}, "jit_hazards")
         assert r["findings"] == []
 
+    def test_multi_stage_probe_idiom_clean(self, tmp_path):
+        # the N-stage probe idiom (ops/plan_fusion FusedPlanKernel:
+        # multi-join chains): the stage list is a STATIC tuple baked
+        # into the plan signature — a Python for over it unrolls at
+        # trace time; each stage ANDs its match into ONE shared
+        # visibility mask, gathers its payload lanes into the column
+        # namespace (clipped indices — masked rows gather garbage that
+        # the mask keeps out of every aggregate), and a later stage may
+        # probe an earlier stage's payload lane
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import jax
+            import jax.numpy as jnp
+            from functools import partial
+            @partial(jax.jit, static_argnames=("join_shape",))
+            def fused(cols, mask, joins, join_shape):
+                for si in range(len(join_shape)):   # static arity: fine
+                    probe_col, num_slots, rows_pad, payload = \\
+                        join_shape[si]
+                    tu, tk, tv, pvals = joins[si]
+                    bits = num_slots.bit_length() - 1  # static: fine
+                    pk = cols[probe_col].astype(jnp.int64)
+                    h = pk.astype(jnp.uint64) \\
+                        * jnp.uint64(0x9E3779B97F4A7C15)
+                    slot = (h >> jnp.uint64(64 - bits)).astype(
+                        jnp.int32)
+                    hit = tu[slot] & (tk[slot] == pk)
+                    midx = jnp.where(hit, tv[slot], -1)
+                    mask = mask & (midx >= 0)   # ONE shared mask
+                    gidx = jnp.clip(midx, 0, rows_pad - 1)
+                    cols = dict(cols)
+                    for bi in range(len(payload)):  # static: fine
+                        cols[payload[bi]] = pvals[bi][gidx]
+                return mask, cols
+            """}, "jit_hazards")
+        assert r["findings"] == []
+
+    def test_multi_stage_probe_idiom_true_positives(self, tmp_path):
+        # the shapes the N-stage chain must NEVER take: early-exit
+        # Python branching on a stage's traced match count (the whole
+        # point of the shared mask is that dead rows ride along), a
+        # host sync of the surviving-row count between stages, and a
+        # Python while chasing convergence of the traced mask
+        r = _run(tmp_path, {"pkg/a.py": """\
+            import jax
+            import jax.numpy as jnp
+            @jax.jit
+            def bad_chain(pk, used, key, val):
+                hit = used[pk] & (key[pk] == pk)
+                midx = jnp.where(hit, val[pk], -1)
+                mask = midx >= 0
+                if mask.sum() == 0:        # python branch on traced
+                    return midx
+                alive = mask.sum().item()  # host sync between stages
+                while mask.sum() > 0:      # python loop on traced
+                    mask = mask & ~mask
+                return midx, alive
+            """}, "jit_hazards")
+        details = sorted(d for _, _, d in _findings(r))
+        assert details == ["bad_chain:if", "bad_chain:item",
+                           "bad_chain:while"]
+
     def test_window_segment_idiom_true_positive(self, tmp_path):
         r = _run(tmp_path, {"pkg/a.py": """\
             import jax

@@ -253,8 +253,28 @@ class TestGroupedParity:
 # --- fallbacks ------------------------------------------------------------
 
 class TestFallbacks:
-    def test_slot_overflow_reverts_to_interpreter(self, strtab):
+    def test_slot_overflow_merges_on_monolithic_route(self, strtab):
+        # DEFAULT behavior since the monolithic partial-spill merge:
+        # an over-cardinality scan on the MONOLITHIC dict-group route
+        # keeps its exact in-range device partials and re-aggregates
+        # only the spilled rows interpreted — backend stays tpu, no
+        # full re-scan fallback (the streamed route got this first;
+        # this is its monolithic twin)
         t, _ = strtab
+        m0 = GROUPED_STATS["spill_merges"]
+        fb0 = GROUPED_STATS["spill_fallbacks"]
+        resp = _grouped_read(t, spec=DictGroupSpec(cols=(1, 2),
+                                                   max_slots=4))
+        assert resp.backend == "tpu"
+        assert GROUPED_STATS["spill_merges"] == m0 + 1
+        assert GROUPED_STATS["spill_fallbacks"] == fb0
+        flags.set_flag("grouped_pushdown_enabled", False)
+        off = _grouped_read(t)
+        assert _by_key(resp) == _by_key(off)
+
+    def test_slot_overflow_reverts_when_merge_disabled(self, strtab):
+        t, _ = strtab
+        flags.set_flag("grouped_spill_merge_enabled", False)
         fb0 = GROUPED_STATS["spill_fallbacks"]
         resp = _grouped_read(t, spec=DictGroupSpec(cols=(1, 2),
                                                    max_slots=4))

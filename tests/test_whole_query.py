@@ -1,0 +1,580 @@
+"""Whole-query fused plans: multi-join chain parity (device vs the
+interpreted join, dangling/NULL FKs at every stage, dict-coded string
+payloads), per-stage typed JoinIneligible fallback (the WHOLE query
+falls back bit-identically), the growth-never-recompiles contract (one
+jitted program, one compile across >=20 launches plus 2x data growth
+and within-bucket build growth), server-side window pushdown parity
+against an independent Python reference with typed refusals for every
+ineligible shape, and the SQL-level 3-table chain + window pushdown
+through MiniCluster."""
+import asyncio
+import tempfile
+
+import numpy as np
+import pytest
+
+from yugabyte_db_tpu.docdb.operations import ReadRequest
+from yugabyte_db_tpu.docdb.table_codec import TableInfo
+from yugabyte_db_tpu.docdb.wire import (read_request_from_wire,
+                                        read_request_to_wire)
+from yugabyte_db_tpu.dockv.packed_row import (ColumnSchema, ColumnType,
+                                              TableSchema)
+from yugabyte_db_tpu.dockv.partition import PartitionSchema
+from yugabyte_db_tpu.ops.expr import Expr
+from yugabyte_db_tpu.ops.grouped_scan import DictGroupSpec
+from yugabyte_db_tpu.ops.join_scan import (BUILD_COL_BASE,
+                                           REASON_DUPLICATE_KEY,
+                                           REASON_STAGE_COUNT,
+                                           JoinIneligible, JoinWire,
+                                           make_join_runtimes)
+from yugabyte_db_tpu.ops.plan_fusion import (LAST_PLAN_STATS,
+                                             default_plan_kernel)
+from yugabyte_db_tpu.ops.scan import AggSpec
+from yugabyte_db_tpu.ops.window_scan import (WINDOW_STATS, WindowWire)
+from yugabyte_db_tpu.tablet import Tablet
+from yugabyte_db_tpu.utils import flags
+
+C = Expr.col
+
+# chain payload lanes (one shared namespace, like the SQL lowering)
+CK = BUILD_COL_BASE          # mid.ck        (stage-1 probe lane)
+MNAME = BUILD_COL_BASE + 1   # mid.name      (string)
+WT = BUILD_COL_BASE + 2      # mid.weight    (int64)
+SEG = BUILD_COL_BASE + 3     # cust.segment  (string, group key)
+RG = BUILD_COL_BASE + 4      # cust.region   (stage-2 probe lane)
+RNAME = BUILD_COL_BASE + 5   # region.name   (string, group key)
+
+
+@pytest.fixture(autouse=True)
+def _reset_flags():
+    yield
+    for f in ("join_pushdown_enabled", "plan_fusion_enabled",
+              "window_pushdown_enabled", "window_server_pushdown_enabled",
+              "multi_join_max_stages", "join_max_build_slots",
+              "streaming_chunk_rows", "streaming_scan_enabled",
+              "grouped_pushdown_enabled", "tpu_min_rows_for_pushdown",
+              "bypass_reader_enabled"):
+        flags.REGISTRY.reset(f)
+
+
+def _probe_tablet(prefix, n=6000, n_mid=400, seed=11, block_rows=4096):
+    """Probe (fact) table: k PK, fk -> mid (a slice dangles past
+    n_mid), val integer-valued f64 (exact device sums), ship int32."""
+    schema = TableSchema((
+        ColumnSchema(0, "k", ColumnType.INT64, is_hash_key=True),
+        ColumnSchema(1, "fk", ColumnType.INT64),
+        ColumnSchema(2, "val", ColumnType.FLOAT64),
+        ColumnSchema(3, "ship", ColumnType.INT32),
+    ), 1)
+    info = TableInfo("probe", "probe", schema, PartitionSchema("hash", 1))
+    t = Tablet("probe", info, tempfile.mkdtemp(prefix=prefix))
+    rng = np.random.default_rng(seed)
+    data = {
+        "k": np.arange(n, dtype=np.int64),
+        # ~11% dangling stage-0 FKs (inner join drops them)
+        "fk": rng.integers(0, int(n_mid * 1.125), n).astype(np.int64),
+        "val": rng.integers(1, 100, n).astype(np.float64),
+        "ship": rng.integers(0, 100, n).astype(np.int32),
+    }
+    t.bulk_load(data, block_rows=block_rows)
+    return t, data
+
+
+def _mid_tables(n_mid=400, n_cust=60, n_reg=7, seed=23):
+    """Build-side rows for the chain: mid (keyed 0..n_mid-1, ships the
+    ck lane with NULLs and values dangling past n_cust), cust (keyed
+    0..n_cust-1, ships segment strings + region codes), region."""
+    rng = np.random.default_rng(seed)
+    mid = {
+        "mk": np.arange(n_mid, dtype=np.int64),
+        # ~8% dangling stage-1 FKs; NULL mask on top
+        "ck": rng.integers(0, int(n_cust * 1.1), n_mid).astype(np.int64),
+        "ckn": (np.arange(n_mid) % 13 == 0),
+        "name": np.array([f"m{i % 7}" for i in range(n_mid)], object),
+        "wt": rng.integers(1, 50, n_mid).astype(np.int64),
+    }
+    cust = {
+        "ck": np.arange(n_cust, dtype=np.int64),
+        "seg": np.array([f"S{i % 4}" for i in range(n_cust)], object),
+        "rg": rng.integers(0, n_reg, n_cust).astype(np.int64),
+    }
+    reg = {
+        "rk": np.arange(n_reg, dtype=np.int64),
+        "name": np.array([f"R{i}" for i in range(n_reg)], object),
+    }
+    return mid, cust, reg
+
+
+def _chain_wires(mid, cust, reg=None):
+    """Ordered JoinWire stages: probe.fk -> mid.mk (ships ck/name/wt),
+    then the CK lane -> cust.ck (ships seg/rg), optionally RG -> reg."""
+    wires = [
+        JoinWire(probe_col=1, keys=mid["mk"],
+                 payload={CK: (mid["ck"], mid["ckn"]),
+                          MNAME: (mid["name"], None),
+                          WT: (mid["wt"], None)}),
+        JoinWire(probe_col=CK, keys=cust["ck"],
+                 payload={SEG: (cust["seg"], None),
+                          RG: (cust["rg"], None)}),
+    ]
+    if reg is not None:
+        wires.append(JoinWire(probe_col=RG, keys=reg["rk"],
+                              payload={RNAME: (reg["name"], None)}))
+    return tuple(wires)
+
+
+_WHERE = (C(3) < 50).node
+_AGGS = (AggSpec("sum", C(2).node), AggSpec("count"),
+         AggSpec("sum", C(WT).node))
+
+
+def _req(wires, group_bid, where=_WHERE):
+    r = ReadRequest("probe", where=where, aggregates=_AGGS,
+                    group_by=DictGroupSpec(cols=(group_bid,)),
+                    join=wires)
+    # every request crosses the wire codec, like a real RPC — the
+    # N-stage join list must round-trip
+    return read_request_from_wire(read_request_to_wire(r))
+
+
+def _by_key(resp):
+    counts = np.asarray(resp.group_counts)
+    out = {}
+    for g in np.nonzero(counts)[0]:
+        key = tuple(str(v[g]) for v in resp.group_values)
+        out[key] = (int(counts[g]),) + tuple(
+            float(np.asarray(v)[g]) for v in resp.agg_values)
+    return out
+
+
+def _np_chain_ref(data, mid, cust, reg, group):
+    """Independent numpy fold of the chain (inner semantics: WHERE,
+    dangling and NULL FKs drop at their own stage)."""
+    fk = data["fk"]
+    m = data["ship"] < 50
+    m &= fk < len(mid["mk"])                  # stage 0 match
+    ck = mid["ck"][np.clip(fk, 0, len(mid["mk"]) - 1)]
+    ckn = mid["ckn"][np.clip(fk, 0, len(mid["mk"]) - 1)]
+    m &= ~ckn & (ck < len(cust["ck"]))        # stage 1: NULL/dangling
+    ckc = np.clip(ck, 0, len(cust["ck"]) - 1)
+    if group == "seg":
+        gvals = cust["seg"][ckc]
+        domain = sorted(set(cust["seg"]))
+    else:
+        rg = cust["rg"][ckc]
+        gvals = reg["name"][rg]
+        domain = sorted(set(reg["name"]))
+    wt = mid["wt"][np.clip(fk, 0, len(mid["mk"]) - 1)]
+    out = {}
+    for g in domain:
+        mg = m & (gvals == g)
+        if mg.any():
+            out[(str(g),)] = (int(mg.sum()),
+                              float(data["val"][mg].sum()),
+                              float(mg.sum()),
+                              float(wt[mg].sum()))
+    return out
+
+
+# --- chain parity: device vs interpreted, bitwise ---------------------------
+
+class TestChainParity:
+    def test_two_stage_chain_device_vs_interpreted_bitwise(self):
+        t, data = _probe_tablet("chain2-")
+        mid, cust, reg = _mid_tables()
+        wires = _chain_wires(mid, cust)
+        flags.set_flag("tpu_min_rows_for_pushdown", 0)
+        dev = t.read(_req(wires, SEG))
+        assert dev.backend == "tpu", "chain fell back"
+        assert LAST_PLAN_STATS.get("join_stages") == 2
+        flags.set_flag("join_pushdown_enabled", False)
+        interp = t.read(_req(wires, SEG))
+        assert interp.backend == "cpu"
+        # integer-valued lanes: device and interpreted results are
+        # IDENTICAL, dangling stage-0 FKs, dangling stage-1 FKs and
+        # NULL ck payloads all dropping at their own stage
+        assert _by_key(dev) == _by_key(interp)
+        assert _by_key(dev) == _np_chain_ref(data, mid, cust, reg, "seg")
+
+    def test_three_stage_chain_device_vs_interpreted_bitwise(self):
+        t, data = _probe_tablet("chain3-")
+        mid, cust, reg = _mid_tables()
+        wires = _chain_wires(mid, cust, reg)
+        flags.set_flag("tpu_min_rows_for_pushdown", 0)
+        dev = t.read(_req(wires, RNAME))
+        assert dev.backend == "tpu", "3-stage chain fell back"
+        assert LAST_PLAN_STATS.get("join_stages") == 3
+        flags.set_flag("join_pushdown_enabled", False)
+        interp = t.read(_req(wires, RNAME))
+        assert interp.backend == "cpu"
+        assert _by_key(dev) == _by_key(interp)
+        assert _by_key(dev) == _np_chain_ref(data, mid, cust, reg, "reg")
+
+    def test_tpch_chain_specs_match_numpy_reference(self):
+        # the gauntlet's adapted Q5 chain at tiny scale: counts exact,
+        # revenue within float tolerance of the numpy reference
+        from yugabyte_db_tpu.models.tpch import (
+            _chain_group, chain_build_wires, generate_customer,
+            generate_lineitem, generate_orders_cust, lineitem_join_data,
+            lineitem_join_info, numpy_reference_chain, tpch_q5_chain)
+        data = generate_lineitem(0.002)
+        n_orders, n_cust = 3000, 300
+        odata = generate_orders_cust(n_orders, n_cust)
+        cdata = generate_customer(n_cust)
+        ldata = lineitem_join_data(data, n_orders)
+        t = Tablet("li-wq", lineitem_join_info(),
+                   tempfile.mkdtemp(prefix="wq-li-"))
+        t.bulk_load(ldata, block_rows=8192)
+        q = tpch_q5_chain()
+        wires = chain_build_wires(q, odata, cdata)
+        flags.set_flag("tpu_min_rows_for_pushdown", 0)
+        r = ReadRequest("lineitem_j", where=q.probe_where,
+                        aggregates=q.aggs,
+                        group_by=_chain_group(q.group_col), join=wires)
+        resp = t.read(read_request_from_wire(read_request_to_wire(r)))
+        assert resp.backend == "tpu"
+        ref = numpy_reference_chain(q, ldata, odata, cdata)
+        got = _by_key(resp)
+        for g, (cnt, rev) in ref.items():
+            have = got.get((str(g),))
+            if cnt == 0:
+                assert have is None
+                continue
+            assert have[0] == cnt, g
+            assert abs(have[1] - rev) <= 1e-6 * max(abs(rev), 1.0), g
+
+
+# --- per-stage typed fallback: the WHOLE query falls back -------------------
+
+class TestPerStageTypedFallback:
+    def test_duplicate_key_names_its_stage(self):
+        mid, cust, _ = _mid_tables()
+        cust_dup = dict(cust)
+        cust_dup["ck"] = cust["ck"].copy()
+        cust_dup["ck"][5] = cust_dup["ck"][4]      # stage-1 duplicate
+        wires = _chain_wires(mid, cust_dup)
+        with pytest.raises(JoinIneligible) as ei:
+            make_join_runtimes(wires, {})
+        assert ei.value.reason == REASON_DUPLICATE_KEY
+        assert ei.value.stage == 1
+
+    def test_stage1_refusal_falls_back_whole_bit_identical(self):
+        t, _ = _probe_tablet("fall1-")
+        mid, cust, _ = _mid_tables()
+        cust_dup = dict(cust)
+        cust_dup["ck"] = cust["ck"].copy()
+        cust_dup["ck"][5] = cust_dup["ck"][4]
+        wires = _chain_wires(mid, cust_dup)
+        flags.set_flag("tpu_min_rows_for_pushdown", 0)
+        from yugabyte_db_tpu.ops.join_scan import JOIN_STATS
+        f0 = JOIN_STATS["fallbacks"]
+        resp = t.read(_req(wires, SEG))
+        # stage 1 refused -> the WHOLE query serves interpreted, and
+        # the refusal is tallied, never silent
+        assert resp.backend == "cpu"
+        assert JOIN_STATS["fallbacks"] == f0 + 1
+        flags.set_flag("join_pushdown_enabled", False)
+        interp = t.read(_req(wires, SEG))
+        assert _by_key(resp) == _by_key(interp)
+
+    def test_stage_budget_typed_then_whole_query_intact(self):
+        t, _ = _probe_tablet("budget-")
+        mid, cust, _ = _mid_tables()
+        wires = _chain_wires(mid, cust)
+        with pytest.raises(JoinIneligible) as ei:
+            make_join_runtimes(wires, {}, max_stages=1)
+        assert ei.value.reason == REASON_STAGE_COUNT
+        flags.set_flag("tpu_min_rows_for_pushdown", 0)
+        flags.set_flag("multi_join_max_stages", 1)
+        over = t.read(_req(wires, SEG))
+        assert over.backend == "cpu"        # typed fallback, whole
+        flags.REGISTRY.reset("multi_join_max_stages")
+        dev = t.read(_req(wires, SEG))
+        assert dev.backend == "tpu"
+        assert _by_key(over) == _by_key(dev)
+
+
+# --- the acceptance contract: one compile, >=20 launches, 2x growth ---------
+
+class TestGrowthNeverRecompiles:
+    def test_chain_one_compile_across_launches_and_growth(self):
+        # every chunk of the streamed scan shares one pow2 bucket, so
+        # the 3-table chain keeps ONE plan signature across 20+
+        # launches, 2x probe-side growth and within-bucket build growth
+        # (4+ chunks each, so BOTH tablets take the streaming route —
+        # under min_chunks the monolithic twin pads to the full scan)
+        flags.set_flag("tpu_min_rows_for_pushdown", 0)
+        flags.set_flag("streaming_chunk_rows", 2048)
+        t_a, _ = _probe_tablet("grow-a-", n=8192, block_rows=2048)
+        t_b, _ = _probe_tablet("grow-b-", n=16384, block_rows=2048)
+        mid, cust, reg = _mid_tables()
+        wires = _chain_wires(mid, cust, reg)
+        kern = default_plan_kernel()
+        c0, l0 = kern.compiles, kern.launches
+        for _ in range(20):
+            r = t_a.read(_req(wires, RNAME))
+            assert r.backend == "tpu"
+        assert kern.compiles - c0 == 1, "launches must share one program"
+        # 2x data growth: more chunks, same chunk bucket, same signature
+        r = t_b.read(_req(wires, RNAME))
+        assert r.backend == "tpu"
+        assert kern.compiles - c0 == 1, "2x growth recompiled"
+        # build-side growth WITHIN the pow2 bucket (400 -> 500 rows pads
+        # to the same 512/1024 buckets): still the same signature
+        mid2, _, _ = _mid_tables(n_mid=500)
+        r = t_a.read(_req(_chain_wires(mid2, cust, reg), RNAME))
+        assert r.backend == "tpu"
+        assert kern.compiles - c0 == 1, "in-bucket build growth recompiled"
+        assert kern.launches - l0 >= 20
+        assert all(v == 1 for v in kern.sig_compiles.values()), \
+            "some plan signature compiled more than once"
+
+
+# --- server-side window pushdown --------------------------------------------
+
+def _window_tablet(prefix, n=300):
+    schema = TableSchema((
+        ColumnSchema(0, "k", ColumnType.INT64, is_hash_key=True),
+        ColumnSchema(1, "g", ColumnType.INT64),
+        ColumnSchema(2, "v", ColumnType.INT64),
+        ColumnSchema(3, "f", ColumnType.FLOAT64),
+    ), 1)
+    info = TableInfo("w", "w", schema, PartitionSchema("hash", 1))
+    t = Tablet("w", info, tempfile.mkdtemp(prefix=prefix))
+    k = np.arange(n, dtype=np.int64)
+    t.bulk_load({
+        "k": k,
+        "g": (k % 5).astype(np.int64),
+        # unique order keys per partition: no tie ambiguity for lag
+        "v": ((k * 7919) % 100003).astype(np.int64),
+        "f": (k * 0.5),
+    }, block_rows=128)
+    return t
+
+
+_WIN_WIRE = WindowWire(
+    partition_by=("g",), order_by=(("v", False),),
+    items=(("rank", 0, None, "rk"), ("sum", 1, "v", "s"),
+           ("lag", 1, "v", "lg"), ("count_star", 1, None, "cs")))
+
+
+def _win_req(wire=_WIN_WIRE, limit=None, where=(C(2) >= 0).node):
+    r = ReadRequest("w", columns=("k", "g", "v"), where=where,
+                    window=wire, limit=limit)
+    return read_request_from_wire(read_request_to_wire(r))
+
+
+def _py_window_ref(rows):
+    """Independent Python fold: per partition sorted by v (unique), so
+    rank == row index + 1, cumulative sum/count and lag are exact."""
+    from collections import defaultdict
+    parts = defaultdict(list)
+    for r in rows:
+        parts[r["g"]].append(r)
+    out = {}
+    for rs in parts.values():
+        rs = sorted(rs, key=lambda r: r["v"])
+        run = 0
+        for i, r in enumerate(rs):
+            run += r["v"]
+            out[r["k"]] = {"rk": i + 1, "s": run,
+                           "lg": rs[i - 1]["v"] if i > 0 else None,
+                           "cs": i + 1}
+    return out
+
+
+class TestServerWindowPushdown:
+    def test_served_rows_match_python_reference(self):
+        t = _window_tablet("win-")
+        resp = t.read(_win_req())
+        assert resp.window_served and resp.window_reason is None
+        ref = _py_window_ref([{k: r[k] for k in ("k", "g", "v")}
+                              for r in resp.rows])
+        for r in resp.rows:
+            want = ref[r["k"]]
+            got = {c: r[c] for c in ("rk", "s", "lg", "cs")}
+            assert got == want, r["k"]
+
+    def test_order_key_ties_peers_share(self):
+        # with order-key ties the cumulative frame is PG's RANGE frame:
+        # peers share the peer-group-end value; rank counts strictly
+        # smaller keys + 1 — both are tie-order independent
+        t = _window_tablet("win-tie-", n=64)
+        wire = WindowWire(partition_by=(), order_by=(("g", False),),
+                          items=(("rank", 0, None, "rk"),
+                                 ("sum", 1, "v", "s"),
+                                 ("count", 1, "v", "c")))
+        resp = t.read(_win_req(wire=wire))
+        assert resp.window_served
+        rows = resp.rows
+        for r in rows:
+            below = [x for x in rows if x["g"] < r["g"]]
+            at = [x for x in rows if x["g"] <= r["g"]]
+            assert r["rk"] == len(below) + 1
+            assert r["s"] == sum(x["v"] for x in at)
+            assert r["c"] == len(at)
+
+    def test_flag_off_typed_refusal(self):
+        t = _window_tablet("win-off-", n=64)
+        flags.set_flag("window_server_pushdown_enabled", False)
+        f0 = WINDOW_STATS["fallbacks"]
+        resp = t.read(_win_req())
+        assert not resp.window_served
+        assert resp.window_reason == "window_server_off"
+        assert WINDOW_STATS["fallbacks"] == f0 + 1
+        assert all("rk" not in r for r in resp.rows)   # plain rows
+
+    def test_limit_typed_refusal(self):
+        # a limited scan serves a row SUBSET: frames need every
+        # partition row, so the server refuses typed and serves plain
+        t = _window_tablet("win-lim-", n=64)
+        resp = t.read(_win_req(limit=10))
+        assert not resp.window_served
+        assert resp.window_reason == "window_paged_scan"
+        assert all("rk" not in r for r in resp.rows)
+
+    def test_value_kind_typed_refusal(self):
+        # float value lane: segment sums would not be bit-identical to
+        # the Python fold, so the shape refuses typed
+        t = _window_tablet("win-f-", n=64)
+        wire = WindowWire(partition_by=("g",),
+                          order_by=(("v", False),),
+                          items=(("sum", 1, "f", "sf"),))
+        r = ReadRequest("w", columns=("k", "g", "v", "f"),
+                        where=(C(2) >= 0).node, window=wire)
+        resp = t.read(read_request_from_wire(read_request_to_wire(r)))
+        assert not resp.window_served
+        assert resp.window_reason == "window_value_kind"
+        assert all("sf" not in r for r in resp.rows)
+
+
+# --- SQL: whole-query chain + window pushdown through the cluster ----------
+
+class TestSqlWholeQuery:
+    def _run(self, coro):
+        return asyncio.run(coro)
+
+    def test_sql_three_table_chain_fused_vs_classic(self, tmp_path):
+        from yugabyte_db_tpu.ql import SqlSession
+        from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+        from yugabyte_db_tpu.ops.plan_fusion import PLAN_STATS
+
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute(
+                    "CREATE TABLE facts (k bigint, fk bigint, v bigint,"
+                    " PRIMARY KEY (k))")
+                await s.execute(
+                    "CREATE TABLE mid (mk bigint, mck bigint, mw bigint,"
+                    " PRIMARY KEY (mk))")
+                await s.execute(
+                    "CREATE TABLE cust (ck bigint, cseg text,"
+                    " PRIMARY KEY (ck))")
+                # fk=i%9 dangles for mid keys >= 7; NULL + dangling mck
+                vals = ",".join(f"({i}, {i % 9}, {(i * 3) % 13})"
+                                for i in range(420))
+                await s.execute(
+                    "INSERT INTO facts (k, fk, v) VALUES " + vals)
+                mrows = []
+                for d in range(7):
+                    mck = ("NULL" if d == 2
+                           else "9" if d == 5      # dangling (no cust 9)
+                           else str(d % 4))
+                    mrows.append(f"({d}, {mck}, {d * 10})")
+                await s.execute("INSERT INTO mid (mk, mck, mw) VALUES "
+                                + ",".join(mrows))
+                await s.execute(
+                    "INSERT INTO cust (ck, cseg) VALUES (0,'a'),"
+                    "(1,'b'),(2,'a'),(3,'c')")
+                flags.set_flag("tpu_min_rows_for_pushdown", 0)
+                q = ("SELECT cseg, count(*) AS c, sum(v) AS sv, "
+                     "sum(mw) AS sw FROM facts "
+                     "JOIN mid ON fk = mk JOIN cust ON mck = ck "
+                     "WHERE v > 2 GROUP BY cseg ORDER BY cseg")
+                l0 = PLAN_STATS["launches"]
+                r1 = (await s.execute(q)).rows
+                assert PLAN_STATS["launches"] > l0, \
+                    "3-table chain never reached the plan kernel"
+                assert LAST_PLAN_STATS.get("join_stages") == 2
+                flags.set_flag("plan_fusion_enabled", False)
+                r2 = (await s.execute(q)).rows
+                # integer lanes: the classic client join answer is
+                # IDENTICAL — NULL and dangling FKs drop per stage
+                assert r1 == r2
+                assert r1, "chain produced no groups"
+            finally:
+                await mc.shutdown()
+        self._run(go())
+
+    def test_bypass_window_request_shape_typed_fallback(self, tmp_path):
+        # the bypass engine serves whole-tablet AGGREGATES only: a
+        # row+window request falls back to the RPC scan with the typed
+        # "request_shape" reason — and the RPC path still serves the
+        # window, so the refusal costs a route, never the answer
+        from yugabyte_db_tpu.ql import SqlSession
+        from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute("CREATE TABLE wb (k bigint, g bigint, "
+                                "v bigint, PRIMARY KEY (k))")
+                vals = ",".join(f"({i}, {i % 3}, {(i * 11) % 97})"
+                                for i in range(60))
+                await s.execute("INSERT INTO wb (k, g, v) VALUES "
+                                + vals)
+                c = mc.client()
+                flags.set_flag("bypass_reader_enabled", True)
+                wire = WindowWire(partition_by=("g",),
+                                  order_by=(("v", False),),
+                                  items=(("rank", 0, None, "rk"),))
+                req = ReadRequest("", columns=("k", "g", "v"),
+                                  window=wire)
+                resp = await c.scan_bypass("wb", req)
+                assert c.last_bypass["used"] is False
+                assert c.last_bypass["reason"] == "request_shape"
+                assert resp.window_served
+                assert all("rk" in r for r in resp.rows)
+            finally:
+                await mc.shutdown()
+        self._run(go())
+
+    def test_sql_window_server_served_and_parity(self, tmp_path):
+        from yugabyte_db_tpu.ql import SqlSession
+        from yugabyte_db_tpu.tools.mini_cluster import MiniCluster
+
+        async def go():
+            mc = await MiniCluster(str(tmp_path), num_tservers=1).start()
+            try:
+                s = SqlSession(mc.client())
+                await s.execute("CREATE TABLE wt (k bigint, g bigint, "
+                                "v bigint, PRIMARY KEY (k))")
+                vals = ",".join(f"({i}, {i % 4}, {(i * 7919) % 1009})"
+                                for i in range(120))
+                await s.execute("INSERT INTO wt (k, g, v) VALUES "
+                                + vals)
+                q = ("SELECT k, rank() OVER (PARTITION BY g ORDER BY v)"
+                     " AS rk, sum(v) OVER (PARTITION BY g ORDER BY v)"
+                     " AS sv, lag(v) OVER (PARTITION BY g ORDER BY v)"
+                     " AS lg FROM wt ORDER BY k")
+
+                def _boom(*a, **kw):   # pragma: no cover - must not run
+                    raise AssertionError(
+                        "client recompute ran: server did not serve")
+                orig = s._apply_windows
+                s._apply_windows = _boom
+                try:
+                    r1 = (await s.execute(q)).rows
+                finally:
+                    s._apply_windows = orig
+                # flag off: the wire never ships, the client tier
+                # recomputes — bit-identical rows either way
+                flags.set_flag("window_server_pushdown_enabled", False)
+                r2 = (await s.execute(q)).rows
+                assert r1 == r2
+            finally:
+                await mc.shutdown()
+        self._run(go())

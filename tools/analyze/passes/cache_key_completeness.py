@@ -120,6 +120,11 @@ REGISTRY: Tuple[dict, ...] = (
             "join_max_build_slots": "join runtime is rebuilt every "
                                     "call OUTSIDE the cached lambda — "
                                     "only build_batch(kept) is keyed",
+            "multi_join_max_stages": "stage-count gate raises a typed "
+                                     "JoinIneligible BEFORE any cache "
+                                     "touch; runtimes are rebuilt "
+                                     "every call outside the cached "
+                                     "lambda",
         },
         "must_mention": [
             ("prune_key", "zone-pruned block list identity"),
@@ -155,6 +160,12 @@ REGISTRY: Tuple[dict, ...] = (
             ("strategy", "grouped-path choice bakes into the kernel"),
             ("col_sig", "column dtype/shape identity"),
             ("join_shape", "build-side shape identity"),
+            ("build_buckets", "per-STAGE pow2 build buckets — a "
+                              "multi-join chain must re-key when any "
+                              "one stage crosses a table bucket"),
+            ("dict_sig", "per-stage dict-coded payload lanes — which "
+                         "lanes carry codes changes rewrite/decode "
+                         "semantics downstream"),
             ("mvcc_mode", "visibility mode changes the kernel body"),
             ("static_sums", "const-folded sum lanes change the body"),
             ("padded_rows", "pow2 pad bucket is a compile-time shape"),

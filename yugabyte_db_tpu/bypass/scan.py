@@ -279,7 +279,13 @@ def bypass_plan_aggregate(
     elif group is not None:
         needed.update(cid for cid, _, _ in group.cols)
     needed = {c for c in needed if c < BUILD_COL_BASE}
-    needed.add(join_wire.probe_col)
+    # multi-stage chains: only REAL probe-table columns gather from
+    # blocks — a chain stage's probe lane is an earlier stage's payload
+    # (>= BUILD_COL_BASE) and materializes inside the fused program
+    from ..ops.join_scan import normalize_join
+    for w in normalize_join(join_wire):
+        if w.probe_col < BUILD_COL_BASE:
+            needed.add(w.probe_col)
     for b in blocks:
         for cid in needed:
             if not (cid in b.fixed or cid in b.pk or cid in b.varlen):

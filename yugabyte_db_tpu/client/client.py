@@ -755,8 +755,8 @@ class YBClient:
         ct = await self._table(table)
         req.table_id = ct.info.table_id
 
-        async def one(loc: TabletLocation,
-                      ct2: CachedTable) -> ReadResponse:
+        async def one(loc: TabletLocation, ct2: CachedTable,
+                      window=None) -> ReadResponse:
             rows: List[dict] = []
             paging = None
             first: Optional[ReadResponse] = None
@@ -766,7 +766,7 @@ class YBClient:
                     aggregates=req.aggregates, group_by=req.group_by,
                     limit=req.limit, paging_state=paging,
                     read_ht=req.read_ht, consistency=req.consistency,
-                    join=req.join)
+                    join=req.join, window=window)
                 payload = {"tablet_id": loc.tablet_id,
                            "req": read_request_to_wire(r)}
                 resp = read_response_from_wire(await self._call_leader(
@@ -783,8 +783,13 @@ class YBClient:
             return first
 
         async def go(ct2):
+            # the server-side window pushdown only holds on a single
+            # tablet (a window spans the whole table); with fan-out > 1
+            # per-tablet copies DROP the window so servers don't burn
+            # compute on partials the client must redo anyway
+            win = req.window if len(ct2.locations) == 1 else None
             parts = await asyncio.gather(
-                *[one(l, ct2) for l in ct2.locations])
+                *[one(l, ct2, win) for l in ct2.locations])
             return self._combine(req, parts)
         return await self._retry_on_split(table, go)
 
@@ -889,10 +894,33 @@ class YBClient:
                  ) -> ReadResponse:
         if not req.aggregates:
             rows = [r for p in parts for r in p.rows]
+            served, reason = False, None
+            if req.window is not None:
+                served = len(parts) == 1 and parts[0].window_served
+                reason = parts[0].window_reason if parts else None
+                if not served:
+                    # fan-out (or a per-tablet refusal): the parts hold
+                    # COMPLETE plain rows, so run the same serving
+                    # helper over the union — the helper sorts
+                    # internally, no stream merge needed.  Typed
+                    # refusal -> the executor's interpreted windows.
+                    from ..ops.window_scan import (REASON_WINDOW_PAGED,
+                                                   WindowIneligible,
+                                                   serve_window_rows)
+                    try:
+                        if req.limit is not None:
+                            raise WindowIneligible(
+                                REASON_WINDOW_PAGED, "limit")
+                        serve_window_rows(req.window, rows)
+                        served, reason = True, None
+                    except WindowIneligible as e:
+                        served, reason = False, e.reason
             if req.limit is not None:
                 rows = rows[:req.limit]
             return ReadResponse(rows=rows,
-                                backend=parts[0].backend if parts else "cpu")
+                                backend=parts[0].backend if parts else "cpu",
+                                window_served=served,
+                                window_reason=reason)
         from ..ops.grouped_scan import DictGroupSpec
         from ..ops.scan import (HashGroupSpec, _expand_avg,
                                 combine_grouped_partials)

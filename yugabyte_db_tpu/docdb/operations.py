@@ -84,12 +84,21 @@ class ReadRequest:
     aggregates: Tuple[AggSpec, ...] = ()     # aggregate pushdown
     group_by: Optional[GroupSpec] = None
     # FK-equijoin pushdown: the (small, pre-filtered) build side ships
-    # WITH the request (ops/join_scan.JoinWire) — keys + payload
-    # columns, referenced from `aggregates`/`group_by` at ids >=
-    # BUILD_COL_BASE.  Aggregate requests only; `where` stays a
-    # probe-side predicate (build-side filters are applied by the
-    # sender before shipping the build rows).
+    # WITH the request — ONE ops/join_scan.JoinWire or an ordered
+    # sequence of them (multi-join chains/stars: N probe stages, probed
+    # in order inside one fused program) — keys + payload columns,
+    # referenced from `aggregates`/`group_by` at ids >= BUILD_COL_BASE.
+    # Aggregate requests only; `where` stays a probe-side predicate
+    # (build-side filters are applied by the sender before shipping
+    # the build rows).
     join: Optional[object] = None
+    # server-side window pushdown: a sorted-scan spec
+    # (ops/window_scan.WindowWire) for ROW requests — the tablet sorts
+    # its visible post-WHERE rows by (partition, order) and attaches
+    # the window values via the segment-scan kernels; ineligible
+    # shapes serve plain rows with a typed reason and the client tier
+    # recomputes bit-identically
+    window: Optional[object] = None
     pk_eq: Optional[Dict[str, object]] = None  # full-PK point lookup
     pk_prefix: Optional[Dict[str, object]] = None  # hash-cols prefix scan
     limit: Optional[int] = None
@@ -115,6 +124,11 @@ class ReadResponse:
     group_values: Optional[tuple] = None
     paging_state: Optional[bytes] = None
     backend: str = "cpu"                      # which path executed
+    # window pushdown outcome: True when `rows` already carry the
+    # request's window values (computed tablet-side); on refusal the
+    # typed reason rides back so the caller can tally it
+    window_served: bool = False
+    window_reason: Optional[str] = None
 
 
 # --------------------------------------------------------------------------
@@ -1174,7 +1188,7 @@ class DocReadOperation:
                 and req.paging_state is None):
             got = self._hash_enumerated_read(req)
             if got is not None:
-                return got
+                return self._serve_window(req, got)
         if req.aggregates and self._tpu_eligible(req):
             resp = self._execute_tpu_aggregate(req)
             if resp is not None:
@@ -1183,8 +1197,42 @@ class DocReadOperation:
                 and req.paging_state is None and self._tpu_eligible(req)):
             resp = self._execute_tpu_filter(req)
             if resp is not None:
-                return resp
-        return self._execute_cpu(req)
+                return self._serve_window(req, resp)
+        return self._serve_window(req, self._execute_cpu(req))
+
+    def _serve_window(self, req: ReadRequest,
+                      resp: ReadResponse) -> ReadResponse:
+        """Server-side window pushdown boundary: a row response whose
+        request carries a WindowWire gets its window values attached
+        HERE, over the tablet's own visible post-WHERE rows
+        (ops/window_scan.serve_window_rows — the same sort codes and
+        segment-scan kernels the executor's device hook runs, so the
+        served values are bitwise what the client tier would compute).
+        Every refusal is typed on the response (window_reason) and the
+        rows serve plain — the executor recomputes bit-identically,
+        never silently."""
+        if req.window is None or req.aggregates:
+            return resp
+        from ..ops.window_scan import (REASON_WINDOW_OFF,
+                                       REASON_WINDOW_PAGED,
+                                       WINDOW_STATS, WindowIneligible,
+                                       serve_window_rows)
+        try:
+            if not flags.get("window_server_pushdown_enabled"):
+                raise WindowIneligible(REASON_WINDOW_OFF)
+            if req.paging_state is not None or req.limit is not None \
+                    or resp.paging_state is not None:
+                # a paged/limited scan serves a row SUBSET: window
+                # frames need every partition row, so those shapes
+                # always recompute above
+                raise WindowIneligible(REASON_WINDOW_PAGED)
+            serve_window_rows(req.window, resp.rows)
+        except WindowIneligible as e:
+            WINDOW_STATS["fallbacks"] += 1
+            resp.window_reason = e.reason
+            return resp
+        resp.window_served = True
+        return resp
 
     def _prefix_scan(self, req: ReadRequest) -> ReadResponse:
         """All visible rows whose doc key starts with the hash prefix
@@ -1698,6 +1746,20 @@ class DocReadOperation:
         tomb = np.concatenate([b.tombstone for b in blocks])
         vis = (ht <= np.uint64(read_ht)) & ~tomb
         sel = np.flatnonzero(vis & ~gnull & (gid >= spill_slot))
+        return self._spill_merge_tail(req, blocks, sel, aggs_run,
+                                      expanded, minmax, dev_part)
+
+    def _spill_merge_tail(self, req: ReadRequest, blocks, sel,
+                          aggs_run, expanded, minmax, dev_part
+                          ) -> Optional[ReadResponse]:
+        """Shared spill-merge tail (streamed AND monolithic routes):
+        gather the spilled rows from the columnar blocks, re-aggregate
+        them on the interpreted fold (same WHERE), and union with the
+        exact device partials through the group-keyed combine.  The
+        partials are DISJOINT by construction (a group's id is fixed:
+        either in range or spilled).  None when the gather can't run —
+        caller reverts to the full interpreted re-scan."""
+        spec = req.group_by
         schema = self.codec.schema
         from ..ops.expr import referenced_columns
         needed = set(spec.cols)
@@ -1737,6 +1799,45 @@ class DocReadOperation:
         return ReadResponse(agg_values=outs_f,
                             group_counts=merged_counts,
                             group_values=merged_gvals, backend="tpu")
+
+    def _monolithic_spill_merge(self, req: ReadRequest, gspec, batch,
+                                blocks, expanded, minmax, aggs_run,
+                                outs, counts, mask
+                                ) -> Optional[ReadResponse]:
+        """Monolithic twin of the partial-spill merge (ROADMAP TPC-H
+        item (c)): the dict-group host codes are ALREADY device lanes
+        in ``batch.cols``, and the kernel's returned row mask already
+        folds visibility, WHERE, and group-key nulls — so the spilled
+        row set is just mask & (gid >= spill_slot) replayed host-side,
+        no second device pass.  Slots below the spill slot keep their
+        exact partials; the spilled rows re-aggregate on the shared
+        interpreted tail."""
+        from ..ops.grouped_scan import decode_slot_groups, resolve_group
+        n = batch.n_rows
+        try:
+            resolved, domains = resolve_group(gspec, batch.dicts)
+        except KeyError:
+            return None
+        spill_slot = resolved.num_slots - 1
+        gid = np.zeros(n, np.int64)
+        stride = 1
+        for cid, dom in zip(gspec.cols, domains):
+            if cid not in batch.cols:
+                return None
+            gid += np.asarray(batch.cols[cid])[:n].astype(np.int64) \
+                * stride
+            stride *= dom
+        counts_hot = np.asarray(counts).copy()
+        counts_hot[spill_slot:] = 0
+        dev_outs = dict_minmax_decode(
+            tuple(aggs_run), [np.asarray(o) for o in outs],
+            batch.dicts)
+        dev_part = decode_slot_groups(gspec, batch.dicts, dev_outs,
+                                      counts_hot)
+        sel = np.flatnonzero(np.asarray(mask)[:n]
+                             & (gid >= spill_slot))
+        return self._spill_merge_tail(req, blocks, sel, aggs_run,
+                                      expanded, minmax, dev_part)
 
     def _check_restart_window(self, blocks, read_ht: int) -> None:
         """Raise ReadRestartError when any block holds a record inside
@@ -1836,9 +1937,22 @@ class DocReadOperation:
             if any(c not in batch.dicts for c in gspec.cols) or \
                     domain_product(gspec, batch.dicts) >= 2 ** 31:
                 return None     # no dictionary / gid would wrap: CPU
-            outs, counts, _, spill = self.kernel.run(
+            outs, counts, mask, spill = self.kernel.run(
                 batch, where, aggs_run, gspec, read_ht)
             if int(spill) > 0:
+                # slot overflow on the MONOLITHIC dict-group route:
+                # same partial-spill merge as the streamed path — keep
+                # the exact in-range device partials, re-aggregate only
+                # the spilled rows on the interpreted fold.  The kernel
+                # mask already folds visibility/WHERE/group-null, so
+                # the spilled row set replays host-side for free.
+                if flags.get("grouped_spill_merge_enabled"):
+                    resp = self._monolithic_spill_merge(
+                        req, gspec, batch, kept, expanded, minmax,
+                        aggs_run, outs, counts, mask)
+                    if resp is not None:
+                        GROUPED_STATS["spill_merges"] += 1
+                        return resp
                 GROUPED_STATS["spill_fallbacks"] += 1
                 return None     # slot overflow: interpreted GROUP BY
             outs_c, counts_c, gvals = decode_slot_groups(
@@ -1909,8 +2023,13 @@ class DocReadOperation:
             needed.update(group.cols)
         elif group is not None:
             needed.update(cid for cid, _, _ in group.cols)
+        from ..ops.join_scan import normalize_join
         needed = {c for c in needed if c < BUILD_COL_BASE}
-        needed.add(req.join.probe_col)
+        for w in normalize_join(req.join):
+            # chain stages probe an EARLIER stage's payload lane
+            # (>= BUILD_COL_BASE) — only real probe-table FKs scan
+            if w.probe_col < BUILD_COL_BASE:
+                needed.add(w.probe_col)
         read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
         from ..ops.scan import _expand_avg
         expanded = tuple(_expand_avg(req.aggregates))
@@ -1996,50 +2115,64 @@ class DocReadOperation:
 
     def _execute_join_cpu(self, req: ReadRequest) -> ReadResponse:
         """Interpreted FK-equijoin aggregate: row-at-a-time probe scan,
-        a Python dict over the shipped build keys, payload values
-        merged into the row under their build-column ids — the
-        correctness reference the fused plan is tested against and the
-        fallback for every ineligible shape."""
-        wire = req.join
+        a Python dict over each stage's shipped build keys, payload
+        values merged into the row under their build-column ids, stages
+        folded LEFT TO RIGHT (a chain stage probes a payload column an
+        earlier stage merged in) — the correctness reference the fused
+        plan is tested against and the fallback for every ineligible
+        shape, one wire or many."""
+        from ..ops.join_scan import normalize_join
+        wires = normalize_join(req.join)
         read_ht = req.read_ht if req.read_ht is not None else _MAX_HT
-        keys = np.asarray(wire.keys)
-        # key -> ALL matching build rows: duplicate build keys (a shape
-        # the device path refuses with a typed reason) keep full SQL
-        # inner-join semantics here — one output row per matching build
-        # row, never a silent last-wins overwrite
-        lookup: Dict[object, list] = {}
-        for i in range(len(keys)):
-            k = keys[i]
-            lookup.setdefault(
-                k.item() if isinstance(k, np.generic) else k,
-                []).append(i)
-        payload = {}
-        for bid, (vals, nls) in wire.payload.items():
-            vals = np.asarray(vals)
-            nls = (np.asarray(nls, bool) if nls is not None
-                   else np.zeros(len(keys), bool))
-            payload[bid] = (vals, nls)
+        stages = []
+        for wire in wires:
+            keys = np.asarray(wire.keys)
+            # key -> ALL matching build rows: duplicate build keys (a
+            # shape the device path refuses with a typed reason) keep
+            # full SQL inner-join semantics here — one output row per
+            # matching build row, never a silent last-wins overwrite
+            lookup: Dict[object, list] = {}
+            for i in range(len(keys)):
+                k = keys[i]
+                lookup.setdefault(
+                    k.item() if isinstance(k, np.generic) else k,
+                    []).append(i)
+            payload = {}
+            for bid, (vals, nls) in wire.payload.items():
+                vals = np.asarray(vals)
+                nls = (np.asarray(nls, bool) if nls is not None
+                       else np.zeros(len(keys), bool))
+                payload[bid] = (vals, nls)
+            stages.append((wire.probe_col, lookup, payload))
         aggs = list(_expand_avg_cpu(req.aggregates))
         agg_state = [_agg_init(a) for a in aggs]
         group_state: Dict[object, list] = {}
-        probe_col = wire.probe_col
+
+        def fold(idrow, si):
+            if si == len(stages):
+                _agg_accumulate(aggs, agg_state, group_state,
+                                req.group_by, idrow)
+                return
+            probe_col, lookup, payload = stages[si]
+            fk = idrow.get(probe_col)
+            if fk is None:
+                return                   # NULL FK never matches
+            matches = lookup.get(fk)
+            if matches is None:
+                return                   # dangling FK: inner join drops
+            for bi in matches:
+                r2 = dict(idrow) if len(matches) > 1 else idrow
+                for bid, (vals, nls) in payload.items():
+                    bv = vals[bi]
+                    r2[bid] = None if nls[bi] else (
+                        bv.item() if isinstance(bv, np.generic) else bv)
+                fold(r2, si + 1)
+
         for idrow in self._iter_visible_idrows(read_ht):
             if req.where is not None and \
                     eval_expr_py(req.where, idrow) is not True:
                 continue
-            fk = idrow.get(probe_col)
-            if fk is None:
-                continue                 # NULL FK never matches
-            matches = lookup.get(fk)
-            if matches is None:
-                continue                 # dangling FK: inner join drops
-            for bi in matches:
-                for bid, (vals, nls) in payload.items():
-                    bv = vals[bi]
-                    idrow[bid] = None if nls[bi] else (
-                        bv.item() if isinstance(bv, np.generic) else bv)
-                _agg_accumulate(aggs, agg_state, group_state,
-                                req.group_by, idrow)
+            fold(idrow, 0)
         if req.group_by is not None:
             return _grouped_cpu_response(aggs, group_state,
                                          req.group_by)

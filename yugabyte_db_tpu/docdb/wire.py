@@ -11,7 +11,7 @@ from typing import Optional
 import numpy as np
 
 from ..ops.grouped_scan import DictGroupSpec
-from ..ops.join_scan import JoinWire
+from ..ops.join_scan import JoinWire, normalize_join
 from ..ops.scan import AggSpec, GroupSpec, HashGroupSpec
 from .operations import ReadRequest, ReadResponse, RowOp, WriteRequest, \
     WriteResponse
@@ -51,6 +51,52 @@ def _join_to_wire(j: JoinWire) -> dict:
             "keys": wkeys,
             "key_kind": kind,
             "payload": payload}
+
+
+def _joins_to_wire(join):
+    """ReadRequest.join -> wire: a single JoinWire ships as the legacy
+    stage dict; a multi-stage chain ships as an ORDERED list of stage
+    dicts (probe order is the plan's semantics — the codec must keep
+    it)."""
+    if join is None:
+        return None
+    stages = normalize_join(join)
+    if len(stages) == 1:
+        return _join_to_wire(stages[0])
+    return [_join_to_wire(w) for w in stages]
+
+
+def _joins_from_wire(d):
+    """Wire -> ReadRequest.join: legacy dict -> single JoinWire,
+    1-element list -> single JoinWire (so ``req.join.probe_col``
+    callers keep working), longer list -> ordered tuple of stages."""
+    if d is None:
+        return None
+    if isinstance(d, dict):
+        return _join_from_wire(d)
+    stages = tuple(_join_from_wire(s) for s in d)
+    return stages[0] if len(stages) == 1 else stages
+
+
+def _window_to_wire(w):
+    if w is None:
+        return None
+    return {"partition": list(w.partition_by),
+            "order": [[nm, bool(desc)] for nm, desc in w.order_by],
+            "items": [[head, int(param), vcol, out]
+                      for head, param, vcol, out in w.items]}
+
+
+def _window_from_wire(d):
+    if d is None:
+        return None
+    from ..ops.window_scan import WindowWire
+    return WindowWire(
+        partition_by=tuple(d.get("partition") or ()),
+        order_by=tuple((nm, bool(desc))
+                       for nm, desc in (d.get("order") or [])),
+        items=tuple((head, int(param), vcol, out)
+                    for head, param, vcol, out in (d.get("items") or [])))
 
 
 def _join_from_wire(d: Optional[dict]) -> Optional[JoinWire]:
@@ -140,8 +186,8 @@ def read_request_to_wire(req: ReadRequest) -> dict:
         "paging_state": req.paging_state,
         "read_ht": req.read_ht,
         "consistency": req.consistency,
-        "join": (_join_to_wire(req.join)
-                 if req.join is not None else None),
+        "join": _joins_to_wire(req.join),
+        "window": _window_to_wire(req.window),
     }
 
 
@@ -167,7 +213,8 @@ def read_request_from_wire(d: dict) -> ReadRequest:
         paging_state=d.get("paging_state"),
         read_ht=d.get("read_ht"),
         consistency=d.get("consistency", "strong"),
-        join=_join_from_wire(d.get("join")),
+        join=_joins_from_wire(d.get("join")),
+        window=_window_from_wire(d.get("window")),
     )
 
 
@@ -182,6 +229,8 @@ def read_response_to_wire(resp: ReadResponse) -> dict:
                          if resp.group_values is not None else None),
         "paging_state": resp.paging_state,
         "backend": resp.backend,
+        "window_served": resp.window_served,
+        "window_reason": resp.window_reason,
     }
 
 
@@ -196,4 +245,6 @@ def read_response_from_wire(d: dict) -> ReadResponse:
                       if d.get("group_values") is not None else None),
         paging_state=d.get("paging_state"),
         backend=d.get("backend", "cpu"),
+        window_served=bool(d.get("window_served", False)),
+        window_reason=d.get("window_reason"),
     )

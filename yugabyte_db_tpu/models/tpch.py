@@ -309,6 +309,323 @@ def numpy_reference_join(q: JoinQuerySpec,
     return out
 
 
+# ---------------------------------------------------------------------------
+# Whole-query gauntlet: customer dimension + multi-join chains + the
+# 22-query TPC-H registry (runnable adapted specs or TYPED inexpressible
+# reasons — a query the engine cannot serve is named, never silent)
+# ---------------------------------------------------------------------------
+
+C_CUSTKEY, C_MKTSEGMENT, C_NATION = 0, 1, 2
+
+#: appended column id on the orders_c clone (the chain FK to customer)
+O_CUSTKEY = 3
+
+#: TPC-H spec cardinalities per scale factor
+ORDERS_PER_SF = 1_500_000
+CUSTOMERS_PER_SF = 150_000
+
+MKTSEG_STRINGS = np.array(
+    ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"],
+    object)
+
+NATION_STRINGS = np.array(
+    ["ALGERIA", "ARGENTINA", "BRAZIL", "CANADA", "CHINA", "EGYPT",
+     "ETHIOPIA", "FRANCE", "GERMANY", "INDIA", "INDONESIA", "IRAN",
+     "IRAQ", "JAPAN", "JORDAN", "KENYA", "MOROCCO", "MOZAMBIQUE",
+     "PERU", "ROMANIA", "RUSSIA", "SAUDI ARABIA", "UNITED KINGDOM",
+     "UNITED STATES", "VIETNAM"], object)
+
+
+def customer_schema() -> TableSchema:
+    return TableSchema(columns=(
+        ColumnSchema(C_CUSTKEY, "c_custkey", ColumnType.INT64,
+                     is_range_key=True),
+        ColumnSchema(C_MKTSEGMENT, "c_mktsegment", ColumnType.STRING),
+        ColumnSchema(C_NATION, "c_nation", ColumnType.STRING),
+    ), version=1)
+
+
+def customer_info() -> TableInfo:
+    return TableInfo("customer", "customer", customer_schema(),
+                     PartitionSchema("range", 0))
+
+
+def orders_cust_schema() -> TableSchema:
+    """orders + the o_custkey FK — the middle table of the 3-table
+    chain (lineitem -> orders_c -> customer).  A separate clone so the
+    2-table workloads keep their original schema/signature."""
+    return TableSchema(columns=orders_schema().columns + (
+        ColumnSchema(O_CUSTKEY, "o_custkey", ColumnType.INT64),),
+        version=1)
+
+
+def orders_cust_info() -> TableInfo:
+    return TableInfo("orders_c", "orders_c", orders_cust_schema(),
+                     PartitionSchema("range", 0))
+
+
+def generate_customer(n_customers: int, seed: int = 2
+                      ) -> Dict[str, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    return {
+        "c_custkey": np.arange(n_customers, dtype=np.int64),
+        "c_mktsegment": MKTSEG_STRINGS[rng.integers(0, len(MKTSEG_STRINGS),
+                                                    n_customers)],
+        "c_nation": NATION_STRINGS[rng.integers(0, len(NATION_STRINGS),
+                                                n_customers)],
+    }
+
+
+def generate_orders_cust(n_orders: int, n_customers: int, seed: int = 1
+                         ) -> Dict[str, np.ndarray]:
+    out = generate_orders(n_orders, seed)
+    rng = np.random.default_rng(seed + 7)
+    out["o_custkey"] = rng.integers(0, max(n_customers, 1),
+                                    n_orders).astype(np.int64)
+    return out
+
+
+def chain_bids() -> Dict[str, int]:
+    """Fixed payload-lane ids for the lineitem->orders_c->customer
+    chain (one shared BUILD_COL_BASE counter, as the executor's
+    lowering pass assigns them)."""
+    from ..ops.join_scan import BUILD_COL_BASE
+    return {"o_custkey": BUILD_COL_BASE,
+            "o_orderpriority": BUILD_COL_BASE + 1,
+            "c_mktsegment": BUILD_COL_BASE + 2,
+            "c_nation": BUILD_COL_BASE + 3}
+
+
+@dataclass(frozen=True)
+class ChainQuerySpec:
+    """A 3-table fused chain: lineitem_j probes orders_c (stage 0, by
+    l_orderkey), then the o_custkey payload LANE probes customer
+    (stage 1) — one device program, one shared visibility mask.
+    Build-side filters (order date window, customer segment) are
+    applied by the sender; inner-join semantics make that equivalent to
+    a post-join predicate."""
+    name: str
+    probe_where: Optional[tuple]
+    order_date_lo: Optional[int]
+    order_date_hi: Optional[int]
+    cust_seg: Optional[str]
+    order_payload: Tuple[str, ...]      # extra stage-0 payload names
+    cust_payload: Tuple[str, ...]       # stage-1 payload names
+    group_col: str                      # payload name the group rides on
+    aggs: Tuple[AggSpec, ...]
+    probe_columns: Tuple[int, ...]
+
+
+#: Q3's cutoff date (1995-03-15)
+_Q3_CUT = 9204
+
+
+def _chain_group(group_col: str):
+    from ..ops.grouped_scan import DictGroupSpec
+    return DictGroupSpec(cols=(chain_bids()[group_col],))
+
+
+_REV = AggSpec("sum", (C(EXTPRICE) * (Expr.const(1.0)
+                                      - C(DISCOUNT))).node)
+
+
+def tpch_q3_chain() -> ChainQuerySpec:
+    """Q3 adapted: revenue by o_orderpriority for BUILDING-segment
+    customers, o_orderdate < 1995-03-15 < l_shipdate.  The spec's
+    GROUP BY l_orderkey (a 1.5M/SF domain) is lowered to the
+    dict-coded priority dimension — group_domain is the typed reason
+    the literal shape refuses."""
+    return ChainQuerySpec(
+        name="q3", probe_where=(C(SHIPDATE) > _Q3_CUT).node,
+        order_date_lo=None, order_date_hi=_Q3_CUT,
+        cust_seg="BUILDING",
+        order_payload=("o_orderpriority",), cust_payload=(),
+        group_col="o_orderpriority",
+        aggs=(_REV, AggSpec("count")),
+        probe_columns=(EXTPRICE, DISCOUNT, SHIPDATE, L_ORDERKEY))
+
+
+def tpch_q5_chain() -> ChainQuerySpec:
+    """Q5 adapted: 1994 revenue by customer nation.  The supplier/
+    nation/region legs are dropped (table_coverage) — nation rides as
+    a denormalized customer attribute."""
+    return ChainQuerySpec(
+        name="q5", probe_where=None,
+        order_date_lo=_D1994, order_date_hi=_D1995,
+        cust_seg=None,
+        order_payload=(), cust_payload=("c_nation",),
+        group_col="c_nation",
+        aggs=(_REV, AggSpec("count")),
+        probe_columns=(EXTPRICE, DISCOUNT, L_ORDERKEY))
+
+
+def tpch_q10_chain() -> ChainQuerySpec:
+    """Q10 adapted: returned-item (l_returnflag = 'R') revenue by
+    customer nation over one order quarter.  GROUP BY c_custkey
+    (150k/SF domain, top-20) is lowered to c_nation — group_domain is
+    the typed reason the literal shape refuses."""
+    return ChainQuerySpec(
+        name="q10",
+        probe_where=C(RETFLAG).eq(
+            int(np.flatnonzero(RETFLAG_STRINGS == "R")[0])).node,
+        order_date_lo=_D1994, order_date_hi=_D1994 + 91,
+        cust_seg=None,
+        order_payload=(), cust_payload=("c_nation",),
+        group_col="c_nation",
+        aggs=(_REV, AggSpec("count")),
+        probe_columns=(EXTPRICE, DISCOUNT, RETFLAG, L_ORDERKEY))
+
+
+def chain_build_wires(q: ChainQuerySpec,
+                      odata: Dict[str, np.ndarray],
+                      cdata: Dict[str, np.ndarray]):
+    """The ordered 2-stage JoinWire list for `q` (probe order IS the
+    list order): filtered orders_c keyed by o_orderkey shipping the
+    o_custkey lane, then filtered customer keyed by c_custkey probed
+    THROUGH that lane."""
+    from ..ops.join_scan import JoinWire
+    bids = chain_bids()
+    mo = np.ones(len(odata["o_orderkey"]), bool)
+    if q.order_date_lo is not None:
+        mo &= odata["o_orderdate"] >= q.order_date_lo
+    if q.order_date_hi is not None:
+        mo &= odata["o_orderdate"] < q.order_date_hi
+    opay = {bids["o_custkey"]: (odata["o_custkey"][mo], None)}
+    for nm in q.order_payload:
+        opay[bids[nm]] = (odata[nm][mo], None)
+    mc = np.ones(len(cdata["c_custkey"]), bool)
+    if q.cust_seg is not None:
+        mc &= cdata["c_mktsegment"] == q.cust_seg
+    cpay = {bids[nm]: (cdata[nm][mc], None) for nm in q.cust_payload}
+    return (JoinWire(probe_col=L_ORDERKEY,
+                     keys=odata["o_orderkey"][mo], payload=opay),
+            JoinWire(probe_col=bids["o_custkey"],
+                     keys=cdata["c_custkey"][mc], payload=cpay))
+
+
+def numpy_reference_chain(q: ChainQuerySpec,
+                          ldata: Dict[str, np.ndarray],
+                          odata: Dict[str, np.ndarray],
+                          cdata: Dict[str, np.ndarray]):
+    """{group string: (count, revenue)} straight from numpy."""
+    ok = ldata["l_orderkey"]
+    ck = odata["o_custkey"][ok]
+    m = np.ones(len(ok), bool)
+    if q.name == "q3":
+        m &= ldata["l_shipdate"] > _Q3_CUT
+    elif q.name == "q10":
+        m &= (ldata["l_returnflag"]
+              == int(np.flatnonzero(RETFLAG_STRINGS == "R")[0]))
+    od = odata["o_orderdate"][ok]
+    if q.order_date_lo is not None:
+        m &= od >= q.order_date_lo
+    if q.order_date_hi is not None:
+        m &= od < q.order_date_hi
+    if q.cust_seg is not None:
+        m &= cdata["c_mktsegment"][ck] == q.cust_seg
+    gvals = (odata[q.group_col][ok] if q.group_col.startswith("o_")
+             else cdata[q.group_col][ck])
+    rev = ldata["l_extendedprice"] * (1.0 - ldata["l_discount"])
+    domain = (PRIO_STRINGS if q.group_col == "o_orderpriority"
+              else NATION_STRINGS if q.group_col == "c_nation"
+              else MKTSEG_STRINGS)
+    out = {}
+    for g in domain:
+        mg = m & (gvals == g)
+        out[g] = (int(mg.sum()), float(rev[mg].sum()))
+    return out
+
+
+# --- the 22-query registry -------------------------------------------------
+
+#: typed reasons a TPC-H query is inexpressible on this engine — the
+#: gauntlet reports these per query, never a silent skip
+REASON_TABLE_COVERAGE = "table_coverage"    # part/supplier/partsupp/
+                                            # nation/region not modeled
+REASON_SUBQUERY = "subquery_shape"          # correlated/scalar subquery
+REASON_SEMI_JOIN = "semi_join"              # EXISTS / NOT EXISTS
+REASON_OUTER_JOIN = "outer_join"            # LEFT OUTER JOIN
+REASON_GROUP_DOMAIN = "group_domain"        # group key domain too wide
+REASON_EXPR_SHAPE = "expr_shape"            # CASE/LIKE/substring aggs
+
+
+@dataclass(frozen=True)
+class TpchEntry:
+    """One TPC-H query in the gauntlet: `kind` is scan/join/chain with
+    a runnable (possibly adapted) spec, or "inexpressible" with a typed
+    `reason`.  `note` records the adaptation or the refusal detail."""
+    name: str
+    kind: str                   # "scan" | "join" | "chain" | "inexpressible"
+    note: str
+    spec: object = None
+    reason: Optional[str] = None
+
+
+def tpch_queries() -> Dict[str, TpchEntry]:
+    """All 22 TPC-H queries, in order.  Runnable entries carry a spec
+    for the device path; the rest carry a typed refusal reason."""
+    E = TpchEntry
+    return {e.name: e for e in (
+        E("q1", "scan", "pricing summary — dict-key GROUP BY over the "
+          "STRING flag columns", tpch_q1_str()),
+        E("q2", "inexpressible", "min-cost supplier: part/supplier/"
+          "partsupp/nation/region + correlated MIN subquery",
+          reason=REASON_TABLE_COVERAGE),
+        E("q3", "chain", "shipping priority — GROUP BY l_orderkey "
+          "(1.5M/SF domain) lowered to o_orderpriority",
+          tpch_q3_chain()),
+        E("q4", "inexpressible", "order priority checking: EXISTS "
+          "semi-join counting ORDERS, not lineitems",
+          reason=REASON_SEMI_JOIN),
+        E("q5", "chain", "local supplier volume — supplier/nation/"
+          "region legs dropped; nation rides on customer",
+          tpch_q5_chain()),
+        E("q6", "scan", "forecasting revenue change — literal",
+          TPCH_Q6),
+        E("q7", "inexpressible", "volume shipping: supplier + nation "
+          "pair (supp_nation, cust_nation) not modeled",
+          reason=REASON_TABLE_COVERAGE),
+        E("q8", "inexpressible", "national market share: 8-table join "
+          "over part/supplier/nation/region",
+          reason=REASON_TABLE_COVERAGE),
+        E("q9", "inexpressible", "product type profit: part/supplier/"
+          "partsupp not modeled", reason=REASON_TABLE_COVERAGE),
+        E("q10", "chain", "returned items — GROUP BY c_custkey "
+          "(150k/SF, top-20) lowered to c_nation", tpch_q10_chain()),
+        E("q11", "inexpressible", "important stock: partsupp/supplier/"
+          "nation + HAVING scalar subquery",
+          reason=REASON_TABLE_COVERAGE),
+        E("q12", "inexpressible", "shipping modes: CASE conditional "
+          "aggregates; l_shipmode/commitdate/receiptdate not modeled",
+          reason=REASON_EXPR_SHAPE),
+        E("q13", "inexpressible", "customer distribution: LEFT OUTER "
+          "JOIN + group-over-count", reason=REASON_OUTER_JOIN),
+        E("q14", "inexpressible", "promotion effect: part + LIKE-"
+          "guarded conditional aggregate", reason=REASON_EXPR_SHAPE),
+        E("q15", "inexpressible", "top supplier: supplier + view with "
+          "scalar MAX subquery", reason=REASON_SUBQUERY),
+        E("q16", "inexpressible", "parts/supplier relationship: part/"
+          "partsupp + COUNT DISTINCT", reason=REASON_TABLE_COVERAGE),
+        E("q17", "inexpressible", "small-quantity-order revenue: "
+          "correlated AVG subquery per part", reason=REASON_SUBQUERY),
+        E("q18", "inexpressible", "large volume customer: HAVING "
+          "SUM(qty) subquery over the 1.5M/SF orderkey domain",
+          reason=REASON_SUBQUERY),
+        E("q19", "inexpressible", "discounted revenue: part table not "
+          "modeled (the OR-of-triples predicate itself is "
+          "expressible)", reason=REASON_TABLE_COVERAGE),
+        E("q20", "inexpressible", "potential part promotion: nested "
+          "IN subqueries over part/partsupp/supplier",
+          reason=REASON_SUBQUERY),
+        E("q21", "inexpressible", "suppliers who kept orders waiting: "
+          "supplier + EXISTS/NOT EXISTS self-joins",
+          reason=REASON_SEMI_JOIN),
+        E("q22", "inexpressible", "global sales opportunity: "
+          "substring() + NOT EXISTS + scalar AVG subquery",
+          reason=REASON_SUBQUERY),
+    )}
+
+
 def numpy_reference(query: QuerySpec, data: Dict[str, np.ndarray]):
     """Direct numpy answer for verification."""
     qty, price, disc = (data["l_quantity"], data["l_extendedprice"],

@@ -63,16 +63,25 @@ REASON_DUPLICATE_KEY = "duplicate_build_key"
 REASON_BUILD_OVERFLOW = "build_overflow"
 REASON_KEY_TYPE = "join_key_type"
 REASON_PROBE_SHAPE = "probe_shape"
+REASON_STAGE_COUNT = "join_stage_count"
 
 
 class JoinIneligible(Exception):
     """Typed refusal: the device join cannot serve this shape exactly;
-    the caller falls back to the interpreted join."""
+    the caller falls back to the interpreted join.  ``stage`` is the
+    0-based probe stage that refused (None when the refusal is not
+    stage-specific) — a multi-join chain falls back WHOLE, but the
+    reason names the stage that killed it."""
 
-    def __init__(self, reason: str, detail: str = ""):
+    def __init__(self, reason: str, detail: str = "",
+                 stage: Optional[int] = None):
+        if stage is not None:
+            detail = (f"stage {stage}: {detail}" if detail
+                      else f"stage {stage}")
         super().__init__(f"{reason}: {detail}" if detail else reason)
         self.reason = reason
         self.detail = detail
+        self.stage = stage
 
 
 @dataclass
@@ -319,6 +328,75 @@ def _make_join_runtime(wire: JoinWire,
         "build_s": round(rt.build_s, 5),
         "payload_cols": len(rt.build_cols)})
     return rt
+
+
+def normalize_join(join) -> Tuple[JoinWire, ...]:
+    """Canonical multi-stage form of a ReadRequest's join field: None,
+    one JoinWire, or an ordered sequence of JoinWires all normalize to
+    a tuple of stages (empty for None).  The order IS the probe order:
+    stage k may probe a payload column shipped by an earlier stage (a
+    chain: lineitem -> orders -> customer) or another real probe-table
+    column (a star: lineitem -> orders, lineitem -> part)."""
+    if join is None:
+        return ()
+    if isinstance(join, JoinWire):
+        return (join,)
+    return tuple(join)
+
+
+def make_join_runtimes(wires, probe_dicts: Dict[int, np.ndarray],
+                       max_slots: Optional[int] = None,
+                       max_stages: Optional[int] = None
+                       ) -> Tuple[JoinRuntime, ...]:
+    """Resolve an ordered multi-stage build list into JoinRuntimes.
+
+    Later stages may probe an earlier stage's dict-coded payload column
+    (string FKs ride as codes): the dictionary namespace ACCUMULATES
+    stage by stage, so stage k's string build keys map through the
+    payload dictionary stage j < k shipped for that column.  Payload
+    ids must be unique across stages (one shared BUILD_COL_BASE
+    counter); a collision or an over-budget stage count raises a typed
+    JoinIneligible carrying the offending stage."""
+    wires = normalize_join(wires)
+    if max_stages is None:
+        from ..utils import flags
+        max_stages = int(flags.get("multi_join_max_stages"))
+    if len(wires) > max_stages:
+        raise JoinIneligible(
+            REASON_STAGE_COUNT,
+            f"{len(wires)} probe stages > multi_join_max_stages="
+            f"{max_stages}", stage=max_stages)
+    dicts = dict(probe_dicts)
+    seen_bids: set = set()
+    rts = []
+    for si, wire in enumerate(wires):
+        overlap = seen_bids & set(wire.payload)
+        if overlap:
+            raise JoinIneligible(
+                REASON_PROBE_SHAPE,
+                f"payload id {sorted(overlap)[0]} shipped by two "
+                "stages", stage=si)
+        try:
+            rt = make_join_runtime(wire, dicts, max_slots)
+        except JoinIneligible as e:
+            if e.stage is None:
+                raise JoinIneligible(e.reason, e.detail,
+                                     stage=si) from e
+            raise
+        rts.append(rt)
+        seen_bids |= set(wire.payload)
+        dicts.update(rt.payload_dicts)
+    if len(rts) > 1:
+        # chain-level build accounting (make_join_runtime wrote the
+        # last stage's alone)
+        LAST_JOIN_STATS.clear()
+        LAST_JOIN_STATS.update({
+            "stages": len(rts),
+            "n_build": sum(rt.n_build for rt in rts),
+            "num_slots": [rt.num_slots for rt in rts],
+            "build_s": round(sum(rt.build_s for rt in rts), 5),
+            "payload_cols": sum(len(rt.build_cols) for rt in rts)})
+    return tuple(rts)
 
 
 # ---------------------------------------------------------------------------

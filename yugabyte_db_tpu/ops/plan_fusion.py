@@ -50,7 +50,8 @@ from .grouped_scan import (DictGroupSpec, ResolvedDictGroup,
 from .join_scan import (BUILD_COL_BASE, JOIN_STATS, JoinIneligible,
                         JoinRuntime, JoinWire, REASON_KEY_TYPE,
                         REASON_PROBE_SHAPE, hash_join_cpu,
-                        make_join_runtime, probe_table)
+                        make_join_runtime, make_join_runtimes,
+                        normalize_join, probe_table)
 from .scan import (AggSpec, HashGroupSpec, _expand_avg, _group_strategy,
                    _rescale_outs, _static_scales, _sum_prep,
                    _sum_prep_static, masked_aggregate, visibility_mask)
@@ -83,7 +84,6 @@ class FusedPlanKernel:
                join_shape, static_sums, strategy):
         import jax
 
-        probe_col, num_slots, rows_pad, payload_meta = join_shape
         # cumulative const offsets: WHERE first, then each aggregate —
         # the shared-consts-list discipline of _build_kernel
         from .expr import const_count
@@ -106,9 +106,7 @@ class FusedPlanKernel:
             return _sum_prep(v, m, n_total)
 
         def fn(cols, nulls, consts, valid, key_hash, ht, write_id,
-               tombstone, read_ht, sum_scales, group_domains,
-               table_used, table_key, table_val,
-               payload_vals, payload_nulls):
+               tombstone, read_ht, sum_scales, group_domains, joins):
             import jax.numpy as jnp
             mask = visibility_mask(mvcc_mode, valid, key_hash, ht,
                                    write_id, tombstone, read_ht)
@@ -117,22 +115,28 @@ class FusedPlanKernel:
                 mask = mask & wv
                 if wn is not None:
                     mask = mask & jnp.logical_not(wn)
-            # --- hash-join probe (inner): NULL FKs never match --------
-            pk = cols[probe_col]
-            pn = nulls.get(probe_col)
-            if pn is not None:
-                mask = mask & jnp.logical_not(pn)
-            midx = probe_table(pk, table_used, table_key, table_val,
-                               num_slots)
-            matched = midx >= 0
-            mask = mask & matched
-            gidx = jnp.clip(midx, 0, rows_pad - 1)
+            # --- N hash-join probe stages under ONE shared mask -------
+            # (inner semantics per stage: NULL FKs never match).  A
+            # chain stage probes an earlier stage's payload lane — its
+            # unmatched rows are already masked AND null-flagged, so
+            # the gathered garbage lanes can never reach an aggregate.
             cols2 = dict(cols)
             nulls2 = dict(nulls)
-            for (bid, _dt), pv, pu in zip(payload_meta, payload_vals,
-                                          payload_nulls):
-                cols2[bid] = pv[gidx]
-                nulls2[bid] = pu[gidx] | jnp.logical_not(matched)
+            for stage, (tu, tk, tv, pvals, pnulls) in zip(join_shape,
+                                                          joins):
+                probe_col, num_slots, rows_pad, payload_meta = stage
+                pk = cols2[probe_col]
+                pn = nulls2.get(probe_col)
+                if pn is not None:
+                    mask = mask & jnp.logical_not(pn)
+                midx = probe_table(pk, tu, tk, tv, num_slots)
+                matched = midx >= 0
+                mask = mask & matched
+                gidx = jnp.clip(midx, 0, rows_pad - 1)
+                for (bid, _dt), pv, pu in zip(payload_meta, pvals,
+                                              pnulls):
+                    cols2[bid] = pv[gidx]
+                    nulls2[bid] = pu[gidx] | jnp.logical_not(matched)
             return masked_aggregate(group, agg_fns, _prep, cols2,
                                     nulls2, consts, mask,
                                     group_domains, sum_scales,
@@ -142,24 +146,33 @@ class FusedPlanKernel:
 
     # ------------------------------------------------------------------
     def run(self, batch: DeviceBatch, where, aggs: Sequence[AggSpec],
-            group, read_ht: Optional[int], join_rt: JoinRuntime):
-        """Run the fused program over one probe batch.  Returns
-        ``(agg_results, counts, mask)`` for flat aggregates or
-        ``(agg_results, counts, mask, spill)`` for a DictGroupSpec —
-        the ScanKernel.run shapes, so every downstream combine/decode
-        path is shared."""
+            group, read_ht: Optional[int], join_rt):
+        """Run the fused program over one probe batch.  ``join_rt`` is
+        one JoinRuntime or an ordered sequence of them (the probe
+        stages, in probe order).  Returns ``(agg_results, counts,
+        mask)`` for flat aggregates or ``(agg_results, counts, mask,
+        spill)`` for a DictGroupSpec — the ScanKernel.run shapes, so
+        every downstream combine/decode path is shared."""
         import jax.numpy as jnp
 
         aggs = tuple(_expand_avg(aggs))
         if isinstance(group, HashGroupSpec):
             raise JoinIneligible(REASON_PROBE_SHAPE,
                                  "hash groups don't fuse")
-        pk_arr = batch.cols.get(join_rt.probe_col)
-        if pk_arr is None or str(pk_arr.dtype)[:3] not in ("int", "uin"):
-            raise JoinIneligible(
-                REASON_KEY_TYPE,
-                f"probe column {join_rt.probe_col} is not an integer "
-                f"lane on device")
+        join_rts = ((join_rt,) if isinstance(join_rt, JoinRuntime)
+                    else tuple(join_rt))
+        # per-stage probe-lane eligibility: stage 0 probes a real batch
+        # lane, stage k may also probe an earlier stage's payload lane
+        avail = {cid: str(v.dtype) for cid, v in batch.cols.items()}
+        for si, rt in enumerate(join_rts):
+            dt = avail.get(rt.probe_col)
+            if dt is None or dt[:3] not in ("int", "uin"):
+                raise JoinIneligible(
+                    REASON_KEY_TYPE,
+                    f"probe column {rt.probe_col} is not an integer "
+                    f"lane on device", stage=si)
+            for bid in rt.build_cols:
+                avail[bid] = str(rt.payload_vals[bid].dtype)
         if read_ht is None:
             mvcc_mode = "none"
         elif batch.unique_keys:
@@ -173,25 +186,34 @@ class FusedPlanKernel:
             if a.expr is not None:
                 collect_constants(a.expr, consts)
         merged_dicts = dict(batch.dicts)
-        merged_dicts.update(join_rt.payload_dicts)
+        bounds = dict(batch.col_bounds)
+        dtype_cols = dict(batch.cols)
+        for rt in join_rts:
+            merged_dicts.update(rt.payload_dicts)
+            bounds.update(rt.payload_bounds)
+            dtype_cols.update(rt.payload_vals)
         domain_args: tuple = ()
         resolved = group
         if isinstance(group, DictGroupSpec):
             resolved, domains = resolve_group(group, merged_dicts)
             domain_args = tuple(jnp.int32(d) for d in domains)
-        bounds = dict(batch.col_bounds)
-        bounds.update(join_rt.payload_bounds)
-        dtype_cols = dict(batch.cols)
-        dtype_cols.update(join_rt.payload_vals)
         static_sums, scale_args = _static_scales(
             aggs, bounds, batch.padded_rows, dtype_cols)
         strategy = _group_strategy()
         col_sig = tuple(sorted(
             (cid, str(v.dtype)) for cid, v in batch.cols.items()))
-        join_shape = (join_rt.probe_col, join_rt.num_slots,
-                      join_rt.build_rows_pad,
-                      tuple((bid, str(join_rt.payload_vals[bid].dtype))
-                            for bid in join_rt.build_cols))
+        join_shape = tuple(
+            (rt.probe_col, rt.num_slots, rt.build_rows_pad,
+             tuple((bid, str(rt.payload_vals[bid].dtype))
+                   for bid in rt.build_cols))
+            for rt in join_rts)
+        # per-stage cache-key components beyond the shape tuple: the
+        # pow2 build buckets and WHICH payload lanes are dict-coded
+        # (dict-coded lanes change rewrite/decode semantics downstream)
+        build_buckets = tuple((rt.num_slots, rt.build_rows_pad)
+                              for rt in join_rts)
+        dict_sig = tuple(tuple(sorted(rt.payload_dicts))
+                         for rt in join_rts)
         sig = (
             "plan",
             expr_signature(where) if where is not None else None,
@@ -201,7 +223,7 @@ class FusedPlanKernel:
                      getattr(resolved, "num_groups", None)))
             if resolved is not None else None,
             mvcc_mode, batch.padded_rows, col_sig, static_sums,
-            strategy, join_shape,
+            strategy, join_shape, build_buckets, dict_sig,
         )
         fn = self._cache.get(sig)
         compiled = fn is None
@@ -239,12 +261,14 @@ class FusedPlanKernel:
                 jnp.uint64(read_ht if read_ht is not None
                            else 0xFFFFFFFFFFFFFFFF),
                 scale_args, domain_args,
-                jnp.asarray(join_rt.used), jnp.asarray(join_rt.table_key),
-                jnp.asarray(join_rt.table_val),
-                tuple(jnp.asarray(join_rt.payload_vals[bid])
-                      for bid in join_rt.build_cols),
-                tuple(jnp.asarray(join_rt.payload_nulls[bid])
-                      for bid in join_rt.build_cols),
+                tuple(
+                    (jnp.asarray(rt.used), jnp.asarray(rt.table_key),
+                     jnp.asarray(rt.table_val),
+                     tuple(jnp.asarray(rt.payload_vals[bid])
+                           for bid in rt.build_cols),
+                     tuple(jnp.asarray(rt.payload_nulls[bid])
+                           for bid in rt.build_cols))
+                    for rt in join_rts),
             )
         return (_rescale_outs(raw[0], raw[1]),) + tuple(raw[2:])
 
@@ -294,11 +318,11 @@ def _plan_probe_dicts(blocks, columns, where, aggs, group):
 
 
 def _group_domain_ok(group, merged_dicts) -> bool:
-    if not isinstance(group, DictGroupSpec):
-        return True
-    if any(c not in merged_dicts for c in group.cols):
-        return False
-    return domain_product(group, merged_dicts) < 2 ** 31
+    # shared with the streamed scan route — ONE wrap-guard definition
+    # (the fused plan checks it against the MERGED namespace: probe
+    # dictionaries plus every stage's payload dictionaries)
+    from .stream_scan import group_domain_ok
+    return group_domain_ok(group, merged_dicts)
 
 
 # ---------------------------------------------------------------------------
@@ -307,7 +331,7 @@ def _group_domain_ok(group, merged_dicts) -> bool:
 
 def streaming_plan_aggregate(
         blocks, columns: Sequence[int], where, aggs: Sequence[AggSpec],
-        group, read_ht: Optional[int], join_wire: JoinWire,
+        group, read_ht: Optional[int], join_wire,
         kernel: Optional[FusedPlanKernel] = None,
         chunk_rows: Optional[int] = None,
         cache=None, cache_key: Optional[tuple] = None,
@@ -316,13 +340,14 @@ def streaming_plan_aggregate(
     """Chunked fused-plan aggregate over `blocks` (the probe side).
 
     `columns` must contain the PROBE-side columns only (incl. the FK
-    column); build-side payload lanes ride in `join_wire`.  Returns
+    columns); build-side payload lanes ride in `join_wire` — one
+    JoinWire or an ordered sequence of probe stages.  Returns
     ``(agg_values, counts)`` or None when the scan isn't streamable
     (same eligibility rules as streaming_scan_aggregate); raises
-    JoinIneligible (typed) when the build side can't be served.  The
-    shared pow2 chunk bucket means every chunk reuses ONE plan-kernel
-    signature: compile count stays flat however many chunks data
-    growth adds."""
+    JoinIneligible (typed, stage-tagged) when a build side can't be
+    served.  The shared pow2 chunk bucket means every chunk reuses ONE
+    plan-kernel signature: compile count stays flat however many
+    chunks data growth adds."""
     if isinstance(group, HashGroupSpec):
         return None
     dict_group = isinstance(group, DictGroupSpec)
@@ -351,11 +376,12 @@ def streaming_plan_aggregate(
     if len(chunks) < min_chunks and not pruned:
         return None
     t_build = time.perf_counter()
-    join_rt = make_join_runtime(join_wire,
-                                plan.dicts if plan is not None else {})
+    join_rts = make_join_runtimes(
+        join_wire, plan.dicts if plan is not None else {})
     build_table_s = time.perf_counter() - t_build
     merged_dicts = dict(plan.dicts) if plan is not None else {}
-    merged_dicts.update(join_rt.payload_dicts)
+    for rt in join_rts:
+        merged_dicts.update(rt.payload_dicts)
     if dict_group and not _group_domain_ok(group, merged_dicts):
         return None
     kernel = kernel or _DEFAULT_PLAN_KERNEL
@@ -391,7 +417,7 @@ def streaming_plan_aggregate(
     rebuilds0 = KEY_REBUILD_STATS["rebuilds"]
     for batch in pipe.run(enumerate(chunks)):
         t0 = time.perf_counter()
-        got = kernel.run(batch, where, aggs, group, read_ht, join_rt)
+        got = kernel.run(batch, where, aggs, group, read_ht, join_rts)
         if dict_group:
             outs, counts, _, spill = got
             spill_acc += int(spill)
@@ -408,7 +434,10 @@ def streaming_plan_aggregate(
         "path": "streaming", "chunks": len(chunks),
         "bucket_rows": bucket,
         "zone_blocks_pruned": pruned,
-        "n_build": join_rt.n_build, "num_slots": join_rt.num_slots,
+        "n_build": sum(rt.n_build for rt in join_rts),
+        "num_slots": (join_rts[0].num_slots if len(join_rts) == 1
+                      else [rt.num_slots for rt in join_rts]),
+        "join_stages": len(join_rts),
         "build_table_s": round(build_table_s, 5),
         "batch_build_s": round(pipe.stage_s[0], 4),
         "kernel_s": round(kernel_s, 4),
@@ -433,7 +462,7 @@ def streaming_plan_aggregate(
 
 def monolithic_plan_aggregate(
         blocks, columns: Sequence[int], where, aggs: Sequence[AggSpec],
-        group, read_ht: Optional[int], join_wire: JoinWire,
+        group, read_ht: Optional[int], join_wire,
         kernel: Optional[FusedPlanKernel] = None,
         cache=None, cache_key: Optional[tuple] = None,
         grouped_out: Optional[dict] = None):
@@ -468,15 +497,16 @@ def monolithic_plan_aggregate(
         where, aggs = DocReadOperation.rewrite_where_and_aggs(
             where, aggs, batch.dicts, allow_dict_minmax=False)
     t_build = time.perf_counter()
-    join_rt = make_join_runtime(join_wire, batch.dicts)
+    join_rts = make_join_runtimes(join_wire, batch.dicts)
     build_table_s = time.perf_counter() - t_build
     merged_dicts = dict(batch.dicts)
-    merged_dicts.update(join_rt.payload_dicts)
+    for rt in join_rts:
+        merged_dicts.update(rt.payload_dicts)
     if dict_group and not _group_domain_ok(group, merged_dicts):
         raise JoinIneligible(REASON_PROBE_SHAPE,
                              "group domain unservable")
     t0 = time.perf_counter()
-    got = kernel.run(batch, where, aggs, group, read_ht, join_rt)
+    got = kernel.run(batch, where, aggs, group, read_ht, join_rts)
     kernel_s = time.perf_counter() - t0
     if dict_group:
         outs, counts, _, spill = got
@@ -490,7 +520,10 @@ def monolithic_plan_aggregate(
     LAST_PLAN_STATS.update({
         "path": "monolithic", "chunks": 1,
         "bucket_rows": batch.padded_rows,
-        "n_build": join_rt.n_build, "num_slots": join_rt.num_slots,
+        "n_build": sum(rt.n_build for rt in join_rts),
+        "num_slots": (join_rts[0].num_slots if len(join_rts) == 1
+                      else [rt.num_slots for rt in join_rts]),
+        "join_stages": len(join_rts),
         "build_table_s": round(build_table_s, 5),
         "kernel_s": round(kernel_s, 4),
         "plan_compiles": kernel.compiles,
@@ -505,17 +538,19 @@ def monolithic_plan_aggregate(
 
 def fused_plan_cpu(blocks, columns: Sequence[int], where,
                    aggs: Sequence[AggSpec], group,
-                   join_wire: JoinWire, read_ht: Optional[int] = None,
+                   join_wire, read_ht: Optional[int] = None,
                    n_total: Optional[int] = None):
     """Numpy twin of the fused plan: same scan-global dictionary plan,
-    same build-table key mapping and match indices, same dense slot
-    encoding and static int64 fixed-point SUM quantization — bitwise
-    equal to the MONOLITHIC device route on an f64 backend when
-    ``n_total`` is the device batch's padded row bucket.  Returns
-    ``(outs, counts, spilled)`` in dense slot form for a DictGroupSpec
-    (decode via decode_slot_groups against the twin's merged dicts,
-    exposed as the 4th return) or scalars for flat aggregates:
-    ``(outs, counts, None, merged_dicts)``."""
+    same build-table key mapping and match indices (per probe stage,
+    in probe order), same dense slot encoding and static int64
+    fixed-point SUM quantization — bitwise equal to the MONOLITHIC
+    device route on an f64 backend when ``n_total`` is the device
+    batch's padded row bucket.  ``join_wire`` is one JoinWire or an
+    ordered stage sequence.  Returns ``(outs, counts, spilled)`` in
+    dense slot form for a DictGroupSpec (decode via decode_slot_groups
+    against the twin's merged dicts, exposed as the 4th return) or
+    scalars for flat aggregates: ``(outs, counts, None,
+    merged_dicts)``."""
     from ..docdb.operations import DocReadOperation
     from .cpu_scan import eval_expr_np
     from .device_batch import f64_conversion
@@ -540,8 +575,8 @@ def fused_plan_cpu(blocks, columns: Sequence[int], where,
         where, aggs = DocReadOperation.rewrite_where_and_aggs(
             where, aggs, plan.dicts if plan is not None else {},
             allow_dict_minmax=False)
-    join_rt = make_join_runtime(join_wire,
-                                plan.dicts if plan is not None else {})
+    join_rts = make_join_runtimes(
+        join_wire, plan.dicts if plan is not None else {})
     cols: Dict[int, np.ndarray] = {}
     nulls: Dict[int, np.ndarray] = {}
     bounds: Dict[int, Tuple[float, float]] = {}
@@ -572,7 +607,8 @@ def fused_plan_cpu(blocks, columns: Sequence[int], where,
         nulls[cid] = np.concatenate(nparts)
         if arr.dtype.kind in "fiu" and len(arr):
             bounds[cid] = (float(arr.min()), float(arr.max()))
-    bounds.update(join_rt.payload_bounds)
+    for rt in join_rts:
+        bounds.update(rt.payload_bounds)
     n = len(next(iter(cols.values()))) if cols else 0
     mask = np.ones(n, bool)
     if read_ht is not None:
@@ -584,20 +620,23 @@ def fused_plan_cpu(blocks, columns: Sequence[int], where,
         mask &= wv
         if wn is not None:
             mask &= ~wn
-    # --- join probe (the twin of probe_table + payload gather) --------
-    pk = cols[join_rt.probe_col]
-    pkn = nulls.get(join_rt.probe_col)
-    if pkn is not None:
-        mask &= ~pkn
-    midx = hash_join_cpu(pk.astype(np.int64), join_rt.keys_mapped)
-    matched = midx >= 0
-    mask &= matched
-    gidx = np.clip(midx, 0, join_rt.build_rows_pad - 1)
-    for bid in join_rt.build_cols:
-        cols[bid] = join_rt.payload_vals[bid][gidx]
-        nulls[bid] = join_rt.payload_nulls[bid][gidx] | ~matched
+    # --- join probe stages (the twin of probe_table + gather, in the
+    # same probe order under the same shared mask) ---------------------
+    for rt in join_rts:
+        pk = cols[rt.probe_col]
+        pkn = nulls.get(rt.probe_col)
+        if pkn is not None:
+            mask &= ~pkn
+        midx = hash_join_cpu(pk.astype(np.int64), rt.keys_mapped)
+        matched = midx >= 0
+        mask &= matched
+        gidx = np.clip(midx, 0, rt.build_rows_pad - 1)
+        for bid in rt.build_cols:
+            cols[bid] = rt.payload_vals[bid][gidx]
+            nulls[bid] = rt.payload_nulls[bid][gidx] | ~matched
     merged_dicts = dict(plan.dicts) if plan is not None else {}
-    merged_dicts.update(join_rt.payload_dicts)
+    for rt in join_rts:
+        merged_dicts.update(rt.payload_dicts)
     if n_total is None:
         n_total = bucket_rows(max(n, 1))
     # --- group/aggregate tail (the masked_aggregate twin) -------------

@@ -118,6 +118,18 @@ def _combine(aggs: Tuple[AggSpec, ...], acc: Optional[list],
     return acc
 
 
+def group_domain_ok(group, dicts) -> bool:
+    """Shared guard for every dict-group route (streamed, fused-plan,
+    bypass): all group columns must carry a scan-global dictionary and
+    the slot-id arithmetic must not wrap int32 (the kernel's gid lane).
+    Non-dict groups pass trivially."""
+    if not isinstance(group, DictGroupSpec):
+        return True
+    if any(c not in dicts for c in group.cols):
+        return False
+    return domain_product(group, dicts) < 2 ** 31
+
+
 def _plan_dict_columns(blocks, columns, where, aggs, group):
     """Scan-global dictionary planning + string-predicate rewrite for a
     streamed scan.  Returns ``(plan, where, aggs, ok)``: plan is None
@@ -140,7 +152,7 @@ def _plan_dict_columns(blocks, columns, where, aggs, group):
     plan = make_dict_plan(blocks, dcids)
     if plan is None:
         return None, where, aggs, False
-    if dict_group and domain_product(group, plan.dicts) >= 2 ** 31:
+    if not group_domain_ok(group, plan.dicts):
         return None, where, aggs, False     # gid arithmetic would wrap
     from ..docdb.operations import DocReadOperation
     try:

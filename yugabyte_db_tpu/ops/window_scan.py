@@ -27,6 +27,7 @@ results stay bit-identical to the Python path it replaces);
 """
 from __future__ import annotations
 
+from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -38,6 +39,179 @@ WINDOW_STATS = {"launches": 0, "fallbacks": 0}
 VALUE_OPS = {"lag", "lead", "sum", "count", "min", "max",
              "rolling_sum"}
 NO_VALUE_OPS = {"row_number", "rank", "dense_rank", "count_star"}
+
+# --- server-side pushdown (the sorted-scan request shape) ------------------
+
+REASON_WINDOW_OFF = "window_server_off"
+REASON_WINDOW_PAGED = "window_paged_scan"
+REASON_WINDOW_NULL_KEY = "window_null_key"
+REASON_WINDOW_KEY_KIND = "window_key_kind"
+REASON_WINDOW_VALUE_KIND = "window_value_kind"
+REASON_WINDOW_FUNC = "window_func"
+REASON_WINDOW_SHAPE = "window_shape"
+
+
+class WindowIneligible(Exception):
+    """Typed refusal: the server-side window path cannot serve this
+    request bit-identically; the tablet serves PLAIN rows with the
+    reason on the response and the client tier recomputes — the answer
+    never depends on which tier computed the window."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        super().__init__(f"{reason}: {detail}" if detail else reason)
+        self.reason = reason
+        self.detail = detail
+
+
+@dataclass
+class WindowWire:
+    """Window spec as it crosses the wire inside a ReadRequest — the
+    sorted-scan request shape.  All items share ONE (partition, order)
+    spec (the executor keeps multi-spec statements client-side).
+
+    ``partition_by``: column NAMES partitioning the rows.
+    ``order_by``: (column name, desc) pairs ordering within a
+    partition.
+    ``items``: (head, param, value_col, out_name) per window item —
+    ``head`` a kernel op head (row_number/rank/dense_rank/count_star/
+    lag/lead/sum/count/min/max), ``param`` its static int parameter
+    (lag/lead offset; 1 for cumulative frames, 0 for whole-partition),
+    ``value_col`` the value column name (None for arithmetic-free
+    heads), ``out_name`` the key the computed value lands under in
+    each served row."""
+    partition_by: Tuple[str, ...] = ()
+    order_by: Tuple[Tuple[str, bool], ...] = ()
+    items: Tuple[Tuple[str, int, Optional[str], str], ...] = ()
+
+    def signature(self) -> tuple:
+        return (self.partition_by, self.order_by,
+                tuple((h, p, v) for h, p, v, _ in self.items))
+
+
+def _key_codes(vals):
+    """Sort codes for one partition/order key lane over row VALUES —
+    the exact codes_of contract of the executor's device window hook
+    (ql/executor._apply_windows_device), so the served answer is the
+    one that hook would compute.  Raises WindowIneligible (typed) for
+    NULL keys and non-orderable kind mixes."""
+    if any(v is None for v in vals):
+        raise WindowIneligible(REASON_WINDOW_NULL_KEY)
+    kinds = {type(v) for v in vals}
+    if kinds <= {int, bool}:
+        arr = np.asarray([int(v) for v in vals], np.int64)
+    elif kinds <= {int, bool, float}:
+        arr = np.asarray([float(v) for v in vals], np.float64)
+        if np.isnan(arr).any():
+            raise WindowIneligible(REASON_WINDOW_KEY_KIND, "NaN key")
+    elif kinds == {str}:
+        arr = np.asarray(vals)
+    else:
+        raise WindowIneligible(
+            REASON_WINDOW_KEY_KIND,
+            ",".join(sorted(k.__name__ for k in kinds)))
+    uniq, codes = np.unique(arr, return_inverse=True)
+    return codes.astype(np.int64), len(uniq)
+
+
+def serve_window_rows(wire: WindowWire, rows: List[dict],
+                      kernel: Optional["WindowKernel"] = None) -> None:
+    """Compute the wire's window items over name-keyed `rows` IN
+    PLACE: one np.lexsort by (partition, order) codes, the segment-
+    scan kernels over the sorted axis, values scattered back to each
+    row under its item's out_name — rows keep their original order.
+
+    This is the tablet-side half of the window pushdown (and the
+    client fan-out merge reuses it over the union of parts): the same
+    codes, the same kernel, the same int()-or-float() value landing as
+    the executor's device hook, so whichever tier runs it the answer
+    is bitwise identical.  Raises WindowIneligible (typed) for every
+    shape the kernel cannot answer bit-identically to the Python
+    window fold — the caller serves plain rows and the executor
+    recomputes."""
+    n = len(rows)
+    if n == 0:
+        return
+    pkeys = [
+        _key_codes([r.get(c) for r in rows])[0]
+        for c in wire.partition_by]
+    okeys = []
+    for cname, desc in wire.order_by:
+        codes, nu = _key_codes([r.get(cname) for r in rows])
+        okeys.append((nu - 1 - codes) if desc else codes)
+    has_order = bool(wire.order_by)
+    ops, values, nulls, names = [], [], [], []
+    for head, param, value_col, out_name in wire.items:
+        if head in ("row_number", "rank", "dense_rank"):
+            ops.append((head,))
+            values.append(None)
+            nulls.append(None)
+        elif head == "count_star":
+            ops.append(("count_star", 1 if has_order else 0))
+            values.append(None)
+            nulls.append(None)
+        elif head in ("lag", "lead"):
+            if value_col is None or param < 0:
+                raise WindowIneligible(REASON_WINDOW_SHAPE, head)
+            vals = [r.get(value_col) for r in rows]
+            kinds = {type(v) for v in vals if v is not None}
+            if kinds <= {int}:
+                arr = np.asarray(
+                    [0 if v is None else int(v) for v in vals],
+                    np.int64)
+            elif kinds <= {int, float}:
+                arr = np.asarray(
+                    [0.0 if v is None else float(v) for v in vals],
+                    np.float64)
+            else:
+                raise WindowIneligible(REASON_WINDOW_VALUE_KIND,
+                                       value_col)
+            ops.append((head, param))
+            values.append(arr)
+            nulls.append(np.asarray([v is None for v in vals], bool))
+        elif head in ("sum", "count", "min", "max"):
+            if value_col is None:
+                raise WindowIneligible(REASON_WINDOW_SHAPE, head)
+            cum = 1 if has_order else 0
+            vals = [r.get(value_col) for r in rows]
+            kinds = {type(v) for v in vals if v is not None}
+            if head == "count":
+                arr = np.zeros(n, np.int64)     # mask-only lane
+            elif kinds <= {int, bool}:
+                # exact int64 segment arithmetic — the only lanes
+                # whose kernel answer is bit-identical to the fold
+                arr = np.asarray(
+                    [0 if v is None else int(v) for v in vals],
+                    np.int64)
+            else:
+                raise WindowIneligible(REASON_WINDOW_VALUE_KIND,
+                                       value_col)
+            ops.append((head, cum))
+            values.append(arr)
+            nulls.append(np.asarray([v is None for v in vals], bool))
+        else:
+            raise WindowIneligible(REASON_WINDOW_FUNC, head)
+        names.append(out_name)
+    keys = pkeys + okeys
+    perm = np.lexsort(tuple(reversed(keys))) if keys else np.arange(n)
+    seg = np.zeros(n, bool)
+    seg[0] = True
+    for kk in pkeys:
+        ks = kk[perm]
+        seg[1:] |= ks[1:] != ks[:-1]
+    peer = np.zeros(n, bool)
+    for kk in okeys:
+        ks = kk[perm]
+        peer[1:] |= ks[1:] != ks[:-1]
+    svalues = [None if v is None else v[perm] for v in values]
+    snulls = [None if m is None else m[perm] for m in nulls]
+    kern = kernel or default_window_kernel()
+    outs = kern.run(ops, seg, peer, svalues, snulls)
+    for (ov, om), name in zip(outs, names):
+        is_f = ov.dtype.kind == "f"
+        for k in range(n):
+            ri = int(perm[k])
+            rows[ri][name] = (None if om[k] else
+                              float(ov[k]) if is_f else int(ov[k]))
 
 
 def _seg_bounds(seg_start, idx, n):

@@ -1948,8 +1948,20 @@ class SqlSession:
             # still applies the limit client-side)
             columns = self._overlay_columns(columns, schema, where)
             push_limit = None
+        # server-side window pushdown: when every window item lowers to
+        # a wire the tablet can serve bit-identically AND no client
+        # stage after the scan changes the row set (correlated filters,
+        # row locks, txn overlays), ship the window spec with the scan
+        # and let the kernel serve the tablet's own rows
+        wwire = None
+        if has_window and not (corr_where or corr_items or for_update
+                               or for_share) \
+                and (self._txn is None
+                     or not self._txn.pending_writes(stmt.table)):
+            wwire = self._window_wire(stmt, schema)
         req = ReadRequest("", columns=tuple(columns), where=where,
-                          read_ht=read_ht, limit=push_limit)
+                          read_ht=read_ht, limit=push_limit,
+                          window=wwire)
         resp = await self.client.scan(stmt.table, req,
                                       keep_all=natural)
         base_rows = resp.rows
@@ -2009,7 +2021,12 @@ class SqlSession:
                         continue
                 locked.append(fresh)
             base_rows = locked
-        if has_window:
+        if has_window and not (wwire is not None and resp.window_served):
+            # unserved (typed refusal somewhere down the stack, or no
+            # wire): the interpreted/device-hook client path computes
+            # them — _apply_windows overwrites the out_name keys
+            # unconditionally, so a partially-served fan-out can never
+            # leak stale per-tablet values
             self._apply_windows(stmt, base_rows)
         rows = [self._project_row(stmt, r, schema) for r in base_rows]
         rows = self._order_limit(stmt, rows)
@@ -2607,44 +2624,58 @@ class SqlSession:
 
     async def _try_fused_join(self, stmt: SelectStmt, pushed,
                               real_of) -> Optional[SqlResult]:
-        """Push a single INNER FK-equijoin + GROUP BY + aggregates down
-        as ONE fused plan: the (filtered) build side ships with the
-        probe-table scan request and the whole
-        filter->probe->gather->group->aggregate shape runs as one
-        device program per tablet (ops/plan_fusion.py), partials
-        combining through the ordinary grouped fan-out combine.  The
-        operator-at-a-time client join stays the path for every shape
-        this doesn't cover (None return), and `plan_fusion_enabled`
-        off restores it wholesale."""
+        """Historical entry point — now a thin wrapper over the general
+        plan-lowering pass (which subsumes the original single-join
+        shape as the 1-stage case)."""
+        return await self._lower_fused_plan(stmt, pushed, real_of)
+
+    async def _lower_fused_plan(self, stmt: SelectStmt, pushed,
+                                real_of) -> Optional[SqlResult]:
+        """General plan-lowering pass: an all-INNER FK-equijoin TREE
+        (left-deep chain like lineitem⋈orders⋈customer, or a star with
+        several dimensions hanging off the probe table) + GROUP BY +
+        aggregates lowers to ONE fused plan — each (filtered) build
+        side ships as a probe STAGE in an ordered JoinWire sequence
+        with the probe-table scan request, and the whole
+        filter->probe_1..probe_N->gather->group->aggregate shape runs
+        as one device program per tablet (ops/plan_fusion.py), partials
+        combining through the ordinary grouped fan-out combine.  A
+        chain stage probes an EARLIER stage's payload lane; a star
+        stage probes a probe-table column.  Arithmetic-free window
+        TAILS over the grouped output ride along client-side on the
+        (small) result rows.  The operator-at-a-time client join stays
+        the path for every shape this doesn't cover (None return), and
+        `plan_fusion_enabled` off restores it wholesale."""
         if not (flags.get("plan_fusion_enabled")
                 and flags.get("join_pushdown_enabled")):
             return None
-        if len(stmt.joins) != 1 or stmt.joins[0].kind != "inner":
+        if not stmt.joins or any(j.kind != "inner" for j in stmt.joins):
             return None
+        if len(stmt.joins) > int(flags.get("multi_join_max_stages")):
+            return None   # stage budget: the classic client join (the
+            #               server would refuse typed anyway — don't
+            #               fetch N build sides just to hear it)
         if getattr(stmt, "having", None) is not None \
                 or getattr(stmt, "distinct", False) \
                 or getattr(stmt, "group_exprs", None):
             return None
         from .pg_catalog import is_virtual
         lbl0 = stmt.table_alias or stmt.table
-        jc = stmt.joins[0]
-        jlabel = jc.alias or jc.table
-        probe_t = real_of.get(lbl0, lbl0)
-        build_t = real_of.get(jlabel, jlabel)
-        for tname in (probe_t, build_t):
+        build_lbls = [j.alias or j.table for j in stmt.joins]
+        labels = [lbl0] + build_lbls
+        if len(set(labels)) != len(labels):
+            return None   # duplicate labels: ownership can't be proven
+        for lbl in labels:
+            tname = real_of.get(lbl, lbl)
             if tname in self._cte_rows or is_virtual(tname):
                 return None
-        if self._txn is not None and (
-                self._txn.pending_writes(probe_t)
-                or self._txn.pending_writes(build_t)):
-            return None       # write-set overlay can't patch partials
-        psch = self._join_schemas.get(lbl0)
-        bsch = self._join_schemas.get(jlabel)
-        if psch is None or bsch is None:
-            return None
+            if self._txn is not None and self._txn.pending_writes(tname):
+                return None   # write-set overlay can't patch partials
+            if self._join_schemas.get(lbl) is None:
+                return None
         agg_items = [(i, it) for i, it in enumerate(stmt.items)
                      if it[0] == "agg"]
-        if not agg_items or any(it[0] not in ("agg", "col")
+        if not agg_items or any(it[0] not in ("agg", "col", "window")
                                 for it in stmt.items):
             return None
         if any(it[1] not in ("sum", "count", "min", "max", "avg")
@@ -2654,6 +2685,19 @@ class SqlSession:
         for i, it in enumerate(stmt.items):
             if it[0] == "col" and self._split_qual(it[1])[1] not in gset:
                 return None
+            if it[0] == "window":
+                # window TAIL over the grouped output: arithmetic-free
+                # heads only, partition/order drawn from the group keys
+                # (those are the columns the result rows carry)
+                if it[2] is not None:
+                    return None
+                if getattr(stmt, "aliases", None):
+                    return None   # an alias could shadow a ref's key
+                refs = set(it[3] or ()) | {n for n, _ in (it[4] or ())}
+                if any(self._split_qual(r)[1] not in gset or
+                       self._split_qual(r)[0] is not None
+                       for r in refs):
+                    return None
         # the WHERE must split entirely into single-side conjuncts
         # (cross-table residuals need the materialized join) — the
         # SAME splitter _join_pushdown used, so the totality check
@@ -2662,7 +2706,7 @@ class SqlSession:
             total = len(_conjuncts(stmt.where))
             if sum(len(v) for v in pushed.values()) != total:
                 return None
-        if any(lbl not in (lbl0, jlabel) for lbl in pushed):
+        if any(lbl not in labels for lbl in pushed):
             return None
 
         def _has(sch, bare):
@@ -2672,19 +2716,33 @@ class SqlSession:
                 return None
 
         def side_of(name):
+            """(owning label, ColumnSchema) — alias-aware qualified
+            refs win; a bare name must live in exactly ONE side."""
             q, bare = self._split_qual(name)
-            pc, bc = _has(psch, bare), _has(bsch, bare)
-            if q == lbl0 or (q is None and pc is not None
-                             and bc is None):
-                return ("p", pc) if pc is not None else None
-            if q == jlabel or (q is None and bc is not None
-                               and pc is None):
-                return ("b", bc) if bc is not None else None
-            return None
+            cands = []
+            for lbl in labels:
+                if q is not None and q != lbl:
+                    continue
+                col = _has(self._join_schemas[lbl], bare)
+                if col is not None:
+                    cands.append((lbl, col))
+            return cands[0] if len(cands) == 1 else None
 
         from ..ops.join_scan import BUILD_COL_BASE, JoinWire
-        payload_ids: Dict[str, int] = {}
+        # ONE payload-id counter across every stage: lanes are a shared
+        # namespace inside the fused program (the kernel refuses typed
+        # on collisions; a shared counter makes them impossible here)
+        payload_ids: Dict[str, Dict[str, int]] = {l: {}
+                                                  for l in build_lbls}
+        nxt_bid = [BUILD_COL_BASE]
         agg_payload: set = set()
+
+        def lane_of(lbl, name):
+            ids = payload_ids[lbl]
+            if name not in ids:
+                ids[name] = nxt_bid[0]
+                nxt_bid[0] += 1
+            return ids[name]
 
         def bind_mixed(n, in_agg=False):
             if not isinstance(n, tuple):
@@ -2693,8 +2751,8 @@ class SqlSession:
                 s = side_of(n[1])
                 if s is None:
                     raise self._NoFuse()
-                side, col = s
-                if side == "p":
+                lbl, col = s
+                if lbl == lbl0:
                     if col.type == ColumnType.DECIMAL:
                         # mirror _bind: DECIMAL stores as text — wrap
                         # so the (interpreted) evaluator converts; the
@@ -2703,11 +2761,9 @@ class SqlSession:
                     return ("col", col.id)
                 if col.type == ColumnType.DECIMAL:
                     raise self._NoFuse()   # payload can't ship decimals
-                bid = payload_ids.setdefault(
-                    col.name, BUILD_COL_BASE + len(payload_ids))
                 if in_agg:
-                    agg_payload.add(col.name)
-                return ("col", bid)
+                    agg_payload.add((lbl, col.name))
+                return ("col", lane_of(lbl, col.name))
             if n[0] == "const":
                 return n
             if n[0] == "fn" and n[1] == "now":
@@ -2721,13 +2777,40 @@ class SqlSession:
                 bind_mixed(c, in_agg) if isinstance(c, tuple) else c
                 for c in n[1:])
 
+        # join KEYS must be exactly representable as int64 or strings —
+        # FLOAT64 keys would truncate under int() and silently change
+        # which rows match; the classic client join owns float keys
+        _keyable = (ColumnType.INT32, ColumnType.INT64,
+                    ColumnType.TIMESTAMP, ColumnType.BOOL,
+                    ColumnType.STRING)
         try:
-            # join keys: one column per side, either written order
-            s_l, s_r = side_of(jc.left_col), side_of(jc.right_col)
-            if s_l is None or s_r is None or s_l[0] == s_r[0]:
-                return None
-            (probe_key, build_key) = (
-                (s_l[1], s_r[1]) if s_l[0] == "p" else (s_r[1], s_l[1]))
+            # per-stage key resolution, in the WRITTEN join order: one
+            # key column on the NEW build table, the other on the probe
+            # table (star stage) or an EARLIER build (chain stage —
+            # probes that stage's payload lane)
+            stages = []   # (build label, build key col, probe_ref)
+            for si, jc in enumerate(stmt.joins):
+                jlabel = build_lbls[si]
+                s_l, s_r = side_of(jc.left_col), side_of(jc.right_col)
+                if s_l is None or s_r is None:
+                    return None
+                if (s_l[0] == jlabel) == (s_r[0] == jlabel):
+                    return None   # both (or neither) on the new build
+                (anchor_lbl, anchor_col), (_, build_key) = (
+                    (s_l, s_r) if s_r[0] == jlabel else (s_r, s_l))
+                if build_key.type not in _keyable:
+                    return None
+                if anchor_lbl == lbl0:
+                    probe_ref = ("p", anchor_col)
+                else:
+                    if anchor_lbl not in build_lbls[:si]:
+                        return None   # anchor must ALREADY be placed
+                    if anchor_col.type not in _keyable:
+                        return None
+                    # the chain anchor becomes a payload lane of the
+                    # earlier stage — shipped even when unprojected
+                    probe_ref = ("lane", anchor_lbl, anchor_col)
+                stages.append((jlabel, build_key, probe_ref))
             aggs = []
             for _i, it in agg_items:
                 if it[2] is None:
@@ -2736,22 +2819,24 @@ class SqlSession:
                     aggs.append(AggSpec(it[1], bind_mixed(it[2],
                                                           in_agg=True)))
             gcols = []
-            gmeta = []
             for g in stmt.group_by:
                 s = side_of(g)
                 if s is None or s[1].type != ColumnType.STRING:
                     return None     # dict-group shape: string keys only
-                side, col = s
-                if side == "p":
+                lbl, col = s
+                if lbl == lbl0:
                     gcols.append(col.id)
                 else:
-                    gcols.append(payload_ids.setdefault(
-                        col.name, BUILD_COL_BASE + len(payload_ids)))
-                gmeta.append(col)
+                    gcols.append(lane_of(lbl, col.name))
             pw = None
             for c in pushed.get(lbl0, ()):
                 pw = c if pw is None else ("and", pw, c)
             pwhere = bind_mixed(pw) if pw is not None else None
+            # register chain-anchor lanes LAST so expr/group lanes get
+            # stable ids whether or not the anchor is also projected
+            for jlabel, build_key, probe_ref in stages:
+                if probe_ref[0] == "lane":
+                    lane_of(probe_ref[1], probe_ref[2].name)
         except self._NoFuse:
             return None
         # payload columns referenced by AGGREGATES must be numeric —
@@ -2760,71 +2845,82 @@ class SqlSession:
         _numeric = (ColumnType.INT32, ColumnType.INT64,
                     ColumnType.TIMESTAMP, ColumnType.BOOL,
                     ColumnType.FLOAT64)
-        for name in agg_payload:
-            if _has(bsch, name).type not in _numeric:
+        for lbl, name in agg_payload:
+            if _has(self._join_schemas[lbl], name).type not in _numeric:
                 return None
-        # join KEYS must be exactly representable as int64 or strings —
-        # FLOAT64 keys would truncate under int() and silently change
-        # which rows match; the classic client join owns float keys
-        if build_key.type not in (ColumnType.INT32, ColumnType.INT64,
-                                  ColumnType.TIMESTAMP, ColumnType.BOOL,
-                                  ColumnType.STRING):
-            return None
-        # --- fetch + ship the (filtered) build side -------------------
-        # the probe's txn read point applies to the build scan too —
+        # --- fetch + ship the (filtered) build sides ------------------
+        # the probe's txn read point applies to every build scan too —
         # a mixed-snapshot join (build at latest, probe at start_ht)
         # could produce a row set no single snapshot contains
         read_ht = self._txn.start_ht if self._txn is not None else None
-        bw = None
-        for c in pushed.get(jlabel, ()):
-            bw = c if bw is None else ("and", bw, c)
-        bwhere = self._bind(bw, bsch) if bw is not None else None
-        bcols = tuple({build_key.name, *payload_ids})
-        bresp = await self.client.scan(
-            build_t, ReadRequest("", columns=bcols, where=bwhere,
-                                 read_ht=read_ht))
-        keys, prows = [], []
-        for r in bresp.rows:
-            k = r.get(build_key.name)
-            if k is None:
-                continue              # NULL keys can never inner-match
-            keys.append(k)
-            prows.append(r)
-        if len(set(keys)) != len(keys):
-            return None   # duplicate build keys multiply rows: the
-            #               materialized client join owns that shape
-        if build_key.type == ColumnType.STRING:
-            keys_arr = np.asarray(keys, object)
-        else:
-            keys_arr = np.asarray([int(k) for k in keys], np.int64)
-        payload = {}
-        for name, bid in payload_ids.items():
-            col = _has(bsch, name)
-            vals = [r.get(name) for r in prows]
-            nulls = np.asarray([v is None for v in vals], bool)
-            if col.type == ColumnType.STRING:
-                arr = np.asarray([v if v is not None else ""
-                                  for v in vals], object)
-            elif col.type == ColumnType.FLOAT64:
-                arr = np.asarray([v if v is not None else 0.0
-                                  for v in vals], np.float64)
+
+        async def fetch_build(jlabel, build_key):
+            bsch = self._join_schemas[jlabel]
+            bw = None
+            for c in pushed.get(jlabel, ()):
+                bw = c if bw is None else ("and", bw, c)
+            bwhere = self._bind(bw, bsch) if bw is not None else None
+            bcols = tuple({build_key.name, *payload_ids[jlabel]})
+            return await self.client.scan(
+                real_of.get(jlabel, jlabel),
+                ReadRequest("", columns=bcols, where=bwhere,
+                            read_ht=read_ht))
+
+        bresps = await asyncio.gather(
+            *[fetch_build(jlabel, build_key)
+              for jlabel, build_key, _ in stages])
+        wires = []
+        for (jlabel, build_key, probe_ref), bresp in zip(stages, bresps):
+            bsch = self._join_schemas[jlabel]
+            keys, prows = [], []
+            for r in bresp.rows:
+                k = r.get(build_key.name)
+                if k is None:
+                    continue          # NULL keys can never inner-match
+                keys.append(k)
+                prows.append(r)
+            if len(set(keys)) != len(keys):
+                return None   # duplicate build keys multiply rows: the
+                #               materialized client join owns that shape
+            if build_key.type == ColumnType.STRING:
+                keys_arr = np.asarray(keys, object)
             else:
-                arr = np.asarray([int(v) if v is not None else 0
-                                  for v in vals], np.int64)
-            payload[bid] = (arr, nulls)
-        wire = JoinWire(probe_col=probe_key.id, keys=keys_arr,
-                        payload=payload)
+                keys_arr = np.asarray([int(k) for k in keys], np.int64)
+            payload = {}
+            for name, bid in payload_ids[jlabel].items():
+                col = _has(bsch, name)
+                vals = [r.get(name) for r in prows]
+                nulls = np.asarray([v is None for v in vals], bool)
+                if col.type == ColumnType.STRING:
+                    arr = np.asarray([v if v is not None else ""
+                                      for v in vals], object)
+                elif col.type == ColumnType.FLOAT64:
+                    arr = np.asarray([v if v is not None else 0.0
+                                      for v in vals], np.float64)
+                else:
+                    arr = np.asarray([int(v) if v is not None else 0
+                                      for v in vals], np.int64)
+                payload[bid] = (arr, nulls)
+            probe_col = (probe_ref[1].id if probe_ref[0] == "p"
+                         else payload_ids[probe_ref[1]][
+                             probe_ref[2].name])
+            wires.append(JoinWire(probe_col=probe_col, keys=keys_arr,
+                                  payload=payload))
+        join_arg = wires[0] if len(wires) == 1 else tuple(wires)
         group = DictGroupSpec(
             cols=tuple(gcols),
             max_slots=int(flags.get("grouped_max_slots"))) \
             if gcols else None
-        resp = await self.client.scan(probe_t, ReadRequest(
-            "", where=pwhere, aggregates=tuple(aggs), group_by=group,
-            read_ht=read_ht, join=wire))
+        resp = await self.client.scan(
+            real_of.get(lbl0, lbl0),
+            ReadRequest("", where=pwhere, aggregates=tuple(aggs),
+                        group_by=group, read_ht=read_ht, join=join_arg))
         # --- format: mirror of the grouped-pushdown row builder -------
         if group is None:
-            return SqlResult(
-                [self._agg_row(stmt, list(resp.agg_values or ()))])
+            rows = [self._agg_row(stmt, list(resp.agg_values or ()))]
+            if any(it[0] == "window" for it in stmt.items):
+                self._apply_windows(stmt, rows)
+            return SqlResult(rows)
         counts = np.asarray(resp.group_counts) \
             if resp.group_counts is not None else np.zeros(0, np.int64)
         gmap = self._group_out_map(stmt)
@@ -2838,6 +2934,8 @@ class SqlSession:
             gvals = [np.asarray(v)[g] for v in resp.agg_values]
             row.update(self._agg_row(stmt, gvals))
             rows.append(row)
+        if any(it[0] == "window" for it in stmt.items):
+            self._apply_windows(stmt, rows)
         return SqlResult(self._order_limit(stmt, rows))
 
     # --- window functions (client-side; reference: PG WindowAgg) --------
@@ -2928,6 +3026,85 @@ class SqlSession:
                             k = e + 1
                 else:
                     raise ValueError(f"unknown window function {fn}")
+
+    def _window_wire(self, stmt: SelectStmt, schema):
+        """Lower the statement's window items to a WindowWire the
+        tablet can serve (ops/window_scan.serve_window_rows), or None
+        when the shape can't ship: the wire carries column NAMES (the
+        server's rows are name-keyed), so every reference must be a
+        BARE name resolving in the scanned schema, every item must use
+        a supported head with a plain-column argument, and ALL items
+        must share ONE (partition, order) spec — a multi-spec statement
+        would need several sorted passes, which the single-wire request
+        shape doesn't model.  Value/key KIND checks stay server-side
+        (typed WindowIneligible): the wire is semantically faithful
+        regardless, and a refusal costs one flag on the response."""
+        if not flags.get("window_server_pushdown_enabled"):
+            return None
+        from ..ops.window_scan import WindowWire
+
+        def _bare(name):
+            q, bare = self._split_qual(name)
+            if q is not None:
+                return None   # rows key by bare name only
+            try:
+                schema.column_by_name(bare)
+            except Exception:  # noqa: BLE001 — not a table column
+                return None
+            return bare
+
+        spec = None
+        items = []
+        for i, it in enumerate(stmt.items):
+            if it[0] != "window":
+                continue
+            _, fn, expr, partition, worder, args = it
+            key = (tuple(partition or ()), tuple(worder or ()))
+            if spec is None:
+                spec = key
+            elif spec != key:
+                return None
+            out = self._item_name(stmt, i)
+            if fn in ("row_number", "rank", "dense_rank"):
+                if expr is not None:
+                    return None
+                items.append((fn, 0, None, out))
+                continue
+            if fn == "count" and expr is None:
+                items.append(("count_star", 0, None, out))
+                continue
+            if not (isinstance(expr, tuple) and len(expr) == 2
+                    and expr[0] == "col"):
+                return None
+            vcol = _bare(expr[1])
+            if vcol is None:
+                return None
+            if fn in ("lag", "lead"):
+                off = int(args[0]) if args else 1
+                if off < 0:
+                    return None
+                items.append((fn, off, vcol, out))
+            elif fn in ("sum", "count", "min", "max"):
+                items.append((fn, 0, vcol, out))
+            else:
+                return None   # avg needs two lanes + a divide: client
+        if not items:
+            return None
+        partition, worder = spec
+        pnames, onames = [], []
+        for nm in partition:
+            b = _bare(nm)
+            if b is None:
+                return None
+            pnames.append(b)
+        for nm, desc in worder:
+            b = _bare(nm)
+            if b is None:
+                return None
+            onames.append((b, bool(desc)))
+        return WindowWire(partition_by=tuple(pnames),
+                          order_by=tuple(onames),
+                          items=tuple(items))
 
     def _apply_windows_device(self, stmt: SelectStmt,
                               rows: List[dict]) -> bool:

@@ -236,6 +236,32 @@ DEFINE_RUNTIME("join_max_build_slots", 65536,
                "smallest pow2 >= 2x build rows, so load factor stays "
                "<= 0.5). Build sides needing more slots fall back to "
                "the interpreted join with a typed reason.")
+DEFINE_RUNTIME("multi_join_max_stages", 4,
+               "Max probe stages a multi-join fused plan may carry "
+               "(ordered JoinWire list on one ReadRequest: chains like "
+               "lineitem JOIN orders JOIN customer, or stars with "
+               "several fact-table FKs). Each stage is one host-built "
+               "pow2 hash table probed sequentially inside ONE device "
+               "program under one shared visibility mask. Requests "
+               "with more stages fall back whole to the interpreted "
+               "join with a typed join_stage_count reason.")
+DEFINE_RUNTIME("window_server_pushdown_enabled", True,
+               "Serve window functions SERVER-side over a sorted-scan "
+               "request shape (ReadRequest.window routed through "
+               "ops/window_scan.py behind the docdb pushdown "
+               "boundary): the tablet sorts its visible rows by "
+               "(partition, order) and runs the segment-scan window "
+               "kernels over its OWN rows instead of the executor's "
+               "materialized ones. Ineligible shapes serve plain "
+               "sorted rows with a typed reason and the client tier "
+               "recomputes bit-identically; off disables the request "
+               "shape entirely.")
+DEFINE_RUNTIME("tpch_sf", 10.0,
+               "Scale factor for the full-suite TPC-H device gauntlet "
+               "(bench.py tpch_full / profile_plan.py): rows = "
+               "6,000,000 x sf per lineitem clone. The BENCH_TPCH_SF "
+               "env knob overrides per run (smoke runs use 0.1; the "
+               "acceptance gauntlet runs 10).")
 DEFINE_RUNTIME("grouped_spill_merge_enabled", True,
                "Partial-spill merge for over-cardinality device GROUP "
                "BYs: slots below the spill slot keep their (exact) "
